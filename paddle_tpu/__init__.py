@@ -1,0 +1,97 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+Capability surface modeled on PaddlePaddle v2.1 (/root/reference), re-designed
+from scratch for TPU: JAX/XLA is the compiler+runtime, Pallas provides hot
+kernels, pjit/shard_map over a device Mesh provides every parallelism the
+reference's Fleet implements with NCCL/brpc.
+"""
+from __future__ import annotations
+
+# dtypes
+from .core.dtype import (  # noqa: F401
+    bfloat16,
+    bool_ as bool,  # noqa: A001
+    complex64,
+    complex128,
+    float16,
+    float32,
+    float64,
+    get_default_dtype,
+    int8,
+    int16,
+    int32,
+    int64,
+    set_default_dtype,
+    uint8,
+)
+
+# device / place
+from .core.place import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    Place,
+    TPUPlace,
+    device_count,
+    get_device,
+    is_compiled_with_tpu,
+    set_device,
+)
+
+# tensor + autograd
+from .core.tensor import Parameter, Tensor, to_tensor  # noqa: F401
+from .core.autograd import enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
+from .framework.random import seed  # noqa: F401
+
+# the full tensor-op surface (also attaches Tensor methods)
+from .tensor_api import *  # noqa: F401,F403
+from . import tensor_api as _tensor_api
+
+from . import core, framework  # noqa: F401
+from . import autograd  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import amp  # noqa: F401
+from . import jit  # noqa: F401
+from . import io  # noqa: F401
+from . import metric  # noqa: F401
+from . import vision  # noqa: F401
+from . import text  # noqa: F401
+from .framework.io import load, save  # noqa: F401
+from .nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+
+__version__ = "0.1.0"
+
+
+def disable_static():  # compat no-op: this framework is always "dygraph+jit"
+    return None
+
+
+def enable_static():  # static graph == to_static/jit here
+    return None
+
+
+def in_dynamic_mode():
+    return True
+
+
+def is_compiled_with_cuda():  # TPU build: never CUDA
+    return False
+
+
+def ones_like(x, dtype=None):  # re-export convenience (already in tensor_api)
+    return _tensor_api.ones_like(x, dtype)
+
+
+# distributed is imported lazily to keep plain single-chip import light
+def __getattr__(name):
+    if name == "distributed":
+        import importlib
+
+        mod = importlib.import_module(".distributed", __name__)
+        globals()["distributed"] = mod
+        return mod
+    if name == "DataParallel":
+        from .distributed.parallel import DataParallel
+
+        return DataParallel
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

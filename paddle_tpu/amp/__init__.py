@@ -1,0 +1,43 @@
+"""Automatic mixed precision.
+
+Reference: python/paddle/amp/auto_cast.py (per-op white/black lists applied in
+imperative/amp_auto_cast.cc) + grad_scaler.py GradScaler backed by
+check_finite_and_unscale / update_loss_scaling CUDA ops.
+
+TPU-first: the compute dtype is bfloat16 — no loss scaling is *needed*
+(bf16 has fp32's exponent range), but GradScaler is provided for parity and
+for fp16 experiments; its finite-check/scale-update math runs as part of the
+jitted step (XLA fuses it) rather than as separate kernels.
+``auto_cast`` flips a thread-local that makes dispatch cast float inputs of
+matmul-class ops to the target dtype, mirroring the reference's trace-time
+rewrite.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .auto_cast import amp_guard, auto_cast, is_amp_enabled, amp_state  # noqa: F401
+from .grad_scaler import AmpScaler, GradScaler  # noqa: F401
+
+__all__ = ["auto_cast", "amp_guard", "GradScaler", "AmpScaler", "decorate"]
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16", master_weight=None,
+             save_dtype=None):
+    """reference paddle.amp.decorate: O2 casts model params to the low dtype."""
+    from ..core.dtype import convert_dtype
+
+    single = not isinstance(models, (list, tuple))
+    ms = [models] if single else list(models)
+    if level == "O2":
+        for m in ms:
+            m.to(dtype=convert_dtype(dtype))
+    if optimizers is None:
+        return models if single else ms
+    return (models if single else ms), optimizers

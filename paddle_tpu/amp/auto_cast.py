@@ -1,0 +1,84 @@
+"""auto_cast context (reference python/paddle/amp/auto_cast.py +
+imperative/amp_auto_cast.cc white/black lists)."""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+# mirrors the reference's default white list (matmul/conv run in low precision)
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "linear", "conv1d", "conv2d", "conv3d", "conv2d_transpose",
+    "einsum", "addmm",
+}
+# ops that must stay fp32 (reference black list: softmax w/ CE, norms, exp…)
+BLACK_LIST = {
+    "cross_entropy", "softmax_with_cross_entropy", "log_softmax", "norm",
+    "mean", "sum", "exp", "log", "layer_norm", "batch_norm", "group_norm",
+    "instance_norm", "logsumexp", "cumsum",
+}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = None
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+amp_state = _AmpState()
+
+
+def is_amp_enabled():
+    return amp_state.enabled
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None, level="O1",
+              dtype="bfloat16"):
+    import jax.numpy as jnp
+
+    from ..core.dtype import convert_dtype
+
+    prev = (amp_state.enabled, amp_state.dtype, amp_state.level,
+            amp_state.custom_white, amp_state.custom_black)
+    amp_state.enabled = enable
+    amp_state.dtype = convert_dtype(dtype)
+    amp_state.level = level
+    amp_state.custom_white = set(custom_white_list or ())
+    amp_state.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (amp_state.enabled, amp_state.dtype, amp_state.level,
+         amp_state.custom_white, amp_state.custom_black) = prev
+
+
+amp_guard = auto_cast
+
+
+def maybe_cast_inputs(op_name: str, vals):
+    """Called by core.dispatch: cast float32 arrays for white-listed ops."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    if not amp_state.enabled:
+        return vals
+    white = (WHITE_LIST | amp_state.custom_white) - amp_state.custom_black
+    if amp_state.level == "O2":
+        black = BLACK_LIST | amp_state.custom_black
+        if op_name in black:
+            return vals
+        cast_all = True
+    else:
+        cast_all = False
+        if op_name not in white:
+            return vals
+    out = []
+    for v in vals:
+        if hasattr(v, "dtype") and np.dtype(v.dtype) == np.float32:
+            out.append(v.astype(amp_state.dtype))
+        else:
+            out.append(v)
+    return out

@@ -1,0 +1,121 @@
+"""GradScaler (reference python/paddle/amp/grad_scaler.py:20 backed by
+operators/amp/check_finite_and_unscale_op + update_loss_scaling_op)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import no_grad
+from ..core.tensor import Tensor
+
+
+class GradScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.0**15, incr_ratio=2.0,
+                 decr_ratio=0.5, incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+                 use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def scale(self, loss: Tensor) -> Tensor:
+        if not self._enable:
+            return loss
+        from ..core.dispatch import dispatch
+
+        s = self._scale
+        return dispatch(lambda l: l * s, loss, op_name="scale_loss")
+
+    def unscale_(self, optimizer):
+        if not self._enable or self._unscaled:
+            return
+        inv = 1.0 / self._scale
+        finite_flags = []
+        with no_grad():
+            for p in optimizer._params():
+                if p.grad is None:
+                    continue
+                g = p.grad.value * inv
+                finite_flags.append(jnp.all(jnp.isfinite(g)))
+                p.grad = Tensor(g)
+        # single host sync for the whole parameter set
+        if finite_flags:
+            all_finite = finite_flags[0]
+            for f in finite_flags[1:]:
+                all_finite = all_finite & f
+            self._found_inf = not bool(all_finite)
+        else:
+            self._found_inf = False
+        self._unscaled = True
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)  # no-op if the user already unscaled (guard)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    def update(self):
+        self._unscaled = False
+        if not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "good": self._good_steps, "bad": self._bad_steps}
+
+    def load_state_dict(self, sd):
+        self._scale = sd["scale"]
+        self._good_steps = sd["good"]
+        self._bad_steps = sd["bad"]
+
+    # -- pure functional variant for jitted steps ---------------------------
+    def scale_and_check_pytree(self, grads):
+        """grads → (unscaled grads, found_inf flag array). jit-safe."""
+        inv = 1.0 / self._scale
+        unscaled = jax.tree_util.tree_map(lambda g: g * inv, grads)
+        leaves = jax.tree_util.tree_leaves(unscaled)
+        finite = jnp.array(True)
+        for l in leaves:
+            finite = finite & jnp.all(jnp.isfinite(l))
+        return unscaled, ~finite
+
+
+AmpScaler = GradScaler

@@ -1,0 +1,99 @@
+"""paddle.autograd equivalent: backward(), PyLayer custom autograd.
+
+Reference: python/paddle/autograd/py_layer.py:192 PyLayer / :21 PyLayerContext
+(C++ side imperative/py_layer_fwd.h).  TPU-first: a PyLayer subclass supplies
+forward/backward over raw arrays; we register it as a single fused tape node,
+so recompute-style tricks (e.g. fleet/utils/recompute.py in the reference)
+compose with the eager tape exactly as they do in the reference.
+"""
+from __future__ import annotations
+
+from ..core import autograd as _engine
+from ..core.autograd import backward, grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
+from ..core.tensor import Tensor
+
+__all__ = ["backward", "grad", "PyLayer", "PyLayerContext", "no_grad"]
+
+
+class PyLayerContext:
+    """Context passed to PyLayer.forward/backward (save_for_backward etc.)."""
+
+    def __init__(self):
+        self._saved = ()
+        self.attrs = {}
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+    # dict-like attr stash (parity with reference ctx usage)
+    def __setattr__(self, k, v):
+        object.__setattr__(self, k, v)
+
+
+class PyLayerMeta(type):
+    def __call__(cls, *args, **kwargs):  # PyLayer is not instantiated directly
+        raise RuntimeError("Call PyLayer subclasses via .apply(...)")
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+
+        multi = isinstance(out, (tuple, list))
+        outs = tuple(out) if multi else (out,)
+
+        diff_inputs = [
+            a for a in args if isinstance(a, Tensor) and not a.stop_gradient
+        ]
+        if not is_grad_enabled() or not diff_inputs:
+            return out
+
+        tensor_args = [a for a in args if isinstance(a, Tensor)]
+        diff_ids = {id(d) for d in diff_inputs}
+
+        def vjp_fn(cotangents):
+            gs = [Tensor(c, stop_gradient=True) for c in cotangents]
+            in_grads = cls.backward(ctx, *gs) if len(gs) > 1 else cls.backward(ctx, gs[0])
+            if not isinstance(in_grads, (tuple, list)):
+                in_grads = (in_grads,)
+            # map returned grads (ordered like tensor inputs) onto diff inputs
+            out_list = []
+            for gi, a in enumerate(tensor_args):
+                g = in_grads[gi] if gi < len(in_grads) else None
+                if id(a) in diff_ids:
+                    out_list.append(None if g is None else (g.value if isinstance(g, Tensor) else g))
+            return tuple(out_list)
+
+        import jax.dtypes
+
+        out_avals = [
+            (o.value.shape, o.value.dtype if _is_float(o) else jax.dtypes.float0)
+            for o in outs
+        ]
+        node = _engine.record(vjp_fn, diff_inputs, out_avals, name=cls.__name__)
+        for i, o in enumerate(outs):
+            if _is_float(o):
+                o.stop_gradient = False
+                o._node = node
+                o._out_index = i
+        return out
+
+
+def _is_float(t: Tensor) -> bool:
+    import numpy as np
+
+    return np.issubdtype(np.dtype(t.value.dtype), np.floating) or str(t.value.dtype) == "bfloat16"

@@ -1,0 +1,14 @@
+from . import autograd, dispatch, dtype, place
+from .autograd import enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled
+from .place import (
+    CPUPlace,
+    CUDAPlace,
+    Place,
+    TPUPlace,
+    current_place,
+    device_count,
+    get_device,
+    is_compiled_with_tpu,
+    set_device,
+)
+from .tensor import Parameter, Tensor, to_tensor

@@ -1,0 +1,99 @@
+"""Eager op dispatch — the Tracer::TraceOp analog.
+
+Reference: /root/reference/paddle/fluid/imperative/tracer.cc:144 (TraceOp:
+run kernel eagerly + record grad node when has_grad) and
+prepared_operator.cc:90 (kernel lookup).  TPU-first: the "kernel" is a pure
+jax function lowered by XLA; recording uses jax.vjp (see autograd.py).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import autograd
+from .dtype import is_floating
+
+
+def _is_float_aval(x) -> bool:
+    d = np.dtype(x.dtype)
+    return is_floating(d)
+
+
+_amp_cast = None
+
+
+def dispatch(fn: Callable, *args, op_name: str = "", **kwargs):
+    """Run pure jax fn over (Tensor|array|scalar) args, recording a tape node.
+
+    Tensors with stop_gradient=False and floating dtype are differentiable
+    inputs.  Static config goes in **kwargs (closed over, never traced as a
+    diff input).  Returns Tensor or tuple of Tensors mirroring fn's output.
+    """
+    from .tensor import Tensor
+
+    vals = [a.value if isinstance(a, Tensor) else a for a in args]
+
+    global _amp_cast
+    if _amp_cast is None:
+        from ..amp.auto_cast import amp_state, maybe_cast_inputs
+
+        _amp_cast = (amp_state, maybe_cast_inputs)
+    if _amp_cast[0].enabled:
+        vals = _amp_cast[1](op_name, vals)
+
+    diff_idx = []
+    if autograd.is_grad_enabled():
+        for i, a in enumerate(args):
+            if isinstance(a, Tensor) and not a.stop_gradient and _is_float_aval(a.value):
+                diff_idx.append(i)
+
+    if not diff_idx:
+        out = fn(*vals, **kwargs)
+        return _wrap_outputs(out, node=None)
+
+    def pure(*diff_vals):
+        call_vals = list(vals)
+        for i, v in zip(diff_idx, diff_vals):
+            call_vals[i] = v
+        return fn(*call_vals, **kwargs)
+
+    out, vjp_fn = jax.vjp(pure, *[vals[i] for i in diff_idx])
+
+    multi = isinstance(out, tuple)
+    outs = out if multi else (out,)
+    out_avals = [
+        (o.shape, o.dtype if _is_float_aval(o) else jax.dtypes.float0) for o in outs
+    ]
+    # backward always hands a tuple of cotangents; jax.vjp expects the fn's
+    # exact output structure, so unwrap for single-output ops
+    tape_vjp = vjp_fn if multi else (lambda cts, _f=vjp_fn: _f(cts[0]))
+    node = autograd.record(
+        tape_vjp, [args[i] for i in diff_idx], out_avals, name=op_name or getattr(fn, "__name__", "op")
+    )
+    wrapped = []
+    for idx, o in enumerate(outs):
+        if _is_float_aval(o):
+            t = Tensor(o, stop_gradient=False)
+            t._node = node
+            t._out_index = idx
+        else:
+            t = Tensor(o, stop_gradient=True)
+        wrapped.append(t)
+    return tuple(wrapped) if multi else wrapped[0]
+
+
+def _wrap_outputs(out, node):
+    from .tensor import Tensor
+
+    if isinstance(out, tuple):
+        return tuple(Tensor(o, stop_gradient=True) for o in out)
+    return Tensor(out, stop_gradient=True)
+
+
+def zero_cotangent(shape, dtype):
+    if dtype is jax.dtypes.float0:
+        return np.zeros(shape, jax.dtypes.float0)
+    return jnp.zeros(shape, dtype)

@@ -1,0 +1,92 @@
+"""Dtype handling.
+
+Capability parity with the reference's dtype surface
+(/root/reference/paddle/fluid/framework/framework.proto:91-117 VarType.Type and
+python/paddle/fluid/data_feeder.py convert_dtype), re-expressed as jnp dtypes.
+TPU-first: bfloat16 is a first-class citizen; float64 is supported but
+discouraged (XLA on TPU emulates it slowly).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects (exported at package top level, e.g. paddle_tpu.float32)
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR2DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "fp16": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "fp32": float32,
+    "float64": float64,
+    "fp64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+_DEFAULT_DTYPE = [jnp.float32]
+
+
+def set_default_dtype(d):
+    _DEFAULT_DTYPE[0] = convert_dtype(d)
+
+
+def get_default_dtype():
+    return _DEFAULT_DTYPE[0]
+
+
+def _x64_enabled() -> bool:
+    import jax
+
+    return bool(jax.config.jax_enable_x64)
+
+
+def convert_dtype(dtype):
+    """Normalise str / np.dtype / jnp dtype to a canonical numpy dtype class.
+
+    Under JAX's default x32 mode (TPU-native), 64-bit dtypes are narrowed to
+    their 32-bit twins — matching what the XLA runtime would do anyway."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            d = _STR2DTYPE[dtype]
+        except KeyError:
+            raise ValueError(f"Unknown dtype string: {dtype!r}")
+    else:
+        d = np.dtype(dtype).type
+    if not _x64_enabled():
+        d = {np.int64: int32, np.float64: float32, np.complex128: complex64}.get(d, d)
+    return d
+
+
+def dtype_name(dtype) -> str:
+    return np.dtype(dtype).name
+
+
+def is_floating(dtype) -> bool:
+    return np.issubdtype(np.dtype(dtype), np.floating) or np.dtype(dtype) == np.dtype(jnp.bfloat16)
+
+
+def is_integer(dtype) -> bool:
+    return np.issubdtype(np.dtype(dtype), np.integer)

@@ -1,0 +1,110 @@
+"""Distributed environment: device mesh management.
+
+Reference capability: process-per-GPU bring-up — fleet launch env vars
+(PADDLE_TRAINER_ID …, launch_utils.py), NCCL-id TCP exchange
+(platform/gen_comm_id_helper.cc:286), c_comm_init ops.
+
+TPU-first: one process per *host*, all chips visible to XLA; "rank" is a mesh
+coordinate, not an OS process.  Multi-host bootstrap is
+``jax.distributed.initialize`` (the coordination service plays the
+gen_comm_id role over DCN).  The global Mesh here is the ambient context all
+Fleet strategies shard over.
+"""
+from __future__ import annotations
+
+import os
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+_GLOBAL_MESH: Mesh | None = None
+
+# canonical axis order: dp outermost (DCN-friendly), then pp, then mp innermost
+# (mp collectives are latency-bound → nearest-neighbour ICI)
+AXIS_ORDER = ("dp", "pp", "sharding", "mp", "sp")
+
+
+def init_parallel_env(mesh_shape: Mapping[str, int] | None = None, devices=None,
+                      coordinator_address: str | None = None, num_processes: int | None = None,
+                      process_id: int | None = None):
+    """Create (and install) the global device mesh.
+
+    mesh_shape e.g. {'dp': 2, 'mp': 4}; missing axes get size 1. With no args,
+    all local devices go to 'dp' (classic DataParallel bring-up —
+    reference paddle.distributed.init_parallel_env).
+    """
+    global _GLOBAL_MESH
+    if coordinator_address is not None:
+        jax.distributed.initialize(coordinator_address, num_processes, process_id)
+    devs = list(devices) if devices is not None else jax.devices()
+    if mesh_shape is None:
+        mesh_shape = {"dp": len(devs)}
+    names = [a for a in AXIS_ORDER if mesh_shape.get(a, 1) > 1]
+    sizes = [mesh_shape[a] for a in names]
+    if not names:  # degenerate single-device mesh still needs one axis
+        names, sizes = ["dp"], [1]
+    total = int(np.prod(sizes))
+    if total > len(devs):
+        raise ValueError(f"mesh {dict(zip(names, sizes))} needs {total} devices, "
+                         f"have {len(devs)}")
+    arr = np.array(devs[:total]).reshape(sizes)
+    _GLOBAL_MESH = Mesh(arr, tuple(names))
+    return _GLOBAL_MESH
+
+
+def set_mesh(mesh: Mesh):
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
+    return mesh
+
+
+def get_mesh() -> Mesh:
+    global _GLOBAL_MESH
+    if _GLOBAL_MESH is None:
+        init_parallel_env()
+    return _GLOBAL_MESH
+
+
+def has_mesh() -> bool:
+    return _GLOBAL_MESH is not None
+
+
+def get_world_size() -> int:
+    if _GLOBAL_MESH is None:
+        return jax.device_count()
+    return int(np.prod(list(_GLOBAL_MESH.shape.values())))
+
+
+def get_rank() -> int:
+    # single-controller SPMD: the "current rank" concept only exists per-host
+    return jax.process_index()
+
+
+def axis_size(axis: str) -> int:
+    m = get_mesh()
+    return m.shape.get(axis, 1)
+
+
+def sharding_for(spec: PartitionSpec | None) -> NamedSharding:
+    m = get_mesh()
+    return NamedSharding(m, spec if spec is not None else PartitionSpec())
+
+
+def normalize_spec(spec: PartitionSpec, mesh: Mesh | None = None) -> PartitionSpec:
+    """Drop axes not present in the mesh (so one sharding table serves any
+    mesh topology — the reference's DistributedStrategy degrade path)."""
+    m = mesh or get_mesh()
+    parts = []
+    for p in spec:
+        if p is None:
+            parts.append(None)
+        elif isinstance(p, (tuple, list)):
+            kept = tuple(a for a in p if a in m.shape)
+            parts.append(kept if kept else None)
+        else:
+            parts.append(p if p in m.shape else None)
+    while parts and parts[-1] is None:
+        parts.pop()
+    return PartitionSpec(*parts)

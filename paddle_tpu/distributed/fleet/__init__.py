@@ -1,0 +1,28 @@
+from ..topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+from .base import Fleet, ShardedTrainStep, fleet, zero_shard_spec  # noqa: F401
+from .strategy import DistributedStrategy  # noqa: F401
+from .. import meta_parallel  # noqa: F401
+
+
+def init(role_maker=None, is_collective=True, strategy=None):
+    return fleet.init(role_maker, is_collective, strategy)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return fleet.distributed_optimizer(optimizer, strategy)
+
+
+def distributed_model(model):
+    return fleet.distributed_model(model)
+
+
+def get_hybrid_communicate_group():
+    return fleet.get_hybrid_communicate_group()
+
+
+def worker_num():
+    return fleet.worker_num()
+
+
+def worker_index():
+    return fleet.worker_index()

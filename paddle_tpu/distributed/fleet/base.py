@@ -1,0 +1,283 @@
+"""Fleet facade + strategy compiler.
+
+Reference: fleet/base/fleet_base.py:72 Fleet (init :139,
+distributed_optimizer :783, distributed_model :836, minimize :1288) and the
+meta-optimizer stack (StrategyCompiler strategy_compiler.py:114 ordering
+RawProgram/AMP/Recompute/Sharding/Pipeline program rewrites).
+
+TPU-first: strategies don't rewrite a Program — they parameterize ONE pjit'd
+train step:
+  - dp        → batch PartitionSpec('dp')       (the RawProgramOptimizer role)
+  - tp        → Megatron param specs over 'mp'  (TensorParallelOptimizer)
+  - sharding  → ZeRO specs for optimizer state  (ShardingOptimizer)
+  - pp        → stacked-layer specs over 'pp' + microbatch schedule
+  - recompute → jax.checkpoint                  (RecomputeOptimizer)
+  - gradient_merge → lax.scan grad accumulation (GradientMergeOptimizer)
+  - amp       → bf16 compute dtype              (AMPOptimizer)
+XLA then emits the same collectives the reference's rewrites insert by hand.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from ...framework import random as _random
+from ..env import get_mesh, init_parallel_env, normalize_spec, set_mesh
+from ..topology import HybridCommunicateGroup
+from .strategy import DistributedStrategy
+
+
+class Fleet:
+    def __init__(self):
+        self._strategy: DistributedStrategy | None = None
+        self._hcg: HybridCommunicateGroup | None = None
+        self._is_initialized = False
+
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        self._strategy = strategy or DistributedStrategy()
+        shape = self._strategy.mesh_shape()
+        # degrade axes that exceed available devices (single-chip dev loop)
+        n = len(jax.devices())
+        need = int(np.prod(list(shape.values())))
+        if need > n:
+            shape = {"dp": n}
+        init_parallel_env(shape)
+        self._hcg = HybridCommunicateGroup()
+        self._is_initialized = True
+        return self
+
+    @property
+    def strategy(self):
+        return self._strategy
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    # reference rank/size helpers
+    def worker_num(self):
+        from ..env import get_world_size
+
+        return get_world_size()
+
+    def worker_index(self):
+        from ..env import get_rank
+
+        return get_rank()
+
+    def is_first_worker(self):
+        return self.worker_index() == 0
+
+    def barrier_worker(self):
+        from .. import collective
+
+        collective.barrier()
+
+    def distributed_model(self, model):
+        """Attach mesh/shardings to a Layer model (reference wraps with
+        DataParallel/TensorParallel/PipelineParallel — here the sharding specs
+        already on Parameters do the work under pjit)."""
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        if strategy is not None:
+            self._strategy = strategy
+        if self._strategy and self._strategy.lamb:
+            from ...optimizer import Lamb
+
+            optimizer = Lamb(learning_rate=optimizer.get_lr(),
+                             parameters=optimizer._parameter_list)
+        if self._strategy and self._strategy.lars:
+            from ...optimizer import Lars
+
+            optimizer = Lars(learning_rate=optimizer.get_lr(),
+                             parameters=optimizer._parameter_list)
+        optimizer._fleet = self
+        return optimizer
+
+    def distributed_scaler(self, scaler):
+        return scaler
+
+    def build_train_step(self, loss_fn, params, optimizer, param_specs=None,
+                         batch_spec=None, donate=True):
+        """Compile the strategy-parameterized train step (the minimize analog)."""
+        return ShardedTrainStep(
+            loss_fn, params, optimizer, mesh=get_mesh(), param_specs=param_specs,
+            batch_spec=batch_spec, strategy=self._strategy, donate=donate,
+        )
+
+    def minimize(self, optimizer, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        loss.backward()
+        optimizer.step()
+        return [], []
+
+    # checkpoint helpers (reference fleet_base.py:732 save_persistables)
+    def save_persistables(self, executor_or_model, dirname, **kw):
+        from ...framework.io import save
+
+        model = executor_or_model
+        save(model.state_dict(), f"{dirname}/model.pdparams")
+
+    def save_inference_model(self, model, dirname, **kw):
+        self.save_persistables(model, dirname)
+
+
+fleet = Fleet()
+
+
+def _leaf_is_spec(x):
+    return isinstance(x, P) or x is None
+
+
+def zero_shard_spec(spec: P | None, shape, axis_name="sharding", mesh=None):
+    """ZeRO: add the sharding axis onto the first unsharded dim divisible by
+    its size (reference ShardingOptimizer shards flat param/opt buffers;
+    GSPMD shards dims — same memory win, no manual bucketing)."""
+    m = mesh or get_mesh()
+    size = m.shape.get(axis_name, 1)
+    if size <= 1:
+        return spec
+    parts = list(spec) if spec is not None else []
+    parts += [None] * (len(shape) - len(parts))
+    used = {a for p in parts if p is not None
+            for a in (p if isinstance(p, tuple) else (p,))}
+    if axis_name in used:
+        return spec
+    for i, (p, dim) in enumerate(zip(parts, shape)):
+        if dim % size == 0 and dim >= size:
+            if p is None:
+                parts[i] = axis_name
+            elif isinstance(p, tuple):
+                parts[i] = (*p, axis_name)
+            else:
+                parts[i] = (p, axis_name)
+            return P(*parts)
+    return spec
+
+
+class ShardedTrainStep:
+    """One pjit'd train step over the hybrid mesh (functional/pytree API).
+
+    loss_fn(params, batch, key) -> scalar loss (pure).
+    """
+
+    def __init__(self, loss_fn, params, optimizer, mesh=None, param_specs=None,
+                 batch_spec=None, strategy=None, donate=True, extra_batch_specs=None):
+        self.mesh = mesh or get_mesh()
+        set_mesh(self.mesh)
+        self.optimizer = optimizer
+        self.strategy = strategy or DistributedStrategy()
+        self._step = 0
+
+        if param_specs is None:
+            param_specs = jax.tree_util.tree_map(lambda _: P(), params)
+        param_specs = jax.tree_util.tree_map(
+            lambda s: normalize_spec(s if s is not None else P(), self.mesh),
+            param_specs, is_leaf=_leaf_is_spec,
+        )
+        self.param_specs = param_specs
+        p_shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.mesh, s), param_specs, is_leaf=_leaf_is_spec
+        )
+        self.params = jax.tree_util.tree_map(
+            lambda v, sh: jax.device_put(jnp.asarray(v), sh), params, p_shardings
+        )
+
+        # optimizer state: inherit param specs; ZeRO adds the sharding/dp axis
+        zero = self.strategy.sharding
+        zero_axis = "sharding" if self.mesh.shape.get("sharding", 1) > 1 else "dp"
+
+        def opt_spec_for(spec, v):
+            if not zero:
+                return spec
+            return zero_shard_spec(spec, v.shape, zero_axis, self.mesh) or spec
+
+        opt_specs = jax.tree_util.tree_map(
+            lambda spec, v: opt_spec_for(spec, v), param_specs, self.params,
+            is_leaf=_leaf_is_spec,
+        )
+        opt_shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.mesh, s), opt_specs, is_leaf=_leaf_is_spec
+        )
+        self.opt_state = jax.jit(
+            optimizer.init_state, out_shardings=opt_shardings
+        )(self.params)
+
+        if batch_spec is None:
+            batch_spec = P("dp") if self.mesh.shape.get("dp", 1) > 1 else P()
+        batch_spec = normalize_spec(batch_spec, self.mesh)
+        self.batch_sharding = NamedSharding(self.mesh, batch_spec)
+
+        k_steps = (self.strategy.gradient_merge_configs.k_steps
+                   if self.strategy.gradient_merge else 1)
+        remat = self.strategy.recompute
+
+        def step_fn(params, opt_state, key, lr, step, batch):
+            def loss_of(p, b, k):
+                return loss_fn(p, b, k)
+
+            if remat:
+                loss_of = jax.checkpoint(loss_of)
+            grad_fn = jax.value_and_grad(loss_of)
+
+            if k_steps > 1:
+                # GradientMerge: split the global batch into k micro-batches
+                # and accumulate grads in a scan (reference
+                # gradient_merge_optimizer.py; keeps peak memory ∝ micro-batch)
+                mb = jax.tree_util.tree_map(
+                    lambda b: b.reshape((k_steps, b.shape[0] // k_steps) + b.shape[1:]),
+                    batch,
+                )
+                keys = jax.random.split(key, k_steps)
+
+                def acc_body(carry, xs):
+                    g_acc, l_acc = carry
+                    b_i, k_i = xs
+                    l, g = grad_fn(params, b_i, k_i)
+                    g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+                    return (g_acc, l_acc + l), None
+
+                g0 = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                (grads, loss), _ = jax.lax.scan(acc_body, (g0, 0.0), (mb, keys))
+                grads = jax.tree_util.tree_map(lambda g: g / k_steps, grads)
+                loss = loss / k_steps
+            else:
+                loss, grads = grad_fn(params, batch, key)
+
+            new_params, new_opt = optimizer.apply_gradients(
+                grads, params, opt_state, lr=lr, step=step + 1)
+            return new_params, new_opt, loss
+
+        self._compiled = jax.jit(
+            step_fn,
+            in_shardings=(p_shardings, opt_shardings, None, None, None,
+                          self.batch_sharding),
+            out_shardings=(p_shardings, opt_shardings, None),
+            donate_argnums=(0, 1) if donate else (),
+        )
+
+    def _current_lr(self):
+        from ...optimizer.lr import LRScheduler
+
+        if isinstance(self.optimizer._lr, LRScheduler):
+            return float(self.optimizer._lr.lr_at(self._step))
+        return self.optimizer.get_lr()
+
+    def __call__(self, batch):
+        if isinstance(batch, Tensor):
+            batch = batch.value
+        batch = jax.tree_util.tree_map(
+            lambda b: jax.device_put(jnp.asarray(b), self.batch_sharding), batch)
+        key = _random.next_key()
+        lr = self._current_lr()
+        self._step += 1
+        self.params, self.opt_state, loss = self._compiled(
+            self.params, self.opt_state, key, lr, self._step, batch)
+        return Tensor(loss, stop_gradient=True)

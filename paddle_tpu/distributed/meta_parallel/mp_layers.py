@@ -1,0 +1,139 @@
+"""Tensor-parallel (Megatron) layers.
+
+Reference: fleet/meta_parallel/parallel_layers/mp_layers.py —
+VocabParallelEmbedding :30, ColumnParallelLinear :97, RowParallelLinear :170,
+ParallelCrossEntropy :249 (c_softmax_with_cross_entropy op).
+
+TPU-first: these layers DON'T issue collectives.  They are ordinary layers
+whose Parameters carry PartitionSpecs; under pjit, GSPMD inserts the
+identical all_gather/all_reduce pattern the reference codes by hand (column:
+gather output or keep sharded; row: psum partial sums).  Activation
+constraints (`mark_sharding`) pin the intermediate layouts so XLA cannot
+de-shard them.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ...core.dispatch import dispatch
+from ...core.tensor import Tensor
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.layer_base import Layer
+from ..env import get_mesh, has_mesh, normalize_spec
+
+
+def mark_sharding(x, spec: P):
+    """with_sharding_constraint that degrades gracefully outside pjit/mesh."""
+    def fn(v):
+        if not has_mesh():
+            return v
+        try:
+            return jax.lax.with_sharding_constraint(
+                v, jax.sharding.NamedSharding(get_mesh(), normalize_spec(spec)))
+        except Exception:
+            return v
+
+    if isinstance(x, Tensor):
+        return dispatch(fn, x, op_name="shard_constraint")
+    return fn(x)
+
+
+class ColumnParallelLinear(Layer):
+    """W [in, out] sharded on out ('mp'); output either kept sharded
+    (feeding a RowParallelLinear) or gathered."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 gather_output=True, name=None):
+        super().__init__()
+        self.gather_output = gather_output
+        self.weight = self.create_parameter((in_features, out_features),
+                                            attr=weight_attr,
+                                            default_initializer=I.XavierNormal())
+        self.weight._sharding_spec = P(None, "mp")
+        if has_bias:
+            self.bias = self.create_parameter((out_features,), is_bias=True)
+            self.bias._sharding_spec = P("mp")
+        else:
+            self.bias = None
+            self._parameters["bias"] = None
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        spec = P(*([None] * (len(y.shape) - 1) + ["mp"]))
+        y = mark_sharding(y, spec if not self.gather_output else P())
+        return y
+
+
+class RowParallelLinear(Layer):
+    """W [in, out] sharded on in ('mp'); partial products psum'd by XLA."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=False, name=None):
+        super().__init__()
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter((in_features, out_features),
+                                            attr=weight_attr,
+                                            default_initializer=I.XavierNormal())
+        self.weight._sharding_spec = P("mp", None)
+        if has_bias:
+            self.bias = self.create_parameter((out_features,), is_bias=True)
+            self.bias._sharding_spec = P()
+        else:
+            self.bias = None
+            self._parameters["bias"] = None
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            spec = P(*([None] * (len(x.shape) - 1) + ["mp"]))
+            x = mark_sharding(x, spec)
+        y = F.linear(x, self.weight, self.bias)
+        return mark_sharding(y, P())
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding table sharded on vocab ('mp').  GSPMD turns the gather into
+    per-shard partial lookups + psum — the reference's masked-lookup +
+    allreduce (mp_layers.py:70) emitted by the compiler."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter((num_embeddings, embedding_dim),
+                                            attr=weight_attr,
+                                            default_initializer=I.Normal(0.0, 0.02))
+        self.weight._sharding_spec = P("mp", None)
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ParallelCrossEntropy(Layer):
+    """Softmax-CE over vocab-sharded logits (reference
+    c_softmax_with_cross_entropy_op: sharded max/sum allreduce).  Under pjit
+    the fp32 log_softmax reduction is compiled to exactly those collectives
+    when the logits' last dim is sharded on 'mp'."""
+
+    def __init__(self, mp_group=None, name=None):
+        super().__init__()
+
+    def forward(self, input, label):
+        lbl = label.value if isinstance(label, Tensor) else label
+
+        def fn(logits):
+            spec = P(*([None] * (logits.ndim - 1) + ["mp"]))
+            if has_mesh():
+                try:
+                    logits = jax.lax.with_sharding_constraint(
+                        logits, jax.sharding.NamedSharding(get_mesh(), normalize_spec(spec)))
+                except Exception:
+                    pass
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            li = lbl
+            if li.ndim == logp.ndim:
+                li = jnp.squeeze(li, -1)
+            picked = jnp.take_along_axis(logp, li[..., None].astype(jnp.int32), axis=-1)
+            return -picked
+
+        return dispatch(fn, input, op_name="parallel_cross_entropy")

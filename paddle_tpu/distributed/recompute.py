@@ -1,0 +1,77 @@
+"""Recompute / activation checkpointing.
+
+Reference: fleet/utils/recompute.py:63 RecomputeFunction (PyLayer that stashes
+RNG state, drops activations, re-runs forward in backward) and static
+RecomputeOptimizer (fluid/optimizer.py:5288).
+
+TPU-first: inside jitted code this is just ``jax.checkpoint`` (XLA remat).
+For the eager tape, ``recompute`` wraps the function in a PyLayer whose
+backward re-runs the forward under jax.vjp — same memory/compute trade, and
+RNG state is restored so dropout masks replay identically (the reference's
+preserve_rng_state)."""
+from __future__ import annotations
+
+import jax
+
+from ..core.autograd import no_grad
+from ..core.tensor import Tensor
+from ..framework import random as _random
+
+
+def recompute(function, *args, preserve_rng_state=True, **kwargs):
+    from ..autograd import PyLayer
+
+    class _Recompute(PyLayer):
+        @staticmethod
+        def forward(ctx, *inputs):
+            ctx.saved_inputs = inputs
+            ctx.rng_key = _random._state.key if preserve_rng_state else None
+            with no_grad():
+                out = function(*inputs, **kwargs)
+            return out
+
+        @staticmethod
+        def backward(ctx, *grads):
+            inputs = ctx.saved_inputs
+            vals = [t.value if isinstance(t, Tensor) else t for t in inputs]
+            diff_idx = [i for i, t in enumerate(inputs)
+                        if isinstance(t, Tensor) and not t.stop_gradient]
+            if ctx.rng_key is not None:
+                saved_key = _random._state.key
+                _random._state.key = ctx.rng_key
+
+            def pure(*diff_vals):
+                call = list(vals)
+                for i, v in zip(diff_idx, diff_vals):
+                    call[i] = v
+                ts = [Tensor(v, stop_gradient=True) for v in call]
+                with no_grad():
+                    out = function(*ts, **kwargs)
+                if isinstance(out, (tuple, list)):
+                    return tuple(o.value for o in out)
+                return out.value
+
+            _, vjp_fn = jax.vjp(pure, *[vals[i] for i in diff_idx])
+            if ctx.rng_key is not None:
+                _random._state.key = saved_key
+            cts = tuple(g.value for g in grads)
+            if len(cts) == 1:
+                in_grads = vjp_fn(cts[0])
+            else:
+                in_grads = vjp_fn(cts)
+            out, gi = [], 0
+            for i, t in enumerate(inputs):
+                if not isinstance(t, Tensor):
+                    continue
+                if i in diff_idx:
+                    out.append(Tensor(in_grads[gi]))
+                    gi += 1
+                else:
+                    out.append(None)
+            return tuple(out) if len(out) > 1 else out[0]
+
+    return _Recompute.apply(*args)
+
+
+# pure-function variant for jitted paths
+checkpoint = jax.checkpoint

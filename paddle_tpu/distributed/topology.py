@@ -1,0 +1,163 @@
+"""Communication topology (reference fleet/base/topology.py:36
+CommunicateTopology / :117 HybridCommunicateGroup).
+
+The reference builds NCCL rings per hybrid axis; here a "group" is a named
+mesh axis — XLA lowers collectives over exactly those axes.  The classes keep
+the reference's rank↔coordinate API so Fleet-style code ports directly, while
+``CommGroup.axis`` is what actually drives pjit/shard_map.
+"""
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass
+class CommGroup:
+    """A communicator handle == a mesh axis (+ ranks for introspection)."""
+
+    axis: str | None
+    ranks: list
+    id: int = 0
+
+    @property
+    def nranks(self):
+        return len(self.ranks)
+
+    @property
+    def world_size(self):
+        return len(self.ranks)
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "model"),
+                 dims=(1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = list(itertools.product(*[range(d) for d in dims]))
+        self._coord2rank = {c: i for i, c in enumerate(self.coordinate)}
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return int(np.prod(self._dims))
+
+    def get_rank(self, **kwargs):
+        coord = tuple(kwargs[n] for n in self._parallel_names)
+        return self._coord2rank[coord]
+
+    def get_coord(self, rank):
+        return self.coordinate[rank]
+
+    def get_axis_list(self, axis_name, index):
+        """All ranks whose coordinate on axis == index."""
+        ax = self._parallel_names.index(axis_name)
+        return [r for r, c in enumerate(self.coordinate) if c[ax] == index]
+
+    def get_comm_list(self, axis_name):
+        """Groups of ranks that vary only along axis (the reference's ring
+        membership lists)."""
+        ax = self._parallel_names.index(axis_name)
+        others = [self._parallel_names[i] for i in range(len(self._dims)) if i != ax]
+        groups = []
+        for fixed in itertools.product(*[range(self.get_dim(n)) for n in others]):
+            grp = []
+            for k in range(self._dims[ax]):
+                kw = dict(zip(others, fixed))
+                kw[axis_name] = k
+                grp.append(self.get_rank(**kw))
+            groups.append(grp)
+        return groups
+
+
+class HybridCommunicateGroup:
+    """4-D (dp × pp × sharding × mp) topology over the global mesh
+    (reference topology.py:117)."""
+
+    AXIS_MAP = {"data": "dp", "pipe": "pp", "sharding": "sharding", "model": "mp"}
+
+    def __init__(self, topology: CommunicateTopology | None = None, rank: int = 0):
+        from .env import get_mesh
+
+        if topology is None:
+            mesh = get_mesh()
+            dims, names = [], []
+            for ref_name, ax in self.AXIS_MAP.items():
+                names.append(ref_name)
+                dims.append(mesh.shape.get(ax, 1))
+            topology = CommunicateTopology(names, dims)
+        self._topo = topology
+        self.global_rank = rank
+        self.nranks = topology.world_size()
+        self._dp_degree = topology.get_dim("data")
+        self._pp_degree = topology.get_dim("pipe")
+        self._sharding_degree = topology.get_dim("sharding")
+        self._mp_degree = topology.get_dim("model")
+        coord = topology.get_coord(rank)
+        names = topology.get_hybrid_group_names()
+        self._coord = dict(zip(names, coord))
+
+    # degrees
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    # ranks (coordinate along each axis)
+    def get_data_parallel_rank(self):
+        return self._coord["data"]
+
+    def get_model_parallel_rank(self):
+        return self._coord["model"]
+
+    def get_stage_id(self):
+        return self._coord["pipe"]
+
+    def get_sharding_parallel_rank(self):
+        return self._coord["sharding"]
+
+    # groups — mesh-axis handles
+    def get_data_parallel_group(self):
+        return CommGroup("dp", self._topo.get_axis_list("data", 0))
+
+    def get_model_parallel_group(self):
+        return CommGroup("mp", self._topo.get_axis_list("model", 0))
+
+    def get_pipe_parallel_group(self):
+        return CommGroup("pp", self._topo.get_axis_list("pipe", 0))
+
+    def get_sharding_parallel_group(self):
+        return CommGroup("sharding", self._topo.get_axis_list("sharding", 0))
+
+    def get_check_parallel_group(self):
+        return CommGroup(None, list(range(self.nranks)))
+
+    def get_p2p_next_rank(self):
+        stage = (self._coord["pipe"] + 1) % self._pp_degree
+        kw = dict(self._coord)
+        kw["pipe"] = stage
+        return self._topo.get_rank(**kw)
+
+    def get_p2p_prev_rank(self):
+        stage = (self._coord["pipe"] - 1) % self._pp_degree
+        kw = dict(self._coord)
+        kw["pipe"] = stage
+        return self._topo.get_rank(**kw)
+
+    def topology(self):
+        return self._topo

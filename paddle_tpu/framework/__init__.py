@@ -1,0 +1,2 @@
+from . import random
+from .random import get_rng_state_tracker, seed

@@ -1,0 +1,41 @@
+"""Checkpoint save/load.
+
+Reference: python/paddle/framework/io.py:565 paddle.save / :781 paddle.load
+(pickle-based nested state_dict).  Same wire format here (pickled dict of
+numpy arrays) so checkpoints are host-portable; sharded/distributed
+checkpoint of pjit arrays lives in distributed.fleet.checkpoint (per-host
+shard files, reference auto_checkpoint analog).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _to_numpy_tree(obj):
+    if isinstance(obj, Tensor):
+        return np.asarray(obj.value)
+    if isinstance(obj, dict):
+        return {k: _to_numpy_tree(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_numpy_tree(v) for v in obj)
+    if hasattr(obj, "dtype") and hasattr(obj, "shape"):  # jax array
+        return np.asarray(obj)
+    return obj
+
+
+def save(obj, path, protocol=4):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_numpy_tree(obj), f, protocol=protocol)
+
+
+def load(path, **kwargs):
+    with open(path, "rb") as f:
+        return pickle.load(f)

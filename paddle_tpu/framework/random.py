@@ -1,0 +1,105 @@
+"""Global RNG state.
+
+Reference capability: paddle.seed / Generator
+(/root/reference/python/paddle/framework/random.py, fluid/framework.py default
+program random_seed) plus per-mp-rank seed control
+(distributed/fleet/meta_parallel/parallel_layers/random.py).
+
+TPU-first: JAX threads explicit PRNG keys.  Eagerly we keep a global splitting
+key (dygraph convenience); jitted code paths install a *traced* key via
+``rng_scope`` so random ops inside jit stay functional.  ``RNGStatesTracker``
+provides named streams whose seeds are offset per model-parallel rank so
+dropout masks are identical-or-independent across TP shards as required.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+
+class _RNGState(threading.local):
+    def __init__(self):
+        self.key = jax.random.PRNGKey(0)
+        self.override = None  # traced key stack for jitted paths
+
+
+_state = _RNGState()
+
+
+def seed(s: int):
+    _state.key = jax.random.PRNGKey(int(s))
+    return _state.key
+
+
+def next_key(n: int = 1):
+    """Split a fresh key off the active stream (override-aware)."""
+    if _state.override is not None:
+        tracker = _state.override
+        return tracker.next(n)
+    _state.key, *sub = jax.random.split(_state.key, n + 1)
+    return sub[0] if n == 1 else list(sub)
+
+
+class _TracedKeyStream:
+    """Deterministic stream of keys derived from one traced root key."""
+
+    def __init__(self, root_key):
+        self.key = root_key
+
+    def next(self, n: int = 1):
+        self.key, *sub = jax.random.split(self.key, n + 1)
+        return sub[0] if n == 1 else list(sub)
+
+
+@contextlib.contextmanager
+def rng_scope(key):
+    """Route next_key() to a traced key — used by jitted train steps so that
+    dropout etc. remain pure functions of an input key."""
+    prev = _state.override
+    _state.override = _TracedKeyStream(key)
+    try:
+        yield
+    finally:
+        _state.override = prev
+
+
+class RNGStatesTracker:
+    """Named RNG streams (reference parallel_layers/random.py RNGStatesTracker):
+    'global' stream shared across TP ranks, 'local' stream offset by mp rank so
+    per-shard dropout is independent."""
+
+    def __init__(self):
+        self.states = {}
+
+    def add(self, name: str, s: int):
+        self.states[name] = jax.random.PRNGKey(int(s))
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str):
+        if name not in self.states:
+            raise ValueError(f"RNG state {name!r} not registered")
+        prev_key = _state.key
+        _state.key = self.states[name]
+        try:
+            yield
+        finally:
+            self.states[name] = _state.key
+            _state.key = prev_key
+
+
+_MODEL_PARALLEL_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _MODEL_PARALLEL_TRACKER
+
+
+def model_parallel_random_seed(base_seed: int, mp_rank: int = 0):
+    """Reference meta_parallel random.py: global seed same across mp ranks,
+    local seed offset per rank."""
+    seed(base_seed)
+    _MODEL_PARALLEL_TRACKER.states.clear()
+    _MODEL_PARALLEL_TRACKER.add("global_seed", base_seed)
+    _MODEL_PARALLEL_TRACKER.add("local_seed", base_seed + 1024 + mp_rank)

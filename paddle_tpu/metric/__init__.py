@@ -1,0 +1,166 @@
+"""Metrics (reference python/paddle/metric/metrics.py:37 Metric / :180
+Accuracy / Precision / Recall / Auc)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _np(x):
+    return np.asarray(x.value) if isinstance(x, Tensor) else np.asarray(x)
+
+
+class Metric:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        return self.__class__.__name__.lower()
+
+    def compute(self, *args):
+        return args
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None):
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def reset(self):
+        self.total = np.zeros(len(self.topk))
+        self.count = np.zeros(len(self.topk))
+
+    def compute(self, pred, label):
+        p = _np(pred)
+        l = _np(label)
+        if l.ndim == p.ndim:
+            l = l.squeeze(-1)
+        idx = np.argsort(-p, axis=-1)[..., : self.maxk]
+        correct = idx == l[..., None]
+        return correct
+
+    def update(self, correct):
+        c = _np(correct)
+        for i, k in enumerate(self.topk):
+            hits = c[..., :k].any(axis=-1)
+            self.total[i] += hits.sum()
+            self.count[i] += hits.size
+        accs = self.total / np.maximum(self.count, 1)
+        return accs[0] if len(self.topk) == 1 else accs
+
+    def accumulate(self):
+        accs = self.total / np.maximum(self.count, 1)
+        return float(accs[0]) if len(self.topk) == 1 else [float(a) for a in accs]
+
+    def name(self):
+        return self._name
+
+
+class Precision(Metric):
+    def __init__(self, name=None):
+        self._name = name or "precision"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        p = (_np(preds) > 0.5).astype(np.int32).reshape(-1)
+        l = _np(labels).astype(np.int32).reshape(-1)
+        self.tp += int(((p == 1) & (l == 1)).sum())
+        self.fp += int(((p == 1) & (l == 0)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return float(self.tp / denom) if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    def __init__(self, name=None):
+        self._name = name or "recall"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        p = (_np(preds) > 0.5).astype(np.int32).reshape(-1)
+        l = _np(labels).astype(np.int32).reshape(-1)
+        self.tp += int(((p == 1) & (l == 1)).sum())
+        self.fn += int(((p == 0) & (l == 1)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return float(self.tp / denom) if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    """Approximate AUC via threshold buckets (reference metrics.py Auc /
+    operators/metrics/auc_op)."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name=None):
+        self._name = name or "auc"
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1)
+        self._stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        p = _np(preds)
+        if p.ndim == 2:
+            p = p[:, -1]
+        l = _np(labels).reshape(-1)
+        buckets = np.minimum((p * self.num_thresholds).astype(np.int64), self.num_thresholds)
+        for b, y in zip(buckets, l):
+            if y:
+                self._stat_pos[b] += 1
+            else:
+                self._stat_neg[b] += 1
+
+    def accumulate(self):
+        tot_pos = self._stat_pos.sum()
+        tot_neg = self._stat_neg.sum()
+        if not tot_pos or not tot_neg:
+            return 0.0
+        # trapezoid over cumulated counts from highest threshold down
+        pos = self._stat_pos[::-1].cumsum()
+        neg = self._stat_neg[::-1].cumsum()
+        tpr = pos / tot_pos
+        fpr = neg / tot_neg
+        return float(np.trapezoid(tpr, fpr)) if hasattr(np, "trapezoid") else float(
+            np.trapz(tpr, fpr))
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1):
+    """functional accuracy (reference fluid.layers.accuracy)."""
+    import jax.numpy as jnp
+
+    p = input.value if isinstance(input, Tensor) else input
+    l = label.value if isinstance(label, Tensor) else label
+    if l.ndim == p.ndim:
+        l = l.squeeze(-1)
+    _, idx = (jnp.sort(p, axis=-1)[..., ::-1][..., :k], jnp.argsort(-p, axis=-1)[..., :k])
+    correct = (idx == l[..., None]).any(axis=-1)
+    return Tensor(correct.mean(dtype=jnp.float32))

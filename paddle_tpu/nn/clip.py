@@ -1,0 +1,94 @@
+"""Gradient clipping (reference python/paddle/fluid/clip.py:152/243/345).
+
+Clip objects expose BOTH an eager interface over (param, grad) Tensor pairs
+and a pure pytree transform (``apply_pytree``) used inside jitted train steps
+— the hybrid-parallel-aware global-norm variant lives in
+distributed.fleet (psum of the local square-sums across mesh axes).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        """params_grads: list[(param, grad Tensor|None)] → same with clipped grads."""
+        raise NotImplementedError
+
+    def apply_pytree(self, grads):
+        """grads: pytree of arrays → clipped pytree (pure; jit-safe)."""
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):  # noqa: A002
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g.value, self.min, self.max))))
+        return out
+
+    def apply_pytree(self, grads):
+        return jax.tree_util.tree_map(lambda g: jnp.clip(g, self.min, self.max), grads)
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip_one(self, g):
+        n = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+        scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(n, 1e-12))
+        return (g * scale).astype(g.dtype)
+
+    def __call__(self, params_grads):
+        return [
+            (p, Tensor(self._clip_one(g.value)) if g is not None else None)
+            for p, g in params_grads
+        ]
+
+    def apply_pytree(self, grads):
+        return jax.tree_util.tree_map(self._clip_one, grads)
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _scale(self, leaves):
+        sq = sum(jnp.sum(g.astype(jnp.float32) ** 2) for g in leaves)
+        gnorm = jnp.sqrt(sq)
+        return jnp.minimum(1.0, self.clip_norm / jnp.maximum(gnorm, 1e-12))
+
+    def __call__(self, params_grads):
+        gs = [g.value for _, g in params_grads if g is not None]
+        if not gs:
+            return params_grads
+        s = self._scale(gs)
+        return [
+            (p, Tensor((g.value * s).astype(g.value.dtype)) if g is not None else None)
+            for p, g in params_grads
+        ]
+
+    def apply_pytree(self, grads):
+        leaves = jax.tree_util.tree_leaves(grads)
+        s = self._scale(leaves)
+        return jax.tree_util.tree_map(lambda g: (g * s).astype(g.dtype), grads)
+
+
+def clip_grad_norm_(parameters, max_norm):
+    """torch-style convenience used by some reference tests."""
+    pg = [(p, p.grad) for p in parameters if p.grad is not None]
+    clipped = ClipGradByGlobalNorm(max_norm)(pg)
+    for (p, _), (_, g) in zip(pg, clipped):
+        p.grad = g

@@ -1,0 +1,89 @@
+"""Activation layers (reference python/paddle/nn/layer/activation.py)."""
+from __future__ import annotations
+
+from .. import functional as F
+from .. import initializer as I
+from ..layer_base import Layer
+
+
+def _simple(name, fn_name, **defaults):
+    def __init__(self, name_arg=None, **kw):
+        Layer.__init__(self)
+        for k, v in defaults.items():
+            setattr(self, k, kw.get(k, v))
+
+    def forward(self, x):
+        fn = getattr(F, fn_name)
+        kw = {k: getattr(self, k) for k in defaults}
+        return fn(x, **kw)
+
+    return type(name, (Layer,), {"__init__": __init__, "forward": forward})
+
+
+ReLU = _simple("ReLU", "relu")
+ReLU6 = _simple("ReLU6", "relu6")
+Sigmoid = _simple("Sigmoid", "sigmoid")
+Tanh = _simple("Tanh", "tanh")
+Hardswish = _simple("Hardswish", "hardswish")
+Hardsigmoid = _simple("Hardsigmoid", "hardsigmoid")
+Hardtanh = _simple("Hardtanh", "hardtanh", min=-1.0, max=1.0)
+Hardshrink = _simple("Hardshrink", "hardshrink", threshold=0.5)
+Softshrink = _simple("Softshrink", "softshrink", threshold=0.5)
+Tanhshrink = _simple("Tanhshrink", "tanhshrink")
+Swish = _simple("Swish", "swish")
+SiLU = _simple("SiLU", "silu")
+Mish = _simple("Mish", "mish")
+Softsign = _simple("Softsign", "softsign")
+LogSigmoid = _simple("LogSigmoid", "log_sigmoid")
+ELU = _simple("ELU", "elu", alpha=1.0)
+CELU = _simple("CELU", "celu", alpha=1.0)
+SELU = _simple("SELU", "selu")
+LeakyReLU = _simple("LeakyReLU", "leaky_relu", negative_slope=0.01)
+Softplus = _simple("Softplus", "softplus", beta=1.0, threshold=20.0)
+
+
+class GELU(Layer):
+    def __init__(self, approximate=False, name=None):
+        super().__init__()
+        self.approximate = approximate
+
+    def forward(self, x):
+        return F.gelu(x, self.approximate)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, self.axis)
+
+
+class LogSoftmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.log_softmax(x, self.axis)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            (num_parameters,), attr=weight_attr, default_initializer=I.Constant(init)
+        )
+
+    def forward(self, x):
+        return F.prelu(x, self.weight)
+
+
+class GLU(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.glu(x, self.axis)

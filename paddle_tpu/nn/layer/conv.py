@@ -1,0 +1,107 @@
+"""Conv layers (reference python/paddle/nn/layer/conv.py → conv2d/cudnn ops).
+
+Weights use the reference layout [out_c, in_c/groups, *kernel]; XLA re-lays
+them out for the MXU at compile time.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import functional as F
+from .. import initializer as I
+from ..layer_base import Layer
+
+
+class _ConvNd(Layer):
+    def __init__(self, nd, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        k = kernel_size if isinstance(kernel_size, (list, tuple)) else (kernel_size,) * nd
+        self.kernel_size = tuple(int(i) for i in k)
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.groups = groups
+        self.data_format = data_format
+        self._nd = nd
+        fan_in = in_channels // groups * int(np.prod(self.kernel_size))
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups, *self.kernel_size),
+            attr=weight_attr,
+            default_initializer=I.KaimingUniform(fan_in=fan_in),
+        )
+        if bias_attr is False:
+            self.bias = None
+            self._parameters["bias"] = None
+        else:
+            self.bias = self.create_parameter((out_channels,), attr=bias_attr, is_bias=True)
+
+    def extra_repr(self):
+        return (f"{self.in_channels}, {self.out_channels}, kernel_size={self.kernel_size}, "
+                f"stride={self.stride}, padding={self.padding}")
+
+
+class Conv1D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NCL"):
+        super().__init__(1, in_channels, out_channels, kernel_size, stride, padding,
+                         dilation, groups, padding_mode, weight_attr, bias_attr, data_format)
+
+    def forward(self, x):
+        return F.conv1d(x, self.weight, self.bias, self.stride, self.padding,
+                        self.dilation, self.groups, self.data_format)
+
+
+class Conv2D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NCHW"):
+        super().__init__(2, in_channels, out_channels, kernel_size, stride, padding,
+                         dilation, groups, padding_mode, weight_attr, bias_attr, data_format)
+
+    def forward(self, x):
+        return F.conv2d(x, self.weight, self.bias, self.stride, self.padding,
+                        self.dilation, self.groups, self.data_format)
+
+
+class Conv3D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NCDHW"):
+        super().__init__(3, in_channels, out_channels, kernel_size, stride, padding,
+                         dilation, groups, padding_mode, weight_attr, bias_attr, data_format)
+
+    def forward(self, x):
+        return F.conv3d(x, self.weight, self.bias, self.stride, self.padding,
+                        self.dilation, self.groups, self.data_format)
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, weight_attr=None,
+                 bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        k = kernel_size if isinstance(kernel_size, (list, tuple)) else (kernel_size,) * 2
+        self.kernel_size = tuple(int(i) for i in k)
+        self.stride, self.padding = stride, padding
+        self.output_padding, self.dilation, self.groups = output_padding, dilation, groups
+        self.data_format = data_format
+        fan_in = in_channels * int(np.prod(self.kernel_size)) // groups
+        self.weight = self.create_parameter(
+            (in_channels, out_channels // groups, *self.kernel_size),
+            attr=weight_attr, default_initializer=I.KaimingUniform(fan_in=fan_in),
+        )
+        if bias_attr is False:
+            self.bias = None
+            self._parameters["bias"] = None
+        else:
+            self.bias = self.create_parameter((out_channels,), attr=bias_attr, is_bias=True)
+
+    def forward(self, x, output_size=None):
+        return F.conv2d_transpose(x, self.weight, self.bias, self.stride, self.padding,
+                                  self.output_padding, self.dilation, self.groups,
+                                  self.data_format, output_size)

@@ -1,0 +1,216 @@
+"""Norm layers (reference python/paddle/nn/layer/norm.py → batch_norm_op etc.).
+
+BatchNorm keeps running stats as buffers and updates them eagerly in train
+mode; under a jitted functional step the stats ride through the buffer pytree
+(see jit.functional_call), which is the TPU-native version of the reference's
+in-place mean/variance mutation inside the CUDA kernel.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.autograd import no_grad
+from ...core.tensor import Tensor
+from .. import functional as F
+from .. import initializer as I
+from ..layer_base import Layer
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", use_global_stats=None, name=None):
+        super().__init__()
+        self.num_features = num_features
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.data_format = data_format
+        self.use_global_stats = use_global_stats
+        if weight_attr is False:
+            self.weight = None
+            self._parameters["weight"] = None
+        else:
+            self.weight = self.create_parameter(
+                (num_features,), attr=weight_attr, default_initializer=I.Constant(1.0)
+            )
+        if bias_attr is False:
+            self.bias = None
+            self._parameters["bias"] = None
+        else:
+            self.bias = self.create_parameter((num_features,), attr=bias_attr, is_bias=True)
+        self.register_buffer("_mean", Tensor(jnp.zeros((num_features,))))
+        self.register_buffer("_variance", Tensor(jnp.ones((num_features,))))
+
+    def forward(self, x):
+        training = self.training and not (self.use_global_stats is True)
+        if training:
+            # update running stats (reference batch_norm kernel side effect)
+            v = x.value
+            ax = 1 if self.data_format.startswith("NC") else x.ndim - 1
+            raxes = tuple(i for i in range(v.ndim) if i != ax)
+            bm = jnp.mean(v, axis=raxes)
+            bv = jnp.var(v, axis=raxes)
+            m = self.momentum
+            mean_buf = self._buffers["_mean"]
+            var_buf = self._buffers["_variance"]
+            mean_buf._value = m * mean_buf._value + (1 - m) * bm
+            var_buf._value = m * var_buf._value + (1 - m) * bv
+        return F.batch_norm(
+            x, self._buffers["_mean"], self._buffers["_variance"], self.weight, self.bias,
+            training=training, momentum=self.momentum, epsilon=self.epsilon,
+            data_format=self.data_format, use_global_stats=self.use_global_stats,
+        )
+
+    def extra_repr(self):
+        return f"num_features={self.num_features}, momentum={self.momentum}"
+
+
+class BatchNorm(_BatchNormBase):
+    pass
+
+
+class BatchNorm1D(_BatchNormBase):
+    pass
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    pass
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """On TPU, batch stats inside pjit are computed over the *global* batch by
+    construction (XLA all-reduces the moments when the batch axis is sharded),
+    so SyncBatchNorm == BatchNorm.  Kept for API parity with the reference's
+    nn.SyncBatchNorm (NCCL-based)."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        return layer
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None, bias_attr=None,
+                 name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.normalized_shape = tuple(normalized_shape)
+        self.epsilon = epsilon
+        if weight_attr is False:
+            self.weight = None
+            self._parameters["weight"] = None
+        else:
+            self.weight = self.create_parameter(
+                self.normalized_shape, attr=weight_attr, default_initializer=I.Constant(1.0)
+            )
+        if bias_attr is False:
+            self.bias = None
+            self._parameters["bias"] = None
+        else:
+            self.bias = self.create_parameter(self.normalized_shape, attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.layer_norm(x, self.normalized_shape, self.weight, self.bias, self.epsilon)
+
+    def extra_repr(self):
+        return f"normalized_shape={self.normalized_shape}, epsilon={self.epsilon}"
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self.num_groups = num_groups
+        self.num_channels = num_channels
+        self.epsilon = epsilon
+        if weight_attr is False:
+            self.weight = None
+            self._parameters["weight"] = None
+        else:
+            self.weight = self.create_parameter(
+                (num_channels,), attr=weight_attr, default_initializer=I.Constant(1.0)
+            )
+        if bias_attr is False:
+            self.bias = None
+            self._parameters["bias"] = None
+        else:
+            self.bias = self.create_parameter((num_channels,), attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.group_norm(x, self.num_groups, self.weight, self.bias, self.epsilon)
+
+
+class InstanceNorm2D(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self.num_features = num_features
+        self.epsilon = epsilon
+        if weight_attr is False:
+            self.weight = None
+            self._parameters["weight"] = None
+        else:
+            self.weight = self.create_parameter(
+                (num_features,), attr=weight_attr, default_initializer=I.Constant(1.0)
+            )
+        if bias_attr is False:
+            self.bias = None
+            self._parameters["bias"] = None
+        else:
+            self.bias = self.create_parameter((num_features,), attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.instance_norm(x, weight=self.weight, bias=self.bias, eps=self.epsilon)
+
+
+InstanceNorm1D = InstanceNorm2D
+InstanceNorm3D = InstanceNorm2D
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+        super().__init__()
+        self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
+
+    def forward(self, x):
+        return F.local_response_norm(x, self.size, self.alpha, self.beta, self.k)
+
+
+class SpectralNorm(Layer):
+    """Power-iteration spectral norm (reference spectral_norm_op)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12, name=None):
+        super().__init__()
+        self.dim = dim
+        self.power_iters = power_iters
+        self.eps = eps
+        h = weight_shape[dim]
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= s
+        self.weight_u = self.create_parameter((h,), default_initializer=I.Normal(0, 1))
+        self.weight_v = self.create_parameter((w,), default_initializer=I.Normal(0, 1))
+        self.weight_u.stop_gradient = True
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        import jax
+
+        wv = weight.value if isinstance(weight, Tensor) else weight
+        mat = jnp.moveaxis(wv, self.dim, 0).reshape(wv.shape[self.dim], -1)
+        u, v = self.weight_u._value, self.weight_v._value
+        for _ in range(self.power_iters):
+            v = mat.T @ u
+            v = v / (jnp.linalg.norm(v) + self.eps)
+            u = mat @ v
+            u = u / (jnp.linalg.norm(u) + self.eps)
+        self.weight_u._value = u
+        self.weight_v._value = v
+        sigma = u @ mat @ v
+        from ...core.dispatch import dispatch
+
+        return dispatch(lambda w_: w_ / sigma, weight, op_name="spectral_norm")

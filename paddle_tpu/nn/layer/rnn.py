@@ -1,0 +1,298 @@
+"""RNN layers (reference python/paddle/nn/layer/rnn.py → rnn_op/cudnn RNN;
+CPU JIT kernels operators/jit/gen for gru/lstm cells).
+
+TPU-first: the time loop is ``lax.scan`` — XLA unrolls/fuses the cell matmuls
+onto the MXU; no per-step Python dispatch, no cuDNN descriptor machinery.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import dispatch
+from ...core.tensor import Tensor
+from .. import functional as F
+from .. import initializer as I
+from ..layer_base import Layer
+
+
+def _cell_params(layer: Layer, input_size, hidden_size, gates, weight_attr=None, bias_attr=None):
+    k = 1.0 / (hidden_size ** 0.5)
+    init = I.Uniform(-k, k)
+    layer.weight_ih = layer.create_parameter((gates * hidden_size, input_size),
+                                             attr=weight_attr, default_initializer=init)
+    layer.weight_hh = layer.create_parameter((gates * hidden_size, hidden_size),
+                                             attr=weight_attr, default_initializer=init)
+    if bias_attr is False:
+        layer.bias_ih = None
+        layer.bias_hh = None
+        layer._parameters["bias_ih"] = None
+        layer._parameters["bias_hh"] = None
+    else:
+        layer.bias_ih = layer.create_parameter((gates * hidden_size,), attr=bias_attr,
+                                               default_initializer=init, is_bias=True)
+        layer.bias_hh = layer.create_parameter((gates * hidden_size,), attr=bias_attr,
+                                               default_initializer=init, is_bias=True)
+
+
+class SimpleRNNCell(Layer):
+    def __init__(self, input_size, hidden_size, activation="tanh", weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        _cell_params(self, input_size, hidden_size, 1, weight_attr, bias_attr)
+
+    def _step(self, x, h, wih, whh, bih, bhh):
+        z = x @ wih.T + h @ whh.T
+        if bih is not None:
+            z = z + bih + bhh
+        return jnp.tanh(z) if self.activation == "tanh" else jax.nn.relu(z)
+
+    def forward(self, inputs, states=None):
+        from ... import tensor_api as P
+
+        if states is None:
+            states = P.zeros((inputs.shape[0], self.hidden_size))
+        args = [inputs, states, self.weight_ih, self.weight_hh]
+        if self.bias_ih is not None:
+            args += [self.bias_ih, self.bias_hh]
+
+        def fn(x, h, wih, whh, *b):
+            return self._step(x, h, wih, whh, b[0] if b else None, b[1] if b else None)
+
+        h = dispatch(fn, *args, op_name="rnn_cell")
+        return h, h
+
+
+class LSTMCell(Layer):
+    def __init__(self, input_size, hidden_size, weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        _cell_params(self, input_size, hidden_size, 4, weight_attr, bias_attr)
+
+    def forward(self, inputs, states=None):
+        from ... import tensor_api as P
+
+        if states is None:
+            z = P.zeros((inputs.shape[0], self.hidden_size))
+            states = (z, z.clone())
+        h0, c0 = states
+        args = [inputs, h0, c0, self.weight_ih, self.weight_hh]
+        if self.bias_ih is not None:
+            args += [self.bias_ih, self.bias_hh]
+
+        H = self.hidden_size
+
+        def fn(x, h, c, wih, whh, *b):
+            z = x @ wih.T + h @ whh.T
+            if b:
+                z = z + b[0] + b[1]
+            i, f, g, o = jnp.split(z, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+
+        h, c = dispatch(fn, *args, op_name="lstm_cell")
+        return h, (h, c)
+
+
+class GRUCell(Layer):
+    def __init__(self, input_size, hidden_size, weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        _cell_params(self, input_size, hidden_size, 3, weight_attr, bias_attr)
+
+    def forward(self, inputs, states=None):
+        from ... import tensor_api as P
+
+        if states is None:
+            states = P.zeros((inputs.shape[0], self.hidden_size))
+        args = [inputs, states, self.weight_ih, self.weight_hh]
+        if self.bias_ih is not None:
+            args += [self.bias_ih, self.bias_hh]
+
+        def fn(x, h, wih, whh, *b):
+            zi = x @ wih.T
+            zh = h @ whh.T
+            if b:
+                zi = zi + b[0]
+                zh = zh + b[1]
+            ri, ui, ci = jnp.split(zi, 3, axis=-1)
+            rh, uh, ch = jnp.split(zh, 3, axis=-1)
+            r = jax.nn.sigmoid(ri + rh)
+            u = jax.nn.sigmoid(ui + uh)
+            c = jnp.tanh(ci + r * ch)
+            return (1 - u) * c + u * h
+
+        h = dispatch(fn, *args, op_name="gru_cell")
+        return h, h
+
+
+class RNN(Layer):
+    """Wrap a cell into a scanned sequence layer (reference rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ... import tensor_api as P
+
+        steps = inputs.shape[0] if self.time_major else inputs.shape[1]
+        outs = []
+        states = initial_states
+        idx = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        for t in idx:
+            x_t = inputs[t] if self.time_major else inputs[:, t]
+            out, states = self.cell(x_t, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        stacked = P.stack(outs, axis=0 if self.time_major else 1)
+        return stacked, states
+
+
+class _RNNBase(Layer):
+    """Multi-layer (optionally bidirectional) rnn built on scanned cells.
+
+    The whole unrolled loop lives in one dispatch, so eager mode costs one
+    XLA computation per forward, not one per timestep."""
+
+    MODE = "RNN_TANH"
+    GATES = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        ndir = 2 if self.bidirectional else 1
+        self.num_directions = ndir
+        k = 1.0 / (hidden_size ** 0.5)
+        init = I.Uniform(-k, k)
+        self._param_names = []
+        for layer_i in range(num_layers):
+            for d in range(ndir):
+                in_sz = input_size if layer_i == 0 else hidden_size * ndir
+                suffix = f"l{layer_i}" + ("_reverse" if d else "")
+                for pname, shape in [
+                    (f"weight_ih_{suffix}", (self.GATES * hidden_size, in_sz)),
+                    (f"weight_hh_{suffix}", (self.GATES * hidden_size, hidden_size)),
+                    (f"bias_ih_{suffix}", (self.GATES * hidden_size,)),
+                    (f"bias_hh_{suffix}", (self.GATES * hidden_size,)),
+                ]:
+                    p = self.create_parameter(shape, default_initializer=init)
+                    self.add_parameter(pname, p)
+                    self._param_names.append(pname)
+
+    def _cell(self, x, h, c, wih, whh, bih, bhh):
+        if self.MODE == "LSTM":
+            z = x @ wih.T + h @ whh.T + bih + bhh
+            i, f, g, o = jnp.split(z, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f * c + i * g
+            return o * jnp.tanh(c_new), c_new
+        if self.MODE == "GRU":
+            zi = x @ wih.T + bih
+            zh = h @ whh.T + bhh
+            ri, ui, ci = jnp.split(zi, 3, axis=-1)
+            rh, uh, ch = jnp.split(zh, 3, axis=-1)
+            r = jax.nn.sigmoid(ri + rh)
+            u = jax.nn.sigmoid(ui + uh)
+            cand = jnp.tanh(ci + r * ch)
+            return (1 - u) * cand + u * h, c
+        z = x @ wih.T + h @ whh.T + bih + bhh
+        h_new = jnp.tanh(z) if self.MODE == "RNN_TANH" else jax.nn.relu(z)
+        return h_new, c
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        params = [getattr(self, n) for n in self._param_names]
+        nl, nd, H = self.num_layers, self.num_directions, self.hidden_size
+        is_lstm = self.MODE == "LSTM"
+        time_major = self.time_major
+
+        def fn(x, *ps):
+            if not time_major:
+                x = jnp.swapaxes(x, 0, 1)  # → [T, B, C]
+            T, B = x.shape[0], x.shape[1]
+            h_all, c_all = [], []
+            out = x
+            pi = 0
+            for li in range(nl):
+                dir_outs = []
+                for d in range(nd):
+                    wih, whh, bih, bhh = ps[pi:pi + 4]
+                    pi += 4
+                    h0 = jnp.zeros((B, H), x.dtype)
+                    c0 = jnp.zeros((B, H), x.dtype)
+                    seq = jnp.flip(out, axis=0) if d == 1 else out
+
+                    def step(carry, xt):
+                        h, c = carry
+                        h2, c2 = self._cell(xt, h, c, wih, whh, bih, bhh)
+                        return (h2, c2), h2
+
+                    (hT, cT), ys = jax.lax.scan(step, (h0, c0), seq)
+                    if d == 1:
+                        ys = jnp.flip(ys, axis=0)
+                    dir_outs.append(ys)
+                    h_all.append(hT)
+                    c_all.append(cT)
+                out = jnp.concatenate(dir_outs, axis=-1) if nd == 2 else dir_outs[0]
+            y = out if time_major else jnp.swapaxes(out, 0, 1)
+            hs = jnp.stack(h_all, axis=0)
+            if is_lstm:
+                return y, hs, jnp.stack(c_all, axis=0)
+            return y, hs
+
+        res = dispatch(fn, inputs, *params, op_name=self.MODE.lower())
+        if is_lstm:
+            y, h, c = res
+            return y, (h, c)
+        y, h = res
+        return y, h
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN_TANH"
+    GATES = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kw):
+        self.MODE = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(input_size, hidden_size, num_layers, direction, time_major,
+                         dropout, **kw)
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+    GATES = 4
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"
+    GATES = 3
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ... import tensor_api as P
+
+        states_fw, states_bw = (initial_states or (None, None))
+        y_fw, s_fw = self.rnn_fw(inputs, states_fw)
+        y_bw, s_bw = self.rnn_bw(inputs, states_bw)
+        return P.concat([y_fw, y_bw], axis=-1), (s_fw, s_bw)

@@ -1,0 +1,342 @@
+"""nn.Layer — module base class.
+
+Reference: python/paddle/fluid/dygraph/layers.py:81 Layer (parameters,
+sublayers, hooks, state_dict, train/eval, create_parameter).  TPU-first
+additions: every Layer can flatten its parameters into a pytree
+(``raw_state``) and run functionally (``functional_call`` in jit.py), which is
+what lets one Layer definition serve both the eager tape and jitted/pjit
+training steps.  Parameters carry an optional PartitionSpec used by the
+distributed layer (GSPMD sharding instead of the reference's per-op
+collectives).
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import OrderedDict
+from typing import Callable, Iterator
+
+import numpy as np
+
+from ..core.autograd import no_grad
+from ..core.dtype import convert_dtype, get_default_dtype
+from ..core.tensor import Parameter, Tensor
+from . import initializer as I
+
+
+class Layer:
+    def __init__(self, name_scope: str | None = None, dtype=None):
+        object.__setattr__(self, "_parameters", OrderedDict())
+        object.__setattr__(self, "_sub_layers", OrderedDict())
+        object.__setattr__(self, "_buffers", OrderedDict())
+        object.__setattr__(self, "_non_persistable_buffer_names", set())
+        self.training = True
+        self._dtype = convert_dtype(dtype) or get_default_dtype()
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+        self._forward_pre_hooks: OrderedDict[int, Callable] = OrderedDict()
+        self._forward_post_hooks: OrderedDict[int, Callable] = OrderedDict()
+        self._hook_id = 0
+
+    # -- attribute routing --------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        bufs = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning parameters")
+            params[name] = value
+            layers.pop(name, None) if layers else None
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__ before assigning sublayers")
+            layers[name] = value
+            params.pop(name, None) if params else None
+        else:
+            if params and name in params:
+                if value is None:
+                    del params[name]
+                elif isinstance(value, Tensor):
+                    params[name] = value
+                    return
+            if bufs is not None and name in bufs:
+                bufs[name] = value
+                return
+            object.__setattr__(self, name, value)
+            return
+        object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"{type(self).__name__!r} object has no attribute {name!r}")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+        if name in self.__dict__:
+            object.__delattr__(self, name)
+
+    # -- construction helpers ----------------------------------------------
+    def create_parameter(
+        self,
+        shape,
+        dtype=None,
+        attr=None,
+        is_bias=False,
+        default_initializer=None,
+    ) -> Parameter:
+        """reference layers.py create_parameter: honours ParamAttr-ish dicts."""
+        init = default_initializer
+        name = None
+        trainable = True
+        if attr is not None and attr is not False:
+            if isinstance(attr, dict):
+                init = attr.get("initializer", init)
+                name = attr.get("name")
+                trainable = attr.get("trainable", True)
+            elif isinstance(attr, I.Initializer):
+                init = attr
+            elif hasattr(attr, "initializer"):  # ParamAttr object
+                init = attr.initializer or init
+                name = getattr(attr, "name", None)
+                trainable = getattr(attr, "trainable", True)
+        if init is None:
+            init = I.Constant(0.0) if is_bias else I.XavierNormal()
+        d = convert_dtype(dtype) or self._dtype
+        value = init(shape, d)
+        p = Parameter(value, name=name, trainable=trainable)
+        return p
+
+    def add_parameter(self, name: str, parameter: Parameter | None):
+        if parameter is None:
+            self._parameters[name] = None
+        else:
+            setattr(self, name, parameter)
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        setattr(self, name, sublayer)
+        return sublayer
+
+    def register_buffer(self, name: str, tensor: Tensor | None, persistable: bool = True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    # -- traversal ----------------------------------------------------------
+    def parameters(self, include_sublayers: bool = True) -> list:
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix: str = "", include_sublayers: bool = True):
+        seen = set()
+        for name, layer in self._traverse(prefix, include_sublayers):
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (f"{name}.{pname}" if name else pname), p
+
+    def buffers(self, include_sublayers: bool = True) -> list:
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix: str = "", include_sublayers: bool = True):
+        seen = set()
+        for name, layer in self._traverse(prefix, include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (f"{name}.{bname}" if name else bname), b
+
+    def _traverse(self, prefix: str = "", include_sublayers: bool = True):
+        yield prefix, self
+        if include_sublayers:
+            for lname, sub in self._sub_layers.items():
+                if sub is None:
+                    continue
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                yield from sub._traverse(sub_prefix, True)
+
+    def children(self) -> Iterator["Layer"]:
+        yield from self._sub_layers.values()
+
+    def named_children(self):
+        yield from self._sub_layers.items()
+
+    def sublayers(self, include_self: bool = False) -> list:
+        out = [l for _, l in self._traverse("", True)]
+        return out if include_self else out[1:]
+
+    def named_sublayers(self, prefix: str = "", include_self: bool = False):
+        it = self._traverse(prefix, True)
+        if not include_self:
+            next(it)
+        yield from it
+
+    def apply(self, fn):
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    # -- mode ---------------------------------------------------------------
+    def train(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = True
+        return self
+
+    def eval(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = False
+        return self
+
+    # -- state dict ---------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True, use_hook=True):
+        dest = OrderedDict() if destination is None else destination
+        for name, p in self.named_parameters(include_sublayers=include_sublayers):
+            dest[name] = p
+        # persistability is owned by the layer that registered the buffer
+        seen = set()
+        for prefix, layer in self._traverse("", include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                if bname in layer._non_persistable_buffer_names:
+                    continue
+                dest[f"{prefix}.{bname}" if prefix else bname] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, t in own.items():
+            if name not in state_dict:
+                missing.append(name)
+                continue
+            src = state_dict[name]
+            arr = src.numpy() if isinstance(src, Tensor) else np.asarray(src)
+            if list(arr.shape) != list(t.shape):
+                raise ValueError(
+                    f"shape mismatch for {name}: checkpoint {arr.shape} vs model {t.shape}"
+                )
+            import jax.numpy as jnp
+
+            t._value = jnp.asarray(arr, t._value.dtype)
+        for k in state_dict:
+            if k not in own:
+                unexpected.append(k)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+    set_dict = set_state_dict
+
+    # -- hooks --------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        self._hook_id += 1
+        hid = self._hook_id
+        self._forward_pre_hooks[hid] = hook
+        return _HookRemover(self._forward_pre_hooks, hid)
+
+    def register_forward_post_hook(self, hook):
+        self._hook_id += 1
+        hid = self._hook_id
+        self._forward_post_hooks[hid] = hook
+        return _HookRemover(self._forward_post_hooks, hid)
+
+    # -- call ---------------------------------------------------------------
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            res = hook(self, args)
+            if res is not None:
+                args = res if isinstance(res, tuple) else (res,)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            res = hook(self, args, out)
+            if res is not None:
+                out = res
+        return out
+
+    # -- dtype / device movement -------------------------------------------
+    def to(self, device=None, dtype=None):
+        import jax
+
+        d = convert_dtype(dtype)
+        with no_grad():
+            for _, p in list(self.named_parameters()) + list(self.named_buffers()):
+                v = p._value
+                if d is not None and _is_float_dtype(v.dtype):
+                    v = v.astype(d)
+                if device is not None:
+                    from ..core import place as _p
+
+                    if isinstance(device, str):
+                        ty, _, ix = device.partition(":")
+                        dev = _p._find_device(ty, int(ix or 0))
+                    else:
+                        dev = device.jax_device
+                    v = jax.device_put(v, dev)
+                p._value = v
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def full_name(self):
+        return self._name_scope
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split("\n")
+            sub_repr = [sub_repr[0]] + ["  " + l for l in sub_repr[1:]]
+            lines.append(f"  ({name}): " + "\n".join(sub_repr))
+        main = f"{type(self).__name__}({extra}"
+        if lines:
+            return main + "\n" + "\n".join(lines) + "\n)"
+        return main + ")"
+
+
+def _is_float_dtype(dt) -> bool:
+    import numpy as _np
+
+    return _np.issubdtype(_np.dtype(dt), _np.floating) or str(dt) == "bfloat16"
+
+
+class _HookRemover:
+    def __init__(self, store, hid):
+        self._store, self._hid = store, hid
+
+    def remove(self):
+        self._store.pop(self._hid, None)
+
+
+class ParamAttr:
+    """reference python/paddle/fluid/param_attr.py ParamAttr."""
+
+    def __init__(
+        self,
+        name=None,
+        initializer=None,
+        learning_rate=1.0,
+        regularizer=None,
+        trainable=True,
+        need_clip=True,
+    ):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip

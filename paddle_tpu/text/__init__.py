@@ -1,0 +1,3 @@
+"""paddle_tpu.text — language models (flagship GPT family) + datasets."""
+from . import gpt  # noqa: F401
+from .gpt import GPTConfig, gpt_1p3b, gpt_13b  # noqa: F401

@@ -1,0 +1,72 @@
+"""Vision datasets (reference python/paddle/vision/datasets/mnist.py etc.).
+
+Zero-egress environment: datasets load from a local path when present,
+otherwise fall back to a deterministic synthetic set with the same shapes —
+enough for the smoke/benchmark ladder (BASELINE config 1).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ..io import Dataset
+
+
+class MNIST(Dataset):
+    def __init__(self, image_path=None, label_path=None, mode="train", transform=None,
+                 download=False, backend=None):
+        self.mode = mode
+        self.transform = transform
+        images, labels = None, None
+        if image_path and os.path.exists(image_path):
+            with gzip.open(image_path, "rb") as f:
+                magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+                images = np.frombuffer(f.read(), dtype=np.uint8).reshape(n, rows, cols)
+            with gzip.open(label_path, "rb") as f:
+                f.read(8)
+                labels = np.frombuffer(f.read(), dtype=np.uint8)
+        if images is None:
+            # deterministic synthetic MNIST: class-dependent patterns + noise
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            n = 8192 if mode == "train" else 1024
+            labels = rng.randint(0, 10, n).astype(np.int64)
+            images = np.zeros((n, 28, 28), np.float32)
+            for c in range(10):
+                idx = labels == c
+                base = np.zeros((28, 28), np.float32)
+                base[2 + 2 * c: 6 + 2 * c, 4:24] = 1.0
+                base[10:18, 2 + c: 6 + c] = 0.5
+                images[idx] = base[None]
+            images = images + 0.1 * rng.randn(n, 28, 28).astype(np.float32)
+            images = (images * 127 + 128).clip(0, 255).astype(np.uint8)
+        self.images = images
+        self.labels = labels.astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 255.0
+        img = (img - 0.1307) / 0.3081
+        img = img[None]  # 1x28x28
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FakeImageDataset(Dataset):
+    """Synthetic ImageNet-like data for throughput benchmarking."""
+
+    def __init__(self, n=1024, shape=(3, 224, 224), num_classes=1000, seed=0):
+        rng = np.random.RandomState(seed)
+        self.images = rng.randn(n, *shape).astype(np.float32)
+        self.labels = rng.randint(0, num_classes, n).astype(np.int64)
+
+    def __getitem__(self, idx):
+        return self.images[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)

@@ -1,0 +1,122 @@
+"""MobileNetV1/V2 (reference python/paddle/vision/models/mobilenetv{1,2}.py)."""
+from ... import nn
+
+
+class ConvBNReLU(nn.Sequential):
+    def __init__(self, in_c, out_c, kernel=3, stride=1, groups=1):
+        super().__init__(
+            nn.Conv2D(in_c, out_c, kernel, stride, padding=(kernel - 1) // 2,
+                      groups=groups, bias_attr=False),
+            nn.BatchNorm2D(out_c),
+            nn.ReLU6(),
+        )
+
+
+class DepthwiseSeparable(nn.Layer):
+    def __init__(self, in_c, out_c1, out_c2, num_groups, stride, scale):
+        super().__init__()
+        self.dw = ConvBNReLU(int(in_c * scale), int(out_c1 * scale), 3, stride,
+                             groups=int(num_groups * scale))
+        self.pw = ConvBNReLU(int(out_c1 * scale), int(out_c2 * scale), 1, 1)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.scale = scale
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = ConvBNReLU(3, int(32 * scale), 3, 2)
+        cfg = [
+            (32, 32, 64, 32, 1), (64, 64, 128, 64, 2), (128, 128, 128, 128, 1),
+            (128, 128, 256, 128, 2), (256, 256, 256, 256, 1), (256, 256, 512, 256, 2),
+            (512, 512, 512, 512, 1), (512, 512, 512, 512, 1), (512, 512, 512, 512, 1),
+            (512, 512, 512, 512, 1), (512, 512, 512, 512, 1), (512, 512, 1024, 512, 2),
+            (1024, 1024, 1024, 1024, 1),
+        ]
+        blocks = [DepthwiseSeparable(i, o1, o2, g, s, scale) for i, o1, o2, g, s in cfg]
+        self.blocks = nn.Sequential(*blocks)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(int(1024 * scale), num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.conv1(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ... import tensor_api as P
+
+            x = P.flatten(x, 1)
+            x = self.fc(x)
+        return x
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, inp, oup, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(inp * expand_ratio))
+        self.use_res = stride == 1 and inp == oup
+        layers = []
+        if expand_ratio != 1:
+            layers.append(ConvBNReLU(inp, hidden, 1))
+        layers += [
+            ConvBNReLU(hidden, hidden, 3, stride, groups=hidden),
+            nn.Conv2D(hidden, oup, 1, bias_attr=False),
+            nn.BatchNorm2D(oup),
+        ]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        input_channel = int(32 * scale)
+        cfg = [
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1),
+        ]
+        features = [ConvBNReLU(3, input_channel, 3, 2)]
+        for t, c, n, s in cfg:
+            out_c = int(c * scale)
+            for i in range(n):
+                features.append(InvertedResidual(input_channel, out_c, s if i == 0 else 1, t))
+                input_channel = out_c
+        self.last_channel = int(1280 * max(1.0, scale))
+        features.append(ConvBNReLU(input_channel, self.last_channel, 1))
+        self.features = nn.Sequential(*features)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Dropout(0.2), nn.Linear(self.last_channel, num_classes)
+            )
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ... import tensor_api as P
+
+            x = P.flatten(x, 1)
+            x = self.classifier(x)
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV2(scale=scale, **kwargs)

@@ -1,0 +1,20 @@
+"""Test config: force CPU with 8 virtual devices so distributed (mesh) tests
+run without TPU hardware (reference test_dist_base.py spawns localhost
+multi-process clusters; the TPU-native analog is a virtual device mesh).
+
+Note: the environment may pre-import jax with JAX_PLATFORMS pointing at the
+TPU tunnel, so overriding os.environ here is not enough — we must update the
+live jax config before any backend initializes.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu", "tests must run on CPU"
+assert len(jax.devices()) >= 8, "need 8 virtual CPU devices for mesh tests"

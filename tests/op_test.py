@@ -1,0 +1,123 @@
+"""OpTest harness — the reference's single most important test asset
+(python/paddle/fluid/tests/unittests/op_test.py:270) re-designed for JAX:
+
+- check_output: run the framework op and compare against a numpy reference.
+- check_grad: compare tape-autograd gradients against numeric finite
+  differences (reference get_numeric_gradient, op_test.py:110).
+- check_jit_consistency: the same op must produce identical values when the
+  call is traced under jax.jit (dygraph/static duality check — the reference
+  runs every OpTest in both executors).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+def numeric_grad(fn, args, wrt: int, eps=1e-3):
+    """Central finite differences of scalar fn(*args) w.r.t. args[wrt]."""
+    base = [np.array(a, dtype=np.float64) for a in args]
+    g = np.zeros_like(base[wrt])
+    it = np.nditer(base[wrt], flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        orig = base[wrt][idx]
+        base[wrt][idx] = orig + eps
+        f_hi = float(fn(*[b.astype(np.float32) for b in base]))
+        base[wrt][idx] = orig - eps
+        f_lo = float(fn(*[b.astype(np.float32) for b in base]))
+        base[wrt][idx] = orig
+        g[idx] = (f_hi - f_lo) / (2 * eps)
+        it.iternext()
+    return g
+
+
+class OpTest:
+    """Subclass and set: op (callable), inputs (dict name→np array),
+    attrs (dict), ref (numpy reference callable)."""
+
+    op = None
+    attrs: dict = {}
+    rtol = 1e-5
+    atol = 1e-6
+    max_relative_error = 0.02
+
+    def make_inputs(self):
+        raise NotImplementedError
+
+    def ref(self, *arrays):
+        raise NotImplementedError
+
+    def _run_op(self, *tensors):
+        return type(self).op(*tensors, **self.attrs)
+
+    def check_output(self):
+        arrays = self.make_inputs()
+        tensors = [paddle.to_tensor(a) for a in arrays]
+        out = self._run_op(*tensors)
+        expected = self.ref(*arrays)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        exps = expected if isinstance(expected, (tuple, list)) else [expected]
+        for o, e in zip(outs, exps):
+            np.testing.assert_allclose(
+                np.asarray(o.value, dtype=np.float64) if hasattr(o, "value") else np.asarray(o),
+                np.asarray(e, dtype=np.float64),
+                rtol=self.rtol, atol=self.atol,
+            )
+
+    def check_grad(self, wrt=(0,), reduce="sum"):
+        arrays = self.make_inputs()
+
+        # random fixed cotangent: a plain sum() can have identically-zero
+        # gradient (e.g. softmax), hiding real errors under the noise floor
+        probe = self._run_op(*[paddle.to_tensor(a) for a in arrays])
+        if isinstance(probe, (tuple, list)):
+            probe = probe[0]
+        cot = np.random.RandomState(0).randn(*probe.shape).astype(np.float32)
+
+        def scalar_fn(*arrs):
+            ts = [paddle.to_tensor(a) for a in arrs]
+            out = self._run_op(*ts)
+            if isinstance(out, (tuple, list)):
+                out = out[0]
+            return float(paddle.sum(out * paddle.to_tensor(cot)).numpy())
+
+        tensors = [paddle.to_tensor(a, stop_gradient=False) for a in arrays]
+        out = self._run_op(*tensors)
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        loss = paddle.sum(out * paddle.to_tensor(cot))
+        loss.backward()
+        for i in wrt:
+            assert tensors[i].grad is not None, f"no grad for input {i}"
+            analytic = np.asarray(tensors[i].grad.value, dtype=np.float64)
+            numeric = numeric_grad(scalar_fn, arrays, i)
+            # reference op_test.py compares via max-relative-error against the
+            # numeric scale (fp32 finite differences are noisy in absolute terms)
+            scale = max(float(np.abs(numeric).max()), 1e-2)
+            err = float(np.abs(analytic - numeric).max()) / scale
+            assert err < self.max_relative_error, (
+                f"grad mismatch for input {i} of {type(self).__name__}: "
+                f"max rel err {err:.4f}\nanalytic={analytic}\nnumeric={numeric}"
+            )
+
+    def check_jit_consistency(self):
+        import jax
+
+        arrays = self.make_inputs()
+
+        def pure(*arrs):
+            ts = [Tensor(a, stop_gradient=True) for a in arrs]
+            out = self._run_op(*ts)
+            if isinstance(out, (tuple, list)):
+                return tuple(o.value for o in out)
+            return out.value
+
+        eager = pure(*[paddle.to_tensor(a).value for a in arrays])
+        jitted = jax.jit(pure)(*[paddle.to_tensor(a).value for a in arrays])
+        e_list = eager if isinstance(eager, tuple) else (eager,)
+        j_list = jitted if isinstance(jitted, tuple) else (jitted,)
+        for e, j in zip(e_list, j_list):
+            np.testing.assert_allclose(np.asarray(e), np.asarray(j), rtol=1e-6, atol=1e-6)

@@ -1,0 +1,165 @@
+"""Layer system + layers tests (reference test_layers.py, test_imperative_*)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+rng = np.random.RandomState(7)
+
+
+def test_layer_params_and_state_dict():
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    names = [n for n, _ in net.named_parameters()]
+    assert names == ["0.weight", "0.bias", "2.weight", "2.bias"]
+    sd = net.state_dict()
+    assert set(sd) == set(names)
+    net2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    net2.set_state_dict({k: v.numpy() for k, v in sd.items()})
+    for (_, a), (_, b) in zip(net.named_parameters(), net2.named_parameters()):
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+
+
+def test_train_eval_mode_dropout():
+    d = nn.Dropout(0.5)
+    x = paddle.ones((100, 100))
+    d.train()
+    y = d(x)
+    assert float(paddle.mean((y == 0).astype("float32")).numpy()) > 0.2
+    d.eval()
+    y2 = d(x)
+    np.testing.assert_array_equal(y2.numpy(), x.numpy())
+
+
+def test_batchnorm_running_stats():
+    bn = nn.BatchNorm2D(3)
+    x = paddle.to_tensor(rng.randn(4, 3, 5, 5).astype(np.float32) * 2 + 1)
+    bn.train()
+    _ = bn(x)
+    m = bn._buffers["_mean"].numpy()
+    assert np.abs(m).sum() > 0  # stats moved off init
+    bn.eval()
+    y = bn(x)
+    assert y.shape == [4, 3, 5, 5]
+
+
+def test_conv_pool_shapes():
+    conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+    x = paddle.randn((2, 3, 16, 16))
+    y = conv(x)
+    assert y.shape == [2, 8, 8, 8]
+    pool = nn.MaxPool2D(2, 2)
+    assert pool(y).shape == [2, 8, 4, 4]
+    ap = nn.AdaptiveAvgPool2D((1, 1))
+    assert ap(y).shape == [2, 8, 1, 1]
+
+
+def test_conv_transpose_shape():
+    ct = nn.Conv2DTranspose(4, 6, 3, stride=2, padding=1, output_padding=1)
+    x = paddle.randn((2, 4, 8, 8))
+    assert ct(x).shape == [2, 6, 16, 16]
+
+
+def test_embedding_layer():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    idx = paddle.to_tensor(np.array([[0, 1, 2]], np.int32))
+    out = emb(idx)
+    assert out.shape == [1, 3, 4]
+    np.testing.assert_array_equal(out.numpy()[0, 0], np.zeros(4, np.float32))
+
+
+def test_multihead_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.randn((2, 5, 16))
+    y = mha(x)
+    assert y.shape == [2, 5, 16]
+    # causal mask
+    mask = paddle.to_tensor(np.tril(np.ones((5, 5))).astype(bool))
+    y2 = mha(x, attn_mask=mask)
+    assert y2.shape == [2, 5, 16]
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(d_model=16, nhead=4, dim_feedforward=32)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = paddle.randn((2, 6, 16))
+    y = enc(x)
+    assert y.shape == [2, 6, 16]
+    # distinct copies: layers must not share parameters
+    p0 = enc.layers[0].linear1.weight
+    p1 = enc.layers[1].linear1.weight
+    assert p0 is not p1
+
+
+def test_full_transformer():
+    model = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=2,
+                           num_decoder_layers=2, dim_feedforward=32)
+    src = paddle.randn((2, 5, 16))
+    tgt = paddle.randn((2, 4, 16))
+    out = model(src, tgt)
+    assert out.shape == [2, 4, 16]
+
+
+def test_lstm_gru():
+    lstm = nn.LSTM(8, 16, num_layers=2)
+    x = paddle.randn((4, 10, 8))
+    y, (h, c) = lstm(x)
+    assert y.shape == [4, 10, 16]
+    assert h.shape == [2, 4, 16]
+    gru = nn.GRU(8, 16, direction="bidirectional")
+    y2, h2 = gru(x)
+    assert y2.shape == [4, 10, 32]
+
+
+def test_rnn_grad_flows():
+    lstm = nn.LSTM(4, 8)
+    x = paddle.randn((2, 5, 4))
+    x.stop_gradient = False
+    y, _ = lstm(x)
+    paddle.mean(y).backward()
+    assert x.grad is not None
+    assert lstm.weight_ih_l0.grad is not None
+
+
+def test_layer_norm_group_norm():
+    ln = nn.LayerNorm(8)
+    x = paddle.randn((2, 4, 8))
+    y = ln(x)
+    out = y.numpy()
+    np.testing.assert_allclose(out.mean(-1), 0, atol=1e-5)
+    gn = nn.GroupNorm(2, 8)
+    xi = paddle.randn((2, 8, 4, 4))
+    assert gn(xi).shape == [2, 8, 4, 4]
+
+
+def test_forward_hooks():
+    lin = nn.Linear(3, 3)
+    calls = []
+    h = lin.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+    lin(paddle.randn((2, 3)))
+    assert calls == [1]
+    h.remove()
+    lin(paddle.randn((2, 3)))
+    assert calls == [1]
+
+
+def test_clip_grad_by_global_norm():
+    p1 = paddle.to_tensor(np.ones(4, np.float32), stop_gradient=False)
+    p2 = paddle.to_tensor(np.ones(4, np.float32), stop_gradient=False)
+    loss = paddle.sum(p1 * 10) + paddle.sum(p2 * 10)
+    loss.backward()
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    out = clip([(p1, p1.grad), (p2, p2.grad)])
+    total = sum((np.asarray(g.value) ** 2).sum() for _, g in out)
+    np.testing.assert_allclose(np.sqrt(total), 1.0, rtol=1e-5)
+
+
+def test_sequential_containers():
+    s = nn.Sequential(nn.Linear(2, 2), nn.Linear(2, 2))
+    assert len(s) == 2
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4
+    ld = nn.LayerDict({"a": nn.Linear(2, 2)})
+    assert "a" in ld

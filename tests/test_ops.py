@@ -1,0 +1,274 @@
+"""Op correctness via the OpTest harness (reference op unit tests, e.g.
+test_matmul_v2_op.py, test_softmax_op.py, test_elementwise_add_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from op_test import OpTest
+
+rng = np.random.RandomState(42)
+
+
+class TestMatmul(OpTest):
+    op = staticmethod(paddle.matmul)
+
+    def make_inputs(self):
+        return [rng.randn(4, 6).astype(np.float32), rng.randn(6, 5).astype(np.float32)]
+
+    def ref(self, a, b):
+        return a @ b
+
+    def test_all(self):
+        self.check_output()
+        self.check_grad(wrt=(0, 1))
+        self.check_jit_consistency()
+
+
+class TestMatmulTranspose(OpTest):
+    op = staticmethod(paddle.matmul)
+    attrs = {"transpose_y": True}
+
+    def make_inputs(self):
+        return [rng.randn(4, 6).astype(np.float32), rng.randn(5, 6).astype(np.float32)]
+
+    def ref(self, a, b):
+        return a @ b.T
+
+    def test_all(self):
+        self.check_output()
+        self.check_grad(wrt=(0, 1))
+
+
+class TestSoftmax(OpTest):
+    op = staticmethod(F.softmax)
+
+    def make_inputs(self):
+        return [rng.randn(3, 7).astype(np.float32)]
+
+    def ref(self, x):
+        e = np.exp(x - x.max(-1, keepdims=True))
+        return e / e.sum(-1, keepdims=True)
+
+    def test_all(self):
+        self.check_output()
+        self.check_grad()
+        self.check_jit_consistency()
+
+
+class TestAdd(OpTest):
+    op = staticmethod(paddle.add)
+
+    def make_inputs(self):
+        return [rng.randn(4, 5).astype(np.float32), rng.randn(5).astype(np.float32)]
+
+    def ref(self, a, b):
+        return a + b
+
+    def test_all(self):
+        self.check_output()
+        self.check_grad(wrt=(0, 1))
+
+
+class TestMeanReduce(OpTest):
+    op = staticmethod(paddle.mean)
+    attrs = {"axis": 1, "keepdim": False}
+
+    def make_inputs(self):
+        return [rng.randn(3, 4, 5).astype(np.float32)]
+
+    def ref(self, x):
+        return x.mean(axis=1)
+
+    def test_all(self):
+        self.check_output()
+        self.check_grad()
+
+
+class TestLayerNorm(OpTest):
+    op = staticmethod(lambda x, w, b: F.layer_norm(x, 8, w, b))
+    atol = 1e-5
+
+    def make_inputs(self):
+        return [rng.randn(4, 8).astype(np.float32),
+                rng.rand(8).astype(np.float32) + 0.5,
+                rng.randn(8).astype(np.float32)]
+
+    def ref(self, x, w, b):
+        m = x.mean(-1, keepdims=True)
+        v = x.var(-1, keepdims=True)
+        return (x - m) / np.sqrt(v + 1e-5) * w + b
+
+    def test_all(self):
+        self.check_output()
+        self.check_grad(wrt=(0, 1, 2))
+
+
+class TestGelu(OpTest):
+    op = staticmethod(F.gelu)
+    atol = 1e-5
+
+    def make_inputs(self):
+        return [rng.randn(3, 4).astype(np.float32)]
+
+    def ref(self, x):
+        from scipy.stats import norm  # noqa
+
+        return x * norm.cdf(x)
+
+    def test_all(self):
+        try:
+            import scipy  # noqa
+        except ImportError:
+            pytest.skip("scipy unavailable")
+        self.check_output()
+        self.check_grad()
+
+
+class TestConv2D(OpTest):
+    op = staticmethod(F.conv2d)
+    attrs = {"stride": 1, "padding": 1}
+    atol = 1e-4
+    rtol = 1e-4
+
+    def make_inputs(self):
+        return [rng.randn(2, 3, 8, 8).astype(np.float32),
+                rng.randn(4, 3, 3, 3).astype(np.float32)]
+
+    def ref(self, x, w):
+        # direct conv reference
+        xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+        n, c, h, w_ = x.shape
+        oc = w.shape[0]
+        out = np.zeros((n, oc, h, w_), np.float64)
+        for i in range(3):
+            for j in range(3):
+                patch = xp[:, :, i:i + h, j:j + w_]
+                out += np.einsum("nchw,oc->nohw", patch, w[:, :, i, j])
+        return out
+
+    def test_all(self):
+        self.check_output()
+        self.check_grad(wrt=(0, 1))
+
+
+class TestEmbedding(OpTest):
+    op = staticmethod(lambda w, ids=None: F.embedding(ids, w))
+
+    def make_inputs(self):
+        return [rng.randn(10, 4).astype(np.float32)]
+
+    def setup_ids(self):
+        return paddle.to_tensor(np.array([1, 3, 5, 1], np.int32))
+
+    def test_output_and_grad(self):
+        w_arr = self.make_inputs()[0]
+        ids = np.array([1, 3, 5, 1], np.int32)
+        w = paddle.to_tensor(w_arr, stop_gradient=False)
+        out = F.embedding(paddle.to_tensor(ids), w)
+        np.testing.assert_allclose(np.asarray(out.value), w_arr[ids], rtol=1e-6)
+        paddle.sum(out).backward()
+        expected = np.zeros_like(w_arr)
+        for i in ids:
+            expected[i] += 1
+        np.testing.assert_allclose(np.asarray(w.grad.value), expected, rtol=1e-6)
+
+
+class TestCrossEntropy(OpTest):
+    def test_matches_numpy(self):
+        logits = rng.randn(6, 10).astype(np.float32)
+        labels = rng.randint(0, 10, 6)
+        t = paddle.to_tensor(logits, stop_gradient=False)
+        loss = F.cross_entropy(t, paddle.to_tensor(labels.astype(np.int32)))
+        # numpy ref
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        expected = -np.log(p[np.arange(6), labels]).mean()
+        np.testing.assert_allclose(float(loss.numpy()), expected, rtol=1e-5)
+        loss.backward()
+        assert t.grad is not None and t.grad.shape == [6, 10]
+
+    def test_soft_label(self):
+        logits = rng.randn(4, 5).astype(np.float32)
+        soft = np.abs(rng.randn(4, 5).astype(np.float32))
+        soft = soft / soft.sum(-1, keepdims=True)
+        loss = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(soft),
+                               soft_label=True)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        logp = np.log(e / e.sum(-1, keepdims=True))
+        expected = (-soft * logp).sum(-1).mean()
+        np.testing.assert_allclose(float(loss.numpy()), expected, rtol=1e-5)
+
+    def test_ignore_index(self):
+        logits = rng.randn(4, 5).astype(np.float32)
+        labels = np.array([1, -100, 2, -100], np.int32)
+        loss = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                               ignore_index=-100)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        expected = -np.log(p[[0, 2], [1, 2]]).mean()
+        np.testing.assert_allclose(float(loss.numpy()), expected, rtol=1e-5)
+
+
+class TestManipulation:
+    def test_reshape_transpose_concat(self):
+        x = paddle.to_tensor(rng.randn(2, 6).astype(np.float32), stop_gradient=False)
+        y = paddle.reshape(x, (3, 4))
+        z = paddle.transpose(y, (1, 0))
+        w = paddle.concat([z, z], axis=0)
+        assert w.shape == [8, 3]
+        paddle.sum(w * w).backward()
+        assert x.grad.shape == [2, 6]
+
+    def test_split_gather(self):
+        x = paddle.to_tensor(rng.randn(6, 4).astype(np.float32), stop_gradient=False)
+        a, b, c = paddle.split(x, 3, axis=0)
+        assert a.shape == [2, 4]
+        idx = paddle.to_tensor(np.array([0, 1], np.int32))
+        g = paddle.gather(x, idx, axis=0)
+        assert g.shape == [2, 4]
+        (paddle.sum(a) + paddle.sum(g)).backward()
+        assert x.grad is not None
+
+    def test_topk_where(self):
+        x = paddle.to_tensor(np.array([[1., 5., 3.], [2., 0., 4.]], np.float32))
+        vals, idx = paddle.topk(x, 2)
+        np.testing.assert_array_equal(np.asarray(vals.value), [[5., 3.], [4., 2.]])
+        w = paddle.where(x > 2, x, paddle.zeros_like(x))
+        np.testing.assert_array_equal(np.asarray(w.value),
+                                      [[0., 5., 3.], [0., 0., 4.]])
+
+    def test_pad_tile_flip(self):
+        x = paddle.to_tensor(rng.randn(2, 3).astype(np.float32))
+        # full-form spec: (lo0, hi0, lo1, hi1)
+        p = paddle.pad(x, [1, 1, 0, 0])
+        assert p.shape == [4, 3]
+        # partial spec pads trailing dims (reference pad2d semantics)
+        p2 = paddle.pad(x, [1, 1])
+        assert p2.shape == [2, 5]
+        t = paddle.tile(x, (2, 1))
+        assert t.shape == [4, 3]
+        f = paddle.flip(x, axis=0)
+        np.testing.assert_allclose(np.asarray(f.value)[0], np.asarray(x.value)[1])
+
+    def test_setitem_getitem_grad(self):
+        x = paddle.to_tensor(rng.randn(4, 4).astype(np.float32), stop_gradient=False)
+        y = x[1:3, :2]
+        assert y.shape == [2, 2]
+        paddle.sum(y).backward()
+        g = np.asarray(x.grad.value)
+        assert g[1:3, :2].sum() == 4 and g.sum() == 4
+
+
+class TestReductionOps:
+    def test_reductions(self):
+        x = rng.randn(3, 4).astype(np.float32)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(float(paddle.sum(t).numpy()), x.sum(), rtol=1e-5)
+        np.testing.assert_allclose(float(paddle.max(t).numpy()), x.max(), rtol=1e-6)
+        np.testing.assert_allclose(float(paddle.std(t).numpy()), x.std(ddof=1), rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(paddle.logsumexp(t, axis=1).value),
+            np.log(np.exp(x).sum(1)), rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(paddle.cumsum(t, axis=0).value), x.cumsum(0), rtol=1e-5)
