@@ -1,0 +1,101 @@
+"""Megatron-style tensor-parallel primitives with MANUAL collectives.
+
+Reference capability: fleet/meta_parallel/parallel_layers/mp_layers.py —
+``VocabParallelEmbedding`` (:30), ``ColumnParallelLinear`` (:97),
+``RowParallelLinear`` (:170), ``ParallelCrossEntropy`` (:249, backed by the
+``c_softmax_with_cross_entropy`` CUDA op in
+operators/collective/c_softmax_with_cross_entropy_op.cu).
+
+Two worlds use these:
+
+* Under plain ``pjit``/GSPMD, Megatron TP needs NO manual code — annotate the
+  weight PartitionSpecs (text/gpt.py ``param_shardings``) and XLA inserts the
+  identical collectives.  That is the default path.
+* Inside ``shard_map`` regions (the pipeline-parallel schedule, ring
+  attention), collectives are manual — exactly like the reference's c_* ops.
+  These functions are that manual layer: each takes the *local shard* of the
+  weight and the tensor-parallel ``axis`` name (None ⇒ no TP, degenerate
+  single-shard math).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _axis_active(axis) -> bool:
+    return axis is not None
+
+
+def vocab_parallel_embedding(wte_local, tokens, axis: str | None,
+                             vocab_per_shard: int | None = None):
+    """Embedding lookup with the vocab dim sharded over ``axis``.
+
+    Out-of-shard tokens contribute zeros; a psum over ``axis`` assembles the
+    full embedding (reference VocabParallelEmbedding: mask + c_allreduce_sum).
+    """
+    if not _axis_active(axis):
+        return wte_local[tokens]
+    vps = vocab_per_shard if vocab_per_shard is not None else wte_local.shape[0]
+    rank = lax.axis_index(axis)
+    local = tokens - rank * vps
+    ok = (local >= 0) & (local < vps)
+    emb = wte_local[jnp.clip(local, 0, vps - 1)]
+    emb = jnp.where(ok[..., None], emb, jnp.zeros((), emb.dtype))
+    return lax.psum(emb, axis)
+
+
+def column_parallel_linear(x, w_local, b_local=None):
+    """y_local = x @ W[:, shard] (+ b[shard]) — no communication; output's
+    feature dim is sharded (reference ColumnParallelLinear, gather_output=False)."""
+    y = x @ w_local
+    if b_local is not None:
+        y = y + b_local
+    return y
+
+
+def row_parallel_linear(x_local, w_local, b=None, axis: str | None = None):
+    """y = psum_over_axis(x_local @ W[shard, :]) (+ b) — the input's feature
+    dim is sharded; one all-reduce restores the full activation (reference
+    RowParallelLinear: matmul + c_allreduce_sum)."""
+    y = x_local @ w_local
+    if _axis_active(axis):
+        y = lax.psum(y, axis)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def vocab_parallel_logits(x, wte_local):
+    """LM head against the vocab-sharded (tied) embedding: [., D] @ [Vl, D]^T
+    → local logits [., Vl]. Stays sharded; feed to vocab_parallel_softmax_ce."""
+    return x @ wte_local.T
+
+
+def vocab_parallel_softmax_ce(logits_local, targets, axis: str | None,
+                              vocab_per_shard: int | None = None):
+    """Softmax cross-entropy over a vocab-sharded logits tensor.
+
+    The reference's ``c_softmax_with_cross_entropy`` op: global max (pmax),
+    global partition function (psum of exp-sums), target-logit fetch from the
+    owning shard (mask + psum).  Per-token loss, fp32.
+    """
+    lg = logits_local.astype(jnp.float32)
+    if not _axis_active(axis):
+        m = jnp.max(lg, axis=-1, keepdims=True)
+        z = jnp.sum(jnp.exp(lg - m), axis=-1)
+        tl = jnp.take_along_axis(lg, targets[..., None], axis=-1)[..., 0]
+        return jnp.log(z) + m[..., 0] - tl
+    vps = vocab_per_shard if vocab_per_shard is not None else lg.shape[-1]
+    # global max for numerical stability only — gradient-free (pmax has no AD
+    # rule, so gather the per-shard maxes and reduce locally)
+    m_local = lax.stop_gradient(jnp.max(lg, axis=-1))
+    m = jnp.max(lax.all_gather(m_local, axis), axis=0)
+    z = lax.psum(jnp.sum(jnp.exp(lg - m[..., None]), axis=-1), axis)
+    rank = lax.axis_index(axis)
+    local = targets - rank * vps
+    ok = (local >= 0) & (local < vps)
+    tl = jnp.take_along_axis(lg, jnp.clip(local, 0, vps - 1)[..., None], axis=-1)[..., 0]
+    tl = lax.psum(jnp.where(ok, tl, 0.0), axis)
+    return jnp.log(z) + m - tl

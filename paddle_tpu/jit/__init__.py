@@ -219,6 +219,9 @@ class TrainStep:
         self._params, self._buffers, self._opt_state, loss = self._compiled(
             self._params, self._buffers, self._opt_state, key, lr, self._step, *arr
         )
+        # keep the Layer's Parameters pointing at live buffers (the originals
+        # were donated into the jit) so eager eval/checkpointing keeps working
+        self.sync_to_model()
         return Tensor(loss, stop_gradient=True)
 
     def sync_to_model(self):

@@ -1,12 +1,15 @@
-"""Pallas TPU flash attention (forward + backward via custom_vjp).
+"""Pallas TPU flash attention — forward + backward via custom_vjp.
 
-Blockwise online-softmax attention: per (batch, head, q-block) grid cell,
-stream k/v blocks through VMEM keeping running max/denominator, so the
-[T, T] score matrix never hits HBM.  Backward recomputes blockwise scores
-(flash-style) using the saved softmax statistics.
+Blockwise online-softmax attention (FlashAttention-2 style): per
+(batch*head, q-block) grid cell the forward streams k/v blocks through VMEM
+keeping a running max/denominator, so the [T, T] score matrix never hits
+HBM; it also emits the per-row logsumexp.  The backward recomputes blockwise
+scores from q/k and the saved logsumexp — two kernels, one accumulating dq
+over k-blocks, one accumulating dk/dv over q-blocks.
 
 This is the TPU-native replacement for the reference's fused attention CUDA
-kernels (operators/fused/multihead_matmul_op.cu).
+kernels (operators/fused/multihead_matmul_op.cu,
+operators/math/bert_encoder_functor).
 """
 from __future__ import annotations
 
@@ -24,63 +27,111 @@ def _xla(q, k, v, causal, scale):
     return xla_attention(q, k, v, is_causal=causal, scale=scale)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "scale"))
-def flash_attention(q, k, v, causal: bool = False, scale=None):
-    """q,k,v: [B, T, H, D] → [B, T, H, D].  Falls back to XLA attention if the
-    Pallas path is unavailable (non-TPU backend or unsupported shape)."""
+def _shape_supported(q_shape, s_len) -> bool:
+    B, T, H, D = q_shape
+    return T % 128 == 0 and s_len % 128 == 0 and D in (64, 128, 256)
+
+
+def _probe() -> bool:
+    """Eagerly compile+run a tiny fwd+bwd pair once; True = must fall back.
+    Runs OUTSIDE any jit so Mosaic lowering failures are actually caught."""
     global _FALLBACK
     if _FALLBACK is None:
         try:
-            _pallas_flash(jnp.zeros((1, 128, 1, 64), jnp.float32),
-                          jnp.zeros((1, 128, 1, 64), jnp.float32),
-                          jnp.zeros((1, 128, 1, 64), jnp.float32), False, None)
+            z = jax.device_put(jnp.zeros((1, 128, 1, 64), jnp.float32))
+            out, vjp_fn = jax.vjp(lambda a, b, c: _flash(a, b, c, False, None),
+                                  z, z, z)
+            jax.block_until_ready(jax.tree_util.tree_leaves(vjp_fn(out)))
             _FALLBACK = False
         except Exception:
             _FALLBACK = True
-    if _FALLBACK:
+    return _FALLBACK
+
+
+def flash_attention(q, k, v, causal: bool = False, scale=None):
+    """q,k,v: [B, T, H, D] → [B, T, H, D].  Falls back to XLA attention if the
+    Pallas path is unavailable (non-TPU backend or unsupported shape).
+
+    Not jitted itself: the availability probe must execute eagerly (it still
+    works when tracing — the probe runs on its own concrete arrays)."""
+    if not _shape_supported(q.shape, k.shape[1]) or _probe():
         return _xla(q, k, v, causal, scale)
-    return _pallas_flash(q, k, v, causal, scale)
+    return _flash(q, k, v, causal, scale)
 
 
-def _pallas_flash(q, k, v, causal, scale):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, causal, scale):
+    out, _ = _flash_fwd_impl(q, k, v, causal, scale)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, scale):
+    out, lse = _flash_fwd_impl(q, k, v, causal, scale)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, scale, res, do):
+    q, k, v, out, lse = res
+    return _flash_bwd_impl(q, k, v, out, lse, do, causal, scale)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _heads_first(x):
+    B, T, H, D = x.shape
+    return jnp.swapaxes(x, 1, 2).reshape(B * H, T, D)
+
+
+def _heads_last(x, B, H):
+    BH, T, D = x.shape
+    return jnp.swapaxes(x.reshape(B, H, T, D), 1, 2)
+
+
+_NEG = -1e30  # large-negative instead of -inf: keeps lse finite on empty rows
+
+
+def _block_sizes(T, S):
+    BQ = 128 if T % 128 == 0 else T
+    BK = 128 if S % 128 == 0 else S
+    return BQ, BK
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _flash_fwd_impl(q, k, v, causal, scale):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B, T, H, D = q.shape
     S = k.shape[1]
     scale = scale if scale is not None else 1.0 / (D**0.5)
-    BQ = min(128 if T >= 128 else T, 512)
-    BK = min(128 if S >= 128 else S, 512)
-    # layout: move heads next to batch → grid (B*H, T/BQ)
-    qh = jnp.swapaxes(q, 1, 2).reshape(B * H, T, D)
-    kh = jnp.swapaxes(k, 1, 2).reshape(B * H, S, D)
-    vh = jnp.swapaxes(v, 1, 2).reshape(B * H, S, D)
-
+    BQ, BK = _block_sizes(T, S)
+    qh, kh, vh = _heads_first(q), _heads_first(k), _heads_first(v)
     nq, nk = T // BQ, S // BK
 
-    def kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr):
+    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr):
         qi = pl.program_id(1)
         ki = pl.program_id(2)
 
         @pl.when(ki == 0)
         def _init():
-            m_scr[:] = jnp.full_like(m_scr, -jnp.inf)
+            m_scr[:] = jnp.full_like(m_scr, _NEG)
             l_scr[:] = jnp.zeros_like(l_scr)
             acc_scr[:] = jnp.zeros_like(acc_scr)
 
-        run = True
-        if causal:
-            run = (ki * BK) <= (qi * BQ + BQ - 1)
-
         def body():
-            qb = q_ref[0].astype(jnp.float32) * scale
+            qb = q_ref[0].astype(jnp.float32)
             kb = k_ref[0].astype(jnp.float32)
-            s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
-                                    preferred_element_type=jnp.float32)
+            s = scale * jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
             if causal:
                 rows = qi * BQ + jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 0)
                 cols = ki * BK + jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 1)
-                s = jnp.where(rows >= cols, s, -jnp.inf)
+                s = jnp.where(rows >= cols, s, _NEG)
             m_prev = m_scr[:, 0]
             m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
             p = jnp.exp(s - m_cur[:, None])
@@ -101,9 +152,12 @@ def _pallas_flash(q, k, v, causal, scale):
 
         @pl.when(ki == nk - 1)
         def _finish():
-            o_ref[0] = (acc_scr[:] / l_scr[:, 0][:, None]).astype(o_ref.dtype)
+            l = l_scr[:, 0]
+            l_safe = jnp.where(l == 0.0, 1.0, l)
+            o_ref[0] = (acc_scr[:] / l_safe[:, None]).astype(o_ref.dtype)
+            lse_ref[0] = (m_scr[:, 0] + jnp.log(l_safe))[:, None]
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, nq, nk),
         in_specs=[
@@ -111,12 +165,157 @@ def _pallas_flash(q, k, v, causal, scale):
             pl.BlockSpec((1, BK, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, BK, D), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, BQ, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, T, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((BQ, 1), jnp.float32),
             pltpu.VMEM((BQ, 1), jnp.float32),
             pltpu.VMEM((BQ, D), jnp.float32),
         ],
     )(qh, kh, vh)
-    return jnp.swapaxes(out.reshape(B, H, T, D), 1, 2)
+    return _heads_last(out, B, H), lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _flash_bwd_impl(q, k, v, out, lse, do, causal, scale):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, T, H, D = q.shape
+    S = k.shape[1]
+    scale = scale if scale is not None else 1.0 / (D**0.5)
+    BQ, BK = _block_sizes(T, S)
+    nq, nk = T // BQ, S // BK
+    qh, kh, vh = _heads_first(q), _heads_first(k), _heads_first(v)
+    doh = _heads_first(do)
+    # delta_i = sum_d do_i * o_i  (rescaling term of the softmax transpose)
+    delta = jnp.sum(doh.astype(jnp.float32) * _heads_first(out).astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [BH, T, 1]
+
+    def scores(q_ref, k_ref, lse_ref, qi, ki):
+        qb = q_ref[0].astype(jnp.float32)
+        kb = k_ref[0].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+        if causal:
+            rows = qi * BQ + jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 0)
+            cols = ki * BK + jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 1)
+            s = jnp.where(rows >= cols, s, _NEG)
+        return jnp.exp(s - lse_ref[0])  # p, normalized (lse block is [BQ, 1])
+
+    # -- dq: grid (BH, nq, nk), accumulate over k blocks --------------------
+    def dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref, acc):
+        qi, ki = pl.program_id(1), pl.program_id(2)
+
+        @pl.when(ki == 0)
+        def _init():
+            acc[:] = jnp.zeros_like(acc)
+
+        def body():
+            p = scores(q_ref, k_ref, lse_ref, qi, ki)
+            dp = jax.lax.dot_general(
+                do_ref[0].astype(jnp.float32), v_ref[0].astype(jnp.float32),
+                (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+            ds = p * (dp - dl_ref[0])
+            acc[:] += scale * jax.lax.dot_general(
+                ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        if causal:
+            @pl.when((ki * BK) <= (qi * BQ + BQ - 1))
+            def _run():
+                body()
+        else:
+            body()
+
+        @pl.when(ki == nk - 1)
+        def _fin():
+            dq_ref[0] = acc[:].astype(dq_ref.dtype)
+
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(B * H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, BK, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, BK, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, BQ, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, BQ, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((BQ, D), jnp.float32)],
+    )(qh, kh, vh, doh, lse, delta)
+
+    # -- dk/dv: grid (BH, nk, nq), accumulate over q blocks -----------------
+    def dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
+                   dk_ref, dv_ref, dk_acc, dv_acc):
+        ki, qi = pl.program_id(1), pl.program_id(2)
+
+        @pl.when(qi == 0)
+        def _init():
+            dk_acc[:] = jnp.zeros_like(dk_acc)
+            dv_acc[:] = jnp.zeros_like(dv_acc)
+
+        def body():
+            p = scores(q_ref, k_ref, lse_ref, qi, ki)
+            dov = do_ref[0].astype(jnp.float32)
+            dv_acc[:] += jax.lax.dot_general(
+                p, dov, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(
+                dov, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            ds = p * (dp - dl_ref[0])
+            dk_acc[:] += scale * jax.lax.dot_general(
+                ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        if causal:
+            @pl.when((qi * BQ + BQ - 1) >= (ki * BK))
+            def _run():
+                body()
+        else:
+            body()
+
+        @pl.when(qi == nq - 1)
+        def _fin():
+            dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+            dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(B * H, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, BQ, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, BK, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, BK, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, BQ, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, BQ, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, BQ, 1), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, BK, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, BK, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, S, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, S, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((BK, D), jnp.float32),
+            pltpu.VMEM((BK, D), jnp.float32),
+        ],
+    )(qh, kh, vh, doh, lse, delta)
+
+    return (_heads_last(dq, B, H), _heads_last(dk, B, H), _heads_last(dv, B, H))

@@ -80,8 +80,10 @@ def init_params(cfg: GPTConfig, key) -> dict:
             "ln1_b": jnp.zeros((L, D), jnp.float32),
             "ln2_g": jnp.ones((L, D), jnp.float32),
             "ln2_b": jnp.zeros((L, D), jnp.float32),
-            "qkv_w": nrm(blk_keys[0], (L, D, 3 * D)),
-            "qkv_b": jnp.zeros((L, 3 * D), jnp.float32),
+            # qkv stored as separate [3, D, D] mats (not one [D, 3D]) so the
+            # output dim shards cleanly per-projection under tensor parallel
+            "qkv_w": nrm(blk_keys[0], (L, 3, D, D)),
+            "qkv_b": jnp.zeros((L, 3, D), jnp.float32),
             "proj_w": nrm(blk_keys[1], (L, D, D), std=s / math.sqrt(2 * L)),
             "proj_b": jnp.zeros((L, D), jnp.float32),
             "fc_w": nrm(blk_keys[2], (L, D, F)),
@@ -107,8 +109,8 @@ def param_shardings(cfg: GPTConfig, dp="dp", mp="mp", pp=None) -> dict:
             "ln1_b": P(l, None),
             "ln2_g": P(l, None),
             "ln2_b": P(l, None),
-            "qkv_w": P(l, None, mp),   # column parallel
-            "qkv_b": P(l, mp),
+            "qkv_w": P(l, None, None, mp),  # column parallel (per-projection)
+            "qkv_b": P(l, None, mp),
             "proj_w": P(l, mp, None),  # row parallel
             "proj_b": P(l, None),
             "fc_w": P(l, None, mp),    # column parallel
@@ -125,50 +127,78 @@ def _layer_norm(x, g, b, eps=1e-5):
     return (x - m) * jax.lax.rsqrt(v + eps) * g + b
 
 
+def _dropout(x, rate, key):
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, jnp.zeros((), x.dtype))
+
+
 def _block(x, p, cfg: GPTConfig, dropout_key=None):
     """One transformer block on [B, T, D] activations (compute dtype)."""
     B, T, D = x.shape
     H, hd = cfg.num_heads, cfg.head_dim
     dt = cfg.dtype
+    drop = cfg.dropout > 0.0 and dropout_key is not None
     h = _layer_norm(x.astype(jnp.float32), p["ln1_g"], p["ln1_b"]).astype(dt)
-    qkv = h @ p["qkv_w"].astype(dt) + p["qkv_b"].astype(dt)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    q = q.reshape(B, T, H, hd)
-    k = k.reshape(B, T, H, hd)
-    v = v.reshape(B, T, H, hd)
+    qkv = jnp.einsum("btd,kde->kbte", h, p["qkv_w"].astype(dt)) + p["qkv_b"].astype(dt)[:, None, None]
+    q = qkv[0].reshape(B, T, H, hd)
+    k = qkv[1].reshape(B, T, H, hd)
+    v = qkv[2].reshape(B, T, H, hd)
     attn = attention_array(q, k, v, is_causal=True)
     attn = attn.reshape(B, T, D)
-    x = x + (attn @ p["proj_w"].astype(dt) + p["proj_b"].astype(dt))
+    a = attn @ p["proj_w"].astype(dt) + p["proj_b"].astype(dt)
+    if drop:
+        a = _dropout(a, cfg.dropout, jax.random.fold_in(dropout_key, 0))
+    x = x + a
     h = _layer_norm(x.astype(jnp.float32), p["ln2_g"], p["ln2_b"]).astype(dt)
     h = jax.nn.gelu(h @ p["fc_w"].astype(dt) + p["fc_b"].astype(dt))
-    x = x + (h @ p["out_w"].astype(dt) + p["out_b"].astype(dt))
-    return x
+    h = h @ p["out_w"].astype(dt) + p["out_b"].astype(dt)
+    if drop:
+        h = _dropout(h, cfg.dropout, jax.random.fold_in(dropout_key, 1))
+    return x + h
 
 
-def forward(params: dict, tokens, cfg: GPTConfig):
-    """tokens [B, T] int32 → logits [B, T, V] (compute dtype)."""
+def forward(params: dict, tokens, cfg: GPTConfig, act_sharding=None, key=None):
+    """tokens [B, T] int32 → logits [B, T, V] (compute dtype).
+
+    act_sharding: optional NamedSharding constraint applied to the [B, T, D]
+    activations — e.g. P('dp', 'sp', None) for sequence parallelism; XLA
+    propagates it through the blocks and inserts the sp collectives.
+    key: PRNG key enabling dropout (cfg.dropout > 0); None = eval mode."""
     B, T = tokens.shape
     dt = cfg.dtype
     x = params["wte"][tokens].astype(dt) + params["wpe"][:T].astype(dt)[None]
+    if act_sharding is not None:
+        x = jax.lax.with_sharding_constraint(x, act_sharding)
 
     blk = functools.partial(_block, cfg=cfg)
     if cfg.remat:
         blk = jax.checkpoint(blk)
 
-    def scan_body(x, layer_params):
-        return blk(x, layer_params), None
+    if cfg.dropout > 0.0 and key is not None:
+        layer_keys = jax.random.split(key, cfg.num_layers)
 
-    x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+        def scan_body(x, pk):
+            p, k = pk
+            return blk(x, p, dropout_key=k), None
+
+        x, _ = jax.lax.scan(scan_body, x, (params["blocks"], layer_keys))
+    else:
+        def scan_body(x, layer_params):
+            return blk(x, layer_params), None
+
+        x, _ = jax.lax.scan(scan_body, x, params["blocks"])
     x = _layer_norm(x.astype(jnp.float32), params["ln_f_g"], params["ln_f_b"]).astype(dt)
     logits = x @ params["wte"].T.astype(dt)
     return logits
 
 
-def loss_fn(params: dict, tokens, cfg: GPTConfig):
+def loss_fn(params: dict, tokens, cfg: GPTConfig, act_sharding=None, key=None):
     """Next-token LM loss; softmax-CE in fp32 (reference
     c_softmax_with_cross_entropy keeps the reduction sharded — here XLA
     handles the sharded softmax under pjit)."""
-    logits = forward(params, tokens[:, :-1], cfg)
+    logits = forward(params, tokens[:, :-1], cfg, act_sharding=act_sharding,
+                     key=key)
     tgt = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     ll = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]

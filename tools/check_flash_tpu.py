@@ -1,0 +1,37 @@
+"""On-device check: Pallas flash attention fwd+bwd vs XLA reference.
+
+Run on a real TPU (the pytest suite pins itself to CPU where the Pallas path
+is skipped): python tools/check_flash_tpu.py
+"""
+import numpy as np
+import jax, jax.numpy as jnp
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from paddle_tpu.ops import flash_attention as fa
+from paddle_tpu.ops.attention import xla_attention
+
+def check(B, T, H, D, causal, dtype):
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (B, T, H, D), dtype) for kk in ks)
+    out = fa._flash(q, k, v, causal, None)
+    ref = xla_attention(q, k, v, is_causal=causal)
+    # fp32 dots on the TPU MXU use bf16 passes by default, and the two paths
+    # accumulate in different orders — tolerances are bf16-rounding-scale
+    tol = 2e-2 if dtype == jnp.bfloat16 else 4e-3
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=tol, rtol=tol)
+
+    do = jax.random.normal(ks[0], (B, T, H, D), dtype)
+    g = jax.vjp(lambda a, b, c: fa._flash(a, b, c, causal, None), q, k, v)[1](do)
+    gr = jax.vjp(lambda a, b, c: xla_attention(a, b, c, is_causal=causal), q, k, v)[1](do)
+    for name, x, y in zip("dq dk dv".split(), g, gr):
+        np.testing.assert_allclose(np.asarray(x, np.float32), np.asarray(y, np.float32), atol=tol*4, rtol=tol*4,
+                                   err_msg=f"{name} B{B} T{T} H{H} D{D} causal={causal} {dtype}")
+    print(f"  OK B{B} T{T} H{H} D{D} causal={causal} {jnp.dtype(dtype).name}")
+
+if __name__ == "__main__":
+    assert jax.devices()[0].platform in ("tpu", "axon"), jax.devices()
+    for causal in (False, True):
+        check(2, 256, 2, 64, causal, jnp.float32)
+        check(2, 512, 4, 128, causal, jnp.bfloat16)
+        check(1, 1024, 2, 128, causal, jnp.bfloat16)
+    print("flash attention fwd+bwd all OK")
