@@ -1,0 +1,78 @@
+"""Native (C++) runtime pieces, built lazily with the system toolchain.
+
+The reference's native runtime (blocking queues operators/reader/
+lod_tensor_blocking_queue.h, DataFeed framework/data_feed.h, custom-op JIT
+toolchain python/paddle/utils/cpp_extension) compiles at build time with
+CMake; here each .cpp is compiled once on first use into a cached .so next
+to the sources and bound via ctypes — same role as the reference's
+cpp_extension JIT path, no pybind11 dependency.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+_LIBS: dict = {}
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+def _build(name: str) -> str:
+    src = os.path.join(_DIR, name + ".cpp")
+    out = os.path.join(_DIR, "_build", name + ".so")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           src, "-o", out + ".tmp"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True,
+                       timeout=240)
+    except FileNotFoundError as e:
+        raise NativeUnavailable("g++ not found") from e
+    except subprocess.CalledProcessError as e:
+        raise NativeUnavailable(f"compile failed:\n{e.stderr}") from e
+    os.replace(out + ".tmp", out)
+    return out
+
+
+def load(name: str) -> ctypes.CDLL:
+    """Compile (once) and dlopen paddle_tpu/_native/<name>.cpp."""
+    with _LOCK:
+        if name not in _LIBS:
+            _LIBS[name] = ctypes.CDLL(_build(name))
+        return _LIBS[name]
+
+
+def io_runtime() -> ctypes.CDLL:
+    lib = load("io_runtime")
+    if not getattr(lib, "_sigs_set", False):
+        c = ctypes
+        u64, ptr, cstr = c.c_uint64, c.c_void_p, c.c_char_p
+        u8p = c.POINTER(c.c_uint8)
+        lib.ptq_create.restype = ptr
+        lib.ptq_create.argtypes = [u64]
+        lib.ptq_push.restype = c.c_int
+        lib.ptq_push.argtypes = [ptr, u8p, u64]
+        lib.ptq_next_size.restype = u64
+        lib.ptq_next_size.argtypes = [ptr]
+        lib.ptq_pop.restype = u64
+        lib.ptq_pop.argtypes = [ptr, u8p, u64]
+        lib.ptq_size.restype = u64
+        lib.ptq_size.argtypes = [ptr]
+        lib.ptq_close.argtypes = [ptr]
+        lib.ptq_destroy.argtypes = [ptr]
+        lib.ptf_start.restype = ptr
+        lib.ptf_start.argtypes = [ptr, cstr, u64, u64, c.c_int, u64, u64]
+        lib.ptf_records_read.restype = u64
+        lib.ptf_records_read.argtypes = [ptr]
+        lib.ptf_join.argtypes = [ptr]
+        lib.ptf_destroy.argtypes = [ptr]
+        lib._sigs_set = True
+    return lib

@@ -1,0 +1,151 @@
+"""Native-backed data ingestion: BlockingBatchQueue + TokenShardReader.
+
+Reference capability: the C++ ingestion path that keeps Python out of the
+hot loop — LoDTensorBlockingQueue (operators/reader/
+lod_tensor_blocking_queue.h) + InMemoryDataFeed (framework/data_feed.h:305)
++ buffered_reader prefetch (operators/reader/buffered_reader.cc).
+
+Here the C++ side (paddle_tpu/_native/io_runtime.cpp) reads fixed-record
+binary shards with a thread pool, packs batches, and hands them over a
+bounded blocking queue; Python turns each batch into a numpy view and a
+background prefetcher pushes it to the device (PJRT owns the actual
+host→HBM DMA, the buffered_reader role).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import queue
+import threading
+from typing import Iterator, Sequence
+
+import numpy as np
+
+from .._native import NativeUnavailable, io_runtime
+
+
+class BlockingBatchQueue:
+    """Bounded MPMC byte-batch queue backed by the C++ runtime."""
+
+    def __init__(self, capacity: int = 8):
+        self._lib = io_runtime()
+        self._h = self._lib.ptq_create(capacity)
+        # next_size + pop must be one atomic step per consumer (two C calls)
+        self._pop_lock = threading.Lock()
+
+    def push(self, arr: np.ndarray) -> bool:
+        arr = np.ascontiguousarray(arr)
+        p = arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        return bool(self._lib.ptq_push(self._h, p, arr.nbytes))
+
+    def pop(self) -> np.ndarray | None:
+        """Blocking; None when the queue is closed and drained."""
+        with self._pop_lock:
+            n = self._lib.ptq_next_size(self._h)
+            if n == 0:
+                return None
+            out = np.empty(n, np.uint8)
+            got = self._lib.ptq_pop(
+                self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n)
+        if got == 0:
+            return None
+        return out[:got]
+
+    def __len__(self):
+        return int(self._lib.ptq_size(self._h))
+
+    def close(self):
+        self._lib.ptq_close(self._h)
+
+    def __del__(self):
+        try:
+            self._lib.ptq_close(self._h)
+            self._lib.ptq_destroy(self._h)
+        except Exception:
+            pass
+
+
+class TokenShardReader:
+    """Multithreaded reader of fixed-length token-record binary shards.
+
+    Each record is ``seq_len`` tokens of ``dtype`` (default int32) — the
+    standard pretraining shard layout.  Yields [batch, seq_len] arrays.
+    """
+
+    def __init__(self, files: Sequence[str], seq_len: int, batch_size: int,
+                 num_threads: int = 4, dtype=np.int32, capacity: int = 8,
+                 seed: int = 0, shuffle_window: int = 0):
+        self.files = [os.fspath(f) for f in files]
+        self.seq_len = int(seq_len)
+        self.batch_size = int(batch_size)
+        self.dtype = np.dtype(dtype)
+        self._lib = io_runtime()
+        self._q = BlockingBatchQueue(capacity)
+        rec_bytes = self.seq_len * self.dtype.itemsize
+        blob = ("\n".join(self.files)).encode()
+        self._f = self._lib.ptf_start(
+            self._q._h, blob, rec_bytes, self.batch_size, int(num_threads),
+            int(seed), int(shuffle_window))
+
+    @property
+    def records_read(self) -> int:
+        return int(self._lib.ptf_records_read(self._f))
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        while True:
+            raw = self._q.pop()
+            if raw is None:
+                return
+            yield raw.view(self.dtype).reshape(-1, self.seq_len)
+
+    def close(self):
+        self._q.close()
+        self._lib.ptf_join(self._f)
+
+    def __del__(self):
+        try:
+            self.close()
+            self._lib.ptf_destroy(self._f)
+        except Exception:
+            pass
+
+
+class DevicePrefetcher:
+    """Background thread that moves host batches to the device ahead of the
+    consumer (the buffered_reader double-buffer role; PJRT does the DMA)."""
+
+    def __init__(self, it, depth: int = 2, device=None, sharding=None):
+        import jax
+
+        self._out: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._src = iter(it)
+
+        def put(x):
+            if sharding is not None:
+                return jax.device_put(x, sharding)
+            if device is not None:
+                return jax.device_put(x, device)
+            return jax.device_put(x)
+
+        self._err: BaseException | None = None
+
+        def worker():
+            try:
+                for item in self._src:
+                    self._out.put(put(item))
+            except BaseException as e:  # surfaced to the consumer, not stderr
+                self._err = e
+            finally:
+                self._out.put(None)
+
+        self._t = threading.Thread(target=worker, daemon=True)
+        self._t.start()
+
+    def __iter__(self):
+        while True:
+            item = self._out.get()
+            if item is None:
+                if self._err is not None:
+                    raise self._err
+                return
+            yield item

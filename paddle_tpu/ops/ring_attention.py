@@ -1,0 +1,96 @@
+"""Ring attention — context parallelism over a mesh axis.
+
+Capability beyond the reference: xymyeah/Paddle has no sequence/context
+parallelism (`grep 'ring.attention|context.parallel|sequence_parallel'` over
+python/paddle/distributed is empty — SURVEY.md §2.3); long-context training is
+a required capability of the TPU build (BASELINE north star).
+
+Design (RingAttention, Liu et al. — blockwise attention + ring passing):
+q/k/v live sharded on the sequence dim over the ``axis`` ring.  Each of the
+``ring_size`` steps computes blockwise attention of the LOCAL q chunk against
+the k/v chunk currently held, merges it into a running (max, denominator,
+accumulator) online-softmax state, then passes k/v to the next ring neighbour
+via ``lax.ppermute`` — an ICI neighbour hop that XLA overlaps with the
+compute.  The full [T, T] score matrix never exists; per-device memory is
+O(T_local * T_local) per step (and the step loop is rematerialized).
+
+Causality uses GLOBAL positions: chunk c holds rows [c*Tl, (c+1)*Tl);
+diagonal pairs get a triangular mask, off-diagonal pairs an all-or-nothing
+one.  Note every ring step still computes its block einsum even when fully
+masked — causal runs carry ~2x the minimal FLOPs (no zigzag load-balancing
+yet); masked scores only zero out through the where.
+
+Differentiable by construction (scan + ppermute both have transposes), so it
+composes with jax.grad/pipeline/TP with no custom VJP.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG = -1e30
+
+
+def _chunk_attend(q, k, v, scale, mask=None):
+    """One blockwise partial attention: returns (scores-max m, exp-sum l,
+    weighted acc) for merging.  q [B,Tq,H,D], k/v [B,Tk,H,D]."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG)
+    m = jnp.max(s, axis=-1)                      # [B,H,Tq]
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)                      # [B,H,Tq]
+    acc = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    return m, l, acc
+
+
+def ring_attention(q, k, v, axis: str, causal: bool = True, scale=None):
+    """Sequence-sharded attention inside a ``shard_map`` region.
+
+    q,k,v: LOCAL chunks [B, T_local, H, D], sequence dim sharded over
+    ``axis`` (ring of size R; global T = R * T_local).  Returns the local
+    output chunk [B, T_local, H, D].
+    """
+    B, Tl, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / (D**0.5)
+    R = lax.axis_size(axis)
+    my = lax.axis_index(axis)
+    perm = [(i, (i + 1) % R) for i in range(R)]  # pass kv forward round-robin
+
+    rows = jnp.arange(Tl)
+
+    def step(carry, r):
+        k_cur, v_cur, m_acc, l_acc, o_acc = carry
+        src = (my - r) % R  # which chunk we hold at ring step r
+        if causal:
+            # global causal mask between q-chunk `my` and kv-chunk `src`
+            q_pos = my * Tl + rows                     # [Tl]
+            k_pos = src * Tl + rows                    # [Tl]
+            mask = q_pos[:, None] >= k_pos[None, :]    # [Tq, Tk]
+            mask = mask[None, None]                    # [1,1,Tq,Tk]
+        else:
+            mask = None
+        m_new, l_new, acc_new = _chunk_attend(q, k_cur, v_cur, scale, mask)
+        # online-softmax merge of the partial result into the running state
+        m_next = jnp.maximum(m_acc, m_new)
+        a_old = jnp.exp(m_acc - m_next)
+        a_new = jnp.exp(m_new - m_next)
+        l_next = l_acc * a_old + l_new * a_new
+        o_next = o_acc * a_old[..., None] + acc_new * a_new[..., None]
+        k_nxt = lax.ppermute(k_cur, axis, perm)
+        v_nxt = lax.ppermute(v_cur, axis, perm)
+        return (k_nxt, v_nxt, m_next, l_next, o_next), None
+
+    m0 = jnp.full((B, H, Tl), _NEG, jnp.float32)
+    l0 = jnp.zeros((B, H, Tl), jnp.float32)
+    o0 = jnp.zeros((B, H, Tl, D), jnp.float32)
+    body = jax.checkpoint(step)  # remat each ring step: O(Tl*Tl) live, not R×
+    (k_f, v_f, m_f, l_f, o_f), _ = lax.scan(
+        body, (k, v, m0, l0, o0), jnp.arange(R))
+    l_safe = jnp.where(l_f == 0.0, 1.0, l_f)
+    out = (o_f / l_safe[..., None]).astype(q.dtype)   # [B,H,Tl,D]
+    return jnp.swapaxes(out, 1, 2)                    # [B,Tl,H,D]

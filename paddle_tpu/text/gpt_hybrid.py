@@ -33,6 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax.experimental.shard_map import shard_map
 
 from ..distributed import megatron as mt
+from ..ops.ring_attention import ring_attention
 from . import gpt
 
 
@@ -44,12 +45,14 @@ _dropout = gpt._dropout
 
 
 def mp_block(x, p, cfg: gpt.GPTConfig, mp_axis: str | None, mp_size: int,
-             key=None):
+             key=None, sp_axis: str | None = None):
     """One transformer block on [B, T, D]; weight leaves are LOCAL mp shards.
 
     qkv/fc are column-parallel (heads and ffn split across mp, no comm);
     proj/out are row-parallel (one psum each) — two all-reduces per block,
-    exactly the reference Megatron block's comm pattern."""
+    exactly the reference Megatron block's comm pattern.  With ``sp_axis``
+    set, T is the LOCAL sequence chunk and attention runs as a ring over
+    that axis (ops/ring_attention.py) — context parallelism."""
     B, T, D = x.shape
     H = cfg.num_heads // mp_size
     hd = cfg.head_dim
@@ -60,7 +63,10 @@ def mp_block(x, p, cfg: gpt.GPTConfig, mp_axis: str | None, mp_size: int,
     q = qkv[0].reshape(B, T, H, hd)
     k = qkv[1].reshape(B, T, H, hd)
     v = qkv[2].reshape(B, T, H, hd)
-    attn = gpt.attention_array(q, k, v, is_causal=True).reshape(B, T, H * hd)
+    if sp_axis is not None:
+        attn = ring_attention(q, k, v, sp_axis, causal=True).reshape(B, T, H * hd)
+    else:
+        attn = gpt.attention_array(q, k, v, is_causal=True).reshape(B, T, H * hd)
     a = mt.row_parallel_linear(attn, p["proj_w"].astype(dt),
                                p["proj_b"].astype(dt), axis=mp_axis)
     if cfg.dropout > 0.0 and key is not None:
@@ -81,32 +87,43 @@ def mp_block(x, p, cfg: gpt.GPTConfig, mp_axis: str | None, mp_size: int,
 # ---------------------------------------------------------------------------
 
 def make_pipeline_gpt_loss(cfg: gpt.GPTConfig, mesh: Mesh, n_micro: int,
-                           dp_axis="dp", pp_axis="pp", mp_axis="mp"):
+                           dp_axis="dp", pp_axis="pp", mp_axis="mp",
+                           sp_axis="sp"):
     """Full-mesh SPMD loss fn (runs per-device inside shard_map).
 
-    tokens: LOCAL [B_local, T] int32 (already dp-sharded by in_specs).
+    tokens: LOCAL [B_local, T] int32 (dp-sharded by in_specs; the sequence
+    dim stays replicated — each sp rank slices its own chunk so the odd
+    T+1 LM shift never has to shard).
     params: LOCAL shards per gpt.param_shardings(mp, pp).
+    Composes pp (ppermute schedule) × mp (Megatron) × sp (ring attention).
     """
     S = mesh.shape.get(pp_axis, 1)
     mp_size = mesh.shape.get(mp_axis, 1)
+    sp_size = mesh.shape.get(sp_axis, 1)
     mp_ax = mp_axis if mp_size > 1 else None
+    sp_ax = sp_axis if sp_size > 1 else None
     dp_ax = dp_axis if mesh.shape.get(dp_axis, 1) > 1 else None
     vps = cfg.vocab_size // mp_size
     perm = [(i, (i + 1) % S) for i in range(S)]
     dt = cfg.dtype
 
-    def embed(params, tok):
-        # tok [..., T]; embed tok[..., :-1]
-        x = mt.vocab_parallel_embedding(params["wte"], tok[..., :-1], mp_ax, vps)
-        return (x + params["wpe"][: tok.shape[-1] - 1]).astype(dt)
+    def embed(params, tok, pos0):
+        # tok [..., Tl] (local chunk); pos0 = global offset of the chunk
+        x = mt.vocab_parallel_embedding(params["wte"], tok, mp_ax, vps)
+        wpe = lax.dynamic_slice_in_dim(params["wpe"], pos0, tok.shape[-1])
+        return (x + wpe).astype(dt)
 
     def stage(blocks, x, key):
         n_local = jax.tree_util.tree_leaves(blocks)[0].shape[0]
         if S > 1:
             # decorrelate dropout across stages: the tick key is stage-shared
             key = jax.random.fold_in(key, lax.axis_index(pp_axis))
+        if sp_ax is not None:
+            # and across sequence chunks, each masking its own positions
+            key = jax.random.fold_in(key, lax.axis_index(sp_ax))
         layer_keys = jax.random.split(key, n_local)
-        body = functools.partial(mp_block, cfg=cfg, mp_axis=mp_ax, mp_size=mp_size)
+        body = functools.partial(mp_block, cfg=cfg, mp_axis=mp_ax,
+                                 mp_size=mp_size, sp_axis=sp_ax)
         if cfg.remat:
             body = jax.checkpoint(body)
 
@@ -124,11 +141,21 @@ def make_pipeline_gpt_loss(cfg: gpt.GPTConfig, mesh: Mesh, n_micro: int,
         if B % M:
             raise ValueError(
                 f"per-dp-shard batch {B} must be divisible by n_micro {M}")
+        if (T - 1) % sp_size:
+            raise ValueError(
+                f"sequence length {T - 1} must divide by sp {sp_size}")
+        Tl = (T - 1) // sp_size
+        sp_rank = lax.axis_index(sp_axis) if sp_ax else 0
+        pos0 = sp_rank * Tl
         mb = tokens.reshape(M, B // M, T)
+        # local sequence chunk of inputs/targets (full tokens stay replicated
+        # over sp; the shifted slices are taken per-rank)
+        tok_in = lax.dynamic_slice_in_dim(mb, pos0, Tl, axis=2)
+        tok_tgt = lax.dynamic_slice_in_dim(mb, pos0 + 1, Tl, axis=2)
         ticks = M + S - 1
         keys = jax.random.split(key, ticks)
-        # all micro-batch embeddings up-front, one batched lookup ([M, b, T-1, D])
-        x_emb = embed(params, mb)
+        # all micro-batch embeddings up-front, one batched lookup ([M, b, Tl, D])
+        x_emb = embed(params, tok_in, pos0)
 
         def tick(carry, inp):
             x_recv = carry
@@ -147,19 +174,22 @@ def make_pipeline_gpt_loss(cfg: gpt.GPTConfig, mesh: Mesh, n_micro: int,
         # outputs for micro-batch m sit at tick m + S - 1 → static slice.
         # One batched head over all M micro-batches (vs per-tick heads: the
         # vocab matmul is the biggest in the model — do it once).
-        y_fin = ys[S - 1:]  # [M, b, T-1, D]
+        y_fin = ys[S - 1:]  # [M, b, Tl, D]
         x = gpt._layer_norm(y_fin.astype(jnp.float32), params["ln_f_g"],
                             params["ln_f_b"]).astype(dt)
         logits = mt.vocab_parallel_logits(x, params["wte"].astype(dt))
-        ce = mt.vocab_parallel_softmax_ce(logits, mb[..., 1:], mp_ax, vps)
+        ce = mt.vocab_parallel_softmax_ce(logits, tok_tgt, mp_ax, vps)
         loss = jnp.where(s == S - 1, jnp.mean(ce.astype(jnp.float32)), 0.0)
         if S > 1:
             loss = lax.psum(loss, pp_axis)  # only last stage's head is real
         if dp_ax is not None:
             loss = lax.pmean(loss, dp_ax)
-        # replicate over any remaining axes (sp etc.) for a clean P() output
+        if sp_ax is not None:
+            loss = lax.pmean(loss, sp_ax)  # equal chunks → mean of means
+        # replicate over any remaining axes for a clean P() output
         for ax in mesh.axis_names:
-            if ax not in (dp_axis, pp_axis, mp_axis) and mesh.shape[ax] > 1:
+            if ax not in (dp_axis, pp_axis, mp_axis, sp_axis) \
+                    and mesh.shape[ax] > 1:
                 loss = lax.pmean(loss, ax)
         return loss
 
@@ -207,24 +237,17 @@ def build_gpt_train_step(cfg: gpt.GPTConfig, mesh: Mesh, optimizer,
         lambda s: NamedSharding(mesh, s if s is not None else P()),
         specs, is_leaf=_spec_leaf)
 
-    if pp > 1:
-        if sp > 1:
-            raise NotImplementedError("sp with pp pending ring-attention stage")
-        tok_spec = P("dp") if dp > 1 else P()
+    tok_spec = P("dp") if dp > 1 else P()
+    if pp > 1 or sp > 1:
+        # manual-collective path: pipeline schedule and/or ring attention
         loss_raw = make_pipeline_gpt_loss(cfg, mesh, n_micro)
         loss_fn = shard_map(loss_raw, mesh=mesh,
                             in_specs=(specs, tok_spec, P()), out_specs=P(),
                             check_rep=False)
     else:
-        tok_spec = P("dp") if dp > 1 else P()
-        act_sharding = None
-        if sp > 1:
-            act_sharding = NamedSharding(
-                mesh, P("dp" if dp > 1 else None, "sp", None))
-
+        # pure GSPMD: XLA inserts dp/mp collectives from the PartitionSpecs
         def loss_fn(params, tokens, key):
-            return gpt.loss_fn(params, tokens, cfg, act_sharding=act_sharding,
-                               key=key)
+            return gpt.loss_fn(params, tokens, cfg, key=key)
 
     tok_sharding = NamedSharding(mesh, tok_spec)
 
