@@ -7,82 +7,99 @@ reference's Fleet implements with NCCL/brpc.
 """
 from __future__ import annotations
 
-# dtypes
-from .core.dtype import (  # noqa: F401
-    bfloat16,
-    bool_ as bool,  # noqa: A001
-    complex64,
-    complex128,
-    float16,
-    float32,
-    float64,
-    get_default_dtype,
-    int8,
-    int16,
-    int32,
-    int64,
-    set_default_dtype,
-    uint8,
-)
-
-# device / place
-from .core.place import (  # noqa: F401
-    CPUPlace,
-    CUDAPlace,
-    Place,
-    TPUPlace,
-    device_count,
-    get_device,
-    is_compiled_with_tpu,
-    set_device,
-)
-
-# tensor + autograd
-from .core.tensor import Parameter, Tensor, to_tensor  # noqa: F401
-from .core.autograd import enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
-from .framework.random import seed  # noqa: F401
-
-# the full tensor-op surface (also attaches Tensor methods)
-from .tensor_api import *  # noqa: F401,F403
-from . import tensor_api as _tensor_api
-
-from . import core, framework  # noqa: F401
-from . import autograd  # noqa: F401
-from . import nn  # noqa: F401
-from . import optimizer  # noqa: F401
-from . import amp  # noqa: F401
-from . import jit  # noqa: F401
-from . import io  # noqa: F401
-from . import metric  # noqa: F401
-from . import vision  # noqa: F401
-from . import text  # noqa: F401
-from .framework.io import load, save  # noqa: F401
-from .nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+import os as _os
+import sys as _sys
 
 __version__ = "0.1.0"
 
+# Tooling entry points (launch CLI, spawn helpers) must not initialize the
+# accelerator backend in their own process — the reference launcher never
+# touches CUDA either (fleet/launch.py only builds env + subprocesses).
+# `python -m paddle_tpu.distributed.launch` imports this package before the
+# module runs, so the light-import switch is decided here.
+_LIGHT_IMPORT = (
+    _os.environ.get("PADDLE_TPU_LIGHT_IMPORT") == "1"
+    or any(a in ("paddle_tpu.distributed.launch",
+                 "paddle_tpu.distributed.spawn")
+           for a in getattr(_sys, "orig_argv", []))
+)
 
-def disable_static():  # compat no-op: this framework is always "dygraph+jit"
-    return None
+if not _LIGHT_IMPORT:
+    # dtypes
+    from .core.dtype import (  # noqa: F401
+        bfloat16,
+        bool_ as bool,  # noqa: A001
+        complex64,
+        complex128,
+        float16,
+        float32,
+        float64,
+        get_default_dtype,
+        int8,
+        int16,
+        int32,
+        int64,
+        set_default_dtype,
+        uint8,
+    )
+
+    # device / place
+    from .core.place import (  # noqa: F401
+        CPUPlace,
+        CUDAPlace,
+        Place,
+        TPUPlace,
+        device_count,
+        get_device,
+        is_compiled_with_tpu,
+        set_device,
+    )
+
+    # tensor + autograd
+    from .core.tensor import Parameter, Tensor, to_tensor  # noqa: F401
+    from .core.autograd import (  # noqa: F401
+        enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled,
+    )
+    from .framework.random import seed  # noqa: F401
+
+    # the full tensor-op surface (also attaches Tensor methods)
+    from .tensor_api import *  # noqa: F401,F403
+    from . import tensor_api as _tensor_api
+
+    from . import core, framework  # noqa: F401
+    from . import autograd  # noqa: F401
+    from . import nn  # noqa: F401
+    from . import optimizer  # noqa: F401
+    from . import amp  # noqa: F401
+    from . import jit  # noqa: F401
+    from . import io  # noqa: F401
+    from . import metric  # noqa: F401
+    from . import vision  # noqa: F401
+    from . import text  # noqa: F401
+    from . import inference  # noqa: F401
+    from .framework.io import load, save  # noqa: F401
+    from .nn.clip import (  # noqa: F401
+        ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
+    )
+
+    def disable_static():  # compat no-op: always "dygraph+jit" here
+        return None
+
+    def enable_static():  # static graph == to_static/jit here
+        return None
+
+    def in_dynamic_mode():
+        return True
+
+    def is_compiled_with_cuda():  # TPU build: never CUDA
+        return False
+
+    def ones_like(x, dtype=None):  # re-export convenience
+        return _tensor_api.ones_like(x, dtype)
 
 
-def enable_static():  # static graph == to_static/jit here
-    return None
-
-
-def in_dynamic_mode():
-    return True
-
-
-def is_compiled_with_cuda():  # TPU build: never CUDA
-    return False
-
-
-def ones_like(x, dtype=None):  # re-export convenience (already in tensor_api)
-    return _tensor_api.ones_like(x, dtype)
-
-
-# distributed is imported lazily to keep plain single-chip import light
+# distributed is imported lazily to keep plain single-chip import light (and
+# it is the only namespace available under light import)
 def __getattr__(name):
     if name == "distributed":
         import importlib
@@ -90,8 +107,10 @@ def __getattr__(name):
         mod = importlib.import_module(".distributed", __name__)
         globals()["distributed"] = mod
         return mod
-    if name == "DataParallel":
+    if not _LIGHT_IMPORT and name == "DataParallel":
         from .distributed.parallel import DataParallel
 
         return DataParallel
-    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
+    extra = " (light import: launcher process)" if _LIGHT_IMPORT else ""
+    raise AttributeError(
+        f"module 'paddle_tpu' has no attribute {name!r}{extra}")

@@ -2,39 +2,50 @@
 
 Reference: python/paddle/distributed (collective.py API, fleet/, launch).
 See module docstrings for the NCCL→XLA-collective mapping (SURVEY.md §2.4).
+
+Under light import (launcher/spawn processes — see paddle_tpu/__init__.py)
+only the backend-free tooling modules load: kvstore, elastic, launch.
 """
-from . import fleet  # noqa: F401
-from .collective import (  # noqa: F401
-    ReduceOp, all_gather, all_reduce, alltoall, barrier, broadcast, new_group,
-    prim, recv, reduce, reduce_scatter, scatter, send,
-)
-from .env import (  # noqa: F401
-    get_mesh, get_rank, get_world_size, has_mesh, init_parallel_env, set_mesh,
-)
-from .parallel import DataParallel  # noqa: F401
-from .recompute import recompute  # noqa: F401
-from . import pipeline  # noqa: F401
-from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+import paddle_tpu as _root
 
+from . import elastic, kvstore  # noqa: F401  (backend-free, always safe)
 
-def get_group(gid=0):
-    from .collective import get_group as _g
+if not _root._LIGHT_IMPORT:
+    from . import fleet  # noqa: F401
+    from .collective import (  # noqa: F401
+        ReduceOp, all_gather, all_reduce, alltoall, barrier, broadcast,
+        new_group, prim, recv, reduce, reduce_scatter, scatter, send,
+    )
+    from .env import (  # noqa: F401
+        get_mesh, get_rank, get_world_size, has_mesh, init_parallel_env,
+        set_mesh,
+    )
+    from .parallel import DataParallel  # noqa: F401
+    from .recompute import recompute  # noqa: F401
+    from . import megatron, pipeline  # noqa: F401
+    from .topology import (  # noqa: F401
+        CommunicateTopology, HybridCommunicateGroup,
+    )
 
-    return _g(gid)
+    def get_group(gid=0):
+        from .collective import get_group as _g
 
+        return _g(gid)
 
-def split(x, size, operation, axis=0, num_partitions=1, gather_out=True, weight_attr=None,
-          bias_attr=None, name=None):
-    """reference collective.py:1282 paddle.distributed.split — megatron-style
-    sharded fc/embedding, provided via meta_parallel layers."""
-    from .meta_parallel import ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding
+    def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+              weight_attr=None, bias_attr=None, name=None):
+        """reference collective.py:1282 paddle.distributed.split —
+        megatron-style sharded fc/embedding via meta_parallel layers."""
+        from .meta_parallel import (ColumnParallelLinear, RowParallelLinear,
+                                    VocabParallelEmbedding)
 
-    if operation == "linear":
-        cls = ColumnParallelLinear if axis == 1 else RowParallelLinear
-        layer = cls(size[0], size[1], weight_attr=weight_attr,
-                    has_bias=bias_attr is not False)
-        return layer(x)
-    if operation == "embedding":
-        layer = VocabParallelEmbedding(size[0], size[1], weight_attr=weight_attr)
-        return layer(x)
-    raise ValueError(operation)
+        if operation == "linear":
+            cls = ColumnParallelLinear if axis == 1 else RowParallelLinear
+            layer = cls(size[0], size[1], weight_attr=weight_attr,
+                        has_bias=bias_attr is not False)
+            return layer(x)
+        if operation == "embedding":
+            layer = VocabParallelEmbedding(size[0], size[1],
+                                           weight_attr=weight_attr)
+            return layer(x)
+        raise ValueError(operation)

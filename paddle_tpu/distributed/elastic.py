@@ -1,0 +1,89 @@
+"""Elastic membership: heartbeat-based scale-up/down detection + relaunch.
+
+Reference capability: ``ElasticManager`` (fleet/elastic.py:90) — etcd-backed
+(:125) host registration, peer watching, teardown+relaunch on scale events,
+np range via PADDLE_ELASTIC_NP.  Here membership rides the stdlib KV store
+(kvstore.py) instead of etcd: each host heartbeats `elastic/host/<id>` with a
+timestamp; the manager watches the live set and reports scale events the
+launcher acts on (restart training with the new world size — with JAX this
+means re-running jax.distributed.initialize + rebuilding the mesh).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from .kvstore import KVClient
+
+
+class ElasticStatus:
+    OK = "ok"
+    SCALE = "scale"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    def __init__(self, client: KVClient, host_id: str,
+                 np_range: tuple[int, int] | None = None,
+                 heartbeat_interval: float = 1.0, ttl: float = 5.0):
+        self.c = client
+        self.host_id = host_id
+        self.interval = heartbeat_interval
+        self.ttl = ttl
+        self.np_min, self.np_max = np_range or (1, 1 << 30)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._last_live: frozenset = frozenset()
+
+    # -- membership ----------------------------------------------------------
+    def register(self):
+        self._beat()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        self._last_live = frozenset(self.live_hosts()[: self.np_max])
+        return self
+
+    def _beat(self):
+        self.c.set(f"elastic/host/{self.host_id}", time.time())
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self._beat()
+            self._stop.wait(self.interval)
+
+    def deregister(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+        self.c.delete(f"elastic/host/{self.host_id}")
+
+    def live_hosts(self) -> list:
+        now = time.time()
+        hosts = []
+        for k in self.c.keys("elastic/host/"):
+            ts = self.c.get(k)
+            if ts is not None and now - float(ts) < self.ttl:
+                hosts.append(k.split("/", 2)[2])
+        return sorted(hosts)
+
+    # -- watch ---------------------------------------------------------------
+    def check(self) -> str:
+        """Poll once: OK (effective membership unchanged), SCALE (world
+        changed within [np_min, np_max] → relaunch), EXIT (below np_min).
+        Hosts beyond np_max are ignored (capped), not a scale event."""
+        live = self.live_hosts()
+        if len(live) < self.np_min:
+            return ElasticStatus.EXIT
+        effective = frozenset(live[: self.np_max])
+        if effective != self._last_live:
+            self._last_live = effective
+            return ElasticStatus.SCALE
+        return ElasticStatus.OK
+
+    def wait_for_np(self, n: int, timeout: float = 60) -> bool:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if len(self.live_hosts()) >= n:
+                return True
+            time.sleep(self.interval / 2)
+        return False

@@ -36,6 +36,16 @@ def init_parallel_env(mesh_shape: Mapping[str, int] | None = None, devices=None,
     reference paddle.distributed.init_parallel_env).
     """
     global _GLOBAL_MESH
+    if coordinator_address is None:
+        # launch.py contract: the launcher exports these for every trainer;
+        # the KV store owns the advertised port, JAX coordination takes +1
+        env_coord = os.environ.get("PADDLE_TPU_COORDINATOR")
+        env_np = int(os.environ.get("PADDLE_TPU_NUM_PROCESSES", "1"))
+        if env_coord is not None and env_np > 1:
+            host, port = env_coord.rsplit(":", 1)
+            coordinator_address = f"{host}:{int(port) + 1}"
+            num_processes = env_np
+            process_id = int(os.environ.get("PADDLE_TPU_PROCESS_ID", "0"))
     if coordinator_address is not None:
         jax.distributed.initialize(coordinator_address, num_processes, process_id)
     devs = list(devices) if devices is not None else jax.devices()

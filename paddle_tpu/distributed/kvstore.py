@@ -1,0 +1,187 @@
+"""TCP key-value store: bootstrap + barrier + heartbeat primitive.
+
+Reference capability: the TCP bootstrap plumbing — ncclUniqueId exchange
+(platform/gen_comm_id_helper.cc:126 CreateListenSocket / :286
+SendBroadCastCommID), the gloo HTTP KV store (fleet/utils/http_server.py),
+and the barrier tables.  TPU-native role: JAX's coordination service does the
+PJRT-level bootstrap; this store covers the framework-level needs around it —
+rendezvous of the coordinator address, elastic membership heartbeats,
+cross-host barriers in launch/elastic tooling.  Pure stdlib, thread-per-conn.
+
+Protocol: length-prefixed JSON requests {op, key, value?, ...} → {ok, value?}.
+Ops: set, get (blocking-optional), add (atomic counter), barrier, keys, ping.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Any
+
+
+def _send(sock: socket.socket, obj: Any):
+    data = json.dumps(obj).encode()
+    sock.sendall(struct.pack("!I", len(data)) + data)
+
+
+def _recv(sock: socket.socket) -> Any:
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        hdr += chunk
+    (n,) = struct.unpack("!I", hdr)
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return json.loads(buf.decode())
+
+
+class KVServer:
+    """Threaded TCP KV server; start() returns the bound (host, port)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        store: dict[str, Any] = {}
+        cond = threading.Condition()
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        req = _recv(self.request)
+                        op = req.get("op")
+                        if op == "set":
+                            with cond:
+                                store[req["key"]] = req["value"]
+                                cond.notify_all()
+                            _send(self.request, {"ok": True})
+                        elif op == "get":
+                            timeout = req.get("timeout", 0)
+                            deadline = time.time() + timeout
+                            with cond:
+                                while req["key"] not in store:
+                                    left = deadline - time.time()
+                                    if timeout == 0 or left <= 0:
+                                        break
+                                    cond.wait(min(left, 1.0))
+                                val = store.get(req["key"])
+                            _send(self.request,
+                                  {"ok": req["key"] in store, "value": val})
+                        elif op == "add":
+                            with cond:
+                                cur = int(store.get(req["key"], 0)) + int(
+                                    req.get("value", 1))
+                                store[req["key"]] = cur
+                                cond.notify_all()
+                            _send(self.request, {"ok": True, "value": cur})
+                        elif op == "barrier":
+                            key, world = req["key"], int(req["world"])
+                            with cond:
+                                cur = int(store.get(key, 0)) + 1
+                                store[key] = cur
+                                cond.notify_all()
+                                deadline = time.time() + req.get("timeout", 300)
+                                while int(store.get(key, 0)) % world != 0:
+                                    left = deadline - time.time()
+                                    if left <= 0:
+                                        break
+                                    cond.wait(min(left, 1.0))
+                                done = int(store.get(key, 0)) % world == 0
+                            _send(self.request, {"ok": done})
+                        elif op == "keys":
+                            with cond:
+                                ks = [k for k in store
+                                      if k.startswith(req.get("prefix", ""))]
+                            _send(self.request, {"ok": True, "value": ks})
+                        elif op == "delete":
+                            with cond:
+                                store.pop(req["key"], None)
+                                cond.notify_all()
+                            _send(self.request, {"ok": True})
+                        elif op == "ping":
+                            _send(self.request, {"ok": True})
+                        else:
+                            _send(self.request, {"ok": False,
+                                                 "error": f"bad op {op}"})
+                except (ConnectionError, OSError):
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._srv = Server((host, port), Handler)
+        self.host, self.port = self._srv.server_address
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self.host, self.port
+
+    def shutdown(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class KVClient:
+    """Client handle; one persistent connection, thread-safe.
+
+    Connection is retried with backoff until ``connect_timeout`` — peers may
+    come up before the rank-0 server (the reference's comm-id exchange
+    retries the same way)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 300,
+                 connect_timeout: float = 60):
+        self._addr = (host, port)
+        self._lock = threading.Lock()
+        deadline = time.time() + connect_timeout
+        delay = 0.05
+        while True:
+            try:
+                self._sock = socket.create_connection(self._addr,
+                                                      timeout=timeout)
+                break
+            except OSError:
+                if time.time() >= deadline:
+                    raise
+                time.sleep(delay)
+                delay = min(delay * 2, 1.0)
+
+    def _rpc(self, req: dict) -> dict:
+        with self._lock:
+            _send(self._sock, req)
+            return _recv(self._sock)
+
+    def set(self, key: str, value):
+        return self._rpc({"op": "set", "key": key, "value": value})["ok"]
+
+    def get(self, key: str, timeout: float = 0):
+        r = self._rpc({"op": "get", "key": key, "timeout": timeout})
+        return r["value"] if r["ok"] else None
+
+    def add(self, key: str, value: int = 1) -> int:
+        return int(self._rpc({"op": "add", "key": key, "value": value})["value"])
+
+    def barrier(self, key: str, world: int, timeout: float = 300) -> bool:
+        return self._rpc({"op": "barrier", "key": key, "world": world,
+                          "timeout": timeout})["ok"]
+
+    def keys(self, prefix: str = "") -> list:
+        return self._rpc({"op": "keys", "prefix": prefix})["value"]
+
+    def delete(self, key: str):
+        return self._rpc({"op": "delete", "key": key})["ok"]
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass

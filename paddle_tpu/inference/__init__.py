@@ -1,0 +1,186 @@
+"""Inference deployment: compile-once predictor + serialized model artifact.
+
+Reference capability (L8): ``AnalysisPredictor`` (inference/api/
+analysis_predictor.cc — CreatePaddlePredictor :1183, Run :381,
+OptimizeInferenceProgram :621), ``AnalysisConfig`` (api/analysis_config.cc),
+``save_inference_model`` (python/paddle/fluid/io.py:1246), ZeroCopyTensor.
+
+TPU-native design: the serialized "program" is a **StableHLO artifact**
+(jax.export) — the portable compiled-graph format the XLA toolchain owns,
+playing the ProgramDesc + IR-pass-pipeline role.  ``save_inference_model``
+traces the model once with frozen weights (the reference also freezes params
+into the inference program), serializes StableHLO bytes + a JSON manifest.
+``Predictor`` deserializes and jit-executes; XLA's fusion pipeline IS the
+GpuPassStrategy analog — no hand-maintained pass list to port.
+
+Artifact layout:  <prefix>.pdmodel   — StableHLO bytes (jax.export)
+                  <prefix>.json     — manifest (input names/shapes/dtypes)
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+
+class Config:
+    """AnalysisConfig analog — construction-time knobs for the predictor."""
+
+    def __init__(self, model_path: str | None = None):
+        self._model_prefix = None
+        if model_path is not None:
+            self.set_model(model_path)
+        self._device = None  # default: first jax device
+        self._dtype = None   # optional cast of floating inputs (e.g. bf16)
+        self._donate_inputs = False
+
+    def set_model(self, prefix: str):
+        self._model_prefix = prefix
+        return self
+
+    def model_path(self):
+        return self._model_prefix
+
+    def enable_use_gpu(self, *_a, **_k):  # reference API shape; TPU is ambient
+        return self
+
+    def set_device(self, device):
+        self._device = device
+        return self
+
+    def enable_bf16(self):
+        import jax.numpy as jnp
+
+        self._dtype = jnp.bfloat16
+        return self
+
+    # reference knobs that are XLA's job here — accepted as no-ops
+    def switch_ir_optim(self, *_a, **_k):
+        return self
+
+    def enable_memory_optim(self, *_a, **_k):
+        return self
+
+    def set_cpu_math_library_num_threads(self, *_a, **_k):
+        return self
+
+
+def save_inference_model(path_prefix: str, fn_or_layer, example_inputs,
+                         params: Any = None):
+    """Trace + freeze + serialize a model for serving.
+
+    fn_or_layer: a pure ``fn(*arrays)`` or an ``nn.Layer`` (its parameters
+    are frozen into the artifact, like the reference's inference program).
+    example_inputs: sequence of arrays or ShapeDtypeStructs fixing the
+    serving signature.
+    """
+    import jax
+
+    from ..core.tensor import Tensor
+
+    if hasattr(fn_or_layer, "named_parameters"):  # nn.Layer
+        layer = fn_or_layer
+
+        def fn(*xs):
+            outs = layer(*[Tensor(x, stop_gradient=True) for x in xs])
+            if isinstance(outs, (tuple, list)):
+                return tuple(o.value if isinstance(o, Tensor) else o
+                             for o in outs)
+            return outs.value if isinstance(outs, Tensor) else outs
+    elif params is not None:
+        base = fn_or_layer
+
+        def fn(*xs):
+            return base(params, *xs)
+    else:
+        fn = fn_or_layer
+
+    specs = tuple(
+        x if isinstance(x, jax.ShapeDtypeStruct)
+        else jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype)
+        for x in example_inputs)
+    exported = jax.export.export(jax.jit(fn))(*specs)
+    blob = exported.serialize()
+    os.makedirs(os.path.dirname(os.path.abspath(path_prefix)), exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(blob)
+    manifest = {
+        "format": "stablehlo-jax-export-v1",
+        "inputs": [{"name": f"x{i}", "shape": list(s.shape),
+                    "dtype": np.dtype(s.dtype).name}
+                   for i, s in enumerate(specs)],
+    }
+    with open(path_prefix + ".json", "w") as f:
+        json.dump(manifest, f, indent=1)
+    return path_prefix
+
+
+class Predictor:
+    """Compile-once server: deserialize StableHLO, jit, run.
+
+    API surface mirrors the reference predictor (get_input_names /
+    get_input_handle / run / get_output_handle); tensors are zero-copy
+    jax arrays under the hood (the ZeroCopyTensor role)."""
+
+    def __init__(self, config: Config):
+        import jax
+
+        prefix = config.model_path()
+        if prefix is None:
+            raise ValueError("Config.set_model(path_prefix) required")
+        with open(prefix + ".pdmodel", "rb") as f:
+            self._exported = jax.export.deserialize(f.read())
+        with open(prefix + ".json") as f:
+            self._manifest = json.load(f)
+        self._cfg = config
+        self._call = jax.jit(self._exported.call)
+        self._inputs: dict[str, Any] = {}
+        self._outputs: Sequence[Any] = ()
+
+    # -- reference-shaped API ------------------------------------------------
+    def get_input_names(self):
+        return [i["name"] for i in self._manifest["inputs"]]
+
+    def get_input_handle(self, name):
+        pred = self
+
+        class _Handle:
+            def copy_from_cpu(self, arr):
+                pred._inputs[name] = np.ascontiguousarray(arr)
+
+            def reshape(self, *_a):
+                pass
+
+        return _Handle()
+
+    def get_output_names(self):
+        return [f"out{i}" for i in range(len(self._outputs))] or ["out0"]
+
+    def get_output_handle(self, name):
+        idx = int(name[3:]) if name.startswith("out") else 0
+        pred = self
+
+        class _Handle:
+            def copy_to_cpu(self):
+                return np.asarray(pred._outputs[idx])
+
+        return _Handle()
+
+    def run(self, inputs: Sequence[Any] | None = None):
+        import jax
+
+        if inputs is None:
+            inputs = [self._inputs[n] for n in self.get_input_names()]
+        arrs = [np.asarray(x) if not hasattr(x, "dtype") else x
+                for x in inputs]
+        out = self._call(*arrs)
+        self._outputs = out if isinstance(out, (tuple, list)) else (out,)
+        jax.block_until_ready(self._outputs)
+        return self._outputs
+
+
+def create_predictor(config: Config) -> Predictor:
+    """CreatePaddlePredictor analog."""
+    return Predictor(config)

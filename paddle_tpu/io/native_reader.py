@@ -35,6 +35,9 @@ class BlockingBatchQueue:
 
     def push(self, arr: np.ndarray) -> bool:
         arr = np.ascontiguousarray(arr)
+        if arr.nbytes == 0:
+            # size 0 is the closed-and-drained sentinel on the pop side
+            raise ValueError("cannot push an empty buffer")
         p = arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
         return bool(self._lib.ptq_push(self._h, p, arr.nbytes))
 

@@ -40,6 +40,7 @@ class GPTConfig:
     dtype: Any = jnp.bfloat16  # compute dtype; params stay fp32
     remat: bool = False
     use_flash: bool = True
+    moe: Any = None  # MoEConfig → every block's FFN becomes expert-parallel
 
     @property
     def head_dim(self):
@@ -70,54 +71,77 @@ def init_params(cfg: GPTConfig, key) -> dict:
         return std * jax.random.normal(k, shape, jnp.float32)
 
     blk_keys = jax.random.split(keys[9], 6)
+    blocks = {
+        "ln1_g": jnp.ones((L, D), jnp.float32),
+        "ln1_b": jnp.zeros((L, D), jnp.float32),
+        "ln2_g": jnp.ones((L, D), jnp.float32),
+        "ln2_b": jnp.zeros((L, D), jnp.float32),
+        # qkv stored as separate [3, D, D] mats (not one [D, 3D]) so the
+        # output dim shards cleanly per-projection under tensor parallel
+        "qkv_w": nrm(blk_keys[0], (L, 3, D, D)),
+        "qkv_b": jnp.zeros((L, 3, D), jnp.float32),
+        "proj_w": nrm(blk_keys[1], (L, D, D), std=s / math.sqrt(2 * L)),
+        "proj_b": jnp.zeros((L, D), jnp.float32),
+    }
+    if cfg.moe is None:
+        blocks.update({
+            "fc_w": nrm(blk_keys[2], (L, D, F)),
+            "fc_b": jnp.zeros((L, F), jnp.float32),
+            "out_w": nrm(blk_keys[3], (L, F, D), std=s / math.sqrt(2 * L)),
+            "out_b": jnp.zeros((L, D), jnp.float32),
+        })
+    else:
+        from .moe import init_moe_params
+
+        per_layer = [init_moe_params(k, D, F, cfg.moe)
+                     for k in jax.random.split(blk_keys[2], L)]
+        blocks["moe"] = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *per_layer)
     params = {
         "wte": nrm(keys[0], (V, D)),
         "wpe": nrm(keys[1], (T, D)),
         "ln_f_g": jnp.ones((D,), jnp.float32),
         "ln_f_b": jnp.zeros((D,), jnp.float32),
-        "blocks": {
-            "ln1_g": jnp.ones((L, D), jnp.float32),
-            "ln1_b": jnp.zeros((L, D), jnp.float32),
-            "ln2_g": jnp.ones((L, D), jnp.float32),
-            "ln2_b": jnp.zeros((L, D), jnp.float32),
-            # qkv stored as separate [3, D, D] mats (not one [D, 3D]) so the
-            # output dim shards cleanly per-projection under tensor parallel
-            "qkv_w": nrm(blk_keys[0], (L, 3, D, D)),
-            "qkv_b": jnp.zeros((L, 3, D), jnp.float32),
-            "proj_w": nrm(blk_keys[1], (L, D, D), std=s / math.sqrt(2 * L)),
-            "proj_b": jnp.zeros((L, D), jnp.float32),
-            "fc_w": nrm(blk_keys[2], (L, D, F)),
-            "fc_b": jnp.zeros((L, F), jnp.float32),
-            "out_w": nrm(blk_keys[3], (L, F, D), std=s / math.sqrt(2 * L)),
-            "out_b": jnp.zeros((L, D), jnp.float32),
-        },
+        "blocks": blocks,
     }
     return params
 
 
-def param_shardings(cfg: GPTConfig, dp="dp", mp="mp", pp=None) -> dict:
+def param_shardings(cfg: GPTConfig, dp="dp", mp="mp", pp=None, ep="ep") -> dict:
     """Megatron-style PartitionSpecs (reference mp_layers.py Column/RowParallel
-    + VocabParallelEmbedding; ZeRO/pp compose by adding axes)."""
+    + VocabParallelEmbedding; ZeRO/pp compose by adding axes).  With MoE the
+    expert dim shards over ``ep`` (expert parallelism)."""
     l = pp  # leading stacked-layer axis shards over pipeline stages if set
+    blocks = {
+        "ln1_g": P(l, None),
+        "ln1_b": P(l, None),
+        "ln2_g": P(l, None),
+        "ln2_b": P(l, None),
+        "qkv_w": P(l, None, None, mp),  # column parallel (per-projection)
+        "qkv_b": P(l, None, mp),
+        "proj_w": P(l, mp, None),  # row parallel
+        "proj_b": P(l, None),
+    }
+    if cfg.moe is None:
+        blocks.update({
+            "fc_w": P(l, None, mp),    # column parallel
+            "fc_b": P(l, mp),
+            "out_w": P(l, mp, None),   # row parallel
+            "out_b": P(l, None),
+        })
+    else:
+        from .moe import moe_param_shardings
+
+        # per-layer MoE specs with the stacked-layer axis prepended
+        blocks["moe"] = {
+            k: P(l, *v) for k, v in moe_param_shardings(ep=ep, mp=mp).items()
+        }
     return {
         "wte": P(mp, None),          # vocab-parallel embedding
         "wpe": P(None, None),
         "ln_f_g": P(None),
         "ln_f_b": P(None),
-        "blocks": {
-            "ln1_g": P(l, None),
-            "ln1_b": P(l, None),
-            "ln2_g": P(l, None),
-            "ln2_b": P(l, None),
-            "qkv_w": P(l, None, None, mp),  # column parallel (per-projection)
-            "qkv_b": P(l, None, mp),
-            "proj_w": P(l, mp, None),  # row parallel
-            "proj_b": P(l, None),
-            "fc_w": P(l, None, mp),    # column parallel
-            "fc_b": P(l, mp),
-            "out_w": P(l, mp, None),   # row parallel
-            "out_b": P(l, None),
-        },
+        "blocks": blocks,
     }
 
 
@@ -151,16 +175,26 @@ def _block(x, p, cfg: GPTConfig, dropout_key=None):
         a = _dropout(a, cfg.dropout, jax.random.fold_in(dropout_key, 0))
     x = x + a
     h = _layer_norm(x.astype(jnp.float32), p["ln2_g"], p["ln2_b"]).astype(dt)
-    h = jax.nn.gelu(h @ p["fc_w"].astype(dt) + p["fc_b"].astype(dt))
-    h = h @ p["out_w"].astype(dt) + p["out_b"].astype(dt)
+    if cfg.moe is not None:
+        from .moe import moe_ffn
+
+        h, aux = moe_ffn(p["moe"], h, cfg.moe,
+                         key=(jax.random.fold_in(dropout_key, 2)
+                              if dropout_key is not None else None))
+    else:
+        h = jax.nn.gelu(h @ p["fc_w"].astype(dt) + p["fc_b"].astype(dt))
+        h = h @ p["out_w"].astype(dt) + p["out_b"].astype(dt)
+        aux = jnp.zeros((), jnp.float32)
     if drop:
         h = _dropout(h, cfg.dropout, jax.random.fold_in(dropout_key, 1))
-    return x + h
+    return x + h, aux
 
 
-def forward(params: dict, tokens, cfg: GPTConfig, act_sharding=None, key=None):
-    """tokens [B, T] int32 → logits [B, T, V] (compute dtype).
+def forward_with_aux(params: dict, tokens, cfg: GPTConfig, act_sharding=None,
+                     key=None):
+    """tokens [B, T] int32 → (logits [B, T, V], aux-loss scalar).
 
+    aux is the summed MoE load-balancing loss (0 for dense models).
     act_sharding: optional NamedSharding constraint applied to the [B, T, D]
     activations — e.g. P('dp', 'sp', None) for sequence parallelism; XLA
     propagates it through the blocks and inserts the sp collectives.
@@ -175,34 +209,41 @@ def forward(params: dict, tokens, cfg: GPTConfig, act_sharding=None, key=None):
     if cfg.remat:
         blk = jax.checkpoint(blk)
 
-    if cfg.dropout > 0.0 and key is not None:
+    need_keys = key is not None and (cfg.dropout > 0.0 or cfg.moe is not None)
+    if need_keys:
         layer_keys = jax.random.split(key, cfg.num_layers)
 
         def scan_body(x, pk):
             p, k = pk
-            return blk(x, p, dropout_key=k), None
+            return blk(x, p, dropout_key=k)
 
-        x, _ = jax.lax.scan(scan_body, x, (params["blocks"], layer_keys))
+        x, aux = jax.lax.scan(scan_body, x, (params["blocks"], layer_keys))
     else:
         def scan_body(x, layer_params):
-            return blk(x, layer_params), None
+            return blk(x, layer_params)
 
-        x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+        x, aux = jax.lax.scan(scan_body, x, params["blocks"])
     x = _layer_norm(x.astype(jnp.float32), params["ln_f_g"], params["ln_f_b"]).astype(dt)
     logits = x @ params["wte"].T.astype(dt)
-    return logits
+    return logits, jnp.sum(aux)
+
+
+def forward(params: dict, tokens, cfg: GPTConfig, act_sharding=None, key=None):
+    """tokens [B, T] int32 → logits [B, T, V] (compute dtype)."""
+    return forward_with_aux(params, tokens, cfg, act_sharding, key)[0]
 
 
 def loss_fn(params: dict, tokens, cfg: GPTConfig, act_sharding=None, key=None):
     """Next-token LM loss; softmax-CE in fp32 (reference
     c_softmax_with_cross_entropy keeps the reduction sharded — here XLA
-    handles the sharded softmax under pjit)."""
-    logits = forward(params, tokens[:, :-1], cfg, act_sharding=act_sharding,
-                     key=key)
+    handles the sharded softmax under pjit).  MoE models add the router
+    load-balancing aux loss."""
+    logits, aux = forward_with_aux(params, tokens[:, :-1], cfg,
+                                   act_sharding=act_sharding, key=key)
     tgt = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     ll = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    return -jnp.mean(ll) + aux
 
 
 def count_params(cfg: GPTConfig) -> int:

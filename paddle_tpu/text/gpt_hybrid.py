@@ -225,14 +225,22 @@ def build_gpt_train_step(cfg: gpt.GPTConfig, mesh: Mesh, optimizer,
     mp = axes.get("mp", 1)
     dp = axes.get("dp", 1)
     sp = axes.get("sp", 1)
+    ep = axes.get("ep", 1)
     if cfg.num_layers % max(pp, 1):
         raise ValueError(f"num_layers {cfg.num_layers} must divide by pp {pp}")
     if cfg.num_heads % max(mp, 1) or cfg.vocab_size % max(mp, 1):
         raise ValueError("num_heads and vocab_size must divide by mp")
+    if cfg.moe is not None:
+        if pp > 1 or sp > 1:
+            raise NotImplementedError(
+                "MoE currently composes with dp/mp/ep (GSPMD path) only")
+        if cfg.moe.num_experts % max(ep, 1):
+            raise ValueError("num_experts must divide by ep")
 
     mp_ax = "mp" if mp > 1 else None
     pp_ax = "pp" if pp > 1 else None
-    specs = gpt.param_shardings(cfg, mp=mp_ax, pp=pp_ax)
+    ep_ax = "ep" if ep > 1 else None
+    specs = gpt.param_shardings(cfg, mp=mp_ax, pp=pp_ax, ep=ep_ax)
     p_shard = jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s if s is not None else P()),
         specs, is_leaf=_spec_leaf)

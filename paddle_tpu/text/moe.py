@@ -1,0 +1,120 @@
+"""Mixture-of-Experts layers with expert parallelism (the 'ep' mesh axis).
+
+Capability beyond the reference: xymyeah/Paddle has no MoE/expert parallel
+(`grep -rni 'moe'` over python/paddle/distributed is empty — SURVEY.md §2.3).
+The TPU build adds it as a first-class parallel axis.
+
+GShard-style design (dispatch/combine einsums, not gather/scatter): the
+router produces a dispatch mask [tokens, experts, capacity]; two einsums move
+tokens to expert buffers and back.  Under pjit with the expert dim of the
+weights and buffers sharded P('ep', ...), XLA lowers the dispatch einsums to
+all_to_all over the ep axis — the exact comm pattern hand-written MoE
+frameworks issue, derived from shardings.  Static shapes throughout
+(capacity-bounded, overflow tokens dropped) keep it jit-compatible.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass
+class MoEConfig:
+    num_experts: int = 8
+    capacity_factor: float = 1.25
+    router_noise: float = 0.0          # jitter std for exploration
+    aux_loss_weight: float = 0.01      # load-balancing loss (GShard eq. 4)
+    top_k: int = 2
+
+
+def init_moe_params(key, d_model: int, d_ff: int, cfg: MoEConfig) -> dict:
+    k1, k2, k3 = jax.random.split(key, 3)
+    E = cfg.num_experts
+    s = 0.02
+    return {
+        "router_w": s * jax.random.normal(k1, (d_model, E), jnp.float32),
+        "w_in": s * jax.random.normal(k2, (E, d_model, d_ff), jnp.float32),
+        "b_in": jnp.zeros((E, d_ff), jnp.float32),
+        "w_out": s * jax.random.normal(k3, (E, d_ff, d_model), jnp.float32),
+        "b_out": jnp.zeros((E, d_model), jnp.float32),
+    }
+
+
+def moe_param_shardings(ep="ep", mp=None) -> dict:
+    """Experts shard over 'ep'; inside each expert the ffn dim may shard over
+    'mp' (expert-tensor hybrid)."""
+    return {
+        "router_w": P(None, None),
+        "w_in": P(ep, None, mp),
+        "b_in": P(ep, mp),
+        "w_out": P(ep, mp, None),
+        "b_out": P(ep, None),
+    }
+
+
+def _top_k_gating(logits, k: int):
+    """Returns (weights [N,k], indices [N,k]) with renormalized softmax."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    w, idx = jax.lax.top_k(probs, k)
+    w = w / jnp.sum(w, axis=-1, keepdims=True)
+    return w, idx, probs
+
+
+def moe_ffn(params: dict, x, cfg: MoEConfig, key=None, activation=jax.nn.gelu):
+    """x [..., D] → (y [..., D], aux_loss scalar).
+
+    Capacity per expert C = ceil(N * top_k / E * capacity_factor); tokens
+    over capacity are dropped (residual connection keeps them identity —
+    standard GShard behavior, keeps shapes static for XLA).
+    """
+    orig_shape = x.shape
+    D = orig_shape[-1]
+    xf = x.reshape(-1, D)
+    N = xf.shape[0]
+    E = cfg.num_experts
+    C = max(1, math.ceil(N * cfg.top_k / E * cfg.capacity_factor))
+
+    logits = xf.astype(jnp.float32) @ params["router_w"]
+    if cfg.router_noise > 0.0 and key is not None:
+        logits = logits + cfg.router_noise * jax.random.normal(
+            key, logits.shape)
+    gate_w, gate_idx, probs = _top_k_gating(logits, cfg.top_k)
+
+    # load-balancing aux loss: E * sum_e f_e * p_e  (GShard/Switch)
+    me = jnp.mean(probs, axis=0)                                  # [E] mean prob
+    fe = jnp.sum(jax.nn.one_hot(gate_idx[:, 0], E), axis=0) / N   # [E] frac routed
+    aux = E * jnp.sum(fe * me) * cfg.aux_loss_weight
+
+    # position of each (token, slot) inside its expert buffer via cumsum
+    # dispatch [N, k, E] one-hot over experts
+    onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)         # [N,k,E]
+    flat = onehot.reshape(N * cfg.top_k, E)
+    pos = jnp.cumsum(flat, axis=0) * flat - 1                     # [N*k, E]
+    pos = jnp.max(pos, axis=-1).reshape(N, cfg.top_k)             # [N,k]
+    keep = pos < C
+    gate_w = gate_w * keep
+
+    # dispatch tensor [N, E, C]
+    disp = jnp.zeros((N, E, C), x.dtype)
+    n_ix = jnp.arange(N)[:, None].repeat(cfg.top_k, 1)
+    disp = disp.at[n_ix, gate_idx, jnp.clip(pos, 0, C - 1)].add(
+        keep.astype(x.dtype))
+    comb = jnp.zeros((N, E, C), jnp.float32)
+    comb = comb.at[n_ix, gate_idx, jnp.clip(pos, 0, C - 1)].add(
+        gate_w * keep)
+
+    # route → expert ffn → route back (XLA lowers these to all_to_all when
+    # the E dim is sharded over 'ep')
+    xin = jnp.einsum("nec,nd->ecd", disp, xf)                     # [E,C,D]
+    h = activation(jnp.einsum("ecd,edf->ecf", xin,
+                              params["w_in"].astype(x.dtype))
+                   + params["b_in"][:, None].astype(x.dtype))
+    out = jnp.einsum("ecf,efd->ecd", h, params["w_out"].astype(x.dtype)) \
+        + params["b_out"][:, None].astype(x.dtype)
+    y = jnp.einsum("nec,ecd->nd", comb.astype(x.dtype), out)
+    return y.reshape(orig_shape), aux

@@ -1,0 +1,127 @@
+"""Launch CLI, KV store rendezvous/barrier, elastic membership.
+
+Reference analog: launch_utils cluster tests + test_fleet_elastic_* (etcd
+mocked); here the KV store is real (stdlib TCP) and launch spawns real
+subprocesses on localhost, like test_dist_base.py does.
+"""
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.distributed.elastic import ElasticManager, ElasticStatus
+from paddle_tpu.distributed.kvstore import KVClient, KVServer
+
+
+@pytest.fixture()
+def kv():
+    srv = KVServer()
+    host, port = srv.start()
+    clients = []
+
+    def make():
+        c = KVClient(host, port)
+        clients.append(c)
+        return c
+
+    yield make
+    for c in clients:
+        c.close()
+    srv.shutdown()
+
+
+def test_kv_set_get_add(kv):
+    c = kv()
+    assert c.set("a", {"x": 1})
+    assert c.get("a") == {"x": 1}
+    assert c.get("missing") is None
+    assert c.add("ctr") == 1
+    assert c.add("ctr", 5) == 6
+    assert sorted(c.keys()) == ["a", "ctr"]
+
+
+def test_kv_blocking_get(kv):
+    c1, c2 = kv(), kv()
+
+    def setter():
+        time.sleep(0.2)
+        c2.set("late", 42)
+
+    t = threading.Thread(target=setter)
+    t.start()
+    assert c1.get("late", timeout=5) == 42
+    t.join()
+
+
+def test_kv_barrier(kv):
+    results = []
+
+    def worker(c):
+        results.append(c.barrier("b1", 3, timeout=10))
+
+    cs = [kv() for _ in range(3)]
+    ts = [threading.Thread(target=worker, args=(c,)) for c in cs]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert results == [True, True, True]
+
+
+def test_elastic_membership(kv):
+    c1, c2 = kv(), kv()
+    m1 = ElasticManager(c1, "hostA", np_range=(1, 4),
+                        heartbeat_interval=0.1, ttl=1.0).register()
+    assert m1.check() == ElasticStatus.OK
+    m2 = ElasticManager(c2, "hostB", np_range=(1, 4),
+                        heartbeat_interval=0.1, ttl=1.0).register()
+    assert m2.wait_for_np(2, timeout=5)
+    # m1 sees the join as a scale event
+    assert m1.check() == ElasticStatus.SCALE
+    assert m1.check() == ElasticStatus.OK
+    # hostB leaves; after ttl it disappears
+    m2.deregister()
+    time.sleep(0.1)
+    assert m1.check() == ElasticStatus.SCALE
+    m1.deregister()
+
+
+def test_launch_cli_runs_script(tmp_path):
+    script = tmp_path / "train.py"
+    script.write_text(
+        "import os\n"
+        "rank = os.environ['PADDLE_TRAINER_ID']\n"
+        "world = os.environ['PADDLE_TRAINERS_NUM']\n"
+        "print(f'rank {rank}/{world} ok')\n")
+    log_dir = tmp_path / "logs"
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_host", "2", "--coordinator", "127.0.0.1:0",
+         "--log_dir", str(log_dir), str(script)],
+        cwd="/root/repo", capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    logs = sorted(os.listdir(log_dir))
+    assert logs == ["worker.0.log", "worker.1.log"]
+    text = (log_dir / "worker.1.log").read_text()
+    assert "rank 1/2 ok" in text
+
+
+def test_launch_restarts_on_failure(tmp_path):
+    marker = tmp_path / "marker"
+    script = tmp_path / "flaky.py"
+    script.write_text(
+        f"import os, sys\n"
+        f"m = {str(marker)!r}\n"
+        f"if not os.path.exists(m):\n"
+        f"    open(m, 'w').close()\n"
+        f"    sys.exit(3)\n"
+        f"print('recovered')\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--coordinator", "127.0.0.1:0", "--max_restarts", "1", str(script)],
+        cwd="/root/repo", capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "restart 1/1" in r.stderr
