@@ -77,6 +77,11 @@ if not _LIGHT_IMPORT:
     from . import vision  # noqa: F401
     from . import text  # noqa: F401
     from . import inference  # noqa: F401
+    from . import hapi  # noqa: F401
+    from .hapi import Model, summary  # noqa: F401
+    from . import profiler  # noqa: F401
+    from .flags import get_flags, set_flags  # noqa: F401
+    from .framework import checkpoint, debugger  # noqa: F401
     from .framework.io import load, save  # noqa: F401
     from .nn.clip import (  # noqa: F401
         ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,

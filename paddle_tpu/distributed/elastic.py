@@ -44,7 +44,8 @@ class ElasticManager:
         return self
 
     def _beat(self):
-        self.c.set(f"elastic/host/{self.host_id}", time.time())
+        # server-clock stamp: liveness never depends on cross-host clock sync
+        self.c.stamp(f"elastic/host/{self.host_id}")
 
     def _loop(self):
         while not self._stop.is_set():
@@ -58,13 +59,9 @@ class ElasticManager:
         self.c.delete(f"elastic/host/{self.host_id}")
 
     def live_hosts(self) -> list:
-        now = time.time()
-        hosts = []
-        for k in self.c.keys("elastic/host/"):
-            ts = self.c.get(k)
-            if ts is not None and now - float(ts) < self.ttl:
-                hosts.append(k.split("/", 2)[2])
-        return sorted(hosts)
+        kv, now = self.c.snapshot("elastic/host/")  # server clock for both
+        return sorted(k.split("/", 2)[2] for k, ts in kv.items()
+                      if now - float(ts) < self.ttl)
 
     # -- watch ---------------------------------------------------------------
     def check(self) -> str:

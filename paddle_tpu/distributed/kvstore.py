@@ -100,6 +100,19 @@ class KVServer:
                                 ks = [k for k in store
                                       if k.startswith(req.get("prefix", ""))]
                             _send(self.request, {"ok": True, "value": ks})
+                        elif op == "stamp":
+                            # heartbeat: stamped with the SERVER clock so
+                            # liveness never depends on cross-host clock sync
+                            with cond:
+                                store[req["key"]] = time.time()
+                                cond.notify_all()
+                            _send(self.request, {"ok": True})
+                        elif op == "snapshot":
+                            with cond:
+                                kv = {k: v for k, v in store.items()
+                                      if k.startswith(req.get("prefix", ""))}
+                            _send(self.request, {"ok": True, "value": kv,
+                                                 "now": time.time()})
                         elif op == "delete":
                             with cond:
                                 store.pop(req["key"], None)
@@ -155,8 +168,10 @@ class KVClient:
                 time.sleep(delay)
                 delay = min(delay * 2, 1.0)
 
-    def _rpc(self, req: dict) -> dict:
+    def _rpc(self, req: dict, wait: float = 0) -> dict:
         with self._lock:
+            # the socket deadline must outlive any server-side blocking wait
+            self._sock.settimeout(max(30.0, wait + 30.0))
             _send(self._sock, req)
             return _recv(self._sock)
 
@@ -164,7 +179,8 @@ class KVClient:
         return self._rpc({"op": "set", "key": key, "value": value})["ok"]
 
     def get(self, key: str, timeout: float = 0):
-        r = self._rpc({"op": "get", "key": key, "timeout": timeout})
+        r = self._rpc({"op": "get", "key": key, "timeout": timeout},
+                      wait=timeout)
         return r["value"] if r["ok"] else None
 
     def add(self, key: str, value: int = 1) -> int:
@@ -172,10 +188,19 @@ class KVClient:
 
     def barrier(self, key: str, world: int, timeout: float = 300) -> bool:
         return self._rpc({"op": "barrier", "key": key, "world": world,
-                          "timeout": timeout})["ok"]
+                          "timeout": timeout}, wait=timeout)["ok"]
 
     def keys(self, prefix: str = "") -> list:
         return self._rpc({"op": "keys", "prefix": prefix})["value"]
+
+    def stamp(self, key: str):
+        """Server-clock heartbeat write."""
+        return self._rpc({"op": "stamp", "key": key})["ok"]
+
+    def snapshot(self, prefix: str = ""):
+        """Returns ({key: value}, server_now) for clock-skew-free liveness."""
+        r = self._rpc({"op": "snapshot", "prefix": prefix})
+        return r["value"], float(r["now"])
 
     def delete(self, key: str):
         return self._rpc({"op": "delete", "key": key})["ok"]

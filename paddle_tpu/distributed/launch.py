@@ -78,6 +78,9 @@ class TrainerProc:
         self._log = None
 
     def start(self):
+        if self._log:  # restart: drop the previous handle first
+            self._log.close()
+            self._log = None
         if self.log_path:
             self._log = open(self.log_path, "ab")
         self.proc = subprocess.Popen(
@@ -102,16 +105,20 @@ class TrainerProc:
 def launch(args) -> int:
     coord_host, coord_port = args.coordinator.split(":")
     coord_port = int(coord_port)
-    server = None
-    if args.node_rank == 0:
-        server = KVServer(coord_host if coord_host != "localhost"
-                          else "127.0.0.1", coord_port)
-        server.start()
-
     local_sim = args.nproc_per_host > 1
     if local_sim and args.nnodes > 1:
         raise SystemExit("--nproc_per_host > 1 is a single-host CPU "
                          "simulation mode; it cannot combine with --nnodes")
+    if args.nnodes > 1 and coord_port == 0:
+        raise SystemExit("--nnodes > 1 needs a fixed --coordinator port "
+                         "(every host must dial the same address)")
+    server = None
+    if args.node_rank == 0:
+        server = KVServer(coord_host if coord_host != "localhost"
+                          else "127.0.0.1", coord_port)
+        _, coord_port = server.start()  # port 0 → the actually-bound port
+    coordinator = f"{coord_host}:{coord_port}"
+
     world = args.nnodes if not local_sim else args.nproc_per_host
 
     # rendezvous: register and wait for everyone (gen_comm_id role)
@@ -126,7 +133,7 @@ def launch(args) -> int:
     for r in ranks:
         cmd = [sys.executable, "-u", args.training_script,
                *args.training_script_args]
-        env = _proc_env(r, world, args.coordinator, local_sim)
+        env = _proc_env(r, world, coordinator, local_sim)
         log = (os.path.join(args.log_dir, f"worker.{r}.log")
                if args.log_dir else None)
         if args.log_dir:
@@ -135,37 +142,39 @@ def launch(args) -> int:
     for p in procs:
         p.start()
 
-    # watch loop: abnormal exit of any proc kills (or restarts) the pod
+    # watch loop: abnormal exit of ANY proc stops the whole pod (a multi-
+    # process JAX job cannot survive a single dead rank — the reference's
+    # launch watch does the same); restarts relaunch the POD, not one rank
+    pod_restarts = 0
     exit_code = 0
     try:
         while True:
-            alive = False
-            for p in procs:
-                rc = p.poll()
-                if rc is None:
-                    alive = True
-                elif rc != 0:
-                    if p.restarts < args.max_restarts:
-                        p.restarts += 1
-                        print(f"[launch] rank {p.rank} exited {rc}; "
-                              f"restart {p.restarts}/{args.max_restarts}",
-                              file=sys.stderr)
+            alive = any(p.poll() is None for p in procs)
+            failed = [p for p in procs if p.poll() not in (None, 0)]
+            if failed:
+                rc = failed[0].poll()
+                for p in procs:
+                    p.terminate()
+                if pod_restarts < args.max_restarts:
+                    pod_restarts += 1
+                    print(f"[launch] rank {failed[0].rank} exited {rc}; pod "
+                          f"restart {pod_restarts}/{args.max_restarts}",
+                          file=sys.stderr)
+                    for p in procs:
                         p.start()
-                        alive = True
-                    else:
-                        print(f"[launch] rank {p.rank} failed (exit {rc}); "
-                              "terminating pod", file=sys.stderr)
-                        exit_code = rc
-                        raise KeyboardInterrupt
+                    continue
+                print(f"[launch] rank {failed[0].rank} failed (exit {rc}); "
+                      "terminating pod", file=sys.stderr)
+                exit_code = rc
+                break
             if not alive:
                 break
             time.sleep(0.2)
     except KeyboardInterrupt:
+        exit_code = exit_code or 1
+    finally:
         for p in procs:
             p.terminate()
-        if exit_code == 0:
-            exit_code = 1
-    finally:
         if client:
             client.close()
         if server:

@@ -1,0 +1,63 @@
+"""Global flags: ``paddle.set_flags`` / ``get_flags``.
+
+Reference capability: ~35 gflags in platform/flags.cc exposed through
+pybind/global_value_getter_setter.cc and settable as FLAGS_* env vars or
+``paddle.set_flags``.  TPU-native mapping: flags that correspond to XLA/JAX
+config knobs forward there; framework-behavior flags (nan/inf checking, GC,
+allocator-strategy equivalents that PJRT owns) live in a plain registry consulted
+by the runtime pieces.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Iterable, Mapping
+
+_JAX_MAPPED = {
+    # reference FLAGS_check_nan_inf (platform/flags.cc:44): XLA-level nan
+    # trap on every jitted computation
+    "FLAGS_check_nan_inf": "jax_debug_nans",
+    # escape hatch: run ops eagerly without compilation
+    "FLAGS_disable_jit": "jax_disable_jit",
+    # matmul precision on the MXU (bf16 passes vs fp32): 'default'|'high'|'highest'
+    "FLAGS_matmul_precision": "jax_default_matmul_precision",
+}
+
+_REGISTRY: dict[str, Any] = {
+    "FLAGS_check_nan_inf": False,
+    "FLAGS_disable_jit": False,
+    "FLAGS_matmul_precision": None,
+    # host-side step-level nan scan (framework/details/nan_inf_utils role,
+    # implemented in framework.debugger for train steps)
+    "FLAGS_check_nan_inf_host": False,
+    "FLAGS_benchmark": False,
+    "FLAGS_allocator_strategy": "pjrt",  # informational: PJRT owns HBM
+}
+
+# env seeding, like the reference's FLAGS_* env support
+for _k in list(_REGISTRY):
+    if _k in os.environ:
+        v = os.environ[_k]
+        _REGISTRY[_k] = {"true": True, "false": False, "1": True,
+                         "0": False}.get(v.lower(), v)
+
+
+def set_flags(flags: Mapping[str, Any]):
+    import jax
+
+    for k, v in flags.items():
+        if k not in _REGISTRY:
+            raise ValueError(f"unknown flag {k!r}; known: {sorted(_REGISTRY)}")
+        _REGISTRY[k] = v
+        if k in _JAX_MAPPED and v is not None:
+            jax.config.update(_JAX_MAPPED[k], v)
+
+
+def get_flags(flags: str | Iterable[str]):
+    if isinstance(flags, str):
+        flags = [flags]
+    return {k: _REGISTRY[k] for k in flags}
+
+
+def flag(name: str, default=None):
+    """Internal accessor used by framework code."""
+    return _REGISTRY.get(name, default)

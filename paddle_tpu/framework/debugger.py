@@ -1,0 +1,53 @@
+"""Numerical debugging: nan/inf detection over pytrees and train steps.
+
+Reference capability: FLAGS_check_nan_inf (platform/flags.cc:44) →
+``CheckVarHasNanOrInf`` scanning every kernel output
+(framework/details/nan_inf_utils_detail.cc:299 + .cu kernel).
+
+TPU-native: two tiers —
+  * compile-time trap: ``paddle.set_flags({'FLAGS_check_nan_inf': True})``
+    flips XLA's jax_debug_nans (every jitted computation re-runs un-jitted on
+    a nan and raises at the offending primitive — the per-kernel scan role);
+  * host-side step scan: ``find_nan_inf(tree)`` / ``assert_finite(tree)``
+    check materialized outputs (loss/grads/params) with named leaf paths for
+    actionable errors, used by train loops when FLAGS_check_nan_inf_host.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+
+def find_nan_inf(tree: Any) -> list:
+    """Returns [(leaf_path, n_nan, n_inf), ...] for non-finite leaves."""
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    bad = []
+    for path, leaf in flat:
+        if leaf is None or not hasattr(leaf, "dtype"):
+            continue
+        if not np.issubdtype(np.asarray(leaf).dtype, np.floating):
+            continue
+        a = np.asarray(leaf)
+        n_nan = int(np.isnan(a).sum())
+        n_inf = int(np.isinf(a).sum())
+        if n_nan or n_inf:
+            bad.append((jax.tree_util.keystr(path), n_nan, n_inf))
+    return bad
+
+
+def assert_finite(tree: Any, msg: str = "tensor"):
+    bad = find_nan_inf(tree)
+    if bad:
+        detail = ", ".join(f"{p} (nan={n}, inf={i})" for p, n, i in bad[:8])
+        more = f" … and {len(bad) - 8} more" if len(bad) > 8 else ""
+        raise FloatingPointError(
+            f"nan/inf detected in {msg}: {detail}{more}")
+
+
+def check_numerics_enabled() -> bool:
+    from .. import flags
+
+    return bool(flags.flag("FLAGS_check_nan_inf_host"))

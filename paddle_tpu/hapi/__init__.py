@@ -1,0 +1,6 @@
+"""High-level training API (reference python/paddle/hapi)."""
+from .callbacks import (  # noqa: F401
+    Callback, EarlyStopping, LRSchedulerCallback, ModelCheckpoint,
+    ProgBarLogger,
+)
+from .model import Model, summary  # noqa: F401

@@ -1,0 +1,95 @@
+"""Training callbacks (reference python/paddle/hapi/callbacks.py)."""
+from __future__ import annotations
+
+import sys
+import time
+
+
+class Callback:
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+
+class ProgBarLogger(Callback):
+    def __init__(self, log_freq: int = 10, verbose: int = 1):
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        self._t0 = time.time()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose and step % self.log_freq == 0:
+            items = " ".join(f"{k}: {v:.4f}" if isinstance(v, float) else
+                             f"{k}: {v}" for k, v in (logs or {}).items())
+            print(f"Epoch {self._epoch} step {step} {items}", file=sys.stderr)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            dt = time.time() - self._t0
+            items = " ".join(f"{k}: {v:.4f}" if isinstance(v, float) else
+                             f"{k}: {v}" for k, v in (logs or {}).items())
+            print(f"Epoch {epoch} done in {dt:.1f}s {items}", file=sys.stderr)
+
+
+class ModelCheckpoint(Callback):
+    def __init__(self, save_dir: str, save_freq: int = 1):
+        self.save_dir = save_dir
+        self.save_freq = save_freq
+
+    def on_epoch_end(self, epoch, logs=None):
+        if epoch % self.save_freq == 0:
+            self.model.save(f"{self.save_dir}/epoch_{epoch}")
+
+
+class EarlyStopping(Callback):
+    def __init__(self, monitor="loss", patience=3, mode="min", min_delta=0.0):
+        self.monitor, self.patience = monitor, patience
+        self.mode, self.min_delta = mode, min_delta
+        self.best = None
+        self.wait = 0
+        self.stopped = False
+
+    def on_eval_end(self, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        better = (self.best is None
+                  or (self.mode == "min" and cur < self.best - self.min_delta)
+                  or (self.mode == "max" and cur > self.best + self.min_delta))
+        if better:
+            self.best, self.wait = cur, 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stopped = True
+                self.model._stop_training = True
+
+
+class LRSchedulerCallback(Callback):
+    """Steps an LRScheduler attached to the optimizer once per epoch (the
+    reference's LRScheduler callback; per-step schedulers step in TrainStep)."""
+
+    def on_epoch_end(self, epoch, logs=None):
+        sch = getattr(self.model._optimizer, "_lr", None)
+        if hasattr(sch, "step"):
+            sch.step()

@@ -1,0 +1,177 @@
+"""``paddle.Model`` — fit/evaluate/predict over a Layer.
+
+Reference capability: python/paddle/hapi/model.py:878 ``Model`` (prepare
+:1450, fit :1523) with its dual static/dynamic adapters.  TPU-native: ONE
+adapter — every train step is the jitted whole-step program
+(jit.TrainStep), which is what the reference's StaticGraphAdapter existed to
+approximate; eval/predict run the Layer eagerly (XLA still jits per-op).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..framework.io import load as _load, save as _save
+from ..jit import TrainStep
+from .callbacks import Callback, ProgBarLogger
+
+
+def _to_batches(data, batch_size, shuffle=False, seed=0):
+    """Accepts a DataLoader-like iterable (yields tuples) or a pair of
+    array-likes (features, labels)."""
+    if hasattr(data, "__iter__") and not isinstance(data, (tuple, list)):
+        yield from data
+        return
+    xs, ys = data
+    xs, ys = np.asarray(xs), np.asarray(ys)
+    n = len(xs)
+    idx = np.arange(n)
+    if shuffle:
+        np.random.default_rng(seed).shuffle(idx)
+    for i in range(0, n - batch_size + 1, batch_size):
+        sel = idx[i:i + batch_size]
+        yield xs[sel], ys[sel]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics: Sequence = ()
+        self._train_step: TrainStep | None = None
+        self._stop_training = False
+
+    def prepare(self, optimizer=None, loss=None, metrics=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else (
+            [metrics] if metrics else [])
+        if optimizer is not None and loss is not None:
+            self._train_step = TrainStep(self.network, loss, optimizer)
+        return self
+
+    # -- train ---------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=32, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=1,
+            shuffle=True, callbacks=None):
+        assert self._train_step is not None, "call prepare(optimizer, loss)"
+        cbs = list(callbacks or [])
+        if verbose and not any(isinstance(c, ProgBarLogger) for c in cbs):
+            cbs.insert(0, ProgBarLogger(log_freq, verbose))
+        for c in cbs:
+            c.set_model(self)
+        self._stop_training = False
+        for c in cbs:
+            c.on_train_begin()
+        history = []
+        for epoch in range(epochs):
+            for c in cbs:
+                c.on_epoch_begin(epoch)
+            losses = []
+            for step, batch in enumerate(
+                    _to_batches(train_data, batch_size, shuffle, seed=epoch)):
+                loss = self._train_step(*batch)
+                losses.append(float(loss.numpy()))
+                logs = {"loss": losses[-1]}
+                for c in cbs:
+                    c.on_train_batch_end(step, logs)
+            epoch_logs = {"loss": float(np.mean(losses)) if losses else 0.0}
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                epoch_logs.update(self.evaluate(eval_data, batch_size,
+                                                verbose=0))
+                for c in cbs:
+                    c.on_eval_end(epoch_logs)
+            for c in cbs:
+                c.on_epoch_end(epoch, epoch_logs)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(os.path.join(save_dir, f"epoch_{epoch}"))
+            history.append(epoch_logs)
+            if self._stop_training:
+                break
+        for c in cbs:
+            c.on_train_end()
+        return history
+
+    # -- eval / predict ------------------------------------------------------
+    def evaluate(self, eval_data, batch_size=32, log_freq=10, verbose=1):
+        self.network.eval()
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        try:
+            for batch in _to_batches(eval_data, batch_size):
+                *xs, y = batch
+                out = self.network(*[Tensor(np.asarray(x), True) for x in xs])
+                if self._loss is not None:
+                    losses.append(float(
+                        self._loss(out, Tensor(np.asarray(y), True)).numpy()))
+                for m in self._metrics:
+                    m.update(m.compute(out, Tensor(np.asarray(y), True)))
+        finally:
+            self.network.train()
+        logs = {}
+        if losses:
+            logs["eval_loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            names = m.name() if isinstance(m.name(), (list, tuple)) else [m.name()]
+            vals = m.accumulate()
+            vals = vals if isinstance(vals, (list, tuple)) else [vals]
+            logs.update(dict(zip(names, map(float, vals))))
+        return logs
+
+    def predict(self, test_data, batch_size=32):
+        self.network.eval()
+        outs = []
+        try:
+            for batch in _to_batches(test_data, batch_size):
+                xs = batch if not isinstance(batch, (tuple, list)) else batch
+                if isinstance(xs, (tuple, list)):
+                    xs = xs[:1] if len(xs) > 1 else xs
+                out = self.network(*[Tensor(np.asarray(x), True) for x in xs])
+                outs.append(out.numpy())
+        finally:
+            self.network.train()
+        return outs
+
+    def train_batch(self, inputs, labels):
+        assert self._train_step is not None
+        loss = self._train_step(*(list(np.atleast_1d(inputs))
+                                  if isinstance(inputs, (list, tuple))
+                                  else [inputs]), labels)
+        return [float(loss.numpy())]
+
+    # -- io ------------------------------------------------------------------
+    def save(self, path):
+        _save(self.network.state_dict(), path + ".pdparams")
+        if self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path):
+        self.network.set_state_dict(_load(path + ".pdparams"))
+        if self._optimizer is not None and os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(_load(path + ".pdopt"))
+
+    def parameters(self):
+        return self.network.parameters()
+
+    def summary(self, input_size=None):
+        return summary(self.network)
+
+
+def summary(network, input_size=None):
+    """Parameter-count summary (reference hapi/model_summary.py)."""
+    total = 0
+    trainable = 0
+    rows = []
+    for name, p in network.named_parameters():
+        n = int(np.prod(p.shape)) if p.shape else 1
+        total += n
+        if getattr(p, "trainable", True):
+            trainable += n
+        rows.append((name, tuple(p.shape), n))
+    return {"total_params": total, "trainable_params": trainable,
+            "layers": rows}

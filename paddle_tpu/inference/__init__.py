@@ -175,6 +175,12 @@ class Predictor:
             inputs = [self._inputs[n] for n in self.get_input_names()]
         arrs = [np.asarray(x) if not hasattr(x, "dtype") else x
                 for x in inputs]
+        if self._cfg._dtype is not None:  # enable_bf16: cast float inputs
+            arrs = [a.astype(self._cfg._dtype)
+                    if np.issubdtype(np.asarray(a).dtype, np.floating) else a
+                    for a in arrs]
+        if self._cfg._device is not None:
+            arrs = [jax.device_put(a, self._cfg._device) for a in arrs]
         out = self._call(*arrs)
         self._outputs = out if isinstance(out, (tuple, list)) else (out,)
         jax.block_until_ready(self._outputs)

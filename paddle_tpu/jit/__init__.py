@@ -222,6 +222,11 @@ class TrainStep:
         # keep the Layer's Parameters pointing at live buffers (the originals
         # were donated into the jit) so eager eval/checkpointing keeps working
         self.sync_to_model()
+        from ..framework import debugger
+
+        if debugger.check_numerics_enabled():
+            debugger.assert_finite({"loss": loss}, "train step loss")
+            debugger.assert_finite(self._params, "parameters after step")
         return Tensor(loss, stop_gradient=True)
 
     def sync_to_model(self):

@@ -1,5 +1,7 @@
-"""paddle_tpu.text — language models (flagship GPT family) + datasets."""
+"""paddle_tpu.text — language models (GPT flagship, BERT, MoE) + datasets."""
+from . import bert  # noqa: F401
 from . import gpt  # noqa: F401
 from . import gpt_hybrid  # noqa: F401
+from . import moe  # noqa: F401
 from .gpt import GPTConfig, gpt_1p3b, gpt_13b  # noqa: F401
 from .gpt_hybrid import build_gpt_train_step  # noqa: F401

@@ -1,0 +1,202 @@
+"""BERT encoder family — functional pytree model (BASELINE config 3).
+
+Reference capability: the BERT-era serving/finetune stack — fused attention
+(operators/fused/multihead_matmul_op.cu), fused_embedding_eltwise_layernorm,
+skip_layernorm (operators/fused/), and python/paddle/nn/layer/transformer.py
+TransformerEncoder.  TPU-first: same stacked-block + lax.scan design as
+text/gpt.py — one compiled block regardless of depth; attention runs the
+Pallas flash kernel when there is no padding mask (causal=False path), XLA
+attention with additive mask otherwise; Megatron shardings via
+``param_shardings`` mirror gpt's.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import attention_array, xla_attention
+from . import gpt as _g
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    ffn_ratio: int = 4
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+    @property
+    def ffn_size(self):
+        return self.ffn_ratio * self.hidden_size
+
+
+def bert_base():
+    return BertConfig()
+
+
+def bert_large():
+    return BertConfig(hidden_size=1024, num_layers=24, num_heads=16)
+
+
+def init_params(cfg: BertConfig, key) -> dict:
+    ks = jax.random.split(key, 12)
+    D, F, L, V = cfg.hidden_size, cfg.ffn_size, cfg.num_layers, cfg.vocab_size
+    s = 0.02
+
+    def nrm(k, shape, std=s):
+        return std * jax.random.normal(k, shape, jnp.float32)
+
+    return {
+        "wte": nrm(ks[0], (V, D)),
+        "wpe": nrm(ks[1], (cfg.max_seq_len, D)),
+        "wtt": nrm(ks[2], (cfg.type_vocab_size, D)),  # token-type embeddings
+        "ln_e_g": jnp.ones((D,), jnp.float32),
+        "ln_e_b": jnp.zeros((D,), jnp.float32),
+        "blocks": {
+            "ln1_g": jnp.ones((L, D), jnp.float32),
+            "ln1_b": jnp.zeros((L, D), jnp.float32),
+            "ln2_g": jnp.ones((L, D), jnp.float32),
+            "ln2_b": jnp.zeros((L, D), jnp.float32),
+            "qkv_w": nrm(ks[3], (L, 3, D, D)),
+            "qkv_b": jnp.zeros((L, 3, D), jnp.float32),
+            "proj_w": nrm(ks[4], (L, D, D), std=s / math.sqrt(2 * L)),
+            "proj_b": jnp.zeros((L, D), jnp.float32),
+            "fc_w": nrm(ks[5], (L, D, F)),
+            "fc_b": jnp.zeros((L, F), jnp.float32),
+            "out_w": nrm(ks[6], (L, F, D), std=s / math.sqrt(2 * L)),
+            "out_b": jnp.zeros((L, D), jnp.float32),
+        },
+        "pool_w": nrm(ks[7], (D, D)),
+        "pool_b": jnp.zeros((D,), jnp.float32),
+        "mlm_w": nrm(ks[8], (D, D)),   # transform before tied decoder
+        "mlm_b": jnp.zeros((D,), jnp.float32),
+        "mlm_ln_g": jnp.ones((D,), jnp.float32),
+        "mlm_ln_b": jnp.zeros((D,), jnp.float32),
+        "mlm_bias": jnp.zeros((V,), jnp.float32),
+        "nsp_w": nrm(ks[9], (D, 2)),
+        "nsp_b": jnp.zeros((2,), jnp.float32),
+    }
+
+
+def param_shardings(cfg: BertConfig, mp="mp", pp=None) -> dict:
+    l = pp
+    return {
+        "wte": P(mp, None),
+        "wpe": P(None, None),
+        "wtt": P(None, None),
+        "ln_e_g": P(None),
+        "ln_e_b": P(None),
+        "blocks": {
+            "ln1_g": P(l, None), "ln1_b": P(l, None),
+            "ln2_g": P(l, None), "ln2_b": P(l, None),
+            "qkv_w": P(l, None, None, mp), "qkv_b": P(l, None, mp),
+            "proj_w": P(l, mp, None), "proj_b": P(l, None),
+            "fc_w": P(l, None, mp), "fc_b": P(l, mp),
+            "out_w": P(l, mp, None), "out_b": P(l, None),
+        },
+        "pool_w": P(None, None), "pool_b": P(None),
+        "mlm_w": P(None, None), "mlm_b": P(None),
+        "mlm_ln_g": P(None), "mlm_ln_b": P(None),
+        "mlm_bias": P(mp),
+        "nsp_w": P(None, None), "nsp_b": P(None),
+    }
+
+
+def _block(x, p, cfg: BertConfig, attn_bias=None, dropout_key=None):
+    """Post-LN BERT block on [B, T, D] (compute dtype)."""
+    B, T, D = x.shape
+    H, hd = cfg.num_heads, cfg.head_dim
+    dt = cfg.dtype
+    drop = cfg.dropout > 0.0 and dropout_key is not None
+    qkv = jnp.einsum("btd,kde->kbte", x, p["qkv_w"].astype(dt)) \
+        + p["qkv_b"].astype(dt)[:, None, None]
+    q = qkv[0].reshape(B, T, H, hd)
+    k = qkv[1].reshape(B, T, H, hd)
+    v = qkv[2].reshape(B, T, H, hd)
+    if attn_bias is None:
+        attn = attention_array(q, k, v, is_causal=False)
+    else:
+        attn = xla_attention(q, k, v, mask=attn_bias)
+    attn = attn.reshape(B, T, D)
+    a = attn @ p["proj_w"].astype(dt) + p["proj_b"].astype(dt)
+    if drop:
+        a = _g._dropout(a, cfg.dropout, jax.random.fold_in(dropout_key, 0))
+    x = _g._layer_norm((x + a).astype(jnp.float32), p["ln1_g"],
+                       p["ln1_b"]).astype(dt)
+    h = jax.nn.gelu(x @ p["fc_w"].astype(dt) + p["fc_b"].astype(dt))
+    h = h @ p["out_w"].astype(dt) + p["out_b"].astype(dt)
+    if drop:
+        h = _g._dropout(h, cfg.dropout, jax.random.fold_in(dropout_key, 1))
+    return _g._layer_norm((x + h).astype(jnp.float32), p["ln2_g"],
+                          p["ln2_b"]).astype(dt)
+
+
+def forward(params, input_ids, cfg: BertConfig, token_type_ids=None,
+            attention_mask=None, key=None):
+    """→ (sequence_output [B,T,D], pooled [B,D]); attention_mask [B,T] 1=keep."""
+    B, T = input_ids.shape
+    dt = cfg.dtype
+    tt = token_type_ids if token_type_ids is not None else jnp.zeros_like(input_ids)
+    x = params["wte"][input_ids] + params["wpe"][:T][None] + params["wtt"][tt]
+    x = _g._layer_norm(x.astype(jnp.float32), params["ln_e_g"],
+                       params["ln_e_b"]).astype(dt)
+    attn_bias = None
+    if attention_mask is not None:
+        attn_bias = jnp.where(attention_mask[:, None, None, :].astype(bool),
+                              0.0, -1e30).astype(jnp.float32)
+
+    blk = lambda x, p, k: _block(x, p, cfg, attn_bias=attn_bias, dropout_key=k)
+    if cfg.remat:
+        blk = jax.checkpoint(blk)
+    keys = (jax.random.split(key, cfg.num_layers) if key is not None
+            else jnp.zeros((cfg.num_layers, 2), jnp.uint32))
+
+    def scan_body(x, pk):
+        p, k = pk
+        return blk(x, p, k if key is not None else None), None
+
+    x, _ = jax.lax.scan(scan_body, x, (params["blocks"], keys))
+    pooled = jnp.tanh(x[:, 0].astype(jnp.float32) @ params["pool_w"]
+                      + params["pool_b"]).astype(dt)
+    return x, pooled
+
+
+def pretrain_loss(params, batch, cfg: BertConfig, key=None):
+    """Masked-LM + next-sentence loss.
+
+    batch: dict(input_ids, token_type_ids, attention_mask, mlm_positions
+    [B,K], mlm_labels [B,K] with -100 = unmasked, nsp_labels [B])."""
+    seq, pooled = forward(params, batch["input_ids"], cfg,
+                          batch.get("token_type_ids"),
+                          batch.get("attention_mask"), key=key)
+    pos = batch["mlm_positions"]
+    hidden = jnp.take_along_axis(seq, pos[..., None], axis=1)  # [B,K,D]
+    h = jax.nn.gelu(hidden.astype(jnp.float32) @ params["mlm_w"]
+                    + params["mlm_b"])
+    h = _g._layer_norm(h, params["mlm_ln_g"], params["mlm_ln_b"])
+    logits = h @ params["wte"].T + params["mlm_bias"]          # [B,K,V]
+    labels = batch["mlm_labels"]
+    valid = labels >= 0
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(lp, jnp.clip(labels, 0)[..., None], axis=-1)[..., 0]
+    mlm = -jnp.sum(ll * valid) / jnp.maximum(jnp.sum(valid), 1)
+    nsp_logits = pooled.astype(jnp.float32) @ params["nsp_w"] + params["nsp_b"]
+    nsp_lp = jax.nn.log_softmax(nsp_logits, axis=-1)
+    nsp = -jnp.mean(jnp.take_along_axis(
+        nsp_lp, batch["nsp_labels"][:, None], axis=-1))
+    return mlm + nsp
