@@ -77,6 +77,8 @@ if not _LIGHT_IMPORT:
     from . import vision  # noqa: F401
     from . import text  # noqa: F401
     from . import inference  # noqa: F401
+    from . import quantization  # noqa: F401
+    from . import sparsity  # noqa: F401
     from . import hapi  # noqa: F401
     from .hapi import Model, summary  # noqa: F401
     from . import profiler  # noqa: F401

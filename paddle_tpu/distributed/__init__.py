@@ -22,7 +22,7 @@ if not _root._LIGHT_IMPORT:
     )
     from .parallel import DataParallel  # noqa: F401
     from .recompute import recompute  # noqa: F401
-    from . import megatron, pipeline  # noqa: F401
+    from . import megatron, pipeline, ps  # noqa: F401
     from .topology import (  # noqa: F401
         CommunicateTopology, HybridCommunicateGroup,
     )

@@ -97,6 +97,7 @@ class TrainerProc:
                 self.proc.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 self.proc.kill()
+                self.proc.wait()  # reap; old proc must release devices/ports
         if self._log:
             self._log.close()
             self._log = None

@@ -1,0 +1,185 @@
+"""Sparse embedding "parameter server" — sharded tables + sparse updates.
+
+Reference capability (§2.4): the brpc parameter server stack —
+``CommonSparseTable`` (distributed/table/common_sparse_table.cc,
+shard-hashed embedding rows with per-row adagrad), ``PSClient``
+pull_sparse/push_sparse (service/ps_client.h), ``TheOnePSRuntime``
+(fleet/runtime/the_one_ps.py), ``distributed_lookup_table`` ops.
+
+TPU-native redesign: there are no separate server processes — the "servers"
+are the devices themselves.  A table is a [V, D] jax.Array row-sharded over
+a mesh axis (the shard-hash role is the sharding); ``pull`` is a sharded
+gather (XLA inserts the comm), ``push`` applies a *sparse* optimizer update
+that touches only the referenced rows via scatter ops — no dense [V, D]
+gradient ever exists, which is the whole point of a PS for 10^8-row
+recommender vocabularies.  Duplicate ids inside a batch are merged exactly
+like the reference's push merge (sort + segment-sum), all with static
+shapes so the update jits.
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _merge_duplicate_ids(ids, grads, vocab_size: int):
+    """Merge per-occurrence grads of duplicate ids (static shapes).
+
+    Returns (slot_ids [N], merged [N, D]) where only the first occurrence of
+    each id keeps its merged gradient and duplicates are redirected to a
+    dummy row ``vocab_size`` (the caller's table carries V+1 rows)."""
+    order = jnp.argsort(ids)
+    s_ids = ids[order]
+    s_g = grads[order]
+    first = jnp.concatenate([jnp.ones((1,), bool), s_ids[1:] != s_ids[:-1]])
+    seg = jnp.cumsum(first) - 1                       # group index per slot
+    merged = jax.ops.segment_sum(s_g, seg, num_segments=ids.shape[0])
+    # group g's merged grad sits at merged[g]; map back to first-occurrence
+    slot_of_group = jax.ops.segment_min(jnp.arange(ids.shape[0]), seg,
+                                        num_segments=ids.shape[0])
+    out_ids = jnp.where(
+        jnp.arange(ids.shape[0]) < seg[-1] + 1,
+        s_ids[slot_of_group.clip(0, ids.shape[0] - 1)], vocab_size)
+    return out_ids, merged
+
+
+class SparseTableState(NamedTuple):
+    """Functional state of one table (pytree)."""
+
+    rows: Any        # [V+1, D]  (+1 dummy row for duplicate-merge scatter)
+    accum: Any       # [V+1] adagrad accumulator (or zeros for sgd)
+
+
+class SparseEmbeddingTable:
+    """Row-sharded embedding table with sparse adagrad/sgd push.
+
+    entry_dim rows sharded P(axis) over the mesh — every device owns a
+    contiguous row shard (the reference's shard-hash placement role).
+    """
+
+    def __init__(self, vocab_size: int, dim: int, mesh: Mesh | None = None,
+                 axis: str | None = "mp", optimizer: str = "adagrad",
+                 lr: float = 0.05, initializer_std: float = 0.01,
+                 seed: int = 0):
+        self.vocab_size = vocab_size
+        self.dim = dim
+        self.optimizer = optimizer
+        self.lr = lr
+        self.mesh = mesh
+        n_shards = (mesh.shape.get(axis, 1) if mesh is not None else 1)
+        # +1 dummy row for the duplicate-merge scatter, padded up so every
+        # shard holds the same number of rows
+        self._rows_total = -((vocab_size + 1) // -n_shards) * n_shards
+        spec = P(axis, None) if (mesh is not None and
+                                 mesh.shape.get(axis, 1) > 1) else P()
+        self._sharding = (NamedSharding(mesh, spec) if mesh is not None
+                          else None)
+        acc_spec = P(spec[0]) if spec else P()
+        self._acc_sharding = (NamedSharding(mesh, acc_spec)
+                              if mesh is not None else None)
+
+        def init(key):
+            rows = initializer_std * jax.random.normal(
+                key, (self._rows_total, dim), jnp.float32)
+            rows = jnp.where(
+                (jnp.arange(self._rows_total) < vocab_size)[:, None], rows, 0.0)
+            return SparseTableState(rows, jnp.zeros((self._rows_total,),
+                                                    jnp.float32))
+
+        if self._sharding is not None:
+            self.state = jax.jit(
+                init, out_shardings=SparseTableState(
+                    self._sharding, self._acc_sharding))(
+                jax.random.PRNGKey(seed))
+        else:
+            self.state = init(jax.random.PRNGKey(seed))
+
+        self._pull = jax.jit(lambda st, ids: st.rows[ids])
+        self._push = jax.jit(self._push_impl, donate_argnums=(0,))
+
+    # -- client API (pull_sparse / push_sparse) -----------------------------
+    def pull(self, ids):
+        """ids [...,] int32 → embeddings [..., D] (the pull_sparse role)."""
+        return self._pull(self.state, jnp.asarray(ids))
+
+    def push(self, ids, grads, lr: float | None = None):
+        """Apply merged sparse gradients to the touched rows only."""
+        ids = jnp.asarray(ids).reshape(-1)
+        grads = jnp.asarray(grads).reshape(-1, self.dim)
+        self.state = self._push(self.state, ids, grads,
+                                jnp.asarray(lr if lr is not None else self.lr,
+                                            jnp.float32))
+        return self
+
+    def _push_impl(self, st: SparseTableState, ids, grads, lr):
+        slot_ids, merged = _merge_duplicate_ids(ids, grads, self.vocab_size)
+        if self.optimizer == "adagrad":
+            g2 = jnp.sum(merged * merged, axis=-1) / self.dim
+            accum = st.accum.at[slot_ids].add(g2)
+            denom = jnp.sqrt(accum[slot_ids])[:, None] + 1e-8
+            rows = st.rows.at[slot_ids].add(-lr * merged / denom)
+            return SparseTableState(rows, accum)
+        rows = st.rows.at[slot_ids].add(-lr * merged)  # plain sgd
+        return SparseTableState(rows, st.accum)
+
+    # -- embedding-layer style forward with autograd ------------------------
+    def lookup_and_grad_fn(self, ids):
+        """Returns (embeddings, push_fn) where push_fn(d_embeddings[, lr])
+        applies the sparse update — the distributed_lookup_table fwd/bwd
+        pair as an explicit functional handshake."""
+        emb = self.pull(ids)
+
+        def push_fn(d_emb, lr=None):
+            self.push(ids, d_emb, lr)
+
+        return emb, push_fn
+
+    # -- persistence (fleet.save_persistables for tables) -------------------
+    def save(self, dirname: str, step: int = 0):
+        from ..framework.checkpoint import save_sharded
+
+        save_sharded({"rows": self.state.rows, "accum": self.state.accum},
+                     dirname, step)
+
+    def load(self, dirname: str, step: int = 0):
+        from ..framework.checkpoint import load_sharded
+
+        out = load_sharded(dirname, step,
+                           {"rows": self.state.rows,
+                            "accum": self.state.accum})
+        self.state = SparseTableState(out["rows"], out["accum"])
+        return self
+
+
+class TheOnePS:
+    """Table registry + facade (TheOnePSRuntime / PSClient role)."""
+
+    def __init__(self, mesh: Mesh | None = None):
+        self.mesh = mesh
+        self._tables: dict[int, SparseEmbeddingTable] = {}
+
+    def create_table(self, table_id: int, vocab_size: int, dim: int, **kw):
+        t = SparseEmbeddingTable(vocab_size, dim, mesh=self.mesh, **kw)
+        self._tables[table_id] = t
+        return t
+
+    def table(self, table_id: int) -> SparseEmbeddingTable:
+        return self._tables[table_id]
+
+    def pull_sparse(self, table_id: int, ids):
+        return self._tables[table_id].pull(ids)
+
+    def push_sparse(self, table_id: int, ids, grads, lr=None):
+        return self._tables[table_id].push(ids, grads, lr)
+
+    def save(self, dirname: str):
+        for tid, t in self._tables.items():
+            t.save(f"{dirname}/table_{tid}")
+
+    def load(self, dirname: str):
+        for tid, t in self._tables.items():
+            t.load(f"{dirname}/table_{tid}")

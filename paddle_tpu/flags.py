@@ -33,12 +33,14 @@ _REGISTRY: dict[str, Any] = {
     "FLAGS_allocator_strategy": "pjrt",  # informational: PJRT owns HBM
 }
 
-# env seeding, like the reference's FLAGS_* env support
+# env seeding, like the reference's FLAGS_* env support — routed through
+# set_flags below so JAX-mapped flags actually take effect
+_ENV_SEEDED = {}
 for _k in list(_REGISTRY):
     if _k in os.environ:
         v = os.environ[_k]
-        _REGISTRY[_k] = {"true": True, "false": False, "1": True,
-                         "0": False}.get(v.lower(), v)
+        _ENV_SEEDED[_k] = {"true": True, "false": False, "1": True,
+                           "0": False}.get(v.lower(), v)
 
 
 def set_flags(flags: Mapping[str, Any]):
@@ -61,3 +63,7 @@ def get_flags(flags: str | Iterable[str]):
 def flag(name: str, default=None):
     """Internal accessor used by framework code."""
     return _REGISTRY.get(name, default)
+
+
+if _ENV_SEEDED:
+    set_flags(_ENV_SEEDED)

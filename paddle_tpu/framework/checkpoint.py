@@ -118,6 +118,15 @@ def load_sharded(ckpt_dir: str, step: int, target: Any):
     d = os.path.join(ckpt_dir, f"step_{step}")
     with open(os.path.join(d, "manifest.json")) as f:
         manifest = json.load(f)
+    # multi-host saves: union every per-process manifest's chunk lists so a
+    # loader sees ALL shards, not just the finalizing process's own
+    for fn in os.listdir(d):
+        if fn.startswith("manifest.") and fn != "manifest.json":
+            with open(os.path.join(d, fn)) as f:
+                part = json.load(f)
+            for name, meta in part["leaves"].items():
+                manifest["leaves"].setdefault(name, meta)
+                manifest["leaves"][name]["chunks"].update(meta["chunks"])
     names, leaves, treedef = _flatten(target)
     out = []
     for name, leaf in zip(names, leaves):

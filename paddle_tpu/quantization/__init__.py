@@ -1,0 +1,31 @@
+"""Quantization: QAT (fake-quant) + PTQ (post-training calibration).
+
+Reference capability: python/paddle/fluid/contrib/slim/quantization —
+``quantization_pass.py`` (fake-quant op insertion), ``imperative/qat.py``
+(dygraph QAT layer swapping), ``post_training_quantization.py`` + KL
+threshold calibration (``cal_kl_threshold.py``); quantized layers
+python/paddle/nn/quant/quant_layers.py.
+
+TPU-native: there is no int8 engine to hand kernels to — XLA takes int8
+matmuls natively — so quantization is expressed functionally:
+  * ``FakeQuant`` — straight-through-estimator quantize/dequantize, fused by
+    XLA into the surrounding ops (the fake_quantize_abs_max op role);
+  * ``QAT.quantize(layer)`` — swaps Linear/Conv2D sublayers for quantized
+    twins that fake-quant weights + activations during training;
+  * ``PostTrainingQuantization`` — runs calibration batches, collects
+    activation histograms, picks per-tensor thresholds (abs-max or KL), and
+    returns a state_dict of int8 weights + scales.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .quant_layers import FakeQuant, QuantedConv2D, QuantedLinear, fake_quant
+from .qat import QAT, ImperativeQuantAware
+from .ptq import PostTrainingQuantization, kl_threshold
+
+__all__ = [
+    "FakeQuant", "fake_quant", "QuantedLinear", "QuantedConv2D",
+    "QAT", "ImperativeQuantAware",
+    "PostTrainingQuantization", "kl_threshold",
+]

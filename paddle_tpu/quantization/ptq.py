@@ -1,0 +1,150 @@
+"""Post-training quantization: calibration + threshold search + int8 export.
+
+Reference: slim/quantization/post_training_quantization.py (runs sample
+batches through the model collecting activation statistics) and
+cal_kl_threshold.py (KL-divergence threshold search over the activation
+histogram — the classic TensorRT-style calibration).
+"""
+from __future__ import annotations
+
+from typing import Callable, Iterable
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer.common import Linear
+from ..nn.layer.conv import Conv2D
+from ..nn.layer_base import Layer
+
+
+def kl_threshold(hist: np.ndarray, bin_width: float, bits: int = 8) -> float:
+    """Pick the clip threshold minimizing KL(P || quantized-P).
+
+    hist: histogram of |activation| values.  Returns the threshold value
+    (reference cal_kl_threshold.py algorithm, re-derived)."""
+    n_quant = 2 ** (bits - 1)  # positive quantization levels
+    total = hist.sum()
+    if total == 0:
+        return bin_width * len(hist)
+    best_kl, best_i = np.inf, len(hist)
+    for i in range(n_quant, len(hist) + 1):
+        # reference P: clip everything beyond bin i into bin i-1 (the clip
+        # spike); candidate Q is built from the UNCLIPPED bins — the KL then
+        # trades clipping error (small i) against quantization coarseness
+        # (large i)
+        raw = hist[:i].astype(np.float64)
+        p = raw.copy()
+        p[i - 1] += hist[i:].sum()
+        chunk = i / n_quant
+        q = np.zeros(i)
+        for j in range(n_quant):
+            a, b = int(np.floor(j * chunk)), int(np.ceil((j + 1) * chunk))
+            b = min(b, i)
+            mass = raw[a:b].sum()
+            nz = (raw[a:b] > 0).sum()
+            if nz:
+                q[a:b] = np.where(raw[a:b] > 0, mass / nz, 0)
+        pm = p / p.sum()
+        qs = q.sum()
+        if qs == 0:
+            continue
+        qm = q / qs
+        mask = pm > 0
+        kl = float(np.sum(pm[mask] * np.log(pm[mask] /
+                                            np.maximum(qm[mask], 1e-12))))
+        if kl < best_kl:
+            best_kl, best_i = kl, i
+    return best_i * bin_width
+
+
+class _Observer:
+    def __init__(self, algo: str, bins: int = 2048):
+        self.algo = algo
+        self.bins = bins
+        self.abs_max = 0.0
+        self.hist = None
+        self.bin_width = None
+
+    def observe(self, arr: np.ndarray):
+        a = np.abs(arr).ravel()
+        m = float(a.max()) if a.size else 0.0
+        self.abs_max = max(self.abs_max, m)
+        if self.algo == "KL":
+            if self.hist is None:
+                self.bin_width = max(self.abs_max, 1e-8) / self.bins
+                self.hist = np.zeros(self.bins)
+            # widen the histogram when later batches exceed its range (merge
+            # existing bins by an integer factor) instead of saturating the
+            # last bin — a tiny first batch must not poison calibration
+            if m > self.bins * self.bin_width:
+                factor = int(np.ceil(m / (self.bins * self.bin_width)))
+                pad = (-len(self.hist)) % factor
+                h = np.pad(self.hist, (0, pad))
+                self.hist = np.zeros(self.bins)
+                coarse = h.reshape(-1, factor).sum(-1)
+                self.hist[: len(coarse)] = coarse
+                self.bin_width *= factor
+            bw = self.bin_width
+            idx = np.minimum((a / bw).astype(np.int64), self.bins - 1)
+            self.hist += np.bincount(idx, minlength=self.bins)
+
+    def threshold(self, bits: int = 8) -> float:
+        if self.algo == "KL" and self.hist is not None:
+            return kl_threshold(self.hist, self.bin_width, bits)
+        return max(self.abs_max, 1e-8)
+
+
+class PostTrainingQuantization:
+    """Calibrate a Layer on sample data, then export int8 weights + scales.
+
+    algo: 'abs_max' | 'KL' (activation thresholds).
+    """
+
+    def __init__(self, model: Layer, data_loader: Iterable, algo: str = "KL",
+                 bits: int = 8):
+        self.model = model
+        self.loader = data_loader
+        self.algo = algo
+        self.bits = bits
+        self.act_scales: dict[str, float] = {}
+
+    def _quantizable(self):
+        for name, layer in self.model.named_sublayers():
+            if isinstance(layer, (Linear, Conv2D)):
+                yield name, layer
+
+    def quantize(self) -> dict:
+        # 1) calibration: forward hooks observe each quantizable layer's input
+        observers = {name: _Observer(self.algo)
+                     for name, _ in self._quantizable()}
+        handles = []
+        for name, layer in self._quantizable():
+            def hook(lyr, inputs, _name=name):
+                x = inputs[0] if isinstance(inputs, (tuple, list)) else inputs
+                observers[_name].observe(np.asarray(
+                    x.value if isinstance(x, Tensor) else x))
+
+            handles.append(layer.register_forward_pre_hook(hook))
+        self.model.eval()
+        try:
+            for batch in self.loader:
+                xs = batch[0] if isinstance(batch, (tuple, list)) else batch
+                self.model(Tensor(np.asarray(xs), stop_gradient=True))
+        finally:
+            for h in handles:
+                h.remove()
+            self.model.train()
+
+        # 2) thresholds + int8 weights
+        qmax = 2 ** (self.bits - 1) - 1
+        out = {"bits": self.bits, "act_scales": {}, "weights": {},
+               "weight_scales": {}}
+        for name, layer in self._quantizable():
+            out["act_scales"][name] = observers[name].threshold(self.bits)
+            w = np.asarray(layer.weight.value)
+            scale = max(float(np.abs(w).max()), 1e-8)
+            out["weight_scales"][name] = scale
+            out["weights"][name] = np.clip(
+                np.round(w / scale * qmax), -qmax, qmax).astype(np.int8)
+        self.act_scales = out["act_scales"]
+        return out

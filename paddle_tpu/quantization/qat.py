@@ -1,0 +1,31 @@
+"""Quantization-aware training: swap Linear/Conv2D for quantized twins.
+
+Reference: slim/quantization/imperative/qat.py ``ImperativeQuantAware``
+(.quantize(model) walks sublayers and replaces them in-place).
+"""
+from __future__ import annotations
+
+from ..nn.layer.common import Linear
+from ..nn.layer.conv import Conv2D
+from .quant_layers import QuantedConv2D, QuantedLinear
+
+
+class ImperativeQuantAware:
+    def __init__(self, bits: int = 8,
+                 quantizable_layer_type=("Linear", "Conv2D")):
+        self.bits = bits
+        self.types = set(quantizable_layer_type)
+
+    def quantize(self, model):
+        """In-place: replace each quantizable sublayer with its twin."""
+        for name, child in list(model.named_children()):
+            if isinstance(child, Linear) and "Linear" in self.types:
+                setattr(model, name, QuantedLinear(child, self.bits))
+            elif isinstance(child, Conv2D) and "Conv2D" in self.types:
+                setattr(model, name, QuantedConv2D(child, self.bits))
+            else:
+                self.quantize(child)
+        return model
+
+
+QAT = ImperativeQuantAware

@@ -1,0 +1,125 @@
+"""Fake-quant primitives + quantized layer twins.
+
+Reference: fake_quantize_abs_max / moving_average_abs_max ops
+(operators/fake_quantize_op.cc) and nn/quant/quant_layers.py QuantedLinear /
+QuantedConv2D.  Straight-through estimator: rounding is identity in the
+backward (custom_vjp), so QAT gradients flow as if unquantized.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _ste_quant_dequant(x, scale, bits):
+    qmax = 2.0 ** (bits - 1) - 1
+    q = jnp.clip(jnp.round(x / scale * qmax), -qmax, qmax)
+    return q * scale / qmax
+
+
+def _ste_fwd(x, scale, bits):
+    return _ste_quant_dequant(x, scale, bits), (x, scale)
+
+
+def _ste_bwd(bits, res, g):
+    x, scale = res
+    qmax = 2.0 ** (bits - 1) - 1
+    inside = (jnp.abs(x) <= scale).astype(g.dtype)
+    return g * inside, jnp.zeros_like(scale)  # STE; clip region passes no grad
+
+
+_ste_quant_dequant.defvjp(_ste_fwd, _ste_bwd)
+
+
+def fake_quant(x, scale=None, bits: int = 8):
+    """Array-level quantize→dequantize with STE backward (abs-max scale)."""
+    if scale is None:
+        scale = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8)
+    return _ste_quant_dequant(x, scale, bits)
+
+
+class FakeQuant(Layer):
+    """Activation fake-quant with moving-average abs-max scale (the
+    moving_average_abs_max op's role)."""
+
+    def __init__(self, bits: int = 8, momentum: float = 0.9):
+        super().__init__()
+        self.bits = bits
+        self.momentum = momentum
+        self.register_buffer("scale", Tensor(jnp.ones(()), stop_gradient=True))
+
+    def forward(self, x):
+        def fn(xv, scale):
+            cur = jnp.maximum(jnp.max(jnp.abs(xv)), 1e-8).astype(jnp.float32)
+            new_scale = self.momentum * scale + (1 - self.momentum) * cur
+            return _ste_quant_dequant(xv, new_scale.astype(xv.dtype),
+                                      self.bits), new_scale
+
+        out, new_scale = dispatch(fn, x, self.scale, op_name="fake_quant")
+        if self.training:
+            self.scale._value = new_scale.value
+        return out
+
+
+class QuantedLinear(Layer):
+    """Linear with fake-quant weights + activations (QAT twin)."""
+
+    def __init__(self, inner, bits: int = 8):
+        super().__init__()
+        self.weight = inner.weight
+        self.bias = getattr(inner, "bias", None)
+        self.bits = bits
+        self.act_quant = FakeQuant(bits)
+
+    def forward(self, x):
+        x = self.act_quant(x)
+
+        def fn(xv, w, *b):
+            wq = fake_quant(w, bits=self.bits)
+            y = xv @ wq
+            if b:
+                y = y + b[0]
+            return y
+
+        args = (x, self.weight) + ((self.bias,) if self.bias is not None else ())
+        return dispatch(fn, *args, op_name="quanted_linear")
+
+
+class QuantedConv2D(Layer):
+    """Conv2D with fake-quant weights + activations (QAT twin).  Adopts the
+    inner conv's Parameters so gradients reach the ORIGINAL weights through
+    the STE inside one dispatch."""
+
+    def __init__(self, inner, bits: int = 8):
+        super().__init__()
+        self.weight = inner.weight
+        self.bias = getattr(inner, "bias", None)
+        self._stride = inner.stride
+        self._padding = inner.padding
+        self._dilation = inner.dilation
+        self._groups = inner.groups
+        self._data_format = inner.data_format
+        self.bits = bits
+        self.act_quant = FakeQuant(bits)
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        x = self.act_quant(x)
+        args = (x, self.weight) + ((self.bias,) if self.bias is not None else ())
+
+        def fn(xv, w, *b):
+            wq = fake_quant(w, bits=self.bits)
+            return F._conv_nd(xv, wq, b[0] if b else None, self._stride,
+                              self._padding, self._dilation, self._groups, 2,
+                              self._data_format)
+
+        return dispatch(fn, *args, op_name="quanted_conv2d")

@@ -1,0 +1,108 @@
+"""ASP — automatic 2:4 structured sparsity.
+
+Reference capability: python/paddle/fluid/contrib/sparsity — ``ASPHelper``
+(asp.py:200), ``sparsity.decorate(optimizer)`` (asp.py:55): compute 2:4
+masks over supported weights, zero them, and keep the masks applied through
+every optimizer update; ``calculate_density``, mask-checking utilities.
+
+TPU note: the MXU has no 2:4 sparse mode (that is an Ampere tensor-core
+feature), so here ASP is a *model-compression* capability: masks shrink the
+checkpoint/serving footprint and the pruned weights stay exactly zero
+through training, which XLA exploits via constant folding where it can.
+"""
+from __future__ import annotations
+
+from typing import Iterable
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def compute_mask_2d(w: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
+    """n:m mask along the last axis: keep the n largest-|w| of every m."""
+    shape = w.shape
+    flat = np.abs(w.reshape(-1, shape[-1]))
+    pad = (-flat.shape[-1]) % m
+    if pad:
+        flat = np.pad(flat, ((0, 0), (0, pad)))
+    groups = flat.reshape(flat.shape[0], -1, m)
+    kth = np.argsort(groups, axis=-1)  # ascending
+    mask = np.zeros_like(groups, dtype=bool)
+    np.put_along_axis(mask, kth[..., -n:], True, axis=-1)
+    mask = mask.reshape(flat.shape[0], -1)[:, : shape[-1]]
+    return mask.reshape(shape)
+
+
+def calculate_density(w) -> float:
+    a = np.asarray(w)
+    return float((a != 0).sum() / a.size)
+
+
+def check_mask_2d(w, n: int = 2, m: int = 4) -> bool:
+    """True if every m-group along the last axis has ≤ n non-zeros."""
+    a = np.abs(np.asarray(w)).reshape(-1, np.asarray(w).shape[-1])
+    pad = (-a.shape[-1]) % m
+    if pad:
+        a = np.pad(a, ((0, 0), (0, pad)))
+    g = a.reshape(a.shape[0], -1, m)
+    return bool(((g != 0).sum(-1) <= n).all())
+
+
+class ASPHelper:
+    """Holds masks per parameter and re-applies them after updates."""
+
+    def __init__(self, n: int = 2, m: int = 4):
+        self.n, self.m = n, m
+        self._masks: dict[int, jnp.ndarray] = {}
+
+    def _supported(self, p: Tensor) -> bool:
+        return p.ndim >= 2 and p.shape[-1] % self.m == 0
+
+    def prune_model(self, model):
+        """Compute + apply 2:4 masks on all supported weights."""
+        for name, p in model.named_parameters():
+            if not self._supported(p):
+                continue
+            mask = compute_mask_2d(np.asarray(p.value), self.n, self.m)
+            mj = jnp.asarray(mask, p.value.dtype)
+            self._masks[id(p)] = mj
+            p._value = p.value * mj
+        return self
+
+    def apply_masks(self, params: Iterable[Tensor]):
+        for p in params:
+            mj = self._masks.get(id(p))
+            if mj is not None:
+                p._value = p.value * mj
+
+    def decorate(self, optimizer):
+        """Wrap optimizer.step so masks survive every update
+        (sparsity.decorate analog)."""
+        helper = self
+        orig_step = optimizer.step
+
+        def step():
+            orig_step()
+            helper.apply_masks(optimizer._params())
+
+        optimizer.step = step
+        optimizer._asp_helper = helper
+        return optimizer
+
+
+_default_helper: ASPHelper | None = None
+
+
+def prune_model(model, n: int = 2, m: int = 4):
+    global _default_helper
+    _default_helper = ASPHelper(n, m).prune_model(model)
+    return model
+
+
+def decorate(optimizer):
+    global _default_helper
+    if _default_helper is None:
+        _default_helper = ASPHelper()
+    return _default_helper.decorate(optimizer)

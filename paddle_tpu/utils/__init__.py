@@ -1,0 +1,2 @@
+"""paddle_tpu.utils — interop + extension toolchain."""
+from . import cpp_extension, dlpack  # noqa: F401

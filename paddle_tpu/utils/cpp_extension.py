@@ -1,0 +1,75 @@
+"""C++ custom-op toolchain (reference python/paddle/utils/cpp_extension —
+JIT-compile user C++ into a loadable module; PD_BUILD_OP ABI in
+fluid/extension/).
+
+TPU-native shape: custom device kernels are **Pallas** (Python-defined), so
+the C++ extension path targets HOST-side ops — data transforms, IO,
+tokenizers — compiled with the same lazy g++ flow as paddle_tpu/_native and
+bound via ctypes.  ``load(name, sources)`` compiles + dlopens; the returned
+CDLL is the module (declare restype/argtypes per function, or use
+``CustomOpLibrary`` for numpy-array signatures).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_LOCK = threading.Lock()
+_CACHE: dict = {}
+
+
+def load(name: str, sources, extra_cxx_flags=(), build_directory=None):
+    """Compile C++ sources into <build_directory>/<name>.so and dlopen it."""
+    import hashlib
+
+    key = (name, tuple(sources), tuple(extra_cxx_flags))
+    with _LOCK:
+        if key in _CACHE:
+            return _CACHE[key]
+        bdir = build_directory or os.path.join(
+            os.path.expanduser("~"), ".cache", "paddle_tpu_extensions")
+        os.makedirs(bdir, exist_ok=True)
+        # .so name carries a digest of sources+flags: same `name` with
+        # different inputs must never reuse a stale artifact
+        digest = hashlib.sha256(
+            "\0".join([*map(os.fspath, sources),
+                       *extra_cxx_flags]).encode()).hexdigest()[:12]
+        out = os.path.join(bdir, f"{name}-{digest}.so")
+        srcs = [os.fspath(s) for s in sources]
+        newest = max(os.path.getmtime(s) for s in srcs)
+        if not (os.path.exists(out) and os.path.getmtime(out) >= newest):
+            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+                   *extra_cxx_flags, *srcs, "-o", out + ".tmp"]
+            r = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=600)
+            if r.returncode != 0:
+                raise RuntimeError(f"cpp_extension build failed:\n{r.stderr}")
+            os.replace(out + ".tmp", out)
+        lib = ctypes.CDLL(out)
+        _CACHE[key] = lib
+        return lib
+
+
+class CustomOpLibrary:
+    """Convenience wrapper: call exported C functions with numpy arrays.
+
+    Functions must take (const double* in, int64 n, double* out) — enough
+    for elementwise host ops; richer signatures use the raw CDLL from
+    ``load``."""
+
+    def __init__(self, name: str, sources, **kw):
+        self._lib = load(name, sources, **kw)
+
+    def elementwise(self, fn_name: str, x: np.ndarray) -> np.ndarray:
+        fn = getattr(self._lib, fn_name)
+        fn.argtypes = [ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+                       ctypes.POINTER(ctypes.c_double)]
+        xin = np.ascontiguousarray(x, np.float64)
+        out = np.empty_like(xin)
+        fn(xin.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), xin.size,
+           out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        return out.reshape(x.shape)

@@ -1,0 +1,107 @@
+"""Sparse embedding PS tables (reference CommonSparseTable / PSClient tests
+analog: brpc_service_dense_sgd_test.cc, distributed_lookup_table)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from paddle_tpu.distributed.ps import (SparseEmbeddingTable, TheOnePS,
+                                       _merge_duplicate_ids)
+
+
+def mesh_of(n, name="mp"):
+    return Mesh(np.array(jax.devices()[:n]), (name,))
+
+
+def test_merge_duplicate_ids():
+    ids = jnp.asarray([5, 3, 5, 7, 3, 5], jnp.int32)
+    g = jnp.arange(6, dtype=jnp.float32)[:, None] * jnp.ones((1, 2))
+    out_ids, merged = _merge_duplicate_ids(ids, g, vocab_size=10)
+    got = {}
+    for i, mid in enumerate(np.asarray(out_ids)):
+        if mid < 10:
+            got[int(mid)] = float(np.asarray(merged)[i][0])
+    assert got == {3: 1.0 + 4.0, 5: 0.0 + 2.0 + 5.0, 7: 3.0}
+
+
+def test_pull_push_sgd_matches_dense():
+    t = SparseEmbeddingTable(16, 4, optimizer="sgd", lr=0.1, seed=0)
+    dense = np.asarray(t.state.rows).copy()
+    ids = np.asarray([2, 5, 2], np.int32)
+    g = np.asarray(np.random.default_rng(0).normal(size=(3, 4)), np.float32)
+    emb = t.pull(ids)
+    np.testing.assert_allclose(emb, dense[ids], rtol=1e-6)
+    t.push(ids, g)
+    want = dense.copy()
+    for i, idx in enumerate(ids):
+        want[idx] -= 0.1 * g[i]
+    np.testing.assert_allclose(np.asarray(t.state.rows)[:16], want[:16],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_adagrad_denominator_grows():
+    t = SparseEmbeddingTable(8, 4, optimizer="adagrad", lr=1.0, seed=0)
+    ids = np.asarray([1], np.int32)
+    g = np.ones((1, 4), np.float32)
+    r0 = np.asarray(t.state.rows)[1].copy()
+    t.push(ids, g)
+    step1 = np.abs(np.asarray(t.state.rows)[1] - r0).max()
+    r1 = np.asarray(t.state.rows)[1].copy()
+    t.push(ids, g)
+    step2 = np.abs(np.asarray(t.state.rows)[1] - r1).max()
+    assert step2 < step1  # accumulator dampens later updates
+    # untouched rows identical
+    assert np.asarray(t.state.accum)[2] == 0.0
+
+
+def test_sharded_table_over_mesh():
+    mesh = mesh_of(8)
+    t = SparseEmbeddingTable(64, 8, mesh=mesh, axis="mp", optimizer="sgd",
+                             lr=0.5)
+    ids = np.asarray([0, 17, 63, 17], np.int32)
+    emb = t.pull(ids)
+    assert emb.shape == (4, 8)
+    before = np.asarray(t.state.rows).copy()
+    g = np.ones((4, 8), np.float32)
+    t.push(ids, g)
+    after = np.asarray(t.state.rows)
+    # 17 appears twice -> merged grad 2.0
+    np.testing.assert_allclose(after[17], before[17] - 0.5 * 2.0, rtol=1e-5)
+    np.testing.assert_allclose(after[0], before[0] - 0.5, rtol=1e-5)
+    np.testing.assert_allclose(after[5], before[5])  # untouched
+    # sharding preserved through the donated update
+    assert t.state.rows.sharding.spec == t._sharding.spec
+
+
+def test_the_one_ps_save_load(tmp_path):
+    ps = TheOnePS()
+    ps.create_table(0, 32, 4, optimizer="sgd", lr=0.1)
+    ids = np.asarray([1, 2], np.int32)
+    ps.push_sparse(0, ids, np.ones((2, 4), np.float32))
+    want = np.asarray(ps.table(0).state.rows).copy()
+    ps.save(str(tmp_path))
+    ps2 = TheOnePS()
+    ps2.create_table(0, 32, 4, optimizer="sgd", lr=0.1, seed=99)
+    ps2.load(str(tmp_path))
+    np.testing.assert_allclose(np.asarray(ps2.table(0).state.rows), want)
+
+
+def test_lookup_and_grad_roundtrip():
+    """End-to-end: embedding lookup feeding a dense model, sparse backward."""
+    t = SparseEmbeddingTable(32, 4, optimizer="sgd", lr=0.1, seed=0)
+    ids = jnp.asarray([3, 9, 3], jnp.int32)
+    w = jnp.ones((4, 1), jnp.float32)
+    emb, push_fn = t.lookup_and_grad_fn(ids)
+
+    def loss_of(emb):
+        return jnp.sum((emb @ w) ** 2)
+
+    loss, d_emb = jax.value_and_grad(loss_of)(emb)
+    before = np.asarray(t.state.rows).copy()
+    push_fn(d_emb)
+    after = np.asarray(t.state.rows)
+    assert not np.allclose(after[3], before[3])
+    assert not np.allclose(after[9], before[9])
+    np.testing.assert_allclose(after[4], before[4])

@@ -1,0 +1,123 @@
+"""QAT/PTQ quantization + ASP 2:4 sparsity (reference slim/quantization and
+contrib/sparsity test analogs)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import sparsity
+from paddle_tpu.quantization import (ImperativeQuantAware,
+                                     PostTrainingQuantization, QuantedConv2D,
+                                     QuantedLinear, fake_quant, kl_threshold)
+
+
+class TestFakeQuant:
+    def test_quant_dequant_error_bounded(self):
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(64,)), jnp.float32)
+        y = fake_quant(x, bits=8)
+        scale = float(jnp.max(jnp.abs(x)))
+        assert float(jnp.max(jnp.abs(y - x))) <= scale / 127 + 1e-6
+
+    def test_ste_gradient_passthrough(self):
+        x = jnp.linspace(-1.0, 1.0, 16)
+        g = jax.grad(lambda v: jnp.sum(fake_quant(v, scale=2.0)))(x)
+        np.testing.assert_allclose(g, np.ones(16), atol=1e-6)  # inside clip
+
+    def test_ste_gradient_clipped_region(self):
+        x = jnp.asarray([0.5, 3.0])  # 3.0 outside scale=1
+        g = jax.grad(lambda v: jnp.sum(fake_quant(v, scale=1.0)))(x)
+        np.testing.assert_allclose(g, [1.0, 0.0], atol=1e-6)
+
+
+class TestQAT:
+    def test_swaps_and_trains(self):
+        net = paddle.nn.Sequential(
+            paddle.nn.Linear(8, 16), paddle.nn.ReLU(), paddle.nn.Linear(16, 2))
+        ImperativeQuantAware(bits=8).quantize(net)
+        assert isinstance(net[0], QuantedLinear)
+        assert isinstance(net[2], QuantedLinear)
+        opt = paddle.optimizer.Adam(1e-2, parameters=net.parameters())
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.normal(size=(32, 8)).astype(np.float32))
+        y = paddle.to_tensor(rng.integers(0, 2, (32,)))
+        losses = []
+        for _ in range(10):
+            loss = F.cross_entropy(net(x), y)
+            loss.backward()
+            opt.step(); opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+    def test_conv_qat_lenet(self):
+        net = paddle.vision.models.LeNet()
+        ImperativeQuantAware().quantize(net)
+        quanted = [type(l).__name__ for _, l in net.named_sublayers()]
+        assert "QuantedConv2D" in quanted and "QuantedLinear" in quanted
+        x = paddle.to_tensor(np.random.default_rng(0).normal(
+            size=(2, 1, 28, 28)).astype(np.float32))
+        y = paddle.to_tensor(np.array([1, 2]))
+        loss = F.cross_entropy(net(x), y)
+        loss.backward()
+        # conv weight grads flow through the STE
+        g = net.features[0].weight.grad
+        assert g is not None and float(np.abs(np.asarray(g.value)).sum()) > 0
+
+
+class TestPTQ:
+    def test_kl_threshold_sane(self):
+        rng = np.random.default_rng(0)
+        vals = np.abs(rng.normal(0, 1, 100000))
+        hist, edges = np.histogram(vals, bins=2048, range=(0, vals.max()))
+        th = kl_threshold(hist, edges[1] - edges[0])
+        assert 1.0 < th <= vals.max() + 1e-6  # clips the long tail
+
+    def test_ptq_quantize(self):
+        net = paddle.nn.Sequential(paddle.nn.Linear(8, 8), paddle.nn.ReLU(),
+                                   paddle.nn.Linear(8, 4))
+        rng = np.random.default_rng(0)
+        loader = [(rng.normal(size=(16, 8)).astype(np.float32),)
+                  for _ in range(4)]
+        ptq = PostTrainingQuantization(net, loader, algo="abs_max")
+        res = ptq.quantize()
+        assert set(res["weights"]) == set(res["act_scales"])
+        for name, w8 in res["weights"].items():
+            assert w8.dtype == np.int8
+            # dequantized weight close to original
+            w = np.asarray(dict(net.named_sublayers())[name].weight.value)
+            deq = w8.astype(np.float32) * res["weight_scales"][name] / 127
+            assert np.abs(deq - w).max() <= res["weight_scales"][name] / 127 + 1e-6
+
+
+class TestASP:
+    def test_mask_2_4(self):
+        w = np.random.default_rng(0).normal(size=(8, 16)).astype(np.float32)
+        mask = sparsity.compute_mask_2d(w)
+        assert mask.shape == w.shape
+        assert sparsity.check_mask_2d(w * mask)
+        assert abs(sparsity.calculate_density(w * mask) - 0.5) < 1e-6
+        # kept entries are the group-wise largest
+        g = np.abs(w.reshape(8, 4, 4))
+        kept = np.abs((w * mask).reshape(8, 4, 4))
+        assert (kept.sum(-1) >= np.sort(g, -1)[..., -2:].sum(-1) - 1e-6).all()
+
+    def test_decorate_keeps_sparsity_through_training(self):
+        net = paddle.nn.Sequential(paddle.nn.Linear(16, 16), paddle.nn.ReLU(),
+                                   paddle.nn.Linear(16, 2))
+        sparsity.prune_model(net)
+        opt = sparsity.decorate(
+            paddle.optimizer.SGD(0.1, parameters=net.parameters()))
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.normal(size=(8, 16)).astype(np.float32))
+        y = paddle.to_tensor(rng.integers(0, 2, (8,)))
+        for _ in range(3):
+            loss = F.cross_entropy(net(x), y)
+            loss.backward()
+            opt.step(); opt.clear_grad()
+        w0 = np.asarray(net[0].weight.value)
+        assert sparsity.check_mask_2d(w0)
+        assert abs(sparsity.calculate_density(w0) - 0.5) < 0.05
+        # out dim 2 is not 2:4-maskable -> correctly left dense
+        assert sparsity.calculate_density(np.asarray(net[2].weight.value)) > 0.9
