@@ -3,6 +3,7 @@ from .base import Fleet, ShardedTrainStep, fleet, zero_shard_spec  # noqa: F401
 from .strategy import DistributedStrategy  # noqa: F401
 from .. import meta_parallel  # noqa: F401
 from . import comm_opt  # noqa: F401
+from . import dataset  # noqa: F401  (InMemoryDataset / QueueDataset)
 
 
 def init(role_maker=None, is_collective=True, strategy=None):

@@ -1,0 +1,118 @@
+"""Industrial dataset ingestion: InMemoryDataset / QueueDataset.
+
+Reference capability: python/paddle/distributed/fleet/dataset/dataset.py —
+wrappers over the C++ Dataset/DataFeed (framework/data_set.h:43,
+data_feed.h:305): multithreaded file readers feeding training directly,
+``load_into_memory`` + ``local_shuffle`` for the in-memory variant,
+streaming for the queue variant.
+
+TPU-native: both wrap the native C++ shard feeder
+(paddle_tpu/_native/io_runtime.cpp).  Records are fixed-length binary
+(``set_record_schema`` gives the [seq_len, dtype] layout — the pretraining
+shard format); batches surface as numpy arrays ready for jit steps.
+"""
+from __future__ import annotations
+
+from typing import Iterator, Sequence
+
+import numpy as np
+
+
+class _DatasetBase:
+    def __init__(self):
+        self._files: list[str] = []
+        self._seq_len = 0
+        self._dtype = np.int32
+        self._batch = 1
+        self._threads = 4
+        self._shuffle_window = 0
+        self._seed = 0
+
+    # reference config surface
+    def set_filelist(self, files: Sequence[str]):
+        self._files = list(files)
+
+    def set_batch_size(self, bs: int):
+        self._batch = int(bs)
+
+    def set_thread(self, n: int):
+        self._threads = int(n)
+
+    def set_record_schema(self, seq_len: int, dtype=np.int32):
+        self._seq_len = int(seq_len)
+        self._dtype = np.dtype(dtype)
+
+    def set_shuffle_window(self, window: int):
+        """Streaming reservoir-shuffle window (0 = no shuffle)."""
+        self._shuffle_window = int(window)
+
+    def set_seed(self, seed: int):
+        self._seed = int(seed)
+
+    def _reader(self, capacity=8):
+        from ...io.native_reader import TokenShardReader
+
+        if not self._files or not self._seq_len:
+            raise ValueError("set_filelist + set_record_schema first")
+        return TokenShardReader(
+            self._files, seq_len=self._seq_len, batch_size=self._batch,
+            num_threads=self._threads, dtype=self._dtype, capacity=capacity,
+            seed=self._seed, shuffle_window=self._shuffle_window)
+
+
+class QueueDataset(_DatasetBase):
+    """Streaming: batches flow straight from reader threads (no staging).
+
+    Drop-last-per-worker semantics: each reader thread emits only FULL
+    batches, so up to (batch_size - 1) records per thread are dropped at
+    end-of-stream — the streaming trade-off (the reference QueueDataset
+    similarly streams without an epoch-exact tail).  Use InMemoryDataset
+    when every record must be seen."""
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        yield from self._reader()
+
+
+class InMemoryDataset(_DatasetBase):
+    """Stage everything in host RAM, then (re-)shuffle and iterate epochs
+    (reference load_into_memory/local_shuffle/global_shuffle)."""
+
+    def __init__(self):
+        super().__init__()
+        self._records: np.ndarray | None = None
+
+    def load_into_memory(self):
+        # stage at record granularity (batch=1) so no ragged per-worker tail
+        # is dropped; batching happens at iteration time
+        saved = self._batch
+        self._batch = 1
+        try:
+            batches = list(self._reader(capacity=32))
+        finally:
+            self._batch = saved
+        if batches:
+            self._records = np.concatenate(batches, axis=0)
+        else:
+            self._records = np.empty((0, self._seq_len), self._dtype)
+        return self
+
+    def local_shuffle(self, seed: int | None = None):
+        assert self._records is not None, "load_into_memory first"
+        rng = np.random.default_rng(self._seed if seed is None else seed)
+        rng.shuffle(self._records)
+        return self
+
+    # single-host build: global == local (multi-host would alltoall shards)
+    global_shuffle = local_shuffle
+
+    def get_memory_data_size(self) -> int:
+        return 0 if self._records is None else len(self._records)
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        assert self._records is not None, "load_into_memory first"
+        n = (len(self._records) // self._batch) * self._batch
+        for i in range(0, n, self._batch):
+            yield self._records[i:i + self._batch]
+
+    def release_memory(self):
+        self._records = None
