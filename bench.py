@@ -1,25 +1,33 @@
 #!/usr/bin/env python
-"""Headline benchmark: GPT 1.3B (BASELINE config 4) train-step throughput.
+"""Benchmark ladder (BASELINE.md configs 1-4) on one chip.
 
-Prints ONE JSON line:
+stdout: exactly ONE JSON line — the headline GPT metric:
   {"metric": ..., "value": N, "unit": "tokens/s/chip", "vs_baseline": N}
+stderr: per-config progress + diagnostics.
+``--all`` additionally measures MNIST-LeNet / ResNet-50 / BERT-base and
+writes every config's result to BENCH_DETAILS.json.
+``--config NAME`` runs a single config (gpt|mnist|resnet|bert).
+``--small`` forces the scaled-down CI configs.
 
 The reference repo publishes no absolute numbers (BASELINE.md), so
 ``vs_baseline`` is measured MFU relative to the north-star bar of A100-class
-MFU (BASELINE.json: "≥ A100 MFU"); we take 0.45 MFU — strong published
+MFU (BASELINE.json: ">= A100 MFU"); we take 0.45 MFU — strong published
 Megatron-LM A100 efficiency for GPT-scale models — as that bar, i.e.
 vs_baseline = our_MFU / 0.45 (>1.0 beats the bar).
 
-On CPU (or --small) runs a scaled-down config so the script stays fast in CI.
+Robustness (round-1 lesson: rc=1, no JSON at all): backend init happens in a
+throwaway subprocess first (the axon tunnel can hang or be temporarily
+UNAVAILABLE); on repeated failure we pin JAX_PLATFORMS=cpu *before* importing
+jax in this process and still emit a JSON line (vs_baseline=0.0, metric
+suffixed `_cpu_fallback`) rather than nothing.
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
-
-import jax
-
 
 # bf16 peak FLOPs per chip by device kind (dense MXU)
 _PEAK = {
@@ -33,16 +41,66 @@ _PEAK = {
 _A100_MFU_BAR = 0.45
 
 
+def _log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _probe_backend(timeout=240, attempts=2):
+    """Initialize the jax backend in a subprocess so a tunnel hang cannot
+    take down the bench process. Returns device info dict or None."""
+    code = ("import jax, json; d = jax.devices()[0]; "
+            "print(json.dumps({'platform': d.platform, "
+            "'kind': getattr(d, 'device_kind', '')}))")
+    for i in range(attempts):
+        try:
+            t0 = time.perf_counter()
+            out = subprocess.run([sys.executable, "-c", code],
+                                 capture_output=True, text=True,
+                                 timeout=timeout)
+            dt = time.perf_counter() - t0
+            if out.returncode == 0 and out.stdout.strip():
+                info = json.loads(out.stdout.strip().splitlines()[-1])
+                _log(f"[bench] backend probe ok in {dt:.0f}s: {info}")
+                return info
+            _log(f"[bench] backend probe attempt {i + 1} failed rc="
+                 f"{out.returncode}: {out.stderr.strip()[-500:]}")
+        except subprocess.TimeoutExpired:
+            _log(f"[bench] backend probe attempt {i + 1} timed out "
+                 f"after {timeout}s")
+        except Exception as e:  # noqa: BLE001 - diagnostics only
+            _log(f"[bench] backend probe attempt {i + 1} error: {e!r}")
+        time.sleep(5)
+    return None
+
+
 def _peak_flops(dev) -> float:
     kind = (getattr(dev, "device_kind", "") or "").lower()
     for k, v in _PEAK.items():
         if k in kind:
             return v
+    env_gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    if env_gen in _PEAK:
+        return _PEAK[env_gen]
     return 459e12 if dev.platform in ("tpu", "axon") else 1e12
 
 
-def main():
+def _time_steps(run_one, iters, block):
+    run_one()  # compile + warmup
+    block()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        run_one()
+    block()
+    return (time.perf_counter() - t0) / iters
+
+
+# ---------------------------------------------------------------------------
+# configs
+# ---------------------------------------------------------------------------
+
+def bench_gpt(small: bool):
     import numpy as np
+    import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh
 
@@ -50,14 +108,12 @@ def main():
     from paddle_tpu.text import gpt, gpt_hybrid
 
     dev = jax.devices()[0]
-    small = "--small" in sys.argv or dev.platform == "cpu"
     if small:
         ladder = [("gpt_small_smoke",
-                   gpt.GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
-                                 num_heads=4, max_seq_len=256), 2, 256, 3)]
+                   gpt.GPTConfig(vocab_size=1024, hidden_size=128,
+                                 num_layers=2, num_heads=4, max_seq_len=256),
+                   2, 256, 3)]
     else:
-        # size ladder: try the largest first, fall back on OOM (v5e has 16G
-        # HBM; v4/v5p take the 1.3B head entry)
         c13 = gpt.gpt_1p3b()
         c760 = gpt.GPTConfig(vocab_size=50304, hidden_size=1536, num_layers=24,
                              num_heads=16, max_seq_len=2048)
@@ -65,6 +121,8 @@ def main():
                              num_heads=16, max_seq_len=2048)
         for c in (c13, c760, c350):
             c.remat = True
+        # try the largest first, fall back on OOM (v5e has 16G HBM;
+        # v4/v5p take the 1.3B head entry)
         ladder = [("gpt_1.3b", c13, 8, 2048, 10),
                   ("gpt_760m", c760, 8, 2048, 10),
                   ("gpt_350m", c350, 8, 2048, 10)]
@@ -80,39 +138,234 @@ def main():
             rng = np.random.default_rng(0)
             toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, T + 1)),
                                jnp.int32)
-            # compile + warmup
             state, loss = step_fn(state, toks, key, 2e-4)
             jax.block_until_ready(loss)
             break
-        except Exception as e:  # OOM → next rung (full error surfaced)
+        except Exception as e:  # OOM -> next rung (full error surfaced)
             last_err = e
             import traceback
             traceback.print_exc(file=sys.stderr)
-            print(f"[bench] {name} failed ({type(e).__name__}); trying next",
-                  file=sys.stderr)
+            _log(f"[bench] {name} failed ({type(e).__name__}); trying next")
     else:
         raise last_err
 
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, loss = step_fn(state, toks, key, 2e-4)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
+    st = {"state": state, "loss": loss}
 
-    tok_s = B * T * iters / dt
-    flops_s = gpt.flops_per_token(cfg, T) * tok_s
-    mfu = flops_s / _peak_flops(dev)
-    print(
-        f"[bench] {name}: {tok_s:,.0f} tok/s  step={dt / iters * 1e3:.1f}ms  "
-        f"loss={float(loss):.4f}  MFU={mfu:.3f}  device={dev.device_kind}",
-        file=sys.stderr,
-    )
-    print(json.dumps({
-        "metric": f"tokens_per_sec_per_chip_{name}",
-        "value": round(tok_s, 1),
-        "unit": "tokens/s/chip",
-        "vs_baseline": round(mfu / _A100_MFU_BAR, 4),
-    }))
+    def one():
+        st["state"], st["loss"] = step_fn(st["state"], toks, key, 2e-4)
+
+    dt = _time_steps(one, iters, lambda: jax.block_until_ready(st["loss"]))
+    tok_s = B * T / dt
+    mfu = gpt.flops_per_token(cfg, T) * tok_s / _peak_flops(dev)
+    _log(f"[bench] {name}: {tok_s:,.0f} tok/s  step={dt * 1e3:.1f}ms  "
+         f"loss={float(st['loss']):.4f}  MFU={mfu:.3f}  "
+         f"device={dev.device_kind}")
+    return {"metric": f"tokens_per_sec_per_chip_{name}",
+            "value": round(tok_s, 1), "unit": "tokens/s/chip",
+            "step_ms": round(dt * 1e3, 2), "mfu": round(mfu, 4),
+            "vs_baseline": round(mfu / _A100_MFU_BAR, 4)}
+
+
+def bench_bert(small: bool):
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.text import bert
+
+    dev = jax.devices()[0]
+    if small:
+        cfg = bert.BertConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                              num_heads=4, max_seq_len=128)
+        ladder, T, K, iters = [2], 128, 20, 3
+    else:
+        cfg = bert.bert_base()
+        ladder, T, K, iters = [32, 16, 8], 512, 76, 10
+
+    opt = AdamW(learning_rate=1e-4)
+    key = jax.random.PRNGKey(0)
+
+    def make_batch(B):
+        rng = np.random.default_rng(0)
+        return {
+            "input_ids": jnp.asarray(
+                rng.integers(0, cfg.vocab_size, (B, T)), jnp.int32),
+            "mlm_positions": jnp.asarray(
+                np.sort(rng.integers(0, T, (B, K)), axis=1), jnp.int32),
+            "mlm_labels": jnp.asarray(
+                rng.integers(0, cfg.vocab_size, (B, K)), jnp.int32),
+            "nsp_labels": jnp.asarray(rng.integers(0, 2, (B,)), jnp.int32),
+        }
+
+    @jax.jit
+    def step(params, opt_state, batch, step_i):
+        loss, grads = jax.value_and_grad(bert.pretrain_loss)(
+            params, batch, cfg)
+        params, opt_state = opt.apply_gradients(
+            grads, params, opt_state, lr=1e-4, step=step_i)
+        return params, opt_state, loss
+
+    last_err = None
+    for B in ladder:
+        try:
+            params = bert.init_params(cfg, key)
+            opt_state = opt.init_state(params)
+            batch = make_batch(B)
+            params, opt_state, loss = step(params, opt_state, batch, 1)
+            jax.block_until_ready(loss)
+            break
+        except Exception as e:
+            last_err = e
+            _log(f"[bench] bert B={B} failed ({type(e).__name__}); "
+                 f"trying next")
+    else:
+        raise last_err
+
+    st = {"p": params, "o": opt_state, "l": loss}
+
+    def one():
+        st["p"], st["o"], st["l"] = step(st["p"], st["o"], batch, 1)
+
+    dt = _time_steps(one, iters, lambda: jax.block_until_ready(st["l"]))
+    # matmul-weight flops: blocks + mlm head (tied wte, applied on K of T)
+    D, F, L, V = cfg.hidden_size, cfg.ffn_size, cfg.num_layers, cfg.vocab_size
+    per_tok = 6 * L * (4 * D * D + 2 * D * F) + 12 * L * D * T
+    per_seq = per_tok * T + 6 * (V * D + D * D) * K
+    samp_s = B / dt
+    mfu = per_seq * samp_s / _peak_flops(dev)
+    _log(f"[bench] bert_base: {samp_s:,.1f} seq/s ({samp_s * T:,.0f} tok/s) "
+         f"step={dt * 1e3:.1f}ms loss={float(st['l']):.4f} MFU={mfu:.3f}")
+    return {"metric": "sequences_per_sec_per_chip_bert_base",
+            "value": round(samp_s, 2), "unit": "sequences/s/chip",
+            "step_ms": round(dt * 1e3, 2), "mfu": round(mfu, 4),
+            "vs_baseline": round(mfu / _A100_MFU_BAR, 4)}
+
+
+def _layer_train_bench(name, net, X, Y, iters, lr=0.01, flops_per_step=None):
+    """Shared TrainStep-based bench for Layer models (LeNet/ResNet)."""
+    import jax
+
+    from paddle_tpu import nn
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.optimizer import Momentum
+
+    dev = jax.devices()[0]
+    opt = Momentum(learning_rate=lr, momentum=0.9, parameters=net.parameters())
+    step = TrainStep(net, nn.functional.cross_entropy, opt)
+    loss_box = {}
+
+    def one():
+        loss_box["l"] = step(X, Y)
+
+    dt = _time_steps(one, iters,
+                     lambda: jax.block_until_ready(loss_box["l"].value))
+    B = X.shape[0]
+    samp_s = B / dt
+    out = {"metric": f"samples_per_sec_per_chip_{name}",
+           "value": round(samp_s, 1), "unit": "samples/s/chip",
+           "step_ms": round(dt * 1e3, 2), "vs_baseline": 0.0}
+    if flops_per_step is not None:
+        mfu = flops_per_step / dt / _peak_flops(dev)
+        out["mfu"] = round(mfu, 4)
+        out["vs_baseline"] = round(mfu / _A100_MFU_BAR, 4)
+    _log(f"[bench] {name}: {samp_s:,.1f} samples/s step={dt * 1e3:.1f}ms "
+         f"loss={float(loss_box['l'].value):.4f}"
+         + (f" MFU={out['mfu']:.3f}" if "mfu" in out else ""))
+    return out
+
+
+def bench_mnist(small: bool):
+    import numpy as np
+
+    from paddle_tpu.vision.models import LeNet
+
+    B = 64 if small else 512
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((B, 1, 28, 28), dtype=np.float32)
+    Y = rng.integers(0, 10, (B,)).astype(np.int64)
+    return _layer_train_bench("mnist_lenet", LeNet(), X, Y,
+                              iters=3 if small else 20)
+
+
+def bench_resnet(small: bool):
+    import numpy as np
+
+    from paddle_tpu.vision.models import resnet50
+
+    if small:
+        B, hw, iters = 2, 64, 2
+    else:
+        B, hw, iters = 64, 224, 10
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((B, 3, hw, hw), dtype=np.float32)
+    Y = rng.integers(0, 1000, (B,)).astype(np.int64)
+    # ResNet-50 fwd ~= 4.1 GFLOPs per 224x224 image; training ~= 3x fwd
+    flops = 3 * 2 * 2.05e9 * B * (hw / 224.0) ** 2 if hw >= 64 else None
+    return _layer_train_bench("resnet50", resnet50(), X, Y, iters,
+                              flops_per_step=flops)
+
+
+_CONFIGS = {"gpt": bench_gpt, "mnist": bench_mnist, "resnet": bench_resnet,
+            "bert": bench_bert}
+
+
+def main():
+    argv = sys.argv[1:]
+    cpu_fallback = False
+    if "--cpu" in argv:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    else:
+        info = _probe_backend()
+        if info is None:
+            _log("[bench] backend unavailable after retries; "
+                 "falling back to CPU so a JSON line still appears")
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            cpu_fallback = True
+
+    import jax
+
+    if cpu_fallback or os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    dev = jax.devices()[0]
+    small = "--small" in argv or dev.platform == "cpu"
+    _log(f"[bench] device={dev.platform}/{getattr(dev, 'device_kind', '')} "
+         f"small={small}")
+
+    which = None
+    if "--config" in argv:
+        which = argv[argv.index("--config") + 1]
+    run_all = "--all" in argv
+
+    results = {}
+    if which:
+        results[which] = _CONFIGS[which](small)
+    elif run_all:
+        for name, fn in _CONFIGS.items():
+            try:
+                results[name] = fn(small)
+            except Exception as e:  # noqa: BLE001 - record and continue
+                import traceback
+                traceback.print_exc(file=sys.stderr)
+                results[name] = {"error": f"{type(e).__name__}: {e}"}
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_DETAILS.json"), "w") as f:
+            json.dump(results, f, indent=2)
+    else:
+        results["gpt"] = bench_gpt(small)
+
+    head = next((r for r in ([results.get("gpt", {})]
+                             + list(results.values())) if "metric" in r),
+                None)
+    if head is None:
+        raise SystemExit("[bench] no config produced a result")
+    line = {"metric": head["metric"], "value": head["value"],
+            "unit": head["unit"], "vs_baseline": head["vs_baseline"]}
+    if cpu_fallback:
+        line["metric"] += "_cpu_fallback"
+        line["vs_baseline"] = 0.0
+    print(json.dumps(line), flush=True)
 
 
 if __name__ == "__main__":

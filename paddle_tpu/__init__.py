@@ -17,12 +17,31 @@ __version__ = "0.1.0"
 # touches CUDA either (fleet/launch.py only builds env + subprocesses).
 # `python -m paddle_tpu.distributed.launch` imports this package before the
 # module runs, so the light-import switch is decided here.
-_LIGHT_IMPORT = (
-    _os.environ.get("PADDLE_TPU_LIGHT_IMPORT") == "1"
-    or any(a in ("paddle_tpu.distributed.launch",
-                 "paddle_tpu.distributed.spawn")
-           for a in getattr(_sys, "orig_argv", []))
-)
+def _is_light_entry() -> bool:
+    if _os.environ.get("PADDLE_TPU_LIGHT_IMPORT") == "1":
+        return True
+    # only a `-m <launcher>` among the INTERPRETER options counts — the scan
+    # stops at the first script/command argument, so a training command that
+    # merely mentions the launcher (even as its own -m flag value) must not
+    # get the stripped-down package
+    argv = list(getattr(_sys, "orig_argv", []))
+    i = 1
+    while i < len(argv):
+        a = argv[i]
+        if a == "-m":
+            return i + 1 < len(argv) and argv[i + 1] in (
+                "paddle_tpu.distributed.launch",
+                "paddle_tpu.distributed.spawn")
+        if a == "-c" or a == "-" or not a.startswith("-"):
+            return False  # command string / stdin / script path reached
+        if a in ("-W", "-X", "--check-hash-based-pycs"):
+            i += 2  # interpreter option with a separate value argument
+        else:
+            i += 1
+    return False
+
+
+_LIGHT_IMPORT = _is_light_entry()
 
 if not _LIGHT_IMPORT:
     # dtypes

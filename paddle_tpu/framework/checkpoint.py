@@ -98,11 +98,15 @@ def save_sharded(tree: Any, ckpt_dir: str, step: int):
     return final
 
 
+_STEP_DIR = re.compile(r"step_(\d+)")
+
+
 def latest_step(ckpt_dir: str) -> int | None:
     if not os.path.isdir(ckpt_dir):
         return None
-    steps = [int(d.split("_", 1)[1]) for d in os.listdir(ckpt_dir)
-             if d.startswith("step_") and not d.endswith(".tmp")
+    # strict match: transient multi-host 'step_N.tmpP' dirs must not parse
+    steps = [int(m.group(1)) for d in os.listdir(ckpt_dir)
+             if (m := _STEP_DIR.fullmatch(d))
              and os.path.exists(os.path.join(ckpt_dir, d, "manifest.json"))]
     return max(steps) if steps else None
 
@@ -138,27 +142,45 @@ def load_sharded(ckpt_dir: str, step: int, target: Any):
             path = os.path.join(d, f"{name}.c{cid}.npy")
             chunks.append((tuple(cm["starts"]), tuple(cm["shape"]), path))
 
-        def read_slice(index, *, _chunks=chunks, _shape=shape, _dtype=dtype):
+        def read_slice(index, *, _chunks=chunks, _shape=shape, _dtype=dtype,
+                       _name=name):
             # requested global slice -> assemble from overlapping chunks
             req_start = [(s.start or 0) for s in index] if index else []
             req_stop = [s.stop if s.stop is not None else dim
                         for s, dim in zip(index, _shape)] if index else []
             if not req_start:
                 req_start, req_stop = [0] * len(_shape), list(_shape)
+            req_size = 1
+            for a, b in zip(req_start, req_stop):
+                req_size *= b - a
             out_arr = np.empty([b - a for a, b in zip(req_start, req_stop)],
                                _dtype)
+            covered = np.zeros(out_arr.shape, bool) if req_size else None
             for cstart, cshape, path in _chunks:
                 cstop = [a + b for a, b in zip(cstart, cshape)]
                 inter_a = [max(a, ca) for a, ca in zip(req_start, cstart)]
                 inter_b = [min(b, cb) for b, cb in zip(req_stop, cstop)]
                 if any(a >= b for a, b in zip(inter_a, inter_b)):
                     continue
-                src = np.load(path, mmap_mode="r")
+                try:
+                    src = np.load(path, mmap_mode="r")
+                except OSError:
+                    continue  # listed but unreadable -> counts as a hole
                 src_sl = tuple(slice(a - ca, b - ca)
                                for a, b, ca in zip(inter_a, inter_b, cstart))
                 dst_sl = tuple(slice(a - ra, b - ra)
                                for a, b, ra in zip(inter_a, inter_b, req_start))
                 out_arr[dst_sl] = src[src_sl]
+                covered[dst_sl] = True
+            if covered is not None and not covered.all():
+                # a hole means an incomplete/unbarriered save — corrupt
+                # resume silently would be worse than failing here
+                missing = int(req_size - covered.sum())
+                raise ValueError(
+                    f"checkpoint leaf {_name!r}: chunks cover only "
+                    f"{req_size - missing}/{req_size} elements of the "
+                    f"requested slice (incomplete multi-host save or missing "
+                    f"chunk files in {d!r})")
             return out_arr
 
         sharding = getattr(leaf, "sharding", None)
@@ -200,8 +222,8 @@ class AutoCheckpoint:
 
     def _gc(self):
         steps = sorted(
-            int(d.split("_", 1)[1]) for d in os.listdir(self.dir)
-            if d.startswith("step_") and not d.endswith(".tmp"))
+            int(m.group(1)) for d in os.listdir(self.dir)
+            if (m := _STEP_DIR.fullmatch(d)))
         for s in steps[: -self.keep_max]:
             shutil.rmtree(os.path.join(self.dir, f"step_{s}"),
                           ignore_errors=True)

@@ -1,0 +1,48 @@
+"""Platform pinning helpers.
+
+The container may pre-register an accelerator PJRT plugin (e.g. the axon TPU
+tunnel) in every interpreter, in which case ``JAX_PLATFORMS`` env alone is
+ignored once jax resolves backends — the live jax config must be updated
+*before the first backend init*.  One shared recipe (used by tests/conftest,
+__graft_entry__ and bench) so fixes land in one place.
+"""
+from __future__ import annotations
+
+import os
+import re
+
+_COUNT_FLAG = re.compile(r"--xla_force_host_platform_device_count=(\d+)")
+
+
+def force_cpu(n_devices: int = 1):
+    """Pin the CPU platform with >= ``n_devices`` virtual devices.
+
+    Must run BEFORE any jax backend initializes; raises if a non-CPU backend
+    already won or the virtual-device flag landed too late.  Returns the
+    first ``n_devices`` devices.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = _COUNT_FLAG.search(flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}")
+    elif int(m.group(1)) < n_devices:
+        os.environ["XLA_FLAGS"] = _COUNT_FLAG.sub(
+            f"--xla_force_host_platform_device_count={n_devices}", flags)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    devs = jax.devices()
+    if devs[0].platform != "cpu":
+        raise RuntimeError(
+            f"need the CPU platform but got {devs[0].platform!r}; a non-CPU "
+            f"backend was already initialized before force_cpu() was called")
+    if len(devs) < n_devices:
+        raise RuntimeError(
+            f"need {n_devices} virtual CPU devices, have {len(devs)}; "
+            f"XLA_FLAGS was set too late (backend already initialized). Set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n_devices} "
+            f"before importing jax")
+    return devs[:n_devices]

@@ -20,9 +20,18 @@ import jax
 
 
 class _RNGState(threading.local):
+    """Global key is LAZY: ``import paddle_tpu`` must never initialize a jax
+    backend (creating a PRNGKey at import time forces platform selection
+    before the caller can pin it — see tests/conftest.py)."""
+
     def __init__(self):
-        self.key = jax.random.PRNGKey(0)
+        self.key = None  # materialized on first use
         self.override = None  # traced key stack for jitted paths
+
+    def get_key(self):
+        if self.key is None:
+            self.key = jax.random.PRNGKey(0)
+        return self.key
 
 
 _state = _RNGState()
@@ -38,7 +47,7 @@ def next_key(n: int = 1):
     if _state.override is not None:
         tracker = _state.override
         return tracker.next(n)
-    _state.key, *sub = jax.random.split(_state.key, n + 1)
+    _state.key, *sub = jax.random.split(_state.get_key(), n + 1)
     return sub[0] if n == 1 else list(sub)
 
 

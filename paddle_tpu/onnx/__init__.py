@@ -13,24 +13,21 @@ from __future__ import annotations
 def export(layer, path: str, input_spec=None, opset_version=None, **kw):
     """Export ``layer`` for interchange.
 
-    Writes the StableHLO artifact at ``path`` (always works).  If the
-    optional ``onnx`` package is importable, also attempts .onnx emission;
-    otherwise instructs how to convert the StableHLO artifact externally.
+    Writes the StableHLO artifact at ``path``.  Direct .onnx emission is NOT
+    implemented (the converter ecosystem ingests StableHLO directly); a
+    warning always points at the conversion route so callers expecting a
+    .onnx file find out immediately, not at deploy time.
     """
+    import warnings
+
     from ..inference import save_inference_model
 
     if input_spec is None:
         raise ValueError("input_spec (example inputs) required for export")
     prefix = path[:-5] if path.endswith(".onnx") else path
     save_inference_model(prefix, layer, input_spec)
-    try:
-        import onnx  # noqa: F401  (not vendored in this image)
-        import warnings
-
-        warnings.warn(
-            "direct .onnx emission is not implemented; the StableHLO "
-            f"artifact at {prefix}.pdmodel converts via stablehlo->onnx "
-            "tooling", stacklevel=2)
-    except ImportError:
-        pass
+    warnings.warn(
+        "paddle_tpu.onnx.export writes a StableHLO artifact, not a .onnx "
+        f"file; convert {prefix}.pdmodel with stablehlo->onnx tooling "
+        "(e.g. onnx-mlir) if ONNX protobuf output is required", stacklevel=2)
     return prefix

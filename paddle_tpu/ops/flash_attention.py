@@ -18,7 +18,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-_FALLBACK = None
+_FALLBACK: dict = {}
 
 
 def _xla(q, k, v, causal, scale):
@@ -32,20 +32,23 @@ def _shape_supported(q_shape, s_len) -> bool:
     return T % 128 == 0 and s_len % 128 == 0 and D in (64, 128, 256)
 
 
-def _probe() -> bool:
-    """Eagerly compile+run a tiny fwd+bwd pair once; True = must fall back.
-    Runs OUTSIDE any jit so Mosaic lowering failures are actually caught."""
-    global _FALLBACK
-    if _FALLBACK is None:
+def _probe(dtype, causal: bool, D: int) -> bool:
+    """Eagerly compile+run a tiny fwd+bwd pair once per (dtype, causal, D)
+    configuration; True = must fall back.  Runs OUTSIDE any jit so Mosaic
+    lowering failures are actually caught — and keyed per config so e.g. a
+    bf16- or causal-specific lowering failure can't hide behind a healthy
+    fp32 non-causal probe."""
+    cache_key = (jnp.dtype(dtype).name, bool(causal), int(D))
+    if cache_key not in _FALLBACK:
         try:
-            z = jax.device_put(jnp.zeros((1, 128, 1, 64), jnp.float32))
-            out, vjp_fn = jax.vjp(lambda a, b, c: _flash(a, b, c, False, None),
-                                  z, z, z)
+            z = jax.device_put(jnp.zeros((1, 128, 1, D), dtype))
+            out, vjp_fn = jax.vjp(
+                lambda a, b, c: _flash(a, b, c, causal, None), z, z, z)
             jax.block_until_ready(jax.tree_util.tree_leaves(vjp_fn(out)))
-            _FALLBACK = False
+            _FALLBACK[cache_key] = False
         except Exception:
-            _FALLBACK = True
-    return _FALLBACK
+            _FALLBACK[cache_key] = True
+    return _FALLBACK[cache_key]
 
 
 def flash_attention(q, k, v, causal: bool = False, scale=None):
@@ -54,7 +57,8 @@ def flash_attention(q, k, v, causal: bool = False, scale=None):
 
     Not jitted itself: the availability probe must execute eagerly (it still
     works when tracing — the probe runs on its own concrete arrays)."""
-    if not _shape_supported(q.shape, k.shape[1]) or _probe():
+    if not _shape_supported(q.shape, k.shape[1]) \
+            or _probe(q.dtype, causal, q.shape[-1]):
         return _xla(q, k, v, causal, scale)
     return _flash(q, k, v, causal, scale)
 

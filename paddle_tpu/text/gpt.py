@@ -254,8 +254,15 @@ def count_params(cfg: GPTConfig) -> int:
 
 
 def flops_per_token(cfg: GPTConfig, seq_len: int) -> float:
-    """Training FLOPs/token ≈ 6*N + attention term (for MFU accounting)."""
-    n = count_params(cfg) - cfg.vocab_size * cfg.hidden_size  # wte tied w/ head; keep
-    n = count_params(cfg)
-    attn = 12 * cfg.num_layers * cfg.hidden_size * seq_len
-    return 6 * n + attn
+    """Training FLOPs/token = 6 * (matmul-weight params) + attention term.
+
+    Matmul weights: qkv (3 D^2) + attn proj (D^2) + ffn (2 D F) per block,
+    plus the tied-embedding head matmul (V D).  The embedding *lookup* is a
+    gather (no MXU flops), so with tied weights V*D is counted exactly once;
+    wpe, biases and layernorm params contribute no matmul flops.  Attention
+    scores: QK^T + AV = 12 L D T training flops/token (full, non-causal
+    accounting — the conservative standard for MFU)."""
+    D, F, L, V = cfg.hidden_size, cfg.ffn_size, cfg.num_layers, cfg.vocab_size
+    n_matmul = L * (4 * D * D + 2 * D * F) + V * D
+    attn = 12 * L * D * seq_len
+    return 6 * n_matmul + attn

@@ -2,19 +2,17 @@
 run without TPU hardware (reference test_dist_base.py spawns localhost
 multi-process clusters; the TPU-native analog is a virtual device mesh).
 
-Note: the environment may pre-import jax with JAX_PLATFORMS pointing at the
-TPU tunnel, so overriding os.environ here is not enough — we must update the
-live jax config before any backend initializes.
+The environment may pre-import jax with JAX_PLATFORMS pointing at the TPU
+tunnel, so overriding os.environ alone is not enough — the shared
+``paddle_tpu.framework.platform.force_cpu`` updates the live jax config
+before any backend initializes (``import paddle_tpu`` itself never touches a
+backend).
 """
 import os
+import sys
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
-os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
+from paddle_tpu.framework.platform import force_cpu  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-assert jax.devices()[0].platform == "cpu", "tests must run on CPU"
-assert len(jax.devices()) >= 8, "need 8 virtual CPU devices for mesh tests"
+force_cpu(8)
