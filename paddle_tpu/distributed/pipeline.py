@@ -25,7 +25,7 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 
 def make_pipeline_loss(embed_fn, stage_fn, head_loss_fn, n_micro: int, pp_size: int,
@@ -106,7 +106,7 @@ def build_pipeline_train_step(mesh: Mesh, embed_fn, stage_fn, head_loss_fn,
         spmd_loss, mesh=mesh,
         in_specs=(param_specs, tok_spec, P()),
         out_specs=P(),
-        check_rep=False,
+        check_vma=False,
     )
 
     def step_fn(params, opt_state, tokens, key, lr, step):

@@ -10,12 +10,17 @@ compose into ONE jitted train step:
   (text/gpt.py ``param_shardings``); XLA inserts all_gather / reduce_scatter
   over 'mp', all_reduce over 'dp', and handles 'sp' (sequence-sharded
   activations) automatically.
-* pp > 1 → ``shard_map`` pipeline: the 1F1B-equivalent schedule is a
-  ``lax.scan`` over M + S - 1 ticks; stage hops ride ``ppermute`` over the
-  'pp' ICI axis (the send_v2/recv_v2 analog, section_worker.cc:130-183) and
-  tensor parallel inside each stage uses the manual-collective Megatron
-  primitives (distributed/megatron.py) — including the vocab-sharded softmax
-  CE loss (c_softmax_with_cross_entropy analog).
+* pp > 1 → ``shard_map`` pipeline over the 'pp' ICI axis; stage hops ride
+  ``ppermute`` (the send_v2/recv_v2 analog) and tensor parallel inside each
+  stage uses the manual-collective Megatron primitives
+  (distributed/megatron.py) — including the vocab-sharded softmax CE loss
+  (c_softmax_with_cross_entropy analog).  Two schedules, matching the
+  reference SectionWorker's schedule_mode (section_worker.cc:130-183):
+  "1f1b" (default) interleaves one forward and one backward micro-batch step
+  per tick with manual per-stage VJP — activation memory is bounded by the
+  in-flight window (min(M, 2S-1) stage inputs), flat in the micro-batch
+  count; "fthenb" differentiates the forward scan with autodiff (residuals
+  for every tick — simple, memory grows with M).
 
 ZeRO optimizer-state sharding (reference sharding_optimizer.py) composes via
 ``zero_shard_spec`` on the Adam moment specs.
@@ -30,7 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from ..distributed import megatron as mt
 from ..ops.ring_attention import ring_attention
@@ -86,17 +91,25 @@ def mp_block(x, p, cfg: gpt.GPTConfig, mp_axis: str | None, mp_size: int,
 # pipeline (shard_map) loss
 # ---------------------------------------------------------------------------
 
-def make_pipeline_gpt_loss(cfg: gpt.GPTConfig, mesh: Mesh, n_micro: int,
-                           dp_axis="dp", pp_axis="pp", mp_axis="mp",
-                           sp_axis="sp"):
-    """Full-mesh SPMD loss fn (runs per-device inside shard_map).
+class _Parts(NamedTuple):
+    """Per-rank pipeline closures + axis constants, shared by the F-then-B
+    autodiff path and the interleaved-1F1B manual path."""
+    S: int
+    mp_size: int
+    sp_size: int
+    mp_ax: Any
+    sp_ax: Any
+    dp_ax: Any
+    vps: int
+    perm_fwd: list
+    perm_bwd: list
+    dt: Any
+    embed: Callable
+    stage: Callable
 
-    tokens: LOCAL [B_local, T] int32 (dp-sharded by in_specs; the sequence
-    dim stays replicated — each sp rank slices its own chunk so the odd
-    T+1 LM shift never has to shard).
-    params: LOCAL shards per gpt.param_shardings(mp, pp).
-    Composes pp (ppermute schedule) × mp (Megatron) × sp (ring attention).
-    """
+
+def _pipeline_parts(cfg: gpt.GPTConfig, mesh: Mesh, dp_axis, pp_axis, mp_axis,
+                    sp_axis) -> _Parts:
     S = mesh.shape.get(pp_axis, 1)
     mp_size = mesh.shape.get(mp_axis, 1)
     sp_size = mesh.shape.get(sp_axis, 1)
@@ -104,7 +117,6 @@ def make_pipeline_gpt_loss(cfg: gpt.GPTConfig, mesh: Mesh, n_micro: int,
     sp_ax = sp_axis if sp_size > 1 else None
     dp_ax = dp_axis if mesh.shape.get(dp_axis, 1) > 1 else None
     vps = cfg.vocab_size // mp_size
-    perm = [(i, (i + 1) % S) for i in range(S)]
     dt = cfg.dtype
 
     def embed(params, tok, pos0):
@@ -133,6 +145,32 @@ def make_pipeline_gpt_loss(cfg: gpt.GPTConfig, mesh: Mesh, n_micro: int,
 
         x, _ = lax.scan(scan_body, x, (blocks, layer_keys))
         return x
+
+    return _Parts(S, mp_size, sp_size, mp_ax, sp_ax, dp_ax, vps,
+                  [(i, (i + 1) % S) for i in range(S)],
+                  [(i, (i - 1) % S) for i in range(S)], dt, embed, stage)
+
+
+def make_pipeline_gpt_loss(cfg: gpt.GPTConfig, mesh: Mesh, n_micro: int,
+                           dp_axis="dp", pp_axis="pp", mp_axis="mp",
+                           sp_axis="sp"):
+    """Full-mesh SPMD loss fn (runs per-device inside shard_map).
+
+    tokens: LOCAL [B_local, T] int32 (dp-sharded by in_specs; the sequence
+    dim stays replicated — each sp rank slices its own chunk so the odd
+    T+1 LM shift never has to shard).
+    params: LOCAL shards per gpt.param_shardings(mp, pp).
+    Composes pp (ppermute schedule) × mp (Megatron) × sp (ring attention).
+
+    F-then-B memory profile: autodiff over the tick scan stores residuals
+    for every tick — use :func:`make_pipeline_1f1b_grads` for the
+    memory-bounded interleaved schedule.
+    """
+    parts = _pipeline_parts(cfg, mesh, dp_axis, pp_axis, mp_axis, sp_axis)
+    S, mp_ax, sp_ax, dp_ax = parts.S, parts.mp_ax, parts.sp_ax, parts.dp_ax
+    sp_size, vps, dt = parts.sp_size, parts.vps, parts.dt
+    perm = parts.perm_fwd
+    embed, stage = parts.embed, parts.stage
 
     def loss_fn(params, tokens, key):
         s = lax.axis_index(pp_axis) if S > 1 else 0
@@ -197,6 +235,181 @@ def make_pipeline_gpt_loss(cfg: gpt.GPTConfig, mesh: Mesh, n_micro: int,
 
 
 # ---------------------------------------------------------------------------
+# interleaved 1F1B pipeline with manual per-stage VJP (memory-bounded)
+# ---------------------------------------------------------------------------
+
+def _spec_axes(spec) -> set:
+    axes = set()
+    if spec is None:
+        return axes
+    for el in spec:
+        if el is None:
+            continue
+        if isinstance(el, tuple):
+            axes.update(el)
+        else:
+            axes.add(el)
+    return axes
+
+
+def make_pipeline_1f1b_grads(cfg: gpt.GPTConfig, mesh: Mesh, n_micro: int,
+                             dp_axis="dp", pp_axis="pp", mp_axis="mp",
+                             sp_axis="sp"):
+    """(params, tokens, key) -> (loss, grads) per-rank fn for shard_map.
+
+    The 1F1B-class schedule (reference SectionWorker schedule_mode=1,
+    section_worker.cc:130-183): one scan whose every tick runs ONE forward
+    micro-batch step and ONE backward micro-batch step per stage.  Micro-batch
+    m runs forward on stage s at tick ``m + s`` and backward at tick
+    ``m + 2(S-1) - s`` (the backward wave reflects off the last stage, which
+    computes its loss-head VJP in the same tick as its forward).  Activations
+    live only as a ring buffer of the last ``min(M, 2S-1)`` stage *inputs* —
+    flat in M, unlike autodiff over the F-then-B scan which stores residuals
+    for all ``M + S - 1`` ticks.  The backward slot recomputes the stage
+    forward from the saved input under ``jax.vjp`` (per-block remat applies
+    inside when cfg.remat).
+
+    Gradients are accumulated across ticks and explicitly reduced: psum over
+    model axes the leaf is NOT sharded over (pp for shared embeddings — the
+    reference's allreduce_shared_weight_gradients, pp_layers.py:188 — and mp
+    for replicated norms/biases), pmean over the data axes (dp, sp).
+    """
+    parts = _pipeline_parts(cfg, mesh, dp_axis, pp_axis, mp_axis, sp_axis)
+    S, mp_ax, sp_ax, dp_ax = parts.S, parts.mp_ax, parts.sp_ax, parts.dp_ax
+    sp_size, vps, dt = parts.sp_size, parts.vps, parts.dt
+    embed, stage = parts.embed, parts.stage
+    if S < 2:
+        raise ValueError("1F1B schedule needs pp >= 2; use the GSPMD path")
+
+    specs = gpt.param_shardings(cfg, mp=mp_ax, pp=pp_axis)
+
+    def sync_grads(grads):
+        """Per-rank cotangents follow the partial-sum convention (psum
+        transposes to psum under shard_map, and the loss seed is divided by
+        mp_size), so every leaf's true grad is the SUM over the model axes
+        it is not sharded over — pp for shared embeddings (the reference's
+        allreduce_shared_weight_gradients) and mp for replicated leaves —
+        and the MEAN over the data axes (dp, sp)."""
+        def leaf(g, spec):
+            owned = _spec_axes(spec)
+            sum_axes = tuple(a for a in (pp_axis, mp_axis)
+                             if mesh.shape.get(a, 1) > 1 and a not in owned)
+            if sum_axes:
+                g = lax.psum(g, sum_axes)
+            mean_axes = tuple(a for a in (dp_axis, sp_axis)
+                              if mesh.shape.get(a, 1) > 1)
+            if mean_axes:
+                g = lax.pmean(g, mean_axes)
+            return g
+
+        return jax.tree_util.tree_map(leaf, grads, specs,
+                                      is_leaf=lambda x: _spec_leaf(x))
+
+    def loss_and_grads(params, tokens, key):
+        s = lax.axis_index(pp_axis)
+        M = n_micro
+        B, T = tokens.shape
+        if B % M:
+            raise ValueError(
+                f"per-dp-shard batch {B} must be divisible by n_micro {M}")
+        if (T - 1) % sp_size:
+            raise ValueError(
+                f"sequence length {T - 1} must divide by sp {sp_size}")
+        b = B // M
+        Tl = (T - 1) // sp_size
+        sp_rank = lax.axis_index(sp_axis) if sp_ax else 0
+        pos0 = sp_rank * Tl
+        mb = tokens.reshape(M, b, T)
+        tok_in = lax.dynamic_slice_in_dim(mb, pos0, Tl, axis=2)
+        tok_tgt = lax.dynamic_slice_in_dim(mb, pos0 + 1, Tl, axis=2)
+        D = cfg.hidden_size
+
+        def fwd_only(p, x_in, tok_mb, k):
+            x0 = jnp.where(s == 0, embed(p, tok_mb, pos0), x_in)
+            return stage(p["blocks"], x0, k)
+
+        def full(p, x_in, tok_mb, tgt_mb, k):
+            """stage + (masked) loss head — the unit the backward slot VJPs.
+            The head term is where-masked off except on the last stage, so
+            its cotangents vanish elsewhere; under SPMD every rank still
+            executes it (the cost of a uniform program)."""
+            y = fwd_only(p, x_in, tok_mb, k)
+            x = gpt._layer_norm(y.astype(jnp.float32), p["ln_f_g"],
+                                p["ln_f_b"]).astype(dt)
+            logits = mt.vocab_parallel_logits(x, p["wte"].astype(dt))
+            ce = mt.vocab_parallel_softmax_ce(logits, tgt_mb, mp_ax, vps)
+            loss_mb = jnp.where(s == S - 1,
+                                jnp.mean(ce.astype(jnp.float32)), 0.0)
+            return y, loss_mb
+
+        BUF = min(M, 2 * S - 1)
+        ticks = M + 2 * (S - 1)
+        zeros_x = jnp.zeros((b, Tl, D), dt)
+        init = (zeros_x, zeros_x, jnp.zeros((BUF, b, Tl, D), dt),
+                jax.tree_util.tree_map(jnp.zeros_like, params),
+                jnp.zeros((), jnp.float32))
+
+        def tick(carry, t):
+            x_fwd, dx_bwd, buf, grads, loss_sum = carry
+
+            # ---- forward slot: micro-batch t - s
+            f_m = t - s
+            f_valid = (f_m >= 0) & (f_m < M)
+            f_idx = jnp.clip(f_m, 0, M - 1)
+            tok_f = lax.dynamic_index_in_dim(tok_in, f_idx, keepdims=False)
+            y_f = fwd_only(params, x_fwd, tok_f,
+                           jax.random.fold_in(key, f_idx))
+            # save the stage INPUT for the backward recompute; guard so the
+            # drain phase can't clobber a slot whose backward hasn't run
+            buf = jnp.where(
+                f_valid,
+                lax.dynamic_update_index_in_dim(buf, x_fwd, f_idx % BUF, 0),
+                buf)
+            x_fwd_next = lax.ppermute(y_f, pp_axis, parts.perm_fwd)
+
+            # ---- backward slot: micro-batch t - 2(S-1) + s
+            b_m = t - 2 * (S - 1) + s
+            b_valid = (b_m >= 0) & (b_m < M)
+            b_idx = jnp.clip(b_m, 0, M - 1)
+            x_saved = lax.dynamic_index_in_dim(buf, b_idx % BUF,
+                                               keepdims=False)
+            tok_b = lax.dynamic_index_in_dim(tok_in, b_idx, keepdims=False)
+            tgt_b = lax.dynamic_index_in_dim(tok_tgt, b_idx, keepdims=False)
+            k_b = jax.random.fold_in(key, b_idx)
+            (_, loss_mb), vjp_fn = jax.vjp(
+                lambda p, x: full(p, x, tok_b, tgt_b, k_b), params, x_saved)
+            # seed: last stage's dy comes from its own head (inside `full`);
+            # other stages receive dL/dy from stage s+1's backward slot.
+            # The loss seed is split 1/mp_size per rank because cotangents
+            # follow the partial-sum convention (psum transposes to psum):
+            # every replicated value's true cotangent is the psum of the
+            # per-rank pieces, which sync_grads applies at the end.
+            valid = b_valid.astype(jnp.float32)
+            dy = jnp.where(s == S - 1, jnp.zeros_like(dx_bwd), dx_bwd)
+            dy = dy * valid.astype(dt)
+            dparams, dx = vjp_fn((dy, valid / (M * parts.mp_size)))
+            grads = jax.tree_util.tree_map(jnp.add, grads, dparams)
+            loss_sum = loss_sum + valid * loss_mb
+            dx_next = lax.ppermute(dx, pp_axis, parts.perm_bwd)
+            return (x_fwd_next, dx_next, buf, grads, loss_sum), None
+
+        (_, _, _, grads, loss_sum), _ = lax.scan(tick, init,
+                                                 jnp.arange(ticks))
+        loss = lax.psum(loss_sum, pp_axis) / M  # only last stage accumulated
+        if dp_ax is not None:
+            loss = lax.pmean(loss, dp_ax)
+        if sp_ax is not None:
+            loss = lax.pmean(loss, sp_ax)
+        for ax in mesh.axis_names:
+            if ax not in (dp_axis, pp_axis, mp_axis, sp_axis) \
+                    and mesh.shape[ax] > 1:
+                loss = lax.pmean(loss, ax)
+        return loss, sync_grads(grads)
+
+    return loss_and_grads
+
+
+# ---------------------------------------------------------------------------
 # train-step builder
 # ---------------------------------------------------------------------------
 
@@ -212,14 +425,21 @@ def _spec_leaf(x):
 
 def build_gpt_train_step(cfg: gpt.GPTConfig, mesh: Mesh, optimizer,
                          n_micro: int = 1, zero: bool = False,
-                         donate: bool = True):
+                         donate: bool = True, schedule: str = "1f1b"):
     """Compile one hybrid-parallel GPT train step over ``mesh``.
+
+    ``schedule`` selects the pipeline schedule when pp > 1: "1f1b"
+    (interleaved fwd/bwd, activation memory bounded by the in-flight window
+    — reference section_worker.cc schedule_mode 1) or "fthenb" (autodiff
+    over the forward scan; residuals for every tick — schedule_mode 0).
 
     Returns (init_fn, step_fn, meta):
       init_fn(seed) -> GPTTrainState  (params/opt-state placed per sharding)
       step_fn(state, tokens, key, lr) -> (state, loss)   [jitted, donating]
       meta: dict of axis sizes + shardings (tok_sharding, param_shardings)
     """
+    if schedule not in ("1f1b", "fthenb"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
     axes = dict(mesh.shape)
     pp = axes.get("pp", 1)
     mp = axes.get("mp", 1)
@@ -246,12 +466,20 @@ def build_gpt_train_step(cfg: gpt.GPTConfig, mesh: Mesh, optimizer,
         specs, is_leaf=_spec_leaf)
 
     tok_spec = P("dp") if dp > 1 else P()
-    if pp > 1 or sp > 1:
+    value_and_grad_fn = None
+    if pp > 1 and schedule == "1f1b":
+        # interleaved 1F1B with manual per-stage VJP (memory-bounded)
+        vg_raw = make_pipeline_1f1b_grads(cfg, mesh, n_micro)
+        value_and_grad_fn = shard_map(
+            vg_raw, mesh=mesh, in_specs=(specs, tok_spec, P()),
+            out_specs=(P(), specs), check_vma=False)
+        loss_fn = None
+    elif pp > 1 or sp > 1:
         # manual-collective path: pipeline schedule and/or ring attention
         loss_raw = make_pipeline_gpt_loss(cfg, mesh, n_micro)
         loss_fn = shard_map(loss_raw, mesh=mesh,
                             in_specs=(specs, tok_spec, P()), out_specs=P(),
-                            check_rep=False)
+                            check_vma=False)
     else:
         # pure GSPMD: XLA inserts dp/mp collectives from the PartitionSpecs
         def loss_fn(params, tokens, key):
@@ -293,7 +521,11 @@ def build_gpt_train_step(cfg: gpt.GPTConfig, mesh: Mesh, optimizer,
         return GPTTrainState(params, opt_state, jnp.zeros((), jnp.int32))
 
     def step_fn(state: GPTTrainState, tokens, key, lr):
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens, key)
+        if value_and_grad_fn is not None:
+            loss, grads = value_and_grad_fn(state.params, tokens, key)
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens,
+                                                      key)
         new_p, new_o = optimizer.apply_gradients(
             grads, state.params, state.opt_state, lr=lr, step=state.step + 1)
         return GPTTrainState(new_p, new_o, state.step + 1), loss

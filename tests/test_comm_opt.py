@@ -4,7 +4,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from paddle_tpu.distributed.fleet.comm_opt import (DGCState, LocalSGD,
@@ -46,7 +46,7 @@ def test_dgc_allreduce_over_axis():
         return send["w"][None]
 
     out = shard_map(f, mesh=mesh, in_specs=(P("dp"),), out_specs=P("dp"),
-                    check_rep=False)(g)
+                    check_vma=False)(g)
     np.testing.assert_allclose(np.asarray(out)[0], np.full(8, 1.5), atol=1e-6)
 
 
@@ -60,7 +60,7 @@ def test_localsgd_periodic_sync():
 
     f = lambda step: shard_map(
         lambda pi: run(pi, step), mesh=mesh, in_specs=(P("dp"),),
-        out_specs=P("dp"), check_rep=False)(p)
+        out_specs=P("dp"), check_vma=False)(p)
     # step not divisible by k: untouched
     np.testing.assert_allclose(np.asarray(f(1)), np.asarray(p))
     # divisible: everyone gets the mean (1.5)

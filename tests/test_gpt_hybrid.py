@@ -13,7 +13,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from paddle_tpu.distributed import megatron as mt
@@ -45,7 +45,7 @@ class TestMegatronPrimitives:
         f = shard_map(
             lambda w, t: mt.vocab_parallel_embedding(w, t, "mp", V // 8),
             mesh=self.mesh, in_specs=(P("mp", None), P()), out_specs=P(),
-            check_rep=False)
+            check_vma=False)
         np.testing.assert_allclose(f(wte, tok), wte[tok], rtol=1e-6)
 
     def test_row_parallel_linear(self):
@@ -55,7 +55,7 @@ class TestMegatronPrimitives:
         f = shard_map(
             lambda xl, wl, bb: mt.row_parallel_linear(xl, wl, bb, axis="mp"),
             mesh=self.mesh, in_specs=(P(None, "mp"), P("mp", None), P()),
-            out_specs=P(), check_rep=False)
+            out_specs=P(), check_vma=False)
         np.testing.assert_allclose(f(x, w, b), x @ w + b, rtol=2e-5)
 
     def test_vocab_parallel_softmax_ce(self):
@@ -66,7 +66,7 @@ class TestMegatronPrimitives:
         f = shard_map(
             lambda lg, t: mt.vocab_parallel_softmax_ce(lg, t, "mp", V // 8),
             mesh=self.mesh, in_specs=(P(None, None, "mp"), P()), out_specs=P(),
-            check_rep=False)
+            check_vma=False)
         got = f(logits, tgt)
         lp = jax.nn.log_softmax(logits, axis=-1)
         want = -jnp.take_along_axis(lp, tgt[..., None], axis=-1)[..., 0]
@@ -82,7 +82,7 @@ class TestMegatronPrimitives:
                 lambda l, t: jnp.mean(
                     mt.vocab_parallel_softmax_ce(l, t, "mp", V // 8)),
                 mesh=self.mesh, in_specs=(P(None, "mp"), P()), out_specs=P(),
-                check_rep=False)
+                check_vma=False)
             return f(lg, tgt)
 
         def dense(lg):
@@ -115,7 +115,7 @@ class TestHybridEquivalence:
         loss_raw = gpt_hybrid.make_pipeline_gpt_loss(CFG, mesh, n_micro=2)
         specs = gpt.param_shardings(CFG, mp="mp", pp="pp")
         f = shard_map(loss_raw, mesh=mesh, in_specs=(specs, P("dp"), P()),
-                      out_specs=P(), check_rep=False)
+                      out_specs=P(), check_vma=False)
         got = jax.jit(f)(params, toks, jax.random.PRNGKey(0))
         want = gpt.loss_fn(params, toks, CFG)
         np.testing.assert_allclose(got, want, rtol=2e-5)
@@ -127,7 +127,7 @@ class TestHybridEquivalence:
         loss_raw = gpt_hybrid.make_pipeline_gpt_loss(CFG, mesh, n_micro=2)
         specs = gpt.param_shardings(CFG, mp="mp", pp="pp")
         f = shard_map(loss_raw, mesh=mesh, in_specs=(specs, P("dp"), P()),
-                      out_specs=P(), check_rep=False)
+                      out_specs=P(), check_vma=False)
         g_got = jax.jit(jax.grad(f))(params, toks, jax.random.PRNGKey(0))
         g_want = jax.grad(lambda p: gpt.loss_fn(p, toks, CFG))(params)
         for name in ("wte", "wpe", "ln_f_g"):
@@ -211,7 +211,7 @@ class TestRingAttention:
         f = shard_map(
             lambda a, b, c: ring_attention(a, b, c, "sp", causal=True),
             mesh=mesh, in_specs=(P(None, "sp"),) * 3,
-            out_specs=P(None, "sp"), check_rep=False)
+            out_specs=P(None, "sp"), check_vma=False)
         got = jax.jit(f)(q, k, v)
         want = xla_attention(q, k, v, is_causal=True)
         np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
@@ -229,7 +229,7 @@ class TestRingAttention:
             f = shard_map(
                 lambda a, b, c: ring_attention(a, b, c, "sp", causal=True),
                 mesh=mesh, in_specs=(P(None, "sp"),) * 3,
-                out_specs=P(None, "sp"), check_rep=False)
+                out_specs=P(None, "sp"), check_vma=False)
             return jnp.sum(f(q, k, v) ** 2)
 
         def dense_loss(q, k, v):
@@ -248,7 +248,7 @@ class TestRingAttention:
         loss_raw = gpt_hybrid.make_pipeline_gpt_loss(CFG, mesh, n_micro=1)
         specs = gpt.param_shardings(CFG, mp="mp", pp=None)
         f = shard_map(loss_raw, mesh=mesh, in_specs=(specs, P("dp"), P()),
-                      out_specs=P(), check_rep=False)
+                      out_specs=P(), check_vma=False)
         got = jax.jit(f)(params, toks, jax.random.PRNGKey(0))
         want = gpt.loss_fn(params, toks, CFG)
         np.testing.assert_allclose(got, want, rtol=2e-5)
