@@ -181,6 +181,25 @@ class ShardedTrainStep:
             lambda s: normalize_spec(s if s is not None else P(), self.mesh),
             param_specs, is_leaf=_leaf_is_spec,
         )
+
+        # ZeRO stages (reference sharding_optimizer.py:502,635,745 — there a
+        # program rewrite staging broadcast/reduce-scatter by hand; here a
+        # sharding-spec choice XLA lowers to the same collectives):
+        #   1: optimizer state sharded over the zero axis
+        #   2: + gradients (reduce-scatter instead of all-reduce; the
+        #        grad-accumulation buffer under gradient_merge is sharded)
+        #   3: + parameters (stored sharded; XLA all-gathers at use — FSDP)
+        zero_stage = 0
+        if self.strategy.sharding:
+            zero_stage = max(1, int(self.strategy.sharding_configs.stage))
+        zero_axis = "sharding" if self.mesh.shape.get("sharding", 1) > 1 else "dp"
+
+        def zero_spec_for(spec, v):
+            return zero_shard_spec(spec, np.shape(v), zero_axis, self.mesh) or spec
+
+        if zero_stage >= 3:
+            param_specs = jax.tree_util.tree_map(
+                zero_spec_for, param_specs, params, is_leaf=_leaf_is_spec)
         self.param_specs = param_specs
         p_shardings = jax.tree_util.tree_map(
             lambda s: NamedSharding(self.mesh, s), param_specs, is_leaf=_leaf_is_spec
@@ -190,13 +209,10 @@ class ShardedTrainStep:
         )
 
         # optimizer state: inherit param specs; ZeRO adds the sharding/dp axis
-        zero = self.strategy.sharding
-        zero_axis = "sharding" if self.mesh.shape.get("sharding", 1) > 1 else "dp"
-
         def opt_spec_for(spec, v):
-            if not zero:
+            if not zero_stage:
                 return spec
-            return zero_shard_spec(spec, v.shape, zero_axis, self.mesh) or spec
+            return zero_spec_for(spec, v)
 
         opt_specs = jax.tree_util.tree_map(
             lambda spec, v: opt_spec_for(spec, v), param_specs, self.params,
@@ -217,6 +233,20 @@ class ShardedTrainStep:
         k_steps = (self.strategy.gradient_merge_configs.k_steps
                    if self.strategy.gradient_merge else 1)
         remat = self.strategy.recompute
+
+        # ZeRO-2: gradients live (and accumulate) reduce-scattered over the
+        # zero axis; the optimizer update is shard-local and XLA all-gathers
+        # the updated params back to their stored sharding.
+        grad_shardings = None
+        if zero_stage >= 2:
+            grad_shardings = jax.tree_util.tree_map(
+                lambda spec, v: NamedSharding(self.mesh, zero_spec_for(spec, v)),
+                param_specs, self.params, is_leaf=_leaf_is_spec)
+
+        def shard_grads(g):
+            if grad_shardings is None:
+                return g
+            return jax.lax.with_sharding_constraint(g, grad_shardings)
 
         def step_fn(params, opt_state, key, lr, step, batch):
             def loss_of(p, b, k):
@@ -240,16 +270,17 @@ class ShardedTrainStep:
                     g_acc, l_acc = carry
                     b_i, k_i = xs
                     l, g = grad_fn(params, b_i, k_i)
-                    g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
-                    return (g_acc, l_acc + l), None
+                    g_acc = jax.tree_util.tree_map(jnp.add, g_acc, shard_grads(g))
+                    return (shard_grads(g_acc), l_acc + l), None
 
-                g0 = jax.tree_util.tree_map(
-                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                g0 = shard_grads(jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params))
                 (grads, loss), _ = jax.lax.scan(acc_body, (g0, 0.0), (mb, keys))
                 grads = jax.tree_util.tree_map(lambda g: g / k_steps, grads)
                 loss = loss / k_steps
             else:
                 loss, grads = grad_fn(params, batch, key)
+                grads = shard_grads(grads)
 
             new_params, new_opt = optimizer.apply_gradients(
                 grads, params, opt_state, lr=lr, step=step + 1)

@@ -424,7 +424,7 @@ def _spec_leaf(x):
 
 
 def build_gpt_train_step(cfg: gpt.GPTConfig, mesh: Mesh, optimizer,
-                         n_micro: int = 1, zero: bool = False,
+                         n_micro: int = 1, zero: bool | int = False,
                          donate: bool = True, schedule: str = "1f1b"):
     """Compile one hybrid-parallel GPT train step over ``mesh``.
 
@@ -433,6 +433,12 @@ def build_gpt_train_step(cfg: gpt.GPTConfig, mesh: Mesh, optimizer,
     — reference section_worker.cc schedule_mode 1) or "fthenb" (autodiff
     over the forward scan; residuals for every tick — schedule_mode 0).
 
+    ``zero`` is the ZeRO stage (reference sharding_optimizer.py stages):
+    False/0 = off, True/1 = optimizer state sharded, 2 = + gradients
+    (reduce-scatter), 3 = + parameters stored sharded (GSPMD FSDP — XLA
+    all-gathers at use).  Stages 2/3 compose with the pure-GSPMD path
+    (pp == 1, sp == 1) only.
+
     Returns (init_fn, step_fn, meta):
       init_fn(seed) -> GPTTrainState  (params/opt-state placed per sharding)
       step_fn(state, tokens, key, lr) -> (state, loss)   [jitted, donating]
@@ -440,6 +446,7 @@ def build_gpt_train_step(cfg: gpt.GPTConfig, mesh: Mesh, optimizer,
     """
     if schedule not in ("1f1b", "fthenb"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    zero_stage = int(zero)
     axes = dict(mesh.shape)
     pp = axes.get("pp", 1)
     mp = axes.get("mp", 1)
@@ -461,6 +468,28 @@ def build_gpt_train_step(cfg: gpt.GPTConfig, mesh: Mesh, optimizer,
     pp_ax = "pp" if pp > 1 else None
     ep_ax = "ep" if ep > 1 else None
     specs = gpt.param_shardings(cfg, mp=mp_ax, pp=pp_ax, ep=ep_ax)
+
+    # optimizer state: inherit param specs; ZeRO adds dp/sharding axis
+    from ..distributed.fleet.base import zero_shard_spec
+
+    zero_axis = "sharding" if axes.get("sharding", 1) > 1 else "dp"
+    p_abstract = jax.eval_shape(lambda k: gpt.init_params(cfg, k),
+                                jax.ShapeDtypeStruct((2,), jnp.uint32))
+
+    if zero_stage >= 2 and (pp > 1 or sp > 1):
+        raise NotImplementedError(
+            "ZeRO stage >= 2 composes with the pure-GSPMD path (pp == 1, "
+            "sp == 1) only; the manual-collective pipeline computes its own "
+            "grad reduction")
+
+    def zero_spec_for(s, leaf):
+        s = s if s is not None else P()
+        return zero_shard_spec(s, leaf.shape, zero_axis, mesh) or s
+
+    if zero_stage >= 3:
+        # params themselves stored sharded over the data axis (FSDP)
+        specs = jax.tree_util.tree_map(zero_spec_for, specs, p_abstract,
+                                       is_leaf=_spec_leaf)
     p_shard = jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s if s is not None else P()),
         specs, is_leaf=_spec_leaf)
@@ -487,19 +516,12 @@ def build_gpt_train_step(cfg: gpt.GPTConfig, mesh: Mesh, optimizer,
 
     tok_sharding = NamedSharding(mesh, tok_spec)
 
-    # optimizer state: inherit param specs; ZeRO adds dp/sharding axis
-    from ..distributed.fleet.base import zero_shard_spec
-
-    zero_axis = "sharding" if axes.get("sharding", 1) > 1 else "dp"
-
     def leaf_spec(s, shape):
         s = s if s is not None else P()
-        if zero:
+        if zero_stage:
             return zero_shard_spec(s, shape, zero_axis, mesh) or s
         return s
 
-    p_abstract = jax.eval_shape(lambda k: gpt.init_params(cfg, k),
-                                jax.ShapeDtypeStruct((2,), jnp.uint32))
     opt_abstract = jax.eval_shape(optimizer.init_state, p_abstract)
     # opt-state tree: same structure as params but leaves are tuples of arrays.
     # Broadcast each param's spec onto its tuple of state arrays.
@@ -520,12 +542,25 @@ def build_gpt_train_step(cfg: gpt.GPTConfig, mesh: Mesh, optimizer,
                             out_shardings=opt_shard)(params)
         return GPTTrainState(params, opt_state, jnp.zeros((), jnp.int32))
 
+    # ZeRO-2: gradients reduce-scattered over the zero axis; the optimizer
+    # update runs shard-local and XLA gathers updated params back to their
+    # stored sharding (a no-op gather under stage 3, where params stay
+    # sharded).
+    grad_shardings = None
+    if zero_stage >= 2:
+        grad_shardings = jax.tree_util.tree_map(
+            lambda s, leaf: NamedSharding(mesh, zero_spec_for(s, leaf)),
+            gpt.param_shardings(cfg, mp=mp_ax, pp=pp_ax, ep=ep_ax),
+            p_abstract, is_leaf=_spec_leaf)
+
     def step_fn(state: GPTTrainState, tokens, key, lr):
         if value_and_grad_fn is not None:
             loss, grads = value_and_grad_fn(state.params, tokens, key)
         else:
             loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens,
                                                       key)
+        if grad_shardings is not None:
+            grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
         new_p, new_o = optimizer.apply_gradients(
             grads, state.params, state.opt_state, lr=lr, step=state.step + 1)
         return GPTTrainState(new_p, new_o, state.step + 1), loss

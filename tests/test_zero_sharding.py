@@ -1,0 +1,164 @@
+"""ZeRO stages 1/2/3 (reference sharding_optimizer.py:502,635,745).
+
+The reference stages broadcast/reduce-scatter by program rewrite; here each
+stage is a sharding-spec choice and XLA lowers to the same collectives:
+  stage 1 — optimizer state sharded over the zero axis
+  stage 2 — + gradients reduce-scattered (the grad buffer under
+            gradient_merge is stored sharded)
+  stage 3 — + parameters stored sharded (FSDP; all-gather at use)
+
+Checks: per-device param/opt bytes shrink ~linearly in shard count, the
+compiled stage-2/3 step actually contains reduce-scatter, and losses stay
+step-for-step equal to the unsharded run (the collectives are exact).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.distributed.fleet.base import ShardedTrainStep
+from paddle_tpu.distributed.fleet.strategy import DistributedStrategy
+from paddle_tpu.optimizer import Adam, AdamW
+from paddle_tpu.text import gpt, gpt_hybrid
+
+CFG = gpt.GPTConfig(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+                    max_seq_len=64, dtype=jnp.float32)
+
+
+def mesh_of(shape, names):
+    devs = np.array(jax.devices()[: int(np.prod(shape))]).reshape(shape)
+    return Mesh(devs, names)
+
+
+def _tokens(cfg, B=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, cfg.vocab_size, (B, cfg.max_seq_len)),
+                       jnp.int32)
+
+
+def _shard_bytes(tree):
+    """Per-device addressable bytes of one device's shards."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        sh = leaf.addressable_shards[0]
+        total += np.prod(sh.data.shape) * leaf.dtype.itemsize
+    return int(total)
+
+
+def _run_steps(step_fn, state, toks, n=3):
+    key = jax.random.PRNGKey(1)
+    losses = []
+    for _ in range(n):
+        state, loss = step_fn(state, toks, key, 1e-3)
+        losses.append(float(loss))
+    return losses, state
+
+
+class TestGPTZeroStages:
+    def test_loss_parity_across_stages(self):
+        mesh = mesh_of((8,), ("dp",))
+        toks = _tokens(CFG)
+        base = None
+        for stage in (0, 1, 2, 3):
+            init_fn, step_fn, _ = gpt_hybrid.build_gpt_train_step(
+                CFG, mesh, AdamW(learning_rate=1e-3), zero=stage)
+            losses, _ = _run_steps(step_fn, init_fn(0), toks)
+            assert np.isfinite(losses).all(), (stage, losses)
+            if base is None:
+                base = losses
+            else:
+                np.testing.assert_allclose(losses, base, rtol=2e-4,
+                                           err_msg=f"stage {stage}")
+
+    def test_zero3_shards_params_linearly(self):
+        toks = _tokens(CFG)
+        bytes_by_dp = {}
+        for dp in (2, 8):
+            mesh = mesh_of((dp,), ("dp",))
+            init_fn, step_fn, _ = gpt_hybrid.build_gpt_train_step(
+                CFG, mesh, Adam(learning_rate=1e-3), zero=3)
+            state = init_fn(0)
+            bytes_by_dp[dp] = (_shard_bytes(state.params)
+                               + _shard_bytes(state.opt_state))
+            # still trains
+            losses, _ = _run_steps(step_fn, state, _tokens(CFG, B=dp), n=2)
+            assert np.isfinite(losses).all()
+        # 4x more shards -> ~4x less resident per device (small replicated
+        # leaves — norms, biases — keep it from being exactly linear)
+        assert bytes_by_dp[8] < bytes_by_dp[2] / 2.5, bytes_by_dp
+
+    def test_zero2_update_is_shard_local(self):
+        """Stage 2's compiled step gathers params back after the shard-local
+        update — an all-gather the unsharded step doesn't have.  (XLA:CPU
+        decomposes the grad reduce-scatter into all-reduce + slice; on TPU it
+        stays a reduce-scatter over ICI, so assert on the gather side.)"""
+        mesh = mesh_of((8,), ("dp",))
+        toks = _tokens(CFG)
+        hlos = {}
+        for stage in (0, 2):
+            init_fn, step_fn, _ = gpt_hybrid.build_gpt_train_step(
+                CFG, mesh, Adam(learning_rate=1e-3), zero=stage)
+            state = init_fn(0)
+            hlos[stage] = step_fn.lower(state, toks, jax.random.PRNGKey(0),
+                                        1e-3).compile().as_text()
+        assert "all-gather" not in hlos[0]
+        assert "all-gather" in hlos[2], \
+            "stage-2 update should be shard-local + param all-gather"
+
+    def test_zero_stage2_rejected_on_pipeline(self):
+        mesh = mesh_of((2, 4), ("pp", "dp"))
+        with pytest.raises(NotImplementedError):
+            gpt_hybrid.build_gpt_train_step(
+                CFG, mesh, Adam(learning_rate=1e-3), n_micro=2, zero=2)
+
+
+class TestFleetZeroStages:
+    """ShardedTrainStep (the fleet strategy compiler) honors
+    sharding_configs.stage, including the sharded grad-merge buffer."""
+
+    def _mlp_setup(self):
+        rng = np.random.default_rng(0)
+        params = {"w1": rng.standard_normal((64, 128), np.float32) * 0.02,
+                  "w2": rng.standard_normal((128, 8), np.float32) * 0.02}
+        X = rng.standard_normal((16, 64), np.float32)
+        Y = rng.integers(0, 8, (16,))
+
+        def loss_fn(p, batch, key):
+            x, y = batch
+            h = jnp.tanh(x @ p["w1"])
+            logits = h @ p["w2"]
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            return jnp.mean(lse - logits[jnp.arange(x.shape[0]), y])
+
+        return params, (X, Y.astype(np.int32)), loss_fn
+
+    @pytest.mark.parametrize("gm", [False, True])
+    def test_stage_parity_and_sharding(self, gm):
+        params, batch, loss_fn = self._mlp_setup()
+        mesh = mesh_of((8,), ("dp",))
+        from paddle_tpu.distributed.env import set_mesh
+        set_mesh(mesh)
+
+        losses_by_stage = {}
+        pbytes = {}
+        for stage in (1, 2, 3):
+            strat = DistributedStrategy()
+            strat.sharding = True
+            strat.sharding_configs = {"stage": stage}
+            if gm:
+                strat.gradient_merge = True
+                strat.gradient_merge_configs = {"k_steps": 2}
+            opt = Adam(learning_rate=1e-2)
+            step = ShardedTrainStep(loss_fn, params, opt, mesh=mesh,
+                                    strategy=strat, donate=False)
+            losses_by_stage[stage] = [float(step(batch).value)
+                                      for _ in range(3)]
+            pbytes[stage] = _shard_bytes(step.params)
+        np.testing.assert_allclose(losses_by_stage[2], losses_by_stage[1],
+                                   rtol=1e-5)
+        np.testing.assert_allclose(losses_by_stage[3], losses_by_stage[1],
+                                   rtol=1e-5)
+        # stage 3 stores params sharded 8-way
+        assert pbytes[3] <= pbytes[1] // 4, pbytes
