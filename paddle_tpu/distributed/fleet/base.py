@@ -308,7 +308,8 @@ class ShardedTrainStep:
             lambda b: jax.device_put(jnp.asarray(b), self.batch_sharding), batch)
         key = _random.next_key()
         lr = self._current_lr()
-        self._step += 1
+        # pass the 0-based step; step_fn's +1 makes Adam's first update t=1
         self.params, self.opt_state, loss = self._compiled(
             self.params, self.opt_state, key, lr, self._step, batch)
+        self._step += 1
         return Tensor(loss, stop_gradient=True)

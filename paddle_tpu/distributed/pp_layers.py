@@ -113,8 +113,8 @@ def _pack(tree, meta: _PackMeta, pad_to: int):
     leaves = jax.tree_util.tree_leaves(tree)
     if not leaves:
         return jnp.zeros((pad_to,), jnp.float32)
-    flat = [jnp.asarray(l).astype(jnp.float32).reshape(-1) for l in leaves]
-    vec = jnp.concatenate(flat) if flat else jnp.zeros((0,), jnp.float32)
+    vec = jnp.concatenate(
+        [jnp.asarray(l).astype(jnp.float32).reshape(-1) for l in leaves])
     return jnp.pad(vec, (0, pad_to - meta.size))
 
 
@@ -125,12 +125,6 @@ def _unpack(vec, meta: _PackMeta):
         leaf = lax.slice_in_dim(vec, off, off + n).reshape(shape).astype(dtype)
         leaves.append(leaf)
     return jax.tree_util.tree_unflatten(meta.treedef, leaves)
-
-
-def _state_of(layer: Layer):
-    params = {k: p.value for k, p in layer.named_parameters()}
-    bufs = {k: b.value for k, b in layer.named_buffers()}
-    return params, bufs
 
 
 def _wrap_tree(x):
@@ -234,7 +228,6 @@ class PipelineLayer(Layer):
         acc = 0.0
         for i, w in enumerate(weights):
             acc += w
-            stage = len(bounds) - 1
             remaining_items = n - (i + 1)
             remaining_stages = S - len(bounds)
             if (acc >= total * len(bounds) / S
@@ -295,20 +288,22 @@ class PipelineTrainStep:
         training = pl.training
 
         # ---- per-stage state packing (params P('pp')-stacked, shared repl.)
+        from ..jit import _split_state as _jit_split_state
+
         stage_ptrees, stage_btrees = [], []
         for s in range(S):
             pt, bt = {}, {}
             for j, it in enumerate(pl.stage_items(s)):
                 if it.kind != "layer":
                     continue
-                p, b = _state_of(it.layer)
+                p, b = _jit_split_state(it.layer)
                 pt[str(j)] = p
                 bt[str(j)] = b
             stage_ptrees.append(pt)
             stage_btrees.append(bt)
         shared_p, shared_b = {}, {}
         for key, l in pl._shared_layers.items():
-            shared_p[key], sb = _state_of(l)
+            shared_p[key], sb = _jit_split_state(l)
             if sb:
                 raise NotImplementedError(
                     "SharedLayerDesc layers with buffers are not supported "
@@ -489,10 +484,11 @@ class PipelineTrainStep:
             is_leaf=lambda a: isinstance(a, Tensor))
         key = _random.next_key()
         lr = self._current_lr()
-        self._step += 1
+        # pass the 0-based step; step_fn's +1 makes Adam's first update t=1
         self._params, self._opt_state, self._bvec, loss = self._compiled(
             self._params, self._opt_state, self._bvec, X, Y, key, lr,
             self._step)
+        self._step += 1
         return Tensor(loss, stop_gradient=True)
 
     def sync_to_model(self):

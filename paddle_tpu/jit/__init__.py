@@ -105,6 +105,15 @@ class StaticFunction:
     StaticFunction, program_translator.py:232)."""
 
     def __init__(self, fn_or_layer, input_spec=None, donate_buffers=False):
+        # dy2static: rewrite tensor-valued if/while into lax control flow
+        # (reference ProgramTranslator role); no-op when source is
+        # unavailable or the code has no convertible control flow
+        from .dy2static import convert_layer_forward, convert_to_static
+
+        if isinstance(fn_or_layer, Layer):
+            fn_or_layer = convert_layer_forward(fn_or_layer)
+        else:
+            fn_or_layer = convert_to_static(fn_or_layer)
         self._target = fn_or_layer
         self._is_layer = isinstance(fn_or_layer, Layer)
         self._input_spec = input_spec
@@ -163,6 +172,9 @@ def to_static(function=None, input_spec=None, **kwargs):
 
 
 def not_to_static(fn):
+    """Mark ``fn`` exempt from dy2static AST conversion (reference
+    paddle.jit.not_to_static escape hatch)."""
+    fn.__pt_dy2st_skip__ = True
     return fn
 
 
@@ -215,10 +227,11 @@ class TrainStep:
         arr = [b.value if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
         key = _random.next_key()
         lr = self._current_lr()
-        self._step += 1
+        # pass the 0-based step; step_fn's +1 makes Adam's first update t=1
         self._params, self._buffers, self._opt_state, loss = self._compiled(
             self._params, self._buffers, self._opt_state, key, lr, self._step, *arr
         )
+        self._step += 1
         # keep the Layer's Parameters pointing at live buffers (the originals
         # were donated into the jit) so eager eval/checkpointing keeps working
         self.sync_to_model()

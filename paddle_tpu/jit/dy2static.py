@@ -1,0 +1,577 @@
+"""dy2static: data-dependent Python control flow under ``to_static``.
+
+Reference capability: dygraph_to_static (9,106 LoC —
+program_translator.py:759 ProgramTranslator, ifelse/loop AST transformers)
+rewrites Python ``if``/``while`` on Variables into conditional_block/while
+ops.  TPU-first: the same AST rewrite targets ``lax.cond`` /
+``lax.while_loop`` — but only *dispatches* there at runtime, so conditions
+on plain Python values keep exact Python semantics (including
+short-circuiting), and only traced-tensor conditions become XLA control
+flow.
+
+The transform (per ``if``/``while`` statement):
+* names assigned in any branch become the threaded state tuple;
+* branch bodies become nested functions taking/returning that tuple
+  (reads of unassigned names resolve through the enclosing closure);
+* the statement becomes a call to :func:`convert_ifelse` /
+  :func:`convert_while`;
+* ``and`` / ``or`` / ``not`` inside the condition become
+  :func:`logical_and` etc. (thunked: Python short-circuit when concrete,
+  ``jnp.logical_*`` when traced);
+* branches containing ``return`` / ``break`` / ``continue`` are left as
+  Python, guarded by :func:`assert_py_cond` — a tensor condition there
+  raises :class:`Dy2StaticError` naming the source line (the reference
+  converts these with RETURN-flag rewrites; explicitly out of scope).
+
+Conversion is applied to the entry function/forward only (the reference's
+``convert_call`` recursion over every callee is not reproduced; sublayers
+with tensor-dependent control flow must be converted explicitly).
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import types
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor
+
+__all__ = ["convert_to_static", "Dy2StaticError", "convert_ifelse",
+           "convert_while", "logical_and", "logical_or", "logical_not"]
+
+
+class Dy2StaticError(Exception):
+    """Control-flow construct that cannot become XLA control flow; message
+    carries the original file:line."""
+
+
+def _is_traced(x):
+    v = x.value if isinstance(x, Tensor) else x
+    return isinstance(v, jax.core.Tracer)
+
+
+def _as_pred(x):
+    v = x.value if isinstance(x, Tensor) else x
+    return jnp.asarray(v).reshape(()).astype(bool)
+
+
+def _unwrap1(x):
+    return x.value if isinstance(x, Tensor) else x
+
+
+# ---------------------------------------------------------------------------
+# runtime helpers the generated code calls
+# ---------------------------------------------------------------------------
+
+class _UndefinedVar:
+    """Sentinel for names not yet bound when control flow starts (the
+    reference's UndefinedVar, dygraph_to_static/utils.py)."""
+
+    def __repr__(self):
+        return "<undefined>"
+
+
+UNDEF = _UndefinedVar()
+
+
+def state(lcls: dict, names):
+    """Build the threaded-state tuple, tolerating not-yet-bound names."""
+    return tuple(lcls.get(n, UNDEF) for n in names)
+
+
+def _arrayish(u) -> bool:
+    import numpy as _np
+
+    return isinstance(u, (jax.Array, jax.core.Tracer, _np.ndarray,
+                          bool, int, float, complex))
+
+
+def _split_state(vals: tuple):
+    """Split a state tuple into traced arrays + static residue."""
+    arrs, statics = [], []
+    for v in vals:
+        u = _unwrap1(v)
+        if _arrayish(u):
+            arrs.append(jnp.asarray(u))
+            statics.append(None)
+        else:
+            arrs.append(None)
+            statics.append(("static", u))
+    return arrs, statics
+
+
+def _loc(line_info):
+    return f"{line_info[0]}:{line_info[1]}" if line_info else "<unknown>"
+
+
+def convert_ifelse(pred, true_fn, false_fn, vals: tuple, _loc_info=None):
+    if not _is_traced(pred):
+        return true_fn(vals) if bool(_unwrap1(pred)) else false_fn(vals)
+
+    arrs, statics = _split_state(vals)
+    traced_idx = [i for i, s in enumerate(statics) if s is None]
+    operand = tuple(arrs[i] for i in traced_idx)
+
+    def wrap(fn):
+        def inner(op):
+            full = list(vals)
+            for j, i in enumerate(traced_idx):
+                full[i] = Tensor(op[j]) if isinstance(vals[i], Tensor) \
+                    else op[j]
+            out = fn(tuple(full))
+            out_arrs = []
+            for v in out:
+                u = _unwrap1(v)
+                if isinstance(u, _UndefinedVar):
+                    raise Dy2StaticError(
+                        f"at {_loc(_loc_info)}: a variable under a "
+                        f"tensor-valued `if` is only assigned in one "
+                        f"branch; assign it in both (or before the if)")
+                try:
+                    out_arrs.append(jnp.asarray(u))
+                except TypeError as e:
+                    raise Dy2StaticError(
+                        f"at {_loc(_loc_info)}: a variable assigned under a "
+                        f"tensor-valued `if` has non-tensor type "
+                        f"{type(u).__name__!r}; both branches must produce "
+                        f"jax-compatible values") from e
+            return tuple(out_arrs)
+
+        return inner
+
+    try:
+        res = lax.cond(_as_pred(pred), wrap(true_fn), wrap(false_fn), operand)
+    except TypeError as e:
+        raise Dy2StaticError(
+            f"at {_loc(_loc_info)}: `if` on a traced tensor requires both "
+            f"branches to produce matching shapes/dtypes for every assigned "
+            f"variable ({e})") from e
+    return tuple(Tensor(r) for r in res)
+
+
+def convert_while(cond_fn, body_fn, vals: tuple, _loc_info=None):
+    if not _is_traced(cond_fn(vals)):
+        while bool(_unwrap1(cond_fn(vals))):
+            vals = body_fn(vals)
+        return vals
+
+    arrs, statics = _split_state(vals)
+    traced_idx = [i for i, s in enumerate(statics) if s is None]
+    operand = tuple(arrs[i] for i in traced_idx)
+
+    def rebuild(op):
+        full = list(vals)
+        for j, i in enumerate(traced_idx):
+            full[i] = Tensor(op[j]) if isinstance(vals[i], Tensor) else op[j]
+        return tuple(full)
+
+    def cond_w(op):
+        return _as_pred(cond_fn(rebuild(op)))
+
+    def body_w(op):
+        out = body_fn(rebuild(op))
+        out_arrs = []
+        for j, i in enumerate(traced_idx):
+            u = _unwrap1(out[i])
+            out_arrs.append(jnp.asarray(u).astype(op[j].dtype).reshape(
+                op[j].shape) if hasattr(op[j], "shape") else jnp.asarray(u))
+        # statics must stay loop-invariant
+        for i, s in enumerate(statics):
+            if s is not None and out[i] is not vals[i] and out[i] != vals[i]:
+                raise Dy2StaticError(
+                    f"at {_loc(_loc_info)}: non-tensor loop variable "
+                    f"changed inside a tensor-valued `while`; hoist it or "
+                    f"make it a tensor")
+        return tuple(out_arrs)
+
+    try:
+        res = lax.while_loop(cond_w, body_w, operand)
+    except TypeError as e:
+        raise Dy2StaticError(
+            f"at {_loc(_loc_info)}: `while` on a traced tensor requires the "
+            f"body to preserve every loop variable's shape/dtype ({e})") \
+            from e
+    # NOTE: reverse-mode differentiation through the produced lax.while_loop
+    # works only when jax can transpose it (linear loop bodies); otherwise
+    # jax raises its own "Reverse-mode differentiation does not work for
+    # lax.while_loop" at transpose time — rewrite as a bounded Python `for`
+    # for training in that case.
+    full = list(vals)
+    for j, i in enumerate(traced_idx):
+        full[i] = Tensor(res[j])
+    return tuple(full)
+
+
+def logical_and(*thunks):
+    vals = []
+    for t in thunks:
+        v = t()
+        if not _is_traced(v) and not bool(_unwrap1(v)):
+            return v  # python short-circuit
+        vals.append(v)
+    out = vals[0]
+    if any(_is_traced(v) for v in vals):
+        acc = _as_pred(vals[0])
+        for v in vals[1:]:
+            acc = jnp.logical_and(acc, _as_pred(v))
+        return Tensor(acc)
+    return vals[-1]
+
+
+def logical_or(*thunks):
+    vals = []
+    for t in thunks:
+        v = t()
+        if not _is_traced(v) and bool(_unwrap1(v)):
+            return v
+        vals.append(v)
+    if any(_is_traced(v) for v in vals):
+        acc = _as_pred(vals[0])
+        for v in vals[1:]:
+            acc = jnp.logical_or(acc, _as_pred(v))
+        return Tensor(acc)
+    return vals[-1]
+
+
+def logical_not(v):
+    if _is_traced(v):
+        return Tensor(jnp.logical_not(_as_pred(v)))
+    return not bool(_unwrap1(v))
+
+
+def assert_py_cond(pred, _loc_info=None, reason=""):
+    """Guard for constructs left as Python: fails loudly on tensor preds."""
+    if _is_traced(pred):
+        raise Dy2StaticError(
+            f"at {_loc(_loc_info)}: this `if`/`while` cannot be converted "
+            f"to XLA control flow ({reason}) but its condition is a traced "
+            f"tensor; restructure the code (e.g. move the return out of the "
+            f"branch) or keep the condition a Python value")
+    return pred
+
+
+# ---------------------------------------------------------------------------
+# AST transform
+# ---------------------------------------------------------------------------
+
+_RT = "__pt_dy2st"
+
+
+def _has_control_flow(fdef) -> bool:
+    """Any if/while in the function's own statement scope (not nested
+    defs) — the only constructs the transformer touches."""
+
+    class V(ast.NodeVisitor):
+        found = False
+
+        def visit_If(self, node):
+            self.found = True
+
+        def visit_While(self, node):
+            self.found = True
+
+        def visit_FunctionDef(self, node):
+            pass
+
+        def visit_AsyncFunctionDef(self, node):
+            pass
+
+        def visit_Lambda(self, node):
+            pass
+
+    v = V()
+    for s in fdef.body:
+        v.visit(s)
+        if v.found:
+            return True
+    return False
+
+
+class _AssignedNames(ast.NodeVisitor):
+    def __init__(self):
+        self.names: set[str] = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store,)):
+            self.names.add(node.id)
+
+    def visit_AugAssign(self, node):
+        if isinstance(node.target, ast.Name):
+            self.names.add(node.target.id)
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        t = node.target
+        if isinstance(t, ast.Name):
+            self.names.add(t.id)
+        self.generic_visit(node)
+
+    # do not descend into nested scopes
+    def visit_FunctionDef(self, node):
+        pass
+
+    def visit_AsyncFunctionDef(self, node):
+        pass
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _assigned(stmts) -> list[str]:
+    v = _AssignedNames()
+    for s in stmts:
+        v.visit(s)
+    return sorted(v.names)
+
+
+class _HasReturn(ast.NodeVisitor):
+    """Return anywhere in this statement scope (not nested functions)."""
+
+    def __init__(self):
+        self.found = False
+
+    def visit_Return(self, node):
+        self.found = True
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    def visit_AsyncFunctionDef(self, node):
+        pass
+
+    def visit_Lambda(self, node):
+        pass
+
+
+class _HasEscape(_HasReturn):
+    """Return/break/continue escaping this statement level; break/continue
+    bound to an inner loop do not count."""
+
+    def visit_Break(self, node):
+        self.found = True
+
+    def visit_Continue(self, node):
+        self.found = True
+
+    def visit_For(self, node):
+        r = _HasReturn()
+        for s in node.body + node.orelse:
+            r.visit(s)
+        self.found = self.found or r.found
+
+    def visit_While(self, node):
+        self.visit_For(node)
+
+
+def _escapes(stmts) -> bool:
+    v = _HasEscape()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+class _BoolOpRewriter(ast.NodeTransformer):
+    """and/or/not inside conditions -> thunked runtime logical ops."""
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        fn = "logical_and" if isinstance(node.op, ast.And) else "logical_or"
+        thunks = [ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                               kwonlyargs=[], kw_defaults=[], kwarg=None,
+                               defaults=[]),
+            body=v) for v in node.values]
+        return ast.Call(
+            func=ast.Attribute(value=ast.Name(id=_RT, ctx=ast.Load()),
+                               attr=fn, ctx=ast.Load()),
+            args=thunks, keywords=[])
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.Call(
+                func=ast.Attribute(value=ast.Name(id=_RT, ctx=ast.Load()),
+                                   attr="logical_not", ctx=ast.Load()),
+                args=[node.operand], keywords=[])
+        return node
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self, filename: str):
+        self.filename = filename
+        self.counter = 0
+
+    def _loc_tuple(self, node):
+        return ast.Tuple(
+            elts=[ast.Constant(self.filename), ast.Constant(node.lineno)],
+            ctx=ast.Load())
+
+    def _state_tuple(self, names, ctx):
+        return ast.Tuple(elts=[ast.Name(id=n, ctx=ctx) for n in names],
+                         ctx=ctx)
+
+    def _state_load(self, names):
+        """__pt_rt.state(locals(), ['a', 'b']) — tolerates unbound names."""
+        return self._rt_call("state", [
+            ast.Call(func=ast.Name(id="locals", ctx=ast.Load()), args=[],
+                     keywords=[]),
+            ast.List(elts=[ast.Constant(n) for n in names], ctx=ast.Load())])
+
+    def _make_branch_fn(self, fname, names, body):
+        """def fname(__pt_s): (a, b) = __pt_s; <body>; return (a, b)"""
+        stmts = []
+        if names:
+            stmts.append(ast.Assign(
+                targets=[self._state_tuple(names, ast.Store())],
+                value=ast.Name(id="__pt_s", ctx=ast.Load())))
+        stmts.extend(body)
+        stmts.append(ast.Return(value=self._state_tuple(names, ast.Load())))
+        fd = ast.FunctionDef(
+            name=fname,
+            args=ast.arguments(
+                posonlyargs=[],
+                args=[ast.arg(arg="__pt_s")], vararg=None, kwonlyargs=[],
+                kw_defaults=[], kwarg=None, defaults=[]),
+            body=stmts, decorator_list=[], returns=None)
+        fd.type_params = []  # py3.12+
+        return fd
+
+    def _rt_call(self, attr, args):
+        return ast.Call(
+            func=ast.Attribute(value=ast.Name(id=_RT, ctx=ast.Load()),
+                               attr=attr, ctx=ast.Load()),
+            args=args, keywords=[])
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        test = _BoolOpRewriter().visit(node.test)
+        if _escapes(node.body) or _escapes(node.orelse):
+            node.test = self._rt_call(
+                "assert_py_cond",
+                [test, self._loc_tuple(node),
+                 ast.Constant("return/break/continue inside the branch")])
+            return node
+        i = self.counter
+        self.counter += 1
+        names = _assigned(node.body + node.orelse)
+        tf, ff = f"__pt_true_{i}", f"__pt_false_{i}"
+        out = [
+            self._make_branch_fn(tf, names, node.body or [ast.Pass()]),
+            self._make_branch_fn(ff, names, node.orelse or [ast.Pass()]),
+            ast.Assign(
+                targets=[self._state_tuple(names, ast.Store())]
+                if names else [ast.Name(id="__pt_void", ctx=ast.Store())],
+                value=self._rt_call(
+                    "convert_ifelse",
+                    [test, ast.Name(id=tf, ctx=ast.Load()),
+                     ast.Name(id=ff, ctx=ast.Load()),
+                     self._state_load(names),
+                     self._loc_tuple(node)])),
+        ]
+        return out
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        test = _BoolOpRewriter().visit(node.test)
+        if _escapes(node.body) or node.orelse:
+            node.test = self._rt_call(
+                "assert_py_cond",
+                [test, self._loc_tuple(node),
+                 ast.Constant("return/break/continue or while-else")])
+            return node
+        i = self.counter
+        self.counter += 1
+        names = _assigned(node.body)
+        cf, bf = f"__pt_wcond_{i}", f"__pt_wbody_{i}"
+        cond_fn = self._make_branch_fn(cf, names, [])
+        cond_fn.body[-1] = ast.Return(value=test)
+        out = [
+            cond_fn,
+            self._make_branch_fn(bf, names, node.body),
+            ast.Assign(
+                targets=[self._state_tuple(names, ast.Store())]
+                if names else [ast.Name(id="__pt_void", ctx=ast.Store())],
+                value=self._rt_call(
+                    "convert_while",
+                    [ast.Name(id=cf, ctx=ast.Load()),
+                     ast.Name(id=bf, ctx=ast.Load()),
+                     self._state_load(names),
+                     self._loc_tuple(node)])),
+        ]
+        return out
+
+
+def convert_to_static(fn):
+    """AST-convert ``fn`` (plain function or unbound forward); returns the
+    converted function, or ``fn`` unchanged when source is unavailable.
+    Results are cached on the function object."""
+    if inspect.ismethod(fn):  # convert the underlying function, re-bind
+        return types.MethodType(convert_to_static(fn.__func__), fn.__self__)
+    if getattr(fn, "__pt_dy2st_skip__", False):  # not_to_static escape hatch
+        return fn
+    if hasattr(fn, "__pt_dy2st_converted__"):
+        return fn.__pt_dy2st_converted__
+    if getattr(fn, "__wrapped__", None) is not None:
+        # a functools.wraps-style wrapper: getsource would unwrap to the
+        # inner def and recompiling it would silently drop the wrapper's
+        # behavior — leave such functions alone
+        return fn
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError):
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef,)):
+        return fn
+    if not _has_control_flow(fdef):
+        return fn  # nothing to convert: keep the original untouched
+    # only paddle's own jit decorators are safe to strip on recompile; any
+    # other decorator would be silently lost — skip conversion instead
+    known = {"to_static", "not_to_static"}
+    for dec in fdef.decorator_list:
+        d = dec.func if isinstance(dec, ast.Call) else dec
+        name = d.attr if isinstance(d, ast.Attribute) else getattr(d, "id", "")
+        if name not in known:
+            return fn
+    fdef.decorator_list = []
+    new_tree = _ControlFlowTransformer(
+        inspect.getsourcefile(fn) or "<unknown>").visit(tree)
+    ast.fix_missing_locations(new_tree)
+    import paddle_tpu.jit.dy2static as _rt_mod
+
+    glb = dict(fn.__globals__)
+    glb[_RT] = _rt_mod
+    # snapshot closure variables (converted code loses real closure cells)
+    if fn.__closure__:
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                glb[name] = cell.cell_contents
+            except ValueError:
+                pass
+    code = compile(new_tree, filename=inspect.getsourcefile(fn) or "<dy2st>",
+                   mode="exec")
+    ns: dict = {}
+    exec(code, glb, ns)  # noqa: S102 - compiling the user's own source
+    conv = ns[fdef.name]
+    conv = functools.wraps(fn)(conv)
+    conv.__pt_dy2st_converted__ = conv
+    try:
+        fn.__pt_dy2st_converted__ = conv
+    except (AttributeError, TypeError):
+        pass
+    return conv
+
+
+def convert_layer_forward(layer):
+    """Convert ``type(layer).forward`` and bind it onto the instance."""
+    fwd = type(layer).forward
+    conv = convert_to_static(fwd)
+    if conv is not fwd:
+        layer.forward = types.MethodType(conv, layer)
+    return layer
