@@ -27,7 +27,8 @@ from ..core.tensor import Parameter, Tensor
 from ..framework import random as _random
 from ..nn.layer_base import Layer
 
-__all__ = ["to_static", "functional_call", "TrainStep", "save", "load", "not_to_static"]
+__all__ = ["to_static", "functional_call", "TrainStep", "TranslatedLayer",
+           "save", "load", "not_to_static"]
 
 
 def _split_state(layer: Layer):
@@ -254,16 +255,69 @@ class TrainStep:
 
 
 def save(layer, path, input_spec=None, **kwargs):
-    """paddle.jit.save-alike: persists state_dict (weights) — program export
-    is the XLA compile cache, not a serialized artifact."""
+    """paddle.jit.save analog (reference dygraph/jit.py:515): persists BOTH
+    the weights (``<path>.pdparams``, for resume/fine-tune) and — when
+    ``input_spec`` fixes the serving signature — the runnable program as a
+    StableHLO artifact (``<path>.pdmodel`` + ``<path>.json``, via
+    jax.export), so :func:`load` can rebuild a callable without the
+    original Python class (the reference's TranslatedLayer round trip,
+    dygraph/io.py:1082)."""
     from ..framework.io import save as _save
 
     if isinstance(layer, StaticFunction):
         layer = layer._target
-    _save(layer.state_dict(), path + ".pdparams" if not path.endswith(".pdparams") else path)
+    prefix = path[:-9] if path.endswith(".pdparams") else path
+    _save(layer.state_dict(), prefix + ".pdparams")
+    if input_spec is not None:
+        from ..inference import save_inference_model
+
+        arrs = [s.value if isinstance(s, Tensor) else s for s in input_spec]
+        save_inference_model(prefix, layer, arrs)
+    return prefix
+
+
+class TranslatedLayer(Layer):
+    """Callable rebuilt from a saved program, no original class needed
+    (reference TranslatedLayer, dygraph/io.py:1082).  Inference-only: the
+    program is traced with frozen weights; resume training from the
+    ``.pdparams`` into the original class instead."""
+
+    def __init__(self, prefix: str):
+        super().__init__()
+        from ..inference import Config, Predictor
+
+        self._predictor = Predictor(Config(prefix))
+        from ..framework.io import load as _load
+
+        self._state = _load(prefix + ".pdparams") \
+            if __import__("os").path.exists(prefix + ".pdparams") else {}
+        self.eval()
+
+    def forward(self, *inputs):
+        arrs = [x.value if isinstance(x, Tensor) else jnp.asarray(x)
+                for x in inputs]
+        outs = self._predictor.run(arrs)
+        wrapped = tuple(Tensor(o, stop_gradient=True) for o in outs)
+        return wrapped[0] if len(wrapped) == 1 else wrapped
+
+    def state_dict(self, *a, **k):
+        return dict(self._state)
+
+    def train(self):
+        raise RuntimeError(
+            "TranslatedLayer is inference-only (frozen StableHLO program); "
+            "rebuild the original Layer and load the .pdparams to train")
 
 
 def load(path, **kwargs):
+    """paddle.jit.load analog: returns a callable :class:`TranslatedLayer`
+    when a saved program (``.pdmodel``) exists at ``path``; otherwise the
+    bare state_dict (weights-only save)."""
+    import os
+
     from ..framework.io import load as _load
 
-    return _load(path if path.endswith(".pdparams") else path + ".pdparams")
+    prefix = path[:-9] if path.endswith(".pdparams") else path
+    if os.path.exists(prefix + ".pdmodel"):
+        return TranslatedLayer(prefix)
+    return _load(prefix + ".pdparams")

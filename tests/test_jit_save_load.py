@@ -1,0 +1,59 @@
+"""paddle.jit.save/load program round trip (reference dygraph/jit.py:515
+save + dygraph/io.py:1082 TranslatedLayer).
+
+save with input_spec emits weights + StableHLO program; load rebuilds a
+callable WITHOUT the original Python class.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.jit import TranslatedLayer, load, save
+
+
+def _net():
+    return nn.Sequential(
+        nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+class TestJitSaveLoad:
+    def test_round_trip_without_class(self, tmp_path):
+        net = _net()
+        net.eval()
+        x = np.random.default_rng(0).standard_normal((3, 8)).astype(np.float32)
+        ref = np.asarray(net(paddle.to_tensor(x)).value)
+
+        prefix = str(tmp_path / "m")
+        save(net, prefix, input_spec=[paddle.to_tensor(x)])
+        del net
+
+        tl = load(prefix)
+        assert isinstance(tl, TranslatedLayer)
+        out = tl(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(out.value), ref, rtol=1e-5)
+        # weights also present for fine-tune into the original class
+        sd = tl.state_dict()
+        assert any("weight" in k for k in sd)
+
+    def test_weights_only_save_back_compat(self, tmp_path):
+        net = _net()
+        prefix = str(tmp_path / "w")
+        save(net, prefix)  # no input_spec -> weights only
+        sd = load(prefix)
+        assert isinstance(sd, dict)
+        net2 = _net()
+        net2.set_state_dict(sd)
+        x = np.ones((2, 8), np.float32)
+        np.testing.assert_allclose(
+            np.asarray(net2(paddle.to_tensor(x)).value),
+            np.asarray(net(paddle.to_tensor(x)).value), rtol=1e-6)
+
+    def test_translated_layer_refuses_training(self, tmp_path):
+        net = _net()
+        prefix = str(tmp_path / "t")
+        x = np.ones((2, 8), np.float32)
+        save(net, prefix, input_spec=[paddle.to_tensor(x)])
+        tl = load(prefix)
+        with pytest.raises(RuntimeError, match="inference-only"):
+            tl.train()
