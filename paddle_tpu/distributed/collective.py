@@ -144,16 +144,43 @@ class prim:
 
 def _run_collective(x: Tensor, body, in_spec, out_spec) -> Tensor:
     mesh = get_mesh()
-    fn = _shard_map(body, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec)
+    fn = _shard_map(body, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec,
+                    check_vma=False)
     v = x.value if isinstance(x, Tensor) else x
+    # reshard onto the mesh (eager tensors are usually committed to one
+    # device; the collective needs the stacked layout distributed)
+    v = jax.device_put(v, NamedSharding(mesh, in_spec))
     out = jax.jit(fn)(v)
     return Tensor(out)
 
 
+def _check_stacked(tensor, ax, opname):
+    """Eager collectives use the STACKED-PER-RANK convention: under the
+    single controller there is no 'my rank's tensor' — the reference's
+    per-rank inputs are represented as ONE global array whose leading dim
+    concatenates every rank's contribution (dim0 = group_size * per_rank
+    rows).  Anything else is silently wrong, so validate loudly."""
+    from .env import axis_size
+
+    n = axis_size(ax)
+    v = tensor.value if isinstance(tensor, Tensor) else tensor
+    shape = jnp.shape(v)
+    if not shape or shape[0] % n:
+        raise ValueError(
+            f"{opname}: leading dim {shape[0] if shape else '<scalar>'} "
+            f"must be a multiple of group size {n} — eager collectives "
+            f"take the stacked-per-rank layout (rank i's tensor at rows "
+            f"[i*B, (i+1)*B)); a replicated per-rank tensor must be "
+            f"stacked/tiled first (see distributed/collective.py docstring)")
+    return n
+
+
 def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
-    """Input: global array sharded on the group axis' leading dim (each shard
-    = one rank's contribution).  Output: replicated reduced value."""
+    """Stacked-per-rank input [n*B, ...] → in-place result [B, ...]
+    replicated: the sum (or max/min/avg/prod) over the n rank blocks —
+    reference all_reduce semantics under a single controller."""
     ax = _axis_of(group)
+    _check_stacked(tensor, ax, "all_reduce")
     out = _run_collective(
         tensor,
         lambda x: prim.all_reduce(x, op, ax),
@@ -164,11 +191,9 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
 
 
 def all_gather(tensor_list, tensor: Tensor, group=None, sync_op=True):
-    """Each shard contributes; result (list of per-rank tensors) replicated."""
+    """Stacked-per-rank input; result (list of per-rank tensors) replicated."""
     ax = _axis_of(group)
-    from .env import axis_size
-
-    n = axis_size(ax)
+    n = _check_stacked(tensor, ax, "all_gather")
     gathered = _run_collective(
         tensor, lambda x: prim.all_gather(x, ax, axis=0), P(ax), P(),
     )
@@ -180,6 +205,7 @@ def all_gather(tensor_list, tensor: Tensor, group=None, sync_op=True):
 
 def reduce_scatter(tensor: Tensor, op=ReduceOp.SUM, group=None):
     ax = _axis_of(group)
+    _check_stacked(tensor, ax, "reduce_scatter")
     return _run_collective(
         tensor, lambda x: prim.reduce_scatter(x, ax, axis=0), P(ax), P(ax),
     )
@@ -187,6 +213,7 @@ def reduce_scatter(tensor: Tensor, op=ReduceOp.SUM, group=None):
 
 def broadcast(tensor: Tensor, src=0, group=None, sync_op=True):
     ax = _axis_of(group)
+    _check_stacked(tensor, ax, "broadcast")
     out = _run_collective(
         tensor, lambda x: prim.broadcast(x, src, ax), P(ax), P(),
     )
@@ -200,12 +227,24 @@ def reduce(tensor: Tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 
 
 def scatter(tensor: Tensor, tensor_list=None, src=0, group=None, sync_op=True):
-    """Global→sharded: slice src's data across the axis."""
+    """Global→sharded: slice the source data across the axis.
+
+    ``src`` is accepted for reference-API parity but is meaningless under a
+    single controller: there is only one copy of ``tensor_list`` (it IS the
+    source rank's data)."""
     ax = _axis_of(group)
+    from .env import axis_size
+
+    n = axis_size(ax)
     if tensor_list is not None:
+        if len(tensor_list) != n:
+            raise ValueError(
+                f"scatter: tensor_list has {len(tensor_list)} entries; the "
+                f"group size is {n} (one tensor per rank)")
         src_val = jnp.concatenate([t.value if isinstance(t, Tensor) else t
                                    for t in tensor_list], axis=0)
     else:
+        _check_stacked(tensor, ax, "scatter")
         src_val = tensor.value
     mesh = get_mesh()
     sharded = jax.device_put(src_val, NamedSharding(mesh, P(ax)))
@@ -216,8 +255,15 @@ def scatter(tensor: Tensor, tensor_list=None, src=0, group=None, sync_op=True):
 def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
     ax = _axis_of(group)
     if isinstance(in_tensor_list, (list, tuple)):
+        from .env import axis_size
+
+        if len(in_tensor_list) != axis_size(ax):
+            raise ValueError(
+                f"alltoall: {len(in_tensor_list)} tensors for a group of "
+                f"size {axis_size(ax)} (need one per rank)")
         x = Tensor(jnp.concatenate([t.value for t in in_tensor_list], axis=0))
     else:
+        _check_stacked(in_tensor_list, ax, "alltoall")
         x = in_tensor_list
     out = _run_collective(
         x, lambda v: prim.all_to_all(v, ax, split_axis=0, concat_axis=0), P(ax), P(ax),

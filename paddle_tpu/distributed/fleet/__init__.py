@@ -6,8 +6,10 @@ from . import comm_opt  # noqa: F401
 from . import dataset  # noqa: F401  (InMemoryDataset / QueueDataset)
 
 
-def init(role_maker=None, is_collective=True, strategy=None):
-    return fleet.init(role_maker, is_collective, strategy)
+def init(role_maker=None, is_collective=True, strategy=None,
+         allow_degrade=False):
+    return fleet.init(role_maker, is_collective, strategy,
+                      allow_degrade=allow_degrade)
 
 
 def distributed_optimizer(optimizer, strategy=None):

@@ -39,13 +39,29 @@ class Fleet:
         self._hcg: HybridCommunicateGroup | None = None
         self._is_initialized = False
 
-    def init(self, role_maker=None, is_collective=True, strategy=None):
+    def init(self, role_maker=None, is_collective=True, strategy=None,
+             allow_degrade=False):
         self._strategy = strategy or DistributedStrategy()
         shape = self._strategy.mesh_shape()
-        # degrade axes that exceed available devices (single-chip dev loop)
         n = len(jax.devices())
         need = int(np.prod(list(shape.values())))
         if need > n:
+            # a silently-degraded mesh runs a COMPLETELY different program
+            # (e.g. 4-way mp collapses to dp on 1 chip) — only do it when
+            # the caller opted in (single-chip dev loop)
+            if not allow_degrade:
+                raise RuntimeError(
+                    f"fleet.init: strategy mesh {shape} needs {need} "
+                    f"devices but only {n} are visible; pass "
+                    f"allow_degrade=True to collapse to {{'dp': {n}}} for "
+                    f"a dev loop, or fix hybrid_configs degrees")
+            import warnings
+
+            warnings.warn(
+                f"fleet.init: degrading mesh {shape} -> {{'dp': {n}}} "
+                f"({need} devices requested, {n} visible); parallelism "
+                f"semantics differ from the requested strategy",
+                stacklevel=2)
             shape = {"dp": n}
         init_parallel_env(shape)
         self._hcg = HybridCommunicateGroup()

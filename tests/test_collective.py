@@ -1,0 +1,87 @@
+"""Eager collective API semantics (reference collective.py all_reduce :413,
+all_gather :587, scatter :665, alltoall :1455) under the single-controller
+stacked-per-rank convention, plus fleet.init mesh-degrade safety."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import distributed as dist
+from paddle_tpu.distributed import collective
+from paddle_tpu.distributed.fleet import Fleet
+from paddle_tpu.distributed.fleet.strategy import DistributedStrategy
+
+
+@pytest.fixture(autouse=True)
+def _env():
+    dist.init_parallel_env({"dp": 8})
+    yield
+
+
+class TestEagerCollectives:
+    def test_all_reduce_stacked(self):
+        # 8 ranks, each contributing [2,3] block of ones*rank
+        blocks = np.stack([np.full((2, 3), r, np.float32) for r in range(8)])
+        t = paddle.to_tensor(blocks.reshape(16, 3))
+        collective.all_reduce(t)
+        np.testing.assert_allclose(np.asarray(t.value),
+                                   np.full((2, 3), sum(range(8))))
+
+    def test_all_reduce_rejects_bad_leading_dim(self):
+        t = paddle.to_tensor(np.ones((3, 4), np.float32))  # 3 % 8 != 0
+        with pytest.raises(ValueError, match="stacked-per-rank"):
+            collective.all_reduce(t)
+
+    def test_all_gather_list(self):
+        blocks = np.stack([np.full((1, 2), r, np.float32) for r in range(8)])
+        t = paddle.to_tensor(blocks.reshape(8, 2))
+        out: list = []
+        collective.all_gather(out, t)
+        assert len(out) == 8
+        np.testing.assert_allclose(np.asarray(out[3].value), [[3, 3]])
+
+    def test_reduce_scatter(self):
+        # per-rank input [8,2] (one row per destination rank), stacked [64,2]
+        t = paddle.to_tensor(np.ones((64, 2), np.float32))
+        out = collective.reduce_scatter(t)
+        # rank i keeps the sum over ranks of their i-th row block
+        np.testing.assert_allclose(np.asarray(out.value),
+                                   np.full((8, 2), 8.0))
+
+    def test_scatter_validates_list_length(self):
+        t = paddle.to_tensor(np.zeros((8, 2), np.float32))
+        with pytest.raises(ValueError, match="one tensor per rank"):
+            collective.scatter(t, [paddle.to_tensor(np.ones((1, 2)))] * 3)
+
+    def test_alltoall_validates_list_length(self):
+        with pytest.raises(ValueError, match="one per rank"):
+            collective.alltoall([paddle.to_tensor(np.ones((1, 2)))] * 3, [])
+
+    def test_broadcast(self):
+        blocks = np.stack([np.full((1, 2), r, np.float32) for r in range(8)])
+        t = paddle.to_tensor(blocks.reshape(8, 2))
+        collective.broadcast(t, src=5)
+        np.testing.assert_allclose(np.asarray(t.value), [[5, 5]])
+
+
+class TestFleetInitSafety:
+    def test_oversized_mesh_raises_without_opt_in(self):
+        strat = DistributedStrategy()
+        strat.hybrid_configs = {"dp_degree": 4, "mp_degree": 8}  # 32 > 8
+        with pytest.raises(RuntimeError, match="allow_degrade"):
+            Fleet().init(strategy=strat)
+
+    def test_oversized_mesh_degrades_with_opt_in(self):
+        strat = DistributedStrategy()
+        strat.hybrid_configs = {"dp_degree": 4, "mp_degree": 8}
+        with pytest.warns(UserWarning, match="degrading mesh"):
+            f = Fleet().init(strategy=strat, allow_degrade=True)
+        assert f._is_initialized
+
+    def test_fitting_mesh_ok(self):
+        strat = DistributedStrategy()
+        strat.hybrid_configs = {"dp_degree": 4, "mp_degree": 2}
+        f = Fleet().init(strategy=strat)
+        assert f._is_initialized
