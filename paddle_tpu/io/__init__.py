@@ -26,7 +26,8 @@ from ..framework import random as _random
 __all__ = [
     "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset", "ChainDataset",
     "Subset", "random_split", "BatchSampler", "Sampler", "SequenceSampler",
-    "RandomSampler", "DistributedBatchSampler", "DataLoader", "default_collate_fn",
+    "RandomSampler", "DistributedBatchSampler", "DataLoader", "FileDataset",
+    "default_collate_fn",
 ]
 
 
@@ -217,6 +218,37 @@ class DistributedBatchSampler(BatchSampler):
         return math.ceil(self.num_samples / self.batch_size)
 
 
+class FileDataset(IterableDataset):
+    """Fixed-record binary shards read by the native C++ feeder
+    (_native/io_runtime.cpp — the reference's C++ DataFeed role,
+    framework/data_feed.h:305).  A DataLoader over a FileDataset bypasses
+    the Python per-sample path entirely: the C++ thread pool packs whole
+    batches and Python only wraps + device-prefetches them."""
+
+    def __init__(self, files, record_len: int, dtype=np.int32,
+                 num_threads: int = 4, shuffle_window: int = 0, seed: int = 0):
+        self.files = list(files)
+        self.record_len = int(record_len)
+        self.dtype = np.dtype(dtype)
+        self.num_threads = num_threads
+        self.shuffle_window = shuffle_window
+        self.seed = seed
+
+    def reader(self, batch_size: int):
+        from .native_reader import TokenShardReader
+
+        return TokenShardReader(
+            self.files, self.record_len, batch_size,
+            num_threads=self.num_threads, dtype=self.dtype,
+            seed=self.seed, shuffle_window=self.shuffle_window)
+
+    def __iter__(self):
+        # sample-at-a-time fallback (plain Python path); DataLoader uses
+        # .reader() for whole batches instead
+        for arr in self.reader(batch_size=1):
+            yield arr[0]
+
+
 def default_collate_fn(batch):
     sample = batch[0]
     if isinstance(sample, (tuple, list)):
@@ -232,56 +264,199 @@ def default_collate_fn(batch):
     return to_tensor(arr)
 
 
+def _to_device(batch):
+    """Start the host→device transfer for every array in the batch (PJRT
+    runs the DMA asynchronously; holding the result in the prefetch queue
+    is what overlaps it with the consumer's compute)."""
+    import jax
+
+    if isinstance(batch, Tensor):
+        return Tensor(jax.device_put(batch.value),
+                      stop_gradient=batch.stop_gradient)
+    if isinstance(batch, (tuple, list)):
+        return type(batch)(_to_device(b) for b in batch)
+    if isinstance(batch, dict):
+        return {k: _to_device(v) for k, v in batch.items()}
+    return jax.device_put(np.asarray(batch))
+
+
+class _Sentinel:
+    pass
+
+
+_END = _Sentinel()
+
+
+class _PipelineState:
+    """Shared state of one prefetch pipeline run.  Thread closures hold THIS
+    object (never the iterator), so an abandoned iterator can be
+    garbage-collected — its weakref.finalize fires :meth:`shutdown`, the
+    timeout-based puts/waits observe ``stop``, and every thread exits."""
+
+    def __init__(self, nw: int, depth: int):
+        self.stop = threading.Event()
+        self.idx_q: queue.Queue = queue.Queue(maxsize=2 * nw)
+        self.results: dict[int, object] = {}
+        self.cond = threading.Condition()
+        self.total: int | None = None
+        self.next_needed = 0
+        self.err: BaseException | None = None
+        self.dev_q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+
+    def fail(self, e: BaseException):
+        with self.cond:
+            if self.err is None:
+                self.err = e
+            self.cond.notify_all()
+
+    def put_stopable(self, q: queue.Queue, item) -> bool:
+        """Bounded put that gives up when the pipeline is shut down."""
+        while not self.stop.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def shutdown(self):
+        self.stop.set()
+        with self.cond:
+            self.cond.notify_all()
+        try:  # drop device-resident batches an abandoned consumer never took
+            while True:
+                self.dev_q.get_nowait()
+        except queue.Empty:
+            pass
+
+
+def _run_pipeline(st: _PipelineState, loader, nw: int):
+    """Start feeder / collate-worker / device-stage threads over ``st``.
+    Deliberately a free function: closures capture ``st`` and ``loader``
+    only, keeping the iterator object collectable (see _PipelineState)."""
+    ahead_bound = 2 * nw + 2  # collated-but-unconsumed host batches
+
+    def feeder():
+        count = 0
+        try:
+            for i, idxs in enumerate(loader.batch_sampler):
+                if not st.put_stopable(st.idx_q, (i, idxs)):
+                    return
+                count = i + 1
+        except BaseException as e:  # surfaced at the consumer
+            st.fail(e)
+        with st.cond:
+            st.total = count
+            st.cond.notify_all()
+        for _ in range(nw):
+            if not st.put_stopable(st.idx_q, None):
+                return
+
+    def worker():
+        while not st.stop.is_set():
+            try:
+                item = st.idx_q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            if item is None:
+                return
+            i, idxs = item
+            try:
+                samples = [loader.dataset[j] for j in idxs]
+                batch = loader.collate_fn(samples)
+            except BaseException as e:
+                st.fail(e)
+                return
+            with st.cond:
+                # backpressure: collation may run at most ahead_bound
+                # batches past the consumer — EXCEPT the batch the merge
+                # stage needs next, which must always land (no deadlock)
+                while (st.err is None and not st.stop.is_set()
+                       and i > st.next_needed
+                       and len(st.results) >= ahead_bound):
+                    st.cond.wait(timeout=0.2)
+                if st.stop.is_set():
+                    return
+                st.results[i] = batch
+                st.cond.notify_all()
+
+    def ordered():
+        while True:
+            with st.cond:
+                n = st.next_needed
+                while (st.err is None and not st.stop.is_set()
+                       and (st.total is None or n < st.total)
+                       and n not in st.results):
+                    st.cond.wait(timeout=0.5)
+                if st.err is not None or st.stop.is_set():
+                    return
+                if st.total is not None and n >= st.total \
+                        and n not in st.results:
+                    return
+                batch = st.results.pop(n)
+                st.next_needed = n + 1
+                st.cond.notify_all()
+            yield batch
+
+    def device_stage():
+        try:
+            for b in ordered():
+                if not st.put_stopable(st.dev_q, _to_device(b)):
+                    return
+        except BaseException as e:
+            st.fail(e)
+        finally:
+            st.put_stopable(st.dev_q, _END) or None
+
+    threads = [threading.Thread(target=feeder, daemon=True)]
+    threads += [threading.Thread(target=worker, daemon=True)
+                for _ in range(nw)]
+    threads.append(threading.Thread(target=device_stage, daemon=True))
+    for t in threads:
+        t.start()
+    return threads
+
+
 class _PrefetchIter:
-    """Thread-pool loader + device prefetch queue (buffered_reader analog)."""
+    """Multi-stage loader pipeline (the buffered_reader.cc analog):
+
+    feeder thread → bounded index queue → ``num_workers`` collate threads
+    (numpy assembly releases the GIL, bounded look-ahead) → in-order merge
+    → device stage whose bounded queue (``prefetch_factor`` deep) holds
+    DEVICE-resident batches ahead of the consumer.  Indices stream lazily;
+    worker/feeder failures propagate; abandoning the iterator shuts the
+    pipeline down via weakref.finalize (threads never reference the
+    iterator)."""
 
     def __init__(self, loader):
-        self.loader = loader
-        self.batch_iter = iter(loader.batch_sampler)
-        self.out_q: queue.Queue = queue.Queue(maxsize=loader.prefetch_factor)
-        self.workers = []
-        self._stop = threading.Event()
-        self._idx_q: queue.Queue = queue.Queue()
-        self._results: dict[int, object] = {}
-        self._results_lock = threading.Condition()
-        self._n_batches = 0
-        for i, idxs in enumerate(self.batch_iter):
-            self._idx_q.put((i, idxs))
-            self._n_batches += 1
-        self._next_emit = 0
-        nw = max(1, loader.num_workers)
-        for _ in range(nw):
-            t = threading.Thread(target=self._worker, daemon=True)
-            t.start()
-            self.workers.append(t)
+        import weakref
 
-    def _worker(self):
-        while not self._stop.is_set():
-            try:
-                i, idxs = self._idx_q.get_nowait()
-            except queue.Empty:
-                return
-            samples = [self.loader.dataset[j] for j in idxs]
-            batch = self.loader.collate_fn(samples)
-            with self._results_lock:
-                self._results[i] = batch
-                self._results_lock.notify_all()
+        nw = max(1, loader.num_workers)
+        st = _PipelineState(nw, loader.prefetch_factor)
+        self._st = st
+        self._finished = False
+        _run_pipeline(st, loader, nw)
+        self._finalizer = weakref.finalize(self, _PipelineState.shutdown, st)
 
     def __iter__(self):
         return self
 
     def __next__(self):
-        if self._next_emit >= self._n_batches:
+        if self._finished:
             raise StopIteration
-        with self._results_lock:
-            while self._next_emit not in self._results:
-                self._results_lock.wait(timeout=60.0)
-            batch = self._results.pop(self._next_emit)
-        self._next_emit += 1
-        return batch
+        item = self._st.dev_q.get()
+        if isinstance(item, _Sentinel):
+            self._finished = True
+            err = self._st.err
+            self._st.shutdown()
+            if err is not None:
+                raise err
+            raise StopIteration
+        return item
 
-    def __del__(self):
-        self._stop.set()
+    def close(self):
+        self._finished = True
+        self._finalizer()
 
 
 class DataLoader:
@@ -307,11 +482,29 @@ class DataLoader:
             self.drop_last = drop_last
 
     def __iter__(self):
+        if isinstance(self.dataset, FileDataset):
+            return self._iter_native()
         if self._iterable_mode:
             return self._iter_iterable()
         if self.num_workers > 0:
             return _PrefetchIter(self)
         return self._iter_single()
+
+    def _iter_native(self):
+        """C++ feeder → Tensor wrap → device prefetch queue."""
+        from .native_reader import DevicePrefetcher
+
+        bs = getattr(self, "batch_size", None) or \
+            getattr(self.batch_sampler, "batch_size", 1)
+        reader = self.dataset.reader(bs)
+        pf = DevicePrefetcher(reader, depth=self.prefetch_factor)
+        try:
+            for arr in pf:
+                yield Tensor(arr, stop_gradient=True)
+        finally:
+            # early break must not leak the C++ feeder threads/queue
+            pf.close()
+            reader.close()
 
     def _iter_single(self):
         for idxs in self.batch_sampler:

@@ -122,6 +122,7 @@ class DevicePrefetcher:
 
         self._out: "queue.Queue" = queue.Queue(maxsize=depth)
         self._src = iter(it)
+        self._stop = threading.Event()
 
         def put(x):
             if sharding is not None:
@@ -130,19 +131,40 @@ class DevicePrefetcher:
                 return jax.device_put(x, device)
             return jax.device_put(x)
 
+        def put_q(item) -> bool:
+            # bounded put that observes close() so an abandoned consumer
+            # doesn't pin this thread (and the source reader) forever
+            while not self._stop.is_set():
+                try:
+                    self._out.put(item, timeout=0.2)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
         self._err: BaseException | None = None
 
         def worker():
             try:
                 for item in self._src:
-                    self._out.put(put(item))
+                    if not put_q(put(item)):
+                        return
             except BaseException as e:  # surfaced to the consumer, not stderr
                 self._err = e
             finally:
-                self._out.put(None)
+                put_q(None)
 
         self._t = threading.Thread(target=worker, daemon=True)
         self._t.start()
+
+    def close(self):
+        """Stop the prefetch thread and drop queued batches."""
+        self._stop.set()
+        try:
+            while True:
+                self._out.get_nowait()
+        except queue.Empty:
+            pass
 
     def __iter__(self):
         while True:
