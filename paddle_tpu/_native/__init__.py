@@ -50,6 +50,32 @@ def load(name: str) -> ctypes.CDLL:
         return _LIBS[name]
 
 
+def ps_table() -> ctypes.CDLL:
+    """Sparse-table shard kernel (ps_table.cpp — common_sparse_table role)."""
+    lib = load("ps_table")
+    if not getattr(lib, "_sigs_set", False):
+        c = ctypes
+        u64, ptr, cstr = c.c_uint64, c.c_void_p, c.c_char_p
+        i64p = c.POINTER(c.c_int64)
+        f32p = c.POINTER(c.c_float)
+        lib.pst_create.restype = ptr
+        lib.pst_create.argtypes = [u64, u64, u64, c.c_float]
+        lib.pst_destroy.argtypes = [ptr]
+        lib.pst_rows.restype = u64
+        lib.pst_rows.argtypes = [ptr]
+        lib.pst_dim.restype = u64
+        lib.pst_dim.argtypes = [ptr]
+        lib.pst_pull.argtypes = [ptr, i64p, u64, f32p]
+        lib.pst_push_adagrad.argtypes = [ptr, i64p, f32p, u64, c.c_float,
+                                         c.c_float]
+        lib.pst_save.restype = c.c_int
+        lib.pst_save.argtypes = [ptr, cstr]
+        lib.pst_load.restype = c.c_int
+        lib.pst_load.argtypes = [ptr, cstr]
+        lib._sigs_set = True
+    return lib
+
+
 def io_runtime() -> ctypes.CDLL:
     lib = load("io_runtime")
     if not getattr(lib, "_sigs_set", False):

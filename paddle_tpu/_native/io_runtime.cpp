@@ -165,11 +165,21 @@ class FileFeeder {
       }
       std::fclose(f);
     }
-    // drain reservoir + partial batch (only full batches are emitted)
+    // drain reservoir, then emit the trailing PARTIAL batch too — dropping
+    // the tail would silently lose up to nthreads*(batch-1) records per
+    // epoch (the reference data feed delivers tail batches; consumers that
+    // want drop_last semantics filter short batches themselves)
     for (auto& rec : reservoir) {
       packed.insert(packed.end(), rec.begin(), rec.end());
       emit_if_full();
       if (q_->closed()) break;
+    }
+    if (!packed.empty() && !q_->closed()) {
+      Buffer b;
+      b.size = packed.size();
+      b.data = std::make_unique<uint8_t[]>(b.size);
+      std::memcpy(b.data.get(), packed.data(), b.size);
+      q_->Push(std::move(b));
     }
     if (done_.fetch_add(1) + 1 == nthreads_) q_->Close();
   }

@@ -1,0 +1,142 @@
+// Sparse-table shard kernel: the parameter-server data path in native code.
+//
+// Reference capability: CommonSparseTable (fluid/distributed/table/
+// common_sparse_table.cc) — shard-hashed embedding rows with per-row
+// adagrad, duplicate-id merge on push, and raw save/load.  The RPC layer
+// above this lives in Python (distributed/ps_service.py, the brpc_ps_*
+// role); this file owns the hot loops: pull gather, merged adagrad push.
+//
+// Layout: rows [R, D] f32 + adagrad accumulator [R] f32, contiguous.
+// All ids here are LOCAL row indices (the client maps global id ->
+// (server = id % S, local = id / S)).
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Table {
+  uint64_t rows;
+  uint64_t dim;
+  std::vector<float> data;   // [rows * dim]
+  std::vector<float> accum;  // [rows]
+  std::mutex mu;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pst_create(uint64_t rows, uint64_t dim, uint64_t seed,
+                 float init_range) {
+  auto* t = new Table();
+  t->rows = rows;
+  t->dim = dim;
+  t->data.resize(rows * dim);
+  t->accum.assign(rows, 0.0f);
+  std::mt19937_64 rng(seed);
+  std::uniform_real_distribution<float> dist(-init_range, init_range);
+  for (auto& v : t->data) v = dist(rng);
+  return t;
+}
+
+void pst_destroy(void* h) { delete static_cast<Table*>(h); }
+
+uint64_t pst_rows(void* h) { return static_cast<Table*>(h)->rows; }
+uint64_t pst_dim(void* h) { return static_cast<Table*>(h)->dim; }
+
+// out[i, :] = rows[ids[i], :]
+void pst_pull(void* h, const int64_t* ids, uint64_t n, float* out) {
+  auto* t = static_cast<Table*>(h);
+  std::lock_guard<std::mutex> lk(t->mu);
+  const uint64_t D = t->dim;
+  for (uint64_t i = 0; i < n; ++i) {
+    const int64_t r = ids[i];
+    if (r < 0 || (uint64_t)r >= t->rows) {
+      std::memset(out + i * D, 0, D * sizeof(float));
+      continue;
+    }
+    std::memcpy(out + i * D, t->data.data() + (uint64_t)r * D,
+                D * sizeof(float));
+  }
+}
+
+// Merged adagrad push (reference push_sparse merge + per-row adagrad):
+// duplicate ids' grads are summed first, then per unique row
+//   accum[r] += mean(g^2);  rows[r] -= lr * g / (sqrt(accum[r]) + eps)
+void pst_push_adagrad(void* h, const int64_t* ids, const float* grads,
+                      uint64_t n, float lr, float eps) {
+  auto* t = static_cast<Table*>(h);
+  const uint64_t D = t->dim;
+  // merge duplicates outside the lock
+  std::unordered_map<int64_t, uint64_t> slot;  // id -> merged index
+  slot.reserve(n);
+  std::vector<int64_t> uids;
+  std::vector<float> merged;
+  uids.reserve(n);
+  merged.reserve(n * D);
+  for (uint64_t i = 0; i < n; ++i) {
+    const int64_t r = ids[i];
+    if (r < 0 || (uint64_t)r >= t->rows) continue;
+    auto it = slot.find(r);
+    if (it == slot.end()) {
+      slot.emplace(r, uids.size());
+      uids.push_back(r);
+      merged.insert(merged.end(), grads + i * D, grads + (i + 1) * D);
+    } else {
+      float* dst = merged.data() + it->second * D;
+      const float* src = grads + i * D;
+      for (uint64_t d = 0; d < D; ++d) dst[d] += src[d];
+    }
+  }
+  std::lock_guard<std::mutex> lk(t->mu);
+  for (uint64_t u = 0; u < uids.size(); ++u) {
+    const uint64_t r = (uint64_t)uids[u];
+    const float* g = merged.data() + u * D;
+    float sq = 0.0f;
+    for (uint64_t d = 0; d < D; ++d) sq += g[d] * g[d];
+    t->accum[r] += sq / (float)D;
+    const float scale = lr / (std::sqrt(t->accum[r]) + eps);
+    float* row = t->data.data() + r * D;
+    for (uint64_t d = 0; d < D; ++d) row[d] -= scale * g[d];
+  }
+}
+
+// raw snapshot: [rows, dim] u64 header + data + accum
+int pst_save(void* h, const char* path) {
+  auto* t = static_cast<Table*>(h);
+  std::lock_guard<std::mutex> lk(t->mu);
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return -1;
+  uint64_t hdr[2] = {t->rows, t->dim};
+  std::fwrite(hdr, sizeof(uint64_t), 2, f);
+  std::fwrite(t->data.data(), sizeof(float), t->data.size(), f);
+  std::fwrite(t->accum.data(), sizeof(float), t->accum.size(), f);
+  std::fclose(f);
+  return 0;
+}
+
+int pst_load(void* h, const char* path) {
+  auto* t = static_cast<Table*>(h);
+  std::lock_guard<std::mutex> lk(t->mu);
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  uint64_t hdr[2];
+  if (std::fread(hdr, sizeof(uint64_t), 2, f) != 2 || hdr[0] != t->rows ||
+      hdr[1] != t->dim) {
+    std::fclose(f);
+    return -2;
+  }
+  size_t r1 = std::fread(t->data.data(), sizeof(float), t->data.size(), f);
+  size_t r2 = std::fread(t->accum.data(), sizeof(float), t->accum.size(), f);
+  std::fclose(f);
+  return (r1 == t->data.size() && r2 == t->accum.size()) ? 0 : -3;
+}
+
+}  // extern "C"

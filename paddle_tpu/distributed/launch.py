@@ -43,6 +43,11 @@ def parse_args(argv=None):
                    help=">1 simulates a multi-host job on one machine (CPU)")
     p.add_argument("--max_restarts", type=int, default=0)
     p.add_argument("--log_dir", default=None)
+    p.add_argument("--server_num", type=int, default=0,
+                   help="parameter-server mode: spawn N table servers "
+                        "(reference ParameterServerLauncher)")
+    p.add_argument("--worker_num", type=int, default=1,
+                   help="parameter-server mode: trainer process count")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -103,7 +108,95 @@ class TrainerProc:
             self._log = None
 
 
+def launch_ps(args) -> int:
+    """Parameter-server pod: N table servers + M trainer workers
+    (reference ParameterServerLauncher, fleet/launch_utils.py:788).
+    Servers run paddle_tpu.distributed.ps_service; workers get
+    PADDLE_PSERVER_ENDPOINTS / TRAINING_ROLE / PADDLE_TRAINER_ID env."""
+    import tempfile
+
+    log_dir = args.log_dir
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+    tmp = tempfile.mkdtemp(prefix="pt_ps_")
+    servers: list[TrainerProc] = []
+    for i in range(args.server_num):
+        ready = os.path.join(tmp, f"ep{i}.txt")
+        cmd = [sys.executable, "-u", "-m", "paddle_tpu.distributed.ps_service",
+               "--port", "0", "--server_idx", str(i),
+               "--num_servers", str(args.server_num), "--ready_path", ready]
+        env = dict(os.environ)
+        env["TRAINING_ROLE"] = "PSERVER"
+        env["PADDLE_TPU_LIGHT_IMPORT"] = "1"  # servers never need jax
+        log = os.path.join(log_dir, f"server.{i}.log") if log_dir else None
+        sp = TrainerProc(cmd, env, log, i)
+        sp.ready_path = ready
+        servers.append(sp)
+    for sp in servers:
+        sp.start()
+    endpoints = []
+    deadline = time.time() + 120
+    for sp in servers:
+        while not (os.path.exists(sp.ready_path)
+                   and os.path.getsize(sp.ready_path)):
+            if sp.poll() not in (None,):
+                for s in servers:
+                    s.terminate()
+                print(f"[launch] ps server {sp.rank} died during startup",
+                      file=sys.stderr)
+                return 1
+            if time.time() > deadline:
+                for s in servers:
+                    s.terminate()
+                print("[launch] ps servers did not come up", file=sys.stderr)
+                return 1
+            time.sleep(0.05)
+        endpoints.append(open(sp.ready_path).read().strip())
+
+    workers: list[TrainerProc] = []
+    for r in range(args.worker_num):
+        cmd = [sys.executable, "-u", args.training_script,
+               *args.training_script_args]
+        env = dict(os.environ)
+        env.pop("PADDLE_TPU_LIGHT_IMPORT", None)
+        env.update({
+            "TRAINING_ROLE": "TRAINER",
+            "PADDLE_PSERVER_ENDPOINTS": ",".join(endpoints),
+            "PADDLE_TRAINER_ID": str(r),
+            "PADDLE_TRAINERS_NUM": str(args.worker_num),
+        })
+        log = os.path.join(log_dir, f"worker.{r}.log") if log_dir else None
+        workers.append(TrainerProc(cmd, env, log, r))
+    for w in workers:
+        w.start()
+
+    exit_code = 0
+    try:
+        while True:
+            failed = [w for w in workers if w.poll() not in (None, 0)]
+            dead_srv = [s for s in servers if s.poll() is not None]
+            if failed or dead_srv:
+                exit_code = (failed[0].poll() if failed
+                             else dead_srv[0].poll()) or 1
+                who = (f"worker {failed[0].rank}" if failed
+                       else f"server {dead_srv[0].rank}")
+                print(f"[launch] {who} exited abnormally; terminating pod",
+                      file=sys.stderr)
+                break
+            if all(w.poll() == 0 for w in workers):
+                break  # normal completion
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        exit_code = exit_code or 1
+    finally:
+        for p in workers + servers:
+            p.terminate()
+    return exit_code
+
+
 def launch(args) -> int:
+    if args.server_num > 0:
+        return launch_ps(args)
     coord_host, coord_port = args.coordinator.split(":")
     coord_port = int(coord_port)
     local_sim = args.nproc_per_host > 1

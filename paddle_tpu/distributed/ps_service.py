@@ -1,0 +1,511 @@
+"""Parameter-server SERVICE: server processes + sharded client + async
+communicator.
+
+Reference capability (§2.4): the brpc PS stack — ``BrpcPsServer``/
+``BrpcPsClient`` (fluid/distributed/service/brpc_ps_*.{h,cc}, protocol
+sendrecv.proto), the async ``Communicator`` (service/communicator.cc:
+send queues + batched merge push), and TheOnePSRuntime server/worker
+bring-up.  This is the capability the in-device tables (distributed/ps.py)
+do NOT cover: a CPU-hosted table service that outlives any one worker and
+scales recommender vocabularies beyond accelerator memory.
+
+TPU-native split of labor:
+* hot loops (pull gather, duplicate-merged adagrad push, snapshot IO) run
+  in native code — _native/ps_table.cpp (common_sparse_table.cc role);
+* the wire is stdlib TCP with a length-prefixed binary frame carrying
+  numpy buffers (the brpc/protobuf role, without the vendored RPC stack);
+* sharding is id % num_servers (the reference's shard hash), mapped
+  client-side to (server, local_row = id // num_servers).
+
+This module must stay importable WITHOUT jax (server processes are plain
+CPU processes; spawn start method re-imports it).
+"""
+from __future__ import annotations
+
+import ctypes
+import io
+import os
+import queue
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Any, Sequence
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# wire format: !I frame length | !B op | npz-style payload
+# ---------------------------------------------------------------------------
+
+OPS = {"create": 1, "pull": 2, "push": 3, "pull_dense": 4, "push_dense": 5,
+       "save": 6, "load": 7, "stat": 8, "barrier_add": 9, "shutdown": 10,
+       "barrier_get": 11, "err": 12}
+_OP_NAMES = {v: k for k, v in OPS.items()}
+
+
+def _pack(op: str, meta: dict, arrays: dict[str, np.ndarray]) -> bytes:
+    import json
+
+    buf = io.BytesIO()
+    np.savez(buf, __meta__=np.frombuffer(
+        json.dumps(meta).encode(), np.uint8), **arrays)
+    body = buf.getvalue()
+    return struct.pack("!IB", len(body) + 1, OPS[op]) + body
+
+
+def _unpack(frame: bytes):
+    import json
+
+    op = _OP_NAMES[frame[0]]
+    with np.load(io.BytesIO(frame[1:]), allow_pickle=False) as z:
+        meta = json.loads(bytes(z["__meta__"]).decode())
+        arrays = {k: z[k] for k in z.files if k != "__meta__"}
+    return op, meta, arrays
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        b = sock.recv(min(n, 1 << 20))
+        if not b:
+            raise ConnectionError("peer closed")
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+def _send_frame(sock: socket.socket, data: bytes):
+    sock.sendall(data)
+
+
+def _recv_frame(sock: socket.socket) -> bytes:
+    (n,) = struct.unpack("!I", _read_exact(sock, 4))
+    return _read_exact(sock, n)
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+class PSServer:
+    """One table-shard server process (BrpcPsServer role).
+
+    Owns the rows with ``id % num_servers == server_idx`` of every table,
+    stored/updated by the native kernel; handles pull/push/dense/save/load
+    over threaded TCP."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 server_idx: int = 0, num_servers: int = 1):
+        from .._native import ps_table
+
+        self._lib = ps_table()
+        self.server_idx = server_idx
+        self.num_servers = num_servers
+        self._tables: dict[int, dict] = {}
+        self._dense: dict[str, np.ndarray] = {}
+        self._dense_lock = threading.Lock()
+        self._counters: dict[str, int] = {}
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        frame = _recv_frame(self.request)
+                        resp = outer._dispatch(frame)
+                        _send_frame(self.request, resp)
+                        if frame[0] == OPS["shutdown"]:
+                            threading.Thread(
+                                target=outer._srv.shutdown,
+                                daemon=True).start()
+                            return
+                except (ConnectionError, OSError):
+                    return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._srv = Server((host, port), Handler)
+        self.host, self.port = self._srv.server_address
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def _local_rows(self, vocab: int) -> int:
+        # rows this shard owns under id % S == idx
+        s, i = self.num_servers, self.server_idx
+        return (vocab - i + s - 1) // s if vocab > i else 0
+
+    def _dispatch(self, frame: bytes) -> bytes:
+        try:
+            op, meta, arrays = _unpack(frame)
+        except Exception as e:  # noqa: BLE001 - protocol skew/corrupt frame:
+            # the client still deserves an answer, not a dead thread
+            return _pack("err", {"ok": False,
+                                 "err": f"bad frame: {e!r}"}, {})
+        lib = self._lib
+        try:
+            if op == "create":
+                tid = meta["tid"]
+                if tid not in self._tables:
+                    rows = self._local_rows(meta["vocab"])
+                    h = lib.pst_create(
+                        rows, meta["dim"],
+                        meta.get("seed", 0) * 1000 + self.server_idx,
+                        meta.get("init_range", 0.05))
+                    self._tables[tid] = {"h": h, "rows": rows,
+                                         "dim": meta["dim"],
+                                         "vocab": meta["vocab"]}
+                return _pack("create", {"ok": True}, {})
+            if op == "pull":
+                t = self._tables[meta["tid"]]
+                ids = np.ascontiguousarray(arrays["ids"], np.int64)
+                out = np.empty((len(ids), t["dim"]), np.float32)
+                lib.pst_pull(t["h"],
+                             ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                             len(ids),
+                             out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+                return _pack("pull", {"ok": True}, {"rows": out})
+            if op == "push":
+                t = self._tables[meta["tid"]]
+                ids = np.ascontiguousarray(arrays["ids"], np.int64)
+                g = np.ascontiguousarray(arrays["grads"], np.float32)
+                lib.pst_push_adagrad(
+                    t["h"],
+                    ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                    g.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                    len(ids), meta.get("lr", 0.05), meta.get("eps", 1e-8))
+                return _pack("push", {"ok": True}, {})
+            if op == "pull_dense":
+                with self._dense_lock:
+                    arr = self._dense.get(meta["key"])
+                return _pack("pull_dense", {"ok": arr is not None},
+                             {"value": arr} if arr is not None else {})
+            if op == "push_dense":
+                with self._dense_lock:
+                    if meta.get("grad", False):
+                        if meta["key"] not in self._dense:
+                            # applying a grad to nothing would silently store
+                            # the gradient AS the parameter
+                            return _pack("push_dense", {
+                                "ok": False,
+                                "err": f"dense key {meta['key']!r} not "
+                                       f"initialized; push the value first"},
+                                {})
+                        self._dense[meta["key"]] = (
+                            self._dense[meta["key"]]
+                            - meta.get("lr", 0.05) * arrays["value"])
+                    else:
+                        self._dense[meta["key"]] = arrays["value"]
+                return _pack("push_dense", {"ok": True}, {})
+            if op == "save":
+                os.makedirs(meta["dir"], exist_ok=True)
+                for tid, t in self._tables.items():
+                    lib.pst_save(t["h"], os.path.join(
+                        meta["dir"],
+                        f"table_{tid}.shard{self.server_idx}").encode())
+                return _pack("save", {"ok": True}, {})
+            if op == "load":
+                for tid, t in self._tables.items():
+                    rc = lib.pst_load(t["h"], os.path.join(
+                        meta["dir"],
+                        f"table_{tid}.shard{self.server_idx}").encode())
+                    if rc != 0:
+                        return _pack("load", {"ok": False, "rc": rc}, {})
+                return _pack("load", {"ok": True}, {})
+            if op == "barrier_add":
+                with self._dense_lock:
+                    k = meta["key"]
+                    self._counters[k] = self._counters.get(k, 0) + 1
+                    return _pack("barrier_add",
+                                 {"ok": True, "count": self._counters[k]}, {})
+            if op == "barrier_get":
+                with self._dense_lock:
+                    return _pack("barrier_get", {
+                        "ok": True,
+                        "count": self._counters.get(meta["key"], 0)}, {})
+            if op == "stat":
+                return _pack("stat", {
+                    "ok": True, "server_idx": self.server_idx,
+                    "tables": {str(tid): {"rows": t["rows"], "dim": t["dim"]}
+                               for tid, t in self._tables.items()}}, {})
+            if op == "shutdown":
+                return _pack("shutdown", {"ok": True}, {})
+            return _pack(op, {"ok": False, "err": f"bad op {op}"}, {})
+        except Exception as e:  # noqa: BLE001 - must answer the client
+            return _pack(op, {"ok": False, "err": repr(e)}, {})
+
+    def serve_forever(self):
+        self._srv.serve_forever()
+
+    def start_background(self):
+        t = threading.Thread(target=self._srv.serve_forever, daemon=True)
+        t.start()
+        return t
+
+    def shutdown(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+def run_server(port: int, server_idx: int, num_servers: int,
+               ready_path: str | None = None):
+    """Blocking server entry point for a spawned process (the reference's
+    server-side main, TheOnePSRuntime._init_server role)."""
+    srv = PSServer(port=port, server_idx=server_idx, num_servers=num_servers)
+    if ready_path:
+        with open(ready_path, "w") as f:
+            f.write(srv.endpoint)
+    srv.serve_forever()
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+class PSClient:
+    """Sharded client (BrpcPsClient role): routes by id % num_servers,
+    fans requests to all servers in parallel, reassembles in order."""
+
+    def __init__(self, endpoints: Sequence[str], timeout: float = 60.0):
+        self.endpoints = list(endpoints)
+        self.S = len(self.endpoints)
+        self._socks: list[socket.socket | None] = [None] * self.S
+        self._locks = [threading.Lock() for _ in range(self.S)]
+        self._timeout = timeout
+
+    def _sock(self, s: int) -> socket.socket:
+        if self._socks[s] is None:
+            host, port = self.endpoints[s].rsplit(":", 1)
+            sk = socket.create_connection((host, int(port)),
+                                          timeout=self._timeout)
+            sk.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._socks[s] = sk
+        return self._socks[s]
+
+    def _rpc(self, s: int, op: str, meta: dict, arrays: dict):
+        with self._locks[s]:
+            sk = self._sock(s)
+            _send_frame(sk, _pack(op, meta, arrays))
+            rop, rmeta, rarr = _unpack(_recv_frame(sk))
+        if not rmeta.get("ok", False):
+            raise RuntimeError(f"PS {op} on server {s} failed: "
+                               f"{rmeta.get('err', rmeta)}")
+        return rmeta, rarr
+
+    def _fan(self, op: str, metas, arrays_by_s):
+        out: dict[int, tuple] = {}
+        errs: list = []
+
+        def go(s):
+            try:
+                out[s] = self._rpc(s, op, metas[s], arrays_by_s[s])
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=go, args=(s,)) for s in range(self.S)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        if errs:
+            raise errs[0]
+        return out
+
+    # -- table API ----------------------------------------------------------
+    def create_table(self, tid: int, vocab: int, dim: int, seed: int = 0,
+                     init_range: float = 0.05):
+        meta = {"tid": tid, "vocab": vocab, "dim": dim, "seed": seed,
+                "init_range": init_range}
+        self._fan("create", [meta] * self.S, [{}] * self.S)
+
+    def pull_sparse(self, tid: int, ids: np.ndarray) -> np.ndarray:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        srv = ids % self.S
+        local = ids // self.S
+        metas, arrs = [], []
+        for s in range(self.S):
+            metas.append({"tid": tid})
+            arrs.append({"ids": local[srv == s]})
+        out = self._fan("pull", metas, arrs)
+        dim = next(iter(out.values()))[1]["rows"].shape[1]
+        res = np.empty((len(ids), dim), np.float32)
+        for s in range(self.S):
+            res[srv == s] = out[s][1]["rows"]
+        return res
+
+    def push_sparse(self, tid: int, ids: np.ndarray, grads: np.ndarray,
+                    lr: float = 0.05):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), -1)
+        srv = ids % self.S
+        local = ids // self.S
+        metas, arrs = [], []
+        for s in range(self.S):
+            m = srv == s
+            metas.append({"tid": tid, "lr": lr})
+            arrs.append({"ids": local[m], "grads": grads[m]})
+        self._fan("push", metas, arrs)
+
+    # -- dense API (key-sharded by hash) -------------------------------------
+    def _dense_server(self, key: str) -> int:
+        import zlib
+
+        # stable across processes (python's hash() is per-process salted —
+        # workers would route the same key to different servers)
+        return zlib.crc32(key.encode()) % self.S
+
+    def push_dense(self, key: str, value: np.ndarray, grad: bool = False,
+                   lr: float = 0.05):
+        s = self._dense_server(key)
+        self._rpc(s, "push_dense", {"key": key, "grad": grad, "lr": lr},
+                  {"value": np.asarray(value, np.float32)})
+
+    def pull_dense(self, key: str) -> np.ndarray:
+        s = self._dense_server(key)
+        _, arr = self._rpc(s, "pull_dense", {"key": key}, {})
+        return arr["value"]
+
+    # -- control -------------------------------------------------------------
+    def save(self, dirname: str):
+        self._fan("save", [{"dir": dirname}] * self.S, [{}] * self.S)
+
+    def load(self, dirname: str):
+        self._fan("load", [{"dir": dirname}] * self.S, [{}] * self.S)
+
+    def stat(self):
+        return [self._rpc(s, "stat", {}, {})[0] for s in range(self.S)]
+
+    def barrier(self, key: str, world: int, timeout: float = 60.0):
+        """All-worker barrier through server 0's counter table (the
+        reference BarrierTable role): arrive once, poll until everyone has."""
+        self._rpc(0, "barrier_add", {"key": key}, {})
+        t0 = time.time()
+        while time.time() - t0 < timeout:
+            c, _ = self._rpc(0, "barrier_get", {"key": key}, {})
+            if c["count"] >= world:
+                return True
+            time.sleep(0.05)
+        raise TimeoutError(f"PS barrier {key!r}")
+
+    def shutdown_servers(self):
+        for s in range(self.S):
+            try:
+                self._rpc(s, "shutdown", {}, {})
+            except Exception:  # noqa: BLE001 - best effort on teardown
+                pass
+
+    def close(self):
+        for sk in self._socks:
+            if sk is not None:
+                try:
+                    sk.close()
+                except OSError:
+                    pass
+
+
+# ---------------------------------------------------------------------------
+# async communicator (a_sync mode)
+# ---------------------------------------------------------------------------
+
+class AsyncCommunicator:
+    """Client-side async push batching (reference service/communicator.cc:
+    per-table send queues, merged batched push, bounded staleness).
+
+    ``push_sparse`` enqueues; a background thread concatenates pending
+    (ids, grads) per table — duplicate merge happens server-side — and
+    pushes every ``flush_interval`` seconds or ``max_pending`` batches."""
+
+    def __init__(self, client: PSClient, flush_interval: float = 0.01,
+                 max_pending: int = 16):
+        self.client = client
+        self.flush_interval = flush_interval
+        self.max_pending = max_pending
+        self._q: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        # race-free flush accounting: every enqueued push increments
+        # _pushed; only after its batch is ACKed by the server does
+        # _applied catch up (no event-flag lost-wakeup window)
+        self._cv = threading.Condition()
+        self._pushed = 0
+        self._applied = 0
+        self._err: Exception | None = None
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def push_sparse(self, tid: int, ids, grads, lr: float = 0.05):
+        if self._err is not None:
+            raise self._err
+        with self._cv:
+            self._pushed += 1
+        self._q.put((tid, np.asarray(ids, np.int64).reshape(-1),
+                     np.asarray(grads, np.float32), float(lr)))
+
+    def _drain(self):
+        pending: dict[tuple, list] = {}
+        n = 0
+        while n < self.max_pending:
+            try:
+                tid, ids, g, lr = self._q.get_nowait()
+            except queue.Empty:
+                break
+            pending.setdefault((tid, lr), []).append(
+                (ids, g.reshape(len(ids), -1)))
+            n += 1
+        for (tid, lr), items in pending.items():
+            ids = np.concatenate([i for i, _ in items])
+            grads = np.concatenate([g for _, g in items])
+            self.client.push_sparse(tid, ids, grads, lr=lr)
+        if n:
+            with self._cv:
+                self._applied += n
+                self._cv.notify_all()
+        return n
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                if self._drain() == 0:
+                    self._stop.wait(self.flush_interval)
+            except Exception as e:  # noqa: BLE001 - surfaced on next push/flush
+                with self._cv:
+                    self._err = e
+                    self._cv.notify_all()
+                return
+
+    def flush(self, timeout: float = 30.0):
+        deadline = time.time() + timeout
+        with self._cv:
+            while self._applied < self._pushed and self._err is None:
+                remaining = deadline - time.time()
+                if remaining <= 0 or not self._cv.wait(timeout=remaining):
+                    raise TimeoutError("AsyncCommunicator flush")
+            if self._err is not None:
+                raise self._err
+
+    def stop(self):
+        self.flush()
+        self._stop.set()
+        self._t.join(timeout=5)
+
+
+def main(argv=None):
+    """Server-process CLI: python -m paddle_tpu.distributed.ps_service
+    --port P --server_idx I --num_servers N [--ready_path F]"""
+    import argparse
+
+    p = argparse.ArgumentParser(prog="paddle_tpu.distributed.ps_service")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--server_idx", type=int, required=True)
+    p.add_argument("--num_servers", type=int, required=True)
+    p.add_argument("--ready_path", default=None)
+    a = p.parse_args(argv)
+    run_server(a.port, a.server_idx, a.num_servers, a.ready_path)
+
+
+if __name__ == "__main__":
+    main()

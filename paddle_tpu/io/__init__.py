@@ -280,20 +280,13 @@ def _to_device(batch):
     return jax.device_put(np.asarray(batch))
 
 
-class _Sentinel:
-    pass
-
-
-_END = _Sentinel()
-
-
 class _PipelineState:
     """Shared state of one prefetch pipeline run.  Thread closures hold THIS
     object (never the iterator), so an abandoned iterator can be
     garbage-collected — its weakref.finalize fires :meth:`shutdown`, the
     timeout-based puts/waits observe ``stop``, and every thread exits."""
 
-    def __init__(self, nw: int, depth: int):
+    def __init__(self, nw: int):
         self.stop = threading.Event()
         self.idx_q: queue.Queue = queue.Queue(maxsize=2 * nw)
         self.results: dict[int, object] = {}
@@ -301,7 +294,6 @@ class _PipelineState:
         self.total: int | None = None
         self.next_needed = 0
         self.err: BaseException | None = None
-        self.dev_q: queue.Queue = queue.Queue(maxsize=max(1, depth))
 
     def fail(self, e: BaseException):
         with self.cond:
@@ -323,17 +315,13 @@ class _PipelineState:
         self.stop.set()
         with self.cond:
             self.cond.notify_all()
-        try:  # drop device-resident batches an abandoned consumer never took
-            while True:
-                self.dev_q.get_nowait()
-        except queue.Empty:
-            pass
 
 
 def _run_pipeline(st: _PipelineState, loader, nw: int):
-    """Start feeder / collate-worker / device-stage threads over ``st``.
-    Deliberately a free function: closures capture ``st`` and ``loader``
-    only, keeping the iterator object collectable (see _PipelineState)."""
+    """Start feeder / collate-worker threads over ``st``; returns the
+    in-order batch generator (host side).  Deliberately a free function:
+    closures capture ``st`` and ``loader`` only, keeping the iterator
+    object collectable (see _PipelineState)."""
     ahead_bound = 2 * nw + 2  # collated-but-unconsumed host batches
 
     def feeder():
@@ -388,7 +376,9 @@ def _run_pipeline(st: _PipelineState, loader, nw: int):
                        and (st.total is None or n < st.total)
                        and n not in st.results):
                     st.cond.wait(timeout=0.5)
-                if st.err is not None or st.stop.is_set():
+                if st.err is not None:
+                    raise st.err
+                if st.stop.is_set():
                     return
                 if st.total is not None and n >= st.total \
                         and n not in st.results:
@@ -398,23 +388,17 @@ def _run_pipeline(st: _PipelineState, loader, nw: int):
                 st.cond.notify_all()
             yield batch
 
-    def device_stage():
-        try:
-            for b in ordered():
-                if not st.put_stopable(st.dev_q, _to_device(b)):
-                    return
-        except BaseException as e:
-            st.fail(e)
-        finally:
-            st.put_stopable(st.dev_q, _END) or None
-
     threads = [threading.Thread(target=feeder, daemon=True)]
     threads += [threading.Thread(target=worker, daemon=True)
                 for _ in range(nw)]
-    threads.append(threading.Thread(target=device_stage, daemon=True))
     for t in threads:
         t.start()
-    return threads
+    return ordered()
+
+
+def _shutdown_pipeline(st: _PipelineState, pf):
+    st.shutdown()
+    pf.close()
 
 
 class _PrefetchIter:
@@ -422,21 +406,27 @@ class _PrefetchIter:
 
     feeder thread → bounded index queue → ``num_workers`` collate threads
     (numpy assembly releases the GIL, bounded look-ahead) → in-order merge
-    → device stage whose bounded queue (``prefetch_factor`` deep) holds
-    DEVICE-resident batches ahead of the consumer.  Indices stream lazily;
-    worker/feeder failures propagate; abandoning the iterator shuts the
-    pipeline down via weakref.finalize (threads never reference the
-    iterator)."""
+    → DevicePrefetcher whose bounded queue (``prefetch_factor`` deep)
+    holds DEVICE-resident batches ahead of the consumer.  Indices stream
+    lazily; worker/feeder failures propagate; abandoning the iterator
+    shuts the pipeline down via weakref.finalize (threads never reference
+    the iterator)."""
 
     def __init__(self, loader):
         import weakref
 
+        from .native_reader import DevicePrefetcher
+
         nw = max(1, loader.num_workers)
-        st = _PipelineState(nw, loader.prefetch_factor)
+        st = _PipelineState(nw)
         self._st = st
         self._finished = False
-        _run_pipeline(st, loader, nw)
-        self._finalizer = weakref.finalize(self, _PipelineState.shutdown, st)
+        ordered_gen = _run_pipeline(st, loader, nw)
+        self._pf = DevicePrefetcher(ordered_gen, depth=loader.prefetch_factor,
+                                    transform=_to_device)
+        self._it = iter(self._pf)
+        self._finalizer = weakref.finalize(self, _shutdown_pipeline, st,
+                                           self._pf)
 
     def __iter__(self):
         return self
@@ -444,15 +434,16 @@ class _PrefetchIter:
     def __next__(self):
         if self._finished:
             raise StopIteration
-        item = self._st.dev_q.get()
-        if isinstance(item, _Sentinel):
+        try:
+            return next(self._it)
+        except StopIteration:
             self._finished = True
-            err = self._st.err
             self._st.shutdown()
-            if err is not None:
-                raise err
-            raise StopIteration
-        return item
+            raise
+        except BaseException:
+            self._finished = True
+            self._st.shutdown()
+            raise
 
     def close(self):
         self._finished = True
@@ -470,6 +461,19 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self._iterable_mode = isinstance(dataset, IterableDataset)
+        if isinstance(dataset, FileDataset):
+            # the C++ feeder owns batching/shuffling; options that silently
+            # would not apply must fail loudly
+            if collate_fn is not None or batch_sampler is not None:
+                raise ValueError(
+                    "DataLoader over a FileDataset is served whole-batch by "
+                    "the native feeder; collate_fn/batch_sampler do not "
+                    "apply (shape the records in FileDataset instead)")
+            if shuffle:
+                raise ValueError(
+                    "shuffle=True does not apply to FileDataset; use "
+                    "FileDataset(shuffle_window=N) for native reservoir "
+                    "shuffling")
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
         elif not self._iterable_mode:
@@ -491,15 +495,19 @@ class DataLoader:
         return self._iter_single()
 
     def _iter_native(self):
-        """C++ feeder → Tensor wrap → device prefetch queue."""
+        """C++ feeder → Tensor wrap → device prefetch queue.  The feeder
+        emits trailing partial batches; drop_last filters them here."""
         from .native_reader import DevicePrefetcher
 
         bs = getattr(self, "batch_size", None) or \
             getattr(self.batch_sampler, "batch_size", 1)
+        drop_last = getattr(self, "drop_last", False)
         reader = self.dataset.reader(bs)
         pf = DevicePrefetcher(reader, depth=self.prefetch_factor)
         try:
             for arr in pf:
+                if drop_last and arr.shape[0] < bs:
+                    continue
                 yield Tensor(arr, stop_gradient=True)
         finally:
             # early break must not leak the C++ feeder threads/queue

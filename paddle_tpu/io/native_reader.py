@@ -117,14 +117,17 @@ class DevicePrefetcher:
     """Background thread that moves host batches to the device ahead of the
     consumer (the buffered_reader double-buffer role; PJRT does the DMA)."""
 
-    def __init__(self, it, depth: int = 2, device=None, sharding=None):
+    def __init__(self, it, depth: int = 2, device=None, sharding=None,
+                 transform=None):
         import jax
 
-        self._out: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._out: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
         self._src = iter(it)
         self._stop = threading.Event()
 
         def put(x):
+            if transform is not None:
+                return transform(x)
             if sharding is not None:
                 return jax.device_put(x, sharding)
             if device is not None:

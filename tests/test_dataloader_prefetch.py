@@ -158,3 +158,32 @@ class TestNativeFileLoader:
         got = np.sort(np.concatenate([np.asarray(b.value) for b in batches],
                                      axis=0), axis=0)
         np.testing.assert_array_equal(got, np.sort(recs, axis=0))
+
+    def test_partial_tail_delivered_and_drop_last(self, tmp_path):
+        from paddle_tpu._native import NativeUnavailable
+
+        T = 8
+        recs = np.arange(61 * T, dtype=np.int32).reshape(61, T)
+        f = tmp_path / "tail.bin"
+        f.write_bytes(recs.tobytes())
+        try:
+            ds = FileDataset([str(f)], record_len=T, num_threads=2)
+            total = sum(b.shape[0]
+                        for b in DataLoader(ds, batch_size=8))
+            # trailing partial batches are delivered, no records lost
+            assert total == 61
+            ds2 = FileDataset([str(f)], record_len=T, num_threads=2)
+            kept = [b.shape[0] for b in DataLoader(ds2, batch_size=8,
+                                                   drop_last=True)]
+        except NativeUnavailable:
+            pytest.skip("native io_runtime not built")
+        assert all(k == 8 for k in kept), kept
+
+    def test_native_loader_rejects_silent_options(self, tmp_path):
+        f = tmp_path / "x.bin"
+        f.write_bytes(np.zeros((8, 4), np.int32).tobytes())
+        ds = FileDataset([str(f)], record_len=4)
+        with pytest.raises(ValueError, match="collate_fn"):
+            DataLoader(ds, batch_size=2, collate_fn=lambda b: b)
+        with pytest.raises(ValueError, match="shuffle_window"):
+            DataLoader(ds, batch_size=2, shuffle=True)
