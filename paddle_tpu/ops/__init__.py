@@ -4,4 +4,4 @@ This package plays the role of the reference's hand-optimised CUDA kernels
 (/root/reference/paddle/fluid/operators/fused/ — multihead_matmul,
 fused_attention precursors), re-done as Pallas TPU kernels.
 """
-from . import attention  # noqa: F401
+from . import attention, sequence  # noqa: F401

@@ -1,0 +1,183 @@
+"""Sequence (ragged) ops (reference operators/sequence_ops/ — LoD-aware
+seq_pool/pad/unpad/softmax/reverse/expand over LoDTensor, ~15k LoC C++).
+
+TPU-first redesign: the reference's LoD (level-of-detail offset vectors +
+dynamic-shaped kernels) becomes the **lengths / segment-ids convention**
+with STATIC shapes, the representation XLA actually runs well:
+
+* packed form: ``values [N, ...]`` + ``lengths [B]`` (sum == N) — the
+  LoDTensor analog; ``segment_ids`` derived with static bounds;
+* padded form: ``[B, T, ...]`` + lengths — what the compute wants.
+
+Each op is a jnp/segment-op program (jax.ops.segment_* lower to one-pass
+scatter-adds on TPU); packed↔padded conversion is a gather/scatter with
+static output shape (maxlen is a required static argument when tracing).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["sequence_mask", "sequence_pad", "sequence_unpad",
+           "sequence_pool", "sequence_softmax", "sequence_reverse",
+           "sequence_expand", "sequence_first_step", "sequence_last_step",
+           "segment_ids_from_lengths"]
+
+
+def _unwrap(x):
+    from ..core.tensor import Tensor
+
+    return x.value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def segment_ids_from_lengths(lengths, total: int):
+    """lengths [B] → segment ids [total] (rows past sum(lengths) get B —
+    an out-of-range segment that jax segment ops drop)."""
+    lengths = _unwrap(lengths)
+    B = lengths.shape[0]
+    starts = jnp.cumsum(lengths) - lengths
+    # mark each segment start with +1 and prefix-sum (static-shape trick)
+    marks = jnp.zeros((total + 1,), jnp.int32)
+    marks = marks.at[starts].add(1)
+    ids = jnp.cumsum(marks[:total]) - 1
+    valid = jnp.arange(total) < jnp.sum(lengths)
+    return jnp.where(valid, ids, B)
+
+
+def sequence_mask(lengths, maxlen: int, dtype=jnp.bool_):
+    """lengths [B] → mask [B, maxlen] (reference sequence_mask_op)."""
+    lengths = _unwrap(lengths)
+    return (jnp.arange(maxlen)[None, :] < lengths[:, None]).astype(dtype)
+
+
+def sequence_pad(values, lengths, maxlen: int, pad_value=0.0):
+    """Packed [N, ...] + lengths [B] → padded [B, maxlen, ...]
+    (reference sequence_pad_op)."""
+    values = _unwrap(values)
+    lengths = _unwrap(lengths)
+    B = lengths.shape[0]
+    starts = jnp.cumsum(lengths) - lengths
+    pos = jnp.arange(maxlen)
+    idx = starts[:, None] + pos[None, :]              # [B, maxlen]
+    take = jnp.clip(idx, 0, values.shape[0] - 1)
+    out = values[take]                                # [B, maxlen, ...]
+    mask = sequence_mask(lengths, maxlen)
+    mshape = mask.shape + (1,) * (out.ndim - 2)
+    return jnp.where(mask.reshape(mshape), out,
+                     jnp.asarray(pad_value, out.dtype))
+
+
+def sequence_unpad(padded, lengths):
+    """Padded [B, T, ...] + lengths → packed [B*T, ...] with the valid rows
+    front-packed and a valid-count (static total; reference
+    sequence_unpad_op emits dynamic N — mask with the count)."""
+    padded = _unwrap(padded)
+    lengths = _unwrap(lengths)
+    B, T = padded.shape[:2]
+    flat = padded.reshape((B * T,) + padded.shape[2:])
+    mask = sequence_mask(lengths, T).reshape(-1)
+    # stable front-pack permutation: valid rows keep order, pads go last
+    order = jnp.argsort(~mask, stable=True)
+    return flat[order], jnp.sum(lengths)
+
+
+def sequence_pool(values, lengths, pool_type: str = "sum"):
+    """Packed [N, D] + lengths [B] → [B, D] (reference sequence_pool_op:
+    sum/mean/max/min/sqrt/first/last)."""
+    values = _unwrap(values)
+    lengths = _unwrap(lengths)
+    N = values.shape[0]
+    B = lengths.shape[0]
+    seg = segment_ids_from_lengths(lengths, N)
+    pt = pool_type.lower()
+    if pt == "sum":
+        return jax.ops.segment_sum(values, seg, num_segments=B)
+    if pt == "mean":
+        s = jax.ops.segment_sum(values, seg, num_segments=B)
+        return s / jnp.maximum(lengths, 1).astype(s.dtype)[:, None]
+    if pt == "sqrt":
+        s = jax.ops.segment_sum(values, seg, num_segments=B)
+        return s / jnp.sqrt(jnp.maximum(lengths, 1).astype(s.dtype))[:, None]
+    if pt == "max":
+        return jax.ops.segment_max(values, seg, num_segments=B)
+    if pt == "min":
+        return jax.ops.segment_min(values, seg, num_segments=B)
+    if pt == "first":
+        starts = jnp.cumsum(lengths) - lengths
+        return values[jnp.clip(starts, 0, N - 1)]
+    if pt == "last":
+        ends = jnp.cumsum(lengths) - 1
+        return values[jnp.clip(ends, 0, N - 1)]
+    raise ValueError(pool_type)
+
+
+def sequence_first_step(values, lengths):
+    return sequence_pool(values, lengths, "first")
+
+
+def sequence_last_step(values, lengths):
+    return sequence_pool(values, lengths, "last")
+
+
+def sequence_softmax(values, lengths):
+    """Packed [N] (or [N, 1]) + lengths → per-segment softmax (reference
+    sequence_softmax_op)."""
+    values = _unwrap(values)
+    lengths = _unwrap(lengths)
+    squeeze = values.ndim == 2 and values.shape[1] == 1
+    v = values.reshape(-1)
+    N = v.shape[0]
+    B = lengths.shape[0]
+    seg = segment_ids_from_lengths(lengths, N)
+    vmax = jax.ops.segment_max(v, seg, num_segments=B + 1)
+    v = v - vmax[seg]
+    e = jnp.exp(v)
+    valid = seg < B
+    e = jnp.where(valid, e, 0.0)
+    denom = jax.ops.segment_sum(e, seg, num_segments=B + 1)
+    out = e / jnp.maximum(denom[seg], 1e-30)
+    return out[:, None] if squeeze else out
+
+
+def sequence_reverse(values, lengths):
+    """Packed [N, ...]: reverse each segment in place (reference
+    sequence_reverse_op — the Bi-RNN building block)."""
+    values = _unwrap(values)
+    lengths = _unwrap(lengths)
+    N = values.shape[0]
+    B = lengths.shape[0]
+    seg = segment_ids_from_lengths(lengths, N)
+    segc = jnp.clip(seg, 0, B - 1)
+    starts = (jnp.cumsum(lengths) - lengths)[segc]
+    ends = (jnp.cumsum(lengths) - 1)[segc]
+    pos = jnp.arange(N)
+    src = jnp.where(seg < B, (starts + (ends - pos)).astype(pos.dtype), pos)
+    return values[jnp.clip(src, 0, N - 1)]
+
+
+def sequence_expand(values, lengths, repeat_lengths, total_out: int):
+    """Repeat segment i of ``values`` ``repeat_lengths[i]`` times
+    (reference sequence_expand_op).  ``total_out`` is the static output
+    row count (sum(lengths * repeats) padded up)."""
+    values = _unwrap(values)
+    lengths = _unwrap(lengths)
+    repeats = _unwrap(repeat_lengths)
+    B = lengths.shape[0]
+    # output segment structure: segment i appears repeats[i] times, each
+    # copy with lengths[i] rows (jnp.repeat pads the tail past
+    # sum(repeats); padded tail rows are masked out below)
+    out_seg_len = jnp.repeat(lengths, repeats, total_repeat_length=total_out)
+    src_seg = jnp.repeat(jnp.arange(B), repeats,
+                         total_repeat_length=total_out)
+    ids = segment_ids_from_lengths(out_seg_len, total_out)
+    idsc = jnp.clip(ids, 0, total_out - 1)
+    starts_out = (jnp.cumsum(out_seg_len) - out_seg_len)[idsc]
+    offs = jnp.arange(total_out) - starts_out
+    starts_in = jnp.cumsum(lengths) - lengths
+    src = starts_in[jnp.clip(src_seg[idsc], 0, B - 1)] + offs
+    n_rows = jnp.sum(lengths * repeats)  # true output rows
+    row_valid = jnp.arange(total_out) < n_rows
+    out = values[jnp.clip(src, 0, values.shape[0] - 1)]
+    vshape = (total_out,) + (1,) * (out.ndim - 1)
+    return jnp.where(row_valid.reshape(vshape), out, jnp.zeros_like(out))

@@ -1,0 +1,325 @@
+"""Detection ops (reference operators/detection/ — yolo_box_op.cc,
+prior_box_op.cc, box_coder_op.cc, nms via multiclass_nms_op.cc,
+roi_align_op.cc — ~25k LoC of CUDA/C++).
+
+TPU-first redesign: every op is a fixed-shape jnp program so it jits onto
+the MXU/VPU — no dynamic result counts.  NMS returns (indices, valid_mask)
+of STATIC length ``max_out`` (the XLA-friendly convention; the reference
+returns a LoD tensor of dynamic size), and roi_align is a batched bilinear
+gather instead of a per-ROI CUDA kernel.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["yolo_box", "prior_box", "box_coder", "box_iou", "nms",
+           "multiclass_nms", "roi_align", "roi_pool"]
+
+
+def _unwrap(x):
+    from ..core.tensor import Tensor
+
+    return x.value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# ---------------------------------------------------------------------------
+# yolo_box (yolo_box_op.cc): decode a YOLOv3 head into boxes + scores
+# ---------------------------------------------------------------------------
+
+def yolo_box(x, img_size, anchors: Sequence[int], class_num: int,
+             conf_thresh: float = 0.01, downsample_ratio: int = 32,
+             clip_bbox: bool = True, scale_x_y: float = 1.0):
+    """x: [N, A*(5+C), H, W]; img_size: [N, 2] (h, w).
+    Returns (boxes [N, A*H*W, 4] xyxy in image coords,
+             scores [N, A*H*W, C]); low-confidence rows score 0."""
+    x = _unwrap(x)
+    img_size = _unwrap(img_size)
+    N, _, H, W = x.shape
+    A = len(anchors) // 2
+    C = class_num
+    x = x.reshape(N, A, 5 + C, H, W)
+    grid_x = jnp.arange(W, dtype=x.dtype)[None, None, None, :]
+    grid_y = jnp.arange(H, dtype=x.dtype)[None, None, :, None]
+    an_w = jnp.asarray(anchors[0::2], x.dtype)[None, :, None, None]
+    an_h = jnp.asarray(anchors[1::2], x.dtype)[None, :, None, None]
+    in_h = H * downsample_ratio
+    in_w = W * downsample_ratio
+
+    bx = (jax.nn.sigmoid(x[:, :, 0]) * scale_x_y
+          - (scale_x_y - 1) / 2 + grid_x) / W
+    by = (jax.nn.sigmoid(x[:, :, 1]) * scale_x_y
+          - (scale_x_y - 1) / 2 + grid_y) / H
+    bw = jnp.exp(x[:, :, 2]) * an_w / in_w
+    bh = jnp.exp(x[:, :, 3]) * an_h / in_h
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    prob = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    prob = jnp.where(conf[:, :, None] < conf_thresh, 0.0, prob)
+
+    img_h = img_size[:, 0].astype(x.dtype)[:, None, None, None]
+    img_w = img_size[:, 1].astype(x.dtype)[:, None, None, None]
+    x0 = (bx - bw / 2) * img_w
+    y0 = (by - bh / 2) * img_h
+    x1 = (bx + bw / 2) * img_w
+    y1 = (by + bh / 2) * img_h
+    if clip_bbox:
+        x0 = jnp.clip(x0, 0, img_w - 1)
+        y0 = jnp.clip(y0, 0, img_h - 1)
+        x1 = jnp.clip(x1, 0, img_w - 1)
+        y1 = jnp.clip(y1, 0, img_h - 1)
+    boxes = jnp.stack([x0, y0, x1, y1], axis=-1).reshape(N, -1, 4)
+    scores = jnp.moveaxis(prob, 2, -1).reshape(N, -1, C)
+    return boxes, scores
+
+
+# ---------------------------------------------------------------------------
+# prior_box (prior_box_op.cc): SSD anchors for one feature map
+# ---------------------------------------------------------------------------
+
+def prior_box(feat_h: int, feat_w: int, img_h: int, img_w: int,
+              min_sizes: Sequence[float], max_sizes: Sequence[float] = (),
+              aspect_ratios: Sequence[float] = (1.0,), flip: bool = True,
+              clip: bool = False, step: float = 0.0, offset: float = 0.5):
+    """Returns [H, W, P, 4] normalized (x0, y0, x1, y1) anchors."""
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    whs = []
+    for ms in min_sizes:
+        whs.append((ms, ms))
+        for ar in ars:
+            if abs(ar - 1.0) < 1e-6:
+                continue
+            whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+    for ms, Ms in zip(min_sizes, max_sizes):
+        whs.append((np.sqrt(ms * Ms), np.sqrt(ms * Ms)))
+    whs = np.asarray(whs, np.float32)  # [P, 2] in pixels
+    step_x = step or img_w / feat_w
+    step_y = step or img_h / feat_h
+    cx = (np.arange(feat_w, dtype=np.float32) + offset) * step_x
+    cy = (np.arange(feat_h, dtype=np.float32) + offset) * step_y
+    cx, cy = np.meshgrid(cx, cy)
+    out = np.empty((feat_h, feat_w, len(whs), 4), np.float32)
+    out[..., 0] = (cx[..., None] - whs[:, 0] / 2) / img_w
+    out[..., 1] = (cy[..., None] - whs[:, 1] / 2) / img_h
+    out[..., 2] = (cx[..., None] + whs[:, 0] / 2) / img_w
+    out[..., 3] = (cy[..., None] + whs[:, 1] / 2) / img_h
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    return jnp.asarray(out)
+
+
+# ---------------------------------------------------------------------------
+# box_coder (box_coder_op.cc): encode/decode vs anchors
+# ---------------------------------------------------------------------------
+
+def box_coder(prior_boxes, target_box, code_type: str = "decode_center_size",
+              variance: Sequence[float] = (0.1, 0.1, 0.2, 0.2)):
+    pb = _unwrap(prior_boxes)
+    tb = _unwrap(target_box)
+    v = jnp.asarray(variance, pb.dtype)
+    pw = pb[..., 2] - pb[..., 0]
+    ph = pb[..., 3] - pb[..., 1]
+    pcx = pb[..., 0] + pw / 2
+    pcy = pb[..., 1] + ph / 2
+    if code_type == "encode_center_size":
+        tw = tb[..., 2] - tb[..., 0]
+        th = tb[..., 3] - tb[..., 1]
+        tcx = tb[..., 0] + tw / 2
+        tcy = tb[..., 1] + th / 2
+        return jnp.stack([
+            (tcx - pcx) / pw / v[0], (tcy - pcy) / ph / v[1],
+            jnp.log(tw / pw) / v[2], jnp.log(th / ph) / v[3]], axis=-1)
+    if code_type == "decode_center_size":
+        cx = tb[..., 0] * v[0] * pw + pcx
+        cy = tb[..., 1] * v[1] * ph + pcy
+        w = jnp.exp(tb[..., 2] * v[2]) * pw
+        h = jnp.exp(tb[..., 3] * v[3]) * ph
+        return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                         axis=-1)
+    raise ValueError(code_type)
+
+
+def box_iou(a, b):
+    """a: [..., M, 4], b: [..., N, 4] xyxy → IoU [..., M, N]."""
+    a = _unwrap(a)
+    b = _unwrap(b)
+    lt = jnp.maximum(a[..., :, None, :2], b[..., None, :, :2])
+    rb = jnp.minimum(a[..., :, None, 2:], b[..., None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = ((a[..., 2] - a[..., 0]) * (a[..., 3] - a[..., 1]))[..., :, None]
+    area_b = ((b[..., 2] - b[..., 0]) * (b[..., 3] - b[..., 1]))[..., None, :]
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-10)
+
+
+# ---------------------------------------------------------------------------
+# nms: greedy hard-NMS with STATIC output size (TPU convention)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("max_out",))
+def _nms_impl(boxes, scores, iou_threshold, score_threshold, max_out):
+    n = boxes.shape[0]
+    iou = box_iou(boxes, boxes)
+    order_scores = jnp.where(scores > score_threshold, scores, -jnp.inf)
+
+    def body(i, state):
+        alive, sel, sel_n = state
+        s = jnp.where(alive, order_scores, -jnp.inf)
+        best = jnp.argmax(s)
+        ok = s[best] > -jnp.inf
+        sel = sel.at[i].set(jnp.where(ok, best, -1))
+        sel_n = sel_n + ok.astype(jnp.int32)
+        kill = iou[best] > iou_threshold  # suppress overlaps incl. self
+        alive = alive & ~(kill & ok)
+        alive = alive.at[best].set(False)
+        return alive, sel, sel_n
+
+    alive0 = jnp.ones((n,), bool)
+    sel0 = jnp.full((max_out,), -1, jnp.int32)
+    alive, sel, sel_n = jax.lax.fori_loop(0, max_out, body,
+                                          (alive0, sel0, jnp.int32(0)))
+    return sel, sel >= 0
+
+
+def nms(boxes, scores, iou_threshold: float = 0.3,
+        score_threshold: float = -jnp.inf, max_out: int | None = None):
+    """Greedy NMS over [N, 4] xyxy boxes.
+
+    Returns (indices [max_out] int32, valid [max_out] bool): indices of the
+    kept boxes in descending-score order, -1 padded.  ``max_out`` defaults
+    to N (the reference emits a dynamic count; fixed shape is the price of
+    jit — mask with ``valid``)."""
+    boxes = _unwrap(boxes)
+    scores = _unwrap(scores)
+    m = int(max_out or boxes.shape[0])
+    return _nms_impl(boxes, scores, jnp.asarray(iou_threshold),
+                     jnp.asarray(score_threshold), m)
+
+
+def multiclass_nms(bboxes, scores, score_threshold: float = 0.05,
+                   nms_threshold: float = 0.3, keep_top_k: int = 100,
+                   background_label: int = -1):
+    """bboxes [N, 4], scores [C, N] → (out [keep_top_k, 6] rows
+    (label, score, x0, y0, x1, y1), valid [keep_top_k]).  -1/0 padded."""
+    bboxes = _unwrap(bboxes)
+    scores = _unwrap(scores)
+    C, N = scores.shape
+    per_cls = []
+    for c in range(C):
+        if c == background_label:
+            continue
+        idx, valid = nms(bboxes, scores[c], nms_threshold, score_threshold)
+        take = jnp.clip(idx, 0)
+        rows = jnp.concatenate([
+            jnp.full((N, 1), c, bboxes.dtype),
+            scores[c][take][:, None], bboxes[take]], axis=1)
+        per_cls.append(jnp.where(valid[:, None], rows, -1.0))
+    allr = jnp.concatenate(per_cls, axis=0)
+    order = jnp.argsort(-allr[:, 1])[:keep_top_k]
+    out = allr[order]
+    valid = out[:, 1] > score_threshold
+    return jnp.where(valid[:, None], out, -1.0), valid
+
+
+# ---------------------------------------------------------------------------
+# roi_align / roi_pool (roi_align_op.cc): batched bilinear gather
+# ---------------------------------------------------------------------------
+
+def roi_align(x, boxes, box_nums=None, output_size=(1, 1),
+              spatial_scale: float = 1.0, sampling_ratio: int = -1,
+              aligned: bool = True):
+    """x: [N, C, H, W]; boxes: [R, 4] xyxy (feature-map scale after
+    spatial_scale); box_nums: [N] rois per image (sum R).  Returns
+    [R, C, ph, pw].  Bilinear average pooling per output bin."""
+    x = _unwrap(x)
+    boxes = _unwrap(boxes)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    N, C, H, W = x.shape
+    R = boxes.shape[0]
+    if box_nums is None:
+        img_of = jnp.zeros((R,), jnp.int32)
+    else:
+        box_nums = _unwrap(box_nums).astype(jnp.int32)
+        img_of = jnp.repeat(jnp.arange(N, dtype=jnp.int32), box_nums,
+                            total_repeat_length=R)
+    off = 0.5 if aligned else 0.0
+    b = boxes * spatial_scale
+    x0, y0, x1, y1 = b[:, 0] - off, b[:, 1] - off, b[:, 2] - off, b[:, 3] - off
+    rw = jnp.maximum(x1 - x0, 1.0 if not aligned else 1e-6)
+    rh = jnp.maximum(y1 - y0, 1.0 if not aligned else 1e-6)
+    s = sampling_ratio if sampling_ratio > 0 else 2
+    # sample grid: [R, ph*s] ys, [R, pw*s] xs
+    gy = (jnp.arange(ph * s, dtype=x.dtype) + 0.5) / s
+    gx = (jnp.arange(pw * s, dtype=x.dtype) + 0.5) / s
+    ys = y0[:, None] + gy[None, :] * (rh[:, None] / ph)
+    xs = x0[:, None] + gx[None, :] * (rw[:, None] / pw)
+
+    def bilinear(img, ys_r, xs_r):
+        # img [C, H, W]; ys_r [hs], xs_r [ws] -> [C, hs, ws]
+        y = jnp.clip(ys_r, 0, H - 1)
+        xc = jnp.clip(xs_r, 0, W - 1)
+        y0i = jnp.clip(jnp.floor(y).astype(jnp.int32), 0, H - 1)
+        x0i = jnp.clip(jnp.floor(xc).astype(jnp.int32), 0, W - 1)
+        y1i = jnp.clip(y0i + 1, 0, H - 1)
+        x1i = jnp.clip(x0i + 1, 0, W - 1)
+        wy = (y - y0i).astype(x.dtype)
+        wx = (xc - x0i).astype(x.dtype)
+        v00 = img[:, y0i][:, :, x0i]
+        v01 = img[:, y0i][:, :, x1i]
+        v10 = img[:, y1i][:, :, x0i]
+        v11 = img[:, y1i][:, :, x1i]
+        top = v00 * (1 - wx)[None, None, :] + v01 * wx[None, None, :]
+        bot = v10 * (1 - wx)[None, None, :] + v11 * wx[None, None, :]
+        return top * (1 - wy)[None, :, None] + bot * wy[None, :, None]
+
+    def per_roi(img_idx, ys_r, xs_r):
+        vals = bilinear(x[img_idx], ys_r, xs_r)       # [C, ph*s, pw*s]
+        vals = vals.reshape(C, ph, s, pw, s)
+        return vals.mean(axis=(2, 4))                  # [C, ph, pw]
+
+    return jax.vmap(per_roi)(img_of, ys, xs)
+
+
+def roi_pool(x, boxes, box_nums=None, output_size=(1, 1),
+             spatial_scale: float = 1.0):
+    """Max-pool variant (roi_pool_op.cc) via dense sampling max."""
+    x = _unwrap(x)
+    boxes = _unwrap(boxes)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    N, C, H, W = x.shape
+    R = boxes.shape[0]
+    if box_nums is None:
+        img_of = jnp.zeros((R,), jnp.int32)
+    else:
+        box_nums = _unwrap(box_nums).astype(jnp.int32)
+        img_of = jnp.repeat(jnp.arange(N, dtype=jnp.int32), box_nums,
+                            total_repeat_length=R)
+    b = boxes * spatial_scale
+    s = 4  # dense sampling per bin approximates exact integer-grid max
+    gy = (jnp.arange(ph * s, dtype=x.dtype)) / s
+    gx = (jnp.arange(pw * s, dtype=x.dtype)) / s
+    rh = jnp.maximum(b[:, 3] - b[:, 1], 1e-6)
+    rw = jnp.maximum(b[:, 2] - b[:, 0], 1e-6)
+    ys = b[:, 1][:, None] + gy[None, :] * (rh[:, None] / ph)
+    xs = b[:, 0][:, None] + gx[None, :] * (rw[:, None] / pw)
+
+    def per_roi(img_idx, ys_r, xs_r):
+        yi = jnp.clip(jnp.round(ys_r).astype(jnp.int32), 0, H - 1)
+        xi = jnp.clip(jnp.round(xs_r).astype(jnp.int32), 0, W - 1)
+        vals = x[img_idx][:, yi][:, :, xi]             # [C, ph*s, pw*s]
+        vals = vals.reshape(C, ph, s, pw, s)
+        return vals.max(axis=(2, 4))
+
+    return jax.vmap(per_roi)(img_of, ys, xs)

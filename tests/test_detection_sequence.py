@@ -1,0 +1,202 @@
+"""Detection + sequence op families vs numpy references (OpTest pattern,
+reference operators/detection/ and operators/sequence_ops/)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops import sequence as seq
+from paddle_tpu.vision import ops as vops
+
+
+# ---------------------------------------------------------------------------
+# detection
+# ---------------------------------------------------------------------------
+
+class TestBoxOps:
+    def test_box_iou(self):
+        a = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+        b = np.array([[0, 0, 2, 2], [2, 2, 4, 4]], np.float32)
+        iou = np.asarray(vops.box_iou(a, b))
+        np.testing.assert_allclose(iou[0, 0], 1.0)
+        np.testing.assert_allclose(iou[1, 1], 1 / 7, rtol=1e-5)
+        np.testing.assert_allclose(iou[0, 1], 0.0)
+
+    def test_box_coder_round_trip(self):
+        rng = np.random.default_rng(0)
+        priors = np.abs(rng.normal(2, 0.5, (10, 4))).astype(np.float32)
+        priors[:, 2:] = priors[:, :2] + np.abs(priors[:, 2:]) + 1.0
+        gt = priors + rng.normal(0, 0.2, (10, 4)).astype(np.float32)
+        gt[:, 2:] = np.maximum(gt[:, 2:], gt[:, :2] + 0.5)
+        enc = vops.box_coder(priors, gt, "encode_center_size")
+        dec = np.asarray(vops.box_coder(priors, enc, "decode_center_size"))
+        np.testing.assert_allclose(dec, gt, rtol=1e-4, atol=1e-4)
+
+    def test_nms_greedy_matches_numpy(self):
+        rng = np.random.default_rng(1)
+        n = 40
+        xy = rng.uniform(0, 10, (n, 2)).astype(np.float32)
+        wh = rng.uniform(1, 4, (n, 2)).astype(np.float32)
+        boxes = np.concatenate([xy, xy + wh], 1)
+        scores = rng.uniform(0, 1, n).astype(np.float32)
+
+        def np_nms(thr):
+            order = np.argsort(-scores)
+            keep, alive = [], np.ones(n, bool)
+            for i in order:
+                if not alive[i]:
+                    continue
+                keep.append(i)
+                iou = np.asarray(vops.box_iou(boxes[i][None], boxes))[0]
+                alive &= iou <= thr
+            return keep
+
+        idx, valid = vops.nms(boxes, scores, iou_threshold=0.4)
+        got = [int(i) for i, v in zip(np.asarray(idx), np.asarray(valid))
+               if v]
+        assert got == np_nms(0.4)
+
+    def test_nms_static_shape_and_threshold(self):
+        boxes = np.array([[0, 0, 1, 1], [0, 0, 1.01, 1], [5, 5, 6, 6]],
+                         np.float32)
+        scores = np.array([0.9, 0.8, 0.05], np.float32)
+        idx, valid = vops.nms(boxes, scores, iou_threshold=0.5,
+                              score_threshold=0.1, max_out=3)
+        assert idx.shape == (3,)
+        got = np.asarray(idx)[np.asarray(valid)]
+        np.testing.assert_array_equal(got, [0])  # 1 suppressed, 2 below thr
+
+    def test_multiclass_nms_shapes(self):
+        rng = np.random.default_rng(2)
+        boxes = np.sort(rng.uniform(0, 10, (20, 4)).astype(np.float32), -1)
+        scores = rng.uniform(0, 1, (3, 20)).astype(np.float32)
+        out, valid = vops.multiclass_nms(boxes, scores, keep_top_k=10)
+        assert out.shape == (10, 6)
+        labels = np.asarray(out)[np.asarray(valid), 0]
+        assert set(labels).issubset({0.0, 1.0, 2.0})
+
+    def test_yolo_box_shapes_and_range(self):
+        rng = np.random.default_rng(3)
+        N, A, C, H, W = 2, 3, 5, 4, 4
+        x = rng.normal(0, 1, (N, A * (5 + C), H, W)).astype(np.float32)
+        img = np.array([[128, 128], [256, 192]], np.int32)
+        boxes, scores = vops.yolo_box(x, img, anchors=[10, 13, 16, 30, 33, 23],
+                                      class_num=C, downsample_ratio=32)
+        assert boxes.shape == (N, A * H * W, 4)
+        assert scores.shape == (N, A * H * W, C)
+        b = np.asarray(boxes)
+        assert (b[0] >= 0).all() and (b[0, :, [0, 2]] <= 127).all()
+        assert (np.asarray(scores) >= 0).all()
+
+    def test_prior_box(self):
+        pb = np.asarray(vops.prior_box(2, 2, 64, 64, min_sizes=[16],
+                                       max_sizes=[32],
+                                       aspect_ratios=[2.0], clip=True))
+        # P = 1 (min) + 2 (ar 2 + flip) + 1 (sqrt(min*max)) = 4
+        assert pb.shape == (2, 2, 4, 4)
+        assert (pb >= 0).all() and (pb <= 1).all()
+        # center of cell (0,0) is at pixel 16 -> normalized 0.25
+        c = (pb[0, 0, 0, :2] + pb[0, 0, 0, 2:]) / 2
+        np.testing.assert_allclose(c, [0.25, 0.25], atol=1e-6)
+
+    def test_roi_align_constant_and_grad(self):
+        # constant feature map -> every aligned value is that constant
+        x = np.full((1, 2, 8, 8), 3.0, np.float32)
+        rois = np.array([[1.0, 1.0, 5.0, 5.0]], np.float32)
+        out = np.asarray(vops.roi_align(x, rois, output_size=(2, 2)))
+        assert out.shape == (1, 2, 2, 2)
+        np.testing.assert_allclose(out, 3.0, rtol=1e-6)
+        # differentiable
+        g = jax.grad(lambda v: vops.roi_align(v, rois,
+                                              output_size=(2, 2)).sum())(
+            jnp.asarray(x))
+        assert np.isfinite(np.asarray(g)).all() and np.asarray(g).sum() > 0
+
+    def test_roi_align_multi_image_routing(self):
+        x = np.zeros((2, 1, 4, 4), np.float32)
+        x[1] += 7.0
+        rois = np.array([[0, 0, 3, 3], [0, 0, 3, 3]], np.float32)
+        out = np.asarray(vops.roi_align(x, rois, box_nums=np.array([1, 1]),
+                                        output_size=1))
+        np.testing.assert_allclose(out[:, 0, 0, 0], [0.0, 7.0])
+
+    def test_roi_pool_max(self):
+        x = np.zeros((1, 1, 4, 4), np.float32)
+        x[0, 0, 2, 2] = 5.0
+        rois = np.array([[0, 0, 3.9, 3.9]], np.float32)
+        out = np.asarray(vops.roi_pool(x, rois, output_size=1))
+        np.testing.assert_allclose(out[0, 0, 0, 0], 5.0)
+
+
+# ---------------------------------------------------------------------------
+# sequence (ragged)
+# ---------------------------------------------------------------------------
+
+class TestSequenceOps:
+    lengths = np.array([3, 1, 4], np.int32)
+    N = 8
+
+    def _vals(self, d=2):
+        return np.arange(self.N * d, dtype=np.float32).reshape(self.N, d)
+
+    def test_segment_ids(self):
+        ids = np.asarray(seq.segment_ids_from_lengths(self.lengths, self.N))
+        np.testing.assert_array_equal(ids, [0, 0, 0, 1, 2, 2, 2, 2])
+
+    def test_mask_pad_unpad_round_trip(self):
+        v = self._vals()
+        padded = np.asarray(seq.sequence_pad(v, self.lengths, maxlen=4,
+                                             pad_value=-1.0))
+        assert padded.shape == (3, 4, 2)
+        np.testing.assert_array_equal(padded[1, 1:], -1.0)
+        np.testing.assert_array_equal(padded[0, :3], v[:3])
+        np.testing.assert_array_equal(padded[2, :4], v[4:8])
+        packed, n = seq.sequence_unpad(padded, self.lengths)
+        assert int(n) == 8
+        np.testing.assert_array_equal(np.asarray(packed)[:8], v)
+
+    @pytest.mark.parametrize("pool,ref", [
+        ("sum", lambda s: s.sum(0)),
+        ("mean", lambda s: s.mean(0)),
+        ("max", lambda s: s.max(0)),
+        ("sqrt", lambda s: s.sum(0) / np.sqrt(len(s))),
+        ("first", lambda s: s[0]),
+        ("last", lambda s: s[-1]),
+    ])
+    def test_pool_matches_numpy(self, pool, ref):
+        v = self._vals()
+        out = np.asarray(seq.sequence_pool(v, self.lengths, pool))
+        segs = [v[0:3], v[3:4], v[4:8]]
+        expect = np.stack([ref(s) for s in segs])
+        np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+    def test_softmax_per_segment(self):
+        v = np.array([1., 2., 3., 5., 1., 1., 1., 1.], np.float32)
+        out = np.asarray(seq.sequence_softmax(v, self.lengths))
+        np.testing.assert_allclose(out[:3], np.exp(v[:3]) / np.exp(v[:3]).sum(),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(out[3], 1.0)
+        np.testing.assert_allclose(out[4:], 0.25, rtol=1e-6)
+
+    def test_reverse(self):
+        v = self._vals(1)
+        out = np.asarray(seq.sequence_reverse(v, self.lengths)).reshape(-1)
+        np.testing.assert_array_equal(out, [2, 1, 0, 3, 7, 6, 5, 4])
+
+    def test_expand(self):
+        v = np.array([[1.], [2.], [3.]], np.float32)
+        lengths = np.array([1, 2], np.int32)  # segs: [1], [2,3]
+        out = np.asarray(seq.sequence_expand(
+            v, lengths, np.array([2, 2], np.int32), total_out=8))
+        np.testing.assert_array_equal(out.reshape(-1),
+                                      [1, 1, 2, 3, 2, 3, 0, 0])
+
+    def test_pool_grad_flows(self):
+        v = jnp.asarray(self._vals())
+        g = jax.grad(lambda x: seq.sequence_pool(x, self.lengths,
+                                                 "mean").sum())(v)
+        # each row's grad = 1/len(segment)
+        np.testing.assert_allclose(np.asarray(g)[:, 0],
+                                   [1 / 3, 1 / 3, 1 / 3, 1, .25, .25, .25, .25],
+                                   rtol=1e-6)
