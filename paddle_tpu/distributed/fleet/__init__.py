@@ -4,6 +4,7 @@ from .strategy import DistributedStrategy  # noqa: F401
 from .. import meta_parallel  # noqa: F401
 from . import comm_opt  # noqa: F401
 from . import dataset  # noqa: F401  (InMemoryDataset / QueueDataset)
+from . import metrics  # noqa: F401  (distributed AUC/acc/sum/max)
 
 
 def init(role_maker=None, is_collective=True, strategy=None,

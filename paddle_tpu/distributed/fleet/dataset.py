@@ -63,14 +63,26 @@ class _DatasetBase:
 class QueueDataset(_DatasetBase):
     """Streaming: batches flow straight from reader threads (no staging).
 
-    Drop-last-per-worker semantics: each reader thread emits only FULL
-    batches, so up to (batch_size - 1) records per thread are dropped at
-    end-of-stream — the streaming trade-off (the reference QueueDataset
-    similarly streams without an epoch-exact tail).  Use InMemoryDataset
-    when every record must be seen."""
+    The native feeder delivers trailing PARTIAL per-thread batches so no
+    record is lost; jitted consumers need static shapes, so QueueDataset
+    keeps its documented only-full-batches contract by default
+    (``drop_last=True``) and short tails are filtered here.  Call
+    ``set_drop_last(False)`` to receive the ragged tails (eager/numpy
+    consumers); use InMemoryDataset for epoch-exact full batches."""
+
+    def __init__(self):
+        super().__init__()
+        self._drop_last = True
+
+    def set_drop_last(self, drop: bool):
+        self._drop_last = bool(drop)
+        return self
 
     def __iter__(self) -> Iterator[np.ndarray]:
-        yield from self._reader()
+        for arr in self._reader():
+            if self._drop_last and arr.shape[0] < self._batch:
+                continue
+            yield arr
 
 
 class InMemoryDataset(_DatasetBase):

@@ -1,0 +1,92 @@
+"""Distributed metrics (reference fleet/metrics/metric.py — sum/max/auc
+over all trainers via gloo all_reduce of local numpy stats).
+
+TPU-first: under single-controller SPMD a 'per-trainer local stat' is a
+stacked-per-rank array (see distributed/collective.py); these helpers
+reduce it with the eager collectives when a mesh axis is active and fall
+back to plain numpy when running single-process (the common case for
+metric aggregation at epoch end).  ``auc`` computes the final value from
+the (merged) positive/negative histograms exactly like the reference's
+distributed AUC."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["sum", "max", "min", "auc", "acc"]
+
+_builtin_sum, _builtin_max, _builtin_min = sum, max, min
+
+
+def _reduce(local, op: str):
+    """Stacked-per-rank [n*B, ...] -> reduced [B, ...] when a mesh axis is
+    live; identity for single-process."""
+    from ..env import get_mesh, has_mesh
+
+    arr = np.asarray(local)
+    if not has_mesh():
+        return arr
+    mesh = get_mesh()
+    ax = mesh.axis_names[0]
+    n = mesh.shape[ax]
+    if n <= 1:
+        return arr
+    if arr.ndim == 0 or arr.shape[0] % n:
+        from ...framework.errors import InvalidArgumentError
+
+        raise InvalidArgumentError(
+            f"fleet.metrics with an active {n}-way mesh needs "
+            f"stacked-per-rank input (leading dim a multiple of {n}); got "
+            f"shape {arr.shape}",
+            hint="stack each rank's local stat along dim 0, or aggregate "
+                 "before the mesh is initialized")
+    blocks = arr.reshape((n, arr.shape[0] // n) + arr.shape[1:])
+    if op == "sum":
+        return blocks.sum(0)
+    if op == "max":
+        return blocks.max(0)
+    if op == "min":
+        return blocks.min(0)
+    raise ValueError(op)
+
+
+def sum(local):  # noqa: A001 - reference API name
+    return _reduce(local, "sum")
+
+
+def max(local):  # noqa: A001
+    return _reduce(local, "max")
+
+
+def min(local):  # noqa: A001
+    return _reduce(local, "min")
+
+
+def acc(correct, total):
+    """Global accuracy from per-rank (correct, total) scalars or stacked
+    arrays (reference fleet.metrics.acc)."""
+    c = np.asarray(sum(np.atleast_1d(np.asarray(correct))), np.float64)
+    t = np.asarray(sum(np.atleast_1d(np.asarray(total))), np.float64)
+    return float(c.sum() / np.maximum(t.sum(), 1.0))
+
+
+def auc(stat_pos, stat_neg):
+    """AUC from positive/negative score histograms (reference
+    fleet/metrics/metric.py:auc — trapezoid over merged buckets).
+
+    stat_pos/stat_neg: [num_buckets] per-rank or stacked [n*num_buckets]
+    counts; bucket i holds scores in [i/B, (i+1)/B)."""
+    pos = np.asarray(sum(np.asarray(stat_pos, np.float64)), np.float64)
+    neg = np.asarray(sum(np.asarray(stat_neg, np.float64)), np.float64)
+    pos = np.atleast_1d(pos).reshape(-1)
+    neg = np.atleast_1d(neg).reshape(-1)
+    tot_pos = tot_neg = 0.0
+    area = 0.0
+    # walk buckets from high score to low (reference order)
+    for i in range(len(pos) - 1, -1, -1):
+        new_pos = tot_pos + pos[i]
+        new_neg = tot_neg + neg[i]
+        area += (new_pos + tot_pos) * neg[i] / 2.0
+        tot_pos, tot_neg = new_pos, new_neg
+    if tot_pos == 0.0 or tot_neg == 0.0:
+        return 0.5
+    return float(area / (tot_pos * tot_neg))

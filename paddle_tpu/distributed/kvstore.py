@@ -22,26 +22,34 @@ import time
 from typing import Any
 
 
+def send_frame(sock: socket.socket, payload: bytes):
+    """Length-prefixed frame write (shared by the KV store and the PS
+    service wire — one framing implementation to fix, not two)."""
+    sock.sendall(struct.pack("!I", len(payload)) + payload)
+
+
+def read_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        b = sock.recv(min(n, 1 << 20))
+        if not b:
+            raise ConnectionError("peer closed")
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+def recv_frame(sock: socket.socket) -> bytes:
+    (n,) = struct.unpack("!I", read_exact(sock, 4))
+    return read_exact(sock, n)
+
+
 def _send(sock: socket.socket, obj: Any):
-    data = json.dumps(obj).encode()
-    sock.sendall(struct.pack("!I", len(data)) + data)
+    send_frame(sock, json.dumps(obj).encode())
 
 
 def _recv(sock: socket.socket) -> Any:
-    hdr = b""
-    while len(hdr) < 4:
-        chunk = sock.recv(4 - len(hdr))
-        if not chunk:
-            raise ConnectionError("peer closed")
-        hdr += chunk
-    (n,) = struct.unpack("!I", hdr)
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise ConnectionError("peer closed")
-        buf += chunk
-    return json.loads(buf.decode())
+    return json.loads(recv_frame(sock).decode())
 
 
 class KVServer:

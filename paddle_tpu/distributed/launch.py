@@ -113,12 +113,20 @@ def launch_ps(args) -> int:
     (reference ParameterServerLauncher, fleet/launch_utils.py:788).
     Servers run paddle_tpu.distributed.ps_service; workers get
     PADDLE_PSERVER_ENDPOINTS / TRAINING_ROLE / PADDLE_TRAINER_ID env."""
+    import shutil
     import tempfile
 
     log_dir = args.log_dir
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
     tmp = tempfile.mkdtemp(prefix="pt_ps_")
+    try:
+        return _launch_ps_impl(args, tmp, log_dir)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _launch_ps_impl(args, tmp, log_dir) -> int:
     servers: list[TrainerProc] = []
     for i in range(args.server_num):
         ready = os.path.join(tmp, f"ep{i}.txt")

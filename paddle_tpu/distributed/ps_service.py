@@ -28,7 +28,6 @@ import os
 import queue
 import socket
 import socketserver
-import struct
 import threading
 import time
 from typing import Any, Sequence
@@ -46,13 +45,14 @@ _OP_NAMES = {v: k for k, v in OPS.items()}
 
 
 def _pack(op: str, meta: dict, arrays: dict[str, np.ndarray]) -> bytes:
+    """Frame payload: 1 op byte + npz body (length prefix added by the
+    shared kvstore framing on send)."""
     import json
 
     buf = io.BytesIO()
     np.savez(buf, __meta__=np.frombuffer(
         json.dumps(meta).encode(), np.uint8), **arrays)
-    body = buf.getvalue()
-    return struct.pack("!IB", len(body) + 1, OPS[op]) + body
+    return bytes([OPS[op]]) + buf.getvalue()
 
 
 def _unpack(frame: bytes):
@@ -65,24 +65,9 @@ def _unpack(frame: bytes):
     return op, meta, arrays
 
 
-def _read_exact(sock: socket.socket, n: int) -> bytes:
-    chunks = []
-    while n:
-        b = sock.recv(min(n, 1 << 20))
-        if not b:
-            raise ConnectionError("peer closed")
-        chunks.append(b)
-        n -= len(b)
-    return b"".join(chunks)
-
-
-def _send_frame(sock: socket.socket, data: bytes):
-    sock.sendall(data)
-
-
-def _recv_frame(sock: socket.socket) -> bytes:
-    (n,) = struct.unpack("!I", _read_exact(sock, 4))
-    return _read_exact(sock, n)
+# length-prefixed framing shared with the KV store (kvstore.py)
+from .kvstore import recv_frame as _recv_frame  # noqa: E402
+from .kvstore import send_frame as _send_frame  # noqa: E402
 
 
 # ---------------------------------------------------------------------------
@@ -104,6 +89,7 @@ class PSServer:
         self.server_idx = server_idx
         self.num_servers = num_servers
         self._tables: dict[int, dict] = {}
+        self._tables_lock = threading.Lock()
         self._dense: dict[str, np.ndarray] = {}
         self._dense_lock = threading.Lock()
         self._counters: dict[str, int] = {}
@@ -151,15 +137,17 @@ class PSServer:
         try:
             if op == "create":
                 tid = meta["tid"]
-                if tid not in self._tables:
-                    rows = self._local_rows(meta["vocab"])
-                    h = lib.pst_create(
-                        rows, meta["dim"],
-                        meta.get("seed", 0) * 1000 + self.server_idx,
-                        meta.get("init_range", 0.05))
-                    self._tables[tid] = {"h": h, "rows": rows,
-                                         "dim": meta["dim"],
-                                         "vocab": meta["vocab"]}
+                with self._tables_lock:  # concurrent creates must not
+                    # race the check-then-insert (handle leak + lost pushes)
+                    if tid not in self._tables:
+                        rows = self._local_rows(meta["vocab"])
+                        h = lib.pst_create(
+                            rows, meta["dim"],
+                            meta.get("seed", 0) * 1000 + self.server_idx,
+                            meta.get("init_range", 0.05))
+                        self._tables[tid] = {"h": h, "rows": rows,
+                                             "dim": meta["dim"],
+                                             "vocab": meta["vocab"]}
                 return _pack("create", {"ok": True}, {})
             if op == "pull":
                 t = self._tables[meta["tid"]]
@@ -272,11 +260,17 @@ class PSClient:
     fans requests to all servers in parallel, reassembles in order."""
 
     def __init__(self, endpoints: Sequence[str], timeout: float = 60.0):
+        from concurrent.futures import ThreadPoolExecutor
+
         self.endpoints = list(endpoints)
         self.S = len(self.endpoints)
         self._socks: list[socket.socket | None] = [None] * self.S
         self._locks = [threading.Lock() for _ in range(self.S)]
         self._timeout = timeout
+        # persistent fan-out pool: pull/push run every training step —
+        # per-call thread construction would sit on the hot path
+        self._pool = ThreadPoolExecutor(max_workers=self.S,
+                                        thread_name_prefix="psclient")
 
     def _sock(self, s: int) -> socket.socket:
         if self._socks[s] is None:
@@ -298,23 +292,10 @@ class PSClient:
         return rmeta, rarr
 
     def _fan(self, op: str, metas, arrays_by_s):
-        out: dict[int, tuple] = {}
-        errs: list = []
-
-        def go(s):
-            try:
-                out[s] = self._rpc(s, op, metas[s], arrays_by_s[s])
-            except Exception as e:  # noqa: BLE001
-                errs.append(e)
-
-        ts = [threading.Thread(target=go, args=(s,)) for s in range(self.S)]
-        for t in ts:
-            t.start()
-        for t in ts:
-            t.join()
-        if errs:
-            raise errs[0]
-        return out
+        futs = {s: self._pool.submit(self._rpc, s, op, metas[s],
+                                     arrays_by_s[s])
+                for s in range(self.S)}
+        return {s: f.result() for s, f in futs.items()}
 
     # -- table API ----------------------------------------------------------
     def create_table(self, tid: int, vocab: int, dim: int, seed: int = 0,
@@ -382,12 +363,16 @@ class PSClient:
 
     def barrier(self, key: str, world: int, timeout: float = 60.0):
         """All-worker barrier through server 0's counter table (the
-        reference BarrierTable role): arrive once, poll until everyone has."""
-        self._rpc(0, "barrier_add", {"key": key}, {})
+        reference BarrierTable role).  Generation-based so the same key is
+        reusable across epochs: my arrival number fixes my generation, and
+        I wait until that whole generation has arrived — the counter only
+        ever grows, no reset race."""
+        m, _ = self._rpc(0, "barrier_add", {"key": key}, {})
+        gen_target = ((m["count"] - 1) // world + 1) * world
         t0 = time.time()
         while time.time() - t0 < timeout:
             c, _ = self._rpc(0, "barrier_get", {"key": key}, {})
-            if c["count"] >= world:
+            if c["count"] >= gen_target:
                 return True
             time.sleep(0.05)
         raise TimeoutError(f"PS barrier {key!r}")
@@ -400,6 +385,7 @@ class PSClient:
                 pass
 
     def close(self):
+        self._pool.shutdown(wait=False)
         for sk in self._socks:
             if sk is not None:
                 try:

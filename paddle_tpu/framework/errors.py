@@ -1,0 +1,105 @@
+"""Typed error system (reference platform/enforce.h:427 PADDLE_ENFORCE* +
+error_codes.proto — LEGACY/INVALID_ARGUMENT/NOT_FOUND/OUT_OF_RANGE/
+ALREADY_EXISTS/.../UNAVAILABLE typed exceptions with enriched messages).
+
+TPU-first: plain Python exception classes carrying an error code, plus
+``enforce``/``enforce_eq``/``enforce_shape`` helpers that build the
+reference-style message (expected vs actual, caller hint) without the C++
+stack machinery — the Python traceback IS the stack."""
+from __future__ import annotations
+
+from typing import Any
+
+__all__ = ["EnforceNotMet", "InvalidArgumentError", "NotFoundError",
+           "OutOfRangeError", "AlreadyExistsError", "PermissionDeniedError",
+           "UnimplementedError", "UnavailableError", "ResourceExhaustedError",
+           "PreconditionNotMetError", "ExecutionTimeoutError", "FatalError",
+           "enforce", "enforce_eq", "enforce_gt", "enforce_shape"]
+
+
+class EnforceNotMet(RuntimeError):
+    """Base of all typed framework errors (enforce.h EnforceNotMet)."""
+
+    code = "LEGACY"
+
+    def __init__(self, msg: str, hint: str = ""):
+        self.hint = hint
+        full = f"[{self.code}] {msg}"
+        if hint:
+            full += f"\n  [Hint: {hint}]"
+        super().__init__(full)
+
+
+class InvalidArgumentError(EnforceNotMet):
+    code = "INVALID_ARGUMENT"
+
+
+class NotFoundError(EnforceNotMet):
+    code = "NOT_FOUND"
+
+
+class OutOfRangeError(EnforceNotMet):
+    code = "OUT_OF_RANGE"
+
+
+class AlreadyExistsError(EnforceNotMet):
+    code = "ALREADY_EXISTS"
+
+
+class ResourceExhaustedError(EnforceNotMet):
+    code = "RESOURCE_EXHAUSTED"
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    code = "PRECONDITION_NOT_MET"
+
+
+class PermissionDeniedError(EnforceNotMet):
+    code = "PERMISSION_DENIED"
+
+
+class ExecutionTimeoutError(EnforceNotMet):
+    code = "EXECUTION_TIMEOUT"
+
+
+class UnimplementedError(EnforceNotMet):
+    code = "UNIMPLEMENTED"
+
+
+class UnavailableError(EnforceNotMet):
+    code = "UNAVAILABLE"
+
+
+class FatalError(EnforceNotMet):
+    code = "FATAL"
+
+
+def enforce(cond: Any, msg: str, exc: type = InvalidArgumentError,
+            hint: str = ""):
+    """PADDLE_ENFORCE analog: raise ``exc`` with an enriched message when
+    ``cond`` is falsy."""
+    if not cond:
+        raise exc(msg, hint)
+
+
+def enforce_eq(a, b, what: str = "value", exc: type = InvalidArgumentError):
+    """PADDLE_ENFORCE_EQ analog with expected-vs-actual in the message."""
+    if a != b:
+        raise exc(f"{what} mismatch: expected {b!r}, got {a!r}")
+
+
+def enforce_gt(a, b, what: str = "value", exc: type = InvalidArgumentError):
+    if not a > b:
+        raise exc(f"{what} must be > {b!r}, got {a!r}")
+
+
+def enforce_shape(x, shape, what: str = "tensor",
+                  exc: type = InvalidArgumentError):
+    """Shape check tolerating None wildcards in ``shape``."""
+    import numpy as np
+
+    actual = tuple(np.shape(x))
+    if len(actual) != len(shape) or any(
+            s is not None and s != a for s, a in zip(shape, actual)):
+        raise exc(f"{what} shape mismatch: expected "
+                  f"{tuple(shape)!r}, got {actual!r}")

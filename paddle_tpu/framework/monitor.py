@@ -1,0 +1,108 @@
+"""Global runtime counters (reference platform/monitor.h:44 —
+``StatValue``/``StatRegistry``, the GPU mem/usage counters surfaced by
+``paddle.fluid.core.get_mem_usage`` style getters).
+
+TPU-first: a thread-safe process-local registry; device-side numbers come
+from PJRT (``jax.local_devices()[i].memory_stats()``) and are snapshotted
+into the same registry so one ``stats()`` call observes both."""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Iterator
+
+__all__ = ["StatValue", "StatRegistry", "get_stat", "stats", "reset_all",
+           "snapshot_device_stats"]
+
+
+class StatValue:
+    """One named monotonic-ish counter (int64 semantics like the
+    reference's StatValue: add/sub/reset/get)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def add(self, n: int = 1) -> int:
+        with self._lock:
+            self._v += int(n)
+            return self._v
+
+    def sub(self, n: int = 1) -> int:
+        return self.add(-n)
+
+    def set(self, n: int) -> None:
+        with self._lock:
+            self._v = int(n)
+
+    def get(self) -> int:
+        with self._lock:
+            return self._v
+
+    def reset(self) -> None:
+        self.set(0)
+
+
+class StatRegistry:
+    """Singleton name→StatValue table (monitor.h StatRegistry)."""
+
+    _inst: "StatRegistry | None" = None
+    _inst_lock = threading.Lock()
+
+    def __init__(self):
+        self._stats: dict[str, StatValue] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def instance(cls) -> "StatRegistry":
+        with cls._inst_lock:
+            if cls._inst is None:
+                cls._inst = cls()
+            return cls._inst
+
+    def get(self, name: str) -> StatValue:
+        with self._lock:
+            if name not in self._stats:
+                self._stats[name] = StatValue(name)
+            return self._stats[name]
+
+    def __iter__(self) -> Iterator[StatValue]:
+        with self._lock:
+            return iter(list(self._stats.values()))
+
+    def dict(self) -> dict[str, int]:
+        return {s.name: s.get() for s in self}
+
+    def reset_all(self) -> None:
+        for s in self:
+            s.reset()
+
+
+def get_stat(name: str) -> StatValue:
+    return StatRegistry.instance().get(name)
+
+
+def stats() -> dict[str, int]:
+    return StatRegistry.instance().dict()
+
+
+def reset_all() -> None:
+    StatRegistry.instance().reset_all()
+
+
+def snapshot_device_stats() -> dict[str, int]:
+    """Fold PJRT per-device memory stats into the registry
+    (STAT_gpuN_mem analog: stat 'device{i}_bytes_in_use' etc.)."""
+    import jax
+
+    out = {}
+    for i, d in enumerate(jax.local_devices()):
+        ms = d.memory_stats() or {}
+        for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if k in ms:
+                name = f"device{i}_{k}"
+                get_stat(name).set(ms[k])
+                out[name] = ms[k]
+    get_stat("device_stats_snapshot_time_ns").set(time.time_ns())
+    return out

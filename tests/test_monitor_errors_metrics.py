@@ -1,0 +1,122 @@
+"""StatRegistry counters (monitor.h:44), typed enforce errors
+(enforce.h:427 / error_codes.proto), distributed fleet metrics
+(fleet/metrics/metric.py)."""
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.framework import errors, monitor
+
+
+class TestMonitor:
+    def setup_method(self, _):
+        monitor.reset_all()
+
+    def test_counter_add_get_reset(self):
+        s = monitor.get_stat("steps")
+        assert s.add(5) == 5
+        assert s.sub(2) == 3
+        assert monitor.get_stat("steps") is s  # registry is a singleton map
+        assert monitor.stats()["steps"] == 3
+        monitor.reset_all()
+        assert s.get() == 0
+
+    def test_thread_safety(self):
+        s = monitor.get_stat("concurrent")
+
+        def work():
+            for _ in range(1000):
+                s.add(1)
+
+        ts = [threading.Thread(target=work) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert s.get() == 8000
+
+    def test_device_snapshot(self):
+        out = monitor.snapshot_device_stats()
+        # CPU backend may expose no memory stats; the call must still
+        # register the snapshot timestamp
+        assert monitor.stats()["device_stats_snapshot_time_ns"] > 0
+        assert isinstance(out, dict)
+
+
+class TestErrors:
+    def test_typed_codes_and_hint(self):
+        with pytest.raises(errors.InvalidArgumentError,
+                           match=r"(?s)\[INVALID_ARGUMENT\].*positive.*Hint"):
+            errors.enforce(False, "n must be positive",
+                           hint="pass n >= 1")
+
+    def test_enforce_eq_message(self):
+        with pytest.raises(errors.InvalidArgumentError,
+                           match="expected 4, got 3"):
+            errors.enforce_eq(3, 4, "axis size")
+
+    def test_enforce_shape_wildcards(self):
+        errors.enforce_shape(np.zeros((2, 5)), (None, 5))
+        with pytest.raises(errors.InvalidArgumentError, match="shape"):
+            errors.enforce_shape(np.zeros((2, 5)), (None, 4), "logits")
+
+    def test_hierarchy(self):
+        assert issubclass(errors.NotFoundError, errors.EnforceNotMet)
+        with pytest.raises(errors.EnforceNotMet):
+            errors.enforce(False, "x", exc=errors.UnavailableError)
+
+
+class TestFleetMetrics:
+    def test_auc_perfect_and_random(self):
+        from paddle_tpu.distributed.fleet import metrics as fm
+
+        B = 10
+        # perfect separation: all negatives in low buckets, positives high
+        pos = np.zeros(B)
+        neg = np.zeros(B)
+        pos[9] = 100
+        neg[0] = 100
+        assert fm.auc(pos, neg) == pytest.approx(1.0)
+        # identical distributions -> 0.5
+        pos = np.ones(B) * 10
+        neg = np.ones(B) * 10
+        assert fm.auc(pos, neg) == pytest.approx(0.5)
+        # degenerate (no positives) -> 0.5 like the reference
+        assert fm.auc(np.zeros(B), neg) == 0.5
+
+    def test_auc_matches_sklearn_style_reference(self):
+        from paddle_tpu.distributed.fleet import metrics as fm
+
+        rng = np.random.default_rng(0)
+        B = 100
+        scores_pos = np.clip(rng.beta(4, 2, 2000), 0, 0.999)
+        scores_neg = np.clip(rng.beta(2, 4, 2000), 0, 0.999)
+        pos, _ = np.histogram(scores_pos, bins=B, range=(0, 1))
+        neg, _ = np.histogram(scores_neg, bins=B, range=(0, 1))
+        got = fm.auc(pos, neg)
+        # exact pairwise AUC on the same bucketed data
+        exact = 0.0
+        tot = 0.0
+        bp = (np.arange(B) + 0.5) / B
+        for i in range(B):
+            for j in range(B):
+                if pos[i] == 0 or neg[j] == 0:
+                    continue
+                w = pos[i] * neg[j]
+                tot += w
+                exact += w * (1.0 if bp[i] > bp[j] else
+                              0.5 if i == j else 0.0)
+        np.testing.assert_allclose(got, exact / tot, atol=0.01)
+
+    def test_stacked_reduce_and_acc(self):
+        from paddle_tpu.distributed import init_parallel_env
+        from paddle_tpu.distributed.fleet import metrics as fm
+
+        init_parallel_env({"dp": 8})
+        stacked = np.arange(8, dtype=np.float64)  # one scalar per rank
+        assert float(fm.sum(stacked)[0]) == 28.0
+        assert float(fm.max(stacked)[0]) == 7.0
+        correct = np.full(8, 10.0)
+        total = np.full(8, 20.0)
+        assert fm.acc(correct, total) == pytest.approx(0.5)
