@@ -22,7 +22,10 @@ if not _root._LIGHT_IMPORT:
     )
     from .parallel import DataParallel  # noqa: F401
     from .recompute import recompute  # noqa: F401
-    from . import megatron, pipeline, pp_layers, ps  # noqa: F401
+    from . import megatron, pipeline, pp_layers, ps, role_maker  # noqa: F401
+    from .role_maker import (  # noqa: F401
+        PaddleCloudRoleMaker, UserDefinedRoleMaker,
+    )
     from .pp_layers import (  # noqa: F401
         LayerDesc, PipelineLayer, SharedLayerDesc,
     )

@@ -63,6 +63,16 @@ class ElasticManager:
         return sorted(k.split("/", 2)[2] for k, ts in kv.items()
                       if now - float(ts) < self.ttl)
 
+    def resnapshot(self):
+        """Re-baseline the membership snapshot (call once every expected
+        peer has registered, so their first heartbeats don't read as a
+        scale event)."""
+        self._last_live = frozenset(self.live_hosts()[: self.np_max])
+
+    def effective_hosts(self) -> list:
+        """The np_max-capped membership the job actually runs with."""
+        return self.live_hosts()[: self.np_max]
+
     # -- watch ---------------------------------------------------------------
     def check(self) -> str:
         """Poll once: OK (effective membership unchanged), SCALE (world

@@ -38,10 +38,18 @@ class Fleet:
         self._strategy: DistributedStrategy | None = None
         self._hcg: HybridCommunicateGroup | None = None
         self._is_initialized = False
+        self._role_maker = None
 
     def init(self, role_maker=None, is_collective=True, strategy=None,
              allow_degrade=False):
+        from ..role_maker import PaddleCloudRoleMaker
+
+        self._role_maker = role_maker or PaddleCloudRoleMaker(is_collective)
         self._strategy = strategy or DistributedStrategy()
+        if self._role_maker.is_server():
+            # PS-pod server process: no mesh/backend to initialize
+            self._is_initialized = True
+            return self
         shape = self._strategy.mesh_shape()
         n = len(jax.devices())
         need = int(np.prod(list(shape.values())))
@@ -88,6 +96,18 @@ class Fleet:
 
     def is_first_worker(self):
         return self.worker_index() == 0
+
+    def is_worker(self):
+        return self._role_maker is None or self._role_maker.is_worker()
+
+    def is_server(self):
+        return self._role_maker is not None and self._role_maker.is_server()
+
+    def server_num(self):
+        return self._role_maker.server_num() if self._role_maker else 0
+
+    def server_index(self):
+        return self._role_maker.server_index() if self._role_maker else -1
 
     def barrier_worker(self):
         from .. import collective

@@ -14,9 +14,6 @@ import numpy as np
 
 __all__ = ["sum", "max", "min", "auc", "acc"]
 
-_builtin_sum, _builtin_max, _builtin_min = sum, max, min
-
-
 def _reduce(local, op: str):
     """Stacked-per-rank [n*B, ...] -> reduced [B, ...] when a mesh axis is
     live; identity for single-process."""

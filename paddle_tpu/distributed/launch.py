@@ -43,6 +43,10 @@ def parse_args(argv=None):
                    help=">1 simulates a multi-host job on one machine (CPU)")
     p.add_argument("--max_restarts", type=int, default=0)
     p.add_argument("--log_dir", default=None)
+    p.add_argument("--elastic_np", default=None,
+                   help="MIN:MAX live-host range; watch KV membership and "
+                        "relaunch the pod on scale events (reference "
+                        "ElasticManager, fleet/elastic.py:90)")
     p.add_argument("--server_num", type=int, default=0,
                    help="parameter-server mode: spawn N table servers "
                         "(reference ParameterServerLauncher)")
@@ -230,19 +234,44 @@ def launch(args) -> int:
         client.set(f"host/{args.node_rank}", os.uname().nodename)
         client.barrier("launch/ready", args.nnodes)
 
-    procs: list[TrainerProc] = []
-    ranks = range(world) if local_sim else [args.node_rank]
-    for r in ranks:
-        cmd = [sys.executable, "-u", args.training_script,
-               *args.training_script_args]
-        env = _proc_env(r, world, coordinator, local_sim)
-        log = (os.path.join(args.log_dir, f"worker.{r}.log")
-               if args.log_dir else None)
-        if args.log_dir:
-            os.makedirs(args.log_dir, exist_ok=True)
-        procs.append(TrainerProc(cmd, env, log, r))
-    for p in procs:
-        p.start()
+    # elastic membership: heartbeat this node, watch the live set, and
+    # relaunch the pod on scale events (ElasticManager integration — the
+    # reference's elastic.py watch-callback teardown/relaunch)
+    elastic = None
+    if args.elastic_np:
+        from .elastic import ElasticManager
+
+        np_min, np_max = (int(v) for v in args.elastic_np.split(":"))
+        if client is None:
+            client = KVClient(coord_host, coord_port)
+        elastic = ElasticManager(client, host_id=f"node{args.node_rank}",
+                                 np_range=(np_min, np_max),
+                                 heartbeat_interval=0.2, ttl=2.0)
+        elastic.register()
+        if args.nnodes > 1:
+            # wait for every expected peer's first heartbeat before
+            # baselining, or their arrival reads as a spurious scale event
+            elastic.wait_for_np(min(args.nnodes, np_max), timeout=60)
+        elastic.resnapshot()
+
+    def spawn_pod(world_n: int, my_rank: int | None = None):
+        ps = []
+        ranks = range(world_n) if local_sim else [
+            my_rank if my_rank is not None else args.node_rank]
+        for r in ranks:
+            cmd = [sys.executable, "-u", args.training_script,
+                   *args.training_script_args]
+            env = _proc_env(r, world_n, coordinator, local_sim)
+            log = (os.path.join(args.log_dir, f"worker.{r}.log")
+                   if args.log_dir else None)
+            if args.log_dir:
+                os.makedirs(args.log_dir, exist_ok=True)
+            ps.append(TrainerProc(cmd, env, log, r))
+        for p in ps:
+            p.start()
+        return ps
+
+    procs = spawn_pod(world)
 
     # watch loop: abnormal exit of ANY proc stops the whole pod (a multi-
     # process JAX job cannot survive a single dead rank — the reference's
@@ -253,6 +282,33 @@ def launch(args) -> int:
         while True:
             alive = any(p.poll() is None for p in procs)
             failed = [p for p in procs if p.poll() not in (None, 0)]
+            if elastic is not None and alive:
+                status = elastic.check()
+                if status == "scale":
+                    # re-rank against the capped effective membership: after
+                    # a scale-down the surviving hosts' ranks must stay
+                    # contiguous (the reference ElasticManager re-ranks)
+                    eff = elastic.effective_hosts()
+                    new_world = len(eff)
+                    me = f"node{args.node_rank}"
+                    if not local_sim and me not in eff:
+                        print("[launch] elastic: this host fell out of the "
+                              "effective membership; exiting", file=sys.stderr)
+                        exit_code = 1
+                        break
+                    new_rank = eff.index(me) if not local_sim else None
+                    print(f"[launch] elastic scale event: effective hosts -> "
+                          f"{new_world}; relaunching pod", file=sys.stderr)
+                    for p in procs:
+                        p.terminate()
+                    world = new_world if not local_sim else world
+                    procs = spawn_pod(world, new_rank)
+                    continue
+                if status == "exit":
+                    print("[launch] elastic: below np_min; terminating",
+                          file=sys.stderr)
+                    exit_code = 1
+                    break
             if failed:
                 rc = failed[0].poll()
                 for p in procs:
@@ -277,6 +333,8 @@ def launch(args) -> int:
     finally:
         for p in procs:
             p.terminate()
+        if elastic is not None:
+            elastic.deregister()
         if client:
             client.close()
         if server:

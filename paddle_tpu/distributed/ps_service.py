@@ -192,13 +192,17 @@ class PSServer:
                 return _pack("push_dense", {"ok": True}, {})
             if op == "save":
                 os.makedirs(meta["dir"], exist_ok=True)
-                for tid, t in self._tables.items():
+                with self._tables_lock:  # snapshot: creates may race
+                    tables = list(self._tables.items())
+                for tid, t in tables:
                     lib.pst_save(t["h"], os.path.join(
                         meta["dir"],
                         f"table_{tid}.shard{self.server_idx}").encode())
                 return _pack("save", {"ok": True}, {})
             if op == "load":
-                for tid, t in self._tables.items():
+                with self._tables_lock:
+                    tables = list(self._tables.items())
+                for tid, t in tables:
                     rc = lib.pst_load(t["h"], os.path.join(
                         meta["dir"],
                         f"table_{tid}.shard{self.server_idx}").encode())
@@ -217,10 +221,12 @@ class PSServer:
                         "ok": True,
                         "count": self._counters.get(meta["key"], 0)}, {})
             if op == "stat":
+                with self._tables_lock:
+                    tables = list(self._tables.items())
                 return _pack("stat", {
                     "ok": True, "server_idx": self.server_idx,
                     "tables": {str(tid): {"rows": t["rows"], "dim": t["dim"]}
-                               for tid, t in self._tables.items()}}, {})
+                               for tid, t in tables}}, {})
             if op == "shutdown":
                 return _pack("shutdown", {"ok": True}, {})
             return _pack(op, {"ok": False, "err": f"bad op {op}"}, {})

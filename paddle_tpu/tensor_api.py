@@ -331,8 +331,11 @@ def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
 
 @_public
 def lerp(x, y, weight):
-    w = _v(weight) if isinstance(weight, Tensor) else weight
-    return dispatch(lambda a, b: a + w * (b - a), x, y, op_name="lerp")
+    if isinstance(weight, Tensor):
+        # weight must flow through dispatch or its gradient is lost
+        return dispatch(lambda a, b, w: a + w * (b - a), x, y, weight,
+                        op_name="lerp")
+    return dispatch(lambda a, b: a + weight * (b - a), x, y, op_name="lerp")
 
 
 @_public

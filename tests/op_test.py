@@ -75,7 +75,8 @@ class OpTest:
         probe = self._run_op(*[paddle.to_tensor(a) for a in arrays])
         if isinstance(probe, (tuple, list)):
             probe = probe[0]
-        cot = np.random.RandomState(0).randn(*probe.shape).astype(np.float32)
+        cot = np.asarray(np.random.RandomState(0).randn(*probe.shape),
+                         np.float32)  # asarray: scalar outputs give a 0-d
 
         def scalar_fn(*arrs):
             ts = [paddle.to_tensor(a) for a in arrs]

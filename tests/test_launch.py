@@ -109,6 +109,72 @@ def test_launch_cli_runs_script(tmp_path):
     assert "rank 1/2 ok" in text
 
 
+def test_launch_elastic_scale_relaunch(tmp_path):
+    """End-to-end elastic: a new host heartbeating into the coordinator KV
+    triggers a pod relaunch (reference ElasticManager watch→teardown→
+    relaunch, fleet/elastic.py:125-164)."""
+    import socket
+
+    script = tmp_path / "train.py"
+    script.write_text(
+        "import os, time\n"
+        "print('POD-START world', os.environ['PADDLE_TRAINERS_NUM'],"
+        " flush=True)\n"
+        "time.sleep(8)\n")
+    # fixed free port so the test can dial the same KV store
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--coordinator", f"127.0.0.1:{port}", "--elastic_np", "1:4",
+         str(script)],
+        cwd="/root/repo", stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True)
+    try:
+        time.sleep(3.0)  # pod up, membership snapshot taken
+        c = KVClient("127.0.0.1", port)
+        c.stamp("elastic/host/node99")  # a second host joins
+        # relaunch fires; node99's single heartbeat expires (ttl) causing
+        # one more relaunch; the final pod runs to completion and the
+        # launcher exits normally (no SIGTERM: children share the pipe)
+        out, err = proc.communicate(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    assert "elastic scale event" in err, err
+    assert out.count("POD-START") >= 2, out  # original + relaunched pod
+
+
+def test_role_maker_env_parsing(monkeypatch):
+    from paddle_tpu.distributed.role_maker import (PaddleCloudRoleMaker,
+                                                   UserDefinedRoleMaker)
+
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
+    monkeypatch.setenv("PADDLE_TRAINER_ENDPOINTS",
+                       "h0:8000,h1:8000,h2:8000,h3:8000")
+    monkeypatch.setenv("PADDLE_PSERVER_ENDPOINTS", "h0:9000,h1:9000")
+    monkeypatch.setenv("TRAINING_ROLE", "TRAINER")
+    rm = PaddleCloudRoleMaker()
+    assert rm.is_worker() and not rm.is_server()
+    assert rm.worker_index() == 2 and rm.worker_num() == 4
+    assert rm.server_num() == 2
+    assert rm.get_pserver_endpoints() == ["h0:9000", "h1:9000"]
+    assert not rm.is_first_worker()
+
+    monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+    monkeypatch.setenv("PADDLE_PSERVER_ID", "1")
+    rs = PaddleCloudRoleMaker()
+    assert rs.is_server() and rs.server_index() == 1
+
+    u = UserDefinedRoleMaker(current_id=0, worker_num=2,
+                             worker_endpoints=["a:1", "b:1"])
+    assert u.is_first_worker() and u.worker_num() == 2
+
+
 def test_launch_restarts_on_failure(tmp_path):
     marker = tmp_path / "marker"
     script = tmp_path / "flaky.py"
