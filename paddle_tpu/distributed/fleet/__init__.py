@@ -5,6 +5,9 @@ from .. import meta_parallel  # noqa: F401
 from . import comm_opt  # noqa: F401
 from . import dataset  # noqa: F401  (InMemoryDataset / QueueDataset)
 from . import metrics  # noqa: F401  (distributed AUC/acc/sum/max)
+from .strategy_compiler import (  # noqa: F401
+    StrategyPlan, compile_strategy,
+)
 
 
 def init(role_maker=None, is_collective=True, strategy=None,

@@ -39,6 +39,7 @@ class Fleet:
         self._hcg: HybridCommunicateGroup | None = None
         self._is_initialized = False
         self._role_maker = None
+        self._degraded = False
 
     def init(self, role_maker=None, is_collective=True, strategy=None,
              allow_degrade=False):
@@ -71,6 +72,7 @@ class Fleet:
                 f"semantics differ from the requested strategy",
                 stacklevel=2)
             shape = {"dp": n}
+            self._degraded = True
         init_parallel_env(shape)
         self._hcg = HybridCommunicateGroup()
         self._is_initialized = True
@@ -141,11 +143,31 @@ class Fleet:
 
     def build_train_step(self, loss_fn, params, optimizer, param_specs=None,
                          batch_spec=None, donate=True):
-        """Compile the strategy-parameterized train step (the minimize analog)."""
+        """Compile the strategy-parameterized train step (the minimize
+        analog, functional/pytree API).  Validates the toggle plan loudly
+        first — unless the caller opted into a degraded mesh, where axis-
+        requiring toggles disable with a warning (the reference's
+        _disable_strategy behavior)."""
+        from .strategy_compiler import compile_strategy
+
+        compile_strategy(
+            self._strategy or DistributedStrategy(), dict(get_mesh().shape),
+            on_missing_axis="disable" if self._degraded else "raise")
         return ShardedTrainStep(
             loss_fn, params, optimizer, mesh=get_mesh(), param_specs=param_specs,
             batch_spec=batch_spec, strategy=self._strategy, donate=donate,
         )
+
+    def build_layer_train_step(self, model, loss_fn, optimizer,
+                               example_input=None):
+        """Route a Layer model per the compiled strategy plan (the
+        distributed_model + minimize dispatch, fleet_base.py:836)."""
+        from .strategy_compiler import build_layer_train_step
+
+        return build_layer_train_step(
+            model, loss_fn, optimizer,
+            self._strategy or DistributedStrategy(),
+            mesh=get_mesh(), example_input=example_input)
 
     def minimize(self, optimizer, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
@@ -225,9 +247,13 @@ class ShardedTrainStep:
         #   2: + gradients (reduce-scatter instead of all-reduce; the
         #        grad-accumulation buffer under gradient_merge is sharded)
         #   3: + parameters (stored sharded; XLA all-gathers at use — FSDP)
-        zero_stage = 0
-        if self.strategy.sharding:
-            zero_stage = max(1, int(self.strategy.sharding_configs.stage))
+        # the compiled plan is the single derivation source for strategy-
+        # dependent step parameters (zero stage, grad-merge k)
+        from .strategy_compiler import compile_strategy
+
+        plan = compile_strategy(self.strategy, dict(self.mesh.shape),
+                                on_missing_axis="disable")
+        zero_stage = plan.zero_stage
         zero_axis = "sharding" if self.mesh.shape.get("sharding", 1) > 1 else "dp"
 
         def zero_spec_for(spec, v):
@@ -266,9 +292,8 @@ class ShardedTrainStep:
         batch_spec = normalize_spec(batch_spec, self.mesh)
         self.batch_sharding = NamedSharding(self.mesh, batch_spec)
 
-        k_steps = (self.strategy.gradient_merge_configs.k_steps
-                   if self.strategy.gradient_merge else 1)
-        remat = self.strategy.recompute
+        k_steps = plan.k_steps
+        remat = plan.has("recompute")
 
         # ZeRO-2: gradients live (and accumulate) reduce-scattered over the
         # zero axis; the optimizer update is shard-local and XLA all-gathers

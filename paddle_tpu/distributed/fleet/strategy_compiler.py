@@ -1,0 +1,169 @@
+"""Strategy compiler: validate + order the strategy toggles into one plan.
+
+Reference capability: ``MetaOptimizerFactory`` (meta_optimizer_factory.py:27)
+collects *Optimizer classes and ``StrategyCompiler`` (strategy_compiler.py:
+114) orders/validates the meta-optimizer stack — each meta-optimizer
+declares ``_can_apply`` and ``_disable_strategy`` and rewrites the Program
+in sequence.
+
+TPU-first: strategies don't rewrite programs — they parameterize ONE
+compiled train step — so the "stack" becomes a validated, ordered PLAN of
+composition rules.  Each rule declares requirements (mesh axes, model
+capabilities) and conflicts; :func:`compile_strategy` resolves them and
+the fleet facade routes to the right step builder (ShardedTrainStep,
+PipelineLayer.build_train_step, gpt_hybrid for the flagship path)."""
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+from .strategy import DistributedStrategy
+
+
+class Rule(NamedTuple):
+    name: str  # also the DistributedStrategy toggle attribute
+    # rules this one cannot compose with (reference _disable_strategy)
+    conflicts: tuple = ()
+    # mesh axis the rule needs (>1) — None = no axis requirement
+    needs_axis: str | None = None
+    # ordering priority (lower runs/wraps first — the reference orders
+    # graph rewrites; here it documents composition order)
+    priority: int = 50
+
+
+# the rule set mirrors the reference's meta-optimizer list
+_RULES = [
+    Rule("amp", priority=10),
+    Rule("recompute", priority=20),
+    Rule("pipeline", needs_axis="pp", priority=30),
+    Rule("tensor_parallel", needs_axis="mp", priority=31),
+    Rule("sequence_parallel", needs_axis="sp", priority=32),
+    Rule("sharding", priority=40),
+    Rule("gradient_merge", conflicts=("localsgd",), priority=45),
+    Rule("dgc", conflicts=("localsgd", "sharding"), priority=60),
+    Rule("localsgd", conflicts=("dgc", "gradient_merge"), priority=61),
+    Rule("lamb", conflicts=("lars",), priority=70),
+    Rule("lars", conflicts=("lamb",), priority=70),
+]
+
+
+class StrategyPlan(NamedTuple):
+    """Ordered applicable rules + resolved facts the builders consume —
+    the single derivation source for strategy-dependent step parameters."""
+    rules: tuple
+    mesh_shape: dict
+    zero_stage: int
+    n_micro: int
+    k_steps: int
+
+    def has(self, name: str) -> bool:
+        return name in self.rules
+
+
+def compile_strategy(strategy: DistributedStrategy,
+                     mesh_shape: dict | None = None,
+                     on_missing_axis: str = "raise") -> StrategyPlan:
+    """Validate toggle compatibility and produce the ordered plan
+    (reference StrategyCompiler.generate_optimizer role).
+
+    Conflicting toggles always raise.  A toggle whose required mesh axis
+    is missing/1 raises by default (failing loudly is the deliberate
+    difference from the reference) — ``on_missing_axis="disable"`` gives
+    the reference's ``_disable_strategy`` behavior instead, with a
+    warning; that is the right mode after an opted-in mesh degrade."""
+    from ...framework.errors import InvalidArgumentError
+
+    shape = dict(mesh_shape or strategy.mesh_shape())
+    active = [r for r in _RULES if getattr(strategy, r.name, False)]
+    names = {r.name for r in active}
+    for r in active:
+        for c in r.conflicts:
+            if c in names:
+                raise InvalidArgumentError(
+                    f"strategy toggles {r.name!r} and {c!r} cannot compose",
+                    hint="the reference's meta-optimizers disable each "
+                         "other here; turn one off")
+    kept = []
+    for r in active:
+        if r.needs_axis is not None and shape.get(r.needs_axis, 1) <= 1:
+            if on_missing_axis == "disable":
+                import warnings
+
+                warnings.warn(
+                    f"strategy {r.name!r} disabled: mesh axis "
+                    f"{r.needs_axis!r} is missing/1 (degraded mesh)",
+                    stacklevel=2)
+                continue
+            raise InvalidArgumentError(
+                f"strategy {r.name!r} needs mesh axis {r.needs_axis!r} > 1 "
+                f"(got {shape.get(r.needs_axis, 1)})",
+                hint=f"set hybrid_configs.{r.needs_axis}_degree")
+        kept.append(r)
+    ordered = tuple(r.name for r in sorted(kept, key=lambda r: r.priority))
+    zero_stage = (max(1, int(strategy.sharding_configs.stage))
+                  if "sharding" in ordered else 0)
+    n_micro = (strategy.pipeline_configs.accumulate_steps
+               if "pipeline" in ordered else 1)
+    k_steps = (strategy.gradient_merge_configs.k_steps
+               if "gradient_merge" in ordered else 1)
+    return StrategyPlan(ordered, shape, zero_stage, n_micro, k_steps)
+
+
+# toggles the Layer-model route cannot honor (they need the functional
+# pytree API — ShardedTrainStep via fleet.build_train_step)
+_LAYER_ROUTE_UNSUPPORTED = ("sharding", "gradient_merge", "tensor_parallel",
+                            "sequence_parallel", "dgc", "localsgd", "amp")
+
+
+def build_layer_train_step(model, loss_fn, optimizer,
+                           strategy: DistributedStrategy, mesh=None,
+                           example_input=None):
+    """Route a Layer model to the right compiled step per the plan (the
+    reference's fleet.distributed_model + minimize dispatch,
+    fleet_base.py:836 — TensorParallel/PipelineParallel/ShardingParallel
+    wrappers chosen from the strategy).
+
+    * pipeline on → the model must be a PipelineLayer; its pp schedule
+      composes dp from the mesh (plus recompute).
+    * otherwise → jit.TrainStep with strategy-driven recompute.  Toggles
+      this route cannot honor raise UnimplementedError instead of being
+      silently dropped — use the functional ``fleet.build_train_step``
+      (ShardedTrainStep) for sharding/gradient_merge/amp composition."""
+    from ..env import get_mesh
+    from ...framework.errors import InvalidArgumentError, UnimplementedError
+
+    mesh = mesh or get_mesh()
+    plan = compile_strategy(strategy, dict(mesh.shape))
+    if plan.has("pipeline"):
+        from ..pp_layers import PipelineLayer
+
+        if not isinstance(model, PipelineLayer):
+            raise InvalidArgumentError(
+                "strategy.pipeline needs a PipelineLayer model (wrap the "
+                "layer list in distributed.PipelineLayer)",
+                hint="reference PipelineOptimizer also requires "
+                     "device_guard-annotated programs")
+        if example_input is None:
+            raise InvalidArgumentError(
+                "pipeline routing needs example_input to trace boundary "
+                "shapes")
+        unsupported = [n for n in _LAYER_ROUTE_UNSUPPORTED if plan.has(n)]
+        if unsupported:
+            raise UnimplementedError(
+                f"strategy toggles {unsupported} do not compose with the "
+                f"PipelineLayer route yet",
+                hint="use the functional fleet.build_train_step or the "
+                     "flagship gpt_hybrid path")
+        return model.build_train_step(
+            mesh, optimizer, loss_fn, n_micro=max(1, plan.n_micro),
+            example_input=example_input, remat=plan.has("recompute"))
+    unsupported = [n for n in _LAYER_ROUTE_UNSUPPORTED if plan.has(n)]
+    if unsupported:
+        raise UnimplementedError(
+            f"strategy toggles {unsupported} need the functional pytree "
+            f"API; the Layer route supports recompute/pipeline only",
+            hint="call fleet.build_train_step(loss_fn, params, optimizer) "
+                 "— ShardedTrainStep composes dp/amp/zero/gradient_merge")
+    from ...jit import TrainStep
+
+    return TrainStep(model, loss_fn, optimizer, mesh=mesh,
+                     remat=plan.has("recompute"))
