@@ -65,9 +65,14 @@ def ps_table() -> ctypes.CDLL:
         lib.pst_rows.argtypes = [ptr]
         lib.pst_dim.restype = u64
         lib.pst_dim.argtypes = [ptr]
+        lib.pst_create_ssd.restype = ptr
+        lib.pst_create_ssd.argtypes = [u64, u64, u64, c.c_float, cstr]
+        lib.pst_sync.restype = c.c_int
+        lib.pst_sync.argtypes = [ptr]
         lib.pst_pull.argtypes = [ptr, i64p, u64, f32p]
         lib.pst_push_adagrad.argtypes = [ptr, i64p, f32p, u64, c.c_float,
                                          c.c_float]
+        lib.pst_push_delta.argtypes = [ptr, i64p, f32p, u64]
         lib.pst_save.restype = c.c_int
         lib.pst_save.argtypes = [ptr, cstr]
         lib.pst_load.restype = c.c_int

@@ -10,24 +10,66 @@
 // All ids here are LOCAL row indices (the client maps global id ->
 // (server = id % S, local = id / S)).
 
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
 #include <cmath>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
 #include <mutex>
 #include <random>
+#include <string>
 #include <unordered_map>
 #include <vector>
 
 namespace {
 
+// mmap file layout: 64-byte header, then rows*dim data floats, then rows
+// accum floats.  `ready` is written LAST on a fresh init, so a crash mid-
+// initialization leaves an invalid header, not silent garbage.
+struct SsdHeader {
+  uint64_t magic;
+  uint64_t rows;
+  uint64_t dim;
+  uint64_t ready;
+  uint64_t pad[4];
+};
+constexpr uint64_t kSsdMagic = 0x4c42545000ULL;  // "PTBL"
+static_assert(sizeof(SsdHeader) == 64, "header must stay 64 bytes");
+
 struct Table {
   uint64_t rows;
   uint64_t dim;
-  std::vector<float> data;   // [rows * dim]
-  std::vector<float> accum;  // [rows]
+  std::vector<float> mem_data;   // in-memory mode: [rows * dim]
+  std::vector<float> mem_accum;  // [rows]
+  // disk mode (SSDSparseTable role): rows+accum live in one mmap'd file —
+  // the OS page cache keeps the hot working set resident while the table
+  // exceeds RAM (vocab >> memory recommender embeddings)
+  void* map = nullptr;   // mmap base (SsdHeader + payload)
+  int fd = -1;
+  uint64_t map_bytes = 0;
   std::mutex mu;
+
+  float* payload() {
+    return reinterpret_cast<float*>(static_cast<char*>(map)
+                                    + sizeof(SsdHeader));
+  }
+  float* data() { return map ? payload() : mem_data.data(); }
+  float* accum() {
+    return map ? payload() + rows * dim : mem_accum.data();
+  }
 };
+
+void fill_random(Table* t, uint64_t seed, float init_range) {
+  std::mt19937_64 rng(seed);
+  std::uniform_real_distribution<float> dist(-init_range, init_range);
+  float* d = t->data();
+  for (uint64_t i = 0; i < t->rows * t->dim; ++i) d[i] = dist(rng);
+  std::memset(t->accum(), 0, t->rows * sizeof(float));
+}
 
 }  // namespace
 
@@ -38,15 +80,81 @@ void* pst_create(uint64_t rows, uint64_t dim, uint64_t seed,
   auto* t = new Table();
   t->rows = rows;
   t->dim = dim;
-  t->data.resize(rows * dim);
-  t->accum.assign(rows, 0.0f);
-  std::mt19937_64 rng(seed);
-  std::uniform_real_distribution<float> dist(-init_range, init_range);
-  for (auto& v : t->data) v = dist(rng);
+  t->mem_data.resize(rows * dim);
+  t->mem_accum.assign(rows, 0.0f);
+  fill_random(t, seed, init_range);
   return t;
 }
 
-void pst_destroy(void* h) { delete static_cast<Table*>(h); }
+// SSD-backed shard: the whole table lives in ONE mmap'd file at `path`
+// (created and random-initialized when absent; reopened — with header
+// validation — when present).  Returns nullptr on any failure, including
+// a shape mismatch or a half-initialized file from a crashed process
+// (never silently reinterprets or truncates trained rows).
+void* pst_create_ssd(uint64_t rows, uint64_t dim, uint64_t seed,
+                     float init_range, const char* path) {
+  auto* t = new Table();
+  t->rows = rows;
+  t->dim = dim;
+  t->map_bytes = sizeof(SsdHeader) + (rows * dim + rows) * sizeof(float);
+  bool fresh = (access(path, F_OK) != 0);
+  t->fd = ::open(path, O_RDWR | O_CREAT, 0644);
+  if (t->fd < 0) {
+    delete t;
+    return nullptr;
+  }
+  if (!fresh) {
+    struct stat st{};
+    SsdHeader hdr{};
+    if (fstat(t->fd, &st) != 0 || (uint64_t)st.st_size != t->map_bytes ||
+        pread(t->fd, &hdr, sizeof(hdr), 0) != (ssize_t)sizeof(hdr) ||
+        hdr.magic != kSsdMagic || hdr.rows != rows || hdr.dim != dim ||
+        hdr.ready != 1) {
+      ::close(t->fd);
+      delete t;
+      return nullptr;
+    }
+  } else if (ftruncate(t->fd, (off_t)t->map_bytes) != 0) {
+    ::close(t->fd);
+    delete t;
+    return nullptr;
+  }
+  void* m = mmap(nullptr, t->map_bytes, PROT_READ | PROT_WRITE, MAP_SHARED,
+                 t->fd, 0);
+  if (m == MAP_FAILED) {
+    ::close(t->fd);
+    delete t;
+    return nullptr;
+  }
+  t->map = m;
+  if (fresh) {
+    fill_random(t, seed, init_range);
+    auto* hdr = static_cast<SsdHeader*>(t->map);
+    hdr->magic = kSsdMagic;
+    hdr->rows = rows;
+    hdr->dim = dim;
+    hdr->ready = 1;  // written after init: crash leaves an invalid header
+    msync(t->map, t->map_bytes, MS_SYNC);
+  }
+  return t;
+}
+
+// flush disk-backed rows to stable storage (msync)
+int pst_sync(void* h) {
+  auto* t = static_cast<Table*>(h);
+  if (!t->map) return 0;
+  std::lock_guard<std::mutex> lk(t->mu);
+  return msync(t->map, t->map_bytes, MS_SYNC);
+}
+
+void pst_destroy(void* h) {
+  auto* t = static_cast<Table*>(h);
+  if (t->map) {
+    munmap(t->map, t->map_bytes);
+    ::close(t->fd);
+  }
+  delete t;
+}
 
 uint64_t pst_rows(void* h) { return static_cast<Table*>(h)->rows; }
 uint64_t pst_dim(void* h) { return static_cast<Table*>(h)->dim; }
@@ -62,7 +170,7 @@ void pst_pull(void* h, const int64_t* ids, uint64_t n, float* out) {
       std::memset(out + i * D, 0, D * sizeof(float));
       continue;
     }
-    std::memcpy(out + i * D, t->data.data() + (uint64_t)r * D,
+    std::memcpy(out + i * D, t->data() + (uint64_t)r * D,
                 D * sizeof(float));
   }
 }
@@ -96,15 +204,35 @@ void pst_push_adagrad(void* h, const int64_t* ids, const float* grads,
     }
   }
   std::lock_guard<std::mutex> lk(t->mu);
+  float* acc = t->accum();
+  float* base = t->data();
   for (uint64_t u = 0; u < uids.size(); ++u) {
     const uint64_t r = (uint64_t)uids[u];
     const float* g = merged.data() + u * D;
     float sq = 0.0f;
     for (uint64_t d = 0; d < D; ++d) sq += g[d] * g[d];
-    t->accum[r] += sq / (float)D;
-    const float scale = lr / (std::sqrt(t->accum[r]) + eps);
-    float* row = t->data.data() + r * D;
+    acc[r] += sq / (float)D;
+    const float scale = lr / (std::sqrt(acc[r]) + eps);
+    float* row = base + r * D;
     for (uint64_t d = 0; d < D; ++d) row[d] -= scale * g[d];
+  }
+}
+
+// Geo-async delta apply (reference SparseGeoTable role): rows[ids[i]] +=
+// deltas[i].  Trainers train on a local cache and periodically send the
+// accumulated difference; the server just adds it.
+void pst_push_delta(void* h, const int64_t* ids, const float* deltas,
+                    uint64_t n) {
+  auto* t = static_cast<Table*>(h);
+  const uint64_t D = t->dim;
+  std::lock_guard<std::mutex> lk(t->mu);
+  float* base = t->data();
+  for (uint64_t i = 0; i < n; ++i) {
+    const int64_t r = ids[i];
+    if (r < 0 || (uint64_t)r >= t->rows) continue;
+    float* row = base + (uint64_t)r * D;
+    const float* d = deltas + i * D;
+    for (uint64_t k = 0; k < D; ++k) row[k] += d[k];
   }
 }
 
@@ -116,8 +244,8 @@ int pst_save(void* h, const char* path) {
   if (!f) return -1;
   uint64_t hdr[2] = {t->rows, t->dim};
   std::fwrite(hdr, sizeof(uint64_t), 2, f);
-  std::fwrite(t->data.data(), sizeof(float), t->data.size(), f);
-  std::fwrite(t->accum.data(), sizeof(float), t->accum.size(), f);
+  std::fwrite(t->data(), sizeof(float), t->rows * t->dim, f);
+  std::fwrite(t->accum(), sizeof(float), t->rows, f);
   std::fclose(f);
   return 0;
 }
@@ -133,10 +261,10 @@ int pst_load(void* h, const char* path) {
     std::fclose(f);
     return -2;
   }
-  size_t r1 = std::fread(t->data.data(), sizeof(float), t->data.size(), f);
-  size_t r2 = std::fread(t->accum.data(), sizeof(float), t->accum.size(), f);
+  size_t r1 = std::fread(t->data(), sizeof(float), t->rows * t->dim, f);
+  size_t r2 = std::fread(t->accum(), sizeof(float), t->rows, f);
   std::fclose(f);
-  return (r1 == t->data.size() && r2 == t->accum.size()) ? 0 : -3;
+  return (r1 == t->rows * t->dim && r2 == t->rows) ? 0 : -3;
 }
 
 }  // extern "C"

@@ -150,12 +150,13 @@ class Fleet:
         _disable_strategy behavior)."""
         from .strategy_compiler import compile_strategy
 
-        compile_strategy(
+        plan = compile_strategy(
             self._strategy or DistributedStrategy(), dict(get_mesh().shape),
             on_missing_axis="disable" if self._degraded else "raise")
         return ShardedTrainStep(
             loss_fn, params, optimizer, mesh=get_mesh(), param_specs=param_specs,
             batch_spec=batch_spec, strategy=self._strategy, donate=donate,
+            plan=plan,
         )
 
     def build_layer_train_step(self, model, loss_fn, optimizer,
@@ -167,7 +168,8 @@ class Fleet:
         return build_layer_train_step(
             model, loss_fn, optimizer,
             self._strategy or DistributedStrategy(),
-            mesh=get_mesh(), example_input=example_input)
+            mesh=get_mesh(), example_input=example_input,
+            on_missing_axis="disable" if self._degraded else "raise")
 
     def minimize(self, optimizer, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
@@ -226,11 +228,13 @@ class ShardedTrainStep:
     """
 
     def __init__(self, loss_fn, params, optimizer, mesh=None, param_specs=None,
-                 batch_spec=None, strategy=None, donate=True, extra_batch_specs=None):
+                 batch_spec=None, strategy=None, donate=True,
+                 extra_batch_specs=None, plan=None):
         self.mesh = mesh or get_mesh()
         set_mesh(self.mesh)
         self.optimizer = optimizer
         self.strategy = strategy or DistributedStrategy()
+        self._plan = plan  # pre-compiled StrategyPlan (avoids recompiling)
         self._step = 0
 
         if param_specs is None:
@@ -249,10 +253,13 @@ class ShardedTrainStep:
         #   3: + parameters (stored sharded; XLA all-gathers at use — FSDP)
         # the compiled plan is the single derivation source for strategy-
         # dependent step parameters (zero stage, grad-merge k)
-        from .strategy_compiler import compile_strategy
+        if self._plan is None:
+            from .strategy_compiler import compile_strategy
 
-        plan = compile_strategy(self.strategy, dict(self.mesh.shape),
-                                on_missing_axis="disable")
+            self._plan = compile_strategy(self.strategy,
+                                          dict(self.mesh.shape),
+                                          on_missing_axis="disable")
+        plan = self._plan
         zero_stage = plan.zero_stage
         zero_axis = "sharding" if self.mesh.shape.get("sharding", 1) > 1 else "dp"
 

@@ -116,7 +116,8 @@ _LAYER_ROUTE_UNSUPPORTED = ("sharding", "gradient_merge", "tensor_parallel",
 
 def build_layer_train_step(model, loss_fn, optimizer,
                            strategy: DistributedStrategy, mesh=None,
-                           example_input=None):
+                           example_input=None,
+                           on_missing_axis: str = "raise"):
     """Route a Layer model to the right compiled step per the plan (the
     reference's fleet.distributed_model + minimize dispatch,
     fleet_base.py:836 — TensorParallel/PipelineParallel/ShardingParallel
@@ -132,7 +133,8 @@ def build_layer_train_step(model, loss_fn, optimizer,
     from ...framework.errors import InvalidArgumentError, UnimplementedError
 
     mesh = mesh or get_mesh()
-    plan = compile_strategy(strategy, dict(mesh.shape))
+    plan = compile_strategy(strategy, dict(mesh.shape),
+                            on_missing_axis=on_missing_axis)
     if plan.has("pipeline"):
         from ..pp_layers import PipelineLayer
 

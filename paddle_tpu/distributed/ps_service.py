@@ -40,7 +40,7 @@ import numpy as np
 
 OPS = {"create": 1, "pull": 2, "push": 3, "pull_dense": 4, "push_dense": 5,
        "save": 6, "load": 7, "stat": 8, "barrier_add": 9, "shutdown": 10,
-       "barrier_get": 11, "err": 12}
+       "barrier_get": 11, "err": 12, "push_delta": 13}
 _OP_NAMES = {v: k for k, v in OPS.items()}
 
 
@@ -82,12 +82,14 @@ class PSServer:
     over threaded TCP."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 server_idx: int = 0, num_servers: int = 1):
+                 server_idx: int = 0, num_servers: int = 1,
+                 ssd_dir: str | None = None):
         from .._native import ps_table
 
         self._lib = ps_table()
         self.server_idx = server_idx
         self.num_servers = num_servers
+        self._ssd_dir = ssd_dir  # enables storage="ssd" tables
         self._tables: dict[int, dict] = {}
         self._tables_lock = threading.Lock()
         self._dense: dict[str, np.ndarray] = {}
@@ -137,17 +139,37 @@ class PSServer:
         try:
             if op == "create":
                 tid = meta["tid"]
+                storage = meta.get("storage", "mem")
                 with self._tables_lock:  # concurrent creates must not
                     # race the check-then-insert (handle leak + lost pushes)
                     if tid not in self._tables:
                         rows = self._local_rows(meta["vocab"])
-                        h = lib.pst_create(
-                            rows, meta["dim"],
-                            meta.get("seed", 0) * 1000 + self.server_idx,
-                            meta.get("init_range", 0.05))
+                        seed = meta.get("seed", 0) * 1000 + self.server_idx
+                        rng = meta.get("init_range", 0.05)
+                        if storage == "ssd":
+                            # mmap-file-backed shard (SSDSparseTable role)
+                            if self._ssd_dir is None:
+                                return _pack("create", {
+                                    "ok": False,
+                                    "err": "server started without "
+                                           "ssd_dir"}, {})
+                            os.makedirs(self._ssd_dir, exist_ok=True)
+                            path = os.path.join(
+                                self._ssd_dir,
+                                f"table_{tid}.shard{self.server_idx}.mmap")
+                            h = lib.pst_create_ssd(rows, meta["dim"], seed,
+                                                   rng, path.encode())
+                            if not h:
+                                return _pack("create", {
+                                    "ok": False,
+                                    "err": f"mmap create failed: {path}"},
+                                    {})
+                        else:
+                            h = lib.pst_create(rows, meta["dim"], seed, rng)
                         self._tables[tid] = {"h": h, "rows": rows,
                                              "dim": meta["dim"],
-                                             "vocab": meta["vocab"]}
+                                             "vocab": meta["vocab"],
+                                             "storage": storage}
                 return _pack("create", {"ok": True}, {})
             if op == "pull":
                 t = self._tables[meta["tid"]]
@@ -168,6 +190,16 @@ class PSServer:
                     g.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
                     len(ids), meta.get("lr", 0.05), meta.get("eps", 1e-8))
                 return _pack("push", {"ok": True}, {})
+            if op == "push_delta":
+                t = self._tables[meta["tid"]]
+                ids = np.ascontiguousarray(arrays["ids"], np.int64)
+                d = np.ascontiguousarray(arrays["deltas"], np.float32)
+                lib.pst_push_delta(
+                    t["h"],
+                    ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                    d.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                    len(ids))
+                return _pack("push_delta", {"ok": True}, {})
             if op == "pull_dense":
                 with self._dense_lock:
                     arr = self._dense.get(meta["key"])
@@ -195,6 +227,8 @@ class PSServer:
                 with self._tables_lock:  # snapshot: creates may race
                     tables = list(self._tables.items())
                 for tid, t in tables:
+                    if t.get("storage") == "ssd":
+                        lib.pst_sync(t["h"])  # msync the mmap first
                     lib.pst_save(t["h"], os.path.join(
                         meta["dir"],
                         f"table_{tid}.shard{self.server_idx}").encode())
@@ -247,10 +281,11 @@ class PSServer:
 
 
 def run_server(port: int, server_idx: int, num_servers: int,
-               ready_path: str | None = None):
+               ready_path: str | None = None, ssd_dir: str | None = None):
     """Blocking server entry point for a spawned process (the reference's
     server-side main, TheOnePSRuntime._init_server role)."""
-    srv = PSServer(port=port, server_idx=server_idx, num_servers=num_servers)
+    srv = PSServer(port=port, server_idx=server_idx, num_servers=num_servers,
+                   ssd_dir=ssd_dir)
     if ready_path:
         with open(ready_path, "w") as f:
             f.write(srv.endpoint)
@@ -305,10 +340,26 @@ class PSClient:
 
     # -- table API ----------------------------------------------------------
     def create_table(self, tid: int, vocab: int, dim: int, seed: int = 0,
-                     init_range: float = 0.05):
+                     init_range: float = 0.05, storage: str = "mem"):
+        """storage="ssd" puts the shard in an mmap'd file on the server
+        (SSDSparseTable role; the server needs ssd_dir)."""
         meta = {"tid": tid, "vocab": vocab, "dim": dim, "seed": seed,
-                "init_range": init_range}
+                "init_range": init_range, "storage": storage}
         self._fan("create", [meta] * self.S, [{}] * self.S)
+
+    def push_sparse_delta(self, tid: int, ids: np.ndarray,
+                          deltas: np.ndarray):
+        """rows[ids] += deltas (the geo-async merge op)."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        deltas = np.asarray(deltas, np.float32).reshape(len(ids), -1)
+        srv = ids % self.S
+        local = ids // self.S
+        metas, arrs = [], []
+        for s in range(self.S):
+            m = srv == s
+            metas.append({"tid": tid})
+            arrs.append({"ids": local[m], "deltas": deltas[m]})
+        self._fan("push_delta", metas, arrs)
 
     def pull_sparse(self, tid: int, ids: np.ndarray) -> np.ndarray:
         ids = np.asarray(ids, np.int64).reshape(-1)
@@ -485,6 +536,62 @@ class AsyncCommunicator:
         self._t.join(timeout=5)
 
 
+class GeoCommunicator:
+    """Geo-async sparse training (reference SparseGeoTable +
+    GeoCommunicator, service/communicator.cc geo mode): the trainer
+    trains against a LOCAL row cache (zero-latency pull/push) and every
+    ``k_steps`` pushes only the accumulated per-row DELTA to the server
+    and refreshes its cache with the globally merged rows — bounded
+    staleness instead of per-step round trips."""
+
+    def __init__(self, client: PSClient, tid: int, k_steps: int = 10):
+        self.client = client
+        self.tid = tid
+        self.k_steps = k_steps
+        self._cache: dict[int, np.ndarray] = {}  # id -> local row
+        self._base: dict[int, np.ndarray] = {}   # id -> row at last sync
+        self._dirty: set[int] = set()
+        self._step = 0
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        missing = [int(i) for i in ids if int(i) not in self._cache]
+        if missing:
+            rows = self.client.pull_sparse(self.tid, np.asarray(missing))
+            for i, r in zip(missing, rows):
+                self._cache[i] = r.copy()
+                self._base[i] = r.copy()
+        return np.stack([self._cache[int(i)] for i in ids])
+
+    def push(self, ids: np.ndarray, grads: np.ndarray, lr: float = 0.05):
+        """Local SGD on the cache; server sync every k_steps.  Ids never
+        pulled are fetched lazily first (push-before-pull is legal)."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), -1)
+        self.pull(ids)  # ensure every id is cached (no-op when warm)
+        for i, g in zip(ids, grads):
+            i = int(i)
+            self._cache[i] = self._cache[i] - lr * g
+            self._dirty.add(i)
+        self._step += 1
+        if self._step % self.k_steps == 0:
+            self.sync()
+
+    def sync(self):
+        """Push accumulated deltas, refresh the cache with merged rows."""
+        if not self._dirty:
+            return
+        ids = np.asarray(sorted(self._dirty), np.int64)
+        deltas = np.stack([self._cache[int(i)] - self._base[int(i)]
+                           for i in ids])
+        self.client.push_sparse_delta(self.tid, ids, deltas)
+        merged = self.client.pull_sparse(self.tid, ids)
+        for i, r in zip(ids, merged):
+            self._cache[int(i)] = r.copy()
+            self._base[int(i)] = r.copy()
+        self._dirty.clear()
+
+
 def main(argv=None):
     """Server-process CLI: python -m paddle_tpu.distributed.ps_service
     --port P --server_idx I --num_servers N [--ready_path F]"""
@@ -495,8 +602,10 @@ def main(argv=None):
     p.add_argument("--server_idx", type=int, required=True)
     p.add_argument("--num_servers", type=int, required=True)
     p.add_argument("--ready_path", default=None)
+    p.add_argument("--ssd_dir", default=None,
+                   help="enable storage='ssd' tables (mmap files here)")
     a = p.parse_args(argv)
-    run_server(a.port, a.server_idx, a.num_servers, a.ready_path)
+    run_server(a.port, a.server_idx, a.num_servers, a.ready_path, a.ssd_dir)
 
 
 if __name__ == "__main__":

@@ -12,7 +12,7 @@ import pytest
 from paddle_tpu._native import NativeUnavailable
 
 
-def _start_servers(n, tmp_path):
+def _start_servers(n, tmp_path, ssd_dir=None):
     """Spawn n PSServer processes; returns (procs, endpoints)."""
     try:
         from paddle_tpu.distributed.ps_service import PSServer  # noqa: F401
@@ -28,7 +28,8 @@ def _start_servers(n, tmp_path):
 
     for i in range(n):
         ready = str(tmp_path / f"ep{i}.txt")
-        p = ctx.Process(target=run_server, args=(0, i, n, ready), daemon=True)
+        p = ctx.Process(target=run_server, args=(0, i, n, ready, ssd_dir),
+                        daemon=True)
         p.start()
         procs.append(p)
         deadline = time.time() + 60
@@ -130,6 +131,91 @@ class TestPSService:
         assert cluster.barrier("b0", world=1, timeout=10)
         st = cluster.stat()
         assert len(st) == 2 and st[0]["server_idx"] == 0
+
+
+class TestSSDAndGeo:
+    def test_ssd_table_persists_across_restart(self, tmp_path):
+        """mmap-file-backed shard (SSDSparseTable role): rows survive a
+        full server-process restart without an explicit save."""
+        from paddle_tpu.distributed.ps_service import PSClient
+
+        ssd = str(tmp_path / "ssd")
+        procs, eps = _start_servers(2, tmp_path, ssd_dir=ssd)
+        c = PSClient(eps)
+        V, D = 24, 4
+        c.create_table(0, V, D, seed=9, storage="ssd")
+        target = np.random.default_rng(5).standard_normal(
+            (V, D)).astype(np.float32)
+        for _ in range(100):
+            ids = np.arange(V)
+            rows = c.pull_sparse(0, ids)
+            c.push_sparse(0, ids, rows - target, lr=0.5)
+        trained = c.pull_sparse(0, np.arange(V))
+        c.save(str(tmp_path / "unused"))  # forces msync of the mmap
+        c.shutdown_servers()
+        c.close()
+        for p in procs:
+            p.join(timeout=10)
+
+        # fresh server processes re-open the same mmap files
+        (tmp_path / "ep0.txt").unlink()
+        (tmp_path / "ep1.txt").unlink()
+        procs2, eps2 = _start_servers(2, tmp_path, ssd_dir=ssd)
+        c2 = PSClient(eps2)
+        c2.create_table(0, V, D, seed=123, storage="ssd")  # reopen, not init
+        rows = c2.pull_sparse(0, np.arange(V))
+        np.testing.assert_allclose(rows, trained, rtol=1e-6)
+        c2.shutdown_servers()
+        c2.close()
+        for p in procs2:
+            p.join(timeout=10)
+
+    def test_ssd_reopen_shape_mismatch_rejected(self, tmp_path):
+        """Reopening an mmap shard with a different shape must fail loudly
+        (silent reinterpretation would corrupt trained rows)."""
+        from paddle_tpu.distributed.ps_service import PSClient
+
+        ssd = str(tmp_path / "ssd")
+        procs, eps = _start_servers(1, tmp_path, ssd_dir=ssd)
+        c = PSClient(eps)
+        c.create_table(0, 16, 4, storage="ssd")
+        c.shutdown_servers()
+        c.close()
+        for p in procs:
+            p.join(timeout=10)
+        (tmp_path / "ep0.txt").unlink()
+        procs2, eps2 = _start_servers(1, tmp_path, ssd_dir=ssd)
+        c2 = PSClient(eps2)
+        with pytest.raises(RuntimeError, match="mmap"):
+            c2.create_table(0, 16, 8, storage="ssd")  # dim changed
+        c2.shutdown_servers()
+        c2.close()
+        for p in procs2:
+            p.join(timeout=10)
+
+    def test_geo_async_two_workers_converge(self, cluster):
+        """Geo mode: both workers train on local caches, sync deltas every
+        k steps, and the server's merged rows converge (reference
+        SparseGeoTable semantics: additive delta merge)."""
+        from paddle_tpu.distributed.ps_service import GeoCommunicator
+
+        V, D = 20, 4
+        cluster.create_table(7, V, D, seed=11)
+        rng = np.random.default_rng(2)
+        target = rng.standard_normal((V, D)).astype(np.float32)
+        w1 = GeoCommunicator(cluster, tid=7, k_steps=5)
+        w2 = GeoCommunicator(cluster, tid=7, k_steps=5)
+        for step in range(400):
+            for w in (w1, w2):
+                ids = rng.integers(0, V, 16)
+                rows = w.pull(ids)
+                # halved lr: two workers' deltas add on the server
+                w.push(ids, rows - target[ids], lr=0.25)
+        w1.sync()
+        w2.sync()
+        rows = cluster.pull_sparse(7, np.arange(V))
+        mse = float(((rows - target) ** 2).mean())
+        assert mse < 0.05, mse
 
 
 class TestPSLaunchMode:
