@@ -1,2 +1,2 @@
-from . import errors, monitor, random
+from . import crypto, errors, monitor, random
 from .random import get_rng_state_tracker, seed

@@ -1,0 +1,88 @@
+"""Encrypted model save/load (reference framework/io/crypto/ — AES-CBC via
+cryptopp, pybind/crypto.cc, used to ship encrypted inference models).
+
+TPU-native build vendors no crypto library, so the cipher is a documented
+stdlib construction: SHA256-CTR keystream XOR (encrypt) with
+HMAC-SHA256 encrypt-then-MAC integrity, random 16-byte nonce per file.
+This provides the same *capability* (models unreadable without the key,
+tamper detection); swap `_keystream` for AES when a vetted library is
+available in the deployment image.
+
+File layout: magic(8) | nonce(16) | ciphertext | hmac(32).
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+import pickle
+import struct
+
+_MAGIC = b"PTENC\x00\x01\x00"
+
+
+def _keystream(key: bytes, nonce: bytes, nbytes: int) -> bytes:
+    n_blocks = (nbytes + 31) // 32
+    prefix = key + nonce
+    return b"".join(
+        hashlib.sha256(prefix + struct.pack("<Q", c)).digest()
+        for c in range(n_blocks))[:nbytes]
+
+
+def _xor(data: bytes, ks: bytes) -> bytes:
+    import numpy as np
+
+    # vectorized: a 500MB model must not take minutes of per-byte Python
+    a = np.frombuffer(data, np.uint8)
+    b = np.frombuffer(ks, np.uint8)
+    return np.bitwise_xor(a, b).tobytes()
+
+
+def _norm_key(key: bytes | str) -> bytes:
+    if isinstance(key, str):
+        key = key.encode()
+    return hashlib.sha256(b"paddle_tpu-enc" + key).digest()
+
+
+def encrypt_bytes(data: bytes, key: bytes | str) -> bytes:
+    k = _norm_key(key)
+    nonce = os.urandom(16)
+    ct = _xor(data, _keystream(k, nonce, len(data)))
+    mac = hmac.new(k, _MAGIC + nonce + ct, hashlib.sha256).digest()
+    return _MAGIC + nonce + ct + mac
+
+
+def decrypt_bytes(blob: bytes, key: bytes | str) -> bytes:
+    k = _norm_key(key)
+    if len(blob) < len(_MAGIC) + 16 + 32 or not blob.startswith(_MAGIC):
+        raise ValueError("not a paddle_tpu encrypted blob")
+    nonce = blob[len(_MAGIC):len(_MAGIC) + 16]
+    ct = blob[len(_MAGIC) + 16:-32]
+    mac = blob[-32:]
+    want = hmac.new(k, _MAGIC + nonce + ct, hashlib.sha256).digest()
+    if not hmac.compare_digest(mac, want):
+        raise ValueError("wrong key or tampered file (HMAC mismatch)")
+    return _xor(ct, _keystream(k, nonce, len(ct)))
+
+
+def save_encrypted(obj, path: str, key: bytes | str, protocol: int = 4):
+    """paddle.save + encryption (reference paddle.save with cipher).
+    Plaintext never touches disk: pickling happens in memory."""
+    from .io import _to_numpy_tree
+
+    blob = encrypt_bytes(
+        pickle.dumps(_to_numpy_tree(obj), protocol=protocol), key)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(blob)
+    return path
+
+
+def load_encrypted(path: str, key: bytes | str):
+    """Decrypt + paddle.load (reference encrypted-model load path);
+    decryption and unpickling stay in memory."""
+    with open(path, "rb") as f:
+        data = decrypt_bytes(f.read(), key)
+    return pickle.loads(data)

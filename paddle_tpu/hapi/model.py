@@ -36,6 +36,24 @@ def _to_batches(data, batch_size, shuffle=False, seed=0):
         yield xs[sel], ys[sel]
 
 
+def _metric_update(m, out, label):
+    """Reference hapi semantics: compute may return a tuple of update
+    args (base Metric.compute passes (pred, label) through) or a single
+    array (Accuracy's correct-mask)."""
+    r = m.compute(out, label)
+    if isinstance(r, tuple):
+        m.update(*r)
+    else:
+        m.update(r)
+
+
+def _metric_logs(m, prefix: str = "") -> dict:
+    names = m.name() if isinstance(m.name(), (list, tuple)) else [m.name()]
+    vals = m.accumulate()
+    vals = vals if isinstance(vals, (list, tuple)) else [vals]
+    return {prefix + n: float(v) for n, v in zip(names, vals)}
+
+
 class Model:
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
@@ -51,7 +69,10 @@ class Model:
         self._metrics = metrics if isinstance(metrics, (list, tuple)) else (
             [metrics] if metrics else [])
         if optimizer is not None and loss is not None:
-            self._train_step = TrainStep(self.network, loss, optimizer)
+            # metrics stream from the SAME jitted forward's outputs
+            # (reference fit computes train metrics per batch)
+            self._train_step = TrainStep(self.network, loss, optimizer,
+                                         return_outputs=bool(self._metrics))
         return self
 
     # -- train ---------------------------------------------------------------
@@ -71,15 +92,28 @@ class Model:
         for epoch in range(epochs):
             for c in cbs:
                 c.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
             losses = []
             for step, batch in enumerate(
                     _to_batches(train_data, batch_size, shuffle, seed=epoch)):
                 loss = self._train_step(*batch)
                 losses.append(float(loss.numpy()))
                 logs = {"loss": losses[-1]}
+                out = self._train_step.last_outputs
+                if out is not None:
+                    y = batch[-1]
+                    yt = y if isinstance(y, Tensor) else Tensor(
+                        np.asarray(y), stop_gradient=True)
+                    for m in self._metrics:
+                        _metric_update(m, out, yt)
+                        logs.update(_metric_logs(m))
                 for c in cbs:
                     c.on_train_batch_end(step, logs)
             epoch_logs = {"loss": float(np.mean(losses)) if losses else 0.0}
+            if self._train_step.last_outputs is not None:
+                for m in self._metrics:
+                    epoch_logs.update(_metric_logs(m, prefix="train_"))
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
                 epoch_logs.update(self.evaluate(eval_data, batch_size,
                                                 verbose=0))
@@ -110,17 +144,14 @@ class Model:
                     losses.append(float(
                         self._loss(out, Tensor(np.asarray(y), True)).numpy()))
                 for m in self._metrics:
-                    m.update(m.compute(out, Tensor(np.asarray(y), True)))
+                    _metric_update(m, out, Tensor(np.asarray(y), True))
         finally:
             self.network.train()
         logs = {}
         if losses:
             logs["eval_loss"] = float(np.mean(losses))
         for m in self._metrics:
-            names = m.name() if isinstance(m.name(), (list, tuple)) else [m.name()]
-            vals = m.accumulate()
-            vals = vals if isinstance(vals, (list, tuple)) else [vals]
-            logs.update(dict(zip(names, map(float, vals))))
+            logs.update(_metric_logs(m))
         return logs
 
     def predict(self, test_data, batch_size=32):

@@ -189,12 +189,15 @@ class TrainStep:
     """
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer, mesh=None,
-                 shardings=None, donate=True, remat=False):
+                 shardings=None, donate=True, remat=False,
+                 return_outputs=False):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.mesh = mesh
         self._step = 0
+        self._return_outputs = return_outputs
+        self.last_outputs = None  # model outputs when return_outputs=True
         params, buffers = _split_state(model)
         self._params = params
         self._buffers = buffers
@@ -205,14 +208,18 @@ class TrainStep:
                 with _random.rng_scope(key):
                     out, new_buf = functional_call(model, params, buffers, *batch[:-1])
                     loss = self.loss_fn(_wrap(out), Tensor(batch[-1], stop_gradient=True))
-                return _unwrap(loss), new_buf
+                # outputs ride the aux so train-time metrics reuse the SAME
+                # forward (reference hapi streams metrics from fit outputs)
+                aux_out = out if return_outputs else ()
+                return _unwrap(loss), (new_buf, aux_out)
 
             if remat:
                 loss_of = jax.checkpoint(loss_of)
-            (loss, new_buf), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
+            (loss, (new_buf, out)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params)
             new_params, new_opt = optimizer.apply_gradients(grads, params, opt_state,
                                                             lr=lr, step=step + 1)
-            return new_params, new_buf, new_opt, loss
+            return new_params, new_buf, new_opt, loss, out
 
         donate_args = (0, 2) if donate else ()
         self._compiled = jax.jit(step_fn, donate_argnums=donate_args)
@@ -229,9 +236,11 @@ class TrainStep:
         key = _random.next_key()
         lr = self._current_lr()
         # pass the 0-based step; step_fn's +1 makes Adam's first update t=1
-        self._params, self._buffers, self._opt_state, loss = self._compiled(
+        (self._params, self._buffers, self._opt_state, loss,
+         out) = self._compiled(
             self._params, self._buffers, self._opt_state, key, lr, self._step, *arr
         )
+        self.last_outputs = _wrap(out) if self._return_outputs else None
         self._step += 1
         # keep the Layer's Parameters pointing at live buffers (the originals
         # were donated into the jit) so eager eval/checkpointing keeps working

@@ -107,11 +107,35 @@ class TestHapiModel:
         hist = m.fit((x, y), eval_data=(x, y), batch_size=32, epochs=10,
                      verbose=0, save_dir=str(tmp_path))
         assert hist[-1]["loss"] < hist[0]["loss"]
+        # train metrics stream from the jitted step's own outputs
+        # (reference fit logs per-batch train metrics)
+        assert "train_acc" in hist[-1]
+        assert hist[-1]["train_acc"] > hist[0]["train_acc"] - 0.05
         logs = m.evaluate((x, y), batch_size=32, verbose=0)
         assert logs["acc"] > 0.8
         # checkpoint files written
         import os
         assert any(f.endswith(".pdparams") for f in os.listdir(tmp_path))
+
+    def test_fit_streams_tuple_compute_metrics(self):
+        """Metrics whose compute() passes (pred, label) through (base
+        Metric semantics — Precision/Recall) must work in fit, not just
+        Accuracy's single-array compute."""
+        net = paddle.nn.Sequential(paddle.nn.Linear(8, 1))
+
+        def bce(pred, label):
+            p = paddle.nn.functional.sigmoid(pred.reshape((-1,)))
+            y = label.astype("float32")
+            return -paddle.mean(y * paddle.log(p + 1e-7)
+                                + (1 - y) * paddle.log(1 - p + 1e-7))
+
+        m = Model(net)
+        m.prepare(paddle.optimizer.Adam(5e-2, parameters=net.parameters()),
+                  bce, [paddle.metric.Precision(), paddle.metric.Recall()])
+        x, y = self._data(64)
+        hist = m.fit((x, y), batch_size=32, epochs=3, verbose=0)
+        assert "train_precision" in hist[-1] and "train_recall" in hist[-1]
+        assert 0.0 <= hist[-1]["train_precision"] <= 1.0
 
     def test_early_stopping(self):
         net = paddle.nn.Linear(8, 2)

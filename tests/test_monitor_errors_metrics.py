@@ -67,6 +67,36 @@ class TestErrors:
             errors.enforce(False, "x", exc=errors.UnavailableError)
 
 
+class TestCrypto:
+    def test_round_trip_and_tamper(self, tmp_path):
+        from paddle_tpu.framework import crypto
+
+        sd = {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+              "b": np.zeros(4, np.float32)}
+        p = str(tmp_path / "m.pdenc")
+        crypto.save_encrypted(sd, p, key="s3cret")
+        back = crypto.load_encrypted(p, key="s3cret")
+        np.testing.assert_array_equal(np.asarray(back["w"].numpy()
+                                                 if hasattr(back["w"],
+                                                            "numpy")
+                                                 else back["w"]), sd["w"])
+        with pytest.raises(ValueError, match="wrong key|HMAC"):
+            crypto.load_encrypted(p, key="wrong")
+        blob = bytearray(open(p, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF  # flip a ciphertext bit
+        open(p, "wb").write(bytes(blob))
+        with pytest.raises(ValueError, match="tampered|HMAC"):
+            crypto.load_encrypted(p, key="s3cret")
+
+    def test_ciphertext_hides_plaintext(self, tmp_path):
+        from paddle_tpu.framework import crypto
+
+        data = b"SECRET_WEIGHTS" * 100
+        blob = crypto.encrypt_bytes(data, "k")
+        assert b"SECRET_WEIGHTS" not in blob
+        assert crypto.decrypt_bytes(blob, "k") == data
+
+
 class TestFleetMetrics:
     def test_auc_perfect_and_random(self):
         from paddle_tpu.distributed.fleet import metrics as fm
