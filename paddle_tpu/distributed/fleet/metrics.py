@@ -1,41 +1,31 @@
 """Distributed metrics (reference fleet/metrics/metric.py — sum/max/auc
 over all trainers via gloo all_reduce of local numpy stats).
 
-TPU-first: under single-controller SPMD a 'per-trainer local stat' is a
-stacked-per-rank array (see distributed/collective.py); these helpers
-reduce it with the eager collectives when a mesh axis is active and fall
-back to plain numpy when running single-process (the common case for
-metric aggregation at epoch end).  ``auc`` computes the final value from
-the (merged) positive/negative histograms exactly like the reference's
-distributed AUC."""
+TPU-first: under single-controller SPMD there is ONE process, so metric
+stats are usually already global — the reference's per-trainer all_reduce
+has no implicit analog.  When the caller DID build per-rank stats (one
+block per rank stacked along dim 0), pass ``stacked=world`` to reduce
+them; guessing from an ambient mesh would silently misinterpret ordinary
+histograms whose length happens to relate to the mesh size."""
 from __future__ import annotations
 
 import numpy as np
 
 __all__ = ["sum", "max", "min", "auc", "acc"]
 
-def _reduce(local, op: str):
-    """Stacked-per-rank [n*B, ...] -> reduced [B, ...] when a mesh axis is
-    live; identity for single-process."""
-    from ..env import get_mesh, has_mesh
 
+def _reduce(local, op: str, stacked: int | None):
     arr = np.asarray(local)
-    if not has_mesh():
+    if not stacked or stacked <= 1:
         return arr
-    mesh = get_mesh()
-    ax = mesh.axis_names[0]
-    n = mesh.shape[ax]
-    if n <= 1:
-        return arr
+    n = int(stacked)
     if arr.ndim == 0 or arr.shape[0] % n:
         from ...framework.errors import InvalidArgumentError
 
         raise InvalidArgumentError(
-            f"fleet.metrics with an active {n}-way mesh needs "
-            f"stacked-per-rank input (leading dim a multiple of {n}); got "
-            f"shape {arr.shape}",
-            hint="stack each rank's local stat along dim 0, or aggregate "
-                 "before the mesh is initialized")
+            f"stacked={n} needs the leading dim to be a multiple of {n}; "
+            f"got shape {arr.shape}",
+            hint="stack each rank's local stat along dim 0")
     blocks = arr.reshape((n, arr.shape[0] // n) + arr.shape[1:])
     if op == "sum":
         return blocks.sum(0)
@@ -46,34 +36,38 @@ def _reduce(local, op: str):
     raise ValueError(op)
 
 
-def sum(local):  # noqa: A001 - reference API name
-    return _reduce(local, "sum")
+def sum(local, stacked: int | None = None):  # noqa: A001 - reference API name
+    return _reduce(local, "sum", stacked)
 
 
-def max(local):  # noqa: A001
-    return _reduce(local, "max")
+def max(local, stacked: int | None = None):  # noqa: A001
+    return _reduce(local, "max", stacked)
 
 
-def min(local):  # noqa: A001
-    return _reduce(local, "min")
+def min(local, stacked: int | None = None):  # noqa: A001
+    return _reduce(local, "min", stacked)
 
 
-def acc(correct, total):
-    """Global accuracy from per-rank (correct, total) scalars or stacked
-    arrays (reference fleet.metrics.acc)."""
-    c = np.asarray(sum(np.atleast_1d(np.asarray(correct))), np.float64)
-    t = np.asarray(sum(np.atleast_1d(np.asarray(total))), np.float64)
+def acc(correct, total, stacked: int | None = None):
+    """Global accuracy from (correct, total) counts; ``stacked=world``
+    when each rank's scalar is stacked along dim 0 (reference
+    fleet.metrics.acc all_reduces the two scalars)."""
+    c = np.asarray(sum(np.atleast_1d(np.asarray(correct)), stacked),
+                   np.float64)
+    t = np.asarray(sum(np.atleast_1d(np.asarray(total)), stacked),
+                   np.float64)
     return float(c.sum() / np.maximum(t.sum(), 1.0))
 
 
-def auc(stat_pos, stat_neg):
+def auc(stat_pos, stat_neg, stacked: int | None = None):
     """AUC from positive/negative score histograms (reference
     fleet/metrics/metric.py:auc — trapezoid over merged buckets).
 
-    stat_pos/stat_neg: [num_buckets] per-rank or stacked [n*num_buckets]
-    counts; bucket i holds scores in [i/B, (i+1)/B)."""
-    pos = np.asarray(sum(np.asarray(stat_pos, np.float64)), np.float64)
-    neg = np.asarray(sum(np.asarray(stat_neg, np.float64)), np.float64)
+    stat_pos/stat_neg: [num_buckets] global counts, or [world*num_buckets]
+    per-rank stacked with ``stacked=world``; bucket i holds scores in
+    [i/B, (i+1)/B)."""
+    pos = np.asarray(sum(np.asarray(stat_pos, np.float64), stacked))
+    neg = np.asarray(sum(np.asarray(stat_neg, np.float64), stacked))
     pos = np.atleast_1d(pos).reshape(-1)
     neg = np.atleast_1d(neg).reshape(-1)
     tot_pos = tot_neg = 0.0

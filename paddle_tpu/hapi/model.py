@@ -107,7 +107,9 @@ class Model:
                         np.asarray(y), stop_gradient=True)
                     for m in self._metrics:
                         _metric_update(m, out, yt)
-                        logs.update(_metric_logs(m))
+                        # train_ prefix everywhere: the bare name is
+                        # reserved for eval values (eval_loss convention)
+                        logs.update(_metric_logs(m, prefix="train_"))
                 for c in cbs:
                     c.on_train_batch_end(step, logs)
             epoch_logs = {"loss": float(np.mean(losses)) if losses else 0.0}

@@ -28,7 +28,8 @@ from ..framework import random as _random
 from ..nn.layer_base import Layer
 
 __all__ = ["to_static", "functional_call", "TrainStep", "TranslatedLayer",
-           "save", "load", "not_to_static"]
+           "TranslatedTrainStep", "load_train_program", "save", "load",
+           "not_to_static"]
 
 
 def _split_state(layer: Layer):
@@ -261,6 +262,112 @@ class TrainStep:
         for k, b in self.model.named_buffers():
             if k in self._buffers:
                 b._value = self._buffers[k]
+
+    def save_program(self, path_prefix: str, *example_batch):
+        """Serialize the ENTIRE training program (forward + backward +
+        optimizer update, one StableHLO artifact via jax.export) plus the
+        current train state — the serializable *train* Program the
+        reference persists as ProgramDesc (framework.proto:202).
+        :func:`load_train_program` resumes training WITHOUT the model's
+        Python class."""
+        import json
+        import os
+
+        import numpy as np
+
+        from ..framework import random as _random
+        from ..framework.io import save as _save
+
+        arr = [b.value if isinstance(b, Tensor) else jnp.asarray(b)
+               for b in example_batch]
+        # fixed dummy key: export only needs shape/dtype — consuming the
+        # global RNG stream here would make a pure save perturb every
+        # subsequent dropout mask (run reproducibility)
+        args = (self._params, self._buffers, self._opt_state,
+                jax.random.PRNGKey(0), jnp.float32(0.0),
+                jnp.int32(0), *arr)
+        exported = jax.export.export(self._compiled)(*args)
+        os.makedirs(os.path.dirname(os.path.abspath(path_prefix)),
+                    exist_ok=True)
+        with open(path_prefix + ".pdtrain", "wb") as f:
+            f.write(exported.serialize())
+        _save({"params": self._params, "buffers": self._buffers,
+               "opt_state": self._opt_state, "step": self._step,
+               "lr": float(self._current_lr())},
+              path_prefix + ".pdstate")
+        with open(path_prefix + ".pdtrain.json", "w") as f:
+            json.dump({
+                "format": "paddle-tpu-train-program-v1",
+                "batch": [{"shape": list(np.shape(a)),
+                           "dtype": str(jnp.asarray(a).dtype)}
+                          for a in arr],
+            }, f, indent=1)
+        return path_prefix
+
+
+class TranslatedTrainStep:
+    """A training step rebuilt from a serialized program — no model class
+    needed (the trainable counterpart of TranslatedLayer).  State advances
+    exactly like the original TrainStep; weights come back out via
+    ``state_dict()``."""
+
+    def __init__(self, prefix: str):
+        import json
+        import os
+
+        from ..framework import random as _random
+        from ..framework.io import load as _load
+
+        with open(prefix + ".pdtrain", "rb") as f:
+            self._exported = jax.export.deserialize(f.read())
+        st = _load(prefix + ".pdstate")
+        self._params = st["params"]
+        self._buffers = st["buffers"]
+        self._opt_state = st["opt_state"]
+        self._step = int(st.get("step", 0))
+        self._lr = float(st.get("lr", 1e-3))  # the saved run's rate
+        self._batch_spec = None
+        if os.path.exists(prefix + ".pdtrain.json"):
+            with open(prefix + ".pdtrain.json") as f:
+                self._batch_spec = json.load(f).get("batch")
+        self._call = jax.jit(self._exported.call)
+        self._rand = _random
+
+    def _check_batch(self, arr):
+        if self._batch_spec is None:
+            return
+        from ..framework.errors import InvalidArgumentError
+
+        got = [(list(jnp.shape(a)), str(jnp.asarray(a).dtype)) for a in arr]
+        want = [(s["shape"], s["dtype"]) for s in self._batch_spec]
+        if got != want:
+            raise InvalidArgumentError(
+                f"batch does not match the exported program's signature: "
+                f"expected {want}, got {got}",
+                hint="exported train programs are shape-locked to the "
+                     "example batch passed to save_program")
+
+    def __call__(self, *batch, lr: float | None = None):
+        arr = [b.value if isinstance(b, Tensor) else jnp.asarray(b)
+               for b in batch]
+        self._check_batch(arr)
+        key = self._rand.next_key()
+        (self._params, self._buffers, self._opt_state, loss,
+         _out) = self._call(
+            self._params, self._buffers, self._opt_state, key,
+            jnp.float32(self._lr if lr is None else lr),
+            jnp.int32(self._step), *arr)
+        self._step += 1
+        return Tensor(loss, stop_gradient=True)
+
+    def state_dict(self):
+        return dict(self._params)
+
+
+def load_train_program(prefix: str) -> TranslatedTrainStep:
+    """Rebuild a runnable training step from :meth:`TrainStep.save_program`
+    output — resumable training without the original Python model."""
+    return TranslatedTrainStep(prefix)
 
 
 def save(layer, path, input_spec=None, **kwargs):

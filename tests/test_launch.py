@@ -120,7 +120,7 @@ def test_launch_elastic_scale_relaunch(tmp_path):
         "import os, time\n"
         "print('POD-START world', os.environ['PADDLE_TRAINERS_NUM'],"
         " flush=True)\n"
-        "time.sleep(8)\n")
+        "time.sleep(12)\n")
     # fixed free port so the test can dial the same KV store
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -133,13 +133,23 @@ def test_launch_elastic_scale_relaunch(tmp_path):
         cwd="/root/repo", stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         text=True)
     try:
-        time.sleep(3.0)  # pod up, membership snapshot taken
+        # wait until the launcher's own heartbeat is registered (no fixed
+        # sleep: under CI load the pod may come up slowly)
         c = KVClient("127.0.0.1", port)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            kv, _now = c.snapshot("elastic/host/")
+            if any(k.endswith("node0") for k in kv):
+                break
+            time.sleep(0.2)
+        else:
+            raise TimeoutError("launcher never registered membership")
+        time.sleep(1.0)  # let the post-register baseline snapshot land
         c.stamp("elastic/host/node99")  # a second host joins
         # relaunch fires; node99's single heartbeat expires (ttl) causing
         # one more relaunch; the final pod runs to completion and the
         # launcher exits normally (no SIGTERM: children share the pipe)
-        out, err = proc.communicate(timeout=60)
+        out, err = proc.communicate(timeout=90)
     finally:
         if proc.poll() is None:
             proc.kill()

@@ -140,13 +140,17 @@ class TestFleetMetrics:
         np.testing.assert_allclose(got, exact / tot, atol=0.01)
 
     def test_stacked_reduce_and_acc(self):
-        from paddle_tpu.distributed import init_parallel_env
         from paddle_tpu.distributed.fleet import metrics as fm
+        from paddle_tpu.framework.errors import InvalidArgumentError
 
-        init_parallel_env({"dp": 8})
         stacked = np.arange(8, dtype=np.float64)  # one scalar per rank
-        assert float(fm.sum(stacked)[0]) == 28.0
-        assert float(fm.max(stacked)[0]) == 7.0
+        assert float(fm.sum(stacked, stacked=8)[0]) == 28.0
+        assert float(fm.max(stacked, stacked=8)[0]) == 7.0
         correct = np.full(8, 10.0)
         total = np.full(8, 20.0)
-        assert fm.acc(correct, total) == pytest.approx(0.5)
+        assert fm.acc(correct, total, stacked=8) == pytest.approx(0.5)
+        # global (unstacked) semantics are the default — histogram length
+        # must NOT be misread as per-rank blocks
+        assert float(fm.sum(stacked).sum()) == 28.0
+        with pytest.raises(InvalidArgumentError, match="multiple"):
+            fm.sum(np.ones(7), stacked=8)
