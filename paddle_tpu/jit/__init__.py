@@ -275,7 +275,6 @@ class TrainStep:
 
         import numpy as np
 
-        from ..framework import random as _random
         from ..framework.io import save as _save
 
         arr = [b.value if isinstance(b, Tensor) else jnp.asarray(b)
@@ -315,7 +314,6 @@ class TranslatedTrainStep:
         import json
         import os
 
-        from ..framework import random as _random
         from ..framework.io import load as _load
 
         with open(prefix + ".pdtrain", "rb") as f:

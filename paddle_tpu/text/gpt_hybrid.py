@@ -50,14 +50,18 @@ _dropout = gpt._dropout
 
 
 def mp_block(x, p, cfg: gpt.GPTConfig, mp_axis: str | None, mp_size: int,
-             key=None, sp_axis: str | None = None):
+             key=None, sp_axis: str | None = None,
+             ep_axis: str | None = None, ep_size: int = 1):
     """One transformer block on [B, T, D]; weight leaves are LOCAL mp shards.
 
     qkv/fc are column-parallel (heads and ffn split across mp, no comm);
     proj/out are row-parallel (one psum each) — two all-reduces per block,
     exactly the reference Megatron block's comm pattern.  With ``sp_axis``
     set, T is the LOCAL sequence chunk and attention runs as a ring over
-    that axis (ops/ring_attention.py) — context parallelism."""
+    that axis (ops/ring_attention.py) — context parallelism.  With
+    ``cfg.moe`` the ffn becomes expert-parallel over ``ep_axis``
+    (moe.moe_ffn_manual: explicit all_to_all dispatch).  Returns
+    ``(x, aux)`` — the MoE load-balancing loss (0 for dense)."""
     B, T, D = x.shape
     H = cfg.num_heads // mp_size
     hd = cfg.head_dim
@@ -78,13 +82,21 @@ def mp_block(x, p, cfg: gpt.GPTConfig, mp_axis: str | None, mp_size: int,
         a = _dropout(a, cfg.dropout, jax.random.fold_in(key, 0))
     x = x + a
     h = gpt._layer_norm(x.astype(jnp.float32), p["ln2_g"], p["ln2_b"]).astype(dt)
-    h = jax.nn.gelu(mt.column_parallel_linear(h, p["fc_w"].astype(dt),
-                                              p["fc_b"].astype(dt)))
-    h = mt.row_parallel_linear(h, p["out_w"].astype(dt),
-                               p["out_b"].astype(dt), axis=mp_axis)
+    if cfg.moe is not None:
+        from .moe import moe_ffn_manual
+
+        h, aux = moe_ffn_manual(
+            p["moe"], h, cfg.moe, ep_axis, ep_size, mp_axis=mp_axis,
+            key=(jax.random.fold_in(key, 2) if key is not None else None))
+    else:
+        h = jax.nn.gelu(mt.column_parallel_linear(h, p["fc_w"].astype(dt),
+                                                  p["fc_b"].astype(dt)))
+        h = mt.row_parallel_linear(h, p["out_w"].astype(dt),
+                                   p["out_b"].astype(dt), axis=mp_axis)
+        aux = jnp.zeros((), jnp.float32)
     if cfg.dropout > 0.0 and key is not None:
         h = _dropout(h, cfg.dropout, jax.random.fold_in(key, 1))
-    return x + h
+    return x + h, aux
 
 
 # ---------------------------------------------------------------------------
@@ -97,9 +109,11 @@ class _Parts(NamedTuple):
     S: int
     mp_size: int
     sp_size: int
+    ep_size: int
     mp_ax: Any
     sp_ax: Any
     dp_ax: Any
+    ep_ax: Any
     vps: int
     perm_fwd: list
     perm_bwd: list
@@ -109,13 +123,15 @@ class _Parts(NamedTuple):
 
 
 def _pipeline_parts(cfg: gpt.GPTConfig, mesh: Mesh, dp_axis, pp_axis, mp_axis,
-                    sp_axis) -> _Parts:
+                    sp_axis, ep_axis="ep") -> _Parts:
     S = mesh.shape.get(pp_axis, 1)
     mp_size = mesh.shape.get(mp_axis, 1)
     sp_size = mesh.shape.get(sp_axis, 1)
+    ep_size = mesh.shape.get(ep_axis, 1)
     mp_ax = mp_axis if mp_size > 1 else None
     sp_ax = sp_axis if sp_size > 1 else None
     dp_ax = dp_axis if mesh.shape.get(dp_axis, 1) > 1 else None
+    ep_ax = ep_axis if ep_size > 1 else None
     vps = cfg.vocab_size // mp_size
     dt = cfg.dtype
 
@@ -126,6 +142,8 @@ def _pipeline_parts(cfg: gpt.GPTConfig, mesh: Mesh, dp_axis, pp_axis, mp_axis,
         return (x + wpe).astype(dt)
 
     def stage(blocks, x, key):
+        """Run this stage's blocks; returns (x, aux) — the summed MoE
+        load-balancing loss of the stage's own layers (0 for dense)."""
         n_local = jax.tree_util.tree_leaves(blocks)[0].shape[0]
         if S > 1:
             # decorrelate dropout across stages: the tick key is stage-shared
@@ -135,18 +153,21 @@ def _pipeline_parts(cfg: gpt.GPTConfig, mesh: Mesh, dp_axis, pp_axis, mp_axis,
             key = jax.random.fold_in(key, lax.axis_index(sp_ax))
         layer_keys = jax.random.split(key, n_local)
         body = functools.partial(mp_block, cfg=cfg, mp_axis=mp_ax,
-                                 mp_size=mp_size, sp_axis=sp_ax)
+                                 mp_size=mp_size, sp_axis=sp_ax,
+                                 ep_axis=ep_ax, ep_size=ep_size)
         if cfg.remat:
             body = jax.checkpoint(body)
 
         def scan_body(x, pk):
             p, k = pk
-            return body(x, p, key=k), None
+            x, aux = body(x, p, key=k)
+            return x, aux
 
-        x, _ = lax.scan(scan_body, x, (blocks, layer_keys))
-        return x
+        x, auxs = lax.scan(scan_body, x, (blocks, layer_keys))
+        return x, jnp.sum(auxs)
 
-    return _Parts(S, mp_size, sp_size, mp_ax, sp_ax, dp_ax, vps,
+    return _Parts(S, mp_size, sp_size, ep_size, mp_ax, sp_ax, dp_ax, ep_ax,
+                  vps,
                   [(i, (i + 1) % S) for i in range(S)],
                   [(i, (i - 1) % S) for i in range(S)], dt, embed, stage)
 
@@ -196,18 +217,23 @@ def make_pipeline_gpt_loss(cfg: gpt.GPTConfig, mesh: Mesh, n_micro: int,
         x_emb = embed(params, tok_in, pos0)
 
         def tick(carry, inp):
-            x_recv = carry
+            x_recv, aux_acc = carry
             t, k_t = inp
             in_idx = jnp.clip(t, 0, M - 1)
             x_in = jnp.where(
                 s == 0, lax.dynamic_index_in_dim(x_emb, in_idx, keepdims=False),
                 x_recv)
-            y = stage(params["blocks"], x_in, k_t)
+            y, aux = stage(params["blocks"], x_in, k_t)
+            # this stage holds real data only at ticks s..s+M-1; fill/drain
+            # ticks' aux is garbage and must not enter the loss
+            valid = (t >= s) & (t < s + M)
+            aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
             x_send = lax.ppermute(y, pp_axis, perm) if S > 1 else y
-            return x_send, y
+            return (x_send, aux_acc), y
 
-        _, ys = lax.scan(tick, jnp.zeros_like(x_emb[0]),
-                         (jnp.arange(ticks), keys))
+        (_, aux_sum), ys = lax.scan(
+            tick, (jnp.zeros_like(x_emb[0]), jnp.zeros((), jnp.float32)),
+            (jnp.arange(ticks), keys))
         # ys[t] is this stage's output at tick t; the last stage's final
         # outputs for micro-batch m sit at tick m + S - 1 → static slice.
         # One batched head over all M micro-batches (vs per-tick heads: the
@@ -218,6 +244,9 @@ def make_pipeline_gpt_loss(cfg: gpt.GPTConfig, mesh: Mesh, n_micro: int,
         logits = mt.vocab_parallel_logits(x, params["wte"].astype(dt))
         ce = mt.vocab_parallel_softmax_ce(logits, tok_tgt, mp_ax, vps)
         loss = jnp.where(s == S - 1, jnp.mean(ce.astype(jnp.float32)), 0.0)
+        # each stage contributes its own layers' MoE aux (mean per micro-
+        # batch); summed over pp with the masked head below
+        loss = loss + aux_sum / M
         if S > 1:
             loss = lax.psum(loss, pp_axis)  # only last stage's head is real
         if dp_ax is not None:
@@ -277,23 +306,29 @@ def make_pipeline_1f1b_grads(cfg: gpt.GPTConfig, mesh: Mesh, n_micro: int,
     parts = _pipeline_parts(cfg, mesh, dp_axis, pp_axis, mp_axis, sp_axis)
     S, mp_ax, sp_ax, dp_ax = parts.S, parts.mp_ax, parts.sp_ax, parts.dp_ax
     sp_size, vps, dt = parts.sp_size, parts.vps, parts.dt
+    ep_ax, ep_size = parts.ep_ax, parts.ep_size
     embed, stage = parts.embed, parts.stage
     if S < 2:
         raise ValueError("1F1B schedule needs pp >= 2; use the GSPMD path")
 
-    specs = gpt.param_shardings(cfg, mp=mp_ax, pp=pp_axis)
+    specs = gpt.param_shardings(cfg, mp=mp_ax, pp=pp_axis, ep=ep_ax)
+    # the loss is computed redundantly on every mp (and ep) rank; seeding
+    # each replica's VJP with 1/replicas keeps the psum'd grads exact
+    replicas = parts.mp_size * max(ep_size, 1)
 
     def sync_grads(grads):
         """Per-rank cotangents follow the partial-sum convention (psum
         transposes to psum under shard_map, and the loss seed is divided by
-        mp_size), so every leaf's true grad is the SUM over the model axes
-        it is not sharded over — pp for shared embeddings (the reference's
-        allreduce_shared_weight_gradients) and mp for replicated leaves —
-        and the MEAN over the data axes (dp, sp)."""
+        the mp*ep replica count), so every leaf's true grad is the SUM over
+        the model axes it is not sharded over — pp for shared embeddings
+        (the reference's allreduce_shared_weight_gradients), mp for
+        replicated leaves, ep for non-expert leaves — and the MEAN over the
+        data axes (dp, sp)."""
         def leaf(g, spec):
             owned = _spec_axes(spec)
-            sum_axes = tuple(a for a in (pp_axis, mp_axis)
-                             if mesh.shape.get(a, 1) > 1 and a not in owned)
+            sum_axes = tuple(a for a in (pp_axis, mp_axis, ep_ax)
+                             if a is not None
+                             and mesh.shape.get(a, 1) > 1 and a not in owned)
             if sum_axes:
                 g = lax.psum(g, sum_axes)
             mean_axes = tuple(a for a in (dp_axis, sp_axis)
@@ -326,21 +361,25 @@ def make_pipeline_1f1b_grads(cfg: gpt.GPTConfig, mesh: Mesh, n_micro: int,
 
         def fwd_only(p, x_in, tok_mb, k):
             x0 = jnp.where(s == 0, embed(p, tok_mb, pos0), x_in)
-            return stage(p["blocks"], x0, k)
+            y, _aux = stage(p["blocks"], x0, k)
+            return y
 
         def full(p, x_in, tok_mb, tgt_mb, k):
             """stage + (masked) loss head — the unit the backward slot VJPs.
             The head term is where-masked off except on the last stage, so
             its cotangents vanish elsewhere; under SPMD every rank still
-            executes it (the cost of a uniform program)."""
-            y = fwd_only(p, x_in, tok_mb, k)
+            executes it (the cost of a uniform program).  The stage's own
+            MoE aux loss joins unmasked — every stage owns its layers'
+            router gradients."""
+            x0 = jnp.where(s == 0, embed(p, tok_mb, pos0), x_in)
+            y, aux = stage(p["blocks"], x0, k)
             x = gpt._layer_norm(y.astype(jnp.float32), p["ln_f_g"],
                                 p["ln_f_b"]).astype(dt)
             logits = mt.vocab_parallel_logits(x, p["wte"].astype(dt))
             ce = mt.vocab_parallel_softmax_ce(logits, tgt_mb, mp_ax, vps)
             loss_mb = jnp.where(s == S - 1,
                                 jnp.mean(ce.astype(jnp.float32)), 0.0)
-            return y, loss_mb
+            return y, loss_mb + aux
 
         BUF = min(M, 2 * S - 1)
         ticks = M + 2 * (S - 1)
@@ -387,7 +426,7 @@ def make_pipeline_1f1b_grads(cfg: gpt.GPTConfig, mesh: Mesh, n_micro: int,
             valid = b_valid.astype(jnp.float32)
             dy = jnp.where(s == S - 1, jnp.zeros_like(dx_bwd), dx_bwd)
             dy = dy * valid.astype(dt)
-            dparams, dx = vjp_fn((dy, valid / (M * parts.mp_size)))
+            dparams, dx = vjp_fn((dy, valid / (M * replicas)))
             grads = jax.tree_util.tree_map(jnp.add, grads, dparams)
             loss_sum = loss_sum + valid * loss_mb
             dx_next = lax.ppermute(dx, pp_axis, parts.perm_bwd)
@@ -395,7 +434,9 @@ def make_pipeline_1f1b_grads(cfg: gpt.GPTConfig, mesh: Mesh, n_micro: int,
 
         (_, _, _, grads, loss_sum), _ = lax.scan(tick, init,
                                                  jnp.arange(ticks))
-        loss = lax.psum(loss_sum, pp_axis) / M  # only last stage accumulated
+        # every stage accumulated: the CE head on the last stage plus each
+        # stage's own MoE aux — the psum gathers all of it
+        loss = lax.psum(loss_sum, pp_axis) / M
         if dp_ax is not None:
             loss = lax.pmean(loss, dp_ax)
         if sp_ax is not None:
@@ -458,9 +499,6 @@ def build_gpt_train_step(cfg: gpt.GPTConfig, mesh: Mesh, optimizer,
     if cfg.num_heads % max(mp, 1) or cfg.vocab_size % max(mp, 1):
         raise ValueError("num_heads and vocab_size must divide by mp")
     if cfg.moe is not None:
-        if pp > 1 or sp > 1:
-            raise NotImplementedError(
-                "MoE currently composes with dp/mp/ep (GSPMD path) only")
         if cfg.moe.num_experts % max(ep, 1):
             raise ValueError("num_experts must divide by ep")
 

@@ -65,6 +65,87 @@ def _top_k_gating(logits, k: int):
     return w, idx, probs
 
 
+def _route(params, xf, cfg: MoEConfig, key, E: int, C: int, dtype):
+    """Shared router: returns (disp [N,E,C], comb [N,E,C], aux scalar)."""
+    N = xf.shape[0]
+    logits = xf.astype(jnp.float32) @ params["router_w"]
+    if cfg.router_noise > 0.0 and key is not None:
+        logits = logits + cfg.router_noise * jax.random.normal(
+            key, logits.shape)
+    gate_w, gate_idx, probs = _top_k_gating(logits, cfg.top_k)
+
+    # load-balancing aux loss: E * sum_e f_e * p_e  (GShard/Switch)
+    me = jnp.mean(probs, axis=0)                                  # [E]
+    fe = jnp.sum(jax.nn.one_hot(gate_idx[:, 0], E), axis=0) / N   # [E]
+    aux = E * jnp.sum(fe * me) * cfg.aux_loss_weight
+
+    onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)         # [N,k,E]
+    flat = onehot.reshape(N * cfg.top_k, E)
+    pos = jnp.cumsum(flat, axis=0) * flat - 1                     # [N*k, E]
+    pos = jnp.max(pos, axis=-1).reshape(N, cfg.top_k)             # [N,k]
+    keep = pos < C
+    gate_w = gate_w * keep
+
+    disp = jnp.zeros((N, E, C), dtype)
+    n_ix = jnp.arange(N)[:, None].repeat(cfg.top_k, 1)
+    disp = disp.at[n_ix, gate_idx, jnp.clip(pos, 0, C - 1)].add(
+        keep.astype(dtype))
+    comb = jnp.zeros((N, E, C), jnp.float32)
+    comb = comb.at[n_ix, gate_idx, jnp.clip(pos, 0, C - 1)].add(
+        gate_w * keep)
+    return disp, comb, aux
+
+
+def moe_ffn_manual(params: dict, x, cfg: MoEConfig, ep_axis: str | None,
+                   ep_size: int, mp_axis: str | None = None,
+                   key=None, activation=jax.nn.gelu):
+    """Manual-collective MoE ffn for ``shard_map`` bodies (the pipeline /
+    ring-attention composition path, where GSPMD sharding propagation is
+    unavailable).
+
+    Param leaves are LOCAL shards: w_in [E_local, D, F_local] etc. with
+    E_local = E/ep and F_local = F/mp; router_w replicated.  In this path
+    the TOKENS are replicated over 'ep' (ep shards only the experts), so
+    dispatch needs no all_to_all: each rank slices its own experts' block
+    of the dispatch/combine tensors, runs only its E_local experts
+    (1/ep of the FLOPs), and ONE psum over 'ep' merges the partial
+    combines — numerically identical to the GSPMD lowering, with the
+    Megatron column→row pattern (one more psum over 'mp') inside each
+    expert.  Under sequence parallelism the routing statistics (capacity,
+    aux loss) are computed per local sequence chunk rather than globally
+    — same per-token assignments, chunk-local capacity accounting."""
+    orig_shape = x.shape
+    D = orig_shape[-1]
+    xf = x.reshape(-1, D)
+    N = xf.shape[0]
+    E_local = params["w_in"].shape[0]
+    E = E_local * max(ep_size, 1)
+    C = max(1, math.ceil(N * cfg.top_k / E * cfg.capacity_factor))
+
+    disp, comb, aux = _route(params, xf, cfg, key, E, C, x.dtype)
+
+    if ep_axis is not None and ep_size > 1:
+        g = jax.lax.axis_index(ep_axis)
+        disp = jax.lax.dynamic_slice_in_dim(disp, g * E_local, E_local,
+                                            axis=1)   # [N, E_local, C]
+        comb = jax.lax.dynamic_slice_in_dim(comb, g * E_local, E_local,
+                                            axis=1)
+
+    xin = jnp.einsum("nec,nd->ecd", disp, xf)         # [E_local, C, D]
+    h = activation(jnp.einsum("ecd,edf->ecf", xin,
+                              params["w_in"].astype(x.dtype))
+                   + params["b_in"][:, None].astype(x.dtype))
+    out = jnp.einsum("ecf,efd->ecd", h, params["w_out"].astype(x.dtype))
+    if mp_axis is not None:
+        out = jax.lax.psum(out, mp_axis)  # row-parallel reduce
+    out = out + params["b_out"][:, None].astype(x.dtype)
+
+    y = jnp.einsum("nec,ecd->nd", comb.astype(x.dtype), out)
+    if ep_axis is not None and ep_size > 1:
+        y = jax.lax.psum(y, ep_axis)      # merge the per-expert-group parts
+    return y.reshape(orig_shape), aux
+
+
 def moe_ffn(params: dict, x, cfg: MoEConfig, key=None, activation=jax.nn.gelu):
     """x [..., D] → (y [..., D], aux_loss scalar).
 
@@ -79,34 +160,7 @@ def moe_ffn(params: dict, x, cfg: MoEConfig, key=None, activation=jax.nn.gelu):
     E = cfg.num_experts
     C = max(1, math.ceil(N * cfg.top_k / E * cfg.capacity_factor))
 
-    logits = xf.astype(jnp.float32) @ params["router_w"]
-    if cfg.router_noise > 0.0 and key is not None:
-        logits = logits + cfg.router_noise * jax.random.normal(
-            key, logits.shape)
-    gate_w, gate_idx, probs = _top_k_gating(logits, cfg.top_k)
-
-    # load-balancing aux loss: E * sum_e f_e * p_e  (GShard/Switch)
-    me = jnp.mean(probs, axis=0)                                  # [E] mean prob
-    fe = jnp.sum(jax.nn.one_hot(gate_idx[:, 0], E), axis=0) / N   # [E] frac routed
-    aux = E * jnp.sum(fe * me) * cfg.aux_loss_weight
-
-    # position of each (token, slot) inside its expert buffer via cumsum
-    # dispatch [N, k, E] one-hot over experts
-    onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)         # [N,k,E]
-    flat = onehot.reshape(N * cfg.top_k, E)
-    pos = jnp.cumsum(flat, axis=0) * flat - 1                     # [N*k, E]
-    pos = jnp.max(pos, axis=-1).reshape(N, cfg.top_k)             # [N,k]
-    keep = pos < C
-    gate_w = gate_w * keep
-
-    # dispatch tensor [N, E, C]
-    disp = jnp.zeros((N, E, C), x.dtype)
-    n_ix = jnp.arange(N)[:, None].repeat(cfg.top_k, 1)
-    disp = disp.at[n_ix, gate_idx, jnp.clip(pos, 0, C - 1)].add(
-        keep.astype(x.dtype))
-    comb = jnp.zeros((N, E, C), jnp.float32)
-    comb = comb.at[n_ix, gate_idx, jnp.clip(pos, 0, C - 1)].add(
-        gate_w * keep)
+    disp, comb, aux = _route(params, xf, cfg, key, E, C, x.dtype)
 
     # route → expert ffn → route back (XLA lowers these to all_to_all when
     # the E dim is sharded over 'ep')
