@@ -114,18 +114,22 @@ def bench_gpt(small: bool):
                                  num_layers=2, num_heads=4, max_seq_len=256),
                    2, 256, 3)]
     else:
+        import dataclasses
+
         c13 = gpt.gpt_1p3b()
         c760 = gpt.GPTConfig(vocab_size=50304, hidden_size=1536, num_layers=24,
                              num_heads=16, max_seq_len=2048)
         c350 = gpt.GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
                              num_heads=16, max_seq_len=2048)
-        for c in (c13, c760, c350):
-            c.remat = True
-        # try the largest first, fall back on OOM (v5e has 16G HBM;
-        # v4/v5p take the 1.3B head entry)
-        ladder = [("gpt_1.3b", c13, 8, 2048, 10),
-                  ("gpt_760m", c760, 8, 2048, 10),
-                  ("gpt_350m", c350, 8, 2048, 10)]
+        # each size first WITHOUT remat (activation memory permitting, no
+        # recompute FLOPs → higher MFU), then with remat, then next size
+        ladder = []
+        for name, c in (("gpt_1.3b", c13), ("gpt_760m", c760),
+                        ("gpt_350m", c350)):
+            ladder.append((name, dataclasses.replace(c, remat=False),
+                           8, 2048, 10))
+            ladder.append((name + "_remat", dataclasses.replace(c, remat=True),
+                           8, 2048, 10))
 
     mesh = Mesh(np.array([dev]).reshape(1), ("dp",))
     opt = AdamW(learning_rate=2e-4, weight_decay=0.01)
@@ -142,10 +146,13 @@ def bench_gpt(small: bool):
             jax.block_until_ready(loss)
             break
         except Exception as e:  # OOM -> next rung (full error surfaced)
-            last_err = e
             import traceback
             traceback.print_exc(file=sys.stderr)
             _log(f"[bench] {name} failed ({type(e).__name__}); trying next")
+            # drop everything pinning the failed rung's HBM before the next
+            # attempt: the state AND the traceback frames referencing it
+            state = None  # noqa: F841
+            last_err = RuntimeError(f"{name}: {type(e).__name__}: {e}")
     else:
         raise last_err
 
@@ -163,6 +170,7 @@ def bench_gpt(small: bool):
     return {"metric": f"tokens_per_sec_per_chip_{name}",
             "value": round(tok_s, 1), "unit": "tokens/s/chip",
             "step_ms": round(dt * 1e3, 2), "mfu": round(mfu, 4),
+            "remat": bool(cfg.remat),  # configs are NOT comparable across
             "vs_baseline": round(mfu / _A100_MFU_BAR, 4)}
 
 
