@@ -98,7 +98,67 @@ def _time_steps(run_one, iters, block):
 # configs
 # ---------------------------------------------------------------------------
 
-def bench_gpt(small: bool):
+def _gpt_rungs():
+    """Full GPT ladder: (name, config_kwargs, B, T, iters, state_dtype).
+
+    Ordered by preference: the FIRST rung that fits+runs is the headline.
+    bf16 optimizer state (Adam m/v) halves optimizer HBM — the difference
+    between GPT-1.3B fitting a 16 GB v5e chip or not; update math stays fp32
+    (optimizer.py Adam._update_leaf)."""
+    c13 = dict(vocab_size=50304, hidden_size=2048, num_layers=24,
+               num_heads=16, max_seq_len=2048)
+    c760 = dict(vocab_size=50304, hidden_size=1536, num_layers=24,
+                num_heads=16, max_seq_len=2048)
+    c350 = dict(vocab_size=50304, hidden_size=1024, num_layers=24,
+                num_heads=16, max_seq_len=2048)
+    r = []
+    for B in (8, 4, 2):
+        r.append((f"gpt_1.3b_remat_b{B}", dict(c13, remat=True), B, 2048, 10,
+                  "bfloat16"))
+    r.append(("gpt_760m", dict(c760, remat=False), 8, 2048, 10, "bfloat16"))
+    r.append(("gpt_760m_remat", dict(c760, remat=True), 8, 2048, 10,
+              "bfloat16"))
+    r.append(("gpt_350m", dict(c350, remat=False), 8, 2048, 10, "bfloat16"))
+    r.append(("gpt_350m_remat", dict(c350, remat=True), 8, 2048, 10,
+              "bfloat16"))
+    return r
+
+
+def _hbm_bytes() -> float:
+    env = os.environ.get("BENCH_HBM_GB")
+    if env:
+        return float(env) * 1e9
+    try:
+        import jax
+
+        stats = jax.devices()[0].memory_stats() or {}
+        if stats.get("bytes_limit"):
+            return float(stats["bytes_limit"])
+    except Exception:  # noqa: BLE001 - fall through to kind-based default
+        pass
+    return 16e9  # v5e / v5 lite
+
+
+def _gpt_rung_fits(cfg_kwargs, B, T, state_dtype, hbm) -> bool:
+    """Static-footprint estimate: params fp32 + m/v + grads bf16 + logits.
+    Skipping a hopeless rung saves ~2 min of compile-to-OOM each."""
+    from paddle_tpu.text import gpt
+
+    cfg = gpt.GPTConfig(**cfg_kwargs)
+    n = gpt.count_params(cfg)
+    sbytes = 2 if state_dtype == "bfloat16" else 4
+    base = n * (4 + 2 * sbytes + 2)
+    logits = B * T * cfg.vocab_size * 2 * 2  # logits + grad, bf16
+    if cfg.remat:
+        acts = cfg.num_layers * B * T * cfg.hidden_size * 2 * 2
+    else:
+        acts = cfg.num_layers * B * T * (12 * cfg.hidden_size
+                                         + 2 * cfg.ffn_size) * 2
+    return base + logits + acts <= 0.95 * hbm
+
+
+def _run_gpt_rung(idx: int):
+    """Run one ladder rung in-process and return its result dict."""
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -107,54 +167,24 @@ def bench_gpt(small: bool):
     from paddle_tpu.optimizer import AdamW
     from paddle_tpu.text import gpt, gpt_hybrid
 
+    if idx < 0:  # CI/CPU smoke rung
+        name, cfg_kwargs, B, T, iters, state_dtype = (
+            "gpt_small_smoke",
+            dict(vocab_size=1024, hidden_size=128, num_layers=2, num_heads=4,
+                 max_seq_len=256), 2, 256, 3, None)
+    else:
+        name, cfg_kwargs, B, T, iters, state_dtype = _gpt_rungs()[idx]
+    cfg = gpt.GPTConfig(**cfg_kwargs)
     dev = jax.devices()[0]
-    if small:
-        ladder = [("gpt_small_smoke",
-                   gpt.GPTConfig(vocab_size=1024, hidden_size=128,
-                                 num_layers=2, num_heads=4, max_seq_len=256),
-                   2, 256, 3)]
-    else:
-        import dataclasses
-
-        c13 = gpt.gpt_1p3b()
-        c760 = gpt.GPTConfig(vocab_size=50304, hidden_size=1536, num_layers=24,
-                             num_heads=16, max_seq_len=2048)
-        c350 = gpt.GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
-                             num_heads=16, max_seq_len=2048)
-        # each size first WITHOUT remat (activation memory permitting, no
-        # recompute FLOPs → higher MFU), then with remat, then next size
-        ladder = []
-        for name, c in (("gpt_1.3b", c13), ("gpt_760m", c760),
-                        ("gpt_350m", c350)):
-            ladder.append((name, dataclasses.replace(c, remat=False),
-                           8, 2048, 10))
-            ladder.append((name + "_remat", dataclasses.replace(c, remat=True),
-                           8, 2048, 10))
-
     mesh = Mesh(np.array([dev]).reshape(1), ("dp",))
-    opt = AdamW(learning_rate=2e-4, weight_decay=0.01)
+    opt = AdamW(learning_rate=2e-4, weight_decay=0.01, state_dtype=state_dtype)
     key = jax.random.PRNGKey(0)
-    last_err = None
-    for name, cfg, B, T, iters in ladder:
-        try:
-            init_fn, step_fn, _ = gpt_hybrid.build_gpt_train_step(cfg, mesh, opt)
-            state = init_fn(0)
-            rng = np.random.default_rng(0)
-            toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, T + 1)),
-                               jnp.int32)
-            state, loss = step_fn(state, toks, key, 2e-4)
-            jax.block_until_ready(loss)
-            break
-        except Exception as e:  # OOM -> next rung (full error surfaced)
-            import traceback
-            traceback.print_exc(file=sys.stderr)
-            _log(f"[bench] {name} failed ({type(e).__name__}); trying next")
-            # drop everything pinning the failed rung's HBM before the next
-            # attempt: the state AND the traceback frames referencing it
-            state = None  # noqa: F841
-            last_err = RuntimeError(f"{name}: {type(e).__name__}: {e}")
-    else:
-        raise last_err
+    init_fn, step_fn, _ = gpt_hybrid.build_gpt_train_step(cfg, mesh, opt)
+    state = init_fn(0)
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, T + 1)), jnp.int32)
+    state, loss = step_fn(state, toks, key, 2e-4)
+    jax.block_until_ready(loss)
 
     st = {"state": state, "loss": loss}
 
@@ -171,7 +201,42 @@ def bench_gpt(small: bool):
             "value": round(tok_s, 1), "unit": "tokens/s/chip",
             "step_ms": round(dt * 1e3, 2), "mfu": round(mfu, 4),
             "remat": bool(cfg.remat),  # configs are NOT comparable across
+            "state_dtype": state_dtype,
             "vs_baseline": round(mfu / _A100_MFU_BAR, 4)}
+
+
+def bench_gpt(small: bool):
+    if small:
+        return _run_gpt_rung(-1)
+
+    # full ladder: one subprocess per rung so a hung/slow remote compile
+    # cannot take down the whole bench (round-1 lesson), with a static
+    # HBM-footprint pre-filter so hopeless rungs don't burn 2-min OOM compiles
+    hbm = _hbm_bytes()
+    rung_timeout = float(os.environ.get("BENCH_RUNG_TIMEOUT", "900"))
+    last_fail = None
+    for i, (name, cfg_kwargs, B, T, iters, sd) in enumerate(_gpt_rungs()):
+        if not _gpt_rung_fits(cfg_kwargs, B, T, sd, hbm):
+            _log(f"[bench] {name}: skipped (estimated footprint exceeds "
+                 f"{hbm / 1e9:.0f} GB HBM)")
+            continue
+        _log(f"[bench] {name}: attempting (timeout {rung_timeout:.0f}s)")
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--gpt-rung", str(i)],
+                capture_output=True, text=True, timeout=rung_timeout)
+        except subprocess.TimeoutExpired:
+            _log(f"[bench] {name}: timed out after {rung_timeout:.0f}s; "
+                 "trying next rung")
+            last_fail = f"{name}: timeout"
+            continue
+        sys.stderr.write(out.stderr[-4000:])
+        if out.returncode == 0 and out.stdout.strip():
+            return json.loads(out.stdout.strip().splitlines()[-1])
+        _log(f"[bench] {name}: failed rc={out.returncode}; trying next rung")
+        last_fail = f"{name}: rc={out.returncode}"
+    raise RuntimeError(f"all GPT rungs failed (last: {last_fail})")
 
 
 def bench_bert(small: bool):
@@ -320,6 +385,10 @@ _CONFIGS = {"gpt": bench_gpt, "mnist": bench_mnist, "resnet": bench_resnet,
 
 def main():
     argv = sys.argv[1:]
+    if "--gpt-rung" in argv:  # child mode: one ladder rung, JSON on stdout
+        idx = int(argv[argv.index("--gpt-rung") + 1])
+        print(json.dumps(_run_gpt_rung(idx)), flush=True)
+        return
     cpu_fallback = False
     if "--cpu" in argv:
         os.environ["JAX_PLATFORMS"] = "cpu"

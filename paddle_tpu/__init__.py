@@ -108,14 +108,25 @@ if not _LIGHT_IMPORT:
         ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
     )
 
-    def disable_static():  # compat no-op: always "dygraph+jit" here
-        return None
+    from . import static  # noqa: F401
 
-    def enable_static():  # static graph == to_static/jit here
-        return None
+    def disable_static():
+        """Leave Program-recording mode (back to dygraph)."""
+        from .static.program import disable_static_recording
+
+        disable_static_recording()
+
+    def enable_static():
+        """Route public API calls on static Variables into the default main
+        Program (reference paddle.enable_static); run with static.Executor."""
+        from .static.program import enable_static_recording
+
+        enable_static_recording()
 
     def in_dynamic_mode():
-        return True
+        from .core import static_mode
+
+        return static_mode.CURRENT is None
 
     def is_compiled_with_cuda():  # TPU build: never CUDA
         return False

@@ -1,0 +1,73 @@
+"""Static-graph recording switch.
+
+Reference capability: the global build-state that decides whether an API call
+executes eagerly (dygraph fast path through ``core.ops.*``) or appends an
+OpDesc to the current Program (``_dygraph_tracer()`` checks throughout
+/root/reference/python/paddle/fluid/framework.py:804 Variable /
+:1920 Operator / :4016 Program).  TPU-first: there is ONE op implementation
+(a pure jax function); "appending to the program" means recording the API
+call so ``Executor.run`` can replay the whole program inside a single
+``jax.jit`` — XLA then plays the role of the reference's Executor + pass
+pipeline.
+
+This module is deliberately tiny and dependency-free: the eager hot path pays
+exactly one global load + identity check (``CURRENT is None``) per API call.
+"""
+from __future__ import annotations
+
+# The Program currently recording, or None (eager mode). Set exclusively by
+# paddle_tpu.static.Program context managers.
+CURRENT = None
+
+# True while a recorded program is being replayed (inside jit / eval_shape):
+# replay runs the real op implementations on Tensors and must not re-record.
+REPLAYING = False
+
+
+def recording():
+    return CURRENT if not REPLAYING else None
+
+
+def has_variables(args, kwargs):
+    """Cheap scan: does any argument carry a static Variable?"""
+    from ..static.program import Variable
+
+    for a in args:
+        if isinstance(a, Variable):
+            return True
+        if type(a) in (list, tuple) and any(isinstance(x, Variable) for x in a):
+            return True
+    for a in kwargs.values():
+        if isinstance(a, Variable):
+            return True
+        if type(a) in (list, tuple) and any(isinstance(x, Variable) for x in a):
+            return True
+    return False
+
+
+def maybe_record(fn, args, kwargs):
+    """Called by wrapped API functions. Returns (handled, result)."""
+    prog = recording()
+    if prog is None:
+        return False, None
+    if not has_variables(args, kwargs):
+        return False, None
+    return True, prog.record_call(fn, args, kwargs)
+
+
+def static_aware(fn):
+    """Wrap a public op so that, while a Program is recording and any arg is
+    a static Variable, the call is recorded instead of executed.  The eager
+    hot path pays one global identity check."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if CURRENT is not None and not REPLAYING:
+            handled, out = maybe_record(fn, args, kwargs)
+            if handled:
+                return out
+        return fn(*args, **kwargs)
+
+    wrapper.__wrapped_op__ = fn
+    return wrapper

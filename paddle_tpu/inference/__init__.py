@@ -108,7 +108,9 @@ def save_inference_model(path_prefix: str, fn_or_layer, example_inputs,
         f.write(blob)
     manifest = {
         "format": "stablehlo-jax-export-v1",
-        "inputs": [{"name": f"x{i}", "shape": list(s.shape),
+        "inputs": [{"name": f"x{i}",
+                    "shape": [d if isinstance(d, int) else -1
+                              for d in s.shape],  # -1: symbolic (poly) dim
                     "dtype": np.dtype(s.dtype).name}
                    for i, s in enumerate(specs)],
     }

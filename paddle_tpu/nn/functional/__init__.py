@@ -1050,3 +1050,25 @@ def diag_embed(x, offset=0, dim1=-2, dim2=-1):
         return out
 
     return dispatch(fn, x, op_name="diag_embed")
+
+
+# ---------------------------------------------------------------------------
+# static-graph duality: wrap every public op so calls on static Variables
+# record into the active Program (core/static_mode.py) — one implementation
+# serves dygraph, jit, and Program/Executor modes.
+# ---------------------------------------------------------------------------
+def _wrap_for_static():
+    import sys as _sys
+    import types as _types
+
+    from ...core.static_mode import static_aware as _sa
+
+    mod = _sys.modules[__name__]
+    for name in list(vars(mod)):
+        f = getattr(mod, name)
+        if (isinstance(f, _types.FunctionType) and not name.startswith("_")
+                and f.__module__ == __name__):
+            setattr(mod, name, _sa(f))
+
+
+_wrap_for_static()

@@ -139,6 +139,13 @@ class Optimizer:
     minimize_step = step
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..static.program import Variable as _StaticVar
+        from ..static.program import register_static_minimize
+
+        if isinstance(loss, _StaticVar):
+            # static mode: Executor.run fuses loss+grads+this update into one
+            # XLA program (reference appends grad/update OpDescs instead)
+            return register_static_minimize(self, loss)
         loss.backward()
         self.step()
         return [], []
@@ -201,32 +208,38 @@ class Momentum(Optimizer):
 class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
-                 multi_precision=False, name=None):
+                 multi_precision=False, state_dtype=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        # m/v storage dtype.  fp32 is the default (reference adam kernel keeps
+        # fp32 moments); bf16 halves optimizer HBM — the knob that lets
+        # GPT-1.3B + AdamW fit one 16 GB v5e chip.  Update math is always fp32.
+        self._state_dtype = jnp.float32 if state_dtype is None else jnp.dtype(state_dtype)
 
     def _init_leaf(self, p):
-        return (jnp.zeros_like(p, dtype=jnp.float32), jnp.zeros_like(p, dtype=jnp.float32))
+        return (jnp.zeros_like(p, dtype=self._state_dtype),
+                jnp.zeros_like(p, dtype=self._state_dtype))
 
     def _update_leaf(self, g, p, state, lr, step):
         m, v = state
         g32 = g.astype(jnp.float32)
         b1, b2 = self._beta1, self._beta2
-        m2 = b1 * m + (1 - b1) * g32
-        v2 = b2 * v + (1 - b2) * g32 * g32
+        m2 = b1 * m.astype(jnp.float32) + (1 - b1) * g32
+        v2 = b2 * v.astype(jnp.float32) + (1 - b2) * g32 * g32
         t = jnp.asarray(step, jnp.float32)
         mhat = m2 / (1 - b1**t)
         vhat = v2 / (1 - b2**t)
         upd = lr * mhat / (jnp.sqrt(vhat) + self._eps)
-        return (p.astype(jnp.float32) - upd).astype(p.dtype), (m2, v2)
+        sd = self._state_dtype
+        return (p.astype(jnp.float32) - upd).astype(p.dtype), (m2.astype(sd), v2.astype(sd))
 
 
 class AdamW(Adam):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None,
-                 grad_clip=None, multi_precision=False, name=None):
+                 grad_clip=None, multi_precision=False, state_dtype=None, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         weight_decay, grad_clip, name=name)
+                         weight_decay, grad_clip, state_dtype=state_dtype, name=name)
         self._decoupled_wd = True
         self._apply_decay_fun = apply_decay_param_fun
 

@@ -1,0 +1,75 @@
+"""paddle.static — the static-graph (Program/Executor) surface.
+
+Reference capability: python/paddle/static/__init__.py (Program, Executor,
+program_guard, data, InputSpec, append_backward, save/load_inference_model,
+nn.* layer functions).  TPU-first architecture: a Program *records* the
+public API calls made while it is active and Executor *replays* them inside
+one jax.jit — XLA is the executor/pass-pipeline (see static/program.py).
+"""
+from __future__ import annotations
+
+from . import nn  # noqa: F401
+from .io import (deserialize_persistables, deserialize_program,  # noqa: F401
+                 load, load_from_file, load_inference_model,
+                 load_program_state, normalize_program, save,
+                 save_inference_model, save_to_file,
+                 serialize_persistables, serialize_program,
+                 set_program_state)
+from .program import (Executor, InputSpec, Print, Program,  # noqa: F401
+                      Scope, Variable, append_backward, create_global_var,
+                      create_parameter, data, default_main_program,
+                      default_startup_program, global_scope, gradients,
+                      name_scope, program_guard, scope_guard)
+
+__all__ = [
+    "Program", "Executor", "program_guard", "default_main_program",
+    "default_startup_program", "data", "InputSpec", "Variable", "Scope",
+    "global_scope", "scope_guard", "append_backward", "gradients",
+    "create_parameter", "create_global_var", "name_scope", "Print", "nn",
+    "save_inference_model", "load_inference_model", "save", "load",
+    "serialize_persistables", "deserialize_persistables",
+    "serialize_program", "deserialize_program", "save_to_file",
+    "load_from_file", "normalize_program", "load_program_state",
+    "set_program_state", "cpu_places", "device_guard",
+]
+
+
+def cpu_places(device_count=None):
+    from ..core.place import CPUPlace
+
+    n = device_count or 1
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    return cpu_places(len(device_ids) if device_ids else 1)
+
+
+class device_guard:
+    """Device placement hint — meaningless under single-program XLA
+    compilation (sharding annotations play this role); kept for parity."""
+
+    def __init__(self, device=None):
+        self.device = device
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+# attach the tensor method/dunder surface to Variable so symbolic handles
+# compose exactly like Tensors (x + y, x.matmul(w), x[0], x.mean() …)
+def _attach_variable_methods():
+    from .. import tensor_api as T
+
+    for name, fn in T._METHODS.items():
+        if not hasattr(Variable, name):
+            setattr(Variable, name, fn)
+    for name, fn in T._DUNDERS.items():
+        setattr(Variable, name, fn)  # __hash__ stays identity (defined)
+    Variable.pow = T.pow_
+
+
+_attach_variable_methods()

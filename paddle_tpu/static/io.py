@@ -1,0 +1,193 @@
+"""paddle.static model persistence.
+
+Reference capability: python/paddle/static/io.py save_inference_model /
+load_inference_model (+ serialize/deserialize program & persistables,
+python/paddle/fluid/io.py:1246/:1840/:1948).  TPU-first: the "program" is
+compiled — the saved artifact is the StableHLO export produced by
+paddle_tpu.inference (same format paddle_tpu.jit.save writes), with params
+baked as constants the way the reference's save_inference_model freezes
+persistables into the serialized program.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import static_mode
+from ..core.tensor import Tensor
+from .program import Executor, Program, Variable, default_main_program
+
+
+def _program_forward_fn(prog: Program, feed_vars, fetch_vars):
+    """Pure fn(feed arrays...) → fetch arrays, with params closed over."""
+    from ..framework import random as _random
+
+    from .program import slice_ops
+
+    feed_vids = [v.vid for v in feed_vars]
+    fetch_vids = [v.vid for v in fetch_vars]
+    params = {n: p.value for n, p in prog.parameters.items()}
+    # prune to the fetch targets' ancestors (reference fluid/io.py:1246 —
+    # the inference program drops loss/label ops)
+    ops = slice_ops(prog, fetch_vids)
+
+    def fn(*feeds):
+        env = dict(zip(feed_vids, feeds))
+        prev = static_mode.REPLAYING
+        static_mode.REPLAYING = True
+        try:
+            with _random.rng_scope(jax.random.PRNGKey(0)):
+                for op in ops:
+                    op.replay(env, params)
+        finally:
+            static_mode.REPLAYING = prev
+        return tuple(env[v] for v in fetch_vids)
+
+    return fn
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Freeze + export a static program for serving.
+    Reference static/io.py save_inference_model."""
+    from .. import inference
+
+    if isinstance(feed_vars, Variable):
+        feed_vars = [feed_vars]
+    if isinstance(fetch_vars, Variable):
+        fetch_vars = [fetch_vars]
+    prog = program if program is not None else (
+        feed_vars[0]._program._root() if feed_vars[0]._program
+        else default_main_program())
+    fn = _program_forward_fn(prog, feed_vars, fetch_vars)
+    # dynamic dims (data(..., [None, …])) export shape-polymorphic: the
+    # served artifact accepts any batch, like the reference's -1 dims
+    examples = []
+    for i, v in enumerate(feed_vars):
+        if any(s < 0 for s in v.shape):
+            dims = ", ".join(f"d{i}_{j}" if s < 0 else str(s)
+                             for j, s in enumerate(v.shape))
+            shape = jax.export.symbolic_shape(dims)
+            examples.append(jax.ShapeDtypeStruct(tuple(shape), v.dtype))
+        else:
+            examples.append(jnp.zeros(tuple(v.shape), v.dtype))
+    inference.save_inference_model(path_prefix, fn, tuple(examples))
+    with open(path_prefix + ".static.json", "w") as f:
+        json.dump({"feed_names": [v.name for v in feed_vars],
+                   "fetch_names": [v.name for v in fetch_vars]}, f)
+    return path_prefix
+
+
+class _LoadedProgram:
+    """Runnable handle returned by load_inference_model; Executor.run
+    dispatches to it (the TranslatedLayer-for-static analog)."""
+
+    def __init__(self, predictor, feed_names, fetch_names):
+        self._predictor = predictor
+        self.feed_target_names = feed_names
+        self.fetch_targets = fetch_names
+
+    def _executor_run(self, feed, fetch_list, return_numpy=True):
+        p = self._predictor
+        names = p.get_input_names()
+        for n in names:
+            val = feed[n] if n in (feed or {}) else None
+            if val is None:  # positional fallback
+                val = list(feed.values())[list(names).index(n)]
+            p.get_input_handle(n).copy_from_cpu(np.asarray(val))
+        p.run()
+        outs = [p.get_output_handle(n).copy_to_cpu()
+                for n in p.get_output_names()]
+        return [np.asarray(o) for o in outs] if return_numpy else outs
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Returns [program, feed_target_names, fetch_targets] like the
+    reference (executor.py load_inference_model)."""
+    from .. import inference
+
+    cfg = inference.Config(path_prefix)
+    predictor = inference.create_predictor(cfg)
+    meta_path = path_prefix + ".static.json"
+    feed_names = list(predictor.get_input_names())
+    fetch_names = list(predictor.get_output_names())
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+        feed_names = meta["feed_names"]
+        fetch_names = meta["fetch_names"]
+    loaded = _LoadedProgram(predictor, feed_names, fetch_names)
+    return [loaded, loaded.feed_target_names, loaded.fetch_targets]
+
+
+# -- persistables / program (de)serialization --------------------------------
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None,
+                           program=None) -> bytes:
+    prog = program or default_main_program()
+    return pickle.dumps({n: np.asarray(p.value)
+                         for n, p in prog.parameters.items()})
+
+
+def deserialize_persistables(program, data: bytes, executor=None):
+    state = pickle.loads(data)
+    for n, arr in state.items():
+        if n in program.parameters:
+            program.parameters[n]._value = jnp.asarray(arr)
+
+
+def serialize_program(feed_vars, fetch_vars, program=None) -> bytes:
+    prog = program or default_main_program()
+    return pickle.dumps({"n_ops": len(prog.ops),
+                         "inputs": [n for n, _ in prog.inputs],
+                         "params": {n: (tuple(p.shape), str(p.value.dtype))
+                                    for n, p in prog.parameters.items()}})
+
+
+def deserialize_program(data: bytes):
+    return pickle.loads(data)
+
+
+def save_to_file(path, content: bytes):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def normalize_program(program, feed_vars, fetch_vars):
+    return program
+
+
+def save(program, model_path, **kwargs):
+    """paddle.static.save — params + opt-ish state to <path>.pdparams."""
+    state = {n: np.asarray(p.value) for n, p in program.parameters.items()}
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(state, f)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    with open(model_path + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    for n, arr in state.items():
+        if n in program.parameters:
+            program.parameters[n]._value = jnp.asarray(arr)
+
+
+def load_program_state(model_path, var_list=None):
+    with open(model_path + ".pdparams", "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state):
+    for n, arr in state.items():
+        if n in program.parameters:
+            program.parameters[n]._value = jnp.asarray(arr)

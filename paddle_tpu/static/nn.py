@@ -1,0 +1,473 @@
+"""paddle.static.nn — program-building layer functions + control flow.
+
+Reference capability: python/paddle/static/nn/__init__.py (fc, conv2d,
+batch_norm, embedding, cond, while_loop, case, switch_case, sequence_* …) —
+each appends OpDescs + creates persistable parameter VarDescs.  TPU-first:
+the layer functions here just *compose the real functional ops on symbolic
+Variables* — recording happens automatically in the wrapped public API
+(core/static_mode.py), so there is exactly one implementation of every op.
+Parameters are created via ``create_parameter`` (initialization recorded into
+the startup program).  Control flow records sub-programs replayed as
+``lax.cond`` / ``lax.while_loop`` closures — the compiler-friendly analog of
+the reference's conditional_block/while ops
+(/root/reference/paddle/fluid/operators/controlflow/).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import static_mode
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+from .program import (Variable, _VarRef, _require_prog, create_parameter,
+                      data)
+
+__all__ = [
+    "fc", "embedding", "sparse_embedding", "conv2d", "conv2d_transpose",
+    "conv3d", "batch_norm", "layer_norm", "instance_norm", "group_norm",
+    "prelu", "data_norm", "cond", "case", "switch_case", "while_loop",
+    "py_func", "sequence_pool", "sequence_softmax", "sequence_first_step",
+    "sequence_last_step", "sequence_pad", "sequence_unpad",
+    "sequence_reverse", "sequence_expand", "sequence_mask",
+]
+
+
+def _act(y, activation):
+    if not activation:
+        return y
+    from ..nn import functional as F
+
+    return getattr(F, activation)(y)
+
+
+def _static_dim(v, i, what):
+    s = v.shape[i]
+    if s < 0:
+        raise ValueError(f"{what} needs a static dim {i}; got {list(v.shape)}")
+    return int(s)
+
+
+# ---------------------------------------------------------------------------
+# layer functions (reference static/nn/common.py fc:86 …)
+# ---------------------------------------------------------------------------
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    import paddle_tpu as P
+
+    in_dim = 1
+    for i in range(num_flatten_dims, len(x.shape)):
+        in_dim *= _static_dim(x, i, "fc input")
+    w = create_parameter([in_dim, size], x.dtype, name=name and name + ".w")
+    xf = P.reshape(x, [-1, in_dim]) if len(x.shape) > num_flatten_dims + 1 \
+        else x
+    y = P.matmul(xf, w)
+    if bias_attr is not False:
+        b = create_parameter([size], x.dtype, is_bias=True,
+                             name=name and name + ".b")
+        y = y + b
+    if len(x.shape) > num_flatten_dims + 1:
+        lead = [-1 if s < 0 else s for s in x.shape[:num_flatten_dims]]
+        y = P.reshape(y, lead + [size])
+    return _act(y, activation)
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32", name=None):
+    from ..nn import functional as F
+
+    w = create_parameter(list(size), dtype, name=name and name + ".w")
+    return F.embedding(input, w, padding_idx=padding_idx, sparse=is_sparse)
+
+
+def sparse_embedding(input, size, padding_idx=None, param_attr=None,
+                     dtype="float32", name=None):
+    return embedding(input, size, is_sparse=True, padding_idx=padding_idx,
+                     dtype=dtype, name=name)
+
+
+def _pair(v, n=2):
+    return list(v) if isinstance(v, (list, tuple)) else [v] * n
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCHW", name=None):
+    from ..nn import functional as F
+
+    k = _pair(filter_size)
+    cin = _static_dim(input, 1 if data_format == "NCHW" else -1, "conv2d")
+    w = create_parameter([num_filters, cin // groups, k[0], k[1]],
+                         input.dtype, name=name and name + ".w")
+    b = None
+    if bias_attr is not False:
+        b = create_parameter([num_filters], input.dtype, is_bias=True,
+                             name=name and name + ".b")
+    y = F.conv2d(input, w, b, stride=stride, padding=padding,
+                 dilation=dilation, groups=groups, data_format=data_format)
+    return _act(y, act)
+
+
+def conv2d_transpose(input, num_filters, filter_size, stride=1, padding=0,
+                     dilation=1, groups=1, param_attr=None, bias_attr=None,
+                     act=None, data_format="NCHW", name=None):
+    from ..nn import functional as F
+
+    k = _pair(filter_size)
+    cin = _static_dim(input, 1 if data_format == "NCHW" else -1,
+                      "conv2d_transpose")
+    w = create_parameter([cin, num_filters // groups, k[0], k[1]],
+                         input.dtype, name=name and name + ".w")
+    b = None
+    if bias_attr is not False:
+        b = create_parameter([num_filters], input.dtype, is_bias=True,
+                             name=name and name + ".b")
+    y = F.conv2d_transpose(input, w, b, stride=stride, padding=padding,
+                           dilation=dilation, groups=groups,
+                           data_format=data_format)
+    return _act(y, act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCDHW", name=None):
+    from ..nn import functional as F
+
+    k = _pair(filter_size, 3)
+    cin = _static_dim(input, 1 if data_format == "NCDHW" else -1, "conv3d")
+    w = create_parameter([num_filters, cin // groups, k[0], k[1], k[2]],
+                         input.dtype, name=name and name + ".w")
+    b = None
+    if bias_attr is not False:
+        b = create_parameter([num_filters], input.dtype, is_bias=True,
+                             name=name and name + ".b")
+    y = F.conv3d(input, w, b, stride=stride, padding=padding,
+                 dilation=dilation, groups=groups, data_format=data_format)
+    return _act(y, act)
+
+
+def _bn_infer_impl(x, mean, var, scale, bias, momentum, eps, caxis):
+    """Test-mode twin of _bn_train_impl (same signature/outputs so
+    Program.clone(for_test=True) can swap fn pointers): normalizes with the
+    running stats and passes them through unchanged."""
+    xv, mv, vv = x.value, mean.value, var.value
+    shape = [1] * xv.ndim
+    shape[caxis] = -1
+    xn = (xv - mv.reshape(shape).astype(xv.dtype)) * jax.lax.rsqrt(
+        vv.reshape(shape).astype(jnp.float32) + eps).astype(xv.dtype)
+    out = xn * scale.value.reshape(shape) + bias.value.reshape(shape)
+    return Tensor(out), Tensor(mv), Tensor(vv)
+
+
+def _bn_train_impl(x, mean, var, scale, bias, momentum, eps, caxis):
+    """Batch-stat normalization returning (out, new_mean, new_var) so the
+    running stats become write-back outputs of the program (the reference
+    batch_norm op updates MomentumTensor in place)."""
+    xv, mv, vv = x.value, mean.value, var.value
+    axes = tuple(i for i in range(xv.ndim) if i != caxis)
+    bm = jnp.mean(xv.astype(jnp.float32), axis=axes)
+    bv = jnp.var(xv.astype(jnp.float32), axis=axes)
+    shape = [1] * xv.ndim
+    shape[caxis] = -1
+    xn = (xv - bm.reshape(shape).astype(xv.dtype)) * jax.lax.rsqrt(
+        bv.reshape(shape).astype(jnp.float32) + eps).astype(xv.dtype)
+    out = xn * scale.value.reshape(shape) + bias.value.reshape(shape)
+    new_mean = momentum * mv + (1 - momentum) * bm.astype(mv.dtype)
+    new_var = momentum * vv + (1 - momentum) * bv.astype(vv.dtype)
+    return Tensor(out), Tensor(new_mean), Tensor(new_var)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_format="NCHW",
+               use_global_stats=False, name=None):
+    from ..nn import functional as F
+    from ..nn import initializer as I
+
+    caxis = 1 if data_format.startswith("NC") else input.ndim - 1
+    C = _static_dim(input, caxis, "batch_norm")
+    pre = name or "bn"
+    scale = create_parameter([C], input.dtype, name=f"{pre}.w_{id(input)}",
+                             default_initializer=I.Constant(1.0))
+    bias = create_parameter([C], input.dtype, is_bias=True,
+                            name=f"{pre}.b_{id(input)}")
+    mean = create_parameter([C], input.dtype, name=f"{pre}.mean_{id(input)}",
+                            default_initializer=I.Constant(0.0))
+    var = create_parameter([C], input.dtype, name=f"{pre}.var_{id(input)}",
+                           default_initializer=I.Constant(1.0))
+    mean.trainable = False
+    var.trainable = False
+    if is_test or use_global_stats:
+        y = F.batch_norm(input, mean, var, scale, bias, training=False,
+                         momentum=momentum, epsilon=epsilon,
+                         data_format=data_format)
+        return _act(y, act)
+    prog = _require_prog()
+    out, new_mean, new_var = prog.record_call(
+        _bn_train_impl, (input, mean, var, scale, bias, momentum, epsilon,
+                         caxis), {})
+    root = prog._root()
+    root.writebacks.append((mean.name, _VarRef(new_mean.vid)))
+    root.writebacks.append((var.name, _VarRef(new_var.vid)))
+    root._version += 1
+    return _act(out, act)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    from ..nn import functional as F
+    from ..nn import initializer as I
+
+    norm_shape = [_static_dim(input, i, "layer_norm")
+                  for i in range(begin_norm_axis, input.ndim)]
+    n = int(np.prod(norm_shape))
+    w = create_parameter([n], input.dtype, default_initializer=I.Constant(1.0)
+                         ) if scale else None
+    b = create_parameter([n], input.dtype, is_bias=True) if shift else None
+    import paddle_tpu as P
+
+    flat = P.reshape(input, [-1 if s < 0 else s
+                             for s in input.shape[:begin_norm_axis]] + [n]) \
+        if len(norm_shape) > 1 else input
+    y = F.layer_norm(flat, n, w, b, epsilon=epsilon)
+    if len(norm_shape) > 1:
+        y = P.reshape(y, [-1 if s < 0 else s for s in input.shape])
+    return _act(y, act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    from ..nn import functional as F
+    from ..nn import initializer as I
+
+    C = _static_dim(input, 1, "instance_norm")
+    w = create_parameter([C], input.dtype,
+                         default_initializer=I.Constant(1.0))
+    b = create_parameter([C], input.dtype, is_bias=True)
+    return F.instance_norm(input, weight=w, bias=b, eps=epsilon)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_format="NCHW", name=None):
+    from ..nn import functional as F
+    from ..nn import initializer as I
+
+    C = _static_dim(input, 1 if data_format == "NCHW" else -1, "group_norm")
+    w = create_parameter([C], input.dtype,
+                         default_initializer=I.Constant(1.0))
+    b = create_parameter([C], input.dtype, is_bias=True)
+    y = F.group_norm(input, groups, w, b, epsilon=epsilon,
+                     data_format=data_format)
+    return _act(y, act)
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    from ..nn import functional as F
+    from ..nn import initializer as I
+
+    if mode == "all":
+        shape = [1]
+    elif mode == "channel":
+        shape = [_static_dim(x, 1, "prelu")]
+    else:  # element
+        shape = [int(s) for s in x.shape[1:]]
+    w = create_parameter(shape, x.dtype,
+                         default_initializer=I.Constant(0.25))
+    return F.prelu(x, w)
+
+
+def data_norm(input, epsilon=1e-5, param_attr=None, name=None):
+    """Simplified data_norm: learned per-feature scale from accumulated
+    statistics — here expressed as affine normalization parameters."""
+    from ..nn import initializer as I
+
+    C = _static_dim(input, input.ndim - 1, "data_norm")
+    mean = create_parameter([C], input.dtype,
+                            default_initializer=I.Constant(0.0))
+    scale = create_parameter([C], input.dtype,
+                             default_initializer=I.Constant(1.0))
+    return (input - mean) * scale
+
+
+# ---------------------------------------------------------------------------
+# control flow (reference operators/controlflow/, static/nn cond:66
+# while_loop:84 case:65 switch_case:83)
+# ---------------------------------------------------------------------------
+
+def _flatten_branch_out(out):
+    leaves = jax.tree_util.tree_flatten(
+        out, is_leaf=lambda x: isinstance(x, (Variable, Tensor)))[0]
+    tree = jax.tree_util.tree_structure(
+        out, is_leaf=lambda x: isinstance(x, (Variable, Tensor)))
+    return leaves, tree
+
+
+def _leaf_aval(leaf, prog):
+    if isinstance(leaf, Variable):
+        return leaf.aval
+    if isinstance(leaf, Tensor):
+        return jax.ShapeDtypeStruct(tuple(leaf.value.shape),
+                                    np.dtype(leaf.value.dtype))
+    a = jnp.asarray(leaf)
+    return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+
+def _trace_branch(prog, fn, args=()):
+    sub = prog.subprogram()
+    prev = static_mode.CURRENT
+    static_mode.CURRENT = sub
+    try:
+        out = fn(*args)
+    finally:
+        static_mode.CURRENT = prev
+    leaves, tree = _flatten_branch_out(out)
+    sub.out_refs = [_VarRef(v.vid) if isinstance(v, Variable)
+                    else (v if isinstance(v, Tensor) else Tensor(jnp.asarray(v)))
+                    for v in leaves]
+    avals = [_leaf_aval(v, prog) for v in leaves]
+    return sub, avals, tree
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """Tensor-predicate conditional → lax.cond at replay (differentiable,
+    both branches traced — the XLA-native semantics; the reference runs one
+    conditional_block). Branch callables take no arguments."""
+    prog = _require_prog()._root()
+    t_sub, t_avals, t_tree = _trace_branch(prog, true_fn or (lambda: ()))
+    f_sub, f_avals, f_tree = _trace_branch(prog, false_fn or (lambda: ()))
+    if [tuple(a.shape) for a in t_avals] != [tuple(a.shape) for a in f_avals]:
+        raise ValueError(
+            f"cond branches must return matching shapes; got "
+            f"{[a.shape for a in t_avals]} vs {[a.shape for a in f_avals]}")
+    outs = prog.record_cond(pred, t_sub, f_sub, t_avals)
+    return jax.tree_util.tree_unflatten(t_tree, outs)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """Right-fold into nested cond (lax.cond chains — XLA flattens)."""
+    if not pred_fn_pairs:
+        raise ValueError("case needs at least one (pred, fn) pair")
+    pairs = list(pred_fn_pairs)
+    tail = default if default is not None else pairs[-1][1]
+    if default is None:
+        pairs = pairs[:-1]
+        if not pairs:
+            return tail()
+
+    def build(i):
+        if i == len(pairs):
+            return tail
+        p, f = pairs[i]
+        return lambda: cond(p, f, build(i + 1))
+
+    return build(0)()
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Integer-indexed dispatch (reference switch_case). Implemented as a
+    case over equality predicates."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = list(enumerate(branch_fns))
+    pairs = [(branch_index == k, fn) for k, fn in items]
+    return case(pairs, default=default if default is not None
+                else items[-1][1])
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """Reference static/nn while_loop — body/cond are functions of the loop
+    vars; replays as lax.while_loop.  Forward-only (XLA's while is not
+    reverse-differentiable); use lax.scan-style fixed-trip loops for
+    differentiable recurrence (nn.layer.rnn does)."""
+    prog = _require_prog()._root()
+    flat_lv, tree = _flatten_branch_out(list(loop_vars))
+    carries = []
+    for leaf in flat_lv:
+        a = _leaf_aval(leaf, prog)
+        v = Variable(a.shape, a.dtype, program=prog)
+        prog.variables[v.vid] = v
+        carries.append(v)
+    carry_struct = jax.tree_util.tree_unflatten(tree, carries)
+
+    c_sub, c_avals, _ = _trace_branch(prog, cond_fn, tuple(carry_struct))
+    if len(c_avals) != 1:
+        raise ValueError("while_loop cond must return a single boolean")
+    b_sub, b_avals, b_tree = _trace_branch(prog, body_fn,
+                                           tuple(carry_struct))
+    if [tuple(a.shape) for a in b_avals] != \
+            [tuple(_leaf_aval(l, prog).shape) for l in flat_lv]:
+        raise ValueError("while_loop body must return values shaped like "
+                         "loop_vars")
+    outs = prog.record_while(flat_lv, [c.vid for c in carries], c_sub, b_sub,
+                             b_avals)
+    return jax.tree_util.tree_unflatten(b_tree, outs)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-callback escape hatch (reference layers/nn.py py_func) via
+    jax.pure_callback; forward-only unless backward_func given (ignored —
+    XLA cannot differentiate a host callback)."""
+    prog = _require_prog()._root()
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    out_spec = out if isinstance(out, (list, tuple)) else [out]
+
+    def impl(*ts):
+        avals = [jax.ShapeDtypeStruct(tuple(o.shape), np.dtype(o.dtype))
+                 for o in out_spec]
+
+        def host(*arrs):
+            r = func(*arrs)
+            r = r if isinstance(r, (list, tuple)) else [r]
+            return tuple(np.asarray(a) for a in r)
+
+        res = jax.pure_callback(host, tuple(avals),
+                                *[t.value for t in ts])
+        return tuple(Tensor(r) for r in res)
+
+    outs = prog.record_call(impl, tuple(xs), {})
+    return outs if isinstance(out, (list, tuple)) else outs[0]
+
+
+# ---------------------------------------------------------------------------
+# sequence ops — ragged batches as (values, lengths); reference
+# operators/sequence_ops/ over LoD tensors (paddle_tpu.ops.sequence docs)
+# ---------------------------------------------------------------------------
+
+def _seq(name):
+    from .. import ops as _ops
+
+    fn = getattr(_ops.sequence, name)
+
+    def wrapper(*args, **kwargs):
+        prog = static_mode.recording()
+        if prog is not None and static_mode.has_variables(args, kwargs):
+            def impl(*a, **k):
+                vals = [x.value if isinstance(x, Tensor) else x for x in a]
+                out = fn(*vals, **k)
+                if isinstance(out, tuple):
+                    return tuple(Tensor(o) for o in out)
+                return Tensor(out)
+            return prog.record_call(impl, args, kwargs)
+        vals = [x.value if isinstance(x, Tensor) else x for x in args]
+        out = fn(*vals, **kwargs)
+        return tuple(Tensor(o) for o in out) if isinstance(out, tuple) \
+            else Tensor(out)
+
+    wrapper.__name__ = name
+    return wrapper
+
+
+sequence_pool = _seq("sequence_pool")
+sequence_softmax = _seq("sequence_softmax")
+sequence_first_step = _seq("sequence_first_step")
+sequence_last_step = _seq("sequence_last_step")
+sequence_pad = _seq("sequence_pad")
+sequence_unpad = _seq("sequence_unpad")
+sequence_reverse = _seq("sequence_reverse")
+sequence_expand = _seq("sequence_expand")
+sequence_mask = _seq("sequence_mask")

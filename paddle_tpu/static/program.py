@@ -1,0 +1,975 @@
+"""Static graph Program — recorded API calls replayed inside one jit.
+
+Reference capability: ``Program``/``Block``/``Operator``/``Variable``
+(/root/reference/python/paddle/fluid/framework.py:4016/:2521/:1920/:804) +
+``Executor`` (/root/reference/python/paddle/fluid/executor.py:475) +
+``append_backward`` (/root/reference/python/paddle/fluid/backward.py:1369).
+
+TPU-first design: the reference appends protobuf OpDescs and interprets them
+op-by-op in C++ (executor.cc:292).  Here a Program records the *Python API
+calls* made between ``program_guard`` (each public paddle_tpu op checks one
+global — core/static_mode.py) and ``Executor.run`` replays the whole recorded
+program on Tensors inside a single ``jax.jit``: XLA is the executor, the pass
+pipeline, and the kernel scheduler all at once.  Backward is not a graph
+rewrite (backward.py:1369 appends grad OpDescs); it is ``jax.value_and_grad``
+over the replayed program — same math, zero duplicated machinery.
+
+Parameters are ordinary eager ``Parameter`` tensors (the scope/persistables
+store); feeds bind ``data`` Variables; fetches read any recorded Variable,
+including ``param@GRAD`` Variables created by ``append_backward``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import weakref
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import static_mode
+from ..core.dtype import convert_dtype, get_default_dtype
+from ..core.tensor import Parameter, Tensor
+from ..framework import random as _random
+
+__all__ = [
+    "Variable", "Program", "program_guard", "default_main_program",
+    "default_startup_program", "data", "InputSpec", "Executor",
+    "global_scope", "scope_guard", "Scope", "append_backward", "gradients",
+    "name_scope", "create_parameter", "create_global_var", "Print",
+]
+
+
+# ---------------------------------------------------------------------------
+# symbolic variable
+# ---------------------------------------------------------------------------
+
+_COUNTER = [0]
+
+
+def _next_id() -> int:
+    _COUNTER[0] += 1
+    return _COUNTER[0]
+
+
+_ALL_PROGRAMS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+class Variable:
+    """Symbolic handle to a value produced inside a Program.
+
+    The tensor method/dunder surface is attached by ``paddle_tpu.static``
+    (same functions as Tensor methods — they record when handed a Variable).
+    """
+
+    __slots__ = ("vid", "shape", "dtype", "name", "stop_gradient",
+                 "persistable", "_program")
+
+    def __init__(self, shape, dtype, name=None, program=None,
+                 stop_gradient=False):
+        self.vid = _next_id()
+        self.shape = tuple(-1 if s in (None, -1) else int(s) for s in shape)
+        self.dtype = np.dtype(dtype)
+        self.name = name or f"var_{self.vid}"
+        self.stop_gradient = stop_gradient
+        self.persistable = False
+        self._program = program
+
+    @property
+    def aval(self):
+        """Abstract value used for build-time shape inference; unknown dims
+        (-1) become 1 — real shapes come from feeds at run time."""
+        return jax.ShapeDtypeStruct(
+            tuple(1 if s == -1 else s for s in self.shape), self.dtype)
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def astype(self, dtype):  # recorded like any other op
+        return _record_api(_cast_impl, (self, dtype), {})
+
+    def __getitem__(self, key):
+        if static_mode.has_variables((key,) if not isinstance(key, tuple)
+                                     else key, {}):
+            raise TypeError(
+                "static Variable indices must be static (ints/slices); use "
+                "paddle.gather / index_select for tensor-valued indices")
+        return _record_api(_getitem_impl, (self, key), {})
+
+    def __len__(self):
+        s = self.shape[0]
+        if s < 0:
+            raise TypeError("len() of a Variable with dynamic dim 0")
+        return s
+
+    def __repr__(self):
+        return (f"Variable(name={self.name!r}, shape={list(self.shape)}, "
+                f"dtype={self.dtype.name})")
+
+    def __hash__(self):  # identity hash — __eq__ records an elementwise op
+        return id(self)
+
+
+def _getitem_impl(x, key):
+    return x[key]
+
+
+def _cast_impl(x, dtype):
+    return x.cast(dtype) if hasattr(x, "cast") else Tensor(
+        x.value.astype(convert_dtype(dtype)))
+
+
+@dataclasses.dataclass
+class InputSpec:
+    """Reference: python/paddle/static/input.py InputSpec."""
+    shape: Sequence[int]
+    dtype: Any = "float32"
+    name: str | None = None
+
+    @classmethod
+    def from_tensor(cls, t, name=None):
+        return cls(tuple(t.shape), t.dtype, name)
+
+
+# ---------------------------------------------------------------------------
+# recorded ops
+# ---------------------------------------------------------------------------
+
+class _VarRef:
+    __slots__ = ("vid",)
+
+    def __init__(self, vid):
+        self.vid = vid
+
+
+class _ParamRef:
+    __slots__ = ("name",)
+
+    def __init__(self, name):
+        self.name = name
+
+
+def _encode(obj, prog: "Program"):
+    if isinstance(obj, Variable):
+        return _VarRef(obj.vid)
+    if isinstance(obj, Parameter):
+        prog._root().register_parameter(obj)
+        return _ParamRef(obj.name)
+    if isinstance(obj, Tensor):
+        return obj  # concrete constant, closed over at replay
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_encode(o, prog) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _encode(v, prog) for k, v in obj.items()}
+    return obj
+
+
+def _decode(obj, env, params):
+    if isinstance(obj, _VarRef):
+        return Tensor(env[obj.vid], stop_gradient=False)
+    if isinstance(obj, _ParamRef):
+        t = Tensor(params[obj.name], stop_gradient=False)
+        t.name = obj.name
+        return t
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_decode(o, env, params) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _decode(v, env, params) for k, v in obj.items()}
+    return obj
+
+
+def _enc_avals(obj, prog):
+    """ShapeDtypeStructs for every ref inside an encoded arg tree."""
+    if isinstance(obj, _VarRef):
+        return prog.find_var_by_id(obj.vid).aval
+    if isinstance(obj, _ParamRef):
+        p = prog._root().parameters[obj.name]
+        return jax.ShapeDtypeStruct(tuple(p.shape), np.dtype(p.value.dtype))
+    if isinstance(obj, Tensor):
+        return jax.ShapeDtypeStruct(tuple(obj.value.shape),
+                                    np.dtype(obj.value.dtype))
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_enc_avals(o, prog) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _enc_avals(v, prog) for k, v in obj.items()}
+    return obj
+
+
+def _bind_outputs(out, prog):
+    """Wrap an op's (Tensor|tuple|list) output into fresh Variables."""
+    if isinstance(out, (tuple, list)):
+        vs = type(out)(_bind_outputs(o, prog) for o in out)
+        return vs
+    if isinstance(out, Tensor):
+        v = Variable(out.value.shape, np.dtype(out.value.dtype), program=prog)
+        prog.variables[v.vid] = v
+        return v
+    return out  # passthrough (e.g. python scalar returned by an op)
+
+
+def _out_ids(bound):
+    if isinstance(bound, (tuple, list)):
+        return type(bound)(_out_ids(b) for b in bound)
+    if isinstance(bound, Variable):
+        return _VarRef(bound.vid)
+    return bound
+
+
+def _assign_outputs(ids, vals, env):
+    if isinstance(ids, (tuple, list)):
+        for i, v in zip(ids, vals):
+            _assign_outputs(i, v, env)
+    elif isinstance(ids, _VarRef):
+        env[ids.vid] = vals.value if isinstance(vals, Tensor) else vals
+
+
+class ApiOp:
+    __slots__ = ("fn", "args", "kwargs", "outs")
+
+    def __init__(self, fn, args, kwargs, outs):
+        self.fn, self.args, self.kwargs, self.outs = fn, args, kwargs, outs
+
+    def replay(self, env, params):
+        a = _decode(self.args, env, params)
+        k = _decode(self.kwargs, env, params)
+        out = self.fn(*a, **k)
+        _assign_outputs(self.outs, out, env)
+
+
+class CondOp:
+    """lax.cond over two recorded sub-programs (closures may read outer env)."""
+    __slots__ = ("pred", "true_sub", "false_sub", "outs")
+
+    def __init__(self, pred, true_sub, false_sub, outs):
+        self.pred, self.true_sub, self.false_sub = pred, true_sub, false_sub
+        self.outs = outs
+
+    def replay(self, env, params):
+        pred = _decode(self.pred, env, params).value.reshape(())
+
+        def branch(sub):
+            def f(_):
+                sub_env = dict(env)
+                vals = sub.replay_into(sub_env, params)
+                return tuple(v.value if isinstance(v, Tensor) else v
+                             for v in vals)
+            return f
+
+        outs = jax.lax.cond(pred.astype(bool), branch(self.true_sub),
+                            branch(self.false_sub), 0)
+        for ref, val in zip(self.outs, outs):
+            env[ref.vid] = val
+
+
+class WhileOp:
+    """lax.while_loop over recorded cond/body sub-programs."""
+    __slots__ = ("init", "carry_ids", "cond_sub", "body_sub", "outs")
+
+    def __init__(self, init, carry_ids, cond_sub, body_sub, outs):
+        self.init, self.carry_ids = init, carry_ids
+        self.cond_sub, self.body_sub, self.outs = cond_sub, body_sub, outs
+
+    def replay(self, env, params):
+        init = tuple(
+            (v.value if isinstance(v, Tensor) else v)
+            for v in (_decode(i, env, params) for i in self.init))
+
+        def run_sub(sub, vals):
+            sub_env = dict(env)
+            sub_env.update(zip(self.carry_ids, vals))
+            return sub.replay_into(sub_env, params)
+
+        def c(vals):
+            (pred,) = run_sub(self.cond_sub, vals)
+            return pred.value.reshape(()).astype(bool)
+
+        def b(vals):
+            outs = run_sub(self.body_sub, vals)
+            return tuple(o.value if isinstance(o, Tensor) else o
+                         for o in outs)
+
+        outs = jax.lax.while_loop(c, b, init)
+        for ref, val in zip(self.outs, outs):
+            env[ref.vid] = val
+
+
+class PrintOp:
+    __slots__ = ("ref", "message")
+
+    def __init__(self, ref, message):
+        self.ref, self.message = ref, message
+
+    def replay(self, env, params):
+        jax.debug.print(self.message + "{x}", x=env[self.ref.vid])
+
+
+# ---------------------------------------------------------------------------
+# Program
+# ---------------------------------------------------------------------------
+
+class SubProgram:
+    """Ops recorded inside a control-flow branch/body; replays against a
+    chained environment so reads of outer Variables resolve naturally."""
+
+    def __init__(self, root):
+        self.ops: list = []
+        self.root = root
+        self.out_refs: list = []
+
+    # recording interface (same as Program)
+    def record_call(self, fn, args, kwargs):
+        return self.root._record_into(self, fn, args, kwargs)
+
+    def _root(self):
+        return self.root
+
+    @property
+    def variables(self):
+        return self.root.variables
+
+    def find_var_by_id(self, vid):
+        return self.root.find_var_by_id(vid)
+
+    def replay_into(self, env, params):
+        for op in self.ops:
+            op.replay(env, params)
+        return [Tensor(env[r.vid]) if isinstance(r, _VarRef) else r
+                for r in self.out_refs]
+
+
+class Program:
+    """Recorded static program. Reference framework.py:4016."""
+
+    def __init__(self):
+        _ALL_PROGRAMS.add(self)
+        self.ops: list = []
+        self.variables: dict[int, Variable] = {}
+        self.inputs: list[tuple[str, int]] = []  # (feed name, vid)
+        self.parameters: dict[str, Parameter] = {}
+        self.initializers: list[Callable[[], None]] = []  # startup thunks
+        self.writebacks: list[tuple[str, _VarRef]] = []  # buffer updates
+        self.loss: Variable | None = None
+        self.grad_vars: dict[str, Variable] = {}
+        self.optimizer = None
+        self.opt_state = None
+        self.train_step_count = 0
+        self.random_seed = None
+        self._version = 0
+
+    # -- bookkeeping --------------------------------------------------------
+    def _root(self):
+        return self
+
+    def find_var_by_id(self, vid) -> Variable:
+        return self.variables[vid]
+
+    def register_parameter(self, p: Parameter):
+        if p.name is None:
+            p.name = f"param_{id(p)}"
+        self.parameters.setdefault(p.name, p)
+        self._version += 1
+
+    def global_block(self):
+        return self
+
+    def all_parameters(self):
+        return list(self.parameters.values())
+
+    def list_vars(self):
+        return list(self.variables.values())
+
+    def var(self, name):
+        for v in self.variables.values():
+            if v.name == name:
+                return v
+        raise KeyError(name)
+
+    def clone(self, for_test=False):
+        p = Program.__new__(Program)
+        p.__dict__ = dict(self.__dict__)
+        p.ops = list(self.ops)
+        p.variables = dict(self.variables)
+        p.inputs = list(self.inputs)
+        p.parameters = dict(self.parameters)
+        p.writebacks = list(self.writebacks)
+        p.initializers = list(self.initializers)
+        p.grad_vars = dict(self.grad_vars)
+        _ALL_PROGRAMS.add(p)
+        if for_test:
+            # reference Program.clone(for_test=True): train-only ops flip to
+            # inference semantics. Swap batch-norm batch-stat ops for their
+            # running-stat twins and drop the optimizer + stat write-backs.
+            from .nn import _bn_infer_impl, _bn_train_impl
+
+            p.ops = [ApiOp(_bn_infer_impl, op.args, op.kwargs, op.outs)
+                     if isinstance(op, ApiOp) and op.fn is _bn_train_impl
+                     else op for op in p.ops]
+            p.writebacks = []
+            p.optimizer = None
+            p.opt_state = None
+            p.loss = None
+            p.grad_vars = {}
+            p._version += 1
+        return p
+
+    # -- recording ----------------------------------------------------------
+    def record_call(self, fn, args, kwargs):
+        return self._record_into(self, fn, args, kwargs)
+
+    def _record_into(self, target, fn, args, kwargs):
+        enc_args = _encode(args, self)
+        enc_kwargs = _encode(kwargs, self)
+        # build-time shape inference: run the real op on abstract values
+        leaves, treedef = jax.tree_util.tree_flatten(
+            (enc_args, enc_kwargs),
+            is_leaf=lambda x: isinstance(x, (_VarRef, _ParamRef, Tensor)))
+        ref_pos = [i for i, l in enumerate(leaves)
+                   if isinstance(l, (_VarRef, _ParamRef, Tensor))]
+        avals_in = [_enc_avals(leaves[i], self) for i in ref_pos]
+
+        def infer(*vals):
+            lv = list(leaves)
+            for i, v in zip(ref_pos, vals):
+                lv[i] = Tensor(v)
+            a, k = jax.tree_util.tree_unflatten(treedef, lv)
+            out = fn(*a, **k)
+            return jax.tree_util.tree_map(
+                lambda o: o.value if isinstance(o, Tensor) else o, out,
+                is_leaf=lambda o: isinstance(o, Tensor))
+
+        prev = static_mode.REPLAYING
+        static_mode.REPLAYING = True
+        try:
+            out_aval = jax.eval_shape(infer, *avals_in)
+        finally:
+            static_mode.REPLAYING = prev
+
+        # bind outputs by mirroring the aval structure
+        bound = _bind_avals(out_aval, target)
+        target.ops.append(ApiOp(fn, enc_args, enc_kwargs, _out_ids(bound)))
+        self._version += 1
+        return bound
+
+    def record_cond(self, pred, true_sub, false_sub, out_avals):
+        outs = [Variable(a.shape, a.dtype, program=self) for a in out_avals]
+        for v in outs:
+            self.variables[v.vid] = v
+        self.ops.append(CondOp(_encode(pred, self), true_sub, false_sub,
+                               [_VarRef(v.vid) for v in outs]))
+        self._version += 1
+        return outs
+
+    def record_while(self, init_vars, carry_ids, cond_sub, body_sub,
+                     out_avals):
+        outs = [Variable(a.shape, a.dtype, program=self) for a in out_avals]
+        for v in outs:
+            self.variables[v.vid] = v
+        self.ops.append(WhileOp([_encode(v, self) for v in init_vars],
+                                carry_ids, cond_sub, body_sub,
+                                [_VarRef(v.vid) for v in outs]))
+        self._version += 1
+        return outs
+
+    def subprogram(self) -> SubProgram:
+        return SubProgram(self)
+
+    # -- replay -------------------------------------------------------------
+    def replay(self, env, params):
+        for op in self.ops:
+            op.replay(env, params)
+        return env
+
+    def __repr__(self):
+        return (f"Program(ops={len(self.ops)}, vars={len(self.variables)}, "
+                f"params={list(self.parameters)})")
+
+
+def _iter_refs(obj):
+    """Yield every _VarRef inside an encoded arg/output tree."""
+    if isinstance(obj, _VarRef):
+        yield obj
+    elif isinstance(obj, (list, tuple)):
+        for o in obj:
+            yield from _iter_refs(o)
+    elif isinstance(obj, dict):
+        for o in obj.values():
+            yield from _iter_refs(o)
+
+
+def _op_out_vids(op) -> set:
+    return {r.vid for r in _iter_refs(op.outs)} if hasattr(op, "outs") \
+        else set()
+
+
+def _op_in_vids(op) -> set:
+    vids: set = set()
+    if isinstance(op, ApiOp):
+        vids |= {r.vid for r in _iter_refs(op.args)}
+        vids |= {r.vid for r in _iter_refs(op.kwargs)}
+    elif isinstance(op, CondOp):
+        vids |= {r.vid for r in _iter_refs(op.pred)}
+        for sub in (op.true_sub, op.false_sub):
+            for sop in sub.ops:
+                vids |= _op_in_vids(sop)
+            vids |= {r.vid for r in _iter_refs(sub.out_refs)}
+    elif isinstance(op, WhileOp):
+        vids |= {r.vid for r in _iter_refs(op.init)}
+        for sub in (op.cond_sub, op.body_sub):
+            for sop in sub.ops:
+                vids |= _op_in_vids(sop)
+            vids |= {r.vid for r in _iter_refs(sub.out_refs)}
+    elif isinstance(op, PrintOp):
+        vids.add(op.ref.vid)
+    return vids
+
+
+def slice_ops(prog, fetch_vids):
+    """Backward slice: the ops actually needed to produce fetch_vids — the
+    reference's save_inference_model program pruning (fluid/io.py:1246)."""
+    needed = set(fetch_vids)
+    keep = []
+    for op in reversed(prog.ops):
+        if _op_out_vids(op) & needed or isinstance(op, PrintOp):
+            keep.append(op)
+            needed |= _op_in_vids(op)
+    return list(reversed(keep))
+
+
+def _bind_avals(out_aval, prog):
+    if isinstance(out_aval, (tuple, list)):
+        return type(out_aval)(_bind_avals(o, prog) for o in out_aval)
+    if hasattr(out_aval, "shape") and hasattr(out_aval, "dtype"):
+        v = Variable(out_aval.shape, out_aval.dtype, program=prog)
+        prog.variables[v.vid] = v
+        return v
+    return out_aval
+
+
+def _record_api(fn, args, kwargs):
+    prog = static_mode.recording()
+    if prog is None:
+        raise RuntimeError(
+            "static Variable used outside program_guard/static mode; call "
+            "paddle.enable_static() or build inside program_guard")
+    return prog.record_call(fn, args, kwargs)
+
+
+# ---------------------------------------------------------------------------
+# default programs + guards
+# ---------------------------------------------------------------------------
+
+_DEFAULT_MAIN = Program()
+_DEFAULT_STARTUP = Program()
+_tls = threading.local()
+
+
+def default_main_program() -> Program:
+    return getattr(_tls, "main", _DEFAULT_MAIN)
+
+
+def default_startup_program() -> Program:
+    return getattr(_tls, "startup", _DEFAULT_STARTUP)
+
+
+class program_guard:
+    """Reference framework.py program_guard — routes recording to the given
+    program and enables static recording for its extent."""
+
+    def __init__(self, main_program, startup_program=None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        self._prev = (getattr(_tls, "main", None),
+                      getattr(_tls, "startup", None),
+                      static_mode.CURRENT)
+        _tls.main = self.main
+        if self.startup is not None:
+            _tls.startup = self.startup
+        static_mode.CURRENT = self.main
+        return self.main
+
+    def __exit__(self, *exc):
+        pm, ps, pc = self._prev
+        if pm is None:
+            del _tls.main
+        else:
+            _tls.main = pm
+        if self.startup is not None:
+            if ps is None:
+                del _tls.startup
+            else:
+                _tls.startup = ps
+        static_mode.CURRENT = pc
+        return False
+
+
+class name_scope:
+    """Name prefix for created variables (cosmetic parity)."""
+
+    def __init__(self, prefix=""):
+        self.prefix = prefix
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def enable_static_recording():
+    static_mode.CURRENT = default_main_program()
+
+
+def disable_static_recording():
+    static_mode.CURRENT = None
+
+
+# ---------------------------------------------------------------------------
+# data / parameters
+# ---------------------------------------------------------------------------
+
+def data(name, shape, dtype=None, lod_level=0) -> Variable:
+    """Feed slot. Reference python/paddle/static/input.py data."""
+    prog = static_mode.recording() or default_main_program()
+    d = convert_dtype(dtype) or get_default_dtype()
+    v = Variable(shape, np.dtype(d), name=name, program=prog)
+    prog.variables[v.vid] = v
+    prog.inputs.append((name, v.vid))
+    prog._version += 1
+    return v
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Create a Parameter registered with the current main+startup programs.
+    The startup program owns initialization (run it once before training)."""
+    from ..nn import initializer as I
+
+    prog = static_mode.recording() or default_main_program()
+    root = prog._root()
+    startup = default_startup_program()
+    d = convert_dtype(dtype)
+    if default_initializer is None:
+        default_initializer = (I.Constant(0.0) if is_bias
+                               else I.XavierUniform())
+    if name is None:
+        name = f"w_{_next_id()}"
+    p = Parameter(jnp.zeros(tuple(int(s) for s in shape), d), name=name)
+    root.register_parameter(p)
+
+    def init_thunk(p=p, init=default_initializer,
+                   shape=tuple(int(s) for s in shape), d=d):
+        p._value = jnp.asarray(init(shape, d))
+
+    startup.initializers.append(init_thunk)
+    return p
+
+
+def create_global_var(shape, value, dtype, persistable=False, name=None):
+    from ..nn import initializer as I
+
+    return create_parameter(shape, dtype, name=name,
+                            default_initializer=I.Constant(float(value)))
+
+
+def Print(var, message=""):
+    prog = _require_prog()
+    prog.ops.append(PrintOp(_VarRef(var.vid), message))
+    return var
+
+
+def _require_prog() -> Program:
+    prog = static_mode.recording()
+    if prog is None:
+        raise RuntimeError("no static program is being built; use "
+                           "program_guard or paddle.enable_static()")
+    return prog
+
+
+# ---------------------------------------------------------------------------
+# backward / training config
+# ---------------------------------------------------------------------------
+
+def append_backward(loss, parameter_list=None, no_grad_set=None):
+    """Mark the program for gradient computation. Reference
+    backward.py:1369 — here backward is jax.value_and_grad at replay time,
+    so "appending" just creates the param@GRAD fetch handles."""
+    prog = loss._program._root() if loss._program else default_main_program()
+    prog.loss = loss
+    out = []
+    for name, p in prog.parameters.items():
+        if not getattr(p, "trainable", True):
+            continue
+        g = Variable(tuple(p.shape), np.dtype(p.value.dtype),
+                     name=f"{name}@GRAD", program=prog)
+        prog.variables[g.vid] = g
+        prog.grad_vars[name] = g
+        out.append((p, g))
+    prog._version += 1
+    return out
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    t = targets[0] if isinstance(targets, (list, tuple)) else targets
+    pairs = append_backward(t)
+    name_of = {id(p): g for p, g in pairs}
+    return [name_of.get(id(i)) for i in
+            (inputs if isinstance(inputs, (list, tuple)) else [inputs])]
+
+
+def register_static_minimize(optimizer, loss):
+    """Optimizer.minimize(static Variable) lands here."""
+    prog = loss._program._root() if loss._program else default_main_program()
+    if prog.loss is None or prog.loss is not loss:
+        append_backward(loss)
+    prog.optimizer = optimizer
+    prog.opt_state = None  # lazily initialized from param values
+    prog._version += 1
+    return [], []
+
+
+# ---------------------------------------------------------------------------
+# scope
+# ---------------------------------------------------------------------------
+
+class _ScopeVar:
+    def __init__(self, scope, name):
+        self._scope, self._name = scope, name
+
+    def get_tensor(self):
+        return np.asarray(self._scope._store[self._name])
+
+    def set(self, value, place=None):
+        self._scope._store[self._name] = jnp.asarray(value)
+
+
+class _ParamVar:
+    """Live view over a Parameter: set() reaches the real weight (the
+    reference's scope.find_var(name).get_tensor().set(arr) idiom)."""
+
+    def __init__(self, param):
+        self._param = param
+
+    def get_tensor(self):
+        return self
+
+    def set(self, value, place=None):
+        self._param._value = jnp.asarray(value)
+
+    def __array__(self, dtype=None, copy=None):
+        arr = np.asarray(self._param.value)
+        return arr.astype(dtype) if dtype is not None else arr
+
+
+class Scope:
+    """name → value store (reference framework/scope.h:52). Parameters live
+    on Program objects; this scope exposes them uniformly for tooling."""
+
+    def __init__(self):
+        self._store: dict[str, Any] = {}
+
+    def var(self, name):
+        self._store.setdefault(name, None)
+        return _ScopeVar(self, name)
+
+    def find_var(self, name):
+        for prog in list(_ALL_PROGRAMS):
+            if name in prog.parameters:
+                return _ParamVar(prog.parameters[name])
+        if name in self._store:
+            return _ScopeVar(self, name)
+        return None
+
+
+_GLOBAL_SCOPE = Scope()
+_scope_stack: list[Scope] = []
+
+
+def global_scope() -> Scope:
+    return _scope_stack[-1] if _scope_stack else _GLOBAL_SCOPE
+
+
+class scope_guard:
+    def __init__(self, scope):
+        self.scope = scope
+
+    def __enter__(self):
+        _scope_stack.append(self.scope)
+        return self.scope
+
+    def __exit__(self, *exc):
+        _scope_stack.pop()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Executor
+# ---------------------------------------------------------------------------
+
+class Executor:
+    """Compile-and-run a recorded Program. Reference executor.py:475.
+
+    The first run with a given (program version, feed signature, fetch set)
+    traces + compiles; later runs hit the jit cache.  Training programs
+    (optimizer.minimize called on a loss) run a full fused train step: loss,
+    grads, optimizer update and buffer write-backs in ONE XLA program —
+    matching what jit/TrainStep does for dygraph."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: dict = {}
+
+    def close(self):
+        self._cache.clear()
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True, **kw):
+        prog = program if program is not None else default_main_program()
+        if hasattr(prog, "_executor_run"):  # loaded inference program
+            return prog._executor_run(feed, fetch_list, return_numpy)
+        feed = dict(feed or {})
+        fetch_list = list(fetch_list or [])
+
+        # startup program: run initializer thunks eagerly
+        if not prog.ops and prog.initializers and not fetch_list:
+            for thunk in prog.initializers:
+                thunk()
+            return []
+
+        fetch_refs = []
+        for f in fetch_list:
+            if isinstance(f, str):
+                f = prog.var(f)
+            fetch_refs.append(f)
+
+        train = prog.optimizer is not None
+        feed_names = sorted(feed)
+        feed_vals = {n: jnp.asarray(np.asarray(feed[n])) for n in feed_names}
+        sig = (id(prog), prog._version, train,
+               tuple((n, feed_vals[n].shape, str(feed_vals[n].dtype))
+                     for n in feed_names),
+               tuple(v.vid for v in fetch_refs))
+        fn = self._cache.get(sig)
+        if fn is None:
+            fn = self._build(prog, feed_names, fetch_refs, train)
+            self._cache[sig] = fn
+
+        params = {n: p.value for n, p in prog.parameters.items()
+                  if getattr(p, "trainable", True)}
+        frozen = {n: p.value for n, p in prog.parameters.items()
+                  if not getattr(p, "trainable", True)}
+        if train and prog.opt_state is None:
+            prog.opt_state = prog.optimizer.init_state(params)
+        prog.train_step_count += 1
+        key = jax.random.PRNGKey(prog.train_step_count
+                                 if prog.random_seed is None
+                                 else prog.random_seed)
+        if train:
+            new_params, new_state, wb, fetches = fn(
+                params, prog.opt_state, frozen, feed_vals, key,
+                jnp.asarray(prog.train_step_count, jnp.int32),
+                jnp.asarray(prog.optimizer.get_lr(), jnp.float32))
+            prog.opt_state = new_state
+            for n in new_params:
+                prog.parameters[n]._value = new_params[n]
+        else:
+            wb, fetches = fn(params, frozen, feed_vals, key)
+        for (pname, _), val in zip(prog.writebacks, wb):
+            prog.parameters[pname]._value = val
+        if return_numpy:
+            fetches = [np.asarray(f) for f in fetches]
+        return fetches
+
+    # -- compile ------------------------------------------------------------
+    def _build(self, prog: Program, feed_names, fetch_refs, train):
+        loss_vid = prog.loss.vid if prog.loss is not None else None
+        grad_vids = {g.vid: pname for pname, g in prog.grad_vars.items()}
+        fetch_vids = [v.vid for v in fetch_refs]
+        writeback_refs = list(prog.writebacks)
+        input_vids = dict(prog.inputs)
+        need_grads = train or any(v in grad_vids for v in fetch_vids)
+        if need_grads:
+            ops = list(prog.ops)  # loss path: full program
+        else:  # forward-only: prune to fetch + write-back ancestors
+            ops = slice_ops(prog, set(fetch_vids)
+                            | {r.vid for _, r in writeback_refs})
+
+        def forward(params, frozen, feed_vals, key):
+            params = {**params, **frozen}
+            env: dict[int, Any] = {}
+            for name, vid in input_vids.items():
+                if name in feed_vals:
+                    env[vid] = feed_vals[name]
+            prev = static_mode.REPLAYING
+            static_mode.REPLAYING = True
+            try:
+                with _random.rng_scope(key):
+                    for op in ops:
+                        op.replay(env, params)
+            finally:
+                static_mode.REPLAYING = prev
+            return env
+
+        def collect(env):
+            wb = [env[r.vid] for _, r in writeback_refs]
+            fetches = []
+            for vid in fetch_vids:
+                if vid in env:
+                    fetches.append(env[vid])
+                else:
+                    fetches.append(None)  # grad var — filled by caller
+            return wb, fetches
+
+        if not need_grads:
+
+            @jax.jit
+            def infer_fn(params, frozen, feed_vals, key):
+                env = forward(params, frozen, feed_vals, key)
+                return collect(env)
+
+            return infer_fn
+
+        if loss_vid is None:
+            raise ValueError(
+                "fetching @GRAD variables requires append_backward(loss) "
+                "on this program first")
+
+        # loss/grad path (train or fetch of @GRAD vars)
+        def loss_and_env(params, frozen, feed_vals, key):
+            env = forward(params, frozen, feed_vals, key)
+            return env[loss_vid].astype(jnp.float32).mean(), env
+
+        if not train:
+
+            @jax.jit
+            def grad_fn(params, frozen, feed_vals, key):
+                (loss, env), grads = jax.value_and_grad(
+                    loss_and_env, has_aux=True)(params, frozen, feed_vals,
+                                                key)
+                wb, fetches = collect(env)
+                fetches = [grads[grad_vids[vid]]
+                           if f is None and vid in grad_vids else f
+                           for f, vid in zip(fetches, fetch_vids)]
+                return wb, fetches
+
+            return grad_fn
+
+        opt = prog.optimizer
+
+        @jax.jit
+        def train_fn(params, opt_state, frozen, feed_vals, key, step, lr):
+            (loss, env), grads = jax.value_and_grad(
+                loss_and_env, has_aux=True)(params, frozen, feed_vals, key)
+            new_params, new_state = opt.apply_gradients(
+                grads, params, opt_state, lr=lr, step=step)
+            wb, fetches = collect(env)
+            fetches = [grads[grad_vids[vid]]
+                       if f is None and vid in grad_vids else f
+                       for f, vid in zip(fetches, fetch_vids)]
+            return new_params, new_state, wb, fetches
+
+        return train_fn

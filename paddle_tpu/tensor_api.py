@@ -22,15 +22,23 @@ import numpy as np
 from .core.dispatch import dispatch
 from .core.dtype import convert_dtype, get_default_dtype
 from .core.place import current_jax_device
+from .core.static_mode import static_aware
 from .core.tensor import Tensor, to_tensor
 from .framework import random as _random
 
 __all__: list = []
 
 
+_NEVER_RECORD = {"is_tensor", "to_tensor"}  # python-level predicates
+
+
 def _public(fn):
     __all__.append(fn.__name__)
-    return fn
+    if fn.__name__ in _NEVER_RECORD:
+        return fn
+    # static-graph duality: while a Program records (paddle.static), calls
+    # with Variable args append to the program instead of executing
+    return static_aware(fn)
 
 
 def _v(x):
@@ -252,7 +260,7 @@ def _binary(name, fn):
 
     op.__name__ = name
     __all__.append(name)
-    return op
+    return static_aware(op)
 
 
 add = _binary("add", jnp.add)
@@ -278,7 +286,7 @@ def _unary(name, fn):
 
     op.__name__ = name
     __all__.append(name)
-    return op
+    return static_aware(op)
 
 
 abs = _unary("abs", jnp.abs)  # noqa: A001
@@ -1015,7 +1023,7 @@ def _cmp(name, fn):
 
     op.__name__ = name
     __all__.append(name)
-    return op
+    return static_aware(op)
 
 
 equal = _cmp("equal", jnp.equal)

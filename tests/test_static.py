@@ -1,0 +1,292 @@
+"""paddle.static Program/Executor — recorded-replay static graph mode.
+
+Mirrors the reference's static-graph unit tests (test_program.py,
+test_executor_*, test_cond.py, test_while_loop_op.py in
+python/paddle/fluid/tests/unittests/): build with program_guard, run with
+Executor, train with optimizer.minimize, control flow via static.nn.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+def _fresh_programs():
+    return static.Program(), static.Program()
+
+
+def test_data_fc_forward():
+    main, startup = _fresh_programs()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 4], "float32")
+        y = static.nn.fc(x, 3)
+    assert y.shape[-1] == 3
+    exe = static.Executor()
+    exe.run(startup)
+    out, = exe.run(main, feed={"x": np.ones((5, 4), np.float32)},
+                   fetch_list=[y])
+    assert out.shape == (5, 3)
+
+
+def test_startup_initializes_params():
+    main, startup = _fresh_programs()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 8], "float32")
+        y = static.nn.fc(x, 16)
+    exe = static.Executor()
+    exe.run(startup)
+    w = next(p for n, p in main.parameters.items() if "w" in n or p.ndim == 2)
+    assert float(np.abs(np.asarray(w.value)).sum()) > 0  # xavier, not zeros
+
+
+def test_variable_methods_and_dunders():
+    main, startup = _fresh_programs()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 4], "float32")
+        y = (x * 2.0 + 1.0).mean()
+        z = paddle.sum(x, axis=-1)
+    exe = static.Executor()
+    xv = np.arange(8, dtype=np.float32).reshape(2, 4)
+    out_y, out_z = exe.run(main, feed={"x": xv}, fetch_list=[y, z])
+    np.testing.assert_allclose(out_y, (xv * 2 + 1).mean(), rtol=1e-6)
+    np.testing.assert_allclose(out_z, xv.sum(-1), rtol=1e-6)
+
+
+def test_static_training_linear_regression():
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((64, 4)).astype(np.float32)
+    true_w = np.array([[1.5], [-2.0], [0.5], [3.0]], np.float32)
+    Y = X @ true_w
+
+    main, startup = _fresh_programs()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 4], "float32")
+        label = static.data("y", [None, 1], "float32")
+        pred = static.nn.fc(x, 1, bias_attr=False)
+        loss = paddle.mean((pred - label) ** 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    losses = []
+    for _ in range(60):
+        lv, = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < 0.01, losses[-5:]
+    assert losses[-1] < losses[0] / 20
+
+
+def test_append_backward_grad_fetch():
+    main, startup = _fresh_programs()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 3], "float32")
+        w_pairs = None
+        y = static.nn.fc(x, 1, bias_attr=False)
+        loss = paddle.mean(y * y)
+        w_pairs = static.append_backward(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    p, g = w_pairs[0]
+    xv = np.ones((4, 3), np.float32)
+    gv, = exe.run(main, feed={"x": xv}, fetch_list=[g])
+    assert gv.shape == tuple(p.shape)
+    # numeric check: d/dw mean((xw)^2) = 2/N * x^T (x w)
+    w = np.asarray(p.value)
+    expect = 2 * xv.T @ (xv @ w) / 4
+    np.testing.assert_allclose(gv, expect, rtol=1e-4)
+
+
+def test_cond_both_branches():
+    main, startup = _fresh_programs()
+    with static.program_guard(main, startup):
+        a = static.data("a", [1], "float32")
+        r = static.nn.cond(a.sum() > 0.0,
+                           lambda: a * 2.0,
+                           lambda: a - 10.0)
+    exe = static.Executor()
+    pos, = exe.run(main, feed={"a": np.array([3.0], np.float32)},
+                   fetch_list=[r])
+    neg, = exe.run(main, feed={"a": np.array([-3.0], np.float32)},
+                   fetch_list=[r])
+    np.testing.assert_allclose(pos, [6.0])
+    np.testing.assert_allclose(neg, [-13.0])
+
+
+def test_cond_gradient_flows():
+    main, startup = _fresh_programs()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 2], "float32")
+        y = static.nn.fc(x, 1, bias_attr=False)
+        r = static.nn.cond(y.sum() > 0.0, lambda: y * 3.0, lambda: y * 5.0)
+        loss = paddle.mean(r)
+        opt = paddle.optimizer.SGD(learning_rate=0.05)
+        opt.minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    lv, = exe.run(main, feed={"x": np.ones((2, 2), np.float32)},
+                  fetch_list=[loss])
+    assert np.isfinite(lv)
+
+
+def test_while_loop_sum():
+    main, startup = _fresh_programs()
+    with static.program_guard(main, startup):
+        i = paddle.zeros([1], "int32")
+        acc = paddle.zeros([1], "float32")
+        # loop vars seeded from constants (Tensors) — carried as lax state
+        iv, accv = static.nn.while_loop(
+            lambda i, a: i < 10,
+            lambda i, a: [i + 1, a + 2.0],
+            [i, acc])
+    exe = static.Executor()
+    out_i, out_a = exe.run(main, feed={}, fetch_list=[iv, accv])
+    assert int(out_i[0]) == 10
+    np.testing.assert_allclose(out_a, [20.0])
+
+
+def test_case_and_switch_case():
+    main, startup = _fresh_programs()
+    with static.program_guard(main, startup):
+        k = static.data("k", [1], "int32")
+        r = static.nn.switch_case(
+            k.sum(),
+            {0: lambda: paddle.full([1], 10.0),
+             1: lambda: paddle.full([1], 20.0)},
+            default=lambda: paddle.full([1], -1.0))
+    exe = static.Executor()
+    for kv, expect in [(0, 10.0), (1, 20.0), (7, -1.0)]:
+        out, = exe.run(main, feed={"k": np.array([kv], np.int32)},
+                       fetch_list=[r])
+        np.testing.assert_allclose(out, [expect])
+
+
+def test_batch_norm_writeback_updates_running_stats():
+    main, startup = _fresh_programs()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 3, 4, 4], "float32")
+        y = static.nn.batch_norm(x, is_test=False, momentum=0.5)
+        loss = paddle.mean(y)
+        opt = paddle.optimizer.SGD(learning_rate=0.01)
+        opt.minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    mean_p = next(p for n, p in main.parameters.items() if ".mean" in n)
+    before = np.asarray(mean_p.value).copy()
+    rng = np.random.default_rng(0)
+    xv = (rng.standard_normal((8, 3, 4, 4)) * 2 + 5).astype(np.float32)
+    exe.run(main, feed={"x": xv}, fetch_list=[loss])
+    after = np.asarray(mean_p.value)
+    assert not np.allclose(before, after)
+    # momentum 0.5 pulls running mean halfway toward ~5
+    assert after.mean() > 1.0
+
+
+def test_save_load_inference_model(tmp_path):
+    main, startup = _fresh_programs()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 4], "float32")
+        y = static.nn.fc(x, 2)
+    exe = static.Executor()
+    exe.run(startup)
+    xv = np.ones((3, 4), np.float32)
+    expect, = exe.run(main, feed={"x": xv}, fetch_list=[y])
+
+    prefix = str(tmp_path / "model")
+    static.save_inference_model(prefix, [x], [y], exe, program=main)
+    prog, feeds, fetches = static.load_inference_model(prefix, exe)
+    got = exe.run(prog, feed={feeds[0]: xv}, fetch_list=fetches)
+    np.testing.assert_allclose(got[0], expect, rtol=1e-5)
+
+
+def test_nn_layer_in_static_mode():
+    """nn.Layer objects compose in static mode: their Parameters become
+    program parameters (the reference's Layer dual-mode capability)."""
+    main, startup = _fresh_programs()
+    lin = paddle.nn.Linear(6, 2)
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 6], "float32")
+        y = lin(x)
+        loss = paddle.mean(y ** 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+    exe = static.Executor()
+    w_before = np.asarray(lin.weight.value).copy()
+    for _ in range(3):
+        exe.run(main, feed={"x": np.ones((4, 6), np.float32)},
+                fetch_list=[loss])
+    assert not np.allclose(w_before, np.asarray(lin.weight.value))
+
+
+def test_program_guard_isolation():
+    main, startup = _fresh_programs()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 2], "float32")
+        _ = x + 1.0
+    assert len(main.ops) == 1
+    # outside the guard, eager works untouched
+    t = paddle.ones([2, 2]) + 1.0
+    np.testing.assert_allclose(np.asarray(t.value), 2 * np.ones((2, 2)))
+
+
+def test_enable_disable_static():
+    paddle.enable_static()
+    try:
+        assert not paddle.in_dynamic_mode()
+        x = static.data("xs", [None, 2], "float32")
+        y = x * 3.0
+        exe = static.Executor()
+        out, = exe.run(feed={"xs": np.ones((2, 2), np.float32)},
+                       fetch_list=[y])
+        np.testing.assert_allclose(out, 3 * np.ones((2, 2)))
+    finally:
+        paddle.disable_static()
+    assert paddle.in_dynamic_mode()
+
+
+def test_clone_for_test_uses_running_stats():
+    main, startup = _fresh_programs()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 2, 4, 4], "float32")
+        y = static.nn.batch_norm(x, is_test=False, momentum=0.0)
+        loss = paddle.mean(y * y)
+        opt = paddle.optimizer.SGD(learning_rate=0.0)
+        opt.minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    rng = np.random.default_rng(1)
+    xv = (rng.standard_normal((16, 2, 4, 4)) * 3 + 7).astype(np.float32)
+    exe.run(main, feed={"x": xv}, fetch_list=[loss])  # writes running stats
+    test_prog = main.clone(for_test=True)
+    # test program needs no label/optimizer and normalizes with running stats
+    out, = exe.run(test_prog, feed={"x": xv}, fetch_list=[y])
+    mean_p = next(p for n, p in main.parameters.items() if ".mean" in n)
+    var_p = next(p for n, p in main.parameters.items() if ".var" in n)
+    m = np.asarray(mean_p.value).reshape(1, -1, 1, 1)
+    v = np.asarray(var_p.value).reshape(1, -1, 1, 1)
+    scale_p = next(p for n, p in main.parameters.items() if ".w" in n)
+    bias_p = next(p for n, p in main.parameters.items() if ".b" in n)
+    expect = ((xv - m) / np.sqrt(v + 1e-5)
+              * np.asarray(scale_p.value).reshape(1, -1, 1, 1)
+              + np.asarray(bias_p.value).reshape(1, -1, 1, 1))
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-4)
+
+
+def test_frozen_param_not_trained_and_scope_set_reaches_weight():
+    main, startup = _fresh_programs()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 3], "float32")
+        w = static.create_parameter([3, 1], "float32")
+        w.trainable = False
+        loss = paddle.mean(paddle.matmul(x, w) ** 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.5)
+        opt.minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    before = np.asarray(w.value).copy()
+    exe.run(main, feed={"x": np.ones((2, 3), np.float32)},
+            fetch_list=[loss])
+    np.testing.assert_array_equal(before, np.asarray(w.value))
+    sv = static.global_scope().find_var(w.name)
+    sv.get_tensor().set(np.zeros((3, 1), np.float32))
+    assert np.allclose(np.asarray(w.value), 0.0)
