@@ -109,6 +109,13 @@ if not _LIGHT_IMPORT:
     )
 
     from . import static  # noqa: F401
+    from . import incubate  # noqa: F401
+    from . import callbacks  # noqa: F401
+    from . import device  # noqa: F401
+    from . import distribution  # noqa: F401
+    from . import hub  # noqa: F401
+    from . import regularizer  # noqa: F401
+    from . import sysconfig  # noqa: F401
 
     def disable_static():
         """Leave Program-recording mode (back to dygraph)."""
@@ -128,11 +135,57 @@ if not _LIGHT_IMPORT:
 
         return static_mode.CURRENT is None
 
-    def is_compiled_with_cuda():  # TPU build: never CUDA
-        return False
+    from .device import (  # noqa: F401  (single definition in device.py)
+        CUDAPinnedPlace, NPUPlace, XPUPlace, get_cudnn_version,
+        is_compiled_with_cuda, is_compiled_with_npu, is_compiled_with_rocm,
+        is_compiled_with_xpu)
 
     def ones_like(x, dtype=None):  # re-export convenience
         return _tensor_api.ones_like(x, dtype)
+
+    # dygraph-era aliases (reference fluid/framework.py)
+    VarBase = Tensor
+    import numpy as _np
+
+    dtype = _np.dtype  # paddle.dtype('float32') etc.
+    from .nn.layer_base import ParamAttr  # noqa: F401
+    from .hapi.model import flops  # noqa: F401
+    from .static.program import create_parameter  # noqa: F401
+
+    def enable_dygraph(place=None):
+        disable_static()
+
+    def disable_dygraph():
+        enable_static()
+
+    def in_dygraph_mode():
+        return in_dynamic_mode()
+
+    def batch(reader, batch_size, drop_last=False):
+        """reference paddle.batch: wrap a sample reader into a batch reader."""
+        def batch_reader():
+            buf = []
+            for sample in reader():
+                buf.append(sample)
+                if len(buf) == batch_size:
+                    yield buf
+                    buf = []
+            if buf and not drop_last:
+                yield buf
+
+        return batch_reader
+
+    def get_cuda_rng_state():  # no CUDA generator on TPU builds
+        return []
+
+    def set_cuda_rng_state(state):
+        return None
+
+    def monkey_patch_math_varbase():  # method attachment happens at import
+        return None
+
+    def monkey_patch_variable():
+        return None
 
 
 # distributed is imported lazily to keep plain single-chip import light (and

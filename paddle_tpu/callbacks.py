@@ -1,0 +1,11 @@
+"""paddle.callbacks — hapi training callbacks.
+
+Reference: python/paddle/callbacks.py re-exporting hapi/callbacks.py.
+"""
+from .hapi.callbacks import (  # noqa: F401
+    Callback, EarlyStopping, LRSchedulerCallback, ModelCheckpoint,
+    ProgBarLogger)
+
+LRScheduler = LRSchedulerCallback  # reference name
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
+           "LRScheduler"]

@@ -1,0 +1,77 @@
+"""Row-sparse gradients — the SelectedRows capability.
+
+Reference: framework/selected_rows.h (rows + value block instead of a dense
+tensor; produced by lookup_table's sparse grad, consumed by sgd/adam
+``lazy_mode`` row-wise update kernels, and by the PS sparse push).
+
+TPU-first: a tiny host-side carrier ``RowSparseGrad`` flows only at the
+EAGER tape boundary (leaf ``Parameter.grad``); inside jit everything stays
+dense because XLA fuses the scatter anyway.  Duck-typing: ``__jax_array__``
+densifies on demand, so any tensor math on a sparse grad silently promotes
+to dense — only the optimizers' row-wise fast paths keep it sparse.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class RowSparseGrad:
+    """rows [N] int32 + values [N, ...] laid against dense_shape."""
+
+    __slots__ = ("rows", "values", "dense_shape")
+
+    def __init__(self, rows, values, dense_shape):
+        self.rows = jnp.asarray(rows).reshape(-1)
+        self.values = jnp.asarray(values)
+        self.dense_shape = tuple(int(s) for s in dense_shape)
+
+    # -- duck-typed array surface -------------------------------------------
+    @property
+    def shape(self):
+        return self.dense_shape
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def ndim(self):
+        return len(self.dense_shape)
+
+    def __jax_array__(self):
+        return self.to_dense()
+
+    def __array__(self, dtype=None, copy=None):
+        arr = np.asarray(self.to_dense())
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def __repr__(self):
+        return (f"RowSparseGrad(nnz_rows={self.rows.shape[0]}, "
+                f"dense_shape={self.dense_shape})")
+
+    # -- ops ----------------------------------------------------------------
+    def to_dense(self):
+        out = jnp.zeros(self.dense_shape, self.values.dtype)
+        return out.at[self.rows].add(self.values)
+
+    def merged(self) -> "RowSparseGrad":
+        """Sum duplicate row ids (the reference's merge_add before sparse
+        kernels).  Eager-only (concrete shapes), so plain jnp.unique."""
+        uniq, inv = jnp.unique(self.rows, return_inverse=True)
+        summed = jnp.zeros((uniq.shape[0],) + self.values.shape[1:],
+                           self.values.dtype).at[inv].add(self.values)
+        return RowSparseGrad(uniq, summed, self.dense_shape)
+
+    def add(self, other):
+        if isinstance(other, RowSparseGrad):
+            return RowSparseGrad(
+                jnp.concatenate([self.rows, other.rows]),
+                jnp.concatenate([self.values, other.values]),
+                self.dense_shape)
+        return self.to_dense() + jnp.asarray(other)
+
+
+def is_sparse_grad(g) -> bool:
+    return isinstance(g, RowSparseGrad)

@@ -151,8 +151,15 @@ class Tensor:
         autograd.backward([self], [grad_tensor], retain_graph=retain_graph)
 
     def _accumulate_grad(self, g):
+        from .selected_rows import RowSparseGrad
+
         if self.grad is None:
             self.grad = Tensor(g, stop_gradient=True)
+        elif isinstance(self.grad._value, RowSparseGrad):
+            self.grad = Tensor(self.grad._value.add(g), stop_gradient=True)
+        elif isinstance(g, RowSparseGrad):
+            self.grad = Tensor(jnp.asarray(self.grad._value)
+                               + g.to_dense(), stop_gradient=True)
         else:
             self.grad = Tensor(self.grad._value + g, stop_gradient=True)
 

@@ -1,0 +1,47 @@
+"""paddle.device — device management facade.
+
+Reference: python/paddle/device.py (set_device/get_device/
+is_compiled_with_* over the Place stack, platform/place.h:150).
+"""
+from .core.place import (  # noqa: F401
+    CPUPlace, CUDAPlace, Place, TPUPlace, device_count, get_device,
+    is_compiled_with_tpu, set_device)
+
+__all__ = ["set_device", "get_device", "device_count", "Place", "CPUPlace",
+           "TPUPlace", "CUDAPlace", "is_compiled_with_tpu",
+           "is_compiled_with_cuda", "is_compiled_with_xpu",
+           "is_compiled_with_npu", "is_compiled_with_rocm", "XPUPlace",
+           "NPUPlace", "CUDAPinnedPlace", "get_cudnn_version"]
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_npu():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def XPUPlace(idx: int = 0):
+    raise NotImplementedError("TPU build has no XPU backend; use TPUPlace")
+
+
+def NPUPlace(idx: int = 0):
+    raise NotImplementedError("TPU build has no NPU backend; use TPUPlace")
+
+
+def CUDAPinnedPlace():
+    raise NotImplementedError("TPU build has no CUDA pinned memory; "
+                              "host staging is PJRT-managed")
+
+
+def get_cudnn_version():
+    return None

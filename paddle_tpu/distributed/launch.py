@@ -244,9 +244,14 @@ def launch(args) -> int:
         np_min, np_max = (int(v) for v in args.elastic_np.split(":"))
         if client is None:
             client = KVClient(coord_host, coord_port)
+        # TTL must leave slack for scheduler stalls on loaded hosts: a
+        # heartbeat thread starved past the TTL reads as a dead peer and
+        # triggers a spurious relaunch (env-tunable for tests/CI)
+        hb = float(os.environ.get("PADDLE_ELASTIC_HEARTBEAT", "0.2"))
+        ttl = float(os.environ.get("PADDLE_ELASTIC_TTL", "2.0"))
         elastic = ElasticManager(client, host_id=f"node{args.node_rank}",
                                  np_range=(np_min, np_max),
-                                 heartbeat_interval=0.2, ttl=2.0)
+                                 heartbeat_interval=hb, ttl=ttl)
         elastic.register()
         if args.nnodes > 1:
             # wait for every expected peer's first heartbeat before

@@ -32,6 +32,8 @@ class DataParallel(Layer):
         super().__init__()
         self._layers = layers
         self._sync_enabled = True
+        self._find_unused = find_unused_parameters
+        self._comm_buffer_bytes = int(comm_buffer_size * 1024 * 1024)
 
     def forward(self, *inputs, **kwargs):
         if has_mesh() and get_mesh().shape.get("dp", 1) > 1:
@@ -63,19 +65,24 @@ class DataParallel(Layer):
         self.sync_gradients()
 
     def sync_gradients(self):
-        """Average grads over the dp axis (Reducer's fused allreduce).  With
-        batch-sharded SPMD execution grads arrive already summed; this is for
-        the per-device eager path."""
-        if not self._sync_enabled or not has_mesh():
+        """The Reducer's job (imperative/reducer.cc): bucketed grad
+        allreduce + unused-parameter handling.
+
+        Under single-controller SPMD the allreduce half is subsumed: grads
+        of a dp-sharded batch arrive globally reduced (XLA inserted — and
+        bucketed/overlapped — the collectives during backward), so no
+        explicit communication remains to issue here.  What does remain is
+        the unused-parameter walk: params untouched by this backward get
+        zero grads so optimizer accumulator updates stay rank-consistent
+        (the reference marks them via a graph walk so its allreduce doesn't
+        hang; ours would silently skip the optimizer update instead — same
+        divergence, same cure)."""
+        if not self._sync_enabled:
             return
-        mesh = get_mesh()
-        if mesh.shape.get("dp", 1) <= 1:
-            return
-        for p in self._layers.parameters():
-            if p.grad is not None:
-                # replicated-sum: a psum over dp of the (global) grad array is
-                # an identity under single-controller; kept for API parity
-                pass
+        if self._find_unused:
+            for p in self._layers.parameters():
+                if p.grad is None and getattr(p, "trainable", True):
+                    p.grad = Tensor(jnp.zeros_like(p.value))
 
     # delegate everything else
     def __getattr__(self, name):

@@ -1,0 +1,163 @@
+"""Probability distributions.
+
+Reference capability: python/paddle/distribution.py — Distribution base
+(:41), Uniform (:168), Normal (:390), Categorical (:640) with
+sample/entropy/log_prob/probs/kl_divergence and numpy/Tensor broadcasting
+semantics.  TPU-first: sampling uses the framework PRNG stream
+(framework/random.py) so it is explicit-key pure under jit; all math is pure
+jnp and differentiable (reparameterized samples for Uniform/Normal — the
+reference samples via uniform_random/gaussian_random kernels).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core.tensor import Tensor
+from .framework import random as _random
+
+__all__ = ["Distribution", "Uniform", "Normal", "Categorical"]
+
+
+def _as_val(x, dtype=jnp.float32):
+    if isinstance(x, Tensor):
+        return x.value.astype(dtype)
+    return jnp.asarray(x, dtype)
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in np.asarray(shape.value)]
+    return [int(s) for s in shape]
+
+
+class Distribution:
+    """Base class (reference distribution.py:41)."""
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    """U(low, high) (reference distribution.py:168): log_prob/probs treat
+    out-of-support values with 0 density; sample is reparameterized."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _as_val(low)
+        self.high = _as_val(high)
+        self.name = name
+
+    def sample(self, shape=(), seed=0):
+        key = jax.random.PRNGKey(seed) if seed else _random.next_key()
+        shape = tuple(_shape_list(shape))
+        b = jnp.broadcast_shapes(self.low.shape, self.high.shape)
+        u = jax.random.uniform(key, shape + b, jnp.float32)
+        return Tensor(self.low + u * (self.high - self.low))
+
+    def log_prob(self, value):
+        v = _as_val(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+    def probs(self, value):
+        v = _as_val(value)
+        inside = (v >= self.low) & (v < self.high)
+        return Tensor(jnp.where(inside, 1.0 / (self.high - self.low), 0.0))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+
+class Normal(Distribution):
+    """N(loc, scale) (reference distribution.py:390)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_val(loc)
+        self.scale = _as_val(scale)
+        self.name = name
+
+    def sample(self, shape=(), seed=0):
+        key = jax.random.PRNGKey(seed) if seed else _random.next_key()
+        shape = tuple(_shape_list(shape))
+        b = jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        eps = jax.random.normal(key, shape + b, jnp.float32)
+        return Tensor(self.loc + eps * self.scale)
+
+    def entropy(self):
+        b = jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        scale = jnp.broadcast_to(self.scale, b)
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(scale))
+
+    def log_prob(self, value):
+        v = _as_val(value)
+        var = self.scale * self.scale
+        return Tensor(-((v - self.loc) ** 2) / (2 * var)
+                      - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def probs(self, value):
+        return Tensor(jnp.exp(self.log_prob(value).value))
+
+    def kl_divergence(self, other: "Normal"):
+        """KL(self || other) — reference distribution.py:595."""
+        var_ratio = (self.scale / other.scale) ** 2
+        t1 = ((self.loc - other.loc) / other.scale) ** 2
+        return Tensor(0.5 * (var_ratio + t1 - 1.0 - jnp.log(var_ratio)))
+
+
+class Categorical(Distribution):
+    """Categorical over unnormalized logits (reference distribution.py:640
+    — note the reference's `logits` are *unnormalized probabilities*, not
+    log-probabilities; we follow that semantics for parity)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _as_val(logits)
+        self.name = name
+
+    @property
+    def _p(self):
+        z = jnp.maximum(self.logits, 0.0) + 1e-30  # ref: prob ∝ logits
+        return z / z.sum(-1, keepdims=True)
+
+    def sample(self, shape=()):
+        shape = tuple(_shape_list(shape))
+        key = _random.next_key()
+        lp = jnp.log(self._p)
+        n = int(np.prod(shape)) if shape else 1
+        draws = jax.random.categorical(
+            key, lp, axis=-1, shape=(n,) + lp.shape[:-1])
+        out = jnp.moveaxis(draws, 0, -1).reshape(lp.shape[:-1] + shape) \
+            if shape else draws.reshape(lp.shape[:-1])
+        return Tensor(out.astype(jnp.int64))
+
+    def entropy(self):
+        p = self._p
+        return Tensor(-(p * jnp.log(p)).sum(-1))
+
+    def kl_divergence(self, other: "Categorical"):
+        p, q = self._p, other._p
+        return Tensor((p * (jnp.log(p) - jnp.log(q))).sum(-1))
+
+    def probs(self, value):
+        v = _as_val(value, jnp.int32)
+        return Tensor(jnp.take_along_axis(
+            self._p, v.reshape(self._p.shape[:-1] + (-1,)), axis=-1
+        ).reshape(v.shape))
+
+    def log_prob(self, value):
+        return Tensor(jnp.log(self.probs(value).value))

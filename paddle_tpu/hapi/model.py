@@ -209,3 +209,58 @@ def summary(network, input_size=None):
         rows.append((name, tuple(p.shape), n))
     return {"total_params": total, "trainable_params": trainable,
             "layers": rows}
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Forward-pass FLOPs estimate (reference hapi/dynamic_flops.py).
+
+    Counts multiply-accumulates as 2 FLOPs for Conv2D/Linear (the MXU-
+    relevant ops), plus norm/activation elementwise costs, via forward
+    hooks on a dry run with zeros input."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    counts = {"flops": 0}
+    hooks = []
+
+    def conv_hook(layer, inp, out):
+        x = inp[0] if isinstance(inp, (list, tuple)) else inp
+        w = layer.weight
+        out_elems = int(np.prod(out.shape))
+        kernel_macs = int(np.prod(w.shape[1:]))
+        counts["flops"] += 2 * out_elems * kernel_macs
+
+    def linear_hook(layer, inp, out):
+        w = layer.weight
+        out_elems = int(np.prod(out.shape[:-1]))
+        counts["flops"] += 2 * out_elems * int(np.prod(w.shape))
+
+    def elemwise_hook(layer, inp, out):
+        counts["flops"] += int(np.prod(out.shape))
+
+    for layer in net.sublayers(include_self=True):
+        if isinstance(layer, nn.Conv2D):
+            hooks.append(layer.register_forward_post_hook(conv_hook))
+        elif isinstance(layer, nn.Linear):
+            hooks.append(layer.register_forward_post_hook(linear_hook))
+        elif isinstance(layer, (nn.BatchNorm2D, nn.LayerNorm, nn.ReLU)):
+            hooks.append(layer.register_forward_post_hook(elemwise_hook))
+    # dry-run in eval mode (a training-mode forward would blend the zeros
+    # batch into BatchNorm running stats), restoring per-layer flags after
+    modes = [(layer, layer.training) for layer in
+             net.sublayers(include_self=True)]
+    try:
+        net.eval()
+        x = paddle.zeros(list(input_size))
+        net(x)
+    finally:
+        for layer, was_training in modes:
+            layer.training = was_training
+        for h in hooks:
+            try:
+                h.remove()
+            except Exception:  # noqa: BLE001
+                pass
+    return counts["flops"]

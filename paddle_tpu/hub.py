@@ -1,0 +1,49 @@
+"""paddle.hub — model hub loader (reference python/paddle/hub.py).
+
+Zero-egress environment: only ``local`` source is supported; github/gitee
+sources raise with a clear message instead of attempting network access.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_HUB_MODULE = "hubconf.py"
+
+
+def _load_entry(repo_dir: str):
+    path = os.path.join(repo_dir, _HUB_MODULE)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no {_HUB_MODULE} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["paddle_tpu_hubconf"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_source(source):
+    if source != "local":
+        raise NotImplementedError(
+            "paddle_tpu.hub supports source='local' only (no network egress);"
+            " clone the repo and point repo_dir at it")
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    _check_source(source)
+    mod = _load_entry(repo_dir)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    _check_source(source)
+    return getattr(_load_entry(repo_dir), model).__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    _check_source(source)
+    return getattr(_load_entry(repo_dir), model)(**kwargs)

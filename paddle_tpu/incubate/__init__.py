@@ -1,0 +1,8 @@
+"""paddle.incubate — experimental optimizers.
+
+Reference: python/paddle/incubate/optimizer/{lookahead,modelaverage}.py.
+"""
+from . import optimizer  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
+
+__all__ = ["optimizer", "LookAhead", "ModelAverage"]

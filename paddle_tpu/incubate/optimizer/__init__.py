@@ -1,0 +1,127 @@
+"""Incubate optimizers: LookAhead, ModelAverage.
+
+Reference capability: /root/reference/python/paddle/incubate/optimizer/
+lookahead.py:26 (slow/fast weights, slow ← slow + α(fast − slow) every k
+steps) and modelaverage.py:27 (sliding accumulation of params, apply/restore
+for eval).  TPU-first: both are pure per-leaf pytree transforms wrapping an
+inner optimizer; under jit the k-step branch is a lax.cond so the whole
+update stays one XLA program.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.autograd import no_grad
+from ...core.tensor import Tensor
+from ...optimizer.optimizer import Optimizer
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead(Optimizer):
+    """lookahead.py:26 — wraps an inner optimizer; every k steps the slow
+    weights catch up: slow += alpha * (fast - slow), and fast ← slow."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        super().__init__(inner_optimizer._lr,
+                         inner_optimizer._parameter_list, None,
+                         inner_optimizer._grad_clip, name)
+
+    # -- pure pytree API -----------------------------------------------------
+    def init_state(self, params):
+        return {"inner": self.inner.init_state(params),
+                "slow": jax.tree_util.tree_map(jnp.asarray, params),
+                "step": jnp.zeros((), jnp.int32)}
+
+    def apply_gradients(self, grads, params, state, lr=None, step=0):
+        fast, inner_state = self.inner.apply_gradients(
+            grads, params, state["inner"], lr=lr, step=step)
+        t = state["step"] + 1
+
+        def sync(_):
+            slow = jax.tree_util.tree_map(
+                lambda s, f: s + self.alpha * (f.astype(s.dtype) - s),
+                state["slow"], fast)
+            return slow, slow
+
+        def keep(_):
+            return state["slow"], fast
+
+        slow, fast2 = jax.lax.cond(t % self.k == 0, sync, keep, 0)
+        fast2 = jax.tree_util.tree_map(
+            lambda f, p: f.astype(np.asarray(p).dtype), fast2, params)
+        return fast2, {"inner": inner_state, "slow": slow, "step": t}
+
+    # -- eager API -----------------------------------------------------------
+    @no_grad()
+    def step(self):
+        self.inner.step()
+        self._step_count += 1
+        params = self.inner._params()
+        if not hasattr(self, "_slow"):
+            self._slow = {id(p): jnp.asarray(p.value) for p in params}
+        if self._step_count % self.k == 0:
+            for p in params:
+                s = self._slow[id(p)]
+                s = s + self.alpha * (p.value.astype(s.dtype) - s)
+                self._slow[id(p)] = s
+                p._value = s.astype(p.value.dtype)
+
+    def clear_grad(self):
+        self.inner.clear_grad()
+
+    clear_gradients = clear_grad
+
+
+class ModelAverage(Optimizer):
+    """modelaverage.py:27 — accumulate parameters during training; swap in
+    the average for evaluation via apply()/restore()."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        super().__init__(0.0, parameters, None, None, name)
+        self.rate = average_window_rate
+        self.min_w = int(min_average_window)
+        self.max_w = int(max_average_window)
+        self._sum = {}
+        self._num = 0
+        self._backup = None
+
+    @no_grad()
+    def step(self):
+        for p in self._params():
+            sid = id(p)
+            acc = self._sum.get(sid)
+            v32 = p.value.astype(jnp.float32)
+            self._sum[sid] = v32 if acc is None else acc + v32
+        self._num += 1
+        if self._num > self.max_w:
+            # restart window (reference restores sliding windows; a restart
+            # keeps memory O(1) with the same long-run average behavior)
+            for p in self._params():
+                self._sum[id(p)] = self._sum[id(p)] / self._num
+            self._num = 1
+
+    @no_grad()
+    def apply(self, executor=None, need_restore=True):
+        if self._num == 0:
+            return
+        self._backup = {}
+        for p in self._params():
+            self._backup[id(p)] = p.value
+            p._value = (self._sum[id(p)] / self._num).astype(p.value.dtype)
+
+    @no_grad()
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._params():
+            if id(p) in self._backup:
+                p._value = self._backup[id(p)]
+        self._backup = None

@@ -207,6 +207,47 @@ def convert_while(cond_fn, body_fn, vals: tuple, _loc_info=None):
     return tuple(full)
 
 
+def convert_for_range(range_args, body_fn, vals: tuple, _loc_info=None):
+    """``for <i> in range(...)`` → lax.while_loop when any bound is traced
+    (reference dygraph_to_static loop_transformer converts for→while).
+
+    vals = (loop_target_placeholder, *state); body_fn takes/returns the full
+    tuple with the target first.  Python-int bounds keep the plain (possibly
+    trace-unrolled) Python loop semantics."""
+    args = [(_unwrap1(a) if isinstance(a, Tensor) else a) for a in range_args]
+    if len(args) == 1:
+        start, stop, step = 0, args[0], 1
+    elif len(args) == 2:
+        start, stop, step = args[0], args[1], 1
+    else:
+        start, stop, step = args
+
+    import numpy as _np
+
+    if all(isinstance(a, (int, bool, _np.integer))
+           for a in (start, stop, step)):
+        out = vals
+        for i in range(int(start), int(stop), int(step)):
+            out = body_fn((i,) + tuple(out[1:]))
+            out = (i,) + tuple(out[1:])
+        return out
+
+    st = jnp.asarray(step)
+    stop_v = jnp.asarray(stop)
+    sign = jnp.where(st >= 0, 1, -1).astype(st.dtype)
+    i0 = Tensor(jnp.asarray(start))
+
+    def cond_fn(vs):
+        return Tensor(((jnp.asarray(_unwrap1(vs[0])) - stop_v) * sign) < 0)
+
+    def body_w(vs):
+        out = body_fn(vs)
+        i_next = Tensor(jnp.asarray(_unwrap1(vs[0])) + st)
+        return (i_next,) + tuple(out[1:])
+
+    return convert_while(cond_fn, body_w, (i0,) + tuple(vals[1:]), _loc_info)
+
+
 def logical_and(*thunks):
     vals = []
     for t in thunks:
@@ -274,6 +315,14 @@ def _has_control_flow(fdef) -> bool:
 
         def visit_While(self, node):
             self.found = True
+
+        def visit_For(self, node):
+            if (isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range"):
+                self.found = True
+            else:
+                self.generic_visit(node)
 
         def visit_FunctionDef(self, node):
             pass
@@ -470,6 +519,37 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                     "convert_ifelse",
                     [test, ast.Name(id=tf, ctx=ast.Load()),
                      ast.Name(id=ff, ctx=ast.Load()),
+                     self._state_load(names),
+                     self._loc_tuple(node)])),
+        ]
+        return out
+
+    def visit_For(self, node):
+        """for <name> in range(...) → convert_for_range (lax.while when a
+        bound is a traced tensor; plain Python loop otherwise). Other
+        iterables keep Python semantics (unrolled under trace)."""
+        self.generic_visit(node)
+        is_range = (isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range"
+                    and not node.iter.keywords
+                    and isinstance(node.target, ast.Name)
+                    and not node.orelse and not _escapes(node.body))
+        if not is_range:
+            return node
+        i = self.counter
+        self.counter += 1
+        tname = node.target.id
+        names = [tname] + [n for n in _assigned(node.body) if n != tname]
+        bf = f"__pt_fbody_{i}"
+        out = [
+            self._make_branch_fn(bf, names, node.body),
+            ast.Assign(
+                targets=[self._state_tuple(names, ast.Store())],
+                value=self._rt_call(
+                    "convert_for_range",
+                    [ast.Tuple(elts=list(node.iter.args), ctx=ast.Load()),
+                     ast.Name(id=bf, ctx=ast.Load()),
                      self._state_load(names),
                      self._loc_tuple(node)])),
         ]

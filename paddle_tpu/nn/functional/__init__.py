@@ -197,7 +197,12 @@ def linear(x, weight, bias=None):
 
 
 def embedding(x, weight, padding_idx=None, sparse=False):
-    """reference lookup_table_v2: gather rows; padding_idx row gets zero grad."""
+    """reference lookup_table_v2: gather rows; padding_idx row gets zero grad.
+
+    sparse=True on the EAGER path produces a ``RowSparseGrad`` for the weight
+    (the SelectedRows capability: lookup_table's is_sparse grad consumed by
+    lazy_mode optimizers) instead of a dense scatter over the full table.
+    Under jit the dense path always applies — XLA fuses the scatter."""
     idx = _v(x)
 
     def fn(w):
@@ -206,6 +211,34 @@ def embedding(x, weight, padding_idx=None, sparse=False):
             mask = (idx == padding_idx)[..., None]
             out = jnp.where(mask, 0.0, out)
         return out
+
+    from ...core import autograd as _ag
+
+    w_val = _v(weight)
+    eager = not isinstance(w_val, jax.core.Tracer) and \
+        not isinstance(idx, jax.core.Tracer)
+    if (sparse and eager and isinstance(weight, Tensor)
+            and not weight.stop_gradient and _ag.is_grad_enabled()):
+        from ...core.selected_rows import RowSparseGrad
+
+        out_val = fn(w_val)
+
+        def sparse_vjp(cts):
+            ct = jnp.asarray(cts[0])
+            rows = idx.reshape(-1)
+            vals = ct.reshape((-1,) + ct.shape[idx.ndim:])
+            if padding_idx is not None:
+                keep = (rows != padding_idx)
+                vals = jnp.where(keep[:, None], vals, 0)
+            return (RowSparseGrad(rows, vals, w_val.shape),)
+
+        node = _ag.record(sparse_vjp, [weight],
+                          [(out_val.shape, out_val.dtype)],
+                          name="embedding_sparse")
+        t = Tensor(out_val, stop_gradient=False)
+        t._node = node
+        t._out_index = 0
+        return t
 
     return dispatch(fn, weight, op_name="embedding")
 

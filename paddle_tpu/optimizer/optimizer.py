@@ -109,11 +109,26 @@ class Optimizer:
             raise ValueError("optimizer constructed without parameters")
         return [p for p in self._parameter_list if isinstance(p, Tensor)]
 
+    # -- sparse (SelectedRows) support ---------------------------------------
+    def _supports_sparse(self) -> bool:
+        """Row-wise update available? (reference: sgd/adam lazy_mode kernels
+        accept SelectedRows grads; others densify)."""
+        return False
+
+    def _update_leaf_sparse(self, g, p, state, lr, step):
+        raise NotImplementedError
+
     @no_grad()
     def step(self):
+        from ..core.selected_rows import RowSparseGrad
+
         params = self._params()
         pgs = [(p, p.grad) for p in params]
         if self._grad_clip is not None:
+            # clipping needs norms — densify sparse grads first
+            pgs = [(p, Tensor(g.value.to_dense())
+                    if g is not None and isinstance(g.value, RowSparseGrad)
+                    else g) for p, g in pgs]
             pgs = self._grad_clip(pgs)
         lr = self.get_lr()
         self._step_count += 1
@@ -122,12 +137,21 @@ class Optimizer:
                 continue
             name = p.name if p.name is not None else f"param_{i}"
             gv = g.value
-            if self._wd and not self._decoupled_wd:
-                gv = gv + self._wd * p.value
             sid = id(p)
             if sid not in self._eager_state:
                 self._eager_state[sid] = self._init_leaf(p.value)
             self._current_param_name = name
+            if isinstance(gv, RowSparseGrad):
+                if self._supports_sparse():
+                    new_p, new_s = self._update_leaf_sparse(
+                        gv.merged(), p.value, self._eager_state[sid], lr,
+                        self._step_count)
+                    self._eager_state[sid] = new_s
+                    p._value = new_p
+                    continue
+                gv = gv.to_dense()
+            if self._wd and not self._decoupled_wd:
+                gv = gv + self._wd * p.value
             new_p, new_s = self._update_leaf(gv, p.value, self._eager_state[sid], lr,
                                              self._step_count)
             if self._decoupled_wd and self._wd and self._should_decay(name):
@@ -183,6 +207,16 @@ class SGD(Optimizer):
     def _update_leaf(self, g, p, state, lr, step):
         return p - lr * g.astype(p.dtype), state
 
+    def _supports_sparse(self):
+        return True
+
+    def _update_leaf_sparse(self, g, p, state, lr, step):
+        """Row-wise SGD (reference sgd_op SelectedRows kernel)."""
+        vals = g.values.astype(p.dtype)
+        if self._wd:
+            vals = vals + self._wd * p[g.rows]
+        return p.at[g.rows].add(-lr * vals), state
+
 
 class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
@@ -211,6 +245,7 @@ class Adam(Optimizer):
                  multi_precision=False, state_dtype=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._lazy = bool(lazy_mode)
         # m/v storage dtype.  fp32 is the default (reference adam kernel keeps
         # fp32 moments); bf16 halves optimizer HBM — the knob that lets
         # GPT-1.3B + AdamW fit one 16 GB v5e chip.  Update math is always fp32.
@@ -232,6 +267,31 @@ class Adam(Optimizer):
         upd = lr * mhat / (jnp.sqrt(vhat) + self._eps)
         sd = self._state_dtype
         return (p.astype(jnp.float32) - upd).astype(p.dtype), (m2.astype(sd), v2.astype(sd))
+
+    def _supports_sparse(self):
+        return self._lazy  # reference adam lazy_mode: rows-only moment decay
+
+    def _update_leaf_sparse(self, g, p, state, lr, step):
+        m, v = state
+        rows = g.rows
+        g32 = g.values.astype(jnp.float32)
+        p_rows32 = p[rows].astype(jnp.float32)
+        if self._wd and not self._decoupled_wd:  # coupled L2 → into the grad
+            g32 = g32 + self._wd * p_rows32
+        b1, b2 = self._beta1, self._beta2
+        m_r = b1 * m[rows].astype(jnp.float32) + (1 - b1) * g32
+        v_r = b2 * v[rows].astype(jnp.float32) + (1 - b2) * g32 * g32
+        t = jnp.asarray(step, jnp.float32)
+        mhat = m_r / (1 - b1**t)
+        vhat = v_r / (1 - b2**t)
+        upd = lr * mhat / (jnp.sqrt(vhat) + self._eps)
+        if self._decoupled_wd and self._wd and self._should_decay(
+                self._current_param_name):  # AdamW row-wise decay
+            upd = upd + lr * self._wd * p_rows32
+        sd = self._state_dtype
+        new_p = p.at[rows].add(-upd.astype(p.dtype))
+        return new_p, (m.at[rows].set(m_r.astype(sd)),
+                       v.at[rows].set(v_r.astype(sd)))
 
 
 class AdamW(Adam):

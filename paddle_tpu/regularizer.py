@@ -1,0 +1,10 @@
+"""paddle.regularizer — weight-decay regularizers.
+
+Reference: python/paddle/regularizer.py (L1Decay/L2Decay appended as decay
+ops into the backward program).  TPU-first: decay folds into the fused
+optimizer update (optimizer.py applies it inside apply_gradients, which XLA
+fuses with the rest of the step).
+"""
+from .optimizer.optimizer import L1Decay, L2Decay  # noqa: F401
+
+__all__ = ["L1Decay", "L2Decay"]

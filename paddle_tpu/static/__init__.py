@@ -15,6 +15,7 @@ from .io import (deserialize_persistables, deserialize_program,  # noqa: F401
                  save_inference_model, save_to_file,
                  serialize_persistables, serialize_program,
                  set_program_state)
+from . import amp  # noqa: F401
 from .program import (Executor, InputSpec, Print, Program,  # noqa: F401
                       Scope, Variable, append_backward, create_global_var,
                       create_parameter, data, default_main_program,

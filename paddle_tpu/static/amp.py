@@ -1,0 +1,29 @@
+"""paddle.static.amp — mixed precision for static programs.
+
+Reference capability: python/paddle/fluid/contrib/mixed_precision/decorator.py
+(``decorate(optimizer)`` rewrites the program with cast ops + dynamic loss
+scaling).  TPU-first: bf16 is the native mixed-precision dtype (MXU) and
+needs no loss scaling; ``decorate`` marks the program so Executor replays
+every recorded op under the same ``amp.auto_cast`` white/black lists the
+dygraph path uses (dispatch-level casting — one implementation again).
+"""
+from __future__ import annotations
+
+from ..amp.grad_scaler import GradScaler  # noqa: F401  (API parity)
+
+__all__ = ["decorate", "CustomOpLists"]
+
+
+class CustomOpLists:
+    def __init__(self, custom_white_list=None, custom_black_list=None):
+        self.white = set(custom_white_list or ())
+        self.black = set(custom_black_list or ())
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=2.0 ** 15,
+             use_dynamic_loss_scaling=True, **kwargs):
+    """Mark the optimizer so minimize() flips its program to AMP replay.
+    bf16 on TPU needs no loss scaling; scaler args accepted for parity."""
+    optimizer._static_amp = True
+    optimizer._static_amp_lists = amp_lists
+    return optimizer

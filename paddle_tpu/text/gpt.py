@@ -207,7 +207,11 @@ def forward_with_aux(params: dict, tokens, cfg: GPTConfig, act_sharding=None,
 
     blk = functools.partial(_block, cfg=cfg)
     if cfg.remat:
-        blk = jax.checkpoint(blk)
+        # prevent_cse=False: inside lax.scan the loop structure already
+        # prevents the grad-of-checkpoint CSE hazard, and the default's
+        # optimization_barriers send the TPU compiler into a tailspin
+        # (observed: >15 min hangs on v5e for the 350M config)
+        blk = jax.checkpoint(blk, prevent_cse=False)
 
     need_keys = key is not None and (cfg.dropout > 0.0 or cfg.moe is not None)
     if need_keys:

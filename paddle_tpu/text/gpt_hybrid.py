@@ -156,7 +156,9 @@ def _pipeline_parts(cfg: gpt.GPTConfig, mesh: Mesh, dp_axis, pp_axis, mp_axis,
                                  mp_size=mp_size, sp_axis=sp_ax,
                                  ep_axis=ep_ax, ep_size=ep_size)
         if cfg.remat:
-            body = jax.checkpoint(body)
+            # prevent_cse=False: scan supplies the CSE protection; the
+            # default's optimization_barriers hang the TPU compile (gpt.py)
+            body = jax.checkpoint(body, prevent_cse=False)
 
         def scan_body(x, pk):
             p, k = pk

@@ -1,3 +1,3 @@
 """paddle_tpu.vision (reference python/paddle/vision)."""
-from . import models, ops  # noqa: F401
+from . import models, ops, transforms  # noqa: F401
 from .datasets import MNIST, FakeImageDataset  # noqa: F401

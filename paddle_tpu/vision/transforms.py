@@ -1,0 +1,503 @@
+"""paddle.vision.transforms — image preprocessing.
+
+Reference capability: python/paddle/vision/transforms/{transforms,functional}.py
+(Compose/Resize/RandomCrop/Normalize/ColorJitter… with cv2/PIL/tensor
+backends).  TPU-first: transforms are *host-side* numpy (HWC uint8/float) —
+preprocessing belongs on CPU feeding the device input pipeline
+(io/DataLoader prefetches to HBM); no PIL/cv2 dependency is required.
+``to_tensor`` produces the CHW float Tensor handed to the model.
+"""
+from __future__ import annotations
+
+import math
+import numbers
+import random as _pyrandom
+
+import numpy as np
+
+__all__ = [
+    "BaseTransform", "Compose", "Resize", "RandomResizedCrop", "CenterCrop",
+    "RandomHorizontalFlip", "RandomVerticalFlip", "Transpose", "Normalize",
+    "BrightnessTransform", "SaturationTransform", "ContrastTransform",
+    "HueTransform", "ColorJitter", "RandomCrop", "Pad", "RandomRotation",
+    "Grayscale", "ToTensor",
+    "to_tensor", "hflip", "vflip", "resize", "pad", "rotate", "to_grayscale",
+    "crop", "center_crop", "adjust_brightness", "adjust_contrast",
+    "adjust_hue", "normalize",
+]
+
+
+def _as_float(img):
+    if img.dtype == np.uint8:
+        return img.astype(np.float32) / 255.0, True
+    return img.astype(np.float32), False
+
+
+def _restore(img, was_uint8):
+    if was_uint8:
+        return np.clip(img * 255.0 + 0.5, 0, 255).astype(np.uint8)
+    return img
+
+
+# ---------------------------------------------------------------------------
+# functional (reference vision/transforms/functional.py)
+# ---------------------------------------------------------------------------
+
+def to_tensor(pic, data_format="CHW"):
+    """HWC image (uint8 [0,255] or float) → float32 Tensor, CHW by default,
+    scaled to [0,1] for uint8 input (reference functional.to_tensor)."""
+    from ..core.tensor import to_tensor as _tt
+
+    arr = np.asarray(pic)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if arr.dtype == np.uint8:
+        arr = arr.astype(np.float32) / 255.0
+    else:
+        arr = arr.astype(np.float32)
+    if data_format == "CHW":
+        arr = np.transpose(arr, (2, 0, 1))
+    return _tt(arr)
+
+
+def hflip(img):
+    return np.ascontiguousarray(img[:, ::-1])
+
+
+def vflip(img):
+    return np.ascontiguousarray(img[::-1])
+
+
+def _interp_axis(img, out_len, axis):
+    """Separable linear interpolation along one axis (align_corners=False,
+    the cv2/reference default)."""
+    in_len = img.shape[axis]
+    if in_len == out_len:
+        return img
+    pos = (np.arange(out_len) + 0.5) * in_len / out_len - 0.5
+    lo = np.clip(np.floor(pos).astype(np.int64), 0, in_len - 1)
+    hi = np.clip(lo + 1, 0, in_len - 1)
+    w = (pos - lo).astype(np.float32)
+    a = np.take(img, lo, axis=axis).astype(np.float32)
+    b = np.take(img, hi, axis=axis).astype(np.float32)
+    shape = [1] * img.ndim
+    shape[axis] = out_len
+    return a + (b - a) * w.reshape(shape)
+
+
+def resize(img, size, interpolation="bilinear"):
+    """size: int (short side) or (h, w). Bilinear (separable) or nearest."""
+    h, w = img.shape[:2]
+    if isinstance(size, int):
+        if h < w:
+            oh, ow = size, max(1, int(round(w * size / h)))
+        else:
+            oh, ow = max(1, int(round(h * size / w))), size
+    else:
+        oh, ow = int(size[0]), int(size[1])
+    if interpolation == "nearest":
+        ri = np.clip((np.arange(oh) * h / oh).astype(np.int64), 0, h - 1)
+        ci = np.clip((np.arange(ow) * w / ow).astype(np.int64), 0, w - 1)
+        return img[ri][:, ci]
+    dtype = img.dtype
+    out = _interp_axis(_interp_axis(img, oh, 0), ow, 1)
+    if dtype == np.uint8:
+        out = np.clip(out + 0.5, 0, 255).astype(np.uint8)
+    return out
+
+
+def crop(img, top, left, height, width):
+    return img[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    h, w = img.shape[:2]
+    th, tw = output_size
+    return crop(img, (h - th) // 2, (w - tw) // 2, th, tw)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    if isinstance(padding, numbers.Number):
+        pl = pt = pr = pb = int(padding)
+    elif len(padding) == 2:
+        pl, pt = int(padding[0]), int(padding[1])
+        pr, pb = pl, pt
+    else:
+        pl, pt, pr, pb = (int(p) for p in padding)
+    widths = [(pt, pb), (pl, pr)] + [(0, 0)] * (img.ndim - 2)
+    if padding_mode == "constant":
+        return np.pad(img, widths, mode="constant", constant_values=fill)
+    mode = {"edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    return np.pad(img, widths, mode=mode)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Rotate counter-clockwise by `angle` degrees (nearest resampling)."""
+    h, w = img.shape[:2]
+    rad = math.radians(angle)
+    c, s = math.cos(rad), math.sin(rad)
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None \
+        else (center[1], center[0])
+    if expand:
+        nh = int(round(abs(h * c) + abs(w * s)))
+        nw = int(round(abs(w * c) + abs(h * s)))
+    else:
+        nh, nw = h, w
+    oy, ox = (nh - 1) / 2.0, (nw - 1) / 2.0
+    yy, xx = np.meshgrid(np.arange(nh), np.arange(nw), indexing="ij")
+    # inverse map: output → input
+    sy = (yy - oy) * c - (xx - ox) * s + cy
+    sx = (yy - oy) * s + (xx - ox) * c + cx
+    ri = np.round(sy).astype(np.int64)
+    ci = np.round(sx).astype(np.int64)
+    valid = (ri >= 0) & (ri < h) & (ci >= 0) & (ci < w)
+    out_shape = (nh, nw) + img.shape[2:]
+    out = np.full(out_shape, fill, dtype=img.dtype)
+    out[valid] = img[ri[valid], ci[valid]]
+    return out
+
+
+def to_grayscale(img, num_output_channels=1):
+    f, u8 = _as_float(img)
+    if f.ndim == 2:
+        g = f
+    else:
+        g = f[..., 0] * 0.299 + f[..., 1] * 0.587 + f[..., 2] * 0.114
+    g = np.repeat(g[..., None], num_output_channels, axis=-1)
+    return _restore(g, u8)
+
+
+def adjust_brightness(img, brightness_factor):
+    f, u8 = _as_float(img)
+    return _restore(f * brightness_factor, u8)
+
+
+def adjust_contrast(img, contrast_factor):
+    f, u8 = _as_float(img)
+    mean = to_grayscale(_restore(f, False)).mean()
+    return _restore((f - mean) * contrast_factor + mean, u8)
+
+
+def adjust_saturation(img, saturation_factor):
+    f, u8 = _as_float(img)
+    g = to_grayscale(_restore(f, False), 3)
+    return _restore(g + (f - g) * saturation_factor, u8)
+
+
+def _rgb_to_hsv(rgb):
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    maxc = np.max(rgb, axis=-1)
+    minc = np.min(rgb, axis=-1)
+    v = maxc
+    d = maxc - minc
+    s = np.where(maxc > 0, d / np.maximum(maxc, 1e-12), 0.0)
+    rc = (maxc - r) / np.maximum(d, 1e-12)
+    gc = (maxc - g) / np.maximum(d, 1e-12)
+    bc = (maxc - b) / np.maximum(d, 1e-12)
+    h = np.where(maxc == r, bc - gc,
+                 np.where(maxc == g, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = np.where(d == 0, 0.0, (h / 6.0) % 1.0)
+    return np.stack([h, s, v], axis=-1)
+
+
+def _hsv_to_rgb(hsv):
+    h, s, v = hsv[..., 0], hsv[..., 1], hsv[..., 2]
+    i = np.floor(h * 6.0).astype(np.int64) % 6
+    f = h * 6.0 - np.floor(h * 6.0)
+    p = v * (1 - s)
+    q = v * (1 - s * f)
+    t = v * (1 - s * (1 - f))
+    choices = np.stack([
+        np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+        np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+        np.stack([t, p, v], -1), np.stack([v, p, q], -1)], 0)
+    return np.take_along_axis(choices, i[None, ..., None].repeat(3, -1),
+                              axis=0)[0]
+
+
+def adjust_hue(img, hue_factor):
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    f, u8 = _as_float(img)
+    hsv = _rgb_to_hsv(f)
+    hsv[..., 0] = (hsv[..., 0] + hue_factor) % 1.0
+    return _restore(_hsv_to_rgb(hsv), u8)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    is_tensor = hasattr(img, "value")  # paddle Tensor in, Tensor out
+    arr = np.asarray(img.value if is_tensor else img, np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    shape = (-1, 1, 1) if data_format == "CHW" else (1, 1, -1)
+    out = (arr - mean.reshape(shape)) / std.reshape(shape)
+    if is_tensor:
+        from ..core.tensor import to_tensor as _tt
+
+        return _tt(out)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# transform classes (reference vision/transforms/transforms.py)
+# ---------------------------------------------------------------------------
+
+class BaseTransform:
+    """Reference BaseTransform: keys-aware transform; here simplified to
+    single-image application with optional param sharing via _get_params."""
+
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+    def __call__(self, inputs):
+        if isinstance(inputs, (list, tuple)):
+            return type(inputs)(self._apply_image(i) for i in inputs)
+        return self._apply_image(inputs)
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size, self.interpolation = size, interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        super().__init__(keys)
+        if isinstance(size, numbers.Number):
+            size = (int(size), int(size))
+        self.size = size
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        if self.padding is not None:
+            img = pad(img, self.padding, self.fill, self.padding_mode)
+        h, w = img.shape[:2]
+        th, tw = self.size
+        if self.pad_if_needed and (h < th or w < tw):
+            img = pad(img, (max(0, tw - w), max(0, th - h)), self.fill,
+                      self.padding_mode)
+            h, w = img.shape[:2]
+        top = _pyrandom.randint(0, max(0, h - th))
+        left = _pyrandom.randint(0, max(0, w - tw))
+        return crop(img, top, left, th, tw)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        if isinstance(size, numbers.Number):
+            size = (int(size), int(size))
+        self.size, self.scale, self.ratio = size, scale, ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * _pyrandom.uniform(*self.scale)
+            ar = math.exp(_pyrandom.uniform(math.log(self.ratio[0]),
+                                            math.log(self.ratio[1])))
+            cw = int(round(math.sqrt(target * ar)))
+            ch = int(round(math.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = _pyrandom.randint(0, h - ch)
+                left = _pyrandom.randint(0, w - cw)
+                return resize(crop(img, top, left, ch, cw), self.size,
+                              self.interpolation)
+        return resize(center_crop(img, min(h, w)), self.size,
+                      self.interpolation)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return hflip(img) if _pyrandom.random() < self.prob else img
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return vflip(img) if _pyrandom.random() < self.prob else img
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.interpolation = interpolation
+        self.expand, self.center, self.fill = expand, center, fill
+
+    def _apply_image(self, img):
+        angle = _pyrandom.uniform(*self.degrees)
+        return rotate(img, angle, self.interpolation, self.expand,
+                      self.center, self.fill)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = order
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return np.transpose(arr, self.order)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        super().__init__(keys)
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean, self.std = mean, std
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return adjust_brightness(img,
+                                 _pyrandom.uniform(max(0, 1 - self.value),
+                                                   1 + self.value))
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if value < 0:
+            raise ValueError("contrast value must be non-negative")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return adjust_contrast(img,
+                               _pyrandom.uniform(max(0, 1 - self.value),
+                                                 1 + self.value))
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return adjust_saturation(img,
+                                 _pyrandom.uniform(max(0, 1 - self.value),
+                                                   1 + self.value))
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return adjust_hue(img, _pyrandom.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.transforms = [BrightnessTransform(brightness),
+                           ContrastTransform(contrast),
+                           SaturationTransform(saturation),
+                           HueTransform(hue)]
+
+    def _apply_image(self, img):
+        order = list(range(4))
+        _pyrandom.shuffle(order)
+        for i in order:
+            img = self.transforms[i]._apply_image(img)
+        return img
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.padding, self.fill, self.padding_mode = padding, fill, \
+            padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)

@@ -126,3 +126,35 @@ def test_second_order_via_double_backward_not_supported_cleanly():
     y = x * x * x
     (g,) = paddle.grad(y, x, create_graph=True)
     assert g is not None
+
+
+def test_inplace_op_keeps_upstream_gradient():
+    """y = w*2; y.tanh_(); backward — the tape must reach w (regression:
+    in-place once made the tensor its own producer, a self-edge that
+    silently dropped all upstream grads)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    w = paddle.to_tensor(np.array([0.3, -0.7], np.float32))
+    w.stop_gradient = False
+    y = w * 2.0
+    y.tanh_()
+    loss = paddle.sum(y)
+    loss.backward()
+    expect = 2.0 * (1 - np.tanh(2 * np.array([0.3, -0.7])) ** 2)
+    np.testing.assert_allclose(np.asarray(w.grad.value), expect, rtol=1e-5)
+
+
+def test_inplace_on_grad_leaf_raises():
+    import numpy as np
+    import pytest
+
+    import paddle_tpu as paddle
+
+    w = paddle.to_tensor(np.ones(2, np.float32))
+    w.stop_gradient = False
+    with pytest.raises(RuntimeError, match="leaf"):
+        w.tanh_()
+    with paddle.no_grad():
+        w.tanh_()  # allowed under no_grad (optimizer-style mutation)

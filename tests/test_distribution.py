@@ -1,0 +1,84 @@
+"""paddle.distribution — Uniform/Normal/Categorical semantics.
+
+Mirrors reference tests/unittests/test_distribution.py: sample shapes &
+moments, log_prob/probs numerics, entropy, KL.
+"""
+import math
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import Categorical, Normal, Uniform
+
+
+def test_uniform_sample_logprob_entropy():
+    u = Uniform(low=1.0, high=3.0)
+    s = np.asarray(u.sample([2000]).value)
+    assert s.shape == (2000,)
+    assert s.min() >= 1.0 and s.max() < 3.0
+    assert abs(s.mean() - 2.0) < 0.1
+    np.testing.assert_allclose(np.asarray(u.log_prob(
+        paddle.to_tensor([1.5, 2.5])).value), [math.log(0.5)] * 2, rtol=1e-6)
+    assert np.isneginf(np.asarray(u.log_prob(
+        paddle.to_tensor([0.0])).value))[0]
+    np.testing.assert_allclose(np.asarray(u.probs(
+        paddle.to_tensor([2.0])).value), [0.5], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(u.entropy().value),
+                               math.log(2.0), rtol=1e-6)
+
+
+def test_uniform_broadcasting():
+    u = Uniform(low=np.zeros(3, np.float32), high=np.array([1., 2., 4.],
+                                                           np.float32))
+    s = np.asarray(u.sample([10]).value)
+    assert s.shape == (10, 3)
+    e = np.asarray(u.entropy().value)
+    np.testing.assert_allclose(e, np.log([1., 2., 4.]), rtol=1e-6)
+
+
+def test_normal_moments_logprob_kl():
+    n = Normal(loc=1.0, scale=2.0)
+    s = np.asarray(n.sample([4000]).value)
+    assert abs(s.mean() - 1.0) < 0.15 and abs(s.std() - 2.0) < 0.15
+    v = 1.0
+    expect = -((v - 1.0) ** 2) / 8 - math.log(2.0) - 0.5 * math.log(
+        2 * math.pi)
+    np.testing.assert_allclose(np.asarray(n.log_prob(
+        paddle.to_tensor([v])).value), [expect], rtol=1e-5)
+    # entropy of N(mu, sigma): 0.5 + 0.5 log(2 pi) + log sigma
+    np.testing.assert_allclose(
+        np.asarray(n.entropy().value),
+        0.5 + 0.5 * math.log(2 * math.pi) + math.log(2.0), rtol=1e-6)
+    # KL(N0||N1) closed form
+    n2 = Normal(loc=0.0, scale=1.0)
+    kl = float(np.asarray(n.kl_divergence(n2).value))
+    expect_kl = math.log(1.0 / 2.0) + (4 + 1) / 2 - 0.5
+    np.testing.assert_allclose(kl, expect_kl, rtol=1e-5)
+
+
+def test_categorical_reference_semantics():
+    # reference: logits are unnormalized probabilities
+    c = Categorical(paddle.to_tensor([1.0, 3.0]))
+    np.testing.assert_allclose(np.asarray(c.probs(
+        paddle.to_tensor([0, 1])).value), [0.25, 0.75], rtol=1e-5)
+    s = np.asarray(c.sample([5000]).value)
+    assert s.shape == (5000,)
+    assert abs((s == 1).mean() - 0.75) < 0.05
+    ent = float(np.asarray(c.entropy().value))
+    expect = -(0.25 * math.log(0.25) + 0.75 * math.log(0.75))
+    np.testing.assert_allclose(ent, expect, rtol=1e-5)
+    c2 = Categorical(paddle.to_tensor([1.0, 1.0]))
+    kl = float(np.asarray(c.kl_divergence(c2).value))
+    assert kl > 0
+
+
+def test_small_parity_modules():
+    assert paddle.regularizer.L2Decay(1e-4)
+    assert paddle.callbacks.EarlyStopping
+    assert isinstance(paddle.sysconfig.get_include(), str)
+    assert paddle.device.get_device() in ("cpu", "tpu:0", "cpu:0") or ":" in \
+        paddle.device.get_device()
+    import pytest
+
+    with pytest.raises(NotImplementedError):
+        paddle.hub.load("/nonexistent", "model", source="github")

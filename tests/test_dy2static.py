@@ -179,3 +179,57 @@ class TestLayerForward:
         out = sf(paddle.to_tensor(x))
         assert tuple(out.shape) == (2, 4)
         assert np.isfinite(np.asarray(out.value)).all()
+
+
+def test_for_range_python_bounds_unchanged():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def f(x):
+        acc = paddle.zeros_like(x)
+        for i in range(3):
+            acc = acc + x * float(i + 1)
+        return acc
+
+    x = paddle.ones([2])
+    np.testing.assert_allclose(np.asarray(f(x).value), [6.0, 6.0])
+
+
+def test_for_range_tensor_bound_becomes_while():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def f(x, n):
+        acc = paddle.zeros_like(x)
+        for i in range(n):
+            acc = acc + x
+        return acc
+
+    x = paddle.ones([2])
+    out = f(x, paddle.to_tensor(np.asarray(4)))
+    np.testing.assert_allclose(np.asarray(out.value), [4.0, 4.0])
+    out = f(x, paddle.to_tensor(np.asarray(0)))
+    np.testing.assert_allclose(np.asarray(out.value), [0.0, 0.0])
+
+
+def test_for_range_start_stop_step_tensor():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def f(lo, hi):
+        s = paddle.zeros([1])
+        for i in range(lo, hi, 2):
+            s = s + 1.0
+        return s
+
+    out = f(paddle.to_tensor(np.asarray(1)), paddle.to_tensor(np.asarray(8)))
+    np.testing.assert_allclose(np.asarray(out.value), [4.0])  # 1,3,5,7

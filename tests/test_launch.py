@@ -126,12 +126,17 @@ def test_launch_elastic_scale_relaunch(tmp_path):
     s.bind(("127.0.0.1", 0))
     port = s.getsockname()[1]
     s.close()
+    env = dict(os.environ)
+    # generous margins: under full-suite CPU load the launcher's heartbeat
+    # thread can starve past a tight TTL → spurious relaunch → flaky counts
+    env["PADDLE_ELASTIC_HEARTBEAT"] = "0.3"
+    env["PADDLE_ELASTIC_TTL"] = "4.0"
     proc = subprocess.Popen(
         [sys.executable, "-m", "paddle_tpu.distributed.launch",
          "--coordinator", f"127.0.0.1:{port}", "--elastic_np", "1:4",
          str(script)],
         cwd="/root/repo", stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-        text=True)
+        text=True, env=env)
     try:
         # wait until the launcher's own heartbeat is registered (no fixed
         # sleep: under CI load the pod may come up slowly)

@@ -133,3 +133,70 @@ def test_functional_pytree_matches_eager():
     new_params, _ = opt2.apply_gradients({"w": jnp.asarray(g0)}, params, state,
                                          lr=0.05, step=1)
     np.testing.assert_allclose(np.asarray(w.value), np.asarray(new_params["w"]), rtol=1e-6)
+
+
+def test_lookahead_converges_and_syncs():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate import LookAhead
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((32, 4)).astype(np.float32)
+    Y = (X @ np.array([[1.0], [2.0], [-1.0], [0.5]], np.float32))
+    lin = paddle.nn.Linear(4, 1)
+    inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=lin.parameters())
+    opt = LookAhead(inner, alpha=0.5, k=3)
+    first = None
+    for _ in range(40):
+        loss = paddle.mean((lin(paddle.to_tensor(X)) -
+                            paddle.to_tensor(Y)) ** 2)
+        if first is None:
+            first = float(np.asarray(loss.value))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(np.asarray(loss.value)) < first / 10
+
+
+def test_lookahead_pure_pytree_matches_k_sync():
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate import LookAhead
+
+    inner = paddle.optimizer.SGD(learning_rate=1.0)
+    opt = LookAhead(inner, alpha=0.5, k=2)
+    params = {"w": jnp.ones(2)}
+    state = opt.init_state(params)
+    g = {"w": jnp.ones(2)}
+    # step1: fast = 0, slow stays 1
+    params, state = opt.apply_gradients(g, params, state, lr=1.0, step=1)
+    np.testing.assert_allclose(params["w"], 0.0)
+    np.testing.assert_allclose(state["slow"]["w"], 1.0)
+    # step2: fast = -1; sync: slow = 1 + 0.5*(-1-1) = 0; fast <- slow
+    params, state = opt.apply_gradients(g, params, state, lr=1.0, step=2)
+    np.testing.assert_allclose(state["slow"]["w"], 0.0)
+    np.testing.assert_allclose(params["w"], 0.0)
+
+
+def test_model_average_apply_restore():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate import ModelAverage
+
+    lin = paddle.nn.Linear(2, 1)
+    ma = ModelAverage(parameters=lin.parameters())
+    w0 = np.asarray(lin.weight.value).copy()
+    ma.step()
+    lin.weight._value = lin.weight.value + 2.0
+    ma.step()
+    ma.apply()
+    np.testing.assert_allclose(np.asarray(lin.weight.value), w0 + 1.0,
+                               rtol=1e-6)
+    ma.restore()
+    np.testing.assert_allclose(np.asarray(lin.weight.value), w0 + 2.0,
+                               rtol=1e-6)

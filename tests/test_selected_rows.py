@@ -1,0 +1,113 @@
+"""SelectedRows capability: sparse embedding grads + lazy_mode optimizers.
+
+Reference: framework/selected_rows.h, operators/optimizers/{sgd,adam}_op
+SelectedRows kernels, lookup_table_v2 is_sparse grad.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.selected_rows import RowSparseGrad
+from paddle_tpu.nn import functional as F
+
+
+def _emb_setup(sparse):
+    w = paddle.core.tensor.Parameter(
+        paddle.to_tensor(np.arange(40, dtype=np.float32).reshape(10, 4)).value,
+        name="emb_w")
+    w.stop_gradient = False
+    idx = paddle.to_tensor(np.array([[1, 3], [3, 5]], np.int64))
+    out = F.embedding(idx, w, sparse=sparse)
+    loss = paddle.sum(out * out)
+    loss.backward()
+    return w
+
+
+def test_sparse_embedding_grad_is_row_sparse():
+    w = _emb_setup(sparse=True)
+    g = w.grad.value
+    assert isinstance(g, RowSparseGrad)
+    assert sorted(np.asarray(g.rows).tolist()) == [1, 3, 3, 5]
+    # densified sparse grad equals dense-path grad
+    wd = _emb_setup(sparse=False)
+    np.testing.assert_allclose(np.asarray(g.to_dense()),
+                               np.asarray(wd.grad.value), rtol=1e-6)
+
+
+def test_merged_sums_duplicates():
+    g = RowSparseGrad(np.array([2, 2, 5]),
+                      np.array([[1.0], [2.0], [4.0]], np.float32), (8, 1))
+    m = g.merged()
+    assert np.asarray(m.rows).tolist() == [2, 5]
+    np.testing.assert_allclose(np.asarray(m.values), [[3.0], [4.0]])
+    np.testing.assert_allclose(np.asarray(m.to_dense()),
+                               np.asarray(g.to_dense()))
+
+
+def _train_once(sparse, opt_factory):
+    np.random.seed(0)
+    emb = paddle.nn.Embedding(20, 4, sparse=sparse)
+    emb.weight._value = paddle.to_tensor(
+        np.random.RandomState(0).randn(20, 4).astype(np.float32)).value
+    opt = opt_factory(emb.parameters())
+    idx = paddle.to_tensor(np.array([[1, 2, 2], [7, 1, 9]], np.int64))
+    for _ in range(3):
+        out = emb(idx)
+        loss = paddle.mean(out ** 2)
+        loss.backward()
+        # the layer must actually route sparse→RowSparseGrad (regression:
+        # Embedding.forward once dropped the flag and these parity tests
+        # still passed dense-vs-dense)
+        assert isinstance(emb.weight.grad.value, RowSparseGrad) == sparse
+        opt.step()
+        opt.clear_grad()
+    return np.asarray(emb.weight.value)
+
+
+def test_sgd_sparse_matches_dense():
+    dense = _train_once(False, lambda ps: paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=ps))
+    sparse = _train_once(True, lambda ps: paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=ps))
+    np.testing.assert_allclose(sparse, dense, rtol=1e-5, atol=1e-6)
+
+
+def test_adam_lazy_updates_touched_rows_only():
+    w0 = np.random.RandomState(0).randn(20, 4).astype(np.float32)
+    lazy = _train_once(True, lambda ps: paddle.optimizer.Adam(
+        learning_rate=0.1, parameters=ps, lazy_mode=True))
+    touched = {1, 2, 7, 9}
+    for r in range(20):
+        if r in touched:
+            assert not np.allclose(lazy[r], w0[r]), r
+        else:
+            np.testing.assert_allclose(lazy[r], w0[r], rtol=1e-6)
+
+
+def test_adam_nonlazy_sparse_densifies_and_matches():
+    dense = _train_once(False, lambda ps: paddle.optimizer.Adam(
+        learning_rate=0.05, parameters=ps))
+    sparse = _train_once(True, lambda ps: paddle.optimizer.Adam(
+        learning_rate=0.05, parameters=ps))
+    np.testing.assert_allclose(sparse, dense, rtol=1e-5, atol=1e-6)
+
+
+def test_adam_lazy_weight_decay_applied():
+    """Coupled L2 must reach sparse rows (regression: the lazy path once
+    skipped weight decay entirely). Uses a constant-gradient loss so the
+    decay term isn't masked by Adam's gradient-scale invariance."""
+    def run(wd):
+        emb = paddle.nn.Embedding(20, 4, sparse=True)
+        emb.weight._value = paddle.to_tensor(
+            np.random.RandomState(0).randn(20, 4).astype(np.float32)).value
+        opt = paddle.optimizer.Adam(learning_rate=0.1,
+                                    parameters=emb.parameters(),
+                                    lazy_mode=True, weight_decay=wd)
+        idx = paddle.to_tensor(np.array([[1, 2]], np.int64))
+        for _ in range(3):
+            loss = paddle.mean(emb(idx))  # grad constant, not ∝ p
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return np.asarray(emb.weight.value)
+
+    assert not np.allclose(run(0.5)[1], run(None)[1])

@@ -290,3 +290,20 @@ def test_frozen_param_not_trained_and_scope_set_reaches_weight():
     sv = static.global_scope().find_var(w.name)
     sv.get_tensor().set(np.zeros((3, 1), np.float32))
     assert np.allclose(np.asarray(w.value), 0.0)
+
+
+def test_static_amp_decorate():
+    """static.amp.decorate: replay runs under bf16 auto_cast lists."""
+    main, startup = _fresh_programs()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 8], "float32")
+        y = static.nn.fc(x, 8)
+        loss = paddle.mean(y * y)
+        opt = static.amp.decorate(paddle.optimizer.SGD(learning_rate=0.01))
+        opt.minimize(loss)
+    assert main.amp
+    exe = static.Executor()
+    exe.run(startup)
+    lv, = exe.run(main, feed={"x": np.ones((4, 8), np.float32)},
+                  fetch_list=[loss])
+    assert np.isfinite(lv)
