@@ -48,8 +48,12 @@ def _log(msg):
 def _probe_backend(timeout=240, attempts=2):
     """Initialize the jax backend in a subprocess so a tunnel hang cannot
     take down the bench process. Returns device info dict or None."""
-    code = ("import jax, json; d = jax.devices()[0]; "
-            "print(json.dumps({'platform': d.platform, "
+    # enumerate AND compute: a wedged tunnel can list devices yet hang the
+    # first executable, so the probe must exercise a real compile+run
+    code = ("import jax, json; import jax.numpy as jnp; d = jax.devices()[0];"
+            " x = (jnp.ones((128, 128)) @ jnp.ones((128, 128)));"
+            " x.block_until_ready();"
+            " print(json.dumps({'platform': d.platform, "
             "'kind': getattr(d, 'device_kind', '')}))")
     for i in range(attempts):
         try:

@@ -49,6 +49,39 @@ class _DatasetBase:
     def set_seed(self, seed: int):
         self._seed = int(seed)
 
+    def set_use_var(self, var_list):
+        """Bind static data Variables to record columns (reference
+        dataset.set_use_var → DataFeed slots).  Each var with trailing dim k
+        consumes the next k columns of the flat record, cast to its dtype;
+        used by Executor.train_from_dataset to build feeds."""
+        self._use_vars = list(var_list)
+
+    def slice_batch(self, batch: np.ndarray) -> dict:
+        """Split a [B, seq_len] record batch into a feed dict per use_var."""
+        if not getattr(self, "_use_vars", None):
+            raise ValueError("set_use_var(...) first")
+        feed = {}
+        col = 0
+        for v in self._use_vars:
+            k = 1
+            for s in v.shape[1:]:
+                if int(s) < 0:
+                    raise ValueError(
+                        f"use_var {v.name!r} has dynamic trailing dim "
+                        f"{list(v.shape)}: record slicing needs static "
+                        "widths (only dim 0 may be batch/-1)")
+                k *= int(s)
+            width = k if len(v.shape) > 1 else 1
+            chunk = batch[:, col:col + width]
+            col += width
+            if len(v.shape) == 1:
+                chunk = chunk.reshape(-1)
+            else:
+                chunk = chunk.reshape((-1,) + tuple(
+                    max(1, int(s)) for s in v.shape[1:]))
+            feed[v.name] = chunk.astype(v.dtype)
+        return feed
+
     def _reader(self, capacity=8):
         from ...io.native_reader import TokenShardReader
 

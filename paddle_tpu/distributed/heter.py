@@ -1,0 +1,85 @@
+"""Heterogeneous PS training — host-resident sparse tables + device dense.
+
+Reference capability: heterogeneous parameter-server training
+(/root/reference/paddle/fluid/framework/fleet/heter_ps/ heter_comm.h,
+device_worker.h:367 HeterCpuWorker, trainer.h:180 HeterXpuTrainer): the huge
+sparse embedding lives on CPU parameter servers while dense math runs on the
+accelerator, with pull/push at every step.
+
+TPU-first shape: the dense half is ONE jitted XLA program whose inputs
+include the pulled embedding rows (so embedding grads fall out of the same
+value_and_grad), the sparse half is the C++ PS service
+(distributed/ps_service.py + _native/ps_table.cpp).  Unique-ids pull,
+inverse-gather on device, push of merged row grads — the
+pull→compute→push cycle of the reference's HeterCpuWorker::TrainFiles.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["HeterTrainer"]
+
+
+class HeterTrainer:
+    """Pull-compute-push training over a PSClient + a pure dense step.
+
+    dense_apply(params, embeds, batch) -> (loss, new-like outputs) must be a
+    pure function: ``embeds`` is [n_unique, dim] pulled rows; the trainer
+    jits loss+grads over (params, embeds) together, pushes the row grads to
+    the PS (server-side adagrad), and applies ``optimizer`` to the dense
+    params locally.
+    """
+
+    def __init__(self, client, table_id: int, dim: int,
+                 dense_params, dense_apply: Callable, optimizer,
+                 sparse_lr: float = 0.05):
+        self.client = client
+        self.tid = table_id
+        self.dim = dim
+        self.params = dense_params
+        self.opt = optimizer
+        self.opt_state = optimizer.init_state(dense_params)
+        self.sparse_lr = sparse_lr
+        self._step = 0
+
+        def _loss(params, embeds, batch):
+            return dense_apply(params, embeds, batch)
+
+        self._vg = jax.jit(jax.value_and_grad(_loss, argnums=(0, 1)))
+        self._apply = jax.jit(
+            lambda g, p, s, lr, step: optimizer.apply_gradients(
+                g, p, s, lr=lr, step=step))
+
+    def train_step(self, ids: np.ndarray, batch) -> float:
+        """ids: int64 [B, S] sparse feature ids for this batch."""
+        ids = np.asarray(ids, np.int64)
+        uniq, inv = np.unique(ids.reshape(-1), return_inverse=True)
+        # pad unique count to the next power of two so the jitted dense
+        # program sees a bounded set of shapes (otherwise every distinct
+        # n_unique retraces + recompiles); pad slots repeat row uniq[0] and
+        # are never referenced by inv, so their grads are exactly zero
+        pad_to = 1 << (len(uniq) - 1).bit_length()
+        if pad_to != len(uniq):
+            uniq = np.concatenate(
+                [uniq, np.full(pad_to - len(uniq), uniq[0], np.int64)])
+        # 1. pull unique rows from the PS shards
+        rows = self.client.pull_sparse(self.tid, uniq)
+        embeds = jnp.asarray(rows.reshape(len(uniq), self.dim))
+        # 2. one fused device program: dense fwd + bwd wrt params AND rows
+        inv_dev = jnp.asarray(inv.reshape(ids.shape))
+        loss, (gp, ge) = self._vg(self.params, embeds,
+                                  dict(batch, _inv=inv_dev))
+        # 3. push row grads (server applies its adagrad update)
+        self.client.push_sparse(self.tid, uniq, np.asarray(ge),
+                                lr=self.sparse_lr)
+        # 4. local dense update
+        self._step += 1
+        self.params, self.opt_state = self._apply(
+            gp, self.params, self.opt_state,
+            jnp.asarray(self.opt.get_lr(), jnp.float32),
+            jnp.asarray(self._step, jnp.int32))
+        return float(loss)

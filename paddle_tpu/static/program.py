@@ -887,6 +887,42 @@ class Executor:
             fetches = [np.asarray(f) for f in fetches]
         return fetches
 
+    # -- dataset-driven loops (Trainer/DeviceWorker role) --------------------
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """One pass over a fleet Dataset (reference executor.py
+        train_from_dataset → TrainerBase/HogwildWorker,
+        framework/trainer.h:57): the native C++ feeder streams record
+        batches, each step runs the fused jitted train program — no
+        per-batch Python beyond the feed split."""
+        import sys as _sys
+
+        prog = program if program is not None else default_main_program()
+        it = 0
+        last = None
+        for batch in dataset:
+            feed = dataset.slice_batch(np.asarray(batch))
+            last = self.run(prog, feed=feed, fetch_list=fetch_list)
+            it += 1
+            if fetch_list and (debug or it % print_period == 0):
+                names = fetch_info or [getattr(f, "name", str(i))
+                                       for i, f in enumerate(fetch_list)]
+                vals = ", ".join(f"{n}={np.asarray(v).mean():.6f}"
+                                 for n, v in zip(names, last))
+                print(f"[train_from_dataset] step {it}: {vals}",
+                      file=_sys.stderr)
+        return last
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Inference twin (reference infer_from_dataset): same loop on a
+        program without an optimizer (clone(for_test=True) upstream)."""
+        return self.train_from_dataset(program, dataset, scope, thread,
+                                       debug, fetch_list, fetch_info,
+                                       print_period)
+
     # -- compile ------------------------------------------------------------
     def _build(self, prog: Program, feed_names, fetch_refs, train):
         loss_vid = prog.loss.vid if prog.loss is not None else None

@@ -307,3 +307,47 @@ def test_static_amp_decorate():
     lv, = exe.run(main, feed={"x": np.ones((4, 8), np.float32)},
                   fetch_list=[loss])
     assert np.isfinite(lv)
+
+
+def test_train_from_dataset(tmp_path):
+    """Dataset-driven static training (Trainer/DeviceWorker role): native
+    feeder -> record slicing by use_var -> fused train step per batch."""
+    from paddle_tpu.distributed.fleet.dataset import QueueDataset
+
+    rng = np.random.default_rng(0)
+    # records: 4 feature columns + 1 target column (y = x @ w)
+    w_true = np.array([2.0, -1.0, 0.5, 3.0], np.float32)
+    files = []
+    for fi in range(2):
+        X = rng.standard_normal((64, 4)).astype(np.float32)
+        y = X @ w_true
+        rec = np.concatenate([X, y[:, None]], axis=1)
+        f = tmp_path / f"part-{fi}.bin"
+        # native feeder reads int records; scale floats to keep precision
+        (rec * 1000).astype(np.int32).tofile(f)
+        files.append(str(f))
+
+    main, startup = _fresh_programs()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 4], "float32")
+        label = static.data("y", [None, 1], "float32")
+        pred = static.nn.fc(x / 1000.0, 1, bias_attr=False)
+        loss = paddle.mean((pred - label / 1000.0) ** 2)
+        opt = paddle.optimizer.Adam(learning_rate=0.05)
+        opt.minimize(loss)
+
+    ds = QueueDataset()
+    ds.set_filelist(files)
+    ds.set_record_schema(5, np.int32)
+    ds.set_batch_size(16)
+    ds.set_thread(2)
+    ds.set_use_var([x, label])
+
+    exe = static.Executor()
+    exe.run(startup)
+    first = None
+    for _ in range(12):  # multiple passes over the files
+        out = exe.train_from_dataset(main, ds, fetch_list=[loss])
+        if first is None:
+            first = float(out[0])
+    assert float(out[0]) < first / 3, (first, float(out[0]))
