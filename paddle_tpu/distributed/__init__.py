@@ -33,6 +33,56 @@ if not _root._LIGHT_IMPORT:
         CommunicateTopology, HybridCommunicateGroup,
     )
 
+    from . import heter, spawn  # noqa: F401
+    from .fleet.dataset import InMemoryDataset, QueueDataset  # noqa: F401
+
+    class ParallelEnv:
+        """reference fluid/dygraph/parallel.py ParallelEnv: per-process rank
+        view (populated by the launcher's env contract)."""
+
+        def __init__(self):
+            from .env import get_rank, get_world_size
+
+            self.rank = get_rank()
+            self.world_size = get_world_size()
+            self.local_rank = int(__import__("os").environ.get(
+                "PADDLE_LOCAL_RANK", self.rank))
+            self.nranks = self.world_size
+            self.dev_id = self.local_rank
+
+        @property
+        def current_endpoint(self):
+            import os
+
+            return os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+
+        @property
+        def trainer_endpoints(self):
+            import os
+
+            eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+            return eps.split(",") if eps else []
+
+    def wait(tensor, group=None, use_calc_stream=True):
+        """reference collective.wait — XLA orders collectives; block for
+        parity semantics."""
+        import jax
+
+        if hasattr(tensor, "value"):
+            jax.block_until_ready(tensor.value)
+        return tensor
+
+    class CountFilterEntry:
+        """Sparse-table admission policy (reference entry configs for PS
+        tables): admit a feature after `count` occurrences."""
+
+        def __init__(self, count=1):
+            self.count = int(count)
+
+    class ProbabilityEntry:
+        def __init__(self, probability=1.0):
+            self.probability = float(probability)
+
     def get_group(gid=0):
         from .collective import get_group as _g
 

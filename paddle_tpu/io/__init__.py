@@ -536,3 +536,40 @@ class DataLoader:
 
     def __call__(self):
         return iter(self)
+
+
+class WeightedRandomSampler(Sampler):
+    """reference io WeightedRandomSampler: draw indices ∝ weights."""
+
+    def __init__(self, weights, num_samples, replacement=True):
+        self.weights = np.asarray(weights, np.float64)
+        self.num_samples = int(num_samples)
+        self.replacement = replacement
+        if not replacement and self.num_samples > len(self.weights):
+            raise ValueError("num_samples > population without replacement")
+
+    def __iter__(self):
+        p = self.weights / self.weights.sum()
+        idx = np.random.default_rng().choice(
+            len(self.weights), size=self.num_samples,
+            replace=self.replacement, p=p)
+        return iter(idx.tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+def get_worker_info():
+    """reference dataloader get_worker_info — worker processes set these env
+    vars (io worker protocol); None in the main process."""
+    import os
+
+    wid = os.environ.get("PADDLE_TPU_WORKER_ID")
+    if wid is None:
+        return None
+
+    class _Info:
+        id = int(wid)
+        num_workers = int(os.environ.get("PADDLE_TPU_NUM_WORKERS", "1"))
+
+    return _Info()

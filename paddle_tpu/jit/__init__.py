@@ -144,6 +144,12 @@ class StaticFunction:
     def __call__(self, *args):
         import numpy as np
 
+        if not ProgramTranslator.enable_to_static:
+            # paddle.jit.enable_to_static(False): run the original callable
+            # eagerly (reference dygraph-debug escape hatch)
+            raw = getattr(self._target, "__dy2static_original__", None)
+            target = raw or self._target
+            return target(*args)
         layer = self._target if self._is_layer else None
         if layer is not None:
             params, buffers = _split_state(layer)
@@ -435,3 +441,65 @@ def load(path, **kwargs):
     if os.path.exists(prefix + ".pdmodel"):
         return TranslatedLayer(prefix)
     return _load(prefix + ".pdparams")
+
+
+# -- dy2static-era compat surface (reference jit/__init__.py) ----------------
+
+declarative = to_static  # pre-2.0 alias
+
+
+class ProgramTranslator:
+    """reference ProgramTranslator singleton: global dy2static switch."""
+
+    _instance = None
+    enable_to_static = True
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, enable_to_static: bool):
+        ProgramTranslator.enable_to_static = bool(enable_to_static)
+
+
+def enable_to_static(flag: bool):
+    ProgramTranslator.get_instance().enable(flag)
+
+
+def set_code_level(level=100):
+    """reference dy2static debug knob — converted source can be inspected
+    via converted_fn.__wrapped_source__ instead; accepted for parity."""
+    return None
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    return None
+
+
+class TracedLayer:
+    """reference TracedLayer (dygraph_to_static trace): wraps a traced
+    callable + example inputs; here StaticFunction already plays that role,
+    so TracedLayer is a thin adapter with save_inference_model."""
+
+    def __init__(self, layer, inputs):
+        self._layer = layer
+        self._inputs = inputs
+        self._static = StaticFunction(layer)
+
+    @staticmethod
+    def trace(layer, inputs):
+        tl = TracedLayer(layer, inputs)
+        outs = tl._static(*inputs)
+        return outs, tl
+
+    def __call__(self, *args):
+        return self._static(*args)
+
+    def save_inference_model(self, path, feed=None, fetch=None):
+        from .. import inference
+
+        examples = tuple(
+            (i.value if hasattr(i, "value") else i) for i in self._inputs)
+        return inference.save_inference_model(path, self._layer, examples)

@@ -641,6 +641,7 @@ def convert_to_static(fn):
     conv = ns[fdef.name]
     conv = functools.wraps(fn)(conv)
     conv.__pt_dy2st_converted__ = conv
+    conv.__dy2static_original__ = fn  # jit.enable_to_static(False) fallback
     try:
         fn.__pt_dy2st_converted__ = conv
     except (AttributeError, TypeError):

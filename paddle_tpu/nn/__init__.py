@@ -3,17 +3,23 @@ from . import functional, initializer  # noqa: F401
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
 from .layer_base import Layer, ParamAttr  # noqa: F401
 from .layer.activation import (  # noqa: F401
+    Maxout, Silu, ThresholdedReLU,
     CELU, ELU, GELU, GLU, SELU, LeakyReLU, LogSigmoid, LogSoftmax, Mish, PReLU,
     ReLU, ReLU6, Sigmoid, SiLU, Softmax, Softplus, Softshrink, Softsign, Swish,
     Tanh, Tanhshrink, Hardshrink, Hardsigmoid, Hardswish, Hardtanh,
 )
 from .layer.common import (  # noqa: F401
-    AlphaDropout, Bilinear, CosineSimilarity, Dropout, Dropout2D, Embedding,
-    Flatten, Identity, Linear, Pad1D, Pad2D, Pad3D, PixelShuffle, Unfold, Upsample,
+    AlphaDropout, Bilinear, CosineSimilarity, Dropout, Dropout2D, Dropout3D,
+    Embedding, Flatten, Identity, Linear, Pad1D, Pad2D, Pad3D,
+    PairwiseDistance, PixelShuffle, Unfold, Upsample, UpsamplingBilinear2D,
+    UpsamplingNearest2D,
 )
 from .layer.container import LayerDict, LayerList, ParameterList, Sequential  # noqa: F401
-from .layer.conv import Conv1D, Conv2D, Conv2DTranspose, Conv3D  # noqa: F401
+from .layer.conv import (  # noqa: F401
+    Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose, Conv3D, Conv3DTranspose,
+)
 from .layer.loss import (  # noqa: F401
+    CTCLoss, HSigmoidLoss,
     BCELoss, BCEWithLogitsLoss, CrossEntropyLoss, HingeEmbeddingLoss, KLDivLoss,
     L1Loss, MarginRankingLoss, MSELoss, NLLLoss, SmoothL1Loss,
 )
@@ -23,12 +29,16 @@ from .layer.norm import (  # noqa: F401
     SyncBatchNorm,
 )
 from .layer.pooling import (  # noqa: F401
-    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveMaxPool2D, AvgPool1D, AvgPool2D,
-    MaxPool1D, MaxPool2D,
+    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D, AdaptiveMaxPool1D,
+    AdaptiveMaxPool2D, AdaptiveMaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
+    MaxPool1D, MaxPool2D, MaxPool3D,
 )
 from .layer.rnn import (  # noqa: F401
     GRU, LSTM, RNN, BiRNN, GRUCell, LSTMCell, SimpleRNN, SimpleRNNCell,
 )
+RNNCellBase = Layer  # reference rnn.py RNNCellBase — cells are plain Layers
+from . import utils  # noqa: F401
+from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
 from .layer.transformer import (  # noqa: F401
     MultiHeadAttention, Transformer, TransformerDecoder, TransformerDecoderLayer,
     TransformerEncoder, TransformerEncoderLayer,

@@ -1,0 +1,128 @@
+"""Sequence decoding: BeamSearchDecoder + dynamic_decode.
+
+Reference capability: python/paddle/nn/decode.py (BeamSearchDecoder over an
+RNNCellBase, dynamic_decode loop, gather_tree backtrace — serving the
+seq2seq/translation model family).  TPU-first: the decode loop runs a fixed
+``max_step_num`` of steps with finished-beam masking (compiler-friendly
+static trip count; XLA hoists the gathers), early-exiting the Python loop
+eagerly once every beam finished.  Backtrace = functional.gather_tree.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from . import functional as F
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode"]
+
+
+def _v(x):
+    return x.value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class BeamSearchDecoder:
+    """Beam search over an RNN cell (reference decode.py BeamSearchDecoder).
+
+    embedding_fn maps int token ids → cell inputs; output_fn maps cell
+    outputs → vocab logits (e.g. the projection Linear).
+    """
+
+    def __init__(self, cell, start_token: int, end_token: int,
+                 beam_size: int, embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- helpers -------------------------------------------------------------
+    @staticmethod
+    def tile_beam_merge_with_batch(t, beam_size):
+        v = _v(t)
+        v = jnp.repeat(v[:, None], beam_size, axis=1)
+        return Tensor(v.reshape((-1,) + v.shape[2:]))
+
+    def _states_map(self, states, fn):
+        return jax.tree_util.tree_map(
+            lambda s: fn(_v(s)), states,
+            is_leaf=lambda s: isinstance(s, (Tensor, jnp.ndarray)))
+
+    def initialize(self, initial_states, batch_size):
+        W = self.beam_size
+        states = self._states_map(
+            initial_states,
+            lambda s: jnp.repeat(s[:, None], W, 1).reshape((-1,)
+                                                           + s.shape[1:]))
+        tokens = jnp.full((batch_size, W), self.start_token, jnp.int32)
+        log_probs = jnp.concatenate(
+            [jnp.zeros((batch_size, 1), jnp.float32),
+             jnp.full((batch_size, W - 1), -1e9, jnp.float32)], axis=1)
+        finished = jnp.zeros((batch_size, W), bool)
+        return tokens, states, log_probs, finished
+
+    def step(self, tokens, states, log_probs, finished):
+        B, W = tokens.shape
+        flat_tok = Tensor(tokens.reshape(-1))
+        inp = self.embedding_fn(flat_tok) if self.embedding_fn else flat_tok
+        out, new_states = self.cell(inp, states)
+        logits = self.output_fn(out) if self.output_fn else out
+        lv = _v(logits).astype(jnp.float32)
+        V = lv.shape[-1]
+        step_lp = jax.nn.log_softmax(lv, -1).reshape(B, W, V)
+        # finished beams emit only end_token with probability 1
+        fin_row = jnp.full((V,), -1e9, jnp.float32).at[self.end_token].set(0)
+        step_lp = jnp.where(finished[..., None], fin_row, step_lp)
+        total = log_probs[..., None] + step_lp  # [B, W, V]
+        top_lp, top_idx = jax.lax.top_k(total.reshape(B, W * V), W)
+        parents = top_idx // V  # [B, W]
+        next_tok = (top_idx % V).astype(jnp.int32)
+        new_finished = jnp.take_along_axis(finished, parents, 1) | (
+            next_tok == self.end_token)
+
+        def regather(s):
+            sw = s.reshape((B, W) + s.shape[1:])
+            sel = jnp.take_along_axis(
+                sw, parents.reshape((B, W) + (1,) * (sw.ndim - 2)), 1)
+            return sel.reshape((-1,) + s.shape[1:])
+
+        new_states = self._states_map(new_states, regather)
+        return next_tok, parents, new_states, top_lp, new_finished
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=64, batch_size=None,
+                   output_time_major=False, **kwargs):
+    """Run the decoder until every beam finished or max_step_num steps
+    (reference decode.py dynamic_decode).  Returns (ids [B, W, T'],
+    final log_probs [B, W], sequence lengths [B, W])."""
+    if batch_size is None:
+        leaf = jax.tree_util.tree_leaves(
+            inits, is_leaf=lambda s: isinstance(s, (Tensor, jnp.ndarray)))[0]
+        batch_size = _v(leaf).shape[0]
+    tokens, states, log_probs, finished = decoder.initialize(
+        inits, batch_size)
+    ids_steps, parent_steps = [], []
+    lengths = jnp.zeros(finished.shape, jnp.int32)
+    for _ in range(int(max_step_num)):
+        # count this step for every beam not already finished BEFORE it —
+        # the step that emits end_token is included, and a never-finishing
+        # beam tops out at exactly max_step_num (== tokens returned)
+        lengths = lengths + (~finished).astype(jnp.int32)
+        tokens, parents, states, log_probs, new_fin = decoder.step(
+            tokens, states, log_probs, finished)
+        ids_steps.append(tokens)
+        parent_steps.append(parents)
+        finished = new_fin
+        if bool(jnp.all(finished)):
+            break
+    ids = jnp.stack(ids_steps)  # [T, B, W]
+    parents = jnp.stack(parent_steps)
+    full = F.gather_tree(Tensor(ids), Tensor(parents))
+    out = _v(full)
+    if not output_time_major:
+        out = jnp.moveaxis(out, 0, 2)  # [B, W, T]
+    return Tensor(out), Tensor(log_probs), Tensor(lengths)

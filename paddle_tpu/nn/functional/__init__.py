@@ -343,39 +343,12 @@ def conv2d_transpose(
     opad = _pair(output_padding, nd)
 
     def fn(a, w, *b):
-        chan_last = data_format == "NHWC"
-        if chan_last:
-            a = jnp.moveaxis(a, -1, 1)
-        # transpose conv = gradient of conv wrt input: use conv_transpose
-        kshape = w.shape  # (in, out/groups, kh, kw)
-        pads = []
-        for i in range(nd):
-            k_eff = (kshape[2 + i] - 1) * dil[i] + 1
-            lo = k_eff - 1 - pad_in[i]
-            hi = k_eff - 1 - pad_in[i] + opad[i]
-            pads.append((lo, hi))
-        # lax.conv_transpose expects kernel (spatial..., in, out) with IO dims;
-        # use gradient formulation via conv_general_dilated with lhs_dilation.
-        w_flip = jnp.flip(w, axis=(-1, -2))  # rotate kernel
-        w_t = jnp.swapaxes(w_flip, 0, 1)  # (out/groups, in, kh, kw)
-        if groups > 1:
-            # regroup: input channels split among groups
-            w_t = jnp.reshape(
-                jnp.swapaxes(jnp.reshape(w_flip, (groups, kshape[0] // groups) + kshape[1:]), 1, 2),
-                (kshape[1] * groups, kshape[0] // groups) + kshape[2:],
-            )
-        out = jax.lax.conv_general_dilated(
-            a, w_t, window_strides=(1,) * nd, padding=pads,
-            lhs_dilation=stride_, rhs_dilation=dil,
-            feature_group_count=groups, dimension_numbers=jax.lax.conv_dimension_numbers(
-                a.shape, w_t.shape, _dim_str(nd)
-            ),
-        )
-        if b:
-            out = out + b[0].reshape((1, -1) + (1,) * nd)
-        if chan_last:
-            out = jnp.moveaxis(out, 1, -1)
-        return out
+        # shared transpose-conv math lives in _conv_transpose_impl (defined
+        # below; also serves conv1d/3d_transpose) — one copy of the
+        # flip/regroup/lhs_dilation formulation
+        return _conv_transpose_impl(a, w, b[0] if b else None, stride,
+                                    padding, output_padding, dilation,
+                                    groups, nd, data_format == "NHWC")
 
     args = (x, weight) + ((bias,) if bias is not None else ())
     return dispatch(fn, *args, op_name="conv2d_transpose")
@@ -673,8 +646,9 @@ def dropout2d(x, p=0.5, training=True, data_format="NCHW"):
     return dropout(x, p, training, axis=ax)
 
 
-def dropout3d(x, p=0.5, training=True):
-    return dropout(x, p, training, axis=(0, 1))
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW"):
+    ax = (0, 1) if data_format == "NCDHW" else (0, 4)
+    return dropout(x, p, training, axis=ax)
 
 
 def alpha_dropout(x, p=0.5, training=True):
@@ -1083,6 +1057,368 @@ def diag_embed(x, offset=0, dim1=-2, dim2=-1):
         return out
 
     return dispatch(fn, x, op_name="diag_embed")
+
+
+
+
+# ---------------------------------------------------------------------------
+# pooling / conv completions (reference operators/pool_op.cc 3D variants,
+# conv_transpose_op.cc 1D/3D)
+# ---------------------------------------------------------------------------
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW"):
+    def fn(a):
+        if data_format == "NDHWC":
+            a = jnp.moveaxis(a, -1, 1)
+        out = _pool(a, 3, kernel_size, stride, padding, "max")
+        if data_format == "NDHWC":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return dispatch(fn, x, op_name="max_pool3d")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               count_include_pad=True, data_format="NCDHW"):
+    def fn(a):
+        if data_format == "NDHWC":
+            a = jnp.moveaxis(a, -1, 1)
+        out = _pool(a, 3, kernel_size, stride, padding, "avg",
+                    count_include_pad=count_include_pad)
+        if data_format == "NDHWC":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return dispatch(fn, x, op_name="avg_pool3d")
+
+
+def _adaptive_cells(length, out):
+    return [int(math.floor(i * length / out)) for i in range(out + 1)]
+
+
+def _adaptive_pool_nd(a, sizes, reduce_fn, nd):
+    lead = a.shape[:-nd]
+    if all(a.shape[-nd + i] % sizes[i] == 0 for i in range(nd)):
+        shape = list(lead)
+        for i in range(nd):
+            shape += [sizes[i], a.shape[len(lead) + i] // sizes[i]]
+        r = a.reshape(shape)
+        axes = tuple(len(lead) + 2 * i + 1 for i in range(nd))
+        return reduce_fn(r, axes)
+    # general: per-cell windows (python loops — shapes are static)
+    import itertools
+
+    grids = [_adaptive_cells(a.shape[len(lead) + i], sizes[i])
+             for i in range(nd)]
+    cells = []
+    for idx in itertools.product(*(range(s) for s in sizes)):
+        sl = tuple(slice(None) for _ in lead) + tuple(
+            slice(grids[i][idx[i]], grids[i][idx[i] + 1]) for i in range(nd))
+        cells.append(reduce_fn(a[sl], tuple(range(len(lead),
+                                                  len(lead) + nd))))
+    out = jnp.stack(cells, axis=-1)
+    return out.reshape(lead + tuple(sizes))
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
+    os3 = _pair(output_size, 3)
+
+    def fn(a):
+        if data_format == "NDHWC":
+            a = jnp.moveaxis(a, -1, 1)
+        out = _adaptive_pool_nd(a, os3, lambda v, ax: v.mean(axis=ax), 3)
+        if data_format == "NDHWC":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return dispatch(fn, x, op_name="adaptive_avg_pool3d")
+
+
+def adaptive_max_pool3d(x, output_size, data_format="NCDHW"):
+    os3 = _pair(output_size, 3)
+
+    def fn(a):
+        if data_format == "NDHWC":
+            a = jnp.moveaxis(a, -1, 1)
+        out = _adaptive_pool_nd(a, os3, lambda v, ax: v.max(axis=ax), 3)
+        if data_format == "NDHWC":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return dispatch(fn, x, op_name="adaptive_max_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False):
+    def fn(a):
+        return _adaptive_pool_nd(a, [int(output_size)],
+                                 lambda v, ax: v.max(axis=ax), 1)
+
+    return dispatch(fn, x, op_name="adaptive_max_pool1d")
+
+
+def _conv_transpose_impl(a, w, b, stride, padding, output_padding, dilation,
+                         groups, nd, chan_last):
+    stride_ = _pair(stride, nd)
+    dil = _pair(dilation, nd)
+    pad_in = _pair(padding, nd)
+    opad = _pair(output_padding, nd)
+    if chan_last:
+        a = jnp.moveaxis(a, -1, 1)
+    kshape = w.shape  # (in, out/groups, k...)
+    pads = []
+    for i in range(nd):
+        k_eff = (kshape[2 + i] - 1) * dil[i] + 1
+        pads.append((k_eff - 1 - pad_in[i],
+                     k_eff - 1 - pad_in[i] + opad[i]))
+    w_flip = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+    w_t = jnp.swapaxes(w_flip, 0, 1)
+    if groups > 1:
+        w_t = jnp.reshape(
+            jnp.swapaxes(jnp.reshape(
+                w_flip, (groups, kshape[0] // groups) + kshape[1:]), 1, 2),
+            (kshape[1] * groups, kshape[0] // groups) + kshape[2:])
+    out = jax.lax.conv_general_dilated(
+        a, w_t, window_strides=(1,) * nd, padding=pads,
+        lhs_dilation=stride_, rhs_dilation=dil, feature_group_count=groups,
+        dimension_numbers=jax.lax.conv_dimension_numbers(
+            a.shape, w_t.shape, _dim_str(nd)))
+    if b is not None:
+        out = out + b.reshape((1, -1) + (1,) * nd)
+    if chan_last:
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCL", output_size=None):
+    args = (x, weight) + ((bias,) if bias is not None else ())
+
+    def fn(a, w, *b):
+        return _conv_transpose_impl(a, w, b[0] if b else None, stride,
+                                    padding, output_padding, dilation,
+                                    groups, 1, data_format == "NLC")
+
+    return dispatch(fn, *args, op_name="conv1d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCDHW", output_size=None):
+    args = (x, weight) + ((bias,) if bias is not None else ())
+
+    def fn(a, w, *b):
+        return _conv_transpose_impl(a, w, b[0] if b else None, stride,
+                                    padding, output_padding, dilation,
+                                    groups, 3, data_format == "NDHWC")
+
+    return dispatch(fn, *args, op_name="conv3d_transpose")
+
+
+# ---------------------------------------------------------------------------
+# loss / activation completions (reference warpctc_op, log_loss_op,
+# npair_loss, hierarchical_sigmoid_op, maxout_op, thresholded_relu)
+# ---------------------------------------------------------------------------
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC loss (reference warpctc_op) as a pure lax.scan forward DP over
+    the standard extended label sequence; differentiable by jax autodiff
+    (grad of logsumexp DP == the forward-backward soft alignment).
+
+    log_probs: [T, B, C] raw logits (softmax applied internally, matching
+    the reference's warpctc on activations); labels: [B, L] int padded.
+    """
+    lab = _v(labels)
+    in_len = _v(input_lengths).astype(jnp.int32)
+    lab_len = _v(label_lengths).astype(jnp.int32)
+
+    def fn(acts):
+        T, B, C = acts.shape
+        logp = jax.nn.log_softmax(acts.astype(jnp.float32), axis=-1)
+        L = lab.shape[1]
+        S = 2 * L + 1
+        # extended sequence: blank, l1, blank, l2, ... blank
+        ext = jnp.full((B, S), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        neg_inf = jnp.float32(-1e30)
+        # allow skip from s-2 when ext[s] != blank and ext[s] != ext[s-2]
+        can_skip = jnp.concatenate(
+            [jnp.zeros((B, 2), bool),
+             (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2])], axis=1)
+        alpha0 = jnp.full((B, S), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(logp[0, :, blank])
+        first_lab = jnp.take_along_axis(logp[0], ext[:, 1:2], axis=1)[:, 0]
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(lab_len > 0, first_lab, neg_inf))
+
+        def step(alpha, lp_t):
+            prev1 = jnp.concatenate(
+                [jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+            prev2 = jnp.concatenate(
+                [jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+            prev2 = jnp.where(can_skip, prev2, neg_inf)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, prev1), prev2)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return merged + emit, merged + emit
+
+        _, alphas = jax.lax.scan(step, alpha0, logp[1:])
+        alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T,B,S]
+        # per-sample: read alpha at t = in_len-1, s in {2*lab_len, 2*lab_len-1}
+        t_idx = jnp.clip(in_len - 1, 0, T - 1)
+        a_T = alphas[t_idx, jnp.arange(B)]  # [B, S]
+        s_last = jnp.clip(2 * lab_len, 0, S - 1)
+        s_prev = jnp.clip(2 * lab_len - 1, 0, S - 1)
+        ll = jnp.logaddexp(
+            jnp.take_along_axis(a_T, s_last[:, None], 1)[:, 0],
+            jnp.where(lab_len > 0,
+                      jnp.take_along_axis(a_T, s_prev[:, None], 1)[:, 0],
+                      neg_inf))
+        loss = -ll
+        if reduction == "mean":
+            return (loss / jnp.maximum(lab_len, 1)).mean()
+        if reduction == "sum":
+            return loss.sum()
+        return loss
+
+    return dispatch(fn, log_probs, op_name="ctc_loss")
+
+
+def log_loss(input, label, epsilon=1e-4):
+    def fn(p, y):
+        p = jnp.clip(p, epsilon, 1 - epsilon)
+        return -y * jnp.log(p) - (1 - y) * jnp.log(1 - p)
+
+    return dispatch(fn, input, label, op_name="log_loss")
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    def fn(p, y):
+        yh = jax.nn.one_hot(y.squeeze(-1), p.shape[-1], dtype=p.dtype)
+        inter = (p * yh).sum(axis=tuple(range(1, p.ndim)))
+        union = p.sum(axis=tuple(range(1, p.ndim))) + yh.sum(
+            axis=tuple(range(1, p.ndim)))
+        return (1 - (2 * inter + epsilon) / (union + epsilon)).mean()
+
+    return dispatch(fn, input, label, op_name="dice_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def fn(a, p):
+        logits = a @ p.T  # [B, B]
+        y = _v(labels).reshape(-1)
+        same = (y[:, None] == y[None, :]).astype(a.dtype)
+        tgt = same / same.sum(-1, keepdims=True)
+        ce = (-tgt * jax.nn.log_softmax(logits, -1)).sum(-1).mean()
+        reg = l2_reg * ((a * a).sum(-1) + (p * p).sum(-1)).mean() / 2
+        return ce + reg
+
+    return dispatch(fn, anchor, positive, op_name="npair_loss")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False):
+    """Hierarchical sigmoid over the default complete binary tree
+    (reference hierarchical_sigmoid_op default-path mode)."""
+    def fn(x, w, *b):
+        y = _v(label).reshape(-1)
+        code_len = max(1, int(math.ceil(math.log2(max(2, num_classes)))))
+        # node index path for each class in an implicit heap layout
+        codes = []
+        nodes = []
+        for d in range(code_len):
+            bit = (y >> (code_len - 1 - d)) & 1
+            node = (y >> (code_len - d)) + (2 ** d - 1)
+            codes.append(bit.astype(x.dtype))
+            nodes.append(jnp.clip(node, 0, w.shape[0] - 1))
+        loss = 0.0
+        for bit, node in zip(codes, nodes):
+            wn = w[node]  # [B, D]
+            logit = (x * wn).sum(-1)
+            if b:
+                logit = logit + b[0].reshape(-1)[node]
+            # bit==1 → sigmoid(logit) ; bit==0 → 1-sigmoid
+            loss = loss + jax.nn.softplus(logit) - bit * logit
+        return (loss / 1.0).mean()
+
+    args = (input, weight) + ((bias,) if bias is not None else ())
+    return dispatch(fn, *args, op_name="hsigmoid_loss")
+
+
+def maxout(x, groups, axis=1):
+    def fn(a):
+        ax = axis if axis >= 0 else a.ndim + axis
+        c = a.shape[ax]
+        shape = list(a.shape)
+        shape[ax:ax + 1] = [c // groups, groups]
+        return a.reshape(shape).max(axis=ax + 1)
+
+    return dispatch(fn, x, op_name="maxout")
+
+
+def thresholded_relu(x, threshold=1.0):
+    return dispatch(lambda a: jnp.where(a > threshold, a, 0.0), x,
+                    op_name="thresholded_relu")
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (reference gather_tree_op): follow parent
+    pointers from the last step to assemble full beams. [T, B, W] ids."""
+    idv = _v(ids)
+    pv = _v(parents)
+    T = idv.shape[0]
+
+    def step(nxt_parent, t):
+        ids_t = idv[t]
+        par_t = pv[t]
+        sel = jnp.take_along_axis(ids_t, nxt_parent, axis=1)
+        new_parent = jnp.take_along_axis(par_t, nxt_parent, axis=1)
+        return new_parent, sel
+
+    init = jnp.broadcast_to(jnp.arange(idv.shape[2], dtype=pv.dtype)[None],
+                            idv.shape[1:])
+    _, out = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+    return Tensor(out[::-1])
+
+
+def _inplace_apply(name, x, fn):
+    """Snapshot-based in-place (same discipline as tensor_api._inplace: the
+    recorded tape edge must point upstream, never at x itself)."""
+    from ...core import autograd as _ag
+
+    if (isinstance(x, Tensor) and not x.stop_gradient and x._node is None
+            and _ag.is_grad_enabled()):
+        raise RuntimeError(
+            f"{name}: a leaf Tensor that requires grad cannot be used in an "
+            "in-place operation")
+    snap = Tensor(x._value, stop_gradient=x.stop_gradient)
+    snap._node = x._node
+    snap._out_index = x._out_index
+    out = fn(snap)
+    x._value = out.value
+    x._node, x._out_index = out._node, out._out_index
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def elu_(x, alpha=1.0):
+    return _inplace_apply("elu_", x, lambda s: elu(s, alpha))
+
+
+def relu_(x):
+    return _inplace_apply("relu_", x, relu)
+
+
+def softmax_(x, axis=-1):
+    return _inplace_apply("softmax_", x, lambda s: softmax(s, axis))
+
+
+def tanh_(x):
+    return _inplace_apply("tanh_", x, tanh)
+
 
 
 # ---------------------------------------------------------------------------

@@ -105,3 +105,61 @@ class Conv2DTranspose(Layer):
         return F.conv2d_transpose(x, self.weight, self.bias, self.stride, self.padding,
                                   self.output_padding, self.dilation, self.groups,
                                   self.data_format, output_size)
+
+
+class _ConvTransposeNd(Layer):
+    """Shared ctor for Conv1DTranspose/Conv3DTranspose (reference
+    conv_transpose_op 1D/3D variants)."""
+
+    def __init__(self, nd, in_channels, out_channels, kernel_size, stride,
+                 padding, output_padding, dilation, groups, weight_attr,
+                 bias_attr, data_format):
+        super().__init__()
+        k = kernel_size if isinstance(kernel_size, (list, tuple)) \
+            else (kernel_size,) * nd
+        self.kernel_size = tuple(int(i) for i in k)
+        self.stride, self.padding = stride, padding
+        self.output_padding, self.dilation, self.groups = \
+            output_padding, dilation, groups
+        self.data_format = data_format
+        fan_in = in_channels * int(np.prod(self.kernel_size)) // groups
+        self.weight = self.create_parameter(
+            (in_channels, out_channels // groups, *self.kernel_size),
+            attr=weight_attr,
+            default_initializer=I.KaimingUniform(fan_in=fan_in))
+        if bias_attr is False:
+            self.bias = None
+            self._parameters["bias"] = None
+        else:
+            self.bias = self.create_parameter((out_channels,),
+                                              attr=bias_attr, is_bias=True)
+
+
+class Conv1DTranspose(_ConvTransposeNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__(1, in_channels, out_channels, kernel_size, stride,
+                         padding, output_padding, dilation, groups,
+                         weight_attr, bias_attr, data_format)
+
+    def forward(self, x, output_size=None):
+        return F.conv1d_transpose(x, self.weight, self.bias, self.stride,
+                                  self.padding, self.output_padding,
+                                  self.dilation, self.groups,
+                                  self.data_format, output_size)
+
+
+class Conv3DTranspose(_ConvTransposeNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__(3, in_channels, out_channels, kernel_size, stride,
+                         padding, output_padding, dilation, groups,
+                         weight_attr, bias_attr, data_format)
+
+    def forward(self, x, output_size=None):
+        return F.conv3d_transpose(x, self.weight, self.bias, self.stride,
+                                  self.padding, self.output_padding,
+                                  self.dilation, self.groups,
+                                  self.data_format, output_size)

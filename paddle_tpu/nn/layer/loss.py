@@ -106,3 +106,38 @@ class HingeEmbeddingLoss(Layer):
 
     def forward(self, input, label):
         return F.hinge_embedding_loss(input, label, self.margin, self.reduction)
+
+
+class CTCLoss(Layer):
+    """reference warpctc: see functional.ctc_loss (lax.scan forward DP)."""
+
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank, self.reduction = blank, reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          self.blank, self.reduction, norm_by_times)
+
+
+class HSigmoidLoss(Layer):
+    """reference hierarchical_sigmoid_op (default complete-binary-tree)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        self.num_classes = num_classes
+        self.weight = self.create_parameter((num_classes - 1, feature_size),
+                                            attr=weight_attr)
+        if bias_attr is False:
+            self.bias = None
+            self._parameters["bias"] = None
+        else:
+            self.bias = self.create_parameter((num_classes - 1, 1),
+                                              attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias, path_table, path_code)

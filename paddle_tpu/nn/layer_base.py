@@ -54,9 +54,18 @@ class Layer:
         else:
             if params and name in params:
                 if value is None:
+                    # keep the __dict__ mirror consistent: a None'd param
+                    # disappears from parameters() AND attribute reads
                     del params[name]
+                    if name in self.__dict__:
+                        object.__delattr__(self, name)
+                    return
                 elif isinstance(value, Tensor):
+                    # reparametrization (weight_norm etc.) swaps a derived
+                    # Tensor in for a Parameter: keep the fast-path __dict__
+                    # mirror in sync or reads keep seeing the stale object
                     params[name] = value
+                    object.__setattr__(self, name, value)
                     return
             if bufs is not None and name in bufs:
                 bufs[name] = value

@@ -1,0 +1,129 @@
+"""nn.utils — weight_norm / spectral_norm parametrizations.
+
+Reference capability: python/paddle/nn/utils/weight_norm_hook.py (weight
+re-parameterized as g * v/||v|| recomputed each forward via a pre-hook) and
+spectral_norm_hook.py.  TPU-first: the recompute is a couple of fused XLA
+ops inside whatever jit the forward runs under.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Parameter, Tensor
+from .. import functional as F  # noqa: F401  (parity import surface)
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm"]
+
+
+def _norm_except(v, dim):
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt((v.astype(jnp.float32) ** 2).sum(axis=axes,
+                                                     keepdims=True))
+
+
+def weight_norm(layer, name: str = "weight", dim: int = 0):
+    """Reparameterize ``layer.<name>`` as g * v / ||v||; g and v become the
+    trainable parameters, the original param is recomputed in a forward
+    pre-hook (reference weight_norm_hook.py)."""
+    w = getattr(layer, name)
+    if dim is None:
+        dim = -1  # whole-tensor norm
+    wv = w.value
+    if dim == -1:
+        g0 = jnp.sqrt((wv.astype(jnp.float32) ** 2).sum())
+    else:
+        g0 = _norm_except(wv, dim)
+    g = Parameter(g0.astype(wv.dtype), name=f"{name}_g")
+    v = Parameter(wv, name=f"{name}_v")
+    layer.add_parameter(f"{name}_g", g)
+    layer.add_parameter(f"{name}_v", v)
+    # the base weight is no longer independently trainable
+    w.trainable = False
+
+    def _recompute(lay, inputs):
+        # differentiable recompute on the tape: grads flow to g and v
+        import paddle_tpu as paddle
+
+        if dim == -1:
+            nrm_t = paddle.sqrt(paddle.sum(v * v))
+        else:
+            axes = [i for i in range(v.ndim) if i != dim]
+            nrm_t = paddle.sqrt(paddle.sum(v * v, axis=axes, keepdim=True))
+        setattr(lay, name, g * (v / (nrm_t + 1e-12)))
+        return None
+
+    h = layer.register_forward_pre_hook(_recompute)
+    layer._weight_norm_hooks = getattr(layer, "_weight_norm_hooks", {})
+    layer._weight_norm_hooks[name] = (h, g, v, dim)
+    _recompute(layer, None)
+    return layer
+
+
+def remove_weight_norm(layer, name: str = "weight"):
+    hooks = getattr(layer, "_weight_norm_hooks", {})
+    if name not in hooks:
+        return layer
+    h, g, v, dim = hooks.pop(name)
+    h.remove()
+    import paddle_tpu as paddle
+
+    with paddle.no_grad():
+        if dim == -1:
+            nrm = paddle.sqrt(paddle.sum(v * v))
+        else:
+            axes = [i for i in range(v.ndim) if i != dim]
+            nrm = paddle.sqrt(paddle.sum(v * v, axis=axes, keepdim=True))
+        w = Parameter((g.value * (v.value / (nrm.value + 1e-12))), name=name)
+    setattr(layer, name, w)
+    layer.add_parameter(name, w)
+    for pname in (f"{name}_g", f"{name}_v"):
+        layer._parameters.pop(pname, None)
+    return layer
+
+
+def spectral_norm(layer, name: str = "weight", n_power_iterations: int = 1,
+                  eps: float = 1e-12, dim: int = 0):
+    """Spectral normalization pre-hook (reference spectral_norm_hook.py):
+    weight / sigma_max, sigma estimated by persistent power iteration."""
+    w = getattr(layer, name)
+    wv = w.value
+    h = wv.shape[dim]
+    state = {
+        "u": jnp.asarray(np.random.default_rng(0).standard_normal(h),
+                         jnp.float32),
+        "orig": Parameter(wv, name=f"{name}_orig"),
+    }
+    layer.add_parameter(f"{name}_orig", state["orig"])
+    w.trainable = False
+
+    def _apply(lay, inputs):
+        import paddle_tpu as paddle
+
+        ov = state["orig"]
+        mat = jnp.moveaxis(ov.value, dim, 0).reshape(ov.value.shape[dim], -1)
+        # power iteration under stop_gradient (torch/reference semantics:
+        # u, v are buffers); sigma = u^T W v keeps the gradient path
+        # through W so grads of weight/sigma flow to the orig param
+        u = jax.lax.stop_gradient(state["u"])
+        m_sg = jax.lax.stop_gradient(mat).astype(jnp.float32)
+        v = None
+        for _ in range(n_power_iterations):
+            v = m_sg.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = m_sg @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        if not isinstance(u, jax.core.Tracer):  # persist only when eager
+            state["u"] = u
+        u_t = Tensor(u)
+        v_t = Tensor(v)
+        mat_t = paddle.reshape(
+            paddle.moveaxis(ov, dim, 0), [ov.value.shape[dim], -1])
+        sigma = paddle.sum(u_t * paddle.matmul(mat_t, v_t))
+        setattr(lay, name, ov / (sigma + eps))
+        return None
+
+    layer.register_forward_pre_hook(_apply)
+    _apply(layer, None)
+    return layer

@@ -304,6 +304,29 @@ class AdamW(Adam):
         self._apply_decay_fun = apply_decay_param_fun
 
 
+class Adadelta(Optimizer):
+    """reference adadelta_op: accumulated squared grads + squared updates."""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._eps, self._rho = epsilon, rho
+
+    def _init_leaf(self, p):
+        return (jnp.zeros_like(p, dtype=jnp.float32),
+                jnp.zeros_like(p, dtype=jnp.float32))
+
+    def _update_leaf(self, g, p, state, lr, step):
+        avg_sq_g, avg_sq_u = state
+        g32 = g.astype(jnp.float32)
+        r = self._rho
+        avg_sq_g = r * avg_sq_g + (1 - r) * g32 * g32
+        upd = jnp.sqrt(avg_sq_u + self._eps) / jnp.sqrt(avg_sq_g + self._eps) * g32
+        avg_sq_u = r * avg_sq_u + (1 - r) * upd * upd
+        return (p.astype(jnp.float32) - lr * upd).astype(p.dtype), (avg_sq_g, avg_sq_u)
+
+
 class Adamax(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=None, grad_clip=None, name=None):

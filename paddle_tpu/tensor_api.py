@@ -1258,6 +1258,40 @@ def _inplace(name, fn):
     return op
 
 
+@_public
+def reverse(x, axis):
+    return flip(x, axis)
+
+
+# -- LoD tensor-array ops (reference lod_tensor_array + array ops): a plain
+# python list plays the TensorArray role; inside jit use lax.scan instead ----
+
+@_public
+def create_array(dtype="float32", initialized_list=None):
+    return list(initialized_list or [])
+
+
+@_public
+def array_write(x, i, array=None):
+    if array is None:
+        array = []
+    i = int(_v(i)) if not isinstance(i, int) else i
+    while len(array) <= i:
+        array.append(None)
+    array[i] = x if isinstance(x, Tensor) else Tensor(_v(x))
+    return array
+
+
+@_public
+def array_read(array, i):
+    return array[int(_v(i)) if not isinstance(i, int) else i]
+
+
+@_public
+def array_length(array):
+    return Tensor(jnp.asarray(len(array), jnp.int64))
+
+
 reshape_ = _inplace("reshape_", lambda x, s: reshape(x, s))
 scatter_ = _inplace("scatter_", lambda x, *a, **k: scatter(x, *a, **k))
 squeeze_ = _inplace("squeeze_", lambda x, *a, **k: squeeze(x, *a, **k))
@@ -1273,6 +1307,7 @@ ceil_ = _inplace("ceil_", lambda x: ceil(x))
 floor_ = _inplace("floor_", lambda x: floor(x))
 scale_ = _inplace("scale_", lambda x, *a, **k: scale(x, *a, **k))
 subtract_ = _inplace("subtract_", lambda x, y: subtract(x, y))
+flatten_ = _inplace("flatten_", lambda x, *a, **k: flatten(x, *a, **k))
 add_ = _inplace("add_", lambda x, y: add(x, y))
 
 

@@ -1,3 +1,35 @@
 """paddle_tpu.vision (reference python/paddle/vision)."""
 from . import models, ops, transforms  # noqa: F401
 from .datasets import MNIST, FakeImageDataset  # noqa: F401
+from .models import LeNet  # noqa: F401  (reference exposes it at vision/)
+
+_image_backend = "numpy"
+
+
+def set_image_backend(backend: str):
+    """reference vision/image.py: 'pil'/'cv2' — this build is numpy-native;
+    accepted values are recorded but all decoding is numpy."""
+    global _image_backend
+    if backend not in ("numpy", "pil", "cv2"):
+        raise ValueError(f"unknown image backend {backend!r}")
+    _image_backend = backend
+
+
+def get_image_backend() -> str:
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image file as an HWC numpy array (PNG/PPM/BMP via stdlib;
+    no PIL/cv2 in this environment)."""
+    import numpy as np
+
+    with open(path, "rb") as f:
+        head = f.read(2)
+    if head == b"\x42\x4d" or str(path).endswith(".bmp"):
+        raise NotImplementedError("BMP decoding not supported; use .npy")
+    if str(path).endswith(".npy"):
+        return np.load(path)
+    raise NotImplementedError(
+        "image_load supports .npy arrays in this environment (no PIL/cv2); "
+        "decode images offline into arrays")

@@ -1,0 +1,157 @@
+"""nn completions: 3D pools, transposed convs, CTC, hsigmoid, decode,
+weight/spectral norm (reference nn test files: test_pool3d_op, test_warpctc,
+test_beam_search_decoder, test_weight_norm)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+
+def test_pool3d_layers():
+    x = paddle.randn([2, 3, 8, 8, 8])
+    assert tuple(nn.MaxPool3D(2)(x).shape) == (2, 3, 4, 4, 4)
+    assert tuple(nn.AvgPool3D(2)(x).shape) == (2, 3, 4, 4, 4)
+    assert tuple(nn.AdaptiveAvgPool3D(2)(x).shape) == (2, 3, 2, 2, 2)
+    assert tuple(nn.AdaptiveMaxPool3D(2)(x).shape) == (2, 3, 2, 2, 2)
+    # adaptive max == max over cells
+    v = np.asarray(nn.AdaptiveMaxPool3D(1)(x).value)
+    np.testing.assert_allclose(
+        v[..., 0, 0, 0], np.asarray(x.value).max((2, 3, 4)), rtol=1e-6)
+
+
+def test_conv_transpose_1d3d_shapes_and_grad():
+    c1 = nn.Conv1DTranspose(4, 6, 3, stride=2)
+    y = c1(paddle.randn([2, 4, 8]))
+    assert tuple(y.shape) == (2, 6, 17)
+    loss = paddle.sum(y * y)
+    loss.backward()
+    assert c1.weight.grad is not None
+
+    c3 = nn.Conv3DTranspose(2, 3, 3, stride=2)
+    y3 = c3(paddle.randn([1, 2, 4, 4, 4]))
+    assert tuple(y3.shape) == (1, 3, 9, 9, 9)
+
+
+def test_conv1d_transpose_matches_torch():
+    import torch
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 4, 8)).astype(np.float32)
+    w = rng.standard_normal((4, 6, 3)).astype(np.float32)
+    ours = F.conv1d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                              stride=2, padding=1)
+    ref = torch.nn.functional.conv_transpose1d(
+        torch.tensor(x), torch.tensor(w), stride=2, padding=1)
+    np.testing.assert_allclose(np.asarray(ours.value), ref.numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_ctc_loss_matches_torch():
+    import torch
+
+    rng = np.random.default_rng(0)
+    T, B, C, L = 10, 2, 6, 3
+    logits = rng.standard_normal((T, B, C)).astype(np.float32)
+    labels = rng.integers(1, C, (B, L)).astype(np.int64)
+    il = np.array([10, 7], np.int64)
+    ll = np.array([3, 2], np.int64)
+    ours = F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                      paddle.to_tensor(il), paddle.to_tensor(ll),
+                      reduction="none")
+    ref = torch.nn.functional.ctc_loss(
+        torch.log_softmax(torch.tensor(logits), -1), torch.tensor(labels),
+        torch.tensor(il), torch.tensor(ll), reduction="none")
+    np.testing.assert_allclose(np.asarray(ours.value), ref.numpy(),
+                               rtol=1e-4)
+
+
+def test_hsigmoid_and_misc_losses():
+    x = paddle.randn([8, 16])
+    y = paddle.to_tensor(np.random.default_rng(0).integers(0, 10, 8))
+    hs = nn.HSigmoidLoss(16, 10)
+    loss = hs(x, y)
+    assert np.isfinite(float(np.asarray(loss.value)))
+    p = paddle.to_tensor(np.random.default_rng(1).random((4, 1)).astype(
+        np.float32))
+    lbl = paddle.to_tensor(np.array([[1.], [0.], [1.], [0.]], np.float32))
+    ll = F.log_loss(p, lbl)
+    assert tuple(ll.shape) == (4, 1)
+    a = paddle.randn([6, 8])
+    pos = paddle.randn([6, 8])
+    ids = paddle.to_tensor(np.array([0, 1, 2, 0, 1, 2], np.int64))
+    assert np.isfinite(float(np.asarray(F.npair_loss(a, pos, ids).value)))
+
+
+def test_beam_search_decoder_prefers_likely_sequence():
+    """Cell with a fixed transition matrix: beam search must recover the
+    greedy-optimal path and stop at end_token."""
+    V, H, W = 6, 6, 3
+    emb = nn.Embedding(V, H)
+
+    class DummyCell(nn.Layer):
+        def forward(self, x, states):
+            return x, states  # output = current token's embedding
+
+    # logits projection: favor token (argmax of state) + 1, then end at 5
+    proj = nn.Linear(H, V)
+    with paddle.no_grad():
+        w = np.zeros((H, V), np.float32)
+        for i in range(V - 1):
+            w[i, i + 1] = 5.0
+        proj.weight._value = paddle.to_tensor(w).value
+        proj.bias._value = paddle.to_tensor(np.zeros(V, np.float32)).value
+        e = np.zeros((V, H), np.float32)
+        for i in range(V):
+            e[i, i] = 1.0
+        emb.weight._value = paddle.to_tensor(e).value
+
+    dec = nn.BeamSearchDecoder(DummyCell(), start_token=0, end_token=V - 1,
+                               beam_size=W, embedding_fn=emb,
+                               output_fn=proj)
+    import jax.numpy as jnp
+
+    init_state = paddle.zeros([2, H])
+    ids, lp, lens = nn.dynamic_decode(dec, init_state, max_step_num=10)
+    best = np.asarray(ids.value)[:, 0]  # top beam per batch
+    # path 1,2,3,4,5(end) from start 0
+    np.testing.assert_array_equal(best[0][:5], [1, 2, 3, 4, 5])
+
+
+def test_gather_tree_backtrace():
+    ids = paddle.to_tensor(np.array(
+        [[[1, 2]], [[3, 4]], [[5, 6]]], np.int32))  # [T=3, B=1, W=2]
+    parents = paddle.to_tensor(np.array(
+        [[[0, 0]], [[1, 0]], [[0, 1]]], np.int32))
+    out = np.asarray(F.gather_tree(ids, parents).value)
+    assert out.shape == (3, 1, 2)
+    # beam 0 at t=2 came from parent 0 (t=2 value 5), whose parent chain:
+    # parents[2][0]=0 -> t=1 beam 0 value 3? parent[1][0]=1 -> t=0 beam 1=2
+    np.testing.assert_array_equal(out[:, 0, 0], [2, 3, 5])
+
+
+def test_weight_norm_trains_and_removes():
+    lin = nn.Linear(4, 2)
+    w0 = np.asarray(lin.weight.value).copy()
+    nn.utils.weight_norm(lin, "weight", dim=0)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+    for _ in range(3):
+        loss = paddle.sum(lin(paddle.ones([2, 4])) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    nn.utils.remove_weight_norm(lin, "weight")
+    assert not np.allclose(np.asarray(lin.weight.value), w0)
+
+
+def test_spectral_norm_shrinks_sigma():
+    lin = nn.Linear(6, 6)
+    with paddle.no_grad():
+        lin.weight._value = (lin.weight.value * 10.0)
+    nn.utils.spectral_norm(lin, "weight", n_power_iterations=2)
+    for _ in range(10):  # power iteration converges across forwards
+        lin(paddle.ones([1, 6]))
+    sigma = np.linalg.svd(np.asarray(lin.weight.value))[1][0]
+    assert sigma < 1.5, sigma
