@@ -108,14 +108,16 @@ def dynamic_decode(decoder, inits=None, max_step_num=64, batch_size=None,
     ids_steps, parent_steps = [], []
     lengths = jnp.zeros(finished.shape, jnp.int32)
     for _ in range(int(max_step_num)):
-        # count this step for every beam not already finished BEFORE it —
-        # the step that emits end_token is included, and a never-finishing
-        # beam tops out at exactly max_step_num (== tokens returned)
-        lengths = lengths + (~finished).astype(jnp.int32)
         tokens, parents, states, log_probs, new_fin = decoder.step(
             tokens, states, log_probs, finished)
         ids_steps.append(tokens)
         parent_steps.append(parents)
+        # lengths follow their beam through top-k reordering (slot w now
+        # continues parent slot parents[w]); count the step when the parent
+        # was not already finished — the end_token-emitting step included,
+        # and a never-finishing beam tops out at exactly max_step_num
+        lengths = jnp.take_along_axis(lengths, parents, 1) + (
+            ~jnp.take_along_axis(finished, parents, 1)).astype(jnp.int32)
         finished = new_fin
         if bool(jnp.all(finished)):
             break

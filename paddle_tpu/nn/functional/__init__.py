@@ -348,7 +348,8 @@ def conv2d_transpose(
         # flip/regroup/lhs_dilation formulation
         return _conv_transpose_impl(a, w, b[0] if b else None, stride,
                                     padding, output_padding, dilation,
-                                    groups, nd, data_format == "NHWC")
+                                    groups, nd, data_format == "NHWC",
+                                    output_size)
 
     args = (x, weight) + ((bias,) if bias is not None else ())
     return dispatch(fn, *args, op_name="conv2d_transpose")
@@ -1159,13 +1160,27 @@ def adaptive_max_pool1d(x, output_size, return_mask=False):
 
 
 def _conv_transpose_impl(a, w, b, stride, padding, output_padding, dilation,
-                         groups, nd, chan_last):
+                         groups, nd, chan_last, output_size=None):
     stride_ = _pair(stride, nd)
     dil = _pair(dilation, nd)
     pad_in = _pair(padding, nd)
     opad = _pair(output_padding, nd)
     if chan_last:
         a = jnp.moveaxis(a, -1, 1)
+    if output_size is not None:
+        # reference semantics: output_size resolves the transposed-conv
+        # output ambiguity by choosing output_padding
+        osz = _pair(output_size, nd)
+        opad = []
+        for i in range(nd):
+            k_eff = (w.shape[2 + i] - 1) * dil[i] + 1
+            base = (a.shape[2 + i] - 1) * stride_[i] - 2 * pad_in[i] + k_eff
+            extra = int(osz[i]) - base
+            if not 0 <= extra < max(1, stride_[i]):
+                raise ValueError(
+                    f"output_size {osz[i]} unreachable for dim {i}: base "
+                    f"{base}, stride {stride_[i]}")
+            opad.append(extra)
     kshape = w.shape  # (in, out/groups, k...)
     pads = []
     for i in range(nd):
@@ -1199,7 +1214,8 @@ def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
     def fn(a, w, *b):
         return _conv_transpose_impl(a, w, b[0] if b else None, stride,
                                     padding, output_padding, dilation,
-                                    groups, 1, data_format == "NLC")
+                                    groups, 1, data_format == "NLC",
+                                    output_size)
 
     return dispatch(fn, *args, op_name="conv1d_transpose")
 
@@ -1212,7 +1228,8 @@ def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
     def fn(a, w, *b):
         return _conv_transpose_impl(a, w, b[0] if b else None, stride,
                                     padding, output_padding, dilation,
-                                    groups, 3, data_format == "NDHWC")
+                                    groups, 3, data_format == "NDHWC",
+                                    output_size)
 
     return dispatch(fn, *args, op_name="conv3d_transpose")
 
@@ -1319,30 +1336,55 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002):
     return dispatch(fn, anchor, positive, op_name="npair_loss")
 
 
+def _hsigmoid_paths(num_classes: int):
+    """Root-to-leaf paths in the complete binary tree with `num_classes`
+    leaves and num_classes-1 internal nodes (heap layout: internal node i
+    has children 2i+1, 2i+2; node >= num_classes-1 is leaf num=node-(C-1)).
+    Returns (nodes [C, D], codes [C, D], mask [C, D]) numpy constants."""
+    C = num_classes
+    paths, codes = [], []
+    for y in range(C):
+        node = y + C - 1  # leaf position in the full heap
+        p, cds = [], []
+        while node > 0:
+            parent = (node - 1) // 2
+            cds.append(node == 2 * parent + 2)  # right child → bit 1
+            p.append(parent)
+            node = parent
+        paths.append(p[::-1])
+        codes.append(cds[::-1])
+    D = max(len(p) for p in paths)
+    nodes = np.zeros((C, D), np.int32)
+    bits = np.zeros((C, D), np.float32)
+    mask = np.zeros((C, D), np.float32)
+    for y in range(C):
+        L = len(paths[y])
+        nodes[y, :L] = paths[y]
+        bits[y, :L] = codes[y]
+        mask[y, :L] = 1.0
+    return nodes, bits, mask
+
+
 def hsigmoid_loss(input, label, num_classes, weight, bias=None,
                   path_table=None, path_code=None, is_sparse=False):
-    """Hierarchical sigmoid over the default complete binary tree
-    (reference hierarchical_sigmoid_op default-path mode)."""
+    """Hierarchical sigmoid over the complete binary tree with num_classes
+    leaves (reference hierarchical_sigmoid_op default-path mode): per-class
+    root→leaf node/code paths are exact precomputed constants, so the loss
+    normalizes over classes for any num_classes (not only powers of two)."""
+    nodes_np, bits_np, mask_np = _hsigmoid_paths(int(num_classes))
+
     def fn(x, w, *b):
         y = _v(label).reshape(-1)
-        code_len = max(1, int(math.ceil(math.log2(max(2, num_classes)))))
-        # node index path for each class in an implicit heap layout
-        codes = []
-        nodes = []
-        for d in range(code_len):
-            bit = (y >> (code_len - 1 - d)) & 1
-            node = (y >> (code_len - d)) + (2 ** d - 1)
-            codes.append(bit.astype(x.dtype))
-            nodes.append(jnp.clip(node, 0, w.shape[0] - 1))
-        loss = 0.0
-        for bit, node in zip(codes, nodes):
-            wn = w[node]  # [B, D]
-            logit = (x * wn).sum(-1)
-            if b:
-                logit = logit + b[0].reshape(-1)[node]
-            # bit==1 → sigmoid(logit) ; bit==0 → 1-sigmoid
-            loss = loss + jax.nn.softplus(logit) - bit * logit
-        return (loss / 1.0).mean()
+        nodes = jnp.asarray(nodes_np)[y]  # [B, D]
+        bits = jnp.asarray(bits_np)[y]
+        mask = jnp.asarray(mask_np)[y]
+        wn = w[nodes]  # [B, D, dim]
+        logit = (x[:, None, :] * wn).sum(-1)  # [B, D]
+        if b:
+            logit = logit + b[0].reshape(-1)[nodes]
+        # bit==1 → sigmoid(logit); bit==0 → 1-sigmoid; masked steps 0
+        nll = (jax.nn.softplus(logit) - bits * logit) * mask
+        return nll.sum(-1).mean()
 
     args = (input, weight) + ((bias,) if bias is not None else ())
     return dispatch(fn, *args, op_name="hsigmoid_loss")

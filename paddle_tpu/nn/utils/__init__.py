@@ -26,12 +26,13 @@ def _norm_except(v, dim):
 def weight_norm(layer, name: str = "weight", dim: int = 0):
     """Reparameterize ``layer.<name>`` as g * v / ||v||; g and v become the
     trainable parameters, the original param is recomputed in a forward
-    pre-hook (reference weight_norm_hook.py)."""
+    pre-hook (reference weight_norm_hook.py).  dim=None norms the whole
+    tensor (scalar g); negative dims count from the end."""
     w = getattr(layer, name)
-    if dim is None:
-        dim = -1  # whole-tensor norm
     wv = w.value
-    if dim == -1:
+    if dim is not None:
+        dim = dim % wv.ndim  # -1 means the LAST axis, not whole-tensor
+    if dim is None:
         g0 = jnp.sqrt((wv.astype(jnp.float32) ** 2).sum())
     else:
         g0 = _norm_except(wv, dim)
@@ -46,7 +47,7 @@ def weight_norm(layer, name: str = "weight", dim: int = 0):
         # differentiable recompute on the tape: grads flow to g and v
         import paddle_tpu as paddle
 
-        if dim == -1:
+        if dim is None:
             nrm_t = paddle.sqrt(paddle.sum(v * v))
         else:
             axes = [i for i in range(v.ndim) if i != dim]
@@ -70,7 +71,7 @@ def remove_weight_norm(layer, name: str = "weight"):
     import paddle_tpu as paddle
 
     with paddle.no_grad():
-        if dim == -1:
+        if dim is None:
             nrm = paddle.sqrt(paddle.sum(v * v))
         else:
             axes = [i for i in range(v.ndim) if i != dim]
@@ -79,7 +80,7 @@ def remove_weight_norm(layer, name: str = "weight"):
     setattr(layer, name, w)
     layer.add_parameter(name, w)
     for pname in (f"{name}_g", f"{name}_v"):
-        layer._parameters.pop(pname, None)
+        setattr(layer, pname, None)  # clears _parameters AND __dict__ mirror
     return layer
 
 

@@ -5,3 +5,4 @@ This package plays the role of the reference's hand-optimised CUDA kernels
 fused_attention precursors), re-done as Pallas TPU kernels.
 """
 from . import attention, sequence  # noqa: F401
+from . import crf  # noqa: F401

@@ -27,7 +27,7 @@ from .program import (Variable, _VarRef, _require_prog, create_parameter,
                       data)
 
 __all__ = [
-    "fc", "embedding", "sparse_embedding", "conv2d", "conv2d_transpose",
+    "crf_decoding", "linear_chain_crf", "fc", "embedding", "sparse_embedding", "conv2d", "conv2d_transpose",
     "conv3d", "batch_norm", "layer_norm", "instance_norm", "group_norm",
     "prelu", "data_norm", "cond", "case", "switch_case", "while_loop",
     "py_func", "sequence_pool", "sequence_softmax", "sequence_first_step",
@@ -471,3 +471,61 @@ sequence_unpad = _seq("sequence_unpad")
 sequence_reverse = _seq("sequence_reverse")
 sequence_expand = _seq("sequence_expand")
 sequence_mask = _seq("sequence_mask")
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    """CRF NLL loss with a created transition parameter (reference
+    fluid/layers linear_chain_crf over linear_chain_crf_op).  param_attr
+    may be a name string; calls sharing the name share the SAME transition
+    parameter (reference param_attr semantics) — distinct CRF heads must
+    pass distinct names."""
+    from ..ops import crf as _crf
+
+    C = _static_dim(input, input.ndim - 1, "linear_chain_crf")
+    pname = param_attr if isinstance(param_attr, str) else "crf_transition"
+    prog0 = static_mode.recording()
+    existing = (prog0._root().parameters.get(pname)
+                if prog0 is not None else None)
+    if existing is not None:
+        if tuple(existing.shape) != (C, C):
+            raise ValueError(
+                f"CRF transition {pname!r} exists with shape "
+                f"{tuple(existing.shape)}, need {(C, C)}; pass a distinct "
+                "param_attr name for a second CRF head")
+        tr = existing
+    else:
+        tr = create_parameter([C, C], input.dtype, name=pname)
+    prog = static_mode.recording()
+    if prog is not None:
+        def impl(em, trp, lab, *rest):
+            ln = rest[0] if rest else None
+            return _crf.linear_chain_crf(em, trp, lab, ln)
+        args = (input, tr, label) + ((length,) if length is not None else ())
+        return prog.record_call(impl, args, {})
+    return _crf.linear_chain_crf(input, tr, label, length)
+
+
+def crf_decoding(input, param_attr=None, label=None, length=None,
+                 transition=None):
+    """Viterbi decode (reference crf_decoding op). ``transition`` may be the
+    Parameter created by linear_chain_crf."""
+    from ..ops import crf as _crf
+
+    if transition is None:
+        pname = param_attr if isinstance(param_attr, str) else "crf_transition"
+        prog = (static_mode.recording() or
+                __import__("paddle_tpu").static.default_main_program())
+        transition = prog._root().parameters.get(pname)
+        if transition is None:
+            raise ValueError("crf_decoding needs linear_chain_crf first or "
+                             "an explicit transition parameter")
+    prog = static_mode.recording()
+    if prog is not None:
+        def impl(em, trp, *rest):
+            ln = rest[0] if rest else None
+            s, p = _crf.viterbi_decode(em, trp, ln)
+            return p
+        args = (input, transition) + ((length,) if length is not None else ())
+        return prog.record_call(impl, args, {})
+    _, p = _crf.viterbi_decode(input, transition, length)
+    return p

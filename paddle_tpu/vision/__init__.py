@@ -20,8 +20,8 @@ def get_image_backend() -> str:
 
 
 def image_load(path, backend=None):
-    """Load an image file as an HWC numpy array (PNG/PPM/BMP via stdlib;
-    no PIL/cv2 in this environment)."""
+    """Load an image as an HWC numpy array. Only ``.npy`` arrays are
+    supported in this environment (no PIL/cv2); decode images offline."""
     import numpy as np
 
     with open(path, "rb") as f:

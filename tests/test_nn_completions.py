@@ -155,3 +155,65 @@ def test_spectral_norm_shrinks_sigma():
         lin(paddle.ones([1, 6]))
     sigma = np.linalg.svd(np.asarray(lin.weight.value))[1][0]
     assert sigma < 1.5, sigma
+
+
+def test_hsigmoid_normalizes_over_classes():
+    """For any num_classes (incl. non-powers-of-two) the implied class
+    probabilities must sum to 1 (regression: node aliasing broke this)."""
+    import math as _math
+
+    rng = np.random.default_rng(0)
+    C, D = 10, 6
+    x = rng.standard_normal((1, D)).astype(np.float32)
+    w = rng.standard_normal((C - 1, D)).astype(np.float32)
+    b = rng.standard_normal((C - 1, 1)).astype(np.float32)
+    total = 0.0
+    for y in range(C):
+        loss = F.hsigmoid_loss(paddle.to_tensor(x),
+                               paddle.to_tensor(np.array([y], np.int64)),
+                               C, paddle.to_tensor(w), paddle.to_tensor(b))
+        total += _math.exp(-float(np.asarray(loss.value)))
+    np.testing.assert_allclose(total, 1.0, rtol=1e-4)
+
+
+def test_conv_transpose_output_size():
+    x = paddle.randn([2, 4, 8])
+    w = paddle.randn([4, 6, 3])
+    y = F.conv1d_transpose(x, w, stride=2, output_size=18)
+    assert tuple(y.shape) == (2, 6, 18)
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        F.conv1d_transpose(x, w, stride=2, output_size=25)
+
+
+def test_dynamic_decode_lengths_follow_beams():
+    """Sequence lengths must be permuted with their beams (regression)."""
+    V, H, W = 5, 5, 2
+    emb = nn.Embedding(V, H)
+
+    class Cell(nn.Layer):
+        def forward(self, x, states):
+            return x, states
+
+    proj = nn.Linear(H, V)
+    with paddle.no_grad():
+        w = np.zeros((H, V), np.float32)
+        w[1, 4] = 3.0  # after token 1, end (4) is likely
+        w[2, 2] = 3.0  # after token 2, keep emitting 2
+        e = np.eye(V, dtype=np.float32)
+        proj.weight._value = paddle.to_tensor(w).value
+        proj.bias._value = paddle.to_tensor(
+            np.array([0, 1.0, 0.9, 0, 0], np.float32)).value
+        emb.weight._value = paddle.to_tensor(e).value
+    dec = nn.BeamSearchDecoder(Cell(), start_token=0, end_token=4,
+                               beam_size=W, embedding_fn=emb, output_fn=proj)
+    ids, lp, lens = nn.dynamic_decode(dec, paddle.zeros([1, H]),
+                                      max_step_num=6)
+    out = np.asarray(ids.value)[0]  # [W, T]
+    L = np.asarray(lens.value)[0]
+    for wbeam in range(W):
+        toks = out[wbeam][:L[wbeam]]
+        if 4 in out[wbeam]:
+            # length must point exactly at the end token
+            assert toks[-1] == 4, (out[wbeam], L[wbeam])

@@ -266,3 +266,57 @@ def test_bf16_tolerance(case):
     got = np.asarray(out.value, np.float64)
     want = np.asarray(ref(*arrays), np.float64)
     np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+
+
+# -- batch-4 completions sweep (pool3d/conv-transpose/linalg additions) ------
+
+class TestCompletionOps:
+    def test_pool3d_vs_numpy(self):
+        import paddle_tpu.nn.functional as F
+
+        x = _std((2, 3, 4, 4, 4))
+        out = np.asarray(F.max_pool3d(paddle.to_tensor(x), 2).value)
+        ref = x.reshape(2, 3, 2, 2, 2, 2, 2, 2).max((3, 5, 7))
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+        out = np.asarray(F.avg_pool3d(paddle.to_tensor(x), 2).value)
+        ref = x.reshape(2, 3, 2, 2, 2, 2, 2, 2).mean((3, 5, 7))
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_linalg_vs_numpy(self):
+        a = _std((4, 4)) + 4 * np.eye(4, dtype=np.float32)
+        spd = a @ a.T
+        np.testing.assert_allclose(
+            np.asarray(paddle.cholesky(paddle.to_tensor(spd)).value),
+            np.linalg.cholesky(spd), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(paddle.inverse(paddle.to_tensor(spd)).value),
+            np.linalg.inv(spd), rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(paddle.matrix_power(paddle.to_tensor(a), 3).value),
+            np.linalg.matrix_power(a, 3), rtol=1e-4, atol=1e-3)
+        np.testing.assert_allclose(
+            np.asarray(paddle.diagonal(paddle.to_tensor(a)).value),
+            np.diagonal(a), rtol=1e-6)
+
+    def test_inverse_numeric_grad(self):
+        a = _std((3, 3)) + 3 * np.eye(3, dtype=np.float32)
+        t = paddle.to_tensor(a)
+        t.stop_gradient = False
+        loss = paddle.sum(paddle.inverse(t) ** 2)
+        loss.backward()
+        g = np.asarray(t.grad.value)
+        ng = numeric_grad(
+            lambda arr: float(np.sum(np.linalg.inv(arr) ** 2)), [a], 0)
+        np.testing.assert_allclose(g, ng, rtol=2e-2, atol=1e-3)
+
+    def test_maxout_grad_routes_to_max(self):
+        import paddle_tpu.nn.functional as F
+
+        x = paddle.to_tensor(_std((2, 4, 3)))
+        x.stop_gradient = False
+        loss = paddle.sum(F.maxout(x, 2))
+        loss.backward()
+        g = np.asarray(x.grad.value)
+        # exactly one of each channel pair receives gradient 1
+        pairs = g.reshape(2, 2, 2, 3).sum(2)
+        np.testing.assert_allclose(pairs, 1.0)
