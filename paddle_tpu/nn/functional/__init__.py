@@ -14,6 +14,7 @@ lax.conv_general_dilated which XLA lays out optimally for the MXU.
 """
 from __future__ import annotations
 
+import functools
 import math
 
 import jax
@@ -1169,7 +1170,11 @@ def _conv_transpose_impl(a, w, b, stride, padding, output_padding, dilation,
         a = jnp.moveaxis(a, -1, 1)
     if output_size is not None:
         # reference semantics: output_size resolves the transposed-conv
-        # output ambiguity by choosing output_padding
+        # output ambiguity by choosing output_padding — the two arguments
+        # are mutually exclusive (the reference raises on both)
+        if any(p != 0 for p in opad):
+            raise ValueError(
+                "output_padding and output_size may not both be set")
         osz = _pair(output_size, nd)
         opad = []
         for i in range(nd):
@@ -1336,6 +1341,7 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002):
     return dispatch(fn, anchor, positive, op_name="npair_loss")
 
 
+@functools.lru_cache(maxsize=64)
 def _hsigmoid_paths(num_classes: int):
     """Root-to-leaf paths in the complete binary tree with `num_classes`
     leaves and num_classes-1 internal nodes (heap layout: internal node i

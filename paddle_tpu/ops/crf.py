@@ -81,8 +81,13 @@ def linear_chain_crf(emission, transition, label, length=None,
 
 def viterbi_decode(emission, transition, length=None, start=None, stop=None,
                    include_bos_eos_tag=False):
-    """Most-likely label path (reference crf_decoding_op): returns
-    (scores [B], paths [B, T])."""
+    """Most-likely label path (reference crf_decoding_op /
+    paddle.text.ViterbiDecoder): returns (scores [B], paths [B, T]).
+
+    include_bos_eos_tag=True follows the reference convention: the LAST TWO
+    tags of the transition matrix are BOS and EOS — transitions out of BOS
+    provide the start scores, transitions into EOS the stop scores, and
+    neither tag may appear in the decoded path."""
     em = _v(emission).astype(jnp.float32)
     tr = _v(transition).astype(jnp.float32)
     B, T, C = em.shape
@@ -90,6 +95,13 @@ def viterbi_decode(emission, transition, length=None, start=None, stop=None,
             else jnp.full((B,), T, jnp.int32))
     st = _v(start).astype(jnp.float32) if start is not None else jnp.zeros(C)
     sp = _v(stop).astype(jnp.float32) if stop is not None else jnp.zeros(C)
+    if include_bos_eos_tag:
+        bos, eos = C - 2, C - 1
+        st = st + tr[bos]  # scores for the first real tag
+        sp = sp + tr[:, eos]
+        bar = jnp.full((C,), -1e30, jnp.float32)
+        bar = bar.at[:C - 2].set(0.0)
+        em = em + bar[None, None, :]  # BOS/EOS never emitted mid-sequence
     mask = (jnp.arange(T)[None, :] < lens[:, None])
 
     def step(delta, t):

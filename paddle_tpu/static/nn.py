@@ -519,13 +519,30 @@ def crf_decoding(input, param_attr=None, label=None, length=None,
         if transition is None:
             raise ValueError("crf_decoding needs linear_chain_crf first or "
                              "an explicit transition parameter")
+    lab = label
+
+    def impl(em, trp, *rest):
+        i = 0
+        ln = rest[i] if length is not None else None
+        i += 1 if length is not None else 0
+        lb = rest[i] if lab is not None else None
+        _, p = _crf.viterbi_decode(em, trp, ln)
+        if lb is not None:
+            # reference crf_decoding with Label: per-position correctness
+            # indicators (1 where the decoded tag equals the label)
+            from ..core.tensor import Tensor as _T
+
+            lv = lb.value if hasattr(lb, "value") else lb
+            return _T((p.value == lv.astype(p.value.dtype))
+                      .astype(jnp.int64))
+        return p
+
+    args = (input, transition)
+    if length is not None:
+        args += (length,)
+    if lab is not None:
+        args += (lab,)
     prog = static_mode.recording()
     if prog is not None:
-        def impl(em, trp, *rest):
-            ln = rest[0] if rest else None
-            s, p = _crf.viterbi_decode(em, trp, ln)
-            return p
-        args = (input, transition) + ((length,) if length is not None else ())
         return prog.record_call(impl, args, {})
-    _, p = _crf.viterbi_decode(input, transition, length)
-    return p
+    return impl(*args)

@@ -114,3 +114,35 @@ def test_static_crf_program():
     lv, pv = exe.run(main, feed={"em": emv, "lab": labv},
                      fetch_list=[loss, path])
     assert np.isfinite(lv) and pv.shape == (3, 5)
+
+
+def test_crf_decoding_with_label_returns_correctness():
+    from paddle_tpu import static
+
+    rng = np.random.default_rng(0)
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        em = static.data("em", [None, 4, 3], "float32")
+        lab = static.data("lab", [None, 4], "int64")
+        loss = paddle.mean(static.nn.linear_chain_crf(em, lab))
+        correct = static.nn.crf_decoding(em, label=lab)
+    exe = static.Executor()
+    exe.run(startup)
+    emv = rng.standard_normal((2, 4, 3)).astype(np.float32)
+    labv = rng.integers(0, 3, (2, 4)).astype(np.int64)
+    cv, = exe.run(main, feed={"em": emv, "lab": labv}, fetch_list=[correct])
+    assert cv.shape == (2, 4) and set(np.unique(cv)) <= {0, 1}
+
+
+def test_viterbi_include_bos_eos():
+    from paddle_tpu.ops.crf import viterbi_decode
+
+    rng = np.random.default_rng(1)
+    C = 5  # 3 real tags + BOS + EOS
+    em = rng.standard_normal((2, 6, C)).astype(np.float32)
+    tr = rng.standard_normal((C, C)).astype(np.float32)
+    scores, paths = viterbi_decode(paddle.to_tensor(em),
+                                   paddle.to_tensor(tr),
+                                   include_bos_eos_tag=True)
+    pv = np.asarray(paths.value)
+    assert pv.max() <= C - 3  # BOS/EOS never decoded
