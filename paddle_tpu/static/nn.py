@@ -27,7 +27,8 @@ from .program import (Variable, _VarRef, _require_prog, create_parameter,
                       data)
 
 __all__ = [
-    "crf_decoding", "linear_chain_crf", "fc", "embedding", "sparse_embedding", "conv2d", "conv2d_transpose",
+    "bilinear_tensor_product", "crf_decoding", "linear_chain_crf",
+    "nce", "row_conv", "fc", "embedding", "sparse_embedding", "conv2d", "conv2d_transpose",
     "conv3d", "batch_norm", "layer_norm", "instance_norm", "group_norm",
     "prelu", "data_norm", "cond", "case", "switch_case", "while_loop",
     "py_func", "sequence_pool", "sequence_softmax", "sequence_first_step",
@@ -542,6 +543,90 @@ def crf_decoding(input, param_attr=None, label=None, length=None,
         args += (length,)
     if lab is not None:
         args += (lab,)
+    prog = static_mode.recording()
+    if prog is not None:
+        return prog.record_call(impl, args, {})
+    return impl(*args)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """y_k = x^T W_k y + b_k (reference bilinear_tensor_product_op)."""
+    from ..nn import functional as F
+
+    d1 = _static_dim(x, x.ndim - 1, "bilinear_tensor_product x")
+    d2 = _static_dim(y, y.ndim - 1, "bilinear_tensor_product y")
+    w = create_parameter([size, d1, d2], x.dtype,
+                         name=name and name + ".w")
+    b = None
+    if bias_attr is not False:
+        b = create_parameter([size], x.dtype, is_bias=True,
+                             name=name and name + ".b")
+    out = F.bilinear(x, y, w, b)
+    return _act(out, act)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead row convolution (reference row_conv_op, DeepSpeech2):
+    out[t] = sum_{i=0..k} w[i] ⊙ x[t+i] over a [B, T, D] sequence."""
+    D = _static_dim(input, input.ndim - 1, "row_conv")
+    k = int(future_context_size)
+    w = create_parameter([k + 1, D], input.dtype)
+
+    def impl(x, wp):
+        xv = x.value if isinstance(x, Tensor) else x
+        wv = wp.value if isinstance(wp, Tensor) else wp
+        T_ = xv.shape[1]
+        out = jnp.zeros_like(xv)
+        for i in range(k + 1):
+            sl = xv[:, i:T_, :]
+            pad = jnp.zeros(xv.shape[:1] + (i,) + xv.shape[2:], xv.dtype)
+            shifted = jnp.concatenate([sl, pad], axis=1)
+            out = out + shifted * wv[i][None, None, :]
+        return Tensor(out)
+
+    prog = static_mode.recording()
+    if prog is not None:
+        return _act(prog.record_call(impl, (input, w), {}), act)
+    return _act(impl(input, w), act)
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=10, name=None, seed=0,
+        sampler="uniform", custom_dist=None, is_sparse=False):
+    """Noise-contrastive estimation loss (reference nce_op): logistic
+    discrimination of the true class against k uniform noise samples."""
+    D = _static_dim(input, input.ndim - 1, "nce")
+    C = int(num_total_classes)
+    k = int(num_neg_samples)
+    w = create_parameter([C, D], input.dtype, name=name and name + ".w")
+    b = None
+    if bias_attr is not False:
+        b = create_parameter([C], input.dtype, is_bias=True,
+                             name=name and name + ".b")
+
+    def impl(x, lab, wp, *rest):
+        from ..framework import random as _random
+
+        xv = x.value if isinstance(x, Tensor) else x
+        lv = (lab.value if isinstance(lab, Tensor) else lab).reshape(-1)
+        wv = wp.value if isinstance(wp, Tensor) else wp
+        bv = (rest[0].value if isinstance(rest[0], Tensor) else rest[0]) \
+            if rest else None
+        # fresh noise classes every step: under Executor replay next_key()
+        # draws from the per-step traced key (rng_scope), matching the
+        # reference nce_op's per-batch sampler
+        noise = jax.random.randint(_random.next_key(), (k,), 0, C)
+        pos_logit = (xv * wv[lv]).sum(-1)
+        neg_logit = xv @ wv[noise].T  # [B, k]
+        if bv is not None:
+            pos_logit = pos_logit + bv[lv]
+            neg_logit = neg_logit + bv[noise][None, :]
+        loss = jax.nn.softplus(-pos_logit) + jax.nn.softplus(
+            neg_logit).sum(-1)
+        return Tensor(loss[:, None])
+
+    args = (input, label, w) + ((b,) if b is not None else ())
     prog = static_mode.recording()
     if prog is not None:
         return prog.record_call(impl, args, {})

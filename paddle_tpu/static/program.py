@@ -444,7 +444,11 @@ class Program:
         prev = static_mode.REPLAYING
         static_mode.REPLAYING = True
         try:
-            out_aval = jax.eval_shape(infer, *avals_in)
+            # route next_key() to a throwaway stream: ops with randomness
+            # (dropout, nce sampling) would otherwise store eval_shape
+            # tracers into the global RNG key (UnexpectedTracerError later)
+            with _random.rng_scope(jax.random.PRNGKey(0)):
+                out_aval = jax.eval_shape(infer, *avals_in)
         finally:
             static_mode.REPLAYING = prev
 

@@ -351,3 +351,28 @@ def test_train_from_dataset(tmp_path):
         if first is None:
             first = float(out[0])
     assert float(out[0]) < first / 3, (first, float(out[0]))
+
+
+def test_static_nn_extra_layers():
+    main, startup = _fresh_programs()
+    with static.program_guard(main, startup):
+        x1 = static.data("x1", [None, 4], "float32")
+        x2 = static.data("x2", [None, 5], "float32")
+        btp = static.nn.bilinear_tensor_product(x1, x2, 3)
+        seq = static.data("seq", [None, 6, 4], "float32")
+        rc = static.nn.row_conv(seq, 2)
+        lab = static.data("lab", [None, 1], "int64")
+        nloss = static.nn.nce(x1, lab, num_total_classes=7,
+                              num_neg_samples=3)
+    exe = static.Executor()
+    exe.run(startup)
+    rng = np.random.default_rng(0)
+    out = exe.run(main, feed={
+        "x1": rng.standard_normal((2, 4)).astype(np.float32),
+        "x2": rng.standard_normal((2, 5)).astype(np.float32),
+        "seq": rng.standard_normal((2, 6, 4)).astype(np.float32),
+        "lab": rng.integers(0, 7, (2, 1)).astype(np.int64),
+    }, fetch_list=[btp, rc, nloss])
+    assert out[0].shape == (2, 3)
+    assert out[1].shape == (2, 6, 4)
+    assert out[2].shape == (2, 1) and np.all(np.isfinite(out[2]))
