@@ -28,7 +28,7 @@ from .program import (Variable, _VarRef, _require_prog, create_parameter,
 
 __all__ = [
     "bilinear_tensor_product", "crf_decoding", "linear_chain_crf",
-    "nce", "row_conv", "fc", "embedding", "sparse_embedding", "conv2d", "conv2d_transpose",
+    "deform_conv2d", "nce", "row_conv", "fc", "embedding", "sparse_embedding", "conv2d", "conv2d_transpose",
     "conv3d", "batch_norm", "layer_norm", "instance_norm", "group_norm",
     "prelu", "data_norm", "cond", "case", "switch_case", "while_loop",
     "py_func", "sequence_pool", "sequence_softmax", "sequence_first_step",
@@ -596,6 +596,12 @@ def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
         sampler="uniform", custom_dist=None, is_sparse=False):
     """Noise-contrastive estimation loss (reference nce_op): logistic
     discrimination of the true class against k uniform noise samples."""
+    if sampler != "uniform" or custom_dist is not None:
+        raise NotImplementedError(
+            "nce supports sampler='uniform' (custom_dist/log_uniform not "
+            "implemented); adjust the sampler or use softmax losses")
+    if sample_weight is not None:
+        raise NotImplementedError("nce sample_weight is not supported")
     D = _static_dim(input, input.ndim - 1, "nce")
     C = int(num_total_classes)
     k = int(num_neg_samples)
@@ -622,6 +628,11 @@ def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
         if bv is not None:
             pos_logit = pos_logit + bv[lv]
             neg_logit = neg_logit + bv[noise][None, :]
+        # NCE discriminates on s(w) - log(k * q(w)) (reference nce_op);
+        # uniform sampler: q = 1/C
+        shift = float(np.log(k / C))
+        pos_logit = pos_logit - shift
+        neg_logit = neg_logit - shift
         loss = jax.nn.softplus(-pos_logit) + jax.nn.softplus(
             neg_logit).sum(-1)
         return Tensor(loss[:, None])
@@ -631,3 +642,24 @@ def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
     if prog is not None:
         return prog.record_call(impl, args, {})
     return impl(*args)
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None, name=None):
+    """Deformable conv v1/v2 (reference static/nn deform_conv2d over
+    deformable_conv_op) — creates the kernel parameter and composes
+    vision.ops.deform_conv2d."""
+    from ..vision.ops import deform_conv2d as _dc
+
+    k = _pair(filter_size)
+    cin = _static_dim(x, 1, "deform_conv2d")
+    w = create_parameter([num_filters, cin // groups, k[0], k[1]], x.dtype,
+                         name=name and name + ".w")
+    b = None
+    if bias_attr is not False:
+        b = create_parameter([num_filters], x.dtype, is_bias=True,
+                             name=name and name + ".b")
+    return _dc(x, offset, w, bias=b, stride=stride, padding=padding,
+               dilation=dilation, deformable_groups=deformable_groups,
+               groups=groups, mask=mask)

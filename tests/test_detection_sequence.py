@@ -200,3 +200,97 @@ class TestSequenceOps:
         np.testing.assert_allclose(np.asarray(g)[:, 0],
                                    [1 / 3, 1 / 3, 1 / 3, 1, .25, .25, .25, .25],
                                    rtol=1e-6)
+
+
+def test_deform_conv2d_matches_naive():
+    """deform_conv2d vs a per-position python loop reference (v1 and v2)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.ops import deform_conv2d
+
+    rng = np.random.default_rng(0)
+    B, C, H, W, Cout, k = 1, 2, 5, 5, 3, 3
+    x = rng.standard_normal((B, C, H, W)).astype(np.float32)
+    w = rng.standard_normal((Cout, C, k, k)).astype(np.float32)
+    off = (rng.standard_normal((B, 2 * k * k, H, W)) * 0.5).astype(
+        np.float32)
+    m = rng.random((B, k * k, H, W)).astype(np.float32)
+
+    def bilin(img, y, x_):
+        v = 0.0
+        y0, x0 = int(np.floor(y)), int(np.floor(x_))
+        for (yy, xx, wgt) in [
+            (y0, x0, (1 - (y - y0)) * (1 - (x_ - x0))),
+            (y0, x0 + 1, (1 - (y - y0)) * (x_ - x0)),
+            (y0 + 1, x0, (y - y0) * (1 - (x_ - x0))),
+            (y0 + 1, x0 + 1, (y - y0) * (x_ - x0)),
+        ]:
+            if 0 <= yy < img.shape[0] and 0 <= xx < img.shape[1]:
+                v += wgt * img[yy, xx]
+        return v
+
+    def naive(use_mask):
+        out = np.zeros((B, Cout, H, W), np.float32)
+        for b in range(B):
+            for oc in range(Cout):
+                for oy in range(H):
+                    for ox in range(W):
+                        acc = 0.0
+                        for ic in range(C):
+                            for i in range(k):
+                                for j in range(k):
+                                    t = i * k + j
+                                    sy = oy - 1 + i + off[b, 2 * t, oy, ox]
+                                    sx = ox - 1 + j + off[b, 2 * t + 1,
+                                                          oy, ox]
+                                    v = bilin(x[b, ic], sy, sx)
+                                    if use_mask:
+                                        v *= m[b, t, oy, ox]
+                                    acc += w[oc, ic, i, j] * v
+                        out[b, oc, oy, ox] = acc
+        return out
+
+    got = np.asarray(deform_conv2d(
+        paddle.to_tensor(x), paddle.to_tensor(off), paddle.to_tensor(w),
+        padding=1).value)
+    np.testing.assert_allclose(got, naive(False), rtol=1e-4, atol=1e-4)
+
+    got2 = np.asarray(deform_conv2d(
+        paddle.to_tensor(x), paddle.to_tensor(off), paddle.to_tensor(w),
+        padding=1, mask=paddle.to_tensor(m)).value)
+    np.testing.assert_allclose(got2, naive(True), rtol=1e-4, atol=1e-4)
+
+    # gradients flow to offsets (the point of deformable convs)
+    ot = paddle.to_tensor(off)
+    ot.stop_gradient = False
+    loss = paddle.sum(deform_conv2d(paddle.to_tensor(x), ot,
+                                    paddle.to_tensor(w), padding=1) ** 2)
+    loss.backward()
+    assert ot.grad is not None
+    assert float(np.abs(np.asarray(ot.grad.value)).sum()) > 0
+
+
+def test_deform_conv2d_static_program():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+
+    rng = np.random.default_rng(0)
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 2, 5, 5], "float32")
+        off = static.data("off", [None, 18, 5, 5], "float32")
+        m = static.data("m", [None, 9, 5, 5], "float32")
+        y = static.nn.deform_conv2d(x, off, m, 4, 3, padding=1)
+        loss = paddle.mean(y * y)
+        paddle.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    lv, = exe.run(main, feed={
+        "x": rng.standard_normal((2, 2, 5, 5)).astype(np.float32),
+        "off": (rng.standard_normal((2, 18, 5, 5)) * 0.3).astype(np.float32),
+        "m": rng.random((2, 9, 5, 5)).astype(np.float32),
+    }, fetch_list=[loss])
+    assert np.isfinite(lv)
