@@ -1,0 +1,8 @@
+"""fleet.utils — filesystem abstraction + helpers.
+
+Reference: python/paddle/distributed/fleet/utils/fs.py (LocalFS/HDFSClient
+used by auto-checkpoint and PS snapshot upload) and fleet/utils/__init__.py.
+"""
+from .fs import FS, HDFSClient, LocalFS  # noqa: F401
+
+__all__ = ["FS", "LocalFS", "HDFSClient"]

@@ -1,6 +1,8 @@
 """paddle_tpu.vision (reference python/paddle/vision)."""
 from . import models, ops, transforms  # noqa: F401
-from .datasets import MNIST, FakeImageDataset  # noqa: F401
+from .datasets import (  # noqa: F401
+    MNIST, Cifar10, Cifar100, DatasetFolder, FakeImageDataset, FashionMNIST,
+    Flowers, ImageFolder, VOC2012)
 from .models import LeNet  # noqa: F401  (reference exposes it at vision/)
 
 _image_backend = "numpy"

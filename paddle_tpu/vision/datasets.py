@@ -70,3 +70,184 @@ class FakeImageDataset(Dataset):
 
     def __len__(self):
         return len(self.images)
+
+
+class _SyntheticImageSet(Dataset):
+    """Shared local-file-or-synthetic base (zero-egress policy: parse a
+    local archive when given, else deterministic learnable synthetic)."""
+
+    def __init__(self, n, shape, num_classes, mode, transform=None, seed=0):
+        # class prototypes come from the split-INDEPENDENT seed so train and
+        # test share the same underlying classes (a model trained on the
+        # train split generalizes); only labels/noise differ per split
+        base = np.random.RandomState(seed).randn(
+            num_classes, *shape).astype(np.float32)
+        rng = np.random.RandomState(seed + (1 if mode == "train" else 2))
+        self.labels = rng.randint(0, num_classes, n).astype(np.int64)
+        noise = rng.randn(n, *shape).astype(np.float32)
+        self.images = (base[self.labels] + 0.5 * noise)
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(_SyntheticImageSet):
+    """reference vision/datasets/mnist.py FashionMNIST (idx-format files
+    load via the MNIST class; synthetic fallback here)."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        if image_path and os.path.exists(image_path):
+            m = MNIST(image_path, label_path, mode, transform)
+            # normalize to the same contract as the synthetic path (and
+            # MNIST.__getitem__): float32 [1, 28, 28], mean/std scaled
+            imgs = np.asarray(m.images, np.float32) / 255.0
+            self.images = ((imgs - 0.1307) / 0.3081)[:, None]
+            self.labels = np.asarray(m.labels, np.int64)
+            self.transform = transform
+            return
+        super().__init__(2000 if mode == "train" else 400, (1, 28, 28), 10,
+                         mode, transform, seed=10)
+
+
+class Cifar10(_SyntheticImageSet):
+    """reference vision/datasets/cifar.py Cifar10: python-pickle batches
+    from the local tar when given, else synthetic."""
+
+    NUM_CLASSES = 10
+    SYNTH_SEED = 20
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if data_file and os.path.exists(data_file):
+            self.images, self.labels = self._parse(data_file, mode)
+            self.transform = transform
+            return
+        super().__init__(2000 if mode == "train" else 400, (3, 32, 32),
+                         self.NUM_CLASSES, mode, transform,
+                         seed=self.SYNTH_SEED)
+
+    @classmethod
+    def _parse(cls, path, mode):
+        import pickle
+        import tarfile
+
+        key = b"labels" if cls.NUM_CLASSES == 10 else b"fine_labels"
+        want = ("data_batch" if mode == "train" else "test_batch") \
+            if cls.NUM_CLASSES == 10 else ("train" if mode == "train"
+                                           else "test")
+        imgs, labs = [], []
+        with tarfile.open(path) as tf:
+            for m in tf.getmembers():
+                if want in os.path.basename(m.name):
+                    d = pickle.load(tf.extractfile(m), encoding="bytes")
+                    imgs.append(np.asarray(d[b"data"], np.float32)
+                                .reshape(-1, 3, 32, 32) / 255.0)
+                    labs.append(np.asarray(d[key], np.int64))
+        return np.concatenate(imgs), np.concatenate(labs)
+
+
+class Cifar100(Cifar10):
+    NUM_CLASSES = 100
+    SYNTH_SEED = 30
+
+
+class Flowers(_SyntheticImageSet):
+    """reference vision/datasets/flowers.py (102 categories)."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False, backend=None):
+        super().__init__(1024 if mode == "train" else 128, (3, 64, 64), 102,
+                         mode, transform, seed=40)
+
+
+class VOC2012(Dataset):
+    """reference vision/datasets/voc2012.py: (image, segmentation mask)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        rng = np.random.RandomState(50 if mode == "train" else 51)
+        n = 200 if mode == "train" else 40
+        self.images = rng.randn(n, 3, 64, 64).astype(np.float32)
+        # blocky synthetic masks over 21 classes
+        masks = rng.randint(0, 21, (n, 8, 8)).astype(np.int64)
+        self.masks = np.kron(masks, np.ones((8, 8), np.int64))
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.masks[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class DatasetFolder(Dataset):
+    """reference vision/datasets/folder.py: class-per-subdirectory layout
+    of .npy arrays (no PIL — decode images offline)."""
+
+    def __init__(self, root, loader=None, extensions=(".npy",),
+                 transform=None, is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or (lambda p: np.load(p))
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for f in sorted(os.listdir(cdir)):
+                path = os.path.join(cdir, f)
+                ok = (is_valid_file(path) if is_valid_file
+                      else f.endswith(tuple(extensions)))
+                if ok:
+                    self.samples.append((path, self.class_to_idx[c]))
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """reference vision/datasets/folder.py ImageFolder: a FLAT directory of
+    sample files iterated without labels — each item is ``[img]`` (contrast
+    DatasetFolder's class-per-subdirectory (img, target))."""
+
+    def __init__(self, root, loader=None, extensions=(".npy",),
+                 transform=None, is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or (lambda p: np.load(p))
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for f in sorted(files):
+                path = os.path.join(dirpath, f)
+                ok = (is_valid_file(path) if is_valid_file
+                      else f.endswith(tuple(extensions)))
+                if ok:
+                    self.samples.append(path)
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)

@@ -15,6 +15,8 @@ from typing import Sequence
 
 import jax
 import jax.numpy as jnp
+
+from ..core.static_mode import static_aware
 import numpy as np
 
 __all__ = ["yolo_box", "prior_box", "box_coder", "box_iou", "nms",
@@ -323,9 +325,6 @@ def roi_pool(x, boxes, box_nums=None, output_size=(1, 1),
         return vals.max(axis=(2, 4))
 
     return jax.vmap(per_roi)(img_of, ys, xs)
-
-
-from ..core.static_mode import static_aware
 
 
 @static_aware

@@ -124,3 +124,65 @@ class TestFlagsAndNanCheck:
                     step(x, y)
         finally:
             paddle.set_flags({"FLAGS_check_nan_inf_host": False})
+
+
+def test_fleet_fs_localfs(tmp_path):
+    from paddle_tpu.distributed.fleet.utils import HDFSClient, LocalFS
+
+    fs = LocalFS()
+    d = tmp_path / "ckpt"
+    fs.mkdirs(str(d))
+    fs.touch(str(d / "a.txt"))
+    (d / "sub").mkdir()
+    dirs, files = fs.ls_dir(str(d))
+    assert dirs == ["sub"] and files == ["a.txt"]
+    fs.mv(str(d / "a.txt"), str(d / "b.txt"))
+    assert fs.is_file(str(d / "b.txt"))
+    fs.delete(str(d))
+    assert not fs.is_exist(str(d))
+    # hadoop-less HDFSClient raises clearly
+    h = HDFSClient()
+    if not h._available:
+        import pytest
+
+        with pytest.raises(RuntimeError, match="hadoop"):
+            h.is_exist("/x")
+
+
+def test_merge_timeline(tmp_path):
+    import json
+    import subprocess
+    import sys
+
+    t0 = {"traceEvents": [{"name": "step", "ph": "X", "ts": 0, "dur": 5,
+                           "pid": 0, "tid": 1}]}
+    t1 = {"traceEvents": [{"name": "step", "ph": "X", "ts": 2, "dur": 5,
+                           "pid": 0, "tid": 1}]}
+    a, b, out = tmp_path / "a.json", tmp_path / "b.json", tmp_path / "m.json"
+    a.write_text(json.dumps(t0))
+    b.write_text(json.dumps(t1))
+    import os
+
+    tool = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "merge_timeline.py")
+    r = subprocess.run([sys.executable, tool,
+                        str(out), str(a), str(b)], capture_output=True)
+    assert r.returncode == 0, r.stderr
+    merged = json.loads(out.read_text())
+    pids = {e.get("pid") for e in merged["traceEvents"]}
+    assert pids == {0, 1}
+
+
+def test_vision_dataset_family():
+    import numpy as np
+
+    from paddle_tpu.vision.datasets import (Cifar10, FashionMNIST, Flowers,
+                                            VOC2012)
+
+    for ds, shape in [(Cifar10(), (3, 32, 32)),
+                      (FashionMNIST(), (1, 28, 28)),
+                      (Flowers(), (3, 64, 64))]:
+        img, lab = ds[0]
+        assert img.shape == shape and 0 <= int(lab)
+    img, mask = VOC2012()[0]
+    assert mask.shape == (64, 64) and mask.max() < 21

@@ -1,0 +1,35 @@
+#!/usr/bin/env python
+"""Merge per-host chrome traces into one timeline.
+
+Reference capability: tools/CrossStackProfiler (multi-node timeline merger).
+Each host's paddle_tpu.profiler chrome-trace export becomes a distinct
+process row (pid = host index, labeled), preserving per-host thread rows.
+
+Usage: python tools/merge_timeline.py out.json host0.json host1.json ...
+"""
+import json
+import sys
+
+
+def merge(paths):
+    events = []
+    for hi, path in enumerate(paths):
+        with open(path) as f:
+            data = json.load(f)
+        evs = data["traceEvents"] if isinstance(data, dict) else data
+        events.append({"name": "process_name", "ph": "M", "pid": hi,
+                       "args": {"name": f"host{hi}:{path}"}})
+        for e in evs:
+            e = dict(e)
+            e["pid"] = hi
+            events.append(e)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+if __name__ == "__main__":
+    if len(sys.argv) < 3:
+        raise SystemExit(__doc__)
+    out, *ins = sys.argv[1:]
+    with open(out, "w") as f:
+        json.dump(merge(ins), f)
+    print(f"merged {len(ins)} host traces -> {out}")
