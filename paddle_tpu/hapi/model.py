@@ -19,6 +19,12 @@ from ..jit import TrainStep
 from .callbacks import Callback, ProgBarLogger
 
 
+def _as_tensor(x):
+    from ..core.tensor import to_tensor
+
+    return x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+
+
 def _to_batches(data, batch_size, shuffle=False, seed=0):
     """Accepts a DataLoader-like iterable (yields tuples) or a pair of
     array-likes (features, labels)."""
@@ -177,6 +183,50 @@ class Model:
                                   if isinstance(inputs, (list, tuple))
                                   else [inputs]), labels)
         return [float(loss.numpy())]
+
+    def eval_batch(self, inputs, labels=None):
+        """reference Model.eval_batch: loss (+ metric updates) on one batch
+        without a parameter update, in eval mode."""
+        from ..core.autograd import no_grad
+
+        xs = (list(inputs) if isinstance(inputs, (list, tuple))
+              else [inputs])
+        was_training = self.network.training
+        self.network.eval()
+        try:
+            with no_grad():
+                out = self.network(*[_as_tensor(x) for x in xs])
+                res = []
+                yt = _as_tensor(labels) if labels is not None else None
+                if self._loss is not None and yt is not None:
+                    res.append(float(np.asarray(
+                        self._loss(out, yt).value)))
+                if yt is not None:
+                    for m in self._metrics:
+                        _metric_update(m, out, yt)
+        finally:
+            if was_training:
+                self.network.train()
+        return res
+
+    def predict_batch(self, inputs):
+        """reference Model.predict_batch: forward-only outputs as numpy,
+        in eval mode."""
+        from ..core.autograd import no_grad
+
+        xs = (list(inputs) if isinstance(inputs, (list, tuple))
+              else [inputs])
+        was_training = self.network.training
+        self.network.eval()
+        try:
+            with no_grad():
+                out = self.network(*[_as_tensor(x) for x in xs])
+        finally:
+            if was_training:
+                self.network.train()
+        if isinstance(out, (list, tuple)):
+            return [np.asarray(o.value) for o in out]
+        return [np.asarray(out.value)]
 
     # -- io ------------------------------------------------------------------
     def save(self, path):
