@@ -184,46 +184,50 @@ class Model:
                                   else [inputs]), labels)
         return [float(loss.numpy())]
 
-    def eval_batch(self, inputs, labels=None):
-        """reference Model.eval_batch: loss (+ metric updates) on one batch
-        without a parameter update, in eval mode."""
+    def _eval_forward(self, inputs):
+        """Eval-mode forward with per-layer mode save/restore (a blanket
+        .train() would un-freeze individually-eval()'d sublayers — same
+        discipline as flops())."""
         from ..core.autograd import no_grad
 
         xs = (list(inputs) if isinstance(inputs, (list, tuple))
               else [inputs])
-        was_training = self.network.training
+        modes = [(layer, layer.training)
+                 for layer in self.network.sublayers(include_self=True)]
         self.network.eval()
         try:
             with no_grad():
-                out = self.network(*[_as_tensor(x) for x in xs])
-                res = []
-                yt = _as_tensor(labels) if labels is not None else None
-                if self._loss is not None and yt is not None:
-                    res.append(float(np.asarray(
-                        self._loss(out, yt).value)))
-                if yt is not None:
-                    for m in self._metrics:
-                        _metric_update(m, out, yt)
+                return self.network(*[_as_tensor(x) for x in xs])
         finally:
-            if was_training:
-                self.network.train()
-        return res
+            for layer, was_training in modes:
+                layer.training = was_training
+
+    def eval_batch(self, inputs, labels=None):
+        """reference Model.eval_batch: loss (+ per-batch metric values) on
+        one batch without a parameter update, in eval mode.  Returns
+        ``[losses]`` or ``([losses], [metric accumulations])`` when metrics
+        are prepared — the reference adapter's contract."""
+        out = self._eval_forward(inputs)
+        losses = []
+        yt = _as_tensor(labels) if labels is not None else None
+        if self._loss is not None and yt is not None:
+            losses.append(float(np.asarray(self._loss(out, yt).value)))
+        if yt is not None:
+            for m in self._metrics:
+                _metric_update(m, out, yt)
+        if self._metrics:
+            metric_vals = []
+            for m in self._metrics:
+                v = m.accumulate()
+                metric_vals.append(list(v) if isinstance(v, (list, tuple))
+                                   else v)
+            return losses, metric_vals
+        return losses
 
     def predict_batch(self, inputs):
         """reference Model.predict_batch: forward-only outputs as numpy,
         in eval mode."""
-        from ..core.autograd import no_grad
-
-        xs = (list(inputs) if isinstance(inputs, (list, tuple))
-              else [inputs])
-        was_training = self.network.training
-        self.network.eval()
-        try:
-            with no_grad():
-                out = self.network(*[_as_tensor(x) for x in xs])
-        finally:
-            if was_training:
-                self.network.train()
+        out = self._eval_forward(inputs)
         if isinstance(out, (list, tuple)):
             return [np.asarray(o.value) for o in out]
         return [np.asarray(out.value)]
