@@ -215,7 +215,7 @@ class Model:
         if yt is not None:
             for m in self._metrics:
                 _metric_update(m, out, yt)
-        if self._metrics:
+        if self._metrics and yt is not None:
             metric_vals = []
             for m in self._metrics:
                 v = m.accumulate()

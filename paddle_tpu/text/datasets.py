@@ -103,16 +103,13 @@ class Imikolov(Dataset):
             # word2vec-style models have learnable signal, not just a
             # unigram prior
             tokens = np.empty(num_samples, np.int64)
-            if num_samples == 0:
-                self.word_idx = {}
-                self.data = []
-                return
-            tokens[0] = int(r.integers(0, vocab_size))
-            jumps = r.random(num_samples) < 0.1
-            rand_tok = r.integers(0, vocab_size, num_samples)
-            for i in range(1, num_samples):
-                tokens[i] = (rand_tok[i] if jumps[i]
-                             else (tokens[i - 1] * 7 + 3) % vocab_size)
+            if num_samples:
+                tokens[0] = int(r.integers(0, vocab_size))
+                jumps = r.random(num_samples) < 0.1
+                rand_tok = r.integers(0, vocab_size, num_samples)
+                for i in range(1, num_samples):
+                    tokens[i] = (rand_tok[i] if jumps[i]
+                                 else (tokens[i - 1] * 7 + 3) % vocab_size)
         self.word_idx = {}
         if data_type.upper() == "NGRAM":
             n = window_size
