@@ -246,12 +246,42 @@ class Model:
     def parameters(self):
         return self.network.parameters()
 
-    def summary(self, input_size=None):
-        return summary(self.network)
+    def summary(self, input_size=None, dtypes=None):
+        return summary(self.network, input_size, dtypes)
 
 
-def summary(network, input_size=None):
-    """Parameter-count summary (reference hapi/model_summary.py)."""
+def _hooked_dry_run(network, input_size, choose_hook, dtypes=None):
+    """Eval-mode zeros forward with per-layer hooks and mode save/restore —
+    shared by summary() and flops() (one copy of the hook/eval/restore
+    discipline)."""
+    import paddle_tpu as paddle
+
+    hooks = []
+    for layer in network.sublayers(include_self=False):
+        h = choose_hook(layer)
+        if h is not None:
+            hooks.append(layer.register_forward_post_hook(h))
+    modes = [(l, l.training) for l in network.sublayers(include_self=True)]
+    dtype = (dtypes[0] if isinstance(dtypes, (list, tuple)) else dtypes) \
+        or "float32"
+    try:
+        network.eval()
+        network(paddle.zeros(list(input_size), dtype))
+    finally:
+        for l, t in modes:
+            l.training = t
+        for h in hooks:
+            try:
+                h.remove()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def summary(network, input_size=None, dtypes=None):
+    """Layer table + parameter counts (reference hapi/model_summary.py).
+
+    With ``input_size`` the network dry-runs in eval mode and the table
+    includes per-layer output shapes (hooks, like flops())."""
     total = 0
     trainable = 0
     rows = []
@@ -261,8 +291,35 @@ def summary(network, input_size=None):
         if getattr(p, "trainable", True):
             trainable += n
         rows.append((name, tuple(p.shape), n))
-    return {"total_params": total, "trainable_params": trainable,
-            "layers": rows}
+    out = {"total_params": total, "trainable_params": trainable,
+           "layers": rows}
+
+    if input_size is not None:
+        layer_rows = []
+
+        def make_hook(layer):
+            def hook(lay, inp, o):
+                shape = (tuple(o.shape) if hasattr(o, "shape")
+                         else tuple(o[0].shape))
+                n = sum(int(np.prod(p.shape)) if p.shape else 1
+                        for p in lay.parameters(include_sublayers=False)) \
+                    if hasattr(lay, "parameters") else 0
+                layer_rows.append((type(lay).__name__, shape, n))
+            return hook
+
+        def choose(layer):
+            return make_hook(layer) if not layer.sublayers() else None
+
+        _hooked_dry_run(network, input_size, choose, dtypes)
+        out["layer_table"] = layer_rows
+        # render (the reference prints the table)
+        print(f"{'Layer':<24}{'Output Shape':<24}{'Params':>10}")
+        print("-" * 58)
+        for name, shape, n in layer_rows:
+            print(f"{name:<24}{str(list(shape)):<24}{n:>10,}")
+        print("-" * 58)
+        print(f"Total params: {total:,}  (trainable {trainable:,})")
+    return out
 
 
 def flops(net, input_size, custom_ops=None, print_detail=False):
@@ -294,27 +351,14 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
     def elemwise_hook(layer, inp, out):
         counts["flops"] += int(np.prod(out.shape))
 
-    for layer in net.sublayers(include_self=True):
+    def choose(layer):
         if isinstance(layer, nn.Conv2D):
-            hooks.append(layer.register_forward_post_hook(conv_hook))
-        elif isinstance(layer, nn.Linear):
-            hooks.append(layer.register_forward_post_hook(linear_hook))
-        elif isinstance(layer, (nn.BatchNorm2D, nn.LayerNorm, nn.ReLU)):
-            hooks.append(layer.register_forward_post_hook(elemwise_hook))
-    # dry-run in eval mode (a training-mode forward would blend the zeros
-    # batch into BatchNorm running stats), restoring per-layer flags after
-    modes = [(layer, layer.training) for layer in
-             net.sublayers(include_self=True)]
-    try:
-        net.eval()
-        x = paddle.zeros(list(input_size))
-        net(x)
-    finally:
-        for layer, was_training in modes:
-            layer.training = was_training
-        for h in hooks:
-            try:
-                h.remove()
-            except Exception:  # noqa: BLE001
-                pass
+            return conv_hook
+        if isinstance(layer, nn.Linear):
+            return linear_hook
+        if isinstance(layer, (nn.BatchNorm2D, nn.LayerNorm, nn.ReLU)):
+            return elemwise_hook
+        return None
+
+    _hooked_dry_run(net, input_size, choose)
     return counts["flops"]

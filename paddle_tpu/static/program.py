@@ -491,6 +491,63 @@ class Program:
         return (f"Program(ops={len(self.ops)}, vars={len(self.variables)}, "
                 f"params={list(self.parameters)})")
 
+    # -- inspection (reference Program.to_string / print(program)) ----------
+    def _op_line(self, op, indent="  "):
+        def fmt_refs(obj):
+            out = []
+            for r in _iter_refs(obj):
+                v = self.variables.get(r.vid)
+                out.append(v.name if v is not None else f"var_{r.vid}")
+            return out
+
+        if isinstance(op, ApiOp):
+            name = getattr(op.fn, "__name__", str(op.fn))
+            params = [r.name for r in _iter_params(op.args)] + \
+                     [r.name for r in _iter_params(op.kwargs)]
+            ins = fmt_refs(op.args) + fmt_refs(op.kwargs) + params
+            return (f"{indent}{{{', '.join(fmt_refs(op.outs)) or '—'}}} = "
+                    f"{name}({', '.join(ins)})")
+        if isinstance(op, CondOp):
+            lines = [f"{indent}cond(pred={fmt_refs(op.pred)}) -> "
+                     f"{fmt_refs(op.outs)}"]
+            for tag, sub in (("true", op.true_sub), ("false", op.false_sub)):
+                lines.append(f"{indent}  {tag}:")
+                lines += [self._op_line(o, indent + "    ")
+                          for o in sub.ops]
+            return "\n".join(lines)
+        if isinstance(op, WhileOp):
+            lines = [f"{indent}while(carry={fmt_refs(op.init)}) -> "
+                     f"{fmt_refs(op.outs)}"]
+            for tag, sub in (("cond", op.cond_sub), ("body", op.body_sub)):
+                lines.append(f"{indent}  {tag}:")
+                lines += [self._op_line(o, indent + "    ")
+                          for o in sub.ops]
+            return "\n".join(lines)
+        if isinstance(op, PrintOp):
+            return f"{indent}print({op.message!r}, var_{op.ref.vid})"
+        return f"{indent}{type(op).__name__}"
+
+    def to_string(self, throw_on_error=False, with_details=False) -> str:
+        lines = [f"program: {len(self.ops)} ops, "
+                 f"{len(self.parameters)} params"]
+        for name, vid in self.inputs:
+            v = self.variables[vid]
+            lines.append(f"  feed {name}: shape={list(v.shape)} "
+                         f"dtype={v.dtype.name}")
+        for pname, p in self.parameters.items():
+            lines.append(f"  param {pname}: shape={list(p.shape)}"
+                         + ("" if getattr(p, 'trainable', True)
+                            else " (frozen)"))
+        lines += [self._op_line(op) for op in self.ops]
+        if self.loss is not None:
+            lines.append(f"  loss: {self.loss.name}")
+        if self.optimizer is not None:
+            lines.append(f"  optimizer: {type(self.optimizer).__name__}")
+        return "\n".join(lines)
+
+    def __str__(self):
+        return self.to_string()
+
 
 def _iter_refs(obj):
     """Yield every _VarRef inside an encoded arg/output tree."""
@@ -502,6 +559,18 @@ def _iter_refs(obj):
     elif isinstance(obj, dict):
         for o in obj.values():
             yield from _iter_refs(o)
+
+
+def _iter_params(obj):
+    """Yield every _ParamRef inside an encoded arg tree."""
+    if isinstance(obj, _ParamRef):
+        yield obj
+    elif isinstance(obj, (list, tuple)):
+        for o in obj:
+            yield from _iter_params(o)
+    elif isinstance(obj, dict):
+        for o in obj.values():
+            yield from _iter_params(o)
 
 
 def _op_out_vids(op) -> set:

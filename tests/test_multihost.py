@@ -40,11 +40,26 @@ print(f"MULTIHOST-OK-{jax.process_index()}", flush=True)
 """
 
 
+def _free_port_pair():
+    """env.py advertises the KV port and binds jax coordination on port+1 —
+    both must be free."""
+    for _ in range(64):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        try:
+            s2 = socket.socket()
+            s2.bind(("127.0.0.1", port + 1))
+            s2.close()
+            return port
+        except OSError:
+            continue
+    raise RuntimeError("no free consecutive port pair")
+
+
 def test_two_process_psum(tmp_path):
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
+    port = _free_port_pair()
     script = tmp_path / "worker.py"
     script.write_text(_WORKER)
     procs = []

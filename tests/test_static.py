@@ -376,3 +376,24 @@ def test_static_nn_extra_layers():
     assert out[0].shape == (2, 3)
     assert out[1].shape == (2, 6, 4)
     assert out[2].shape == (2, 1) and np.all(np.isfinite(out[2]))
+
+
+def test_program_to_string():
+    main, startup = _fresh_programs()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 4], "float32")
+        y = static.nn.fc(x, 3, activation="relu")
+        loss = paddle.mean(y)
+        paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    s = str(main)
+    assert "feed x" in s and "matmul" in s and "relu" in s
+    assert "optimizer: SGD" in s and "loss:" in s
+
+
+def test_summary_layer_table():
+    s = paddle.summary(paddle.vision.models.LeNet(), (1, 1, 28, 28))
+    assert s["total_params"] == 61610
+    names = [r[0] for r in s["layer_table"]]
+    assert "Conv2D" in names and "Linear" in names
+    shapes = [r[1] for r in s["layer_table"]]
+    assert (1, 10) in shapes  # final logits
