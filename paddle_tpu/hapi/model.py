@@ -257,7 +257,7 @@ def _hooked_dry_run(network, input_size, choose_hook, dtypes=None):
     import paddle_tpu as paddle
 
     hooks = []
-    for layer in network.sublayers(include_self=False):
+    for layer in network.sublayers(include_self=True):
         h = choose_hook(layer)
         if h is not None:
             hooks.append(layer.register_forward_post_hook(h))
@@ -334,7 +334,6 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
     from paddle_tpu import nn
 
     counts = {"flops": 0}
-    hooks = []
 
     def conv_hook(layer, inp, out):
         x = inp[0] if isinstance(inp, (list, tuple)) else inp

@@ -389,9 +389,28 @@ def save(layer, path, input_spec=None, **kwargs):
     prefix = path[:-9] if path.endswith(".pdparams") else path
     _save(layer.state_dict(), prefix + ".pdparams")
     if input_spec is not None:
-        from ..inference import save_inference_model
+        import jax
 
-        arrs = [s.value if isinstance(s, Tensor) else s for s in input_spec]
+        from ..core.dtype import convert_dtype
+        from ..inference import save_inference_model
+        from ..static.program import InputSpec
+
+        arrs = []
+        for i, s in enumerate(input_spec):
+            if isinstance(s, InputSpec):
+                # None/-1 dims export shape-polymorphic (dynamic batch),
+                # matching static.save_inference_model
+                if any(d in (None, -1) for d in s.shape):
+                    dims = ", ".join(
+                        f"js{i}_{j}" if d in (None, -1) else str(d)
+                        for j, d in enumerate(s.shape))
+                    shape = tuple(jax.export.symbolic_shape(dims))
+                else:
+                    shape = tuple(int(d) for d in s.shape)
+                arrs.append(jax.ShapeDtypeStruct(
+                    shape, convert_dtype(s.dtype) or "float32"))
+            else:
+                arrs.append(s.value if isinstance(s, Tensor) else s)
         save_inference_model(prefix, layer, arrs)
     return prefix
 

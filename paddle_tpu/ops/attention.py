@@ -9,7 +9,6 @@ correctness reference and the fallback for CPU tests.
 """
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp

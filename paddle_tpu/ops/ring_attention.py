@@ -25,7 +25,6 @@ composes with jax.grad/pipeline/TP with no custom VJP.
 """
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp

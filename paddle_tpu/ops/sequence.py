@@ -22,7 +22,9 @@ import numpy as np
 __all__ = ["sequence_mask", "sequence_pad", "sequence_unpad",
            "sequence_pool", "sequence_softmax", "sequence_reverse",
            "sequence_expand", "sequence_first_step", "sequence_last_step",
-           "segment_ids_from_lengths"]
+           "sequence_concat", "sequence_conv", "sequence_enumerate",
+           "sequence_expand_as", "sequence_reshape", "sequence_scatter",
+           "sequence_slice", "segment_ids_from_lengths"]
 
 
 def _unwrap(x):
@@ -181,3 +183,156 @@ def sequence_expand(values, lengths, repeat_lengths, total_out: int):
     out = values[jnp.clip(src, 0, values.shape[0] - 1)]
     vshape = (total_out,) + (1,) * (out.ndim - 1)
     return jnp.where(row_valid.reshape(vshape), out, jnp.zeros_like(out))
+
+
+def sequence_concat(values_list, lengths_list):
+    """Concatenate ragged batches along TIME per sample (reference
+    sequence_concat_op): sample b's output = concat of its rows from each
+    input.  Inputs: lists of ([Ni, D], [B]) pairs; returns
+    (values [sum Ni, D], lengths [B])."""
+    vals = [_unwrap(v) for v in values_list]
+    lens = [_unwrap(l) for l in lengths_list]
+    B = lens[0].shape[0]
+    total = sum(v.shape[0] for v in vals)
+    out_len = sum(lens)
+    # output row -> (sample, which input, offset) via gather: build source
+    # indices per output position
+    starts_out = jnp.cumsum(out_len) - out_len  # [B]
+    out = jnp.zeros((total,) + vals[0].shape[1:], vals[0].dtype)
+    cursor = starts_out
+    for v, l in zip(vals, lens):
+        starts_in = jnp.cumsum(l) - l
+        n = v.shape[0]
+        # scatter each input row to its output slot
+        seg = segment_ids_from_lengths(l, n)
+        segc = jnp.clip(seg, 0, B - 1)
+        offs = jnp.arange(n) - starts_in[segc]
+        dest = cursor[segc] + offs
+        valid = seg < B
+        dest = jnp.where(valid, dest, total)  # dropped by scatter-clip
+        out = out.at[jnp.clip(dest, 0, total - 1)].add(
+            jnp.where(valid.reshape((-1,) + (1,) * (v.ndim - 1)), v, 0))
+        cursor = cursor + l
+    return out, out_len
+
+
+def sequence_expand_as(values, lengths, ref_lengths):
+    """Expand each sample's single row run to match ref_lengths (reference
+    sequence_expand_as_op: every row of sample b repeats so the sample has
+    ref_lengths[b] rows; requires lengths[b] == 1 semantics)."""
+    values = _unwrap(values)
+    lengths = _unwrap(lengths)
+    ref = _unwrap(ref_lengths)
+    B = lengths.shape[0]
+    try:
+        total_out = int(ref.sum())  # static output row count
+    except jax.errors.TracerIntegerConversionError as e:
+        raise ValueError(
+            "sequence_expand_as needs concrete ref_lengths (static output "
+            "shape); pass a host value or use sequence_expand with "
+            "total_out") from e
+    starts_in = jnp.cumsum(lengths) - lengths
+    ids = segment_ids_from_lengths(ref, total_out)
+    idsc = jnp.clip(ids, 0, B - 1)
+    return jnp.take(values, starts_in[idsc], axis=0), ref
+
+
+def sequence_enumerate(values, lengths, win_size: int, pad_value=0):
+    """Sliding windows of ids per sample (reference sequence_enumerate_op):
+    [N] int ids → [N, win_size]; windows crossing a sample end fill with
+    pad_value."""
+    v = _unwrap(values).reshape(-1)
+    lengths = _unwrap(lengths)
+    N = v.shape[0]
+    B = lengths.shape[0]
+    seg = segment_ids_from_lengths(lengths, N)
+    ends = jnp.cumsum(lengths)  # [B]
+    segc = jnp.clip(seg, 0, B - 1)
+    end_of_row = ends[segc]
+    cols = []
+    for w in range(win_size):
+        idx = jnp.arange(N) + w
+        ok = (idx < end_of_row) & (seg < B)
+        cols.append(jnp.where(ok, v[jnp.clip(idx, 0, N - 1)], pad_value))
+    return jnp.stack(cols, axis=1)
+
+
+def sequence_slice(values, lengths, offset, length):
+    """Per-sample slice (reference sequence_slice_op): sample b keeps rows
+    [offset[b], offset[b]+length[b]).  Returns (values [same N, D] with
+    kept rows compacted to the front of each output segment, lengths)."""
+    v = _unwrap(values)
+    lens = _unwrap(lengths)
+    off = _unwrap(offset).reshape(-1)
+    ln = _unwrap(length).reshape(-1)
+    B = lens.shape[0]
+    N = v.shape[0]
+    starts_in = jnp.cumsum(lens) - lens
+    out_len = ln
+    starts_out = jnp.cumsum(out_len) - out_len
+    ids = segment_ids_from_lengths(out_len, N)
+    idsc = jnp.clip(ids, 0, B - 1)
+    offs = jnp.arange(N) - starts_out[idsc]
+    src = starts_in[idsc] + off[idsc] + offs
+    valid = (ids < B)
+    out = jnp.where(valid.reshape((-1,) + (1,) * (v.ndim - 1)),
+                    jnp.take(v, jnp.clip(src, 0, N - 1), axis=0), 0)
+    return out, out_len
+
+
+def sequence_conv(values, lengths, weight, context_size: int,
+                  context_start: int = None, bias=None):
+    """Time-window convolution over ragged rows (reference
+    sequence_conv_op): out[t] = sum_w values[t + start + w] @ W[w], windows
+    clipped at sample boundaries."""
+    v = _unwrap(values)
+    lens = _unwrap(lengths)
+    W = _unwrap(weight)  # [context_size * D, out]
+    if context_start is None:
+        context_start = -(context_size // 2)
+    N, D = v.shape
+    B = lens.shape[0]
+    seg = segment_ids_from_lengths(lens, N)
+    segc = jnp.clip(seg, 0, B - 1)
+    starts = (jnp.cumsum(lens) - lens)[segc]
+    ends = jnp.cumsum(lens)[segc]
+    pieces = []
+    for w in range(context_size):
+        idx = jnp.arange(N) + context_start + w
+        ok = (idx >= starts) & (idx < ends) & (seg < B)
+        rows = jnp.where(ok[:, None],
+                         jnp.take(v, jnp.clip(idx, 0, N - 1), axis=0), 0)
+        pieces.append(rows)
+    ctx = jnp.concatenate(pieces, axis=1)  # [N, context_size * D]
+    out = ctx @ W
+    if bias is not None:
+        out = out + _unwrap(bias)
+    return out
+
+
+def sequence_reshape(values, lengths, new_dim: int):
+    """Re-chunk each sample's flattened elements into rows of new_dim
+    (reference sequence_reshape_op); sample element counts must divide
+    new_dim."""
+    v = _unwrap(values)
+    lens = _unwrap(lengths)
+    D = v.shape[1]
+    out = v.reshape(-1, new_dim)
+    new_len = lens * D // new_dim
+    return out, new_len
+
+
+def sequence_scatter(x, index_values, index_lengths, updates):
+    """Scatter-add ragged updates into x (reference sequence_scatter_op):
+    sample b adds updates-rows at column indices index[b] of x's row b."""
+    xv = _unwrap(x)
+    idx = _unwrap(index_values).reshape(-1)
+    lens = _unwrap(index_lengths)
+    upd = _unwrap(updates).reshape(-1)
+    B = lens.shape[0]
+    N = idx.shape[0]
+    seg = segment_ids_from_lengths(lens, N)
+    valid = seg < B
+    rows = jnp.clip(seg, 0, B - 1)
+    return xv.at[rows, jnp.clip(idx, 0, xv.shape[1] - 1)].add(
+        jnp.where(valid, upd, 0))

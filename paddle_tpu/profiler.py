@@ -18,7 +18,6 @@ import os
 import threading
 import time
 from collections import defaultdict
-from typing import Any
 
 _state = threading.local()
 _events: list = []  # (name, start_s, stop_s, thread_id)

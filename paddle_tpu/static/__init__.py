@@ -74,3 +74,134 @@ def _attach_variable_methods():
 
 
 _attach_variable_methods()
+
+
+# -- compiled-program compat shims (XLA subsumes; reference
+# fluid/compiler.py BuildStrategy/ExecutionStrategy/CompiledProgram and
+# parallel_executor.py — the pass pipeline + SSA scheduler roles are
+# played by jax.jit/XLA, so these accept configuration and return the
+# program unchanged) --------------------------------------------------------
+
+class BuildStrategy:
+    def __init__(self):
+        self.memory_optimize = None
+        self.enable_inplace = None
+        self.fuse_all_reduce_ops = None
+        self.fuse_elewise_add_act_ops = None
+        self.reduce_strategy = None
+        self.num_trainers = 1
+        self.trainer_id = 0
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 0
+        self.num_iteration_per_drop_scope = 1
+        self.num_iteration_per_run = 1
+
+
+class CompiledProgram:
+    """Accepts a Program and strategy config; Executor.run handles it like
+    the raw program (compilation happens in the jit cache anyway)."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        return self
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_program"), name)
+
+
+ParallelExecutor = Executor  # single jitted program covers the role
+
+
+class WeightNormParamAttr:
+    """reference param_attr.py WeightNormParamAttr — accepted by
+    create_parameter-style APIs; weight normalization itself is applied via
+    nn.utils.weight_norm on the built layer."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.trainable = trainable
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Static accuracy op (reference layers/metric_op.py accuracy)."""
+    from ..core import static_mode
+    from ..core.tensor import Tensor as _T
+
+    def impl(logits, lab):
+        import jax.numpy as jnp
+
+        lv = logits.value if hasattr(logits, "value") else logits
+        yv = (lab.value if hasattr(lab, "value") else lab).reshape(-1)
+        topk = jnp.argsort(lv, axis=-1)[:, -k:]
+        hit = (topk == yv[:, None]).any(-1)
+        return _T(hit.mean(dtype=jnp.float32).reshape(1))
+
+    prog = static_mode.recording()
+    if prog is not None:
+        return prog.record_call(impl, (input, label), {})
+    return impl(input, label)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """Static AUC (reference auc_op): ROC-AUC of positive-class scores via
+    the rank statistic (exact for distinct scores)."""
+    from ..core import static_mode
+    from ..core.tensor import Tensor as _T
+
+    def impl(logits, lab):
+        import jax.numpy as jnp
+
+        lv = logits.value if hasattr(logits, "value") else logits
+        yv = (lab.value if hasattr(lab, "value") else lab).reshape(-1)
+        score = lv[:, 1] if lv.ndim == 2 and lv.shape[1] == 2 else \
+            lv.reshape(-1)
+        order = jnp.argsort(score)
+        ranks = jnp.empty_like(order).at[order].set(
+            jnp.arange(1, score.shape[0] + 1))
+        pos = (yv > 0)
+        n_pos = pos.sum()
+        n_neg = yv.shape[0] - n_pos
+        a = (ranks * pos).sum() - n_pos * (n_pos + 1) / 2.0
+        return _T((a / jnp.maximum(n_pos * n_neg, 1)).astype(
+            jnp.float32).reshape(1))
+
+    prog = static_mode.recording()
+    if prog is not None:
+        return prog.record_call(impl, (input, label), {})
+    return impl(input, label)
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """Save ALL program parameters (vars/predicate filters are not
+    supported — the whole-state save is the capability)."""
+    import os as _os
+
+    _os.makedirs(dirname, exist_ok=True)
+    save(main_program or default_main_program(),
+         _os.path.join(dirname, filename or "params"))
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    load(main_program or default_main_program(),
+         __import__("os").path.join(dirname, filename or "params"))
+
+
+def xpu_places(device_ids=None):
+    raise NotImplementedError("TPU build has no XPU backend")
+
+
+from .nn import py_func  # noqa: F401,E402  (reference exports it at static/)

@@ -34,6 +34,10 @@ __all__ = [
     "py_func", "sequence_pool", "sequence_softmax", "sequence_first_step",
     "sequence_last_step", "sequence_pad", "sequence_unpad",
     "sequence_reverse", "sequence_expand", "sequence_mask",
+    "sequence_concat", "sequence_conv", "sequence_enumerate",
+    "sequence_expand_as", "sequence_reshape", "sequence_scatter",
+    "sequence_slice", "conv3d_transpose", "spectral_norm",
+    "multi_box_head",
 ]
 
 
@@ -663,3 +667,183 @@ def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
     return _dc(x, offset, w, bias=b, stride=stride, padding=padding,
                dilation=dilation, deformable_groups=deformable_groups,
                groups=groups, mask=mask)
+
+
+def conv3d_transpose(input, num_filters, filter_size, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1, param_attr=None,
+                     bias_attr=None, act=None, data_format="NCDHW",
+                     name=None):
+    from ..nn import functional as F
+
+    k = _pair(filter_size, 3)
+    cin = _static_dim(input, 1 if data_format == "NCDHW" else -1,
+                      "conv3d_transpose")
+    w = create_parameter([cin, num_filters // groups, k[0], k[1], k[2]],
+                         input.dtype, name=name and name + ".w")
+    b = None
+    if bias_attr is not False:
+        b = create_parameter([num_filters], input.dtype, is_bias=True,
+                             name=name and name + ".b")
+    y = F.conv3d_transpose(input, w, b, stride=stride, padding=padding,
+                           output_padding=output_padding, dilation=dilation,
+                           groups=groups, data_format=data_format)
+    return _act(y, act)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Spectrally-normalized view of a weight Variable/Parameter (reference
+    static spectral_norm op): creates persistent u/v vectors and returns
+    weight / sigma."""
+    from ..nn.layer.norm import SpectralNorm as _SN
+
+    shape = [int(s) for s in weight.shape]
+    sn = _SN(shape, dim=dim, power_iters=power_iters, eps=eps)
+    prog = static_mode.recording()
+    if prog is not None:
+        def impl(w, u, v):
+            # power iteration on the stop-gradient weight; sigma keeps the
+            # grad path through w; updated u/v become write-back outputs so
+            # the estimate CONVERGES across steps (reference op persists
+            # them, as does the dynamic SpectralNorm layer)
+            wv = w.value
+            mat = jnp.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)
+            uu, vv = u.value, v.value
+            m_sg = jax.lax.stop_gradient(mat)
+            for _ in range(power_iters):
+                vv = m_sg.T @ uu
+                vv = vv / (jnp.linalg.norm(vv) + eps)
+                uu = m_sg @ vv
+                uu = uu / (jnp.linalg.norm(uu) + eps)
+            sigma = uu @ mat @ vv
+            return Tensor(wv / (sigma + eps)), Tensor(uu), Tensor(vv)
+        out, new_u, new_v = prog.record_call(
+            impl, (weight, sn.weight_u, sn.weight_v), {})
+        root = prog._root()
+        root.writebacks.append((sn.weight_u.name, _VarRef(new_u.vid)))
+        root.writebacks.append((sn.weight_v.name, _VarRef(new_v.vid)))
+        root._version += 1
+        return out
+    return sn(weight)
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, offset=0.5, flip=True,
+                   clip=False, name=None):
+    """SSD detection heads (reference static/nn multi_box_head over
+    operators/detection): per feature map, a loc conv (P*4 channels) and a
+    conf conv (P*C channels) plus prior boxes; outputs concatenated across
+    maps as (locs [N, total_P, 4], confs [N, total_P, C],
+    boxes [total_P, 4])."""
+    import numpy as np_
+
+    import paddle_tpu as P
+    from ..vision.ops import prior_box as _prior_box
+
+    n_maps = len(inputs)
+    if min_sizes is None:
+        min_ratio = min_ratio if min_ratio is not None else 20
+        max_ratio = max_ratio if max_ratio is not None else 90
+        step = int((max_ratio - min_ratio) / max(1, n_maps - 2))
+        ratios = [min_ratio + i * step for i in range(n_maps - 1)]
+        min_sizes = [base_size * 0.1] + [base_size * r / 100.0
+                                         for r in ratios]
+        max_sizes = [base_size * 0.2] + [base_size * (r + step) / 100.0
+                                         for r in ratios]
+    img_h = _static_dim(image, 2, "multi_box_head image")
+    img_w = _static_dim(image, 3, "multi_box_head image")
+
+    locs, confs, boxes = [], [], []
+    for i, feat in enumerate(inputs):
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[i],
+                                            (list, tuple)) \
+            else [aspect_ratios[i]]
+        ms = [min_sizes[i]] if not isinstance(min_sizes[i], (list, tuple)) \
+            else list(min_sizes[i])
+        xs = [max_sizes[i]] if max_sizes else []
+        fh = _static_dim(feat, 2, "multi_box_head feat")
+        fw = _static_dim(feat, 3, "multi_box_head feat")
+        pb = _prior_box(fh, fw, img_h, img_w, ms, xs, ar, flip=flip,
+                        clip=clip,
+                        step=(steps[i] if steps else 0.0), offset=offset)
+        pb_np = np_.asarray(pb.value if hasattr(pb, "value") else pb)
+        P_per = pb_np.shape[2]
+        loc = conv2d(feat, P_per * 4, 3, padding=1, bias_attr=False,
+                     name=name and f"{name}.loc{i}")
+        conf = conv2d(feat, P_per * num_classes, 3, padding=1,
+                      bias_attr=False, name=name and f"{name}.conf{i}")
+        # [N, P*4, H, W] -> [N, H*W*P, 4]
+        loc = P.reshape(P.transpose(loc, [0, 2, 3, 1]), [-1, fh * fw * P_per, 4])
+        conf = P.reshape(P.transpose(conf, [0, 2, 3, 1]),
+                         [-1, fh * fw * P_per, num_classes])
+        locs.append(loc)
+        confs.append(conf)
+        boxes.append(pb_np.reshape(-1, 4))
+    mbox_locs = P.concat(locs, axis=1)
+    mbox_confs = P.concat(confs, axis=1)
+    import jax.numpy as jnp_
+
+    all_boxes = Tensor(jnp_.asarray(np_.concatenate(boxes, 0)))
+    return mbox_locs, mbox_confs, all_boxes, None
+
+
+sequence_expand_as = _seq("sequence_expand_as")
+sequence_enumerate = _seq("sequence_enumerate")
+sequence_slice = _seq("sequence_slice")
+sequence_reshape = _seq("sequence_reshape")
+sequence_scatter = _seq("sequence_scatter")
+
+
+def sequence_concat(values_list, lengths_list):
+    """Ragged per-sample time-concat (reference sequence_concat) — custom
+    wrapper because the inputs are LISTS of (values, lengths)."""
+    from ..ops import sequence as _s
+
+    def impl(vl, ll):
+        vals = [x.value if isinstance(x, Tensor) else x for x in vl]
+        lens = [x.value if isinstance(x, Tensor) else x for x in ll]
+        out, ol = _s.sequence_concat(vals, lens)
+        return Tensor(out), Tensor(ol)
+
+    prog = static_mode.recording()
+    if prog is not None and (static_mode.has_variables(tuple(values_list), {})
+                             or static_mode.has_variables(
+                                 tuple(lengths_list), {})):
+        return prog.record_call(impl, (list(values_list),
+                                       list(lengths_list)), {})
+    return impl(list(values_list), list(lengths_list))
+
+
+def sequence_conv(values, lengths, num_filters=None, filter_size=3,
+                  context_start=None, param_attr=None, bias_attr=None,
+                  act=None):
+    """Ragged time-window conv with a created parameter (reference
+    sequence_conv layer)."""
+    from ..ops import sequence as _s
+
+    if hasattr(values, "shape"):
+        D = int(values.shape[-1])
+    else:
+        D = int(np.asarray(values).shape[-1])
+    out_dim = num_filters or D
+    w = create_parameter([filter_size * D, out_dim], "float32")
+    b = None
+    if bias_attr is not False:
+        b = create_parameter([out_dim], "float32", is_bias=True)
+
+    def impl(v, l, wp, *rest):
+        bb = rest[0] if rest else None
+        out = _s.sequence_conv(
+            v.value if isinstance(v, Tensor) else v,
+            l.value if isinstance(l, Tensor) else l,
+            wp.value if isinstance(wp, Tensor) else wp,
+            filter_size, context_start,
+            (bb.value if isinstance(bb, Tensor) else bb)
+            if bb is not None else None)
+        return Tensor(out)
+
+    args = (values, lengths, w) + ((b,) if b is not None else ())
+    prog = static_mode.recording()
+    if prog is not None and static_mode.has_variables(args, {}):
+        return _act(prog.record_call(impl, args, {}), act)
+    return _act(impl(*args), act)

@@ -90,3 +90,25 @@ class TestJitSaveLoad:
         tl = load(prefix)
         with pytest.raises(RuntimeError, match="inference-only"):
             tl.train()
+
+
+def test_jit_save_with_input_spec_dynamic_batch(tmp_path):
+    """paddle.jit.save(layer, path, input_spec=[InputSpec([None, D])]) —
+    the reference's standard signature; served at multiple batch sizes."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.static import InputSpec
+
+    lin = paddle.nn.Linear(4, 2)
+    prefix = str(tmp_path / "m")
+    paddle.jit.save(lin, prefix,
+                    input_spec=[InputSpec([None, 4], "float32")])
+    loaded = paddle.jit.load(prefix)
+    for b in (1, 5):
+        x = np.ones((b, 4), np.float32)
+        got = np.asarray(loaded(paddle.to_tensor(x)).value
+                         if hasattr(loaded(paddle.to_tensor(x)), "value")
+                         else loaded(paddle.to_tensor(x)))
+        expect = np.asarray(lin(paddle.to_tensor(x)).value)
+        np.testing.assert_allclose(got, expect, rtol=1e-5)

@@ -397,3 +397,68 @@ def test_summary_layer_table():
     assert "Conv2D" in names and "Linear" in names
     shapes = [r[1] for r in s["layer_table"]]
     assert (1, 10) in shapes  # final logits
+
+
+def test_static_accuracy_auc_and_compiled_program():
+    rng = np.random.default_rng(0)
+    main, startup = _fresh_programs()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 4], "float32")
+        lab = static.data("lab", [None], "int64")
+        logits = static.nn.fc(x, 2)
+        acc = static.accuracy(logits, lab)
+        a = static.auc(logits, lab)
+    exe = static.Executor()
+    exe.run(startup)
+    cp = static.CompiledProgram(main,
+                                build_strategy=static.BuildStrategy())
+    cp = cp.with_data_parallel(loss_name=None)
+    out = exe.run(cp._program, feed={
+        "x": rng.standard_normal((16, 4)).astype(np.float32),
+        "lab": rng.integers(0, 2, 16).astype(np.int64)},
+        fetch_list=[acc, a])
+    assert 0.0 <= out[0][0] <= 1.0 and 0.0 <= out[1][0] <= 1.0
+
+
+def test_static_auc_matches_sklearn_free_formula():
+    """rank-statistic AUC vs a brute-force pairwise computation."""
+    rng = np.random.default_rng(1)
+    scores = rng.random(50).astype(np.float32)
+    labels = rng.integers(0, 2, 50).astype(np.int64)
+    logits = np.stack([1 - scores, scores], 1)
+    got = float(np.asarray(static.auc(
+        paddle.to_tensor(logits), paddle.to_tensor(labels)).value)[0])
+    pos = scores[labels == 1]
+    neg = scores[labels == 0]
+    brute = (pos[:, None] > neg[None, :]).mean()
+    np.testing.assert_allclose(got, brute, rtol=1e-5)
+
+
+def test_static_nn_sequence_and_multibox():
+    # ragged sequence ops through static.nn
+    v = paddle.to_tensor(np.arange(5, dtype=np.float32).reshape(5, 1))
+    lens = paddle.to_tensor(np.array([2, 3]))
+    win = static.nn.sequence_enumerate(
+        paddle.to_tensor(np.array([1, 2, 3, 4, 5])), lens, 2)
+    assert tuple(win.shape) == (5, 2)
+
+    # multi_box_head over two feature maps
+    main, startup = _fresh_programs()
+    with static.program_guard(main, startup):
+        img = static.data("img", [None, 3, 64, 64], "float32")
+        f1 = static.data("f1", [None, 8, 8, 8], "float32")
+        f2 = static.data("f2", [None, 8, 4, 4], "float32")
+        locs, confs, boxes, _ = static.nn.multi_box_head(
+            [f1, f2], img, base_size=64, num_classes=3,
+            aspect_ratios=[[2.0], [2.0]])
+    exe = static.Executor()
+    exe.run(startup)
+    rng = np.random.default_rng(0)
+    lo, co = exe.run(main, feed={
+        "img": rng.standard_normal((2, 3, 64, 64)).astype(np.float32),
+        "f1": rng.standard_normal((2, 8, 8, 8)).astype(np.float32),
+        "f2": rng.standard_normal((2, 8, 4, 4)).astype(np.float32),
+    }, fetch_list=[locs, confs])
+    assert lo.shape[0] == 2 and lo.shape[2] == 4
+    assert co.shape[:2] == lo.shape[:2] and co.shape[2] == 3
+    assert boxes.shape[0] == lo.shape[1]  # priors align with heads
