@@ -33,7 +33,9 @@ if not _root._LIGHT_IMPORT:
         CommunicateTopology, HybridCommunicateGroup,
     )
 
-    from . import heter, spawn  # noqa: F401
+    from . import heter, sharding_rules, spawn  # noqa: F401
+    from .sharding_rules import (  # noqa: F401
+        apply_sharding_rules, match_sharding_rules)
     from .fleet.dataset import InMemoryDataset, QueueDataset  # noqa: F401
 
     class ParallelEnv:

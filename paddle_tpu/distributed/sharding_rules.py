@@ -1,0 +1,76 @@
+"""Name-pattern → PartitionSpec rules for arbitrary models.
+
+Reference capability: the reference's per-layer manual sharding choices
+(mp_layers.py picks row/col sharding per named layer; sharding_optimizer
+walks named vars).  TPU-first: users give ordered (regex, PartitionSpec)
+rules over parameter path names and get a matching pytree of specs for
+pjit/jit in_shardings — the standard JAX-community idiom for sharding
+custom models without writing per-layer wrappers.
+"""
+from __future__ import annotations
+
+import re
+from typing import Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["match_sharding_rules", "apply_sharding_rules"]
+
+
+def _leaf_paths(tree):
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    names = []
+    for path, _ in flat:
+        parts = []
+        for k in path:
+            if hasattr(k, "key"):
+                parts.append(str(k.key))
+            elif hasattr(k, "idx"):
+                parts.append(str(k.idx))
+            elif hasattr(k, "name"):
+                parts.append(str(k.name))
+            else:
+                parts.append(str(k))
+        names.append("/".join(parts))
+    return names, [l for _, l in flat], treedef
+
+
+def match_sharding_rules(rules: Sequence[Tuple[str, P]], params,
+                         default=None, strict=True):
+    """Ordered (regex, PartitionSpec) rules → pytree of specs matching
+    ``params``.  Scalars are never partitioned.  With ``strict`` a leaf no
+    rule matches raises (silently-replicated big weights are the classic
+    sharding bug); otherwise it gets ``default`` (replicated when None)."""
+    names, leaves, treedef = _leaf_paths(params)
+    specs = []
+    for name, leaf in zip(names, leaves):
+        shape = getattr(leaf, "shape", ())
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            specs.append(P())
+            continue
+        for pat, spec in rules:
+            if re.search(pat, name):
+                specs.append(spec)
+                break
+        else:
+            if strict:
+                raise ValueError(
+                    f"no sharding rule matches parameter {name!r} "
+                    f"(shape {tuple(shape)}); add a rule or pass "
+                    "strict=False")
+            specs.append(default if default is not None else P())
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def apply_sharding_rules(rules, params, mesh, default=None, strict=True):
+    """Place ``params`` onto ``mesh`` per the matched rules; returns
+    (placed params, pytree of NamedShardings for jit in_shardings)."""
+    specs = match_sharding_rules(rules, params, default=default,
+                                 strict=strict)
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P))
+    placed = jax.tree_util.tree_map(jax.device_put, params, shardings)
+    return placed, shardings

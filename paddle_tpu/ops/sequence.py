@@ -274,7 +274,11 @@ def sequence_slice(values, lengths, offset, length):
     idsc = jnp.clip(ids, 0, B - 1)
     offs = jnp.arange(N) - starts_out[idsc]
     src = starts_in[idsc] + off[idsc] + offs
-    valid = (ids < B)
+    # a slice must stay inside its own sample (reference enforces
+    # offset+length <= sample length; rows past the boundary zero out
+    # rather than leaking the NEXT sample's data)
+    inside = (off[idsc] + offs) < lens[idsc]
+    valid = (ids < B) & inside
     out = jnp.where(valid.reshape((-1,) + (1,) * (v.ndim - 1)),
                     jnp.take(v, jnp.clip(src, 0, N - 1), axis=0), 0)
     return out, out_len
@@ -312,11 +316,22 @@ def sequence_conv(values, lengths, weight, context_size: int,
 
 def sequence_reshape(values, lengths, new_dim: int):
     """Re-chunk each sample's flattened elements into rows of new_dim
-    (reference sequence_reshape_op); sample element counts must divide
-    new_dim."""
+    (reference sequence_reshape_op); each sample's element count
+    (lengths[b] * D) must be divisible BY new_dim — the reference op
+    enforces this and so do we (silent merging would blend samples)."""
     v = _unwrap(values)
     lens = _unwrap(lengths)
     D = v.shape[1]
+    try:
+        bad = np.asarray((lens * D) % new_dim != 0)
+        if bad.any():
+            raise ValueError(
+                f"sequence_reshape: sample element counts "
+                f"{np.asarray(lens * D).tolist()} must divide by "
+                f"new_dim={new_dim}")
+    except (TypeError, jax.errors.TracerArrayConversionError,
+            jax.errors.ConcretizationTypeError):
+        pass  # traced lengths: caller guarantees divisibility
     out = v.reshape(-1, new_dim)
     new_len = lens * D // new_dim
     return out, new_len

@@ -31,7 +31,10 @@ __all__ = [
     "serialize_persistables", "deserialize_persistables",
     "serialize_program", "deserialize_program", "save_to_file",
     "load_from_file", "normalize_program", "load_program_state",
-    "set_program_state", "cpu_places", "device_guard",
+    "set_program_state", "cpu_places", "device_guard", "accuracy", "auc",
+    "BuildStrategy", "CompiledProgram", "ExecutionStrategy",
+    "ParallelExecutor", "WeightNormParamAttr", "save_vars", "load_vars",
+    "py_func", "xpu_places", "amp",
 ]
 
 
@@ -115,8 +118,30 @@ class CompiledProgram:
     def __getattr__(self, name):
         return getattr(object.__getattribute__(self, "_program"), name)
 
+    def __setattr__(self, name, value):
+        # training state (opt_state, train_step_count, ...) must land on
+        # the wrapped Program — a write trapped on the wrapper would fork
+        # the state from the raw program
+        if name in ("_program", "_build_strategy"):
+            object.__setattr__(self, name, value)
+        else:
+            setattr(object.__getattribute__(self, "_program"), name, value)
 
-ParallelExecutor = Executor  # single jitted program covers the role
+
+class ParallelExecutor(Executor):
+    """Reference parallel_executor.py signature compat: the single jitted
+    program covers the multi-device SSA-executor role (XLA schedules)."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None):
+        super().__init__()
+        self._main_program = main_program
+
+    def run(self, fetch_list=None, feed=None, program=None, **kw):
+        return super().run(program or self._main_program, feed=feed,
+                           fetch_list=fetch_list, **kw)
 
 
 class WeightNormParamAttr:
@@ -138,14 +163,15 @@ def accuracy(input, label, k=1, correct=None, total=None):
     from ..core import static_mode
     from ..core.tensor import Tensor as _T
 
+    from ..metric import accuracy as _metric_accuracy
+
     def impl(logits, lab):
+        # ONE top-k implementation: delegate to metric.accuracy (handles
+        # the [N,1]-label squeeze); reshape to the reference's [1] output
         import jax.numpy as jnp
 
-        lv = logits.value if hasattr(logits, "value") else logits
-        yv = (lab.value if hasattr(lab, "value") else lab).reshape(-1)
-        topk = jnp.argsort(lv, axis=-1)[:, -k:]
-        hit = (topk == yv[:, None]).any(-1)
-        return _T(hit.mean(dtype=jnp.float32).reshape(1))
+        v = _metric_accuracy(logits, lab, k=k).value
+        return _T(jnp.reshape(v, (1,)))
 
     prog = static_mode.recording()
     if prog is not None:

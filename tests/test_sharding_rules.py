@@ -1,0 +1,56 @@
+"""Regex sharding rules for custom models (generic GSPMD helper)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.sharding_rules import (apply_sharding_rules,
+                                                   match_sharding_rules)
+
+
+def _params():
+    return {
+        "embed": {"w": jnp.zeros((64, 32))},
+        "blocks": [{"attn_qkv": jnp.zeros((32, 96)),
+                    "ffn_out": jnp.zeros((128, 32)),
+                    "ln_g": jnp.zeros((32,)),
+                    "scale": jnp.zeros(())}],
+    }
+
+
+RULES = [
+    (r"embed/w", P("mp", None)),
+    (r"attn_qkv", P(None, "mp")),
+    (r"ffn_out", P("mp", None)),
+    (r"ln_g", P()),
+]
+
+
+def test_match_rules_and_scalars():
+    specs = match_sharding_rules(RULES, _params())
+    assert specs["embed"]["w"] == P("mp", None)
+    assert specs["blocks"][0]["attn_qkv"] == P(None, "mp")
+    assert specs["blocks"][0]["ln_g"] == P()
+    assert specs["blocks"][0]["scale"] == P()  # scalars never partitioned
+
+
+def test_strict_raises_on_unmatched():
+    params = dict(_params(), rogue=jnp.zeros((8, 8)))
+    with pytest.raises(ValueError, match="rogue"):
+        match_sharding_rules(RULES, params)
+    specs = match_sharding_rules(RULES, params, strict=False)
+    assert specs["rogue"] == P()
+
+
+def test_apply_places_on_mesh():
+    devs = jax.devices()[:8]
+    mesh = Mesh(np.array(devs).reshape(2, 4), ("dp", "mp"))
+    placed, shardings = apply_sharding_rules(RULES, _params(), mesh)
+    w = placed["embed"]["w"]
+    # sharded over mp=4 along dim 0 → each shard holds 16 rows
+    assert w.addressable_shards[0].data.shape == (16, 32)
+    qkv = placed["blocks"][0]["attn_qkv"]
+    assert qkv.addressable_shards[0].data.shape == (32, 24)
