@@ -3,6 +3,7 @@ from . import bert  # noqa: F401
 from . import gpt  # noqa: F401
 from . import gpt_hybrid  # noqa: F401
 from . import datasets  # noqa: F401
+from . import generate  # noqa: F401
 from . import seq2seq  # noqa: F401
 from . import moe  # noqa: F401
 from .gpt import GPTConfig, gpt_1p3b, gpt_13b  # noqa: F401

@@ -1,0 +1,171 @@
+"""Autoregressive GPT generation with a KV cache.
+
+Beyond-reference capability (the v2.1 reference ships no generate API): a
+TPU-first decode loop — the whole generation is ONE ``lax.scan`` over
+positions with per-layer K/V caches updated via ``dynamic_update_slice``,
+so XLA compiles a single program per (batch, max_len) and every decode step
+is a fixed-shape cached-attention block (no re-running the prefix).
+
+Works with the dense `gpt.GPTConfig` models (tied embeddings); sampling is
+greedy or temperature/top-k off an explicit PRNG key.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from . import gpt
+
+__all__ = ["init_cache", "decode_step", "generate"]
+
+
+def init_cache(cfg: gpt.GPTConfig, batch: int, max_len: int):
+    """Per-layer K/V cache [L, B, max_len, H, hd]; the caller tracks the
+    write position (generate's scan carries it implicitly)."""
+    L, H, hd = cfg.num_layers, cfg.num_heads, cfg.head_dim
+    shape = (L, batch, max_len, H, hd)
+    return {"k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def _cached_block(x, p, cache_k, cache_v, pos, cfg: gpt.GPTConfig):
+    """One block on a SINGLE position [B, 1, D] against the cache.
+    Returns (x, new_k_row, new_v_row): caller writes the rows at pos."""
+    B, _, D = x.shape
+    H, hd = cfg.num_heads, cfg.head_dim
+    dt = cfg.dtype
+    h = gpt._layer_norm(x.astype(jnp.float32), p["ln1_g"],
+                        p["ln1_b"]).astype(dt)
+    qkv = jnp.einsum("btd,kde->kbte", h, p["qkv_w"].astype(dt)) \
+        + p["qkv_b"].astype(dt)[:, None, None]
+    q = qkv[0].reshape(B, H, hd)
+    k_new = qkv[1].reshape(B, H, hd)
+    v_new = qkv[2].reshape(B, H, hd)
+    # attend over cache rows [B, max_len, H, hd] with the fresh row at pos
+    k_all = jax.lax.dynamic_update_slice(
+        cache_k, k_new[:, None], (0, pos, 0, 0))
+    v_all = jax.lax.dynamic_update_slice(
+        cache_v, v_new[:, None], (0, pos, 0, 0))
+    scores = jnp.einsum("bhd,bthd->bht", q, k_all) / jnp.sqrt(
+        jnp.asarray(hd, jnp.float32)).astype(dt)
+    T = cache_k.shape[1]
+    mask = jnp.arange(T)[None, None, :] <= pos
+    scores = jnp.where(mask, scores.astype(jnp.float32), -1e30)
+    w = jax.nn.softmax(scores, axis=-1).astype(dt)
+    attn = jnp.einsum("bht,bthd->bhd", w, v_all).reshape(B, 1, D)
+    a = attn @ p["proj_w"].astype(dt) + p["proj_b"].astype(dt)
+    x = x + a
+    h = gpt._layer_norm(x.astype(jnp.float32), p["ln2_g"],
+                        p["ln2_b"]).astype(dt)
+    h = jax.nn.gelu(h @ p["fc_w"].astype(dt) + p["fc_b"].astype(dt))
+    h = h @ p["out_w"].astype(dt) + p["out_b"].astype(dt)
+    return x + h, k_new, v_new
+
+
+def decode_step(params, cache, token, pos, cfg: gpt.GPTConfig):
+    """token [B] int32 at position pos → (logits [B, V], updated cache)."""
+    if cfg.moe is not None:
+        raise NotImplementedError("cached decode supports dense models")
+    dt = cfg.dtype
+    B = token.shape[0]
+    x = params["wte"][token].astype(dt)[:, None] \
+        + jax.lax.dynamic_slice(params["wpe"], (pos, 0),
+                                (1, cfg.hidden_size)).astype(dt)[None]
+
+    def body(x, layer):
+        p, ck, cv = layer
+        x, k_new, v_new = _cached_block(x, p, ck, cv, pos, cfg)
+        return x, (k_new, v_new)
+
+    x, (k_rows, v_rows) = jax.lax.scan(
+        body, x, (params["blocks"], cache["k"], cache["v"]))
+    new_k = jax.lax.dynamic_update_slice(
+        cache["k"], k_rows[:, :, None], (0, 0, pos, 0, 0))
+    new_v = jax.lax.dynamic_update_slice(
+        cache["v"], v_rows[:, :, None], (0, 0, pos, 0, 0))
+    x = gpt._layer_norm(x.astype(jnp.float32), params["ln_f_g"],
+                        params["ln_f_b"]).astype(dt)
+    logits = (x @ params["wte"].T.astype(dt))[:, 0]
+    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
+_GEN_CACHE: dict = {}
+
+
+def _cfg_key(cfg):
+    """Value-based cache key (GPTConfig is an unhashable dataclass; keying
+    by id() would recompile per object and leak executables)."""
+    moe = cfg.moe
+    moe_key = (moe.num_experts,) if moe is not None else None
+    return (cfg.vocab_size, cfg.hidden_size, cfg.num_layers, cfg.num_heads,
+            cfg.max_seq_len, cfg.ffn_ratio, str(cfg.dtype), cfg.use_flash,
+            moe_key)
+
+
+def _get_generate_fn(cfg, max_new_tokens, top_k):
+    """jit per (config VALUE, gen params) — GPTConfig is closed over
+    (dataclass isn't hashable for static_argnames)."""
+    cache_key = (_cfg_key(cfg), max_new_tokens, top_k)
+    fn = _GEN_CACHE.get(cache_key)
+    if fn is None:
+        fn = jax.jit(functools.partial(
+            _generate_impl, cfg=cfg, max_new_tokens=max_new_tokens,
+            top_k=top_k))
+        _GEN_CACHE[cache_key] = fn
+    return fn
+
+
+def _generate_impl(params, prompt, key, temperature, *, cfg,
+                   max_new_tokens, top_k):
+    B, P = prompt.shape
+    total = P + max_new_tokens
+    cache = init_cache(cfg, B, total)
+    tokens = jnp.zeros((B, total), jnp.int32)
+    tokens = tokens.at[:, :P].set(prompt)
+
+    def step(carry, pos):
+        tokens, cache, key = carry
+        tok = jax.lax.dynamic_slice(tokens, (0, pos), (B, 1))[:, 0]
+        logits, cache = decode_step(params, cache, tok, pos, cfg)
+        key, sub = jax.random.split(key)
+        if top_k > 0:
+            kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+            logits = jnp.where(logits < kth, -1e30, logits)
+        nxt = jax.lax.cond(
+            jnp.asarray(temperature) > 0.0,
+            lambda: jax.random.categorical(
+                sub, logits / jnp.maximum(temperature, 1e-6)),
+            lambda: jnp.argmax(logits, axis=-1).astype(jnp.int32))
+        nxt = nxt.astype(jnp.int32)
+        # prompt positions keep their given token; past-prompt write samples
+        write = jnp.where(pos + 1 < P, tokens[:, pos + 1], nxt)
+        tokens = jax.lax.dynamic_update_slice(
+            tokens, write[:, None], (0, pos + 1))
+        return (tokens, cache, key), None
+
+    (tokens, cache, _), _ = jax.lax.scan(
+        step, (tokens, cache, key), jnp.arange(total - 1))
+    return tokens
+
+
+def generate(params, cfg: gpt.GPTConfig, prompt, max_new_tokens=32,
+             temperature=0.0, top_k=0, key=None):
+    """prompt [B, P] int → [B, P + max_new_tokens] tokens (greedy when
+    temperature == 0)."""
+    import numpy as np
+
+    prompt = jnp.asarray(np.asarray(prompt), jnp.int32)
+    total = prompt.shape[1] + int(max_new_tokens)
+    if total > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt ({prompt.shape[1]}) + max_new_tokens "
+            f"({max_new_tokens}) = {total} exceeds cfg.max_seq_len "
+            f"{cfg.max_seq_len}: positions past the table would silently "
+            "reuse the last positional embedding")
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    fn = _get_generate_fn(cfg, int(max_new_tokens), int(top_k))
+    return fn(params, prompt, key, jnp.asarray(float(temperature)))
