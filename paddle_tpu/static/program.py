@@ -931,6 +931,16 @@ class Executor:
                tuple(v.vid for v in fetch_refs))
         fn = self._cache.get(sig)
         if fn is None:
+            # fail fast with NAMES when a required feed is absent (a raw
+            # KeyError on an internal vid mid-trace names nothing useful).
+            # Cache-miss-only: a run missing a feed necessarily has a
+            # different feed signature, so warm steps skip the O(ops) scan.
+            needed = self._required_feeds(prog, fetch_refs, train)
+            missing = needed - set(feed_names)
+            if missing:
+                raise ValueError(
+                    f"missing feed(s) {sorted(missing)} required by the "
+                    f"fetched outputs; provided: {feed_names or 'none'}")
             fn = self._build(prog, feed_names, fetch_refs, train)
             self._cache[sig] = fn
 
@@ -995,6 +1005,27 @@ class Executor:
         return self.train_from_dataset(program, dataset, scope, thread,
                                        debug, fetch_list, fetch_info,
                                        print_period)
+
+    @staticmethod
+    def _required_feeds(prog: Program, fetch_refs, train) -> set:
+        """Feed names the run actually needs: inputs read by the op slice
+        that produces the fetches (full program when the run takes the
+        grads path — the SAME condition _build uses)."""
+        grad_vids = {g.vid for g in prog.grad_vars.values()}
+        need_grads = train or any(v.vid in grad_vids for v in fetch_refs)
+        if need_grads:
+            ops = prog.ops
+        else:
+            ops = slice_ops(prog, {v.vid for v in fetch_refs}
+                            | {r.vid for _, r in prog.writebacks})
+        read: set = set()
+        produced: set = set()
+        for op in ops:
+            read |= _op_in_vids(op) - produced
+            produced |= _op_out_vids(op)
+        fetch_vids = {v.vid for v in fetch_refs}
+        return {name for name, vid in prog.inputs
+                if vid in read or vid in fetch_vids}
 
     # -- compile ------------------------------------------------------------
     def _build(self, prog: Program, feed_names, fetch_refs, train):

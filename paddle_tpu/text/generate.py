@@ -167,5 +167,6 @@ def generate(params, cfg: gpt.GPTConfig, prompt, max_new_tokens=32,
             "reuse the last positional embedding")
     if key is None:
         key = jax.random.PRNGKey(0)
-    fn = _get_generate_fn(cfg, int(max_new_tokens), int(top_k))
+    top_k = min(int(top_k), cfg.vocab_size)  # top-k over the whole vocab
+    fn = _get_generate_fn(cfg, int(max_new_tokens), top_k)
     return fn(params, prompt, key, jnp.asarray(float(temperature)))

@@ -462,3 +462,39 @@ def test_static_nn_sequence_and_multibox():
     assert lo.shape[0] == 2 and lo.shape[2] == 4
     assert co.shape[:2] == lo.shape[:2] and co.shape[2] == 3
     assert boxes.shape[0] == lo.shape[1]  # priors align with heads
+
+
+def test_missing_feed_raises_with_name():
+    main, startup = _fresh_programs()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 2], "float32")
+        lab = static.data("lab", [None], "int64")
+        loss = paddle.nn.functional.cross_entropy(static.nn.fc(x, 3), lab)
+    exe = static.Executor()
+    exe.run(startup)
+    with pytest.raises(ValueError, match="lab"):
+        exe.run(main, feed={"x": np.ones((2, 2), np.float32)},
+                fetch_list=[loss])
+    # forward-only fetch of an x-only output must NOT require lab
+    with static.program_guard(main, startup):
+        y2 = static.nn.fc(x, 2)
+    out, = exe.run(main, feed={"x": np.ones((2, 2), np.float32)},
+                   fetch_list=[y2])
+    assert out.shape == (2, 2)
+
+
+def test_forward_fetch_after_append_backward_needs_no_label():
+    """append_backward must not force label feeds onto forward-only
+    fetches (regression: validator/_build condition mismatch)."""
+    main, startup = _fresh_programs()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 2], "float32")
+        lab = static.data("lab", [None], "int64")
+        y = static.nn.fc(x, 3)
+        loss = paddle.nn.functional.cross_entropy(y, lab)
+        static.append_backward(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    out, = exe.run(main, feed={"x": np.ones((2, 2), np.float32)},
+                   fetch_list=[y])
+    assert out.shape == (2, 3)
