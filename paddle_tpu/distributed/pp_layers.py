@@ -22,11 +22,23 @@ state becomes data:
   transpose psums their gradients over 'pp' automatically — the reference's
   ``allreduce_shared_weight_gradients`` (pp_layers.py:188) for free.
 
-The schedule is the F-then-B scan (micro-batch m enters at tick m, leaves at
-tick m + S - 1); backward comes from differentiating the scan.  The flagship
-GPT path (text/gpt_hybrid.py) keeps its hand-built memory-bounded 1F1B —
-this module trades peak-memory optimality for *generality over arbitrary
-Layer lists* (ResNet, BERT, mixed conv/fc models).
+Two schedules, matching the reference SectionWorker's ``schedule_mode``
+(section_worker.cc:130-183), selectable via ``build_train_step(schedule=)``:
+
+* ``"1f1b"`` (default): one scan whose every tick runs ONE forward
+  micro-batch step and ONE backward micro-batch step per stage — micro-batch
+  m runs forward on stage s at tick ``m + s`` and backward at tick
+  ``m + 2(S-1) - s``.  The backward slot re-runs the stage forward under
+  ``jax.vjp`` from a ring buffer of the last ``min(M, 2S-1)`` stage *inputs*
+  (plus the pre-update buffer vector, so BN recompute sees the same state),
+  so activation memory is flat in the micro-batch count M.
+* ``"fthenb"``: autodiff over the F-then-B scan (micro-batch m enters at
+  tick m, leaves at tick m + S - 1) — simpler, but the scan stores residuals
+  for every tick, so activation memory grows with M.
+
+The flagship GPT path (text/gpt_hybrid.py) keeps its hand-built
+Megatron-aware 1F1B; this module generalizes the same schedule to
+*arbitrary Layer lists* (ResNet, BERT, mixed conv/fc models).
 """
 from __future__ import annotations
 
@@ -256,15 +268,26 @@ class PipelineLayer(Layer):
     # -- pipeline-parallel compiled step -------------------------------------
     def build_train_step(self, mesh: Mesh, optimizer, loss_fn,
                          n_micro: int, example_input, dp_axis: str = "dp",
-                         pp_axis: str = "pp", remat: bool = True):
+                         pp_axis: str = "pp", remat: bool = True,
+                         schedule: str = "1f1b"):
         """Compile the pp(+dp)-parallel train step over ``mesh``.
 
         ``example_input``: one (global-batch) input array/pytree used to
         trace boundary shapes — its per-micro-batch slice must be valid.
+        ``schedule``: "1f1b" (interleaved, activation memory bounded by the
+        in-flight window — reference section_worker.cc schedule_mode 1) or
+        "fthenb" (autodiff over the forward scan, residuals for every tick
+        — schedule_mode 0).  With one stage both collapse to the same loop.
+        ``remat``: rematerialize stage forwards in the backward pass — under
+        "fthenb" this is what keeps the scan's residuals to one boundary
+        buffer per tick; under "1f1b" it bounds the *within-tick* VJP
+        residuals to the branch inputs (the cross-tick window is already
+        flat in M by construction).
         Returns a :class:`PipelineTrainStep`: call ``(X, Y) -> loss``.
         """
         return PipelineTrainStep(self, mesh, optimizer, loss_fn, n_micro,
-                                 example_input, dp_axis, pp_axis, remat)
+                                 example_input, dp_axis, pp_axis, remat,
+                                 schedule)
 
 
 class PipelineTrainStep:
@@ -273,11 +296,13 @@ class PipelineTrainStep:
 
     def __init__(self, pl: PipelineLayer, mesh: Mesh, optimizer, loss_fn,
                  n_micro: int, example_input, dp_axis: str, pp_axis: str,
-                 remat: bool):
+                 remat: bool, schedule: str = "1f1b"):
         S = mesh.shape[pp_axis]
         if S != pl.num_stages:
             raise ValueError(f"mesh '{pp_axis}' size {S} != num_stages "
                              f"{pl.num_stages}")
+        if schedule not in ("1f1b", "fthenb"):
+            raise ValueError(f"unknown pipeline schedule {schedule!r}")
         dp = mesh.shape.get(dp_axis, 1)
         self.pl = pl
         self.mesh = mesh
@@ -349,8 +374,12 @@ class PipelineTrainStep:
         A = max([m.size for m in x_meta if m is not None] + [out_meta.size],
                 default=1) or 1
 
-        # ---- per-stage switch branches (uniform signature)
-        def make_branch(s):
+        # ---- per-stage switch branches (uniform signature; flags pick the
+        # outputs so all three uses share one stage-application body):
+        # fthenb ticks need (y, new_bv, loss); 1F1B forward slots own the
+        # buffer updates (y, new_bv); 1F1B backward slots VJP the
+        # stage+masked-head unit (y, loss)
+        def make_branch(s, *, emit_bv: bool, emit_loss: bool):
             pm, bm = self._pmetas[s], self._bmetas[s]
 
             def branch(pv, bv, sp, x_flat, x0, y_lbl, key):
@@ -359,22 +388,36 @@ class PipelineTrainStep:
                 x = x0 if s == 0 else _unpack(x_flat, x_meta[s])
                 with _random.rng_scope(key):
                     y, new_b = run_stage_concrete(s, ptree, btree, sp, x)
+                loss = jnp.zeros((), jnp.float32)
                 if s == S - 1:
-                    loss = loss_fn(_wrap_tree(y),
-                                   Tensor(y_lbl, stop_gradient=True))
-                    loss = (loss.value if isinstance(loss, Tensor)
-                            else loss).astype(jnp.float32)
+                    # nothing consumes the last stage's forward output
+                    # (fthenb: the head is here; 1f1b: the same-tick
+                    # backward recomputes it inside its VJP)
                     y_send = jnp.zeros((A,), jnp.float32)
+                    if emit_loss:
+                        loss = loss_fn(_wrap_tree(y),
+                                       Tensor(y_lbl, stop_gradient=True))
+                        loss = (loss.value if isinstance(loss, Tensor)
+                                else loss).astype(jnp.float32)
                 else:
-                    loss = jnp.zeros((), jnp.float32)
                     y_send = _pack(y, x_meta[s + 1], A)
-                new_bv = lax.stop_gradient(_pack(new_b, bm, Lb))
-                return y_send, new_bv, loss
+                out = (y_send,)
+                if emit_bv:
+                    out += (lax.stop_gradient(_pack(new_b, bm, Lb)),)
+                if emit_loss:
+                    out += (loss,)
+                return out
 
             return branch
 
-        branches = [make_branch(s) for s in range(S)]
+        branches = [make_branch(s, emit_bv=True, emit_loss=True)
+                    for s in range(S)]
+        fwd_branches = [make_branch(s, emit_bv=True, emit_loss=False)
+                        for s in range(S)]
+        full_branches = [make_branch(s, emit_bv=False, emit_loss=True)
+                         for s in range(S)]
         perm = [(i, (i + 1) % S) for i in range(S)]
+        perm_bwd = [(i, (i - 1) % S) for i in range(S)]
         dp_ax = dp_axis if dp > 1 else None
 
         def pp_loss(pv_loc, bv_loc, sp, X, Y, key):
@@ -428,22 +471,151 @@ class PipelineTrainStep:
                     loss = lax.pmean(loss, ax)
             return loss, bv_new[None]
 
+        other_axes = tuple(ax for ax in mesh.axis_names
+                           if ax not in (dp_axis, pp_axis)
+                           and mesh.shape[ax] > 1)
+
+        def pp_1f1b(pv_loc, bv_loc, sp, X, Y, key):
+            """Per-rank interleaved schedule: returns (loss, local stage
+            grads, shared grads, new buffers) — no outer autodiff needed.
+            Micro-batch m: forward on stage s at tick m + s, backward at
+            tick m + 2(S-1) - s (the wave reflects off the last stage,
+            whose loss-head VJP runs in the same tick as its forward)."""
+            s_idx = lax.axis_index(pp_axis)
+            pv = pv_loc[0]
+            bv = bv_loc[0]
+            M = n_micro
+            Xmb = jax.tree_util.tree_map(
+                lambda a: a.reshape((M, a.shape[0] // M) + a.shape[1:]), X)
+            Ymb = jax.tree_util.tree_map(
+                lambda a: a.reshape((M, a.shape[0] // M) + a.shape[1:]), Y)
+            BUF = min(M, 2 * S - 1)
+            ticks = M + 2 * (S - 1)
+            g_sp0 = jax.tree_util.tree_map(jnp.zeros_like, sp)
+
+            def tick(carry, t):
+                x_fwd, dx_bwd, bv_c, buf_x, buf_bv, g_pv, g_sp, loss_acc = \
+                    carry
+
+                # ---- forward slot: micro-batch t - s
+                f_m = t - s_idx
+                f_valid = (f_m >= 0) & (f_m < M)
+                f_idx = jnp.clip(f_m, 0, M - 1)
+                x0_f = jax.tree_util.tree_map(
+                    lambda a: lax.dynamic_index_in_dim(a, f_idx,
+                                                       keepdims=False), Xmb)
+                ylbl_f = jax.tree_util.tree_map(
+                    lambda a: lax.dynamic_index_in_dim(a, f_idx,
+                                                       keepdims=False), Ymb)
+                k_f = jax.random.fold_in(jax.random.fold_in(key, f_idx),
+                                         s_idx)
+                y_f, bv_n = lax.switch(s_idx, fwd_branches, pv, bv_c, sp,
+                                       x_fwd, x0_f, ylbl_f, k_f)
+                # ring buffer of stage INPUTS (+ the pre-update buffer
+                # vector, so the backward recompute sees the same BN state);
+                # guard so drain ticks can't clobber an unconsumed slot
+                buf_x = jnp.where(
+                    f_valid,
+                    lax.dynamic_update_index_in_dim(buf_x, x_fwd,
+                                                    f_idx % BUF, 0), buf_x)
+                buf_bv = jnp.where(
+                    f_valid,
+                    lax.dynamic_update_index_in_dim(buf_bv, bv_c,
+                                                    f_idx % BUF, 0), buf_bv)
+                bv_next = jnp.where(f_valid, bv_n, bv_c)
+                x_fwd_next = lax.ppermute(y_f, pp_axis, perm)
+
+                # ---- backward slot: micro-batch t - 2(S-1) + s
+                b_m = t - 2 * (S - 1) + s_idx
+                b_valid = (b_m >= 0) & (b_m < M)
+                b_idx = jnp.clip(b_m, 0, M - 1)
+                x_saved = lax.dynamic_index_in_dim(buf_x, b_idx % BUF,
+                                                   keepdims=False)
+                bv_saved = lax.dynamic_index_in_dim(buf_bv, b_idx % BUF,
+                                                    keepdims=False)
+                x0_b = jax.tree_util.tree_map(
+                    lambda a: lax.dynamic_index_in_dim(a, b_idx,
+                                                       keepdims=False), Xmb)
+                y_lbl = jax.tree_util.tree_map(
+                    lambda a: lax.dynamic_index_in_dim(a, b_idx,
+                                                       keepdims=False), Ymb)
+                k_b = jax.random.fold_in(jax.random.fold_in(key, b_idx),
+                                         s_idx)
+
+                def run(pv_, sp_, xf_):
+                    return lax.switch(s_idx, full_branches, pv_, bv_saved,
+                                      sp_, xf_, x0_b, y_lbl, k_b)
+
+                if remat:
+                    # bound the within-tick residuals to the branch inputs;
+                    # prevent_cse=False — the scan provides CSE protection
+                    # and the default's optimization barriers hang the axon
+                    # TPU compile (see text/gpt.py)
+                    run = jax.checkpoint(run, prevent_cse=False)
+                (_, loss_mb), vjp_fn = jax.vjp(run, pv, sp, x_saved)
+                valid = b_valid.astype(jnp.float32)
+                # last stage's cotangent comes from its own head; others
+                # receive dL/dy from stage s+1's backward slot
+                dy = jnp.where(s_idx == S - 1, jnp.zeros_like(dx_bwd),
+                               dx_bwd) * valid
+                g_pv_t, g_sp_t, dx = vjp_fn((dy, valid / M))
+                g_pv = g_pv + g_pv_t
+                g_sp = jax.tree_util.tree_map(jnp.add, g_sp, g_sp_t)
+                loss_acc = loss_acc + valid * loss_mb
+                dx_next = lax.ppermute(dx, pp_axis, perm_bwd)
+                return (x_fwd_next, dx_next, bv_next, buf_x, buf_bv, g_pv,
+                        g_sp, loss_acc), None
+
+            init = (jnp.zeros((A,), jnp.float32),
+                    jnp.zeros((A,), jnp.float32), bv,
+                    jnp.zeros((BUF, A), jnp.float32),
+                    jnp.zeros((BUF, Lb), jnp.float32),
+                    jnp.zeros_like(pv), g_sp0, jnp.zeros((), jnp.float32))
+            (_, _, bv_new, _, _, g_pv, g_sp, loss_sum), _ = lax.scan(
+                tick, init, jnp.arange(ticks))
+
+            loss = lax.psum(loss_sum, pp_axis) / M
+            # shared weights live replicated across pp — their true grad is
+            # the SUM of the per-stage pieces (the reference's
+            # allreduce_shared_weight_gradients, pp_layers.py:188)
+            g_sp = lax.psum(g_sp, pp_axis)
+            mean_axes = (dp_axis,) * (dp > 1) + other_axes
+            if mean_axes:
+                loss = lax.pmean(loss, mean_axes)
+                g_pv = lax.pmean(g_pv, mean_axes)
+                g_sp = lax.pmean(g_sp, mean_axes)
+            return loss, g_pv[None], g_sp, bv_new[None]
+
         data_spec = P(dp_axis) if dp > 1 else P()
-        sharded = shard_map(
-            pp_loss, mesh=mesh,
-            in_specs=(P(pp_axis, None), P(pp_axis, None), P(), data_spec,
-                      data_spec, P()),
-            out_specs=(P(), P(pp_axis, None)), check_vma=False)
+        in_specs = (P(pp_axis, None), P(pp_axis, None), P(), data_spec,
+                    data_spec, P())
+        if schedule == "1f1b" and S > 1:
+            sharded_1f1b = shard_map(
+                pp_1f1b, mesh=mesh, in_specs=in_specs,
+                out_specs=(P(), P(pp_axis, None), P(), P(pp_axis, None)),
+                check_vma=False)
 
-        def step_fn(ptree, opt_state, bv, X, Y, key, lr, step):
-            def loss_of(pt):
-                return sharded(pt["stages"], bv, pt["shared"], X, Y, key)
+            def step_fn(ptree, opt_state, bv, X, Y, key, lr, step):
+                loss, g_stages, g_shared, bv_new = sharded_1f1b(
+                    ptree["stages"], bv, ptree["shared"], X, Y, key)
+                grads = {"stages": g_stages, "shared": g_shared}
+                new_p, new_o = optimizer.apply_gradients(
+                    grads, ptree, opt_state, lr=lr, step=step + 1)
+                return new_p, new_o, bv_new, loss
+        else:
+            sharded = shard_map(
+                pp_loss, mesh=mesh, in_specs=in_specs,
+                out_specs=(P(), P(pp_axis, None)), check_vma=False)
 
-            (loss, bv_new), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(ptree)
-            new_p, new_o = optimizer.apply_gradients(
-                grads, ptree, opt_state, lr=lr, step=step + 1)
-            return new_p, new_o, bv_new, loss
+            def step_fn(ptree, opt_state, bv, X, Y, key, lr, step):
+                def loss_of(pt):
+                    return sharded(pt["stages"], bv, pt["shared"], X, Y, key)
+
+                (loss, bv_new), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(ptree)
+                new_p, new_o = optimizer.apply_gradients(
+                    grads, ptree, opt_state, lr=lr, step=step + 1)
+                return new_p, new_o, bv_new, loss
 
         self._params = {"stages": pvec, "shared": shared_p}
         pv_shard = NamedSharding(mesh, P(pp_axis, None))
