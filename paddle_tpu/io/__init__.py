@@ -5,15 +5,19 @@ with shared-memory queues — fluid/dataloader/dataloader_iter.py:97/:248,
 memory/allocation/mmap_allocator.cc) + buffered_reader double-buffer prefetch
 to device (operators/reader/buffered_reader.cc).
 
-TPU-first: workers are threads (numpy batch assembly releases the GIL) or
-processes (num_workers>0 w/ fork start), and the prefetcher overlaps host
-batch assembly with device steps by keeping a small queue of device-resident
-batches — the buffered_reader role.
+TPU-first: workers default to threads (numpy batch assembly releases the
+GIL), with ``worker_mode="process"`` spawning real worker processes for
+GIL-bound Python ``__getitem__`` transforms — children run ONLY the dataset
+indexing (numpy-pure, never touching the TPU backend) and ship samples back
+over pipes to the parent's ordered merge, where collation runs.  The
+prefetcher overlaps host batch assembly with device steps by keeping a
+small queue of device-resident batches — the buffered_reader role.
 """
 from __future__ import annotations
 
 import itertools
 import math
+import os
 import queue
 import threading
 from typing import Iterable
@@ -317,12 +321,170 @@ class _PipelineState:
             self.cond.notify_all()
 
 
-def _run_pipeline(st: _PipelineState, loader, nw: int):
-    """Start feeder / collate-worker threads over ``st``; returns the
-    in-order batch generator (host side).  Deliberately a free function:
-    closures capture ``st`` and ``loader`` only, keeping the iterator
-    object collectable (see _PipelineState)."""
+class _PipelineStop(Exception):
+    """Raised inside a worker's work_fn when the pipeline shuts down."""
+
+
+class _ChildProc:
+    """One spawned DataLoader worker process + its request/response pipes.
+
+    Plain Popen on the standalone worker script (io/_worker.py), run BY
+    PATH: no multiprocessing-spawn ``__main__`` re-import (which re-runs
+    unguarded user scripts) and no paddle_tpu package import in the child.
+    Request/response is lockstep; an aborted wait leaves the response frame
+    in flight, so the next request drains it first (``_pending``)."""
+
+    def __init__(self, dataset, init_fn, worker_id: int, num_workers: int,
+                 seed: int):
+        import subprocess
+        import sys
+
+        from . import _worker
+
+        self._worker = _worker
+        self.worker_id = worker_id
+        r_cmd, w_cmd = os.pipe()
+        r_res, w_res = os.pipe()
+        env = dict(os.environ,
+                   JAX_PLATFORMS="cpu",  # a jax-importing dataset must
+                   PADDLE_TPU_WORKER_ID=str(worker_id),  # never claim TPU
+                   PADDLE_TPU_NUM_WORKERS=str(num_workers))
+        self.proc = subprocess.Popen(
+            [sys.executable, _worker.__file__, str(r_cmd), str(w_res)],
+            pass_fds=(r_cmd, w_res), env=env, close_fds=True)
+        os.close(r_cmd)
+        os.close(w_res)
+        self._cmd_f = os.fdopen(w_cmd, "wb")
+        self._res_f = os.fdopen(r_res, "rb")
+        self._pending = False
+        self._worker.write_frame(self._cmd_f, (list(sys.path),))
+        self._worker.write_frame(
+            self._cmd_f, (dataset, init_fn, worker_id, num_workers, seed))
+
+    def _read_one(self, stop: threading.Event):
+        """Next response frame; raises _PipelineStop on shutdown and
+        RuntimeError if the child died or closed its pipe."""
+        import select
+
+        while not stop.is_set():
+            ready, _, _ = select.select([self._res_f], [], [], 0.2)
+            if ready:
+                frame = self._worker.read_frame(self._res_f)
+                if frame is None:  # EOF
+                    raise RuntimeError(
+                        f"DataLoader worker process {self.worker_id} closed "
+                        f"its pipe (exitcode {self.proc.poll()})")
+                return frame
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"DataLoader worker process {self.worker_id} died "
+                    f"unexpectedly (exitcode {self.proc.returncode})")
+        raise _PipelineStop  # a sent request stays pending → drained later
+
+    def request(self, i, idxs, stop: threading.Event):
+        """Returns the child's sample list for batch ``i``."""
+        while self._pending:  # drain a previously aborted wait's response
+            self._read_one(stop)
+            self._pending = False
+        self._worker.write_frame(self._cmd_f, (i, list(idxs)))
+        self._pending = True
+        _, samples, err = self._read_one(stop)
+        self._pending = False
+        if err is not None:
+            raise RuntimeError(
+                f"DataLoader worker process {self.worker_id} failed:\n{err}")
+        return samples
+
+    def shutdown(self):
+        import subprocess
+
+        try:
+            self._worker.write_frame(self._cmd_f, None)
+        except (OSError, ValueError):
+            pass
+        try:
+            self.proc.wait(timeout=2.0)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait()  # reap — no zombie for the parent's lifetime
+        try:
+            self._cmd_f.close()
+            self._res_f.close()
+        except OSError:
+            pass
+
+
+def _shutdown_pool(children):
+    for c in children:
+        c.shutdown()
+
+
+def _worker_seed() -> int:
+    """Child RNG seed that never consumes from the parent's global numpy
+    stream (a np.random draw here would silently shift seeded shuffle
+    orders vs num_workers=0)."""
+    return int.from_bytes(os.urandom(4), "little")
+
+
+class _ProcessPool:
+    """Persistent worker-process pool (torch's persistent_workers): spawned
+    once per DataLoader, reused by every epoch's pipeline so the per-epoch
+    cost is zero after warm-up.  Children hold the dataset pickled at spawn
+    time — mutations to it between epochs are not visible to them.  The
+    pipes are lockstep per child, so only ONE pipeline may borrow the pool
+    at a time (``busy``); a second concurrent iterator over the same
+    DataLoader falls back to ephemeral children."""
+
+    def __init__(self, loader, nw: int):
+        import weakref
+
+        self.busy = False
+        self.children = [
+            _ChildProc(loader.dataset, loader.worker_init_fn, k, nw,
+                       _worker_seed()) for k in range(nw)]
+        self._finalizer = weakref.finalize(self, _shutdown_pool,
+                                           self.children)
+
+    def close(self):
+        self._finalizer()
+
+
+def _run_pipeline(st: _PipelineState, loader, nw: int, pool=None):
+    """Start feeder / worker threads over ``st``; returns the in-order
+    batch generator (host side).  Deliberately a free function: closures
+    capture ``st`` and ``loader`` only, keeping the iterator object
+    collectable (see _PipelineState).
+
+    Each worker thread owns a ``work(i, idxs) -> batch`` obtained from
+    ``make_work`` — local indexing+collation in thread mode, or an RPC to
+    a child process (``pool``'s if borrowed, else one spawned for this
+    pipeline) which runs ``__getitem__``; collate still runs here, off the
+    child's pickle-cheap sample list."""
     ahead_bound = 2 * nw + 2  # collated-but-unconsumed host batches
+    process_mode = getattr(loader, "worker_mode", "thread") == "process"
+
+    def make_thread_work(k):
+        def work(i, idxs):
+            samples = [loader.dataset[j] for j in idxs]
+            return loader.collate_fn(samples)
+
+        return work, (lambda: None)
+
+    def make_process_work(k):
+        if pool is not None:
+            child = pool.children[k]
+            cleanup = lambda: None  # the pool owns the child's lifetime
+        else:
+            child = _ChildProc(loader.dataset, loader.worker_init_fn, k, nw,
+                               _worker_seed())
+            cleanup = child.shutdown
+
+        def work(i, idxs):
+            return loader.collate_fn(child.request(i, idxs, st.stop))
+
+        return work, cleanup
+
+    make_work = make_process_work if process_mode else make_thread_work
 
     def feeder():
         count = 0
@@ -340,33 +502,42 @@ def _run_pipeline(st: _PipelineState, loader, nw: int):
             if not st.put_stopable(st.idx_q, None):
                 return
 
-    def worker():
-        while not st.stop.is_set():
-            try:
-                item = st.idx_q.get(timeout=0.2)
-            except queue.Empty:
-                continue
-            if item is None:
-                return
-            i, idxs = item
-            try:
-                samples = [loader.dataset[j] for j in idxs]
-                batch = loader.collate_fn(samples)
-            except BaseException as e:
-                st.fail(e)
-                return
-            with st.cond:
-                # backpressure: collation may run at most ahead_bound
-                # batches past the consumer — EXCEPT the batch the merge
-                # stage needs next, which must always land (no deadlock)
-                while (st.err is None and not st.stop.is_set()
-                       and i > st.next_needed
-                       and len(st.results) >= ahead_bound):
-                    st.cond.wait(timeout=0.2)
-                if st.stop.is_set():
+    def worker(k):
+        try:
+            work, cleanup = make_work(k)
+        except BaseException as e:  # e.g. unpicklable dataset at spawn
+            st.fail(e)
+            return
+        try:
+            while not st.stop.is_set():
+                try:
+                    item = st.idx_q.get(timeout=0.2)
+                except queue.Empty:
+                    continue
+                if item is None:
                     return
-                st.results[i] = batch
-                st.cond.notify_all()
+                i, idxs = item
+                try:
+                    batch = work(i, idxs)
+                except _PipelineStop:
+                    return
+                except BaseException as e:
+                    st.fail(e)
+                    return
+                with st.cond:
+                    # backpressure: collation may run at most ahead_bound
+                    # batches past the consumer — EXCEPT the batch the merge
+                    # stage needs next, which must always land (no deadlock)
+                    while (st.err is None and not st.stop.is_set()
+                           and i > st.next_needed
+                           and len(st.results) >= ahead_bound):
+                        st.cond.wait(timeout=0.2)
+                    if st.stop.is_set():
+                        return
+                    st.results[i] = batch
+                    st.cond.notify_all()
+        finally:
+            cleanup()
 
     def ordered():
         while True:
@@ -389,16 +560,23 @@ def _run_pipeline(st: _PipelineState, loader, nw: int):
             yield batch
 
     threads = [threading.Thread(target=feeder, daemon=True)]
-    threads += [threading.Thread(target=worker, daemon=True)
-                for _ in range(nw)]
+    threads += [threading.Thread(target=worker, args=(k,), daemon=True)
+                for k in range(nw)]
+    st.worker_threads = threads[1:]
     for t in threads:
         t.start()
     return ordered()
 
 
-def _shutdown_pipeline(st: _PipelineState, pf):
+def _shutdown_pipeline(st: _PipelineState, pf, pool=None):
     st.shutdown()
     pf.close()
+    if pool is not None:
+        # pool pipes are lockstep: only hand the children back once every
+        # borrower thread has let go of them
+        for t in getattr(st, "worker_threads", ()):
+            t.join(timeout=5.0)
+        pool.busy = False
 
 
 class _PrefetchIter:
@@ -421,12 +599,18 @@ class _PrefetchIter:
         st = _PipelineState(nw)
         self._st = st
         self._finished = False
-        ordered_gen = _run_pipeline(st, loader, nw)
+        pool = getattr(loader, "_pool", None)
+        if pool is not None:
+            if pool.busy:
+                pool = None  # concurrent iterator: ephemeral children
+            else:
+                pool.busy = True
+        ordered_gen = _run_pipeline(st, loader, nw, pool)
         self._pf = DevicePrefetcher(ordered_gen, depth=loader.prefetch_factor,
                                     transform=_to_device)
         self._it = iter(self._pf)
         self._finalizer = weakref.finalize(self, _shutdown_pipeline, st,
-                                           self._pf)
+                                           self._pf, pool)
 
     def __iter__(self):
         return self
@@ -438,11 +622,11 @@ class _PrefetchIter:
             return next(self._it)
         except StopIteration:
             self._finished = True
-            self._st.shutdown()
+            self._finalizer()  # release workers/pool promptly, not at GC
             raise
         except BaseException:
             self._finished = True
-            self._st.shutdown()
+            self._finalizer()
             raise
 
     def close(self):
@@ -455,10 +639,30 @@ class DataLoader:
                  batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
                  collate_fn=None, num_workers=0, use_buffer_reader=True,
                  prefetch_factor=2, use_shared_memory=True, timeout=0,
-                 worker_init_fn=None):
+                 worker_init_fn=None, worker_mode="thread",
+                 persistent_workers=False):
+        """``worker_mode``: "thread" (default — numpy assembly releases the
+        GIL, zero start-up cost) or "process" (reference
+        dataloader_iter.py:248 semantics — ``num_workers`` spawned child
+        processes run ``__getitem__``, unblocking GIL-bound Python
+        transforms; the dataset must be picklable and children never touch
+        the TPU backend).  ``persistent_workers=True`` keeps the process
+        pool alive across epochs (spawn cost paid once; children hold the
+        dataset as pickled at first iteration)."""
+        if worker_mode not in ("thread", "process"):
+            raise ValueError(f"worker_mode must be 'thread' or 'process', "
+                             f"got {worker_mode!r}")
+        if persistent_workers and worker_mode != "process":
+            raise ValueError(
+                "persistent_workers applies to worker_mode='process' only "
+                "(thread workers have no start-up cost to amortize)")
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
+        self.worker_mode = worker_mode
+        self.worker_init_fn = worker_init_fn
+        self.persistent_workers = persistent_workers
+        self._pool = None
         self.prefetch_factor = prefetch_factor
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if isinstance(dataset, FileDataset):
@@ -491,8 +695,18 @@ class DataLoader:
         if self._iterable_mode:
             return self._iter_iterable()
         if self.num_workers > 0:
+            if (self.persistent_workers and self.worker_mode == "process"
+                    and self._pool is None):
+                self._pool = _ProcessPool(self, max(1, self.num_workers))
             return _PrefetchIter(self)
         return self._iter_single()
+
+    def close(self):
+        """Shut down the persistent worker pool (if any); iterating again
+        respawns it."""
+        if self._pool is not None:
+            self._pool.close()
+            self._pool = None
 
     def _iter_native(self):
         """C++ feeder → Tensor wrap → device prefetch queue.  The feeder

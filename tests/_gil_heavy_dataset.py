@@ -1,0 +1,54 @@
+"""Picklable, numpy-only dataset with a deliberately GIL-bound __getitem__.
+
+Spawned DataLoader worker children unpickle this class, importing ONLY this
+module + numpy — never jax/paddle_tpu — which keeps worker start-up cheap
+and proves process workers cannot touch the TPU backend.
+"""
+import numpy as np
+
+
+class GilHeavyDataset:
+    """__getitem__ burns ``work`` pure-Python bytecodes holding the GIL —
+    the workload threads cannot parallelize but processes can."""
+
+    def __init__(self, n=96, work=600_000):
+        self.n = n
+        self.work = work
+
+    def __getitem__(self, idx):
+        acc = 0
+        for i in range(self.work):
+            acc += (i ^ idx) & 7
+        return np.array([idx, acc % 97], dtype=np.int64)
+
+    def __len__(self):
+        return self.n
+
+
+class SleepDataset:
+    """I/O-bound stand-in: sleeps overlap across workers on any core count."""
+
+    def __init__(self, n=32, delay=0.2):
+        self.n = n
+        self.delay = delay
+
+    def __getitem__(self, idx):
+        import time
+
+        time.sleep(self.delay)
+        return np.array([idx], dtype=np.int64)
+
+    def __len__(self):
+        return self.n
+
+
+class FailingDataset:
+    """Raises inside the worker at index 5 (exception-propagation test)."""
+
+    def __getitem__(self, idx):
+        if idx == 5:
+            raise ValueError("boom at 5")
+        return np.array([idx], dtype=np.int64)
+
+    def __len__(self):
+        return 8

@@ -1,0 +1,145 @@
+"""Multiprocess DataLoader workers (reference dataloader_iter.py:248 —
+subprocess worker pool). Process mode must (a) return exactly the same
+ordered batches as the serial path, (b) beat thread mode wall-clock on a
+GIL-bound __getitem__, (c) propagate worker exceptions, and (d) expose
+get_worker_info inside the child.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _gil_heavy_dataset import (FailingDataset, GilHeavyDataset,  # noqa: E402
+                                SleepDataset)
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.io import DataLoader  # noqa: E402
+
+
+def _collect(loader):
+    return [np.asarray(b.value if hasattr(b, "value") else b)
+            for b in loader]
+
+
+class TestProcessWorkers:
+    def test_matches_serial_ordering(self):
+        ds = GilHeavyDataset(n=24, work=100)
+        ref = _collect(DataLoader(ds, batch_size=4, num_workers=0))
+        out = _collect(DataLoader(ds, batch_size=4, num_workers=2,
+                                  worker_mode="process"))
+        assert len(ref) == len(out)
+        for a, b in zip(ref, out):
+            np.testing.assert_array_equal(a, b)
+
+    def test_gil_bound_getitem_scales_with_processes(self):
+        # wall-clock scaling needs real cores: child interpreters each burn
+        # a GIL-bound loop that threads must serialize. On a single-core
+        # box (this CI container has cpu.max=1) no process pool can beat
+        # threads physically — skip rather than assert the impossible.
+        cores = len(os.sched_getaffinity(0))
+        if cores < 2:
+            pytest.skip(f"needs >=2 cores for parallel speedup, have {cores}")
+        nw = min(4, cores)
+        ds = GilHeavyDataset(n=24 * nw, work=600_000)
+
+        def run(mode):
+            t0 = time.perf_counter()
+            n = len(_collect(DataLoader(ds, batch_size=2, num_workers=nw,
+                                        worker_mode=mode)))
+            return time.perf_counter() - t0, n
+
+        t_thread, n_thread = run("thread")
+        t_proc, n_proc = run("process")
+        assert n_thread == n_proc == 12 * nw
+        # generous bound absorbs worker start-up + CI noise
+        assert t_proc < 0.8 * t_thread, (t_proc, t_thread)
+
+    def test_children_serve_concurrently_and_pool_persists(self):
+        # core-count-independent concurrency proof: sleeps overlap across
+        # the 4 children iff the parent drives them in parallel. Epoch 1
+        # pays the one-time spawn (persistent_workers); epoch 2 is pure
+        # serving — 32 * 0.2 = 6.4s of sleep must compress ~4x.
+        loader = DataLoader(SleepDataset(n=32, delay=0.2), batch_size=2,
+                            num_workers=4, worker_mode="process",
+                            persistent_workers=True)
+        try:
+            assert len(_collect(loader)) == 16  # warm-up: spawns the pool
+            pool = loader._pool
+            assert pool is not None
+            t0 = time.perf_counter()
+            n = len(_collect(loader))
+            elapsed = time.perf_counter() - t0
+            assert n == 16
+            assert elapsed < 0.55 * 6.4, elapsed
+            assert loader._pool is pool  # same children served epoch 2
+        finally:
+            loader.close()
+
+    def test_concurrent_iterators_over_persistent_pool(self):
+        # the pool's pipes are lockstep — a second live iterator must get
+        # its own ephemeral children, not corrupt the borrowed ones
+        ds = GilHeavyDataset(n=16, work=100)
+        loader = DataLoader(ds, batch_size=4, num_workers=2,
+                            worker_mode="process", persistent_workers=True)
+        try:
+            ref = _collect(DataLoader(ds, batch_size=4, num_workers=0))
+            for a, b in zip(loader, loader):
+                pass  # two live iterators at once
+            out = _collect(loader)  # pool still healthy afterwards
+            for r, o in zip(ref, out):
+                np.testing.assert_array_equal(r, np.asarray(o))
+        finally:
+            loader.close()
+
+    def test_seeded_shuffle_unperturbed_by_workers(self):
+        # worker seeding must not consume from the global numpy stream:
+        # seeded shuffle order must match the num_workers=0 path exactly
+        ds = GilHeavyDataset(n=16, work=100)
+        np.random.seed(1234)
+        ref = _collect(DataLoader(ds, batch_size=4, shuffle=True))
+        np.random.seed(1234)
+        out = _collect(DataLoader(ds, batch_size=4, shuffle=True,
+                                  num_workers=2, worker_mode="process"))
+        for a, b in zip(ref, out):
+            np.testing.assert_array_equal(a, b)
+
+    def test_worker_exception_propagates(self):
+        # index 5 raises inside the child: must surface at the consumer
+        loader = DataLoader(FailingDataset(), batch_size=2, num_workers=2,
+                            worker_mode="process")
+        with pytest.raises(RuntimeError, match="worker process"):
+            _collect(loader)
+
+    def test_invalid_worker_mode_rejected(self):
+        with pytest.raises(ValueError, match="worker_mode"):
+            DataLoader(GilHeavyDataset(n=4, work=10), worker_mode="greenlet")
+
+
+class _WorkerInfoDataset:
+    def __getitem__(self, idx):
+        from paddle_tpu.io import get_worker_info
+
+        info = get_worker_info()
+        wid = -1 if info is None else info.id
+        return np.array([idx, wid], dtype=np.int64)
+
+    def __len__(self):
+        return 16
+
+
+class TestWorkerInfo:
+    def test_get_worker_info_set_in_children(self):
+        out = _collect(DataLoader(_WorkerInfoDataset(), batch_size=4,
+                                  num_workers=2, worker_mode="process"))
+        wids = np.concatenate([b[:, 1] for b in out])
+        assert set(wids.tolist()) <= {0, 1}
+        assert (wids >= 0).all()  # every sample came from a real worker
+
+    def test_main_process_has_no_worker_info(self):
+        from paddle_tpu.io import get_worker_info
+
+        assert get_worker_info() is None
