@@ -77,6 +77,23 @@ def ps_table() -> ctypes.CDLL:
         lib.pst_save.argtypes = [ptr, cstr]
         lib.pst_load.restype = c.c_int
         lib.pst_load.argtypes = [ptr, cstr]
+        # graph table (common_graph_table.cc role)
+        lib.pgt_create.restype = ptr
+        lib.pgt_create.argtypes = [u64]
+        lib.pgt_destroy.argtypes = [ptr]
+        lib.pgt_add_edges.argtypes = [ptr, i64p, i64p, f32p, u64]
+        lib.pgt_add_nodes.argtypes = [ptr, i64p, u64]
+        lib.pgt_num_nodes.restype = u64
+        lib.pgt_num_nodes.argtypes = [ptr]
+        lib.pgt_num_edges.restype = u64
+        lib.pgt_num_edges.argtypes = [ptr]
+        lib.pgt_degrees.argtypes = [ptr, i64p, u64, i64p]
+        lib.pgt_sample_neighbors.argtypes = [ptr, i64p, u64, u64, i64p]
+        lib.pgt_random_sample_nodes.argtypes = [ptr, u64, i64p]
+        lib.pgt_save.restype = c.c_int
+        lib.pgt_save.argtypes = [ptr, cstr]
+        lib.pgt_load.restype = c.c_int
+        lib.pgt_load.argtypes = [ptr, cstr]
         lib._sigs_set = True
     return lib
 

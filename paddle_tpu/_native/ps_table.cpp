@@ -15,10 +15,12 @@
 #include <sys/stat.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <cmath>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <limits>
 #include <mutex>
 #include <random>
 #include <string>
@@ -246,6 +248,222 @@ int pst_save(void* h, const char* path) {
   std::fwrite(hdr, sizeof(uint64_t), 2, f);
   std::fwrite(t->data(), sizeof(float), t->rows * t->dim, f);
   std::fwrite(t->accum(), sizeof(float), t->rows, f);
+  std::fclose(f);
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Graph table (reference common_graph_table.cc: graph storage + neighbor
+// sampling service for GNN recsys).  Adjacency is a hash map keyed by the
+// GLOBAL node id (the client shards edges by src % S, so one server holds
+// the full out-neighborhood of each node it owns); sampling is uniform
+// without replacement, or weighted (Efraimidis–Spirakis top-k keys) when
+// edge weights were supplied.  Missing slots pad with -1.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct GraphTable {
+  std::unordered_map<int64_t, std::vector<int64_t>> adj;
+  std::unordered_map<int64_t, std::vector<float>> wts;  // parallel to adj
+  std::vector<int64_t> nodes;  // insertion order, for random node batches
+  std::unordered_map<int64_t, size_t> node_pos;
+  uint64_t edges = 0;
+  bool weighted = false;
+  std::mt19937_64 rng;
+  std::mutex mu;
+
+  void touch(int64_t id) {
+    if (node_pos.find(id) == node_pos.end()) {
+      node_pos.emplace(id, nodes.size());
+      nodes.push_back(id);
+    }
+  }
+};
+
+}  // namespace
+
+void* pgt_create(uint64_t seed) {
+  auto* g = new GraphTable();
+  g->rng.seed(seed);
+  return g;
+}
+
+void pgt_destroy(void* h) { delete static_cast<GraphTable*>(h); }
+
+// append edges src[i] -> dst[i] (weights nullable; mixing weighted and
+// unweighted calls upgrades earlier edges to weight 1)
+void pgt_add_edges(void* h, const int64_t* src, const int64_t* dst,
+                   const float* w, uint64_t n) {
+  auto* g = static_cast<GraphTable*>(h);
+  std::lock_guard<std::mutex> lk(g->mu);
+  if (w && !g->weighted) {
+    g->weighted = true;
+    for (auto& kv : g->adj)  // backfill weight 1 for earlier edges
+      g->wts[kv.first].assign(kv.second.size(), 1.0f);
+  }
+  for (uint64_t i = 0; i < n; ++i) {
+    // only src joins this shard's node set — dst nodes are registered on
+    // THEIR owning shard via pgt_add_nodes (the client fans them out), so
+    // per-shard node counts partition the global node set exactly
+    g->touch(src[i]);
+    g->adj[src[i]].push_back(dst[i]);
+    if (g->weighted) g->wts[src[i]].push_back(w ? w[i] : 1.0f);
+    ++g->edges;
+  }
+}
+
+void pgt_add_nodes(void* h, const int64_t* ids, uint64_t n) {
+  auto* g = static_cast<GraphTable*>(h);
+  std::lock_guard<std::mutex> lk(g->mu);
+  for (uint64_t i = 0; i < n; ++i) g->touch(ids[i]);
+}
+
+uint64_t pgt_num_nodes(void* h) {
+  auto* g = static_cast<GraphTable*>(h);
+  std::lock_guard<std::mutex> lk(g->mu);
+  return g->nodes.size();
+}
+
+uint64_t pgt_num_edges(void* h) {
+  auto* g = static_cast<GraphTable*>(h);
+  std::lock_guard<std::mutex> lk(g->mu);
+  return g->edges;
+}
+
+void pgt_degrees(void* h, const int64_t* ids, uint64_t n, int64_t* out) {
+  auto* g = static_cast<GraphTable*>(h);
+  std::lock_guard<std::mutex> lk(g->mu);
+  for (uint64_t i = 0; i < n; ++i) {
+    auto it = g->adj.find(ids[i]);
+    out[i] = it == g->adj.end() ? 0 : (int64_t)it->second.size();
+  }
+}
+
+// out[i, :] = up to k sampled out-neighbors of ids[i], -1 padded.
+// degree <= k returns the whole neighborhood (reference sample semantics);
+// otherwise k distinct neighbors — uniformly, or by weight when weighted.
+void pgt_sample_neighbors(void* h, const int64_t* ids, uint64_t n,
+                          uint64_t k, int64_t* out) {
+  auto* g = static_cast<GraphTable*>(h);
+  std::lock_guard<std::mutex> lk(g->mu);
+  std::vector<uint32_t> idx;
+  std::vector<std::pair<float, uint32_t>> keys;  // weighted top-k
+  std::uniform_real_distribution<float> uni(
+      std::numeric_limits<float>::min(), 1.0f);
+  for (uint64_t i = 0; i < n; ++i) {
+    int64_t* row = out + i * k;
+    auto it = g->adj.find(ids[i]);
+    const uint64_t d = it == g->adj.end() ? 0 : it->second.size();
+    if (d <= k) {
+      for (uint64_t j = 0; j < k; ++j)
+        row[j] = j < d ? it->second[j] : -1;
+      continue;
+    }
+    const auto& nb = it->second;
+    if (g->weighted) {
+      // Efraimidis–Spirakis: top-k of u^(1/w) draws k items w/o
+      // replacement with probability proportional to weight
+      const auto& wt = g->wts[ids[i]];
+      keys.clear();
+      keys.reserve(d);
+      for (uint64_t j = 0; j < d; ++j) {
+        float u = uni(g->rng);
+        float key = wt[j] > 0 ? std::pow(u, 1.0f / wt[j]) : 0.0f;
+        keys.emplace_back(key, (uint32_t)j);
+      }
+      std::partial_sort(keys.begin(), keys.begin() + k, keys.end(),
+                        [](auto& a, auto& b) { return a.first > b.first; });
+      for (uint64_t j = 0; j < k; ++j) row[j] = nb[keys[j].second];
+    } else {
+      // partial Fisher–Yates over an index scratch
+      idx.resize(d);
+      for (uint64_t j = 0; j < d; ++j) idx[j] = (uint32_t)j;
+      for (uint64_t j = 0; j < k; ++j) {
+        std::uniform_int_distribution<uint64_t> pick(j, d - 1);
+        std::swap(idx[j], idx[pick(g->rng)]);
+        row[j] = nb[idx[j]];
+      }
+    }
+  }
+}
+
+// k nodes drawn uniformly (with replacement) from this shard's node set
+void pgt_random_sample_nodes(void* h, uint64_t k, int64_t* out) {
+  auto* g = static_cast<GraphTable*>(h);
+  std::lock_guard<std::mutex> lk(g->mu);
+  if (g->nodes.empty()) {
+    for (uint64_t i = 0; i < k; ++i) out[i] = -1;
+    return;
+  }
+  std::uniform_int_distribution<uint64_t> pick(0, g->nodes.size() - 1);
+  for (uint64_t i = 0; i < k; ++i) out[i] = g->nodes[pick(g->rng)];
+}
+
+// snapshot: u64 n_nodes, then per node: id, degree, neighbors, weights?
+int pgt_save(void* h, const char* path) {
+  auto* g = static_cast<GraphTable*>(h);
+  std::lock_guard<std::mutex> lk(g->mu);
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return -1;
+  uint64_t hdr[2] = {g->nodes.size(), g->weighted ? 1ull : 0ull};
+  std::fwrite(hdr, sizeof(uint64_t), 2, f);
+  for (int64_t id : g->nodes) {
+    auto it = g->adj.find(id);
+    uint64_t d = it == g->adj.end() ? 0 : it->second.size();
+    std::fwrite(&id, sizeof(int64_t), 1, f);
+    std::fwrite(&d, sizeof(uint64_t), 1, f);
+    if (d) {
+      std::fwrite(it->second.data(), sizeof(int64_t), d, f);
+      if (g->weighted) std::fwrite(g->wts[id].data(), sizeof(float), d, f);
+    }
+  }
+  std::fclose(f);
+  return 0;
+}
+
+int pgt_load(void* h, const char* path) {
+  auto* g = static_cast<GraphTable*>(h);
+  std::lock_guard<std::mutex> lk(g->mu);
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  uint64_t hdr[2];
+  if (std::fread(hdr, sizeof(uint64_t), 2, f) != 2) {
+    std::fclose(f);
+    return -2;
+  }
+  g->adj.clear();
+  g->wts.clear();
+  g->nodes.clear();
+  g->node_pos.clear();
+  g->edges = 0;
+  g->weighted = hdr[1] != 0;
+  for (uint64_t i = 0; i < hdr[0]; ++i) {
+    int64_t id;
+    uint64_t d;
+    if (std::fread(&id, sizeof(int64_t), 1, f) != 1 ||
+        std::fread(&d, sizeof(uint64_t), 1, f) != 1) {
+      std::fclose(f);
+      return -3;
+    }
+    g->touch(id);
+    if (!d) continue;
+    auto& nb = g->adj[id];
+    nb.resize(d);
+    if (std::fread(nb.data(), sizeof(int64_t), d, f) != d) {
+      std::fclose(f);
+      return -3;
+    }
+    if (g->weighted) {
+      auto& wt = g->wts[id];
+      wt.resize(d);
+      if (std::fread(wt.data(), sizeof(float), d, f) != d) {
+        std::fclose(f);
+        return -3;
+      }
+    }
+    g->edges += d;
+  }
   std::fclose(f);
   return 0;
 }

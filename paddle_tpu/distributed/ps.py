@@ -183,3 +183,35 @@ class TheOnePS:
     def load(self, dirname: str):
         for tid, t in self._tables.items():
             t.load(f"{dirname}/table_{tid}")
+
+
+class DistributedGraphTable:
+    """Client-side handle on the PS-service graph table (reference
+    common_graph_table.cc + graph_brpc_client: graph storage + neighbor
+    sampling RPC for GNN recsys models).
+
+    The storage and sampling kernels live server-side
+    (_native/ps_table.cpp ``pgt_*``); edges are sharded ``src %
+    num_servers`` so each server owns the full out-neighborhood of its
+    nodes.  This wrapper binds one table id on a
+    :class:`~paddle_tpu.distributed.ps_service.PSClient`."""
+
+    def __init__(self, client, tid: int = 0, seed: int = 0):
+        self.client = client
+        self.tid = tid
+        client.create_graph_table(tid, seed=seed)
+
+    def add_edges(self, src, dst, weights=None):
+        self.client.add_edges(self.tid, src, dst, weights)
+
+    def sample_neighbors(self, ids, k: int):
+        return self.client.sample_neighbors(self.tid, ids, k)
+
+    def degrees(self, ids):
+        return self.client.node_degrees(self.tid, ids)
+
+    def random_nodes(self, k: int):
+        return self.client.random_sample_nodes(self.tid, k)
+
+    def stat(self):
+        return self.client.graph_stat(self.tid)

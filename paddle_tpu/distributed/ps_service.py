@@ -40,7 +40,10 @@ import numpy as np
 
 OPS = {"create": 1, "pull": 2, "push": 3, "pull_dense": 4, "push_dense": 5,
        "save": 6, "load": 7, "stat": 8, "barrier_add": 9, "shutdown": 10,
-       "barrier_get": 11, "err": 12, "push_delta": 13}
+       "barrier_get": 11, "err": 12, "push_delta": 13,
+       # graph table service (common_graph_table.cc role)
+       "g_create": 14, "g_add_edges": 15, "g_sample": 16, "g_degree": 17,
+       "g_nodes": 18, "g_add_nodes": 19, "g_stat": 20}
 _OP_NAMES = {v: k for k, v in OPS.items()}
 
 
@@ -227,22 +230,101 @@ class PSServer:
                 with self._tables_lock:  # snapshot: creates may race
                     tables = list(self._tables.items())
                 for tid, t in tables:
+                    path = os.path.join(
+                        meta["dir"],
+                        f"table_{tid}.shard{self.server_idx}").encode()
+                    if t.get("kind") == "graph":
+                        lib.pgt_save(t["h"], path)
+                        continue
                     if t.get("storage") == "ssd":
                         lib.pst_sync(t["h"])  # msync the mmap first
-                    lib.pst_save(t["h"], os.path.join(
-                        meta["dir"],
-                        f"table_{tid}.shard{self.server_idx}").encode())
+                    lib.pst_save(t["h"], path)
                 return _pack("save", {"ok": True}, {})
             if op == "load":
                 with self._tables_lock:
                     tables = list(self._tables.items())
                 for tid, t in tables:
-                    rc = lib.pst_load(t["h"], os.path.join(
+                    path = os.path.join(
                         meta["dir"],
-                        f"table_{tid}.shard{self.server_idx}").encode())
+                        f"table_{tid}.shard{self.server_idx}").encode()
+                    fn = (lib.pgt_load if t.get("kind") == "graph"
+                          else lib.pst_load)
+                    rc = fn(t["h"], path)
                     if rc != 0:
                         return _pack("load", {"ok": False, "rc": rc}, {})
                 return _pack("load", {"ok": True}, {})
+            if op == "g_create":
+                tid = meta["tid"]
+                with self._tables_lock:
+                    if tid not in self._tables:
+                        h = lib.pgt_create(
+                            meta.get("seed", 0) * 1000 + self.server_idx + 1)
+                        self._tables[tid] = {"h": h, "kind": "graph",
+                                             "rows": 0, "dim": 0}
+                return _pack("g_create", {"ok": True}, {})
+            if op == "g_add_edges":
+                t = self._tables[meta["tid"]]
+                src = np.ascontiguousarray(arrays["src"], np.int64)
+                dst = np.ascontiguousarray(arrays["dst"], np.int64)
+                w = arrays.get("weights")
+                wp = None
+                if w is not None:
+                    w = np.ascontiguousarray(w, np.float32)
+                    wp = w.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+                lib.pgt_add_edges(
+                    t["h"],
+                    src.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                    dst.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                    wp, len(src))
+                return _pack("g_add_edges", {"ok": True}, {})
+            if op == "g_add_nodes":
+                t = self._tables[meta["tid"]]
+                ids = np.ascontiguousarray(arrays["ids"], np.int64)
+                lib.pgt_add_nodes(
+                    t["h"],
+                    ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                    len(ids))
+                return _pack("g_add_nodes", {"ok": True}, {})
+            if op == "g_sample":
+                t = self._tables[meta["tid"]]
+                ids = np.ascontiguousarray(arrays["ids"], np.int64)
+                k = int(meta["k"])
+                out = np.full((len(ids), k), -1, np.int64)
+                lib.pgt_sample_neighbors(
+                    t["h"],
+                    ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                    len(ids), k,
+                    out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+                return _pack("g_sample", {"ok": True}, {"nbrs": out})
+            if op == "g_degree":
+                t = self._tables[meta["tid"]]
+                ids = np.ascontiguousarray(arrays["ids"], np.int64)
+                out = np.zeros(len(ids), np.int64)
+                lib.pgt_degrees(
+                    t["h"],
+                    ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                    len(ids),
+                    out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+                return _pack("g_degree", {"ok": True}, {"degrees": out})
+            if op == "g_stat":
+                # read-only: must not touch the sampling RNG
+                t = self._tables[meta["tid"]]
+                return _pack("g_stat", {
+                    "ok": True,
+                    "num_nodes": int(lib.pgt_num_nodes(t["h"])),
+                    "num_edges": int(lib.pgt_num_edges(t["h"]))}, {})
+            if op == "g_nodes":
+                t = self._tables[meta["tid"]]
+                k = int(meta["k"])
+                out = np.full(k, -1, np.int64)
+                lib.pgt_random_sample_nodes(
+                    t["h"], k,
+                    out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+                return _pack("g_nodes", {
+                    "ok": True,
+                    "num_nodes": int(lib.pgt_num_nodes(t["h"])),
+                    "num_edges": int(lib.pgt_num_edges(t["h"]))},
+                    {"nodes": out})
             if op == "barrier_add":
                 with self._dense_lock:
                     k = meta["key"]
@@ -388,6 +470,91 @@ class PSClient:
             metas.append({"tid": tid, "lr": lr})
             arrs.append({"ids": local[m], "grads": grads[m]})
         self._fan("push", metas, arrs)
+
+    # -- graph API (common_graph_table.cc role) ------------------------------
+    def create_graph_table(self, tid: int, seed: int = 0):
+        """Distributed graph table for GNN sampling: each server owns the
+        full out-neighborhood of the nodes with ``src % num_servers ==
+        server_idx``."""
+        self._fan("g_create", [{"tid": tid, "seed": seed}] * self.S,
+                  [{}] * self.S)
+
+    def add_edges(self, tid: int, src, dst, weights=None):
+        src = np.asarray(src, np.int64).reshape(-1)
+        dst = np.asarray(dst, np.int64).reshape(-1)
+        if len(src) != len(dst):
+            raise ValueError("src/dst length mismatch")
+        w = None if weights is None else np.asarray(
+            weights, np.float32).reshape(-1)
+        srv = src % self.S
+        metas, arrs = [], []
+        for s in range(self.S):
+            m = srv == s
+            metas.append({"tid": tid})
+            a = {"src": src[m], "dst": dst[m]}
+            if w is not None:
+                a["weights"] = w[m]
+            arrs.append(a)
+        self._fan("g_add_edges", metas, arrs)
+        # register dst nodes on THEIR owning shards so per-shard node sets
+        # partition the graph (random_sample_nodes stays unbiased)
+        dsrv = dst % self.S
+        self._fan("g_add_nodes", [{"tid": tid}] * self.S,
+                  [{"ids": np.unique(dst[dsrv == s])}
+                   for s in range(self.S)])
+
+    def sample_neighbors(self, tid: int, ids, k: int) -> np.ndarray:
+        """[n, k] int64 of sampled out-neighbors, -1-padded where the
+        degree is below k.  Uniform without replacement, or
+        weight-proportional when the edges carried weights."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        srv = ids % self.S
+        metas, arrs = [], []
+        for s in range(self.S):
+            metas.append({"tid": tid, "k": int(k)})
+            arrs.append({"ids": ids[srv == s]})
+        out = self._fan("g_sample", metas, arrs)
+        res = np.full((len(ids), int(k)), -1, np.int64)
+        for s in range(self.S):
+            res[srv == s] = out[s][1]["nbrs"]
+        return res
+
+    def node_degrees(self, tid: int, ids) -> np.ndarray:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        srv = ids % self.S
+        metas, arrs = [], []
+        for s in range(self.S):
+            metas.append({"tid": tid})
+            arrs.append({"ids": ids[srv == s]})
+        out = self._fan("g_degree", metas, arrs)
+        res = np.zeros(len(ids), np.int64)
+        for s in range(self.S):
+            res[srv == s] = out[s][1]["degrees"]
+        return res
+
+    def random_sample_nodes(self, tid: int, k: int) -> np.ndarray:
+        """k nodes drawn ~uniformly across the whole distributed graph:
+        each shard returns k uniform draws from its own node set plus its
+        node count; the client keeps a count-weighted mix."""
+        out = self._fan("g_nodes", [{"tid": tid, "k": int(k)}] * self.S,
+                        [{}] * self.S)
+        counts = np.array([out[s][0]["num_nodes"] for s in range(self.S)],
+                          np.float64)
+        if counts.sum() == 0:
+            return np.full(int(k), -1, np.int64)
+        take = np.random.multinomial(int(k), counts / counts.sum())
+        picks = [out[s][1]["nodes"][:t] for s, t in enumerate(take)]
+        res = np.concatenate(picks) if picks else np.empty(0, np.int64)
+        # a shard with fewer unique draws than requested never under-fills:
+        # the server samples with replacement, so take<=k always satisfiable
+        return res
+
+    def graph_stat(self, tid: int) -> dict:
+        out = self._fan("g_stat", [{"tid": tid}] * self.S, [{}] * self.S)
+        return {"num_nodes": sum(out[s][0]["num_nodes"]
+                                 for s in range(self.S)),
+                "num_edges": sum(out[s][0]["num_edges"]
+                                 for s in range(self.S))}
 
     # -- dense API (key-sharded by hash) -------------------------------------
     def _dense_server(self, key: str) -> int:
