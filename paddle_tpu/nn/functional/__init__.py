@@ -369,7 +369,7 @@ def _pool(a, nd, kernel, stride, padding, mode, ceil_mode=False, count_include_p
         return out
     # avg
     out = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides, pads)
-    if count_include_pad or builtins_all_zero(p):
+    if count_include_pad or _all_zero(p):
         return out / float(np.prod(k))
     ones = jnp.ones(a.shape[2:], a.dtype)
     cnt = jax.lax.reduce_window(
@@ -378,7 +378,7 @@ def _pool(a, nd, kernel, stride, padding, mode, ceil_mode=False, count_include_p
     return out / cnt
 
 
-def builtins_all_zero(p):
+def _all_zero(p):
     return all(pi == 0 for pi in p)
 
 

@@ -73,7 +73,7 @@ def _attach_variable_methods():
             setattr(Variable, name, fn)
     for name, fn in T._DUNDERS.items():
         setattr(Variable, name, fn)  # __hash__ stays identity (defined)
-    Variable.pow = T.pow_
+    Variable.pow = T.pow
 
 
 _attach_variable_methods()

@@ -125,9 +125,10 @@ def arange(start=0, end=None, step=1, dtype=None):
         start, end = 0, start
     start, end, step = _v(start), _v(end), _v(step)
     if d is None:
-        d = jnp.int64 if all(
-            isinstance(a, (int, np.integer)) for a in (start, end, step)
-        ) else get_default_dtype()
+        # NB: plain all() here would hit this module's tensor `all` op
+        is_int = builtins.all(isinstance(a, (int, np.integer))
+                              for a in (start, end, step))
+        d = jnp.int64 if is_int else get_default_dtype()
     return _place_new(jnp.arange(start, end, step, dtype=d))
 
 
@@ -271,8 +272,7 @@ floor_divide = _binary("floor_divide", jnp.floor_divide)
 remainder = _binary("remainder", jnp.remainder)
 mod = remainder
 __all__.append("mod")
-pow_ = _binary("pow", jnp.power)
-pow = pow_  # noqa: A001
+pow = _binary("pow", jnp.power)  # noqa: A001
 maximum = _binary("maximum", jnp.maximum)
 minimum = _binary("minimum", jnp.minimum)
 fmax = _binary("fmax", jnp.fmax)
@@ -1292,7 +1292,7 @@ def array_length(array):
     return Tensor(jnp.asarray(len(array), jnp.int64))
 
 
-reshape_ = _inplace("reshape_", lambda x, s: reshape(x, s))
+reshape_ = _inplace("reshape_", lambda x, *a, **k: reshape(x, *a, **k))
 scatter_ = _inplace("scatter_", lambda x, *a, **k: scatter(x, *a, **k))
 squeeze_ = _inplace("squeeze_", lambda x, *a, **k: squeeze(x, *a, **k))
 unsqueeze_ = _inplace("unsqueeze_", lambda x, *a, **k: unsqueeze(x, *a, **k))
@@ -1326,7 +1326,7 @@ for _name, _fn in _METHODS.items():
         setattr(Tensor, _name, _fn)
 
 # `pow` name clash: method exists
-Tensor.pow = pow_
+Tensor.pow = pow
 
 
 def _swap(fn):
@@ -1345,8 +1345,8 @@ _DUNDERS = {
     "__floordiv__": floor_divide,
     "__rfloordiv__": _swap(floor_divide),
     "__mod__": remainder,
-    "__pow__": pow_,
-    "__rpow__": _swap(pow_),
+    "__pow__": pow,
+    "__rpow__": _swap(pow),
     "__matmul__": matmul,
     "__rmatmul__": _swap(matmul),
     "__neg__": neg,

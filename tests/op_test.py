@@ -17,17 +17,25 @@ from paddle_tpu.core.tensor import Tensor
 
 
 def numeric_grad(fn, args, wrt: int, eps=1e-3):
-    """Central finite differences of scalar fn(*args) w.r.t. args[wrt]."""
-    base = [np.array(a, dtype=np.float64) for a in args]
+    """Central finite differences of scalar fn(*args) w.r.t. args[wrt].
+    Integer/bool inputs (indices, masks) keep their dtype — only float
+    inputs are perturbed/downcast."""
+    def as_f32(a):
+        a = np.asarray(a)
+        return a.astype(np.float32) if a.dtype.kind == "f" else a
+
+    base = [np.array(a, dtype=np.float64) if np.asarray(a).dtype.kind == "f"
+            else np.array(a) for a in args]
+    assert base[wrt].dtype.kind == "f", "cannot differentiate w.r.t. ints"
     g = np.zeros_like(base[wrt])
     it = np.nditer(base[wrt], flags=["multi_index"])
     while not it.finished:
         idx = it.multi_index
         orig = base[wrt][idx]
         base[wrt][idx] = orig + eps
-        f_hi = float(fn(*[b.astype(np.float32) for b in base]))
+        f_hi = float(fn(*[as_f32(b) for b in base]))
         base[wrt][idx] = orig - eps
-        f_lo = float(fn(*[b.astype(np.float32) for b in base]))
+        f_lo = float(fn(*[as_f32(b) for b in base]))
         base[wrt][idx] = orig
         g[idx] = (f_hi - f_lo) / (2 * eps)
         it.iternext()

@@ -1,0 +1,283 @@
+"""Op-surface coverage GATE (reference op_test.py:270 discipline: every
+registered op gets an OpTest; here, every public ``tensor_api`` /
+``nn.functional`` export must appear in a sweep table, an auto-derived
+sweep below, or the checked-in EXEMPT list — adding an op without a test
+fails this gate).
+
+Also home of the auto-derived tiers:
+* inplace aliases (``op_``) checked against their out-of-place twin AND
+  for actual in-place mutation of the Tensor;
+* random ops checked statistically (moments / support / permutation
+  invariants under a fixed paddle.seed);
+* dropout family: train-mode mean preservation + eval-mode identity.
+"""
+import inspect
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+import paddle_tpu.tensor_api as TA
+
+from test_ops_sweep import OUT_CASES, _pos, _std
+from test_ops_sweep2 import ALL_CASES
+
+
+def _ops_of(mod):
+    out = []
+    for n in dir(mod):
+        if n.startswith("_"):
+            continue
+        obj = getattr(mod, n)
+        if (not callable(obj) or inspect.isclass(obj)
+                or inspect.ismodule(obj)):
+            continue
+        if not (getattr(obj, "__module__", "") or "").startswith(
+                "paddle_tpu"):
+            continue
+        out.append(n)
+    return sorted(out)
+
+
+# ---------------------------------------------------------------------------
+# inplace aliases: result == out-of-place twin, and the tensor mutated
+# ---------------------------------------------------------------------------
+
+# name -> (module, builders, extra args)
+INPLACE_CASES = {
+    "add_": (TA, [lambda: _std((3, 4)), lambda: _std((3, 4), 1)], {}),
+    "subtract_": (TA, [lambda: _std((3, 4)), lambda: _std((3, 4), 1)], {}),
+    "ceil_": (TA, [lambda: 3 * _std((3, 4))], {}),
+    "floor_": (TA, [lambda: 3 * _std((3, 4))], {}),
+    "round_": (TA, [lambda: 3 * _std((3, 4))], {}),
+    "clip_": (TA, [lambda: _std((3, 4))], {"min": -0.5, "max": 0.5}),
+    "exp_": (TA, [lambda: _std((3, 4))], {}),
+    "sqrt_": (TA, [lambda: _pos((3, 4))], {}),
+    "rsqrt_": (TA, [lambda: _pos((3, 4))], {}),
+    "reciprocal_": (TA, [lambda: _pos((3, 4))], {}),
+    "tanh_": (TA, [lambda: _std((3, 4))], {}),
+    "scale_": (TA, [lambda: _std((3, 4))], {"scale": 2.0, "bias": 1.0}),
+    "reshape_": (TA, [lambda: _std((3, 4))], {"shape": (4, 3)}),
+    "flatten_": (TA, [lambda: _std((2, 3, 4))], {}),
+    "squeeze_": (TA, [lambda: _std((3, 1, 4))], {"axis": 1}),
+    "unsqueeze_": (TA, [lambda: _std((3, 4))], {"axis": 1}),
+    "scatter_": (TA, [lambda: _std((5, 4)),
+                      lambda: np.array([1, 3], np.int64),
+                      lambda: _std((2, 4), 1)], {}),
+    "relu_": (F, [lambda: _std((3, 4))], {}),
+    "elu_": (F, [lambda: _std((3, 4))], {}),
+    "softmax_": (F, [lambda: _std((3, 4))], {}),
+}
+# F.tanh_ is TA.tanh_ re-exported; sweep once under TA
+_F_REEXPORTS = {"tanh_"}
+
+
+@pytest.mark.parametrize("name", sorted(INPLACE_CASES),
+                         ids=sorted(INPLACE_CASES))
+def test_inplace_matches_outofplace(name):
+    mod, builders, kwargs = INPLACE_CASES[name]
+    base = getattr(mod, name[:-1])
+    inplace = getattr(mod, name)
+    arrays = [b() for b in builders]
+    want = base(*[paddle.to_tensor(a) for a in arrays], **kwargs)
+    x = paddle.to_tensor(arrays[0])
+    rest = [paddle.to_tensor(a) for a in arrays[1:]]
+    got = inplace(x, *rest, **kwargs)
+    np.testing.assert_allclose(np.asarray(got.value, np.float64),
+                               np.asarray(want.value, np.float64),
+                               rtol=1e-6, atol=1e-6)
+    # actual in-place semantics: the INPUT tensor now holds the result
+    np.testing.assert_allclose(np.asarray(x.value, np.float64),
+                               np.asarray(want.value, np.float64),
+                               rtol=1e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# random ops: statistical sweep under a fixed seed
+# ---------------------------------------------------------------------------
+
+def _moments(name, sampler, mean, std, n=20000, mtol=0.05, stol=0.05):
+    paddle.seed(1234)
+    s = np.asarray(sampler(n).value, np.float64).reshape(-1)
+    assert abs(s.mean() - mean) < mtol, (name, s.mean())
+    assert abs(s.std() - std) < stol, (name, s.std())
+
+
+RANDOM_CHECKS = {
+    "randn": lambda: _moments(
+        "randn", lambda n: paddle.randn((n,)), 0.0, 1.0),
+    "standard_normal": lambda: _moments(
+        "standard_normal", lambda n: paddle.standard_normal((n,)), 0.0, 1.0),
+    "normal": lambda: _moments(
+        "normal", lambda n: paddle.normal(mean=2.0, std=3.0, shape=(n,)),
+        2.0, 3.0, mtol=0.15, stol=0.15),
+    "rand": lambda: _moments(
+        "rand", lambda n: paddle.rand((n,)), 0.5, 1 / np.sqrt(12)),
+    "uniform": lambda: _moments(
+        "uniform", lambda n: paddle.uniform((n,), min=-2.0, max=2.0),
+        0.0, 4 / np.sqrt(12), mtol=0.1, stol=0.1),
+    "bernoulli": lambda: _moments(
+        "bernoulli",
+        lambda n: paddle.bernoulli(paddle.full((n,), 0.3)),
+        0.3, np.sqrt(0.3 * 0.7), mtol=0.02, stol=0.02),
+    "gumbel_softmax": lambda: _gumbel_check(),
+}
+
+
+def _gumbel_check():
+    paddle.seed(7)
+    logits = paddle.to_tensor(np.zeros((4000, 3), np.float32))
+    out = np.asarray(F.gumbel_softmax(logits, hard=True).value)
+    assert out.shape == (4000, 3)
+    np.testing.assert_allclose(out.sum(1), 1.0, atol=1e-6)  # one-hot rows
+    # uniform logits -> each class picked ~1/3 of the time
+    assert np.abs(out.mean(0) - 1 / 3).max() < 0.05
+
+
+@pytest.mark.parametrize("name", sorted(RANDOM_CHECKS),
+                         ids=sorted(RANDOM_CHECKS))
+def test_random_statistics(name):
+    RANDOM_CHECKS[name]()
+
+
+def test_randint_support():
+    paddle.seed(3)
+    s = np.asarray(paddle.randint(2, 7, (5000,)).value)
+    assert s.min() >= 2 and s.max() < 7
+    assert set(np.unique(s)) == {2, 3, 4, 5, 6}
+
+
+def test_randperm_is_permutation():
+    paddle.seed(4)
+    s = np.asarray(paddle.randperm(50).value)
+    np.testing.assert_array_equal(np.sort(s), np.arange(50))
+
+
+def test_multinomial_distribution():
+    paddle.seed(5)
+    probs = paddle.to_tensor(np.array([0.1, 0.2, 0.7], np.float32))
+    s = np.asarray(paddle.multinomial(probs, 6000,
+                                      replacement=True).value).reshape(-1)
+    freq = np.bincount(s, minlength=3) / s.size
+    np.testing.assert_allclose(freq, [0.1, 0.2, 0.7], atol=0.03)
+
+
+DROPOUTS = {
+    "dropout": lambda x, p, training: F.dropout(x, p, training=training),
+    "dropout2d": lambda x, p, training: F.dropout2d(x, p, training=training),
+    "dropout3d": lambda x, p, training: F.dropout3d(x, p, training=training),
+    "alpha_dropout": lambda x, p, training: F.alpha_dropout(
+        x, p, training=training),
+}
+
+
+@pytest.mark.parametrize("name", sorted(DROPOUTS), ids=sorted(DROPOUTS))
+def test_dropout_family(name):
+    fn = DROPOUTS[name]
+    nd = {"dropout": 2, "dropout2d": 4, "dropout3d": 5,
+          "alpha_dropout": 2}[name]
+    shape = {2: (64, 64), 4: (8, 32, 4, 4), 5: (8, 16, 4, 4, 2)}[nd]
+    x = np.ones(shape, np.float32)
+    # eval mode and p=0: identity
+    for out in (fn(paddle.to_tensor(x), 0.5, False),
+                fn(paddle.to_tensor(x), 0.0, True)):
+        np.testing.assert_allclose(np.asarray(out.value), x)
+    # train mode: ~p of units dropped; plain dropout is inverted-scaled so
+    # the mean is preserved
+    paddle.seed(11)
+    out = np.asarray(fn(paddle.to_tensor(x), 0.25, True).value)
+    if name == "alpha_dropout":
+        # kept units are affine-remapped (a*x + b), not identity: expect
+        # exactly two levels with ~75/25 split
+        vals, counts = np.unique(out.round(5), return_counts=True)
+        assert len(vals) == 2, vals
+        assert abs(counts.max() / out.size - 0.75) < 0.05
+    else:
+        assert abs((out == 0).mean() - 0.25) < 0.08
+        assert abs(out.mean() - 1.0) < 0.08
+
+
+# ---------------------------------------------------------------------------
+# odds and ends swept directly
+# ---------------------------------------------------------------------------
+
+def test_broadcast_shape():
+    assert tuple(paddle.broadcast_shape((3, 1, 4), (2, 4))) == (3, 2, 4)
+
+
+def test_complex_semantics():
+    # the table's float64 casts would silently drop imaginary parts, so
+    # complex ops get their own exact checks here
+    z = np.array([[1 + 2j, 3 - 4j], [0 + 1j, -2 - 3j]], np.complex64)
+    t = paddle.to_tensor(z)
+    np.testing.assert_allclose(np.asarray(paddle.conj(t).value), z.conj())
+    np.testing.assert_allclose(np.asarray(paddle.real(t).value), z.real)
+    np.testing.assert_allclose(np.asarray(paddle.imag(t).value), z.imag)
+    x = np.array([[1., 2.], [3., 4.]], np.float32)
+    zc = np.asarray(paddle.as_complex(paddle.to_tensor(x)).value)
+    np.testing.assert_allclose(zc, x[..., 0] + 1j * x[..., 1])
+    rt = np.asarray(paddle.as_real(paddle.to_tensor(zc)).value)
+    np.testing.assert_allclose(rt, x)
+
+
+# ---------------------------------------------------------------------------
+# THE GATE
+# ---------------------------------------------------------------------------
+
+# name -> reason; every entry must justify why no sweep row exists
+EXEMPT = {
+    # framework helpers re-exported by module import, not ops
+    "convert_dtype": "dtype-string helper, not an op",
+    "current_jax_device": "device query helper (core.place), not an op",
+    "dispatch": "op-dispatch internal re-export, not an op",
+    "get_default_dtype": "config getter, not an op",
+    "static_aware": "static-mode decorator re-export, not an op",
+    # constructors / python-side utilities exercised by every other test
+    "to_tensor": "constructor used by every sweep row",
+    "is_tensor": "isinstance helper; trivially exercised package-wide",
+    "tolist": "python conversion; round-trips in test_utils_interop.py",
+    "set_printoptions": "repr formatting config, no numeric output",
+    # static-graph Program ops with dedicated tests
+    "create_array": "LoDTensorArray op, tested in test_static.py",
+    "array_read": "LoDTensorArray op, tested in test_static.py",
+    "array_write": "LoDTensorArray op, tested in test_static.py",
+    "array_length": "LoDTensorArray op, tested in test_static.py",
+    # ops with dedicated parity tests elsewhere
+    "F.ctc_loss": "torch-parity test in test_nn_completions.py",
+    "F.gather_tree": "backtrace test in test_nn_completions.py",
+    "F.hsigmoid_loss": "dedicated tests in test_nn_completions.py",
+}
+
+
+def test_every_public_op_is_swept():
+    swept = {c[0] for c in OUT_CASES} | {c[0] for c in ALL_CASES}
+    swept |= {"norm", "pad"}  # table ids norm_fro / pad_f
+    swept |= set(INPLACE_CASES) | _F_REEXPORTS
+    swept |= set(RANDOM_CHECKS) | {"randint", "randperm", "multinomial",
+                                   "rand", "randn", "standard_normal",
+                                   "normal", "uniform", "bernoulli"}
+    swept |= set(DROPOUTS)
+    swept |= {"broadcast_shape"}
+
+    missing = []
+    for n in _ops_of(TA):
+        if n not in swept and n not in EXEMPT:
+            missing.append(n)
+    for n in _ops_of(F):
+        if n not in swept and n not in EXEMPT and f"F.{n}" not in EXEMPT:
+            missing.append(f"F.{n}")
+    assert not missing, (
+        f"public ops with no sweep coverage (add a table row in "
+        f"test_ops_sweep2.py or an EXEMPT entry with a reason): {missing}")
+
+    # the sweep must stay at reference breadth (VERDICT r2 item 4: >= 250)
+    total = len(OUT_CASES) + len(ALL_CASES) + len(INPLACE_CASES) \
+        + len(RANDOM_CHECKS) + 3 + len(DROPOUTS)
+    assert total >= 250, total
+
+    # exemptions must not rot: every entry still names a real export
+    for name in EXEMPT:
+        bare = name[2:] if name.startswith("F.") else name
+        mod = F if name.startswith("F.") else TA
+        assert hasattr(mod, bare), f"stale EXEMPT entry {name}"
