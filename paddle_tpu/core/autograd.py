@@ -111,6 +111,25 @@ def record(vjp_fn, inputs, out_avals, name=""):
 # ---------------------------------------------------------------------------
 
 
+_leaf_ready_hooks: list = []
+
+
+def add_leaf_grad_ready_hook(cb):
+    """Register ``cb(tensor)`` to fire the moment a LEAF tensor's gradient
+    is final during a backward sweep (all of its consumer edges have
+    contributed) — the reference Reducer's per-parameter grad-ready hook
+    (imperative/reducer.cc ``AddDistHook``).  Returns a remover."""
+    _leaf_ready_hooks.append(cb)
+
+    def remove():
+        try:
+            _leaf_ready_hooks.remove(cb)
+        except ValueError:
+            pass
+
+    return remove
+
+
 def backward(tensors, grad_tensors=None, retain_graph=False):
     from .tensor import Tensor
 
@@ -124,7 +143,27 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
     # Seed cotangents
     node_out_grads: dict[int, list] = {}  # id(node) -> per-output cotangent
     nodes: dict[int, TapeNode] = {}
-    leaf_grads: dict[int, Any] = {}
+    # leaf-readiness accounting (Reducer grad-ready hooks): how many
+    # consumer edges each leaf still owes before its grad is final
+    leaf_pending: dict[int, int] = {}
+    leaf_tensors: dict[int, Any] = {}
+
+    def _leaf_edge(t: Tensor):
+        if _leaf_ready_hooks and not t.stop_gradient:
+            leaf_pending[id(t)] = leaf_pending.get(id(t), 0) + 1
+            leaf_tensors[id(t)] = t
+
+    def _leaf_done(t: Tensor):
+        if not _leaf_ready_hooks:
+            return
+        tid = id(t)
+        if tid not in leaf_pending:
+            return
+        leaf_pending[tid] -= 1
+        if leaf_pending[tid] == 0:
+            del leaf_pending[tid]
+            for cb in list(_leaf_ready_hooks):
+                cb(t)
 
     def _seed(t: Tensor, g):
         if g is None:
@@ -143,6 +182,7 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
         if node is None:
             if not t.stop_gradient:
                 t._accumulate_grad(g)
+                _leaf_done(t)
             return
         nid = id(node)
         nodes[nid] = node
@@ -168,11 +208,15 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
                     pnid = id(inp._node)
                     pending[pnid] = pending.get(pnid, 0) + 1
                     stack.append(inp._node)
+                else:
+                    _leaf_edge(inp)
 
     roots = [t._node for t in tensors if t._node is not None]
     _discover(roots)
 
     for t, g in zip(tensors, grad_tensors):
+        if t._node is None:
+            _leaf_edge(t)  # a seeded leaf owes exactly its seed edge
         _seed(t, g)
 
     # 2. Reverse sweep: run a node's vjp once all its consumers have fired.
@@ -209,6 +253,7 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
             if pnode is None:
                 if not inp.stop_gradient:
                     inp._accumulate_grad(g)
+                    _leaf_done(inp)
                 continue
             pnid = id(pnode)
             buf = node_out_grads.setdefault(pnid, [None] * pnode.n_outputs)

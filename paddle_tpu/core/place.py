@@ -65,7 +65,10 @@ def CUDAPlace(idx: int = 0) -> Place:  # pragma: no cover - compat shim
 @functools.lru_cache(maxsize=None)
 def _platforms():
     plats = {}
-    for d in jax.devices():
+    # local_devices, not devices: under a multi-controller run the global
+    # list starts with process 0's devices, and placing this process's
+    # eager tensors there is illegal (non-addressable)
+    for d in jax.local_devices():
         plats.setdefault(_platform_name(d), []).append(d)
     for d in jax.local_devices(backend="cpu") if _has_cpu_backend() else []:
         plats.setdefault("cpu", []).append(d)

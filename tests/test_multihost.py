@@ -40,6 +40,36 @@ print(f"MULTIHOST-OK-{jax.process_index()}", flush=True)
 """
 
 
+_REDUCER_WORKER = r"""
+import os
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+paddle.distributed.init_parallel_env({"dp": 2})
+r = jax.process_index()
+
+model = nn.Linear(4, 1)
+model.weight._value = jax.numpy.zeros((4, 1), "float32")  # identical init
+dp = paddle.DataParallel(model)  # process_count()==2 -> Reducer auto-on
+assert dp._reducer is not None
+
+# DIFFERENT local batch per rank: local grad_w = 3*(r+1) per entry,
+# so the reduced (mean) grad must be (3*1 + 3*2)/2 = 4.5 on BOTH ranks
+x = paddle.to_tensor(np.full((3, 4), float(r + 1), np.float32))
+loss = paddle.sum(dp(x))
+loss.backward()
+dp.sync_gradients()
+g = np.asarray(model.weight.grad.value)
+assert np.allclose(g, 4.5), (r, g)
+print(f"REDUCER-OK-{r}", flush=True)
+"""
+
+
 def _free_port_pair():
     """env.py advertises the KV port and binds jax coordination on port+1 —
     both must be free."""
@@ -58,10 +88,10 @@ def _free_port_pair():
     raise RuntimeError("no free consecutive port pair")
 
 
-def test_two_process_psum(tmp_path):
+def _run_cluster(tmp_path, source, marker):
     port = _free_port_pair()
     script = tmp_path / "worker.py"
-    script.write_text(_WORKER)
+    script.write_text(source)
     procs = []
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     for pid in range(2):
@@ -87,4 +117,15 @@ def test_two_process_psum(tmp_path):
             out, _ = p.communicate()
         outs.append(out)
     for pid, out in enumerate(outs):
-        assert f"MULTIHOST-OK-{pid}" in out, out[-2000:]
+        assert f"{marker}-{pid}" in out, out[-2000:]
+
+
+def test_two_process_psum(tmp_path):
+    _run_cluster(tmp_path, _WORKER, "MULTIHOST-OK")
+
+
+def test_two_process_reducer_parity(tmp_path):
+    """Eager DataParallel across REAL processes: per-rank local grads
+    differ; the Reducer's fused bucket pmean must land the cross-process
+    mean on every rank (reference reducer.cc allreduce parity)."""
+    _run_cluster(tmp_path, _REDUCER_WORKER, "REDUCER-OK")
