@@ -23,9 +23,13 @@ The transform (per ``if``/``while`` statement):
   raises :class:`Dy2StaticError` naming the source line (the reference
   converts these with RETURN-flag rewrites; explicitly out of scope).
 
-Conversion is applied to the entry function/forward only (the reference's
-``convert_call`` recursion over every callee is not reproduced; sublayers
-with tensor-dependent control flow must be converted explicitly).
+Conversion recurses through callees (the reference's ``convert_call``,
+program_translator.py): every call site in converted code is rewritten to
+``convert_call(f)(...)``, which lazily converts user functions, bound
+methods, and sublayer ``forward``s (cached on the function object) while
+passing library callables (paddle_tpu/jax/numpy/builtins) through
+untouched — so a sublayer's tensor-valued ``if`` works without manual
+decoration.
 """
 from __future__ import annotations
 
@@ -41,8 +45,9 @@ from jax import lax
 
 from ..core.tensor import Tensor
 
-__all__ = ["convert_to_static", "Dy2StaticError", "convert_ifelse",
-           "convert_while", "logical_and", "logical_or", "logical_not"]
+__all__ = ["convert_to_static", "convert_call", "Dy2StaticError",
+           "convert_ifelse", "convert_while", "logical_and", "logical_or",
+           "logical_not"]
 
 
 class Dy2StaticError(Exception):
@@ -285,6 +290,46 @@ def logical_not(v):
     return not bool(_unwrap1(v))
 
 
+# modules whose callables are infrastructure, not user code to convert
+_SKIP_ROOTS = {"jax", "jaxlib", "numpy", "paddle_tpu", "builtins", "math",
+               "functools", "itertools", "operator", "typing", "collections",
+               "abc", "contextlib", "random", "re", "os", "sys"}
+
+
+def convert_call(f):
+    """Reference ``convert_call`` (program_translator.py): lazily convert a
+    callee reached from converted code.  User functions and methods are
+    AST-converted (cached per function object by :func:`convert_to_static`);
+    Layer instances get their ``forward`` converted and re-bound; library
+    callables (paddle_tpu/jax/numpy/builtins/C functions, classes) pass
+    through untouched.  Any conversion failure falls back to the original
+    callable — convert_call must never break a working call."""
+    try:
+        from ..nn.layer_base import Layer
+
+        if isinstance(f, types.MethodType):
+            g = convert_call(f.__func__)
+            return f if g is f.__func__ else types.MethodType(g, f.__self__)
+        if isinstance(f, Layer):
+            fwd = type(f).forward
+            mod = (getattr(fwd, "__module__", "") or "").split(".")[0]
+            if mod in _SKIP_ROOTS:  # library layers (nn.Linear...) stay
+                return f            # untouched — no rebind, no recompile
+            conv = convert_to_static(fwd)
+            if conv is not fwd \
+                    and getattr(f.forward, "__func__", None) is not conv:
+                f.forward = types.MethodType(conv, f)
+            return f
+        if not isinstance(f, types.FunctionType):
+            return f
+        mod = (getattr(f, "__module__", "") or "").split(".")[0]
+        if mod in _SKIP_ROOTS:
+            return f
+        return convert_to_static(f)
+    except Exception:  # noqa: BLE001 - never turn a working call into a crash
+        return f
+
+
 def assert_py_cond(pred, _loc_info=None, reason=""):
     """Guard for constructs left as Python: fails loudly on tensor preds."""
     if _is_traced(pred):
@@ -422,6 +467,65 @@ def _escapes(stmts) -> bool:
     for s in stmts:
         v.visit(s)
     return v.found
+
+
+class _CallWrapper(ast.NodeTransformer):
+    """Rewrite every call site ``f(...)`` to ``__pt_dy2st.convert_call(f)
+    (...)`` (the reference's convert_call injection).  Names whose identity
+    the rest of the transform (or Python semantics) depends on are left
+    bare: ``range`` must stay recognizable to the for-range transformer,
+    ``locals`` must run in the caller's frame, and zero-arg ``super``
+    needs the ``__class__`` cell of the immediate function."""
+
+    SKIP_NAMES = {"range", "locals", "super", "globals", "vars", "eval",
+                  "exec"}
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in self.SKIP_NAMES:
+            return node
+        node.func = ast.Call(
+            func=ast.Attribute(value=ast.Name(id=_RT, ctx=ast.Load()),
+                               attr="convert_call", ctx=ast.Load()),
+            args=[f], keywords=[])
+        return node
+
+    # nested defs/lambdas convert on their own when actually called
+    def visit_FunctionDef(self, node):
+        return node
+
+    def visit_AsyncFunctionDef(self, node):
+        return node
+
+    def visit_Lambda(self, node):
+        return node
+
+
+class _HasCalls(ast.NodeVisitor):
+    def __init__(self):
+        self.found = False
+
+    def visit_Call(self, node):
+        self.found = True
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    def visit_AsyncFunctionDef(self, node):
+        pass
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _has_calls(fdef) -> bool:
+    v = _HasCalls()
+    for s in fdef.body:
+        v.visit(s)
+        if v.found:
+            return True
+    return False
 
 
 class _BoolOpRewriter(ast.NodeTransformer):
@@ -609,8 +713,15 @@ def convert_to_static(fn):
     fdef = tree.body[0]
     if not isinstance(fdef, (ast.FunctionDef,)):
         return fn
-    if not _has_control_flow(fdef):
-        return fn  # nothing to convert: keep the original untouched
+    has_cf = _has_control_flow(fdef)
+    if not has_cf and not _has_calls(fdef):
+        return fn  # no control flow and no callees: keep the original
+    if not has_cf and "__class__" in fn.__code__.co_freevars:
+        # zero-arg super() needs the compiler's __class__ cell, which an
+        # AST recompile cannot reproduce — a call-wrapping-only conversion
+        # is optional, so skip it (control-flow conversion still proceeds;
+        # there super() was already unsupported)
+        return fn
     # only paddle's own jit decorators are safe to strip on recompile; any
     # other decorator would be silently lost — skip conversion instead
     known = {"to_static", "not_to_static"}
@@ -620,6 +731,10 @@ def convert_to_static(fn):
         if name not in known:
             return fn
     fdef.decorator_list = []
+    # convert_call injection FIRST (on the user's original call sites, not
+    # descending into nested defs), then the control-flow rewrite whose
+    # generated runtime calls must stay bare
+    fdef.body = [_CallWrapper().visit(s) for s in fdef.body]
     new_tree = _ControlFlowTransformer(
         inspect.getsourcefile(fn) or "<unknown>").visit(tree)
     ast.fix_missing_locations(new_tree)

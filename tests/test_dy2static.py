@@ -14,6 +14,7 @@ import jax.numpy as jnp
 import paddle_tpu as paddle
 from paddle_tpu import nn
 from paddle_tpu.jit import to_static
+from paddle_tpu.core.tensor import Tensor as _T
 from paddle_tpu.jit.dy2static import (Dy2StaticError, convert_to_static)
 
 
@@ -179,6 +180,142 @@ class TestLayerForward:
         out = sf(paddle.to_tensor(x))
         assert tuple(out.shape) == (2, 4)
         assert np.isfinite(np.asarray(out.value)).all()
+
+
+class TestConvertCallRecursion:
+    """convert_call recursion (reference program_translator.py): tensor
+    control flow inside CALLEES — sublayers, helper functions, bound
+    methods — converts without manual decoration of each one."""
+
+    def _gate_cls(self):
+        class Gate(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                h = self.fc(x)
+                if h.mean() > 0:  # tensor cond in the SUBLAYER
+                    out = h * 2.0
+                else:
+                    out = h * 0.5
+                return out
+
+        return Gate
+
+    def test_sublayer_tensor_if_converts_through_parent(self):
+        Gate = self._gate_cls()
+
+        class Parent(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.gate = Gate()
+
+            def forward(self, x):
+                return self.gate(x) + 1.0  # only the PARENT is decorated
+
+        net = Parent()
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        eager = np.asarray(net(x).value)
+        # to_static(Layer) compiles the forward under ONE jit — that trace
+        # only succeeds if the sublayer's tensor-if became lax.cond (an
+        # unconverted sublayer raises TracerBoolConversionError here)
+        sf = to_static(net)
+        out = np.asarray(sf(x).value)
+        np.testing.assert_allclose(out, eager, rtol=1e-6)
+
+    def test_helper_function_tensor_while_converts(self):
+        def clamp_norm(v):
+            n = paddle.sum(v * v)
+            while n > 4.0:  # tensor cond in a plain HELPER function
+                v = v * 0.5
+                n = paddle.sum(v * v)
+            return v
+
+        @to_static
+        def run(x):
+            return clamp_norm(x * 3.0)
+
+        # StaticFunction compiles under ONE jit: the helper's tensor-while
+        # must become lax.while_loop during that trace or this raises
+        x = paddle.to_tensor(np.ones((4,), np.float32))
+        out = np.asarray(run(x).value)
+        assert float(np.sum(out * out)) <= 4.0
+
+    def test_bound_method_converts(self):
+        class Helper:
+            def pick(self, x):
+                if x.sum() > 0:
+                    out = x + 10.0
+                else:
+                    out = x - 10.0
+                return out
+
+        h = Helper()
+
+        @to_static
+        def run(x):
+            return h.pick(x)
+
+        pos = run(paddle.to_tensor(np.ones((3,), np.float32)))
+        np.testing.assert_allclose(np.asarray(pos.value), 11.0)
+
+    def test_zero_arg_super_callee_untouched(self):
+        # __class__-cell users without control flow must NOT be recompiled
+        # (an AST recompile cannot reproduce the compiler's super() cell)
+        class Base(nn.Layer):
+            def forward(self, x):
+                return x * 2.0
+
+        class Child(Base):
+            def forward(self, x):
+                return super().forward(x) + 1.0  # no tensor control flow
+
+        class Top(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.child = Child()
+
+            def forward(self, x):
+                return self.child(x)
+
+        net = Top()
+        sf = to_static(net)
+        out = sf(paddle.to_tensor(np.ones((2,), np.float32)))
+        np.testing.assert_allclose(np.asarray(out.value), 3.0)
+
+    def test_library_layers_not_rebound(self):
+        # convert_call must leave paddle_tpu's own layers alone: no
+        # per-instance forward rebinding, no recompiled library code
+        class Wrap(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                h = self.fc(x)
+                if h.mean() > 0:
+                    out = h * 2.0
+                else:
+                    out = h * 0.5
+                return out
+
+        net = Wrap()
+        sf = to_static(net)
+        sf(paddle.to_tensor(np.ones((2, 4), np.float32)))
+        assert "forward" not in net.fc.__dict__, (
+            "library Linear instance got a rebound forward")
+
+    def test_library_calls_pass_through(self):
+        def jnp_free(x):  # user helper without control flow still works
+            return x * 2.0
+
+        @to_static
+        def run(x):
+            return paddle.sum(jnp_free(x))
+
+        out = run(paddle.to_tensor(np.ones((3,), np.float32)))
+        assert float(out.value) == 6.0
 
 
 def test_for_range_python_bounds_unchanged():
