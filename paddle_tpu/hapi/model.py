@@ -1,10 +1,14 @@
 """``paddle.Model`` — fit/evaluate/predict over a Layer.
 
 Reference capability: python/paddle/hapi/model.py:878 ``Model`` (prepare
-:1450, fit :1523) with its dual static/dynamic adapters.  TPU-native: ONE
-adapter — every train step is the jitted whole-step program
-(jit.TrainStep), which is what the reference's StaticGraphAdapter existed to
-approximate; eval/predict run the Layer eagerly (XLA still jits per-op).
+:1450, fit :1523) with its DUAL adapters: DynamicGraphAdapter in dygraph
+and StaticGraphAdapter (:249) under ``paddle.enable_static()``.  Both
+exist here: the dynamic path is the jitted whole-step program
+(jit.TrainStep — what the reference's static adapter approximated), and
+:class:`_StaticGraphAdapter` routes prepare/fit/evaluate/predict through
+``paddle.static`` Program + Executor when static mode is active at
+``prepare`` time — train/eval/predict Programs are recorded lazily from
+the first batch's shapes and replayed by one Executor.
 """
 from __future__ import annotations
 
@@ -60,6 +64,105 @@ def _metric_logs(m, prefix: str = "") -> dict:
     return {prefix + n: float(v) for n, v in zip(names, vals)}
 
 
+class _StaticGraphAdapter:
+    """Model backend under ``paddle.enable_static()`` (reference
+    hapi/model.py:249): records train (forward + loss + minimize) and eval
+    (forward + loss, layers in eval mode) Programs from the first batch's
+    shapes/dtypes and replays them with one Executor."""
+
+    def __init__(self, model: "Model"):
+        self.model = model
+        self._exe = None
+        self._progs: dict = {}
+
+    def _spec(self, arr):
+        arr = np.asarray(arr)
+        return [None] + list(arr.shape[1:]), str(arr.dtype)
+
+    def _feed(self, xs, yb=None):
+        d = {f"x{i}": np.asarray(a) for i, a in enumerate(xs)}
+        if yb is not None:
+            d["y"] = np.asarray(yb)
+        return d
+
+    def _build(self, xs, yb):
+        from .. import static
+
+        net, loss_fn = self.model.network, self.model._loss
+        opt = self.model._optimizer
+
+        def data_vars():
+            return [static.data(f"x{i}", *self._spec(a))
+                    for i, a in enumerate(xs)]
+
+        startup = static.Program()
+        main = static.Program()
+        with static.program_guard(main, startup):
+            xv = data_vars()
+            y = static.data("y", *self._spec(yb))
+            out = net(*xv)
+            loss = loss_fn(out, y) if loss_fn is not None else None
+            if opt is not None and loss is not None:
+                opt.minimize(loss)
+        self._progs["train"] = (main, loss, out)
+
+        eval_prog = static.Program()
+        modes = [(l, l.training) for l in net.sublayers(include_self=True)]
+        net.eval()
+        try:
+            with static.program_guard(eval_prog, static.Program()):
+                xv = data_vars()
+                ye = static.data("y", *self._spec(yb))
+                oute = net(*xv)
+                losse = loss_fn(oute, ye) if loss_fn is not None else None
+            pred_prog = static.Program()
+            with static.program_guard(pred_prog, static.Program()):
+                outp = net(*data_vars())
+        finally:
+            for l, t in modes:
+                l.training = t
+        self._progs["eval"] = (eval_prog, losse, oute)
+        self._progs["predict"] = (pred_prog, None, outp)
+
+        self._exe = static.Executor()
+        self._exe.run(startup)
+
+    def train_batch(self, xs, yb):
+        if self.model._optimizer is None or self.model._loss is None:
+            # mirroring the dygraph assert: a "train" step that cannot
+            # update parameters must not pretend to succeed
+            raise RuntimeError(
+                "static-mode training needs prepare(optimizer=..., "
+                "loss=...)")
+        if self._exe is None:
+            self._build(xs, yb)
+        main, loss, out = self._progs["train"]
+        lv, ov = self._exe.run(main, feed=self._feed(xs, yb),
+                               fetch_list=[loss, out])
+        return float(lv), ov
+
+    def eval_batch(self, xs, yb):
+        if self._exe is None:
+            self._build(xs, yb)
+        prog, loss, out = self._progs["eval"]
+        fetch = [out] if loss is None else [loss, out]
+        res = self._exe.run(prog, feed=self._feed(xs, yb),
+                            fetch_list=fetch)
+        if loss is None:
+            return None, res[0]
+        return float(res[0]), res[1]
+
+    def predict_batch(self, xs):
+        if self._exe is None:
+            raise RuntimeError(
+                "static-mode predict needs one train/eval batch first (the "
+                "Programs are recorded from batch shapes) — or call "
+                "Model.fit/evaluate before predict")
+        prog, _, out = self._progs["predict"]
+        ov, = self._exe.run(prog, feed=self._feed(xs), fetch_list=[out])
+        return ov
+
+
 class Model:
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
@@ -67,13 +170,20 @@ class Model:
         self._loss = None
         self._metrics: Sequence = ()
         self._train_step: TrainStep | None = None
+        self._adapter: _StaticGraphAdapter | None = None
         self._stop_training = False
 
     def prepare(self, optimizer=None, loss=None, metrics=None):
+        import paddle_tpu as paddle
+
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = metrics if isinstance(metrics, (list, tuple)) else (
             [metrics] if metrics else [])
+        if not paddle.in_dynamic_mode():
+            # reference dual-backend dispatch (hapi/model.py:249)
+            self._adapter = _StaticGraphAdapter(self)
+            return self
         if optimizer is not None and loss is not None:
             # metrics stream from the SAME jitted forward's outputs
             # (reference fit computes train metrics per batch)
@@ -81,11 +191,24 @@ class Model:
                                          return_outputs=bool(self._metrics))
         return self
 
+    def _run_train_batch(self, batch):
+        """One optimizer step through the active backend; returns
+        (loss_float, outputs_for_metrics_or_None)."""
+        if self._adapter is not None:
+            *xs, y = batch
+            lv, ov = self._adapter.train_batch(xs, y)
+            out = Tensor(np.asarray(ov), stop_gradient=True) \
+                if self._metrics else None
+            return lv, out
+        loss = self._train_step(*batch)
+        return float(loss.numpy()), self._train_step.last_outputs
+
     # -- train ---------------------------------------------------------------
     def fit(self, train_data=None, eval_data=None, batch_size=32, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=1,
             shuffle=True, callbacks=None):
-        assert self._train_step is not None, "call prepare(optimizer, loss)"
+        assert self._train_step is not None or self._adapter is not None, \
+            "call prepare(optimizer, loss)"
         cbs = list(callbacks or [])
         if verbose and not any(isinstance(c, ProgBarLogger) for c in cbs):
             cbs.insert(0, ProgBarLogger(log_freq, verbose))
@@ -101,13 +224,14 @@ class Model:
             for m in self._metrics:
                 m.reset()
             losses = []
+            saw_outputs = False
             for step, batch in enumerate(
                     _to_batches(train_data, batch_size, shuffle, seed=epoch)):
-                loss = self._train_step(*batch)
-                losses.append(float(loss.numpy()))
+                loss_val, out = self._run_train_batch(batch)
+                losses.append(loss_val)
                 logs = {"loss": losses[-1]}
-                out = self._train_step.last_outputs
                 if out is not None:
+                    saw_outputs = True
                     y = batch[-1]
                     yt = y if isinstance(y, Tensor) else Tensor(
                         np.asarray(y), stop_gradient=True)
@@ -119,7 +243,7 @@ class Model:
                 for c in cbs:
                     c.on_train_batch_end(step, logs)
             epoch_logs = {"loss": float(np.mean(losses)) if losses else 0.0}
-            if self._train_step.last_outputs is not None:
+            if saw_outputs:
                 for m in self._metrics:
                     epoch_logs.update(_metric_logs(m, prefix="train_"))
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
@@ -140,21 +264,32 @@ class Model:
 
     # -- eval / predict ------------------------------------------------------
     def evaluate(self, eval_data, batch_size=32, log_freq=10, verbose=1):
-        self.network.eval()
         for m in self._metrics:
             m.reset()
         losses = []
-        try:
+        if self._adapter is not None:
             for batch in _to_batches(eval_data, batch_size):
                 *xs, y = batch
-                out = self.network(*[Tensor(np.asarray(x), True) for x in xs])
-                if self._loss is not None:
-                    losses.append(float(
-                        self._loss(out, Tensor(np.asarray(y), True)).numpy()))
+                lv, ov = self._adapter.eval_batch(xs, y)
+                if lv is not None:
+                    losses.append(lv)
+                out = Tensor(np.asarray(ov), stop_gradient=True)
                 for m in self._metrics:
                     _metric_update(m, out, Tensor(np.asarray(y), True))
-        finally:
-            self.network.train()
+        else:
+            self.network.eval()
+            try:
+                for batch in _to_batches(eval_data, batch_size):
+                    *xs, y = batch
+                    out = self.network(*[Tensor(np.asarray(x), True)
+                                         for x in xs])
+                    if self._loss is not None:
+                        losses.append(float(self._loss(
+                            out, Tensor(np.asarray(y), True)).numpy()))
+                    for m in self._metrics:
+                        _metric_update(m, out, Tensor(np.asarray(y), True))
+            finally:
+                self.network.train()
         logs = {}
         if losses:
             logs["eval_loss"] = float(np.mean(losses))
@@ -163,8 +298,14 @@ class Model:
         return logs
 
     def predict(self, test_data, batch_size=32):
-        self.network.eval()
         outs = []
+        if self._adapter is not None:
+            for batch in _to_batches(test_data, batch_size):
+                xs = (list(batch[:-1]) or list(batch)) \
+                    if isinstance(batch, (tuple, list)) else [batch]
+                outs.append(np.asarray(self._adapter.predict_batch(xs)))
+            return outs
+        self.network.eval()
         try:
             for batch in _to_batches(test_data, batch_size):
                 if isinstance(batch, (tuple, list)):
@@ -178,6 +319,11 @@ class Model:
         return outs
 
     def train_batch(self, inputs, labels):
+        if self._adapter is not None:
+            xs = list(inputs) if isinstance(inputs, (list, tuple)) \
+                else [inputs]
+            lv, _ = self._adapter.train_batch(xs, labels)
+            return [lv]
         assert self._train_step is not None
         loss = self._train_step(*(list(np.atleast_1d(inputs))
                                   if isinstance(inputs, (list, tuple))
@@ -207,6 +353,23 @@ class Model:
         one batch without a parameter update, in eval mode.  Returns
         ``[losses]`` or ``([losses], [metric accumulations])`` when metrics
         are prepared — the reference adapter's contract."""
+        if self._adapter is not None and labels is not None:
+            xs = list(inputs) if isinstance(inputs, (list, tuple)) \
+                else [inputs]
+            lv, ov = self._adapter.eval_batch(xs, labels)
+            out = Tensor(np.asarray(ov), stop_gradient=True)
+            losses = [] if lv is None else [lv]
+            yt = _as_tensor(labels)
+            for m in self._metrics:
+                _metric_update(m, out, yt)
+            if self._metrics:
+                metric_vals = []
+                for m in self._metrics:
+                    v = m.accumulate()
+                    metric_vals.append(list(v) if isinstance(v, (list, tuple))
+                                       else v)
+                return losses, metric_vals
+            return losses
         out = self._eval_forward(inputs)
         losses = []
         yt = _as_tensor(labels) if labels is not None else None
@@ -227,6 +390,10 @@ class Model:
     def predict_batch(self, inputs):
         """reference Model.predict_batch: forward-only outputs as numpy,
         in eval mode."""
+        if self._adapter is not None:
+            xs = list(inputs) if isinstance(inputs, (list, tuple)) \
+                else [inputs]
+            return [np.asarray(self._adapter.predict_batch(xs))]
         out = self._eval_forward(inputs)
         if isinstance(out, (list, tuple)):
             return [np.asarray(o.value) for o in out]
