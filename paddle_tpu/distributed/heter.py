@@ -12,10 +12,26 @@ value_and_grad), the sparse half is the C++ PS service
 (distributed/ps_service.py + _native/ps_table.cpp).  Unique-ids pull,
 inverse-gather on device, push of merged row grads — the
 pull→compute→push cycle of the reference's HeterCpuWorker::TrainFiles.
+
+Two execution shapes:
+* :meth:`HeterTrainer.train_step` — synchronous pull→compute→push;
+* :meth:`HeterTrainer.train_stream` — the pull of batch N+1 runs on a
+  prefetch thread WHILE the device computes batch N (the reference
+  HeterCpuWorker's pipelined data/pull queues), hiding PS round-trip
+  latency behind device time.  Rows pulled one step early are one push
+  stale — the reference's async-pipeline semantics.
+
+Fault tolerance: transient server loss (crash/restart) is retried — the
+client reconnects, re-creates the table on the fresh server, reloads the
+last snapshot when ``snapshot_dir`` is set, and repeats the op (the
+reference PS-client's retry/reregister path).
 """
 from __future__ import annotations
 
-from typing import Callable
+import queue as _queue
+import threading
+import time
+from typing import Callable, Iterable
 
 import jax
 import jax.numpy as jnp
@@ -32,11 +48,16 @@ class HeterTrainer:
     jits loss+grads over (params, embeds) together, pushes the row grads to
     the PS (server-side adagrad), and applies ``optimizer`` to the dense
     params locally.
-    """
+
+    ``vocab`` (+ optional ``snapshot_dir``) arms the recovery path: when a
+    server dies and comes back empty, the table is re-created (and the
+    snapshot reloaded) before the failed op is retried."""
 
     def __init__(self, client, table_id: int, dim: int,
                  dense_params, dense_apply: Callable, optimizer,
-                 sparse_lr: float = 0.05):
+                 sparse_lr: float = 0.05, vocab: int | None = None,
+                 snapshot_dir: str | None = None, max_retries: int = 3,
+                 retry_interval: float = 0.5):
         self.client = client
         self.tid = table_id
         self.dim = dim
@@ -44,6 +65,10 @@ class HeterTrainer:
         self.opt = optimizer
         self.opt_state = optimizer.init_state(dense_params)
         self.sparse_lr = sparse_lr
+        self.vocab = vocab
+        self.snapshot_dir = snapshot_dir
+        self.max_retries = max_retries
+        self.retry_interval = retry_interval
         self._step = 0
 
         def _loss(params, embeds, batch):
@@ -54,8 +79,42 @@ class HeterTrainer:
             lambda g, p, s, lr, step: optimizer.apply_gradients(
                 g, p, s, lr=lr, step=step))
 
-    def train_step(self, ids: np.ndarray, batch) -> float:
-        """ids: int64 [B, S] sparse feature ids for this batch."""
+    # -- fault tolerance -----------------------------------------------------
+    def _recover(self):
+        """Reconnect + re-provision restarted (empty) servers.  Snapshots
+        are restored ONLY onto shards whose table was just re-created — a
+        blanket load would roll healthy shards back to the snapshot while
+        the dense params kept their newer state.  A fresh shard with no
+        usable snapshot keeps its random re-init (bounded loss on that
+        shard's rows; training continues)."""
+        self.client.reset_connections()
+        if self.vocab is not None:
+            fresh = self.client.create_table(self.tid, self.vocab, self.dim)
+            if self.snapshot_dir is not None:
+                for s, was_fresh in fresh.items():
+                    if not was_fresh:
+                        continue
+                    try:
+                        self.client.load_shard(s, self.snapshot_dir)
+                    except (RuntimeError, ConnectionError, OSError):
+                        pass  # no snapshot yet: keep the fresh init
+
+    def _with_recovery(self, fn):
+        for attempt in range(self.max_retries + 1):
+            try:
+                return fn()
+            except (RuntimeError, ConnectionError, OSError):
+                if attempt == self.max_retries:
+                    raise
+                time.sleep(self.retry_interval * (attempt + 1))
+                try:
+                    self._recover()
+                except (RuntimeError, ConnectionError, OSError):
+                    continue  # server still down: next attempt re-tries
+
+    # -- the two step phases -------------------------------------------------
+    def _prepare(self, ids: np.ndarray):
+        """Host/PS half: unique + pad + pull (safe on a prefetch thread)."""
         ids = np.asarray(ids, np.int64)
         uniq, inv = np.unique(ids.reshape(-1), return_inverse=True)
         # pad unique count to the next power of two so the jitted dense
@@ -66,20 +125,84 @@ class HeterTrainer:
         if pad_to != len(uniq):
             uniq = np.concatenate(
                 [uniq, np.full(pad_to - len(uniq), uniq[0], np.int64)])
-        # 1. pull unique rows from the PS shards
-        rows = self.client.pull_sparse(self.tid, uniq)
+        rows = self._with_recovery(
+            lambda: self.client.pull_sparse(self.tid, uniq))
         embeds = jnp.asarray(rows.reshape(len(uniq), self.dim))
-        # 2. one fused device program: dense fwd + bwd wrt params AND rows
-        inv_dev = jnp.asarray(inv.reshape(ids.shape))
+        return uniq, inv.reshape(ids.shape), embeds
+
+    def _push(self, uniq: np.ndarray, ge: np.ndarray):
+        """Per-SHARD pushes, each with its own retry: a whole-fan retry
+        would re-apply grads on shards that already succeeded (adagrad is
+        not idempotent — double update + inflated accumulator)."""
+        grads = np.asarray(ge)
+        srv = uniq % self.client.S
+        local = uniq // self.client.S
+        for s in range(self.client.S):
+            m = srv == s
+            if not m.any():
+                continue
+            self._with_recovery(
+                lambda s=s, i=local[m], g=grads[m]:
+                self.client.push_sparse_shard(s, self.tid, i, g,
+                                              lr=self.sparse_lr))
+
+    def _compute_push_apply(self, prepared, batch) -> float:
+        """Device half + push: one fused grad program, then PS push and the
+        local dense update."""
+        uniq, inv, embeds = prepared
         loss, (gp, ge) = self._vg(self.params, embeds,
-                                  dict(batch, _inv=inv_dev))
-        # 3. push row grads (server applies its adagrad update)
-        self.client.push_sparse(self.tid, uniq, np.asarray(ge),
-                                lr=self.sparse_lr)
-        # 4. local dense update
+                                  dict(batch, _inv=jnp.asarray(inv)))
+        self._push(uniq, ge)
         self._step += 1
         self.params, self.opt_state = self._apply(
             gp, self.params, self.opt_state,
             jnp.asarray(self.opt.get_lr(), jnp.float32),
             jnp.asarray(self._step, jnp.int32))
         return float(loss)
+
+    # -- public API ----------------------------------------------------------
+    def train_step(self, ids: np.ndarray, batch) -> float:
+        """ids: int64 [B, S] sparse feature ids for this batch."""
+        return self._compute_push_apply(self._prepare(ids), batch)
+
+    def train_stream(self, batches: Iterable, prefetch: int = 2):
+        """Pipelined epoch over ``(ids, batch)`` pairs: a prefetch thread
+        pulls batch N+1's rows while the device computes batch N (the
+        reference HeterCpuWorker pipeline).  Yields each step's loss."""
+        q: _queue.Queue = _queue.Queue(maxsize=max(1, prefetch))
+        stop = threading.Event()
+
+        def feeder():
+            try:
+                for ids, batch in batches:
+                    prepared = self._prepare(ids)
+                    while not stop.is_set():
+                        try:
+                            q.put((prepared, batch), timeout=0.2)
+                            break
+                        except _queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+                q.put(None)
+            except BaseException as e:  # surfaced at the consumer
+                q.put(e)
+
+        t = threading.Thread(target=feeder, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is None:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                prepared, batch = item
+                yield self._compute_push_apply(prepared, batch)
+        finally:
+            stop.set()
+            while True:  # unblock a feeder stuck on a full queue
+                try:
+                    q.get_nowait()
+                except _queue.Empty:
+                    break

@@ -143,9 +143,11 @@ class PSServer:
             if op == "create":
                 tid = meta["tid"]
                 storage = meta.get("storage", "mem")
+                fresh = False
                 with self._tables_lock:  # concurrent creates must not
                     # race the check-then-insert (handle leak + lost pushes)
                     if tid not in self._tables:
+                        fresh = True
                         rows = self._local_rows(meta["vocab"])
                         seed = meta.get("seed", 0) * 1000 + self.server_idx
                         rng = meta.get("init_range", 0.05)
@@ -173,7 +175,10 @@ class PSServer:
                                              "dim": meta["dim"],
                                              "vocab": meta["vocab"],
                                              "storage": storage}
-                return _pack("create", {"ok": True}, {})
+                # fresh=True means THIS server just created (randomly
+                # initialized) the shard — recovery paths use it to restore
+                # a snapshot onto exactly the restarted servers
+                return _pack("create", {"ok": True, "fresh": fresh}, {})
             if op == "pull":
                 t = self._tables[meta["tid"]]
                 ids = np.ascontiguousarray(arrays["ids"], np.int64)
@@ -406,13 +411,36 @@ class PSClient:
 
     def _rpc(self, s: int, op: str, meta: dict, arrays: dict):
         with self._locks[s]:
-            sk = self._sock(s)
-            _send_frame(sk, _pack(op, meta, arrays))
-            rop, rmeta, rarr = _unpack(_recv_frame(sk))
+            try:
+                sk = self._sock(s)
+                _send_frame(sk, _pack(op, meta, arrays))
+                rop, rmeta, rarr = _unpack(_recv_frame(sk))
+            except (ConnectionError, OSError, EOFError):
+                # a dead/restarted server leaves the cached socket broken —
+                # drop it so the next call dials fresh (heter recovery path)
+                try:
+                    if self._socks[s] is not None:
+                        self._socks[s].close()
+                except OSError:
+                    pass
+                self._socks[s] = None
+                raise
         if not rmeta.get("ok", False):
             raise RuntimeError(f"PS {op} on server {s} failed: "
                                f"{rmeta.get('err', rmeta)}")
         return rmeta, rarr
+
+    def reset_connections(self):
+        """Drop every cached socket; subsequent RPCs reconnect (used by
+        recovery paths after a server restart)."""
+        for s in range(self.S):
+            with self._locks[s]:
+                if self._socks[s] is not None:
+                    try:
+                        self._socks[s].close()
+                    except OSError:
+                        pass
+                    self._socks[s] = None
 
     def _fan(self, op: str, metas, arrays_by_s):
         futs = {s: self._pool.submit(self._rpc, s, op, metas[s],
@@ -424,10 +452,27 @@ class PSClient:
     def create_table(self, tid: int, vocab: int, dim: int, seed: int = 0,
                      init_range: float = 0.05, storage: str = "mem"):
         """storage="ssd" puts the shard in an mmap'd file on the server
-        (SSDSparseTable role; the server needs ssd_dir)."""
+        (SSDSparseTable role; the server needs ssd_dir).  Returns
+        {server -> fresh}: True where the shard was just created (used by
+        recovery to reload snapshots onto restarted servers ONLY)."""
         meta = {"tid": tid, "vocab": vocab, "dim": dim, "seed": seed,
                 "init_range": init_range, "storage": storage}
-        self._fan("create", [meta] * self.S, [{}] * self.S)
+        out = self._fan("create", [meta] * self.S, [{}] * self.S)
+        return {s: bool(out[s][0].get("fresh", False)) for s in range(self.S)}
+
+    def load_shard(self, s: int, dirname: str):
+        """Restore ONE server's tables from a snapshot dir (recovery path —
+        a plain load() would roll healthy shards back too)."""
+        self._rpc(s, "load", {"dir": dirname}, {})
+
+    def push_sparse_shard(self, s: int, tid: int, local_ids: np.ndarray,
+                          grads: np.ndarray, lr: float = 0.05):
+        """Push pre-sharded LOCAL row grads to one server.  Retry loops use
+        this so a shard that already applied its update is never pushed
+        twice (adagrad is not idempotent)."""
+        self._rpc(s, "push", {"tid": tid, "lr": lr},
+                  {"ids": np.asarray(local_ids, np.int64),
+                   "grads": np.asarray(grads, np.float32)})
 
     def push_sparse_delta(self, tid: int, ids: np.ndarray,
                           deltas: np.ndarray):
