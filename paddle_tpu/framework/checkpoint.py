@@ -101,14 +101,24 @@ def save_sharded(tree: Any, ckpt_dir: str, step: int):
 _STEP_DIR = re.compile(r"step_(\d+)")
 
 
-def latest_step(ckpt_dir: str) -> int | None:
+def available_steps(ckpt_dir: str) -> list[int]:
+    """All checkpoint steps with a finalized manifest, ascending.  A step
+    listed here may still be TORN (a peer crashed between writing its
+    chunks and the finalizer's manifest — the multi-host save is
+    best-effort): loaders that must survive crashes walk this list newest
+    → oldest (AutoCheckpoint.resume)."""
     if not os.path.isdir(ckpt_dir):
-        return None
+        return []
     # strict match: transient multi-host 'step_N.tmpP' dirs must not parse
-    steps = [int(m.group(1)) for d in os.listdir(ckpt_dir)
-             if (m := _STEP_DIR.fullmatch(d))
-             and os.path.exists(os.path.join(ckpt_dir, d, "manifest.json"))]
-    return max(steps) if steps else None
+    return sorted(int(m.group(1)) for d in os.listdir(ckpt_dir)
+                  if (m := _STEP_DIR.fullmatch(d))
+                  and os.path.exists(os.path.join(ckpt_dir, d,
+                                                  "manifest.json")))
+
+
+def latest_step(ckpt_dir: str) -> int | None:
+    steps = available_steps(ckpt_dir)
+    return steps[-1] if steps else None
 
 
 def load_sharded(ckpt_dir: str, step: int, target: Any):
@@ -206,12 +216,30 @@ class AutoCheckpoint:
         self.keep_max = keep_max
 
     def resume(self, target):
-        """Returns (state, step): the latest checkpoint restored into
-        target's shardings, or (target, 0) if none exists."""
-        s = latest_step(self.dir)
-        if s is None:
-            return target, 0
-        return load_sharded(self.dir, s, target), s
+        """Returns (state, step): the newest LOADABLE checkpoint restored
+        into target's shardings, or (target, 0) if none exists.  Torn
+        checkpoints — a rank crashed after the manifest was finalized but
+        before its own chunks landed — are skipped in favor of the next
+        older one (the reference auto_checkpoint's crash-resume
+        guarantee)."""
+        import json as _json
+        import warnings
+
+        for s in reversed(available_steps(self.dir)):
+            try:
+                return load_sharded(self.dir, s, target), s
+            except (OSError, _json.JSONDecodeError) as e:
+                torn = e  # missing/partial files: a crash mid-save
+            except ValueError as e:
+                if "chunks cover only" not in str(e):
+                    raise  # structural/shape mismatch: a real error, not a
+                    # torn snapshot — silently discarding checkpoints here
+                    # would lose data
+                torn = e
+            warnings.warn(
+                f"checkpoint step_{s} in {self.dir} is torn "
+                f"({torn!r}); falling back to an older one")
+        return target, 0
 
     def maybe_save(self, state, step: int):
         if step % self.every:
