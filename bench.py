@@ -47,34 +47,39 @@ def _log(msg):
 
 def _probe_backend(timeout=240, attempts=2):
     """Initialize the jax backend in a subprocess so a tunnel hang cannot
-    take down the bench process. Returns device info dict or None."""
-    # enumerate AND compute: a wedged tunnel can list devices yet hang the
-    # first executable, so the probe must exercise a real compile+run
-    code = ("import jax, json; import jax.numpy as jnp; d = jax.devices()[0];"
-            " x = (jnp.ones((128, 128)) @ jnp.ones((128, 128)));"
-            " x.block_until_ready();"
-            " print(json.dumps({'platform': d.platform, "
-            "'kind': getattr(d, 'device_kind', '')}))")
+    take down the bench process. Returns device info dict or None.  Every
+    attempt is appended to tpu_probe_log.json (tools/probe_tpu.py), so a
+    CPU-fallback bench line carries timestamped infra evidence."""
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                    "tools"))
     for i in range(attempts):
         try:
-            t0 = time.perf_counter()
-            out = subprocess.run([sys.executable, "-c", code],
-                                 capture_output=True, text=True,
-                                 timeout=timeout)
-            dt = time.perf_counter() - t0
-            if out.returncode == 0 and out.stdout.strip():
-                info = json.loads(out.stdout.strip().splitlines()[-1])
-                _log(f"[bench] backend probe ok in {dt:.0f}s: {info}")
-                return info
-            _log(f"[bench] backend probe attempt {i + 1} failed rc="
-                 f"{out.returncode}: {out.stderr.strip()[-500:]}")
-        except subprocess.TimeoutExpired:
-            _log(f"[bench] backend probe attempt {i + 1} timed out "
-                 f"after {timeout}s")
-        except Exception as e:  # noqa: BLE001 - diagnostics only
+            from probe_tpu import probe as _tp_probe
+
+            entry = _tp_probe(timeout, source=f"bench attempt {i + 1}")
+        except Exception as e:  # noqa: BLE001 - the probe must NEVER kill
+            # the bench (this fallback path exists to always emit JSON)
             _log(f"[bench] backend probe attempt {i + 1} error: {e!r}")
+            time.sleep(5)
+            continue
+        if entry["ok"]:
+            _log(f"[bench] backend probe ok in {entry['elapsed_s']}s: "
+                 f"{entry['detail']}")
+            return entry["detail"]
+        _log(f"[bench] backend probe attempt {i + 1} failed: "
+             f"{entry['detail']}")
         time.sleep(5)
     return None
+
+
+def _probe_evidence(n=12):
+    """Last n probe-log entries — attached to fallback bench JSON."""
+    try:
+        from probe_tpu import read_log
+
+        return read_log(n)
+    except Exception:  # noqa: BLE001 - evidence is best-effort
+        return []
 
 
 def _peak_flops(dev) -> float:
@@ -504,6 +509,9 @@ def main():
     if cpu_fallback:
         line["metric"] += "_cpu_fallback"
         line["vs_baseline"] = 0.0
+        # the missing TPU number must be ATTRIBUTABLE: timestamped probe
+        # outcomes (every failed enumeration/compile) ride along
+        line["probe_evidence"] = _probe_evidence()
     print(json.dumps(line), flush=True)
 
 

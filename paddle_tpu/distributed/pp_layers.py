@@ -39,6 +39,26 @@ Two schedules, matching the reference SectionWorker's ``schedule_mode``
 The flagship GPT path (text/gpt_hybrid.py) keeps its hand-built
 Megatron-aware 1F1B; this module generalizes the same schedule to
 *arbitrary Layer lists* (ResNet, BERT, mixed conv/fc models).
+
+Cost model for heterogeneous stages (this module's whole point — and its
+price).  XLA SPMD compiles ONE program for every device, so per-stage
+differences become padding, not divergence:
+
+* **weights**: each stage's params flatten into one f32 vector padded to
+  the LARGEST stage's size ``Lp`` — per-device weight memory is
+  ``max_s |params_s|``, not ``|params_s|``.  ``seg_method="parameters"``
+  exists to balance exactly this.
+* **boundary activations**: every ppermute hop carries the LARGEST
+  boundary's flat size ``A = max_s |x_s|`` — a conv stack whose early
+  feature maps are 10x its late ones pays the early size on every hop.
+* **compute**: a ``lax.switch`` runs only the selected branch — stage
+  FLOPs do NOT pad up; per-tick wall-clock is the SLOWEST stage (ordinary
+  pipeline balance, same as the reference's per-process stages).
+
+So padding hurts memory/bandwidth, never FLOPs.  When stage sizes are
+badly skewed, rebalance with ``seg_method="parameters"`` or hand-place
+cuts; ``PipelineTrainStep.padding_report()`` quantifies the current waste
+(tests/test_pp_layers.py exercises a 16x-skewed stack against it).
 """
 from __future__ import annotations
 
@@ -373,6 +393,9 @@ class PipelineTrainStep:
         out_meta = _meta_of(x_abs)  # last stage's output (loss head input)
         A = max([m.size for m in x_meta if m is not None] + [out_meta.size],
                 default=1) or 1
+        self._x_metas = x_meta
+        self._out_meta = out_meta
+        self._A = A
 
         # ---- per-stage switch branches (uniform signature; flags pick the
         # outputs so all three uses share one stage-application body):
@@ -628,6 +651,29 @@ class PipelineTrainStep:
         self._opt_state = jax.jit(optimizer.init_state)(self._params)
         self._data_sharding = NamedSharding(mesh, data_spec)
         self._compiled = jax.jit(step_fn, donate_argnums=(0, 1, 2))
+
+    def padding_report(self) -> dict:
+        """Quantify the heterogeneous-stage padding cost (see the module
+        docstring's cost model): per-stage real parameter/boundary sizes
+        vs the padded sizes every device actually pays.
+
+        Returns {"param_sizes", "param_padded", "param_waste_frac",
+        "boundary_sizes", "boundary_padded", "boundary_waste_frac"}."""
+        p_sizes = [m.size for m in self._pmetas]
+        # every real ppermute hop: the inter-stage boundaries AND the last
+        # stage's output (it rides the same padded buffer)
+        b_sizes = [m.size for m in self._x_metas if m is not None] \
+            + [self._out_meta.size]
+        Lp = max(p_sizes) or 1
+        A = self._A
+        n = len(p_sizes)
+        p_waste = 1.0 - sum(p_sizes) / (n * Lp)
+        b_waste = (1.0 - sum(b_sizes) / (len(b_sizes) * A)) if b_sizes \
+            else 0.0
+        return {"param_sizes": p_sizes, "param_padded": Lp,
+                "param_waste_frac": p_waste,
+                "boundary_sizes": b_sizes, "boundary_padded": A,
+                "boundary_waste_frac": b_waste}
 
     def _current_lr(self):
         from ..optimizer.lr import LRScheduler

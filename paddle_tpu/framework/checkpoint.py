@@ -121,6 +121,45 @@ def latest_step(ckpt_dir: str) -> int | None:
     return steps[-1] if steps else None
 
 
+def _merged_manifest(d: str) -> dict:
+    with open(os.path.join(d, "manifest.json")) as f:
+        manifest = json.load(f)
+    for fn in os.listdir(d):
+        if fn.startswith("manifest.") and fn != "manifest.json":
+            with open(os.path.join(d, fn)) as f:
+                part = json.load(f)
+            for name, meta in part["leaves"].items():
+                manifest["leaves"].setdefault(name, meta)
+                manifest["leaves"][name]["chunks"].update(meta["chunks"])
+    return manifest
+
+
+def verify_step(ckpt_dir: str, step: int) -> bool:
+    """GLOBAL completeness check of one checkpoint, independent of this
+    host's shardings — every host computes the same verdict from the same
+    files, so multi-host resume agrees on the step (per-host hole checks
+    would let ranks resume from DIFFERENT steps after a torn save).
+
+    Sound for this module's save format: chunks are the disjoint
+    replica-0 shard blocks, so full coverage == every listed chunk file
+    present and the element counts summing to the leaf's size."""
+    d = os.path.join(ckpt_dir, f"step_{step}")
+    try:
+        manifest = _merged_manifest(d)
+    except (OSError, json.JSONDecodeError):
+        return False
+    for name, meta in manifest["leaves"].items():
+        total = int(np.prod(meta["shape"])) if meta["shape"] else 1
+        got = 0
+        for cid, cm in meta["chunks"].items():
+            if not os.path.exists(os.path.join(d, f"{name}.c{cid}.npy")):
+                return False
+            got += int(np.prod(cm["shape"])) if cm["shape"] else 1
+        if got != total:
+            return False
+    return True
+
+
 def load_sharded(ckpt_dir: str, step: int, target: Any):
     """Rebuild the checkpoint into ``target``'s tree structure + shardings.
 
@@ -130,17 +169,9 @@ def load_sharded(ckpt_dir: str, step: int, target: Any):
     import jax
 
     d = os.path.join(ckpt_dir, f"step_{step}")
-    with open(os.path.join(d, "manifest.json")) as f:
-        manifest = json.load(f)
     # multi-host saves: union every per-process manifest's chunk lists so a
     # loader sees ALL shards, not just the finalizing process's own
-    for fn in os.listdir(d):
-        if fn.startswith("manifest.") and fn != "manifest.json":
-            with open(os.path.join(d, fn)) as f:
-                part = json.load(f)
-            for name, meta in part["leaves"].items():
-                manifest["leaves"].setdefault(name, meta)
-                manifest["leaves"][name]["chunks"].update(meta["chunks"])
+    manifest = _merged_manifest(d)
     names, leaves, treedef = _flatten(target)
     out = []
     for name, leaf in zip(names, leaves):
@@ -226,10 +257,20 @@ class AutoCheckpoint:
         import warnings
 
         for s in reversed(available_steps(self.dir)):
+            # GLOBAL completeness first (verify_step): every host reads the
+            # same files and skips the same torn steps, so multi-host
+            # resume agrees on the step — a per-host hole check would let
+            # ranks resume from different steps and deadlock the first
+            # collective
+            if not verify_step(self.dir, s):
+                warnings.warn(
+                    f"checkpoint step_{s} in {self.dir} is torn "
+                    f"(missing chunks); falling back to an older one")
+                continue
             try:
                 return load_sharded(self.dir, s, target), s
             except (OSError, _json.JSONDecodeError) as e:
-                torn = e  # missing/partial files: a crash mid-save
+                torn = e  # raced away under our feet mid-read
             except ValueError as e:
                 if "chunks cover only" not in str(e):
                     raise  # structural/shape mismatch: a real error, not a

@@ -137,6 +137,10 @@ class _StaticGraphAdapter:
         if self._exe is None:
             self._build(xs, yb)
         main, loss, out = self._progs["train"]
+        if not self.model._metrics:  # no metrics: don't materialize outputs
+            lv, = self._exe.run(main, feed=self._feed(xs, yb),
+                                fetch_list=[loss])
+            return float(lv), None
         lv, ov = self._exe.run(main, feed=self._feed(xs, yb),
                                fetch_list=[loss, out])
         return float(lv), ov
@@ -198,7 +202,7 @@ class Model:
             *xs, y = batch
             lv, ov = self._adapter.train_batch(xs, y)
             out = Tensor(np.asarray(ov), stop_gradient=True) \
-                if self._metrics else None
+                if (self._metrics and ov is not None) else None
             return lv, out
         loss = self._train_step(*batch)
         return float(loss.numpy()), self._train_step.last_outputs
@@ -309,7 +313,8 @@ class Model:
         try:
             for batch in _to_batches(test_data, batch_size):
                 if isinstance(batch, (tuple, list)):
-                    xs = list(batch[:1]) if len(batch) > 1 else list(batch)
+                    # all-but-label inputs (multi-input nets get them all)
+                    xs = list(batch[:-1]) if len(batch) > 1 else list(batch)
                 else:  # bare array batch: one positional input
                     xs = [batch]
                 out = self.network(*[Tensor(np.asarray(x), True) for x in xs])

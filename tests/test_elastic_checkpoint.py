@@ -32,9 +32,17 @@ sh = NamedSharding(mesh, P("dp"))
 w = jax.make_array_from_callback(
     (2, 8), sh, lambda idx: np.zeros((2, 8), np.float32)[idx])
 state = {"w": w}
-acp = AutoCheckpoint(ckpt, every_steps=1, keep_max=4)
+acp = AutoCheckpoint(ckpt, every_steps=1, keep_max=6)
 state, start = acp.resume(state)
 print(f"rank {rank} resumed at step {start}", flush=True)
+
+# real training steps carry collectives: when a peer dies, the survivor's
+# next psum fails instead of letting it race ahead solo and pollute the
+# checkpoint dir with rank-partial saves
+from jax import shard_map
+couple = jax.jit(shard_map(lambda v: jax.lax.psum(v, "dp"), mesh=mesh,
+                           in_specs=P("dp"), out_specs=P(),
+                           check_vma=False))
 
 for step in range(start + 1, TOTAL + 1):
     state = {"w": jax.jit(lambda a, s: a + s, out_shardings=sh,
@@ -42,6 +50,7 @@ for step in range(start + 1, TOTAL + 1):
     if rank == 1 and step == 6 and not os.path.exists(marker):
         open(marker, "w").close()
         os.kill(os.getpid(), signal.SIGKILL)  # die BEFORE saving step 6
+    couple(state["w"]).block_until_ready()  # cross-rank coupling
     acp.maybe_save(state, step)
 
 mine = np.asarray(state["w"].addressable_shards[0].data)
@@ -81,9 +90,15 @@ def test_kill_rank_resumes_from_sharded_checkpoint(tmp_path):
         f = tmp_path / f"done.{rank}"
         assert f.exists(), (rank, r.stderr[-2000:])
         assert float(f.read_text()) == float(sum(range(1, 13)))
-    # the relaunched pod really resumed from a checkpoint, not step 0
-    logs = "".join((log_dir / p).read_text()
-                   for p in os.listdir(log_dir))
-    resumes = [int(line.rsplit("step", 1)[1])
-               for line in logs.splitlines() if "resumed at step" in line]
-    assert any(s >= 4 for s in resumes), resumes
+    # the relaunched pod really resumed from a checkpoint, not step 0 —
+    # and BOTH ranks agreed on the step (verify_step's global completeness
+    # check; divergent per-rank resume would deadlock real collectives)
+    per_rank = {}
+    for p in os.listdir(log_dir):
+        rank = int(p.split(".")[1])
+        per_rank[rank] = [int(line.rsplit("step", 1)[1])
+                          for line in (log_dir / p).read_text().splitlines()
+                          if "resumed at step" in line]
+    finals = {r: v[-1] for r, v in per_rank.items() if v}
+    assert len(finals) == 2 and len(set(finals.values())) == 1, per_rank
+    assert next(iter(finals.values())) >= 4, per_rank

@@ -159,6 +159,57 @@ class TestSchedules:
         assert mem_1f1b < mem_fthenb, (mem_1f1b, mem_fthenb)
 
 
+class TestHeterogeneousStageCost:
+    """The documented cost model for size-skewed stages (round-2 Weak #6):
+    padding hits weight memory + hop bandwidth, never correctness; the
+    'parameters' segmenter and padding_report() are the mitigation."""
+
+    def _skewed_descs(self):
+        # stage candidates with ~16x parameter skew: one fat Linear among
+        # thin ones
+        return [LayerDesc(nn.Linear, 8, 8), LayerDesc(nn.ReLU),
+                LayerDesc(nn.Linear, 8, 128), LayerDesc(nn.ReLU),
+                LayerDesc(nn.Linear, 128, 8), LayerDesc(nn.ReLU),
+                LayerDesc(nn.Linear, 8, 4)]
+
+    def test_skewed_stack_trains_and_reports_padding(self):
+        rng = np.random.default_rng(3)
+        X = rng.standard_normal((16, 8)).astype(np.float32)
+        Y = rng.integers(0, 4, 16).astype(np.int64)
+        mesh = mesh_of((2,), ("pp",))
+        pl = PipelineLayer(self._skewed_descs(), num_stages=2)
+        pl.train()
+        step = pl.build_train_step(mesh, Adam(learning_rate=5e-3),
+                                   nn.functional.cross_entropy, n_micro=4,
+                                   example_input=X)
+        losses = [float(step(X, Y).value) for _ in range(10)]
+        assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+        rep = step.padding_report()
+        # the uniform cut puts both big Linears in one stage: real skew,
+        # real padding — the report must expose it
+        assert rep["param_padded"] == max(rep["param_sizes"])
+        assert 0.0 < rep["param_waste_frac"] < 1.0
+        assert rep["boundary_padded"] == max(rep["boundary_sizes"])
+
+    def test_parameter_segmentation_reduces_padding_waste(self):
+        X = np.zeros((8, 8), np.float32)
+        mesh = mesh_of((2,), ("pp",))
+
+        def waste(seg):
+            paddle.seed(0)
+            pl = PipelineLayer(self._skewed_descs(), num_stages=2,
+                               seg_method=seg)
+            step = pl.build_train_step(mesh, Adam(learning_rate=1e-3),
+                                       nn.functional.cross_entropy,
+                                       n_micro=2, example_input=X)
+            return step.padding_report()["param_waste_frac"]
+
+        # balancing cuts by parameter count must not be worse than naive
+        # uniform cuts on a 16x-skewed stack
+        assert waste("parameters") <= waste("uniform") + 1e-6
+
+
 class TestPipelineTransformerShared:
     """Tied-embedding LM stack: SharedLayerDesc provides the embedding at
     stage 0 and the logits head (transpose reuse) at the last stage —
