@@ -109,6 +109,7 @@ if not _LIGHT_IMPORT:
     )
 
     from . import static  # noqa: F401
+    from . import onnx  # noqa: F401
     from . import incubate  # noqa: F401
     from . import callbacks  # noqa: F401
     from . import device  # noqa: F401

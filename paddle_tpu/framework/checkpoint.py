@@ -134,6 +134,25 @@ def _merged_manifest(d: str) -> dict:
     return manifest
 
 
+def _verified_manifest(d: str):
+    """Merged manifest if the step directory is globally complete, else
+    None (see verify_step)."""
+    try:
+        manifest = _merged_manifest(d)
+    except (OSError, json.JSONDecodeError):
+        return None
+    for name, meta in manifest["leaves"].items():
+        total = int(np.prod(meta["shape"])) if meta["shape"] else 1
+        got = 0
+        for cid, cm in meta["chunks"].items():
+            if not os.path.exists(os.path.join(d, f"{name}.c{cid}.npy")):
+                return None
+            got += int(np.prod(cm["shape"])) if cm["shape"] else 1
+        if got != total:
+            return None
+    return manifest
+
+
 def verify_step(ckpt_dir: str, step: int) -> bool:
     """GLOBAL completeness check of one checkpoint, independent of this
     host's shardings — every host computes the same verdict from the same
@@ -143,35 +162,24 @@ def verify_step(ckpt_dir: str, step: int) -> bool:
     Sound for this module's save format: chunks are the disjoint
     replica-0 shard blocks, so full coverage == every listed chunk file
     present and the element counts summing to the leaf's size."""
-    d = os.path.join(ckpt_dir, f"step_{step}")
-    try:
-        manifest = _merged_manifest(d)
-    except (OSError, json.JSONDecodeError):
-        return False
-    for name, meta in manifest["leaves"].items():
-        total = int(np.prod(meta["shape"])) if meta["shape"] else 1
-        got = 0
-        for cid, cm in meta["chunks"].items():
-            if not os.path.exists(os.path.join(d, f"{name}.c{cid}.npy")):
-                return False
-            got += int(np.prod(cm["shape"])) if cm["shape"] else 1
-        if got != total:
-            return False
-    return True
+    return _verified_manifest(
+        os.path.join(ckpt_dir, f"step_{step}")) is not None
 
 
-def load_sharded(ckpt_dir: str, step: int, target: Any):
+def load_sharded(ckpt_dir: str, step: int, target: Any, manifest=None):
     """Rebuild the checkpoint into ``target``'s tree structure + shardings.
 
     target: pytree of jax.Arrays (a freshly-initialized state) OR of
     (ShapeDtypeStruct-with-sharding); each leaf's sharding decides which
-    bytes this host reads."""
+    bytes this host reads.  ``manifest``: a pre-merged manifest (callers
+    that just verified the step pass it to avoid re-parsing)."""
     import jax
 
     d = os.path.join(ckpt_dir, f"step_{step}")
-    # multi-host saves: union every per-process manifest's chunk lists so a
-    # loader sees ALL shards, not just the finalizing process's own
-    manifest = _merged_manifest(d)
+    if manifest is None:
+        # multi-host saves: union every per-process manifest's chunk lists
+        # so a loader sees ALL shards, not just the finalizing process's own
+        manifest = _merged_manifest(d)
     names, leaves, treedef = _flatten(target)
     out = []
     for name, leaf in zip(names, leaves):
@@ -257,18 +265,20 @@ class AutoCheckpoint:
         import warnings
 
         for s in reversed(available_steps(self.dir)):
-            # GLOBAL completeness first (verify_step): every host reads the
-            # same files and skips the same torn steps, so multi-host
-            # resume agrees on the step — a per-host hole check would let
-            # ranks resume from different steps and deadlock the first
-            # collective
-            if not verify_step(self.dir, s):
+            # GLOBAL completeness first: every host reads the same files
+            # and skips the same torn steps, so multi-host resume agrees
+            # on the step — a per-host hole check would let ranks resume
+            # from different steps and deadlock the first collective
+            manifest = _verified_manifest(
+                os.path.join(self.dir, f"step_{s}"))
+            if manifest is None:
                 warnings.warn(
                     f"checkpoint step_{s} in {self.dir} is torn "
                     f"(missing chunks); falling back to an older one")
                 continue
             try:
-                return load_sharded(self.dir, s, target), s
+                return load_sharded(self.dir, s, target,
+                                    manifest=manifest), s
             except (OSError, _json.JSONDecodeError) as e:
                 torn = e  # raced away under our feet mid-read
             except ValueError as e:

@@ -1,33 +1,60 @@
 """ONNX export (reference python/paddle/onnx/export.py → paddle2onnx).
 
-The reference delegates to the external paddle2onnx package; this build's
-portable serialized format is the StableHLO artifact
-(paddle_tpu.inference.save_inference_model — jax.export), which the ONNX
-ecosystem ingests via onnx-mlir/StableHLO converters.  ``export`` writes
-that artifact; direct .onnx emission requires the optional ``onnx`` package
-(not vendored) and raises a clear error without it.
+The reference delegates to the external paddle2onnx package; here the model
+IS a jax function, so export traces it to a jaxpr and emits a REAL .onnx
+protobuf directly (emit.py + wire.py — no onnx/protobuf package needed),
+covering the deploy-relevant op surface (matmul/conv/activations/
+reductions/shape ops).  A StableHLO artifact can be written alongside via
+``also_stablehlo=True`` for consumers that ingest StableHLO instead.
 """
 from __future__ import annotations
 
+from .emit import emit_model  # noqa: F401  (public: fn-level emission)
 
-def export(layer, path: str, input_spec=None, opset_version=None, **kw):
-    """Export ``layer`` for interchange.
 
-    Writes the StableHLO artifact at ``path``.  Direct .onnx emission is NOT
-    implemented (the converter ecosystem ingests StableHLO directly); a
-    warning always points at the conversion route so callers expecting a
-    .onnx file find out immediately, not at deploy time.
-    """
-    import warnings
+def export(layer, path: str, input_spec=None, opset_version=13,
+           also_stablehlo: bool = False, **kw):
+    """Export ``layer`` (a Layer or a pure fn over Tensors) to ``path``
+    as ONNX protobuf (.onnx appended when missing).
 
-    from ..inference import save_inference_model
+    ``input_spec``: example inputs (Tensors/arrays) fixing shapes/dtypes.
+    Returns the .onnx path.  Raises NotImplementedError naming any traced
+    primitive without a lowering — a loud gap beats a corrupt file."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
 
     if input_spec is None:
         raise ValueError("input_spec (example inputs) required for export")
-    prefix = path[:-5] if path.endswith(".onnx") else path
-    save_inference_model(prefix, layer, input_spec)
-    warnings.warn(
-        "paddle_tpu.onnx.export writes a StableHLO artifact, not a .onnx "
-        f"file; convert {prefix}.pdmodel with stablehlo->onnx tooling "
-        "(e.g. onnx-mlir) if ONNX protobuf output is required", stacklevel=2)
-    return prefix
+    if opset_version not in (None, 13):
+        raise ValueError("only opset 13 is emitted")
+    specs = input_spec if isinstance(input_spec, (list, tuple)) \
+        else [input_spec]
+    arrs = [jnp.asarray(s.value if isinstance(s, Tensor) else s)
+            for s in specs]
+
+    def fn(*args):
+        from ..core.autograd import no_grad
+
+        with no_grad():
+            out = layer(*[Tensor(a, stop_gradient=True) for a in args])
+        return out.value if isinstance(out, Tensor) else out
+
+    onnx_path = path if path.endswith(".onnx") else path + ".onnx"
+    is_layer = hasattr(layer, "eval") and hasattr(layer, "sublayers")
+    modes = [(l, l.training) for l in layer.sublayers(include_self=True)] \
+        if is_layer else []
+    if is_layer:
+        layer.eval()  # inference graph: BN uses running stats, no dropout
+    try:
+        data = emit_model(fn, arrs)
+    finally:
+        for l, t in modes:  # exporting mid-training must not leave the
+            l.training = t  # network silently stuck in eval mode
+    with open(onnx_path, "wb") as f:
+        f.write(data)
+    if also_stablehlo:
+        from ..inference import save_inference_model
+
+        save_inference_model(onnx_path[:-5], layer, specs)
+    return onnx_path

@@ -1,0 +1,113 @@
+"""Minimal protobuf wire-format encoder/decoder (no protobuf dependency).
+
+ONNX files are protobuf messages; this environment has no ``onnx`` (or
+``protobuf``) package, so emission writes the wire format directly — it is
+tiny and stable: a message is a sequence of (tag, payload) fields where
+``tag = field_number << 3 | wire_type`` as a varint, wire_type 0 = varint,
+1 = 64-bit, 2 = length-delimited (bytes/string/sub-message/packed), 5 =
+32-bit.  Field numbers used by the emitter (onnx/onnx.proto, stable since
+IR version 3) live in emit.py next to their messages.
+
+The decoder exists so tests can independently re-parse emitted files
+without trusting the encoder's structure.
+"""
+from __future__ import annotations
+
+import struct
+
+
+def varint(n: int) -> bytes:
+    if n < 0:
+        n &= (1 << 64) - 1  # two's-complement 64-bit, per protobuf int64
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def tag(field: int, wire_type: int) -> bytes:
+    return varint((field << 3) | wire_type)
+
+
+def f_varint(field: int, value: int) -> bytes:
+    return tag(field, 0) + varint(int(value))
+
+
+def f_bytes(field: int, value: bytes) -> bytes:
+    return tag(field, 2) + varint(len(value)) + value
+
+
+def f_string(field: int, value: str) -> bytes:
+    return f_bytes(field, value.encode("utf-8"))
+
+
+def f_message(field: int, encoded: bytes) -> bytes:
+    return f_bytes(field, encoded)
+
+
+def f_float(field: int, value: float) -> bytes:
+    return tag(field, 5) + struct.pack("<f", value)
+
+
+def f_packed_int64(field: int, values) -> bytes:
+    body = b"".join(varint(int(v)) for v in values)
+    return f_bytes(field, body)
+
+
+# ---------------------------------------------------------------------------
+# decoder (test-side independent re-parse)
+# ---------------------------------------------------------------------------
+
+
+def read_varint(buf: bytes, pos: int):
+    out = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+
+
+def decode_message(buf: bytes):
+    """-> {field_number: [values]}; wire-type-2 values stay raw bytes (the
+    caller decides whether they are strings, sub-messages, or packed)."""
+    fields: dict[int, list] = {}
+    pos = 0
+    while pos < len(buf):
+        key, pos = read_varint(buf, pos)
+        field, wt = key >> 3, key & 7
+        if wt == 0:
+            v, pos = read_varint(buf, pos)
+        elif wt == 1:
+            v = struct.unpack("<q", buf[pos:pos + 8])[0]
+            pos += 8
+        elif wt == 2:
+            n, pos = read_varint(buf, pos)
+            v = buf[pos:pos + n]
+            pos += n
+        elif wt == 5:
+            v = struct.unpack("<f", buf[pos:pos + 4])[0]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        fields.setdefault(field, []).append(v)
+    return fields
+
+
+def decode_packed_int64(buf: bytes) -> list[int]:
+    out = []
+    pos = 0
+    while pos < len(buf):
+        v, pos = read_varint(buf, pos)
+        if v >= 1 << 63:
+            v -= 1 << 64
+        out.append(v)
+    return out

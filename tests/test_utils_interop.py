@@ -32,12 +32,18 @@ def test_cpp_extension_load(tmp_path):
     np.testing.assert_allclose(lib.elementwise("square", x), x * x)
 
 
-def test_onnx_export_writes_stablehlo(tmp_path):
+def test_onnx_export_writes_onnx_and_optional_stablehlo(tmp_path):
+    import os
+
     from paddle_tpu import onnx
 
     net = paddle.nn.Linear(4, 2)
     net.eval()
     x = np.zeros((1, 4), np.float32)
-    prefix = onnx.export(net, str(tmp_path / "m.onnx"), input_spec=[x])
-    import os
-    assert os.path.exists(prefix + ".pdmodel")
+    # real .onnx protobuf now (deep validation in test_onnx_export.py)
+    p = onnx.export(net, str(tmp_path / "m.onnx"), input_spec=[x])
+    assert p.endswith(".onnx") and os.path.getsize(p) > 0
+    # the StableHLO artifact remains available alongside on request
+    p2 = onnx.export(net, str(tmp_path / "m2.onnx"), input_spec=[x],
+                     also_stablehlo=True)
+    assert os.path.exists(p2[:-5] + ".pdmodel")

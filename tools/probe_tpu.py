@@ -79,7 +79,11 @@ def probe(timeout: float = 120.0, source: str = "probe_tpu") -> dict:
                  "source": source,
                  "detail": f"timeout after {timeout}s (device enumeration "
                            f"or first compile hung — wedged tunnel)"}
-    append_entry(entry)
+    try:
+        append_entry(entry)
+    except OSError:
+        pass  # read-only checkout: the probe VERDICT must still stand —
+        # a logging failure must never turn a healthy TPU into a fallback
     return entry
 
 
