@@ -63,6 +63,7 @@ cuts; ``PipelineTrainStep.padding_report()`` quantifies the current waste
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, Callable, NamedTuple
 
 import jax
@@ -573,8 +574,11 @@ class PipelineTrainStep:
                     # bound the within-tick residuals to the branch inputs;
                     # prevent_cse=False — the scan provides CSE protection
                     # and the default's optimization barriers hang the axon
-                    # TPU compile (see text/gpt.py)
-                    run = jax.checkpoint(run, prevent_cse=False)
+                    # TPU compile (see text/gpt.py).  Same env override as
+                    # gpt.py so the on-device variant check covers pp too.
+                    _cse = os.environ.get(
+                        "PADDLE_TPU_REMAT_PREVENT_CSE", "") == "1"
+                    run = jax.checkpoint(run, prevent_cse=_cse)
                 (_, loss_mb), vjp_fn = jax.vjp(run, pv, sp, x_saved)
                 valid = b_valid.astype(jnp.float32)
                 # last stage's cotangent comes from its own head; others

@@ -9,6 +9,7 @@ correctness reference and the fallback for CPU tests.
 """
 from __future__ import annotations
 
+import os
 
 import jax
 import jax.numpy as jnp
@@ -27,6 +28,10 @@ FLASH_ENABLED = True  # flipped off automatically when the kernel can't run
 def _use_flash(q_shape) -> bool:
     # flash kernel needs TPU backend + seq len divisible by block
     if not FLASH_ENABLED:
+        return False
+    # ablation kill-switch ("0"/"" = flash stays on, matching the
+    # PADDLE_TPU_REMAT_PREVENT_CSE flag convention)
+    if os.environ.get("PADDLE_TPU_NO_FLASH", "") not in ("", "0"):
         return False
     try:
         dev = jax.devices()[0]

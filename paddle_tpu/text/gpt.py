@@ -19,6 +19,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import math
+import os
 from typing import Any
 
 import jax
@@ -210,8 +211,11 @@ def forward_with_aux(params: dict, tokens, cfg: GPTConfig, act_sharding=None,
         # prevent_cse=False: inside lax.scan the loop structure already
         # prevents the grad-of-checkpoint CSE hazard, and the default's
         # optimization_barriers send the TPU compiler into a tailspin
-        # (observed: >15 min hangs on v5e for the 350M config)
-        blk = jax.checkpoint(blk, prevent_cse=False)
+        # (observed: >15 min hangs on v5e for the 350M config).
+        # PADDLE_TPU_REMAT_PREVENT_CSE=1 restores the default barriers so
+        # tools/remat_compile_check.py can measure both variants on-device.
+        _cse = os.environ.get("PADDLE_TPU_REMAT_PREVENT_CSE", "") == "1"
+        blk = jax.checkpoint(blk, prevent_cse=_cse)
 
     need_keys = key is not None and (cfg.dropout > 0.0 or cfg.moe is not None)
     if need_keys:

@@ -9,10 +9,24 @@ attributable to infra with timestamps (the round-2 verdict's requirement).
 
 Usage: python tools/probe_tpu.py [--timeout 120]
 Exit code 0 = healthy, 1 = wedged/failed.
+
+Watchdog mode (round-3 verdict Next #1 — "make taking the TPU number
+unattended"): ``python tools/probe_tpu.py --watch [--interval 600]
+[--max-hours 14]`` probes on a loop, logging every attempt, and on the
+FIRST healthy probe runs the full measurement payload — bench.py ladder,
+bench.py --all, the no-flash ablation, the Pallas flash-attention on-device
+check, and the remat-variant compile check — recording everything
+incrementally to ``WATCHDOG_RESULTS.json``.  bench.py's fallback path
+replays the watchdog's TPU headline, so a 20-minute healthy window at 3am
+still yields a BENCH_r04.json with device=tpu even if the tunnel is wedged
+again when the driver runs the bench.  Steps that fail are retried in later
+healthy windows (a step timeout is read as the tunnel re-wedging, ending
+the current window).
 """
 import datetime
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -87,10 +101,152 @@ def probe(timeout: float = 120.0, source: str = "probe_tpu") -> dict:
     return entry
 
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RESULTS = os.path.join(REPO, "WATCHDOG_RESULTS.json")
+
+
+def _now() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds")
+
+
+def _payload_steps():
+    py = sys.executable
+    bench = os.path.join(REPO, "bench.py")
+    return [
+        # (name, argv, timeout_s, extra_env, output_json_path_or_None)
+        ("ladder", [py, bench], 5400, {}, None),
+        ("all", [py, bench, "--all"], 7200, {}, None),
+        ("noflash", [py, bench], 3600, {"PADDLE_TPU_NO_FLASH": "1"},
+         os.path.join(REPO, "noflash.json")),
+        ("flash_check", [py, os.path.join(REPO, "tools",
+                                          "check_flash_tpu.py")], 1200, {},
+         None),
+        ("remat_variants", [py, os.path.join(REPO, "tools",
+                                             "remat_compile_check.py")],
+         3600, {}, None),
+    ]
+
+
+def _save_results(data: dict):
+    tmp = RESULTS + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(data, f, indent=2)
+    os.replace(tmp, RESULTS)
+
+
+def _load_results() -> dict:
+    try:
+        with open(RESULTS) as f:
+            return json.load(f)
+    except Exception:  # noqa: BLE001 - first run / torn file
+        return {"steps": {}, "windows": []}
+
+
+def _run_step(name, argv, timeout, env, out_json, log):
+    rec = {"started": _now(), "argv": argv, "timeout_s": timeout}
+    # start_new_session: a step timeout must kill the WHOLE process group —
+    # bench.py runs each rung in its own grandchild, and an orphaned rung
+    # left holding a hung remote compile keeps the tunnel wedged for every
+    # later watchdog window (the exact failure the watchdog exists to ride
+    # out)
+    proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True, cwd=REPO,
+                            env=dict(os.environ, **env),
+                            start_new_session=True)
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout)
+        rec["rc"] = proc.returncode
+        rec["stderr_tail"] = stderr[-3000:]
+        last = stdout.strip().splitlines()[-1] if stdout.strip() else ""
+        try:
+            rec["headline"] = json.loads(last)
+        except (json.JSONDecodeError, ValueError):
+            rec["stdout_tail"] = stdout[-1500:]
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        proc.communicate()
+        rec["rc"] = None
+        rec["error"] = f"timeout after {timeout}s"
+    rec["finished"] = _now()
+    # success = clean exit AND (for bench steps) a genuinely on-device
+    # headline — a CPU-fallback line means the tunnel died under us
+    head = rec.get("headline") or {}
+    # a replayed watchdog headline (source=tpu_watchdog) is bench.py echoing
+    # OUR earlier measurement back — not a fresh on-device run
+    fell_back = ("_cpu_fallback" in str(head.get("metric", ""))
+                 or head.get("source") == "tpu_watchdog")
+    rec["ok"] = rec.get("rc") == 0 and not fell_back
+    if out_json and rec["ok"] and rec.get("headline") is not None:
+        # only persist a FRESH measurement — a replayed/fallback headline
+        # written here would poison the ablation file (noflash.json)
+        with open(out_json, "w") as f:
+            json.dump(rec["headline"], f, indent=2)
+    log(f"[watch] step {name}: ok={rec['ok']} rc={rec.get('rc')}"
+        + (f" headline={head.get('metric')}" if head else ""))
+    return rec
+
+
+def watch(interval: float, probe_timeout: float, max_hours: float):
+    def log(msg):
+        print(f"{_now()} {msg}", flush=True)
+
+    deadline = time.monotonic() + max_hours * 3600
+    data = _load_results()
+    data.setdefault("steps", {})
+    data.setdefault("windows", [])
+    log(f"[watch] starting: interval={interval}s probe_timeout="
+        f"{probe_timeout}s max_hours={max_hours}")
+    while time.monotonic() < deadline:
+        e = probe(probe_timeout, source="watchdog")
+        log(f"[watch] probe ok={e['ok']} elapsed={e['elapsed_s']}s "
+            f"detail={e['detail']}")
+        if e["ok"]:
+            data["windows"].append({"opened": _now()})
+            _save_results(data)
+            for name, argv, to, env, out_json in _payload_steps():
+                prev = data["steps"].get(name, {})
+                if prev.get("ok"):
+                    continue
+                if prev.get("attempts", 0) >= 3:
+                    continue  # persistently failing step: stop burning it
+                rec = _run_step(name, argv, to, env, out_json, log)
+                rec["attempts"] = prev.get("attempts", 0) + 1
+                data["steps"][name] = rec
+                _save_results(data)
+                if rec.get("error", "").startswith("timeout"):
+                    log("[watch] step timed out — treating the window as "
+                        "closed; back to probing")
+                    break
+            if all(s.get("ok") or s.get("attempts", 0) >= 3
+                   for s in data["steps"].values()) \
+                    and len(data["steps"]) == len(_payload_steps()):
+                log("[watch] all payload steps resolved; exiting")
+                _save_results(data)
+                break
+        time.sleep(interval)
+    else:
+        log("[watch] max duration reached; exiting")
+    # exit 0 only means "the headline TPU number exists" — steps that merely
+    # exhausted their attempts must not read as success to the caller
+    return 0 if data["steps"].get("ladder", {}).get("ok") else 1
+
+
 if __name__ == "__main__":
     t = 120.0
     if "--timeout" in sys.argv:
         t = float(sys.argv[sys.argv.index("--timeout") + 1])
+    if "--watch" in sys.argv:
+        iv = 600.0
+        if "--interval" in sys.argv:
+            iv = float(sys.argv[sys.argv.index("--interval") + 1])
+        mh = 14.0
+        if "--max-hours" in sys.argv:
+            mh = float(sys.argv[sys.argv.index("--max-hours") + 1])
+        sys.exit(watch(iv, t, mh))
     e = probe(t)
     print(json.dumps(e))
     sys.exit(0 if e["ok"] else 1)
