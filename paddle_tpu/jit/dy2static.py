@@ -18,10 +18,17 @@ The transform (per ``if``/``while`` statement):
 * ``and`` / ``or`` / ``not`` inside the condition become
   :func:`logical_and` etc. (thunked: Python short-circuit when concrete,
   ``jnp.logical_*`` when traced);
-* branches containing ``return`` / ``break`` / ``continue`` are left as
-  Python, guarded by :func:`assert_py_cond` — a tensor condition there
-  raises :class:`Dy2StaticError` naming the source line (the reference
-  converts these with RETURN-flag rewrites; explicitly out of scope).
+* ``return`` / ``break`` / ``continue`` inside convertible constructs are
+  lifted by :class:`_EscapeRewriter` (the reference's RETURN-flag and
+  break/continue transforms, return_transformer.py /
+  break_continue_transformer.py): returns become ``__pt_rf``/``__pt_rv``
+  flag+value threading with the block remainder guarded by
+  ``if not flag``, break/continue become per-loop flags conjoined into the
+  loop condition.  Concrete conditions keep exact Python semantics; traced
+  conditions become lax control flow.  Remaining unconvertible shapes
+  (escapes in ``try``, break in a non-range ``for``, ``return <value>``
+  inside a traced loop) still raise :class:`Dy2StaticError` with the
+  source line.
 
 Conversion recurses through callees (the reference's ``convert_call``,
 program_translator.py): every call site in converted code is rewritten to
@@ -114,7 +121,8 @@ def _loc(line_info):
     return f"{line_info[0]}:{line_info[1]}" if line_info else "<unknown>"
 
 
-def convert_ifelse(pred, true_fn, false_fn, vals: tuple, _loc_info=None):
+def convert_ifelse(pred, true_fn, false_fn, vals: tuple, _loc_info=None,
+                   names=None):
     if not _is_traced(pred):
         return true_fn(vals) if bool(_unwrap1(pred)) else false_fn(vals)
 
@@ -122,48 +130,119 @@ def convert_ifelse(pred, true_fn, false_fn, vals: tuple, _loc_info=None):
     traced_idx = [i for i, s in enumerate(statics) if s is None]
     operand = tuple(arrs[i] for i in traced_idx)
 
-    def wrap(fn):
-        def inner(op):
-            full = list(vals)
-            for j, i in enumerate(traced_idx):
-                full[i] = Tensor(op[j]) if isinstance(vals[i], Tensor) \
-                    else op[j]
-            out = fn(tuple(full))
-            out_arrs = []
-            for v in out:
-                u = _unwrap1(v)
-                if isinstance(u, _UndefinedVar):
+    def rebuild(op):
+        full = list(vals)
+        for j, i in enumerate(traced_idx):
+            full[i] = Tensor(op[j]) if isinstance(vals[i], Tensor) \
+                else op[j]
+        return tuple(full)
+
+    def _name(i):
+        return f"`{names[i]}`" if names and i < len(names) else "a variable"
+
+    def run_branch(fn, op, fills, undef_out):
+        """Execute one branch on rebuilt state; outputs as arrays.
+
+        ``fills[i]`` (a ShapeDtypeStruct) materialises an UNDEF output as
+        zeros — sound only for compiler-generated ``__pt_*`` flag/value
+        names whose reads the escape rewrite guards behind their flag.
+        ``undef_out`` (a set) collects UNDEF positions instead of raising
+        (the abstract reconnaissance pass)."""
+        out = fn(rebuild(op))
+        res = []
+        for i, v in enumerate(out):
+            u = _unwrap1(v)
+            if isinstance(u, _UndefinedVar):
+                if fills is not None and fills.get(i) is not None:
+                    res.append(jnp.zeros(fills[i].shape, fills[i].dtype))
+                elif undef_out is not None:
+                    undef_out.add(i)
+                    res.append(jnp.zeros((), jnp.float32))
+                else:
                     raise Dy2StaticError(
-                        f"at {_loc(_loc_info)}: a variable under a "
+                        f"at {_loc(_loc_info)}: {_name(i)} under a "
                         f"tensor-valued `if` is only assigned in one "
                         f"branch; assign it in both (or before the if)")
+            else:
                 try:
-                    out_arrs.append(jnp.asarray(u))
+                    res.append(jnp.asarray(u))
                 except TypeError as e:
                     raise Dy2StaticError(
-                        f"at {_loc(_loc_info)}: a variable assigned under a "
+                        f"at {_loc(_loc_info)}: {_name(i)} assigned under a "
                         f"tensor-valued `if` has non-tensor type "
                         f"{type(u).__name__!r}; both branches must produce "
                         f"jax-compatible values") from e
-            return tuple(out_arrs)
+        return tuple(res)
 
-        return inner
+    # Reconcile pass (only when some state slot is not yet bound): discover
+    # each branch's output shapes abstractly, then fill the side that leaves
+    # a compiler-generated name UNDEF with zeros of the other side's
+    # shape/dtype so lax.cond's branch signatures match.  The escape rewrite
+    # guarantees such a fill is never read unless its flag says it was
+    # really assigned.
+    fills_t = fills_f = None
+    undef_both: set = set()
+    has_undef = any(isinstance(_unwrap1(v), _UndefinedVar) for v in vals)
+    if has_undef:
+        ut: set = set()
+        uf: set = set()
+        shp_t = jax.eval_shape(lambda op: run_branch(true_fn, op, None, ut),
+                               operand)
+        shp_f = jax.eval_shape(lambda op: run_branch(false_fn, op, None, uf),
+                               operand)
+        fills_t, fills_f = {}, {}
+        for i in ut | uf:
+            if i in ut and i in uf:
+                undef_both.add(i)  # unassigned on both sides: stays UNDEF
+                continue
+            if not (names and i < len(names)
+                    and names[i].startswith("__pt_")):
+                raise Dy2StaticError(
+                    f"at {_loc(_loc_info)}: {_name(i)} under a "
+                    f"tensor-valued `if` is only assigned in one branch; "
+                    f"assign it in both (or before the if)")
+            if i in ut:
+                fills_t[i] = shp_f[i]
+            else:
+                fills_f[i] = shp_t[i]
 
     try:
-        res = lax.cond(_as_pred(pred), wrap(true_fn), wrap(false_fn), operand)
+        res = lax.cond(_as_pred(pred),
+                       lambda op: run_branch(true_fn, op, fills_t,
+                                             set() if has_undef else None),
+                       lambda op: run_branch(false_fn, op, fills_f,
+                                             set() if has_undef else None),
+                       operand)
     except TypeError as e:
         raise Dy2StaticError(
             f"at {_loc(_loc_info)}: `if` on a traced tensor requires both "
             f"branches to produce matching shapes/dtypes for every assigned "
             f"variable ({e})") from e
-    return tuple(Tensor(r) for r in res)
+    return tuple(UNDEF if i in undef_both else Tensor(r)
+                 for i, r in enumerate(res))
 
 
-def convert_while(cond_fn, body_fn, vals: tuple, _loc_info=None):
+def convert_while(cond_fn, body_fn, vals: tuple, _loc_info=None, names=None):
     if not _is_traced(cond_fn(vals)):
         while bool(_unwrap1(cond_fn(vals))):
             vals = body_fn(vals)
         return vals
+
+    for i, v in enumerate(vals):
+        if isinstance(_unwrap1(v), _UndefinedVar):
+            nm = names[i] if names and i < len(names) else None
+            if nm == "__pt_rv":
+                raise Dy2StaticError(
+                    f"at {_loc(_loc_info)}: `return <value>` inside a "
+                    f"tensor-valued `while`/`for` cannot become XLA control "
+                    f"flow (the result has no shape before the first "
+                    f"iteration); assign the result to a variable "
+                    f"initialised before the loop and `break` instead")
+            raise Dy2StaticError(
+                f"at {_loc(_loc_info)}: "
+                f"{f'`{nm}`' if nm else 'a loop variable'} may be read "
+                f"before assignment in a tensor-valued `while`; assign it "
+                f"before the loop")
 
     arrs, statics = _split_state(vals)
     traced_idx = [i for i, s in enumerate(statics) if s is None]
@@ -212,13 +291,21 @@ def convert_while(cond_fn, body_fn, vals: tuple, _loc_info=None):
     return tuple(full)
 
 
-def convert_for_range(range_args, body_fn, vals: tuple, _loc_info=None):
+def convert_for_range(range_args, body_fn, vals: tuple, _loc_info=None,
+                      stop_idx=(), names=None):
     """``for <i> in range(...)`` → lax.while_loop when any bound is traced
     (reference dygraph_to_static loop_transformer converts for→while).
 
     vals = (loop_target_placeholder, *state); body_fn takes/returns the full
     tuple with the target first.  Python-int bounds keep the plain (possibly
-    trace-unrolled) Python loop semantics."""
+    trace-unrolled) Python loop semantics.
+
+    ``stop_idx``: positions of escape flags (break/return rewrite flags)
+    that end the loop — conjoined into the while condition when bounds are
+    traced; checked concretely per iteration when bounds are Python ints
+    (a traced flag there cannot break the Python loop early, but the
+    escape rewrite's in-body guards make the remaining iterations no-ops,
+    so semantics are preserved — only trace size grows)."""
     args = [(_unwrap1(a) if isinstance(a, Tensor) else a) for a in range_args]
     if len(args) == 1:
         start, stop, step = 0, args[0], 1
@@ -235,6 +322,9 @@ def convert_for_range(range_args, body_fn, vals: tuple, _loc_info=None):
         for i in range(int(start), int(stop), int(step)):
             out = body_fn((i,) + tuple(out[1:]))
             out = (i,) + tuple(out[1:])
+            if any(not _is_traced(out[k]) and bool(_unwrap1(out[k]))
+                   for k in stop_idx):
+                break
         return out
 
     st = jnp.asarray(step)
@@ -243,14 +333,18 @@ def convert_for_range(range_args, body_fn, vals: tuple, _loc_info=None):
     i0 = Tensor(jnp.asarray(start))
 
     def cond_fn(vs):
-        return Tensor(((jnp.asarray(_unwrap1(vs[0])) - stop_v) * sign) < 0)
+        c = ((jnp.asarray(_unwrap1(vs[0])) - stop_v) * sign) < 0
+        for k in stop_idx:
+            c = jnp.logical_and(c, jnp.logical_not(_as_pred(vs[k])))
+        return Tensor(c)
 
     def body_w(vs):
         out = body_fn(vs)
         i_next = Tensor(jnp.asarray(_unwrap1(vs[0])) + st)
         return (i_next,) + tuple(out[1:])
 
-    return convert_while(cond_fn, body_w, (i0,) + tuple(vals[1:]), _loc_info)
+    return convert_while(cond_fn, body_w, (i0,) + tuple(vals[1:]), _loc_info,
+                         names=names)
 
 
 def logical_and(*thunks):
@@ -328,6 +422,22 @@ def convert_call(f):
         return convert_to_static(f)
     except Exception:  # noqa: BLE001 - never turn a working call into a crash
         return f
+
+
+def finalize_return(flag, val, may_fall_off: bool, _loc_info=None):
+    """Epilogue of the RETURN-flag rewrite (reference
+    return_transformer.py): concrete flag keeps exact Python semantics
+    (``None`` on fall-through); a traced flag requires every path to have
+    returned, because the traced result must have one shape."""
+    if not _is_traced(flag):
+        return val if bool(_unwrap1(flag)) else None
+    if isinstance(val, _UndefinedVar) or may_fall_off:
+        raise Dy2StaticError(
+            f"at {_loc(_loc_info)}: a `return` under a tensor-valued "
+            f"condition requires every execution path through the function "
+            f"to end in an explicit `return` (the traced result must have "
+            f"one shape); add a final `return` to the function")
+    return val
 
 
 def assert_py_cond(pred, _loc_info=None, reason=""):
@@ -442,31 +552,312 @@ class _HasReturn(ast.NodeVisitor):
         pass
 
 
-class _HasEscape(_HasReturn):
+def _escapes(stmts) -> bool:
     """Return/break/continue escaping this statement level; break/continue
     bound to an inner loop do not count."""
+    info = _escape_info(stmts)
+    return info.brk or info.cont or info.ret
+
+
+# ---------------------------------------------------------------------------
+# escape (return/break/continue) pre-pass — reference return_transformer.py
+# and break_continue_transformer.py, re-targeted at lax control flow
+# ---------------------------------------------------------------------------
+
+class _EscapeInfo(ast.NodeVisitor):
+    """break/continue bound to the current loop level + returns anywhere in
+    the function scope (nested loops bound their own break/continue but
+    propagate returns; nested defs/lambdas are opaque)."""
+
+    def __init__(self):
+        self.brk = False
+        self.cont = False
+        self.ret = False
 
     def visit_Break(self, node):
-        self.found = True
+        self.brk = True
 
     def visit_Continue(self, node):
-        self.found = True
+        self.cont = True
+
+    def visit_Return(self, node):
+        self.ret = True
 
     def visit_For(self, node):
+        # the nested loop binds its own break/continue but propagates
+        # returns; its ORELSE runs outside that loop, so break/continue
+        # there bind to the CURRENT level
         r = _HasReturn()
-        for s in node.body + node.orelse:
+        for s in node.body:
             r.visit(s)
-        self.found = self.found or r.found
+        self.ret = self.ret or r.found
+        for s in node.orelse:
+            self.visit(s)
 
     def visit_While(self, node):
         self.visit_For(node)
 
+    def visit_FunctionDef(self, node):
+        pass
 
-def _escapes(stmts) -> bool:
-    v = _HasEscape()
+    def visit_AsyncFunctionDef(self, node):
+        pass
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _escape_info(stmts) -> _EscapeInfo:
+    v = _EscapeInfo()
     for s in stmts:
         v.visit(s)
-    return v.found
+    return v
+
+
+def _is_range_for(s) -> bool:
+    """Matches the shape visit_For converts (convert_for_range target)."""
+    return (isinstance(s, ast.For) and isinstance(s.iter, ast.Call)
+            and isinstance(s.iter.func, ast.Name)
+            and s.iter.func.id == "range" and not s.iter.keywords
+            and isinstance(s.target, ast.Name) and not s.orelse)
+
+
+def _escape_under_cf(stmts, depth: int = 0) -> bool:
+    """Any return/break/continue nested inside if/while/for (the constructs
+    the rewrite can lift escapes out of).  Try blocks are opaque."""
+    for s in stmts:
+        if depth > 0 and isinstance(s, (ast.Return, ast.Break, ast.Continue)):
+            return True
+        if isinstance(s, (ast.If, ast.While, ast.For)):
+            if _escape_under_cf(s.body, depth + 1) \
+                    or _escape_under_cf(s.orelse, depth + 1):
+                return True
+        elif isinstance(s, ast.With):
+            if _escape_under_cf(s.body, depth):
+                return True
+    return False
+
+
+def _always_returns(stmts) -> bool:
+    """Conservative: every path through this block ends in return/raise."""
+    for s in stmts:
+        if isinstance(s, (ast.Return, ast.Raise)):
+            return True
+        if isinstance(s, ast.If) and s.orelse \
+                and _always_returns(s.body) and _always_returns(s.orelse):
+            return True
+        if isinstance(s, ast.With) and _always_returns(s.body):
+            return True
+    return False
+
+
+class _LoopCtx:
+    def __init__(self, bf, cf, treated):
+        self.bf, self.cf, self.treated = bf, cf, treated
+
+
+class _EscapeRewriter:
+    """Rewrite ``return`` / ``break`` / ``continue`` into flag threading so
+    the control-flow transformer can convert the containing if/while/for to
+    lax ops (the reference's RETURN-flag and break/continue transforms).
+
+    * ``return e`` → ``__pt_rv = e; __pt_rf = True`` (plus a real ``break``
+      when directly inside a loop the rewrite does not manage);
+    * ``break``/``continue`` in a managed loop → ``__pt_bf_k/__pt_cf_k =
+      True``; the loop's condition gains ``not flag`` conjuncts (via the
+      ``_pt_stop_flags`` node annotation consumed by the transformer);
+    * after any statement that may set a live flag, the remainder of the
+      block is wrapped in ``if logical_not(flag): ...`` — under concrete
+      flags this is exact Python semantics, under traced flags it becomes
+      lax.cond;
+    * the function gains a ``finalize_return`` epilogue.
+
+    Loops the rewrite manages: ``while`` (no else) and ``for _ in range``.
+    Non-range ``for`` keeps real break/continue (Python executes them);
+    returns inside it become flag-sets plus a real ``break``.
+    """
+
+    def __init__(self):
+        self.n = 0
+        self.uses_rf = False
+
+    # ---- AST builders -----------------------------------------------------
+    @staticmethod
+    def _empty_args():
+        return ast.arguments(posonlyargs=[], args=[], vararg=None,
+                             kwonlyargs=[], kw_defaults=[], kwarg=None,
+                             defaults=[])
+
+    @staticmethod
+    def _assign(name, value):
+        return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                          value=value)
+
+    @staticmethod
+    def _rt(attr, args):
+        return ast.Call(
+            func=ast.Attribute(value=ast.Name(id=_RT, ctx=ast.Load()),
+                               attr=attr, ctx=ast.Load()),
+            args=args, keywords=[])
+
+    def _not_flags(self, flags):
+        if len(flags) == 1:
+            inner = ast.Name(id=flags[0], ctx=ast.Load())
+        else:
+            inner = self._rt("logical_or", [
+                ast.Lambda(args=self._empty_args(),
+                           body=ast.Name(id=f, ctx=ast.Load()))
+                for f in flags])
+        return self._rt("logical_not", [inner])
+
+    # ---- entry ------------------------------------------------------------
+    def rewrite(self, fdef):
+        if not _escape_under_cf(fdef.body):
+            return fdef
+        may_fall_off = not _always_returns(fdef.body)
+        body = self._block(list(fdef.body), ())
+        if self.uses_rf:
+            epilogue = ast.Return(value=self._rt("finalize_return", [
+                ast.Name(id="__pt_rf", ctx=ast.Load()),
+                ast.Call(
+                    func=ast.Attribute(
+                        value=ast.Call(
+                            func=ast.Name(id="locals", ctx=ast.Load()),
+                            args=[], keywords=[]),
+                        attr="get", ctx=ast.Load()),
+                    args=[ast.Constant("__pt_rv"),
+                          ast.Attribute(
+                              value=ast.Name(id=_RT, ctx=ast.Load()),
+                              attr="UNDEF", ctx=ast.Load())],
+                    keywords=[]),
+                ast.Constant(may_fall_off),
+                ast.Tuple(elts=[ast.Constant("<function>"),
+                                ast.Constant(fdef.lineno)], ctx=ast.Load()),
+            ]))
+            fdef.body = ([self._assign("__pt_rf", ast.Constant(False))]
+                         + body + [epilogue])
+        else:
+            fdef.body = body
+        ast.fix_missing_locations(fdef)
+        return fdef
+
+    # ---- per-statement rewrite -------------------------------------------
+    def _flags_set_in(self, node, loops):
+        info = _escape_info([node])
+        flags = []
+        if info.ret:
+            flags.append("__pt_rf")
+        if loops and loops[-1].treated:
+            if info.brk and loops[-1].bf:
+                flags.append(loops[-1].bf)
+            if info.cont and loops[-1].cf:
+                flags.append(loops[-1].cf)
+        return flags
+
+    def _block(self, stmts, loops):
+        out = []
+        for idx, s in enumerate(stmts):
+            set_flags = []
+            if isinstance(s, ast.Return):
+                self.uses_rf = True
+                out.append(self._assign(
+                    "__pt_rv",
+                    s.value if s.value is not None else ast.Constant(None)))
+                out.append(self._assign("__pt_rf", ast.Constant(True)))
+                if loops and not loops[-1].treated:
+                    out.append(ast.Break())  # physically leave a real loop
+                set_flags = ["__pt_rf"]
+            elif isinstance(s, ast.Break):
+                if loops and loops[-1].treated:
+                    out.append(self._assign(loops[-1].bf,
+                                            ast.Constant(True)))
+                    set_flags = [loops[-1].bf]
+                else:
+                    out.append(s)
+            elif isinstance(s, ast.Continue):
+                if loops and loops[-1].treated:
+                    out.append(self._assign(loops[-1].cf,
+                                            ast.Constant(True)))
+                    set_flags = [loops[-1].cf]
+                else:
+                    out.append(s)
+            elif isinstance(s, ast.If):
+                set_flags = self._flags_set_in(s, loops)
+                s.body = self._block(s.body, loops)
+                s.orelse = self._block(s.orelse, loops)
+                out.append(s)
+            elif isinstance(s, ast.With):
+                set_flags = self._flags_set_in(s, loops)
+                s.body = self._block(s.body, loops)
+                out.append(s)
+            elif (isinstance(s, ast.While) and not s.orelse) \
+                    or _is_range_for(s):
+                info = _escape_info(s.body)
+                if info.ret:
+                    set_flags = ["__pt_rf"]
+                out.extend(self._managed_loop(s, loops, info))
+            elif isinstance(s, (ast.While, ast.For)):
+                # opaque loop (non-range for / while-else): real
+                # break/continue stay; returns inside became rf + break
+                info = _escape_info(s.body)
+                if info.ret:
+                    set_flags = ["__pt_rf"]
+                rec = _LoopCtx(None, None, False)
+                s.body = self._block(s.body, loops + (rec,))
+                s.orelse = self._block(s.orelse, loops)  # else runs outside
+                out.append(s)
+            else:
+                out.append(s)
+            if set_flags:
+                # a pending return must PHYSICALLY exit an unmanaged loop
+                # (managed loops stop via their condition conjunct): emit
+                # `if __pt_rf: break` so enclosing opaque loops don't keep
+                # iterating — re-running side effects and overwriting
+                # __pt_rv.  (A raw Return already emitted its own break.)
+                if "__pt_rf" in set_flags and loops \
+                        and not loops[-1].treated \
+                        and not isinstance(s, ast.Return):
+                    out.append(ast.If(
+                        test=ast.Name(id="__pt_rf", ctx=ast.Load()),
+                        body=[ast.Break()], orelse=[]))
+                if idx + 1 < len(stmts):
+                    rest = self._block(stmts[idx + 1:], loops)
+                    guard = ast.If(test=self._not_flags(set_flags),
+                                   body=rest, orelse=[])
+                    out.append(guard)
+                    return out
+        return out
+
+    def _managed_loop(self, s, loops, info):
+        if not (info.brk or info.cont or info.ret):
+            # nothing escapes THIS loop; still recurse for nested loops
+            rec = _LoopCtx(None, None, False)
+            s.body = self._block(s.body, loops + (rec,))
+            return [s]
+        k = self.n
+        self.n += 1
+        bf = f"__pt_bf_{k}" if info.brk else None
+        cf = f"__pt_cf_{k}" if info.cont else None
+        rec = _LoopCtx(bf, cf, True)
+        body = self._block(s.body, loops + (rec,))
+        stop = [f for f in (bf, "__pt_rf" if info.ret else None) if f]
+        if stop:
+            # whole-body guard (reference break_continue_transformer wraps
+            # the body in `if not flag`): a converted loop stops via the
+            # condition conjunct, but a CONCRETE-range loop with a TRACED
+            # flag cannot exit the Python loop early — the guard makes the
+            # remaining iterations no-ops so semantics still hold
+            body = [ast.If(test=self._not_flags(stop), body=body,
+                           orelse=[])]
+        if cf:
+            body = [self._assign(cf, ast.Constant(False))] + body
+        s.body = body
+        s._pt_stop_flags = stop
+        # both flags are loop-carried state: they must be bound before the
+        # first condition/state evaluation (cf is also re-reset per
+        # iteration at the body top)
+        pre = [self._assign(f, ast.Constant(False)) for f in (bf, cf) if f]
+        return pre + [s]
 
 
 class _CallWrapper(ast.NodeTransformer):
@@ -561,7 +952,8 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 
     def _loc_tuple(self, node):
         return ast.Tuple(
-            elts=[ast.Constant(self.filename), ast.Constant(node.lineno)],
+            elts=[ast.Constant(self.filename),
+                  ast.Constant(getattr(node, "lineno", 0))],
             ctx=ast.Load())
 
     def _state_tuple(self, names, ctx):
@@ -624,7 +1016,9 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                     [test, ast.Name(id=tf, ctx=ast.Load()),
                      ast.Name(id=ff, ctx=ast.Load()),
                      self._state_load(names),
-                     self._loc_tuple(node)])),
+                     self._loc_tuple(node),
+                     ast.List(elts=[ast.Constant(n) for n in names],
+                              ctx=ast.Load())])),
         ]
         return out
 
@@ -645,6 +1039,9 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         self.counter += 1
         tname = node.target.id
         names = [tname] + [n for n in _assigned(node.body) if n != tname]
+        stop_idx = [names.index(f)
+                    for f in getattr(node, "_pt_stop_flags", [])
+                    if f in names]
         bf = f"__pt_fbody_{i}"
         out = [
             self._make_branch_fn(bf, names, node.body),
@@ -655,13 +1052,30 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                     [ast.Tuple(elts=list(node.iter.args), ctx=ast.Load()),
                      ast.Name(id=bf, ctx=ast.Load()),
                      self._state_load(names),
-                     self._loc_tuple(node)])),
+                     self._loc_tuple(node),
+                     ast.List(elts=[ast.Constant(k) for k in stop_idx],
+                              ctx=ast.Load()),
+                     ast.List(elts=[ast.Constant(n) for n in names],
+                              ctx=ast.Load())])),
         ]
         return out
 
     def visit_While(self, node):
         self.generic_visit(node)
         test = _BoolOpRewriter().visit(node.test)
+        # escape-rewrite flags (break/return) end the loop: conjoin
+        # `not flag` BEFORE the original test so a concrete flag
+        # short-circuits without re-evaluating the condition.  This must
+        # happen even on the unconvertible path below — a managed loop
+        # whose body retains a real escape (e.g. break inside try) still
+        # relies on the conjunct to terminate once a rewritten flag is set
+        for fl in reversed(getattr(node, "_pt_stop_flags", [])):
+            test = self._rt_call("logical_and", [
+                ast.Lambda(args=_EscapeRewriter._empty_args(),
+                           body=self._rt_call(
+                               "logical_not",
+                               [ast.Name(id=fl, ctx=ast.Load())])),
+                ast.Lambda(args=_EscapeRewriter._empty_args(), body=test)])
         if _escapes(node.body) or node.orelse:
             node.test = self._rt_call(
                 "assert_py_cond",
@@ -685,7 +1099,9 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                     [ast.Name(id=cf, ctx=ast.Load()),
                      ast.Name(id=bf, ctx=ast.Load()),
                      self._state_load(names),
-                     self._loc_tuple(node)])),
+                     self._loc_tuple(node),
+                     ast.List(elts=[ast.Constant(n) for n in names],
+                              ctx=ast.Load())])),
         ]
         return out
 
@@ -732,9 +1148,11 @@ def convert_to_static(fn):
             return fn
     fdef.decorator_list = []
     # convert_call injection FIRST (on the user's original call sites, not
-    # descending into nested defs), then the control-flow rewrite whose
+    # descending into nested defs), then the escape (return/break/continue
+    # → flag threading) pre-pass, then the control-flow rewrite whose
     # generated runtime calls must stay bare
     fdef.body = [_CallWrapper().visit(s) for s in fdef.body]
+    _EscapeRewriter().rewrite(fdef)
     new_tree = _ControlFlowTransformer(
         inspect.getsourcefile(fn) or "<unknown>").visit(tree)
     ast.fix_missing_locations(new_tree)

@@ -91,15 +91,22 @@ class TestTensorIf:
         with pytest.raises(Dy2StaticError, match=r"test_dy2static.py:\d+"):
             sf(paddle.to_tensor(np.ones((4,), np.float32)))
 
-    def test_return_in_branch_tensor_cond_errors_with_line(self):
+    def test_return_in_branch_tensor_cond_converts(self):
+        # reference return_transformer.py: early return under a tensor
+        # condition becomes flag+value threading through lax.cond
         def f(x):
             if x.sum() > 0:
                 return x * 2.0
             return x
 
         sf = to_static(f)
-        with pytest.raises(Dy2StaticError, match=r"test_dy2static.py:\d+"):
-            sf(paddle.to_tensor(np.ones((2,), np.float32)))
+        np.testing.assert_allclose(
+            np.asarray(sf(paddle.to_tensor(np.ones((2,), np.float32))).value),
+            2 * np.ones(2))
+        np.testing.assert_allclose(
+            np.asarray(
+                sf(paddle.to_tensor(-np.ones((2,), np.float32))).value),
+            -np.ones(2))
 
     def test_return_in_branch_python_cond_ok(self):
         def f(x, flag=False):
@@ -370,3 +377,238 @@ def test_for_range_start_stop_step_tensor():
 
     out = f(paddle.to_tensor(np.asarray(1)), paddle.to_tensor(np.asarray(8)))
     np.testing.assert_allclose(np.asarray(out.value), [4.0])  # 1,3,5,7
+
+
+class TestEscapeRewrites:
+    """RETURN-flag + break/continue rewrites (reference
+    return_transformer.py / break_continue_transformer.py): escapes under
+    tensor conditions become flag threading through lax control flow;
+    concrete conditions keep exact Python semantics."""
+
+    def _jit(self, f):
+        conv = convert_to_static(f)
+        return jax.jit(lambda *a: conv(*[_T(x) for x in a]).value)
+
+    def test_early_return_traced_both_paths(self):
+        def f(x):
+            if x.sum() > 0:
+                return x * 2.0
+            return x - 1.0
+
+        j = self._jit(f)
+        np.testing.assert_allclose(
+            np.asarray(j(jnp.ones((2,), jnp.float32))), 2 * np.ones(2))
+        np.testing.assert_allclose(
+            np.asarray(j(-jnp.ones((2,), jnp.float32))), -2 * np.ones(2))
+
+    def test_nested_return_and_assignment_mix(self):
+        def f(x):
+            if x.sum() > 0:
+                if x.max() > 2.0:
+                    return x * 3.0
+                x = x + 1.0
+            return x
+
+        def ref(a):
+            if a.sum() > 0:
+                if a.max() > 2.0:
+                    return a * 3.0
+                a = a + 1.0
+            return a
+
+        j = self._jit(f)
+        for arr in (np.full((2,), 3.0, np.float32),
+                    np.ones((2,), np.float32), -np.ones((2,), np.float32)):
+            np.testing.assert_allclose(np.asarray(j(jnp.asarray(arr))),
+                                       ref(arr))
+
+    def test_grad_through_early_return(self):
+        def f(x):
+            if x.sum() > 0:
+                return x * 2.0
+            return x - 1.0
+
+        conv = convert_to_static(f)
+        g = jax.grad(lambda a: conv(_T(a)).value.sum())(
+            jnp.ones((2,), jnp.float32))
+        np.testing.assert_allclose(np.asarray(g), 2 * np.ones(2))
+        g = jax.grad(lambda a: conv(_T(a)).value.sum())(
+            -jnp.ones((2,), jnp.float32))
+        np.testing.assert_allclose(np.asarray(g), np.ones(2))
+
+    def test_break_in_tensor_while(self):
+        def f(x):
+            s = x
+            while s.sum() < 100.0:
+                s = s * 2.0
+                if s.sum() > 10.0:
+                    break
+            return s
+
+        def ref(a):
+            s = a
+            while s.sum() < 100.0:
+                s = s * 2.0
+                if s.sum() > 10.0:
+                    break
+            return s
+
+        j = self._jit(f)
+        a = np.ones((2,), np.float32)
+        np.testing.assert_allclose(np.asarray(j(jnp.asarray(a))), ref(a))
+
+    def test_continue_in_tensor_while(self):
+        def f(x):
+            i = x.sum() * 0.0
+            s = x.sum() * 0.0
+            while i < 5.0:
+                i = i + 1.0
+                if i == 3.0:
+                    continue
+                s = s + i
+        # 1+2+4+5
+            return s
+
+        j = self._jit(f)
+        assert float(j(jnp.ones((2,), jnp.float32))) == 12.0
+
+    def test_break_in_traced_for_range(self):
+        def f(x, n):
+            s = x.sum() * 0.0
+            for i in range(n):
+                s = s + 1.0
+                if s > 3.0:
+                    break
+            return s
+
+        j = self._jit(f)
+        out = j(jnp.ones((2,), jnp.float32), jnp.asarray(10))
+        assert float(out) == 4.0
+
+    def test_break_in_concrete_range_traced_flag(self):
+        # concrete bounds + traced break condition: the Python loop cannot
+        # exit early, but in-body guards make later iterations no-ops
+        def f(x):
+            s = x.sum() * 0.0
+            for i in range(10):
+                s = s + 1.0
+                if s > 3.0:
+                    break
+            return s
+
+        j = self._jit(f)
+        assert float(j(jnp.ones((2,), jnp.float32))) == 4.0
+
+    def test_python_concrete_escapes_keep_semantics(self):
+        calls = []
+
+        def f(x, flag=False):
+            if flag:
+                calls.append("t")
+                return x * 2.0
+            calls.append("f")
+            return x + 3.0
+
+        conv = convert_to_static(f)
+        out = conv(_T(jnp.zeros((2,), jnp.float32)))
+        np.testing.assert_allclose(np.asarray(out.value), 3 * np.ones(2))
+        assert calls == ["f"]  # true path never executed
+
+    def test_fall_off_end_eager_none_traced_raises(self):
+        def f(x):
+            if x.sum() > 0:
+                return x * 2.0
+
+        conv = convert_to_static(f)
+        assert conv(_T(jnp.asarray(-np.ones((2,), np.float32)))) is None
+        with pytest.raises(Dy2StaticError, match="explicit `return`"):
+            jax.jit(lambda a: conv(_T(a)).value)(
+                jnp.ones((2,), jnp.float32))
+
+    def test_return_value_in_traced_while_raises_clear(self):
+        def f(x):
+            s = x.sum()
+            while s < 10.0:
+                s = s * 2.0
+                if s > 5.0:
+                    return s * 100.0
+            return s
+
+        conv = convert_to_static(f)
+        with pytest.raises(Dy2StaticError, match="assign the result"):
+            jax.jit(lambda a: conv(_T(a)).value)(
+                jnp.ones((1,), jnp.float32))
+
+    def test_return_in_concrete_while_ok(self):
+        def f(x):
+            n = 0
+            while n < 5:
+                x = x + 1.0
+                if n == 2:
+                    return x * 10.0
+                n = n + 1
+            return x
+
+        conv = convert_to_static(f)
+        out = conv(_T(jnp.zeros((2,), jnp.float32)))
+        np.testing.assert_allclose(np.asarray(out.value), 30 * np.ones(2))
+
+    def test_return_exits_nested_opaque_loops(self):
+        # a lifted return must PHYSICALLY break every enclosing non-range
+        # loop, not just the innermost: no re-run side effects, no
+        # __pt_rv overwrite
+        effects = []
+
+        def f(x):
+            for a in [1, 2, 3]:
+                for b in [10, 20]:
+                    effects.append((a, b))
+                    if b == 10:
+                        return x + a
+            return x
+
+        conv = convert_to_static(f)
+        out = conv(_T(jnp.zeros((1,), jnp.float32)))
+        np.testing.assert_allclose(np.asarray(out.value), [1.0])
+        assert effects == [(1, 10)]  # outer loop did not keep iterating
+
+    def test_return_in_managed_loop_inside_generator_loop(self):
+        # the opaque outer loop must stop consuming its iterator once the
+        # managed inner loop's return flag is set
+        def gen():
+            i = 0
+            while True:
+                yield i
+                i += 1
+
+        def f(x, it):
+            for v in it:
+                for i in range(3):
+                    if i == 1:
+                        return x + v + i
+            return x
+
+        conv = convert_to_static(f)
+        g = gen()
+        out = conv(_T(jnp.zeros((1,), jnp.float32)), g)
+        np.testing.assert_allclose(np.asarray(out.value), [1.0])
+        assert next(g) == 1  # exactly one element was consumed
+
+    def test_while_with_try_break_still_terminates(self):
+        # a managed while whose body retains a REAL escape (break inside
+        # try) keeps its return-flag conjunct: the loop must terminate
+        def f(x):
+            n = 0
+            while n < 20:
+                n = n + 1
+                try:
+                    pass
+                except ValueError:
+                    break
+                if n == 5:
+                    return x + n
+            return x
+
+        conv = convert_to_static(f)
+        out = conv(_T(jnp.zeros((1,), jnp.float32)))
+        np.testing.assert_allclose(np.asarray(out.value), [5.0])
