@@ -6,10 +6,15 @@ the natural exporter traces it to a jaxpr and lowers each primitive to the
 matching ONNX op, writing the protobuf wire format directly (wire.py — no
 onnx/protobuf dependency exists in this environment).
 
-Covered primitives target the deploy-relevant surface: matmul family
-(dot_general), conv (conv_general_dilated), elementwise math, activations,
-reductions, shape ops, casts, select.  Anything else raises with the
-primitive's name so the gap is loud, not a corrupt file.
+Covered primitives target the deploy-relevant surface: the FULL dot_general
+space (arbitrary batch/contract dims via transpose+flatten+MatMul), conv
+(conv_general_dilated, NCHW/OIHW, loud on transposed/grouped-batch forms),
+elementwise math, activations, reductions, argmax/argmin, shape ops, casts,
+select/clamp, gather (embedding take), slice/dynamic_slice, concatenate,
+iota (constant-folded), and lax.scan (UNROLLED — static trip count, weights
+sliced via Gather), which is what lets GPT/BERT-class encoders with their
+scan-over-blocks export.  Anything else raises with the primitive's name so
+the gap is loud, not a corrupt file.
 
 ONNX field numbers follow onnx/onnx.proto (public, stable since IR v3).
 Opset 13, default domain.
@@ -106,36 +111,103 @@ class _Graph:
 # ---------------------------------------------------------------------------
 
 
+def _maybe_transpose(g, x, perm):
+    if list(perm) == list(range(len(perm))):
+        return x
+    return g.add("Transpose", [x], attrs=_attr_ints("perm", perm),
+                 hint="transpose")
+
+
+def _maybe_reshape(g, x, cur_shape, new_shape):
+    if tuple(cur_shape) == tuple(new_shape):
+        return x
+    return _lower_reshape_to(g, x, new_shape)
+
+
+def _prod(xs):
+    out = 1
+    for x in xs:
+        out *= int(x)
+    return out
+
+
 def _lower_dot_general(g, eqn, ins):
+    """General contraction: transpose both sides to [batch, free,
+    contract] / [batch, contract, free], flatten to rank 3, MatMul,
+    reshape to jax's output convention (batch dims, lhs free, rhs free).
+    The common 2-D matmul / leading-aligned-batch case degenerates to a
+    bare MatMul (no transpose/reshape nodes emitted)."""
     (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
     la, ra = eqn.invars[0].aval, eqn.invars[1].aval
     lhs, rhs = ins
-    # standard matmul patterns: contract last-of-lhs with second-to-last (or
-    # only) of rhs, batch dims leading and aligned — MatMul semantics
-    ln, rn = len(la.shape), len(ra.shape)
-    std = (list(lb) == list(range(ln - 2)) == list(rb)
-           and list(lc) == [ln - 1]
-           and list(rc) == [max(rn - 2, 0)])
-    if not std:
-        raise NotImplementedError(
-            f"ONNX export: dot_general with dimension_numbers "
-            f"{eqn.params['dimension_numbers']} is not a MatMul pattern")
-    return g.add("MatMul", [lhs, rhs], hint="matmul")
+    lshape, rshape = la.shape, ra.shape
+    lfree = [d for d in range(len(lshape)) if d not in lc and d not in lb]
+    rfree = [d for d in range(len(rshape)) if d not in rc and d not in rb]
+
+    perm_l = list(lb) + lfree + list(lc)
+    perm_r = list(rb) + list(rc) + rfree
+    lhs = _maybe_transpose(g, lhs, perm_l)
+    rhs = _maybe_transpose(g, rhs, perm_r)
+
+    bshape = [lshape[d] for d in lb]
+    lf_shape = [lshape[d] for d in lfree]
+    rf_shape = [rshape[d] for d in rfree]
+    cshape = [lshape[d] for d in lc]
+
+    if len(lc) == 1 and len(lfree) == 1 and len(rfree) == 1:
+        # transposed operands are already [*b, lf, c] x [*b, c, rf]:
+        # numpy-style MatMul semantics, output [*b, lf, rf] = jax's order
+        mm = g.add("MatMul", [lhs, rhs], hint="matmul")
+        return _cast_to_out_dtype(g, eqn, mm)
+
+    B, Fl, Fr, C = (_prod(bshape), _prod(lf_shape), _prod(rf_shape),
+                    _prod(cshape))
+    lhs = _maybe_reshape(g, lhs, [lshape[d] for d in perm_l], [B, Fl, C])
+    rhs = _maybe_reshape(g, rhs, [rshape[d] for d in perm_r], [B, C, Fr])
+    mm = g.add("MatMul", [lhs, rhs], hint="matmul")
+    out_shape = bshape + lf_shape + rf_shape  # jax dot_general convention
+    return _cast_to_out_dtype(
+        g, eqn, _maybe_reshape(g, mm, [B, Fl, Fr], out_shape))
+
+
+def _cast_to_out_dtype(g, eqn, name):
+    """dot_general/conv may accumulate to a wider dtype
+    (preferred_element_type): the ONNX op computes at input dtype, so a
+    Cast keeps the tensor matching the graph's declared output type."""
+    in_dt = np.dtype(eqn.invars[0].aval.dtype)
+    out_dt = np.dtype(eqn.outvars[0].aval.dtype)
+    if in_dt == out_dt:
+        return name
+    return g.add("Cast", [name], attrs=_attr_int("to", _DT[out_dt.name]),
+                 hint="cast")
 
 
 def _lower_conv(g, eqn, ins):
     p = eqn.params
     dn = p["dimension_numbers"]
-    # we emit NCHW/OIHW only (the lowering paddle_tpu's convs use)
-    if dn.lhs_spec != tuple(range(len(dn.lhs_spec))):
-        raise NotImplementedError("ONNX export: conv with non-NCHW layout")
+    # we emit NCHW/OIHW only (the lowering paddle_tpu's convs use); every
+    # other configuration must fail loudly, not produce a plain Conv with
+    # silently wrong semantics (transposed conv via lhs_dilation, grouped
+    # batches, permuted kernel/output layouts)
+    ident = tuple(range(len(dn.lhs_spec)))
+    if dn.lhs_spec != ident or dn.rhs_spec != ident or dn.out_spec != ident:
+        raise NotImplementedError(
+            "ONNX export: conv with non-NCHW/OIHW layout")
+    if any(d != 1 for d in p.get("lhs_dilation", ())):
+        raise NotImplementedError(
+            "ONNX export: conv with input (lhs) dilation — transposed "
+            "conv is not representable as ONNX Conv")
+    if p.get("batch_group_count", 1) != 1:
+        raise NotImplementedError(
+            "ONNX export: conv with batch_group_count != 1")
     attrs = _attr_ints("strides", p["window_strides"])
     pads = p["padding"]
     attrs += _attr_ints("pads", [lo for lo, _ in pads]
                         + [hi for _, hi in pads])
     attrs += _attr_ints("dilations", p["rhs_dilation"])
     attrs += _attr_int("group", p["feature_group_count"])
-    return g.add("Conv", list(ins), attrs=attrs, hint="conv")
+    return _cast_to_out_dtype(
+        g, eqn, g.add("Conv", list(ins), attrs=attrs, hint="conv"))
 
 
 def _reduce(op):
@@ -233,6 +305,151 @@ def _pool_attrs(p):
     return attrs, wd
 
 
+def _lower_gather(g, eqn, ins):
+    """Embedding-style take along a leading axis: ``operand[indices]``
+    (jnp.take axis=0).  jax expresses it as gather with a single start
+    index mapped to a collapsed axis and full slices elsewhere — exactly
+    ONNX Gather(axis) after dropping the trailing index-vector dim."""
+    dn = eqn.params["dimension_numbers"]
+    op_aval = eqn.invars[0].aval
+    idx_aval = eqn.invars[1].aval
+    sizes = eqn.params["slice_sizes"]
+    simple = (not dn.operand_batching_dims
+              and len(dn.start_index_map) == 1
+              and dn.start_index_map == dn.collapsed_slice_dims
+              and dn.start_index_map[0] == 0
+              and idx_aval.shape and idx_aval.shape[-1] == 1
+              and sizes[0] == 1
+              and tuple(sizes[1:]) == tuple(op_aval.shape[1:])
+              and tuple(dn.offset_dims)
+              == tuple(range(len(idx_aval.shape) - 1,
+                             len(idx_aval.shape) - 1 + len(sizes) - 1)))
+    if not simple:
+        raise NotImplementedError(
+            f"ONNX export: gather with dimension_numbers {dn} is not a "
+            f"take-along-leading-axis (embedding) pattern")
+    idx = _lower_reshape_to(g, ins[1], idx_aval.shape[:-1])
+    # jax's out-of-bounds modes must be reproduced — ONNX Gather on an OOB
+    # index is undefined behavior (onnxruntime raises), so PROMISE_IN_BOUNDS
+    # maps directly, CLIP/FILL_OR_DROP clamp the ids first and FILL_OR_DROP
+    # additionally zeroes the dropped rows
+    from jax.lax import GatherScatterMode as _GSM
+
+    mode = eqn.params.get("mode")
+    V = int(op_aval.shape[0])
+    idt = np.dtype(idx_aval.dtype)
+    if mode in (_GSM.CLIP, _GSM.FILL_OR_DROP, None):
+        lo = g.const(np.asarray(0, idt), "lo")
+        hi = g.const(np.asarray(V - 1, idt), "hi")
+        clipped = g.add("Clip", [idx, lo, hi], hint="clip")
+    else:
+        clipped = idx
+    gathered = g.add("Gather", [ins[0], clipped],
+                     attrs=_attr_int("axis", 0), hint="gather")
+    if mode in (_GSM.FILL_OR_DROP, None):
+        ok_lo = g.add("GreaterOrEqual",
+                      [idx, g.const(np.asarray(0, idt), "zero")], hint="ge")
+        ok_hi = g.add("Less", [idx, g.const(np.asarray(V, idt), "v")],
+                      hint="lt")
+        ok = g.add("And", [ok_lo, ok_hi], hint="ok")
+        # broadcast the validity mask over the trailing feature dims
+        ok = _lower_reshape_to(g, ok, tuple(idx_aval.shape[:-1])
+                               + (1,) * (len(op_aval.shape) - 1))
+        fv = eqn.params.get("fill_value")
+        fill = g.const(np.asarray(0 if fv is None else fv,
+                                  np.dtype(op_aval.dtype)), "fill")
+        gathered = g.add("Where", [ok, gathered, fill], hint="gatherfill")
+    return gathered
+
+
+def _lower_slice(g, eqn, ins):
+    p = eqn.params
+    starts = list(p["start_indices"])
+    ends = list(p["limit_indices"])
+    steps = list(p["strides"] or [1] * len(starts))
+    axes = list(range(len(starts)))
+    return g.add("Slice", [
+        ins[0], g.const(np.asarray(starts, np.int64), "starts"),
+        g.const(np.asarray(ends, np.int64), "ends"),
+        g.const(np.asarray(axes, np.int64), "axes"),
+        g.const(np.asarray(steps, np.int64), "steps")], hint="slice")
+
+
+def _lower_iota(g, eqn, ins):
+    p = eqn.params
+    shape, dim = p["shape"], p["dimension"]
+    ar = np.arange(shape[dim], dtype=np.dtype(p["dtype"]))
+    view = [1] * len(shape)
+    view[dim] = shape[dim]
+    return g.const(np.broadcast_to(ar.reshape(view), shape).copy(), "iota")
+
+
+def _lower_concatenate(g, eqn, ins):
+    return g.add("Concat", list(ins),
+                 attrs=_attr_int("axis", eqn.params["dimension"]),
+                 hint="concat")
+
+
+def _lower_dynamic_slice(g, eqn, ins):
+    """Runtime start indices: per-dim scalars → Cast(int64) → Reshape[1]
+    → Concat → Slice with ends = starts + slice_sizes."""
+    sizes = eqn.params["slice_sizes"]
+    nd = len(sizes)
+    parts = []
+    for k in range(nd):
+        s = g.add("Cast", [ins[1 + k]], attrs=_attr_int("to", _DT["int64"]),
+                  hint="cast")
+        parts.append(_lower_reshape_to(g, s, (1,)))
+    starts = g.add("Concat", parts, attrs=_attr_int("axis", 0),
+                   hint="starts")
+    # jax clamps each start into [0, dim - size] so the output shape is
+    # always exactly slice_sizes; an unclamped ONNX Slice would silently
+    # shrink the result for out-of-range starts
+    op_shape = eqn.invars[0].aval.shape
+    lo = g.const(np.zeros(nd, np.int64), "lo")
+    hi = g.const(np.asarray([int(d) - int(s)
+                             for d, s in zip(op_shape, sizes)], np.int64),
+                 "hi")
+    starts = g.add("Clip", [starts, lo, hi], hint="clipstarts")
+    ends = g.add("Add", [starts, g.const(np.asarray(sizes, np.int64),
+                                         "sizes")], hint="ends")
+    axes = g.const(np.asarray(range(nd), np.int64), "axes")
+    return g.add("Slice", [ins[0], starts, ends, axes], hint="dynslice")
+
+
+def _arg_reduce(op):
+    def f(g, eqn, ins):
+        p = eqn.params
+        axes = p.get("axes")
+        axis = int(axes[0]) if axes else 0
+        attrs = _attr_int("axis", axis) + _attr_int("keepdims", 0)
+        out = g.add(op, list(ins), attrs=attrs, hint=op.lower())
+        idx_dt = np.dtype(p["index_dtype"]).name
+        if idx_dt != "int64":
+            out = g.add("Cast", [out],
+                        attrs=_attr_int("to", _DT[idx_dt]), hint="cast")
+        return out
+
+    return f
+
+
+def _lower_clamp(g, eqn, ins):
+    lo, x, hi = ins
+    return g.add("Clip", [x, lo, hi], hint="clip")
+
+
+def _lower_log1p(g, eqn, ins):
+    one = g.const(np.asarray(1.0, eqn.invars[0].aval.dtype), "one")
+    return g.add("Log", [g.add("Add", [ins[0], one], hint="add")],
+                 hint="log1p")
+
+
+def _lower_expm1(g, eqn, ins):
+    one = g.const(np.asarray(1.0, eqn.invars[0].aval.dtype), "one")
+    return g.add("Sub", [g.add("Exp", [ins[0]], hint="exp"), one],
+                 hint="expm1")
+
+
 def _lower_reduce_window_max(g, eqn, ins):
     attrs, _ = _pool_attrs(eqn.params)
     return g.add("MaxPool", list(ins), attrs=attrs, hint="maxpool")
@@ -273,6 +490,16 @@ _LOWER = {
     "pad": _lower_pad,
     "reduce_window_max": _lower_reduce_window_max,
     "reduce_window_sum": _lower_reduce_window_sum,
+    "gather": _lower_gather,
+    "slice": _lower_slice,
+    "iota": _lower_iota,
+    "concatenate": _lower_concatenate,
+    "dynamic_slice": _lower_dynamic_slice,
+    "argmax": _arg_reduce("ArgMax"),
+    "argmin": _arg_reduce("ArgMin"),
+    "clamp": _lower_clamp,
+    "log1p": _lower_log1p,
+    "expm1": _lower_expm1,
 }
 
 
@@ -359,6 +586,46 @@ def emit_model(fn, example_args, producer="paddle_tpu") -> bytes:
             if prim == "reduce_sum":
                 env[eqn.outvars[0]] = _lower_reduce_sum13(
                     g, eqn, [ref(v) for v in eqn.invars])
+                continue
+            if prim == "scan":
+                # static trip count → UNROLL (deploy-friendly: flat graphs
+                # optimize better than ONNX Loop, and every iteration's
+                # weights slice folds to a Gather on the stacked tensor)
+                p = eqn.params
+                L, nc, nk = p["length"], p["num_consts"], p["num_carry"]
+                closed = p["jaxpr"]
+                body = closed.jaxpr
+                all_ins = [ref(v) for v in eqn.invars]
+                consts_in = all_ins[:nc]
+                carry = list(all_ins[nc:nc + nk])
+                xs = all_ins[nc + nk:]
+                n_ys = len(body.outvars) - nk
+                ys_parts = [[None] * L for _ in range(n_ys)]
+                for cv, c in zip(body.constvars, closed.consts):
+                    env[cv] = g.const(np.asarray(c), "param")
+                idxs = range(L - 1, -1, -1) if p["reverse"] else range(L)
+                for it in idxs:
+                    xs_i = [
+                        g.add("Gather",
+                              [x, g.const(np.asarray(it, np.int64), "i")],
+                              attrs=_attr_int("axis", 0), hint="xslice")
+                        for x in xs]
+                    for bv, name in zip(body.invars,
+                                        consts_in + carry + xs_i):
+                        env[bv] = name
+                    walk(body)
+                    carry = [ref(v) for v in body.outvars[:nk]]
+                    for j, ov in enumerate(body.outvars[nk:]):
+                        ys_parts[j][it] = _lower_reshape_to(
+                            g, ref(ov), (1,) + tuple(ov.aval.shape))
+                for v, name in zip(eqn.outvars[:nk], carry):
+                    env[v] = name
+                for j, v in enumerate(eqn.outvars[nk:]):
+                    env[v] = g.add("Concat", ys_parts[j],
+                                   attrs=_attr_int("axis", 0), hint="ys") \
+                        if L > 0 else g.const(
+                            np.zeros((0,) + tuple(v.aval.shape[1:]),
+                                     v.aval.dtype), "ys")
                 continue
             fnl = _LOWER.get(prim)
             if fnl is None:
