@@ -20,8 +20,8 @@ import paddle_tpu as paddle
 import paddle_tpu.nn.functional as F
 import paddle_tpu.tensor_api as TA
 
-from test_ops_sweep import OUT_CASES, _pos, _std
-from test_ops_sweep2 import ALL_CASES
+from test_ops_sweep import BF16_CASES, BF16_EXEMPT1, OUT_CASES, _pos, _std
+from test_ops_sweep2 import ALL_CASES, BF16_2, _BF16_EXEMPT
 
 
 def _ops_of(mod):
@@ -281,3 +281,30 @@ def test_every_public_op_is_swept():
         bare = name[2:] if name.startswith("F.") else name
         mod = F if name.startswith("F.") else TA
         assert hasattr(mod, bare), f"stale EXEMPT entry {name}"
+
+
+def test_bf16_tier_covers_swept_surface():
+    """bf16 coverage GATE (round-3 verdict Next #4): bf16 is THE TPU
+    dtype — every op the sweep covers must also run in the bf16 tolerance
+    tier or carry a reasoned exemption, so the tier cannot silently lag
+    newly added ops.  Same discipline as the surface gate above; matches
+    the per-place dtype rigor of reference op_test.py:270 dtype lists."""
+    # sweep1 (elementwise): exempt-list based, so coverage is structural —
+    # just check the exemptions stay real and the tier stays big
+    names1 = {c[0] for c in OUT_CASES}
+    assert not set(BF16_EXEMPT1) - names1, set(BF16_EXEMPT1) - names1
+    tier1 = {c[0] for c in BF16_CASES}
+    assert names1 - tier1 == set(BF16_EXEMPT1)
+
+    # sweep2 (full-surface tables): every case is in the tier or exempt
+    names2 = {c[0] for c in ALL_CASES}
+    tier2 = {c[0] for c in BF16_2}
+    exempt2 = set(_BF16_EXEMPT)
+    uncovered = names2 - tier2 - exempt2
+    assert not uncovered, (
+        f"ops missing from the bf16 tier (add to _BF16_EXTRA or give a "
+        f"reasoned _BF16_EXEMPT entry in test_ops_sweep2.py): "
+        f"{sorted(uncovered)}")
+
+    # the tier must stay at reference breadth (>200 ops at bf16)
+    assert len(tier1) + len(tier2) >= 200, (len(tier1), len(tier2))
