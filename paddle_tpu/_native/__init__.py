@@ -90,6 +90,13 @@ def ps_table() -> ctypes.CDLL:
         lib.pgt_degrees.argtypes = [ptr, i64p, u64, i64p]
         lib.pgt_sample_neighbors.argtypes = [ptr, i64p, u64, u64, i64p]
         lib.pgt_random_sample_nodes.argtypes = [ptr, u64, i64p]
+        lib.pgt_set_node_feat.restype = c.c_int
+        lib.pgt_set_node_feat.argtypes = [ptr, i64p, f32p, u64, u64]
+        lib.pgt_get_node_feat.restype = c.c_int
+        lib.pgt_get_node_feat.argtypes = [ptr, i64p, u64, u64, f32p,
+                                          c.POINTER(c.c_uint8)]
+        lib.pgt_feat_dim.restype = u64
+        lib.pgt_feat_dim.argtypes = [ptr]
         lib.pgt_save.restype = c.c_int
         lib.pgt_save.argtypes = [ptr, cstr]
         lib.pgt_load.restype = c.c_int

@@ -263,9 +263,13 @@ int pst_save(void* h, const char* path) {
 
 namespace {
 
+constexpr uint64_t kFeatMagic = 0xFEA7FEA75EC7104Eull;
+
 struct GraphTable {
   std::unordered_map<int64_t, std::vector<int64_t>> adj;
   std::unordered_map<int64_t, std::vector<float>> wts;  // parallel to adj
+  std::unordered_map<int64_t, std::vector<float>> feat;  // node features
+  uint64_t feat_dim = 0;  // fixed by the first set_node_feat call
   std::vector<int64_t> nodes;  // insertion order, for random node batches
   std::unordered_map<int64_t, size_t> node_pos;
   uint64_t edges = 0;
@@ -400,13 +404,64 @@ void pgt_random_sample_nodes(void* h, uint64_t k, int64_t* out) {
   for (uint64_t i = 0; i < k; ++i) out[i] = g->nodes[pick(g->rng)];
 }
 
-// snapshot: u64 n_nodes, then per node: id, degree, neighbors, weights?
+// Node feature blobs (reference common_graph_table.h:121
+// get_node_feat/set_node_feat): the half of the GNN path that feeds the
+// model — sampled subgraphs come back with their input vectors attached.
+// Feature dim is fixed by the first set call; a mismatch returns -1.
+int pgt_set_node_feat(void* h, const int64_t* ids, const float* feats,
+                      uint64_t n, uint64_t dim) {
+  auto* g = static_cast<GraphTable*>(h);
+  std::lock_guard<std::mutex> lk(g->mu);
+  if (dim == 0) return -1;
+  if (g->feat_dim == 0) g->feat_dim = dim;
+  if (g->feat_dim != dim) return -1;
+  for (uint64_t i = 0; i < n; ++i) {
+    g->touch(ids[i]);
+    auto& v = g->feat[ids[i]];
+    v.assign(feats + i * dim, feats + (i + 1) * dim);
+  }
+  return 0;
+}
+
+// out is [n * dim]; nodes with no stored feature fill with zeros and set
+// found[i] = 0 (found nullable).  dim must match the table's feat_dim
+// (0 allowed when the table holds no features yet: everything zero-fills).
+int pgt_get_node_feat(void* h, const int64_t* ids, uint64_t n,
+                      uint64_t dim, float* out, uint8_t* found) {
+  auto* g = static_cast<GraphTable*>(h);
+  std::lock_guard<std::mutex> lk(g->mu);
+  if (g->feat_dim != 0 && dim != g->feat_dim) return -1;
+  for (uint64_t i = 0; i < n; ++i) {
+    auto it = g->feat.find(ids[i]);
+    if (it == g->feat.end()) {
+      std::fill(out + i * dim, out + (i + 1) * dim, 0.0f);
+      if (found) found[i] = 0;
+    } else {
+      std::copy(it->second.begin(), it->second.end(), out + i * dim);
+      if (found) found[i] = 1;
+    }
+  }
+  return 0;
+}
+
+uint64_t pgt_feat_dim(void* h) {
+  auto* g = static_cast<GraphTable*>(h);
+  std::lock_guard<std::mutex> lk(g->mu);
+  return g->feat_dim;
+}
+
+// snapshot: u64 n_nodes, u64 flags (bit0 weighted, bit1 features), then
+// per node: id, degree, neighbors, weights?; if bit1: u64 feat_dim,
+// u64 n_feat, then per feature node: id + feat_dim floats.  Old files
+// (flags in {0,1}) load unchanged.
 int pgt_save(void* h, const char* path) {
   auto* g = static_cast<GraphTable*>(h);
   std::lock_guard<std::mutex> lk(g->mu);
   FILE* f = std::fopen(path, "wb");
   if (!f) return -1;
-  uint64_t hdr[2] = {g->nodes.size(), g->weighted ? 1ull : 0ull};
+  uint64_t flags = (g->weighted ? 1ull : 0ull)
+                   | (g->feat.empty() ? 0ull : 2ull);
+  uint64_t hdr[2] = {g->nodes.size(), flags};
   std::fwrite(hdr, sizeof(uint64_t), 2, f);
   for (int64_t id : g->nodes) {
     auto it = g->adj.find(id);
@@ -416,6 +471,18 @@ int pgt_save(void* h, const char* path) {
     if (d) {
       std::fwrite(it->second.data(), sizeof(int64_t), d, f);
       if (g->weighted) std::fwrite(g->wts[id].data(), sizeof(float), d, f);
+    }
+  }
+  if (flags & 2ull) {
+    // magic guards the section boundary so truncated/corrupt files fail
+    // with -3 instead of misparsing; NOTE this is a format extension —
+    // pre-feature loaders misread flags=2 as 'weighted', so feature
+    // snapshots require this loader version or newer
+    uint64_t fhdr[3] = {kFeatMagic, g->feat_dim, g->feat.size()};
+    std::fwrite(fhdr, sizeof(uint64_t), 3, f);
+    for (const auto& kv : g->feat) {
+      std::fwrite(&kv.first, sizeof(int64_t), 1, f);
+      std::fwrite(kv.second.data(), sizeof(float), g->feat_dim, f);
     }
   }
   std::fclose(f);
@@ -434,10 +501,12 @@ int pgt_load(void* h, const char* path) {
   }
   g->adj.clear();
   g->wts.clear();
+  g->feat.clear();
+  g->feat_dim = 0;
   g->nodes.clear();
   g->node_pos.clear();
   g->edges = 0;
-  g->weighted = hdr[1] != 0;
+  g->weighted = (hdr[1] & 1ull) != 0;
   for (uint64_t i = 0; i < hdr[0]; ++i) {
     int64_t id;
     uint64_t d;
@@ -463,6 +532,47 @@ int pgt_load(void* h, const char* path) {
       }
     }
     g->edges += d;
+  }
+  if (hdr[1] & 2ull) {
+    uint64_t fhdr[3];
+    if (std::fread(fhdr, sizeof(uint64_t), 3, f) != 3 ||
+        fhdr[0] != kFeatMagic) {
+      std::fclose(f);
+      return -3;
+    }
+    // bound the claimed sizes against the bytes actually remaining, so a
+    // corrupt header can never drive a huge allocation before the short
+    // read would fail
+    long pos = std::ftell(f);
+    std::fseek(f, 0, SEEK_END);
+    long end = std::ftell(f);
+    std::fseek(f, pos, SEEK_SET);
+    uint64_t remain = end > pos ? static_cast<uint64_t>(end - pos) : 0;
+    if (fhdr[1] == 0 || fhdr[1] > remain / sizeof(float)) {
+      std::fclose(f);
+      return -3;
+    }
+    uint64_t per = sizeof(int64_t) + fhdr[1] * sizeof(float);
+    if (fhdr[2] > remain / per) {
+      std::fclose(f);
+      return -3;
+    }
+    g->feat_dim = fhdr[1];
+    for (uint64_t i = 0; i < fhdr[2]; ++i) {
+      int64_t id;
+      if (std::fread(&id, sizeof(int64_t), 1, f) != 1) {
+        std::fclose(f);
+        return -3;
+      }
+      g->touch(id);
+      auto& v = g->feat[id];
+      v.resize(g->feat_dim);
+      if (std::fread(v.data(), sizeof(float), g->feat_dim, f)
+          != g->feat_dim) {
+        std::fclose(f);
+        return -3;
+      }
+    }
   }
   std::fclose(f);
   return 0;

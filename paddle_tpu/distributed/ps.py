@@ -210,6 +210,15 @@ class DistributedGraphTable:
     def degrees(self, ids):
         return self.client.node_degrees(self.tid, ids)
 
+    def set_node_feat(self, ids, feats):
+        self.client.set_node_feat(self.tid, ids, feats)
+
+    def get_node_feat(self, ids):
+        """([n..., dim] features, [n...] found mask) — accepts the [n, k]
+        output of :meth:`sample_neighbors` directly (padding -1 rows come
+        back zero-filled with found=False)."""
+        return self.client.get_node_feat(self.tid, ids)
+
     def random_nodes(self, k: int):
         return self.client.random_sample_nodes(self.tid, k)
 

@@ -43,7 +43,8 @@ OPS = {"create": 1, "pull": 2, "push": 3, "pull_dense": 4, "push_dense": 5,
        "barrier_get": 11, "err": 12, "push_delta": 13,
        # graph table service (common_graph_table.cc role)
        "g_create": 14, "g_add_edges": 15, "g_sample": 16, "g_degree": 17,
-       "g_nodes": 18, "g_add_nodes": 19, "g_stat": 20}
+       "g_nodes": 18, "g_add_nodes": 19, "g_stat": 20,
+       "g_set_feat": 21, "g_get_feat": 22}
 _OP_NAMES = {v: k for k, v in OPS.items()}
 
 
@@ -311,6 +312,43 @@ class PSServer:
                     len(ids),
                     out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
                 return _pack("g_degree", {"ok": True}, {"degrees": out})
+            if op == "g_set_feat":
+                t = self._tables[meta["tid"]]
+                ids = np.ascontiguousarray(arrays["ids"], np.int64)
+                feats = np.ascontiguousarray(arrays["feats"], np.float32)
+                dim = feats.shape[1] if feats.ndim == 2 else int(meta["dim"])
+                rc = lib.pgt_set_node_feat(
+                    t["h"],
+                    ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                    feats.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                    len(ids), dim)
+                if rc != 0:
+                    return _pack("err", {"error": (
+                        f"set_node_feat: feature dim {dim} conflicts with "
+                        f"the table's established dim "
+                        f"{int(lib.pgt_feat_dim(t['h']))}")}, {})
+                return _pack("g_set_feat", {"ok": True}, {})
+            if op == "g_get_feat":
+                t = self._tables[meta["tid"]]
+                ids = np.ascontiguousarray(arrays["ids"], np.int64)
+                dim = int(meta["dim"]) if meta.get("dim") \
+                    else int(lib.pgt_feat_dim(t["h"]))
+                out = np.zeros((len(ids), max(dim, 1)), np.float32)
+                found = np.zeros(len(ids), np.uint8)
+                if dim:
+                    rc = lib.pgt_get_node_feat(
+                        t["h"],
+                        ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                        len(ids), dim,
+                        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                        found.ctypes.data_as(
+                            ctypes.POINTER(ctypes.c_uint8)))
+                    if rc != 0:
+                        return _pack("err", {"error": (
+                            f"get_node_feat: dim {dim} != table dim "
+                            f"{int(lib.pgt_feat_dim(t['h']))}")}, {})
+                return _pack("g_get_feat", {"ok": True, "dim": dim},
+                             {"feats": out[:, :dim], "found": found})
             if op == "g_stat":
                 # read-only: must not touch the sampling RNG
                 t = self._tables[meta["tid"]]
@@ -576,6 +614,62 @@ class PSClient:
         for s in range(self.S):
             res[srv == s] = out[s][1]["degrees"]
         return res
+
+    def set_node_feat(self, tid: int, ids, feats):
+        """Store per-node float feature vectors on the owning shards
+        (reference common_graph_table.h:121 set_node_feat).  ``feats`` is
+        [n, dim]; the dim is fixed by the first call table-wide."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        feats = np.asarray(feats, np.float32)
+        if feats.ndim != 2 or len(feats) != len(ids) or feats.shape[1] == 0:
+            raise ValueError(f"feats must be [{len(ids)}, dim>=1], got "
+                             f"{feats.shape}")
+        if (ids < 0).any():
+            # get_node_feat treats negative ids as sample padding — a
+            # stored-but-unreadable feature would be a silent write loss
+            raise ValueError("negative node ids cannot carry features")
+        srv = ids % self.S
+        metas, arrs = [], []
+        for s in range(self.S):
+            m = srv == s
+            metas.append({"tid": tid, "dim": int(feats.shape[1])})
+            arrs.append({"ids": ids[m], "feats": feats[m]})
+        self._fan("g_set_feat", metas, arrs)
+
+    def get_node_feat(self, tid: int, ids):
+        """[n, dim] float32 features for ``ids`` plus an [n] bool found
+        mask; unknown nodes (including -1 sample padding) zero-fill with
+        found=False, so sampled neighborhoods feed the model directly."""
+        ids = np.asarray(ids, np.int64)
+        shape = ids.shape
+        flat = ids.reshape(-1)
+        srv = flat % self.S
+        # -1 padding from sample_neighbors: never ask a shard for it
+        srv = np.where(flat < 0, -1, srv)
+        metas, arrs = [], []
+        for s in range(self.S):
+            metas.append({"tid": tid, "dim": 0})
+            arrs.append({"ids": flat[srv == s]})
+        out = self._fan("g_get_feat", metas, arrs)
+        dims = [out[s][0]["dim"] for s in range(self.S)]
+        nonzero = sorted({d for d in dims if d})
+        if len(nonzero) > 1:
+            # a shard restored from a different-dim snapshot must be LOUD,
+            # not silently zero-filled training data
+            raise RuntimeError(
+                f"graph table {tid}: shards disagree on feature dim "
+                f"(per-shard dims {dims}); reload matching snapshots")
+        dim = nonzero[0] if nonzero else 0
+        res = np.zeros((len(flat), dim), np.float32)
+        found = np.zeros(len(flat), bool)
+        for s in range(self.S):
+            m = srv == s
+            fe = out[s][1]["feats"]
+            if fe.shape[1] == dim:  # dim-0 shard = no features stored there
+                res[m] = fe
+                found[m] = out[s][1]["found"].astype(bool)
+        return (res.reshape(shape + (dim,)),
+                found.reshape(shape))
 
     def random_sample_nodes(self, tid: int, k: int) -> np.ndarray:
         """k nodes drawn ~uniformly across the whole distributed graph:

@@ -146,3 +146,62 @@ class TestGraphTable:
         assert graph.degrees([0])[0] == 4
         st = graph.stat()
         assert st["num_edges"] == len(EDGES)
+
+    def test_node_features_roundtrip_sharded(self, graph):
+        # reference common_graph_table.h:121 get/set_node_feat: features
+        # live on the node's owning shard; ids 0..5 span both parities
+        _build(graph)
+        ids = np.arange(6)
+        feats = (np.arange(24, dtype=np.float32).reshape(6, 4) + 1) / 7.0
+        graph.set_node_feat(ids, feats)
+        got, found = graph.get_node_feat([5, 0, 3, 2])
+        assert found.all()
+        np.testing.assert_allclose(got, feats[[5, 0, 3, 2]])
+
+    def test_sampled_neighborhood_comes_back_with_features(self, graph):
+        # the GNN input path: sample a neighborhood, pull its features in
+        # the sampled [n, k] layout — padding rows zero-filled, found=False
+        _build(graph)
+        ids = np.array([0, 1, 3, 10])
+        feats = np.random.default_rng(0).standard_normal(
+            (11, 3)).astype(np.float32)
+        graph.set_node_feat(np.arange(11), feats)
+        nbrs = graph.sample_neighbors(ids, k=3)  # [4, 3] with -1 padding
+        got, found = graph.get_node_feat(nbrs)
+        assert got.shape == (4, 3, 3) and found.shape == (4, 3)
+        for i in range(nbrs.shape[0]):
+            for j in range(nbrs.shape[1]):
+                if nbrs[i, j] < 0:
+                    assert not found[i, j]
+                    np.testing.assert_array_equal(got[i, j], 0.0)
+                else:
+                    assert found[i, j]
+                    np.testing.assert_allclose(got[i, j], feats[nbrs[i, j]])
+
+    def test_feature_dim_mismatch_is_loud(self, graph):
+        _build(graph)
+        graph.set_node_feat([0, 2], np.ones((2, 4), np.float32))
+        with pytest.raises(RuntimeError, match="dim"):
+            graph.set_node_feat([4], np.ones((1, 5), np.float32))
+
+    def test_unknown_node_zero_fills(self, graph):
+        _build(graph)
+        graph.set_node_feat([0], np.full((1, 2), 3.5, np.float32))
+        got, found = graph.get_node_feat([0, 999])
+        assert found.tolist() == [True, False]
+        np.testing.assert_allclose(got[0], 3.5)
+        np.testing.assert_array_equal(got[1], 0.0)
+
+    def test_features_survive_save_load(self, graph, tmp_path):
+        _build(graph)
+        ids = np.arange(6)
+        feats = np.random.default_rng(1).standard_normal(
+            (6, 5)).astype(np.float32)
+        graph.set_node_feat(ids, feats)
+        d = str(tmp_path / "gsnap_feat")
+        graph.client.save(d)
+        graph.set_node_feat(ids, np.zeros((6, 5), np.float32))
+        graph.client.load(d)
+        got, found = graph.get_node_feat(ids)
+        assert found.all()
+        np.testing.assert_allclose(got, feats)
