@@ -244,6 +244,12 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
             node.vjp_fn = None  # free residuals eagerly (reference GC analog)
         for inp, g in zip(node.inputs, in_grads):
             if g is None:
+                # a None cotangent still retires this edge's readiness
+                # count — otherwise leaf_pending never reaches zero and the
+                # Reducer's as-ready bucket flush for that parameter only
+                # happens at finalize(), losing the comm/compute overlap
+                if inp._node is None and not inp.stop_gradient:
+                    _leaf_done(inp)
                 continue
             for hook in inp._hooks:
                 res = hook(_wrap_hook_arg(inp, g))

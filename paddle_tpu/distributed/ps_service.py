@@ -323,7 +323,7 @@ class PSServer:
                     feats.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
                     len(ids), dim)
                 if rc != 0:
-                    return _pack("err", {"error": (
+                    return _pack("err", {"ok": False, "err": (
                         f"set_node_feat: feature dim {dim} conflicts with "
                         f"the table's established dim "
                         f"{int(lib.pgt_feat_dim(t['h']))}")}, {})
@@ -344,7 +344,7 @@ class PSServer:
                         found.ctypes.data_as(
                             ctypes.POINTER(ctypes.c_uint8)))
                     if rc != 0:
-                        return _pack("err", {"error": (
+                        return _pack("err", {"ok": False, "err": (
                             f"get_node_feat: dim {dim} != table dim "
                             f"{int(lib.pgt_feat_dim(t['h']))}")}, {})
                 return _pack("g_get_feat", {"ok": True, "dim": dim},

@@ -30,20 +30,20 @@ def _as_tensor(x):
 
 
 def _to_batches(data, batch_size, shuffle=False, seed=0):
-    """Accepts a DataLoader-like iterable (yields tuples) or a pair of
-    array-likes (features, labels)."""
+    """Accepts a DataLoader-like iterable (yields tuples) or a tuple of
+    array-likes — classically (features, labels), but any arity works so a
+    multi-input Model's predict data ((x1, x2, x3)) batches correctly."""
     if hasattr(data, "__iter__") and not isinstance(data, (tuple, list)):
         yield from data
         return
-    xs, ys = data
-    xs, ys = np.asarray(xs), np.asarray(ys)
-    n = len(xs)
+    arrays = [np.asarray(a) for a in data]
+    n = len(arrays[0])
     idx = np.arange(n)
     if shuffle:
         np.random.default_rng(seed).shuffle(idx)
     for i in range(0, n - batch_size + 1, batch_size):
         sel = idx[i:i + batch_size]
-        yield xs[sel], ys[sel]
+        yield tuple(a[sel] for a in arrays)
 
 
 def _metric_update(m, out, label):
@@ -170,6 +170,10 @@ class _StaticGraphAdapter:
 class Model:
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
+        self._inputs = inputs if inputs is None or isinstance(
+            inputs, (list, tuple)) else [inputs]
+        self._labels = labels if labels is None or isinstance(
+            labels, (list, tuple)) else [labels]
         self._optimizer = None
         self._loss = None
         self._metrics: Sequence = ()
@@ -301,22 +305,34 @@ class Model:
             logs.update(_metric_logs(m))
         return logs
 
+    def _predict_inputs(self, batch):
+        """Split a predict batch into network inputs.  The declared input
+        spec (Model(inputs=...)) decides the arity when present — the
+        reference splits via _inputs the same way, so unlabeled
+        multi-input test data is not misread as (inputs..., label).  The
+        trailing-element-is-label heuristic only applies with no spec."""
+        if not isinstance(batch, (tuple, list)):
+            return [batch]
+        if self._inputs is not None:
+            n = len(self._inputs)
+            if len(batch) < n:
+                raise ValueError(
+                    f"predict batch has {len(batch)} elements but the "
+                    f"Model declares {n} inputs")
+            return list(batch[:n])
+        return list(batch[:-1]) if len(batch) > 1 else list(batch)
+
     def predict(self, test_data, batch_size=32):
         outs = []
         if self._adapter is not None:
             for batch in _to_batches(test_data, batch_size):
-                xs = (list(batch[:-1]) or list(batch)) \
-                    if isinstance(batch, (tuple, list)) else [batch]
+                xs = self._predict_inputs(batch)
                 outs.append(np.asarray(self._adapter.predict_batch(xs)))
             return outs
         self.network.eval()
         try:
             for batch in _to_batches(test_data, batch_size):
-                if isinstance(batch, (tuple, list)):
-                    # all-but-label inputs (multi-input nets get them all)
-                    xs = list(batch[:-1]) if len(batch) > 1 else list(batch)
-                else:  # bare array batch: one positional input
-                    xs = [batch]
+                xs = self._predict_inputs(batch)
                 out = self.network(*[Tensor(np.asarray(x), True) for x in xs])
                 outs.append(out.numpy())
         finally:

@@ -381,18 +381,24 @@ class _ChildProc:
                     f"unexpectedly (exitcode {self.proc.returncode})")
         raise _PipelineStop  # a sent request stays pending → drained later
 
-    def request(self, i, idxs, stop: threading.Event):
-        """Returns the child's sample list for batch ``i``."""
+    def request(self, i, idxs, stop: threading.Event, rseed=None):
+        """Returns the child's sample list for batch ``i``; ``rseed``
+        reseeds the child's numpy RNG first (batch-index-derived
+        augmentation randomness — see _worker.py)."""
         while self._pending:  # drain a previously aborted wait's response
             self._read_one(stop)
             self._pending = False
-        self._worker.write_frame(self._cmd_f, (i, list(idxs)))
+        self._worker.write_frame(self._cmd_f, (i, list(idxs), rseed))
         self._pending = True
-        _, samples, err = self._read_one(stop)
+        ret_i, samples, err = self._read_one(stop)
         self._pending = False
         if err is not None:
             raise RuntimeError(
                 f"DataLoader worker process {self.worker_id} failed:\n{err}")
+        if ret_i != i:  # cheap lockstep-consistency check on the pipe
+            raise RuntimeError(
+                f"DataLoader worker process {self.worker_id} protocol "
+                f"desync: requested batch {i}, got response for {ret_i}")
         return samples
 
     def shutdown(self):
@@ -419,11 +425,33 @@ def _shutdown_pool(children):
         c.shutdown()
 
 
-def _worker_seed() -> int:
-    """Child RNG seed that never consumes from the parent's global numpy
-    stream (a np.random draw here would silently shift seeded shuffle
-    orders vs num_workers=0)."""
-    return int.from_bytes(os.urandom(4), "little")
+def _seed_base() -> int:
+    """Deterministic function of the CURRENT global numpy RNG state,
+    read WITHOUT consuming from it (a np.random draw here would silently
+    shift seeded shuffle orders vs num_workers=0, and os.urandom would
+    make worker-side augmentation irreproducible under a user's
+    np.random.seed — the reference derives base_seed + worker_id).
+    Hashes keys AND stream position: the MT key block only twists every
+    624 draws, so state[1] alone would repeat across nearby epochs."""
+    import zlib
+
+    state = np.random.get_state()  # pure read: no stream consumption
+    return zlib.crc32(np.asarray(state[1]).tobytes()
+                      + int(state[2]).to_bytes(8, "little"))
+
+
+def _worker_seed(k: int = 0, base: int | None = None) -> int:
+    """Per-worker child seed (worker_init_fn reproducibility).  Per-BATCH
+    augmentation randomness uses _batch_seed instead, so values don't
+    depend on which child the work-stealing queue picks."""
+    if base is None:
+        base = _seed_base()
+    return int(np.random.SeedSequence([base, k]).generate_state(1)[0])
+
+
+def _batch_seed(base: int, i: int) -> int:
+    return int(np.random.SeedSequence([base, 0x5EED, i])
+               .generate_state(1)[0])
 
 
 class _ProcessPool:
@@ -436,12 +464,17 @@ class _ProcessPool:
     DataLoader falls back to ephemeral children."""
 
     def __init__(self, loader, nw: int):
+        import threading
         import weakref
 
         self.busy = False
+        # guards the busy check-and-set: two threads starting iterators
+        # concurrently must not BOTH borrow the pool (the per-child pipes
+        # are lockstep; interleaved requests would corrupt batches)
+        self.lock = threading.Lock()
         self.children = [
             _ChildProc(loader.dataset, loader.worker_init_fn, k, nw,
-                       _worker_seed()) for k in range(nw)]
+                       _worker_seed(k)) for k in range(nw)]
         self._finalizer = weakref.finalize(self, _shutdown_pool,
                                            self.children)
 
@@ -470,17 +503,26 @@ def _run_pipeline(st: _PipelineState, loader, nw: int, pool=None):
 
         return work, (lambda: None)
 
+    # seed base snapshot BEFORE any thread starts: the feeder thread
+    # consumes the global numpy stream (shuffle), so deriving seeds lazily
+    # inside worker threads would race it and break reproducibility
+    base = _seed_base() if process_mode else 0
+
     def make_process_work(k):
         if pool is not None:
             child = pool.children[k]
             cleanup = lambda: None  # the pool owns the child's lifetime
         else:
             child = _ChildProc(loader.dataset, loader.worker_init_fn, k, nw,
-                               _worker_seed())
+                               _worker_seed(k, base))
             cleanup = child.shutdown
 
         def work(i, idxs):
-            return loader.collate_fn(child.request(i, idxs, st.stop))
+            # per-batch reseed: augmentation is a function of (epoch base,
+            # batch index) — identical across runs no matter which child
+            # serves the batch, fresh per epoch even on a persistent pool
+            return loader.collate_fn(
+                child.request(i, idxs, st.stop, _batch_seed(base, i)))
 
         return work, cleanup
 
@@ -601,10 +643,11 @@ class _PrefetchIter:
         self._finished = False
         pool = getattr(loader, "_pool", None)
         if pool is not None:
-            if pool.busy:
-                pool = None  # concurrent iterator: ephemeral children
-            else:
-                pool.busy = True
+            with pool.lock:
+                if pool.busy:
+                    pool = None  # concurrent iterator: ephemeral children
+                else:
+                    pool.busy = True
         ordered_gen = _run_pipeline(st, loader, nw, pool)
         self._pf = DevicePrefetcher(ordered_gen, depth=loader.prefetch_factor,
                                     transform=_to_device)
@@ -663,6 +706,7 @@ class DataLoader:
         self.worker_init_fn = worker_init_fn
         self.persistent_workers = persistent_workers
         self._pool = None
+        self._pool_lock = threading.Lock()
         self.prefetch_factor = prefetch_factor
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if isinstance(dataset, FileDataset):
@@ -695,18 +739,24 @@ class DataLoader:
         if self._iterable_mode:
             return self._iter_iterable()
         if self.num_workers > 0:
-            if (self.persistent_workers and self.worker_mode == "process"
-                    and self._pool is None):
-                self._pool = _ProcessPool(self, max(1, self.num_workers))
+            if self.persistent_workers and self.worker_mode == "process":
+                # creation check-and-set under the same discipline as the
+                # pool's busy flag: two threads iterating concurrently must
+                # not each spawn (and leak) a child pool
+                with self._pool_lock:
+                    if self._pool is None:
+                        self._pool = _ProcessPool(self,
+                                                  max(1, self.num_workers))
             return _PrefetchIter(self)
         return self._iter_single()
 
     def close(self):
         """Shut down the persistent worker pool (if any); iterating again
         respawns it."""
-        if self._pool is not None:
-            self._pool.close()
-            self._pool = None
+        with self._pool_lock:
+            if self._pool is not None:
+                self._pool.close()
+                self._pool = None
 
     def _iter_native(self):
         """C++ feeder → Tensor wrap → device prefetch queue.  The feeder

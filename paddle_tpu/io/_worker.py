@@ -11,8 +11,14 @@ env, so even a jax-importing dataset can never claim the TPU tunnel.
 
 Frame protocol (length-prefixed pickle, request/response lockstep):
   parent→child:  (sys_path,)  then  (dataset, worker_init_fn, wid, nw, seed)
-                 then  (i, idxs) per batch;  None = clean shutdown
+                 then  (i, idxs, rseed) per batch;  None = clean shutdown
   child→parent:  (i, samples, None)  or  (i, None, traceback_str)
+
+``rseed`` (when not None) reseeds the child's global numpy RNG before
+serving batch ``i``: the parent derives it from (per-epoch base, batch
+index), so worker-side augmentation depends only on the batch — identical
+across runs regardless of which child the work-stealing queue hands the
+batch to, and fresh each epoch even for a persistent pool.
 """
 import os
 import pickle
@@ -62,8 +68,11 @@ def main(argv):
         msg = read_frame(inp)
         if msg is None:
             return 0
-        i, idxs = msg
+        i, idxs = msg[0], msg[1]
+        rseed = msg[2] if len(msg) > 2 else None
         try:
+            if rseed is not None:
+                np.random.seed(rseed % (2 ** 32))
             write_frame(out, (i, [dataset[j] for j in idxs], None))
         except BaseException:
             write_frame(out, (i, None, traceback.format_exc()))

@@ -25,6 +25,33 @@ class GilHeavyDataset:
         return self.n
 
 
+class TimestampingGilDataset:
+    """GIL-bound work that also reports WHO ran it and WHEN: each item
+    returns [idx, pid, enter_ns, exit_ns] (CLOCK_MONOTONIC is system-wide
+    on Linux, so the timestamps are comparable across worker processes).
+    Lets a test assert concurrent in-flight service on ANY core count:
+    if the parent dispatches to children in parallel, wall-clock intervals
+    from different pids overlap even when one core timeshares them."""
+
+    def __init__(self, n=16, work=200_000):
+        self.n = n
+        self.work = work
+
+    def __getitem__(self, idx):
+        import os
+        import time
+
+        enter = time.monotonic_ns()
+        acc = 0
+        for i in range(self.work):
+            acc += (i ^ idx) & 7
+        return np.array([idx, os.getpid(), enter, time.monotonic_ns()],
+                        dtype=np.int64)
+
+    def __len__(self):
+        return self.n
+
+
 class SleepDataset:
     """I/O-bound stand-in: sleeps overlap across workers on any core count."""
 
@@ -52,3 +79,18 @@ class FailingDataset:
 
     def __len__(self):
         return 8
+
+
+class RandomAugmentDataset:
+    """__getitem__ draws from the worker-local numpy stream — tests that
+    per-worker seeds derive deterministically from the parent's seeded
+    global RNG state (reproducible augmentation), without consuming it."""
+
+    def __init__(self, n=8):
+        self.n = n
+
+    def __getitem__(self, idx):
+        return np.array([idx, np.random.randint(0, 1 << 30)], np.int64)
+
+    def __len__(self):
+        return self.n

@@ -117,3 +117,50 @@ class TestDualBackend:
         assert logs_dy["acc"] > 0.8 and logs_st["acc"] > 0.8
         assert abs(logs_dy["eval_loss"] - logs_st["eval_loss"]) < 0.2, (
             logs_dy, logs_st)
+
+
+class TestPredictInputArity:
+    def test_unlabeled_multi_input_predict_uses_declared_spec(self):
+        # (x1, x2) test tuples with a declared 2-input spec: both elements
+        # are inputs — the last must NOT be dropped as a label (reference
+        # splits via the Model's input spec)
+        class TwoIn(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(8, 2)
+
+            def forward(self, a, b):
+                return self.fc(a + b)
+
+        X1, _ = _data(64, seed=1)
+        X2, _ = _data(64, seed=2)
+        paddle.seed(3)
+        model = Model(TwoIn(), inputs=["a", "b"])
+        preds = model.predict((X1, X2), batch_size=32)
+        assert len(preds) == 2 and preds[0].shape == (32, 2)
+        # parity with calling the network directly
+        net_out = model.network(
+            paddle.to_tensor(X1[:32]), paddle.to_tensor(X2[:32])).numpy()
+        np.testing.assert_allclose(preds[0], net_out, rtol=1e-6)
+
+    def test_three_input_predict(self):
+        class ThreeIn(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(8, 2)
+
+            def forward(self, a, b, c):
+                return self.fc(a + b - c)
+
+        xs = [_data(64, seed=s)[0] for s in (1, 2, 3)]
+        paddle.seed(5)
+        model = Model(ThreeIn(), inputs=["a", "b", "c"])
+        preds = model.predict(tuple(xs), batch_size=32)
+        assert len(preds) == 2 and preds[0].shape == (32, 2)
+
+    def test_labeled_data_with_spec_ignores_trailing_label(self):
+        X, Y = _data(64)
+        paddle.seed(4)
+        model = Model(_net(), inputs=["x"])
+        preds = model.predict((X, Y), batch_size=32)
+        assert len(preds) == 2 and preds[0].shape == (32, 2)
