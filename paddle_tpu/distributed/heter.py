@@ -25,6 +25,16 @@ Fault tolerance: transient server loss (crash/restart) is retried — the
 client reconnects, re-creates the table on the fresh server, reloads the
 last snapshot when ``snapshot_dir`` is set, and repeats the op (the
 reference PS-client's retry/reregister path).
+
+Bounded-time degradation (``degrade="stale"`` + ``op_budget``): instead of
+blocking in lockstep retries, a pull that exhausts its wall-clock budget is
+served from the client-side row cache (zeros for never-seen ids) and a push
+that exhausts its budget is DEFERRED — queued locally and drained on later
+steps once the server answers again.  This is the reference async
+communicator's degradation contract
+(fluid/distributed/service/communicator.cc: send queues + stale reads keep
+training moving through server hiccups); ``stats`` counts every stale pull
+and deferred push so the degradation is observable, never silent.
 """
 from __future__ import annotations
 
@@ -57,7 +67,11 @@ class HeterTrainer:
                  dense_params, dense_apply: Callable, optimizer,
                  sparse_lr: float = 0.05, vocab: int | None = None,
                  snapshot_dir: str | None = None, max_retries: int = 3,
-                 retry_interval: float = 0.5):
+                 retry_interval: float = 0.5, degrade: str = "block",
+                 op_budget: float | None = None):
+        if degrade not in ("block", "stale"):
+            raise ValueError(f"degrade must be 'block' or 'stale', "
+                             f"got {degrade!r}")
         self.client = client
         self.tid = table_id
         self.dim = dim
@@ -69,6 +83,18 @@ class HeterTrainer:
         self.snapshot_dir = snapshot_dir
         self.max_retries = max_retries
         self.retry_interval = retry_interval
+        self.degrade = degrade
+        self.op_budget = op_budget
+        # degradation state: last-known rows for stale reads, queued
+        # (shard, ids, grads) for deferred pushes, and observability
+        self._row_cache: dict[int, np.ndarray] = {}
+        self._deferred: list[tuple[int, np.ndarray, np.ndarray]] = []
+        # heuristic server-health flag (benign race under train_stream:
+        # feeder writes it, consumer reads it — a stale value only shifts
+        # WHICH step pays the drain probe)
+        self._last_pull_stale = False
+        self.stats = {"stale_pulls": 0, "stale_rows": 0,
+                      "deferred_pushes": 0, "drained_pushes": 0}
         self._step = 0
 
         def _loss(params, embeds, batch):
@@ -99,14 +125,26 @@ class HeterTrainer:
                     except (RuntimeError, ConnectionError, OSError):
                         pass  # no snapshot yet: keep the fresh init
 
-    def _with_recovery(self, fn):
-        for attempt in range(self.max_retries + 1):
+    def _with_recovery(self, fn, budget: float | None = None):
+        """Retry ``fn`` through recovery, bounded by ``budget`` seconds of
+        wall clock when given (each attempt still bounded by the client's
+        socket timeout).  Exhaustion raises; degradation is the CALLER's
+        policy (stale read / deferred push), not this helper's."""
+        deadline = None if budget is None else time.monotonic() + budget
+        attempt = 0
+        while True:
             try:
                 return fn()
             except (RuntimeError, ConnectionError, OSError):
-                if attempt == self.max_retries:
+                out_of_time = (deadline is not None
+                               and time.monotonic() >= deadline)
+                if out_of_time or (deadline is None
+                                   and attempt >= self.max_retries):
                     raise
-                time.sleep(self.retry_interval * (attempt + 1))
+                time.sleep(min(self.retry_interval * (attempt + 1),
+                               max(0.0, deadline - time.monotonic())
+                               if deadline is not None else 60.0))
+                attempt += 1
                 try:
                     self._recover()
                 except (RuntimeError, ConnectionError, OSError):
@@ -125,15 +163,80 @@ class HeterTrainer:
         if pad_to != len(uniq):
             uniq = np.concatenate(
                 [uniq, np.full(pad_to - len(uniq), uniq[0], np.int64)])
-        rows = self._with_recovery(
-            lambda: self.client.pull_sparse(self.tid, uniq))
-        embeds = jnp.asarray(rows.reshape(len(uniq), self.dim))
+        try:
+            rows = self._with_recovery(
+                lambda: self.client.pull_sparse(self.tid, uniq),
+                budget=self.op_budget)
+            rows = rows.reshape(len(uniq), self.dim)
+            if self.degrade == "stale":
+                # .copy(): a cached view would pin each pull's whole
+                # [pad_to, dim] base array for as long as any row survives
+                for j, u in enumerate(uniq):
+                    self._row_cache[int(u)] = rows[j].copy()
+                self._last_pull_stale = False
+        except (RuntimeError, ConnectionError, OSError):
+            if self.degrade != "stale":
+                raise
+            # budget exhausted mid-pull: serve last-known rows (zeros for
+            # never-seen ids) so the step completes in bounded time
+            rows = np.zeros((len(uniq), self.dim), np.float32)
+            miss = 0
+            for j, u in enumerate(uniq):
+                cached = self._row_cache.get(int(u))
+                if cached is not None:
+                    rows[j] = cached
+                else:
+                    miss += 1
+            self.stats["stale_pulls"] += 1
+            self.stats["stale_rows"] += len(uniq) - miss
+            self._last_pull_stale = True
+        embeds = jnp.asarray(rows)
         return uniq, inv.reshape(ids.shape), embeds
+
+    def _drain_deferred(self):
+        """Re-try queued pushes under the op budget; order within a shard
+        is preserved so the server applies grads in step order.  Returns
+        the shards that still hold queued deltas — the caller must keep
+        routing NEW grads for those shards through the queue, or step
+        N+1's update would reach the stateful server-side adagrad before
+        step N's."""
+        if not self._deferred:
+            return set()
+        deadline = None if self.op_budget is None \
+            else time.monotonic() + self.op_budget
+        remaining = []
+        blocked: set[int] = set()  # first failure blocks that shard's rest
+        timed_out = False
+        for item in self._deferred:
+            s, i, g = item
+            timed_out = timed_out or (deadline is not None
+                                      and time.monotonic() >= deadline)
+            if timed_out or s in blocked:
+                remaining.append(item)
+                continue
+            try:
+                self.client.push_sparse_shard(s, self.tid, i, g,
+                                              lr=self.sparse_lr)
+                self.stats["drained_pushes"] += 1
+            except (RuntimeError, ConnectionError, OSError):
+                blocked.add(s)
+                remaining.append(item)
+        self._deferred = remaining
+        return {s for s, _, _ in remaining}
 
     def _push(self, uniq: np.ndarray, ge: np.ndarray):
         """Per-SHARD pushes, each with its own retry: a whole-fan retry
         would re-apply grads on shards that already succeeded (adagrad is
         not idempotent — double update + inflated accumulator)."""
+        backlogged: set[int] = set()
+        if self.degrade == "stale":
+            # skip the drain while the server is known-down (this step's
+            # pull just degraded): probing a dead shard would cost a full
+            # socket timeout per step on top of the budgeted push
+            if self._last_pull_stale:
+                backlogged = {s for s, _, _ in self._deferred}
+            else:
+                backlogged = self._drain_deferred()
         grads = np.asarray(ge)
         srv = uniq % self.client.S
         local = uniq // self.client.S
@@ -141,10 +244,24 @@ class HeterTrainer:
             m = srv == s
             if not m.any():
                 continue
-            self._with_recovery(
-                lambda s=s, i=local[m], g=grads[m]:
-                self.client.push_sparse_shard(s, self.tid, i, g,
-                                              lr=self.sparse_lr))
+            if s in backlogged:
+                # older deltas for this shard are still queued: keep step
+                # order by queueing the new ones behind them
+                self._deferred.append((s, local[m], grads[m]))
+                self.stats["deferred_pushes"] += 1
+                continue
+            try:
+                self._with_recovery(
+                    lambda s=s, i=local[m], g=grads[m]:
+                    self.client.push_sparse_shard(s, self.tid, i, g,
+                                                  lr=self.sparse_lr),
+                    budget=self.op_budget)
+            except (RuntimeError, ConnectionError, OSError):
+                if self.degrade != "stale":
+                    raise
+                # budget exhausted: queue the delta; later steps drain it
+                self._deferred.append((s, local[m], grads[m]))
+                self.stats["deferred_pushes"] += 1
 
     def _compute_push_apply(self, prepared, batch) -> float:
         """Device half + push: one fused grad program, then PS push and the

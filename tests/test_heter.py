@@ -225,3 +225,50 @@ def test_kill_one_server_recovery(cluster, tmp_path):
     odd = np.arange(1, V, 2)
     drift = np.abs(rows_after[odd] - rows_before[odd]).max()
     assert drift < 1.0, drift  # trained-on continuity, not random re-init
+
+
+def test_mid_pull_server_loss_degrades_in_bounded_time(cluster):
+    """A server frozen DURING a pull (SIGSTOP: connection stays up, no
+    response) must not block training: with degrade='stale' + op_budget,
+    the step completes in bounded wall-clock serving last-known rows, the
+    failed push is deferred, and after the server resumes the deferred
+    deltas drain — the reference async communicator's degradation contract
+    (fluid/distributed/service/communicator.cc send queues)."""
+    import signal
+
+    from paddle_tpu.distributed.ps_service import PSClient
+
+    V, D = 64, 8
+    cluster.create_table(0, V, D, seed=3)
+    fast = PSClient(cluster.endpoints, timeout=1.0)
+    rng = np.random.default_rng(1)
+    trainer = _make_trainer(fast, rng, V=V, D=D, big=50,
+                            degrade="stale", op_budget=2.0, vocab=V)
+    ids = rng.integers(0, V, (64, 4)).astype(np.int64)
+    y = jnp.asarray((ids[:, 0] % 2).astype(np.int64))
+    for _ in range(3):  # healthy warm-up populates the row cache
+        trainer.train_step(ids, {"y": y})
+    assert trainer.stats["stale_pulls"] == 0
+
+    pid = cluster._procs[1].pid
+    os.kill(pid, signal.SIGSTOP)
+    try:
+        t0 = time.monotonic()
+        loss = trainer.train_step(ids, {"y": y})
+        elapsed = time.monotonic() - t0
+        assert np.isfinite(loss)
+        assert elapsed < 20.0, f"degraded step took {elapsed:.1f}s"
+        assert trainer.stats["stale_pulls"] >= 1
+        assert trainer.stats["stale_rows"] > 0  # cache actually served
+        assert trainer.stats["deferred_pushes"] >= 1
+        assert trainer._deferred
+    finally:
+        os.kill(pid, signal.SIGCONT)
+
+    for _ in range(6):  # resumed server: deferred deltas drain
+        trainer.train_step(ids, {"y": y})
+        if not trainer._deferred:
+            break
+    assert not trainer._deferred
+    assert trainer.stats["drained_pushes"] >= 1
+    fast.close()
