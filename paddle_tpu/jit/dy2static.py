@@ -231,7 +231,7 @@ def convert_while(cond_fn, body_fn, vals: tuple, _loc_info=None, names=None):
     for i, v in enumerate(vals):
         if isinstance(_unwrap1(v), _UndefinedVar):
             nm = names[i] if names and i < len(names) else None
-            if nm == "__pt_rv":
+            if nm is not None and nm.startswith("__pt_rv"):
                 raise Dy2StaticError(
                     f"at {_loc(_loc_info)}: `return <value>` inside a "
                     f"tensor-valued `while`/`for` cannot become XLA control "
@@ -440,6 +440,21 @@ def finalize_return(flag, val, may_fall_off: bool, _loc_info=None):
     return val
 
 
+def finalize_return_multi(flag, vals: tuple, may_fall_off: bool,
+                          _loc_info=None):
+    """Tuple-return variant: every ``return`` in the function was a
+    same-arity tuple literal, split into per-element threaded values so
+    each element reconciles its own shape through lax.cond."""
+    if not _is_traced(flag):
+        return tuple(vals) if bool(_unwrap1(flag)) else None
+    if may_fall_off or any(isinstance(v, _UndefinedVar) for v in vals):
+        raise Dy2StaticError(
+            f"at {_loc(_loc_info)}: a tuple `return` under a tensor-valued "
+            f"condition requires every execution path to end in an "
+            f"explicit `return`; add a final `return` to the function")
+    return tuple(vals)
+
+
 def assert_py_cond(pred, _loc_info=None, reason=""):
     """Guard for constructs left as Python: fails loudly on tensor preds."""
     if _is_traced(pred):
@@ -458,11 +473,26 @@ def assert_py_cond(pred, _loc_info=None, reason=""):
 _RT = "__pt_dy2st"
 
 
+class _ScopeBoundVisitor(ast.NodeVisitor):
+    """NodeVisitor that never descends into nested function scopes — the
+    shared boundary rule for every scanner in this module (a nested def /
+    lambda converts on its own when actually called)."""
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    def visit_AsyncFunctionDef(self, node):
+        pass
+
+    def visit_Lambda(self, node):
+        pass
+
+
 def _has_control_flow(fdef) -> bool:
     """Any if/while in the function's own statement scope (not nested
     defs) — the only constructs the transformer touches."""
 
-    class V(ast.NodeVisitor):
+    class V(_ScopeBoundVisitor):
         found = False
 
         def visit_If(self, node):
@@ -479,15 +509,6 @@ def _has_control_flow(fdef) -> bool:
             else:
                 self.generic_visit(node)
 
-        def visit_FunctionDef(self, node):
-            pass
-
-        def visit_AsyncFunctionDef(self, node):
-            pass
-
-        def visit_Lambda(self, node):
-            pass
-
     v = V()
     for s in fdef.body:
         v.visit(s)
@@ -496,7 +517,7 @@ def _has_control_flow(fdef) -> bool:
     return False
 
 
-class _AssignedNames(ast.NodeVisitor):
+class _AssignedNames(_ScopeBoundVisitor):
     def __init__(self):
         self.names: set[str] = set()
 
@@ -516,14 +537,6 @@ class _AssignedNames(ast.NodeVisitor):
         self.generic_visit(node)
 
     # do not descend into nested scopes
-    def visit_FunctionDef(self, node):
-        pass
-
-    def visit_AsyncFunctionDef(self, node):
-        pass
-
-    def visit_Lambda(self, node):
-        pass
 
 
 def _assigned(stmts) -> list[str]:
@@ -533,7 +546,7 @@ def _assigned(stmts) -> list[str]:
     return sorted(v.names)
 
 
-class _HasReturn(ast.NodeVisitor):
+class _HasReturn(_ScopeBoundVisitor):
     """Return anywhere in this statement scope (not nested functions)."""
 
     def __init__(self):
@@ -542,14 +555,6 @@ class _HasReturn(ast.NodeVisitor):
     def visit_Return(self, node):
         self.found = True
 
-    def visit_FunctionDef(self, node):
-        pass
-
-    def visit_AsyncFunctionDef(self, node):
-        pass
-
-    def visit_Lambda(self, node):
-        pass
 
 
 def _escapes(stmts) -> bool:
@@ -564,7 +569,7 @@ def _escapes(stmts) -> bool:
 # and break_continue_transformer.py, re-targeted at lax control flow
 # ---------------------------------------------------------------------------
 
-class _EscapeInfo(ast.NodeVisitor):
+class _EscapeInfo(_ScopeBoundVisitor):
     """break/continue bound to the current loop level + returns anywhere in
     the function scope (nested loops bound their own break/continue but
     propagate returns; nested defs/lambdas are opaque)."""
@@ -597,14 +602,6 @@ class _EscapeInfo(ast.NodeVisitor):
     def visit_While(self, node):
         self.visit_For(node)
 
-    def visit_FunctionDef(self, node):
-        pass
-
-    def visit_AsyncFunctionDef(self, node):
-        pass
-
-    def visit_Lambda(self, node):
-        pass
 
 
 def _escape_info(stmts) -> _EscapeInfo:
@@ -680,6 +677,7 @@ class _EscapeRewriter:
     def __init__(self):
         self.n = 0
         self.uses_rf = False
+        self.rv_arity: int | None = None  # tuple-return split width
 
     # ---- AST builders -----------------------------------------------------
     @staticmethod
@@ -710,30 +708,69 @@ class _EscapeRewriter:
                 for f in flags])
         return self._rt("logical_not", [inner])
 
+    @staticmethod
+    def _locals_get(name):
+        return ast.Call(
+            func=ast.Attribute(
+                value=ast.Call(func=ast.Name(id="locals", ctx=ast.Load()),
+                               args=[], keywords=[]),
+                attr="get", ctx=ast.Load()),
+            args=[ast.Constant(name),
+                  ast.Attribute(value=ast.Name(id=_RT, ctx=ast.Load()),
+                                attr="UNDEF", ctx=ast.Load())],
+            keywords=[])
+
+    @staticmethod
+    def _tuple_return_arity(stmts):
+        """n when EVERY return in the function scope carries a same-arity
+        tuple literal (safe to split into per-element values); else None."""
+
+        class V(_ScopeBoundVisitor):
+            def __init__(self):
+                self.rets = []
+
+            def visit_Return(self, node):
+                self.rets.append(node)
+
+        v = V()
+        for s in stmts:
+            v.visit(s)
+        if not v.rets:
+            return None
+        # every return must be a tuple LITERAL of one arity with no
+        # starred elements (a star makes the runtime width unknowable, so
+        # a fixed-width unpack would break code that worked unsplit)
+        if not all(r.value is not None and isinstance(r.value, ast.Tuple)
+                   and not any(isinstance(e, ast.Starred)
+                               for e in r.value.elts)
+                   for r in v.rets):
+            return None
+        lens = {len(r.value.elts) for r in v.rets}
+        return lens.pop() if len(lens) == 1 else None
+
     # ---- entry ------------------------------------------------------------
     def rewrite(self, fdef):
         if not _escape_under_cf(fdef.body):
             return fdef
         may_fall_off = not _always_returns(fdef.body)
+        self.rv_arity = self._tuple_return_arity(fdef.body)
         body = self._block(list(fdef.body), ())
         if self.uses_rf:
-            epilogue = ast.Return(value=self._rt("finalize_return", [
-                ast.Name(id="__pt_rf", ctx=ast.Load()),
-                ast.Call(
-                    func=ast.Attribute(
-                        value=ast.Call(
-                            func=ast.Name(id="locals", ctx=ast.Load()),
-                            args=[], keywords=[]),
-                        attr="get", ctx=ast.Load()),
-                    args=[ast.Constant("__pt_rv"),
-                          ast.Attribute(
-                              value=ast.Name(id=_RT, ctx=ast.Load()),
-                              attr="UNDEF", ctx=ast.Load())],
-                    keywords=[]),
-                ast.Constant(may_fall_off),
-                ast.Tuple(elts=[ast.Constant("<function>"),
-                                ast.Constant(fdef.lineno)], ctx=ast.Load()),
-            ]))
+            loc = ast.Tuple(elts=[ast.Constant("<function>"),
+                                  ast.Constant(fdef.lineno)], ctx=ast.Load())
+            if self.rv_arity:
+                epilogue = ast.Return(
+                    value=self._rt("finalize_return_multi", [
+                        ast.Name(id="__pt_rf", ctx=ast.Load()),
+                        ast.Tuple(elts=[self._locals_get(f"__pt_rv{k}")
+                                        for k in range(self.rv_arity)],
+                                  ctx=ast.Load()),
+                        ast.Constant(may_fall_off), loc]))
+            else:
+                epilogue = ast.Return(value=self._rt("finalize_return", [
+                    ast.Name(id="__pt_rf", ctx=ast.Load()),
+                    self._locals_get("__pt_rv"),
+                    ast.Constant(may_fall_off), loc]))
             fdef.body = ([self._assign("__pt_rf", ast.Constant(False))]
                          + body + [epilogue])
         else:
@@ -760,9 +797,18 @@ class _EscapeRewriter:
             set_flags = []
             if isinstance(s, ast.Return):
                 self.uses_rf = True
-                out.append(self._assign(
-                    "__pt_rv",
-                    s.value if s.value is not None else ast.Constant(None)))
+                if self.rv_arity:
+                    # split the tuple literal: each element threads (and
+                    # shape-reconciles) independently through lax.cond
+                    tgt = ast.Tuple(
+                        elts=[ast.Name(id=f"__pt_rv{k}", ctx=ast.Store())
+                              for k in range(self.rv_arity)],
+                        ctx=ast.Store())
+                    out.append(ast.Assign(targets=[tgt], value=s.value))
+                else:
+                    out.append(self._assign(
+                        "__pt_rv", s.value if s.value is not None
+                        else ast.Constant(None)))
                 out.append(self._assign("__pt_rf", ast.Constant(True)))
                 if loops and not loops[-1].treated:
                     out.append(ast.Break())  # physically leave a real loop
@@ -893,21 +939,12 @@ class _CallWrapper(ast.NodeTransformer):
         return node
 
 
-class _HasCalls(ast.NodeVisitor):
+class _HasCalls(_ScopeBoundVisitor):
     def __init__(self):
         self.found = False
 
     def visit_Call(self, node):
         self.found = True
-
-    def visit_FunctionDef(self, node):
-        pass
-
-    def visit_AsyncFunctionDef(self, node):
-        pass
-
-    def visit_Lambda(self, node):
-        pass
 
 
 def _has_calls(fdef) -> bool:

@@ -612,3 +612,43 @@ class TestEscapeRewrites:
         conv = convert_to_static(f)
         out = conv(_T(jnp.zeros((1,), jnp.float32)))
         np.testing.assert_allclose(np.asarray(out.value), [5.0])
+
+    def test_tuple_return_under_tensor_if(self):
+        # same-arity tuple-literal returns split into per-element threaded
+        # values, so multi-value functions convert too
+        def f(x):
+            if x.sum() > 0:
+                return x * 2.0, x.sum()
+            return x - 1.0, x.sum() * 3.0
+
+        conv = convert_to_static(f)
+        j = jax.jit(lambda a: tuple(
+            t.value for t in conv(_T(a))))
+
+        def ref(a):
+            if a.sum() > 0:
+                return a * 2.0, a.sum()
+            return a - 1.0, a.sum() * 3.0
+
+        for arr in (np.ones((2,), np.float32), -np.ones((2,), np.float32)):
+            got = j(jnp.asarray(arr))
+            want = ref(arr)
+            np.testing.assert_allclose(np.asarray(got[0]), want[0])
+            np.testing.assert_allclose(np.asarray(got[1]), want[1],
+                                       rtol=1e-6)
+        # eager/concrete path too
+        out = conv(_T(jnp.asarray(np.ones((2,), np.float32))))
+        assert isinstance(out, tuple) and len(out) == 2
+
+    def test_mixed_arity_returns_stay_loud_when_traced(self):
+        def f(x):
+            if x.sum() > 0:
+                return x * 2.0, x.sum()
+            return x  # different arity: no tuple split
+
+        conv = convert_to_static(f)
+        # concrete paths keep python semantics
+        out = conv(_T(jnp.asarray(-np.ones((2,), np.float32))))
+        assert not isinstance(out, tuple)
+        with pytest.raises(Dy2StaticError):
+            jax.jit(lambda a: conv(_T(a)))(jnp.ones((2,), jnp.float32))
