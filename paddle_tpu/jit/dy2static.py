@@ -759,20 +759,37 @@ class _EscapeRewriter:
             loc = ast.Tuple(elts=[ast.Constant("<function>"),
                                   ast.Constant(fdef.lineno)], ctx=ast.Load())
             if self.rv_arity:
-                epilogue = ast.Return(
+                # bind locals() once — one frame-dict build per exit, not
+                # one per tuple element
+                bind = self._assign("__pt_locals", ast.Call(
+                    func=ast.Name(id="locals", ctx=ast.Load()),
+                    args=[], keywords=[]))
+
+                def get(name):
+                    return ast.Call(
+                        func=ast.Attribute(
+                            value=ast.Name(id="__pt_locals", ctx=ast.Load()),
+                            attr="get", ctx=ast.Load()),
+                        args=[ast.Constant(name),
+                              ast.Attribute(
+                                  value=ast.Name(id=_RT, ctx=ast.Load()),
+                                  attr="UNDEF", ctx=ast.Load())],
+                        keywords=[])
+
+                epilogue = [bind, ast.Return(
                     value=self._rt("finalize_return_multi", [
                         ast.Name(id="__pt_rf", ctx=ast.Load()),
-                        ast.Tuple(elts=[self._locals_get(f"__pt_rv{k}")
+                        ast.Tuple(elts=[get(f"__pt_rv{k}")
                                         for k in range(self.rv_arity)],
                                   ctx=ast.Load()),
-                        ast.Constant(may_fall_off), loc]))
+                        ast.Constant(may_fall_off), loc]))]
             else:
-                epilogue = ast.Return(value=self._rt("finalize_return", [
+                epilogue = [ast.Return(value=self._rt("finalize_return", [
                     ast.Name(id="__pt_rf", ctx=ast.Load()),
                     self._locals_get("__pt_rv"),
-                    ast.Constant(may_fall_off), loc]))
+                    ast.Constant(may_fall_off), loc]))]
             fdef.body = ([self._assign("__pt_rf", ast.Constant(False))]
-                         + body + [epilogue])
+                         + body + epilogue)
         else:
             fdef.body = body
         ast.fix_missing_locations(fdef)

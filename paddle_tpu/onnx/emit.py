@@ -26,8 +26,9 @@ import numpy as np
 from . import wire as W
 
 # TensorProto.DataType
-_DT = {"float32": 1, "uint8": 2, "int8": 3, "int32": 6, "int64": 7,
-       "bool": 9, "float16": 10, "float64": 11, "bfloat16": 16}
+_DT = {"float32": 1, "uint8": 2, "int8": 3, "uint16": 4, "int16": 5,
+       "int32": 6, "int64": 7, "bool": 9, "float16": 10, "float64": 11,
+       "uint32": 12, "uint64": 13, "bfloat16": 16}
 
 # AttributeProto.AttributeType
 _AT_FLOAT, _AT_INT, _AT_INTS = 1, 2, 7
