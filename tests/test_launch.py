@@ -120,7 +120,7 @@ def test_launch_elastic_scale_relaunch(tmp_path):
         "import os, time\n"
         "print('POD-START world', os.environ['PADDLE_TRAINERS_NUM'],"
         " flush=True)\n"
-        "time.sleep(12)\n")
+        "time.sleep(25)\n")
     # fixed free port so the test can dial the same KV store
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -130,7 +130,7 @@ def test_launch_elastic_scale_relaunch(tmp_path):
     # generous margins: under full-suite CPU load the launcher's heartbeat
     # thread can starve past a tight TTL → spurious relaunch → flaky counts
     env["PADDLE_ELASTIC_HEARTBEAT"] = "0.3"
-    env["PADDLE_ELASTIC_TTL"] = "4.0"
+    env["PADDLE_ELASTIC_TTL"] = "8.0"
     proc = subprocess.Popen(
         [sys.executable, "-m", "paddle_tpu.distributed.launch",
          "--coordinator", f"127.0.0.1:{port}", "--elastic_np", "1:4",
@@ -154,7 +154,7 @@ def test_launch_elastic_scale_relaunch(tmp_path):
         # relaunch fires; node99's single heartbeat expires (ttl) causing
         # one more relaunch; the final pod runs to completion and the
         # launcher exits normally (no SIGTERM: children share the pipe)
-        out, err = proc.communicate(timeout=90)
+        out, err = proc.communicate(timeout=150)
     finally:
         if proc.poll() is None:
             proc.kill()

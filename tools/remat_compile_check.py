@@ -52,8 +52,11 @@ def _child(variant: str):
     key = jax.random.PRNGKey(0)
     t0 = time.perf_counter()
     # AOT compile only — no execution, so an OOM-at-runtime rung still
-    # answers the question this check asks (does the COMPILE finish?)
-    compiled = jax.jit(step_fn).lower(state, toks, key, 2e-4).compile()
+    # answers the question this check asks (does the COMPILE finish?).
+    # step_fn from build_gpt_train_step is already jitted (with buffer
+    # donation); lower it directly rather than double-wrapping
+    lowerable = step_fn if hasattr(step_fn, "lower") else jax.jit(step_fn)
+    compiled = lowerable.lower(state, toks, key, 2e-4).compile()
     dt = time.perf_counter() - t0
     mem = {}
     try:
