@@ -137,6 +137,12 @@ def _gpt_rungs():
          "bfloat16", 2),
         ("gpt_350m_b4", dict(c350, remat=False), 4, 2048, 10, "bfloat16", 1),
         ("gpt_350m_b2", dict(c350, remat=False), 2, 2048, 10, "bfloat16", 1),
+        # selective-checkpoint middle rungs: keep matmul outputs, recompute
+        # elementwise — cheaper recompute than full remat AND a different
+        # compile shape, so they may succeed where full-remat programs hang
+        ("gpt_1.3b_remat_dots_b2",
+         dict(c13, remat=True, remat_policy="dots"), 2, 2048, 10,
+         "bfloat16", 1),
         ("gpt_1.3b_remat_b4", dict(c13, remat=True), 4, 2048, 10,
          "bfloat16", 1),
         ("gpt_350m_remat_b8", dict(c350, remat=True), 8, 2048, 10,
@@ -177,12 +183,36 @@ def _gpt_rung_estimate(cfg_kwargs, B, T, state_dtype, accum=1) -> float:
         base += n * 2
     Bm = max(1, B // max(1, accum))
     logits = Bm * T * cfg.vocab_size * 2 * 2  # logits + grad, bf16
-    if cfg.remat:
+    if cfg.remat and _effective_remat_policy(cfg) == "dots":
+        # saved matmul outputs per block: qkv (3h) + attn-out (h) + ffn
+        # up (4h) + ffn down (h) ≈ 9h per token per layer, bf16
+        acts = cfg.num_layers * Bm * T * 9 * cfg.hidden_size * 2
+        if not _flash_active(cfg, T):
+            # XLA attention's q@kT scores are ALSO dot outputs the policy
+            # saves: H*T floats per token per layer
+            acts += cfg.num_layers * Bm * T * T * cfg.num_heads * 2
+    elif cfg.remat:
         acts = cfg.num_layers * Bm * T * cfg.hidden_size * 2 * 2
     else:
         acts = cfg.num_layers * Bm * T * (12 * cfg.hidden_size
                                           + 2 * cfg.ffn_size) * 2
     return float(base + logits + acts)
+
+
+def _effective_remat_policy(cfg):
+    """The policy the program will actually compile with: explicit config
+    wins; the PADDLE_TPU_REMAT_POLICY env override only fills a None."""
+    return cfg.remat_policy or (
+        os.environ.get("PADDLE_TPU_REMAT_POLICY") or None)
+
+
+def _flash_active(cfg, T) -> bool:
+    """Mirrors ops/attention._use_flash for estimation purposes (minus the
+    device check — the estimate only matters on TPU)."""
+    if os.environ.get("PADDLE_TPU_NO_FLASH", "") not in ("", "0"):
+        return False
+    head = cfg.hidden_size // cfg.num_heads
+    return T % 128 == 0 and head in (64, 128, 256)
 
 
 def _gpt_rung_fits(cfg_kwargs, B, T, state_dtype, hbm, accum=1) -> bool:
@@ -240,6 +270,8 @@ def _run_gpt_rung(idx: int):
            "value": round(tok_s, 1), "unit": "tokens/s/chip",
            "step_ms": round(dt * 1e3, 2), "mfu": round(mfu, 4),
            "remat": bool(cfg.remat),  # configs are NOT comparable across
+           "remat_policy": _effective_remat_policy(cfg) if cfg.remat
+           else None,
            "state_dtype": state_dtype, "accum": accum,
            "vs_baseline": round(mfu / _A100_MFU_BAR, 4)}
     if idx >= 0:

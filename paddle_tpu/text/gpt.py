@@ -40,6 +40,14 @@ class GPTConfig:
     dropout: float = 0.0
     dtype: Any = jnp.bfloat16  # compute dtype; params stay fp32
     remat: bool = False
+    # selective-checkpoint policy when remat=True (reference recompute
+    # lists a subset of ops to keep; jax expresses it as a policy):
+    # None = save nothing (full recompute, max memory saving);
+    # "dots" = keep matmul outputs (recompute only cheap elementwise —
+    #   a middle rung that may also sidestep backends where FULL-remat
+    #   programs fail to compile);
+    # "dots_no_batch" = keep only non-batch matmuls (weights-stationary)
+    remat_policy: str | None = None
     use_flash: bool = True
     moe: Any = None  # MoEConfig → every block's FFN becomes expert-parallel
 
@@ -158,6 +166,29 @@ def _dropout(x, rate, key):
     return jnp.where(mask, x / keep, jnp.zeros((), x.dtype))
 
 
+def _remat_policy(name: str | None):
+    """Map GPTConfig.remat_policy to a jax checkpoint policy.  The env
+    var PADDLE_TPU_REMAT_POLICY lets on-device tooling
+    (tools/remat_compile_check.py) A/B policies without rebuilding — but
+    only when the config does NOT set one explicitly: an explicit config
+    must stay authoritative (and keep raising on invalid values), or
+    bench labels and HBM estimates silently desynchronize from the
+    program actually compiled."""
+    if name is None:
+        name = os.environ.get("PADDLE_TPU_REMAT_POLICY") or None
+    if name is None or name == "none":
+        return None  # save nothing: full recompute
+    table = {
+        "dots": jax.checkpoint_policies.checkpoint_dots,
+        "dots_no_batch":
+            jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+    }
+    if name not in table:
+        raise ValueError(f"unknown remat_policy {name!r}; "
+                         f"choose from {sorted(table)} or None")
+    return table[name]
+
+
 def _block(x, p, cfg: GPTConfig, dropout_key=None):
     """One transformer block on [B, T, D] activations (compute dtype)."""
     B, T, D = x.shape
@@ -207,7 +238,7 @@ def forward_with_aux(params: dict, tokens, cfg: GPTConfig, act_sharding=None,
         x = jax.lax.with_sharding_constraint(x, act_sharding)
 
     blk = functools.partial(_block, cfg=cfg)
-    if cfg.remat:
+    if cfg.remat:  # see _remat_policy for the policy names
         # prevent_cse=False: inside lax.scan the loop structure already
         # prevents the grad-of-checkpoint CSE hazard, and the default's
         # optimization_barriers send the TPU compiler into a tailspin
@@ -215,7 +246,8 @@ def forward_with_aux(params: dict, tokens, cfg: GPTConfig, act_sharding=None,
         # PADDLE_TPU_REMAT_PREVENT_CSE=1 restores the default barriers so
         # tools/remat_compile_check.py can measure both variants on-device.
         _cse = os.environ.get("PADDLE_TPU_REMAT_PREVENT_CSE", "") == "1"
-        blk = jax.checkpoint(blk, prevent_cse=_cse)
+        blk = jax.checkpoint(blk, prevent_cse=_cse,
+                             policy=_remat_policy(cfg.remat_policy))
 
     need_keys = key is not None and (cfg.dropout > 0.0 or cfg.moe is not None)
     if need_keys:

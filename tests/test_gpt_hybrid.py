@@ -326,3 +326,53 @@ def test_gradient_accumulation_matches_full_batch():
     for a, b in zip(flat1, flat2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-2, atol=5e-3)
+
+
+class TestRematPolicies:
+    def test_remat_policies_match_no_remat(self, monkeypatch):
+        """Selective checkpointing (remat_policy) must be numerically
+        inert: loss AND grads identical to the un-checkpointed forward
+        for every policy (only memory/recompute scheduling changes)."""
+        monkeypatch.delenv("PADDLE_TPU_REMAT_POLICY", raising=False)
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.text import gpt
+
+        base = dict(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=32, dtype=jnp.float32)
+        key = jax.random.PRNGKey(0)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0, 128)
+
+        def run(**kw):
+            cfg = gpt.GPTConfig(**base, **kw)
+            params = gpt.init_params(cfg, key)
+            loss, g = jax.jit(jax.value_and_grad(
+                lambda p: gpt.loss_fn(p, toks, cfg)))(params)
+            return float(loss), g
+
+        l0, g0 = run(remat=False)
+        for kw in (dict(remat=True),
+                   dict(remat=True, remat_policy="dots"),
+                   dict(remat=True, remat_policy="dots_no_batch")):
+            l1, g1 = run(**kw)
+            assert abs(l0 - l1) < 1e-5, (kw, l0, l1)
+            jax.tree_util.tree_map(
+                lambda a, b: np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+                g0, g1)
+
+    def test_unknown_policy_is_loud(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_TPU_REMAT_POLICY", raising=False)
+        import jax
+
+        from paddle_tpu.text import gpt
+
+        cfg = gpt.GPTConfig(vocab_size=64, hidden_size=16, num_layers=1,
+                            num_heads=2, max_seq_len=16, remat=True,
+                            remat_policy="everything")
+        params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+        import jax.numpy as jnp
+        toks = jnp.zeros((1, 17), jnp.int32)
+        with pytest.raises(ValueError, match="remat_policy"):
+            gpt.loss_fn(params, toks, cfg)

@@ -4,14 +4,15 @@ The repo's remat paths (text/gpt.py, distributed/pp_layers.py) use
 ``jax.checkpoint(..., prevent_cse=False)`` because the default optimization
 barriers were observed to hang the axon v5e compile (>15 min).  That
 workaround has never actually been A/B'd on a healthy tunnel.  This script
-compiles the 350M GPT train step in three variants — no remat, remat with
+compiles the 350M GPT train step in four variants — no remat, remat with
 ``prevent_cse=False`` (the shipped workaround), remat with the default
-barriers (``PADDLE_TPU_REMAT_PREVENT_CSE=1``) — each AOT (lower+compile, no
-execution) in its own subprocess with a hard timeout, and records compile
-seconds per variant to ``remat_check.json``.
+barriers (``PADDLE_TPU_REMAT_PREVENT_CSE=1``), and selective checkpointing
+(``PADDLE_TPU_REMAT_POLICY=dots``: keep matmul outputs) — each AOT
+(lower+compile, no execution) in its own subprocess with a hard timeout,
+and records compile seconds per variant to ``remat_check.json``.
 
 Run standalone or via ``tools/probe_tpu.py --watch`` in a healthy window.
-Child mode: ``--variant none|nocse|cse``.
+Child mode: ``--variant none|nocse|cse|dots``.
 """
 import json
 import os
@@ -26,6 +27,9 @@ VARIANTS = {
     "none": {"remat": False, "env": {}},
     "nocse": {"remat": True, "env": {}},
     "cse": {"remat": True, "env": {"PADDLE_TPU_REMAT_PREVENT_CSE": "1"}},
+    # selective checkpointing: keeps matmul outputs — a different compile
+    # shape that may succeed where full-remat programs hang on this backend
+    "dots": {"remat": True, "env": {"PADDLE_TPU_REMAT_POLICY": "dots"}},
 }
 
 
