@@ -183,17 +183,20 @@ def _gpt_rung_estimate(cfg_kwargs, B, T, state_dtype, accum=1) -> float:
         base += n * 2
     Bm = max(1, B // max(1, accum))
     logits = Bm * T * cfg.vocab_size * 2 * 2  # logits + grad, bf16
-    if cfg.remat and _effective_remat_policy(cfg) == "dots":
+    from paddle_tpu.ops.remat_policies import canonical
+
+    policy = canonical(_effective_remat_policy(cfg)) if cfg.remat else None
+    if cfg.remat and policy in ("dots", "dots_no_batch"):
         # saved matmul outputs per block: qkv (3h) + attn-out (h) + ffn
         # up (4h) + ffn down (h) ≈ 9h per token per layer, bf16
         acts = cfg.num_layers * Bm * T * 9 * cfg.hidden_size * 2
-        if not _flash_active(cfg, T):
-            # XLA attention's q@kT scores are ALSO dot outputs the policy
-            # saves: H*T floats per token per layer
+        if policy == "dots" and not _flash_active(cfg, T):
+            # XLA attention's q@kT scores are batched dots that 'dots'
+            # (but not 'dots_no_batch') also saves: H*T floats per token
             acts += cfg.num_layers * Bm * T * T * cfg.num_heads * 2
-    elif cfg.remat:
+    elif cfg.remat and policy is None:
         acts = cfg.num_layers * Bm * T * cfg.hidden_size * 2 * 2
-    else:
+    else:  # no remat, or 'everything' (checkpoint is a no-op)
         acts = cfg.num_layers * Bm * T * (12 * cfg.hidden_size
                                           + 2 * cfg.ffn_size) * 2
     return float(base + logits + acts)

@@ -301,6 +301,12 @@ class ShardedTrainStep:
 
         k_steps = plan.k_steps
         remat = plan.has("recompute")
+        # the strategy's recompute policy (RecomputeConfig.policy) selects
+        # WHICH residuals the checkpoint keeps — 'full' = save nothing
+        from ...ops.remat_policies import resolve as _resolve_policy
+
+        remat_policy = _resolve_policy(
+            self.strategy.recompute_configs.policy) if remat else None
 
         # ZeRO-2: gradients live (and accumulate) reduce-scattered over the
         # zero axis; the optimizer update is shard-local and XLA all-gathers
@@ -321,7 +327,7 @@ class ShardedTrainStep:
                 return loss_fn(p, b, k)
 
             if remat:
-                loss_of = jax.checkpoint(loss_of)
+                loss_of = jax.checkpoint(loss_of, policy=remat_policy)
             grad_fn = jax.value_and_grad(loss_of)
 
             if k_steps > 1:

@@ -110,6 +110,13 @@ def compile_strategy(strategy: DistributedStrategy,
 
 # toggles the Layer-model route cannot honor (they need the functional
 # pytree API — ShardedTrainStep via fleet.build_train_step)
+def _policy_of(strategy) -> str | None:
+    """Canonical recompute policy named by the strategy (None = full)."""
+    from ...ops.remat_policies import canonical
+
+    return canonical(strategy.recompute_configs.policy)
+
+
 _LAYER_ROUTE_UNSUPPORTED = ("sharding", "gradient_merge", "tensor_parallel",
                             "sequence_parallel", "dgc", "localsgd", "amp")
 
@@ -155,6 +162,15 @@ def build_layer_train_step(model, loss_fn, optimizer,
                 f"PipelineLayer route yet",
                 hint="use the functional fleet.build_train_step or the "
                      "flagship gpt_hybrid path")
+        if plan.has("recompute") and _policy_of(strategy) is not None:
+            # PipelineLayer's remat policy is env-selected only
+            # (PADDLE_TPU_REMAT_POLICY, see pp_layers.py) — a strategy
+            # policy this route cannot honor must be loud, not dropped
+            raise UnimplementedError(
+                "recompute_configs.policy does not compose with the "
+                "PipelineLayer route yet",
+                hint="set PADDLE_TPU_REMAT_POLICY or use the functional "
+                     "fleet.build_train_step route")
         return model.build_train_step(
             mesh, optimizer, loss_fn, n_micro=max(1, plan.n_micro),
             example_input=example_input, remat=plan.has("recompute"))
@@ -168,4 +184,5 @@ def build_layer_train_step(model, loss_fn, optimizer,
     from ...jit import TrainStep
 
     return TrainStep(model, loss_fn, optimizer, mesh=mesh,
-                     remat=plan.has("recompute"))
+                     remat=plan.has("recompute"),
+                     remat_policy=_policy_of(strategy))

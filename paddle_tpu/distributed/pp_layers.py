@@ -574,11 +574,16 @@ class PipelineTrainStep:
                     # bound the within-tick residuals to the branch inputs;
                     # prevent_cse=False — the scan provides CSE protection
                     # and the default's optimization barriers hang the axon
-                    # TPU compile (see text/gpt.py).  Same env override as
+                    # TPU compile (see text/gpt.py).  Same env overrides as
                     # gpt.py so the on-device variant check covers pp too.
+                    from ..ops.remat_policies import resolve as _rp
+
                     _cse = os.environ.get(
                         "PADDLE_TPU_REMAT_PREVENT_CSE", "") == "1"
-                    run = jax.checkpoint(run, prevent_cse=_cse)
+                    run = jax.checkpoint(
+                        run, prevent_cse=_cse,
+                        policy=_rp(os.environ.get(
+                            "PADDLE_TPU_REMAT_POLICY") or None))
                 (_, loss_mb), vjp_fn = jax.vjp(run, pv, sp, x_saved)
                 valid = b_valid.astype(jnp.float32)
                 # last stage's cotangent comes from its own head; others

@@ -197,7 +197,7 @@ class TrainStep:
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer, mesh=None,
                  shardings=None, donate=True, remat=False,
-                 return_outputs=False):
+                 remat_policy=None, return_outputs=False):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -209,6 +209,11 @@ class TrainStep:
         self._params = params
         self._buffers = buffers
         self._opt_state = optimizer.init_state(params)
+        # resolve eagerly: a typo'd policy must fail at construction, not
+        # wrapped in a tracing traceback on the first step
+        from ..ops.remat_policies import resolve as _resolve_policy
+
+        remat_pol = _resolve_policy(remat_policy) if remat else None
 
         def step_fn(params, buffers, opt_state, key, lr, step, *batch):
             def loss_of(params):
@@ -221,7 +226,7 @@ class TrainStep:
                 return _unwrap(loss), (new_buf, aux_out)
 
             if remat:
-                loss_of = jax.checkpoint(loss_of)
+                loss_of = jax.checkpoint(loss_of, policy=remat_pol)
             (loss, (new_buf, out)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(params)
             new_params, new_opt = optimizer.apply_gradients(grads, params, opt_state,

@@ -174,19 +174,11 @@ def _remat_policy(name: str | None):
     must stay authoritative (and keep raising on invalid values), or
     bench labels and HBM estimates silently desynchronize from the
     program actually compiled."""
+    from ..ops.remat_policies import resolve
+
     if name is None:
         name = os.environ.get("PADDLE_TPU_REMAT_POLICY") or None
-    if name is None or name == "none":
-        return None  # save nothing: full recompute
-    table = {
-        "dots": jax.checkpoint_policies.checkpoint_dots,
-        "dots_no_batch":
-            jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
-    }
-    if name not in table:
-        raise ValueError(f"unknown remat_policy {name!r}; "
-                         f"choose from {sorted(table)} or None")
-    return table[name]
+    return resolve(name)
 
 
 def _block(x, p, cfg: GPTConfig, dropout_key=None):

@@ -370,9 +370,9 @@ class TestRematPolicies:
 
         cfg = gpt.GPTConfig(vocab_size=64, hidden_size=16, num_layers=1,
                             num_heads=2, max_seq_len=16, remat=True,
-                            remat_policy="everything")
+                            remat_policy="bogus")
         params = gpt.init_params(cfg, jax.random.PRNGKey(0))
         import jax.numpy as jnp
         toks = jnp.zeros((1, 17), jnp.int32)
-        with pytest.raises(ValueError, match="remat_policy"):
+        with pytest.raises(ValueError, match="policy"):
             gpt.loss_fn(params, toks, cfg)

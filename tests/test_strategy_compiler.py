@@ -134,3 +134,50 @@ class TestRouting:
                                            parameters=net.parameters()), s,
                                       mesh=self._mesh((1,), ("dp",)))
         assert isinstance(step, TrainStep)
+
+
+def test_recompute_policy_flows_from_strategy():
+    """RecomputeConfig.policy selects the checkpoint policy of the
+    sharded step; every alias resolves and an invalid one is loud."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet.strategy import DistributedStrategy
+    from paddle_tpu.ops.remat_policies import resolve
+
+    import jax
+
+    assert resolve("full") is None
+    assert resolve("nothing_saveable") is None
+    assert resolve("dots_saveable") is jax.checkpoint_policies.checkpoint_dots
+    assert resolve("everything_saveable") \
+        is jax.checkpoint_policies.everything_saveable
+    try:
+        resolve("bogus")
+        raise AssertionError("no raise")
+    except ValueError:
+        pass
+
+    # end-to-end: a sharded step with recompute + dots policy still trains
+    from paddle_tpu.distributed.fleet.base import ShardedTrainStep
+
+    rng = np.random.default_rng(0)
+    W = paddle.to_tensor(rng.standard_normal((4, 4)).astype(np.float32))
+
+    def loss_fn(params, batch, key):
+        x, y = batch
+        pred = x @ params["w"]
+        return ((pred - y) ** 2).mean()
+
+    strat = DistributedStrategy()
+    strat.recompute = True
+    strat.recompute_configs.policy = "dots_saveable"
+    opt = paddle.optimizer.SGD(learning_rate=0.1)
+    step = ShardedTrainStep(loss_fn, {"w": W.value}, opt, strategy=strat)
+    import jax.numpy as jnp
+    x = jnp.asarray(rng.standard_normal((8, 4)).astype(np.float32))
+    y = jnp.asarray(rng.standard_normal((8, 4)).astype(np.float32))
+    l0 = float(step((x, y)))
+    for _ in range(10):
+        l1 = float(step((x, y)))
+    assert l1 < l0
