@@ -439,6 +439,13 @@ def _lower_clamp(g, eqn, ins):
     return g.add("Clip", [x, lo, hi], hint="clip")
 
 
+def _lower_cumsum(g, eqn, ins):
+    axis = g.const(np.asarray(eqn.params["axis"], np.int64), "axis")
+    attrs = _attr_int("exclusive", 0) \
+        + _attr_int("reverse", 1 if eqn.params.get("reverse") else 0)
+    return g.add("CumSum", [ins[0], axis], attrs=attrs, hint="cumsum")
+
+
 def _lower_log1p(g, eqn, ins):
     one = g.const(np.asarray(1.0, eqn.invars[0].aval.dtype), "one")
     return g.add("Log", [g.add("Add", [ins[0], one], hint="add")],
@@ -501,6 +508,7 @@ _LOWER = {
     "clamp": _lower_clamp,
     "log1p": _lower_log1p,
     "expm1": _lower_expm1,
+    "cumsum": _lower_cumsum,
 }
 
 

@@ -206,6 +206,15 @@ def run_graph(model: dict, feeds: dict) -> list:
             out = out.astype(np.int64)
         elif op == "Clip":
             out = np.clip(i[0], i[1], i[2])
+        elif op == "CumSum":
+            ax = int(np.asarray(i[1]))
+            x = i[0]
+            if a.get("reverse"):
+                x = np.flip(x, ax)
+            out = np.cumsum(x, axis=ax)
+            if a.get("reverse"):
+                out = np.flip(out, ax)
+            assert not a.get("exclusive")
         elif op == "And":
             out = np.logical_and(i[0], i[1])
         elif op == "Or":
@@ -445,9 +454,23 @@ class TestOnnxExport:
         want = np.asarray(f(ids, x).value)
         np.testing.assert_allclose(got, want, rtol=1e-6)
 
+    def test_cumsum_exports_and_matches(self, tmp_path):
+        def f(x):
+            return paddle.cumsum(x, axis=0)
+
+        x = paddle.to_tensor(
+            np.random.default_rng(8).standard_normal((3, 4)).astype(
+                np.float32))
+        p = export(f, str(tmp_path / "cs.onnx"), input_spec=[x])
+        with open(p, "rb") as fh:
+            model = parse_model(fh.read())
+        got = run_graph(model, {"input_0": np.asarray(x.value)})[0]
+        np.testing.assert_allclose(got, np.cumsum(np.asarray(x.value), 0),
+                                   rtol=1e-6)
+
     def test_unsupported_primitive_is_loud(self, tmp_path):
         def weird(x):
-            return paddle.cumsum(x, axis=0)  # no lowering on purpose
+            return paddle.sort(x, axis=0)  # sort has no lowering on purpose
 
         x = paddle.to_tensor(np.ones((3, 2), np.float32))
         with pytest.raises(NotImplementedError, match="primitive"):
