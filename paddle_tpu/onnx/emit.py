@@ -512,6 +512,24 @@ _LOWER = {
 }
 
 
+def _lower_top_k(g, eqn, ins):
+    p = eqn.params
+    k = g.const(np.asarray([p["k"]], np.int64), "k")
+    attrs = (_attr_int("axis", p["axis"]) + _attr_int("largest", 1)
+             + _attr_int("sorted", 1))
+    vals, idx = g.add("TopK", [ins[0], k],
+                      outputs=[g.fresh("topk_v"), g.fresh("topk_i")],
+                      attrs=attrs)
+    idx_dt = np.dtype(eqn.outvars[1].aval.dtype)
+    if idx_dt.name != "int64":  # ONNX TopK indices are int64
+        idx = g.add("Cast", [idx], attrs=_attr_int("to", _DT[idx_dt.name]),
+                    hint="cast")
+    return [vals, idx]
+
+
+_LOWER["top_k"] = _lower_top_k
+
+
 def _lower_rsqrt(g, eqn, ins):
     s = g.add("Sqrt", [ins[0]], hint="sqrt")
     one = g.const(np.asarray(1.0, eqn.invars[0].aval.dtype), "one")
@@ -642,7 +660,11 @@ def emit_model(fn, example_args, producer="paddle_tpu") -> bytes:
                     f"ONNX export: primitive {prim!r} has no lowering "
                     f"(supported: {sorted(_LOWER)})")
             out = fnl(g, eqn, [ref(v) for v in eqn.invars])
-            env[eqn.outvars[0]] = out
+            if len(eqn.outvars) > 1:
+                for v, name in zip(eqn.outvars, out):
+                    env[v] = name
+            else:
+                env[eqn.outvars[0]] = out
 
     walk(jaxpr)
 

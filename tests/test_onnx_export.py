@@ -206,6 +206,15 @@ def run_graph(model: dict, feeds: dict) -> list:
             out = out.astype(np.int64)
         elif op == "Clip":
             out = np.clip(i[0], i[1], i[2])
+        elif op == "TopK":
+            ax = a["axis"]
+            kk = int(np.asarray(i[1]).reshape(()))
+            order = np.argsort(-i[0], axis=ax, kind="stable")
+            idx = np.take(order, range(kk), axis=ax)
+            vals = np.take_along_axis(i[0], idx, axis=ax)
+            env[n["outputs"][0]] = vals
+            env[n["outputs"][1]] = idx.astype(np.int64)
+            continue
         elif op == "CumSum":
             ax = int(np.asarray(i[1]))
             x = i[0]
@@ -467,6 +476,22 @@ class TestOnnxExport:
         got = run_graph(model, {"input_0": np.asarray(x.value)})[0]
         np.testing.assert_allclose(got, np.cumsum(np.asarray(x.value), 0),
                                    rtol=1e-6)
+
+    def test_topk_exports_and_matches(self, tmp_path):
+        def f(x):
+            v, i = paddle.topk(x, 3, axis=1)
+            return v + 0.0, i
+
+        x = paddle.to_tensor(
+            np.random.default_rng(9).standard_normal((2, 8)).astype(
+                np.float32))
+        p = export(lambda t: f(t)[0], str(tmp_path / "tk.onnx"),
+                   input_spec=[x])
+        with open(p, "rb") as fh:
+            model = parse_model(fh.read())
+        got = run_graph(model, {"input_0": np.asarray(x.value)})[0]
+        want = -np.sort(-np.asarray(x.value), axis=1)[:, :3]
+        np.testing.assert_allclose(got, want, rtol=1e-6)
 
     def test_unsupported_primitive_is_loud(self, tmp_path):
         def weird(x):
