@@ -1,0 +1,79 @@
+"""Assemble the round's on-device measurements into one ablation table.
+
+Round-3 verdict Next #9: once a TPU number exists, the deliverable is an
+ABLATION — flash on/off, remat variants, and measured HBM high-water vs
+the static estimate — not just a headline.  This script joins whatever
+evidence exists (WATCHDOG_RESULTS.json ladder + BENCH_DETAILS.json +
+noflash.json + remat_check.json) into ``ABLATION.json``; missing pieces
+are recorded as absent rather than invented.  The watchdog runs it as its
+final payload step; it is also safe to run by hand at any time.
+"""
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(name):
+    try:
+        with open(os.path.join(REPO, name)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def main():
+    wd = _load("WATCHDOG_RESULTS.json") or {}
+    steps = wd.get("steps", {})
+    ladder = (steps.get("ladder") or {}).get("headline")
+    noflash = _load("noflash.json")
+    remat = _load("remat_check.json")
+    details = _load("BENCH_DETAILS.json")
+
+    report = {"generated": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime())}
+
+    # flash ablation: same-config tok/s with the Pallas kernel on vs off
+    if ladder and noflash and noflash.get("metric") == ladder.get("metric"):
+        on, off = ladder["value"], noflash["value"]
+        report["flash_ablation"] = {
+            "config": ladder["metric"], "tok_s_flash_on": on,
+            "tok_s_flash_off": off,
+            "speedup": round(on / off, 3) if off else None}
+    else:
+        report["flash_ablation"] = {
+            "status": "incomplete",
+            "have_ladder": ladder is not None,
+            "have_noflash": noflash is not None,
+            "configs_match": bool(
+                ladder and noflash
+                and noflash.get("metric") == ladder.get("metric"))}
+
+    # remat variants: which compile, how long, compiled temp memory
+    report["remat_variants"] = remat or {"status": "absent"}
+
+    # HBM calibration: measured high-water vs the static pre-filter
+    # estimate, per rung that actually ran
+    cal = []
+    for src in ([ladder] if ladder else []) + (
+            [details.get("gpt")] if details else []):
+        if not src or "hbm_peak_gb" not in src or "hbm_est_gb" not in src:
+            continue
+        cal.append({"config": src["metric"],
+                    "hbm_peak_gb": src["hbm_peak_gb"],
+                    "hbm_est_gb": src["hbm_est_gb"],
+                    "est_over_measured": round(
+                        src["hbm_est_gb"] / src["hbm_peak_gb"], 3)
+                    if src["hbm_peak_gb"] else None})
+    report["hbm_calibration"] = cal or {"status": "no measured rungs"}
+
+    with open(os.path.join(REPO, "ABLATION.json"), "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -125,6 +125,9 @@ def _payload_steps():
         ("remat_variants", [py, os.path.join(REPO, "tools",
                                              "remat_compile_check.py")],
          3600, {}, None),
+        ("ablation_report", [py, os.path.join(REPO, "tools",
+                                              "ablation_report.py")],
+         120, {}, None),
     ]
 
 
