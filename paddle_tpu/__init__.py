@@ -117,6 +117,9 @@ if not _LIGHT_IMPORT:
     from . import hub  # noqa: F401
     from . import regularizer  # noqa: F401
     from . import sysconfig  # noqa: F401
+    from . import version  # noqa: F401
+    from .version import full_version  # noqa: F401
+    from .framework.errors import check_shape  # noqa: F401
 
     def disable_static():
         """Leave Program-recording mode (back to dygraph)."""
@@ -198,6 +201,11 @@ def __getattr__(name):
         mod = importlib.import_module(".distributed", __name__)
         globals()["distributed"] = mod
         return mod
+    if name == "commit":  # lazy: resolving it shells out to git once
+        from . import version as _version
+
+        globals()["commit"] = _version.commit
+        return globals()["commit"]
     if not _LIGHT_IMPORT and name == "DataParallel":
         from .distributed.parallel import DataParallel
 

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 from typing import Any
 
-__all__ = ["EnforceNotMet", "InvalidArgumentError", "NotFoundError",
+__all__ = ["EnforceNotMet", "InvalidArgumentError", "NotFoundError", "check_shape",
            "OutOfRangeError", "AlreadyExistsError", "PermissionDeniedError",
            "UnimplementedError", "UnavailableError", "ResourceExhaustedError",
            "PreconditionNotMetError", "ExecutionTimeoutError", "FatalError",
@@ -103,3 +103,38 @@ def enforce_shape(x, shape, what: str = "tensor",
             s is not None and s != a for s, a in zip(shape, actual)):
         raise exc(f"{what} shape mismatch: expected "
                   f"{tuple(shape)!r}, got {actual!r}")
+
+
+def check_shape(shape, op_name: str = "op"):
+    """Validate a shape ARGUMENT before an op consumes it (reference
+    data_feeder.py:142 check_shape, exported as paddle.check_shape): a
+    list/tuple of python ints (or int arrays/Tensors for runtime dims),
+    or a 1-D integer Tensor.  Raises TypeError with the op name."""
+    from ..core.tensor import Tensor
+
+    def _is_int_tensor(v):
+        import numpy as np
+
+        arr = v.value if isinstance(v, Tensor) else v
+        # read the dtype attribute directly: np.asarray would materialize
+        # the value (device->host copy, and a crash on jax tracers — the
+        # reference skips this check under tracing for the same reason)
+        return hasattr(arr, "dtype") and np.issubdtype(arr.dtype,
+                                                       np.integer)
+
+    if isinstance(shape, Tensor) or hasattr(shape, "dtype"):
+        if not _is_int_tensor(shape):
+            raise TypeError(
+                f"The data type of 'shape' in {op_name} must be int32 or "
+                f"int64 when shape is a Tensor")
+        return
+    if not isinstance(shape, (list, tuple)):
+        raise TypeError(
+            f"The type of 'shape' in {op_name} must be list, tuple or "
+            f"Tensor, but received {type(shape).__name__}")
+    for item in shape:
+        if isinstance(item, bool) or not (
+                isinstance(item, int) or _is_int_tensor(item)):
+            raise TypeError(
+                f"The type of element of 'shape' in {op_name} must be int "
+                f"or integer Tensor, but received {type(item).__name__}")

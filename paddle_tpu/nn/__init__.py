@@ -38,6 +38,8 @@ from .layer.rnn import (  # noqa: F401
 )
 RNNCellBase = Layer  # reference rnn.py RNNCellBase — cells are plain Layers
 from . import utils  # noqa: F401
+from .layer import loss  # noqa: F401  (reference nn/__init__.py:132)
+from .utils import spectral_norm  # noqa: F401  (reference :129)
 from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
 from .layer.transformer import (  # noqa: F401
     MultiHeadAttention, Transformer, TransformerDecoder, TransformerDecoderLayer,

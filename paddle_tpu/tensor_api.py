@@ -1205,6 +1205,12 @@ def crop_tensor(x, shape=None, offsets=None):
     return dispatch(lambda a: a[sl], x, op_name="crop_tensor")
 
 
+@_public
+def crop(x, shape=None, offsets=None):
+    """Alias (reference exports crop_tensor as paddle.crop)."""
+    return crop_tensor(x, shape=shape, offsets=offsets)
+
+
 def set_printoptions(precision=None, threshold=None, edgeitems=None,
                      linewidth=None, sci_mode=None):
     """reference paddle.set_printoptions → numpy printoptions here."""

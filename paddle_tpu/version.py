@@ -1,0 +1,57 @@
+"""Version metadata (reference python/paddle/version.py shape: the build
+writes major/minor/patch/rc plus the source commit; here the commit is
+read lazily from the git checkout that CONTAINS THIS PACKAGE — not any
+enclosing user repo — so `paddle.version.commit` stays meaningful for bug
+reports without taxing import time)."""
+from __future__ import annotations
+
+import os
+import subprocess
+
+major = 0
+minor = 1
+patch = 0
+rc = 0
+full_version = f"{major}.{minor}.{patch}"
+
+_commit_cache: str | None = None
+
+
+def _git_commit() -> str:
+    pkg_dir = os.path.dirname(os.path.abspath(__file__))
+    try:
+        top = subprocess.run(
+            ["git", "-C", pkg_dir, "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, timeout=5)
+        # only trust a repo that actually contains the package source —
+        # a pip install inside a user's own git tree must not report the
+        # USER's commit as the framework's
+        if top.returncode != 0 or not pkg_dir.startswith(
+                top.stdout.strip()):
+            return "unknown"
+        out = subprocess.run(["git", "-C", pkg_dir, "rev-parse", "HEAD"],
+                             capture_output=True, text=True, timeout=5)
+        if out.returncode == 0:
+            return out.stdout.strip()
+    except (OSError, subprocess.SubprocessError):
+        pass
+    return "unknown"
+
+
+def __getattr__(name):  # lazy: no subprocess on plain `import paddle_tpu`
+    global _commit_cache
+    if name == "commit":
+        if _commit_cache is None:
+            _commit_cache = _git_commit()
+        return _commit_cache
+    raise AttributeError(name)
+
+
+def show():
+    """Print the version block (reference version.show())."""
+    print(f"full_version: {full_version}")
+    print(f"major: {major}")
+    print(f"minor: {minor}")
+    print(f"patch: {patch}")
+    print(f"rc: {rc}")
+    print(f"commit: {__getattr__('commit')}")

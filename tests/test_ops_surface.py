@@ -234,6 +234,7 @@ EXEMPT = {
     "get_default_dtype": "config getter, not an op",
     "static_aware": "static-mode decorator re-export, not an op",
     # constructors / python-side utilities exercised by every other test
+    "crop": "alias of crop_tensor (swept); reference exports both",
     "to_tensor": "constructor used by every sweep row",
     "is_tensor": "isinstance helper; trivially exercised package-wide",
     "tolist": "python conversion; round-trips in test_utils_interop.py",
