@@ -4,7 +4,16 @@ from .strategy import DistributedStrategy  # noqa: F401
 from .. import meta_parallel  # noqa: F401
 from . import comm_opt  # noqa: F401
 from . import dataset  # noqa: F401  (InMemoryDataset / QueueDataset)
+from .dataset import InMemoryDataset, QueueDataset  # noqa: F401
 from . import metrics  # noqa: F401  (distributed AUC/acc/sum/max)
+from . import data_generator  # noqa: F401
+from .data_generator import (  # noqa: F401
+    MultiSlotDataGenerator, MultiSlotStringDataGenerator,
+)
+from .util import UtilBase  # noqa: F401
+from ..role_maker import (  # noqa: F401
+    PaddleCloudRoleMaker, Role, UserDefinedRoleMaker,
+)
 from .strategy_compiler import (  # noqa: F401
     StrategyPlan, compile_strategy,
 )

@@ -1,0 +1,87 @@
+"""fleet.util — UtilBase (reference fleet/base/util_factory.py).
+
+Cross-trainer utilities for industrial training scripts: numeric
+all_reduce/all_gather over the worker world, a barrier, deterministic
+file sharding, and rank-gated printing.  TPU-first: the comm rides the
+same XLA-collective layer as everything else (distributed/collective.py)
+when a multi-rank world is initialized; with a single-rank world every
+op degenerates to the exact identity the reference's gloo path produces
+for one trainer.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["UtilBase"]
+
+
+class UtilBase:
+    def __init__(self, role_maker=None):
+        self._role = role_maker
+
+    # -- world shape ---------------------------------------------------------
+    # a "worker" is a TRAINER PROCESS (the reference's trainer), not a
+    # device: under single-controller SPMD one process already owns the
+    # whole mesh, so the worker world is jax's process world
+    def _world(self) -> int:
+        if self._role is not None:
+            return int(self._role.worker_num())
+        import jax
+
+        return jax.process_count()
+
+    def _rank(self) -> int:
+        if self._role is not None:
+            return int(self._role.worker_index())
+        import jax
+
+        return jax.process_index()
+
+    # -- collectives ---------------------------------------------------------
+    def all_reduce(self, input, mode: str = "sum", comm_world="worker"):
+        """Reduce a host numpy value across the worker processes."""
+        arr = np.asarray(input)
+        if mode not in ("sum", "max", "min"):
+            raise ValueError(f"all_reduce mode must be sum/max/min, "
+                             f"got {mode!r}")
+        if self._world() <= 1:
+            return arr
+        g = np.asarray(self._process_allgather(arr))
+        return {"sum": g.sum, "max": g.max, "min": g.min}[mode](axis=0)
+
+    def all_gather(self, input, comm_world="worker"):
+        """Gather one scalar/array per worker process; returns a list."""
+        if self._world() <= 1:
+            return [np.asarray(input)]
+        g = np.asarray(self._process_allgather(np.asarray(input)))
+        return list(g)
+
+    def barrier(self, comm_world="worker"):
+        if self._world() <= 1:
+            return
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("fleet_util_barrier")
+
+    @staticmethod
+    def _process_allgather(arr):
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.process_allgather(jnp.asarray(arr))
+
+    # -- host-side helpers ---------------------------------------------------
+    def get_file_shard(self, files):
+        """This rank's slice of ``files`` — contiguous blocks, remainder
+        spread over the first ranks (reference get_file_shard contract:
+        every file assigned exactly once, sizes differ by at most one)."""
+        if not isinstance(files, (list, tuple)):
+            raise TypeError("files must be a list of paths")
+        n, w, r = len(files), self._world(), self._rank()
+        base, rem = divmod(n, w)
+        start = r * base + min(r, rem)
+        return list(files[start:start + base + (1 if r < rem else 0)])
+
+    def print_on_rank(self, message, rank_id: int = 0):
+        if self._rank() == int(rank_id):
+            print(message, flush=True)

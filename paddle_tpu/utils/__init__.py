@@ -1,2 +1,5 @@
-"""paddle_tpu.utils — interop + extension toolchain."""
+"""paddle_tpu.utils — interop + extension toolchain + general helpers."""
 from . import cpp_extension, dlpack  # noqa: F401
+from .tools import (  # noqa: F401
+    deprecated, require_version, run_check, try_import,
+)

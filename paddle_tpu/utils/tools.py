@@ -1,0 +1,110 @@
+"""General utilities (reference python/paddle/utils/__init__.py surface:
+deprecated / try_import / require_version / run_check).
+
+* :func:`deprecated` — decorator stamping a DeprecationWarning + docstring
+  note (reference utils/deprecated.py);
+* :func:`try_import` — import-or-explain for optional dependencies
+  (reference utils/lazy_import.py);
+* :func:`require_version` — assert the installed framework version falls
+  in a range (reference fluid/framework.py require_version);
+* :func:`run_check` — smoke-check the install: device enumeration, a
+  compiled matmul, and an autograd step (reference
+  utils/install_check.py run_check, minus the multi-GPU fleet probe —
+  multi-chip validation lives in ``__graft_entry__.dryrun_multichip``).
+"""
+from __future__ import annotations
+
+import functools
+import warnings
+
+__all__ = ["deprecated", "try_import", "require_version", "run_check"]
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = ""):
+    """Mark an API deprecated: warns once per call site category and
+    prepends a note to the docstring."""
+
+    def decorator(fn):
+        note = f"Warning: API {fn.__module__}.{fn.__name__} is deprecated"
+        if since:
+            note += f" since {since}"
+        if update_to:
+            note += f", use {update_to} instead"
+        if reason:
+            note += f" ({reason})"
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            warnings.warn(note, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        wrapper.__doc__ = note + "\n\n" + (fn.__doc__ or "")
+        return wrapper
+
+    return decorator
+
+
+def try_import(module_name: str, err_msg: str | None = None):
+    """Import an optional dependency or raise ImportError with guidance."""
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            err_msg or f"optional dependency {module_name!r} is required "
+                       f"for this feature; install it first") from e
+
+
+def _parse_version(v: str) -> tuple:
+    parts = []
+    for p in str(v).split("."):
+        num = ""
+        for ch in p:
+            if ch.isdigit():
+                num += ch
+            else:
+                break
+        parts.append(int(num) if num else 0)
+    return tuple(parts)
+
+
+def require_version(min_version: str, max_version: str | None = None):
+    """Raise unless min_version <= installed < unbounded/max_version
+    (inclusive max, matching the reference's contract)."""
+    from .. import version
+
+    if not isinstance(min_version, str) or (
+            max_version is not None and not isinstance(max_version, str)):
+        raise TypeError("version bounds must be strings like '0.1.0'")
+    cur = _parse_version(version.full_version)
+    lo = _parse_version(min_version)
+    if cur < lo:
+        raise Exception(
+            f"paddle_tpu version {version.full_version} is below the "
+            f"required minimum {min_version}")
+    if max_version is not None and cur > _parse_version(max_version):
+        raise Exception(
+            f"paddle_tpu version {version.full_version} is above the "
+            f"allowed maximum {max_version}")
+
+
+def run_check():
+    """Install smoke check: enumerate devices, compile+run a matmul, and
+    take one autograd step; prints the all-clear like the reference."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    dev = paddle.device.get_device()
+    x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+        (64, 64)).astype(np.float32))
+    y = paddle.matmul(x, x)  # jit-compiles on first use
+    assert tuple(y.shape) == (64, 64)
+
+    w = paddle.to_tensor(np.ones((64, 1), np.float32), stop_gradient=False)
+    loss = paddle.matmul(x, w).sum()
+    loss.backward()
+    assert w.grad is not None
+    print(f"paddle_tpu is installed successfully! device: {dev}, "
+          f"compiled matmul + autograd OK")

@@ -20,14 +20,16 @@ _commit_cache: str | None = None
 def _git_commit() -> str:
     pkg_dir = os.path.dirname(os.path.abspath(__file__))
     try:
-        top = subprocess.run(
-            ["git", "-C", pkg_dir, "rev-parse", "--show-toplevel"],
+        # only trust a repo that actually TRACKS this package's source —
+        # a pip install whose site-packages happens to sit inside a
+        # user's own git tree must not report the USER's commit as the
+        # framework's (an enclosing repo never tracks the venv's files,
+        # so ls-files --error-unmatch rejects exactly that case)
+        tracked = subprocess.run(
+            ["git", "-C", pkg_dir, "ls-files", "--error-unmatch",
+             os.path.join(pkg_dir, "__init__.py")],
             capture_output=True, text=True, timeout=5)
-        # only trust a repo that actually contains the package source —
-        # a pip install inside a user's own git tree must not report the
-        # USER's commit as the framework's
-        if top.returncode != 0 or not pkg_dir.startswith(
-                top.stdout.strip()):
+        if tracked.returncode != 0:
             return "unknown"
         out = subprocess.run(["git", "-C", pkg_dir, "rev-parse", "HEAD"],
                              capture_output=True, text=True, timeout=5)

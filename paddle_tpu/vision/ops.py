@@ -419,3 +419,238 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
         return out
 
     return dispatch(fn, *args, op_name="deform_conv2d")
+
+
+def yolo_loss(x, gt_box, gt_label, anchors: Sequence[int],
+              anchor_mask: Sequence[int], class_num: int,
+              ignore_thresh: float, downsample_ratio: int,
+              gt_score=None, use_label_smooth: bool = True, name=None,
+              scale_x_y: float = 1.0):
+    """YOLOv3 loss for one detection scale (reference yolov3_loss_op):
+
+    * location: sigmoid-CE on (tx, ty), L1 on (tw, th), weighted by
+      ``2 - gw*gh`` (small boxes weigh more);
+    * objectness: sigmoid-CE — target 1 at matched anchors, 0 elsewhere,
+      EXCEPT predictions whose decoded box overlaps any gt above
+      ``ignore_thresh`` (ignored, the YOLOv3 paper rule);
+    * classification: per-class sigmoid-CE at matched anchors (optionally
+      label-smoothed to [1/C, 1 - 1/C]).
+
+    Each gt box matches the best-IoU anchor over ALL ``anchors`` (w/h
+    only, centered); the match trains this scale only when that anchor id
+    is in ``anchor_mask``.  ``gt_box`` is [N, B, 4] (cx, cy, w, h)
+    normalized to the input image; rows with w<=0 or h<=0 are padding.
+    ``gt_score`` (mixup) scales every loss term of its box.  Returns
+    [N] per-image loss (sum over terms, like the reference op).
+    """
+    from ..core.dispatch import dispatch
+
+    anchors = [int(a) for a in anchors]
+    amask = [int(a) for a in anchor_mask]
+
+    args = [x, gt_box, gt_label] + ([gt_score] if gt_score is not None
+                                    else [])
+
+    def fn(xa, gb, gl, *rest):
+        gs = rest[0] if gt_score is not None else None
+        N, _, H, W = xa.shape
+        S = len(amask)
+        C = class_num
+        xa = xa.reshape(N, S, 5 + C, H, W).astype(jnp.float32)
+        in_w = W * downsample_ratio
+        in_h = H * downsample_ratio
+        pw = jnp.asarray([anchors[2 * i] for i in amask], jnp.float32)
+        ph = jnp.asarray([anchors[2 * i + 1] for i in amask], jnp.float32)
+        aw_all = jnp.asarray(anchors[0::2], jnp.float32)
+        ah_all = jnp.asarray(anchors[1::2], jnp.float32)
+
+        gb = gb.astype(jnp.float32)
+        B = gb.shape[1]
+        gw, gh = gb[:, :, 2], gb[:, :, 3]
+        valid = (gw > 0) & (gh > 0)  # [N, B]
+        score = (gs.astype(jnp.float32) if gs is not None
+                 else jnp.ones((N, B), jnp.float32)) * valid
+
+        # -- matching: best anchor over ALL anchors by centered-wh IoU ----
+        bw_px = gw * in_w
+        bh_px = gh * in_h
+        inter = (jnp.minimum(bw_px[..., None], aw_all)
+                 * jnp.minimum(bh_px[..., None], ah_all))
+        union = (bw_px * bh_px)[..., None] + aw_all * ah_all - inter
+        best = jnp.argmax(inter / jnp.maximum(union, 1e-9), -1)  # [N, B]
+        # scale-local anchor slot (or -1 when this scale doesn't own it)
+        slot = jnp.full((N, B), -1, jnp.int32)
+        for j, a in enumerate(amask):
+            slot = jnp.where(best == a, j, slot)
+        gi = jnp.clip((gb[:, :, 0] * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gb[:, :, 1] * H).astype(jnp.int32), 0, H - 1)
+        matched = valid & (slot >= 0)
+
+        # -- per-gt targets ----------------------------------------------
+        tx = gb[:, :, 0] * W - gi  # in (0, 1)
+        ty = gb[:, :, 1] * H - gj
+        sl = jnp.maximum(slot, 0)
+        tw = jnp.log(jnp.maximum(bw_px / pw[sl], 1e-9))
+        th = jnp.log(jnp.maximum(bh_px / ph[sl], 1e-9))
+        box_w = 2.0 - gw * gh
+
+        def bce(logit, target):
+            return jnp.maximum(logit, 0) - logit * target \
+                + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+        # gather predictions at each gt's (slot, gj, gi)
+        def at(chan):  # [N, B] values of channel `chan` at the match site
+            flat = xa[:, :, chan].reshape(N, S * H * W)
+            idx = sl * H * W + gj * W + gi
+            return jnp.take_along_axis(flat, idx, axis=1)
+
+        # sigmoid-CE directly on the RAW logits (the reference kernel's
+        # loss_x/loss_y; scale_x_y only affects the DECODED boxes used by
+        # the ignore rule below) — reconstructing a logit from a clipped
+        # sigmoid would zero the gradient exactly where predictions
+        # saturate
+        a = scale_x_y
+        loss_xy = bce(at(0), tx) + bce(at(1), ty)
+        loss_wh = jnp.abs(at(2) - tw) + jnp.abs(at(3) - th)
+        loss_loc = (loss_xy + loss_wh) * box_w * matched * score
+
+        # classification at match sites
+        if use_label_smooth and C > 1:
+            pos_t, neg_t = 1.0 - 1.0 / C, 1.0 / C
+        else:
+            pos_t, neg_t = 1.0, 0.0
+        # gather [N, B, C] class logits at (slot, gj, gi)
+        cls_logits = xa[:, :, 5:].reshape(N, S, C, H * W)
+        flat_cls = jnp.moveaxis(cls_logits, 2, 3).reshape(
+            N, S * H * W, C)
+        idx2 = (sl * H * W + gj * W + gi)[..., None]
+        cl = jnp.take_along_axis(flat_cls, jnp.broadcast_to(
+            idx2, (N, B, C)), axis=1)  # [N, B, C]
+        onehot = jax.nn.one_hot(gl.astype(jnp.int32), C)
+        cls_t = onehot * pos_t + (1 - onehot) * neg_t
+        loss_cls = (bce(cl, cls_t).sum(-1) * matched * score)
+
+        # -- objectness over the whole grid -------------------------------
+        grid_x = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+        grid_y = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+        bx = (jax.nn.sigmoid(xa[:, :, 0]) * a - (a - 1) / 2 + grid_x) / W
+        by = (jax.nn.sigmoid(xa[:, :, 1]) * a - (a - 1) / 2 + grid_y) / H
+        bw = jnp.exp(xa[:, :, 2]) * pw[None, :, None, None] / in_w
+        bh = jnp.exp(xa[:, :, 3]) * ph[None, :, None, None] / in_h
+        # IoU of every predicted box vs every gt [N, S, H, W, B]
+        px0, px1 = bx - bw / 2, bx + bw / 2
+        py0, py1 = by - bh / 2, by + bh / 2
+        gx0 = (gb[:, :, 0] - gw / 2)[:, None, None, None]
+        gx1 = (gb[:, :, 0] + gw / 2)[:, None, None, None]
+        gy0 = (gb[:, :, 1] - gh / 2)[:, None, None, None]
+        gy1 = (gb[:, :, 1] + gh / 2)[:, None, None, None]
+        iw = jnp.maximum(jnp.minimum(px1[..., None], gx1)
+                         - jnp.maximum(px0[..., None], gx0), 0)
+        ih = jnp.maximum(jnp.minimum(py1[..., None], gy1)
+                         - jnp.maximum(py0[..., None], gy0), 0)
+        inter2 = iw * ih
+        area_p = (bw * bh)[..., None]
+        area_g = (gw * gh)[:, None, None, None]
+        iou = inter2 / jnp.maximum(area_p + area_g - inter2, 1e-9)
+        iou = jnp.where(valid[:, None, None, None], iou, 0.0)
+        ignore = iou.max(-1) > ignore_thresh  # [N, S, H, W]
+
+        obj_t = jnp.zeros((N, S, H, W), jnp.float32)
+        obj_w = jnp.where(ignore, 0.0, 1.0)
+        site_idx = sl * H * W + gj * W + gi  # [N, B]
+        pos = jnp.zeros((N, S * H * W), jnp.float32)
+        pos_sc = jnp.zeros((N, S * H * W), jnp.float32)
+        m = matched.astype(jnp.float32)
+        # scatter positives (last gt wins per cell, like sequential writes)
+        bidx = jnp.arange(N)[:, None]
+        pos = pos.at[bidx, site_idx].max(m)
+        pos_sc = pos_sc.at[bidx, site_idx].max(m * score)
+        pos = pos.reshape(N, S, H, W)
+        pos_sc = pos_sc.reshape(N, S, H, W)
+        obj_t = jnp.where(pos > 0, 1.0, obj_t)
+        obj_w = jnp.where(pos > 0, pos_sc, obj_w)
+        loss_obj = (bce(xa[:, :, 4], obj_t) * obj_w).sum((1, 2, 3))
+
+        return loss_loc.sum(1) + loss_cls.sum(1) + loss_obj
+
+    return dispatch(fn, *args, op_name="yolo_loss")
+
+
+from ..nn.layer_base import Layer as _Layer  # noqa: E402  (no nn->vision
+# cycle exists: nn never imports vision, and the package __init__ imports
+# nn before vision)
+
+
+class DeformConv2D(_Layer):
+    """Deformable conv layer over :func:`deform_conv2d` (reference
+    vision/ops.py DeformConv2D): holds weight/bias; offset (and v2 mask)
+    arrive per-forward from a companion conv."""
+
+    def __init__(self, in_channels, out_channels, kernel_size,
+                 stride=1, padding=0, dilation=1,
+                 deformable_groups=1, groups=1, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        ks = (kernel_size, kernel_size) if isinstance(
+            kernel_size, int) else tuple(kernel_size)
+        self._cfg = (stride, padding, dilation, deformable_groups,
+                     groups)
+        from ..nn import initializer as I
+
+        fan_in = in_channels * ks[0] * ks[1] // groups
+        bound = 1.0 / (fan_in ** 0.5)
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups, *ks),
+            attr=weight_attr,
+            default_initializer=I.Uniform(-bound, bound))
+        self.bias = None if bias_attr is False else \
+            self.create_parameter(
+                (out_channels,), attr=bias_attr,
+                default_initializer=I.Uniform(-bound, bound))
+
+    def forward(self, x, offset, mask=None):
+        stride, padding, dilation, dg, groups = self._cfg
+        return deform_conv2d(
+            x, offset, self.weight, bias=self.bias, stride=stride,
+            padding=padding, dilation=dilation,
+            deformable_groups=dg, groups=groups, mask=mask)
+
+
+def read_file(filename, name=None):
+    """Read a file's raw bytes as a 1-D uint8 Tensor (reference
+    read_file_op; host-side IO feeding decode_jpeg)."""
+    from ..core.tensor import Tensor
+
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(jnp.asarray(np.frombuffer(data, np.uint8)))
+
+
+def decode_jpeg(x, mode: str = "unchanged", name=None):
+    """Decode a JPEG byte Tensor to [C, H, W] uint8 (reference
+    decode_jpeg_op via nvjpeg; host-side via Pillow here — decode is IO,
+    the chip sees the decoded tensor)."""
+    import io as _io
+
+    from ..core.tensor import Tensor
+    from ..utils.tools import try_import
+
+    Image = try_import("PIL.Image",
+                       "decode_jpeg needs Pillow for host-side decode")
+    data = np.asarray(_unwrap(x), np.uint8).tobytes()
+    img = Image.open(_io.BytesIO(data))
+    if mode != "unchanged":
+        conv = {"gray": "L", "rgb": "RGB"}.get(mode)
+        if conv is None:
+            raise ValueError(f"decode_jpeg mode must be unchanged/gray/rgb,"
+                             f" got {mode!r}")
+        img = img.convert(conv)
+    arr = np.asarray(img, np.uint8)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = np.transpose(arr, (2, 0, 1))
+    return Tensor(jnp.asarray(arr))
+
+
+__all__ += ["yolo_loss", "DeformConv2D", "read_file", "decode_jpeg"]

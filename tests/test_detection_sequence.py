@@ -6,6 +6,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+import paddle_tpu as paddle
 from paddle_tpu.ops import sequence as seq
 from paddle_tpu.vision import ops as vops
 
@@ -294,3 +295,147 @@ def test_deform_conv2d_static_program():
         "m": rng.random((2, 9, 5, 5)).astype(np.float32),
     }, fetch_list=[loss])
     assert np.isfinite(lv)
+
+
+class TestYoloLoss:
+    def _mk(self, seed=0, N=2, C=3, H=4, W=4, S=3):
+        rng = np.random.default_rng(seed)
+        x = rng.standard_normal((N, S * (5 + C), H, W)).astype(np.float32)
+        return rng, x
+
+    ANCHORS = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45, 59, 119]
+    MASK = [0, 1, 2]
+
+    def test_shapes_and_finiteness(self):
+        from paddle_tpu.vision.ops import yolo_loss
+
+        rng, x = self._mk()
+        gt_box = np.array([[[0.3, 0.3, 0.2, 0.2], [0.7, 0.6, 0.1, 0.3]],
+                           [[0.5, 0.5, 0.4, 0.4], [0, 0, 0, 0]]],
+                          np.float32)
+        gt_label = np.array([[1, 2], [0, 0]], np.int64)
+        out = yolo_loss(paddle.to_tensor(x), paddle.to_tensor(gt_box),
+                        paddle.to_tensor(gt_label), self.ANCHORS, self.MASK,
+                        class_num=3, ignore_thresh=0.7,
+                        downsample_ratio=32)
+        v = np.asarray(out.value)
+        assert v.shape == (2,) and np.isfinite(v).all() and (v > 0).all()
+
+    def test_perfect_prediction_minimizes_loss(self):
+        # encode the gt into the prediction exactly: its loss must be far
+        # below a random prediction's
+        from paddle_tpu.vision.ops import yolo_loss
+
+        C, H, W, S = 3, 4, 4, 3
+        anchors, mask = self.ANCHORS, self.MASK
+        gt = np.array([[[0.40625, 0.40625, 0.15, 0.2]]], np.float32)
+        label = np.array([[2]], np.int64)
+        in_w = W * 32
+        # matching anchor: best IoU vs (0.15*128, 0.2*128)=(19.2, 25.6) →
+        # anchor 1 (16, 30)
+        x = np.zeros((1, S * (5 + C), H, W), np.float32)
+        xr = x.reshape(1, S, 5 + C, H, W)
+        gi, gj, sl = 1, 1, 1
+        tx = 0.40625 * W - gi
+        big = 8.0
+        xr[0, sl, 0, gj, gi] = np.log(tx / (1 - tx))
+        xr[0, sl, 1, gj, gi] = np.log(tx / (1 - tx))
+        xr[0, sl, 2, gj, gi] = np.log(0.15 * in_w / anchors[2 * 1])
+        xr[0, sl, 3, gj, gi] = np.log(0.2 * in_w / anchors[2 * 1 + 1])
+        xr[0, sl, 4] = -big           # no object anywhere...
+        xr[0, sl, 4, gj, gi] = big    # ...except the match site
+        xr[0, :, 4][np.arange(S) != sl] = -big
+        xr[0, sl, 5:, gj, gi] = -big
+        xr[0, sl, 5 + 2, gj, gi] = big
+        good = yolo_loss(paddle.to_tensor(x), paddle.to_tensor(gt),
+                         paddle.to_tensor(label), anchors, mask, 3, 0.7, 32,
+                         use_label_smooth=False)
+        rng = np.random.default_rng(3)
+        bad = yolo_loss(
+            paddle.to_tensor(rng.standard_normal(x.shape).astype(
+                np.float32)),
+            paddle.to_tensor(gt), paddle.to_tensor(label), anchors, mask,
+            3, 0.7, 32, use_label_smooth=False)
+        g, b = float(good.value[0]), float(bad.value[0])
+        assert g < 0.1 * b, (g, b)
+
+    def test_ignore_thresh_suppresses_overlapping_negatives(self):
+        # a prediction overlapping a gt above the threshold must NOT pay
+        # objectness loss; lower the threshold and the loss reappears
+        from paddle_tpu.vision.ops import yolo_loss
+
+        rng, x = self._mk(seed=5)
+        gt = np.array([[[0.5, 0.5, 0.5, 0.5]]], np.float32)
+        label = np.array([[0]], np.int64)
+        args = (paddle.to_tensor(x[:1]), paddle.to_tensor(gt),
+                paddle.to_tensor(label), self.ANCHORS, self.MASK, 3)
+        loose = yolo_loss(*args, ignore_thresh=0.99, downsample_ratio=32)
+        tight = yolo_loss(*args, ignore_thresh=0.01, downsample_ratio=32)
+        assert float(tight.value[0]) <= float(loose.value[0])
+
+    def test_grad_flows(self):
+        import jax
+
+        from paddle_tpu.vision.ops import yolo_loss
+
+        rng, x = self._mk(seed=7, N=1)
+        gt = np.array([[[0.4, 0.4, 0.2, 0.2]]], np.float32)
+        label = np.array([[1]], np.int64)
+
+        def loss(arr):
+            from paddle_tpu.core.tensor import Tensor
+
+            return yolo_loss(Tensor(arr), Tensor(jnp.asarray(gt)),
+                             Tensor(jnp.asarray(label)), self.ANCHORS,
+                             self.MASK, 3, 0.7, 32).value.sum()
+
+        g = jax.grad(loss)(jnp.asarray(x[:1]))
+        assert np.isfinite(np.asarray(g)).all()
+        assert np.abs(np.asarray(g)).max() > 0
+
+
+class TestDeformConvLayerAndImageIO:
+    def test_deform_conv2d_layer_matches_functional(self):
+        from paddle_tpu.vision.ops import DeformConv2D, deform_conv2d
+
+        rng = np.random.default_rng(0)
+        paddle.seed(0)
+        layer = DeformConv2D(4, 6, 3, padding=1)
+        x = paddle.to_tensor(
+            rng.standard_normal((2, 4, 8, 8)).astype(np.float32))
+        off = paddle.to_tensor(
+            (0.1 * rng.standard_normal((2, 18, 8, 8))).astype(np.float32))
+        out = layer(x, off)
+        ref = deform_conv2d(x, off, layer.weight, bias=layer.bias,
+                            padding=1)
+        np.testing.assert_allclose(np.asarray(out.value),
+                                   np.asarray(ref.value), rtol=1e-5)
+        # zero offsets == plain conv
+        z = paddle.to_tensor(np.zeros((2, 18, 8, 8), np.float32))
+        out0 = layer(x, z)
+        import paddle_tpu.nn.functional as F
+
+        conv = F.conv2d(x, layer.weight, bias=layer.bias, padding=1)
+        np.testing.assert_allclose(np.asarray(out0.value),
+                                   np.asarray(conv.value), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_read_file_decode_jpeg_roundtrip(self, tmp_path):
+        import PIL.Image as Image
+
+        from paddle_tpu.vision.ops import decode_jpeg, read_file
+
+        # smooth gradients survive the lossy codec (random noise does not)
+        yy, xx = np.mgrid[0:16, 0:20]
+        arr = np.stack([yy * 8, xx * 6, (yy + xx) * 4], -1).astype(np.uint8)
+        p = str(tmp_path / "t.jpg")
+        Image.fromarray(arr).save(p, quality=95)
+        data = read_file(p)
+        assert data.value.dtype == np.uint8 and data.value.ndim == 1
+        img = decode_jpeg(data, mode="rgb")
+        v = np.asarray(img.value)
+        assert v.shape == (3, 16, 20)
+        # lossy codec: structural agreement, not exact equality
+        assert np.abs(v.astype(np.int32)
+                      - np.transpose(arr, (2, 0, 1)).astype(
+                          np.int32)).mean() < 12
