@@ -93,6 +93,13 @@ def _ref_all(rel):
     ("distributed.fleet", "python/paddle/distributed/fleet/__init__.py"),
     ("incubate", "python/paddle/incubate/__init__.py"),
     ("text", "python/paddle/text/__init__.py"),
+    ("nn.functional", "python/paddle/nn/functional/__init__.py"),
+    ("metric", "python/paddle/metric/__init__.py"),
+    ("optimizer", "python/paddle/optimizer/__init__.py"),
+    ("io", "python/paddle/io/__init__.py"),
+    ("vision.transforms", "python/paddle/vision/transforms/__init__.py"),
+    ("vision.datasets", "python/paddle/vision/datasets/__init__.py"),
+    ("vision.models", "python/paddle/vision/models/__init__.py"),
 ])
 def test_subnamespace_covers_reference_all(sub, rel):
     names = _ref_all(rel)
