@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -490,8 +491,11 @@ def batch_norm(
     reduce_axes = None
 
     def fn(a, *rest):
-        w = rest[0] if weight is not None else None
-        b = rest[1] if bias is not None else None
+        # rest holds only the PROVIDED affine params, in (weight, bias)
+        # order - bias-without-weight must not read weight's slot
+        it = iter(rest)
+        w = next(it) if weight is not None else None
+        b = next(it) if bias is not None else None
         rm, rv = _v(running_mean), _v(running_var)
         ax = axis % a.ndim
         raxes = tuple(i for i in range(a.ndim) if i != ax)
@@ -523,8 +527,22 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
     nd = len(tuple(normalized_shape))
 
     def fn(a, *rest):
-        w = rest[0] if weight is not None else None
-        b = rest[1] if bias is not None else None
+        # rest holds only the PROVIDED affine params, in (weight, bias)
+        # order — bias-without-weight must not read weight's slot
+        it = iter(rest)
+        w = next(it) if weight is not None else None
+        b = next(it) if bias is not None else None
+        if nd == 1 and a.ndim >= 2 and \
+                os.environ.get("PADDLE_TPU_FUSED_LN", "") == "1":
+            # Pallas row-statistics kernel when available (TPU, aligned
+            # shapes); fused_layer_norm probes once per config and falls
+            # back to this same XLA expression otherwise.  Opt-in until the
+            # on-device parity check (tools/check_flash_tpu.py) has passed
+            # on real hardware — a compiling-but-wrong kernel must never be
+            # able to contaminate a bench headline silently.
+            from ...ops.fused_norm import fused_layer_norm
+
+            return fused_layer_norm(a, weight=w, bias=b, eps=epsilon)
         axes = tuple(range(a.ndim - nd, a.ndim))
         m = jnp.mean(a, axis=axes, keepdims=True)
         v = jnp.var(a, axis=axes, keepdims=True)
@@ -546,8 +564,11 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
 def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
                   use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW"):
     def fn(a, *rest):
-        w = rest[0] if weight is not None else None
-        b = rest[1] if bias is not None else None
+        # rest holds only the PROVIDED affine params, in (weight, bias)
+        # order - bias-without-weight must not read weight's slot
+        it = iter(rest)
+        w = next(it) if weight is not None else None
+        b = next(it) if bias is not None else None
         axes = tuple(range(2, a.ndim))
         m = jnp.mean(a, axis=axes, keepdims=True)
         v = jnp.var(a, axis=axes, keepdims=True)
@@ -569,8 +590,11 @@ def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None
 
 def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5, data_format="NCHW"):
     def fn(a, *rest):
-        w = rest[0] if weight is not None else None
-        b = rest[1] if bias is not None else None
+        # rest holds only the PROVIDED affine params, in (weight, bias)
+        # order - bias-without-weight must not read weight's slot
+        it = iter(rest)
+        w = next(it) if weight is not None else None
+        b = next(it) if bias is not None else None
         n, c = a.shape[:2]
         spatial = a.shape[2:]
         g = a.reshape(n, num_groups, c // num_groups, *spatial)

@@ -1,0 +1,67 @@
+"""Shared availability-probe scaffolding for Pallas TPU kernels.
+
+Every Pallas kernel module (flash_attention, fused_norm, fused_ce) wants the
+same contract: try the kernel once per *configuration*, remember whether the
+Mosaic lowering worked, and fall back to the plain XLA expression forever
+after if it didn't — so the kernels are safe to call from any path on any
+backend.
+
+Two lessons are encoded here so they stay single-site:
+
+* the probe must run under ``jax.ensure_compile_time_eval()`` — "eager" jax
+  ops inside an outer jit trace are otherwise silently staged into that
+  trace (stackless tracing), nothing compiles or raises, and a broken
+  Pallas path reports healthy;
+* the probe must execute the kernel with the SAME configuration the real
+  call will use (block shapes, dtypes) — a fixed tiny probe config can
+  lower fine while the production one fails, letting the exception escape
+  into the training step.  Callers are responsible for keying the cache on
+  everything that changes the lowering.
+"""
+from __future__ import annotations
+
+import jax
+
+# Shared block geometry for row-sweep kernels (fused_norm, fused_ce): one
+# row-block of fp32 working set per buffer, a handful of buffers resident —
+# well under the ~16 MB VMEM core budget.  Single-site so a retune for a
+# new TPU generation applies to every kernel at once.
+BLOCK_BYTES = 2 * 1024 * 1024
+ROW_PAD = 8  # row counts are padded up to this multiple before blocking
+
+
+def row_block(N: int, row_elems: int, limit: int = BLOCK_BYTES) -> int | None:
+    """Largest row-block size dividing ``N`` whose fp32 working block of
+    ``row_elems`` columns fits the budget; None if no candidate divides."""
+    for bn in (256, 128, 64, 32, 16, 8):
+        if N % bn == 0 and bn * row_elems * 4 <= limit:
+            return bn
+    return None
+
+
+def pad_rows(N: int) -> int:
+    return -(-N // ROW_PAD) * ROW_PAD
+
+
+def tpu_backend() -> bool:
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def probe_once(cache: dict, key, thunk) -> bool:
+    """True = fall back.  ``thunk`` must compile+run the kernel fwd+bwd on
+    concrete arrays shaped like the real call; any exception marks ``key``
+    as unavailable permanently (for this process)."""
+    if key not in cache:
+        if not tpu_backend():
+            cache[key] = True
+            return True
+        try:
+            with jax.ensure_compile_time_eval():
+                jax.block_until_ready(jax.tree_util.tree_leaves(thunk()))
+            cache[key] = False
+        except Exception:
+            cache[key] = True
+    return cache[key]

@@ -34,21 +34,20 @@ def _shape_supported(q_shape, s_len) -> bool:
 
 def _probe(dtype, causal: bool, D: int) -> bool:
     """Eagerly compile+run a tiny fwd+bwd pair once per (dtype, causal, D)
-    configuration; True = must fall back.  Runs OUTSIDE any jit so Mosaic
-    lowering failures are actually caught — and keyed per config so e.g. a
+    configuration; True = must fall back.  Keyed per config so e.g. a
     bf16- or causal-specific lowering failure can't hide behind a healthy
-    fp32 non-causal probe."""
-    cache_key = (jnp.dtype(dtype).name, bool(causal), int(D))
-    if cache_key not in _FALLBACK:
-        try:
-            z = jax.device_put(jnp.zeros((1, 128, 1, D), dtype))
-            out, vjp_fn = jax.vjp(
-                lambda a, b, c: _flash(a, b, c, causal, None), z, z, z)
-            jax.block_until_ready(jax.tree_util.tree_leaves(vjp_fn(out)))
-            _FALLBACK[cache_key] = False
-        except Exception:
-            _FALLBACK[cache_key] = True
-    return _FALLBACK[cache_key]
+    fp32 non-causal probe; execution discipline (ensure_compile_time_eval,
+    platform gate) lives in ops/_pallas_probe.py."""
+    from ._pallas_probe import probe_once
+
+    def thunk():
+        z = jax.device_put(jnp.zeros((1, 128, 1, D), dtype))
+        out, vjp_fn = jax.vjp(
+            lambda a, b, c: _flash(a, b, c, causal, None), z, z, z)
+        return vjp_fn(out)
+
+    return probe_once(_FALLBACK,
+                      (jnp.dtype(dtype).name, bool(causal), int(D)), thunk)
 
 
 def flash_attention(q, k, v, causal: bool = False, scale=None):

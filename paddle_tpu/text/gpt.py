@@ -273,6 +273,13 @@ def loss_fn(params: dict, tokens, cfg: GPTConfig, act_sharding=None, key=None):
     logits, aux = forward_with_aux(params, tokens[:, :-1], cfg,
                                    act_sharding=act_sharding, key=key)
     tgt = tokens[:, 1:]
+    if os.environ.get("PADDLE_TPU_FUSED_CE", "") == "1":
+        # Pallas blockwise loss head: no [B, T, V] fp32 log-softmax in HBM
+        # (ops/fused_ce.py; falls back to the expression below off-TPU).
+        # Opt-in until the on-device parity check has passed on hardware.
+        from ..ops.fused_ce import fused_softmax_ce
+
+        return jnp.mean(fused_softmax_ce(logits, tgt)) + aux
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     ll = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll) + aux

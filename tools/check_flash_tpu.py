@@ -28,6 +28,53 @@ def check(B, T, H, D, causal, dtype):
                                    err_msg=f"{name} B{B} T{T} H{H} D{D} causal={causal} {dtype}")
     print(f"  OK B{B} T{T} H{H} D{D} causal={causal} {jnp.dtype(dtype).name}")
 
+def check_fused_ln(N, F, dtype):
+    from paddle_tpu.ops import fused_norm as fnorm
+    ks = jax.random.split(jax.random.PRNGKey(7), 4)
+    x = jax.random.normal(ks[0], (N, F), dtype)
+    g = (jax.random.normal(ks[1], (F,)) + 1.0).astype(dtype)
+    b = jax.random.normal(ks[2], (F,), dtype)
+    tol = 3e-2 if dtype == jnp.bfloat16 else 1e-4
+    y = fnorm._fused_ln(x, g, b, 1e-5)
+    ref = fnorm._xla_ln(x.astype(jnp.float32), g.astype(jnp.float32),
+                        b.astype(jnp.float32), 1e-5)
+    np.testing.assert_allclose(np.asarray(y, np.float32), np.asarray(ref),
+                               atol=tol, rtol=tol)
+    dy = jax.random.normal(ks[3], (N, F), dtype)
+    _, vjp = jax.vjp(lambda a, w, c: fnorm._fused_ln(a, w, c, 1e-5), x, g, b)
+    _, rvjp = jax.vjp(lambda a, w, c: fnorm._xla_ln(a, w, c, 1e-5),
+                      x.astype(jnp.float32), g.astype(jnp.float32),
+                      b.astype(jnp.float32))
+    for name, got, want in zip("dx dg db".split(), vjp(dy),
+                               rvjp(dy.astype(jnp.float32))):
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(want, np.float32),
+                                   atol=tol * 4, rtol=tol * 4,
+                                   err_msg=f"{name} N{N} F{F} {dtype}")
+    print(f"  fused_ln OK N{N} F{F} {jnp.dtype(dtype).name}")
+
+
+def check_fused_ce(N, V, dtype):
+    from paddle_tpu.ops import fused_ce as fce
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(11), 3)
+    logits = (jax.random.normal(k1, (N, V)) * 3.0).astype(dtype)
+    labels = jax.random.randint(k2, (N,), 0, V, jnp.int32)
+    tol = 3e-2 if dtype == jnp.bfloat16 else 1e-4
+    loss = fce._fused_ce(logits, labels)
+    ref = fce._xla_ce(logits.astype(jnp.float32), labels)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(ref),
+                               atol=tol, rtol=tol)
+    dl = jax.random.normal(k3, (N,))
+    _, vjp = jax.vjp(lambda a: fce._fused_ce(a, labels), logits)
+    _, rvjp = jax.vjp(lambda a: fce._xla_ce(a, labels),
+                      logits.astype(jnp.float32))
+    np.testing.assert_allclose(np.asarray(vjp(dl)[0], np.float32),
+                               np.asarray(rvjp(dl)[0], np.float32),
+                               atol=tol * 4, rtol=tol * 4,
+                               err_msg=f"dlogits N{N} V{V} {dtype}")
+    print(f"  fused_ce OK N{N} V{V} {jnp.dtype(dtype).name}")
+
+
 if __name__ == "__main__":
     assert jax.devices()[0].platform in ("tpu", "axon"), jax.devices()
     for causal in (False, True):
@@ -35,3 +82,10 @@ if __name__ == "__main__":
         check(2, 512, 4, 128, causal, jnp.bfloat16)
         check(1, 1024, 2, 128, causal, jnp.bfloat16)
     print("flash attention fwd+bwd all OK")
+    check_fused_ln(256, 1024, jnp.float32)
+    check_fused_ln(512, 2048, jnp.bfloat16)
+    check_fused_ln(1024, 4096, jnp.bfloat16)
+    print("fused layer_norm fwd+bwd all OK")
+    check_fused_ce(256, 1024, jnp.float32)
+    check_fused_ce(512, 50304, jnp.bfloat16)  # GPT vocab, 393 x 128 blocks
+    print("fused softmax-CE fwd+bwd all OK")
