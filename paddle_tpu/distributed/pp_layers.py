@@ -22,8 +22,9 @@ state becomes data:
   transpose psums their gradients over 'pp' automatically — the reference's
   ``allreduce_shared_weight_gradients`` (pp_layers.py:188) for free.
 
-Two schedules, matching the reference SectionWorker's ``schedule_mode``
-(section_worker.cc:130-183), selectable via ``build_train_step(schedule=)``:
+Three schedules, selectable via ``build_train_step(schedule=)`` — the
+first two match the reference SectionWorker's ``schedule_mode``
+(section_worker.cc:130-183); the third goes beyond the reference:
 
 * ``"1f1b"`` (default): one scan whose every tick runs ONE forward
   micro-batch step and ONE backward micro-batch step per stage — micro-batch
@@ -35,6 +36,11 @@ Two schedules, matching the reference SectionWorker's ``schedule_mode``
 * ``"fthenb"``: autodiff over the F-then-B scan (micro-batch m enters at
   tick m, leaves at tick m + S - 1) — simpler, but the scan stores residuals
   for every tick, so activation memory grows with M.
+* ``"interleaved"`` (+ ``n_virtual=v``): Megatron-style virtual pipeline
+  stages — each rank holds v round-robin model chunks, shrinking the
+  pipeline bubble by ~v at the cost of more in-flight activations.  The
+  schedule itself is generated and dependency-validated as data in
+  pp_schedule.py and executed by :class:`InterleavedPipelineTrainStep`.
 
 The flagship GPT path (text/gpt_hybrid.py) keeps its hand-built
 Megatron-aware 1F1B; this module generalizes the same schedule to
@@ -172,6 +178,30 @@ def _unwrap_tree(x):
         is_leaf=lambda t: isinstance(t, Tensor))
 
 
+def _current_lr_of(optimizer, step: int) -> float:
+    from ..optimizer.lr import LRScheduler
+
+    if isinstance(optimizer._lr, LRScheduler):
+        return float(optimizer._lr.lr_at(step))
+    return optimizer.get_lr()
+
+
+def _check_batch_divisible(X, n_micro: int, dp: int):
+    for leaf in jax.tree_util.tree_leaves(X):
+        B = np.shape(leaf)[0]
+        if B % (n_micro * dp):
+            raise ValueError(
+                f"global batch {B} must divide by n_micro*dp = "
+                f"{n_micro * dp}")
+
+
+def _put_batch(tree, sharding):
+    return jax.tree_util.tree_map(
+        lambda a: jax.device_put(jnp.asarray(
+            a.value if isinstance(a, Tensor) else a), sharding), tree,
+        is_leaf=lambda a: isinstance(a, Tensor))
+
+
 def _apply_item(item: _Item, params, bufs, x, training: bool):
     """Run one list item functionally; returns (y, new_bufs)."""
     from ..jit import _swap_state
@@ -242,7 +272,11 @@ class PipelineLayer(Layer):
 
     # -- segmentation ------------------------------------------------------
     def _segment(self, method: str):
-        n, S = len(self._items), self.num_stages
+        self._seg_method = method
+        return self._segment_bounds(method, self.num_stages)
+
+    def _segment_bounds(self, method: str, S: int):
+        n = len(self._items)
         if method == "uniform":
             weights = [1.0] * n
         elif method == "parameters":
@@ -290,22 +324,32 @@ class PipelineLayer(Layer):
     def build_train_step(self, mesh: Mesh, optimizer, loss_fn,
                          n_micro: int, example_input, dp_axis: str = "dp",
                          pp_axis: str = "pp", remat: bool = True,
-                         schedule: str = "1f1b"):
+                         schedule: str = "1f1b", n_virtual: int = 1):
         """Compile the pp(+dp)-parallel train step over ``mesh``.
 
         ``example_input``: one (global-batch) input array/pytree used to
         trace boundary shapes — its per-micro-batch slice must be valid.
-        ``schedule``: "1f1b" (interleaved, activation memory bounded by the
-        in-flight window — reference section_worker.cc schedule_mode 1) or
-        "fthenb" (autodiff over the forward scan, residuals for every tick
-        — schedule_mode 0).  With one stage both collapse to the same loop.
+        ``schedule``: "1f1b" (activation memory bounded by the in-flight
+        window — reference section_worker.cc schedule_mode 1), "fthenb"
+        (autodiff over the forward scan, residuals for every tick —
+        schedule_mode 0), or "interleaved" (virtual pipeline stages:
+        each rank holds ``n_virtual`` model chunks round-robin, shrinking
+        the pipeline bubble by ~n_virtual — beyond the reference, which
+        has only modes 0/1; see pp_schedule.py).  With one stage all
+        collapse to the same loop.
         ``remat``: rematerialize stage forwards in the backward pass — under
         "fthenb" this is what keeps the scan's residuals to one boundary
-        buffer per tick; under "1f1b" it bounds the *within-tick* VJP
-        residuals to the branch inputs (the cross-tick window is already
-        flat in M by construction).
-        Returns a :class:`PipelineTrainStep`: call ``(X, Y) -> loss``.
+        buffer per tick; under "1f1b"/"interleaved" it bounds the
+        *within-tick* VJP residuals to the branch inputs (the cross-tick
+        window is already flat in M by construction).
+        Returns a step object: call ``(X, Y) -> loss``.
         """
+        if schedule == "interleaved":
+            return InterleavedPipelineTrainStep(
+                self, mesh, optimizer, loss_fn, n_micro, example_input,
+                dp_axis, pp_axis, remat, n_virtual)
+        if n_virtual != 1:
+            raise ValueError("n_virtual > 1 requires schedule='interleaved'")
         return PipelineTrainStep(self, mesh, optimizer, loss_fn, n_micro,
                                  example_input, dp_axis, pp_axis, remat,
                                  schedule)
@@ -684,33 +728,12 @@ class PipelineTrainStep:
                 "boundary_sizes": b_sizes, "boundary_padded": A,
                 "boundary_waste_frac": b_waste}
 
-    def _current_lr(self):
-        from ..optimizer.lr import LRScheduler
-
-        if isinstance(self.optimizer._lr, LRScheduler):
-            return float(self.optimizer._lr.lr_at(self._step))
-        return self.optimizer.get_lr()
-
     def __call__(self, X, Y):
-        dp = self._dp
-        for leaf in jax.tree_util.tree_leaves(X):
-            B = np.shape(leaf)[0]
-            if B % (self.n_micro * dp):
-                raise ValueError(
-                    f"global batch {B} must divide by n_micro*dp = "
-                    f"{self.n_micro * dp}")
-        X = jax.tree_util.tree_map(
-            lambda a: jax.device_put(jnp.asarray(
-                a.value if isinstance(a, Tensor) else a),
-                self._data_sharding), X,
-            is_leaf=lambda a: isinstance(a, Tensor))
-        Y = jax.tree_util.tree_map(
-            lambda a: jax.device_put(jnp.asarray(
-                a.value if isinstance(a, Tensor) else a),
-                self._data_sharding), Y,
-            is_leaf=lambda a: isinstance(a, Tensor))
+        _check_batch_divisible(X, self.n_micro, self._dp)
+        X = _put_batch(X, self._data_sharding)
+        Y = _put_batch(Y, self._data_sharding)
         key = _random.next_key()
-        lr = self._current_lr()
+        lr = _current_lr_of(self.optimizer, self._step)
         # pass the 0-based step; step_fn's +1 makes Adam's first update t=1
         self._params, self._opt_state, self._bvec, loss = self._compiled(
             self._params, self._opt_state, self._bvec, X, Y, key, lr,
@@ -734,6 +757,340 @@ class PipelineTrainStep:
                     p._value = ptree[str(j)][k]
                 for k, b in it.layer.named_buffers():
                     b._value = btree[str(j)][k]
+        for key, l in pl._shared_layers.items():
+            for k, p in l.named_parameters():
+                p._value = self._params["shared"][key][k]
+
+
+class InterleavedPipelineTrainStep:
+    """Interleaved-1F1B (virtual pipeline stages) train step.
+
+    Megatron-LM style: the layer list is cut into ``S * v`` virtual stages
+    and virtual stage ``j`` lives on rank ``j % S`` (chunk ``j // S``), so
+    consecutive stages sit on consecutive ranks and every hop — including
+    the chunk-boundary wrap from rank S-1 back to rank 0 — is one
+    ``lax.ppermute`` neighbor step on the 'pp' ring.  The pipeline fill is
+    paid in chunk units, shrinking the bubble fraction by ~v (the
+    reference's SectionWorker has only F-then-B and flat 1F1B).
+
+    SPMD shape: the schedule is data (pp_schedule.build's dependency-
+    validated [ticks, S] slot table).  One ``lax.scan`` tick stashes the
+    activations/cotangents that arrived over the ring, then runs a 3-way
+    ``lax.switch`` — forward slot, backward (VJP) slot, or idle — so each
+    rank pays only its scheduled chunk-exec per tick (XLA conditionals
+    execute only the taken branch), then both ppermutes run
+    unconditionally (collectives must be uniform across ranks).
+
+    Per-rank state: params pvec rank-major ``[S*v, Lp]`` sharded P('pp')
+    (local rows = this rank's v chunks), activation ring ``[v, BUF, A]``
+    and cotangent ring ``[v, BUF, A]`` with BUF = the schedule's measured
+    max in-flight window.  Stages with buffers (BatchNorm) are rejected —
+    their update order under interleaving is schedule-dependent; use
+    schedule='1f1b' for those models.
+    """
+
+    def __init__(self, pl: PipelineLayer, mesh: Mesh, optimizer, loss_fn,
+                 n_micro: int, example_input, dp_axis: str, pp_axis: str,
+                 remat: bool, n_virtual: int):
+        from .pp_schedule import build as _build_schedule
+
+        S = mesh.shape[pp_axis]
+        if S != pl.num_stages:
+            raise ValueError(f"mesh '{pp_axis}' size {S} != num_stages "
+                             f"{pl.num_stages}")
+        v = int(n_virtual)
+        if v < 1:
+            raise ValueError("n_virtual must be >= 1")
+        V = S * v
+        if len(pl._items) < V:
+            raise ValueError(
+                f"cannot split {len(pl._items)} layers into {V} virtual "
+                f"stages (num_stages={S} x n_virtual={v})")
+        dp = mesh.shape.get(dp_axis, 1)
+        M = n_micro
+        self.pl = pl
+        self.mesh = mesh
+        self._dp = dp
+        self._v = v
+        self.optimizer = optimizer
+        self.n_micro = M
+        self._step = 0
+        training = pl.training
+        sched = _build_schedule(S, v, M)
+        self._sched = sched
+        BUF = sched.buf
+
+        bounds = pl._segment_bounds(pl._seg_method, V)
+        self._vbounds = bounds
+
+        def vstage_items(j):
+            return pl._items[bounds[j]: bounds[j + 1]]
+
+        from ..jit import _split_state as _jit_split_state
+
+        stage_ptrees = []
+        for j in range(V):
+            pt = {}
+            for i, it in enumerate(vstage_items(j)):
+                if it.kind != "layer":
+                    continue
+                p, b = _jit_split_state(it.layer)
+                if b:
+                    raise NotImplementedError(
+                        "interleaved schedule does not support stages with "
+                        "buffers (running BatchNorm stats update in "
+                        "schedule-dependent order); use schedule='1f1b'")
+                pt[str(i)] = p
+            stage_ptrees.append(pt)
+        shared_p = {}
+        for key, l in pl._shared_layers.items():
+            shared_p[key], sb = _jit_split_state(l)
+            if sb:
+                raise NotImplementedError(
+                    "SharedLayerDesc layers with buffers are not supported")
+        self._pmetas = [_meta_of(t) for t in stage_ptrees]
+        Lp = max(m.size for m in self._pmetas) or 1
+        # rank-major packing: row r*v + c  =  virtual stage c*S + r, so
+        # P('pp') sharding hands each rank exactly its v chunks
+        rows = []
+        for r in range(S):
+            for c in range(v):
+                j = c * S + r
+                rows.append(_pack(stage_ptrees[j], self._pmetas[j], Lp))
+        pvec = jnp.stack(rows)
+
+        # ---- boundary activation metas
+        def mb_slice(tree):
+            return jax.tree_util.tree_map(
+                lambda a: jnp.zeros((np.shape(a)[0] // (M * max(dp, 1)),)
+                                    + tuple(np.shape(a)[1:]),
+                                    jnp.asarray(a).dtype), tree)
+
+        def run_stage_concrete(j, ptree, sp, x):
+            for i, it in enumerate(vstage_items(j)):
+                if it.kind == "layer":
+                    x, _ = _apply_item(it, ptree[str(i)], {}, x, training)
+                elif it.kind == "shared":
+                    x, _ = _apply_item(it, sp[it.shared_key], {}, x, training)
+                else:
+                    x, _ = _apply_item(it, None, None, x, training)
+            return x
+
+        x_meta = [None] * V
+        x_abs = mb_slice(example_input)
+        for j in range(V):
+            if j >= 1:
+                x_meta[j] = _meta_of(x_abs)
+            x_abs = jax.eval_shape(
+                functools.partial(run_stage_concrete, j, stage_ptrees[j],
+                                  shared_p), x_abs)
+        out_meta = _meta_of(x_abs)
+        A = max([m.size for m in x_meta if m is not None] + [out_meta.size],
+                default=1) or 1
+        self._x_metas = x_meta
+        self._out_meta = out_meta
+        self._A = A
+
+        def make_branch(j, *, emit_loss: bool):
+            pm = self._pmetas[j]
+
+            def branch(pv_row, sp, x_flat, x0, y_lbl, key):
+                ptree = _unpack(pv_row, pm)
+                x = x0 if j == 0 else _unpack(x_flat, x_meta[j])
+                with _random.rng_scope(key):
+                    y = run_stage_concrete(j, ptree, sp, x)
+                loss = jnp.zeros((), jnp.float32)
+                if j == V - 1:
+                    y_send = jnp.zeros((A,), jnp.float32)
+                    if emit_loss:
+                        loss = loss_fn(_wrap_tree(y),
+                                       Tensor(y_lbl, stop_gradient=True))
+                        loss = (loss.value if isinstance(loss, Tensor)
+                                else loss).astype(jnp.float32)
+                else:
+                    y_send = _pack(y, x_meta[j + 1], A)
+                return (y_send, loss) if emit_loss else (y_send,)
+
+            return branch
+
+        fwd_branches = [make_branch(j, emit_loss=False) for j in range(V)]
+        full_branches = [make_branch(j, emit_loss=True) for j in range(V)]
+        perm = [(i, (i + 1) % S) for i in range(S)]
+        perm_bwd = [(i, (i - 1) % S) for i in range(S)]
+        other_axes = tuple(ax for ax in mesh.axis_names
+                           if ax not in (dp_axis, pp_axis)
+                           and mesh.shape[ax] > 1)
+        TBL = jnp.asarray(sched.table)       # [ticks, S, 3]
+        RCF = jnp.asarray(sched.recv_f)
+        RCB = jnp.asarray(sched.recv_b)
+
+        def pp_interleaved(pv_loc, sp, X, Y, key):
+            s_idx = lax.axis_index(pp_axis)
+            M_ = M
+            Xmb = jax.tree_util.tree_map(
+                lambda a: a.reshape((M_, a.shape[0] // M_) + a.shape[1:]), X)
+            Ymb = jax.tree_util.tree_map(
+                lambda a: a.reshape((M_, a.shape[0] // M_) + a.shape[1:]), Y)
+            g_sp0 = jax.tree_util.tree_map(jnp.zeros_like, sp)
+
+            def tick(carry, trow):
+                (x_in, d_in, store_x, store_d, g_pv, g_sp,
+                 loss_acc) = carry
+                tbl_row, rcf_row, rcb_row = trow
+
+                # ---- stash what arrived over the ring last tick
+                fv, fc, fs = (rcf_row[s_idx, 0], rcf_row[s_idx, 1],
+                              rcf_row[s_idx, 2])
+                upd_x = lax.dynamic_update_slice(
+                    store_x, x_in[None, None, :], (fc, fs, 0))
+                store_x = jnp.where(fv == 1, upd_x, store_x)
+                bv_, bc, bs = (rcb_row[s_idx, 0], rcb_row[s_idx, 1],
+                               rcb_row[s_idx, 2])
+                upd_d = lax.dynamic_update_slice(
+                    store_d, d_in[None, None, :], (bc, bs, 0))
+                store_d = jnp.where(bv_ == 1, upd_d, store_d)
+
+                # ---- this tick's slot
+                kind = tbl_row[s_idx, 0]
+                c = tbl_row[s_idx, 1]
+                m = tbl_row[s_idx, 2]
+                j = c * S + s_idx
+                mslot = m % BUF
+                pv_row = lax.dynamic_index_in_dim(pv_loc, c, keepdims=False)
+                x0 = jax.tree_util.tree_map(
+                    lambda a: lax.dynamic_index_in_dim(a, m, keepdims=False),
+                    Xmb)
+                y_lbl = jax.tree_util.tree_map(
+                    lambda a: lax.dynamic_index_in_dim(a, m, keepdims=False),
+                    Ymb)
+                x_flat = lax.dynamic_slice(store_x, (c, mslot, 0),
+                                           (1, 1, A)).reshape(A)
+                dy_in = lax.dynamic_slice(store_d, (c, mslot, 0),
+                                          (1, 1, A)).reshape(A)
+                # fwd and its bwd recompute must see the SAME rng stream
+                k_t = jax.random.fold_in(jax.random.fold_in(key, m), j)
+
+                def fwd_slot(_):
+                    (y_send,) = lax.switch(j, fwd_branches, pv_row, sp,
+                                           x_flat, x0, y_lbl, k_t)
+                    return (y_send, jnp.zeros((A,), jnp.float32), g_pv,
+                            g_sp, jnp.zeros((), jnp.float32))
+
+                def bwd_slot(_):
+                    def run(pvr, sp_, xf_):
+                        return lax.switch(j, full_branches, pvr, sp_, xf_,
+                                          x0, y_lbl, k_t)
+
+                    if remat:
+                        from ..ops.remat_policies import resolve as _rp
+
+                        _cse = os.environ.get(
+                            "PADDLE_TPU_REMAT_PREVENT_CSE", "") == "1"
+                        run_ = jax.checkpoint(
+                            run, prevent_cse=_cse,
+                            policy=_rp(os.environ.get(
+                                "PADDLE_TPU_REMAT_POLICY") or None))
+                    else:
+                        run_ = run
+                    (_, loss_mb), vjp_fn = jax.vjp(run_, pv_row, sp, x_flat)
+                    dy = jnp.where(j == V - 1, jnp.zeros_like(dy_in), dy_in)
+                    g_row, g_sp_t, dx = vjp_fn(
+                        (dy, jnp.ones((), jnp.float32) / M_))
+                    new_row = lax.dynamic_index_in_dim(
+                        g_pv, c, keepdims=False) + g_row
+                    g_pv_n = lax.dynamic_update_index_in_dim(
+                        g_pv, new_row, c, 0)
+                    g_sp_n = jax.tree_util.tree_map(jnp.add, g_sp, g_sp_t)
+                    return (jnp.zeros((A,), jnp.float32), dx, g_pv_n,
+                            g_sp_n, loss_mb)
+
+                def idle_slot(_):
+                    return (jnp.zeros((A,), jnp.float32),
+                            jnp.zeros((A,), jnp.float32), g_pv, g_sp,
+                            jnp.zeros((), jnp.float32))
+
+                y_send, d_send, g_pv, g_sp, loss_add = lax.switch(
+                    kind, [fwd_slot, bwd_slot, idle_slot], 0)
+                x_out = lax.ppermute(y_send, pp_axis, perm)
+                d_out = lax.ppermute(d_send, pp_axis, perm_bwd)
+                return (x_out, d_out, store_x, store_d, g_pv, g_sp,
+                        loss_acc + loss_add), None
+
+            init = (jnp.zeros((A,), jnp.float32),
+                    jnp.zeros((A,), jnp.float32),
+                    jnp.zeros((v, BUF, A), jnp.float32),
+                    jnp.zeros((v, BUF, A), jnp.float32),
+                    jnp.zeros_like(pv_loc), g_sp0,
+                    jnp.zeros((), jnp.float32))
+            (_, _, _, _, g_pv, g_sp, loss_sum), _ = lax.scan(
+                tick, init, (TBL, RCF, RCB))
+            loss = lax.psum(loss_sum, pp_axis) / M_
+            g_sp = lax.psum(g_sp, pp_axis)
+            mean_axes = (dp_axis,) * (dp > 1) + other_axes
+            if mean_axes:
+                loss = lax.pmean(loss, mean_axes)
+                g_pv = lax.pmean(g_pv, mean_axes)
+                g_sp = lax.pmean(g_sp, mean_axes)
+            return loss, g_pv, g_sp
+
+        data_spec = P(dp_axis) if dp > 1 else P()
+        sharded = shard_map(
+            pp_interleaved, mesh=mesh,
+            in_specs=(P(pp_axis, None), P(), data_spec, data_spec, P()),
+            out_specs=(P(), P(pp_axis, None), P()), check_vma=False)
+
+        def step_fn(ptree, opt_state, X, Y, key, lr, step):
+            loss, g_stages, g_shared = sharded(
+                ptree["stages"], ptree["shared"], X, Y, key)
+            grads = {"stages": g_stages, "shared": g_shared}
+            new_p, new_o = optimizer.apply_gradients(
+                grads, ptree, opt_state, lr=lr, step=step + 1)
+            return new_p, new_o, loss
+
+        self._params = {"stages": pvec, "shared": shared_p}
+        pv_shard = NamedSharding(mesh, P(pp_axis, None))
+        repl = NamedSharding(mesh, P())
+        shared_shard = jax.tree_util.tree_map(lambda _: repl, shared_p)
+        self._params = jax.device_put(
+            self._params, {"stages": pv_shard, "shared": shared_shard})
+        self._opt_state = jax.jit(optimizer.init_state)(self._params)
+        self._data_sharding = NamedSharding(mesh, data_spec)
+        self._compiled = jax.jit(step_fn, donate_argnums=(0, 1))
+
+    def schedule_report(self) -> dict:
+        """Bubble accounting straight from the validated slot table."""
+        s = self._sched
+        return {"ticks": s.ticks, "n_virtual": s.n_virtual,
+                "buf": s.buf, "idle_frac": s.idle_frac,
+                "useful_slots": 2 * s.n_stages * s.n_virtual * s.n_micro}
+
+    def __call__(self, X, Y):
+        _check_batch_divisible(X, self.n_micro, self._dp)
+        X = _put_batch(X, self._data_sharding)
+        Y = _put_batch(Y, self._data_sharding)
+        key = _random.next_key()
+        lr = _current_lr_of(self.optimizer, self._step)
+        self._params, self._opt_state, loss = self._compiled(
+            self._params, self._opt_state, X, Y, key, lr, self._step)
+        self._step += 1
+        return Tensor(loss, stop_gradient=True)
+
+    def sync_to_model(self):
+        """Unpack rank-major stage vectors back into the Layers."""
+        pl = self.pl
+        S, v = pl.num_stages, self._v
+        pvec = np.asarray(self._params["stages"])
+        for r in range(S):
+            for c in range(v):
+                j = c * S + r
+                ptree = _unpack(jnp.asarray(pvec[r * v + c]),
+                                self._pmetas[j])
+                items = pl._items[self._vbounds[j]: self._vbounds[j + 1]]
+                for i, it in enumerate(items):
+                    if it.kind != "layer":
+                        continue
+                    for k, p in it.layer.named_parameters():
+                        p._value = ptree[str(i)][k]
         for key, l in pl._shared_layers.items():
             for k, p in l.named_parameters():
                 p._value = self._params["shared"][key][k]

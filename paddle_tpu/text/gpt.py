@@ -160,6 +160,22 @@ def _layer_norm(x, g, b, eps=1e-5):
     return (x - m) * jax.lax.rsqrt(v + eps) * g + b
 
 
+def _ln(x, g, b, dt):
+    """LayerNorm with fp32 statistics, output in the compute dtype.
+
+    The plain path upcasts the whole activation to fp32 (the reference's
+    layer_norm_op.cu accumulates fp32 the same way) — but under scan-over-
+    layers autodiff those fp32 chains become the largest saved residuals
+    (measured on v5e: 6x 288 MB fp32 buffers for GPT-760M at B=1).  The
+    Pallas fused kernel (PADDLE_TPU_FUSED_LN=1) keeps x in the compute
+    dtype end-to-end and saves only [N,1] statistics."""
+    if os.environ.get("PADDLE_TPU_FUSED_LN", "") == "1":
+        from ..ops.fused_norm import fused_layer_norm
+
+        return fused_layer_norm(x, g, b)
+    return _layer_norm(x.astype(jnp.float32), g, b).astype(dt)
+
+
 def _dropout(x, rate, key):
     keep = 1.0 - rate
     mask = jax.random.bernoulli(key, keep, x.shape)
@@ -187,7 +203,7 @@ def _block(x, p, cfg: GPTConfig, dropout_key=None):
     H, hd = cfg.num_heads, cfg.head_dim
     dt = cfg.dtype
     drop = cfg.dropout > 0.0 and dropout_key is not None
-    h = _layer_norm(x.astype(jnp.float32), p["ln1_g"], p["ln1_b"]).astype(dt)
+    h = _ln(x, p["ln1_g"], p["ln1_b"], dt)
     qkv = jnp.einsum("btd,kde->kbte", h, p["qkv_w"].astype(dt)) + p["qkv_b"].astype(dt)[:, None, None]
     q = qkv[0].reshape(B, T, H, hd)
     k = qkv[1].reshape(B, T, H, hd)
@@ -198,7 +214,7 @@ def _block(x, p, cfg: GPTConfig, dropout_key=None):
     if drop:
         a = _dropout(a, cfg.dropout, jax.random.fold_in(dropout_key, 0))
     x = x + a
-    h = _layer_norm(x.astype(jnp.float32), p["ln2_g"], p["ln2_b"]).astype(dt)
+    h = _ln(x, p["ln2_g"], p["ln2_b"], dt)
     if cfg.moe is not None:
         from .moe import moe_ffn
 
@@ -255,7 +271,7 @@ def forward_with_aux(params: dict, tokens, cfg: GPTConfig, act_sharding=None,
             return blk(x, layer_params)
 
         x, aux = jax.lax.scan(scan_body, x, params["blocks"])
-    x = _layer_norm(x.astype(jnp.float32), params["ln_f_g"], params["ln_f_b"]).astype(dt)
+    x = _ln(x, params["ln_f_g"], params["ln_f_b"], dt)
     logits = x @ params["wte"].T.astype(dt)
     return logits, jnp.sum(aux)
 

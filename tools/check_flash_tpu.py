@@ -76,6 +76,12 @@ def check_fused_ce(N, V, dtype):
 
 
 if __name__ == "__main__":
+    # a marker from a PREVIOUS run must not certify this one: remove it
+    # up front so a crash below leaves no stale certification behind
+    _marker = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "FUSED_KERNELS_OK.json")
+    if os.path.exists(_marker):
+        os.remove(_marker)
     assert jax.devices()[0].platform in ("tpu", "axon"), jax.devices()
     for causal in (False, True):
         check(2, 256, 2, 64, causal, jnp.float32)
@@ -89,3 +95,16 @@ if __name__ == "__main__":
     check_fused_ce(256, 1024, jnp.float32)
     check_fused_ce(512, 50304, jnp.bfloat16)  # GPT vocab, 393 x 128 blocks
     print("fused softmax-CE fwd+bwd all OK")
+    # certify the fused LN/CE kernels for the bench ladder: bench.py only
+    # offers its fused rungs when this marker exists (a compiling-but-wrong
+    # kernel must never produce a headline number)
+    import datetime, json
+    marker = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "FUSED_KERNELS_OK.json")
+    with open(marker, "w") as f:
+        json.dump({"ts": datetime.datetime.now(datetime.timezone.utc)
+                   .isoformat(timespec="seconds"),
+                   "device": str(jax.devices()[0].device_kind),
+                   "checks": ["flash_attention", "fused_layer_norm",
+                              "fused_softmax_ce"]}, f, indent=2)
+    print(f"wrote {marker}")
