@@ -119,9 +119,16 @@ def _fused_kernels_ok() -> bool:
     if not os.path.exists(marker):
         return False
     kdir = os.path.join(root, "paddle_tpu", "ops")
-    kernels = [os.path.join(kdir, f) for f in
-               ("fused_norm.py", "fused_ce.py", "flash_attention.py",
-                "_pallas_probe.py")]
+    # import by path: the shared list must be readable without triggering
+    # the paddle_tpu package __init__ (and with it jax) in this process
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "certified", os.path.join(kdir, "certified.py"))
+    certified = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(certified)
+    kernels = [os.path.join(kdir, f)
+               for f in certified.KERNEL_SOURCE_FILES]
     try:
         return os.path.getmtime(marker) > max(os.path.getmtime(k)
                                               for k in kernels)
@@ -239,8 +246,9 @@ def _gpt_rung_estimate(cfg_kwargs, B, T, state_dtype, accum=1,
         # micro-batch grad tree during the scan
         base += n * 2
     Bm = max(1, B // max(1, accum))
-    # logits [Bm*T, V]: bf16 value + bf16 grad, plus (non-fused CE only)
-    # the fp32 log_softmax + its cotangent
+    # logits [Bm*T, V] bytes/element: fused CE = bf16 value + bf16 grad
+    # (4); non-fused adds the fp32 log_softmax + its fp32 cotangent, whose
+    # bf16 downcast fuses into the softmax buffer (2 + 4 + 4 = 10)
     logits = Bm * T * cfg.vocab_size * (4 if fused else 10)
     from paddle_tpu.ops.remat_policies import canonical
 

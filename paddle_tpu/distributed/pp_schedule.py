@@ -64,7 +64,10 @@ def _sim(S: int, v: int, M: int):
     # per-rank forward work list in Megatron order: micro-batches grouped
     # per chunk in runs of S (finish a group of S micro-batches on chunk c
     # before touching chunk c+1, cycling)
-    def fwd_order(r):
+    def fwd_order():
+        # identical for every rank: the rank-dependence of interleaved 1F1B
+        # lives in WHEN a rank may start (the warmup offset), not in the
+        # order it walks its chunks
         order = []
         groups = (M + S - 1) // S
         for g in range(groups):
@@ -74,7 +77,8 @@ def _sim(S: int, v: int, M: int):
                     order.append((c, m))
         return order
 
-    fwd_q = {r: fwd_order(r) for r in range(S)}
+    _order = fwd_order()
+    fwd_q = {r: list(_order) for r in range(S)}
     bwd_q = {r: [] for r in range(S)}  # filled as forwards complete
     slots = []
 

@@ -2,13 +2,73 @@
 
 Run on a real TPU (the pytest suite pins itself to CPU where the Pallas path
 is skipped): python tools/check_flash_tpu.py
+
+The full matrix is ~44 remote compiles; through a slow axon tunnel that can
+exceed one watchdog step budget (round-4 window 2: 20 min, zero checks
+reported).  Each PASSED check is therefore recorded immediately in
+``flash_check_cache.json`` keyed by a kernel-source signature, so a re-run
+in a later healthy window resumes after the last passed check instead of
+restarting; an edit to any kernel source invalidates the whole cache (a
+certification must never outlive the code it certified).
 """
+import json
 import numpy as np
 import jax, jax.numpy as jnp
 import sys, os
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 from paddle_tpu.ops import flash_attention as fa
 from paddle_tpu.ops.attention import xla_attention
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_CACHE = os.path.join(_REPO, "flash_check_cache.json")
+from paddle_tpu.ops.certified import KERNEL_SOURCE_FILES  # noqa: E402
+_KERNEL_SRCS = [os.path.join(_REPO, "paddle_tpu", "ops", f)
+                for f in KERNEL_SOURCE_FILES]
+
+
+def _src_sig() -> str:
+    import hashlib
+
+    h = hashlib.sha256()
+    for p in _KERNEL_SRCS + [os.path.abspath(__file__)]:
+        try:
+            with open(p, "rb") as f:
+                h.update(f.read())
+        except OSError:
+            h.update(b"missing:" + p.encode())
+    return h.hexdigest()[:16]
+
+
+def _load_cache(sig: str) -> set:
+    try:
+        with open(_CACHE) as f:
+            d = json.load(f)
+        if d.get("src_sig") == sig:
+            return set(d.get("passed", []))
+    except Exception:  # noqa: BLE001 - torn/missing cache = empty
+        pass
+    return set()
+
+
+def _save_cache(sig: str, passed: set):
+    tmp = _CACHE + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"src_sig": sig, "passed": sorted(passed)}, f, indent=1)
+    os.replace(tmp, _CACHE)
+
+
+_SIG = _src_sig()
+_PASSED = _load_cache(_SIG)
+
+
+def _cached(key: str, fn):
+    """Run ``fn`` unless ``key`` already passed under the current sources."""
+    if key in _PASSED:
+        print(f"  cached-OK {key}", flush=True)
+        return
+    fn()
+    _PASSED.add(key)
+    _save_cache(_SIG, _PASSED)
 
 def check(B, T, H, D, causal, dtype):
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
@@ -26,7 +86,7 @@ def check(B, T, H, D, causal, dtype):
     for name, x, y in zip("dq dk dv".split(), g, gr):
         np.testing.assert_allclose(np.asarray(x, np.float32), np.asarray(y, np.float32), atol=tol*4, rtol=tol*4,
                                    err_msg=f"{name} B{B} T{T} H{H} D{D} causal={causal} {dtype}")
-    print(f"  OK B{B} T{T} H{H} D{D} causal={causal} {jnp.dtype(dtype).name}")
+    print(f"  OK B{B} T{T} H{H} D{D} causal={causal} {jnp.dtype(dtype).name}", flush=True)
 
 def check_fused_ln(N, F, dtype):
     from paddle_tpu.ops import fused_norm as fnorm
@@ -51,7 +111,7 @@ def check_fused_ln(N, F, dtype):
                                    np.asarray(want, np.float32),
                                    atol=tol * 4, rtol=tol * 4,
                                    err_msg=f"{name} N{N} F{F} {dtype}")
-    print(f"  fused_ln OK N{N} F{F} {jnp.dtype(dtype).name}")
+    print(f"  fused_ln OK N{N} F{F} {jnp.dtype(dtype).name}", flush=True)
 
 
 def check_fused_ce(N, V, dtype):
@@ -72,7 +132,7 @@ def check_fused_ce(N, V, dtype):
                                np.asarray(rvjp(dl)[0], np.float32),
                                atol=tol * 4, rtol=tol * 4,
                                err_msg=f"dlogits N{N} V{V} {dtype}")
-    print(f"  fused_ce OK N{N} V{V} {jnp.dtype(dtype).name}")
+    print(f"  fused_ce OK N{N} V{V} {jnp.dtype(dtype).name}", flush=True)
 
 
 if __name__ == "__main__":
@@ -83,28 +143,48 @@ if __name__ == "__main__":
     if os.path.exists(_marker):
         os.remove(_marker)
     assert jax.devices()[0].platform in ("tpu", "axon"), jax.devices()
-    for causal in (False, True):
-        check(2, 256, 2, 64, causal, jnp.float32)
-        check(2, 512, 4, 128, causal, jnp.bfloat16)
-        check(1, 1024, 2, 128, causal, jnp.bfloat16)
-    print("flash attention fwd+bwd all OK")
-    check_fused_ln(256, 1024, jnp.float32)
-    check_fused_ln(512, 2048, jnp.bfloat16)
-    check_fused_ln(1024, 4096, jnp.bfloat16)
-    print("fused layer_norm fwd+bwd all OK")
-    check_fused_ce(256, 1024, jnp.float32)
-    check_fused_ce(512, 50304, jnp.bfloat16)  # GPT vocab, 393 x 128 blocks
-    print("fused softmax-CE fwd+bwd all OK")
+    if _PASSED:
+        print(f"resuming: {len(_PASSED)} checks cached (sig {_SIG})",
+              flush=True)
+    # ladder-relevant bf16 configs FIRST: if the tunnel wedges mid-run the
+    # next window resumes from the cache, so the checks that actually gate
+    # the headline rungs (causal bf16 flash at head_dim 128, bf16 fused LN,
+    # GPT-vocab fused CE) certify at the earliest opportunity
+    _cached("flash:causal:B2T512H4D128:bf16",
+            lambda: check(2, 512, 4, 128, True, jnp.bfloat16))
+    _cached("fused_ln:N512F2048:bf16",
+            lambda: check_fused_ln(512, 2048, jnp.bfloat16))
+    # GPT vocab, 393 x 128 blocks
+    _cached("fused_ce:N512V50304:bf16",
+            lambda: check_fused_ce(512, 50304, jnp.bfloat16))
+    _cached("flash:causal:B1T1024H2D128:bf16",
+            lambda: check(1, 1024, 2, 128, True, jnp.bfloat16))
+    _cached("fused_ln:N1024F4096:bf16",
+            lambda: check_fused_ln(1024, 4096, jnp.bfloat16))
+    _cached("flash:causal:B2T256H2D64:f32",
+            lambda: check(2, 256, 2, 64, True, jnp.float32))
+    for causal in (False,):
+        _cached(f"flash:c{int(causal)}:B2T256H2D64:f32",
+                lambda c=causal: check(2, 256, 2, 64, c, jnp.float32))
+        _cached(f"flash:c{int(causal)}:B2T512H4D128:bf16",
+                lambda c=causal: check(2, 512, 4, 128, c, jnp.bfloat16))
+        _cached(f"flash:c{int(causal)}:B1T1024H2D128:bf16",
+                lambda c=causal: check(1, 1024, 2, 128, c, jnp.bfloat16))
+    print("flash attention fwd+bwd all OK", flush=True)
+    _cached("fused_ln:N256F1024:f32",
+            lambda: check_fused_ln(256, 1024, jnp.float32))
+    print("fused layer_norm fwd+bwd all OK", flush=True)
+    _cached("fused_ce:N256V1024:f32",
+            lambda: check_fused_ce(256, 1024, jnp.float32))
+    print("fused softmax-CE fwd+bwd all OK", flush=True)
     # certify the fused LN/CE kernels for the bench ladder: bench.py only
     # offers its fused rungs when this marker exists (a compiling-but-wrong
     # kernel must never produce a headline number)
-    import datetime, json
-    marker = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "FUSED_KERNELS_OK.json")
-    with open(marker, "w") as f:
+    import datetime
+    with open(_marker, "w") as f:
         json.dump({"ts": datetime.datetime.now(datetime.timezone.utc)
                    .isoformat(timespec="seconds"),
                    "device": str(jax.devices()[0].device_kind),
                    "checks": ["flash_attention", "fused_layer_norm",
                               "fused_softmax_ce"]}, f, indent=2)
-    print(f"wrote {marker}")
+    print(f"wrote {_marker}", flush=True)

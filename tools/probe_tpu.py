@@ -123,8 +123,12 @@ def _payload_steps():
         # whose calibrated footprint fits the 16 GB v5e — so every later
         # ladder attempt starts from the rungs that can actually run.
         # BENCH_RUNG_TIMEOUT bounds a mid-window re-wedge to ~2x9 min.
+        # 2400s budget (round-4 window 2: the full matrix is ~44 remote
+        # compiles and 20 min wasn't enough for even one pass); the check
+        # resumes from flash_check_cache.json, so each window only pays
+        # for checks not yet passed under the current kernel sources
         ("flash_check", [py, os.path.join(REPO, "tools",
-                                          "check_flash_tpu.py")], 1200, {},
+                                          "check_flash_tpu.py")], 2400, {},
          None),
         ("ladder", [py, bench], 5400, {"BENCH_RUNG_TIMEOUT": "540"}, None),
         ("all", [py, bench, "--all"], 7200,
