@@ -123,16 +123,17 @@ def _fused_kernels_ok() -> bool:
     # the paddle_tpu package __init__ (and with it jax) in this process
     import importlib.util
 
-    spec = importlib.util.spec_from_file_location(
-        "certified", os.path.join(kdir, "certified.py"))
-    certified = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(certified)
-    kernels = [os.path.join(kdir, f)
-               for f in certified.KERNEL_SOURCE_FILES]
     try:
+        spec = importlib.util.spec_from_file_location(
+            "certified", os.path.join(kdir, "certified.py"))
+        certified = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(certified)
+        kernels = [os.path.join(kdir, f)
+                   for f in certified.KERNEL_SOURCE_FILES]
         return os.path.getmtime(marker) > max(os.path.getmtime(k)
                                               for k in kernels)
-    except OSError:
+    except Exception:  # noqa: BLE001 - a broken/missing gate source means
+        # "not certified", never a bench crash before rung selection
         return False
 
 

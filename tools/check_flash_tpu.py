@@ -57,8 +57,11 @@ def _save_cache(sig: str, passed: set):
     os.replace(tmp, _CACHE)
 
 
-_SIG = _src_sig()
-_PASSED = _load_cache(_SIG)
+# bound in __main__ AFTER the device is known: the cache signature folds in
+# device_kind so a cache filled on one chip can never let the marker be
+# rewritten for a different chip without re-running a single check
+_SIG = None
+_PASSED = set()
 
 
 def _cached(key: str, fn):
@@ -143,6 +146,9 @@ if __name__ == "__main__":
     if os.path.exists(_marker):
         os.remove(_marker)
     assert jax.devices()[0].platform in ("tpu", "axon"), jax.devices()
+    _SIG = (_src_sig() + ":"
+            + str(getattr(jax.devices()[0], "device_kind", "?")))
+    _PASSED = _load_cache(_SIG)
     if _PASSED:
         print(f"resuming: {len(_PASSED)} checks cached (sig {_SIG})",
               flush=True)

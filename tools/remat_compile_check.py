@@ -74,10 +74,64 @@ def _child(variant: str):
                       "platform": dev.platform, **mem}))
 
 
+def _src_sig() -> str:
+    """Hash of the sources whose compile behavior this check measures —
+    a recorded verdict must not outlive an edit to the code it compiled."""
+    import hashlib
+
+    srcs = [os.path.join(REPO, "paddle_tpu", "text", "gpt.py"),
+            os.path.join(REPO, "paddle_tpu", "text", "gpt_hybrid.py"),
+            os.path.join(REPO, "paddle_tpu", "ops", "remat_policies.py"),
+            os.path.join(REPO, "paddle_tpu", "ops", "flash_attention.py"),
+            os.path.join(REPO, "paddle_tpu", "ops", "attention.py"),
+            os.path.abspath(__file__)]
+    h = hashlib.sha256()
+    for p in srcs:
+        try:
+            with open(p, "rb") as f:
+                h.update(f.read())
+        except OSError:
+            h.update(b"missing:" + p.encode())
+    return h.hexdigest()[:16]
+
+
+def _resolved(r) -> bool:
+    """A variant record that answers the question: a successful on-device
+    compile, or a timeout CONFIRMED as the verdict (not a tunnel wedge)."""
+    return isinstance(r, dict) and ("error" not in r
+                                    or r.get("verdict_timeout"))
+
+
 def main():
     timeout = float(os.environ.get("REMAT_CHECK_TIMEOUT", "900"))
-    results = {"started": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    sig = _src_sig()
+    results = {"started": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+               "src_sig": sig}
+    # resume across healthy-tunnel windows: a variant whose record already
+    # answers the question (under the SAME sources) is kept; unresolved
+    # ones are retried (REMAT_CHECK_FRESH=1 forces a full rerun)
+    prev_timeouts = {}
+    if os.environ.get("REMAT_CHECK_FRESH", "") != "1":
+        try:
+            with open(OUT) as f:
+                prev = json.load(f)
+            if prev.get("src_sig") == sig:
+                for name in VARIANTS:
+                    r = prev.get(name)
+                    if _resolved(r) and (r.get("platform") in ("tpu", "axon")
+                                         or r.get("verdict_timeout")):
+                        results[name] = r
+                    elif isinstance(r, dict):
+                        prev_timeouts[name] = r.get("timeout_count", 0)
+        except Exception:  # noqa: BLE001 - absent/torn file = fresh run
+            pass
+    live_names = []
     for name, spec in VARIANTS.items():
+        if name in results:
+            print(f"[remat_check] {name}: cached {results[name]}",
+                  file=sys.stderr, flush=True)
+            continue
+        live_names.append(name)
         env = dict(os.environ, **spec["env"])
         print(f"[remat_check] {name}: compiling (timeout {timeout:.0f}s)",
               file=sys.stderr, flush=True)
@@ -92,12 +146,33 @@ def main():
                 results[name] = {"error": f"rc={out.returncode}: "
                                           f"{out.stderr.strip()[-300:]}"}
         except subprocess.TimeoutExpired:
-            results[name] = {"error": f"compile timeout after {timeout:.0f}s"}
+            results[name] = {"error": f"compile timeout after {timeout:.0f}s",
+                             "timeout_count": prev_timeouts.get(name, 0) + 1}
         print(f"[remat_check] {name}: {results[name]}", file=sys.stderr,
               flush=True)
         with open(OUT, "w") as f:
             json.dump(results, f, indent=2)
+    # Disambiguate "the compile genuinely exceeds the budget" (the likely
+    # TRUE answer for the default-barrier 'cse' variant — round-3 observed
+    # >15 min hangs) from "the tunnel wedged mid-window": a timeout is
+    # CONFIRMED as the verdict when another variant compiled fine in the
+    # same run (tunnel provably healthy), or when two independent
+    # probe-gated windows both timed out.  Unconfirmed timeouts exit
+    # nonzero so the watchdog retries ONLY those in a later window.
+    # only a variant compiled live in THIS run proves the tunnel was
+    # healthy now; resumed records prove a PREVIOUS window was
+    healthy_evidence = any("error" not in results[n] for n in live_names
+                           if n in results)
+    for n in VARIANTS:
+        r = results.get(n)
+        if (isinstance(r, dict) and "timeout" in str(r.get("error", ""))
+                and (healthy_evidence or r.get("timeout_count", 0) >= 2)):
+            r["verdict_timeout"] = True
+    with open(OUT, "w") as f:
+        json.dump(results, f, indent=2)
     print(json.dumps(results))
+    if not all(_resolved(results.get(n)) for n in VARIANTS):
+        raise SystemExit(1)
 
 
 if __name__ == "__main__":
