@@ -24,6 +24,8 @@ class HybridConfig:
     pp_degree: int = 1
     sharding_degree: int = 1
     sp_degree: int = 1  # sequence/context parallel — beyond-reference axis
+    # (the zigzag causal load-balancing LAYOUT is a model-level choice, not
+    # a mesh degree: see build_gpt_train_step(sp_zigzag=True))
 
 
 @dataclass

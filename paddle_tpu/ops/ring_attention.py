@@ -17,8 +17,9 @@ O(T_local * T_local) per step (and the step loop is rematerialized).
 Causality uses GLOBAL positions: chunk c holds rows [c*Tl, (c+1)*Tl);
 diagonal pairs get a triangular mask, off-diagonal pairs an all-or-nothing
 one.  Note every ring step still computes its block einsum even when fully
-masked — causal runs carry ~2x the minimal FLOPs (no zigzag load-balancing
-yet); masked scores only zero out through the where.
+masked — causal runs carry ~2x the minimal FLOPs; masked scores only zero
+out through the where.  For balanced causal work use
+:func:`ring_attention_zigzag` below (2x less per-device compute).
 
 Differentiable by construction (scan + ppermute both have transposes), so it
 composes with jax.grad/pipeline/TP with no custom VJP.
@@ -45,6 +46,16 @@ def _chunk_attend(q, k, v, scale, mask=None):
     l = jnp.sum(p, axis=-1)                      # [B,H,Tq]
     acc = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
     return m, l, acc
+
+
+def _merge(m_acc, l_acc, o_acc, m_new, l_new, acc_new):
+    """Online-softmax merge of one blockwise partial into the running
+    (max, denominator, accumulator) state."""
+    m_next = jnp.maximum(m_acc, m_new)
+    a_old = jnp.exp(m_acc - m_next)
+    a_new = jnp.exp(m_new - m_next)
+    return (m_next, l_acc * a_old + l_new * a_new,
+            o_acc * a_old[..., None] + acc_new * a_new[..., None])
 
 
 def ring_attention(q, k, v, axis: str, causal: bool = True, scale=None):
@@ -75,11 +86,8 @@ def ring_attention(q, k, v, axis: str, causal: bool = True, scale=None):
             mask = None
         m_new, l_new, acc_new = _chunk_attend(q, k_cur, v_cur, scale, mask)
         # online-softmax merge of the partial result into the running state
-        m_next = jnp.maximum(m_acc, m_new)
-        a_old = jnp.exp(m_acc - m_next)
-        a_new = jnp.exp(m_new - m_next)
-        l_next = l_acc * a_old + l_new * a_new
-        o_next = o_acc * a_old[..., None] + acc_new * a_new[..., None]
+        m_next, l_next, o_next = _merge(m_acc, l_acc, o_acc,
+                                        m_new, l_new, acc_new)
         k_nxt = lax.ppermute(k_cur, axis, perm)
         v_nxt = lax.ppermute(v_cur, axis, perm)
         return (k_nxt, v_nxt, m_next, l_next, o_next), None
@@ -93,3 +101,118 @@ def ring_attention(q, k, v, axis: str, causal: bool = True, scale=None):
     l_safe = jnp.where(l_f == 0.0, 1.0, l_f)
     out = (o_f / l_safe[..., None]).astype(q.dtype)   # [B,H,Tl,D]
     return jnp.swapaxes(out, 1, 2)                    # [B,Tl,H,D]
+
+
+# ---------------------------------------------------------------------------
+# zigzag layout: causal load balancing
+# ---------------------------------------------------------------------------
+# With the contiguous layout above, causality wastes ~half the ring's
+# compute: at every step roughly half the devices hold a fully-masked
+# (q-chunk, kv-chunk) pair, but the ring is lockstep, so they wait on the
+# devices that do have work.  The zigzag layout (as popularized by
+# Megatron-LM context parallelism / llama3 training) splits the sequence
+# into 2R chunks and gives rank i the PAIR (i, 2R-1-i).  Then at every ring
+# step each rank has exactly two unmasked blocks to compute — the high
+# chunk 2R-1-i attends every kv chunk it meets, and exactly one of
+# {low-vs-low, high-vs-high} is live depending on the ring direction — so
+# causal compute is T^2/(2R) scores per device: perfect 1/R scaling, 2x
+# better than the contiguous layout's worst-case T^2/R.
+
+
+def zigzag_permutation(T: int, R: int):
+    """Global row order placing chunk pair (i, 2R-1-i) on rank i.
+
+    Returns int32 index array ``perm`` with ``x_zig = x[perm]``; chunks are
+    T/(2R) rows each.  Apply to tokens AND anything position-aligned
+    (labels, position ids) BEFORE sharding the sequence dim over the ring
+    axis; invert with :func:`zigzag_inverse`."""
+    import numpy as np
+
+    if T % (2 * R):
+        raise ValueError(f"zigzag needs seq len divisible by 2R "
+                         f"(T={T}, R={R})")
+    Tc = T // (2 * R)
+    idx = []
+    for i in range(R):
+        idx.extend(range(i * Tc, (i + 1) * Tc))            # low chunk i
+        idx.extend(range((2 * R - 1 - i) * Tc,
+                         (2 * R - i) * Tc))                # high chunk
+    return np.asarray(idx, np.int32)
+
+
+def zigzag_inverse(T: int, R: int):
+    """Inverse permutation: ``x == x_zig[zigzag_inverse(T, R)]``."""
+    import numpy as np
+
+    perm = zigzag_permutation(T, R)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(T, dtype=np.int32)
+    return inv
+
+
+def ring_attention_zigzag(q, k, v, axis: str, scale=None):
+    """Causal ring attention over ``axis`` in the zigzag layout.
+
+    q,k,v: LOCAL [B, 2*Tc, H, D] — rows [:Tc] are global chunk ``i`` (the
+    rank index), rows [Tc:] global chunk ``2R-1-i``, i.e. the input
+    sequence was reordered with :func:`zigzag_permutation` before sharding.
+    Returns the local output in the same layout (undo at the end with
+    :func:`zigzag_inverse`).  Causal only — zigzag exists to balance the
+    causal mask; use :func:`ring_attention` for the non-causal case.
+    """
+    B, T2, H, D = q.shape
+    if T2 % 2:
+        raise ValueError("zigzag local chunk must hold an even row count")
+    Tc = T2 // 2
+    scale = scale if scale is not None else 1.0 / (D**0.5)
+    R = lax.axis_size(axis)
+    my = lax.axis_index(axis)
+    perm = [(i, (i + 1) % R) for i in range(R)]
+
+    qa, qb = q[:, :Tc], q[:, Tc:]      # global chunks my, 2R-1-my
+    rows = jnp.arange(Tc)
+    tril = (rows[:, None] >= rows[None, :])[None, None]  # within-chunk diag
+
+    def split(kv):
+        return kv[:, :Tc], kv[:, Tc:]
+
+    # step 0 (j == my): qa sees its own diagonal; qb sees ka fully
+    # (2R-1-my > my for every rank) plus its own diagonal
+    ka, kb = split(k)
+    va, vb = split(v)
+    st_a = _chunk_attend(qa, ka, va, scale, tril)
+    st_b = _merge(*_chunk_attend(qb, ka, va, scale),
+                  *_chunk_attend(qb, kb, vb, scale, tril))
+
+    def step(carry, r):
+        k_cur, v_cur, st_a, st_b = carry
+        k_cur = lax.ppermute(k_cur, axis, perm)
+        v_cur = lax.ppermute(v_cur, axis, perm)
+        j = (my - r) % R                   # rank whose kv we now hold
+        ka, kb = split(k_cur)
+        va, vb = split(v_cur)
+        # always live: high q-chunk vs low kv-chunk (2R-1-my >= R > j)
+        st_b2 = _merge(*st_b, *_chunk_attend(qb, ka, va, scale))
+        # exactly one of the remaining pairs is causally live:
+        #   j < my:  low-vs-low  (my > j)       — update st_a
+        #   j > my:  high-vs-high (2R-1-my > 2R-1-j) — update st_b
+        st_a2, st_b2 = lax.cond(
+            j < my,
+            lambda sa, sb: (_merge(*sa, *_chunk_attend(qa, ka, va, scale)),
+                            sb),
+            lambda sa, sb: (sa,
+                            _merge(*sb, *_chunk_attend(qb, kb, vb, scale))),
+            st_a, st_b2)
+        return (k_cur, v_cur, st_a2, st_b2), None
+
+    body = jax.checkpoint(step)
+    (k_f, v_f, st_a, st_b), _ = lax.scan(
+        body, (k, v, st_a, st_b), jnp.arange(1, R))
+
+    def finish(st):
+        m_f, l_f, o_f = st
+        l_safe = jnp.where(l_f == 0.0, 1.0, l_f)
+        out = (o_f / l_safe[..., None]).astype(q.dtype)  # [B,H,Tc,D]
+        return jnp.swapaxes(out, 1, 2)                   # [B,Tc,H,D]
+
+    return jnp.concatenate([finish(st_a), finish(st_b)], axis=1)

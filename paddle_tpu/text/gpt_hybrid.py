@@ -38,7 +38,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from ..distributed import megatron as mt
-from ..ops.ring_attention import ring_attention
+from ..ops.ring_attention import ring_attention, ring_attention_zigzag
 from . import gpt
 
 
@@ -50,7 +50,7 @@ _dropout = gpt._dropout
 
 
 def mp_block(x, p, cfg: gpt.GPTConfig, mp_axis: str | None, mp_size: int,
-             key=None, sp_axis: str | None = None,
+             key=None, sp_axis: str | None = None, sp_zigzag: bool = False,
              ep_axis: str | None = None, ep_size: int = 1):
     """One transformer block on [B, T, D]; weight leaves are LOCAL mp shards.
 
@@ -72,7 +72,11 @@ def mp_block(x, p, cfg: gpt.GPTConfig, mp_axis: str | None, mp_size: int,
     q = qkv[0].reshape(B, T, H, hd)
     k = qkv[1].reshape(B, T, H, hd)
     v = qkv[2].reshape(B, T, H, hd)
-    if sp_axis is not None:
+    if sp_axis is not None and sp_zigzag:
+        # zigzag layout: rows are the global chunk pair (rank, 2R-1-rank),
+        # balancing causal ring work (ops/ring_attention.py)
+        attn = ring_attention_zigzag(q, k, v, sp_axis).reshape(B, T, H * hd)
+    elif sp_axis is not None:
         attn = ring_attention(q, k, v, sp_axis, causal=True).reshape(B, T, H * hd)
     else:
         attn = gpt.attention_array(q, k, v, is_causal=True).reshape(B, T, H * hd)
@@ -120,10 +124,12 @@ class _Parts(NamedTuple):
     dt: Any
     embed: Callable
     stage: Callable
+    seq_chunk: Callable
+    seq_pos: Callable
 
 
 def _pipeline_parts(cfg: gpt.GPTConfig, mesh: Mesh, dp_axis, pp_axis, mp_axis,
-                    sp_axis, ep_axis="ep") -> _Parts:
+                    sp_axis, ep_axis="ep", sp_zigzag: bool = False) -> _Parts:
     S = mesh.shape.get(pp_axis, 1)
     mp_size = mesh.shape.get(mp_axis, 1)
     sp_size = mesh.shape.get(sp_axis, 1)
@@ -135,11 +141,47 @@ def _pipeline_parts(cfg: gpt.GPTConfig, mesh: Mesh, dp_axis, pp_axis, mp_axis,
     vps = cfg.vocab_size // mp_size
     dt = cfg.dtype
 
-    def embed(params, tok, pos0):
-        # tok [..., Tl] (local chunk); pos0 = global offset of the chunk
+    zig = bool(sp_zigzag) and sp_ax is not None
+
+    def embed(params, tok, positions):
+        # tok [..., Tl] (local chunk); positions [Tl] = the GLOBAL position
+        # id of each local row (contiguous or zigzag — see seq_pos)
         x = mt.vocab_parallel_embedding(params["wte"], tok, mp_ax, vps)
-        wpe = lax.dynamic_slice_in_dim(params["wpe"], pos0, tok.shape[-1])
+        wpe = jnp.take(params["wpe"], positions, axis=0)
         return (x + wpe).astype(dt)
+
+    def _rank():
+        return lax.axis_index(sp_axis) if sp_ax else 0
+
+    def seq_chunk(mb, Tl, shift=0):
+        """This rank's local sequence rows from the replicated [..., T].
+
+        Contiguous layout: rows [rank*Tl, (rank+1)*Tl).  Zigzag layout
+        (ops/ring_attention.py): the chunk PAIR (rank, 2R-1-rank) of length
+        Tl/2 each — causal ring-attention work is then balanced across the
+        sp ring.  ``shift`` selects the target slice (inputs vs labels)."""
+        if zig:
+            if Tl % 2:
+                raise ValueError(
+                    f"zigzag needs an even local sequence chunk (Tl={Tl}: "
+                    f"T-1 must divide by 2*sp)")
+            R, Tc = sp_size, Tl // 2
+            lo = lax.dynamic_slice_in_dim(mb, _rank() * Tc + shift, Tc,
+                                          axis=-1)
+            hi = lax.dynamic_slice_in_dim(
+                mb, (2 * R - 1 - _rank()) * Tc + shift, Tc, axis=-1)
+            return jnp.concatenate([lo, hi], axis=-1)
+        return lax.dynamic_slice_in_dim(mb, _rank() * Tl + shift, Tl,
+                                        axis=-1)
+
+    def seq_pos(Tl):
+        """Global position ids [Tl] of this rank's local rows."""
+        if zig:
+            R, Tc = sp_size, Tl // 2
+            return jnp.concatenate(
+                [_rank() * Tc + jnp.arange(Tc),
+                 (2 * R - 1 - _rank()) * Tc + jnp.arange(Tc)])
+        return _rank() * Tl + jnp.arange(Tl)
 
     def stage(blocks, x, key):
         """Run this stage's blocks; returns (x, aux) — the summed MoE
@@ -154,6 +196,7 @@ def _pipeline_parts(cfg: gpt.GPTConfig, mesh: Mesh, dp_axis, pp_axis, mp_axis,
         layer_keys = jax.random.split(key, n_local)
         body = functools.partial(mp_block, cfg=cfg, mp_axis=mp_ax,
                                  mp_size=mp_size, sp_axis=sp_ax,
+                                 sp_zigzag=zig,
                                  ep_axis=ep_ax, ep_size=ep_size)
         if cfg.remat:
             # prevent_cse=False: scan supplies the CSE protection; the
@@ -171,12 +214,13 @@ def _pipeline_parts(cfg: gpt.GPTConfig, mesh: Mesh, dp_axis, pp_axis, mp_axis,
     return _Parts(S, mp_size, sp_size, ep_size, mp_ax, sp_ax, dp_ax, ep_ax,
                   vps,
                   [(i, (i + 1) % S) for i in range(S)],
-                  [(i, (i - 1) % S) for i in range(S)], dt, embed, stage)
+                  [(i, (i - 1) % S) for i in range(S)], dt, embed, stage,
+                  seq_chunk, seq_pos)
 
 
 def make_pipeline_gpt_loss(cfg: gpt.GPTConfig, mesh: Mesh, n_micro: int,
                            dp_axis="dp", pp_axis="pp", mp_axis="mp",
-                           sp_axis="sp"):
+                           sp_axis="sp", sp_zigzag: bool = False):
     """Full-mesh SPMD loss fn (runs per-device inside shard_map).
 
     tokens: LOCAL [B_local, T] int32 (dp-sharded by in_specs; the sequence
@@ -189,11 +233,13 @@ def make_pipeline_gpt_loss(cfg: gpt.GPTConfig, mesh: Mesh, n_micro: int,
     for every tick — use :func:`make_pipeline_1f1b_grads` for the
     memory-bounded interleaved schedule.
     """
-    parts = _pipeline_parts(cfg, mesh, dp_axis, pp_axis, mp_axis, sp_axis)
+    parts = _pipeline_parts(cfg, mesh, dp_axis, pp_axis, mp_axis, sp_axis,
+                            sp_zigzag=sp_zigzag)
     S, mp_ax, sp_ax, dp_ax = parts.S, parts.mp_ax, parts.sp_ax, parts.dp_ax
     sp_size, vps, dt = parts.sp_size, parts.vps, parts.dt
     perm = parts.perm_fwd
     embed, stage = parts.embed, parts.stage
+    seq_chunk, seq_pos = parts.seq_chunk, parts.seq_pos
 
     def loss_fn(params, tokens, key):
         s = lax.axis_index(pp_axis) if S > 1 else 0
@@ -206,17 +252,16 @@ def make_pipeline_gpt_loss(cfg: gpt.GPTConfig, mesh: Mesh, n_micro: int,
             raise ValueError(
                 f"sequence length {T - 1} must divide by sp {sp_size}")
         Tl = (T - 1) // sp_size
-        sp_rank = lax.axis_index(sp_axis) if sp_ax else 0
-        pos0 = sp_rank * Tl
         mb = tokens.reshape(M, B // M, T)
         # local sequence chunk of inputs/targets (full tokens stay replicated
-        # over sp; the shifted slices are taken per-rank)
-        tok_in = lax.dynamic_slice_in_dim(mb, pos0, Tl, axis=2)
-        tok_tgt = lax.dynamic_slice_in_dim(mb, pos0 + 1, Tl, axis=2)
+        # over sp; the shifted slices are taken per-rank, contiguous or
+        # zigzag per parts.seq_chunk)
+        tok_in = seq_chunk(mb, Tl, 0)
+        tok_tgt = seq_chunk(mb, Tl, 1)
         ticks = M + S - 1
         keys = jax.random.split(key, ticks)
         # all micro-batch embeddings up-front, one batched lookup ([M, b, Tl, D])
-        x_emb = embed(params, tok_in, pos0)
+        x_emb = embed(params, tok_in, seq_pos(Tl))
 
         def tick(carry, inp):
             x_recv, aux_acc = carry
@@ -285,7 +330,7 @@ def _spec_axes(spec) -> set:
 
 def make_pipeline_1f1b_grads(cfg: gpt.GPTConfig, mesh: Mesh, n_micro: int,
                              dp_axis="dp", pp_axis="pp", mp_axis="mp",
-                             sp_axis="sp"):
+                             sp_axis="sp", sp_zigzag: bool = False):
     """(params, tokens, key) -> (loss, grads) per-rank fn for shard_map.
 
     The 1F1B-class schedule (reference SectionWorker schedule_mode=1,
@@ -305,11 +350,13 @@ def make_pipeline_1f1b_grads(cfg: gpt.GPTConfig, mesh: Mesh, n_micro: int,
     reference's allreduce_shared_weight_gradients, pp_layers.py:188 — and mp
     for replicated norms/biases), pmean over the data axes (dp, sp).
     """
-    parts = _pipeline_parts(cfg, mesh, dp_axis, pp_axis, mp_axis, sp_axis)
+    parts = _pipeline_parts(cfg, mesh, dp_axis, pp_axis, mp_axis, sp_axis,
+                            sp_zigzag=sp_zigzag)
     S, mp_ax, sp_ax, dp_ax = parts.S, parts.mp_ax, parts.sp_ax, parts.dp_ax
     sp_size, vps, dt = parts.sp_size, parts.vps, parts.dt
     ep_ax, ep_size = parts.ep_ax, parts.ep_size
     embed, stage = parts.embed, parts.stage
+    seq_chunk, seq_pos = parts.seq_chunk, parts.seq_pos
     if S < 2:
         raise ValueError("1F1B schedule needs pp >= 2; use the GSPMD path")
 
@@ -354,15 +401,14 @@ def make_pipeline_1f1b_grads(cfg: gpt.GPTConfig, mesh: Mesh, n_micro: int,
                 f"sequence length {T - 1} must divide by sp {sp_size}")
         b = B // M
         Tl = (T - 1) // sp_size
-        sp_rank = lax.axis_index(sp_axis) if sp_ax else 0
-        pos0 = sp_rank * Tl
+        pos = seq_pos(Tl)
         mb = tokens.reshape(M, b, T)
-        tok_in = lax.dynamic_slice_in_dim(mb, pos0, Tl, axis=2)
-        tok_tgt = lax.dynamic_slice_in_dim(mb, pos0 + 1, Tl, axis=2)
+        tok_in = seq_chunk(mb, Tl, 0)
+        tok_tgt = seq_chunk(mb, Tl, 1)
         D = cfg.hidden_size
 
         def fwd_only(p, x_in, tok_mb, k):
-            x0 = jnp.where(s == 0, embed(p, tok_mb, pos0), x_in)
+            x0 = jnp.where(s == 0, embed(p, tok_mb, pos), x_in)
             y, _aux = stage(p["blocks"], x0, k)
             return y
 
@@ -373,7 +419,7 @@ def make_pipeline_1f1b_grads(cfg: gpt.GPTConfig, mesh: Mesh, n_micro: int,
             executes it (the cost of a uniform program).  The stage's own
             MoE aux loss joins unmasked — every stage owns its layers'
             router gradients."""
-            x0 = jnp.where(s == 0, embed(p, tok_mb, pos0), x_in)
+            x0 = jnp.where(s == 0, embed(p, tok_mb, pos), x_in)
             y, aux = stage(p["blocks"], x0, k)
             x = gpt._layer_norm(y.astype(jnp.float32), p["ln_f_g"],
                                 p["ln_f_b"]).astype(dt)
@@ -469,7 +515,7 @@ def _spec_leaf(x):
 def build_gpt_train_step(cfg: gpt.GPTConfig, mesh: Mesh, optimizer,
                          n_micro: int = 1, zero: bool | int = False,
                          donate: bool = True, schedule: str = "1f1b",
-                         accum: int = 1):
+                         accum: int = 1, sp_zigzag: bool = False):
     """Compile one hybrid-parallel GPT train step over ``mesh``.
 
     ``schedule`` selects the pipeline schedule when pp > 1: "1f1b"
@@ -545,14 +591,16 @@ def build_gpt_train_step(cfg: gpt.GPTConfig, mesh: Mesh, optimizer,
     value_and_grad_fn = None
     if pp > 1 and schedule == "1f1b":
         # interleaved 1F1B with manual per-stage VJP (memory-bounded)
-        vg_raw = make_pipeline_1f1b_grads(cfg, mesh, n_micro)
+        vg_raw = make_pipeline_1f1b_grads(cfg, mesh, n_micro,
+                                          sp_zigzag=sp_zigzag)
         value_and_grad_fn = shard_map(
             vg_raw, mesh=mesh, in_specs=(specs, tok_spec, P()),
             out_specs=(P(), specs), check_vma=False)
         loss_fn = None
     elif pp > 1 or sp > 1:
         # manual-collective path: pipeline schedule and/or ring attention
-        loss_raw = make_pipeline_gpt_loss(cfg, mesh, n_micro)
+        loss_raw = make_pipeline_gpt_loss(cfg, mesh, n_micro,
+                                          sp_zigzag=sp_zigzag)
         loss_fn = shard_map(loss_raw, mesh=mesh,
                             in_specs=(specs, tok_spec, P()), out_specs=P(),
                             check_vma=False)

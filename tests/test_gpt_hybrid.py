@@ -264,6 +264,73 @@ class TestRingAttention:
         for name, a, b in zip("dq dk dv".split(), g_got, g_want):
             np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5, err_msg=name)
 
+    def test_zigzag_matches_dense_causal(self):
+        """Zigzag layout (rank i holds chunks i and 2R-1-i): permute →
+        ring → unpermute must equal dense causal attention."""
+        from paddle_tpu.ops.ring_attention import (
+            ring_attention_zigzag, zigzag_inverse, zigzag_permutation)
+        from paddle_tpu.ops.attention import xla_attention
+
+        for R, T in ((8, 64), (4, 32)):
+            mesh = mesh_of((R,), ("sp",))
+            B, H, D = 2, 2, 16
+            ks = jax.random.split(jax.random.PRNGKey(0), 3)
+            q, k, v = (jax.random.normal(kk, (B, T, H, D)) for kk in ks)
+            perm, inv = zigzag_permutation(T, R), zigzag_inverse(T, R)
+
+            f = shard_map(
+                lambda a, b, c: ring_attention_zigzag(a, b, c, "sp"),
+                mesh=mesh, in_specs=(P(None, "sp"),) * 3,
+                out_specs=P(None, "sp"), check_vma=False)
+            got = jax.jit(f)(q[:, perm], k[:, perm], v[:, perm])[:, inv]
+            want = xla_attention(q, k, v, is_causal=True)
+            np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5,
+                                       err_msg=f"R={R}")
+
+    def test_zigzag_grads_match_dense(self):
+        from paddle_tpu.ops.ring_attention import (
+            ring_attention_zigzag, zigzag_inverse, zigzag_permutation)
+        from paddle_tpu.ops.attention import xla_attention
+
+        mesh = mesh_of((4,), ("sp",))
+        B, T, H, D = 1, 32, 2, 8
+        ks = jax.random.split(jax.random.PRNGKey(1), 3)
+        q, k, v = (jax.random.normal(kk, (B, T, H, D)) for kk in ks)
+        perm, inv = zigzag_permutation(T, 4), zigzag_inverse(T, 4)
+
+        def ring_loss(q, k, v):
+            f = shard_map(
+                lambda a, b, c: ring_attention_zigzag(a, b, c, "sp"),
+                mesh=mesh, in_specs=(P(None, "sp"),) * 3,
+                out_specs=P(None, "sp"), check_vma=False)
+            out = f(q[:, perm], k[:, perm], v[:, perm])[:, inv]
+            return jnp.sum(out ** 2)
+
+        def dense_loss(q, k, v):
+            return jnp.sum(xla_attention(q, k, v, is_causal=True) ** 2)
+
+        g_got = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+        g_want = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("dq dk dv".split(), g_got, g_want):
+            np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5,
+                                       err_msg=name)
+
+    def test_zigzag_permutation_roundtrip(self):
+        from paddle_tpu.ops.ring_attention import (zigzag_inverse,
+                                                   zigzag_permutation)
+
+        T, R = 48, 4
+        perm, inv = zigzag_permutation(T, R), zigzag_inverse(T, R)
+        x = np.arange(T)
+        np.testing.assert_array_equal(x[perm][inv], x)
+        # rank 0's local rows are global chunks 0 and 2R-1
+        Tc = T // (2 * R)
+        np.testing.assert_array_equal(perm[:Tc], np.arange(Tc))
+        np.testing.assert_array_equal(
+            perm[Tc:2 * Tc], np.arange((2 * R - 1) * Tc, 2 * R * Tc))
+        with pytest.raises(ValueError):
+            zigzag_permutation(50, 4)  # not divisible by 2R
+
     def test_sp_hybrid_loss_matches_dense(self):
         """dp×sp×mp shard_map (ring attention + Megatron) == dense loss."""
         mesh = mesh_of((2, 2, 2), ("dp", "sp", "mp"))
@@ -276,6 +343,37 @@ class TestRingAttention:
         got = jax.jit(f)(params, toks, jax.random.PRNGKey(0))
         want = gpt.loss_fn(params, toks, CFG)
         np.testing.assert_allclose(got, want, rtol=2e-5)
+
+    def test_sp_zigzag_loss_matches_dense(self):
+        """Zigzag sp layout through the FULL hybrid loss (embedding
+        positions, ring attention, CE) == dense loss: CE's positionwise
+        mean is permutation-invariant, so the numbers must agree."""
+        mesh = mesh_of((2, 2, 2), ("dp", "sp", "mp"))
+        params = _replicated_params(CFG)
+        toks = _tokens(CFG)
+        loss_raw = gpt_hybrid.make_pipeline_gpt_loss(CFG, mesh, n_micro=1,
+                                                     sp_zigzag=True)
+        specs = gpt.param_shardings(CFG, mp="mp", pp=None)
+        f = shard_map(loss_raw, mesh=mesh, in_specs=(specs, P("dp"), P()),
+                      out_specs=P(), check_vma=False)
+        got = jax.jit(f)(params, toks, jax.random.PRNGKey(0))
+        want = gpt.loss_fn(params, toks, CFG)
+        np.testing.assert_allclose(got, want, rtol=2e-5)
+
+    def test_sp_zigzag_1f1b_training(self):
+        """Zigzag sp composed with the interleaved-1F1B pipeline trains."""
+        mesh = mesh_of((2, 2, 2), ("pp", "sp", "mp"))
+        opt = AdamW(learning_rate=1e-3)
+        init_fn, step_fn, _ = gpt_hybrid.build_gpt_train_step(
+            CFG, mesh, opt, n_micro=2, sp_zigzag=True)
+        state = init_fn(0)
+        toks = _tokens(CFG)
+        key = jax.random.PRNGKey(0)
+        losses = []
+        for _ in range(8):
+            state, loss = step_fn(state, toks, key, 1e-3)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0], losses
 
     def test_sp_pp_mp_training(self):
         """All four axes at once: dp=1, pp=2, sp=2, mp=2 training decreases."""

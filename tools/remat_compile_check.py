@@ -97,9 +97,11 @@ def _src_sig() -> str:
 
 def _resolved(r) -> bool:
     """A variant record that answers the question: a successful on-device
-    compile, or a timeout CONFIRMED as the verdict (not a tunnel wedge)."""
+    compile, or a failure CONFIRMED as the verdict (a genuine compile hang
+    or deterministic compile error — not a tunnel wedge)."""
     return isinstance(r, dict) and ("error" not in r
-                                    or r.get("verdict_timeout"))
+                                    or r.get("verdict_timeout")
+                                    or r.get("verdict_error"))
 
 
 def main():
@@ -119,10 +121,12 @@ def main():
                 for name in VARIANTS:
                     r = prev.get(name)
                     if _resolved(r) and (r.get("platform") in ("tpu", "axon")
-                                         or r.get("verdict_timeout")):
+                                         or r.get("verdict_timeout")
+                                         or r.get("verdict_error")):
                         results[name] = r
                     elif isinstance(r, dict):
-                        prev_timeouts[name] = r.get("timeout_count", 0)
+                        prev_timeouts[name] = max(r.get("timeout_count", 0),
+                                                  r.get("fail_count", 0))
         except Exception:  # noqa: BLE001 - absent/torn file = fresh run
             pass
     live_names = []
@@ -144,7 +148,9 @@ def main():
                 results[name] = json.loads(out.stdout.strip().splitlines()[-1])
             else:
                 results[name] = {"error": f"rc={out.returncode}: "
-                                          f"{out.stderr.strip()[-300:]}"}
+                                          f"{out.stderr.strip()[-300:]}",
+                                 "fail_count":
+                                     prev_timeouts.get(name, 0) + 1}
         except subprocess.TimeoutExpired:
             results[name] = {"error": f"compile timeout after {timeout:.0f}s",
                              "timeout_count": prev_timeouts.get(name, 0) + 1}
@@ -165,9 +171,18 @@ def main():
                            if n in results)
     for n in VARIANTS:
         r = results.get(n)
-        if (isinstance(r, dict) and "timeout" in str(r.get("error", ""))
+        if not isinstance(r, dict) or "error" not in r:
+            continue
+        if ("timeout" in str(r.get("error", ""))
                 and (healthy_evidence or r.get("timeout_count", 0) >= 2)):
             r["verdict_timeout"] = True
+        elif "timeout" not in str(r.get("error", "")) \
+                and (healthy_evidence or r.get("fail_count", 0) >= 2):
+            # a real XLA compile error with a healthy tunnel (or seen in
+            # two independent windows) is deterministic under unchanged
+            # sources — record it as the verdict instead of re-burning a
+            # window per retry
+            r["verdict_error"] = True
     with open(OUT, "w") as f:
         json.dump(results, f, indent=2)
     print(json.dumps(results))
