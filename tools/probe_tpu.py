@@ -217,10 +217,12 @@ def watch(interval: float, probe_timeout: float, max_hours: float):
     data.setdefault("windows", [])
     log(f"[watch] starting: interval={interval}s probe_timeout="
         f"{probe_timeout}s max_hours={max_hours}")
+    consecutive_fails = 0
     while time.monotonic() < deadline:
         e = probe(probe_timeout, source="watchdog")
         log(f"[watch] probe ok={e['ok']} elapsed={e['elapsed_s']}s "
             f"detail={e['detail']}")
+        consecutive_fails = 0 if e["ok"] else consecutive_fails + 1
         if e["ok"]:
             data["windows"].append({"opened": _now()})
             _save_results(data)
@@ -244,7 +246,22 @@ def watch(interval: float, probe_timeout: float, max_hours: float):
                 log("[watch] all payload steps resolved; exiting")
                 _save_results(data)
                 break
-        time.sleep(interval)
+        # Back off hard after repeated failures.  Evidence (probe log,
+        # rounds 3-4): every killed probe/compile leaves the tunnel's
+        # remote claim held, so continuous 5-min probing SUSTAINED wedges
+        # for hours (nine failed probes 15:40-19:30 round 3), while both
+        # healthy windows this round appeared after 90+ minutes of probe
+        # silence.  Quiet time is what lets the claim clear — so after 3
+        # consecutive failures, probe only every 30 minutes.
+        sleep_s = interval if consecutive_fails < 3 else max(interval, 1800)
+        if sleep_s != interval:
+            log(f"[watch] {consecutive_fails} consecutive failed probes — "
+                f"backing off to {sleep_s:.0f}s to give the tunnel quiet "
+                f"time to clear")
+        # never sleep past the max-hours deadline: overrunning it gets the
+        # process killed mid-sleep instead of exiting via the clean path
+        time.sleep(max(0.0, min(sleep_s,
+                                deadline - time.monotonic())))
     else:
         log("[watch] max duration reached; exiting")
     # exit 0 only means "the headline TPU number exists" — steps that merely

@@ -125,8 +125,12 @@ def main():
                                          or r.get("verdict_error")):
                         results[name] = r
                     elif isinstance(r, dict):
-                        prev_timeouts[name] = max(r.get("timeout_count", 0),
-                                                  r.get("fail_count", 0))
+                        # keep BOTH counters distinct: a timeout window
+                        # followed by an rc-fail window is two different
+                        # failure modes, not two confirmations of one
+                        prev_timeouts[name] = {
+                            "timeout_count": r.get("timeout_count", 0),
+                            "fail_count": r.get("fail_count", 0)}
         except Exception:  # noqa: BLE001 - absent/torn file = fresh run
             pass
     live_names = []
@@ -147,13 +151,19 @@ def main():
             if out.returncode == 0 and out.stdout.strip():
                 results[name] = json.loads(out.stdout.strip().splitlines()[-1])
             else:
+                prevc = prev_timeouts.get(name, {})
                 results[name] = {"error": f"rc={out.returncode}: "
                                           f"{out.stderr.strip()[-300:]}",
+                                 "timeout_count":
+                                     prevc.get("timeout_count", 0),
                                  "fail_count":
-                                     prev_timeouts.get(name, 0) + 1}
+                                     prevc.get("fail_count", 0) + 1}
         except subprocess.TimeoutExpired:
+            prevc = prev_timeouts.get(name, {})
             results[name] = {"error": f"compile timeout after {timeout:.0f}s",
-                             "timeout_count": prev_timeouts.get(name, 0) + 1}
+                             "timeout_count":
+                                 prevc.get("timeout_count", 0) + 1,
+                             "fail_count": prevc.get("fail_count", 0)}
         print(f"[remat_check] {name}: {results[name]}", file=sys.stderr,
               flush=True)
         with open(OUT, "w") as f:
