@@ -143,11 +143,18 @@ def _pipeline_parts(cfg: gpt.GPTConfig, mesh: Mesh, dp_axis, pp_axis, mp_axis,
 
     zig = bool(sp_zigzag) and sp_ax is not None
 
-    def embed(params, tok, positions):
-        # tok [..., Tl] (local chunk); positions [Tl] = the GLOBAL position
-        # id of each local row (contiguous or zigzag — see seq_pos)
+    def embed(params, tok, pos):
+        # tok [..., Tl] (local chunk); pos = the chunk's global offset
+        # (scalar, contiguous layout) or per-row global position ids
+        # ([Tl] array, zigzag layout) — see seq_pos
         x = mt.vocab_parallel_embedding(params["wte"], tok, mp_ax, vps)
-        wpe = jnp.take(params["wpe"], positions, axis=0)
+        if zig:
+            # ids are in-bounds by construction (max T-1 < max_seq_len);
+            # clip-mode gather skips jnp.take's negative-index wrap pass
+            wpe = jnp.take(params["wpe"], pos, axis=0, mode="clip")
+        else:
+            wpe = lax.dynamic_slice_in_dim(params["wpe"], pos,
+                                           tok.shape[-1])
         return (x + wpe).astype(dt)
 
     def _rank():
@@ -175,13 +182,15 @@ def _pipeline_parts(cfg: gpt.GPTConfig, mesh: Mesh, dp_axis, pp_axis, mp_axis,
                                         axis=-1)
 
     def seq_pos(Tl):
-        """Global position ids [Tl] of this rank's local rows."""
+        """This rank's global positions: a scalar chunk offset in the
+        contiguous layout (embed slices), per-row ids [Tl] under zigzag
+        (embed gathers)."""
         if zig:
             R, Tc = sp_size, Tl // 2
             return jnp.concatenate(
                 [_rank() * Tc + jnp.arange(Tc),
                  (2 * R - 1 - _rank()) * Tc + jnp.arange(Tc)])
-        return _rank() * Tl + jnp.arange(Tl)
+        return _rank() * Tl
 
     def stage(blocks, x, key):
         """Run this stage's blocks; returns (x, aux) — the summed MoE

@@ -237,8 +237,12 @@ def watch(interval: float, probe_timeout: float, max_hours: float):
                 data["steps"][name] = rec
                 _save_results(data)
                 if rec.get("error", "").startswith("timeout"):
+                    # the killed step itself likely re-wedged the tunnel
+                    # (its in-flight remote compile holds the claim): go
+                    # straight to slow probing rather than hammering
+                    consecutive_fails = 3
                     log("[watch] step timed out — treating the window as "
-                        "closed; back to probing")
+                        "closed; back to probing (backoff engaged)")
                     break
             if all(s.get("ok") or s.get("attempts", 0) >= 3
                    for s in data["steps"].values()) \
