@@ -34,17 +34,62 @@ from jax import lax
 _NEG = -1e30
 
 
-def _chunk_attend(q, k, v, scale, mask=None):
+def _chunk_attend(q, k, v, scale, mask=None, sub: int | None = None):
     """One blockwise partial attention: returns (scores-max m, exp-sum l,
-    weighted acc) for merging.  q [B,Tq,H,D], k/v [B,Tk,H,D]."""
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
-    if mask is not None:
-        s = jnp.where(mask, s, _NEG)
-    m = jnp.max(s, axis=-1)                      # [B,H,Tq]
-    p = jnp.exp(s - m[..., None])
-    l = jnp.sum(p, axis=-1)                      # [B,H,Tq]
-    acc = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    weighted acc) for merging.  q [B,Tq,H,D], k/v [B,Tk,H,D].
+
+    ``sub`` bounds the score temp: instead of one [B,H,Tq,Tk] block, the
+    kv rows are walked in sub-chunks of that many rows with an inner
+    online-softmax scan (the flash-attention recurrence in pure XLA), so
+    the largest live score tensor is [B,H,Tq,sub].  This is what keeps
+    per-device memory flat as the LOCAL chunk grows — the ring bounds
+    memory in the ring size R, sub-blocking bounds it in Tl."""
+    if sub is None or sub >= k.shape[1]:
+        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * scale
+        if mask is not None:
+            s = jnp.where(mask, s, _NEG)
+        m = jnp.max(s, axis=-1)                      # [B,H,Tq]
+        p = jnp.exp(s - m[..., None])
+        l = jnp.sum(p, axis=-1)                      # [B,H,Tq]
+        acc = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+        return m, l, acc
+    B, Tk, H, D = k.shape
+    if Tk % sub:
+        raise ValueError(f"sub_block {sub} must divide the kv chunk {Tk}")
+    n = Tk // sub
+    Tq = q.shape[1]
+    ks = k.reshape(B, n, sub, H, D)
+    vs = v.reshape(B, n, sub, H, D)
+    # mask [..., Tq, Tk] → per-sub-chunk column slices [n, ..., Tq, sub]
+    msub = (None if mask is None else
+            jnp.moveaxis(mask.reshape(*mask.shape[:-1], n, sub), -2, 0))
+
+    def body(carry, xs):
+        m_acc, l_acc, o_acc = carry
+        if msub is None:
+            kk, vv = xs
+            mm = None
+        else:
+            kk, vv, mm = xs
+        st = _chunk_attend(q, kk, vv, scale, mm)
+        return _merge(m_acc, l_acc, o_acc, *st), None
+
+    m0 = jnp.full((B, H, Tq), _NEG, jnp.float32)
+    l0 = jnp.zeros((B, H, Tq), jnp.float32)
+    o0 = jnp.zeros((B, H, Tq, D), jnp.float32)
+    xs = ((jnp.moveaxis(ks, 1, 0), jnp.moveaxis(vs, 1, 0))
+          if msub is None else
+          (jnp.moveaxis(ks, 1, 0), jnp.moveaxis(vs, 1, 0), msub))
+    # checkpoint the inner body too: without it the inner scan's VJP
+    # stacks per-sub-chunk score residuals back up to ~[B,H,Tq,Tk] —
+    # defeating the cap exactly where it matters (training).  Recomputing
+    # scores per sub-chunk in the backward is the flash-attention trade.
+    # prevent_cse=False: the scan structure supplies the CSE protection,
+    # and the default's optimization barriers hang the axon TPU compile
+    # (text/gpt.py, round-3 evidence).
+    (m, l, acc), _ = lax.scan(jax.checkpoint(body, prevent_cse=False),
+                              (m0, l0, o0), xs)
     return m, l, acc
 
 
@@ -58,12 +103,16 @@ def _merge(m_acc, l_acc, o_acc, m_new, l_new, acc_new):
             o_acc * a_old[..., None] + acc_new * a_new[..., None])
 
 
-def ring_attention(q, k, v, axis: str, causal: bool = True, scale=None):
+def ring_attention(q, k, v, axis: str, causal: bool = True, scale=None,
+                   sub_block: int | None = None):
     """Sequence-sharded attention inside a ``shard_map`` region.
 
     q,k,v: LOCAL chunks [B, T_local, H, D], sequence dim sharded over
     ``axis`` (ring of size R; global T = R * T_local).  Returns the local
-    output chunk [B, T_local, H, D].
+    output chunk [B, T_local, H, D].  ``sub_block`` caps the live score
+    temp at [B,H,Tl,sub_block] (see _chunk_attend) — required for long
+    local chunks, where a full [Tl,Tl] block would defeat the point of
+    the ring.
     """
     B, Tl, H, D = q.shape
     scale = scale if scale is not None else 1.0 / (D**0.5)
@@ -84,7 +133,8 @@ def ring_attention(q, k, v, axis: str, causal: bool = True, scale=None):
             mask = mask[None, None]                    # [1,1,Tq,Tk]
         else:
             mask = None
-        m_new, l_new, acc_new = _chunk_attend(q, k_cur, v_cur, scale, mask)
+        m_new, l_new, acc_new = _chunk_attend(q, k_cur, v_cur, scale, mask,
+                                              sub=sub_block)
         # online-softmax merge of the partial result into the running state
         m_next, l_next, o_next = _merge(m_acc, l_acc, o_acc,
                                         m_new, l_new, acc_new)
@@ -150,7 +200,8 @@ def zigzag_inverse(T: int, R: int):
     return inv
 
 
-def ring_attention_zigzag(q, k, v, axis: str, scale=None):
+def ring_attention_zigzag(q, k, v, axis: str, scale=None,
+                          sub_block: int | None = None):
     """Causal ring attention over ``axis`` in the zigzag layout.
 
     q,k,v: LOCAL [B, 2*Tc, H, D] — rows [:Tc] are global chunk ``i`` (the
@@ -180,9 +231,9 @@ def ring_attention_zigzag(q, k, v, axis: str, scale=None):
     # (2R-1-my > my for every rank) plus its own diagonal
     ka, kb = split(k)
     va, vb = split(v)
-    st_a = _chunk_attend(qa, ka, va, scale, tril)
-    st_b = _merge(*_chunk_attend(qb, ka, va, scale),
-                  *_chunk_attend(qb, kb, vb, scale, tril))
+    st_a = _chunk_attend(qa, ka, va, scale, tril, sub=sub_block)
+    st_b = _merge(*_chunk_attend(qb, ka, va, scale, sub=sub_block),
+                  *_chunk_attend(qb, kb, vb, scale, tril, sub=sub_block))
 
     def step(carry, r):
         k_cur, v_cur, st_a, st_b = carry
@@ -192,16 +243,19 @@ def ring_attention_zigzag(q, k, v, axis: str, scale=None):
         ka, kb = split(k_cur)
         va, vb = split(v_cur)
         # always live: high q-chunk vs low kv-chunk (2R-1-my >= R > j)
-        st_b2 = _merge(*st_b, *_chunk_attend(qb, ka, va, scale))
+        st_b2 = _merge(*st_b, *_chunk_attend(qb, ka, va, scale,
+                                             sub=sub_block))
         # exactly one of the remaining pairs is causally live:
         #   j < my:  low-vs-low  (my > j)       — update st_a
         #   j > my:  high-vs-high (2R-1-my > 2R-1-j) — update st_b
         st_a2, st_b2 = lax.cond(
             j < my,
-            lambda sa, sb: (_merge(*sa, *_chunk_attend(qa, ka, va, scale)),
+            lambda sa, sb: (_merge(*sa, *_chunk_attend(qa, ka, va, scale,
+                                                       sub=sub_block)),
                             sb),
             lambda sa, sb: (sa,
-                            _merge(*sb, *_chunk_attend(qb, kb, vb, scale))),
+                            _merge(*sb, *_chunk_attend(qb, kb, vb, scale,
+                                                       sub=sub_block))),
             st_a, st_b2)
         return (k_cur, v_cur, st_a2, st_b2), None
 

@@ -49,6 +49,11 @@ class GPTConfig:
     # "dots_no_batch" = keep only non-batch matmuls (weights-stationary)
     remat_policy: str | None = None
     use_flash: bool = True
+    # sequence-parallel ring attention: cap the live score temp at
+    # [B, H, Tl, sp_sub_block] by walking kv in sub-chunks (the flash
+    # recurrence in XLA — ops/ring_attention.py _chunk_attend).  None =
+    # whole-block scores; set for long local chunks.
+    sp_sub_block: int | None = None
     moe: Any = None  # MoEConfig → every block's FFN becomes expert-parallel
 
     @property

@@ -75,9 +75,13 @@ def mp_block(x, p, cfg: gpt.GPTConfig, mp_axis: str | None, mp_size: int,
     if sp_axis is not None and sp_zigzag:
         # zigzag layout: rows are the global chunk pair (rank, 2R-1-rank),
         # balancing causal ring work (ops/ring_attention.py)
-        attn = ring_attention_zigzag(q, k, v, sp_axis).reshape(B, T, H * hd)
+        attn = ring_attention_zigzag(
+            q, k, v, sp_axis,
+            sub_block=cfg.sp_sub_block).reshape(B, T, H * hd)
     elif sp_axis is not None:
-        attn = ring_attention(q, k, v, sp_axis, causal=True).reshape(B, T, H * hd)
+        attn = ring_attention(
+            q, k, v, sp_axis, causal=True,
+            sub_block=cfg.sp_sub_block).reshape(B, T, H * hd)
     else:
         attn = gpt.attention_array(q, k, v, is_causal=True).reshape(B, T, H * hd)
     a = mt.row_parallel_linear(attn, p["proj_w"].astype(dt),

@@ -315,6 +315,79 @@ class TestRingAttention:
             np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5,
                                        err_msg=name)
 
+    def test_sub_block_matches_whole_block(self):
+        """Flash-recurrence sub-blocking == whole-block scores, both
+        layouts, values and grads."""
+        from paddle_tpu.ops.ring_attention import (
+            ring_attention, ring_attention_zigzag, zigzag_inverse,
+            zigzag_permutation)
+
+        mesh = mesh_of((4,), ("sp",))
+        B, T, H, D = 1, 64, 2, 8
+        ks = jax.random.split(jax.random.PRNGKey(2), 3)
+        q, k, v = (jax.random.normal(kk, (B, T, H, D)) for kk in ks)
+        perm, inv = zigzag_permutation(T, 4), zigzag_inverse(T, 4)
+
+        def loss(fn, permute):
+            def f(q, k, v):
+                g = shard_map(fn, mesh=mesh, in_specs=(P(None, "sp"),) * 3,
+                              out_specs=P(None, "sp"), check_vma=False)
+                if permute:
+                    return jnp.sum(g(q[:, perm], k[:, perm],
+                                     v[:, perm])[:, inv] ** 2)
+                return jnp.sum(g(q, k, v) ** 2)
+            return f
+
+        for permute, make in (
+                (False, lambda sb: (lambda a, b, c: ring_attention(
+                    a, b, c, "sp", causal=True, sub_block=sb))),
+                (True, lambda sb: (lambda a, b, c: ring_attention_zigzag(
+                    a, b, c, "sp", sub_block=sb)))):
+            whole = loss(make(None), permute)
+            subbed = loss(make(4), permute)
+            np.testing.assert_allclose(jax.jit(whole)(q, k, v),
+                                       jax.jit(subbed)(q, k, v), rtol=2e-5)
+            g_w = jax.jit(jax.grad(whole, argnums=(0, 1, 2)))(q, k, v)
+            g_s = jax.jit(jax.grad(subbed, argnums=(0, 1, 2)))(q, k, v)
+            for name, a, b in zip("dq dk dv".split(), g_w, g_s):
+                np.testing.assert_allclose(
+                    a, b, rtol=2e-4, atol=2e-5,
+                    err_msg=f"{name} zigzag={permute}")
+        # divisibility is validated loudly
+        with pytest.raises(ValueError):
+            jax.jit(loss(make(7), True))(q, k, v)
+
+    def test_sub_block_caps_score_temp(self):
+        """The quantitative witness: compiled temp memory with sub_block
+        is strictly below whole-block at the same shapes."""
+        from paddle_tpu.ops.ring_attention import ring_attention
+
+        mesh = mesh_of((2,), ("sp",))
+        B, T, H, D = 1, 512, 1, 8
+        ks = jax.random.split(jax.random.PRNGKey(3), 3)
+        q, k, v = (jax.random.normal(kk, (B, T, H, D)) for kk in ks)
+
+        def temp_bytes(sb, grad):
+            f = shard_map(
+                lambda a, b, c: ring_attention(a, b, c, "sp", causal=True,
+                                               sub_block=sb),
+                mesh=mesh, in_specs=(P(None, "sp"),) * 3,
+                out_specs=P(None, "sp"), check_vma=False)
+            fn = (jax.grad(lambda a, b, c: jnp.sum(f(a, b, c) ** 2),
+                           argnums=(0, 1, 2)) if grad else f)
+            ma = jax.jit(fn).lower(q, k, v).compile().memory_analysis()
+            return ma.temp_size_in_bytes
+
+        # whole-block live scores: [B,H,256,256] fp32 ≈ 256 KB/block;
+        # sub-blocked: [B,H,256,32] ≈ 32 KB — compiled temps must reflect
+        # a meaningful reduction, not just noise.  The grad case is the
+        # one that matters (training): without the inner-scan checkpoint
+        # the VJP stacks per-sub-chunk residuals back to the whole block
+        # (caught by measurement in round-4 review).
+        for grad in (False, True):
+            whole, subbed = temp_bytes(None, grad), temp_bytes(32, grad)
+            assert subbed < whole * 0.7, (grad, whole, subbed)
+
     def test_zigzag_permutation_roundtrip(self):
         from paddle_tpu.ops.ring_attention import (zigzag_inverse,
                                                    zigzag_permutation)
