@@ -12,7 +12,9 @@ the k/v chunk currently held, merges it into a running (max, denominator,
 accumulator) online-softmax state, then passes k/v to the next ring neighbour
 via ``lax.ppermute`` — an ICI neighbour hop that XLA overlaps with the
 compute.  The full [T, T] score matrix never exists; per-device memory is
-O(T_local * T_local) per step (and the step loop is rematerialized).
+O(T_local * T_local) per step (and the step loop is rematerialized), or
+O(T_local * sub_block) — masks included — with ``sub_block`` set (the
+flash recurrence over kv sub-chunks; see ``_chunk_attend``).
 
 Causality uses GLOBAL positions: chunk c holds rows [c*Tl, (c+1)*Tl);
 diagonal pairs get a triangular mask, off-diagonal pairs an all-or-nothing
@@ -34,53 +36,63 @@ from jax import lax
 _NEG = -1e30
 
 
-def _chunk_attend(q, k, v, scale, mask=None, sub: int | None = None):
-    """One blockwise partial attention: returns (scores-max m, exp-sum l,
-    weighted acc) for merging.  q [B,Tq,H,D], k/v [B,Tk,H,D].
+def _block_attend(q, k, v, scale, mask=None):
+    """One dense score block: returns (scores-max m, exp-sum l, weighted
+    acc) for merging.  q [B,Tq,H,D], k/v [B,Tk,H,D]."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG)
+    m = jnp.max(s, axis=-1)                      # [B,H,Tq]
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)                      # [B,H,Tq]
+    acc = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    return m, l, acc
+
+
+def _chunk_attend(q, k, v, scale, pos=None, sub: int | None = None):
+    """Blockwise partial attention with an optional causal mask given as
+    POSITIONS, not a dense array: ``pos = (q_pos [Tq], k_pos [Tk])``
+    global position ids; rows attend columns with q_pos >= k_pos.
 
     ``sub`` bounds the score temp: instead of one [B,H,Tq,Tk] block, the
     kv rows are walked in sub-chunks of that many rows with an inner
     online-softmax scan (the flash-attention recurrence in pure XLA), so
-    the largest live score tensor is [B,H,Tq,sub].  This is what keeps
+    the largest live tensor is [B,H,Tq,sub] — masks included: each
+    [Tq, sub] mask slice is built inside the scan body from the linear-
+    size position ids, never as one [Tq, Tk] array.  This is what keeps
     per-device memory flat as the LOCAL chunk grows — the ring bounds
     memory in the ring size R, sub-blocking bounds it in Tl."""
+    if sub is not None and sub <= 0:
+        raise ValueError(f"sub_block must be positive (got {sub})")
     if sub is None or sub >= k.shape[1]:
-        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                       k.astype(jnp.float32)) * scale
-        if mask is not None:
-            s = jnp.where(mask, s, _NEG)
-        m = jnp.max(s, axis=-1)                      # [B,H,Tq]
-        p = jnp.exp(s - m[..., None])
-        l = jnp.sum(p, axis=-1)                      # [B,H,Tq]
-        acc = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
-        return m, l, acc
+        mask = (None if pos is None else
+                (pos[0][:, None] >= pos[1][None, :])[None, None])
+        return _block_attend(q, k, v, scale, mask)
     B, Tk, H, D = k.shape
     if Tk % sub:
         raise ValueError(f"sub_block {sub} must divide the kv chunk {Tk}")
     n = Tk // sub
     Tq = q.shape[1]
-    ks = k.reshape(B, n, sub, H, D)
-    vs = v.reshape(B, n, sub, H, D)
-    # mask [..., Tq, Tk] → per-sub-chunk column slices [n, ..., Tq, sub]
-    msub = (None if mask is None else
-            jnp.moveaxis(mask.reshape(*mask.shape[:-1], n, sub), -2, 0))
+    ks = jnp.moveaxis(k.reshape(B, n, sub, H, D), 1, 0)
+    vs = jnp.moveaxis(v.reshape(B, n, sub, H, D), 1, 0)
+    kp = None if pos is None else pos[1].reshape(n, sub)
 
     def body(carry, xs):
         m_acc, l_acc, o_acc = carry
-        if msub is None:
+        if kp is None:
             kk, vv = xs
             mm = None
         else:
-            kk, vv, mm = xs
-        st = _chunk_attend(q, kk, vv, scale, mm)
+            kk, vv, kps = xs
+            mm = (pos[0][:, None] >= kps[None, :])[None, None]
+        st = _block_attend(q, kk, vv, scale, mm)
         return _merge(m_acc, l_acc, o_acc, *st), None
 
     m0 = jnp.full((B, H, Tq), _NEG, jnp.float32)
     l0 = jnp.zeros((B, H, Tq), jnp.float32)
     o0 = jnp.zeros((B, H, Tq, D), jnp.float32)
-    xs = ((jnp.moveaxis(ks, 1, 0), jnp.moveaxis(vs, 1, 0))
-          if msub is None else
-          (jnp.moveaxis(ks, 1, 0), jnp.moveaxis(vs, 1, 0), msub))
+    xs = (ks, vs) if kp is None else (ks, vs, kp)
     # checkpoint the inner body too: without it the inner scan's VJP
     # stacks per-sub-chunk score residuals back up to ~[B,H,Tq,Tk] —
     # defeating the cap exactly where it matters (training).  Recomputing
@@ -126,14 +138,12 @@ def ring_attention(q, k, v, axis: str, causal: bool = True, scale=None,
         k_cur, v_cur, m_acc, l_acc, o_acc = carry
         src = (my - r) % R  # which chunk we hold at ring step r
         if causal:
-            # global causal mask between q-chunk `my` and kv-chunk `src`
-            q_pos = my * Tl + rows                     # [Tl]
-            k_pos = src * Tl + rows                    # [Tl]
-            mask = q_pos[:, None] >= k_pos[None, :]    # [Tq, Tk]
-            mask = mask[None, None]                    # [1,1,Tq,Tk]
+            # global causal positions of q-chunk `my` and kv-chunk `src`
+            # (linear size; the dense mask is built blockwise downstream)
+            pos = (my * Tl + rows, src * Tl + rows)
         else:
-            mask = None
-        m_new, l_new, acc_new = _chunk_attend(q, k_cur, v_cur, scale, mask,
+            pos = None
+        m_new, l_new, acc_new = _chunk_attend(q, k_cur, v_cur, scale, pos,
                                               sub=sub_block)
         # online-softmax merge of the partial result into the running state
         m_next, l_next, o_next = _merge(m_acc, l_acc, o_acc,
@@ -222,7 +232,7 @@ def ring_attention_zigzag(q, k, v, axis: str, scale=None,
 
     qa, qb = q[:, :Tc], q[:, Tc:]      # global chunks my, 2R-1-my
     rows = jnp.arange(Tc)
-    tril = (rows[:, None] >= rows[None, :])[None, None]  # within-chunk diag
+    diag = (rows, rows)  # same-chunk positions → within-chunk tril mask
 
     def split(kv):
         return kv[:, :Tc], kv[:, Tc:]
@@ -231,9 +241,9 @@ def ring_attention_zigzag(q, k, v, axis: str, scale=None,
     # (2R-1-my > my for every rank) plus its own diagonal
     ka, kb = split(k)
     va, vb = split(v)
-    st_a = _chunk_attend(qa, ka, va, scale, tril, sub=sub_block)
+    st_a = _chunk_attend(qa, ka, va, scale, diag, sub=sub_block)
     st_b = _merge(*_chunk_attend(qb, ka, va, scale, sub=sub_block),
-                  *_chunk_attend(qb, kb, vb, scale, tril, sub=sub_block))
+                  *_chunk_attend(qb, kb, vb, scale, diag, sub=sub_block))
 
     def step(carry, r):
         k_cur, v_cur, st_a, st_b = carry

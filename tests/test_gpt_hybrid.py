@@ -353,9 +353,11 @@ class TestRingAttention:
                 np.testing.assert_allclose(
                     a, b, rtol=2e-4, atol=2e-5,
                     err_msg=f"{name} zigzag={permute}")
-        # divisibility is validated loudly
+        # divisibility and positivity are validated loudly
         with pytest.raises(ValueError):
             jax.jit(loss(make(7), True))(q, k, v)
+        with pytest.raises(ValueError):
+            jax.jit(loss(make(0), True))(q, k, v)
 
     def test_sub_block_caps_score_temp(self):
         """The quantitative witness: compiled temp memory with sub_block
