@@ -398,12 +398,12 @@ class TestRingAttention:
         cfg = gpt.GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
                             num_heads=2, max_seq_len=2048,
                             sp_sub_block=64)
-        mesh = mesh_of((4, 2), ("sp", "mp"))
+        mesh = mesh_of((2, 2, 2), ("pp", "sp", "mp"))
         params = _replicated_params(cfg)
         rng = np.random.default_rng(7)
         toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 2049)),
                            jnp.int32)
-        loss_raw = gpt_hybrid.make_pipeline_gpt_loss(cfg, mesh, n_micro=1,
+        loss_raw = gpt_hybrid.make_pipeline_gpt_loss(cfg, mesh, n_micro=2,
                                                      sp_zigzag=True)
         specs = gpt.param_shardings(cfg, mp="mp", pp=None)
         f = shard_map(loss_raw, mesh=mesh, in_specs=(specs, P(), P()),

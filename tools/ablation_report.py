@@ -51,6 +51,33 @@ def main():
                 ladder and noflash
                 and noflash.get("metric") == ladder.get("metric"))}
 
+    # fused-LN/CE kernel ablation: the SAME 350M config measured with and
+    # without the Pallas kernels (watchdog steps gpt350_fused/_nofused)
+    ab_on = _load("kernel_ab_fused.json")
+    ab_off = _load("kernel_ab_nofused.json")
+    # the two files persist across commits: verify they are the claimed
+    # rungs in the claimed fused-states before pairing them (mirrors the
+    # flash ablation's configs_match guard)
+    if ab_on and not (
+            ab_on.get("fused_kernels") is True
+            and ab_on.get("metric", "").endswith("gpt_350m_fused_acc2_b8")):
+        ab_on = None
+    if ab_off and not (
+            ab_off.get("fused_kernels") is False
+            and ab_off.get("metric", "").endswith("gpt_350m_acc2_b8")):
+        ab_off = None
+    if ab_on and ab_off:
+        report["fused_kernel_ablation"] = {
+            "config": "gpt_350m B=8 T=2048 accum=2",
+            "tok_s_fused": ab_on["value"], "tok_s_unfused": ab_off["value"],
+            "mfu_fused": ab_on.get("mfu"), "mfu_unfused": ab_off.get("mfu"),
+            "speedup": round(ab_on["value"] / ab_off["value"], 3)
+            if ab_off["value"] else None}
+    else:
+        report["fused_kernel_ablation"] = {
+            "status": "incomplete", "have_fused": ab_on is not None,
+            "have_unfused": ab_off is not None}
+
     # remat variants: which compile, how long, compiled temp memory
     report["remat_variants"] = remat or {"status": "absent"}
 

@@ -136,6 +136,23 @@ def _payload_steps():
         ("noflash", [py, bench], 2700,
          {"PADDLE_TPU_NO_FLASH": "1", "BENCH_RUNG_TIMEOUT": "480"},
          os.path.join(REPO, "noflash.json")),
+        # like-for-like fused-LN/CE kernel A/B: the SAME 350M config
+        # (B=8, T=2048, accum=2) with and without the Pallas fused
+        # kernels — the ladder alone can't produce this pair because it
+        # returns its first fitting rung.  Both arms pin the flash/fused
+        # env flags explicitly so an operator shell's exports can't turn
+        # the "unfused" arm fused.  The fused arm is GATED on the
+        # certification marker (6th tuple slot): while it is absent the
+        # step is skipped WITHOUT burning an attempt — the rung doesn't
+        # exist yet, which is not a failure of this step.
+        ("gpt350_fused", [py, bench, "--gpt-rung", "gpt_350m_fused_acc2_b8"],
+         900, {"PADDLE_TPU_NO_FLASH": "0"},
+         os.path.join(REPO, "kernel_ab_fused.json"),
+         os.path.join(REPO, "FUSED_KERNELS_OK.json")),
+        ("gpt350_nofused", [py, bench, "--gpt-rung", "gpt_350m_acc2_b8"],
+         900, {"PADDLE_TPU_NO_FLASH": "0", "PADDLE_TPU_FUSED_LN": "0",
+               "PADDLE_TPU_FUSED_CE": "0"},
+         os.path.join(REPO, "kernel_ab_nofused.json")),
         ("remat_variants", [py, os.path.join(REPO, "tools",
                                              "remat_compile_check.py")],
          3600, {}, None),
@@ -226,12 +243,23 @@ def watch(interval: float, probe_timeout: float, max_hours: float):
         if e["ok"]:
             data["windows"].append({"opened": _now()})
             _save_results(data)
-            for name, argv, to, env, out_json in _payload_steps():
+            for step_spec in _payload_steps():
+                name, argv, to, env, out_json = step_spec[:5]
+                gate = step_spec[5] if len(step_spec) > 5 else None
                 prev = data["steps"].get(name, {})
-                if prev.get("ok"):
+                # ablation_report is a cheap local join that must ALWAYS
+                # re-run: inputs it reported "incomplete" may have been
+                # produced by later windows' steps
+                if name != "ablation_report":
+                    if prev.get("ok"):
+                        continue
+                    if prev.get("attempts", 0) >= 3:
+                        continue  # persistently failing step: stop burning
+                if gate and not os.path.exists(gate):
+                    log(f"[watch] step {name}: gated on "
+                        f"{os.path.basename(gate)} (absent) — skipped, "
+                        f"attempt not counted")
                     continue
-                if prev.get("attempts", 0) >= 3:
-                    continue  # persistently failing step: stop burning it
                 rec = _run_step(name, argv, to, env, out_json, log)
                 rec["attempts"] = prev.get("attempts", 0) + 1
                 data["steps"][name] = rec
