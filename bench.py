@@ -353,6 +353,12 @@ def _run_gpt_rung(idx: int):
          f"device={dev.device_kind}")
     out = {"metric": f"tokens_per_sec_per_chip_{name}",
            "value": round(tok_s, 1), "unit": "tokens/s/chip",
+           # the platform the rung ACTUALLY ran on: child mode (--gpt-rung)
+           # skips the parent's backend probe, so without this field a
+           # silent CPU fallback would be indistinguishable from a TPU
+           # measurement downstream (watchdog kernel A/B, ablation joins)
+           "device": dev.platform,
+           "device_kind": str(getattr(dev, "device_kind", "")),
            "step_ms": round(dt * 1e3, 2), "mfu": round(mfu, 4),
            "remat": bool(cfg.remat),  # configs are NOT comparable across
            "remat_policy": _effective_remat_policy(cfg) if cfg.remat
@@ -412,7 +418,16 @@ def bench_gpt(small: bool):
         timeouts = 0
         sys.stderr.write(out.stderr[-4000:])
         if out.returncode == 0 and out.stdout.strip():
-            return json.loads(out.stdout.strip().splitlines()[-1])
+            r = json.loads(out.stdout.strip().splitlines()[-1])
+            # the ladder only runs after a successful TPU probe, so a
+            # child that quietly fell back to CPU mid-window must not
+            # become the headline
+            if r.get("device") in (None, "tpu", "axon"):
+                return r
+            _log(f"[bench] {name}: child ran on {r.get('device')} — "
+                 f"rejecting (tunnel died between probe and rung)")
+            last_fail = f"{name}: child fell back to {r.get('device')}"
+            continue
         _log(f"[bench] {name}: failed rc={out.returncode}; trying next rung")
         last_fail = f"{name}: rc={out.returncode}"
     raise RuntimeError(f"all GPT rungs failed (last: {last_fail})")

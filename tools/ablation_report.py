@@ -60,15 +60,19 @@ def main():
     # flash ablation's configs_match guard)
     if ab_on and not (
             ab_on.get("fused_kernels") is True
-            and ab_on.get("metric", "").endswith("gpt_350m_fused_acc2_b8")):
+            and ab_on.get("metric", "").endswith("gpt_350m_fused_acc2_b8")
+            and ab_on.get("device") in ("tpu", "axon")):
         ab_on = None
     if ab_off and not (
             ab_off.get("fused_kernels") is False
-            and ab_off.get("metric", "").endswith("gpt_350m_acc2_b8")):
+            and ab_off.get("metric", "").endswith("gpt_350m_acc2_b8")
+            and ab_off.get("device") in ("tpu", "axon")):
         ab_off = None
     if ab_on and ab_off:
         report["fused_kernel_ablation"] = {
-            "config": "gpt_350m B=8 T=2048 accum=2",
+            # label derived from the measured record, not restated by hand
+            "config": (f"{ab_on['metric']} vs {ab_off['metric']} "
+                       f"(accum={ab_on.get('accum')})"),
             "tok_s_fused": ab_on["value"], "tok_s_unfused": ab_off["value"],
             "mfu_fused": ab_on.get("mfu"), "mfu_unfused": ab_off.get("mfu"),
             "speedup": round(ab_on["value"] / ab_off["value"], 3)

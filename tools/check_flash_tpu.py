@@ -27,16 +27,12 @@ _KERNEL_SRCS = [os.path.join(_REPO, "paddle_tpu", "ops", f)
 
 
 def _src_sig() -> str:
-    import hashlib
+    # script-dir insert: covers import-by-path (drive scripts), where
+    # sys.path[0] is not tools/
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from srcsig import source_signature
 
-    h = hashlib.sha256()
-    for p in _KERNEL_SRCS + [os.path.abspath(__file__)]:
-        try:
-            with open(p, "rb") as f:
-                h.update(f.read())
-        except OSError:
-            h.update(b"missing:" + p.encode())
-    return h.hexdigest()[:16]
+    return source_signature(_KERNEL_SRCS + [os.path.abspath(__file__)])
 
 
 def _load_cache(sig: str) -> set:
@@ -169,13 +165,12 @@ if __name__ == "__main__":
             lambda: check_fused_ln(1024, 4096, jnp.bfloat16))
     _cached("flash:causal:B2T256H2D64:f32",
             lambda: check(2, 256, 2, 64, True, jnp.float32))
-    for causal in (False,):
-        _cached(f"flash:c{int(causal)}:B2T256H2D64:f32",
-                lambda c=causal: check(2, 256, 2, 64, c, jnp.float32))
-        _cached(f"flash:c{int(causal)}:B2T512H4D128:bf16",
-                lambda c=causal: check(2, 512, 4, 128, c, jnp.bfloat16))
-        _cached(f"flash:c{int(causal)}:B1T1024H2D128:bf16",
-                lambda c=causal: check(1, 1024, 2, 128, c, jnp.bfloat16))
+    _cached("flash:c0:B2T256H2D64:f32",
+            lambda: check(2, 256, 2, 64, False, jnp.float32))
+    _cached("flash:c0:B2T512H4D128:bf16",
+            lambda: check(2, 512, 4, 128, False, jnp.bfloat16))
+    _cached("flash:c0:B1T1024H2D128:bf16",
+            lambda: check(1, 1024, 2, 128, False, jnp.bfloat16))
     print("flash attention fwd+bwd all OK", flush=True)
     _cached("fused_ln:N256F1024:f32",
             lambda: check_fused_ln(256, 1024, jnp.float32))

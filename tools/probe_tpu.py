@@ -110,11 +110,30 @@ def _now() -> str:
         timespec="seconds")
 
 
+def _fused_gate() -> bool:
+    """The certification gate, by bench.py's own rule: marker present AND
+    newer than every kernel source (a stale marker means bench will not
+    offer the fused rung, so running the fused A/B arm would only burn
+    attempts on 'unknown rung')."""
+    import importlib.util
+
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "bench", os.path.join(REPO, "bench.py"))
+        b = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(b)
+        return bool(b._fused_kernels_ok())
+    except Exception:  # noqa: BLE001 - unreadable bench = gate closed
+        return False
+
+
 def _payload_steps():
     py = sys.executable
     bench = os.path.join(REPO, "bench.py")
     return [
-        # (name, argv, timeout_s, extra_env, output_json_path_or_None)
+        # (name, argv, timeout_s, extra_env, output_json_path_or_None,
+        #  gate_callable_or_None — step skipped WITHOUT burning an attempt
+        #  while the gate returns False)
         #
         # Order is tuned for SHORT healthy windows (round-4 window 1
         # measured ~7 min before the tunnel re-wedged): the kernel parity
@@ -129,13 +148,14 @@ def _payload_steps():
         # for checks not yet passed under the current kernel sources
         ("flash_check", [py, os.path.join(REPO, "tools",
                                           "check_flash_tpu.py")], 2400, {},
-         None),
-        ("ladder", [py, bench], 5400, {"BENCH_RUNG_TIMEOUT": "540"}, None),
+         None, None),
+        ("ladder", [py, bench], 5400, {"BENCH_RUNG_TIMEOUT": "540"},
+         None, None),
         ("all", [py, bench, "--all"], 7200,
-         {"BENCH_RUNG_TIMEOUT": "540"}, None),
+         {"BENCH_RUNG_TIMEOUT": "540"}, None, None),
         ("noflash", [py, bench], 2700,
          {"PADDLE_TPU_NO_FLASH": "1", "BENCH_RUNG_TIMEOUT": "480"},
-         os.path.join(REPO, "noflash.json")),
+         os.path.join(REPO, "noflash.json"), None),
         # like-for-like fused-LN/CE kernel A/B: the SAME 350M config
         # (B=8, T=2048, accum=2) with and without the Pallas fused
         # kernels — the ladder alone can't produce this pair because it
@@ -147,18 +167,17 @@ def _payload_steps():
         # exist yet, which is not a failure of this step.
         ("gpt350_fused", [py, bench, "--gpt-rung", "gpt_350m_fused_acc2_b8"],
          900, {"PADDLE_TPU_NO_FLASH": "0"},
-         os.path.join(REPO, "kernel_ab_fused.json"),
-         os.path.join(REPO, "FUSED_KERNELS_OK.json")),
+         os.path.join(REPO, "kernel_ab_fused.json"), _fused_gate),
         ("gpt350_nofused", [py, bench, "--gpt-rung", "gpt_350m_acc2_b8"],
          900, {"PADDLE_TPU_NO_FLASH": "0", "PADDLE_TPU_FUSED_LN": "0",
                "PADDLE_TPU_FUSED_CE": "0"},
-         os.path.join(REPO, "kernel_ab_nofused.json")),
+         os.path.join(REPO, "kernel_ab_nofused.json"), None),
         ("remat_variants", [py, os.path.join(REPO, "tools",
                                              "remat_compile_check.py")],
-         3600, {}, None),
+         3600, {}, None, None),
         ("ablation_report", [py, os.path.join(REPO, "tools",
                                               "ablation_report.py")],
-         120, {}, None),
+         120, {}, None, None),
     ]
 
 
@@ -212,7 +231,10 @@ def _run_step(name, argv, timeout, env, out_json, log):
     # a replayed watchdog headline (source=tpu_watchdog) is bench.py echoing
     # OUR earlier measurement back — not a fresh on-device run
     fell_back = ("_cpu_fallback" in str(head.get("metric", ""))
-                 or head.get("source") == "tpu_watchdog")
+                 or head.get("source") == "tpu_watchdog"
+                 # rung child mode skips the parent backend probe; its
+                 # records carry the actual platform instead
+                 or head.get("device") not in (None, "tpu", "axon"))
     rec["ok"] = rec.get("rc") == 0 and not fell_back
     if out_json and rec["ok"] and rec.get("headline") is not None:
         # only persist a FRESH measurement — a replayed/fallback headline
@@ -243,9 +265,7 @@ def watch(interval: float, probe_timeout: float, max_hours: float):
         if e["ok"]:
             data["windows"].append({"opened": _now()})
             _save_results(data)
-            for step_spec in _payload_steps():
-                name, argv, to, env, out_json = step_spec[:5]
-                gate = step_spec[5] if len(step_spec) > 5 else None
+            for name, argv, to, env, out_json, gate in _payload_steps():
                 prev = data["steps"].get(name, {})
                 # ablation_report is a cheap local join that must ALWAYS
                 # re-run: inputs it reported "incomplete" may have been
@@ -255,9 +275,9 @@ def watch(interval: float, probe_timeout: float, max_hours: float):
                         continue
                     if prev.get("attempts", 0) >= 3:
                         continue  # persistently failing step: stop burning
-                if gate and not os.path.exists(gate):
-                    log(f"[watch] step {name}: gated on "
-                        f"{os.path.basename(gate)} (absent) — skipped, "
+                if gate is not None and not gate():
+                    log(f"[watch] step {name}: gate closed (fused rungs "
+                        f"not certified for current sources) — skipped, "
                         f"attempt not counted")
                     continue
                 rec = _run_step(name, argv, to, env, out_json, log)
@@ -272,9 +292,20 @@ def watch(interval: float, probe_timeout: float, max_hours: float):
                     log("[watch] step timed out — treating the window as "
                         "closed; back to probing (backoff engaged)")
                     break
-            if all(s.get("ok") or s.get("attempts", 0) >= 3
-                   for s in data["steps"].values()) \
-                    and len(data["steps"]) == len(_payload_steps()):
+            def _step_resolved(name, gate):
+                s = data["steps"].get(name)
+                if s and (s.get("ok") or s.get("attempts", 0) >= 3):
+                    return True
+                if gate is not None and not gate():
+                    # gated shut: unreachable unless a future flash_check
+                    # run rewrites the certification — once flash_check
+                    # itself is resolved, this step can never run
+                    fc = data["steps"].get("flash_check", {})
+                    return bool(fc.get("ok") or fc.get("attempts", 0) >= 3)
+                return False
+
+            if all(_step_resolved(spec[0], spec[5])
+                   for spec in _payload_steps()):
                 log("[watch] all payload steps resolved; exiting")
                 _save_results(data)
                 break

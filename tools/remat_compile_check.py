@@ -77,7 +77,8 @@ def _child(variant: str):
 def _src_sig() -> str:
     """Hash of the sources whose compile behavior this check measures —
     a recorded verdict must not outlive an edit to the code it compiled."""
-    import hashlib
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from srcsig import source_signature
 
     srcs = [os.path.join(REPO, "paddle_tpu", "text", "gpt.py"),
             os.path.join(REPO, "paddle_tpu", "text", "gpt_hybrid.py"),
@@ -85,14 +86,7 @@ def _src_sig() -> str:
             os.path.join(REPO, "paddle_tpu", "ops", "flash_attention.py"),
             os.path.join(REPO, "paddle_tpu", "ops", "attention.py"),
             os.path.abspath(__file__)]
-    h = hashlib.sha256()
-    for p in srcs:
-        try:
-            with open(p, "rb") as f:
-                h.update(f.read())
-        except OSError:
-            h.update(b"missing:" + p.encode())
-    return h.hexdigest()[:16]
+    return source_signature(srcs)
 
 
 def _resolved(r) -> bool:

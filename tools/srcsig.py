@@ -1,0 +1,21 @@
+"""Shared source-signature helper for resumable on-device checks.
+
+Both `check_flash_tpu.py` and `remat_compile_check.py` key their
+window-resume caches on a hash of the sources whose behavior they measure
+— a recorded verdict must never outlive an edit to the code it verified.
+"""
+import hashlib
+import os
+
+
+def source_signature(paths) -> str:
+    """Stable 16-hex digest over the given files' bytes (missing files
+    hash their path, so adding/removing a file also changes the sig)."""
+    h = hashlib.sha256()
+    for p in paths:
+        try:
+            with open(p, "rb") as f:
+                h.update(f.read())
+        except OSError:
+            h.update(b"missing:" + p.encode())
+    return h.hexdigest()[:16]
