@@ -424,10 +424,13 @@ def bench_gpt(small: bool):
             # become the headline
             if r.get("device") in (None, "tpu", "axon"):
                 return r
+            # a CPU child means the tunnel died between the parent probe
+            # and the rung — later rungs would all do the same; stop the
+            # ladder rather than walking every rung on the wrong backend
             _log(f"[bench] {name}: child ran on {r.get('device')} — "
-                 f"rejecting (tunnel died between probe and rung)")
+                 f"tunnel died between probe and rung; abandoning ladder")
             last_fail = f"{name}: child fell back to {r.get('device')}"
-            continue
+            break
         _log(f"[bench] {name}: failed rc={out.returncode}; trying next rung")
         last_fail = f"{name}: rc={out.returncode}"
     raise RuntimeError(f"all GPT rungs failed (last: {last_fail})")
@@ -505,7 +508,8 @@ def bench_bert(small: bool):
          f"step={dt * 1e3:.1f}ms loss={float(st['l']):.4f} MFU={mfu:.3f}")
     return {"metric": "sequences_per_sec_per_chip_bert_base",
             "value": round(samp_s, 2), "unit": "sequences/s/chip",
-            "step_ms": round(dt * 1e3, 2), "mfu": round(mfu, 4),
+            "device": dev.platform, "step_ms": round(dt * 1e3, 2),
+            "mfu": round(mfu, 4),
             "vs_baseline": round(mfu / _A100_MFU_BAR, 4)}
 
 
@@ -541,7 +545,8 @@ def _layer_train_bench(name, net, X, Y, iters, lr=0.01, flops_per_step=None,
     samp_s = B / dt
     out = {"metric": f"samples_per_sec_per_chip_{name}",
            "value": round(samp_s, 1), "unit": "samples/s/chip",
-           "step_ms": round(dt * 1e3, 2), "vs_baseline": 0.0}
+           "device": dev.platform, "step_ms": round(dt * 1e3, 2),
+           "vs_baseline": 0.0}
     if flops_per_step is not None:
         mfu = flops_per_step / dt / _peak_flops(dev)
         out["mfu"] = round(mfu, 4)

@@ -110,21 +110,31 @@ def _now() -> str:
         timespec="seconds")
 
 
+_GATE_MEMO = {"t": -1e9, "v": False}
+
+
 def _fused_gate() -> bool:
     """The certification gate, by bench.py's own rule: marker present AND
     newer than every kernel source (a stale marker means bench will not
     offer the fused rung, so running the fused A/B arm would only burn
-    attempts on 'unknown rung')."""
+    attempts on 'unknown rung').  Memoized for 5s: one watch iteration
+    consults it several times and re-executing bench.py each call is
+    pointless within a single check point."""
     import importlib.util
 
+    now = time.monotonic()
+    if now - _GATE_MEMO["t"] < 5.0:
+        return _GATE_MEMO["v"]
     try:
         spec = importlib.util.spec_from_file_location(
             "bench", os.path.join(REPO, "bench.py"))
         b = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(b)
-        return bool(b._fused_kernels_ok())
+        v = bool(b._fused_kernels_ok())
     except Exception:  # noqa: BLE001 - unreadable bench = gate closed
-        return False
+        v = False
+    _GATE_MEMO.update(t=now, v=v)
+    return v
 
 
 def _payload_steps():
@@ -264,6 +274,29 @@ def watch(interval: float, probe_timeout: float, max_hours: float):
         consecutive_fails = 0 if e["ok"] else consecutive_fails + 1
         if e["ok"]:
             data["windows"].append({"opened": _now()})
+            # a kernel-source edit invalidates past certification AND past
+            # A/B measurements: reopen the steps whose recorded success no
+            # longer matches the current sources, else _step_resolved would
+            # trust a stale ok and skip re-measuring forever
+            fc = data["steps"].get("flash_check")
+            if fc and fc.get("ok") and not _fused_gate():
+                log("[watch] certification stale vs current sources — "
+                    "reopening flash_check")
+                data["steps"]["flash_check"] = {"attempts": 0}
+            for nm, fn in (("gpt350_fused", "kernel_ab_fused.json"),
+                           ("gpt350_nofused", "kernel_ab_nofused.json")):
+                st = data["steps"].get(nm)
+                if not (st and st.get("ok")):
+                    continue
+                try:
+                    with open(os.path.join(REPO, fn)) as f:
+                        rec = json.load(f)
+                except Exception:  # noqa: BLE001 - missing/torn = invalid
+                    rec = {}
+                if rec.get("device") not in ("tpu", "axon"):
+                    log(f"[watch] {nm}: recorded arm has no on-device "
+                        f"provenance — reopening for re-measurement")
+                    data["steps"][nm] = {"attempts": 0}
             _save_results(data)
             for name, argv, to, env, out_json, gate in _payload_steps():
                 prev = data["steps"].get(name, {})
