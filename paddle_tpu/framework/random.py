@@ -17,6 +17,7 @@ import contextlib
 import threading
 
 import jax
+from jax.core import Tracer
 
 
 class _RNGState(threading.local):
@@ -27,6 +28,7 @@ class _RNGState(threading.local):
     def __init__(self):
         self.key = None  # materialized on first use
         self.override = None  # traced key stack for jitted paths
+        self.trace_calls = 0  # distinct-key counter under foreign traces
 
     def get_key(self):
         if self.key is None:
@@ -47,7 +49,22 @@ def next_key(n: int = 1):
     if _state.override is not None:
         tracker = _state.override
         return tracker.next(n)
-    _state.key, *sub = jax.random.split(_state.get_key(), n + 1)
+    key = _state.get_key()
+    new_key, *sub = jax.random.split(key, n + 1)
+    if isinstance(new_key, Tracer):
+        # Under a FOREIGN trace (ONNX export / make_jaxpr over a
+        # StaticFunction — jitted paddle paths install ``rng_scope``
+        # instead and never reach here): storing the traced key would let
+        # the tracer escape and poison every later RNG use, but NOT
+        # advancing at all would hand every call site the same key,
+        # silently correlating e.g. all dropout masks.  A Python-side
+        # counter folds a distinct stream per call site into the frozen
+        # key; the concrete global stream stays untouched.
+        _state.trace_calls += 1
+        sub = list(jax.random.split(
+            jax.random.fold_in(key, _state.trace_calls), n))
+    else:
+        _state.key = new_key
     return sub[0] if n == 1 else list(sub)
 
 

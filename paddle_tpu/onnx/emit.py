@@ -31,7 +31,7 @@ _DT = {"float32": 1, "uint8": 2, "int8": 3, "uint16": 4, "int16": 5,
        "uint32": 12, "uint64": 13, "bfloat16": 16}
 
 # AttributeProto.AttributeType
-_AT_FLOAT, _AT_INT, _AT_INTS = 1, 2, 7
+_AT_FLOAT, _AT_INT, _AT_GRAPH, _AT_INTS = 1, 2, 5, 7
 
 
 def _attr_int(name: str, v: int) -> bytes:
@@ -51,6 +51,12 @@ def _attr_ints(name: str, vs) -> bytes:
 def _attr_float(name: str, v: float) -> bytes:
     return W.f_message(5, W.f_string(1, name) + W.f_float(2, float(v))
                        + W.f_varint(20, _AT_FLOAT))
+
+
+def _attr_graph(name: str, graph_bytes: bytes) -> bytes:
+    """Graph-valued attribute (If then/else_branch, Loop body)."""
+    return W.f_message(5, W.f_string(1, name) + W.f_message(6, graph_bytes)
+                       + W.f_varint(20, _AT_GRAPH))
 
 
 def _tensor(name: str, arr: np.ndarray) -> bytes:
@@ -86,14 +92,20 @@ def _node(op: str, inputs, outputs, attrs: bytes = b"", name="") -> bytes:
 
 
 class _Graph:
-    def __init__(self):
+    def __init__(self, counter: list | None = None):
         self.nodes: list[bytes] = []
         self.initializers: list[bytes] = []
-        self._n = 0
+        # subgraphs (If branches, Loop bodies) share the parent's counter:
+        # ONNX subgraphs see the outer scope, so a name minted in a
+        # subgraph must never collide with an outer name
+        self._counter = counter if counter is not None else [0]
+
+    def sub(self) -> "_Graph":
+        return _Graph(self._counter)
 
     def fresh(self, hint="t") -> str:
-        self._n += 1
-        return f"{hint}_{self._n}"
+        self._counter[0] += 1
+        return f"{hint}_{self._counter[0]}"
 
     def add(self, op, inputs, outputs=None, attrs=b"", hint=None):
         outs = outputs or [self.fresh(hint or op.lower())]
@@ -565,6 +577,21 @@ def _lower_reduce_sum13(g, eqn, ins):
                  attrs=_attr_int("keepdims", 0), hint="reducesum")
 
 
+def _assemble_graph(g: _Graph, graph_inputs, graph_outputs,
+                    name="paddle_tpu_graph") -> bytes:
+    graph = b""
+    for n in g.nodes:
+        graph += W.f_message(1, n)
+    graph += W.f_string(2, name)
+    for t in g.initializers:
+        graph += W.f_message(5, t)
+    for vi in graph_inputs:
+        graph += W.f_message(11, vi)
+    for vo in graph_outputs:
+        graph += W.f_message(12, vo)
+    return graph
+
+
 def emit_model(fn, example_args, producer="paddle_tpu") -> bytes:
     """Trace ``fn(*example_args)`` and lower the jaxpr to ONNX bytes."""
     import jax
@@ -573,13 +600,46 @@ def emit_model(fn, example_args, producer="paddle_tpu") -> bytes:
     jaxpr, consts = closed.jaxpr, closed.consts
     g = _Graph()
     env: dict = {}
+    # concrete values from const-folding (the PRNG chain a StaticFunction
+    # wrapper threads for dropout keys: random_seed/wrap/split/unwrap are
+    # all literal-seeded at export time).  Key-typed values stay here and
+    # are only ever consumed by other folded prims; numeric ones
+    # materialize as initializers on first reference.
+    const_vals: dict = {}
 
-    def ref(var):
+    def ref(var, gr=None):
         from jax._src.core import Literal
 
         if isinstance(var, Literal):
-            return g.const(np.asarray(var.val), "lit")
+            return (gr or g).const(np.asarray(var.val), "lit")
+        if var not in env and var in const_vals:
+            env[var] = (gr or g).const(np.asarray(const_vals[var]),
+                                       "folded")
         return env[var]
+
+    def inline(closed_j, gr, arg_names):
+        """Walk a ClosedJaxpr's body into graph ``gr`` with its invars
+        bound to existing names; returns the outvar names."""
+        jx = closed_j.jaxpr
+        for v, nm in zip(jx.invars, arg_names):
+            env[v] = nm
+        for cv, c in zip(jx.constvars, closed_j.consts):
+            env[cv] = gr.const(np.asarray(c), "param")
+        walk(jx, gr)
+        return [ref(v, gr) for v in jx.outvars]
+
+    def branch_graph(g_parent, closed_b, operand_names, outvars):
+        """One If branch as a subgraph: operands come from the OUTER
+        scope by name (ONNX subgraphs see enclosing values); every output
+        is Identity-wrapped so the subgraph's declared outputs are nodes
+        it produced itself."""
+        sub = g_parent.sub()
+        outs = inline(closed_b, sub, operand_names)
+        vis = []
+        for v, nm in zip(outvars, outs):
+            onm = sub.add("Identity", [nm], hint="branch_out")
+            vis.append(_value_info(onm, v.aval.shape, v.aval.dtype))
+        return _assemble_graph(sub, [], vis, name=sub.fresh("branch"))
 
     graph_inputs = []
     for i, v in enumerate(jaxpr.invars):
@@ -587,32 +647,141 @@ def emit_model(fn, example_args, producer="paddle_tpu") -> bytes:
         env[v] = name
         graph_inputs.append(_value_info(name, v.aval.shape, v.aval.dtype))
     for v, c in zip(jaxpr.constvars, consts):
-        env[v] = g.const(np.asarray(c), "param")
+        # via const_vals, not an eager initializer: (a) key-typed closure
+        # consts (the global PRNG key a StaticFunction captures) must stay
+        # foldable rather than crash np.asarray, (b) unused consts never
+        # bloat the file — ref() materializes on first reference
+        const_vals[v] = c
 
-    def walk(jaxpr_inner):
+    def walk(jaxpr_inner, g):
         for eqn in jaxpr_inner.eqns:
             prim = eqn.primitive.name
             if prim in ("jit", "pjit", "custom_jvp_call", "custom_vjp_call",
                         "custom_jvp_call_jaxpr", "closed_call",
                         "remat", "checkpoint"):
+                import types
+
                 inner = eqn.params.get("jaxpr") or eqn.params.get(
                     "call_jaxpr") or eqn.params.get("fun_jaxpr")
                 inner_jaxpr = getattr(inner, "jaxpr", inner)
                 inner_consts = getattr(inner, "consts", [])
-                for iv, ov in zip(inner_jaxpr.invars,
-                                  eqn.invars[len(inner_consts):]
-                                  if len(inner_jaxpr.invars)
-                                  != len(eqn.invars) else eqn.invars):
-                    env[iv] = ref(ov)
-                for cv, c in zip(inner_jaxpr.constvars, inner_consts):
-                    env[cv] = g.const(np.asarray(c), "param")
-                walk(inner_jaxpr)
-                for ov, iv in zip(eqn.outvars, inner_jaxpr.outvars):
-                    env[ov] = ref(iv)
+                arg_vars = (eqn.invars[len(inner_consts):]
+                            if len(inner_jaxpr.invars) != len(eqn.invars)
+                            else eqn.invars)
+                outs = inline(
+                    types.SimpleNamespace(jaxpr=inner_jaxpr,
+                                          consts=inner_consts),
+                    g, [ref(v, g) for v in arg_vars])
+                for ov, nm in zip(eqn.outvars, outs):
+                    env[ov] = nm
+                continue
+            if prim == "device_put":
+                # placement is meaningless in a serialized graph
+                for ov, iv in zip(eqn.outvars, eqn.invars):
+                    if iv in const_vals and iv not in env:
+                        const_vals[ov] = const_vals[iv]
+                    else:
+                        env[ov] = ref(iv, g)
+                continue
+            if prim in ("random_seed", "random_wrap", "random_unwrap",
+                        "random_split", "random_fold_in"):
+                from jax._src.core import Literal
+
+                vals = []
+                for v in eqn.invars:
+                    if isinstance(v, Literal):
+                        vals.append(v.val)
+                    elif v in const_vals:
+                        vals.append(const_vals[v])
+                    else:
+                        raise NotImplementedError(
+                            f"ONNX export: {prim} with non-constant "
+                            f"inputs (an inference graph must not consume "
+                            f"runtime randomness)")
+                out = eqn.primitive.bind(*vals, **eqn.params)
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                for v, val in zip(eqn.outvars, outs):
+                    const_vals[v] = val
                 continue
             if prim == "reduce_sum":
                 env[eqn.outvars[0]] = _lower_reduce_sum13(
-                    g, eqn, [ref(v) for v in eqn.invars])
+                    g, eqn, [ref(v, g) for v in eqn.invars])
+                continue
+            if prim == "cond":
+                # lax.cond / lax.switch → ONNX If (chained for N > 2).
+                # jax clamps the branch index into range; the Less-chain
+                # reproduces that (idx <= 0 → branch 0, idx >= N-1 → last).
+                branches = eqn.params["branches"]
+                idx = ref(eqn.invars[0], g)
+                op_names = [ref(v, g) for v in eqn.invars[1:]]
+                idx_dt = eqn.invars[0].aval.dtype
+
+                def if_chain(gr, k):
+                    then_g = branch_graph(gr, branches[k], op_names,
+                                          eqn.outvars)
+                    if k + 1 == len(branches) - 1:
+                        else_g = branch_graph(gr, branches[k + 1],
+                                              op_names, eqn.outvars)
+                    else:
+                        sub = gr.sub()
+                        inner = if_chain(sub, k + 1)
+                        vis = [_value_info(nm, v.aval.shape, v.aval.dtype)
+                               for nm, v in zip(inner, eqn.outvars)]
+                        else_g = _assemble_graph(sub, [], vis,
+                                                 name=sub.fresh("chain"))
+                    pred = gr.add(
+                        "Less", [idx, gr.const(np.asarray(k + 1, idx_dt),
+                                               "k")], hint="pred")
+                    outs = [gr.fresh("if_out") for _ in eqn.outvars]
+                    gr.add("If", [pred], outputs=outs,
+                           attrs=_attr_graph("then_branch", then_g)
+                           + _attr_graph("else_branch", else_g))
+                    return outs
+
+                if len(branches) == 1:  # degenerate switch: no If needed
+                    outs = inline(branches[0], g, op_names)
+                else:
+                    outs = if_chain(g, 0)
+                for v, nm in zip(eqn.outvars, outs):
+                    env[v] = nm
+                continue
+            if prim == "while":
+                # lax.while_loop → ONNX Loop: cond evaluated once in the
+                # outer graph for the initial check, and re-evaluated at
+                # the end of each body iteration for the carried cond_out
+                p = eqn.params
+                cj, bj = p["cond_jaxpr"], p["body_jaxpr"]
+                ncc, nbc = p["cond_nconsts"], p["body_nconsts"]
+                ins = [ref(v, g) for v in eqn.invars]
+                cond_consts = ins[:ncc]
+                body_consts = ins[ncc:ncc + nbc]
+                carry = ins[ncc + nbc:]
+                carry_vars = eqn.invars[ncc + nbc:]
+                cond0 = inline(cj, g, cond_consts + carry)[0]
+                sub = g.sub()
+                it_nm = sub.fresh("iter")
+                cin_nm = sub.fresh("cond_in")
+                carry_in = [sub.fresh("carry_in") for _ in carry]
+                new_carry = inline(bj, sub, body_consts + carry_in)
+                cond_next = inline(cj, sub, cond_consts + new_carry)[0]
+                cond_out = sub.add("Identity", [cond_next], hint="cond_out")
+                carry_out = [sub.add("Identity", [nm], hint="carry_out")
+                             for nm in new_carry]
+                in_vis = ([_value_info(it_nm, (), np.int64),
+                           _value_info(cin_nm, (), np.bool_)]
+                          + [_value_info(nm, v.aval.shape, v.aval.dtype)
+                             for nm, v in zip(carry_in, carry_vars)])
+                out_vis = ([_value_info(cond_out, (), np.bool_)]
+                           + [_value_info(nm, v.aval.shape, v.aval.dtype)
+                              for nm, v in zip(carry_out, carry_vars)])
+                body_g = _assemble_graph(sub, in_vis, out_vis,
+                                         name=sub.fresh("loop_body"))
+                outs = [g.fresh("loop_out") for _ in carry]
+                # first Loop input (max trip count M) is absent: ""
+                g.add("Loop", ["", cond0] + carry, outputs=outs,
+                      attrs=_attr_graph("body", body_g))
+                for v, nm in zip(eqn.outvars, outs):
+                    env[v] = nm
                 continue
             if prim == "scan":
                 # static trip count → UNROLL (deploy-friendly: flat graphs
@@ -622,7 +791,7 @@ def emit_model(fn, example_args, producer="paddle_tpu") -> bytes:
                 L, nc, nk = p["length"], p["num_consts"], p["num_carry"]
                 closed = p["jaxpr"]
                 body = closed.jaxpr
-                all_ins = [ref(v) for v in eqn.invars]
+                all_ins = [ref(v, g) for v in eqn.invars]
                 consts_in = all_ins[:nc]
                 carry = list(all_ins[nc:nc + nk])
                 xs = all_ins[nc + nk:]
@@ -640,11 +809,11 @@ def emit_model(fn, example_args, producer="paddle_tpu") -> bytes:
                     for bv, name in zip(body.invars,
                                         consts_in + carry + xs_i):
                         env[bv] = name
-                    walk(body)
-                    carry = [ref(v) for v in body.outvars[:nk]]
+                    walk(body, g)
+                    carry = [ref(v, g) for v in body.outvars[:nk]]
                     for j, ov in enumerate(body.outvars[nk:]):
                         ys_parts[j][it] = _lower_reshape_to(
-                            g, ref(ov), (1,) + tuple(ov.aval.shape))
+                            g, ref(ov, g), (1,) + tuple(ov.aval.shape))
                 for v, name in zip(eqn.outvars[:nk], carry):
                     env[v] = name
                 for j, v in enumerate(eqn.outvars[nk:]):
@@ -659,30 +828,21 @@ def emit_model(fn, example_args, producer="paddle_tpu") -> bytes:
                 raise NotImplementedError(
                     f"ONNX export: primitive {prim!r} has no lowering "
                     f"(supported: {sorted(_LOWER)})")
-            out = fnl(g, eqn, [ref(v) for v in eqn.invars])
+            out = fnl(g, eqn, [ref(v, g) for v in eqn.invars])
             if len(eqn.outvars) > 1:
                 for v, name in zip(eqn.outvars, out):
                     env[v] = name
             else:
                 env[eqn.outvars[0]] = out
 
-    walk(jaxpr)
+    walk(jaxpr, g)
 
     graph_outputs = []
     for i, v in enumerate(jaxpr.outvars):
-        name = ref(v)
+        name = ref(v, g)
         graph_outputs.append(_value_info(name, v.aval.shape, v.aval.dtype))
 
-    graph = b""
-    for n in g.nodes:
-        graph += W.f_message(1, n)
-    graph += W.f_string(2, "paddle_tpu_graph")
-    for t in g.initializers:
-        graph += W.f_message(5, t)
-    for vi in graph_inputs:
-        graph += W.f_message(11, vi)
-    for vo in graph_outputs:
-        graph += W.f_message(12, vo)
+    graph = _assemble_graph(g, graph_inputs, graph_outputs)
 
     opset = W.f_string(1, "") + W.f_varint(2, 13)
     model = W.f_varint(1, 8)  # ir_version

@@ -23,11 +23,8 @@ _DT_NP = {1: np.float32, 2: np.uint8, 3: np.int8, 4: np.uint16,
           10: np.float16, 11: np.float64, 12: np.uint32, 13: np.uint64}
 
 
-def parse_model(data: bytes) -> dict:
-    m = W.decode_message(data)
-    assert m[1][0] == 8  # ir_version
-    opsets = [W.decode_message(b) for b in m.get(8, [])]
-    graph = W.decode_message(m[7][0])
+def parse_graph(gb: bytes) -> dict:
+    graph = W.decode_message(gb)
     nodes = []
     for nb in graph.get(1, []):
         n = W.decode_message(nb)
@@ -43,6 +40,8 @@ def parse_model(data: bytes) -> dict:
                                for v in a.get(8, [])]
             elif atype == 1:  # FLOAT
                 attrs[name] = a[2][0]
+            elif atype == 5:  # GRAPH (If branches, Loop body)
+                attrs[name] = parse_graph(a[6][0])
         nodes.append({
             "op": n[4][0].decode(),
             "inputs": [b.decode() for b in n.get(1, [])],
@@ -60,12 +59,20 @@ def parse_model(data: bytes) -> dict:
         v = W.decode_message(b)
         return v[1][0].decode()
     return {
-        "opset": {o[1][0].decode(): o[2][0] for o in opsets},
         "nodes": nodes,
         "initializers": inits,
         "inputs": [vi(b) for b in graph.get(11, [])],
         "outputs": [vi(b) for b in graph.get(12, [])],
     }
+
+
+def parse_model(data: bytes) -> dict:
+    m = W.decode_message(data)
+    assert m[1][0] == 8  # ir_version
+    opsets = [W.decode_message(b) for b in m.get(8, [])]
+    out = parse_graph(m[7][0])
+    out["opset"] = {o[1][0].decode(): o[2][0] for o in opsets}
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -112,15 +119,45 @@ def _pool(x, attrs, mode):
     return out
 
 
-def run_graph(model: dict, feeds: dict) -> list:
+def run_graph(model: dict, feeds: dict, outer_env: dict | None = None) -> list:
     import math
 
-    env = dict(model["initializers"])
+    # ONNX subgraphs (If branches, Loop bodies) see the enclosing scope;
+    # locals/initializers/feeds shadow it
+    env = dict(outer_env) if outer_env else {}
+    env.update(model["initializers"])
     env.update(feeds)
     for n in model["nodes"]:
-        i = [env[x] for x in n["inputs"]]
         a = n["attrs"]
         op = n["op"]
+        if op == "If":
+            pred = bool(np.asarray(env[n["inputs"][0]]).reshape(()))
+            chosen = a["then_branch"] if pred else a["else_branch"]
+            for o_name, val in zip(n["outputs"],
+                                   run_graph(chosen, {}, env)):
+                env[o_name] = val
+            continue
+        if op == "Loop":
+            m_in = n["inputs"][0]
+            trip_max = (None if m_in == ""
+                        else int(np.asarray(env[m_in]).reshape(())))
+            cond = bool(np.asarray(env[n["inputs"][1]]).reshape(()))
+            vs = [env[x] for x in n["inputs"][2:]]
+            body = a["body"]
+            it = 0
+            while cond and (trip_max is None or it < trip_max):
+                fb = {body["inputs"][0]: np.asarray(it, np.int64),
+                      body["inputs"][1]: np.asarray(cond)}
+                for nm, v in zip(body["inputs"][2:], vs):
+                    fb[nm] = v
+                res = run_graph(body, fb, env)
+                cond = bool(np.asarray(res[0]).reshape(()))
+                vs = res[1:]
+                it += 1
+            for o_name, val in zip(n["outputs"], vs):
+                env[o_name] = val
+            continue
+        i = [env[x] for x in n["inputs"]]
         if op == "MatMul":
             out = i[0] @ i[1]
         elif op == "Add":
@@ -492,6 +529,107 @@ class TestOnnxExport:
         got = run_graph(model, {"input_0": np.asarray(x.value)})[0]
         want = -np.sort(-np.asarray(x.value), axis=1)[:, :3]
         np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_cond_exports_as_if(self, tmp_path):
+        """lax.cond → ONNX If; both predicate values run correctly on the
+        independent interpreter (reference conditional_block_op role)."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        def f(x):
+            v = x.value  # export passes Tensors; lax wants raw arrays
+            return lax.cond(jnp.sum(v) > 0.0,
+                            lambda u: u * 2.0,
+                            lambda u: u - 1.0, v)
+
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        p = export(f, str(tmp_path / "cond.onnx"), input_spec=[x])
+        with open(p, "rb") as fh:
+            model = parse_model(fh.read())
+        assert any(n["op"] == "If" for n in model["nodes"])
+        for xv in (np.ones((2, 3), np.float32),
+                   -np.ones((2, 3), np.float32)):
+            got = run_graph(model, {"input_0": xv})[0]
+            want = xv * 2.0 if xv.sum() > 0 else xv - 1.0
+            np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_switch_exports_as_if_chain(self, tmp_path):
+        """lax.switch (N=3) → chained If; index clamping matches jax."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        def f(idx, x):
+            i, v = idx.value, x.value
+            return lax.switch(i, [lambda u: u + 10.0,
+                                  lambda u: u * 3.0,
+                                  lambda u: -u], v)
+
+        idx = paddle.to_tensor(np.asarray(1, np.int32))
+        x = paddle.to_tensor(np.arange(4, dtype=np.float32))
+        p = export(f, str(tmp_path / "switch.onnx"), input_spec=[idx, x])
+        with open(p, "rb") as fh:
+            model = parse_model(fh.read())
+        xv = np.arange(4, dtype=np.float32)
+        import jax
+
+        for i in (-2, 0, 1, 2, 7):  # out-of-range indices clamp, as in jax
+            got = run_graph(model, {"input_0": np.asarray(i, np.int32),
+                                    "input_1": xv})[0]
+            want = np.asarray(jax.jit(
+                lambda j, u: lax.switch(j, [lambda a: a + 10.0,
+                                            lambda a: a * 3.0,
+                                            lambda a: -a], u))(
+                np.int32(i), xv))
+            np.testing.assert_allclose(got, want, rtol=1e-6, err_msg=str(i))
+
+    def test_while_exports_as_loop(self, tmp_path):
+        """lax.while_loop → ONNX Loop (reference while_op role), including
+        the zero-iteration case (cond false at entry)."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        def f(n, x):
+            nv, xv = n.value, x.value
+
+            def body(c):
+                i, v = c
+                return i + 1, v * 1.5
+
+            return lax.while_loop(lambda c: c[0] < nv, body,
+                                  (jnp.zeros((), jnp.int32), xv))[1]
+
+        n = paddle.to_tensor(np.asarray(4, np.int32))
+        x = paddle.to_tensor(np.ones((3,), np.float32))
+        p = export(f, str(tmp_path / "while.onnx"), input_spec=[n, x])
+        with open(p, "rb") as fh:
+            model = parse_model(fh.read())
+        assert any(n_["op"] == "Loop" for n_ in model["nodes"])
+        xv = np.ones((3,), np.float32)
+        for nv in (4, 0):  # 0 = loop body never runs
+            got = run_graph(model, {"input_0": np.asarray(nv, np.int32),
+                                    "input_1": xv})[0]
+            np.testing.assert_allclose(got, xv * 1.5 ** nv, rtol=1e-6,
+                                       err_msg=str(nv))
+
+    def test_dy2static_while_exports(self, tmp_path):
+        """The full chain: a Python while over tensor state converts via
+        dy2static into lax.while_loop and exports as ONNX Loop."""
+        from paddle_tpu.jit import to_static
+
+        @to_static
+        def f(x):
+            s = paddle.zeros([1], "float32")
+            while paddle.sum(s) < 10.0:
+                s = s + x
+            return s
+
+        x = paddle.to_tensor(np.asarray([3.0], np.float32))
+        p = export(f, str(tmp_path / "d2s_while.onnx"), input_spec=[x])
+        with open(p, "rb") as fh:
+            model = parse_model(fh.read())
+        assert any(n_["op"] == "Loop" for n_ in model["nodes"])
+        got = run_graph(model, {"input_0": np.asarray([3.0], np.float32)})[0]
+        np.testing.assert_allclose(got, [12.0], rtol=1e-6)  # 3,6,9,12
 
     def test_unsupported_primitive_is_loud(self, tmp_path):
         def weird(x):
