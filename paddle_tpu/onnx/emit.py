@@ -11,9 +11,14 @@ space (arbitrary batch/contract dims via transpose+flatten+MatMul), conv
 (conv_general_dilated, NCHW/OIHW, loud on transposed/grouped-batch forms),
 elementwise math, activations, reductions, argmax/argmin, shape ops, casts,
 select/clamp, gather (embedding take), slice/dynamic_slice, concatenate,
-iota (constant-folded), and lax.scan (UNROLLED — static trip count, weights
+iota (constant-folded), lax.scan (UNROLLED — static trip count, weights
 sliced via Gather), which is what lets GPT/BERT-class encoders with their
-scan-over-blocks export.  Anything else raises with the primitive's name so
+scan-over-blocks export, plus real control flow: lax.cond / lax.switch →
+ONNX If (chained, jax index clamping preserved) and lax.while_loop →
+ONNX Loop (reference conditional_block/while_op roles,
+operators/controlflow/) — so dy2static-converted tensor-dependent
+branches and loops export too (the StaticFunction PRNG chain
+const-folds).  Anything else raises with the primitive's name so
 the gap is loud, not a corrupt file.
 
 ONNX field numbers follow onnx/onnx.proto (public, stable since IR v3).
