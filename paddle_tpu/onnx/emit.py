@@ -435,6 +435,49 @@ def _lower_dynamic_slice(g, eqn, ins):
     return g.add("Slice", [ins[0], starts, ends, axes], hint="dynslice")
 
 
+def _lower_dynamic_update_slice(g, eqn, ins):
+    """Block write at runtime offsets → ScatterND (the KV-cache decode
+    write: reference while_op + assign-slice role).  Per-dim: clamp the
+    start into [0, dim - update_dim] (jax semantics), Range over the
+    update extent, broadcast each dim's positions to the update shape, and
+    stack them into [*update.shape, rank] indices.  Index volume is
+    rank * prod(update.shape) — fine for the row-sized updates this
+    exists for."""
+    op_aval = eqn.invars[0].aval
+    up_aval = eqn.invars[1].aval
+    data, update = ins[0], ins[1]
+    r = len(op_aval.shape)
+    if r == 0:  # rank-0: the update IS the result
+        return g.add("Identity", [update], hint="dus")
+    zero = g.const(np.asarray(0, np.int64), "zero")
+    one = g.const(np.asarray(1, np.int64), "one")
+    eshape = g.const(np.asarray(up_aval.shape, np.int64), "upshape")
+    parts = []
+    for d in range(r):
+        s64 = g.add("Cast", [ins[2 + d]],
+                    attrs=_attr_int("to", _DT["int64"]), hint="start64")
+        lim = g.const(np.asarray(int(op_aval.shape[d])
+                                 - int(up_aval.shape[d]), np.int64), "lim")
+        sc = g.add("Min", [g.add("Max", [s64, zero], hint="smax"), lim],
+                   hint="sclamp")
+        rng = g.add("Range", [zero,
+                              g.const(np.asarray(int(up_aval.shape[d]),
+                                                 np.int64), "ext"), one],
+                    hint="range")
+        rng = g.add("Add", [rng, sc], hint="rowpos")
+        shape_d = [1] * r
+        shape_d[d] = int(up_aval.shape[d])
+        rng = _lower_reshape_to(g, rng, shape_d)
+        rng = g.add("Expand", [rng, eshape], hint="posgrid")
+        rng = g.add("Unsqueeze",
+                    [rng, g.const(np.asarray([r], np.int64), "ax")],
+                    hint="poscol")
+        parts.append(rng)
+    indices = (g.add("Concat", parts, attrs=_attr_int("axis", r),
+                     hint="dusidx") if r > 1 else parts[0])
+    return g.add("ScatterND", [data, indices, update], hint="dus")
+
+
 def _arg_reduce(op):
     def f(g, eqn, ins):
         p = eqn.params
@@ -520,6 +563,7 @@ _LOWER = {
     "iota": _lower_iota,
     "concatenate": _lower_concatenate,
     "dynamic_slice": _lower_dynamic_slice,
+    "dynamic_update_slice": _lower_dynamic_update_slice,
     "argmax": _arg_reduce("ArgMax"),
     "argmin": _arg_reduce("ArgMin"),
     "clamp": _lower_clamp,

@@ -261,6 +261,18 @@ def run_graph(model: dict, feeds: dict, outer_env: dict | None = None) -> list:
             if a.get("reverse"):
                 out = np.flip(out, ax)
             assert not a.get("exclusive")
+        elif op == "Range":
+            out = np.arange(int(np.asarray(i[0])), int(np.asarray(i[1])),
+                            int(np.asarray(i[2])), dtype=np.int64)
+        elif op == "Unsqueeze":
+            out = np.expand_dims(i[0], tuple(int(v) for v in i[1]))
+        elif op == "ScatterND":
+            out = i[0].copy()
+            k = i[1].shape[-1]
+            flat_idx = i[1].reshape(-1, k)
+            flat_upd = i[2].reshape(-1, *i[0].shape[k:])
+            for j in range(flat_idx.shape[0]):
+                out[tuple(flat_idx[j])] = flat_upd[j]
         elif op == "And":
             out = np.logical_and(i[0], i[1])
         elif op == "Or":
@@ -630,6 +642,80 @@ class TestOnnxExport:
         assert any(n_["op"] == "Loop" for n_ in model["nodes"])
         got = run_graph(model, {"input_0": np.asarray([3.0], np.float32)})[0]
         np.testing.assert_allclose(got, [12.0], rtol=1e-6)  # 3,6,9,12
+
+    def test_dynamic_update_slice_exports(self, tmp_path):
+        """lax.dynamic_update_slice → ScatterND, including jax's
+        start-clamping semantics."""
+        from jax import lax
+
+        def f(x, u, p):
+            return lax.dynamic_update_slice(
+                x.value, u.value, (p.value, np.int32(1)))
+
+        x = paddle.to_tensor(np.zeros((5, 4), np.float32))
+        u = paddle.to_tensor(np.ones((2, 2), np.float32))
+        p = paddle.to_tensor(np.asarray(1, np.int32))
+        path = export(f, str(tmp_path / "dus.onnx"), input_spec=[x, u, p])
+        with open(path, "rb") as fh:
+            model = parse_model(fh.read())
+        xv = np.zeros((5, 4), np.float32)
+        uv = np.ones((2, 2), np.float32)
+        import jax
+
+        for pv in (1, 0, 7, -3):  # 7/-3 clamp to 3/0, as in jax
+            got = run_graph(model, {"input_0": xv, "input_1": uv,
+                                    "input_2": np.asarray(pv, np.int32)})[0]
+            want = np.asarray(jax.jit(
+                lambda a, b, q: lax.dynamic_update_slice(
+                    a, b, (q, np.int32(1))))(xv, uv, np.int32(pv)))
+            np.testing.assert_allclose(got, want, err_msg=str(pv))
+
+    def test_kv_cache_decode_step_exports(self, tmp_path):
+        """The WHOLE autoregressive serving unit: one KV-cache decode step
+        (gather embed, per-layer cached attention, cache write at pos via
+        dynamic_update_slice, logits head) exports and reproduces the
+        framework's decode_step exactly."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.text import gpt
+        from paddle_tpu.text.generate import decode_step, init_cache
+
+        cfg = gpt.GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                            num_heads=2, max_seq_len=16,
+                            dtype=jnp.float32)
+        import jax
+
+        params = gpt.init_params(cfg, jax.random.PRNGKey(3))
+        cache0 = init_cache(cfg, 1, 16)
+
+        def f(tok, pos, ck, cv):
+            logits, new_cache = decode_step(
+                params, {"k": ck.value, "v": cv.value},
+                tok.value, pos.value, cfg)
+            return logits
+
+        tok = paddle.to_tensor(np.asarray([5], np.int32))
+        pos = paddle.to_tensor(np.asarray(3, np.int32))
+        ck = paddle.to_tensor(np.asarray(cache0["k"]))
+        cv = paddle.to_tensor(np.asarray(cache0["v"]))
+        path = export(f, str(tmp_path / "decode.onnx"),
+                      input_spec=[tok, pos, ck, cv])
+        with open(path, "rb") as fh:
+            model = parse_model(fh.read())
+        # simulate three decode steps through the EXPORTED graph, feeding
+        # the framework's own evolving cache (logits parity at each pos)
+        cache = cache0
+        for i, t in enumerate((5, 9, 2)):
+            got = run_graph(model, {
+                "input_0": np.asarray([t], np.int32),
+                "input_1": np.asarray(i, np.int32),
+                "input_2": np.asarray(cache["k"]),
+                "input_3": np.asarray(cache["v"])})[0]
+            want, cache = decode_step(params, cache,
+                                      jnp.asarray([t], jnp.int32),
+                                      jnp.asarray(i, jnp.int32), cfg)
+            np.testing.assert_allclose(got, np.asarray(want), rtol=2e-4,
+                                       atol=2e-5, err_msg=f"step {i}")
 
     def test_unsupported_primitive_is_loud(self, tmp_path):
         def weird(x):
