@@ -458,8 +458,8 @@ def _lower_dynamic_update_slice(g, eqn, ins):
                     attrs=_attr_int("to", _DT["int64"]), hint="start64")
         lim = g.const(np.asarray(int(op_aval.shape[d])
                                  - int(up_aval.shape[d]), np.int64), "lim")
-        sc = g.add("Min", [g.add("Max", [s64, zero], hint="smax"), lim],
-                   hint="sclamp")
+        # same clamp form as _lower_dynamic_slice (jax start semantics)
+        sc = g.add("Clip", [s64, zero, lim], hint="sclamp")
         rng = g.add("Range", [zero,
                               g.const(np.asarray(int(up_aval.shape[d]),
                                                  np.int64), "ext"), one],

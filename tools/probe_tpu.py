@@ -161,8 +161,11 @@ def _payload_steps():
          None, None),
         ("ladder", [py, bench], 5400, {"BENCH_RUNG_TIMEOUT": "540"},
          None, None),
+        # --all reuses the ladder step's fresh GPT headline instead of
+        # re-measuring the whole ladder inside the same window
         ("all", [py, bench, "--all"], 7200,
-         {"BENCH_RUNG_TIMEOUT": "540"}, None, None),
+         {"BENCH_RUNG_TIMEOUT": "540", "BENCH_REUSE_LADDER": "1"},
+         None, None),
         ("noflash", [py, bench], 2700,
          {"PADDLE_TPU_NO_FLASH": "1", "BENCH_RUNG_TIMEOUT": "480"},
          os.path.join(REPO, "noflash.json"), None),
@@ -213,9 +216,13 @@ def _run_step(name, argv, timeout, env, out_json, log):
     # left holding a hung remote compile keeps the tunnel wedged for every
     # later watchdog window (the exact failure the watchdog exists to ride
     # out)
+    # persistent XLA compilation cache: a rung compiled in window 1 loads
+    # instantly in window 2 — compile time dominates short healthy windows
+    cache_env = {"JAX_COMPILATION_CACHE_DIR":
+                 os.path.join(REPO, ".jax_cache")}
     proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
                             stderr=subprocess.PIPE, text=True, cwd=REPO,
-                            env=dict(os.environ, **env),
+                            env=dict(os.environ, **cache_env, **env),
                             start_new_session=True)
     try:
         stdout, stderr = proc.communicate(timeout=timeout)
