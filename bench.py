@@ -682,20 +682,23 @@ def main():
 
     results = {}
     reuse = None
-    # same plain-run guard as the watchdog-replay fallback below: the
-    # ladder headline can only stand in for a run that asked for exactly
-    # the ladder's configuration (full-size, flash on)
-    if (run_all and os.environ.get("BENCH_REUSE_LADDER", "") == "1"
-            and not small and not _no_flash_requested()):
+    # plain-run guard (same condition as the watchdog-replay fallback
+    # below): the ladder headline can only stand in for a run that asked
+    # for exactly the ladder's configuration (full-size, flash on) — AND
+    # only when the ladder was measured in THIS healthy window (the
+    # watchdog exports the window-open time; a 20h-old headline from a
+    # previous window must be re-measured, not replayed)
+    window_opened = os.environ.get("WATCHDOG_WINDOW_OPENED", "")
+    if (run_all and which is None
+            and os.environ.get("BENCH_REUSE_LADDER", "") == "1"
+            and window_opened and not small
+            and not _no_flash_requested()):
         wd = _watchdog_tpu_result()
-        if wd is not None:
-            # the watchdog just measured the ladder in this same healthy
-            # window; re-running ~15 min of GPT rungs inside --all would
-            # only burn the window
+        if wd is not None and str(wd.get("measured_at")) >= window_opened:
             _log("[bench] --all: reusing the watchdog ladder GPT headline "
-                 f"measured at {wd.get('measured_at')}")
-            reuse = dict(wd["headline"], measured_at=wd.get("measured_at"),
-                         source="watchdog_ladder_reuse")
+                 f"measured at {wd.get('measured_at')} (window opened "
+                 f"{window_opened})")
+            reuse = _headline_from_watchdog(wd, "watchdog_ladder_reuse")
     if which:
         results[which] = _CONFIGS[which](small)
     elif run_all:
@@ -733,9 +736,7 @@ def main():
             # replay that measured number rather than reporting a CPU zero
             _log("[bench] tunnel wedged now, but the watchdog measured a "
                  f"TPU result at {wd.get('measured_at')}; replaying it")
-            line = dict(wd["headline"])
-            line["measured_at"] = wd.get("measured_at")
-            line["source"] = "tpu_watchdog"
+            line = _headline_from_watchdog(wd, "tpu_watchdog")
         else:
             line["metric"] += "_cpu_fallback"
             line["vs_baseline"] = 0.0
@@ -747,6 +748,11 @@ def main():
 
 def _no_flash_requested() -> bool:
     return os.environ.get("PADDLE_TPU_NO_FLASH", "") not in ("", "0")
+
+
+def _headline_from_watchdog(wd, source):
+    return dict(wd["headline"], measured_at=wd.get("measured_at"),
+                source=source)
 
 
 def _watchdog_tpu_result():

@@ -85,17 +85,23 @@ def _conv(x, w, attrs):
     pads = attrs.get("pads", [0, 0, 0, 0])
     d = attrs.get("dilations", [1, 1])
     g = attrs.get("group", 1)
-    assert d == [1, 1] and g == 1
+    assert d == [1, 1]
     B, C, H, Wd = x.shape
-    O, _, kh, kw = w.shape
+    O, Cg, kh, kw = w.shape  # per-group input channels
+    assert C == Cg * g and O % g == 0, (C, Cg, O, g)  # loud on bad attrs
     xp = np.pad(x, ((0, 0), (0, 0), (pads[0], pads[2]), (pads[1], pads[3])))
     Ho = (xp.shape[2] - kh) // s[0] + 1
     Wo = (xp.shape[3] - kw) // s[1] + 1
     out = np.zeros((B, O, Ho, Wo), np.float64)
+    Og = O // g
     for i in range(Ho):
         for j in range(Wo):
             patch = xp[:, :, i * s[0]:i * s[0] + kh, j * s[1]:j * s[1] + kw]
-            out[:, :, i, j] = np.einsum("bchw,ochw->bo", patch, w)
+            for gi in range(g):  # grouped/depthwise: per-group einsum
+                pg = patch[:, gi * Cg:(gi + 1) * Cg]
+                wg = w[gi * Og:(gi + 1) * Og]
+                out[:, gi * Og:(gi + 1) * Og, i, j] = np.einsum(
+                    "bchw,ochw->bo", pg, wg)
     return out.astype(x.dtype)
 
 
@@ -446,7 +452,7 @@ class TestOnnxExport:
         verdict Weak #6: per-model support must be a stated matrix, not
         per-model luck): every entry exports AND matches numerically
         through the independent interpreter."""
-        from paddle_tpu.vision.models import LeNet
+        from paddle_tpu.vision.models import LeNet, mobilenet_v1
 
         paddle.seed(9)
         zoo = {
@@ -457,11 +463,22 @@ class TestOnnxExport:
             "lenet": (LeNet(),
                       np.random.default_rng(1).standard_normal(
                           (2, 1, 28, 28)).astype(np.float32)),
+            # depthwise/grouped conv rides ONNX Conv's group attribute
+            "mobilenet_v1": (mobilenet_v1(),
+                             np.random.default_rng(2).standard_normal(
+                                 (1, 3, 32, 32)).astype(np.float32)),
         }
         for name, (net, x) in zoo.items():
             net.eval()
             _roundtrip(net, [paddle.to_tensor(x)],
                        tmp_path / f"zoo_{name}.onnx")
+        # the rest of the stated matrix lives in dedicated tests:
+        #   resnet18             test_resnet18_exports_and_matches
+        #   gpt-small (encoder)  test_gpt_small_exports_and_matches
+        #   bert encoder         test_bert_encoder_exports_and_matches
+        #   gpt decode step (KV) test_kv_cache_decode_step_exports
+        #   control flow         test_cond/switch/while/dy2static_while
+        # — keep this list in sync when extending the zoo
 
     def test_argmax_concat_export(self, tmp_path):
         def head(x):

@@ -209,7 +209,7 @@ def _load_results() -> dict:
         return {"steps": {}, "windows": []}
 
 
-def _run_step(name, argv, timeout, env, out_json, log):
+def _run_step(name, argv, timeout, env, out_json, log, window_opened=""):
     rec = {"started": _now(), "argv": argv, "timeout_s": timeout}
     # start_new_session: a step timeout must kill the WHOLE process group —
     # bench.py runs each rung in its own grandchild, and an orphaned rung
@@ -219,7 +219,10 @@ def _run_step(name, argv, timeout, env, out_json, log):
     # persistent XLA compilation cache: a rung compiled in window 1 loads
     # instantly in window 2 — compile time dominates short healthy windows
     cache_env = {"JAX_COMPILATION_CACHE_DIR":
-                 os.path.join(REPO, ".jax_cache")}
+                 os.path.join(REPO, ".jax_cache"),
+                 # lets bench's --all ladder-reuse verify the ladder
+                 # headline was measured in THIS window, not a stale one
+                 "WATCHDOG_WINDOW_OPENED": window_opened}
     proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
                             stderr=subprocess.PIPE, text=True, cwd=REPO,
                             env=dict(os.environ, **cache_env, **env),
@@ -280,7 +283,8 @@ def watch(interval: float, probe_timeout: float, max_hours: float):
             f"detail={e['detail']}")
         consecutive_fails = 0 if e["ok"] else consecutive_fails + 1
         if e["ok"]:
-            data["windows"].append({"opened": _now()})
+            window_opened = _now()
+            data["windows"].append({"opened": window_opened})
             # a kernel-source edit invalidates past certification AND past
             # A/B measurements: reopen the steps whose recorded success no
             # longer matches the current sources, else _step_resolved would
@@ -320,7 +324,8 @@ def watch(interval: float, probe_timeout: float, max_hours: float):
                         f"not certified for current sources) — skipped, "
                         f"attempt not counted")
                     continue
-                rec = _run_step(name, argv, to, env, out_json, log)
+                rec = _run_step(name, argv, to, env, out_json, log,
+                                window_opened=window_opened)
                 rec["attempts"] = prev.get("attempts", 0) + 1
                 data["steps"][name] = rec
                 _save_results(data)
