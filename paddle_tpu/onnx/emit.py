@@ -540,6 +540,7 @@ _LOWER = {
     "log": _ew("Log"), "tanh": _ew("Tanh"), "logistic": _ew("Sigmoid"),
     "sqrt": _ew("Sqrt"), "rsqrt": None, "abs": _ew("Abs"),
     "sign": _ew("Sign"), "floor": _ew("Floor"), "ceil": _ew("Ceil"),
+    "round": _ew("Round"),  # jax round_nearest_even == ONNX Round
     "erf": _ew("Erf"), "pow": _ew("Pow"), "max": _lower_max,
     "min": _ew("Min"), "stop_gradient": _ew("Identity"),
     "copy": _ew("Identity"),
@@ -624,6 +625,49 @@ def _lower_reduce_sum13(g, eqn, ins):
     axes = g.const(np.asarray(eqn.params["axes"], np.int64), "axes")
     return g.add("ReduceSum", [ins[0], axes],
                  attrs=_attr_int("keepdims", 0), hint="reducesum")
+
+
+def _match_qdq(closed_call):
+    """Recognize the STE fake-quant body (quantization/quant_layers.py
+    ``_ste_quant_dequant``): (x, scale) -> round/clamp chain -> x-shaped
+    output, with a qmax literal multiplied in and divided back out AND
+    round + clamp(±qmax) actually present (an unrelated custom_vjp that
+    merely rescales by the same literal must NOT be rewritten).  Only the
+    int8 range (qmax == 127) is emitted — a wider-bits fake-quant falls
+    back to exact inline math rather than saturating int8 tensors.
+    Returns qmax or None."""
+    jx = getattr(closed_call, "jaxpr", closed_call)
+    if len(jx.invars) != 2 or len(jx.outvars) != 1:
+        return None
+    if jx.invars[0].aval.shape != jx.outvars[0].aval.shape:
+        return None
+    from jax._src.core import Literal
+
+    prims: set = set()
+    lits: list = []
+
+    def collect(j):
+        for e in j.eqns:
+            prims.add(e.primitive.name)
+            for v in e.invars:
+                if isinstance(v, Literal) and np.ndim(v.val) == 0:
+                    lits.append((e.primitive.name, float(v.val)))
+            for pv in e.params.values():
+                inner = getattr(pv, "jaxpr", None)
+                if inner is not None:
+                    collect(getattr(inner, "jaxpr", inner))
+
+    collect(jx)
+    has_clamp = "clamp" in prims or ("max" in prims and "min" in prims)
+    if not any(p.startswith("round") for p in prims) or not has_clamp:
+        return None
+    muls = {v for p, v in lits if p == "mul" and v > 1}
+    divs = {v for p, v in lits if p == "div" and v > 1}
+    all_vals = {v for _, v in lits}
+    for q in muls & divs:
+        if q == 127.0 and -q in all_vals and q in all_vals:
+            return q
+    return None
 
 
 def _assemble_graph(g: _Graph, graph_inputs, graph_outputs,
@@ -717,6 +761,26 @@ def emit_model(fn, example_args, producer="paddle_tpu") -> bytes:
                 arg_vars = (eqn.invars[len(inner_consts):]
                             if len(inner_jaxpr.invars) != len(eqn.invars)
                             else eqn.invars)
+                qmax = (_match_qdq(inner)
+                        if prim.startswith("custom_vjp") else None)
+                if qmax is not None:
+                    # STE fake-quant → REAL ONNX QDQ: the deploy form the
+                    # reference reaches via mkldnn/TRT int8.  Clip to
+                    # [-scale, scale] first so int8 saturation at -128
+                    # can never disagree with the framework's ±qmax clip;
+                    # inside that range round-half-even matches exactly.
+                    x_nm, s_nm = [ref(v, g) for v in arg_vars[-2:]]
+                    neg_s = g.add("Neg", [s_nm], hint="negscale")
+                    xc = g.add("Clip", [x_nm, neg_s, s_nm], hint="qclip")
+                    y_scale = g.add(
+                        "Div", [s_nm, g.const(np.asarray(qmax, np.float32),
+                                              "qmax")], hint="yscale")
+                    zp = g.const(np.asarray(0, np.int8), "zp")
+                    q = g.add("QuantizeLinear", [xc, y_scale, zp],
+                              hint="quant")
+                    env[eqn.outvars[0]] = g.add(
+                        "DequantizeLinear", [q, y_scale, zp], hint="deq")
+                    continue
                 outs = inline(
                     types.SimpleNamespace(jaxpr=inner_jaxpr,
                                           consts=inner_consts),

@@ -267,6 +267,17 @@ def run_graph(model: dict, feeds: dict, outer_env: dict | None = None) -> list:
             if a.get("reverse"):
                 out = np.flip(out, ax)
             assert not a.get("exclusive")
+        elif op == "Round":
+            out = np.rint(i[0])  # half-to-even, matching jax/ONNX
+        elif op == "QuantizeLinear":
+            ys = np.asarray(i[1], np.float32)
+            zp = np.asarray(i[2]).astype(np.int32)
+            out = np.clip(np.rint(i[0] / ys).astype(np.int32) + zp,
+                          -128, 127).astype(np.int8)
+        elif op == "DequantizeLinear":
+            out = ((i[0].astype(np.int32)
+                    - np.asarray(i[2]).astype(np.int32))
+                   .astype(np.float32) * np.asarray(i[1], np.float32))
         elif op == "Range":
             out = np.arange(int(np.asarray(i[0])), int(np.asarray(i[1])),
                             int(np.asarray(i[2])), dtype=np.int64)
@@ -733,6 +744,31 @@ class TestOnnxExport:
                                       jnp.asarray(i, jnp.int32), cfg)
             np.testing.assert_allclose(got, np.asarray(want), rtol=2e-4,
                                        atol=2e-5, err_msg=f"step {i}")
+
+    def test_qat_model_exports_as_qdq(self, tmp_path):
+        """A QAT-converted net exports with REAL QuantizeLinear /
+        DequantizeLinear pairs (the reference's int8 deploy endpoint via
+        mkldnn/TRT), numerically exact vs the framework's fake-quant."""
+        from paddle_tpu.quantization import ImperativeQuantAware
+
+        paddle.seed(11)
+        net = nn.Sequential(nn.Linear(6, 8), nn.ReLU(), nn.Linear(8, 3))
+        ImperativeQuantAware(bits=8).quantize(net)
+        x = np.random.default_rng(4).standard_normal((5, 6)).astype(
+            np.float32)
+        # a calibration pass populates the moving-average act scales
+        net(paddle.to_tensor(x))
+        net.eval()
+        p = export(net, str(tmp_path / "qat.onnx"),
+                   input_spec=[paddle.to_tensor(x)])
+        with open(p, "rb") as fh:
+            model = parse_model(fh.read())
+        n_q = sum(n["op"] == "QuantizeLinear" for n in model["nodes"])
+        n_d = sum(n["op"] == "DequantizeLinear" for n in model["nodes"])
+        assert n_q == n_d and n_q >= 4, (n_q, n_d)  # 2 layers x (act + w)
+        got = run_graph(model, {"input_0": x})[0]
+        want = np.asarray(net(paddle.to_tensor(x)).value)
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
 
     def test_unsupported_primitive_is_loud(self, tmp_path):
         def weird(x):
