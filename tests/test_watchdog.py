@@ -1,0 +1,209 @@
+"""The unattended TPU measurement loop (tools/probe_tpu.py watch mode).
+
+The watchdog is how a missing TPU number becomes either a measured number
+or attributable infra evidence (round-3 verdict Next #1), so its control
+flow is tested like product code: windows, retries, gating, backoff, and
+the resume/reopen rules — with probe() and the payload subprocesses faked.
+"""
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def pt(tmp_path, monkeypatch):
+    """A fresh probe_tpu module instance whose state files live in tmp."""
+    spec = importlib.util.spec_from_file_location(
+        "probe_tpu_under_test", os.path.join(REPO, "tools", "probe_tpu.py"))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    monkeypatch.setattr(m, "RESULTS", str(tmp_path / "WD.json"))
+    monkeypatch.setattr(m, "LOG", str(tmp_path / "probe.jsonl"))
+    # REPO too: tests must never write provenance files (kernel_ab_*.json)
+    # into the real repo root
+    monkeypatch.setattr(m, "REPO", str(tmp_path))
+    # fake clock: sleeps advance it instantly, so max_hours deadlines are
+    # exercised without wall time passing
+    m._sleeps = []
+    m._clock = [0.0]
+
+    def _sleep(s):
+        m._sleeps.append(s)
+        m._clock[0] += s
+
+    monkeypatch.setattr(m.time, "sleep", _sleep)
+    monkeypatch.setattr(m.time, "monotonic", lambda: m._clock[0])
+    return m
+
+
+def _fake_steps(m, names, gates=None):
+    steps = [(n, ["true"], 60, {}, None, (gates or {}).get(n))
+             for n in names]
+    m._payload_steps = lambda: steps
+    return steps
+
+
+def _probe_seq(m, outcomes):
+    """probe() returns ok per the given sequence, then keeps failing."""
+    it = iter(outcomes)
+
+    def fake_probe(timeout, source="watchdog"):
+        ok = next(it, False)
+        return {"ts": m._now(), "ok": ok, "elapsed_s": 0.0,
+                "source": source, "detail": {} if ok else "wedged"}
+
+    m.probe = fake_probe
+
+
+def _runner(results_by_name):
+    """Fake _run_step: returns canned records, tracking call order."""
+    calls = []
+
+    def run(name, argv, timeout, env, out_json, log, window_opened=""):
+        calls.append(name)
+        rec = dict(results_by_name.get(name, {"ok": True, "rc": 0}))
+        return rec
+
+    return run, calls
+
+
+def test_window_runs_steps_in_order_and_exits(pt):
+    _fake_steps(pt, ["a", "b", "c"])
+    _probe_seq(pt, [True])
+    run, calls = _runner({})
+    pt._run_step = run
+    rc = pt.watch(interval=1, probe_timeout=1, max_hours=1)
+    assert calls == ["a", "b", "c"]
+    data = json.load(open(pt.RESULTS))
+    assert all(data["steps"][n]["ok"] for n in "abc")
+    assert len(data["windows"]) == 1
+    # exit code keys on the ladder step, absent here -> nonzero
+    assert rc == 1
+
+
+def test_failed_step_retried_next_window_only_it(pt):
+    _fake_steps(pt, ["a", "b"])
+    _probe_seq(pt, [True, True])
+    outcomes = {"b": {"ok": False, "rc": 1}}
+    run, calls = _runner(outcomes)
+    pt._run_step = run
+    # first window: a ok, b fails; make b succeed for the second window
+    orig_run = run
+
+    def run2(name, *a, **k):
+        rec = orig_run(name, *a, **k)
+        if name == "b" and calls.count("b") >= 2:
+            rec = {"ok": True, "rc": 0}
+        return rec
+
+    pt._run_step = run2
+    pt.watch(interval=1, probe_timeout=1, max_hours=1)
+    # a ran once (ok skips it in window 2); b ran twice
+    assert calls.count("a") == 1 and calls.count("b") == 2
+
+
+def test_step_timeout_closes_window_and_engages_backoff(pt):
+    _fake_steps(pt, ["a", "b"])
+    _probe_seq(pt, [True, False])
+    run, calls = _runner({"a": {"ok": False, "rc": None,
+                                "error": "timeout after 60s"}})
+    pt._run_step = run
+    pt.watch(interval=300, probe_timeout=1, max_hours=0.5)
+    # b never ran: the timed-out step closed the window
+    assert calls == ["a"]
+    # and the very next sleep is the long backoff, not the fast interval
+    # (the killed step itself likely re-wedged the tunnel)
+    assert pt._sleeps and pt._sleeps[0] >= 1500
+
+
+def test_gated_step_skipped_without_attempt_then_runs(pt):
+    gate_state = {"open": False}
+    _fake_steps(pt, ["a", "g"], gates={"g": lambda: gate_state["open"]})
+    run, calls = _runner({})
+    pt._run_step = run
+    # the gate stays CLOSED through window 1 and opens between windows
+    # (certification landing in a later window), so the skip branch is
+    # genuinely exercised
+    seq = iter([True, True])
+
+    def fake_probe(timeout, source="watchdog"):
+        ok = next(seq, False)
+        if gate_state.get("w1_done"):
+            gate_state["open"] = True
+        gate_state["w1_done"] = True
+        return {"ts": pt._now(), "ok": ok, "elapsed_s": 0.0,
+                "source": source, "detail": {} if ok else "wedged"}
+
+    pt.probe = fake_probe
+    pt.watch(interval=1, probe_timeout=1, max_hours=1)
+    data = json.load(open(pt.RESULTS))
+    # g was skipped in window 1 (no attempts entry yet), ran in window 2
+    assert calls == ["a", "g"]
+    assert data["steps"]["g"]["attempts"] == 1
+    assert len(data["windows"]) == 2
+
+
+def test_permanently_gated_step_resolves_when_opener_exhausted(pt):
+    _fake_steps(pt, ["flash_check", "g"], gates={"g": lambda: False})
+    _probe_seq(pt, [True, True, True, True])
+    run, calls = _runner({"flash_check": {"ok": False, "rc": 1}})
+    pt._run_step = run
+    pt.watch(interval=1, probe_timeout=1, max_hours=1)
+    # flash_check burned its 3 attempts; g never ran; the loop still
+    # exited via all-resolved instead of probing to max_hours
+    assert calls == ["flash_check"] * 3
+    data = json.load(open(pt.RESULTS))
+    assert "g" not in data["steps"]
+
+
+def test_probe_backoff_after_three_failures(pt):
+    _fake_steps(pt, ["a"])
+    _probe_seq(pt, [False] * 6)
+    run, _ = _runner({})
+    pt._run_step = run
+    pt.watch(interval=300, probe_timeout=1, max_hours=1.0)
+    # first two sleeps at the fast interval, then the 30-minute quiet —
+    # with every sleep clamped to the remaining max-hours budget
+    assert pt._sleeps[0] == 300 and pt._sleeps[1] == 300
+    assert pt._sleeps[2] == 1800
+    assert pt._sleeps[3] == 1200  # clamped: 3600s deadline - 2400 elapsed
+
+
+def test_stale_certification_reopens_flash_check(pt, tmp_path):
+    _fake_steps(pt, ["flash_check"])
+    _probe_seq(pt, [True])
+    # prior session: flash_check ok — but the gate says sources changed
+    json.dump({"steps": {"flash_check": {"ok": True, "attempts": 1}},
+               "windows": []}, open(pt.RESULTS, "w"))
+    pt._fused_gate = lambda: False
+    run, calls = _runner({})
+    pt._run_step = run
+    pt.watch(interval=1, probe_timeout=1, max_hours=1)
+    assert calls == ["flash_check"]  # re-ran despite prev ok
+
+
+def test_ab_arm_without_device_provenance_reopens(pt, tmp_path):
+    _fake_steps(pt, ["gpt350_fused"])
+    _probe_seq(pt, [True])
+    json.dump({"steps": {"gpt350_fused": {"ok": True, "attempts": 1}},
+               "windows": []}, open(pt.RESULTS, "w"))
+    # recorded arm exists but carries no on-device provenance
+    monkey_file = os.path.join(pt.REPO, "kernel_ab_fused.json")
+    had = os.path.exists(monkey_file)
+    orig = open(monkey_file).read() if had else None
+    try:
+        json.dump({"metric": "x", "value": 1.0, "device": "cpu"},
+                  open(monkey_file, "w"))
+        run, calls = _runner({})
+        pt._run_step = run
+        pt.watch(interval=1, probe_timeout=1, max_hours=1)
+        assert calls == ["gpt350_fused"]  # reopened for re-measurement
+    finally:
+        if had:
+            open(monkey_file, "w").write(orig)
+        else:
+            os.remove(monkey_file)
