@@ -96,6 +96,10 @@ if not _LIGHT_IMPORT:
     from . import vision  # noqa: F401
     from . import text  # noqa: F401
     from . import inference  # noqa: F401
+    from . import compat  # noqa: F401
+    from . import dataset  # noqa: F401
+    from . import reader  # noqa: F401
+    from . import tensor  # noqa: F401
     from . import quantization  # noqa: F401
     from . import sparsity  # noqa: F401
     from . import hapi  # noqa: F401

@@ -1,0 +1,13 @@
+"""Legacy ``paddle.dataset`` reader-creator API (reference
+python/paddle/dataset/): each submodule exposes zero-arg creator
+functions (``mnist.train()`` → generator of (sample, label)).
+
+TPU-first these wrap the modern Dataset classes (vision/text.datasets) —
+one data implementation, two API generations.  The reference's loaders
+download from public mirrors; in this zero-egress environment the
+underlying Dataset classes synthesize deterministic data when no local
+files are given, and the creators inherit that behavior.
+"""
+from . import cifar, imdb, imikolov, mnist, movielens, uci_housing  # noqa: F401
+
+__all__ = []

@@ -1,0 +1,20 @@
+"""Shared bridge: modern Dataset class → legacy reader-creator."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def creator(dataset_factory, map_sample=None):
+    """Zero-arg creator over a lazily-built Dataset (built once)."""
+    box = {}
+
+    def reader():
+        if "ds" not in box:
+            box["ds"] = dataset_factory()
+        ds = box["ds"]
+        for i in range(len(ds)):
+            s = ds[i]
+            yield map_sample(s) if map_sample else tuple(
+                np.asarray(x) for x in s)
+
+    return reader

@@ -1,0 +1,38 @@
+"""paddle.dataset.cifar (reference dataset/cifar.py:80-143)."""
+from ._wrap import creator
+
+
+def _ds(cls_name, mode):
+    from ..vision import datasets
+
+    return getattr(datasets, cls_name)(mode=mode)
+
+
+def train10(cycle=False):
+    r = creator(lambda: _ds("Cifar10", "train"),
+                lambda s: (s[0].reshape(-1), int(s[1])))
+    return _cycled(r) if cycle else r
+
+
+def test10(cycle=False):
+    r = creator(lambda: _ds("Cifar10", "test"),
+                lambda s: (s[0].reshape(-1), int(s[1])))
+    return _cycled(r) if cycle else r
+
+
+def train100():
+    return creator(lambda: _ds("Cifar100", "train"),
+                   lambda s: (s[0].reshape(-1), int(s[1])))
+
+
+def test100():
+    return creator(lambda: _ds("Cifar100", "test"),
+                   lambda s: (s[0].reshape(-1), int(s[1])))
+
+
+def _cycled(r):
+    def cycle_reader():
+        while True:
+            yield from r()
+
+    return cycle_reader

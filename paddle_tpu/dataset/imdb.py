@@ -1,0 +1,28 @@
+"""paddle.dataset.imdb (reference dataset/imdb.py:108/:130)."""
+
+
+def _ds(mode):
+    from ..text.datasets import Imdb
+
+    return Imdb(mode=mode)
+
+
+def word_dict():
+    """token → id mapping of the underlying corpus."""
+    return dict(_ds("train").word_idx)
+
+
+def train(word_idx):
+    del word_idx  # ids already applied by the underlying Dataset
+    from ._wrap import creator
+
+    return creator(lambda: _ds("train"),
+                   lambda s: (list(map(int, s[0])), int(s[1])))
+
+
+def test(word_idx):
+    del word_idx
+    from ._wrap import creator
+
+    return creator(lambda: _ds("test"),
+                   lambda s: (list(map(int, s[0])), int(s[1])))

@@ -1,0 +1,27 @@
+"""paddle.dataset.imikolov (reference dataset/imikolov.py)."""
+
+
+def _ds(mode, window_size):
+    from ..text.datasets import Imikolov
+
+    return Imikolov(mode=mode, window_size=window_size)
+
+
+def build_dict(min_word_freq=50):
+    return dict(_ds("train", 5).word_idx)
+
+
+def train(word_idx, n, data_type=1):
+    del word_idx, data_type
+    from ._wrap import creator
+
+    return creator(lambda: _ds("train", n),
+                   lambda s: tuple(int(x) for x in s))
+
+
+def test(word_idx, n, data_type=1):
+    del word_idx, data_type
+    from ._wrap import creator
+
+    return creator(lambda: _ds("test", n),
+                   lambda s: tuple(int(x) for x in s))

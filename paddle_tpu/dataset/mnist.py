@@ -1,0 +1,19 @@
+"""paddle.dataset.mnist (reference dataset/mnist.py:98/:120)."""
+from ._wrap import creator
+
+
+def _ds(mode):
+    from ..vision.datasets import MNIST
+
+    return MNIST(mode=mode)
+
+
+def train():
+    """Creator of (image [784] float32 in [-1,1]-style range, int label)."""
+    return creator(lambda: _ds("train"),
+                   lambda s: (s[0].reshape(-1), int(s[1])))
+
+
+def test():
+    return creator(lambda: _ds("test"),
+                   lambda s: (s[0].reshape(-1), int(s[1])))

@@ -1,0 +1,19 @@
+"""paddle.dataset.movielens (reference dataset/movielens.py)."""
+
+
+def _ds(mode):
+    from ..text.datasets import Movielens
+
+    return Movielens(mode=mode)
+
+
+def train():
+    from ._wrap import creator
+
+    return creator(lambda: _ds("train"))
+
+
+def test():
+    from ._wrap import creator
+
+    return creator(lambda: _ds("test"))

@@ -1,0 +1,16 @@
+"""paddle.dataset.uci_housing (reference dataset/uci_housing.py:92/:117)."""
+from ._wrap import creator
+
+
+def _ds(mode):
+    from ..text.datasets import UCIHousing
+
+    return UCIHousing(mode=mode)
+
+
+def train():
+    return creator(lambda: _ds("train"))
+
+
+def test():
+    return creator(lambda: _ds("test"))

@@ -1,0 +1,206 @@
+"""Reader-creator combinators (reference python/paddle/reader/decorator.py).
+
+The v2.1 data idiom below ``paddle.io``: a *reader creator* is a zero-arg
+callable returning a fresh generator of samples; these decorators compose
+creators.  Implemented py3-native (threads for the prefetch/xmap pieces —
+the reference uses the same shapes over its own queues).
+"""
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import random as _random
+import threading
+
+__all__ = []
+
+
+def map_readers(func, *readers):
+    """Creator applying ``func`` across samples zipped from ``readers``."""
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """Creator shuffling within a sliding buffer of ``buf_size`` samples."""
+    def shuffled():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return shuffled
+
+
+def chain(*readers):
+    """Creator concatenating the readers' streams in order."""
+    def reader():
+        return itertools.chain(*[r() for r in readers])
+
+    return reader
+
+
+def compose(*readers, **kwargs):
+    """Creator zipping readers into combined samples; non-tuple samples
+    are treated as 1-tuples.  ``check_alignment=True`` (default) raises
+    when streams end unevenly."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if check_alignment:
+            sentinel = object()
+            for outs in itertools.zip_longest(*rs, fillvalue=sentinel):
+                if sentinel in outs:
+                    raise ValueError(
+                        "compose: readers have different lengths")
+                yield sum((make_tuple(o) for o in outs), ())
+        else:
+            for outs in zip(*rs):
+                yield sum((make_tuple(o) for o in outs), ())
+
+    return reader
+
+
+def buffered(reader, size):
+    """Creator prefetching up to ``size`` samples on a worker thread (the
+    reference's buffered_reader role at the python level)."""
+    end = object()
+
+    def buffered_reader():
+        q: _queue.Queue = _queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for d in reader():
+                    q.put(d)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is end:
+                break
+            yield e
+
+    return buffered_reader
+
+
+def firstn(reader, n):
+    """Creator yielding only the first ``n`` samples."""
+    def firstn_reader():
+        return itertools.islice(reader(), n)
+
+    return firstn_reader
+
+
+def cache(reader):
+    """Creator materializing the stream once, replaying from memory."""
+    all_data = tuple(reader())
+
+    def cache_reader():
+        return iter(all_data)
+
+    return cache_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Creator mapping samples with ``process_num`` worker THREADS through
+    bounded queues (the reference's multiprocess variant of map_readers;
+    GIL-free mappers belong in paddle.io.DataLoader's process workers).
+    ``order=True`` preserves input order."""
+    end = object()
+
+    def xreader():
+        in_q: _queue.Queue = _queue.Queue(buffer_size)
+        out_q: _queue.Queue = _queue.Queue(buffer_size)
+
+        def feed():
+            for i, d in enumerate(reader()):
+                in_q.put((i, d))
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def work():
+            while True:
+                e = in_q.get()
+                if e is end:
+                    out_q.put(end)
+                    return
+                i, d = e
+                out_q.put((i, mapper(d)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+        finished = 0
+        if order:
+            pending: dict = {}
+            want = 0
+            while finished < process_num:
+                e = out_q.get()
+                if e is end:
+                    finished += 1
+                    continue
+                i, d = e
+                pending[i] = d
+                while want in pending:
+                    yield pending.pop(want)
+                    want += 1
+            for i in sorted(pending):
+                yield pending[i]
+        else:
+            while finished < process_num:
+                e = out_q.get()
+                if e is end:
+                    finished += 1
+                    continue
+                yield e[1]
+
+    return xreader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Merge several reader creators into one interleaved stream via
+    concurrent workers (reference decorator.py multiprocess_reader;
+    thread-backed here — true process workers live in
+    ``paddle.io.DataLoader(num_workers=...)``, the modern path)."""
+    del use_pipe  # transport detail of the reference's fork+pipe impl
+    end = object()
+
+    def reader():
+        q: _queue.Queue = _queue.Queue(queue_size)
+
+        def work(r):
+            try:
+                for d in r():
+                    q.put(d)
+            finally:
+                q.put(end)
+
+        for r in readers:
+            threading.Thread(target=work, args=(r,), daemon=True).start()
+        done = 0
+        while done < len(readers):
+            e = q.get()
+            if e is end:
+                done += 1
+                continue
+            yield e
+
+    return reader

@@ -1,0 +1,24 @@
+"""``paddle.tensor`` module surface (reference python/paddle/tensor/).
+
+The reference defines tensor functions in grouped submodules
+(math/creation/...) and hoists them to ``paddle.*``; this framework
+defines them once in ``tensor_api`` and hoists the same way, so this
+package is the inverse mapping — the module-path surface users import
+from (``from paddle.tensor.math import add``).  Every public
+``tensor_api`` callable is re-exported here, and the grouped submodules
+delegate to the same definitions (one source of truth, no per-group
+copies to drift).
+"""
+from __future__ import annotations
+
+from .. import tensor_api as _api
+
+__all__ = list(_api.__all__)
+
+for _n in __all__:
+    globals()[_n] = getattr(_api, _n)
+
+from . import (attribute, creation, linalg, logic, manipulation, math,  # noqa: E402,F401
+               random, search, stat)
+
+del _n

@@ -1,0 +1,14 @@
+"""paddle.tensor.attribute — delegates to the single tensor_api definition set
+(reference python/paddle/tensor/attribute.py defines these; here they live once
+in tensor_api and this module serves the grouped import path)."""
+from __future__ import annotations
+
+
+def __getattr__(name):
+    from .. import tensor_api
+
+    try:
+        return getattr(tensor_api, name)
+    except AttributeError:
+        raise AttributeError(
+            f"module 'paddle_tpu.tensor.attribute' has no attribute {name!r}")

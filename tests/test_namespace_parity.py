@@ -202,3 +202,72 @@ class _FakeRole:
 
     def worker_num(self):
         return self._w
+
+
+class TestLegacyDataSurfaces:
+    """paddle.tensor / paddle.reader / paddle.dataset / paddle.compat —
+    the module-path surfaces v2.1 user code imports from (reference
+    python/paddle/{tensor,reader,dataset,compat}*)."""
+
+    def test_tensor_module_paths(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.tensor import creation, linalg, math  # noqa: F401
+        from paddle_tpu.tensor.math import add
+
+        out = add(paddle.to_tensor(np.float32(2)),
+                  paddle.to_tensor(np.float32(3)))
+        assert float(out.value) == 5.0
+        # every top-level tensor fn is reachable via the module path too
+        assert len(paddle.tensor.__all__) > 200
+
+    def test_compat_helpers(self):
+        from paddle_tpu import compat
+
+        assert compat.to_text(b"abc") == "abc"
+        assert compat.to_bytes("abc") == b"abc"
+        assert compat.to_text([b"a", b"b"]) == ["a", "b"]
+        assert compat.round(2.5) == 3.0  # py2 half-away-from-zero
+        assert compat.round(-2.5) == -3.0
+        assert compat.floor_division(7, 2) == 3
+        assert compat.get_exception_message(ValueError("x")) == "x"
+
+    def test_reader_decorators(self):
+        from paddle_tpu import reader as rd
+
+        def r():
+            return iter(range(6))
+
+        assert list(rd.firstn(r, 3)()) == [0, 1, 2]
+        assert list(rd.chain(r, r)()) == list(range(6)) * 2
+        assert list(rd.map_readers(lambda a, b: a + b, r, r)()) \
+            == [0, 2, 4, 6, 8, 10]
+        assert sorted(rd.shuffle(r, 4)()) == list(range(6))
+        assert list(rd.buffered(r, 2)()) == list(range(6))
+        assert list(rd.cache(r)()) == list(range(6))
+        got = list(rd.xmap_readers(lambda x: x * 10, r, 2, 4, order=True)())
+        assert got == [0, 10, 20, 30, 40, 50]
+        assert sorted(rd.multiprocess_reader([r, r])()) \
+            == sorted(list(range(6)) * 2)
+        comp = list(rd.compose(r, r)())
+        assert comp[0] == (0, 0)
+        with pytest.raises(ValueError):
+            list(rd.compose(r, rd.firstn(r, 2))())  # uneven lengths
+
+    def test_dataset_reader_creators(self):
+        from paddle_tpu import dataset
+
+        img, label = next(dataset.mnist.train()())
+        assert img.shape == (784,) and 0 <= int(label) < 10
+        x, y = next(dataset.uci_housing.test()())
+        assert x.shape == (13,) and y.shape == (1,)
+        toks, sentiment = next(dataset.imdb.train(None)())
+        assert toks and sentiment in (0, 1)
+        tup = next(dataset.imikolov.train(None, 5)())
+        assert len(tup) == 5
+        sample = next(dataset.cifar.train10()())
+        assert sample[0].shape == (3072,)
+        # cycle=True wraps around
+        it = dataset.cifar.test10(cycle=True)()
+        n_test = len(list(dataset.cifar.test10()()))
+        for _ in range(n_test + 2):
+            next(it)  # must not StopIteration

@@ -26,8 +26,11 @@ def pt(tmp_path, monkeypatch):
     # REPO too: tests must never write provenance files (kernel_ab_*.json)
     # into the real repo root
     monkeypatch.setattr(m, "REPO", str(tmp_path))
-    # fake clock: sleeps advance it instantly, so max_hours deadlines are
-    # exercised without wall time passing
+    # fake clock injected as the MODULE's time object — patching the
+    # shared stdlib time module would leak the jumping clock to every
+    # thread in the pytest process (daemon reader threads, plugins)
+    import types
+
     m._sleeps = []
     m._clock = [0.0]
 
@@ -35,8 +38,11 @@ def pt(tmp_path, monkeypatch):
         m._sleeps.append(s)
         m._clock[0] += s
 
-    monkeypatch.setattr(m.time, "sleep", _sleep)
-    monkeypatch.setattr(m.time, "monotonic", lambda: m._clock[0])
+    fake_time = types.SimpleNamespace(
+        sleep=_sleep, monotonic=lambda: m._clock[0],
+        perf_counter=lambda: m._clock[0], strftime=__import__("time").strftime,
+        gmtime=__import__("time").gmtime)
+    monkeypatch.setattr(m, "time", fake_time)
     return m
 
 
@@ -173,7 +179,7 @@ def test_probe_backoff_after_three_failures(pt):
     assert pt._sleeps[3] == 1200  # clamped: 3600s deadline - 2400 elapsed
 
 
-def test_stale_certification_reopens_flash_check(pt, tmp_path):
+def test_stale_certification_reopens_flash_check(pt):
     _fake_steps(pt, ["flash_check"])
     _probe_seq(pt, [True])
     # prior session: flash_check ok — but the gate says sources changed
@@ -186,24 +192,16 @@ def test_stale_certification_reopens_flash_check(pt, tmp_path):
     assert calls == ["flash_check"]  # re-ran despite prev ok
 
 
-def test_ab_arm_without_device_provenance_reopens(pt, tmp_path):
+def test_ab_arm_without_device_provenance_reopens(pt):
     _fake_steps(pt, ["gpt350_fused"])
     _probe_seq(pt, [True])
     json.dump({"steps": {"gpt350_fused": {"ok": True, "attempts": 1}},
                "windows": []}, open(pt.RESULTS, "w"))
-    # recorded arm exists but carries no on-device provenance
-    monkey_file = os.path.join(pt.REPO, "kernel_ab_fused.json")
-    had = os.path.exists(monkey_file)
-    orig = open(monkey_file).read() if had else None
-    try:
-        json.dump({"metric": "x", "value": 1.0, "device": "cpu"},
-                  open(monkey_file, "w"))
-        run, calls = _runner({})
-        pt._run_step = run
-        pt.watch(interval=1, probe_timeout=1, max_hours=1)
-        assert calls == ["gpt350_fused"]  # reopened for re-measurement
-    finally:
-        if had:
-            open(monkey_file, "w").write(orig)
-        else:
-            os.remove(monkey_file)
+    # recorded arm exists but carries no on-device provenance (the fixture
+    # pins REPO to tmp, so this never touches the real repo root)
+    json.dump({"metric": "x", "value": 1.0, "device": "cpu"},
+              open(os.path.join(pt.REPO, "kernel_ab_fused.json"), "w"))
+    run, calls = _runner({})
+    pt._run_step = run
+    pt.watch(interval=1, probe_timeout=1, max_hours=1)
+    assert calls == ["gpt350_fused"]  # reopened for re-measurement
