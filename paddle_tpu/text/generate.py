@@ -23,9 +23,11 @@ __all__ = ["init_cache", "decode_step", "generate"]
 
 
 def init_cache(cfg: gpt.GPTConfig, batch: int, max_len: int):
-    """Per-layer K/V cache [L, B, max_len, H, hd]; the caller tracks the
-    write position (generate's scan carries it implicitly)."""
-    L, H, hd = cfg.num_layers, cfg.num_heads, cfg.head_dim
+    """Per-layer K/V cache [L, B, max_len, Hkv, hd]; the caller tracks the
+    write position (generate's scan carries it implicitly).  Under GQA
+    (cfg.num_kv_heads) the cache holds only the Hkv shared heads — the
+    num_heads/Hkv decode-memory saving is the feature's point."""
+    L, H, hd = cfg.num_layers, cfg.kv_heads, cfg.head_dim
     shape = (L, batch, max_len, H, hd)
     return {"k": jnp.zeros(shape, cfg.dtype),
             "v": jnp.zeros(shape, cfg.dtype)}
@@ -39,23 +41,44 @@ def _cached_block(x, p, cache_k, cache_v, pos, cfg: gpt.GPTConfig):
     dt = cfg.dtype
     h = gpt._layer_norm(x.astype(jnp.float32), p["ln1_g"],
                         p["ln1_b"]).astype(dt)
-    qkv = jnp.einsum("btd,kde->kbte", h, p["qkv_w"].astype(dt)) \
-        + p["qkv_b"].astype(dt)[:, None, None]
-    q = qkv[0].reshape(B, H, hd)
-    k_new = qkv[1].reshape(B, H, hd)
-    v_new = qkv[2].reshape(B, H, hd)
+    if cfg.num_kv_heads is not None:
+        Hkv = cfg.kv_heads
+        q3, k3, v3 = gpt._gqa_qkv(h, p, cfg, repeat_kv=False)
+        q = q3.reshape(B, H, hd)
+        k_new = k3.reshape(B, Hkv, hd)  # cache stores the Hkv heads
+        v_new = v3.reshape(B, Hkv, hd)
+    else:
+        qkv = jnp.einsum("btd,kde->kbte", h, p["qkv_w"].astype(dt)) \
+            + p["qkv_b"].astype(dt)[:, None, None]
+        q = qkv[0].reshape(B, H, hd)
+        k_new = qkv[1].reshape(B, H, hd)
+        v_new = qkv[2].reshape(B, H, hd)
     # attend over cache rows [B, max_len, H, hd] with the fresh row at pos
     k_all = jax.lax.dynamic_update_slice(
         cache_k, k_new[:, None], (0, pos, 0, 0))
     v_all = jax.lax.dynamic_update_slice(
         cache_v, v_new[:, None], (0, pos, 0, 0))
-    scores = jnp.einsum("bhd,bthd->bht", q, k_all) / jnp.sqrt(
-        jnp.asarray(hd, jnp.float32)).astype(dt)
+    if cfg.num_kv_heads is not None and cfg.kv_heads != H:
+        # grouped attention against the Hkv-head cache: fold the group dim
+        # into the einsum instead of repeating the whole cache
+        g = H // cfg.kv_heads
+        qg = q.reshape(B, cfg.kv_heads, g, hd)
+        scores = jnp.einsum("bkgd,btkd->bkgt", qg, k_all) / jnp.sqrt(
+            jnp.asarray(hd, jnp.float32)).astype(dt)
+        scores = scores.reshape(B, H, k_all.shape[1])
+    else:
+        scores = jnp.einsum("bhd,bthd->bht", q, k_all) / jnp.sqrt(
+            jnp.asarray(hd, jnp.float32)).astype(dt)
     T = cache_k.shape[1]
     mask = jnp.arange(T)[None, None, :] <= pos
     scores = jnp.where(mask, scores.astype(jnp.float32), -1e30)
     w = jax.nn.softmax(scores, axis=-1).astype(dt)
-    attn = jnp.einsum("bht,bthd->bhd", w, v_all).reshape(B, 1, D)
+    if cfg.num_kv_heads is not None and cfg.kv_heads != H:
+        g = H // cfg.kv_heads
+        wg = w.reshape(B, cfg.kv_heads, g, -1)
+        attn = jnp.einsum("bkgt,btkd->bkgd", wg, v_all).reshape(B, 1, D)
+    else:
+        attn = jnp.einsum("bht,bthd->bhd", w, v_all).reshape(B, 1, D)
     a = attn @ p["proj_w"].astype(dt) + p["proj_b"].astype(dt)
     x = x + a
     h = gpt._layer_norm(x.astype(jnp.float32), p["ln2_g"],
@@ -101,6 +124,7 @@ def _cfg_key(cfg):
     moe = cfg.moe
     moe_key = (moe.num_experts,) if moe is not None else None
     return (cfg.vocab_size, cfg.hidden_size, cfg.num_layers, cfg.num_heads,
+            cfg.num_kv_heads,
             cfg.max_seq_len, cfg.ffn_ratio, str(cfg.dtype), cfg.use_flash,
             moe_key)
 

@@ -54,11 +54,31 @@ class GPTConfig:
     # recurrence in XLA — ops/ring_attention.py _chunk_attend).  None =
     # whole-block scores; set for long local chunks.
     sp_sub_block: int | None = None
+    # grouped-query attention (beyond the reference — the Llama/Mistral
+    # family): ``num_kv_heads`` < num_heads shares each K/V head across a
+    # group of query heads, shrinking qkv params and (the real win) the
+    # decode KV cache by num_heads/num_kv_heads.  None = MHA; 1 = MQA.
+    num_kv_heads: int | None = None
     moe: Any = None  # MoEConfig → every block's FFN becomes expert-parallel
+
+    def __post_init__(self):
+        # the invariant lives on the config, not one entry point: every
+        # consumer (count_params/shardings/init_cache/checkpoint-loaded
+        # params) inherits the loud failure
+        if (self.num_kv_heads is not None
+                and self.num_heads % self.num_kv_heads):
+            raise ValueError(
+                f"num_kv_heads {self.num_kv_heads} must divide num_heads "
+                f"{self.num_heads}")
 
     @property
     def head_dim(self):
         return self.hidden_size // self.num_heads
+
+    @property
+    def kv_heads(self):
+        return self.num_kv_heads if self.num_kv_heads is not None \
+            else self.num_heads
 
     @property
     def ffn_size(self):
@@ -90,13 +110,21 @@ def init_params(cfg: GPTConfig, key) -> dict:
         "ln1_b": jnp.zeros((L, D), jnp.float32),
         "ln2_g": jnp.ones((L, D), jnp.float32),
         "ln2_b": jnp.zeros((L, D), jnp.float32),
-        # qkv stored as separate [3, D, D] mats (not one [D, 3D]) so the
-        # output dim shards cleanly per-projection under tensor parallel
-        "qkv_w": nrm(blk_keys[0], (L, 3, D, D)),
-        "qkv_b": jnp.zeros((L, 3, D), jnp.float32),
         "proj_w": nrm(blk_keys[1], (L, D, D), std=s / math.sqrt(2 * L)),
         "proj_b": jnp.zeros((L, D), jnp.float32),
     }
+    if cfg.num_kv_heads is not None:
+        Dkv = cfg.kv_heads * cfg.head_dim
+        # GQA: q keeps the full width; k/v project to Dkv
+        blocks["q_w"] = nrm(blk_keys[4], (L, D, D))
+        blocks["q_b"] = jnp.zeros((L, D), jnp.float32)
+        blocks["kv_w"] = nrm(blk_keys[5], (L, 2, D, Dkv))
+        blocks["kv_b"] = jnp.zeros((L, 2, Dkv), jnp.float32)
+    else:
+        # qkv stored as separate [3, D, D] mats (not one [D, 3D]) so the
+        # output dim shards cleanly per-projection under tensor parallel
+        blocks["qkv_w"] = nrm(blk_keys[0], (L, 3, D, D))
+        blocks["qkv_b"] = jnp.zeros((L, 3, D), jnp.float32)
     if cfg.moe is None:
         blocks.update({
             "fc_w": nrm(blk_keys[2], (L, D, F)),
@@ -136,6 +164,13 @@ def param_shardings(cfg: GPTConfig, dp="dp", mp="mp", pp=None, ep="ep") -> dict:
         "proj_w": P(l, mp, None),  # row parallel
         "proj_b": P(l, None),
     }
+    if cfg.num_kv_heads is not None:
+        for k in ("qkv_w", "qkv_b"):
+            del blocks[k]
+        blocks.update({
+            "q_w": P(l, None, mp), "q_b": P(l, mp),
+            "kv_w": P(l, None, None, mp), "kv_b": P(l, None, mp),
+        })
     if cfg.moe is None:
         blocks.update({
             "fc_w": P(l, None, mp),    # column parallel
@@ -202,6 +237,28 @@ def _remat_policy(name: str | None):
     return resolve(name)
 
 
+def _gqa_qkv(h, p, cfg: GPTConfig, repeat_kv: bool = True):
+    """Grouped-query projections.  With ``repeat_kv`` the Hkv k/v heads
+    are repeated across their query groups so every attention backend
+    (flash included) sees the standard [B, T, H, hd] layout; the decode
+    path passes False and keeps the cache at Hkv heads.  The GQA savings
+    live in the params and the decode cache, not the training-time
+    attention math."""
+    B, T, D = h.shape
+    H, Hkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    dt = cfg.dtype
+    q = (h @ p["q_w"].astype(dt) + p["q_b"].astype(dt)).reshape(B, T, H, hd)
+    kv = jnp.einsum("btd,kde->kbte", h, p["kv_w"].astype(dt)) \
+        + p["kv_b"].astype(dt)[:, None, None]
+    k = kv[0].reshape(B, T, Hkv, hd)
+    v = kv[1].reshape(B, T, Hkv, hd)
+    rep = H // Hkv
+    if repeat_kv and rep > 1:
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return q, k, v
+
+
 def _block(x, p, cfg: GPTConfig, dropout_key=None):
     """One transformer block on [B, T, D] activations (compute dtype)."""
     B, T, D = x.shape
@@ -209,10 +266,13 @@ def _block(x, p, cfg: GPTConfig, dropout_key=None):
     dt = cfg.dtype
     drop = cfg.dropout > 0.0 and dropout_key is not None
     h = _ln(x, p["ln1_g"], p["ln1_b"], dt)
-    qkv = jnp.einsum("btd,kde->kbte", h, p["qkv_w"].astype(dt)) + p["qkv_b"].astype(dt)[:, None, None]
-    q = qkv[0].reshape(B, T, H, hd)
-    k = qkv[1].reshape(B, T, H, hd)
-    v = qkv[2].reshape(B, T, H, hd)
+    if cfg.num_kv_heads is not None:
+        q, k, v = _gqa_qkv(h, p, cfg)
+    else:
+        qkv = jnp.einsum("btd,kde->kbte", h, p["qkv_w"].astype(dt)) + p["qkv_b"].astype(dt)[:, None, None]
+        q = qkv[0].reshape(B, T, H, hd)
+        k = qkv[1].reshape(B, T, H, hd)
+        v = qkv[2].reshape(B, T, H, hd)
     attn = attention_array(q, k, v, is_causal=True)
     attn = attn.reshape(B, T, D)
     a = attn @ p["proj_w"].astype(dt) + p["proj_b"].astype(dt)
@@ -309,7 +369,10 @@ def loss_fn(params: dict, tokens, cfg: GPTConfig, act_sharding=None, key=None):
 def count_params(cfg: GPTConfig) -> int:
     D, F, L, V, T = (cfg.hidden_size, cfg.ffn_size, cfg.num_layers, cfg.vocab_size,
                      cfg.max_seq_len)
-    per_block = 4 * D + 3 * D * D + 3 * D + D * D + D + D * F + F + F * D + D
+    Dkv = cfg.kv_heads * cfg.head_dim
+    qkv = (D * D + D + 2 * D * Dkv + 2 * Dkv
+           if cfg.num_kv_heads is not None else 3 * D * D + 3 * D)
+    per_block = 4 * D + qkv + D * D + D + D * F + F + F * D + D
     return V * D + T * D + 2 * D + L * per_block
 
 
@@ -323,6 +386,9 @@ def flops_per_token(cfg: GPTConfig, seq_len: int) -> float:
     scores: QK^T + AV = 12 L D T training flops/token (full, non-causal
     accounting — the conservative standard for MFU)."""
     D, F, L, V = cfg.hidden_size, cfg.ffn_size, cfg.num_layers, cfg.vocab_size
-    n_matmul = L * (4 * D * D + 2 * D * F) + V * D
+    Dkv = cfg.kv_heads * cfg.head_dim
+    qkv_w = (D * D + 2 * D * Dkv if cfg.num_kv_heads is not None
+             else 3 * D * D)
+    n_matmul = L * (qkv_w + D * D + 2 * D * F) + V * D
     attn = 12 * L * D * seq_len
     return 6 * n_matmul + attn

@@ -569,6 +569,11 @@ def build_gpt_train_step(cfg: gpt.GPTConfig, mesh: Mesh, optimizer,
     if cfg.moe is not None:
         if cfg.moe.num_experts % max(ep, 1):
             raise ValueError("num_experts must divide by ep")
+    if cfg.num_kv_heads is not None and (pp > 1 or sp > 1):
+        raise NotImplementedError(
+            "GQA (num_kv_heads) composes with the GSPMD path (dp/mp/ZeRO) "
+            "only for now: the manual-collective pipeline block reads the "
+            "fused qkv weights")
 
     mp_ax = "mp" if mp > 1 else None
     pp_ax = "pp" if pp > 1 else None
