@@ -745,6 +745,54 @@ class TestOnnxExport:
             np.testing.assert_allclose(got, np.asarray(want), rtol=2e-4,
                                        atol=2e-5, err_msg=f"step {i}")
 
+    def test_greedy_generation_exports(self, tmp_path):
+        """Capstone serving export: a 3-step greedy continuation (decode
+        step + argmax, scan-unrolled) runs autonomously inside the .onnx
+        file and reproduces the framework's own generation."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        from paddle_tpu.text import gpt
+        from paddle_tpu.text.generate import decode_step, init_cache
+
+        cfg = gpt.GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                            num_heads=2, max_seq_len=16, dtype=jnp.float32)
+        params = gpt.init_params(cfg, jax.random.PRNGKey(5))
+        cache0 = init_cache(cfg, 1, 16)
+
+        def f(tok0, ck, cv):
+            def body(carry, i):
+                tok, k, v = carry
+                logits, cache = decode_step(params, {"k": k, "v": v},
+                                            tok, i, cfg)
+                nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+                return (nxt, cache["k"], cache["v"]), nxt
+
+            (_, _, _), toks = lax.scan(
+                body, (tok0.value, ck.value, cv.value), jnp.arange(3))
+            return toks
+
+        tok0 = paddle.to_tensor(np.asarray([7], np.int32))
+        ck = paddle.to_tensor(np.asarray(cache0["k"]))
+        cv = paddle.to_tensor(np.asarray(cache0["v"]))
+        path = export(f, str(tmp_path / "greedy.onnx"),
+                      input_spec=[tok0, ck, cv])
+        with open(path, "rb") as fh:
+            model = parse_model(fh.read())
+        got = run_graph(model, {
+            "input_0": np.asarray([7], np.int32),
+            "input_1": np.asarray(cache0["k"]),
+            "input_2": np.asarray(cache0["v"])})[0]
+        # reference: run the framework's decode loop directly
+        tok, cache, want = jnp.asarray([7], jnp.int32), cache0, []
+        for i in range(3):
+            logits, cache = decode_step(params, cache, tok,
+                                        jnp.asarray(i, jnp.int32), cfg)
+            tok = jnp.argmax(logits, -1).astype(jnp.int32)
+            want.append(int(tok[0]))
+        np.testing.assert_array_equal(np.asarray(got).reshape(-1), want)
+
     def test_qat_model_exports_as_qdq(self, tmp_path):
         """A QAT-converted net exports with REAL QuantizeLinear /
         DequantizeLinear pairs (the reference's int8 deploy endpoint via
