@@ -237,15 +237,20 @@ def _remat_policy(name: str | None):
     return resolve(name)
 
 
-def _gqa_qkv(h, p, cfg: GPTConfig, repeat_kv: bool = True):
+def _gqa_qkv(h, p, cfg: GPTConfig, repeat_kv: bool = True,
+             H: int | None = None, Hkv: int | None = None):
     """Grouped-query projections.  With ``repeat_kv`` the Hkv k/v heads
     are repeated across their query groups so every attention backend
     (flash included) sees the standard [B, T, H, hd] layout; the decode
-    path passes False and keeps the cache at Hkv heads.  The GQA savings
-    live in the params and the decode cache, not the training-time
-    attention math."""
+    path passes False and keeps the cache at Hkv heads.  ``H``/``Hkv``
+    override the config's global head counts with per-rank LOCAL ones
+    when the weights are tensor-parallel shards (gpt_hybrid.mp_block).
+    The GQA savings live in the params and the decode cache, not the
+    training-time attention math."""
     B, T, D = h.shape
-    H, Hkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    H = H if H is not None else cfg.num_heads
+    Hkv = Hkv if Hkv is not None else cfg.kv_heads
+    hd = cfg.head_dim
     dt = cfg.dtype
     q = (h @ p["q_w"].astype(dt) + p["q_b"].astype(dt)).reshape(B, T, H, hd)
     kv = jnp.einsum("btd,kde->kbte", h, p["kv_w"].astype(dt)) \

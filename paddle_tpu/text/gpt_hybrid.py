@@ -67,11 +67,26 @@ def mp_block(x, p, cfg: gpt.GPTConfig, mp_axis: str | None, mp_size: int,
     hd = cfg.head_dim
     dt = cfg.dtype
     h = gpt._layer_norm(x.astype(jnp.float32), p["ln1_g"], p["ln1_b"]).astype(dt)
-    qkv = jnp.einsum("btd,kde->kbte", h, p["qkv_w"].astype(dt)) \
-        + p["qkv_b"].astype(dt)[:, None, None]
-    q = qkv[0].reshape(B, T, H, hd)
-    k = qkv[1].reshape(B, T, H, hd)
-    v = qkv[2].reshape(B, T, H, hd)
+    if cfg.num_kv_heads is not None:
+        # GQA under tensor parallel: kv heads shard over mp exactly like
+        # q heads (column parallel), each rank holding Hkv/mp shared
+        # heads repeated across its local query groups — after this the
+        # attention backends (flash, ring, zigzag) see the standard
+        # [B, T, H_local, hd] layout unchanged.  KNOWN TRADEOFF: under
+        # sp, the repeated kv rides the ring, so each hop ships
+        # H/Hkv more KV bytes than the shared heads strictly need;
+        # circulating Hkv heads with a grouped score einsum (as the
+        # decode path does) would reclaim that bandwidth — future
+        # optimization, noted here so the cost is a decision, not a
+        # surprise.
+        q, k, v = gpt._gqa_qkv(h, p, cfg, H=H,
+                               Hkv=cfg.kv_heads // mp_size)
+    else:
+        qkv = jnp.einsum("btd,kde->kbte", h, p["qkv_w"].astype(dt)) \
+            + p["qkv_b"].astype(dt)[:, None, None]
+        q = qkv[0].reshape(B, T, H, hd)
+        k = qkv[1].reshape(B, T, H, hd)
+        v = qkv[2].reshape(B, T, H, hd)
     if sp_axis is not None and sp_zigzag:
         # zigzag layout: rows are the global chunk pair (rank, 2R-1-rank),
         # balancing causal ring work (ops/ring_attention.py)
@@ -569,11 +584,14 @@ def build_gpt_train_step(cfg: gpt.GPTConfig, mesh: Mesh, optimizer,
     if cfg.moe is not None:
         if cfg.moe.num_experts % max(ep, 1):
             raise ValueError("num_experts must divide by ep")
-    if cfg.num_kv_heads is not None and (pp > 1 or sp > 1):
-        raise NotImplementedError(
-            "GQA (num_kv_heads) composes with the GSPMD path (dp/mp/ZeRO) "
-            "only for now: the manual-collective pipeline block reads the "
-            "fused qkv weights")
+    if (cfg.num_kv_heads is not None and (pp > 1 or sp > 1)
+            and cfg.kv_heads % max(mp, 1)):
+        # only the manual-collective path slices kv heads per mp rank;
+        # pure GSPMD (pp==1, sp==1) lets XLA lay out any Hkv vs mp
+        raise ValueError(
+            f"num_kv_heads {cfg.kv_heads} must divide by mp {mp} on the "
+            f"pipeline/ring path (kv heads shard over tensor parallel "
+            f"like q heads)")
 
     mp_ax = "mp" if mp > 1 else None
     pp_ax = "pp" if pp > 1 else None
