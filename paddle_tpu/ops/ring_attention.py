@@ -38,15 +38,29 @@ _NEG = -1e30
 
 def _block_attend(q, k, v, scale, mask=None):
     """One dense score block: returns (scores-max m, exp-sum l, weighted
-    acc) for merging.  q [B,Tq,H,D], k/v [B,Tk,H,D]."""
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
+    acc) for merging.  q [B,Tq,H,D]; k/v [B,Tk,Hkv,D] where Hkv may be a
+    DIVISOR of H (grouped-query attention: q head h shares kv head
+    h // (H//Hkv), matching gpt._gqa_qkv's repeat layout) — the group
+    dim folds into the einsums so the shared kv heads are never
+    materialized H/Hkv times (and never ride the ring repeated)."""
+    B, Tq, H, hd = q.shape
+    Hkv = k.shape[2]
+    if H % Hkv:
+        raise ValueError(f"kv heads {Hkv} must divide q heads {H}")
+    g = H // Hkv  # 1 = plain MHA; the grouped form is identical math
+    Tk = k.shape[1]
+    qg = q.reshape(B, Tq, Hkv, g, hd).astype(jnp.float32)
+    s = jnp.einsum("bqkgd,bskd->bkgqs", qg,
+                   k.astype(jnp.float32)) * scale   # [B,Hkv,g,Tq,Tk]
+    s = s.reshape(B, H, Tq, Tk)
     if mask is not None:
         s = jnp.where(mask, s, _NEG)
-    m = jnp.max(s, axis=-1)                      # [B,H,Tq]
+    m = jnp.max(s, axis=-1)                          # [B,H,Tq]
     p = jnp.exp(s - m[..., None])
-    l = jnp.sum(p, axis=-1)                      # [B,H,Tq]
-    acc = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    l = jnp.sum(p, axis=-1)                          # [B,H,Tq]
+    pg = p.reshape(B, Hkv, g, Tq, Tk)
+    acc = jnp.einsum("bkgqs,bskd->bkgqd", pg,
+                     v.astype(jnp.float32)).reshape(B, H, Tq, hd)
     return m, l, acc
 
 
@@ -69,13 +83,13 @@ def _chunk_attend(q, k, v, scale, pos=None, sub: int | None = None):
         mask = (None if pos is None else
                 (pos[0][:, None] >= pos[1][None, :])[None, None])
         return _block_attend(q, k, v, scale, mask)
-    B, Tk, H, D = k.shape
+    B, Tk, Hkv, D = k.shape  # Hkv may be a divisor of q's head count
     if Tk % sub:
         raise ValueError(f"sub_block {sub} must divide the kv chunk {Tk}")
     n = Tk // sub
     Tq = q.shape[1]
-    ks = jnp.moveaxis(k.reshape(B, n, sub, H, D), 1, 0)
-    vs = jnp.moveaxis(v.reshape(B, n, sub, H, D), 1, 0)
+    ks = jnp.moveaxis(k.reshape(B, n, sub, Hkv, D), 1, 0)
+    vs = jnp.moveaxis(v.reshape(B, n, sub, Hkv, D), 1, 0)
     kp = None if pos is None else pos[1].reshape(n, sub)
 
     def body(carry, xs):
@@ -89,9 +103,10 @@ def _chunk_attend(q, k, v, scale, pos=None, sub: int | None = None):
         st = _block_attend(q, kk, vv, scale, mm)
         return _merge(m_acc, l_acc, o_acc, *st), None
 
-    m0 = jnp.full((B, H, Tq), _NEG, jnp.float32)
-    l0 = jnp.zeros((B, H, Tq), jnp.float32)
-    o0 = jnp.zeros((B, H, Tq, D), jnp.float32)
+    Hq = q.shape[2]  # may exceed k's Hkv under grouped-query attention
+    m0 = jnp.full((B, Hq, Tq), _NEG, jnp.float32)
+    l0 = jnp.zeros((B, Hq, Tq), jnp.float32)
+    o0 = jnp.zeros((B, Hq, Tq, D), jnp.float32)
     xs = (ks, vs) if kp is None else (ks, vs, kp)
     # checkpoint the inner body too: without it the inner scan's VJP
     # stacks per-sub-chunk score residuals back up to ~[B,H,Tq,Tk] —
@@ -120,8 +135,11 @@ def ring_attention(q, k, v, axis: str, causal: bool = True, scale=None,
     """Sequence-sharded attention inside a ``shard_map`` region.
 
     q,k,v: LOCAL chunks [B, T_local, H, D], sequence dim sharded over
-    ``axis`` (ring of size R; global T = R * T_local).  Returns the local
-    output chunk [B, T_local, H, D].  ``sub_block`` caps the live score
+    ``axis`` (ring of size R; global T = R * T_local).  k/v may carry
+    Hkv < H heads (grouped-query attention): the UNREPEATED shared heads
+    ride the ring — H/Hkv less KV traffic per hop — and the group dim
+    folds into the block einsums.  Returns the local output chunk
+    [B, T_local, H, D].  ``sub_block`` caps the live score
     temp at [B,H,Tl,sub_block] (see _chunk_attend) — required for long
     local chunks, where a full [Tl,Tl] block would defeat the point of
     the ring.
@@ -217,6 +235,8 @@ def ring_attention_zigzag(q, k, v, axis: str, scale=None,
     q,k,v: LOCAL [B, 2*Tc, H, D] — rows [:Tc] are global chunk ``i`` (the
     rank index), rows [Tc:] global chunk ``2R-1-i``, i.e. the input
     sequence was reordered with :func:`zigzag_permutation` before sharding.
+    As with :func:`ring_attention`, k/v may carry Hkv < H grouped-query
+    heads and circulate unrepeated.
     Returns the local output in the same layout (undo at the end with
     :func:`zigzag_inverse`).  Causal only — zigzag exists to balance the
     causal mask; use :func:`ring_attention` for the non-causal case.

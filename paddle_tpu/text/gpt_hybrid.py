@@ -70,17 +70,13 @@ def mp_block(x, p, cfg: gpt.GPTConfig, mp_axis: str | None, mp_size: int,
     if cfg.num_kv_heads is not None:
         # GQA under tensor parallel: kv heads shard over mp exactly like
         # q heads (column parallel), each rank holding Hkv/mp shared
-        # heads repeated across its local query groups — after this the
-        # attention backends (flash, ring, zigzag) see the standard
-        # [B, T, H_local, hd] layout unchanged.  KNOWN TRADEOFF: under
-        # sp, the repeated kv rides the ring, so each hop ships
-        # H/Hkv more KV bytes than the shared heads strictly need;
-        # circulating Hkv heads with a grouped score einsum (as the
-        # decode path does) would reclaim that bandwidth — future
-        # optimization, noted here so the cost is a decision, not a
-        # surprise.
-        q, k, v = gpt._gqa_qkv(h, p, cfg, H=H,
-                               Hkv=cfg.kv_heads // mp_size)
+        # heads.  On the ring paths (sp) the UNREPEATED Hkv heads ride
+        # the ppermute ring — the block einsums fold the query-group dim
+        # (ops/ring_attention.py _block_attend) — so each hop ships only
+        # the shared heads' bytes; the flash/XLA path still repeats to
+        # the standard layout.
+        q, k, v = gpt._gqa_qkv(h, p, cfg, repeat_kv=(sp_axis is None),
+                               H=H, Hkv=cfg.kv_heads // mp_size)
     else:
         qkv = jnp.einsum("btd,kde->kbte", h, p["qkv_w"].astype(dt)) \
             + p["qkv_b"].astype(dt)[:, None, None]
