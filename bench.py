@@ -93,13 +93,27 @@ def _peak_flops(dev) -> float:
     return 459e12 if dev.platform in ("tpu", "axon") else 1e12
 
 
-def _time_steps(run_one, iters, block):
+def _time_steps(run_one, iters, fetch):
+    """Steady-state step time: enqueue ``iters`` steps, then synchronize.
+
+    ``fetch()`` must return a (small) device value data-dependent on the
+    LAST step's output — the loss threaded through the state chain.  The
+    sync is a HOST TRANSFER (``jax.device_get``), deliberately not
+    ``block_until_ready``: through the axon remote backend
+    block_until_ready can return before execution finishes (round-4
+    window 1 evidence: a 350M GPT rung "measured" 0.18 ms/step and MFU
+    1288 — physically impossible; the ten enqueued steps only actually
+    ran when the loss was later fetched for the log line).  A transfer of
+    the value itself cannot complete early on any backend, because the
+    bytes do not exist until the dependency chain has executed."""
+    import jax
+
     run_one()  # compile + warmup
-    block()
+    jax.device_get(fetch())
     t0 = time.perf_counter()
     for _ in range(iters):
         run_one()
-    block()
+    jax.device_get(fetch())
     return (time.perf_counter() - t0) / iters
 
 
@@ -338,14 +352,14 @@ def _run_gpt_rung(idx: int):
     rng = np.random.default_rng(0)
     toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, T + 1)), jnp.int32)
     state, loss = step_fn(state, toks, key, 2e-4)
-    jax.block_until_ready(loss)
+    jax.device_get(loss)  # forced execution: an OOM must surface HERE
 
     st = {"state": state, "loss": loss}
 
     def one():
         st["state"], st["loss"] = step_fn(st["state"], toks, key, 2e-4)
 
-    dt = _time_steps(one, iters, lambda: jax.block_until_ready(st["loss"]))
+    dt = _time_steps(one, iters, lambda: st["loss"])
     tok_s = B * T / dt
     mfu = gpt.flops_per_token(cfg, T) * tok_s / _peak_flops(dev)
     _log(f"[bench] {name}: {tok_s:,.0f} tok/s  step={dt * 1e3:.1f}ms  "
@@ -483,7 +497,10 @@ def bench_bert(small: bool):
             opt_state = opt.init_state(params)
             batch = make_batch(B)
             params, opt_state, loss = step(params, opt_state, batch, 1)
-            jax.block_until_ready(loss)
+            # device_get, not block_until_ready: the OOM that steps this
+            # ladder down must surface inside THIS try (axon's
+            # block_until_ready can return before execution)
+            jax.device_get(loss)
             break
         except Exception as e:
             last_err = e
@@ -497,7 +514,7 @@ def bench_bert(small: bool):
     def one():
         st["p"], st["o"], st["l"] = step(st["p"], st["o"], batch, 1)
 
-    dt = _time_steps(one, iters, lambda: jax.block_until_ready(st["l"]))
+    dt = _time_steps(one, iters, lambda: st["l"])
     # matmul-weight flops: blocks + mlm head (tied wte, applied on K of T)
     D, F, L, V = cfg.hidden_size, cfg.ffn_size, cfg.num_layers, cfg.vocab_size
     per_tok = 6 * L * (4 * D * D + 2 * D * F) + 12 * L * D * T
@@ -539,8 +556,7 @@ def _layer_train_bench(name, net, X, Y, iters, lr=0.01, flops_per_step=None,
         loss_box["l"] = step(X, Y)
 
     with auto_cast() if amp else contextlib.nullcontext():
-        dt = _time_steps(one, iters,
-                         lambda: jax.block_until_ready(loss_box["l"].value))
+        dt = _time_steps(one, iters, lambda: loss_box["l"].value)
     B = X.shape[0]
     samp_s = B / dt
     out = {"metric": f"samples_per_sec_per_chip_{name}",

@@ -60,7 +60,11 @@ def probe_once(cache: dict, key, thunk) -> bool:
             return True
         try:
             with jax.ensure_compile_time_eval():
-                jax.block_until_ready(jax.tree_util.tree_leaves(thunk()))
+                # device_get, not block_until_ready: an execution-time
+                # kernel failure must be caught HERE and mark the kernel
+                # unavailable (axon's block_until_ready can return before
+                # execution finishes, deferring the crash to the real call)
+                jax.device_get(jax.tree_util.tree_leaves(thunk()))
             cache[key] = False
         except Exception:
             cache[key] = True

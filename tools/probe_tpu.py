@@ -38,9 +38,12 @@ _CODE = (
     "import jax, json; import jax.numpy as jnp;"
     " d = jax.devices()[0];"
     " x = jnp.ones((128, 128), jnp.bfloat16);"
-    " y = (x @ x); y.block_until_ready();"
+    # fetch a VALUE, not block_until_ready: through axon the latter can
+    # return before execution, so a probe could report healthy without the
+    # chip ever doing the matmul
+    " s = float((x @ x).sum());"
     " print(json.dumps({'platform': d.platform,"
-    " 'kind': getattr(d, 'device_kind', '')}))"
+    " 'kind': getattr(d, 'device_kind', ''), 'sum': s}))"
 )
 
 
