@@ -23,6 +23,7 @@ suffixed `_cpu_fallback`) rather than nothing.
 """
 from __future__ import annotations
 
+import datetime
 import json
 import os
 import subprocess
@@ -93,27 +94,47 @@ def _peak_flops(dev) -> float:
     return 459e12 if dev.platform in ("tpu", "axon") else 1e12
 
 
-def _time_steps(run_one, iters, fetch):
-    """Steady-state step time: enqueue ``iters`` steps, then synchronize.
+def _sync_all(trees):
+    """Barrier: host-fetch one scalar data-dependent on EVERY leaf.
 
-    ``fetch()`` must return a (small) device value data-dependent on the
-    LAST step's output — the loss threaded through the state chain.  The
-    sync is a HOST TRANSFER (``jax.device_get``), deliberately not
+    The sync is a HOST TRANSFER (``jax.device_get``), deliberately not
     ``block_until_ready``: through the axon remote backend
     block_until_ready can return before execution finishes (round-4
     window 1 evidence: a 350M GPT rung "measured" 0.18 ms/step and MFU
     1288 — physically impossible; the ten enqueued steps only actually
-    ran when the loss was later fetched for the log line).  A transfer of
-    the value itself cannot complete early on any backend, because the
-    bytes do not exist until the dependency chain has executed."""
+    ran when the loss was later fetched for the log line).  And the
+    fetched value is a jitted reduction over the first element of every
+    leaf — params, optimizer moments, counters, loss — not the loss
+    alone: under a per-buffer-readiness backend, loss only proves the
+    last step's FORWARD finished; its backward + optimizer update are
+    outside loss's dependency cone and would fall outside the timer.
+    One compiled program, one scalar transfer, regardless of leaf count."""
     import jax
+    import jax.numpy as jnp
 
+    def _reduce(ts):
+        acc = jnp.zeros((), jnp.float32)
+        for leaf in jax.tree_util.tree_leaves(ts):
+            if hasattr(leaf, "ravel") and getattr(leaf, "size", 0):
+                acc = acc + leaf.ravel()[:1].astype(jnp.float32)[0]
+        return acc
+    # jax.jit caches by tree structure: compiled once per bench config
+    fn = _sync_all.__dict__.setdefault("_jit", jax.jit(_reduce))
+    return jax.device_get(fn(trees))
+
+
+def _time_steps(run_one, iters, fetch):
+    """Steady-state step time: enqueue ``iters`` steps, then synchronize.
+
+    ``fetch()`` must return the updated device state of the LAST step —
+    every tensor the step writes (params, optimizer state, loss), so the
+    ``_sync_all`` barrier covers the whole step, not just the forward."""
     run_one()  # compile + warmup
-    jax.device_get(fetch())
+    _sync_all(fetch())
     t0 = time.perf_counter()
     for _ in range(iters):
         run_one()
-    jax.device_get(fetch())
+    _sync_all(fetch())
     return (time.perf_counter() - t0) / iters
 
 
@@ -185,8 +206,18 @@ def _gpt_rungs():
          "bfloat16", 16, True),
         ("gpt_760m_fused_acc8_b8", dict(c760, remat=False), 8, 2048, 10,
          "bfloat16", 8, True),
+        # v5e-16GB tournament candidates (estimator-enumerated, ~14-15 GB):
+        # the no-remat fused 350M has zero recompute overhead (best MFU if
+        # it truly fits); the dots-remat pair trades ~mild recompute for a
+        # bigger model (760M) or a bigger micro-batch (350M Bm=8)
         ("gpt_350m_fused_acc2_b8", dict(c350, remat=False), 8, 2048, 10,
          "bfloat16", 2, True),
+        ("gpt_760m_fused_dots_acc4_b8",
+         dict(c760, remat=True, remat_policy="dots"), 8, 2048, 10,
+         "bfloat16", 4, True),
+        ("gpt_350m_fused_dots_b8",
+         dict(c350, remat=True, remat_policy="dots"), 8, 2048, 10,
+         "bfloat16", 1, True),
         ("gpt_1.3b_fused_remat_dots_b2",
          dict(c13, remat=True, remat_policy="dots"), 2, 2048, 10,
          "bfloat16", 1, True),
@@ -359,7 +390,7 @@ def _run_gpt_rung(idx: int):
     def one():
         st["state"], st["loss"] = step_fn(st["state"], toks, key, 2e-4)
 
-    dt = _time_steps(one, iters, lambda: st["loss"])
+    dt = _time_steps(one, iters, lambda: (st["state"], st["loss"]))
     tok_s = B * T / dt
     mfu = gpt.flops_per_token(cfg, T) * tok_s / _peak_flops(dev)
     _log(f"[bench] {name}: {tok_s:,.0f} tok/s  step={dt * 1e3:.1f}ms  "
@@ -367,6 +398,10 @@ def _run_gpt_rung(idx: int):
          f"device={dev.device_kind}")
     out = {"metric": f"tokens_per_sec_per_chip_{name}",
            "value": round(tok_s, 1), "unit": "tokens/s/chip",
+           # stamped so downstream joins (ablation_report) can refuse to
+           # pair measurements from different rounds/revisions
+           "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
+               timespec="seconds"),
            # the platform the rung ACTUALLY ran on: child mode (--gpt-rung)
            # skips the parent's backend probe, so without this field a
            # silent CPU fallback would be indistinguishable from a TPU
@@ -400,13 +435,33 @@ def bench_gpt(small: bool):
 
     # full ladder: one subprocess per rung so a hung/slow remote compile
     # cannot take down the whole bench (round-1 lesson), with a static
-    # HBM-footprint pre-filter so hopeless rungs don't burn 2-min OOM compiles
+    # HBM-footprint pre-filter so hopeless rungs don't burn 2-min OOM
+    # compiles.  TOURNAMENT (round-4): the rung *order* encodes an MFU
+    # guess, but the guess has been wrong before — so instead of
+    # headlining the first fitting rung, keep measuring until
+    # BENCH_LADDER_TOP rungs have succeeded (default 3) and headline the
+    # best measured MFU.  A wedged-tunnel abort still returns the best
+    # result so far, so a short window degrades to the old behavior.
     hbm = _hbm_bytes()
     rung_timeout = float(os.environ.get("BENCH_RUNG_TIMEOUT", "720"))
+    top_k = int(os.environ.get("BENCH_LADDER_TOP", "3"))
+    rungs = list(_gpt_rungs())
+    if os.environ.get("BENCH_PREFER_LADDER_HEADLINE"):
+        # ablation arm: measure the SAME rung the main ladder headlined so
+        # ablation_report gets a like-for-like pair; if it doesn't fit
+        # under this arm's estimates (e.g. no-flash adds the [H,T,T]
+        # scores), the normal walk below still produces a number
+        wd = _watchdog_tpu_result() or {}
+        head = (wd.get("headline") or {}).get("metric", "")
+        want = head.replace("tokens_per_sec_per_chip_", "")
+        rungs.sort(key=lambda r: r[0] != want)  # stable: preferred first
+    results = []
     last_fail = None
     timeouts = 0
     for i, (name, cfg_kwargs, B, T, iters, sd, accum, fused) in enumerate(
-            _gpt_rungs()):
+            rungs):
+        if len(results) >= top_k:
+            break
         if not _gpt_rung_fits(cfg_kwargs, B, T, sd, hbm, accum, fused):
             _log(f"[bench] {name}: skipped (estimated footprint exceeds "
                  f"{hbm / 1e9:.0f} GB HBM)")
@@ -437,7 +492,8 @@ def bench_gpt(small: bool):
             # child that quietly fell back to CPU mid-window must not
             # become the headline
             if r.get("device") in (None, "tpu", "axon"):
-                return r
+                results.append(r)
+                continue
             # a CPU child means the tunnel died between the parent probe
             # and the rung — later rungs would all do the same; stop the
             # ladder rather than walking every rung on the wrong backend
@@ -447,6 +503,18 @@ def bench_gpt(small: bool):
             break
         _log(f"[bench] {name}: failed rc={out.returncode}; trying next rung")
         last_fail = f"{name}: rc={out.returncode}"
+    if results:
+        best = max(results, key=lambda r: r.get("mfu", 0.0))
+        if len(results) > 1:
+            best = dict(best)
+            best["candidates"] = [
+                {"metric": r["metric"], "mfu": r.get("mfu"),
+                 "value": r.get("value"), "step_ms": r.get("step_ms")}
+                for r in results]
+        _log("[bench] tournament: "
+             + "; ".join(f"{r['metric']}={r.get('mfu')}" for r in results)
+             + f" -> headline {best['metric']}")
+        return best
     raise RuntimeError(f"all GPT rungs failed (last: {last_fail})")
 
 
@@ -514,7 +582,7 @@ def bench_bert(small: bool):
     def one():
         st["p"], st["o"], st["l"] = step(st["p"], st["o"], batch, 1)
 
-    dt = _time_steps(one, iters, lambda: st["l"])
+    dt = _time_steps(one, iters, lambda: (st["p"], st["o"], st["l"]))
     # matmul-weight flops: blocks + mlm head (tied wte, applied on K of T)
     D, F, L, V = cfg.hidden_size, cfg.ffn_size, cfg.num_layers, cfg.vocab_size
     per_tok = 6 * L * (4 * D * D + 2 * D * F) + 12 * L * D * T
@@ -556,7 +624,9 @@ def _layer_train_bench(name, net, X, Y, iters, lr=0.01, flops_per_step=None,
         loss_box["l"] = step(X, Y)
 
     with auto_cast() if amp else contextlib.nullcontext():
-        dt = _time_steps(one, iters, lambda: loss_box["l"].value)
+        dt = _time_steps(one, iters,
+                         lambda: (step._params, step._buffers,
+                                  step._opt_state, loss_box["l"].value))
     B = X.shape[0]
     samp_s = B / dt
     out = {"metric": f"samples_per_sec_per_chip_{name}",

@@ -1,0 +1,121 @@
+"""The ablation assembler's flash on/off join (tools/ablation_report.py).
+
+The ladder is a tournament, so the flash and noflash arms may headline
+different rungs; the join must pair them through the headline's
+``candidates`` table, and record what each arm measured when no rung is
+shared (an honest mismatch, not "incomplete" silence).
+"""
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def ab(tmp_path, monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "ablation_under_test", os.path.join(REPO, "tools",
+                                            "ablation_report.py"))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    monkeypatch.setattr(m, "REPO", str(tmp_path))
+    return m, tmp_path
+
+
+def _write(tmp, name, obj):
+    with open(os.path.join(str(tmp), name), "w") as f:
+        json.dump(obj, f)
+
+
+def _noflash(tmp, rung, value, **over):
+    """A noflash arm record that passes the provenance guard by default."""
+    import datetime
+
+    rec = {"metric": f"tokens_per_sec_per_chip_{rung}", "value": value,
+           "device": "tpu", "flash": False,
+           "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
+               timespec="seconds")}
+    rec.update(over)
+    _write(tmp, "noflash.json", rec)
+
+
+def _ladder(tmp, headline_rung, mfu, candidates=()):
+    _write(tmp, "WATCHDOG_RESULTS.json", {"steps": {"ladder": {
+        "ok": True, "headline": {
+            "metric": f"tokens_per_sec_per_chip_{headline_rung}",
+            "value": mfu * 1e5, "mfu": mfu, "device": "tpu",
+            "candidates": [
+                {"metric": f"tokens_per_sec_per_chip_{n}", "mfu": m,
+                 "value": m * 1e5, "step_ms": 1.0}
+                for n, m in candidates]}}}})
+
+
+def _run(ab_mod, tmp):
+    ab_mod.main()
+    with open(os.path.join(str(tmp), "ABLATION.json")) as f:
+        return json.load(f)
+
+
+def test_join_through_candidates_when_headlines_differ(ab):
+    m, tmp = ab
+    _ladder(tmp, "gpt_760m_fused_dots_acc4_b8", 0.4,
+            candidates=[("gpt_350m_fused_acc2_b8", 0.3),
+                        ("gpt_760m_fused_dots_acc4_b8", 0.4)])
+    # noflash arm headlined a DIFFERENT rung — but one the flash arm also
+    # measured as a tournament candidate
+    _noflash(tmp, "gpt_350m_fused_acc2_b8", 2.0e4, mfu=0.2)
+    fl = _run(m, tmp)["flash_ablation"]
+    assert fl["config"] == "tokens_per_sec_per_chip_gpt_350m_fused_acc2_b8"
+    assert fl["tok_s_flash_on"] == pytest.approx(0.3e5)
+    assert fl["tok_s_flash_off"] == pytest.approx(2.0e4)
+    assert fl["speedup"] == pytest.approx(1.5)
+
+
+def test_same_headline_still_joins(ab):
+    m, tmp = ab
+    _ladder(tmp, "gpt_350m_fused_acc2_b8", 0.3)
+    _noflash(tmp, "gpt_350m_fused_acc2_b8", 1.5e4)
+    fl = _run(m, tmp)["flash_ablation"]
+    assert fl["speedup"] == pytest.approx(0.3e5 / 1.5e4)
+
+
+def test_disjoint_rungs_record_both_sides(ab):
+    m, tmp = ab
+    _ladder(tmp, "gpt_760m_fused_dots_acc4_b8", 0.4)
+    _noflash(tmp, "gpt_350m_remat_b8", 1e4)
+    fl = _run(m, tmp)["flash_ablation"]
+    assert fl["status"] == "incomplete"
+    assert fl["ladder_rungs"] == [
+        "tokens_per_sec_per_chip_gpt_760m_fused_dots_acc4_b8"]
+    assert fl["noflash_rungs"] == [
+        "tokens_per_sec_per_chip_gpt_350m_remat_b8"]
+
+
+def test_missing_noflash_is_incomplete(ab):
+    m, tmp = ab
+    _ladder(tmp, "gpt_350m_fused_acc2_b8", 0.3)
+    fl = _run(m, tmp)["flash_ablation"]
+    assert fl["status"] == "incomplete" and fl["have_noflash"] is False
+
+
+def test_stale_or_unprovenanced_noflash_is_dropped(ab):
+    m, tmp = ab
+    _ladder(tmp, "gpt_350m_fused_acc2_b8", 0.3)
+    # same rung, but measured by a previous round (old ts) — must not pair
+    _noflash(tmp, "gpt_350m_fused_acc2_b8", 1.5e4,
+             ts="2026-07-01T00:00:00+00:00")
+    fl = _run(m, tmp)["flash_ablation"]
+    assert fl["status"] == "incomplete" and fl["have_noflash"] is False
+
+    # unstamped old-schema file: also stale
+    _noflash(tmp, "gpt_350m_fused_acc2_b8", 1.5e4, ts=None)
+    fl = _run(m, tmp)["flash_ablation"]
+    assert fl["status"] == "incomplete"
+
+    # flash flag missing (not measured with the kernel off): dropped
+    _noflash(tmp, "gpt_350m_fused_acc2_b8", 1.5e4, flash=True)
+    fl = _run(m, tmp)["flash_ablation"]
+    assert fl["status"] == "incomplete"

@@ -1,0 +1,176 @@
+"""The bench GPT ladder's tournament selection (bench.py::bench_gpt).
+
+The ladder's rung order encodes an MFU *guess*; the tournament measures up
+to BENCH_LADDER_TOP fitting rungs and headlines the best MEASURED MFU, so
+a wrong guess costs a few extra minutes instead of the round's headline
+number.  Control flow is tested like product code (cf. test_watchdog.py):
+rung children, the HBM pre-filter, and the wedge-abort are faked.
+"""
+import importlib.util
+import json
+import os
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def bench(monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py"))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    # deterministic environment: every rung "fits", 3-rung tournament
+    monkeypatch.setattr(m, "_hbm_bytes", lambda: 16e9)
+    monkeypatch.setattr(
+        m, "_gpt_rung_fits",
+        lambda cfg_kwargs, B, T, sd, hbm, accum=1, fused=False: True)
+    monkeypatch.delenv("BENCH_LADDER_TOP", raising=False)
+    monkeypatch.delenv("BENCH_RUNG_TIMEOUT", raising=False)
+    return m
+
+
+def _rungs(m, monkeypatch, names):
+    monkeypatch.setattr(
+        m, "_gpt_rungs",
+        lambda: [(n, {}, 8, 2048, 10, "bfloat16", 1, False) for n in names])
+
+
+class _Done:
+    def __init__(self, rc=0, stdout="", stderr=""):
+        self.returncode, self.stdout, self.stderr = rc, stdout, stderr
+
+
+def _child_results(m, monkeypatch, by_name):
+    """Fake the per-rung subprocess: by_name[rung] is a result dict, an
+    int (nonzero rc), or 'timeout'."""
+    calls = []
+
+    def fake_run(argv, capture_output, text, timeout):
+        name = argv[argv.index("--gpt-rung") + 1]
+        calls.append(name)
+        spec = by_name[name]
+        if spec == "timeout":
+            raise subprocess.TimeoutExpired(argv, timeout)
+        if isinstance(spec, int):
+            return _Done(rc=spec)
+        return _Done(stdout=json.dumps(spec) + "\n")
+
+    monkeypatch.setattr(m.subprocess, "run", fake_run)
+    return calls
+
+
+def _r(name, mfu, device="tpu"):
+    return {"metric": f"tokens_per_sec_per_chip_{name}", "mfu": mfu,
+            "value": mfu * 1e5, "step_ms": 100.0, "device": device}
+
+
+def test_headline_is_best_mfu_not_first_success(bench, monkeypatch):
+    _rungs(bench, monkeypatch, ["a", "b", "c", "d"])
+    calls = _child_results(bench, monkeypatch, {
+        "a": _r("a", 0.21), "b": _r("b", 0.34), "c": _r("c", 0.28),
+        "d": _r("d", 0.9)})
+    out = bench.bench_gpt(small=False)
+    # top_k=3 default: 'd' must never run; best of a/b/c wins
+    assert calls == ["a", "b", "c"]
+    assert out["metric"] == "tokens_per_sec_per_chip_b"
+    assert [c["mfu"] for c in out["candidates"]] == [0.21, 0.34, 0.28]
+
+
+def test_failed_rungs_dont_count_toward_top_k(bench, monkeypatch):
+    _rungs(bench, monkeypatch, ["a", "b", "c", "d"])
+    calls = _child_results(bench, monkeypatch, {
+        "a": 1, "b": _r("b", 0.2), "c": 1, "d": _r("d", 0.3)})
+    out = bench.bench_gpt(small=False)
+    assert calls == ["a", "b", "c", "d"]
+    assert out["metric"] == "tokens_per_sec_per_chip_d"
+
+
+def test_two_timeouts_abort_with_best_so_far(bench, monkeypatch):
+    _rungs(bench, monkeypatch, ["a", "b", "c", "d"])
+    calls = _child_results(bench, monkeypatch, {
+        "a": _r("a", 0.25), "b": "timeout", "c": "timeout",
+        "d": _r("d", 0.5)})
+    out = bench.bench_gpt(small=False)
+    # wedge abort after b+c; a's measurement survives as the headline
+    assert calls == ["a", "b", "c"]
+    assert out["metric"] == "tokens_per_sec_per_chip_a"
+    assert "candidates" not in out  # single result: no tournament table
+
+
+def test_cpu_child_aborts_ladder_keeps_best(bench, monkeypatch):
+    _rungs(bench, monkeypatch, ["a", "b", "c"])
+    calls = _child_results(bench, monkeypatch, {
+        "a": _r("a", 0.25), "b": _r("b", 0.9, device="cpu"),
+        "c": _r("c", 0.95)})
+    out = bench.bench_gpt(small=False)
+    assert calls == ["a", "b"]  # CPU fallback child ends the ladder
+    assert out["metric"] == "tokens_per_sec_per_chip_a"
+
+
+def test_all_rungs_failing_raises(bench, monkeypatch):
+    _rungs(bench, monkeypatch, ["a", "b"])
+    _child_results(bench, monkeypatch, {"a": 1, "b": 1})
+    with pytest.raises(RuntimeError):
+        bench.bench_gpt(small=False)
+
+
+def test_top_k_env_override(bench, monkeypatch):
+    monkeypatch.setenv("BENCH_LADDER_TOP", "1")
+    _rungs(bench, monkeypatch, ["a", "b"])
+    calls = _child_results(bench, monkeypatch, {
+        "a": _r("a", 0.2), "b": _r("b", 0.8)})
+    out = bench.bench_gpt(small=False)
+    assert calls == ["a"]
+    assert out["metric"] == "tokens_per_sec_per_chip_a"
+
+
+def test_unfit_rungs_are_skipped_entirely(bench, monkeypatch):
+    bench._gpt_rung_fits = (
+        lambda cfg_kwargs, B, T, sd, hbm, accum=1, fused=False: False)
+    _rungs(bench, monkeypatch, ["a"])
+    _child_results(bench, monkeypatch, {})
+    with pytest.raises(RuntimeError):
+        bench.bench_gpt(small=False)
+
+
+def test_new_fused_rungs_exist_and_fit_16gb(bench):
+    """The v5e tournament candidates must stay in the ladder AND stay
+    under the calibrated 16 GB estimate (the whole point of adding them)."""
+    # marker-independent: query the list with the fused gate forced open
+    bench._fused_kernels_ok = lambda: True
+    rungs = {r[0]: r for r in bench._gpt_rungs()}
+    for name in ("gpt_350m_fused_acc2_b8", "gpt_760m_fused_dots_acc4_b8",
+                 "gpt_350m_fused_dots_b8"):
+        assert name in rungs, name
+        _, kw, B, T, _, sd, accum, fused = rungs[name]
+        est = bench._gpt_rung_estimate(kw, B, T, sd, accum, fused)
+        assert est <= 16e9, (name, est)
+
+
+def test_prefer_ladder_headline_reorders_walk(bench, monkeypatch):
+    monkeypatch.setenv("BENCH_PREFER_LADDER_HEADLINE", "1")
+    monkeypatch.setenv("BENCH_LADDER_TOP", "1")
+    monkeypatch.setattr(bench, "_watchdog_tpu_result", lambda: {
+        "headline": {"metric": "tokens_per_sec_per_chip_c"}})
+    _rungs(bench, monkeypatch, ["a", "b", "c"])
+    calls = _child_results(bench, monkeypatch, {
+        "a": _r("a", 0.2), "b": _r("b", 0.3), "c": _r("c", 0.1)})
+    out = bench.bench_gpt(small=False)
+    assert calls == ["c"]  # the main ladder's headline rung goes first
+    assert out["metric"] == "tokens_per_sec_per_chip_c"
+
+
+def test_prefer_headline_without_watchdog_result_keeps_order(bench,
+                                                             monkeypatch):
+    monkeypatch.setenv("BENCH_PREFER_LADDER_HEADLINE", "1")
+    monkeypatch.setenv("BENCH_LADDER_TOP", "1")
+    monkeypatch.setattr(bench, "_watchdog_tpu_result", lambda: None)
+    _rungs(bench, monkeypatch, ["a", "b"])
+    calls = _child_results(bench, monkeypatch, {
+        "a": _r("a", 0.2), "b": _r("b", 0.3)})
+    out = bench.bench_gpt(small=False)
+    assert calls == ["a"]
+    assert out["metric"] == "tokens_per_sec_per_chip_a"

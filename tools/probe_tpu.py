@@ -169,8 +169,14 @@ def _payload_steps():
         ("all", [py, bench, "--all"], 7200,
          {"BENCH_RUNG_TIMEOUT": "540", "BENCH_REUSE_LADDER": "1"},
          None, None),
+        # LADDER_TOP=1: the ablation arm needs one measured rung, not a
+        # tournament — three successes under the 2700s budget would risk a
+        # step timeout that watch() reads as a re-wedged tunnel (closing a
+        # healthy window); ablation_report joins the arms on any shared
+        # rung via the headline's candidates table
         ("noflash", [py, bench], 2700,
-         {"PADDLE_TPU_NO_FLASH": "1", "BENCH_RUNG_TIMEOUT": "480"},
+         {"PADDLE_TPU_NO_FLASH": "1", "BENCH_RUNG_TIMEOUT": "480",
+          "BENCH_LADDER_TOP": "1", "BENCH_PREFER_LADDER_HEADLINE": "1"},
          os.path.join(REPO, "noflash.json"), None),
         # like-for-like fused-LN/CE kernel A/B: the SAME 350M config
         # (B=8, T=2048, accum=2) with and without the Pallas fused
