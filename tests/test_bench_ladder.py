@@ -174,3 +174,14 @@ def test_prefer_headline_without_watchdog_result_keeps_order(bench,
     out = bench.bench_gpt(small=False)
     assert calls == ["a"]
     assert out["metric"] == "tokens_per_sec_per_chip_a"
+
+
+def test_tournament_budget_stops_after_banked_result(bench, monkeypatch):
+    monkeypatch.setenv("BENCH_TOURNAMENT_BUDGET", "0")  # instant exhaustion
+    _rungs(bench, monkeypatch, ["a", "b", "c"])
+    calls = _child_results(bench, monkeypatch, {
+        "a": _r("a", 0.2), "b": _r("b", 0.8), "c": _r("c", 0.9)})
+    out = bench.bench_gpt(small=False)
+    # the first rung banks a result; the exhausted budget stops the rest
+    assert calls == ["a"]
+    assert out["metric"] == "tokens_per_sec_per_chip_a"

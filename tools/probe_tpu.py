@@ -162,7 +162,11 @@ def _payload_steps():
         ("flash_check", [py, os.path.join(REPO, "tools",
                                           "check_flash_tpu.py")], 2400, {},
          None, None),
-        ("ladder", [py, bench], 5400, {"BENCH_RUNG_TIMEOUT": "540"},
+        # tournament budget raised to most of the step budget: the
+        # watchdog window is WHERE the 3-rung tournament should spend
+        # time (the driver's own bench run keeps the tight 1500s default)
+        ("ladder", [py, bench], 5400, {"BENCH_RUNG_TIMEOUT": "540",
+                                       "BENCH_TOURNAMENT_BUDGET": "4500"},
          None, None),
         # --all reuses the ladder step's fresh GPT headline instead of
         # re-measuring the whole ladder inside the same window
