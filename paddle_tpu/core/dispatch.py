@@ -54,22 +54,43 @@ def dispatch(fn: Callable, *args, op_name: str = "", **kwargs):
         out = fn(*vals, **kwargs)
         return _wrap_outputs(out, node=None)
 
-    def pure(*diff_vals):
-        call_vals = list(vals)
-        for i, v in zip(diff_idx, diff_vals):
-            call_vals[i] = v
-        return fn(*call_vals, **kwargs)
-
-    out, vjp_fn = jax.vjp(pure, *[vals[i] for i in diff_idx])
+    # Forward runs ONCE, eagerly — VJP construction is DEFERRED to
+    # backward time (recompute-based tape).  Building jax.vjp here cost a
+    # full linearizing retrace on every op call (~25x the raw-jax eager
+    # latency, measured); deferring it makes grad-mode forward as cheap as
+    # no-grad mode, and drops the held residuals to just the input values
+    # (jax arrays are immutable, so the captured vals can't be mutated
+    # between forward and backward; ops that sample — dropout etc. — bind
+    # their PRNG key OUTSIDE the dispatched fn, so the recompute replays
+    # the identical mask).  The backward recomputes the op's forward — the
+    # reference instead stores activations (imperative/basic_engine.cc),
+    # but per-op recompute is the TPU-first trade: eager latency is Python
+    # dispatch-bound, while throughput training goes through the jitted
+    # TrainStep where none of this machinery runs.
+    out = fn(*vals, **kwargs)
 
     multi = isinstance(out, tuple)
     outs = out if multi else (out,)
     out_avals = [
         (o.shape, o.dtype if _is_float_aval(o) else jax.dtypes.float0) for o in outs
     ]
-    # backward always hands a tuple of cotangents; jax.vjp expects the fn's
-    # exact output structure, so unwrap for single-output ops
-    tape_vjp = vjp_fn if multi else (lambda cts, _f=vjp_fn: _f(cts[0]))
+
+    def tape_vjp(cts, _vals=tuple(vals), _diff=tuple(diff_idx), _memo=[]):
+        if not _memo:
+            def pure(*diff_vals):
+                call_vals = list(_vals)
+                for i, v in zip(_diff, diff_vals):
+                    call_vals[i] = v
+                return fn(*call_vals, **kwargs)
+
+            # memoized: a retain_graph=True graph backwarded k times pays
+            # the linearizing trace once, not k times (the node drops this
+            # whole closure after a non-retained backward anyway)
+            _memo.append(jax.vjp(pure, *[_vals[i] for i in _diff])[1])
+        vjp_fn = _memo[0]
+        # backward always hands a tuple of cotangents; jax.vjp expects
+        # the fn's exact output structure, so unwrap for single-output ops
+        return vjp_fn(tuple(cts) if multi else cts[0])
     node = autograd.record(
         tape_vjp, [args[i] for i in diff_idx], out_avals, name=op_name or getattr(fn, "__name__", "op")
     )
