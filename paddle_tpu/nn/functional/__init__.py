@@ -271,8 +271,12 @@ def _pair(v, n=2):
     return (int(v),) * n
 
 
-def _conv_nd(a, w, bias, stride, padding, dilation, groups, nd, data_format):
+def _conv_nd(a, w, bias, stride, padding, dilation, groups, nd, data_format,
+             preferred_element_type=None):
     # a: N C ...spatial (NCHW api); w stored [out_c, in_c/groups, *k] (reference layout)
+    # preferred_element_type: accumulation dtype override — the int8
+    # inference path (quantization/int8_infer.py) requests s32 accumulation
+    # for s8 x s8 convolutions
     chan_last = data_format in ("NHWC", "NLC", "NDHWC")
     if chan_last:
         a = jnp.moveaxis(a, -1, 1)
@@ -293,6 +297,7 @@ def _conv_nd(a, w, bias, stride, padding, dilation, groups, nd, data_format):
         a, w, window_strides=stride, padding=pad,
         rhs_dilation=dilation, feature_group_count=groups,
         dimension_numbers=dn,
+        preferred_element_type=preferred_element_type,
     )
     if bias is not None:
         out = out + bias.reshape((1, -1) + (1,) * nd)

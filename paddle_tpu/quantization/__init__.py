@@ -23,9 +23,12 @@ import numpy as np
 from .quant_layers import FakeQuant, QuantedConv2D, QuantedLinear, fake_quant
 from .qat import QAT, ImperativeQuantAware
 from .ptq import PostTrainingQuantization, kl_threshold
+from .int8_infer import (Int8Conv2D, Int8Linear, convert_to_int8,
+                         quantize_weight)
 
 __all__ = [
     "FakeQuant", "fake_quant", "QuantedLinear", "QuantedConv2D",
     "QAT", "ImperativeQuantAware",
     "PostTrainingQuantization", "kl_threshold",
+    "Int8Linear", "Int8Conv2D", "convert_to_int8", "quantize_weight",
 ]

@@ -1,0 +1,188 @@
+"""Real int8 inference execution: quantized matmul/conv on the int8 MXU.
+
+Reference capability: the int8 DEPLOY half of slim/quantization — the
+reference hands calibrated models to TensorRT/MKLDNN engines
+(post_training_quantization.py + inference/tensorrt int8 paths).  A TPU has
+no external engine to delegate to, and none is needed: the MXU natively
+multiplies s8 x s8 into s32 (at twice the bf16 peak on v5e), and XLA lowers
+integer dot/conv directly.  So the TPU-native deploy path is a LAYER SWAP:
+
+    ptq = PostTrainingQuantization(model, loader).quantize()
+    int8_model = convert_to_int8(model, ptq)      # Int8Linear/Int8Conv2D
+    y = int8_model(x)                             # s8 MXU matmuls inside
+
+Math (symmetric, qmax = 2^(bits-1) - 1 = 127):
+    qx = clip(round(x / sx * qmax))      int8, per-tensor calibrated sx
+    qw = clip(round(w / sw * qmax))      int8, per-OUTPUT-CHANNEL sw
+    y  = (qx . qw) * sx * sw / qmax^2 + b     (int32 exact accumulation)
+
+The int32 accumulation makes the quantized contraction EXACT — the only
+error vs fp32 is the input/weight rounding itself, which is the same error
+the QAT/PTQ fake-quant model trains against.  Per-channel weight scales
+cost nothing at inference (one fp32 multiply per output channel, fused by
+XLA into the dequant) and are the accuracy standard for deploy.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor
+from ..nn.layer.common import Linear
+from ..nn.layer.conv import Conv2D
+from ..nn.layer_base import Layer
+
+__all__ = ["quantize_weight", "Int8Linear", "Int8Conv2D", "convert_to_int8"]
+
+
+def quantize_weight(w: np.ndarray, channel_axis: int | None = None,
+                    bits: int = 8):
+    """Symmetric int8 weight quantization.
+
+    channel_axis: the OUTPUT-channel axis for per-channel scales (None =
+    per-tensor).  Returns (q int8 ndarray, scale fp32 ndarray — scalar or
+    per-channel vector)."""
+    qmax = 2 ** (bits - 1) - 1
+    w = np.asarray(w, np.float32)
+    if channel_axis is None:
+        scale = np.maximum(np.abs(w).max(), 1e-8).astype(np.float32)
+    else:
+        red = tuple(i for i in range(w.ndim) if i != channel_axis)
+        scale = np.maximum(np.abs(w).max(axis=red), 1e-8).astype(np.float32)
+        shape = [1] * w.ndim
+        shape[channel_axis] = -1
+        scale = scale.reshape(shape)
+    q = np.clip(np.round(w / scale * qmax), -qmax, qmax).astype(np.int8)
+    return q, np.float32(scale)
+
+
+def _quantize_act(x, scale, qmax):
+    return jnp.clip(jnp.round(x / scale * qmax), -qmax, qmax).astype(jnp.int8)
+
+
+def _adopt_bias(layer: Layer, bias):
+    """Take the float bias as a STOP-GRADIENT BUFFER, not a Parameter: an
+    int8 layer is a deploy artifact — exposing a trainable bias (while the
+    weight is frozen int8) would let an optimizer silently fine-tune only
+    biases, and a grad-tracked bias makes every inference call pay
+    grad-mode dispatch."""
+    if bias is None:
+        layer.bias = None
+    else:
+        layer.register_buffer("bias", Tensor(bias.value,
+                                             stop_gradient=True))
+
+
+class Int8Linear(Layer):
+    """Inference-only Linear running y = xW + b as an s8xs8->s32 MXU dot.
+
+    Built from a float Linear + a calibrated activation scale; weights are
+    quantized per-output-channel at construction."""
+
+    def __init__(self, inner: Linear, act_scale: float, bits: int = 8):
+        super().__init__()
+        w = np.asarray(inner.weight.value)
+        q, sw = quantize_weight(w, channel_axis=1, bits=bits)  # W: [in, out]
+        self.bits = bits
+        self.register_buffer("qweight", Tensor(jnp.asarray(q),
+                                               stop_gradient=True))
+        # [1, out] -> [out]: broadcasting over the batch dims is implicit
+        self.register_buffer("w_scale", Tensor(jnp.asarray(sw.reshape(-1)),
+                                               stop_gradient=True))
+        self.act_scale = float(act_scale)
+        _adopt_bias(self, getattr(inner, "bias", None))
+
+    def forward(self, x):
+        qmax = float(2 ** (self.bits - 1) - 1)
+        args = (x, self.qweight, self.w_scale) + (
+            (self.bias,) if self.bias is not None else ())
+
+        def fn(xv, qw, sw, *b):
+            qx = _quantize_act(xv, self.act_scale, qmax)
+            acc = jax.lax.dot_general(
+                qx, qw, (((qx.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            y = acc.astype(jnp.float32) * (self.act_scale / qmax) \
+                * (sw.astype(jnp.float32) / qmax)
+            if b:
+                y = y + b[0].astype(jnp.float32)
+            return y.astype(xv.dtype)
+
+        return dispatch(fn, *args, op_name="int8_linear")
+
+    def extra_repr(self):
+        return (f"in={self.qweight.shape[0]}, out={self.qweight.shape[1]}, "
+                f"bits={self.bits}")
+
+
+class Int8Conv2D(Layer):
+    """Inference-only Conv2D as an s8xs8->s32 convolution (OIHW weights,
+    per-output-channel scales)."""
+
+    def __init__(self, inner: Conv2D, act_scale: float, bits: int = 8):
+        super().__init__()
+        if inner.data_format != "NCHW":
+            raise NotImplementedError("int8 conv: NCHW only")
+        w = np.asarray(inner.weight.value)  # OIHW
+        q, sw = quantize_weight(w, channel_axis=0, bits=bits)
+        self.bits = bits
+        self.register_buffer("qweight", Tensor(jnp.asarray(q),
+                                               stop_gradient=True))
+        self.register_buffer("w_scale", Tensor(
+            jnp.asarray(sw.reshape(-1)), stop_gradient=True))
+        self.act_scale = float(act_scale)
+        _adopt_bias(self, getattr(inner, "bias", None))
+        self._stride = inner.stride
+        self._padding = inner.padding
+        self._dilation = inner.dilation
+        self._groups = inner.groups
+
+    def forward(self, x):
+        from ..nn.functional import _conv_nd
+
+        qmax = float(2 ** (self.bits - 1) - 1)
+        args = (x, self.qweight, self.w_scale) + (
+            (self.bias,) if self.bias is not None else ())
+
+        def fn(xv, qw, sw, *b):
+            qx = _quantize_act(xv, self.act_scale, qmax)
+            acc = _conv_nd(qx, qw, None, self._stride, self._padding,
+                           self._dilation, self._groups, 2, "NCHW",
+                           preferred_element_type=jnp.int32)
+            y = acc.astype(jnp.float32) * (self.act_scale / qmax) \
+                * (sw.astype(jnp.float32) / qmax)[None, :, None, None]
+            if b:
+                y = y + b[0].astype(jnp.float32)[None, :, None, None]
+            return y.astype(xv.dtype)
+
+        return dispatch(fn, *args, op_name="int8_conv2d")
+
+
+def convert_to_int8(model: Layer, ptq_result: dict, bits: int | None = None
+                    ) -> Layer:
+    """In-place swap of calibrated Linear/Conv2D sublayers for int8 twins.
+
+    ptq_result: the dict returned by PostTrainingQuantization.quantize()
+    (only ``act_scales`` and ``bits`` are consulted — weights are
+    re-quantized per-channel from the live float weights, which is finer
+    than the PTQ export's per-tensor int8).  Layers without a calibrated
+    activation scale are left float."""
+    bits = bits or ptq_result.get("bits", 8)
+    scales = ptq_result["act_scales"]
+
+    def swap(layer: Layer, prefix: str):
+        for name, child in list(layer.named_children()):
+            qual = f"{prefix}.{name}" if prefix else name
+            if isinstance(child, Linear) and qual in scales:
+                setattr(layer, name, Int8Linear(child, scales[qual], bits))
+            elif isinstance(child, Conv2D) and qual in scales:
+                setattr(layer, name, Int8Conv2D(child, scales[qual], bits))
+            else:
+                swap(child, qual)
+
+    swap(model, "")
+    model.eval()
+    return model

@@ -136,15 +136,15 @@ class PostTrainingQuantization:
             self.model.train()
 
         # 2) thresholds + int8 weights
-        qmax = 2 ** (self.bits - 1) - 1
         out = {"bits": self.bits, "act_scales": {}, "weights": {},
                "weight_scales": {}}
         for name, layer in self._quantizable():
             out["act_scales"][name] = observers[name].threshold(self.bits)
-            w = np.asarray(layer.weight.value)
-            scale = max(float(np.abs(w).max()), 1e-8)
-            out["weight_scales"][name] = scale
-            out["weights"][name] = np.clip(
-                np.round(w / scale * qmax), -qmax, qmax).astype(np.int8)
+            from .int8_infer import quantize_weight
+
+            q, scale = quantize_weight(np.asarray(layer.weight.value),
+                                       bits=self.bits)
+            out["weight_scales"][name] = float(scale)
+            out["weights"][name] = q
         self.act_scales = out["act_scales"]
         return out

@@ -1,0 +1,147 @@
+"""Int8 inference execution (quantization/int8_infer.py).
+
+The reference deploys calibrated int8 models through TensorRT/MKLDNN
+engines; the TPU-native path executes s8 x s8 -> s32 contractions directly
+on the MXU.  The quantized contraction is EXACT (int32 accumulation), so
+the int8 layer must match the explicit dequantized-numpy math to fp32
+rounding — not just "be close".
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.quantization import (Int8Conv2D, Int8Linear,
+                                     PostTrainingQuantization,
+                                     convert_to_int8, quantize_weight)
+
+RNG = np.random.default_rng(7)
+
+
+def _ref_int8_linear(x, w, b, sx, bits=8):
+    """Plain-numpy reference of the exact quantized math."""
+    qmax = 2 ** (bits - 1) - 1
+    qx = np.clip(np.round(x / sx * qmax), -qmax, qmax).astype(np.int64)
+    q, sw = quantize_weight(w, channel_axis=1, bits=bits)
+    acc = qx @ q.astype(np.int64)
+    y = acc.astype(np.float64) * (sx / qmax) * (sw.reshape(-1) / qmax)
+    return (y + (b if b is not None else 0.0)).astype(np.float32)
+
+
+def test_int8_linear_matches_exact_quantized_math():
+    lin = nn.Linear(32, 16)
+    x = RNG.standard_normal((8, 32)).astype(np.float32)
+    sx = float(np.abs(x).max())
+    qlin = Int8Linear(lin, act_scale=sx)
+    got = np.asarray(qlin(Tensor(jnp.asarray(x))).value)
+    want = _ref_int8_linear(x, np.asarray(lin.weight.value),
+                            np.asarray(lin.bias.value), sx)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_int8_linear_close_to_float_layer():
+    lin = nn.Linear(64, 32)
+    x = RNG.standard_normal((16, 64)).astype(np.float32)
+    ref = np.asarray(lin(Tensor(jnp.asarray(x))).value)
+    qlin = Int8Linear(lin, act_scale=float(np.abs(x).max()))
+    got = np.asarray(qlin(Tensor(jnp.asarray(x))).value)
+    # int8 rounding error: well under 1% of the output scale
+    assert np.abs(got - ref).max() < 0.01 * np.abs(ref).max() + 0.02
+
+
+def test_per_channel_beats_per_tensor_on_skewed_weights():
+    """A layer whose output channels have wildly different weight ranges —
+    the case per-channel scales exist for."""
+    lin = nn.Linear(32, 8, bias_attr=False)
+    w = RNG.standard_normal((32, 8)).astype(np.float32)
+    w[:, 0] *= 100.0  # one loud channel would swamp a per-tensor scale
+    lin.weight._value = jnp.asarray(w)
+    x = RNG.standard_normal((64, 32)).astype(np.float32)
+    ref = x @ w
+    sx = float(np.abs(x).max())
+    got_pc = np.asarray(Int8Linear(lin, act_scale=sx)(
+        Tensor(jnp.asarray(x))).value)
+    # per-tensor reference: quantize the whole matrix with one scale
+    qmax = 127
+    sw = np.abs(w).max()
+    qw = np.clip(np.round(w / sw * qmax), -qmax, qmax)
+    qx = np.clip(np.round(x / sx * qmax), -qmax, qmax)
+    got_pt = (qx @ qw) * (sx / qmax) * (sw / qmax)
+    err_pc = np.abs(got_pc - ref)[:, 1:].mean()  # quiet channels
+    err_pt = np.abs(got_pt - ref)[:, 1:].mean()
+    assert err_pc < err_pt / 5, (err_pc, err_pt)
+
+
+def test_int8_conv_matches_float_within_quant_error():
+    conv = nn.Conv2D(3, 8, 3, padding=1)
+    x = RNG.standard_normal((2, 3, 16, 16)).astype(np.float32)
+    ref = np.asarray(conv(Tensor(jnp.asarray(x))).value)
+    qconv = Int8Conv2D(conv, act_scale=float(np.abs(x).max()))
+    got = np.asarray(qconv(Tensor(jnp.asarray(x))).value)
+    assert np.abs(got - ref).max() < 0.02 * np.abs(ref).max() + 0.02
+
+
+def test_int8_conv_stride_groups_padding():
+    conv = nn.Conv2D(4, 8, 3, stride=2, padding=2, groups=2)
+    x = RNG.standard_normal((2, 4, 12, 12)).astype(np.float32)
+    ref = np.asarray(conv(Tensor(jnp.asarray(x))).value)
+    got = np.asarray(Int8Conv2D(conv, act_scale=float(np.abs(x).max()))(
+        Tensor(jnp.asarray(x))).value)
+    assert got.shape == ref.shape
+    assert np.abs(got - ref).max() < 0.03 * np.abs(ref).max() + 0.03
+
+
+class _SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2D(1, 8, 3, padding=1)
+        self.conv2 = nn.Conv2D(8, 16, 3, stride=2, padding=1)
+        self.fc = nn.Linear(16 * 7 * 7, 10)
+
+    def forward(self, x):
+        h = nn.functional.relu(self.conv1(x))
+        h = nn.functional.relu(self.conv2(h))
+        return self.fc(paddle.reshape(h, (h.shape[0], -1)))
+
+
+def test_ptq_convert_pipeline_end_to_end():
+    """Calibrate -> convert_to_int8 -> run: the deploy path in one piece."""
+    net = _SmallNet()
+    calib = [RNG.standard_normal((4, 1, 14, 14)).astype(np.float32)
+             for _ in range(4)]
+    ptq = PostTrainingQuantization(net, calib, algo="abs_max").quantize()
+    assert set(ptq["act_scales"]) == {"conv1", "conv2", "fc"}
+
+    x = calib[0]
+    ref = np.asarray(net(Tensor(jnp.asarray(x))).value)
+    qnet = convert_to_int8(net, ptq)
+    # every quantizable sublayer swapped; the swap is in-place
+    assert isinstance(qnet.conv1, Int8Conv2D)
+    assert isinstance(qnet.conv2, Int8Conv2D)
+    assert isinstance(qnet.fc, Int8Linear)
+    got = np.asarray(qnet(Tensor(jnp.asarray(x))).value)
+    # error compounds across 3 quantized layers; logits stay close
+    assert np.abs(got - ref).max() < 0.05 * np.abs(ref).max() + 0.05
+    # weights really are int8 buffers (deploy artifact, not fake-quant)
+    assert np.asarray(qnet.conv1.qweight.value).dtype == np.int8
+
+
+def test_uncalibrated_layers_stay_float():
+    net = _SmallNet()
+    ptq = {"bits": 8, "act_scales": {"fc": 1.0}}
+    convert_to_int8(net, ptq)
+    assert isinstance(net.fc, Int8Linear)
+    assert isinstance(net.conv1, nn.Conv2D)  # untouched
+
+
+def test_kl_calibration_also_drives_convert():
+    net = _SmallNet()
+    calib = [RNG.standard_normal((4, 1, 14, 14)).astype(np.float32)
+             for _ in range(3)]
+    ptq = PostTrainingQuantization(net, calib, algo="KL").quantize()
+    qnet = convert_to_int8(net, ptq)
+    out = qnet(Tensor(jnp.asarray(calib[0])))
+    assert np.isfinite(np.asarray(out.value)).all()
