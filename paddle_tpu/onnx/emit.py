@@ -172,20 +172,29 @@ def _lower_dot_general(g, eqn, ins):
     rf_shape = [rshape[d] for d in rfree]
     cshape = [lshape[d] for d in lc]
 
+    # integer contraction (the int8 deploy path, quantization/int8_infer.py):
+    # ONNX MatMul does not admit (u)int8 inputs — MatMulInteger is the
+    # spec'd op, accumulating straight to int32 (no trailing Cast needed)
+    int_mm = (np.dtype(la.dtype) in (np.dtype(np.int8), np.dtype(np.uint8))
+              and np.dtype(ra.dtype) in (np.dtype(np.int8),
+                                         np.dtype(np.uint8))
+              and np.dtype(eqn.outvars[0].aval.dtype) == np.dtype(np.int32))
+    mm_op = "MatMulInteger" if int_mm else "MatMul"
+
     if len(lc) == 1 and len(lfree) == 1 and len(rfree) == 1:
         # transposed operands are already [*b, lf, c] x [*b, c, rf]:
         # numpy-style MatMul semantics, output [*b, lf, rf] = jax's order
-        mm = g.add("MatMul", [lhs, rhs], hint="matmul")
-        return _cast_to_out_dtype(g, eqn, mm)
+        mm = g.add(mm_op, [lhs, rhs], hint="matmul")
+        return mm if int_mm else _cast_to_out_dtype(g, eqn, mm)
 
     B, Fl, Fr, C = (_prod(bshape), _prod(lf_shape), _prod(rf_shape),
                     _prod(cshape))
     lhs = _maybe_reshape(g, lhs, [lshape[d] for d in perm_l], [B, Fl, C])
     rhs = _maybe_reshape(g, rhs, [rshape[d] for d in perm_r], [B, C, Fr])
-    mm = g.add("MatMul", [lhs, rhs], hint="matmul")
+    mm = g.add(mm_op, [lhs, rhs], hint="matmul")
     out_shape = bshape + lf_shape + rf_shape  # jax dot_general convention
-    return _cast_to_out_dtype(
-        g, eqn, _maybe_reshape(g, mm, [B, Fl, Fr], out_shape))
+    out = _maybe_reshape(g, mm, [B, Fl, Fr], out_shape)
+    return out if int_mm else _cast_to_out_dtype(g, eqn, out)
 
 
 def _cast_to_out_dtype(g, eqn, name):
@@ -224,6 +233,14 @@ def _lower_conv(g, eqn, ins):
                         + [hi for _, hi in pads])
     attrs += _attr_ints("dilations", p["rhs_dilation"])
     attrs += _attr_int("group", p["feature_group_count"])
+    la, ra = eqn.invars[0].aval, eqn.invars[1].aval
+    # int8 deploy conv: ONNX Conv does not admit (u)int8 inputs —
+    # ConvInteger (same attrs) accumulates to int32 directly
+    if (np.dtype(la.dtype) in (np.dtype(np.int8), np.dtype(np.uint8))
+            and np.dtype(ra.dtype) in (np.dtype(np.int8),
+                                       np.dtype(np.uint8))
+            and np.dtype(eqn.outvars[0].aval.dtype) == np.dtype(np.int32)):
+        return g.add("ConvInteger", list(ins), attrs=attrs, hint="conv")
     return _cast_to_out_dtype(
         g, eqn, g.add("Conv", list(ins), attrs=attrs, hint="conv"))
 

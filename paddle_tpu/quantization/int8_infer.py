@@ -178,7 +178,11 @@ def convert_to_int8(model: Layer, ptq_result: dict, bits: int | None = None
             qual = f"{prefix}.{name}" if prefix else name
             if isinstance(child, Linear) and qual in scales:
                 setattr(layer, name, Int8Linear(child, scales[qual], bits))
-            elif isinstance(child, Conv2D) and qual in scales:
+            elif (isinstance(child, Conv2D) and qual in scales
+                  and child.data_format == "NCHW"):
+                # non-NCHW convs stay float (same policy as uncalibrated
+                # layers) — raising here would leave the in-place swap
+                # half-done with no way back to the float weights
                 setattr(layer, name, Int8Conv2D(child, scales[qual], bits))
             else:
                 swap(child, qual)

@@ -138,10 +138,10 @@ class PostTrainingQuantization:
         # 2) thresholds + int8 weights
         out = {"bits": self.bits, "act_scales": {}, "weights": {},
                "weight_scales": {}}
+        from .int8_infer import quantize_weight
+
         for name, layer in self._quantizable():
             out["act_scales"][name] = observers[name].threshold(self.bits)
-            from .int8_infer import quantize_weight
-
             q, scale = quantize_weight(np.asarray(layer.weight.value),
                                        bits=self.bits)
             out["weight_scales"][name] = float(scale)

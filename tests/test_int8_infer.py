@@ -145,3 +145,28 @@ def test_kl_calibration_also_drives_convert():
     qnet = convert_to_int8(net, ptq)
     out = qnet(Tensor(jnp.asarray(calib[0])))
     assert np.isfinite(np.asarray(out.value)).all()
+
+
+def test_int8_model_serves_through_predictor(tmp_path):
+    """The deploy loop closes natively: calibrate -> convert -> StableHLO
+    save_inference_model -> Predictor run, int8 contractions inside the
+    serialized program (the reference hands this to a TRT int8 engine; here
+    the artifact IS the engine)."""
+    from paddle_tpu.inference import Config, Predictor, save_inference_model
+
+    net = _SmallNet()
+    calib = [RNG.standard_normal((4, 1, 14, 14)).astype(np.float32)
+             for _ in range(2)]
+    ptq = PostTrainingQuantization(net, calib, algo="abs_max").quantize()
+    qnet = convert_to_int8(net, ptq)
+    x = calib[0]
+    want = np.asarray(qnet(Tensor(jnp.asarray(x))).value)
+
+    prefix = str(tmp_path / "int8_model")
+    save_inference_model(prefix, qnet, [x])
+    pred = Predictor(Config(prefix))
+    inp = pred.get_input_handle(pred.get_input_names()[0])
+    inp.copy_from_cpu(x)
+    pred.run()
+    got = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)

@@ -166,6 +166,13 @@ def run_graph(model: dict, feeds: dict, outer_env: dict | None = None) -> list:
         i = [env[x] for x in n["inputs"]]
         if op == "MatMul":
             out = i[0] @ i[1]
+        elif op == "MatMulInteger":
+            # int32 accumulation, computed exactly in int64 then narrowed
+            out = (i[0].astype(np.int64) @ i[1].astype(np.int64)
+                   ).astype(np.int32)
+        elif op == "ConvInteger":
+            out = _conv(i[0].astype(np.int64), i[1].astype(np.int64),
+                        a).astype(np.int32)
         elif op == "Add":
             out = i[0] + i[1]
         elif op == "Sub":
@@ -817,6 +824,45 @@ class TestOnnxExport:
         got = run_graph(model, {"input_0": x})[0]
         want = np.asarray(net(paddle.to_tensor(x)).value)
         np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+    def test_converted_int8_model_exports_as_integer_ops(self, tmp_path):
+        """A convert_to_int8 deploy model exports with MatMulInteger /
+        ConvInteger (ONNX MatMul/Conv do not admit int8 inputs), and the
+        independent interpreter reproduces the framework's outputs — the
+        exported graph really contracts in int8."""
+        from paddle_tpu.quantization import (PostTrainingQuantization,
+                                             convert_to_int8)
+
+        paddle.seed(5)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.conv = nn.Conv2D(2, 4, 3, padding=1)
+                self.fc = nn.Linear(4 * 6 * 6, 5)
+
+            def forward(self, x):
+                h = nn.functional.relu(self.conv(x))
+                return self.fc(paddle.reshape(h, (h.shape[0], -1)))
+
+        net = Net()
+        rng = np.random.default_rng(9)
+        calib = [rng.standard_normal((3, 2, 6, 6)).astype(np.float32)
+                 for _ in range(2)]
+        ptq = PostTrainingQuantization(net, calib, algo="abs_max").quantize()
+        qnet = convert_to_int8(net, ptq)
+        x = calib[0]
+        want = np.asarray(qnet(paddle.to_tensor(x)).value)
+
+        p = export(qnet, str(tmp_path / "int8.onnx"),
+                   input_spec=[paddle.to_tensor(x)])
+        with open(p, "rb") as fh:
+            model = parse_model(fh.read())
+        ops = [n["op"] for n in model["nodes"]]
+        assert "MatMulInteger" in ops and "ConvInteger" in ops, ops
+        assert "MatMul" not in ops and "Conv" not in ops  # nothing float
+        got = run_graph(model, {"input_0": x})[0]
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
 
     def test_unsupported_primitive_is_loud(self, tmp_path):
         def weird(x):
