@@ -767,8 +767,58 @@ def bench_int8(small: bool):
             "vs_baseline": 0.0}
 
 
+def bench_decode(small: bool):
+    """Autoregressive decode throughput (tokens/s), float vs weight-only
+    int8 (text/woq.py).  Decode reads every weight per token — the
+    bandwidth-bound regime where int8 weights approach 2x bf16; the
+    measured ratio calibrates that roofline claim on the real chip."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.text import generate, gpt, woq
+
+    dev = jax.devices()[0]
+    if small:
+        cfg = gpt.GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                            num_heads=4, max_seq_len=64)
+        B, new_toks, iters = 2, 8, 2
+    else:
+        cfg = gpt.GPTConfig(vocab_size=50304, hidden_size=1024,
+                            num_layers=24, num_heads=16, max_seq_len=2048)
+        B, new_toks, iters = 8, 64, 3
+    params = jax.device_get(gpt.init_params(cfg, jax.random.PRNGKey(0)))
+    prompt = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (B, 8)), jnp.int32)
+    key = jax.random.PRNGKey(1)
+
+    def tok_s(p):
+        box = {}
+
+        def one():
+            box["y"] = generate.generate(p, cfg, prompt,
+                                         max_new_tokens=new_toks,
+                                         temperature=0.0, key=key)
+
+        dt = _time_steps(one, iters, lambda: box["y"])
+        # every call runs P-1 prefill + new_toks decode steps, each a full
+        # weight read — count them all, not just the new tokens
+        return B * (prompt.shape[1] + new_toks - 1) / dt
+
+    f_tok = tok_s(params)
+    q_tok = tok_s(woq.quantize_gpt_int8(params))
+    _log(f"[bench] gpt decode: int8-weight {q_tok:,.0f} vs float "
+         f"{f_tok:,.0f} tok/s (B={B}, {cfg.num_layers}L/{cfg.hidden_size}D)")
+    return {"metric": "tokens_per_sec_decode_gpt350m_int8w",
+            "value": round(q_tok, 1), "unit": "tokens/s/chip",
+            "device": dev.platform,
+            "float_tok_s": round(f_tok, 1),
+            "int8_vs_float": round(q_tok / f_tok, 3) if f_tok else None,
+            "vs_baseline": 0.0}
+
+
 _CONFIGS = {"gpt": bench_gpt, "mnist": bench_mnist, "resnet": bench_resnet,
-            "bert": bench_bert, "int8": bench_int8}
+            "bert": bench_bert, "int8": bench_int8, "decode": bench_decode}
 
 
 def main():

@@ -149,6 +149,16 @@ def _prod(xs):
     return out
 
 
+def _is_integer_contraction(eqn) -> bool:
+    """Both operands (u)int8 and the output int32: the int8 deploy path's
+    contraction shape — lowered to MatMulInteger/ConvInteger (ONNX
+    MatMul/Conv do not admit int8 inputs)."""
+    i8 = (np.dtype(np.int8), np.dtype(np.uint8))
+    return (np.dtype(eqn.invars[0].aval.dtype) in i8
+            and np.dtype(eqn.invars[1].aval.dtype) in i8
+            and np.dtype(eqn.outvars[0].aval.dtype) == np.dtype(np.int32))
+
+
 def _lower_dot_general(g, eqn, ins):
     """General contraction: transpose both sides to [batch, free,
     contract] / [batch, contract, free], flatten to rank 3, MatMul,
@@ -172,13 +182,9 @@ def _lower_dot_general(g, eqn, ins):
     rf_shape = [rshape[d] for d in rfree]
     cshape = [lshape[d] for d in lc]
 
-    # integer contraction (the int8 deploy path, quantization/int8_infer.py):
-    # ONNX MatMul does not admit (u)int8 inputs — MatMulInteger is the
-    # spec'd op, accumulating straight to int32 (no trailing Cast needed)
-    int_mm = (np.dtype(la.dtype) in (np.dtype(np.int8), np.dtype(np.uint8))
-              and np.dtype(ra.dtype) in (np.dtype(np.int8),
-                                         np.dtype(np.uint8))
-              and np.dtype(eqn.outvars[0].aval.dtype) == np.dtype(np.int32))
+    # integer contraction: MatMulInteger accumulates straight to int32
+    # (no trailing Cast needed)
+    int_mm = _is_integer_contraction(eqn)
     mm_op = "MatMulInteger" if int_mm else "MatMul"
 
     if len(lc) == 1 and len(lfree) == 1 and len(rfree) == 1:
@@ -233,13 +239,8 @@ def _lower_conv(g, eqn, ins):
                         + [hi for _, hi in pads])
     attrs += _attr_ints("dilations", p["rhs_dilation"])
     attrs += _attr_int("group", p["feature_group_count"])
-    la, ra = eqn.invars[0].aval, eqn.invars[1].aval
-    # int8 deploy conv: ONNX Conv does not admit (u)int8 inputs —
-    # ConvInteger (same attrs) accumulates to int32 directly
-    if (np.dtype(la.dtype) in (np.dtype(np.int8), np.dtype(np.uint8))
-            and np.dtype(ra.dtype) in (np.dtype(np.int8),
-                                       np.dtype(np.uint8))
-            and np.dtype(eqn.outvars[0].aval.dtype) == np.dtype(np.int32)):
+    # int8 deploy conv: ConvInteger (same attrs) accumulates to int32
+    if _is_integer_contraction(eqn):
         return g.add("ConvInteger", list(ins), attrs=attrs, hint="conv")
     return _cast_to_out_dtype(
         g, eqn, g.add("Conv", list(ins), attrs=attrs, hint="conv"))

@@ -17,7 +17,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-from . import gpt
+from . import gpt, woq
 
 __all__ = ["init_cache", "decode_step", "generate"]
 
@@ -48,7 +48,7 @@ def _cached_block(x, p, cache_k, cache_v, pos, cfg: gpt.GPTConfig):
         k_new = k3.reshape(B, Hkv, hd)  # cache stores the Hkv heads
         v_new = v3.reshape(B, Hkv, hd)
     else:
-        qkv = jnp.einsum("btd,kde->kbte", h, p["qkv_w"].astype(dt)) \
+        qkv = jnp.einsum("btd,kde->kbte", h, woq.w(p, "qkv_w", dt)) \
             + p["qkv_b"].astype(dt)[:, None, None]
         q = qkv[0].reshape(B, H, hd)
         k_new = qkv[1].reshape(B, H, hd)
@@ -79,12 +79,12 @@ def _cached_block(x, p, cache_k, cache_v, pos, cfg: gpt.GPTConfig):
         attn = jnp.einsum("bkgt,btkd->bkgd", wg, v_all).reshape(B, 1, D)
     else:
         attn = jnp.einsum("bht,bthd->bhd", w, v_all).reshape(B, 1, D)
-    a = attn @ p["proj_w"].astype(dt) + p["proj_b"].astype(dt)
+    a = attn @ woq.w(p, "proj_w", dt) + p["proj_b"].astype(dt)
     x = x + a
     h = gpt._layer_norm(x.astype(jnp.float32), p["ln2_g"],
                         p["ln2_b"]).astype(dt)
-    h = jax.nn.gelu(h @ p["fc_w"].astype(dt) + p["fc_b"].astype(dt))
-    h = h @ p["out_w"].astype(dt) + p["out_b"].astype(dt)
+    h = jax.nn.gelu(h @ woq.w(p, "fc_w", dt) + p["fc_b"].astype(dt))
+    h = h @ woq.w(p, "out_w", dt) + p["out_b"].astype(dt)
     return x + h, k_new, v_new
 
 
@@ -94,7 +94,7 @@ def decode_step(params, cache, token, pos, cfg: gpt.GPTConfig):
         raise NotImplementedError("cached decode supports dense models")
     dt = cfg.dtype
     B = token.shape[0]
-    x = params["wte"][token].astype(dt)[:, None] \
+    x = woq.embed(params, token, dt)[:, None] \
         + jax.lax.dynamic_slice(params["wpe"], (pos, 0),
                                 (1, cfg.hidden_size)).astype(dt)[None]
 
@@ -111,7 +111,7 @@ def decode_step(params, cache, token, pos, cfg: gpt.GPTConfig):
         cache["v"], v_rows[:, :, None], (0, 0, pos, 0, 0))
     x = gpt._layer_norm(x.astype(jnp.float32), params["ln_f_g"],
                         params["ln_f_b"]).astype(dt)
-    logits = (x @ params["wte"].T.astype(dt))[:, 0]
+    logits = woq.logits(x, params, dt)[:, 0]
     return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
 
 

@@ -252,8 +252,12 @@ def _gqa_qkv(h, p, cfg: GPTConfig, repeat_kv: bool = True,
     Hkv = Hkv if Hkv is not None else cfg.kv_heads
     hd = cfg.head_dim
     dt = cfg.dtype
-    q = (h @ p["q_w"].astype(dt) + p["q_b"].astype(dt)).reshape(B, T, H, hd)
-    kv = jnp.einsum("btd,kde->kbte", h, p["kv_w"].astype(dt)) \
+    # weights resolve through woq.w: identity on float params (training),
+    # fused dequant on weight-only-int8 decode params (text/woq.py)
+    from . import woq
+
+    q = (h @ woq.w(p, "q_w", dt) + p["q_b"].astype(dt)).reshape(B, T, H, hd)
+    kv = jnp.einsum("btd,kde->kbte", h, woq.w(p, "kv_w", dt)) \
         + p["kv_b"].astype(dt)[:, None, None]
     k = kv[0].reshape(B, T, Hkv, hd)
     v = kv[1].reshape(B, T, Hkv, hd)

@@ -1,0 +1,114 @@
+"""Weight-only int8 quantization for GPT decode (W8A16).
+
+Autoregressive decode is HBM-bandwidth-bound: every generated token reads
+every weight once, so at batch sizes below the roofline knee the decode
+rate is weight-bytes/sec, not FLOPs.  Storing the matmul weights as int8
+with per-output-channel fp scales reads half the bytes of bf16 (a quarter
+of fp32) — XLA fuses the dequant (convert + channel-scale multiply) into
+the matmul's weight read, so no full-precision copy is ever materialized.
+Activations stay bf16 (W8A16): decode-time activation tensors are tiny
+([B, 1, D]), so activation quantization buys nothing here — this is the
+standard weight-only serving recipe, distinct from quantization/int8_infer
+(W8A8 with s32 accumulation) which targets compute-bound batch inference.
+
+Usage:
+    qparams = woq.quantize_gpt_int8(params)          # same tree keys +
+                                                     # "<name>_s" scales
+    logits, cache = generate.decode_step(qparams, cache, tok, pos, cfg)
+    text.generate.generate(qparams, cfg, prompt, ...)  # transparently
+
+The decode path resolves weights through ``woq.w(p, name, dt)``, which
+dequantizes int8 entries and is the identity on float entries — float
+params flow through unchanged, so the same decode code serves both.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# block-level matmul weights and the OUTPUT-channel axis to scale over
+# (axis indices are for the PER-LAYER slice, i.e. without the leading L)
+_BLOCK_WEIGHTS = {
+    "qkv_w": 2,   # [3, D, D]   -> out axis 2
+    "q_w": 1,     # [D, D]
+    "kv_w": 2,    # [2, D, Dkv]
+    "proj_w": 1,  # [D, D]
+    "fc_w": 1,    # [D, F]
+    "out_w": 1,   # [F, D]
+}
+
+
+def _quant(w, axis: int):
+    """Symmetric per-channel int8; axis is the output-channel axis of the
+    PER-LAYER weight (shift by one for the stacked [L, ...] layout).
+
+    Every weight here is [..., in, out]: reduce ONLY the input-dim axis,
+    keeping the layer axis (scan slices it per block), any projection
+    stack axis (q/k/v magnitudes diverge after training — sharing one
+    scale across the stack would waste v's 8-bit range on q's outliers),
+    and the output axis."""
+    w = np.asarray(w, np.float32)
+    stacked_out = axis + 1   # leading L dim of the stacked blocks
+    stacked_in = stacked_out - 1
+    scale = np.maximum(np.abs(w).max(axis=stacked_in, keepdims=True), 1e-8)
+    q = np.clip(np.round(w / scale * 127.0), -127, 127).astype(np.int8)
+    return jnp.asarray(q), jnp.asarray((scale / 127.0).astype(np.float32))
+
+
+def quantize_gpt_int8(params: dict) -> dict:
+    """Return a decode-ready param tree: block matmul weights and the tied
+    embedding become int8 with per-output-channel scales stored under
+    ``<name>_s``.  LayerNorm, biases, and wpe stay float (negligible
+    bytes; norm math is fp32 anyway).  MoE models are untouched by design
+    — cached decode rejects them before weights matter."""
+    out = dict(params)
+    blocks = dict(params["blocks"])
+    for name, axis in _BLOCK_WEIGHTS.items():
+        if name in blocks and blocks[name] is not None:
+            q, s = _quant(blocks[name], axis)
+            blocks[name] = q
+            blocks[name + "_s"] = s
+    out["blocks"] = blocks
+    # wte [V, D]: PER-ROW scales [V, 1] serve both uses — the embedding
+    # lookup (wte[token] * s[token]) and the tied logits matmul
+    # (x @ wte.T scaled per OUTPUT vocab column = per wte row)
+    w = np.asarray(params["wte"], np.float32)
+    s = np.maximum(np.abs(w).max(axis=1, keepdims=True), 1e-8)
+    out["wte"] = jnp.asarray(
+        np.clip(np.round(w / s * 127.0), -127, 127).astype(np.int8))
+    out["wte_s"] = jnp.asarray((s / 127.0).astype(np.float32))
+    return out
+
+
+def w(p: dict, name: str, dt):
+    """Resolve a (possibly int8) weight to compute dtype.
+
+    Identity-cost on float params; on int8 params the convert+scale is a
+    fusable elementwise producer that XLA folds into the consuming matmul's
+    weight read."""
+    arr = p[name]
+    if arr.dtype == jnp.int8:
+        return arr.astype(dt) * p[name + "_s"].astype(dt)
+    return arr.astype(dt)
+
+
+def embed(params: dict, token, dt):
+    """wte[token] in compute dtype, dequantizing per-row scales if int8."""
+    e = params["wte"][token].astype(dt)
+    if params["wte"].dtype == jnp.int8:
+        e = e * params["wte_s"][token].astype(dt)
+    return e
+
+
+def logits(x, params: dict, dt):
+    """Tied-head logits x @ wte.T; per-row wte scales factor out of the
+    contraction and apply on the [..., V] output (cheaper than scaling the
+    weight, exactly equal)."""
+    y = x @ params["wte"].T.astype(dt)
+    if params["wte"].dtype == jnp.int8:
+        y = y * params["wte_s"].reshape(-1).astype(dt)
+    return y
+
+
+def is_quantized(params: dict) -> bool:
+    return any(k.endswith("_s") for k in params.get("blocks", {}))
