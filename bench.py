@@ -807,13 +807,17 @@ def bench_decode(small: bool):
 
     f_tok = tok_s(params)
     q_tok = tok_s(woq.quantize_gpt_int8(params))
-    _log(f"[bench] gpt decode: int8-weight {q_tok:,.0f} vs float "
-         f"{f_tok:,.0f} tok/s (B={B}, {cfg.num_layers}L/{cfg.hidden_size}D)")
+    q4_tok = tok_s(woq.quantize_gpt_int4(params))
+    _log(f"[bench] gpt decode: int4 {q4_tok:,.0f} / int8 {q_tok:,.0f} / "
+         f"float {f_tok:,.0f} tok/s (B={B}, "
+         f"{cfg.num_layers}L/{cfg.hidden_size}D)")
     return {"metric": "tokens_per_sec_decode_gpt350m_int8w",
             "value": round(q_tok, 1), "unit": "tokens/s/chip",
             "device": dev.platform,
             "float_tok_s": round(f_tok, 1),
+            "int4_tok_s": round(q4_tok, 1),
             "int8_vs_float": round(q_tok / f_tok, 3) if f_tok else None,
+            "int4_vs_float": round(q4_tok / f_tok, 3) if f_tok else None,
             "vs_baseline": 0.0}
 
 

@@ -27,6 +27,11 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..ops.attention import attention_array
+# weight access in _gqa_qkv/_block/forward resolves through woq.w /
+# woq.embed / woq.logits: identity on float training params, fused dequant
+# on weight-only int8/int4 decode params — forward on quantized params is
+# a correct eval (perplexity) path, never silent garbage
+from . import woq
 
 
 @dataclasses.dataclass
@@ -252,10 +257,6 @@ def _gqa_qkv(h, p, cfg: GPTConfig, repeat_kv: bool = True,
     Hkv = Hkv if Hkv is not None else cfg.kv_heads
     hd = cfg.head_dim
     dt = cfg.dtype
-    # weights resolve through woq.w: identity on float params (training),
-    # fused dequant on weight-only-int8 decode params (text/woq.py)
-    from . import woq
-
     q = (h @ woq.w(p, "q_w", dt) + p["q_b"].astype(dt)).reshape(B, T, H, hd)
     kv = jnp.einsum("btd,kde->kbte", h, woq.w(p, "kv_w", dt)) \
         + p["kv_b"].astype(dt)[:, None, None]
@@ -278,13 +279,14 @@ def _block(x, p, cfg: GPTConfig, dropout_key=None):
     if cfg.num_kv_heads is not None:
         q, k, v = _gqa_qkv(h, p, cfg)
     else:
-        qkv = jnp.einsum("btd,kde->kbte", h, p["qkv_w"].astype(dt)) + p["qkv_b"].astype(dt)[:, None, None]
+        qkv = jnp.einsum("btd,kde->kbte", h, woq.w(p, "qkv_w", dt)) \
+            + p["qkv_b"].astype(dt)[:, None, None]
         q = qkv[0].reshape(B, T, H, hd)
         k = qkv[1].reshape(B, T, H, hd)
         v = qkv[2].reshape(B, T, H, hd)
     attn = attention_array(q, k, v, is_causal=True)
     attn = attn.reshape(B, T, D)
-    a = attn @ p["proj_w"].astype(dt) + p["proj_b"].astype(dt)
+    a = attn @ woq.w(p, "proj_w", dt) + p["proj_b"].astype(dt)
     if drop:
         a = _dropout(a, cfg.dropout, jax.random.fold_in(dropout_key, 0))
     x = x + a
@@ -296,8 +298,8 @@ def _block(x, p, cfg: GPTConfig, dropout_key=None):
                          key=(jax.random.fold_in(dropout_key, 2)
                               if dropout_key is not None else None))
     else:
-        h = jax.nn.gelu(h @ p["fc_w"].astype(dt) + p["fc_b"].astype(dt))
-        h = h @ p["out_w"].astype(dt) + p["out_b"].astype(dt)
+        h = jax.nn.gelu(h @ woq.w(p, "fc_w", dt) + p["fc_b"].astype(dt))
+        h = h @ woq.w(p, "out_w", dt) + p["out_b"].astype(dt)
         aux = jnp.zeros((), jnp.float32)
     if drop:
         h = _dropout(h, cfg.dropout, jax.random.fold_in(dropout_key, 1))
@@ -315,7 +317,7 @@ def forward_with_aux(params: dict, tokens, cfg: GPTConfig, act_sharding=None,
     key: PRNG key enabling dropout (cfg.dropout > 0); None = eval mode."""
     B, T = tokens.shape
     dt = cfg.dtype
-    x = params["wte"][tokens].astype(dt) + params["wpe"][:T].astype(dt)[None]
+    x = woq.embed(params, tokens, dt) + params["wpe"][:T].astype(dt)[None]
     if act_sharding is not None:
         x = jax.lax.with_sharding_constraint(x, act_sharding)
 
@@ -346,7 +348,7 @@ def forward_with_aux(params: dict, tokens, cfg: GPTConfig, act_sharding=None,
 
         x, aux = jax.lax.scan(scan_body, x, params["blocks"])
     x = _ln(x, params["ln_f_g"], params["ln_f_b"], dt)
-    logits = x @ params["wte"].T.astype(dt)
+    logits = woq.logits(x, params, dt)
     return logits, jnp.sum(aux)
 
 

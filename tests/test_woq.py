@@ -80,11 +80,13 @@ def test_per_layer_scales_are_kept():
     assert s[0].max() > 10 * s[1].max()
 
 
-def test_trained_model_generates_identically_after_quantization():
-    """Markov-stream capstone: train tiny GPT until confident, then the
-    int8-weight decode must reproduce the float generation exactly (the
-    learned rule's logit margins dwarf the quantization error)."""
-    import numpy as np
+_TRAINED = {}
+
+
+def _train_tiny_markov():
+    """Train the Markov-rule GPT once; both capstones reuse the params."""
+    if "params" in _TRAINED:
+        return _TRAINED["cfg"], _TRAINED["params"]
     from jax.sharding import Mesh
 
     from paddle_tpu.optimizer import AdamW
@@ -98,6 +100,7 @@ def test_trained_model_generates_identically_after_quantization():
     state = init_fn(0)
     rng = np.random.default_rng(0)
     key = jax.random.PRNGKey(0)
+
     # deterministic rule: next = (tok * 3 + 1) % 13
     def stream(B, T):
         t = rng.integers(0, 13, (B, 1))
@@ -111,8 +114,16 @@ def test_trained_model_generates_identically_after_quantization():
     for i in range(150):
         state, loss = step_fn(state, stream(8, 31), key, 3e-3)
     assert float(loss) < 0.1, float(loss)
+    _TRAINED["cfg"] = cfg
+    _TRAINED["params"] = jax.device_get(state.params)
+    return cfg, _TRAINED["params"]
 
-    params = jax.device_get(state.params)
+
+def test_trained_model_generates_identically_after_quantization():
+    """Markov-stream capstone: train tiny GPT until confident, then the
+    int8-weight decode must reproduce the float generation exactly (the
+    learned rule's logit margins dwarf the quantization error)."""
+    cfg, params = _train_tiny_markov()
     prompt = jnp.asarray([[2]], jnp.int32)
     out_f = generate.generate(params, cfg, prompt, max_new_tokens=12,
                               temperature=0.0)
@@ -124,3 +135,57 @@ def test_trained_model_generates_identically_after_quantization():
     seq = np.asarray(out_q).reshape(-1)
     for a, b in zip(seq[:-1], seq[1:]):
         assert b == (a * 3 + 1) % 13, seq
+
+
+def test_trained_model_generates_identically_at_int4():
+    """Same Markov capstone at 4 bits: the learned rule's logit margins
+    survive group-wise int4."""
+    cfg, params = _train_tiny_markov()
+    prompt = jnp.asarray([[2]], jnp.int32)
+    out_f = generate.generate(params, cfg, prompt, max_new_tokens=12,
+                              temperature=0.0)
+    out_4 = generate.generate(woq.quantize_gpt_int4(params, group_size=32),
+                              cfg, prompt, max_new_tokens=12,
+                              temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(out_f), np.asarray(out_4))
+
+
+def test_eval_forward_is_quantization_aware():
+    """gpt.forward(qparams) is a correct eval path (perplexity on the
+    quantized model), not silent garbage: forward logits must match the
+    float forward within quantization error for BOTH dense and GQA."""
+    for over in ({}, {"num_kv_heads": 2}):
+        cfg = _cfg(**over)
+        params = _params(cfg)
+        toks = jnp.asarray(np.random.default_rng(3).integers(
+            0, cfg.vocab_size, (2, 8)), jnp.int32)
+        lf = np.asarray(gpt.forward(params, toks, cfg))
+        lq = np.asarray(gpt.forward(woq.quantize_gpt_int8(params), toks,
+                                    cfg))
+        err = np.abs(lf - lq).max()
+        assert err < 0.05 * np.abs(lf).max() + 0.05, (over, err)
+
+
+def test_int4_grouped_decode_close_to_float():
+    cfg = _cfg(hidden_size=128)  # divisible by the 64 group size
+    params = _params(cfg)
+    q4 = woq.quantize_gpt_int4(params, group_size=64)
+    assert q4["blocks"]["fc_w"].dtype == jnp.int4
+    assert q4["wte"].dtype == jnp.int8  # embeddings stay 8-bit
+    # grouped scale carries the extra axis: [L, G, 1, out]
+    s = q4["blocks"]["fc_w_s"]
+    assert s.ndim == params["blocks"]["fc_w"].ndim + 1
+    cache = generate.init_cache(cfg, 2, 16)
+    tok = jnp.asarray([3, 7], jnp.int32)
+    lf, _ = generate.decode_step(params, cache, tok, 0, cfg)
+    l4, _ = generate.decode_step(q4, cache, tok, 0, cfg)
+    err = np.abs(np.asarray(lf) - np.asarray(l4)).max()
+    # 4-bit x group-64: coarser than int8 but still tracking
+    assert err < 0.15 * np.abs(np.asarray(lf)).max() + 0.15, err
+
+
+def test_int4_indivisible_input_falls_back_to_int8():
+    cfg = _cfg(hidden_size=48)  # 48 % 64 != 0
+    q4 = woq.quantize_gpt_int4(_params(cfg), group_size=64)
+    assert q4["blocks"]["q_w" if cfg.num_kv_heads else "qkv_w"].dtype \
+        == jnp.int8
