@@ -194,3 +194,81 @@ def generate(params, cfg: gpt.GPTConfig, prompt, max_new_tokens=32,
     top_k = min(int(top_k), cfg.vocab_size)  # top-k over the whole vocab
     fn = _get_generate_fn(cfg, int(max_new_tokens), top_k)
     return fn(params, prompt, key, jnp.asarray(float(temperature)))
+
+
+# ---------------------------------------------------------------------------
+# tensor-parallel (sharded) decode — serving models too big for one chip
+# ---------------------------------------------------------------------------
+
+
+def _decode_param_specs(params, cfg: gpt.GPTConfig, mp: str):
+    """A PartitionSpec tree matching ``params`` — float OR weight-only
+    quantized (text/woq.py): quantized weights take their float twin's
+    Megatron spec (same shape), the small ``*_s`` scale tensors replicate
+    (PartitionSpec() is rank-agnostic 'all replicated')."""
+    from jax.sharding import PartitionSpec as P
+
+    base = gpt.param_shardings(cfg, mp=mp)
+    blocks = {}
+    for name, v in params["blocks"].items():
+        if name.endswith("_s"):
+            blocks[name] = P()
+        else:
+            blocks[name] = base["blocks"][name]
+    out = {k: (base[k] if k in base else P()) for k in params if k != "blocks"}
+    out["blocks"] = blocks
+    return out
+
+
+def build_sharded_decode(params, cfg: gpt.GPTConfig, mesh, mp: str = "mp"):
+    """Megatron-sharded single-token decode over ``mesh`` (the serving
+    analog of gpt_hybrid's TP training: reference mp_layers.py shards
+    projections by hand + NCCL; here the SAME decode_step is pjit'd under
+    the param PartitionSpecs and XLA inserts the collectives over ICI).
+
+    The KV cache shards over the head axis when the mesh divides it —
+    with GQA this composes: Hkv heads spread across mp ranks, so a 13B
+    model's cache splits like its weights.  Returns
+    ``(sharded_params, make_cache, decode_fn)``:
+        sharded_params     params placed per the Megatron specs
+        make_cache(B, T)   sharded cache
+        decode_fn(p, cache, token [B] int32, pos scalar) -> (logits, cache)
+    Weight-only int8/int4 params (woq.quantize_gpt_*) shard identically —
+    scales replicate.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if cfg.moe is not None:
+        raise NotImplementedError("sharded decode supports dense models")
+    mp_size = mesh.shape[mp]
+    pspecs = _decode_param_specs(params, cfg, mp)
+    ns = lambda spec: NamedSharding(mesh, spec)  # noqa: E731
+    sharded_params = jax.tree_util.tree_map(
+        lambda v, s: jax.device_put(v, ns(s)), params, pspecs,
+        is_leaf=lambda v: not isinstance(v, dict))
+
+    # cache [L, B, T, H, hd]: shard heads over mp when divisible;
+    # otherwise replicate (correct, just not memory-split)
+    cache_spec = (P(None, None, None, mp, None)
+                  if cfg.kv_heads % mp_size == 0 else P())
+    repl = P()
+
+    def _step(p, cache, token, pos):
+        return decode_step(p, cache, token, pos, cfg)
+
+    decode_fn = jax.jit(
+        _step,
+        in_shardings=(jax.tree_util.tree_map(
+            ns, pspecs, is_leaf=lambda s: isinstance(s, P)),
+            {"k": ns(cache_spec), "v": ns(cache_spec)},
+            ns(repl), ns(repl)),
+        out_shardings=(ns(repl),
+                       {"k": ns(cache_spec), "v": ns(cache_spec)}))
+
+    def make_cache(batch: int, max_len: int):
+        return jax.tree_util.tree_map(
+            lambda v: jax.device_put(v, ns(cache_spec)),
+            init_cache(cfg, batch, max_len))
+
+    return sharded_params, make_cache, decode_fn

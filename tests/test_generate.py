@@ -178,3 +178,86 @@ class TestGQA:
             state, loss = step_fn(state, toks, key, 1e-3)
             losses.append(float(loss))
         assert losses[-1] < losses[0], losses
+
+
+class TestShardedDecode:
+    """Tensor-parallel decode (G.build_sharded_decode): the SAME
+    decode_step pjit'd under Megatron PartitionSpecs over an ('mp',) mesh —
+    the serving analog of TP training; XLA inserts the collectives."""
+
+    def _mesh(self, n):
+        import numpy as np
+        from jax.sharding import Mesh
+
+        return Mesh(np.array(jax.devices()[:n]), ("mp",))
+
+    def _parity(self, cfg, params, n_dev, quantize=None):
+        import numpy as np
+
+        from paddle_tpu.text import woq
+
+        if quantize:
+            params = getattr(woq, quantize)(params)
+        mesh = self._mesh(n_dev)
+        sp, make_cache, decode = G.build_sharded_decode(
+            params, cfg, mesh)
+        cache_s = make_cache(2, 12)
+        cache_r = G.init_cache(cfg, 2, 12)
+        toks = [jnp.asarray([3, 7], jnp.int32), jnp.asarray([1, 2], jnp.int32)]
+        for pos, tok in enumerate(toks):
+            want, cache_r = G.decode_step(params, cache_r, tok,
+                                                 pos, cfg)
+            got, cache_s = decode(sp, cache_s, tok, jnp.asarray(pos))
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=2e-2, atol=5e-3)
+        return sp, cache_s, mesh
+
+    def test_dense_parity_and_cache_sharding(self):
+        cfg = gpt.GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                            num_heads=4, max_seq_len=32)
+        params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+        sp, cache_s, mesh = self._parity(cfg, params, 4)
+        # the cache really is split over heads, and weights over mp
+        k_shard = cache_s["k"].sharding.shard_shape(cache_s["k"].shape)
+        assert k_shard[3] == cfg.num_heads // 4
+        fc = sp["blocks"]["fc_w"]
+        assert fc.sharding.shard_shape(fc.shape)[2] == fc.shape[2] // 4
+
+    def test_gqa_cache_shards_over_kv_heads(self):
+        cfg = gpt.GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                            num_heads=4, num_kv_heads=2, max_seq_len=32)
+        params = gpt.init_params(cfg, jax.random.PRNGKey(1))
+        sp, cache_s, _ = self._parity(cfg, params, 2)
+        k_shard = cache_s["k"].sharding.shard_shape(cache_s["k"].shape)
+        assert k_shard[3] == cfg.kv_heads // 2
+
+    def test_gqa_indivisible_heads_replicate_cache(self):
+        cfg = gpt.GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                            num_heads=4, num_kv_heads=2, max_seq_len=32)
+        params = gpt.init_params(cfg, jax.random.PRNGKey(2))
+        # mp=4 does not divide Hkv=2: cache replicates, numerics hold
+        sp, cache_s, _ = self._parity(cfg, params, 4)
+        k_shard = cache_s["k"].sharding.shard_shape(cache_s["k"].shape)
+        assert k_shard == cache_s["k"].shape
+
+    def test_weight_only_int8_params_shard_too(self):
+        from paddle_tpu.text import woq
+
+        cfg = gpt.GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                            num_heads=4, max_seq_len=32)
+        params = gpt.init_params(cfg, jax.random.PRNGKey(3))
+        sp, _, _ = self._parity(cfg, params, 2,
+                                quantize="quantize_gpt_int8")
+        qw = sp["blocks"]["fc_w"]
+        assert qw.dtype == jnp.int8
+        assert qw.sharding.shard_shape(qw.shape)[2] == qw.shape[2] // 2
+
+    def test_weight_only_int4_params_shard_too(self):
+        cfg = gpt.GPTConfig(vocab_size=64, hidden_size=128, num_layers=2,
+                            num_heads=4, max_seq_len=32)
+        params = gpt.init_params(cfg, jax.random.PRNGKey(4))
+        sp, _, _ = self._parity(cfg, params, 2,
+                                quantize="quantize_gpt_int4")
+        qw = sp["blocks"]["fc_w"]
+        assert qw.dtype == jnp.int4
+        assert qw.sharding.shard_shape(qw.shape)[2] == qw.shape[2] // 2
