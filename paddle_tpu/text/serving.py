@@ -1,0 +1,163 @@
+"""Continuous-batching decode server (slot scheduler over the KV cache).
+
+Beyond-reference serving: the reference serves with one AnalysisPredictor
+per thread (inference/api/analysis_predictor.cc — fixed batch, no shared
+state); modern LLM serving instead keeps ONE resident batched KV cache and
+lets requests join and leave mid-flight (continuous batching).  TPU-first
+shape: the whole tick is one jitted ``decode_step`` vmapped over slots
+with PER-SLOT positions — fixed shapes (XLA compiles once per
+(max_batch, max_len)), no re-running prefixes, no cache re-allocation; a
+freed slot is reused without clearing (the causal mask ``t <= pos`` hides
+stale rows until they are overwritten).
+
+    srv = DecodeServer(params, cfg, max_batch=8, max_len=256, eos_id=2)
+    rid = srv.submit([5, 3, 9], max_new_tokens=32)
+    while srv.pending():
+        srv.tick()
+    tokens = srv.result(rid)
+
+Weight-only quantized params (text/woq.py) work unchanged — the vmapped
+step routes through the same woq accessors.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import generate, gpt
+
+__all__ = ["decode_step_batched", "DecodeServer"]
+
+
+def decode_step_batched(params, cache, token, pos, cfg: gpt.GPTConfig):
+    """decode_step with PER-SLOT positions: token [B] int32, pos [B] int32.
+
+    Implemented as vmap of the scalar-pos ``decode_step`` over the batch
+    axis (params broadcast, cache batch axis 1) — identical math, batched
+    cache scatter."""
+    def one(tok, ck, cv, p):
+        logits, new = generate.decode_step(
+            params, {"k": ck[:, None], "v": cv[:, None]}, tok[None], p, cfg)
+        return logits[0], (new["k"][:, 0], new["v"][:, 0])
+
+    logits, (nk, nv) = jax.vmap(one, in_axes=(0, 1, 1, 0),
+                                out_axes=(0, (1, 1)))(
+        token, cache["k"], cache["v"], pos)
+    return logits, {"k": nk, "v": nv}
+
+
+_STEP_CACHE: dict = {}
+
+
+def _get_step_fn(cfg: gpt.GPTConfig):
+    """One jitted batched step per config VALUE (generate._GEN_CACHE's
+    rationale: keying by object identity would recompile per DecodeServer
+    and leak executables)."""
+    k = generate._cfg_key(cfg)
+    fn = _STEP_CACHE.get(k)
+    if fn is None:
+        fn = jax.jit(lambda p, c, t, s, _cfg=cfg: decode_step_batched(
+            p, c, t, s, _cfg))
+        _STEP_CACHE[k] = fn
+    return fn
+
+
+class DecodeServer:
+    """Host-side slot scheduler around one jitted batched decode step.
+
+    Greedy decoding; prompts are consumed token-by-token through the same
+    step (each prompt token's logits are discarded until the prompt ends).
+    """
+
+    def __init__(self, params, cfg: gpt.GPTConfig, max_batch: int,
+                 max_len: int, eos_id: int | None = None):
+        self.params = params
+        self.cfg = cfg
+        self.max_batch = max_batch
+        self.max_len = max_len
+        self.eos_id = eos_id
+        self.cache = generate.init_cache(cfg, max_batch, max_len)
+        self._step = _get_step_fn(cfg)
+        # per-slot host state
+        self._free = list(range(max_batch))
+        self._slots: dict[int, dict] = {}        # slot -> request state
+        self._queue: list[dict] = []             # waiting requests
+        self._results: dict[int, list] = {}
+        self._next_rid = 0
+
+    # -- request lifecycle --------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int = 32) -> int:
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, "
+                             f"got {max_new_tokens}")
+        total = len(prompt) + max_new_tokens
+        if total > min(self.max_len, self.cfg.max_seq_len):
+            raise ValueError(
+                f"prompt+max_new_tokens {total} exceeds serving window "
+                f"{min(self.max_len, self.cfg.max_seq_len)}")
+        rid = self._next_rid
+        self._next_rid += 1
+        self._queue.append({"rid": rid, "prompt": prompt,
+                            "max_new": max_new_tokens})
+        self._admit()
+        return rid
+
+    def _admit(self):
+        while self._queue and self._free:
+            slot = self._free.pop()
+            req = self._queue.pop(0)
+            self._slots[slot] = {
+                "rid": req["rid"], "prompt": req["prompt"],
+                "max_new": req["max_new"],
+                "generated": [],
+                "pos": 0,   # next position == index of the token to feed
+            }
+
+    def pending(self) -> bool:
+        return bool(self._slots or self._queue)
+
+    def result(self, rid: int):
+        """Generated tokens (no prompt) once the request finished."""
+        return self._results[rid]
+
+    # -- one tick: a single batched device step -----------------------------
+
+    def tick(self):
+        if not self._slots:
+            self._admit()
+            if not self._slots:
+                return
+        tok = np.zeros((self.max_batch,), np.int32)
+        pos = np.zeros((self.max_batch,), np.int32)
+        for slot, st in self._slots.items():
+            i = st["pos"]  # the token fed at position i is sequence[i]
+            np_ = len(st["prompt"])
+            tok[slot] = (st["prompt"][i] if i < np_
+                         else st["generated"][i - np_])
+            pos[slot] = i
+        logits, self.cache = self._step(self.params, self.cache,
+                                        jnp.asarray(tok), jnp.asarray(pos))
+        nxt = np.asarray(jnp.argmax(logits, axis=-1))
+        done = []
+        for slot, st in self._slots.items():
+            i = st["pos"]
+            st["pos"] = i + 1
+            if i < len(st["prompt"]) - 1:
+                continue                # still feeding prompt; logits unused
+            t = int(nxt[slot])
+            st["generated"].append(t)
+            if (len(st["generated"]) >= st["max_new"]
+                    or (self.eos_id is not None and t == self.eos_id)):
+                done.append(slot)
+        for slot in done:
+            st = self._slots.pop(slot)
+            self._results[st["rid"]] = st["generated"]
+            self._free.append(slot)
+        self._admit()

@@ -16,3 +16,44 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from paddle_tpu.framework.platform import force_cpu  # noqa: E402
 
 force_cpu(8)
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def markov_gpt():
+    """A tiny GPT trained (once per session) on the deterministic stream
+    next = (tok * 3 + 1) % 13 until loss < 0.1 — the shared capstone model
+    for decode/quantization/serving tests: its next token DEPENDS on the
+    fed token, so wrong-input bugs can't hide behind attractor tokens."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.text import gpt, gpt_hybrid
+
+    cfg = gpt.GPTConfig(vocab_size=16, hidden_size=64, num_layers=2,
+                        num_heads=4, max_seq_len=32)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
+    opt = AdamW(learning_rate=3e-3)
+    init_fn, step_fn, _ = gpt_hybrid.build_gpt_train_step(cfg, mesh, opt)
+    state = init_fn(0)
+    rng = np.random.default_rng(0)
+    key = jax.random.PRNGKey(0)
+
+    def stream(B, T):
+        t = rng.integers(0, 13, (B, 1))
+        rows = [t]
+        for _ in range(T):
+            t = (t * 3 + 1) % 13
+            rows.append(t)
+        return jnp.asarray(np.concatenate(rows, 1), jnp.int32)
+
+    loss = None
+    for i in range(150):
+        state, loss = step_fn(state, stream(8, 31), key, 3e-3)
+    assert float(loss) < 0.1, float(loss)
+    return cfg, jax.device_get(state.params)

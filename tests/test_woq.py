@@ -80,50 +80,11 @@ def test_per_layer_scales_are_kept():
     assert s[0].max() > 10 * s[1].max()
 
 
-_TRAINED = {}
-
-
-def _train_tiny_markov():
-    """Train the Markov-rule GPT once; both capstones reuse the params."""
-    if "params" in _TRAINED:
-        return _TRAINED["cfg"], _TRAINED["params"]
-    from jax.sharding import Mesh
-
-    from paddle_tpu.optimizer import AdamW
-    from paddle_tpu.text import gpt_hybrid
-
-    cfg = _cfg(vocab_size=16, hidden_size=64, num_layers=2, num_heads=4,
-               max_seq_len=32)
-    mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
-    opt = AdamW(learning_rate=3e-3)
-    init_fn, step_fn, _ = gpt_hybrid.build_gpt_train_step(cfg, mesh, opt)
-    state = init_fn(0)
-    rng = np.random.default_rng(0)
-    key = jax.random.PRNGKey(0)
-
-    # deterministic rule: next = (tok * 3 + 1) % 13
-    def stream(B, T):
-        t = rng.integers(0, 13, (B, 1))
-        rows = [t]
-        for _ in range(T):
-            t = (t * 3 + 1) % 13
-            rows.append(t)
-        return jnp.asarray(np.concatenate(rows, 1), jnp.int32)
-
-    loss = None
-    for i in range(150):
-        state, loss = step_fn(state, stream(8, 31), key, 3e-3)
-    assert float(loss) < 0.1, float(loss)
-    _TRAINED["cfg"] = cfg
-    _TRAINED["params"] = jax.device_get(state.params)
-    return cfg, _TRAINED["params"]
-
-
-def test_trained_model_generates_identically_after_quantization():
+def test_trained_model_generates_identically_after_quantization(markov_gpt):
     """Markov-stream capstone: train tiny GPT until confident, then the
     int8-weight decode must reproduce the float generation exactly (the
     learned rule's logit margins dwarf the quantization error)."""
-    cfg, params = _train_tiny_markov()
+    cfg, params = markov_gpt
     prompt = jnp.asarray([[2]], jnp.int32)
     out_f = generate.generate(params, cfg, prompt, max_new_tokens=12,
                               temperature=0.0)
@@ -137,10 +98,10 @@ def test_trained_model_generates_identically_after_quantization():
         assert b == (a * 3 + 1) % 13, seq
 
 
-def test_trained_model_generates_identically_at_int4():
+def test_trained_model_generates_identically_at_int4(markov_gpt):
     """Same Markov capstone at 4 bits: the learned rule's logit margins
     survive group-wise int4."""
-    cfg, params = _train_tiny_markov()
+    cfg, params = markov_gpt
     prompt = jnp.asarray([[2]], jnp.int32)
     out_f = generate.generate(params, cfg, prompt, max_new_tokens=12,
                               temperature=0.0)
