@@ -272,3 +272,80 @@ def build_sharded_decode(params, cfg: gpt.GPTConfig, mesh, mp: str = "mp"):
             init_cache(cfg, batch, max_len))
 
     return sharded_params, make_cache, decode_fn
+
+
+# ---------------------------------------------------------------------------
+# chunked prefill — whole-prompt cache fill in one step
+# ---------------------------------------------------------------------------
+
+
+def _prefill_block(x, p, cfg: gpt.GPTConfig):
+    """One block over a PADDED prompt chunk [B, P, D] with within-chunk
+    causal attention (the cache is empty at prefill: pos0 == 0), returning
+    (x, k_rows [B, P, Hkv, hd], v_rows) for the caller to write."""
+    B, P, D = x.shape
+    H, hd = cfg.num_heads, cfg.head_dim
+    dt = cfg.dtype
+    h = gpt._layer_norm(x.astype(jnp.float32), p["ln1_g"],
+                        p["ln1_b"]).astype(dt)
+    if cfg.num_kv_heads is not None:
+        # project ONCE (unrepeated), derive the attention copies by repeat
+        q, k_rows, v_rows = gpt._gqa_qkv(h, p, cfg, repeat_kv=False)
+        rep = H // cfg.kv_heads
+        k = jnp.repeat(k_rows, rep, axis=2) if rep > 1 else k_rows
+        v = jnp.repeat(v_rows, rep, axis=2) if rep > 1 else v_rows
+    else:
+        qkv = jnp.einsum("btd,kde->kbte", h, woq.w(p, "qkv_w", dt)) \
+            + p["qkv_b"].astype(dt)[:, None, None]
+        q = qkv[0].reshape(B, P, H, hd)
+        k = qkv[1].reshape(B, P, H, hd)
+        v = qkv[2].reshape(B, P, H, hd)
+        k_rows, v_rows = k, v
+    from ..ops.attention import attention_array
+
+    attn = attention_array(q, k, v, is_causal=True).reshape(B, P, D)
+    a = attn @ woq.w(p, "proj_w", dt) + p["proj_b"].astype(dt)
+    x = x + a
+    h = gpt._layer_norm(x.astype(jnp.float32), p["ln2_g"],
+                        p["ln2_b"]).astype(dt)
+    h = jax.nn.gelu(h @ woq.w(p, "fc_w", dt) + p["fc_b"].astype(dt))
+    h = h @ woq.w(p, "out_w", dt) + p["out_b"].astype(dt)
+    return x + h, k_rows, v_rows
+
+
+def prefill_slot(params, cache, tokens, length, slot, cfg: gpt.GPTConfig):
+    """Process one request's whole (padded) prompt in a single step.
+
+    tokens [1, P] int32 padded to P; ``length`` (traced scalar) = valid
+    prompt tokens; ``slot`` (traced scalar) = batch row of the serving
+    cache [L, B, T, Hkv, hd].  Writes cache rows [0, length) for that slot
+    (padded rows are NOT written — stale tenants' data beyond ``length``
+    stays hidden by the decode-time causal mask until overwritten) and
+    returns (greedy logits at position length-1 [V], cache)."""
+    if cfg.moe is not None:
+        raise NotImplementedError("prefill supports dense models")
+    dt = cfg.dtype
+    P = tokens.shape[1]
+    x = woq.embed(params, tokens, dt) + params["wpe"][:P].astype(dt)[None]
+
+    def body(x, p):
+        x, k_rows, v_rows = _prefill_block(x, p, cfg)
+        return x, (k_rows, v_rows)
+
+    x, (k_rows, v_rows) = jax.lax.scan(body, x, params["blocks"])
+    # masked merge into this slot's rows [0, P): only the valid prefix
+    valid = (jnp.arange(P) < length)[None, :, None, None]
+    for name, rows in (("k", k_rows), ("v", v_rows)):
+        old = jax.lax.dynamic_slice(
+            cache[name], (0, slot, 0, 0, 0),
+            (cache[name].shape[0], 1, P) + cache[name].shape[3:])
+        merged = jnp.where(valid[None], rows[:, 0][:, None], old)
+        cache = dict(cache, **{name: jax.lax.dynamic_update_slice(
+            cache[name], merged.astype(cache[name].dtype),
+            (0, slot, 0, 0, 0))})
+    x = gpt._layer_norm(x.astype(jnp.float32), params["ln_f_g"],
+                        params["ln_f_b"]).astype(dt)
+    last = jax.lax.dynamic_slice(x, (0, length - 1, 0),
+                                 (1, 1, cfg.hidden_size))
+    logits = woq.logits(last, params, dt)[0, 0]
+    return logits.astype(jnp.float32), cache

@@ -52,6 +52,16 @@ def decode_step_batched(params, cache, token, pos, cfg: gpt.GPTConfig):
 _STEP_CACHE: dict = {}
 
 
+def _get_prefill_fn(cfg: gpt.GPTConfig):
+    k = ("prefill", generate._cfg_key(cfg))
+    fn = _STEP_CACHE.get(k)
+    if fn is None:
+        fn = jax.jit(lambda p, c, t, ln, sl, _cfg=cfg:
+                     generate.prefill_slot(p, c, t, ln, sl, _cfg))
+        _STEP_CACHE[k] = fn
+    return fn
+
+
 def _get_step_fn(cfg: gpt.GPTConfig):
     """One jitted batched step per config VALUE (generate._GEN_CACHE's
     rationale: keying by object identity would recompile per DecodeServer
@@ -68,12 +78,17 @@ def _get_step_fn(cfg: gpt.GPTConfig):
 class DecodeServer:
     """Host-side slot scheduler around one jitted batched decode step.
 
-    Greedy decoding; prompts are consumed token-by-token through the same
-    step (each prompt token's logits are discarded until the prompt ends).
-    """
+    Greedy decoding.  With the default ``prefill=True``, submit/_admit
+    runs the whole (bucket-padded) prompt through ONE jitted
+    ``generate.prefill_slot`` step — device work at admission, one XLA
+    compile per power-of-two bucket — and ticks only generate; with
+    ``prefill=False`` prompts are consumed token-by-token through the
+    tick step (each prompt token's logits discarded until the prompt
+    ends)."""
 
     def __init__(self, params, cfg: gpt.GPTConfig, max_batch: int,
-                 max_len: int, eos_id: int | None = None):
+                 max_len: int, eos_id: int | None = None,
+                 prefill: bool = True):
         self.params = params
         self.cfg = cfg
         self.max_batch = max_batch
@@ -81,6 +96,10 @@ class DecodeServer:
         self.eos_id = eos_id
         self.cache = generate.init_cache(cfg, max_batch, max_len)
         self._step = _get_step_fn(cfg)
+        # chunked prefill: a whole prompt becomes ONE admission-time step
+        # (generate.prefill_slot) instead of len(prompt) ticks; prompts pad
+        # to power-of-two buckets so XLA compiles one prefill per bucket
+        self._prefill = (_get_prefill_fn(cfg) if prefill else None)
         # per-slot host state
         self._free = list(range(max_batch))
         self._slots: dict[int, dict] = {}        # slot -> request state
@@ -113,12 +132,34 @@ class DecodeServer:
         while self._queue and self._free:
             slot = self._free.pop()
             req = self._queue.pop(0)
-            self._slots[slot] = {
+            st = {
                 "rid": req["rid"], "prompt": req["prompt"],
                 "max_new": req["max_new"],
                 "generated": [],
                 "pos": 0,   # next position == index of the token to feed
             }
+            if self._prefill is not None:
+                n = len(req["prompt"])
+                bucket = 1
+                while bucket < n:
+                    bucket *= 2
+                # the padded chunk must fit both the wpe table and the
+                # cache window; both bounds are >= n (submit checked)
+                bucket = min(bucket, self.max_len, self.cfg.max_seq_len)
+                padded = np.zeros((1, bucket), np.int32)
+                padded[0, :n] = req["prompt"]
+                logits, self.cache = self._prefill(
+                    self.params, self.cache, jnp.asarray(padded),
+                    jnp.asarray(n), jnp.asarray(slot))
+                t = int(np.asarray(jnp.argmax(logits)))
+                st["generated"].append(t)
+                st["pos"] = n  # cache rows [0, n) are filled
+                if (st["max_new"] <= 1
+                        or (self.eos_id is not None and t == self.eos_id)):
+                    self._results[st["rid"]] = st["generated"]
+                    self._free.append(slot)
+                    continue
+            self._slots[slot] = st
 
     def pending(self) -> bool:
         return bool(self._slots or self._queue)
