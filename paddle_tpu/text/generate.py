@@ -41,18 +41,10 @@ def _cached_block(x, p, cache_k, cache_v, pos, cfg: gpt.GPTConfig):
     dt = cfg.dtype
     h = gpt._layer_norm(x.astype(jnp.float32), p["ln1_g"],
                         p["ln1_b"]).astype(dt)
-    if cfg.num_kv_heads is not None:
-        Hkv = cfg.kv_heads
-        q3, k3, v3 = gpt._gqa_qkv(h, p, cfg, repeat_kv=False)
-        q = q3.reshape(B, H, hd)
-        k_new = k3.reshape(B, Hkv, hd)  # cache stores the Hkv heads
-        v_new = v3.reshape(B, Hkv, hd)
-    else:
-        qkv = jnp.einsum("btd,kde->kbte", h, woq.w(p, "qkv_w", dt)) \
-            + p["qkv_b"].astype(dt)[:, None, None]
-        q = qkv[0].reshape(B, H, hd)
-        k_new = qkv[1].reshape(B, H, hd)
-        v_new = qkv[2].reshape(B, H, hd)
+    q3, k3, v3 = gpt._project_qkv(h, p, cfg, repeat_kv=False)
+    q = q3.reshape(B, H, hd)
+    k_new = k3.reshape(B, -1, hd)   # Hkv rows under GQA, H otherwise
+    v_new = v3.reshape(B, -1, hd)
     # attend over cache rows [B, max_len, H, hd] with the fresh row at pos
     k_all = jax.lax.dynamic_update_slice(
         cache_k, k_new[:, None], (0, pos, 0, 0))
@@ -81,11 +73,7 @@ def _cached_block(x, p, cache_k, cache_v, pos, cfg: gpt.GPTConfig):
         attn = jnp.einsum("bht,bthd->bhd", w, v_all).reshape(B, 1, D)
     a = attn @ woq.w(p, "proj_w", dt) + p["proj_b"].astype(dt)
     x = x + a
-    h = gpt._layer_norm(x.astype(jnp.float32), p["ln2_g"],
-                        p["ln2_b"]).astype(dt)
-    h = jax.nn.gelu(h @ woq.w(p, "fc_w", dt) + p["fc_b"].astype(dt))
-    h = h @ woq.w(p, "out_w", dt) + p["out_b"].astype(dt)
-    return x + h, k_new, v_new
+    return gpt._ffn_dense(x, p, cfg), k_new, v_new
 
 
 def decode_step(params, cache, token, pos, cfg: gpt.GPTConfig):
@@ -288,29 +276,16 @@ def _prefill_block(x, p, cfg: gpt.GPTConfig):
     dt = cfg.dtype
     h = gpt._layer_norm(x.astype(jnp.float32), p["ln1_g"],
                         p["ln1_b"]).astype(dt)
-    if cfg.num_kv_heads is not None:
-        # project ONCE (unrepeated), derive the attention copies by repeat
-        q, k_rows, v_rows = gpt._gqa_qkv(h, p, cfg, repeat_kv=False)
-        rep = H // cfg.kv_heads
-        k = jnp.repeat(k_rows, rep, axis=2) if rep > 1 else k_rows
-        v = jnp.repeat(v_rows, rep, axis=2) if rep > 1 else v_rows
-    else:
-        qkv = jnp.einsum("btd,kde->kbte", h, woq.w(p, "qkv_w", dt)) \
-            + p["qkv_b"].astype(dt)[:, None, None]
-        q = qkv[0].reshape(B, P, H, hd)
-        k = qkv[1].reshape(B, P, H, hd)
-        v = qkv[2].reshape(B, P, H, hd)
-        k_rows, v_rows = k, v
+    # project ONCE (unrepeated); derive GQA attention copies by repeat
+    q, k_rows, v_rows = gpt._project_qkv(h, p, cfg, repeat_kv=False)
+    rep = H // k_rows.shape[2]
+    k = jnp.repeat(k_rows, rep, axis=2) if rep > 1 else k_rows
+    v = jnp.repeat(v_rows, rep, axis=2) if rep > 1 else v_rows
     from ..ops.attention import attention_array
 
     attn = attention_array(q, k, v, is_causal=True).reshape(B, P, D)
     a = attn @ woq.w(p, "proj_w", dt) + p["proj_b"].astype(dt)
-    x = x + a
-    h = gpt._layer_norm(x.astype(jnp.float32), p["ln2_g"],
-                        p["ln2_b"]).astype(dt)
-    h = jax.nn.gelu(h @ woq.w(p, "fc_w", dt) + p["fc_b"].astype(dt))
-    h = h @ woq.w(p, "out_w", dt) + p["out_b"].astype(dt)
-    return x + h, k_rows, v_rows
+    return gpt._ffn_dense(x + a, p, cfg), k_rows, v_rows
 
 
 def prefill_slot(params, cache, tokens, length, slot, cfg: gpt.GPTConfig):
@@ -349,3 +324,139 @@ def prefill_slot(params, cache, tokens, length, slot, cfg: gpt.GPTConfig):
                                  (1, 1, cfg.hidden_size))
     logits = woq.logits(last, params, dt)[0, 0]
     return logits.astype(jnp.float32), cache
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding (greedy): draft proposes, target verifies in 1 chunk
+# ---------------------------------------------------------------------------
+
+
+def verify_chunk(params, cache, tokens, pos0, cfg: gpt.GPTConfig):
+    """Score K tokens in one pass against an existing cache.
+
+    tokens [1, K] int32 fed at positions [pos0, pos0+K); attends cache
+    rows [0, pos0) plus within-chunk causally; writes the chunk's K/V rows
+    at [pos0, pos0+K) (rows past an eventual rejection point stay hidden
+    behind the caller's position pointer until overwritten — the same
+    stale-row invariant the serving slots rely on).  Returns
+    (logits [1, K, V], cache)."""
+    if cfg.moe is not None:
+        raise NotImplementedError("verify_chunk supports dense models")
+    dt = cfg.dtype
+    B, K = tokens.shape
+    H, hd = cfg.num_heads, cfg.head_dim
+    x = woq.embed(params, tokens, dt) \
+        + jax.lax.dynamic_slice(params["wpe"], (pos0, 0),
+                                (K, cfg.hidden_size)).astype(dt)[None]
+
+    def body(x, layer):
+        p, ck, cv = layer
+        h = gpt._layer_norm(x.astype(jnp.float32), p["ln1_g"],
+                            p["ln1_b"]).astype(dt)
+        q, k_new, v_new = gpt._project_qkv(h, p, cfg, repeat_kv=False)
+        Hq, Hkv = H, k_new.shape[2]
+        k_all = jax.lax.dynamic_update_slice(ck, k_new.astype(ck.dtype),
+                                             (0, pos0, 0, 0))
+        v_all = jax.lax.dynamic_update_slice(cv, v_new.astype(cv.dtype),
+                                             (0, pos0, 0, 0))
+        T = ck.shape[1]
+        g = Hq // Hkv
+        qg = q.reshape(B, K, Hkv, g, hd)
+        scores = jnp.einsum("bikgd,btkd->bkgit", qg, k_all) / jnp.sqrt(
+            jnp.asarray(hd, jnp.float32)).astype(dt)
+        # row i may see cache rows t <= pos0 + i
+        mask = (jnp.arange(T)[None, :]
+                <= pos0 + jnp.arange(K)[:, None])[None, None, None]
+        scores = jnp.where(mask, scores.astype(jnp.float32), -1e30)
+        w_ = jax.nn.softmax(scores, axis=-1).astype(dt)
+        attn = jnp.einsum("bkgit,btkd->bikgd", w_, v_all).reshape(B, K, -1)
+        a = attn @ woq.w(p, "proj_w", dt) + p["proj_b"].astype(dt)
+        return gpt._ffn_dense(x + a, p, cfg), (k_new, v_new)
+
+    x, (k_rows, v_rows) = jax.lax.scan(
+        body, x, (params["blocks"], cache["k"], cache["v"]))
+    new_k = jax.lax.dynamic_update_slice(
+        cache["k"], k_rows.astype(cache["k"].dtype), (0, 0, pos0, 0, 0))
+    new_v = jax.lax.dynamic_update_slice(
+        cache["v"], v_rows.astype(cache["v"].dtype), (0, 0, pos0, 0, 0))
+    x = gpt._layer_norm(x.astype(jnp.float32), params["ln_f_g"],
+                        params["ln_f_b"]).astype(dt)
+    logits = woq.logits(x, params, dt)
+    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
+def _jit_by_cfg(tag: str, fn, cfg):
+    """Value-keyed jit cache (the _GEN_CACHE rationale: per-call jax.jit
+    wrappers would recompile per invocation and leak executables)."""
+    key = (tag, _cfg_key(cfg))
+    jf = _GEN_CACHE.get(key)
+    if jf is None:
+        jf = jax.jit(lambda p, c, t, s, _cfg=cfg: fn(p, c, t, s, _cfg))
+        _GEN_CACHE[key] = jf
+    return jf
+
+
+def speculative_generate(tparams, tcfg, dparams, dcfg, prompt,
+                         max_new_tokens=32, k=4):
+    """Greedy speculative decoding: a small DRAFT model proposes ``k``
+    tokens per round (k cheap decode steps), the TARGET verifies them in
+    ONE verify_chunk pass, accepting the longest prefix where its own
+    greedy choice agrees and substituting its token at the first
+    disagreement.  Output is EXACTLY the target's greedy generation — the
+    draft only changes how many target forward passes it takes.
+
+    Both models keep KV caches; rejected rows in either cache stay hidden
+    behind the position pointers and are overwritten on the next round
+    (the serving slots' stale-row invariant).  Returns a python list of
+    the generated tokens (no prompt)."""
+    import numpy as np
+
+    prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+    if not prompt:
+        raise ValueError("empty prompt")
+    total = len(prompt) + max_new_tokens
+    if total > min(tcfg.max_seq_len, dcfg.max_seq_len):
+        raise ValueError("prompt + max_new_tokens exceeds a model's window")
+    t_step = _jit_by_cfg("decode", decode_step, tcfg)
+    d_step = _jit_by_cfg("decode", decode_step, dcfg)
+    t_verify = _jit_by_cfg("verify", verify_chunk, tcfg)
+    t_cache = init_cache(tcfg, 1, total)
+    d_cache = init_cache(dcfg, 1, total)
+
+    # prompt: feed both models token-by-token (simple; prefill would also
+    # work) — target logits at the last prompt position seed generation
+    t_logits = None
+    for pos in range(len(prompt)):
+        tok = jnp.asarray([prompt[pos]], jnp.int32)
+        t_logits, t_cache = t_step(tparams, t_cache, tok, pos)
+        _, d_cache = d_step(dparams, d_cache, tok, pos)
+
+    out = [int(np.asarray(jnp.argmax(t_logits, -1))[0])]
+    t_pos = len(prompt)          # target cache rows [0, t_pos) are final
+    while len(out) < max_new_tokens:
+        kk = min(k, max_new_tokens - len(out), total - 1 - t_pos)
+        if kk <= 0:
+            break
+        # 1) draft proposes kk tokens from the current accepted tail
+        draft = []
+        cur = out[-1]
+        for j in range(kk):
+            dl, d_cache = d_step(dparams, d_cache,
+                                 jnp.asarray([cur], jnp.int32), t_pos + j)
+            cur = int(np.asarray(jnp.argmax(dl, -1))[0])
+            draft.append(cur)
+        # 2) target scores [out[-1], draft[0..kk-2]] in one chunk: row j's
+        # logits are the target's choice AFTER seeing draft[j-1]
+        chunk = jnp.asarray([[out[-1]] + draft[:-1]], jnp.int32)
+        vl, t_cache = t_verify(tparams, t_cache, chunk, t_pos)
+        tchoice = np.asarray(jnp.argmax(vl[0], -1))
+        for j in range(kk):
+            out.append(int(tchoice[j]))
+            t_pos += 1
+            if int(tchoice[j]) != draft[j]:
+                break   # target disagrees: its token wins, round ends
+        # no draft-cache resync is needed: after a rejection the draft's
+        # first stale row sits exactly at the new t_pos — the position the
+        # next round's first proposal overwrites (fed the corrected
+        # out[-1]); rows before it were fed accepted (= identical) tokens
+    return out[:max_new_tokens]

@@ -269,6 +269,32 @@ def _gqa_qkv(h, p, cfg: GPTConfig, repeat_kv: bool = True,
     return q, k, v
 
 
+def _project_qkv(h, p, cfg: GPTConfig, repeat_kv: bool = True):
+    """qkv projection for BOTH attention families: q [B,T,H,hd], k/v
+    [B,T,H,hd] (dense / repeated GQA) or [B,T,Hkv,hd] (repeat_kv=False —
+    the cache-row layout).  The single source the train block and every
+    decode-path block (generate.py: cached/prefill/verify) project
+    through."""
+    B, T, _ = h.shape
+    if cfg.num_kv_heads is not None:
+        return _gqa_qkv(h, p, cfg, repeat_kv=repeat_kv)
+    dt = cfg.dtype
+    H, hd = cfg.num_heads, cfg.head_dim
+    qkv = jnp.einsum("btd,kde->kbte", h, woq.w(p, "qkv_w", dt)) \
+        + p["qkv_b"].astype(dt)[:, None, None]
+    return (qkv[0].reshape(B, T, H, hd), qkv[1].reshape(B, T, H, hd),
+            qkv[2].reshape(B, T, H, hd))
+
+
+def _ffn_dense(x, p, cfg: GPTConfig):
+    """Residual dense FFN half of a block: x + MLP(LN(x))."""
+    dt = cfg.dtype
+    h = _layer_norm(x.astype(jnp.float32), p["ln2_g"],
+                    p["ln2_b"]).astype(dt)
+    h = jax.nn.gelu(h @ woq.w(p, "fc_w", dt) + p["fc_b"].astype(dt))
+    return x + (h @ woq.w(p, "out_w", dt) + p["out_b"].astype(dt))
+
+
 def _block(x, p, cfg: GPTConfig, dropout_key=None):
     """One transformer block on [B, T, D] activations (compute dtype)."""
     B, T, D = x.shape
@@ -276,14 +302,7 @@ def _block(x, p, cfg: GPTConfig, dropout_key=None):
     dt = cfg.dtype
     drop = cfg.dropout > 0.0 and dropout_key is not None
     h = _ln(x, p["ln1_g"], p["ln1_b"], dt)
-    if cfg.num_kv_heads is not None:
-        q, k, v = _gqa_qkv(h, p, cfg)
-    else:
-        qkv = jnp.einsum("btd,kde->kbte", h, woq.w(p, "qkv_w", dt)) \
-            + p["qkv_b"].astype(dt)[:, None, None]
-        q = qkv[0].reshape(B, T, H, hd)
-        k = qkv[1].reshape(B, T, H, hd)
-        v = qkv[2].reshape(B, T, H, hd)
+    q, k, v = _project_qkv(h, p, cfg)
     attn = attention_array(q, k, v, is_causal=True)
     attn = attn.reshape(B, T, D)
     a = attn @ woq.w(p, "proj_w", dt) + p["proj_b"].astype(dt)

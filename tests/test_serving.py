@@ -221,3 +221,66 @@ def test_served_markov_with_prefill_follows_rule(markov_gpt):
     # prompts were consumed by prefill, not ticks: 3 requests x 8 tokens
     # on 2 slots needs at most ~2 waves of 7 post-admission ticks
     assert ticks <= 16, ticks
+
+
+def test_prefill_default_matches_solo_on_trained(markov_gpt):
+    """The DEFAULT configuration (prefill on): served tokens equal the
+    solo sequential decode — on the trained model whose margins make the
+    equality robust to chunked-vs-stepwise bf16 noise."""
+    cfg, params = markov_gpt
+    prompts = [[2, 7, 9], [11], [5, 3]]
+    srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=30)
+    rids = [srv.submit(p, max_new_tokens=6) for p in prompts]
+    while srv.pending():
+        srv.tick()
+    for rid, p in zip(rids, prompts):
+        assert srv.result(rid) == _greedy_reference(params, cfg, p, 6), p
+
+
+def test_prefill_eos_at_admission_frees_slot(markov_gpt):
+    """EOS produced BY the prefill step itself: the request completes at
+    admission, the slot is recycled inside the same _admit loop, and the
+    next queued request is served."""
+    cfg, params = markov_gpt
+    # the trained rule: prompt [2] greedily yields (2*3+1)%13 = 7 first
+    srv = serving.DecodeServer(params, cfg, max_batch=1, max_len=30,
+                               eos_id=7)
+    r1 = srv.submit([2], max_new_tokens=10)   # completes at admission
+    r2 = srv.submit([5], max_new_tokens=3)    # must still get the slot
+    while srv.pending():
+        srv.tick()
+    assert srv.result(r1) == [7]
+    assert srv.result(r2) == _greedy_reference(params, cfg, [5], 3)
+
+
+def test_prefill_max_new_one_completes_at_admission(markov_gpt):
+    cfg, params = markov_gpt
+    srv = serving.DecodeServer(params, cfg, max_batch=1, max_len=30)
+    rid = srv.submit([2, 7], max_new_tokens=1)
+    # no ticks needed: prefill already produced the single token
+    assert not srv.pending()
+    assert srv.result(rid) == _greedy_reference(params, cfg, [2, 7], 1)
+
+
+def test_prefill_parity_gqa():
+    """GQA prefill (unrepeated projection + repeat for attention): written
+    cache rows and last-position logits match the sequential feed."""
+    cfg = _cfg(num_kv_heads=2)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(8))
+    prompt = [3, 9, 1, 7]
+    cache_r = G.init_cache(cfg, 1, 32)
+    want = None
+    for pos in range(len(prompt)):
+        want, cache_r = G.decode_step(
+            params, cache_r, jnp.asarray([prompt[pos]], jnp.int32), pos,
+            cfg)
+    cache_p = G.init_cache(cfg, 2, 32)
+    padded = np.zeros((1, 4), np.int32)
+    padded[0, :] = prompt
+    got, cache_p = G.prefill_slot(params, cache_p, jnp.asarray(padded),
+                                  jnp.asarray(4), jnp.asarray(0), cfg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want)[0],
+                               rtol=2e-2, atol=5e-3)
+    np.testing.assert_allclose(np.asarray(cache_p["k"][:, 0, :4]),
+                               np.asarray(cache_r["k"][:, 0, :4]),
+                               rtol=2e-2, atol=5e-3)
