@@ -370,11 +370,14 @@ def watch(interval: float, probe_timeout: float, max_hours: float):
         # Back off hard after repeated failures.  Evidence (probe log,
         # rounds 3-4): every killed probe/compile leaves the tunnel's
         # remote claim held, so continuous 5-min probing SUSTAINED wedges
-        # for hours (nine failed probes 15:40-19:30 round 3), while both
-        # healthy windows this round appeared after 90+ minutes of probe
-        # silence.  Quiet time is what lets the claim clear — so after 3
-        # consecutive failures, probe only every 30 minutes.
-        sleep_s = interval if consecutive_fails < 3 else max(interval, 1800)
+        # for hours (nine failed probes 15:40-19:30 round 3), while every
+        # healthy window on record opened after 90+ minutes of probe
+        # SILENCE (round 4: last probe 10:53, healthy 12:27).  30-minute
+        # backoff probing was tried for 5 h on 2026-07-31 (11 consecutive
+        # fails, 12:48-17:28) and never saw the tunnel clear — each
+        # killed probe plausibly renews the held claim.  So after 3
+        # consecutive failures, go genuinely quiet: 95 minutes.
+        sleep_s = interval if consecutive_fails < 3 else max(interval, 5700)
         if sleep_s != interval:
             log(f"[watch] {consecutive_fails} consecutive failed probes — "
                 f"backing off to {sleep_s:.0f}s to give the tunnel quiet "
