@@ -171,12 +171,13 @@ def test_probe_backoff_after_three_failures(pt):
     _probe_seq(pt, [False] * 6)
     run, _ = _runner({})
     pt._run_step = run
-    pt.watch(interval=300, probe_timeout=1, max_hours=1.0)
-    # first two sleeps at the fast interval, then the 30-minute quiet —
+    pt.watch(interval=300, probe_timeout=1, max_hours=2.0)
+    # first two sleeps at the fast interval, then the 95-minute quiet
+    # (healthy windows only ever opened after 90+ min of probe silence) —
     # with every sleep clamped to the remaining max-hours budget
     assert pt._sleeps[0] == 300 and pt._sleeps[1] == 300
-    assert pt._sleeps[2] == 1800
-    assert pt._sleeps[3] == 1200  # clamped: 3600s deadline - 2400 elapsed
+    assert pt._sleeps[2] == 5700
+    assert pt._sleeps[3] == 900  # clamped: 7200s deadline - 6300 elapsed
 
 
 def test_stale_certification_reopens_flash_check(pt):
