@@ -73,13 +73,18 @@ def _cached_block(x, p, cache_k, cache_v, pos, cfg: gpt.GPTConfig):
         attn = jnp.einsum("bht,bthd->bhd", w, v_all).reshape(B, 1, D)
     a = attn @ woq.w(p, "proj_w", dt) + p["proj_b"].astype(dt)
     x = x + a
-    return gpt._ffn_dense(x, p, cfg), k_new, v_new
+    return gpt._ffn_tail(x, p, cfg), k_new, v_new
 
 
 def decode_step(params, cache, token, pos, cfg: gpt.GPTConfig):
-    """token [B] int32 at position pos → (logits [B, V], updated cache)."""
-    if cfg.moe is not None:
-        raise NotImplementedError("cached decode supports dense models")
+    """token [B] int32 at position pos → (logits [B, V], updated cache).
+
+    MoE models decode too: the expert FFN routes the step's B tokens
+    jointly (GShard capacity from the call's token count, C =
+    ceil(B*top_k/E*cf)) — at B == 1 nothing can drop; at B > 1 batch rows
+    contend for capacity exactly as training tokens do, so a batched
+    sequence's tokens can depend on its batch-mates (inherent to
+    capacity-bounded routing, not a cache artifact)."""
     dt = cfg.dtype
     B = token.shape[0]
     x = woq.embed(params, token, dt)[:, None] \
@@ -110,7 +115,11 @@ def _cfg_key(cfg):
     """Value-based cache key (GPTConfig is an unhashable dataclass; keying
     by id() would recompile per object and leak executables)."""
     moe = cfg.moe
-    moe_key = (moe.num_experts,) if moe is not None else None
+    # every routing-relevant field: two MoE configs differing in top_k or
+    # capacity must never share a jitted executable
+    moe_key = ((moe.num_experts, moe.top_k, moe.capacity_factor,
+                moe.router_noise, moe.aux_loss_weight)
+               if moe is not None else None)
     return (cfg.vocab_size, cfg.hidden_size, cfg.num_layers, cfg.num_heads,
             cfg.num_kv_heads,
             cfg.max_seq_len, cfg.ffn_ratio, str(cfg.dtype), cfg.use_flash,
@@ -285,7 +294,7 @@ def _prefill_block(x, p, cfg: gpt.GPTConfig):
 
     attn = attention_array(q, k, v, is_causal=True).reshape(B, P, D)
     a = attn @ woq.w(p, "proj_w", dt) + p["proj_b"].astype(dt)
-    return gpt._ffn_dense(x + a, p, cfg), k_rows, v_rows
+    return gpt._ffn_tail(x + a, p, cfg), k_rows, v_rows
 
 
 def prefill_slot(params, cache, tokens, length, slot, cfg: gpt.GPTConfig):
@@ -298,7 +307,12 @@ def prefill_slot(params, cache, tokens, length, slot, cfg: gpt.GPTConfig):
     stays hidden by the decode-time causal mask until overwritten) and
     returns (greedy logits at position length-1 [V], cache)."""
     if cfg.moe is not None:
-        raise NotImplementedError("prefill supports dense models")
+        # the PADDING tokens would be routed too, consuming expert
+        # capacity and silently corrupting real tokens' activations (and
+        # the K/V rows derived from them) — MoE prompts feed stepwise
+        raise NotImplementedError(
+            "prefill with MoE: padded bucket tokens would consume expert "
+            "capacity; feed the prompt token-by-token instead")
     dt = cfg.dtype
     P = tokens.shape[1]
     x = woq.embed(params, tokens, dt) + params["wpe"][:P].astype(dt)[None]
@@ -339,9 +353,12 @@ def verify_chunk(params, cache, tokens, pos0, cfg: gpt.GPTConfig):
     at [pos0, pos0+K) (rows past an eventual rejection point stay hidden
     behind the caller's position pointer until overwritten — the same
     stale-row invariant the serving slots rely on).  Returns
-    (logits [1, K, V], cache)."""
-    if cfg.moe is not None:
-        raise NotImplementedError("verify_chunk supports dense models")
+    (logits [1, K, V], cache).
+
+    MoE: the K chunk tokens route JOINTLY (capacity C from N=K), so a
+    chunk can drop tokens a one-at-a-time decode would not — chunked
+    verification is therefore not bit-equal to stepwise decode for MoE;
+    speculative_generate rejects MoE targets for exactly this reason."""
     dt = cfg.dtype
     B, K = tokens.shape
     H, hd = cfg.num_heads, cfg.head_dim
@@ -371,7 +388,7 @@ def verify_chunk(params, cache, tokens, pos0, cfg: gpt.GPTConfig):
         w_ = jax.nn.softmax(scores, axis=-1).astype(dt)
         attn = jnp.einsum("bkgit,btkd->bikgd", w_, v_all).reshape(B, K, -1)
         a = attn @ woq.w(p, "proj_w", dt) + p["proj_b"].astype(dt)
-        return gpt._ffn_dense(x + a, p, cfg), (k_new, v_new)
+        return gpt._ffn_tail(x + a, p, cfg), (k_new, v_new)
 
     x, (k_rows, v_rows) = jax.lax.scan(
         body, x, (params["blocks"], cache["k"], cache["v"]))
@@ -414,6 +431,14 @@ def speculative_generate(tparams, tcfg, dparams, dcfg, prompt,
     prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
     if not prompt:
         raise ValueError("empty prompt")
+    if tcfg.moe is not None or dcfg.moe is not None:
+        # verify_chunk routes K tokens jointly while plain decode routes
+        # 1: capacity drops could make "accepted" tokens differ from the
+        # target's own greedy decode, silently breaking the exactness
+        # guarantee this function exists for
+        raise NotImplementedError(
+            "speculative decoding requires dense models (MoE capacity "
+            "routing differs between chunked verify and stepwise decode)")
     total = len(prompt) + max_new_tokens
     if total > min(tcfg.max_seq_len, dcfg.max_seq_len):
         raise ValueError("prompt + max_new_tokens exceeds a model's window")

@@ -295,6 +295,23 @@ def _ffn_dense(x, p, cfg: GPTConfig):
     return x + (h @ woq.w(p, "out_w", dt) + p["out_b"].astype(dt))
 
 
+def _ffn_tail(x, p, cfg: GPTConfig):
+    """Inference FFN half: dense MLP or MoE (aux loss discarded — it only
+    matters for the training objective).  MoE capacity is computed from
+    the CALL's token count (GShard semantics): at one token nothing can
+    drop; a batched call's rows contend for capacity like training
+    tokens."""
+    if cfg.moe is None:
+        return _ffn_dense(x, p, cfg)
+    from .moe import moe_ffn
+
+    dt = cfg.dtype
+    h = _layer_norm(x.astype(jnp.float32), p["ln2_g"],
+                    p["ln2_b"]).astype(dt)
+    y, _aux = moe_ffn(p["moe"], h, cfg.moe, key=None)
+    return x + y
+
+
 def _block(x, p, cfg: GPTConfig, dropout_key=None):
     """One transformer block on [B, T, D] activations (compute dtype)."""
     B, T, D = x.shape

@@ -98,8 +98,12 @@ class DecodeServer:
         self._step = _get_step_fn(cfg)
         # chunked prefill: a whole prompt becomes ONE admission-time step
         # (generate.prefill_slot) instead of len(prompt) ticks; prompts pad
-        # to power-of-two buckets so XLA compiles one prefill per bucket
-        self._prefill = (_get_prefill_fn(cfg) if prefill else None)
+        # to power-of-two buckets so XLA compiles one prefill per bucket.
+        # MoE models feed token-by-token instead: bucket PADDING would be
+        # routed too, consuming expert capacity and potentially dropping
+        # real prompt tokens (GShard capacity is per-call N)
+        self._prefill = (_get_prefill_fn(cfg)
+                         if prefill and cfg.moe is None else None)
         # per-slot host state
         self._free = list(range(max_batch))
         self._slots: dict[int, dict] = {}        # slot -> request state

@@ -70,8 +70,10 @@ def quantize_gpt_int8(params: dict) -> dict:
     """Return a decode-ready param tree: block matmul weights and the tied
     embedding become int8 with per-output-channel scales stored under
     ``<name>_s``.  LayerNorm, biases, and wpe stay float (negligible
-    bytes; norm math is fp32 anyway).  MoE models are untouched by design
-    — cached decode rejects them before weights matter."""
+    bytes; norm math is fp32 anyway).  MoE expert weights (p["moe"]) are
+    NOT quantized — an MoE model decodes through this tree but only its
+    attention weights and embedding shrink; expert-weight quantization is
+    future work, so expect no bandwidth win on expert-dominated models."""
     out = dict(params)
     blocks = dict(params["blocks"])
     for name, axis in _BLOCK_WEIGHTS.items():
