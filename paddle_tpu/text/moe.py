@@ -20,6 +20,8 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+
+from . import woq
 from jax.sharding import PartitionSpec as P
 
 
@@ -133,9 +135,9 @@ def moe_ffn_manual(params: dict, x, cfg: MoEConfig, ep_axis: str | None,
 
     xin = jnp.einsum("nec,nd->ecd", disp, xf)         # [E_local, C, D]
     h = activation(jnp.einsum("ecd,edf->ecf", xin,
-                              params["w_in"].astype(x.dtype))
+                              woq.w(params, "w_in", x.dtype))
                    + params["b_in"][:, None].astype(x.dtype))
-    out = jnp.einsum("ecf,efd->ecd", h, params["w_out"].astype(x.dtype))
+    out = jnp.einsum("ecf,efd->ecd", h, woq.w(params, "w_out", x.dtype))
     if mp_axis is not None:
         out = jax.lax.psum(out, mp_axis)  # row-parallel reduce
     out = out + params["b_out"][:, None].astype(x.dtype)
@@ -163,12 +165,14 @@ def moe_ffn(params: dict, x, cfg: MoEConfig, key=None, activation=jax.nn.gelu):
     disp, comb, aux = _route(params, xf, cfg, key, E, C, x.dtype)
 
     # route → expert ffn → route back (XLA lowers these to all_to_all when
-    # the E dim is sharded over 'ep')
+    # the E dim is sharded over 'ep'); weights resolve through woq.w —
+    # identity on float training params, fused dequant on weight-only
+    # int8/int4 decode params
     xin = jnp.einsum("nec,nd->ecd", disp, xf)                     # [E,C,D]
     h = activation(jnp.einsum("ecd,edf->ecf", xin,
-                              params["w_in"].astype(x.dtype))
+                              woq.w(params, "w_in", x.dtype))
                    + params["b_in"][:, None].astype(x.dtype))
-    out = jnp.einsum("ecf,efd->ecd", h, params["w_out"].astype(x.dtype)) \
+    out = jnp.einsum("ecf,efd->ecd", h, woq.w(params, "w_out", x.dtype)) \
         + params["b_out"][:, None].astype(x.dtype)
     y = jnp.einsum("nec,ecd->nd", comb.astype(x.dtype), out)
     return y.reshape(orig_shape), aux

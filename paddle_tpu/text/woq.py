@@ -37,6 +37,12 @@ _BLOCK_WEIGHTS = {
     "out_w": 1,   # [F, D]
 }
 
+# expert weights inside blocks["moe"]: [E, D, F] / [E, F, D] per layer —
+# out axis 2 either way.  The router (router_w) stays float: it is tiny
+# and its argmax decides WHICH experts run — routing flips are a far
+# larger error than any bandwidth win.
+_MOE_WEIGHTS = {"w_in": 2, "w_out": 2}
+
 
 def _quant(w, axis: int):
     """Symmetric per-channel int8; axis is the output-channel axis of the
@@ -70,10 +76,10 @@ def quantize_gpt_int8(params: dict) -> dict:
     """Return a decode-ready param tree: block matmul weights and the tied
     embedding become int8 with per-output-channel scales stored under
     ``<name>_s``.  LayerNorm, biases, and wpe stay float (negligible
-    bytes; norm math is fp32 anyway).  MoE expert weights (p["moe"]) are
-    NOT quantized — an MoE model decodes through this tree but only its
-    attention weights and embedding shrink; expert-weight quantization is
-    future work, so expect no bandwidth win on expert-dominated models."""
+    bytes; norm math is fp32 anyway).  MoE expert weights (blocks["moe"]
+    w_in/w_out — the bulk of an MoE model) quantize per-output-channel
+    like the dense weights; the tiny router stays float (a routing flip
+    is a far larger error than its bandwidth is worth)."""
     out = dict(params)
     blocks = dict(params["blocks"])
     for name, axis in _BLOCK_WEIGHTS.items():
@@ -81,6 +87,13 @@ def quantize_gpt_int8(params: dict) -> dict:
             q, s = _quant(blocks[name], axis)
             blocks[name] = q
             blocks[name + "_s"] = s
+    if isinstance(blocks.get("moe"), dict):
+        moe = dict(blocks["moe"])
+        for name, axis in _MOE_WEIGHTS.items():
+            q, s = _quant(moe[name], axis)
+            moe[name] = q
+            moe[name + "_s"] = s
+        blocks["moe"] = moe
     out["blocks"] = blocks
     _quantize_wte_int8(out, params)
     return out
@@ -93,27 +106,35 @@ def quantize_gpt_int4(params: dict, group_size: int = 64) -> dict:
     ``group_size`` inputs, the standard W4 recipe).  The embedding stays
     int8 (quantize_gpt_int8's path): lookup tables are small and 4-bit
     token vectors measurably hurt.  HBM reads drop to a quarter of bf16."""
+    def q4(w_, axis):
+        """(int4 q, grouped scale) — or per-channel int8 when the input
+        dim doesn't divide into groups."""
+        w_ = np.asarray(w_, np.float32)
+        in_axis = axis  # stacked layout: in dim sits just before out
+        in_dim = w_.shape[in_axis]
+        if in_dim % group_size:
+            return _quant(w_, axis)
+        G = in_dim // group_size
+        shp = w_.shape
+        grouped = w_.reshape(*shp[:in_axis], G, group_size,
+                             *shp[in_axis + 1:])
+        scale = np.maximum(np.abs(grouped).max(axis=in_axis + 1,
+                                               keepdims=True), 1e-8)
+        q = np.clip(np.round(grouped / scale * 7.0), -7, 7)
+        return (jnp.asarray(q.reshape(shp), jnp.int4),
+                jnp.asarray((scale / 7.0).astype(np.float32)))
+
     out = dict(params)
     blocks = dict(params["blocks"])
     for name, axis in _BLOCK_WEIGHTS.items():
         if name not in blocks or blocks[name] is None:
             continue
-        w_ = np.asarray(blocks[name], np.float32)
-        in_axis = axis  # stacked layout: in dim sits just before out
-        in_dim = w_.shape[in_axis]
-        if in_dim % group_size:
-            # ungrouped fallback: per-channel int8 for just this tensor
-            blocks[name], blocks[name + "_s"] = _quant(w_, axis)
-            continue
-        G = in_dim // group_size
-        shp = w_.shape
-        grouped = w_.reshape(*shp[:in_axis], G, group_size, *shp[in_axis + 1:])
-        scale = np.maximum(np.abs(grouped).max(axis=in_axis + 1,
-                                               keepdims=True), 1e-8)
-        q = np.clip(np.round(grouped / scale * 7.0), -7, 7)
-        blocks[name] = jnp.asarray(q.reshape(shp), jnp.int4)
-        blocks[name + "_s"] = jnp.asarray(
-            (scale / 7.0).astype(np.float32))
+        blocks[name], blocks[name + "_s"] = q4(blocks[name], axis)
+    if isinstance(blocks.get("moe"), dict):
+        moe = dict(blocks["moe"])
+        for name, axis in _MOE_WEIGHTS.items():
+            moe[name], moe[name + "_s"] = q4(moe[name], axis)
+        blocks["moe"] = moe
     out["blocks"] = blocks
     _quantize_wte_int8(out, params)
     return out

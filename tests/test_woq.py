@@ -150,3 +150,26 @@ def test_int4_indivisible_input_falls_back_to_int8():
     q4 = woq.quantize_gpt_int4(_params(cfg), group_size=64)
     assert q4["blocks"]["q_w" if cfg.num_kv_heads else "qkv_w"].dtype \
         == jnp.int8
+
+
+def test_moe_expert_weights_quantize_and_decode():
+    """MoE expert weights (the bulk of an MoE model) quantize too; the
+    quantized MoE decode tracks the float decode.  Router stays float."""
+    from paddle_tpu.text.moe import MoEConfig
+
+    cfg = _cfg(hidden_size=64, moe=MoEConfig(num_experts=2, top_k=2,
+                                             capacity_factor=1.0,
+                                             router_noise=0.0))
+    params = _params(cfg)
+    q8 = woq.quantize_gpt_int8(params)
+    assert q8["blocks"]["moe"]["w_in"].dtype == jnp.int8
+    assert q8["blocks"]["moe"]["router_w"].dtype != jnp.int8
+    q4 = woq.quantize_gpt_int4(params, group_size=32)
+    assert q4["blocks"]["moe"]["w_in"].dtype == jnp.int4
+    cache = generate.init_cache(cfg, 2, 8)
+    tok = jnp.asarray([3, 7], jnp.int32)
+    lf, _ = generate.decode_step(params, cache, tok, 0, cfg)
+    for q in (q8, q4):
+        lq, _ = generate.decode_step(q, cache, tok, 0, cfg)
+        err = np.abs(np.asarray(lf) - np.asarray(lq)).max()
+        assert err < 0.15 * np.abs(np.asarray(lf)).max() + 0.15, err
