@@ -926,6 +926,9 @@ def main():
     if which:
         results[which] = _CONFIGS[which](small)
     elif run_all:
+        details_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_DETAILS.json")
         for name, fn in _CONFIGS.items():
             if name == "gpt" and reuse is not None:
                 results["gpt"] = reuse
@@ -936,6 +939,13 @@ def main():
                 import traceback
                 traceback.print_exc(file=sys.stderr)
                 results[name] = {"error": f"{type(e).__name__}: {e}"}
+            # write INCREMENTALLY: a step-timeout SIGKILL mid-walk (the
+            # watchdog treats overruns as a re-wedged tunnel) must not
+            # discard the configs already measured in this window
+            tmp = details_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(results, f, indent=2)
+            os.replace(tmp, details_path)
         with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "BENCH_DETAILS.json"), "w") as f:
             json.dump(results, f, indent=2)
