@@ -1,0 +1,159 @@
+"""LoRA fine-tuning for the GPT family (low-rank adapters).
+
+Beyond-reference capability: parameter-efficient fine-tuning — freeze the
+pretrained weights, train only low-rank deltas.  TPU-first shape: the
+adapters are ordinary pytree leaves (``<name>_lora_a`` [..., in, r] and
+``<name>_lora_b`` [..., r, out]) living NEXT TO the frozen weights, and
+every weight consumer already resolves through ``woq.w`` — which adds
+``a @ b`` after (de)quantization.  One mechanism therefore covers:
+
+  * LoRA over a float base (classic fine-tuning),
+  * QLoRA: the base stored int8/int4 (woq.quantize_gpt_*), adapters fp32
+    — fine-tune a model whose weights don't fit in HBM at full precision,
+  * LoRA'd DECODE: generate/serving read the same accessor, so adapted
+    models generate without merging.
+
+``b`` initializes to zero (standard LoRA), so an adapted model is exactly
+the base model at step 0.  The conventional alpha/r scale is folded into
+``a``'s init std — document-equivalent to scaling the delta, without a
+third leaf per weight.
+
+    params = lora_init(base_params, cfg, rank=8, key=key)
+    init, step = build_lora_train_step(cfg, opt)
+    state = init(params)
+    state, loss = step(state, tokens, lr)          # trains ONLY adapters
+    adapted = join_lora(state.base, state.adapters)
+    merged = merge_lora(adapted)                   # fold for deploy
+
+Inference cost note: an UN-merged adapted model rebuilds each weight's
+delta (a @ b, O(in*out*r)) inside every compiled step — fine for
+training and evaluation, but for latency-critical float serving, merge
+first; QLoRA decode (quantized base, unmergeable) pays the delta per
+step by design.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from . import gpt, woq
+
+__all__ = ["lora_init", "split_lora", "join_lora", "merge_lora",
+           "build_lora_train_step"]
+
+_SUFFIX_A, _SUFFIX_B = "_lora_a", "_lora_b"
+
+
+def lora_init(params: dict, cfg: gpt.GPTConfig, rank: int = 8,
+              key=None, alpha: float = 16.0,
+              targets: tuple = ("qkv_w", "q_w", "kv_w", "proj_w")) -> dict:
+    """Attach zero-initialized adapters to the targeted block weights.
+
+    targets defaults to the attention projections (the standard LoRA
+    recipe); add "fc_w"/"out_w" to adapt the MLP too.  Works on float OR
+    woq-quantized base params (QLoRA)."""
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    out = dict(params)
+    blocks = dict(params["blocks"])
+    # kaiming-scale a (fan_in = the weight's input dim) times the
+    # conventional alpha/rank: the delta's reachable magnitude is bounded
+    # by |a|, so a too-small a throttles adaptation no matter the lr
+    for name in targets:
+        base = blocks.get(name)
+        if base is None:
+            continue
+        shp = tuple(base.shape)  # [L, ..., in, out]
+        key, sub = jax.random.split(key)
+        a = (jax.random.normal(sub, shp[:-1] + (rank,), jnp.float32)
+             * ((alpha / rank) / jnp.sqrt(shp[-2])))
+        blocks[name + _SUFFIX_A] = a
+        blocks[name + _SUFFIX_B] = jnp.zeros(shp[:-2] + (rank, shp[-1]),
+                                             jnp.float32)
+    out["blocks"] = blocks
+    return out
+
+
+def split_lora(params: dict):
+    """(frozen_base, adapters): adapters is the trainable sub-tree."""
+    blocks = params["blocks"]
+    ad = {k: v for k, v in blocks.items()
+          if k.endswith(_SUFFIX_A) or k.endswith(_SUFFIX_B)}
+    base_blocks = {k: v for k, v in blocks.items() if k not in ad}
+    return dict(params, blocks=base_blocks), ad
+
+
+def join_lora(base: dict, adapters: dict) -> dict:
+    """Recombine a split state into one adapted param tree (the form
+    every consumer — forward, generate, serving — takes)."""
+    return dict(base, blocks=dict(base["blocks"], **adapters))
+
+
+_join = join_lora  # internal alias
+
+
+def merge_lora(params: dict) -> dict:
+    """Fold the adapters into the base weights (deploy artifact).
+
+    Float bases only — merging into an int8/int4 base would re-quantize
+    and silently change the model; dequantize-merge-requantize is a
+    deliberate, lossy step the caller should take explicitly."""
+    blocks = dict(params["blocks"])
+    names = [k[: -len(_SUFFIX_A)] for k in blocks if k.endswith(_SUFFIX_A)]
+    for name in names:
+        base = blocks[name]
+        if base.dtype in (jnp.int8, jnp.int4):
+            raise NotImplementedError(
+                "merge_lora on a quantized base: dequantize first (the "
+                "merge would re-quantize and change the model)")
+        delta = jnp.einsum("...dr,...rf->...df",
+                           blocks.pop(name + _SUFFIX_A),
+                           blocks.pop(name + _SUFFIX_B))
+        blocks[name] = (base + delta).astype(base.dtype)
+    return dict(params, blocks=blocks)
+
+
+@dataclasses.dataclass
+class LoraTrainState:
+    base: Any          # frozen (possibly quantized) weights
+    adapters: Any      # trainable low-rank leaves
+    opt_state: Any
+    step: Any
+
+
+def build_lora_train_step(cfg: gpt.GPTConfig, optimizer):
+    """Single-chip LoRA train step: loss/grads/update over ONLY the
+    adapter leaves.  The state (including the frozen base) is DONATED:
+    the base passes through unchanged, so XLA aliases its buffers
+    input-to-output — no per-step re-materialization of a multi-GB
+    frozen tree (the QLoRA case this exists for)."""
+
+    def init(params_with_lora) -> LoraTrainState:
+        base, adapters = split_lora(params_with_lora)
+        return LoraTrainState(base=base, adapters=adapters,
+                              opt_state=optimizer.init_state(adapters),
+                              step=jnp.zeros((), jnp.int32))
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(state: LoraTrainState, tokens, lr):
+        def loss_of(adapters):
+            return gpt.loss_fn(_join(state.base, adapters), tokens, cfg)
+
+        loss, grads = jax.value_and_grad(loss_of)(state.adapters)
+        adapters, opt_state = optimizer.apply_gradients(
+            grads, state.adapters, state.opt_state, lr=lr,
+            step=state.step + 1)
+        return LoraTrainState(base=state.base, adapters=adapters,
+                              opt_state=opt_state,
+                              step=state.step + 1), loss
+
+    return init, step
+
+
+jax.tree_util.register_dataclass(
+    LoraTrainState, data_fields=["base", "adapters", "opt_state", "step"],
+    meta_fields=[])

@@ -141,13 +141,17 @@ def quantize_gpt_int4(params: dict, group_size: int = 64) -> dict:
 
 
 def w(p: dict, name: str, dt):
-    """Resolve a (possibly quantized) weight to compute dtype.
+    """Resolve a (possibly quantized, possibly LoRA-adapted) weight to
+    compute dtype.
 
     Identity-cost on float params; on int8/int4 params the convert+scale
     is a fusable elementwise producer that XLA folds into the consuming
     matmul's weight read.  Group-wise scales (int4) are recognized by
     their extra axis: scale [..., G, 1, out] against weight [..., in,
-    out]."""
+    out].  A low-rank adapter pair (text/lora.py: ``<name>_lora_a``
+    [..., in, r] x ``<name>_lora_b`` [..., r, out]) adds its delta after
+    dequant — so LoRA composes with a frozen float base (classic) or a
+    frozen int8/int4 base (QLoRA) through the same accessor."""
     arr = p[name]
     if arr.dtype in (jnp.int8, jnp.int4):
         s = p[name + "_s"]
@@ -155,9 +159,17 @@ def w(p: dict, name: str, dt):
             G = s.shape[-3]
             shp = arr.shape
             grouped = arr.reshape(*shp[:-2], G, shp[-2] // G, shp[-1])
-            return (grouped.astype(dt) * s.astype(dt)).reshape(shp)
-        return arr.astype(dt) * s.astype(dt)
-    return arr.astype(dt)
+            out = (grouped.astype(dt) * s.astype(dt)).reshape(shp)
+        else:
+            out = arr.astype(dt) * s.astype(dt)
+    else:
+        out = arr.astype(dt)
+    a = p.get(name + "_lora_a")
+    if a is not None:
+        b = p[name + "_lora_b"]
+        out = out + jnp.einsum("...dr,...rf->...df", a.astype(dt),
+                               b.astype(dt))
+    return out
 
 
 def embed(params: dict, token, dt):

@@ -1,0 +1,122 @@
+"""LoRA / QLoRA fine-tuning (text/lora.py).
+
+Adapters are pytree leaves next to the frozen weights; woq.w adds the
+low-rank delta after (de)quantization, so one mechanism serves float LoRA,
+QLoRA over an int8/int4 base, and adapted decode without merging.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.optimizer import AdamW
+from paddle_tpu.text import generate as G
+from paddle_tpu.text import gpt, lora, woq
+
+
+def _cfg(**over):
+    kw = dict(vocab_size=16, hidden_size=32, num_layers=2, num_heads=4,
+              max_seq_len=32)
+    kw.update(over)
+    return gpt.GPTConfig(**kw)
+
+
+def test_zero_init_is_identity():
+    cfg = _cfg()
+    base = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    adapted = lora.lora_init(base, cfg, rank=4, key=jax.random.PRNGKey(1))
+    toks = jnp.asarray(np.random.default_rng(0).integers(0, 16, (2, 8)),
+                       jnp.int32)
+    np.testing.assert_array_equal(
+        np.asarray(gpt.forward(base, toks, cfg)),
+        np.asarray(gpt.forward(adapted, toks, cfg)))
+
+
+def test_lora_finetunes_pretrained_base_to_new_rule(markov_gpt):
+    """The canonical LoRA setting: a PRETRAINED base (the Markov model,
+    rule next=(t*3+1)%13) fine-tuned to a DIFFERENT rule (next=(t*5+2)%13)
+    through adapters alone.  From a random base this would fail — the
+    needed capacity lives in the (untouched) tied embedding — which is
+    exactly why LoRA presumes pretraining."""
+    cfg, base = markov_gpt
+    params = lora.lora_init(base, cfg, rank=16, key=jax.random.PRNGKey(3),
+                            targets=("qkv_w", "proj_w", "fc_w", "out_w"))
+    init, step = lora.build_lora_train_step(cfg, AdamW(learning_rate=5e-3))
+    state = init(params)
+    rng = np.random.default_rng(0)
+
+    def stream(B, T):
+        t = rng.integers(0, 13, (B, 1))
+        rows = [t]
+        for _ in range(T):
+            t = (t * 5 + 2) % 13
+            rows.append(t)
+        return jnp.asarray(np.concatenate(rows, 1), jnp.int32)
+
+    first = None
+    for i in range(300):
+        state, loss = step(state, stream(8, 31), 5e-3)
+        if first is None:
+            first = float(loss)
+    assert float(loss) < 0.2, (first, float(loss))
+    # the base never moved
+    for k, v in state.base["blocks"].items():
+        np.testing.assert_array_equal(np.asarray(v),
+                                      np.asarray(base["blocks"][k]), k)
+    # adapted decode follows the NEW rule; the base still follows the old
+    adapted = lora.join_lora(state.base, state.adapters)
+    out = np.asarray(G.generate(adapted, cfg,
+                                jnp.asarray([[2]], jnp.int32),
+                                max_new_tokens=8, temperature=0.0))[0]
+    for a, b in zip(out[:-1], out[1:]):
+        assert b == (a * 5 + 2) % 13, out
+    out_base = np.asarray(G.generate(base, cfg,
+                                     jnp.asarray([[2]], jnp.int32),
+                                     max_new_tokens=4,
+                                     temperature=0.0))[0]
+    assert out_base[1] == (2 * 3 + 1) % 13
+
+
+def test_merge_matches_adapted_forward():
+    cfg = _cfg()
+    base = gpt.init_params(cfg, jax.random.PRNGKey(4))
+    params = lora.lora_init(base, cfg, rank=4, key=jax.random.PRNGKey(5))
+    # give the adapters nonzero content
+    params["blocks"]["qkv_w_lora_b"] = 0.02 * jax.random.normal(
+        jax.random.PRNGKey(6), params["blocks"]["qkv_w_lora_b"].shape)
+    toks = jnp.asarray(np.random.default_rng(1).integers(0, 16, (2, 6)),
+                       jnp.int32)
+    want = np.asarray(gpt.forward(params, toks, cfg))
+    merged = lora.merge_lora(params)
+    assert not any(k.endswith("_lora_a") for k in merged["blocks"])
+    got = np.asarray(gpt.forward(merged, toks, cfg))
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=5e-3)
+
+
+def test_qlora_int8_base_decodes():
+    """Adapters over a QUANTIZED base: zero-init generation equals the
+    quantized base's generation, and the train step runs."""
+    cfg = _cfg()
+    base = woq.quantize_gpt_int8(gpt.init_params(cfg, jax.random.PRNGKey(7)))
+    params = lora.lora_init(base, cfg, rank=4, key=jax.random.PRNGKey(8))
+    prompt = jnp.asarray([[3, 1]], jnp.int32)
+    np.testing.assert_array_equal(
+        np.asarray(G.generate(base, cfg, prompt, max_new_tokens=5)),
+        np.asarray(G.generate(params, cfg, prompt, max_new_tokens=5)))
+    init, step = lora.build_lora_train_step(cfg, AdamW(learning_rate=1e-3))
+    state = init(params)
+    toks = jnp.asarray(np.random.default_rng(2).integers(0, 16, (2, 9)),
+                       jnp.int32)
+    state, loss = step(state, toks, 1e-3)
+    assert np.isfinite(float(loss))
+    # int8 base weights are not in the trainable tree
+    assert not any(k in state.adapters for k in ("qkv_w", "proj_w"))
+
+
+def test_merge_on_quantized_base_raises():
+    cfg = _cfg()
+    base = woq.quantize_gpt_int8(gpt.init_params(cfg, jax.random.PRNGKey(9)))
+    params = lora.lora_init(base, cfg, rank=2)
+    with pytest.raises(NotImplementedError, match="quantized base"):
+        lora.merge_lora(params)
