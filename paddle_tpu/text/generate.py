@@ -126,21 +126,21 @@ def _cfg_key(cfg):
             moe_key)
 
 
-def _get_generate_fn(cfg, max_new_tokens, top_k):
+def _get_generate_fn(cfg, max_new_tokens, top_k, top_p=1.0):
     """jit per (config VALUE, gen params) — GPTConfig is closed over
     (dataclass isn't hashable for static_argnames)."""
-    cache_key = (_cfg_key(cfg), max_new_tokens, top_k)
+    cache_key = (_cfg_key(cfg), max_new_tokens, top_k, float(top_p))
     fn = _GEN_CACHE.get(cache_key)
     if fn is None:
         fn = jax.jit(functools.partial(
             _generate_impl, cfg=cfg, max_new_tokens=max_new_tokens,
-            top_k=top_k))
+            top_k=top_k, top_p=float(top_p)))
         _GEN_CACHE[cache_key] = fn
     return fn
 
 
 def _generate_impl(params, prompt, key, temperature, *, cfg,
-                   max_new_tokens, top_k):
+                   max_new_tokens, top_k, top_p):
     B, P = prompt.shape
     total = P + max_new_tokens
     cache = init_cache(cfg, B, total)
@@ -155,6 +155,17 @@ def _generate_impl(params, prompt, key, temperature, *, cfg,
         if top_k > 0:
             kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
             logits = jnp.where(logits < kth, -1e30, logits)
+        if top_p < 1.0:
+            # nucleus sampling: keep the smallest prefix of the
+            # probability-sorted vocab whose mass reaches top_p (the top
+            # token always survives)
+            srt = jnp.sort(logits, axis=-1)[:, ::-1]
+            probs = jax.nn.softmax(srt, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            keep_sorted = cum - probs < top_p   # mass BEFORE this token
+            kth_idx = jnp.sum(keep_sorted, axis=-1) - 1
+            cutoff = jnp.take_along_axis(srt, kth_idx[:, None], axis=-1)
+            logits = jnp.where(logits < cutoff, -1e30, logits)
         nxt = jax.lax.cond(
             jnp.asarray(temperature) > 0.0,
             lambda: jax.random.categorical(
@@ -173,9 +184,11 @@ def _generate_impl(params, prompt, key, temperature, *, cfg,
 
 
 def generate(params, cfg: gpt.GPTConfig, prompt, max_new_tokens=32,
-             temperature=0.0, top_k=0, key=None):
+             temperature=0.0, top_k=0, top_p=1.0, key=None):
     """prompt [B, P] int → [B, P + max_new_tokens] tokens (greedy when
-    temperature == 0)."""
+    temperature == 0).  ``top_k`` keeps the k highest logits; ``top_p``
+    (nucleus) keeps the smallest probability-mass prefix reaching p —
+    both compose (k filter first, then p over what survives)."""
     import numpy as np
 
     prompt = jnp.asarray(np.asarray(prompt), jnp.int32)
@@ -189,7 +202,9 @@ def generate(params, cfg: gpt.GPTConfig, prompt, max_new_tokens=32,
     if key is None:
         key = jax.random.PRNGKey(0)
     top_k = min(int(top_k), cfg.vocab_size)  # top-k over the whole vocab
-    fn = _get_generate_fn(cfg, int(max_new_tokens), top_k)
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    fn = _get_generate_fn(cfg, int(max_new_tokens), top_k, top_p)
     return fn(params, prompt, key, jnp.asarray(float(temperature)))
 
 

@@ -325,3 +325,32 @@ class TestMoEDecode:
         while srv.pending():
             srv.tick()
         assert srv.result(rid) == list(np.asarray(out)[0, 3:])
+
+
+def test_top_p_nucleus_sampling():
+    """top_p keeps the smallest probability-mass prefix: with a tight p,
+    every sampled token must come from the nucleus computed by hand."""
+    cfg = _cfg()
+    params = gpt.init_params(cfg, jax.random.PRNGKey(3))
+    prompt = jnp.asarray([[5, 3]], jnp.int32)
+    # hand-computed nucleus of the first sampling position
+    cache = G.init_cache(cfg, 1, 10)
+    _, cache = G.decode_step(params, cache, prompt[:, 0], 0, cfg)
+    logits, _ = G.decode_step(params, cache, prompt[:, 1], 1, cfg)
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(logits[0]), -1))
+    order = np.argsort(probs)[::-1]
+    cum = np.cumsum(probs[order])
+    nucleus = set(order[np.where(cum - probs[order] < 0.5)[0]])
+    for seed in range(6):
+        out = np.asarray(G.generate(params, cfg, prompt, max_new_tokens=1,
+                                    temperature=1.0, top_p=0.5,
+                                    key=jax.random.PRNGKey(seed)))
+        assert out[0, 2] in nucleus, (out[0, 2], sorted(nucleus))
+    # top_p=1.0 is a no-op (greedy path unchanged)
+    a = G.generate(params, cfg, prompt, max_new_tokens=3, temperature=0.0)
+    b = G.generate(params, cfg, prompt, max_new_tokens=3, temperature=0.0,
+                   top_p=1.0)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="top_p"):
+        G.generate(params, cfg, prompt, max_new_tokens=1, top_p=0.0)
