@@ -49,6 +49,12 @@ def decode_step_batched(params, cache, token, pos, cfg: gpt.GPTConfig):
     return logits, {"k": nk, "v": nv}
 
 
+def _hits_stop(st: dict) -> bool:
+    gen = st["generated"]
+    return any(len(gen) >= len(seq) and gen[-len(seq):] == seq
+               for seq in st.get("stop", []))
+
+
 _STEP_CACHE: dict = {}
 
 
@@ -113,7 +119,10 @@ class DecodeServer:
 
     # -- request lifecycle --------------------------------------------------
 
-    def submit(self, prompt, max_new_tokens: int = 32) -> int:
+    def submit(self, prompt, max_new_tokens: int = 32,
+               stop: list | None = None) -> int:
+        """``stop``: optional list of token SEQUENCES; generation ends
+        (sequence included) as soon as the generated tail matches one."""
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -125,10 +134,13 @@ class DecodeServer:
             raise ValueError(
                 f"prompt+max_new_tokens {total} exceeds serving window "
                 f"{min(self.max_len, self.cfg.max_seq_len)}")
+        stop = [[int(t) for t in seq] for seq in (stop or [])]
+        if any(not seq for seq in stop):
+            raise ValueError("empty stop sequence")
         rid = self._next_rid
         self._next_rid += 1
         self._queue.append({"rid": rid, "prompt": prompt,
-                            "max_new": max_new_tokens})
+                            "max_new": max_new_tokens, "stop": stop})
         self._admit()
         return rid
 
@@ -138,7 +150,7 @@ class DecodeServer:
             req = self._queue.pop(0)
             st = {
                 "rid": req["rid"], "prompt": req["prompt"],
-                "max_new": req["max_new"],
+                "max_new": req["max_new"], "stop": req.get("stop", []),
                 "generated": [],
                 "pos": 0,   # next position == index of the token to feed
             }
@@ -159,7 +171,8 @@ class DecodeServer:
                 st["generated"].append(t)
                 st["pos"] = n  # cache rows [0, n) are filled
                 if (st["max_new"] <= 1
-                        or (self.eos_id is not None and t == self.eos_id)):
+                        or (self.eos_id is not None and t == self.eos_id)
+                        or _hits_stop(st)):
                     self._results[st["rid"]] = st["generated"]
                     self._free.append(slot)
                     continue
@@ -199,7 +212,8 @@ class DecodeServer:
             t = int(nxt[slot])
             st["generated"].append(t)
             if (len(st["generated"]) >= st["max_new"]
-                    or (self.eos_id is not None and t == self.eos_id)):
+                    or (self.eos_id is not None and t == self.eos_id)
+                    or _hits_stop(st)):
                 done.append(slot)
         for slot in done:
             st = self._slots.pop(slot)

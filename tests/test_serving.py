@@ -284,3 +284,26 @@ def test_prefill_parity_gqa():
     np.testing.assert_allclose(np.asarray(cache_p["k"][:, 0, :4]),
                                np.asarray(cache_r["k"][:, 0, :4]),
                                rtol=2e-2, atol=5e-3)
+
+
+def test_stop_sequences_end_generation(markov_gpt):
+    """A multi-token stop sequence ends the request the moment the
+    generated tail matches it (sequence included in the result)."""
+    cfg, params = markov_gpt
+    # the rule from 2: 7, 9, 2, 7, 9, 2 ... -> stop at the [9, 2] tail
+    srv = serving.DecodeServer(params, cfg, max_batch=1, max_len=30)
+    rid = srv.submit([2], max_new_tokens=12, stop=[[9, 2]])
+    while srv.pending():
+        srv.tick()
+    got = srv.result(rid)
+    assert got[-2:] == [9, 2] and len(got) < 12, got
+
+    # a stop sequence that never occurs: runs to max_new
+    rid2 = srv.submit([2], max_new_tokens=6, stop=[[12, 12, 12]])
+    while srv.pending():
+        srv.tick()
+    assert len(srv.result(rid2)) == 6
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="empty stop"):
+        srv.submit([2], max_new_tokens=3, stop=[[]])
