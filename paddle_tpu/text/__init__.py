@@ -9,6 +9,7 @@ from . import moe  # noqa: F401
 from . import woq  # noqa: F401
 from . import serving  # noqa: F401
 from . import lora  # noqa: F401
+from . import evaluate  # noqa: F401
 from .gpt import GPTConfig, gpt_1p3b, gpt_13b  # noqa: F401
 from .gpt_hybrid import build_gpt_train_step  # noqa: F401
 from .datasets import (  # noqa: F401  (reference text/__init__.py __all__)

@@ -1,0 +1,65 @@
+"""Perplexity / language-model evaluation.
+
+The eval half of the text stack: token-level negative log-likelihood and
+perplexity over a corpus, batched and jitted, working unchanged on float,
+weight-only int8/int4 (text/woq.py), and LoRA-adapted parameter trees —
+every weight resolves through the same accessors the forward uses, which
+is what makes "evaluate the quantized model's quality loss" a one-liner:
+
+    ppl_f = perplexity(params, cfg, tokens)
+    ppl_q = perplexity(woq.quantize_gpt_int8(params), cfg, tokens)
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import generate, gpt
+
+__all__ = ["nll", "perplexity"]
+
+_EVAL_CACHE: dict = {}
+
+
+def _eval_fn(cfg: gpt.GPTConfig):
+    key = generate._cfg_key(cfg)
+    fn = _EVAL_CACHE.get(key)
+    if fn is None:
+        def run(params, tokens):
+            # tokens [B, T+1]: positions predict their successors
+            logits, _aux = gpt.forward_with_aux(params, tokens[:, :-1], cfg)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            tgt = tokens[:, 1:]
+            tok_nll = -jnp.take_along_axis(logp, tgt[..., None],
+                                           -1)[..., 0]
+            return tok_nll.sum(), tok_nll.size
+
+        fn = jax.jit(run)
+        _EVAL_CACHE[key] = fn
+    return fn
+
+
+def nll(params, cfg: gpt.GPTConfig, tokens) -> float:
+    """Mean per-token negative log-likelihood of [B, T+1] token batches
+    (a list/iterable of batches is accumulated)."""
+    import numpy as np
+
+    fn = _eval_fn(cfg)
+    batches = tokens if isinstance(tokens, (list, tuple)) else [tokens]
+    total, count = 0.0, 0
+    for b in batches:
+        b = jnp.asarray(np.asarray(b), jnp.int32)
+        if b.ndim != 2 or b.shape[1] < 2:
+            raise ValueError(f"eval batch must be [B, T+1] with T >= 1, "
+                             f"got {b.shape}")
+        s, n = fn(params, b)
+        total += float(s)
+        count += int(n)
+    return total / max(count, 1)
+
+
+def perplexity(params, cfg: gpt.GPTConfig, tokens) -> float:
+    """exp(mean NLL) — the standard LM quality number."""
+    import math
+
+    return math.exp(nll(params, cfg, tokens))

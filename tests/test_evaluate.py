@@ -1,0 +1,59 @@
+"""Perplexity evaluation (text/evaluate.py) — including the quantized-
+model quality check the quantization-aware forward exists for."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.text import evaluate, gpt, woq
+
+
+def _rule_batch(rng, B, T):
+    t = rng.integers(0, 13, (B, 1))
+    rows = [t]
+    for _ in range(T):
+        t = (t * 3 + 1) % 13
+        rows.append(t)
+    return np.concatenate(rows, 1)
+
+
+def test_trained_model_has_low_ppl_on_its_stream(markov_gpt):
+    cfg, params = markov_gpt
+    rng = np.random.default_rng(1)
+    on_rule = _rule_batch(rng, 8, 16)
+    random_toks = rng.integers(0, 13, (8, 17))
+    ppl_rule = evaluate.perplexity(params, cfg, on_rule)
+    ppl_rand = evaluate.perplexity(params, cfg, random_toks)
+    # near-deterministic stream -> ppl near 1; random stream near vocab
+    assert ppl_rule < 1.6, ppl_rule
+    assert ppl_rand > 5.0, ppl_rand
+
+
+def test_quantized_model_ppl_close_to_float(markov_gpt):
+    """THE quantization quality report: int8/int4 perplexity within a few
+    percent of float on the task stream."""
+    cfg, params = markov_gpt
+    rng = np.random.default_rng(2)
+    batches = [_rule_batch(rng, 8, 16) for _ in range(2)]
+    ppl_f = evaluate.perplexity(params, cfg, batches)
+    ppl_8 = evaluate.perplexity(woq.quantize_gpt_int8(params), cfg, batches)
+    ppl_4 = evaluate.perplexity(woq.quantize_gpt_int4(params, 32), cfg,
+                                batches)
+    assert abs(ppl_8 - ppl_f) / ppl_f < 0.05, (ppl_f, ppl_8)
+    assert abs(ppl_4 - ppl_f) / ppl_f < 0.25, (ppl_f, ppl_4)
+
+
+def test_nll_accumulates_over_batches(markov_gpt):
+    cfg, params = markov_gpt
+    rng = np.random.default_rng(3)
+    a, b = _rule_batch(rng, 4, 16), _rule_batch(rng, 4, 16)
+    joint = evaluate.nll(params, cfg, [a, b])
+    solo = (evaluate.nll(params, cfg, a) + evaluate.nll(params, cfg, b)) / 2
+    assert abs(joint - solo) < 1e-5
+
+
+def test_bad_batch_shapes_are_loud(markov_gpt):
+    cfg, params = markov_gpt
+    with pytest.raises(ValueError, match="T >= 1"):
+        evaluate.nll(params, cfg, np.zeros((4, 1), np.int32))
