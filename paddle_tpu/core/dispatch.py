@@ -31,6 +31,15 @@ def dispatch(fn: Callable, *args, op_name: str = "", **kwargs):
     Tensors with stop_gradient=False and floating dtype are differentiable
     inputs.  Static config goes in **kwargs (closed over, never traced as a
     diff input).  Returns Tensor or tuple of Tensors mirroring fn's output.
+
+    CONTRACT (for custom-op authors — dispatch is the extension point):
+    ``fn`` must be DETERMINISTIC and CLOSURE-PURE in grad mode.  The tape
+    is recompute-based: backward re-executes ``fn`` with the same saved
+    immutable inputs to build the VJP, so an fn that closes over mutable
+    state or draws fresh randomness inside (rather than binding a PRNG
+    key as an argument/closure constant, as all in-repo ops do) would
+    silently produce gradients for a DIFFERENT forward than the one that
+    ran.  Bind randomness and any varying config outside fn.
     """
     from .tensor import Tensor
 

@@ -152,6 +152,13 @@ def _generate_impl(params, prompt, key, temperature, *, cfg,
         tok = jax.lax.dynamic_slice(tokens, (0, pos), (B, 1))[:, 0]
         logits, cache = decode_step(params, cache, tok, pos, cfg)
         key, sub = jax.random.split(key)
+        # temperature scales BEFORE the filters (advisor r4: computing the
+        # nucleus on untempered logits keeps a different token set than
+        # the mainstream temperature-then-top-p order).  top-k is
+        # monotonic-invariant; top-p is not.  Greedy (temperature == 0)
+        # bypasses the scale via the argmax branch below.
+        logits = jnp.where(jnp.asarray(temperature) > 0.0,
+                           logits / jnp.maximum(temperature, 1e-6), logits)
         if top_k > 0:
             kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
             logits = jnp.where(logits < kth, -1e30, logits)
@@ -168,8 +175,7 @@ def _generate_impl(params, prompt, key, temperature, *, cfg,
             logits = jnp.where(logits < cutoff, -1e30, logits)
         nxt = jax.lax.cond(
             jnp.asarray(temperature) > 0.0,
-            lambda: jax.random.categorical(
-                sub, logits / jnp.maximum(temperature, 1e-6)),
+            lambda: jax.random.categorical(sub, logits),
             lambda: jnp.argmax(logits, axis=-1).astype(jnp.int32))
         nxt = nxt.astype(jnp.int32)
         # prompt positions keep their given token; past-prompt write samples
@@ -215,15 +221,20 @@ def generate(params, cfg: gpt.GPTConfig, prompt, max_new_tokens=32,
 
 def _decode_param_specs(params, cfg: gpt.GPTConfig, mp: str):
     """A PartitionSpec tree matching ``params`` — float OR weight-only
-    quantized (text/woq.py): quantized weights take their float twin's
-    Megatron spec (same shape), the small ``*_s`` scale tensors replicate
-    (PartitionSpec() is rank-agnostic 'all replicated')."""
+    quantized (text/woq.py) OR LoRA-adapted (text/lora.py): quantized
+    weights take their float twin's Megatron spec (same shape), while the
+    small ``*_s`` scale tensors and ``*_lora_a``/``*_lora_b`` low-rank
+    adapter pairs replicate (PartitionSpec() is rank-agnostic 'all
+    replicated'; the adapter delta is recomputed per rank — rank-r
+    matmuls are noise next to the sharded base weights, and GSPMD
+    reshards the delta to match the consumer)."""
     from jax.sharding import PartitionSpec as P
 
     base = gpt.param_shardings(cfg, mp=mp)
     blocks = {}
     for name, v in params["blocks"].items():
-        if name.endswith("_s"):
+        if (name.endswith("_s") or name.endswith("_lora_a")
+                or name.endswith("_lora_b")):
             blocks[name] = P()
         else:
             blocks[name] = base["blocks"][name]

@@ -307,3 +307,77 @@ def test_stop_sequences_end_generation(markov_gpt):
     import pytest as _pytest
     with _pytest.raises(ValueError, match="empty stop"):
         srv.submit([2], max_new_tokens=3, stop=[[]])
+
+
+# ---------------------------------------------------------------------------
+# device-resident block tick (round-5: one host fetch per `block` tokens)
+# ---------------------------------------------------------------------------
+
+
+def _serve(params, cfg, prompts, max_new, block=None, **kw):
+    srv = serving.DecodeServer(params, cfg, max_batch=3, max_len=40, **kw)
+    rids = [srv.submit(p, max_new_tokens=max_new) for p in prompts]
+    ticks = 0
+    while srv.pending():
+        srv.tick_block(block) if block else srv.tick()
+        ticks += 1
+        assert ticks < 300
+    return [srv.result(r) for r in rids]
+
+
+def test_tick_block_matches_single_ticks():
+    """Block sizes 1/4/8 over 4 requests contending for 3 slots (slot
+    reuse + overrun mid-block) must reproduce the per-token tick path
+    token-for-token."""
+    cfg = _cfg()
+    params = gpt.init_params(cfg, jax.random.PRNGKey(2))
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(0, cfg.vocab_size, n)) for n in (5, 3, 9, 1)]
+    ref = _serve(params, cfg, prompts, 11)
+    for block in (1, 4, 8):
+        assert _serve(params, cfg, prompts, 11, block=block) == ref, block
+
+
+def test_tick_block_prompt_feeding_falls_back():
+    """prefill=False servers still consume prompts token-by-token under
+    tick_block (logits-discarded positions can't batch); results match."""
+    cfg = _cfg()
+    params = gpt.init_params(cfg, jax.random.PRNGKey(3))
+    rng = np.random.default_rng(1)
+    prompts = [list(rng.integers(0, cfg.vocab_size, n)) for n in (6, 2)]
+    assert (_serve(params, cfg, prompts, 8, block=4, prefill=False)
+            == _serve(params, cfg, prompts, 8, prefill=False))
+
+
+def test_tick_block_feeds_generated_token(markov_gpt):
+    """Wrong-input detector on the block path: the trained markov model's
+    next token depends on the FED token, so any feedback error inside the
+    device-side scan would break the rule chain."""
+    cfg, params = markov_gpt
+    got = _serve(params, cfg, [[2], [5]], 9, block=4)
+    for first, out in zip((2, 5), got):
+        want, t = [], first
+        for _ in range(9):
+            t = (t * 3 + 1) % 13
+            want.append(t)
+        assert out == want
+
+
+def test_tick_block_eos_and_stop(markov_gpt):
+    """EOS and stop sequences end requests mid-block; surplus block tokens
+    are discarded."""
+    cfg, params = markov_gpt
+    # rule from 2: 7, 9, 2, 7, 9, 2 ... -> [9, 2] tail stops it
+    srv = serving.DecodeServer(params, cfg, max_batch=1, max_len=30)
+    rid = srv.submit([2], max_new_tokens=12, stop=[[9, 2]])
+    while srv.pending():
+        srv.tick_block(5)
+    got = srv.result(rid)
+    assert got[-2:] == [9, 2] and len(got) < 12, got
+    srv2 = serving.DecodeServer(params, cfg, max_batch=1, max_len=30,
+                                eos_id=9)
+    rid2 = srv2.submit([2], max_new_tokens=12)
+    while srv2.pending():
+        srv2.tick_block(5)
+    g2 = srv2.result(rid2)
+    assert g2[-1] == 9 and len(g2) < 12, g2
