@@ -295,12 +295,15 @@ def _ffn_dense(x, p, cfg: GPTConfig):
     return x + (h @ woq.w(p, "out_w", dt) + p["out_b"].astype(dt))
 
 
-def _ffn_tail(x, p, cfg: GPTConfig):
+def _ffn_tail(x, p, cfg: GPTConfig, valid=None):
     """Inference FFN half: dense MLP or MoE (aux loss discarded — it only
     matters for the training objective).  MoE capacity is computed from
     the CALL's token count (GShard semantics): at one token nothing can
     drop; a batched call's rows contend for capacity like training
-    tokens."""
+    tokens.  ``valid`` (prefill path): pad mask over x's token dims —
+    pads route nowhere, and capacity becomes the dropless bound so a
+    padded prompt chunk routes exactly like its unpadded prefix
+    (text/moe._route)."""
     if cfg.moe is None:
         return _ffn_dense(x, p, cfg)
     from .moe import moe_ffn
@@ -308,7 +311,11 @@ def _ffn_tail(x, p, cfg: GPTConfig):
     dt = cfg.dtype
     h = _layer_norm(x.astype(jnp.float32), p["ln2_g"],
                     p["ln2_b"]).astype(dt)
-    y, _aux = moe_ffn(p["moe"], h, cfg.moe, key=None)
+    n_tokens = 1
+    for d in x.shape[:-1]:
+        n_tokens *= d
+    y, _aux = moe_ffn(p["moe"], h, cfg.moe, key=None, valid=valid,
+                      capacity=(n_tokens if valid is not None else None))
     return x + y
 
 

@@ -67,8 +67,15 @@ def _top_k_gating(logits, k: int):
     return w, idx, probs
 
 
-def _route(params, xf, cfg: MoEConfig, key, E: int, C: int, dtype):
-    """Shared router: returns (disp [N,E,C], comb [N,E,C], aux scalar)."""
+def _route(params, xf, cfg: MoEConfig, key, E: int, C: int, dtype,
+           valid=None):
+    """Shared router: returns (disp [N,E,C], comb [N,E,C], aux scalar).
+
+    ``valid`` [N] bool (round-5, serving chunked prefill): tokens with
+    valid=False — bucket PADDING — claim NO capacity slots (their onehot
+    is zeroed before the cumsum position assignment), carry zero gates,
+    and are excluded from the load-balancing statistics; a padded prompt
+    chunk therefore routes exactly like its unpadded prefix."""
     N = xf.shape[0]
     logits = xf.astype(jnp.float32) @ params["router_w"]
     if cfg.router_noise > 0.0 and key is not None:
@@ -76,16 +83,31 @@ def _route(params, xf, cfg: MoEConfig, key, E: int, C: int, dtype):
             key, logits.shape)
     gate_w, gate_idx, probs = _top_k_gating(logits, cfg.top_k)
 
-    # load-balancing aux loss: E * sum_e f_e * p_e  (GShard/Switch)
-    me = jnp.mean(probs, axis=0)                                  # [E]
-    fe = jnp.sum(jax.nn.one_hot(gate_idx[:, 0], E), axis=0) / N   # [E]
+    v = None if valid is None else valid.reshape(N).astype(jnp.float32)
+    if v is not None:
+        gate_w = gate_w * v[:, None]
+
+    # load-balancing aux loss: E * sum_e f_e * p_e  (GShard/Switch),
+    # over the valid tokens only
+    if v is None:
+        me = jnp.mean(probs, axis=0)                                 # [E]
+        fe = jnp.sum(jax.nn.one_hot(gate_idx[:, 0], E), axis=0) / N  # [E]
+    else:
+        denom = jnp.maximum(jnp.sum(v), 1.0)
+        me = jnp.sum(probs * v[:, None], axis=0) / denom
+        fe = jnp.sum(jax.nn.one_hot(gate_idx[:, 0], E) * v[:, None],
+                     axis=0) / denom
     aux = E * jnp.sum(fe * me) * cfg.aux_loss_weight
 
     onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)         # [N,k,E]
+    if v is not None:
+        onehot = onehot * v.astype(jnp.int32)[:, None, None]
     flat = onehot.reshape(N * cfg.top_k, E)
     pos = jnp.cumsum(flat, axis=0) * flat - 1                     # [N*k, E]
     pos = jnp.max(pos, axis=-1).reshape(N, cfg.top_k)             # [N,k]
-    keep = pos < C
+    # pos == -1 (all-zero row: a masked pad token) claimed nothing and
+    # must not be clipped into slot 0 of someone else's expert buffer
+    keep = (pos >= 0) & (pos < C)
     gate_w = gate_w * keep
 
     disp = jnp.zeros((N, E, C), dtype)
@@ -148,21 +170,30 @@ def moe_ffn_manual(params: dict, x, cfg: MoEConfig, ep_axis: str | None,
     return y.reshape(orig_shape), aux
 
 
-def moe_ffn(params: dict, x, cfg: MoEConfig, key=None, activation=jax.nn.gelu):
+def moe_ffn(params: dict, x, cfg: MoEConfig, key=None, activation=jax.nn.gelu,
+            valid=None, capacity: int | None = None):
     """x [..., D] → (y [..., D], aux_loss scalar).
 
     Capacity per expert C = ceil(N * top_k / E * capacity_factor); tokens
     over capacity are dropped (residual connection keeps them identity —
     standard GShard behavior, keeps shapes static for XLA).
-    """
+
+    ``valid`` (round-5): boolean mask over the token dims of x — pad
+    tokens route nowhere and claim no capacity (see _route).
+    ``capacity`` overrides C; serving prefill passes the DROPLESS bound
+    C = N (an expert can receive at most one slot per token), trading
+    transient [N, E, N] dispatch memory for the guarantee that a chunked
+    prompt routes identically to feeding it token-by-token."""
     orig_shape = x.shape
     D = orig_shape[-1]
     xf = x.reshape(-1, D)
     N = xf.shape[0]
     E = cfg.num_experts
-    C = max(1, math.ceil(N * cfg.top_k / E * cfg.capacity_factor))
+    C = (int(capacity) if capacity is not None
+         else max(1, math.ceil(N * cfg.top_k / E * cfg.capacity_factor)))
 
-    disp, comb, aux = _route(params, xf, cfg, key, E, C, x.dtype)
+    disp, comb, aux = _route(params, xf, cfg, key, E, C, x.dtype,
+                             valid=valid)
 
     # route → expert ffn → route back (XLA lowers these to all_to_all when
     # the E dim is sharded over 'ep'); weights resolve through woq.w —

@@ -78,7 +78,10 @@ def _hits_stop(st: dict) -> bool:
                for seq in st.get("stop", []))
 
 
-_STEP_CACHE: dict = {}
+import os as _os
+
+_STEP_CACHE = generate._LRU(
+    int(_os.environ.get("PADDLE_TPU_STEP_CACHE_SIZE", "64")))
 
 
 def _get_prefill_fn(cfg: gpt.GPTConfig):
@@ -138,16 +141,17 @@ class DecodeServer:
         # chunked prefill: a whole prompt becomes ONE admission-time step
         # (generate.prefill_slot) instead of len(prompt) ticks; prompts pad
         # to power-of-two buckets so XLA compiles one prefill per bucket.
-        # MoE models feed token-by-token instead: bucket PADDING would be
-        # routed too, consuming expert capacity and potentially dropping
-        # real prompt tokens (GShard capacity is per-call N)
-        self._prefill = (_get_prefill_fn(cfg)
-                         if prefill and cfg.moe is None else None)
+        # MoE models prefill too (round-5): the pad mask reaches the
+        # router, padding claims no expert capacity, and the chunk uses
+        # the dropless capacity bound — admission routes exactly like
+        # token-by-token feeding
+        self._prefill = _get_prefill_fn(cfg) if prefill else None
         # per-slot host state
         self._free = list(range(max_batch))
         self._slots: dict[int, dict] = {}        # slot -> request state
         self._queue: list[dict] = []             # waiting requests
         self._results: dict[int, list] = {}
+        self._dropped: set[int] = set()          # rids abandoned by close()
         self._next_rid = 0
 
     # -- request lifecycle --------------------------------------------------
@@ -214,25 +218,82 @@ class DecodeServer:
     def pending(self) -> bool:
         return bool(self._slots or self._queue)
 
+    def close(self):
+        """Release this server's compiled executables and KV cache.
+
+        UNFINISHED requests (queued or mid-generation) are ABANDONED:
+        their rids are remembered and ``result()`` raises a descriptive
+        error for them — call only when the server is drained or the
+        pending work is disposable.  The jit caches key by config VALUE,
+        so entries may be shared with another live server of the same
+        config — that server transparently recompiles on its next tick
+        (correctness is unaffected; the cache exists to avoid recompiles,
+        not to carry state).  The LRU bound on _STEP_CACHE already caps
+        growth; close() is for eagerly dropping a cycled-out model's
+        executables (and their implicit param refs)."""
+        ck = generate._cfg_key(self.cfg)
+        for k in _STEP_CACHE.keys():
+            if k == ck or (isinstance(k, tuple) and ck in k):
+                _STEP_CACHE.pop(k)
+        self.cache = None
+        self._step = None
+        self._prefill = None
+        for st in self._slots.values():
+            self._dropped.add(st["rid"])
+        for req in self._queue:
+            self._dropped.add(req["rid"])
+        self._slots.clear()
+        self._queue.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
     def result(self, rid: int):
         """Generated tokens (no prompt) once the request finished."""
+        if rid in self._dropped:
+            raise RuntimeError(
+                f"request {rid} was abandoned unfinished when the server "
+                f"was closed")
         return self._results[rid]
 
     # -- one tick: a single batched device step -----------------------------
+
+    def _feed_arrays(self):
+        """The batched (tok, pos) feed for the current slots: the token
+        fed at position i is sequence[i] — prompt while i is inside it,
+        the generated tail after."""
+        tok = np.zeros((self.max_batch,), np.int32)
+        pos = np.zeros((self.max_batch,), np.int32)
+        for slot, st in self._slots.items():
+            i = st["pos"]
+            np_ = len(st["prompt"])
+            tok[slot] = (st["prompt"][i] if i < np_
+                         else st["generated"][i - np_])
+            pos[slot] = i
+        return tok, pos
+
+    def _finished(self, st, t: int) -> bool:
+        return (len(st["generated"]) >= st["max_new"]
+                or (self.eos_id is not None and t == self.eos_id)
+                or _hits_stop(st))
+
+    def _retire(self, done):
+        for slot in done:
+            st = self._slots.pop(slot)
+            self._results[st["rid"]] = st["generated"]
+            self._free.append(slot)
+        self._admit()
 
     def tick(self):
         if not self._slots:
             self._admit()
             if not self._slots:
                 return
-        tok = np.zeros((self.max_batch,), np.int32)
-        pos = np.zeros((self.max_batch,), np.int32)
-        for slot, st in self._slots.items():
-            i = st["pos"]  # the token fed at position i is sequence[i]
-            np_ = len(st["prompt"])
-            tok[slot] = (st["prompt"][i] if i < np_
-                         else st["generated"][i - np_])
-            pos[slot] = i
+        tok, pos = self._feed_arrays()
         logits, self.cache = self._step(self.params, self.cache,
                                         jnp.asarray(tok), jnp.asarray(pos))
         nxt = np.asarray(jnp.argmax(logits, axis=-1))
@@ -244,15 +305,9 @@ class DecodeServer:
                 continue                # still feeding prompt; logits unused
             t = int(nxt[slot])
             st["generated"].append(t)
-            if (len(st["generated"]) >= st["max_new"]
-                    or (self.eos_id is not None and t == self.eos_id)
-                    or _hits_stop(st)):
+            if self._finished(st, t):
                 done.append(slot)
-        for slot in done:
-            st = self._slots.pop(slot)
-            self._results[st["rid"]] = st["generated"]
-            self._free.append(slot)
-        self._admit()
+        self._retire(done)
 
     def tick_block(self, block: int = 8):
         """``block`` greedy decode steps with ONE host round trip.
@@ -263,6 +318,9 @@ class DecodeServer:
         to ``block`` single ticks — per-token host feedback is the whole
         point of that path.  Slots finishing mid-block overrun on device;
         the host discards their surplus tokens here."""
+        block = int(block)
+        if block < 1:
+            raise ValueError(f"block must be >= 1, got {block}")
         if not self._slots:
             self._admit()
             if not self._slots:
@@ -277,15 +335,8 @@ class DecodeServer:
                 if not self._slots:
                     break
             return
-        tok = np.zeros((self.max_batch,), np.int32)
-        pos = np.zeros((self.max_batch,), np.int32)
-        for slot, st in self._slots.items():
-            i = st["pos"]
-            np_ = len(st["prompt"])
-            tok[slot] = (st["prompt"][i] if i < np_
-                         else st["generated"][i - np_])
-            pos[slot] = i
-        fn = _get_block_fn(self.cfg, int(block))
+        tok, pos = self._feed_arrays()
+        fn = _get_block_fn(self.cfg, block)
         toks, self.cache, _, _ = fn(self.params, self.cache,
                                     jnp.asarray(tok), jnp.asarray(pos))
         toks = np.asarray(toks)  # the block's single device->host fetch
@@ -295,13 +346,7 @@ class DecodeServer:
                 t = int(toks[slot, j])
                 st["generated"].append(t)
                 st["pos"] += 1
-                if (len(st["generated"]) >= st["max_new"]
-                        or (self.eos_id is not None and t == self.eos_id)
-                        or _hits_stop(st)):
+                if self._finished(st, t):
                     done.append(slot)
                     break
-        for slot in done:
-            st = self._slots.pop(slot)
-            self._results[st["rid"]] = st["generated"]
-            self._free.append(slot)
-        self._admit()
+        self._retire(done)

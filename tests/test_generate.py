@@ -301,9 +301,9 @@ class TestMoEDecode:
                          max_new_tokens=4, temperature=0.0)
         assert out.shape == (1, 6)
         srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=16)
-        # MoE must NOT take the prefill path: padded bucket tokens would
-        # be routed and consume expert capacity (dropping real tokens)
-        assert srv._prefill is None
+        # round-5: MoE takes the prefill path too — the pad mask keeps
+        # bucket padding out of expert capacity (moe._route valid=)
+        assert srv._prefill is not None
         rid = srv.submit([3, 1], max_new_tokens=4)
         while srv.pending():
             srv.tick()
@@ -311,13 +311,14 @@ class TestMoEDecode:
         assert srv.result(rid) == list(np.asarray(out)[0, 2:])
 
     def test_moe_serving_with_padding_length_prompt(self):
-        """A prompt whose length is NOT a power of two (would pad under
-        prefill): token-by-token feeding keeps MoE routing exact."""
+        """A prompt whose length is NOT a power of two pads to a bucket
+        under prefill: the router's valid mask keeps the pad tokens out
+        of expert capacity, so routing stays exact (round-5)."""
         from paddle_tpu.text import serving
 
         cfg = self._cfg()
         params = gpt.init_params(cfg, jax.random.PRNGKey(2))
-        prompt = [5, 2, 9]  # would pad to bucket 4
+        prompt = [5, 2, 9]  # pads to bucket 4
         out = G.generate(params, cfg, jnp.asarray([prompt], jnp.int32),
                          max_new_tokens=3, temperature=0.0)
         srv = serving.DecodeServer(params, cfg, max_batch=1, max_len=16)

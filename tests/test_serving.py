@@ -381,3 +381,151 @@ def test_tick_block_eos_and_stop(markov_gpt):
         srv2.tick_block(5)
     g2 = srv2.result(rid2)
     assert g2[-1] == 9 and len(g2) < 12, g2
+
+
+# ---------------------------------------------------------------------------
+# MoE chunked prefill (round-5): padding claims no expert capacity
+# ---------------------------------------------------------------------------
+
+
+def _moe_cfg():
+    from paddle_tpu.text.moe import MoEConfig
+
+    return _cfg(moe=MoEConfig(num_experts=4, top_k=2, capacity_factor=1.25,
+                              router_noise=0.0))
+
+
+def test_route_padding_claims_zero_capacity():
+    """Dropped-token counters, directly on the router: with a valid mask,
+    pad rows dispatch NOWHERE (zero capacity slots consumed) and every
+    valid token keeps all top_k assignments under the dropless bound —
+    and the valid prefix routes exactly as the unpadded prompt would."""
+    import jax.numpy as jnp
+    from paddle_tpu.text import moe
+
+    cfg = _moe_cfg().moe
+    rng = np.random.default_rng(0)
+    n, pad = 6, 10          # 6 real tokens in a 16-bucket
+    xf = jnp.asarray(rng.standard_normal((n + pad, 32)), jnp.float32)
+    params = moe.init_moe_params(jax.random.PRNGKey(0), 32, 64, cfg)
+    valid = jnp.arange(n + pad) < n
+    C = n + pad             # dropless
+    disp, comb, aux = moe._route(params, xf, cfg, None, cfg.num_experts,
+                                 C, jnp.float32, valid=valid)
+    disp = np.asarray(disp)
+    assert disp[n:].sum() == 0            # pads consumed zero capacity
+    assert (disp[:n].sum(axis=(1, 2)) == cfg.top_k).all()  # nothing dropped
+    # prefix parity: same tokens without padding route to the same slots
+    d2, c2, _ = moe._route(params, xf[:n], cfg, None, cfg.num_experts,
+                           C, jnp.float32)
+    np.testing.assert_array_equal(disp[:n, :, :], np.asarray(d2)[:, :, :])
+    np.testing.assert_allclose(np.asarray(comb)[:n], np.asarray(c2),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_moe_prefill_logits_match_sequential_feeding():
+    """prefill_slot on a padded bucket == feeding the prompt stepwise
+    through decode_step, for an MoE model (round-4 gap: MoE admission was
+    O(prompt_len) device steps because padding would eat capacity)."""
+    cfg = _moe_cfg()
+    params = gpt.init_params(cfg, jax.random.PRNGKey(4))
+    prompt = [5, 3, 9, 1]
+    cache_r = G.init_cache(cfg, 1, 16)
+    for pos, tok in enumerate(prompt):
+        want, cache_r = G.decode_step(params, cache_r,
+                                      jnp.asarray([tok], jnp.int32),
+                                      pos, cfg)
+    cache_p = G.init_cache(cfg, 1, 16)
+    padded = np.zeros((1, 8), np.int32)
+    padded[0, :4] = prompt
+    got, cache_p = G.prefill_slot(params, cache_p, jnp.asarray(padded),
+                                  jnp.asarray(4), jnp.asarray(0), cfg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want)[0],
+                               rtol=2e-2, atol=5e-3)
+    np.testing.assert_allclose(np.asarray(cache_p["k"][:, 0, :4]),
+                               np.asarray(cache_r["k"][:, 0, :4]),
+                               rtol=2e-2, atol=5e-3)
+
+
+def test_moe_server_prefill_matches_stepwise_serving():
+    """End-to-end: an MoE DecodeServer with chunked-prefill admission
+    produces the same tokens as the token-by-token path and as solo
+    decode (single slot: no batch capacity contention in the ticks)."""
+    cfg = _moe_cfg()
+    params = gpt.init_params(cfg, jax.random.PRNGKey(5))
+    rng = np.random.default_rng(2)
+    prompt = list(rng.integers(0, cfg.vocab_size, 5))
+    want = _greedy_reference(params, cfg, prompt, 7)
+
+    for prefill in (True, False):
+        srv = serving.DecodeServer(params, cfg, max_batch=1, max_len=32,
+                                   prefill=prefill)
+        rid = srv.submit(prompt, max_new_tokens=7)
+        ticks = 0
+        while srv.pending():
+            srv.tick()
+            ticks += 1
+            assert ticks < 100
+        assert srv.result(rid) == want, prefill
+    # prefill admission really is O(1) ticks: after submit, only the
+    # 6 generate ticks remain (first token came from admission)
+    srv = serving.DecodeServer(params, cfg, max_batch=1, max_len=32)
+    rid = srv.submit(prompt, max_new_tokens=7)
+    ticks = 0
+    while srv.pending():
+        srv.tick()
+        ticks += 1
+    assert ticks == 6, ticks
+
+
+# ---------------------------------------------------------------------------
+# executable-cache hygiene (round-5): bounded growth + explicit release
+# ---------------------------------------------------------------------------
+
+
+def test_step_cache_bounded_and_close_releases():
+    """Cycling many model configs through servers must not grow the jit
+    cache beyond its LRU bound, and close() eagerly drops a config's
+    executables."""
+    before = len(serving._STEP_CACHE)
+    bound = serving._STEP_CACHE.maxsize
+    cfgs = [_cfg(hidden_size=32 + 16 * i) for i in range(4)]
+    for cfg in cfgs:
+        params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+        with serving.DecodeServer(params, cfg, max_batch=1,
+                                  max_len=16) as srv:
+            rid = srv.submit([1, 2], max_new_tokens=2)
+            while srv.pending():
+                srv.tick()
+            assert len(srv.result(rid)) == 2
+        # close() dropped this config's prefill/step entries
+        ck = G._cfg_key(cfg)
+        assert not any(k == ck or (isinstance(k, tuple) and ck in k)
+                       for k in serving._STEP_CACHE.keys())
+    assert len(serving._STEP_CACHE) <= max(before, bound)
+    assert len(serving._STEP_CACHE) <= bound
+
+
+def test_gen_cache_lru_evicts():
+    lru = G._LRU(3)
+    for i in range(5):
+        lru[("k", i)] = i
+    assert len(lru) == 3
+    assert lru.get(("k", 0)) is None and lru.get(("k", 4)) == 4
+    # touching an entry protects it from the next eviction
+    lru.get(("k", 2))
+    lru[("k", 9)] = 9
+    assert lru.get(("k", 2)) == 2 and lru.get(("k", 3)) is None
+
+
+def test_tick_block_zero_rejected_and_close_abandons():
+    cfg = _cfg()
+    params = gpt.init_params(cfg, jax.random.PRNGKey(7))
+    srv = serving.DecodeServer(params, cfg, max_batch=1, max_len=16)
+    rid = srv.submit([1, 2], max_new_tokens=4)
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="block"):
+        srv.tick_block(0)
+    srv.close()     # rid still mid-flight -> abandoned, not a bare KeyError
+    with _pytest.raises(RuntimeError, match="abandoned"):
+        srv.result(rid)

@@ -109,3 +109,121 @@ def test_speculative_with_small_different_draft_cfg(markov_gpt):
     got = G.speculative_generate(params, cfg, draft, dcfg, prompt,
                                  max_new_tokens=8, k=3)
     assert got == want
+
+
+# ---------------------------------------------------------------------------
+# rejection-sampling speculative decoding (round-5): the output
+# DISTRIBUTION must equal target-only sampling
+# ---------------------------------------------------------------------------
+
+
+def _law_after(params, cfg, prompt, temperature, top_k, top_p):
+    """The target's exact filtered next-token law after ``prompt``."""
+    cache = G.init_cache(cfg, 1, cfg.max_seq_len)
+    for pos, tok in enumerate(prompt):
+        l, cache = G.decode_step(params, cache,
+                                 jnp.asarray([tok], jnp.int32), pos, cfg)
+    return G._filtered_probs(np.asarray(l)[0], temperature, top_k, top_p)
+
+
+def _second_token_law(params, cfg, prompt, temperature, top_k, top_p):
+    """Exact marginal of generated token #2: sum over token #1's law of
+    the conditional law — enumerable at toy vocab size."""
+    p0 = _law_after(params, cfg, prompt, temperature, top_k, top_p)
+    law = np.zeros_like(p0)
+    for t1 in np.nonzero(p0 > 0)[0]:
+        law += p0[t1] * _law_after(params, cfg, prompt + [int(t1)],
+                                   temperature, top_k, top_p)
+    return law
+
+
+def _chi2(counts, law, n):
+    keep = law * n >= 5          # standard chi-square validity threshold
+    o = np.concatenate([counts[keep], [counts[~keep].sum()]])
+    e = np.concatenate([law[keep] * n, [law[~keep].sum() * n]])
+    e = np.maximum(e, 1e-12)
+    return float(((o - e) ** 2 / e).sum()), int(keep.sum())
+
+
+def _spec_second_tokens(tparams, dparams, cfg, dcfg, prompt, n, **kw):
+    toks = []
+    for i in range(n):
+        out = G.speculative_generate(tparams, cfg, dparams, dcfg, prompt,
+                                     max_new_tokens=4, k=3,
+                                     key=jax.random.PRNGKey(1000 + i), **kw)
+        toks.append(out[1])
+    return np.bincount(toks, minlength=cfg.vocab_size).astype(float)
+
+
+def test_filtered_probs_matches_device_sampler():
+    """The host filter mirror must agree with generate()'s on-device
+    sampling law — otherwise the rejection math targets the wrong p."""
+    cfg = _cfg(vocab_size=12, max_seq_len=16)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(3))
+    prompt = [4, 7]
+    n = 400
+    for temperature, top_k, top_p in ((1.3, 0, 1.0), (0.9, 0, 0.7),
+                                      (1.0, 4, 1.0)):
+        law = _law_after(params, cfg, prompt, temperature, top_k, top_p)
+        toks = [int(np.asarray(G.generate(
+            params, cfg, jnp.asarray([prompt], jnp.int32),
+            max_new_tokens=1, temperature=temperature, top_k=top_k,
+            top_p=top_p, key=jax.random.PRNGKey(i)))[0, -1])
+            for i in range(n)]
+        counts = np.bincount(toks, minlength=cfg.vocab_size).astype(float)
+        stat, df = _chi2(counts, law, n)
+        assert stat < 3 * max(df, 1) + 10, (temperature, top_k, top_p, stat)
+        assert counts[law == 0].sum() == 0  # filter support respected
+
+
+def test_speculative_sampling_matches_target_law():
+    """Chi-square capstone: the SECOND generated token (the first one the
+    accept/resample rule produces) follows the target's exact marginal —
+    with a same-architecture draft from a different init (proposals
+    disagree often, so rejections + residual resampling really fire)."""
+    cfg = _cfg(vocab_size=12, max_seq_len=16)
+    tparams = gpt.init_params(cfg, jax.random.PRNGKey(3))
+    dparams = gpt.init_params(cfg, jax.random.PRNGKey(9))
+    prompt = [4, 7]
+    n = 300
+    law = _second_token_law(params=tparams, cfg=cfg, prompt=prompt,
+                            temperature=1.3, top_k=0, top_p=1.0)
+    counts = _spec_second_tokens(tparams, dparams, cfg, cfg, prompt, n,
+                                 temperature=1.3)
+    stat, df = _chi2(counts, law, n)
+    assert stat < 3 * max(df, 1) + 10, stat
+
+
+def test_speculative_sampling_composes_with_top_p_top_k():
+    """The round-4 gap: speculative + nucleus/top-k now compose; support
+    respects the filters and the law still matches."""
+    cfg = _cfg(vocab_size=12, max_seq_len=16)
+    tparams = gpt.init_params(cfg, jax.random.PRNGKey(3))
+    dparams = gpt.init_params(cfg, jax.random.PRNGKey(9))
+    prompt = [4, 7]
+    n = 300
+    law = _second_token_law(tparams, cfg, prompt, 0.9, 0, 0.7)
+    counts = _spec_second_tokens(tparams, dparams, cfg, cfg, prompt, n,
+                                 temperature=0.9, top_p=0.7)
+    stat, df = _chi2(counts, law, n)
+    assert stat < 3 * max(df, 1) + 10, stat
+    assert counts[law == 0].sum() == 0
+    law_k = _second_token_law(tparams, cfg, prompt, 1.0, 3, 1.0)
+    counts_k = _spec_second_tokens(tparams, dparams, cfg, cfg, prompt, n,
+                                   temperature=1.0, top_k=3)
+    stat_k, df_k = _chi2(counts_k, law_k, n)
+    assert stat_k < 3 * max(df_k, 1) + 10, stat_k
+    assert counts_k[law_k == 0].sum() == 0
+
+
+def test_speculative_sampling_deterministic_per_key():
+    cfg = _cfg(vocab_size=12, max_seq_len=32)
+    tparams = gpt.init_params(cfg, jax.random.PRNGKey(3))
+    dparams = gpt.init_params(cfg, jax.random.PRNGKey(9))
+    a = G.speculative_generate(tparams, cfg, dparams, cfg, [4, 7],
+                               max_new_tokens=10, k=4, temperature=1.1,
+                               key=jax.random.PRNGKey(5))
+    b = G.speculative_generate(tparams, cfg, dparams, cfg, [4, 7],
+                               max_new_tokens=10, k=4, temperature=1.1,
+                               key=jax.random.PRNGKey(5))
+    assert a == b and len(a) == 10
