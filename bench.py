@@ -1199,15 +1199,16 @@ def _headline_from_watchdog(wd, source):
                 source=source)
 
 
-def _watchdog_tpu_result():
+def _watchdog_tpu_result(path=None):
     """A TPU headline captured by the watchdog during a healthy window, or
     None.  WATCHDOG_RESULTS.json is written incrementally by probe_tpu.py
-    --watch; only a ladder line measured on-device (no _cpu_fallback suffix,
-    nonzero vs_baseline) within the last 24 h counts — an older file is from
-    a previous round's code and must not masquerade as this revision's
-    number."""
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "WATCHDOG_RESULTS.json")
+    --watch; only a ladder or fast_headline line measured on-device (no
+    _cpu_fallback suffix, nonzero vs_baseline, step ok) within the last
+    24 h counts — an older file is from a previous round's code and must
+    not masquerade as this revision's number."""
+    if path is None:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "WATCHDOG_RESULTS.json")
     try:
         with open(path) as f:
             data = json.load(f)

@@ -25,10 +25,16 @@ The transform (per ``if``/``while`` statement):
   flag+value threading with the block remainder guarded by
   ``if not flag``, break/continue become per-loop flags conjoined into the
   loop condition.  Concrete conditions keep exact Python semantics; traced
-  conditions become lax control flow.  Remaining unconvertible shapes
-  (escapes in ``try``, break in a non-range ``for``, ``return <value>``
-  inside a traced loop) still raise :class:`Dy2StaticError` with the
-  source line.
+  conditions become lax control flow.  ``return <value>`` inside a traced
+  loop works (round-5): the pre-loop carry is zero-initialised from a
+  one-body shape probe and every read stays guarded by ``__pt_rf``
+  (reference return_transformer.py's capability, via the same flag
+  mechanism).  PERMANENT DESCOPES — these raise :class:`Dy2StaticError`
+  with the source line, by design: escapes inside ``try`` (lax control
+  flow cannot model Python exception unwinding) and ``break`` in a
+  non-range ``for`` over traced data (the iterator is opaque to XLA; a
+  real Python break executes, so only tensor-condition breaks there are
+  rejected).
 
 Conversion recurses through callees (the reference's ``convert_call``,
 program_translator.py): every call site in converted code is rewritten to
@@ -228,21 +234,46 @@ def convert_while(cond_fn, body_fn, vals: tuple, _loc_info=None, names=None):
             vals = body_fn(vals)
         return vals
 
+    undef_rv = [i for i, v in enumerate(vals)
+                if isinstance(_unwrap1(v), _UndefinedVar)
+                and names and i < len(names)
+                and names[i].startswith("__pt_rv")]
+    # NON-rv undefined loop variables raise BEFORE the shape probe runs:
+    # probing a body that reads an unbound user variable would die with an
+    # opaque _UndefinedVar TypeError instead of this located diagnostic
     for i, v in enumerate(vals):
-        if isinstance(_unwrap1(v), _UndefinedVar):
+        if i not in undef_rv and isinstance(_unwrap1(v), _UndefinedVar):
             nm = names[i] if names and i < len(names) else None
-            if nm is not None and nm.startswith("__pt_rv"):
-                raise Dy2StaticError(
-                    f"at {_loc(_loc_info)}: `return <value>` inside a "
-                    f"tensor-valued `while`/`for` cannot become XLA control "
-                    f"flow (the result has no shape before the first "
-                    f"iteration); assign the result to a variable "
-                    f"initialised before the loop and `break` instead")
             raise Dy2StaticError(
                 f"at {_loc(_loc_info)}: "
                 f"{f'`{nm}`' if nm else 'a loop variable'} may be read "
                 f"before assignment in a tensor-valued `while`; assign it "
                 f"before the loop")
+    if undef_rv:
+        # `return <value>` inside a traced loop (round-5; the reference's
+        # return_transformer covers this via the same flag mechanism): the
+        # return value has no shape before the first iteration, so probe
+        # ONE body application to learn the shape each __pt_rv* takes,
+        # then enter the loop carrying zeros of that shape.  The zeros are
+        # never observable: every read of __pt_rv* is guarded by __pt_rf,
+        # which only becomes True at the iteration that assigns the real
+        # value.  The probe's traced ops are dead code XLA eliminates.
+        probe = body_fn(vals)
+        vals = list(vals)
+        for i in undef_rv:
+            u = _unwrap1(probe[i])
+            if isinstance(u, _UndefinedVar):
+                # e.g. the return sits under a concretely-false branch:
+                # no shape to learn — keep the explicit guidance
+                raise Dy2StaticError(
+                    f"at {_loc(_loc_info)}: `return <value>` inside this "
+                    f"tensor-valued loop never assigns a value on the "
+                    f"probed path; assign the result to a variable "
+                    f"initialised before the loop and `break` instead")
+            arr = jnp.asarray(u)
+            z = jnp.zeros(arr.shape, arr.dtype)
+            vals[i] = Tensor(z) if isinstance(probe[i], Tensor) else z
+        vals = tuple(vals)
 
     arrs, statics = _split_state(vals)
     traced_idx = [i for i, s in enumerate(statics) if s is None]

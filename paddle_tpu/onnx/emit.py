@@ -26,6 +26,8 @@ Opset 13, default domain.
 """
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from . import wire as W
@@ -152,7 +154,16 @@ def _prod(xs):
 def _is_integer_contraction(eqn) -> bool:
     """Both operands (u)int8 and the output int32: the int8 deploy path's
     contraction shape — lowered to MatMulInteger/ConvInteger (ONNX
-    MatMul/Conv do not admit int8 inputs)."""
+    MatMul/Conv do not admit int8 inputs).
+
+    RUNTIME CAVEAT (advisor r4): the emitted s8 x s8 form is
+    ONNX-spec-legal, but onnxruntime's CPU ConvInteger kernel registers
+    only u8 activations — s8-activation ConvInteger models may fail to
+    load there (MatMulInteger s8 x s8 is fine).  For onnxruntime conv
+    deployment, export the QAT/PTQ fake-quant model instead: it emits the
+    QDQ (QuantizeLinear/DequantizeLinear) form every mainstream runtime
+    folds to its own int8 kernels.  This path keeps exact s8 semantics
+    for runtimes that support it and for the in-repo decoder."""
     i8 = (np.dtype(np.int8), np.dtype(np.uint8))
     return (np.dtype(eqn.invars[0].aval.dtype) in i8
             and np.dtype(eqn.invars[1].aval.dtype) in i8
@@ -915,13 +926,64 @@ def emit_model(fn, example_args, producer="paddle_tpu") -> bytes:
                     env[v] = nm
                 continue
             if prim == "scan":
-                # static trip count → UNROLL (deploy-friendly: flat graphs
-                # optimize better than ONNX Loop, and every iteration's
-                # weights slice folds to a Gather on the stacked tensor)
+                # static trip count → UNROLL by default (deploy-friendly:
+                # flat graphs optimize better than ONNX Loop, and every
+                # iteration's weights slice folds to a Gather on the
+                # stacked tensor).  PADDLE_TPU_ONNX_SCAN=loop emits ONE
+                # ONNX Loop instead (round-5 verdict Next #7: a
+                # weight-carrying scan — the decode loop's natural form —
+                # should export without unrolling): the iteration counter
+                # Gathers each xs slice, ys become Loop scan_outputs
+                # (stacked on a new leading axis, exactly scan's ys).
                 p = eqn.params
                 L, nc, nk = p["length"], p["num_consts"], p["num_carry"]
                 closed = p["jaxpr"]
                 body = closed.jaxpr
+                if (os.environ.get("PADDLE_TPU_ONNX_SCAN", "unroll")
+                        == "loop" and not p["reverse"] and L > 0):
+                    all_ins = [ref(v, g) for v in eqn.invars]
+                    consts_in = all_ins[:nc]
+                    carry0 = all_ins[nc:nc + nk]
+                    xs = all_ins[nc + nk:]
+                    carry_vars = eqn.invars[nc:nc + nk]
+                    sub = g.sub()
+                    it_nm = sub.fresh("iter")
+                    cin_nm = sub.fresh("cond_in")
+                    carry_in = [sub.fresh("carry_in") for _ in carry0]
+                    # per-iteration xs slice: Gather(x, iter) on axis 0
+                    # (scalar index drops the axis — the slice aval)
+                    xs_i = [sub.add("Gather", [x, it_nm],
+                                    attrs=_attr_int("axis", 0),
+                                    hint="xslice") for x in xs]
+                    body_outs = inline(closed, sub,
+                                       consts_in + carry_in + xs_i)
+                    cond_out = sub.add("Identity", [cin_nm],
+                                       hint="cond_out")
+                    outs_wrapped = [sub.add("Identity", [nm], hint="body_out")
+                                    for nm in body_outs]
+                    in_vis = ([_value_info(it_nm, (), np.int64),
+                               _value_info(cin_nm, (), np.bool_)]
+                              + [_value_info(nm, v.aval.shape, v.aval.dtype)
+                                 for nm, v in zip(carry_in, carry_vars)])
+                    out_vis = ([_value_info(cond_out, (), np.bool_)]
+                               + [_value_info(nm, v.aval.shape,
+                                              v.aval.dtype)
+                                  for nm, v in zip(
+                                      outs_wrapped[:nk], carry_vars)]
+                               + [_value_info(nm, v.aval.shape,
+                                              v.aval.dtype)
+                                  for nm, v in zip(outs_wrapped[nk:],
+                                                   body.outvars[nk:])])
+                    body_g = _assemble_graph(sub, in_vis, out_vis,
+                                             name=sub.fresh("scan_body"))
+                    m_nm = g.const(np.asarray(L, np.int64), "trip")
+                    c_nm = g.const(np.asarray(True, np.bool_), "true")
+                    outs = [g.fresh("scan_out") for _ in eqn.outvars]
+                    g.add("Loop", [m_nm, c_nm] + list(carry0),
+                          outputs=outs, attrs=_attr_graph("body", body_g))
+                    for v, nm in zip(eqn.outvars, outs):
+                        env[v] = nm
+                    continue
                 all_ins = [ref(v, g) for v in eqn.invars]
                 consts_in = all_ins[:nc]
                 carry = list(all_ins[nc:nc + nk])

@@ -127,6 +127,17 @@ class _LRU:
             return self._d[k]
         return default
 
+    _MISS = object()
+
+    def __getitem__(self, k):
+        v = self.get(k, _LRU._MISS)
+        if v is _LRU._MISS:
+            raise KeyError(k)
+        return v
+
+    def __contains__(self, k):
+        return k in self._d
+
     def __setitem__(self, k, v):
         self._d[k] = v
         self._d.move_to_end(k)
@@ -191,27 +202,17 @@ def _generate_impl(params, prompt, key, temperature, *, cfg,
         tok = jax.lax.dynamic_slice(tokens, (0, pos), (B, 1))[:, 0]
         logits, cache = decode_step(params, cache, tok, pos, cfg)
         key, sub = jax.random.split(key)
-        # temperature scales BEFORE the filters (advisor r4: computing the
-        # nucleus on untempered logits keeps a different token set than
-        # the mainstream temperature-then-top-p order).  top-k is
-        # monotonic-invariant; top-p is not.  Greedy (temperature == 0)
-        # bypasses the scale via the argmax branch below.
-        logits = jnp.where(jnp.asarray(temperature) > 0.0,
-                           logits / jnp.maximum(temperature, 1e-6), logits)
-        if top_k > 0:
-            kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-            logits = jnp.where(logits < kth, -1e30, logits)
-        if top_p < 1.0:
-            # nucleus sampling: keep the smallest prefix of the
-            # probability-sorted vocab whose mass reaches top_p (the top
-            # token always survives)
-            srt = jnp.sort(logits, axis=-1)[:, ::-1]
-            probs = jax.nn.softmax(srt, axis=-1)
-            cum = jnp.cumsum(probs, axis=-1)
-            keep_sorted = cum - probs < top_p   # mass BEFORE this token
-            kth_idx = jnp.sum(keep_sorted, axis=-1) - 1
-            cutoff = jnp.take_along_axis(srt, kth_idx[:, None], axis=-1)
-            logits = jnp.where(logits < cutoff, -1e30, logits)
+        # the canonical temperature -> top-k -> nucleus pipeline
+        # (_filter_logits is the single implementation all samplers
+        # share; advisor r4: temperature must scale BEFORE the nucleus
+        # cut).  Skipped entirely when both filters are statically off —
+        # the plain-sampling path then pays no vocab sorts per step.
+        if top_k > 0 or top_p < 1.0:
+            logits = _filter_logits(logits, temperature, top_k, top_p)
+        else:
+            logits = jnp.where(jnp.asarray(temperature) > 0.0,
+                               logits / jnp.maximum(temperature, 1e-6),
+                               logits)
         nxt = jax.lax.cond(
             jnp.asarray(temperature) > 0.0,
             lambda: jax.random.categorical(sub, logits),
@@ -251,6 +252,123 @@ def generate(params, cfg: gpt.GPTConfig, prompt, max_new_tokens=32,
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     fn = _get_generate_fn(cfg, int(max_new_tokens), top_k, top_p)
     return fn(params, prompt, key, jnp.asarray(float(temperature)))
+
+
+# ---------------------------------------------------------------------------
+# beam search — width-k max-probability decoding (serving staple)
+# ---------------------------------------------------------------------------
+
+
+def _beam_impl(params, prompt, *, cfg, max_new_tokens, num_beams,
+               length_penalty, eos_id):
+    B, P = prompt.shape
+    W = num_beams
+    V = cfg.vocab_size
+    total = P + max_new_tokens
+    NEG = jnp.float32(-1e30)
+
+    # every beam shares the prompt: run it once at beam-batch width so the
+    # cache is already [B*W] and generation never reshapes it
+    cache = init_cache(cfg, B * W, total)
+    toks = jnp.zeros((B, W, total), jnp.int32)
+    toks = toks.at[:, :, :P].set(prompt[:, None, :])
+
+    def feed(carry, pos):
+        cache, = carry
+        tok = jnp.repeat(prompt[:, pos], W)            # [B*W]
+        _, cache = decode_step(params, cache, tok, pos, cfg)
+        return (cache,), None
+
+    if P > 1:
+        (cache,), _ = jax.lax.scan(feed, (cache,), jnp.arange(P - 1))
+
+    # scores: beam 0 seeds the search; duplicates start at -inf so the
+    # first expansion yields W DISTINCT continuations
+    scores = jnp.full((B, W), NEG).at[:, 0].set(0.0)
+    alive = jnp.ones((B, W), bool)
+    lengths = jnp.zeros((B, W), jnp.int32)
+
+    def step(carry, pos):
+        cache, toks, scores, alive, lengths = carry
+        tok = jax.lax.dynamic_slice(
+            toks, (0, 0, pos), (B, W, 1)).reshape(B * W)
+        logits, cache = decode_step(params, cache, tok, pos, cfg)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        logp = logp.reshape(B, W, V)
+        if eos_id is not None:
+            # a finished beam must survive unexpanded: exactly one
+            # candidate (continue with eos) at zero added score
+            only_eos = jnp.full((V,), NEG).at[eos_id].set(0.0)
+            logp = jnp.where(alive[:, :, None], logp, only_eos)
+        cand = scores[:, :, None] + logp               # [B, W, V]
+        new_scores, idx = jax.lax.top_k(cand.reshape(B, W * V), W)
+        parent = idx // V                              # [B, W]
+        new_tok = (idx % V).astype(jnp.int32)
+        gather = lambda a: jnp.take_along_axis(a, parent, axis=1)  # noqa
+        toks = jnp.take_along_axis(
+            toks, parent[:, :, None], axis=1)
+        toks = jax.lax.dynamic_update_slice(
+            toks, new_tok[:, :, None], (0, 0, pos + 1))
+        # cache rows follow their beam: gather along the B*W axis
+        flat_parent = (jnp.arange(B)[:, None] * W + parent).reshape(-1)
+        cache = {k: jnp.take(v, flat_parent, axis=1)
+                 for k, v in cache.items()}
+        alive = gather(alive)
+        lengths = gather(lengths)
+        if eos_id is not None:
+            lengths = jnp.where(alive, lengths + 1, lengths)
+            alive = alive & (new_tok != eos_id)
+        else:
+            lengths = lengths + 1
+        return (cache, toks, new_scores, alive, lengths), None
+
+    (cache, toks, scores, alive, lengths), _ = jax.lax.scan(
+        step, (cache, toks, scores, alive, lengths),
+        P - 1 + jnp.arange(max_new_tokens))
+    norm = scores / jnp.power(jnp.maximum(lengths, 1).astype(jnp.float32),
+                              length_penalty)
+    best = jnp.argmax(norm, axis=1)                    # [B]
+    return (jnp.take_along_axis(toks, best[:, None, None], axis=1)[:, 0],
+            jnp.take_along_axis(norm, best[:, None], axis=1)[:, 0])
+
+
+def beam_search(params, cfg: gpt.GPTConfig, prompt, max_new_tokens=32,
+                num_beams=4, length_penalty: float = 0.0,
+                eos_id: int | None = None):
+    """Width-``num_beams`` beam search → (tokens [B, P+max_new], score [B]).
+
+    TPU-first shape: ONE jitted program — the prompt feeds at beam-batch
+    width (cache is [B*W] from step 0, no mid-flight reshape), each
+    generation step is a batched cached-attention decode + top-k over the
+    W*V joint candidates, and beam reordering is a gather on the cache's
+    batch axis.  Static shapes throughout; finished beams (``eos_id``)
+    survive unexpanded via a single zero-delta eos candidate.
+
+    ``length_penalty`` alpha normalizes final scores by generated-length
+    ** alpha (0 = pure sum-logprob).  With ``num_beams`` >= V**max_new
+    the search is exhaustive — the tests use that to prove optimality.
+    Beyond-reference capability: the v2.1 reference ships no generation
+    API at all (text/gpt.py docstring)."""
+    import numpy as np
+
+    prompt = jnp.asarray(np.asarray(prompt), jnp.int32)
+    total = prompt.shape[1] + int(max_new_tokens)
+    if total > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt + max_new_tokens = {total} exceeds cfg.max_seq_len "
+            f"{cfg.max_seq_len}")
+    if num_beams < 1:
+        raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+    key = ("beam", _cfg_key(cfg), int(max_new_tokens), int(num_beams),
+           float(length_penalty), eos_id)
+    fn = _GEN_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(functools.partial(
+            _beam_impl, cfg=cfg, max_new_tokens=int(max_new_tokens),
+            num_beams=int(num_beams),
+            length_penalty=float(length_penalty), eos_id=eos_id))
+        _GEN_CACHE[key] = fn
+    return fn(params, prompt)
 
 
 # ---------------------------------------------------------------------------
@@ -480,27 +598,61 @@ def _jit_by_cfg(tag: str, fn, cfg):
     return jf
 
 
-def _filtered_probs(logits, temperature, top_k, top_p):
-    """Host-side mirror of _generate_impl's sampling rule on a [V] logit
-    vector: temperature scale, then top-k, then nucleus — returns the
-    normalized probability vector the device sampler draws from.  The
-    rejection-sampling accept/resample math needs q and p as explicit
-    vectors, so the filter pipeline must match the sampler EXACTLY (same
-    order, same mass-before-token nucleus cut)."""
+def _key_seed(key):
+    """np.random seed material from a jax PRNG key (typed keys need
+    key_data; raw PRNGKey uint32 arrays convert directly)."""
     import numpy as np
 
-    x = np.asarray(logits, np.float64) / max(float(temperature), 1e-6)
-    if top_k and top_k > 0:
-        kth = np.sort(x)[-int(top_k)]
-        x = np.where(x < kth, -np.inf, x)
-    if top_p < 1.0:
-        order = np.argsort(-x)
-        srt = x[order]
-        e = np.exp(srt - srt[0])
-        probs = e / e.sum()
-        keep_sorted = np.cumsum(probs) - probs < top_p
-        cutoff = srt[np.sum(keep_sorted) - 1]
-        x = np.where(x < cutoff, -np.inf, x)
+    try:
+        return np.asarray(jax.random.key_data(key)).ravel()
+    except Exception:  # noqa: BLE001 - raw uint32 key array
+        return np.asarray(key).ravel()
+
+
+def _filter_logits(logits, temperature, top_k, top_p, xp=jnp):
+    """THE temperature → top-k → nucleus filter over [..., V] logits —
+    the single source of truth for every sampler: ``_generate_impl``
+    (device, scalar params), ``serving._sample_batched`` (device,
+    per-slot param arrays), and ``_filtered_probs`` (host mirror for the
+    speculative rejection rule, ``xp=numpy``).  Backend-agnostic on
+    purpose: one formula cannot drift between the three call sites (the
+    chi-square tests additionally pin host and device statistically).
+
+    temperature/top_k/top_p broadcast over the leading dims; top_k == 0
+    and top_p == 1 disable their stages; temperature == 0 leaves logits
+    unscaled (greedy callers take the argmax, which every stage
+    preserves — the top token always survives)."""
+    V = logits.shape[-1]
+    lead = logits.shape[:-1]
+
+    def bc(a, dt):
+        return xp.broadcast_to(xp.asarray(a, dt), lead)[..., None]
+
+    t = bc(temperature, xp.float32)
+    tk = bc(top_k, xp.int32)
+    tp = bc(top_p, xp.float32)
+    x = xp.where(t > 0, logits / xp.maximum(t, 1e-6), logits)
+    srt = xp.sort(x, axis=-1)[..., ::-1]               # descending
+    kth = xp.take_along_axis(srt, xp.clip(tk - 1, 0, V - 1), axis=-1)
+    x = xp.where((tk > 0) & (x < kth), -1e30, x)
+    srt2 = xp.sort(x, axis=-1)[..., ::-1]
+    e = xp.exp(srt2 - srt2[..., :1])
+    probs = e / xp.sum(e, axis=-1, keepdims=True)
+    keep = xp.cumsum(probs, axis=-1) - probs < tp  # mass BEFORE the token
+    kth_idx = xp.sum(keep, axis=-1, keepdims=True) - 1
+    cutoff = xp.take_along_axis(srt2, kth_idx, axis=-1)
+    return xp.where((tp < 1.0) & (x < cutoff), -1e30, x)
+
+
+def _filtered_probs(logits, temperature, top_k, top_p):
+    """Host-side probability vector of the sampling law on a [V] logit
+    vector — evaluates the SAME ``_filter_logits`` formula under numpy
+    (float64), then normalizes.  The rejection-sampling accept/resample
+    math needs q and p as explicit vectors."""
+    import numpy as np
+
+    x = _filter_logits(np.asarray(logits, np.float64), float(temperature),
+                       int(top_k), float(top_p), xp=np)
     e = np.exp(x - x.max())
     return e / e.sum()
 
@@ -617,13 +769,8 @@ def _speculative_sample(tparams, tcfg, dparams, dcfg, prompt,
     if key is None:
         key = jax.random.PRNGKey(0)
     # one host RNG drives draft draws, accept draws, and resamples —
-    # deterministic per key (typed keys need key_data; raw PRNGKey
-    # arrays convert directly)
-    try:
-        seed = np.asarray(jax.random.key_data(key)).ravel()
-    except Exception:  # noqa: BLE001 - raw uint32 key array
-        seed = np.asarray(key).ravel()
-    rng = np.random.default_rng(seed)
+    # deterministic per key
+    rng = np.random.default_rng(_key_seed(key))
 
     t_step = _jit_by_cfg("decode", decode_step, tcfg)
     d_step = _jit_by_cfg("decode", decode_step, dcfg)

@@ -49,6 +49,49 @@ def decode_step_batched(params, cache, token, pos, cfg: gpt.GPTConfig):
     return logits, {"k": nk, "v": nv}
 
 
+def _sample_batched(logits, key, temp, topk, topp):
+    """Per-slot sampling over batched logits [B, V]: temperature scale,
+    then top-k, then nucleus — the same pipeline (and order) as
+    ``generate``'s sampler, vectorized with PER-SLOT parameters so one
+    compiled step serves a batch mixing greedy and sampled requests.
+    temp/topp are float32 [B], topk int32 [B] (0 = off); slots with
+    temp == 0 take the argmax of the raw logits (bit-identical to the
+    greedy path).  The filter math lives in generate._filter_logits —
+    the single shared implementation."""
+    scaled = generate._filter_logits(logits, temp, topk, topp)
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jnp.where(temp > 0.0, sampled, greedy)
+
+
+def sample_step_batched(params, cache, tok, pos, key, temp, topk, topp,
+                        cfg: gpt.GPTConfig):
+    """One batched decode step that returns sampled TOKENS [B] (greedy
+    where temp == 0) instead of logits — the sampling-serving twin of
+    decode_step_batched."""
+    logits, cache = decode_step_batched(params, cache, tok, pos, cfg)
+    return _sample_batched(logits, key, temp, topk, topp), cache
+
+
+def sample_block_batched(params, cache, tok, pos, base_key, off, temp, topk,
+                         topp, k: int, cfg: gpt.GPTConfig):
+    """``k`` sampled decode steps on device, one host fetch — the
+    sampling twin of decode_block_batched.  Step j draws with
+    fold_in(base_key, off + j): the SAME key schedule the per-tick path
+    uses, so tick and tick_block produce identical tokens for identical
+    step counters (tests rely on this parity)."""
+    def body(carry, j):
+        cache, tok, pos = carry
+        logits, cache = decode_step_batched(params, cache, tok, pos, cfg)
+        nxt = _sample_batched(logits, jax.random.fold_in(base_key, off + j),
+                              temp, topk, topp)
+        return (cache, nxt, pos + 1), nxt
+
+    (cache, tok, pos), toks = jax.lax.scan(body, (cache, tok, pos),
+                                           jnp.arange(k))
+    return toks.T, cache
+
+
 def decode_block_batched(params, cache, tok, pos, k: int, cfg: gpt.GPTConfig):
     """``k`` greedy decode steps entirely ON DEVICE (round-4 verdict Weak
     #3: fetching the argmax to numpy every tick makes tunnel decode
@@ -104,6 +147,27 @@ def _get_block_fn(cfg: gpt.GPTConfig, k: int):
     return fn
 
 
+def _get_sample_step_fn(cfg: gpt.GPTConfig):
+    k = ("sample", generate._cfg_key(cfg))
+    fn = _STEP_CACHE.get(k)
+    if fn is None:
+        fn = jax.jit(lambda p, c, t, s, ky, te, tk, tp, _cfg=cfg:
+                     sample_step_batched(p, c, t, s, ky, te, tk, tp, _cfg))
+        _STEP_CACHE[k] = fn
+    return fn
+
+
+def _get_sample_block_fn(cfg: gpt.GPTConfig, k: int):
+    key = ("sample_block", generate._cfg_key(cfg), k)
+    fn = _STEP_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(lambda p, c, t, s, ky, off, te, tk, tp, _cfg=cfg,
+                     _k=k: sample_block_batched(p, c, t, s, ky, off, te,
+                                                tk, tp, _k, _cfg))
+        _STEP_CACHE[key] = fn
+    return fn
+
+
 def _get_step_fn(cfg: gpt.GPTConfig):
     """One jitted batched step per config VALUE (generate._GEN_CACHE's
     rationale: keying by object identity would recompile per DecodeServer
@@ -120,7 +184,10 @@ def _get_step_fn(cfg: gpt.GPTConfig):
 class DecodeServer:
     """Host-side slot scheduler around one jitted batched decode step.
 
-    Greedy decoding.  With the default ``prefill=True``, submit/_admit
+    Greedy by default; per-request ``temperature``/``top_k``/``top_p``
+    (round-5) sample on device with per-slot parameters, so one batch
+    mixes greedy and sampled requests in the same compiled step.  With
+    the default ``prefill=True``, submit/_admit
     runs the whole (bucket-padded) prompt through ONE jitted
     ``generate.prefill_slot`` step — device work at admission, one XLA
     compile per power-of-two bucket — and ticks only generate; with
@@ -130,7 +197,7 @@ class DecodeServer:
 
     def __init__(self, params, cfg: gpt.GPTConfig, max_batch: int,
                  max_len: int, eos_id: int | None = None,
-                 prefill: bool = True):
+                 prefill: bool = True, seed: int = 0):
         self.params = params
         self.cfg = cfg
         self.max_batch = max_batch
@@ -138,6 +205,13 @@ class DecodeServer:
         self.eos_id = eos_id
         self.cache = generate.init_cache(cfg, max_batch, max_len)
         self._step = _get_step_fn(cfg)
+        # per-request sampling (round-5): one base key; device step n
+        # draws with fold_in(base, n) — the same schedule for tick and
+        # tick_block, so the two paths produce identical samples.  A
+        # slot's draws depend on its batch-mates only through WHICH
+        # global steps it occupies (standard for batched serving).
+        self._base_key = jax.random.PRNGKey(seed)
+        self._step_no = 0
         # chunked prefill: a whole prompt becomes ONE admission-time step
         # (generate.prefill_slot) instead of len(prompt) ticks; prompts pad
         # to power-of-two buckets so XLA compiles one prefill per bucket.
@@ -157,9 +231,16 @@ class DecodeServer:
     # -- request lifecycle --------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int = 32,
-               stop: list | None = None) -> int:
+               stop: list | None = None, temperature: float = 0.0,
+               top_k: int = 0, top_p: float = 1.0) -> int:
         """``stop``: optional list of token SEQUENCES; generation ends
-        (sequence included) as soon as the generated tail matches one."""
+        (sequence included) as soon as the generated tail matches one.
+
+        ``temperature``/``top_k``/``top_p`` (round-5): PER-REQUEST
+        sampling — greedy at temperature 0 (the default, bit-identical
+        to before); otherwise the same scale→top-k→nucleus pipeline as
+        ``generate``, applied per slot so one batch can mix greedy and
+        sampled requests."""
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -174,10 +255,17 @@ class DecodeServer:
         stop = [[int(t) for t in seq] for seq in (stop or [])]
         if any(not seq for seq in stop):
             raise ValueError("empty stop sequence")
+        if temperature < 0.0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         rid = self._next_rid
         self._next_rid += 1
         self._queue.append({"rid": rid, "prompt": prompt,
-                            "max_new": max_new_tokens, "stop": stop})
+                            "max_new": max_new_tokens, "stop": stop,
+                            "temperature": float(temperature),
+                            "top_k": min(int(top_k), self.cfg.vocab_size),
+                            "top_p": float(top_p)})
         self._admit()
         return rid
 
@@ -188,6 +276,9 @@ class DecodeServer:
             st = {
                 "rid": req["rid"], "prompt": req["prompt"],
                 "max_new": req["max_new"], "stop": req.get("stop", []),
+                "temperature": req.get("temperature", 0.0),
+                "top_k": req.get("top_k", 0),
+                "top_p": req.get("top_p", 1.0),
                 "generated": [],
                 "pos": 0,   # next position == index of the token to feed
             }
@@ -204,7 +295,19 @@ class DecodeServer:
                 logits, self.cache = self._prefill(
                     self.params, self.cache, jnp.asarray(padded),
                     jnp.asarray(n), jnp.asarray(slot))
-                t = int(np.asarray(jnp.argmax(logits)))
+                if st["temperature"] > 0.0:
+                    # admission draws host-side from the filtered law,
+                    # seeded per rid off the server key — deterministic
+                    # regardless of admission order or batch-mates
+                    p = generate._filtered_probs(
+                        np.asarray(logits), st["temperature"],
+                        st["top_k"], st["top_p"])
+                    rng = np.random.default_rng(generate._key_seed(
+                        jax.random.fold_in(self._base_key,
+                                           (1 << 20) + st["rid"])))
+                    t = int(rng.choice(len(p), p=p))
+                else:
+                    t = int(np.asarray(jnp.argmax(logits)))
                 st["generated"].append(t)
                 st["pos"] = n  # cache rows [0, n) are filled
                 if (st["max_new"] <= 1
@@ -281,6 +384,19 @@ class DecodeServer:
                 or (self.eos_id is not None and t == self.eos_id)
                 or _hits_stop(st))
 
+    def _sampling_arrays(self):
+        """Per-slot (temperature, top_k, top_p) for the current batch;
+        free and prompt-feeding slots sample nothing (temp 0)."""
+        temp = np.zeros((self.max_batch,), np.float32)
+        tk = np.zeros((self.max_batch,), np.int32)
+        tp = np.ones((self.max_batch,), np.float32)
+        for slot, st in self._slots.items():
+            if st["pos"] >= len(st["prompt"]) - 1:
+                temp[slot] = st["temperature"]
+                tk[slot] = st["top_k"]
+                tp[slot] = st["top_p"]
+        return temp, tk, tp
+
     def _retire(self, done):
         for slot in done:
             st = self._slots.pop(slot)
@@ -294,9 +410,21 @@ class DecodeServer:
             if not self._slots:
                 return
         tok, pos = self._feed_arrays()
-        logits, self.cache = self._step(self.params, self.cache,
-                                        jnp.asarray(tok), jnp.asarray(pos))
-        nxt = np.asarray(jnp.argmax(logits, axis=-1))
+        temp, tk, tp = self._sampling_arrays()
+        n = self._step_no
+        self._step_no = n + 1
+        if temp.any():
+            fn = _get_sample_step_fn(self.cfg)
+            nxt, self.cache = fn(
+                self.params, self.cache, jnp.asarray(tok),
+                jnp.asarray(pos), jax.random.fold_in(self._base_key, n),
+                jnp.asarray(temp), jnp.asarray(tk), jnp.asarray(tp))
+            nxt = np.asarray(nxt)
+        else:
+            logits, self.cache = self._step(self.params, self.cache,
+                                            jnp.asarray(tok),
+                                            jnp.asarray(pos))
+            nxt = np.asarray(jnp.argmax(logits, axis=-1))
         done = []
         for slot, st in self._slots.items():
             i = st["pos"]
@@ -336,9 +464,19 @@ class DecodeServer:
                     break
             return
         tok, pos = self._feed_arrays()
-        fn = _get_block_fn(self.cfg, block)
-        toks, self.cache, _, _ = fn(self.params, self.cache,
-                                    jnp.asarray(tok), jnp.asarray(pos))
+        temp, tk, tp = self._sampling_arrays()
+        n = self._step_no
+        self._step_no = n + block
+        if temp.any():
+            fn = _get_sample_block_fn(self.cfg, block)
+            toks, self.cache = fn(
+                self.params, self.cache, jnp.asarray(tok),
+                jnp.asarray(pos), self._base_key, jnp.asarray(n),
+                jnp.asarray(temp), jnp.asarray(tk), jnp.asarray(tp))
+        else:
+            fn = _get_block_fn(self.cfg, block)
+            toks, self.cache, _, _ = fn(self.params, self.cache,
+                                        jnp.asarray(tok), jnp.asarray(pos))
         toks = np.asarray(toks)  # the block's single device->host fetch
         done = []
         for slot, st in self._slots.items():

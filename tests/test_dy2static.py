@@ -525,7 +525,9 @@ class TestEscapeRewrites:
             jax.jit(lambda a: conv(_T(a)).value)(
                 jnp.ones((2,), jnp.float32))
 
-    def test_return_value_in_traced_while_raises_clear(self):
+    def test_return_value_in_traced_while_converts(self):
+        # round-5: this used to raise ("no shape before the first
+        # iteration"); the shape-probe zero-init makes it convert
         def f(x):
             s = x.sum()
             while s < 10.0:
@@ -534,10 +536,20 @@ class TestEscapeRewrites:
                     return s * 100.0
             return s
 
+        def ref(a):
+            s = a.sum()
+            while s < 10.0:
+                s = s * 2.0
+                if s > 5.0:
+                    return s * 100.0
+            return s
+
         conv = convert_to_static(f)
-        with pytest.raises(Dy2StaticError, match="assign the result"):
-            jax.jit(lambda a: conv(_T(a)).value)(
-                jnp.ones((1,), jnp.float32))
+        j = jax.jit(lambda a: conv(_T(a)).value)
+        for a in (np.ones((1,), np.float32),           # 1->2->4->8: exits
+                  np.full((1,), 20.0, np.float32)):    # cond false at entry
+            np.testing.assert_allclose(np.asarray(j(jnp.asarray(a))),
+                                       ref(a))
 
     def test_return_in_concrete_while_ok(self):
         def f(x):
@@ -652,3 +664,121 @@ class TestEscapeRewrites:
         assert not isinstance(out, tuple)
         with pytest.raises(Dy2StaticError):
             jax.jit(lambda a: conv(_T(a)))(jnp.ones((2,), jnp.float32))
+
+
+class TestReturnValueInTracedLoop:
+    """Round-5 (reference return_transformer.py capability): `return
+    <value>` inside a TENSOR-valued while/for converts — the pre-loop
+    carry is zero-initialised from a one-body shape probe; reads stay
+    guarded by the return flag."""
+
+    def _jit(self, f):
+        conv = convert_to_static(f)
+        return jax.jit(lambda *a: conv(*[_T(x) for x in a]).value)
+
+    def test_return_in_traced_while(self):
+        def f(x, n):
+            i = jnp.zeros((), jnp.int32)
+            while i < n:
+                x = x + 1.0
+                if x.sum() >= 6.0:
+                    return x * 10.0
+                i = i + 1
+            return x
+
+        def ref(x, n):
+            for _ in range(int(n)):
+                x = x + 1.0
+                if x.sum() >= 6.0:
+                    return x * 10.0
+            return x
+
+        j = self._jit(f)
+        for n in (5, 2, 0):
+            got = np.asarray(j(jnp.zeros((2,), jnp.float32),
+                               jnp.asarray(n, jnp.int32)))
+            np.testing.assert_allclose(got, ref(np.zeros(2, np.float32), n),
+                                       err_msg=str(n))
+
+    def test_return_in_traced_range_for(self):
+        def f(x, n):
+            for i in range(n):
+                x = x + 1.0
+                if x.max() >= 3.0:
+                    return x + 100.0
+            return x
+
+        def ref(x, n):
+            for i in range(int(n)):
+                x = x + 1.0
+                if x.max() >= 3.0:
+                    return x + 100.0
+            return x
+
+        j = self._jit(f)
+        for n in (6, 1):
+            got = np.asarray(j(jnp.zeros((2,), jnp.float32),
+                               jnp.asarray(n, jnp.int32)))
+            np.testing.assert_allclose(got, ref(np.zeros(2, np.float32), n),
+                                       err_msg=str(n))
+
+    def test_tuple_return_in_traced_while(self):
+        def f(x, n):
+            i = jnp.zeros((), jnp.int32)
+            while i < n:
+                x = x + 1.0
+                if x.sum() >= 4.0:
+                    return x * 2.0, x.sum()
+                i = i + 1
+            return x, x.sum()
+
+        conv = convert_to_static(f)
+
+        def run(n):
+            a, b = conv(_T(jnp.zeros((2,), jnp.float32)),
+                        _T(jnp.asarray(n, jnp.int32)))
+            return np.asarray(a.value), float(np.asarray(b.value))
+
+        a, b = run(5)   # returns at i=1 (sum hits 4.0)
+        np.testing.assert_allclose(a, 4.0 * np.ones(2))
+        assert b == 4.0
+        a, b = run(1)   # loop ends before the return fires
+        np.testing.assert_allclose(a, np.ones(2))
+        assert b == 2.0
+
+    def test_return_only_path_in_traced_while(self):
+        # the body's ONLY exit is the return: the probe still learns the
+        # shape and the conjunct ends the loop at the first iteration
+        def f(x, n):
+            i = jnp.zeros((), jnp.int32)
+            while i < n:
+                return x * 3.0
+            return x
+
+        j = self._jit(f)
+        np.testing.assert_allclose(
+            np.asarray(j(jnp.ones((2,), jnp.float32),
+                         jnp.asarray(4, jnp.int32))), 3 * np.ones(2))
+        np.testing.assert_allclose(
+            np.asarray(j(jnp.ones((2,), jnp.float32),
+                         jnp.asarray(0, jnp.int32))), np.ones(2))
+
+
+def test_unbound_loop_var_diagnostic_survives_rv_probe():
+    """A traced loop with BOTH a value-return and an unbound user
+    variable must still raise the located read-before-assignment
+    diagnostic, not an opaque _UndefinedVar TypeError from the shape
+    probe (the non-rv check runs before the probe)."""
+    def f(x, n):
+        i = jnp.zeros((), jnp.int32)
+        while i < n:
+            if x.sum() > 3.0:
+                return x * 2.0
+            acc = acc + 1.0  # noqa: F821 - deliberately unbound
+            i = i + 1
+        return x
+
+    conv = convert_to_static(f)
+    with pytest.raises(Dy2StaticError, match="before assignment"):
+        jax.jit(lambda a, n: conv(_T(a), _T(n)).value)(
+            jnp.zeros((2,), jnp.float32), jnp.asarray(5, jnp.int32))

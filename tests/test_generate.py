@@ -355,3 +355,78 @@ def test_top_p_nucleus_sampling():
     import pytest as _pytest
     with _pytest.raises(ValueError, match="top_p"):
         G.generate(params, cfg, prompt, max_new_tokens=1, top_p=0.0)
+
+
+class TestBeamSearch:
+    """Width-k beam search (round-5, beyond-reference serving staple)."""
+
+    def _cfg(self, V=4):
+        return gpt.GPTConfig(vocab_size=V, hidden_size=32, num_layers=2,
+                             num_heads=4, max_seq_len=16)
+
+    def test_beam_one_equals_greedy(self):
+        cfg = self._cfg(V=16)
+        params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+        prompt = np.asarray([[3, 7, 1], [5, 2, 9]], np.int32)
+        greedy = np.asarray(G.generate(params, cfg, prompt,
+                                       max_new_tokens=6, temperature=0.0))
+        beams, _ = G.beam_search(params, cfg, prompt, max_new_tokens=6,
+                                 num_beams=1)
+        np.testing.assert_array_equal(np.asarray(beams), greedy)
+
+    def test_exhaustive_width_finds_optimum(self):
+        """num_beams = V**max_new makes the search exhaustive: the result
+        must be the true max-sum-logprob path (checked by brute force)."""
+        cfg = self._cfg(V=4)
+        params = gpt.init_params(cfg, jax.random.PRNGKey(1))
+        prompt = [2, 0]
+        V, m = 4, 2
+
+        def path_score(seq):
+            cache = G.init_cache(cfg, 1, 16)
+            score, prev = 0.0, None
+            feed = prompt + list(seq)
+            for pos, tok in enumerate(feed[:-1] if len(feed) > len(prompt)
+                                      else feed):
+                l, cache = G.decode_step(params, cache,
+                                         jnp.asarray([tok], jnp.int32),
+                                         pos, cfg)
+                if pos >= len(prompt) - 1:
+                    lp = np.asarray(jax.nn.log_softmax(l[0]))
+                    score += float(lp[feed[pos + 1]])
+            return score
+
+        paths = [(a, b) for a in range(V) for b in range(V)]
+        scores = {p: path_score(p) for p in paths}
+        best_path = max(scores, key=scores.get)
+        toks, sc = G.beam_search(params, cfg, np.asarray([prompt]),
+                                 max_new_tokens=m, num_beams=V ** m)
+        got = tuple(np.asarray(toks)[0, len(prompt):])
+        assert got == best_path, (got, best_path, scores[got],
+                                  scores[best_path])
+        np.testing.assert_allclose(float(np.asarray(sc)[0]),
+                                   scores[best_path], rtol=1e-3, atol=1e-3)
+
+    def test_eos_freezes_finished_beams(self):
+        cfg = self._cfg(V=8)
+        params = gpt.init_params(cfg, jax.random.PRNGKey(2))
+        toks, _ = G.beam_search(params, cfg, np.asarray([[3, 1]]),
+                                max_new_tokens=10, num_beams=4, eos_id=2)
+        out = list(np.asarray(toks)[0, 2:])
+        if 2 in out:
+            i = out.index(2)
+            assert all(t == 2 for t in out[i:]), out  # eos-padded tail
+
+    def test_beam_width_monotone(self):
+        """More beams can only improve (or tie) the best raw score."""
+        cfg = self._cfg(V=6)
+        params = gpt.init_params(cfg, jax.random.PRNGKey(3))
+        prompt = np.asarray([[1, 4]], np.int32)
+        s_prev = None
+        for W in (1, 2, 8):
+            _, sc = G.beam_search(params, cfg, prompt, max_new_tokens=3,
+                                  num_beams=W)
+            s = float(np.asarray(sc)[0])
+            if s_prev is not None:
+                assert s >= s_prev - 1e-5, (W, s, s_prev)
+            s_prev = s

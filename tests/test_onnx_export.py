@@ -150,6 +150,12 @@ def run_graph(model: dict, feeds: dict, outer_env: dict | None = None) -> list:
             cond = bool(np.asarray(env[n["inputs"][1]]).reshape(()))
             vs = [env[x] for x in n["inputs"][2:]]
             body = a["body"]
+            # ONNX spec: N carried deps = len(node inputs) - 2; body
+            # outputs are (cond, N carried, K scan_outputs); the node's
+            # outputs are the final carried deps followed by the K scan
+            # outputs stacked on a new leading axis
+            N = len(n["inputs"]) - 2
+            scan_acc = [[] for _ in range(len(n["outputs"]) - N)]
             it = 0
             while cond and (trip_max is None or it < trip_max):
                 fb = {body["inputs"][0]: np.asarray(it, np.int64),
@@ -158,9 +164,12 @@ def run_graph(model: dict, feeds: dict, outer_env: dict | None = None) -> list:
                     fb[nm] = v
                 res = run_graph(body, fb, env)
                 cond = bool(np.asarray(res[0]).reshape(()))
-                vs = res[1:]
+                vs = res[1:1 + N]
+                for kk, sv in enumerate(res[1 + N:]):
+                    scan_acc[kk].append(np.asarray(sv))
                 it += 1
-            for o_name, val in zip(n["outputs"], vs):
+            final = list(vs) + [np.stack(acc) for acc in scan_acc]
+            for o_name, val in zip(n["outputs"], final):
                 env[o_name] = val
             continue
         i = [env[x] for x in n["inputs"]]
@@ -875,3 +884,113 @@ class TestOnnxExport:
     def test_requires_input_spec(self, tmp_path):
         with pytest.raises(ValueError, match="input_spec"):
             export(nn.Linear(2, 2), str(tmp_path / "x.onnx"))
+
+
+class TestScanAsLoop:
+    """PADDLE_TPU_ONNX_SCAN=loop (round-5): a weight-carrying lax.scan —
+    the decode loop's natural form — lowers to ONE ONNX Loop with carried
+    state and scan_outputs instead of unrolling."""
+
+    def test_carry_scan_exports_as_loop(self, tmp_path, monkeypatch):
+        import jax.numpy as jnp
+        from jax import lax
+
+        monkeypatch.setenv("PADDLE_TPU_ONNX_SCAN", "loop")
+        w = np.asarray(np.random.default_rng(0).standard_normal((4, 4)),
+                       np.float32)
+
+        def f(x):
+            def body(c, i):
+                c2 = jnp.tanh(c @ jnp.asarray(w)) + i.astype(jnp.float32)
+                return c2, c2.sum()
+
+            c, ys = lax.scan(body, x.value, jnp.arange(5))
+            return c, ys
+
+        x0 = paddle.to_tensor(np.ones((2, 4), np.float32))
+        path = export(f, str(tmp_path / "scanloop.onnx"), input_spec=[x0])
+        with open(path, "rb") as fh:
+            model = parse_model(fh.read())
+        loops = [n for n in model["nodes"] if n["op"] == "Loop"]
+        assert len(loops) == 1           # one Loop, nothing unrolled
+        got_c, got_ys = run_graph(model,
+                                  {"input_0": np.ones((2, 4), np.float32)})
+        want_c, want_ys = f(x0)
+        np.testing.assert_allclose(got_c, np.asarray(want_c.value
+                                   if hasattr(want_c, "value") else want_c),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(got_ys, np.asarray(want_ys), rtol=1e-5,
+                                   atol=1e-6)
+        assert np.asarray(got_ys).shape == (5,)
+
+    def test_greedy_generation_exports_as_loop(self, tmp_path, monkeypatch):
+        """The decode capstone under Loop mode: nested Loops (position
+        loop carrying the KV cache; per-step block scan) reproduce the
+        framework's generation — with the graph a fraction of the
+        unrolled size."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        from paddle_tpu.text import gpt
+        from paddle_tpu.text.generate import decode_step, init_cache
+
+        cfg = gpt.GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                            num_heads=2, max_seq_len=16, dtype=jnp.float32)
+        params = gpt.init_params(cfg, jax.random.PRNGKey(5))
+        cache0 = init_cache(cfg, 1, 16)
+
+        def f(tok0, ck, cv):
+            def body(carry, i):
+                tok, k, v = carry
+                logits, cache = decode_step(params, {"k": k, "v": v},
+                                            tok, i, cfg)
+                nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+                return (nxt, cache["k"], cache["v"]), nxt
+
+            (_, _, _), toks = lax.scan(
+                body, (tok0.value, ck.value, cv.value), jnp.arange(3))
+            return toks
+
+        tok0 = paddle.to_tensor(np.asarray([7], np.int32))
+        ck = paddle.to_tensor(np.asarray(cache0["k"]))
+        cv = paddle.to_tensor(np.asarray(cache0["v"]))
+
+        monkeypatch.setenv("PADDLE_TPU_ONNX_SCAN", "loop")
+        path = export(f, str(tmp_path / "greedy_loop.onnx"),
+                      input_spec=[tok0, ck, cv])
+        with open(path, "rb") as fh:
+            loop_bytes = fh.read()
+        model = parse_model(loop_bytes)
+
+        def count_loops(m):
+            c = 0
+            for n_ in m["nodes"]:
+                c += n_["op"] == "Loop"
+                for sub in n_["attrs"].values():
+                    if isinstance(sub, dict) and "nodes" in sub:
+                        c += count_loops(sub)
+            return c
+
+        assert count_loops(model) >= 2   # position Loop + block Loop
+        got = run_graph(model, {
+            "input_0": np.asarray([7], np.int32),
+            "input_1": np.asarray(cache0["k"]),
+            "input_2": np.asarray(cache0["v"])})[0]
+        tok, cache, want = jnp.asarray([7], jnp.int32), cache0, []
+        for i in range(3):
+            logits, cache = decode_step(params, cache, tok,
+                                        jnp.asarray(i, jnp.int32), cfg)
+            tok = jnp.argmax(logits, -1).astype(jnp.int32)
+            want.append(int(tok[0]))
+        np.testing.assert_array_equal(np.asarray(got).reshape(-1), want)
+
+        monkeypatch.setenv("PADDLE_TPU_ONNX_SCAN", "unroll")
+        upath = export(f, str(tmp_path / "greedy_unrolled.onnx"),
+                       input_spec=[tok0, ck, cv])
+        with open(upath, "rb") as fh:
+            unrolled_bytes = fh.read()
+        # the graph body appears once instead of 3x5-positions-x-layers
+        # (weights are shared initializers either way, so the saving is
+        # node count, not parameter bytes)
+        assert len(loop_bytes) < len(unrolled_bytes) * 0.75

@@ -529,3 +529,114 @@ def test_tick_block_zero_rejected_and_close_abandons():
     srv.close()     # rid still mid-flight -> abandoned, not a bare KeyError
     with _pytest.raises(RuntimeError, match="abandoned"):
         srv.result(rid)
+
+
+# ---------------------------------------------------------------------------
+# per-request sampling (round-5): temperature/top-k/top-p per slot
+# ---------------------------------------------------------------------------
+
+
+def _law_after_prompt(params, cfg, prompt, temperature, top_k, top_p):
+    cache = G.init_cache(cfg, 1, cfg.max_seq_len)
+    for pos, tok in enumerate(prompt):
+        l, cache = G.decode_step(params, cache,
+                                 jnp.asarray([tok], jnp.int32), pos, cfg)
+    return G._filtered_probs(np.asarray(l)[0], temperature, top_k, top_p)
+
+
+def _chi2_counts(counts, law, n):
+    keep = law * n >= 5
+    o = np.concatenate([counts[keep], [counts[~keep].sum()]])
+    e = np.maximum(np.concatenate([law[keep] * n,
+                                   [law[~keep].sum() * n]]), 1e-12)
+    return float(((o - e) ** 2 / e).sum()), int(keep.sum())
+
+
+def test_sampled_tick_matches_sampled_tick_block():
+    """Same seed, same step counters: per-token ticks and block ticks
+    draw identical samples (the fold_in(base, step) schedule)."""
+    cfg = _cfg(vocab_size=12)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(8))
+    rng = np.random.default_rng(3)
+    prompts = [list(rng.integers(0, 12, n)) for n in (4, 2, 6)]
+
+    def run(block):
+        srv = serving.DecodeServer(params, cfg, max_batch=3, max_len=32,
+                                   seed=11)
+        rids = [srv.submit(p, max_new_tokens=9, temperature=1.2,
+                           top_p=0.95) for p in prompts]
+        while srv.pending():
+            srv.tick_block(block) if block else srv.tick()
+        return [srv.result(r) for r in rids]
+
+    ref = run(None)
+    for block in (1, 3, 8):
+        assert run(block) == ref, block
+
+
+def test_mixed_greedy_and_sampled_batch():
+    """A greedy request batched with sampled strangers must produce its
+    solo greedy tokens exactly (per-slot temp 0 takes raw argmax)."""
+    cfg = _cfg(vocab_size=12)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(9))
+    rng = np.random.default_rng(4)
+    gp = list(rng.integers(0, 12, 5))
+    want = _greedy_reference(params, cfg, gp, 8)
+    srv = serving.DecodeServer(params, cfg, max_batch=3, max_len=32,
+                               seed=2)
+    rg = srv.submit(gp, max_new_tokens=8)  # greedy
+    rs1 = srv.submit(list(rng.integers(0, 12, 3)), max_new_tokens=8,
+                     temperature=1.5)
+    rs2 = srv.submit(list(rng.integers(0, 12, 2)), max_new_tokens=8,
+                     temperature=0.8, top_p=0.9)
+    while srv.pending():
+        srv.tick_block(4)
+    assert srv.result(rg) == want
+    for r in (rs1, rs2):
+        out = srv.result(r)
+        assert len(out) == 8 and all(0 <= t < 12 for t in out)
+
+
+def test_sampled_serving_follows_target_law_tick_path():
+    """Chi-square: with prefill=False and max_new=1 the generated token
+    comes from the DEVICE sampler (_sample_batched) — its distribution
+    over server seeds must match the exact filtered law."""
+    cfg = _cfg(vocab_size=12)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(9))
+    prompt = [4, 7]
+    n = 200
+    law = _law_after_prompt(params, cfg, prompt, 1.3, 0, 1.0)
+    toks = []
+    for i in range(n):
+        srv = serving.DecodeServer(params, cfg, max_batch=1, max_len=16,
+                                   prefill=False, seed=100 + i)
+        rid = srv.submit(prompt, max_new_tokens=1, temperature=1.3)
+        while srv.pending():
+            srv.tick()
+        toks.append(srv.result(rid)[0])
+    counts = np.bincount(toks, minlength=12).astype(float)
+    stat, df = _chi2_counts(counts, law, n)
+    assert stat < 3 * max(df, 1) + 10, stat
+
+
+def test_sampled_admission_follows_target_law_prefill_path():
+    """Chi-square for the host-side admission draw (prefill first
+    token), including nucleus-support respect."""
+    cfg = _cfg(vocab_size=12)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(9))
+    prompt = [4, 7]
+    n = 200
+    law = _law_after_prompt(params, cfg, prompt, 0.9, 0, 0.7)
+    toks = []
+    for i in range(n):
+        srv = serving.DecodeServer(params, cfg, max_batch=1, max_len=16,
+                                   seed=500 + i)
+        rid = srv.submit(prompt, max_new_tokens=1, temperature=0.9,
+                         top_p=0.7)
+        while srv.pending():
+            srv.tick()
+        toks.append(srv.result(rid)[0])
+    counts = np.bincount(toks, minlength=12).astype(float)
+    stat, df = _chi2_counts(counts, law, n)
+    assert stat < 3 * max(df, 1) + 10, stat
+    assert counts[law == 0].sum() == 0

@@ -206,3 +206,79 @@ def test_ab_arm_without_device_provenance_reopens(pt):
     pt._run_step = run
     pt.watch(interval=1, probe_timeout=1, max_hours=1)
     assert calls == ["gpt350_fused"]  # reopened for re-measurement
+
+
+def _bench_mod():
+    spec = importlib.util.spec_from_file_location(
+        "bench_for_test", os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "bench.py"))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    return m
+
+
+def _wd_file(tmp_path, steps):
+    import datetime
+
+    now = datetime.datetime.now(
+        datetime.timezone.utc).isoformat(timespec="seconds")
+    for s in steps.values():
+        s.setdefault("finished", now)
+    p = tmp_path / "WATCHDOG_RESULTS.json"
+    json.dump({"steps": steps, "windows": []}, open(p, "w"))
+    return str(p)
+
+
+def test_bench_replay_prefers_ladder_over_fast_headline(tmp_path):
+    b = _bench_mod()
+    head_l = {"metric": "tokens_per_sec_per_chip_gpt_350m_fused_acc2_b8",
+              "vs_baseline": 0.9, "device": "tpu", "mfu": 0.4}
+    head_f = {"metric": "tokens_per_sec_per_chip_gpt_350m_dots_acc2_b8",
+              "vs_baseline": 0.5, "device": "tpu", "mfu": 0.22,
+              "fast_headline": True}
+    p = _wd_file(tmp_path, {"ladder": {"ok": True, "headline": head_l},
+                            "fast_headline": {"ok": True,
+                                              "headline": head_f}})
+    wd = b._watchdog_tpu_result(p)
+    assert wd["step"] == "ladder" and wd["headline"]["mfu"] == 0.4
+
+
+def test_bench_replay_falls_back_to_fast_headline(tmp_path):
+    """Round-5 point: a window long enough for ONE rung but not the
+    tournament must still produce a device=tpu BENCH headline."""
+    b = _bench_mod()
+    head_f = {"metric": "tokens_per_sec_per_chip_gpt_350m_dots_acc2_b8",
+              "vs_baseline": 0.5, "device": "tpu", "mfu": 0.22,
+              "fast_headline": True}
+    p = _wd_file(tmp_path, {
+        "ladder": {"ok": False, "rc": 1,
+                   "headline": {"metric": "x", "vs_baseline": 0.0}},
+        "fast_headline": {"ok": True, "headline": head_f}})
+    wd = b._watchdog_tpu_result(p)
+    assert wd["step"] == "fast_headline"
+    line = b._headline_from_watchdog(
+        wd, "tpu_watchdog" if wd.get("step") == "ladder"
+        else "tpu_watchdog_fast_headline")
+    assert line["source"] == "tpu_watchdog_fast_headline"
+    assert line["mfu"] == 0.22 and "measured_at" in line
+
+
+def test_bench_replay_rejects_stale_and_not_ok(tmp_path):
+    import datetime
+
+    b = _bench_mod()
+    head = {"metric": "m", "vs_baseline": 0.5, "device": "tpu"}
+    # not ok -> rejected
+    p = _wd_file(tmp_path, {"fast_headline": {"ok": False,
+                                              "headline": head}})
+    assert b._watchdog_tpu_result(p) is None
+    # older than 24h -> rejected
+    old = (datetime.datetime.now(datetime.timezone.utc)
+           - datetime.timedelta(hours=30)).isoformat(timespec="seconds")
+    p = _wd_file(tmp_path, {"fast_headline": {
+        "ok": True, "headline": head, "finished": old}})
+    assert b._watchdog_tpu_result(p) is None
+    # cpu-fallback suffix / zero vs_baseline -> rejected
+    p = _wd_file(tmp_path, {"ladder": {"ok": True, "headline": {
+        "metric": "m_cpu_fallback", "vs_baseline": 0.5}}})
+    assert b._watchdog_tpu_result(p) is None
