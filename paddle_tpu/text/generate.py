@@ -39,9 +39,15 @@ def _cached_block(x, p, cache_k, cache_v, pos, cfg: gpt.GPTConfig):
     B, _, D = x.shape
     H, hd = cfg.num_heads, cfg.head_dim
     dt = cfg.dtype
-    h = gpt._layer_norm(x.astype(jnp.float32), p["ln1_g"],
-                        p["ln1_b"]).astype(dt)
+    h = gpt._norm(x, p, "ln1", cfg)
     q3, k3, v3 = gpt._project_qkv(h, p, cfg, repeat_kv=False)
+    if cfg.pos_embed == "rope":
+        # rotate q and the NEW key row at this position; the cache holds
+        # already-rotated keys (rope's relative-offset property makes
+        # them valid forever)
+        pos_arr = jnp.asarray(pos, jnp.int32).reshape(1)
+        q3 = gpt.apply_rope(q3, pos_arr)
+        k3 = gpt.apply_rope(k3, pos_arr)
     q = q3.reshape(B, H, hd)
     k_new = k3.reshape(B, -1, hd)   # Hkv rows under GQA, H otherwise
     v_new = v3.reshape(B, -1, hd)
@@ -87,9 +93,10 @@ def decode_step(params, cache, token, pos, cfg: gpt.GPTConfig):
     capacity-bounded routing, not a cache artifact)."""
     dt = cfg.dtype
     B = token.shape[0]
-    x = woq.embed(params, token, dt)[:, None] \
-        + jax.lax.dynamic_slice(params["wpe"], (pos, 0),
-                                (1, cfg.hidden_size)).astype(dt)[None]
+    x = woq.embed(params, token, dt)[:, None]
+    if cfg.pos_embed == "learned":
+        x = x + jax.lax.dynamic_slice(
+            params["wpe"], (pos, 0), (1, cfg.hidden_size)).astype(dt)[None]
 
     def body(x, layer):
         p, ck, cv = layer
@@ -102,8 +109,7 @@ def decode_step(params, cache, token, pos, cfg: gpt.GPTConfig):
         cache["k"], k_rows[:, :, None], (0, 0, pos, 0, 0))
     new_v = jax.lax.dynamic_update_slice(
         cache["v"], v_rows[:, :, None], (0, 0, pos, 0, 0))
-    x = gpt._layer_norm(x.astype(jnp.float32), params["ln_f_g"],
-                        params["ln_f_b"]).astype(dt)
+    x = gpt._norm(x, params, "ln_f", cfg)
     logits = woq.logits(x, params, dt)[:, 0]
     return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
 
@@ -173,6 +179,7 @@ def _cfg_key(cfg):
     return (cfg.vocab_size, cfg.hidden_size, cfg.num_layers, cfg.num_heads,
             cfg.num_kv_heads,
             cfg.max_seq_len, cfg.ffn_ratio, str(cfg.dtype), cfg.use_flash,
+            cfg.pos_embed, cfg.norm, cfg.activation,
             moe_key)
 
 
@@ -468,10 +475,13 @@ def _prefill_block(x, p, cfg: gpt.GPTConfig, valid=None):
     B, P, D = x.shape
     H, hd = cfg.num_heads, cfg.head_dim
     dt = cfg.dtype
-    h = gpt._layer_norm(x.astype(jnp.float32), p["ln1_g"],
-                        p["ln1_b"]).astype(dt)
+    h = gpt._norm(x, p, "ln1", cfg)
     # project ONCE (unrepeated); derive GQA attention copies by repeat
     q, k_rows, v_rows = gpt._project_qkv(h, p, cfg, repeat_kv=False)
+    if cfg.pos_embed == "rope":
+        pos_arr = jnp.arange(P)
+        q = gpt.apply_rope(q, pos_arr)
+        k_rows = gpt.apply_rope(k_rows, pos_arr)
     rep = H // k_rows.shape[2]
     k = jnp.repeat(k_rows, rep, axis=2) if rep > 1 else k_rows
     v = jnp.repeat(v_rows, rep, axis=2) if rep > 1 else v_rows
@@ -499,7 +509,9 @@ def prefill_slot(params, cache, tokens, length, slot, cfg: gpt.GPTConfig):
     (tests/test_serving.py MoE prefill parity)."""
     dt = cfg.dtype
     P = tokens.shape[1]
-    x = woq.embed(params, tokens, dt) + params["wpe"][:P].astype(dt)[None]
+    x = woq.embed(params, tokens, dt)
+    if cfg.pos_embed == "learned":
+        x = x + params["wpe"][:P].astype(dt)[None]
     valid_mask = (jnp.arange(P) < length)[None, :]       # [1, P]
 
     def body(x, p):
@@ -508,7 +520,7 @@ def prefill_slot(params, cache, tokens, length, slot, cfg: gpt.GPTConfig):
 
     x, (k_rows, v_rows) = jax.lax.scan(body, x, params["blocks"])
     # masked merge into this slot's rows [0, P): only the valid prefix
-    valid = (jnp.arange(P) < length)[None, :, None, None]
+    valid = valid_mask[..., None, None]
     for name, rows in (("k", k_rows), ("v", v_rows)):
         old = jax.lax.dynamic_slice(
             cache[name], (0, slot, 0, 0, 0),
@@ -517,8 +529,7 @@ def prefill_slot(params, cache, tokens, length, slot, cfg: gpt.GPTConfig):
         cache = dict(cache, **{name: jax.lax.dynamic_update_slice(
             cache[name], merged.astype(cache[name].dtype),
             (0, slot, 0, 0, 0))})
-    x = gpt._layer_norm(x.astype(jnp.float32), params["ln_f_g"],
-                        params["ln_f_b"]).astype(dt)
+    x = gpt._norm(x, params, "ln_f", cfg)
     last = jax.lax.dynamic_slice(x, (0, length - 1, 0),
                                  (1, 1, cfg.hidden_size))
     logits = woq.logits(last, params, dt)[0, 0]
@@ -547,15 +558,19 @@ def verify_chunk(params, cache, tokens, pos0, cfg: gpt.GPTConfig):
     dt = cfg.dtype
     B, K = tokens.shape
     H, hd = cfg.num_heads, cfg.head_dim
-    x = woq.embed(params, tokens, dt) \
-        + jax.lax.dynamic_slice(params["wpe"], (pos0, 0),
-                                (K, cfg.hidden_size)).astype(dt)[None]
+    x = woq.embed(params, tokens, dt)
+    if cfg.pos_embed == "learned":
+        x = x + jax.lax.dynamic_slice(
+            params["wpe"], (pos0, 0), (K, cfg.hidden_size)).astype(dt)[None]
 
     def body(x, layer):
         p, ck, cv = layer
-        h = gpt._layer_norm(x.astype(jnp.float32), p["ln1_g"],
-                            p["ln1_b"]).astype(dt)
+        h = gpt._norm(x, p, "ln1", cfg)
         q, k_new, v_new = gpt._project_qkv(h, p, cfg, repeat_kv=False)
+        if cfg.pos_embed == "rope":
+            chunk_pos = pos0 + jnp.arange(K)
+            q = gpt.apply_rope(q, chunk_pos)
+            k_new = gpt.apply_rope(k_new, chunk_pos)
         Hq, Hkv = H, k_new.shape[2]
         k_all = jax.lax.dynamic_update_slice(ck, k_new.astype(ck.dtype),
                                              (0, pos0, 0, 0))
@@ -581,8 +596,7 @@ def verify_chunk(params, cache, tokens, pos0, cfg: gpt.GPTConfig):
         cache["k"], k_rows.astype(cache["k"].dtype), (0, 0, pos0, 0, 0))
     new_v = jax.lax.dynamic_update_slice(
         cache["v"], v_rows.astype(cache["v"].dtype), (0, 0, pos0, 0, 0))
-    x = gpt._layer_norm(x.astype(jnp.float32), params["ln_f_g"],
-                        params["ln_f_b"]).astype(dt)
+    x = gpt._norm(x, params, "ln_f", cfg)
     logits = woq.logits(x, params, dt)
     return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
 

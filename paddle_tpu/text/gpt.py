@@ -65,6 +65,14 @@ class GPTConfig:
     # decode KV cache by num_heads/num_kv_heads.  None = MHA; 1 = MQA.
     num_kv_heads: int | None = None
     moe: Any = None  # MoEConfig → every block's FFN becomes expert-parallel
+    # Llama-family architecture switches (round-5; independent of each
+    # other and of GQA — num_kv_heads + the three below give the
+    # Llama/Mistral shape on the same GPT machinery):
+    # "learned" = trained wpe table; "rope" = rotary embeddings applied
+    # to q/k (no position table; the decode cache stores ROTATED keys)
+    pos_embed: str = "learned"
+    norm: str = "layernorm"        # "layernorm" | "rmsnorm" (gain-only)
+    activation: str = "gelu"       # "gelu" | "swiglu" (gated FFN)
 
     def __post_init__(self):
         # the invariant lives on the config, not one entry point: every
@@ -75,6 +83,18 @@ class GPTConfig:
             raise ValueError(
                 f"num_kv_heads {self.num_kv_heads} must divide num_heads "
                 f"{self.num_heads}")
+        if self.pos_embed not in ("learned", "rope"):
+            raise ValueError(f"unknown pos_embed {self.pos_embed!r}")
+        if self.norm not in ("layernorm", "rmsnorm"):
+            raise ValueError(f"unknown norm {self.norm!r}")
+        if self.activation not in ("gelu", "swiglu"):
+            raise ValueError(f"unknown activation {self.activation!r}")
+        if self.pos_embed == "rope" and self.head_dim % 2:
+            raise ValueError("rope needs an even head_dim")
+        if self.moe is not None and self.activation != "gelu":
+            raise ValueError(
+                "MoE experts use the gelu FFN; activation='swiglu' with "
+                "moe is not implemented")
 
     @property
     def head_dim(self):
@@ -110,14 +130,20 @@ def init_params(cfg: GPTConfig, key) -> dict:
         return std * jax.random.normal(k, shape, jnp.float32)
 
     blk_keys = jax.random.split(keys[9], 6)
+    # fold_in, NOT split(…, 7): widening the split would silently change
+    # blk_keys[0..5] and with them every existing config's initial
+    # weights for the same seed (split has no prefix property) — old
+    # recorded seeds must keep reproducing their models
+    gate_key = jax.random.fold_in(keys[9], 6)
     blocks = {
         "ln1_g": jnp.ones((L, D), jnp.float32),
-        "ln1_b": jnp.zeros((L, D), jnp.float32),
         "ln2_g": jnp.ones((L, D), jnp.float32),
-        "ln2_b": jnp.zeros((L, D), jnp.float32),
         "proj_w": nrm(blk_keys[1], (L, D, D), std=s / math.sqrt(2 * L)),
         "proj_b": jnp.zeros((L, D), jnp.float32),
     }
+    if cfg.norm == "layernorm":   # rmsnorm is gain-only
+        blocks["ln1_b"] = jnp.zeros((L, D), jnp.float32)
+        blocks["ln2_b"] = jnp.zeros((L, D), jnp.float32)
     if cfg.num_kv_heads is not None:
         Dkv = cfg.kv_heads * cfg.head_dim
         # GQA: q keeps the full width; k/v project to Dkv
@@ -137,6 +163,10 @@ def init_params(cfg: GPTConfig, key) -> dict:
             "out_w": nrm(blk_keys[3], (L, F, D), std=s / math.sqrt(2 * L)),
             "out_b": jnp.zeros((L, D), jnp.float32),
         })
+        if cfg.activation == "swiglu":
+            # gated FFN: down(silu(gate(x)) * up(x)) — the third matmul
+            blocks["gate_w"] = nrm(gate_key, (L, D, F))
+            blocks["gate_b"] = jnp.zeros((L, F), jnp.float32)
     else:
         from .moe import init_moe_params
 
@@ -146,11 +176,13 @@ def init_params(cfg: GPTConfig, key) -> dict:
             lambda *xs: jnp.stack(xs), *per_layer)
     params = {
         "wte": nrm(keys[0], (V, D)),
-        "wpe": nrm(keys[1], (T, D)),
         "ln_f_g": jnp.ones((D,), jnp.float32),
-        "ln_f_b": jnp.zeros((D,), jnp.float32),
         "blocks": blocks,
     }
+    if cfg.pos_embed == "learned":   # rope has no position table
+        params["wpe"] = nrm(keys[1], (T, D))
+    if cfg.norm == "layernorm":
+        params["ln_f_b"] = jnp.zeros((D,), jnp.float32)
     return params
 
 
@@ -161,14 +193,15 @@ def param_shardings(cfg: GPTConfig, dp="dp", mp="mp", pp=None, ep="ep") -> dict:
     l = pp  # leading stacked-layer axis shards over pipeline stages if set
     blocks = {
         "ln1_g": P(l, None),
-        "ln1_b": P(l, None),
         "ln2_g": P(l, None),
-        "ln2_b": P(l, None),
         "qkv_w": P(l, None, None, mp),  # column parallel (per-projection)
         "qkv_b": P(l, None, mp),
         "proj_w": P(l, mp, None),  # row parallel
         "proj_b": P(l, None),
     }
+    if cfg.norm == "layernorm":
+        blocks["ln1_b"] = P(l, None)
+        blocks["ln2_b"] = P(l, None)
     if cfg.num_kv_heads is not None:
         for k in ("qkv_w", "qkv_b"):
             del blocks[k]
@@ -183,6 +216,9 @@ def param_shardings(cfg: GPTConfig, dp="dp", mp="mp", pp=None, ep="ep") -> dict:
             "out_w": P(l, mp, None),   # row parallel
             "out_b": P(l, None),
         })
+        if cfg.activation == "swiglu":
+            blocks["gate_w"] = P(l, None, mp)   # column parallel like fc
+            blocks["gate_b"] = P(l, mp)
     else:
         from .moe import moe_param_shardings
 
@@ -190,19 +226,63 @@ def param_shardings(cfg: GPTConfig, dp="dp", mp="mp", pp=None, ep="ep") -> dict:
         blocks["moe"] = {
             k: P(l, *v) for k, v in moe_param_shardings(ep=ep, mp=mp).items()
         }
-    return {
+    out = {
         "wte": P(mp, None),          # vocab-parallel embedding
-        "wpe": P(None, None),
         "ln_f_g": P(None),
-        "ln_f_b": P(None),
         "blocks": blocks,
     }
+    if cfg.pos_embed == "learned":
+        out["wpe"] = P(None, None)
+    if cfg.norm == "layernorm":
+        out["ln_f_b"] = P(None)
+    return out
 
 
 def _layer_norm(x, g, b, eps=1e-5):
     m = jnp.mean(x, axis=-1, keepdims=True)
     v = jnp.var(x, axis=-1, keepdims=True)
     return (x - m) * jax.lax.rsqrt(v + eps) * g + b
+
+
+def _rms_norm(x, g, eps=1e-5):
+    """Gain-only RMS normalization (Llama family): no mean subtraction,
+    no bias — x * rsqrt(mean(x^2)) * g, statistics in the caller's dtype
+    (callers upcast to fp32 like _layer_norm's)."""
+    ms = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    return x * jax.lax.rsqrt(ms + eps) * g
+
+
+def _norm(x, p, prefix: str, cfg):
+    """Block-norm dispatch — THE single entry every block path (train,
+    cached decode, prefill, verify) normalizes through.  LayerNorm keeps
+    the fp32-stats/fused-kernel behavior of _ln; RMSNorm is gain-only
+    (params carry no ``<prefix>_b``) and never takes the fused-LN kernel
+    (different math)."""
+    dt = cfg.dtype
+    if cfg.norm == "rmsnorm":
+        return _rms_norm(x.astype(jnp.float32),
+                         p[prefix + "_g"]).astype(dt)
+    return _ln(x, p[prefix + "_g"], p[prefix + "_b"], dt)
+
+
+def apply_rope(x, positions, base: float = 10000.0):
+    """Rotary position embedding on [..., T, H, hd] (hd even): the
+    rotate-half convention, angles in fp32.  ``positions`` [T] int —
+    decode passes the single cache position, verify/prefill pass
+    pos0 + arange(K).  Defining property (tested): inner products depend
+    only on POSITION DIFFERENCES, which is what lets the decode cache
+    store rotated keys once and never re-rotate them."""
+    hd = x.shape[-1]
+    half = hd // 2
+    freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = positions.astype(jnp.float32)[:, None] * freqs      # [T, half]
+    cos = jnp.cos(ang)[:, None, :]                            # [T, 1, half]
+    sin = jnp.sin(ang)[:, None, :]
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin,
+                           x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
 
 
 def _ln(x, g, b, dt):
@@ -286,13 +366,24 @@ def _project_qkv(h, p, cfg: GPTConfig, repeat_kv: bool = True):
             qkv[2].reshape(B, T, H, hd))
 
 
-def _ffn_dense(x, p, cfg: GPTConfig):
-    """Residual dense FFN half of a block: x + MLP(LN(x))."""
+def _ffn_body(h, p, cfg: GPTConfig):
+    """The FFN matmuls on a normalized input — gelu MLP or SwiGLU
+    (down(silu(gate) * up)); the single implementation the train block
+    and every decode-path block share."""
     dt = cfg.dtype
-    h = _layer_norm(x.astype(jnp.float32), p["ln2_g"],
-                    p["ln2_b"]).astype(dt)
-    h = jax.nn.gelu(h @ woq.w(p, "fc_w", dt) + p["fc_b"].astype(dt))
-    return x + (h @ woq.w(p, "out_w", dt) + p["out_b"].astype(dt))
+    if cfg.activation == "swiglu":
+        gate = jax.nn.silu(h @ woq.w(p, "gate_w", dt)
+                           + p["gate_b"].astype(dt))
+        up = h @ woq.w(p, "fc_w", dt) + p["fc_b"].astype(dt)
+        h = gate * up
+    else:
+        h = jax.nn.gelu(h @ woq.w(p, "fc_w", dt) + p["fc_b"].astype(dt))
+    return h @ woq.w(p, "out_w", dt) + p["out_b"].astype(dt)
+
+
+def _ffn_dense(x, p, cfg: GPTConfig):
+    """Residual dense FFN half of a block: x + MLP(norm(x))."""
+    return x + _ffn_body(_norm(x, p, "ln2", cfg), p, cfg)
 
 
 def _ffn_tail(x, p, cfg: GPTConfig, valid=None):
@@ -308,9 +399,7 @@ def _ffn_tail(x, p, cfg: GPTConfig, valid=None):
         return _ffn_dense(x, p, cfg)
     from .moe import moe_ffn
 
-    dt = cfg.dtype
-    h = _layer_norm(x.astype(jnp.float32), p["ln2_g"],
-                    p["ln2_b"]).astype(dt)
+    h = _norm(x, p, "ln2", cfg)
     n_tokens = 1
     for d in x.shape[:-1]:
         n_tokens *= d
@@ -325,15 +414,18 @@ def _block(x, p, cfg: GPTConfig, dropout_key=None):
     H, hd = cfg.num_heads, cfg.head_dim
     dt = cfg.dtype
     drop = cfg.dropout > 0.0 and dropout_key is not None
-    h = _ln(x, p["ln1_g"], p["ln1_b"], dt)
+    h = _norm(x, p, "ln1", cfg)
     q, k, v = _project_qkv(h, p, cfg)
+    if cfg.pos_embed == "rope":
+        pos = jnp.arange(T)
+        q, k = apply_rope(q, pos), apply_rope(k, pos)
     attn = attention_array(q, k, v, is_causal=True)
     attn = attn.reshape(B, T, D)
     a = attn @ woq.w(p, "proj_w", dt) + p["proj_b"].astype(dt)
     if drop:
         a = _dropout(a, cfg.dropout, jax.random.fold_in(dropout_key, 0))
     x = x + a
-    h = _ln(x, p["ln2_g"], p["ln2_b"], dt)
+    h = _norm(x, p, "ln2", cfg)
     if cfg.moe is not None:
         from .moe import moe_ffn
 
@@ -341,8 +433,7 @@ def _block(x, p, cfg: GPTConfig, dropout_key=None):
                          key=(jax.random.fold_in(dropout_key, 2)
                               if dropout_key is not None else None))
     else:
-        h = jax.nn.gelu(h @ woq.w(p, "fc_w", dt) + p["fc_b"].astype(dt))
-        h = h @ woq.w(p, "out_w", dt) + p["out_b"].astype(dt)
+        h = _ffn_body(h, p, cfg)
         aux = jnp.zeros((), jnp.float32)
     if drop:
         h = _dropout(h, cfg.dropout, jax.random.fold_in(dropout_key, 1))
@@ -360,7 +451,9 @@ def forward_with_aux(params: dict, tokens, cfg: GPTConfig, act_sharding=None,
     key: PRNG key enabling dropout (cfg.dropout > 0); None = eval mode."""
     B, T = tokens.shape
     dt = cfg.dtype
-    x = woq.embed(params, tokens, dt) + params["wpe"][:T].astype(dt)[None]
+    x = woq.embed(params, tokens, dt)
+    if cfg.pos_embed == "learned":
+        x = x + params["wpe"][:T].astype(dt)[None]
     if act_sharding is not None:
         x = jax.lax.with_sharding_constraint(x, act_sharding)
 
@@ -390,7 +483,7 @@ def forward_with_aux(params: dict, tokens, cfg: GPTConfig, act_sharding=None,
             return blk(x, layer_params)
 
         x, aux = jax.lax.scan(scan_body, x, params["blocks"])
-    x = _ln(x, params["ln_f_g"], params["ln_f_b"], dt)
+    x = _norm(x, params, "ln_f", cfg)
     logits = woq.logits(x, params, dt)
     return logits, jnp.sum(aux)
 
@@ -426,8 +519,14 @@ def count_params(cfg: GPTConfig) -> int:
     Dkv = cfg.kv_heads * cfg.head_dim
     qkv = (D * D + D + 2 * D * Dkv + 2 * Dkv
            if cfg.num_kv_heads is not None else 3 * D * D + 3 * D)
-    per_block = 4 * D + qkv + D * D + D + D * F + F + F * D + D
-    return V * D + T * D + 2 * D + L * per_block
+    norms = 4 * D if cfg.norm == "layernorm" else 2 * D  # 2 gains (+2 biases)
+    ffn = D * F + F + F * D + D
+    if cfg.activation == "swiglu":
+        ffn += D * F + F                                  # gate matmul
+    per_block = norms + qkv + D * D + D + ffn
+    final_norm = 2 * D if cfg.norm == "layernorm" else D
+    pos = T * D if cfg.pos_embed == "learned" else 0
+    return V * D + pos + final_norm + L * per_block
 
 
 def flops_per_token(cfg: GPTConfig, seq_len: int) -> float:
@@ -443,6 +542,7 @@ def flops_per_token(cfg: GPTConfig, seq_len: int) -> float:
     Dkv = cfg.kv_heads * cfg.head_dim
     qkv_w = (D * D + 2 * D * Dkv if cfg.num_kv_heads is not None
              else 3 * D * D)
-    n_matmul = L * (qkv_w + D * D + 2 * D * F) + V * D
+    ffn_w = (3 if cfg.activation == "swiglu" else 2) * D * F
+    n_matmul = L * (qkv_w + D * D + ffn_w) + V * D
     attn = 12 * L * D * seq_len
     return 6 * n_matmul + attn

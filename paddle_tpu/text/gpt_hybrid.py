@@ -145,6 +145,17 @@ class _Parts(NamedTuple):
 
 def _pipeline_parts(cfg: gpt.GPTConfig, mesh: Mesh, dp_axis, pp_axis, mp_axis,
                     sp_axis, ep_axis="ep", sp_zigzag: bool = False) -> _Parts:
+    if (cfg.pos_embed != "learned" or cfg.norm != "layernorm"
+            or cfg.activation != "gelu"):
+        # the manual-collective blocks below hand-build the GPT
+        # architecture; this check sits in the SHARED parts builder so
+        # every entry point (build_gpt_train_step, make_pipeline_gpt_loss,
+        # make_pipeline_1f1b_grads) refuses loudly instead of dying on a
+        # missing wpe/ln bias key deep inside shard_map
+        raise NotImplementedError(
+            "pos_embed/norm/activation variants (rope/rmsnorm/swiglu) "
+            "are implemented on the GSPMD path only; use pp == 1, "
+            "sp == 1 (dp/mp/ep shard via GSPMD)")
     S = mesh.shape.get(pp_axis, 1)
     mp_size = mesh.shape.get(mp_axis, 1)
     sp_size = mesh.shape.get(sp_axis, 1)
@@ -573,6 +584,17 @@ def build_gpt_train_step(cfg: gpt.GPTConfig, mesh: Mesh, optimizer,
     dp = axes.get("dp", 1)
     sp = axes.get("sp", 1)
     ep = axes.get("ep", 1)
+    if (pp > 1 or sp > 1) and (cfg.pos_embed != "learned"
+                               or cfg.norm != "layernorm"
+                               or cfg.activation != "gelu"):
+        # early twin of _pipeline_parts' shared guard (which also covers
+        # the public make_pipeline_* entry points): refuse before any
+        # sharding work rather than silently training a DIFFERENT
+        # architecture than the config asks for
+        raise NotImplementedError(
+            "pos_embed/norm/activation variants (rope/rmsnorm/swiglu) "
+            "are implemented on the GSPMD path only; use pp == 1, "
+            "sp == 1 (dp/mp/ep shard via GSPMD)")
     if cfg.num_layers % max(pp, 1):
         raise ValueError(f"num_layers {cfg.num_layers} must divide by pp {pp}")
     if cfg.num_heads % max(mp, 1) or cfg.vocab_size % max(mp, 1):

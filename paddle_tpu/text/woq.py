@@ -34,6 +34,7 @@ _BLOCK_WEIGHTS = {
     "kv_w": 2,    # [2, D, Dkv]
     "proj_w": 1,  # [D, D]
     "fc_w": 1,    # [D, F]
+    "gate_w": 1,  # [D, F]  (swiglu third matmul)
     "out_w": 1,   # [F, D]
 }
 

@@ -57,3 +57,21 @@ def markov_gpt():
         state, loss = step_fn(state, stream(8, 31), key, 3e-3)
     assert float(loss) < 0.1, float(loss)
     return cfg, jax.device_get(state.params)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Reset jax's compilation caches after every test module.
+
+    The full suite performs thousands of XLA:CPU compiles in one
+    process; with the caches accumulating across all ~65 modules, the
+    compiler segfaulted DETERMINISTICALLY at the same late-suite compile
+    in two consecutive full runs (pytest_r05_full.log: decode_step via
+    test_serving.py::test_mixed_greedy_and_sampled_batch) while the same
+    tests pass in any shorter invocation.  Dropping the caches between
+    modules bounds the accumulated compiler state; modules re-compile
+    what they share (slightly slower, deterministic, and crash-free)."""
+    yield
+    import jax
+
+    jax.clear_caches()
