@@ -520,18 +520,121 @@ def prefill_slot(params, cache, tokens, length, slot, cfg: gpt.GPTConfig):
 
     x, (k_rows, v_rows) = jax.lax.scan(body, x, params["blocks"])
     # masked merge into this slot's rows [0, P): only the valid prefix
-    valid = valid_mask[..., None, None]
-    for name, rows in (("k", k_rows), ("v", v_rows)):
-        old = jax.lax.dynamic_slice(
-            cache[name], (0, slot, 0, 0, 0),
-            (cache[name].shape[0], 1, P) + cache[name].shape[3:])
-        merged = jnp.where(valid[None], rows[:, 0][:, None], old)
-        cache = dict(cache, **{name: jax.lax.dynamic_update_slice(
-            cache[name], merged.astype(cache[name].dtype),
-            (0, slot, 0, 0, 0))})
-    x = gpt._norm(x, params, "ln_f", cfg)
+    cache = _merge_slot_rows(cache, k_rows, v_rows, slot,
+                             jnp.asarray(0), valid_mask)
+    # slice the last valid row before the (per-row) final norm
     last = jax.lax.dynamic_slice(x, (0, length - 1, 0),
                                  (1, 1, cfg.hidden_size))
+    last = gpt._norm(last, params, "ln_f", cfg)
+    logits = woq.logits(last, params, dt)[0, 0]
+    return logits.astype(jnp.float32), cache
+
+
+def _chunk_attend_block(x, p, ck, cv, pos0, cfg: gpt.GPTConfig,
+                        valid=None):
+    """One transformer block over a K-token chunk at positions
+    [pos0, pos0+K) against a per-layer cache slice ck/cv [B, T, Hkv, hd]
+    whose rows [0, pos0) are already filled: row i attends cache rows
+    t <= pos0 + i.  THE shared body of verify_chunk and
+    prefill_slot_chunk (one copy of the chunk-attention math).
+    PRECONDITION: pos0 + K <= T — dynamic_update_slice CLAMPS start
+    indices, so an overrunning window would silently write the chunk's
+    rows at a shifted offset while the mask/positions still use pos0
+    (callers guarantee the bound; the serving walk overlaps its last
+    window instead of overrunning).  Returns (x_out, k_new, v_new)."""
+    B, K, D = x.shape
+    H, hd = cfg.num_heads, cfg.head_dim
+    dt = cfg.dtype
+    h = gpt._norm(x, p, "ln1", cfg)
+    q, k_new, v_new = gpt._project_qkv(h, p, cfg, repeat_kv=False)
+    if cfg.pos_embed == "rope":
+        chunk_pos = pos0 + jnp.arange(K)
+        q = gpt.apply_rope(q, chunk_pos)
+        k_new = gpt.apply_rope(k_new, chunk_pos)
+    Hkv = k_new.shape[2]
+    k_all = jax.lax.dynamic_update_slice(ck, k_new.astype(ck.dtype),
+                                         (0, pos0, 0, 0))
+    v_all = jax.lax.dynamic_update_slice(cv, v_new.astype(cv.dtype),
+                                         (0, pos0, 0, 0))
+    T = ck.shape[1]
+    g = H // Hkv
+    qg = q.reshape(B, K, Hkv, g, hd)
+    scores = jnp.einsum("bikgd,btkd->bkgit", qg, k_all) / jnp.sqrt(
+        jnp.asarray(hd, jnp.float32)).astype(dt)
+    # row i may see cache rows t <= pos0 + i
+    mask = (jnp.arange(T)[None, :]
+            <= pos0 + jnp.arange(K)[:, None])[None, None, None]
+    scores = jnp.where(mask, scores.astype(jnp.float32), -1e30)
+    w_ = jax.nn.softmax(scores, axis=-1).astype(dt)
+    attn = jnp.einsum("bkgit,btkd->bikgd", w_, v_all).reshape(B, K, -1)
+    a = attn @ woq.w(p, "proj_w", dt) + p["proj_b"].astype(dt)
+    return gpt._ffn_tail(x + a, p, cfg, valid=valid), k_new, v_new
+
+
+def _merge_slot_rows(cache, k_rows, v_rows, slot, pos0, valid):
+    """Masked write of per-layer chunk rows [L, 1, P, Hkv, hd] into one
+    slot's cache rows [pos0, pos0+P): only rows where ``valid`` [1, P]
+    is True are written (pads leave the old tenant's rows untouched —
+    the stale-row invariant).  Shared by prefill_slot (pos0 == 0) and
+    prefill_slot_chunk."""
+    P = k_rows.shape[2]
+    v4 = valid[..., None, None]
+    for name, rows in (("k", k_rows), ("v", v_rows)):
+        old = jax.lax.dynamic_slice(
+            cache[name], (0, slot, pos0, 0, 0),
+            (cache[name].shape[0], 1, P) + cache[name].shape[3:])
+        merged = jnp.where(v4[None], rows[:, 0][:, None], old)
+        cache = dict(cache, **{name: jax.lax.dynamic_update_slice(
+            cache[name], merged.astype(cache[name].dtype),
+            (0, slot, pos0, 0, 0))})
+    return cache
+
+
+def prefill_slot_chunk(params, cache, tokens, pos0, length, slot,
+                       cfg: gpt.GPTConfig):
+    """One FIXED-SIZE chunk of a prompt at positions [pos0, pos0+P) for
+    one serving slot — the multi-chunk admission step (round-5): long
+    prompts feed as a sequence of these, each attending the slot's
+    already-filled cache rows [0, pos0), so activation memory is bounded
+    by the chunk and ONE executable serves any prompt length (vs one
+    compile per power-of-two bucket).
+
+    tokens [1, P] int32 (pad tail beyond ``length``); ``pos0``/``length``
+    /``slot`` are traced scalars.  PRECONDITION pos0 + P <= cache rows
+    (and the wpe table) — see _chunk_attend_block; DecodeServer's walk
+    overlaps the last window rather than overrunning.  Writes cache rows
+    [pos0, pos0+length) (pads unwritten, and routed nowhere under MoE —
+    the valid mask + dropless capacity, exactly prefill_slot's rule);
+    returns (logits at the chunk's last valid position [V], cache)."""
+    dt = cfg.dtype
+    P = tokens.shape[1]
+    x = woq.embed(params, tokens, dt)
+    if cfg.pos_embed == "learned":
+        x = x + jax.lax.dynamic_slice(
+            params["wpe"], (pos0, 0), (P, cfg.hidden_size)).astype(dt)[None]
+    valid_mask = (jnp.arange(P) < length)[None, :]       # [1, P]
+    # this slot's cache rows [L, 1, T, Hkv, hd]
+    sl_k = jax.lax.dynamic_slice(
+        cache["k"], (0, slot, 0, 0, 0),
+        (cache["k"].shape[0], 1) + cache["k"].shape[2:])
+    sl_v = jax.lax.dynamic_slice(
+        cache["v"], (0, slot, 0, 0, 0),
+        (cache["v"].shape[0], 1) + cache["v"].shape[2:])
+
+    def body(x, layer):
+        p, ck, cv = layer
+        x, k_new, v_new = _chunk_attend_block(x, p, ck, cv, pos0, cfg,
+                                              valid=valid_mask)
+        return x, (k_new, v_new)
+
+    x, (k_rows, v_rows) = jax.lax.scan(
+        body, x, (params["blocks"], sl_k, sl_v))
+    cache = _merge_slot_rows(cache, k_rows, v_rows, slot, pos0, valid_mask)
+    # slice the last valid row FIRST: the final norm is per-row, so
+    # normalizing all P rows per chunk would be pure waste
+    last = jax.lax.dynamic_slice(x, (0, length - 1, 0),
+                                 (1, 1, cfg.hidden_size))
+    last = gpt._norm(last, params, "ln_f", cfg)
     logits = woq.logits(last, params, dt)[0, 0]
     return logits.astype(jnp.float32), cache
 
@@ -557,7 +660,6 @@ def verify_chunk(params, cache, tokens, pos0, cfg: gpt.GPTConfig):
     speculative_generate rejects MoE targets for exactly this reason."""
     dt = cfg.dtype
     B, K = tokens.shape
-    H, hd = cfg.num_heads, cfg.head_dim
     x = woq.embed(params, tokens, dt)
     if cfg.pos_embed == "learned":
         x = x + jax.lax.dynamic_slice(
@@ -565,30 +667,8 @@ def verify_chunk(params, cache, tokens, pos0, cfg: gpt.GPTConfig):
 
     def body(x, layer):
         p, ck, cv = layer
-        h = gpt._norm(x, p, "ln1", cfg)
-        q, k_new, v_new = gpt._project_qkv(h, p, cfg, repeat_kv=False)
-        if cfg.pos_embed == "rope":
-            chunk_pos = pos0 + jnp.arange(K)
-            q = gpt.apply_rope(q, chunk_pos)
-            k_new = gpt.apply_rope(k_new, chunk_pos)
-        Hq, Hkv = H, k_new.shape[2]
-        k_all = jax.lax.dynamic_update_slice(ck, k_new.astype(ck.dtype),
-                                             (0, pos0, 0, 0))
-        v_all = jax.lax.dynamic_update_slice(cv, v_new.astype(cv.dtype),
-                                             (0, pos0, 0, 0))
-        T = ck.shape[1]
-        g = Hq // Hkv
-        qg = q.reshape(B, K, Hkv, g, hd)
-        scores = jnp.einsum("bikgd,btkd->bkgit", qg, k_all) / jnp.sqrt(
-            jnp.asarray(hd, jnp.float32)).astype(dt)
-        # row i may see cache rows t <= pos0 + i
-        mask = (jnp.arange(T)[None, :]
-                <= pos0 + jnp.arange(K)[:, None])[None, None, None]
-        scores = jnp.where(mask, scores.astype(jnp.float32), -1e30)
-        w_ = jax.nn.softmax(scores, axis=-1).astype(dt)
-        attn = jnp.einsum("bkgit,btkd->bikgd", w_, v_all).reshape(B, K, -1)
-        a = attn @ woq.w(p, "proj_w", dt) + p["proj_b"].astype(dt)
-        return gpt._ffn_tail(x + a, p, cfg), (k_new, v_new)
+        x, k_new, v_new = _chunk_attend_block(x, p, ck, cv, pos0, cfg)
+        return x, (k_new, v_new)
 
     x, (k_rows, v_rows) = jax.lax.scan(
         body, x, (params["blocks"], cache["k"], cache["v"]))

@@ -137,6 +137,17 @@ def _get_prefill_fn(cfg: gpt.GPTConfig):
     return fn
 
 
+def _get_prefill_chunk_fn(cfg: gpt.GPTConfig):
+    k = ("prefill_chunk", generate._cfg_key(cfg))
+    fn = _STEP_CACHE.get(k)
+    if fn is None:
+        fn = jax.jit(lambda p, c, t, p0, ln, sl, _cfg=cfg:
+                     generate.prefill_slot_chunk(p, c, t, p0, ln, sl,
+                                                 _cfg))
+        _STEP_CACHE[k] = fn
+    return fn
+
+
 def _get_block_fn(cfg: gpt.GPTConfig, k: int):
     key = ("block", generate._cfg_key(cfg), k)
     fn = _STEP_CACHE.get(key)
@@ -197,7 +208,8 @@ class DecodeServer:
 
     def __init__(self, params, cfg: gpt.GPTConfig, max_batch: int,
                  max_len: int, eos_id: int | None = None,
-                 prefill: bool = True, seed: int = 0):
+                 prefill: bool = True, seed: int = 0,
+                 prefill_chunk: int | None = None):
         self.params = params
         self.cfg = cfg
         self.max_batch = max_batch
@@ -218,8 +230,23 @@ class DecodeServer:
         # MoE models prefill too (round-5): the pad mask reaches the
         # router, padding claims no expert capacity, and the chunk uses
         # the dropless capacity bound — admission routes exactly like
-        # token-by-token feeding
-        self._prefill = _get_prefill_fn(cfg) if prefill else None
+        # token-by-token feeding.
+        # prefill_chunk=N (round-5, vLLM-style): admission instead walks
+        # the prompt in FIXED N-token chunks (generate.prefill_slot_chunk,
+        # each attending the rows earlier chunks filled) — bounded
+        # activation memory and ONE executable for ANY prompt length
+        if prefill_chunk is not None:
+            window = min(max_len, cfg.max_seq_len)
+            if not 1 <= int(prefill_chunk) <= window:
+                raise ValueError(
+                    f"prefill_chunk must be in [1, {window}] "
+                    f"(the serving window), got {prefill_chunk}")
+        self._prefill = (_get_prefill_fn(cfg)
+                         if prefill and prefill_chunk is None else None)
+        self._chunk = (int(prefill_chunk) if prefill_chunk is not None
+                       else None)
+        self._prefill_chunk = (_get_prefill_chunk_fn(cfg)
+                               if prefill and self._chunk else None)
         # per-slot host state
         self._free = list(range(max_batch))
         self._slots: dict[int, dict] = {}        # slot -> request state
@@ -282,19 +309,45 @@ class DecodeServer:
                 "generated": [],
                 "pos": 0,   # next position == index of the token to feed
             }
-            if self._prefill is not None:
+            if self._prefill is not None or self._prefill_chunk is not None:
                 n = len(req["prompt"])
-                bucket = 1
-                while bucket < n:
-                    bucket *= 2
-                # the padded chunk must fit both the wpe table and the
-                # cache window; both bounds are >= n (submit checked)
-                bucket = min(bucket, self.max_len, self.cfg.max_seq_len)
-                padded = np.zeros((1, bucket), np.int32)
-                padded[0, :n] = req["prompt"]
-                logits, self.cache = self._prefill(
-                    self.params, self.cache, jnp.asarray(padded),
-                    jnp.asarray(n), jnp.asarray(slot))
+                if self._prefill is not None:
+                    bucket = 1
+                    while bucket < n:
+                        bucket *= 2
+                    # the padded chunk must fit both the wpe table and
+                    # the cache window; both bounds >= n (submit checked)
+                    bucket = min(bucket, self.max_len,
+                                 self.cfg.max_seq_len)
+                    padded = np.zeros((1, bucket), np.int32)
+                    padded[0, :n] = req["prompt"]
+                    logits, self.cache = self._prefill(
+                        self.params, self.cache, jnp.asarray(padded),
+                        jnp.asarray(n), jnp.asarray(slot))
+                else:
+                    # fixed-chunk walk: every chunk reuses ONE
+                    # executable.  The LAST window starts at n - C
+                    # (overlapping the previous chunk) instead of
+                    # overrunning the cache/wpe bounds — overlapped rows
+                    # recompute to identical values (deterministic
+                    # function of the same tokens + already-correct
+                    # prefix), and dynamic_update_slice would otherwise
+                    # CLAMP an overrunning start and silently shift the
+                    # written rows (_chunk_attend_block's precondition)
+                    C = self._chunk
+                    if n <= C:
+                        starts = [0]
+                    else:
+                        starts = list(range(0, n - C, C)) + [n - C]
+                    logits = None
+                    for i in starts:
+                        chunk = req["prompt"][i:i + C]
+                        padded = np.zeros((1, C), np.int32)
+                        padded[0, :len(chunk)] = chunk
+                        logits, self.cache = self._prefill_chunk(
+                            self.params, self.cache, jnp.asarray(padded),
+                            jnp.asarray(i), jnp.asarray(len(chunk)),
+                            jnp.asarray(slot))
                 if st["temperature"] > 0.0:
                     # admission draws host-side from the filtered law,
                     # seeded per rid off the server key — deterministic
@@ -341,6 +394,7 @@ class DecodeServer:
         self.cache = None
         self._step = None
         self._prefill = None
+        self._prefill_chunk = None
         for st in self._slots.values():
             self._dropped.add(st["rid"])
         for req in self._queue:

@@ -1,0 +1,172 @@
+"""Multi-chunk prefill (round-5): long-prompt admission in FIXED-SIZE
+chunks that attend the slot's already-filled cache rows — bounded
+activation memory and ONE compile for any prompt length (vs one compile
+per power-of-two bucket).  The vLLM-style chunked-prefill shape, built on
+the verify_chunk attention math with prefill_slot's slot select/merge."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.text import generate as G
+from paddle_tpu.text import gpt, serving
+
+
+def _cfg(**over):
+    kw = dict(vocab_size=32, hidden_size=32, num_layers=2, num_heads=4,
+              max_seq_len=64)
+    kw.update(over)
+    return gpt.GPTConfig(**kw)
+
+
+def _stepwise(params, cfg, prompt, max_len=48):
+    cache = G.init_cache(cfg, 1, max_len)
+    for pos, tok in enumerate(prompt):
+        logits, cache = G.decode_step(params, cache,
+                                      jnp.asarray([tok], jnp.int32),
+                                      pos, cfg)
+    return np.asarray(logits)[0], cache
+
+
+class TestPrefillChunk:
+    @pytest.mark.parametrize("over", [{}, dict(num_kv_heads=2),
+                                      dict(pos_embed="rope",
+                                           norm="rmsnorm",
+                                           activation="swiglu")])
+    def test_chunked_equals_stepwise(self, over):
+        """Chunks of 4 over a 10-token prompt in slot 1 of a 3-slot
+        cache: final logits and the written K rows equal stepwise
+        feeding (the chunk attends rows [0, pos0) filled by earlier
+        chunks)."""
+        cfg = _cfg(**over)
+        params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        prompt = list(rng.integers(0, cfg.vocab_size, 10))
+        want, ref_cache = _stepwise(params, cfg, prompt)
+
+        cache = G.init_cache(cfg, 3, 48)
+        C = 4
+        logits = None
+        for i in range(0, len(prompt), C):
+            chunk = prompt[i:i + C]
+            padded = np.zeros((1, C), np.int32)
+            padded[0, :len(chunk)] = chunk
+            logits, cache = G.prefill_slot_chunk(
+                params, cache, jnp.asarray(padded), jnp.asarray(i),
+                jnp.asarray(len(chunk)), jnp.asarray(1), cfg)
+        np.testing.assert_allclose(np.asarray(logits), want, rtol=2e-2,
+                                   atol=5e-3)
+        np.testing.assert_allclose(
+            np.asarray(cache["k"][:, 1, :10]),
+            np.asarray(ref_cache["k"][:, 0, :10]), rtol=2e-2, atol=5e-3)
+
+    def test_moe_chunked_equals_stepwise(self):
+        from paddle_tpu.text.moe import MoEConfig
+
+        cfg = _cfg(moe=MoEConfig(num_experts=4, top_k=2))
+        params = gpt.init_params(cfg, jax.random.PRNGKey(1))
+        rng = np.random.default_rng(1)
+        prompt = list(rng.integers(0, cfg.vocab_size, 7))
+        want, _ = _stepwise(params, cfg, prompt)
+        cache = G.init_cache(cfg, 1, 48)
+        C = 3
+        for i in range(0, len(prompt), C):
+            chunk = prompt[i:i + C]
+            padded = np.zeros((1, C), np.int32)
+            padded[0, :len(chunk)] = chunk
+            logits, cache = G.prefill_slot_chunk(
+                params, cache, jnp.asarray(padded), jnp.asarray(i),
+                jnp.asarray(len(chunk)), jnp.asarray(0), cfg)
+        np.testing.assert_allclose(np.asarray(logits), want, rtol=2e-2,
+                                   atol=5e-3)
+
+
+class TestServerChunkedAdmission:
+    def test_server_chunked_prefill_matches_solo(self):
+        """prefill_chunk=4: prompts of very different lengths admit
+        through the SAME chunk executable and serve their solo-decode
+        tokens exactly."""
+        cfg = _cfg()
+        params = gpt.init_params(cfg, jax.random.PRNGKey(2))
+        rng = np.random.default_rng(2)
+        prompts = [list(rng.integers(0, cfg.vocab_size, n))
+                   for n in (11, 3, 17)]
+        srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=40,
+                                   prefill_chunk=4)
+        rids = [srv.submit(p, max_new_tokens=6) for p in prompts]
+        ticks = 0
+        while srv.pending():
+            srv.tick()
+            ticks += 1
+            assert ticks < 100
+        for p, rid in zip(prompts, rids):
+            cache = G.init_cache(cfg, 1, 40)
+            out, tok = [], None
+            for pos in range(len(p) + 6 - 1):
+                cur = p[pos] if pos < len(p) else tok
+                lg, cache = G.decode_step(
+                    params, cache, jnp.asarray([cur], jnp.int32), pos,
+                    cfg)
+                if pos >= len(p) - 1:
+                    tok = int(np.asarray(jnp.argmax(lg, -1))[0])
+                    out.append(tok)
+            assert srv.result(rid) == out, rid
+
+    def test_one_executable_any_prompt_length(self):
+        """The whole point: N different prompt lengths, ONE chunk-prefill
+        executable in the jit cache (vs one per pow-2 bucket)."""
+        cfg = _cfg(hidden_size=48)  # fresh config: clean cache slice
+        params = gpt.init_params(cfg, jax.random.PRNGKey(3))
+        ck = G._cfg_key(cfg)
+        before = [k for k in serving._STEP_CACHE.keys()
+                  if isinstance(k, tuple) and ck in k]
+        assert not before
+        srv = serving.DecodeServer(params, cfg, max_batch=1, max_len=60,
+                                   prefill_chunk=8)
+        for n in (2, 9, 20, 33):
+            rid = srv.submit(list(np.random.default_rng(n).integers(
+                0, cfg.vocab_size, n)), max_new_tokens=2)
+            while srv.pending():
+                srv.tick()
+            assert len(srv.result(rid)) == 2
+        chunk_keys = [k for k in serving._STEP_CACHE.keys()
+                      if isinstance(k, tuple) and ck in k
+                      and k[0] == "prefill_chunk"]
+        assert len(chunk_keys) == 1, chunk_keys
+
+
+def test_last_window_never_overruns_cache():
+    """Reviewer-constructed trap: 37-token prompt, max_len 40, chunk 6 —
+    a naive walk's last window [36, 42) would exceed the cache and
+    dynamic_update_slice would CLAMP it, silently shifting rows.  The
+    server's walk overlaps the last window ([31, 37)) instead; output
+    must equal solo decode exactly."""
+    cfg = _cfg()
+    params = gpt.init_params(cfg, jax.random.PRNGKey(5))
+    rng = np.random.default_rng(5)
+    prompt = list(rng.integers(0, cfg.vocab_size, 37))
+    srv = serving.DecodeServer(params, cfg, max_batch=1, max_len=40,
+                               prefill_chunk=6)
+    rid = srv.submit(prompt, max_new_tokens=3)
+    while srv.pending():
+        srv.tick()
+    cache = G.init_cache(cfg, 1, 40)
+    out, tok = [], None
+    for pos in range(len(prompt) + 3 - 1):
+        cur = prompt[pos] if pos < len(prompt) else tok
+        lg, cache = G.decode_step(params, cache,
+                                  jnp.asarray([cur], jnp.int32), pos, cfg)
+        if pos >= len(prompt) - 1:
+            tok = int(np.asarray(jnp.argmax(lg, -1))[0])
+            out.append(tok)
+    assert srv.result(rid) == out
+
+
+def test_prefill_chunk_validation():
+    cfg = _cfg()
+    params = gpt.init_params(cfg, jax.random.PRNGKey(6))
+    for bad in (0, -1, 10_000):
+        with pytest.raises(ValueError, match="prefill_chunk"):
+            serving.DecodeServer(params, cfg, max_batch=1, max_len=32,
+                                 prefill_chunk=bad)
