@@ -218,6 +218,14 @@ def _gpt_rungs():
         ("gpt_350m_fused_dots_b8",
          dict(c350, remat=True, remat_policy="dots"), 8, 2048, 10,
          "bfloat16", 1, True),
+        # round-5 window 2 calibration: est-12.7GB rungs OOM on the real
+        # chip (HLO temps the estimate can't see) — mid-footprint fused
+        # rungs (~9-10GB est) so the walk has certified rungs that FIT
+        ("gpt_350m_fused_acc4_b8", dict(c350, remat=False), 8, 2048, 10,
+         "bfloat16", 4, True),
+        ("gpt_350m_fused_dots_acc2_b8",
+         dict(c350, remat=True, remat_policy="dots"), 8, 2048, 10,
+         "bfloat16", 2, True),
         ("gpt_1.3b_fused_remat_dots_b2",
          dict(c13, remat=True, remat_policy="dots"), 2, 2048, 10,
          "bfloat16", 1, True),
@@ -240,6 +248,15 @@ def _gpt_rungs():
         ("gpt_350m_dots_acc2_b8",
          dict(c350, remat=True, remat_policy="dots"), 8, 2048, 10,
          "bfloat16", 2, False),
+        # round-5 window 2: est-12.7GB OOMed on the chip — higher-accum
+        # dots rungs (~9 and ~7GB est) are the new ungated anchors; the
+        # non-fused logits term (10 B/elem) shrinks with micro-batch
+        ("gpt_350m_dots_acc4_b8",
+         dict(c350, remat=True, remat_policy="dots"), 8, 2048, 10,
+         "bfloat16", 4, False),
+        ("gpt_350m_dots_acc8_b8",
+         dict(c350, remat=True, remat_policy="dots"), 8, 2048, 10,
+         "bfloat16", 8, False),
         ("gpt_350m_b4", dict(c350, remat=False), 4, 2048, 10,
          "bfloat16", 1, False),
         ("gpt_350m_b2", dict(c350, remat=False), 2, 2048, 10,
@@ -348,12 +365,16 @@ def _flash_active(cfg, T) -> bool:
 def _gpt_rung_fits(cfg_kwargs, B, T, state_dtype, hbm, accum=1,
                    fused=False) -> bool:
     """Skipping a hopeless rung saves ~2 min of compile-to-OOM each.
-    With the round-4 calibrated terms the estimate is no longer a
-    systematic under-count, so the slack drops from 1.15 to 1.0 —
-    borderline rungs still get benefit of the doubt via XLA's buffer
-    reuse, which the estimate ignores in the other direction."""
+    Round-5 window-2 calibration: the est-12.7GB dots rung AND the
+    est-12.8GB 350m_b2 rung both OOMed on the real 16GB v5e — XLA's
+    buffer-assignment dump showed >2GB of HLO-temp AllocateBuffer
+    fusion scratch (2x384MB f32 + many 192MB stacks) that no static
+    activation count can see.  So the fit test is now ADDITIVE:
+    estimate + headroom <= hbm, headroom defaulting to 4GB (the
+    observed temp mass plus margin; BENCH_HEADROOM_GB overrides)."""
+    headroom = float(os.environ.get("BENCH_HEADROOM_GB", "4")) * 1e9
     return _gpt_rung_estimate(cfg_kwargs, B, T, state_dtype, accum,
-                              fused) <= 1.0 * hbm
+                              fused) + headroom <= hbm
 
 
 def _run_gpt_rung(idx: int):
@@ -455,7 +476,14 @@ def _run_rung_child(name: str, timeout: float):
             capture_output=True, text=True, timeout=timeout)
     except subprocess.TimeoutExpired:
         return None, f"{name}: timeout", True
-    sys.stderr.write(out.stderr[-4000:])
+    # head + tail: an XLA OOM's FIRST lines carry the ground truth this
+    # bench needs most ("Ran out of memory ... used X of Y hbm") while the
+    # tail is the python traceback; tail-only capture lost the Y
+    if len(out.stderr) > 4000:
+        sys.stderr.write(out.stderr[:2000] + "\n...[stderr elided]...\n"
+                         + out.stderr[-2000:])
+    else:
+        sys.stderr.write(out.stderr)
     if out.returncode == 0 and out.stdout.strip():
         return (json.loads(out.stdout.strip().splitlines()[-1]),
                 None, False)
@@ -557,14 +585,16 @@ def bench_gpt(small: bool):
 # Round-5 (VERDICT r4 Next #1): preference order for the headline-first
 # watchdog step.  Fused favorites lead when certified (they simply aren't
 # in _gpt_rungs() while FUSED_KERNELS_OK.json is absent/stale, so the walk
-# self-degrades); the non-fused dots-remat rung is the UNGATED anchor that
-# fits 16 GB without certification; the B=2 no-remat rung is the last
-# resort (smallest compile, smallest footprint).
+# self-degrades to the ungated dots-remat anchors, whose higher accum
+# keeps the non-fused logits/activation terms under the temp headroom).
 _FAST_PREFERENCE = [
-    "gpt_350m_fused_acc2_b8",
-    "gpt_350m_fused_dots_b8",
-    "gpt_350m_dots_acc2_b8",
-    "gpt_350m_b2",
+    # round-5 window 2: the acc2/b2 favorites OOMed on the chip (see
+    # _gpt_rung_fits) — lead with the mid-footprint rungs that clear the
+    # 4GB temp headroom, certified first, then the ungated anchors
+    "gpt_350m_fused_acc4_b8",
+    "gpt_350m_fused_dots_acc2_b8",
+    "gpt_350m_dots_acc4_b8",
+    "gpt_350m_dots_acc8_b8",
 ]
 
 
@@ -888,20 +918,40 @@ def bench_decode(small: bool):
         # weight read — count them all, not just the new tokens
         return B * (prompt.shape[1] + new_toks - 1) / dt
 
-    f_tok = tok_s(params)
-    q_tok = tok_s(woq.quantize_gpt_int8(params))
-    q4_tok = tok_s(woq.quantize_gpt_int4(params))
-    _log(f"[bench] gpt decode: int4 {q4_tok:,.0f} / int8 {q_tok:,.0f} / "
-         f"float {f_tok:,.0f} tok/s (B={B}, "
-         f"{cfg.num_layers}L/{cfg.hidden_size}D)")
-    return {"metric": "tokens_per_sec_decode_gpt350m_int8w",
-            "value": round(q_tok, 1), "unit": "tokens/s/chip",
-            "device": dev.platform,
-            "float_tok_s": round(f_tok, 1),
-            "int4_tok_s": round(q4_tok, 1),
-            "int8_vs_float": round(q_tok / f_tok, 3) if f_tok else None,
-            "int4_vs_float": round(q4_tok / f_tok, 3) if f_tok else None,
-            "vs_baseline": 0.0}
+    # per-arm isolation (round-5 window 2: an eager S4 convert crashed
+    # through axon and the WHOLE table was lost — one broken arm must not
+    # zero the healthy ones)
+    out = {"metric": "tokens_per_sec_decode_gpt350m_int8w",
+           "unit": "tokens/s/chip", "device": dev.platform,
+           "vs_baseline": 0.0}
+    f_tok = None
+    for arm, make in (("float", lambda: params),
+                      ("int8", lambda: woq.quantize_gpt_int8(params)),
+                      ("int4", lambda: woq.quantize_gpt_int4(params))):
+        try:
+            t = tok_s(make())
+        except Exception as e:  # noqa: BLE001 - record, keep other arms
+            _log(f"[bench] gpt decode {arm} arm failed: "
+                 f"{type(e).__name__}: {e}")
+            out[f"{arm}_error"] = f"{type(e).__name__}: {e}"[:300]
+            continue
+        out[f"{arm}_tok_s"] = round(t, 1)
+        _log(f"[bench] gpt decode {arm}: {t:,.0f} tok/s (B={B}, "
+             f"{cfg.num_layers}L/{cfg.hidden_size}D)")
+        if arm == "float":
+            f_tok = t
+        elif arm == "int8":
+            out["value"], out["value_arm"] = round(t, 1), arm
+        if f_tok and arm != "float":
+            out[f"{arm}_vs_float"] = round(t / f_tok, 3)
+    if "value" not in out:  # int8 arm died: headline whatever survived,
+        for arm in ("float", "int4"):  # SAYING which arm it was
+            if f"{arm}_tok_s" in out:
+                out["value"], out["value_arm"] = out[f"{arm}_tok_s"], arm
+                break
+        else:
+            out["value"], out["value_arm"] = 0.0, None
+    return out
 
 
 def bench_serving(small: bool):
@@ -972,27 +1022,43 @@ def bench_serving(small: bool):
         # the GENERATED rate (prompts admit in one prefill step each)
         return B * new_toks / dt
 
-    bf16_tok = tok_s(serving_tree(params))
-    int8_tok = tok_s(serving_tree(woq.quantize_gpt_int8(params)))
-    int4_tok = tok_s(serving_tree(woq.quantize_gpt_int4(params)))
-    _log(f"[bench] serving: bf16 {bf16_tok:,.0f} / int8 {int8_tok:,.0f} / "
-         f"int4 {int4_tok:,.0f} gen-tok/s (B={B}, {p_len}-in/{new_toks}-out,"
-         f" block={block})")
-    return {"metric": "tokens_per_sec_serving_gpt350m_bf16",
-            "value": round(bf16_tok, 1), "unit": "tokens/s/chip",
-            "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
-                timespec="seconds"),
-            "device": dev.platform,
-            "device_kind": str(getattr(dev, "device_kind", "")),
-            "int8_tok_s": round(int8_tok, 1),
-            "int4_tok_s": round(int4_tok, 1),
-            "int8_vs_bf16": round(int8_tok / bf16_tok, 3) if bf16_tok
-            else None,
-            "int4_vs_bf16": round(int4_tok / bf16_tok, 3) if bf16_tok
-            else None,
-            "batch": B, "prompt_len": p_len, "new_tokens": new_toks,
-            "block": block,
-            "vs_baseline": 0.0}
+    out = {"metric": "tokens_per_sec_serving_gpt350m_bf16",
+           "unit": "tokens/s/chip",
+           "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
+               timespec="seconds"),
+           "device": dev.platform,
+           "device_kind": str(getattr(dev, "device_kind", "")),
+           "batch": B, "prompt_len": p_len, "new_tokens": new_toks,
+           "block": block, "vs_baseline": 0.0}
+    bf16_tok = None
+    # per-arm isolation (round-5 window 2: the int4 arm crashed through
+    # axon and took the measured bf16/int8 numbers down with it)
+    for arm, make in (("bf16", lambda: params),
+                      ("int8", lambda: woq.quantize_gpt_int8(params)),
+                      ("int4", lambda: woq.quantize_gpt_int4(params))):
+        try:
+            t = tok_s(serving_tree(make()))
+        except Exception as e:  # noqa: BLE001 - record, keep other arms
+            _log(f"[bench] serving {arm} arm failed: "
+                 f"{type(e).__name__}: {e}")
+            out[f"{arm}_error"] = f"{type(e).__name__}: {e}"[:300]
+            continue
+        _log(f"[bench] serving {arm}: {t:,.0f} gen-tok/s (B={B}, "
+             f"{p_len}-in/{new_toks}-out, block={block})")
+        out[f"{arm}_tok_s"] = round(t, 1)
+        if arm == "bf16":
+            bf16_tok = t
+            out["value"], out["value_arm"] = round(t, 1), arm
+        elif bf16_tok:
+            out[f"{arm}_vs_bf16"] = round(t / bf16_tok, 3)
+    if "value" not in out:  # bf16 arm died: headline a survivor, labeled
+        for arm in ("int8", "int4"):
+            if f"{arm}_tok_s" in out:
+                out["value"], out["value_arm"] = out[f"{arm}_tok_s"], arm
+                break
+        else:
+            out["value"], out["value_arm"] = 0.0, None
+    return out
 
 
 _CONFIGS = {"gpt": bench_gpt, "mnist": bench_mnist, "resnet": bench_resnet,

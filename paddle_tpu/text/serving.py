@@ -236,6 +236,13 @@ class DecodeServer:
         # each attending the rows earlier chunks filled) — bounded
         # activation memory and ONE executable for ANY prompt length
         if prefill_chunk is not None:
+            if not prefill:
+                # the combination would silently degrade to token-by-token
+                # feeding — neither the bounded-memory chunks the caller
+                # asked for nor whole-prompt prefill
+                raise ValueError(
+                    "prefill_chunk requires prefill=True (chunked "
+                    "admission IS a prefill mode)")
             window = min(max_len, cfg.max_seq_len)
             if not 1 <= int(prefill_chunk) <= window:
                 raise ValueError(

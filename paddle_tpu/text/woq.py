@@ -106,14 +106,24 @@ def quantize_gpt_int4(params: dict, group_size: int = 64) -> dict:
     too coarse at 4 bits — grouping bounds each scale's dynamic range to
     ``group_size`` inputs, the standard W4 recipe).  The embedding stays
     int8 (quantize_gpt_int8's path): lookup tables are small and 4-bit
-    token vectors measurably hurt.  HBM reads drop to a quarter of bf16."""
+    token vectors measurably hurt.  HBM reads drop to a quarter of bf16.
+
+    Storage is NIBBLE-PACKED int8 — two signed 4-bit values per byte along
+    the input dim ([..., in, out] -> [..., in/2, out]), the GPTQ/AWQ
+    layout — not the jnp.int4 dtype: the TPU has no 4-bit compute (XLA
+    widens before the matmul either way), PJRT S4 buffers are not
+    supported end-to-end on every transport (an eager S4
+    convert_element_type recursed fatally through the axon tunnel,
+    round-5 window 2), and a packed byte stream is exactly the HBM-read
+    halving the format exists for.  ``w()`` unpacks with two arithmetic
+    shifts that XLA fuses into the consuming matmul's weight read."""
     def q4(w_, axis):
-        """(int4 q, grouped scale) — or per-channel int8 when the input
-        dim doesn't divide into groups."""
+        """(packed int4-pair int8, grouped scale) — or per-channel int8
+        when the input dim doesn't divide into (even-sized) groups."""
         w_ = np.asarray(w_, np.float32)
         in_axis = axis  # stacked layout: in dim sits just before out
         in_dim = w_.shape[in_axis]
-        if in_dim % group_size:
+        if in_dim % group_size or in_dim % 2:
             return _quant(w_, axis)
         G = in_dim // group_size
         shp = w_.shape
@@ -122,7 +132,14 @@ def quantize_gpt_int4(params: dict, group_size: int = 64) -> dict:
         scale = np.maximum(np.abs(grouped).max(axis=in_axis + 1,
                                                keepdims=True), 1e-8)
         q = np.clip(np.round(grouped / scale * 7.0), -7, 7)
-        return (jnp.asarray(q.reshape(shp), jnp.int4),
+        q = q.reshape(shp).astype(np.int32)
+        # pack input-dim pairs (2i -> low nibble, 2i+1 -> high nibble);
+        # 4-bit two's complement per nibble, assembled in uint8 then
+        # reinterpreted int8 so the device array is a plain byte tensor
+        pair = q.reshape(*shp[:-2], shp[-2] // 2, 2, shp[-1])
+        packed = ((pair[..., 0, :] & 0xF)
+                  | ((pair[..., 1, :] & 0xF) << 4)).astype(np.uint8)
+        return (jnp.asarray(packed.view(np.int8)),
                 jnp.asarray((scale / 7.0).astype(np.float32)))
 
     out = dict(params)
@@ -147,19 +164,25 @@ def w(p: dict, name: str, dt):
 
     Identity-cost on float params; on int8/int4 params the convert+scale
     is a fusable elementwise producer that XLA folds into the consuming
-    matmul's weight read.  Group-wise scales (int4) are recognized by
-    their extra axis: scale [..., G, 1, out] against weight [..., in,
-    out].  A low-rank adapter pair (text/lora.py: ``<name>_lora_a``
-    [..., in, r] x ``<name>_lora_b`` [..., r, out]) adds its delta after
-    dequant — so LoRA composes with a frozen float base (classic) or a
-    frozen int8/int4 base (QLoRA) through the same accessor."""
+    matmul's weight read.  Grouped scales' extra axis (scale
+    [..., G, 1, out] against weight [..., in/2, out]) marks the
+    nibble-packed int4 form (see quantize_gpt_int4): unpack is two
+    arithmetic shifts — int8 ``<< 4 >> 4`` sign-extends the low nibble,
+    ``>> 4`` the high — interleaved back to [..., in, out].  A low-rank
+    adapter pair (text/lora.py: ``<name>_lora_a`` [..., in, r] x
+    ``<name>_lora_b`` [..., r, out]) adds its delta after dequant — so
+    LoRA composes with a frozen float base (classic) or a frozen
+    int8/int4 base (QLoRA) through the same accessor."""
     arr = p[name]
-    if arr.dtype in (jnp.int8, jnp.int4):
+    if arr.dtype == jnp.int8:
         s = p[name + "_s"]
-        if s.ndim == arr.ndim + 1:  # grouped along the input dim
+        if s.ndim == arr.ndim + 1:  # grouped scales => nibble-packed int4
+            lo = jnp.right_shift(jnp.left_shift(arr, 4), 4)
+            hi = jnp.right_shift(arr, 4)
+            shp = (*arr.shape[:-2], arr.shape[-2] * 2, arr.shape[-1])
+            q = jnp.stack([lo, hi], axis=-2).reshape(shp)
             G = s.shape[-3]
-            shp = arr.shape
-            grouped = arr.reshape(*shp[:-2], G, shp[-2] // G, shp[-1])
+            grouped = q.reshape(*shp[:-2], G, shp[-2] // G, shp[-1])
             out = (grouped.astype(dt) * s.astype(dt)).reshape(shp)
         else:
             out = arr.astype(dt) * s.astype(dt)

@@ -170,3 +170,8 @@ def test_prefill_chunk_validation():
         with pytest.raises(ValueError, match="prefill_chunk"):
             serving.DecodeServer(params, cfg, max_batch=1, max_len=32,
                                  prefill_chunk=bad)
+    # contradictory: chunked admission IS a prefill mode — silently
+    # degrading to token-by-token feeding would hand the caller neither
+    with pytest.raises(ValueError, match="prefill=True"):
+        serving.DecodeServer(params, cfg, max_batch=1, max_len=32,
+                             prefill=False, prefill_chunk=8)

@@ -131,7 +131,10 @@ def test_int4_grouped_decode_close_to_float():
     cfg = _cfg(hidden_size=128)  # divisible by the 64 group size
     params = _params(cfg)
     q4 = woq.quantize_gpt_int4(params, group_size=64)
-    assert q4["blocks"]["fc_w"].dtype == jnp.int4
+    # nibble-packed storage: int8 bytes, input dim halved
+    assert q4["blocks"]["fc_w"].dtype == jnp.int8
+    assert (q4["blocks"]["fc_w"].shape[-2]
+            == params["blocks"]["fc_w"].shape[-2] // 2)
     assert q4["wte"].dtype == jnp.int8  # embeddings stay 8-bit
     # grouped scale carries the extra axis: [L, G, 1, out]
     s = q4["blocks"]["fc_w_s"]
@@ -165,7 +168,9 @@ def test_moe_expert_weights_quantize_and_decode():
     assert q8["blocks"]["moe"]["w_in"].dtype == jnp.int8
     assert q8["blocks"]["moe"]["router_w"].dtype != jnp.int8
     q4 = woq.quantize_gpt_int4(params, group_size=32)
-    assert q4["blocks"]["moe"]["w_in"].dtype == jnp.int4
+    assert q4["blocks"]["moe"]["w_in"].dtype == jnp.int8  # packed nibbles
+    assert (q4["blocks"]["moe"]["w_in"].shape[-2]
+            == params["blocks"]["moe"]["w_in"].shape[-2] // 2)
     cache = generate.init_cache(cfg, 2, 8)
     tok = jnp.asarray([3, 7], jnp.int32)
     lf, _ = generate.decode_step(params, cache, tok, 0, cfg)

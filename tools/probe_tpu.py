@@ -259,7 +259,11 @@ def _run_step(name, argv, timeout, env, out_json, log, window_opened=""):
     try:
         stdout, stderr = proc.communicate(timeout=timeout)
         rec["rc"] = proc.returncode
-        rec["stderr_tail"] = stderr[-3000:]
+        # head + tail: an XLA OOM's first lines carry "used X of Y hbm" —
+        # the number the bench's fit-calibration needs; tail-only lost it
+        rec["stderr_tail"] = (stderr if len(stderr) <= 3000 else
+                              stderr[:1500] + "\n...[elided]...\n"
+                              + stderr[-1500:])
         last = stdout.strip().splitlines()[-1] if stdout.strip() else ""
         try:
             rec["headline"] = json.loads(last)
