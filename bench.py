@@ -226,6 +226,17 @@ def _gpt_rungs():
         ("gpt_350m_fused_dots_acc2_b8",
          dict(c350, remat=True, remat_policy="dots"), 8, 2048, 10,
          "bfloat16", 2, True),
+        # fused arm of the like-for-like kernel A/B (the only 350M
+        # no-remat config whose NON-fused twin also clears the headroom)
+        ("gpt_350m_fused_acc8_b8", dict(c350, remat=False), 8, 2048, 10,
+         "bfloat16", 8, True),
+        # dots-remat fused twin of the MEASURED gpt_350m_dots_acc4_b8
+        # (MFU 0.276, window 2) — the kernel A/B pair that provably fits:
+        # no-remat non-fused twins OOM even at est 9.2GB (whole-weight
+        # scan copies the estimate can't see)
+        ("gpt_350m_fused_dots_acc4_b8",
+         dict(c350, remat=True, remat_policy="dots"), 8, 2048, 10,
+         "bfloat16", 4, True),
         ("gpt_1.3b_fused_remat_dots_b2",
          dict(c13, remat=True, remat_policy="dots"), 2, 2048, 10,
          "bfloat16", 1, True),
@@ -256,6 +267,10 @@ def _gpt_rungs():
          "bfloat16", 4, False),
         ("gpt_350m_dots_acc8_b8",
          dict(c350, remat=True, remat_policy="dots"), 8, 2048, 10,
+         "bfloat16", 8, False),
+        # non-fused no-remat twin for the kernel A/B: at Bm=1 the fp32
+        # LN chains + 10B/elem logits still fit under the temp headroom
+        ("gpt_350m_acc8_b8", dict(c350, remat=False), 8, 2048, 10,
          "bfloat16", 8, False),
         ("gpt_350m_b4", dict(c350, remat=False), 4, 2048, 10,
          "bfloat16", 1, False),
@@ -465,6 +480,29 @@ def _run_gpt_rung(idx: int):
     return out
 
 
+def extract_oom_line(stderr: str) -> str:
+    """The one stderr line that matters most for HBM calibration — "Ran
+    out of memory in memory space hbm. Used X of Y" — sits mid-dump where
+    head/tail truncation windows miss it.  Shared with
+    tools/probe_tpu.py so the match set can't drift between the two
+    capture paths."""
+    for ln in stderr.splitlines():
+        if ("Ran out of memory" in ln or "RESOURCE_EXHAUSTED" in ln
+                or "would exceed memory" in ln):
+            return ln[:500]
+    return ""
+
+
+def clip_head_tail(s: str, n: int) -> str:
+    """Head+tail windowing: an XLA error's FIRST lines carry the failure
+    class while the tail has the python traceback; tail-only loses the
+    former."""
+    if len(s) <= n:
+        return s
+    h = n // 2
+    return s[:h] + "\n...[stderr elided]...\n" + s[-h:]
+
+
 def _run_rung_child(name: str, timeout: float):
     """Run one ladder rung in a child process (OOM/hang isolation) and
     parse its JSON line.  Returns (rec_or_None, fail_reason_or_None,
@@ -476,14 +514,10 @@ def _run_rung_child(name: str, timeout: float):
             capture_output=True, text=True, timeout=timeout)
     except subprocess.TimeoutExpired:
         return None, f"{name}: timeout", True
-    # head + tail: an XLA OOM's FIRST lines carry the ground truth this
-    # bench needs most ("Ran out of memory ... used X of Y hbm") while the
-    # tail is the python traceback; tail-only capture lost the Y
-    if len(out.stderr) > 4000:
-        sys.stderr.write(out.stderr[:2000] + "\n...[stderr elided]...\n"
-                         + out.stderr[-2000:])
-    else:
-        sys.stderr.write(out.stderr)
+    oom = extract_oom_line(out.stderr)
+    if oom:
+        sys.stderr.write("[bench] OOM detail: " + oom + "\n")
+    sys.stderr.write(clip_head_tail(out.stderr, 4000))
     if out.returncode == 0 and out.stdout.strip():
         return (json.loads(out.stdout.strip().splitlines()[-1]),
                 None, False)
@@ -592,6 +626,7 @@ _FAST_PREFERENCE = [
     # _gpt_rung_fits) — lead with the mid-footprint rungs that clear the
     # 4GB temp headroom, certified first, then the ungated anchors
     "gpt_350m_fused_acc4_b8",
+    "gpt_350m_fused_dots_acc4_b8",
     "gpt_350m_fused_dots_acc2_b8",
     "gpt_350m_dots_acc4_b8",
     "gpt_350m_dots_acc8_b8",
@@ -742,6 +777,7 @@ def _layer_train_bench(name, net, X, Y, iters, lr=0.01, flops_per_step=None,
     import contextlib
 
     import jax
+    import jax.numpy as jnp
 
     from paddle_tpu import nn
     from paddle_tpu.amp import auto_cast
@@ -749,6 +785,12 @@ def _layer_train_bench(name, net, X, Y, iters, lr=0.01, flops_per_step=None,
     from paddle_tpu.optimizer import Momentum
 
     dev = jax.devices()[0]
+    # device-resident inputs: numpy args re-upload per step, and through
+    # the ~15 MB/s axon tunnel that transfer DOMINATED the measurement
+    # (round-5 window 2: ResNet-50 B=64 "measured" 2.5 s/step — 38.5 MB
+    # of fp32 images per call — while fp32 beat AMP, the transfer-bound
+    # signature; the real chip never saw a steady-state step)
+    X, Y = jnp.asarray(X), jnp.asarray(Y)
     opt = Momentum(learning_rate=lr, momentum=0.9, parameters=net.parameters())
     step = TrainStep(net, nn.functional.cross_entropy, opt)
     loss_box = {}
@@ -843,6 +885,10 @@ def bench_int8(small: bool):
         B, hw, iters, calib_n = 64, 224, 10, 2
     rng = np.random.default_rng(0)
     X = rng.standard_normal((B, 3, hw, hw), dtype=np.float32)
+    # device-resident once: a numpy X re-uploads 38 MB per call through
+    # the tunnel, swamping the inference being measured (see
+    # _layer_train_bench)
+    X = jnp.asarray(X)
     net = resnet50()
     net.eval()
 

@@ -39,9 +39,14 @@ def _row_block(N: int, F: int) -> int | None:
 
 
 def _xla_ln(x, g, b, eps):
+    # cast back: fp32 affine params promote a bf16 x to fp32, but the
+    # public contract is output dtype == x.dtype (what the Pallas path
+    # returns) — a probe-triggered mid-stack fallback must not flip the
+    # residual-stream dtype (it broke the fused GPT rungs' scan carry on
+    # the chip, round-5 window 2)
     m = jnp.mean(x, axis=-1, keepdims=True)
     v = jnp.var(x, axis=-1, keepdims=True)
-    return (x - m) * jax.lax.rsqrt(v + eps) * g + b
+    return ((x - m) * jax.lax.rsqrt(v + eps) * g + b).astype(x.dtype)
 
 
 def _probe(dtype, gdtype, bdtype, F: int, BN: int) -> bool:

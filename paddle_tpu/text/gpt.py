@@ -297,7 +297,10 @@ def _ln(x, g, b, dt):
     if os.environ.get("PADDLE_TPU_FUSED_LN", "") == "1":
         from ..ops.fused_norm import fused_layer_norm
 
-        return fused_layer_norm(x, g, b)
+        # belt-and-braces .astype(dt): the kernel returns x.dtype, which
+        # equals dt everywhere in this stack — but the residual-stream
+        # dtype is a scan-carry invariant, so enforce it at the call site
+        return fused_layer_norm(x, g, b).astype(dt)
     return _layer_norm(x.astype(jnp.float32), g, b).astype(dt)
 
 

@@ -207,10 +207,16 @@ def _payload_steps():
         # certification marker (6th tuple slot): while it is absent the
         # step is skipped WITHOUT burning an attempt — the rung doesn't
         # exist yet, which is not a failure of this step.
-        ("gpt350_fused", [py, bench, "--gpt-rung", "gpt_350m_fused_acc2_b8"],
+        # dots-remat pair (round-5 window 2, second repointing): no-remat
+        # non-fused twins OOM even at est 9.2 GB (whole-weight scan
+        # copies), so the A/B rides the config that PROVABLY runs —
+        # gpt_350m_dots_acc4_b8 measured MFU 0.276 in this window; its
+        # fused twin differs only in the LN/CE kernels
+        ("gpt350_fused",
+         [py, bench, "--gpt-rung", "gpt_350m_fused_dots_acc4_b8"],
          900, {"PADDLE_TPU_NO_FLASH": "0"},
          os.path.join(REPO, "kernel_ab_fused.json"), _fused_gate),
-        ("gpt350_nofused", [py, bench, "--gpt-rung", "gpt_350m_acc2_b8"],
+        ("gpt350_nofused", [py, bench, "--gpt-rung", "gpt_350m_dots_acc4_b8"],
          900, {"PADDLE_TPU_NO_FLASH": "0", "PADDLE_TPU_FUSED_LN": "0",
                "PADDLE_TPU_FUSED_CE": "0"},
          os.path.join(REPO, "kernel_ab_nofused.json"), None),
@@ -259,11 +265,17 @@ def _run_step(name, argv, timeout, env, out_json, log, window_opened=""):
     try:
         stdout, stderr = proc.communicate(timeout=timeout)
         rec["rc"] = proc.returncode
-        # head + tail: an XLA OOM's first lines carry "used X of Y hbm" —
-        # the number the bench's fit-calibration needs; tail-only lost it
-        rec["stderr_tail"] = (stderr if len(stderr) <= 3000 else
-                              stderr[:1500] + "\n...[elided]...\n"
-                              + stderr[-1500:])
+        # shared truncation + OOM-line extraction with bench._run_rung_child
+        # (one match set, one windowing policy — they must not drift);
+        # bench.py is jax-free at import, safe in the probe parent
+        if REPO not in sys.path:
+            sys.path.insert(0, REPO)
+        from bench import clip_head_tail, extract_oom_line
+
+        rec["stderr_tail"] = clip_head_tail(stderr, 3000)
+        oom = extract_oom_line(stderr)
+        if oom:
+            rec["oom_line"] = oom
         last = stdout.strip().splitlines()[-1] if stdout.strip() else ""
         try:
             rec["headline"] = json.loads(last)
