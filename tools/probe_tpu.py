@@ -34,6 +34,14 @@ import time
 LOG = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                    "tpu_probe_log.jsonl")
 
+# shared stderr truncation + OOM-line extraction with bench._run_rung_child
+# (one match set, one windowing policy — they must not drift).  Imported at
+# module top so a bench.py import-time regression fails the watchdog at
+# START, not mid-window after a step record was collected; bench.py is
+# deliberately jax-free at import.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from bench import clip_head_tail, extract_oom_line  # noqa: E402
+
 _CODE = (
     "import jax, json; import jax.numpy as jnp;"
     " d = jax.devices()[0];"
@@ -265,13 +273,6 @@ def _run_step(name, argv, timeout, env, out_json, log, window_opened=""):
     try:
         stdout, stderr = proc.communicate(timeout=timeout)
         rec["rc"] = proc.returncode
-        # shared truncation + OOM-line extraction with bench._run_rung_child
-        # (one match set, one windowing policy — they must not drift);
-        # bench.py is jax-free at import, safe in the probe parent
-        if REPO not in sys.path:
-            sys.path.insert(0, REPO)
-        from bench import clip_head_tail, extract_oom_line
-
         rec["stderr_tail"] = clip_head_tail(stderr, 3000)
         oom = extract_oom_line(stderr)
         if oom:
