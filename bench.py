@@ -419,7 +419,10 @@ def _gpt_rung_fits(name, cfg_kwargs, B, T, state_dtype, hbm, accum=1,
     _gpt_rung_estimate, each anchored to a measured "Used X of Y hbm"
     line.  Rungs in _PROVEN_FIT bypass the estimate, but ONLY on a chip
     at least as large as the 15.75GiB v5e the proof was measured on."""
-    if name in _PROVEN_FIT and hbm >= 16.5e9:
+    # 15.9e9 not 16.9e9: every legacy wrapper exports BENCH_HBM_GB=16
+    # (the old default) to MEAN "the v5e" — that spelling must not veto
+    # the rungs proven on that exact chip
+    if name in _PROVEN_FIT and hbm >= 15.9e9:
         return True
     headroom = float(os.environ.get("BENCH_HEADROOM_GB", "2")) * 1e9
     return _gpt_rung_estimate(cfg_kwargs, B, T, state_dtype, accum,

@@ -26,7 +26,7 @@ def bench(monkeypatch):
     monkeypatch.setattr(m, "_hbm_bytes", lambda: 16e9)
     monkeypatch.setattr(
         m, "_gpt_rung_fits",
-        lambda cfg_kwargs, B, T, sd, hbm, accum=1, fused=False: True)
+        lambda name, cfg_kwargs, B, T, sd, hbm, accum=1, fused=False: True)
     monkeypatch.delenv("BENCH_LADDER_TOP", raising=False)
     monkeypatch.delenv("BENCH_RUNG_TIMEOUT", raising=False)
     return m
@@ -129,25 +129,66 @@ def test_top_k_env_override(bench, monkeypatch):
 
 def test_unfit_rungs_are_skipped_entirely(bench, monkeypatch):
     bench._gpt_rung_fits = (
-        lambda cfg_kwargs, B, T, sd, hbm, accum=1, fused=False: False)
+        lambda name, cfg_kwargs, B, T, sd, hbm, accum=1, fused=False: False)
     _rungs(bench, monkeypatch, ["a"])
     _child_results(bench, monkeypatch, {})
     with pytest.raises(RuntimeError):
         bench.bench_gpt(small=False)
 
 
-def test_new_fused_rungs_exist_and_fit_16gb(bench):
-    """The v5e tournament candidates must stay in the ladder AND stay
-    under the calibrated 16 GB estimate (the whole point of adding them)."""
-    # marker-independent: query the list with the fused gate forced open
+def test_calibrated_walk_matches_on_device_outcomes():
+    """The round-5 window-2 ground truth, frozen as a test: every rung
+    PROVEN to run on the 15.75GiB v5e is admitted by the walk, every
+    rung that OOMed there ("Used 29.05G / 20.26G of 15.75G hbm") is
+    excluded, and the proven-fit bypass is void on smaller chips.
+
+    Loads its own module copy: the shared fixture stubs _gpt_rung_fits
+    to always-True, which is exactly what this test must NOT use."""
+    spec = importlib.util.spec_from_file_location(
+        "bench_calibration_test", os.path.join(REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
     bench._fused_kernels_ok = lambda: True
     rungs = {r[0]: r for r in bench._gpt_rungs()}
-    for name in ("gpt_350m_fused_acc2_b8", "gpt_760m_fused_dots_acc4_b8",
-                 "gpt_350m_fused_dots_b8"):
-        assert name in rungs, name
+    hbm = 16.9e9  # 15.75 GiB in decimal bytes
+
+    def fits(name, hbm_b=hbm):
         _, kw, B, T, _, sd, accum, fused = rungs[name]
-        est = bench._gpt_rung_estimate(kw, B, T, sd, accum, fused)
-        assert est <= 16e9, (name, est)
+        return bench._gpt_rung_fits(name, kw, B, T, sd, hbm_b, accum,
+                                    fused)
+
+    ran = ["gpt_760m_fused_dots_acc16_b16", "gpt_760m_fused_dots_acc8_b8",
+           "gpt_350m_fused_dots_acc4_b8", "gpt_350m_dots_acc4_b8",
+           "gpt_350m_dots_acc8_b8", "gpt_350m_remat_b8"]
+    oomed = ["gpt_350m_fused_acc2_b8", "gpt_350m_fused_dots_acc2_b8",
+             "gpt_350m_dots_acc2_b8", "gpt_350m_b2"]
+    for name in ran:
+        assert fits(name), name
+    for name in oomed:
+        assert not fits(name), name
+    # empirical proof is chip-specific: an 8GB part gets the estimate
+    for name in ran:
+        assert not fits(name, 8e9), name
+    # the proof is keyed by NAME but holds for a specific CONFIG: freeze
+    # the shape of every proven rung so an edit under the same name
+    # can't silently ride the bypass into a compile-to-OOM
+    frozen = {
+        "gpt_760m_fused_dots_acc16_b16": (1536, 24, 16, 2048, 16, True,
+                                          "dots"),
+        "gpt_760m_fused_dots_acc8_b8": (1536, 24, 8, 2048, 8, True,
+                                        "dots"),
+        "gpt_350m_fused_dots_acc4_b8": (1024, 24, 8, 2048, 4, True,
+                                        "dots"),
+        "gpt_350m_dots_acc4_b8": (1024, 24, 8, 2048, 4, False, "dots"),
+        "gpt_350m_dots_acc8_b8": (1024, 24, 8, 2048, 8, False, "dots"),
+        "gpt_350m_remat_b8": (1024, 24, 8, 2048, 1, False, None),
+    }
+    assert set(frozen) == set(bench._PROVEN_FIT)
+    for name, (h, L, B, T, accum, fused, policy) in frozen.items():
+        _, kw, rb, rt, _, _, raccum, rfused = rungs[name]
+        assert (kw["hidden_size"], kw["num_layers"], rb, rt, raccum,
+                rfused, kw.get("remat_policy")) == (h, L, B, T, accum,
+                                                    fused, policy), name
 
 
 def test_prefer_ladder_headline_reorders_walk(bench, monkeypatch):
