@@ -136,7 +136,7 @@ def test_unfit_rungs_are_skipped_entirely(bench, monkeypatch):
         bench.bench_gpt(small=False)
 
 
-def test_calibrated_walk_matches_on_device_outcomes():
+def test_calibrated_walk_matches_on_device_outcomes(monkeypatch):
     """The round-5 window-2 ground truth, frozen as a test: every rung
     PROVEN to run on the 15.75GiB v5e is admitted by the walk, every
     rung that OOMed there ("Used 29.05G / 20.26G of 15.75G hbm") is
@@ -144,6 +144,9 @@ def test_calibrated_walk_matches_on_device_outcomes():
 
     Loads its own module copy: the shared fixture stubs _gpt_rung_fits
     to always-True, which is exactly what this test must NOT use."""
+    # hermetic: an ambient BENCH_HEADROOM_GB export (natural when
+    # experimenting with the pre-filter) must not flip the frozen facts
+    monkeypatch.delenv("BENCH_HEADROOM_GB", raising=False)
     spec = importlib.util.spec_from_file_location(
         "bench_calibration_test", os.path.join(REPO, "bench.py"))
     bench = importlib.util.module_from_spec(spec)

@@ -94,19 +94,27 @@ def main():
     # without the Pallas kernels (watchdog steps gpt350_fused/_nofused)
     ab_on = _load("kernel_ab_fused.json")
     ab_off = _load("kernel_ab_nofused.json")
-    # the two files persist across commits: verify they are the claimed
-    # rungs in the claimed fused-states before pairing them (mirrors the
-    # flash ablation's configs_match guard)
-    if ab_on and not (
-            ab_on.get("fused_kernels") is True
-            and ab_on.get("metric", "").endswith("gpt_350m_fused_acc2_b8")
-            and ab_on.get("device") in ("tpu", "axon")):
+    # the two files persist across commits: verify they are a genuine
+    # like-for-like pair in the claimed fused-states before pairing them
+    # (mirrors the flash ablation's configs_match guard).  Structural,
+    # not name-pinned (the A/B config has been repointed once already —
+    # round-5 window 2 moved it from the OOMing acc2 pair to dots acc4):
+    # the metrics must differ ONLY by the "fused_" tag and agree on
+    # accum + remat policy.
+    if ab_on and not (ab_on.get("fused_kernels") is True
+                      and ab_on.get("device") in ("tpu", "axon")):
         ab_on = None
-    if ab_off and not (
-            ab_off.get("fused_kernels") is False
-            and ab_off.get("metric", "").endswith("gpt_350m_acc2_b8")
-            and ab_off.get("device") in ("tpu", "axon")):
+    if ab_off and not (ab_off.get("fused_kernels") is False
+                       and ab_off.get("device") in ("tpu", "axon")):
         ab_off = None
+    if ab_on and ab_off:
+        same_config = (
+            ab_on.get("metric", "").replace("fused_", "")
+            == ab_off.get("metric", "")
+            and ab_on.get("accum") == ab_off.get("accum")
+            and ab_on.get("remat_policy") == ab_off.get("remat_policy"))
+        if not same_config:
+            ab_on = ab_off = None
     if ab_on and ab_off:
         report["fused_kernel_ablation"] = {
             # label derived from the measured record, not restated by hand
