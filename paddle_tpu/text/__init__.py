@@ -1,5 +1,6 @@
 """paddle_tpu.text — language models (GPT flagship, BERT, MoE) + datasets."""
 from . import bert  # noqa: F401
+from . import ernie  # noqa: F401
 from . import gpt  # noqa: F401
 from . import gpt_hybrid  # noqa: F401
 from . import datasets  # noqa: F401

@@ -1,0 +1,101 @@
+"""ERNIE: knowledge-masked BERT pretraining (Baidu's flagship NLP family).
+
+The BASELINE north star names "GPT-3/ERNIE 1.3B" as the model pair the
+framework must train.  Architecturally ERNIE 1.0 IS the BERT encoder
+(text/bert.py) — its contribution is the MASKING STRATEGY: instead of
+masking independent word-piece positions, whole *knowledge units*
+(phrases, named entities) are masked atomically, forcing the model to
+recover them from context rather than from the unit's other pieces.
+ERNIE's reference implementation lives outside the Paddle core repo; the
+snapshot at /root/reference ships only the framework that trains it, so
+this module provides the same capability the TPU-first way: a pure
+data-side masking transform feeding the existing jitted BERT pretrain
+step (bert.pretrain_loss — one XLA program, MXU matmuls, no new model
+code to maintain).
+
+Usage:
+    cfg = ernie.ernie_base()
+    batch = ernie.knowledge_mask(tokens, spans, key, cfg)  # host side
+    loss = bert.pretrain_loss(params, batch, cfg, key)     # jitted step
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .bert import BertConfig
+
+MASK_ID = 3          # ERNIE vocab convention: [MASK]
+NUM_SPECIAL = 4      # PAD/UNK/CLS/MASK — excluded from random replacement
+IGNORE = -100        # unmasked positions in mlm_labels
+
+
+def ernie_base() -> BertConfig:
+    """ERNIE 1.0 base: BERT-base geometry over the 18k Chinese-char
+    vocab (model/ernie config in the public release)."""
+    return BertConfig(vocab_size=18000, hidden_size=768, num_layers=12,
+                      num_heads=12, max_seq_len=513)
+
+
+def ernie_large() -> BertConfig:
+    return BertConfig(vocab_size=18000, hidden_size=1024, num_layers=24,
+                      num_heads=16, max_seq_len=513)
+
+
+def knowledge_mask(tokens, spans, key, cfg: BertConfig, *,
+                   mask_rate: float = 0.15, max_predictions: int = 76,
+                   nsp_labels=None):
+    """Whole-span MLM batch from tokens [B, T] + knowledge spans.
+
+    ``spans`` is a list (len B) of ``(start, end)`` half-open unit
+    boundaries per sequence — word/phrase/entity segmentation from the
+    host-side pipeline (basic-level units are single-token spans, so the
+    classic BERT scheme is the degenerate case).  Units are sampled
+    WITHOUT splitting until ~``mask_rate`` of tokens are covered; each
+    chosen unit is masked ATOMICALLY with the standard 80/10/10
+    mask/keep/random-replace split applied per UNIT (the whole unit gets
+    one treatment — replacing half an entity would leak its identity).
+
+    Pure numpy on the host (data pipeline territory — the reference
+    feeds masked batches through DataFeed the same way); the returned
+    dict is ``bert.pretrain_loss``'s batch contract with fixed-shape
+    [B, max_predictions] mlm tensors, so ONE jitted step serves every
+    batch.  ``key`` is a numpy Generator or int seed.
+    """
+    rng = (key if isinstance(key, np.random.Generator)
+           else np.random.default_rng(key))
+    toks = np.asarray(tokens)
+    B, T = toks.shape
+    out = toks.copy()
+    mlm_pos = np.zeros((B, max_predictions), np.int32)
+    mlm_lab = np.full((B, max_predictions), IGNORE, np.int64)
+    budget = max(1, int(round(mask_rate * T)))
+    for b in range(B):
+        units = [(s, e) for s, e in spans[b] if 0 <= s < e <= T]
+        order = rng.permutation(len(units))
+        covered = 0
+        k = 0
+        for ui in order:
+            s, e = units[ui]
+            if covered >= budget or k + (e - s) > max_predictions:
+                continue
+            # one draw per UNIT: 80% mask, 10% keep, 10% random token
+            r = rng.random()
+            for t in range(s, e):
+                mlm_pos[b, k] = t
+                mlm_lab[b, k] = toks[b, t]
+                k += 1
+                if r < 0.8:
+                    out[b, t] = MASK_ID
+                elif r < 0.9:
+                    # replacement pool excludes special ids: drawing
+                    # MASK_ID here would mix [MASK] into a "replaced"
+                    # unit, breaking the one-treatment-per-unit invariant
+                    out[b, t] = rng.integers(NUM_SPECIAL, cfg.vocab_size)
+            covered += e - s
+    return {
+        "input_ids": out,
+        "mlm_positions": mlm_pos,
+        "mlm_labels": mlm_lab,
+        "nsp_labels": (np.zeros((B,), np.int64) if nsp_labels is None
+                       else np.asarray(nsp_labels, np.int64)),
+    }

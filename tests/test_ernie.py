@@ -1,0 +1,101 @@
+"""ERNIE knowledge masking over the BERT encoder (text/ernie.py).
+
+The masking transform is the capability: whole knowledge units mask
+ATOMICALLY (replacing half an entity leaks its identity), the batch dict
+satisfies bert.pretrain_loss's contract bit-for-bit, and a jitted
+pretrain step trains on span-masked batches.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.text import bert, ernie
+
+
+def _spans(B, T, rng, unit=3):
+    """Non-overlapping unit segmentation covering [0, T)."""
+    spans = []
+    for _ in range(B):
+        cuts = [0]
+        while cuts[-1] < T:
+            cuts.append(min(T, cuts[-1] + int(rng.integers(1, unit + 1))))
+        spans.append(list(zip(cuts[:-1], cuts[1:])))
+    return spans
+
+
+def test_units_mask_atomically_and_budget_respected():
+    cfg = ernie.ernie_base()
+    rng = np.random.default_rng(0)
+    B, T = 4, 64
+    toks = rng.integers(10, cfg.vocab_size, (B, T))
+    spans = _spans(B, T, rng)
+    batch = ernie.knowledge_mask(toks, spans, 1, cfg)
+    for b in range(B):
+        labelled = {int(p) for p, l in zip(batch["mlm_positions"][b],
+                                           batch["mlm_labels"][b])
+                    if l != ernie.IGNORE}
+        assert labelled, "some units must be chosen"
+        # ~15% budget with one-unit overshoot tolerance
+        assert len(labelled) <= int(0.15 * T) + 3
+        # atomicity: a unit is labelled all-or-nothing
+        for s, e in spans[b]:
+            inside = [t in labelled for t in range(s, e)]
+            assert all(inside) or not any(inside), (b, s, e)
+        # labels preserve the ORIGINAL token at every labelled position
+        for p, l in zip(batch["mlm_positions"][b], batch["mlm_labels"][b]):
+            if l != ernie.IGNORE:
+                assert l == toks[b, p]
+        # unlabelled positions pass through unchanged
+        for t in range(T):
+            if t not in labelled:
+                assert batch["input_ids"][b, t] == toks[b, t]
+
+
+def test_masked_unit_gets_one_treatment():
+    """80/10/10 is drawn per UNIT: within one masked unit, either every
+    position is [MASK], or every position kept/replaced — never a mix of
+    [MASK] and original (that's the leak ERNIE exists to prevent)."""
+    cfg = ernie.ernie_base()
+    rng = np.random.default_rng(1)
+    B, T = 8, 60
+    toks = rng.integers(10, cfg.vocab_size, (B, T))
+    spans = _spans(B, T, rng, unit=4)
+    batch = ernie.knowledge_mask(toks, spans, 2, cfg)
+    for b in range(B):
+        labelled = {int(p) for p, l in zip(batch["mlm_positions"][b],
+                                           batch["mlm_labels"][b])
+                    if l != ernie.IGNORE}
+        for s, e in spans[b]:
+            if e - s < 2 or s not in labelled:
+                continue
+            unit_masked = [batch["input_ids"][b, t] == ernie.MASK_ID
+                           for t in range(s, e)]
+            assert all(unit_masked) or not any(unit_masked), (b, s, e)
+
+
+def test_pretrain_step_trains_on_knowledge_masked_batches():
+    cfg = bert.BertConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                          num_heads=2, max_seq_len=32)
+    params = bert.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    B, T = 4, 32
+    toks = rng.integers(10, cfg.vocab_size, (B, T))
+    spans = _spans(B, T, rng)
+
+    @jax.jit
+    def loss_and_grad(p, batch):
+        def f(p_):
+            return bert.pretrain_loss(p_, batch, cfg)
+        return jax.value_and_grad(f)(p)
+
+    batch = {k: jnp.asarray(v)
+             for k, v in ernie.knowledge_mask(toks, spans, 3, cfg).items()}
+    l0, g = loss_and_grad(params, batch)
+    lr = 0.1
+    for i in range(12):
+        batch = {k: jnp.asarray(v) for k, v in
+                 ernie.knowledge_mask(toks, spans, 100 + i, cfg).items()}
+        l, g = loss_and_grad(params, batch)
+        params = jax.tree_util.tree_map(lambda p, gg: p - lr * gg,
+                                        params, g)
+    assert float(l) < float(l0), (float(l0), float(l))

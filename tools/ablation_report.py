@@ -101,18 +101,37 @@ def main():
     # round-5 window 2 moved it from the OOMing acc2 pair to dots acc4):
     # the metrics must differ ONLY by the "fused_" tag and agree on
     # accum + remat policy.
-    if ab_on and not (ab_on.get("fused_kernels") is True
-                      and ab_on.get("device") in ("tpu", "axon")):
-        ab_on = None
-    if ab_off and not (ab_off.get("fused_kernels") is False
-                       and ab_off.get("device") in ("tpu", "axon")):
-        ab_off = None
+    def _fresh_arm(rec, want_fused):
+        """Same 48h ts gate as the noflash arm — these files persist
+        across commits, and the structural check alone would happily
+        pair a months-old measurement with today's."""
+        if not rec:
+            return None
+        import datetime
+        try:
+            age = (datetime.datetime.now(datetime.timezone.utc)
+                   - datetime.datetime.fromisoformat(rec["ts"])
+                   ).total_seconds()
+        except (KeyError, ValueError, TypeError):
+            return None
+        ok = (rec.get("fused_kernels") is want_fused
+              and rec.get("device") in ("tpu", "axon")
+              and age < 48 * 3600)
+        return rec if ok else None
+
+    ab_on = _fresh_arm(ab_on, True)
+    ab_off = _fresh_arm(ab_off, False)
     if ab_on and ab_off:
+        # key PRESENCE is part of the check: old-schema records missing
+        # metric/accum/remat_policy must not pass vacuously (None==None),
+        # and the fused arm's metric must actually carry the tag
         same_config = (
-            ab_on.get("metric", "").replace("fused_", "")
-            == ab_off.get("metric", "")
-            and ab_on.get("accum") == ab_off.get("accum")
-            and ab_on.get("remat_policy") == ab_off.get("remat_policy"))
+            all(k in ab_on and k in ab_off
+                for k in ("metric", "accum", "remat_policy"))
+            and "fused_" in ab_on["metric"]
+            and ab_on["metric"].replace("fused_", "") == ab_off["metric"]
+            and ab_on["accum"] == ab_off["accum"]
+            and ab_on["remat_policy"] == ab_off["remat_policy"])
         if not same_config:
             ab_on = ab_off = None
     if ab_on and ab_off:
