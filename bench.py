@@ -540,6 +540,86 @@ def clip_head_tail(s: str, n: int) -> str:
     return s[:h] + "\n...[stderr elided]...\n" + s[-h:]
 
 
+def _arms_isolated(dev) -> bool:
+    """True when decode/serving arms run as subprocesses — ALSO consulted
+    by the bench fns before building the shared param tree, which only
+    in-process arms (and --arm children) use: on tpu the ~1.4GB fp32
+    init + host device_get would cost ~90s of tunnel time per bench for
+    a tree the children rebuild themselves anyway."""
+    return (dev.platform in ("tpu", "axon")
+            and os.environ.get("BENCH_ARM_ISOLATE", "1") == "1"
+            and not os.environ.get("BENCH_ARM"))
+
+
+def _arm_results(config_name, arm_names, measure_inproc, small, dev):
+    """Per-arm isolation shared by bench_decode/bench_serving: returns
+    ``{arm: {"tok_s": N} | {"error": msg}}``.
+
+    On TPU each arm runs in its OWN subprocess (``--arm config:arm``)
+    with a timeout: a crashed arm must not zero the healthy ones
+    (round-5: an eager S4 convert crashed through axon and took the
+    whole serving table down) and a HUNG arm must not stall the window
+    (round-5: the decode config wedged mid ``--all`` and burned the
+    step's 7200s budget).  Off-TPU (tests, smoke) arms run in-process —
+    same behavior, no process-spawn noise."""
+    isolate = _arms_isolated(dev)
+    timeout = float(os.environ.get("BENCH_ARM_TIMEOUT", "600"))
+    res = {}
+    for arm in arm_names:
+        if not isolate:
+            try:
+                res[arm] = {"tok_s": measure_inproc(arm)}
+            except Exception as e:  # noqa: BLE001 - record, keep others
+                res[arm] = {"error": f"{type(e).__name__}: {e}"[:300]}
+            continue
+        argv = ([sys.executable, os.path.abspath(__file__),
+                 "--arm", f"{config_name}:{arm}"]
+                + (["--small"] if small else []))
+        try:
+            out = subprocess.run(argv, capture_output=True, text=True,
+                                 timeout=timeout)
+        except subprocess.TimeoutExpired:
+            res[arm] = {"error": f"timeout after {timeout:.0f}s "
+                                 f"(hung arm killed)"}
+            continue
+        if out.returncode == 0 and out.stdout.strip():
+            try:
+                res[arm] = json.loads(out.stdout.strip().splitlines()[-1])
+                continue
+            except (json.JSONDecodeError, ValueError):
+                pass
+        res[arm] = {"error": (extract_oom_line(out.stderr)
+                              or f"rc={out.returncode}: "
+                                 f"{out.stderr[-200:]}")[:300]}
+    return res
+
+
+def _assemble_arm_record(out, res, arm_names, ratio_ref, headline_arm,
+                         log_of):
+    """Fold per-arm results into the bench record: ``{arm}_tok_s`` /
+    ``{arm}_error`` fields, ``{arm}_vs_{ratio_ref}`` ratios, and a
+    headline value that names which arm it came from when the preferred
+    headline arm died."""
+    ref = res.get(ratio_ref, {}).get("tok_s")
+    for arm in arm_names:
+        r = res.get(arm, {})
+        if "tok_s" in r:
+            out[f"{arm}_tok_s"] = round(r["tok_s"], 1)
+            _log(f"[bench] {log_of} {arm}: {r['tok_s']:,.0f} tok/s")
+            if arm != ratio_ref and ref:
+                out[f"{arm}_vs_{ratio_ref}"] = round(r["tok_s"] / ref, 3)
+        else:
+            _log(f"[bench] {log_of} {arm} arm failed: {r.get('error')}")
+            out[f"{arm}_error"] = r.get("error", "unknown")
+    for arm in (headline_arm, *arm_names):
+        if f"{arm}_tok_s" in out:
+            out["value"], out["value_arm"] = out[f"{arm}_tok_s"], arm
+            break
+    else:
+        out["value"], out["value_arm"] = 0.0, None
+    return out
+
+
 def _run_rung_child(name: str, timeout: float):
     """Run one ladder rung in a child process (OOM/hang isolation) and
     parse its JSON line.  Returns (rec_or_None, fail_reason_or_None,
@@ -1012,7 +1092,11 @@ def bench_decode(small: bool):
         cfg = gpt.GPTConfig(vocab_size=50304, hidden_size=1024,
                             num_layers=24, num_heads=16, max_seq_len=2048)
         B, new_toks, iters = 8, 64, 3
-    params = jax.device_get(gpt.init_params(cfg, jax.random.PRNGKey(0)))
+    # skipped under isolation: subprocess arms rebuild their own trees,
+    # and this ~1.4GB init + host fetch is ~90s of tunnel time
+    params = (None if _arms_isolated(dev)
+              else jax.device_get(gpt.init_params(cfg,
+                                                  jax.random.PRNGKey(0))))
     prompt = jnp.asarray(np.random.default_rng(0).integers(
         0, cfg.vocab_size, (B, 8)), jnp.int32)
     key = jax.random.PRNGKey(1)
@@ -1030,40 +1114,19 @@ def bench_decode(small: bool):
         # weight read — count them all, not just the new tokens
         return B * (prompt.shape[1] + new_toks - 1) / dt
 
-    # per-arm isolation (round-5 window 2: an eager S4 convert crashed
-    # through axon and the WHOLE table was lost — one broken arm must not
-    # zero the healthy ones)
+    makers = {"float": lambda: params,
+              "int8": lambda: woq.quantize_gpt_int8(params),
+              "int4": lambda: woq.quantize_gpt_int4(params)}
+    sel = os.environ.get("BENCH_ARM")
+    if sel:  # child mode: one arm, one JSON line (see _arm_results)
+        return {"arm": sel, "tok_s": tok_s(makers[sel]())}
     out = {"metric": "tokens_per_sec_decode_gpt350m_int8w",
            "unit": "tokens/s/chip", "device": dev.platform,
            "vs_baseline": 0.0}
-    f_tok = None
-    for arm, make in (("float", lambda: params),
-                      ("int8", lambda: woq.quantize_gpt_int8(params)),
-                      ("int4", lambda: woq.quantize_gpt_int4(params))):
-        try:
-            t = tok_s(make())
-        except Exception as e:  # noqa: BLE001 - record, keep other arms
-            _log(f"[bench] gpt decode {arm} arm failed: "
-                 f"{type(e).__name__}: {e}")
-            out[f"{arm}_error"] = f"{type(e).__name__}: {e}"[:300]
-            continue
-        out[f"{arm}_tok_s"] = round(t, 1)
-        _log(f"[bench] gpt decode {arm}: {t:,.0f} tok/s (B={B}, "
-             f"{cfg.num_layers}L/{cfg.hidden_size}D)")
-        if arm == "float":
-            f_tok = t
-        elif arm == "int8":
-            out["value"], out["value_arm"] = round(t, 1), arm
-        if f_tok and arm != "float":
-            out[f"{arm}_vs_float"] = round(t / f_tok, 3)
-    if "value" not in out:  # int8 arm died: headline whatever survived,
-        for arm in ("float", "int4"):  # SAYING which arm it was
-            if f"{arm}_tok_s" in out:
-                out["value"], out["value_arm"] = out[f"{arm}_tok_s"], arm
-                break
-        else:
-            out["value"], out["value_arm"] = 0.0, None
-    return out
+    res = _arm_results("decode", list(makers), lambda a: tok_s(makers[a]()),
+                       small, dev)
+    return _assemble_arm_record(out, res, list(makers), "float", "int8",
+                                "gpt decode")
 
 
 def bench_serving(small: bool):
@@ -1088,7 +1151,11 @@ def bench_serving(small: bool):
         cfg = gpt.GPTConfig(vocab_size=50304, hidden_size=1024,
                             num_layers=24, num_heads=16, max_seq_len=2048)
         B, p_len, new_toks, block, iters = 8, 128, 128, 16, 2
-    params = jax.device_get(gpt.init_params(cfg, jax.random.PRNGKey(0)))
+    # skipped under isolation: subprocess arms rebuild their own trees,
+    # and this ~1.4GB init + host fetch is ~90s of tunnel time
+    params = (None if _arms_isolated(dev)
+              else jax.device_get(gpt.init_params(cfg,
+                                                  jax.random.PRNGKey(0))))
 
     def serving_tree(tree):
         """Deploy form of a param tree: fp32 leaves (except the small
@@ -1134,6 +1201,12 @@ def bench_serving(small: bool):
         # the GENERATED rate (prompts admit in one prefill step each)
         return B * new_toks / dt
 
+    makers = {"bf16": lambda: params,
+              "int8": lambda: woq.quantize_gpt_int8(params),
+              "int4": lambda: woq.quantize_gpt_int4(params)}
+    sel = os.environ.get("BENCH_ARM")
+    if sel:  # child mode: one arm, one JSON line (see _arm_results)
+        return {"arm": sel, "tok_s": tok_s(serving_tree(makers[sel]()))}
     out = {"metric": "tokens_per_sec_serving_gpt350m_bf16",
            "unit": "tokens/s/chip",
            "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
@@ -1142,35 +1215,11 @@ def bench_serving(small: bool):
            "device_kind": str(getattr(dev, "device_kind", "")),
            "batch": B, "prompt_len": p_len, "new_tokens": new_toks,
            "block": block, "vs_baseline": 0.0}
-    bf16_tok = None
-    # per-arm isolation (round-5 window 2: the int4 arm crashed through
-    # axon and took the measured bf16/int8 numbers down with it)
-    for arm, make in (("bf16", lambda: params),
-                      ("int8", lambda: woq.quantize_gpt_int8(params)),
-                      ("int4", lambda: woq.quantize_gpt_int4(params))):
-        try:
-            t = tok_s(serving_tree(make()))
-        except Exception as e:  # noqa: BLE001 - record, keep other arms
-            _log(f"[bench] serving {arm} arm failed: "
-                 f"{type(e).__name__}: {e}")
-            out[f"{arm}_error"] = f"{type(e).__name__}: {e}"[:300]
-            continue
-        _log(f"[bench] serving {arm}: {t:,.0f} gen-tok/s (B={B}, "
-             f"{p_len}-in/{new_toks}-out, block={block})")
-        out[f"{arm}_tok_s"] = round(t, 1)
-        if arm == "bf16":
-            bf16_tok = t
-            out["value"], out["value_arm"] = round(t, 1), arm
-        elif bf16_tok:
-            out[f"{arm}_vs_bf16"] = round(t / bf16_tok, 3)
-    if "value" not in out:  # bf16 arm died: headline a survivor, labeled
-        for arm in ("int8", "int4"):
-            if f"{arm}_tok_s" in out:
-                out["value"], out["value_arm"] = out[f"{arm}_tok_s"], arm
-                break
-        else:
-            out["value"], out["value_arm"] = 0.0, None
-    return out
+    res = _arm_results("serving", list(makers),
+                       lambda a: tok_s(serving_tree(makers[a]())),
+                       small, dev)
+    return _assemble_arm_record(out, res, list(makers), "bf16", "bf16",
+                                "serving")
 
 
 _CONFIGS = {"gpt": bench_gpt, "mnist": bench_mnist, "resnet": bench_resnet,
@@ -1198,6 +1247,12 @@ def main():
                     f"{[r[0] for r in _gpt_rungs()]}")
             idx = matches[0]
         print(json.dumps(_run_gpt_rung(idx)), flush=True)
+        return
+    if "--arm" in argv:  # child mode: one decode/serving arm, JSON out
+        config, _, arm = argv[argv.index("--arm") + 1].partition(":")
+        os.environ["BENCH_ARM"] = arm
+        fn = {"decode": bench_decode, "serving": bench_serving}[config]
+        print(json.dumps(fn("--small" in argv)), flush=True)
         return
     if "--fast-headline" in argv:
         # headline-first watchdog step: skip the parent backend probe (the

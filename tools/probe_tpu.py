@@ -189,13 +189,18 @@ def _payload_steps():
         # round-5: first on-device serving number (DecodeServer block-tick
         # bf16 vs int8 vs int4) — before the long --all walk so a
         # mid-length window still banks it
-        ("serving", [py, bench, "--config", "serving"], 1500, {},
+        # 3 isolated arms x 360s + parent probe/startup (~250s worst
+        # case) < the 1500s step budget: even three hung-to-timeout arms
+        # can't blow the step (an arm that hangs is killed by its OWN
+        # timeout, not the step's, so healthy arms' results survive)
+        ("serving", [py, bench, "--config", "serving"], 1500,
+         {"BENCH_ARM_TIMEOUT": "360"},
          os.path.join(REPO, "serving_tpu.json"), None),
         # --all reuses the ladder step's fresh GPT headline instead of
         # re-measuring the whole ladder inside the same window
         ("all", [py, bench, "--all"], 7200,
          {"BENCH_RUNG_TIMEOUT": "540", "BENCH_REUSE_LADDER": "1",
-          "BENCH_REUSE_SERVING": "1"},
+          "BENCH_REUSE_SERVING": "1", "BENCH_ARM_TIMEOUT": "480"},
          None, None),
         # LADDER_TOP=1: the ablation arm needs one measured rung, not a
         # tournament — three successes under the 2700s budget would risk a
