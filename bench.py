@@ -421,8 +421,12 @@ def _gpt_rung_fits(name, cfg_kwargs, B, T, state_dtype, hbm, accum=1,
     at least as large as the 15.75GiB v5e the proof was measured on."""
     # 15.9e9 not 16.9e9: every legacy wrapper exports BENCH_HBM_GB=16
     # (the old default) to MEAN "the v5e" — that spelling must not veto
-    # the rungs proven on that exact chip
-    if name in _PROVEN_FIT and hbm >= 15.9e9:
+    # the rungs proven on that exact chip.  The proofs were measured
+    # with flash attention ACTIVE: under PADDLE_TPU_NO_FLASH the same
+    # rung saves the [H,T,T] score tensors too, so the empirical fact
+    # no longer applies and the estimate (with its TT term) decides.
+    if (name in _PROVEN_FIT and hbm >= 15.9e9
+            and not _no_flash_requested()):
         return True
     headroom = float(os.environ.get("BENCH_HEADROOM_GB", "2")) * 1e9
     return _gpt_rung_estimate(cfg_kwargs, B, T, state_dtype, accum,
@@ -588,6 +592,12 @@ def _arm_results(config_name, arm_names, measure_inproc, small, dev):
                 continue
             except (json.JSONDecodeError, ValueError):
                 pass
+        # surface the child's stderr like _run_rung_child does — the XLA
+        # failure class lives in the FIRST lines; a tail-only 200-char
+        # summary left nothing to diagnose the next tunnel crash from
+        sys.stderr.write(f"[bench] {config_name}:{arm} child failed "
+                         f"(rc={out.returncode}):\n"
+                         + clip_head_tail(out.stderr, 4000))
         res[arm] = {"error": (extract_oom_line(out.stderr)
                               or f"rc={out.returncode}: "
                                  f"{out.stderr[-200:]}")[:300]}
@@ -1342,9 +1352,12 @@ def main():
     if which:
         results[which] = _CONFIGS[which](small)
     elif run_all:
+        # --small smoke must not clobber the measured TPU table (it did
+        # once, round 5 — a CPU smoke run overwrote the round's on-device
+        # numbers mid-window); smoke details go to a sibling file
         details_path = os.path.join(
             os.path.dirname(os.path.abspath(__file__)),
-            "BENCH_DETAILS.json")
+            "BENCH_DETAILS_SMALL.json" if small else "BENCH_DETAILS.json")
         def _serving_reuse():
             """The watchdog's dedicated serving step's table, when it was
             measured in THIS window — don't spend another ~25 min of

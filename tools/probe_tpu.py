@@ -189,12 +189,13 @@ def _payload_steps():
         # round-5: first on-device serving number (DecodeServer block-tick
         # bf16 vs int8 vs int4) — before the long --all walk so a
         # mid-length window still banks it
-        # 3 isolated arms x 360s + parent probe/startup (~250s worst
-        # case) < the 1500s step budget: even three hung-to-timeout arms
-        # can't blow the step (an arm that hangs is killed by its OWN
-        # timeout, not the step's, so healthy arms' results survive)
+        # worst-case budget: parent probe retries (2 x 240s = 480s) plus
+        # 3 arms hung to their full 330s timeouts (990s) = 1470s < the
+        # 1500s step budget — even three hung-to-timeout arms can't blow
+        # the step (an arm that hangs is killed by its OWN timeout, so
+        # healthy arms' results survive)
         ("serving", [py, bench, "--config", "serving"], 1500,
-         {"BENCH_ARM_TIMEOUT": "360"},
+         {"BENCH_ARM_TIMEOUT": "330"},
          os.path.join(REPO, "serving_tpu.json"), None),
         # --all reuses the ladder step's fresh GPT headline instead of
         # re-measuring the whole ladder inside the same window
