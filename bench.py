@@ -826,7 +826,9 @@ def bench_bert(small: bool):
         ladder, T, K, iters = [2], 128, 20, 3
     else:
         cfg = bert.bert_base()
-        ladder, T, K, iters = [32, 16, 8], 512, 76, 10
+        # B=64 first (round-5: B=32 measured MFU 0.311 with HBM to
+        # spare — bigger batches fill the MXU; the walk falls back on OOM)
+        ladder, T, K, iters = [64, 32, 16, 8], 512, 76, 10
 
     opt = AdamW(learning_rate=1e-4)
     key = jax.random.PRNGKey(0)
@@ -1161,6 +1163,24 @@ def bench_serving(small: bool):
         cfg = gpt.GPTConfig(vocab_size=50304, hidden_size=1024,
                             num_layers=24, num_heads=16, max_seq_len=2048)
         B, p_len, new_toks, block, iters = 8, 128, 128, 16, 2
+        # block-size sweep lever: serving through the tunnel is
+        # dispatch-latency-bound (round-5: ~15ms/step measured vs ~1ms
+        # of weight reads), so tokens-per-dispatch is the lever — a
+        # bigger block amortizes the host round trip at the cost of
+        # result latency granularity.  Validated once here: a block not
+        # dividing new_toks would overrun finished slots in the timed
+        # pass and silently skew tok_s; a non-int would kill every arm.
+        env_block = os.environ.get("BENCH_SERVING_BLOCK")
+        if env_block:
+            try:
+                cand = int(env_block)
+            except ValueError:
+                raise SystemExit(f"BENCH_SERVING_BLOCK={env_block!r} is "
+                                 f"not an integer")
+            if cand < 1 or new_toks % cand:
+                raise SystemExit(f"BENCH_SERVING_BLOCK={cand} must divide "
+                                 f"new_tokens={new_toks}")
+            block = cand
     # skipped under isolation: subprocess arms rebuild their own trees,
     # and this ~1.4GB init + host fetch is ~90s of tunnel time
     params = (None if _arms_isolated(dev)
