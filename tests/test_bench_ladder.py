@@ -187,6 +187,11 @@ def test_calibrated_walk_matches_on_device_outcomes(monkeypatch):
         "gpt_350m_remat_b8": (1024, 24, 8, 2048, 1, False, None),
     }
     assert set(frozen) == set(bench._PROVEN_FIT)
+    # extrapolated rungs are admitted to the walk but NOT certified as
+    # ground truth; they must stay disjoint from the proven set
+    assert not (bench._EXTRAPOLATED_FIT & bench._PROVEN_FIT)
+    for name in bench._EXTRAPOLATED_FIT:
+        assert fits(name), name
     for name, (h, L, B, T, accum, fused, policy) in frozen.items():
         _, kw, rb, rt, _, _, raccum, rfused = rungs[name]
         assert (kw["hidden_size"], kw["num_layers"], rb, rt, raccum,
