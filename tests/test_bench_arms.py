@@ -1,0 +1,142 @@
+"""Per-arm subprocess isolation for the decode/serving benches
+(bench.py::_arm_results / _assemble_arm_record).
+
+Tested like the rung ladder (test_bench_ladder.py): the child
+subprocess is faked, and the assembler's contract — tok_s fields,
+ratios, labeled headline fallback — is pinned so drift between the
+decode and serving records can't reappear.
+"""
+import importlib.util
+import json
+import os
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def bench(monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "bench_arms_under_test", os.path.join(REPO, "bench.py"))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    monkeypatch.delenv("BENCH_ARM", raising=False)
+    monkeypatch.delenv("BENCH_ARM_ISOLATE", raising=False)
+    monkeypatch.delenv("BENCH_ARM_TIMEOUT", raising=False)
+    return m
+
+
+class _TpuDev:
+    platform = "tpu"
+    device_kind = "fake v5e"
+
+
+class _CpuDev:
+    platform = "cpu"
+    device_kind = "cpu"
+
+
+class _Done:
+    def __init__(self, rc=0, stdout="", stderr=""):
+        self.returncode, self.stdout, self.stderr = rc, stdout, stderr
+
+
+def _fake_children(m, monkeypatch, by_arm):
+    """by_arm[arm] -> dict (json result), int (rc), 'timeout', or
+    'garbage' (rc 0, non-JSON stdout)."""
+    calls = []
+
+    def fake_run(argv, capture_output, text, timeout):
+        arm = argv[argv.index("--arm") + 1].split(":")[1]
+        calls.append(arm)
+        spec = by_arm[arm]
+        if spec == "timeout":
+            raise subprocess.TimeoutExpired(argv, timeout)
+        if spec == "garbage":
+            return _Done(stdout="not json\n")
+        if isinstance(spec, int):
+            return _Done(rc=spec, stderr="boom\nRan out of memory in "
+                                         "memory space hbm. Used 20G of "
+                                         "15.75G hbm.\ntail")
+        return _Done(stdout=json.dumps(spec) + "\n")
+
+    monkeypatch.setattr(m.subprocess, "run", fake_run)
+    return calls
+
+
+def test_tpu_arms_run_in_subprocesses(bench, monkeypatch):
+    calls = _fake_children(bench, monkeypatch, {
+        "a": {"arm": "a", "tok_s": 100.0},
+        "b": {"arm": "b", "tok_s": 50.0}})
+    res = bench._arm_results("decode", ["a", "b"],
+                             lambda arm: 1 / 0, False, _TpuDev())
+    assert calls == ["a", "b"]
+    assert res == {"a": {"arm": "a", "tok_s": 100.0},
+                   "b": {"arm": "b", "tok_s": 50.0}}
+
+
+def test_cpu_arms_run_in_process(bench, monkeypatch):
+    def no_subprocess(*a, **k):
+        raise AssertionError("CPU path must not spawn children")
+    monkeypatch.setattr(bench.subprocess, "run", no_subprocess)
+    res = bench._arm_results("decode", ["a"], lambda arm: 42.0, False,
+                             _CpuDev())
+    assert res == {"a": {"tok_s": 42.0}}
+
+
+def test_hung_arm_is_killed_and_recorded(bench, monkeypatch):
+    monkeypatch.setenv("BENCH_ARM_TIMEOUT", "7")
+    _fake_children(bench, monkeypatch, {
+        "a": "timeout", "b": {"arm": "b", "tok_s": 9.0}})
+    res = bench._arm_results("serving", ["a", "b"],
+                             lambda arm: 1 / 0, False, _TpuDev())
+    assert "timeout" in res["a"]["error"]
+    assert res["b"]["tok_s"] == 9.0  # later arms still run after a hang
+
+
+def test_crashed_arm_reports_oom_line(bench, monkeypatch):
+    _fake_children(bench, monkeypatch, {"a": 1})
+    res = bench._arm_results("decode", ["a"], lambda arm: 1 / 0, False,
+                             _TpuDev())
+    assert "Used 20G of 15.75G" in res["a"]["error"]
+
+
+def test_garbage_stdout_is_an_error_not_a_crash(bench, monkeypatch):
+    _fake_children(bench, monkeypatch, {"a": "garbage"})
+    res = bench._arm_results("decode", ["a"], lambda arm: 1 / 0, False,
+                             _TpuDev())
+    assert "error" in res["a"]
+
+
+def test_assembler_ratio_and_headline_contract(bench):
+    out = bench._assemble_arm_record(
+        {}, {"float": {"tok_s": 100.0}, "int8": {"tok_s": 150.0},
+             "int4": {"tok_s": 80.0}},
+        ["float", "int8", "int4"], "float", "int8", "t")
+    assert out["value"] == 150.0 and out["value_arm"] == "int8"
+    assert out["int8_vs_float"] == 1.5 and out["int4_vs_float"] == 0.8
+    assert "float_vs_float" not in out
+
+
+def test_assembler_headline_falls_back_labeled(bench):
+    out = bench._assemble_arm_record(
+        {}, {"bf16": {"error": "x"}, "int8": {"tok_s": 70.0},
+             "int4": {"error": "y"}},
+        ["bf16", "int8", "int4"], "bf16", "bf16", "t")
+    assert out["value"] == 70.0 and out["value_arm"] == "int8"
+    assert out["bf16_error"] == "x" and out["int4_error"] == "y"
+    assert "int8_vs_bf16" not in out  # no reference arm: no ratio
+
+
+def test_assembler_total_failure_yields_zero(bench):
+    out = bench._assemble_arm_record(
+        {}, {"a": {"error": "x"}}, ["a"], "a", "a", "t")
+    assert out["value"] == 0.0 and out["value_arm"] is None
+
+
+def test_child_env_flag_disables_isolation(bench, monkeypatch):
+    """A child (--arm) must never recurse into more subprocesses."""
+    monkeypatch.setenv("BENCH_ARM", "int8")
+    assert not bench._arms_isolated(_TpuDev())

@@ -188,10 +188,21 @@ def test_calibrated_walk_matches_on_device_outcomes(monkeypatch):
     }
     assert set(frozen) == set(bench._PROVEN_FIT)
     # extrapolated rungs are admitted to the walk but NOT certified as
-    # ground truth; they must stay disjoint from the proven set
+    # ground truth; they must stay disjoint from the proven set, and
+    # their shapes freeze too — the bypass is name-keyed, so a config
+    # edit under the same name must not silently ride it into an OOM
     assert not (bench._EXTRAPOLATED_FIT & bench._PROVEN_FIT)
-    for name in bench._EXTRAPOLATED_FIT:
+    frozen_extrapolated = {
+        "gpt_760m_fused_dots_acc32_b32": (1536, 24, 32, 2048, 32, True,
+                                          "dots"),
+    }
+    assert set(frozen_extrapolated) == set(bench._EXTRAPOLATED_FIT)
+    for name, (h, L, B, T, accum, fused, policy) in             frozen_extrapolated.items():
         assert fits(name), name
+        _, kw, rb, rt, _, _, raccum, rfused = rungs[name]
+        assert (kw["hidden_size"], kw["num_layers"], rb, rt, raccum,
+                rfused, kw.get("remat_policy")) == (h, L, B, T, accum,
+                                                    fused, policy), name
     for name, (h, L, B, T, accum, fused, policy) in frozen.items():
         _, kw, rb, rt, _, _, raccum, rfused = rungs[name]
         assert (kw["hidden_size"], kw["num_layers"], rb, rt, raccum,
