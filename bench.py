@@ -557,6 +557,19 @@ def clip_head_tail(s: str, n: int) -> str:
     return s[:h] + "\n...[stderr elided]...\n" + s[-h:]
 
 
+def _w4_stats():
+    """Whether the Pallas W4 decode kernel ACTUALLY engaged during the
+    measurement just taken — the env flag alone says nothing (w4_matmul
+    probes per-config and falls back silently).  probes>0 with
+    fallbacks==0 means the kernel ran; equal counts mean every matmul
+    took the XLA dequant path despite the flag."""
+    from paddle_tpu.ops import woq_matmul as wm
+
+    return {"enabled": os.environ.get("PADDLE_TPU_W4_KERNEL") == "1",
+            "probes": len(wm._FALLBACK),
+            "fallbacks": sum(1 for v in wm._FALLBACK.values() if v)}
+
+
 def _arms_isolated(dev) -> bool:
     """True when decode/serving arms run as subprocesses — ALSO consulted
     by the bench fns before building the shared param tree, which only
@@ -586,6 +599,8 @@ def _arm_results(config_name, arm_names, measure_inproc, small, dev):
         if not isolate:
             try:
                 res[arm] = {"tok_s": measure_inproc(arm)}
+                if arm == "int4":
+                    res[arm]["w4"] = _w4_stats()
             except Exception as e:  # noqa: BLE001 - record, keep others
                 res[arm] = {"error": f"{type(e).__name__}: {e}"[:300]}
             continue
@@ -628,6 +643,8 @@ def _assemble_arm_record(out, res, arm_names, ratio_ref, headline_arm,
         r = res.get(arm, {})
         if "tok_s" in r:
             out[f"{arm}_tok_s"] = round(r["tok_s"], 1)
+            if "w4" in r:  # actual kernel engagement, not the env flag
+                out[f"{arm}_w4"] = r["w4"]
             _log(f"[bench] {log_of} {arm}: {r['tok_s']:,.0f} tok/s")
             if arm != ratio_ref and ref:
                 out[f"{arm}_vs_{ratio_ref}"] = round(r["tok_s"] / ref, 3)
@@ -1142,9 +1159,16 @@ def bench_decode(small: bool):
     makers = {"float": lambda: params,
               "int8": lambda: woq.quantize_gpt_int8(params),
               "int4": lambda: woq.quantize_gpt_int4(params)}
+    # Pallas W4 decode kernel: only under fresh on-device certification
+    # (setdefault: an operator's explicit =0 pins the A/B's off arm)
+    if _fused_kernels_ok():
+        os.environ.setdefault("PADDLE_TPU_W4_KERNEL", "1")
     sel = os.environ.get("BENCH_ARM")
     if sel:  # child mode: one arm, one JSON line (see _arm_results)
-        return {"arm": sel, "tok_s": tok_s(makers[sel]())}
+        rec = {"arm": sel, "tok_s": tok_s(makers[sel]())}
+        if sel == "int4":
+            rec["w4"] = _w4_stats()
+        return rec
     out = {"metric": "tokens_per_sec_decode_gpt350m_int8w",
            "unit": "tokens/s/chip", "device": dev.platform,
            "vs_baseline": 0.0}
@@ -1247,9 +1271,15 @@ def bench_serving(small: bool):
     makers = {"bf16": lambda: params,
               "int8": lambda: woq.quantize_gpt_int8(params),
               "int4": lambda: woq.quantize_gpt_int4(params)}
+    # Pallas W4 decode kernel: only under fresh on-device certification
+    if _fused_kernels_ok():
+        os.environ.setdefault("PADDLE_TPU_W4_KERNEL", "1")
     sel = os.environ.get("BENCH_ARM")
     if sel:  # child mode: one arm, one JSON line (see _arm_results)
-        return {"arm": sel, "tok_s": tok_s(serving_tree(makers[sel]()))}
+        rec = {"arm": sel, "tok_s": tok_s(serving_tree(makers[sel]()))}
+        if sel == "int4":
+            rec["w4"] = _w4_stats()
+        return rec
     out = {"metric": "tokens_per_sec_serving_gpt350m_bf16",
            "unit": "tokens/s/chip",
            "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(

@@ -17,4 +17,5 @@ KERNEL_SOURCE_FILES = (
     "flash_attention.py",
     "_pallas_probe.py",
     "attention.py",
+    "woq_matmul.py",
 )

@@ -77,7 +77,7 @@ def _cached_block(x, p, cache_k, cache_v, pos, cfg: gpt.GPTConfig):
         attn = jnp.einsum("bkgt,btkd->bkgd", wg, v_all).reshape(B, 1, D)
     else:
         attn = jnp.einsum("bht,bthd->bhd", w, v_all).reshape(B, 1, D)
-    a = attn @ woq.w(p, "proj_w", dt) + p["proj_b"].astype(dt)
+    a = woq.mm(attn, p, "proj_w", dt) + p["proj_b"].astype(dt)
     x = x + a
     return gpt._ffn_tail(x, p, cfg), k_new, v_new
 
@@ -159,6 +159,11 @@ class _LRU:
     def pop(self, k, default=None):
         return self._d.pop(k, default)
 
+    def clear(self):
+        """Drop every cached executable (tests that flip trace-time env
+        flags — e.g. PADDLE_TPU_W4_KERNEL — must force a retrace)."""
+        self._d.clear()
+
 
 import os as _os
 
@@ -180,7 +185,13 @@ def _cfg_key(cfg):
             cfg.num_kv_heads,
             cfg.max_seq_len, cfg.ffn_ratio, str(cfg.dtype), cfg.use_flash,
             cfg.pos_embed, cfg.norm, cfg.activation,
-            moe_key)
+            moe_key,
+            # trace-time env routing flags: an executable BAKES these in
+            # (woq.mm reads PADDLE_TPU_W4_KERNEL, gpt._ln reads FUSED_LN
+            # at trace time) — flipping a flag mid-process must retrace,
+            # not silently reuse the other routing's executable
+            _os.environ.get("PADDLE_TPU_W4_KERNEL", ""),
+            _os.environ.get("PADDLE_TPU_FUSED_LN", ""))
 
 
 def _get_generate_fn(cfg, max_new_tokens, top_k, top_p=1.0):
@@ -488,7 +499,7 @@ def _prefill_block(x, p, cfg: gpt.GPTConfig, valid=None):
     from ..ops.attention import attention_array
 
     attn = attention_array(q, k, v, is_causal=True).reshape(B, P, D)
-    a = attn @ woq.w(p, "proj_w", dt) + p["proj_b"].astype(dt)
+    a = woq.mm(attn, p, "proj_w", dt) + p["proj_b"].astype(dt)
     return gpt._ffn_tail(x + a, p, cfg, valid=valid), k_rows, v_rows
 
 
@@ -567,7 +578,7 @@ def _chunk_attend_block(x, p, ck, cv, pos0, cfg: gpt.GPTConfig,
     scores = jnp.where(mask, scores.astype(jnp.float32), -1e30)
     w_ = jax.nn.softmax(scores, axis=-1).astype(dt)
     attn = jnp.einsum("bkgit,btkd->bikgd", w_, v_all).reshape(B, K, -1)
-    a = attn @ woq.w(p, "proj_w", dt) + p["proj_b"].astype(dt)
+    a = woq.mm(attn, p, "proj_w", dt) + p["proj_b"].astype(dt)
     return gpt._ffn_tail(x + a, p, cfg, valid=valid), k_new, v_new
 
 

@@ -340,7 +340,7 @@ def _gqa_qkv(h, p, cfg: GPTConfig, repeat_kv: bool = True,
     Hkv = Hkv if Hkv is not None else cfg.kv_heads
     hd = cfg.head_dim
     dt = cfg.dtype
-    q = (h @ woq.w(p, "q_w", dt) + p["q_b"].astype(dt)).reshape(B, T, H, hd)
+    q = (woq.mm(h, p, "q_w", dt) + p["q_b"].astype(dt)).reshape(B, T, H, hd)
     kv = jnp.einsum("btd,kde->kbte", h, woq.w(p, "kv_w", dt)) \
         + p["kv_b"].astype(dt)[:, None, None]
     k = kv[0].reshape(B, T, Hkv, hd)
@@ -375,13 +375,13 @@ def _ffn_body(h, p, cfg: GPTConfig):
     and every decode-path block share."""
     dt = cfg.dtype
     if cfg.activation == "swiglu":
-        gate = jax.nn.silu(h @ woq.w(p, "gate_w", dt)
+        gate = jax.nn.silu(woq.mm(h, p, "gate_w", dt)
                            + p["gate_b"].astype(dt))
-        up = h @ woq.w(p, "fc_w", dt) + p["fc_b"].astype(dt)
+        up = woq.mm(h, p, "fc_w", dt) + p["fc_b"].astype(dt)
         h = gate * up
     else:
-        h = jax.nn.gelu(h @ woq.w(p, "fc_w", dt) + p["fc_b"].astype(dt))
-    return h @ woq.w(p, "out_w", dt) + p["out_b"].astype(dt)
+        h = jax.nn.gelu(woq.mm(h, p, "fc_w", dt) + p["fc_b"].astype(dt))
+    return woq.mm(h, p, "out_w", dt) + p["out_b"].astype(dt)
 
 
 def _ffn_dense(x, p, cfg: GPTConfig):
@@ -424,7 +424,7 @@ def _block(x, p, cfg: GPTConfig, dropout_key=None):
         q, k = apply_rope(q, pos), apply_rope(k, pos)
     attn = attention_array(q, k, v, is_causal=True)
     attn = attn.reshape(B, T, D)
-    a = attn @ woq.w(p, "proj_w", dt) + p["proj_b"].astype(dt)
+    a = woq.mm(attn, p, "proj_w", dt) + p["proj_b"].astype(dt)
     if drop:
         a = _dropout(a, cfg.dropout, jax.random.fold_in(dropout_key, 0))
     x = x + a

@@ -23,6 +23,8 @@ params flow through unchanged, so the same decode code serves both.
 """
 from __future__ import annotations
 
+import os
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -100,6 +102,18 @@ def quantize_gpt_int8(params: dict) -> dict:
     return out
 
 
+def pack_int4_halves(q):
+    """THE int4 byte layout, in one place (consumers: quantize_gpt_int4,
+    tools/check_flash_tpu's kernel oracle, tests): signed values in
+    [-7, 7] with the input dim at axis -2 pack two-per-byte HALF-SPLIT —
+    rows [0, in/2) in the low nibble, rows [in/2, in) in the high — as
+    4-bit two's complement assembled in uint8, reinterpreted int8."""
+    q = np.asarray(q, np.int32)
+    P = q.shape[-2] // 2
+    lo, hi = q[..., :P, :], q[..., P:, :]
+    return ((lo & 0xF) | ((hi & 0xF) << 4)).astype(np.uint8).view(np.int8)
+
+
 def quantize_gpt_int4(params: dict, group_size: int = 64) -> dict:
     """4-bit weight-only decode params: block matmul weights become int4
     with GROUP-WISE scales along the input dimension (per-channel alone is
@@ -132,19 +146,13 @@ def quantize_gpt_int4(params: dict, group_size: int = 64) -> dict:
         scale = np.maximum(np.abs(grouped).max(axis=in_axis + 1,
                                                keepdims=True), 1e-8)
         q = np.clip(np.round(grouped / scale * 7.0), -7, 7)
-        q = q.reshape(shp).astype(np.int32)
-        # HALF-SPLIT packing: low nibble holds input rows [0, in/2), high
-        # nibble rows [in/2, in) — so unpack is concat(lo, hi) along the
-        # input dim IN ORIGINAL ROW ORDER: two elementwise-derived
-        # tensors, no interleave permutation for XLA to materialize
-        # (pair-interleaved packing measured 0.78x bf16 decode on the
-        # chip — the stack+reshape shuffle broke dequant-into-matmul
-        # fusion).  4-bit two's complement per nibble, assembled in uint8
-        # then reinterpreted int8.
-        P = shp[-2] // 2
-        lo, hi = q[..., :P, :], q[..., P:, :]
-        packed = ((lo & 0xF) | ((hi & 0xF) << 4)).astype(np.uint8)
-        return (jnp.asarray(packed.view(np.int8)),
+        # HALF-SPLIT packing (pack_int4_halves): unpack is concat(lo, hi)
+        # along the input dim IN ORIGINAL ROW ORDER — two elementwise-
+        # derived tensors, no interleave permutation for XLA to
+        # materialize (pair-interleaved packing measured 0.78x bf16
+        # decode on the chip — the stack+reshape shuffle broke
+        # dequant-into-matmul fusion)
+        return (jnp.asarray(pack_int4_halves(q.reshape(shp))),
                 jnp.asarray((scale / 7.0).astype(np.float32)))
 
     out = dict(params)
@@ -202,6 +210,31 @@ def w(p: dict, name: str, dt):
         out = out + jnp.einsum("...dr,...rf->...df", a.astype(dt),
                                b.astype(dt))
     return out
+
+
+def mm(h, p: dict, name: str, dt):
+    """``h @ w(p, name, dt)`` with a fused-kernel fast path.
+
+    When ``name`` resolves to a nibble-packed int4 2-D weight, the env
+    flag ``PADDLE_TPU_W4_KERNEL=1`` is set (the bench flips it on only
+    under fresh on-device certification — a compiling-but-wrong kernel
+    must never serve tokens), and no LoRA adapter is attached, the
+    matmul runs through the Pallas W4 kernel (ops/woq_matmul.py): the
+    packed bytes stream through VMEM and no dequantized bf16 copy is
+    ever written to HBM.  Every other case — float weights, per-channel
+    int8, stacked (3-D+) weights, adapted trees — is exactly
+    ``h @ w(...)``, so training and all existing decode paths are
+    untouched when the flag is off or the shape doesn't qualify."""
+    arr = p[name]
+    s = p.get(name + "_s")
+    if (os.environ.get("PADDLE_TPU_W4_KERNEL", "") == "1"
+            and arr.ndim == 2 and arr.dtype == jnp.int8
+            and s is not None and s.ndim == arr.ndim + 1
+            and p.get(name + "_lora_a") is None):
+        from ..ops.woq_matmul import w4_matmul
+
+        return w4_matmul(h.astype(dt), arr, s)
+    return h @ w(p, name, dt)
 
 
 def embed(params: dict, token, dt):

@@ -134,6 +134,29 @@ def check_fused_ce(N, V, dtype):
     print(f"  fused_ce OK N{N} V{V} {jnp.dtype(dtype).name}", flush=True)
 
 
+def check_w4_matmul(N, K, M, gs, dtype):
+    """Kernel vs XLA-dequant oracle on the real chip — int4 decode must
+    be bit-faithful to woq.w's math before the bench may enable it."""
+    from paddle_tpu.ops import woq_matmul as wm
+    from paddle_tpu.text.woq import pack_int4_halves
+    rng = np.random.default_rng(N + K + M)
+    x = jnp.asarray(rng.normal(size=(N, K)), dtype)
+    q = rng.integers(-7, 8, (K, M))
+    packed = jnp.asarray(pack_int4_halves(q))
+    scale = jnp.asarray(rng.uniform(0.01, 0.1, (K // gs, 1, M))
+                        .astype(np.float32))
+    out = wm._w4_call(jnp.pad(x, ((0, -(-N // 8) * 8 - N), (0, 0))),
+                      packed, scale, gs)[:N]
+    ref = wm._xla_w4(x, packed, scale)
+    tol = 2e-2 if dtype == jnp.bfloat16 else 1e-4
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=tol, rtol=tol,
+                               err_msg=f"w4 N{N} K{K} M{M} gs{gs}")
+    print(f"  w4_matmul OK N{N} K{K} M{M} gs{gs} "
+          f"{jnp.dtype(dtype).name}", flush=True)
+
+
 if __name__ == "__main__":
     # a marker from a PREVIOUS run must not certify this one: remove it
     # up front so a crash below leaves no stale certification behind
@@ -178,6 +201,15 @@ if __name__ == "__main__":
     _cached("fused_ce:N256V1024:f32",
             lambda: check_fused_ce(256, 1024, jnp.float32))
     print("fused softmax-CE fwd+bwd all OK", flush=True)
+    # W4 decode kernel: the serving-relevant GPT-350M shapes (D=1024,
+    # F=4096, gs=64) at decode batch 8
+    _cached("w4:N8K1024M4096gs64:bf16",
+            lambda: check_w4_matmul(8, 1024, 4096, 64, jnp.bfloat16))
+    _cached("w4:N8K4096M1024gs64:bf16",
+            lambda: check_w4_matmul(8, 4096, 1024, 64, jnp.bfloat16))
+    _cached("w4:N3K1024M1024gs64:bf16",
+            lambda: check_w4_matmul(3, 1024, 1024, 64, jnp.bfloat16))
+    print("w4 dequant-matmul all OK", flush=True)
     # certify the fused LN/CE kernels for the bench ladder: bench.py only
     # offers its fused rungs when this marker exists (a compiling-but-wrong
     # kernel must never produce a headline number)
@@ -187,5 +219,6 @@ if __name__ == "__main__":
                    .isoformat(timespec="seconds"),
                    "device": str(jax.devices()[0].device_kind),
                    "checks": ["flash_attention", "fused_layer_norm",
-                              "fused_softmax_ce"]}, f, indent=2)
+                              "fused_softmax_ce", "w4_matmul"]}, f,
+                  indent=2)
     print(f"wrote {_marker}", flush=True)
