@@ -341,7 +341,7 @@ def _gqa_qkv(h, p, cfg: GPTConfig, repeat_kv: bool = True,
     hd = cfg.head_dim
     dt = cfg.dtype
     q = (woq.mm(h, p, "q_w", dt) + p["q_b"].astype(dt)).reshape(B, T, H, hd)
-    kv = jnp.einsum("btd,kde->kbte", h, woq.w(p, "kv_w", dt)) \
+    kv = woq.mm_stacked(h, p, "kv_w", dt) \
         + p["kv_b"].astype(dt)[:, None, None]
     k = kv[0].reshape(B, T, Hkv, hd)
     v = kv[1].reshape(B, T, Hkv, hd)
@@ -363,7 +363,7 @@ def _project_qkv(h, p, cfg: GPTConfig, repeat_kv: bool = True):
         return _gqa_qkv(h, p, cfg, repeat_kv=repeat_kv)
     dt = cfg.dtype
     H, hd = cfg.num_heads, cfg.head_dim
-    qkv = jnp.einsum("btd,kde->kbte", h, woq.w(p, "qkv_w", dt)) \
+    qkv = woq.mm_stacked(h, p, "qkv_w", dt) \
         + p["qkv_b"].astype(dt)[:, None, None]
     return (qkv[0].reshape(B, T, H, hd), qkv[1].reshape(B, T, H, hd),
             qkv[2].reshape(B, T, H, hd))

@@ -212,6 +212,17 @@ def w(p: dict, name: str, dt):
     return out
 
 
+def _w4_qualifies(p: dict, name: str, ndim: int) -> bool:
+    """ONE routing predicate for the Pallas W4 fast path (mm: ndim 2,
+    mm_stacked: ndim 3) — env-gated, packed-int4-shaped, unadapted."""
+    arr = p[name]
+    s = p.get(name + "_s")
+    return (os.environ.get("PADDLE_TPU_W4_KERNEL", "") == "1"
+            and arr.ndim == ndim and arr.dtype == jnp.int8
+            and s is not None and s.ndim == arr.ndim + 1
+            and p.get(name + "_lora_a") is None)
+
+
 def mm(h, p: dict, name: str, dt):
     """``h @ w(p, name, dt)`` with a fused-kernel fast path.
 
@@ -225,16 +236,27 @@ def mm(h, p: dict, name: str, dt):
     int8, stacked (3-D+) weights, adapted trees — is exactly
     ``h @ w(...)``, so training and all existing decode paths are
     untouched when the flag is off or the shape doesn't qualify."""
-    arr = p[name]
-    s = p.get(name + "_s")
-    if (os.environ.get("PADDLE_TPU_W4_KERNEL", "") == "1"
-            and arr.ndim == 2 and arr.dtype == jnp.int8
-            and s is not None and s.ndim == arr.ndim + 1
-            and p.get(name + "_lora_a") is None):
+    if _w4_qualifies(p, name, 2):
         from ..ops.woq_matmul import w4_matmul
 
-        return w4_matmul(h.astype(dt), arr, s)
+        return w4_matmul(h.astype(dt), p[name], p[name + "_s"])
     return h @ w(p, name, dt)
+
+
+def mm_stacked(h, p: dict, name: str, dt):
+    """``einsum('...d,kde->k...e', h, w(p, name, dt))`` — the stacked
+    qkv/kv projection form — with the same W4 fast path as :func:`mm`:
+    a packed 3-D weight [k, in/2, out] runs one Pallas W4 matmul per
+    stack slice (k is 2 or 3, a static python loop), covering the
+    remaining quarter of dense decode weight bytes the 2-D sites miss."""
+    if _w4_qualifies(p, name, 3):
+        from ..ops.woq_matmul import w4_matmul
+
+        arr, s = p[name], p[name + "_s"]
+        hq = h.astype(dt)
+        return jnp.stack([w4_matmul(hq, arr[i], s[i])
+                          for i in range(arr.shape[0])])
+    return jnp.einsum("...d,kde->k...e", h, w(p, name, dt))
 
 
 def embed(params: dict, token, dt):

@@ -119,6 +119,38 @@ def test_mm_routes_only_qualified_weights(monkeypatch):
     assert calls == [1]
 
 
+def test_mm_stacked_routes_and_matches(monkeypatch):
+    """The stacked qkv/kv form: per-slice kernel calls equal the einsum
+    over the dequantized stack."""
+    monkeypatch.setenv("PADDLE_TPU_W4_KERNEL", "1")
+    rng = np.random.default_rng(3)
+    K, M = 128, 128
+    w_ = rng.normal(size=(1, 3, K, M)).astype(np.float32)  # [L, 3, K, M]
+    tree = woq.quantize_gpt_int4({"blocks": {"qkv_w": w_},
+                                  "wte": rng.normal(size=(8, M))
+                                  .astype(np.float32)}, group_size=32)
+    p = {"qkv_w": tree["blocks"]["qkv_w"][0],
+         "qkv_w_s": tree["blocks"]["qkv_w_s"][0]}
+    x = jnp.asarray(rng.normal(size=(2, 4, K)), jnp.bfloat16)
+    calls = []
+    real = wm.w4_matmul
+
+    def spy(*a, **k):
+        calls.append(1)
+        return real(*a, **k)
+    monkeypatch.setattr(wm, "w4_matmul", spy)
+    out = woq.mm_stacked(x, p, "qkv_w", jnp.bfloat16)
+    assert calls == [1, 1, 1] and out.shape == (3, 2, 4, M)
+    ref = jnp.einsum("...d,kde->k...e", x,
+                     woq.w(p, "qkv_w", jnp.bfloat16))
+    # one-ulp bf16 tolerance: the kernel accumulates its dots in f32
+    # (preferred_element_type) while the einsum accumulates in bf16 —
+    # same dequant values, occasionally different final rounding
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=4e-3, rtol=4e-3)
+
+
 def test_decode_identical_with_kernel_forced(markov_gpt, monkeypatch):
     """THE serving guarantee: the trained markov model generates the
     same tokens with the W4 kernel on and off."""
