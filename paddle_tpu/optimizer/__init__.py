@@ -1,5 +1,5 @@
 from . import lr  # noqa: F401
 from .optimizer import (  # noqa: F401
-    SGD, Adagrad, Adam, Adadelta, Adamax, AdamW, L1Decay, L2Decay, Lamb, Lars, Momentum,
-    Optimizer, RMSProp,
+    SGD, Adadelta, Adafactor, Adagrad, Adam, Adamax, AdamW, L1Decay, L2Decay,
+    Lamb, Lars, Momentum, Optimizer, RMSProp,
 )

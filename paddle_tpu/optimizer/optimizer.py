@@ -426,6 +426,85 @@ class Lamb(Optimizer):
         return (p32 - lr * trust * r).astype(p.dtype), (m2, v2)
 
 
+class Adafactor(Optimizer):
+    """Adafactor (Shazeer & Stern 2018) — factored second moments.
+
+    Beyond the reference's optimizer zoo, and the knob that makes the
+    BASELINE's GPT-1.3B trainable on ONE 16GiB-class chip: Adam's m/v
+    cost 2 x params (1.3B -> ~10.5GB fp32, ~5.2GB bf16 — either way the
+    state alone crowds out activations), while Adafactor's per-matrix
+    row/column EMAs cost params/dim (~8MB total at 1.3B).  Matrix-shaped
+    leaves ([..., R, C], stacked layer dims leading) factor over the
+    LAST TWO axes; vectors/scalars keep a full second moment.  The
+    update follows the paper: decaying beta2_t = 1 - t^-0.8, the
+    R x C / mean(R) low-rank vhat reconstruction, and RMS-clipping of
+    the unscaled update at ``clip_threshold`` (the stability device
+    that replaces Adam's bias correction).  First moments are OFF by
+    default (beta1=None) — that is where the memory win comes from;
+    pass beta1 to trade memory for Adam-like smoothing.
+    """
+
+    def __init__(self, learning_rate=0.01, beta1=None, beta2_exponent=0.8,
+                 epsilon=1e-30, clip_threshold=1.0, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._beta1 = beta1
+        self._b2_exp = float(beta2_exponent)
+        self._eps1 = epsilon
+        self._clip = float(clip_threshold)
+
+    def _factored(self, p) -> bool:
+        # factor only genuine matrices: stacked per-layer VECTORS (ln
+        # gains [L, h], biases [L, 3, M]) must keep full moments — their
+        # trailing axes are (layer, hidden) or (projection, hidden), and
+        # a factored vhat would mix gradient statistics across unrelated
+        # layers (paper Sec. 3 / optax min_dim_size_to_factor)
+        return p.ndim >= 2 and min(p.shape[-2:]) >= 128
+
+    def _init_leaf(self, p):
+        if self._factored(p):
+            st = (jnp.zeros(p.shape[:-1], jnp.float32),           # row EMA
+                  jnp.zeros(p.shape[:-2] + p.shape[-1:],          # col EMA
+                            jnp.float32))
+        else:
+            st = (jnp.zeros_like(p, dtype=jnp.float32),)
+        if self._beta1 is not None:
+            st = st + (jnp.zeros_like(p, dtype=jnp.float32),)
+        return st
+
+    def _update_leaf(self, g, p, state, lr, step):
+        g32 = g.astype(jnp.float32)
+        t = jnp.maximum(jnp.asarray(step, jnp.float32), 1.0)
+        b2t = 1.0 - t ** (-self._b2_exp)
+        gsq = g32 * g32 + self._eps1
+        if self._factored(p):
+            vr, vc = state[0], state[1]
+            vr2 = b2t * vr + (1 - b2t) * jnp.mean(gsq, axis=-1)
+            vc2 = b2t * vc + (1 - b2t) * jnp.mean(gsq, axis=-2)
+            # low-rank vhat = R x C / mean(R): exact when g^2 is rank-1
+            r = vr2 / jnp.maximum(jnp.mean(vr2, axis=-1, keepdims=True),
+                                  self._eps1)
+            u = g32 * jax.lax.rsqrt(r[..., None] + self._eps1) \
+                * jax.lax.rsqrt(vc2[..., None, :] + self._eps1)
+            new_v = (vr2, vc2)
+        else:
+            v = state[0]
+            v2 = b2t * v + (1 - b2t) * gsq
+            u = g32 * jax.lax.rsqrt(v2 + self._eps1)
+            new_v = (v2,)
+        # RMS clip of the unscaled update (the paper's d=1.0 threshold)
+        rms_u = jnp.sqrt(jnp.mean(u * u))
+        u = u / jnp.maximum(1.0, rms_u / self._clip)
+        if self._beta1 is not None:
+            m = state[-1]
+            m2 = self._beta1 * m + (1 - self._beta1) * u
+            u, new_state = m2, new_v + (m2,)
+        else:
+            new_state = new_v
+        return (p.astype(jnp.float32) - lr * u).astype(p.dtype), new_state
+
+
 class Lars(Momentum):
     """LARS (reference lars_momentum_op): layer-wise adaptive rate scaling."""
 

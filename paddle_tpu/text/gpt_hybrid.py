@@ -667,6 +667,11 @@ def build_gpt_train_step(cfg: gpt.GPTConfig, mesh: Mesh, optimizer,
 
     def leaf_spec(s, shape):
         s = s if s is not None else P()
+        if len(s) > len(shape):
+            # reduced-rank optimizer state (Adafactor's factored R/C
+            # vectors) can't inherit the full param spec; the vectors
+            # are a param's size divided by a matrix dim — replicate
+            return P()
         if zero_stage:
             return zero_shard_spec(s, shape, zero_axis, mesh) or s
         return s

@@ -1,0 +1,109 @@
+"""Adafactor (optimizer/optimizer.py) — factored second moments.
+
+The capability claim: optimizer state shrinks from Adam's 2x params to
+~params/dim, which is what puts GPT-1.3B training inside one
+16GiB-class chip.  Tested like the other optimizers: state shapes,
+convergence on the shared markov GPT task, and the hybrid train step
+(including the reduced-rank state leaves under a sharded mesh).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.optimizer import Adafactor
+from paddle_tpu.text import gpt, gpt_hybrid
+from jax.sharding import Mesh
+
+
+def test_factored_state_shapes_and_size():
+    params = {"w": jnp.zeros((4, 256, 512)),   # stacked matrix: factored
+              "g": jnp.zeros((24, 1536)),      # stacked LN gain: NOT
+              # factored (trailing axes are layer x hidden — mixing
+              # layer statistics would crush per-layer step sizes)
+              "b": jnp.zeros((256,)),          # vector: full moment
+              "s": jnp.zeros(())}              # scalar: full moment
+    st = Adafactor(learning_rate=0.01).init_state(params)
+    (vr, vc) = st["w"]
+    assert vr.shape == (4, 256) and vc.shape == (4, 512)
+    assert st["b"][0].shape == (256,) and st["s"][0].shape == ()
+    assert len(st["g"]) == 1 and st["g"][0].shape == (24, 1536)
+    n_param = sum(np.prod(p.shape) for p in jax.tree_util.tree_leaves(params))
+    n_state = sum(np.prod(s.shape) for s in jax.tree_util.tree_leaves(st))
+    # the memory claim, in miniature: state is a small fraction of params
+    assert n_state < 0.1 * n_param  # gains keep full moments; matrices dominate real trees
+    # beta1 adds a full first moment (the opt-in memory trade)
+    st_m = Adafactor(learning_rate=0.01, beta1=0.9).init_state(params)
+    assert st_m["w"][2].shape == (4, 256, 512)
+
+
+def test_quadratic_converges():
+    """min ||Wx - y||^2: the factored update must actually optimize."""
+    rng = np.random.default_rng(0)
+    Wtrue = jnp.asarray(rng.normal(size=(8, 8)), jnp.float32)
+    X = jnp.asarray(rng.normal(size=(64, 8)), jnp.float32)
+    Y = X @ Wtrue.T
+    params = {"W": jnp.zeros((8, 8), jnp.float32)}
+    opt = Adafactor(learning_rate=0.05)
+    st = opt.init_state(params)
+
+    @jax.jit
+    def step(p, s, i):
+        def loss(q):
+            return jnp.mean((X @ q["W"].T - Y) ** 2)
+        l, g = jax.value_and_grad(loss)(p)
+        p2, s2 = opt.apply_gradients(g, p, s, lr=0.05, step=i)
+        return p2, s2, l
+
+    l0 = None
+    for i in range(1, 300):
+        params, st, l = step(params, st, i)
+        l0 = l0 or float(l)
+    assert float(l) < 0.01 * l0, (l0, float(l))
+
+
+def test_gpt_trains_under_hybrid_step():
+    """build_gpt_train_step(cfg, mesh, Adafactor) on the markov stream —
+    the 1.3B-enabling path in miniature, loss must fall well below the
+    random-prediction floor."""
+    cfg = gpt.GPTConfig(vocab_size=16, hidden_size=64, num_layers=2,
+                        num_heads=2, max_seq_len=32)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
+    opt = Adafactor(learning_rate=0.03)
+    init_fn, step_fn, _ = gpt_hybrid.build_gpt_train_step(cfg, mesh, opt)
+    state = init_fn(0)
+    seq = [1]
+    for _ in range(32):
+        seq.append((seq[-1] * 3 + 1) % 13)
+    toks = jnp.asarray(np.tile(seq[:33], (4, 1)), jnp.int32)
+    key = jax.random.PRNGKey(0)
+    l0 = None
+    for _ in range(150):
+        state, loss = step_fn(state, toks, key, 0.03)
+        l0 = l0 or float(loss)
+    assert float(loss) < 0.5, (l0, float(loss))
+    assert float(loss) < 0.3 * l0
+
+
+def test_sharded_step_with_factored_state():
+    """The reduced-rank R/C leaves must survive the hybrid step's
+    opt-state sharding broadcast (param specs don't fit their rank —
+    they replicate instead of crashing) on a real dp x mp mesh."""
+    n = min(4, len(jax.devices()))
+    cfg = gpt.GPTConfig(vocab_size=64, hidden_size=64, num_layers=2,
+                        num_heads=2, max_seq_len=16)
+    mesh = Mesh(np.array(jax.devices()[:n]).reshape(n // 2, 2),
+                ("dp", "mp"))
+    opt = Adafactor(learning_rate=0.01)
+    init_fn, step_fn, _ = gpt_hybrid.build_gpt_train_step(cfg, mesh, opt)
+    state = init_fn(0)
+    toks = jnp.asarray(np.random.default_rng(0).integers(0, 64, (4, 17)),
+                       jnp.int32)
+    p_before = jax.tree_util.tree_map(np.asarray, state.params)
+    state, loss = step_fn(state, toks, jax.random.PRNGKey(0), 0.01)
+    assert np.isfinite(float(loss))
+    # the sharded update actually moved the (finite) params
+    moved = [not np.array_equal(np.asarray(a), b) and
+             np.all(np.isfinite(np.asarray(a)))
+             for a, b in zip(jax.tree_util.tree_leaves(state.params),
+                             jax.tree_util.tree_leaves(p_before))]
+    assert all(moved), moved

@@ -195,6 +195,8 @@ def test_calibrated_walk_matches_on_device_outcomes(monkeypatch):
     frozen_extrapolated = {
         "gpt_760m_fused_dots_acc32_b32": (1536, 24, 32, 2048, 32, True,
                                           "dots"),
+        "gpt_1.3b_fused_remat_af_acc8_b8": (2048, 24, 8, 2048, 8, True,
+                                            None),
     }
     assert set(frozen_extrapolated) == set(bench._EXTRAPOLATED_FIT)
     for name, (h, L, B, T, accum, fused, policy) in             frozen_extrapolated.items():
