@@ -231,6 +231,12 @@ def _gpt_rungs():
         ("gpt_760m_fused_dots_acc32_b32",
          dict(c760, remat=True, remat_policy="dots"), 32, 2048, 5,
          "bfloat16", 32, True),
+        # the BASELINE's named model on ONE chip: Adafactor (factored
+        # moments) + fused kernels + full remat — inside the tournament's
+        # top-3 window so a healthy ladder run actually tries it
+        ("gpt_1.3b_fused_remat_af_acc8_b8",
+         dict(c13, remat=True), 8, 2048, 5,
+         "adafactor", 8, True),
         # THE measured winner (round-5 window 2): MFU 0.476, the first
         # config to beat the A100-class bar — 760M amortizes layer
         # overheads over 2.2x the FLOPs of 350M, and only fits because
@@ -256,11 +262,6 @@ def _gpt_rungs():
         ("gpt_1.3b_fused_remat_dots_b2",
          dict(c13, remat=True, remat_policy="dots"), 2, 2048, 10,
          "bfloat16", 1, True),
-        # the BASELINE's named model on ONE chip: Adafactor (factored
-        # moments) + fused kernels + full remat; extrapolated fit
-        ("gpt_1.3b_fused_remat_af_acc8_b8",
-         dict(c13, remat=True), 8, 2048, 5,
-         "adafactor", 8, True),
     ] if _fused_kernels_ok() else []
     r = fused_rungs + [
         ("gpt_1.3b_acc8_b8", dict(c13, remat=False), 8, 2048, 10,
