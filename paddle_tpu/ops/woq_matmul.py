@@ -130,8 +130,12 @@ def _w4_call(x, packed, scale, gs):
         def _init():
             acc[...] = jnp.zeros_like(acc)
 
-        pk = pk_ref[...]
-        lo = jnp.right_shift(jnp.left_shift(pk, 4), 4)
+        # Mosaic can't legalize arith.shli/shrsi on i8 vectors (v5e cert
+        # failure, window 3): widen to i32 and sign-extend the nibbles
+        # with 28-bit shift pairs — value-identical to the i8 math in
+        # _xla_w4 (shl-28 + ashr-28 == keep low nibble with sign)
+        pk = pk_ref[...].astype(jnp.int32)
+        lo = jnp.right_shift(jnp.left_shift(pk, 28), 28)
         hi = jnp.right_shift(pk, 4)
 
         def dq(q, s_ref):
