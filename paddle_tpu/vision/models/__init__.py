@@ -2,3 +2,5 @@ from .lenet import LeNet  # noqa: F401
 from .mobilenet import MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2  # noqa: F401
 from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152  # noqa: F401
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
+from .vit import (VisionTransformer, vit_b_16, vit_b_32,  # noqa: F401
+                  vit_l_16, vit_s_16)

@@ -1,0 +1,75 @@
+"""ViT model family: shapes, grad flow, TrainStep, eval determinism.
+
+Beyond the reference zoo (python/paddle/vision/models/ is conv-only) —
+see paddle_tpu/vision/models/vit.py for the TPU rationale.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.vision.models import VisionTransformer, vit_s_16
+
+
+def _tiny(num_classes=10, dropout=0.0):
+    return VisionTransformer(image_size=32, patch_size=8, embed_dim=64,
+                             depth=2, num_heads=4, dropout=dropout,
+                             num_classes=num_classes)
+
+
+class TestViT:
+    def test_forward_shape(self):
+        net = _tiny()
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(2, 3, 32, 32).astype(np.float32))
+        out = net(x)
+        assert tuple(out.shape) == (2, 10)
+        assert np.isfinite(out.numpy()).all()
+
+    def test_feature_mode(self):
+        """num_classes=0 returns the class-token feature, like ResNet."""
+        net = _tiny(num_classes=0)
+        x = paddle.to_tensor(np.zeros((1, 3, 32, 32), np.float32))
+        assert tuple(net(x).shape) == (1, 64)
+
+    def test_patch_count(self):
+        net = _tiny()
+        assert net.patch_embed.num_patches == 16  # (32/8)^2
+        assert tuple(net.pos_embed.shape) == (1, 17, 64)
+
+    def test_grad_flows_to_all_params(self):
+        net = _tiny()
+        x = paddle.to_tensor(np.random.RandomState(1)
+                             .randn(2, 3, 32, 32).astype(np.float32))
+        loss = net(x).square().mean()
+        loss.backward()
+        for name, p in net.named_parameters():
+            assert p.grad is not None, f"no grad reached {name}"
+            assert np.isfinite(p.grad.numpy()).all(), name
+
+    def test_trainstep_loss_decreases(self):
+        net = _tiny(num_classes=4)
+        opt = paddle.optimizer.AdamW(1e-3, parameters=net.parameters())
+
+        def loss_fn(logits, label):
+            import paddle_tpu.nn.functional as F
+
+            return F.cross_entropy(logits, label).mean()
+
+        step = TrainStep(net, loss_fn, opt)
+        rng = np.random.RandomState(2)
+        x = paddle.to_tensor(rng.randn(4, 3, 32, 32).astype(np.float32))
+        y = paddle.to_tensor(rng.randint(0, 4, (4,)).astype(np.int64))
+        losses = [float(step(x, y).numpy()) for _ in range(8)]
+        assert losses[-1] < losses[0], losses
+
+    def test_eval_mode_deterministic_with_dropout(self):
+        net = _tiny(dropout=0.3)
+        net.eval()
+        x = paddle.to_tensor(np.random.RandomState(3)
+                             .randn(1, 3, 32, 32).astype(np.float32))
+        a, b = net(x).numpy(), net(x).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_named_variants_construct(self):
+        net = vit_s_16(image_size=32, num_classes=0)
+        assert net.patch_embed.num_patches == 4  # (32/16)^2
