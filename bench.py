@@ -1203,7 +1203,8 @@ def bench_decode(small: bool):
 def bench_serving(small: bool):
     """Continuous-batching DecodeServer throughput (round-5 verdict Next
     #2): batch 8, 128-token prompts, 128 new tokens each, measured with
-    the device-resident block tick (one host fetch per 16 tokens) — bf16
+    the device-resident block tick (one host fetch per 64 tokens;
+    BENCH_SERVING_BLOCK overrides) — bf16
     vs weight-only int8 (W8A16) vs int4.  The int8/int4-vs-bf16 ratios
     are the first on-device evidence for the woq bandwidth claim
     (text/woq.py: decode reads every weight once per token)."""
@@ -1221,12 +1222,15 @@ def bench_serving(small: bool):
     else:
         cfg = gpt.GPTConfig(vocab_size=50304, hidden_size=1024,
                             num_layers=24, num_heads=16, max_seq_len=2048)
-        B, p_len, new_toks, block, iters = 8, 128, 128, 16, 2
-        # block-size sweep lever: serving through the tunnel is
-        # dispatch-latency-bound (round-5: ~15ms/step measured vs ~1ms
-        # of weight reads), so tokens-per-dispatch is the lever — a
-        # bigger block amortizes the host round trip at the cost of
-        # result latency granularity.  Validated once here: a block not
+        # block 64 (was 16): serving through the tunnel is
+        # dispatch-latency-bound (round-5 window 2: ~241ms per 16-token
+        # block dispatch vs ~1ms of weight reads per token), so
+        # tokens-per-dispatch is the lever — 64 quarters the host round
+        # trips per request at the same tunnel budget (2 dispatches per
+        # 128-token pass), trading result-latency granularity the bench
+        # doesn't score.  BENCH_SERVING_BLOCK overrides for sweeps.
+        B, p_len, new_toks, block, iters = 8, 128, 128, 64, 2
+        # Validated once here: a block not
         # dividing new_toks would overrun finished slots in the timed
         # pass and silently skew tok_s; a non-int would kill every arm.
         env_block = os.environ.get("BENCH_SERVING_BLOCK")

@@ -8,12 +8,15 @@ back to the fast_headline record (MFU 0.2763) instead of the banked
 window-2 champion (MFU 0.4761, `WATCHDOG_RESULTS.json.bak_window3`).
 
 This guard restores the backup's ladder record into the live state file
-whenever the live ladder is unresolved or strictly worse than the
-backup.  Run as a loop (``--loop [seconds]``) alongside the watchdog:
-last-writer-wins races with the watchdog's own per-step saves are
-resolved by re-checking every interval — and the restore is a no-op the
-moment the watchdog banks an equal-or-better fresh measurement.
+ONLY while the live ladder has no completed fresh on-device measurement
+(unresolved, or a failed attempt) — a completed ok re-run is the current
+truth and is never overwritten, even when its MFU is lower.  Restored
+records carry ``restored_from`` so a relaunched watchdog treats them as
+replay-valid but still pending re-measurement (probe_tpu.py's skip
+checks).  Run as a loop (``--loop [seconds]``) alongside the watchdog;
+writers serialize on the shared ``.lock`` file.
 """
+import fcntl
 import json
 import os
 import sys
@@ -58,28 +61,35 @@ def check_once() -> bool:
             cur = json.load(f).get("steps", {}).get("ladder", {})
     except Exception:  # noqa: BLE001 - torn mid-write: retry next tick
         return False
-    if cur.get("ok") or _mfu(cur) >= _mfu(bak):
+    if ((cur.get("ok") and not cur.get("restored_from"))
+            or _mfu(cur) >= _mfu(bak)):
         return False
-    # re-read immediately before the write and patch ONLY steps.ladder,
-    # so a watchdog save landing between our read and write loses at
-    # most the ladder key (which this guard exists to own) — not its
-    # other steps' fresh results
-    try:
-        with open(LIVE) as f:
-            live = json.load(f)
-    except Exception:  # noqa: BLE001
-        return False
-    if live.get("steps", {}).get("ladder", {}).get("ok"):
-        return False
-    live.setdefault("steps", {})["ladder"] = dict(
-        bak, restored_from="bak_window3",
-        note="window-2 measurement; training-path sources unchanged "
-             "since (only the int4-decode W4 unpack was edited, which "
-             "no training rung executes)")
-    tmp = LIVE + ".restore_tmp"
-    with open(tmp, "w") as f:
-        json.dump(live, f, indent=2)
-    os.replace(tmp, LIVE)
+    # hold the lock shared with probe_tpu._save_results across the whole
+    # read-modify-replace, then patch ONLY steps.ladder — a concurrent
+    # watchdog save can no longer land inside our window and be lost
+    with open(LIVE + ".lock", "w") as lk:
+        fcntl.flock(lk, fcntl.LOCK_EX)
+        try:
+            with open(LIVE) as f:
+                live = json.load(f)
+        except Exception:  # noqa: BLE001
+            return False
+        cur = live.get("steps", {}).get("ladder", {})
+        if cur.get("ok") and not cur.get("restored_from"):
+            return False
+        live.setdefault("steps", {})["ladder"] = dict(
+            bak, restored_from="bak_window3",
+            # the live attempts count survives the restore so the
+            # watchdog's 3-attempt cap still binds across guard cycles
+            attempts=max(int(cur.get("attempts", 0) or 0),
+                         int(bak.get("attempts", 0) or 0)),
+            note="window-2 measurement; training-path sources unchanged "
+                 "since (only the int4-decode W4 unpack was edited, which "
+                 "no training rung executes)")
+        tmp = LIVE + ".restore_tmp"
+        with open(tmp, "w") as f:
+            json.dump(live, f, indent=2)
+        os.replace(tmp, LIVE)
     return True
 
 
