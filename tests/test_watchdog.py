@@ -282,3 +282,40 @@ def test_bench_replay_rejects_stale_and_not_ok(tmp_path):
     p = _wd_file(tmp_path, {"ladder": {"ok": True, "headline": {
         "metric": "m_cpu_fallback", "vs_baseline": 0.5}}})
     assert b._watchdog_tpu_result(p) is None
+
+
+def test_restored_record_is_pending_not_resolved(pt):
+    """A ladder record the headline guard restored from a backup
+    (ok=true + restored_from) is replay-valid for bench but must NOT
+    make a relaunched watchdog skip the re-measure shot — and the
+    3-attempt cap (which the guard preserves) still binds."""
+    _fake_steps(pt, ["ladder"])
+    # pre-existing state: a restored record, 1 prior attempt
+    json.dump({"steps": {"ladder": {
+        "ok": True, "restored_from": "bak_window3", "attempts": 1,
+        "headline": {"metric": "m", "mfu": 0.4761}}}, "windows": []},
+        open(pt.RESULTS, "w"))
+    _probe_seq(pt, [True])
+    run, calls = _runner({"ladder": {"ok": True, "rc": 0}})
+    pt._run_step = run
+    pt.watch(interval=1, probe_timeout=1, max_hours=1)
+    assert calls == ["ladder"]  # re-ran despite ok=true
+    rec = json.load(open(pt.RESULTS))["steps"]["ladder"]
+    assert rec["ok"] and "restored_from" not in rec  # fresh result won
+    assert rec["attempts"] == 2
+
+
+def test_restored_record_attempts_cap_still_binds(pt):
+    _fake_steps(pt, ["ladder"])
+    json.dump({"steps": {"ladder": {
+        "ok": True, "restored_from": "bak_window3", "attempts": 3,
+        "headline": {"metric": "m", "mfu": 0.4761}}}, "windows": []},
+        open(pt.RESULTS, "w"))
+    _probe_seq(pt, [True])
+    run, calls = _runner({})
+    pt._run_step = run
+    pt.watch(interval=1, probe_timeout=1, max_hours=1)
+    # exhausted attempts: the restored record stands, no re-run burned
+    assert calls == []
+    rec = json.load(open(pt.RESULTS))["steps"]["ladder"]
+    assert rec["restored_from"] == "bak_window3"
