@@ -197,12 +197,6 @@ def _payload_steps():
         ("serving", [py, bench, "--config", "serving"], 1500,
          {"BENCH_ARM_TIMEOUT": "330"},
          os.path.join(REPO, "serving_tpu.json"), None),
-        # --all reuses the ladder step's fresh GPT headline instead of
-        # re-measuring the whole ladder inside the same window
-        ("all", [py, bench, "--all"], 7200,
-         {"BENCH_RUNG_TIMEOUT": "540", "BENCH_REUSE_LADDER": "1",
-          "BENCH_REUSE_SERVING": "1", "BENCH_ARM_TIMEOUT": "480"},
-         None, None),
         # LADDER_TOP=1: the ablation arm needs one measured rung, not a
         # tournament — three successes under the 2700s budget would risk a
         # step timeout that watch() reads as a re-wedged tunnel (closing a
@@ -212,6 +206,12 @@ def _payload_steps():
          {"PADDLE_TPU_NO_FLASH": "1", "BENCH_RUNG_TIMEOUT": "480",
           "BENCH_LADDER_TOP": "1", "BENCH_PREFER_LADDER_HEADLINE": "1"},
          os.path.join(REPO, "noflash.json"), None),
+        # --all reuses the ladder step's fresh GPT headline instead of
+        # re-measuring the whole ladder inside the same window
+        ("all", [py, bench, "--all"], 7200,
+         {"BENCH_RUNG_TIMEOUT": "540", "BENCH_REUSE_LADDER": "1",
+          "BENCH_REUSE_SERVING": "1", "BENCH_ARM_TIMEOUT": "480"},
+         None, None),
         # like-for-like fused-LN/CE kernel A/B: the SAME 350M config
         # (B=8, T=2048, accum=2) with and without the Pallas fused
         # kernels — the ladder alone can't produce this pair because it
