@@ -73,3 +73,41 @@ class TestViT:
     def test_named_variants_construct(self):
         net = vit_s_16(image_size=32, num_classes=0)
         assert net.patch_embed.num_patches == 4  # (32/16)^2
+
+
+class TestViTInference:
+    def test_predictor_stablehlo(self, tmp_path):
+        """ViT through the inference stack: save_inference_model ->
+        Config -> create_predictor -> run (the reference deploy loop)."""
+        import numpy as np
+
+        from paddle_tpu.inference import (Config, create_predictor,
+                                          save_inference_model)
+
+        net = _tiny(num_classes=3)
+        net.eval()
+        x = np.random.RandomState(5).randn(2, 3, 32, 32).astype(np.float32)
+        want = net(paddle.to_tensor(x)).numpy()
+        prefix = str(tmp_path / "vit")
+        save_inference_model(prefix, net, [paddle.to_tensor(x)])
+        pred = create_predictor(Config(prefix))
+        (got,) = pred.run([x])
+        np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5,
+                                   atol=2e-5)
+
+    def test_onnx_export_matches(self, tmp_path):
+        """ViT through the ONNX emitter and the independent decoder:
+        patch conv, concat'd class token, MHA dot_generals, GELU (Erf),
+        pre-LN — a transformer-on-images graph the reference exports via
+        paddle2onnx (reference python/paddle/onnx/export.py)."""
+        import numpy as np
+
+        from test_onnx_export import _roundtrip
+
+        net = _tiny(num_classes=3)
+        net.eval()
+        x = paddle.to_tensor(np.random.RandomState(6)
+                             .randn(1, 3, 32, 32).astype(np.float32))
+        model = _roundtrip(net, [x], tmp_path / "vit.onnx")
+        ops = {n["op"] for n in model["nodes"]}
+        assert "Conv" in ops and "MatMul" in ops
