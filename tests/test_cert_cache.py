@@ -1,0 +1,66 @@
+"""Per-kernel-family certification cache (tools/check_flash_tpu.py).
+
+Round-5 window 3: a one-file W4 edit voided the then-global cache, which
+would have re-paid ~44 remote compiles for three untouched kernels.  The
+cache is now keyed per check-key prefix; these tests lock the
+invalidation semantics without a device.
+"""
+import importlib.util
+import json
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_module():
+    spec = importlib.util.spec_from_file_location(
+        "check_flash_under_test",
+        os.path.join(REPO, "tools", "check_flash_tpu.py"))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    return m
+
+
+class TestFamilyCache:
+    def test_family_sigs_cover_every_check_prefix(self):
+        m = _load_module()
+        sigs = m._family_sigs("TPU v5 lite")
+        assert set(sigs) == {"flash", "fused_ln", "fused_ce", "w4"}
+        # device kind folds into every family signature
+        assert all(s.endswith(":TPU v5 lite") for s in sigs.values())
+        assert sigs != m._family_sigs("TPU v4")
+
+    def test_one_family_edit_keeps_other_families(self, tmp_path,
+                                                  monkeypatch):
+        m = _load_module()
+        m._CACHE = str(tmp_path / "cache.json")
+        sigs = m._family_sigs("x")
+        passed = {"flash:causal:B2T512H4D128:bf16",
+                  "fused_ln:N512F2048:bf16", "w4:N8K1024M4096gs64:bf16"}
+        m._save_cache(sigs, passed)
+        # same sources: everything resumes
+        assert m._load_cache(sigs) == passed
+        # a w4-only edit: w4 entries drop, flash/ln survive
+        edited = dict(sigs, w4="deadbeef:x")
+        assert m._load_cache(edited) == {
+            "flash:causal:B2T512H4D128:bf16", "fused_ln:N512F2048:bf16"}
+
+    def test_old_global_format_reads_as_empty(self, tmp_path):
+        m = _load_module()
+        m._CACHE = str(tmp_path / "cache.json")
+        json.dump({"src_sig": "abc:x", "passed": ["flash:k"]},
+                  open(m._CACHE, "w"))
+        assert m._load_cache(m._family_sigs("x")) == set()
+
+    def test_every_emitted_check_key_has_a_family(self):
+        """The __main__ check list and _PREFIX_SRCS must not drift: a
+        check key whose prefix has no family sig would never resume."""
+        src = open(os.path.join(REPO, "tools",
+                                "check_flash_tpu.py")).read()
+        import re
+
+        keys = re.findall(r'_cached\("([^"]+)"', src)
+        assert keys, "no check keys found"
+        m = _load_module()
+        for k in keys:
+            assert k.split(":", 1)[0] in m._PREFIX_SRCS, k

@@ -6,10 +6,14 @@ is skipped): python tools/check_flash_tpu.py
 The full matrix is ~44 remote compiles; through a slow axon tunnel that can
 exceed one watchdog step budget (round-4 window 2: 20 min, zero checks
 reported).  Each PASSED check is therefore recorded immediately in
-``flash_check_cache.json`` keyed by a kernel-source signature, so a re-run
-in a later healthy window resumes after the last passed check instead of
-restarting; an edit to any kernel source invalidates the whole cache (a
-certification must never outlive the code it certified).
+``flash_check_cache.json`` keyed PER KERNEL FAMILY by a source signature
+over that family's own files (plus this checker), so a re-run in a later
+healthy window resumes after the last passed check — and an edit to ONE
+kernel re-pays only that kernel's checks, not the whole matrix (round-5
+window 3: the W4 unpack fix voided the then-global cache and would have
+cost a full re-certification of three untouched kernels).  A
+certification still never outlives the code it certified: the family sig
+covers the kernel, its parity oracle, and the check code.
 """
 import json
 import numpy as np
@@ -22,34 +26,66 @@ from paddle_tpu.ops.attention import xla_attention
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _CACHE = os.path.join(_REPO, "flash_check_cache.json")
 from paddle_tpu.ops.certified import KERNEL_SOURCE_FILES  # noqa: E402
-_KERNEL_SRCS = [os.path.join(_REPO, "paddle_tpu", "ops", f)
-                for f in KERNEL_SOURCE_FILES]
 
 
-def _src_sig() -> str:
+# check-key prefix -> the ops/ sources whose edit invalidates that
+# family's certification: the kernel itself and its parity oracle.
+# Folded into EVERY family: this checker script (an edited tolerance or
+# shape must re-certify everything it checks) and _pallas_probe.py
+# (shared runtime the kernels import — fused_norm/fused_ce take their
+# block geometry from it).  Coverage of certified.KERNEL_SOURCE_FILES is
+# asserted below so this map cannot silently drift from the registry the
+# bench gate keys on (the round-4 drift class certified.py exists to
+# prevent).
+_PREFIX_SRCS = {
+    "flash": ["flash_attention.py", "attention.py"],
+    "fused_ln": ["fused_norm.py"],
+    "fused_ce": ["fused_ce.py"],
+    "w4": ["woq_matmul.py"],
+}
+_SHARED_SRCS = ["_pallas_probe.py"]
+# every registered kernel source must feed some family's signature
+assert (set(sum(_PREFIX_SRCS.values(), _SHARED_SRCS))
+        == set(KERNEL_SOURCE_FILES)), (
+    "check_flash_tpu._PREFIX_SRCS drifted from certified.KERNEL_SOURCE_FILES")
+# non-ops oracles a family's parity math additionally depends on
+_EXTRA_SRCS = {"w4": [os.path.join("..", "text", "woq.py")]}
+
+
+def _family_sigs(device_kind: str) -> dict:
     # script-dir insert: covers import-by-path (drive scripts), where
     # sys.path[0] is not tools/
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from srcsig import source_signature
 
-    return source_signature(_KERNEL_SRCS + [os.path.abspath(__file__)])
+    ops = os.path.join(_REPO, "paddle_tpu", "ops")
+    shared = ([os.path.join(ops, f) for f in _SHARED_SRCS]
+              + [os.path.abspath(__file__)])
+    return {pre: (source_signature(
+                      [os.path.join(ops, f) for f in rel]
+                      + [os.path.join(ops, f)
+                         for f in _EXTRA_SRCS.get(pre, [])]
+                      + shared) + ":" + device_kind)
+            for pre, rel in _PREFIX_SRCS.items()}
 
 
-def _load_cache(sig: str) -> set:
+def _load_cache(sigs: dict) -> set:
+    """Passed keys whose own family's signature still matches."""
     try:
         with open(_CACHE) as f:
             d = json.load(f)
-        if d.get("src_sig") == sig:
-            return set(d.get("passed", []))
-    except Exception:  # noqa: BLE001 - torn/missing cache = empty
-        pass
-    return set()
+        cached_sigs = d.get("sigs", {})
+        return {k for k in d.get("passed", [])
+                if cached_sigs.get(k.split(":", 1)[0])
+                == sigs.get(k.split(":", 1)[0])}
+    except Exception:  # noqa: BLE001 - torn/missing/old-format = empty
+        return set()
 
 
-def _save_cache(sig: str, passed: set):
+def _save_cache(sigs: dict, passed: set):
     tmp = _CACHE + ".tmp"
     with open(tmp, "w") as f:
-        json.dump({"src_sig": sig, "passed": sorted(passed)}, f, indent=1)
+        json.dump({"sigs": sigs, "passed": sorted(passed)}, f, indent=1)
     os.replace(tmp, _CACHE)
 
 
@@ -165,12 +201,12 @@ if __name__ == "__main__":
     if os.path.exists(_marker):
         os.remove(_marker)
     assert jax.devices()[0].platform in ("tpu", "axon"), jax.devices()
-    _SIG = (_src_sig() + ":"
-            + str(getattr(jax.devices()[0], "device_kind", "?")))
+    _SIG = _family_sigs(str(getattr(jax.devices()[0], "device_kind", "?")))
     _PASSED = _load_cache(_SIG)
     if _PASSED:
-        print(f"resuming: {len(_PASSED)} checks cached (sig {_SIG})",
-              flush=True)
+        fams = sorted({k.split(":", 1)[0] for k in _PASSED})
+        print(f"resuming: {len(_PASSED)} checks cached "
+              f"(families {', '.join(fams)})", flush=True)
     # ladder-relevant bf16 configs FIRST: if the tunnel wedges mid-run the
     # next window resumes from the cache, so the checks that actually gate
     # the headline rungs (causal bf16 flash at head_dim 128, bf16 fused LN,
