@@ -1,8 +1,10 @@
-"""paddle.incubate — experimental optimizers.
+"""paddle.incubate — experimental optimizers + auto-checkpoint.
 
-Reference: python/paddle/incubate/optimizer/{lookahead,modelaverage}.py.
+Reference: python/paddle/incubate/{optimizer/{lookahead,modelaverage},
+checkpoint}/__init__.py.
 """
+from . import checkpoint  # noqa: F401
 from . import optimizer  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
 
-__all__ = ["optimizer", "LookAhead", "ModelAverage"]
+__all__ = ["checkpoint", "optimizer", "LookAhead", "ModelAverage"]

@@ -1,0 +1,11 @@
+"""paddle.incubate.checkpoint namespace (reference
+python/paddle/incubate/checkpoint/__init__.py re-exports
+fluid.incubate.checkpoint.auto_checkpoint).  The TPU-native
+auto-checkpoint lives in framework/checkpoint.py (AutoCheckpoint:
+transparent periodic save + crash resume); this module is the v2.1
+import-path shim over it.
+"""
+from ...framework import checkpoint as auto_checkpoint  # noqa: F401
+from ...framework.checkpoint import AutoCheckpoint  # noqa: F401
+
+__all__ = ["auto_checkpoint", "AutoCheckpoint"]

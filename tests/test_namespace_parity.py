@@ -92,6 +92,7 @@ def _ref_all(rel):
     ("distributed", "python/paddle/distributed/__init__.py"),
     ("distributed.fleet", "python/paddle/distributed/fleet/__init__.py"),
     ("incubate", "python/paddle/incubate/__init__.py"),
+    ("incubate.checkpoint", "python/paddle/incubate/checkpoint/__init__.py"),
     ("text", "python/paddle/text/__init__.py"),
     ("nn.functional", "python/paddle/nn/functional/__init__.py"),
     ("metric", "python/paddle/metric/__init__.py"),
