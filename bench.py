@@ -142,34 +142,97 @@ def _time_steps(run_one, iters, fetch):
 # configs
 # ---------------------------------------------------------------------------
 
-def _fused_kernels_ok() -> bool:
-    """The Pallas fused LN/CE rungs are gated on FUSED_KERNELS_OK.json —
-    written by tools/check_flash_tpu.py only after the kernels pass their
-    on-device parity checks.  A compiling-but-wrong kernel must never be
-    able to produce a bench headline — which is also why a marker OLDER
-    than any kernel source is ignored: certification does not survive a
-    kernel edit."""
-    root = os.path.dirname(os.path.abspath(__file__))
-    marker = os.path.join(root, "FUSED_KERNELS_OK.json")
-    if not os.path.exists(marker):
-        return False
-    kdir = os.path.join(root, "paddle_tpu", "ops")
-    # import by path: the shared list must be readable without triggering
-    # the paddle_tpu package __init__ (and with it jax) in this process
+_MARKER_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "FUSED_KERNELS_OK.json")
+_CERT_MEMO: dict = {}
+
+
+def _tool(name):
+    """Load a tools/ module by path — no sys.path mutation, no jax."""
     import importlib.util
 
+    root = os.path.dirname(os.path.abspath(__file__))
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(root, "tools", name + ".py"))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    return m
+
+
+def _probed_device_kind() -> str:
+    """Chip kind from the last HEALTHY tunnel probe (jax-free) — the chip
+    this bench run is about to use.  Empty when no probe evidence
+    exists."""
     try:
-        spec = importlib.util.spec_from_file_location(
-            "certified", os.path.join(kdir, "certified.py"))
-        certified = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(certified)
-        kernels = [os.path.join(kdir, f)
-                   for f in certified.KERNEL_SOURCE_FILES]
-        return os.path.getmtime(marker) > max(os.path.getmtime(k)
-                                              for k in kernels)
+        for e in reversed(_tool("probe_tpu").read_log()):
+            if e.get("ok") and isinstance(e.get("detail"), dict):
+                return str(e["detail"].get("kind", ""))
+    except Exception:  # noqa: BLE001 - no log = no evidence
+        pass
+    return ""
+
+
+def _certified_families(device_kind: str | None = None) -> set:
+    """Families whose FUSED_KERNELS_OK.json signature matches the CURRENT
+    sources (tools/check_flash_tpu.py writes the marker per family after
+    on-device parity; tools/srcsig.family_signatures is the shared sig
+    computation).  A compiling-but-wrong kernel must never produce a
+    headline — content-hash validation means certification dies with any
+    edit to exactly the family it covers, and a w4 failure no longer
+    gates the training families (round-5 window 3).
+
+    ``device_kind``: the chip about to run — pass it when jax is live;
+    when None it resolves from the last healthy probe entry, so a marker
+    certified on one chip type cannot validate on another.  Only with
+    zero device evidence does the marker's own device stand in."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    try:
+        st = os.stat(_MARKER_PATH)
+        key = (st.st_mtime_ns, st.st_size, device_kind)
+        if _CERT_MEMO.get("key") == key:
+            return _CERT_MEMO["val"]
+        with open(_MARKER_PATH) as f:
+            rec = json.load(f)
+        families = rec.get("families")
+        if not isinstance(families, dict):
+            return set()  # pre-round-5 marker format: force re-cert
+        dk = device_kind or _probed_device_kind() or str(
+            rec.get("device", ""))
+        if dk != str(rec.get("device", "")):
+            return set()  # certified on a different chip type
+        current = _tool("srcsig").family_signatures(root, dk)
+        val = {fam for fam, sig in families.items()
+               if current.get(fam) == sig}
+        _CERT_MEMO.update(key=key, val=val)
+        return val
     except Exception:  # noqa: BLE001 - a broken/missing gate source means
         # "not certified", never a bench crash before rung selection
+        return set()
+
+
+def _fused_kernels_ok(device_kind: str | None = None) -> bool:
+    """True when every TRAINING family (flash, fused LN, fused CE) holds
+    fresh on-device certification — the gate for the ladder's fused
+    rungs."""
+    try:
+        import importlib.util
+
+        root = os.path.dirname(os.path.abspath(__file__))
+        spec = importlib.util.spec_from_file_location(
+            "certified", os.path.join(root, "paddle_tpu", "ops",
+                                      "certified.py"))
+        certified = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(certified)
+        need = set(certified.TRAINING_FAMILIES)
+    except Exception:  # noqa: BLE001
         return False
+    return need <= _certified_families(device_kind)
+
+
+def _w4_kernel_certified(device_kind: str | None = None) -> bool:
+    """The serving int4 arm enables the Pallas W4 kernel only under its
+    own family's fresh certification — independent of the training gate."""
+    return "w4" in _certified_families(device_kind)
 
 
 def _gpt_rungs():
@@ -1297,8 +1360,9 @@ def bench_serving(small: bool):
     makers = {"bf16": lambda: params,
               "int8": lambda: woq.quantize_gpt_int8(params),
               "int4": lambda: woq.quantize_gpt_int4(params)}
-    # Pallas W4 decode kernel: only under fresh on-device certification
-    if _fused_kernels_ok():
+    # Pallas W4 decode kernel: only under ITS OWN fresh on-device
+    # certification (independent of the training-family gate)
+    if _w4_kernel_certified(str(getattr(dev, "device_kind", ""))):
         os.environ.setdefault("PADDLE_TPU_W4_KERNEL", "1")
     sel = os.environ.get("BENCH_ARM")
     if sel:  # child mode: one arm, one JSON line (see _arm_results)

@@ -19,3 +19,26 @@ KERNEL_SOURCE_FILES = (
     "attention.py",
     "woq_matmul.py",
 )
+
+# Certification FAMILIES (round-5): the marker records a source signature
+# per family, so a failure or edit in one kernel can no longer gate the
+# others — the training rungs need only TRAINING_FAMILIES, while the
+# serving W4 kernel needs "w4".  Family values are ops/-relative files
+# (the kernel + its parity oracle); SHARED files and the checker script
+# fold into every family's signature.
+KERNEL_FAMILIES = {
+    "flash": ("flash_attention.py", "attention.py"),
+    "fused_ln": ("fused_norm.py",),
+    "fused_ce": ("fused_ce.py",),
+    "w4": ("woq_matmul.py",),
+}
+SHARED_KERNEL_FILES = ("_pallas_probe.py",)
+TRAINING_FAMILIES = ("flash", "fused_ln", "fused_ce")
+# repo-root-relative extra oracle sources a family's parity math uses
+FAMILY_EXTRA_SOURCES = {"w4": ("paddle_tpu/text/woq.py",)}
+
+# the families must exactly cover the registry — the same no-drift rule
+# the registry itself exists for
+assert (set(sum((list(v) for v in KERNEL_FAMILIES.values()),
+               list(SHARED_KERNEL_FILES)))
+        == set(KERNEL_SOURCE_FILES)), "KERNEL_FAMILIES drifted"

@@ -61,6 +61,86 @@ class TestFamilyCache:
 
         keys = re.findall(r'_cached\("([^"]+)"', src)
         assert keys, "no check keys found"
-        m = _load_module()
+        import importlib.util as iu
+
+        spec = iu.spec_from_file_location(
+            "certified", os.path.join(REPO, "paddle_tpu", "ops",
+                                      "certified.py"))
+        certified = iu.module_from_spec(spec)
+        spec.loader.exec_module(certified)
         for k in keys:
-            assert k.split(":", 1)[0] in m._PREFIX_SRCS, k
+            assert k.split(":", 1)[0] in certified.KERNEL_FAMILIES, k
+
+
+class TestFamilyMarkerGates:
+    """bench.py's gates validate FUSED_KERNELS_OK.json per family by
+    content signature: training rungs need flash+ln+ce; the serving W4
+    switch needs only w4 — and a w4 failure no longer gates training."""
+
+    def _bench(self, marker_path):
+        spec = importlib.util.spec_from_file_location(
+            "bench_under_test", os.path.join(REPO, "bench.py"))
+        m = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(m)
+        # never touch the repo-root marker: a test must not destroy a
+        # machine's live certification (review finding, round 5)
+        m._MARKER_PATH = str(marker_path)
+        return m
+
+    def _sigs(self, device="TPU v5 lite"):
+        import sys
+
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        from srcsig import family_signatures
+
+        return family_signatures(REPO, device)
+
+    DK = "TPU v5 lite"
+
+    def _marker(self, tmp_path, families, device=DK):
+        p = tmp_path / "FUSED_KERNELS_OK.json"
+        json.dump({"device": device, "families": families}, open(p, "w"))
+        return p
+
+    def test_training_gate_without_w4(self, tmp_path):
+        sigs = self._sigs()
+        p = self._marker(tmp_path, {f: sigs[f] for f in
+                                    ("flash", "fused_ln", "fused_ce")})
+        b = self._bench(p)
+        assert b._fused_kernels_ok(self.DK) is True
+        assert b._w4_kernel_certified(self.DK) is False
+
+    def test_w4_gate_independent(self, tmp_path):
+        sigs = self._sigs()
+        b = self._bench(self._marker(tmp_path, {"w4": sigs["w4"]}))
+        assert b._fused_kernels_ok(self.DK) is False
+        assert b._w4_kernel_certified(self.DK) is True
+
+    def test_stale_family_sig_rejected(self, tmp_path):
+        sigs = self._sigs()
+        fams = {f: sigs[f] for f in ("flash", "fused_ln", "fused_ce")}
+        fams["fused_ce"] = "stale0123456789ab:" + self.DK
+        b = self._bench(self._marker(tmp_path, fams))
+        assert b._fused_kernels_ok(self.DK) is False
+
+    def test_cross_chip_marker_rejected(self, tmp_path):
+        """A marker certified on one chip type must not validate on
+        another (review finding: the device check was self-referential)."""
+        sigs = self._sigs()
+        p = self._marker(tmp_path, {f: sigs[f] for f in
+                                    ("flash", "fused_ln", "fused_ce")})
+        b = self._bench(p)
+        assert b._fused_kernels_ok("TPU v4") is False
+
+    def test_old_format_marker_forces_recert(self, tmp_path):
+        p = tmp_path / "FUSED_KERNELS_OK.json"
+        json.dump({"device": self.DK, "checks": ["flash_attention"]},
+                  open(p, "w"))
+        b = self._bench(p)
+        assert b._fused_kernels_ok(self.DK) is False
+        assert b._w4_kernel_certified(self.DK) is False
+
+    def test_no_marker_means_uncertified(self, tmp_path):
+        b = self._bench(tmp_path / "absent.json")
+        assert b._fused_kernels_ok(self.DK) is False
+        assert b._w4_kernel_certified(self.DK) is False

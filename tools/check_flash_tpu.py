@@ -25,48 +25,22 @@ from paddle_tpu.ops.attention import xla_attention
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _CACHE = os.path.join(_REPO, "flash_check_cache.json")
-from paddle_tpu.ops.certified import KERNEL_SOURCE_FILES  # noqa: E402
 
 
-# check-key prefix -> the ops/ sources whose edit invalidates that
-# family's certification: the kernel itself and its parity oracle.
-# Folded into EVERY family: this checker script (an edited tolerance or
-# shape must re-certify everything it checks) and _pallas_probe.py
-# (shared runtime the kernels import — fused_norm/fused_ce take their
-# block geometry from it).  Coverage of certified.KERNEL_SOURCE_FILES is
-# asserted below so this map cannot silently drift from the registry the
-# bench gate keys on (the round-4 drift class certified.py exists to
-# prevent).
-_PREFIX_SRCS = {
-    "flash": ["flash_attention.py", "attention.py"],
-    "fused_ln": ["fused_norm.py"],
-    "fused_ce": ["fused_ce.py"],
-    "w4": ["woq_matmul.py"],
-}
-_SHARED_SRCS = ["_pallas_probe.py"]
-# every registered kernel source must feed some family's signature
-assert (set(sum(_PREFIX_SRCS.values(), _SHARED_SRCS))
-        == set(KERNEL_SOURCE_FILES)), (
-    "check_flash_tpu._PREFIX_SRCS drifted from certified.KERNEL_SOURCE_FILES")
-# non-ops oracles a family's parity math additionally depends on
-_EXTRA_SRCS = {"w4": [os.path.join("..", "text", "woq.py")]}
+# Families (kernel + oracle file sets, shared probe module, this checker)
+# live in paddle_tpu/ops/certified.py; the signature computation is shared
+# with bench.py's gates via tools/srcsig.family_signatures — one
+# implementation, no drift (the round-4 lesson certified.py encodes).
+from paddle_tpu.ops.certified import TRAINING_FAMILIES  # noqa: E402
 
 
 def _family_sigs(device_kind: str) -> dict:
     # script-dir insert: covers import-by-path (drive scripts), where
     # sys.path[0] is not tools/
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    from srcsig import source_signature
+    from srcsig import family_signatures
 
-    ops = os.path.join(_REPO, "paddle_tpu", "ops")
-    shared = ([os.path.join(ops, f) for f in _SHARED_SRCS]
-              + [os.path.abspath(__file__)])
-    return {pre: (source_signature(
-                      [os.path.join(ops, f) for f in rel]
-                      + [os.path.join(ops, f)
-                         for f in _EXTRA_SRCS.get(pre, [])]
-                      + shared) + ":" + device_kind)
-            for pre, rel in _PREFIX_SRCS.items()}
+    return family_signatures(_REPO, device_kind)
 
 
 def _load_cache(sigs: dict) -> set:
@@ -237,6 +211,27 @@ if __name__ == "__main__":
     _cached("fused_ce:N256V1024:f32",
             lambda: check_fused_ce(256, 1024, jnp.float32))
     print("fused softmax-CE fwd+bwd all OK", flush=True)
+
+    # marker with PER-FAMILY signatures, written INCREMENTALLY: the
+    # training families (flash/ln/ce) certify the bench ladder's fused
+    # rungs the moment they all pass — a later w4 failure (round-5
+    # window 3: the W4 kernel's first on-device compile died in Mosaic)
+    # must not gate the training headline with it.  bench.py validates
+    # each family by recomputing the same content signature, so a
+    # kernel edit invalidates exactly its own family.
+    import datetime
+
+    def _write_marker(families: dict):
+        with open(_marker, "w") as f:
+            json.dump({"ts": datetime.datetime.now(datetime.timezone.utc)
+                       .isoformat(timespec="seconds"),
+                       "device": str(jax.devices()[0].device_kind),
+                       "families": families}, f, indent=2)
+        print(f"wrote {_marker} (families: {sorted(families)})",
+              flush=True)
+
+    _write_marker({fam: _SIG[fam] for fam in TRAINING_FAMILIES})
+
     # W4 decode kernel: the serving-relevant GPT-350M shapes (D=1024,
     # F=4096, gs=64) at decode batch 8
     _cached("w4:N8K1024M4096gs64:bf16",
@@ -246,15 +241,5 @@ if __name__ == "__main__":
     _cached("w4:N3K1024M1024gs64:bf16",
             lambda: check_w4_matmul(3, 1024, 1024, 64, jnp.bfloat16))
     print("w4 dequant-matmul all OK", flush=True)
-    # certify the fused LN/CE kernels for the bench ladder: bench.py only
-    # offers its fused rungs when this marker exists (a compiling-but-wrong
-    # kernel must never produce a headline number)
-    import datetime
-    with open(_marker, "w") as f:
-        json.dump({"ts": datetime.datetime.now(datetime.timezone.utc)
-                   .isoformat(timespec="seconds"),
-                   "device": str(jax.devices()[0].device_kind),
-                   "checks": ["flash_attention", "fused_layer_norm",
-                              "fused_softmax_ce", "w4_matmul"]}, f,
-                  indent=2)
-    print(f"wrote {_marker}", flush=True)
+    _write_marker(dict({fam: _SIG[fam] for fam in TRAINING_FAMILIES},
+                       w4=_SIG["w4"]))

@@ -19,3 +19,30 @@ def source_signature(paths) -> str:
         except OSError:
             h.update(b"missing:" + p.encode())
     return h.hexdigest()[:16]
+
+
+def family_signatures(repo_root: str, device_kind: str) -> dict:
+    """Per-certification-family content signatures (jax-free).
+
+    One implementation shared by tools/check_flash_tpu.py (writes the
+    marker) and bench.py's gates (validate it) — the sig covers the
+    family's kernel + oracle files, the shared Pallas probe module, any
+    extra oracle sources, and the checker script itself, suffixed with
+    the device kind so certification never crosses chip types.
+    """
+    import importlib.util
+
+    ops = os.path.join(repo_root, "paddle_tpu", "ops")
+    spec = importlib.util.spec_from_file_location(
+        "certified", os.path.join(ops, "certified.py"))
+    certified = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(certified)
+    checker = os.path.join(repo_root, "tools", "check_flash_tpu.py")
+    shared = ([os.path.join(ops, f)
+               for f in certified.SHARED_KERNEL_FILES] + [checker])
+    return {fam: (source_signature(
+                      [os.path.join(ops, f) for f in rel]
+                      + [os.path.join(repo_root, p) for p in
+                         certified.FAMILY_EXTRA_SOURCES.get(fam, ())]
+                      + shared) + ":" + device_kind)
+            for fam, rel in certified.KERNEL_FAMILIES.items()}
