@@ -151,6 +151,19 @@ def main():
     # remat variants: which compile, how long, compiled temp memory
     report["remat_variants"] = remat or {"status": "absent"}
 
+    # serving quantization ablation: generated-tok/s bf16 vs weight-only
+    # int8 vs int4 from the dedicated serving step (on-device only), plus
+    # the W4-kernel engagement counters when the int4 arm recorded them
+    srv = _load("serving_tpu.json")
+    if srv and srv.get("device") in ("tpu", "axon"):
+        report["serving_quant_ablation"] = {
+            k: srv[k] for k in ("ts", "device_kind", "batch", "prompt_len",
+                                "new_tokens", "block", "bf16_tok_s",
+                                "int8_tok_s", "int8_vs_bf16", "int4_tok_s",
+                                "int4_vs_bf16", "w4") if k in srv}
+    else:
+        report["serving_quant_ablation"] = {"status": "absent"}
+
     # HBM calibration: measured high-water vs the static pre-filter
     # estimate, per rung that actually ran
     cal = []
