@@ -159,17 +159,35 @@ def _tool(name):
     return m
 
 
+_PROBE_KIND_MEMO: dict = {}
+
+
 def _probed_device_kind() -> str:
     """Chip kind from the last HEALTHY tunnel probe (jax-free) — the chip
     this bench run is about to use.  Empty when no probe evidence
-    exists."""
+    exists.  Memoized on the log's (mtime, size): resolution execs
+    tools/probe_tpu.py and reads the whole log, and _certified_families
+    now consults it on every memo-hit path."""
+    log = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "tpu_probe_log.jsonl")
+    try:
+        st = os.stat(log)
+        key = (st.st_mtime_ns, st.st_size)
+    except OSError:
+        key = None
+    if key is not None and _PROBE_KIND_MEMO.get("key") == key:
+        return _PROBE_KIND_MEMO["val"]
+    val = ""
     try:
         for e in reversed(_tool("probe_tpu").read_log()):
             if e.get("ok") and isinstance(e.get("detail"), dict):
-                return str(e["detail"].get("kind", ""))
+                val = str(e["detail"].get("kind", ""))
+                break
     except Exception:  # noqa: BLE001 - no log = no evidence
         pass
-    return ""
+    if key is not None:
+        _PROBE_KIND_MEMO.update(key=key, val=val)
+    return val
 
 
 def _certified_families(device_kind: str | None = None) -> set:
@@ -188,7 +206,12 @@ def _certified_families(device_kind: str | None = None) -> set:
     root = os.path.dirname(os.path.abspath(__file__))
     try:
         st = os.stat(_MARKER_PATH)
-        key = (st.st_mtime_ns, st.st_size, device_kind)
+        # key on the PROBE-RESOLVED chip kind, not the raw argument: with
+        # device_kind None/'' the probe log decides, and a new healthy
+        # entry for a different chip must invalidate the memo rather than
+        # return the old chip's certification set
+        resolved = device_kind or _probed_device_kind()
+        key = (st.st_mtime_ns, st.st_size, resolved)
         if _CERT_MEMO.get("key") == key:
             return _CERT_MEMO["val"]
         with open(_MARKER_PATH) as f:
@@ -196,8 +219,7 @@ def _certified_families(device_kind: str | None = None) -> set:
         families = rec.get("families")
         if not isinstance(families, dict):
             return set()  # pre-round-5 marker format: force re-cert
-        dk = device_kind or _probed_device_kind() or str(
-            rec.get("device", ""))
+        dk = resolved or str(rec.get("device", ""))
         if dk != str(rec.get("device", "")):
             return set()  # certified on a different chip type
         current = _tool("srcsig").family_signatures(root, dk)
@@ -683,7 +705,10 @@ def _arm_results(config_name, arm_names, measure_inproc, small, dev):
     for arm in arm_names:
         if not isolate:
             try:
-                res[arm] = {"tok_s": measure_inproc(arm)}
+                r = measure_inproc(arm)
+                # measurers may return bare tok/s or a dict with
+                # diagnostics (first_token_ms, warmup_s)
+                res[arm] = dict(r) if isinstance(r, dict) else {"tok_s": r}
                 if arm == "int4":
                     res[arm]["w4"] = _w4_stats()
             except Exception as e:  # noqa: BLE001 - record, keep others
@@ -730,6 +755,9 @@ def _assemble_arm_record(out, res, arm_names, ratio_ref, headline_arm,
             out[f"{arm}_tok_s"] = round(r["tok_s"], 1)
             if "w4" in r:  # actual kernel engagement, not the env flag
                 out[f"{arm}_w4"] = r["w4"]
+            for extra in ("first_token_ms", "warmup_s"):
+                if extra in r:  # post-warmup serving diagnostics
+                    out[f"{arm}_{extra}"] = r[extra]
             _log(f"[bench] {log_of} {arm}: {r['tok_s']:,.0f} tok/s")
             if arm != ratio_ref and ref:
                 out[f"{arm}_vs_{ratio_ref}"] = round(r["tok_s"] / ref, 3)
@@ -766,9 +794,46 @@ def _run_rung_child(name: str, timeout: float):
     return None, f"{name}: rc={out.returncode}", False
 
 
+def _decode_smoke():
+    """Warmup + donated + async decode smoke, run by ``--config gpt
+    --small`` (CI): exercises the exact serving hot path the TPU bench
+    uses — KV-cache donation, async dispatch, warmup — on a tiny config
+    and RAISES on any shape/aliasing/parity error, so a donation
+    regression fails CI before it burns a TPU window."""
+    import numpy as np
+    import jax
+
+    from paddle_tpu import flags
+    from paddle_tpu.text import gpt, serving
+
+    cfg = gpt.GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                        num_heads=4, max_seq_len=64)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    prompts = np.random.default_rng(0).integers(1, 100, (3, 5))
+
+    def pass_(async_):
+        srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=32,
+                                   async_dispatch=async_)
+        wt = srv.warmup(prompt_lens=[5], blocks=(4,)) if async_ else {}
+        rids = [srv.submit(prompts[i], max_new_tokens=6) for i in range(3)]
+        while srv.pending():
+            srv.tick_block(4)
+        return [srv.result(r) for r in rids], wt
+
+    sync_toks, _ = pass_(False)
+    async_toks, wt = pass_(True)
+    if sync_toks != async_toks:
+        raise AssertionError(
+            f"async/sync decode divergence: {async_toks} vs {sync_toks}")
+    return {"ok": True, "tokens": sum(len(t) for t in async_toks),
+            "donate": flags.donate_decode(), "warmed": sorted(wt)}
+
+
 def bench_gpt(small: bool):
     if small:
-        return _run_gpt_rung(-1)
+        rec = _run_gpt_rung(-1)
+        rec["decode_smoke"] = _decode_smoke()
+        return rec
 
     # full ladder: one subprocess per rung so a hung/slow remote compile
     # cannot take down the whole bench (round-1 lesson), with a static
@@ -1236,21 +1301,38 @@ def bench_decode(small: bool):
                                          max_new_tokens=new_toks,
                                          temperature=0.0, key=key)
 
+        # first_token_ms: post-warmup latency of a single-token continue
+        # (prefill the prompt + 1 decode step) — its executable warms on
+        # the first call, then one timed run; kept OUT of the throughput
+        # timing so re-launch compiles can't pollute the headline
+        def one_tok():
+            y = generate.generate(p, cfg, prompt, max_new_tokens=1,
+                                  temperature=0.0, key=key)
+            jax.block_until_ready(y)
+
+        one_tok()  # compile + warmup (persistent cache hit on relaunch)
+        t0 = time.perf_counter()
+        one_tok()
+        ft_ms = (time.perf_counter() - t0) * 1e3
         dt = _time_steps(one, iters, lambda: box["y"])
         # every call runs P-1 prefill + new_toks decode steps, each a full
         # weight read — count them all, not just the new tokens
-        return B * (prompt.shape[1] + new_toks - 1) / dt
+        return {"tok_s": B * (prompt.shape[1] + new_toks - 1) / dt,
+                "first_token_ms": round(ft_ms, 2)}
 
     makers = {"float": lambda: params,
               "int8": lambda: woq.quantize_gpt_int8(params),
               "int4": lambda: woq.quantize_gpt_int4(params)}
-    # Pallas W4 decode kernel: only under fresh on-device certification
-    # (setdefault: an operator's explicit =0 pins the A/B's off arm)
-    if _fused_kernels_ok():
+    # Pallas W4 decode kernel: only under ITS OWN family's fresh
+    # certification — the training-family gate (_fused_kernels_ok) says
+    # nothing about w4, and an uncertified W4 kernel must never produce
+    # a headline (ADVICE r5 high: the serving arm was fixed, decode
+    # missed).  setdefault: an operator's explicit =0 pins the off arm.
+    if _w4_kernel_certified(str(getattr(dev, "device_kind", ""))):
         os.environ.setdefault("PADDLE_TPU_W4_KERNEL", "1")
     sel = os.environ.get("BENCH_ARM")
     if sel:  # child mode: one arm, one JSON line (see _arm_results)
-        rec = {"arm": sel, "tok_s": tok_s(makers[sel]())}
+        rec = dict({"arm": sel}, **tok_s(makers[sel]()))
         if sel == "int4":
             rec["w4"] = _w4_stats()
         return rec
@@ -1275,6 +1357,7 @@ def bench_serving(small: bool):
     import jax
     import jax.numpy as jnp
 
+    from paddle_tpu import flags
     from paddle_tpu.text import gpt, serving, woq
 
     dev = jax.devices()[0]
@@ -1336,9 +1419,18 @@ def bench_serving(small: bool):
     rng = np.random.default_rng(0)
     prompts = rng.integers(0, cfg.vocab_size, (B, p_len))
 
+    # async dispatch (one block in flight) is the serving default — the
+    # tokens are bit-identical to the sync path (tests pin the parity);
+    # BENCH_SERVING_ASYNC=0 pins an A/B's sync arm
+    use_async = os.environ.get("BENCH_SERVING_ASYNC", "1") != "0"
+
+    def make_srv(p):
+        return serving.DecodeServer(p, cfg, max_batch=B,
+                                    max_len=p_len + new_toks,
+                                    async_dispatch=use_async)
+
     def serve_pass(p):
-        srv = serving.DecodeServer(p, cfg, max_batch=B,
-                                   max_len=p_len + new_toks)
+        srv = make_srv(p)
         for b in range(B):
             srv.submit(prompts[b], max_new_tokens=new_toks)
         while srv.pending():
@@ -1346,7 +1438,20 @@ def bench_serving(small: bool):
         return srv
 
     def tok_s(p):
-        srv = serve_pass(p)          # compile + warmup
+        # explicit warmup: pre-compile the prefill bucket + block step
+        # (and the persistent compile cache makes relaunches disk reads),
+        # so the timed passes and the first-token diagnostic are pure
+        # device/host work
+        t0 = time.perf_counter()
+        srv = make_srv(p)
+        srv.warmup(prompt_lens=[p_len], blocks=(block,))
+        warmup_s = time.perf_counter() - t0
+        # post-warmup first-token latency: submit() runs the compiled
+        # prefill and yields the request's first token at admission
+        t0 = time.perf_counter()
+        srv.submit(prompts[0], max_new_tokens=new_toks)
+        first_ms = (time.perf_counter() - t0) * 1e3
+        srv = serve_pass(p)          # steady-state warm pass
         _sync_all(srv.cache)
         t0 = time.perf_counter()
         for _ in range(iters):
@@ -1355,7 +1460,9 @@ def bench_serving(small: bool):
         dt = (time.perf_counter() - t0) / iters
         # prefill tokens are device work too, but the serving headline is
         # the GENERATED rate (prompts admit in one prefill step each)
-        return B * new_toks / dt
+        return {"tok_s": B * new_toks / dt,
+                "first_token_ms": round(first_ms, 2),
+                "warmup_s": round(warmup_s, 2)}
 
     makers = {"bf16": lambda: params,
               "int8": lambda: woq.quantize_gpt_int8(params),
@@ -1366,7 +1473,7 @@ def bench_serving(small: bool):
         os.environ.setdefault("PADDLE_TPU_W4_KERNEL", "1")
     sel = os.environ.get("BENCH_ARM")
     if sel:  # child mode: one arm, one JSON line (see _arm_results)
-        rec = {"arm": sel, "tok_s": tok_s(serving_tree(makers[sel]()))}
+        rec = dict({"arm": sel}, **tok_s(serving_tree(makers[sel]())))
         if sel == "int4":
             rec["w4"] = _w4_stats()
         return rec
@@ -1377,7 +1484,9 @@ def bench_serving(small: bool):
            "device": dev.platform,
            "device_kind": str(getattr(dev, "device_kind", "")),
            "batch": B, "prompt_len": p_len, "new_tokens": new_toks,
-           "block": block, "vs_baseline": 0.0}
+           "block": block, "async": use_async,
+           "donate": flags.donate_decode(),
+           "vs_baseline": 0.0}
     res = _arm_results("serving", list(makers),
                        lambda a: tok_s(serving_tree(makers[a]())),
                        small, dev)

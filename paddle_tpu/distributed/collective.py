@@ -25,7 +25,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map as _shard_map
+from ..compat import shard_map as _shard_map
 
 from ..core.tensor import Tensor
 from .env import get_mesh

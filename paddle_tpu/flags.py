@@ -65,5 +65,21 @@ def flag(name: str, default=None):
     return _REGISTRY.get(name, default)
 
 
+def donate_decode() -> bool:
+    """KV-cache buffer donation on the decode/serving hot path (ON by
+    default).
+
+    When on, every jitted decode/prefill/sample step donates its cache
+    argument (``donate_argnums``), so XLA aliases the [L, B, T, Hkv, hd]
+    K/V buffers in place instead of allocating + copying them per token.
+    ``PADDLE_TPU_DONATE_DECODE=0`` is the escape hatch — donation is
+    baked into the compiled executable at trace time, so the flag is
+    part of the decode jit-cache key (generate._cfg_key): flipping it
+    mid-process retraces rather than silently reusing the other
+    routing's executable."""
+    v = os.environ.get("PADDLE_TPU_DONATE_DECODE", "1").strip().lower()
+    return v not in ("0", "false", "off", "no")
+
+
 if _ENV_SEEDED:
     set_flags(_ENV_SEEDED)

@@ -56,6 +56,17 @@ class Config:
         self._dtype = jnp.bfloat16
         return self
 
+    def enable_buffer_donation(self, flag: bool = True):
+        """Donate the predictor's input buffers to the compiled call
+        (``donate_argnums`` over every input): XLA may then reuse input
+        HBM for the outputs instead of allocating fresh buffers — the
+        serving-path aliasing optimization, applied to the whole
+        artifact signature.  Callers passing device arrays must treat
+        them as CONSUMED after ``run`` (host numpy inputs are unaffected:
+        the donated buffer is the transfer's device copy)."""
+        self._donate_inputs = bool(flag)
+        return self
+
     # reference knobs that are XLA's job here — accepted as no-ops
     def switch_ir_optim(self, *_a, **_k):
         return self
@@ -77,6 +88,7 @@ def save_inference_model(path_prefix: str, fn_or_layer, example_inputs,
     serving signature.
     """
     import jax
+    import jax.export  # lazy submodule: explicit import required on jax<0.5
 
     from ..core.tensor import Tensor
 
@@ -128,6 +140,7 @@ class Predictor:
 
     def __init__(self, config: Config):
         import jax
+        import jax.export  # lazy submodule: explicit import required on jax<0.5
 
         prefix = config.model_path()
         if prefix is None:
@@ -137,7 +150,12 @@ class Predictor:
         with open(prefix + ".json") as f:
             self._manifest = json.load(f)
         self._cfg = config
-        self._call = jax.jit(self._exported.call)
+        # Config.enable_buffer_donation: alias input HBM into the outputs
+        # (inputs whose shape/dtype match no output still copy — XLA
+        # decides per buffer)
+        donate = (tuple(range(len(self._manifest["inputs"])))
+                  if config._donate_inputs else ())
+        self._call = jax.jit(self._exported.call, donate_argnums=donate)
         self._inputs: dict[str, Any] = {}
         self._outputs: Sequence[Any] = ()
 

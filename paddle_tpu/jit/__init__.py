@@ -20,6 +20,7 @@ import functools
 from typing import Any, Callable
 
 import jax
+import jax.export  # lazy submodule: explicit import required on jax<0.5
 import jax.numpy as jnp
 
 from ..core.autograd import no_grad

@@ -606,7 +606,10 @@ _LOWER = {
 def _lower_top_k(g, eqn, ins):
     p = eqn.params
     k = g.const(np.asarray([p["k"]], np.int64), "k")
-    attrs = (_attr_int("axis", p["axis"]) + _attr_int("largest", 1)
+    # the pinned jax's top_k primitive carries no axis param (it always
+    # reduces the last axis; the param only exists on newer jax)
+    axis = p.get("axis", eqn.invars[0].aval.ndim - 1)
+    attrs = (_attr_int("axis", axis) + _attr_int("largest", 1)
              + _attr_int("sorted", 1))
     vals, idx = g.add("TopK", [ins[0], k],
                       outputs=[g.fresh("topk_v"), g.fresh("topk_i")],
@@ -779,8 +782,8 @@ def emit_model(fn, example_args, producer="paddle_tpu") -> bytes:
         for eqn in jaxpr_inner.eqns:
             prim = eqn.primitive.name
             if prim in ("jit", "pjit", "custom_jvp_call", "custom_vjp_call",
-                        "custom_jvp_call_jaxpr", "closed_call",
-                        "remat", "checkpoint"):
+                        "custom_jvp_call_jaxpr", "custom_vjp_call_jaxpr",
+                        "closed_call", "remat", "checkpoint"):
                 import types
 
                 inner = eqn.params.get("jaxpr") or eqn.params.get(

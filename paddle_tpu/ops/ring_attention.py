@@ -33,6 +33,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..compat import axis_size as _axis_size
+
 _NEG = -1e30
 
 
@@ -146,7 +148,7 @@ def ring_attention(q, k, v, axis: str, causal: bool = True, scale=None,
     """
     B, Tl, H, D = q.shape
     scale = scale if scale is not None else 1.0 / (D**0.5)
-    R = lax.axis_size(axis)
+    R = _axis_size(axis)
     my = lax.axis_index(axis)
     perm = [(i, (i + 1) % R) for i in range(R)]  # pass kv forward round-robin
 
@@ -246,7 +248,7 @@ def ring_attention_zigzag(q, k, v, axis: str, scale=None,
         raise ValueError("zigzag local chunk must hold an even row count")
     Tc = T2 // 2
     scale = scale if scale is not None else 1.0 / (D**0.5)
-    R = lax.axis_size(axis)
+    R = _axis_size(axis)
     my = lax.axis_index(axis)
     perm = [(i, (i + 1) % R) for i in range(R)]
 

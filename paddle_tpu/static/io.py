@@ -15,6 +15,7 @@ import os
 import pickle
 
 import jax
+import jax.export  # lazy submodule: explicit import required on jax<0.5
 import jax.numpy as jnp
 import numpy as np
 

@@ -172,6 +172,21 @@ import os as _os
 _GEN_CACHE = _LRU(int(_os.environ.get("PADDLE_TPU_GEN_CACHE_SIZE", "64")))
 
 
+def _donate_cache():
+    """``donate_argnums`` for the decode-path jits, whose cache is arg 1.
+
+    Donation lets XLA alias the [L, B, T, Hkv, hd] K/V buffers in place
+    instead of allocating + copying the whole cache every token — the
+    hot-path optimization this serving stack's throughput stands on.
+    Callers of a donated step MUST treat the passed cache as consumed
+    (reassign from the return value; every call site in this repo does).
+    ``PADDLE_TPU_DONATE_DECODE=0`` turns it off (flags.donate_decode);
+    the flag is part of _cfg_key so flipping it retraces."""
+    from .. import flags
+
+    return (1,) if flags.donate_decode() else ()
+
+
 def _cfg_key(cfg):
     """Value-based cache key (GPTConfig is an unhashable dataclass; keying
     by id() would recompile per object and leak executables)."""
@@ -191,7 +206,10 @@ def _cfg_key(cfg):
             # at trace time) — flipping a flag mid-process must retrace,
             # not silently reuse the other routing's executable
             _os.environ.get("PADDLE_TPU_W4_KERNEL", ""),
-            _os.environ.get("PADDLE_TPU_FUSED_LN", ""))
+            _os.environ.get("PADDLE_TPU_FUSED_LN", ""),
+            # donation is baked into the executable (aliased vs copied
+            # cache buffers) — same retrace-on-flip rule as the kernels
+            _os.environ.get("PADDLE_TPU_DONATE_DECODE", ""))
 
 
 def _get_generate_fn(cfg, max_new_tokens, top_k, top_p=1.0):
@@ -462,7 +480,10 @@ def build_sharded_decode(params, cfg: gpt.GPTConfig, mesh, mp: str = "mp"):
             {"k": ns(cache_spec), "v": ns(cache_spec)},
             ns(repl), ns(repl)),
         out_shardings=(ns(repl),
-                       {"k": ns(cache_spec), "v": ns(cache_spec)}))
+                       {"k": ns(cache_spec), "v": ns(cache_spec)}),
+        # the sharded cache is donated like the single-chip steps' —
+        # in and out shardings match, so aliasing is exact per shard
+        donate_argnums=_donate_cache())
 
     def make_cache(batch: int, max_len: int):
         return jax.tree_util.tree_map(
@@ -694,11 +715,13 @@ def verify_chunk(params, cache, tokens, pos0, cfg: gpt.GPTConfig):
 
 def _jit_by_cfg(tag: str, fn, cfg):
     """Value-keyed jit cache (the _GEN_CACHE rationale: per-call jax.jit
-    wrappers would recompile per invocation and leak executables)."""
+    wrappers would recompile per invocation and leak executables).  The
+    cache (arg 1) is DONATED — callers reassign it from the return."""
     key = (tag, _cfg_key(cfg))
     jf = _GEN_CACHE.get(key)
     if jf is None:
-        jf = jax.jit(lambda p, c, t, s, _cfg=cfg: fn(p, c, t, s, _cfg))
+        jf = jax.jit(lambda p, c, t, s, _cfg=cfg: fn(p, c, t, s, _cfg),
+                     donate_argnums=_donate_cache())
         _GEN_CACHE[key] = jf
     return jf
 

@@ -35,7 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from ..compat import shard_map
 
 from ..distributed import megatron as mt
 from ..ops.ring_attention import ring_attention, ring_attention_zigzag

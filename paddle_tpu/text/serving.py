@@ -132,7 +132,8 @@ def _get_prefill_fn(cfg: gpt.GPTConfig):
     fn = _STEP_CACHE.get(k)
     if fn is None:
         fn = jax.jit(lambda p, c, t, ln, sl, _cfg=cfg:
-                     generate.prefill_slot(p, c, t, ln, sl, _cfg))
+                     generate.prefill_slot(p, c, t, ln, sl, _cfg),
+                     donate_argnums=generate._donate_cache())
         _STEP_CACHE[k] = fn
     return fn
 
@@ -143,7 +144,8 @@ def _get_prefill_chunk_fn(cfg: gpt.GPTConfig):
     if fn is None:
         fn = jax.jit(lambda p, c, t, p0, ln, sl, _cfg=cfg:
                      generate.prefill_slot_chunk(p, c, t, p0, ln, sl,
-                                                 _cfg))
+                                                 _cfg),
+                     donate_argnums=generate._donate_cache())
         _STEP_CACHE[k] = fn
     return fn
 
@@ -153,7 +155,8 @@ def _get_block_fn(cfg: gpt.GPTConfig, k: int):
     fn = _STEP_CACHE.get(key)
     if fn is None:
         fn = jax.jit(lambda p, c, t, s, _cfg=cfg, _k=k:
-                     decode_block_batched(p, c, t, s, _k, _cfg))
+                     decode_block_batched(p, c, t, s, _k, _cfg),
+                     donate_argnums=generate._donate_cache())
         _STEP_CACHE[key] = fn
     return fn
 
@@ -163,7 +166,8 @@ def _get_sample_step_fn(cfg: gpt.GPTConfig):
     fn = _STEP_CACHE.get(k)
     if fn is None:
         fn = jax.jit(lambda p, c, t, s, ky, te, tk, tp, _cfg=cfg:
-                     sample_step_batched(p, c, t, s, ky, te, tk, tp, _cfg))
+                     sample_step_batched(p, c, t, s, ky, te, tk, tp, _cfg),
+                     donate_argnums=generate._donate_cache())
         _STEP_CACHE[k] = fn
     return fn
 
@@ -174,7 +178,8 @@ def _get_sample_block_fn(cfg: gpt.GPTConfig, k: int):
     if fn is None:
         fn = jax.jit(lambda p, c, t, s, ky, off, te, tk, tp, _cfg=cfg,
                      _k=k: sample_block_batched(p, c, t, s, ky, off, te,
-                                                tk, tp, _k, _cfg))
+                                                tk, tp, _k, _cfg),
+                     donate_argnums=generate._donate_cache())
         _STEP_CACHE[key] = fn
     return fn
 
@@ -182,13 +187,64 @@ def _get_sample_block_fn(cfg: gpt.GPTConfig, k: int):
 def _get_step_fn(cfg: gpt.GPTConfig):
     """One jitted batched step per config VALUE (generate._GEN_CACHE's
     rationale: keying by object identity would recompile per DecodeServer
-    and leak executables)."""
+    and leak executables).  Every step fn here DONATES its cache (arg 1,
+    generate._donate_cache): the caller must reassign the cache from the
+    return value — DecodeServer always does."""
     k = generate._cfg_key(cfg)
     fn = _STEP_CACHE.get(k)
     if fn is None:
         fn = jax.jit(lambda p, c, t, s, _cfg=cfg: decode_step_batched(
-            p, c, t, s, _cfg))
+            p, c, t, s, _cfg),
+            donate_argnums=generate._donate_cache())
         _STEP_CACHE[k] = fn
+    return fn
+
+
+def _get_async_step_fn(cfg: gpt.GPTConfig):
+    """The async-dispatch tick step: like _get_sample_step_fn but the
+    feed token is selected ON DEVICE between the host-built token and
+    the previous (still in flight, unfetched) step's output — ``pm``
+    [B] bool picks ``pv`` (previous device tokens) over ``ht`` (host
+    tokens).  Greedy slots pass temp 0 and take the raw argmax, so one
+    executable serves greedy and sampled async ticks bit-identically to
+    the sync paths."""
+    k = ("async", generate._cfg_key(cfg))
+    fn = _STEP_CACHE.get(k)
+    if fn is None:
+        fn = jax.jit(lambda p, c, ht, pm, pv, s, ky, te, tk, tp, _cfg=cfg:
+                     sample_step_batched(p, c, jnp.where(pm, pv, ht), s,
+                                         ky, te, tk, tp, _cfg),
+                     donate_argnums=generate._donate_cache())
+        _STEP_CACHE[k] = fn
+    return fn
+
+
+def _get_async_block_fn(cfg: gpt.GPTConfig, k: int):
+    """Async greedy block: decode_block_batched with the device-side
+    feed select (see _get_async_step_fn)."""
+    key = ("async_block", generate._cfg_key(cfg), k)
+    fn = _STEP_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(lambda p, c, ht, pm, pv, s, _cfg=cfg, _k=k:
+                     decode_block_batched(p, c, jnp.where(pm, pv, ht), s,
+                                          _k, _cfg),
+                     donate_argnums=generate._donate_cache())
+        _STEP_CACHE[key] = fn
+    return fn
+
+
+def _get_async_sample_block_fn(cfg: gpt.GPTConfig, k: int):
+    """Async sampled block: sample_block_batched with the device-side
+    feed select (see _get_async_step_fn)."""
+    key = ("async_sample_block", generate._cfg_key(cfg), k)
+    fn = _STEP_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(lambda p, c, ht, pm, pv, s, ky, off, te, tk, tp,
+                     _cfg=cfg, _k=k:
+                     sample_block_batched(p, c, jnp.where(pm, pv, ht), s,
+                                          ky, off, te, tk, tp, _k, _cfg),
+                     donate_argnums=generate._donate_cache())
+        _STEP_CACHE[key] = fn
     return fn
 
 
@@ -209,7 +265,8 @@ class DecodeServer:
     def __init__(self, params, cfg: gpt.GPTConfig, max_batch: int,
                  max_len: int, eos_id: int | None = None,
                  prefill: bool = True, seed: int = 0,
-                 prefill_chunk: int | None = None):
+                 prefill_chunk: int | None = None,
+                 async_dispatch: bool = False):
         self.params = params
         self.cfg = cfg
         self.max_batch = max_batch
@@ -217,6 +274,17 @@ class DecodeServer:
         self.eos_id = eos_id
         self.cache = generate.init_cache(cfg, max_batch, max_len)
         self._step = _get_step_fn(cfg)
+        # async_dispatch: keep ONE step/block in flight — tick() first
+        # dispatches step N+1 (feeding the previous step's tokens from
+        # the DEVICE array, never fetched) and only then blocks on step
+        # N's tokens for host bookkeeping, overlapping host scheduling
+        # with device compute.  Per-request tokens are identical to the
+        # sync path; the one observable schedule shift is that a QUEUED
+        # request admits one tick later after a retire (for sampled
+        # requests that shifts WHICH global steps the slot occupies —
+        # the documented batched-serving dependence above).
+        self._async = bool(async_dispatch)
+        self._inflight: dict | None = None
         # per-request sampling (round-5): one base key; device step n
         # draws with fold_in(base, n) — the same schedule for tick and
         # tick_block, so the two paths produce identical samples.  A
@@ -402,6 +470,7 @@ class DecodeServer:
         self._step = None
         self._prefill = None
         self._prefill_chunk = None
+        self._inflight = None
         for st in self._slots.values():
             self._dropped.add(st["rid"])
         for req in self._queue:
@@ -429,7 +498,15 @@ class DecodeServer:
     def _feed_arrays(self):
         """The batched (tok, pos) feed for the current slots: the token
         fed at position i is sequence[i] — prompt while i is inside it,
-        the generated tail after."""
+        the generated tail after.
+
+        Donation audit: this (and every host-side helper here) reads
+        only the per-slot HOST state (prompt/generated/pos lists) —
+        never the device cache, whose buffers the jitted steps donate
+        and whose old generations are therefore deleted.  The only
+        device arrays the server retains are ``self.cache`` (always the
+        newest, reassigned at every step) and the async in-flight token
+        array (an output, never donated)."""
         tok = np.zeros((self.max_batch,), np.int32)
         pos = np.zeros((self.max_batch,), np.int32)
         for slot, st in self._slots.items():
@@ -466,6 +543,9 @@ class DecodeServer:
         self._admit()
 
     def tick(self):
+        if self._async:
+            self._tick_async()
+            return
         if not self._slots:
             self._admit()
             if not self._slots:
@@ -498,6 +578,272 @@ class DecodeServer:
                 done.append(slot)
         self._retire(done)
 
+    # -- async dispatch: one step/block in flight ---------------------------
+
+    def _dispatch_feed(self, prev, block: int = 1):
+        """Host-side feed snapshot for an async dispatch.
+
+        Returns (host_tok, prev_mask, pos, temp, tk, tp, snap): per slot,
+        the feed token comes from the host (prompt, or a generated token
+        already fetched) unless it is the output of the still-in-flight
+        previous dispatch — then ``prev_mask`` routes the DEVICE array
+        through the jitted select instead (no host round trip).  ``snap``
+        records (slot, st, fed_pos) for the deferred bookkeeping; each
+        slot's pos advances by ``block`` optimistically (a slot that
+        finishes mid-block retires at process time, where its stale pos
+        no longer matters)."""
+        B = self.max_batch
+        ht = np.zeros((B,), np.int32)
+        pm = np.zeros((B,), bool)
+        pos = np.zeros((B,), np.int32)
+        temp = np.zeros((B,), np.float32)
+        tk = np.zeros((B,), np.int32)
+        tp = np.ones((B,), np.float32)
+        snap = []
+        for slot, st in self._slots.items():
+            i = st["pos"]
+            n_p = len(st["prompt"])
+            if i < n_p:
+                ht[slot] = st["prompt"][i]
+            elif i - n_p < len(st["generated"]):
+                ht[slot] = st["generated"][i - n_p]
+            else:
+                # the feed token is the previous dispatch's output —
+                # still on device, unfetched
+                assert prev is not None, "in-flight feed without inflight"
+                pm[slot] = True
+            if i >= n_p - 1:  # the step at i produces a kept token
+                temp[slot] = st["temperature"]
+                tk[slot] = st["top_k"]
+                tp[slot] = st["top_p"]
+            pos[slot] = i
+            snap.append((slot, st, i))
+            st["pos"] = i + block
+        return ht, pm, pos, temp, tk, tp, snap
+
+    def _prev_feed(self, prev):
+        """The [B] device token array feeding off the in-flight dispatch
+        (step: its tokens; block: the block's last column)."""
+        if prev is None:
+            return jnp.zeros((self.max_batch,), jnp.int32)
+        return prev["feed"]
+
+    def _dispatch_step_async(self, prev):
+        ht, pm, pos, temp, tk, tp, snap = self._dispatch_feed(prev)
+        n = self._step_no
+        self._step_no = n + 1
+        fn = _get_async_step_fn(self.cfg)
+        nxt, self.cache = fn(
+            self.params, self.cache, jnp.asarray(ht), jnp.asarray(pm),
+            self._prev_feed(prev), jnp.asarray(pos),
+            jax.random.fold_in(self._base_key, n), jnp.asarray(temp),
+            jnp.asarray(tk), jnp.asarray(tp))
+        self._inflight = {"kind": "step", "toks": nxt, "feed": nxt,
+                          "snap": snap}
+
+    def _dispatch_block_async(self, prev, block: int):
+        ht, pm, pos, temp, tk, tp, snap = self._dispatch_feed(prev, block)
+        n = self._step_no
+        self._step_no = n + block
+        if temp.any():
+            fn = _get_async_sample_block_fn(self.cfg, block)
+            toks, self.cache = fn(
+                self.params, self.cache, jnp.asarray(ht), jnp.asarray(pm),
+                self._prev_feed(prev), jnp.asarray(pos), self._base_key,
+                jnp.asarray(n), jnp.asarray(temp), jnp.asarray(tk),
+                jnp.asarray(tp))
+            feed = toks[:, -1]  # the block's last token per slot
+        else:
+            fn = _get_async_block_fn(self.cfg, block)
+            toks, self.cache, feed, _ = fn(
+                self.params, self.cache, jnp.asarray(ht), jnp.asarray(pm),
+                self._prev_feed(prev), jnp.asarray(pos))
+        self._inflight = {"kind": "block", "toks": toks, "feed": feed,
+                          "snap": snap, "block": block}
+
+    def _process_inflight(self, prev):
+        """Fetch a completed dispatch's tokens and run the deferred host
+        bookkeeping.  Slots whose request retired (or was replaced by a
+        new tenant) since the dispatch are skipped — their tokens are
+        the overrun the async pipeline trades for overlap."""
+        toks = np.asarray(prev["toks"])  # the ONLY device->host fetch
+        done = []
+        for slot, st, i in prev["snap"]:
+            if self._slots.get(slot) is not st:
+                continue  # retired/replaced while this step was in flight
+            if prev["kind"] == "step":
+                if i < len(st["prompt"]) - 1:
+                    continue  # still feeding prompt; logits-token unused
+                t = int(toks[slot])
+                st["generated"].append(t)
+                if self._finished(st, t):
+                    done.append(slot)
+            else:
+                for j in range(prev["block"]):
+                    t = int(toks[slot, j])
+                    st["generated"].append(t)
+                    if self._finished(st, t):
+                        done.append(slot)
+                        break
+        self._retire(done)
+
+    def _tick_async(self):
+        """One async tick: dispatch step N+1 FIRST (feeding the in-flight
+        step's device tokens), then block on step N for bookkeeping —
+        the device is never idle while the host schedules.  The last
+        dispatch before a drain is overrun work whose results are simply
+        never fetched."""
+        prev = self._inflight
+        self._inflight = None
+        if not self._slots:
+            self._admit()
+            if not self._slots:
+                return
+        self._dispatch_step_async(prev)
+        if prev is not None:
+            self._process_inflight(prev)
+
+    def _tick_block_async(self, block: int):
+        """Async tick_block: one BLOCK in flight (see _tick_async).  The
+        stepwise-prompt fallback first drains the in-flight dispatch —
+        single async ticks then pipeline among themselves."""
+        prev = self._inflight
+        self._inflight = None
+        if not self._slots:
+            self._admit()
+            if not self._slots:
+                return
+        if any(st["pos"] < len(st["prompt"]) - 1
+               for st in self._slots.values()):
+            if prev is not None:
+                self._process_inflight(prev)
+            for _ in range(block):
+                self.tick()
+                if not self._slots:
+                    break
+            return
+        self._dispatch_block_async(prev, block)
+        if prev is not None:
+            self._process_inflight(prev)
+
+    # -- warmup: pre-compile what this server will serve --------------------
+
+    def warmup(self, prompt_lens=None, blocks=(), sample: bool = False):
+        """Pre-compile the executables this server will serve, so the
+        first request pays device time only (and re-launches hit the
+        persistent compilation cache — framework.platform
+        .init_compile_cache, called here).
+
+        ``prompt_lens``: prompt lengths to warm admission for — their
+        power-of-two buckets dedupe to one compile each (default: every
+        bucket up to the serving window; chunked-prefill servers have a
+        single executable regardless).  ``blocks``: tick_block sizes to
+        warm.  ``sample``: also warm the sampled-step twins.
+
+        Warm steps run on the LIVE cache (donation chains it through),
+        writing garbage rows at pos 0 for every slot — hidden by the
+        same stale-row invariant as slot reuse: admission prefill
+        overwrites rows [0, n), n >= 1, before any mask exposes them.
+        That invariant only holds for requests admitted AFTER warmup, so
+        warming an idle server is enforced: an active slot's already-
+        prefilled rows would be silently corrupted.  The PRNG step
+        counter is NOT advanced, so a warmed server produces
+        bit-identical tokens to a cold one.
+
+        Returns {executable: seconds} compile+first-run timings."""
+        import time
+
+        from ..framework import platform as _platform
+
+        if self._inflight is not None and not self._slots and not self._queue:
+            # a drained async server's final overrun dispatch: every slot
+            # it fed has retired, so its tokens are disposable by design
+            self._inflight = None
+        if self._slots or self._queue or self._inflight is not None:
+            raise RuntimeError(
+                "DecodeServer.warmup() requires an idle server: warm "
+                "steps write garbage rows at pos 0 of every slot, which "
+                "only un-admitted requests are guaranteed to overwrite")
+        _platform.init_compile_cache()
+        timings = {}
+        B = self.max_batch
+        zi = np.zeros((B,), np.int32)
+        zb = np.zeros((B,), bool)
+        zf = np.zeros((B,), np.float32)
+        of = np.ones((B,), np.float32)
+        # any key works (warmup compiles; values are discarded) — a high
+        # sentinel keeps clear of the per-step fold_in counters
+        key = jax.random.fold_in(self._base_key, (1 << 31) + 1)
+
+        def warm(name, thunk):
+            t0 = time.perf_counter()
+            out = thunk()
+            jax.block_until_ready(out[0])
+            self.cache = out[1]
+            timings[name] = round(time.perf_counter() - t0, 3)
+
+        tok, pos = jnp.asarray(zi), jnp.asarray(zi)
+        if self._async:
+            fn = _get_async_step_fn(self.cfg)
+            warm("async_step", lambda: fn(
+                self.params, self.cache, tok, jnp.asarray(zb), tok, pos,
+                key, jnp.asarray(zf), jnp.asarray(zi), jnp.asarray(of)))
+        else:
+            warm("step", lambda: self._step(self.params, self.cache, tok,
+                                            pos))
+            if sample:
+                fn = _get_sample_step_fn(self.cfg)
+                warm("sample_step", lambda: fn(
+                    self.params, self.cache, tok, pos, key,
+                    jnp.asarray(zf), jnp.asarray(zi), jnp.asarray(of)))
+        for k in blocks:
+            k = int(k)
+            if self._async:
+                fn = _get_async_block_fn(self.cfg, k)
+                warm(f"async_block{k}", lambda fn=fn: fn(
+                    self.params, self.cache, tok, jnp.asarray(zb), tok,
+                    pos)[:2])
+                if sample:
+                    fn = _get_async_sample_block_fn(self.cfg, k)
+                    warm(f"async_sample_block{k}", lambda fn=fn: fn(
+                        self.params, self.cache, tok, jnp.asarray(zb),
+                        tok, pos, self._base_key, jnp.asarray(0),
+                        jnp.asarray(zf), jnp.asarray(zi),
+                        jnp.asarray(of)))
+            else:
+                fn = _get_block_fn(self.cfg, k)
+                warm(f"block{k}", lambda fn=fn: fn(
+                    self.params, self.cache, tok, pos)[:2])
+                if sample:
+                    fn = _get_sample_block_fn(self.cfg, k)
+                    warm(f"sample_block{k}", lambda fn=fn: fn(
+                        self.params, self.cache, tok, pos,
+                        self._base_key, jnp.asarray(0), jnp.asarray(zf),
+                        jnp.asarray(zi), jnp.asarray(of)))
+        window = min(self.max_len, self.cfg.max_seq_len)
+        if self._prefill_chunk is not None:
+            C = self._chunk
+            padded = jnp.zeros((1, C), jnp.int32)
+            warm(f"prefill_chunk{C}", lambda: self._prefill_chunk(
+                self.params, self.cache, padded, jnp.asarray(0),
+                jnp.asarray(1), jnp.asarray(0)))
+        elif self._prefill is not None:
+            if prompt_lens is None:
+                buckets, b = [], 1
+                while b < window:
+                    buckets.append(b)
+                    b *= 2
+                buckets.append(window)
+            else:
+                buckets = [min(1 << max(0, int(n) - 1).bit_length(),
+                               window) for n in prompt_lens]
+            for b in sorted(set(buckets)):
+                padded = jnp.zeros((1, b), jnp.int32)
+                warm(f"prefill{b}", lambda padded=padded: self._prefill(
+                    self.params, self.cache, padded, jnp.asarray(1),
+                    jnp.asarray(0)))
+        return timings
+
     def tick_block(self, block: int = 8):
         """``block`` greedy decode steps with ONE host round trip.
 
@@ -510,6 +856,9 @@ class DecodeServer:
         block = int(block)
         if block < 1:
             raise ValueError(f"block must be >= 1, got {block}")
+        if self._async:
+            self._tick_block_async(block)
+            return
         if not self._slots:
             self._admit()
             if not self._slots:

@@ -77,7 +77,10 @@ def test_gpt_trains_under_hybrid_step():
     toks = jnp.asarray(np.tile(seq[:33], (4, 1)), jnp.int32)
     key = jax.random.PRNGKey(0)
     l0 = None
-    for _ in range(150):
+    # 250 steps, not 150: under conftest's 8-virtual-device CPU platform
+    # the loss plateaus near 0.5 through step ~210 before dropping to
+    # 0.08 — the single-device trajectory converges by 150
+    for _ in range(250):
         state, loss = step_fn(state, toks, key, 0.03)
         l0 = l0 or float(loss)
     assert float(loss) < 0.5, (l0, float(loss))

@@ -9,6 +9,9 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
+
+import jax
 
 _TRAIN = r"""
 import os, signal, sys, time
@@ -39,7 +42,7 @@ print(f"rank {rank} resumed at step {start}", flush=True)
 # real training steps carry collectives: when a peer dies, the survivor's
 # next psum fails instead of letting it race ahead solo and pollute the
 # checkpoint dir with rank-partial saves
-from jax import shard_map
+from paddle_tpu.compat import shard_map
 couple = jax.jit(shard_map(lambda v: jax.lax.psum(v, "dp"), mesh=mesh,
                            in_specs=P("dp"), out_specs=P(),
                            check_vma=False))
@@ -61,6 +64,14 @@ print(f"rank {rank} DONE {mine.ravel()[0]}", flush=True)
 """
 
 
+# the worker script pins jax_platforms=cpu, and the pinned jaxlib's CPU
+# client has no cross-process collectives (the gloo implementation landed
+# behind jax_cpu_collectives_implementation on later jax) — the 2-proc pod
+# then dies at its first psum with "Multiprocess computations aren't
+# implemented on the CPU backend", on any host
+@pytest.mark.skipif(
+    not hasattr(jax.config, "jax_cpu_collectives_implementation"),
+    reason="pinned jaxlib: no CPU cross-process collectives")
 def test_kill_rank_resumes_from_sharded_checkpoint(tmp_path):
     script = tmp_path / "train.py"
     script.write_text(_TRAIN)

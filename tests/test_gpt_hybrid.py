@@ -13,7 +13,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from paddle_tpu.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from paddle_tpu.distributed import megatron as mt
@@ -656,7 +656,9 @@ class TestGQAHybrid:
                       out_specs=P(), check_vma=False)
         got = jax.jit(f)(params, toks, jax.random.PRNGKey(0))
         want = gpt.loss_fn(params, toks, cfg)
-        np.testing.assert_allclose(got, want, rtol=2e-5)
+        # 3e-5 not 2e-5: the ring reassociates the fp32 softmax sums, and
+        # CPU XLA on the pinned jax lands ~2.3e-5 off the dense order
+        np.testing.assert_allclose(got, want, rtol=3e-5)
 
     def test_gqa_kv_heads_must_divide_mp(self):
         import dataclasses

@@ -43,7 +43,10 @@ def test_rmsprop_converges():
 
 
 def test_lamb_converges():
-    assert _quadratic_converges(Lamb, lr=0.3, steps=120, tol=0.5)
+    # lr 0.05, not the siblings' 0.3: LAMB's trust ratio keeps the step
+    # aggressive on this 3-param quadratic and 0.3 oscillates without
+    # ever settling (1.4 after 400 steps); 0.05 reaches 0.013 by 240
+    assert _quadratic_converges(Lamb, lr=0.05, steps=240, tol=0.1)
 
 
 def test_adam_matches_reference_update():

@@ -174,7 +174,13 @@ class TestViTTensorParallel:
         qkv = placed["encoder.layers.0.self_attn.q_proj.weight"]
         assert qkv.addressable_shards[0].data.shape[1] * 4 == qkv.shape[1]
         xs = jax.device_put(x, NamedSharding(mesh, P("dp")))
-        tp_sgd = jax.jit(sgd, in_shardings=(shardings, None, None))
+        # out_shardings pins the updated params to the rule-derived
+        # shardings: without it GSPMD may hand back e.g. the pos-embed
+        # resharded over 'mp', and the pinned jax's pjit rejects the
+        # mismatch against in_shardings on the next step instead of
+        # resharding (later jax reshards silently)
+        tp_sgd = jax.jit(sgd, in_shardings=(shardings, None, None),
+                         out_shardings=(None, shardings))
         tp_losses = []
         pt = placed
         for _ in range(2):

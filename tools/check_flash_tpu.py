@@ -222,11 +222,16 @@ if __name__ == "__main__":
     import datetime
 
     def _write_marker(families: dict):
-        with open(_marker, "w") as f:
+        # tmp + os.replace (like _save_cache): a concurrent bench read
+        # must never parse a torn marker, and a crash mid-write must not
+        # leave a corrupt file that kills certification until a re-cert
+        tmp = _marker + ".tmp"
+        with open(tmp, "w") as f:
             json.dump({"ts": datetime.datetime.now(datetime.timezone.utc)
                        .isoformat(timespec="seconds"),
                        "device": str(jax.devices()[0].device_kind),
                        "families": families}, f, indent=2)
+        os.replace(tmp, _marker)
         print(f"wrote {_marker} (families: {sorted(families)})",
               flush=True)
 
