@@ -794,6 +794,141 @@ def _run_rung_child(name: str, timeout: float):
     return None, f"{name}: rc={out.returncode}", False
 
 
+def _fit_lm(vocab, hidden, layers, seq):
+    """Small Layer LM for the hapi fit benches: Embedding -> L x
+    (Linear+GELU+LayerNorm) -> vocab head, cross-entropy over every
+    position — enough matmul per token for tok_s to mean something while
+    the loop overheads under test (dispatch, host sync, H2D) stay the
+    dominant term at small scale."""
+    from paddle_tpu import nn
+
+    mods = [nn.Embedding(vocab, hidden)]
+    for _ in range(layers):
+        mods += [nn.Linear(hidden, hidden), nn.GELU(),
+                 nn.LayerNorm(hidden)]
+    mods.append(nn.Linear(hidden, vocab))
+    return nn.Sequential(*mods)
+
+
+def _fit_data(n, seq, vocab, seed=0):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    toks = rng.integers(0, vocab, (n, seq + 1))
+    return (toks[:, :-1].astype(np.int32), toks[:, 1:].astype(np.int64))
+
+
+def bench_train(small: bool):
+    """hapi ``Model.fit`` training hot path: in-jit gradient accumulation
+    (``grad_accum``) + async loss drain + device prefetch, versus the
+    fully synchronous ``grad_accum=1`` fit loop at the SAME microbatch
+    size and token count.  Reports post-warmup ``steps_s``/``tok_s`` and
+    ``accum_speedup`` — accumulation folds ``accum`` dispatch+sync round
+    trips into ONE jitted program, async keeps losses on device, prefetch
+    overlaps batch assembly + H2D with the running step."""
+    import numpy as np
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.optimizer import AdamW
+
+    dev = jax.devices()[0]
+    if small:
+        vocab, hidden, layers, T, Bm, accum, steps = 256, 64, 2, 32, 4, 4, 8
+    else:
+        vocab, hidden, layers, T, Bm, accum, steps = 8192, 512, 4, 256, 8, 8, 16
+    n = Bm * accum * steps  # same sample count for both arms
+    X, Y = _fit_data(n, T, vocab)
+
+    def arm(grad_accum, async_, prefetch):
+        paddle.seed(0)
+        net = _fit_lm(vocab, hidden, layers, T)
+        m = Model(net)
+        m.prepare(AdamW(learning_rate=1e-3, parameters=net.parameters()),
+                  nn.functional.cross_entropy, grad_accum=grad_accum,
+                  async_metrics=async_)
+        bs = Bm * grad_accum
+        pf = 4 if prefetch else 0
+        fit = lambda: m.fit((X, Y), batch_size=bs, epochs=1, verbose=0,
+                            shuffle=False, log_freq=10 ** 9,
+                            prefetch_factor=pf)
+        fit()  # compile + warmup epoch
+        step = m._train_step
+        _sync_all((step._params, step._opt_state))
+        t0 = time.perf_counter()
+        fit()
+        _sync_all((step._params, step._opt_state))
+        dt = time.perf_counter() - t0
+        opt_steps = n // bs
+        return {"tok_s": n * T / dt, "steps_s": opt_steps / dt,
+                "epoch_s": round(dt, 4)}
+
+    base = arm(1, async_=False, prefetch=False)
+    over = arm(accum, async_=True, prefetch=True)
+    _log(f"[bench] train fit: overlapped {over['tok_s']:,.0f} tok/s "
+         f"(accum={accum}) vs sync baseline {base['tok_s']:,.0f} tok/s "
+         f"-> accum_speedup {over['tok_s'] / base['tok_s']:.2f}x")
+    return {"metric": "tokens_per_sec_train_fit"
+                      + ("_small" if small else ""),
+            "value": round(over["tok_s"], 1), "unit": "tokens/s/chip",
+            "device": dev.platform,
+            "device_kind": str(getattr(dev, "device_kind", "")),
+            "steps_s": round(over["steps_s"], 2),
+            "tok_s": round(over["tok_s"], 1),
+            "baseline_tok_s": round(base["tok_s"], 1),
+            "baseline_steps_s": round(base["steps_s"], 2),
+            "accum_speedup": round(over["tok_s"] / base["tok_s"], 3),
+            "grad_accum": accum, "async": True, "prefetch": True,
+            "vs_baseline": 0.0}
+
+
+def _train_smoke():
+    """Accumulated + async + prefetched fit smoke, run by ``--config gpt
+    --small`` (CI): exercises the exact training hot path the train bench
+    measures — in-jit grad accumulation, device-resident losses, prefetch
+    — on a tiny config and RAISES on parity loss vs the sync grad_accum=1
+    loop, so a hot-path regression fails CI before it burns a TPU
+    window."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import flags, nn
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.optimizer import AdamW
+
+    vocab, hidden, T, B = 64, 32, 16, 8
+    X, Y = _fit_data(24, T, vocab)
+
+    def run(grad_accum, async_, prefetch):
+        paddle.seed(0)
+        net = _fit_lm(vocab, hidden, 1, T)
+        m = Model(net)
+        m.prepare(AdamW(learning_rate=1e-3, parameters=net.parameters()),
+                  nn.functional.cross_entropy, grad_accum=grad_accum,
+                  async_metrics=async_)
+        hist = m.fit((X, Y), batch_size=B, epochs=2, verbose=0,
+                     shuffle=False, prefetch_factor=4 if prefetch else 0)
+        return hist, {k: np.asarray(p.value)
+                      for k, p in net.named_parameters()}
+
+    sync_hist, sync_p = run(1, async_=False, prefetch=False)
+    over_hist, over_p = run(2, async_=True, prefetch=True)
+    for k in sync_p:
+        if not np.allclose(sync_p[k], over_p[k], rtol=1e-4, atol=1e-5):
+            raise AssertionError(
+                f"accumulated/async fit diverged from the sync loop at "
+                f"{k}: max |d|="
+                f"{np.abs(sync_p[k] - over_p[k]).max():.2e}")
+    if not all(np.isfinite(h["loss"]) for h in over_hist):
+        raise AssertionError(f"non-finite fit loss: {over_hist}")
+    return {"ok": True, "epochs": len(over_hist),
+            "loss": round(float(over_hist[-1]["loss"]), 4),
+            "grad_accum": 2, "async": flags.async_train(),
+            "prefetch": flags.fit_prefetch()}
+
+
 def _decode_smoke():
     """Warmup + donated + async decode smoke, run by ``--config gpt
     --small`` (CI): exercises the exact serving hot path the TPU bench
@@ -833,6 +968,9 @@ def bench_gpt(small: bool):
     if small:
         rec = _run_gpt_rung(-1)
         rec["decode_smoke"] = _decode_smoke()
+        # training hot path rides the same CI smoke: grad-accum + async +
+        # prefetch fit parity vs the sync loop (BENCH gets a train number)
+        rec["train_smoke"] = _train_smoke()
         return rec
 
     # full ladder: one subprocess per rung so a hung/slow remote compile
@@ -1494,9 +1632,9 @@ def bench_serving(small: bool):
                                 "serving")
 
 
-_CONFIGS = {"gpt": bench_gpt, "mnist": bench_mnist, "resnet": bench_resnet,
-            "bert": bench_bert, "int8": bench_int8, "decode": bench_decode,
-            "serving": bench_serving}
+_CONFIGS = {"gpt": bench_gpt, "train": bench_train, "mnist": bench_mnist,
+            "resnet": bench_resnet, "bert": bench_bert, "int8": bench_int8,
+            "decode": bench_decode, "serving": bench_serving}
 
 
 def main():

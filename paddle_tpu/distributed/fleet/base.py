@@ -322,6 +322,22 @@ class ShardedTrainStep:
                 return g
             return jax.lax.with_sharding_constraint(g, grad_shardings)
 
+        # bucketed reduce/update overlap applies on the PLAIN dp path
+        # only: every ZeRO stage keeps some per-leaf state sharded over
+        # the zero axis (stage 1: optimizer m/v; 2/3: also grads/params),
+        # and any OTHER live mesh axis (mp/pp/sp/ep) means params
+        # themselves are sharded per leaf — in both cases a flat
+        # cross-leaf concat would force GSPMD to re-gather exactly what
+        # the sharding exists to keep distributed
+        _non_dp_axes = [ax for ax, n in self.mesh.shape.items()
+                        if ax != "dp" and n > 1]
+        dp_bucketed = self.mesh.shape.get("dp", 1) > 1 \
+            and not _non_dp_axes \
+            and not zero_stage \
+            and getattr(optimizer, "_elementwise", False)
+        dp_bucket_bytes = int(getattr(self.strategy, "fuse_grad_size_in_MB",
+                                      25) or 25) << 20
+
         def step_fn(params, opt_state, key, lr, step, batch):
             def loss_of(p, b, k):
                 return loss_fn(p, b, k)
@@ -356,8 +372,20 @@ class ShardedTrainStep:
                 loss, grads = grad_fn(params, batch, key)
                 grads = shard_grads(grads)
 
-            new_params, new_opt = optimizer.apply_gradients(
-                grads, params, opt_state, lr=lr, step=step + 1)
+            if dp_bucketed:
+                # data-parallel meshes: size-bucketed fused update (the
+                # ParallelExecutor fused-allreduce role) — each bucket is
+                # one flat update chain, so XLA's latency-hiding scheduler
+                # overlaps the GSPMD-inserted gradient reduction of bucket
+                # k+1 (attached to its concat, the grads' first use) with
+                # bucket k's optimizer math.  Bit-identical numerics;
+                # non-elementwise optimizers fall back inside.
+                new_params, new_opt = optimizer.apply_gradients_bucketed(
+                    grads, params, opt_state, lr=lr, step=step + 1,
+                    bucket_bytes=dp_bucket_bytes)
+            else:
+                new_params, new_opt = optimizer.apply_gradients(
+                    grads, params, opt_state, lr=lr, step=step + 1)
             return new_params, new_opt, loss
 
         self._compiled = jax.jit(

@@ -257,6 +257,35 @@ class DataParallel(Layer):
             inputs = tuple(sharded)
         return self._layers(*inputs, **kwargs)
 
+    def overlap_optimizer_update(self, optimizer):
+        """Overlap gradient all-reduce with the optimizer update (the
+        reference ParallelExecutor's pipelining: bucket k+1's fused
+        allreduce runs while bucket k's update kernels execute).
+
+        Wires the Reducer's as-ready bucket flush to
+        ``optimizer.step_group``: each bucket's eager update dispatches
+        the moment its fused collective does, and JAX async dispatch
+        pipelines the next bucket's reduction behind it (the VJP closures
+        captured their primals at forward time, so updating parameter
+        values mid-backward cannot perturb still-running grad math).  The
+        training loop's ``optimizer.step()`` then only closes the round —
+        stragglers and unused parameters.  Requires the explicit-Reducer
+        mode (``local_grads=True`` or a multi-process run) and no global
+        ``grad_clip``."""
+        if self._reducer is None:
+            raise RuntimeError(
+                "overlap_optimizer_update needs the explicit Reducer "
+                "(DataParallel(local_grads=True) on a dp>1 mesh, or a "
+                "multi-process run); under single-controller SPMD XLA "
+                "already schedules/overlaps the collectives")
+        if getattr(optimizer, "_grad_clip", None) is not None:
+            raise ValueError(
+                "global grad_clip needs every gradient before any update; "
+                "overlap_optimizer_update is unavailable with grad_clip")
+        self._reducer._on_flush = \
+            lambda gi, params: optimizer.step_group(params)
+        return self
+
     def close(self):
         """Detach the Reducer's grad-ready hook (safe to call twice; also
         happens automatically when the DataParallel is garbage-collected —

@@ -65,6 +65,59 @@ def flag(name: str, default=None):
     return _REGISTRY.get(name, default)
 
 
+def async_train() -> bool:
+    """Sync-free ``Model.fit`` loop (ON by default).
+
+    When on, the fit loop keeps every per-step loss ON DEVICE and only
+    drains (host-fetches) it at ``log_freq`` boundaries and epoch end, so
+    steady-state train steps issue zero synchronous host<->device round
+    trips and JAX async dispatch keeps the device saturated.
+    ``PADDLE_TPU_ASYNC_TRAIN=0`` is the escape hatch (per-step float
+    losses, the pre-PR-2 behavior).  Read at ``Model.prepare`` /
+    ``TrainStep`` construction — like ``PADDLE_TPU_DONATE_DECODE`` it is
+    part of the step's construction key (``train_step_key``): flipping it
+    mid-process affects new TrainSteps, never a live one."""
+    v = os.environ.get("PADDLE_TPU_ASYNC_TRAIN", "1").strip().lower()
+    return v not in ("0", "false", "off", "no")
+
+
+def train_grad_accum() -> int:
+    """Default microbatch count for in-jit gradient accumulation
+    (``TrainStep(grad_accum=...)``); ``PADDLE_TPU_GRAD_ACCUM=N`` sets the
+    default for TrainSteps that don't pass it explicitly (1 = off).
+
+    Accumulation is a ``lax.scan`` baked into the compiled step program
+    at trace time, so the value is part of ``train_step_key``: flipping
+    the env mid-process changes newly built steps (retrace), never a
+    compiled one."""
+    try:
+        return max(1, int(os.environ.get("PADDLE_TPU_GRAD_ACCUM", "1")))
+    except ValueError:
+        return 1
+
+
+def fit_prefetch() -> bool:
+    """Route ``Model.fit``'s batch stream through ``io.DevicePrefetcher``
+    (ON by default): host batch assembly + the host->device transfer run
+    in a background thread ``prefetch_factor`` batches ahead, overlapping
+    the running step.  ``PADDLE_TPU_FIT_PREFETCH=0`` is the escape hatch
+    (synchronous per-step uploads)."""
+    v = os.environ.get("PADDLE_TPU_FIT_PREFETCH", "1").strip().lower()
+    return v not in ("0", "false", "off", "no")
+
+
+def train_step_key() -> tuple:
+    """The trace-time training-flag tuple — the ``_cfg_key`` analog for
+    the training hot path.  Everything here is BAKED into a TrainStep at
+    construction (accumulation scan shape, async drain mode, prefetch
+    routing); today the TrainStep INSTANCE is the only cache (each
+    construction re-reads the flags, so flipping an env var affects new
+    steps and never a compiled one).  Any future cross-instance cache of
+    compiled train steps must fold this tuple into its key, exactly like
+    the decode cache folds ``PADDLE_TPU_DONATE_DECODE``."""
+    return (train_grad_accum(), async_train(), fit_prefetch())
+
+
 def donate_decode() -> bool:
     """KV-cache buffer donation on the decode/serving hot path (ON by
     default).

@@ -38,7 +38,9 @@ class ProgBarLogger(Callback):
         self._t0 = time.time()
 
     def on_train_batch_end(self, step, logs=None):
-        if self.verbose and step % self.log_freq == 0:
+        # log_freq=0 = per-step logging off entirely (the async fit
+        # loop's epoch-end-only drain mode)
+        if self.verbose and self.log_freq and step % self.log_freq == 0:
             items = " ".join(f"{k}: {v:.4f}" if isinstance(v, float) else
                              f"{k}: {v}" for k, v in (logs or {}).items())
             print(f"Epoch {self._epoch} step {step} {items}", file=sys.stderr)

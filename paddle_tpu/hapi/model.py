@@ -12,6 +12,7 @@ the first batch's shapes and replayed by one Executor.
 """
 from __future__ import annotations
 
+import functools
 import os
 from typing import Any, Callable, Sequence
 
@@ -27,6 +28,33 @@ def _as_tensor(x):
     from ..core.tensor import to_tensor
 
     return x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+
+
+def _host_scalar(x):
+    """THE host-fetch choke point of the fit loop: every per-step loss
+    materialization funnels through here, so tests can count the steady-
+    state train loop's host syncs (zero per step in async mode — drained
+    only at log_freq boundaries and epoch end)."""
+    if isinstance(x, Tensor):
+        x = x.value
+    return float(np.asarray(x))
+
+
+def _device_put_batch(batch, sharding=None):
+    """Prefetcher transform: move one fit batch host->device (sharded over
+    'dp' when the TrainStep carries a mesh) in the prefetch thread, so the
+    DMA overlaps the running step instead of serializing before it."""
+    import jax
+
+    def put(x):
+        if isinstance(x, Tensor):
+            x = x.value
+        return jax.device_put(x, sharding) if sharding is not None \
+            else jax.device_put(x)
+
+    if isinstance(batch, (tuple, list)):
+        return type(batch)(put(b) for b in batch)
+    return put(batch)
 
 
 def _to_batches(data, batch_size, shuffle=False, seed=0):
@@ -181,7 +209,14 @@ class Model:
         self._adapter: _StaticGraphAdapter | None = None
         self._stop_training = False
 
-    def prepare(self, optimizer=None, loss=None, metrics=None):
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                grad_accum=None, async_metrics=None):
+        """``grad_accum=N`` runs N microbatches per optimizer step inside
+        the one jitted program (in-jit ``lax.scan``, mean-of-grads);
+        ``async_metrics`` keeps per-step losses on device, drained by
+        ``fit`` every ``log_freq`` steps (default from
+        ``PADDLE_TPU_ASYNC_TRAIN``).  Both are trace-time choices baked
+        into the TrainStep at prepare (``flags.train_step_key``)."""
         import paddle_tpu as paddle
 
         self._optimizer = optimizer
@@ -189,15 +224,35 @@ class Model:
         self._metrics = metrics if isinstance(metrics, (list, tuple)) else (
             [metrics] if metrics else [])
         if not paddle.in_dynamic_mode():
+            if (grad_accum or 1) > 1 or async_metrics:
+                import warnings
+
+                warnings.warn(
+                    "grad_accum/async_metrics apply to the dynamic "
+                    "(TrainStep) backend only; the static-graph adapter "
+                    "ignores them", stacklevel=2)
             # reference dual-backend dispatch (hapi/model.py:249)
             self._adapter = _StaticGraphAdapter(self)
             return self
         if optimizer is not None and loss is not None:
             # metrics stream from the SAME jitted forward's outputs
-            # (reference fit computes train metrics per batch)
+            # (reference fit computes train metrics per batch); lazy sync:
+            # the Layer's Parameters are written back at checkpoint/eval/
+            # fit-end (every Model access point funnels through
+            # _sync_network), not per step
             self._train_step = TrainStep(self.network, loss, optimizer,
-                                         return_outputs=bool(self._metrics))
+                                         return_outputs=bool(self._metrics),
+                                         grad_accum=grad_accum,
+                                         async_metrics=async_metrics,
+                                         lazy_sync=True)
         return self
+
+    def _sync_network(self):
+        """Write the train step's functional params back into the Layer
+        (lazy-sync drain point: checkpoint / eval / predict / fit end)."""
+        ts = self._train_step
+        if ts is not None and getattr(ts, "_model_stale", False):
+            ts.sync_to_model()
 
     def _run_train_batch(self, batch):
         """One optimizer step through the active backend; returns
@@ -214,7 +269,16 @@ class Model:
     # -- train ---------------------------------------------------------------
     def fit(self, train_data=None, eval_data=None, batch_size=32, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=1,
-            shuffle=True, callbacks=None):
+            shuffle=True, callbacks=None, prefetch_factor=2):
+        """Sync-free steady state (dynamic mode): each step's loss stays on
+        device (drained every ``log_freq`` steps and at epoch end — one
+        stacked fetch), the Layer write-back is lazy (checkpoint/eval
+        boundaries), and the batch stream runs through
+        ``io.DevicePrefetcher`` — host batch assembly + host->device DMA
+        overlap the running step, ``prefetch_factor`` batches deep
+        (``PADDLE_TPU_FIT_PREFETCH=0`` / ``prefetch_factor=0`` disable)."""
+        from .. import flags as _flags
+
         assert self._train_step is not None or self._adapter is not None, \
             "call prepare(optimizer, loss)"
         cbs = list(callbacks or [])
@@ -225,32 +289,85 @@ class Model:
         self._stop_training = False
         for c in cbs:
             c.on_train_begin()
+        dynamic = self._adapter is None
+        use_async = dynamic and self._train_step.async_metrics
+        use_prefetch = (dynamic and _flags.fit_prefetch()
+                        and prefetch_factor and prefetch_factor > 0)
         history = []
         for epoch in range(epochs):
             for c in cbs:
                 c.on_epoch_begin(epoch)
             for m in self._metrics:
                 m.reset()
-            losses = []
+            losses = []       # drained floats (sync / adapter path)
+            loss_sum = None   # async: O(1)-memory device-side running sum
+            n_steps = 0
             saw_outputs = False
-            for step, batch in enumerate(
-                    _to_batches(train_data, batch_size, shuffle, seed=epoch)):
-                loss_val, out = self._run_train_batch(batch)
-                losses.append(loss_val)
-                logs = {"loss": losses[-1]}
-                if out is not None:
-                    saw_outputs = True
-                    y = batch[-1]
-                    yt = y if isinstance(y, Tensor) else Tensor(
-                        np.asarray(y), stop_gradient=True)
-                    for m in self._metrics:
-                        _metric_update(m, out, yt)
-                        # train_ prefix everywhere: the bare name is
-                        # reserved for eval values (eval_loss convention)
-                        logs.update(_metric_logs(m, prefix="train_"))
-                for c in cbs:
-                    c.on_train_batch_end(step, logs)
-            epoch_logs = {"loss": float(np.mean(losses)) if losses else 0.0}
+            batches = _to_batches(train_data, batch_size, shuffle, seed=epoch)
+            pf = None
+            if use_prefetch:
+                from ..io.native_reader import DevicePrefetcher
+
+                pf = DevicePrefetcher(
+                    batches, depth=max(1, int(prefetch_factor)),
+                    transform=functools.partial(
+                        _device_put_batch,
+                        sharding=self._train_step.batch_sharding))
+                batches = iter(pf)
+            try:
+                for step, batch in enumerate(batches):
+                    drain = (not use_async) or (log_freq
+                                                and step % log_freq == 0)
+                    if not dynamic:
+                        loss_rep, out = self._run_train_batch(batch)
+                        losses.append(loss_rep)
+                    else:
+                        loss_t = self._train_step(*batch)
+                        out = self._train_step.last_outputs
+                        if use_async:
+                            # the loss stays a device scalar: fold it into
+                            # a running on-device sum (one tiny async add,
+                            # O(1) memory for any epoch length) and
+                            # float() only at drain boundaries — so the
+                            # steady-state step issues zero host round
+                            # trips.  NOTE: between drains, callbacks see
+                            # the device scalar in logs["loss"], not a
+                            # float (the async contract; ProgBarLogger
+                            # prints at log_freq, which is a drain step).
+                            lv = loss_t.value
+                            loss_sum = lv if loss_sum is None \
+                                else loss_sum + lv
+                            n_steps += 1
+                            loss_rep = _host_scalar(loss_t) if drain else lv
+                        else:
+                            loss_rep = _host_scalar(loss_t)
+                            losses.append(loss_rep)
+                    logs = {"loss": loss_rep}
+                    if out is not None and self._metrics:
+                        saw_outputs = True
+                        y = batch[-1]
+                        yt = y if isinstance(y, Tensor) else Tensor(
+                            y if hasattr(y, "dtype") else np.asarray(y),
+                            stop_gradient=True)
+                        for m in self._metrics:
+                            _metric_update(m, out, yt)
+                        if drain:
+                            for m in self._metrics:
+                                # train_ prefix everywhere: the bare name
+                                # is reserved for eval values (eval_loss
+                                # convention)
+                                logs.update(_metric_logs(m, prefix="train_"))
+                    for c in cbs:
+                        c.on_train_batch_end(step, logs)
+            finally:
+                if pf is not None:
+                    pf.close()
+            if loss_sum is not None:
+                # ONE host fetch for the whole async epoch
+                epoch_logs = {"loss": _host_scalar(loss_sum) / n_steps}
+            else:
+                epoch_logs = {"loss": float(np.mean(losses))
+                              if losses else 0.0}
             if saw_outputs:
                 for m in self._metrics:
                     epoch_logs.update(_metric_logs(m, prefix="train_"))
@@ -266,12 +383,14 @@ class Model:
             history.append(epoch_logs)
             if self._stop_training:
                 break
+        self._sync_network()  # post-fit eager access sees the final params
         for c in cbs:
             c.on_train_end()
         return history
 
     # -- eval / predict ------------------------------------------------------
     def evaluate(self, eval_data, batch_size=32, log_freq=10, verbose=1):
+        self._sync_network()  # lazy-sync drain: eval runs on the Layer
         for m in self._metrics:
             m.reset()
         losses = []
@@ -323,6 +442,7 @@ class Model:
         return list(batch[:-1]) if len(batch) > 1 else list(batch)
 
     def predict(self, test_data, batch_size=32):
+        self._sync_network()
         outs = []
         if self._adapter is not None:
             for batch in _to_batches(test_data, batch_size):
@@ -349,6 +469,9 @@ class Model:
         loss = self._train_step(*(list(np.atleast_1d(inputs))
                                   if isinstance(inputs, (list, tuple))
                                   else [inputs]), labels)
+        # one-off API, not the hot loop: keep the Layer in sync so callers
+        # can interleave train_batch with eager access
+        self._sync_network()
         return [float(loss.numpy())]
 
     def _eval_forward(self, inputs):
@@ -374,6 +497,7 @@ class Model:
         one batch without a parameter update, in eval mode.  Returns
         ``[losses]`` or ``([losses], [metric accumulations])`` when metrics
         are prepared — the reference adapter's contract."""
+        self._sync_network()
         if self._adapter is not None and labels is not None:
             xs = list(inputs) if isinstance(inputs, (list, tuple)) \
                 else [inputs]
@@ -411,6 +535,7 @@ class Model:
     def predict_batch(self, inputs):
         """reference Model.predict_batch: forward-only outputs as numpy,
         in eval mode."""
+        self._sync_network()
         if self._adapter is not None:
             xs = list(inputs) if isinstance(inputs, (list, tuple)) \
                 else [inputs]
@@ -422,6 +547,7 @@ class Model:
 
     # -- io ------------------------------------------------------------------
     def save(self, path):
+        self._sync_network()  # checkpoint the functional (live) params
         _save(self.network.state_dict(), path + ".pdparams")
         if self._optimizer is not None:
             _save(self._optimizer.state_dict(), path + ".pdopt")
@@ -432,9 +558,11 @@ class Model:
             self._optimizer.set_state_dict(_load(path + ".pdopt"))
 
     def parameters(self):
+        self._sync_network()  # lazy-sync drain: hand out LIVE buffers
         return self.network.parameters()
 
     def summary(self, input_size=None, dtypes=None):
+        self._sync_network()
         return summary(self.network, input_size, dtypes)
 
 

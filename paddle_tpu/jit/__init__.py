@@ -198,7 +198,11 @@ class TrainStep:
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer, mesh=None,
                  shardings=None, donate=True, remat=False,
-                 remat_policy=None, return_outputs=False):
+                 remat_policy=None, return_outputs=False,
+                 grad_accum: int | None = None, lazy_sync: bool = False,
+                 async_metrics: bool | None = None):
+        from .. import flags as _flags
+
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -206,21 +210,60 @@ class TrainStep:
         self._step = 0
         self._return_outputs = return_outputs
         self.last_outputs = None  # model outputs when return_outputs=True
+        # trace-time training flags resolve at CONSTRUCTION (the decode
+        # cache's retrace-on-flip rule): grad_accum is a scan shape baked
+        # into the compiled program, async_metrics a host-drain mode the
+        # fit loop consults per step
+        accum = _flags.train_grad_accum() if grad_accum is None \
+            else max(1, int(grad_accum))
+        self.grad_accum = accum
+        self.async_metrics = _flags.async_train() if async_metrics is None \
+            else bool(async_metrics)
+        self.trace_key = (accum, bool(remat), bool(donate),
+                          bool(return_outputs))
+        # lazy sync: skip the per-step Layer write-back; parameters are
+        # written back on checkpoint/eval/explicit sync_to_model() only.
+        # While stale, the Layer's Parameters point at DONATED buffers —
+        # eager access without a sync raises loudly ("array was deleted"),
+        # never reads garbage.
+        self.lazy_sync = bool(lazy_sync)
+        self._model_stale = False
         params, buffers = _split_state(model)
         self._params = params
         self._buffers = buffers
         self._opt_state = optimizer.init_state(params)
+        # write-back targets resolved ONCE: named_parameters() walks the
+        # module tree recursively — per-step traversal was measurable host
+        # overhead on deep models (the sync-free fit loop goal)
+        self._sync_params = [(k, p) for k, p in model.named_parameters()
+                             if k in params]
+        self._sync_buffers = [(k, b) for k, b in model.named_buffers()
+                              if k in buffers]
+        # dp batch sharding: with a multi-device mesh the fit prefetcher
+        # device_puts batches pre-sharded over 'dp' in its background
+        # thread (transfer overlaps the running step); XLA then inserts
+        # (and overlaps) the gradient all-reduces itself
+        self.batch_sharding = None
+        if mesh is not None and dict(mesh.shape).get("dp", 1) > 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            self.batch_sharding = NamedSharding(mesh, PartitionSpec("dp"))
         # resolve eagerly: a typo'd policy must fail at construction, not
         # wrapped in a tracing traceback on the first step
         from ..ops.remat_policies import resolve as _resolve_policy
 
         remat_pol = _resolve_policy(remat_policy) if remat else None
 
-        def step_fn(params, buffers, opt_state, key, lr, step, *batch):
+        def micro_grads(buffers, key, batch):
+            """value_and_grad of one (micro)batch — shared by the plain
+            and the accumulated paths so remat/aux handling cannot
+            drift between them."""
             def loss_of(params):
                 with _random.rng_scope(key):
-                    out, new_buf = functional_call(model, params, buffers, *batch[:-1])
-                    loss = self.loss_fn(_wrap(out), Tensor(batch[-1], stop_gradient=True))
+                    out, new_buf = functional_call(model, params, buffers,
+                                                   *batch[:-1])
+                    loss = self.loss_fn(_wrap(out),
+                                        Tensor(batch[-1], stop_gradient=True))
                 # outputs ride the aux so train-time metrics reuse the SAME
                 # forward (reference hapi streams metrics from fit outputs)
                 aux_out = out if return_outputs else ()
@@ -228,8 +271,52 @@ class TrainStep:
 
             if remat:
                 loss_of = jax.checkpoint(loss_of, policy=remat_pol)
-            (loss, (new_buf, out)), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(params)
+            return loss_of
+
+        def step_fn(params, buffers, opt_state, key, lr, step, *batch):
+            if accum > 1:
+                # in-jit gradient accumulation (reference GradientMerge):
+                # lax.scan over `accum` microbatches inside the ONE
+                # compiled program — activation memory scales with
+                # B/accum, dispatch cost stays one step, and mean-of-
+                # grads matches the full batch (equal micro sizes, mean
+                # losses).  Grads accumulate in the grad's own dtype
+                # (fp32 for fp32 params) for full-batch parity.
+                B = batch[0].shape[0]
+                if B % accum:
+                    raise ValueError(
+                        f"batch size {B} must divide by grad_accum {accum}")
+                micro = tuple(
+                    b.reshape((accum, B // accum) + b.shape[1:])
+                    for b in batch)
+                keys = jax.random.split(key, accum)
+                inv = 1.0 / accum
+
+                def body(carry, xs):
+                    bufs, g_acc, l_acc = carry
+                    k_i, mb = xs
+                    loss_of = micro_grads(bufs, k_i, mb)
+                    (l, (new_buf, out)), g = jax.value_and_grad(
+                        loss_of, has_aux=True)(params)
+                    g_acc = jax.tree_util.tree_map(
+                        lambda a, b: a + (b * inv).astype(a.dtype), g_acc, g)
+                    return ((new_buf, g_acc,
+                             l_acc + l.astype(jnp.float32) * inv), out)
+
+                zero_g = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, p.dtype), params)
+                (new_buf, grads, loss), outs = jax.lax.scan(
+                    body, (buffers, zero_g, jnp.zeros((), jnp.float32)),
+                    (keys, micro))
+                # [accum, Bm, ...] microbatch outputs -> [B, ...] so fit
+                # metrics see the whole batch exactly like accum == 1
+                out = (jax.tree_util.tree_map(
+                    lambda o: o.reshape((-1,) + o.shape[2:]), outs)
+                    if return_outputs else ())
+            else:
+                loss_of = micro_grads(buffers, key, batch)
+                (loss, (new_buf, out)), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(params)
             new_params, new_opt = optimizer.apply_gradients(grads, params, opt_state,
                                                             lr=lr, step=step + 1)
             return new_params, new_buf, new_opt, loss, out
@@ -246,6 +333,18 @@ class TrainStep:
 
     def __call__(self, *batch):
         arr = [b.value if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
+        if self.grad_accum > 1 and arr and arr[0].shape \
+                and arr[0].shape[0] % self.grad_accum:
+            # host-side pre-check: a partial trailing batch (DataLoader
+            # without drop_last) must fail actionably BEFORE burning a
+            # compile on a shape that can only raise at trace time
+            raise ValueError(
+                f"batch size {arr[0].shape[0]} must divide by "
+                f"grad_accum={self.grad_accum}; drop partial batches "
+                f"(DataLoader(drop_last=True)) or pick a divisible "
+                f"batch size")
+        if self.batch_sharding is not None:
+            arr = [jax.device_put(a, self.batch_sharding) for a in arr]
         key = _random.next_key()
         lr = self._current_lr()
         # pass the 0-based step; step_fn's +1 makes Adam's first update t=1
@@ -255,9 +354,16 @@ class TrainStep:
         )
         self.last_outputs = _wrap(out) if self._return_outputs else None
         self._step += 1
-        # keep the Layer's Parameters pointing at live buffers (the originals
-        # were donated into the jit) so eager eval/checkpointing keeps working
-        self.sync_to_model()
+        if self.lazy_sync:
+            # sync-free hot path: the Layer's Parameters go stale (they
+            # point at donated buffers) until checkpoint/eval/explicit
+            # sync_to_model() — Model.fit drains at exactly those points
+            self._model_stale = True
+        else:
+            # keep the Layer's Parameters pointing at live buffers (the
+            # originals were donated into the jit) so eager
+            # eval/checkpointing keeps working
+            self.sync_to_model()
         from ..framework import debugger
 
         if debugger.check_numerics_enabled():
@@ -268,12 +374,12 @@ class TrainStep:
     def sync_to_model(self):
         """Write the functional state back into the Layer's Parameters (for
         checkpointing / eval in eager mode)."""
-        for k, p in self.model.named_parameters():
-            if k in self._params:
-                p._value = self._params[k]
-        for k, b in self.model.named_buffers():
-            if k in self._buffers:
-                b._value = self._buffers[k]
+        params, buffers = self._params, self._buffers
+        for k, p in self._sync_params:
+            p._value = params[k]
+        for k, b in self._sync_buffers:
+            b._value = buffers[k]
+        self._model_stale = False
 
     def save_program(self, path_prefix: str, *example_batch):
         """Serialize the ENTIRE training program (forward + backward +

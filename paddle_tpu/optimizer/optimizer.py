@@ -24,6 +24,14 @@ from .lr import LRScheduler
 
 
 class Optimizer:
+    # True for uniform-elementwise updates (SGD/Momentum/Adam family):
+    # concatenating a bucket of leaves and updating the flat vector is
+    # bit-identical to per-leaf updates, which is what lets
+    # apply_gradients_bucketed fuse each bucket into ONE update chain.
+    # False where the math reads per-parameter structure (Lamb's trust
+    # ratio, Adafactor's factored moments).
+    _elementwise = False
+
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None):
         self._lr = learning_rate
@@ -40,6 +48,11 @@ class Optimizer:
         self._step_count = 0
         self._eager_state: dict[int, Any] = {}
         self._current_param_name = None  # set around each _update_leaf call
+        # overlap-round bookkeeping (step_group): params already updated by
+        # a bucket flush this round, skipped by the closing step()
+        self._overlap_round = False
+        self._overlap_done: set[int] = set()
+        self._overlap_gidx: dict[int, int] = {}
 
     def _should_decay(self, name) -> bool:
         if self._apply_decay_fun is None:
@@ -103,6 +116,113 @@ class Optimizer:
         self._current_param_name = None
         return treedef.unflatten(new_p), treedef.unflatten(new_s)
 
+    def apply_gradients_bucketed(self, grads, params, state, lr=None, step=0,
+                                 bucket_bytes=25 << 20, reduce_fn=None):
+        """Bucketed/fused variant of :meth:`apply_gradients` for jitted
+        data-parallel steps (the ParallelExecutor fused-allreduce role).
+
+        Leaves are grouped in reverse registration order into same-dtype,
+        size-capped buckets (the eager Reducer's AssignGroupBySize
+        discipline) and each bucket's gradients are CONCATENATED into one
+        flat vector: ``reduce_fn`` (e.g. a pmean, when the caller reduces
+        explicitly) runs once per bucket — one fused collective instead of
+        one per leaf — and the elementwise optimizer update runs once per
+        flat bucket, so XLA's latency-hiding scheduler overlaps bucket
+        k+1's reduction with bucket k's update math.
+
+        Numerically identical to :meth:`apply_gradients` (concatenation
+        commutes with elementwise math; decoupled weight decay applies per
+        leaf after the split).  Falls back to the per-leaf path when the
+        optimizer's update is not uniform-elementwise (Lamb, Adafactor) or
+        a leaf gradient is missing/sparse."""
+        lr = self.get_lr() if lr is None else lr
+        flat_with_path, treedef = jax.tree_util.tree_flatten_with_path(params)
+        names = [jax.tree_util.keystr(path) for path, _ in flat_with_path]
+        flat_p = [leaf for _, leaf in flat_with_path]
+        flat_g = treedef.flatten_up_to(grads)
+        flat_s = treedef.flatten_up_to(state)
+
+        def fusable():
+            if not self._elementwise:
+                return False
+            if reduce_fn is not None and (
+                    self._grad_clip is not None
+                    or (self._wd and not self._decoupled_wd)):
+                # clip and coupled weight decay must see the REDUCED
+                # global gradient (the fallback order: reduce -> clip/wd
+                # -> update); the fused path folds both before its
+                # per-bucket reduce, which would scale them by the
+                # reduction — take the per-leaf fallback instead so
+                # semantics never depend on the optimizer class
+                return False
+            for g, p, s in zip(flat_g, flat_p, flat_s):
+                if g is None or not hasattr(g, "dtype"):
+                    return False
+                if not isinstance(s, tuple):
+                    return False
+                if any(jnp.shape(x) != jnp.shape(p) for x in s):
+                    return False
+            return True
+
+        if not fusable():
+            if reduce_fn is not None:
+                grads = jax.tree_util.tree_map(reduce_fn, grads)
+            return self.apply_gradients(grads, params, state, lr=lr,
+                                        step=step)
+        if self._grad_clip is not None:
+            grads = self._grad_clip.apply_pytree(grads)
+            flat_g = treedef.flatten_up_to(grads)
+        if self._wd and not self._decoupled_wd:
+            flat_g = [g + self._wd * p for g, p in zip(flat_g, flat_p)]
+
+        # reverse registration order: grads become final roughly in that
+        # order during backward, so the first bucket's reduction/update
+        # chain is ready earliest (mirrors assign_group_by_size)
+        buckets: list[list[int]] = []
+        cur: list[int] = []
+        cur_bytes = 0
+        cur_key = None
+        for i in reversed(range(len(flat_p))):
+            p, g = flat_p[i], flat_g[i]
+            nbytes = int(np.prod(p.shape or (1,))) * jnp.dtype(p.dtype).itemsize
+            key = (jnp.dtype(p.dtype), jnp.dtype(g.dtype),
+                   tuple(jnp.dtype(s.dtype) for s in flat_s[i]))
+            if cur and (cur_key != key or cur_bytes + nbytes > bucket_bytes):
+                buckets.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(i)
+            cur_bytes += nbytes
+            cur_key = key
+        if cur:
+            buckets.append(cur)
+
+        new_p: list = [None] * len(flat_p)
+        new_s: list = [None] * len(flat_p)
+        self._current_param_name = None
+        for bucket in buckets:
+            nstate = len(flat_s[bucket[0]])
+            gv = jnp.concatenate([jnp.ravel(flat_g[i]) for i in bucket])
+            if reduce_fn is not None:
+                gv = reduce_fn(gv)
+            pv = jnp.concatenate([jnp.ravel(flat_p[i]) for i in bucket])
+            sv = tuple(jnp.concatenate([jnp.ravel(flat_s[i][j])
+                                        for i in bucket])
+                       for j in range(nstate))
+            up, us = self._update_leaf(gv, pv, sv, lr, step)
+            off = 0
+            for i in bucket:
+                p = flat_p[i]
+                k = int(np.prod(p.shape or (1,)))
+                np_ = up[off:off + k].reshape(p.shape)
+                if self._decoupled_wd and self._wd \
+                        and self._should_decay(names[i]):
+                    np_ = np_ - lr * self._wd * p
+                new_p[i] = np_
+                new_s[i] = tuple(us[j][off:off + k].reshape(flat_s[i][j].shape)
+                                 for j in range(nstate))
+                off += k
+        return treedef.unflatten(new_p), treedef.unflatten(new_s)
+
     # -- eager (dygraph) API --------------------------------------------------
     def _params(self):
         if self._parameter_list is None:
@@ -118,6 +238,34 @@ class Optimizer:
     def _update_leaf_sparse(self, g, p, state, lr, step):
         raise NotImplementedError
 
+    def _eager_update_one(self, p, g, name, lr):
+        """One parameter's eager update — shared by :meth:`step` and
+        :meth:`step_group` so the two paths cannot drift."""
+        from ..core.selected_rows import RowSparseGrad
+
+        gv = g.value
+        sid = id(p)
+        if sid not in self._eager_state:
+            self._eager_state[sid] = self._init_leaf(p.value)
+        self._current_param_name = name
+        if isinstance(gv, RowSparseGrad):
+            if self._supports_sparse():
+                new_p, new_s = self._update_leaf_sparse(
+                    gv.merged(), p.value, self._eager_state[sid], lr,
+                    self._step_count)
+                self._eager_state[sid] = new_s
+                p._value = new_p
+                return
+            gv = gv.to_dense()
+        if self._wd and not self._decoupled_wd:
+            gv = gv + self._wd * p.value
+        new_p, new_s = self._update_leaf(gv, p.value, self._eager_state[sid], lr,
+                                         self._step_count)
+        if self._decoupled_wd and self._wd and self._should_decay(name):
+            new_p = new_p - lr * self._wd * p.value
+        self._eager_state[sid] = new_s
+        p._value = new_p
+
     @no_grad()
     def step(self):
         from ..core.selected_rows import RowSparseGrad
@@ -131,33 +279,82 @@ class Optimizer:
                     else g) for p, g in pgs]
             pgs = self._grad_clip(pgs)
         lr = self.get_lr()
-        self._step_count += 1
+        if self._overlap_round:
+            # step_group (bucket-overlap) opened this round and already
+            # advanced the counter + updated its buckets: only close the
+            # round (stragglers / unused params)
+            done, self._overlap_done = self._overlap_done, set()
+            self._overlap_round = False
+        else:
+            self._step_count += 1
+            done = ()
         for i, (p, g) in enumerate(pgs):
-            if g is None or not getattr(p, "trainable", True):
+            if g is None or not getattr(p, "trainable", True) \
+                    or id(p) in done:
                 continue
-            name = p.name if p.name is not None else f"param_{i}"
-            gv = g.value
-            sid = id(p)
-            if sid not in self._eager_state:
-                self._eager_state[sid] = self._init_leaf(p.value)
-            self._current_param_name = name
-            if isinstance(gv, RowSparseGrad):
-                if self._supports_sparse():
-                    new_p, new_s = self._update_leaf_sparse(
-                        gv.merged(), p.value, self._eager_state[sid], lr,
-                        self._step_count)
-                    self._eager_state[sid] = new_s
-                    p._value = new_p
-                    continue
-                gv = gv.to_dense()
-            if self._wd and not self._decoupled_wd:
-                gv = gv + self._wd * p.value
-            new_p, new_s = self._update_leaf(gv, p.value, self._eager_state[sid], lr,
-                                             self._step_count)
-            if self._decoupled_wd and self._wd and self._should_decay(name):
-                new_p = new_p - lr * self._wd * p.value
-            self._eager_state[sid] = new_s
-            p._value = new_p
+            self._eager_update_one(
+                p, g, p.name if p.name is not None else f"param_{i}", lr)
+        self._current_param_name = None
+
+    @no_grad()
+    def step_group(self, params):
+        """Partial eager step over one BUCKET of parameters — the
+        reduce/update overlap path (reference ParallelExecutor: bucket
+        k+1's fused all-reduce runs while bucket k's update kernels
+        execute).  Called from the Reducer's as-ready bucket flush
+        (:meth:`DataParallel.overlap_optimizer_update`); JAX async
+        dispatch then pipelines the next bucket's collective behind this
+        bucket's update math.  The first call of a round advances the
+        step counter; the training loop's closing ``optimizer.step()``
+        updates any parameters no bucket covered and ends the round.
+
+        Incompatible with a global ``grad_clip`` (the norm needs every
+        gradient before any update)."""
+        if self._grad_clip is not None:
+            raise ValueError(
+                "step_group cannot apply a global grad_clip (the norm "
+                "needs all gradients before any update); construct the "
+                "optimizer without grad_clip to overlap updates with "
+                "gradient reduction")
+        if not self._overlap_round:
+            self._step_count += 1
+            self._overlap_round = True
+            # unnamed params fall back to their GLOBAL parameter-list
+            # index — the same identity step() would give them — so
+            # _should_decay sees one consistent name whichever path
+            # updates the param.  Built once per round, not per bucket.
+            self._overlap_gidx = (
+                {id(p): j for j, p in enumerate(self._parameter_list)}
+                if self._parameter_list is not None else {})
+        lr = self.get_lr()
+        gidx = self._overlap_gidx
+        # Reducer buckets cover ALL of the model's trainable params; this
+        # optimizer must only ever touch the ones it was constructed with
+        # (step() iterates _parameter_list — same ownership rule)
+        owned = set(gidx) if self._parameter_list is not None else None
+        for i, p in enumerate(params):
+            g = p.grad
+            if g is None or not getattr(p, "trainable", True) \
+                    or (owned is not None and id(p) not in owned):
+                continue
+            if id(p) in self._overlap_done:
+                # a bucket re-flushed mid-round: a second backward() is
+                # accumulating gradients, and this bucket's params were
+                # ALREADY updated with the first backward's partial grads
+                # — silent divergence.  Accumulation composes with
+                # overlap via no_sync() on the non-final backwards (the
+                # Reducer stays quiet there; the final backward flushes
+                # once with the accumulated grads).
+                raise RuntimeError(
+                    "step_group re-entered for a parameter already "
+                    "updated this round (multiple backward() calls "
+                    "between optimizer.step()?).  Wrap the non-final "
+                    "backwards in DataParallel.no_sync() when "
+                    "accumulating gradients with overlapped updates")
+            self._eager_update_one(
+                p, g, p.name if p.name is not None
+                else f"param_{gidx.get(id(p), i)}", lr)
+            self._overlap_done.add(id(p))
         self._current_param_name = None
 
     minimize_step = step
@@ -204,6 +401,7 @@ class Optimizer:
 
 
 class SGD(Optimizer):
+    _elementwise = True
     def _update_leaf(self, g, p, state, lr, step):
         return p - lr * g.astype(p.dtype), state
 
@@ -219,6 +417,7 @@ class SGD(Optimizer):
 
 
 class Momentum(Optimizer):
+    _elementwise = True
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
@@ -240,6 +439,7 @@ class Momentum(Optimizer):
 
 
 class Adam(Optimizer):
+    _elementwise = True
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
                  multi_precision=False, state_dtype=None, name=None):
@@ -307,6 +507,8 @@ class AdamW(Adam):
 class Adadelta(Optimizer):
     """reference adadelta_op: accumulated squared grads + squared updates."""
 
+    _elementwise = True
+
     def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
                  parameters=None, weight_decay=None, grad_clip=None,
                  name=None):
@@ -328,6 +530,7 @@ class Adadelta(Optimizer):
 
 
 class Adamax(Optimizer):
+    _elementwise = True
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=None, grad_clip=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
@@ -348,6 +551,7 @@ class Adamax(Optimizer):
 
 
 class Adagrad(Optimizer):
+    _elementwise = True
     def __init__(self, learning_rate=0.001, epsilon=1e-6, parameters=None,
                  weight_decay=None, grad_clip=None, initial_accumulator_value=0.0, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
@@ -366,6 +570,7 @@ class Adagrad(Optimizer):
 
 
 class RMSProp(Optimizer):
+    _elementwise = True
     def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6, momentum=0.0,
                  centered=False, parameters=None, weight_decay=None, grad_clip=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
@@ -507,6 +712,8 @@ class Adafactor(Optimizer):
 
 class Lars(Momentum):
     """LARS (reference lars_momentum_op): layer-wise adaptive rate scaling."""
+
+    _elementwise = False  # trust ratio reads per-LAYER norms: never fuse
 
     def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
                  lars_weight_decay=0.0005, parameters=None, grad_clip=None,

@@ -41,6 +41,32 @@ def test_compat_shard_map_is_the_real_one():
     assert mod.startswith("jax"), mod
 
 
+def test_import_never_initializes_a_jax_backend():
+    """``import paddle_tpu`` (and the training/serving entry submodules)
+    must not initialize ANY jax backend — no ``jax.devices()``, no
+    ``PRNGKey`` at import time.  The bench harness depends on this
+    lazy-RNG invariant: it pins JAX_PLATFORMS / probes the TPU tunnel in
+    a subprocess AFTER import, and an import-time backend would freeze
+    platform selection before the caller can steer it (the RNG state's
+    global key is lazy for exactly this reason — framework/random.py).
+
+    Checked in a FRESH interpreter via jax's backend registry: the
+    xla_bridge backend cache must still be empty after the imports."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import paddle_tpu\n"
+         "import paddle_tpu.hapi, paddle_tpu.jit, paddle_tpu.io\n"
+         "import paddle_tpu.optimizer, paddle_tpu.flags\n"
+         "from jax._src import xla_bridge\n"
+         "assert not xla_bridge._backends, (\n"
+         "    'import initialized jax backend(s): '\n"
+         "    + repr(list(xla_bridge._backends)))\n"],
+        capture_output=True, text=True, timeout=240,
+        cwd=os.path.dirname(PKG), env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+
+
 def test_no_direct_shard_map_imports_in_package():
     """Source-scan the package: every shard_map import must go through
     paddle_tpu.compat (a direct ``from jax import shard_map`` would
