@@ -46,13 +46,49 @@ def _log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+def _recent_probe_wedge(window_s: float = 1800.0) -> str:
+    """Evidence that the tunnel is ALREADY known wedged: the most recent
+    tpu_probe_log.jsonl entry failed (timeout or error) within
+    ``window_s`` with no healthy probe after it.  Returns that entry's
+    timestamp ('' = no such evidence).  jax-free, read-only — consulted
+    by _probe_backend to fail fast instead of burning 2x240 s
+    re-discovering what the last probe (same watchdog window, BENCH_r05
+    tail: the --all walk paid the full retry ladder minutes after the
+    watchdog logged the wedge) already measured."""
+    try:
+        entries = _tool("probe_tpu").read_log(1)
+        if not entries or entries[-1].get("ok"):
+            return ""
+        ts = str(entries[-1].get("ts", ""))
+        age = (datetime.datetime.now(datetime.timezone.utc)
+               - datetime.datetime.fromisoformat(ts)).total_seconds()
+        return ts if 0 <= age <= window_s else ""
+    except Exception:  # noqa: BLE001 - no/torn log = no evidence
+        return ""
+
+
 def _probe_backend(timeout=240, attempts=2):
     """Initialize the jax backend in a subprocess so a tunnel hang cannot
     take down the bench process. Returns device info dict or None.  Every
     attempt is appended to tpu_probe_log.json (tools/probe_tpu.py), so a
-    CPU-fallback bench line carries timestamped infra evidence."""
+    CPU-fallback bench line carries timestamped infra evidence.
+
+    Fail-fast: when the last probe-log entry ALREADY records a failed
+    probe in this window (watchdog or a sibling bench minutes ago), the
+    retry ladder collapses to ONE short attempt — enough to notice a
+    tunnel that just healed, without spending 2x240 s + sleeps
+    re-proving a wedge that is already timestamped evidence."""
     sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                     "tools"))
+    wedged_at = _recent_probe_wedge()
+    if wedged_at:
+        # 90 s, not 60: a healed-but-cold tunnel can take over a minute
+        # to init (the normal ladder's 240 s exists for that) — the
+        # fail-fast must cut the wedged-ladder cost, not misclassify the
+        # first healthy probe after a wedge
+        _log(f"[bench] last probe in this window already failed "
+             f"({wedged_at}); fail-fast: one short attempt")
+        attempts, timeout = 1, min(timeout, 90)
     for i in range(attempts):
         try:
             from probe_tpu import probe as _tp_probe
@@ -255,6 +291,13 @@ def _w4_kernel_certified(device_kind: str | None = None) -> bool:
     """The serving int4 arm enables the Pallas W4 kernel only under its
     own family's fresh certification — independent of the training gate."""
     return "w4" in _certified_families(device_kind)
+
+
+def _decode_kernel_certified(device_kind: str | None = None) -> bool:
+    """The decode_long bench enables the flash-decode kernel only under
+    its own family's fresh on-device certification (the W4 rule: a
+    compiling-but-wrong kernel must never produce a headline)."""
+    return "decode" in _certified_families(device_kind)
 
 
 def _gpt_rungs():
@@ -1483,6 +1526,210 @@ def bench_decode(small: bool):
                                 "gpt decode")
 
 
+def bench_decode_long(small: bool):
+    """Decode attention throughput vs CONTEXT LENGTH — the flash-decode
+    arm (tok/s at pre-filled context 1k/4k/16k; flash-decode kernel
+    on/off x KV-cache dtype fp32/bf16/int8).
+
+    Decode attention reads the whole [L, B, T, Hkv, hd] cache per token,
+    so past short contexts the decode rate is cache-bytes/sec — this arm
+    measures exactly that regime (weight reads are identical across
+    arms, so the ratios isolate the attention path).  The cache is
+    pre-filled with synthetic K/V (throughput does not depend on the
+    values); each measured step is the jitted donated ``decode_step`` at
+    a fixed long position.  On CPU (or --small) it instead runs the
+    interpret-mode parity gate plus a tiny timed sweep, so the arm
+    always emits a JSON line.
+
+    The kernel arm only engages under fresh on-device certification of
+    the 'decode' family (tools/check_flash_tpu.py), like W4."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu import flags
+    from paddle_tpu.text import generate, gpt
+    from paddle_tpu.ops import decode_attention as da
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform in ("tpu", "axon")
+    if small or not on_tpu:
+        contexts, B, iters = (128, 256), 2, 2
+        cfg_kwargs = dict(vocab_size=512, hidden_size=256, num_layers=2,
+                          num_heads=4, num_kv_heads=2,
+                          max_seq_len=max(contexts) + 8)
+    else:
+        contexts, B, iters = (1024, 4096, 16384), 8, 8
+        # GQA 16/4 at hd=64: the modern serving shape the kernel's
+        # Hkv-head consumption exists for; 24 layers keep the cache the
+        # dominant HBM stream at 16k (int8 16k cache ~0.4 GB vs ~6 GB
+        # fp32 — the sweep's whole point)
+        cfg_kwargs = dict(vocab_size=50304, hidden_size=1024,
+                          num_layers=24, num_heads=16, num_kv_heads=4,
+                          max_seq_len=max(contexts) + 8)
+    cfg = gpt.GPTConfig(**cfg_kwargs)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+
+    parity = None
+    if small or not on_tpu:
+        # interpret-mode parity gate: the kernel must match the XLA
+        # einsum path before any number is reported (CPU acceptance)
+        old_int = da._INTERPRET
+        da._INTERPRET = True
+        try:
+            _decode_long_parity(generate, gpt, cfg, params)
+            parity = "ok"
+        finally:
+            da._INTERPRET = old_int
+
+    kernel_ok = (True if (small or not on_tpu) else
+                 _decode_kernel_certified(str(getattr(dev, "device_kind",
+                                                      ""))))
+
+    def measure(ctx: int, kernel: bool, kv: str) -> dict:
+        saved = {k: os.environ.get(k) for k in
+                 ("PADDLE_TPU_FLASH_DECODE", "PADDLE_TPU_KV_DTYPE")}
+        os.environ["PADDLE_TPU_FLASH_DECODE"] = "1" if kernel else "0"
+        if kv == "fp32":
+            os.environ["PADDLE_TPU_KV_DTYPE"] = "fp32"
+        elif kv == "int8":
+            os.environ["PADDLE_TPU_KV_DTYPE"] = "int8"
+        else:
+            os.environ.pop("PADDLE_TPU_KV_DTYPE", None)
+        old_int = da._INTERPRET
+        if kernel and not on_tpu:
+            da._INTERPRET = True  # CPU smoke: interpret IS the kernel path
+        try:
+            step = generate._jit_by_cfg("decode", generate.decode_step,
+                                        cfg)
+            # ctx + 128 keeps the allocated length kernel-tileable (the
+            # contexts are 128-multiples); init_cache would round up
+            # anyway, but an arm labeled flash_* must never silently
+            # measure the einsum fallback — assert engagement below
+            cache = da.random_filled_cache(
+                generate.init_cache(cfg, B, ctx + 128),
+                jax.random.PRNGKey(1), amp=0.1)
+            q_shape = (B, 1, cfg.num_heads, cfg.head_dim)
+            # per-layer cache slice shape [B, T, Hkv, hd] (leading L off)
+            active = bool(da.supported(q_shape, cache["k"].shape[1:]))
+            if kernel and not active:
+                return {"error": f"kernel shape gate rejected "
+                                 f"{cache['k'].shape} — flash arm would "
+                                 f"measure the XLA fallback"}
+            if kernel and on_tpu:
+                # the shape gate is static; the RUNTIME probe can still
+                # fall back (e.g. a block size certification never
+                # lowered) — a flash-labeled arm must detect that, not
+                # quietly time the einsum path
+                g_heads = cfg.num_heads // cfg.kv_heads
+                if da._probe(cfg.dtype, cache["k"].dtype, 1, g_heads,
+                             cfg.head_dim,
+                             da._kv_block(cache["k"].shape[2])):
+                    return {"error": "decode kernel probe fell back for "
+                            "this (dtype, block) configuration"}
+            tok = jnp.zeros((B,), jnp.int32)
+            box = {"cache": cache}
+
+            def one():
+                _, box["cache"] = step(params, box["cache"], tok, ctx)
+
+            dt = _time_steps(one, iters, lambda: box["cache"])
+            return {"tok_s": round(B / dt, 2),
+                    "step_ms": round(dt * 1e3, 3)}
+        except Exception as e:  # noqa: BLE001 - record per-arm, continue
+            return {"error": f"{type(e).__name__}: {e}"}
+        finally:
+            da._INTERPRET = old_int
+            # RESTORE the operator's exported flag values (an exported
+            # opt-out must survive the sweep — check_flash_tpu's rule)
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    table = {}
+    for ctx in contexts:
+        row = {}
+        for kernel in (False, True):
+            for kv in (("fp32", "bf16", "int8") if kernel
+                       else ("fp32", "bf16")):
+                name = f"{'flash' if kernel else 'xla'}_{kv}"
+                if kernel and not kernel_ok:
+                    # every suppressed arm is RECORDED (a reader diffing
+                    # certified vs uncertified runs must see skips, not
+                    # silently missing keys)
+                    row[name] = {"error": "decode kernel uncertified "
+                                 "(tools/check_flash_tpu.py)"}
+                    continue
+                row[name] = measure(ctx, kernel, kv)
+                _log(f"[bench] decode_long ctx={ctx} {name}: {row[name]}")
+        base = row.get("xla_fp32", {}).get("tok_s")
+        best = row.get("flash_int8", {}).get("tok_s")
+        if base and best:
+            row["flash_int8_vs_xla_fp32"] = round(best / base, 3)
+        table[str(ctx)] = row
+    longest = table[str(max(contexts))]
+    head = (longest.get("flash_int8", {}).get("tok_s")
+            or longest.get("xla_bf16", {}).get("tok_s")
+            or longest.get("xla_fp32", {}).get("tok_s") or 0.0)
+    out = {"metric": "tokens_per_sec_decode_long_ctx",
+           "value": head, "unit": "tokens/s/chip",
+           "device": dev.platform,
+           "device_kind": str(getattr(dev, "device_kind", "")),
+           "batch": B, "contexts": list(contexts),
+           "kernel_certified": bool(kernel_ok),
+           "donate": flags.donate_decode(),
+           "by_context": table,
+           "vs_baseline": 0.0}
+    if parity is not None:
+        out["interpret_parity"] = parity
+    ratio = longest.get("flash_int8_vs_xla_fp32")
+    if ratio is not None:
+        out["flash_int8_vs_xla_fp32_at_max_ctx"] = ratio
+    return out
+
+
+def _decode_long_parity(generate, gpt, cfg, params):
+    """Interpret-mode gate for the CPU smoke: kernel-on decode logits
+    must match the einsum path (and greedy argmax exactly) for bf16 and
+    int8 caches before the arm reports any throughput number."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops import decode_attention as da
+
+    saved = {k: os.environ.get(k) for k in
+             ("PADDLE_TPU_FLASH_DECODE", "PADDLE_TPU_KV_DTYPE")}
+    for kv in ("", "int8"):
+        if kv:
+            os.environ["PADDLE_TPU_KV_DTYPE"] = kv
+        else:
+            os.environ.pop("PADDLE_TPU_KV_DTYPE", None)
+        try:
+            cache = da.random_filled_cache(
+                generate.init_cache(cfg, 2, 128), jax.random.PRNGKey(2))
+            tok = jnp.asarray([3, 7], jnp.int32)
+            os.environ["PADDLE_TPU_FLASH_DECODE"] = "1"
+            lk, _ = generate.decode_step(params, dict(cache), tok, 100,
+                                         cfg)
+            os.environ["PADDLE_TPU_FLASH_DECODE"] = "0"
+            lx, _ = generate.decode_step(params, dict(cache), tok, 100,
+                                         cfg)
+            np.testing.assert_allclose(np.asarray(lk), np.asarray(lx),
+                                       atol=5e-2, rtol=5e-2)
+            if kv != "int8":
+                assert (np.asarray(jnp.argmax(lk, -1))
+                        == np.asarray(jnp.argmax(lx, -1))).all()
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+
 def bench_serving(small: bool):
     """Continuous-batching DecodeServer throughput (round-5 verdict Next
     #2): batch 8, 128-token prompts, 128 new tokens each, measured with
@@ -1634,7 +1881,8 @@ def bench_serving(small: bool):
 
 _CONFIGS = {"gpt": bench_gpt, "train": bench_train, "mnist": bench_mnist,
             "resnet": bench_resnet, "bert": bench_bert, "int8": bench_int8,
-            "decode": bench_decode, "serving": bench_serving}
+            "decode": bench_decode, "decode_long": bench_decode_long,
+            "serving": bench_serving}
 
 
 def main():

@@ -134,5 +134,59 @@ def donate_decode() -> bool:
     return v not in ("0", "false", "off", "no")
 
 
+def flash_decode() -> bool:
+    """Split-KV Pallas decode attention on the cached-decode hot path (ON
+    by default).
+
+    When on (and the backend is a TPU whose probe passes), every cached
+    attention site — single-token decode, batched serving ticks, verify
+    chunks, chunked prefill — routes through
+    ``ops/decode_attention.decode_attention`` instead of the XLA einsum
+    over the full cache; off-TPU the einsum path is used regardless, so
+    CPU tests see no change.  ``PADDLE_TPU_FLASH_DECODE=0`` is the escape
+    hatch — like donation, the routing is baked into the compiled
+    executable at trace time, so the flag is part of the decode jit-cache
+    key (``decode_jit_key``): flipping it mid-process retraces."""
+    v = os.environ.get("PADDLE_TPU_FLASH_DECODE", "1").strip().lower()
+    return v not in ("0", "false", "off", "no")
+
+
+def kv_cache_dtype() -> str:
+    """KV-cache STORAGE dtype: '' (default — the model's compute dtype,
+    the pre-flag behavior), 'fp32', 'bf16', or 'int8'.
+
+    Selected at ``generate.init_cache`` time; int8 stores per-(position,
+    head) scales beside the cache (``decode_attention.quantize_kv``) and
+    dequantizes inside the decode kernel — decode HBM reads drop 4x vs
+    fp32 (2x vs bf16) and the cache footprint shrinks the same factor.
+    Composes with donation: shapes and dtypes are fixed per config, so
+    the aliased buffers never change layout.  Part of ``decode_jit_key``
+    (trace-time: the storage dtype changes the compiled program)."""
+    v = os.environ.get("PADDLE_TPU_KV_DTYPE", "").strip().lower()
+    if v in ("", "fp32", "float32"):
+        return "" if v == "" else "fp32"
+    if v in ("bf16", "bfloat16"):
+        return "bf16"
+    if v == "int8":
+        return "int8"
+    raise ValueError(
+        f"PADDLE_TPU_KV_DTYPE={v!r}: expected fp32|bf16|int8 (or empty "
+        f"for the model compute dtype)")
+
+
+def decode_jit_key() -> tuple:
+    """The trace-time decode-routing flag tuple — folded into every
+    decode/serving jit-cache key (``generate._cfg_key``), so flipping any
+    of these env vars mid-process retraces rather than silently reusing
+    an executable that baked in the other routing: W4 kernel gate
+    (woq.mm), fused LN (gpt._ln), cache donation, flash-decode kernel
+    routing, and the KV-cache storage dtype."""
+    return (os.environ.get("PADDLE_TPU_W4_KERNEL", ""),
+            os.environ.get("PADDLE_TPU_FUSED_LN", ""),
+            os.environ.get("PADDLE_TPU_DONATE_DECODE", ""),
+            os.environ.get("PADDLE_TPU_FLASH_DECODE", ""),
+            kv_cache_dtype())
+
+
 if _ENV_SEEDED:
     set_flags(_ENV_SEEDED)

@@ -18,6 +18,7 @@ KERNEL_SOURCE_FILES = (
     "_pallas_probe.py",
     "attention.py",
     "woq_matmul.py",
+    "decode_attention.py",
 )
 
 # Certification FAMILIES (round-5): the marker records a source signature
@@ -31,11 +32,16 @@ KERNEL_FAMILIES = {
     "fused_ln": ("fused_norm.py",),
     "fused_ce": ("fused_ce.py",),
     "w4": ("woq_matmul.py",),
+    # split-KV flash-decode + quantized-KV format: kernel, XLA oracle,
+    # and quantize/dequantize all live in decode_attention.py; the
+    # production einsum fallback it must match lives in generate.py
+    "decode": ("decode_attention.py",),
 }
 SHARED_KERNEL_FILES = ("_pallas_probe.py",)
 TRAINING_FAMILIES = ("flash", "fused_ln", "fused_ce")
 # repo-root-relative extra oracle sources a family's parity math uses
-FAMILY_EXTRA_SOURCES = {"w4": ("paddle_tpu/text/woq.py",)}
+FAMILY_EXTRA_SOURCES = {"w4": ("paddle_tpu/text/woq.py",),
+                        "decode": ("paddle_tpu/text/generate.py",)}
 
 # the families must exactly cover the registry — the same no-drift rule
 # the registry itself exists for

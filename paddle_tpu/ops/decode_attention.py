@@ -1,0 +1,284 @@
+"""Pallas TPU split-KV flash-decode attention + the quantized-KV helpers.
+
+The training/prefill flash kernel (ops/flash_attention.py) rejects decode
+shapes (T_q = 1), so long-context decode attention ran as plain XLA over
+the full [B, T, Hkv, hd] cache — per-token HBM traffic scales with the
+context length, which is the serving bottleneck once dispatch and weight
+reads are optimized (PR 1/2).  This kernel streams the KV cache through
+VMEM in T-blocks with the same online-softmax recurrence as
+``_flash_fwd_impl``, specialized for small T_q:
+
+* **split-KV grid** ``(B * Hkv, T // BT)``: each cell owns one (batch,
+  kv-head) pair and walks the KV blocks keeping a running max/denominator
+  in VMEM scratch — no [T] score row ever hits HBM, and blocks entirely
+  past the causal frontier (``base > pos + Tq - 1``) are skipped;
+* **GQA-aware**: the q rows for one kv head are its whole query group
+  ([Tq * G, hd], G = Hq // Hkv), so the kernel consumes the Hkv-head
+  cache DIRECTLY (the ``repeat_kv=False`` layout ``_gqa_qkv`` already
+  produces) instead of materializing repeated K/V heads — the HBM read
+  is the cache's true size, not G times it;
+* **int8 cache**: per-(position, head) scales (``quantize_kv``) dequantize
+  inside the kernel right after the VMEM load — HBM reads a quarter of
+  the fp32 bytes, and no dequantized copy is ever written back.
+
+Forward-only by design (decode is inference).  Availability probing +
+XLA fallback follow ops/flash_attention.py; the routing gate is
+``PADDLE_TPU_FLASH_DECODE`` (read by text/generate.py, which keeps its
+original einsum math as the off/fallback path).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_FALLBACK: dict = {}
+_INTERPRET = False  # tests flip this to run the kernel on CPU (interpret)
+
+_NEG = -1e30  # large-negative instead of -inf (flash_attention's rule)
+
+_R_CAP = 1024  # q rows (Tq * G) per grid cell; verify chunks stay under it
+
+
+def _kv_block(T: int) -> int | None:
+    """KV block length: the largest standard tile dividing T, or T itself
+    for short test-sized caches (interpret mode / tiny serving windows)."""
+    for cand in (512, 256, 128):
+        if T % cand == 0:
+            return cand
+    if T <= 512 and T % 8 == 0:
+        return T
+    return None
+
+
+def supported(q_shape, kv_shape) -> bool:
+    """Static shape gate: q [B, Tq, Hq, hd] against cache [B, T, Hkv, hd]."""
+    B, Tq, Hq, hd = q_shape
+    T, Hkv = kv_shape[1], kv_shape[2]
+    return (hd in (64, 128, 256) and Hq % Hkv == 0
+            and Tq * (Hq // Hkv) <= _R_CAP
+            and _kv_block(T) is not None)
+
+
+def available(q_shape, kv_shape) -> bool:
+    """supported() + a backend that can run the kernel (TPU, or interpret
+    mode for CPU tests).  The per-configuration probe runs inside
+    ``decode_attention`` — this is the cheap trace-time routing check
+    text/generate.py consults before leaving its einsum path."""
+    if not supported(q_shape, kv_shape):
+        return False
+    if _INTERPRET:
+        return True
+    from ._pallas_probe import tpu_backend
+
+    return tpu_backend()
+
+
+# ---------------------------------------------------------------------------
+# quantized-KV helpers — THE int8 cache format, in one place
+# ---------------------------------------------------------------------------
+
+
+def quantize_kv(x):
+    """Symmetric per-(…, head) int8 over the trailing head_dim axis:
+    returns (q int8 like x, scale fp32 of x.shape[:-1]).  One K/V row's
+    head vector shares one scale — the scale array rides beside the cache
+    at hd*... /1 of its size (~1-2%), and dequant inside the kernel is a
+    single broadcast multiply."""
+    xf = x.astype(jnp.float32)
+    s = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1), 1e-8) / 127.0
+    q = jnp.clip(jnp.round(xf / s[..., None]), -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def dequantize_kv(q, s, dt):
+    """Inverse of quantize_kv, in fp32 then cast (matches the kernel's
+    internal math) — the XLA-fallback attention path uses this."""
+    return (q.astype(jnp.float32) * s[..., None]).astype(dt)
+
+
+def random_filled_cache(cache: dict, key, amp: float = 1.0) -> dict:
+    """A ``generate.init_cache`` tree filled with synthetic normal K/V
+    (scaled by ``amp``), quantizing through the real format when the
+    cache carries scale planes — THE cache-format-aware fill the bench
+    and on-device certification share (one copy; a format change edits
+    exactly here)."""
+    ks = jax.random.split(key, 2)
+    kf = jax.random.normal(ks[0], cache["k"].shape) * amp
+    vf = jax.random.normal(ks[1], cache["v"].shape) * amp
+    if "k_s" in cache:
+        k, k_s = quantize_kv(kf)
+        v, v_s = quantize_kv(vf)
+        return dict(cache, k=k, v=v, k_s=k_s, v_s=v_s)
+    return dict(cache, k=kf.astype(cache["k"].dtype),
+                v=vf.astype(cache["v"].dtype))
+
+
+# ---------------------------------------------------------------------------
+# XLA reference (parity oracle + runtime fallback)
+# ---------------------------------------------------------------------------
+
+
+def _xla_decode(q, k, v, pos, k_scale, v_scale, scale):
+    """Grouped-query cached attention in plain XLA: q [B, Tq, Hq, hd],
+    cache [B, T, Hkv, hd] (+ scales for int8), mask t <= pos[b] + i for
+    q row i.  fp32 softmax like every attention path in this repo."""
+    B, Tq, Hq, hd = q.shape
+    T, Hkv = k.shape[1], k.shape[2]
+    G = Hq // Hkv
+    scale = scale if scale is not None else 1.0 / (hd ** 0.5)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    if k_scale is not None:
+        kf = kf * k_scale[..., None]
+        vf = vf * v_scale[..., None]
+    qg = q.reshape(B, Tq, Hkv, G, hd).astype(jnp.float32)
+    s = jnp.einsum("bikgd,btkd->bkgit", qg, kf) * scale
+    mask = (jnp.arange(T)[None, :]
+            <= pos[:, None, None, None, None] + jnp.arange(Tq)[:, None])
+    s = jnp.where(mask, s, _NEG)
+    w = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bkgit,btkd->bikgd", w, vf)
+    return out.reshape(B, Tq, Hq, hd).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# kernel
+# ---------------------------------------------------------------------------
+
+
+def _probe(q_dtype, kv_dtype, Tq: int, G: int, hd: int, BT: int) -> bool:
+    """True = fall back.  Probes the exact (block shapes, dtypes)
+    configuration the real call lowers with, per _pallas_probe's rules."""
+    from ._pallas_probe import probe_once
+
+    def thunk():
+        quant = jnp.dtype(kv_dtype) == jnp.int8
+        q = jax.device_put(jnp.zeros((1, Tq, G, hd), q_dtype))
+        k = jax.device_put(jnp.zeros((1, BT, 1, hd), kv_dtype))
+        ks = (jax.device_put(jnp.ones((1, BT, 1), jnp.float32))
+              if quant else None)
+        pos = jax.device_put(jnp.zeros((1,), jnp.int32))
+        return _decode_call(q, k, k, pos, ks, ks, None)
+
+    return probe_once(
+        _FALLBACK,
+        (jnp.dtype(q_dtype).name, jnp.dtype(kv_dtype).name,
+         int(Tq), int(G), int(hd), int(BT)), thunk)
+
+
+def decode_attention(q, k, v, pos, k_scale=None, v_scale=None, scale=None):
+    """q [B, Tq, Hq, hd] against a cache [B, T, Hkv, hd] → [B, Tq, Hq, hd]
+    (q.dtype).  ``pos`` [B] int32: q row i of batch b attends cache rows
+    t <= pos[b] + i (decode passes Tq=1 and the current position; verify/
+    chunked-prefill pass the chunk and its first position).  int8 caches
+    pass per-row ``k_scale``/``v_scale`` [B, T, Hkv].  Falls back to the
+    XLA expression when the Pallas path is unavailable.
+
+    Not jitted itself: the availability probe must execute eagerly
+    (flash_attention's rule — it still works when tracing)."""
+    if not supported(q.shape, k.shape):
+        return _xla_decode(q, k, v, pos, k_scale, v_scale, scale)
+    G = q.shape[2] // k.shape[2]
+    BT = _kv_block(k.shape[1])
+    if not _INTERPRET and _probe(q.dtype, k.dtype, q.shape[1], G,
+                                 q.shape[-1], BT):
+        return _xla_decode(q, k, v, pos, k_scale, v_scale, scale)
+    return _decode_call(q, k, v, pos, k_scale, v_scale, scale)
+
+
+def _decode_call(q, k, v, pos, k_scale, v_scale, scale):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, Tq, Hq, hd = q.shape
+    T, Hkv = k.shape[1], k.shape[2]
+    G = Hq // Hkv
+    R = Tq * G
+    BT = _kv_block(T)
+    nt = T // BT
+    scale = scale if scale is not None else 1.0 / (hd ** 0.5)
+    quant = k_scale is not None
+
+    # rows for kv head h are its whole query group, causally ordered:
+    # row r = tq * G + g  (mask recovers tq as r // G)
+    qh = q.reshape(B, Tq, Hkv, G, hd).swapaxes(1, 2).reshape(B, Hkv, R, hd)
+    pos2 = pos.reshape(B, 1).astype(jnp.int32)
+
+    def kernel(pos_ref, q_ref, k_ref, v_ref, *rest):
+        if quant:
+            ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+        else:
+            o_ref, m_scr, l_scr, acc_scr = rest
+        ti = pl.program_id(1)
+
+        @pl.when(ti == 0)
+        def _init():
+            m_scr[:] = jnp.full_like(m_scr, _NEG)
+            l_scr[:] = jnp.zeros_like(l_scr)
+            acc_scr[:] = jnp.zeros_like(acc_scr)
+
+        p_b = pos_ref[0, 0]
+        base = ti * BT
+
+        # skip KV blocks entirely past the causal frontier
+        @pl.when(base <= p_b + Tq - 1)
+        def _run():
+            qb = q_ref[0, 0].astype(jnp.float32)           # [R, hd]
+            kb = k_ref[0, :, 0, :].astype(jnp.float32)     # [BT, hd]
+            vb = v_ref[0, :, 0, :].astype(jnp.float32)
+            if quant:
+                kb = kb * ks_ref[0, :, 0][:, None]
+                vb = vb * vs_ref[0, :, 0][:, None]
+            s = scale * jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)        # [R, BT]
+            rows_tq = jax.lax.broadcasted_iota(jnp.int32, (R, BT), 0) // G
+            cols = base + jax.lax.broadcasted_iota(jnp.int32, (R, BT), 1)
+            s = jnp.where(cols <= p_b + rows_tq, s, _NEG)
+            m_prev = m_scr[:, 0]
+            m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
+            p = jnp.exp(s - m_cur[:, None])
+            alpha = jnp.exp(m_prev - m_cur)
+            l_scr[:, 0] = l_scr[:, 0] * alpha + jnp.sum(p, axis=1)
+            acc_scr[:] = acc_scr[:] * alpha[:, None] + jax.lax.dot_general(
+                p, vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_scr[:, 0] = m_cur
+
+        @pl.when(ti == nt - 1)
+        def _fin():
+            l = l_scr[:, 0]
+            l_safe = jnp.where(l == 0.0, 1.0, l)
+            o_ref[0, 0] = (acc_scr[:] / l_safe[:, None]).astype(o_ref.dtype)
+
+    in_specs = [
+        pl.BlockSpec((1, 1), lambda i, t: (i // Hkv, 0),
+                     memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, 1, R, hd), lambda i, t: (i // Hkv, i % Hkv, 0, 0)),
+        pl.BlockSpec((1, BT, 1, hd), lambda i, t: (i // Hkv, t, i % Hkv, 0)),
+        pl.BlockSpec((1, BT, 1, hd), lambda i, t: (i // Hkv, t, i % Hkv, 0)),
+    ]
+    args = [pos2, qh, k, v]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, BT, 1), lambda i, t: (i // Hkv, t, i % Hkv)),
+            pl.BlockSpec((1, BT, 1), lambda i, t: (i // Hkv, t, i % Hkv)),
+        ]
+        args += [k_scale, v_scale]
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * Hkv, nt),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, R, hd),
+                               lambda i, t: (i // Hkv, i % Hkv, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, R, hd), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((R, 1), jnp.float32),
+            pltpu.VMEM((R, 1), jnp.float32),
+            pltpu.VMEM((R, hd), jnp.float32),
+        ],
+        interpret=_INTERPRET,
+    )(*args)
+    return (out.reshape(B, Hkv, Tq, G, hd).swapaxes(1, 2)
+            .reshape(B, Tq, Hq, hd))

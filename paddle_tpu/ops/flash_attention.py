@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 
 _FALLBACK: dict = {}
+_INTERPRET = False  # tests flip this to run the kernels on CPU (interpret)
 
 
 def _xla(q, k, v, causal, scale):
@@ -57,7 +58,7 @@ def flash_attention(q, k, v, causal: bool = False, scale=None):
     Not jitted itself: the availability probe must execute eagerly (it still
     works when tracing — the probe runs on its own concrete arrays)."""
     if not _shape_supported(q.shape, k.shape[1]) \
-            or _probe(q.dtype, causal, q.shape[-1]):
+            or (not _INTERPRET and _probe(q.dtype, causal, q.shape[-1])):
         return _xla(q, k, v, causal, scale)
     return _flash(q, k, v, causal, scale)
 
@@ -181,6 +182,7 @@ def _flash_fwd_impl(q, k, v, causal, scale):
             pltpu.VMEM((BQ, 1), jnp.float32),
             pltpu.VMEM((BQ, D), jnp.float32),
         ],
+        interpret=_INTERPRET,
     )(qh, kh, vh)
     return _heads_last(out, B, H), lse
 
@@ -258,6 +260,7 @@ def _flash_bwd_impl(q, k, v, out, lse, do, causal, scale):
         out_specs=pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((BQ, D), jnp.float32)],
+        interpret=_INTERPRET,
     )(qh, kh, vh, doh, lse, delta)
 
     # -- dk/dv: grid (BH, nk, nq), accumulate over q blocks -----------------
@@ -319,6 +322,7 @@ def _flash_bwd_impl(q, k, v, out, lse, do, causal, scale):
             pltpu.VMEM((BK, D), jnp.float32),
             pltpu.VMEM((BK, D), jnp.float32),
         ],
+        interpret=_INTERPRET,
     )(qh, kh, vh, doh, lse, delta)
 
     return (_heads_last(dq, B, H), _heads_last(dk, B, H), _heads_last(dv, B, H))

@@ -16,7 +16,7 @@ import jax.numpy as jnp
 
 from . import generate, gpt
 
-__all__ = ["nll", "perplexity"]
+__all__ = ["nll", "perplexity", "cached_nll", "cached_perplexity"]
 
 _EVAL_CACHE: dict = {}
 
@@ -63,3 +63,60 @@ def perplexity(params, cfg: gpt.GPTConfig, tokens) -> float:
     import math
 
     return math.exp(nll(params, cfg, tokens))
+
+
+def _cached_eval_fn(cfg: gpt.GPTConfig):
+    key = ("cached", generate._cfg_key(cfg))
+    fn = _EVAL_CACHE.get(key)
+    if fn is None:
+        def run(params, tokens):
+            # feed token t at position t through the DECODE path; its
+            # logits score token t+1 — one lax.scan over positions
+            B, T1 = tokens.shape
+            cache = generate.init_cache(cfg, B, T1 - 1)
+
+            def step(cache, t):
+                logits, cache = generate.decode_step(
+                    params, cache, tokens[:, t], t, cfg)
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                return cache, jnp.take_along_axis(
+                    logp, tokens[:, t + 1][:, None], -1)[:, 0]
+
+            _, ll = jax.lax.scan(step, cache, jnp.arange(T1 - 1))
+            return -ll.sum(), ll.size
+
+        fn = jax.jit(run)
+        _EVAL_CACHE[key] = fn
+    return fn
+
+
+def cached_nll(params, cfg: gpt.GPTConfig, tokens) -> float:
+    """Mean per-token NLL scored through the KV-CACHE decode path
+    (``generate.decode_step``), not the teacher-forced forward.
+
+    With the default cache dtype this matches :func:`nll` to numerical
+    tolerance (the cache is exact) — its purpose is measuring the quality
+    cost of LOSSY cache settings: ``PADDLE_TPU_KV_DTYPE=int8`` quantizes
+    what decode attends to, which the forward-pass perplexity can never
+    see.  The README's int8 accuracy caveat cites this number."""
+    import numpy as np
+
+    fn = _cached_eval_fn(cfg)
+    batches = tokens if isinstance(tokens, (list, tuple)) else [tokens]
+    total, count = 0.0, 0
+    for b in batches:
+        b = jnp.asarray(np.asarray(b), jnp.int32)
+        if b.ndim != 2 or b.shape[1] < 2:
+            raise ValueError(f"eval batch must be [B, T+1] with T >= 1, "
+                             f"got {b.shape}")
+        s, n = fn(params, b)
+        total += float(s)
+        count += int(n)
+    return total / max(count, 1)
+
+
+def cached_perplexity(params, cfg: gpt.GPTConfig, tokens) -> float:
+    """exp(mean cached_nll) — perplexity through the decode path."""
+    import math
+
+    return math.exp(cached_nll(params, cfg, tokens))

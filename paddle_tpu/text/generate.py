@@ -18,24 +18,134 @@ import jax
 import jax.numpy as jnp
 
 from . import gpt, woq
+from .. import flags as _flags
 
 __all__ = ["init_cache", "decode_step", "generate"]
 
 
+def _kv_store_dtype(cfg: gpt.GPTConfig):
+    """The cache STORAGE dtype (flags.kv_cache_dtype): '' = the model's
+    compute dtype (the default, pre-flag behavior)."""
+    name = _flags.kv_cache_dtype()
+    if name == "fp32":
+        return jnp.float32
+    if name == "bf16":
+        return jnp.bfloat16
+    if name == "int8":
+        return jnp.int8
+    return cfg.dtype
+
+
+def _round_cache_len(n: int) -> int:
+    """Round a cache length up to a flash-decode-tileable size (8-multiple
+    up to 512, 128-multiple beyond): the row count is pure ALLOCATION —
+    the causal mask hides rows past the write position — so padding a few
+    rows costs a sliver of HBM while an unaligned length would silently
+    pin every decode of that cache on the einsum fallback (callers pass
+    arbitrary prompt+max_new totals)."""
+    n = max(int(n), 1)
+    if n <= 512:
+        return -(-n // 8) * 8
+    return -(-n // 128) * 128
+
+
 def init_cache(cfg: gpt.GPTConfig, batch: int, max_len: int):
-    """Per-layer K/V cache [L, B, max_len, Hkv, hd]; the caller tracks the
-    write position (generate's scan carries it implicitly).  Under GQA
-    (cfg.num_kv_heads) the cache holds only the Hkv shared heads — the
-    num_heads/Hkv decode-memory saving is the feature's point."""
+    """Per-layer K/V cache [L, B, T, Hkv, hd] with T = ``max_len`` rounded
+    up to a kernel-tileable length (_round_cache_len — extra rows stay
+    masked); the caller tracks the write position (generate's scan
+    carries it implicitly).  Under GQA (cfg.num_kv_heads) the cache holds
+    only the Hkv shared heads — the num_heads/Hkv decode-memory saving is
+    the feature's point.
+
+    ``PADDLE_TPU_KV_DTYPE`` selects the storage dtype; int8 caches carry
+    per-(position, head) fp32 scale planes ``k_s``/``v_s``
+    [L, B, T, Hkv] beside the values (~hd x smaller), written by
+    the same row writes and dequantized at the attention site (inside
+    the flash-decode kernel, or before the XLA einsum)."""
     L, H, hd = cfg.num_layers, cfg.kv_heads, cfg.head_dim
-    shape = (L, batch, max_len, H, hd)
-    return {"k": jnp.zeros(shape, cfg.dtype),
-            "v": jnp.zeros(shape, cfg.dtype)}
+    dt = _kv_store_dtype(cfg)
+    shape = (L, batch, _round_cache_len(max_len), H, hd)
+    cache = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+    if dt == jnp.int8:
+        cache["k_s"] = jnp.zeros(shape[:-1], jnp.float32)
+        cache["v_s"] = jnp.zeros(shape[:-1], jnp.float32)
+    return cache
 
 
-def _cached_block(x, p, cache_k, cache_v, pos, cfg: gpt.GPTConfig):
-    """One block on a SINGLE position [B, 1, D] against the cache.
-    Returns (x, new_k_row, new_v_row): caller writes the rows at pos."""
+def _store_rows(k_rows, v_rows, cfg: gpt.GPTConfig) -> dict:
+    """Compute-dtype K/V rows [..., Hkv, hd] → cache-storage leaves (the
+    dict mirrors the cache structure minus the time axis handling): int8
+    quantizes per-(row, head) and adds the scale leaves."""
+    from ..ops import decode_attention as da
+
+    dt = _kv_store_dtype(cfg)
+    if dt == jnp.int8:
+        qk, sk = da.quantize_kv(k_rows)
+        qv, sv = da.quantize_kv(v_rows)
+        return {"k": qk, "v": qv, "k_s": sk, "v_s": sv}
+    return {"k": k_rows.astype(dt), "v": v_rows.astype(dt)}
+
+
+def _use_decode_kernel(cfg: gpt.GPTConfig, q_shape, kv_shape) -> bool:
+    """Route this cached-attention site through the split-KV Pallas
+    kernel?  Flag + backend/shape gate (ops/decode_attention.available);
+    the per-config probe then runs inside the op itself.  False keeps the
+    site on its original einsum math — bit-identical to pre-kernel
+    behavior (and the only path off-TPU outside interpret tests)."""
+    from ..ops import decode_attention as da
+
+    return _flags.flash_decode() and da.available(q_shape, kv_shape)
+
+
+def _attend_cache(q, full, pos, cfg: gpt.GPTConfig):
+    """Cached attention for a Tq-row query block against one layer's
+    cache slice ``full`` (rows through the current positions already
+    written): q [B, Tq, H, hd], full leaves k/v [B, T, Hkv, hd]
+    (+ scales), row i of batch b attends rows t <= pos + i.  Returns
+    [B, Tq, H*hd] in the compute dtype.
+
+    Kernel path: ops/decode_attention (GQA-aware split-KV streaming,
+    int8 dequant in-kernel).  Fallback: the original grouped einsum —
+    int8 caches dequantize via the shared helper first."""
+    B, Tq, H, hd = q.shape
+    dt = cfg.dtype
+    k_all, v_all = full["k"], full["v"]
+    ks, vs = full.get("k_s"), full.get("v_s")
+    if _use_decode_kernel(cfg, q.shape, k_all.shape):
+        from ..ops import decode_attention as da
+
+        pos_b = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (B,))
+        out = da.decode_attention(q, k_all, v_all, pos_b,
+                                  k_scale=ks, v_scale=vs)
+        return out.astype(dt).reshape(B, Tq, H * hd)
+    if ks is not None:
+        from ..ops import decode_attention as da
+
+        k_all = da.dequantize_kv(k_all, ks, dt)
+        v_all = da.dequantize_kv(v_all, vs, dt)
+    # a non-compute storage dtype (fp32/bf16 flag) joins the einsums in
+    # the COMPUTE dtype — the residual stream's dtype is a scan-carry
+    # invariant, and mixed-dtype einsums would silently promote it
+    k_all = k_all.astype(dt)
+    v_all = v_all.astype(dt)
+    T = k_all.shape[1]
+    Hkv = k_all.shape[2]
+    g = H // Hkv
+    qg = q.reshape(B, Tq, Hkv, g, hd)
+    scores = jnp.einsum("bikgd,btkd->bkgit", qg, k_all) / jnp.sqrt(
+        jnp.asarray(hd, jnp.float32)).astype(dt)
+    mask = (jnp.arange(T)[None, :]
+            <= pos + jnp.arange(Tq)[:, None])[None, None, None]
+    scores = jnp.where(mask, scores.astype(jnp.float32), -1e30)
+    w = jax.nn.softmax(scores, axis=-1).astype(dt)
+    return jnp.einsum("bkgit,btkd->bikgd", w, v_all).reshape(B, Tq, -1)
+
+
+def _cached_block(x, p, csl, pos, cfg: gpt.GPTConfig):
+    """One block on a SINGLE position [B, 1, D] against one layer's cache
+    slice ``csl`` (leaves k/v [B, T, Hkv, hd], plus scales for int8).
+    Returns (x, rows): storage-dtype row leaves for the caller to write
+    at pos."""
     B, _, D = x.shape
     H, hd = cfg.num_heads, cfg.head_dim
     dt = cfg.dtype
@@ -48,38 +158,36 @@ def _cached_block(x, p, cache_k, cache_v, pos, cfg: gpt.GPTConfig):
         pos_arr = jnp.asarray(pos, jnp.int32).reshape(1)
         q3 = gpt.apply_rope(q3, pos_arr)
         k3 = gpt.apply_rope(k3, pos_arr)
-    q = q3.reshape(B, H, hd)
     k_new = k3.reshape(B, -1, hd)   # Hkv rows under GQA, H otherwise
     v_new = v3.reshape(B, -1, hd)
-    # attend over cache rows [B, max_len, H, hd] with the fresh row at pos
-    k_all = jax.lax.dynamic_update_slice(
-        cache_k, k_new[:, None], (0, pos, 0, 0))
-    v_all = jax.lax.dynamic_update_slice(
-        cache_v, v_new[:, None], (0, pos, 0, 0))
-    if cfg.num_kv_heads is not None and cfg.kv_heads != H:
-        # grouped attention against the Hkv-head cache: fold the group dim
-        # into the einsum instead of repeating the whole cache
-        g = H // cfg.kv_heads
-        qg = q.reshape(B, cfg.kv_heads, g, hd)
-        scores = jnp.einsum("bkgd,btkd->bkgt", qg, k_all) / jnp.sqrt(
-            jnp.asarray(hd, jnp.float32)).astype(dt)
-        scores = scores.reshape(B, H, k_all.shape[1])
-    else:
-        scores = jnp.einsum("bhd,bthd->bht", q, k_all) / jnp.sqrt(
-            jnp.asarray(hd, jnp.float32)).astype(dt)
-    T = cache_k.shape[1]
-    mask = jnp.arange(T)[None, None, :] <= pos
-    scores = jnp.where(mask, scores.astype(jnp.float32), -1e30)
-    w = jax.nn.softmax(scores, axis=-1).astype(dt)
-    if cfg.num_kv_heads is not None and cfg.kv_heads != H:
-        g = H // cfg.kv_heads
-        wg = w.reshape(B, cfg.kv_heads, g, -1)
-        attn = jnp.einsum("bkgt,btkd->bkgd", wg, v_all).reshape(B, 1, D)
-    else:
-        attn = jnp.einsum("bht,bthd->bhd", w, v_all).reshape(B, 1, D)
+    rows = _store_rows(k_new, v_new, cfg)
+    # attend over cache rows [B, max_len, Hkv, hd] with the fresh row at
+    # pos — spliced in STORAGE form, so what this step attends is exactly
+    # what later steps will read back (int8 included)
+    full = {name: jax.lax.dynamic_update_slice(
+                csl[name], val[:, None],
+                (0, pos) + (0,) * (csl[name].ndim - 2))
+            for name, val in rows.items()}
+    attn = _attend_cache(q3, full, pos, cfg)           # [B, 1, D]
     a = woq.mm(attn, p, "proj_w", dt) + p["proj_b"].astype(dt)
     x = x + a
-    return gpt._ffn_tail(x, p, cfg), k_new, v_new
+    return gpt._ffn_tail(x, p, cfg), rows
+
+
+def _write_rows(cache: dict, rows: dict, pos) -> dict:
+    """Write stacked per-layer rows (leaves [L, B, P?, Hkv(, hd)]) into
+    the cache at time index ``pos`` — the single row-write every decode/
+    verify path funnels through.  Rows without a time axis (single-token
+    decode: [L, B, Hkv(, hd)]) get one inserted."""
+    out = {}
+    for name, val in rows.items():
+        arr = cache[name]
+        if val.ndim == arr.ndim - 1:
+            val = jnp.expand_dims(val, 2)
+        out[name] = jax.lax.dynamic_update_slice(
+            arr, val.astype(arr.dtype),
+            (0, 0, pos) + (0,) * (arr.ndim - 3))
+    return out
 
 
 def decode_step(params, cache, token, pos, cfg: gpt.GPTConfig):
@@ -99,19 +207,15 @@ def decode_step(params, cache, token, pos, cfg: gpt.GPTConfig):
             params["wpe"], (pos, 0), (1, cfg.hidden_size)).astype(dt)[None]
 
     def body(x, layer):
-        p, ck, cv = layer
-        x, k_new, v_new = _cached_block(x, p, ck, cv, pos, cfg)
-        return x, (k_new, v_new)
+        p, csl = layer
+        x, rows = _cached_block(x, p, csl, pos, cfg)
+        return x, rows
 
-    x, (k_rows, v_rows) = jax.lax.scan(
-        body, x, (params["blocks"], cache["k"], cache["v"]))
-    new_k = jax.lax.dynamic_update_slice(
-        cache["k"], k_rows[:, :, None], (0, 0, pos, 0, 0))
-    new_v = jax.lax.dynamic_update_slice(
-        cache["v"], v_rows[:, :, None], (0, 0, pos, 0, 0))
+    x, rows = jax.lax.scan(body, x, (params["blocks"], cache))
+    new_cache = _write_rows(cache, rows, pos)
     x = gpt._norm(x, params, "ln_f", cfg)
     logits = woq.logits(x, params, dt)[:, 0]
-    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+    return logits.astype(jnp.float32), new_cache
 
 
 class _LRU:
@@ -201,15 +305,13 @@ def _cfg_key(cfg):
             cfg.max_seq_len, cfg.ffn_ratio, str(cfg.dtype), cfg.use_flash,
             cfg.pos_embed, cfg.norm, cfg.activation,
             moe_key,
-            # trace-time env routing flags: an executable BAKES these in
-            # (woq.mm reads PADDLE_TPU_W4_KERNEL, gpt._ln reads FUSED_LN
-            # at trace time) — flipping a flag mid-process must retrace,
-            # not silently reuse the other routing's executable
-            _os.environ.get("PADDLE_TPU_W4_KERNEL", ""),
-            _os.environ.get("PADDLE_TPU_FUSED_LN", ""),
-            # donation is baked into the executable (aliased vs copied
-            # cache buffers) — same retrace-on-flip rule as the kernels
-            _os.environ.get("PADDLE_TPU_DONATE_DECODE", ""))
+            # trace-time env routing flags (flags.decode_jit_key): an
+            # executable BAKES these in — W4 kernel gate (woq.mm), fused
+            # LN (gpt._ln), cache donation (aliased vs copied buffers),
+            # flash-decode kernel routing, and the KV-cache storage
+            # dtype.  Flipping any of them mid-process must retrace, not
+            # silently reuse the other routing's executable.
+            _flags.decode_jit_key())
 
 
 def _get_generate_fn(cfg, max_new_tokens, top_k, top_p=1.0):
@@ -464,10 +566,17 @@ def build_sharded_decode(params, cfg: gpt.GPTConfig, mesh, mp: str = "mp"):
         lambda v, s: jax.device_put(v, ns(s)), params, pspecs,
         is_leaf=lambda v: not isinstance(v, dict))
 
-    # cache [L, B, T, H, hd]: shard heads over mp when divisible;
-    # otherwise replicate (correct, just not memory-split)
-    cache_spec = (P(None, None, None, mp, None)
-                  if cfg.kv_heads % mp_size == 0 else P())
+    # cache leaves [L, B, T, Hkv(, hd)] (values + int8 scale planes):
+    # shard the head axis (3) over mp when divisible; otherwise replicate
+    # (correct, just not memory-split)
+    def _cache_spec(arr):
+        if cfg.kv_heads % mp_size:
+            return P()
+        return P(*([None] * 3 + [mp] + [None] * (arr.ndim - 4)))
+
+    template = init_cache(cfg, 1, 1)
+    cache_specs = {name: _cache_spec(arr) for name, arr in template.items()}
+    cache_shardings = {name: ns(s) for name, s in cache_specs.items()}
     repl = P()
 
     def _step(p, cache, token, pos):
@@ -477,18 +586,26 @@ def build_sharded_decode(params, cfg: gpt.GPTConfig, mesh, mp: str = "mp"):
         _step,
         in_shardings=(jax.tree_util.tree_map(
             ns, pspecs, is_leaf=lambda s: isinstance(s, P)),
-            {"k": ns(cache_spec), "v": ns(cache_spec)},
+            cache_shardings,
             ns(repl), ns(repl)),
-        out_shardings=(ns(repl),
-                       {"k": ns(cache_spec), "v": ns(cache_spec)}),
+        out_shardings=(ns(repl), cache_shardings),
         # the sharded cache is donated like the single-chip steps' —
         # in and out shardings match, so aliasing is exact per shard
         donate_argnums=_donate_cache())
 
     def make_cache(batch: int, max_len: int):
-        return jax.tree_util.tree_map(
-            lambda v: jax.device_put(v, ns(cache_spec)),
-            init_cache(cfg, batch, max_len))
+        fresh = init_cache(cfg, batch, max_len)
+        if set(fresh) != set(cache_shardings):
+            # init_cache re-reads PADDLE_TPU_KV_DTYPE at call time, but
+            # decode_fn baked the build-time structure into its
+            # in_shardings/donation — a flag flip in between must fail
+            # loudly here, not as a pytree mismatch inside the jit
+            raise ValueError(
+                "PADDLE_TPU_KV_DTYPE changed since build_sharded_decode "
+                f"(built {sorted(cache_shardings)}, now {sorted(fresh)}); "
+                "rebuild the sharded decoder")
+        return {name: jax.device_put(arr, cache_shardings[name])
+                for name, arr in fresh.items()}
 
     return sharded_params, make_cache, decode_fn
 
@@ -501,7 +618,7 @@ def build_sharded_decode(params, cfg: gpt.GPTConfig, mesh, mp: str = "mp"):
 def _prefill_block(x, p, cfg: gpt.GPTConfig, valid=None):
     """One block over a PADDED prompt chunk [B, P, D] with within-chunk
     causal attention (the cache is empty at prefill: pos0 == 0), returning
-    (x, k_rows [B, P, Hkv, hd], v_rows) for the caller to write.
+    (x, rows) — storage-dtype row leaves for the caller to merge.
     ``valid`` [B, P]: pad mask forwarded to the MoE router (pads claim no
     expert capacity); dense models ignore it."""
     B, P, D = x.shape
@@ -514,14 +631,27 @@ def _prefill_block(x, p, cfg: gpt.GPTConfig, valid=None):
         pos_arr = jnp.arange(P)
         q = gpt.apply_rope(q, pos_arr)
         k_rows = gpt.apply_rope(k_rows, pos_arr)
-    rep = H // k_rows.shape[2]
-    k = jnp.repeat(k_rows, rep, axis=2) if rep > 1 else k_rows
-    v = jnp.repeat(v_rows, rep, axis=2) if rep > 1 else v_rows
+    rows = _store_rows(k_rows, v_rows, cfg)
+    # attend the STORAGE view of the fresh rows (the sibling sites'
+    # attend-what-you-store invariant): under int8 the admission path
+    # sees exactly the rows later decode steps will read back, so
+    # prefill and token-by-token feeding stay in lockstep
+    if "k_s" in rows:
+        from ..ops import decode_attention as da
+
+        k_att = da.dequantize_kv(rows["k"], rows["k_s"], dt)
+        v_att = da.dequantize_kv(rows["v"], rows["v_s"], dt)
+    else:
+        k_att = rows["k"].astype(dt)
+        v_att = rows["v"].astype(dt)
+    rep = H // k_att.shape[2]
+    k = jnp.repeat(k_att, rep, axis=2) if rep > 1 else k_att
+    v = jnp.repeat(v_att, rep, axis=2) if rep > 1 else v_att
     from ..ops.attention import attention_array
 
     attn = attention_array(q, k, v, is_causal=True).reshape(B, P, D)
     a = woq.mm(attn, p, "proj_w", dt) + p["proj_b"].astype(dt)
-    return gpt._ffn_tail(x + a, p, cfg, valid=valid), k_rows, v_rows
+    return gpt._ffn_tail(x + a, p, cfg, valid=valid), rows
 
 
 def prefill_slot(params, cache, tokens, length, slot, cfg: gpt.GPTConfig):
@@ -547,13 +677,12 @@ def prefill_slot(params, cache, tokens, length, slot, cfg: gpt.GPTConfig):
     valid_mask = (jnp.arange(P) < length)[None, :]       # [1, P]
 
     def body(x, p):
-        x, k_rows, v_rows = _prefill_block(x, p, cfg, valid=valid_mask)
-        return x, (k_rows, v_rows)
+        x, rows = _prefill_block(x, p, cfg, valid=valid_mask)
+        return x, rows
 
-    x, (k_rows, v_rows) = jax.lax.scan(body, x, params["blocks"])
+    x, rows = jax.lax.scan(body, x, params["blocks"])
     # masked merge into this slot's rows [0, P): only the valid prefix
-    cache = _merge_slot_rows(cache, k_rows, v_rows, slot,
-                             jnp.asarray(0), valid_mask)
+    cache = _merge_slot_rows(cache, rows, slot, jnp.asarray(0), valid_mask)
     # slice the last valid row before the (per-row) final norm
     last = jax.lax.dynamic_slice(x, (0, length - 1, 0),
                                  (1, 1, cfg.hidden_size))
@@ -562,20 +691,19 @@ def prefill_slot(params, cache, tokens, length, slot, cfg: gpt.GPTConfig):
     return logits.astype(jnp.float32), cache
 
 
-def _chunk_attend_block(x, p, ck, cv, pos0, cfg: gpt.GPTConfig,
+def _chunk_attend_block(x, p, csl, pos0, cfg: gpt.GPTConfig,
                         valid=None):
     """One transformer block over a K-token chunk at positions
-    [pos0, pos0+K) against a per-layer cache slice ck/cv [B, T, Hkv, hd]
-    whose rows [0, pos0) are already filled: row i attends cache rows
-    t <= pos0 + i.  THE shared body of verify_chunk and
-    prefill_slot_chunk (one copy of the chunk-attention math).
-    PRECONDITION: pos0 + K <= T — dynamic_update_slice CLAMPS start
-    indices, so an overrunning window would silently write the chunk's
-    rows at a shifted offset while the mask/positions still use pos0
-    (callers guarantee the bound; the serving walk overlaps its last
-    window instead of overrunning).  Returns (x_out, k_new, v_new)."""
+    [pos0, pos0+K) against a per-layer cache slice ``csl`` (leaves k/v
+    [B, T, Hkv, hd] + scales) whose rows [0, pos0) are already filled:
+    row i attends cache rows t <= pos0 + i.  THE shared body of
+    verify_chunk and prefill_slot_chunk (one copy of the chunk-attention
+    math).  PRECONDITION: pos0 + K <= T — dynamic_update_slice CLAMPS
+    start indices, so an overrunning window would silently write the
+    chunk's rows at a shifted offset while the mask/positions still use
+    pos0 (callers guarantee the bound; the serving walk overlaps its
+    last window instead of overrunning).  Returns (x_out, rows)."""
     B, K, D = x.shape
-    H, hd = cfg.num_heads, cfg.head_dim
     dt = cfg.dtype
     h = gpt._norm(x, p, "ln1", cfg)
     q, k_new, v_new = gpt._project_qkv(h, p, cfg, repeat_kv=False)
@@ -583,43 +711,33 @@ def _chunk_attend_block(x, p, ck, cv, pos0, cfg: gpt.GPTConfig,
         chunk_pos = pos0 + jnp.arange(K)
         q = gpt.apply_rope(q, chunk_pos)
         k_new = gpt.apply_rope(k_new, chunk_pos)
-    Hkv = k_new.shape[2]
-    k_all = jax.lax.dynamic_update_slice(ck, k_new.astype(ck.dtype),
-                                         (0, pos0, 0, 0))
-    v_all = jax.lax.dynamic_update_slice(cv, v_new.astype(cv.dtype),
-                                         (0, pos0, 0, 0))
-    T = ck.shape[1]
-    g = H // Hkv
-    qg = q.reshape(B, K, Hkv, g, hd)
-    scores = jnp.einsum("bikgd,btkd->bkgit", qg, k_all) / jnp.sqrt(
-        jnp.asarray(hd, jnp.float32)).astype(dt)
-    # row i may see cache rows t <= pos0 + i
-    mask = (jnp.arange(T)[None, :]
-            <= pos0 + jnp.arange(K)[:, None])[None, None, None]
-    scores = jnp.where(mask, scores.astype(jnp.float32), -1e30)
-    w_ = jax.nn.softmax(scores, axis=-1).astype(dt)
-    attn = jnp.einsum("bkgit,btkd->bikgd", w_, v_all).reshape(B, K, -1)
+    rows = _store_rows(k_new, v_new, cfg)
+    full = {name: jax.lax.dynamic_update_slice(
+                csl[name], val, (0, pos0) + (0,) * (csl[name].ndim - 2))
+            for name, val in rows.items()}
+    attn = _attend_cache(q, full, pos0, cfg)           # [B, K, D]
     a = woq.mm(attn, p, "proj_w", dt) + p["proj_b"].astype(dt)
-    return gpt._ffn_tail(x + a, p, cfg, valid=valid), k_new, v_new
+    return gpt._ffn_tail(x + a, p, cfg, valid=valid), rows
 
 
-def _merge_slot_rows(cache, k_rows, v_rows, slot, pos0, valid):
-    """Masked write of per-layer chunk rows [L, 1, P, Hkv, hd] into one
-    slot's cache rows [pos0, pos0+P): only rows where ``valid`` [1, P]
-    is True are written (pads leave the old tenant's rows untouched —
-    the stale-row invariant).  Shared by prefill_slot (pos0 == 0) and
-    prefill_slot_chunk."""
-    P = k_rows.shape[2]
-    v4 = valid[..., None, None]
-    for name, rows in (("k", k_rows), ("v", v_rows)):
+def _merge_slot_rows(cache, rows, slot, pos0, valid):
+    """Masked write of per-layer chunk row leaves [L, 1, P, Hkv(, hd)]
+    into one slot's cache rows [pos0, pos0+P): only rows where ``valid``
+    [1, P] is True are written (pads leave the old tenant's rows
+    untouched — the stale-row invariant).  Shared by prefill_slot
+    (pos0 == 0) and prefill_slot_chunk; int8 scale planes merge under
+    the same mask."""
+    P = rows["k"].shape[2]
+    out = dict(cache)
+    for name, val in rows.items():
+        arr = cache[name]
+        start = (0, slot, pos0) + (0,) * (arr.ndim - 3)
         old = jax.lax.dynamic_slice(
-            cache[name], (0, slot, pos0, 0, 0),
-            (cache[name].shape[0], 1, P) + cache[name].shape[3:])
-        merged = jnp.where(v4[None], rows[:, 0][:, None], old)
-        cache = dict(cache, **{name: jax.lax.dynamic_update_slice(
-            cache[name], merged.astype(cache[name].dtype),
-            (0, slot, pos0, 0, 0))})
-    return cache
+            arr, start, (arr.shape[0], 1, P) + arr.shape[3:])
+        vmask = valid.reshape((1, 1, P) + (1,) * (arr.ndim - 3))
+        merged = jnp.where(vmask, val.astype(arr.dtype), old)
+        out[name] = jax.lax.dynamic_update_slice(arr, merged, start)
+    return out
 
 
 def prefill_slot_chunk(params, cache, tokens, pos0, length, slot,
@@ -645,23 +763,20 @@ def prefill_slot_chunk(params, cache, tokens, pos0, length, slot,
         x = x + jax.lax.dynamic_slice(
             params["wpe"], (pos0, 0), (P, cfg.hidden_size)).astype(dt)[None]
     valid_mask = (jnp.arange(P) < length)[None, :]       # [1, P]
-    # this slot's cache rows [L, 1, T, Hkv, hd]
-    sl_k = jax.lax.dynamic_slice(
-        cache["k"], (0, slot, 0, 0, 0),
-        (cache["k"].shape[0], 1) + cache["k"].shape[2:])
-    sl_v = jax.lax.dynamic_slice(
-        cache["v"], (0, slot, 0, 0, 0),
-        (cache["v"].shape[0], 1) + cache["v"].shape[2:])
+    # this slot's cache rows [L, 1, T, Hkv(, hd)] per leaf
+    sl = {name: jax.lax.dynamic_slice(
+              arr, (0, slot) + (0,) * (arr.ndim - 2),
+              (arr.shape[0], 1) + arr.shape[2:])
+          for name, arr in cache.items()}
 
     def body(x, layer):
-        p, ck, cv = layer
-        x, k_new, v_new = _chunk_attend_block(x, p, ck, cv, pos0, cfg,
-                                              valid=valid_mask)
-        return x, (k_new, v_new)
+        p, csl = layer
+        x, rows = _chunk_attend_block(x, p, csl, pos0, cfg,
+                                      valid=valid_mask)
+        return x, rows
 
-    x, (k_rows, v_rows) = jax.lax.scan(
-        body, x, (params["blocks"], sl_k, sl_v))
-    cache = _merge_slot_rows(cache, k_rows, v_rows, slot, pos0, valid_mask)
+    x, rows = jax.lax.scan(body, x, (params["blocks"], sl))
+    cache = _merge_slot_rows(cache, rows, slot, pos0, valid_mask)
     # slice the last valid row FIRST: the final norm is per-row, so
     # normalizing all P rows per chunk would be pure waste
     last = jax.lax.dynamic_slice(x, (0, length - 1, 0),
@@ -698,19 +813,15 @@ def verify_chunk(params, cache, tokens, pos0, cfg: gpt.GPTConfig):
             params["wpe"], (pos0, 0), (K, cfg.hidden_size)).astype(dt)[None]
 
     def body(x, layer):
-        p, ck, cv = layer
-        x, k_new, v_new = _chunk_attend_block(x, p, ck, cv, pos0, cfg)
-        return x, (k_new, v_new)
+        p, csl = layer
+        x, rows = _chunk_attend_block(x, p, csl, pos0, cfg)
+        return x, rows
 
-    x, (k_rows, v_rows) = jax.lax.scan(
-        body, x, (params["blocks"], cache["k"], cache["v"]))
-    new_k = jax.lax.dynamic_update_slice(
-        cache["k"], k_rows.astype(cache["k"].dtype), (0, 0, pos0, 0, 0))
-    new_v = jax.lax.dynamic_update_slice(
-        cache["v"], v_rows.astype(cache["v"].dtype), (0, 0, pos0, 0, 0))
+    x, rows = jax.lax.scan(body, x, (params["blocks"], cache))
+    new_cache = _write_rows(cache, rows, pos0)
     x = gpt._norm(x, params, "ln_f", cfg)
     logits = woq.logits(x, params, dt)
-    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+    return logits.astype(jnp.float32), new_cache
 
 
 def _jit_by_cfg(tag: str, fn, cfg):

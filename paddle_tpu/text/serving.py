@@ -36,17 +36,16 @@ def decode_step_batched(params, cache, token, pos, cfg: gpt.GPTConfig):
     """decode_step with PER-SLOT positions: token [B] int32, pos [B] int32.
 
     Implemented as vmap of the scalar-pos ``decode_step`` over the batch
-    axis (params broadcast, cache batch axis 1) — identical math, batched
-    cache scatter."""
-    def one(tok, ck, cv, p):
-        logits, new = generate.decode_step(
-            params, {"k": ck[:, None], "v": cv[:, None]}, tok[None], p, cfg)
-        return logits[0], (new["k"][:, 0], new["v"][:, 0])
+    axis (params broadcast, every cache leaf's batch axis 1 — int8 scale
+    planes included) — identical math, batched cache scatter."""
+    def one(tok, csl, p):
+        sl = {name: v[:, None] for name, v in csl.items()}
+        logits, new = generate.decode_step(params, sl, tok[None], p, cfg)
+        return logits[0], {name: v[:, 0] for name, v in new.items()}
 
-    logits, (nk, nv) = jax.vmap(one, in_axes=(0, 1, 1, 0),
-                                out_axes=(0, (1, 1)))(
-        token, cache["k"], cache["v"], pos)
-    return logits, {"k": nk, "v": nv}
+    logits, new = jax.vmap(one, in_axes=(0, 1, 0), out_axes=(0, 1))(
+        token, cache, pos)
+    return logits, new
 
 
 def _sample_batched(logits, key, temp, topk, topp):
@@ -733,6 +732,14 @@ class DecodeServer:
         first request pays device time only (and re-launches hit the
         persistent compilation cache — framework.platform
         .init_compile_cache, called here).
+
+        This also warms the flash-decode kernel variants: tracing the
+        step executables runs the split-KV Pallas kernel's availability
+        probe (ops/decode_attention) and compiles the kernel for this
+        server's exact (cache length, head, KV-dtype) configuration —
+        under ``PADDLE_TPU_FLASH_DECODE``/``PADDLE_TPU_KV_DTYPE`` the
+        first tick pays device time only, like every other executable
+        here.
 
         ``prompt_lens``: prompt lengths to warm admission for — their
         power-of-two buckets dedupe to one compile each (default: every

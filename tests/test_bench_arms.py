@@ -140,3 +140,78 @@ def test_child_env_flag_disables_isolation(bench, monkeypatch):
     """A child (--arm) must never recurse into more subprocesses."""
     monkeypatch.setenv("BENCH_ARM", "int8")
     assert not bench._arms_isolated(_TpuDev())
+
+
+# ---------------------------------------------------------------------------
+# _probe_backend fail-fast on a known-wedged tunnel
+# ---------------------------------------------------------------------------
+
+
+def _fake_probe_log(bench, monkeypatch, entries):
+    class _FakeProbeTool:
+        @staticmethod
+        def read_log(n=None):
+            return entries if n is None else entries[-n:]
+
+    monkeypatch.setattr(bench, "_tool",
+                        lambda name: _FakeProbeTool
+                        if name == "probe_tpu" else (1 / 0))
+
+
+def _ts(age_s):
+    import datetime
+
+    return (datetime.datetime.now(datetime.timezone.utc)
+            - datetime.timedelta(seconds=age_s)).isoformat(
+                timespec="seconds")
+
+
+def test_recent_wedge_detected(bench, monkeypatch):
+    _fake_probe_log(bench, monkeypatch,
+                    [{"ts": _ts(120), "ok": False,
+                      "detail": "timeout after 240s"}])
+    assert bench._recent_probe_wedge()
+
+
+def test_healthy_or_stale_log_means_full_ladder(bench, monkeypatch):
+    # most recent entry healthy: no fail-fast, even with older failures
+    _fake_probe_log(bench, monkeypatch,
+                    [{"ts": _ts(300), "ok": False, "detail": "timeout"},
+                     {"ts": _ts(60), "ok": True, "detail": {}}])
+    assert not bench._recent_probe_wedge()
+    # failure, but outside the window: evidence is stale
+    _fake_probe_log(bench, monkeypatch,
+                    [{"ts": _ts(7200), "ok": False, "detail": "timeout"}])
+    assert not bench._recent_probe_wedge()
+    # empty/absent log
+    _fake_probe_log(bench, monkeypatch, [])
+    assert not bench._recent_probe_wedge()
+
+
+def test_probe_backend_fail_fast_single_short_attempt(bench, monkeypatch):
+    """With a fresh failed probe already on record, _probe_backend makes
+    ONE short attempt instead of the 2x240 s retry ladder."""
+    import sys as _sys
+    import types
+
+    calls = []
+
+    def fake_probe(timeout, source=""):
+        calls.append(timeout)
+        return {"ok": False, "detail": "still wedged", "elapsed_s": 1}
+
+    fake_mod = types.ModuleType("probe_tpu")
+    fake_mod.probe = fake_probe
+    monkeypatch.setitem(_sys.modules, "probe_tpu", fake_mod)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    _fake_probe_log(bench, monkeypatch,
+                    [{"ts": _ts(60), "ok": False,
+                      "detail": "timeout after 240s"}])
+    assert bench._probe_backend() is None
+    assert calls == [90]  # one attempt, short (but cold-init-sized) timeout
+
+    # and without wedge evidence: the full ladder (2 x 240)
+    calls.clear()
+    _fake_probe_log(bench, monkeypatch, [])
+    assert bench._probe_backend() is None
+    assert calls == [240, 240]

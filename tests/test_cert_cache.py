@@ -25,7 +25,8 @@ class TestFamilyCache:
     def test_family_sigs_cover_every_check_prefix(self):
         m = _load_module()
         sigs = m._family_sigs("TPU v5 lite")
-        assert set(sigs) == {"flash", "fused_ln", "fused_ce", "w4"}
+        assert set(sigs) == {"flash", "fused_ln", "fused_ce", "w4",
+                             "decode"}
         # device kind folds into every family signature
         assert all(s.endswith(":TPU v5 lite") for s in sigs.values())
         assert sigs != m._family_sigs("TPU v4")

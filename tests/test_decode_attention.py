@@ -1,0 +1,486 @@
+"""Flash-decode attention + quantized KV cache (ops/decode_attention.py).
+
+The kernel runs in Pallas INTERPRET mode here (JAX_PLATFORMS=cpu — the
+conftest pins it), so these tests exercise the real kernel body, not the
+XLA fallback: GQA parity against the einsum path across num_kv_heads
+{1, H/4, None}, long caches (>= 2k), every cache storage dtype, and
+donation.  The on-device certification twin is
+tools/check_flash_tpu.py's decode family.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops import decode_attention as da
+from paddle_tpu.text import generate as G, gpt, serving
+
+
+@pytest.fixture()
+def interpret():
+    """Run the decode kernel (and the prefill flash kernel) in interpret
+    mode for the duration of a test."""
+    from paddle_tpu.ops import flash_attention as fa
+
+    old_da, old_fa = da._INTERPRET, fa._INTERPRET
+    da._INTERPRET, fa._INTERPRET = True, True
+    # trace-time routing flags are baked into cached executables
+    G._GEN_CACHE.clear()
+    serving._STEP_CACHE.clear()
+    yield
+    da._INTERPRET, fa._INTERPRET = old_da, old_fa
+    G._GEN_CACHE.clear()
+    serving._STEP_CACHE.clear()
+
+
+@pytest.fixture()
+def kv_env(monkeypatch):
+    """Setter for the decode-routing env flags that also busts the
+    value-keyed jit caches (the flags are part of _cfg_key, but modules
+    cache traced fns across tests)."""
+    def set_(**kw):
+        for k, v in kw.items():
+            if v is None:
+                monkeypatch.delenv(k, raising=False)
+            else:
+                monkeypatch.setenv(k, v)
+        G._GEN_CACHE.clear()
+        serving._STEP_CACHE.clear()
+    yield set_
+    G._GEN_CACHE.clear()
+    serving._STEP_CACHE.clear()
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=64, hidden_size=256, num_layers=2, num_heads=4,
+                max_seq_len=2304)
+    base.update(kw)
+    return gpt.GPTConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# op-level parity: kernel vs XLA oracle (GQA sweep, long T, all dtypes)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("Hkv,G_", [(1, 8), (2, 4), (8, 1)])
+@pytest.mark.parametrize("kv", ["fp32", "bf16", "int8"])
+def test_kernel_matches_oracle_long_cache(interpret, Hkv, G_, kv):
+    Hq, hd, B, T = Hkv * G_, 64, 2, 2048
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, 1, Hq, hd), jnp.bfloat16)
+    kc = jax.random.normal(ks[1], (B, T, Hkv, hd), jnp.float32)
+    vc = jax.random.normal(ks[2], (B, T, Hkv, hd), jnp.float32)
+    ksc = vsc = None
+    if kv == "int8":
+        kc, ksc = da.quantize_kv(kc)
+        vc, vsc = da.quantize_kv(vc)
+    elif kv == "bf16":
+        kc, vc = kc.astype(jnp.bfloat16), vc.astype(jnp.bfloat16)
+    pos = jnp.asarray([1500, 2047], jnp.int32)
+    assert da.supported(q.shape, kc.shape)
+    out = da._decode_call(q, kc, vc, pos, ksc, vsc, None)
+    ref = da._xla_decode(q, kc, vc, pos, ksc, vsc, None)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=2e-2, rtol=2e-2)
+
+
+def test_kernel_small_tq_chunk(interpret):
+    """Tq > 1 (the verify-chunk shape): per-row causal frontier."""
+    B, Tq, Hq, Hkv, hd, T = 1, 8, 8, 2, 64, 256
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(ks[0], (B, Tq, Hq, hd), jnp.float32)
+    kc = jax.random.normal(ks[1], (B, T, Hkv, hd), jnp.float32)
+    vc = jax.random.normal(ks[2], (B, T, Hkv, hd), jnp.float32)
+    pos = jnp.asarray([100], jnp.int32)
+    out = da._decode_call(q, kc, vc, pos, None, None, None)
+    ref = da._xla_decode(q, kc, vc, pos, None, None, None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_quantize_roundtrip_and_scale_shape():
+    x = jax.random.normal(jax.random.PRNGKey(2), (3, 128, 2, 64)) * 4.0
+    q, s = da.quantize_kv(x)
+    assert q.dtype == jnp.int8 and s.shape == (3, 128, 2)
+    back = da.dequantize_kv(q, s, jnp.float32)
+    # per-head absmax int8: worst-case error is scale/2 = absmax/254
+    err = np.abs(np.asarray(back - x))
+    bound = np.asarray(s)[..., None] * 0.5 + 1e-6
+    assert (err <= bound).all()
+
+
+def test_unsupported_shapes_fall_back():
+    # hd not in the MXU set -> XLA path (still correct)
+    q = jnp.zeros((1, 1, 4, 16))
+    k = v = jnp.zeros((1, 24, 4, 16))
+    assert not da.supported(q.shape, k.shape)
+    out = da.decode_attention(q, k, v, jnp.zeros((1,), jnp.int32))
+    assert out.shape == (1, 1, 4, 16)
+
+
+# ---------------------------------------------------------------------------
+# decode-path parity: kernel routing vs the einsum path, full model
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kvh", [None, 1, 2])
+def test_decode_step_logits_match_einsum_path(interpret, kv_env, kvh):
+    cfg = _cfg(num_kv_heads=kvh)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    cache = G.init_cache(cfg, 2, 2048)
+    kk = jax.random.split(jax.random.PRNGKey(3), 2)
+    cache = {"k": (jax.random.normal(kk[0], cache["k"].shape) * 0.3
+                   ).astype(cache["k"].dtype),
+             "v": (jax.random.normal(kk[1], cache["v"].shape) * 0.3
+                   ).astype(cache["v"].dtype)}
+    tok = jnp.asarray([3, 7], jnp.int32)
+    kv_env(PADDLE_TPU_FLASH_DECODE="1")
+    lk, ck = G.decode_step(params, dict(cache), tok, 1900, cfg)
+    kv_env(PADDLE_TPU_FLASH_DECODE="0")
+    lx, cx = G.decode_step(params, dict(cache), tok, 1900, cfg)
+    np.testing.assert_allclose(np.asarray(lk), np.asarray(lx),
+                               atol=3e-2, rtol=3e-2)
+    assert (np.asarray(jnp.argmax(lk, -1))
+            == np.asarray(jnp.argmax(lx, -1))).all()
+    # layer 0's written rows are identical (same projection, same
+    # storage); later layers' inputs flow through the differing
+    # attention path, so only closeness holds there
+    np.testing.assert_allclose(
+        np.asarray(ck["k"], np.float32)[0, :, 1900],
+        np.asarray(cx["k"], np.float32)[0, :, 1900], atol=1e-6)
+
+
+def test_greedy_tokens_bit_identical_markov(interpret, kv_env, markov_gpt):
+    """Acceptance: greedy decode tokens are bit-identical between the
+    kernel and XLA paths for float caches — on the TRAINED markov model
+    whose every next token depends on the fed one."""
+    cfg, params = markov_gpt
+    prompt = [[3, 10, 5]]
+    want = np.asarray(G.generate(params, cfg, prompt, max_new_tokens=13))
+    kv_env(PADDLE_TPU_FLASH_DECODE="1")
+    # markov cfg has hd=16 (< MXU tile): route a supported-hd twin config
+    # through the kernel instead of silently testing the fallback
+    assert not da.supported((1, 1, cfg.num_heads, cfg.head_dim),
+                            (1, 16, cfg.num_heads, cfg.head_dim))
+    got = np.asarray(G.generate(params, cfg, prompt, max_new_tokens=13))
+    assert (want == got).all()
+
+
+def test_greedy_tokens_bit_identical_kernel_engaged(interpret, kv_env):
+    """The same acceptance on a config the kernel actually covers
+    (hd=64, cache length 8-aligned), with engagement asserted."""
+    cfg = _cfg(num_kv_heads=2, max_seq_len=64)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(1))
+    prompt = [[5, 9, 3]]
+
+    calls = {"n": 0}
+    orig = da._decode_call
+
+    def counted(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    kv_env(PADDLE_TPU_FLASH_DECODE="0")
+    want = np.asarray(G.generate(params, cfg, prompt, max_new_tokens=13))
+    kv_env(PADDLE_TPU_FLASH_DECODE="1")
+    da._decode_call = counted
+    try:
+        got = np.asarray(G.generate(params, cfg, prompt,
+                                    max_new_tokens=13))
+    finally:
+        da._decode_call = orig
+    assert calls["n"] >= 1, "kernel path never engaged"
+    assert (want == got).all()
+
+
+# ---------------------------------------------------------------------------
+# quantized KV cache: structure, donation, serving end-to-end
+# ---------------------------------------------------------------------------
+
+
+def test_init_cache_rounds_to_tileable_length(kv_env):
+    """Cache allocation rounds up to a kernel-tileable row count (extra
+    rows stay causally masked) so arbitrary prompt+max_new totals don't
+    silently pin decode on the einsum fallback."""
+    cfg = _cfg()
+    assert G.init_cache(cfg, 1, 10)["k"].shape[2] == 16
+    assert G.init_cache(cfg, 1, 16)["k"].shape[2] == 16
+    assert G.init_cache(cfg, 1, 513)["k"].shape[2] == 640
+    assert G.init_cache(cfg, 1, 1024)["k"].shape[2] == 1024
+    # and the rounded lengths actually pass the kernel's shape gate
+    for n in (10, 513, 1000):
+        T = G.init_cache(cfg, 1, n)["k"].shape[2]
+        assert da.supported((1, 1, 4, 64), (1, T, 4, 64)), (n, T)
+
+
+def test_kernel_engages_on_unaligned_generate_total(interpret, kv_env):
+    """generate() with an arbitrary total (prompt 3 + 20 new = 23) still
+    runs the kernel — the rounding closes the review's fallback hole."""
+    cfg = _cfg(num_kv_heads=2, max_seq_len=64)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(1))
+    calls = {"n": 0}
+    orig = da._decode_call
+
+    def counted(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    kv_env(PADDLE_TPU_FLASH_DECODE="1")
+    da._decode_call = counted
+    try:
+        G.generate(params, cfg, [[5, 9, 3]], max_new_tokens=20)
+    finally:
+        da._decode_call = orig
+    assert calls["n"] >= 1
+
+
+def test_random_filled_cache_matches_format(kv_env):
+    key = jax.random.PRNGKey(0)
+    cfg = _cfg(num_kv_heads=2)
+    filled = da.random_filled_cache(G.init_cache(cfg, 1, 16), key)
+    assert filled["k"].dtype == cfg.dtype
+    kv_env(PADDLE_TPU_KV_DTYPE="int8")
+    filled = da.random_filled_cache(G.init_cache(cfg, 1, 16), key)
+    assert filled["k"].dtype == jnp.int8
+    assert filled["k_s"].shape == filled["k"].shape[:-1]
+    assert float(jnp.max(jnp.abs(filled["k_s"]))) > 0
+
+
+def test_serving_tick_kernel_engaged_matches_einsum(interpret, kv_env):
+    """The vmapped serving tick (pallas_call under jax.vmap, SMEM pos
+    operand) runs the kernel and serves the same greedy tokens as the
+    einsum path — the production path the kernel exists for."""
+    cfg = _cfg(num_kv_heads=2, max_seq_len=64)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    reqs = [([5, 9, 3], 5), ([7, 1], 6)]
+
+    def serve():
+        srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=24)
+        rids = [srv.submit(p, max_new_tokens=n) for p, n in reqs]
+        while srv.pending():
+            srv.tick()
+        return [srv.result(r) for r in rids]
+
+    kv_env(PADDLE_TPU_FLASH_DECODE="0")
+    want = serve()
+    kv_env(PADDLE_TPU_FLASH_DECODE="1")
+    calls = {"n": 0}
+    orig = da._decode_call
+
+    def counted(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    da._decode_call = counted
+    try:
+        got = serve()
+    finally:
+        da._decode_call = orig
+    assert calls["n"] >= 1, "kernel never engaged under vmap"
+    assert got == want
+
+
+def test_sharded_decode_kernel_engaged_parity(interpret, kv_env):
+    """The pjit-sharded decode step (cache head-sharded over mp) runs
+    the kernel and matches the unsharded einsum decode."""
+    from jax.sharding import Mesh
+
+    cfg = _cfg(num_kv_heads=2, max_seq_len=64)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    toks = [5, 9, 3]
+    kv_env(PADDLE_TPU_FLASH_DECODE="0")
+    cache_r = G.init_cache(cfg, 1, 16)
+    want = None
+    for pos, t in enumerate(toks):
+        want, cache_r = G.decode_step(params, cache_r,
+                                      jnp.asarray([t], jnp.int32), pos,
+                                      cfg)
+    kv_env(PADDLE_TPU_FLASH_DECODE="1")
+    calls = {"n": 0}
+    orig = da._decode_call
+
+    def counted(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("mp",))
+    sp, make_cache, decode = G.build_sharded_decode(params, cfg, mesh)
+    cache = make_cache(1, 16)
+    da._decode_call = counted
+    try:
+        got = None
+        for pos, t in enumerate(toks):
+            got, cache = decode(sp, cache, jnp.asarray([t], jnp.int32),
+                                jnp.asarray(pos))
+    finally:
+        da._decode_call = orig
+    assert calls["n"] >= 1, "kernel never engaged under pjit"
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=3e-2, rtol=3e-2)
+    assert (np.asarray(jnp.argmax(got, -1))
+            == np.asarray(jnp.argmax(want, -1))).all()
+
+
+def test_int8_cache_structure_and_flag_validation(kv_env):
+    cfg = _cfg(num_kv_heads=2)
+    kv_env(PADDLE_TPU_KV_DTYPE="int8")
+    cache = G.init_cache(cfg, 3, 16)
+    assert set(cache) == {"k", "v", "k_s", "v_s"}
+    assert cache["k"].dtype == jnp.int8
+    assert cache["k_s"].shape == (2, 3, 16, 2)
+    assert cache["k_s"].dtype == jnp.float32
+    kv_env(PADDLE_TPU_KV_DTYPE="fp32")
+    assert G.init_cache(cfg, 1, 8)["k"].dtype == jnp.float32
+    kv_env(PADDLE_TPU_KV_DTYPE="bogus")
+    from paddle_tpu import flags
+    with pytest.raises(ValueError, match="PADDLE_TPU_KV_DTYPE"):
+        flags.kv_cache_dtype()
+
+
+def test_int8_cache_decode_close_to_float(kv_env):
+    """int8-cache greedy decode follows the float path closely on a
+    random model (logit-level tolerance; the trained-model token check
+    lives in test_int8_markov_rule)."""
+    cfg = _cfg(num_kv_heads=2, max_seq_len=64)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    toks = jnp.asarray([[5, 9, 3, 7]], jnp.int32)
+    kv_env()
+    cache = G.init_cache(cfg, 1, 8)
+    want = []
+    for t in range(4):
+        l, cache = G.decode_step(params, cache, toks[:, t], t, cfg)
+        want.append(np.asarray(l))
+    kv_env(PADDLE_TPU_KV_DTYPE="int8")
+    cache = G.init_cache(cfg, 1, 8)
+    for t in range(4):
+        l, cache = G.decode_step(params, cache, toks[:, t], t, cfg)
+        np.testing.assert_allclose(np.asarray(l), want[t], atol=0.15,
+                                   rtol=0.15)
+
+
+def test_int8_markov_rule(kv_env, markov_gpt):
+    """The trained markov chain survives cache quantization: every
+    generated token still obeys next = (tok * 3 + 1) % 13."""
+    cfg, params = markov_gpt
+    kv_env(PADDLE_TPU_KV_DTYPE="int8")
+    out = np.asarray(G.generate(params, cfg, [[3, 10, 5]],
+                                max_new_tokens=10))[0]
+    seq = out[2:].tolist()  # from the last prompt token on
+    for a, b in zip(seq, seq[1:]):
+        assert b == (a * 3 + 1) % 13, seq
+
+
+def test_int8_cache_donation_and_serving_drain(kv_env):
+    """Donation aliases every cache leaf (scale planes included), and a
+    DecodeServer drains correctly on an int8 cache."""
+    cfg = _cfg(num_kv_heads=2, max_seq_len=64)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    kv_env(PADDLE_TPU_KV_DTYPE="int8")
+    cache = G.init_cache(cfg, 2, 16)
+    ptrs = {n: cache[n].unsafe_buffer_pointer() for n in cache}
+    fn = serving._get_step_fn(cfg)
+    _, out = fn(params, cache, jnp.zeros((2,), jnp.int32),
+                jnp.zeros((2,), jnp.int32))
+    assert all(cache[n].is_deleted() for n in cache)
+    assert {n: out[n].unsafe_buffer_pointer() for n in out} == ptrs
+
+    srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=24)
+    rids = [srv.submit([5, 9, 3], max_new_tokens=5),
+            srv.submit([7, 1], max_new_tokens=5)]
+    while srv.pending():
+        srv.tick()
+    assert all(len(srv.result(r)) == 5 for r in rids)
+
+
+def test_int8_prefill_matches_stepwise_admission(kv_env):
+    """Prefill admission and token-by-token feeding write the SAME
+    quantized rows — the prefill-parity invariant holds under int8."""
+    cfg = _cfg(num_kv_heads=2, max_seq_len=64)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    kv_env(PADDLE_TPU_KV_DTYPE="int8")
+    prompt = [5, 9, 3, 7, 2]
+    res = {}
+    for prefill in (True, False):
+        srv = serving.DecodeServer(params, cfg, max_batch=1, max_len=32,
+                                   prefill=prefill)
+        rid = srv.submit(prompt, max_new_tokens=6)
+        while srv.pending():
+            srv.tick()
+        res[prefill] = srv.result(rid)
+    assert res[True] == res[False]
+
+
+def test_sharded_decode_int8_cache_specs(kv_env):
+    """build_sharded_decode shards the scale planes with the values."""
+    from jax.sharding import Mesh
+
+    cfg = _cfg(num_kv_heads=2, max_seq_len=64)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    kv_env(PADDLE_TPU_KV_DTYPE="int8")
+    mesh = Mesh(np.array(jax.devices()[:2]), ("mp",))
+    sp, make_cache, decode = G.build_sharded_decode(params, cfg, mesh)
+    cache = make_cache(1, 8)
+    assert set(cache) == {"k", "v", "k_s", "v_s"}
+    k_shard = cache["k"].sharding.shard_shape(cache["k"].shape)
+    s_shard = cache["k_s"].sharding.shard_shape(cache["k_s"].shape)
+    assert k_shard[3] == 1 and s_shard[3] == 1  # Hkv=2 split over mp=2
+    logits, cache = decode(sp, cache, jnp.zeros((1,), jnp.int32),
+                           jnp.asarray(0))
+    assert logits.shape == (1, cfg.vocab_size)
+
+
+def test_sharded_decode_kv_flag_flip_fails_loudly(kv_env):
+    """make_cache re-reads PADDLE_TPU_KV_DTYPE; a flip since build must
+    raise, not hand the baked decode_fn a mismatched pytree."""
+    from jax.sharding import Mesh
+
+    cfg = _cfg(num_kv_heads=2, max_seq_len=64)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    mesh = Mesh(np.array(jax.devices()[:2]), ("mp",))
+    kv_env(PADDLE_TPU_KV_DTYPE=None)
+    _, make_cache, _ = G.build_sharded_decode(params, cfg, mesh)
+    kv_env(PADDLE_TPU_KV_DTYPE="int8")
+    with pytest.raises(ValueError, match="PADDLE_TPU_KV_DTYPE changed"):
+        make_cache(1, 8)
+
+
+def test_kv_dtype_part_of_jit_key(kv_env):
+    cfg = _cfg()
+    kv_env(PADDLE_TPU_KV_DTYPE=None)
+    k1 = G._cfg_key(cfg)
+    kv_env(PADDLE_TPU_KV_DTYPE="int8")
+    k2 = G._cfg_key(cfg)
+    kv_env(PADDLE_TPU_FLASH_DECODE="0", PADDLE_TPU_KV_DTYPE=None)
+    k3 = G._cfg_key(cfg)
+    assert len({k1, k2, k3}) == 3
+
+
+# ---------------------------------------------------------------------------
+# prefill flash kernel under the new _INTERPRET hook (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_prefill_flash_kernel_interpret_parity(interpret):
+    from paddle_tpu.ops import flash_attention as fa
+    from paddle_tpu.ops.attention import xla_attention
+
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (1, 128, 2, 64), jnp.float32)
+               for kk in ks)
+    out = fa.flash_attention(q, k, v, causal=True)
+    ref = xla_attention(q, k, v, is_causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+    # backward too: the custom_vjp kernels run interpreted
+    g = jax.vjp(lambda a, b, c: fa.flash_attention(a, b, c, causal=True),
+                q, k, v)[1](ref)
+    gr = jax.vjp(lambda a, b, c: xla_attention(a, b, c, is_causal=True),
+                 q, k, v)[1](ref)
+    for x, y in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   atol=1e-4, rtol=1e-4)

@@ -57,3 +57,33 @@ def test_bad_batch_shapes_are_loud(markov_gpt):
     cfg, params = markov_gpt
     with pytest.raises(ValueError, match="T >= 1"):
         evaluate.nll(params, cfg, np.zeros((4, 1), np.int32))
+
+
+def test_cached_nll_matches_forward_nll(markov_gpt):
+    """The decode-path scorer agrees with the teacher-forced forward when
+    the cache is exact (default dtype) — the baseline the int8 caveat
+    number is measured against."""
+    cfg, params = markov_gpt
+    rng = np.random.default_rng(5)
+    batch = _rule_batch(rng, 4, 16)
+    a = evaluate.nll(params, cfg, batch)
+    b = evaluate.cached_nll(params, cfg, batch)
+    assert abs(a - b) < 5e-2, (a, b)
+
+
+def test_cached_ppl_int8_cache_delta_is_small(markov_gpt, monkeypatch):
+    """The README's int8-KV accuracy caveat, as a regression gate: the
+    decode-path perplexity delta from cache quantization stays small."""
+    from paddle_tpu.text import evaluate as ev
+
+    cfg, params = markov_gpt
+    rng = np.random.default_rng(6)
+    batch = _rule_batch(rng, 4, 16)
+    ppl_f = ev.cached_perplexity(params, cfg, batch)
+    monkeypatch.setenv("PADDLE_TPU_KV_DTYPE", "int8")
+    ev._EVAL_CACHE.clear()  # the flag is part of the traced program
+    try:
+        ppl_q = ev.cached_perplexity(params, cfg, batch)
+    finally:
+        ev._EVAL_CACHE.clear()
+    assert abs(ppl_q - ppl_f) / ppl_f < 0.05, (ppl_f, ppl_q)

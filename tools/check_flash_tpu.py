@@ -167,6 +167,76 @@ def check_w4_matmul(N, K, M, gs, dtype):
           f"{jnp.dtype(dtype).name}", flush=True)
 
 
+def check_decode(B, T, Tq, Hq, Hkv, hd, kv, dtype):
+    """Split-KV decode kernel vs its XLA oracle on the real chip — the
+    flash-decode path must be parity-certified before bench/serving may
+    route tokens through it (the W4 rule).  ``kv``: cache dtype name."""
+    from paddle_tpu.ops import decode_attention as da
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(ks[0], (B, Tq, Hq, hd), dtype)
+    k = jax.random.normal(ks[1], (B, T, Hkv, hd), dtype)
+    v = jax.random.normal(ks[2], (B, T, Hkv, hd), dtype)
+    ksc = vsc = None
+    if kv == "int8":
+        k, ksc = da.quantize_kv(k)
+        v, vsc = da.quantize_kv(v)
+    elif kv == "fp32":
+        k, v = k.astype(jnp.float32), v.astype(jnp.float32)
+    pos = jnp.asarray(np.linspace(T // 2, T - Tq, B), jnp.int32)
+    out = da._decode_call(q, k, v, pos, ksc, vsc, None)
+    ref = da._xla_decode(q, k, v, pos, ksc, vsc, None)
+    tol = 2e-2 if dtype == jnp.bfloat16 else 4e-3
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=tol, rtol=tol,
+                               err_msg=f"decode B{B} T{T} Tq{Tq} Hq{Hq} "
+                                       f"Hkv{Hkv} D{hd} kv={kv}")
+    print(f"  decode OK B{B} T{T} Tq{Tq} Hq{Hq} Hkv{Hkv} D{hd} kv={kv} "
+          f"{jnp.dtype(dtype).name}", flush=True)
+
+
+def check_decode_step_tokens(kv):
+    """Greedy decode_step parity kernel-on vs kernel-off through the REAL
+    generate path (the einsum fallback in text/generate.py is part of
+    this family's signature): argmax tokens must be identical for
+    float caches, and logits close for every cache dtype."""
+    from paddle_tpu.text import generate as G, gpt
+    cfg = gpt.GPTConfig(vocab_size=512, hidden_size=512, num_layers=2,
+                        num_heads=8, num_kv_heads=2, max_seq_len=1024)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(5))
+    old = os.environ.get("PADDLE_TPU_KV_DTYPE", "")
+    old_fd = os.environ.get("PADDLE_TPU_FLASH_DECODE")
+    os.environ["PADDLE_TPU_KV_DTYPE"] = kv if kv != "compute" else ""
+    try:
+        from paddle_tpu.ops import decode_attention as da
+        cache = da.random_filled_cache(G.init_cache(cfg, 2, 1024),
+                                       jax.random.PRNGKey(6))
+        tok = jnp.asarray([7, 11], jnp.int32)
+        os.environ["PADDLE_TPU_FLASH_DECODE"] = "1"
+        lk, _ = G.decode_step(params, dict(cache), tok, 900, cfg)
+        os.environ["PADDLE_TPU_FLASH_DECODE"] = "0"
+        lx, _ = G.decode_step(params, dict(cache), tok, 900, cfg)
+        np.testing.assert_allclose(np.asarray(lk), np.asarray(lx),
+                                   atol=5e-2, rtol=5e-2,
+                                   err_msg=f"decode_step kv={kv}")
+        if kv != "int8":
+            assert (np.asarray(jnp.argmax(lk, -1))
+                    == np.asarray(jnp.argmax(lx, -1))).all(), \
+                f"greedy tokens diverged (kv={kv})"
+    finally:
+        # restore BOTH flags to the operator's pre-check values (an
+        # exported FLASH_DECODE=0 opt-out must survive certification)
+        if old_fd is None:
+            os.environ.pop("PADDLE_TPU_FLASH_DECODE", None)
+        else:
+            os.environ["PADDLE_TPU_FLASH_DECODE"] = old_fd
+        if old:
+            os.environ["PADDLE_TPU_KV_DTYPE"] = old
+        else:
+            os.environ.pop("PADDLE_TPU_KV_DTYPE", None)
+    print(f"  decode_step OK kv={kv}", flush=True)
+
+
 if __name__ == "__main__":
     # a marker from a PREVIOUS run must not certify this one: remove it
     # up front so a crash below leaves no stale certification behind
@@ -248,3 +318,31 @@ if __name__ == "__main__":
     print("w4 dequant-matmul all OK", flush=True)
     _write_marker(dict({fam: _SIG[fam] for fam in TRAINING_FAMILIES},
                        w4=_SIG["w4"]))
+
+    # flash-decode kernel (split-KV + quantized cache): serving-relevant
+    # shapes — GQA bf16 at long context first (the decode_long headline
+    # config), then MHA/MQA, the verify-chunk Tq, and every cache dtype.
+    # Marker written incrementally (the _write_marker device-kind rule):
+    # a decode failure never voids the families already certified above.
+    _cached("decode:B8T2048Tq1H16Hkv4D64:bf16:bf16",
+            lambda: check_decode(8, 2048, 1, 16, 4, 64, "bf16",
+                                 jnp.bfloat16))
+    _cached("decode:B8T2048Tq1H16Hkv4D64:int8:bf16",
+            lambda: check_decode(8, 2048, 1, 16, 4, 64, "int8",
+                                 jnp.bfloat16))
+    _cached("decode:B2T1024Tq1H8Hkv8D128:fp32:f32",
+            lambda: check_decode(2, 1024, 1, 8, 8, 128, "fp32",
+                                 jnp.float32))
+    _cached("decode:B2T1024Tq1H8Hkv1D128:bf16:bf16",
+            lambda: check_decode(2, 1024, 1, 8, 1, 128, "bf16",
+                                 jnp.bfloat16))
+    _cached("decode:B1T2048Tq8H16Hkv4D64:int8:bf16",
+            lambda: check_decode(1, 2048, 8, 16, 4, 64, "int8",
+                                 jnp.bfloat16))
+    _cached("decode:step:compute",
+            lambda: check_decode_step_tokens("compute"))
+    _cached("decode:step:int8",
+            lambda: check_decode_step_tokens("int8"))
+    print("flash-decode attention all OK", flush=True)
+    _write_marker(dict({fam: _SIG[fam] for fam in TRAINING_FAMILIES},
+                       w4=_SIG["w4"], decode=_SIG["decode"]))
