@@ -886,6 +886,8 @@ def bench_train(small: bool):
     X, Y = _fit_data(n, T, vocab)
 
     def arm(grad_accum, async_, prefetch):
+        from paddle_tpu import telemetry as _tl
+
         paddle.seed(0)
         net = _fit_lm(vocab, hidden, layers, T)
         m = Model(net)
@@ -900,13 +902,16 @@ def bench_train(small: bool):
         fit()  # compile + warmup epoch
         step = m._train_step
         _sync_all((step._params, step._opt_state))
+        _tl.reset()  # telemetry window = the warm timed epoch only
         t0 = time.perf_counter()
         fit()
         _sync_all((step._params, step._opt_state))
         dt = time.perf_counter() - t0
         opt_steps = n // bs
         return {"tok_s": n * T / dt, "steps_s": opt_steps / dt,
-                "epoch_s": round(dt, 4)}
+                "epoch_s": round(dt, 4),
+                "telemetry": (_tl.latency_summary("train.")
+                              if _tl.enabled() else {"enabled": False})}
 
     base = arm(1, async_=False, prefetch=False)
     over = arm(accum, async_=True, prefetch=True)
@@ -924,6 +929,7 @@ def bench_train(small: bool):
             "baseline_steps_s": round(base["steps_s"], 2),
             "accum_speedup": round(over["tok_s"] / base["tok_s"], 3),
             "grad_accum": accum, "async": True, "prefetch": True,
+            "telemetry": over.get("telemetry", {}),
             "vs_baseline": 0.0}
 
 
@@ -981,13 +987,15 @@ def _decode_smoke():
     import numpy as np
     import jax
 
-    from paddle_tpu import flags
+    from paddle_tpu import flags, telemetry as _tl
     from paddle_tpu.text import gpt, serving
 
     cfg = gpt.GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
                         num_heads=4, max_seq_len=64)
     params = gpt.init_params(cfg, jax.random.PRNGKey(0))
     prompts = np.random.default_rng(0).integers(1, 100, (3, 5))
+
+    _tl.reset()
 
     def pass_(async_):
         srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=32,
@@ -1003,8 +1011,26 @@ def _decode_smoke():
     if sync_toks != async_toks:
         raise AssertionError(
             f"async/sync decode divergence: {async_toks} vs {sync_toks}")
-    return {"ok": True, "tokens": sum(len(t) for t in async_toks),
-            "donate": flags.donate_decode(), "warmed": sorted(wt)}
+    rec = {"ok": True, "tokens": sum(len(t) for t in async_toks),
+           "donate": flags.donate_decode(), "warmed": sorted(wt)}
+    if _tl.enabled():
+        # tier-1-safe telemetry smoke: the serving pass above must leave
+        # TTFT/per-token/e2e records and a drained queue — a silent
+        # telemetry regression fails CI here, not on a TPU window
+        snap = _tl.snapshot()
+        h = snap["histograms"]
+        for name in ("serving.ttft_ms", "serving.tpot_ms",
+                     "serving.e2e_ms"):
+            if h.get(name, {}).get("count", 0) <= 0:
+                raise AssertionError(
+                    f"telemetry smoke: no {name} records after a serving "
+                    f"pass (histograms: {sorted(h)})")
+        if snap["gauges"].get("serving.queue_depth") != 0:
+            raise AssertionError(
+                f"telemetry smoke: queue_depth gauge did not return to 0 "
+                f"({snap['gauges']})")
+        rec["telemetry"] = _tl.latency_summary("serving.")
+    return rec
 
 
 def bench_gpt(small: bool):
@@ -1823,6 +1849,8 @@ def bench_serving(small: bool):
         return srv
 
     def tok_s(p):
+        from paddle_tpu import telemetry as _tl
+
         # explicit warmup: pre-compile the prefill bucket + block step
         # (and the persistent compile cache makes relaunches disk reads),
         # so the timed passes and the first-token diagnostic are pure
@@ -1838,6 +1866,9 @@ def bench_serving(small: bool):
         first_ms = (time.perf_counter() - t0) * 1e3
         srv = serve_pass(p)          # steady-state warm pass
         _sync_all(srv.cache)
+        # telemetry window = the timed passes only: BENCH_*.json carries
+        # the warm-path TTFT/TPOT DISTRIBUTION, not just the means
+        _tl.reset()
         t0 = time.perf_counter()
         for _ in range(iters):
             srv = serve_pass(p)
@@ -1845,9 +1876,12 @@ def bench_serving(small: bool):
         dt = (time.perf_counter() - t0) / iters
         # prefill tokens are device work too, but the serving headline is
         # the GENERATED rate (prompts admit in one prefill step each)
-        return {"tok_s": B * new_toks / dt,
-                "first_token_ms": round(first_ms, 2),
-                "warmup_s": round(warmup_s, 2)}
+        rec = {"tok_s": B * new_toks / dt,
+               "first_token_ms": round(first_ms, 2),
+               "warmup_s": round(warmup_s, 2)}
+        rec["telemetry"] = (_tl.latency_summary("serving.")
+                            if _tl.enabled() else {"enabled": False})
+        return rec
 
     makers = {"bf16": lambda: params,
               "int8": lambda: woq.quantize_gpt_int8(params),

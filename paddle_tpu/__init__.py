@@ -105,6 +105,7 @@ if not _LIGHT_IMPORT:
     from . import hapi  # noqa: F401
     from .hapi import Model, summary  # noqa: F401
     from . import profiler  # noqa: F401
+    from . import telemetry  # noqa: F401
     from .flags import get_flags, set_flags  # noqa: F401
     from .framework import checkpoint, debugger  # noqa: F401
     from .framework.io import load, save  # noqa: F401

@@ -174,6 +174,27 @@ def kv_cache_dtype() -> str:
         f"for the model compute dtype)")
 
 
+def telemetry_enabled() -> bool:
+    """Runtime telemetry master switch (ON by default).
+
+    When on, :mod:`paddle_tpu.telemetry` records serving request spans +
+    latency histograms, training step timings, and the jit recompile
+    watch.  ``PADDLE_TPU_TELEMETRY=0`` is the escape hatch: every record
+    call early-outs and the jit-compile instrumentation wrapper is never
+    installed (the hot paths run the raw executables).  Unlike the
+    trace-time routing flags this is NOT part of any jit-cache key —
+    telemetry never changes a compiled program, only host bookkeeping."""
+    v = os.environ.get("PADDLE_TPU_TELEMETRY", "1").strip().lower()
+    return v not in ("0", "false", "off", "no")
+
+
+def telemetry_log() -> str | None:
+    """``PADDLE_TPU_TELEMETRY_LOG=<path>``: append every telemetry span
+    as one JSON line (consumed by ``tools/merge_timeline.py`` to build a
+    merged Perfetto timeline or a quantile summary).  None = no log."""
+    return os.environ.get("PADDLE_TPU_TELEMETRY_LOG") or None
+
+
 def decode_jit_key() -> tuple:
     """The trace-time decode-routing flag tuple — folded into every
     decode/serving jit-cache key (``generate._cfg_key``), so flipping any

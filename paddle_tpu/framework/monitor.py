@@ -4,7 +4,11 @@
 
 TPU-first: a thread-safe process-local registry; device-side numbers come
 from PJRT (``jax.local_devices()[i].memory_stats()``) and are snapshotted
-into the same registry so one ``stats()`` call observes both."""
+into the same registry so one ``stats()`` call observes both.  The
+telemetry layer (:mod:`paddle_tpu.telemetry`) routes its counters and
+histogram count/sum mirrors through this registry too — float stats
+(``as_float=True``) carry latency sums; existing counters keep the
+reference's int64 semantics."""
 from __future__ import annotations
 
 import threading
@@ -16,27 +20,31 @@ __all__ = ["StatValue", "StatRegistry", "get_stat", "stats", "reset_all",
 
 
 class StatValue:
-    """One named monotonic-ish counter (int64 semantics like the
-    reference's StatValue: add/sub/reset/get)."""
+    """One named monotonic-ish counter.  Int64 semantics by default like
+    the reference's StatValue (add/sub/reset/get truncate to int);
+    ``as_float=True`` makes it a float accumulator (latency sums) — the
+    cast is fixed at creation, so existing int counters are unchanged."""
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, as_float: bool = False):
         self.name = name
-        self._v = 0
+        self.is_float = bool(as_float)
+        self._cast = float if as_float else int
+        self._v = self._cast(0)
         self._lock = threading.Lock()
 
-    def add(self, n: int = 1) -> int:
+    def add(self, n=1):
         with self._lock:
-            self._v += int(n)
+            self._v += self._cast(n)
             return self._v
 
-    def sub(self, n: int = 1) -> int:
+    def sub(self, n=1):
         return self.add(-n)
 
-    def set(self, n: int) -> None:
+    def set(self, n) -> None:
         with self._lock:
-            self._v = int(n)
+            self._v = self._cast(n)
 
-    def get(self) -> int:
+    def get(self):
         with self._lock:
             return self._v
 
@@ -61,17 +69,17 @@ class StatRegistry:
                 cls._inst = cls()
             return cls._inst
 
-    def get(self, name: str) -> StatValue:
+    def get(self, name: str, as_float: bool = False) -> StatValue:
         with self._lock:
             if name not in self._stats:
-                self._stats[name] = StatValue(name)
+                self._stats[name] = StatValue(name, as_float=as_float)
             return self._stats[name]
 
     def __iter__(self) -> Iterator[StatValue]:
         with self._lock:
             return iter(list(self._stats.values()))
 
-    def dict(self) -> dict[str, int]:
+    def dict(self) -> dict:
         return {s.name: s.get() for s in self}
 
     def reset_all(self) -> None:
@@ -79,11 +87,23 @@ class StatRegistry:
             s.reset()
 
 
-def get_stat(name: str) -> StatValue:
-    return StatRegistry.instance().get(name)
+def get_stat(name: str, as_float: bool = False, **labels) -> StatValue:
+    """Registry accessor; ``labels`` build a Prometheus-style namespaced
+    name — ``get_stat("serving.ttft_ms", slot=3)`` →
+    ``serving.ttft_ms{slot="3"}`` — so per-entity series live beside the
+    bare aggregate without a separate label store.  The first ``get``
+    fixes a stat's int/float semantics."""
+    if labels:
+        def esc(v):  # Prometheus exposition escaping for label values
+            return str(v).replace("\\", r"\\").replace('"', r'\"') \
+                .replace("\n", r"\n")
+
+        name = name + "{" + ",".join(
+            f'{k}="{esc(labels[k])}"' for k in sorted(labels)) + "}"
+    return StatRegistry.instance().get(name, as_float=as_float)
 
 
-def stats() -> dict[str, int]:
+def stats() -> dict:
     return StatRegistry.instance().dict()
 
 

@@ -14,10 +14,12 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 from typing import Any, Callable, Sequence
 
 import numpy as np
 
+from .. import telemetry as _telemetry
 from ..core.tensor import Tensor
 from ..framework.io import load as _load, save as _save
 from ..jit import TrainStep
@@ -34,7 +36,11 @@ def _host_scalar(x):
     """THE host-fetch choke point of the fit loop: every per-step loss
     materialization funnels through here, so tests can count the steady-
     state train loop's host syncs (zero per step in async mode — drained
-    only at log_freq boundaries and epoch end)."""
+    only at log_freq boundaries and epoch end).  The telemetry layer
+    counts the same choke point into the shared registry
+    (``train.host_syncs``) — a counter increment, never an extra fetch,
+    so ``PADDLE_TPU_ASYNC_TRAIN`` semantics are untouched."""
+    _telemetry.count("train.host_syncs")
     if isinstance(x, Tensor):
         x = x.value
     return float(np.asarray(x))
@@ -293,6 +299,13 @@ class Model:
         use_async = dynamic and self._train_step.async_metrics
         use_prefetch = (dynamic and _flags.fit_prefetch()
                         and prefetch_factor and prefetch_factor > 0)
+        # training telemetry: step-time/throughput histograms into the
+        # shared registry.  Pure host timestamps around the step call —
+        # under async metrics that measures DISPATCH time (the device
+        # runs behind), which is exactly the hot-path quantity the
+        # sync-free loop optimizes; drain steps honestly include their
+        # one host fetch.  Never adds a device sync of its own.
+        tel = _telemetry.enabled()
         history = []
         for epoch in range(epochs):
             for c in cbs:
@@ -314,8 +327,11 @@ class Model:
                         _device_put_batch,
                         sharding=self._train_step.batch_sharding))
                 batches = iter(pf)
+            t_epoch0 = time.perf_counter()
+            samples = 0
             try:
                 for step, batch in enumerate(batches):
+                    t_step0 = time.perf_counter() if tel else 0.0
                     drain = (not use_async) or (log_freq
                                                 and step % log_freq == 0)
                     if not dynamic:
@@ -342,6 +358,14 @@ class Model:
                         else:
                             loss_rep = _host_scalar(loss_t)
                             losses.append(loss_rep)
+                    if tel:
+                        _telemetry.observe(
+                            "train.step_ms",
+                            (time.perf_counter() - t_step0) * 1e3)
+                        _telemetry.count("train.steps")
+                        shp = getattr(batch[0], "shape", None)
+                        if shp:
+                            samples += int(shp[0])
                     logs = {"loss": loss_rep}
                     if out is not None and self._metrics:
                         saw_outputs = True
@@ -362,6 +386,12 @@ class Model:
             finally:
                 if pf is not None:
                     pf.close()
+            if tel:
+                ep_dt = time.perf_counter() - t_epoch0
+                _telemetry.observe("train.epoch_s", ep_dt)
+                if samples and ep_dt > 0:
+                    _telemetry.set_gauge("train.samples_per_s",
+                                         samples / ep_dt)
             if loss_sum is not None:
                 # ONE host fetch for the whole async epoch
                 epoch_logs = {"loss": _host_scalar(loss_sum) / n_steps}

@@ -322,7 +322,19 @@ class TrainStep:
             return new_params, new_buf, new_opt, loss, out
 
         donate_args = (0, 2) if donate else ()
-        self._compiled = jax.jit(step_fn, donate_argnums=donate_args)
+        # compile telemetry: the first __call__ (where tracing + XLA
+        # compilation happen) records ("jit.TrainStep", key, wall).  The
+        # flags-diff WARNING stays disarmed (flags_key None): a TrainStep
+        # compiles once per construction by design, and explicit
+        # grad_accum/async_metrics args legitimately differ between
+        # instances — unlike the decode caches there is no stable
+        # cfg-vs-flags split to diff.  jax.export callers unwrap via
+        # _telemetry_inner (save_program does).
+        from .. import telemetry as _telemetry
+
+        self._compiled = _telemetry.instrument_compile(
+            "jit.TrainStep", (self.trace_key, _flags.train_step_key()),
+            None, jax.jit(step_fn, donate_argnums=donate_args))
 
     def _current_lr(self):
         from ..optimizer.lr import LRScheduler
@@ -403,7 +415,12 @@ class TrainStep:
         args = (self._params, self._buffers, self._opt_state,
                 jax.random.PRNGKey(0), jnp.float32(0.0),
                 jnp.int32(0), *arr)
-        exported = jax.export.export(self._compiled)(*args)
+        # unwrap the telemetry compile-watch wrapper: jax.export needs
+        # the jitted function itself (NOT __wrapped__ — a raw jax.jit
+        # result carries that too, pointing at the un-jitted step_fn)
+        compiled = getattr(self._compiled, "_telemetry_inner",
+                           self._compiled)
+        exported = jax.export.export(compiled)(*args)
         os.makedirs(os.path.dirname(os.path.abspath(path_prefix)),
                     exist_ok=True)
         with open(path_prefix + ".pdtrain", "wb") as f:

@@ -13,6 +13,7 @@ covers the report/export surface.
 """
 from __future__ import annotations
 
+import functools
 import json
 import os
 import threading
@@ -27,36 +28,50 @@ _trace_dir: str | None = None
 
 
 class RecordEvent:
-    """Context manager / decorator naming a host span (profiler.h:127)."""
+    """Context manager / decorator naming a host span (profiler.h:127).
+
+    Re-entrant and thread-safe: one shared instance may be entered
+    concurrently from several threads (or nested in one) — per-thread
+    span state lives in a thread-local STACK, so every ``__enter__``
+    gets its own ``t0``/annotation instead of clobbering a sibling's."""
 
     def __init__(self, name: str):
         self.name = name
-        self._ann = None
+        self._tls = threading.local()
+
+    def _stack(self):
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
 
     def __enter__(self):
-        self._t0 = time.perf_counter()
+        t0 = time.perf_counter()
         try:
             import jax
 
-            self._ann = jax.profiler.TraceAnnotation(self.name)
-            self._ann.__enter__()
+            ann = jax.profiler.TraceAnnotation(self.name)
+            ann.__enter__()
         except Exception:
-            self._ann = None
+            ann = None
+        self._stack().append((t0, ann))
         return self
 
     def __exit__(self, *exc):
         t1 = time.perf_counter()
-        if self._ann is not None:
-            self._ann.__exit__(*exc)
+        t0, ann = self._stack().pop()
+        if ann is not None:
+            ann.__exit__(*exc)
         if _enabled:
             with _events_lock:
-                _events.append((self.name, self._t0, t1,
+                _events.append((self.name, t0, t1,
                                 threading.get_ident()))
         return False
 
     def __call__(self, fn):
+        @functools.wraps(fn)
         def wrapped(*a, **k):
-            with RecordEvent(self.name):
+            with self:
                 return fn(*a, **k)
 
         return wrapped
@@ -106,6 +121,15 @@ class profiler:
     def __exit__(self, *exc):
         self.report = stop_profiler(profile_path=self.profile_path)
         return False
+
+
+def host_events() -> list:
+    """Snapshot of the recorded host spans as (name, t0, t1, tid) tuples
+    (``time.perf_counter`` seconds) — the telemetry layer merges these
+    with its request-lifecycle spans into one chrome-trace timeline
+    (``telemetry.dump_chrome_trace``)."""
+    with _events_lock:
+        return list(_events)
 
 
 def summary(evts=None, sorted_key: str = "total"):

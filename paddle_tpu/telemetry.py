@@ -1,0 +1,613 @@
+"""Runtime telemetry: one structured observability layer with three feeds.
+
+Reference capability: platform/profiler.cc ``RecordEvent`` + chrome-trace
+export and platform/monitor.h ``StatRegistry`` give the reference a
+profiler/monitor surface; serving-systems work (Orca, vLLM — PAPERS.md)
+treats per-request TTFT/TPOT percentiles and cache-occupancy gauges as the
+first-class product metric.  This module is the TPU-native equivalent,
+built on the seeds in :mod:`paddle_tpu.profiler` (host spans) and
+:mod:`paddle_tpu.framework.monitor` (StatRegistry):
+
+1. **Serving request tracing** — every ``DecodeServer`` submit→retire
+   lifecycle records queue-wait / TTFT / per-token / end-to-end latency
+   into streaming histograms (fixed log-spaced buckets, O(1) memory) plus
+   batch-slot / KV-cache / queue-depth gauges, sampled from host values
+   the server already fetched (no extra device syncs).
+2. **Training step telemetry** — ``Model.fit`` / ``TrainStep`` emit
+   step-time and throughput histograms, and the fit loop's host-sync
+   count lands in the shared counter registry via the
+   ``hapi.model._host_scalar`` choke point.
+3. **Recompile watch** — every jit-cache miss funnels through
+   :func:`instrument_compile`, which records (fn name, cfg/flags key,
+   wall time) on the executable's first call and raises a rate-limited
+   ``RuntimeWarning`` with the key diff when the flags portion of a key
+   flips mid-process (the ``flags.decode_jit_key`` /
+   ``flags.train_step_key`` retrace discipline, made observable).
+
+Export surface: :func:`snapshot` (JSON dict with quantiles),
+:func:`render_prometheus` (+ :func:`serve_metrics` HTTP endpoint, wired
+as ``DecodeServer(metrics_port=...)``), a JSONL event log
+(``PADDLE_TPU_TELEMETRY_LOG=<path>``), and :func:`dump_chrome_trace`
+merging request-lifecycle spans with :mod:`paddle_tpu.profiler` host
+events into one Perfetto-loadable timeline (``tools/merge_timeline.py``
+folds in ``jax.profiler`` device traces).
+
+All hot-path work is lock-cheap counters/bucket increments;
+``PADDLE_TPU_TELEMETRY=0`` turns every record call into an early-out
+no-op (and :func:`instrument_compile` returns the raw executable).
+"""
+from __future__ import annotations
+
+import bisect
+import contextlib
+import functools
+import json
+import math
+import os
+import threading
+import time
+import warnings
+from collections import deque
+
+from . import flags as _flags
+from .framework import monitor as _monitor
+
+__all__ = [
+    "enabled", "reset", "hist", "gauge", "observe", "set_gauge", "count",
+    "event", "span", "record_compile", "instrument_compile", "snapshot",
+    "latency_summary", "render_prometheus", "serve_metrics",
+    "chrome_events", "dump_chrome_trace", "Histogram", "Gauge",
+    "MetricsServer",
+]
+
+
+def enabled() -> bool:
+    """Master switch (re-read per call so tests can flip the env)."""
+    return _flags.telemetry_enabled()
+
+
+# ---------------------------------------------------------------------------
+# streaming metrics: histogram / gauge / counter
+# ---------------------------------------------------------------------------
+
+# Fixed log-spaced bucket bounds shared by every histogram: 20 buckets per
+# decade from 1e-3 to 1e7 (unit-agnostic; in ms that spans 1 µs .. ~3 h).
+# O(1) memory per histogram regardless of sample count, and quantiles
+# interpolate to within one bucket ratio (10^(1/20) ≈ 12% worst case).
+_BOUNDS: tuple = tuple(10.0 ** (i / 20.0) for i in range(-60, 141))
+
+
+class Histogram:
+    """Streaming latency histogram: fixed log-spaced buckets, O(1) memory,
+    lock-cheap ``observe``, Prometheus-compatible cumulative export."""
+
+    __slots__ = ("name", "_counts", "_count", "_sum", "_min", "_max",
+                 "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._counts = [0] * (len(_BOUNDS) + 1)  # last = overflow
+        self._count = 0
+        self._sum = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, n: int = 1) -> None:
+        """Record ``n`` observations of ``value`` (n > 1 folds a batch of
+        identical-latency samples — e.g. one block tick's tokens — in one
+        lock acquisition)."""
+        v = float(value)
+        i = bisect.bisect_left(_BOUNDS, v) if v > 0.0 else 0
+        with self._lock:
+            self._counts[i] += n
+            self._count += n
+            self._sum += v * n
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+
+    def quantile(self, q: float) -> float:
+        """Interpolated quantile from the bucket counts (the
+        histogram_quantile rule: linear within the containing bucket,
+        clamped to the observed min/max)."""
+        with self._lock:
+            total = self._count
+            if total == 0:
+                return 0.0
+            counts = list(self._counts)
+            lo_obs, hi_obs = self._min, self._max
+        rank = q * total
+        cum = 0.0
+        for i, c in enumerate(counts):
+            if c == 0:
+                continue
+            if cum + c >= rank:
+                lo = _BOUNDS[i - 1] if 0 < i <= len(_BOUNDS) else 0.0
+                hi = _BOUNDS[i] if i < len(_BOUNDS) else hi_obs
+                frac = (rank - cum) / c
+                v = lo + (hi - lo) * frac
+                return min(max(v, lo_obs), hi_obs)
+            cum += c
+        return hi_obs
+
+    def summary(self) -> dict:
+        with self._lock:
+            n, s = self._count, self._sum
+            mn = self._min if n else 0.0
+            mx = self._max if n else 0.0
+        return {"count": n, "sum": round(s, 6), "avg": round(s / n, 6)
+                if n else 0.0, "min": round(mn, 6), "max": round(mx, 6),
+                "p50": round(self.quantile(0.50), 6),
+                "p90": round(self.quantile(0.90), 6),
+                "p99": round(self.quantile(0.99), 6)}
+
+    def buckets(self):
+        """(upper_bound, cumulative_count) pairs for Prometheus exposition
+        — only bounds where the cumulative count changes, plus +Inf (a
+        subset of ``le`` values is valid exposition and keeps the text
+        small)."""
+        with self._lock:
+            counts = list(self._counts)
+        out, cum = [], 0
+        for i, c in enumerate(counts[:-1]):
+            if c:
+                cum += c
+                out.append((_BOUNDS[i], cum))
+        out.append((math.inf, cum + counts[-1]))
+        return out
+
+
+class Gauge:
+    """Last-write-wins float gauge."""
+
+    __slots__ = ("name", "_v", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._v = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._v = float(v)
+
+    def add(self, v: float) -> None:
+        with self._lock:
+            self._v += float(v)
+
+    def get(self) -> float:
+        with self._lock:
+            return self._v
+
+
+# ---------------------------------------------------------------------------
+# the registry: histograms + gauges here, counters in framework.monitor
+# ---------------------------------------------------------------------------
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+_lock = threading.Lock()
+_hists: dict[str, Histogram] = {}
+_gauges: dict[str, Gauge] = {}
+_events: deque = deque(maxlen=_env_int("PADDLE_TPU_TELEMETRY_EVENTS",
+                                       65536))
+_log_lock = threading.Lock()  # JSONL I/O only — never blocks recording
+_log_fh = None
+_log_path: str | None = None
+_counter_names: set[str] = set()
+
+# recompile watch state: per (name, flagless key) the last-seen flags key
+_compile_lock = threading.Lock()
+_compile_seen: dict[tuple, tuple] = {}
+# ring like _events: a model-cycling server recompiles forever — the log
+# must not grow with it
+_compile_log: deque = deque(maxlen=_env_int(
+    "PADDLE_TPU_TELEMETRY_COMPILES", 4096))
+_warn_last: dict[str, float] = {}
+# rate limit: at most one recompile warning per fn name per interval
+# (module-level so tests can shrink it)
+_WARN_INTERVAL_S = 30.0
+
+
+def hist(name: str) -> Histogram:
+    h = _hists.get(name)
+    if h is None:
+        with _lock:
+            h = _hists.setdefault(name, Histogram(name))
+    return h
+
+
+def gauge(name: str) -> Gauge:
+    g = _gauges.get(name)
+    if g is None:
+        with _lock:
+            g = _gauges.setdefault(name, Gauge(name))
+    return g
+
+
+def observe(name: str, value: float, n: int = 1) -> None:
+    if not enabled():
+        return
+    hist(name).observe(value, n)
+
+
+def set_gauge(name: str, value: float) -> None:
+    if not enabled():
+        return
+    gauge(name).set(value)
+
+
+def count(name: str, n: int = 1) -> None:
+    """Counter feed — lands in the SAME registry the reference's monitor
+    surface reads (``framework.monitor.StatRegistry``), so one
+    ``monitor.stats()`` call observes telemetry counters next to the
+    existing runtime counters."""
+    if not enabled():
+        return
+    if name not in _counter_names:  # steady state: no lock, no add
+        with _lock:
+            _counter_names.add(name)
+    _monitor.get_stat(name).add(n)
+
+
+def reset() -> None:
+    """Drop every histogram/gauge/event/compile record and this module's
+    counters (tests; bench arms isolate their snapshots with this).  The
+    rest of the monitor registry is left alone."""
+    global _log_fh, _log_path
+    with _lock:
+        _hists.clear()
+        _gauges.clear()
+        _events.clear()
+        for n in _counter_names:
+            _monitor.get_stat(n).reset()
+        _counter_names.clear()
+    with _log_lock:
+        if _log_fh is not None:
+            with contextlib.suppress(Exception):
+                _log_fh.close()
+        _log_fh = None
+        _log_path = None
+    with _compile_lock:
+        _compile_seen.clear()
+        _compile_log.clear()
+        _warn_last.clear()
+
+
+# ---------------------------------------------------------------------------
+# spans / events: ring buffer + JSONL log + chrome-trace export
+# ---------------------------------------------------------------------------
+
+
+def _jsonl_write(rec: dict) -> None:
+    global _log_fh, _log_path
+    path = _flags.telemetry_log()
+    if not path:
+        return
+    # dedicated lock: a slow flush must stall only other log writers,
+    # never the lock-cheap metric recording or a /metrics scrape
+    with _log_lock:
+        if _log_fh is None or _log_path != path:
+            if _log_fh is not None:
+                with contextlib.suppress(Exception):
+                    _log_fh.close()
+            d = os.path.dirname(os.path.abspath(path))
+            os.makedirs(d, exist_ok=True)
+            _log_fh = open(path, "a", encoding="utf-8")
+            _log_path = path
+        _log_fh.write(json.dumps(rec) + "\n")
+        _log_fh.flush()
+
+
+def event(name: str, t0: float, t1: float, tid: int = 0, **args) -> None:
+    """Record a completed host span [t0, t1] (``time.perf_counter``
+    seconds — the same clock profiler.py stamps, so the two event streams
+    merge onto one timeline).  Ring-buffered in memory, appended to the
+    ``PADDLE_TPU_TELEMETRY_LOG`` JSONL when set."""
+    if not enabled():
+        return
+    rec = {"name": name, "t0": t0, "t1": t1, "tid": int(tid)}
+    if args:
+        rec["args"] = args
+    with _lock:
+        _events.append(rec)
+    _jsonl_write(rec)
+
+
+@contextlib.contextmanager
+def span(name: str, tid: int = 0, **args):
+    """``with telemetry.span("prefill", rid=3): ...`` — records an event
+    on exit (no-op when disabled)."""
+    if not enabled():
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        event(name, t0, time.perf_counter(), tid=tid, **args)
+
+
+def chrome_events(pid: int = 1) -> list:
+    """The ring buffer as chrome://tracing 'X' events (µs timestamps)."""
+    out = [{"name": "process_name", "ph": "M", "pid": pid,
+            "args": {"name": "paddle_tpu.telemetry"}}]
+    with _lock:
+        events = list(_events)
+    for e in events:
+        ev = {"name": e["name"], "ph": "X", "pid": pid, "tid": e["tid"],
+              "ts": e["t0"] * 1e6, "dur": (e["t1"] - e["t0"]) * 1e6}
+        if "args" in e:
+            ev["args"] = e["args"]
+        out.append(ev)
+    return out
+
+
+def dump_chrome_trace(path: str, include_profiler: bool = True) -> str:
+    """Write one Perfetto-loadable chrome-trace JSON: telemetry spans
+    (request lifecycles, compiles) next to :mod:`paddle_tpu.profiler`
+    host events — drop the file (or its ``tools/merge_timeline.py`` merge
+    with a ``jax.profiler`` device trace) into ui.perfetto.dev."""
+    evs = []
+    if include_profiler:
+        from . import profiler as _profiler
+
+        evs.append({"name": "process_name", "ph": "M", "pid": 0,
+                    "args": {"name": "paddle_tpu.profiler"}})
+        evs.extend({"name": n, "ph": "X", "pid": 0, "tid": tid,
+                    "ts": t0 * 1e6, "dur": (t1 - t0) * 1e6}
+                   for n, t0, t1, tid in _profiler.host_events())
+    evs.extend(chrome_events(pid=1))
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": evs, "displayTimeUnit": "ms"}, f)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# recompile watch
+# ---------------------------------------------------------------------------
+
+
+def _strip_flags(key, flags_key):
+    """``key`` with every (possibly nested) occurrence of ``flags_key``
+    replaced by a sentinel — the cfg-identity part of a jit-cache key
+    (generate._cfg_key embeds flags.decode_jit_key as a sub-tuple)."""
+    if key == flags_key:
+        return "<flags>"
+    if isinstance(key, tuple):
+        return tuple(_strip_flags(k, flags_key) for k in key)
+    return key
+
+
+def _key_diff(old: tuple, new: tuple) -> str:
+    if not (isinstance(old, tuple) and isinstance(new, tuple)
+            and len(old) == len(new)):
+        return f"{old!r} -> {new!r}"
+    ds = [f"[{i}] {a!r} -> {b!r}" for i, (a, b) in
+          enumerate(zip(old, new)) if a != b]
+    return "; ".join(ds) or f"{old!r} -> {new!r}"
+
+
+def record_compile(name: str, key, flags_key=None,
+                   seconds: float | None = None) -> None:
+    """Record one jit-cache-miss compile: counter + wall-time histogram +
+    timeline span, and the recompile watch — if this (name, cfg-part)
+    compiled before under a DIFFERENT flags key, the compile is a
+    mid-process flag-flip retrace: warn (rate-limited) with the key diff.
+    A fresh config compiling for the first time never warns."""
+    if not enabled():
+        return
+    count("compile.count")
+    if seconds is not None:
+        hist("compile.ms").observe(seconds * 1e3)
+        now = time.perf_counter()
+        event(f"compile:{name}", now - seconds, now, key=repr(key))
+    with _compile_lock:
+        _compile_log.append({"name": name, "key": repr(key),
+                             "seconds": None if seconds is None
+                             else round(seconds, 4)})
+        if flags_key is None:
+            return
+        base = (name, _strip_flags(key, flags_key))
+        last = _compile_seen.get(base)
+        _compile_seen[base] = flags_key
+        if last is None or last == flags_key:
+            return
+        now = time.monotonic()
+        rate_ok = now - _warn_last.get(name, -math.inf) >= _WARN_INTERVAL_S
+        if rate_ok:
+            _warn_last[name] = now
+    count("compile.recompiles")
+    if rate_ok:
+        warnings.warn(
+            f"[paddle_tpu.telemetry] steady-state recompile of {name!r}: "
+            f"the trace-time flags key changed mid-process "
+            f"({_key_diff(last, flags_key)}) — an executable bakes these "
+            f"in, so the flip forced a retrace (flags.decode_jit_key / "
+            f"train_step_key discipline)", RuntimeWarning, stacklevel=3)
+
+
+def instrument_compile(name: str, key, flags_key, fn):
+    """Wrap a freshly built jitted callable from a jit-cache MISS: the
+    first call (where tracing + XLA compilation actually happen) is timed
+    and recorded via :func:`record_compile`; later calls pay one ``if``.
+    Returns ``fn`` unchanged when telemetry is off — the hot path
+    compiles down to the raw executable.  The original jit function stays
+    reachable as ``wrapper._telemetry_inner`` (``jax.export`` callers
+    must unwrap through that attribute — NOT ``__wrapped__``, which a
+    raw ``jax.jit`` result also carries, pointing past the jit)."""
+    if not enabled():
+        return fn
+
+    done = False
+
+    @functools.wraps(fn)
+    def wrapper(*a, **k):
+        nonlocal done
+        if done:
+            return fn(*a, **k)
+        t0 = time.perf_counter()
+        out = fn(*a, **k)
+        done = True
+        record_compile(name, key, flags_key, time.perf_counter() - t0)
+        return out
+
+    wrapper._telemetry_inner = fn
+    return wrapper
+
+
+# ---------------------------------------------------------------------------
+# export: snapshot / prometheus / HTTP
+# ---------------------------------------------------------------------------
+
+
+def snapshot() -> dict:
+    """One JSON-serializable dict over all three feeds: histogram
+    quantiles, gauges, the shared counter registry, and the compile log.
+    Histogram count/sum are also pushed into the monitor registry as
+    float stats, so ``monitor.stats()`` alone sees every feed."""
+    # copy under the registry lock: the MetricsServer thread snapshots
+    # while serving threads insert new names / reset() clears
+    with _lock:
+        hists = sorted(_hists.items())
+        gauges = sorted(_gauges.items())
+    hs = {}
+    for name, h in hists:
+        s = h.summary()
+        hs[name] = s
+        with _lock:
+            _counter_names.add(name + ".count")
+            _counter_names.add(name + ".sum")
+        _monitor.get_stat(name + ".count").set(s["count"])
+        _monitor.get_stat(name + ".sum", as_float=True).set(s["sum"])
+    with _compile_lock:
+        compiles = list(_compile_log)
+    return {
+        "enabled": enabled(),
+        "histograms": hs,
+        "gauges": {n: g.get() for n, g in gauges},
+        "counters": _monitor.stats(),
+        "compiles": compiles,
+        "events": len(_events),
+    }
+
+
+def latency_summary(prefix: str = "serving.") -> dict:
+    """Compact {short_name: {count, p50, p99}} over histograms under
+    ``prefix`` — the ``telemetry`` block bench arms embed in their JSON
+    lines, so BENCH_*.json captures latency distributions, not means."""
+    with _lock:
+        hists = sorted(_hists.items())
+    out = {}
+    for name, h in hists:
+        if not name.startswith(prefix):
+            continue
+        s = h.summary()
+        out[name[len(prefix):]] = {"count": s["count"], "p50": s["p50"],
+                                   "p99": s["p99"]}
+    return out
+
+
+def _prom_name(name: str) -> str:
+    """Sanitize the metric name but keep a monitor-style ``{k="v"}``
+    label block intact (``monitor.get_stat(name, **labels)`` built it in
+    valid exposition syntax already)."""
+    base, brace, labels = name.partition("{")
+    out = "".join(c if c.isalnum() or c == "_" else "_" for c in base)
+    return "paddle_tpu_" + out + brace + labels
+
+
+def render_prometheus() -> str:
+    """Prometheus text exposition (v0.0.4) over the whole registry."""
+    with _lock:  # the endpoint thread renders while serving code records
+        hists = sorted(_hists.items())
+        gauges = sorted(_gauges.items())
+    lines = []
+    for name, h in hists:
+        pn = _prom_name(name)
+        s = h.summary()
+        lines.append(f"# TYPE {pn} histogram")
+        for ub, cum in h.buckets():
+            le = "+Inf" if ub == math.inf else f"{ub:.6g}"
+            lines.append(f'{pn}_bucket{{le="{le}"}} {cum}')
+        lines.append(f"{pn}_sum {s['sum']:.6g}")
+        lines.append(f"{pn}_count {s['count']}")
+    for name, g in gauges:
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {g.get():.6g}")
+    # the '<hist>.count'/'<hist>.sum' monitor mirrors snapshot() writes
+    # would sanitize to the histogram's own _count/_sum sample names —
+    # duplicate families are invalid exposition, so skip them here
+    mirror = {f"{n}.count" for n, _ in hists} | \
+             {f"{n}.sum" for n, _ in hists}
+    for name, v in sorted(_monitor.stats().items()):
+        if name in mirror:
+            continue
+        pn = _prom_name(name)
+        # TYPE declares the FAMILY (label-free); the sample keeps labels
+        lines.append(f"# TYPE {pn.partition('{')[0]} counter")
+        lines.append(f"{pn} {v:.6g}" if isinstance(v, float)
+                     else f"{pn} {v}")
+    return "\n".join(lines) + "\n"
+
+
+class MetricsServer:
+    """Tiny opt-in HTTP endpoint: ``GET /metrics`` (Prometheus text),
+    ``GET /snapshot`` (the JSON snapshot).  Daemon-threaded; ``port=0``
+    picks an ephemeral port (``.port`` has the bound one).  Binds
+    loopback by default — the endpoint is unauthenticated, so exposing
+    it beyond the host (``host="0.0.0.0"`` for a scraper sidecar) is an
+    explicit opt-in."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1"):
+        import http.server
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self_h):  # noqa: N805
+                if self_h.path.startswith("/snapshot"):
+                    body = json.dumps(snapshot()).encode()
+                    ctype = "application/json"
+                elif self_h.path.startswith("/metrics") or \
+                        self_h.path == "/":
+                    body = render_prometheus().encode()
+                    ctype = "text/plain; version=0.0.4"
+                else:
+                    self_h.send_error(404)
+                    return
+                self_h.send_response(200)
+                self_h.send_header("Content-Type", ctype)
+                self_h.send_header("Content-Length", str(len(body)))
+                self_h.end_headers()
+                self_h.wfile.write(body)
+
+            def log_message(self_h, *a):  # noqa: N805 - quiet by design
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer((host, int(port)),
+                                                      Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="paddle-tpu-metrics",
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self):
+        with contextlib.suppress(Exception):
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+
+def serve_metrics(port: int, host: str = "127.0.0.1") -> MetricsServer:
+    """Start the /metrics endpoint (``DecodeServer(metrics_port=...)``
+    calls this; standalone use works too)."""
+    return MetricsServer(port, host)

@@ -291,6 +291,20 @@ def _donate_cache():
     return (1,) if flags.donate_decode() else ()
 
 
+def _watch_jit(name: str, key, fn):
+    """Telemetry recompile watch around a jit-cache MISS: every decode-
+    path cache-get choke point (here and in text/serving.py) funnels its
+    freshly built executable through this, so each compile records
+    (fn name, cfg/flags key, wall time) and a mid-process flip of
+    ``flags.decode_jit_key`` — whose tuple every ``_cfg_key`` embeds —
+    raises the rate-limited recompile warning with the key diff.  With
+    telemetry off the raw jit function is returned untouched."""
+    from .. import telemetry as _telemetry
+
+    return _telemetry.instrument_compile(name, key,
+                                         _flags.decode_jit_key(), fn)
+
+
 def _cfg_key(cfg):
     """Value-based cache key (GPTConfig is an unhashable dataclass; keying
     by id() would recompile per object and leak executables)."""
@@ -320,9 +334,10 @@ def _get_generate_fn(cfg, max_new_tokens, top_k, top_p=1.0):
     cache_key = (_cfg_key(cfg), max_new_tokens, top_k, float(top_p))
     fn = _GEN_CACHE.get(cache_key)
     if fn is None:
-        fn = jax.jit(functools.partial(
-            _generate_impl, cfg=cfg, max_new_tokens=max_new_tokens,
-            top_k=top_k, top_p=float(top_p)))
+        fn = _watch_jit("generate.generate", cache_key, jax.jit(
+            functools.partial(
+                _generate_impl, cfg=cfg, max_new_tokens=max_new_tokens,
+                top_k=top_k, top_p=float(top_p))))
         _GEN_CACHE[cache_key] = fn
     return fn
 
@@ -501,10 +516,11 @@ def beam_search(params, cfg: gpt.GPTConfig, prompt, max_new_tokens=32,
            float(length_penalty), eos_id)
     fn = _GEN_CACHE.get(key)
     if fn is None:
-        fn = jax.jit(functools.partial(
-            _beam_impl, cfg=cfg, max_new_tokens=int(max_new_tokens),
-            num_beams=int(num_beams),
-            length_penalty=float(length_penalty), eos_id=eos_id))
+        fn = _watch_jit("generate.beam_search", key, jax.jit(
+            functools.partial(
+                _beam_impl, cfg=cfg, max_new_tokens=int(max_new_tokens),
+                num_beams=int(num_beams),
+                length_penalty=float(length_penalty), eos_id=eos_id)))
         _GEN_CACHE[key] = fn
     return fn(params, prompt)
 
@@ -582,7 +598,7 @@ def build_sharded_decode(params, cfg: gpt.GPTConfig, mesh, mp: str = "mp"):
     def _step(p, cache, token, pos):
         return decode_step(p, cache, token, pos, cfg)
 
-    decode_fn = jax.jit(
+    decode_fn = _watch_jit("generate.sharded_decode", _cfg_key(cfg), jax.jit(
         _step,
         in_shardings=(jax.tree_util.tree_map(
             ns, pspecs, is_leaf=lambda s: isinstance(s, P)),
@@ -591,7 +607,7 @@ def build_sharded_decode(params, cfg: gpt.GPTConfig, mesh, mp: str = "mp"):
         out_shardings=(ns(repl), cache_shardings),
         # the sharded cache is donated like the single-chip steps' —
         # in and out shardings match, so aliasing is exact per shard
-        donate_argnums=_donate_cache())
+        donate_argnums=_donate_cache()))
 
     def make_cache(batch: int, max_len: int):
         fresh = init_cache(cfg, batch, max_len)
@@ -831,8 +847,9 @@ def _jit_by_cfg(tag: str, fn, cfg):
     key = (tag, _cfg_key(cfg))
     jf = _GEN_CACHE.get(key)
     if jf is None:
-        jf = jax.jit(lambda p, c, t, s, _cfg=cfg: fn(p, c, t, s, _cfg),
-                     donate_argnums=_donate_cache())
+        jf = _watch_jit(f"generate.{tag}", key, jax.jit(
+            lambda p, c, t, s, _cfg=cfg: fn(p, c, t, s, _cfg),
+            donate_argnums=_donate_cache()))
         _GEN_CACHE[key] = jf
     return jf
 

@@ -21,6 +21,7 @@ step routes through the same woq accessors.
 """
 from __future__ import annotations
 
+import time
 from typing import Any
 
 import jax
@@ -28,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import generate, gpt
+from .. import telemetry as _telemetry
 
 __all__ = ["decode_step_batched", "DecodeServer"]
 
@@ -130,9 +132,10 @@ def _get_prefill_fn(cfg: gpt.GPTConfig):
     k = ("prefill", generate._cfg_key(cfg))
     fn = _STEP_CACHE.get(k)
     if fn is None:
-        fn = jax.jit(lambda p, c, t, ln, sl, _cfg=cfg:
-                     generate.prefill_slot(p, c, t, ln, sl, _cfg),
-                     donate_argnums=generate._donate_cache())
+        fn = generate._watch_jit("serving.prefill", k, jax.jit(
+            lambda p, c, t, ln, sl, _cfg=cfg:
+            generate.prefill_slot(p, c, t, ln, sl, _cfg),
+            donate_argnums=generate._donate_cache()))
         _STEP_CACHE[k] = fn
     return fn
 
@@ -141,10 +144,10 @@ def _get_prefill_chunk_fn(cfg: gpt.GPTConfig):
     k = ("prefill_chunk", generate._cfg_key(cfg))
     fn = _STEP_CACHE.get(k)
     if fn is None:
-        fn = jax.jit(lambda p, c, t, p0, ln, sl, _cfg=cfg:
-                     generate.prefill_slot_chunk(p, c, t, p0, ln, sl,
-                                                 _cfg),
-                     donate_argnums=generate._donate_cache())
+        fn = generate._watch_jit("serving.prefill_chunk", k, jax.jit(
+            lambda p, c, t, p0, ln, sl, _cfg=cfg:
+            generate.prefill_slot_chunk(p, c, t, p0, ln, sl, _cfg),
+            donate_argnums=generate._donate_cache()))
         _STEP_CACHE[k] = fn
     return fn
 
@@ -153,9 +156,10 @@ def _get_block_fn(cfg: gpt.GPTConfig, k: int):
     key = ("block", generate._cfg_key(cfg), k)
     fn = _STEP_CACHE.get(key)
     if fn is None:
-        fn = jax.jit(lambda p, c, t, s, _cfg=cfg, _k=k:
-                     decode_block_batched(p, c, t, s, _k, _cfg),
-                     donate_argnums=generate._donate_cache())
+        fn = generate._watch_jit("serving.block", key, jax.jit(
+            lambda p, c, t, s, _cfg=cfg, _k=k:
+            decode_block_batched(p, c, t, s, _k, _cfg),
+            donate_argnums=generate._donate_cache()))
         _STEP_CACHE[key] = fn
     return fn
 
@@ -164,9 +168,10 @@ def _get_sample_step_fn(cfg: gpt.GPTConfig):
     k = ("sample", generate._cfg_key(cfg))
     fn = _STEP_CACHE.get(k)
     if fn is None:
-        fn = jax.jit(lambda p, c, t, s, ky, te, tk, tp, _cfg=cfg:
-                     sample_step_batched(p, c, t, s, ky, te, tk, tp, _cfg),
-                     donate_argnums=generate._donate_cache())
+        fn = generate._watch_jit("serving.sample_step", k, jax.jit(
+            lambda p, c, t, s, ky, te, tk, tp, _cfg=cfg:
+            sample_step_batched(p, c, t, s, ky, te, tk, tp, _cfg),
+            donate_argnums=generate._donate_cache()))
         _STEP_CACHE[k] = fn
     return fn
 
@@ -175,10 +180,11 @@ def _get_sample_block_fn(cfg: gpt.GPTConfig, k: int):
     key = ("sample_block", generate._cfg_key(cfg), k)
     fn = _STEP_CACHE.get(key)
     if fn is None:
-        fn = jax.jit(lambda p, c, t, s, ky, off, te, tk, tp, _cfg=cfg,
-                     _k=k: sample_block_batched(p, c, t, s, ky, off, te,
-                                                tk, tp, _k, _cfg),
-                     donate_argnums=generate._donate_cache())
+        fn = generate._watch_jit("serving.sample_block", key, jax.jit(
+            lambda p, c, t, s, ky, off, te, tk, tp, _cfg=cfg, _k=k:
+            sample_block_batched(p, c, t, s, ky, off, te, tk, tp, _k,
+                                 _cfg),
+            donate_argnums=generate._donate_cache()))
         _STEP_CACHE[key] = fn
     return fn
 
@@ -192,9 +198,10 @@ def _get_step_fn(cfg: gpt.GPTConfig):
     k = generate._cfg_key(cfg)
     fn = _STEP_CACHE.get(k)
     if fn is None:
-        fn = jax.jit(lambda p, c, t, s, _cfg=cfg: decode_step_batched(
-            p, c, t, s, _cfg),
-            donate_argnums=generate._donate_cache())
+        fn = generate._watch_jit("serving.step", k, jax.jit(
+            lambda p, c, t, s, _cfg=cfg: decode_step_batched(
+                p, c, t, s, _cfg),
+            donate_argnums=generate._donate_cache()))
         _STEP_CACHE[k] = fn
     return fn
 
@@ -210,10 +217,11 @@ def _get_async_step_fn(cfg: gpt.GPTConfig):
     k = ("async", generate._cfg_key(cfg))
     fn = _STEP_CACHE.get(k)
     if fn is None:
-        fn = jax.jit(lambda p, c, ht, pm, pv, s, ky, te, tk, tp, _cfg=cfg:
-                     sample_step_batched(p, c, jnp.where(pm, pv, ht), s,
-                                         ky, te, tk, tp, _cfg),
-                     donate_argnums=generate._donate_cache())
+        fn = generate._watch_jit("serving.async_step", k, jax.jit(
+            lambda p, c, ht, pm, pv, s, ky, te, tk, tp, _cfg=cfg:
+            sample_step_batched(p, c, jnp.where(pm, pv, ht), s,
+                                ky, te, tk, tp, _cfg),
+            donate_argnums=generate._donate_cache()))
         _STEP_CACHE[k] = fn
     return fn
 
@@ -224,10 +232,11 @@ def _get_async_block_fn(cfg: gpt.GPTConfig, k: int):
     key = ("async_block", generate._cfg_key(cfg), k)
     fn = _STEP_CACHE.get(key)
     if fn is None:
-        fn = jax.jit(lambda p, c, ht, pm, pv, s, _cfg=cfg, _k=k:
-                     decode_block_batched(p, c, jnp.where(pm, pv, ht), s,
-                                          _k, _cfg),
-                     donate_argnums=generate._donate_cache())
+        fn = generate._watch_jit("serving.async_block", key, jax.jit(
+            lambda p, c, ht, pm, pv, s, _cfg=cfg, _k=k:
+            decode_block_batched(p, c, jnp.where(pm, pv, ht), s, _k,
+                                 _cfg),
+            donate_argnums=generate._donate_cache()))
         _STEP_CACHE[key] = fn
     return fn
 
@@ -238,11 +247,13 @@ def _get_async_sample_block_fn(cfg: gpt.GPTConfig, k: int):
     key = ("async_sample_block", generate._cfg_key(cfg), k)
     fn = _STEP_CACHE.get(key)
     if fn is None:
-        fn = jax.jit(lambda p, c, ht, pm, pv, s, ky, off, te, tk, tp,
-                     _cfg=cfg, _k=k:
-                     sample_block_batched(p, c, jnp.where(pm, pv, ht), s,
-                                          ky, off, te, tk, tp, _k, _cfg),
-                     donate_argnums=generate._donate_cache())
+        fn = generate._watch_jit("serving.async_sample_block", key,
+                                 jax.jit(
+            lambda p, c, ht, pm, pv, s, ky, off, te, tk, tp, _cfg=cfg,
+            _k=k:
+            sample_block_batched(p, c, jnp.where(pm, pv, ht), s,
+                                 ky, off, te, tk, tp, _k, _cfg),
+            donate_argnums=generate._donate_cache()))
         _STEP_CACHE[key] = fn
     return fn
 
@@ -265,8 +276,19 @@ class DecodeServer:
                  max_len: int, eos_id: int | None = None,
                  prefill: bool = True, seed: int = 0,
                  prefill_chunk: int | None = None,
-                 async_dispatch: bool = False):
+                 async_dispatch: bool = False,
+                 metrics_port: int | None = None):
         self.params = params
+        # telemetry (request tracing + latency histograms + gauges):
+        # decided once at construction — per-tick records are lock-cheap
+        # host counters off the already-fetched host values, and with
+        # PADDLE_TPU_TELEMETRY=0 every sample site is one bool check.
+        # ``metrics_port`` opts into the /metrics HTTP endpoint
+        # (telemetry.serve_metrics; port 0 = ephemeral, see
+        # ``self.metrics_server.port``).
+        self._tel = _telemetry.enabled()
+        self.metrics_server = (_telemetry.serve_metrics(metrics_port)
+                               if metrics_port is not None else None)
         self.cfg = cfg
         self.max_batch = max_batch
         self.max_len = max_len
@@ -366,14 +388,19 @@ class DecodeServer:
                             "max_new": max_new_tokens, "stop": stop,
                             "temperature": float(temperature),
                             "top_k": min(int(top_k), self.cfg.vocab_size),
-                            "top_p": float(top_p)})
+                            "top_p": float(top_p),
+                            "t_submit": time.perf_counter()})
+        if self._tel:
+            _telemetry.count("serving.requests_submitted")
         self._admit()
+        self._tel_gauges()
         return rid
 
     def _admit(self):
         while self._queue and self._free:
             slot = self._free.pop()
             req = self._queue.pop(0)
+            t_admit = time.perf_counter()
             st = {
                 "rid": req["rid"], "prompt": req["prompt"],
                 "max_new": req["max_new"], "stop": req.get("stop", []),
@@ -382,7 +409,14 @@ class DecodeServer:
                 "top_p": req.get("top_p", 1.0),
                 "generated": [],
                 "pos": 0,   # next position == index of the token to feed
+                # span timestamps (host clock only; never a device sync)
+                "t_submit": req.get("t_submit", t_admit),
+                "t_admit": t_admit,
             }
+            if self._tel:
+                _telemetry.observe(
+                    "serving.queue_wait_ms",
+                    (t_admit - st["t_submit"]) * 1e3)
             if self._prefill is not None or self._prefill_chunk is not None:
                 n = len(req["prompt"])
                 if self._prefill is not None:
@@ -437,11 +471,24 @@ class DecodeServer:
                     t = int(np.asarray(jnp.argmax(logits)))
                 st["generated"].append(t)
                 st["pos"] = n  # cache rows [0, n) are filled
+                if self._tel:
+                    # the argmax/choice above already fetched the host
+                    # value, so "now" IS the first-token time — TTFT and
+                    # the prefill span cost zero extra syncs
+                    now = time.perf_counter()
+                    st["t_first"] = st["t_last"] = now
+                    _telemetry.observe(
+                        "serving.ttft_ms", (now - st["t_submit"]) * 1e3)
+                    _telemetry.event("serving.prefill", t_admit, now,
+                                     tid=slot, rid=st["rid"],
+                                     prompt_len=n)
+                    _telemetry.count("serving.tokens_generated")
                 if (st["max_new"] <= 1
                         or (self.eos_id is not None and t == self.eos_id)
                         or _hits_stop(st)):
                     self._results[st["rid"]] = st["generated"]
                     self._free.append(slot)
+                    self._tel_retire(st, slot)
                     continue
             self._slots[slot] = st
 
@@ -461,6 +508,9 @@ class DecodeServer:
         not to carry state).  The LRU bound on _STEP_CACHE already caps
         growth; close() is for eagerly dropping a cycled-out model's
         executables (and their implicit param refs)."""
+        if self.metrics_server is not None:
+            self.metrics_server.close()
+            self.metrics_server = None
         ck = generate._cfg_key(self.cfg)
         for k in _STEP_CACHE.keys():
             if k == ck or (isinstance(k, tuple) and ck in k):
@@ -539,7 +589,71 @@ class DecodeServer:
             st = self._slots.pop(slot)
             self._results[st["rid"]] = st["generated"]
             self._free.append(slot)
+            self._tel_retire(st, slot)
         self._admit()
+        self._tel_gauges()
+
+    # -- telemetry sampling (host values only — never a device sync) --------
+
+    def _tel_gauges(self):
+        """Occupancy gauges off the scheduler's host state: queue depth,
+        active slots, slot occupancy, and KV-cache utilization (filled
+        rows / window, from the per-slot host ``pos``)."""
+        if not self._tel:
+            return
+        _telemetry.set_gauge("serving.queue_depth", len(self._queue))
+        _telemetry.set_gauge("serving.active_slots", len(self._slots))
+        _telemetry.set_gauge("serving.slot_occupancy",
+                             len(self._slots) / self.max_batch)
+        _telemetry.set_gauge(
+            "serving.kv_utilization",
+            sum(min(st["pos"], self.max_len)
+                for st in self._slots.values())
+            / (self.max_batch * self.max_len))
+
+    def _tel_retire(self, st, slot):
+        """End-of-lifecycle records for one request: end-to-end latency
+        histogram + the submit→retire span on the timeline."""
+        if not self._tel:
+            return
+        now = time.perf_counter()
+        t_sub = st.get("t_submit", now)
+        _telemetry.observe("serving.e2e_ms", (now - t_sub) * 1e3)
+        _telemetry.count("serving.requests_completed")
+        _telemetry.event("serving.request", t_sub, now, tid=slot,
+                         rid=st["rid"], prompt_len=len(st["prompt"]),
+                         tokens=len(st["generated"]))
+
+    def _tel_tokens(self, appended, t0, steps: int = 1):
+        """Per-tick records from the host bookkeeping that JUST ran on
+        the already-fetched token block: tick latency, first-token time
+        for slots whose first kept token arrived this tick (the
+        ``prefill=False`` path — prefill admission stamps TTFT itself),
+        and per-token latency = tick wall / steps (each slot decoded
+        every step of the block it was fed into)."""
+        if not self._tel:
+            return
+        now = time.perf_counter()
+        dt_ms = (now - t0) * 1e3
+        _telemetry.observe("serving.tick_ms", dt_ms)
+        if not appended:
+            return
+        total = 0
+        per_tok = dt_ms / max(steps, 1)
+        for st, n in appended:
+            total += n
+            if "t_first" not in st:
+                st["t_first"] = now
+                _telemetry.observe(
+                    "serving.ttft_ms",
+                    (now - st.get("t_submit", t0)) * 1e3)
+                if n > 1:
+                    _telemetry.observe("serving.tpot_ms", per_tok,
+                                       n=n - 1)
+            else:
+                _telemetry.observe("serving.tpot_ms", per_tok, n=n)
+            st["t_last"] = now
+        _telemetry.count("serving.tokens_generated", total)
 
     def tick(self):
         if self._async:
@@ -549,6 +663,7 @@ class DecodeServer:
             self._admit()
             if not self._slots:
                 return
+        t0 = time.perf_counter()
         tok, pos = self._feed_arrays()
         temp, tk, tp = self._sampling_arrays()
         n = self._step_no
@@ -566,6 +681,7 @@ class DecodeServer:
                                             jnp.asarray(pos))
             nxt = np.asarray(jnp.argmax(logits, axis=-1))
         done = []
+        appended = []
         for slot, st in self._slots.items():
             i = st["pos"]
             st["pos"] = i + 1
@@ -573,8 +689,10 @@ class DecodeServer:
                 continue                # still feeding prompt; logits unused
             t = int(nxt[slot])
             st["generated"].append(t)
+            appended.append((st, 1))
             if self._finished(st, t):
                 done.append(slot)
+        self._tel_tokens(appended, t0)
         self._retire(done)
 
     # -- async dispatch: one step/block in flight ---------------------------
@@ -638,7 +756,7 @@ class DecodeServer:
             jax.random.fold_in(self._base_key, n), jnp.asarray(temp),
             jnp.asarray(tk), jnp.asarray(tp))
         self._inflight = {"kind": "step", "toks": nxt, "feed": nxt,
-                          "snap": snap}
+                          "snap": snap, "t_disp": time.perf_counter()}
 
     def _dispatch_block_async(self, prev, block: int):
         ht, pm, pos, temp, tk, tp, snap = self._dispatch_feed(prev, block)
@@ -658,7 +776,8 @@ class DecodeServer:
                 self.params, self.cache, jnp.asarray(ht), jnp.asarray(pm),
                 self._prev_feed(prev), jnp.asarray(pos))
         self._inflight = {"kind": "block", "toks": toks, "feed": feed,
-                          "snap": snap, "block": block}
+                          "snap": snap, "block": block,
+                          "t_disp": time.perf_counter()}
 
     def _process_inflight(self, prev):
         """Fetch a completed dispatch's tokens and run the deferred host
@@ -667,6 +786,7 @@ class DecodeServer:
         the overrun the async pipeline trades for overlap."""
         toks = np.asarray(prev["toks"])  # the ONLY device->host fetch
         done = []
+        appended = []
         for slot, st, i in prev["snap"]:
             if self._slots.get(slot) is not st:
                 continue  # retired/replaced while this step was in flight
@@ -675,15 +795,23 @@ class DecodeServer:
                     continue  # still feeding prompt; logits-token unused
                 t = int(toks[slot])
                 st["generated"].append(t)
+                appended.append((st, 1))
                 if self._finished(st, t):
                     done.append(slot)
             else:
+                kept = 0
                 for j in range(prev["block"]):
                     t = int(toks[slot, j])
                     st["generated"].append(t)
+                    kept += 1
                     if self._finished(st, t):
                         done.append(slot)
                         break
+                appended.append((st, kept))
+        # latency window: dispatch -> this fetch (the async pipeline's
+        # real step time, overlap included)
+        self._tel_tokens(appended, prev.get("t_disp", time.perf_counter()),
+                         steps=prev.get("block", 1))
         self._retire(done)
 
     def _tick_async(self):
@@ -880,6 +1008,7 @@ class DecodeServer:
                 if not self._slots:
                     break
             return
+        t0 = time.perf_counter()
         tok, pos = self._feed_arrays()
         temp, tk, tp = self._sampling_arrays()
         n = self._step_no
@@ -896,12 +1025,17 @@ class DecodeServer:
                                         jnp.asarray(tok), jnp.asarray(pos))
         toks = np.asarray(toks)  # the block's single device->host fetch
         done = []
+        appended = []
         for slot, st in self._slots.items():
+            kept = 0
             for j in range(block):
                 t = int(toks[slot, j])
                 st["generated"].append(t)
                 st["pos"] += 1
+                kept += 1
                 if self._finished(st, t):
                     done.append(slot)
                     break
+            appended.append((st, kept))
+        self._tel_tokens(appended, t0, steps=block)
         self._retire(done)

@@ -1,0 +1,492 @@
+"""Runtime telemetry layer: serving request tracing, latency histograms,
+recompile watch, exportable timeline (paddle_tpu/telemetry.py).
+
+Coverage per the issue: histogram quantile correctness vs numpy on random
+samples; a serving smoke run leaves TTFT/per-token records and the
+queue-depth gauge returns to 0; the recompile watch fires exactly once on
+a forced cfg-key change and never in steady state; PADDLE_TPU_TELEMETRY=0
+leaves zero records; and an async-parity guard that telemetry does not
+change the fit loop's zero-host-sync drain count."""
+import json
+import os
+import urllib.request
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn, telemetry
+from paddle_tpu.framework import monitor
+from paddle_tpu.hapi import Model
+from paddle_tpu.hapi import model as hapi_model
+from paddle_tpu.text import generate, gpt, serving
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = gpt.GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                        num_heads=2, max_seq_len=32)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _serve(cfg, params, n_req=3, max_new=5, async_=False, block=None,
+           **srv_kwargs):
+    srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=16,
+                               async_dispatch=async_, **srv_kwargs)
+    prompts = np.random.default_rng(0).integers(1, 60, (n_req, 4))
+    rids = [srv.submit(prompts[i], max_new_tokens=max_new)
+            for i in range(n_req)]
+    while srv.pending():
+        srv.tick_block(block) if block else srv.tick()
+    return srv, [srv.result(r) for r in rids]
+
+
+class TestHistogram:
+    def test_quantiles_vs_numpy(self):
+        rng = np.random.default_rng(0)
+        samples = rng.lognormal(mean=2.0, sigma=1.2, size=20000)
+        h = telemetry.Histogram("t")
+        for v in samples:
+            h.observe(v)
+        for q in (0.5, 0.9, 0.99):
+            want = float(np.quantile(samples, q))
+            got = h.quantile(q)
+            # log-spaced buckets (20/decade): one bucket ratio ≈ 12%
+            assert abs(got - want) / want < 0.13, (q, got, want)
+        s = h.summary()
+        assert s["count"] == len(samples)
+        # summary rounds to 6 decimals — compare accordingly
+        np.testing.assert_allclose(s["sum"], samples.sum(), rtol=1e-6)
+        np.testing.assert_allclose(s["min"], samples.min(), atol=1e-6)
+        np.testing.assert_allclose(s["max"], samples.max(), rtol=1e-6)
+
+    def test_weighted_observe_matches_repeats(self):
+        a, b = telemetry.Histogram("a"), telemetry.Histogram("b")
+        for _ in range(7):
+            a.observe(3.5)
+        b.observe(3.5, n=7)
+        assert a.summary() == b.summary()
+
+    def test_constant_memory(self):
+        h = telemetry.Histogram("t")
+        base = len(h._counts)
+        for v in np.random.default_rng(1).uniform(0.001, 1e6, 5000):
+            h.observe(v)
+        assert len(h._counts) == base  # fixed buckets, O(1) memory
+
+    def test_empty_and_extremes(self):
+        h = telemetry.Histogram("t")
+        assert h.quantile(0.5) == 0.0
+        h.observe(0.0)       # <= 0 lands in the first bucket
+        h.observe(1e12)      # beyond the last bound: overflow bucket
+        assert h.summary()["count"] == 2
+        assert h.quantile(0.99) <= 1e12
+
+
+class TestMonitorFloatAndLabels:
+    def test_float_stat(self):
+        s = monitor.get_stat("test.latency_sum", as_float=True)
+        s.add(1.5)
+        s.add(2.25)
+        assert s.get() == pytest.approx(3.75)
+        assert isinstance(monitor.stats()["test.latency_sum"], float)
+
+    def test_int_semantics_preserved(self):
+        s = monitor.get_stat("test.int_counter")
+        s.add(2.9)  # int64 reference semantics: truncates
+        assert s.get() == 2 and isinstance(s.get(), int)
+
+    def test_labels_namespacing(self):
+        s = monitor.get_stat("serving.test_ms", as_float=True, slot=3)
+        s.set(1.0)
+        assert 'serving.test_ms{slot="3"}' in monitor.stats()
+
+
+class TestServingTelemetry:
+    def test_smoke_records_and_gauge_drain(self, tiny_model):
+        cfg, params = tiny_model
+        _, toks = _serve(cfg, params)
+        assert all(len(t) == 5 for t in toks)
+        snap = telemetry.snapshot()
+        h = snap["histograms"]
+        assert h["serving.ttft_ms"]["count"] == 3
+        assert h["serving.e2e_ms"]["count"] == 3
+        # 5 tokens per request, the first arrives at prefill admission
+        assert h["serving.tpot_ms"]["count"] == 3 * 4
+        assert h["serving.queue_wait_ms"]["count"] == 3
+        assert snap["gauges"]["serving.queue_depth"] == 0
+        assert snap["gauges"]["serving.active_slots"] == 0
+        assert snap["counters"]["serving.requests_submitted"] == 3
+        assert snap["counters"]["serving.requests_completed"] == 3
+        assert snap["counters"]["serving.tokens_generated"] == 15
+        assert snap["events"] > 0
+
+    def test_async_and_block_paths_record_and_match_sync(self, tiny_model):
+        cfg, params = tiny_model
+        _, sync_toks = _serve(cfg, params, async_=False, block=4)
+        sync_snap = telemetry.snapshot()
+        telemetry.reset()
+        _, async_toks = _serve(cfg, params, async_=True, block=4)
+        async_snap = telemetry.snapshot()
+        # telemetry must not perturb the token stream (bit-parity)
+        assert sync_toks == async_toks
+        for snap in (sync_snap, async_snap):
+            assert snap["histograms"]["serving.ttft_ms"]["count"] == 3
+            assert snap["histograms"]["serving.tpot_ms"]["count"] > 0
+            assert snap["gauges"]["serving.queue_depth"] == 0
+
+    def test_kv_utilization_gauge_tracks_occupancy(self, tiny_model):
+        cfg, params = tiny_model
+        srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=16)
+        srv.submit([1, 2, 3], max_new_tokens=8)
+        srv.tick()
+        g = telemetry.snapshot()["gauges"]
+        assert g["serving.active_slots"] == 1
+        assert g["serving.slot_occupancy"] == 0.5
+        assert 0 < g["serving.kv_utilization"] <= 1
+        while srv.pending():
+            srv.tick()
+        g = telemetry.snapshot()["gauges"]
+        assert g["serving.active_slots"] == 0
+        assert g["serving.kv_utilization"] == 0
+
+    def test_metrics_port_http_endpoint(self, tiny_model):
+        cfg, params = tiny_model
+        srv, _ = _serve(cfg, params, metrics_port=0)
+        port = srv.metrics_server.port
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+        assert "paddle_tpu_serving_ttft_ms_count" in body
+        assert "_bucket{le=" in body
+        snap = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/snapshot", timeout=10).read())
+        assert snap["histograms"]["serving.ttft_ms"]["count"] == 3
+        srv.close()
+        assert srv.metrics_server is None
+
+
+class TestRecompileWatch:
+    def _step_once(self, cfg, params):
+        cache = generate.init_cache(cfg, 2, 16)
+        fn = serving._get_step_fn(cfg)
+        return fn(params, cache, jnp.zeros((2,), jnp.int32),
+                  jnp.zeros((2,), jnp.int32))
+
+    def test_fires_once_on_key_change_never_in_steady_state(
+            self, tiny_model, monkeypatch):
+        cfg, params = tiny_model
+        serving._STEP_CACHE.clear()
+        generate._GEN_CACHE.clear()
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            self._step_once(cfg, params)   # first compile: expected
+            self._step_once(cfg, params)   # steady state: cache hit
+            assert [x for x in w if "recompile" in str(x.message)] == []
+        monkeypatch.setenv("PADDLE_TPU_DONATE_DECODE", "0")
+        serving._STEP_CACHE.clear()
+        generate._GEN_CACHE.clear()
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            self._step_once(cfg, params)   # forced retrace: flags flipped
+            self._step_once(cfg, params)   # steady again
+            msgs = [x for x in w if "recompile" in str(x.message)]
+            assert len(msgs) == 1
+            assert "'' -> '0'" in str(msgs[0].message)  # the key diff
+        snap = telemetry.snapshot()
+        assert snap["counters"]["compile.recompiles"] == 1
+        assert snap["counters"]["compile.count"] >= 2
+        # every compile carried (name, key, wall time)
+        names = {c["name"] for c in snap["compiles"]}
+        assert "serving.step" in names
+        assert all(c["seconds"] is not None for c in snap["compiles"])
+
+    def test_fresh_config_never_warns(self, tiny_model):
+        cfg, params = tiny_model
+        cfg2 = gpt.GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                             num_heads=2, max_seq_len=64)
+        params2 = gpt.init_params(cfg2, jax.random.PRNGKey(1))
+        serving._STEP_CACHE.clear()
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            self._step_once(cfg, params)
+            self._step_once(cfg2, params2)  # different model, same flags
+            assert [x for x in w if "recompile" in str(x.message)] == []
+
+    def test_rate_limit(self, tiny_model, monkeypatch):
+        cfg, params = tiny_model
+        monkeypatch.setattr(telemetry, "_WARN_INTERVAL_S", 1e9)
+        serving._STEP_CACHE.clear()
+        self._step_once(cfg, params)
+        for flip in ("0", "1", "0"):
+            monkeypatch.setenv("PADDLE_TPU_DONATE_DECODE", flip)
+            serving._STEP_CACHE.clear()
+            generate._GEN_CACHE.clear()
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                self._step_once(cfg, params)
+            if flip == "0" and len(w):   # first flip warned
+                continue
+        snap = telemetry.snapshot()
+        # three flips = three retraces, but the rate limiter allowed at
+        # most one warning; the counter saw them all
+        assert snap["counters"]["compile.recompiles"] == 3
+
+
+class TestDisabled:
+    def test_env_off_leaves_zero_records(self, tiny_model, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_TELEMETRY", "0")
+        telemetry.reset()
+        cfg, params = tiny_model
+        serving._STEP_CACHE.clear()
+        generate._GEN_CACHE.clear()
+        _, toks = _serve(cfg, params)
+        assert all(len(t) == 5 for t in toks)  # serving itself unaffected
+        snap = telemetry.snapshot()
+        assert snap["enabled"] is False
+        assert snap["histograms"] == {}
+        assert snap["gauges"] == {}
+        assert snap["compiles"] == []
+        assert snap["events"] == 0
+        # stats created by earlier (enabled) runs stay registered but
+        # must not have moved
+        assert snap["counters"].get("serving.requests_submitted", 0) == 0
+
+    def test_instrument_compile_returns_raw_fn(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_TELEMETRY", "0")
+        fn = lambda x: x  # noqa: E731
+        assert telemetry.instrument_compile("n", (1,), (), fn) is fn
+
+    @pytest.mark.parametrize("tel", ["0", "1"])
+    def test_trainstep_save_program_both_modes(self, monkeypatch,
+                                               tmp_path, tel):
+        """jax.export must receive the jitted fn in BOTH telemetry modes:
+        with telemetry on the wrapper exposes `_telemetry_inner`; with it
+        off the raw jit result's own __wrapped__ (the un-jitted step_fn)
+        must NOT be unwrapped into export."""
+        from paddle_tpu.jit import TrainStep
+
+        monkeypatch.setenv("PADDLE_TPU_TELEMETRY", tel)
+        X = np.random.default_rng(0).standard_normal((8, 4)) \
+            .astype(np.float32)
+        Y = np.random.default_rng(0).integers(0, 3, 8).astype(np.int64)
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 3))
+        step = TrainStep(net, F.cross_entropy,
+                         paddle.optimizer.SGD(
+                             learning_rate=1e-2,
+                             parameters=net.parameters()))
+        step(X, Y)
+        prefix = str(tmp_path / f"prog{tel}")
+        step.save_program(prefix, X, Y)
+        assert os.path.exists(prefix + ".pdtrain")
+
+
+class TestTrainTelemetry:
+    def test_fit_records_step_histogram_and_host_sync_counter(self):
+        X = np.random.default_rng(0).standard_normal((32, 8)) \
+            .astype(np.float32)
+        Y = np.random.default_rng(0).integers(0, 4, 32).astype(np.int64)
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        m = Model(net)
+        m.prepare(paddle.optimizer.Adam(1e-2,
+                                        parameters=net.parameters()),
+                  F.cross_entropy, async_metrics=True)
+        m.fit((X, Y), batch_size=8, epochs=1, verbose=0, shuffle=False,
+              log_freq=0)
+        snap = telemetry.snapshot()
+        assert snap["histograms"]["train.step_ms"]["count"] == 4
+        assert snap["histograms"]["train.epoch_s"]["count"] == 1
+        assert snap["counters"]["train.steps"] == 4
+        # async + log_freq=0: exactly ONE drain (the epoch mean), and the
+        # telemetry counter sits on the same _host_scalar choke point
+        assert snap["counters"]["train.host_syncs"] == 1
+        assert snap["gauges"]["train.samples_per_s"] > 0
+
+    def test_async_parity_guard_telemetry_does_not_add_host_syncs(
+            self, monkeypatch):
+        """The PR-2 invariant, re-pinned WITH telemetry active: a steady-
+        state async fit epoch drains the device exactly once regardless
+        of step count — telemetry samples host timestamps, never the
+        device."""
+        drains = []
+        real = hapi_model._host_scalar
+        monkeypatch.setattr(hapi_model, "_host_scalar",
+                            lambda x: (drains.append(1), real(x))[1])
+
+        def fit_steps(n):
+            drains.clear()
+            X = np.random.default_rng(0).standard_normal((n, 8)) \
+                .astype(np.float32)
+            Y = np.random.default_rng(0).integers(0, 4, n).astype(np.int64)
+            paddle.seed(0)
+            net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                                nn.Linear(16, 4))
+            m = Model(net)
+            m.prepare(paddle.optimizer.Adam(
+                1e-2, parameters=net.parameters()), F.cross_entropy,
+                async_metrics=True)
+            m.fit((X, Y), batch_size=8, epochs=1, verbose=0,
+                  shuffle=False, log_freq=0)
+            return len(drains)
+
+        assert telemetry.enabled()
+        assert fit_steps(32) == fit_steps(128) == 1
+
+
+class TestExport:
+    def test_jsonl_log_and_merge_timeline(self, tiny_model, monkeypatch,
+                                          tmp_path):
+        log = tmp_path / "serve.jsonl"
+        monkeypatch.setenv("PADDLE_TPU_TELEMETRY_LOG", str(log))
+        cfg, params = tiny_model
+        _serve(cfg, params)
+        telemetry.reset()  # closes the JSONL handle
+        lines = [json.loads(ln) for ln in log.read_text().splitlines()]
+        names = {ln["name"] for ln in lines}
+        assert "serving.request" in names and "serving.prefill" in names
+        assert all("t0" in ln and "t1" in ln for ln in lines)
+
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "merge_timeline", os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(
+                    __file__))), "tools", "merge_timeline.py"))
+        mt = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mt)
+        chrome = tmp_path / "host.json"
+        chrome.write_text(json.dumps({"traceEvents": [
+            {"name": "step", "ph": "X", "pid": 0, "tid": 1,
+             "ts": 1.0, "dur": 2.0}]}))
+        merged = mt.merge([str(chrome), str(log)])
+        evs = merged["traceEvents"]
+        pids = {e["pid"] for e in evs}
+        assert pids == {0, 1}  # one process row per input
+        assert any(e["name"] == "serving.request" and e["ph"] == "X"
+                   for e in evs)
+        out = tmp_path / "merged.json"
+        out.write_text(json.dumps(merged))
+        assert json.loads(out.read_text())["traceEvents"]
+        # --summary quantile table over the same inputs
+        rows = mt.summary([str(chrome), str(log)])
+        by_name = {r["name"]: r for r in rows}
+        assert by_name["serving.request"]["count"] == 3
+        assert by_name["serving.request"]["p50_ms"] > 0
+        mt.print_summary(rows)
+
+    def test_dump_chrome_trace_merges_profiler_events(self, tiny_model,
+                                                      tmp_path):
+        from paddle_tpu import profiler as prof
+
+        cfg, params = tiny_model
+        prof.start_profiler()
+        with prof.RecordEvent("host_work"):
+            _serve(cfg, params)
+        prof.stop_profiler()
+        path = telemetry.dump_chrome_trace(str(tmp_path / "trace.json"))
+        evs = json.load(open(path))["traceEvents"]
+        names = {e["name"] for e in evs}
+        # one Perfetto timeline: profiler host spans (pid 0) next to
+        # telemetry request lifecycles (pid 1)
+        assert "host_work" in names and "serving.request" in names
+        assert {e["pid"] for e in evs if e["ph"] == "X"} == {0, 1}
+
+    def test_render_prometheus_shape(self):
+        telemetry.observe("serving.ttft_ms", 12.5)
+        telemetry.observe("serving.ttft_ms", 40.0)
+        telemetry.set_gauge("serving.queue_depth", 2)
+        telemetry.count("serving.requests_submitted")
+        text = telemetry.render_prometheus()
+        assert "# TYPE paddle_tpu_serving_ttft_ms histogram" in text
+        assert 'paddle_tpu_serving_ttft_ms_bucket{le="+Inf"} 2' in text
+        assert "paddle_tpu_serving_ttft_ms_count 2" in text
+        assert "paddle_tpu_serving_queue_depth 2" in text
+        assert "paddle_tpu_serving_requests_submitted 1" in text
+
+    def test_prometheus_valid_after_snapshot(self):
+        """snapshot() mirrors '<hist>.count'/'<hist>.sum' into the
+        monitor registry; render_prometheus must not re-export them as
+        counter families colliding with the histogram's own _count/_sum
+        samples (duplicate families are invalid exposition)."""
+        telemetry.observe("serving.ttft_ms", 5.0)
+        telemetry.snapshot()  # creates the mirror stats
+        text = telemetry.render_prometheus()
+        sample_names = [ln.split("{")[0].split(" ")[0]
+                        for ln in text.splitlines()
+                        if ln and not ln.startswith("#")]
+        dupes = {n for n in sample_names if sample_names.count(n) > 1
+                 and not n.endswith("_bucket")}
+        assert not dupes, dupes
+
+    def test_span_context_manager(self):
+        with telemetry.span("unit_span", rid=1):
+            pass
+        assert any(e["name"] == "unit_span"
+                   for e in telemetry.chrome_events())
+
+
+class TestProfilerSatellites:
+    def test_record_event_wraps_preserves_metadata(self):
+        from paddle_tpu import profiler as prof
+
+        @prof.RecordEvent("timed")
+        def my_fn(x):
+            """doc."""
+            return x + 1
+
+        assert my_fn.__name__ == "my_fn"
+        assert my_fn.__doc__ == "doc."
+        assert my_fn(1) == 2
+
+    def test_record_event_reentrant_threads(self):
+        import threading
+        import time as _time
+
+        from paddle_tpu import profiler as prof
+
+        prof.start_profiler()
+        shared = prof.RecordEvent("shared")
+
+        def work():
+            for _ in range(10):
+                with shared:
+                    _time.sleep(0.001)
+
+        ts = [threading.Thread(target=work) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        spans = [e for e in prof.host_events() if e[0] == "shared"]
+        prof.stop_profiler()
+        assert len(spans) == 40
+        # per-thread t0: with the old shared-attribute _t0, a sibling
+        # thread's LATER __enter__ clobbers an open span's start, which
+        # shows up as a duration below the 1ms the body slept
+        assert all(t1 - t0 >= 0.0009 for _, t0, t1, _ in spans), \
+            sorted(t1 - t0 for _, t0, t1, _ in spans)[:5]
+
+    def test_record_event_nested_same_instance(self):
+        from paddle_tpu import profiler as prof
+
+        prof.start_profiler()
+        ev = prof.RecordEvent("nest")
+        with ev:
+            with ev:
+                pass
+        rows = {r["name"]: r for r in prof.stop_profiler()}
+        assert rows["nest"]["calls"] == 2

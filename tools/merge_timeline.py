@@ -1,35 +1,142 @@
 #!/usr/bin/env python
-"""Merge per-host chrome traces into one timeline.
+"""Merge chrome traces AND telemetry JSONL event logs into one timeline.
 
 Reference capability: tools/CrossStackProfiler (multi-node timeline merger).
-Each host's paddle_tpu.profiler chrome-trace export becomes a distinct
-process row (pid = host index, labeled), preserving per-host thread rows.
+Each input becomes a distinct process row (pid = input index, labeled),
+preserving per-input thread rows.  Inputs may be:
 
-Usage: python tools/merge_timeline.py out.json host0.json host1.json ...
+- chrome-trace JSON (``paddle_tpu.profiler`` / ``telemetry
+  .dump_chrome_trace`` exports, or a ``jax.profiler`` trace converted to
+  chrome format) — ``.json`` with a ``traceEvents`` list;
+- telemetry JSONL event logs (``PADDLE_TPU_TELEMETRY_LOG``) — one span
+  per line, converted to chrome 'X' events (tid = the span's slot/tid).
+
+The merged file loads in Perfetto (ui.perfetto.dev) / chrome://tracing:
+one timeline with serving request lifecycles next to profiler host spans
+and device traces.
+
+Usage:
+    python tools/merge_timeline.py out.json in0.json serve.jsonl ...
+    python tools/merge_timeline.py --summary serve.jsonl [more inputs]
+
+``--summary`` prints a per-span-name quantile table (count / p50 / p90 /
+p99 / total ms) instead of writing a merge.
 """
 import json
 import sys
 
 
+def _jsonl_events(path):
+    """Telemetry JSONL spans -> chrome 'X' events (µs timestamps)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # truncated tail of a killed writer — skip
+            if "t0" not in rec or "t1" not in rec:
+                continue  # non-span line (snapshots etc.) — skip
+            ev = {"name": rec.get("name", "?"), "ph": "X",
+                  "tid": rec.get("tid", 0), "ts": rec["t0"] * 1e6,
+                  "dur": (rec["t1"] - rec["t0"]) * 1e6}
+            if rec.get("args"):
+                ev["args"] = rec["args"]
+            out.append(ev)
+    return out
+
+
+def _is_jsonl(path):
+    if path.endswith(".jsonl"):
+        return True
+    # bounded sniff: a chrome trace (possibly one enormous line) must not
+    # be read/parsed whole just to classify it — a span line is tiny, so
+    # only a short first line that parses as a {t0, t1} record counts
+    with open(path) as f:
+        head = f.readline(65536).strip()
+    if not head.startswith("{") or not head.endswith("}"):
+        return False
+    try:
+        rec = json.loads(head)
+    except json.JSONDecodeError:
+        return False
+    return "t0" in rec and "t1" in rec
+
+
+def load_events(path):
+    """One input file -> a list of chrome events (pid unset)."""
+    if _is_jsonl(path):
+        return _jsonl_events(path)
+    with open(path) as f:
+        data = json.load(f)
+    evs = data["traceEvents"] if isinstance(data, dict) else data
+    return [dict(e) for e in evs]
+
+
 def merge(paths):
     events = []
     for hi, path in enumerate(paths):
-        with open(path) as f:
-            data = json.load(f)
-        evs = data["traceEvents"] if isinstance(data, dict) else data
         events.append({"name": "process_name", "ph": "M", "pid": hi,
                        "args": {"name": f"host{hi}:{path}"}})
-        for e in evs:
-            e = dict(e)
+        for e in load_events(path):
             e["pid"] = hi
             events.append(e)
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
+def _quantile(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
+def summary(paths):
+    """Per-name duration table over every span in the inputs (ms)."""
+    durs = {}
+    for path in paths:
+        for e in load_events(path):
+            if e.get("ph") not in (None, "X") or "dur" not in e:
+                continue
+            durs.setdefault(e.get("name", "?"), []).append(
+                e["dur"] / 1e3)
+    rows = []
+    for name in sorted(durs):
+        vs = sorted(durs[name])
+        rows.append({"name": name, "count": len(vs),
+                     "p50_ms": round(_quantile(vs, 0.50), 3),
+                     "p90_ms": round(_quantile(vs, 0.90), 3),
+                     "p99_ms": round(_quantile(vs, 0.99), 3),
+                     "total_ms": round(sum(vs), 3)})
+    return rows
+
+
+def print_summary(rows, out=sys.stdout):
+    cols = ["name", "count", "p50_ms", "p90_ms", "p99_ms", "total_ms"]
+    widths = {c: max(len(c), *(len(str(r[c])) for r in rows)) if rows
+              else len(c) for c in cols}
+    line = "  ".join(c.ljust(widths[c]) for c in cols)
+    print(line, file=out)
+    print("-" * len(line), file=out)
+    for r in rows:
+        print("  ".join(str(r[c]).ljust(widths[c]) for c in cols),
+              file=out)
+
+
 if __name__ == "__main__":
-    if len(sys.argv) < 3:
+    argv = sys.argv[1:]
+    if argv and argv[0] == "--summary":
+        ins = argv[1:]
+        if not ins:
+            raise SystemExit(__doc__)
+        print_summary(summary(ins))
+        sys.exit(0)
+    if len(argv) < 2:
         raise SystemExit(__doc__)
-    out, *ins = sys.argv[1:]
+    out, *ins = argv
     with open(out, "w") as f:
         json.dump(merge(ins), f)
-    print(f"merged {len(ins)} host traces -> {out}")
+    print(f"merged {len(ins)} inputs -> {out}")
