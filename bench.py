@@ -30,15 +30,6 @@ import subprocess
 import sys
 import time
 
-# bf16 peak FLOPs per chip by device kind (dense MXU)
-_PEAK = {
-    "v4": 275e12,
-    "v5p": 459e12,
-    "v5 lite": 197e12,
-    "v5e": 197e12,
-    "v6": 918e12,
-    "trillium": 918e12,
-}
 _A100_MFU_BAR = 0.45
 
 
@@ -119,15 +110,104 @@ def _probe_evidence(n=12):
         return []
 
 
-def _peak_flops(dev) -> float:
-    kind = (getattr(dev, "device_kind", "") or "").lower()
-    for k, v in _PEAK.items():
-        if k in kind:
-            return v
-    env_gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
-    if env_gen in _PEAK:
-        return _PEAK[env_gen]
-    return 459e12 if dev.platform in ("tpu", "axon") else 1e12
+# Every bench JSON line carries this block (MLPerf-style reporting: a
+# number without its measurement conditions is not a result).  The keys
+# are the schema — tools/bench_history.py and the CI smoke validate them.
+_PROVENANCE_KEYS = ("ts", "platform", "device_kind", "jax", "jaxlib",
+                    "python", "git_rev", "fallback_reason", "probe_wedge",
+                    "certified_families", "flags")
+
+
+def _git_rev():
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+            text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        return out.stdout.strip() or None
+    except Exception:  # noqa: BLE001 - provenance is evidence, not a gate
+        return None
+
+
+def _provenance(dev=None, fallback_reason=None) -> dict:
+    """The provenance block: where/how THIS bench process measured —
+    platform + chip kind, jax/jaxlib versions, the source git rev, why a
+    fallback happened (None = ran on the requested backend), timestamped
+    probe-wedge evidence, the fresh certification families, and the
+    PADDLE_TPU_* flag environment.  ``platform`` is always the backend
+    of the RUNNING process: a replayed watchdog headline keeps device=
+    "tpu" in its own fields while provenance says this run was on CPU —
+    that disagreement IS the information (BENCH_r02–r05 shipped without
+    it and read as TPU numbers)."""
+    plat = kind = None
+    if dev is not None:
+        plat = dev.platform
+        kind = str(getattr(dev, "device_kind", ""))
+    jv = jlv = None
+    try:
+        import jax
+        import jaxlib
+
+        jv, jlv = jax.__version__, jaxlib.__version__
+        if dev is None:
+            d = jax.devices()[0]
+            plat = d.platform
+            kind = str(getattr(d, "device_kind", ""))
+    except Exception:  # noqa: BLE001 - a jax-free caller still gets a block
+        pass
+    return {
+        "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
+            timespec="seconds"),
+        "platform": plat, "device_kind": kind,
+        "jax": jv, "jaxlib": jlv,
+        "python": sys.version.split()[0],
+        "git_rev": _git_rev(),
+        "fallback_reason": fallback_reason,
+        "probe_wedge": _recent_probe_wedge() or None,
+        "certified_families": sorted(_certified_families(kind or None)),
+        "flags": {k: v for k, v in sorted(os.environ.items())
+                  if k.startswith("PADDLE_TPU_")
+                  or k in ("PALLAS_AXON_TPU_GEN", "JAX_PLATFORMS")},
+    }
+
+
+def _stamp_provenance(rec, dev=None, fallback_reason=None):
+    """Attach the provenance block to a bench record (in place).  An
+    existing block is preserved — a child process stamped it on the
+    backend that actually measured; only ``fallback_reason`` may be
+    filled in later (the parent learns about the fallback, the child
+    doesn't)."""
+    if not isinstance(rec, dict):
+        return rec
+    prov = rec.get("provenance")
+    if isinstance(prov, dict):
+        if fallback_reason and not prov.get("fallback_reason"):
+            prov["fallback_reason"] = fallback_reason
+        return rec
+    rec["provenance"] = _provenance(dev, fallback_reason)
+    return rec
+
+
+def _peak_flops(dev):
+    """bf16 peak FLOPs/s for the chip, or None when unknown — the table
+    lives in paddle_tpu.framework.platform.DEVICE_PEAKS (shared with the
+    telemetry device feed's live MFU gauges).  None means every MFU
+    derived from it reports null: an unrecognized chip (or a CPU
+    fallback) must never produce a fabricated percentage (the old
+    459e12-for-anything-TPU default did exactly that)."""
+    from paddle_tpu.framework.platform import peak_flops
+
+    return peak_flops(getattr(dev, "device_kind", "") or "",
+                      platform=getattr(dev, "platform", None))
+
+
+def _mfu_fields(mfu) -> dict:
+    """The (mfu, vs_baseline) pair, null-safe: unknown peak -> mfu null
+    and vs_baseline 0.0 (never a number made up from a guessed peak)."""
+    if mfu is None:
+        return {"mfu": None, "vs_baseline": 0.0}
+    return {"mfu": round(mfu, 4),
+            "vs_baseline": round(mfu / _A100_MFU_BAR, 4)}
 
 
 def _sync_all(trees):
@@ -639,11 +719,14 @@ def _run_gpt_rung(idx: int):
 
     dt = _time_steps(one, iters, lambda: (st["state"], st["loss"]))
     tok_s = B * T / dt
-    mfu = gpt.flops_per_token(cfg, T) * tok_s / _peak_flops(dev)
+    peak = _peak_flops(dev)
+    achieved = gpt.flops_per_token(cfg, T) * tok_s  # peak-independent
+    mfu = (achieved / peak) if peak else None
     _log(f"[bench] {name}: {tok_s:,.0f} tok/s  step={dt * 1e3:.1f}ms  "
-         f"loss={float(st['loss']):.4f}  MFU={mfu:.3f}  "
+         f"loss={float(st['loss']):.4f}  "
+         f"MFU={'null (unknown peak)' if mfu is None else f'{mfu:.3f}'}  "
          f"device={dev.device_kind}")
-    if dev.platform in ("tpu", "axon") and mfu >= 1.0:
+    if mfu is not None and dev.platform in ("tpu", "axon") and mfu >= 1.0:
         # >=100% of peak is physically impossible: the timing barrier
         # failed to cover execution (exactly how the round-4 window-1
         # number went wrong).  Fail the rung so a broken measurement can
@@ -663,13 +746,18 @@ def _run_gpt_rung(idx: int):
            # measurement downstream (watchdog kernel A/B, ablation joins)
            "device": dev.platform,
            "device_kind": str(getattr(dev, "device_kind", "")),
-           "step_ms": round(dt * 1e3, 2), "mfu": round(mfu, 4),
+           "step_ms": round(dt * 1e3, 2),
+           # achieved model FLOPs/s: computable on ANY chip (no peaks
+           # table needed) — the tournament orders rungs by this, so an
+           # unknown chip kind (every mfu null) still headlines the rung
+           # that did the most work, not whichever ran first
+           "flops_per_s": round(achieved, 1),
            "remat": bool(cfg.remat),  # configs are NOT comparable across
            "remat_policy": _effective_remat_policy(cfg) if cfg.remat
            else None,
            "state_dtype": state_dtype, "accum": accum,
            "fused_kernels": fused,
-           "vs_baseline": round(mfu / _A100_MFU_BAR, 4)}
+           **_mfu_fields(mfu)}
     if idx >= 0:
         out["hbm_est_gb"] = round(_gpt_rung_estimate(
             cfg_kwargs, B, T, state_dtype, accum, fused) / 1e9, 2)
@@ -681,7 +769,7 @@ def _run_gpt_rung(idx: int):
         out["hbm_peak_gb"] = round(stats["peak_bytes_in_use"] / 1e9, 2)
     if _no_flash_requested():
         out["flash"] = False
-    return out
+    return _stamp_provenance(out, dev)
 
 
 def extract_oom_line(stderr: str) -> str:
@@ -1030,6 +1118,21 @@ def _decode_smoke():
                 f"telemetry smoke: queue_depth gauge did not return to 0 "
                 f"({snap['gauges']})")
         rec["telemetry"] = _tl.latency_summary("serving.")
+        if flags.device_feed_enabled():
+            # the device feed must be NON-NULL after a serving pass:
+            # per-compiled-step FLOPs captured at instrument_compile
+            # time (cost analysis works on the CPU jit too) — a feed
+            # regression fails CI here, not on a TPU window
+            feed = snap.get("device", {})
+            with_flops = sorted(n for n, s in feed.get("steps", {}).items()
+                                if s.get("flops"))
+            if not with_flops:
+                raise AssertionError(
+                    f"device feed is dark after a serving pass: no "
+                    f"compiled step carries FLOPs "
+                    f"(steps: {sorted(feed.get('steps', {}))})")
+            rec["device_feed"] = {"steps": with_flops,
+                                  "platform": feed.get("platform")}
     return rec
 
 
@@ -1040,6 +1143,16 @@ def bench_gpt(small: bool):
         # training hot path rides the same CI smoke: grad-accum + async +
         # prefetch fit parity vs the sync loop (BENCH gets a train number)
         rec["train_smoke"] = _train_smoke()
+        # provenance-schema gate (CI): a bench line whose provenance
+        # block is missing or incomplete must fail the smoke — a silent
+        # CPU fallback can never again ship as an unlabeled number
+        prov = rec.get("provenance")
+        missing = [k for k in _PROVENANCE_KEYS
+                   if not isinstance(prov, dict) or k not in prov]
+        if missing:
+            raise AssertionError(
+                f"provenance block missing keys {missing} "
+                f"(block: {prov!r})")
         return rec
 
     # full ladder: one subprocess per rung so a hung/slow remote compile
@@ -1117,7 +1230,12 @@ def bench_gpt(small: bool):
         _log(f"[bench] {fail}; trying next rung")
         last_fail = fail
     if results:
-        best = max(results, key=lambda r: r.get("mfu", 0.0))
+        # achieved FLOPs/s orders identically to MFU on one chip (same
+        # peak divisor) and stays defined when the chip kind is unknown
+        # (mfu null for every rung); mfu is the legacy fallback for
+        # records that predate the field
+        best = max(results, key=lambda r: (r.get("flops_per_s")
+                                           or r.get("mfu") or 0.0))
         if len(results) > 1:
             best = dict(best)
             best["candidates"] = [
@@ -1270,17 +1388,18 @@ def bench_bert(small: bool):
     per_tok = 6 * L * (4 * D * D + 2 * D * F) + 12 * L * D * T
     per_seq = per_tok * T + 6 * (V * D + D * D) * K
     samp_s = B / dt
-    mfu = per_seq * samp_s / _peak_flops(dev)
+    peak = _peak_flops(dev)
+    mfu = (per_seq * samp_s / peak) if peak else None
     _log(f"[bench] bert_base: {samp_s:,.1f} seq/s ({samp_s * T:,.0f} tok/s) "
-         f"step={dt * 1e3:.1f}ms loss={float(st['l']):.4f} MFU={mfu:.3f}")
-    if dev.platform in ("tpu", "axon") and mfu >= 1.0:
+         f"step={dt * 1e3:.1f}ms loss={float(st['l']):.4f} "
+         f"MFU={'null' if mfu is None else f'{mfu:.3f}'}")
+    if mfu is not None and dev.platform in ("tpu", "axon") and mfu >= 1.0:
         raise RuntimeError(f"implausible MFU {mfu:.1f} — timing sync is "
                            f"not covering device execution")
     return {"metric": "sequences_per_sec_per_chip_bert_base",
             "value": round(samp_s, 2), "unit": "sequences/s/chip",
             "device": dev.platform, "step_ms": round(dt * 1e3, 2),
-            "mfu": round(mfu, 4),
-            "vs_baseline": round(mfu / _A100_MFU_BAR, 4)}
+            **_mfu_fields(mfu)}
 
 
 def _layer_train_bench(name, net, X, Y, iters, lr=0.01, flops_per_step=None,
@@ -1326,15 +1445,17 @@ def _layer_train_bench(name, net, X, Y, iters, lr=0.01, flops_per_step=None,
            "device": dev.platform, "step_ms": round(dt * 1e3, 2),
            "vs_baseline": 0.0}
     if flops_per_step is not None:
-        mfu = flops_per_step / dt / _peak_flops(dev)
-        if dev.platform in ("tpu", "axon") and mfu >= 1.0:
+        peak = _peak_flops(dev)
+        mfu = (flops_per_step / dt / peak) if peak else None
+        if mfu is not None and dev.platform in ("tpu", "axon") \
+                and mfu >= 1.0:
             raise RuntimeError(f"implausible MFU {mfu:.1f} — timing sync "
                                f"is not covering device execution")
-        out["mfu"] = round(mfu, 4)
-        out["vs_baseline"] = round(mfu / _A100_MFU_BAR, 4)
+        out.update(_mfu_fields(mfu))
     _log(f"[bench] {name}: {samp_s:,.1f} samples/s step={dt * 1e3:.1f}ms "
          f"loss={float(loss_box['l'].value):.4f}"
-         + (f" MFU={out['mfu']:.3f}" if "mfu" in out else ""))
+         + (f" MFU={out['mfu']:.3f}"
+            if out.get("mfu") is not None else ""))
     return out
 
 
@@ -1542,7 +1663,7 @@ def bench_decode(small: bool):
         rec = dict({"arm": sel}, **tok_s(makers[sel]()))
         if sel == "int4":
             rec["w4"] = _w4_stats()
-        return rec
+        return _stamp_provenance(rec, dev)
     out = {"metric": "tokens_per_sec_decode_gpt350m_int8w",
            "unit": "tokens/s/chip", "device": dev.platform,
            "vs_baseline": 0.0}
@@ -1895,7 +2016,7 @@ def bench_serving(small: bool):
         rec = dict({"arm": sel}, **tok_s(serving_tree(makers[sel]())))
         if sel == "int4":
             rec["w4"] = _w4_stats()
-        return rec
+        return _stamp_provenance(rec, dev)
     out = {"metric": "tokens_per_sec_serving_gpt350m_bf16",
            "unit": "tokens/s/chip",
            "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
@@ -2007,7 +2128,9 @@ def main():
                 r = json.loads(out.stdout.strip().splitlines()[-1])
                 r["metric"] += "_cpu_fallback"
                 r["vs_baseline"] = 0.0
-                return r
+                return _stamp_provenance(
+                    r, None, f"GPT ladder failed ({type(e).__name__}); "
+                             f"CPU smoke stood in")
             raise
 
     results = {}
@@ -2074,6 +2197,10 @@ def main():
                     import traceback
                     traceback.print_exc(file=sys.stderr)
                     results[name] = {"error": f"{type(e).__name__}: {e}"}
+            _stamp_provenance(
+                results[name], dev,
+                "backend probe failed; pinned JAX_PLATFORMS=cpu"
+                if cpu_fallback else None)
             # write INCREMENTALLY — reused entries included (there is no
             # post-loop rewrite any more; a reuse `continue` that skipped
             # this write would leave the entry out of the final file): a
@@ -2097,6 +2224,7 @@ def main():
     # can only stand in for a run that asked for exactly that
     plain_run = (which is None and "--small" not in argv
                  and not _no_flash_requested())
+    fallback_reason = None
     if cpu_fallback:
         wd = _watchdog_tpu_result() if plain_run else None
         if wd is not None:
@@ -2109,12 +2237,19 @@ def main():
             line = _headline_from_watchdog(
                 wd, "tpu_watchdog" if wd.get("step") == "ladder"
                 else "tpu_watchdog_fast_headline")
+            fallback_reason = (
+                f"tunnel wedged in this run; replayed the watchdog "
+                f"{wd.get('step')} headline measured at "
+                f"{wd.get('measured_at')} — this process ran on CPU")
         else:
             line["metric"] += "_cpu_fallback"
             line["vs_baseline"] = 0.0
             # the missing TPU number must be ATTRIBUTABLE: timestamped probe
             # outcomes (every failed enumeration/compile) ride along
             line["probe_evidence"] = _probe_evidence()
+            fallback_reason = ("backend probe failed; pinned "
+                               "JAX_PLATFORMS=cpu")
+    _stamp_provenance(line, dev, fallback_reason)
     print(json.dumps(line), flush=True)
 
 
@@ -2153,9 +2288,15 @@ def _watchdog_tpu_result(path=None):
             age = (datetime.datetime.now(datetime.timezone.utc)
                    - datetime.datetime.fromisoformat(measured)
                    ).total_seconds()
+            # on-device evidence: vs_baseline > 0 (known chip) OR an
+            # explicit device stamp — an unrecognized chip kind now
+            # yields mfu null / vs_baseline 0.0 by design (honest
+            # unknown peak), and that must not disqualify a genuinely
+            # measured TPU headline from replay
             if (age < 24 * 3600
                     and "_cpu_fallback" not in head.get("metric", "")
-                    and head.get("vs_baseline", 0) > 0):
+                    and (head.get("vs_baseline", 0) > 0
+                         or head.get("device") in ("tpu", "axon"))):
                 # "step" lets callers label provenance honestly — a
                 # fast_headline number is a one-rung provisional, not the
                 # tournament result

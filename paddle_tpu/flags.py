@@ -188,6 +188,52 @@ def telemetry_enabled() -> bool:
     return v not in ("0", "false", "off", "no")
 
 
+def device_feed_enabled() -> bool:
+    """Device-truth telemetry feed (ON by default, nested under the
+    telemetry master switch).
+
+    When on, every jit-cache miss routed through
+    ``telemetry.instrument_compile`` also captures the executable's
+    ``cost_analysis``/``memory_analysis`` (per-step FLOPs, HBM bytes
+    moved, argument/output/temp sizes) so ``telemetry.snapshot()`` can
+    derive live MFU and roofline gauges, and the serving/fit hot paths
+    sample PJRT device memory stats at a rate-limited cadence.  The
+    capture costs one extra lowering per compiled executable — never per
+    step.  The memory-analysis half additionally needs an AOT recompile,
+    paid only where cheap/amortized: on CPU, when the persistent compile
+    cache is configured (``DecodeServer.warmup`` configures it), or
+    under an explicit ``PADDLE_TPU_DEVICE_FEED=full``; otherwise the
+    feed carries FLOPs/bytes from the lowering's cost analysis alone.
+    ``PADDLE_TPU_DEVICE_FEED=0`` is the escape hatch; like the telemetry
+    master it never changes a compiled program, only host bookkeeping."""
+    return device_feed_mode() != "off"
+
+
+def device_feed_mode() -> str:
+    """'off' | 'on' | 'full' — the one parse of ``PADDLE_TPU_DEVICE_FEED``
+    (telemetry's capture gate and :func:`device_feed_enabled` both read
+    it here, so the value set can't diverge between the two sites)."""
+    if not telemetry_enabled():
+        return "off"
+    v = os.environ.get("PADDLE_TPU_DEVICE_FEED", "1").strip().lower()
+    if v in ("0", "false", "off", "no"):
+        return "off"
+    return "full" if v == "full" else "on"
+
+
+def hbm_sample_interval_s() -> float:
+    """Minimum seconds between PJRT ``memory_stats()`` samples on the
+    hot paths (``PADDLE_TPU_HBM_SAMPLE_MS``, default 500).  The stats
+    call is a host-side PJRT query — not a device sync — but through a
+    remote tunnel it is still an RPC, so the hot-path sites rate-limit
+    it here."""
+    try:
+        return max(0.0, float(os.environ.get("PADDLE_TPU_HBM_SAMPLE_MS",
+                                             "500"))) / 1e3
+    except ValueError:
+        return 0.5
+
+
 def telemetry_log() -> str | None:
     """``PADDLE_TPU_TELEMETRY_LOG=<path>``: append every telemetry span
     as one JSON line (consumed by ``tools/merge_timeline.py`` to build a

@@ -111,13 +111,20 @@ def reset_all() -> None:
     StatRegistry.instance().reset_all()
 
 
-def snapshot_device_stats() -> dict[str, int]:
+def snapshot_device_stats(devices=None) -> dict[str, int]:
     """Fold PJRT per-device memory stats into the registry
-    (STAT_gpuN_mem analog: stat 'device{i}_bytes_in_use' etc.)."""
-    import jax
+    (STAT_gpuN_mem analog: stat 'device{i}_bytes_in_use' etc.).
 
+    ``devices`` overrides the sampled device list (anything with a
+    ``memory_stats()`` method — tests inject fakes; None = every local
+    jax device).  Backends without memory stats (CPU) contribute
+    nothing — the return is {} and no stat is written."""
+    if devices is None:
+        import jax
+
+        devices = jax.local_devices()
     out = {}
-    for i, d in enumerate(jax.local_devices()):
+    for i, d in enumerate(devices):
         ms = d.memory_stats() or {}
         for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
             if k in ms:

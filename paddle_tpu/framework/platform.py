@@ -13,6 +13,63 @@ import re
 
 _COUNT_FLAG = re.compile(r"--xla_force_host_platform_device_count=(\d+)")
 
+# Per-chip peaks keyed on a ``device_kind`` substring (lowercased match):
+# (dense-MXU bf16 peak FLOPs/s, HBM bandwidth bytes/s).  The single source
+# of truth for every MFU / roofline computation — bench.py and
+# telemetry's device feed both read it, so a headline MFU and the live
+# gauge can never disagree about what "peak" means.  There is
+# deliberately NO catch-all TPU entry: a chip kind not listed here gets
+# (None, None) and MFU reports as null — an honest "unknown" beats a
+# fabricated percentage (the old 459e12-for-anything-TPU fallback made
+# CPU-fallback numbers look like plausible MFUs).
+DEVICE_PEAKS: dict = {
+    "v4": (275e12, 1.23e12),
+    "v5p": (459e12, 2.77e12),
+    "v5 lite": (197e12, 0.82e12),
+    "v5e": (197e12, 0.82e12),
+    "v6 lite": (918e12, 1.64e12),
+    "v6e": (918e12, 1.64e12),
+    "v6": (918e12, 1.64e12),
+    "trillium": (918e12, 1.64e12),
+}
+
+
+def device_peaks(device_kind: str | None = None,
+                 platform: str | None = None) -> tuple:
+    """(peak_flops, peak_hbm_bytes_per_s) for a chip kind, resolved by
+    substring against :data:`DEVICE_PEAKS`; the ``PALLAS_AXON_TPU_GEN``
+    env var stands in when the kind string is empty/unrecognized (the
+    tunnel sometimes reports an opaque kind).  Unknown -> (None, None):
+    callers must treat MFU as unknowable, not guess.
+
+    ``platform`` (the jax device's ``.platform`` — pass it when you have
+    the device) hard-gates the env hint: a non-TPU platform never picks
+    up TPU peaks, so a CPU-fallback run with ``PALLAS_AXON_TPU_GEN``
+    still exported (the normal tunnel environment) cannot fabricate a
+    TPU-peak MFU.  The kind-substring guard below covers callers that
+    only have the kind string."""
+    plat = (platform or "").lower()
+    if plat and plat not in ("tpu", "axon"):
+        return (None, None)
+    kind = (device_kind or "").lower()
+    for k, peaks in DEVICE_PEAKS.items():
+        if k in kind:
+            return peaks
+    if "cpu" in kind or "gpu" in kind:
+        return (None, None)
+    env_gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    if env_gen:
+        for k, peaks in DEVICE_PEAKS.items():
+            if k in env_gen:
+                return peaks
+    return (None, None)
+
+
+def peak_flops(device_kind: str | None = None,
+               platform: str | None = None):
+    """bf16 peak FLOPs/s for a chip kind, or None when unknown."""
+    return device_peaks(device_kind, platform)[0]
+
 _cache_inited: str | None = None
 
 

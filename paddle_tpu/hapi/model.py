@@ -329,6 +329,8 @@ class Model:
                 batches = iter(pf)
             t_epoch0 = time.perf_counter()
             samples = 0
+            tel_steps = 0
+            tel_step_s = 0.0  # sum of honest per-step walls (sync mode)
             try:
                 for step, batch in enumerate(batches):
                     t_step0 = time.perf_counter() if tel else 0.0
@@ -359,13 +361,20 @@ class Model:
                             loss_rep = _host_scalar(loss_t)
                             losses.append(loss_rep)
                     if tel:
-                        _telemetry.observe(
-                            "train.step_ms",
-                            (time.perf_counter() - t_step0) * 1e3)
+                        step_wall = time.perf_counter() - t_step0
+                        _telemetry.observe("train.step_ms",
+                                           step_wall * 1e3)
                         _telemetry.count("train.steps")
+                        tel_steps += 1
+                        tel_step_s += step_wall
                         shp = getattr(batch[0], "shape", None)
                         if shp:
                             samples += int(shp[0])
+                        if drain:
+                            # drain boundary: the loop just paid a host
+                            # fetch anyway — sample the (rate-limited)
+                            # PJRT memory stats here, never mid-stride
+                            _telemetry.sample_device_stats()
                     logs = {"loss": loss_rep}
                     if out is not None and self._metrics:
                         saw_outputs = True
@@ -398,6 +407,24 @@ class Model:
             else:
                 epoch_logs = {"loss": float(np.mean(losses))
                               if losses else 0.0}
+            if tel:
+                if tel_steps and dynamic:
+                    # device feed: joined with the captured TrainStep
+                    # FLOPs into live MFU.  Sync mode: the in-loop
+                    # per-step walls are honest (each includes its host
+                    # fetch) and exclude data-loading/callback overhead.
+                    # Async mode: those walls only measure DISPATCH, so
+                    # the only honest window is the whole epoch measured
+                    # AFTER the loss fetch above (a wall that doesn't
+                    # cover the drain would inflate the gauge).  Dynamic
+                    # path only: the static-graph adapter runs a
+                    # different executable than jit.TrainStep, and its
+                    # walls must not masquerade under that name.
+                    wall = (tel_step_s if not use_async
+                            else time.perf_counter() - t_epoch0)
+                    _telemetry.note_step_time("jit.TrainStep",
+                                              wall / tel_steps)
+                _telemetry.sample_device_stats()
             if saw_outputs:
                 for m in self._metrics:
                     epoch_logs.update(_metric_logs(m, prefix="train_"))

@@ -123,6 +123,20 @@ class profiler:
         return False
 
 
+def capture_device_trace(ms: float = 500.0,
+                         log_dir: str | None = None) -> str:
+    """On-demand device-trace capture (the fluid-profiler-shaped entry
+    to the telemetry layer's ``capture_device_profile``): start a
+    ``jax.profiler`` XPlane trace, let ``ms`` milliseconds of live
+    traffic run, stop, and return the trace dir.  The same capture the
+    metrics endpoint serves as ``POST /profile?ms=...`` — the reference
+    enabled its CUPTI device tracer this way (EnableProfiler around a
+    window of work)."""
+    from . import telemetry as _telemetry
+
+    return _telemetry.capture_device_profile(ms, log_dir)
+
+
 def host_events() -> list:
     """Snapshot of the recorded host spans as (name, t0, t1, tid) tuples
     (``time.perf_counter`` seconds) — the telemetry layer merges these

@@ -57,7 +57,8 @@ __all__ = [
     "event", "span", "record_compile", "instrument_compile", "snapshot",
     "latency_summary", "render_prometheus", "serve_metrics",
     "chrome_events", "dump_chrome_trace", "Histogram", "Gauge",
-    "MetricsServer",
+    "MetricsServer", "note_step_time", "sample_device_stats",
+    "device_feed", "probe_health", "capture_device_profile",
 ]
 
 
@@ -203,6 +204,27 @@ _log_fh = None
 _log_path: str | None = None
 _counter_names: set[str] = set()
 
+# ---------------------------------------------------------------------------
+# device feed state: per-executable cost/memory analyses + step-time EWMAs
+# ---------------------------------------------------------------------------
+# Analyses are COMPILE-TIME facts captured once per jit-cache miss; they
+# share the lifetime of the compiled executables (which reset() does not
+# drop either — the instrument wrappers never re-capture), so reset()
+# clears only the measurement state (_step_times / _hbm_last).
+_device_lock = threading.Lock()
+_step_analysis: dict[str, dict] = {}      # instrument name -> analysis
+_step_times: dict[str, dict] = {}         # instrument name -> ewma state
+# names whose NEXT noted wall overlapped the compiling first call — that
+# wall is compile-dominated and must not seed the step-time EWMA (a
+# bucket hit exactly once would otherwise export a ~100x-low MFU forever)
+_skip_first_wall: set = set()
+_device_info: dict = {}                   # platform/device_kind, jax live
+_hbm_last: dict = {}                      # last sample_device_stats result
+_hbm_state = {"t": 0.0}
+# EWMA weight for step walls: ~last 8 calls dominate — responsive to a
+# batch-size change without one cold outlier owning the gauge
+_STEP_EWMA_ALPHA = 0.25
+
 # recompile watch state: per (name, flagless key) the last-seen flags key
 _compile_lock = threading.Lock()
 _compile_seen: dict[tuple, tuple] = {}
@@ -279,6 +301,15 @@ def reset() -> None:
         _compile_seen.clear()
         _compile_log.clear()
         _warn_last.clear()
+    with _device_lock:
+        # measurement state only: the captured cost/memory analyses are
+        # compile-time facts tied to executables reset() doesn't drop
+        # (the instrument wrappers capture exactly once) — clearing them
+        # would leave the device feed permanently dark after a reset
+        _step_times.clear()
+        _skip_first_wall.clear()
+        _hbm_last.clear()
+        _hbm_state["t"] = 0.0
 
 
 # ---------------------------------------------------------------------------
@@ -321,6 +352,20 @@ def event(name: str, t0: float, t1: float, tid: int = 0, **args) -> None:
     _jsonl_write(rec)
 
 
+def _counter_event(name: str, values: dict) -> None:
+    """Record a Perfetto COUNTER sample (chrome 'C' phase): the HBM
+    gauges land on the merged timeline as counter tracks next to the
+    request spans.  Same ring buffer + JSONL sinks as :func:`event`;
+    consumers that only understand spans skip these (no t0/t1)."""
+    if not enabled() or not values:
+        return
+    rec = {"name": name, "ph": "C", "t": time.perf_counter(),
+           "args": {k: float(v) for k, v in values.items()}}
+    with _lock:
+        _events.append(rec)
+    _jsonl_write(rec)
+
+
 @contextlib.contextmanager
 def span(name: str, tid: int = 0, **args):
     """``with telemetry.span("prefill", rid=3): ...`` — records an event
@@ -342,6 +387,11 @@ def chrome_events(pid: int = 1) -> list:
     with _lock:
         events = list(_events)
     for e in events:
+        if e.get("ph") == "C":  # counter sample (HBM gauges)
+            out.append({"name": e["name"], "ph": "C", "pid": pid,
+                        "tid": 0, "ts": e["t"] * 1e6,
+                        "args": e.get("args", {})})
+            continue
         ev = {"name": e["name"], "ph": "X", "pid": pid, "tid": e["tid"],
               "ts": e["t0"] * 1e6, "dur": (e["t1"] - e["t0"]) * 1e6}
         if "args" in e:
@@ -459,10 +509,238 @@ def instrument_compile(name: str, key, flags_key, fn):
         out = fn(*a, **k)
         done = True
         record_compile(name, key, flags_key, time.perf_counter() - t0)
+        with _device_lock:
+            # the caller's wall around THIS call includes the compile —
+            # note_step_time must discard it, not seed the EWMA with it
+            _skip_first_wall.add(name)
+        _capture_analysis(name, fn, a, k)
         return out
 
     wrapper._telemetry_inner = fn
     return wrapper
+
+
+def _capture_analysis(name: str, fn, args, kwargs) -> None:
+    """Device feed, capture half: pull the freshly compiled step's
+    ``cost_analysis``/``memory_analysis`` out of jax's AOT surface —
+    per-executable FLOPs, bytes moved, argument/output/temp sizes — and
+    stash them under the instrument name for :func:`device_feed` to
+    join with measured step walls.
+
+    Runs ONCE per jit-cache miss, right after the compiling first call:
+    ``fn.lower`` reuses the cached trace (args are the exact call's — a
+    donated buffer's aval survives deletion) and ``lowered.compile()``
+    is an AOT recompile that the persistent compile cache turns into a
+    disk read.  Strictly best-effort: any backend that lacks an
+    analysis yields nulls, never an exception on the hot path."""
+    if not _flags.device_feed_enabled():
+        return
+    rec: dict = {"captured_at": time.time()}
+    try:
+        import jax
+
+        d = jax.devices()[0]
+        with _device_lock:
+            _device_info.setdefault("platform", d.platform)
+            _device_info.setdefault(
+                "device_kind", str(getattr(d, "device_kind", "")))
+        lowered = fn.lower(*args, **kwargs)
+    except Exception:  # noqa: BLE001 - feed capture must never break a step
+        return
+    def _fold_cost(ca):
+        if isinstance(ca, list):
+            ca = ca[0] if ca else {}
+        if ca.get("flops", 0) > 0:
+            rec["flops"] = float(ca["flops"])
+        if ca.get("bytes accessed", 0) > 0:
+            rec["bytes_accessed"] = float(ca["bytes accessed"])
+
+    # The memory-analysis half needs an AOT recompile (lowered.compile()
+    # does not share the jit dispatch cache).  Pay it only where it is
+    # cheap or amortized: CPU (test/dev compiles are sub-second), any
+    # backend with the persistent compile cache configured (serving
+    # warmup calls init_compile_cache, making this a disk read), or an
+    # explicit PADDLE_TPU_DEVICE_FEED=full.  Otherwise an unwarmed TPU
+    # server would pay minutes of double compile inside its first ticks.
+    try:
+        full = (d.platform == "cpu"
+                or bool(jax.config.jax_compilation_cache_dir)
+                or _flags.device_feed_mode() == "full")
+    except Exception:  # noqa: BLE001
+        full = False
+    if not full:
+        with contextlib.suppress(Exception):
+            _fold_cost(lowered.cost_analysis())
+        _store_analysis(name, rec)
+        return
+    try:
+        compiled = lowered.compile()
+        with contextlib.suppress(Exception):
+            _fold_cost(compiled.cost_analysis())
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            for field in ("argument_size_in_bytes", "output_size_in_bytes",
+                          "temp_size_in_bytes", "alias_size_in_bytes",
+                          "generated_code_size_in_bytes"):
+                v = getattr(ma, field, None)
+                if v is not None:
+                    rec[field.replace("_size_in_bytes", "_bytes")] = int(v)
+    except Exception:  # noqa: BLE001 - memory analysis is the optional half
+        pass
+    if "flops" not in rec:
+        # backend without compiled-level analysis: the unoptimized-HLO
+        # cost model still yields FLOPs/bytes (no XLA compile needed)
+        with contextlib.suppress(Exception):
+            _fold_cost(lowered.cost_analysis())
+    _store_analysis(name, rec)
+
+
+def _store_analysis(name: str, rec: dict) -> None:
+    if len(rec) <= 1:  # nothing beyond the timestamp — keep the feed null
+        return
+    with _device_lock:
+        prev = _step_analysis.get(name)
+        rec["compiles"] = (prev.get("compiles", 0) + 1) if prev else 1
+        _step_analysis[name] = rec
+        # a re-capture means a NEW executable now owns this name (e.g. a
+        # server built for a different config): the old executable's wall
+        # EWMA must not blend into the new one's MFU.  Two same-named
+        # configs ticking CONCURRENTLY still blend — a documented
+        # limitation; per-config name suffixes would explode gauge
+        # cardinality for the common one-config-per-process case.
+        _step_times.pop(name, None)
+        # cardinality bound: per-construction names (jit.to_static:*#N)
+        # would otherwise grow /metrics and host memory for the life of
+        # a process that keeps wrapping new functions — evict the oldest
+        # capture past the cap (reset() never clears this store)
+        while len(_step_analysis) > 256:
+            oldest = min(_step_analysis,
+                         key=lambda n: _step_analysis[n]
+                         .get("captured_at", 0.0))
+            del _step_analysis[oldest]
+            _step_times.pop(oldest, None)
+            _skip_first_wall.discard(oldest)
+        while len(_skip_first_wall) > 1024:  # names never noted
+            _skip_first_wall.pop()
+
+
+def note_step_time(name: str, seconds: float) -> None:
+    """Feed one measured per-call wall of the ``name`` executable into
+    the device feed's EWMA (callers: the serving tick/fit sites that
+    already hold an honest wall covering device execution — never the
+    async dispatch time, which returns before the device finishes)."""
+    if not enabled() or seconds <= 0.0:
+        return
+    s = float(seconds)
+    with _device_lock:
+        if name in _skip_first_wall:
+            # this wall overlapped the executable's compiling first call
+            # (instrument_compile flagged it) — compile-dominated, and a
+            # name hit exactly once would export it as a live gauge
+            _skip_first_wall.discard(name)
+            return
+        t = _step_times.get(name)
+        if t is None:
+            _step_times[name] = {"ewma_s": s, "last_s": s, "calls": 1}
+        elif t["calls"] == 1 and t["ewma_s"] > 3.0 * s:
+            # the first wall of a fresh executable usually includes its
+            # XLA compile — once a steady-state sample shows it was an
+            # outlier, restart the EWMA instead of averaging it in
+            _step_times[name] = {"ewma_s": s, "last_s": s, "calls": 2}
+        else:
+            t["ewma_s"] += _STEP_EWMA_ALPHA * (s - t["ewma_s"])
+            t["last_s"] = s
+            t["calls"] += 1
+
+
+def sample_device_stats(min_interval_s: float | None = None,
+                        devices=None) -> dict:
+    """Rate-limited PJRT memory-stats sample for the hot paths: folds
+    ``monitor.snapshot_device_stats`` (bytes_in_use / peak / limit per
+    device — the STAT_gpuN_mem analog) into the shared registry, mirrors
+    the numbers as telemetry gauges, and drops one Perfetto counter
+    event so HBM rides the timeline next to the request spans.
+
+    A host-side PJRT query, never a device sync; backends without
+    memory stats (CPU) yield {} silently.  ``devices`` overrides the
+    sampled device list (tests inject fakes)."""
+    if not _flags.device_feed_enabled():
+        return {}
+    now = time.monotonic()
+    interval = (_flags.hbm_sample_interval_s() if min_interval_s is None
+                else min_interval_s)
+    with _device_lock:
+        if now - _hbm_state["t"] < interval:
+            return dict(_hbm_last)
+        _hbm_state["t"] = now
+    try:
+        out = _monitor.snapshot_device_stats(devices=devices)
+    except Exception:  # noqa: BLE001 - a flaky tunnel must not kill a tick
+        return {}
+    if not out:
+        return {}
+    for k, v in out.items():
+        gauge(f"device.{k}").set(v)
+    with _device_lock:
+        _hbm_last.clear()
+        _hbm_last.update(out)
+    _counter_event("hbm", {k: v for k, v in out.items()
+                           if "bytes_in_use" in k})
+    return dict(out)
+
+
+def device_feed() -> dict:
+    """The device half of :func:`snapshot`: per-compiled-step FLOPs /
+    bytes / sizes joined with measured step walls into live MFU and
+    roofline (compute- vs bandwidth-bound) gauges, plus the last HBM
+    sample.  Null-safe by construction — an unknown chip kind (or CPU)
+    has ``peak_flops`` None and every MFU reports null rather than a
+    fabricated percentage (framework.platform.DEVICE_PEAKS is the one
+    peaks table)."""
+    from .framework import platform as _platform
+
+    with _device_lock:
+        info = dict(_device_info)
+        analyses = {n: dict(r) for n, r in _step_analysis.items()}
+        times = {n: dict(t) for n, t in _step_times.items()}
+        hbm = dict(_hbm_last)
+    peak_f, peak_bw = _platform.device_peaks(info.get("device_kind"),
+                                             info.get("platform"))
+    balance = (peak_f / peak_bw) if peak_f and peak_bw else None
+    steps = {}
+    for nm, rec in analyses.items():
+        s = dict(rec)
+        s.pop("captured_at", None)
+        flops = rec.get("flops")
+        bts = rec.get("bytes_accessed")
+        s["mfu"] = None
+        s["bound"] = None
+        if flops and bts:
+            ai = flops / bts  # arithmetic intensity, FLOPs/byte
+            s["arithmetic_intensity"] = round(ai, 3)
+            if balance is not None:
+                s["bound"] = "compute" if ai >= balance else "bandwidth"
+        t = times.get(nm)
+        if t and t.get("ewma_s", 0) > 0:
+            s["step_s"] = round(t["ewma_s"], 6)
+            s["step_calls"] = t["calls"]
+            if flops:
+                fps = flops / t["ewma_s"]
+                s["flops_per_s"] = round(fps, 1)
+                if peak_f:
+                    # full precision: a tiny step's MFU is legitimately
+                    # ~1e-5 and fixed-decimal rounding would zero it
+                    s["mfu"] = fps / peak_f
+            if bts:
+                bps = bts / t["ewma_s"]
+                s["bytes_per_s"] = round(bps, 1)
+                if peak_bw:
+                    s["hbm_bw_util"] = bps / peak_bw
+        steps[nm] = s
+    return {"platform": info.get("platform"),
+            "device_kind": info.get("device_kind"),
+            "peak_flops": peak_f, "peak_hbm_bytes_per_s": peak_bw,
+            "steps": steps, "hbm": hbm}
 
 
 # ---------------------------------------------------------------------------
@@ -497,6 +775,7 @@ def snapshot() -> dict:
         "gauges": {n: g.get() for n, g in gauges},
         "counters": _monitor.stats(),
         "compiles": compiles,
+        "device": device_feed(),
         "events": len(_events),
     }
 
@@ -547,9 +826,15 @@ def render_prometheus() -> str:
         lines.append(f"{pn} {g.get():.6g}")
     # the '<hist>.count'/'<hist>.sum' monitor mirrors snapshot() writes
     # would sanitize to the histogram's own _count/_sum sample names —
-    # duplicate families are invalid exposition, so skip them here
+    # duplicate families are invalid exposition, so skip them here.
+    # Device-memory stats are skipped the same way: sample_device_stats
+    # already exports them as 'device.*' GAUGES (the honest typing for a
+    # value that goes down), and the counter-typed monitor twin would be
+    # a second, rate()-breaking name for the same number
     mirror = {f"{n}.count" for n, _ in hists} | \
-             {f"{n}.sum" for n, _ in hists}
+             {f"{n}.sum" for n, _ in hists} | \
+             {n[len("device."):] for n, _ in gauges
+              if n.startswith("device.")}
     for name, v in sorted(_monitor.stats().items()):
         if name in mirror:
             continue
@@ -558,25 +843,177 @@ def render_prometheus() -> str:
         lines.append(f"# TYPE {pn.partition('{')[0]} counter")
         lines.append(f"{pn} {v:.6g}" if isinstance(v, float)
                      else f"{pn} {v}")
+    # device feed: per-step FLOPs/MFU/roofline as labeled gauges (null
+    # MFUs — unknown chip — are simply absent, never a fabricated 0)
+    feed = device_feed()
+    if feed["steps"]:
+        emitted = set()
+        for metric, field in (("step_flops", "flops"),
+                              ("step_bytes_accessed", "bytes_accessed"),
+                              ("step_mfu", "mfu"),
+                              ("step_hbm_bw_util", "hbm_bw_util"),
+                              ("step_seconds", "step_s")):
+            for nm, s in sorted(feed["steps"].items()):
+                v = s.get(field)
+                if v is None:
+                    continue
+                if metric not in emitted:
+                    emitted.add(metric)
+                    lines.append(f"# TYPE paddle_tpu_device_{metric} gauge")
+                lines.append(
+                    f'paddle_tpu_device_{metric}{{step="{nm}"}} {v:.6g}')
     return "\n".join(lines) + "\n"
+
+
+def probe_health(path: str | None = None,
+                 wedge_window_s: float = 1800.0) -> dict:
+    """Probe/wedge state from the tunnel-probe evidence log
+    (``tpu_probe_log.jsonl`` — tools/probe_tpu.py appends one line per
+    attempt).  Resolution: explicit ``path`` > ``PADDLE_TPU_PROBE_LOG``
+    env > ``./tpu_probe_log.jsonl`` > the source checkout root's
+    ``tpu_probe_log.jsonl`` (where tools/probe_tpu.py pins it — a server
+    launched from another cwd must still see the wedge evidence).
+    Status values: ``ok`` (last probe
+    healthy AND within the window), ``wedged`` (last probe failed within
+    the window — the fail-fast evidence bench._recent_probe_wedge
+    consults), ``stale`` (last entry — healthy or not — older than the
+    window: the probe process itself may be dead, so the log is no
+    longer evidence either way), ``unknown`` (no log)."""
+    path = path or os.environ.get("PADDLE_TPU_PROBE_LOG")
+    if path is None:
+        path = "tpu_probe_log.jsonl"
+        if not os.path.exists(path):
+            rooted = os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "tpu_probe_log.jsonl")
+            if os.path.exists(rooted):
+                path = rooted
+    last = None
+    try:
+        # bounded tail read: the log is append-only and only the LAST
+        # entry matters — a liveness probe must not re-parse weeks of
+        # history per request
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - 65536))
+            tail = f.read().decode("utf-8", errors="replace")
+        for line in tail.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            with contextlib.suppress(json.JSONDecodeError):
+                rec = json.loads(line)
+                if isinstance(rec, dict):
+                    last = rec
+    except OSError:
+        return {"status": "unknown", "log": path, "last_probe": None}
+    if last is None:
+        return {"status": "unknown", "log": path, "last_probe": None}
+    age = None
+    with contextlib.suppress(Exception):
+        import datetime
+
+        age = (datetime.datetime.now(datetime.timezone.utc)
+               - datetime.datetime.fromisoformat(str(last.get("ts")))
+               ).total_seconds()
+    fresh = age is not None and 0 <= age <= wedge_window_s
+    if last.get("ok"):
+        # an old healthy entry is NOT health: if the probe process died
+        # after one good probe, /healthz must go stale, not evergreen
+        status = "ok" if fresh else "stale"
+    elif fresh:
+        status = "wedged"
+    else:
+        status = "stale"
+    return {"status": status, "log": path, "last_probe": last,
+            "age_s": None if age is None else round(age, 1)}
+
+
+_profile_lock = threading.Lock()
+
+
+def capture_device_profile(ms: float = 500.0,
+                           out_dir: str | None = None) -> str:
+    """On-demand device profiling: ``jax.profiler.start_trace`` /
+    ``stop_trace`` around ``ms`` milliseconds of whatever traffic is
+    live (the serving threads keep ticking — this blocks only the
+    caller).  Returns the trace directory (TensorBoard 'profile'
+    plugin / Perfetto loadable).  One capture at a time: a concurrent
+    request raises rather than corrupting the active trace."""
+    ms = float(ms)
+    if not 0 < ms <= 60_000:
+        raise ValueError(f"profile window must be in (0, 60000] ms, "
+                         f"got {ms}")
+    if not _profile_lock.acquire(blocking=False):
+        raise RuntimeError("a device profile capture is already running")
+    try:
+        import tempfile
+
+        import jax
+
+        out_dir = (out_dir or os.environ.get("PADDLE_TPU_PROFILE_DIR")
+                   or tempfile.mkdtemp(prefix="paddle_tpu_trace_"))
+        os.makedirs(out_dir, exist_ok=True)
+        # the capture window itself lands on the telemetry timeline, so
+        # the merged Perfetto view shows WHICH requests the device trace
+        # overlapped
+        with span("profiler.capture", dir=out_dir, ms=ms):
+            jax.profiler.start_trace(out_dir)
+            try:
+                time.sleep(ms / 1e3)
+            finally:
+                jax.profiler.stop_trace()
+        return out_dir
+    finally:
+        _profile_lock.release()
 
 
 class MetricsServer:
     """Tiny opt-in HTTP endpoint: ``GET /metrics`` (Prometheus text),
-    ``GET /snapshot`` (the JSON snapshot).  Daemon-threaded; ``port=0``
-    picks an ephemeral port (``.port`` has the bound one).  Binds
-    loopback by default — the endpoint is unauthenticated, so exposing
-    it beyond the host (``host="0.0.0.0"`` for a scraper sidecar) is an
-    explicit opt-in."""
+    ``GET /snapshot`` (the JSON snapshot), ``GET /healthz`` (probe/wedge
+    + feed state), ``POST /profile?ms=500`` (on-demand device trace
+    around live traffic; returns the trace dir).  Daemon-threaded;
+    ``port=0`` picks an ephemeral port (``.port`` has the bound one).
+    Binds loopback by default — the endpoint is unauthenticated, so
+    exposing it beyond the host (``host="0.0.0.0"`` for a scraper
+    sidecar) is an explicit opt-in."""
 
     def __init__(self, port: int, host: str = "127.0.0.1"):
         import http.server
 
         class Handler(http.server.BaseHTTPRequestHandler):
+            def _reply(self_h, code, body, ctype):  # noqa: N805
+                self_h.send_response(code)
+                self_h.send_header("Content-Type", ctype)
+                self_h.send_header("Content-Length", str(len(body)))
+                self_h.end_headers()
+                self_h.wfile.write(body)
+
             def do_GET(self_h):  # noqa: N805
                 if self_h.path.startswith("/snapshot"):
                     body = json.dumps(snapshot()).encode()
                     ctype = "application/json"
+                elif self_h.path.startswith("/healthz"):
+                    probe = probe_health()
+                    feed = device_feed()
+                    healthy = probe["status"] != "wedged"
+                    body = json.dumps({
+                        "ok": healthy,
+                        "telemetry_enabled": enabled(),
+                        "device_feed_enabled":
+                            _flags.device_feed_enabled(),
+                        "probe": probe,
+                        "platform": feed.get("platform"),
+                        "device_kind": feed.get("device_kind"),
+                        "instrumented_steps": sorted(feed["steps"]),
+                        "hbm": feed.get("hbm", {}),
+                    }).encode()
+                    # healthz convention: status-code signaling — a
+                    # k8s-style httpGet probe never reads the body, so a
+                    # wedged tunnel must be a non-2xx
+                    self_h._reply(200 if healthy else 503, body,
+                                  "application/json")
+                    return
                 elif self_h.path.startswith("/metrics") or \
                         self_h.path == "/":
                     body = render_prometheus().encode()
@@ -584,11 +1021,37 @@ class MetricsServer:
                 else:
                     self_h.send_error(404)
                     return
-                self_h.send_response(200)
-                self_h.send_header("Content-Type", ctype)
-                self_h.send_header("Content-Length", str(len(body)))
-                self_h.end_headers()
-                self_h.wfile.write(body)
+                self_h._reply(200, body, ctype)
+
+            def do_POST(self_h):  # noqa: N805
+                if not self_h.path.startswith("/profile"):
+                    self_h.send_error(404)
+                    return
+                from urllib.parse import parse_qs, urlparse
+
+                q = parse_qs(urlparse(self_h.path).query)
+                try:
+                    ms = float(q.get("ms", ["500"])[0])
+                    # no client-chosen output dir: the endpoint is
+                    # unauthenticated, so the write target stays server-
+                    # side (PADDLE_TPU_PROFILE_DIR or a fresh tempdir)
+                    trace_dir = capture_device_profile(ms)
+                except ValueError as e:
+                    self_h._reply(400, json.dumps(
+                        {"error": str(e)}).encode(), "application/json")
+                    return
+                except RuntimeError as e:  # capture already running
+                    self_h._reply(409, json.dumps(
+                        {"error": str(e)}).encode(), "application/json")
+                    return
+                except Exception as e:  # noqa: BLE001 - report, don't die
+                    self_h._reply(500, json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode(),
+                        "application/json")
+                    return
+                self_h._reply(200, json.dumps(
+                    {"trace_dir": trace_dir, "ms": ms}).encode(),
+                    "application/json")
 
             def log_message(self_h, *a):  # noqa: N805 - quiet by design
                 pass

@@ -128,11 +128,15 @@ _STEP_CACHE = generate._LRU(
     int(_os.environ.get("PADDLE_TPU_STEP_CACHE_SIZE", "64")))
 
 
-def _get_prefill_fn(cfg: gpt.GPTConfig):
-    k = ("prefill", generate._cfg_key(cfg))
+def _get_prefill_fn(cfg: gpt.GPTConfig, bucket: int):
+    """One wrapper per (cfg, prompt bucket): the jit would retrace per
+    bucket shape anyway, and a per-bucket wrapper keeps the device
+    feed's captured FLOPs joined to walls of the SAME bucket — one
+    shared wrapper would divide bucket-8 FLOPs by bucket-512 walls."""
+    k = ("prefill", generate._cfg_key(cfg), int(bucket))
     fn = _STEP_CACHE.get(k)
     if fn is None:
-        fn = generate._watch_jit("serving.prefill", k, jax.jit(
+        fn = generate._watch_jit(f"serving.prefill@{bucket}", k, jax.jit(
             lambda p, c, t, ln, sl, _cfg=cfg:
             generate.prefill_slot(p, c, t, ln, sl, _cfg),
             donate_argnums=generate._donate_cache()))
@@ -156,7 +160,7 @@ def _get_block_fn(cfg: gpt.GPTConfig, k: int):
     key = ("block", generate._cfg_key(cfg), k)
     fn = _STEP_CACHE.get(key)
     if fn is None:
-        fn = generate._watch_jit("serving.block", key, jax.jit(
+        fn = generate._watch_jit(f"serving.block@{k}", key, jax.jit(
             lambda p, c, t, s, _cfg=cfg, _k=k:
             decode_block_batched(p, c, t, s, _k, _cfg),
             donate_argnums=generate._donate_cache()))
@@ -180,7 +184,8 @@ def _get_sample_block_fn(cfg: gpt.GPTConfig, k: int):
     key = ("sample_block", generate._cfg_key(cfg), k)
     fn = _STEP_CACHE.get(key)
     if fn is None:
-        fn = generate._watch_jit("serving.sample_block", key, jax.jit(
+        fn = generate._watch_jit(f"serving.sample_block@{k}", key,
+                                 jax.jit(
             lambda p, c, t, s, ky, off, te, tk, tp, _cfg=cfg, _k=k:
             sample_block_batched(p, c, t, s, ky, off, te, tk, tp, _k,
                                  _cfg),
@@ -232,7 +237,8 @@ def _get_async_block_fn(cfg: gpt.GPTConfig, k: int):
     key = ("async_block", generate._cfg_key(cfg), k)
     fn = _STEP_CACHE.get(key)
     if fn is None:
-        fn = generate._watch_jit("serving.async_block", key, jax.jit(
+        fn = generate._watch_jit(f"serving.async_block@{k}", key,
+                                 jax.jit(
             lambda p, c, ht, pm, pv, s, _cfg=cfg, _k=k:
             decode_block_batched(p, c, jnp.where(pm, pv, ht), s, _k,
                                  _cfg),
@@ -247,8 +253,8 @@ def _get_async_sample_block_fn(cfg: gpt.GPTConfig, k: int):
     key = ("async_sample_block", generate._cfg_key(cfg), k)
     fn = _STEP_CACHE.get(key)
     if fn is None:
-        fn = generate._watch_jit("serving.async_sample_block", key,
-                                 jax.jit(
+        fn = generate._watch_jit(f"serving.async_sample_block@{k}",
+                                 key, jax.jit(
             lambda p, c, ht, pm, pv, s, ky, off, te, tk, tp, _cfg=cfg,
             _k=k:
             sample_block_batched(p, c, jnp.where(pm, pv, ht), s,
@@ -337,7 +343,11 @@ class DecodeServer:
                 raise ValueError(
                     f"prefill_chunk must be in [1, {window}] "
                     f"(the serving window), got {prefill_chunk}")
-        self._prefill = (_get_prefill_fn(cfg)
+        # whole-prompt prefill executables resolve PER BUCKET at
+        # admission (_get_prefill_fn(cfg, bucket)); this marker is the
+        # factory, kept callable-shaped so `is not None` mode checks read
+        # the same as before
+        self._prefill = ((lambda bucket: _get_prefill_fn(cfg, bucket))
                          if prefill and prefill_chunk is None else None)
         self._chunk = (int(prefill_chunk) if prefill_chunk is not None
                        else None)
@@ -419,6 +429,7 @@ class DecodeServer:
                     (t_admit - st["t_submit"]) * 1e3)
             if self._prefill is not None or self._prefill_chunk is not None:
                 n = len(req["prompt"])
+                prefill_calls = 1
                 if self._prefill is not None:
                     bucket = 1
                     while bucket < n:
@@ -427,9 +438,10 @@ class DecodeServer:
                     # the cache window; both bounds >= n (submit checked)
                     bucket = min(bucket, self.max_len,
                                  self.cfg.max_seq_len)
+                    prefill_name = f"prefill@{bucket}"
                     padded = np.zeros((1, bucket), np.int32)
                     padded[0, :n] = req["prompt"]
-                    logits, self.cache = self._prefill(
+                    logits, self.cache = self._prefill(bucket)(
                         self.params, self.cache, jnp.asarray(padded),
                         jnp.asarray(n), jnp.asarray(slot))
                 else:
@@ -447,6 +459,8 @@ class DecodeServer:
                         starts = [0]
                     else:
                         starts = list(range(0, n - C, C)) + [n - C]
+                    prefill_calls = len(starts)
+                    prefill_name = "prefill_chunk"
                     logits = None
                     for i in starts:
                         chunk = req["prompt"][i:i + C]
@@ -456,19 +470,25 @@ class DecodeServer:
                             self.params, self.cache, jnp.asarray(padded),
                             jnp.asarray(i), jnp.asarray(len(chunk)),
                             jnp.asarray(slot))
+                # one host fetch of the admission logits; the timestamp
+                # right after it bounds the DEVICE window (the sampling
+                # below is pure host math and must not be charged to the
+                # prefill executable's step wall)
+                logits_np = np.asarray(logits)
+                t_prefill_done = time.perf_counter()
                 if st["temperature"] > 0.0:
                     # admission draws host-side from the filtered law,
                     # seeded per rid off the server key — deterministic
                     # regardless of admission order or batch-mates
                     p = generate._filtered_probs(
-                        np.asarray(logits), st["temperature"],
+                        logits_np, st["temperature"],
                         st["top_k"], st["top_p"])
                     rng = np.random.default_rng(generate._key_seed(
                         jax.random.fold_in(self._base_key,
                                            (1 << 20) + st["rid"])))
                     t = int(rng.choice(len(p), p=p))
                 else:
-                    t = int(np.asarray(jnp.argmax(logits)))
+                    t = int(logits_np.argmax())
                 st["generated"].append(t)
                 st["pos"] = n  # cache rows [0, n) are filled
                 if self._tel:
@@ -482,6 +502,13 @@ class DecodeServer:
                     _telemetry.event("serving.prefill", t_admit, now,
                                      tid=slot, rid=st["rid"],
                                      prompt_len=n)
+                    # per-EXECUTION wall bounded at the logits fetch
+                    # (host sampling excluded): chunked admission ran
+                    # the one chunk executable len(starts) times — the
+                    # device feed joins this with ONE execution's FLOPs
+                    _telemetry.note_step_time(
+                        f"serving.{prefill_name}",
+                        (t_prefill_done - t_admit) / prefill_calls)
                     _telemetry.count("serving.tokens_generated")
                 if (st["max_new"] <= 1
                         or (self.eos_id is not None and t == self.eos_id)
@@ -598,9 +625,13 @@ class DecodeServer:
     def _tel_gauges(self):
         """Occupancy gauges off the scheduler's host state: queue depth,
         active slots, slot occupancy, and KV-cache utilization (filled
-        rows / window, from the per-slot host ``pos``)."""
+        rows / window, from the per-slot host ``pos``).  Also the HBM
+        sampling point: a rate-limited PJRT memory-stats query (host
+        RPC, never a device sync) keeps live bytes_in_use/peak gauges
+        next to the occupancy ones."""
         if not self._tel:
             return
+        _telemetry.sample_device_stats()
         _telemetry.set_gauge("serving.queue_depth", len(self._queue))
         _telemetry.set_gauge("serving.active_slots", len(self._slots))
         _telemetry.set_gauge("serving.slot_occupancy",
@@ -624,18 +655,25 @@ class DecodeServer:
                          rid=st["rid"], prompt_len=len(st["prompt"]),
                          tokens=len(st["generated"]))
 
-    def _tel_tokens(self, appended, t0, steps: int = 1):
+    def _tel_tokens(self, appended, t0, steps: int = 1, kind=None):
         """Per-tick records from the host bookkeeping that JUST ran on
         the already-fetched token block: tick latency, first-token time
         for slots whose first kept token arrived this tick (the
         ``prefill=False`` path — prefill admission stamps TTFT itself),
         and per-token latency = tick wall / steps (each slot decoded
-        every step of the block it was fed into)."""
+        every step of the block it was fed into).
+
+        ``kind`` names the executable that ran (serving.<kind> — the
+        instrument_compile name) so the device feed can join this wall,
+        which genuinely covers dispatch→token-fetch even on the async
+        path, with the executable's captured FLOPs into a live MFU."""
         if not self._tel:
             return
         now = time.perf_counter()
         dt_ms = (now - t0) * 1e3
         _telemetry.observe("serving.tick_ms", dt_ms)
+        if kind is not None:
+            _telemetry.note_step_time(f"serving.{kind}", dt_ms / 1e3)
         if not appended:
             return
         total = 0
@@ -669,6 +707,7 @@ class DecodeServer:
         n = self._step_no
         self._step_no = n + 1
         if temp.any():
+            kind = "sample_step"
             fn = _get_sample_step_fn(self.cfg)
             nxt, self.cache = fn(
                 self.params, self.cache, jnp.asarray(tok),
@@ -676,6 +715,7 @@ class DecodeServer:
                 jnp.asarray(temp), jnp.asarray(tk), jnp.asarray(tp))
             nxt = np.asarray(nxt)
         else:
+            kind = "step"
             logits, self.cache = self._step(self.params, self.cache,
                                             jnp.asarray(tok),
                                             jnp.asarray(pos))
@@ -692,7 +732,7 @@ class DecodeServer:
             appended.append((st, 1))
             if self._finished(st, t):
                 done.append(slot)
-        self._tel_tokens(appended, t0)
+        self._tel_tokens(appended, t0, kind=kind)
         self._retire(done)
 
     # -- async dispatch: one step/block in flight ---------------------------
@@ -756,6 +796,7 @@ class DecodeServer:
             jax.random.fold_in(self._base_key, n), jnp.asarray(temp),
             jnp.asarray(tk), jnp.asarray(tp))
         self._inflight = {"kind": "step", "toks": nxt, "feed": nxt,
+                          "fn": "async_step",
                           "snap": snap, "t_disp": time.perf_counter()}
 
     def _dispatch_block_async(self, prev, block: int):
@@ -763,6 +804,7 @@ class DecodeServer:
         n = self._step_no
         self._step_no = n + block
         if temp.any():
+            fname = f"async_sample_block@{block}"
             fn = _get_async_sample_block_fn(self.cfg, block)
             toks, self.cache = fn(
                 self.params, self.cache, jnp.asarray(ht), jnp.asarray(pm),
@@ -771,12 +813,13 @@ class DecodeServer:
                 jnp.asarray(tp))
             feed = toks[:, -1]  # the block's last token per slot
         else:
+            fname = f"async_block@{block}"
             fn = _get_async_block_fn(self.cfg, block)
             toks, self.cache, feed, _ = fn(
                 self.params, self.cache, jnp.asarray(ht), jnp.asarray(pm),
                 self._prev_feed(prev), jnp.asarray(pos))
         self._inflight = {"kind": "block", "toks": toks, "feed": feed,
-                          "snap": snap, "block": block,
+                          "fn": fname, "snap": snap, "block": block,
                           "t_disp": time.perf_counter()}
 
     def _process_inflight(self, prev):
@@ -811,7 +854,7 @@ class DecodeServer:
         # latency window: dispatch -> this fetch (the async pipeline's
         # real step time, overlap included)
         self._tel_tokens(appended, prev.get("t_disp", time.perf_counter()),
-                         steps=prev.get("block", 1))
+                         steps=prev.get("block", 1), kind=prev.get("fn"))
         self._retire(done)
 
     def _tick_async(self):
@@ -974,7 +1017,8 @@ class DecodeServer:
                                window) for n in prompt_lens]
             for b in sorted(set(buckets)):
                 padded = jnp.zeros((1, b), jnp.int32)
-                warm(f"prefill{b}", lambda padded=padded: self._prefill(
+                fn = self._prefill(b)
+                warm(f"prefill{b}", lambda fn=fn, padded=padded: fn(
                     self.params, self.cache, padded, jnp.asarray(1),
                     jnp.asarray(0)))
         return timings
@@ -1014,12 +1058,14 @@ class DecodeServer:
         n = self._step_no
         self._step_no = n + block
         if temp.any():
+            kind = f"sample_block@{block}"
             fn = _get_sample_block_fn(self.cfg, block)
             toks, self.cache = fn(
                 self.params, self.cache, jnp.asarray(tok),
                 jnp.asarray(pos), self._base_key, jnp.asarray(n),
                 jnp.asarray(temp), jnp.asarray(tk), jnp.asarray(tp))
         else:
+            kind = f"block@{block}"
             fn = _get_block_fn(self.cfg, block)
             toks, self.cache, _, _ = fn(self.params, self.cache,
                                         jnp.asarray(tok), jnp.asarray(pos))
@@ -1037,5 +1083,5 @@ class DecodeServer:
                     done.append(slot)
                     break
             appended.append((st, kept))
-        self._tel_tokens(appended, t0, steps=block)
+        self._tel_tokens(appended, t0, steps=block, kind=kind)
         self._retire(done)

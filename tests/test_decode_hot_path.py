@@ -56,7 +56,7 @@ def test_decode_step_donates_and_aliases_cache(small_model):
 def test_prefill_and_sample_steps_donate(small_model):
     cfg, params = small_model
     cache = G.init_cache(cfg, 2, 16)
-    pre = serving._get_prefill_fn(cfg)
+    pre = serving._get_prefill_fn(cfg, 4)  # bucket = the padded width
     _, cache2 = pre(params, cache, jnp.zeros((1, 4), jnp.int32),
                     jnp.asarray(2), jnp.asarray(0))
     assert cache["k"].is_deleted()

@@ -425,11 +425,15 @@ class TestExport:
         telemetry.observe("serving.ttft_ms", 5.0)
         telemetry.snapshot()  # creates the mirror stats
         text = telemetry.render_prometheus()
-        sample_names = [ln.split("{")[0].split(" ")[0]
-                        for ln in text.splitlines()
+        # full labeled sample names: label-distinct samples under ONE
+        # TYPE are valid exposition (the device feed's per-step gauges
+        # use them); the collision under test is the LABEL-FREE
+        # '<hist>.count'/'<hist>.sum' mirrors duplicating the
+        # histogram's own _count/_sum samples
+        sample_names = [ln.split(" ")[0] for ln in text.splitlines()
                         if ln and not ln.startswith("#")]
         dupes = {n for n in sample_names if sample_names.count(n) > 1
-                 and not n.endswith("_bucket")}
+                 and not n.split("{")[0].endswith("_bucket")}
         assert not dupes, dupes
 
     def test_span_context_manager(self):

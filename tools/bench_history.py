@@ -1,0 +1,218 @@
+#!/usr/bin/env python
+"""Bench trajectory + regression watchtower over ``BENCH_r*.json``.
+
+Each round's driver wraps one ``python bench.py`` run as
+``{"n", "cmd", "rc", "tail", "parsed"}`` where ``parsed`` is the bench's
+single stdout JSON line.  This tool folds those rounds into one
+trajectory table with an honest per-run STATUS — was the number actually
+measured on TPU in that run, or did the bench silently fall back to CPU
+/ replay an earlier watchdog headline? — and FAILS (exit 1) on:
+
+- **regression**: two consecutive genuinely-measured runs of the same
+  metric family where the value dropped more than ``--max-drop``
+  (default 20%);
+- **platform flip**: a genuinely-measured TPU run followed by a run
+  that was not (CPU fallback, watchdog replay, or no number at all) —
+  the exact failure mode of BENCH_r02–r05, which shipped CPU-fallback /
+  replayed lines that read as TPU numbers (ROADMAP "Bench caveat").
+
+Status classes (per run):
+
+- ``ok``          — the line was measured on TPU by THIS run;
+- ``cpu_fallback``— the run pinned CPU (metric suffix, provenance
+                    ``fallback_reason``, or wedge evidence in the tail);
+- ``replayed``    — a TPU number, but replayed from an earlier watchdog
+                    window (``source: tpu_watchdog*``): infra evidence,
+                    not a measurement of this revision's run;
+- ``missing``     — no JSON line parsed at all;
+- ``unknown``     — a line with no platform evidence either way (the
+                    pre-provenance format this tool exists to retire).
+
+Runs stamped with the PR-6 ``provenance`` block classify from it
+directly; older runs classify from the legacy heuristics above.
+
+Usage:
+    python tools/bench_history.py               # BENCH_r*.json in repo
+    python tools/bench_history.py a.json b.json # explicit history
+    python tools/bench_history.py --json        # machine-readable
+    python tools/bench_history.py --max-drop 0.3
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+
+# legacy runs have no provenance block; these tail markers are the
+# evidence a run fell back (bench stderr + probe-log excerpts)
+_FALLBACK_TAIL_MARKERS = ("_cpu_fallback", "falling back to CPU",
+                          "wedged tunnel", '"ok": false')
+
+
+def classify(rec: dict) -> str:
+    """One run's status (see module docstring for the classes)."""
+    parsed = rec.get("parsed") or {}
+    prov = parsed.get("provenance") or {}
+    source = str(parsed.get("source", ""))
+    replayed = source.startswith(("tpu_watchdog", "watchdog"))
+    if prov:
+        if prov.get("fallback_reason"):
+            return "replayed" if replayed else "cpu_fallback"
+        if replayed:
+            # a watchdog-reuse headline (BENCH_REUSE_LADDER healthy-window
+            # path) is stamped fallback-free on a TPU process, but the
+            # number was still measured by the watchdog, not this run —
+            # it must not become a regression baseline as 'ok'
+            return "replayed"
+        if prov.get("platform") in ("tpu", "axon"):
+            return "ok"
+        return "cpu_fallback"
+    if not parsed.get("metric"):
+        tail = str(rec.get("tail", ""))
+        if any(m in tail for m in _FALLBACK_TAIL_MARKERS):
+            return "cpu_fallback"
+        return "missing"
+    if "_cpu_fallback" in parsed["metric"]:
+        return "cpu_fallback"
+    if replayed:
+        return "replayed"
+    dev = parsed.get("device")
+    if dev in ("tpu", "axon"):
+        return "ok"
+    if dev == "cpu":
+        return "cpu_fallback"
+    return "unknown"
+
+
+def _family(metric: str) -> str:
+    return re.sub(r"_cpu_fallback$", "", metric or "")
+
+
+def load_history(paths) -> list:
+    """Trajectory rows, one per run file, ordered by round number."""
+    rows = []
+    for path in paths:
+        try:
+            with open(path, encoding="utf-8") as f:
+                rec = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            rows.append({"file": os.path.basename(path), "n": None,
+                         "status": "missing", "metric": None,
+                         "error": f"{type(e).__name__}: {e}"})
+            continue
+        parsed = rec.get("parsed") or {}
+        prov = parsed.get("provenance") or {}
+        rows.append({
+            "file": os.path.basename(path),
+            "n": rec.get("n"),
+            "status": classify(rec),
+            "metric": parsed.get("metric"),
+            "family": _family(parsed.get("metric", "")) or None,
+            "value": parsed.get("value"),
+            "unit": parsed.get("unit"),
+            "mfu": parsed.get("mfu"),
+            "vs_baseline": parsed.get("vs_baseline"),
+            "device": parsed.get("device") or prov.get("platform"),
+            "device_kind": (parsed.get("device_kind")
+                            or prov.get("device_kind")),
+            "source": parsed.get("source"),
+            "provenance": bool(prov),
+        })
+    rows.sort(key=lambda r: (r["n"] is None, r["n"], r["file"]))
+    return rows
+
+
+def find_violations(rows, max_drop: float = 0.2) -> list:
+    """Regression + platform-flip violations over an ordered trajectory."""
+    violations = []
+    prev = None
+    last_ok_by_family: dict = {}
+    for row in rows:
+        if prev is not None and prev["status"] == "ok" \
+                and row["status"] != "ok":
+            violations.append({
+                "kind": "platform_flip",
+                "run": row["file"],
+                "detail": (f"{prev['file']} measured on TPU "
+                           f"({prev.get('device_kind') or 'tpu'}) but "
+                           f"{row['file']} is {row['status']} — the "
+                           f"trajectory left the device"),
+            })
+        if row["status"] == "ok" and row.get("family") \
+                and row.get("value"):
+            last = last_ok_by_family.get(row["family"])
+            if last is not None and last["value"]:
+                drop = 1.0 - row["value"] / last["value"]
+                if drop > max_drop:
+                    violations.append({
+                        "kind": "regression",
+                        "run": row["file"],
+                        "detail": (f"{row['family']}: "
+                                   f"{last['value']:g} -> "
+                                   f"{row['value']:g} "
+                                   f"({drop:.0%} drop > "
+                                   f"{max_drop:.0%} threshold, vs "
+                                   f"{last['file']})"),
+                    })
+            last_ok_by_family[row["family"]] = row
+        prev = row
+    return violations
+
+
+def render_table(rows) -> str:
+    cols = ("file", "status", "metric", "value", "mfu", "device_kind",
+            "source")
+    widths = {c: max(len(c), *(len(str(r.get(c) if r.get(c) is not None
+                                       else "-")) for r in rows))
+              for c in cols} if rows else {c: len(c) for c in cols}
+    lines = ["  ".join(c.ljust(widths[c]) for c in cols),
+             "  ".join("-" * widths[c] for c in cols)]
+    for r in rows:
+        lines.append("  ".join(
+            str(r.get(c) if r.get(c) is not None else "-").ljust(widths[c])
+            for c in cols))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    max_drop = 0.2
+    if "--max-drop" in argv:
+        i = argv.index("--max-drop")
+        try:
+            max_drop = float(argv[i + 1])
+        except (IndexError, ValueError):
+            print("bench_history: --max-drop needs a numeric fraction "
+                  "(e.g. --max-drop 0.2)", file=sys.stderr)
+            return 2
+        del argv[i:i + 2]
+    paths = argv
+    if not paths:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        paths = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+    if not paths:
+        print("bench_history: no BENCH_r*.json found", file=sys.stderr)
+        return 2
+    rows = load_history(paths)
+    violations = find_violations(rows, max_drop=max_drop)
+    not_measured = [r["file"] for r in rows if r["status"] != "ok"]
+    if as_json:
+        print(json.dumps({"rows": rows, "violations": violations,
+                          "not_tpu_measured": not_measured}, indent=2))
+    else:
+        print(render_table(rows))
+        if not_measured:
+            print(f"\nnot measured on TPU in-run: "
+                  f"{', '.join(not_measured)}")
+        for v in violations:
+            print(f"VIOLATION [{v['kind']}] {v['run']}: {v['detail']}",
+                  file=sys.stderr)
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

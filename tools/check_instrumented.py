@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Instrumentation lint: every ``jax.jit(`` site on the decode/serving/
+jit hot paths must be routed through ``telemetry.instrument_compile``.
+
+The recompile watch (PR 4) and the device feed (PR 6 — per-step
+cost/memory analysis, MFU gauges) both hang off that one choke point: a
+new step getter that calls ``jax.jit`` directly compiles in the watch's
+blind spot — its retraces are invisible, its FLOPs never captured.
+This AST scan makes the blind spot a test failure instead of a code
+review hope: a ``jax.jit`` reference (called directly OR passed to
+``functools.partial``) counts as instrumented only when it sits inside
+the argument list of a call to ``_watch_jit`` (generate.py's wrapper)
+or ``instrument_compile`` itself.
+
+Scanned files: ``text/serving.py``, ``text/generate.py``, and every
+module under ``jit/`` — the step-function zoo the Engine refactor will
+consolidate.  The lint is syntactic by design (no imports, no jax): it
+assumes the repo's idiom of ``jax.jit`` attribute access (a
+``from jax import jit`` alias would evade it, and also the repo's
+review conventions).
+
+Usage: ``python tools/check_instrumented.py [repo_root]`` — exits 1 and
+lists ``file:line`` for every unrouted site.  ``tests/
+test_device_telemetry.py`` runs it in tier-1, so a dodge can't merge.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+# call names that count as the instrumentation choke point
+WRAPPER_NAMES = {"_watch_jit", "instrument_compile"}
+
+# repo-relative files/dirs on the decode/serving/train hot paths
+SCAN = (
+    os.path.join("paddle_tpu", "text", "serving.py"),
+    os.path.join("paddle_tpu", "text", "generate.py"),
+    os.path.join("paddle_tpu", "jit"),
+)
+
+
+def _call_name(node: ast.Call):
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def scan_source(src: str, filename: str = "<src>") -> list:
+    """Violations in one source string: [(filename, lineno, message)].
+
+    A "site" is any ``jax.jit`` attribute access in the AST — covering
+    both ``jax.jit(fn, ...)`` calls and ``functools.partial(jax.jit,
+    ...)`` decorator forms.  It passes only when an ANCESTOR node is a
+    call to one of :data:`WRAPPER_NAMES` (i.e. the freshly built
+    executable is handed straight to the instrumentation)."""
+    tree = ast.parse(src, filename=filename)
+    parents: dict = {}
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            parents[child] = parent
+    violations = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Attribute) and node.attr == "jit"
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "jax"):
+            continue
+        cur, routed = node, False
+        while cur in parents:
+            cur = parents[cur]
+            if isinstance(cur, ast.Call) \
+                    and _call_name(cur) in WRAPPER_NAMES:
+                routed = True
+                break
+        if not routed:
+            violations.append(
+                (filename, node.lineno,
+                 "jax.jit site not routed through "
+                 "telemetry.instrument_compile / generate._watch_jit"))
+    return violations
+
+
+def scan_repo(root: str | None = None) -> list:
+    """Violations across every scanned hot-path module."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    files = []
+    for rel in SCAN:
+        path = os.path.join(root, rel)
+        if os.path.isdir(path):
+            # recursive: a future jit/ subpackage (the Engine refactor)
+            # must not evade the lint by nesting its modules
+            for dirpath, _, names in sorted(os.walk(path)):
+                files.extend(os.path.join(dirpath, f)
+                             for f in sorted(names)
+                             if f.endswith(".py"))
+        elif os.path.exists(path):
+            files.append(path)
+    violations = []
+    for path in files:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        violations.extend(scan_source(src, os.path.relpath(path, root)))
+    return violations
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = argv[0] if argv else None
+    violations = scan_repo(root)
+    if not violations:
+        print("check_instrumented: every jax.jit site is routed through "
+              "the recompile watch")
+        return 0
+    for fname, line, msg in violations:
+        print(f"{fname}:{line}: {msg}", file=sys.stderr)
+    print(f"check_instrumented: {len(violations)} unrouted jax.jit "
+          f"site(s) — new step getters must funnel through "
+          f"telemetry.instrument_compile", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -38,6 +38,13 @@ def _jsonl_events(path):
                 rec = json.loads(line)
             except json.JSONDecodeError:
                 continue  # truncated tail of a killed writer — skip
+            if rec.get("ph") == "C" and "t" in rec:
+                # telemetry counter sample (HBM gauges) -> a Perfetto
+                # counter track beside the spans
+                out.append({"name": rec.get("name", "?"), "ph": "C",
+                            "tid": 0, "ts": rec["t"] * 1e6,
+                            "args": rec.get("args", {})})
+                continue
             if "t0" not in rec or "t1" not in rec:
                 continue  # non-span line (snapshots etc.) — skip
             ev = {"name": rec.get("name", "?"), "ph": "X",
@@ -53,8 +60,11 @@ def _is_jsonl(path):
     if path.endswith(".jsonl"):
         return True
     # bounded sniff: a chrome trace (possibly one enormous line) must not
-    # be read/parsed whole just to classify it — a span line is tiny, so
-    # only a short first line that parses as a {t0, t1} record counts
+    # be read/parsed whole just to classify it — a telemetry line is
+    # tiny, so only a short first line that parses as a span ({t0, t1})
+    # or counter-sample ({ph: "C", t}) record counts.  The counter form
+    # matters: on a TPU run the FIRST log line can be an 'hbm' counter
+    # (submit -> gauge sampling) before any span completes.
     with open(path) as f:
         head = f.readline(65536).strip()
     if not head.startswith("{") or not head.endswith("}"):
@@ -63,7 +73,8 @@ def _is_jsonl(path):
         rec = json.loads(head)
     except json.JSONDecodeError:
         return False
-    return "t0" in rec and "t1" in rec
+    return ("t0" in rec and "t1" in rec) or \
+        (rec.get("ph") == "C" and "t" in rec)
 
 
 def load_events(path):
