@@ -37,7 +37,7 @@ def _log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
-def _recent_probe_wedge(window_s: float = 1800.0) -> str:
+def _recent_probe_wedge(window_s: float | None = None) -> str:
     """Evidence that the tunnel is ALREADY known wedged: the most recent
     tpu_probe_log.jsonl entry failed (timeout or error) within
     ``window_s`` with no healthy probe after it.  Returns that entry's
@@ -45,7 +45,19 @@ def _recent_probe_wedge(window_s: float = 1800.0) -> str:
     by _probe_backend to fail fast instead of burning 2x240 s
     re-discovering what the last probe (same watchdog window, BENCH_r05
     tail: the --all walk paid the full retry ladder minutes after the
-    watchdog logged the wedge) already measured."""
+    watchdog logged the wedge) already measured.
+
+    The window is a TTL (``PADDLE_TPU_WEDGE_TTL_S``, default 1800 s —
+    the same knob ``telemetry.probe_health`` honors, read from the env
+    directly so this path stays import-light): evidence older than it
+    is IGNORED, so a long-past wedge can never fail-fast a healthy
+    machine forever."""
+    if window_s is None:
+        try:
+            window_s = float(os.environ.get("PADDLE_TPU_WEDGE_TTL_S",
+                                            "1800"))
+        except ValueError:
+            window_s = 1800.0
     try:
         entries = _tool("probe_tpu").read_log(1)
         if not entries or entries[-1].get("ok"):
@@ -80,23 +92,25 @@ def _probe_backend(timeout=240, attempts=2):
         _log(f"[bench] last probe in this window already failed "
              f"({wedged_at}); fail-fast: one short attempt")
         attempts, timeout = 1, min(timeout, 90)
-    for i in range(attempts):
-        try:
-            from probe_tpu import probe as _tp_probe
+    # retries via the one probe-retry policy (tools/probe_tpu.py
+    # probe_with_retry -> resilience.retry): capped exponential backoff
+    # with jitter between attempts (a killed probe can renew the
+    # tunnel's held claim — the growing gaps give it quiet time), every
+    # engaged retry counted into resilience.retries.probe_tpu
+    try:
+        from probe_tpu import probe_with_retry as _tp_retry
 
-            entry = _tp_probe(timeout, source=f"bench attempt {i + 1}")
-        except Exception as e:  # noqa: BLE001 - the probe must NEVER kill
-            # the bench (this fallback path exists to always emit JSON)
-            _log(f"[bench] backend probe attempt {i + 1} error: {e!r}")
-            time.sleep(5)
-            continue
-        if entry["ok"]:
-            _log(f"[bench] backend probe ok in {entry['elapsed_s']}s: "
-                 f"{entry['detail']}")
-            return entry["detail"]
-        _log(f"[bench] backend probe attempt {i + 1} failed: "
+        entry = _tp_retry(timeout, attempts=attempts, source="bench")
+    except Exception as e:  # noqa: BLE001 - the probe must NEVER kill
+        # the bench (this fallback path exists to always emit JSON)
+        _log(f"[bench] backend probe error: {e!r}")
+        return None
+    if entry and entry.get("ok"):
+        _log(f"[bench] backend probe ok in {entry['elapsed_s']}s: "
              f"{entry['detail']}")
-        time.sleep(5)
+        return entry["detail"]
+    _log(f"[bench] backend probe gave up after {attempts} attempt(s): "
+         f"{(entry or {}).get('detail')}")
     return None
 
 
@@ -1136,6 +1150,84 @@ def _decode_smoke():
     return rec
 
 
+def _resilience_smoke():
+    """Injected-fault round, run by ``--config gpt --small`` (CI): one
+    OOM injected on a serving tick (the resilience retry chain must
+    engage AND the requests still finish with tokens bit-identical to a
+    fault-free pass) plus one expired deadline (shed with the timeout
+    status), with the engaged ``resilience.*`` counters asserted in the
+    returned record — a silent regression of the recovery paths fails CI
+    before it pages an operator."""
+    import time as _time
+
+    import numpy as np
+    import jax
+
+    from paddle_tpu import faults, resilience, telemetry as _tl
+    from paddle_tpu.framework import monitor
+    from paddle_tpu.text import gpt, serving
+
+    if not resilience.enabled():
+        return {"ok": True, "skipped": "PADDLE_TPU_RESILIENCE=0"}
+    if not _tl.enabled():
+        # the smoke ASSERTS the engaged counters, which only record with
+        # telemetry on — without it the chain still engages but the
+        # assertion would fail for the wrong reason
+        return {"ok": True, "skipped": "PADDLE_TPU_TELEMETRY=0"}
+    cfg = gpt.GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                        num_heads=4, max_seq_len=64)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    prompts = np.random.default_rng(1).integers(1, 100, (2, 5))
+
+    def serve(spec):
+        faults.reset()
+        if spec:
+            faults.install(spec)
+        try:
+            srv = serving.DecodeServer(params, cfg, max_batch=2,
+                                       max_len=32)
+            rids = [srv.submit(p, max_new_tokens=6) for p in prompts]
+            while srv.pending():
+                srv.tick()
+            return [srv.result(r) for r in rids]
+        finally:
+            faults.reset()
+
+    clean = serve("")
+    _tl.reset()
+    faulted = serve("oom:tick:2")
+    if faulted != clean:
+        raise AssertionError(
+            f"resilience smoke: tokens diverged after an injected OOM "
+            f"retry ({faulted} vs {clean})")
+    oom_retries = int(monitor.get_stat("resilience.oom_retries").get())
+    if oom_retries < 1:
+        raise AssertionError(
+            "resilience smoke: injected OOM engaged no retry "
+            "(resilience.oom_retries == 0)")
+    # deadline shed: saturate both slots, then an impossible TTL on a
+    # queued third request — the next tick must shed it with the
+    # timeout status while the active requests keep decoding
+    srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=32)
+    for p in prompts:
+        srv.submit(p, max_new_tokens=8)
+    rid = srv.submit(prompts[0], max_new_tokens=4, ttl_s=0.001)
+    _time.sleep(0.01)
+    while srv.pending():
+        srv.tick()
+    if srv.status(rid) != "timeout":
+        raise AssertionError(
+            f"resilience smoke: expired request not shed "
+            f"(status={srv.status(rid)!r})")
+    sheds = int(monitor.get_stat("resilience.deadline_sheds").get())
+    if sheds < 1:
+        raise AssertionError(
+            "resilience smoke: deadline shed recorded no counter")
+    return {"ok": True, "oom_retries": oom_retries,
+            "deadline_sheds": sheds,
+            "tokens": sum(len(t) for t in faulted)}
+
+
 def bench_gpt(small: bool):
     if small:
         rec = _run_gpt_rung(-1)
@@ -1143,6 +1235,10 @@ def bench_gpt(small: bool):
         # training hot path rides the same CI smoke: grad-accum + async +
         # prefetch fit parity vs the sync loop (BENCH gets a train number)
         rec["train_smoke"] = _train_smoke()
+        # resilience layer rides the CI smoke too: an injected fault
+        # round proves the retry chain + deadline shedding still work
+        # (counters asserted inside)
+        rec["resilience_smoke"] = _resilience_smoke()
         # provenance-schema gate (CI): a bench line whose provenance
         # block is missing or incomplete must fail the smoke — a silent
         # CPU fallback can never again ship as an unlabeled number

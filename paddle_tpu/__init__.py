@@ -106,6 +106,8 @@ if not _LIGHT_IMPORT:
     from .hapi import Model, summary  # noqa: F401
     from . import profiler  # noqa: F401
     from . import telemetry  # noqa: F401
+    from . import faults  # noqa: F401
+    from . import resilience  # noqa: F401
     from .flags import get_flags, set_flags  # noqa: F401
     from .framework import checkpoint, debugger  # noqa: F401
     from .framework.io import load, save  # noqa: F401

@@ -110,12 +110,121 @@ def train_step_key() -> tuple:
     """The trace-time training-flag tuple — the ``_cfg_key`` analog for
     the training hot path.  Everything here is BAKED into a TrainStep at
     construction (accumulation scan shape, async drain mode, prefetch
-    routing); today the TrainStep INSTANCE is the only cache (each
-    construction re-reads the flags, so flipping an env var affects new
-    steps and never a compiled one).  Any future cross-instance cache of
-    compiled train steps must fold this tuple into its key, exactly like
-    the decode cache folds ``PADDLE_TPU_DONATE_DECODE``."""
-    return (train_grad_accum(), async_train(), fit_prefetch())
+    routing, the non-finite skip guard, and any in-jit fault injection —
+    the last two change the compiled program); today the TrainStep
+    INSTANCE is the only cache (each construction re-reads the flags, so
+    flipping an env var affects new steps and never a compiled one).
+    Any future cross-instance cache of compiled train steps must fold
+    this tuple into its key, exactly like the decode cache folds
+    ``PADDLE_TPU_DONATE_DECODE``."""
+    from . import faults as _faults
+
+    return (train_grad_accum(), async_train(), fit_prefetch(),
+            nan_guard(), _faults.spec_string())
+
+
+def resilience_enabled() -> bool:
+    """Resilience layer master switch (ON by default).
+
+    When on, the runtime SURVIVES faults instead of dying on them:
+    ``resilience.retry`` engages bounded backoff chains, ``DecodeServer``
+    sheds expired requests / runs the OOM retry chain / recovers wedged
+    async steps, ``TrainStep`` skips non-finite steps, and the
+    ``DevicePrefetcher`` retries transient reader errors.
+    ``PADDLE_TPU_RESILIENCE=0`` restores today's fail-fast behavior
+    everywhere (retry = one attempt, every degradation chain skipped).
+    Host-side scheduling only — never part of a decode jit-cache key;
+    the one resilience knob that changes a compiled program
+    (:func:`nan_guard`) folds into ``train_step_key`` itself."""
+    v = os.environ.get("PADDLE_TPU_RESILIENCE", "1").strip().lower()
+    return v not in ("0", "false", "off", "no")
+
+
+def nan_guard() -> bool:
+    """In-jit non-finite train-step guard (ON whenever resilience is on).
+
+    When on, ``jit.TrainStep`` compiles a guard around the optimizer
+    update: a step whose loss or gradients are non-finite applies NO
+    update (params/opt state carried through unchanged) and bumps an
+    on-device skip counter, drained by ``Model.fit`` at its existing
+    host-fetch boundaries (``train.nonfinite_skips``).  Trace-time: the
+    guard is baked into the compiled program, so it is part of
+    ``train_step_key``.  ``PADDLE_TPU_NAN_GUARD=0`` disables just the
+    guard while keeping the rest of the resilience layer."""
+    if not resilience_enabled():
+        return False
+    v = os.environ.get("PADDLE_TPU_NAN_GUARD", "1").strip().lower()
+    return v not in ("0", "false", "off", "no")
+
+
+def nan_restore_k() -> int:
+    """``PADDLE_TPU_NAN_RESTORE_K=K``: after K CONSECUTIVE non-finite
+    (skipped) train steps, ``Model.fit`` restores the TrainStep from its
+    last-good host snapshot (taken at drain boundaries while healthy).
+    0 (default) = never restore — skipping alone is usually enough, and
+    the snapshot costs a host copy of params+opt state, so it is strictly
+    opt-in."""
+    try:
+        return max(0, int(os.environ.get("PADDLE_TPU_NAN_RESTORE_K", "0")))
+    except ValueError:
+        return 0
+
+
+def request_ttl_s() -> float | None:
+    """Default per-request serving deadline (``PADDLE_TPU_REQUEST_TTL_S``
+    seconds, None = off): a request still QUEUED this long after submit
+    is shed with the ``timeout`` status instead of occupying a slot
+    (``DecodeServer.submit(ttl_s=...)`` overrides per request).  Host
+    scheduling only — never a jit-cache key."""
+    v = os.environ.get("PADDLE_TPU_REQUEST_TTL_S", "").strip()
+    if not v:
+        return None
+    try:
+        ttl = float(v)
+    except ValueError:
+        return None
+    return ttl if ttl > 0 else None
+
+
+def step_budget_s() -> float:
+    """Wall budget for one async serving step's token fetch
+    (``PADDLE_TPU_STEP_BUDGET_S`` seconds, 0 = watchdog off, the
+    default): past it the wedge watchdog marks the server wedged
+    (``/healthz`` 503), cancels the in-flight dispatch, rolls the slots
+    back, and re-decodes — unaffected requests finish with bit-identical
+    tokens.  The budget must comfortably exceed a worst-case honest step
+    (compile excluded — warm up first)."""
+    try:
+        return max(0.0, float(
+            os.environ.get("PADDLE_TPU_STEP_BUDGET_S", "0")))
+    except ValueError:
+        return 0.0
+
+
+def prefetch_retries() -> int:
+    """Bounded re-read retries for a ``DevicePrefetcher`` worker whose
+    source iterator raises a transient error
+    (``PADDLE_TPU_PREFETCH_RETRIES``, default 2; resilience off = 0)."""
+    if not resilience_enabled():
+        return 0
+    try:
+        return max(0, int(os.environ.get("PADDLE_TPU_PREFETCH_RETRIES",
+                                         "2")))
+    except ValueError:
+        return 2
+
+
+def wedge_evidence_ttl_s() -> float:
+    """TTL on probe-wedge evidence (``PADDLE_TPU_WEDGE_TTL_S`` seconds,
+    default 1800): a failed-probe log entry older than this no longer
+    fail-fasts ``bench._probe_backend`` or flips ``probe_health`` to
+    wedged — a long-past wedge must not condemn a healthy machine
+    forever."""
+    try:
+        return max(0.0, float(os.environ.get("PADDLE_TPU_WEDGE_TTL_S",
+                                             "1800")))
+    except ValueError:
+        return 1800.0
 
 
 def donate_decode() -> bool:

@@ -29,11 +29,37 @@ def _to_numpy_tree(obj):
 
 
 def save(obj, path, protocol=4):
+    """Atomic checkpoint write: the tree is pickled to a sibling temp
+    file, fsync'd, and os.replace'd over ``path`` — a crash (or full
+    disk) mid-save can never corrupt the last good checkpoint, because
+    ``path`` only ever transitions between complete states.  One retry
+    on a transient I/O error (resilience layer; fail-fast with
+    ``PADDLE_TPU_RESILIENCE=0``)."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_to_numpy_tree(obj), f, protocol=protocol)
+    tree = _to_numpy_tree(obj)
+
+    def _write():
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "wb") as f:
+                pickle.dump(tree, f, protocol=protocol)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except BaseException:
+            # never leave a torn temp file beside the checkpoint
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    from .. import resilience as _resilience
+
+    _resilience.retry(_write, name="checkpoint.save", attempts=2,
+                      base=0.1, jitter=0.0, retry_on=OSError)
 
 
 def load(path, **kwargs):

@@ -283,6 +283,8 @@ class Model:
         ``io.DevicePrefetcher`` — host batch assembly + host->device DMA
         overlap the running step, ``prefetch_factor`` batches deep
         (``PADDLE_TPU_FIT_PREFETCH=0`` / ``prefetch_factor=0`` disable)."""
+        import jax.numpy as jnp  # once per fit, NOT inside the step loop
+
         from .. import flags as _flags
 
         assert self._train_step is not None or self._adapter is not None, \
@@ -299,6 +301,18 @@ class Model:
         use_async = dynamic and self._train_step.async_metrics
         use_prefetch = (dynamic and _flags.fit_prefetch()
                         and prefetch_factor and prefetch_factor > 0)
+        # non-finite guard (resilience layer): the compiled step already
+        # skipped bad updates on device; the fit loop's jobs are (a) keep
+        # skipped losses out of the epoch mean, (b) drain the skip
+        # counter into telemetry at epoch end (a boundary that already
+        # pays a host fetch), and (c) optionally restore the last good
+        # state after K consecutive skips (PADDLE_TPU_NAN_RESTORE_K) at
+        # drain boundaries
+        use_guard = dynamic and getattr(self._train_step, "nan_guard",
+                                        False)
+        restore_k = _flags.nan_restore_k() if use_guard else 0
+        if restore_k:
+            self._train_step.snapshot_state()
         # training telemetry: step-time/throughput histograms into the
         # shared registry.  Pure host timestamps around the step call —
         # under async metrics that measures DISPATCH time (the device
@@ -353,13 +367,37 @@ class Model:
                             # float (the async contract; ProgBarLogger
                             # prints at log_freq, which is a drain step).
                             lv = loss_t.value
+                            if use_guard:
+                                # a skipped step contributes 0 to the
+                                # running sum (the epoch mean divides by
+                                # the non-skipped count below) — one
+                                # tiny async select, never a host sync
+                                lv = jnp.where(self._train_step.last_good,
+                                               lv, jnp.zeros_like(lv))
                             loss_sum = lv if loss_sum is None \
                                 else loss_sum + lv
                             n_steps += 1
                             loss_rep = _host_scalar(loss_t) if drain else lv
                         else:
                             loss_rep = _host_scalar(loss_t)
-                            losses.append(loss_rep)
+                            # skip decided by the guard's OWN verdict
+                            # (last_good covers non-finite GRADS with a
+                            # finite loss, which a loss-only test would
+                            # miss); sync mode already fetches per step,
+                            # so the extra scalar fetch matches its cost
+                            # model
+                            skipped = use_guard and not bool(np.asarray(
+                                self._train_step.last_good))
+                            if not skipped:
+                                losses.append(loss_rep)
+                    if use_guard and restore_k and dynamic \
+                            and log_freq and step % log_freq == 0:
+                        # log_freq boundary (NOT every sync-mode step —
+                        # a healthy check refreshes the snapshot, an
+                        # O(model-size) host copy): one scalar fetch
+                        # decides whether the last-good snapshot comes
+                        # back
+                        self._train_step.maybe_restore(restore_k)
                     if tel:
                         step_wall = time.perf_counter() - t_step0
                         _telemetry.observe("train.step_ms",
@@ -401,9 +439,15 @@ class Model:
                 if samples and ep_dt > 0:
                     _telemetry.set_gauge("train.samples_per_s",
                                          samples / ep_dt)
+            # guard drain: ONE skip-counter fetch per epoch, counted into
+            # train.nonfinite_skips — skipped steps contributed 0 to the
+            # running sum, so the async mean divides by the good count
+            epoch_skips = (self._train_step.drain_nonfinite()
+                           if use_guard else 0)
             if loss_sum is not None:
                 # ONE host fetch for the whole async epoch
-                epoch_logs = {"loss": _host_scalar(loss_sum) / n_steps}
+                epoch_logs = {"loss": _host_scalar(loss_sum)
+                              / max(1, n_steps - epoch_skips)}
             else:
                 epoch_logs = {"loss": float(np.mean(losses))
                               if losses else 0.0}

@@ -17,6 +17,7 @@ import ctypes
 import os
 import queue
 import threading
+import time
 from typing import Iterator, Sequence
 
 import numpy as np
@@ -115,15 +116,31 @@ class TokenShardReader:
 
 class DevicePrefetcher:
     """Background thread that moves host batches to the device ahead of the
-    consumer (the buffered_reader double-buffer role; PJRT does the DMA)."""
+    consumer (the buffered_reader double-buffer role; PJRT does the DMA).
+
+    Resilience (PADDLE_TPU_RESILIENCE): a worker exception PROPAGATES to
+    the consuming loop (``Model.fit`` raises, never hangs on the bounded
+    queue), after up to ``retries`` bounded re-read attempts on the
+    source iterator (``PADDLE_TPU_PREFETCH_RETRIES``, default 2 — a
+    transient shard-read error should not kill an epoch; a generator
+    that died stays dead and propagates immediately).  The consumer side
+    also polls worker liveness, so even a violently killed worker thread
+    ends iteration with the error instead of a deadlock."""
 
     def __init__(self, it, depth: int = 2, device=None, sharding=None,
-                 transform=None):
+                 transform=None, retries: int | None = None):
         import jax
+
+        from .. import faults as _faults
+        from .. import flags as _flags
+        from .. import resilience as _resilience
+        from .. import telemetry as _telemetry
 
         self._out: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
         self._src = iter(it)
         self._stop = threading.Event()
+        retries = (_flags.prefetch_retries() if retries is None
+                   else max(0, int(retries)))
 
         def put(x):
             if transform is not None:
@@ -147,15 +164,55 @@ class DevicePrefetcher:
 
         self._err: BaseException | None = None
 
+        def next_item():
+            # one source pull, retried within bounds on a TRANSIENT
+            # error: a re-callable iterator (shard reader, DataLoader)
+            # may succeed on the next record; an exhausted generator
+            # re-raises StopIteration (never retried), and a generator
+            # that raised is dead — its retry fails fast with the same
+            # error, which is the propagation the fit loop needs.
+            if _faults.active():
+                _faults.check("prefetch", "io.prefetch")
+            return next(self._src)
+
         def worker():
-            try:
-                for item in self._src:
+            fails = 0
+            last_err: BaseException | None = None
+            while not self._stop.is_set():
+                try:
+                    item = next_item()
+                    fails = 0
+                    last_err = None
+                except StopIteration:
+                    # a GENERATOR that raised is dead: its retry pull
+                    # lands here as StopIteration, not the original
+                    # error — surface that error, never swallow it into
+                    # a silently-short epoch
+                    if last_err is not None:
+                        self._err = last_err
+                    break
+                except BaseException as e:  # noqa: BLE001 - surfaced to
+                    # the consumer, not stderr
+                    fails += 1
+                    last_err = e
+                    if fails > retries:
+                        self._err = e
+                        break
+                    _telemetry.count("resilience.prefetch_retries")
+                    # exponential growth across CONSECUTIVE failures:
+                    # delay index = how many retries this streak has
+                    # already burned
+                    delays = _resilience.backoff_schedule(
+                        retries + 1, base=0.02, max_delay=1.0)
+                    time.sleep(delays[min(fails, len(delays)) - 1])
+                    continue
+                try:
                     if not put_q(put(item)):
                         return
-            except BaseException as e:  # surfaced to the consumer, not stderr
-                self._err = e
-            finally:
-                put_q(None)
+                except BaseException as e:  # device_put/transform failed
+                    self._err = e
+                    break
+            put_q(None)
 
         self._t = threading.Thread(target=worker, daemon=True)
         self._t.start()
@@ -171,7 +228,18 @@ class DevicePrefetcher:
 
     def __iter__(self):
         while True:
-            item = self._out.get()
+            try:
+                # bounded get + liveness poll: if the worker thread died
+                # without managing its end-of-stream sentinel, iteration
+                # must END (with its error if recorded), not deadlock on
+                # an empty bounded queue
+                item = self._out.get(timeout=0.5)
+            except queue.Empty:
+                if self._t.is_alive():
+                    continue
+                if self._err is not None:
+                    raise self._err
+                return
             if item is None:
                 if self._err is not None:
                     raise self._err

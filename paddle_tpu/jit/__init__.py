@@ -245,8 +245,28 @@ class TrainStep:
         self.grad_accum = accum
         self.async_metrics = _flags.async_train() if async_metrics is None \
             else bool(async_metrics)
+        # non-finite step guard (resilience layer, PADDLE_TPU_NAN_GUARD):
+        # trace-time — the guard compiles a select around the optimizer
+        # update, so it resolves at construction and rides
+        # flags.train_step_key like grad_accum.  The fault harness's
+        # in-jit nan injection (PADDLE_TPU_FAULTS=nan:train_step:N)
+        # resolves here too: the spec is part of train_step_key, so a
+        # poisoned program can never be cache-confused with a clean one.
+        from .. import faults as _faults
+
+        self.nan_guard = _flags.nan_guard()
+        nan_at = _faults.nan_train_steps() if _faults.active() else ()
+        guard = self.nan_guard
+        # device-side skip accounting (never a per-step host sync): a
+        # cumulative skip counter and a consecutive-skip streak, drained
+        # by Model.fit at its existing fetch boundaries
+        self._skips = None
+        self._consec = None
+        self._skips_reported = 0
+        self._snapshot = None     # last-good host copy (restore path)
+        self.last_good = None     # device bool of the latest step
         self.trace_key = (accum, bool(remat), bool(donate),
-                          bool(return_outputs))
+                          bool(return_outputs), guard, nan_at)
         # lazy sync: skip the per-step Layer write-back; parameters are
         # written back on checkpoint/eval/explicit sync_to_model() only.
         # While stale, the Layer's Parameters point at DONATED buffers —
@@ -343,9 +363,43 @@ class TrainStep:
                 loss_of = micro_grads(buffers, key, batch)
                 (loss, (new_buf, out)), grads = jax.value_and_grad(
                     loss_of, has_aux=True)(params)
+            if nan_at:
+                # deterministic chaos: multiply poisons loss AND grads on
+                # the targeted (1-based) steps (0 = every step), exactly
+                # like a real numeric blow-up would — the guard below
+                # (or, with the guard off, the parameters themselves)
+                # sees honest NaNs
+                bad = jnp.bool_(0 in nan_at)
+                for n in nan_at:
+                    if n > 0:
+                        bad = jnp.logical_or(bad,
+                                             jnp.int32(step + 1) == n)
+                poison = jnp.where(bad, jnp.float32(jnp.nan),
+                                   jnp.float32(1.0))
+                loss = (loss * poison).astype(loss.dtype)
+                grads = jax.tree_util.tree_map(
+                    lambda g: g * poison.astype(g.dtype), grads)
             new_params, new_opt = optimizer.apply_gradients(grads, params, opt_state,
                                                             lr=lr, step=step + 1)
-            return new_params, new_buf, new_opt, loss, out
+            if not guard:
+                return (new_params, new_buf, new_opt, loss, out,
+                        jnp.bool_(True))
+            # non-finite guard (reference check_nan_inf as a SURVIVABLE
+            # runtime feature, not a crash): a step whose loss or any
+            # gradient is non-finite applies NO update — params, opt
+            # state, and buffers carry through unchanged (the select
+            # composes with donation: XLA reads the donated operand
+            # before overwriting it).  The raw (possibly NaN) loss is
+            # still returned — callers must see the truth; fit keeps
+            # skipped losses out of its epoch mean.
+            good = jax.tree_util.tree_reduce(
+                lambda a, g: jnp.logical_and(a, jnp.all(jnp.isfinite(g))),
+                grads, jnp.isfinite(loss.astype(jnp.float32)))
+            keep = lambda n, o: jnp.where(good, n, o)  # noqa: E731
+            new_params = jax.tree_util.tree_map(keep, new_params, params)
+            new_opt = jax.tree_util.tree_map(keep, new_opt, opt_state)
+            new_buf = jax.tree_util.tree_map(keep, new_buf, buffers)
+            return new_params, new_buf, new_opt, loss, out, good
 
         donate_args = (0, 2) if donate else ()
         # compile telemetry: the first __call__ (where tracing + XLA
@@ -387,9 +441,18 @@ class TrainStep:
         lr = self._current_lr()
         # pass the 0-based step; step_fn's +1 makes Adam's first update t=1
         (self._params, self._buffers, self._opt_state, loss,
-         out) = self._compiled(
+         out, good) = self._compiled(
             self._params, self._buffers, self._opt_state, key, lr, self._step, *arr
         )
+        if self.nan_guard:
+            # device-side skip accounting: two tiny async adds, never a
+            # host sync — drained at Model.fit's existing fetch points
+            self.last_good = good
+            inc = jnp.where(good, 0, 1).astype(jnp.int32)
+            self._skips = inc if self._skips is None else self._skips + inc
+            self._consec = jnp.where(
+                good, 0, inc if self._consec is None
+                else self._consec + inc).astype(jnp.int32)
         self.last_outputs = _wrap(out) if self._return_outputs else None
         self._step += 1
         if self.lazy_sync:
@@ -408,6 +471,81 @@ class TrainStep:
             debugger.assert_finite({"loss": loss}, "train step loss")
             debugger.assert_finite(self._params, "parameters after step")
         return Tensor(loss, stop_gradient=True)
+
+    # -- non-finite guard: drain / snapshot / restore -----------------------
+
+    @property
+    def nonfinite_skips(self) -> int:
+        """Total steps the guard skipped (ONE host fetch — call at
+        drain boundaries, not per step)."""
+        if self._skips is None:
+            return 0
+        import numpy as np
+
+        return int(np.asarray(self._skips))
+
+    def drain_nonfinite(self) -> int:
+        """Host-fetch the skip counter and return the DELTA since the
+        last drain, counting it into ``train.nonfinite_skips``.  One
+        fetch; Model.fit calls this at epoch end (a boundary that
+        already pays a host sync)."""
+        if not self.nan_guard or self._skips is None:
+            return 0
+        import numpy as np
+
+        from .. import telemetry as _telemetry
+
+        total = int(np.asarray(self._skips))
+        delta = total - self._skips_reported
+        self._skips_reported = total
+        if delta > 0:
+            _telemetry.count("train.nonfinite_skips", delta)
+        return delta
+
+    def snapshot_state(self):
+        """Host copy of the current (presumed good) train state — the
+        restore point for ``maybe_restore``.  A HOST copy on purpose:
+        donation deletes old device buffers every step, so a by-reference
+        snapshot would be dead by the time it is needed."""
+        import numpy as np
+
+        self._snapshot = (
+            jax.tree_util.tree_map(np.asarray, self._params),
+            jax.tree_util.tree_map(np.asarray, self._buffers),
+            jax.tree_util.tree_map(np.asarray, self._opt_state),
+            self._step)
+
+    def maybe_restore(self, k: int) -> bool:
+        """Drain-boundary restore check (``PADDLE_TPU_NAN_RESTORE_K``):
+        with >= ``k`` CONSECUTIVE skipped steps, roll params/opt state
+        back to the last snapshot (counting ``train.nonfinite_restores``)
+        and return True; while healthy (streak 0), refresh the snapshot
+        instead.  One scalar fetch per call — drain boundaries only."""
+        if not self.nan_guard or k <= 0:
+            return False
+        import numpy as np
+
+        consec = (0 if self._consec is None
+                  else int(np.asarray(self._consec)))
+        if consec == 0:
+            self.snapshot_state()
+            return False
+        if consec < k or self._snapshot is None:
+            return False
+        from .. import telemetry as _telemetry
+
+        params, buffers, opt, step = self._snapshot
+        self._params = jax.tree_util.tree_map(jnp.asarray, params)
+        self._buffers = jax.tree_util.tree_map(jnp.asarray, buffers)
+        self._opt_state = jax.tree_util.tree_map(jnp.asarray, opt)
+        self._step = step
+        self._consec = None
+        if self.lazy_sync:
+            self._model_stale = True
+        else:
+            self.sync_to_model()
+        _telemetry.count("train.nonfinite_restores")
+        return True
 
     def sync_to_model(self):
         """Write the functional state back into the Layer's Parameters (for
@@ -515,11 +653,14 @@ class TranslatedTrainStep:
                for b in batch]
         self._check_batch(arr)
         key = self._rand.next_key()
-        (self._params, self._buffers, self._opt_state, loss,
-         _out) = self._call(
+        # programs exported with the non-finite guard carry a trailing
+        # ``good`` flag (6 outputs); pre-guard artifacts have 5
+        res = self._call(
             self._params, self._buffers, self._opt_state, key,
             jnp.float32(self._lr if lr is None else lr),
             jnp.int32(self._step), *arr)
+        (self._params, self._buffers, self._opt_state, loss,
+         _out) = res[:5]
         self._step += 1
         return Tensor(loss, stop_gradient=True)
 

@@ -59,6 +59,7 @@ __all__ = [
     "chrome_events", "dump_chrome_trace", "Histogram", "Gauge",
     "MetricsServer", "note_step_time", "sample_device_stats",
     "device_feed", "probe_health", "capture_device_profile",
+    "set_runtime_wedge", "clear_runtime_wedge", "runtime_wedge",
 ]
 
 
@@ -236,6 +237,44 @@ _warn_last: dict[str, float] = {}
 # rate limit: at most one recompile warning per fn name per interval
 # (module-level so tests can shrink it)
 _WARN_INTERVAL_S = 30.0
+
+# ---------------------------------------------------------------------------
+# runtime wedge state: the resilience watchdog's live verdict
+# ---------------------------------------------------------------------------
+# Distinct from probe_health (the PROBE log's view of the tunnel): this is
+# the serving loop's own watchdog saying an in-process step blew its wall
+# budget.  /healthz folds both — either one wedges the endpoint to 503.
+# State lives here (not in resilience.py) so the HTTP handler needs no
+# import cycle: resilience -> telemetry only.
+_runtime_wedge_lock = threading.Lock()
+_runtime_wedge: dict = {"wedged": False, "reason": None, "since": None,
+                        "detections": 0, "recoveries": 0}
+
+
+def set_runtime_wedge(reason: str) -> None:
+    """Mark the process wedged (watchdog verdict) — /healthz answers 503
+    until :func:`clear_runtime_wedge`."""
+    with _runtime_wedge_lock:
+        _runtime_wedge["wedged"] = True
+        _runtime_wedge["reason"] = str(reason)
+        _runtime_wedge["since"] = time.time()
+        _runtime_wedge["detections"] += 1
+
+
+def clear_runtime_wedge() -> None:
+    """The loop recovered (a full step completed after a wedge) —
+    /healthz flips back to ok."""
+    with _runtime_wedge_lock:
+        if _runtime_wedge["wedged"]:
+            _runtime_wedge["recoveries"] += 1
+        _runtime_wedge["wedged"] = False
+        _runtime_wedge["reason"] = None
+        _runtime_wedge["since"] = None
+
+
+def runtime_wedge() -> dict:
+    with _runtime_wedge_lock:
+        return dict(_runtime_wedge)
 
 
 def hist(name: str) -> Histogram:
@@ -866,7 +905,7 @@ def render_prometheus() -> str:
 
 
 def probe_health(path: str | None = None,
-                 wedge_window_s: float = 1800.0) -> dict:
+                 wedge_window_s: float | None = None) -> dict:
     """Probe/wedge state from the tunnel-probe evidence log
     (``tpu_probe_log.jsonl`` — tools/probe_tpu.py appends one line per
     attempt).  Resolution: explicit ``path`` > ``PADDLE_TPU_PROBE_LOG``
@@ -878,7 +917,12 @@ def probe_health(path: str | None = None,
     the window — the fail-fast evidence bench._recent_probe_wedge
     consults), ``stale`` (last entry — healthy or not — older than the
     window: the probe process itself may be dead, so the log is no
-    longer evidence either way), ``unknown`` (no log)."""
+    longer evidence either way), ``unknown`` (no log).  The window
+    defaults to ``flags.wedge_evidence_ttl_s`` (``PADDLE_TPU_WEDGE_TTL_S``,
+    1800 s) — the same TTL that stops a long-past wedge fail-fasting
+    ``bench._probe_backend`` forever."""
+    if wedge_window_s is None:
+        wedge_window_s = _flags.wedge_evidence_ttl_s()
     path = path or os.environ.get("PADDLE_TPU_PROBE_LOG")
     if path is None:
         path = "tpu_probe_log.jsonl"
@@ -996,13 +1040,19 @@ class MetricsServer:
                 elif self_h.path.startswith("/healthz"):
                     probe = probe_health()
                     feed = device_feed()
-                    healthy = probe["status"] != "wedged"
+                    wedge = runtime_wedge()
+                    # two wedge authorities, either one 503s: the probe
+                    # log (tunnel-level evidence) and the in-process
+                    # resilience watchdog (a live step blew its budget)
+                    healthy = (probe["status"] != "wedged"
+                               and not wedge["wedged"])
                     body = json.dumps({
                         "ok": healthy,
                         "telemetry_enabled": enabled(),
                         "device_feed_enabled":
                             _flags.device_feed_enabled(),
                         "probe": probe,
+                        "runtime_wedge": wedge,
                         "platform": feed.get("platform"),
                         "device_kind": feed.get("device_kind"),
                         "instrumented_steps": sorted(feed["steps"]),
@@ -1068,6 +1118,13 @@ class MetricsServer:
         with contextlib.suppress(Exception):
             self._httpd.shutdown()
             self._httpd.server_close()
+        # join the serve_forever thread (bounded): interpreter exit after
+        # a fault must never hang on a half-shut HTTP server.  The thread
+        # is a daemon, so a pathological join timeout still cannot pin
+        # the process — the bound is about making close() deterministic.
+        with contextlib.suppress(Exception):
+            if self._thread.is_alive():
+                self._thread.join(timeout=5.0)
 
 
 def serve_metrics(port: int, host: str = "127.0.0.1") -> MetricsServer:

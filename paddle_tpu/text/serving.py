@@ -29,6 +29,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import generate, gpt
+from .. import faults as _faults
+from .. import flags as _flags
+from .. import resilience as _resilience
 from .. import telemetry as _telemetry
 
 __all__ = ["decode_step_batched", "DecodeServer"]
@@ -360,12 +363,28 @@ class DecodeServer:
         self._results: dict[int, list] = {}
         self._dropped: set[int] = set()          # rids abandoned by close()
         self._next_rid = 0
+        # resilience layer (PADDLE_TPU_RESILIENCE=0 restores fail-fast):
+        # per-request deadlines shed expired queued work, an OOM on a
+        # tick engages the degradation chain (drop to sync dispatch ->
+        # halve the admitted batch -> evict lowest-priority slots ->
+        # re-tick — the reference's retry-on-OOM allocator chain at
+        # scheduler granularity), and a wall-budget watchdog recovers a
+        # wedged async step with slot state intact.
+        self._resil = _resilience.enabled()
+        self._default_ttl = _flags.request_ttl_s()
+        self._step_budget = _flags.step_budget_s()
+        self._admit_cap = max_batch     # halved by the OOM chain
+        self._status: dict[int, str] = {}   # rid -> "timeout" | "error"
+        self._wedged = False            # a wedge was detected, not yet
+        self._wedge_event = False       # ... recovered by a clean tick
+        self._in_tick = False           # guard re-entrancy (block fallback)
 
     # -- request lifecycle --------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int = 32,
                stop: list | None = None, temperature: float = 0.0,
-               top_k: int = 0, top_p: float = 1.0) -> int:
+               top_k: int = 0, top_p: float = 1.0,
+               ttl_s: float | None = None, priority: int = 0) -> int:
         """``stop``: optional list of token SEQUENCES; generation ends
         (sequence included) as soon as the generated tail matches one.
 
@@ -373,7 +392,14 @@ class DecodeServer:
         sampling — greedy at temperature 0 (the default, bit-identical
         to before); otherwise the same scale→top-k→nucleus pipeline as
         ``generate``, applied per slot so one batch can mix greedy and
-        sampled requests."""
+        sampled requests.
+
+        ``ttl_s``: per-request deadline (default from
+        ``PADDLE_TPU_REQUEST_TTL_S``; None = none) — a request still
+        QUEUED past its TTL is shed with the ``timeout`` status
+        (``result`` raises ``resilience.DeadlineExceeded``) instead of
+        occupying a slot.  ``priority`` (higher = keep longer): the OOM
+        degradation chain evicts the lowest-priority slots first."""
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -392,6 +418,9 @@ class DecodeServer:
             raise ValueError(f"temperature must be >= 0, got {temperature}")
         if not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        ttl = self._default_ttl if ttl_s is None else float(ttl_s)
+        if ttl is not None and ttl <= 0:
+            raise ValueError(f"ttl_s must be > 0, got {ttl}")
         rid = self._next_rid
         self._next_rid += 1
         self._queue.append({"rid": rid, "prompt": prompt,
@@ -399,15 +428,63 @@ class DecodeServer:
                             "temperature": float(temperature),
                             "top_k": min(int(top_k), self.cfg.vocab_size),
                             "top_p": float(top_p),
-                            "t_submit": time.perf_counter()})
+                            "ttl": ttl, "priority": int(priority),
+                            "t_submit": time.perf_counter(),
+                            "t_enqueue": time.perf_counter()})
         if self._tel:
             _telemetry.count("serving.requests_submitted")
         self._admit()
         self._tel_gauges()
         return rid
 
+    def _shed_expired(self):
+        """Deadline shedding: drop queued requests past their TTL with
+        the ``timeout`` status — they never occupy a slot, and
+        ``result()`` raises ``resilience.DeadlineExceeded`` for them.
+        Host-clock arithmetic only; active slots are never shed (their
+        device work is already paid for)."""
+        if not self._resil or not self._queue:
+            return
+        now = time.perf_counter()
+        kept = []
+        for req in self._queue:
+            ttl = req.get("ttl")
+            # the deadline bounds QUEUE WAIT (time in this queue entry),
+            # not total request age: an OOM-evicted request re-enqueues
+            # with a fresh t_enqueue so server-side eviction can never
+            # turn its TTL into a total-age limit and discard paid-for
+            # progress
+            if ttl is not None \
+                    and now - req.get("t_enqueue", req["t_submit"]) > ttl:
+                rid = req["rid"]
+                self._status[rid] = "timeout"
+                if self._tel:
+                    _telemetry.count("serving.requests_shed")
+                    _telemetry.count("resilience.deadline_sheds")
+                    _telemetry.event("serving.shed", req["t_submit"], now,
+                                     rid=rid, ttl_s=ttl)
+            else:
+                kept.append(req)
+        self._queue[:] = kept
+
+    def _fail_request(self, st, slot, reason: str):
+        """Retire one request with the ``error`` status (NaN guard):
+        the slot frees for the next tenant, the server lives."""
+        rid = st["rid"]
+        self._status[rid] = "error"
+        self._free.append(slot)
+        if self._tel:
+            _telemetry.count("serving.requests_failed")
+            _telemetry.count("resilience.nan_requests")
+            _telemetry.event("serving.request_failed",
+                             st.get("t_submit", time.perf_counter()),
+                             time.perf_counter(), tid=slot, rid=rid,
+                             reason=reason)
+
     def _admit(self):
-        while self._queue and self._free:
+        self._shed_expired()
+        while self._queue and self._free \
+                and len(self._slots) < self._admit_cap:
             slot = self._free.pop()
             req = self._queue.pop(0)
             t_admit = time.perf_counter()
@@ -417,7 +494,19 @@ class DecodeServer:
                 "temperature": req.get("temperature", 0.0),
                 "top_k": req.get("top_k", 0),
                 "top_p": req.get("top_p", 1.0),
-                "generated": [],
+                # an OOM-evicted request re-admits with its progress
+                # carried: prompt = original + generated-so-far, and
+                # ``carry`` seeds the generated list so result() returns
+                # the FULL generation.  ``base`` is the ORIGINAL prompt
+                # length — carried tokens appear in BOTH the extended
+                # prompt and ``generated``, so the feed index is
+                # sequence[i] = prompt[i] while i < len(prompt), else
+                # generated[i - base] (i - len(prompt) would skip the
+                # carry and re-feed from the wrong offset)
+                "generated": list(req.get("carry", ())),
+                "base": len(req["prompt"]) - len(req.get("carry", ())),
+                "ttl": req.get("ttl"),
+                "priority": req.get("priority", 0),
                 "pos": 0,   # next position == index of the token to feed
                 # span timestamps (host clock only; never a device sync)
                 "t_submit": req.get("t_submit", t_admit),
@@ -430,52 +519,77 @@ class DecodeServer:
             if self._prefill is not None or self._prefill_chunk is not None:
                 n = len(req["prompt"])
                 prefill_calls = 1
-                if self._prefill is not None:
-                    bucket = 1
-                    while bucket < n:
-                        bucket *= 2
-                    # the padded chunk must fit both the wpe table and
-                    # the cache window; both bounds >= n (submit checked)
-                    bucket = min(bucket, self.max_len,
-                                 self.cfg.max_seq_len)
-                    prefill_name = f"prefill@{bucket}"
-                    padded = np.zeros((1, bucket), np.int32)
-                    padded[0, :n] = req["prompt"]
-                    logits, self.cache = self._prefill(bucket)(
-                        self.params, self.cache, jnp.asarray(padded),
-                        jnp.asarray(n), jnp.asarray(slot))
-                else:
-                    # fixed-chunk walk: every chunk reuses ONE
-                    # executable.  The LAST window starts at n - C
-                    # (overlapping the previous chunk) instead of
-                    # overrunning the cache/wpe bounds — overlapped rows
-                    # recompute to identical values (deterministic
-                    # function of the same tokens + already-correct
-                    # prefix), and dynamic_update_slice would otherwise
-                    # CLAMP an overrunning start and silently shift the
-                    # written rows (_chunk_attend_block's precondition)
-                    C = self._chunk
-                    if n <= C:
-                        starts = [0]
-                    else:
-                        starts = list(range(0, n - C, C)) + [n - C]
-                    prefill_calls = len(starts)
-                    prefill_name = "prefill_chunk"
-                    logits = None
-                    for i in starts:
-                        chunk = req["prompt"][i:i + C]
-                        padded = np.zeros((1, C), np.int32)
-                        padded[0, :len(chunk)] = chunk
-                        logits, self.cache = self._prefill_chunk(
+                try:
+                    if self._prefill is not None:
+                        bucket = 1
+                        while bucket < n:
+                            bucket *= 2
+                        # the padded chunk must fit both the wpe table
+                        # and the cache window; both bounds >= n (submit
+                        # checked)
+                        bucket = min(bucket, self.max_len,
+                                     self.cfg.max_seq_len)
+                        prefill_name = f"prefill@{bucket}"
+                        padded = np.zeros((1, bucket), np.int32)
+                        padded[0, :n] = req["prompt"]
+                        logits, self.cache = self._prefill(bucket)(
                             self.params, self.cache, jnp.asarray(padded),
-                            jnp.asarray(i), jnp.asarray(len(chunk)),
-                            jnp.asarray(slot))
-                # one host fetch of the admission logits; the timestamp
-                # right after it bounds the DEVICE window (the sampling
-                # below is pure host math and must not be charged to the
-                # prefill executable's step wall)
-                logits_np = np.asarray(logits)
+                            jnp.asarray(n), jnp.asarray(slot))
+                    else:
+                        # fixed-chunk walk: every chunk reuses ONE
+                        # executable.  The LAST window starts at n - C
+                        # (overlapping the previous chunk) instead of
+                        # overrunning the cache/wpe bounds — overlapped
+                        # rows recompute to identical values
+                        # (deterministic function of the same tokens +
+                        # already-correct prefix), and
+                        # dynamic_update_slice would otherwise CLAMP an
+                        # overrunning start and silently shift the
+                        # written rows (_chunk_attend_block's
+                        # precondition)
+                        C = self._chunk
+                        if n <= C:
+                            starts = [0]
+                        else:
+                            starts = list(range(0, n - C, C)) + [n - C]
+                        prefill_calls = len(starts)
+                        prefill_name = "prefill_chunk"
+                        logits = None
+                        for i in starts:
+                            chunk = req["prompt"][i:i + C]
+                            padded = np.zeros((1, C), np.int32)
+                            padded[0, :len(chunk)] = chunk
+                            logits, self.cache = self._prefill_chunk(
+                                self.params, self.cache,
+                                jnp.asarray(padded),
+                                jnp.asarray(i), jnp.asarray(len(chunk)),
+                                jnp.asarray(slot))
+                    # one host fetch of the admission logits; the
+                    # timestamp right after it bounds the DEVICE window
+                    # (the sampling below is pure host math and must not
+                    # be charged to the prefill executable's step wall)
+                    logits_np = np.asarray(logits)
+                except Exception:
+                    # a failed admission prefill (e.g. a real OOM the
+                    # guard will degrade around) must neither lose the
+                    # request nor leak the slot: both go back where they
+                    # came from before the error propagates
+                    self._free.append(slot)
+                    self._queue.insert(0, req)
+                    raise
                 t_prefill_done = time.perf_counter()
+                if _faults.active():
+                    logits_np = _faults.corrupt_nan("logits", logits_np)
+                if self._resil and not np.isfinite(logits_np).all():
+                    # NaN guard at admission: the logits are ALREADY on
+                    # the host, so the finite check costs no extra sync.
+                    # A poisoned request fails cleanly (status "error",
+                    # slot freed) instead of feeding garbage tokens —
+                    # with resilience off the garbage argmax proceeds,
+                    # exactly the pre-guard behavior.
+                    self._fail_request(st, slot,
+                                       "non-finite prefill logits")
+                    continue
                 if st["temperature"] > 0.0:
                     # admission draws host-side from the filtered law,
                     # seeded per rid off the server key — deterministic
@@ -510,9 +624,10 @@ class DecodeServer:
                         f"serving.{prefill_name}",
                         (t_prefill_done - t_admit) / prefill_calls)
                     _telemetry.count("serving.tokens_generated")
-                if (st["max_new"] <= 1
-                        or (self.eos_id is not None and t == self.eos_id)
-                        or _hits_stop(st)):
+                # _finished (not the old max_new <= 1 test): a carried
+                # (OOM-evicted, re-admitted) request may hit its budget
+                # on the admission token
+                if self._finished(st, t):
                     self._results[st["rid"]] = st["generated"]
                     self._free.append(slot)
                     self._tel_retire(st, slot)
@@ -534,9 +649,18 @@ class DecodeServer:
         (correctness is unaffected; the cache exists to avoid recompiles,
         not to carry state).  The LRU bound on _STEP_CACHE already caps
         growth; close() is for eagerly dropping a cycled-out model's
-        executables (and their implicit param refs)."""
+        executables (and their implicit param refs).
+
+        Shutdown hardening: the in-flight async dispatch is CANCELLED
+        (its device tokens are never fetched — a wedged step cannot hang
+        interpreter exit), the metrics HTTP server thread is joined with
+        a bound, and a runtime-wedge verdict this server raised is
+        cleared so a later server's /healthz starts clean.  Idempotent."""
+        if self._wedged:
+            self._wedged = False
+            _telemetry.clear_runtime_wedge()
         if self.metrics_server is not None:
-            self.metrics_server.close()
+            self.metrics_server.close()   # joins the serve thread
             self.metrics_server = None
         ck = generate._cfg_key(self.cfg)
         for k in _STEP_CACHE.keys():
@@ -554,6 +678,11 @@ class DecodeServer:
         self._slots.clear()
         self._queue.clear()
 
+    def shutdown(self):
+        """Alias for :meth:`close` (the serving-fleet idiom): cancel
+        in-flight work, join the metrics thread, drop executables."""
+        self.close()
+
     def __enter__(self):
         return self
 
@@ -562,12 +691,46 @@ class DecodeServer:
         return False
 
     def result(self, rid: int):
-        """Generated tokens (no prompt) once the request finished."""
+        """Generated tokens (no prompt) once the request finished.
+
+        A request shed past its deadline raises
+        ``resilience.DeadlineExceeded``; one failed by the NaN guard
+        raises ``RuntimeError`` — in both cases the request retired
+        CLEANLY (slot freed, server alive) and :meth:`status` reports
+        the disposition without raising."""
         if rid in self._dropped:
             raise RuntimeError(
                 f"request {rid} was abandoned unfinished when the server "
                 f"was closed")
+        disp = self._status.get(rid)
+        if disp == "timeout":
+            raise _resilience.DeadlineExceeded(
+                f"request {rid} was shed: still queued past its ttl")
+        if disp == "error":
+            raise RuntimeError(
+                f"request {rid} failed: non-finite logits (the request "
+                f"was retired cleanly; the server is still serving)")
         return self._results[rid]
+
+    def status(self, rid: int) -> str:
+        """One of ``ok`` (result ready), ``timeout`` (deadline shed),
+        ``error`` (NaN guard), ``dropped`` (abandoned by close),
+        ``active`` (decoding), ``queued``."""
+        if rid in self._results:
+            return "ok"
+        disp = self._status.get(rid)
+        if disp is not None:
+            return disp
+        if rid in self._dropped:
+            return "dropped"
+        if any(st["rid"] == rid for st in self._slots.values()) \
+                or (self._inflight is not None
+                    and any(st["rid"] == rid
+                            for _, st, _ in self._inflight["snap"])):
+            return "active"
+        if any(req["rid"] == rid for req in self._queue):
+            return "queued"
+        raise KeyError(f"unknown request id {rid}")
 
     # -- one tick: a single batched device step -----------------------------
 
@@ -588,8 +751,12 @@ class DecodeServer:
         for slot, st in self._slots.items():
             i = st["pos"]
             np_ = len(st["prompt"])
+            # base = original prompt length (differs from len(prompt)
+            # only for OOM-evicted re-admissions, whose carried tokens
+            # live in both the extended prompt and generated)
+            base = st.get("base", np_)
             tok[slot] = (st["prompt"][i] if i < np_
-                         else st["generated"][i - np_])
+                         else st["generated"][i - base])
             pos[slot] = i
         return tok, pos
 
@@ -693,7 +860,198 @@ class DecodeServer:
             st["t_last"] = now
         _telemetry.count("serving.tokens_generated", total)
 
+    # -- resilience: guarded ticks, the OOM chain, wedge recovery -----------
+
+    def _fault_check(self, kind: str):
+        """Deterministic fault-injection hook, placed exactly where a
+        real device OOM would surface (just before the jitted step
+        call, with no host state mutated yet — so a retried tick is
+        bit-exact).  No-op unless ``PADDLE_TPU_FAULTS`` installed.
+        Fires REGARDLESS of the resilience switch: with
+        ``PADDLE_TPU_RESILIENCE=0`` the injected fault propagates
+        uncaught — fail-fast parity is part of the chaos contract."""
+        if _faults.active():
+            # async dispatch sites do NOT consume wedge faults: their
+            # fetch (_process_inflight) has a real hang hook, which is
+            # where a wedge belongs.  Sync sites have no hang hook, so
+            # there a wedge spec raises InjectedWedge LOUDLY (faults.py's
+            # no-silent-no-op promise) instead of vacuously passing a
+            # drill — wedge recovery is an async-dispatch feature.
+            kinds = (("oom", "error") if kind.startswith("async")
+                     else ("oom", "error", "wedge"))
+            _faults.check("tick", f"serving.{kind.split('@')[0]}",
+                          f"serving.{kind}", kinds=kinds)
+
+    def _guarded(self, fn):
+        """Run one tick under the resilience guard: an allocator OOM
+        engages the degradation chain (``_oom_degrade``) and re-ticks;
+        anything else — or an OOM with the chain exhausted, or the
+        cache's donated buffers already consumed — propagates (honest
+        fail-fast).  A clean tick after a wedge recovery flips the
+        runtime-wedge verdict back to healthy (/healthz 503 -> ok)."""
+        if not self._resil or self._in_tick:
+            return fn()
+        self._in_tick = True
+        self._wedge_event = False
+        try:
+            while True:
+                try:
+                    out = fn()
+                except Exception as e:  # noqa: BLE001 - classified below
+                    if _resilience.is_oom(e) and self._oom_degrade(e):
+                        continue
+                    raise
+                if self._wedged and not self._wedge_event:
+                    # a full tick completed after the wedge: recovered
+                    self._wedged = False
+                    _telemetry.clear_runtime_wedge()
+                    if self._tel:
+                        _telemetry.count("resilience.wedge_recoveries")
+                return out
+        finally:
+            self._in_tick = False
+
+    def _cache_consumed(self) -> bool:
+        """True when any cache leaf's donated buffer is already deleted
+        (the failing step consumed it): a re-tick would touch dead
+        buffers, so the OOM chain must fail fast instead."""
+        try:
+            return any(getattr(v, "is_deleted", lambda: False)()
+                       for v in (self.cache or {}).values())
+        except Exception:  # noqa: BLE001 - can't tell = don't retry
+            return True
+
+    def _oom_degrade(self, exc) -> bool:
+        """One link of the retry-on-OOM chain (the reference allocator's
+        retry chain at scheduler granularity).  Returns True when a
+        degradation was applied and the tick should retry:
+
+        1. async -> sync dispatch (drains the in-flight step first: its
+           tokens are real work, never discarded on this path);
+        2. halve the admitted batch (future admissions; active slots
+           beyond the cap are evicted back to the queue with their
+           progress carried);
+        3. evict the lowest-priority slot (ties: youngest first).
+
+        Every engaged link counts ``resilience.oom_retries``."""
+        if self._cache_consumed():
+            return False
+        applied = None
+        if self._async:
+            try:
+                self._drain_inflight()
+            except Exception:  # noqa: BLE001 - the drain itself failing:
+                # _drain_inflight already rolled the scheduler back (the
+                # in-flight record is cancelled inside), so the retry
+                # below re-decodes those steps from consistent host state
+                pass
+            self._async = False
+            applied = "sync_dispatch"
+        elif self._admit_cap > 1:
+            self._admit_cap = max(1, self._admit_cap // 2)
+            self._evict_to_cap()
+            applied = f"admit_cap={self._admit_cap}"
+        elif len(self._slots) > 1:
+            self._evict_one()
+            applied = "evict"
+        if applied is None:
+            return False
+        if self._tel:
+            _telemetry.count("resilience.oom_retries")
+            _telemetry.set_gauge("resilience.admit_cap", self._admit_cap)
+            _telemetry.event("resilience.oom_degrade",
+                             time.perf_counter(), time.perf_counter(),
+                             action=applied, error=str(exc)[:200])
+        return True
+
+    def _evict_one(self) -> bool:
+        """Evict the lowest-priority (ties: youngest) active slot back
+        to the FRONT of the queue with its progress carried — on
+        re-admission its prompt is original-prompt + generated-so-far,
+        so a greedy request still produces its exact full generation."""
+        if not self._slots:
+            return False
+        slot = min(self._slots,
+                   key=lambda s: (self._slots[s].get("priority", 0),
+                                  -self._slots[s].get("t_submit", 0.0)))
+        st = self._slots.pop(slot)
+        self._free.append(slot)
+        # full sequence = ORIGINAL prompt + generated (prompt[:base]
+        # strips a previous eviction's carry — generated already holds
+        # it, so a double-evicted request must not duplicate it)
+        base = st.get("base", len(st["prompt"]))
+        self._queue.insert(0, {
+            "rid": st["rid"],
+            "prompt": st["prompt"][:base] + st["generated"],
+            "max_new": st["max_new"], "stop": st.get("stop", []),
+            "temperature": st.get("temperature", 0.0),
+            "top_k": st.get("top_k", 0), "top_p": st.get("top_p", 1.0),
+            "ttl": st.get("ttl"), "priority": st.get("priority", 0),
+            "carry": list(st["generated"]),
+            "t_submit": st.get("t_submit", time.perf_counter()),
+            # fresh queue-entry clock: TTL bounds queue wait, and this
+            # request's wait starts over (see _shed_expired)
+            "t_enqueue": time.perf_counter(),
+        })
+        if self._tel:
+            _telemetry.count("resilience.oom_evictions")
+        return True
+
+    def _evict_to_cap(self):
+        while len(self._slots) > self._admit_cap:
+            if not self._evict_one():
+                break
+
+    def _cancel_record(self, rec):
+        """Roll the host scheduler back as if ``rec`` (an in-flight
+        dispatch record) was never dispatched: every still-active slot's
+        pos returns to its fed position and the PRNG step counter
+        rewinds, so a re-dispatch replays the SAME steps (greedy:
+        bit-identical tokens and cache rows; sampled: the same fold_in
+        schedule)."""
+        if rec is None:
+            return
+        for slot, st, i in rec["snap"]:
+            if self._slots.get(slot) is st:
+                st["pos"] = min(st["pos"], i)
+        if "step_no0" in rec:
+            self._step_no = min(self._step_no, rec["step_no0"])
+
+    def _drain_inflight(self):
+        """Fetch and process the pending async dispatch NOW (the
+        async -> sync degradation path: its tokens are real work).  If
+        the FETCH fails, the dispatch record is cancelled (slot pos +
+        step counter rolled back) before re-raising, so the caller's
+        retry re-decodes from consistent host state."""
+        prev = self._inflight
+        self._inflight = None
+        if prev is not None:
+            self._process_inflight(prev)
+
+    def _recover_wedge(self, prev, exc):
+        """The watchdog tripped: the async fetch blew its wall budget.
+        Mark the process wedged (/healthz answers 503), cancel BOTH
+        in-flight dispatches (the unfetched ``prev`` and the one
+        dispatched this tick), and roll every affected slot back to its
+        earliest dispatched position — the next ticks re-decode those
+        steps, so unaffected requests still finish with bit-identical
+        tokens (greedy decode is a deterministic function of the host
+        state just restored).  The hung fetch thread is abandoned
+        (daemon); its late result, if any, is discarded."""
+        self._wedge_event = True
+        self._wedged = True
+        _telemetry.set_runtime_wedge(str(exc))
+        self._cancel_record(self._inflight)
+        self._inflight = None
+        self._cancel_record(prev)
+        if self._tel:
+            _telemetry.event("resilience.wedge", time.perf_counter(),
+                             time.perf_counter(), error=str(exc)[:200])
+
     def tick(self):
+        self._guarded(self._tick_impl)
+
+    def _tick_impl(self):
         if self._async:
             self._tick_async()
             return
@@ -705,33 +1063,63 @@ class DecodeServer:
         tok, pos = self._feed_arrays()
         temp, tk, tp = self._sampling_arrays()
         n = self._step_no
-        self._step_no = n + 1
         if temp.any():
             kind = "sample_step"
+            self._fault_check(kind)
             fn = _get_sample_step_fn(self.cfg)
             nxt, self.cache = fn(
                 self.params, self.cache, jnp.asarray(tok),
                 jnp.asarray(pos), jax.random.fold_in(self._base_key, n),
                 jnp.asarray(temp), jnp.asarray(tk), jnp.asarray(tp))
             nxt = np.asarray(nxt)
+            logits = None
         else:
             kind = "step"
+            self._fault_check(kind)
             logits, self.cache = self._step(self.params, self.cache,
                                             jnp.asarray(tok),
                                             jnp.asarray(pos))
             nxt = np.asarray(jnp.argmax(logits, axis=-1))
+        # the step counter advances only AFTER the step call returned:
+        # a failed call (real or injected OOM) leaves host state exactly
+        # as before the tick, so the guard's retry is bit-exact
+        self._step_no = n + 1
+        # NaN guard on the tick logits (greedy path only — the sampled
+        # path fetches tokens, not logits).  The full-logits fetch is
+        # extra host traffic, so it only engages when a fault targets
+        # logits or the operator opted in (PADDLE_TPU_NAN_GUARD_SERVING)
+        nan_slots: set = set()
+        if (logits is not None and self._resil
+                and (_faults.active()
+                     or _os.environ.get("PADDLE_TPU_NAN_GUARD_SERVING",
+                                        "") == "1")):  # noqa: E129
+            lnp = np.asarray(logits)
+            if _faults.active():
+                lnp = _faults.corrupt_nan("logits", lnp)
+            finite = np.isfinite(lnp).all(axis=-1)
+            nan_slots = {s for s in self._slots if not finite[s]}
         done = []
+        failed = []
         appended = []
         for slot, st in self._slots.items():
             i = st["pos"]
             st["pos"] = i + 1
             if i < len(st["prompt"]) - 1:
                 continue                # still feeding prompt; logits unused
+            if slot in nan_slots:
+                # AFTER the prompt-feed skip: a mid-prompt slot never
+                # consumes this tick's logits, so a non-finite row there
+                # must not kill it collaterally
+                failed.append(slot)
+                continue
             t = int(nxt[slot])
             st["generated"].append(t)
             appended.append((st, 1))
             if self._finished(st, t):
                 done.append(slot)
+        for slot in failed:
+            st = self._slots.pop(slot)
+            self._fail_request(st, slot, "non-finite tick logits")
         self._tel_tokens(appended, t0, kind=kind)
         self._retire(done)
 
@@ -760,10 +1148,11 @@ class DecodeServer:
         for slot, st in self._slots.items():
             i = st["pos"]
             n_p = len(st["prompt"])
+            base = st.get("base", n_p)   # see _feed_arrays
             if i < n_p:
                 ht[slot] = st["prompt"][i]
-            elif i - n_p < len(st["generated"]):
-                ht[slot] = st["generated"][i - n_p]
+            elif i - base < len(st["generated"]):
+                ht[slot] = st["generated"][i - base]
             else:
                 # the feed token is the previous dispatch's output —
                 # still on device, unfetched
@@ -785,49 +1174,106 @@ class DecodeServer:
             return jnp.zeros((self.max_batch,), jnp.int32)
         return prev["feed"]
 
+    def _rollback_dispatch(self, snap, n):
+        """Undo one ``_dispatch_feed``'s optimistic advances after the
+        dispatch call itself failed (e.g. an injected/real OOM): the
+        jitted fn raised, so neither the cache nor ``self.cache`` was
+        reassigned — restoring pos and the step counter makes the retry
+        bit-exact."""
+        for slot, st, i in snap:
+            if self._slots.get(slot) is st:
+                st["pos"] = i
+        self._step_no = n
+
     def _dispatch_step_async(self, prev):
         ht, pm, pos, temp, tk, tp, snap = self._dispatch_feed(prev)
         n = self._step_no
         self._step_no = n + 1
         fn = _get_async_step_fn(self.cfg)
-        nxt, self.cache = fn(
-            self.params, self.cache, jnp.asarray(ht), jnp.asarray(pm),
-            self._prev_feed(prev), jnp.asarray(pos),
-            jax.random.fold_in(self._base_key, n), jnp.asarray(temp),
-            jnp.asarray(tk), jnp.asarray(tp))
+        try:
+            self._fault_check("async_step")
+            nxt, self.cache = fn(
+                self.params, self.cache, jnp.asarray(ht), jnp.asarray(pm),
+                self._prev_feed(prev), jnp.asarray(pos),
+                jax.random.fold_in(self._base_key, n), jnp.asarray(temp),
+                jnp.asarray(tk), jnp.asarray(tp))
+        except Exception:
+            self._rollback_dispatch(snap, n)
+            raise
         self._inflight = {"kind": "step", "toks": nxt, "feed": nxt,
-                          "fn": "async_step",
+                          "fn": "async_step", "step_no0": n,
                           "snap": snap, "t_disp": time.perf_counter()}
 
     def _dispatch_block_async(self, prev, block: int):
         ht, pm, pos, temp, tk, tp, snap = self._dispatch_feed(prev, block)
         n = self._step_no
         self._step_no = n + block
-        if temp.any():
-            fname = f"async_sample_block@{block}"
-            fn = _get_async_sample_block_fn(self.cfg, block)
-            toks, self.cache = fn(
-                self.params, self.cache, jnp.asarray(ht), jnp.asarray(pm),
-                self._prev_feed(prev), jnp.asarray(pos), self._base_key,
-                jnp.asarray(n), jnp.asarray(temp), jnp.asarray(tk),
-                jnp.asarray(tp))
-            feed = toks[:, -1]  # the block's last token per slot
-        else:
-            fname = f"async_block@{block}"
-            fn = _get_async_block_fn(self.cfg, block)
-            toks, self.cache, feed, _ = fn(
-                self.params, self.cache, jnp.asarray(ht), jnp.asarray(pm),
-                self._prev_feed(prev), jnp.asarray(pos))
+        try:
+            if temp.any():
+                fname = f"async_sample_block@{block}"
+                self._fault_check(fname)
+                fn = _get_async_sample_block_fn(self.cfg, block)
+                toks, self.cache = fn(
+                    self.params, self.cache, jnp.asarray(ht),
+                    jnp.asarray(pm),
+                    self._prev_feed(prev), jnp.asarray(pos),
+                    self._base_key,
+                    jnp.asarray(n), jnp.asarray(temp), jnp.asarray(tk),
+                    jnp.asarray(tp))
+                feed = toks[:, -1]  # the block's last token per slot
+            else:
+                fname = f"async_block@{block}"
+                self._fault_check(fname)
+                fn = _get_async_block_fn(self.cfg, block)
+                toks, self.cache, feed, _ = fn(
+                    self.params, self.cache, jnp.asarray(ht),
+                    jnp.asarray(pm),
+                    self._prev_feed(prev), jnp.asarray(pos))
+        except Exception:
+            self._rollback_dispatch(snap, n)
+            raise
         self._inflight = {"kind": "block", "toks": toks, "feed": feed,
                           "fn": fname, "snap": snap, "block": block,
-                          "t_disp": time.perf_counter()}
+                          "step_no0": n, "t_disp": time.perf_counter()}
 
     def _process_inflight(self, prev):
         """Fetch a completed dispatch's tokens and run the deferred host
         bookkeeping.  Slots whose request retired (or was replaced by a
         new tenant) since the dispatch are skipped — their tokens are
         the overrun the async pipeline trades for overlap."""
-        toks = np.asarray(prev["toks"])  # the ONLY device->host fetch
+        # the ONLY device->host fetch — watchdogged when a wall budget is
+        # set (PADDLE_TPU_STEP_BUDGET_S): a wedged device step must not
+        # hang the scheduler forever, so the fetch runs under
+        # resilience.call_with_budget and a blown budget triggers
+        # _recover_wedge instead of blocking.  Budget 0 (default) is the
+        # plain inline fetch — zero overhead, today's behavior.
+        try:
+            if self._resil and (self._step_budget > 0
+                                or _faults.active()):
+                def _fetch():
+                    _faults.hang("tick", "serving.fetch")
+                    return np.asarray(prev["toks"])
+
+                toks = _resilience.call_with_budget(
+                    _fetch, self._step_budget, name="serving.fetch")
+            else:
+                toks = np.asarray(prev["toks"])
+        except _resilience.WedgeError as e:
+            self._recover_wedge(prev, e)
+            return
+        except Exception:
+            # the fetch surfaced a device error (plain path included):
+            # roll the scheduler back so host state matches the last
+            # processed step, then let the guard classify (OOM chain or
+            # propagate).  Any SUCCESSOR dispatched this tick is
+            # cancelled too — its host bookkeeping assumed this record's
+            # tokens would land first, and draining it after this
+            # rollback would append its tokens out of order ahead of
+            # the re-decoded ones
+            self._cancel_record(self._inflight)
+            self._inflight = None
+            self._cancel_record(prev)
+            raise
         done = []
         appended = []
         for slot, st, i in prev["snap"]:
@@ -869,7 +1315,14 @@ class DecodeServer:
             self._admit()
             if not self._slots:
                 return
-        self._dispatch_step_async(prev)
+        try:
+            self._dispatch_step_async(prev)
+        except Exception:
+            # the dispatch failed before replacing the pipeline: restore
+            # prev (its tokens are still fetchable) so the OOM chain's
+            # sync fallback can drain it instead of losing a step
+            self._inflight = prev
+            raise
         if prev is not None:
             self._process_inflight(prev)
 
@@ -892,7 +1345,11 @@ class DecodeServer:
                 if not self._slots:
                     break
             return
-        self._dispatch_block_async(prev, block)
+        try:
+            self._dispatch_block_async(prev, block)
+        except Exception:
+            self._inflight = prev   # see _tick_async
+            raise
         if prev is not None:
             self._process_inflight(prev)
 
@@ -1035,6 +1492,9 @@ class DecodeServer:
         block = int(block)
         if block < 1:
             raise ValueError(f"block must be >= 1, got {block}")
+        self._guarded(lambda: self._tick_block_impl(block))
+
+    def _tick_block_impl(self, block: int):
         if self._async:
             self._tick_block_async(block)
             return
@@ -1056,9 +1516,9 @@ class DecodeServer:
         tok, pos = self._feed_arrays()
         temp, tk, tp = self._sampling_arrays()
         n = self._step_no
-        self._step_no = n + block
         if temp.any():
             kind = f"sample_block@{block}"
+            self._fault_check(kind)
             fn = _get_sample_block_fn(self.cfg, block)
             toks, self.cache = fn(
                 self.params, self.cache, jnp.asarray(tok),
@@ -1066,9 +1526,11 @@ class DecodeServer:
                 jnp.asarray(temp), jnp.asarray(tk), jnp.asarray(tp))
         else:
             kind = f"block@{block}"
+            self._fault_check(kind)
             fn = _get_block_fn(self.cfg, block)
             toks, self.cache, _, _ = fn(self.params, self.cache,
                                         jnp.asarray(tok), jnp.asarray(pos))
+        self._step_no = n + block   # after the call: see _tick_impl
         toks = np.asarray(toks)  # the block's single device->host fetch
         done = []
         appended = []

@@ -192,7 +192,6 @@ def test_probe_backend_fail_fast_single_short_attempt(bench, monkeypatch):
     """With a fresh failed probe already on record, _probe_backend makes
     ONE short attempt instead of the 2x240 s retry ladder."""
     import sys as _sys
-    import types
 
     calls = []
 
@@ -200,8 +199,11 @@ def test_probe_backend_fail_fast_single_short_attempt(bench, monkeypatch):
         calls.append(timeout)
         return {"ok": False, "detail": "still wedged", "elapsed_s": 1}
 
-    fake_mod = types.ModuleType("probe_tpu")
-    fake_mod.probe = fake_probe
+    # a REAL probe_tpu module instance with only probe() faked, so the
+    # test still drives the actual retry policy (probe_with_retry ->
+    # resilience.retry) end to end
+    fake_mod = bench._tool("probe_tpu")
+    monkeypatch.setattr(fake_mod, "probe", fake_probe)
     monkeypatch.setitem(_sys.modules, "probe_tpu", fake_mod)
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     _fake_probe_log(bench, monkeypatch,

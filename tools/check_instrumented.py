@@ -19,6 +19,18 @@ assumes the repo's idiom of ``jax.jit`` attribute access (a
 ``from jax import jit`` alias would evade it, and also the repo's
 review conventions).
 
+Resilience lint (PR 7): the resilience layer's value is that every
+degradation is OBSERVABLE, so two more syntactic rules run over the
+tree: (a) every call to ``resilience.retry`` must pass its ``name=``
+(the telemetry counter identity — ``retry`` counts
+``resilience.retries.<name>`` internally, so a nameless call would be
+a retry loop invisible to the registry; it is also a TypeError at
+runtime, but the lint catches sites a test never executes); (b) every
+shed/evict/degrade/recover function on the serving path (name contains
+``shed``/``evict``/``oom_degrade``/``recover_wedge``/``fail_request``)
+must contain a ``count(...)`` or ``set_runtime_wedge(...)`` call — a
+silent degradation path reads as healthy on every dashboard.
+
 Usage: ``python tools/check_instrumented.py [repo_root]`` — exits 1 and
 lists ``file:line`` for every unrouted site.  ``tests/
 test_device_telemetry.py`` runs it in tier-1, so a dodge can't merge.
@@ -38,6 +50,20 @@ SCAN = (
     os.path.join("paddle_tpu", "text", "generate.py"),
     os.path.join("paddle_tpu", "jit"),
 )
+
+# resilience lint scope: everywhere retry loops / shed sites live
+RESIL_SCAN = (
+    "paddle_tpu",
+    "bench.py",
+    "tools",
+)
+
+# a function whose name contains one of these IS a degradation site and
+# must record a telemetry counter (directly, or by delegating to another
+# marker-named site that does — _evict_to_cap -> _evict_one)
+DEGRADE_MARKERS = ("_shed", "shed_", "evict", "oom_degrade",
+                   "recover_wedge", "fail_request")
+COUNT_NAMES = {"count", "set_runtime_wedge"}
 
 
 def _call_name(node: ast.Call):
@@ -83,6 +109,49 @@ def scan_source(src: str, filename: str = "<src>") -> list:
     return violations
 
 
+def scan_resilience_source(src: str, filename: str = "<src>") -> list:
+    """Resilience-lint violations in one source string.
+
+    Rule (a): a ``retry(...)`` call (bare or attribute — the repo's only
+    ``retry`` callables are the resilience primitive and its aliases)
+    must carry a ``name=`` keyword.  Rule (b): a function whose name
+    marks it a degradation site (:data:`DEGRADE_MARKERS`) must contain a
+    call to one of :data:`COUNT_NAMES` somewhere in its body."""
+    tree = ast.parse(src, filename=filename)
+    violations = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _call_name(node) == "retry":
+            if not any(kw.arg == "name" for kw in node.keywords):
+                violations.append(
+                    (filename, node.lineno,
+                     "resilience.retry call without name= (the telemetry "
+                     "counter identity — every retry site must be "
+                     "observable)"))
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and any(m in node.name for m in DEGRADE_MARKERS):
+            counted = any(
+                isinstance(n, ast.Call)
+                and (_call_name(n) in COUNT_NAMES
+                     or any(m in (_call_name(n) or "")
+                            for m in DEGRADE_MARKERS))
+                for n in ast.walk(node))
+            if not counted:
+                violations.append(
+                    (filename, node.lineno,
+                     f"degradation site {node.name}() records no "
+                     f"telemetry counter (count/set_runtime_wedge) — "
+                     f"silent sheds read as healthy"))
+    return violations
+
+
+def _walk_py(path: str) -> list:
+    out = []
+    for dirpath, _, names in sorted(os.walk(path)):
+        out.extend(os.path.join(dirpath, f) for f in sorted(names)
+                   if f.endswith(".py"))
+    return out
+
+
 def scan_repo(root: str | None = None) -> list:
     """Violations across every scanned hot-path module."""
     if root is None:
@@ -93,10 +162,7 @@ def scan_repo(root: str | None = None) -> list:
         if os.path.isdir(path):
             # recursive: a future jit/ subpackage (the Engine refactor)
             # must not evade the lint by nesting its modules
-            for dirpath, _, names in sorted(os.walk(path)):
-                files.extend(os.path.join(dirpath, f)
-                             for f in sorted(names)
-                             if f.endswith(".py"))
+            files.extend(_walk_py(path))
         elif os.path.exists(path):
             files.append(path)
     violations = []
@@ -104,6 +170,19 @@ def scan_repo(root: str | None = None) -> list:
         with open(path, encoding="utf-8") as f:
             src = f.read()
         violations.extend(scan_source(src, os.path.relpath(path, root)))
+    # resilience lint: retry/shed observability across the wider tree
+    resil_files = []
+    for rel in RESIL_SCAN:
+        path = os.path.join(root, rel)
+        if os.path.isdir(path):
+            resil_files.extend(_walk_py(path))
+        elif os.path.exists(path):
+            resil_files.append(path)
+    for path in resil_files:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        violations.extend(
+            scan_resilience_source(src, os.path.relpath(path, root)))
     return violations
 
 

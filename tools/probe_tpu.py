@@ -112,6 +112,37 @@ def probe(timeout: float = 120.0, source: str = "probe_tpu") -> dict:
     return entry
 
 
+def probe_with_retry(timeout: float = 120.0, attempts: int = 3,
+                     source: str = "probe_tpu") -> dict:
+    """:func:`probe` under ``resilience.retry``: capped exponential
+    backoff with jitter between attempts (base 5 s, x2, cap 60 s — a
+    killed probe can renew a wedged tunnel's held claim, so growing
+    gaps give it quiet time), every attempt still appended to the
+    evidence log.  Returns the LAST entry (healthy or not, so callers
+    always get timestamped evidence); with ``PADDLE_TPU_RESILIENCE=0``
+    this is exactly one probe — fail-fast parity."""
+    try:
+        from paddle_tpu import resilience as _resilience
+    except Exception:  # noqa: BLE001 - standalone tool: degrade to one shot
+        return probe(timeout, source=f"{source} attempt 1")
+    state = {"i": 0, "entry": None}
+
+    def attempt():
+        state["i"] += 1
+        e = probe(timeout, source=f"{source} attempt {state['i']}")
+        state["entry"] = e
+        if not e["ok"]:
+            raise RuntimeError(f"probe failed: {e['detail']}")
+        return e
+
+    try:
+        return _resilience.retry(attempt, name="probe_tpu",
+                                 attempts=attempts, base=5.0, factor=2.0,
+                                 max_delay=60.0, jitter=0.2)
+    except Exception:  # noqa: BLE001 - the log entry is the verdict
+        return state["entry"]
+
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 RESULTS = os.path.join(REPO, "WATCHDOG_RESULTS.json")
 
@@ -460,6 +491,10 @@ if __name__ == "__main__":
         if "--max-hours" in sys.argv:
             mh = float(sys.argv[sys.argv.index("--max-hours") + 1])
         sys.exit(watch(iv, t, mh))
-    e = probe(t)
+    retries = 1
+    if "--retries" in sys.argv:
+        retries = int(sys.argv[sys.argv.index("--retries") + 1])
+    e = (probe_with_retry(t, attempts=retries) if retries > 1
+         else probe(t))
     print(json.dumps(e))
     sys.exit(0 if e["ok"] else 1)
