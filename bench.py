@@ -1228,6 +1228,65 @@ def _resilience_smoke():
             "tokens": sum(len(t) for t in faulted)}
 
 
+def _paged_smoke():
+    """Paged KV-cache round, run by ``--config gpt --small`` (CI): a
+    mixed-length batch must produce tokens bit-identical to the
+    contiguous slab, resident blocks must stay well under slab
+    provisioning, and a repeated-prefix workload must register prefix
+    hits — a silent paged-parity or allocator regression fails CI
+    before the layout ever defaults on."""
+    import numpy as np
+    import jax
+
+    from paddle_tpu.text import gpt, serving
+
+    cfg = gpt.GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                        num_heads=4, max_seq_len=64)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(2)
+    sys_prefix = [int(x) for x in rng.integers(1, 100, 8)]
+    prompts = [sys_prefix + [int(x) for x in rng.integers(1, 100, n)]
+               for n in (3, 5, 1)]
+
+    def serve(layout):
+        # the slab provisions max_len=64 rows for EVERY slot; the mixed
+        # 9-13-token prompts + 6 generated cross 2-3 blocks each — the
+        # resident-vs-slab gap below is the layout's whole point
+        srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=64,
+                                   layout=layout, block_size=8)
+        rids = [srv.submit(p, max_new_tokens=6) for p in prompts]
+        while srv.pending():
+            srv.tick_block(4)
+        toks = [srv.result(r) for r in rids]
+        stats = srv._pool.stats() if srv._pool is not None else None
+        srv.close()
+        return toks, stats
+
+    cont, _ = serve("contiguous")
+    paged, stats = serve("paged")
+    if paged != cont:
+        raise AssertionError(
+            f"paged smoke: paged/contiguous token divergence "
+            f"({paged} vs {cont})")
+    if stats["prefix_hits"] < 1:
+        raise AssertionError(
+            f"paged smoke: shared prefix registered no hits ({stats})")
+    # resident HBM: peak mapped blocks vs the slab's provisioning for
+    # the same server (max_batch * nmax blocks, via the real rounding)
+    from paddle_tpu.text import kv_pool as _kvp
+
+    slab_blocks = 2 * (_kvp.round_len(64, 8) // 8)
+    ratio = stats["peak_blocks_in_use"] / slab_blocks
+    if ratio > 0.5 + 1e-9:
+        raise AssertionError(
+            f"paged smoke: peak resident blocks "
+            f"{stats['peak_blocks_in_use']}/{slab_blocks} exceed 50% of "
+            f"slab provisioning for this mixed-length batch")
+    return {"ok": True, "prefix_hits": stats["prefix_hits"],
+            "cow_copies": stats["cow_copies"],
+            "resident_vs_slab": round(ratio, 3)}
+
+
 def bench_gpt(small: bool):
     if small:
         rec = _run_gpt_rung(-1)
@@ -1239,6 +1298,9 @@ def bench_gpt(small: bool):
         # round proves the retry chain + deadline shedding still work
         # (counters asserted inside)
         rec["resilience_smoke"] = _resilience_smoke()
+        # paged KV cache rides the CI smoke: parity + prefix hits +
+        # resident-blocks-vs-slab asserted (see _paged_smoke)
+        rec["paged_smoke"] = _paged_smoke()
         # provenance-schema gate (CI): a bench line whose provenance
         # block is missing or incomplete must fail the smoke — a silent
         # CPU fallback can never again ship as an unlabeled number
@@ -2130,10 +2192,115 @@ def bench_serving(small: bool):
                                 "serving")
 
 
+def bench_paged(small: bool):
+    """Paged KV cache vs the contiguous slab (round 8): a mixed-length
+    continuous-batching pass measured under both layouts — generated
+    tok/s, resident KV HBM per request (peak mapped blocks x block
+    bytes vs the slab's per-slot provisioning), and the prefix-cache
+    hit rate on a repeated-system-prompt workload.  The memory ratio is
+    the paged layout's reason to exist: a slab provisions worst-case
+    context for every slot; the pool holds only blocks actual tokens
+    crossed."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu import flags
+    from paddle_tpu.text import gpt, serving
+
+    dev = jax.devices()[0]
+    if small:
+        cfg = gpt.GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                            num_heads=4, max_seq_len=128)
+        # the CPU-small tok/s is host-dispatch-bound noise (passes are
+        # ~16 tiny dispatches); the arm's load-bearing smoke numbers are
+        # the memory ratio + hit rate, which are deterministic
+        B, max_len, new_toks, block, bs, iters = 4, 64, 8, 4, 8, 2
+        p_lens = (6, 12, 20, 9)
+    else:
+        cfg = gpt.GPTConfig(vocab_size=50304, hidden_size=1024,
+                            num_layers=24, num_heads=16, max_seq_len=2048)
+        B, max_len, new_toks, block, bs, iters = 8, 1024, 64, 32, 16, 2
+        # the mixed-length point: slots sized for 1024 rows but holding
+        # 64-320-token contexts — the slab pays 1024 rows per slot
+        # anyway, the pool pays only crossed blocks
+        p_lens = (64, 128, 256, 320, 96, 64, 192, 128)
+    rng = np.random.default_rng(0)
+    sys_prefix = [int(x) for x in rng.integers(1, cfg.vocab_size, 2 * bs)]
+    prompts = [sys_prefix + [int(x) for x in
+                             rng.integers(1, cfg.vocab_size, n)]
+               for n in p_lens]
+    params = jax.device_get(gpt.init_params(cfg, jax.random.PRNGKey(0)))
+    params = jax.tree_util.tree_map(jnp.asarray, params)
+
+    def serve_pass(layout):
+        srv = serving.DecodeServer(params, cfg, max_batch=B,
+                                   max_len=max_len, layout=layout,
+                                   block_size=bs)
+        for i, p in enumerate(prompts):
+            srv.submit(p, max_new_tokens=new_toks)
+        while srv.pending():
+            srv.tick_block(block)
+        stats = srv._pool.stats() if srv._pool is not None else None
+        toks = srv._results
+        srv.close()
+        return toks, stats
+
+    def measure(layout):
+        serve_pass(layout)                    # warm pass (compiles)
+        t0 = time.perf_counter()
+        stats = None
+        for _ in range(iters):
+            toks, stats = serve_pass(layout)
+        dt = (time.perf_counter() - t0) / iters
+        return len(prompts) * new_toks / dt, stats
+
+    cont_tok_s, _ = measure("contiguous")
+    paged_tok_s, stats = measure("paged")
+    # byte math host-side from the config (constructing a probe server
+    # would allocate a second slab-equivalent pool on device right after
+    # the measured passes): per-block bytes across every pool leaf
+    # (values + int8 scale planes)
+    from paddle_tpu.text import generate as _gen, kv_pool as _kvp
+
+    nmax = _kvp.round_len(max_len, bs) // bs
+    store_itemsize = np.dtype(_gen._kv_store_dtype(cfg)).itemsize
+    block_rows = cfg.num_layers * bs * cfg.kv_heads
+    block_bytes = 2 * block_rows * cfg.head_dim * store_itemsize
+    if store_itemsize == 1:                    # int8: fp32 scale planes
+        block_bytes += 2 * block_rows * 4
+    resident_mb = stats["peak_blocks_in_use"] * block_bytes / len(prompts) \
+        / 1e6
+    slab_mb = nmax * block_bytes / 1e6        # per-slot slab provisioning
+    hits = stats["prefix_hits"]
+    hit_rate = hits / max(1, hits + stats["prefix_misses"])
+    rec = {"metric": "tokens_per_sec_serving_paged_kv",
+           "unit": "tokens/s/chip",
+           "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
+               timespec="seconds"),
+           "device": dev.platform,
+           "device_kind": str(getattr(dev, "device_kind", "")),
+           "batch": B, "max_len": max_len, "new_tokens": new_toks,
+           "block": block, "kv_block_size": bs,
+           "prompt_lens": list(p_lens),
+           "value": round(paged_tok_s, 2),
+           "contiguous_tok_s": round(cont_tok_s, 2),
+           "paged_vs_contiguous": round(paged_tok_s / max(cont_tok_s,
+                                                          1e-9), 3),
+           "resident_hbm_per_request_mb": round(resident_mb, 3),
+           "slab_hbm_per_request_mb": round(slab_mb, 3),
+           "resident_vs_slab": round(resident_mb / max(slab_mb, 1e-9), 3),
+           "prefix_hit_rate": round(hit_rate, 3),
+           "cow_copies": stats["cow_copies"],
+           "kv_dtype": flags.kv_cache_dtype() or "compute",
+           "vs_baseline": 0.0}
+    return _stamp_provenance(rec, dev)
+
+
 _CONFIGS = {"gpt": bench_gpt, "train": bench_train, "mnist": bench_mnist,
             "resnet": bench_resnet, "bert": bench_bert, "int8": bench_int8,
             "decode": bench_decode, "decode_long": bench_decode_long,
-            "serving": bench_serving}
+            "serving": bench_serving, "paged": bench_paged}
 
 
 def main():

@@ -283,6 +283,50 @@ def kv_cache_dtype() -> str:
         f"for the model compute dtype)")
 
 
+def kv_layout() -> str:
+    """KV-cache LAYOUT for serving: 'contiguous' (default — one
+    [L, max_batch, rows, Hkv, hd] slab, every slot provisioned for the
+    worst-case context) or 'paged' (``text/kv_pool.py`` — a fixed pool of
+    [block_size]-row blocks shared by all slots through per-slot block
+    tables, with refcounted prefix reuse and copy-on-write).
+
+    ``PADDLE_TPU_KV_LAYOUT=paged`` flips the ``DecodeServer`` default;
+    ``generate.init_cache(layout=...)`` / ``DecodeServer(layout=...)``
+    override per call.  Trace-time: the two layouts compile different
+    step programs (the cache pytree structure differs), so the flag is
+    part of ``decode_jit_key`` — flipping it mid-process retraces
+    instead of silently reusing the other layout's executable."""
+    v = os.environ.get("PADDLE_TPU_KV_LAYOUT", "").strip().lower()
+    if v in ("", "contiguous", "slab"):
+        return "contiguous"
+    if v == "paged":
+        return "paged"
+    raise ValueError(
+        f"PADDLE_TPU_KV_LAYOUT={v!r}: expected contiguous|paged")
+
+
+def kv_block_size() -> int:
+    """Rows per KV-cache block under the paged layout
+    (``PADDLE_TPU_KV_BLOCK``, default 16).  Smaller blocks waste less
+    tail memory per request and share finer prefixes; larger blocks cut
+    table/grid overhead.  Must be a multiple of 8 (the decode kernel's
+    row tile).  Part of ``decode_jit_key`` — the block geometry is baked
+    into the compiled paged step."""
+    v = os.environ.get("PADDLE_TPU_KV_BLOCK", "16")
+    try:
+        bs = int(v)
+    except ValueError:
+        # raise like the sibling flags (kv_layout, kv_cache_dtype): a
+        # typo'd geometry must not silently compile a different one
+        raise ValueError(
+            f"PADDLE_TPU_KV_BLOCK={v!r}: expected an integer multiple "
+            f"of 8")
+    if bs < 8 or bs % 8:
+        raise ValueError(
+            f"PADDLE_TPU_KV_BLOCK={bs}: must be a positive multiple of 8")
+    return bs
+
+
 def telemetry_enabled() -> bool:
     """Runtime telemetry master switch (ON by default).
 
@@ -361,7 +405,11 @@ def decode_jit_key() -> tuple:
             os.environ.get("PADDLE_TPU_FUSED_LN", ""),
             os.environ.get("PADDLE_TPU_DONATE_DECODE", ""),
             os.environ.get("PADDLE_TPU_FLASH_DECODE", ""),
-            kv_cache_dtype())
+            kv_cache_dtype(),
+            # paged KV cache (text/kv_pool.py): layout + block geometry
+            # change the compiled step (block-table gathers vs slab
+            # slices), so both key the cache like the dtype does
+            kv_layout(), kv_block_size())
 
 
 if _ENV_SEEDED:

@@ -101,16 +101,29 @@ def random_filled_cache(cache: dict, key, amp: float = 1.0) -> dict:
     (scaled by ``amp``), quantizing through the real format when the
     cache carries scale planes — THE cache-format-aware fill the bench
     and on-device certification share (one copy; a format change edits
-    exactly here)."""
+    exactly here).
+
+    Paged caches (``text/kv_pool.py`` trees with a ``tables`` leaf) fill
+    the whole [L, N, bs, Hkv, hd] pool and, when the tables are still
+    unmapped (-1), lay slots out identity-style (slot b owns blocks
+    [b*nmax, (b+1)*nmax)) so the kernel-parity oracle and bench arms
+    exercise real block-table gathers without a host allocator."""
     ks = jax.random.split(key, 2)
     kf = jax.random.normal(ks[0], cache["k"].shape) * amp
     vf = jax.random.normal(ks[1], cache["v"].shape) * amp
     if "k_s" in cache:
         k, k_s = quantize_kv(kf)
         v, v_s = quantize_kv(vf)
-        return dict(cache, k=k, v=v, k_s=k_s, v_s=v_s)
-    return dict(cache, k=kf.astype(cache["k"].dtype),
-                v=vf.astype(cache["v"].dtype))
+        out = dict(cache, k=k, v=v, k_s=k_s, v_s=v_s)
+    else:
+        out = dict(cache, k=kf.astype(cache["k"].dtype),
+                   v=vf.astype(cache["v"].dtype))
+    if "tables" in out and bool((out["tables"] < 0).all()):
+        B, nmax = out["tables"].shape
+        N = out["k"].shape[1]
+        out["tables"] = (jnp.arange(B * nmax, dtype=jnp.int32)
+                         .reshape(B, nmax) % N)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -280,5 +293,224 @@ def _decode_call(q, k, v, pos, k_scale, v_scale, scale):
         ],
         interpret=_INTERPRET,
     )(*args)
+    return (out.reshape(B, Hkv, Tq, G, hd).swapaxes(1, 2)
+            .reshape(B, Tq, Hq, hd))
+
+
+# ---------------------------------------------------------------------------
+# paged (block-table) kernel — the pool layout's decode hot path
+# ---------------------------------------------------------------------------
+
+
+def gather_paged_view(k_pool, tables):
+    """Per-slot contiguous view of a pooled leaf: k_pool [N, bs, ...] +
+    tables [B, nmax] -> [B, nmax*bs, ...].  Unmapped entries (-1) clamp
+    to block 0 — their rows sit past every causal frontier (the
+    allocator maps blocks through the write position), so the garbage is
+    masked exactly like a slab's unwritten rows.  THE oracle/fallback
+    materialization; the Pallas path resolves the same table per grid
+    cell instead."""
+    idx = jnp.clip(tables, 0, k_pool.shape[0] - 1)          # [B, nmax]
+    g = k_pool[idx]                                          # [B,nmax,bs,...]
+    return g.reshape((g.shape[0], g.shape[1] * g.shape[2]) + g.shape[3:])
+
+
+def paged_supported(q_shape, pool_shape) -> bool:
+    """Static shape gate for the paged kernel: q [B, Tq, Hq, hd] against
+    a pool [N, bs, Hkv, hd] (the KV block is the pool's own block)."""
+    B, Tq, Hq, hd = q_shape
+    N, bs, Hkv = pool_shape[0], pool_shape[1], pool_shape[2]
+    return (hd in (64, 128, 256) and Hq % Hkv == 0
+            and Tq * (Hq // Hkv) <= _R_CAP
+            and bs >= 8 and bs % 8 == 0)
+
+
+def paged_available(q_shape, pool_shape) -> bool:
+    """paged_supported + a backend that can run the kernel (TPU, or
+    interpret mode for CPU tests) — the trace-time routing check
+    text/kv_pool.py consults before leaving the gather-einsum path."""
+    if not paged_supported(q_shape, pool_shape):
+        return False
+    if _INTERPRET:
+        return True
+    from ._pallas_probe import tpu_backend
+
+    return tpu_backend()
+
+
+def _xla_paged(q, k_pool, v_pool, tables, pos, k_scale, v_scale, scale):
+    """Oracle/fallback: gather the per-slot views through the tables and
+    run the contiguous XLA reference — bit-identical values to a slab
+    holding the same rows (the gather only relocates blocks)."""
+    k = gather_paged_view(k_pool, tables)
+    v = gather_paged_view(v_pool, tables)
+    ks = gather_paged_view(k_scale, tables) if k_scale is not None else None
+    vs = gather_paged_view(v_scale, tables) if v_scale is not None else None
+    return _xla_decode(q, k, v, pos, ks, vs, scale)
+
+
+def _paged_probe(q_dtype, kv_dtype, Tq: int, G: int, hd: int,
+                 bs: int) -> bool:
+    """True = fall back.  Probes the exact paged configuration the real
+    call lowers with (block geometry + dtypes + scalar-prefetch path)."""
+    from ._pallas_probe import probe_once
+
+    def thunk():
+        quant = jnp.dtype(kv_dtype) == jnp.int8
+        q = jax.device_put(jnp.zeros((1, Tq, G, hd), q_dtype))
+        kp = jax.device_put(jnp.zeros((2, bs, 1, hd), kv_dtype))
+        ks = (jax.device_put(jnp.ones((2, bs, 1), jnp.float32))
+              if quant else None)
+        tables = jax.device_put(jnp.zeros((1, 1), jnp.int32))
+        pos = jax.device_put(jnp.zeros((1,), jnp.int32))
+        return _paged_call(q, kp, kp, tables, pos, ks, ks, None)
+
+    return probe_once(
+        _FALLBACK,
+        ("paged", jnp.dtype(q_dtype).name, jnp.dtype(kv_dtype).name,
+         int(Tq), int(G), int(hd), int(bs)), thunk)
+
+
+def paged_decode_attention(q, k_pool, v_pool, tables, pos,
+                           k_scale=None, v_scale=None, scale=None):
+    """Block-table decode attention: q [B, Tq, Hq, hd] against a pooled
+    cache k/v [N, bs, Hkv, hd] addressed through ``tables`` [B, nmax]
+    int32 (physical block per logical block; -1 = unmapped) ->
+    [B, Tq, Hq, hd] (q.dtype).  ``pos`` [B] as in :func:`decode_attention`
+    — logical row t of slot b is table[b, t // bs] row t % bs, and rows
+    t <= pos[b] + i are attended.  int8 pools pass per-row scales
+    [N, bs, Hkv].  Falls back to gather + the XLA reference when the
+    Pallas path is unavailable.
+
+    Not jitted itself (the probe must execute eagerly — decode_attention's
+    rule); the grid cell resolves its T-block THROUGH the table via
+    scalar prefetch, so the HBM read is each slot's mapped blocks only —
+    never a materialized [B, T] gather — and causally-dead or unmapped
+    blocks are skipped."""
+    if not paged_supported(q.shape, k_pool.shape):
+        return _xla_paged(q, k_pool, v_pool, tables, pos, k_scale, v_scale,
+                          scale)
+    G = q.shape[2] // k_pool.shape[2]
+    bs = k_pool.shape[1]
+    if not _INTERPRET and _paged_probe(q.dtype, k_pool.dtype, q.shape[1],
+                                       G, q.shape[-1], bs):
+        return _xla_paged(q, k_pool, v_pool, tables, pos, k_scale, v_scale,
+                          scale)
+    return _paged_call(q, k_pool, v_pool, tables, pos, k_scale, v_scale,
+                       scale)
+
+
+def _paged_call(q, k_pool, v_pool, tables, pos, k_scale, v_scale, scale):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, Tq, Hq, hd = q.shape
+    N, bs, Hkv = k_pool.shape[0], k_pool.shape[1], k_pool.shape[2]
+    G = Hq // Hkv
+    R = Tq * G
+    nmax = tables.shape[1]
+    scale = scale if scale is not None else 1.0 / (hd ** 0.5)
+    quant = k_scale is not None
+
+    qh = q.reshape(B, Tq, Hkv, G, hd).swapaxes(1, 2).reshape(B, Hkv, R, hd)
+    tab = tables.astype(jnp.int32)
+    pos2 = pos.reshape(B).astype(jnp.int32)
+
+    def kernel(tab_ref, pos_ref, q_ref, k_ref, v_ref, *rest):
+        if quant:
+            ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+        else:
+            o_ref, m_scr, l_scr, acc_scr = rest
+        i = pl.program_id(0)
+        ti = pl.program_id(1)
+        b = i // Hkv
+
+        @pl.when(ti == 0)
+        def _init():
+            m_scr[:] = jnp.full_like(m_scr, _NEG)
+            l_scr[:] = jnp.zeros_like(l_scr)
+            acc_scr[:] = jnp.zeros_like(acc_scr)
+
+        p_b = pos_ref[b]
+        base = ti * bs          # LOGICAL row base of this block
+
+        # skip blocks past the causal frontier AND unmapped table slots
+        # (an unmapped block holds another tenant's rows; the allocator
+        # maps every block through the write position, so a mapped-but-
+        # stale row is already behind the mask like a slab's)
+        @pl.when((base <= p_b + Tq - 1) & (tab_ref[b, ti] >= 0))
+        def _run():
+            qb = q_ref[0, 0].astype(jnp.float32)           # [R, hd]
+            kb = k_ref[0, :, 0, :].astype(jnp.float32)     # [bs, hd]
+            vb = v_ref[0, :, 0, :].astype(jnp.float32)
+            if quant:
+                kb = kb * ks_ref[0, :, 0][:, None]
+                vb = vb * vs_ref[0, :, 0][:, None]
+            s = scale * jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)        # [R, bs]
+            rows_tq = jax.lax.broadcasted_iota(jnp.int32, (R, bs), 0) // G
+            cols = base + jax.lax.broadcasted_iota(jnp.int32, (R, bs), 1)
+            s = jnp.where(cols <= p_b + rows_tq, s, _NEG)
+            m_prev = m_scr[:, 0]
+            m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
+            p = jnp.exp(s - m_cur[:, None])
+            alpha = jnp.exp(m_prev - m_cur)
+            l_scr[:, 0] = l_scr[:, 0] * alpha + jnp.sum(p, axis=1)
+            acc_scr[:] = acc_scr[:] * alpha[:, None] + jax.lax.dot_general(
+                p, vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_scr[:, 0] = m_cur
+
+        @pl.when(ti == nmax - 1)
+        def _fin():
+            l = l_scr[:, 0]
+            l_safe = jnp.where(l == 0.0, 1.0, l)
+            o_ref[0, 0] = (acc_scr[:] / l_safe[:, None]).astype(o_ref.dtype)
+
+    # the pool block for grid cell (i, t) is resolved THROUGH the
+    # prefetched table: physical block tab[b, t] (clamped — the kernel
+    # body skips the compute for unmapped entries, but the DMA engine
+    # still needs an in-bounds address)
+    def _kv_idx(i, t, tab_ref, pos_ref):
+        pb = jnp.clip(tab_ref[i // Hkv, t], 0, N - 1)
+        return (pb, 0, i % Hkv, 0)
+
+    def _ks_idx(i, t, tab_ref, pos_ref):
+        pb = jnp.clip(tab_ref[i // Hkv, t], 0, N - 1)
+        return (pb, 0, i % Hkv)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, R, hd),
+                     lambda i, t, tab_ref, pos_ref: (i // Hkv, i % Hkv,
+                                                     0, 0)),
+        pl.BlockSpec((1, bs, 1, hd), _kv_idx),
+        pl.BlockSpec((1, bs, 1, hd), _kv_idx),
+    ]
+    args = [qh, k_pool, v_pool]
+    if quant:
+        in_specs += [pl.BlockSpec((1, bs, 1), _ks_idx),
+                     pl.BlockSpec((1, bs, 1), _ks_idx)]
+        args += [k_scale, v_scale]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B * Hkv, nmax),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, 1, R, hd),
+            lambda i, t, tab_ref, pos_ref: (i // Hkv, i % Hkv, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((R, 1), jnp.float32),
+            pltpu.VMEM((R, 1), jnp.float32),
+            pltpu.VMEM((R, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, R, hd), q.dtype),
+        interpret=_INTERPRET,
+    )(tab, pos2, *args)
     return (out.reshape(B, Hkv, Tq, G, hd).swapaxes(1, 2)
             .reshape(B, Tq, Hq, hd))

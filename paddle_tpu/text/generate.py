@@ -49,7 +49,9 @@ def _round_cache_len(n: int) -> int:
     return -(-n // 128) * 128
 
 
-def init_cache(cfg: gpt.GPTConfig, batch: int, max_len: int):
+def init_cache(cfg: gpt.GPTConfig, batch: int, max_len: int,
+               layout: str = "contiguous", block_size: int | None = None,
+               num_blocks: int | None = None):
     """Per-layer K/V cache [L, B, T, Hkv, hd] with T = ``max_len`` rounded
     up to a kernel-tileable length (_round_cache_len — extra rows stay
     masked); the caller tracks the write position (generate's scan
@@ -61,7 +63,23 @@ def init_cache(cfg: gpt.GPTConfig, batch: int, max_len: int):
     per-(position, head) fp32 scale planes ``k_s``/``v_s``
     [L, B, T, Hkv] beside the values (~hd x smaller), written by
     the same row writes and dequantized at the attention site (inside
-    the flash-decode kernel, or before the XLA einsum)."""
+    the flash-decode kernel, or before the XLA einsum).
+
+    ``layout="paged"`` returns the pooled format instead (text/kv_pool:
+    value leaves [L, num_blocks, block_size, Hkv, hd] + an int32
+    ``tables`` leaf [batch, nmax], same pytree API — HBM scales with
+    blocks actually mapped, not worst-case context).  The serving layer
+    owns the allocator; the contiguous slab stays the default
+    (``PADDLE_TPU_KV_LAYOUT`` flips ``DecodeServer``'s default)."""
+    if layout == "paged":
+        from . import kv_pool
+
+        return kv_pool.init_paged_cache(cfg, batch, max_len,
+                                        block_size=block_size,
+                                        num_blocks=num_blocks)
+    if layout not in ("contiguous", None, ""):
+        raise ValueError(
+            f"layout {layout!r}: expected 'contiguous' or 'paged'")
     L, H, hd = cfg.num_layers, cfg.kv_heads, cfg.head_dim
     dt = _kv_store_dtype(cfg)
     shape = (L, batch, _round_cache_len(max_len), H, hd)
@@ -141,14 +159,26 @@ def _attend_cache(q, full, pos, cfg: gpt.GPTConfig):
     return jnp.einsum("bkgit,btkd->bikgd", w, v_all).reshape(B, Tq, -1)
 
 
-def _cached_block(x, p, csl, pos, cfg: gpt.GPTConfig):
-    """One block on a SINGLE position [B, 1, D] against one layer's cache
-    slice ``csl`` (leaves k/v [B, T, Hkv, hd], plus scales for int8).
-    Returns (x, rows): storage-dtype row leaves for the caller to write
-    at pos."""
-    B, _, D = x.shape
-    H, hd = cfg.num_heads, cfg.head_dim
-    dt = cfg.dtype
+def _embed_step(params, token, pos, cfg: gpt.GPTConfig):
+    """Embed one decode step's tokens [B] at position ``pos`` ->
+    [B, 1, D] — the single embed+wpe shared by the contiguous decode
+    step and the paged (kv_pool) routes."""
+    x = woq.embed(params, token, cfg.dtype)[:, None]
+    if cfg.pos_embed == "learned":
+        x = x + jax.lax.dynamic_slice(
+            params["wpe"], (pos, 0),
+            (1, cfg.hidden_size)).astype(cfg.dtype)[None]
+    return x
+
+
+def _block_pre_attn(x, p, pos, cfg: gpt.GPTConfig):
+    """Pre-attention half of one decode block on a single position
+    [B, 1, D]: ln1 -> qkv projection (the Hkv heads kept, never
+    repeated) -> rope at ``pos`` -> storage-dtype rows.  Returns
+    (q3, rows); every cached-decode route (contiguous AND paged kernel)
+    shares this, so the per-layer math can never drift between them."""
+    B = x.shape[0]
+    hd = cfg.head_dim
     h = gpt._norm(x, p, "ln1", cfg)
     q3, k3, v3 = gpt._project_qkv(h, p, cfg, repeat_kv=False)
     if cfg.pos_embed == "rope":
@@ -160,7 +190,23 @@ def _cached_block(x, p, csl, pos, cfg: gpt.GPTConfig):
         k3 = gpt.apply_rope(k3, pos_arr)
     k_new = k3.reshape(B, -1, hd)   # Hkv rows under GQA, H otherwise
     v_new = v3.reshape(B, -1, hd)
-    rows = _store_rows(k_new, v_new, cfg)
+    return q3, _store_rows(k_new, v_new, cfg)
+
+
+def _block_post_attn(x, attn, p, cfg: gpt.GPTConfig):
+    """Post-attention half: output projection + residual + FFN tail
+    (the other shared side of :func:`_block_pre_attn`)."""
+    dt = cfg.dtype
+    a = woq.mm(attn, p, "proj_w", dt) + p["proj_b"].astype(dt)
+    return gpt._ffn_tail(x + a, p, cfg)
+
+
+def _cached_block(x, p, csl, pos, cfg: gpt.GPTConfig):
+    """One block on a SINGLE position [B, 1, D] against one layer's cache
+    slice ``csl`` (leaves k/v [B, T, Hkv, hd], plus scales for int8).
+    Returns (x, rows): storage-dtype row leaves for the caller to write
+    at pos."""
+    q3, rows = _block_pre_attn(x, p, pos, cfg)
     # attend over cache rows [B, max_len, Hkv, hd] with the fresh row at
     # pos — spliced in STORAGE form, so what this step attends is exactly
     # what later steps will read back (int8 included)
@@ -169,9 +215,7 @@ def _cached_block(x, p, csl, pos, cfg: gpt.GPTConfig):
                 (0, pos) + (0,) * (csl[name].ndim - 2))
             for name, val in rows.items()}
     attn = _attend_cache(q3, full, pos, cfg)           # [B, 1, D]
-    a = woq.mm(attn, p, "proj_w", dt) + p["proj_b"].astype(dt)
-    x = x + a
-    return gpt._ffn_tail(x, p, cfg), rows
+    return _block_post_attn(x, attn, p, cfg), rows
 
 
 def _write_rows(cache: dict, rows: dict, pos) -> dict:
@@ -200,11 +244,7 @@ def decode_step(params, cache, token, pos, cfg: gpt.GPTConfig):
     sequence's tokens can depend on its batch-mates (inherent to
     capacity-bounded routing, not a cache artifact)."""
     dt = cfg.dtype
-    B = token.shape[0]
-    x = woq.embed(params, token, dt)[:, None]
-    if cfg.pos_embed == "learned":
-        x = x + jax.lax.dynamic_slice(
-            params["wpe"], (pos, 0), (1, cfg.hidden_size)).astype(dt)[None]
+    x = _embed_step(params, token, pos, cfg)
 
     def body(x, layer):
         p, csl = layer

@@ -1,0 +1,553 @@
+"""Paged KV-cache subsystem: block pool, block tables, prefix reuse.
+
+The serving cache was one contiguous ``[L, max_batch, rows, Hkv, hd]``
+slab — every slot provisioned for the worst-case context, and identical
+prompt prefixes (system prompts, few-shot headers) prefilled and stored
+once per request.  This module reproduces the reference's allocator
+stack (auto-growth best-fit chunks, retry-on-OOM chains) at KV-cache
+granularity, in the mold of vLLM's PagedAttention and SGLang's
+RadixAttention:
+
+* **block pool** — device leaves ``[L, num_blocks, block_size, Hkv, hd]``
+  (int8 scale planes ``[L, N, bs, Hkv]`` ride along exactly as in the
+  contiguous layout), shared by every slot;
+* **block tables** — an int32 ``[max_batch, nmax]`` leaf mapping each
+  slot's logical block to a physical pool block (-1 = unmapped), carried
+  in the cache pytree so the jitted steps stay pure pytree-in/pytree-out
+  and donation composes unchanged;
+* **free-list allocator with refcounts** (:class:`PagedAllocator`, host
+  side) — blocks are allocated as a slot's ``pos`` crosses block
+  boundaries instead of reserving ``max_len`` rows up front, and freed or
+  dereferenced on retire;
+* **prefix-hash index** — requests sharing a prompt prefix map their
+  leading table entries to the SAME physical blocks (exact token-chain
+  keys, refcounted), so shared prefixes are prefilled once; the first
+  divergent write to a shared block copies it (copy-on-write).
+
+Device math lives here too: :func:`paged_decode_step_batched` is the
+pooled twin of ``serving.decode_step_batched`` (einsum fallback =
+per-slot ``generate._cached_block`` on a gathered view — bit-identical
+to the slab path holding the same rows; kernel route =
+``ops/decode_attention.paged_decode_attention``, which resolves each
+T-block through the table inside the grid), and
+:func:`paged_prefill_chunk` is the pooled ``generate.prefill_slot_chunk``.
+The contiguous layout stays the default (``PADDLE_TPU_KV_LAYOUT``).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import generate, gpt, woq
+from .. import flags as _flags
+from .. import telemetry as _telemetry
+
+__all__ = [
+    "PoolExhausted", "PagedAllocator", "round_len", "init_paged_cache",
+    "paged_decode_step_batched", "paged_prefill_chunk", "copy_blocks",
+]
+
+# the value/scale leaves of a pooled cache (everything except "tables")
+POOL_LEAVES = ("k", "v", "k_s", "v_s")
+
+
+class PoolExhausted(RuntimeError):
+    """KV block pool has no free block.  The message carries the literal
+    ``RESOURCE_EXHAUSTED`` marker so ``resilience.is_oom`` classifies it
+    exactly like a real allocator OOM — the serving tick's retry chain
+    (evict cold prefix entries -> degrade dispatch -> evict slots)
+    engages on it."""
+
+    def __init__(self, need: int = 1, total: int = 0):
+        super().__init__(
+            f"RESOURCE_EXHAUSTED: KV block pool exhausted "
+            f"(need {need} more block(s), pool size {total})")
+
+
+def round_len(max_len: int, block_size: int) -> int:
+    """A paged cache's per-slot logical row count: the contiguous
+    layout's kernel-tileable rounding, then up to a whole number of
+    blocks (so a slot's gathered view is exactly ``nmax * bs`` rows —
+    pick ``block_size`` dividing ``generate._round_cache_len(max_len)``
+    when bit-parity with a contiguous cache of the same window
+    matters)."""
+    T = generate._round_cache_len(max_len)
+    bs = int(block_size)
+    return -(-T // bs) * bs
+
+
+def init_paged_cache(cfg: gpt.GPTConfig, batch: int, max_len: int,
+                     block_size: int | None = None,
+                     num_blocks: int | None = None) -> dict:
+    """The pooled cache pytree (``generate.init_cache(layout="paged")``):
+    value leaves ``[L, N, bs, Hkv, hd]`` (+ int8 scale planes
+    ``[L, N, bs, Hkv]``) and an int32 ``tables`` leaf ``[batch, nmax]``
+    initialized unmapped (-1).  ``num_blocks`` defaults to full
+    provisioning (``batch * nmax`` — slab-equivalent capacity, the
+    parity-safe default); operators shrink it to the budget actual
+    traffic needs, which is the whole point of paging."""
+    bs = _flags.kv_block_size() if block_size is None else int(block_size)
+    if bs < 8 or bs % 8:
+        raise ValueError(f"block_size {bs}: must be a positive multiple "
+                         f"of 8 (the decode kernel's row tile)")
+    T = round_len(max_len, bs)
+    nmax = T // bs
+    # `is None` (not falsy): num_blocks=0 must hit the validation below,
+    # not silently provision the full slab-equivalent pool
+    N = batch * nmax if num_blocks is None else int(num_blocks)
+    if N < 1:
+        raise ValueError(f"num_blocks must be >= 1, got {N}")
+    L, H, hd = cfg.num_layers, cfg.kv_heads, cfg.head_dim
+    dt = generate._kv_store_dtype(cfg)
+    shape = (L, N, bs, H, hd)
+    cache = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt),
+             "tables": jnp.full((batch, nmax), -1, jnp.int32)}
+    if dt == jnp.int8:
+        cache["k_s"] = jnp.zeros(shape[:-1], jnp.float32)
+        cache["v_s"] = jnp.zeros(shape[:-1], jnp.float32)
+    return cache
+
+
+def _geometry(cache: dict):
+    """(num_blocks, block_size, nmax) of a pooled cache pytree."""
+    N, bs = cache["k"].shape[1], cache["k"].shape[2]
+    return N, bs, cache["tables"].shape[1]
+
+
+def _gather_slot(pool_leaf, trow):
+    """One slot's contiguous view of a per-layer pool leaf:
+    ``pool_leaf`` [N, bs, ...] + table row [nmax] -> [1, nmax*bs, ...].
+    Delegates to the kernel module's batched gather — ONE copy of the
+    unmapped-entry (clamp-to-block-0, causally-masked) semantics shared
+    with the oracle/fallback paths."""
+    from ..ops import decode_attention as da
+
+    return da.gather_paged_view(pool_leaf, trow[None])
+
+
+def _scatter_rows(cache: dict, rows: dict, phys) -> dict:
+    """Write per-layer row leaves into the pool at physical row indices
+    ``phys`` (int32, out-of-bounds = dropped — the overrun/unmapped
+    sink).  ``rows`` leaves [L, R, Hkv(, hd)] against pool leaves
+    [L, N, bs, Hkv(, hd)]; the single row-write every paged decode/
+    prefill path funnels through (the ``generate._write_rows`` twin)."""
+    out = dict(cache)
+    for name, val in rows.items():
+        arr = cache[name]
+        L, NR = arr.shape[0], arr.shape[1] * arr.shape[2]
+        flat = arr.reshape((L, NR) + arr.shape[3:])
+        flat = flat.at[:, phys].set(val.astype(arr.dtype), mode="drop")
+        out[name] = flat.reshape(arr.shape)
+    return out
+
+
+def paged_decode_step_batched(params, cache, token, pos,
+                              cfg: gpt.GPTConfig):
+    """``serving.decode_step_batched`` on the pooled layout: token [B]
+    int32, pos [B] int32 (each slot's write position), cache a
+    :func:`init_paged_cache` tree -> (logits [B, V], cache).
+
+    Fallback route (any backend): vmap over slots of the EXACT per-slot
+    ``generate._cached_block`` math on a table-gathered view — the same
+    ops at the same shapes as the contiguous step, so greedy decode is
+    bit-identical to a slab holding the same rows.  Kernel route (TPU /
+    interpret, ``PADDLE_TPU_FLASH_DECODE``): fresh rows scatter into the
+    pool first, then ``ops/decode_attention.paged_decode_attention``
+    streams each slot's mapped blocks through the grid — no [B, T]
+    gather is ever materialized."""
+    from ..ops import decode_attention as da
+
+    N, bs, nmax = _geometry(cache)
+    B = token.shape[0]
+    H, hd = cfg.num_heads, cfg.head_dim
+    use_kernel = (_flags.flash_decode()
+                  and da.paged_available((B, 1, H, hd),
+                                         cache["k"].shape[1:]))
+    if use_kernel:
+        return _paged_step_kernel(params, cache, token, pos, cfg)
+
+    tables = cache["tables"]
+    pool = {n: cache[n] for n in POOL_LEAVES if n in cache}
+
+    def one(tok_b, pos_b, trow):
+        dt = cfg.dtype
+        x = generate._embed_step(params, tok_b[None], pos_b, cfg)
+
+        def body(x, layer):
+            p, pl = layer
+            csl = {n: _gather_slot(v, trow) for n, v in pl.items()}
+            x, rows = generate._cached_block(x, p, csl, pos_b, cfg)
+            return x, rows
+
+        x, rows = jax.lax.scan(body, x, (params["blocks"], pool))
+        x = gpt._norm(x, params, "ln_f", cfg)
+        logits = woq.logits(x, params, dt)[:, 0]
+        return logits[0].astype(jnp.float32), rows
+
+    logits, rows = jax.vmap(one, in_axes=(0, 0, 0),
+                            out_axes=(0, 0))(token, pos, tables)
+    # rows leaves [B, L, 1, Hkv(, hd)] -> [L, B, Hkv(, hd)]; physical row
+    # per slot through the table (unmapped -> out of bounds -> dropped,
+    # the slab path's clamp-into-masked-rows equivalent)
+    tb = tables[jnp.arange(B), pos // bs]
+    phys = jnp.where(tb >= 0, tb * bs + pos % bs, N * bs)
+    stacked = {n: jnp.moveaxis(v[:, :, 0], 0, 1) for n, v in rows.items()}
+    return logits, _scatter_rows(cache, stacked, phys)
+
+
+def _paged_step_kernel(params, cache, token, pos, cfg: gpt.GPTConfig):
+    """Kernel route of :func:`paged_decode_step_batched` — the layer
+    loop runs at top level so the paged kernel sees the whole batch
+    (grid ``(B*Hkv, nmax)``); the per-slot pre/post math stays vmapped
+    (norm/projections/rope/MoE routing at the contiguous step's B=1
+    shapes)."""
+    from ..ops import decode_attention as da
+
+    N, bs, nmax = _geometry(cache)
+    B = token.shape[0]
+    dt = cfg.dtype
+    hd = cfg.head_dim
+    tables = cache["tables"]
+    tb = tables[jnp.arange(B), pos // bs]
+    phys = jnp.where(tb >= 0, tb * bs + pos % bs, N * bs)
+    pool = {n: cache[n] for n in POOL_LEAVES if n in cache}
+    L = cache["k"].shape[0]
+
+    def embed_one(tok_b, pos_b):
+        return generate._embed_step(params, tok_b[None], pos_b, cfg)
+
+    x = jax.vmap(embed_one)(token, pos)                  # [B, 1, 1, D]
+
+    def body(carry, layer):
+        x, pool = carry
+        p, li = layer
+
+        def pre(xb, pos_b):
+            return generate._block_pre_attn(xb, p, pos_b, cfg)
+
+        q3, rows = jax.vmap(pre)(x, pos)     # q3 [B,1,1,H,hd]
+        # scatter the fresh rows into layer li BEFORE attending: the
+        # kernel then reads exactly what later steps will read back
+        # (scatter-then-attend == the slab path's splice-then-write)
+        new_pool = {}
+        for n, val in rows.items():
+            arr = pool[n]
+            NR = arr.shape[1] * arr.shape[2]
+            flat = arr.reshape((arr.shape[0], NR) + arr.shape[3:])
+            flat = flat.at[li, phys].set(val[:, 0].astype(arr.dtype),
+                                         mode="drop")
+            new_pool[n] = flat.reshape(arr.shape)
+        pool = new_pool
+        q = q3.reshape(B, 1, cfg.num_heads, hd)
+        attn = da.paged_decode_attention(
+            q, pool["k"][li], pool["v"][li], tables, pos,
+            k_scale=pool["k_s"][li] if "k_s" in pool else None,
+            v_scale=pool["v_s"][li] if "v_s" in pool else None)
+        attn = attn.astype(dt).reshape(B, 1, 1, cfg.num_heads * hd)
+
+        def post(xb, ab):
+            return generate._block_post_attn(xb, ab, p, cfg)
+
+        x = jax.vmap(post)(x, attn)
+        return (x, pool), None
+
+    (x, pool), _ = jax.lax.scan(
+        body, (x, pool), (params["blocks"], jnp.arange(L)))
+
+    def fin(xb):
+        xb = gpt._norm(xb, params, "ln_f", cfg)
+        return woq.logits(xb, params, dt)[0, 0]
+
+    logits = jax.vmap(fin)(x)
+    return logits.astype(jnp.float32), dict(cache, **pool)
+
+
+def paged_prefill_chunk(params, cache, tokens, pos0, length, slot,
+                        cfg: gpt.GPTConfig):
+    """``generate.prefill_slot_chunk`` on the pooled layout: one chunk of
+    a prompt at positions [pos0, pos0+C) for one slot, attending the
+    slot's table-gathered cache rows [0, pos0) plus within-chunk
+    causally (``generate._chunk_attend_block`` — the shared chunk math),
+    writing rows [pos0, pos0+length) through the table (pads and
+    unmapped entries dropped), returning (logits at the chunk's last
+    valid position [V], cache).
+
+    With a shared prefix adopted into the table, ``pos0`` starts at the
+    first unshared row — the shared blocks are ATTENDED through the
+    gather but never recomputed, which is where the prefix cache's
+    prefill FLOPs saving comes from."""
+    N, bs, nmax = _geometry(cache)
+    tables = cache["tables"]
+    trow = tables[slot]                                   # [nmax]
+    pool = {n: cache[n] for n in POOL_LEAVES if n in cache}
+    dt = cfg.dtype
+    C = tokens.shape[1]
+    x = woq.embed(params, tokens, dt)
+    if cfg.pos_embed == "learned":
+        x = x + jax.lax.dynamic_slice(
+            params["wpe"], (pos0, 0), (C, cfg.hidden_size)).astype(dt)[None]
+    valid_mask = (jnp.arange(C) < length)[None, :]        # [1, C]
+
+    def body(x, layer):
+        p, pl = layer
+        csl = {n: _gather_slot(v, trow) for n, v in pl.items()}
+        x, rows = generate._chunk_attend_block(x, p, csl, pos0, cfg,
+                                               valid=valid_mask)
+        return x, rows
+
+    x, rows = jax.lax.scan(body, x, (params["blocks"], pool))
+    logi = pos0 + jnp.arange(C)
+    tb = trow[jnp.clip(logi // bs, 0, nmax - 1)]
+    phys = jnp.where((jnp.arange(C) < length) & (tb >= 0)
+                     & (logi // bs < nmax), tb * bs + logi % bs, N * bs)
+    cache = _scatter_rows(cache, {n: v[:, 0] for n, v in rows.items()},
+                          phys)
+    last = jax.lax.dynamic_slice(x, (0, length - 1, 0),
+                                 (1, 1, cfg.hidden_size))
+    last = gpt._norm(last, params, "ln_f", cfg)
+    logits = woq.logits(last, params, dt)[0, 0]
+    return logits.astype(jnp.float32), cache
+
+
+def copy_blocks(cache: dict, src, dst) -> dict:
+    """Copy physical blocks ``src`` -> ``dst`` (int32 [P]) across every
+    pool leaf — the device half of copy-on-write.  Destinations are
+    freshly allocated (never in ``src``), so the gather/scatter pair has
+    no ordering hazard; callers jit + donate the cache so the pool
+    updates in place."""
+    out = dict(cache)
+    for name in POOL_LEAVES:
+        if name in cache:
+            arr = cache[name]
+            out[name] = arr.at[:, dst].set(arr[:, src])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# host allocator: free list + refcounts + prefix index
+# ---------------------------------------------------------------------------
+
+
+class _PrefixEntry:
+    __slots__ = ("block", "last_hit")
+
+    def __init__(self, block: int, tick: int):
+        self.block = block
+        self.last_hit = tick
+
+
+class PagedAllocator:
+    """Host-side block accounting for one pooled cache: the free list,
+    per-block refcounts, the per-slot table mirror (pushed to the device
+    leaf when dirty), pending COW copies, and the prefix-hash index.
+
+    Prefix keys are EXACT token chains (the tuple of all prompt tokens
+    through a block's end) — no hash collisions can ever alias two
+    different prefixes onto one block's rows.  The index holds its own
+    reference on every registered block, so a retired request's prefix
+    blocks survive for the next request until :meth:`evict_cold` (the
+    OOM chain's first rung) or :meth:`close` releases them."""
+
+    def __init__(self, num_blocks: int, block_size: int, nmax: int,
+                 max_batch: int):
+        if num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+        self.N = int(num_blocks)
+        self.bs = int(block_size)
+        self.nmax = int(nmax)
+        self.max_batch = int(max_batch)
+        self.tables = np.full((max_batch, nmax), -1, np.int32)
+        # pop() takes from the end: keep ids ascending-on-pop for
+        # deterministic layouts in tests
+        self._free = list(range(self.N - 1, -1, -1))
+        self._ref = np.zeros(self.N, np.int64)
+        self._prefix: dict = {}              # key -> _PrefixEntry
+        self._pending_copies: list = []      # [(src, dst)] for copy_blocks
+        self._tick = 0                       # LRU clock for the index
+        self.dirty = True                    # tables need a device push
+        # host mirrors of the telemetry counters (tests/bench read these
+        # without the registry)
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.cow_copies = 0
+        self.peak_blocks_in_use = 0
+
+    # -- pool accounting ----------------------------------------------------
+
+    @property
+    def blocks_in_use(self) -> int:
+        return self.N - len(self._free)
+
+    def _alloc_block(self) -> int:
+        """One block off the free list (ref 1) — every allocation path
+        funnels through here."""
+        if not self._free:
+            raise PoolExhausted(1, self.N)
+        b = self._free.pop()
+        self._ref[b] = 1
+        _telemetry.count("kv_pool.blocks_allocated")
+        self.peak_blocks_in_use = max(self.peak_blocks_in_use,
+                                      self.blocks_in_use)
+        return b
+
+    def _decref_free(self, b: int) -> None:
+        """Drop one reference; a block reaching zero returns to the free
+        list — the single release path (slot retire, COW remap, prefix
+        eviction all delegate here).  Pending COW pairs whose destination
+        just died are discarded with it: a stale (src, dst) surviving
+        into a later drain could copy into a REALLOCATED dst and corrupt
+        another request's rows (the failure-path free between a COW and
+        its _apply_pool_ops drain)."""
+        self._ref[b] -= 1
+        if self._ref[b] < 0:
+            raise AssertionError(f"block {b} refcount went negative")
+        if self._ref[b] == 0:
+            self._free.append(b)
+            if self._pending_copies:
+                self._pending_copies = [p for p in self._pending_copies
+                                        if p[1] != b]
+            _telemetry.count("kv_pool.blocks_freed")
+
+    def _cow_block(self, slot: int, li: int) -> int:
+        """Copy-on-write: the slot is about to write into a block some
+        other holder (another slot or the prefix index) also references
+        — allocate a fresh block, queue the device copy, remap the table
+        entry, and drop the shared reference."""
+        src = int(self.tables[slot, li])
+        dst = self._alloc_block()
+        self._pending_copies.append((src, dst))
+        self.tables[slot, li] = dst
+        self._decref_free(src)
+        self.dirty = True
+        self.cow_copies += 1
+        _telemetry.count("kv_pool.cow_copies")
+        return dst
+
+    def ensure_rows(self, slot: int, start: int, stop: int) -> None:
+        """Make rows [start, stop) of ``slot`` writable: allocate
+        unmapped logical blocks, copy-on-write shared ones.  Raises
+        :exc:`PoolExhausted` when the free list runs dry (the caller's
+        OOM chain evicts and retries); row indices clamp to the slot's
+        logical window (block-decode overrun rows write nowhere, the
+        slab path's masked-rows equivalent)."""
+        if stop <= start:
+            return
+        lo = max(0, start // self.bs)
+        hi = min(self.nmax - 1, (stop - 1) // self.bs)
+        for li in range(lo, hi + 1):
+            b = int(self.tables[slot, li])
+            if b < 0:
+                self.tables[slot, li] = self._alloc_block()
+                self.dirty = True
+            elif self._ref[b] > 1:
+                self._cow_block(slot, li)
+
+    def free_slot(self, slot: int) -> None:
+        """Retire a slot: every mapped block loses the slot's reference
+        (prefix-indexed blocks stay resident under the index's own
+        ref)."""
+        for li in range(self.nmax):
+            b = int(self.tables[slot, li])
+            if b >= 0:
+                self._decref_free(b)
+        self.tables[slot] = -1
+        self.dirty = True
+
+    def take_copies(self) -> list:
+        """Drain the pending COW (src, dst) pairs for ``copy_blocks``."""
+        out, self._pending_copies = self._pending_copies, []
+        return out
+
+    # -- prefix index -------------------------------------------------------
+
+    def _key(self, prompt, li: int):
+        return tuple(prompt[:(li + 1) * self.bs])
+
+    def adopt_prefix(self, slot: int, prompt) -> int:
+        """Map the longest indexed block-chain prefix of ``prompt`` into
+        ``slot``'s table (incref per adopted block) and return the
+        shared row count, capped at ``len(prompt) - 1`` so admission
+        always computes at least the last token's logits (a fully
+        shared prompt COWs its final block on that one-row write)."""
+        n = len(prompt)
+        self._tick += 1
+        matched = 0
+        for li in range(n // self.bs):
+            ent = self._prefix.get(self._key(prompt, li))
+            if ent is None:
+                break
+            b = ent.block
+            self._ref[b] += 1
+            self.tables[slot, li] = b
+            ent.last_hit = self._tick
+            matched += 1
+        if matched:
+            self.dirty = True
+            self.prefix_hits += matched
+            _telemetry.count("kv_pool.prefix_hits", matched)
+        missed = n // self.bs - matched
+        if missed:
+            self.prefix_misses += missed
+            _telemetry.count("kv_pool.prefix_misses", missed)
+        return min(matched * self.bs, n - 1)
+
+    def register_prefix(self, slot: int, prompt) -> None:
+        """Index ``slot``'s full prompt blocks for future sharing (the
+        index takes its own reference per newly registered block).  The
+        owner never rewrites a full prompt block — decode writes start
+        at ``len(prompt)`` — so registered blocks are immutable until
+        released."""
+        self._tick += 1
+        for li in range(len(prompt) // self.bs):
+            key = self._key(prompt, li)
+            b = int(self.tables[slot, li])
+            if b < 0:
+                break
+            if key not in self._prefix:
+                self._prefix[key] = _PrefixEntry(b, self._tick)
+                self._ref[b] += 1
+
+    @property
+    def prefix_entries(self) -> int:
+        return len(self._prefix)
+
+    def evict_cold(self, max_entries: int | None = None) -> int:
+        """Drop prefix-cache entries no live slot references (block ref
+        == 1: the index alone), coldest (LRU) first — the OOM retry
+        chain's FIRST rung, and admission's last resort before parking a
+        request back in the queue.  Returns the number of blocks
+        actually freed."""
+        cold = sorted(
+            (ent.last_hit, key) for key, ent in self._prefix.items()
+            if self._ref[ent.block] == 1)
+        if max_entries is not None:
+            cold = cold[:max_entries]
+        freed = 0
+        for _, key in cold:
+            ent = self._prefix.pop(key)
+            self._decref_free(ent.block)
+            freed += 1
+        if freed:
+            _telemetry.count("kv_pool.prefix_evictions", freed)
+        return freed
+
+    def close(self) -> None:
+        """Release the whole index and every table (server shutdown)."""
+        for key in list(self._prefix):
+            ent = self._prefix.pop(key)
+            self._decref_free(ent.block)
+        for slot in range(self.max_batch):
+            if (self.tables[slot] >= 0).any():
+                self.free_slot(slot)
+
+    def stats(self) -> dict:
+        return {
+            "num_blocks": self.N, "block_size": self.bs,
+            "blocks_in_use": self.blocks_in_use,
+            "peak_blocks_in_use": self.peak_blocks_in_use,
+            "prefix_entries": self.prefix_entries,
+            "prefix_hits": self.prefix_hits,
+            "prefix_misses": self.prefix_misses,
+            "cow_copies": self.cow_copies,
+        }

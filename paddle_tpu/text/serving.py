@@ -42,7 +42,18 @@ def decode_step_batched(params, cache, token, pos, cfg: gpt.GPTConfig):
 
     Implemented as vmap of the scalar-pos ``decode_step`` over the batch
     axis (params broadcast, every cache leaf's batch axis 1 — int8 scale
-    planes included) — identical math, batched cache scatter."""
+    planes included) — identical math, batched cache scatter.
+
+    A pooled cache (text/kv_pool — a ``tables`` leaf marks the paged
+    layout) routes to the block-table twin instead; the branch is on
+    pytree STRUCTURE at trace time, so every step getter (sample/block/
+    async) serves both layouts without new plumbing."""
+    if "tables" in cache:
+        from . import kv_pool
+
+        return kv_pool.paged_decode_step_batched(params, cache, token,
+                                                 pos, cfg)
+
     def one(tok, csl, p):
         sl = {name: v[:, None] for name, v in csl.items()}
         logits, new = generate.decode_step(params, sl, tok[None], p, cfg)
@@ -130,6 +141,10 @@ import os as _os
 _STEP_CACHE = generate._LRU(
     int(_os.environ.get("PADDLE_TPU_STEP_CACHE_SIZE", "64")))
 
+# cold prefix-cache entries evicted per OOM-chain engagement (LRU-first
+# batches — repeated engagements drain more; never the whole index)
+_EVICT_BATCH = 4
+
 
 def _get_prefill_fn(cfg: gpt.GPTConfig, bucket: int):
     """One wrapper per (cfg, prompt bucket): the jit would retrace per
@@ -159,8 +174,42 @@ def _get_prefill_chunk_fn(cfg: gpt.GPTConfig):
     return fn
 
 
-def _get_block_fn(cfg: gpt.GPTConfig, k: int):
-    key = ("block", generate._cfg_key(cfg), k)
+def _get_paged_prefill_fn(cfg: gpt.GPTConfig, bucket: int):
+    """Paged admission step: one ``kv_pool.paged_prefill_chunk``
+    executable per (cfg, chunk width) — ONE program serves any prompt
+    offset (the chunk attends rows [0, pos0) through the block table),
+    so bucketed-suffix and fixed-chunk admission share this getter."""
+    k = ("paged_prefill", generate._cfg_key(cfg), int(bucket))
+    fn = _STEP_CACHE.get(k)
+    if fn is None:
+        from . import kv_pool
+
+        fn = generate._watch_jit(f"serving.paged_prefill@{bucket}", k,
+                                 jax.jit(
+            lambda p, c, t, p0, ln, sl, _cfg=cfg:
+            kv_pool.paged_prefill_chunk(p, c, t, p0, ln, sl, _cfg),
+            donate_argnums=generate._donate_cache()))
+        _STEP_CACHE[k] = fn
+    return fn
+
+
+def _get_copy_fn(cfg: gpt.GPTConfig, n_pairs: int):
+    """Copy-on-write device half: gather/scatter ``n_pairs`` pool blocks
+    in one donated call (``kv_pool.copy_blocks``)."""
+    k = ("kv_copy", generate._cfg_key(cfg), int(n_pairs))
+    fn = _STEP_CACHE.get(k)
+    if fn is None:
+        from . import kv_pool
+
+        fn = generate._watch_jit(f"serving.kv_copy@{n_pairs}", k, jax.jit(
+            lambda c, s, d: kv_pool.copy_blocks(c, s, d),
+            donate_argnums=generate._donate_cache() and (0,)))
+        _STEP_CACHE[k] = fn
+    return fn
+
+
+def _get_block_fn(cfg: gpt.GPTConfig, k: int, paged: bool = False):
+    key = ("block", generate._cfg_key(cfg), k, paged)
     fn = _STEP_CACHE.get(key)
     if fn is None:
         fn = generate._watch_jit(f"serving.block@{k}", key, jax.jit(
@@ -171,8 +220,8 @@ def _get_block_fn(cfg: gpt.GPTConfig, k: int):
     return fn
 
 
-def _get_sample_step_fn(cfg: gpt.GPTConfig):
-    k = ("sample", generate._cfg_key(cfg))
+def _get_sample_step_fn(cfg: gpt.GPTConfig, paged: bool = False):
+    k = ("sample", generate._cfg_key(cfg), paged)
     fn = _STEP_CACHE.get(k)
     if fn is None:
         fn = generate._watch_jit("serving.sample_step", k, jax.jit(
@@ -183,8 +232,8 @@ def _get_sample_step_fn(cfg: gpt.GPTConfig):
     return fn
 
 
-def _get_sample_block_fn(cfg: gpt.GPTConfig, k: int):
-    key = ("sample_block", generate._cfg_key(cfg), k)
+def _get_sample_block_fn(cfg: gpt.GPTConfig, k: int, paged: bool = False):
+    key = ("sample_block", generate._cfg_key(cfg), k, paged)
     fn = _STEP_CACHE.get(key)
     if fn is None:
         fn = generate._watch_jit(f"serving.sample_block@{k}", key,
@@ -197,13 +246,16 @@ def _get_sample_block_fn(cfg: gpt.GPTConfig, k: int):
     return fn
 
 
-def _get_step_fn(cfg: gpt.GPTConfig):
+def _get_step_fn(cfg: gpt.GPTConfig, paged: bool = False):
     """One jitted batched step per config VALUE (generate._GEN_CACHE's
     rationale: keying by object identity would recompile per DecodeServer
     and leak executables).  Every step fn here DONATES its cache (arg 1,
     generate._donate_cache): the caller must reassign the cache from the
-    return value — DecodeServer always does."""
-    k = generate._cfg_key(cfg)
+    return value — DecodeServer always does.  ``paged`` tags the cache
+    key (not the math: decode_step_batched branches on the cache
+    structure itself), so a paged server's compiles stay visible to the
+    recompile watch instead of hiding behind a same-key retrace."""
+    k = ("step", generate._cfg_key(cfg), paged)
     fn = _STEP_CACHE.get(k)
     if fn is None:
         fn = generate._watch_jit("serving.step", k, jax.jit(
@@ -214,7 +266,7 @@ def _get_step_fn(cfg: gpt.GPTConfig):
     return fn
 
 
-def _get_async_step_fn(cfg: gpt.GPTConfig):
+def _get_async_step_fn(cfg: gpt.GPTConfig, paged: bool = False):
     """The async-dispatch tick step: like _get_sample_step_fn but the
     feed token is selected ON DEVICE between the host-built token and
     the previous (still in flight, unfetched) step's output — ``pm``
@@ -222,7 +274,7 @@ def _get_async_step_fn(cfg: gpt.GPTConfig):
     tokens).  Greedy slots pass temp 0 and take the raw argmax, so one
     executable serves greedy and sampled async ticks bit-identically to
     the sync paths."""
-    k = ("async", generate._cfg_key(cfg))
+    k = ("async", generate._cfg_key(cfg), paged)
     fn = _STEP_CACHE.get(k)
     if fn is None:
         fn = generate._watch_jit("serving.async_step", k, jax.jit(
@@ -234,10 +286,10 @@ def _get_async_step_fn(cfg: gpt.GPTConfig):
     return fn
 
 
-def _get_async_block_fn(cfg: gpt.GPTConfig, k: int):
+def _get_async_block_fn(cfg: gpt.GPTConfig, k: int, paged: bool = False):
     """Async greedy block: decode_block_batched with the device-side
     feed select (see _get_async_step_fn)."""
-    key = ("async_block", generate._cfg_key(cfg), k)
+    key = ("async_block", generate._cfg_key(cfg), k, paged)
     fn = _STEP_CACHE.get(key)
     if fn is None:
         fn = generate._watch_jit(f"serving.async_block@{k}", key,
@@ -250,10 +302,10 @@ def _get_async_block_fn(cfg: gpt.GPTConfig, k: int):
     return fn
 
 
-def _get_async_sample_block_fn(cfg: gpt.GPTConfig, k: int):
+def _get_async_sample_block_fn(cfg: gpt.GPTConfig, k: int, paged: bool = False):
     """Async sampled block: sample_block_batched with the device-side
     feed select (see _get_async_step_fn)."""
-    key = ("async_sample_block", generate._cfg_key(cfg), k)
+    key = ("async_sample_block", generate._cfg_key(cfg), k, paged)
     fn = _STEP_CACHE.get(key)
     if fn is None:
         fn = generate._watch_jit(f"serving.async_sample_block@{k}",
@@ -286,7 +338,10 @@ class DecodeServer:
                  prefill: bool = True, seed: int = 0,
                  prefill_chunk: int | None = None,
                  async_dispatch: bool = False,
-                 metrics_port: int | None = None):
+                 metrics_port: int | None = None,
+                 layout: str | None = None,
+                 block_size: int | None = None,
+                 num_blocks: int | None = None):
         self.params = params
         # telemetry (request tracing + latency histograms + gauges):
         # decided once at construction — per-tick records are lock-cheap
@@ -302,8 +357,35 @@ class DecodeServer:
         self.max_batch = max_batch
         self.max_len = max_len
         self.eos_id = eos_id
-        self.cache = generate.init_cache(cfg, max_batch, max_len)
-        self._step = _get_step_fn(cfg)
+        # KV-cache layout (round 8): 'contiguous' (the default slab —
+        # every slot provisioned for max_len rows) or 'paged'
+        # (text/kv_pool: a shared block pool addressed through per-slot
+        # block tables, blocks allocated as ``pos`` crosses block
+        # boundaries, refcounted prefix reuse + copy-on-write).
+        # ``PADDLE_TPU_KV_LAYOUT`` flips the default; ``num_blocks``
+        # defaults to full provisioning (slab-equivalent capacity) and
+        # is the knob operators shrink to actual-traffic budgets.
+        lay = layout if layout is not None else _flags.kv_layout()
+        if lay not in ("contiguous", "paged"):
+            raise ValueError(
+                f"layout {lay!r}: expected 'contiguous' or 'paged'")
+        self._paged = lay == "paged"
+        if self._paged:
+            from . import kv_pool as _kv
+
+            # init_cache -> kv_pool.init_paged_cache is the ONE
+            # validator of block_size/num_blocks (and the default pool
+            # sizing); the allocator mirrors the built cache's geometry
+            self.cache = generate.init_cache(
+                cfg, max_batch, max_len, layout="paged",
+                block_size=block_size, num_blocks=num_blocks)
+            self._pool = _kv.PagedAllocator(
+                self.cache["k"].shape[1], self.cache["k"].shape[2],
+                self.cache["tables"].shape[1], max_batch)
+        else:
+            self._pool = None
+            self.cache = generate.init_cache(cfg, max_batch, max_len)
+        self._step = _get_step_fn(cfg, self._paged)
         # async_dispatch: keep ONE step/block in flight — tick() first
         # dispatches step N+1 (feeding the previous step's tokens from
         # the DEVICE array, never fetched) and only then blocks on step
@@ -350,12 +432,19 @@ class DecodeServer:
         # admission (_get_prefill_fn(cfg, bucket)); this marker is the
         # factory, kept callable-shaped so `is not None` mode checks read
         # the same as before
+        # the paged layout routes ALL prefill admission through the
+        # offset-aware kv_pool.paged_prefill_chunk executables (a shared
+        # prefix moves the chunk's start past the adopted blocks, which
+        # the contiguous bucket/chunk programs cannot express)
+        self._prefill_on = bool(prefill)
         self._prefill = ((lambda bucket: _get_prefill_fn(cfg, bucket))
-                         if prefill and prefill_chunk is None else None)
+                         if prefill and prefill_chunk is None
+                         and not self._paged else None)
         self._chunk = (int(prefill_chunk) if prefill_chunk is not None
                        else None)
         self._prefill_chunk = (_get_prefill_chunk_fn(cfg)
-                               if prefill and self._chunk else None)
+                               if prefill and self._chunk
+                               and not self._paged else None)
         # per-slot host state
         self._free = list(range(max_batch))
         self._slots: dict[int, dict] = {}        # slot -> request state
@@ -411,6 +500,17 @@ class DecodeServer:
             raise ValueError(
                 f"prompt+max_new_tokens {total} exceeds serving window "
                 f"{min(self.max_len, self.cfg.max_seq_len)}")
+        if self._paged:
+            # a request needing more blocks than the whole pool can
+            # NEVER be admitted (eviction frees other tenants' blocks,
+            # not capacity) — rejecting here prevents it parking at the
+            # queue front forever and livelocking the serve loop
+            need = -(-total // self._pool.bs)
+            if need > self._pool.N:
+                raise ValueError(
+                    f"request needs {need} KV blocks but the pool has "
+                    f"{self._pool.N} (raise num_blocks or shrink the "
+                    f"request)")
         stop = [[int(t) for t in seq] for seq in (stop or [])]
         if any(not seq for seq in stop):
             raise ValueError("empty stop sequence")
@@ -472,6 +572,8 @@ class DecodeServer:
         the slot frees for the next tenant, the server lives."""
         rid = st["rid"]
         self._status[rid] = "error"
+        if self._paged:
+            self._pool.free_slot(slot)
         self._free.append(slot)
         if self._tel:
             _telemetry.count("serving.requests_failed")
@@ -516,11 +618,30 @@ class DecodeServer:
                 _telemetry.observe(
                     "serving.queue_wait_ms",
                     (t_admit - st["t_submit"]) * 1e3)
-            if self._prefill is not None or self._prefill_chunk is not None:
+            if self._prefill is not None or self._prefill_chunk is not None \
+                    or (self._paged and self._prefill_on):
                 n = len(req["prompt"])
                 prefill_calls = 1
                 try:
-                    if self._prefill is not None:
+                    if self._paged:
+                        from . import kv_pool as _kv
+
+                        try:
+                            prefill_name, prefill_calls, logits = \
+                                self._paged_prefill_slot(req, slot)
+                        except _kv.PoolExhausted:
+                            # no free blocks even after evicting the cold
+                            # prefix cache: the request WAITS (active
+                            # slots will retire and free blocks) instead
+                            # of failing the submit — park it back at
+                            # the queue front and stop admitting
+                            self._pool.free_slot(slot)
+                            self._free.append(slot)
+                            self._queue.insert(0, req)
+                            if self._tel:
+                                _telemetry.count("kv_pool.admit_blocked")
+                            break
+                    elif self._prefill is not None:
                         bucket = 1
                         while bucket < n:
                             bucket *= 2
@@ -573,7 +694,10 @@ class DecodeServer:
                     # a failed admission prefill (e.g. a real OOM the
                     # guard will degrade around) must neither lose the
                     # request nor leak the slot: both go back where they
-                    # came from before the error propagates
+                    # came from before the error propagates (paged: the
+                    # slot's partially mapped blocks return to the pool)
+                    if self._paged:
+                        self._pool.free_slot(slot)
                     self._free.append(slot)
                     self._queue.insert(0, req)
                     raise
@@ -629,10 +753,134 @@ class DecodeServer:
                 # on the admission token
                 if self._finished(st, t):
                     self._results[st["rid"]] = st["generated"]
+                    if self._paged:
+                        self._pool.free_slot(slot)
                     self._free.append(slot)
                     self._tel_retire(st, slot)
                     continue
             self._slots[slot] = st
+
+    # -- paged layout: allocator plumbing (text/kv_pool) --------------------
+
+    def _apply_pool_ops(self):
+        """Execute the allocator's pending device work: COW block copies
+        (one donated gather/scatter) and the host->device table push.
+        Called right before any jitted step that depends on them."""
+        pairs = self._pool.take_copies()
+        if pairs:
+            # pad to a power-of-two width by REPEATING the first real
+            # pair (duplicate writes of identical rows — scatter-safe):
+            # one kv_copy executable per log2 bucket instead of one per
+            # distinct pair count, so a COW storm can't compile mid-tick
+            # per count or flood the step LRU.  A constant (0, 0) filler
+            # would collide when block 0 is itself a COW destination in
+            # the same drain (dst=0 twice with DIFFERENT sources — XLA
+            # scatter order is undefined), violating copy_blocks'
+            # no-dst-in-src precondition
+            width = 1
+            while width < len(pairs):
+                width *= 2
+            pad = [pairs[0]] * (width - len(pairs))
+            src = jnp.asarray([p[0] for p in pairs + pad], jnp.int32)
+            dst = jnp.asarray([p[1] for p in pairs + pad], jnp.int32)
+            self.cache = _get_copy_fn(self.cfg, width)(
+                self.cache, src, dst)
+        if self._pool.dirty:
+            self.cache = dict(self.cache,
+                              tables=jnp.asarray(self._pool.tables))
+            self._pool.dirty = False
+
+    def _ensure_decode_blocks(self, steps: int):
+        """Incremental allocation: before a dispatch of ``steps`` decode
+        steps, map (or copy-on-write) every active slot's blocks
+        covering rows [pos, pos+steps) — admission no longer reserves
+        ``max_len`` rows up front, THE memory point of the paged layout.
+        Rows past the window clamp (block-decode overrun writes drop).
+        A PoolExhausted here surfaces inside the guarded tick, where the
+        OOM chain's first rung evicts cold prefix-cache entries and
+        retries."""
+        if not self._paged or not self._slots:
+            return
+        cap = self._pool.nmax * self._pool.bs
+        for slot, st in self._slots.items():
+            self._pool.ensure_rows(slot, st["pos"],
+                                   min(st["pos"] + steps, cap))
+        self._apply_pool_ops()
+
+    def _paged_prefill_slot(self, req, slot):
+        """Paged admission: adopt the longest indexed prompt prefix into
+        the slot's block table (refcounted sharing — those rows are
+        never recomputed), allocate/COW the blocks the suffix will
+        write, run the suffix through the offset-aware paged prefill
+        chunk executable(s), and register this prompt's full blocks for
+        future sharing.  Returns (telemetry name, executable calls,
+        admission logits)."""
+        from . import kv_pool as _kv
+
+        prompt = req["prompt"]
+        n = len(prompt)
+        alloc = self._pool
+        shared = alloc.adopt_prefix(slot, prompt) if self._prefill_on \
+            else 0
+        window = min(self.max_len, self.cfg.max_seq_len)
+        if self._chunk:
+            C = min(self._chunk, window)
+            if n - shared <= C:
+                # one chunk covers the suffix: start AT the adopted
+                # prefix (recomputing shared rows would COW every
+                # adopted block and forfeit the reuse), backing off only
+                # when the window bound forces an overlap
+                starts = [shared if shared + C <= window
+                          else max(0, n - C)]
+            else:
+                starts = list(range(shared, n - C, C)) + [n - C]
+        else:
+            # bucketed suffix: one power-of-two chunk per admission,
+            # floored at the block size — suffixes after a prefix hit
+            # are typically < block_size, and the floor keeps the
+            # executable-width set small enough for warmup to cover.
+            # pos0 backs off from ``shared`` only when the bucket would
+            # overrun the wpe/window bound — overlapped rows recompute
+            # to identical values (the contiguous walk's rule) after a
+            # COW makes them writable
+            C = 1
+            while C < n - shared:
+                C *= 2
+            C = min(max(C, self._pool.bs), window)
+            starts = [shared if shared + C <= window else max(0, n - C)]
+        while True:
+            try:
+                alloc.ensure_rows(slot, min(starts), n)
+                break
+            except _kv.PoolExhausted:
+                # out of blocks: evict cold prefix-cache entries (the
+                # OOM chain's first rung, applied at admission) in small
+                # LRU batches until the suffix fits — NOT the whole
+                # index at once: one pressure blip must not zero the
+                # fleet's prefix hit rate.  Cold entries are ref==1, so
+                # this request's freshly adopted blocks (ref>=2) are
+                # never its own victims
+                if alloc.evict_cold(max_entries=_EVICT_BATCH) == 0:
+                    raise
+        self._apply_pool_ops()
+        fn = _get_paged_prefill_fn(self.cfg, C)
+        logits = None
+        rows_done = 0
+        for s in starts:
+            chunk = prompt[s:s + C]
+            padded = np.zeros((1, C), np.int32)
+            padded[0, :len(chunk)] = chunk
+            logits, self.cache = fn(
+                self.params, self.cache, jnp.asarray(padded),
+                jnp.asarray(s), jnp.asarray(len(chunk)),
+                jnp.asarray(slot))
+            rows_done += len(chunk)
+        if self._tel:
+            # rows actually prefilled — the repeated-prefix FLOPs saving
+            # is (prompt length - this) per request
+            _telemetry.count("kv_pool.prefill_rows", rows_done)
+        alloc.register_prefix(slot, prompt)
+        return f"paged_prefill@{C}", len(starts), logits
 
     def pending(self) -> bool:
         return bool(self._slots or self._queue)
@@ -677,6 +925,8 @@ class DecodeServer:
             self._dropped.add(req["rid"])
         self._slots.clear()
         self._queue.clear()
+        if self._paged and self._pool is not None:
+            self._pool.close()
 
     def shutdown(self):
         """Alias for :meth:`close` (the serving-fleet idiom): cancel
@@ -782,6 +1032,10 @@ class DecodeServer:
         for slot in done:
             st = self._slots.pop(slot)
             self._results[st["rid"]] = st["generated"]
+            if self._paged:
+                # blocks return to the pool (prefix-indexed ones stay
+                # resident under the index's own reference)
+                self._pool.free_slot(slot)
             self._free.append(slot)
             self._tel_retire(st, slot)
         self._admit()
@@ -803,11 +1057,24 @@ class DecodeServer:
         _telemetry.set_gauge("serving.active_slots", len(self._slots))
         _telemetry.set_gauge("serving.slot_occupancy",
                              len(self._slots) / self.max_batch)
-        _telemetry.set_gauge(
-            "serving.kv_utilization",
-            sum(min(st["pos"], self.max_len)
-                for st in self._slots.values())
-            / (self.max_batch * self.max_len))
+        # kv_utilization = TRUE occupancy (round 8): under the paged
+        # layout, blocks actually mapped / pool size; under contiguous,
+        # filled rows / the slab's real (rounded) allocation — the old
+        # max_len denominator under-reported whenever init_cache rounded
+        # the row count up
+        if self._paged:
+            used = self._pool.blocks_in_use
+            _telemetry.set_gauge("kv_pool.blocks_in_use", used)
+            _telemetry.set_gauge("serving.kv_utilization",
+                                 used / max(1, self._pool.N))
+        else:
+            rows = (int(self.cache["k"].shape[2])
+                    if self.cache is not None else self.max_len)
+            _telemetry.set_gauge(
+                "serving.kv_utilization",
+                sum(min(st["pos"], rows)
+                    for st in self._slots.values())
+                / (self.max_batch * rows))
 
     def _tel_retire(self, st, slot):
         """End-of-lifecycle records for one request: end-to-end latency
@@ -937,7 +1204,26 @@ class DecodeServer:
         if self._cache_consumed():
             return False
         applied = None
-        if self._async:
+        if self._paged:
+            from . import kv_pool as _kv
+        # the first rung only relieves POOL exhaustion (prefix eviction
+        # returns host-accounted pool blocks, zero device HBM — the pool
+        # is preallocated): a real XLA RESOURCE_EXHAUSTED would retry
+        # the identical failing dispatch once per batch, so it skips
+        # straight to dispatch degradation.  Injected drill OOMs stay
+        # routed through the rung so the chaos suite can drive it
+        pool_relievable = self._paged and isinstance(
+            exc, (_kv.PoolExhausted, _faults.InjectedOOM))
+        if pool_relievable and self._pool.evict_cold(
+                max_entries=max(_EVICT_BATCH, len(self._slots))) > 0:
+            # NEW first rung (round 8): free pool blocks the prefix
+            # cache alone holds — pure memory back for zero lost work —
+            # before any dispatch degradation.  Batched (LRU-first), not
+            # the whole index: the chain retries the tick and re-engages
+            # this rung while cold entries remain, so sustained pressure
+            # still drains the cache but a single blip keeps the hit rate
+            applied = "evict_prefix_cache"
+        elif self._async:
             try:
                 self._drain_inflight()
             except Exception:  # noqa: BLE001 - the drain itself failing:
@@ -975,6 +1261,8 @@ class DecodeServer:
                    key=lambda s: (self._slots[s].get("priority", 0),
                                   -self._slots[s].get("t_submit", 0.0)))
         st = self._slots.pop(slot)
+        if self._paged:
+            self._pool.free_slot(slot)
         self._free.append(slot)
         # full sequence = ORIGINAL prompt + generated (prompt[:base]
         # strips a previous eviction's carry — generated already holds
@@ -1060,13 +1348,14 @@ class DecodeServer:
             if not self._slots:
                 return
         t0 = time.perf_counter()
+        self._ensure_decode_blocks(1)
         tok, pos = self._feed_arrays()
         temp, tk, tp = self._sampling_arrays()
         n = self._step_no
         if temp.any():
             kind = "sample_step"
             self._fault_check(kind)
-            fn = _get_sample_step_fn(self.cfg)
+            fn = _get_sample_step_fn(self.cfg, self._paged)
             nxt, self.cache = fn(
                 self.params, self.cache, jnp.asarray(tok),
                 jnp.asarray(pos), jax.random.fold_in(self._base_key, n),
@@ -1186,10 +1475,11 @@ class DecodeServer:
         self._step_no = n
 
     def _dispatch_step_async(self, prev):
+        self._ensure_decode_blocks(1)
         ht, pm, pos, temp, tk, tp, snap = self._dispatch_feed(prev)
         n = self._step_no
         self._step_no = n + 1
-        fn = _get_async_step_fn(self.cfg)
+        fn = _get_async_step_fn(self.cfg, self._paged)
         try:
             self._fault_check("async_step")
             nxt, self.cache = fn(
@@ -1205,6 +1495,7 @@ class DecodeServer:
                           "snap": snap, "t_disp": time.perf_counter()}
 
     def _dispatch_block_async(self, prev, block: int):
+        self._ensure_decode_blocks(block)
         ht, pm, pos, temp, tk, tp, snap = self._dispatch_feed(prev, block)
         n = self._step_no
         self._step_no = n + block
@@ -1212,7 +1503,8 @@ class DecodeServer:
             if temp.any():
                 fname = f"async_sample_block@{block}"
                 self._fault_check(fname)
-                fn = _get_async_sample_block_fn(self.cfg, block)
+                fn = _get_async_sample_block_fn(self.cfg, block,
+                                                self._paged)
                 toks, self.cache = fn(
                     self.params, self.cache, jnp.asarray(ht),
                     jnp.asarray(pm),
@@ -1224,7 +1516,7 @@ class DecodeServer:
             else:
                 fname = f"async_block@{block}"
                 self._fault_check(fname)
-                fn = _get_async_block_fn(self.cfg, block)
+                fn = _get_async_block_fn(self.cfg, block, self._paged)
                 toks, self.cache, feed, _ = fn(
                     self.params, self.cache, jnp.asarray(ht),
                     jnp.asarray(pm),
@@ -1419,7 +1711,7 @@ class DecodeServer:
 
         tok, pos = jnp.asarray(zi), jnp.asarray(zi)
         if self._async:
-            fn = _get_async_step_fn(self.cfg)
+            fn = _get_async_step_fn(self.cfg, self._paged)
             warm("async_step", lambda: fn(
                 self.params, self.cache, tok, jnp.asarray(zb), tok, pos,
                 key, jnp.asarray(zf), jnp.asarray(zi), jnp.asarray(of)))
@@ -1427,36 +1719,74 @@ class DecodeServer:
             warm("step", lambda: self._step(self.params, self.cache, tok,
                                             pos))
             if sample:
-                fn = _get_sample_step_fn(self.cfg)
+                fn = _get_sample_step_fn(self.cfg, self._paged)
                 warm("sample_step", lambda: fn(
                     self.params, self.cache, tok, pos, key,
                     jnp.asarray(zf), jnp.asarray(zi), jnp.asarray(of)))
         for k in blocks:
             k = int(k)
             if self._async:
-                fn = _get_async_block_fn(self.cfg, k)
+                fn = _get_async_block_fn(self.cfg, k, self._paged)
                 warm(f"async_block{k}", lambda fn=fn: fn(
                     self.params, self.cache, tok, jnp.asarray(zb), tok,
                     pos)[:2])
                 if sample:
-                    fn = _get_async_sample_block_fn(self.cfg, k)
+                    fn = _get_async_sample_block_fn(self.cfg, k,
+                                                    self._paged)
                     warm(f"async_sample_block{k}", lambda fn=fn: fn(
                         self.params, self.cache, tok, jnp.asarray(zb),
                         tok, pos, self._base_key, jnp.asarray(0),
                         jnp.asarray(zf), jnp.asarray(zi),
                         jnp.asarray(of)))
             else:
-                fn = _get_block_fn(self.cfg, k)
+                fn = _get_block_fn(self.cfg, k, self._paged)
                 warm(f"block{k}", lambda fn=fn: fn(
                     self.params, self.cache, tok, pos)[:2])
                 if sample:
-                    fn = _get_sample_block_fn(self.cfg, k)
+                    fn = _get_sample_block_fn(self.cfg, k, self._paged)
                     warm(f"sample_block{k}", lambda fn=fn: fn(
                         self.params, self.cache, tok, pos,
                         self._base_key, jnp.asarray(0), jnp.asarray(zf),
                         jnp.asarray(zi), jnp.asarray(of)))
         window = min(self.max_len, self.cfg.max_seq_len)
-        if self._prefill_chunk is not None:
+        if self._paged and self._prefill_on:
+            # paged admission executables: one offset-aware chunk
+            # program per width (fixed chunk, or the suffix buckets).
+            # Widths floor at the block size (admission's rule), and the
+            # block-size width itself is always warmed: a prefix-hit
+            # admission prefills a sub-block suffix through it, which
+            # must not compile mid-serving on a warmed server
+            if self._chunk:
+                widths = [min(self._chunk, window)]
+            else:
+                # admission buckets the suffix to
+                # min(max(pow2(n - shared), bs), window): a PARTIAL
+                # prefix hit lands on ANY power of two in (bs, pow2(n)]
+                # (not bs*2^k — bs need not be a power of two), plus the
+                # bs floor itself.  Warm exactly that reachable set —
+                # log-many executables, no mid-serving compile
+                def _ladder(top):
+                    ws, p = {min(self._pool.bs, window)}, 1
+                    while p < top:
+                        p *= 2
+                        if p > self._pool.bs:
+                            ws.add(min(p, window))
+                    return ws
+
+                if prompt_lens is None:
+                    widths = _ladder(window)
+                else:
+                    widths = set()
+                    for n in prompt_lens:
+                        widths |= _ladder(
+                            1 << max(0, int(n) - 1).bit_length())
+            for C in sorted(set(widths)):
+                fn = _get_paged_prefill_fn(self.cfg, C)
+                padded = jnp.zeros((1, C), jnp.int32)
+                warm(f"paged_prefill{C}", lambda fn=fn, padded=padded: fn(
+                    self.params, self.cache, padded, jnp.asarray(0),
+                    jnp.asarray(1), jnp.asarray(0)))
+        elif self._prefill_chunk is not None:
             C = self._chunk
             padded = jnp.zeros((1, C), jnp.int32)
             warm(f"prefill_chunk{C}", lambda: self._prefill_chunk(
@@ -1513,13 +1843,14 @@ class DecodeServer:
                     break
             return
         t0 = time.perf_counter()
+        self._ensure_decode_blocks(block)
         tok, pos = self._feed_arrays()
         temp, tk, tp = self._sampling_arrays()
         n = self._step_no
         if temp.any():
             kind = f"sample_block@{block}"
             self._fault_check(kind)
-            fn = _get_sample_block_fn(self.cfg, block)
+            fn = _get_sample_block_fn(self.cfg, block, self._paged)
             toks, self.cache = fn(
                 self.params, self.cache, jnp.asarray(tok),
                 jnp.asarray(pos), self._base_key, jnp.asarray(n),
@@ -1527,7 +1858,7 @@ class DecodeServer:
         else:
             kind = f"block@{block}"
             self._fault_check(kind)
-            fn = _get_block_fn(self.cfg, block)
+            fn = _get_block_fn(self.cfg, block, self._paged)
             toks, self.cache, _, _ = fn(self.params, self.cache,
                                         jnp.asarray(tok), jnp.asarray(pos))
         self._step_no = n + block   # after the call: see _tick_impl

@@ -1,0 +1,498 @@
+"""Paged KV-cache subsystem (text/kv_pool.py).
+
+The properties that matter: (1) the allocator's free-list/refcount/COW
+invariants hold under any interleaving of admissions and retires; (2) a
+request served from POOLED blocks — including blocks adopted from
+another request's prefix — produces exactly the tokens the contiguous
+slab produces (bit-parity across fp32/bf16/int8, tick/block/async); and
+(3) the pool degrades observably: exhaustion queues instead of crashing,
+an OOM on a tick evicts the cold prefix cache first, and every
+allocator mutation counts a telemetry counter (linted).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import faults, flags
+from paddle_tpu.framework import monitor
+from paddle_tpu.ops import decode_attention as da
+from paddle_tpu.text import generate as G
+from paddle_tpu.text import gpt, kv_pool, serving
+
+
+def _cfg(**over):
+    kw = dict(vocab_size=32, hidden_size=32, num_layers=2, num_heads=4,
+              max_seq_len=64)
+    kw.update(over)
+    return gpt.GPTConfig(**kw)
+
+
+@pytest.fixture()
+def kv_env(monkeypatch):
+    """Env setter that also busts the value-keyed jit caches (the flags
+    are part of _cfg_key, but modules cache traced fns across tests)."""
+    def set_(**kw):
+        for k, v in kw.items():
+            if v is None:
+                monkeypatch.delenv(k, raising=False)
+            else:
+                monkeypatch.setenv(k, v)
+        G._GEN_CACHE.clear()
+        serving._STEP_CACHE.clear()
+    yield set_
+    G._GEN_CACHE.clear()
+    serving._STEP_CACHE.clear()
+
+
+@pytest.fixture()
+def interpret():
+    from paddle_tpu.ops import flash_attention as fa
+
+    old_da, old_fa = da._INTERPRET, fa._INTERPRET
+    da._INTERPRET, fa._INTERPRET = True, True
+    G._GEN_CACHE.clear()
+    serving._STEP_CACHE.clear()
+    yield
+    da._INTERPRET, fa._INTERPRET = old_da, old_fa
+    G._GEN_CACHE.clear()
+    serving._STEP_CACHE.clear()
+
+
+# ---------------------------------------------------------------------------
+# allocator invariants (pure host)
+# ---------------------------------------------------------------------------
+
+
+def test_alloc_free_refcount_invariants():
+    a = kv_pool.PagedAllocator(num_blocks=4, block_size=8, nmax=4,
+                               max_batch=2)
+    assert a.blocks_in_use == 0
+    a.ensure_rows(0, 0, 17)            # rows 0..16 -> 3 blocks
+    assert a.blocks_in_use == 3
+    assert (a.tables[0, :3] >= 0).all() and a.tables[0, 3] == -1
+    a.ensure_rows(0, 0, 17)            # idempotent: already mapped
+    assert a.blocks_in_use == 3
+    a.free_slot(0)
+    assert a.blocks_in_use == 0
+    assert (a.tables[0] == -1).all()
+    # freed blocks are reusable
+    a.ensure_rows(1, 0, 32)
+    assert a.blocks_in_use == 4
+    with pytest.raises(kv_pool.PoolExhausted):
+        a.ensure_rows(0, 0, 8)
+
+
+def test_pool_exhausted_classifies_as_oom():
+    from paddle_tpu import resilience
+
+    assert resilience.is_oom(kv_pool.PoolExhausted(1, 4))
+
+
+def test_prefix_adopt_register_cap_and_cow():
+    bs = 8
+    a = kv_pool.PagedAllocator(num_blocks=8, block_size=bs, nmax=4,
+                               max_batch=2)
+    prompt = list(range(20))           # 2 full blocks + 4-row tail
+    a.ensure_rows(0, 0, len(prompt))
+    a.register_prefix(0, prompt)
+    assert a.prefix_entries == 2       # full blocks only, never the tail
+    # index holds its own ref: retiring the owner keeps the blocks
+    owned = [int(a.tables[0, i]) for i in range(2)]
+    a.free_slot(0)
+    assert a.blocks_in_use == 2
+    # a second identical prompt adopts both blocks (capped at n-1 rows)
+    shared = a.adopt_prefix(1, prompt)
+    assert shared == 16
+    assert [int(a.tables[1, i]) for i in range(2)] == owned
+    assert a.prefix_hits == 2
+    # the adopted blocks are shared (ref 2): a write COWs
+    a.ensure_rows(1, 8, 20)
+    assert a.cow_copies == 1
+    assert int(a.tables[1, 1]) != owned[1]     # remapped
+    assert int(a.tables[1, 0]) == owned[0]     # untouched block stays
+    src_dst = a.take_copies()
+    assert src_dst == [(owned[1], int(a.tables[1, 1]))]
+    # divergent prompt: chain key mismatch after block 0
+    other = prompt[:8] + [99] * 12
+    a2 = kv_pool.PagedAllocator(num_blocks=8, block_size=bs, nmax=4,
+                                max_batch=2)
+    a2.ensure_rows(0, 0, 20)
+    a2.register_prefix(0, prompt)
+    assert a2.adopt_prefix(1, other) == 8
+    assert a2.prefix_misses >= 1
+
+
+def test_evict_cold_frees_only_index_held_blocks():
+    a = kv_pool.PagedAllocator(num_blocks=8, block_size=8, nmax=4,
+                               max_batch=2)
+    p1, p2 = list(range(8)), list(range(100, 108))
+    a.ensure_rows(0, 0, 8)
+    a.register_prefix(0, p1)
+    a.ensure_rows(1, 0, 8)
+    a.register_prefix(1, p2)
+    a.free_slot(0)                      # p1's block now cold (index-only)
+    freed = a.evict_cold()
+    assert freed == 1                   # p2's block is hot (slot 1 lives)
+    assert a.prefix_entries == 1
+    a.free_slot(1)
+    assert a.evict_cold() == 1
+    assert a.blocks_in_use == 0
+
+
+def test_close_releases_everything():
+    a = kv_pool.PagedAllocator(num_blocks=6, block_size=8, nmax=3,
+                               max_batch=2)
+    a.ensure_rows(0, 0, 24)
+    a.register_prefix(0, list(range(24)))
+    a.close()
+    assert a.blocks_in_use == 0 and a.prefix_entries == 0
+
+
+# ---------------------------------------------------------------------------
+# cache format
+# ---------------------------------------------------------------------------
+
+
+def test_init_paged_cache_shapes(kv_env):
+    cfg = _cfg(num_kv_heads=2)
+    c = G.init_cache(cfg, 3, 20, layout="paged", block_size=8)
+    # rows round to 24 -> nmax 3; full provisioning 3*3 blocks
+    assert c["k"].shape == (2, 9, 8, 2, 8)
+    assert c["tables"].shape == (3, 3)
+    assert int(c["tables"].min()) == -1
+    kv_env(PADDLE_TPU_KV_DTYPE="int8")
+    c8 = G.init_cache(cfg, 1, 16, layout="paged", block_size=8,
+                      num_blocks=4)
+    assert c8["k"].dtype == jnp.int8
+    assert c8["k_s"].shape == (2, 4, 8, 2)
+
+
+def test_random_filled_cache_paged_identity_tables():
+    cfg = _cfg()
+    c = G.init_cache(cfg, 2, 16, layout="paged", block_size=8)
+    filled = da.random_filled_cache(c, jax.random.PRNGKey(0))
+    t = np.asarray(filled["tables"])
+    assert (t >= 0).all() and len(set(t.ravel().tolist())) == t.size
+    assert float(np.abs(np.asarray(filled["k"], np.float32)).max()) > 0
+
+
+def test_round_len_whole_blocks():
+    assert kv_pool.round_len(20, 8) == 24
+    assert kv_pool.round_len(32, 16) == 32
+    assert kv_pool.round_len(5, 8) == 8
+
+
+# ---------------------------------------------------------------------------
+# paged vs contiguous bit-parity (the acceptance gate)
+# ---------------------------------------------------------------------------
+
+
+def _serve(params, cfg, prompts, layout, max_new=6, tick="tick",
+           async_=False, **kw):
+    srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=32,
+                               layout=layout, async_dispatch=async_, **kw)
+    rids = [srv.submit(p, max_new_tokens=max_new) for p in prompts]
+    while srv.pending():
+        if tick == "block":
+            srv.tick_block(4)
+        else:
+            srv.tick()
+    out = [srv.result(r) for r in rids]
+    stats = srv._pool.stats() if srv._pool is not None else None
+    srv.close()
+    return out, stats
+
+
+@pytest.mark.parametrize("kv", ["fp32", "bf16", "int8"])
+def test_paged_matches_contiguous_greedy(kv_env, kv, markov_gpt):
+    kv_env(PADDLE_TPU_KV_DTYPE=None if kv == "fp32" else kv)
+    cfg, params = markov_gpt
+    rng = np.random.default_rng(0)
+    shared = list(rng.integers(0, 13, 8))
+    prompts = [shared + [1, 5], shared + [2], list(rng.integers(0, 13, 5))]
+    cont, _ = _serve(params, cfg, prompts, "contiguous")
+    paged, stats = _serve(params, cfg, prompts, "paged", block_size=8)
+    assert paged == cont
+    assert stats["prefix_hits"] > 0      # the shared 8-row block reused
+
+
+def test_paged_matches_contiguous_block_and_async(markov_gpt):
+    cfg, params = markov_gpt
+    rng = np.random.default_rng(1)
+    prompts = [list(rng.integers(0, 13, n)) for n in (9, 4, 12)]
+    ref, _ = _serve(params, cfg, prompts, "contiguous")
+    for tick, async_ in (("block", False), ("tick", True),
+                         ("block", True)):
+        got, _ = _serve(params, cfg, prompts, "paged", tick=tick,
+                        async_=async_, block_size=8)
+        assert got == ref, (tick, async_)
+
+
+def test_paged_sampled_parity(markov_gpt):
+    """Sampled requests draw from the same fold_in schedule: identical
+    tokens for identical step counters across layouts."""
+    cfg, params = markov_gpt
+
+    def run(layout):
+        srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=32,
+                                   layout=layout, block_size=8, seed=7)
+        r0 = srv.submit([1, 2, 3], max_new_tokens=6, temperature=0.8,
+                        top_k=5)
+        r1 = srv.submit([4, 5], max_new_tokens=6)
+        while srv.pending():
+            srv.tick()
+        out = srv.result(r0), srv.result(r1)
+        srv.close()
+        return out
+
+    assert run("paged") == run("contiguous")
+
+
+def test_prefix_hit_bit_identical_and_prefill_rows_saved(markov_gpt):
+    """A repeated prompt adopts the registered blocks: prefill runs only
+    the suffix (FLOPs skipped), tokens stay bit-identical to cold."""
+    cfg, params = markov_gpt
+    prompt = [int(x) for x in np.random.default_rng(3).integers(0, 13, 18)]
+    srv = serving.DecodeServer(params, cfg, max_batch=1, max_len=32,
+                               layout="paged", block_size=8)
+    rows0 = int(monitor.get_stat("kv_pool.prefill_rows").get())
+    r0 = srv.submit(prompt, max_new_tokens=4)
+    while srv.pending():
+        srv.tick()
+    cold = srv.result(r0)
+    rows_cold = int(monitor.get_stat("kv_pool.prefill_rows").get()) - rows0
+    r1 = srv.submit(prompt, max_new_tokens=4)
+    while srv.pending():
+        srv.tick()
+    warm = srv.result(r1)
+    rows_warm = (int(monitor.get_stat("kv_pool.prefill_rows").get())
+                 - rows0 - rows_cold)
+    stats = srv._pool.stats()
+    srv.close()
+    assert warm == cold
+    assert stats["prefix_hits"] >= 2
+    assert rows_warm < rows_cold         # shared blocks never recomputed
+
+
+def test_cow_on_fully_shared_prompt(markov_gpt):
+    """A prompt that is entirely indexed still computes its last token:
+    the one-row write into the shared final block copy-on-writes it."""
+    cfg, params = markov_gpt
+    prompt = [int(x) for x in np.random.default_rng(4).integers(0, 13, 16)]
+    out, stats = _serve(params, cfg, [prompt, prompt], "paged",
+                        block_size=8)
+    assert out[0] == out[1]
+    assert stats["cow_copies"] >= 1
+    ref, _ = _serve(params, cfg, [prompt, prompt], "contiguous")
+    assert out == ref
+
+
+def test_pool_exhaustion_queues_until_blocks_free(markov_gpt):
+    """A pool too small for two concurrent requests serves them anyway:
+    the second waits in the queue until the first retires its blocks."""
+    cfg, params = markov_gpt
+    srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=32,
+                               layout="paged", block_size=8, num_blocks=2)
+    p = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+    # request 1 owns both blocks; request 2's admission exhausts the
+    # pool (even with block 0 adopted) and must PARK, not fail
+    rids = [srv.submit(p, max_new_tokens=4) for _ in range(2)]
+    assert srv.status(rids[1]) == "queued"
+    for _ in range(200):
+        if not srv.pending():
+            break
+        srv.tick()
+    outs = [srv.result(r) for r in rids]
+    srv.close()
+    assert outs[0] == outs[1] and len(outs[0]) == 4
+
+
+def test_oom_fault_evicts_cold_prefix_cache_first(markov_gpt):
+    """PADDLE_TPU_FAULTS=oom:serving.block:1 — the OOM chain's NEW first
+    rung drops index-only blocks before degrading dispatch, and the
+    faulted pass still yields bit-identical tokens."""
+    cfg, params = markov_gpt
+    prompt = [int(x) for x in np.random.default_rng(5).integers(0, 13, 12)]
+
+    def run(spec):
+        faults.reset()
+        try:
+            srv = serving.DecodeServer(params, cfg, max_batch=2,
+                                       max_len=32, layout="paged",
+                                       block_size=8)
+            r0 = srv.submit(prompt, max_new_tokens=4)
+            while srv.pending():
+                srv.tick_block(4)
+            # r0 retired: its prefix block is now COLD (index-only) —
+            # install the fault so the NEXT block tick OOMs and the
+            # chain's first rung has something to evict
+            cold_entries = srv._pool.prefix_entries
+            if spec:
+                faults.install(spec)
+            # r1 shares NO prefix with r0, so r0's entry stays cold —
+            # exactly what the first rung exists to reclaim
+            r1 = srv.submit([int(x) for x in prompt[::-1][:10]],
+                            max_new_tokens=4)
+            while srv.pending():
+                srv.tick_block(4)
+            out = (srv.result(r0), srv.result(r1))
+            entries_after = srv._pool.prefix_entries
+            srv.close()
+            return out, cold_entries, entries_after
+        finally:
+            faults.reset()
+
+    clean, _, _ = run("")
+    before = int(monitor.get_stat("kv_pool.prefix_evictions").get())
+    faulted, cold_entries, after = run("oom:serving.block:1")
+    evictions = (int(monitor.get_stat("kv_pool.prefix_evictions").get())
+                 - before)
+    assert cold_entries >= 1
+    assert evictions >= 1
+    assert faulted == clean
+    assert int(monitor.get_stat("resilience.oom_retries").get()) >= 1
+
+
+def test_donation_safety_of_pooled_leaves(kv_env):
+    """The paged step donates its cache like the slab step: the passed
+    leaves are consumed (deleted) and the returned tree is fresh."""
+    cfg = _cfg()
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=16,
+                               layout="paged", block_size=8)
+    srv.submit([1, 2, 3], max_new_tokens=4)
+    old = srv.cache
+    srv.tick()
+    assert flags.donate_decode()
+    assert old["k"].is_deleted() and old["v"].is_deleted()
+    assert not srv.cache["k"].is_deleted()
+    srv.close()
+
+
+def test_kv_utilization_gauge_true_occupancy(markov_gpt):
+    """Satellite: paged reports blocks-in-use / pool size; contiguous
+    reports filled rows over the slab's REAL (rounded) row count."""
+    from paddle_tpu import telemetry as tl
+
+    if not tl.enabled():
+        pytest.skip("telemetry off")
+    cfg, params = markov_gpt
+    srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=20,
+                               layout="paged", block_size=8,
+                               num_blocks=8)
+    srv.submit([1, 2, 3, 4, 5], max_new_tokens=8)
+    srv.tick()
+    g = tl.snapshot()["gauges"]
+    used = srv._pool.blocks_in_use
+    assert g["serving.kv_utilization"] == pytest.approx(used / 8)
+    assert g["kv_pool.blocks_in_use"] == used
+    srv.close()
+    # contiguous: rows denominator is the rounded allocation (24), not
+    # max_len (20)
+    srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=20)
+    srv.submit([1, 2, 3, 4, 5], max_new_tokens=8)
+    srv.tick()
+    rows = int(srv.cache["k"].shape[2])
+    pos = [st["pos"] for st in srv._slots.values()]
+    g = tl.snapshot()["gauges"]
+    assert rows == 24
+    assert g["serving.kv_utilization"] == pytest.approx(
+        sum(pos) / (2 * rows))
+    srv.close()
+
+
+def test_jit_key_covers_layout_flags(kv_env):
+    base = flags.decode_jit_key()
+    kv_env(PADDLE_TPU_KV_LAYOUT="paged")
+    paged = flags.decode_jit_key()
+    assert paged != base and "paged" in paged
+    kv_env(PADDLE_TPU_KV_LAYOUT=None, PADDLE_TPU_KV_BLOCK="32")
+    assert flags.decode_jit_key() != base
+    kv_env(PADDLE_TPU_KV_BLOCK=None)
+    assert flags.decode_jit_key() == base
+
+
+def test_layout_flag_flips_server_default(kv_env, markov_gpt):
+    cfg, params = markov_gpt
+    kv_env(PADDLE_TPU_KV_LAYOUT="paged")
+    srv = serving.DecodeServer(params, cfg, max_batch=1, max_len=16)
+    assert srv._paged and "tables" in srv.cache
+    srv.close()
+
+
+# ---------------------------------------------------------------------------
+# paged kernel (interpret mode: the real Pallas body on CPU)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kv", ["fp32", "int8"])
+def test_paged_kernel_matches_gathered_oracle(interpret, kv):
+    B, Hkv, G_, hd = 2, 2, 2, 64
+    bs, nmax, N = 8, 4, 10
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, 1, Hkv * G_, hd), jnp.float32)
+    kp = jax.random.normal(ks[1], (N, bs, Hkv, hd), jnp.float32)
+    vp = jax.random.normal(ks[2], (N, bs, Hkv, hd), jnp.float32)
+    tables = jnp.asarray([[3, 5, 1, -1], [0, 7, -1, -1]], jnp.int32)
+    pos = jnp.asarray([17, 9], jnp.int32)
+    ksc = vsc = None
+    if kv == "int8":
+        kp, ksc = da.quantize_kv(kp)
+        vp, vsc = da.quantize_kv(vp)
+    out = da.paged_decode_attention(q, kp, vp, tables, pos,
+                                    k_scale=ksc, v_scale=vsc)
+    ref = da._xla_paged(q, kp, vp, tables, pos, ksc, vsc, None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_kernel_route_greedy_tokens(interpret, kv_env):
+    """Through the server: the paged KERNEL route (scatter-then-gather
+    through the grid) yields the same greedy tokens as the contiguous
+    kernel route."""
+    # head_dim 64 (the kernel's smallest tile) at the smallest width
+    cfg = _cfg(hidden_size=128, num_heads=2, vocab_size=16)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(1))
+    rng = np.random.default_rng(6)
+    prompts = [list(rng.integers(1, 15, 10)), list(rng.integers(1, 15, 5))]
+    ref, _ = _serve(params, cfg, prompts, "contiguous", max_new=5)
+    got, _ = _serve(params, cfg, prompts, "paged", max_new=5,
+                    block_size=8)
+    assert got == ref
+
+
+# ---------------------------------------------------------------------------
+# lint: every allocator mutation path counts a telemetry counter
+# ---------------------------------------------------------------------------
+
+
+def test_check_instrumented_kv_rule_catches_silent_alloc():
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                    "tools"))
+    import check_instrumented as ci
+
+    bad = ("class P:\n"
+           "    def alloc_block(self):\n"
+           "        return self.free.pop()\n")
+    assert ci.scan_kv_pool_source(bad)
+    good = ("class P:\n"
+            "    def alloc_block(self):\n"
+            "        count('kv_pool.blocks_allocated')\n"
+            "        return self.free.pop()\n"
+            "    def free_slot(self):\n"
+            "        self.alloc_block()\n")
+    assert not ci.scan_kv_pool_source(good)
+
+
+def test_check_instrumented_repo_clean():
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                    "tools"))
+    import check_instrumented as ci
+
+    assert ci.scan_repo() == []

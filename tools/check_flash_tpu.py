@@ -237,6 +237,51 @@ def check_decode_step_tokens(kv):
     print(f"  decode_step OK kv={kv}", flush=True)
 
 
+def check_paged_decode(B, Tq, Hq, Hkv, hd, bs, nmax, kv, dtype):
+    """Block-table (paged) decode kernel vs its gather oracle on the
+    real chip — the pooled cache layout's grid-resolved table path must
+    be parity-certified like the contiguous split-KV kernel.  The cache
+    is built through ``random_filled_cache`` on a real paged pytree, so
+    the oracle covers the exact block-table gathers serving performs."""
+    from paddle_tpu.ops import decode_attention as da
+    from paddle_tpu.text import generate as G, gpt
+
+    old = os.environ.get("PADDLE_TPU_KV_DTYPE", "")
+    os.environ["PADDLE_TPU_KV_DTYPE"] = kv if kv != "compute" else ""
+    try:
+        cfg = gpt.GPTConfig(vocab_size=64, hidden_size=Hq * hd,
+                            num_layers=1, num_heads=Hq,
+                            num_kv_heads=Hkv if Hkv != Hq else None,
+                            max_seq_len=max(64, bs * nmax),
+                            dtype=dtype)
+        cache = da.random_filled_cache(
+            G.init_cache(cfg, B, bs * nmax, layout="paged", block_size=bs,
+                         num_blocks=B * nmax),
+            jax.random.PRNGKey(9))
+    finally:
+        if old:
+            os.environ["PADDLE_TPU_KV_DTYPE"] = old
+        else:
+            os.environ.pop("PADDLE_TPU_KV_DTYPE", None)
+    q = jax.random.normal(jax.random.PRNGKey(10), (B, Tq, Hq, hd), dtype)
+    kp, vp = cache["k"][0], cache["v"][0]
+    ksc = cache["k_s"][0] if "k_s" in cache else None
+    vsc = cache["v_s"][0] if "v_s" in cache else None
+    tables = cache["tables"]
+    T = bs * nmax
+    pos = jnp.asarray(np.linspace(T // 2, T - Tq, B), jnp.int32)
+    out = da._paged_call(q, kp, vp, tables, pos, ksc, vsc, None)
+    ref = da._xla_paged(q, kp, vp, tables, pos, ksc, vsc, None)
+    tol = 2e-2 if dtype == jnp.bfloat16 else 4e-3
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=tol, rtol=tol,
+                               err_msg=f"paged B{B} bs{bs} nmax{nmax} "
+                                       f"Hq{Hq} Hkv{Hkv} D{hd} kv={kv}")
+    print(f"  paged_decode OK B{B} bs{bs} nmax{nmax} Hq{Hq} Hkv{Hkv} "
+          f"D{hd} kv={kv} {jnp.dtype(dtype).name}", flush=True)
+
+
 if __name__ == "__main__":
     # a marker from a PREVIOUS run must not certify this one: remove it
     # up front so a crash below leaves no stale certification behind
@@ -343,6 +388,15 @@ if __name__ == "__main__":
             lambda: check_decode_step_tokens("compute"))
     _cached("decode:step:int8",
             lambda: check_decode_step_tokens("int8"))
+    # paged (block-table) decode kernel: pool geometry the paged serving
+    # bench uses (bs=16), GQA bf16 + int8, through random_filled_cache's
+    # paged format — block-table gathers certified with the family
+    _cached("decode:paged:B8bs16n64H16Hkv4D64:bf16:bf16",
+            lambda: check_paged_decode(8, 1, 16, 4, 64, 16, 64, "bf16",
+                                       jnp.bfloat16))
+    _cached("decode:paged:B8bs16n64H16Hkv4D64:int8:bf16",
+            lambda: check_paged_decode(8, 1, 16, 4, 64, 16, 64, "int8",
+                                       jnp.bfloat16))
     print("flash-decode attention all OK", flush=True)
     _write_marker(dict({fam: _SIG[fam] for fam in TRAINING_FAMILIES},
                        w4=_SIG["w4"], decode=_SIG["decode"]))

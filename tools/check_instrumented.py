@@ -48,6 +48,7 @@ WRAPPER_NAMES = {"_watch_jit", "instrument_compile"}
 SCAN = (
     os.path.join("paddle_tpu", "text", "serving.py"),
     os.path.join("paddle_tpu", "text", "generate.py"),
+    os.path.join("paddle_tpu", "text", "kv_pool.py"),
     os.path.join("paddle_tpu", "jit"),
 )
 
@@ -64,6 +65,15 @@ RESIL_SCAN = (
 DEGRADE_MARKERS = ("_shed", "shed_", "evict", "oom_degrade",
                    "recover_wedge", "fail_request")
 COUNT_NAMES = {"count", "set_runtime_wedge"}
+
+# KV-pool lint (round 8, same rule family): every allocator mutation
+# path in text/kv_pool.py — allocation, release, copy-on-write, prefix
+# eviction — must count a telemetry counter (directly, or by delegating
+# to a marker-named method that does: free_slot -> _decref_free).  A
+# silent block leak or an uncounted COW storm reads as healthy on every
+# dashboard while the pool quietly starves.
+KV_POOL_FILE = os.path.join("paddle_tpu", "text", "kv_pool.py")
+KV_MARKERS = ("alloc", "evict", "cow", "free")
 
 
 def _call_name(node: ast.Call):
@@ -144,6 +154,31 @@ def scan_resilience_source(src: str, filename: str = "<src>") -> list:
     return violations
 
 
+def scan_kv_pool_source(src: str, filename: str = "<src>") -> list:
+    """KV-pool lint violations in one source string: a function whose
+    name carries a :data:`KV_MARKERS` marker must contain a call to one
+    of :data:`COUNT_NAMES` or delegate to another marker-named
+    callable."""
+    tree = ast.parse(src, filename=filename)
+    violations = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and any(m in node.name for m in KV_MARKERS)):
+            continue
+        counted = any(
+            isinstance(n, ast.Call)
+            and (_call_name(n) in COUNT_NAMES
+                 or any(m in (_call_name(n) or "") for m in KV_MARKERS))
+            for n in ast.walk(node))
+        if not counted:
+            violations.append(
+                (filename, node.lineno,
+                 f"kv_pool mutation site {node.name}() records no "
+                 f"telemetry counter (count) — silent block leaks/COW "
+                 f"storms read as healthy"))
+    return violations
+
+
 def _walk_py(path: str) -> list:
     out = []
     for dirpath, _, names in sorted(os.walk(path)):
@@ -183,6 +218,12 @@ def scan_repo(root: str | None = None) -> list:
             src = f.read()
         violations.extend(
             scan_resilience_source(src, os.path.relpath(path, root)))
+    # kv-pool lint: allocator mutation observability
+    kv_path = os.path.join(root, KV_POOL_FILE)
+    if os.path.exists(kv_path):
+        with open(kv_path, encoding="utf-8") as f:
+            violations.extend(scan_kv_pool_source(
+                f.read(), os.path.relpath(kv_path, root)))
     return violations
 
 
