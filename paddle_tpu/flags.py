@@ -327,6 +327,48 @@ def kv_block_size() -> int:
     return bs
 
 
+def fleet_prefill_threshold() -> int:
+    """Prompt length (tokens) at which the fleet router hands admission
+    prefill to a dedicated prefill worker instead of the decode
+    replica's own admission path (``PADDLE_TPU_FLEET_PREFILL_THRESHOLD``,
+    default 0 = every prompt when a worker is attached).  Host
+    scheduling only — never a jit-cache key; the handoff's injected
+    rows are bit-identical to local prefill either way, the threshold
+    only picks WHERE the prefill FLOPs run."""
+    try:
+        return max(0, int(os.environ.get(
+            "PADDLE_TPU_FLEET_PREFILL_THRESHOLD", "0")))
+    except ValueError:
+        return 0
+
+
+def fleet_tick_block() -> int:
+    """Decode steps per replica tick in the fleet router's serve loop
+    (``PADDLE_TPU_FLEET_TICK_BLOCK``, default 1): >1 routes each
+    replica's tick through ``tick_block(k)`` — fewer host round trips
+    per token at block-granular retirement, the bench's serving
+    lever.  Host scheduling only."""
+    try:
+        return max(1, int(os.environ.get("PADDLE_TPU_FLEET_TICK_BLOCK",
+                                         "1")))
+    except ValueError:
+        return 1
+
+
+def fleet_max_queue() -> int:
+    """Queued requests the router will stack on one replica beyond its
+    free slots before holding work in the fleet-level queue
+    (``PADDLE_TPU_FLEET_MAX_QUEUE``, default 2).  Deeper stacking hides
+    admission latency; shallower keeps work re-routable (a request
+    still in the FLEET queue can go to any replica when one wedges or
+    frees up).  Host scheduling only."""
+    try:
+        return max(0, int(os.environ.get("PADDLE_TPU_FLEET_MAX_QUEUE",
+                                         "2")))
+    except ValueError:
+        return 2
+
+
 def telemetry_enabled() -> bool:
     """Runtime telemetry master switch (ON by default).
 

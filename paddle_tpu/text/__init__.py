@@ -9,6 +9,7 @@ from . import seq2seq  # noqa: F401
 from . import moe  # noqa: F401
 from . import woq  # noqa: F401
 from . import serving  # noqa: F401
+from . import fleet  # noqa: F401
 from . import lora  # noqa: F401
 from . import evaluate  # noqa: F401
 from .gpt import GPTConfig, gpt_1p3b, gpt_13b  # noqa: F401

@@ -1,0 +1,835 @@
+"""Disaggregated serving fleet: prefill/decode split + a telemetry router.
+
+The reference dedicates ~20k LoC to distributed serving infrastructure
+(``fluid/distributed``: a param-server fleet over brpc) and a 47k-LoC
+inference layer of per-thread predictors.  This module is the jax-era
+equivalent at LLM-serving granularity — three legs that compose the
+pieces earlier rounds built:
+
+* **Tensor-parallel decode inside the server** lives in
+  ``serving.DecodeServer(mesh=...)`` (round 9): the batched tick runs
+  Megatron-sharded through the same step getters, the paged pool's Hkv
+  axis sharding like the slab's head axis
+  (``generate.sharded_cache_specs``), donation/jit-key/recompile-watch
+  composing unchanged.
+* **Prefill/decode disaggregation**: :class:`PrefillWorker` runs
+  admission prefill OFF the token loop — the same bucketed executables
+  the decode replica would run locally (``serving._get_prefill_fn`` /
+  ``_get_paged_prefill_fn``), on its own single-slot cache — and streams
+  the finished cache rows + admission logits back over a pluggable
+  transport (:class:`LoopbackTransport` in-process for tests/CPU,
+  :class:`SocketTransport` TCP frames for real fleets).  The decode side
+  injects them via ``DecodeServer.submit_prefilled`` (one donated
+  injector executable per bucket; paged: scattered through the block
+  table), so decode proceeds BIT-IDENTICALLY to local admission while
+  long prompts never stall TPOT.
+* **A multi-replica** :class:`Router` front-end: admission, priority and
+  TTL-aware shedding at the fleet queue, load balancing on the exact
+  quantities the telemetry gauges sample (queue depth, slot occupancy,
+  KV utilization — read per replica via ``DecodeServer.load_stats``),
+  per-replica health aggregation (a wedged replica is drained and its
+  queued work re-routed onto survivors, leaning on the round-7 wedge
+  recovery for its active slots), and fleet-level Prometheus export
+  (``fleet.*`` counters/gauges land in the shared registry, so
+  ``Router(metrics_port=...)`` serves them next to the serving feeds).
+
+Transport frames are pickled python objects: the links carry model
+activations between co-owned processes — the SAME trust domain as the
+weights.  Never expose a transport port beyond that domain.
+"""
+from __future__ import annotations
+
+import contextlib
+import pickle
+import queue
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import generate, gpt, serving
+from .. import flags as _flags
+from .. import resilience as _resilience
+from .. import telemetry as _telemetry
+
+__all__ = [
+    "LoopbackTransport", "SocketTransport", "PrefillWorker", "Router",
+    "serve_prefill_worker",
+]
+
+
+# ---------------------------------------------------------------------------
+# transports: one message-passing shape, two fabrics
+# ---------------------------------------------------------------------------
+
+
+class _QueueEndpoint:
+    """One side of an in-process transport (a pair of ``queue.Queue``)."""
+
+    def __init__(self, send_q: queue.Queue, recv_q: queue.Queue):
+        self._send = send_q
+        self._recv = recv_q
+
+    def send(self, obj) -> None:
+        self._send.put(obj)
+
+    def recv(self, timeout: float = 0.0):
+        """Next message, or None when none arrives within ``timeout``."""
+        try:
+            if timeout and timeout > 0:
+                return self._recv.get(timeout=timeout)
+            return self._recv.get_nowait()
+        except queue.Empty:
+            return None
+
+    def close(self) -> None:
+        pass
+
+
+class LoopbackTransport:
+    """In-process endpoint pair (tests, CPU fleets, co-located workers):
+    ``.client`` is the router's side, ``.worker`` the prefill worker's —
+    messages pass by reference, zero serialization."""
+
+    def __init__(self):
+        a, b = queue.Queue(), queue.Queue()
+        self.client = _QueueEndpoint(a, b)
+        self.worker = _QueueEndpoint(b, a)
+
+
+# a frame the peer started but never finished within this budget is a
+# dead link, not a slow one
+_FRAME_BUDGET_S = 30.0
+
+
+class _SocketEndpoint:
+    """Length-prefixed pickle frames over one TCP socket (same send/recv
+    surface as the loopback endpoint).  Writes are locked (whole frames,
+    atomic w.r.t. other senders on this endpoint); reads buffer partial
+    frames across ``recv`` calls so a timeout never tears one."""
+
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+        self._wlock = threading.Lock()
+        self._buf = b""
+
+    def send(self, obj) -> None:
+        payload = pickle.dumps(obj, protocol=4)
+        with self._wlock:
+            self._sock.sendall(struct.pack(">Q", len(payload)) + payload)
+
+    def recv(self, timeout: float = 0.0):
+        deadline = time.perf_counter() + max(float(timeout), 0.0)
+        frame_deadline = None
+        tried = False
+        while True:
+            if len(self._buf) >= 8:
+                (ln,) = struct.unpack(">Q", self._buf[:8])
+                if len(self._buf) >= 8 + ln:
+                    body = self._buf[8:8 + ln]
+                    self._buf = self._buf[8 + ln:]
+                    return pickle.loads(body)
+            if self._buf and frame_deadline is None:
+                # ANY partial frame arms the budget — a peer stalling
+                # mid-header (< 8 bytes) is as dead as one stalling
+                # mid-body
+                frame_deadline = time.perf_counter() + _FRAME_BUDGET_S
+            rem = deadline - time.perf_counter()
+            if self._buf:
+                # mid-frame: wait for the rest (bounded by the frame
+                # budget), even past the caller's poll timeout
+                rem = max(rem, 0.05)
+                if time.perf_counter() > frame_deadline:
+                    raise ConnectionError(
+                        "torn transport frame (peer died mid-send?)")
+            elif rem <= 0 and tried:
+                # timeout 0 is a POLL: at least one non-blocking read
+                # attempt runs before giving up
+                return None
+            tried = True
+            self._sock.settimeout(max(rem, 1e-3))
+            try:
+                chunk = self._sock.recv(1 << 20)
+            except socket.timeout:
+                continue
+            except ConnectionError:
+                raise
+            except OSError as e:
+                # ECONNRESET and friends are OSErrors too: an abortive
+                # peer death must raise like an orderly one, never read
+                # as an idle link
+                raise ConnectionError(
+                    f"transport socket error: {e}") from e
+            if not chunk:
+                # orderly shutdown: the peer is GONE, not idle — raise
+                # so the router can fail outstanding work instead of
+                # polling a dead link forever
+                raise ConnectionError(
+                    "transport closed mid-frame" if self._buf
+                    else "transport closed by peer")
+            self._buf += chunk
+
+    def close(self) -> None:
+        with contextlib.suppress(OSError):
+            self._sock.close()
+
+
+class _SocketListener:
+    def __init__(self, srv: socket.socket):
+        self._srv = srv
+        self.port = srv.getsockname()[1]
+
+    def accept(self, timeout: float = 30.0) -> _SocketEndpoint:
+        self._srv.settimeout(timeout)
+        sock, _ = self._srv.accept()
+        return _SocketEndpoint(sock)
+
+    def close(self) -> None:
+        with contextlib.suppress(OSError):
+            self._srv.close()
+
+
+class SocketTransport:
+    """TCP transport for cross-process fleets: ``listen`` on the worker
+    host, ``connect`` from the router.  Frames are pickled — the link
+    carries cache rows between co-owned processes (the weights' trust
+    domain); never expose the port beyond it."""
+
+    @staticmethod
+    def listen(host: str = "127.0.0.1", port: int = 0) -> _SocketListener:
+        srv = socket.create_server((host, int(port)))
+        return _SocketListener(srv)
+
+    @staticmethod
+    def connect(host: str, port: int,
+                timeout: float = 30.0) -> _SocketEndpoint:
+        return _SocketEndpoint(
+            socket.create_connection((host, int(port)), timeout=timeout))
+
+
+# ---------------------------------------------------------------------------
+# prefill worker: admission prefill off the token loop
+# ---------------------------------------------------------------------------
+
+
+class PrefillWorker:
+    """Dedicated prefill engine: one slot, the SAME bucketed admission
+    executables a ``DecodeServer`` runs locally — so the rows it streams
+    to a decode replica produce bit-identical greedy decode.
+
+    ``layout`` must match the decode replicas' (the two layouts' prefill
+    math differs in reduction shape, and bit-parity is the contract);
+    ``device`` pins the worker's compute to one chip so fleet prefill
+    runs beside, not inside, the decode replicas' devices.  Drive it
+    cooperatively (:meth:`run_once`) or as a daemon thread
+    (:meth:`start`) consuming ``{"rid", "prompt"}`` jobs from
+    ``endpoint`` and answering ``{"rid", "rows", "logits"}`` (or
+    ``{"rid", "error"}``)."""
+
+    def __init__(self, params, cfg: gpt.GPTConfig, max_len: int,
+                 layout: str | None = None, block_size: int | None = None,
+                 endpoint=None, device=None, name: str = "prefill"):
+        lay = layout if layout is not None else _flags.kv_layout()
+        if lay not in ("contiguous", "paged"):
+            raise ValueError(
+                f"layout {lay!r}: expected 'contiguous' or 'paged'")
+        self.cfg = cfg
+        self.max_len = int(max_len)
+        self.name = name
+        self.endpoint = endpoint
+        self._paged = lay == "paged"
+        self._device = device
+        # placement joins the step-cache keys (serving._shard_key): two
+        # workers pinned to different chips must not share executables
+        self._skey = (("device", int(getattr(device, "id", 0)))
+                      if device is not None else None)
+        self.params = (jax.device_put(params, device)
+                       if device is not None else params)
+        if self._paged:
+            from . import kv_pool as _kv
+
+            self.cache = generate.init_cache(cfg, 1, max_len,
+                                             layout="paged",
+                                             block_size=block_size)
+            self._pool = _kv.PagedAllocator(
+                self.cache["k"].shape[1], self.cache["k"].shape[2],
+                self.cache["tables"].shape[1], 1)
+        else:
+            self._pool = None
+            self.cache = generate.init_cache(cfg, 1, max_len)
+        if device is not None:
+            self.cache = jax.device_put(self.cache, device)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._tel = _telemetry.enabled()
+
+    def prefill(self, prompt):
+        """Run one prompt's admission prefill; returns ``(rows,
+        logits)``: rows are host arrays ``[L, 1, n, Hkv(, hd)]`` per
+        cache leaf (int8 scale planes included) in the storage dtype,
+        logits the fp32 ``[V]`` admission logits — exactly what
+        ``DecodeServer.submit_prefilled`` expects."""
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        n = len(prompt)
+        window = min(self.max_len, self.cfg.max_seq_len)
+        if not prompt or n > window:
+            raise ValueError(f"prompt length {n} outside (0, {window}]")
+        t0 = time.perf_counter()
+        if self._paged:
+            bs = self._pool.bs
+            # the decode replica's fresh-prompt rule (shared = 0):
+            # bucketed suffix, floored at the block size — identical
+            # executable, identical math, identical rows
+            C = min(max(serving._pow2_bucket(n), bs), window)
+            self._pool.ensure_rows(0, 0, n)
+            tables = jnp.asarray(self._pool.tables)
+            if self._device is not None:
+                tables = jax.device_put(tables, self._device)
+            self.cache = dict(self.cache, tables=tables)
+            self._pool.dirty = False
+            fn = serving._get_paged_prefill_fn(self.cfg, C, self._skey)
+            padded = np.zeros((1, C), np.int32)
+            padded[0, :n] = prompt
+            logits, self.cache = fn(
+                self.params, self.cache, jnp.asarray(padded),
+                jnp.asarray(0), jnp.asarray(n), jnp.asarray(0))
+            tb = self._pool.tables[0]
+            phys = [int(tb[i // bs]) * bs + i % bs for i in range(n)]
+            rows = {}
+            for name, arr in self.cache.items():
+                if name == "tables":
+                    continue
+                flat = np.asarray(arr).reshape(
+                    (arr.shape[0], arr.shape[1] * arr.shape[2])
+                    + arr.shape[3:])
+                rows[name] = flat[:, phys][:, None]
+            self._pool.free_slot(0)
+        else:
+            bucket = serving._pow2_bucket(n, window)
+            fn = serving._get_prefill_fn(self.cfg, bucket, self._skey)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :n] = prompt
+            logits, self.cache = fn(
+                self.params, self.cache, jnp.asarray(padded),
+                jnp.asarray(n), jnp.asarray(0))
+            rows = {name: np.asarray(arr[:, 0:1, :n])
+                    for name, arr in self.cache.items()}
+        logits = np.asarray(logits, np.float32)
+        if self._tel:
+            _telemetry.count("fleet.prefill_jobs")
+            _telemetry.observe("fleet.prefill_ms",
+                               (time.perf_counter() - t0) * 1e3)
+        return rows, logits
+
+    def run_once(self, timeout: float = 0.0) -> bool:
+        """Consume at most one job from the endpoint (cooperative
+        drive); returns whether a message was handled."""
+        msg = self.endpoint.recv(timeout)
+        if msg is None:
+            return False
+        if isinstance(msg, dict) and msg.get("op") == "stop":
+            self._stop.set()
+            return True
+        try:
+            rows, logits = self.prefill(msg["prompt"])
+            self.endpoint.send({"rid": msg["rid"], "rows": rows,
+                                "logits": logits})
+        except Exception as e:  # noqa: BLE001 - reported to the router
+            self.endpoint.send({"rid": msg.get("rid"),
+                                "error": f"{type(e).__name__}: {e}"})
+        return True
+
+    def start(self) -> None:
+        """Serve jobs on a daemon thread until :meth:`close` (or a
+        ``{"op": "stop"}`` frame)."""
+        if self.endpoint is None:
+            raise ValueError("PrefillWorker.start() needs an endpoint")
+        if self._thread is not None:
+            return
+
+        def run():
+            while not self._stop.is_set():
+                try:
+                    self.run_once(timeout=0.02)
+                except ConnectionError:
+                    break              # dead link: done serving it
+
+        self._thread = threading.Thread(
+            target=run, daemon=True, name=f"paddle-tpu-{self.name}")
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if self.endpoint is not None:
+            self.endpoint.close()
+        if self._pool is not None:
+            self._pool.close()
+        self.cache = None
+
+
+def serve_prefill_worker(worker: PrefillWorker, host: str = "127.0.0.1",
+                         port: int = 0):
+    """Serve one :class:`PrefillWorker` over the socket transport (the
+    cross-process deployment shape): accepts ONE router connection and
+    runs the worker loop against it on a daemon thread.  Returns the
+    listener (``.port`` carries the bound port; ``worker.close()`` stops
+    the loop)."""
+    listener = SocketTransport.listen(host, port)
+
+    def run():
+        try:
+            ep = listener.accept(timeout=60.0)
+        except OSError:
+            return
+        worker.endpoint = ep
+        while not worker._stop.is_set():
+            try:
+                worker.run_once(timeout=0.02)
+            except ConnectionError:
+                break                  # router hung up: done serving it
+
+    threading.Thread(target=run, daemon=True,
+                     name=f"paddle-tpu-{worker.name}-serve").start()
+    return listener
+
+
+# ---------------------------------------------------------------------------
+# router: admission, load balancing, health aggregation
+# ---------------------------------------------------------------------------
+
+
+class Router:
+    """Fleet front-end over N ``DecodeServer`` replicas (+ optional
+    prefill workers).
+
+        router = fleet.Router([srv_a, srv_b], prefill=[worker])
+        rid = router.submit(prompt, max_new_tokens=64)
+        while router.pending():
+            router.tick()
+        tokens = router.result(rid)
+
+    Requests enter a fleet-level queue (priority-ordered, TTL-shed) and
+    dispatch to the least-loaded HEALTHY replica — scored on the same
+    quantities the telemetry gauges sample: queue depth, then slot
+    occupancy, then KV utilization (``DecodeServer.load_stats``).
+    Prompts at or past ``prefill_threshold`` hand off to a prefill
+    worker first; the returned rows inject via ``submit_prefilled``, so
+    the decode loop never runs a long prompt's prefill.  A replica whose
+    wedge watchdog trips is DRAINED — its queued work re-routes to
+    survivors (``fleet.reroutes``) while its active slots keep decoding
+    through the round-7 recovery — and :meth:`healthz` aggregates
+    per-replica state (the process ``/healthz`` endpoint 503s on the
+    same verdict).  ``prefill`` accepts worker-side objects
+    (:class:`PrefillWorker`, auto-wired over a loopback and started) or
+    ready client endpoints (e.g. ``SocketTransport.connect(...)``).
+
+    ``close()`` shuts down the whole fleet it fronts: replicas, owned
+    workers, remote workers (a stop frame), and the metrics server."""
+
+    def __init__(self, replicas, prefill=(),
+                 prefill_threshold: int | None = None,
+                 tick_block: int | None = None,
+                 max_queue: int | None = None,
+                 metrics_port: int | None = None):
+        self.replicas = list(replicas)
+        if not self.replicas:
+            raise ValueError("Router needs at least one decode replica")
+        self._prefill_eps = []
+        self._ep_windows = []      # per endpoint: worker window, or
+        self._owned_workers = []   # None when unknown (raw endpoint)
+        for p in prefill:
+            if hasattr(p, "prefill"):          # a PrefillWorker object
+                lt = LoopbackTransport()
+                p.endpoint = lt.worker
+                p.start()
+                self._owned_workers.append(p)
+                self._prefill_eps.append(lt.client)
+                self._ep_windows.append(min(p.max_len,
+                                            p.cfg.max_seq_len))
+            else:                              # a ready client endpoint
+                self._prefill_eps.append(p)
+                self._ep_windows.append(None)
+        self._threshold = (_flags.fleet_prefill_threshold()
+                           if prefill_threshold is None
+                           else int(prefill_threshold))
+        self._block = (_flags.fleet_tick_block() if tick_block is None
+                       else max(1, int(tick_block)))
+        self._max_queue = (_flags.fleet_max_queue() if max_queue is None
+                           else max(0, int(max_queue)))
+        self._window = min(min(r.max_len, r.cfg.max_seq_len)
+                           for r in self.replicas)
+        self._default_ttl = _flags.request_ttl_s()
+        self._resil = _resilience.enabled()
+        self._tel = _telemetry.enabled()
+        self.metrics_server = (_telemetry.serve_metrics(metrics_port)
+                               if metrics_port is not None else None)
+        self._queue: list[int] = []            # fleet rids awaiting dispatch
+        self._requests: dict[int, dict] = {}   # fleet rid -> record
+        self._local: dict = {}                 # (replica, local rid) -> rid
+        self._ok = [True] * len(self.replicas)
+        self._next_rid = 0
+        self._pf_next = 0
+        self._prefilling: set[int] = set()     # rids out at a worker
+        self._dead_eps: set[int] = set()       # endpoint indices gone
+
+    # -- submission ---------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int = 32,
+               stop: list | None = None, temperature: float = 0.0,
+               top_k: int = 0, top_p: float = 1.0,
+               ttl_s: float | None = None, priority: int = 0) -> int:
+        """Fleet-level submit: same per-request surface as
+        ``DecodeServer.submit`` (sampling params, TTL, priority), one
+        rid namespace across every replica."""
+        prompt, stop, ttl, top_k = serving.validate_request(
+            prompt, max_new_tokens, stop, temperature, top_k, top_p,
+            ttl_s, window=self._window,
+            vocab_size=self.replicas[0].cfg.vocab_size,
+            default_ttl=self._default_ttl)
+        now = time.perf_counter()
+        rid = self._next_rid
+        self._next_rid += 1
+        req = {"prompt": prompt, "max_new": int(max_new_tokens),
+               "stop": stop, "temperature": float(temperature),
+               "top_k": top_k, "top_p": float(top_p),
+               "ttl": ttl, "priority": int(priority),
+               "t_submit": now, "t_enqueue": now}
+        rec = {"state": "queued", "req": req}
+        self._requests[rid] = rec
+        if self._tel:
+            _telemetry.count("fleet.requests")
+        if self._prefill_eps and len(prompt) >= self._threshold:
+            self._handoff_prefill(rid, rec)
+        else:
+            self._queue.append(rid)
+            self._route()
+        self._gauges()
+        return rid
+
+    def _live_eps(self):
+        return [i for i in range(len(self._prefill_eps))
+                if i not in self._dead_eps]
+
+    def _handoff_prefill(self, rid: int, rec: dict) -> None:
+        """Hand one admission prefill to a worker (round-robin over the
+        LIVE endpoints whose known window fits the prompt): the decode
+        loop never runs this prompt's prefill, which is the
+        disaggregation's whole point.  With no suitable worker — all
+        dead, or every known window smaller than the prompt — the
+        request falls back to the fleet queue and the owning replica
+        prefills locally: slower, never stuck, never a spurious
+        error."""
+        n = len(rec["req"]["prompt"])
+
+        def usable():
+            return [i for i in self._live_eps()
+                    if self._ep_windows[i] is None
+                    or self._ep_windows[i] >= n]
+
+        live = usable()
+        while live:
+            i = live[self._pf_next % len(live)]
+            self._pf_next += 1
+            try:
+                self._prefill_eps[i].send(
+                    {"rid": rid, "prompt": rec["req"]["prompt"]})
+            except (ConnectionError, OSError):
+                self._fail_prefill_ep(i)
+                live = usable()
+                continue
+            rec["state"] = "prefilling"
+            rec["ep"] = i
+            self._prefilling.add(rid)
+            if self._tel:
+                _telemetry.count("fleet.prefill_handoffs")
+            return
+        self._queue.append(rid)        # no workers left: prefill locally
+
+    def _fail_prefill_ep(self, i: int) -> None:
+        """One endpoint's transport died: every prefill out at it fails
+        (the requester sees the ``error`` status, never a hang) and the
+        endpoint leaves the rotation."""
+        self._dead_eps.add(i)
+        for rid in sorted(self._prefilling):
+            rec = self._requests[rid]
+            if rec.get("ep") != i:
+                continue
+            self._prefilling.discard(rid)
+            rec["state"] = "error"
+            rec["error"] = "prefill worker transport died mid-job"
+            if self._tel:
+                _telemetry.count("fleet.prefill_errors")
+
+    def _poll_prefill(self) -> None:
+        for i in self._live_eps():
+            ep = self._prefill_eps[i]
+            while True:
+                try:
+                    msg = ep.recv(0.0)
+                except (ConnectionError, OSError):
+                    self._fail_prefill_ep(i)
+                    break
+                if msg is None:
+                    break
+                rid = msg.get("rid")
+                self._prefilling.discard(rid)
+                rec = self._requests.get(rid)
+                if rec is None or rec["state"] != "prefilling":
+                    continue
+                if "error" in msg:
+                    rec["state"] = "error"
+                    rec["error"] = msg["error"]
+                    if self._tel:
+                        _telemetry.count("fleet.prefill_errors")
+                    continue
+                rec["req"]["prefilled"] = (msg["rows"], msg["logits"])
+                rec["state"] = "queued"
+                self._queue.append(rid)
+
+    # -- scheduling ---------------------------------------------------------
+
+    def _expired(self, rec: dict, now: float) -> bool:
+        req = rec["req"]
+        ttl = req.get("ttl")
+        return (ttl is not None
+                and now - req.get("t_enqueue", req["t_submit"]) > ttl)
+
+    def _shed_expired(self) -> None:
+        """Fleet-queue TTL shedding (the replica rule, one level up):
+        a request still waiting here — fleet-queued OR out at a prefill
+        worker — past its TTL retires with the ``timeout`` status
+        instead of ever reaching a replica.  A shed prefilling request's
+        late reply is ignored by ``_poll_prefill`` (state check)."""
+        if not self._resil or not (self._queue or self._prefilling):
+            return
+        now = time.perf_counter()
+        kept = []
+        for rid in self._queue:
+            rec = self._requests[rid]
+            if self._expired(rec, now):
+                rec["state"] = "timeout"
+                if self._tel:
+                    _telemetry.count("fleet.ttl_sheds")
+            else:
+                kept.append(rid)
+        self._queue[:] = kept
+        for rid in sorted(self._prefilling):
+            rec = self._requests[rid]
+            if self._expired(rec, now):
+                self._prefilling.discard(rid)
+                rec["state"] = "timeout"
+                if self._tel:
+                    _telemetry.count("fleet.ttl_sheds")
+
+    def _pick_replica(self, exclude=()):
+        """Least-loaded healthy replica with admission capacity (free
+        slots, or queue headroom under ``max_queue``) — ordered by
+        queue depth, then slot occupancy, then KV utilization: the
+        telemetry-gauge triple as a routing key."""
+        best, best_score = None, None
+        for i, r in enumerate(self.replicas):
+            if not self._ok[i] or i in exclude:
+                continue
+            ls = r.load_stats()
+            cap = ls["free_slots"] + max(
+                0, self._max_queue - ls["queue_depth"])
+            if cap <= 0:
+                continue
+            score = (ls["queue_depth"], ls["slot_occupancy"],
+                     ls["kv_utilization"], i)
+            if best_score is None or score < best_score:
+                best, best_score = i, score
+        return best
+
+    def _route(self) -> None:
+        """Dispatch queued work: priority first (ties: submit order),
+        each request to the least-loaded healthy replica; requests no
+        replica can take stay fleet-queued (re-routable)."""
+        if not self._queue:
+            return
+        self._queue.sort(key=lambda rid: (
+            -self._requests[rid]["req"]["priority"],
+            self._requests[rid]["req"]["t_submit"]))
+        held = []
+        for rid in self._queue:
+            rec = self._requests[rid]
+            rejected = {}
+            while True:
+                i = self._pick_replica(exclude=rejected)
+                if i is None:
+                    healthy = {j for j in range(len(self.replicas))
+                               if self._ok[j]}
+                    if healthy and healthy <= set(rejected):
+                        # every healthy replica rejected it OUTRIGHT
+                        # (window/pool too small — permanent, not a
+                        # capacity wait): error beats an eternal queue
+                        rec["state"] = "error"
+                        rec["error"] = "; ".join(
+                            sorted(set(rejected.values())))
+                        if self._tel:
+                            _telemetry.count("fleet.route_errors")
+                    else:
+                        held.append(rid)
+                    break
+                try:
+                    local = self.replicas[i].adopt_request(rec["req"])
+                except ValueError as e:
+                    rejected[i] = str(e)
+                    continue
+                rec["state"] = "dispatched"
+                rec["replica"] = i
+                rec["local_rid"] = local
+                self._local[(i, local)] = rid
+                if self._tel:
+                    _telemetry.count("fleet.routed")
+                break
+        self._queue[:] = held
+
+    def _check_health(self) -> None:
+        for i, r in enumerate(self.replicas):
+            ok = not r.wedged
+            if self._ok[i] and not ok:
+                self._ok[i] = False
+                self._drain_replica(i)
+            elif ok and not self._ok[i]:
+                self._ok[i] = True
+                if self._tel:
+                    _telemetry.count("fleet.replica_recoveries")
+
+    def _drain_replica(self, i: int) -> None:
+        """A replica's wedge watchdog tripped: pull its QUEUED work back
+        into the fleet queue (front — it has waited already) so healthy
+        replicas pick it up; its active slots stay, the round-7 recovery
+        replays their steps bit-exactly."""
+        if self._tel:
+            _telemetry.count("fleet.drains")
+        # drain ONLY the rids this router owns: a request submitted
+        # directly to the replica stays on its queue (only the direct
+        # submitter holds its local rid — moving it would strand them)
+        mine = {lr for (ri, lr) in self._local if ri == i}
+        reqs = self.replicas[i].drain_queue(mine)
+        front = []
+        for req in reqs:
+            rid = self._local.pop((i, req["rid"]), None)
+            if rid is None:
+                continue        # unreachable given the rid filter
+            rec = self._requests[rid]
+            r = dict(req)
+            r.pop("rid", None)  # the local rid died with the drain
+            rec["req"] = r
+            rec["state"] = "queued"
+            rec.pop("replica", None)
+            rec.pop("local_rid", None)
+            front.append(rid)
+        if front:
+            self._queue[:0] = front
+            if self._tel:
+                _telemetry.count("fleet.reroutes", len(front))
+
+    def tick(self) -> None:
+        """One fleet scheduling round: fold in finished prefills, health
+        check (drain + re-route on a wedge flip), TTL shed, dispatch,
+        then tick every replica with pending work — wedged ones
+        included, since their recovery needs ticks."""
+        self._poll_prefill()
+        self._check_health()
+        self._shed_expired()
+        self._route()
+        for r in self.replicas:
+            if r.pending():
+                if self._block > 1:
+                    r.tick_block(self._block)
+                else:
+                    r.tick()
+        self._check_health()
+        self._gauges()
+
+    def pending(self) -> bool:
+        return (bool(self._queue) or bool(self._prefilling)
+                or any(r.pending() for r in self.replicas))
+
+    # -- results ------------------------------------------------------------
+
+    def status(self, rid: int) -> str:
+        """``queued`` | ``prefilling`` | ``timeout`` | ``error`` at the
+        fleet level; once dispatched, the owning replica's status."""
+        rec = self._requests[rid]
+        if rec["state"] == "dispatched":
+            return self.replicas[rec["replica"]].status(rec["local_rid"])
+        return rec["state"]
+
+    def result(self, rid: int):
+        rec = self._requests[rid]
+        state = rec["state"]
+        if state == "timeout":
+            raise _resilience.DeadlineExceeded(
+                f"request {rid} was shed at the router: still queued "
+                f"past its ttl")
+        if state == "error":
+            raise RuntimeError(
+                f"request {rid} failed: {rec.get('error')}")
+        if state != "dispatched":
+            raise KeyError(f"request {rid} is still {state}")
+        return self.replicas[rec["replica"]].result(rec["local_rid"])
+
+    # -- health + telemetry -------------------------------------------------
+
+    def healthz(self) -> dict:
+        """Aggregated fleet health: ``ok`` iff every replica's wedge
+        watchdog is clear, plus each replica's live load stats — the
+        fleet twin of the process ``GET /healthz`` (which 503s on the
+        same wedge verdict via the shared telemetry state)."""
+        reps = []
+        for i, r in enumerate(self.replicas):
+            ls = r.load_stats()
+            reps.append(dict(ls, ok=not ls["wedged"]))
+        return {
+            "ok": all(rp["ok"] for rp in reps),
+            "replicas": reps,
+            "queue_depth": len(self._queue),
+            "prefill_workers": len(self._prefill_eps),
+            "prefill_outstanding": len(self._prefilling),
+        }
+
+    def _gauges(self) -> None:
+        if not self._tel:
+            return
+        _telemetry.set_gauge("fleet.replicas", len(self.replicas))
+        _telemetry.set_gauge("fleet.healthy_replicas", sum(self._ok))
+        _telemetry.set_gauge("fleet.queue_depth", len(self._queue))
+        _telemetry.set_gauge("fleet.prefill_outstanding",
+                             len(self._prefilling))
+
+    def close(self) -> None:
+        """Shut the fleet down: stop frames to remote workers, owned
+        workers closed, every replica closed (unfinished work is
+        abandoned per ``DecodeServer.close``), metrics server joined."""
+        for ep in self._prefill_eps:
+            with contextlib.suppress(Exception):
+                ep.send({"op": "stop"})
+            with contextlib.suppress(Exception):
+                ep.close()
+        for w in self._owned_workers:
+            with contextlib.suppress(Exception):
+                w.close()
+        for r in self.replicas:
+            with contextlib.suppress(Exception):
+                r.close()
+        if self.metrics_server is not None:
+            self.metrics_server.close()
+            self.metrics_server = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -594,7 +594,29 @@ def _decode_param_specs(params, cfg: gpt.GPTConfig, mp: str):
     return out
 
 
-def build_sharded_decode(params, cfg: gpt.GPTConfig, mesh, mp: str = "mp"):
+def sharded_cache_specs(cfg: gpt.GPTConfig, cache: dict, mesh,
+                        mp: str = "mp") -> dict:
+    """PartitionSpec per cache leaf for tensor-parallel decode — ONE
+    rule for both layouts: the Hkv axis (axis 3 of the contiguous slab
+    ``[L, B, T, Hkv(, hd)]`` AND of the paged pool
+    ``[L, N, bs, Hkv(, hd)]``, scale planes included) shards over ``mp``
+    when divisible, everything else replicates; the paged ``tables``
+    leaf (host-scheduler state, int32 indices) always replicates."""
+    from jax.sharding import PartitionSpec as P
+
+    mp_size = mesh.shape[mp]
+
+    def _spec(name, arr):
+        if name == "tables" or cfg.kv_heads % mp_size:
+            return P()
+        return P(*([None] * 3 + [mp] + [None] * (arr.ndim - 4)))
+
+    return {name: _spec(name, arr) for name, arr in cache.items()}
+
+
+def build_sharded_decode(params, cfg: gpt.GPTConfig, mesh, mp: str = "mp",
+                         layout: str | None = None,
+                         block_size: int | None = None):
     """Megatron-sharded single-token decode over ``mesh`` (the serving
     analog of gpt_hybrid's TP training: reference mp_layers.py shards
     projections by hand + NCCL; here the SAME decode_step is pjit'd under
@@ -602,10 +624,16 @@ def build_sharded_decode(params, cfg: gpt.GPTConfig, mesh, mp: str = "mp"):
 
     The KV cache shards over the head axis when the mesh divides it —
     with GQA this composes: Hkv heads spread across mp ranks, so a 13B
-    model's cache splits like its weights.  Returns
-    ``(sharded_params, make_cache, decode_fn)``:
+    model's cache splits like its weights.  ``layout`` (default: the
+    ``PADDLE_TPU_KV_LAYOUT`` flag) picks the cache format: the pooled
+    layout (round 9) shards the pool's Hkv axis exactly the way the slab
+    shards its head axis (``sharded_cache_specs`` — one rule for both),
+    tables replicate, and the step routes through
+    ``kv_pool.paged_decode_step_batched`` with the scalar ``pos``
+    broadcast per slot.  Returns ``(sharded_params, make_cache,
+    decode_fn)``:
         sharded_params     params placed per the Megatron specs
-        make_cache(B, T)   sharded cache
+        make_cache(B, T, num_blocks=None)   sharded cache
         decode_fn(p, cache, token [B] int32, pos scalar) -> (logits, cache)
     Weight-only int8/int4 params (woq.quantize_gpt_*) shard identically —
     scales replicate.
@@ -615,30 +643,41 @@ def build_sharded_decode(params, cfg: gpt.GPTConfig, mesh, mp: str = "mp"):
 
     if cfg.moe is not None:
         raise NotImplementedError("sharded decode supports dense models")
-    mp_size = mesh.shape[mp]
+    lay = _flags.kv_layout() if layout is None else layout
+    if lay not in ("contiguous", "paged"):
+        raise ValueError(f"layout {lay!r}: expected 'contiguous' or "
+                         f"'paged'")
     pspecs = _decode_param_specs(params, cfg, mp)
     ns = lambda spec: NamedSharding(mesh, spec)  # noqa: E731
     sharded_params = jax.tree_util.tree_map(
         lambda v, s: jax.device_put(v, ns(s)), params, pspecs,
         is_leaf=lambda v: not isinstance(v, dict))
 
-    # cache leaves [L, B, T, Hkv(, hd)] (values + int8 scale planes):
-    # shard the head axis (3) over mp when divisible; otherwise replicate
-    # (correct, just not memory-split)
-    def _cache_spec(arr):
-        if cfg.kv_heads % mp_size:
-            return P()
-        return P(*([None] * 3 + [mp] + [None] * (arr.ndim - 4)))
+    bs = None
+    if lay == "paged":
+        from . import kv_pool as _kvp
 
-    template = init_cache(cfg, 1, 1)
-    cache_specs = {name: _cache_spec(arr) for name, arr in template.items()}
+        bs = _flags.kv_block_size() if block_size is None \
+            else int(block_size)
+        template = _kvp.init_paged_cache(cfg, 1, 1, block_size=bs)
+    else:
+        template = init_cache(cfg, 1, 1)
+    cache_specs = sharded_cache_specs(cfg, template, mesh, mp)
     cache_shardings = {name: ns(s) for name, s in cache_specs.items()}
     repl = P()
 
     def _step(p, cache, token, pos):
+        if lay == "paged":
+            from . import kv_pool as _kvp
+
+            pos_b = jnp.broadcast_to(jnp.asarray(pos, jnp.int32),
+                                     token.shape)
+            return _kvp.paged_decode_step_batched(p, cache, token, pos_b,
+                                                  cfg)
         return decode_step(p, cache, token, pos, cfg)
 
-    decode_fn = _watch_jit("generate.sharded_decode", _cfg_key(cfg), jax.jit(
+    decode_fn = _watch_jit("generate.sharded_decode",
+                           (_cfg_key(cfg), lay, bs), jax.jit(
         _step,
         in_shardings=(jax.tree_util.tree_map(
             ns, pspecs, is_leaf=lambda s: isinstance(s, P)),
@@ -649,16 +688,41 @@ def build_sharded_decode(params, cfg: gpt.GPTConfig, mesh, mp: str = "mp"):
         # in and out shardings match, so aliasing is exact per shard
         donate_argnums=_donate_cache()))
 
-    def make_cache(batch: int, max_len: int):
-        fresh = init_cache(cfg, batch, max_len)
+    def make_cache(batch: int, max_len: int,
+                   num_blocks: int | None = None):
+        # the builder pins the FLAG-derived layout/block at build time
+        # (the explicit-argument form is the caller's own contract): a
+        # flag flip after build would otherwise be silently ignored
+        # here while every OTHER init_cache site in the process honors
+        # it — fail loudly instead of serving two layouts at once
+        if layout is None and _flags.kv_layout() != lay:
+            raise ValueError(
+                f"PADDLE_TPU_KV_LAYOUT changed since "
+                f"build_sharded_decode (built {lay!r}, flag now "
+                f"{_flags.kv_layout()!r}); rebuild the sharded decoder")
+        if lay == "paged" and block_size is None \
+                and _flags.kv_block_size() != bs:
+            raise ValueError(
+                f"PADDLE_TPU_KV_BLOCK changed since "
+                f"build_sharded_decode (built {bs}, flag now "
+                f"{_flags.kv_block_size()}); rebuild the sharded "
+                f"decoder")
+        fresh = init_cache(cfg, batch, max_len, layout=lay,
+                           block_size=bs, num_blocks=num_blocks)
         if set(fresh) != set(cache_shardings):
-            # init_cache re-reads PADDLE_TPU_KV_DTYPE at call time, but
-            # decode_fn baked the build-time structure into its
+            # init_cache re-reads PADDLE_TPU_KV_DTYPE at call time
+            # (layout/block flips were caught above), but decode_fn
+            # baked the build-time structure into its
             # in_shardings/donation — a flag flip in between must fail
             # loudly here, not as a pytree mismatch inside the jit
             raise ValueError(
                 "PADDLE_TPU_KV_DTYPE changed since build_sharded_decode "
                 f"(built {sorted(cache_shardings)}, now {sorted(fresh)}); "
+                "rebuild the sharded decoder")
+        if lay == "paged" and fresh["k"].shape[2] != bs:
+            raise ValueError(
+                f"PADDLE_TPU_KV_BLOCK changed since build_sharded_decode "
+                f"(built block_size={bs}, now {fresh['k'].shape[2]}); "
                 "rebuild the sharded decoder")
         return {name: jax.device_put(arr, cache_shardings[name])
                 for name, arr in fresh.items()}

@@ -46,6 +46,7 @@ from .. import telemetry as _telemetry
 __all__ = [
     "PoolExhausted", "PagedAllocator", "round_len", "init_paged_cache",
     "paged_decode_step_batched", "paged_prefill_chunk", "copy_blocks",
+    "inject_rows",
 ]
 
 # the value/scale leaves of a pooled cache (everything except "tables")
@@ -310,6 +311,28 @@ def paged_prefill_chunk(params, cache, tokens, pos0, length, slot,
     return logits.astype(jnp.float32), cache
 
 
+def inject_rows(cache: dict, rows: dict, start, length, slot) -> dict:
+    """Write externally computed cache rows (a prefill worker's output —
+    leaves ``[L, 1, C, Hkv(, hd)]``, valid through ``length``) into one
+    slot's rows [start, length) through its block table — the paged
+    half of the fleet's prefill/decode handoff
+    (``generate._merge_slot_rows`` is the contiguous twin).  ``start``
+    skips rows an adopted prefix already holds (shared blocks must
+    never be rewritten); pad rows beyond ``length`` and unmapped table
+    entries drop (the standard out-of-bounds sink); the caller has
+    already allocated/COW'd the write range (``ensure_rows``)."""
+    N, bs, nmax = _geometry(cache)
+    trow = cache["tables"][slot]                          # [nmax]
+    C = rows["k"].shape[2]
+    logi = jnp.arange(C)
+    tb = trow[jnp.clip(logi // bs, 0, nmax - 1)]
+    phys = jnp.where((logi >= start) & (logi < length) & (tb >= 0)
+                     & (logi // bs < nmax),
+                     tb * bs + logi % bs, N * bs)
+    return _scatter_rows(cache, {n: v[:, 0] for n, v in rows.items()},
+                         phys)
+
+
 def copy_blocks(cache: dict, src, dst) -> dict:
     """Copy physical blocks ``src`` -> ``dst`` (int32 [P]) across every
     pool leaf — the device half of copy-on-write.  Destinations are
@@ -330,21 +353,35 @@ def copy_blocks(cache: dict, src, dst) -> dict:
 
 
 class _PrefixEntry:
-    __slots__ = ("block", "last_hit")
+    """One indexed prompt block: the physical pool block, its LRU clock,
+    and its position in the interned chain (``key`` = the intern-table
+    key, ``parent`` = the previous block's chain id, 0 at the root) —
+    enough to drop the entry and its intern record together."""
 
-    def __init__(self, block: int, tick: int):
+    __slots__ = ("block", "last_hit", "key", "parent")
+
+    def __init__(self, block: int, tick: int, key, parent: int):
         self.block = block
         self.last_hit = tick
+        self.key = key
+        self.parent = parent
 
 
 class PagedAllocator:
     """Host-side block accounting for one pooled cache: the free list,
     per-block refcounts, the per-slot table mirror (pushed to the device
-    leaf when dirty), pending COW copies, and the prefix-hash index.
+    leaf when dirty), pending COW copies, and the prefix index.
 
-    Prefix keys are EXACT token chains (the tuple of all prompt tokens
-    through a block's end) — no hash collisions can ever alias two
-    different prefixes onto one block's rows.  The index holds its own
+    Prefix identity is an INTERNED parent-id chain (round 9, the ROADMAP
+    open item): block ``li``'s chain id is interned under
+    ``(parent_chain_id, tuple(block li's tokens))``, so looking up or
+    registering a whole prompt touches each token exactly once — O(n)
+    host memory and hashing per distinct prompt, where the old exact
+    full-prefix keys (``tuple(prompt[:(li+1)*bs])``) materialized
+    O(n²/bs).  The no-collision guarantee is unchanged: interning is an
+    exact dict on (parent id, block tokens), and by induction a chain id
+    corresponds to exactly one token chain — two different prefixes can
+    never alias onto one block's rows.  The index holds its own
     reference on every registered block, so a retired request's prefix
     blocks survive for the next request until :meth:`evict_cold` (the
     OOM chain's first rung) or :meth:`close` releases them."""
@@ -362,7 +399,10 @@ class PagedAllocator:
         # deterministic layouts in tests
         self._free = list(range(self.N - 1, -1, -1))
         self._ref = np.zeros(self.N, np.int64)
-        self._prefix: dict = {}              # key -> _PrefixEntry
+        self._prefix: dict = {}              # chain id -> _PrefixEntry
+        self._interned: dict = {}            # (parent id, tokens) -> chain id
+        self._children: dict = {}            # chain id -> interned child count
+        self._next_chain = 1                 # 0 is the root sentinel
         self._pending_copies: list = []      # [(src, dst)] for copy_blocks
         self._tick = 0                       # LRU clock for the index
         self.dirty = True                    # tables need a device push
@@ -461,27 +501,36 @@ class PagedAllocator:
 
     # -- prefix index -------------------------------------------------------
 
-    def _key(self, prompt, li: int):
-        return tuple(prompt[:(li + 1) * self.bs])
+    def _chain_key(self, parent: int, prompt, li: int):
+        """Intern key of prompt block ``li`` under its parent chain:
+        O(block_size) tokens, never the whole prefix."""
+        return (parent, tuple(prompt[li * self.bs:(li + 1) * self.bs]))
 
     def adopt_prefix(self, slot: int, prompt) -> int:
         """Map the longest indexed block-chain prefix of ``prompt`` into
         ``slot``'s table (incref per adopted block) and return the
         shared row count, capped at ``len(prompt) - 1`` so admission
         always computes at least the last token's logits (a fully
-        shared prompt COWs its final block on that one-row write)."""
+        shared prompt COWs its final block on that one-row write).
+
+        The walk follows the interned chain (parent id + this block's
+        tokens per step) and stops at the first block the index does not
+        hold — O(n) total work over the prompt."""
         n = len(prompt)
         self._tick += 1
         matched = 0
+        parent = 0
         for li in range(n // self.bs):
-            ent = self._prefix.get(self._key(prompt, li))
-            if ent is None:
+            cid = self._interned.get(self._chain_key(parent, prompt, li))
+            if cid is None:
                 break
+            ent = self._prefix[cid]
             b = ent.block
             self._ref[b] += 1
             self.tables[slot, li] = b
             ent.last_hit = self._tick
             matched += 1
+            parent = cid
         if matched:
             self.dirty = True
             self.prefix_hits += matched
@@ -497,36 +546,64 @@ class PagedAllocator:
         index takes its own reference per newly registered block).  The
         owner never rewrites a full prompt block — decode writes start
         at ``len(prompt)`` — so registered blocks are immutable until
-        released."""
+        released.  Each block interns one (parent id, block tokens)
+        record — registration is O(n) over the prompt."""
         self._tick += 1
+        parent = 0
         for li in range(len(prompt) // self.bs):
-            key = self._key(prompt, li)
             b = int(self.tables[slot, li])
             if b < 0:
                 break
-            if key not in self._prefix:
-                self._prefix[key] = _PrefixEntry(b, self._tick)
+            key = self._chain_key(parent, prompt, li)
+            cid = self._interned.get(key)
+            if cid is None:
+                cid = self._next_chain
+                self._next_chain += 1
+                self._interned[key] = cid
+                self._prefix[cid] = _PrefixEntry(b, self._tick, key,
+                                                 parent)
+                if parent:
+                    self._children[parent] = \
+                        self._children.get(parent, 0) + 1
                 self._ref[b] += 1
+            parent = cid
 
     @property
     def prefix_entries(self) -> int:
         return len(self._prefix)
+
+    def _drop_entry(self, cid: int) -> None:
+        """Remove one index entry plus its intern record (and its
+        parent's child count) — the single removal path eviction and
+        close share, keeping entry/intern/children consistent."""
+        ent = self._prefix.pop(cid)
+        self._interned.pop(ent.key, None)
+        if ent.parent and ent.parent in self._children:
+            self._children[ent.parent] -= 1
+            if not self._children[ent.parent]:
+                del self._children[ent.parent]
+        self._decref_free(ent.block)
 
     def evict_cold(self, max_entries: int | None = None) -> int:
         """Drop prefix-cache entries no live slot references (block ref
         == 1: the index alone), coldest (LRU) first — the OOM retry
         chain's FIRST rung, and admission's last resort before parking a
         request back in the queue.  Returns the number of blocks
-        actually freed."""
+        actually freed.
+
+        Only chain LEAVES (entries with no interned children) are
+        candidates: dropping an inner block would orphan its
+        descendants' chain ids.  A cold inner block's whole subtree is
+        cold too (a slot adopting a child block always adopted its
+        parents), so repeated engagements drain chains tail-first."""
         cold = sorted(
-            (ent.last_hit, key) for key, ent in self._prefix.items()
-            if self._ref[ent.block] == 1)
+            (ent.last_hit, cid) for cid, ent in self._prefix.items()
+            if self._ref[ent.block] == 1 and not self._children.get(cid))
         if max_entries is not None:
             cold = cold[:max_entries]
         freed = 0
-        for _, key in cold:
-            ent = self._prefix.pop(key)
-            self._decref_free(ent.block)
+        for _, cid in cold:
+            self._drop_entry(cid)
             freed += 1
         if freed:
             _telemetry.count("kv_pool.prefix_evictions", freed)
@@ -534,9 +611,9 @@ class PagedAllocator:
 
     def close(self) -> None:
         """Release the whole index and every table (server shutdown)."""
-        for key in list(self._prefix):
-            ent = self._prefix.pop(key)
-            self._decref_free(ent.block)
+        for cid in list(self._prefix):
+            if cid in self._prefix:
+                self._drop_entry(cid)
         for slot in range(self.max_batch):
             if (self.tables[slot] >= 0).any():
                 self.free_slot(slot)

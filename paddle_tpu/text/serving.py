@@ -34,7 +34,7 @@ from .. import flags as _flags
 from .. import resilience as _resilience
 from .. import telemetry as _telemetry
 
-__all__ = ["decode_step_batched", "DecodeServer"]
+__all__ = ["decode_step_batched", "DecodeServer", "validate_request"]
 
 
 def decode_step_batched(params, cache, token, pos, cfg: gpt.GPTConfig):
@@ -146,40 +146,111 @@ _STEP_CACHE = generate._LRU(
 _EVICT_BATCH = 4
 
 
-def _get_prefill_fn(cfg: gpt.GPTConfig, bucket: int):
+class _ShardCtx:
+    """Tensor-parallel serving context (round 9): one mesh + the
+    sharding trees every step getter threads into ``jax.jit`` so the
+    batched tick runs Megatron-sharded INSIDE the server.
+
+    Params take ``generate._decode_param_specs`` (the
+    ``build_sharded_decode`` rules — ``distributed/sharding_rules``-style
+    regex specs resolved per leaf); the cache takes
+    ``generate.sharded_cache_specs`` — the Hkv axis shards over ``mp``
+    for BOTH layouts (slab head axis / pool Hkv axis), the paged
+    ``tables`` leaf replicates.  Donation composes unchanged (in and out
+    cache shardings match, so aliasing is exact per shard); ``key``
+    folds into every step-cache key so a sharded server's compiles stay
+    visible to the recompile watch instead of colliding with the
+    single-chip executables."""
+
+    def __init__(self, mesh, cfg: gpt.GPTConfig, params, cache,
+                 mp: str = "mp"):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if mp not in mesh.shape:
+            raise ValueError(f"mesh has no {mp!r} axis (axes: "
+                             f"{tuple(mesh.shape)})")
+        self.mesh = mesh
+        self.mp = mp
+        ns = lambda s: NamedSharding(mesh, s)  # noqa: E731
+        pspecs = generate._decode_param_specs(params, cfg, mp)
+        self.params = jax.tree_util.tree_map(
+            ns, pspecs, is_leaf=lambda s: isinstance(s, P))
+        self.cache = {
+            name: ns(spec) for name, spec in
+            generate.sharded_cache_specs(cfg, cache, mesh, mp).items()}
+        self.repl = ns(P())
+        self.key = (mp, tuple(mesh.shape.items()),
+                    tuple(int(d.id) for d in mesh.devices.flat))
+
+
+def _shard_kw(shard: _ShardCtx | None, n_extra: int, outs: str,
+              with_params: bool = True) -> dict:
+    """jit kwargs for one step getter under a shard context (empty dict
+    single-chip — the getters stay byte-identical to the unsharded
+    build).  Inputs are (params, cache, ``n_extra`` replicated host
+    args); ``outs`` spells the output structure ('r' replicated leaf,
+    'c' the cache tree — a one-char string for cache-only returns)."""
+    if not isinstance(shard, _ShardCtx):
+        # None, or a device-pinned server's placement tuple: no explicit
+        # shardings, the key alone keeps executables per-placement
+        return {}
+    lead = ((shard.params, shard.cache) if with_params
+            else (shard.cache,))
+    out = tuple(shard.cache if o == "c" else shard.repl for o in outs)
+    return {"in_shardings": lead + (shard.repl,) * n_extra,
+            "out_shardings": out if len(outs) > 1 else out[0]}
+
+
+def _shard_key(shard):
+    """Step-cache key fragment for a server's placement: the mesh
+    fingerprint under TP, the device id tuple for a pinned single-chip
+    replica (two replicas pinned to different chips must NOT share one
+    watch-instrumented wrapper — the second chip's compile would be
+    invisible to the recompile watch and its wall charged to
+    steady-state telemetry), None for the default placement."""
+    if shard is None:
+        return None
+    return shard.key if isinstance(shard, _ShardCtx) else shard
+
+
+def _get_prefill_fn(cfg: gpt.GPTConfig, bucket: int, shard=None):
     """One wrapper per (cfg, prompt bucket): the jit would retrace per
     bucket shape anyway, and a per-bucket wrapper keeps the device
     feed's captured FLOPs joined to walls of the SAME bucket — one
     shared wrapper would divide bucket-8 FLOPs by bucket-512 walls."""
-    k = ("prefill", generate._cfg_key(cfg), int(bucket))
+    k = ("prefill", generate._cfg_key(cfg), int(bucket),
+         _shard_key(shard))
     fn = _STEP_CACHE.get(k)
     if fn is None:
         fn = generate._watch_jit(f"serving.prefill@{bucket}", k, jax.jit(
             lambda p, c, t, ln, sl, _cfg=cfg:
             generate.prefill_slot(p, c, t, ln, sl, _cfg),
-            donate_argnums=generate._donate_cache()))
+            donate_argnums=generate._donate_cache(),
+            **_shard_kw(shard, 3, "rc")))
         _STEP_CACHE[k] = fn
     return fn
 
 
-def _get_prefill_chunk_fn(cfg: gpt.GPTConfig):
-    k = ("prefill_chunk", generate._cfg_key(cfg))
+def _get_prefill_chunk_fn(cfg: gpt.GPTConfig, shard=None):
+    k = ("prefill_chunk", generate._cfg_key(cfg), _shard_key(shard))
     fn = _STEP_CACHE.get(k)
     if fn is None:
         fn = generate._watch_jit("serving.prefill_chunk", k, jax.jit(
             lambda p, c, t, p0, ln, sl, _cfg=cfg:
             generate.prefill_slot_chunk(p, c, t, p0, ln, sl, _cfg),
-            donate_argnums=generate._donate_cache()))
+            donate_argnums=generate._donate_cache(),
+            **_shard_kw(shard, 4, "rc")))
         _STEP_CACHE[k] = fn
     return fn
 
 
-def _get_paged_prefill_fn(cfg: gpt.GPTConfig, bucket: int):
+def _get_paged_prefill_fn(cfg: gpt.GPTConfig, bucket: int, shard=None):
     """Paged admission step: one ``kv_pool.paged_prefill_chunk``
     executable per (cfg, chunk width) — ONE program serves any prompt
     offset (the chunk attends rows [0, pos0) through the block table),
     so bucketed-suffix and fixed-chunk admission share this getter."""
-    k = ("paged_prefill", generate._cfg_key(cfg), int(bucket))
+    k = ("paged_prefill", generate._cfg_key(cfg), int(bucket),
+         _shard_key(shard))
     fn = _STEP_CACHE.get(k)
     if fn is None:
         from . import kv_pool
@@ -188,52 +259,92 @@ def _get_paged_prefill_fn(cfg: gpt.GPTConfig, bucket: int):
                                  jax.jit(
             lambda p, c, t, p0, ln, sl, _cfg=cfg:
             kv_pool.paged_prefill_chunk(p, c, t, p0, ln, sl, _cfg),
-            donate_argnums=generate._donate_cache()))
+            donate_argnums=generate._donate_cache(),
+            **_shard_kw(shard, 4, "rc")))
         _STEP_CACHE[k] = fn
     return fn
 
 
-def _get_copy_fn(cfg: gpt.GPTConfig, n_pairs: int):
+def _get_copy_fn(cfg: gpt.GPTConfig, n_pairs: int, shard=None):
     """Copy-on-write device half: gather/scatter ``n_pairs`` pool blocks
     in one donated call (``kv_pool.copy_blocks``)."""
-    k = ("kv_copy", generate._cfg_key(cfg), int(n_pairs))
+    k = ("kv_copy", generate._cfg_key(cfg), int(n_pairs),
+         _shard_key(shard))
     fn = _STEP_CACHE.get(k)
     if fn is None:
         from . import kv_pool
 
         fn = generate._watch_jit(f"serving.kv_copy@{n_pairs}", k, jax.jit(
             lambda c, s, d: kv_pool.copy_blocks(c, s, d),
-            donate_argnums=generate._donate_cache() and (0,)))
+            donate_argnums=generate._donate_cache() and (0,),
+            **_shard_kw(shard, 2, "c", with_params=False)))
         _STEP_CACHE[k] = fn
     return fn
 
 
-def _get_block_fn(cfg: gpt.GPTConfig, k: int, paged: bool = False):
-    key = ("block", generate._cfg_key(cfg), k, paged)
+def _get_inject_fn(cfg: gpt.GPTConfig, bucket: int, paged: bool,
+                   shard=None):
+    """Prefill-handoff injector (round 9, the fleet's decode half): one
+    donated executable per (cfg, rows bucket) writing an externally
+    prefilled row block — leaves [L, 1, bucket, Hkv(, hd)], valid
+    through ``length`` — into one slot's cache rows [start, length)
+    (``start`` skips rows an adopted prefix already holds).
+    Contiguous: the ``generate._merge_slot_rows`` masked write; paged:
+    ``kv_pool.inject_rows`` scatters through the slot's block table."""
+    k = ("inject", generate._cfg_key(cfg), int(bucket), paged,
+         _shard_key(shard))
+    fn = _STEP_CACHE.get(k)
+    if fn is None:
+        if paged:
+            from . import kv_pool
+
+            body = lambda c, r, st, ln, sl: kv_pool.inject_rows(  # noqa: E731
+                c, r, st, ln, sl)
+        else:
+            body = lambda c, r, st, ln, sl, _b=bucket: \
+                generate._merge_slot_rows(
+                    c, r, sl, jnp.asarray(0),
+                    ((jnp.arange(_b) >= st)
+                     & (jnp.arange(_b) < ln))[None, :])  # noqa: E731
+        fn = generate._watch_jit(f"serving.inject@{bucket}", k, jax.jit(
+            body, donate_argnums=generate._donate_cache() and (0,),
+            **_shard_kw(shard, 4, "c", with_params=False)))
+        _STEP_CACHE[k] = fn
+    return fn
+
+
+def _get_block_fn(cfg: gpt.GPTConfig, k: int, paged: bool = False,
+                  shard=None):
+    key = ("block", generate._cfg_key(cfg), k, paged, _shard_key(shard))
     fn = _STEP_CACHE.get(key)
     if fn is None:
         fn = generate._watch_jit(f"serving.block@{k}", key, jax.jit(
             lambda p, c, t, s, _cfg=cfg, _k=k:
             decode_block_batched(p, c, t, s, _k, _cfg),
-            donate_argnums=generate._donate_cache()))
+            donate_argnums=generate._donate_cache(),
+            **_shard_kw(shard, 2, "rcrr")))
         _STEP_CACHE[key] = fn
     return fn
 
 
-def _get_sample_step_fn(cfg: gpt.GPTConfig, paged: bool = False):
-    k = ("sample", generate._cfg_key(cfg), paged)
+def _get_sample_step_fn(cfg: gpt.GPTConfig, paged: bool = False,
+                        shard=None):
+    k = ("sample", generate._cfg_key(cfg), paged, _shard_key(shard))
     fn = _STEP_CACHE.get(k)
     if fn is None:
         fn = generate._watch_jit("serving.sample_step", k, jax.jit(
             lambda p, c, t, s, ky, te, tk, tp, _cfg=cfg:
             sample_step_batched(p, c, t, s, ky, te, tk, tp, _cfg),
-            donate_argnums=generate._donate_cache()))
+            donate_argnums=generate._donate_cache(),
+            **_shard_kw(shard, 6, "rc")))
         _STEP_CACHE[k] = fn
     return fn
 
 
-def _get_sample_block_fn(cfg: gpt.GPTConfig, k: int, paged: bool = False):
-    key = ("sample_block", generate._cfg_key(cfg), k, paged)
+def _get_sample_block_fn(cfg: gpt.GPTConfig, k: int, paged: bool = False,
+                         shard=None):
+    key = ("sample_block", generate._cfg_key(cfg), k, paged,
+           _shard_key(shard))
     fn = _STEP_CACHE.get(key)
     if fn is None:
         fn = generate._watch_jit(f"serving.sample_block@{k}", key,
@@ -241,12 +352,13 @@ def _get_sample_block_fn(cfg: gpt.GPTConfig, k: int, paged: bool = False):
             lambda p, c, t, s, ky, off, te, tk, tp, _cfg=cfg, _k=k:
             sample_block_batched(p, c, t, s, ky, off, te, tk, tp, _k,
                                  _cfg),
-            donate_argnums=generate._donate_cache()))
+            donate_argnums=generate._donate_cache(),
+            **_shard_kw(shard, 7, "rc")))
         _STEP_CACHE[key] = fn
     return fn
 
 
-def _get_step_fn(cfg: gpt.GPTConfig, paged: bool = False):
+def _get_step_fn(cfg: gpt.GPTConfig, paged: bool = False, shard=None):
     """One jitted batched step per config VALUE (generate._GEN_CACHE's
     rationale: keying by object identity would recompile per DecodeServer
     and leak executables).  Every step fn here DONATES its cache (arg 1,
@@ -255,18 +367,20 @@ def _get_step_fn(cfg: gpt.GPTConfig, paged: bool = False):
     key (not the math: decode_step_batched branches on the cache
     structure itself), so a paged server's compiles stay visible to the
     recompile watch instead of hiding behind a same-key retrace."""
-    k = ("step", generate._cfg_key(cfg), paged)
+    k = ("step", generate._cfg_key(cfg), paged, _shard_key(shard))
     fn = _STEP_CACHE.get(k)
     if fn is None:
         fn = generate._watch_jit("serving.step", k, jax.jit(
             lambda p, c, t, s, _cfg=cfg: decode_step_batched(
                 p, c, t, s, _cfg),
-            donate_argnums=generate._donate_cache()))
+            donate_argnums=generate._donate_cache(),
+            **_shard_kw(shard, 2, "rc")))
         _STEP_CACHE[k] = fn
     return fn
 
 
-def _get_async_step_fn(cfg: gpt.GPTConfig, paged: bool = False):
+def _get_async_step_fn(cfg: gpt.GPTConfig, paged: bool = False,
+                       shard=None):
     """The async-dispatch tick step: like _get_sample_step_fn but the
     feed token is selected ON DEVICE between the host-built token and
     the previous (still in flight, unfetched) step's output — ``pm``
@@ -274,22 +388,25 @@ def _get_async_step_fn(cfg: gpt.GPTConfig, paged: bool = False):
     tokens).  Greedy slots pass temp 0 and take the raw argmax, so one
     executable serves greedy and sampled async ticks bit-identically to
     the sync paths."""
-    k = ("async", generate._cfg_key(cfg), paged)
+    k = ("async", generate._cfg_key(cfg), paged, _shard_key(shard))
     fn = _STEP_CACHE.get(k)
     if fn is None:
         fn = generate._watch_jit("serving.async_step", k, jax.jit(
             lambda p, c, ht, pm, pv, s, ky, te, tk, tp, _cfg=cfg:
             sample_step_batched(p, c, jnp.where(pm, pv, ht), s,
                                 ky, te, tk, tp, _cfg),
-            donate_argnums=generate._donate_cache()))
+            donate_argnums=generate._donate_cache(),
+            **_shard_kw(shard, 8, "rc")))
         _STEP_CACHE[k] = fn
     return fn
 
 
-def _get_async_block_fn(cfg: gpt.GPTConfig, k: int, paged: bool = False):
+def _get_async_block_fn(cfg: gpt.GPTConfig, k: int, paged: bool = False,
+                        shard=None):
     """Async greedy block: decode_block_batched with the device-side
     feed select (see _get_async_step_fn)."""
-    key = ("async_block", generate._cfg_key(cfg), k, paged)
+    key = ("async_block", generate._cfg_key(cfg), k, paged,
+           _shard_key(shard))
     fn = _STEP_CACHE.get(key)
     if fn is None:
         fn = generate._watch_jit(f"serving.async_block@{k}", key,
@@ -297,15 +414,18 @@ def _get_async_block_fn(cfg: gpt.GPTConfig, k: int, paged: bool = False):
             lambda p, c, ht, pm, pv, s, _cfg=cfg, _k=k:
             decode_block_batched(p, c, jnp.where(pm, pv, ht), s, _k,
                                  _cfg),
-            donate_argnums=generate._donate_cache()))
+            donate_argnums=generate._donate_cache(),
+            **_shard_kw(shard, 4, "rcrr")))
         _STEP_CACHE[key] = fn
     return fn
 
 
-def _get_async_sample_block_fn(cfg: gpt.GPTConfig, k: int, paged: bool = False):
+def _get_async_sample_block_fn(cfg: gpt.GPTConfig, k: int,
+                               paged: bool = False, shard=None):
     """Async sampled block: sample_block_batched with the device-side
     feed select (see _get_async_step_fn)."""
-    key = ("async_sample_block", generate._cfg_key(cfg), k, paged)
+    key = ("async_sample_block", generate._cfg_key(cfg), k, paged,
+           _shard_key(shard))
     fn = _STEP_CACHE.get(key)
     if fn is None:
         fn = generate._watch_jit(f"serving.async_sample_block@{k}",
@@ -314,9 +434,52 @@ def _get_async_sample_block_fn(cfg: gpt.GPTConfig, k: int, paged: bool = False):
             _k=k:
             sample_block_batched(p, c, jnp.where(pm, pv, ht), s,
                                  ky, off, te, tk, tp, _k, _cfg),
-            donate_argnums=generate._donate_cache()))
+            donate_argnums=generate._donate_cache(),
+            **_shard_kw(shard, 9, "rc")))
         _STEP_CACHE[key] = fn
     return fn
+
+
+def _pow2_bucket(n: int, *bounds) -> int:
+    """Smallest power of two >= ``n``, clamped to the given upper
+    bounds — THE prompt-bucket rule.  The bucket is a jit-cache key, so
+    every admission surface (local prefill, the paged suffix walk,
+    prefill workers, row injection) must compute it HERE or executables
+    silently split between surfaces."""
+    b = 1
+    while b < n:
+        b *= 2
+    return min(b, *bounds) if bounds else b
+
+
+def validate_request(prompt, max_new_tokens, stop, temperature, top_k,
+                     top_p, ttl_s, *, window, vocab_size, default_ttl):
+    """THE request-validation rules, shared by ``DecodeServer`` and the
+    fleet ``Router`` (one level up, with the fleet-wide window) so the
+    two admission surfaces can never drift.  Returns the normalized
+    ``(prompt, stop, ttl, top_k)``."""
+    prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+    if not prompt:
+        raise ValueError("empty prompt")
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, "
+                         f"got {max_new_tokens}")
+    total = len(prompt) + max_new_tokens
+    if total > window:
+        raise ValueError(
+            f"prompt+max_new_tokens {total} exceeds serving window "
+            f"{window}")
+    stop = [[int(t) for t in seq] for seq in (stop or [])]
+    if any(not seq for seq in stop):
+        raise ValueError("empty stop sequence")
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    ttl = default_ttl if ttl_s is None else float(ttl_s)
+    if ttl is not None and ttl <= 0:
+        raise ValueError(f"ttl_s must be > 0, got {ttl}")
+    return prompt, stop, ttl, min(int(top_k), vocab_size)
 
 
 class DecodeServer:
@@ -341,7 +504,9 @@ class DecodeServer:
                  metrics_port: int | None = None,
                  layout: str | None = None,
                  block_size: int | None = None,
-                 num_blocks: int | None = None):
+                 num_blocks: int | None = None,
+                 mesh=None, mp_axis: str = "mp",
+                 device=None):
         self.params = params
         # telemetry (request tracing + latency histograms + gauges):
         # decided once at construction — per-tick records are lock-cheap
@@ -385,7 +550,38 @@ class DecodeServer:
         else:
             self._pool = None
             self.cache = generate.init_cache(cfg, max_batch, max_len)
-        self._step = _get_step_fn(cfg, self._paged)
+        # tensor-parallel decode INSIDE the server (round 9): with a
+        # ``mesh``, params take the Megatron specs and every cache leaf
+        # shards its Hkv axis over ``mp_axis`` (paged pool included, the
+        # slab rule) — the batched tick then runs pjit'd with XLA's
+        # collectives over ICI, donation/jit-key/recompile-watch
+        # composing unchanged (_ShardCtx).  ``device`` instead pins a
+        # single-chip server to one device (the fleet's per-replica
+        # placement knob); the two are mutually exclusive.
+        self._device = None
+        self._shard = None
+        if mesh is not None:
+            if device is not None:
+                raise ValueError("mesh= and device= are mutually "
+                                 "exclusive (TP server vs pinned "
+                                 "single-chip replica)")
+            if cfg.moe is not None:
+                raise NotImplementedError(
+                    "tensor-parallel serving supports dense models "
+                    "(build_sharded_decode's rule)")
+            self._shard = _ShardCtx(mesh, cfg, params, self.cache,
+                                    mp_axis)
+            self.params = jax.tree_util.tree_map(
+                jax.device_put, params, self._shard.params)
+            self.cache = {n: jax.device_put(a, self._shard.cache[n])
+                          for n, a in self.cache.items()}
+        elif device is not None:
+            self._device = device
+            self.params = jax.device_put(params, device)
+            self.cache = jax.device_put(self.cache, device)
+            # placement joins every step-cache key (see _shard_key)
+            self._shard = ("device", int(getattr(device, "id", 0)))
+        self._step = _get_step_fn(cfg, self._paged, self._shard)
         # async_dispatch: keep ONE step/block in flight — tick() first
         # dispatches step N+1 (feeding the previous step's tokens from
         # the DEVICE array, never fetched) and only then blocks on step
@@ -437,12 +633,13 @@ class DecodeServer:
         # prefix moves the chunk's start past the adopted blocks, which
         # the contiguous bucket/chunk programs cannot express)
         self._prefill_on = bool(prefill)
-        self._prefill = ((lambda bucket: _get_prefill_fn(cfg, bucket))
+        self._prefill = ((lambda bucket: _get_prefill_fn(
+                             cfg, bucket, self._shard))
                          if prefill and prefill_chunk is None
                          and not self._paged else None)
         self._chunk = (int(prefill_chunk) if prefill_chunk is not None
                        else None)
-        self._prefill_chunk = (_get_prefill_chunk_fn(cfg)
+        self._prefill_chunk = (_get_prefill_chunk_fn(cfg, self._shard)
                                if prefill and self._chunk
                                and not self._paged else None)
         # per-slot host state
@@ -489,50 +686,126 @@ class DecodeServer:
         (``result`` raises ``resilience.DeadlineExceeded``) instead of
         occupying a slot.  ``priority`` (higher = keep longer): the OOM
         degradation chain evicts the lowest-priority slots first."""
-        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
-        if not prompt:
-            raise ValueError("empty prompt")
-        if max_new_tokens < 1:
-            raise ValueError(f"max_new_tokens must be >= 1, "
-                             f"got {max_new_tokens}")
-        total = len(prompt) + max_new_tokens
-        if total > min(self.max_len, self.cfg.max_seq_len):
-            raise ValueError(
-                f"prompt+max_new_tokens {total} exceeds serving window "
-                f"{min(self.max_len, self.cfg.max_seq_len)}")
+        req = self._build_request(prompt, max_new_tokens, stop,
+                                  temperature, top_k, top_p, ttl_s,
+                                  priority)
+        self._queue.append(req)
+        if self._tel:
+            _telemetry.count("serving.requests_submitted")
+        self._admit()
+        self._tel_gauges()
+        return req["rid"]
+
+    def _build_request(self, prompt, max_new_tokens, stop, temperature,
+                       top_k, top_p, ttl_s, priority) -> dict:
+        """Validate one request and mint its queue entry (the shared
+        half of :meth:`submit` and :meth:`submit_prefilled`)."""
+        prompt, stop, ttl, top_k = validate_request(
+            prompt, max_new_tokens, stop, temperature, top_k, top_p,
+            ttl_s, window=min(self.max_len, self.cfg.max_seq_len),
+            vocab_size=self.cfg.vocab_size,
+            default_ttl=self._default_ttl)
         if self._paged:
             # a request needing more blocks than the whole pool can
             # NEVER be admitted (eviction frees other tenants' blocks,
             # not capacity) — rejecting here prevents it parking at the
             # queue front forever and livelocking the serve loop
-            need = -(-total // self._pool.bs)
+            need = -(-(len(prompt) + max_new_tokens) // self._pool.bs)
             if need > self._pool.N:
                 raise ValueError(
                     f"request needs {need} KV blocks but the pool has "
                     f"{self._pool.N} (raise num_blocks or shrink the "
                     f"request)")
-        stop = [[int(t) for t in seq] for seq in (stop or [])]
-        if any(not seq for seq in stop):
-            raise ValueError("empty stop sequence")
-        if temperature < 0.0:
-            raise ValueError(f"temperature must be >= 0, got {temperature}")
-        if not 0.0 < top_p <= 1.0:
-            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
-        ttl = self._default_ttl if ttl_s is None else float(ttl_s)
-        if ttl is not None and ttl <= 0:
-            raise ValueError(f"ttl_s must be > 0, got {ttl}")
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append({"rid": rid, "prompt": prompt,
-                            "max_new": max_new_tokens, "stop": stop,
-                            "temperature": float(temperature),
-                            "top_k": min(int(top_k), self.cfg.vocab_size),
-                            "top_p": float(top_p),
-                            "ttl": ttl, "priority": int(priority),
-                            "t_submit": time.perf_counter(),
-                            "t_enqueue": time.perf_counter()})
+        return {"rid": rid, "prompt": prompt,
+                "max_new": max_new_tokens, "stop": stop,
+                "temperature": float(temperature),
+                "top_k": top_k, "top_p": float(top_p),
+                "ttl": ttl, "priority": int(priority),
+                "t_submit": time.perf_counter(),
+                "t_enqueue": time.perf_counter()}
+
+    def submit_prefilled(self, prompt, rows, logits,
+                         max_new_tokens: int = 32, stop: list | None = None,
+                         temperature: float = 0.0, top_k: int = 0,
+                         top_p: float = 1.0, ttl_s: float | None = None,
+                         priority: int = 0) -> int:
+        """Admit a request whose prompt a PREFILL WORKER already ran
+        (round 9, the fleet's prefill/decode handoff): ``rows`` are the
+        worker's finished cache rows — leaves ``[L, 1, n, Hkv(, hd)]``
+        in this server's storage dtype (int8 scale planes included) —
+        and ``logits`` its admission logits ``[V]``.  Admission writes
+        the rows into a slot (one donated injector executable per
+        power-of-two bucket; paged: through the slot's block table) and
+        seeds the first token from ``logits`` with the exact sampling/
+        telemetry/NaN-guard semantics of local prefill — decode then
+        proceeds bit-identically to a locally prefilled request."""
+        req = self._build_request(prompt, max_new_tokens, stop,
+                                  temperature, top_k, top_p, ttl_s,
+                                  priority)
+        n = len(req["prompt"])
+        rows = {name: np.asarray(v) for name, v in rows.items()}
+        want = {name for name in self.cache if name != "tables"}
+        if set(rows) != want:
+            raise ValueError(
+                f"prefilled rows leaves {sorted(rows)} do not match the "
+                f"cache leaves {sorted(want)} (KV dtype mismatch between "
+                f"prefill worker and decode server?)")
+        for name, v in rows.items():
+            have = self.cache[name].dtype
+            if v.dtype != have:
+                # bf16 worker rows into an fp32 server would otherwise
+                # CAST silently in the injector and break the
+                # bit-parity-with-local-admission contract
+                raise ValueError(
+                    f"prefilled rows leaf {name!r} is {v.dtype}, this "
+                    f"server stores {have} (PADDLE_TPU_KV_DTYPE drift "
+                    f"between prefill worker and decode server?)")
+        if rows["k"].shape[2] != n:
+            raise ValueError(
+                f"prefilled rows cover {rows['k'].shape[2]} positions "
+                f"for a {n}-token prompt")
+        req["prefilled"] = (rows, np.asarray(logits, np.float32))
+        self._queue.append(req)
         if self._tel:
             _telemetry.count("serving.requests_submitted")
+            _telemetry.count("serving.prefilled_submissions")
+        self._admit()
+        self._tel_gauges()
+        return req["rid"]
+
+    def adopt_request(self, req: dict) -> int:
+        """Enqueue a request dict drained from ANOTHER server (the fleet
+        router's re-route path): a fresh local rid and queue-entry clock
+        (TTL stays a queue-wait bound), with progress carry and any
+        prefilled payload preserved.  The dict must come from
+        :meth:`drain_queue` / ``_build_request`` — it is trusted, not
+        re-validated (but the window is re-checked: replicas may be
+        heterogeneous)."""
+        total = len(req["prompt"]) + req["max_new"] \
+            - len(req.get("carry", ()))
+        if total > min(self.max_len, self.cfg.max_seq_len):
+            raise ValueError(
+                f"adopted request needs a {total}-row window; this "
+                f"replica serves {min(self.max_len, self.cfg.max_seq_len)}")
+        if self._paged:
+            # the submit-side whole-pool check, re-applied per replica
+            # (pools may be heterogeneous): a request no eviction can
+            # ever fit would park at the queue front and livelock the
+            # serve loop
+            need = -(-total // self._pool.bs)
+            if need > self._pool.N:
+                raise ValueError(
+                    f"adopted request needs {need} KV blocks; this "
+                    f"replica's pool has {self._pool.N}")
+        rid = self._next_rid
+        self._next_rid += 1
+        r = dict(req, rid=rid, t_enqueue=time.perf_counter())
+        r.setdefault("t_submit", time.perf_counter())
+        self._queue.append(r)
+        if self._tel:
+            _telemetry.count("serving.requests_adopted")
         self._admit()
         self._tel_gauges()
         return rid
@@ -618,12 +891,29 @@ class DecodeServer:
                 _telemetry.observe(
                     "serving.queue_wait_ms",
                     (t_admit - st["t_submit"]) * 1e3)
-            if self._prefill is not None or self._prefill_chunk is not None \
+            if "prefilled" in req or self._prefill is not None \
+                    or self._prefill_chunk is not None \
                     or (self._paged and self._prefill_on):
                 n = len(req["prompt"])
                 prefill_calls = 1
                 try:
-                    if self._paged:
+                    if "prefilled" in req:
+                        from . import kv_pool as _kv
+
+                        try:
+                            prefill_name, logits = \
+                                self._inject_prefilled(req, slot)
+                        except _kv.PoolExhausted:
+                            # same parking rule as local paged
+                            # admission below: wait for blocks, never
+                            # fail the submit
+                            self._pool.free_slot(slot)
+                            self._free.append(slot)
+                            self._queue.insert(0, req)
+                            if self._tel:
+                                _telemetry.count("kv_pool.admit_blocked")
+                            break
+                    elif self._paged:
                         from . import kv_pool as _kv
 
                         try:
@@ -642,14 +932,11 @@ class DecodeServer:
                                 _telemetry.count("kv_pool.admit_blocked")
                             break
                     elif self._prefill is not None:
-                        bucket = 1
-                        while bucket < n:
-                            bucket *= 2
                         # the padded chunk must fit both the wpe table
                         # and the cache window; both bounds >= n (submit
                         # checked)
-                        bucket = min(bucket, self.max_len,
-                                     self.cfg.max_seq_len)
+                        bucket = _pow2_bucket(n, self.max_len,
+                                              self.cfg.max_seq_len)
                         prefill_name = f"prefill@{bucket}"
                         padded = np.zeros((1, bucket), np.int32)
                         padded[0, :n] = req["prompt"]
@@ -783,11 +1070,18 @@ class DecodeServer:
             pad = [pairs[0]] * (width - len(pairs))
             src = jnp.asarray([p[0] for p in pairs + pad], jnp.int32)
             dst = jnp.asarray([p[1] for p in pairs + pad], jnp.int32)
-            self.cache = _get_copy_fn(self.cfg, width)(
+            self.cache = _get_copy_fn(self.cfg, width, self._shard)(
                 self.cache, src, dst)
         if self._pool.dirty:
-            self.cache = dict(self.cache,
-                              tables=jnp.asarray(self._pool.tables))
+            tables = jnp.asarray(self._pool.tables)
+            if isinstance(self._shard, _ShardCtx):
+                # committed to the replicated tables sharding so the
+                # explicit in_shardings see a matching placement
+                tables = jax.device_put(tables,
+                                        self._shard.cache["tables"])
+            elif self._device is not None:
+                tables = jax.device_put(tables, self._device)
+            self.cache = dict(self.cache, tables=tables)
             self._pool.dirty = False
 
     def _ensure_decode_blocks(self, steps: int):
@@ -843,10 +1137,7 @@ class DecodeServer:
             # overrun the wpe/window bound — overlapped rows recompute
             # to identical values (the contiguous walk's rule) after a
             # COW makes them writable
-            C = 1
-            while C < n - shared:
-                C *= 2
-            C = min(max(C, self._pool.bs), window)
+            C = min(max(_pow2_bucket(n - shared), self._pool.bs), window)
             starts = [shared if shared + C <= window else max(0, n - C)]
         while True:
             try:
@@ -863,7 +1154,7 @@ class DecodeServer:
                 if alloc.evict_cold(max_entries=_EVICT_BATCH) == 0:
                     raise
         self._apply_pool_ops()
-        fn = _get_paged_prefill_fn(self.cfg, C)
+        fn = _get_paged_prefill_fn(self.cfg, C, self._shard)
         logits = None
         rows_done = 0
         for s in starts:
@@ -881,6 +1172,55 @@ class DecodeServer:
             _telemetry.count("kv_pool.prefill_rows", rows_done)
         alloc.register_prefix(slot, prompt)
         return f"paged_prefill@{C}", len(starts), logits
+
+    def _inject_prefilled(self, req, slot):
+        """Admission half of the prefill/decode handoff: write the
+        worker-computed rows into ``slot`` — paged servers first adopt
+        the longest indexed prefix (the injected rows for shared blocks
+        are bit-identical to what the index already holds, so those
+        blocks are attended, never rewritten or duplicated), then
+        allocate/COW the remaining write range, evicting cold prefix
+        entries under pressure exactly like local admission — and
+        return (telemetry name, the worker's admission logits)."""
+        rows, logits = req["prefilled"]
+        n = len(req["prompt"])
+        bucket = _pow2_bucket(n, self.max_len, self.cfg.max_seq_len)
+        padded = {}
+        for name, v in rows.items():
+            buf = np.zeros(v.shape[:2] + (bucket,) + v.shape[3:],
+                           v.dtype)
+            buf[:, :, :n] = v
+            padded[name] = jnp.asarray(buf)
+        shared = 0
+        if self._paged:
+            from . import kv_pool as _kv
+
+            if self._prefill_on:
+                # capped at n-1 like local admission: the final row is
+                # always written (COW on a fully-shared prompt)
+                shared = self._pool.adopt_prefix(slot, req["prompt"])
+            while True:
+                try:
+                    self._pool.ensure_rows(slot, shared, n)
+                    break
+                except _kv.PoolExhausted:
+                    # the OOM chain's first rung at admission (see
+                    # _paged_prefill_slot)
+                    if self._pool.evict_cold(
+                            max_entries=_EVICT_BATCH) == 0:
+                        raise
+            self._apply_pool_ops()
+        fn = _get_inject_fn(self.cfg, bucket, self._paged, self._shard)
+        self.cache = fn(self.cache, padded, jnp.asarray(shared),
+                        jnp.asarray(n), jnp.asarray(slot))
+        if self._paged and self._prefill_on:
+            # the injected rows are exactly what local prefill would
+            # have computed, so the prompt's full blocks index for
+            # future local admissions to share
+            self._pool.register_prefix(slot, req["prompt"])
+        if self._tel:
+            _telemetry.count("serving.prefilled_rows", n - shared)
+        return f"inject@{bucket}", logits
 
     def pending(self) -> bool:
         return bool(self._slots or self._queue)
@@ -981,6 +1321,63 @@ class DecodeServer:
         if any(req["rid"] == rid for req in self._queue):
             return "queued"
         raise KeyError(f"unknown request id {rid}")
+
+    # -- fleet surface: load, health, queue drain (text/fleet.py) -----------
+
+    @property
+    def wedged(self) -> bool:
+        """The resilience watchdog's live verdict for THIS server (the
+        fleet router's per-replica health bit; the process-global
+        telemetry wedge state folds every server's verdict)."""
+        return self._wedged
+
+    def load_stats(self) -> dict:
+        """The router's load-balancing inputs, read from the scheduler's
+        host state — the SAME quantities the telemetry gauges sample
+        (queue depth, active slots, slot occupancy, kv utilization),
+        returned per server because the registry gauges are
+        process-global and a fleet co-hosts many replicas."""
+        act = len(self._slots)
+        if self._paged:
+            kv = self._pool.blocks_in_use / max(1, self._pool.N)
+        else:
+            rows = (int(self.cache["k"].shape[2])
+                    if self.cache is not None else self.max_len)
+            kv = sum(min(st["pos"], rows)
+                     for st in self._slots.values()) \
+                / (self.max_batch * rows)
+        return {
+            "queue_depth": len(self._queue),
+            "active_slots": act,
+            "free_slots": min(len(self._free),
+                              max(0, self._admit_cap - act)),
+            "slot_occupancy": act / self.max_batch,
+            "kv_utilization": kv,
+            "admit_cap": self._admit_cap,
+            "wedged": self._wedged,
+        }
+
+    def drain_queue(self, rids=None) -> list:
+        """Remove and return QUEUED request dicts (the fleet router's
+        wedge-drain path: a wedged replica's queued work is re-routed
+        to healthy replicas via :meth:`adopt_request`; its ACTIVE slots
+        keep decoding here — their device work is already paid for and
+        the wedge recovery replays it bit-exactly).
+
+        ``rids`` restricts the drain to those request ids: the router
+        passes the set it owns, so a request submitted DIRECTLY to this
+        server (whose rid only the direct submitter holds) stays queued
+        through the drain instead of vanishing."""
+        if rids is None:
+            out, self._queue[:] = list(self._queue), []
+        else:
+            out = [r for r in self._queue if r["rid"] in rids]
+            self._queue[:] = [r for r in self._queue
+                              if r["rid"] not in rids]
+        if out and self._tel:
+            _telemetry.count("serving.queue_drained", len(out))
+        self._tel_gauges()
+        return out
 
     # -- one tick: a single batched device step -----------------------------
 
@@ -1355,7 +1752,7 @@ class DecodeServer:
         if temp.any():
             kind = "sample_step"
             self._fault_check(kind)
-            fn = _get_sample_step_fn(self.cfg, self._paged)
+            fn = _get_sample_step_fn(self.cfg, self._paged, self._shard)
             nxt, self.cache = fn(
                 self.params, self.cache, jnp.asarray(tok),
                 jnp.asarray(pos), jax.random.fold_in(self._base_key, n),
@@ -1479,7 +1876,7 @@ class DecodeServer:
         ht, pm, pos, temp, tk, tp, snap = self._dispatch_feed(prev)
         n = self._step_no
         self._step_no = n + 1
-        fn = _get_async_step_fn(self.cfg, self._paged)
+        fn = _get_async_step_fn(self.cfg, self._paged, self._shard)
         try:
             self._fault_check("async_step")
             nxt, self.cache = fn(
@@ -1504,7 +1901,7 @@ class DecodeServer:
                 fname = f"async_sample_block@{block}"
                 self._fault_check(fname)
                 fn = _get_async_sample_block_fn(self.cfg, block,
-                                                self._paged)
+                                                self._paged, self._shard)
                 toks, self.cache = fn(
                     self.params, self.cache, jnp.asarray(ht),
                     jnp.asarray(pm),
@@ -1516,7 +1913,8 @@ class DecodeServer:
             else:
                 fname = f"async_block@{block}"
                 self._fault_check(fname)
-                fn = _get_async_block_fn(self.cfg, block, self._paged)
+                fn = _get_async_block_fn(self.cfg, block, self._paged,
+                                         self._shard)
                 toks, self.cache, feed, _ = fn(
                     self.params, self.cache, jnp.asarray(ht),
                     jnp.asarray(pm),
@@ -1711,7 +2109,7 @@ class DecodeServer:
 
         tok, pos = jnp.asarray(zi), jnp.asarray(zi)
         if self._async:
-            fn = _get_async_step_fn(self.cfg, self._paged)
+            fn = _get_async_step_fn(self.cfg, self._paged, self._shard)
             warm("async_step", lambda: fn(
                 self.params, self.cache, tok, jnp.asarray(zb), tok, pos,
                 key, jnp.asarray(zf), jnp.asarray(zi), jnp.asarray(of)))
@@ -1719,31 +2117,35 @@ class DecodeServer:
             warm("step", lambda: self._step(self.params, self.cache, tok,
                                             pos))
             if sample:
-                fn = _get_sample_step_fn(self.cfg, self._paged)
+                fn = _get_sample_step_fn(self.cfg, self._paged,
+                                         self._shard)
                 warm("sample_step", lambda: fn(
                     self.params, self.cache, tok, pos, key,
                     jnp.asarray(zf), jnp.asarray(zi), jnp.asarray(of)))
         for k in blocks:
             k = int(k)
             if self._async:
-                fn = _get_async_block_fn(self.cfg, k, self._paged)
+                fn = _get_async_block_fn(self.cfg, k, self._paged,
+                                         self._shard)
                 warm(f"async_block{k}", lambda fn=fn: fn(
                     self.params, self.cache, tok, jnp.asarray(zb), tok,
                     pos)[:2])
                 if sample:
                     fn = _get_async_sample_block_fn(self.cfg, k,
-                                                    self._paged)
+                                                    self._paged,
+                                                    self._shard)
                     warm(f"async_sample_block{k}", lambda fn=fn: fn(
                         self.params, self.cache, tok, jnp.asarray(zb),
                         tok, pos, self._base_key, jnp.asarray(0),
                         jnp.asarray(zf), jnp.asarray(zi),
                         jnp.asarray(of)))
             else:
-                fn = _get_block_fn(self.cfg, k, self._paged)
+                fn = _get_block_fn(self.cfg, k, self._paged, self._shard)
                 warm(f"block{k}", lambda fn=fn: fn(
                     self.params, self.cache, tok, pos)[:2])
                 if sample:
-                    fn = _get_sample_block_fn(self.cfg, k, self._paged)
+                    fn = _get_sample_block_fn(self.cfg, k, self._paged,
+                                              self._shard)
                     warm(f"sample_block{k}", lambda fn=fn: fn(
                         self.params, self.cache, tok, pos,
                         self._base_key, jnp.asarray(0), jnp.asarray(zf),
@@ -1781,7 +2183,7 @@ class DecodeServer:
                         widths |= _ladder(
                             1 << max(0, int(n) - 1).bit_length())
             for C in sorted(set(widths)):
-                fn = _get_paged_prefill_fn(self.cfg, C)
+                fn = _get_paged_prefill_fn(self.cfg, C, self._shard)
                 padded = jnp.zeros((1, C), jnp.int32)
                 warm(f"paged_prefill{C}", lambda fn=fn, padded=padded: fn(
                     self.params, self.cache, padded, jnp.asarray(0),
@@ -1850,7 +2252,8 @@ class DecodeServer:
         if temp.any():
             kind = f"sample_block@{block}"
             self._fault_check(kind)
-            fn = _get_sample_block_fn(self.cfg, block, self._paged)
+            fn = _get_sample_block_fn(self.cfg, block, self._paged,
+                                      self._shard)
             toks, self.cache = fn(
                 self.params, self.cache, jnp.asarray(tok),
                 jnp.asarray(pos), self._base_key, jnp.asarray(n),
@@ -1858,7 +2261,7 @@ class DecodeServer:
         else:
             kind = f"block@{block}"
             self._fault_check(kind)
-            fn = _get_block_fn(self.cfg, block, self._paged)
+            fn = _get_block_fn(self.cfg, block, self._paged, self._shard)
             toks, self.cache, _, _ = fn(self.params, self.cache,
                                         jnp.asarray(tok), jnp.asarray(pos))
         self._step_no = n + block   # after the call: see _tick_impl

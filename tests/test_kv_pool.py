@@ -143,6 +143,61 @@ def test_evict_cold_frees_only_index_held_blocks():
     assert a.blocks_in_use == 0
 
 
+def test_prefix_index_interned_chain_is_linear():
+    """Round 9: the index interns (parent chain id, block tokens) — one
+    O(block_size) key per block, so a long prompt costs O(n) host
+    memory/hashing where the old exact-chain keys
+    (``tuple(prompt[:(li+1)*bs])``) materialized O(n^2/bs)."""
+    bs = 4
+    a = kv_pool.PagedAllocator(num_blocks=16, block_size=bs, nmax=12,
+                               max_batch=2)
+    prompt = list(range(40))            # 10 full blocks
+    a.ensure_rows(0, 0, 40)
+    a.register_prefix(0, prompt)
+    assert a.prefix_entries == 10
+    assert len(a._interned) == 10
+    # every intern key holds ONE block's tokens, never a growing prefix
+    assert all(len(tokens) == bs for _, tokens in a._interned)
+    # the chain walk still adopts the whole prefix (capped at n-1 rows)
+    assert a.adopt_prefix(1, prompt) == 39
+    a.close()
+
+
+def test_interned_chain_keys_never_alias_across_parents():
+    """The no-collision guarantee survives interning: identical block
+    tokens under DIFFERENT parents are different chain entries, so a
+    prompt starting with another prompt's middle block shares nothing."""
+    bs = 4
+    a = kv_pool.PagedAllocator(num_blocks=16, block_size=bs, nmax=8,
+                               max_batch=2)
+    p1 = [1, 2, 3, 4, 5, 6, 7, 8]
+    a.ensure_rows(0, 0, 8)
+    a.register_prefix(0, p1)
+    # [5,6,7,8] is indexed only under parent [1,2,3,4] — as a ROOT
+    # block it must miss
+    p2 = [5, 6, 7, 8, 9, 10, 11, 12]
+    assert a.adopt_prefix(1, p2) == 0
+    assert a.prefix_misses >= 1
+    a.close()
+
+
+def test_evict_cold_drains_interned_chains_tail_first():
+    """Only chain leaves are eviction candidates (an evicted inner
+    block would orphan its descendants' ids): repeated engagements
+    drain a cold chain one tail block per pass."""
+    a = kv_pool.PagedAllocator(num_blocks=16, block_size=4, nmax=8,
+                               max_batch=2)
+    prompt = list(range(12))            # 3 chained blocks
+    a.ensure_rows(0, 0, 12)
+    a.register_prefix(0, prompt)
+    a.free_slot(0)                      # whole chain cold (index-only)
+    for left in (2, 1, 0):
+        assert a.evict_cold() == 1      # the current leaf only
+        assert a.prefix_entries == left
+    assert a.blocks_in_use == 0
+    a.close()
+
+
 def test_close_releases_everything():
     a = kv_pool.PagedAllocator(num_blocks=6, block_size=8, nmax=3,
                                max_batch=2)

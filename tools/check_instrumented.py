@@ -75,6 +75,15 @@ COUNT_NAMES = {"count", "set_runtime_wedge"}
 KV_POOL_FILE = os.path.join("paddle_tpu", "text", "kv_pool.py")
 KV_MARKERS = ("alloc", "evict", "cow", "free")
 
+# Fleet lint (round 9, same rule family): every router scheduling path
+# in text/fleet.py — routing, shedding, wedge drains, prefill handoffs,
+# re-routes — must count a ``fleet.*`` telemetry counter (directly, or
+# by delegating to another marker-named callable that does).  A fleet
+# that silently sheds or re-routes reads as healthy on every dashboard
+# while requests quietly vanish.
+FLEET_FILE = os.path.join("paddle_tpu", "text", "fleet.py")
+FLEET_MARKERS = ("route", "shed", "drain", "handoff")
+
 
 def _call_name(node: ast.Call):
     f = node.func
@@ -179,6 +188,31 @@ def scan_kv_pool_source(src: str, filename: str = "<src>") -> list:
     return violations
 
 
+def scan_fleet_source(src: str, filename: str = "<src>") -> list:
+    """Fleet lint violations in one source string: a function whose name
+    carries a :data:`FLEET_MARKERS` marker (a router scheduling path)
+    must contain a call to one of :data:`COUNT_NAMES` or delegate to
+    another marker-named callable."""
+    tree = ast.parse(src, filename=filename)
+    violations = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and any(m in node.name for m in FLEET_MARKERS)):
+            continue
+        counted = any(
+            isinstance(n, ast.Call)
+            and (_call_name(n) in COUNT_NAMES
+                 or any(m in (_call_name(n) or "") for m in FLEET_MARKERS))
+            for n in ast.walk(node))
+        if not counted:
+            violations.append(
+                (filename, node.lineno,
+                 f"fleet scheduling site {node.name}() records no "
+                 f"telemetry counter (count) — silent re-routes/sheds "
+                 f"read as healthy while requests vanish"))
+    return violations
+
+
 def _walk_py(path: str) -> list:
     out = []
     for dirpath, _, names in sorted(os.walk(path)):
@@ -224,6 +258,12 @@ def scan_repo(root: str | None = None) -> list:
         with open(kv_path, encoding="utf-8") as f:
             violations.extend(scan_kv_pool_source(
                 f.read(), os.path.relpath(kv_path, root)))
+    # fleet lint: router scheduling observability
+    fleet_path = os.path.join(root, FLEET_FILE)
+    if os.path.exists(fleet_path):
+        with open(fleet_path, encoding="utf-8") as f:
+            violations.extend(scan_fleet_source(
+                f.read(), os.path.relpath(fleet_path, root)))
     return violations
 
 
