@@ -1387,6 +1387,78 @@ def _fleet_smoke():
             "reroutes": reroutes}
 
 
+def _spec_smoke():
+    """Speculative-decoding round, run by ``--config gpt --small`` (CI):
+    a draft-model spec server must produce greedy tokens bit-identical
+    to the plain server on the same request stream while spending at
+    least 1.5x fewer target-model passes per generated token, and a
+    self-drafting (n-gram) server on a repetitive prompt must hold the
+    same bit-parity — a silent acceptance regression or a spec/plain
+    divergence fails CI before speculation ever defaults on."""
+    import numpy as np
+    import jax
+
+    from paddle_tpu.text import gpt, serving
+
+    cfg = gpt.GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                        num_heads=4, max_seq_len=64)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(5)
+    prompts = [[int(x) for x in rng.integers(1, 100, n)] for n in (4, 7)]
+
+    def serve(**kw):
+        srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=64,
+                                   **kw)
+        rids = [srv.submit(p, max_new_tokens=12) for p in prompts]
+        while srv.pending():
+            srv.tick()
+        toks = [srv.result(r) for r in rids]
+        passes = (srv._spec_rounds + srv._spec_plain_steps
+                  if srv._spec_on else srv._step_no)
+        srv.close()
+        return toks, passes
+
+    ref, plain_passes = serve()
+    # draft == target: every proposal is accepted, so the pass count
+    # collapses toward new_tokens / K — the smoke's speedup gate
+    spec, spec_passes = serve(draft_cfg=cfg, draft_params=params,
+                              spec_k=4)
+    if spec != ref:
+        raise AssertionError(
+            f"spec smoke: speculative/plain token divergence "
+            f"({spec} vs {ref})")
+    total = sum(len(t) for t in ref)
+    ratio = (plain_passes / total) / max(spec_passes / total, 1e-9)
+    if ratio < 1.5:
+        raise AssertionError(
+            f"spec smoke: speculation spent {spec_passes} target passes "
+            f"for {total} tokens vs {plain_passes} plain — "
+            f"{ratio:.2f}x < 1.5x fewer passes per token")
+    # self-draft round: a repetitive prompt the host n-gram drafter can
+    # exploit; parity is the assertion, speedup is reported only (the
+    # n-gram hit rate on a random-model stream is workload luck)
+    rep = [7, 3, 7, 3, 7, 3, 7, 3]
+    def serve_rep(**kw):
+        srv = serving.DecodeServer(params, cfg, max_batch=1, max_len=64,
+                                   **kw)
+        rid = srv.submit(rep, max_new_tokens=12)
+        while srv.pending():
+            srv.tick()
+        toks = srv.result(rid)
+        srv.close()
+        return toks
+
+    ref_rep = serve_rep()
+    got_rep = serve_rep(spec_k=4)
+    if got_rep != ref_rep:
+        raise AssertionError(
+            f"spec smoke: self-draft token divergence "
+            f"({got_rep} vs {ref_rep})")
+    return {"ok": True, "plain_target_passes": plain_passes,
+            "spec_target_passes": spec_passes,
+            "passes_per_token_speedup": round(ratio, 3)}
+
+
 def bench_gpt(small: bool):
     if small:
         rec = _run_gpt_rung(-1)
@@ -1404,6 +1476,10 @@ def bench_gpt(small: bool):
         # disaggregated fleet rides the CI smoke: loopback parity +
         # wedge re-route counter asserted (see _fleet_smoke)
         rec["fleet_smoke"] = _fleet_smoke()
+        # speculative decoding rides the CI smoke: draft-model and
+        # self-draft bit-parity + >=1.5x fewer target passes per token
+        # asserted (see _spec_smoke)
+        rec["spec_smoke"] = _spec_smoke()
         # provenance-schema gate (CI): a bench line whose provenance
         # block is missing or incomplete must fail the smoke — a silent
         # CPU fallback can never again ship as an unlabeled number
@@ -2568,11 +2644,136 @@ def bench_fleet(small: bool):
     return _stamp_provenance(rec, dev)
 
 
+def bench_spec(small: bool):
+    """Speculative decoding vs the plain continuous-batching server
+    (round 11): the same greedy request stream driven through three
+    servers — plain, draft-model speculation (a smaller GPT sharing the
+    vocab), and model-free self-drafting (host n-gram) — measuring
+    generated tok/s and TARGET PASSES PER TOKEN, the number the
+    speedup actually comes from: one verify pass scores up to K
+    positions, so accepted drafts amortize the target model's weight
+    traffic across several tokens.
+
+    Asserted: both speculative modes stay bit-identical to the plain
+    server (greedy accept keeps the argmax chain exact), and the
+    draft-model arm spends >= 1.5x fewer target passes per token — on
+    this arm the draft IS the target (perfect agreement), so the gate
+    checks the serving machinery's ceiling, not draft quality.  The
+    self-draft arm's pass count is reported unasserted: its n-gram hit
+    rate is workload-dependent (repetitive streams win, random streams
+    fall back to plain steps)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu import flags
+    from paddle_tpu.text import gpt, serving
+
+    dev = jax.devices()[0]
+    if small:
+        cfg = gpt.GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                            num_heads=4, max_seq_len=128)
+        dcfg = gpt.GPTConfig(vocab_size=512, hidden_size=64, num_layers=1,
+                             num_heads=4, max_seq_len=128)
+        B, max_len, new_toks, K, iters = 4, 64, 16, 4, 2
+        p_lens = (6, 12, 20, 9)
+    else:
+        cfg = gpt.GPTConfig(vocab_size=50304, hidden_size=1024,
+                            num_layers=24, num_heads=16, max_seq_len=2048)
+        # ~12x smaller drafter: the regime the technique targets — the
+        # draft's per-step cost is noise next to one target pass
+        dcfg = gpt.GPTConfig(vocab_size=50304, hidden_size=512,
+                             num_layers=4, num_heads=8, max_seq_len=2048)
+        B, max_len, new_toks, K, iters = 8, 1024, 64, 4, 2
+        p_lens = (64, 128, 256, 320, 96, 64, 192, 128)
+    rng = np.random.default_rng(0)
+    prompts = [[int(x) for x in rng.integers(1, cfg.vocab_size, n)]
+               for n in p_lens]
+    params = jax.device_get(gpt.init_params(cfg, jax.random.PRNGKey(0)))
+    params = jax.tree_util.tree_map(jnp.asarray, params)
+    # small mode verifies the machinery's ceiling with draft == target
+    # (every proposal accepted); full mode pays for a real small drafter
+    dparams = params if small else jax.tree_util.tree_map(
+        jnp.asarray,
+        jax.device_get(gpt.init_params(dcfg, jax.random.PRNGKey(1))))
+    if small:
+        dcfg = cfg
+
+    def serve_pass(**kw):
+        srv = serving.DecodeServer(params, cfg, max_batch=B,
+                                   max_len=max_len, **kw)
+        for p in prompts:
+            srv.submit(p, max_new_tokens=new_toks)
+        while srv.pending():
+            srv.tick()
+        toks = srv._results
+        passes = (srv._spec_rounds + srv._spec_plain_steps
+                  if srv._spec_on else srv._step_no)
+        accept = None
+        if srv._spec_on and srv._spec_prop:
+            accept = srv._spec_acc / srv._spec_prop
+        srv.close()
+        return toks, passes, accept
+
+    def measure(**kw):
+        serve_pass(**kw)                      # warm pass (compiles)
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = serve_pass(**kw)
+        dt = (time.perf_counter() - t0) / iters
+        toks, passes, accept = out
+        total = sum(len(t) for t in toks.values())
+        return toks, total / dt, passes / max(total, 1), accept
+
+    ref, plain_tok_s, plain_ppt, _ = measure()
+    draft_kw = dict(draft_cfg=dcfg, draft_params=dparams, spec_k=K)
+    got_d, draft_tok_s, draft_ppt, draft_acc = measure(**draft_kw)
+    got_s, self_tok_s, self_ppt, self_acc = measure(spec_k=K)
+    if got_d != ref:
+        raise AssertionError(
+            "spec bench: draft-model speculation diverged from the "
+            "plain server's greedy tokens")
+    if got_s != ref:
+        raise AssertionError(
+            "spec bench: self-drafting diverged from the plain "
+            "server's greedy tokens")
+    speedup = plain_ppt / max(draft_ppt, 1e-9)
+    if speedup < 1.5:
+        raise AssertionError(
+            f"spec bench: draft-model arm spent {draft_ppt:.3f} target "
+            f"passes/token vs plain {plain_ppt:.3f} — {speedup:.2f}x "
+            f"< 1.5x fewer passes per token")
+    rec = {"metric": "tokens_per_sec_serving_speculative",
+           "unit": "tokens/s/chip",
+           "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
+               timespec="seconds"),
+           "device": dev.platform,
+           "device_kind": str(getattr(dev, "device_kind", "")),
+           "batch": B, "max_len": max_len, "new_tokens": new_toks,
+           "spec_k": K, "prompt_lens": list(p_lens),
+           "draft_is_target": small,
+           "value": round(draft_tok_s, 2),
+           "plain_tok_s": round(plain_tok_s, 2),
+           "self_draft_tok_s": round(self_tok_s, 2),
+           "plain_passes_per_token": round(plain_ppt, 3),
+           "draft_passes_per_token": round(draft_ppt, 3),
+           "self_draft_passes_per_token": round(self_ppt, 3),
+           "passes_per_token_speedup": round(speedup, 3),
+           "draft_accept_rate": (round(draft_acc, 3)
+                                 if draft_acc is not None else None),
+           "self_draft_accept_rate": (round(self_acc, 3)
+                                      if self_acc is not None else None),
+           "kv_dtype": flags.kv_cache_dtype() or "compute",
+           "vs_baseline": 0.0}
+    return _stamp_provenance(rec, dev)
+
+
 _CONFIGS = {"gpt": bench_gpt, "train": bench_train, "mnist": bench_mnist,
             "resnet": bench_resnet, "bert": bench_bert, "int8": bench_int8,
             "decode": bench_decode, "decode_long": bench_decode_long,
             "serving": bench_serving, "paged": bench_paged,
-            "fleet": bench_fleet}
+            "fleet": bench_fleet, "spec": bench_spec}
 
 
 def main():

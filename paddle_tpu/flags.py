@@ -355,6 +355,54 @@ def fleet_tick_block() -> int:
         return 1
 
 
+def spec_k() -> int:
+    """Draft tokens proposed per speculative serving round
+    (``PADDLE_TPU_SPEC_K``, default 0 = speculation off).  When a
+    ``DecodeServer`` is built without an explicit ``spec_k=`` this is
+    the value it resolves; the batched verify executable bakes K into
+    its shapes, so the raw env string is part of ``decode_jit_key`` —
+    flipping it mid-process retraces instead of silently reusing the
+    other K's executable."""
+    v = os.environ.get("PADDLE_TPU_SPEC_K", "0")
+    try:
+        k = int(v)
+    except ValueError:
+        raise ValueError(f"PADDLE_TPU_SPEC_K={v!r}: expected an integer "
+                         f">= 0 (0 disables speculation)")
+    if k < 0:
+        raise ValueError(f"PADDLE_TPU_SPEC_K={k}: must be >= 0")
+    return k
+
+
+def spec_min_accept() -> float:
+    """Rolling per-request acceptance rate below which a speculating
+    slot falls back to plain decode (``PADDLE_TPU_SPEC_MIN_ACCEPT``,
+    default 0.3).  Below ~1/3 acceptance a K-token verify does more
+    target work per emitted token than plain stepping, so the slot
+    stops paying for proposals it keeps rejecting.  Host scheduling
+    only — never a jit-cache key; acceptance resolution happens on
+    fetched logits either way."""
+    try:
+        return min(1.0, max(0.0, float(os.environ.get(
+            "PADDLE_TPU_SPEC_MIN_ACCEPT", "0.3"))))
+    except ValueError:
+        return 0.3
+
+
+def fleet_tick_workers() -> int:
+    """Upper bound on threads the fleet router fans replica ticks out
+    over (``PADDLE_TPU_FLEET_TICK_WORKERS``, default 8; 1 restores the
+    sequential loop).  Each replica tick blocks on its own device
+    round trip, so with N replicas the sequential loop serializes N
+    round trips per router tick; the fan-out overlaps them.  Host
+    scheduling only."""
+    try:
+        return max(1, int(os.environ.get("PADDLE_TPU_FLEET_TICK_WORKERS",
+                                         "8")))
+    except ValueError:
+        return 8
+
+
 def fleet_max_queue() -> int:
     """Queued requests the router will stack on one replica beyond its
     free slots before holding work in the fleet-level queue
@@ -451,7 +499,10 @@ def decode_jit_key() -> tuple:
             # paged KV cache (text/kv_pool.py): layout + block geometry
             # change the compiled step (block-table gathers vs slab
             # slices), so both key the cache like the dtype does
-            kv_layout(), kv_block_size())
+            kv_layout(), kv_block_size(),
+            # speculative serving: K is baked into the batched verify
+            # executable's shapes (tokens [B, K], logits [B, K, V])
+            os.environ.get("PADDLE_TPU_SPEC_K", ""))
 
 
 if _ENV_SEEDED:

@@ -12,7 +12,14 @@ built on the seeds in :mod:`paddle_tpu.profiler` (host spans) and
    lifecycle records queue-wait / TTFT / per-token / end-to-end latency
    into streaming histograms (fixed log-spaced buckets, O(1) memory) plus
    batch-slot / KV-cache / queue-depth gauges, sampled from host values
-   the server already fetched (no extra device syncs).
+   the server already fetched (no extra device syncs).  Speculative
+   serving adds the ``spec.*`` counter family — ``spec.proposed`` /
+   ``spec.accepted`` / ``spec.fallbacks`` (plus ``spec.draft_steps`` and
+   the self-draft ``spec.ngram_hits``/``spec.ngram_misses``) — and the
+   per-server ``serving.spec_accept_rate`` gauge; all auto-export to
+   :func:`snapshot`/:func:`render_prometheus` like every registry stat,
+   and ``tools/check_instrumented.py`` lints that every spec
+   accept/reject/fallback path counts or delegates.
 2. **Training step telemetry** — ``Model.fit`` / ``TrainStep`` emit
    step-time and throughput histograms, and the fit loop's host-sync
    count lands in the shared counter registry via the

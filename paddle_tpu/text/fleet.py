@@ -39,6 +39,7 @@ weights.  Never expose a transport port beyond that domain.
 """
 from __future__ import annotations
 
+import concurrent.futures
 import contextlib
 import pickle
 import queue
@@ -479,6 +480,15 @@ class Router:
         self._pf_next = 0
         self._prefilling: set[int] = set()     # rids out at a worker
         self._dead_eps: set[int] = set()       # endpoint indices gone
+        # concurrent replica ticks: each replica's tick is independent
+        # host scheduling around its own device dispatch, so the router
+        # fans them out over a bounded thread pool (lazily created —
+        # fleets of 1-2 replicas never pay a thread hop).  Router STATE
+        # (queue/health/routing) stays on the caller's thread: only
+        # DecodeServer.tick/tick_block runs on workers, and each replica
+        # is touched by at most one worker per round.
+        self._tick_workers = _flags.fleet_tick_workers()
+        self._tick_pool = None
 
     # -- submission ---------------------------------------------------------
 
@@ -734,21 +744,51 @@ class Router:
             if self._tel:
                 _telemetry.count("fleet.reroutes", len(front))
 
+    def _tick_replica(self, r) -> None:
+        if self._block > 1:
+            r.tick_block(self._block)
+        else:
+            r.tick()
+
     def tick(self) -> None:
         """One fleet scheduling round: fold in finished prefills, health
         check (drain + re-route on a wedge flip), TTL shed, dispatch,
         then tick every replica with pending work — wedged ones
-        included, since their recovery needs ticks."""
+        included, since their recovery needs ticks.
+
+        Replica ticks run CONCURRENTLY over a bounded thread pool
+        (``PADDLE_TPU_FLEET_TICK_WORKERS``) — a sequential loop was fine
+        for 2 replicas, not 16 waiting on each other's device fetches.
+        The round is still a barrier: every replica's tick completes (or
+        raises) before the post-round health check, so the wedge-drain
+        semantics are EXACTLY the sequential loop's — a wedge verdict
+        raised on a worker thread is observed by ``_check_health`` on
+        this thread after the join, and the drain/re-route runs here,
+        single-threaded.  The first replica exception propagates to the
+        caller after all ticks joined (no replica is left mid-round)."""
         self._poll_prefill()
         self._check_health()
         self._shed_expired()
         self._route()
-        for r in self.replicas:
-            if r.pending():
-                if self._block > 1:
-                    r.tick_block(self._block)
-                else:
-                    r.tick()
+        pend = [r for r in self.replicas if r.pending()]
+        if len(pend) <= 1 or self._tick_workers <= 1:
+            for r in pend:
+                self._tick_replica(r)
+        else:
+            if self._tick_pool is None:
+                self._tick_pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=min(len(self.replicas),
+                                    self._tick_workers),
+                    thread_name_prefix="fleet-tick")
+            errs = []
+            for f in [self._tick_pool.submit(self._tick_replica, r)
+                      for r in pend]:
+                try:
+                    f.result()
+                except Exception as e:  # noqa: BLE001 - re-raised below
+                    errs.append(e)
+            if errs:
+                raise errs[0]
         self._check_health()
         self._gauges()
 
@@ -820,6 +860,9 @@ class Router:
         for w in self._owned_workers:
             with contextlib.suppress(Exception):
                 w.close()
+        if self._tick_pool is not None:
+            self._tick_pool.shutdown(wait=True)
+            self._tick_pool = None
         for r in self.replicas:
             with contextlib.suppress(Exception):
                 r.close()

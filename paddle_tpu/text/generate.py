@@ -263,19 +263,26 @@ class _LRU:
     grow per config VALUE and hold compiled executables + implicit param
     references — fine for tests, a leak for a long-lived server cycling
     models).  dict-compatible get/[] with least-recently-used eviction;
-    evicting an entry drops the last reference to its executable."""
+    evicting an entry drops the last reference to its executable.
+
+    Thread-safe: the fleet router ticks replicas concurrently, and every
+    replica's step getters share these module-level caches — an unlocked
+    OrderedDict corrupts under concurrent move_to_end/popitem."""
 
     def __init__(self, maxsize: int):
         import collections
+        import threading
 
         self._d = collections.OrderedDict()
+        self._mu = threading.Lock()
         self.maxsize = maxsize
 
     def get(self, k, default=None):
-        if k in self._d:
-            self._d.move_to_end(k)
-            return self._d[k]
-        return default
+        with self._mu:
+            if k in self._d:
+                self._d.move_to_end(k)
+                return self._d[k]
+            return default
 
     _MISS = object()
 
@@ -286,27 +293,33 @@ class _LRU:
         return v
 
     def __contains__(self, k):
-        return k in self._d
+        with self._mu:
+            return k in self._d
 
     def __setitem__(self, k, v):
-        self._d[k] = v
-        self._d.move_to_end(k)
-        while len(self._d) > self.maxsize:
-            self._d.popitem(last=False)
+        with self._mu:
+            self._d[k] = v
+            self._d.move_to_end(k)
+            while len(self._d) > self.maxsize:
+                self._d.popitem(last=False)
 
     def __len__(self):
-        return len(self._d)
+        with self._mu:
+            return len(self._d)
 
     def keys(self):
-        return list(self._d.keys())
+        with self._mu:
+            return list(self._d.keys())
 
     def pop(self, k, default=None):
-        return self._d.pop(k, default)
+        with self._mu:
+            return self._d.pop(k, default)
 
     def clear(self):
         """Drop every cached executable (tests that flip trace-time env
         flags — e.g. PADDLE_TPU_W4_KERNEL — must force a retrace)."""
-        self._d.clear()
+        with self._mu:
+            self._d.clear()
 
 
 import os as _os
@@ -1015,6 +1028,32 @@ def _filtered_probs(logits, temperature, top_k, top_p):
                        int(top_k), float(top_p), xp=np)
     e = np.exp(x - x.max())
     return e / e.sum()
+
+
+def ngram_propose(sequence, k, max_order=3, window=256):
+    """Model-free draft proposals: match the sequence's trailing n-gram
+    (longest order first, down to a single token) against its most
+    recent earlier occurrence and copy the continuation — the
+    "self-drafting" / prompt-lookup decoding trick (zero extra model
+    FLOPs, pure host work).  Returns k proposed tokens, or None when no
+    order matches (the caller speculates nothing that round).  Short
+    continuations pad by repeating the last copied token — a cheap
+    guess the verify step rejects at worst.  ``window`` bounds the
+    backward scan so long contexts stay O(window) per call."""
+    seq = list(sequence)
+    n = len(seq)
+    if n < 2:
+        return None
+    lo = max(0, n - int(window))
+    for order in range(min(int(max_order), n - 1), 0, -1):
+        tail = tuple(seq[n - order:])
+        for s in range(n - order - 1, lo - 1, -1):
+            if tuple(seq[s:s + order]) == tail:
+                out = list(seq[s + order:s + order + k])
+                while len(out) < k:
+                    out.append(out[-1])
+                return out
+    return None
 
 
 def speculative_generate(tparams, tcfg, dparams, dcfg, prompt,

@@ -45,8 +45,8 @@ from .. import telemetry as _telemetry
 
 __all__ = [
     "PoolExhausted", "PagedAllocator", "round_len", "init_paged_cache",
-    "paged_decode_step_batched", "paged_prefill_chunk", "copy_blocks",
-    "inject_rows",
+    "paged_decode_step_batched", "paged_prefill_chunk",
+    "paged_verify_chunk_batched", "copy_blocks", "inject_rows",
 ]
 
 # the value/scale leaves of a pooled cache (everything except "tables")
@@ -309,6 +309,60 @@ def paged_prefill_chunk(params, cache, tokens, pos0, length, slot,
     last = gpt._norm(last, params, "ln_f", cfg)
     logits = woq.logits(last, params, dt)[0, 0]
     return logits.astype(jnp.float32), cache
+
+
+def paged_verify_chunk_batched(params, cache, tokens, pos, cfg):
+    """``generate.verify_chunk`` on the pooled layout, batched over
+    slots: tokens [B, K] int32 scored at per-slot positions
+    [pos_b, pos_b + K) -> (logits [B, K, V] fp32, cache).
+
+    Per slot this is the EXACT chunk math ``paged_prefill_chunk`` runs —
+    ``generate._chunk_attend_block`` over the slot's table-gathered view
+    — so row 0 of the verify logits equals the plain decode step's
+    logits for the same feed token (greedy serving parity rests on
+    this).  K/V rows for the whole chunk scatter through the block
+    table; rejected rows land at/past the slot's position pointer where
+    the causal mask hides them and the next round overwrites them (the
+    stale-row invariant — no masked write needed).  Unmapped or
+    past-the-table entries drop (the standard out-of-bounds sink)."""
+    N, bs, nmax = _geometry(cache)
+    B, K = tokens.shape
+    tables = cache["tables"]
+    pool = {n: cache[n] for n in POOL_LEAVES if n in cache}
+    dt = cfg.dtype
+
+    def one(tok_k, p0, trow):
+        x = woq.embed(params, tok_k[None], dt)            # [1, K, D]
+        if cfg.pos_embed == "learned":
+            x = x + jax.lax.dynamic_slice(
+                params["wpe"], (p0, 0),
+                (K, cfg.hidden_size)).astype(dt)[None]
+
+        def body(x, layer):
+            p, pl = layer
+            csl = {n: _gather_slot(v, trow) for n, v in pl.items()}
+            x, rows = generate._chunk_attend_block(x, p, csl, p0, cfg)
+            return x, rows
+
+        x, rows = jax.lax.scan(body, x, (params["blocks"], pool))
+        x = gpt._norm(x, params, "ln_f", cfg)
+        logits = woq.logits(x, params, dt)[0]             # [K, V]
+        return logits.astype(jnp.float32), rows
+
+    logits, rows = jax.vmap(one, in_axes=(0, 0, 0),
+                            out_axes=(0, 0))(tokens, pos, tables)
+    # rows leaves [B, L, 1, K, Hkv(, hd)] -> [L, B*K, Hkv(, hd)];
+    # physical row per (slot, j) through the table
+    logi = pos[:, None] + jnp.arange(K)[None, :]          # [B, K]
+    tb = jnp.take_along_axis(tables, jnp.clip(logi // bs, 0, nmax - 1),
+                             axis=1)
+    phys = jnp.where((tb >= 0) & (logi // bs < nmax),
+                     tb * bs + logi % bs, N * bs).reshape(B * K)
+    stacked = {}
+    for n, v in rows.items():
+        v = jnp.moveaxis(v[:, :, 0], 0, 1)                # [L, B, K, ...]
+        stacked[n] = v.reshape((v.shape[0], B * K) + v.shape[3:])
+    return logits, _scatter_rows(cache, stacked, phys)
 
 
 def inject_rows(cache: dict, rows: dict, start, length, slot) -> dict:

@@ -440,6 +440,57 @@ def _get_async_sample_block_fn(cfg: gpt.GPTConfig, k: int,
     return fn
 
 
+def spec_verify_batched(params, cache, tokens, pos, cfg: gpt.GPTConfig):
+    """Batched draft-then-verify scoring: tokens [B, K] int32 fed at
+    PER-SLOT positions [pos_b, pos_b + K) -> (logits [B, K, V] fp32,
+    cache).  Column 0 is each slot's normal feed token, columns 1..K-1
+    its draft proposals; row j scores position pos_b + j, so row 0
+    equals the plain decode step's logits (greedy parity) and rows
+    1.. are the target's verdicts on the proposals.
+
+    Contiguous: vmap of ``generate.verify_chunk`` per slot — the
+    offline speculative path's exact math at decode_step_batched's
+    batching shapes.  Paged (a ``tables`` leaf): the block-table twin
+    ``kv_pool.paged_verify_chunk_batched``.  Either way the chunk's K
+    cache rows are written unconditionally: rejected rows sit at/past
+    the slot's position pointer where the causal mask hides them and
+    the next round overwrites them (the stale-row invariant the whole
+    server rests on), so no masked write is needed."""
+    if "tables" in cache:
+        from . import kv_pool
+
+        return kv_pool.paged_verify_chunk_batched(params, cache, tokens,
+                                                  pos, cfg)
+
+    def one(tok, csl, p):
+        sl = {name: v[:, None] for name, v in csl.items()}
+        logits, new = generate.verify_chunk(params, sl, tok[None], p, cfg)
+        return logits[0], {name: v[:, 0] for name, v in new.items()}
+
+    logits, new = jax.vmap(one, in_axes=(0, 1, 0), out_axes=(0, 1))(
+        tokens, cache, pos)
+    return logits, new
+
+
+def _get_spec_verify_fn(cfg: gpt.GPTConfig, k: int, paged: bool = False,
+                        shard=None):
+    """The speculative serving verify step: one executable per
+    (cfg, K, layout, placement) — K is baked into the token/logit
+    shapes, and ``decode_jit_key`` carries PADDLE_TPU_SPEC_K so the
+    recompile watch sees every spec compile."""
+    key = ("spec_verify", generate._cfg_key(cfg), int(k), paged,
+           _shard_key(shard))
+    fn = _STEP_CACHE.get(key)
+    if fn is None:
+        fn = generate._watch_jit(f"serving.spec_verify@{k}", key, jax.jit(
+            lambda p, c, t, s, _cfg=cfg: spec_verify_batched(
+                p, c, t, s, _cfg),
+            donate_argnums=generate._donate_cache(),
+            **_shard_kw(shard, 2, "rc")))
+        _STEP_CACHE[key] = fn
+    return fn
+
+
 def _pow2_bucket(n: int, *bounds) -> int:
     """Smallest power of two >= ``n``, clamped to the given upper
     bounds — THE prompt-bucket rule.  The bucket is a jit-cache key, so
@@ -506,7 +557,9 @@ class DecodeServer:
                  block_size: int | None = None,
                  num_blocks: int | None = None,
                  mesh=None, mp_axis: str = "mp",
-                 device=None):
+                 device=None,
+                 draft_cfg: gpt.GPTConfig | None = None,
+                 draft_params=None, spec_k: int | None = None):
         self.params = params
         # telemetry (request tracing + latency histograms + gauges):
         # decided once at construction — per-tick records are lock-cheap
@@ -550,6 +603,73 @@ class DecodeServer:
         else:
             self._pool = None
             self.cache = generate.init_cache(cfg, max_batch, max_len)
+        # speculative decoding (draft-then-verify in the serving tick):
+        # spec_k > 0 turns speculation on — with (draft_cfg,
+        # draft_params) a small draft model proposes K-1 tokens per
+        # round (its KV state rides a twin cache pytree; under the
+        # paged layout the draft pool shares THE SAME allocator/table,
+        # so eviction/rollback frees both coherently), without a draft
+        # the server self-drafts via host n-gram lookup
+        # (generate.ngram_propose — zero extra model FLOPs).  Greedy
+        # output stays bit-identical to the non-speculative server;
+        # per-request rolling acceptance below PADDLE_TPU_SPEC_MIN_ACCEPT
+        # falls the slot back to plain decode.
+        if spec_k is not None:
+            k_spec = int(spec_k)
+        else:
+            k_spec = _flags.spec_k()
+            if k_spec == 0 and draft_cfg is not None:
+                k_spec = 4          # passing a draft model IS opting in
+        if k_spec < 0:
+            raise ValueError(f"spec_k must be >= 0, got {k_spec}")
+        if k_spec == 0 and draft_cfg is not None:
+            raise ValueError("draft_cfg given but spec_k=0 disables "
+                             "speculation — drop one or the other")
+        self._spec_k = k_spec
+        self._spec_on = k_spec > 0
+        self.draft_cfg = draft_cfg
+        self._draft_params = draft_params
+        self._draft_cache = None
+        self._self_draft = self._spec_on and draft_cfg is None
+        self._min_accept = _flags.spec_min_accept()
+        # server-level speculation accounting (load_stats / the
+        # acceptance-rate gauge / bench's target-passes-per-token)
+        self._spec_prop = 0         # proposals scored by the target
+        self._spec_acc = 0          # ... of those, accepted
+        self._spec_rounds = 0       # batched verify dispatches
+        self._spec_plain_steps = 0  # plain target steps while spec on
+        if self._spec_on:
+            window = min(max_len, cfg.max_seq_len)
+            if cfg.moe is not None or (draft_cfg is not None
+                                       and draft_cfg.moe is not None):
+                # speculative_generate's rule, enforced at BUILD (not
+                # first tick): chunked verify routes a chunk's tokens
+                # jointly through MoE capacity, stepwise decode routes
+                # them one at a time — the two are not bit-equal
+                raise NotImplementedError(
+                    "speculative serving requires dense models (MoE "
+                    "capacity routing differs between chunked verify "
+                    "and stepwise decode — speculative_generate's "
+                    "rule)")
+            if mesh is not None:
+                raise NotImplementedError(
+                    "speculative serving is not supported with "
+                    "tensor-parallel (mesh=) serving yet")
+            if not 1 <= k_spec < window:
+                raise ValueError(
+                    f"spec_k {k_spec} must be in [1, {window}) — the "
+                    f"verify chunk must fit the serving window")
+            if draft_cfg is not None:
+                if draft_params is None:
+                    raise ValueError("draft_cfg requires draft_params")
+                if draft_cfg.vocab_size != cfg.vocab_size:
+                    raise ValueError(
+                        f"draft vocab {draft_cfg.vocab_size} != target "
+                        f"vocab {cfg.vocab_size}")
+                if draft_cfg.max_seq_len < window:
+                    raise ValueError(
+                        f"draft max_seq_len {draft_cfg.max_seq_len} < "
+                        f"serving window {window}")
         # tensor-parallel decode INSIDE the server (round 9): with a
         # ``mesh``, params take the Megatron specs and every cache leaf
         # shards its Hkv axis over ``mp_axis`` (paged pool included, the
@@ -582,6 +702,27 @@ class DecodeServer:
             # placement joins every step-cache key (see _shard_key)
             self._shard = ("device", int(getattr(device, "id", 0)))
         self._step = _get_step_fn(cfg, self._paged, self._shard)
+        if self._spec_on and draft_cfg is not None:
+            if self._paged:
+                from . import kv_pool as _kv
+
+                # the draft pool mirrors the target pool's geometry
+                # (same block size, same block count, same nmax), so the
+                # ONE allocator + the one table leaf address both —
+                # target and draft positions advance in lockstep, and
+                # free_slot/eviction releases both pools' rows together
+                self._draft_cache = _kv.init_paged_cache(
+                    draft_cfg, max_batch, max_len,
+                    block_size=int(self.cache["k"].shape[2]),
+                    num_blocks=int(self.cache["k"].shape[1]))
+            else:
+                self._draft_cache = generate.init_cache(
+                    draft_cfg, max_batch, max_len)
+            if self._device is not None:
+                self._draft_params = jax.device_put(draft_params,
+                                                    self._device)
+                self._draft_cache = jax.device_put(self._draft_cache,
+                                                   self._device)
         # async_dispatch: keep ONE step/block in flight — tick() first
         # dispatches step N+1 (feeding the previous step's tokens from
         # the DEVICE array, never fetched) and only then blocks on step
@@ -972,6 +1113,9 @@ class DecodeServer:
                                 jnp.asarray(padded),
                                 jnp.asarray(i), jnp.asarray(len(chunk)),
                                 jnp.asarray(slot))
+                    if self._spec_on and self.draft_cfg is not None:
+                        st["spec_dpos"] = self._spec_draft_admit(req,
+                                                                 slot, n)
                     # one host fetch of the admission logits; the
                     # timestamp right after it bounds the DEVICE window
                     # (the sampling below is pure host math and must not
@@ -1045,6 +1189,10 @@ class DecodeServer:
                     self._free.append(slot)
                     self._tel_retire(st, slot)
                     continue
+            if self._spec_on and self.draft_cfg is not None:
+                # prefill=False admission: the draft saw nothing yet —
+                # the first spec round's catch-up feeds it the sequence
+                st.setdefault("spec_dpos", 0)
             self._slots[slot] = st
 
     # -- paged layout: allocator plumbing (text/kv_pool) --------------------
@@ -1072,6 +1220,13 @@ class DecodeServer:
             dst = jnp.asarray([p[1] for p in pairs + pad], jnp.int32)
             self.cache = _get_copy_fn(self.cfg, width, self._shard)(
                 self.cache, src, dst)
+            if self._draft_cache is not None:
+                # a COW'd block holds both pools' rows for its logical
+                # positions — the draft pool copies the same pairs so
+                # the shared table stays valid for both
+                self._draft_cache = _get_copy_fn(
+                    self.draft_cfg, width, self._shard)(
+                    self._draft_cache, src, dst)
         if self._pool.dirty:
             tables = jnp.asarray(self._pool.tables)
             if isinstance(self._shard, _ShardCtx):
@@ -1082,6 +1237,16 @@ class DecodeServer:
             elif self._device is not None:
                 tables = jax.device_put(tables, self._device)
             self.cache = dict(self.cache, tables=tables)
+            if self._draft_cache is not None:
+                # the draft pytree gets its OWN device buffer of the
+                # same host table: the two caches donate independently,
+                # and a shared array would be deleted out from under
+                # the draft the first time a target step donates it
+                dtables = jnp.asarray(self._pool.tables)
+                if self._device is not None:
+                    dtables = jax.device_put(dtables, self._device)
+                self._draft_cache = dict(self._draft_cache,
+                                         tables=dtables)
             self._pool.dirty = False
 
     def _ensure_decode_blocks(self, steps: int):
@@ -1166,6 +1331,21 @@ class DecodeServer:
                 jnp.asarray(s), jnp.asarray(len(chunk)),
                 jnp.asarray(slot))
             rows_done += len(chunk)
+        if self._draft_cache is not None:
+            # the draft cache walks the SAME starts through its own
+            # chunk executable: the shared table maps both pools, so an
+            # adopted prefix's draft rows are already valid (every
+            # admission on this server writes both caches before
+            # register_prefix) and the suffix fills here
+            dfn = _get_paged_prefill_fn(self.draft_cfg, C, self._shard)
+            for s in starts:
+                chunk = prompt[s:s + C]
+                padded = np.zeros((1, C), np.int32)
+                padded[0, :len(chunk)] = chunk
+                _, self._draft_cache = dfn(
+                    self._draft_params, self._draft_cache,
+                    jnp.asarray(padded), jnp.asarray(s),
+                    jnp.asarray(len(chunk)), jnp.asarray(slot))
         if self._tel:
             # rows actually prefilled — the repeated-prefix FLOPs saving
             # is (prompt length - this) per request
@@ -1225,6 +1405,366 @@ class DecodeServer:
     def pending(self) -> bool:
         return bool(self._slots or self._queue)
 
+    # -- speculative decoding: batched draft-then-verify rounds -------------
+
+    def _spec_limit(self) -> int:
+        """Highest position a spec round may reach: ``pos + K`` must stay
+        inside the cache rows, the target's wpe table, and (draft mode)
+        the draft's twins — ``dynamic_update_slice``/``dynamic_slice``
+        CLAMP out-of-range starts instead of failing, which would
+        silently shift the verify chunk's rows.  Near the window the
+        server just runs plain ticks (_spec_ready)."""
+        if self._paged:
+            rows = self._pool.nmax * self._pool.bs
+        else:
+            rows = int(self.cache["k"].shape[2])
+        lim = min(rows, self.cfg.max_seq_len)
+        if self._draft_cache is not None:
+            drows = (rows if self._paged
+                     else int(self._draft_cache["k"].shape[2]))
+            lim = min(lim, drows, self.draft_cfg.max_seq_len)
+        return lim
+
+    def _spec_ready(self) -> bool:
+        """Whether THIS tick can run as a speculative round: every slot
+        past its prompt (the verify chunk consumes feedback positions
+        only), every slot's ``pos + K`` inside :meth:`_spec_limit`, and
+        at least one slot still speculating (all fallen back = the
+        rounds are pure overhead)."""
+        if not self._spec_on or not self._slots:
+            return False
+        K = self._spec_k
+        lim = self._spec_limit()
+        alive = False
+        for st in self._slots.values():
+            if st["pos"] < len(st["prompt"]) - 1:
+                return False
+            if st["pos"] + K > lim:
+                return False
+            if not st.get("spec_off"):
+                alive = True
+        return alive
+
+    def _spec_rng(self, st):
+        """Per-request host RNG for the sampled spec path (proposal
+        draws + acceptance tests), seeded per rid off the server key —
+        disjoint from the per-step device schedule (fold_in(base, n))
+        and the admission draws (1 << 20 namespace)."""
+        if "spec_rng" not in st:
+            st["spec_rng"] = np.random.default_rng(generate._key_seed(
+                jax.random.fold_in(self._base_key,
+                                   (1 << 21) + st["rid"])))
+        return st["spec_rng"]
+
+    def _spec_draft_admit(self, req, slot, n) -> int:
+        """Admission-time draft prefill: fill the draft cache's rows
+        [0, n) for this slot so the first spec round drafts from
+        position ``n`` directly.  Paged admission already walked the
+        draft chunk executable inside ``_paged_prefill_slot`` (same
+        starts, same shared table).  Handoff-admitted requests
+        ("prefilled") carry TARGET rows only — the draft starts cold
+        (returns 0) and the first spec round's catch-up feeds it the
+        sequence with batched draft steps, still zero target passes."""
+        if "prefilled" in req:
+            return 0
+        if self._paged:
+            return n
+        if self._prefill_chunk is not None:
+            C = self._chunk
+            starts = ([0] if n <= C
+                      else list(range(0, n - C, C)) + [n - C])
+            dfn = _get_prefill_chunk_fn(self.draft_cfg, self._shard)
+            for i in starts:
+                chunk = req["prompt"][i:i + C]
+                padded = np.zeros((1, C), np.int32)
+                padded[0, :len(chunk)] = chunk
+                _, self._draft_cache = dfn(
+                    self._draft_params, self._draft_cache,
+                    jnp.asarray(padded), jnp.asarray(i),
+                    jnp.asarray(len(chunk)), jnp.asarray(slot))
+            return n
+        bucket = _pow2_bucket(n, self.max_len,
+                              self.draft_cfg.max_seq_len)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = req["prompt"]
+        _, self._draft_cache = _get_prefill_fn(
+            self.draft_cfg, bucket, self._shard)(
+            self._draft_params, self._draft_cache, jnp.asarray(padded),
+            jnp.asarray(n), jnp.asarray(slot))
+        return n
+
+    def _spec_draft_catchup(self):
+        """Advance every lagging slot's draft cache to its target
+        position with batched draft steps (``spec_dpos`` = rows the
+        draft has consumed).  Lag comes from handoff admission (draft
+        starts cold), prefill=False admission (the plain path fed the
+        prompt to the target only), and post-rejection rounds capping
+        dpos at the last drafted row.  Non-lagging slots ride along
+        fed their own feed token at their own pos — that row is
+        rewritten identically by the proposal steps, so the overwrite
+        is benign (the same argument covers shared paged blocks:
+        recomputed rows are a deterministic function of the same
+        tokens, hence bit-identical)."""
+        step = _get_step_fn(self.draft_cfg, self._paged, self._shard)
+        while True:
+            lag = [(slot, st) for slot, st in self._slots.items()
+                   if not st.get("spec_off")
+                   and st.get("spec_dpos", 0) < st["pos"]]
+            if not lag:
+                return
+            tok, pos = self._feed_arrays()
+            for slot, st in lag:
+                d = st["spec_dpos"]
+                np_ = len(st["prompt"])
+                base = st.get("base", np_)
+                tok[slot] = (st["prompt"][d] if d < np_
+                             else st["generated"][d - base])
+                pos[slot] = d
+            _, self._draft_cache = step(
+                self._draft_params, self._draft_cache,
+                jnp.asarray(tok), jnp.asarray(pos))
+            for slot, st in lag:
+                st["spec_dpos"] += 1
+
+    def _spec_propose_draft(self, K):
+        """K-1 batched draft steps from each slot's feed position: the
+        draft model's proposals for positions pos+1..pos+K-1 (the
+        verify chunk's columns 1..K-1) plus — for sampled slots — the
+        filtered proposal law q_j the acceptance test divides by.
+        Draft logits are fetched per step (host argmax/sampling); the
+        draft is the cheap model by construction and K is small.
+        Fallen-back slots ride along fed their feed token (their draft
+        rows go stale — benign, they never speculate again)."""
+        self._spec_draft_catchup()
+        step = _get_step_fn(self.draft_cfg, self._paged, self._shard)
+        tok, pos = self._feed_arrays()
+        temp, tk, tp = self._sampling_arrays()
+        eligible = {slot: st for slot, st in self._slots.items()
+                    if not st.get("spec_off")}
+        props = {slot: ([], [] if temp[slot] > 0 else None)
+                 for slot in eligible}
+        for _ in range(K - 1):
+            logits, self._draft_cache = step(
+                self._draft_params, self._draft_cache,
+                jnp.asarray(tok), jnp.asarray(pos))
+            lnp = np.asarray(logits)
+            for slot, st in eligible.items():
+                toks, qs = props[slot]
+                if qs is None:
+                    d = int(lnp[slot].argmax())
+                else:
+                    q = generate._filtered_probs(
+                        lnp[slot], float(temp[slot]), int(tk[slot]),
+                        float(tp[slot]))
+                    d = int(self._spec_rng(st).choice(len(q), p=q))
+                    qs.append(q)
+                toks.append(d)
+                tok[slot] = d
+            pos = pos + 1
+        if self._tel and eligible and K > 1:
+            _telemetry.count("spec.draft_steps", K - 1)
+        return props
+
+    def _spec_propose_ngram(self, K):
+        """Model-free self-drafting: propose the continuation that
+        followed the most recent earlier occurrence of the sequence's
+        current suffix (generate.ngram_propose — longest-match lookup,
+        pure host work, zero extra FLOPs).  Misses propose nothing:
+        the slot still takes row 0 of the shared verify step, exactly
+        one token — plain-decode behavior at plain-decode cost."""
+        props = {}
+        hits = miss = 0
+        for slot, st in self._slots.items():
+            if st.get("spec_off"):
+                continue
+            base = st.get("base", len(st["prompt"]))
+            seq = st["prompt"][:base] + st["generated"]
+            d = generate.ngram_propose(seq, K - 1) if K > 1 else None
+            if d:
+                props[slot] = (d, None)
+                hits += 1
+            else:
+                miss += 1
+        if self._tel:
+            if hits:
+                _telemetry.count("spec.ngram_hits", hits)
+            if miss:
+                _telemetry.count("spec.ngram_misses", miss)
+        return props
+
+    def _spec_accept(self, st, rows, prop):
+        """Resolve one slot's verify logits [K, V] against its proposal
+        -> the token list (1..K) this round appends.  Greedy: accept
+        the longest prefix where the target's argmax agrees with the
+        draft, append the target's own choice at the first disagreement
+        (the correction IS the plain-decode token), and on full
+        agreement keep the bonus row — every kept token equals what
+        stepwise greedy decode would produce at that position given the
+        same prefix, which is the bit-parity argument.  Sampled:
+        delegated rejection sampling (_spec_sampled_tokens)."""
+        draft, qs = prop if prop is not None else ([], None)
+        kk = len(draft)
+        if st.get("temperature", 0.0) > 0.0:
+            toks, accepted = self._spec_sampled_tokens(st, rows, draft,
+                                                       qs)
+        else:
+            tchoice = rows.argmax(axis=-1)
+            toks, accepted = [], 0
+            for j in range(kk):
+                t = int(tchoice[j])
+                toks.append(t)
+                if t != draft[j]:
+                    break
+                accepted += 1
+            else:
+                toks.append(int(tchoice[kk]))
+        if kk:
+            self._spec_prop += kk
+            self._spec_acc += accepted
+            st["spec_prop"] = st.get("spec_prop", 0) + kk
+            st["spec_acc"] = st.get("spec_acc", 0) + accepted
+            if self._tel:
+                _telemetry.count("spec.proposed", kk)
+                if accepted:
+                    _telemetry.count("spec.accepted", accepted)
+        return toks
+
+    def _spec_sampled_tokens(self, st, rows, draft, qs):
+        """Leviathan rejection sampling on one slot's verify rows:
+        accept draft x_j with prob min(1, p_j(x)/q_j(x)); the first
+        rejection resamples the residual (p - q)+ — self-draft's q is
+        the point mass at x, so the residual is p with p[x] zeroed —
+        and full acceptance draws the bonus row.  Marginals equal
+        plain sampled decode (speculative_generate's law;
+        test_speculative.py's chi-square, re-checked at batch>1 by the
+        serving tests)."""
+        t, tk, tp = st["temperature"], st["top_k"], st["top_p"]
+        rng = self._spec_rng(st)
+        toks, accepted = [], 0
+        for j, x in enumerate(draft):
+            p = generate._filtered_probs(rows[j], t, tk, tp)
+            qx = float(qs[j][x]) if qs is not None else 1.0
+            if float(rng.uniform()) < min(
+                    1.0, float(p[x]) / max(qx, 1e-300)):
+                toks.append(int(x))
+                accepted += 1
+                continue
+            if qs is not None:
+                resid = np.maximum(p - qs[j], 0.0)
+            else:
+                resid = p.copy()
+                resid[x] = 0.0
+            mass = float(resid.sum())
+            if mass > 0.0:
+                toks.append(int(rng.choice(len(resid),
+                                           p=resid / mass)))
+            else:
+                toks.append(int(rng.choice(len(p), p=p)))
+            break
+        else:
+            p = generate._filtered_probs(rows[len(draft)], t, tk, tp)
+            toks.append(int(rng.choice(len(p), p=p)))
+        return toks, accepted
+
+    def _spec_fallback_check(self, st):
+        """Acceptance-driven fallback: a slot whose rolling accept rate
+        sits below PADDLE_TPU_SPEC_MIN_ACCEPT after a fair trial stops
+        speculating (row-0-only rounds — still bit-correct, no longer
+        paying proposal work).  The window decays by halving so the
+        rate tracks the request's RECENT regime, not its whole
+        history."""
+        if st.get("spec_off") or not st.get("spec_prop"):
+            return
+        k = max(1, self._spec_k - 1)
+        if st["spec_prop"] >= 16 * k:
+            st["spec_prop"] //= 2
+            st["spec_acc"] //= 2
+        if st["spec_prop"] >= 4 * k \
+                and st["spec_acc"] / st["spec_prop"] < self._min_accept:
+            st["spec_off"] = True
+            if self._tel:
+                _telemetry.count("spec.fallbacks")
+
+    def _tick_spec(self):
+        """One speculative round: propose (host n-gram lookup or K-1
+        batched draft steps), ONE batched target verify over every
+        slot, host-side acceptance, retire.  The verify is the round's
+        only target pass — up to K tokens per slot for one pass, the
+        multiplier the spec bench arm measures.  Rejected verify rows
+        land at/past each slot's new position pointer where the
+        stale-row invariant already hides them (the same rule as
+        warmup garbage and slot reuse), so acceptance needs no masked
+        write and no rollback: after a rejection the next round's
+        writes start exactly at the first stale row."""
+        if self._inflight is not None:
+            # async servers run spec rounds synchronously: the pending
+            # dispatch's tokens are real work — fetch them first
+            self._drain_inflight()
+            if not self._slots:
+                return
+        t0 = time.perf_counter()
+        K = self._spec_k
+        # rows [pos, pos+K) per slot, BEFORE any state mutates: a
+        # PoolExhausted surfaces here and the OOM chain's retry re-runs
+        # the round bit-exactly (greedy) / unbiasedly (sampled)
+        self._ensure_decode_blocks(K)
+        if self._self_draft:
+            props = self._spec_propose_ngram(K)
+        else:
+            props = self._spec_propose_draft(K)
+        tok, pos = self._feed_arrays()
+        tok = np.repeat(tok[:, None], K, axis=1)
+        for slot, (draft, _) in props.items():
+            for j, d in enumerate(draft[:K - 1]):
+                tok[slot, j + 1] = d
+        kind = f"spec_verify@{K}"
+        self._fault_check(kind)
+        fn = _get_spec_verify_fn(self.cfg, K, self._paged, self._shard)
+        logits, self.cache = fn(self.params, self.cache,
+                                jnp.asarray(tok), jnp.asarray(pos))
+        self._step_no += 1   # after the call: see _tick_impl
+        self._spec_rounds += 1
+        lnp = np.asarray(logits)   # the round's ONE device->host fetch
+        failed = []
+        if self._resil and (_faults.active()
+                            or _os.environ.get(
+                                "PADDLE_TPU_NAN_GUARD_SERVING",
+                                "") == "1"):  # noqa: E129
+            if _faults.active():
+                lnp = _faults.corrupt_nan("logits", lnp)
+            finite = np.isfinite(lnp).all(axis=(-2, -1))
+            failed = [s for s in self._slots if not finite[s]]
+        done = []
+        appended = []
+        for slot, st in self._slots.items():
+            if slot in failed:
+                continue
+            toks = self._spec_accept(st, lnp[slot], props.get(slot))
+            old = st["pos"]
+            kept = 0
+            for t in toks:
+                st["generated"].append(t)
+                st["pos"] += 1
+                kept += 1
+                if self._finished(st, t):
+                    done.append(slot)
+                    break
+            appended.append((st, kept))
+            if self._draft_cache is not None \
+                    and not st.get("spec_off"):
+                # draft rows [old, old+K-1) were fed this round; the
+                # prefix fed ACCEPTED (real) tokens is valid through
+                # the new position, capped at the last drafted row —
+                # catch-up re-feeds anything past the cap next round
+                st["spec_dpos"] = min(st["pos"], old + K - 1)
+            self._spec_fallback_check(st)
+        for slot in failed:
+            st = self._slots.pop(slot)
+            self._fail_request(st, slot, "non-finite spec-verify logits")
+        steps = max([kept for _, kept in appended], default=1)
+        self._tel_tokens(appended, t0, steps=max(steps, 1), kind=kind)
+        self._retire(done)
+
     def close(self):
         """Release this server's compiled executables and KV cache.
 
@@ -1250,11 +1790,15 @@ class DecodeServer:
         if self.metrics_server is not None:
             self.metrics_server.close()   # joins the serve thread
             self.metrics_server = None
-        ck = generate._cfg_key(self.cfg)
+        cks = [generate._cfg_key(self.cfg)]
+        if self.draft_cfg is not None:
+            cks.append(generate._cfg_key(self.draft_cfg))
         for k in _STEP_CACHE.keys():
-            if k == ck or (isinstance(k, tuple) and ck in k):
+            if any(k == ck or (isinstance(k, tuple) and ck in k)
+                   for ck in cks):
                 _STEP_CACHE.pop(k)
         self.cache = None
+        self._draft_cache = None
         self._step = None
         self._prefill = None
         self._prefill_chunk = None
@@ -1355,6 +1899,11 @@ class DecodeServer:
             "kv_utilization": kv,
             "admit_cap": self._admit_cap,
             "wedged": self._wedged,
+            # server-wide rolling acceptance (None until the first
+            # proposal is scored) — the router's signal for whether
+            # this replica's speculation is paying for itself
+            "spec_accept_rate": ((self._spec_acc / self._spec_prop)
+                                 if self._spec_prop else None),
         }
 
     def drain_queue(self, rids=None) -> list:
@@ -1454,6 +2003,9 @@ class DecodeServer:
         _telemetry.set_gauge("serving.active_slots", len(self._slots))
         _telemetry.set_gauge("serving.slot_occupancy",
                              len(self._slots) / self.max_batch)
+        if self._spec_on and self._spec_prop:
+            _telemetry.set_gauge("serving.spec_accept_rate",
+                                 self._spec_acc / self._spec_prop)
         # kv_utilization = TRUE occupancy (round 8): under the paged
         # layout, blocks actually mapped / pool size; under contiguous,
         # filled rows / the slab's real (rounded) allocation — the old
@@ -1581,7 +2133,8 @@ class DecodeServer:
         buffers, so the OOM chain must fail fast instead."""
         try:
             return any(getattr(v, "is_deleted", lambda: False)()
-                       for v in (self.cache or {}).values())
+                       for c in (self.cache, self._draft_cache)
+                       if c is not None for v in c.values())
         except Exception:  # noqa: BLE001 - can't tell = don't retry
             return True
 
@@ -1737,6 +2290,19 @@ class DecodeServer:
         self._guarded(self._tick_impl)
 
     def _tick_impl(self):
+        if self._spec_on:
+            # speculative routing sits ABOVE the dispatch modes: a
+            # ready batch runs a draft-then-verify round (sync — async
+            # servers drain their in-flight step inside), anything
+            # else (prompt feeding, window edge, every slot fallen
+            # back) takes the plain path below unchanged
+            if not self._slots and not self._async:
+                self._admit()
+            if self._slots and self._spec_ready():
+                self._tick_spec()
+                return
+            if self._slots:
+                self._spec_plain_steps += 1
         if self._async:
             self._tick_async()
             return
@@ -2107,6 +2673,15 @@ class DecodeServer:
             self.cache = out[1]
             timings[name] = round(time.perf_counter() - t0, 3)
 
+        def warm_draft(name, thunk):
+            # the draft twin: reassigns the DRAFT cache (donation
+            # chains it through exactly like the target's)
+            t0 = time.perf_counter()
+            out = thunk()
+            jax.block_until_ready(out[0])
+            self._draft_cache = out[1]
+            timings[name] = round(time.perf_counter() - t0, 3)
+
         tok, pos = jnp.asarray(zi), jnp.asarray(zi)
         if self._async:
             fn = _get_async_step_fn(self.cfg, self._paged, self._shard)
@@ -2150,6 +2725,22 @@ class DecodeServer:
                         self.params, self.cache, tok, pos,
                         self._base_key, jnp.asarray(0), jnp.asarray(zf),
                         jnp.asarray(zi), jnp.asarray(of)))
+        if self._spec_on:
+            # the speculative round's executables: the batched verify
+            # (K garbage rows per slot at pos 0 — the same stale-row
+            # cover as the plain warm steps) and, in draft mode, the
+            # draft's own decode step
+            K = self._spec_k
+            sfn = _get_spec_verify_fn(self.cfg, K, self._paged,
+                                      self._shard)
+            tokK = jnp.zeros((B, K), jnp.int32)
+            warm(f"spec_verify@{K}", lambda: sfn(
+                self.params, self.cache, tokK, pos))
+            if self._draft_cache is not None:
+                dfn = _get_step_fn(self.draft_cfg, self._paged,
+                                   self._shard)
+                warm_draft("draft_step", lambda: dfn(
+                    self._draft_params, self._draft_cache, tok, pos))
         window = min(self.max_len, self.cfg.max_seq_len)
         if self._paged and self._prefill_on:
             # paged admission executables: one offset-aware chunk
@@ -2188,12 +2779,29 @@ class DecodeServer:
                 warm(f"paged_prefill{C}", lambda fn=fn, padded=padded: fn(
                     self.params, self.cache, padded, jnp.asarray(0),
                     jnp.asarray(1), jnp.asarray(0)))
+                if self._draft_cache is not None:
+                    dfn = _get_paged_prefill_fn(self.draft_cfg, C,
+                                                self._shard)
+                    warm_draft(f"draft_paged_prefill{C}",
+                               lambda dfn=dfn, padded=padded: dfn(
+                                   self._draft_params,
+                                   self._draft_cache, padded,
+                                   jnp.asarray(0), jnp.asarray(1),
+                                   jnp.asarray(0)))
         elif self._prefill_chunk is not None:
             C = self._chunk
             padded = jnp.zeros((1, C), jnp.int32)
             warm(f"prefill_chunk{C}", lambda: self._prefill_chunk(
                 self.params, self.cache, padded, jnp.asarray(0),
                 jnp.asarray(1), jnp.asarray(0)))
+            if self._draft_cache is not None:
+                dfn = _get_prefill_chunk_fn(self.draft_cfg,
+                                            self._shard)
+                warm_draft(f"draft_prefill_chunk{C}",
+                           lambda: dfn(self._draft_params,
+                                       self._draft_cache, padded,
+                                       jnp.asarray(0), jnp.asarray(1),
+                                       jnp.asarray(0)))
         elif self._prefill is not None:
             if prompt_lens is None:
                 buckets, b = [], 1
@@ -2210,6 +2818,14 @@ class DecodeServer:
                 warm(f"prefill{b}", lambda fn=fn, padded=padded: fn(
                     self.params, self.cache, padded, jnp.asarray(1),
                     jnp.asarray(0)))
+                if self._draft_cache is not None:
+                    dfn = _get_prefill_fn(self.draft_cfg, b,
+                                          self._shard)
+                    warm_draft(f"draft_prefill{b}",
+                               lambda dfn=dfn, padded=padded: dfn(
+                                   self._draft_params,
+                                   self._draft_cache, padded,
+                                   jnp.asarray(1), jnp.asarray(0)))
         return timings
 
     def tick_block(self, block: int = 8):
@@ -2227,6 +2843,26 @@ class DecodeServer:
         self._guarded(lambda: self._tick_block_impl(block))
 
     def _tick_block_impl(self, block: int):
+        if self._spec_on:
+            if not self._slots and not self._async:
+                self._admit()
+            if self._slots and self._spec_ready():
+                # a block of N plain steps yields N tokens/slot; spec
+                # rounds yield up to K each, so ceil(N/K) rounds covers
+                # the block's work with the same one-fetch-per-dispatch
+                # cadence (early exit when slots retire or the window
+                # edge forces plain ticks)
+                for _ in range(max(1, -(-block // self._spec_k))):
+                    if not self._slots or not self._spec_ready():
+                        break
+                    self._tick_spec()
+                return
+            if self._slots and not any(
+                    st["pos"] < len(st["prompt"]) - 1
+                    for st in self._slots.values()):
+                # the prompt-feeding case falls through to stepwise
+                # tick()s below, which count their own plain steps
+                self._spec_plain_steps += block
         if self._async:
             self._tick_block_async(block)
             return

@@ -1,0 +1,453 @@
+"""Speculative decoding in the continuous-batching server (round 11).
+
+The correctness property that matters: speculation is a THROUGHPUT
+optimization, never a semantics change — a greedy request served through
+batched draft-then-verify rounds must produce tokens bit-identical to
+the plain server, across every tick mode, cache layout, and KV dtype,
+and a sampled request's token law must stay exactly the target's
+filtered law (the Leviathan accept/residual rule).  Everything else —
+acceptance-driven fallback, OOM eviction of a speculating slot, the
+spec-K jit key — defends that property under production pressure.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import faults, flags
+from paddle_tpu import telemetry as tl
+from paddle_tpu.framework import monitor
+from paddle_tpu.text import generate as G
+from paddle_tpu.text import gpt, serving
+
+from test_speculative import _chi2, _second_token_law
+
+
+def _cfg(**over):
+    kw = dict(vocab_size=32, hidden_size=32, num_layers=2, num_heads=4,
+              max_seq_len=64)
+    kw.update(over)
+    return gpt.GPTConfig(**kw)
+
+
+def _count(name):
+    return int(monitor.get_stat(name).get())
+
+
+def _serve(params, cfg, prompts, max_new=8, block=0, **kw):
+    srv = serving.DecodeServer(params, cfg, **kw)
+    rids = [srv.submit(p, max_new_tokens=max_new) for p in prompts]
+    while srv.pending():
+        if block > 1:
+            srv.tick_block(block)
+        else:
+            srv.tick()
+    toks = [srv.result(r) for r in rids]
+    srv.close()
+    return toks
+
+
+def _biased_draft(params):
+    """A draft that proposes a CONSTANT token: biasing the final LN bias
+    toward one embedding row makes every logit row argmax to that token.
+    (Merely re-seeding the draft is NOT a bad draft: a random-init
+    tied-head GPT argmax-copies its input for any seed, so cross-seed
+    drafts agree with the target almost always.)"""
+    bad = dict(params)
+    bad["ln_f_b"] = params["ln_f_b"] + 50.0 * params["wte"][42]
+    return bad
+
+
+# ---------------------------------------------------------------------------
+# greedy bit-parity: spec server vs plain server
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("layout", ["contiguous", "paged"])
+@pytest.mark.parametrize("block", [0, 4])
+def test_spec_draft_greedy_parity(layout, block):
+    """Draft-model speculation across {contiguous, paged} x {tick,
+    tick_block} must be bit-identical to the plain server — variable
+    per-slot acceptance lands mid-round rejections as stale rows the
+    causal mask hides, and this is the assertion that proves it."""
+    cfg = _cfg()
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    prompts = [[int(x) for x in r]
+               for r in np.random.default_rng(0).integers(1, 30, (3, 5))]
+    kw = dict(max_batch=2, max_len=48, layout=layout)
+    if layout == "paged":
+        kw["block_size"] = 8
+    ref = _serve(params, cfg, prompts, block=block, **kw)
+    got = _serve(params, cfg, prompts, block=block,
+                 draft_cfg=cfg, draft_params=params, spec_k=4, **kw)
+    assert got == ref
+
+
+@pytest.mark.parametrize("layout", ["contiguous", "paged"])
+def test_spec_self_draft_greedy_parity(layout):
+    cfg = _cfg()
+    params = gpt.init_params(cfg, jax.random.PRNGKey(1))
+    prompts = [[5, 9, 5, 9, 5, 9], [int(x) for x in
+                np.random.default_rng(1).integers(1, 30, 7)]]
+    kw = dict(max_batch=2, max_len=48, layout=layout)
+    if layout == "paged":
+        kw["block_size"] = 8
+    ref = _serve(params, cfg, prompts, **kw)
+    got = _serve(params, cfg, prompts, spec_k=4, **kw)
+    assert got == ref
+
+
+def test_spec_async_dispatch_parity():
+    cfg = _cfg()
+    params = gpt.init_params(cfg, jax.random.PRNGKey(2))
+    prompts = [[int(x) for x in r]
+               for r in np.random.default_rng(2).integers(1, 30, (3, 4))]
+    ref = _serve(params, cfg, prompts, max_batch=2, max_len=48)
+    got = _serve(params, cfg, prompts, max_batch=2, max_len=48,
+                 draft_cfg=cfg, draft_params=params, spec_k=3,
+                 async_dispatch=True)
+    assert got == ref
+
+
+def test_spec_small_distinct_draft_parity(markov_gpt):
+    """A genuinely DIFFERENT (smaller) draft model: greedy output must
+    still be exactly the target's — the draft only changes how many
+    verify rounds it takes.  The markov target makes wrong-feed bugs
+    visible (its next token depends on the fed token)."""
+    cfg, params = markov_gpt
+    dcfg = gpt.GPTConfig(vocab_size=cfg.vocab_size, hidden_size=32,
+                         num_layers=1, num_heads=2,
+                         max_seq_len=cfg.max_seq_len)
+    dparams = gpt.init_params(dcfg, jax.random.PRNGKey(7))
+    prompts = [[int(x) for x in r]
+               for r in np.random.default_rng(3).integers(1, 13, (3, 5))]
+    ref = _serve(params, cfg, prompts, max_batch=2, max_len=32)
+    got = _serve(params, cfg, prompts, max_batch=2, max_len=32,
+                 draft_cfg=dcfg, draft_params=dparams, spec_k=3)
+    assert got == ref
+
+
+def test_spec_kv_dtype_parity(monkeypatch):
+    """int8 KV: the verify scatter goes through the quantized store —
+    spec and plain must agree in the SAME storage dtype."""
+    monkeypatch.setenv("PADDLE_TPU_KV_DTYPE", "int8")
+    cfg = _cfg()
+    params = gpt.init_params(cfg, jax.random.PRNGKey(3))
+    prompts = [[int(x) for x in r]
+               for r in np.random.default_rng(4).integers(1, 30, (2, 6))]
+    ref = _serve(params, cfg, prompts, max_batch=2, max_len=48)
+    got = _serve(params, cfg, prompts, max_batch=2, max_len=48,
+                 draft_cfg=cfg, draft_params=params, spec_k=4)
+    assert got == ref
+
+
+def test_spec_fewer_target_passes():
+    """The perf claim, counted: draft == target (full agreement) must
+    spend >= 1.5x fewer target passes per token than plain serving."""
+    cfg = _cfg()
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    prompts = [[int(x) for x in r]
+               for r in np.random.default_rng(5).integers(1, 30, (2, 5))]
+    plain = serving.DecodeServer(params, cfg, max_batch=2, max_len=48)
+    spec = serving.DecodeServer(params, cfg, max_batch=2, max_len=48,
+                                draft_cfg=cfg, draft_params=params,
+                                spec_k=4)
+    out = {}
+    for name, srv in (("plain", plain), ("spec", spec)):
+        rids = [srv.submit(p, max_new_tokens=12) for p in prompts]
+        while srv.pending():
+            srv.tick()
+        toks = [srv.result(r) for r in rids]
+        passes = (srv._spec_rounds + srv._spec_plain_steps
+                  if srv._spec_on else srv._step_no)
+        srv.close()
+        out[name] = (toks, passes)
+    assert out["spec"][0] == out["plain"][0]
+    assert out["plain"][1] >= 1.5 * out["spec"][1], out
+
+
+def test_spec_warmed_server_parity():
+    """warmup() must pre-build the spec executables (verify@K + draft
+    step) without perturbing the served tokens."""
+    cfg = _cfg()
+    params = gpt.init_params(cfg, jax.random.PRNGKey(4))
+    prompts = [[int(x) for x in r]
+               for r in np.random.default_rng(6).integers(1, 30, (2, 5))]
+    ref = _serve(params, cfg, prompts, max_batch=2, max_len=48)
+    srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=48,
+                               draft_cfg=cfg, draft_params=params,
+                               spec_k=4)
+    warmed = srv.warmup()
+    assert any("spec_verify" in k for k in warmed)
+    assert any("draft" in k for k in warmed)
+    rids = [srv.submit(p, max_new_tokens=8) for p in prompts]
+    while srv.pending():
+        srv.tick()
+    got = [srv.result(r) for r in rids]
+    srv.close()
+    assert got == ref
+
+
+# ---------------------------------------------------------------------------
+# sampling: the spec server's token law is exactly the target's
+# ---------------------------------------------------------------------------
+
+
+def _spec_second_token_counts(params, cfg, prompt, n, temperature,
+                              stranger=None, **srv_kw):
+    """n i.i.d. second-token draws from ONE spec server: every request
+    id folds its own PRNG streams (admission, device step, spec host
+    rng), so 200 submits to one server are 200 independent samples —
+    without paying 200 server constructions."""
+    srv = serving.DecodeServer(params, cfg, seed=77, **srv_kw)
+    rids = []
+    for _ in range(n):
+        rids.append(srv.submit(prompt, max_new_tokens=2,
+                               temperature=temperature))
+        if stranger is not None:
+            srv.submit(stranger, max_new_tokens=2,
+                       temperature=temperature)
+    while srv.pending():
+        srv.tick()
+    toks = [srv.result(r)[1] for r in rids]
+    srv.close()
+    return np.bincount(toks, minlength=cfg.vocab_size).astype(float)
+
+
+def test_spec_sampled_serving_follows_target_law():
+    """Chi-square at batch > 1: generated token #2 of a sampled request
+    served NEXT TO A STRANGER through spec verify rounds must follow the
+    exact two-step marginal of the target's filtered law — the
+    Leviathan accept/residual rule composed with per-slot batching."""
+    cfg = _cfg(vocab_size=12)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(9))
+    prompt = [4, 7]
+    n = 200
+    law = _second_token_law(params, cfg, prompt, 1.3, 0, 1.0)
+    counts = _spec_second_token_counts(
+        params, cfg, prompt, n, 1.3, stranger=[2, 9, 1], max_batch=4,
+        max_len=16, draft_cfg=cfg, draft_params=params, spec_k=3)
+    stat, df = _chi2(counts, law, n)
+    assert stat < 3 * max(df, 1) + 10, stat
+
+
+def test_spec_sampled_self_draft_follows_target_law():
+    """Self-drafting q is a point mass (qx == 1): acceptance prob is
+    min(1, p[x]) and the residual zeroes only x — the law must still be
+    exactly the target's."""
+    cfg = _cfg(vocab_size=12)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(9))
+    prompt = [4, 7, 4, 7]
+    n = 200
+    law = _second_token_law(params, cfg, prompt, 1.1, 0, 1.0)
+    counts = _spec_second_token_counts(
+        params, cfg, prompt, n, 1.1, max_batch=4, max_len=16, spec_k=3)
+    stat, df = _chi2(counts, law, n)
+    assert stat < 3 * max(df, 1) + 10, stat
+
+
+# ---------------------------------------------------------------------------
+# acceptance-driven fallback + telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_spec_fallback_on_bad_draft(monkeypatch):
+    """A draft proposing garbage must trip the per-request fallback
+    (spec.fallbacks counted, the slot reverts to plain stepping) and the
+    tokens must STILL be bit-identical — rejection handling is exact."""
+    monkeypatch.setenv("PADDLE_TPU_SPEC_MIN_ACCEPT", "0.6")
+    cfg = _cfg()
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    prompts = [[int(x) for x in r]
+               for r in np.random.default_rng(7).integers(1, 30, (2, 5))]
+    ref = _serve(params, cfg, prompts, max_new=16, max_batch=2,
+                 max_len=64)
+    f0 = _count("spec.fallbacks")
+    srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=64,
+                               draft_cfg=cfg,
+                               draft_params=_biased_draft(params),
+                               spec_k=4)
+    rids = [srv.submit(p, max_new_tokens=16) for p in prompts]
+    while srv.pending():
+        srv.tick()
+    got = [srv.result(r) for r in rids]
+    stats = srv.load_stats()
+    assert srv._spec_plain_steps > 0       # fallback actually stepped
+    srv.close()
+    assert got == ref
+    assert _count("spec.fallbacks") - f0 >= 2
+    assert stats["spec_accept_rate"] is not None
+    assert stats["spec_accept_rate"] < 0.6
+
+
+def test_spec_counters_and_accept_gauge():
+    if not tl.enabled():
+        pytest.skip("PADDLE_TPU_TELEMETRY=0")
+    cfg = _cfg()
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    p0, a0 = _count("spec.proposed"), _count("spec.accepted")
+    got = _serve(params, cfg, [[3, 5, 7, 9]], max_batch=1, max_len=48,
+                 draft_cfg=cfg, draft_params=params, spec_k=4)
+    assert len(got[0]) == 8
+    dp, da = _count("spec.proposed") - p0, _count("spec.accepted") - a0
+    assert dp >= 3 and da == dp            # draft == target: all accepted
+    snap = tl.snapshot()
+    assert snap["gauges"].get("serving.spec_accept_rate") == 1.0
+
+
+# ---------------------------------------------------------------------------
+# production pressure: OOM eviction, jit key, MoE guard
+# ---------------------------------------------------------------------------
+
+
+def test_spec_oom_evicts_speculating_slot(markov_gpt):
+    """Two consecutive tick OOMs on a SPECULATING sync server: the
+    eviction chain requeues mid-speculation slots (draft cache rows and
+    all) and carried-progress re-admission must re-feed exactly — the
+    markov model exposes any wrong-offset re-feed."""
+    cfg, params = markov_gpt
+    prompts = [[int(x) for x in r]
+               for r in np.random.default_rng(4).integers(1, 13, (3, 5))]
+    clean = _serve(params, cfg, prompts, max_new=6, max_batch=4,
+                   max_len=32)
+    tl.reset()
+    faults.install("oom:tick:2,oom:tick:3")
+    try:
+        srv = serving.DecodeServer(params, cfg, max_batch=4, max_len=32,
+                                   draft_cfg=cfg, draft_params=params,
+                                   spec_k=3)
+        rids = [srv.submit(p, max_new_tokens=6, priority=pr)
+                for p, pr in zip(prompts, (2, 1, 0))]
+        while srv.pending():
+            srv.tick()
+        assert [srv.result(r) for r in rids] == clean
+        srv.close()
+    finally:
+        faults.reset()
+    assert _count("resilience.oom_evictions") >= 1
+    assert _count("resilience.oom_retries") >= 1
+
+
+def test_spec_k_in_decode_jit_key(monkeypatch):
+    base = flags.decode_jit_key()
+    monkeypatch.setenv("PADDLE_TPU_SPEC_K", "6")
+    assert flags.decode_jit_key() != base
+    assert flags.spec_k() == 6
+
+
+def test_spec_verify_compile_recorded():
+    if not tl.enabled():
+        pytest.skip("PADDLE_TPU_TELEMETRY=0")
+    cfg = _cfg()
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    _serve(params, cfg, [[1, 2, 3]], max_batch=1, max_len=48,
+           draft_cfg=cfg, draft_params=params, spec_k=5)
+    names = [c["name"] for c in tl.snapshot()["compiles"]]
+    assert any(n.startswith("serving.spec_verify@5") for n in names)
+
+
+def test_spec_rejects_moe_and_bad_args():
+    from paddle_tpu.text.moe import MoEConfig
+
+    cfg = _cfg()
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    mcfg = _cfg(moe=MoEConfig(num_experts=4, top_k=2,
+                              capacity_factor=1.25, router_noise=0.0))
+    with pytest.raises(NotImplementedError):
+        serving.DecodeServer(gpt.init_params(mcfg, jax.random.PRNGKey(0)),
+                             mcfg, max_batch=1, max_len=32, spec_k=2)
+    with pytest.raises(ValueError):       # draft without K
+        serving.DecodeServer(params, cfg, max_batch=1, max_len=32,
+                             spec_k=0, draft_cfg=cfg, draft_params=params)
+    with pytest.raises(ValueError):       # draft cfg without params
+        serving.DecodeServer(params, cfg, max_batch=1, max_len=32,
+                             spec_k=2, draft_cfg=cfg)
+    with pytest.raises(ValueError):       # K must fit the window
+        serving.DecodeServer(params, cfg, max_batch=1, max_len=16,
+                             spec_k=16)
+
+
+# ---------------------------------------------------------------------------
+# self-drafting: host n-gram proposer
+# ---------------------------------------------------------------------------
+
+
+def test_ngram_propose_copies_continuation():
+    # trailing [5, 6] last occurred at index 1 — continuation is [7, 8]
+    assert G.ngram_propose([4, 5, 6, 7, 8, 5, 6], 2) == [7, 8]
+
+
+def test_ngram_propose_pads_short_hit():
+    # [1, 2] matched at index 1: continuation [7, 1, 2] is one token
+    # short of k=4 — padded by repeating the last copied token
+    assert G.ngram_propose([9, 1, 2, 7, 1, 2], 4) == [7, 1, 2, 2]
+
+
+def test_ngram_propose_misses_fresh_context():
+    assert G.ngram_propose([1, 2, 3, 4, 5], 3) is None
+    assert G.ngram_propose([7], 3) is None
+
+
+def test_ngram_propose_window_bounds_scan():
+    seq = [1, 2] + [9] * 300 + [1, 2]
+    assert G.ngram_propose(seq, 2, window=64) is None
+    assert G.ngram_propose(seq, 2, window=512) is not None
+
+
+# ---------------------------------------------------------------------------
+# concurrent router ticks (satellite) + lint
+# ---------------------------------------------------------------------------
+
+
+def test_router_concurrent_ticks_parity(monkeypatch):
+    """Replica ticks fanned out over the bounded thread pool must stay
+    bit-identical to sequential ticking — per-replica state is only
+    touched from its own tick call."""
+    from paddle_tpu.text import fleet
+
+    cfg = _cfg()
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    prompts = [[int(x) for x in r]
+               for r in np.random.default_rng(8).integers(1, 30, (6, 5))]
+
+    def fleet_run(workers):
+        monkeypatch.setenv("PADDLE_TPU_FLEET_TICK_WORKERS", str(workers))
+        router = fleet.Router(
+            [serving.DecodeServer(params, cfg, max_batch=2, max_len=48,
+                                  draft_cfg=cfg, draft_params=params,
+                                  spec_k=3)
+             for _ in range(3)])
+        assert router._tick_workers == workers
+        rids = [router.submit(p, max_new_tokens=6) for p in prompts]
+        while router.pending():
+            router.tick()
+        got = [router.result(r) for r in rids]
+        router.close()
+        return got
+
+    ref = _serve(params, cfg, prompts, max_new=6, max_batch=6,
+                 max_len=48)
+    assert fleet_run(1) == ref
+    assert fleet_run(4) == ref
+
+
+def test_spec_lint_catches_silent_accept():
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                    "tools"))
+    import check_instrumented as ci
+
+    bad = ("class S:\n"
+           "    def _spec_accept(self, rows):\n"
+           "        return rows.argmax()\n")
+    assert ci.scan_spec_source(bad)
+    good = ("class S:\n"
+            "    def _spec_fallback_check(self):\n"
+            "        count('spec.fallbacks')\n"
+            "    def _spec_accept_round(self):\n"
+            "        self._spec_fallback_check()\n")
+    assert not ci.scan_spec_source(good)
+    assert ci.scan_repo() == []

@@ -31,6 +31,14 @@ shed/evict/degrade/recover function on the serving path (name contains
 must contain a ``count(...)`` or ``set_runtime_wedge(...)`` call — a
 silent degradation path reads as healthy on every dashboard.
 
+Speculative-decoding lint (round 11, same rule family): every spec
+accept/propose/fallback path in ``text/serving.py`` (name contains
+``spec_accept``/``spec_propose``/``spec_fallback``) must count a
+``spec.*`` telemetry counter or delegate to another marker-named
+callable — the acceptance rate IS the signal that decides whether
+speculation pays for itself (the fallback knob, the bench arm, the
+router gauge), so an uncounted accept/reject path silently skews it.
+
 Usage: ``python tools/check_instrumented.py [repo_root]`` — exits 1 and
 lists ``file:line`` for every unrouted site.  ``tests/
 test_device_telemetry.py`` runs it in tier-1, so a dodge can't merge.
@@ -83,6 +91,16 @@ KV_MARKERS = ("alloc", "evict", "cow", "free")
 # while requests quietly vanish.
 FLEET_FILE = os.path.join("paddle_tpu", "text", "fleet.py")
 FLEET_MARKERS = ("route", "shed", "drain", "handoff")
+
+# Speculative-decoding lint (round 11, same rule family): every spec
+# accept/propose/fallback path in text/serving.py must count a spec.*
+# telemetry counter (directly, or by delegating to another marker-named
+# callable) — the acceptance rate drives the fallback knob, the bench
+# arm's passes-per-token, and the router's per-replica gauge, so a
+# silent accept/reject path skews the very signal that decides whether
+# speculation pays for itself.
+SPEC_FILE = os.path.join("paddle_tpu", "text", "serving.py")
+SPEC_MARKERS = ("spec_accept", "spec_propose", "spec_fallback")
 
 
 def _call_name(node: ast.Call):
@@ -213,6 +231,31 @@ def scan_fleet_source(src: str, filename: str = "<src>") -> list:
     return violations
 
 
+def scan_spec_source(src: str, filename: str = "<src>") -> list:
+    """Speculative-decoding lint violations in one source string: a
+    function whose name carries a :data:`SPEC_MARKERS` marker (a spec
+    accept/propose/fallback path) must contain a call to one of
+    :data:`COUNT_NAMES` or delegate to another marker-named callable."""
+    tree = ast.parse(src, filename=filename)
+    violations = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and any(m in node.name for m in SPEC_MARKERS)):
+            continue
+        counted = any(
+            isinstance(n, ast.Call)
+            and (_call_name(n) in COUNT_NAMES
+                 or any(m in (_call_name(n) or "") for m in SPEC_MARKERS))
+            for n in ast.walk(node))
+        if not counted:
+            violations.append(
+                (filename, node.lineno,
+                 f"speculative path {node.name}() records no telemetry "
+                 f"counter (count) — an uncounted accept/reject/fallback "
+                 f"skews the acceptance rate that gates speculation"))
+    return violations
+
+
 def _walk_py(path: str) -> list:
     out = []
     for dirpath, _, names in sorted(os.walk(path)):
@@ -264,6 +307,12 @@ def scan_repo(root: str | None = None) -> list:
         with open(fleet_path, encoding="utf-8") as f:
             violations.extend(scan_fleet_source(
                 f.read(), os.path.relpath(fleet_path, root)))
+    # speculative-decoding lint: accept/propose/fallback observability
+    spec_path = os.path.join(root, SPEC_FILE)
+    if os.path.exists(spec_path):
+        with open(spec_path, encoding="utf-8") as f:
+            violations.extend(scan_spec_source(
+                f.read(), os.path.relpath(spec_path, root)))
     return violations
 
 
