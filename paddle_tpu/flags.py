@@ -374,6 +374,30 @@ def spec_k() -> int:
     return k
 
 
+def prefill_budget() -> int:
+    """Per-scheduler-round admission prefill token budget
+    (``PADDLE_TPU_PREFILL_BUDGET``, default 0 = monolithic admission).
+    When > 0, ``DecodeServer`` admission becomes incremental: a
+    request's prefill advances at most this many tokens per scheduler
+    round, interleaved with decode steps, so a long-prompt admission
+    never stalls the decoding slots (Sarathi-style chunked-prefill
+    co-scheduling).  The budget is the chunk WIDTH of the admission
+    executables — a compiled shape — so the raw env string is part of
+    ``decode_jit_key``; flipping it mid-process retraces instead of
+    silently reusing the other width's program."""
+    v = os.environ.get("PADDLE_TPU_PREFILL_BUDGET", "0")
+    try:
+        b = int(v)
+    except ValueError:
+        raise ValueError(
+            f"PADDLE_TPU_PREFILL_BUDGET={v!r}: expected an integer >= 0 "
+            f"(0 keeps monolithic admission)")
+    if b < 0:
+        raise ValueError(
+            f"PADDLE_TPU_PREFILL_BUDGET={b}: must be >= 0")
+    return b
+
+
 def spec_min_accept() -> float:
     """Rolling per-request acceptance rate below which a speculating
     slot falls back to plain decode (``PADDLE_TPU_SPEC_MIN_ACCEPT``,
@@ -502,7 +526,10 @@ def decode_jit_key() -> tuple:
             kv_layout(), kv_block_size(),
             # speculative serving: K is baked into the batched verify
             # executable's shapes (tokens [B, K], logits [B, K, V])
-            os.environ.get("PADDLE_TPU_SPEC_K", ""))
+            os.environ.get("PADDLE_TPU_SPEC_K", ""),
+            # budgeted admission: the per-round prefill budget is the
+            # chunk width of the admission executables
+            os.environ.get("PADDLE_TPU_PREFILL_BUDGET", ""))
 
 
 if _ENV_SEEDED:

@@ -423,7 +423,16 @@ class Router:
     occupancy, then KV utilization (``DecodeServer.load_stats``).
     Prompts at or past ``prefill_threshold`` hand off to a prefill
     worker first; the returned rows inject via ``submit_prefilled``, so
-    the decode loop never runs a long prompt's prefill.  A replica whose
+    the decode loop never runs a long prompt's prefill.  The threshold
+    COMPOSES with the replicas' in-server prefill budget
+    (``PADDLE_TPU_PREFILL_BUDGET`` / ``DecodeServer(prefill_budget=)``):
+    the threshold picks WHERE a prompt's prefill FLOPs run (worker vs
+    replica), the budget bounds how much of a LOCAL admission a decode
+    round absorbs — a below-threshold long prompt (or any prompt with
+    workers absent/dead) co-schedules its prefill chunk-by-chunk
+    between the replica's decode steps instead of stalling them, so
+    the mixed-workload decode-gap bound holds with zero prefill
+    workers attached.  A replica whose
     wedge watchdog trips is DRAINED — its queued work re-routes to
     survivors (``fleet.reroutes``) while its active slots keep decoding
     through the round-7 recovery — and :meth:`healthz` aggregates
@@ -653,8 +662,13 @@ class Router:
                 0, self._max_queue - ls["queue_depth"])
             if cap <= 0:
                 continue
-            score = (ls["queue_depth"], ls["slot_occupancy"],
-                     ls["kv_utilization"], i)
+            # admitting_slots between depth and occupancy: a replica
+            # mid-(budgeted-)admission spends round budget on prefill
+            # chunks, so equal-depth ties prefer a replica with free
+            # admission headroom (all-zero when budgets are off —
+            # ordering unchanged)
+            score = (ls["queue_depth"], ls.get("admitting_slots", 0),
+                     ls["slot_occupancy"], ls["kv_utilization"], i)
             if best_score is None or score < best_score:
                 best, best_score = i, score
         return best

@@ -824,6 +824,24 @@ def prefill_slot(params, cache, tokens, length, slot, cfg: gpt.GPTConfig):
     return logits.astype(jnp.float32), cache
 
 
+def _chunk_pre_attn(x, p, pos0, cfg: gpt.GPTConfig):
+    """Pre-attention half of one block on a K-token chunk [B, K, D] at
+    positions [pos0, pos0+K): ln1 -> qkv projection (Hkv heads kept) ->
+    rope over the chunk's positions -> storage-dtype rows.  Returns
+    (q [B, K, H, hd], rows); :func:`_chunk_attend_block` and the batched
+    kernel verify routes (here and kv_pool) all project through this
+    one copy, so the chunk math can never drift between the einsum and
+    flash routes."""
+    K = x.shape[1]
+    q, k_new, v_new = gpt._project_qkv(
+        gpt._norm(x, p, "ln1", cfg), p, cfg, repeat_kv=False)
+    if cfg.pos_embed == "rope":
+        chunk_pos = pos0 + jnp.arange(K)
+        q = gpt.apply_rope(q, chunk_pos)
+        k_new = gpt.apply_rope(k_new, chunk_pos)
+    return q, _store_rows(k_new, v_new, cfg)
+
+
 def _chunk_attend_block(x, p, csl, pos0, cfg: gpt.GPTConfig,
                         valid=None):
     """One transformer block over a K-token chunk at positions
@@ -836,15 +854,8 @@ def _chunk_attend_block(x, p, csl, pos0, cfg: gpt.GPTConfig,
     chunk's rows at a shifted offset while the mask/positions still use
     pos0 (callers guarantee the bound; the serving walk overlaps its
     last window instead of overrunning).  Returns (x_out, rows)."""
-    B, K, D = x.shape
     dt = cfg.dtype
-    h = gpt._norm(x, p, "ln1", cfg)
-    q, k_new, v_new = gpt._project_qkv(h, p, cfg, repeat_kv=False)
-    if cfg.pos_embed == "rope":
-        chunk_pos = pos0 + jnp.arange(K)
-        q = gpt.apply_rope(q, chunk_pos)
-        k_new = gpt.apply_rope(k_new, chunk_pos)
-    rows = _store_rows(k_new, v_new, cfg)
+    q, rows = _chunk_pre_attn(x, p, pos0, cfg)
     full = {name: jax.lax.dynamic_update_slice(
                 csl[name], val, (0, pos0) + (0,) * (csl[name].ndim - 2))
             for name, val in rows.items()}
@@ -954,6 +965,96 @@ def verify_chunk(params, cache, tokens, pos0, cfg: gpt.GPTConfig):
     new_cache = _write_rows(cache, rows, pos0)
     x = gpt._norm(x, params, "ln_f", cfg)
     logits = woq.logits(x, params, dt)
+    return logits.astype(jnp.float32), new_cache
+
+
+def _write_rows_batched(cache: dict, rows: dict, pos) -> dict:
+    """Per-slot-offset form of :func:`_write_rows`: stacked chunk row
+    leaves [L, B, K, Hkv(, hd)] land at each slot's own positions
+    [pos_b, pos_b+K) (pos [B] int32) — the contiguous-layout write the
+    batched verify kernel route needs, since its slots sit at different
+    frontiers."""
+    out = {}
+    for name, val in rows.items():
+        arr = cache[name]
+
+        def one(arr_b, val_b, p0, _a=arr):
+            return jax.lax.dynamic_update_slice(
+                arr_b, val_b.astype(_a.dtype),
+                (0, p0) + (0,) * (arr_b.ndim - 2))
+
+        out[name] = jax.vmap(one, in_axes=(1, 1, 0), out_axes=1)(
+            arr, val, pos)
+    return out
+
+
+def verify_chunk_batched(params, cache, tokens, pos, cfg: gpt.GPTConfig):
+    """Batched :func:`verify_chunk` with the layer loop at TOP level so
+    the Tq>=1 flash-decode kernel sees the whole batch per layer (ONE
+    kernel launch over [B, K] query rows per block instead of a vmapped
+    per-slot einsum — the ROADMAP "flash-verify" item): tokens [B, K]
+    int32 scored at per-slot positions [pos_b, pos_b+K) ->
+    (logits [B, K, V] fp32, cache).
+
+    The per-slot pre/post math stays vmapped at the fallback's [1, K, D]
+    shapes (:func:`_chunk_pre_attn` — rope needs each slot's own
+    offsets); only the attention itself batches, with the fresh rows
+    spliced into each slot's cache slice BEFORE attending so the kernel
+    reads exactly what later rounds read back (splice-then-write, the
+    :func:`_chunk_attend_block` rule).  Callers gate on
+    :func:`_use_decode_kernel` at q [B, K, H, hd] — off-kernel the
+    vmapped einsum route stays the (bit-identical-to-decode) default."""
+    from ..ops import decode_attention as da
+
+    dt = cfg.dtype
+    B, K = tokens.shape
+    H, hd = cfg.num_heads, cfg.head_dim
+
+    def embed_one(tok_k, p0):
+        x = woq.embed(params, tok_k[None], dt)            # [1, K, D]
+        if cfg.pos_embed == "learned":
+            x = x + jax.lax.dynamic_slice(
+                params["wpe"], (p0, 0),
+                (K, cfg.hidden_size)).astype(dt)[None]
+        return x
+
+    x = jax.vmap(embed_one)(tokens, pos)                  # [B, 1, K, D]
+
+    def body(x, layer):
+        p, csl = layer                # csl leaves [B, T, Hkv(, hd)]
+
+        def pre(xb, p0):
+            return _chunk_pre_attn(xb, p, p0, cfg)
+
+        q3, rows = jax.vmap(pre)(x, pos)  # q3 [B, 1, K, H, hd]
+
+        def splice(arr_b, val_b, p0):
+            return jax.lax.dynamic_update_slice(
+                arr_b, val_b.astype(arr_b.dtype),
+                (p0,) + (0,) * (arr_b.ndim - 1))
+
+        full = {name: jax.vmap(splice)(csl[name], val[:, 0], pos)
+                for name, val in rows.items()}
+        attn = da.decode_attention(
+            q3.reshape(B, K, H, hd), full["k"], full["v"], pos,
+            k_scale=full.get("k_s"), v_scale=full.get("v_s"))
+        attn = attn.astype(dt).reshape(B, 1, K, H * hd)
+
+        def post(xb, ab):
+            return _block_post_attn(xb, ab, p, cfg)
+
+        return jax.vmap(post)(x, attn), rows
+
+    x, rows = jax.lax.scan(body, x, (params["blocks"], cache))
+    # rows leaves [L, B, 1, K, ...] -> per-slot offset write
+    new_cache = _write_rows_batched(
+        cache, {n: v[:, :, 0] for n, v in rows.items()}, pos)
+
+    def fin(xb):
+        xb = gpt._norm(xb, params, "ln_f", cfg)
+        return woq.logits(xb, params, dt)[0]              # [K, V]
+
+    logits = jax.vmap(fin)(x)
     return logits.astype(jnp.float32), new_cache
 
 

@@ -324,9 +324,19 @@ def paged_verify_chunk_batched(params, cache, tokens, pos, cfg):
     table; rejected rows land at/past the slot's position pointer where
     the causal mask hides them and the next round overwrites them (the
     stale-row invariant — no masked write needed).  Unmapped or
-    past-the-table entries drop (the standard out-of-bounds sink)."""
+    past-the-table entries drop (the standard out-of-bounds sink).
+
+    Kernel route (TPU / interpret, ``PADDLE_TPU_FLASH_DECODE``): the
+    layer loop moves to top level and ``paged_decode_attention`` streams
+    the whole batch at Tq=K — the ROADMAP "flash-verify" item."""
+    from ..ops import decode_attention as da
+
     N, bs, nmax = _geometry(cache)
     B, K = tokens.shape
+    if (_flags.flash_decode()
+            and da.paged_available((B, K, cfg.num_heads, cfg.head_dim),
+                                   cache["k"].shape[1:])):
+        return _paged_verify_kernel(params, cache, tokens, pos, cfg)
     tables = cache["tables"]
     pool = {n: cache[n] for n in POOL_LEAVES if n in cache}
     dt = cfg.dtype
@@ -363,6 +373,80 @@ def paged_verify_chunk_batched(params, cache, tokens, pos, cfg):
         v = jnp.moveaxis(v[:, :, 0], 0, 1)                # [L, B, K, ...]
         stacked[n] = v.reshape((v.shape[0], B * K) + v.shape[3:])
     return logits, _scatter_rows(cache, stacked, phys)
+
+
+def _paged_verify_kernel(params, cache, tokens, pos, cfg: gpt.GPTConfig):
+    """Kernel route of :func:`paged_verify_chunk_batched` — the
+    :func:`_paged_step_kernel` structure at Tq=K: layer loop at top
+    level so the paged kernel sees the whole batch per layer, per-slot
+    pre/post math vmapped at the fallback's [1, K, D] shapes
+    (``generate._chunk_pre_attn`` — rope needs per-slot offsets), and
+    the chunk's fresh rows scattered through the tables BEFORE attending
+    (scatter-then-attend == the fallback's splice-then-write; rejected
+    rows stay hidden behind the position pointer as ever)."""
+    from ..ops import decode_attention as da
+
+    N, bs, nmax = _geometry(cache)
+    B, K = tokens.shape
+    dt = cfg.dtype
+    H, hd = cfg.num_heads, cfg.head_dim
+    tables = cache["tables"]
+    pool = {n: cache[n] for n in POOL_LEAVES if n in cache}
+    L = cache["k"].shape[0]
+    logi = pos[:, None] + jnp.arange(K)[None, :]          # [B, K]
+    tb = jnp.take_along_axis(tables, jnp.clip(logi // bs, 0, nmax - 1),
+                             axis=1)
+    phys = jnp.where((tb >= 0) & (logi // bs < nmax),
+                     tb * bs + logi % bs, N * bs).reshape(B * K)
+
+    def embed_one(tok_k, p0):
+        x = woq.embed(params, tok_k[None], dt)            # [1, K, D]
+        if cfg.pos_embed == "learned":
+            x = x + jax.lax.dynamic_slice(
+                params["wpe"], (p0, 0),
+                (K, cfg.hidden_size)).astype(dt)[None]
+        return x
+
+    x = jax.vmap(embed_one)(tokens, pos)                  # [B, 1, K, D]
+
+    def body(carry, layer):
+        x, pool = carry
+        p, li = layer
+
+        def pre(xb, p0):
+            return generate._chunk_pre_attn(xb, p, p0, cfg)
+
+        q3, rows = jax.vmap(pre)(x, pos)  # q3 [B, 1, K, H, hd]
+        new_pool = {}
+        for n, val in rows.items():
+            arr = pool[n]
+            NR = arr.shape[1] * arr.shape[2]
+            flat = arr.reshape((arr.shape[0], NR) + arr.shape[3:])
+            v = val[:, 0].reshape((B * K,) + val.shape[3:])
+            flat = flat.at[li, phys].set(v.astype(arr.dtype), mode="drop")
+            new_pool[n] = flat.reshape(arr.shape)
+        pool = new_pool
+        attn = da.paged_decode_attention(
+            q3.reshape(B, K, H, hd), pool["k"][li], pool["v"][li],
+            tables, pos,
+            k_scale=pool["k_s"][li] if "k_s" in pool else None,
+            v_scale=pool["v_s"][li] if "v_s" in pool else None)
+        attn = attn.astype(dt).reshape(B, 1, K, H * hd)
+
+        def post(xb, ab):
+            return generate._block_post_attn(xb, ab, p, cfg)
+
+        return (jax.vmap(post)(x, attn), pool), None
+
+    (x, pool), _ = jax.lax.scan(
+        body, (x, pool), (params["blocks"], jnp.arange(L)))
+
+    def fin(xb):
+        xb = gpt._norm(xb, params, "ln_f", cfg)
+        return woq.logits(xb, params, dt)[0]              # [K, V]
+
+    logits = jax.vmap(fin)(x)
+    return logits.astype(jnp.float32), dict(cache, **pool)
 
 
 def inject_rows(cache: dict, rows: dict, start, length, slot) -> dict:

@@ -231,11 +231,21 @@ def _get_prefill_fn(cfg: gpt.GPTConfig, bucket: int, shard=None):
     return fn
 
 
-def _get_prefill_chunk_fn(cfg: gpt.GPTConfig, shard=None):
-    k = ("prefill_chunk", generate._cfg_key(cfg), _shard_key(shard))
+def _get_prefill_chunk_fn(cfg: gpt.GPTConfig, shard=None,
+                          width: int | None = None):
+    """Contiguous fixed-chunk admission step.  ``width=None`` keeps the
+    legacy key (the server's configured ``prefill_chunk`` width — the
+    jit retraces per chunk shape under that one name); an explicit
+    ``width`` (budgeted admission: the per-round prefill budget) keys
+    and names the wrapper per width, so the recompile watch joins each
+    budget's compiles to walls of the SAME width."""
+    k = ("prefill_chunk", generate._cfg_key(cfg), _shard_key(shard),
+         None if width is None else int(width))
     fn = _STEP_CACHE.get(k)
     if fn is None:
-        fn = generate._watch_jit("serving.prefill_chunk", k, jax.jit(
+        name = ("serving.prefill_chunk" if width is None
+                else f"serving.prefill_chunk@{int(width)}")
+        fn = generate._watch_jit(name, k, jax.jit(
             lambda p, c, t, p0, ln, sl, _cfg=cfg:
             generate.prefill_slot_chunk(p, c, t, p0, ln, sl, _cfg),
             donate_argnums=generate._donate_cache(),
@@ -450,8 +460,12 @@ def spec_verify_batched(params, cache, tokens, pos, cfg: gpt.GPTConfig):
 
     Contiguous: vmap of ``generate.verify_chunk`` per slot — the
     offline speculative path's exact math at decode_step_batched's
-    batching shapes.  Paged (a ``tables`` leaf): the block-table twin
-    ``kv_pool.paged_verify_chunk_batched``.  Either way the chunk's K
+    batching shapes — or, when the flash-decode flag + shape gate allow
+    it, ``generate.verify_chunk_batched`` (layer loop at top level, one
+    Tq=K kernel launch per block — the ROADMAP "flash-verify" item).
+    Paged (a ``tables`` leaf): the block-table twin
+    ``kv_pool.paged_verify_chunk_batched`` (which routes to its own
+    kernel form under the same gate).  Either way the chunk's K
     cache rows are written unconditionally: rejected rows sit at/past
     the slot's position pointer where the causal mask hides them and
     the next round overwrites them (the stale-row invariant the whole
@@ -461,6 +475,12 @@ def spec_verify_batched(params, cache, tokens, pos, cfg: gpt.GPTConfig):
 
         return kv_pool.paged_verify_chunk_batched(params, cache, tokens,
                                                   pos, cfg)
+    B, K = tokens.shape
+    if generate._use_decode_kernel(
+            cfg, (B, K, cfg.num_heads, cfg.head_dim),
+            cache["k"].shape[1:]):
+        return generate.verify_chunk_batched(params, cache, tokens, pos,
+                                             cfg)
 
     def one(tok, csl, p):
         sl = {name: v[:, None] for name, v in csl.items()}
@@ -559,7 +579,8 @@ class DecodeServer:
                  mesh=None, mp_axis: str = "mp",
                  device=None,
                  draft_cfg: gpt.GPTConfig | None = None,
-                 draft_params=None, spec_k: int | None = None):
+                 draft_params=None, spec_k: int | None = None,
+                 prefill_budget: int | None = None):
         self.params = params
         # telemetry (request tracing + latency histograms + gauges):
         # decided once at construction — per-tick records are lock-cheap
@@ -783,6 +804,35 @@ class DecodeServer:
         self._prefill_chunk = (_get_prefill_chunk_fn(cfg, self._shard)
                                if prefill and self._chunk
                                and not self._paged else None)
+        # budgeted admission (stall-free continuous batching,
+        # Sarathi-style chunked-prefill co-scheduling): prefill_budget=N
+        # (or PADDLE_TPU_PREFILL_BUDGET) caps the prefill tokens any ONE
+        # scheduler round may run.  Admission then only CLAIMS a slot
+        # (state "admitting") and each round advances the oldest
+        # admitting slot by one budget-wide chunk, interleaved with the
+        # decode step — a 4k-token prompt no longer freezes every
+        # decoding request for its whole prefill.  The budget is the
+        # chunk width of the admission executables (contiguous:
+        # prefill_slot_chunk at width N; paged: paged_prefill_chunk at
+        # width N — the offset-aware resume-at-pos0 machinery), so it
+        # rides decode_jit_key.  Greedy tokens are bit-identical to
+        # monolithic admission: chunked prefill is exact math (the paged
+        # layout ALWAYS admits chunked), only the host schedule changes.
+        # When > 0 it supersedes the prefill/prefill_chunk admission
+        # modes above; prefilled handoffs (submit_prefilled) stay
+        # monolithic — injection is one cheap row-write, not a prefill.
+        if prefill_budget is not None:
+            b = int(prefill_budget)
+            if b < 0:
+                raise ValueError(
+                    f"prefill_budget must be >= 0, got {b}")
+            if b > 0 and not prefill:
+                raise ValueError(
+                    "prefill_budget requires prefill=True (budgeted "
+                    "admission IS a prefill mode)")
+        else:
+            b = _flags.prefill_budget() if prefill else 0
+        self._budget = min(b, min(max_len, cfg.max_seq_len)) if b else 0
         # per-slot host state
         self._free = list(range(max_batch))
         self._slots: dict[int, dict] = {}        # slot -> request state
@@ -790,6 +840,11 @@ class DecodeServer:
         self._results: dict[int, list] = {}
         self._dropped: set[int] = set()          # rids abandoned by close()
         self._next_rid = 0
+        # decode-gap probe (the stall the budget exists to kill): host
+        # timestamp of the last tick that appended decode tokens; the
+        # next appending tick observes the gap as serving.decode_gap_ms.
+        # None while idle — an empty server's first tick is not a stall.
+        self._gap_anchor: float | None = None
         # resilience layer (PADDLE_TPU_RESILIENCE=0 restores fail-fast):
         # per-request deadlines shed expired queued work, an OOM on a
         # tick engages the degradation chain (drop to sync dispatch ->
@@ -1032,6 +1087,21 @@ class DecodeServer:
                 _telemetry.observe(
                     "serving.queue_wait_ms",
                     (t_admit - st["t_submit"]) * 1e3)
+            if self._budget and "prefilled" not in req \
+                    and len(req["prompt"]) > self._budget:
+                # budgeted admission: claim the slot NOW (plan the chunk
+                # starts, paged: adopt + allocate) but run ZERO prefill —
+                # each scheduler round advances the oldest admitting slot
+                # by one budget-width chunk (_advance_admitting),
+                # interleaved with decode steps, so a long prompt never
+                # stalls the decode loop.  Prompts that fit one chunk
+                # take the monolithic path below: one executable call
+                # either way, and admission-tick latency stays minimal.
+                # Handoff-admitted requests ("prefilled") stay monolithic
+                # too — their rows arrive computed; injection is a copy
+                if not self._claim_admitting(req, slot, st):
+                    break
+                continue
             if "prefilled" in req or self._prefill is not None \
                     or self._prefill_chunk is not None \
                     or (self._paged and self._prefill_on):
@@ -1194,6 +1264,195 @@ class DecodeServer:
                 # the first spec round's catch-up feeds it the sequence
                 st.setdefault("spec_dpos", 0)
             self._slots[slot] = st
+
+    # -- budgeted admission: chunked-prefill co-scheduling ------------------
+
+    def _claim_admitting(self, req, slot, st) -> bool:
+        """Budgeted admission, part 1 (claim): reserve the slot and plan
+        the prompt's chunk starts WITHOUT running any prefill.  The
+        starts follow the monolithic walks exactly — contiguous: the
+        fixed-chunk rule at width=budget; paged: adopt the longest
+        indexed prefix first, then the suffix rule of
+        ``_paged_prefill_slot`` — so a budgeted admission writes the
+        same rows through the same offset-aware executables, just
+        spread over scheduler rounds.  Paged block allocation happens
+        here in full (rows [min(starts), n)): the decode steps the slot
+        rides during admission write its frontier row, which must
+        already be mapped.  A PoolExhausted parks the request back at
+        the queue front exactly like monolithic admission.
+
+        Returns False when admission must stop (request parked)."""
+        prompt = req["prompt"]
+        n = len(prompt)
+        window = min(self.max_len, self.cfg.max_seq_len)
+        W = min(self._budget, window)
+        if self._paged:
+            from . import kv_pool as _kv
+
+            alloc = self._pool
+            try:
+                shared = alloc.adopt_prefix(slot, prompt) \
+                    if self._prefill_on else 0
+                if n - shared <= W:
+                    starts = [shared if shared + W <= window
+                              else max(0, n - W)]
+                else:
+                    starts = list(range(shared, n - W, W)) + [n - W]
+                while True:
+                    try:
+                        alloc.ensure_rows(slot, min(starts), n)
+                        break
+                    except _kv.PoolExhausted:
+                        # the OOM chain's first rung at admission (see
+                        # _paged_prefill_slot)
+                        if alloc.evict_cold(
+                                max_entries=_EVICT_BATCH) == 0:
+                            raise
+            except _kv.PoolExhausted:
+                self._pool.free_slot(slot)
+                self._free.append(slot)
+                self._queue.insert(0, req)
+                if self._tel:
+                    _telemetry.count("kv_pool.admit_blocked")
+                return False
+            self._apply_pool_ops()
+        else:
+            starts = ([0] if n <= W
+                      else list(range(0, n - W, W)) + [n - W])
+        st["admitting"] = True
+        st["admit_starts"] = starts
+        st["admit_i"] = 0
+        # pos doubles as the prefill frontier: rows [starts[0], pos)
+        # are written.  While admitting, decode dispatches feed
+        # prompt[pos] at pos — the frontier row they write is rewritten
+        # (bit-identically, by chunk contiguity) by the next chunk, the
+        # same stale-row argument as spec catch-up rides
+        st["pos"] = starts[0]
+        self._slots[slot] = st
+        if self._tel:
+            _telemetry.count("serving.admitting_claims")
+        return True
+
+    def _advance_admitting(self) -> bool:
+        """Budgeted admission, part 2 (advance): run ONE budget-width
+        prefill chunk for the OLDEST admitting slot (dict order =
+        claim order), then return — at most ``budget`` prefill tokens
+        per scheduler round, the decode ticks interleaving in between.
+        The last chunk graduates the slot to decoding.
+
+        Host state (admit_i, pos) advances only AFTER the executable
+        returned, so a failed call (real or injected OOM) leaves the
+        slot exactly as before and the guard's retry re-runs the same
+        chunk bit-exactly.  Returns True when a chunk ran."""
+        slot = st = None
+        for s_, st_ in self._slots.items():
+            if st_.get("admitting"):
+                slot, st = s_, st_
+                break
+        if st is None:
+            return False
+        t0 = time.perf_counter()
+        prompt = st["prompt"]
+        n = len(prompt)
+        window = min(self.max_len, self.cfg.max_seq_len)
+        W = min(self._budget, window)
+        i = st["admit_i"]
+        s = st["admit_starts"][i]
+        chunk = prompt[s:s + W]
+        padded = np.zeros((1, W), np.int32)
+        padded[0, :len(chunk)] = chunk
+        if self._paged:
+            kind = f"paged_prefill@{W}"
+            fn = _get_paged_prefill_fn(self.cfg, W, self._shard)
+        else:
+            kind = f"prefill_chunk@{W}"
+            fn = _get_prefill_chunk_fn(self.cfg, self._shard, width=W)
+        logits, self.cache = fn(
+            self.params, self.cache, jnp.asarray(padded),
+            jnp.asarray(s), jnp.asarray(len(chunk)), jnp.asarray(slot))
+        if self._draft_cache is not None:
+            # the draft twin walks the SAME chunk (the budgeted version
+            # of _spec_draft_admit / _paged_prefill_slot's draft walk),
+            # so graduation can set spec_dpos = n directly
+            dfn = (_get_paged_prefill_fn(self.draft_cfg, W, self._shard)
+                   if self._paged else
+                   _get_prefill_chunk_fn(self.draft_cfg, self._shard,
+                                         width=W))
+            _, self._draft_cache = dfn(
+                self._draft_params, self._draft_cache,
+                jnp.asarray(padded), jnp.asarray(s),
+                jnp.asarray(len(chunk)), jnp.asarray(slot))
+        st["admit_i"] = i + 1
+        st["pos"] = min(s + len(chunk), n)
+        if self._tel:
+            _telemetry.count("serving.prefill_chunks_interleaved")
+            if self._paged:
+                _telemetry.count("kv_pool.prefill_rows", len(chunk))
+        if st["admit_i"] == len(st["admit_starts"]):
+            self._graduate_admitting(slot, st, logits, t0, kind)
+        return True
+
+    def _graduate_admitting(self, slot, st, logits, t0, kind):
+        """The last chunk landed: fetch the admission logits, draw the
+        first token (the SAME per-rid host sampling as monolithic
+        admission — bit-identical by construction), and flip the slot
+        to decoding.  Paged: the completed prompt's blocks index for
+        future prefix sharing, exactly where monolithic admission
+        registers them."""
+        prompt = st["prompt"]
+        n = len(prompt)
+        logits_np = np.asarray(logits)
+        t_fetch = time.perf_counter()
+        if _faults.active():
+            logits_np = _faults.corrupt_nan("logits", logits_np)
+        if self._resil and not np.isfinite(logits_np).all():
+            # NaN guard at graduation — the budgeted twin of the
+            # monolithic admission guard (same fetch, same cost)
+            del self._slots[slot]
+            self._fail_request(st, slot, "non-finite prefill logits")
+            return
+        if st["temperature"] > 0.0:
+            p = generate._filtered_probs(
+                logits_np, st["temperature"], st["top_k"], st["top_p"])
+            rng = np.random.default_rng(generate._key_seed(
+                jax.random.fold_in(self._base_key,
+                                   (1 << 20) + st["rid"])))
+            t = int(rng.choice(len(p), p=p))
+        else:
+            t = int(logits_np.argmax())
+        st["generated"].append(t)
+        st["pos"] = n
+        st.pop("admitting", None)
+        st.pop("admit_starts", None)
+        st.pop("admit_i", None)
+        if self._paged and self._prefill_on:
+            self._pool.register_prefix(slot, prompt)
+        if self._spec_on and self.draft_cfg is not None:
+            # draft chunks advanced in lockstep (see _advance_admitting);
+            # without a draft cache the catch-up feeds from 0
+            st["spec_dpos"] = n if self._draft_cache is not None else 0
+        if self._tel:
+            now = time.perf_counter()
+            st["t_first"] = st["t_last"] = now
+            _telemetry.observe("serving.ttft_ms",
+                               (now - st["t_submit"]) * 1e3)
+            _telemetry.event("serving.prefill",
+                             st.get("t_admit", t0), now, tid=slot,
+                             rid=st["rid"], prompt_len=n)
+            # only the FINAL chunk's wall is fetch-bounded (earlier
+            # chunks dispatch without a sync), so per-execution timing
+            # covers exactly this one execution
+            _telemetry.note_step_time(f"serving.{kind}", t_fetch - t0)
+            _telemetry.count("serving.tokens_generated")
+        if self._finished(st, t):
+            # carried (OOM-evicted) requests may hit their budget on
+            # the admission token, exactly like monolithic admission
+            del self._slots[slot]
+            self._results[st["rid"]] = st["generated"]
+            if self._paged:
+                self._pool.free_slot(slot)
+            self._free.append(slot)
+            self._tel_retire(st, slot)
 
     # -- paged layout: allocator plumbing (text/kv_pool) --------------------
 
@@ -1437,7 +1696,10 @@ class DecodeServer:
         lim = self._spec_limit()
         alive = False
         for st in self._slots.values():
-            if st["pos"] < len(st["prompt"]) - 1:
+            # a mid-admission slot counts as prompt-feeding: its pos is
+            # the prefill frontier (possibly n-1), not a feedback
+            # position — spec rounds wait for graduation
+            if st.get("admitting") or st["pos"] < len(st["prompt"]) - 1:
                 return False
             if st["pos"] + K > lim:
                 return False
@@ -1899,6 +2161,13 @@ class DecodeServer:
             "kv_utilization": kv,
             "admit_cap": self._admit_cap,
             "wedged": self._wedged,
+            # budgeted admission: slots mid-prefill (their chunks eat
+            # round budget) and the configured budget itself — a router
+            # can prefer replicas with admission headroom
+            "admitting_slots": sum(
+                1 for st in self._slots.values()
+                if st.get("admitting")),
+            "prefill_budget": self._budget,
             # server-wide rolling acceptance (None until the first
             # proposal is scored) — the router's signal for whether
             # this replica's speculation is paying for itself
@@ -1968,7 +2237,10 @@ class DecodeServer:
         tk = np.zeros((self.max_batch,), np.int32)
         tp = np.ones((self.max_batch,), np.float32)
         for slot, st in self._slots.items():
-            if st["pos"] >= len(st["prompt"]) - 1:
+            # admitting slots sample nothing: their frontier may sit at
+            # n-1 but the step's output there is never kept
+            if st["pos"] >= len(st["prompt"]) - 1 \
+                    and not st.get("admitting"):
                 temp[slot] = st["temperature"]
                 tk[slot] = st["top_k"]
                 tp[slot] = st["top_p"]
@@ -2003,6 +2275,10 @@ class DecodeServer:
         _telemetry.set_gauge("serving.active_slots", len(self._slots))
         _telemetry.set_gauge("serving.slot_occupancy",
                              len(self._slots) / self.max_batch)
+        _telemetry.set_gauge(
+            "serving.admitting_slots",
+            sum(1 for st in self._slots.values()
+                if st.get("admitting")))
         if self._spec_on and self._spec_prop:
             _telemetry.set_gauge("serving.spec_accept_rate",
                                  self._spec_acc / self._spec_prop)
@@ -2057,6 +2333,17 @@ class DecodeServer:
         _telemetry.observe("serving.tick_ms", dt_ms)
         if kind is not None:
             _telemetry.note_step_time(f"serving.{kind}", dt_ms / 1e3)
+        if appended:
+            # decode-gap: wall time between consecutive rounds that
+            # appended decode tokens — THE stall metric budgeted
+            # admission exists to bound (a monolithic long-prompt
+            # admission shows up as one huge gap here).  The anchor
+            # resets to None on idle returns so a quiet queue doesn't
+            # masquerade as a stall.
+            if self._gap_anchor is not None:
+                _telemetry.observe("serving.decode_gap_ms",
+                                   (now - self._gap_anchor) * 1e3)
+            self._gap_anchor = now
         if not appended:
             return
         total = 0
@@ -2309,7 +2596,14 @@ class DecodeServer:
         if not self._slots:
             self._admit()
             if not self._slots:
+                self._gap_anchor = None   # idle, not stalled
                 return
+        # budgeted admission: at most ONE prefill chunk per round,
+        # before the decode step — the stall-free interleaving
+        self._advance_admitting()
+        if not self._slots or all(st.get("admitting")
+                                  for st in self._slots.values()):
+            return   # nothing decodable this round (pure admission)
         t0 = time.perf_counter()
         self._ensure_decode_blocks(1)
         tok, pos = self._feed_arrays()
@@ -2354,6 +2648,12 @@ class DecodeServer:
         failed = []
         appended = []
         for slot, st in self._slots.items():
+            if st.get("admitting"):
+                # rode the step at its prefill frontier: pos is owned by
+                # the admission machinery, the output token discarded,
+                # and a (mathematically valid, differently-rounded)
+                # logits row must not trip the NaN guard collaterally
+                continue
             i = st["pos"]
             st["pos"] = i + 1
             if i < len(st["prompt"]) - 1:
@@ -2401,6 +2701,15 @@ class DecodeServer:
             i = st["pos"]
             n_p = len(st["prompt"])
             base = st.get("base", n_p)   # see _feed_arrays
+            if st.get("admitting"):
+                # mid-admission ride: feed the prefill frontier (the
+                # written row is rewritten by the slot's next chunk).
+                # NO snap entry and NO pos advance — the admission
+                # machinery owns this slot's pos, its dispatch output
+                # is never kept, and rollback/cancel must not touch it
+                ht[slot] = st["prompt"][i]
+                pos[slot] = i
+                continue
             if i < n_p:
                 ht[slot] = st["prompt"][i]
             elif i - base < len(st["generated"]):
@@ -2570,13 +2879,30 @@ class DecodeServer:
         if not self._slots:
             self._admit()
             if not self._slots:
+                self._gap_anchor = None
                 return
+        try:
+            # one prefill chunk per round, before the dispatch (the
+            # chunk chains on the in-flight step's cache future; device
+            # order is step-then-chunk, so the frontier row the step
+            # wrote is rewritten before anything attends it)
+            self._advance_admitting()
+        except Exception:
+            # the chunk failed before any host state moved: restore
+            # prev (its tokens are still fetchable) so the OOM chain's
+            # sync fallback can drain it instead of losing a step
+            self._inflight = prev
+            raise
+        if not self._slots or all(st.get("admitting")
+                                  for st in self._slots.values()):
+            if prev is not None:
+                self._process_inflight(prev)
+            return
         try:
             self._dispatch_step_async(prev)
         except Exception:
             # the dispatch failed before replacing the pipeline: restore
-            # prev (its tokens are still fetchable) so the OOM chain's
-            # sync fallback can drain it instead of losing a step
+            # prev (see above)
             self._inflight = prev
             raise
         if prev is not None:
@@ -2591,8 +2917,10 @@ class DecodeServer:
         if not self._slots:
             self._admit()
             if not self._slots:
+                self._gap_anchor = None
                 return
         if any(st["pos"] < len(st["prompt"]) - 1
+               or st.get("admitting")
                for st in self._slots.values()):
             if prev is not None:
                 self._process_inflight(prev)
@@ -2773,6 +3101,10 @@ class DecodeServer:
                     for n in prompt_lens:
                         widths |= _ladder(
                             1 << max(0, int(n) - 1).bit_length())
+            if self._budget:
+                # budgeted admission walks the budget-width chunk
+                # executable for every claimed (multi-chunk) prompt
+                widths = set(widths) | {min(self._budget, window)}
             for C in sorted(set(widths)):
                 fn = _get_paged_prefill_fn(self.cfg, C, self._shard)
                 padded = jnp.zeros((1, C), jnp.int32)
@@ -2826,6 +3158,22 @@ class DecodeServer:
                                    self._draft_params,
                                    self._draft_cache, padded,
                                    jnp.asarray(1), jnp.asarray(0)))
+        if self._budget and not self._paged:
+            # budgeted admission's offset-aware chunk executable (width
+            # = budget): claims walk it regardless of which monolithic
+            # prefill flavor the server was configured with
+            Wb = min(self._budget, window)
+            bfn = _get_prefill_chunk_fn(self.cfg, self._shard, width=Wb)
+            pad_b = jnp.zeros((1, Wb), jnp.int32)
+            warm(f"prefill_chunk@{Wb}", lambda: bfn(
+                self.params, self.cache, pad_b, jnp.asarray(0),
+                jnp.asarray(1), jnp.asarray(0)))
+            if self._draft_cache is not None:
+                dbfn = _get_prefill_chunk_fn(self.draft_cfg,
+                                             self._shard, width=Wb)
+                warm_draft(f"draft_prefill_chunk@{Wb}", lambda: dbfn(
+                    self._draft_params, self._draft_cache, pad_b,
+                    jnp.asarray(0), jnp.asarray(1), jnp.asarray(0)))
         return timings
 
     def tick_block(self, block: int = 8):
@@ -2859,9 +3207,11 @@ class DecodeServer:
                 return
             if self._slots and not any(
                     st["pos"] < len(st["prompt"]) - 1
+                    or st.get("admitting")
                     for st in self._slots.values()):
-                # the prompt-feeding case falls through to stepwise
-                # tick()s below, which count their own plain steps
+                # the prompt-feeding case (admitting included) falls
+                # through to stepwise tick()s below, which count their
+                # own plain steps
                 self._spec_plain_steps += block
         if self._async:
             self._tick_block_async(block)
@@ -2869,11 +3219,15 @@ class DecodeServer:
         if not self._slots:
             self._admit()
             if not self._slots:
+                self._gap_anchor = None
                 return
         # a slot at pos == len(prompt)-1 is fine for block decode (its feed
         # token is the prompt's last; everything after is feedback) — only
-        # slots with logits-discarded prompt positions left need stepwise
+        # slots with logits-discarded prompt positions left need stepwise.
+        # Admitting slots force stepwise too: one prefill chunk per tick is
+        # exactly the budgeted interleaving
         if any(st["pos"] < len(st["prompt"]) - 1
+               or st.get("admitting")
                for st in self._slots.values()):
             for _ in range(block):
                 self.tick()

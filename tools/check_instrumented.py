@@ -102,6 +102,15 @@ FLEET_MARKERS = ("route", "shed", "drain", "handoff")
 SPEC_FILE = os.path.join("paddle_tpu", "text", "serving.py")
 SPEC_MARKERS = ("spec_accept", "spec_propose", "spec_fallback")
 
+# budgeted-admission lint (round 12, same rule family): every
+# chunked-prefill co-scheduling path in serving.py — the claim, the
+# per-round chunk advance, the graduation — must count a telemetry
+# counter (serving.admitting_claims / serving.prefill_chunks_interleaved)
+# or delegate to another marker-named path: an invisible admission
+# pipeline makes decode-gap regressions undiagnosable
+ADMIT_FILE = os.path.join("paddle_tpu", "text", "serving.py")
+ADMIT_MARKERS = ("admitting", "advance_admit")
+
 
 def _call_name(node: ast.Call):
     f = node.func
@@ -256,6 +265,33 @@ def scan_spec_source(src: str, filename: str = "<src>") -> list:
     return violations
 
 
+def scan_admit_source(src: str, filename: str = "<src>") -> list:
+    """Budgeted-admission lint violations in one source string: a
+    function whose name carries an :data:`ADMIT_MARKERS` marker (a
+    chunked-prefill claim/advance/graduate path) must contain a call to
+    one of :data:`COUNT_NAMES` or delegate to another marker-named
+    callable."""
+    tree = ast.parse(src, filename=filename)
+    violations = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and any(m in node.name for m in ADMIT_MARKERS)):
+            continue
+        counted = any(
+            isinstance(n, ast.Call)
+            and (_call_name(n) in COUNT_NAMES
+                 or any(m in (_call_name(n) or "") for m in ADMIT_MARKERS))
+            for n in ast.walk(node))
+        if not counted:
+            violations.append(
+                (filename, node.lineno,
+                 f"budgeted-admission path {node.name}() records no "
+                 f"telemetry counter (count) — an uncounted "
+                 f"claim/chunk-advance makes admission stalls and "
+                 f"decode-gap regressions undiagnosable"))
+    return violations
+
+
 def _walk_py(path: str) -> list:
     out = []
     for dirpath, _, names in sorted(os.walk(path)):
@@ -313,6 +349,12 @@ def scan_repo(root: str | None = None) -> list:
         with open(spec_path, encoding="utf-8") as f:
             violations.extend(scan_spec_source(
                 f.read(), os.path.relpath(spec_path, root)))
+    # budgeted-admission lint: chunked-prefill co-scheduling observability
+    admit_path = os.path.join(root, ADMIT_FILE)
+    if os.path.exists(admit_path):
+        with open(admit_path, encoding="utf-8") as f:
+            violations.extend(scan_admit_source(
+                f.read(), os.path.relpath(admit_path, root)))
     return violations
 
 
