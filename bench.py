@@ -1546,6 +1546,131 @@ def _mixed_smoke():
             "gap_p99_monolithic_ms": round(gap_mono, 2)}
 
 
+def _overload_smoke():
+    """Overload-drill round, run by ``--config gpt --small`` (CI): with
+    a tight TPOT SLO and an injected per-tick delay
+    (``delay:tick:0:0.03``) the admission controller must climb the
+    degradation ladder off real windowed p99s
+    (``admission.degradations`` asserted), bound the low-priority queue
+    with sheds (``admission.sheds_class0`` asserted; a shed request
+    carries the ``rejected`` status and raises
+    ``resilience.Overloaded`` from ``result()``), keep a high-priority
+    request alive to completion, reset to rung 0 once the burst drains
+    (idle-window reset), and add ZERO compiled executables after
+    ``warmup()`` — a mid-serving retrace from budget-rung switching is
+    the regression this guards."""
+    import time as _time
+
+    import numpy as np
+    import jax
+
+    from paddle_tpu import faults, flags, resilience, telemetry as _tl
+    from paddle_tpu.framework import monitor
+    from paddle_tpu.text import gpt, serving
+
+    if not flags.admission_enabled():
+        return {"ok": True, "skipped": "PADDLE_TPU_ADMISSION=0"}
+    if not _tl.enabled():
+        return {"ok": True, "skipped": "PADDLE_TPU_TELEMETRY=0"}
+
+    def cnt(name):
+        try:
+            return int(monitor.get_stat(name).get())
+        except Exception:
+            return 0
+
+    env = {"PADDLE_TPU_SLO_TPOT_MS": "10",
+           "PADDLE_TPU_SLO_WINDOW_S": "0.1",
+           "PADDLE_TPU_ADMISSION_QUEUE_CAP": "1"}
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    cfg = gpt.GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                        num_heads=4, max_seq_len=64)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(11)
+    bulk_prompts = [[int(x) for x in rng.integers(1, 100, 24)]
+                    for _ in range(8)]
+    gold_prompt = [int(x) for x in rng.integers(1, 100, 6)]
+    try:
+        faults.reset()
+        srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=64,
+                                   prefill_budget=32)
+        if srv._adm is None:
+            raise AssertionError(
+                "overload smoke: PADDLE_TPU_ADMISSION=1 but the server "
+                "built no admission controller")
+        srv.warmup()
+        keys0 = set(serving._STEP_CACHE.keys())
+        _tl.reset()
+        c0 = {n: cnt(n) for n in ("admission.degradations",
+                                  "admission.sheds_class0")}
+        faults.install("delay:tick:0:0.03")
+        gold = srv.submit(gold_prompt, max_new_tokens=1, priority=2,
+                          tenant="gold")
+        bulk = [srv.submit(p, max_new_tokens=12, priority=0,
+                           tenant="bulk") for p in bulk_prompts]
+        rung_max = 0
+        t0 = _time.perf_counter()
+        while srv.pending() and _time.perf_counter() - t0 < 30:
+            srv.tick()
+            rung_max = max(rung_max, srv._adm.rung)
+        if srv.status(gold) != "ok":
+            raise AssertionError(
+                f"overload smoke: high-priority request did not survive "
+                f"the burst (status={srv.status(gold)!r})")
+        rejected = [r for r in bulk if srv.status(r) == "rejected"]
+        if not rejected:
+            raise AssertionError(
+                "overload smoke: no low-priority request was shed at "
+                "queue cap 1 under an 8-request burst")
+        try:
+            srv.result(rejected[0])
+            raise AssertionError(
+                "overload smoke: a rejected request's result() returned "
+                "instead of raising resilience.Overloaded")
+        except resilience.Overloaded:
+            pass
+        degr = cnt("admission.degradations") - c0["admission.degradations"]
+        sheds0 = (cnt("admission.sheds_class0")
+                  - c0["admission.sheds_class0"])
+        if degr < 1 or rung_max < 2:
+            raise AssertionError(
+                f"overload smoke: SLO breach climbed no ladder "
+                f"(degradations={degr}, rung_max={rung_max}) with decode "
+                f"gaps ~30ms against a 10ms TPOT SLO")
+        if sheds0 < 1:
+            raise AssertionError(
+                "overload smoke: sheds engaged no admission.sheds_class0 "
+                "counter")
+        # burst drained: idle ticks must walk the controller back to
+        # rung 0 (the sample-free idle window resets it outright)
+        t_idle = _time.perf_counter()
+        while srv._adm.rung > 0 and _time.perf_counter() - t_idle < 3.0:
+            srv.tick()
+            _time.sleep(0.01)
+        recovery_s = _time.perf_counter() - t_idle
+        if srv._adm.rung != 0:
+            raise AssertionError(
+                f"overload smoke: controller stuck at rung "
+                f"{srv._adm.rung} {recovery_s:.2f}s after the burst "
+                f"drained")
+        added = set(serving._STEP_CACHE.keys()) - keys0
+        if added:
+            raise AssertionError(
+                f"overload smoke: budget-rung switching retraced "
+                f"mid-serving — new executables {sorted(added)}")
+        return {"ok": True, "rung_max": rung_max, "degradations": degr,
+                "sheds_class0": sheds0, "rejected": len(rejected),
+                "recovery_s": round(recovery_s, 3)}
+    finally:
+        faults.reset()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def bench_gpt(small: bool):
     if small:
         rec = _run_gpt_rung(-1)
@@ -1571,6 +1696,10 @@ def bench_gpt(small: bool):
         # co-scheduling bit-parity (contiguous + paged) + interleave
         # counter + mixed decode-gap bound asserted (see _mixed_smoke)
         rec["mixed_smoke"] = _mixed_smoke()
+        # admission control rides the CI smoke: SLO-driven ladder climb,
+        # low-priority sheds + Overloaded, idle recovery to rung 0, and
+        # zero mid-serving retraces asserted (see _overload_smoke)
+        rec["overload_smoke"] = _overload_smoke()
         # provenance-schema gate (CI): a bench line whose provenance
         # block is missing or incomplete must fail the smoke — a silent
         # CPU fallback can never again ship as an unlabeled number
@@ -2902,6 +3031,252 @@ def bench_mixed(small: bool):
     return _stamp_provenance(rec, dev)
 
 
+def bench_overload(small: bool):
+    """Overload drill (round 13): one server, one injected per-tick
+    delay (``delay:tick:0:0.02`` — deterministic latency so the drill
+    runs on tiny CPU models), driven at STEADY load (~2/3 of slot
+    capacity) and then at 4x-capacity BURST with a TTFT SLO installed.
+
+    The acceptance bar this bench encodes: under the burst the
+    admission controller must climb the degradation ladder off real
+    windowed TTFT p99s (``admission.degradations``), shed low-priority
+    work (queue-cap sheds + door sheds, ``admission.sheds_class0``)
+    while every HIGH-priority request completes with TTFT p99 within
+    BENCH_OVERLOAD_TOL (default 2x) of the steady phase; after the
+    burst drains the controller must walk back to rung 0 within ~2 SLO
+    windows (one draining window + one idle-reset window); and the
+    whole drill must add ZERO compiled executables after ``warmup()``
+    — budget-rung switches ride pre-warmed widths, never a mid-serving
+    retrace.  A final arm replays the burst with
+    ``PADDLE_TPU_ADMISSION=0``: the unbounded FIFO queue shows what the
+    controller is protecting against (``protection_factor`` = off/on
+    gold TTFT p99, asserted >= 2)."""
+    import numpy as np
+    import jax
+
+    from paddle_tpu import faults, flags, telemetry as _tl
+    from paddle_tpu.framework import monitor
+    from paddle_tpu.text import gpt, serving
+
+    dev = jax.devices()[0]
+    if not flags.admission_enabled():
+        raise AssertionError(
+            "overload bench needs PADDLE_TPU_ADMISSION unset/1 "
+            "(the off switch is under test in its own arm)")
+    if not _tl.enabled():
+        raise AssertionError(
+            "overload bench needs PADDLE_TPU_TELEMETRY=1 (the SLO "
+            "control loop reads the telemetry histograms)")
+
+    def cnt(name):
+        try:
+            return int(monitor.get_stat(name).get())
+        except Exception:
+            return 0
+
+    n_ticks = 60 if small else 150
+    B = 4
+    bulk_new, bulk_len = 2, 24
+    window_s = 0.2
+    env = {"PADDLE_TPU_SLO_TTFT_MS": "80",
+           "PADDLE_TPU_SLO_WINDOW_S": str(window_s),
+           "PADDLE_TPU_ADMISSION_QUEUE_CAP": "8"}
+    saved = {k: os.environ.get(k) for k in ("PADDLE_TPU_ADMISSION",
+                                            *env)}
+    os.environ.update(env)
+    cfg = gpt.GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                        num_heads=4, max_seq_len=64)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(13)
+    bulk_prompt = [int(x) for x in rng.integers(1, 100, bulk_len)]
+    gold_prompt = [int(x) for x in rng.integers(1, 100, 6)]
+
+    def drive(srv, bulk_per_tick, track=None):
+        """One phase: submit bulk_per_tick(it) low-priority requests
+        each tick plus one 3-token high-priority probe every 5 ticks
+        (first token + two decode gaps — a TTFT-dominated latency
+        probe), then drain.  Returns (all gold walls ms, walls of the
+        golds submitted with the ladder ENGAGED (rung >= 1), bulk
+        rids)."""
+        golds, gold_done, bulk_rids = {}, {}, []
+        it = 0
+        while it < n_ticks or srv.pending():
+            if it < n_ticks:
+                for _ in range(bulk_per_tick(it)):
+                    bulk_rids.append(srv.submit(
+                        bulk_prompt, max_new_tokens=bulk_new,
+                        priority=0, tenant="bulk"))
+                if it % 5 == 2:
+                    eng = (srv._adm is not None
+                           and srv._adm.rung >= 1)
+                    golds[srv.submit(gold_prompt, max_new_tokens=3,
+                                     priority=2, tenant="gold")] = \
+                        (time.perf_counter(), eng)
+            srv.tick()
+            if track is not None and srv._adm is not None:
+                track["rung_max"] = max(track["rung_max"],
+                                        srv._adm.rung)
+            now = time.perf_counter()
+            for rid, (t0, _) in golds.items():
+                if rid not in gold_done and srv.status(rid) == "ok":
+                    gold_done[rid] = (now - t0) * 1e3
+            it += 1
+        if len(gold_done) != len(golds):
+            missing = {rid: srv.status(rid) for rid in golds
+                       if rid not in gold_done}
+            raise AssertionError(
+                f"overload bench: high-priority probes did not all "
+                f"complete: {missing}")
+        return (list(gold_done.values()),
+                [gold_done[r] for r, (_, eng) in golds.items() if eng],
+                bulk_rids)
+
+    def p99(xs):
+        return float(np.percentile(np.asarray(xs), 99)) if xs else 0.0
+
+    try:
+        faults.reset()
+        srv = serving.DecodeServer(params, cfg, max_batch=B, max_len=64,
+                                   prefill_budget=32)
+        srv.warmup()
+        # warm the whole drill path once (steady cadence, short) so the
+        # measured phases pay device time only, then snapshot the step
+        # cache — the zero-retrace assert covers everything after this
+        faults.install("delay:tick:0:0.02")
+        drive(srv, lambda it: 1 if it % 6 else 0)
+        keys0 = set(serving._STEP_CACHE.keys())
+
+        # -- steady phase: ~2/3 of the 4-slot capacity ------------------
+        c_rej0 = cnt("serving.requests_rejected")
+        track_s = {"rung_max": 0}
+        gold_steady, _, _ = drive(srv, lambda it: 1 if it % 6 else 0,
+                                  track_s)
+        steady_rejected = cnt("serving.requests_rejected") - c_rej0
+
+        # -- burst phase: 4x capacity -----------------------------------
+        c0 = {n: cnt(n) for n in ("admission.degradations",
+                                  "admission.sheds_class0",
+                                  "serving.requests_rejected")}
+        track_b = {"rung_max": 0}
+        gold_burst, gold_eng, bulk_rids = drive(srv, lambda it: 4,
+                                                track_b)
+        degr = cnt("admission.degradations") - c0["admission.degradations"]
+        sheds0 = (cnt("admission.sheds_class0")
+                  - c0["admission.sheds_class0"])
+        burst_rejected = (cnt("serving.requests_rejected")
+                          - c0["serving.requests_rejected"])
+        rejected_rids = [r for r in bulk_rids
+                         if srv.status(r) == "rejected"]
+        if degr < 1 or track_b["rung_max"] < 1:
+            raise AssertionError(
+                f"overload bench: 4x burst climbed no ladder "
+                f"(degradations={degr}, "
+                f"rung_max={track_b['rung_max']})")
+        if sheds0 < 1 or not rejected_rids:
+            raise AssertionError(
+                f"overload bench: 4x burst shed no low-priority work "
+                f"(sheds_class0={sheds0}, "
+                f"rejected={len(rejected_rids)})")
+
+        # -- deep-rung retrace coverage: if the controller stabilized
+        # before the budget-switch rungs, force rung 3 and serve a few
+        # requests — every width must already be warm
+        forced_deep = track_b["rung_max"] < 3
+        if forced_deep:
+            srv._adm.rung = 3
+            for _ in range(3):
+                srv.submit(bulk_prompt, max_new_tokens=bulk_new,
+                           priority=2, tenant="bulk")
+            while srv.pending():
+                srv.tick()
+            srv._adm.rung = max(srv._adm.rung, 1)
+
+        # -- recovery: idle ticks walk the ladder back to rung 0 --------
+        t_idle = time.perf_counter()
+        while srv._adm.rung > 0 \
+                and time.perf_counter() - t_idle < 5.0:
+            srv.tick()
+            time.sleep(0.01)
+        recovery_s = time.perf_counter() - t_idle
+        if srv._adm.rung != 0:
+            raise AssertionError(
+                f"overload bench: controller stuck at rung "
+                f"{srv._adm.rung} {recovery_s:.2f}s after the burst")
+        if recovery_s > 2 * window_s + 0.3:
+            raise AssertionError(
+                f"overload bench: recovery took {recovery_s:.2f}s "
+                f"(> 2 SLO windows + slack) — the idle-window reset "
+                f"did not engage")
+        added = set(serving._STEP_CACHE.keys()) - keys0
+        if added:
+            raise AssertionError(
+                f"overload bench: mid-serving retrace — new "
+                f"executables {sorted(added)}")
+
+        tol = float(os.environ.get("BENCH_OVERLOAD_TOL", "2.0"))
+        g_steady = p99(gold_steady)
+        g_burst_all = p99(gold_burst)
+        # the asserted number is the p99 of golds submitted AFTER the
+        # ladder engaged — the acceptance bar holds "while low-priority
+        # sheds engage"; the first-window (pre-engage) golds ride the
+        # uncontrolled FIFO spike and are reported separately
+        g_burst = p99(gold_eng) if len(gold_eng) >= 4 else g_burst_all
+        if g_burst > g_steady * tol:
+            raise AssertionError(
+                f"overload bench: high-priority TTFT p99 under 4x "
+                f"burst ({g_burst:.0f}ms) exceeds {tol}x steady "
+                f"({g_steady:.0f}ms) — degradation is not protecting "
+                f"the gold lane")
+
+        # -- control arm: same burst, admission off ---------------------
+        os.environ["PADDLE_TPU_ADMISSION"] = "0"
+        srv_off = serving.DecodeServer(params, cfg, max_batch=B,
+                                       max_len=64, prefill_budget=32)
+        gold_off, _, _ = drive(srv_off, lambda it: 4)
+        g_off = p99(gold_off)
+        protection = g_off / max(g_burst, 1e-9)
+        if protection < 2.0:
+            raise AssertionError(
+                f"overload bench: admission off held gold TTFT p99 at "
+                f"{g_off:.0f}ms vs {g_burst:.0f}ms with it on "
+                f"(protection {protection:.1f}x < 2x) — the unbounded "
+                f"queue should have starved the probes")
+    finally:
+        faults.reset()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    rec = {"metric": "gold_ttft_p99_burst_ms",
+           "unit": "ms",
+           "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
+               timespec="seconds"),
+           "device": dev.platform,
+           "device_kind": str(getattr(dev, "device_kind", "")),
+           "value": round(g_burst, 1),
+           "gold_p99_burst_all_ms": round(g_burst_all, 1),
+           "gold_engaged_probes": len(gold_eng),
+           "gold_ttft_p99_steady_ms": round(g_steady, 1),
+           "gold_ttft_p99_admission_off_ms": round(g_off, 1),
+           "burst_over_steady": round(g_burst / max(g_steady, 1e-9), 2),
+           "protection_factor": round(protection, 1),
+           "tolerance": tol,
+           "steady_rejected": steady_rejected,
+           "burst_rejected": burst_rejected,
+           "sheds_class0": sheds0,
+           "degradations": degr,
+           "rung_max_steady": track_s["rung_max"],
+           "rung_max_burst": track_b["rung_max"],
+           "forced_deep_rung": forced_deep,
+           "recovery_s": round(recovery_s, 3),
+           "new_compiles": 0,
+           "ticks_per_phase": n_ticks, "max_batch": B,
+           "vs_baseline": 0.0}
+    return _stamp_provenance(rec, dev)
+
+
 def bench_spec(small: bool):
     """Speculative decoding vs the plain continuous-batching server
     (round 11): the same greedy request stream driven through three
@@ -3032,7 +3407,7 @@ _CONFIGS = {"gpt": bench_gpt, "train": bench_train, "mnist": bench_mnist,
             "decode": bench_decode, "decode_long": bench_decode_long,
             "serving": bench_serving, "paged": bench_paged,
             "fleet": bench_fleet, "spec": bench_spec,
-            "mixed": bench_mixed}
+            "mixed": bench_mixed, "overload": bench_overload}
 
 
 def main():

@@ -18,8 +18,14 @@ comma-separated ``kind:site:nth`` triples —
   XlaRuntimeError), ``error`` (raise :class:`InjectedError`),
   ``wedge`` (simulate a hung device step: :func:`hang` sleeps
   ``PADDLE_TPU_FAULT_WEDGE_S`` seconds — long enough to trip the
-  resilience watchdog's wall budget), or ``nan`` (corrupt an array:
-  :func:`corrupt_nan` returns it filled with NaN).
+  resilience watchdog's wall budget), ``nan`` (corrupt an array:
+  :func:`corrupt_nan` returns it filled with NaN), ``delay``
+  (deterministic injected latency: the matching check SLEEPS — an
+  optional 4th field gives the seconds, ``delay:tick:0:0.05``, default
+  0.05 — so SLO drills inflate TTFT/TPOT p99s on tiny CPU models
+  instead of needing wall-clock-sized ones; never an exception), or
+  ``overload`` (raise :class:`InjectedOverload` at an admission site —
+  drives the admission-control drills).
 * ``site``: a label named by the instrumented call site.  A site check
   may pass several aliases (``check("tick", "serving.block")``) —
   a fault matches when its site equals ANY alias, so specs can target
@@ -41,11 +47,12 @@ import time
 
 __all__ = [
     "InjectedFault", "InjectedOOM", "InjectedError", "InjectedWedge",
-    "install", "reset", "active", "check", "hang", "corrupt_nan",
-    "nan_train_steps", "spec_string", "parse_spec",
+    "InjectedOverload", "install", "reset", "active", "check", "hang",
+    "corrupt_nan", "nan_train_steps", "spec_string", "parse_spec",
 ]
 
-_KINDS = ("oom", "error", "wedge", "nan")
+_KINDS = ("oom", "error", "wedge", "nan", "delay", "overload")
+_DELAY_DEFAULT_S = 0.05
 
 
 class InjectedFault(RuntimeError):
@@ -78,13 +85,26 @@ class InjectedWedge(InjectedFault):
         super().__init__(f"injected wedge at {site!r} (PADDLE_TPU_FAULTS)")
 
 
-class _Fault:
-    __slots__ = ("kind", "site", "nth", "hits", "fired")
+class InjectedOverload(InjectedFault):
+    """Simulated admission-layer overload (an ``overload:site:nth``
+    fault firing at a site that opted in via ``kinds``): the admission
+    controller answers it by shedding exactly as it would a real
+    capacity verdict, which is what the overload drills assert."""
 
-    def __init__(self, kind: str, site: str, nth: int):
+    def __init__(self, site: str):
+        super().__init__(
+            f"injected overload at {site!r} (PADDLE_TPU_FAULTS)")
+
+
+class _Fault:
+    __slots__ = ("kind", "site", "nth", "hits", "fired", "seconds")
+
+    def __init__(self, kind: str, site: str, nth: int,
+                 seconds: float | None = None):
         self.kind = kind
         self.site = site
         self.nth = int(nth)
+        self.seconds = seconds      # delay faults only
         self.hits = 0      # matching checks seen so far
         self.fired = 0     # times this fault actually fired
 
@@ -106,17 +126,36 @@ _state = {"parsed": False, "faults": [], "spec": ""}
 def parse_spec(spec: str) -> list:
     """``kind:site:nth`` triples -> [_Fault]; raises ValueError on a
     malformed entry (a typo'd chaos spec must fail the run it was meant
-    to harden, not silently test nothing)."""
+    to harden, not silently test nothing).  ``delay`` entries alone
+    accept a 4th field — the injected latency in seconds
+    (``delay:tick:0:0.05``; default 0.05)."""
     faults = []
     for part in (spec or "").split(","):
         part = part.strip()
         if not part:
             continue
         bits = part.split(":")
+        kind = bits[0].strip().lower()
+        if kind == "delay" and len(bits) == 4:
+            try:
+                seconds = float(bits[3])
+            except ValueError:
+                raise ValueError(
+                    f"PADDLE_TPU_FAULTS entry {part!r}: delay seconds "
+                    f"must be a number")
+            if seconds < 0:
+                raise ValueError(
+                    f"PADDLE_TPU_FAULTS entry {part!r}: delay seconds "
+                    f"must be >= 0")
+            bits = bits[:3]
+        else:
+            seconds = None
         if len(bits) != 3:
             raise ValueError(
-                f"PADDLE_TPU_FAULTS entry {part!r}: expected kind:site:nth")
-        kind, site, nth = bits[0].strip().lower(), bits[1].strip(), bits[2]
+                f"PADDLE_TPU_FAULTS entry {part!r}: expected kind:site:nth"
+                + (" (delay alone takes kind:site:nth:seconds)"
+                   if kind == "delay" else ""))
+        site, nth = bits[1].strip(), bits[2]
         if kind not in _KINDS:
             raise ValueError(
                 f"PADDLE_TPU_FAULTS kind {kind!r}: expected one of {_KINDS}")
@@ -130,7 +169,7 @@ def parse_spec(spec: str) -> list:
         if n < 0:
             raise ValueError(
                 f"PADDLE_TPU_FAULTS entry {part!r}: nth must be >= 0")
-        faults.append(_Fault(kind, site, n))
+        faults.append(_Fault(kind, site, n, seconds))
     return faults
 
 
@@ -192,10 +231,20 @@ def _firing(kinds, names):
 def check(*names: str, kinds: tuple = ("oom", "error", "wedge")) -> None:
     """Raise the matching injected failure, if any fault targeting one of
     ``names`` is due.  ``oom``/``error`` raise their exception; a
-    ``wedge`` fault at a check-only site raises :class:`InjectedWedge`.
-    Sites that ALSO have a real hang hook (the serving fetch calls
-    :func:`hang`) pass ``kinds=("oom", "error")`` so a wedge spec
-    reaches the hook as an actual hang instead of an eager raise."""
+    ``wedge`` fault at a check-only site raises :class:`InjectedWedge`;
+    an ``overload`` fault raises :class:`InjectedOverload` only at sites
+    that opt in via ``kinds`` (admission paths).  Sites that ALSO have a
+    real hang hook (the serving fetch calls :func:`hang`) pass
+    ``kinds=("oom", "error")`` so a wedge spec reaches the hook as an
+    actual hang instead of an eager raise.
+
+    ``delay`` faults fire at EVERY check regardless of ``kinds``: they
+    sleep their configured seconds and raise nothing — injected latency
+    is benign at any site, and requiring opt-in would silently no-op a
+    drill spec at most sites (the no-silent-no-op promise)."""
+    d = _firing(("delay",), names)
+    if d is not None:
+        time.sleep(d.seconds if d.seconds is not None else _DELAY_DEFAULT_S)
     f = _firing(kinds, names)
     if f is None:
         return
@@ -204,6 +253,8 @@ def check(*names: str, kinds: tuple = ("oom", "error", "wedge")) -> None:
         raise InjectedOOM(site)
     if f.kind == "wedge":
         raise InjectedWedge(site)
+    if f.kind == "overload":
+        raise InjectedOverload(site)
     raise InjectedError(site)
 
 

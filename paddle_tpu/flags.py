@@ -398,6 +398,107 @@ def prefill_budget() -> int:
     return b
 
 
+def admission_enabled() -> bool:
+    """SLO-driven admission control master switch (ON by default).
+
+    When on, ``DecodeServer`` and ``fleet.Router`` construct an
+    :class:`paddle_tpu.text.admission.AdmissionController`: per-tenant
+    token-bucket rate limits, bounded per-class queues with
+    shed-lowest-class-first overload policy, and the SLO degradation
+    ladder (admit cap -> prefill-budget rung -> speculation fallback ->
+    shed) driven by the TTFT/TPOT histograms.  ``PADDLE_TPU_ADMISSION=0``
+    restores today's greedy FIFO admission EXACTLY (bit-parity: no
+    controller is constructed, no request is ever ``rejected``).  Host
+    scheduling only — never a jit-cache key; the budget ladder switches
+    among PRE-WARMED chunk widths, it never flips the env."""
+    v = os.environ.get("PADDLE_TPU_ADMISSION", "1").strip().lower()
+    return v not in ("0", "false", "off", "no")
+
+
+def _float_or_none(name: str) -> float | None:
+    v = os.environ.get(name)
+    if v is None or not v.strip():
+        return None
+    try:
+        f = float(v)
+    except ValueError:
+        raise ValueError(f"{name}={v!r}: expected a number")
+    return f if f > 0 else None
+
+
+def slo_ttft_ms() -> float | None:
+    """TTFT SLO in milliseconds (``PADDLE_TPU_SLO_TTFT_MS``; unset/0 =
+    no TTFT objective).  The admission controller compares the WINDOWED
+    ``serving.ttft_ms`` p99 against this each control tick; a breach
+    climbs the degradation ladder."""
+    return _float_or_none("PADDLE_TPU_SLO_TTFT_MS")
+
+
+def slo_tpot_ms() -> float | None:
+    """TPOT/decode-gap SLO in milliseconds (``PADDLE_TPU_SLO_TPOT_MS``;
+    unset/0 = no TPOT objective).  Compared against the windowed
+    ``serving.decode_gap_ms`` p99 — the stall metric budgeted admission
+    bounds — each control tick."""
+    return _float_or_none("PADDLE_TPU_SLO_TPOT_MS")
+
+
+def slo_window_s() -> float:
+    """SLO evaluation window in seconds (``PADDLE_TPU_SLO_WINDOW_S``,
+    default 2.0): the controller re-reads the histograms at most once
+    per window, degrades one rung per breached window, and recovers one
+    rung per fully healthy window (symmetric by construction)."""
+    try:
+        return max(0.05, float(os.environ.get("PADDLE_TPU_SLO_WINDOW_S",
+                                              "2.0")))
+    except ValueError:
+        return 2.0
+
+
+def tenant_rate() -> float | None:
+    """Per-tenant token-bucket refill rate, in admitted tokens
+    (prompt + max_new) per second (``PADDLE_TPU_TENANT_RATE``; unset/0
+    = no per-tenant rate limiting).  A submit whose tenant bucket
+    cannot cover its cost is rejected with ``resilience.Overloaded``
+    and counted ``admission.tenant_throttles``."""
+    return _float_or_none("PADDLE_TPU_TENANT_RATE")
+
+
+def tenant_burst() -> float | None:
+    """Per-tenant token-bucket capacity (``PADDLE_TPU_TENANT_BURST``;
+    default 2x the rate): how many tokens a quiet tenant may burst
+    before the refill rate binds."""
+    return _float_or_none("PADDLE_TPU_TENANT_BURST")
+
+
+def admission_queue_cap() -> int:
+    """Bounded per-class admission queues
+    (``PADDLE_TPU_ADMISSION_QUEUE_CAP``, default 0 = unbounded): when
+    the total queued work exceeds this cap, the LOWEST priority class
+    sheds first (``rejected`` status, ``admission.sheds_class*``
+    counters) — overload answers at the door instead of stacking
+    queues until TTLs fire."""
+    try:
+        return max(0, int(os.environ.get(
+            "PADDLE_TPU_ADMISSION_QUEUE_CAP", "0")))
+    except ValueError:
+        return 0
+
+
+def requeue_max() -> int:
+    """Eviction-count aging bound for the OOM-evict requeue path
+    (``PADDLE_TPU_EVICT_REQUEUE_MAX``, default 8; 0 = unbounded, the
+    pre-bound behavior).  An evicted request re-queues at the FRONT
+    with a fresh TTL clock — under sustained pressure that can starve
+    the rest of the queue forever, so after this many evictions the
+    request fails honestly with the ``error`` status
+    (``resilience.evict_requeue_overflows``) instead of cycling."""
+    try:
+        return max(0, int(os.environ.get("PADDLE_TPU_EVICT_REQUEUE_MAX",
+                                         "8")))
+    except ValueError:
+        return 8
+
+
 def spec_min_accept() -> float:
     """Rolling per-request acceptance rate below which a speculating
     slot falls back to plain decode (``PADDLE_TPU_SPEC_MIN_ACCEPT``,

@@ -37,7 +37,7 @@ from . import flags as _flags
 from . import telemetry as _telemetry
 
 __all__ = [
-    "enabled", "DeadlineExceeded", "WedgeError", "Deadline",
+    "enabled", "DeadlineExceeded", "Overloaded", "WedgeError", "Deadline",
     "backoff_schedule", "retry", "is_oom", "call_with_budget",
 ]
 
@@ -51,6 +51,17 @@ class DeadlineExceeded(TimeoutError):
     """A TTL/deadline expired — e.g. a queued serving request shed
     before admission (``DecodeServer.result`` raises this for requests
     retired with the ``timeout`` status)."""
+
+
+class Overloaded(RuntimeError):
+    """Admission control shed this request at the DOOR — a per-tenant
+    rate limit, a bounded per-class queue overflowing, or the SLO
+    degradation ladder's shed rung (``DecodeServer.result`` /
+    ``fleet.Router.result`` raise this for requests retired with the
+    ``rejected`` status).  Distinct from :class:`DeadlineExceeded` on
+    purpose: a TTL ``timeout`` means the request WAITED and lost; a
+    ``rejected`` means the server refused to queue it at all, which is
+    the signal a client should back off on."""
 
 
 class WedgeError(RuntimeError):

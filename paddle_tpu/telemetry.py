@@ -152,6 +152,16 @@ class Histogram:
                 "p90": round(self.quantile(0.90), 6),
                 "p99": round(self.quantile(0.99), 6)}
 
+    def raw_counts(self) -> list:
+        """A consistent copy of the raw per-bucket counts (cumulative
+        since process start).  Consumers that need a WINDOWED
+        distribution — the admission controller's SLO verdicts — keep
+        the previous copy and feed the elementwise delta to
+        :func:`quantile_from_counts`; the histogram itself stays O(1)
+        and never resets under a live scrape."""
+        with self._lock:
+            return list(self._counts)
+
     def buckets(self):
         """(upper_bound, cumulative_count) pairs for Prometheus exposition
         — only bounds where the cumulative count changes, plus +Inf (a
@@ -166,6 +176,28 @@ class Histogram:
                 out.append((_BOUNDS[i], cum))
         out.append((math.inf, cum + counts[-1]))
         return out
+
+
+def quantile_from_counts(counts, q: float) -> float:
+    """Interpolated quantile over a RAW bucket-count vector (the
+    :meth:`Histogram.raw_counts` shape — typically a delta between two
+    snapshots, i.e. a windowed distribution).  Same interpolation rule
+    as :meth:`Histogram.quantile`, minus the observed min/max clamp
+    (per-window extremes are not tracked); 0.0 on an empty window."""
+    total = sum(counts)
+    if total <= 0:
+        return 0.0
+    rank = q * total
+    cum = 0.0
+    for i, c in enumerate(counts):
+        if c <= 0:
+            continue
+        if cum + c >= rank:
+            lo = _BOUNDS[i - 1] if 0 < i <= len(_BOUNDS) else 0.0
+            hi = _BOUNDS[i] if i < len(_BOUNDS) else _BOUNDS[-1]
+            return lo + (hi - lo) * ((rank - cum) / c)
+        cum += c
+    return _BOUNDS[-1]
 
 
 class Gauge:
@@ -323,6 +355,24 @@ def count(name: str, n: int = 1) -> None:
         with _lock:
             _counter_names.add(name)
     _monitor.get_stat(name).add(n)
+
+
+def admission_snapshot() -> dict:
+    """Every ``admission.*`` gauge and counter currently registered
+    (rung, budget level, per-class sheds, tenant throttles, ...), as one
+    flat dict — the ``/healthz`` admission block and the fleet router's
+    health aggregation both read it here so the name set can't diverge
+    between the two."""
+    out = {}
+    with _lock:
+        gauges = [(n, g) for n, g in _gauges.items()
+                  if n.startswith("admission.")]
+        counters = [n for n in _counter_names if n.startswith("admission.")]
+    for n, g in gauges:
+        out[n] = g.get()
+    for n in counters:
+        out[n] = _monitor.get_stat(n).get()
+    return out
 
 
 def reset() -> None:
@@ -1064,6 +1114,10 @@ class MetricsServer:
                         "device_kind": feed.get("device_kind"),
                         "instrumented_steps": sorted(feed["steps"]),
                         "hbm": feed.get("hbm", {}),
+                        # admission-control state (degradation rung,
+                        # budget level, per-class sheds, throttles) —
+                        # empty dict until a controller records
+                        "admission": admission_snapshot(),
                     }).encode()
                     # healthz convention: status-code signaling — a
                     # k8s-style httpGet probe never reads the body, so a

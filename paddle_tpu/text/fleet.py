@@ -53,6 +53,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import admission as _admission
 from . import generate, gpt, serving
 from .. import flags as _flags
 from .. import resilience as _resilience
@@ -498,16 +499,35 @@ class Router:
         # is touched by at most one worker per round.
         self._tick_workers = _flags.fleet_tick_workers()
         self._tick_pool = None
+        # fleet-level admission (text/admission.py): per-tenant token
+        # buckets + bounded per-class queues at the FRONT DOOR, so
+        # overload sheds here instead of stacking the fleet queue on
+        # top of replica queues.  The router's controller runs no
+        # histogram loop of its own — every tick it absorbs the WORST
+        # replica degradation rung (load_stats()["admission_rung"]) and
+        # sheds by the same rung rule.  PADDLE_TPU_ADMISSION=0 builds
+        # no controller: greedy routing, bit-identical to before.
+        self._adm = (_admission.AdmissionController(scope="fleet")
+                     if _flags.admission_enabled() else None)
 
     # -- submission ---------------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int = 32,
                stop: list | None = None, temperature: float = 0.0,
                top_k: int = 0, top_p: float = 1.0,
-               ttl_s: float | None = None, priority: int = 0) -> int:
+               ttl_s: float | None = None, priority: int = 0,
+               tenant: str | None = None) -> int:
         """Fleet-level submit: same per-request surface as
-        ``DecodeServer.submit`` (sampling params, TTL, priority), one
-        rid namespace across every replica."""
+        ``DecodeServer.submit`` (sampling params, TTL, priority,
+        admission tenant), one rid namespace across every replica.
+
+        Admission control runs at THIS door: the tenant's token bucket
+        (``PADDLE_TPU_TENANT_RATE``) and — when any replica's SLO
+        degradation rung reaches the shed rung — lowest-class shedding,
+        both retiring the request with the ``rejected`` state
+        (``result`` raises ``resilience.Overloaded``).  Requests routed
+        to a replica are NOT re-charged there: the fleet door is the
+        one bucket."""
         prompt, stop, ttl, top_k = serving.validate_request(
             prompt, max_new_tokens, stop, temperature, top_k, top_p,
             ttl_s, window=self._window,
@@ -520,18 +540,49 @@ class Router:
                "stop": stop, "temperature": float(temperature),
                "top_k": top_k, "top_p": float(top_p),
                "ttl": ttl, "priority": int(priority),
+               "tenant": tenant,
                "t_submit": now, "t_enqueue": now}
         rec = {"state": "queued", "req": req}
         self._requests[rid] = rec
         if self._tel:
             _telemetry.count("fleet.requests")
+        if self._adm is not None:
+            ok, _reason = self._adm.admit(
+                tenant, priority, len(prompt) + int(max_new_tokens))
+            if not ok:
+                rec["state"] = "rejected"
+                if self._tel:
+                    _telemetry.count("fleet.requests_rejected")
+                self._gauges()
+                return rid
         if self._prefill_eps and len(prompt) >= self._threshold:
             self._handoff_prefill(rid, rec)
         else:
             self._queue.append(rid)
+            if self._adm is not None:
+                self._shed_queue_overflow()
             self._route()
         self._gauges()
         return rid
+
+    def _shed_queue_overflow(self) -> None:
+        """Bounded per-class fleet queue: while any class is over
+        ``PADDLE_TPU_ADMISSION_QUEUE_CAP``, retire the controller's
+        victim (lowest over-cap class, newest entry) with the
+        ``rejected`` state — front-door backpressure instead of a
+        fleet queue stacking on replica queues."""
+        while True:
+            qreqs = [self._requests[rid]["req"] for rid in self._queue]
+            i = self._adm.overflow_victim(qreqs)
+            if i is None:
+                return
+            rid = self._queue.pop(i)
+            rec = self._requests[rid]
+            rec["state"] = "rejected"
+            self._adm.count_shed(rec["req"].get("priority", 0),
+                                 "queue_full")
+            if self._tel:
+                _telemetry.count("fleet.requests_rejected")
 
     def _live_eps(self):
         return [i for i in range(len(self._prefill_eps))
@@ -783,6 +834,7 @@ class Router:
         self._poll_prefill()
         self._check_health()
         self._shed_expired()
+        self._absorb_backpressure()
         self._route()
         pend = [r for r in self.replicas if r.pending()]
         if len(pend) <= 1 or self._tick_workers <= 1:
@@ -806,6 +858,19 @@ class Router:
         self._check_health()
         self._gauges()
 
+    def _absorb_backpressure(self) -> None:
+        """Fold the replicas' SLO verdicts into the front door: the
+        router's controller adopts the WORST healthy replica's
+        degradation rung (``load_stats()["admission_rung"]``), so when
+        any replica degrades to the shed rung, new lowest-class
+        submissions reject HERE — before queueing, before routing —
+        and recovery tracks the replicas' own ladders exactly."""
+        if self._adm is None:
+            return
+        rungs = [r.load_stats().get("admission_rung", 0)
+                 for i, r in enumerate(self.replicas) if self._ok[i]]
+        self._adm.absorb_fleet_rung(max(rungs) if rungs else 0)
+
     def pending(self) -> bool:
         return (bool(self._queue) or bool(self._prefilling)
                 or any(r.pending() for r in self.replicas))
@@ -813,8 +878,9 @@ class Router:
     # -- results ------------------------------------------------------------
 
     def status(self, rid: int) -> str:
-        """``queued`` | ``prefilling`` | ``timeout`` | ``error`` at the
-        fleet level; once dispatched, the owning replica's status."""
+        """``queued`` | ``prefilling`` | ``timeout`` | ``rejected`` |
+        ``error`` at the fleet level; once dispatched, the owning
+        replica's status."""
         rec = self._requests[rid]
         if rec["state"] == "dispatched":
             return self.replicas[rec["replica"]].status(rec["local_rid"])
@@ -827,6 +893,11 @@ class Router:
             raise _resilience.DeadlineExceeded(
                 f"request {rid} was shed at the router: still queued "
                 f"past its ttl")
+        if state == "rejected":
+            raise _resilience.Overloaded(
+                f"request {rid} was rejected at the fleet door "
+                f"(rate limit, queue bound, or overload shed) — it "
+                f"never queued; back off and resubmit")
         if state == "error":
             raise RuntimeError(
                 f"request {rid} failed: {rec.get('error')}")
@@ -851,6 +922,11 @@ class Router:
             "queue_depth": len(self._queue),
             "prefill_workers": len(self._prefill_eps),
             "prefill_outstanding": len(self._prefilling),
+            # admission verdict at the fleet door (None = controller
+            # off): the rung the front door currently sheds by, plus
+            # the shared admission.* counter/gauge snapshot
+            "admission": (None if self._adm is None
+                          else self._adm.stats()),
         }
 
     def _gauges(self) -> None:
@@ -861,6 +937,8 @@ class Router:
         _telemetry.set_gauge("fleet.queue_depth", len(self._queue))
         _telemetry.set_gauge("fleet.prefill_outstanding",
                              len(self._prefilling))
+        if self._adm is not None:
+            _telemetry.set_gauge("admission.fleet_rung", self._adm.rung)
 
     def close(self) -> None:
         """Shut the fleet down: stop frames to remote workers, owned

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import admission as _admission
 from . import generate, gpt
 from .. import faults as _faults
 from .. import flags as _flags
@@ -857,16 +858,34 @@ class DecodeServer:
         self._step_budget = _flags.step_budget_s()
         self._admit_cap = max_batch     # halved by the OOM chain
         self._status: dict[int, str] = {}   # rid -> "timeout" | "error"
+        #                                   #      | "rejected"
+        self._err_reason: dict[int, str] = {}   # rid -> why "error"
         self._wedged = False            # a wedge was detected, not yet
         self._wedge_event = False       # ... recovered by a clean tick
         self._in_tick = False           # guard re-entrancy (block fallback)
+        # admission control (text/admission.py): per-tenant token
+        # buckets + bounded per-class queues at submit, and the SLO
+        # degradation ladder consulted by _admit/_claim_admitting (admit
+        # cap, pre-warmed budget rung, spec-off, shed).  Decided once at
+        # construction like _tel/_resil: PADDLE_TPU_ADMISSION=0 builds
+        # NO controller and every hot-path consult is `is None` —
+        # greedy FIFO admission, bit-identical to the pre-admission
+        # server.  The budget rungs are ladder_widths(self._budget);
+        # warmup() pre-compiles every rung so a ladder move never
+        # retraces mid-serving.
+        self._adm = (_admission.AdmissionController(
+                         scope="serving",
+                         budget_rungs=_admission.ladder_widths(
+                             self._budget))
+                     if _flags.admission_enabled() else None)
 
     # -- request lifecycle --------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int = 32,
                stop: list | None = None, temperature: float = 0.0,
                top_k: int = 0, top_p: float = 1.0,
-               ttl_s: float | None = None, priority: int = 0) -> int:
+               ttl_s: float | None = None, priority: int = 0,
+               tenant: str | None = None) -> int:
         """``stop``: optional list of token SEQUENCES; generation ends
         (sequence included) as soon as the generated tail matches one.
 
@@ -881,19 +900,58 @@ class DecodeServer:
         QUEUED past its TTL is shed with the ``timeout`` status
         (``result`` raises ``resilience.DeadlineExceeded``) instead of
         occupying a slot.  ``priority`` (higher = keep longer): the OOM
-        degradation chain evicts the lowest-priority slots first."""
+        degradation chain evicts the lowest-priority slots first, and
+        admission control buckets it into three classes (<=0 low, 1
+        normal, >=2 high) for queue bounds and shed ordering.
+
+        ``tenant``: admission-control identity — with
+        ``PADDLE_TPU_TENANT_RATE`` set, each tenant's admitted tokens
+        (prompt + max_new) draw from its own token bucket; an empty
+        bucket REJECTS the request at the door (status ``rejected``,
+        ``result`` raises ``resilience.Overloaded`` — distinct from the
+        TTL ``timeout``: a reject is the back-off signal, the request
+        never queued).  ``tenant=None`` shares one default bucket."""
         req = self._build_request(prompt, max_new_tokens, stop,
                                   temperature, top_k, top_p, ttl_s,
-                                  priority)
-        self._queue.append(req)
+                                  priority, tenant=tenant)
         if self._tel:
             _telemetry.count("serving.requests_submitted")
+        if self._adm is not None:
+            self._adm.control_tick()
+            ok, _reason = self._adm.admit(
+                tenant, priority, len(req["prompt"]) + req["max_new"])
+            if not ok:
+                self._status[req["rid"]] = "rejected"
+                if self._tel:
+                    _telemetry.count("serving.requests_rejected")
+                self._tel_gauges()
+                return req["rid"]
+        self._queue.append(req)
+        if self._adm is not None:
+            self._shed_queue_overflow()
         self._admit()
         self._tel_gauges()
         return req["rid"]
 
+    def _shed_queue_overflow(self) -> None:
+        """Enforce the bounded per-class queues: while any class is over
+        ``PADDLE_TPU_ADMISSION_QUEUE_CAP``, retire the controller's
+        victim (lowest over-cap class, newest entry) with the
+        ``rejected`` status.  Runs after every enqueue, so the bound
+        holds between submits, not just eventually."""
+        while True:
+            i = self._adm.overflow_victim(self._queue)
+            if i is None:
+                return
+            req = self._queue.pop(i)
+            self._status[req["rid"]] = "rejected"
+            self._adm.count_shed(req.get("priority", 0), "queue_full")
+            if self._tel:
+                _telemetry.count("serving.requests_rejected")
+
     def _build_request(self, prompt, max_new_tokens, stop, temperature,
-                       top_k, top_p, ttl_s, priority) -> dict:
+                       top_k, top_p, ttl_s, priority,
+                       tenant=None) -> dict:
         """Validate one request and mint its queue entry (the shared
         half of :meth:`submit` and :meth:`submit_prefilled`)."""
         prompt, stop, ttl, top_k = validate_request(
@@ -919,6 +977,7 @@ class DecodeServer:
                 "temperature": float(temperature),
                 "top_k": top_k, "top_p": float(top_p),
                 "ttl": ttl, "priority": int(priority),
+                "tenant": tenant,
                 "t_submit": time.perf_counter(),
                 "t_enqueue": time.perf_counter()}
 
@@ -1041,6 +1100,7 @@ class DecodeServer:
         the slot frees for the next tenant, the server lives."""
         rid = st["rid"]
         self._status[rid] = "error"
+        self._err_reason[rid] = reason
         if self._paged:
             self._pool.free_slot(slot)
         self._free.append(slot)
@@ -1054,8 +1114,32 @@ class DecodeServer:
 
     def _admit(self):
         self._shed_expired()
+        # the OOM-chain cap binds every class (it is a memory bound);
+        # the controller's ladder cap is SHED pressure and binds class-0
+        # admissions only — throttling the high-priority traffic the
+        # ladder protects would make degradation self-defeating
+        cap = self._admit_cap
+        adm_cap = None
+        if self._adm is not None:
+            adm_cap = min(cap,
+                          self._adm.effective_admit_cap(self.max_batch))
+            if self._adm.engaged and len(self._queue) > 1:
+                # a CONFIGURED controller spends free slots on the
+                # highest priority class first (stable sort — FIFO
+                # within a class); the unconfigured default keeps
+                # strict FIFO so plain ADMISSION=1 matches the
+                # ADMISSION=0 admit order exactly
+                self._queue.sort(key=lambda r: (
+                    -_admission.priority_class(r.get("priority", 0)),
+                    r.get("t_enqueue", 0.0)))
         while self._queue and self._free \
-                and len(self._slots) < self._admit_cap:
+                and len(self._slots) < cap:
+            if (adm_cap is not None and len(self._slots) >= adm_cap
+                    and _admission.priority_class(
+                        self._queue[0].get("priority", 0)) == 0):
+                # queue is class-sorted, so a class-0 head means no
+                # higher-priority request is waiting either
+                break
             slot = self._free.pop()
             req = self._queue.pop(0)
             t_admit = time.perf_counter()
@@ -1078,11 +1162,26 @@ class DecodeServer:
                 "base": len(req["prompt"]) - len(req.get("carry", ())),
                 "ttl": req.get("ttl"),
                 "priority": req.get("priority", 0),
+                "tenant": req.get("tenant"),
+                # OOM-evict requeue aging (satellite: starvation bound):
+                # how many times this request has been evicted and
+                # re-queued; past PADDLE_TPU_EVICT_REQUEUE_MAX it fails
+                # honestly instead of thrashing forever
+                "evictions": req.get("evictions", 0),
                 "pos": 0,   # next position == index of the token to feed
                 # span timestamps (host clock only; never a device sync)
                 "t_submit": req.get("t_submit", t_admit),
                 "t_admit": t_admit,
             }
+            if self._spec_on and self._adm is not None \
+                    and self._adm.spec_forced():
+                # ladder rung >= RUNG_SPEC_OFF: this admission decodes
+                # plain, via the SAME per-slot flag the acceptance-rate
+                # fallback sets — verify passes stop competing with
+                # decode while the server is degraded
+                st["spec_off"] = True
+                if self._tel:
+                    _telemetry.count("admission.spec_forced")
             if self._tel:
                 _telemetry.observe(
                     "serving.queue_wait_ms",
@@ -1267,6 +1366,17 @@ class DecodeServer:
 
     # -- budgeted admission: chunked-prefill co-scheduling ------------------
 
+    def _effective_budget(self) -> int:
+        """The prefill chunk width NEW budgeted admissions claim at: the
+        base budget, or — under SLO degradation — the controller's
+        current pre-warmed ladder rung (admission.ladder_widths; every
+        rung is compiled by warmup(), so a ladder move is a host-side
+        executable pick, never a retrace).  With no controller this is
+        exactly ``self._budget``."""
+        if self._adm is None:
+            return self._budget
+        return self._adm.effective_budget(self._budget)
+
     def _claim_admitting(self, req, slot, st) -> bool:
         """Budgeted admission, part 1 (claim): reserve the slot and plan
         the prompt's chunk starts WITHOUT running any prefill.  The
@@ -1285,7 +1395,7 @@ class DecodeServer:
         prompt = req["prompt"]
         n = len(prompt)
         window = min(self.max_len, self.cfg.max_seq_len)
-        W = min(self._budget, window)
+        W = min(self._effective_budget(), window)
         if self._paged:
             from . import kv_pool as _kv
 
@@ -1322,6 +1432,11 @@ class DecodeServer:
         st["admitting"] = True
         st["admit_starts"] = starts
         st["admit_i"] = 0
+        # the chunk width the starts were planned at: _advance_admitting
+        # runs THIS width for the slot's whole admission even if the
+        # ladder moves the effective budget mid-flight (the starts and
+        # the executable must agree; new claims pick up the new rung)
+        st["admit_w"] = W
         # pos doubles as the prefill frontier: rows [starts[0], pos)
         # are written.  While admitting, decode dispatches feed
         # prompt[pos] at pos — the frontier row they write is rewritten
@@ -1355,7 +1470,10 @@ class DecodeServer:
         prompt = st["prompt"]
         n = len(prompt)
         window = min(self.max_len, self.cfg.max_seq_len)
-        W = min(self._budget, window)
+        # the width the slot's starts were planned at (see
+        # _claim_admitting); absent only for pre-upgrade state — then
+        # the base budget is what the starts were built from
+        W = st.get("admit_w") or min(self._budget, window)
         i = st["admit_i"]
         s = st["admit_starts"][i]
         chunk = prompt[s:s + W]
@@ -2090,10 +2208,12 @@ class DecodeServer:
         """Generated tokens (no prompt) once the request finished.
 
         A request shed past its deadline raises
-        ``resilience.DeadlineExceeded``; one failed by the NaN guard
-        raises ``RuntimeError`` — in both cases the request retired
-        CLEANLY (slot freed, server alive) and :meth:`status` reports
-        the disposition without raising."""
+        ``resilience.DeadlineExceeded``; one rejected by admission
+        control raises ``resilience.Overloaded`` (it never queued —
+        back off and resubmit); one failed by the NaN guard or the
+        evict-requeue bound raises ``RuntimeError`` — in all cases the
+        request retired CLEANLY (slot freed, server alive) and
+        :meth:`status` reports the disposition without raising."""
         if rid in self._dropped:
             raise RuntimeError(
                 f"request {rid} was abandoned unfinished when the server "
@@ -2102,16 +2222,24 @@ class DecodeServer:
         if disp == "timeout":
             raise _resilience.DeadlineExceeded(
                 f"request {rid} was shed: still queued past its ttl")
+        if disp == "rejected":
+            raise _resilience.Overloaded(
+                f"request {rid} was rejected by admission control "
+                f"(rate limit, queue bound, or overload shed) — it "
+                f"never queued; back off and resubmit")
         if disp == "error":
             raise RuntimeError(
-                f"request {rid} failed: non-finite logits (the request "
-                f"was retired cleanly; the server is still serving)")
+                f"request {rid} failed: "
+                f"{self._err_reason.get(rid, 'non-finite logits')} "
+                f"(the request was retired cleanly; the server is "
+                f"still serving)")
         return self._results[rid]
 
     def status(self, rid: int) -> str:
         """One of ``ok`` (result ready), ``timeout`` (deadline shed),
-        ``error`` (NaN guard), ``dropped`` (abandoned by close),
-        ``active`` (decoding), ``queued``."""
+        ``rejected`` (admission control refused it at the door),
+        ``error`` (NaN guard / evict-requeue bound), ``dropped``
+        (abandoned by close), ``active`` (decoding), ``queued``."""
         if rid in self._results:
             return "ok"
         disp = self._status.get(rid)
@@ -2152,11 +2280,15 @@ class DecodeServer:
             kv = sum(min(st["pos"], rows)
                      for st in self._slots.values()) \
                 / (self.max_batch * rows)
+        eff_cap = self._admit_cap
+        if self._adm is not None:
+            eff_cap = min(eff_cap,
+                          self._adm.effective_admit_cap(self.max_batch))
         return {
             "queue_depth": len(self._queue),
             "active_slots": act,
             "free_slots": min(len(self._free),
-                              max(0, self._admit_cap - act)),
+                              max(0, eff_cap - act)),
             "slot_occupancy": act / self.max_batch,
             "kv_utilization": kv,
             "admit_cap": self._admit_cap,
@@ -2173,6 +2305,13 @@ class DecodeServer:
             # this replica's speculation is paying for itself
             "spec_accept_rate": ((self._spec_acc / self._spec_prop)
                                  if self._spec_prop else None),
+            # admission-control verdict: the degradation ladder rung
+            # (0 = healthy) — the fleet router folds the worst replica
+            # rung into its OWN controller (absorb_fleet_rung) and
+            # sheds at the front door instead of stacking queues
+            "admission_rung": (0 if self._adm is None
+                               else self._adm.rung),
+            "slo_ok": self._adm is None or self._adm.rung == 0,
         }
 
     def drain_queue(self, rids=None) -> list:
@@ -2501,6 +2640,29 @@ class DecodeServer:
         if self._paged:
             self._pool.free_slot(slot)
         self._free.append(slot)
+        # requeue aging (the starvation bound): a request evicted more
+        # than PADDLE_TPU_EVICT_REQUEUE_MAX times is losing every race
+        # for a slot — fail it HONESTLY (status "error", counted) so
+        # the client learns, instead of the evict/re-admit/evict loop
+        # burning its progress forever while higher-priority work keeps
+        # arriving.  The slot still frees either way (the OOM chain got
+        # what it came for).
+        evictions = st.get("evictions", 0) + 1
+        cap = _flags.requeue_max()
+        if cap and evictions > cap:
+            rid = st["rid"]
+            self._status[rid] = "error"
+            self._err_reason[rid] = (
+                f"evicted {evictions} times (> "
+                f"PADDLE_TPU_EVICT_REQUEUE_MAX={cap}); giving up")
+            if self._tel:
+                _telemetry.count("serving.requests_failed")
+                _telemetry.count("resilience.evict_requeue_overflows")
+                _telemetry.event("serving.request_failed",
+                                 st.get("t_submit", time.perf_counter()),
+                                 time.perf_counter(), tid=slot, rid=rid,
+                                 reason="evict_requeue_overflow")
+            return True
         # full sequence = ORIGINAL prompt + generated (prompt[:base]
         # strips a previous eviction's carry — generated already holds
         # it, so a double-evicted request must not duplicate it)
@@ -2512,6 +2674,8 @@ class DecodeServer:
             "temperature": st.get("temperature", 0.0),
             "top_k": st.get("top_k", 0), "top_p": st.get("top_p", 1.0),
             "ttl": st.get("ttl"), "priority": st.get("priority", 0),
+            "tenant": st.get("tenant"),
+            "evictions": evictions,
             "carry": list(st["generated"]),
             "t_submit": st.get("t_submit", time.perf_counter()),
             # fresh queue-entry clock: TTL bounds queue wait, and this
@@ -2574,6 +2738,12 @@ class DecodeServer:
                              time.perf_counter(), error=str(exc)[:200])
 
     def tick(self):
+        if self._adm is not None:
+            # the SLO control loop rides the scheduler tick: at most
+            # one evaluation per PADDLE_TPU_SLO_WINDOW_S (control_tick
+            # self-gates), so this is a float compare on idle ticks
+            self._adm.control_tick(
+                idle=not self._slots and not self._queue)
         self._guarded(self._tick_impl)
 
     def _tick_impl(self):
@@ -3103,8 +3273,15 @@ class DecodeServer:
                             1 << max(0, int(n) - 1).bit_length())
             if self._budget:
                 # budgeted admission walks the budget-width chunk
-                # executable for every claimed (multi-chunk) prompt
-                widths = set(widths) | {min(self._budget, window)}
+                # executable for every claimed (multi-chunk) prompt —
+                # and, with admission control on, EVERY degradation-
+                # ladder rung (admission.ladder_widths): the SLO
+                # controller's budget moves must pick among compiled
+                # programs, never retrace mid-serving
+                rungs = (self._adm.budget_rungs if self._adm is not None
+                         else (self._budget,))
+                widths = set(widths) | {min(w, window)
+                                        for w in rungs or (self._budget,)}
             for C in sorted(set(widths)):
                 fn = _get_paged_prefill_fn(self.cfg, C, self._shard)
                 padded = jnp.zeros((1, C), jnp.int32)
@@ -3159,21 +3336,31 @@ class DecodeServer:
                                    self._draft_cache, padded,
                                    jnp.asarray(1), jnp.asarray(0)))
         if self._budget and not self._paged:
-            # budgeted admission's offset-aware chunk executable (width
-            # = budget): claims walk it regardless of which monolithic
-            # prefill flavor the server was configured with
-            Wb = min(self._budget, window)
-            bfn = _get_prefill_chunk_fn(self.cfg, self._shard, width=Wb)
-            pad_b = jnp.zeros((1, Wb), jnp.int32)
-            warm(f"prefill_chunk@{Wb}", lambda: bfn(
-                self.params, self.cache, pad_b, jnp.asarray(0),
-                jnp.asarray(1), jnp.asarray(0)))
-            if self._draft_cache is not None:
-                dbfn = _get_prefill_chunk_fn(self.draft_cfg,
-                                             self._shard, width=Wb)
-                warm_draft(f"draft_prefill_chunk@{Wb}", lambda: dbfn(
-                    self._draft_params, self._draft_cache, pad_b,
-                    jnp.asarray(0), jnp.asarray(1), jnp.asarray(0)))
+            # budgeted admission's offset-aware chunk executables: the
+            # base width, plus — with admission control on — every
+            # degradation-ladder rung (admission.ladder_widths), so the
+            # SLO controller's budget moves pick among compiled
+            # programs and never retrace mid-serving
+            rungs = (self._adm.budget_rungs if self._adm is not None
+                     else ()) or (self._budget,)
+            for Wb in sorted({min(w, window) for w in rungs},
+                             reverse=True):
+                bfn = _get_prefill_chunk_fn(self.cfg, self._shard,
+                                            width=Wb)
+                pad_b = jnp.zeros((1, Wb), jnp.int32)
+                warm(f"prefill_chunk@{Wb}",
+                     lambda bfn=bfn, pad_b=pad_b: bfn(
+                         self.params, self.cache, pad_b, jnp.asarray(0),
+                         jnp.asarray(1), jnp.asarray(0)))
+                if self._draft_cache is not None:
+                    dbfn = _get_prefill_chunk_fn(self.draft_cfg,
+                                                 self._shard, width=Wb)
+                    warm_draft(f"draft_prefill_chunk@{Wb}",
+                               lambda dbfn=dbfn, pad_b=pad_b: dbfn(
+                                   self._draft_params,
+                                   self._draft_cache, pad_b,
+                                   jnp.asarray(0), jnp.asarray(1),
+                                   jnp.asarray(0)))
         return timings
 
     def tick_block(self, block: int = 8):
@@ -3188,6 +3375,9 @@ class DecodeServer:
         block = int(block)
         if block < 1:
             raise ValueError(f"block must be >= 1, got {block}")
+        if self._adm is not None:
+            self._adm.control_tick(
+                idle=not self._slots and not self._queue)
         self._guarded(lambda: self._tick_block_impl(block))
 
     def _tick_block_impl(self, block: int):

@@ -1,0 +1,426 @@
+"""Overload-proof serving (round 13): SLO-driven admission control,
+per-tenant rate limits, and adaptive degradation.
+
+The contract under test, layer by layer:
+
+* ``AdmissionController`` (pure, deterministic ``now=`` clock): priority
+  classes, token buckets, bounded per-class queues with
+  newest-of-lowest-class overflow victims, and the degradation ladder —
+  one rung per breached SLO window, symmetric recovery on affirmatively
+  healthy windows, HOLD on sample-starved windows, idle-window reset.
+* ``DecodeServer`` wiring: the ``rejected`` status is a new terminal
+  state distinct from the TTL ``timeout`` (``resilience.Overloaded`` vs
+  ``resilience.DeadlineExceeded``), high-priority traffic survives
+  oversubscription, budget-rung switches ride pre-warmed widths (zero
+  mid-serving retraces), and ``PADDLE_TPU_ADMISSION=0`` — or the
+  default-on controller with nothing configured — is BIT-IDENTICAL to
+  the greedy baseline on both KV layouts and both dispatch modes.
+* ``fleet.Router``: replica rung verdicts absorb into the front door
+  (backpressure sheds before a request crosses the fleet).
+* ``faults``: the ``delay``/``overload`` kinds that drive the drills.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu import faults, resilience
+from paddle_tpu import telemetry as tl
+from paddle_tpu.framework import monitor
+from paddle_tpu.text import admission, fleet, gpt, serving
+
+_ADM_ENV = ("PADDLE_TPU_ADMISSION", "PADDLE_TPU_SLO_TTFT_MS",
+            "PADDLE_TPU_SLO_TPOT_MS", "PADDLE_TPU_SLO_WINDOW_S",
+            "PADDLE_TPU_TENANT_RATE", "PADDLE_TPU_TENANT_BURST",
+            "PADDLE_TPU_ADMISSION_QUEUE_CAP",
+            "PADDLE_TPU_EVICT_REQUEUE_MAX")
+
+
+def _cfg(**over):
+    kw = dict(vocab_size=64, hidden_size=64, num_layers=2, num_heads=4,
+              max_seq_len=128)
+    kw.update(over)
+    return gpt.GPTConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def cfg_params():
+    cfg = _cfg()
+    return cfg, gpt.init_params(cfg, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for k in _ADM_ENV:
+        monkeypatch.delenv(k, raising=False)
+    faults.reset()
+    tl.reset()
+    yield
+    faults.reset()
+
+
+def _count(name) -> int:
+    try:
+        return int(monitor.get_stat(name).get())
+    except Exception:
+        return 0
+
+
+def _prompts(cfg, seed=0, lens=(5, 7, 4)):
+    rng = np.random.default_rng(seed)
+    return [[int(x) for x in rng.integers(1, cfg.vocab_size, n)]
+            for n in lens]
+
+
+# ---------------------------------------------------------------------------
+# controller units: classes, widths, buckets, overflow victims
+# ---------------------------------------------------------------------------
+
+
+def test_priority_classes_and_ladder_widths():
+    assert [admission.priority_class(p) for p in (-3, 0, 1, 2, 9)] == \
+        [0, 0, 1, 2, 2]
+    # halvings floored at min(budget, 8), deduped, descending
+    assert admission.ladder_widths(64) == (64, 32, 16)
+    assert admission.ladder_widths(16) == (16, 8)
+    assert admission.ladder_widths(8) == (8,)
+    assert admission.ladder_widths(0) == ()
+
+
+def test_token_bucket_refill_and_burst():
+    b = admission.TokenBucket(rate=100.0, burst=200.0, now=0.0)
+    assert b.try_take(200, now=0.0)        # full burst available
+    assert not b.try_take(1, now=0.0)      # drained
+    assert b.try_take(100, now=1.0)        # 1s refill at rate 100
+    assert not b.try_take(1000, now=2.0)   # over burst cap: never
+
+
+def test_overflow_victim_newest_of_lowest_class():
+    q = [{"priority": 2, "t_enqueue": 1.0},
+         {"priority": 0, "t_enqueue": 2.0},
+         {"priority": 0, "t_enqueue": 5.0},
+         {"priority": 1, "t_enqueue": 9.0}]
+    adm = admission.AdmissionController(scope="t", queue_cap=1, now=0.0)
+    # class 0 holds 2 entries (> cap 1): victim is its NEWEST entry
+    assert adm.overflow_victim(q) == 2
+    # under cap everywhere -> no victim
+    assert adm.overflow_victim(q[:2]) is None
+
+
+# ---------------------------------------------------------------------------
+# the ladder, on a deterministic clock
+# ---------------------------------------------------------------------------
+
+
+def _feed_gaps(ms, n=6):
+    for _ in range(n):
+        tl.observe("serving.decode_gap_ms", ms)
+
+
+def test_ladder_climbs_holds_and_recovers():
+    adm = admission.AdmissionController(
+        scope="t", slo_tpot_ms=10.0, window_s=1.0,
+        budget_rungs=(64, 32, 16), now=0.0)
+    t = 0.0
+    # one rung per breached window, monotone through the whole ladder
+    for want in (1, 2, 3, 4):
+        _feed_gaps(50.0)
+        t += 1.01
+        assert adm.control_tick(now=t)
+        assert adm.rung == want
+    assert adm.rung == admission.RUNG_SHED
+    # every degradation lever at its rung
+    assert adm.effective_admit_cap(8) == 4
+    assert adm.budget_level == 2 and adm.effective_budget(64) == 16
+    assert adm.spec_forced() and adm.rejecting()
+    # a sample-starved window proves nothing: HOLD
+    tl.observe("serving.decode_gap_ms", 1.0)
+    t += 1.01
+    assert adm.control_tick(now=t)
+    assert adm.rung == admission.RUNG_SHED
+    # affirmatively healthy windows step down one per window
+    for want in (3, 2, 1, 0):
+        _feed_gaps(1.0)
+        t += 1.01
+        assert adm.control_tick(now=t)
+        assert adm.rung == want
+    assert adm.effective_budget(64) == 64 and not adm.spec_forced()
+
+
+def test_idle_window_resets_ladder_outright():
+    adm = admission.AdmissionController(
+        scope="t", slo_tpot_ms=10.0, window_s=1.0, now=0.0)
+    _feed_gaps(50.0)
+    assert adm.control_tick(now=1.01) and adm.rung == 1
+    _feed_gaps(50.0)
+    assert adm.control_tick(now=2.02) and adm.rung == 2
+    # zero-sample window + the caller vouching idle: straight to 0
+    assert adm.control_tick(now=3.03, idle=True)
+    assert adm.rung == 0
+
+
+def test_shed_rung_rejects_lowest_class_only():
+    adm = admission.AdmissionController(scope="t", now=0.0)
+    adm.rung = admission.RUNG_SHED
+    ok0, reason0 = adm.admit(None, 0, 10, now=0.0)
+    ok2, _ = adm.admit(None, 2, 10, now=0.0)
+    assert not ok0 and reason0
+    assert ok2
+
+
+def test_tenant_buckets_two_equal_tenants_within_20pct():
+    adm = admission.AdmissionController(
+        scope="t", tenant_rate=100.0, tenant_burst=200.0, now=0.0)
+    admitted = {"a": 0, "b": 0}
+    t = 0.0
+    for i in range(400):
+        t += 0.01
+        for tenant in ("a", "b"):
+            ok, _ = adm.admit(tenant, 0, 10, now=t)
+            if ok:
+                admitted[tenant] += 10
+    hi, lo = max(admitted.values()), min(admitted.values())
+    assert lo > 0 and (hi - lo) <= 0.2 * hi, admitted
+    assert adm.admitted_tokens["a"] == admitted["a"]
+    # both were throttled at some point (demand 2000 tok/s vs rate 100)
+    assert _count("admission.tenant_throttles") > 0
+
+
+# ---------------------------------------------------------------------------
+# faults: the delay / overload kinds
+# ---------------------------------------------------------------------------
+
+
+def test_delay_fault_grammar():
+    (f,) = faults.parse_spec("delay:tick:2:0.5")
+    assert (f.kind, f.site, f.nth, f.seconds) == ("delay", "tick", 2, 0.5)
+    (d,) = faults.parse_spec("delay:tick:0")
+    assert d.seconds is None               # default applied at check time
+    (o,) = faults.parse_spec("overload:admission.submit:1")
+    assert o.kind == "overload"
+    with pytest.raises(ValueError):
+        faults.parse_spec("delay:tick:0:nan-seconds")
+    with pytest.raises(ValueError):
+        faults.parse_spec("delay:tick:0:-1")
+    with pytest.raises(ValueError):
+        faults.parse_spec("oom:tick:0:0.5")   # 4th field is delay-only
+
+
+def test_delay_fault_sleeps_and_overload_needs_opt_in():
+    faults.install("delay:site_x:0:0.05")
+    t0 = time.perf_counter()
+    faults.check("site_x")                 # delay fires at EVERY check
+    assert time.perf_counter() - t0 >= 0.04
+    faults.install("overload:site_x:0")
+    faults.check("site_x")                 # no opt-in: benign
+    with pytest.raises(faults.InjectedOverload):
+        faults.check("site_x", kinds=("overload",))
+
+
+# ---------------------------------------------------------------------------
+# serving integration
+# ---------------------------------------------------------------------------
+
+
+def _serve_tokens(params, cfg, prompts, **kw):
+    srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=48, **kw)
+    rids = [srv.submit(p, max_new_tokens=6) for p in prompts]
+    while srv.pending():
+        srv.tick()
+    return [srv.result(r) for r in rids], srv
+
+
+@pytest.mark.parametrize("layout", ["contiguous", "paged"])
+@pytest.mark.parametrize("async_dispatch", [False, True])
+def test_admission_off_bit_parity(cfg_params, monkeypatch, layout,
+                                  async_dispatch):
+    """The exact-off-switch acceptance: PADDLE_TPU_ADMISSION=0 and the
+    default-on-but-unconfigured controller produce bit-identical greedy
+    tokens on every layout x dispatch combination."""
+    cfg, params = cfg_params
+    prompts = _prompts(cfg, seed=3)
+    monkeypatch.setenv("PADDLE_TPU_ADMISSION", "0")
+    ref, srv_off = _serve_tokens(params, cfg, prompts, layout=layout,
+                                 async_dispatch=async_dispatch)
+    assert srv_off._adm is None
+    monkeypatch.delenv("PADDLE_TPU_ADMISSION")
+    got, srv_on = _serve_tokens(params, cfg, prompts, layout=layout,
+                                async_dispatch=async_dispatch)
+    assert srv_on._adm is not None and not srv_on._adm.engaged
+    assert got == ref
+
+
+def test_queue_bound_sheds_lowest_class_first(cfg_params, monkeypatch):
+    """4x oversubscription against a 1-slot server with queue_cap=1:
+    the high-priority request rides out the burst, the newest
+    low-priority submissions shed with the ``rejected`` status and
+    ``resilience.Overloaded`` from result(), and the class-0 shed
+    counter engages."""
+    cfg, params = cfg_params
+    monkeypatch.setenv("PADDLE_TPU_ADMISSION_QUEUE_CAP", "1")
+    srv = serving.DecodeServer(params, cfg, max_batch=1, max_len=48)
+    low = [srv.submit(p, max_new_tokens=4, priority=0, tenant="bulk")
+           for p in _prompts(cfg, seed=4)]
+    gold = srv.submit(_prompts(cfg, seed=5)[0], max_new_tokens=4,
+                      priority=2, tenant="gold")
+    low += [srv.submit(p, max_new_tokens=4, priority=0, tenant="bulk")
+            for p in _prompts(cfg, seed=6)]
+    while srv.pending():
+        srv.tick()
+    assert srv.status(gold) == "ok" and len(srv.result(gold)) == 4
+    rejected = [r for r in low if srv.status(r) == "rejected"]
+    assert rejected and _count("admission.sheds_class0") >= len(rejected)
+    with pytest.raises(resilience.Overloaded):
+        srv.result(rejected[0])
+    # every shed is an honest terminal status; nothing silently vanished
+    assert all(srv.status(r) in ("ok", "rejected") for r in low)
+
+
+def test_rejected_is_distinct_from_timeout(cfg_params, monkeypatch):
+    """A shed-at-the-door reject and a TTL shed are different verdicts:
+    different status strings, different exceptions — a client must be
+    able to tell 'back off and resubmit' from 'too slow'."""
+    cfg, params = cfg_params
+    monkeypatch.setenv("PADDLE_TPU_ADMISSION_QUEUE_CAP", "1")
+    srv = serving.DecodeServer(params, cfg, max_batch=1, max_len=48)
+    p = _prompts(cfg, seed=7)
+    srv.submit(p[0], max_new_tokens=8)
+    slow = srv.submit(p[1], max_new_tokens=4, ttl_s=0.001)
+    burst = [srv.submit(p[2], max_new_tokens=4) for _ in range(3)]
+    time.sleep(0.01)
+    while srv.pending():
+        srv.tick()
+    assert srv.status(slow) == "timeout"
+    with pytest.raises(resilience.DeadlineExceeded):
+        srv.result(slow)
+    rej = [r for r in burst if srv.status(r) == "rejected"]
+    assert rej
+    with pytest.raises(resilience.Overloaded):
+        srv.result(rej[0])
+
+
+def test_injected_overload_fault_sheds_at_door(cfg_params):
+    cfg, params = cfg_params
+    srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=48)
+    faults.install("overload:admission.submit:1")
+    rid = srv.submit(_prompts(cfg)[0], max_new_tokens=4)
+    assert srv.status(rid) == "rejected"
+    with pytest.raises(resilience.Overloaded):
+        srv.result(rid)
+    assert _count("admission.sheds") >= 1
+
+
+def test_evict_requeue_bound_fails_honestly(cfg_params, monkeypatch):
+    """The starvation bound: a request OOM-evicted more than
+    PADDLE_TPU_EVICT_REQUEUE_MAX times stops cycling and fails with an
+    honest ``error`` + counter instead of thrashing forever."""
+    cfg, params = cfg_params
+    monkeypatch.setenv("PADDLE_TPU_EVICT_REQUEUE_MAX", "2")
+    srv = serving.DecodeServer(params, cfg, max_batch=1, max_len=48)
+    rid = srv.submit(_prompts(cfg)[0], max_new_tokens=8)
+    srv.tick()
+    for _ in range(2):                     # evictions 1, 2: requeue+readmit
+        assert srv._evict_one()
+        assert srv.status(rid) == "queued"
+        srv._admit()
+        assert srv.status(rid) == "active"
+    assert srv._evict_one()                # eviction 3 > cap: give up
+    assert srv.status(rid) == "error"
+    assert _count("resilience.evict_requeue_overflows") == 1
+    with pytest.raises(RuntimeError, match="evicted 3 times"):
+        srv.result(rid)
+
+
+def test_budget_rung_switch_never_retraces(cfg_params, monkeypatch):
+    """warmup() pre-compiles every ladder-rung prefill width; forcing
+    the controller through the whole ladder mid-serving must add ZERO
+    executables to the step cache."""
+    cfg, params = cfg_params
+    monkeypatch.setenv("PADDLE_TPU_ADMISSION_QUEUE_CAP", "8")
+    srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=48,
+                               prefill_budget=16)
+    assert srv._adm is not None
+    assert srv._adm.budget_rungs == admission.ladder_widths(16)
+    srv.warmup()
+    keys0 = set(serving._STEP_CACHE.keys())
+    long_prompt = _prompts(cfg, seed=8, lens=(30,))[0]
+    for rung in (0, 1, 2, 3):
+        srv._adm.rung = rung
+        rid = srv.submit(long_prompt, max_new_tokens=4)
+        while srv.pending():
+            srv.tick()
+        assert srv.status(rid) == "ok"
+    assert set(serving._STEP_CACHE.keys()) - keys0 == set()
+
+
+def test_slo_breach_degrades_live_server(cfg_params, monkeypatch):
+    """The chaos drill in miniature: an injected 20ms per-tick delay
+    against a 5ms TPOT SLO climbs the ladder on a LIVE server, then an
+    idle window recovers it to rung 0."""
+    cfg, params = cfg_params
+    monkeypatch.setenv("PADDLE_TPU_SLO_TPOT_MS", "5")
+    monkeypatch.setenv("PADDLE_TPU_SLO_WINDOW_S", "0.1")
+    srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=48)
+    faults.install("delay:tick:0:0.02")
+    rids = [srv.submit(p, max_new_tokens=10)
+            for p in _prompts(cfg, seed=9)]
+    rung_max = 0
+    while srv.pending():
+        srv.tick()
+        rung_max = max(rung_max, srv._adm.rung)
+    assert all(srv.status(r) == "ok" for r in rids)
+    assert rung_max >= 1 and _count("admission.degradations") >= 1
+    faults.reset()
+    t0 = time.perf_counter()
+    while srv._adm.rung > 0 and time.perf_counter() - t0 < 3.0:
+        srv.tick()
+        time.sleep(0.01)
+    assert srv._adm.rung == 0
+    assert _count("admission.recoveries") >= 1
+
+
+def test_load_stats_and_snapshot_carry_rung(cfg_params, monkeypatch):
+    cfg, params = cfg_params
+    monkeypatch.setenv("PADDLE_TPU_ADMISSION_QUEUE_CAP", "4")
+    srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=48)
+    srv._adm.rung = 2
+    srv._adm._set_gauges()
+    ls = srv.load_stats()
+    assert ls["admission_rung"] == 2 and ls["slo_ok"] is False
+    snap = tl.admission_snapshot()
+    assert snap["admission.rung"] == 2
+
+
+# ---------------------------------------------------------------------------
+# fleet backpressure
+# ---------------------------------------------------------------------------
+
+
+def test_router_absorbs_replica_rung_and_sheds_at_front_door(cfg_params):
+    cfg, params = cfg_params
+    replicas = [serving.DecodeServer(params, cfg, max_batch=1, max_len=48)
+                for _ in range(2)]
+    router = fleet.Router(replicas)
+    assert router._adm is not None
+    # one replica reports a fully-degraded ladder; the fleet mirror
+    # takes the max across healthy replicas on the next tick
+    replicas[0]._adm.rung = admission.RUNG_SHED
+    router.tick()
+    assert router._adm.rung == admission.RUNG_SHED
+    low = router.submit(_prompts(cfg)[0], max_new_tokens=4, priority=0)
+    assert router.status(low) == "rejected"
+    with pytest.raises(resilience.Overloaded):
+        router.result(low)
+    gold = router.submit(_prompts(cfg)[1], max_new_tokens=4, priority=2)
+    while router.pending():
+        router.tick()
+    assert router.status(gold) == "ok"
+    health = router.healthz()
+    assert health["admission"]["rung"] == admission.RUNG_SHED
+    # the replica recovers -> the mirror follows back down
+    replicas[0]._adm.rung = 0
+    router.tick()
+    assert router._adm.rung == 0

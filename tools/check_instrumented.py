@@ -111,6 +111,22 @@ SPEC_MARKERS = ("spec_accept", "spec_propose", "spec_fallback")
 ADMIT_FILE = os.path.join("paddle_tpu", "text", "serving.py")
 ADMIT_MARKERS = ("admitting", "advance_admit")
 
+# Admission-control lint (round 13, same rule family): every shed /
+# throttle / degrade / rate-limit path across the admission layer
+# (text/admission.py and the serving/fleet doors that consult it) must
+# count a telemetry counter (admission.* — sheds per class, tenant
+# throttles, degradations) or delegate to another marker-named callable.
+# Overload policy that shed requests invisibly would read as a healthy
+# server with mysteriously missing traffic — the counters ARE the
+# operator's evidence that load was refused, not lost.
+ADMISSION_FILES = (
+    os.path.join("paddle_tpu", "text", "admission.py"),
+    os.path.join("paddle_tpu", "text", "serving.py"),
+    os.path.join("paddle_tpu", "text", "fleet.py"),
+)
+ADMISSION_MARKERS = ("_shed", "shed_", "throttle", "degrade",
+                     "rate_limit")
+
 
 def _call_name(node: ast.Call):
     f = node.func
@@ -292,6 +308,34 @@ def scan_admit_source(src: str, filename: str = "<src>") -> list:
     return violations
 
 
+def scan_admission_source(src: str, filename: str = "<src>") -> list:
+    """Admission-control lint violations in one source string: a
+    function whose name carries an :data:`ADMISSION_MARKERS` marker (a
+    shed/throttle/degrade/rate-limit path) must contain a call to one
+    of :data:`COUNT_NAMES` or delegate to another marker-named
+    callable."""
+    tree = ast.parse(src, filename=filename)
+    violations = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and any(m in node.name for m in ADMISSION_MARKERS)):
+            continue
+        counted = any(
+            isinstance(n, ast.Call)
+            and (_call_name(n) in COUNT_NAMES
+                 or any(m in (_call_name(n) or "")
+                        for m in ADMISSION_MARKERS))
+            for n in ast.walk(node))
+        if not counted:
+            violations.append(
+                (filename, node.lineno,
+                 f"admission-control path {node.name}() records no "
+                 f"telemetry counter (count) — an uncounted shed/"
+                 f"throttle reads as a healthy server with missing "
+                 f"traffic"))
+    return violations
+
+
 def _walk_py(path: str) -> list:
     out = []
     for dirpath, _, names in sorted(os.walk(path)):
@@ -355,6 +399,13 @@ def scan_repo(root: str | None = None) -> list:
         with open(admit_path, encoding="utf-8") as f:
             violations.extend(scan_admit_source(
                 f.read(), os.path.relpath(admit_path, root)))
+    # admission-control lint: shed/throttle/degrade observability
+    for rel in ADMISSION_FILES:
+        adm_path = os.path.join(root, rel)
+        if os.path.exists(adm_path):
+            with open(adm_path, encoding="utf-8") as f:
+                violations.extend(scan_admission_source(
+                    f.read(), os.path.relpath(adm_path, root)))
     return violations
 
 
