@@ -1671,6 +1671,102 @@ def _overload_smoke():
                 os.environ[k] = v
 
 
+def _multilora_smoke():
+    """Multi-tenant adapter round, run by ``--config gpt --small`` (CI):
+    a 2-adapter batch must match each adapter's solo (merged-tree)
+    greedy decode token-for-token, a JSON-schema-constrained request
+    must complete PARSEABLE JSON, and serving the mixed stream after
+    ``warmup()`` must add zero ``_STEP_CACHE`` entries — a gather/mask
+    parity or retrace regression fails CI before a pool ever ships."""
+    import json as _json
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.text import adapters, gpt, lora, serving
+
+    cfg = gpt.GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                        num_heads=4, max_seq_len=64)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+
+    def mk_adapter(seed):
+        key = jax.random.PRNGKey(seed)
+        ad = lora.split_lora(lora.lora_init(params, cfg, rank=4,
+                                            key=key))[1]
+        out = {}
+        for name, v in ad.items():
+            if name.endswith("_lora_b"):
+                key, sub = jax.random.split(key)
+                out[name] = 0.3 * jax.random.normal(sub, v.shape,
+                                                    jnp.float32)
+            else:
+                out[name] = v
+        return out
+
+    ads = {"prod-a": mk_adapter(1), "prod-b": mk_adapter(2)}
+    pool = adapters.AdapterPool(params, cfg, rank=4, max_adapters=2)
+    for name, ad in ads.items():
+        pool.register(name, ad)
+    rng = np.random.default_rng(7)
+    prompts = {name: [int(x) for x in rng.integers(1, 100, 5)]
+               for name in ads}
+
+    def solo_greedy(p, prompt, max_new):
+        from paddle_tpu.text import generate as G
+        cache = G.init_cache(cfg, 1, cfg.max_seq_len)
+        out, tok = [], None
+        for pos in range(len(prompt) + max_new - 1):
+            cur = prompt[pos] if pos < len(prompt) else tok
+            l, cache = G.decode_step(p, cache,
+                                     jnp.asarray([cur], jnp.int32),
+                                     pos, cfg)
+            if pos >= len(prompt) - 1:
+                tok = int(np.asarray(jnp.argmax(l, -1))[0])
+                out.append(tok)
+        return out
+
+    # token id == char code: the schema automaton walks decoded bytes
+    vocab = [chr(i) for i in range(cfg.vocab_size)]
+    schema = {"type": "object",
+              "properties": {"ok": {"type": "boolean"}}}
+    spec = adapters.JsonSchemaConstraint(schema, vocab)
+
+    srv = serving.DecodeServer(params, cfg, max_batch=3, max_len=64,
+                               adapter_pool=pool)
+    srv.warmup(sample=True, constrained=True)
+    keys0 = set(serving._STEP_CACHE.keys())
+    rids = {name: srv.submit(prompts[name], max_new_tokens=10,
+                             adapter=name) for name in ads}
+    rid_c = srv.submit([int(x) for x in rng.integers(1, 100, 4)],
+                       max_new_tokens=20, constraint=spec)
+    while srv.pending():
+        srv.tick()
+    got = {name: srv.result(r) for name, r in rids.items()}
+    text = "".join(vocab[t] for t in srv.result(rid_c))
+    srv.close()
+    for name in ads:
+        want = solo_greedy(lora.join_lora(params, ads[name]),
+                           prompts[name], 10)
+        if got[name] != want:
+            raise AssertionError(
+                f"multilora smoke: adapter {name!r} batched tokens "
+                f"diverge from its merged-tree solo decode "
+                f"({got[name]} vs {want})")
+    doc = _json.loads(text)                  # raises = smoke fails
+    if not isinstance(doc.get("ok"), bool):
+        raise AssertionError(
+            f"multilora smoke: constrained output {text!r} is not the "
+            f"schema's shape")
+    added = set(serving._STEP_CACHE.keys()) - keys0
+    if added:
+        raise AssertionError(
+            f"multilora smoke: post-warmup serving retraced — new "
+            f"executables {sorted(added)}")
+    return {"ok": True, "adapters": len(ads),
+            "constrained_json": text}
+
+
 def bench_gpt(small: bool):
     if small:
         rec = _run_gpt_rung(-1)
@@ -1700,6 +1796,11 @@ def bench_gpt(small: bool):
         # low-priority sheds + Overloaded, idle recovery to rung 0, and
         # zero mid-serving retraces asserted (see _overload_smoke)
         rec["overload_smoke"] = _overload_smoke()
+        # multi-tenant adapter serving rides the CI smoke: 2-adapter
+        # batch parity vs merged-tree solo decode + a JSON-schema-
+        # constrained request completing valid JSON + zero post-warmup
+        # retraces asserted (see _multilora_smoke)
+        rec["multilora_smoke"] = _multilora_smoke()
         # provenance-schema gate (CI): a bench line whose provenance
         # block is missing or incomplete must fail the smoke — a silent
         # CPU fallback can never again ship as an unlabeled number
@@ -3402,12 +3503,144 @@ def bench_spec(small: bool):
     return _stamp_provenance(rec, dev)
 
 
+def bench_multilora(small: bool):
+    """Batched multi-LoRA serving vs sequential per-adapter passes
+    (round 14, the S-LoRA/Punica shape): N products, each a LoRA over
+    one shared base model, each with a request in flight.  The batched
+    arm serves all N in ONE batch — per-slot adapter gather inside the
+    jitted step — while the sequential baseline re-points the same
+    server at one product at a time (the only option without the
+    gather: N passes, N-1 idle slots each), measuring aggregate tok/s
+    across the whole product set.
+
+    Asserted: the batched arm's per-request tokens are bit-identical to
+    the sequential arm's (the gather IS the merge), aggregate
+    throughput is >= 2x sequential, and the measured passes add zero
+    ``_STEP_CACHE`` entries after the warm pass (no mid-serving
+    retraces)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu import flags
+    from paddle_tpu.text import adapters, gpt, lora, serving
+
+    dev = jax.devices()[0]
+    if small:
+        cfg = gpt.GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                            num_heads=4, max_seq_len=128)
+        N, rank, max_len, new_toks, iters = 4, 4, 64, 16, 2
+        p_lens = (6, 12, 9, 15)
+    else:
+        cfg = gpt.GPTConfig(vocab_size=50304, hidden_size=1024,
+                            num_layers=24, num_heads=16, max_seq_len=2048)
+        N, rank, max_len, new_toks, iters = 8, 16, 1024, 64, 2
+        p_lens = (64, 128, 256, 96, 64, 192, 128, 320)
+    names = [f"prod-{i}" for i in range(N)]
+    params = jax.tree_util.tree_map(
+        jnp.asarray, jax.device_get(gpt.init_params(cfg,
+                                                    jax.random.PRNGKey(0))))
+
+    def mk_adapter(seed):
+        key = jax.random.PRNGKey(seed)
+        ad = lora.split_lora(lora.lora_init(params, cfg, rank=rank,
+                                            key=key))[1]
+        out = {}
+        for leaf, v in ad.items():
+            if leaf.endswith("_lora_b"):
+                key, sub = jax.random.split(key)
+                out[leaf] = 0.1 * jax.random.normal(sub, v.shape,
+                                                    jnp.float32)
+            else:
+                out[leaf] = v
+        return out
+
+    pool = adapters.AdapterPool(params, cfg, rank=rank, max_adapters=N)
+    for i, name in enumerate(names):
+        pool.register(name, mk_adapter(i + 1))
+    rng = np.random.default_rng(0)
+    prompts = {name: [int(x) for x in rng.integers(1, cfg.vocab_size, n)]
+               for name, n in zip(names, p_lens)}
+
+    def serve_pass(jobs):
+        """jobs: list of (adapter_name, prompt) served in one batch —
+        the server geometry (and so every executable) is IDENTICAL
+        across arms; only occupancy differs."""
+        srv = serving.DecodeServer(params, cfg, max_batch=N,
+                                   max_len=max_len, adapter_pool=pool)
+        rids = [(name, srv.submit(p, max_new_tokens=new_toks,
+                                  adapter=name)) for name, p in jobs]
+        while srv.pending():
+            srv.tick()
+        out = {name: srv.result(r) for name, r in rids}
+        srv.close()
+        return out
+
+    all_jobs = [(name, prompts[name]) for name in names]
+
+    def batched_pass():
+        return serve_pass(all_jobs)
+
+    def sequential_pass():
+        out = {}
+        for job in all_jobs:
+            out.update(serve_pass([job]))
+        return out
+
+    batched_pass()                            # warm (compiles)
+    sequential_pass()
+    keys0 = set(serving._STEP_CACHE.keys())
+    t0 = time.perf_counter()
+    got_b = None
+    for _ in range(iters):
+        got_b = batched_pass()
+    dt_b = (time.perf_counter() - t0) / iters
+    t0 = time.perf_counter()
+    got_s = None
+    for _ in range(iters):
+        got_s = sequential_pass()
+    dt_s = (time.perf_counter() - t0) / iters
+    if got_b != got_s:
+        raise AssertionError(
+            "multilora bench: batched multi-adapter tokens diverge "
+            "from sequential per-adapter serving")
+    added = set(serving._STEP_CACHE.keys()) - keys0
+    if added:
+        raise AssertionError(
+            f"multilora bench: measured passes retraced — new "
+            f"executables {sorted(added)}")
+    total = sum(len(t) for t in got_b.values())
+    tok_s_b, tok_s_s = total / dt_b, total / dt_s
+    speedup = tok_s_b / max(tok_s_s, 1e-9)
+    if small and speedup < 2.0:
+        raise AssertionError(
+            f"multilora bench: batched {tok_s_b:.1f} tok/s vs "
+            f"sequential {tok_s_s:.1f} — {speedup:.2f}x < 2x aggregate "
+            f"throughput")
+    rec = {"metric": "tokens_per_sec_serving_multilora",
+           "unit": "tokens/s/chip",
+           "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
+               timespec="seconds"),
+           "device": dev.platform,
+           "device_kind": str(getattr(dev, "device_kind", "")),
+           "adapters": N, "rank": rank, "batch": N,
+           "max_len": max_len, "new_tokens": new_toks,
+           "prompt_lens": list(p_lens),
+           "value": round(tok_s_b, 2),
+           "sequential_tok_s": round(tok_s_s, 2),
+           "aggregate_speedup": round(speedup, 3),
+           "kv_dtype": flags.kv_cache_dtype() or "compute",
+           "vs_baseline": 0.0}
+    return _stamp_provenance(rec, dev)
+
+
 _CONFIGS = {"gpt": bench_gpt, "train": bench_train, "mnist": bench_mnist,
             "resnet": bench_resnet, "bert": bench_bert, "int8": bench_int8,
             "decode": bench_decode, "decode_long": bench_decode_long,
             "serving": bench_serving, "paged": bench_paged,
             "fleet": bench_fleet, "spec": bench_spec,
-            "mixed": bench_mixed, "overload": bench_overload}
+            "mixed": bench_mixed, "overload": bench_overload,
+            "multilora": bench_multilora}
 
 
 def main():

@@ -703,7 +703,17 @@ class Router:
         """Least-loaded healthy replica with admission capacity (free
         slots, or queue headroom under ``max_queue``) — ordered by
         queue depth, then slot occupancy, then KV utilization: the
-        telemetry-gauge triple as a routing key."""
+        telemetry-gauge triple as a routing key.
+
+        ``load_stats()`` also reports multi-tenant shape —
+        ``adapters_active`` (per-adapter occupied-slot counts, when the
+        replica carries an :class:`~paddle_tpu.text.adapters.AdapterPool`)
+        and ``constrained_slots`` (slots decoding under a logits-mask
+        constraint).  These are deliberately NOT in the score: adapter
+        gathers and host-side masking cost the same tick either way, so
+        load alone routes correctly; the fields exist so operators (and
+        an affinity-aware router subclass) can see which replica serves
+        which tenant mix."""
         best, best_score = None, None
         for i, r in enumerate(self.replicas):
             if not self._ok[i] or i in exclude:

@@ -10,8 +10,13 @@ every weight consumer already resolves through ``woq.w`` — which adds
   * LoRA over a float base (classic fine-tuning),
   * QLoRA: the base stored int8/int4 (woq.quantize_gpt_*), adapters fp32
     — fine-tune a model whose weights don't fit in HBM at full precision,
-  * LoRA'd DECODE: generate/serving read the same accessor, so adapted
-    models generate without merging.
+  * LoRA'd DECODE: offline ``generate`` resolves adapted trees through
+    the same accessor, so adapted models generate without merging; the
+    serving path gets there via ``text/adapters.py`` — the batched
+    multi-LoRA steps gather each slot's adapter pair from an
+    :class:`~paddle_tpu.text.adapters.AdapterPool` stack and merge the
+    leaves into ``params["blocks"]`` inside the jitted step, at which
+    point ``woq.w`` applies the delta exactly as offline decode does.
 
 ``b`` initializes to zero (standard LoRA), so an adapted model is exactly
 the base model at step 0.  The conventional alpha/r scale is folded into
@@ -43,7 +48,7 @@ import jax.numpy as jnp
 from . import gpt, woq
 
 __all__ = ["lora_init", "split_lora", "join_lora", "merge_lora",
-           "build_lora_train_step"]
+           "stack_adapters", "unstack_adapters", "build_lora_train_step"]
 
 _SUFFIX_A, _SUFFIX_B = "_lora_a", "_lora_b"
 
@@ -94,6 +99,57 @@ def join_lora(base: dict, adapters: dict) -> dict:
 
 
 _join = join_lora  # internal alias
+
+
+def stack_adapters(adapter_list: list) -> dict:
+    """Stack N adapter sub-trees (``split_lora(tree)[1]`` dicts) into
+    one pytree of ``[N, ...]`` leaves — the AdapterPool storage form.
+
+    Validates the pool invariant: every adapter must carry the SAME
+    target set at the SAME rank (one gathered einsum shape serves every
+    slot; a mixed-rank pool would need per-rank executables)."""
+    if not adapter_list:
+        raise ValueError("stack_adapters: empty adapter list")
+    ref = adapter_list[0]
+    names = set(ref)
+    ranks = {k: ref[k].shape[-1] for k in ref if k.endswith(_SUFFIX_A)}
+    if not names or not ranks:
+        raise ValueError(
+            "stack_adapters: first entry has no lora leaves (pass "
+            "split_lora(tree)[1] dicts)")
+    for i, ad in enumerate(adapter_list[1:], start=1):
+        if set(ad) != names:
+            raise ValueError(
+                f"stack_adapters: adapter {i} targets {sorted(set(ad))} "
+                f"!= adapter 0 targets {sorted(names)} (same targets "
+                f"across the pool)")
+        for k, r in ranks.items():
+            if ad[k].shape[-1] != r:
+                raise ValueError(
+                    f"stack_adapters: adapter {i} leaf {k} rank "
+                    f"{ad[k].shape[-1]} != adapter 0 rank {r} (same rank "
+                    f"across the pool)")
+        for k in names:
+            if tuple(ad[k].shape) != tuple(ref[k].shape):
+                raise ValueError(
+                    f"stack_adapters: adapter {i} leaf {k} shape "
+                    f"{tuple(ad[k].shape)} != {tuple(ref[k].shape)}")
+    return {k: jnp.stack([jnp.asarray(ad[k], jnp.float32)
+                          for ad in adapter_list])
+            for k in sorted(names)}
+
+
+def unstack_adapters(stacked: dict) -> list:
+    """Inverse of :func:`stack_adapters`: ``[N, ...]`` leaves back to N
+    per-adapter sub-trees."""
+    if not stacked:
+        raise ValueError("unstack_adapters: empty tree")
+    ns = {v.shape[0] for v in stacked.values()}
+    if len(ns) != 1:
+        raise ValueError(
+            f"unstack_adapters: inconsistent leading axes {sorted(ns)}")
+    (n,) = ns
+    return [{k: v[i] for k, v in stacked.items()} for i in range(n)]
 
 
 def merge_lora(params: dict) -> dict:

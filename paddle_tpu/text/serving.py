@@ -65,7 +65,7 @@ def decode_step_batched(params, cache, token, pos, cfg: gpt.GPTConfig):
     return logits, new
 
 
-def _sample_batched(logits, key, temp, topk, topp):
+def _sample_batched(logits, key, temp, topk, topp, mask=None):
     """Per-slot sampling over batched logits [B, V]: temperature scale,
     then top-k, then nucleus — the same pipeline (and order) as
     ``generate``'s sampler, vectorized with PER-SLOT parameters so one
@@ -73,7 +73,16 @@ def _sample_batched(logits, key, temp, topk, topp):
     temp/topp are float32 [B], topk int32 [B] (0 = off); slots with
     temp == 0 take the argmax of the raw logits (bit-identical to the
     greedy path).  The filter math lives in generate._filter_logits —
-    the single shared implementation."""
+    the single shared implementation.
+
+    ``mask``: optional additive constraint mask [B, V] float32
+    (0 = allowed, ``adapters.NEG_INF`` = banned — see
+    text/adapters.mask_logits), applied BEFORE both branches so greedy
+    (temp == 0) slots take the argmax of the MASKED logits: one
+    executable serves constrained-greedy and constrained-sampled.  An
+    all-zero row is exactly the unconstrained math."""
+    if mask is not None:
+        logits = logits + mask
     scaled = generate._filter_logits(logits, temp, topk, topp)
     sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -81,12 +90,13 @@ def _sample_batched(logits, key, temp, topk, topp):
 
 
 def sample_step_batched(params, cache, tok, pos, key, temp, topk, topp,
-                        cfg: gpt.GPTConfig):
+                        cfg: gpt.GPTConfig, mask=None):
     """One batched decode step that returns sampled TOKENS [B] (greedy
     where temp == 0) instead of logits — the sampling-serving twin of
-    decode_step_batched."""
+    decode_step_batched.  ``mask`` (optional [B, V] additive constraint
+    mask, see _sample_batched) rides through to the sampler."""
     logits, cache = decode_step_batched(params, cache, tok, pos, cfg)
-    return _sample_batched(logits, key, temp, topk, topp), cache
+    return _sample_batched(logits, key, temp, topk, topp, mask=mask), cache
 
 
 def sample_block_batched(params, cache, tok, pos, base_key, off, temp, topk,
@@ -512,6 +522,210 @@ def _get_spec_verify_fn(cfg: gpt.GPTConfig, k: int, paged: bool = False,
     return fn
 
 
+# -- adapter-aware getters (multi-tenant serving: text/adapters.py) --------
+#
+# Every getter below keys on ``pkey`` (AdapterPool.pool_key() — the pool
+# GEOMETRY: capacity/rank/targets) next to the usual cfg/layout/placement
+# fragments, so two servers sharing one pool share executables while a
+# differently-shaped pool compiles its own.  The stacked lora leaves ride
+# as a replicated extra input (arg 2, NEVER donated — the pool keeps the
+# live copy; only the cache at arg 1 aliases), and registering an adapter
+# is a row write into fixed [A, ...] shapes — zero mid-serving retraces.
+
+
+def _get_adapter_step_fn(cfg: gpt.GPTConfig, pkey, paged: bool = False,
+                         shard=None):
+    """Greedy adapter-gathered batched step: (p, cache, stacks, ids [B],
+    tok [B], pos [B]) -> (logits [B, V], cache)."""
+    from . import adapters as _adapters
+
+    k = ("adapter_step", generate._cfg_key(cfg), pkey, paged,
+         _shard_key(shard))
+    fn = _STEP_CACHE.get(k)
+    if fn is None:
+        fn = generate._watch_jit("serving.adapter_step", k, jax.jit(
+            lambda p, c, ad, ids, t, s, _cfg=cfg:
+            _adapters.adapter_decode_step_batched(p, c, ad, ids, t, s,
+                                                  _cfg),
+            donate_argnums=generate._donate_cache(),
+            **_shard_kw(shard, 4, "rc")))
+        _STEP_CACHE[k] = fn
+    return fn
+
+
+def _get_adapter_sample_step_fn(cfg: gpt.GPTConfig, pkey,
+                                paged: bool = False, shard=None):
+    """Adapter-gathered sampled/masked step: the constraint mask [B, V]
+    is a plain array input (all-zero = unconstrained), so per-request
+    automaton state never retraces anything."""
+    from . import adapters as _adapters
+
+    k = ("adapter_sample", generate._cfg_key(cfg), pkey, paged,
+         _shard_key(shard))
+    fn = _STEP_CACHE.get(k)
+    if fn is None:
+        fn = generate._watch_jit("serving.adapter_sample_step", k,
+                                 jax.jit(
+            lambda p, c, ad, ids, t, s, ky, te, tk, tp, m, _cfg=cfg:
+            _adapters.adapter_sample_step_batched(
+                p, c, ad, ids, t, s, ky, te, tk, tp, m, _cfg),
+            donate_argnums=generate._donate_cache(),
+            **_shard_kw(shard, 9, "rc")))
+        _STEP_CACHE[k] = fn
+    return fn
+
+
+def _get_adapter_block_fn(cfg: gpt.GPTConfig, k: int, pkey,
+                          paged: bool = False, shard=None):
+    """Adapter-gathered greedy block (tick_block's gathered twin)."""
+    from . import adapters as _adapters
+
+    key = ("adapter_block", generate._cfg_key(cfg), k, pkey, paged,
+           _shard_key(shard))
+    fn = _STEP_CACHE.get(key)
+    if fn is None:
+        fn = generate._watch_jit(f"serving.adapter_block@{k}", key,
+                                 jax.jit(
+            lambda p, c, ad, ids, t, s, _cfg=cfg, _k=k:
+            _adapters.adapter_decode_block_batched(p, c, ad, ids, t, s,
+                                                   _k, _cfg),
+            donate_argnums=generate._donate_cache(),
+            **_shard_kw(shard, 4, "rcrr")))
+        _STEP_CACHE[key] = fn
+    return fn
+
+
+def _get_adapter_async_step_fn(cfg: gpt.GPTConfig, pkey,
+                               paged: bool = False, shard=None):
+    """Adapter-gathered async step: the device-side feed select of
+    _get_async_step_fn plus the per-slot gather.  No mask input —
+    constrained slots force the sync path (the mask must be built from
+    the PREVIOUS token, which an async pipeline hasn't fetched yet)."""
+    from . import adapters as _adapters
+
+    k = ("adapter_async", generate._cfg_key(cfg), pkey, paged,
+         _shard_key(shard))
+    fn = _STEP_CACHE.get(k)
+    if fn is None:
+        fn = generate._watch_jit("serving.adapter_async_step", k,
+                                 jax.jit(
+            lambda p, c, ad, ids, ht, pm, pv, s, ky, te, tk, tp,
+            _cfg=cfg:
+            _adapters.adapter_sample_step_batched(
+                p, c, ad, ids, jnp.where(pm, pv, ht), s, ky, te, tk,
+                tp, None, _cfg),
+            donate_argnums=generate._donate_cache(),
+            **_shard_kw(shard, 10, "rc")))
+        _STEP_CACHE[k] = fn
+    return fn
+
+
+def _get_adapter_spec_verify_fn(cfg: gpt.GPTConfig, k: int, pkey,
+                                paged: bool = False, shard=None):
+    """Adapter-gathered speculative verify: the verify pass gathers the
+    SAME per-slot adapter the decode step uses, so accepted tokens are
+    exactly the adapter-aware target's tokens (the base-model draft
+    only affects the acceptance RATE, never the output)."""
+    from . import adapters as _adapters
+
+    key = ("adapter_spec_verify", generate._cfg_key(cfg), int(k), pkey,
+           paged, _shard_key(shard))
+    fn = _STEP_CACHE.get(key)
+    if fn is None:
+        fn = generate._watch_jit(f"serving.adapter_spec_verify@{k}", key,
+                                 jax.jit(
+            lambda p, c, ad, ids, t, s, _cfg=cfg:
+            _adapters.adapter_spec_verify_batched(p, c, ad, ids, t, s,
+                                                  _cfg),
+            donate_argnums=generate._donate_cache(),
+            **_shard_kw(shard, 4, "rc")))
+        _STEP_CACHE[key] = fn
+    return fn
+
+
+def _get_adapter_prefill_fn(cfg: gpt.GPTConfig, bucket: int, pkey,
+                            shard=None):
+    """Whole-prompt admission under one slot's adapter (scalar aid):
+    the prompt's cache rows must reflect the ADAPTED weights, or decode
+    would attend base-model rows."""
+    from . import adapters as _adapters
+
+    k = ("adapter_prefill", generate._cfg_key(cfg), int(bucket), pkey,
+         _shard_key(shard))
+    fn = _STEP_CACHE.get(k)
+    if fn is None:
+        fn = generate._watch_jit(f"serving.adapter_prefill@{bucket}", k,
+                                 jax.jit(
+            lambda p, c, ad, aid, t, ln, sl, _cfg=cfg:
+            _adapters.adapter_prefill_slot(p, c, ad, aid, t, ln, sl,
+                                           _cfg),
+            donate_argnums=generate._donate_cache(),
+            **_shard_kw(shard, 5, "rc")))
+        _STEP_CACHE[k] = fn
+    return fn
+
+
+def _get_adapter_prefill_chunk_fn(cfg: gpt.GPTConfig, pkey, shard=None,
+                                  width: int | None = None):
+    """Fixed-chunk / budgeted admission under one slot's adapter (the
+    adapter twin of _get_prefill_chunk_fn, same width keying)."""
+    from . import adapters as _adapters
+
+    k = ("adapter_prefill_chunk", generate._cfg_key(cfg), pkey,
+         _shard_key(shard), None if width is None else int(width))
+    fn = _STEP_CACHE.get(k)
+    if fn is None:
+        name = ("serving.adapter_prefill_chunk" if width is None
+                else f"serving.adapter_prefill_chunk@{int(width)}")
+        fn = generate._watch_jit(name, k, jax.jit(
+            lambda p, c, ad, aid, t, p0, ln, sl, _cfg=cfg:
+            _adapters.adapter_prefill_slot_chunk(p, c, ad, aid, t, p0,
+                                                 ln, sl, _cfg),
+            donate_argnums=generate._donate_cache(),
+            **_shard_kw(shard, 6, "rc")))
+        _STEP_CACHE[k] = fn
+    return fn
+
+
+def _get_adapter_paged_prefill_fn(cfg: gpt.GPTConfig, bucket: int, pkey,
+                                  shard=None):
+    """Paged admission chunk under one slot's adapter."""
+    from . import adapters as _adapters
+
+    k = ("adapter_paged_prefill", generate._cfg_key(cfg), int(bucket),
+         pkey, _shard_key(shard))
+    fn = _STEP_CACHE.get(k)
+    if fn is None:
+        fn = generate._watch_jit(
+            f"serving.adapter_paged_prefill@{bucket}", k, jax.jit(
+                lambda p, c, ad, aid, t, p0, ln, sl, _cfg=cfg:
+                _adapters.adapter_paged_prefill_chunk(
+                    p, c, ad, aid, t, p0, ln, sl, _cfg),
+                donate_argnums=generate._donate_cache(),
+                **_shard_kw(shard, 6, "rc")))
+        _STEP_CACHE[k] = fn
+    return fn
+
+
+def _get_masked_step_fn(cfg: gpt.GPTConfig, paged: bool = False,
+                        shard=None):
+    """Constrained step for servers WITHOUT an adapter pool: the plain
+    sampled step plus the [B, V] constraint mask input.  Greedy slots
+    (temp 0) take the argmax of the masked logits — see
+    _sample_batched."""
+    k = ("masked_step", generate._cfg_key(cfg), paged, _shard_key(shard))
+    fn = _STEP_CACHE.get(k)
+    if fn is None:
+        fn = generate._watch_jit("serving.masked_step", k, jax.jit(
+            lambda p, c, t, s, ky, te, tk, tp, m, _cfg=cfg:
+            sample_step_batched(p, c, t, s, ky, te, tk, tp, _cfg,
+                                mask=m),
+            donate_argnums=generate._donate_cache(),
+            **_shard_kw(shard, 7, "rc")))
+        _STEP_CACHE[k] = fn
+    return fn
+
+
 def _pow2_bucket(n: int, *bounds) -> int:
     """Smallest power of two >= ``n``, clamped to the given upper
     bounds — THE prompt-bucket rule.  The bucket is a jit-cache key, so
@@ -581,7 +795,8 @@ class DecodeServer:
                  device=None,
                  draft_cfg: gpt.GPTConfig | None = None,
                  draft_params=None, spec_k: int | None = None,
-                 prefill_budget: int | None = None):
+                 prefill_budget: int | None = None,
+                 adapter_pool=None):
         self.params = params
         # telemetry (request tracing + latency histograms + gauges):
         # decided once at construction — per-tick records are lock-cheap
@@ -878,6 +1093,34 @@ class DecodeServer:
                          budget_rungs=_admission.ladder_widths(
                              self._budget))
                      if _flags.admission_enabled() else None)
+        # multi-tenant adapter pool (text/adapters.py): N LoRA products
+        # served from ONE base server.  The pool's stacked [A, ...]
+        # leaves join every step call as a replicated extra input and the
+        # jitted step gathers each slot's (a, b) pair by its int32
+        # adapter id — id 0 is the all-zero base row, so a pool-attached
+        # server with only base traffic produces the SAME TOKENS as a
+        # pool-less one (the delta is + 0.0).  pool=None keeps every
+        # code path byte-identical to the pre-adapter server.
+        self._adapters = adapter_pool
+        if adapter_pool is not None:
+            if mesh is not None:
+                raise NotImplementedError(
+                    "adapter_pool with tensor-parallel serving: the "
+                    "stacked lora leaves would need their own sharding "
+                    "specs (replicate-or-split per target) — not built "
+                    "yet")
+            if (generate._cfg_key(adapter_pool.cfg)
+                    != generate._cfg_key(cfg)):
+                raise ValueError(
+                    "adapter_pool was built for a different GPTConfig "
+                    "than this server — pool and server must share the "
+                    "base model geometry")
+            if any(k.endswith(("_lora_a", "_lora_b"))
+                   for k in params["blocks"]):
+                raise ValueError(
+                    "params already carry lora leaves — merge or strip "
+                    "them before attaching an adapter_pool (the pool's "
+                    "gathered delta would stack on top of them)")
 
     # -- request lifecycle --------------------------------------------------
 
@@ -885,7 +1128,8 @@ class DecodeServer:
                stop: list | None = None, temperature: float = 0.0,
                top_k: int = 0, top_p: float = 1.0,
                ttl_s: float | None = None, priority: int = 0,
-               tenant: str | None = None) -> int:
+               tenant: str | None = None,
+               adapter: str | None = None, constraint=None) -> int:
         """``stop``: optional list of token SEQUENCES; generation ends
         (sequence included) as soon as the generated tail matches one.
 
@@ -910,10 +1154,22 @@ class DecodeServer:
         bucket REJECTS the request at the door (status ``rejected``,
         ``result`` raises ``resilience.Overloaded`` — distinct from the
         TTL ``timeout``: a reject is the back-off signal, the request
-        never queued).  ``tenant=None`` shares one default bucket."""
+        never queued).  ``tenant=None`` shares one default bucket.
+
+        ``adapter``: serve this request under a named LoRA from the
+        attached ``adapter_pool`` (None = the tenant's default adapter
+        if one was set via ``AdapterPool.set_tenant_default``, else the
+        base model).  ``constraint``: constrained decoding — a
+        :class:`~paddle_tpu.text.adapters.Constraint` spec (TokenSet /
+        Regex / JsonSchema, or a bare iterable of allowed token ids)
+        compiled host-side to a per-slot automaton; each step bans
+        disallowed tokens with an additive mask inside the jitted
+        sampler, so greedy AND sampled slots only ever emit tokens the
+        automaton accepts."""
         req = self._build_request(prompt, max_new_tokens, stop,
                                   temperature, top_k, top_p, ttl_s,
-                                  priority, tenant=tenant)
+                                  priority, tenant=tenant,
+                                  adapter=adapter, constraint=constraint)
         if self._tel:
             _telemetry.count("serving.requests_submitted")
         if self._adm is not None:
@@ -951,7 +1207,7 @@ class DecodeServer:
 
     def _build_request(self, prompt, max_new_tokens, stop, temperature,
                        top_k, top_p, ttl_s, priority,
-                       tenant=None) -> dict:
+                       tenant=None, adapter=None, constraint=None) -> dict:
         """Validate one request and mint its queue entry (the shared
         half of :meth:`submit` and :meth:`submit_prefilled`)."""
         prompt, stop, ttl, top_k = validate_request(
@@ -959,6 +1215,21 @@ class DecodeServer:
             ttl_s, window=min(self.max_len, self.cfg.max_seq_len),
             vocab_size=self.cfg.vocab_size,
             default_ttl=self._default_ttl)
+        aid = 0
+        if adapter is not None and self._adapters is None:
+            raise ValueError(
+                f"adapter={adapter!r} but no adapter_pool attached to "
+                f"this server")
+        if self._adapters is not None:
+            if adapter is None:
+                adapter = self._adapters.default_for(tenant)
+            aid = self._adapters.resolve(adapter)
+        if constraint is not None:
+            from . import adapters as _ad
+
+            # compile at the door (and discard): a malformed spec raises
+            # HERE in the caller's frame, not ticks later at admission
+            _ad.compile_constraint(constraint, self.cfg.vocab_size)
         if self._paged:
             # a request needing more blocks than the whole pool can
             # NEVER be admitted (eviction frees other tenants' blocks,
@@ -978,6 +1249,9 @@ class DecodeServer:
                 "top_k": top_k, "top_p": float(top_p),
                 "ttl": ttl, "priority": int(priority),
                 "tenant": tenant,
+                "adapter": aid,
+                "adapter_name": adapter if aid else None,
+                "constraint": constraint,
                 "t_submit": time.perf_counter(),
                 "t_enqueue": time.perf_counter()}
 
@@ -1172,7 +1446,24 @@ class DecodeServer:
                 # span timestamps (host clock only; never a device sync)
                 "t_submit": req.get("t_submit", t_admit),
                 "t_admit": t_admit,
+                # multi-tenant serving: which pool row this slot gathers
+                # (0 = base model) and the original spec — the spec (not
+                # the live automaton) survives OOM-evict requeues
+                "adapter": req.get("adapter", 0),
+                "adapter_name": req.get("adapter_name"),
+                "constraint_spec": req.get("constraint"),
             }
+            if req.get("constraint") is not None:
+                from . import adapters as _ad
+
+                cst = _ad.compile_constraint(req["constraint"],
+                                             self.cfg.vocab_size)
+                # an OOM-evicted request re-admits mid-output: replay
+                # the carried tokens so the automaton resumes where the
+                # evicted slot's state machine stood
+                for tt in req.get("carry", ()):
+                    cst.advance(int(tt))
+                st["constraint"] = cst
             if self._spec_on and self._adm is not None \
                     and self._adm.spec_forced():
                 # ladder rung >= RUNG_SPEC_OFF: this admission decodes
@@ -1247,12 +1538,30 @@ class DecodeServer:
                         # checked)
                         bucket = _pow2_bucket(n, self.max_len,
                                               self.cfg.max_seq_len)
-                        prefill_name = f"prefill@{bucket}"
                         padded = np.zeros((1, bucket), np.int32)
                         padded[0, :n] = req["prompt"]
-                        logits, self.cache = self._prefill(bucket)(
-                            self.params, self.cache, jnp.asarray(padded),
-                            jnp.asarray(n), jnp.asarray(slot))
+                        if self._adapters is not None:
+                            # pool attached: ALL admissions run the
+                            # adapter prefill (aid 0 merges the zero
+                            # row — token-parity with the plain path),
+                            # so one executable set serves the mixed
+                            # batch and base-only warmup covers it
+                            prefill_name = f"adapter_prefill@{bucket}"
+                            fn = _get_adapter_prefill_fn(
+                                self.cfg, bucket,
+                                self._adapters.pool_key(), self._shard)
+                            logits, self.cache = fn(
+                                self.params, self.cache,
+                                self._adapters.stacks(),
+                                jnp.asarray(st["adapter"]),
+                                jnp.asarray(padded), jnp.asarray(n),
+                                jnp.asarray(slot))
+                        else:
+                            prefill_name = f"prefill@{bucket}"
+                            logits, self.cache = self._prefill(bucket)(
+                                self.params, self.cache,
+                                jnp.asarray(padded),
+                                jnp.asarray(n), jnp.asarray(slot))
                     else:
                         # fixed-chunk walk: every chunk reuses ONE
                         # executable.  The LAST window starts at n - C
@@ -1271,13 +1580,24 @@ class DecodeServer:
                         else:
                             starts = list(range(0, n - C, C)) + [n - C]
                         prefill_calls = len(starts)
-                        prefill_name = "prefill_chunk"
+                        if self._adapters is not None:
+                            prefill_name = "adapter_prefill_chunk"
+                            afn = _get_adapter_prefill_chunk_fn(
+                                self.cfg, self._adapters.pool_key(),
+                                self._shard)
+                            _ad_st = self._adapters.stacks()
+                            _aid = jnp.asarray(st["adapter"])
+                            pf = lambda p, c, t, p0, ln, sl: afn(
+                                p, c, _ad_st, _aid, t, p0, ln, sl)
+                        else:
+                            prefill_name = "prefill_chunk"
+                            pf = self._prefill_chunk
                         logits = None
                         for i in starts:
                             chunk = req["prompt"][i:i + C]
                             padded = np.zeros((1, C), np.int32)
                             padded[0, :len(chunk)] = chunk
-                            logits, self.cache = self._prefill_chunk(
+                            logits, self.cache = pf(
                                 self.params, self.cache,
                                 jnp.asarray(padded),
                                 jnp.asarray(i), jnp.asarray(len(chunk)),
@@ -1314,6 +1634,14 @@ class DecodeServer:
                     self._fail_request(st, slot,
                                        "non-finite prefill logits")
                     continue
+                cst = st.get("constraint")
+                if cst is not None:
+                    # first token: the logits are already host-side, so
+                    # the constraint masks HERE (same -inf law the jitted
+                    # steps apply) — the automaton then advances below
+                    from . import adapters as _ad
+
+                    logits_np = _ad.apply_constraint_host(logits_np, cst)
                 if st["temperature"] > 0.0:
                     # admission draws host-side from the filtered law,
                     # seeded per rid off the server key — deterministic
@@ -1351,7 +1679,8 @@ class DecodeServer:
                 # _finished (not the old max_new <= 1 test): a carried
                 # (OOM-evicted, re-admitted) request may hit its budget
                 # on the admission token
-                if self._finished(st, t):
+                fin = self._constraint_push(st, t)
+                if self._finished(st, t) or fin:
                     self._results[st["rid"]] = st["generated"]
                     if self._paged:
                         self._pool.free_slot(slot)
@@ -1401,8 +1730,13 @@ class DecodeServer:
 
             alloc = self._pool
             try:
+                # adapter≠0 prompts never share prefix-cache rows: the
+                # cached rows were computed under a DIFFERENT weight
+                # delta (or the base), so adoption would serve wrong
+                # attention state.  Base (adapter 0) traffic shares as
+                # before.
                 shared = alloc.adopt_prefix(slot, prompt) \
-                    if self._prefill_on else 0
+                    if self._prefill_on and not req.get("adapter") else 0
                 if n - shared <= W:
                     starts = [shared if shared + W <= window
                               else max(0, n - W)]
@@ -1479,7 +1813,21 @@ class DecodeServer:
         chunk = prompt[s:s + W]
         padded = np.zeros((1, W), np.int32)
         padded[0, :len(chunk)] = chunk
-        if self._paged:
+        if self._adapters is not None:
+            pk = self._adapters.pool_key()
+            if self._paged:
+                kind = f"adapter_paged_prefill@{W}"
+                afn = _get_adapter_paged_prefill_fn(self.cfg, W, pk,
+                                                    self._shard)
+            else:
+                kind = f"adapter_prefill_chunk@{W}"
+                afn = _get_adapter_prefill_chunk_fn(self.cfg, pk,
+                                                    self._shard, width=W)
+            _ad_st = self._adapters.stacks()
+            _aid = jnp.asarray(st.get("adapter", 0))
+            fn = lambda p, c, t, p0, ln, sl: afn(p, c, _ad_st, _aid,
+                                                 t, p0, ln, sl)
+        elif self._paged:
             kind = f"paged_prefill@{W}"
             fn = _get_paged_prefill_fn(self.cfg, W, self._shard)
         else:
@@ -1529,6 +1877,12 @@ class DecodeServer:
             del self._slots[slot]
             self._fail_request(st, slot, "non-finite prefill logits")
             return
+        cst = st.get("constraint")
+        if cst is not None:
+            # same host-side first-token masking as monolithic admission
+            from . import adapters as _ad
+
+            logits_np = _ad.apply_constraint_host(logits_np, cst)
         if st["temperature"] > 0.0:
             p = generate._filtered_probs(
                 logits_np, st["temperature"], st["top_k"], st["top_p"])
@@ -1543,7 +1897,8 @@ class DecodeServer:
         st.pop("admitting", None)
         st.pop("admit_starts", None)
         st.pop("admit_i", None)
-        if self._paged and self._prefill_on:
+        if self._paged and self._prefill_on and not st.get("adapter"):
+            # adapter rows never index for sharing (see _claim_admitting)
             self._pool.register_prefix(slot, prompt)
         if self._spec_on and self.draft_cfg is not None:
             # draft chunks advanced in lockstep (see _advance_admitting);
@@ -1562,7 +1917,8 @@ class DecodeServer:
             # covers exactly this one execution
             _telemetry.note_step_time(f"serving.{kind}", t_fetch - t0)
             _telemetry.count("serving.tokens_generated")
-        if self._finished(st, t):
+        fin = self._constraint_push(st, t)
+        if self._finished(st, t) or fin:
             # carried (OOM-evicted) requests may hit their budget on
             # the admission token, exactly like monolithic admission
             del self._slots[slot]
@@ -1656,8 +2012,11 @@ class DecodeServer:
         prompt = req["prompt"]
         n = len(prompt)
         alloc = self._pool
-        shared = alloc.adopt_prefix(slot, prompt) if self._prefill_on \
-            else 0
+        # adapter≠0 prompts bypass the prefix cache entirely: adopted
+        # rows carry a different (or no) weight delta, and registering
+        # adapter rows would poison future base/other-adapter admissions
+        shared = alloc.adopt_prefix(slot, prompt) \
+            if self._prefill_on and not req.get("adapter") else 0
         window = min(self.max_len, self.cfg.max_seq_len)
         if self._chunk:
             C = min(self._chunk, window)
@@ -1696,7 +2055,17 @@ class DecodeServer:
                 if alloc.evict_cold(max_entries=_EVICT_BATCH) == 0:
                     raise
         self._apply_pool_ops()
-        fn = _get_paged_prefill_fn(self.cfg, C, self._shard)
+        if self._adapters is not None:
+            name = f"adapter_paged_prefill@{C}"
+            afn = _get_adapter_paged_prefill_fn(
+                self.cfg, C, self._adapters.pool_key(), self._shard)
+            _ad_st = self._adapters.stacks()
+            _aid = jnp.asarray(req.get("adapter", 0))
+            fn = lambda p, c, t, p0, ln, sl: afn(p, c, _ad_st, _aid,
+                                                 t, p0, ln, sl)
+        else:
+            name = f"paged_prefill@{C}"
+            fn = _get_paged_prefill_fn(self.cfg, C, self._shard)
         logits = None
         rows_done = 0
         for s in starts:
@@ -1727,8 +2096,9 @@ class DecodeServer:
             # rows actually prefilled — the repeated-prefix FLOPs saving
             # is (prompt length - this) per request
             _telemetry.count("kv_pool.prefill_rows", rows_done)
-        alloc.register_prefix(slot, prompt)
-        return f"paged_prefill@{C}", len(starts), logits
+        if not req.get("adapter"):
+            alloc.register_prefix(slot, prompt)
+        return name, len(starts), logits
 
     def _inject_prefilled(self, req, slot):
         """Admission half of the prefill/decode handoff: write the
@@ -1809,6 +2179,15 @@ class DecodeServer:
         at least one slot still speculating (all fallen back = the
         rounds are pure overhead)."""
         if not self._spec_on or not self._slots:
+            return False
+        if self._constrained_active():
+            # constrained slots fall back to plain stepping for the
+            # whole batch: draft tokens can't be masked cheaply (each
+            # proposal would need the automaton advanced host-side
+            # mid-chunk), and an unmasked draft's acceptances could
+            # emit banned tokens.  Documented fallback — tested.
+            if self._tel:
+                _telemetry.count("constraint.spec_fallbacks")
             return False
         K = self._spec_k
         lim = self._spec_limit()
@@ -2097,11 +2476,28 @@ class DecodeServer:
         for slot, (draft, _) in props.items():
             for j, d in enumerate(draft[:K - 1]):
                 tok[slot, j + 1] = d
-        kind = f"spec_verify@{K}"
-        self._fault_check(kind)
-        fn = _get_spec_verify_fn(self.cfg, K, self._paged, self._shard)
-        logits, self.cache = fn(self.params, self.cache,
-                                jnp.asarray(tok), jnp.asarray(pos))
+        if self._adapters is not None:
+            # the verify pass gathers the SAME per-slot adapter the
+            # decode step uses — acceptance compares draft tokens
+            # against the ADAPTED target's argmax/law, so accepted
+            # tokens are exactly what plain adapted stepping emits.
+            # The (base-model) draft only moves the acceptance RATE.
+            kind = f"adapter_spec_verify@{K}"
+            self._fault_check(kind)
+            fn = _get_adapter_spec_verify_fn(
+                self.cfg, K, self._adapters.pool_key(), self._paged,
+                self._shard)
+            logits, self.cache = fn(
+                self.params, self.cache, self._adapters.stacks(),
+                jnp.asarray(self._gather_adapter_ids()),
+                jnp.asarray(tok), jnp.asarray(pos))
+        else:
+            kind = f"spec_verify@{K}"
+            self._fault_check(kind)
+            fn = _get_spec_verify_fn(self.cfg, K, self._paged,
+                                     self._shard)
+            logits, self.cache = fn(self.params, self.cache,
+                                    jnp.asarray(tok), jnp.asarray(pos))
         self._step_no += 1   # after the call: see _tick_impl
         self._spec_rounds += 1
         lnp = np.asarray(logits)   # the round's ONE device->host fetch
@@ -2284,6 +2680,11 @@ class DecodeServer:
         if self._adm is not None:
             eff_cap = min(eff_cap,
                           self._adm.effective_admit_cap(self.max_batch))
+        ad_active: dict[str, int] = {}
+        if self._adapters is not None:
+            for st in self._slots.values():
+                nm = st.get("adapter_name") or "base"
+                ad_active[nm] = ad_active.get(nm, 0) + 1
         return {
             "queue_depth": len(self._queue),
             "active_slots": act,
@@ -2312,6 +2713,16 @@ class DecodeServer:
             "admission_rung": (0 if self._adm is None
                                else self._adm.rung),
             "slo_ok": self._adm is None or self._adm.rung == 0,
+            # multi-tenant serving: slots decoding under a constraint
+            # automaton (always present) and, with an adapter pool,
+            # per-adapter active-slot counts — the same numbers the
+            # adapters.active{adapter=} gauges sample, surfaced per
+            # server so the fleet router's docs can point at them
+            "constrained_slots": sum(
+                1 for st in self._slots.values()
+                if st.get("constraint") is not None),
+            **({"adapters_active": ad_active}
+               if self._adapters is not None else {}),
         }
 
     def drain_queue(self, rids=None) -> list:
@@ -2385,6 +2796,57 @@ class DecodeServer:
                 tp[slot] = st["top_p"]
         return temp, tk, tp
 
+    # -- multi-tenant serving: adapter gather + constraint masks ------------
+
+    def _constrained_active(self) -> bool:
+        """Any ACTIVE slot decoding under a constraint automaton?  The
+        gate every incompatible fast path (async pipelining, device
+        blocks, speculation) checks before committing: a masked step
+        needs the PREVIOUS token fetched to build the next mask, so
+        constrained slots always run the stepwise sync path."""
+        return any(st.get("constraint") is not None
+                   for st in self._slots.values())
+
+    def _gather_adapter_ids(self):
+        """Per-slot int32 adapter ids [max_batch] for this dispatch —
+        the gather_adapter index array every adapter step consumes
+        (free slots read row 0, the all-zero base delta)."""
+        ids = np.zeros((self.max_batch,), np.int32)
+        for slot, st in self._slots.items():
+            ids[slot] = st.get("adapter", 0)
+        if self._tel:
+            _telemetry.count("adapters.gather_steps")
+        return ids
+
+    def _mask_array(self):
+        """The [B, V] additive constraint mask for the NEXT step, built
+        host-side from each constrained slot's automaton state — or
+        None when no decoding slot is constrained (the unmasked fast
+        paths stay untouched).  Admitting / prompt-feeding slots are
+        excluded: their step output is never kept, so masking it would
+        only burn host time."""
+        cons = {slot: st["constraint"]
+                for slot, st in self._slots.items()
+                if st.get("constraint") is not None
+                and not st.get("admitting")
+                and st["pos"] >= len(st["prompt"]) - 1}
+        if not cons:
+            return None
+        from . import adapters as _ad
+
+        return _ad.mask_logits(cons, self.max_batch, self.cfg.vocab_size)
+
+    def _constraint_push(self, st, t: int) -> bool:
+        """Advance the slot's automaton over the token it just emitted;
+        True when the constraint is EXHAUSTED (the automaton accepted a
+        complete output and allows nothing further) — the slot must
+        retire even if max_new/eos/stop say otherwise."""
+        cst = st.get("constraint")
+        if cst is None:
+            return False
+        cst.advance(t)
+        return cst.exhausted
+
     def _retire(self, done):
         for slot in done:
             st = self._slots.pop(slot)
@@ -2418,6 +2880,20 @@ class DecodeServer:
             "serving.admitting_slots",
             sum(1 for st in self._slots.values()
                 if st.get("admitting")))
+        if self._adapters is not None:
+            # per-adapter active-slot gauges, Prometheus-labeled
+            # (telemetry._prom_name keeps {adapter="..."} intact).
+            # Every registered name is written EVERY sample — a
+            # retired adapter's gauge drops to 0 instead of freezing
+            # at its last nonzero value
+            counts: dict[str, int] = {}
+            for st in self._slots.values():
+                nm = st.get("adapter_name") or "base"
+                counts[nm] = counts.get(nm, 0) + 1
+            for nm in list(self._adapters.names()) + ["base"]:
+                _telemetry.set_gauge(
+                    f'adapters.active{{adapter="{nm}"}}',
+                    counts.get(nm, 0))
         if self._spec_on and self._spec_prop:
             _telemetry.set_gauge("serving.spec_accept_rate",
                                  self._spec_acc / self._spec_prop)
@@ -2675,6 +3151,11 @@ class DecodeServer:
             "top_k": st.get("top_k", 0), "top_p": st.get("top_p", 1.0),
             "ttl": st.get("ttl"), "priority": st.get("priority", 0),
             "tenant": st.get("tenant"),
+            # adapter id + constraint SPEC survive the requeue; _admit
+            # recompiles the automaton and replays the carry through it
+            "adapter": st.get("adapter", 0),
+            "adapter_name": st.get("adapter_name"),
+            "constraint": st.get("constraint_spec"),
             "evictions": evictions,
             "carry": list(st["generated"]),
             "t_submit": st.get("t_submit", time.perf_counter()),
@@ -2761,8 +3242,20 @@ class DecodeServer:
             if self._slots:
                 self._spec_plain_steps += 1
         if self._async:
-            self._tick_async()
-            return
+            if not self._slots:
+                self._admit()
+            if self._constrained_active():
+                # constrained slots cannot pipeline: the NEXT step's
+                # mask is a function of the token the in-flight step
+                # has not fetched yet.  Drain the pipeline and fall
+                # through to the sync path — same tokens, one tick of
+                # lost overlap per constrained batch
+                self._drain_inflight()
+                if self._tel:
+                    _telemetry.count("constraint.sync_fallbacks")
+            else:
+                self._tick_async()
+                return
         if not self._slots:
             self._admit()
             if not self._slots:
@@ -2778,8 +3271,60 @@ class DecodeServer:
         self._ensure_decode_blocks(1)
         tok, pos = self._feed_arrays()
         temp, tk, tp = self._sampling_arrays()
+        mask = self._mask_array()
         n = self._step_no
-        if temp.any():
+        if self._adapters is not None:
+            # pool attached: every step gathers per-slot (a, b) pairs
+            # by id — base-only batches gather row 0 (the zero delta)
+            # and reproduce the plain server's tokens
+            pk = self._adapters.pool_key()
+            ad = self._adapters.stacks()
+            ids = self._gather_adapter_ids()
+            if temp.any() or mask is not None:
+                kind = "adapter_sample_step"
+                self._fault_check(kind)
+                fn = _get_adapter_sample_step_fn(
+                    self.cfg, pk, self._paged, self._shard)
+                if mask is None:
+                    # the executable takes the mask unconditionally
+                    # (ONE compiled shape); all-zeros is the identity
+                    mask = np.zeros(
+                        (self.max_batch, self.cfg.vocab_size),
+                        np.float32)
+                nxt, self.cache = fn(
+                    self.params, self.cache, ad, jnp.asarray(ids),
+                    jnp.asarray(tok), jnp.asarray(pos),
+                    jax.random.fold_in(self._base_key, n),
+                    jnp.asarray(temp), jnp.asarray(tk),
+                    jnp.asarray(tp), jnp.asarray(mask))
+                nxt = np.asarray(nxt)
+                logits = None
+            else:
+                kind = "adapter_step"
+                self._fault_check(kind)
+                fn = _get_adapter_step_fn(self.cfg, pk, self._paged,
+                                          self._shard)
+                logits, self.cache = fn(
+                    self.params, self.cache, ad, jnp.asarray(ids),
+                    jnp.asarray(tok), jnp.asarray(pos))
+                nxt = np.asarray(jnp.argmax(logits, axis=-1))
+        elif mask is not None:
+            # constrained decode without a pool: the plain step plus
+            # the [B, V] mask input.  Greedy slots take the masked
+            # argmax inside _sample_batched, so this path consumes the
+            # fold_in(n) key like the sampled path (all-greedy batches
+            # draw nothing from it)
+            kind = "masked_step"
+            self._fault_check(kind)
+            fn = _get_masked_step_fn(self.cfg, self._paged, self._shard)
+            nxt, self.cache = fn(
+                self.params, self.cache, jnp.asarray(tok),
+                jnp.asarray(pos), jax.random.fold_in(self._base_key, n),
+                jnp.asarray(temp), jnp.asarray(tk), jnp.asarray(tp),
+                jnp.asarray(mask))
+            nxt = np.asarray(nxt)
+            logits = None
+        elif temp.any():
             kind = "sample_step"
             self._fault_check(kind)
             fn = _get_sample_step_fn(self.cfg, self._paged, self._shard)
@@ -2837,7 +3382,8 @@ class DecodeServer:
             t = int(nxt[slot])
             st["generated"].append(t)
             appended.append((st, 1))
-            if self._finished(st, t):
+            fin = self._constraint_push(st, t)
+            if self._finished(st, t) or fin:
                 done.append(slot)
         for slot in failed:
             st = self._slots.pop(slot)
@@ -2921,19 +3467,41 @@ class DecodeServer:
         ht, pm, pos, temp, tk, tp, snap = self._dispatch_feed(prev)
         n = self._step_no
         self._step_no = n + 1
-        fn = _get_async_step_fn(self.cfg, self._paged, self._shard)
         try:
-            self._fault_check("async_step")
-            nxt, self.cache = fn(
-                self.params, self.cache, jnp.asarray(ht), jnp.asarray(pm),
-                self._prev_feed(prev), jnp.asarray(pos),
-                jax.random.fold_in(self._base_key, n), jnp.asarray(temp),
-                jnp.asarray(tk), jnp.asarray(tp))
+            if self._adapters is not None:
+                # async pipelining composes with the pool (gather rides
+                # the in-flight select); constrained slots never reach
+                # here — _tick_impl drains to sync first
+                fname = "adapter_async_step"
+                self._fault_check(fname)
+                fn = _get_adapter_async_step_fn(
+                    self.cfg, self._adapters.pool_key(), self._paged,
+                    self._shard)
+                nxt, self.cache = fn(
+                    self.params, self.cache, self._adapters.stacks(),
+                    jnp.asarray(self._gather_adapter_ids()),
+                    jnp.asarray(ht), jnp.asarray(pm),
+                    self._prev_feed(prev), jnp.asarray(pos),
+                    jax.random.fold_in(self._base_key, n),
+                    jnp.asarray(temp), jnp.asarray(tk),
+                    jnp.asarray(tp))
+            else:
+                fname = "async_step"
+                self._fault_check(fname)
+                fn = _get_async_step_fn(self.cfg, self._paged,
+                                        self._shard)
+                nxt, self.cache = fn(
+                    self.params, self.cache, jnp.asarray(ht),
+                    jnp.asarray(pm),
+                    self._prev_feed(prev), jnp.asarray(pos),
+                    jax.random.fold_in(self._base_key, n),
+                    jnp.asarray(temp),
+                    jnp.asarray(tk), jnp.asarray(tp))
         except Exception:
             self._rollback_dispatch(snap, n)
             raise
         self._inflight = {"kind": "step", "toks": nxt, "feed": nxt,
-                          "fn": "async_step", "step_no0": n,
+                          "fn": fname, "step_no0": n,
                           "snap": snap, "t_disp": time.perf_counter()}
 
     def _dispatch_block_async(self, prev, block: int):
@@ -3020,7 +3588,11 @@ class DecodeServer:
                 t = int(toks[slot])
                 st["generated"].append(t)
                 appended.append((st, 1))
-                if self._finished(st, t):
+                # constrained slots never dispatch async (the sync
+                # fallback gate) — the push is a no-op kept for the
+                # drain-on-transition edge
+                fin = self._constraint_push(st, t)
+                if self._finished(st, t) or fin:
                     done.append(slot)
             else:
                 kept = 0
@@ -3028,7 +3600,8 @@ class DecodeServer:
                     t = int(toks[slot, j])
                     st["generated"].append(t)
                     kept += 1
-                    if self._finished(st, t):
+                    fin = self._constraint_push(st, t)
+                    if self._finished(st, t) or fin:
                         done.append(slot)
                         break
                 appended.append((st, kept))
@@ -3089,9 +3662,15 @@ class DecodeServer:
             if not self._slots:
                 self._gap_anchor = None
                 return
-        if any(st["pos"] < len(st["prompt"]) - 1
-               or st.get("admitting")
-               for st in self._slots.values()):
+        if self._adapters is not None or self._constrained_active() \
+                or any(st["pos"] < len(st["prompt"]) - 1
+                       or st.get("admitting")
+                       for st in self._slots.values()):
+            # adapter/constrained batches take stepwise async ticks
+            # (the adapter async STEP pipelines; an async adapter BLOCK
+            # executable isn't built, and constrained slots need every
+            # token fetched before the next mask) — same tokens, the
+            # documented fallback
             if prev is not None:
                 self._process_inflight(prev)
             for _ in range(block):
@@ -3109,11 +3688,21 @@ class DecodeServer:
 
     # -- warmup: pre-compile what this server will serve --------------------
 
-    def warmup(self, prompt_lens=None, blocks=(), sample: bool = False):
+    def warmup(self, prompt_lens=None, blocks=(), sample: bool = False,
+               constrained: bool = False):
         """Pre-compile the executables this server will serve, so the
         first request pays device time only (and re-launches hit the
         persistent compilation cache — framework.platform
         .init_compile_cache, called here).
+
+        With an ``adapter_pool`` attached, every warm site compiles the
+        ADAPTER twin instead (gathered steps/blocks/verify/prefill, ids
+        all-zero — the executables are shape-keyed, so base-only warmup
+        covers every adapter id), and ``sample=True`` warms the
+        masked+sampled adapter step (the one executable constrained OR
+        sampled pool traffic runs).  ``constrained=True`` warms the
+        pool-less masked step for servers expecting ``constraint=``
+        requests without a pool.
 
         This also warms the flash-decode kernel variants: tracing the
         step executables runs the split-KV Pallas kernel's availability
@@ -3181,11 +3770,53 @@ class DecodeServer:
             timings[name] = round(time.perf_counter() - t0, 3)
 
         tok, pos = jnp.asarray(zi), jnp.asarray(zi)
-        if self._async:
+        pool = self._adapters
+        if pool is not None:
+            pk = pool.pool_key()
+            ad = pool.stacks()
+            ids0 = jnp.asarray(zi)          # all-base gather
+            aid0 = jnp.asarray(0)
+            zm = jnp.zeros((B, self.cfg.vocab_size), jnp.float32)
+        if pool is not None:
+            # adapter twins: these ARE the executables a pool-attached
+            # server dispatches (see _tick_impl) — the plain ones would
+            # be dead compiles
+            if self._async:
+                fn = _get_adapter_async_step_fn(self.cfg, pk,
+                                                self._paged, self._shard)
+                warm("adapter_async_step", lambda: fn(
+                    self.params, self.cache, ad, ids0, tok,
+                    jnp.asarray(zb), tok, pos, key, jnp.asarray(zf),
+                    jnp.asarray(zi), jnp.asarray(of)))
+            # the sync greedy step also serves async servers' stepwise
+            # constraint fallback, so warm it unconditionally
+            fn = _get_adapter_step_fn(self.cfg, pk, self._paged,
+                                      self._shard)
+            warm("adapter_step", lambda: fn(
+                self.params, self.cache, ad, ids0, tok, pos))
+            if sample or constrained:
+                fn = _get_adapter_sample_step_fn(self.cfg, pk,
+                                                 self._paged,
+                                                 self._shard)
+                warm("adapter_sample_step", lambda: fn(
+                    self.params, self.cache, ad, ids0, tok, pos, key,
+                    jnp.asarray(zf), jnp.asarray(zi), jnp.asarray(of),
+                    zm))
+        elif self._async:
             fn = _get_async_step_fn(self.cfg, self._paged, self._shard)
             warm("async_step", lambda: fn(
                 self.params, self.cache, tok, jnp.asarray(zb), tok, pos,
                 key, jnp.asarray(zf), jnp.asarray(zi), jnp.asarray(of)))
+            if constrained:
+                # async constrained traffic drains to the SYNC masked
+                # step (_tick_impl's fallback) — warm that path too
+                fn = _get_masked_step_fn(self.cfg, self._paged,
+                                         self._shard)
+                zm = jnp.zeros((B, self.cfg.vocab_size), jnp.float32)
+                warm("masked_step", lambda: fn(
+                    self.params, self.cache, tok, pos, key,
+                    jnp.asarray(zf), jnp.asarray(zi), jnp.asarray(of),
+                    zm))
         else:
             warm("step", lambda: self._step(self.params, self.cache, tok,
                                             pos))
@@ -3195,9 +3826,29 @@ class DecodeServer:
                 warm("sample_step", lambda: fn(
                     self.params, self.cache, tok, pos, key,
                     jnp.asarray(zf), jnp.asarray(zi), jnp.asarray(of)))
+            if constrained:
+                fn = _get_masked_step_fn(self.cfg, self._paged,
+                                         self._shard)
+                zm = jnp.zeros((B, self.cfg.vocab_size), jnp.float32)
+                warm("masked_step", lambda: fn(
+                    self.params, self.cache, tok, pos, key,
+                    jnp.asarray(zf), jnp.asarray(zi), jnp.asarray(of),
+                    zm))
         for k in blocks:
             k = int(k)
-            if self._async:
+            if pool is not None:
+                if self._async:
+                    # async adapter tick_block falls back to stepwise
+                    # async ticks (adapter_async_step, warmed above) —
+                    # no block executable to compile
+                    continue
+                fn = _get_adapter_block_fn(self.cfg, k, pk, self._paged,
+                                           self._shard)
+                warm(f"adapter_block{k}", lambda fn=fn: fn(
+                    self.params, self.cache, ad, ids0, tok, pos)[:2])
+                # sampled pool traffic steps through adapter_sample_step
+                # (tick_block's stepwise fallback) — no sampled block
+            elif self._async:
                 fn = _get_async_block_fn(self.cfg, k, self._paged,
                                          self._shard)
                 warm(f"async_block{k}", lambda fn=fn: fn(
@@ -3229,11 +3880,18 @@ class DecodeServer:
             # cover as the plain warm steps) and, in draft mode, the
             # draft's own decode step
             K = self._spec_k
-            sfn = _get_spec_verify_fn(self.cfg, K, self._paged,
-                                      self._shard)
             tokK = jnp.zeros((B, K), jnp.int32)
-            warm(f"spec_verify@{K}", lambda: sfn(
-                self.params, self.cache, tokK, pos))
+            if pool is not None:
+                sfn = _get_adapter_spec_verify_fn(self.cfg, K, pk,
+                                                  self._paged,
+                                                  self._shard)
+                warm(f"adapter_spec_verify@{K}", lambda: sfn(
+                    self.params, self.cache, ad, ids0, tokK, pos))
+            else:
+                sfn = _get_spec_verify_fn(self.cfg, K, self._paged,
+                                          self._shard)
+                warm(f"spec_verify@{K}", lambda: sfn(
+                    self.params, self.cache, tokK, pos))
             if self._draft_cache is not None:
                 dfn = _get_step_fn(self.draft_cfg, self._paged,
                                    self._shard)
@@ -3283,11 +3941,22 @@ class DecodeServer:
                 widths = set(widths) | {min(w, window)
                                         for w in rungs or (self._budget,)}
             for C in sorted(set(widths)):
-                fn = _get_paged_prefill_fn(self.cfg, C, self._shard)
                 padded = jnp.zeros((1, C), jnp.int32)
-                warm(f"paged_prefill{C}", lambda fn=fn, padded=padded: fn(
-                    self.params, self.cache, padded, jnp.asarray(0),
-                    jnp.asarray(1), jnp.asarray(0)))
+                if pool is not None:
+                    afn = _get_adapter_paged_prefill_fn(self.cfg, C, pk,
+                                                        self._shard)
+                    warm(f"adapter_paged_prefill{C}",
+                         lambda afn=afn, padded=padded: afn(
+                             self.params, self.cache, ad, aid0, padded,
+                             jnp.asarray(0), jnp.asarray(1),
+                             jnp.asarray(0)))
+                else:
+                    fn = _get_paged_prefill_fn(self.cfg, C, self._shard)
+                    warm(f"paged_prefill{C}",
+                         lambda fn=fn, padded=padded: fn(
+                             self.params, self.cache, padded,
+                             jnp.asarray(0), jnp.asarray(1),
+                             jnp.asarray(0)))
                 if self._draft_cache is not None:
                     dfn = _get_paged_prefill_fn(self.draft_cfg, C,
                                                 self._shard)
@@ -3300,9 +3969,16 @@ class DecodeServer:
         elif self._prefill_chunk is not None:
             C = self._chunk
             padded = jnp.zeros((1, C), jnp.int32)
-            warm(f"prefill_chunk{C}", lambda: self._prefill_chunk(
-                self.params, self.cache, padded, jnp.asarray(0),
-                jnp.asarray(1), jnp.asarray(0)))
+            if pool is not None:
+                afn = _get_adapter_prefill_chunk_fn(self.cfg, pk,
+                                                    self._shard)
+                warm(f"adapter_prefill_chunk{C}", lambda: afn(
+                    self.params, self.cache, ad, aid0, padded,
+                    jnp.asarray(0), jnp.asarray(1), jnp.asarray(0)))
+            else:
+                warm(f"prefill_chunk{C}", lambda: self._prefill_chunk(
+                    self.params, self.cache, padded, jnp.asarray(0),
+                    jnp.asarray(1), jnp.asarray(0)))
             if self._draft_cache is not None:
                 dfn = _get_prefill_chunk_fn(self.draft_cfg,
                                             self._shard)
@@ -3323,10 +3999,18 @@ class DecodeServer:
                                window) for n in prompt_lens]
             for b in sorted(set(buckets)):
                 padded = jnp.zeros((1, b), jnp.int32)
-                fn = self._prefill(b)
-                warm(f"prefill{b}", lambda fn=fn, padded=padded: fn(
-                    self.params, self.cache, padded, jnp.asarray(1),
-                    jnp.asarray(0)))
+                if pool is not None:
+                    afn = _get_adapter_prefill_fn(self.cfg, b, pk,
+                                                  self._shard)
+                    warm(f"adapter_prefill{b}",
+                         lambda afn=afn, padded=padded: afn(
+                             self.params, self.cache, ad, aid0, padded,
+                             jnp.asarray(1), jnp.asarray(0)))
+                else:
+                    fn = self._prefill(b)
+                    warm(f"prefill{b}", lambda fn=fn, padded=padded: fn(
+                        self.params, self.cache, padded, jnp.asarray(1),
+                        jnp.asarray(0)))
                 if self._draft_cache is not None:
                     dfn = _get_prefill_fn(self.draft_cfg, b,
                                           self._shard)
@@ -3345,13 +4029,23 @@ class DecodeServer:
                      else ()) or (self._budget,)
             for Wb in sorted({min(w, window) for w in rungs},
                              reverse=True):
-                bfn = _get_prefill_chunk_fn(self.cfg, self._shard,
-                                            width=Wb)
                 pad_b = jnp.zeros((1, Wb), jnp.int32)
-                warm(f"prefill_chunk@{Wb}",
-                     lambda bfn=bfn, pad_b=pad_b: bfn(
-                         self.params, self.cache, pad_b, jnp.asarray(0),
-                         jnp.asarray(1), jnp.asarray(0)))
+                if pool is not None:
+                    abfn = _get_adapter_prefill_chunk_fn(
+                        self.cfg, pk, self._shard, width=Wb)
+                    warm(f"adapter_prefill_chunk@{Wb}",
+                         lambda abfn=abfn, pad_b=pad_b: abfn(
+                             self.params, self.cache, ad, aid0, pad_b,
+                             jnp.asarray(0), jnp.asarray(1),
+                             jnp.asarray(0)))
+                else:
+                    bfn = _get_prefill_chunk_fn(self.cfg, self._shard,
+                                                width=Wb)
+                    warm(f"prefill_chunk@{Wb}",
+                         lambda bfn=bfn, pad_b=pad_b: bfn(
+                             self.params, self.cache, pad_b,
+                             jnp.asarray(0),
+                             jnp.asarray(1), jnp.asarray(0)))
                 if self._draft_cache is not None:
                     dbfn = _get_prefill_chunk_fn(self.draft_cfg,
                                                  self._shard, width=Wb)
@@ -3415,10 +4109,18 @@ class DecodeServer:
         # token is the prompt's last; everything after is feedback) — only
         # slots with logits-discarded prompt positions left need stepwise.
         # Admitting slots force stepwise too: one prefill chunk per tick is
-        # exactly the budgeted interleaving
-        if any(st["pos"] < len(st["prompt"]) - 1
-               or st.get("admitting")
-               for st in self._slots.values()):
+        # exactly the budgeted interleaving.  Constrained slots force
+        # stepwise always (the mask for step j+1 needs step j's token on
+        # the host), as do SAMPLED slots under an adapter pool (no
+        # adapter sample-block executable — the stepwise path draws the
+        # same fold_in(n) schedule, so tokens match tick() exactly)
+        if self._constrained_active() \
+                or (self._adapters is not None
+                    and any(st.get("temperature", 0.0) > 0.0
+                            for st in self._slots.values())) \
+                or any(st["pos"] < len(st["prompt"]) - 1
+                       or st.get("admitting")
+                       for st in self._slots.values()):
             for _ in range(block):
                 self.tick()
                 if not self._slots:
@@ -3429,7 +4131,19 @@ class DecodeServer:
         tok, pos = self._feed_arrays()
         temp, tk, tp = self._sampling_arrays()
         n = self._step_no
-        if temp.any():
+        if self._adapters is not None:
+            # greedy adapter block: gather once per step inside the
+            # on-device scan — one host fetch for ``block`` tokens
+            kind = f"adapter_block@{block}"
+            self._fault_check(kind)
+            fn = _get_adapter_block_fn(
+                self.cfg, block, self._adapters.pool_key(),
+                self._paged, self._shard)
+            toks, self.cache, _, _ = fn(
+                self.params, self.cache, self._adapters.stacks(),
+                jnp.asarray(self._gather_adapter_ids()),
+                jnp.asarray(tok), jnp.asarray(pos))
+        elif temp.any():
             kind = f"sample_block@{block}"
             self._fault_check(kind)
             fn = _get_sample_block_fn(self.cfg, block, self._paged,

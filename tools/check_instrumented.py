@@ -57,6 +57,7 @@ SCAN = (
     os.path.join("paddle_tpu", "text", "serving.py"),
     os.path.join("paddle_tpu", "text", "generate.py"),
     os.path.join("paddle_tpu", "text", "kv_pool.py"),
+    os.path.join("paddle_tpu", "text", "adapters.py"),
     os.path.join("paddle_tpu", "jit"),
 )
 
@@ -126,6 +127,20 @@ ADMISSION_FILES = (
 )
 ADMISSION_MARKERS = ("_shed", "shed_", "throttle", "degrade",
                      "rate_limit")
+
+# Multi-tenant adapter lint (round 14, same rule family): every adapter
+# gather / constraint-mask path across the serving layer and the
+# adapters subsystem — the per-slot id gather, the host mask build, the
+# per-row constraint application — must count a telemetry counter
+# (adapters.* / constraint.*) or delegate to another marker-named
+# callable.  Per-adapter traffic and masked-token volume are the
+# capacity-planning signals a multi-tenant operator bills/sizes by; a
+# silent gather or mask site makes one tenant's load invisible.
+ADAPTER_FILES = (
+    os.path.join("paddle_tpu", "text", "serving.py"),
+    os.path.join("paddle_tpu", "text", "adapters.py"),
+)
+ADAPTER_MARKERS = ("gather_adapter", "apply_constraint", "mask_logits")
 
 
 def _call_name(node: ast.Call):
@@ -336,6 +351,34 @@ def scan_admission_source(src: str, filename: str = "<src>") -> list:
     return violations
 
 
+def scan_adapter_source(src: str, filename: str = "<src>") -> list:
+    """Multi-tenant adapter lint violations in one source string: a
+    function whose name carries an :data:`ADAPTER_MARKERS` marker (an
+    adapter-gather or constraint-mask path) must contain a call to one
+    of :data:`COUNT_NAMES` or delegate to another marker-named
+    callable."""
+    tree = ast.parse(src, filename=filename)
+    violations = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and any(m in node.name for m in ADAPTER_MARKERS)):
+            continue
+        counted = any(
+            isinstance(n, ast.Call)
+            and (_call_name(n) in COUNT_NAMES
+                 or any(m in (_call_name(n) or "")
+                        for m in ADAPTER_MARKERS))
+            for n in ast.walk(node))
+        if not counted:
+            violations.append(
+                (filename, node.lineno,
+                 f"multi-tenant adapter path {node.name}() records no "
+                 f"telemetry counter (count) — an uncounted gather/mask "
+                 f"makes one tenant's load invisible to capacity "
+                 f"planning"))
+    return violations
+
+
 def _walk_py(path: str) -> list:
     out = []
     for dirpath, _, names in sorted(os.walk(path)):
@@ -406,6 +449,13 @@ def scan_repo(root: str | None = None) -> list:
             with open(adm_path, encoding="utf-8") as f:
                 violations.extend(scan_admission_source(
                     f.read(), os.path.relpath(adm_path, root)))
+    # multi-tenant adapter lint: gather/constraint-mask observability
+    for rel in ADAPTER_FILES:
+        ad_path = os.path.join(root, rel)
+        if os.path.exists(ad_path):
+            with open(ad_path, encoding="utf-8") as f:
+                violations.extend(scan_adapter_source(
+                    f.read(), os.path.relpath(ad_path, root)))
     return violations
 
 
