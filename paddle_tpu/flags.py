@@ -415,6 +415,21 @@ def admission_enabled() -> bool:
     return v not in ("0", "false", "off", "no")
 
 
+def adaptive_budget() -> bool:
+    """Adaptive prefill budget (ON by default): the admission
+    controller's TPOT objective (the ``serving.decode_gap_ms``
+    histogram) drives prefill-budget rung switches on its OWN counter,
+    finer than the coarse degradation ladder — one breached window
+    shrinks the budget one rung WITHOUT halving the admit cap or
+    forcing speculation off; healthy windows grow it back one rung,
+    an idle window resets it.  The budget only ever moves between the
+    ``ladder_widths`` rungs warmup() pre-compiled, so an adaptive move
+    never retraces.  ``PADDLE_TPU_ADAPTIVE_BUDGET=0`` restores the
+    ladder-only coupling."""
+    v = os.environ.get("PADDLE_TPU_ADAPTIVE_BUDGET", "1").strip().lower()
+    return v not in ("0", "false", "off", "no")
+
+
 def _float_or_none(name: str) -> float | None:
     v = os.environ.get(name)
     if v is None or not v.strip():

@@ -68,9 +68,9 @@ from . import generate, gpt, lora, woq
 from .. import telemetry as _telemetry
 
 __all__ = [
-    "AdapterPool", "TokenSetConstraint", "RegexConstraint",
-    "JsonSchemaConstraint", "compile_constraint", "mask_logits",
-    "apply_constraint_host", "NEG_INF",
+    "AdapterPool", "stacked_pool_specs", "TokenSetConstraint",
+    "RegexConstraint", "JsonSchemaConstraint", "compile_constraint",
+    "mask_logits", "apply_constraint_host", "NEG_INF",
 ]
 
 # additive mask value for banned tokens: large-negative instead of true
@@ -423,6 +423,32 @@ class AdapterPool:
 
     def default_for(self, tenant) -> str | None:
         return self._tenant_default.get(tenant)
+
+
+def stacked_pool_specs(pool: AdapterPool, mp: str = "mp") -> dict:
+    """PartitionSpecs for the pool's stacked ``[A, ...]`` leaves under
+    tensor-parallel (``mesh=``) serving — derived from each TARGET's
+    Megatron spec (gpt.param_shardings) with the leading stack axis
+    replicated.
+
+    The rule mirrors the base weight it adapts: ``*_lora_a``
+    ``[A, ..., in, r]`` keeps the base spec's dims up to (and
+    including) the input dim and replicates the rank dim; ``*_lora_b``
+    ``[A, ..., r, out]`` replicates the rank dim and keeps the base
+    OUTPUT dim's spec.  A column-parallel target (out over ``mp``)
+    therefore gets a replicated ``a`` and an out-sharded ``b`` — the
+    gathered delta lands sharded exactly like the base weight, so
+    GSPMD adds it without a reshard; row-parallel targets mirror on
+    the input side."""
+    from jax.sharding import PartitionSpec as P
+
+    base = gpt.param_shardings(pool.cfg, mp=mp)["blocks"]
+    specs = {}
+    for t in pool.targets:
+        dims = tuple(base[t])                 # matches the base leaf rank
+        specs[t + lora._SUFFIX_A] = P(None, *dims[:-1], None)
+        specs[t + lora._SUFFIX_B] = P(None, *dims[:-2], None, dims[-1])
+    return specs
 
 
 # ---------------------------------------------------------------------------

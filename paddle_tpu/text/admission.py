@@ -179,6 +179,14 @@ class AdmissionController:
                           else max(0, int(queue_cap)))
         self.budget_rungs = tuple(budget_rungs)
         self.rung = 0
+        # adaptive budget (flags.adaptive_budget, default on): the TPOT
+        # objective moves this counter independently of the full
+        # ladder, so the prefill budget shrinks on a decode-gap breach
+        # WITHOUT dragging the admit cap / spec-off / shed levers along
+        # — budget_level takes the max of ladder- and adaptive-derived
+        # levels, always indexing the same pre-warmed rungs
+        self._adaptive = _flags.adaptive_budget()
+        self._budget_adapt = 0
         now = time.perf_counter() if now is None else now
         self._t_eval = now + self.window_s
         self._buckets: dict = {}
@@ -296,6 +304,8 @@ class AdmissionController:
         breach = False
         evidence = False
         samples = 0
+        tpot_breach = False
+        tpot_evidence = False
         for name, slo in (("serving.ttft_ms", self.slo_ttft_ms),
                           ("serving.decode_gap_ms", self.slo_tpot_ms)):
             if slo is None:
@@ -304,8 +314,12 @@ class AdmissionController:
             samples += n
             if n >= _MIN_WINDOW_SAMPLES:
                 evidence = True
+                if name == "serving.decode_gap_ms":
+                    tpot_evidence = True
                 if p99 > slo:
                     breach = True
+                    if name == "serving.decode_gap_ms":
+                        tpot_breach = True
         if breach:
             self._degrade_one_rung()
         elif self.rung > 0:
@@ -314,11 +328,30 @@ class AdmissionController:
             elif evidence:
                 # stepwise recovery needs an affirmatively healthy
                 # window (enough samples, every objective within SLO);
-                # a sample-starved window under load proves nothing and
-                # HOLDS the rung — recovering on silence would flap the
-                # ladder exactly when the shrunken admit cap throttles
-                # the sample rate
+                # a sample-starved window under load stays inconclusive
+                # and HOLDS the rung — recovering on silence would flap
+                # the ladder exactly when the shrunken admit cap
+                # throttles the sample rate
                 self._recover_one_rung()
+        if self._adaptive and self.budget_rungs:
+            # the budget-only control loop: same evidence rules as the
+            # ladder (breach shrinks one rung, an affirmatively healthy
+            # TPOT window grows one back, a vouched-idle empty window
+            # resets), but touching ONLY the chunk-width lever
+            top = len(self.budget_rungs) - 1
+            if tpot_breach and self._budget_adapt < top:
+                self._budget_adapt += 1
+                _telemetry.count("admission.budget_shrinks")
+                self._set_gauges()
+            elif (not tpot_breach) and self._budget_adapt > 0:
+                if idle and samples == 0:
+                    self._budget_adapt = 0
+                    _telemetry.count("admission.budget_grows")
+                    self._set_gauges()
+                elif tpot_evidence:
+                    self._budget_adapt -= 1
+                    _telemetry.count("admission.budget_grows")
+                    self._set_gauges()
         return True
 
     def _degrade_one_rung(self) -> None:
@@ -335,6 +368,7 @@ class AdmissionController:
     def _recover_idle(self) -> None:
         _telemetry.count("admission.recoveries", self.rung)
         self.rung = 0
+        self._budget_adapt = 0
         self._set_gauges()
 
     def absorb_fleet_rung(self, rung: int) -> None:
@@ -350,12 +384,14 @@ class AdmissionController:
 
     @property
     def budget_level(self) -> int:
-        """Index into :attr:`budget_rungs` the current rung selects
-        (rung 0-1 -> level 0; rung 2 -> 1; rung >= 3 -> 2), clamped to
-        the rungs that exist."""
+        """Index into :attr:`budget_rungs` the current state selects:
+        the max of the ladder-derived level (rung 0-1 -> level 0;
+        rung 2 -> 1; rung >= 3 -> 2) and the adaptive TPOT counter
+        (flags.adaptive_budget), clamped to the rungs that exist."""
         if not self.budget_rungs:
             return 0
         lvl = 0 if self.rung <= 1 else (1 if self.rung == 2 else 2)
+        lvl = max(lvl, self._budget_adapt)
         return min(lvl, len(self.budget_rungs) - 1)
 
     def effective_budget(self, base: int) -> int:
@@ -408,6 +444,7 @@ class AdmissionController:
         return {
             "rung": self.rung,
             "budget_level": self.budget_level,
+            "budget_adapt": self._budget_adapt,
             "spec_forced": self.spec_forced(),
             "shedding": self.rejecting(),
             "queue_cap": self.queue_cap,

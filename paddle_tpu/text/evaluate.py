@@ -14,29 +14,29 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from . import engine as _engine
 from . import generate, gpt
 
 __all__ = ["nll", "perplexity", "cached_nll", "cached_perplexity"]
 
-_EVAL_CACHE: dict = {}
+# back-compat alias: eval executables live in the Engine's generate-side
+# cache now (keys embed flags.decode_jit_key via cfg_key, so a KV-dtype
+# flip splits the key instead of needing a manual clear)
+_EVAL_CACHE = _engine.ENGINE._gen
 
 
 def _eval_fn(cfg: gpt.GPTConfig):
-    key = generate._cfg_key(cfg)
-    fn = _EVAL_CACHE.get(key)
-    if fn is None:
-        def run(params, tokens):
-            # tokens [B, T+1]: positions predict their successors
-            logits, _aux = gpt.forward_with_aux(params, tokens[:, :-1], cfg)
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
-            tgt = tokens[:, 1:]
-            tok_nll = -jnp.take_along_axis(logp, tgt[..., None],
-                                           -1)[..., 0]
-            return tok_nll.sum(), tok_nll.size
+    def run(params, tokens):
+        # tokens [B, T+1]: positions predict their successors
+        logits, _aux = gpt.forward_with_aux(params, tokens[:, :-1], cfg)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        tgt = tokens[:, 1:]
+        tok_nll = -jnp.take_along_axis(logp, tgt[..., None],
+                                       -1)[..., 0]
+        return tok_nll.sum(), tok_nll.size
 
-        fn = jax.jit(run)
-        _EVAL_CACHE[key] = fn
-    return fn
+    return _engine.ENGINE.jit(
+        "evaluate.nll", ("eval_nll", _engine.cfg_key(cfg)), run)
 
 
 def nll(params, cfg: gpt.GPTConfig, tokens) -> float:
@@ -66,28 +66,25 @@ def perplexity(params, cfg: gpt.GPTConfig, tokens) -> float:
 
 
 def _cached_eval_fn(cfg: gpt.GPTConfig):
-    key = ("cached", generate._cfg_key(cfg))
-    fn = _EVAL_CACHE.get(key)
-    if fn is None:
-        def run(params, tokens):
-            # feed token t at position t through the DECODE path; its
-            # logits score token t+1 — one lax.scan over positions
-            B, T1 = tokens.shape
-            cache = generate.init_cache(cfg, B, T1 - 1)
+    def run(params, tokens):
+        # feed token t at position t through the DECODE path; its
+        # logits score token t+1 — one lax.scan over positions
+        B, T1 = tokens.shape
+        cache = generate.init_cache(cfg, B, T1 - 1)
 
-            def step(cache, t):
-                logits, cache = generate.decode_step(
-                    params, cache, tokens[:, t], t, cfg)
-                logp = jax.nn.log_softmax(logits, axis=-1)
-                return cache, jnp.take_along_axis(
-                    logp, tokens[:, t + 1][:, None], -1)[:, 0]
+        def step(cache, t):
+            logits, cache = generate.decode_step(
+                params, cache, tokens[:, t], t, cfg)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return cache, jnp.take_along_axis(
+                logp, tokens[:, t + 1][:, None], -1)[:, 0]
 
-            _, ll = jax.lax.scan(step, cache, jnp.arange(T1 - 1))
-            return -ll.sum(), ll.size
+        _, ll = jax.lax.scan(step, cache, jnp.arange(T1 - 1))
+        return -ll.sum(), ll.size
 
-        fn = jax.jit(run)
-        _EVAL_CACHE[key] = fn
-    return fn
+    return _engine.ENGINE.jit(
+        "evaluate.cached_nll",
+        ("eval_cached_nll", _engine.cfg_key(cfg)), run)
 
 
 def cached_nll(params, cfg: gpt.GPTConfig, tokens) -> float:

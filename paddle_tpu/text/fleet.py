@@ -14,8 +14,9 @@ pieces earlier rounds built:
   composing unchanged.
 * **Prefill/decode disaggregation**: :class:`PrefillWorker` runs
   admission prefill OFF the token loop — the same bucketed executables
-  the decode replica would run locally (``serving._get_prefill_fn`` /
-  ``_get_paged_prefill_fn``), on its own single-slot cache — and streams
+  the decode replica would run locally (the Engine's ``prefill`` /
+  ``paged_prefill`` registry kinds), on its own single-slot cache — and
+  streams
   the finished cache rows + admission logits back over a pluggable
   transport (:class:`LoopbackTransport` in-process for tests/CPU,
   :class:`SocketTransport` TCP frames for real fleets).  The decode side
@@ -54,6 +55,7 @@ import jax
 import jax.numpy as jnp
 
 from . import admission as _admission
+from . import engine as _engine
 from . import generate, gpt, serving
 from .. import flags as _flags
 from .. import resilience as _resilience
@@ -294,7 +296,8 @@ class PrefillWorker:
                 tables = jax.device_put(tables, self._device)
             self.cache = dict(self.cache, tables=tables)
             self._pool.dirty = False
-            fn = serving._get_paged_prefill_fn(self.cfg, C, self._skey)
+            fn = _engine.ENGINE.get("paged_prefill", _engine.StepSpec(
+                cfg=self.cfg, bucket=C, shard=self._skey))
             padded = np.zeros((1, C), np.int32)
             padded[0, :n] = prompt
             logits, self.cache = fn(
@@ -313,7 +316,8 @@ class PrefillWorker:
             self._pool.free_slot(0)
         else:
             bucket = serving._pow2_bucket(n, window)
-            fn = serving._get_prefill_fn(self.cfg, bucket, self._skey)
+            fn = _engine.ENGINE.get("prefill", _engine.StepSpec(
+                cfg=self.cfg, bucket=bucket, shard=self._skey))
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :n] = prompt
             logits, self.cache = fn(

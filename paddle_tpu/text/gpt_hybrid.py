@@ -39,6 +39,7 @@ from ..compat import shard_map
 
 from ..distributed import megatron as mt
 from ..ops.ring_attention import ring_attention, ring_attention_zigzag
+from . import engine as _engine
 from . import gpt
 
 
@@ -689,11 +690,16 @@ def build_gpt_train_step(cfg: gpt.GPTConfig, mesh: Mesh, optimizer,
         is_leaf=_spec_leaf)
 
     def init_fn(seed: int = 0) -> GPTTrainState:
+        # cache=False: out_shardings close over THIS mesh — sharing by
+        # config value would hand another mesh's placement back
         key = jax.random.PRNGKey(seed)
-        params = jax.jit(functools.partial(gpt.init_params, cfg),
-                         out_shardings=p_shard)(key)
-        opt_state = jax.jit(optimizer.init_state,
-                            out_shardings=opt_shard)(params)
+        params = _engine.ENGINE.jit(
+            "hybrid.init_params", None,
+            functools.partial(gpt.init_params, cfg), cache=False,
+            out_shardings=p_shard)(key)
+        opt_state = _engine.ENGINE.jit(
+            "hybrid.init_opt_state", None, optimizer.init_state,
+            cache=False, out_shardings=opt_shard)(params)
         return GPTTrainState(params, opt_state, jnp.zeros((), jnp.int32))
 
     # ZeRO-2: gradients reduce-scattered over the zero axis; the optimizer
@@ -748,8 +754,8 @@ def build_gpt_train_step(cfg: gpt.GPTConfig, mesh: Mesh, optimizer,
 
     repl = NamedSharding(mesh, P())
     state_shardings = GPTTrainState(p_shard, opt_shard, repl)
-    compiled = jax.jit(
-        step_fn,
+    compiled = _engine.ENGINE.jit(
+        "hybrid.train_step", None, step_fn, cache=False,
         in_shardings=(state_shardings, tok_sharding, repl, repl),
         out_shardings=(state_shardings, repl),
         donate_argnums=(0,) if donate else (),

@@ -39,7 +39,6 @@ step by design.
 from __future__ import annotations
 
 import dataclasses
-import functools
 from typing import Any
 
 import jax
@@ -188,13 +187,14 @@ def build_lora_train_step(cfg: gpt.GPTConfig, optimizer):
     input-to-output — no per-step re-materialization of a multi-GB
     frozen tree (the QLoRA case this exists for)."""
 
+    from . import engine as _engine
+
     def init(params_with_lora) -> LoraTrainState:
         base, adapters = split_lora(params_with_lora)
         return LoraTrainState(base=base, adapters=adapters,
                               opt_state=optimizer.init_state(adapters),
                               step=jnp.zeros((), jnp.int32))
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
     def step(state: LoraTrainState, tokens, lr):
         def loss_of(adapters):
             return gpt.loss_fn(_join(state.base, adapters), tokens, cfg)
@@ -207,7 +207,11 @@ def build_lora_train_step(cfg: gpt.GPTConfig, optimizer):
                               opt_state=opt_state,
                               step=state.step + 1), loss
 
-    return init, step
+    # cache=False: step closes over THIS optimizer instance — two
+    # builds for the same cfg may carry different optimizers, so
+    # sharing by config value would silently swap update rules
+    return init, _engine.ENGINE.jit("lora.train_step", None, step,
+                                    cache=False, donate_argnums=(0,))
 
 
 jax.tree_util.register_dataclass(

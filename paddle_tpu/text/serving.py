@@ -21,6 +21,7 @@ step routes through the same woq accessors.
 """
 from __future__ import annotations
 
+import os as _os
 import time
 from typing import Any
 
@@ -29,7 +30,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import admission as _admission
+from . import engine as _engine
 from . import generate, gpt
+from .engine import StepSpec as _Spec
 from .. import faults as _faults
 from .. import flags as _flags
 from .. import resilience as _resilience
@@ -147,318 +150,102 @@ def _hits_stop(st: dict) -> bool:
                for seq in st.get("stop", []))
 
 
-import os as _os
-
-_STEP_CACHE = generate._LRU(
-    int(_os.environ.get("PADDLE_TPU_STEP_CACHE_SIZE", "64")))
+# round 15: the Engine (text/engine.py) owns the step cache, the shard
+# context, and every builder — the names below stay as aliases (tests and
+# the fleet address them here), and each retired getter survives as a
+# one-line shim over ``ENGINE.get(kind, StepSpec(...))`` with
+# byte-compatible keys, watch names, jit bodies, and donation.
+_STEP_CACHE = _engine.ENGINE._steps
+_ShardCtx = _engine._ShardCtx
+_shard_kw = _engine._shard_kw
+_shard_key = _engine._shard_key
 
 # cold prefix-cache entries evicted per OOM-chain engagement (LRU-first
 # batches — repeated engagements drain more; never the whole index)
 _EVICT_BATCH = 4
 
 
-class _ShardCtx:
-    """Tensor-parallel serving context (round 9): one mesh + the
-    sharding trees every step getter threads into ``jax.jit`` so the
-    batched tick runs Megatron-sharded INSIDE the server.
-
-    Params take ``generate._decode_param_specs`` (the
-    ``build_sharded_decode`` rules — ``distributed/sharding_rules``-style
-    regex specs resolved per leaf); the cache takes
-    ``generate.sharded_cache_specs`` — the Hkv axis shards over ``mp``
-    for BOTH layouts (slab head axis / pool Hkv axis), the paged
-    ``tables`` leaf replicates.  Donation composes unchanged (in and out
-    cache shardings match, so aliasing is exact per shard); ``key``
-    folds into every step-cache key so a sharded server's compiles stay
-    visible to the recompile watch instead of colliding with the
-    single-chip executables."""
-
-    def __init__(self, mesh, cfg: gpt.GPTConfig, params, cache,
-                 mp: str = "mp"):
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        if mp not in mesh.shape:
-            raise ValueError(f"mesh has no {mp!r} axis (axes: "
-                             f"{tuple(mesh.shape)})")
-        self.mesh = mesh
-        self.mp = mp
-        ns = lambda s: NamedSharding(mesh, s)  # noqa: E731
-        pspecs = generate._decode_param_specs(params, cfg, mp)
-        self.params = jax.tree_util.tree_map(
-            ns, pspecs, is_leaf=lambda s: isinstance(s, P))
-        self.cache = {
-            name: ns(spec) for name, spec in
-            generate.sharded_cache_specs(cfg, cache, mesh, mp).items()}
-        self.repl = ns(P())
-        self.key = (mp, tuple(mesh.shape.items()),
-                    tuple(int(d.id) for d in mesh.devices.flat))
-
-
-def _shard_kw(shard: _ShardCtx | None, n_extra: int, outs: str,
-              with_params: bool = True) -> dict:
-    """jit kwargs for one step getter under a shard context (empty dict
-    single-chip — the getters stay byte-identical to the unsharded
-    build).  Inputs are (params, cache, ``n_extra`` replicated host
-    args); ``outs`` spells the output structure ('r' replicated leaf,
-    'c' the cache tree — a one-char string for cache-only returns)."""
-    if not isinstance(shard, _ShardCtx):
-        # None, or a device-pinned server's placement tuple: no explicit
-        # shardings, the key alone keeps executables per-placement
-        return {}
-    lead = ((shard.params, shard.cache) if with_params
-            else (shard.cache,))
-    out = tuple(shard.cache if o == "c" else shard.repl for o in outs)
-    return {"in_shardings": lead + (shard.repl,) * n_extra,
-            "out_shardings": out if len(outs) > 1 else out[0]}
-
-
-def _shard_key(shard):
-    """Step-cache key fragment for a server's placement: the mesh
-    fingerprint under TP, the device id tuple for a pinned single-chip
-    replica (two replicas pinned to different chips must NOT share one
-    watch-instrumented wrapper — the second chip's compile would be
-    invisible to the recompile watch and its wall charged to
-    steady-state telemetry), None for the default placement."""
-    if shard is None:
-        return None
-    return shard.key if isinstance(shard, _ShardCtx) else shard
-
-
 def _get_prefill_fn(cfg: gpt.GPTConfig, bucket: int, shard=None):
-    """One wrapper per (cfg, prompt bucket): the jit would retrace per
-    bucket shape anyway, and a per-bucket wrapper keeps the device
-    feed's captured FLOPs joined to walls of the SAME bucket — one
-    shared wrapper would divide bucket-8 FLOPs by bucket-512 walls."""
-    k = ("prefill", generate._cfg_key(cfg), int(bucket),
-         _shard_key(shard))
-    fn = _STEP_CACHE.get(k)
-    if fn is None:
-        fn = generate._watch_jit(f"serving.prefill@{bucket}", k, jax.jit(
-            lambda p, c, t, ln, sl, _cfg=cfg:
-            generate.prefill_slot(p, c, t, ln, sl, _cfg),
-            donate_argnums=generate._donate_cache(),
-            **_shard_kw(shard, 3, "rc")))
-        _STEP_CACHE[k] = fn
-    return fn
+    """Engine shim: whole-prompt admission at one power-of-two bucket."""
+    return _engine.ENGINE.get("prefill", _Spec(
+        cfg=cfg, bucket=int(bucket), shard=shard))
 
 
 def _get_prefill_chunk_fn(cfg: gpt.GPTConfig, shard=None,
                           width: int | None = None):
-    """Contiguous fixed-chunk admission step.  ``width=None`` keeps the
-    legacy key (the server's configured ``prefill_chunk`` width — the
-    jit retraces per chunk shape under that one name); an explicit
-    ``width`` (budgeted admission: the per-round prefill budget) keys
-    and names the wrapper per width, so the recompile watch joins each
-    budget's compiles to walls of the SAME width."""
-    k = ("prefill_chunk", generate._cfg_key(cfg), _shard_key(shard),
-         None if width is None else int(width))
-    fn = _STEP_CACHE.get(k)
-    if fn is None:
-        name = ("serving.prefill_chunk" if width is None
-                else f"serving.prefill_chunk@{int(width)}")
-        fn = generate._watch_jit(name, k, jax.jit(
-            lambda p, c, t, p0, ln, sl, _cfg=cfg:
-            generate.prefill_slot_chunk(p, c, t, p0, ln, sl, _cfg),
-            donate_argnums=generate._donate_cache(),
-            **_shard_kw(shard, 4, "rc")))
-        _STEP_CACHE[k] = fn
-    return fn
+    """Engine shim: contiguous fixed-chunk / budgeted admission step."""
+    return _engine.ENGINE.get("prefill_chunk", _Spec(
+        cfg=cfg, shard=shard, width=width))
 
 
 def _get_paged_prefill_fn(cfg: gpt.GPTConfig, bucket: int, shard=None):
-    """Paged admission step: one ``kv_pool.paged_prefill_chunk``
-    executable per (cfg, chunk width) — ONE program serves any prompt
-    offset (the chunk attends rows [0, pos0) through the block table),
-    so bucketed-suffix and fixed-chunk admission share this getter."""
-    k = ("paged_prefill", generate._cfg_key(cfg), int(bucket),
-         _shard_key(shard))
-    fn = _STEP_CACHE.get(k)
-    if fn is None:
-        from . import kv_pool
-
-        fn = generate._watch_jit(f"serving.paged_prefill@{bucket}", k,
-                                 jax.jit(
-            lambda p, c, t, p0, ln, sl, _cfg=cfg:
-            kv_pool.paged_prefill_chunk(p, c, t, p0, ln, sl, _cfg),
-            donate_argnums=generate._donate_cache(),
-            **_shard_kw(shard, 4, "rc")))
-        _STEP_CACHE[k] = fn
-    return fn
+    """Engine shim: paged offset-aware admission chunk."""
+    return _engine.ENGINE.get("paged_prefill", _Spec(
+        cfg=cfg, bucket=int(bucket), shard=shard))
 
 
 def _get_copy_fn(cfg: gpt.GPTConfig, n_pairs: int, shard=None):
-    """Copy-on-write device half: gather/scatter ``n_pairs`` pool blocks
-    in one donated call (``kv_pool.copy_blocks``)."""
-    k = ("kv_copy", generate._cfg_key(cfg), int(n_pairs),
-         _shard_key(shard))
-    fn = _STEP_CACHE.get(k)
-    if fn is None:
-        from . import kv_pool
-
-        fn = generate._watch_jit(f"serving.kv_copy@{n_pairs}", k, jax.jit(
-            lambda c, s, d: kv_pool.copy_blocks(c, s, d),
-            donate_argnums=generate._donate_cache() and (0,),
-            **_shard_kw(shard, 2, "c", with_params=False)))
-        _STEP_CACHE[k] = fn
-    return fn
+    """Engine shim: copy-on-write block gather/scatter (n_pairs wide)."""
+    return _engine.ENGINE.get("kv_copy", _Spec(
+        cfg=cfg, k=int(n_pairs), shard=shard))
 
 
 def _get_inject_fn(cfg: gpt.GPTConfig, bucket: int, paged: bool,
                    shard=None):
-    """Prefill-handoff injector (round 9, the fleet's decode half): one
-    donated executable per (cfg, rows bucket) writing an externally
-    prefilled row block — leaves [L, 1, bucket, Hkv(, hd)], valid
-    through ``length`` — into one slot's cache rows [start, length)
-    (``start`` skips rows an adopted prefix already holds).
-    Contiguous: the ``generate._merge_slot_rows`` masked write; paged:
-    ``kv_pool.inject_rows`` scatters through the slot's block table."""
-    k = ("inject", generate._cfg_key(cfg), int(bucket), paged,
-         _shard_key(shard))
-    fn = _STEP_CACHE.get(k)
-    if fn is None:
-        if paged:
-            from . import kv_pool
-
-            body = lambda c, r, st, ln, sl: kv_pool.inject_rows(  # noqa: E731
-                c, r, st, ln, sl)
-        else:
-            body = lambda c, r, st, ln, sl, _b=bucket: \
-                generate._merge_slot_rows(
-                    c, r, sl, jnp.asarray(0),
-                    ((jnp.arange(_b) >= st)
-                     & (jnp.arange(_b) < ln))[None, :])  # noqa: E731
-        fn = generate._watch_jit(f"serving.inject@{bucket}", k, jax.jit(
-            body, donate_argnums=generate._donate_cache() and (0,),
-            **_shard_kw(shard, 4, "c", with_params=False)))
-        _STEP_CACHE[k] = fn
-    return fn
+    """Engine shim: prefill-handoff row injector (the fleet's decode
+    half)."""
+    return _engine.ENGINE.get("inject", _Spec(
+        cfg=cfg, bucket=int(bucket), paged=paged, shard=shard))
 
 
 def _get_block_fn(cfg: gpt.GPTConfig, k: int, paged: bool = False,
                   shard=None):
-    key = ("block", generate._cfg_key(cfg), k, paged, _shard_key(shard))
-    fn = _STEP_CACHE.get(key)
-    if fn is None:
-        fn = generate._watch_jit(f"serving.block@{k}", key, jax.jit(
-            lambda p, c, t, s, _cfg=cfg, _k=k:
-            decode_block_batched(p, c, t, s, _k, _cfg),
-            donate_argnums=generate._donate_cache(),
-            **_shard_kw(shard, 2, "rcrr")))
-        _STEP_CACHE[key] = fn
-    return fn
+    """Engine shim: k greedy decode steps on device per host fetch."""
+    return _engine.ENGINE.get("block", _Spec(
+        cfg=cfg, k=k, paged=paged, shard=shard))
 
 
 def _get_sample_step_fn(cfg: gpt.GPTConfig, paged: bool = False,
                         shard=None):
-    k = ("sample", generate._cfg_key(cfg), paged, _shard_key(shard))
-    fn = _STEP_CACHE.get(k)
-    if fn is None:
-        fn = generate._watch_jit("serving.sample_step", k, jax.jit(
-            lambda p, c, t, s, ky, te, tk, tp, _cfg=cfg:
-            sample_step_batched(p, c, t, s, ky, te, tk, tp, _cfg),
-            donate_argnums=generate._donate_cache(),
-            **_shard_kw(shard, 6, "rc")))
-        _STEP_CACHE[k] = fn
-    return fn
+    """Engine shim: the batched sampled tick step."""
+    return _engine.ENGINE.get("sample", _Spec(
+        cfg=cfg, paged=paged, shard=shard))
 
 
 def _get_sample_block_fn(cfg: gpt.GPTConfig, k: int, paged: bool = False,
                          shard=None):
-    key = ("sample_block", generate._cfg_key(cfg), k, paged,
-           _shard_key(shard))
-    fn = _STEP_CACHE.get(key)
-    if fn is None:
-        fn = generate._watch_jit(f"serving.sample_block@{k}", key,
-                                 jax.jit(
-            lambda p, c, t, s, ky, off, te, tk, tp, _cfg=cfg, _k=k:
-            sample_block_batched(p, c, t, s, ky, off, te, tk, tp, _k,
-                                 _cfg),
-            donate_argnums=generate._donate_cache(),
-            **_shard_kw(shard, 7, "rc")))
-        _STEP_CACHE[key] = fn
-    return fn
+    """Engine shim: k sampled decode steps on device per host fetch."""
+    return _engine.ENGINE.get("sample_block", _Spec(
+        cfg=cfg, k=k, paged=paged, shard=shard))
 
 
 def _get_step_fn(cfg: gpt.GPTConfig, paged: bool = False, shard=None):
-    """One jitted batched step per config VALUE (generate._GEN_CACHE's
-    rationale: keying by object identity would recompile per DecodeServer
-    and leak executables).  Every step fn here DONATES its cache (arg 1,
-    generate._donate_cache): the caller must reassign the cache from the
-    return value — DecodeServer always does.  ``paged`` tags the cache
-    key (not the math: decode_step_batched branches on the cache
-    structure itself), so a paged server's compiles stay visible to the
-    recompile watch instead of hiding behind a same-key retrace."""
-    k = ("step", generate._cfg_key(cfg), paged, _shard_key(shard))
-    fn = _STEP_CACHE.get(k)
-    if fn is None:
-        fn = generate._watch_jit("serving.step", k, jax.jit(
-            lambda p, c, t, s, _cfg=cfg: decode_step_batched(
-                p, c, t, s, _cfg),
-            donate_argnums=generate._donate_cache(),
-            **_shard_kw(shard, 2, "rc")))
-        _STEP_CACHE[k] = fn
-    return fn
+    """Engine shim: THE batched greedy tick step (cache donated — the
+    caller reassigns from the return; DecodeServer always does)."""
+    return _engine.ENGINE.get("step", _Spec(
+        cfg=cfg, paged=paged, shard=shard))
 
 
 def _get_async_step_fn(cfg: gpt.GPTConfig, paged: bool = False,
                        shard=None):
-    """The async-dispatch tick step: like _get_sample_step_fn but the
-    feed token is selected ON DEVICE between the host-built token and
-    the previous (still in flight, unfetched) step's output — ``pm``
-    [B] bool picks ``pv`` (previous device tokens) over ``ht`` (host
-    tokens).  Greedy slots pass temp 0 and take the raw argmax, so one
-    executable serves greedy and sampled async ticks bit-identically to
-    the sync paths."""
-    k = ("async", generate._cfg_key(cfg), paged, _shard_key(shard))
-    fn = _STEP_CACHE.get(k)
-    if fn is None:
-        fn = generate._watch_jit("serving.async_step", k, jax.jit(
-            lambda p, c, ht, pm, pv, s, ky, te, tk, tp, _cfg=cfg:
-            sample_step_batched(p, c, jnp.where(pm, pv, ht), s,
-                                ky, te, tk, tp, _cfg),
-            donate_argnums=generate._donate_cache(),
-            **_shard_kw(shard, 8, "rc")))
-        _STEP_CACHE[k] = fn
-    return fn
+    """Engine shim: the async-dispatch tick step (device-side feed
+    select between host token and the in-flight step's output)."""
+    return _engine.ENGINE.get("async", _Spec(
+        cfg=cfg, paged=paged, shard=shard))
 
 
 def _get_async_block_fn(cfg: gpt.GPTConfig, k: int, paged: bool = False,
                         shard=None):
-    """Async greedy block: decode_block_batched with the device-side
-    feed select (see _get_async_step_fn)."""
-    key = ("async_block", generate._cfg_key(cfg), k, paged,
-           _shard_key(shard))
-    fn = _STEP_CACHE.get(key)
-    if fn is None:
-        fn = generate._watch_jit(f"serving.async_block@{k}", key,
-                                 jax.jit(
-            lambda p, c, ht, pm, pv, s, _cfg=cfg, _k=k:
-            decode_block_batched(p, c, jnp.where(pm, pv, ht), s, _k,
-                                 _cfg),
-            donate_argnums=generate._donate_cache(),
-            **_shard_kw(shard, 4, "rcrr")))
-        _STEP_CACHE[key] = fn
-    return fn
+    """Engine shim: async greedy block."""
+    return _engine.ENGINE.get("async_block", _Spec(
+        cfg=cfg, k=k, paged=paged, shard=shard))
 
 
 def _get_async_sample_block_fn(cfg: gpt.GPTConfig, k: int,
                                paged: bool = False, shard=None):
-    """Async sampled block: sample_block_batched with the device-side
-    feed select (see _get_async_step_fn)."""
-    key = ("async_sample_block", generate._cfg_key(cfg), k, paged,
-           _shard_key(shard))
-    fn = _STEP_CACHE.get(key)
-    if fn is None:
-        fn = generate._watch_jit(f"serving.async_sample_block@{k}",
-                                 key, jax.jit(
-            lambda p, c, ht, pm, pv, s, ky, off, te, tk, tp, _cfg=cfg,
-            _k=k:
-            sample_block_batched(p, c, jnp.where(pm, pv, ht), s,
-                                 ky, off, te, tk, tp, _k, _cfg),
-            donate_argnums=generate._donate_cache(),
-            **_shard_kw(shard, 9, "rc")))
-        _STEP_CACHE[key] = fn
-    return fn
+    """Engine shim: async sampled block."""
+    return _engine.ENGINE.get("async_sample_block", _Spec(
+        cfg=cfg, k=k, paged=paged, shard=shard))
 
 
 def spec_verify_batched(params, cache, tokens, pos, cfg: gpt.GPTConfig):
@@ -505,225 +292,85 @@ def spec_verify_batched(params, cache, tokens, pos, cfg: gpt.GPTConfig):
 
 def _get_spec_verify_fn(cfg: gpt.GPTConfig, k: int, paged: bool = False,
                         shard=None):
-    """The speculative serving verify step: one executable per
-    (cfg, K, layout, placement) — K is baked into the token/logit
-    shapes, and ``decode_jit_key`` carries PADDLE_TPU_SPEC_K so the
-    recompile watch sees every spec compile."""
-    key = ("spec_verify", generate._cfg_key(cfg), int(k), paged,
-           _shard_key(shard))
-    fn = _STEP_CACHE.get(key)
-    if fn is None:
-        fn = generate._watch_jit(f"serving.spec_verify@{k}", key, jax.jit(
-            lambda p, c, t, s, _cfg=cfg: spec_verify_batched(
-                p, c, t, s, _cfg),
-            donate_argnums=generate._donate_cache(),
-            **_shard_kw(shard, 2, "rc")))
-        _STEP_CACHE[key] = fn
-    return fn
+    """Engine shim: the speculative serving verify step — one
+    executable per (cfg, K, layout, placement); under a ``mesh=`` shard
+    context it composes with TP exactly like the plain step (round 15's
+    registry unlock)."""
+    return _engine.ENGINE.get("spec_verify", _Spec(
+        cfg=cfg, k=int(k), paged=paged, shard=shard))
 
 
-# -- adapter-aware getters (multi-tenant serving: text/adapters.py) --------
+# -- adapter-aware shims (multi-tenant serving: text/adapters.py) ----------
 #
-# Every getter below keys on ``pkey`` (AdapterPool.pool_key() — the pool
-# GEOMETRY: capacity/rank/targets) next to the usual cfg/layout/placement
-# fragments, so two servers sharing one pool share executables while a
-# differently-shaped pool compiles its own.  The stacked lora leaves ride
-# as a replicated extra input (arg 2, NEVER donated — the pool keeps the
-# live copy; only the cache at arg 1 aliases), and registering an adapter
-# is a row write into fixed [A, ...] shapes — zero mid-serving retraces.
+# Every kind keys on ``pkey`` (AdapterPool.pool_key() — the pool GEOMETRY:
+# capacity/rank/targets) next to the usual cfg/layout/placement fragments,
+# so two servers sharing one pool share executables while a differently-
+# shaped pool compiles its own; see the registry entries in engine.py for
+# the stacked-leaf sharding and donation story.
 
 
 def _get_adapter_step_fn(cfg: gpt.GPTConfig, pkey, paged: bool = False,
                          shard=None):
-    """Greedy adapter-gathered batched step: (p, cache, stacks, ids [B],
-    tok [B], pos [B]) -> (logits [B, V], cache)."""
-    from . import adapters as _adapters
-
-    k = ("adapter_step", generate._cfg_key(cfg), pkey, paged,
-         _shard_key(shard))
-    fn = _STEP_CACHE.get(k)
-    if fn is None:
-        fn = generate._watch_jit("serving.adapter_step", k, jax.jit(
-            lambda p, c, ad, ids, t, s, _cfg=cfg:
-            _adapters.adapter_decode_step_batched(p, c, ad, ids, t, s,
-                                                  _cfg),
-            donate_argnums=generate._donate_cache(),
-            **_shard_kw(shard, 4, "rc")))
-        _STEP_CACHE[k] = fn
-    return fn
+    """Engine shim: greedy adapter-gathered batched step."""
+    return _engine.ENGINE.get("adapter_step", _Spec(
+        cfg=cfg, pkey=pkey, paged=paged, shard=shard))
 
 
 def _get_adapter_sample_step_fn(cfg: gpt.GPTConfig, pkey,
                                 paged: bool = False, shard=None):
-    """Adapter-gathered sampled/masked step: the constraint mask [B, V]
-    is a plain array input (all-zero = unconstrained), so per-request
-    automaton state never retraces anything."""
-    from . import adapters as _adapters
-
-    k = ("adapter_sample", generate._cfg_key(cfg), pkey, paged,
-         _shard_key(shard))
-    fn = _STEP_CACHE.get(k)
-    if fn is None:
-        fn = generate._watch_jit("serving.adapter_sample_step", k,
-                                 jax.jit(
-            lambda p, c, ad, ids, t, s, ky, te, tk, tp, m, _cfg=cfg:
-            _adapters.adapter_sample_step_batched(
-                p, c, ad, ids, t, s, ky, te, tk, tp, m, _cfg),
-            donate_argnums=generate._donate_cache(),
-            **_shard_kw(shard, 9, "rc")))
-        _STEP_CACHE[k] = fn
-    return fn
+    """Engine shim: adapter-gathered sampled/masked step."""
+    return _engine.ENGINE.get("adapter_sample", _Spec(
+        cfg=cfg, pkey=pkey, paged=paged, shard=shard))
 
 
 def _get_adapter_block_fn(cfg: gpt.GPTConfig, k: int, pkey,
                           paged: bool = False, shard=None):
-    """Adapter-gathered greedy block (tick_block's gathered twin)."""
-    from . import adapters as _adapters
-
-    key = ("adapter_block", generate._cfg_key(cfg), k, pkey, paged,
-           _shard_key(shard))
-    fn = _STEP_CACHE.get(key)
-    if fn is None:
-        fn = generate._watch_jit(f"serving.adapter_block@{k}", key,
-                                 jax.jit(
-            lambda p, c, ad, ids, t, s, _cfg=cfg, _k=k:
-            _adapters.adapter_decode_block_batched(p, c, ad, ids, t, s,
-                                                   _k, _cfg),
-            donate_argnums=generate._donate_cache(),
-            **_shard_kw(shard, 4, "rcrr")))
-        _STEP_CACHE[key] = fn
-    return fn
+    """Engine shim: adapter-gathered greedy block."""
+    return _engine.ENGINE.get("adapter_block", _Spec(
+        cfg=cfg, k=k, pkey=pkey, paged=paged, shard=shard))
 
 
 def _get_adapter_async_step_fn(cfg: gpt.GPTConfig, pkey,
                                paged: bool = False, shard=None):
-    """Adapter-gathered async step: the device-side feed select of
-    _get_async_step_fn plus the per-slot gather.  No mask input —
-    constrained slots force the sync path (the mask must be built from
-    the PREVIOUS token, which an async pipeline hasn't fetched yet)."""
-    from . import adapters as _adapters
-
-    k = ("adapter_async", generate._cfg_key(cfg), pkey, paged,
-         _shard_key(shard))
-    fn = _STEP_CACHE.get(k)
-    if fn is None:
-        fn = generate._watch_jit("serving.adapter_async_step", k,
-                                 jax.jit(
-            lambda p, c, ad, ids, ht, pm, pv, s, ky, te, tk, tp,
-            _cfg=cfg:
-            _adapters.adapter_sample_step_batched(
-                p, c, ad, ids, jnp.where(pm, pv, ht), s, ky, te, tk,
-                tp, None, _cfg),
-            donate_argnums=generate._donate_cache(),
-            **_shard_kw(shard, 10, "rc")))
-        _STEP_CACHE[k] = fn
-    return fn
+    """Engine shim: adapter-gathered async step."""
+    return _engine.ENGINE.get("adapter_async", _Spec(
+        cfg=cfg, pkey=pkey, paged=paged, shard=shard))
 
 
 def _get_adapter_spec_verify_fn(cfg: gpt.GPTConfig, k: int, pkey,
                                 paged: bool = False, shard=None):
-    """Adapter-gathered speculative verify: the verify pass gathers the
-    SAME per-slot adapter the decode step uses, so accepted tokens are
-    exactly the adapter-aware target's tokens (the base-model draft
-    only affects the acceptance RATE, never the output)."""
-    from . import adapters as _adapters
-
-    key = ("adapter_spec_verify", generate._cfg_key(cfg), int(k), pkey,
-           paged, _shard_key(shard))
-    fn = _STEP_CACHE.get(key)
-    if fn is None:
-        fn = generate._watch_jit(f"serving.adapter_spec_verify@{k}", key,
-                                 jax.jit(
-            lambda p, c, ad, ids, t, s, _cfg=cfg:
-            _adapters.adapter_spec_verify_batched(p, c, ad, ids, t, s,
-                                                  _cfg),
-            donate_argnums=generate._donate_cache(),
-            **_shard_kw(shard, 4, "rc")))
-        _STEP_CACHE[key] = fn
-    return fn
+    """Engine shim: adapter-gathered speculative verify."""
+    return _engine.ENGINE.get("adapter_spec_verify", _Spec(
+        cfg=cfg, k=int(k), pkey=pkey, paged=paged, shard=shard))
 
 
 def _get_adapter_prefill_fn(cfg: gpt.GPTConfig, bucket: int, pkey,
                             shard=None):
-    """Whole-prompt admission under one slot's adapter (scalar aid):
-    the prompt's cache rows must reflect the ADAPTED weights, or decode
-    would attend base-model rows."""
-    from . import adapters as _adapters
-
-    k = ("adapter_prefill", generate._cfg_key(cfg), int(bucket), pkey,
-         _shard_key(shard))
-    fn = _STEP_CACHE.get(k)
-    if fn is None:
-        fn = generate._watch_jit(f"serving.adapter_prefill@{bucket}", k,
-                                 jax.jit(
-            lambda p, c, ad, aid, t, ln, sl, _cfg=cfg:
-            _adapters.adapter_prefill_slot(p, c, ad, aid, t, ln, sl,
-                                           _cfg),
-            donate_argnums=generate._donate_cache(),
-            **_shard_kw(shard, 5, "rc")))
-        _STEP_CACHE[k] = fn
-    return fn
+    """Engine shim: whole-prompt admission under one slot's adapter."""
+    return _engine.ENGINE.get("adapter_prefill", _Spec(
+        cfg=cfg, bucket=int(bucket), pkey=pkey, shard=shard))
 
 
 def _get_adapter_prefill_chunk_fn(cfg: gpt.GPTConfig, pkey, shard=None,
                                   width: int | None = None):
-    """Fixed-chunk / budgeted admission under one slot's adapter (the
-    adapter twin of _get_prefill_chunk_fn, same width keying)."""
-    from . import adapters as _adapters
-
-    k = ("adapter_prefill_chunk", generate._cfg_key(cfg), pkey,
-         _shard_key(shard), None if width is None else int(width))
-    fn = _STEP_CACHE.get(k)
-    if fn is None:
-        name = ("serving.adapter_prefill_chunk" if width is None
-                else f"serving.adapter_prefill_chunk@{int(width)}")
-        fn = generate._watch_jit(name, k, jax.jit(
-            lambda p, c, ad, aid, t, p0, ln, sl, _cfg=cfg:
-            _adapters.adapter_prefill_slot_chunk(p, c, ad, aid, t, p0,
-                                                 ln, sl, _cfg),
-            donate_argnums=generate._donate_cache(),
-            **_shard_kw(shard, 6, "rc")))
-        _STEP_CACHE[k] = fn
-    return fn
+    """Engine shim: fixed-chunk / budgeted admission under one slot's
+    adapter."""
+    return _engine.ENGINE.get("adapter_prefill_chunk", _Spec(
+        cfg=cfg, pkey=pkey, shard=shard, width=width))
 
 
 def _get_adapter_paged_prefill_fn(cfg: gpt.GPTConfig, bucket: int, pkey,
                                   shard=None):
-    """Paged admission chunk under one slot's adapter."""
-    from . import adapters as _adapters
-
-    k = ("adapter_paged_prefill", generate._cfg_key(cfg), int(bucket),
-         pkey, _shard_key(shard))
-    fn = _STEP_CACHE.get(k)
-    if fn is None:
-        fn = generate._watch_jit(
-            f"serving.adapter_paged_prefill@{bucket}", k, jax.jit(
-                lambda p, c, ad, aid, t, p0, ln, sl, _cfg=cfg:
-                _adapters.adapter_paged_prefill_chunk(
-                    p, c, ad, aid, t, p0, ln, sl, _cfg),
-                donate_argnums=generate._donate_cache(),
-                **_shard_kw(shard, 6, "rc")))
-        _STEP_CACHE[k] = fn
-    return fn
+    """Engine shim: paged admission chunk under one slot's adapter."""
+    return _engine.ENGINE.get("adapter_paged_prefill", _Spec(
+        cfg=cfg, bucket=int(bucket), pkey=pkey, shard=shard))
 
 
 def _get_masked_step_fn(cfg: gpt.GPTConfig, paged: bool = False,
                         shard=None):
-    """Constrained step for servers WITHOUT an adapter pool: the plain
-    sampled step plus the [B, V] constraint mask input.  Greedy slots
-    (temp 0) take the argmax of the masked logits — see
-    _sample_batched."""
-    k = ("masked_step", generate._cfg_key(cfg), paged, _shard_key(shard))
-    fn = _STEP_CACHE.get(k)
-    if fn is None:
-        fn = generate._watch_jit("serving.masked_step", k, jax.jit(
-            lambda p, c, t, s, ky, te, tk, tp, m, _cfg=cfg:
-            sample_step_batched(p, c, t, s, ky, te, tk, tp, _cfg,
-                                mask=m),
-            donate_argnums=generate._donate_cache(),
-            **_shard_kw(shard, 7, "rc")))
-        _STEP_CACHE[k] = fn
-    return fn
+    """Engine shim: constrained (masked) step for pool-less servers."""
+    return _engine.ENGINE.get("masked_step", _Spec(
+        cfg=cfg, paged=paged, shard=shard))
 
 
 def _pow2_bucket(n: int, *bounds) -> int:
@@ -888,10 +535,6 @@ class DecodeServer:
                     "capacity routing differs between chunked verify "
                     "and stepwise decode — speculative_generate's "
                     "rule)")
-            if mesh is not None:
-                raise NotImplementedError(
-                    "speculative serving is not supported with "
-                    "tensor-parallel (mesh=) serving yet")
             if not 1 <= k_spec < window:
                 raise ValueError(
                     f"spec_k {k_spec} must be in [1, {window}) — the "
@@ -927,7 +570,7 @@ class DecodeServer:
                     "tensor-parallel serving supports dense models "
                     "(build_sharded_decode's rule)")
             self._shard = _ShardCtx(mesh, cfg, params, self.cache,
-                                    mp_axis)
+                                    mp_axis, pool=adapter_pool)
             self.params = jax.tree_util.tree_map(
                 jax.device_put, params, self._shard.params)
             self.cache = {n: jax.device_put(a, self._shard.cache[n])
@@ -939,6 +582,11 @@ class DecodeServer:
             # placement joins every step-cache key (see _shard_key)
             self._shard = ("device", int(getattr(device, "id", 0)))
         self._step = _get_step_fn(cfg, self._paged, self._shard)
+        # the draft model's placement context: identical to the target's
+        # for pinned/un-placed servers; under mesh= it gets its OWN
+        # _ShardCtx (the draft cfg's Megatron/cache specs differ from the
+        # target's), built below once the twin cache exists
+        self._draft_shard = self._shard
         if self._spec_on and draft_cfg is not None:
             if self._paged:
                 from . import kv_pool as _kv
@@ -960,6 +608,19 @@ class DecodeServer:
                                                     self._device)
                 self._draft_cache = jax.device_put(self._draft_cache,
                                                    self._device)
+            elif mesh is not None:
+                # spec × TP (the registry unlock): the draft twin shards
+                # by the SAME sharded_cache_specs rule as the target —
+                # its Hkv axis over mp_axis, params Megatron-style
+                self._draft_shard = _ShardCtx(mesh, draft_cfg,
+                                              draft_params,
+                                              self._draft_cache, mp_axis)
+                self._draft_params = jax.tree_util.tree_map(
+                    jax.device_put, draft_params,
+                    self._draft_shard.params)
+                self._draft_cache = {
+                    n: jax.device_put(a, self._draft_shard.cache[n])
+                    for n, a in self._draft_cache.items()}
         # async_dispatch: keep ONE step/block in flight — tick() first
         # dispatches step N+1 (feeding the previous step's tokens from
         # the DEVICE array, never fetched) and only then blocks on step
@@ -1103,12 +764,6 @@ class DecodeServer:
         # code path byte-identical to the pre-adapter server.
         self._adapters = adapter_pool
         if adapter_pool is not None:
-            if mesh is not None:
-                raise NotImplementedError(
-                    "adapter_pool with tensor-parallel serving: the "
-                    "stacked lora leaves would need their own sharding "
-                    "specs (replicate-or-split per target) — not built "
-                    "yet")
             if (generate._cfg_key(adapter_pool.cfg)
                     != generate._cfg_key(cfg)):
                 raise ValueError(
@@ -1840,10 +1495,11 @@ class DecodeServer:
             # the draft twin walks the SAME chunk (the budgeted version
             # of _spec_draft_admit / _paged_prefill_slot's draft walk),
             # so graduation can set spec_dpos = n directly
-            dfn = (_get_paged_prefill_fn(self.draft_cfg, W, self._shard)
+            dfn = (_get_paged_prefill_fn(self.draft_cfg, W,
+                                         self._draft_shard)
                    if self._paged else
-                   _get_prefill_chunk_fn(self.draft_cfg, self._shard,
-                                         width=W))
+                   _get_prefill_chunk_fn(self.draft_cfg,
+                                         self._draft_shard, width=W))
             _, self._draft_cache = dfn(
                 self._draft_params, self._draft_cache,
                 jnp.asarray(padded), jnp.asarray(s),
@@ -1958,7 +1614,7 @@ class DecodeServer:
                 # positions — the draft pool copies the same pairs so
                 # the shared table stays valid for both
                 self._draft_cache = _get_copy_fn(
-                    self.draft_cfg, width, self._shard)(
+                    self.draft_cfg, width, self._draft_shard)(
                     self._draft_cache, src, dst)
         if self._pool.dirty:
             tables = jnp.asarray(self._pool.tables)
@@ -1976,7 +1632,10 @@ class DecodeServer:
                 # and a shared array would be deleted out from under
                 # the draft the first time a target step donates it
                 dtables = jnp.asarray(self._pool.tables)
-                if self._device is not None:
+                if isinstance(self._draft_shard, _ShardCtx):
+                    dtables = jax.device_put(
+                        dtables, self._draft_shard.cache["tables"])
+                elif self._device is not None:
                     dtables = jax.device_put(dtables, self._device)
                 self._draft_cache = dict(self._draft_cache,
                                          tables=dtables)
@@ -2083,7 +1742,8 @@ class DecodeServer:
             # adopted prefix's draft rows are already valid (every
             # admission on this server writes both caches before
             # register_prefix) and the suffix fills here
-            dfn = _get_paged_prefill_fn(self.draft_cfg, C, self._shard)
+            dfn = _get_paged_prefill_fn(self.draft_cfg, C,
+                                        self._draft_shard)
             for s in starts:
                 chunk = prompt[s:s + C]
                 padded = np.zeros((1, C), np.int32)
@@ -2232,7 +1892,8 @@ class DecodeServer:
             C = self._chunk
             starts = ([0] if n <= C
                       else list(range(0, n - C, C)) + [n - C])
-            dfn = _get_prefill_chunk_fn(self.draft_cfg, self._shard)
+            dfn = _get_prefill_chunk_fn(self.draft_cfg,
+                                        self._draft_shard)
             for i in starts:
                 chunk = req["prompt"][i:i + C]
                 padded = np.zeros((1, C), np.int32)
@@ -2247,7 +1908,7 @@ class DecodeServer:
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :n] = req["prompt"]
         _, self._draft_cache = _get_prefill_fn(
-            self.draft_cfg, bucket, self._shard)(
+            self.draft_cfg, bucket, self._draft_shard)(
             self._draft_params, self._draft_cache, jnp.asarray(padded),
             jnp.asarray(n), jnp.asarray(slot))
         return n
@@ -2264,7 +1925,8 @@ class DecodeServer:
         is benign (the same argument covers shared paged blocks:
         recomputed rows are a deterministic function of the same
         tokens, hence bit-identical)."""
-        step = _get_step_fn(self.draft_cfg, self._paged, self._shard)
+        step = _get_step_fn(self.draft_cfg, self._paged,
+                            self._draft_shard)
         while True:
             lag = [(slot, st) for slot, st in self._slots.items()
                    if not st.get("spec_off")
@@ -2295,7 +1957,8 @@ class DecodeServer:
         Fallen-back slots ride along fed their feed token (their draft
         rows go stale — benign, they never speculate again)."""
         self._spec_draft_catchup()
-        step = _get_step_fn(self.draft_cfg, self._paged, self._shard)
+        step = _get_step_fn(self.draft_cfg, self._paged,
+                            self._draft_shard)
         tok, pos = self._feed_arrays()
         temp, tk, tp = self._sampling_arrays()
         eligible = {slot: st for slot, st in self._slots.items()
@@ -2566,13 +2229,10 @@ class DecodeServer:
         if self.metrics_server is not None:
             self.metrics_server.close()   # joins the serve thread
             self.metrics_server = None
-        cks = [generate._cfg_key(self.cfg)]
-        if self.draft_cfg is not None:
-            cks.append(generate._cfg_key(self.draft_cfg))
-        for k in _STEP_CACHE.keys():
-            if any(k == ck or (isinstance(k, tuple) and ck in k)
-                   for ck in cks):
-                _STEP_CACHE.pop(k)
+        # one pass over the Engine's OWN caches: every family (plain,
+        # adapter_*, draft twins, generate-side executables) whose key
+        # embeds either cfg drops — a new registry kind can't leak
+        _engine.ENGINE.purge(self.cfg, self.draft_cfg)
         self.cache = None
         self._draft_cache = None
         self._step = None
@@ -3729,333 +3389,9 @@ class DecodeServer:
         bit-identical tokens to a cold one.
 
         Returns {executable: seconds} compile+first-run timings."""
-        import time
-
-        from ..framework import platform as _platform
-
-        if self._inflight is not None and not self._slots and not self._queue:
-            # a drained async server's final overrun dispatch: every slot
-            # it fed has retired, so its tokens are disposable by design
-            self._inflight = None
-        if self._slots or self._queue or self._inflight is not None:
-            raise RuntimeError(
-                "DecodeServer.warmup() requires an idle server: warm "
-                "steps write garbage rows at pos 0 of every slot, which "
-                "only un-admitted requests are guaranteed to overwrite")
-        _platform.init_compile_cache()
-        timings = {}
-        B = self.max_batch
-        zi = np.zeros((B,), np.int32)
-        zb = np.zeros((B,), bool)
-        zf = np.zeros((B,), np.float32)
-        of = np.ones((B,), np.float32)
-        # any key works (warmup compiles; values are discarded) — a high
-        # sentinel keeps clear of the per-step fold_in counters
-        key = jax.random.fold_in(self._base_key, (1 << 31) + 1)
-
-        def warm(name, thunk):
-            t0 = time.perf_counter()
-            out = thunk()
-            jax.block_until_ready(out[0])
-            self.cache = out[1]
-            timings[name] = round(time.perf_counter() - t0, 3)
-
-        def warm_draft(name, thunk):
-            # the draft twin: reassigns the DRAFT cache (donation
-            # chains it through exactly like the target's)
-            t0 = time.perf_counter()
-            out = thunk()
-            jax.block_until_ready(out[0])
-            self._draft_cache = out[1]
-            timings[name] = round(time.perf_counter() - t0, 3)
-
-        tok, pos = jnp.asarray(zi), jnp.asarray(zi)
-        pool = self._adapters
-        if pool is not None:
-            pk = pool.pool_key()
-            ad = pool.stacks()
-            ids0 = jnp.asarray(zi)          # all-base gather
-            aid0 = jnp.asarray(0)
-            zm = jnp.zeros((B, self.cfg.vocab_size), jnp.float32)
-        if pool is not None:
-            # adapter twins: these ARE the executables a pool-attached
-            # server dispatches (see _tick_impl) — the plain ones would
-            # be dead compiles
-            if self._async:
-                fn = _get_adapter_async_step_fn(self.cfg, pk,
-                                                self._paged, self._shard)
-                warm("adapter_async_step", lambda: fn(
-                    self.params, self.cache, ad, ids0, tok,
-                    jnp.asarray(zb), tok, pos, key, jnp.asarray(zf),
-                    jnp.asarray(zi), jnp.asarray(of)))
-            # the sync greedy step also serves async servers' stepwise
-            # constraint fallback, so warm it unconditionally
-            fn = _get_adapter_step_fn(self.cfg, pk, self._paged,
-                                      self._shard)
-            warm("adapter_step", lambda: fn(
-                self.params, self.cache, ad, ids0, tok, pos))
-            if sample or constrained:
-                fn = _get_adapter_sample_step_fn(self.cfg, pk,
-                                                 self._paged,
-                                                 self._shard)
-                warm("adapter_sample_step", lambda: fn(
-                    self.params, self.cache, ad, ids0, tok, pos, key,
-                    jnp.asarray(zf), jnp.asarray(zi), jnp.asarray(of),
-                    zm))
-        elif self._async:
-            fn = _get_async_step_fn(self.cfg, self._paged, self._shard)
-            warm("async_step", lambda: fn(
-                self.params, self.cache, tok, jnp.asarray(zb), tok, pos,
-                key, jnp.asarray(zf), jnp.asarray(zi), jnp.asarray(of)))
-            if constrained:
-                # async constrained traffic drains to the SYNC masked
-                # step (_tick_impl's fallback) — warm that path too
-                fn = _get_masked_step_fn(self.cfg, self._paged,
-                                         self._shard)
-                zm = jnp.zeros((B, self.cfg.vocab_size), jnp.float32)
-                warm("masked_step", lambda: fn(
-                    self.params, self.cache, tok, pos, key,
-                    jnp.asarray(zf), jnp.asarray(zi), jnp.asarray(of),
-                    zm))
-        else:
-            warm("step", lambda: self._step(self.params, self.cache, tok,
-                                            pos))
-            if sample:
-                fn = _get_sample_step_fn(self.cfg, self._paged,
-                                         self._shard)
-                warm("sample_step", lambda: fn(
-                    self.params, self.cache, tok, pos, key,
-                    jnp.asarray(zf), jnp.asarray(zi), jnp.asarray(of)))
-            if constrained:
-                fn = _get_masked_step_fn(self.cfg, self._paged,
-                                         self._shard)
-                zm = jnp.zeros((B, self.cfg.vocab_size), jnp.float32)
-                warm("masked_step", lambda: fn(
-                    self.params, self.cache, tok, pos, key,
-                    jnp.asarray(zf), jnp.asarray(zi), jnp.asarray(of),
-                    zm))
-        for k in blocks:
-            k = int(k)
-            if pool is not None:
-                if self._async:
-                    # async adapter tick_block falls back to stepwise
-                    # async ticks (adapter_async_step, warmed above) —
-                    # no block executable to compile
-                    continue
-                fn = _get_adapter_block_fn(self.cfg, k, pk, self._paged,
-                                           self._shard)
-                warm(f"adapter_block{k}", lambda fn=fn: fn(
-                    self.params, self.cache, ad, ids0, tok, pos)[:2])
-                # sampled pool traffic steps through adapter_sample_step
-                # (tick_block's stepwise fallback) — no sampled block
-            elif self._async:
-                fn = _get_async_block_fn(self.cfg, k, self._paged,
-                                         self._shard)
-                warm(f"async_block{k}", lambda fn=fn: fn(
-                    self.params, self.cache, tok, jnp.asarray(zb), tok,
-                    pos)[:2])
-                if sample:
-                    fn = _get_async_sample_block_fn(self.cfg, k,
-                                                    self._paged,
-                                                    self._shard)
-                    warm(f"async_sample_block{k}", lambda fn=fn: fn(
-                        self.params, self.cache, tok, jnp.asarray(zb),
-                        tok, pos, self._base_key, jnp.asarray(0),
-                        jnp.asarray(zf), jnp.asarray(zi),
-                        jnp.asarray(of)))
-            else:
-                fn = _get_block_fn(self.cfg, k, self._paged, self._shard)
-                warm(f"block{k}", lambda fn=fn: fn(
-                    self.params, self.cache, tok, pos)[:2])
-                if sample:
-                    fn = _get_sample_block_fn(self.cfg, k, self._paged,
-                                              self._shard)
-                    warm(f"sample_block{k}", lambda fn=fn: fn(
-                        self.params, self.cache, tok, pos,
-                        self._base_key, jnp.asarray(0), jnp.asarray(zf),
-                        jnp.asarray(zi), jnp.asarray(of)))
-        if self._spec_on:
-            # the speculative round's executables: the batched verify
-            # (K garbage rows per slot at pos 0 — the same stale-row
-            # cover as the plain warm steps) and, in draft mode, the
-            # draft's own decode step
-            K = self._spec_k
-            tokK = jnp.zeros((B, K), jnp.int32)
-            if pool is not None:
-                sfn = _get_adapter_spec_verify_fn(self.cfg, K, pk,
-                                                  self._paged,
-                                                  self._shard)
-                warm(f"adapter_spec_verify@{K}", lambda: sfn(
-                    self.params, self.cache, ad, ids0, tokK, pos))
-            else:
-                sfn = _get_spec_verify_fn(self.cfg, K, self._paged,
-                                          self._shard)
-                warm(f"spec_verify@{K}", lambda: sfn(
-                    self.params, self.cache, tokK, pos))
-            if self._draft_cache is not None:
-                dfn = _get_step_fn(self.draft_cfg, self._paged,
-                                   self._shard)
-                warm_draft("draft_step", lambda: dfn(
-                    self._draft_params, self._draft_cache, tok, pos))
-        window = min(self.max_len, self.cfg.max_seq_len)
-        if self._paged and self._prefill_on:
-            # paged admission executables: one offset-aware chunk
-            # program per width (fixed chunk, or the suffix buckets).
-            # Widths floor at the block size (admission's rule), and the
-            # block-size width itself is always warmed: a prefix-hit
-            # admission prefills a sub-block suffix through it, which
-            # must not compile mid-serving on a warmed server
-            if self._chunk:
-                widths = [min(self._chunk, window)]
-            else:
-                # admission buckets the suffix to
-                # min(max(pow2(n - shared), bs), window): a PARTIAL
-                # prefix hit lands on ANY power of two in (bs, pow2(n)]
-                # (not bs*2^k — bs need not be a power of two), plus the
-                # bs floor itself.  Warm exactly that reachable set —
-                # log-many executables, no mid-serving compile
-                def _ladder(top):
-                    ws, p = {min(self._pool.bs, window)}, 1
-                    while p < top:
-                        p *= 2
-                        if p > self._pool.bs:
-                            ws.add(min(p, window))
-                    return ws
-
-                if prompt_lens is None:
-                    widths = _ladder(window)
-                else:
-                    widths = set()
-                    for n in prompt_lens:
-                        widths |= _ladder(
-                            1 << max(0, int(n) - 1).bit_length())
-            if self._budget:
-                # budgeted admission walks the budget-width chunk
-                # executable for every claimed (multi-chunk) prompt —
-                # and, with admission control on, EVERY degradation-
-                # ladder rung (admission.ladder_widths): the SLO
-                # controller's budget moves must pick among compiled
-                # programs, never retrace mid-serving
-                rungs = (self._adm.budget_rungs if self._adm is not None
-                         else (self._budget,))
-                widths = set(widths) | {min(w, window)
-                                        for w in rungs or (self._budget,)}
-            for C in sorted(set(widths)):
-                padded = jnp.zeros((1, C), jnp.int32)
-                if pool is not None:
-                    afn = _get_adapter_paged_prefill_fn(self.cfg, C, pk,
-                                                        self._shard)
-                    warm(f"adapter_paged_prefill{C}",
-                         lambda afn=afn, padded=padded: afn(
-                             self.params, self.cache, ad, aid0, padded,
-                             jnp.asarray(0), jnp.asarray(1),
-                             jnp.asarray(0)))
-                else:
-                    fn = _get_paged_prefill_fn(self.cfg, C, self._shard)
-                    warm(f"paged_prefill{C}",
-                         lambda fn=fn, padded=padded: fn(
-                             self.params, self.cache, padded,
-                             jnp.asarray(0), jnp.asarray(1),
-                             jnp.asarray(0)))
-                if self._draft_cache is not None:
-                    dfn = _get_paged_prefill_fn(self.draft_cfg, C,
-                                                self._shard)
-                    warm_draft(f"draft_paged_prefill{C}",
-                               lambda dfn=dfn, padded=padded: dfn(
-                                   self._draft_params,
-                                   self._draft_cache, padded,
-                                   jnp.asarray(0), jnp.asarray(1),
-                                   jnp.asarray(0)))
-        elif self._prefill_chunk is not None:
-            C = self._chunk
-            padded = jnp.zeros((1, C), jnp.int32)
-            if pool is not None:
-                afn = _get_adapter_prefill_chunk_fn(self.cfg, pk,
-                                                    self._shard)
-                warm(f"adapter_prefill_chunk{C}", lambda: afn(
-                    self.params, self.cache, ad, aid0, padded,
-                    jnp.asarray(0), jnp.asarray(1), jnp.asarray(0)))
-            else:
-                warm(f"prefill_chunk{C}", lambda: self._prefill_chunk(
-                    self.params, self.cache, padded, jnp.asarray(0),
-                    jnp.asarray(1), jnp.asarray(0)))
-            if self._draft_cache is not None:
-                dfn = _get_prefill_chunk_fn(self.draft_cfg,
-                                            self._shard)
-                warm_draft(f"draft_prefill_chunk{C}",
-                           lambda: dfn(self._draft_params,
-                                       self._draft_cache, padded,
-                                       jnp.asarray(0), jnp.asarray(1),
-                                       jnp.asarray(0)))
-        elif self._prefill is not None:
-            if prompt_lens is None:
-                buckets, b = [], 1
-                while b < window:
-                    buckets.append(b)
-                    b *= 2
-                buckets.append(window)
-            else:
-                buckets = [min(1 << max(0, int(n) - 1).bit_length(),
-                               window) for n in prompt_lens]
-            for b in sorted(set(buckets)):
-                padded = jnp.zeros((1, b), jnp.int32)
-                if pool is not None:
-                    afn = _get_adapter_prefill_fn(self.cfg, b, pk,
-                                                  self._shard)
-                    warm(f"adapter_prefill{b}",
-                         lambda afn=afn, padded=padded: afn(
-                             self.params, self.cache, ad, aid0, padded,
-                             jnp.asarray(1), jnp.asarray(0)))
-                else:
-                    fn = self._prefill(b)
-                    warm(f"prefill{b}", lambda fn=fn, padded=padded: fn(
-                        self.params, self.cache, padded, jnp.asarray(1),
-                        jnp.asarray(0)))
-                if self._draft_cache is not None:
-                    dfn = _get_prefill_fn(self.draft_cfg, b,
-                                          self._shard)
-                    warm_draft(f"draft_prefill{b}",
-                               lambda dfn=dfn, padded=padded: dfn(
-                                   self._draft_params,
-                                   self._draft_cache, padded,
-                                   jnp.asarray(1), jnp.asarray(0)))
-        if self._budget and not self._paged:
-            # budgeted admission's offset-aware chunk executables: the
-            # base width, plus — with admission control on — every
-            # degradation-ladder rung (admission.ladder_widths), so the
-            # SLO controller's budget moves pick among compiled
-            # programs and never retrace mid-serving
-            rungs = (self._adm.budget_rungs if self._adm is not None
-                     else ()) or (self._budget,)
-            for Wb in sorted({min(w, window) for w in rungs},
-                             reverse=True):
-                pad_b = jnp.zeros((1, Wb), jnp.int32)
-                if pool is not None:
-                    abfn = _get_adapter_prefill_chunk_fn(
-                        self.cfg, pk, self._shard, width=Wb)
-                    warm(f"adapter_prefill_chunk@{Wb}",
-                         lambda abfn=abfn, pad_b=pad_b: abfn(
-                             self.params, self.cache, ad, aid0, pad_b,
-                             jnp.asarray(0), jnp.asarray(1),
-                             jnp.asarray(0)))
-                else:
-                    bfn = _get_prefill_chunk_fn(self.cfg, self._shard,
-                                                width=Wb)
-                    warm(f"prefill_chunk@{Wb}",
-                         lambda bfn=bfn, pad_b=pad_b: bfn(
-                             self.params, self.cache, pad_b,
-                             jnp.asarray(0),
-                             jnp.asarray(1), jnp.asarray(0)))
-                if self._draft_cache is not None:
-                    dbfn = _get_prefill_chunk_fn(self.draft_cfg,
-                                                 self._shard, width=Wb)
-                    warm_draft(f"draft_prefill_chunk@{Wb}",
-                               lambda dbfn=dbfn, pad_b=pad_b: dbfn(
-                                   self._draft_params,
-                                   self._draft_cache, pad_b,
-                                   jnp.asarray(0), jnp.asarray(1),
-                                   jnp.asarray(0)))
-        return timings
+        return _engine.ENGINE.warmup(
+            self, prompt_lens=prompt_lens, blocks=blocks,
+            sample=sample, constrained=constrained)
 
     def tick_block(self, block: int = 8):
         """``block`` greedy decode steps with ONE host round trip.

@@ -36,7 +36,8 @@ _ADM_ENV = ("PADDLE_TPU_ADMISSION", "PADDLE_TPU_SLO_TTFT_MS",
             "PADDLE_TPU_SLO_TPOT_MS", "PADDLE_TPU_SLO_WINDOW_S",
             "PADDLE_TPU_TENANT_RATE", "PADDLE_TPU_TENANT_BURST",
             "PADDLE_TPU_ADMISSION_QUEUE_CAP",
-            "PADDLE_TPU_EVICT_REQUEUE_MAX")
+            "PADDLE_TPU_EVICT_REQUEUE_MAX",
+            "PADDLE_TPU_ADAPTIVE_BUDGET")
 
 
 def _cfg(**over):
@@ -148,6 +149,54 @@ def test_ladder_climbs_holds_and_recovers():
         assert adm.control_tick(now=t)
         assert adm.rung == want
     assert adm.effective_budget(64) == 64 and not adm.spec_forced()
+
+
+def test_adaptive_budget_moves_without_the_ladder():
+    """The round-15 adaptive budget: a TPOT-breach window shrinks the
+    prefill budget one pre-warmed rung while the coarse ladder sits at
+    rung 1 (which alone maps to budget level 0), WITHOUT touching the
+    admit cap or speculation; healthy windows grow it back, an idle
+    window resets it."""
+    adm = admission.AdmissionController(
+        scope="t", slo_tpot_ms=10.0, window_s=1.0,
+        budget_rungs=(64, 32, 16), now=0.0)
+    _feed_gaps(50.0)
+    assert adm.control_tick(now=1.01)
+    # rung 1 -> ladder level 0, but the adaptive counter already moved
+    assert adm.rung == 1
+    assert adm.budget_level == 1 and adm.effective_budget(64) == 32
+    # the other levers stay put at rung 1's settings
+    assert not adm.spec_forced() and not adm.rejecting()
+    # a second breach: adaptive counter leads the ladder again
+    _feed_gaps(50.0)
+    assert adm.control_tick(now=2.02)
+    assert adm.rung == 2 and adm.budget_level == 2
+    assert adm.effective_budget(64) == 16
+    # affirmatively healthy windows grow the budget back one rung each
+    _feed_gaps(1.0)
+    assert adm.control_tick(now=3.03)
+    assert adm.rung == 1 and adm.budget_level == 1
+    _feed_gaps(1.0)
+    assert adm.control_tick(now=4.04)
+    assert adm.rung == 0 and adm.budget_level == 0
+    assert adm.effective_budget(64) == 64
+    # idle reset clears the adaptive counter outright
+    _feed_gaps(50.0)
+    assert adm.control_tick(now=5.05) and adm.budget_level == 1
+    assert adm.control_tick(now=6.06, idle=True)
+    assert adm.budget_level == 0 and adm.stats()["budget_adapt"] == 0
+
+
+def test_adaptive_budget_flag_off_restores_ladder_coupling(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_ADAPTIVE_BUDGET", "0")
+    adm = admission.AdmissionController(
+        scope="t", slo_tpot_ms=10.0, window_s=1.0,
+        budget_rungs=(64, 32, 16), now=0.0)
+    _feed_gaps(50.0)
+    assert adm.control_tick(now=1.01)
+    # rung 1 alone keeps the budget at the base width (pre-15 behavior)
+    assert adm.rung == 1 and adm.budget_level == 0
+    assert adm.effective_budget(64) == 64
 
 
 def test_idle_window_resets_ladder_outright():

@@ -1,0 +1,444 @@
+"""Engine migration-parity suite (round 15).
+
+The step-function zoo (serving's ``_get_*_fn`` getters, generate's
+``_jit_by_cfg``/``_watch_jit``, ``build_sharded_decode``) collapsed into
+one declarative subsystem: ``text/engine.py``'s :class:`StepSpec` +
+registry + :class:`Engine`.  These tests pin the migration contract:
+
+* every serving-path variant — {contiguous, paged} x {tick, block,
+  async} x {spec on/off} x {prefill budget on/off} — produces greedy
+  tokens bit-identical to the plain contiguous tick server;
+* the Engine's step cache holds EXACTLY the legacy key literals the
+  retired getters wrote (hand-written expected sets, per scenario);
+* warmup-then-serve adds zero executables and zero compile-log entries;
+* the recompile watch names every Engine build exactly once;
+* the round-15 unlocks work: speculative decoding on a ``mesh=`` TP
+  server and a stacked :class:`AdapterPool` under TP, both bit-equal to
+  their single-chip twins on a CPU mesh, built purely through the
+  registry;
+* ``close()`` purges BOTH cfg families (target + draft twin, plain +
+  adapter) and the generate-domain entries in one pass;
+* the ENGINE lint family in ``tools/check_instrumented.py`` rejects
+  ``jax.jit`` / step-cache writes outside engine.py and un-instrumented
+  choke points inside it.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from paddle_tpu import telemetry as tl
+from paddle_tpu.text import adapters as A
+from paddle_tpu.text import engine, evaluate, gpt, lora, serving
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=16, hidden_size=32, num_layers=1, num_heads=2,
+                max_seq_len=64)
+    base.update(kw)
+    return gpt.GPTConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def models():
+    cfg = _cfg()
+    dcfg = _cfg(hidden_size=16)
+    return (cfg, gpt.init_params(cfg, jax.random.PRNGKey(0)),
+            dcfg, gpt.init_params(dcfg, jax.random.PRNGKey(1)))
+
+
+# one short prompt (bucket 4) and one long one (bucket 16; with
+# prefill_budget=4 it is admitted through the width-4 chunk path)
+_PROMPTS = ([2, 3, 4], [2] * 12)
+
+
+def _mk_server(models, paged, mode, spec, budget, **extra):
+    cfg, params, dcfg, dparams = models
+    kw = dict(extra)
+    if paged:
+        kw.update(layout="paged", block_size=8, num_blocks=32)
+    if mode == "async":
+        kw["async_dispatch"] = True
+    if spec:
+        kw.update(draft_cfg=dcfg, draft_params=dparams, spec_k=2)
+    if budget:
+        kw["prefill_budget"] = 4
+    return serving.DecodeServer(params, cfg, max_batch=2, max_len=40,
+                                **kw)
+
+
+def _drain(srv, mode, prompts=_PROMPTS, max_new=5):
+    rids = [srv.submit(list(p), max_new_tokens=max_new) for p in prompts]
+    ticks = 0
+    while srv.pending():
+        srv.tick_block(3) if mode == "block" else srv.tick()
+        ticks += 1
+        assert ticks < 300
+    return [srv.result(r) for r in rids]
+
+
+def _expected_keys(ck, dk, paged, mode, spec, budget):
+    """The hand-written legacy key literals one serve of ``_PROMPTS``
+    writes — byte-identical to what the retired ``serving._get_*_fn``
+    getters produced (positions, literals, shard fragment ``None``)."""
+    def prefills(c):
+        if paged:
+            # short prompt rounds to one 8-token block; the long one is
+            # either a 16 bucket or budget-width 4-token chunks
+            return {("paged_prefill", c, 8, None),
+                    ("paged_prefill", c, 4 if budget else 16, None)}
+        if budget:
+            return {("prefill", c, 4, None),
+                    ("prefill_chunk", c, None, 4)}
+        return {("prefill", c, 4, None), ("prefill", c, 16, None)}
+
+    exp = prefills(ck) | {("step", ck, paged, None)}
+    if spec:
+        # draft-twin prefill/step plus the K-token verify executable
+        exp |= prefills(dk) | {("step", dk, paged, None),
+                               ("spec_verify", ck, 2, paged, None)}
+    if mode == "block" and not spec:
+        # spec decode replaces the block path entirely
+        exp.add(("block", ck, 3, paged, None))
+    if mode == "async" and (not spec or budget):
+        # under spec, only the budgeted chunk-admission tail ticks fall
+        # back to the plain async step
+        exp.add(("async", ck, paged, None))
+    return exp
+
+
+def test_matrix_parity_and_keysets(models):
+    """The full {contiguous, paged} x {tick, block, async} x {spec} x
+    {budget} matrix: greedy tokens bit-identical to the plain
+    contiguous tick server, and the Engine's step cache equal to the
+    union of each scenario's hand-written legacy key set (checked
+    incrementally, so any scenario writing an extra or alien key fails
+    at that scenario)."""
+    cfg, params, dcfg, dparams = models
+    ck, dk = engine.cfg_key(cfg), engine.cfg_key(dcfg)
+    engine.ENGINE._steps.clear()
+    ref = None
+    expected = set()
+    servers = []
+    try:
+        for paged in (False, True):
+            for mode in ("tick", "block", "async"):
+                for spec in (False, True):
+                    for budget in (False, True):
+                        srv = _mk_server(models, paged, mode, spec,
+                                         budget)
+                        servers.append(srv)
+                        toks = _drain(srv, mode)
+                        label = (paged, mode, spec, budget)
+                        if ref is None:
+                            ref = toks
+                        assert toks == ref, label
+                        expected |= _expected_keys(ck, dk, paged, mode,
+                                                   spec, budget)
+                        got = set(engine.ENGINE._steps.keys())
+                        assert got == expected, label
+    finally:
+        # close() purges by cfg — one close drops every scenario's keys
+        for srv in servers:
+            srv.close()
+    assert set(engine.ENGINE._steps.keys()) == set()
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_exact_legacy_keyset_fresh_server(models, paged):
+    """A fresh server writes EXACTLY the legacy literals — asserted
+    against fully hand-written sets (no helper) for the two base
+    layouts, and close() purges them back to nothing."""
+    cfg, params, dcfg, dparams = models
+    ck = engine.cfg_key(cfg)
+    engine.ENGINE._steps.clear()
+    srv = _mk_server(models, paged, "tick", False, False)
+    _drain(srv, "tick")
+    if paged:
+        want = {("paged_prefill", ck, 8, None),
+                ("paged_prefill", ck, 16, None),
+                ("step", ck, True, None)}
+    else:
+        want = {("prefill", ck, 4, None), ("prefill", ck, 16, None),
+                ("step", ck, False, None)}
+    assert set(engine.ENGINE._steps.keys()) == want
+    srv.close()
+    assert set(engine.ENGINE._steps.keys()) == set()
+
+
+def test_warmup_then_serve_adds_zero_executables(models):
+    """warmup() (now an Engine method DecodeServer delegates to)
+    pre-builds every executable the serve needs: serving afterwards
+    adds no step-cache key and no compile-log entry."""
+    engine.ENGINE._steps.clear()
+    tl.reset()
+    srv = _mk_server(models, False, "tick", False, False)
+    srv.warmup(prompt_lens=[3, 12], sample=True)
+    keys0 = set(engine.ENGINE._steps.keys())
+    compiles0 = len(tl.snapshot()["compiles"])
+    assert keys0, "warmup built nothing"
+
+    rids = [srv.submit(list(p), max_new_tokens=4) for p in _PROMPTS]
+    rids.append(srv.submit([3, 2, 4], max_new_tokens=4,
+                           temperature=0.7))
+    ticks = 0
+    while srv.pending():
+        srv.tick()
+        ticks += 1
+        assert ticks < 300
+    assert all(len(srv.result(r)) == 4 for r in rids)
+    assert set(engine.ENGINE._steps.keys()) == keys0
+    if tl.enabled():
+        assert len(tl.snapshot()["compiles"]) == compiles0
+    srv.close()
+
+
+def test_recompile_watch_names_every_build_exactly_once(models):
+    """Every Engine build flows through instrument_compile exactly
+    once: the compile log carries one entry per step-cache key (keys
+    render via repr, as the watch records them), no duplicates."""
+    if not tl.enabled():
+        pytest.skip("PADDLE_TPU_TELEMETRY=0")
+    engine.ENGINE._steps.clear()
+    tl.reset()
+    srv = _mk_server(models, False, "tick", False, False)
+    _drain(srv, "tick")
+    entries = tl.snapshot()["compiles"]
+    pairs = [(c["name"], c["key"]) for c in entries]
+    assert len(pairs) == len(set(pairs)), "duplicate compile records"
+    logged = [c["key"] for c in entries]
+    for k in engine.ENGINE._steps.keys():
+        assert logged.count(repr(k)) == 1, k
+    # ... and nothing compiled outside the Engine's cache
+    assert len(entries) == len(engine.ENGINE._steps)
+    srv.close()
+
+
+# ---------------------------------------------------------------------------
+# round-15 unlocks: speculation and adapter pools under mesh= TP
+# ---------------------------------------------------------------------------
+
+
+def _mesh2():
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices (conftest forces 8 CPU devices)")
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:2]), ("mp",))
+
+
+def test_spec_tp_greedy_parity_cpu_mesh(models):
+    """THE tentpole unlock: speculative decoding on a mesh= TP server —
+    verify@K and the draft twin both sharded through registry-built
+    executables — greedy bit-parity vs the single-chip spec server."""
+    cfg, params, dcfg, dparams = models
+    mesh = _mesh2()
+    engine.ENGINE._steps.clear()
+    one = _mk_server(models, False, "tick", True, False)
+    want = _drain(one, "tick")
+    one.close()
+
+    keys_before = set(engine.ENGINE._steps.keys())
+    srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=40,
+                               mesh=mesh, draft_cfg=dcfg,
+                               draft_params=dparams, spec_k=2)
+    got = _drain(srv, "tick")
+    assert got == want
+    # built purely through the registry: the sharded executables carry
+    # the mesh fingerprint in the legacy key slot, same kinds/shapes as
+    # the single-chip run (shard fragment aside)
+    new = set(engine.ENGINE._steps.keys()) - keys_before
+    assert new and all(k[-1] == srv._shard.key for k in new)
+    assert ({k[:-1] + (None,) for k in new}
+            == _expected_keys(engine.cfg_key(cfg), engine.cfg_key(dcfg),
+                              False, "tick", True, False))
+    srv.close()
+
+
+def _rand_adapter(params, cfg, key, rank=4, scale=0.5):
+    ad = lora.split_lora(lora.lora_init(params, cfg, rank=rank,
+                                        key=key))[1]
+    out = {}
+    for name, v in ad.items():
+        if name.endswith("_lora_b"):
+            key, sub = jax.random.split(key)
+            out[name] = scale * jax.random.normal(sub, v.shape,
+                                                  np.float32)
+        else:
+            out[name] = v
+    return out
+
+
+def test_adapter_pool_tp_parity_cpu_mesh(models):
+    """Satellite unlock: a stacked AdapterPool under mesh= TP (leading
+    stack axis replicated, base Megatron spec per leaf) — base and
+    adapter requests bit-equal to the single-chip pool server, and the
+    adapter provably changes tokens."""
+    cfg, params, dcfg, dparams = models
+    mesh = _mesh2()
+
+    def run(mesh_arg):
+        pool = A.AdapterPool(params, cfg, rank=4, max_adapters=2)
+        pool.register("tilt", _rand_adapter(params, cfg,
+                                            jax.random.PRNGKey(7)))
+        srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=40,
+                                   adapter_pool=pool, mesh=mesh_arg)
+        r0 = srv.submit([2, 3, 4], max_new_tokens=5)
+        r1 = srv.submit([2, 3, 4], max_new_tokens=5, adapter="tilt")
+        ticks = 0
+        while srv.pending():
+            srv.tick()
+            ticks += 1
+            assert ticks < 300
+        out = (srv.result(r0), srv.result(r1))
+        srv.close()
+        return out
+
+    single = run(None)
+    assert single[0] != single[1], "adapter did not change tokens"
+    assert run(mesh) == single
+
+
+def test_stacked_pool_specs_replicate_stack_axis(models):
+    """The pool's TP shardings derive from the base leaf's Megatron
+    spec with the stack axis replicated: a column-parallel target gets
+    a replicated ``a`` and an out-sharded ``b``."""
+    cfg, params, dcfg, dparams = models
+    from jax.sharding import PartitionSpec as P
+
+    pool = A.AdapterPool(params, cfg, rank=4, max_adapters=2)
+    specs = A.stacked_pool_specs(pool, mp="mp")
+    base = gpt.param_shardings(cfg, mp="mp")["blocks"]
+    for t in pool.targets:
+        dims = tuple(base[t])
+        assert specs[t + "_lora_a"] == P(None, *dims[:-1], None)
+        assert specs[t + "_lora_b"] == P(None, *dims[:-2], None,
+                                         dims[-1])
+    # the attention projections cover both parallel styles
+    assert tuple(base["qkv_w"])[-1] == "mp"      # column-parallel
+    assert tuple(base["proj_w"])[-2] == "mp"     # row-parallel
+
+
+# ---------------------------------------------------------------------------
+# close()/purge: both cfg families, both domains, one pass
+# ---------------------------------------------------------------------------
+
+
+def test_close_purges_draft_twin_adapter_and_gen_families(models):
+    cfg, params, dcfg, dparams = models
+    ck, dk = engine.cfg_key(cfg), engine.cfg_key(dcfg)
+
+    def alive(c):
+        return [k for cache in (engine.ENGINE._steps, engine.ENGINE._gen)
+                for k in cache.keys()
+                if k == c or (isinstance(k, tuple) and c in k)]
+
+    # spec server: target + draft-twin executables drop on one close
+    srv = _mk_server(models, False, "tick", True, False)
+    _drain(srv, "tick")
+    assert alive(ck) and alive(dk)
+    srv.close()
+    assert alive(ck) == [] and alive(dk) == []
+
+    # pool server + an offline generate-domain compile for the SAME
+    # cfg: close purges the adapter family AND the _gen entry
+    pool = A.AdapterPool(params, cfg, rank=4, max_adapters=2)
+    pool.register("tilt", _rand_adapter(params, cfg,
+                                        jax.random.PRNGKey(7)))
+    srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=40,
+                               adapter_pool=pool)
+    r = srv.submit([2, 3], max_new_tokens=3, adapter="tilt")
+    while srv.pending():
+        srv.tick()
+    assert len(srv.result(r)) == 3
+    evaluate._eval_fn(cfg)                      # ("eval_nll", ck) in _gen
+    assert any(k[0] == "eval_nll" for k in alive(ck))
+    srv.close()
+    assert alive(ck) == []
+
+
+def test_registry_is_the_key_authority(models):
+    """StepSpec.key/.name ARE the cache-key and watch-name authority:
+    the legacy literals come out of the registry, and every family the
+    purge must cover is registered."""
+    cfg, params, dcfg, dparams = models
+    ck = engine.cfg_key(cfg)
+    spec = engine.StepSpec(cfg=cfg)
+    assert spec.key("step") == ("step", ck, False, None)
+    assert spec.name("step") == "serving.step"
+    bspec = engine.StepSpec(cfg=cfg, paged=True, k=4)
+    assert bspec.key("block") == ("block", ck, 4, True, None)
+    assert bspec.name("block") == "serving.block@4"
+    ks = engine.kinds()
+    for fam in ("step", "sample", "block", "async", "prefill",
+                "prefill_chunk", "paged_prefill", "spec_verify",
+                "adapter_step", "adapter_prefill", "generate",
+                "sharded_decode"):
+        assert fam in ks, fam
+
+
+# ---------------------------------------------------------------------------
+# ENGINE lint family (tools/check_instrumented.py)
+# ---------------------------------------------------------------------------
+
+
+class TestEngineLint:
+    def setup_method(self):
+        self.tool = _tool("check_instrumented")
+
+    def test_jax_jit_outside_engine_flagged(self):
+        bad = ("import jax\n"
+               "def getter(cfg):\n"
+               "    return jax.jit(lambda x: x)\n")
+        vs = self.tool.scan_engine_outside_source(bad, "serving.py")
+        assert len(vs) == 1 and "jax.jit" in vs[0][2]
+
+    def test_step_cache_write_outside_engine_flagged(self):
+        bad = "_STEP_CACHE[key] = fn\n"
+        vs = self.tool.scan_engine_outside_source(bad, "serving.py")
+        assert len(vs) == 1 and "_STEP_CACHE" in vs[0][2]
+
+    def test_engine_routed_module_passes(self):
+        good = ("from . import engine as _engine\n"
+                "def getter(cfg, spec):\n"
+                "    fn = _engine.ENGINE.get('step', spec)\n"
+                "    cached = _engine.ENGINE._steps.get(('step',))\n"
+                "    return fn or cached\n")
+        assert self.tool.scan_engine_outside_source(good, "m.py") == []
+
+    def test_unrouted_jit_inside_engine_flagged(self):
+        bad = "import jax\nSTEP = jax.jit(lambda x: x)\n"
+        vs = self.tool.scan_engine_file_source(bad, "engine.py")
+        assert len(vs) == 1 and "register" in vs[0][2]
+
+    def test_registered_builder_and_wrapper_pass(self):
+        good = ("import jax\n"
+                "@register('step', key=None, name='n')\n"
+                "def _build(spec):\n"
+                "    return jax.jit(lambda x: x)\n"
+                "wrapped = _watch_jit('n', ('k',), jax.jit(abs))\n")
+        assert self.tool.scan_engine_file_source(good, "engine.py") == []
+
+    def test_uninstrumented_choke_point_flagged(self):
+        bad = ("class Engine:\n"
+               "    def get(self, kind, spec):\n"
+               "        return self._steps.get(kind)\n")
+        vs = self.tool.scan_engine_file_source(bad, "engine.py")
+        assert len(vs) == 1 and "Engine.get" in vs[0][2]
+
+    def test_repo_is_clean(self):
+        assert self.tool.scan_repo(REPO) == []

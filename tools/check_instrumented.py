@@ -142,6 +142,21 @@ ADAPTER_FILES = (
 )
 ADAPTER_MARKERS = ("gather_adapter", "apply_constraint", "mask_logits")
 
+# ENGINE lint (round 15, the step-compilation subsystem): text/engine.py
+# is the SINGLE authority for building and caching jitted step
+# executables.  Two rules enforce it: (a) any ``jax.jit`` reference OR
+# subscript write to a ``*_CACHE``-named object in ``text/*.py`` outside
+# ``engine.py`` fails — a stray jit site compiles in the recompile
+# watch's blind spot and a stray cache write leaks past Engine.purge;
+# (b) inside ``engine.py`` every ``jax.jit`` must sit in a
+# ``@register(...)``-decorated builder (whose product Engine.get hands
+# to the watch) or in the argument list of the instrumentation wrapper,
+# and the ``Engine.get``/``Engine.jit`` choke points themselves must
+# call the wrapper — so every registry build routes through
+# ``instrument_compile`` by construction.
+ENGINE_DIR = os.path.join("paddle_tpu", "text")
+ENGINE_FILE = os.path.join("paddle_tpu", "text", "engine.py")
+
 
 def _call_name(node: ast.Call):
     f = node.func
@@ -379,6 +394,105 @@ def scan_adapter_source(src: str, filename: str = "<src>") -> list:
     return violations
 
 
+def scan_engine_outside_source(src: str, filename: str = "<src>") -> list:
+    """ENGINE lint rule (a), for a ``text/*.py`` module that is NOT
+    engine.py: any ``jax.jit`` attribute reference fails (compilation
+    belongs to the Engine's registry/``jit`` choke points), and any
+    subscript WRITE to a ``*_CACHE``-named object fails (the Engine owns
+    its executable caches; a side-door write is an entry ``purge`` can
+    never see retired)."""
+    tree = ast.parse(src, filename=filename)
+    violations = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Attribute) and node.attr == "jit"
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "jax"):
+            violations.append(
+                (filename, node.lineno,
+                 "jax.jit outside text/engine.py — route the build "
+                 "through engine.ENGINE.get (a registry kind) or "
+                 "engine.ENGINE.jit (the generic choke point)"))
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for tgt in targets:
+            if (isinstance(tgt, ast.Subscript)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id.endswith("_CACHE")):
+                violations.append(
+                    (filename, tgt.lineno,
+                     f"step-cache write {tgt.value.id}[...] outside "
+                     f"text/engine.py — the Engine owns its caches "
+                     f"(Engine.get stores; Engine.purge retires)"))
+    return violations
+
+
+def scan_engine_file_source(src: str, filename: str = "<src>") -> list:
+    """ENGINE lint rule (b), for engine.py itself: every ``jax.jit``
+    must sit inside a ``@register(...)``-decorated builder (Engine.get
+    instruments its product) or in the argument list of the
+    instrumentation wrapper, and the ``Engine.get``/``Engine.jit``
+    choke points must themselves call the wrapper — together these
+    guarantee every registry build routes through
+    ``instrument_compile``."""
+    tree = ast.parse(src, filename=filename)
+    parents: dict = {}
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            parents[child] = parent
+    registered = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if (isinstance(dec, ast.Call)
+                        and _call_name(dec) == "register") \
+                        or (isinstance(dec, ast.Name)
+                            and dec.id == "register"):
+                    registered.add(node)
+    violations = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Attribute) and node.attr == "jit"
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "jax"):
+            continue
+        cur, routed = node, False
+        while cur in parents:
+            cur = parents[cur]
+            if isinstance(cur, ast.Call) \
+                    and _call_name(cur) in WRAPPER_NAMES:
+                routed = True
+                break
+            if cur in registered:
+                routed = True
+                break
+        if not routed:
+            violations.append(
+                (filename, node.lineno,
+                 "jax.jit in engine.py outside a @register(...) builder "
+                 "or the instrumentation wrapper — Engine.get can never "
+                 "hand this executable to the recompile watch"))
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.ClassDef) and node.name == "Engine"):
+            continue
+        for fn in node.body:
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and fn.name in ("get", "jit"):
+                routed = any(
+                    isinstance(n, ast.Call)
+                    and _call_name(n) in WRAPPER_NAMES
+                    for n in ast.walk(fn))
+                if not routed:
+                    violations.append(
+                        (filename, fn.lineno,
+                         f"Engine.{fn.name}() never calls "
+                         f"instrument_compile/_watch_jit — every build "
+                         f"through this choke point compiles in the "
+                         f"recompile watch's blind spot"))
+    return violations
+
+
 def _walk_py(path: str) -> list:
     out = []
     for dirpath, _, names in sorted(os.walk(path)):
@@ -456,6 +570,20 @@ def scan_repo(root: str | None = None) -> list:
             with open(ad_path, encoding="utf-8") as f:
                 violations.extend(scan_adapter_source(
                     f.read(), os.path.relpath(ad_path, root)))
+    # ENGINE lint: the Engine is the single compilation/caching authority
+    eng_dir = os.path.join(root, ENGINE_DIR)
+    eng_file = os.path.join(root, ENGINE_FILE)
+    if os.path.isdir(eng_dir):
+        for path in _walk_py(eng_dir):
+            if os.path.abspath(path) == os.path.abspath(eng_file):
+                continue
+            with open(path, encoding="utf-8") as f:
+                violations.extend(scan_engine_outside_source(
+                    f.read(), os.path.relpath(path, root)))
+    if os.path.exists(eng_file):
+        with open(eng_file, encoding="utf-8") as f:
+            violations.extend(scan_engine_file_source(
+                f.read(), os.path.relpath(eng_file, root)))
     return violations
 
 
