@@ -1767,6 +1767,145 @@ def _multilora_smoke():
             "constrained_json": text}
 
 
+def _prefix_smoke():
+    """Fleet-scale prefix-cache round, run by ``--config gpt --small``
+    (CI): on a shared preamble that diverges MID-BLOCK, token-granular
+    radix matching must register a strictly higher prefix hit rate than
+    the whole-block baseline (``PADDLE_TPU_KV_RADIX=0``) with greedy
+    tokens bit-identical across both arms and the contiguous slab; a
+    spill->restore cycle (cold chains demoted to host RAM, re-admitted
+    through the existing inject executables) must stay greedy
+    bit-identical while saving >= 90% of the re-prefill rows; and the
+    second spill->restore cycle must add zero new executables."""
+    import numpy as np
+    import jax
+
+    from paddle_tpu.text import gpt, serving
+
+    cfg = gpt.GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                        num_heads=4, max_seq_len=64)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(11)
+    # 20-token preamble over 8-token blocks: the divergence point (20)
+    # sits mid-block, so whole-block matching can only share 16 tokens
+    # while the radix split shares all 20
+    pre = [int(x) for x in rng.integers(1, 100, 20)]
+    prompts = [pre + [int(x) for x in rng.integers(1, 100, 4)]
+               for _ in range(3)]
+
+    env_keys = ("PADDLE_TPU_KV_RADIX", "PADDLE_TPU_KV_SPILL_MB")
+    env0 = {k: os.environ.get(k) for k in env_keys}
+
+    def _set(**env):
+        for k, v in env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    def serve(layout, radix):
+        _set(PADDLE_TPU_KV_RADIX=radix, PADDLE_TPU_KV_SPILL_MB=None)
+        srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=40,
+                                   layout=layout, block_size=8)
+        toks = []
+        for p in prompts:           # sequential: later prompts adopt
+            rid = srv.submit(p, max_new_tokens=6)
+            while srv.pending():
+                srv.tick()
+            toks.append(srv.result(rid))
+        stats = srv._pool.stats() if srv._pool is not None else None
+        srv.close()
+        return toks, stats
+
+    try:
+        cont, _ = serve("contiguous", "1")
+        tok_radix, s_radix = serve("paged", "1")
+        tok_block, s_block = serve("paged", "0")
+        if tok_radix != cont or tok_block != cont:
+            raise AssertionError(
+                f"prefix smoke: paged arms diverged from the contiguous "
+                f"slab (radix {tok_radix} / block {tok_block} vs {cont})")
+
+        def rate(s):
+            return s["prefix_hits"] / max(
+                1, s["prefix_hits"] + s["prefix_misses"])
+
+        if s_radix["radix_splits"] < 1:
+            raise AssertionError(
+                f"prefix smoke: the mid-block divergence never split a "
+                f"radix node ({s_radix})")
+        if rate(s_radix) <= rate(s_block):
+            raise AssertionError(
+                f"prefix smoke: token-granular hit rate "
+                f"{rate(s_radix):.3f} does not beat the whole-block "
+                f"baseline {rate(s_block):.3f}")
+
+        # spill->restore: serve, demote the whole cold chain to host
+        # RAM, re-serve — bit-identical tokens, >= 90% of re-prefill
+        # rows adopted from restored blocks instead of recomputed
+        _set(PADDLE_TPU_KV_RADIX="1", PADDLE_TPU_KV_SPILL_MB="4")
+        srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=40,
+                                   layout="paged", block_size=8)
+        pool = srv._pool
+        spill_prompt = prompts[0]            # 3 full blocks, aligned
+
+        def cycle():
+            rid = srv.submit(spill_prompt, max_new_tokens=6)
+            while srv.pending():
+                srv.tick()
+            first = srv.result(rid)
+            for _ in range(16):
+                if not pool._interned:
+                    break
+                srv._evict_or_spill(8)
+            hits0 = pool.prefix_hits
+            rid = srv.submit(spill_prompt, max_new_tokens=6)
+            while srv.pending():
+                srv.tick()
+            return first, srv.result(rid), pool.prefix_hits - hits0
+
+        first, again, saved = cycle()
+        if first != cont[0]:
+            raise AssertionError(
+                f"prefix smoke: spill-arm serve diverged from the "
+                f"contiguous slab ({first} vs {cont[0]})")
+        s = pool.stats()
+        if s["spilled_blocks"] < 1 or s["restored_blocks"] < 1:
+            raise AssertionError(
+                f"prefix smoke: spill->restore cycle never moved a "
+                f"block through host RAM ({s})")
+        if again != first:
+            raise AssertionError(
+                f"prefix smoke: tokens diverged after a spill->restore "
+                f"cycle ({again} vs {first})")
+        need = 0.9 * (len(spill_prompt) - 1)
+        if saved < need:
+            raise AssertionError(
+                f"prefix smoke: restore saved only {saved} re-prefill "
+                f"rows (< {need:.0f} of {len(spill_prompt) - 1})")
+        keys0 = set(serving._STEP_CACHE.keys())
+        first2, again2, _ = cycle()          # post-warmup pass
+        if again2 != first or first2 != first:
+            raise AssertionError(
+                f"prefix smoke: second spill->restore cycle diverged "
+                f"({first2}/{again2} vs {first})")
+        added = set(serving._STEP_CACHE.keys()) - keys0
+        if added:
+            raise AssertionError(
+                f"prefix smoke: post-warmup spill->restore retraced — "
+                f"new executables {sorted(added)}")
+        hit_rate = rate(pool.stats())
+        srv.close()
+    finally:
+        _set(**env0)
+    return {"ok": True, "radix_hit_rate": round(rate(s_radix), 3),
+            "block_hit_rate": round(rate(s_block), 3),
+            "radix_splits": s_radix["radix_splits"],
+            "spilled_blocks": s["spilled_blocks"],
+            "restored_blocks": s["restored_blocks"],
+            "spill_cycle_hit_rate": round(hit_rate, 3)}
+
+
 def bench_gpt(small: bool):
     if small:
         rec = _run_gpt_rung(-1)
@@ -1796,6 +1935,11 @@ def bench_gpt(small: bool):
         # low-priority sheds + Overloaded, idle recovery to rung 0, and
         # zero mid-serving retraces asserted (see _overload_smoke)
         rec["overload_smoke"] = _overload_smoke()
+        # fleet-scale prefix cache rides the CI smoke: token-granular
+        # hit rate beats the whole-block baseline, spill->restore
+        # bit-parity with >=90% re-prefill rows saved, zero post-warmup
+        # retraces asserted (see _prefix_smoke)
+        rec["prefix_smoke"] = _prefix_smoke()
         # multi-tenant adapter serving rides the CI smoke: 2-adapter
         # batch parity vs merged-tree solo decode + a JSON-schema-
         # constrained request completing valid JSON + zero post-warmup
@@ -2965,6 +3109,232 @@ def bench_fleet(small: bool):
     return _stamp_provenance(rec, dev)
 
 
+def bench_prefix(small: bool):
+    """Fleet-scale prefix cache (round 16): a multi-tenant
+    shared-preamble workload — T tenants, each issuing R requests that
+    share a per-tenant preamble diverging MID-BLOCK — driven through a
+    2-replica fleet under three routing/matching policies, against the
+    same stream on one double-width server.
+
+    Arms (same schedule, fresh routers, warm pass first):
+
+    1. **affinity** — token-granular radix matching + prefix-aware
+       routing (the headline): a tenant's requests land where its KV
+       already lives, and admission recomputes only the unshared tail.
+    2. **block** — ``PADDLE_TPU_KV_RADIX=0``: whole-block matching,
+       affinity routing unchanged — isolates the token-granular win.
+    3. **no-affinity** — ``PADDLE_TPU_PREFIX_ROUTE=0``: radix matching
+       on, pure load-order routing — the load triple actively steers a
+       tenant AWAY from its warm replica (its resident chains raise
+       that replica's kv-utilization), so every crossing pays the full
+       preamble prefill again.
+
+    TTFT is measured over the steady-state phase only (requests 2..R
+    per tenant; the unavoidable first-touch prefills run before the
+    telemetry reset), from the serving ``ttft_ms`` histogram.  The
+    paged admission executable is ``pow2(n - shared)`` wide, so prefix
+    adoption shrinks the admission compute itself — which is what the
+    TTFT spread measures.  Asserted: greedy tokens bit-identical across
+    every arm and the single server; token-granular hit rate strictly
+    above the whole-block baseline; affinity steady-state TTFT p99 <=
+    no-affinity p99 x BENCH_PREFIX_TOL (default 1.0 — strictly no
+    worse, and in practice several x better); ``fleet.prefix_routed``
+    > 0; zero post-warmup retraces per fleet arm."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu import telemetry as _tl
+    from paddle_tpu.framework import monitor
+    from paddle_tpu.text import fleet, gpt, serving
+
+    dev = jax.devices()[0]
+    if small:
+        # hidden 256 x 4L: big enough that a cold 256-wide admission
+        # costs real wall time next to a warm 8-wide one — the TTFT
+        # spread IS the measurement, and a toy model hides it
+        cfg = gpt.GPTConfig(vocab_size=512, hidden_size=256,
+                            num_layers=4, num_heads=8, max_seq_len=512)
+        # T=3 tenants over 2 replicas: the ODD split keeps a chain-sized
+        # kv-utilization gap between the replicas, so the load-order
+        # baseline is structurally steered onto cold replicas (an even
+        # tenant split can tie on utilization and accidentally mimic
+        # affinity, which would null the TTFT comparison)
+        T, R, p_pre, p_tail, new_toks = 3, 6, 460, 6, 8
+        blocks_fleet, blocks_single = 224, 256
+    else:
+        cfg = gpt.GPTConfig(vocab_size=50304, hidden_size=768,
+                            num_layers=12, num_heads=12,
+                            max_seq_len=2048)
+        T, R, p_pre, p_tail, new_toks = 3, 6, 1500, 20, 32
+        blocks_fleet, blocks_single = 640, 768
+    max_len = cfg.max_seq_len
+    rng = np.random.default_rng(5)
+    pres = [[int(x) for x in rng.integers(1, cfg.vocab_size, p_pre)]
+            for _ in range(T)]
+    reqs = [[pres[t] + [int(x) for x in
+                        rng.integers(1, cfg.vocab_size, p_tail)]
+             for _ in range(R)] for t in range(T)]
+    sched1 = [reqs[t][0] for t in range(T)]          # first touch
+    sched2 = [reqs[t][r] for r in range(1, R) for t in range(T)]
+    params = jax.device_get(gpt.init_params(cfg, jax.random.PRNGKey(0)))
+    params = jax.tree_util.tree_map(jnp.asarray, params)
+
+    env_keys = ("PADDLE_TPU_KV_RADIX", "PADDLE_TPU_PREFIX_ROUTE")
+    env0 = {k: os.environ.get(k) for k in env_keys}
+
+    def _set(**env):
+        for k, v in env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    def drive(obj, prompts_):
+        """Closed-loop (one request in flight, tenants interleaved):
+        TTFT then measures the admission prefill the routing policy
+        chose, not queue wait — open-loop arrival buries the ~10x
+        executable-width spread under identical queueing delay."""
+        rids = []
+        for p in prompts_:
+            rids.append(obj.submit(p, max_new_tokens=new_toks))
+            while obj.pending():
+                obj.tick()
+        return [obj.result(r) for r in rids]
+
+    def fleet_arm(radix, route):
+        _set(PADDLE_TPU_KV_RADIX=radix, PADDLE_TPU_PREFIX_ROUTE=route)
+
+        def mk():
+            return fleet.Router(
+                [serving.DecodeServer(params, cfg, max_batch=2,
+                                      max_len=max_len, layout="paged",
+                                      block_size=8,
+                                      num_blocks=blocks_fleet)
+                 for _ in range(2)])
+
+        def run(router):
+            toks = drive(router, sched1)
+            _tl.reset()              # steady-state phase only
+            t0 = time.perf_counter()
+            toks += drive(router, sched2)
+            wall = time.perf_counter() - t0
+            ttft = (_tl.latency_summary("serving.").get("ttft_ms", {})
+                    if _tl.enabled() else {})
+            routed = (int(monitor.get_stat("fleet.prefix_routed").get())
+                      if _tl.enabled() else 0)
+            pools = [r._pool.stats() for r in router.replicas]
+            return toks, ttft, routed, pools, wall
+
+        # the warm router stays OPEN through the measured pass:
+        # close() purges the Engine's executable caches by config, so
+        # closing it first would hand the measured pass cold compiles
+        warm = mk()
+        run(warm)
+        keys0 = set(serving._STEP_CACHE.keys())
+        meas = mk()
+        out = run(meas)
+        added = set(serving._STEP_CACHE.keys()) - keys0
+        warm.close()
+        meas.close()
+        if added:
+            raise AssertionError(
+                f"prefix bench: post-warmup pass retraced — new "
+                f"executables {sorted(added)}")
+        return out
+
+    def single_arm():
+        _set(PADDLE_TPU_KV_RADIX="1", PADDLE_TPU_PREFIX_ROUTE=None)
+
+        def mk():
+            return serving.DecodeServer(params, cfg, max_batch=4,
+                                        max_len=max_len, layout="paged",
+                                        block_size=8,
+                                        num_blocks=blocks_single)
+
+        def run(srv):
+            toks = drive(srv, sched1)
+            t0 = time.perf_counter()
+            toks += drive(srv, sched2)
+            wall = time.perf_counter() - t0
+            return toks, wall
+
+        warm = mk()
+        run(warm)                              # warm pass (compiles)
+        meas = mk()
+        out = run(meas)
+        warm.close()
+        meas.close()
+        return out
+
+    def rate(pools):
+        h = sum(p["prefix_hits"] for p in pools)
+        m = sum(p["prefix_misses"] for p in pools)
+        return h / max(1, h + m)
+
+    try:
+        toks_aff, ttft_aff, routed, pools_aff, wall_aff = \
+            fleet_arm("1", "1")
+        toks_blk, _, _, pools_blk, _ = fleet_arm("0", "1")
+        toks_noaf, ttft_noaf, _, _, wall_noaf = fleet_arm("1", "0")
+        toks_single, wall_single = single_arm()
+    finally:
+        _set(**env0)
+    for name, toks in (("affinity", toks_aff), ("block", toks_blk),
+                       ("no-affinity", toks_noaf)):
+        if toks != toks_single:
+            raise AssertionError(
+                f"prefix bench: {name} fleet tokens diverged from the "
+                f"single server on the same stream")
+    if rate(pools_aff) <= rate(pools_blk):
+        raise AssertionError(
+            f"prefix bench: token-granular hit rate "
+            f"{rate(pools_aff):.4f} does not beat the whole-block "
+            f"baseline {rate(pools_blk):.4f}")
+    if _tl.enabled():
+        if routed < 1:
+            raise AssertionError(
+                "prefix bench: prefix affinity never decided a "
+                "dispatch (fleet.prefix_routed == 0)")
+        tol = float(os.environ.get("BENCH_PREFIX_TOL", "1.0"))
+        if ttft_aff and ttft_noaf \
+                and ttft_aff["p99"] > ttft_noaf["p99"] * tol:
+            raise AssertionError(
+                f"prefix bench: steady-state TTFT p99 with prefix "
+                f"routing ({ttft_aff['p99']:.1f}ms) exceeds {tol}x the "
+                f"load-order baseline ({ttft_noaf['p99']:.1f}ms) — "
+                f"affinity is not landing tenants on their warm "
+                f"replica")
+    rows_saved = sum(p["prefix_hits"] for p in pools_aff)
+    total_toks = sum(len(t) for t in toks_aff[T:])   # steady phase
+    rec = {"metric": "prefix_cache_ttft_p99_ms", "unit": "ms",
+           "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
+               timespec="seconds"),
+           "device": dev.platform,
+           "device_kind": str(getattr(dev, "device_kind", "")),
+           "tenants": T, "requests_per_tenant": R,
+           "preamble_len": p_pre, "tail_len": p_tail,
+           "new_tokens": new_toks, "replicas": 2,
+           "value": round(ttft_aff.get("p99", 0.0), 2),
+           "ttft_p50_ms": round(ttft_aff.get("p50", 0.0), 2),
+           "ttft_p99_noaffinity_ms": round(ttft_noaf.get("p99", 0.0),
+                                           2),
+           "ttft_p50_noaffinity_ms": round(ttft_noaf.get("p50", 0.0),
+                                           2),
+           "prefix_hit_rate": round(rate(pools_aff), 4),
+           "prefix_hit_rate_block": round(rate(pools_blk), 4),
+           "recompute_rows_saved": rows_saved,
+           "radix_splits": sum(p["radix_splits"] for p in pools_aff),
+           "prefix_routed": routed,
+           "steady_tok_s": round(total_toks / max(wall_aff, 1e-9), 2),
+           "steady_tok_s_noaffinity": round(
+               total_toks / max(wall_noaf, 1e-9), 2),
+           "single_server_tok_s": round(
+               total_toks / max(wall_single, 1e-9), 2),
+           "vs_baseline": 0.0}
+    return _stamp_provenance(rec, dev)
+
+
 def bench_mixed(small: bool):
     """Stall-free continuous batching (round 12): the SAME single-server
     mixed long-prompt/short-prompt stream driven through monolithic
@@ -3640,7 +4010,7 @@ _CONFIGS = {"gpt": bench_gpt, "train": bench_train, "mnist": bench_mnist,
             "serving": bench_serving, "paged": bench_paged,
             "fleet": bench_fleet, "spec": bench_spec,
             "mixed": bench_mixed, "overload": bench_overload,
-            "multilora": bench_multilora}
+            "multilora": bench_multilora, "prefix": bench_prefix}
 
 
 def main():

@@ -327,6 +327,60 @@ def kv_block_size() -> int:
     return bs
 
 
+def kv_radix() -> bool:
+    """Token-granular radix matching in the paged prefix index (ON by
+    default).  When on, a prompt sharing only PART of an indexed block's
+    tokens splits that node (the new parent shares the physical block
+    under an extra refcount; the adopter's first write copies it through
+    the normal COW drain) so admission adopts the longest *token*
+    prefix.  ``PADDLE_TPU_KV_RADIX=0`` restores the whole-block
+    matching — the A/B baseline ``bench.py --config prefix`` measures
+    against.  Host-side index bookkeeping only — adoption depth changes
+    which rows prefill recomputes, never the compiled programs, so this
+    is NOT part of any jit-cache key."""
+    v = os.environ.get("PADDLE_TPU_KV_RADIX", "1").strip().lower()
+    return v not in ("0", "false", "off", "no")
+
+
+def kv_spill_mb() -> int:
+    """Host-RAM spill tier capacity in MiB for cold prefix-cache blocks
+    (``PADDLE_TPU_KV_SPILL_MB``, default 0 = spill off).  When set, the
+    OOM chain's evict-cold rung demotes cold block-aligned prefix chains
+    to host buffers (one batched ``device_get`` per eviction round)
+    instead of dropping them, and admission restores a spilled chain
+    with one batched ``device_put`` + table scatter instead of a
+    recompute walk.  Host scheduling only — NEVER a jit-cache key: the
+    restore scatter rides the existing ``inject_rows`` executable
+    buckets, so flipping spill on/off adds zero executable families."""
+    try:
+        return max(0, int(os.environ.get("PADDLE_TPU_KV_SPILL_MB", "0")))
+    except ValueError:
+        return 0
+
+
+def kv_spill_batch() -> int:
+    """Max prefix blocks demoted per spill round
+    (``PADDLE_TPU_KV_SPILL_BATCH``, default 8) — the batching factor of
+    the one ``device_get`` each evict-cold engagement pays.  Candidates
+    beyond the batch fall back to a plain drop.  Host scheduling only,
+    never a jit-cache key."""
+    try:
+        return max(1, int(os.environ.get("PADDLE_TPU_KV_SPILL_BATCH",
+                                         "8")))
+    except ValueError:
+        return 8
+
+
+def kv_restore() -> bool:
+    """Restore policy for spilled prefix chains (ON by default).
+    ``PADDLE_TPU_KV_RESTORE=0`` keeps the spill store write-only —
+    admission recomputes instead of promoting host rows back, which
+    turns the tier into a pure pressure-relief valve (a drill/debug
+    posture).  Host scheduling only, never a jit-cache key."""
+    v = os.environ.get("PADDLE_TPU_KV_RESTORE", "1").strip().lower()
+    return v not in ("0", "false", "off", "no")
+
+
 def fleet_prefill_threshold() -> int:
     """Prompt length (tokens) at which the fleet router hands admission
     prefill to a dedicated prefill worker instead of the decode
@@ -541,6 +595,33 @@ def fleet_tick_workers() -> int:
                                          "8")))
     except ValueError:
         return 8
+
+
+def prefix_route() -> bool:
+    """Prefix-aware fleet routing (ON by default).  When on, each
+    replica ships a compact prefix summary (root-fanout fingerprints +
+    resident-token counts) in ``load_stats()`` and the router scores
+    longest-expected-prefix overlap as a leading term beside its load
+    triple, so a tenant's traffic lands where its KV already lives.
+    ``PADDLE_TPU_PREFIX_ROUTE=0`` restores pure load-order routing.
+    Host scheduling only, never a jit-cache key."""
+    v = os.environ.get("PADDLE_TPU_PREFIX_ROUTE", "1").strip().lower()
+    return v not in ("0", "false", "off", "no")
+
+
+def prefix_route_imbalance() -> int:
+    """Load-imbalance cap on prefix affinity: a replica only earns
+    affinity credit while its queue depth is within this many requests
+    of the least-loaded candidate
+    (``PADDLE_TPU_PREFIX_ROUTE_IMBALANCE``, default 2).  The cap is what
+    keeps a hot tenant from starving a cold replica — past it the
+    router falls back to load order and the cold replica fills.  Host
+    scheduling only."""
+    try:
+        return max(0, int(os.environ.get(
+            "PADDLE_TPU_PREFIX_ROUTE_IMBALANCE", "2")))
+    except ValueError:
+        return 2
 
 
 def fleet_max_queue() -> int:

@@ -19,7 +19,20 @@ built on the seeds in :mod:`paddle_tpu.profiler` (host spans) and
    per-server ``serving.spec_accept_rate`` gauge; all auto-export to
    :func:`snapshot`/:func:`render_prometheus` like every registry stat,
    and ``tools/check_instrumented.py`` lints that every spec
-   accept/reject/fallback path counts or delegates.
+   accept/reject/fallback path counts or delegates.  The fleet-scale
+   prefix cache adds its own family: ``kv_pool.radix_splits`` (no-copy
+   radix node splits on partial-block prompt overlap),
+   ``kv_pool.spilled_blocks`` / ``kv_pool.restored_blocks`` /
+   ``kv_pool.restore_drains`` (host-RAM spill tier traffic),
+   ``kv_pool.prefix_evictions`` (cold-leaf drops, spilled or not), and
+   ``fleet.prefix_routed`` (dispatches where prefix affinity — not the
+   load triple — picked the replica); gauges
+   ``kv_pool.prefix_hit_rate`` (token-granular: adopted rows over
+   adoptable rows) and ``kv_pool.host_spill_bytes`` (resident host
+   bytes held by the spill tier) ride ``load_stats()`` and the
+   Prometheus export, and the same lint requires every
+   ``*split*``/``*spill*``/``*restore*``/``*prefix_route*`` path in
+   kv_pool/fleet to count or delegate.
 2. **Training step telemetry** — ``Model.fit`` / ``TrainStep`` emit
    step-time and throughput histograms, and the fit loop's host-sync
    count lands in the shared counter registry via the

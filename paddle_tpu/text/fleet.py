@@ -56,7 +56,7 @@ import jax.numpy as jnp
 
 from . import admission as _admission
 from . import engine as _engine
-from . import generate, gpt, serving
+from . import generate, gpt, kv_pool as _kv, serving
 from .. import flags as _flags
 from .. import resilience as _resilience
 from .. import telemetry as _telemetry
@@ -513,6 +513,12 @@ class Router:
         # no controller: greedy routing, bit-identical to before.
         self._adm = (_admission.AdmissionController(scope="fleet")
                      if _flags.admission_enabled() else None)
+        # prefix-aware routing (PADDLE_TPU_PREFIX_ROUTE): score each
+        # candidate's expected prefix overlap from the radix summary its
+        # load_stats ships, capped by a load-imbalance bound so affinity
+        # never starves a cold replica
+        self._prefix_route_on = _flags.prefix_route()
+        self._route_imbalance = _flags.prefix_route_imbalance()
 
     # -- submission ---------------------------------------------------------
 
@@ -703,11 +709,23 @@ class Router:
                 if self._tel:
                     _telemetry.count("fleet.ttl_sheds")
 
-    def _pick_replica(self, exclude=()):
-        """Least-loaded healthy replica with admission capacity (free
-        slots, or queue headroom under ``max_queue``) — ordered by
-        queue depth, then slot occupancy, then KV utilization: the
-        telemetry-gauge triple as a routing key.
+    def _snapshot_load(self) -> dict:
+        """ONE ``load_stats()`` read per healthy replica for the whole
+        scheduling round — ``_route`` used to re-read every replica per
+        QUEUED request, which multiplied the per-request host overhead
+        by queue depth (and would have multiplied the radix prefix
+        summaries on top).  ``_route`` keeps the snapshot honest between
+        dispatches by bumping the chosen replica's queue depth."""
+        return {i: r.load_stats() for i, r in enumerate(self.replicas)
+                if self._ok[i]}
+
+    def _pick_replica(self, exclude=(), stats=None, req=None):
+        """Best healthy replica with admission capacity (free slots, or
+        queue headroom under ``max_queue``): prefix-affinity overlap
+        leads (see :meth:`_prefix_route`), then queue depth, slot
+        occupancy and KV utilization — the telemetry-gauge triple as the
+        load key.  ``stats`` is the per-tick ``_snapshot_load``; absent
+        (direct callers), each replica is read live as before.
 
         ``load_stats()`` also reports multi-tenant shape —
         ``adapters_active`` (per-adapter occupied-slot counts, when the
@@ -715,35 +733,73 @@ class Router:
         and ``constrained_slots`` (slots decoding under a logits-mask
         constraint).  These are deliberately NOT in the score: adapter
         gathers and host-side masking cost the same tick either way, so
-        load alone routes correctly; the fields exist so operators (and
-        an affinity-aware router subclass) can see which replica serves
-        which tenant mix."""
-        best, best_score = None, None
+        affinity + load alone route correctly; the fields exist so
+        operators can see which replica serves which tenant mix."""
+        cands = []
         for i, r in enumerate(self.replicas):
             if not self._ok[i] or i in exclude:
                 continue
-            ls = r.load_stats()
+            ls = (stats.get(i) if stats is not None
+                  else r.load_stats())
+            if ls is None:
+                continue
             cap = ls["free_slots"] + max(
                 0, self._max_queue - ls["queue_depth"])
             if cap <= 0:
                 continue
-            # admitting_slots between depth and occupancy: a replica
-            # mid-(budgeted-)admission spends round budget on prefill
-            # chunks, so equal-depth ties prefer a replica with free
-            # admission headroom (all-zero when budgets are off —
-            # ordering unchanged)
-            score = (ls["queue_depth"], ls.get("admitting_slots", 0),
+            cands.append((i, ls))
+        return self._prefix_route(req, cands)
+
+    def _prefix_route(self, req, cands):
+        """Scoring half of replica selection: per candidate, the
+        expected prefix overlap (tokens) between the request's prompt
+        and the replica's resident radix tree — matched by root-fanout
+        fingerprint from ``load_stats()["prefix_summary"]`` — leads the
+        load triple, so a tenant's traffic lands where its KV already
+        lives.  Affinity credit is CAPPED: a candidate further than
+        ``PADDLE_TPU_PREFIX_ROUTE_IMBALANCE`` queued requests above the
+        least-loaded candidate scores zero overlap, so a hot tenant
+        never starves a cold replica.  Counts ``fleet.prefix_routed``
+        when affinity actually decided a dispatch.
+
+        The ``admitting_slots`` term between depth and occupancy:
+        a replica mid-(budgeted-)admission spends round budget on
+        prefill chunks, so equal-depth ties prefer a replica with free
+        admission headroom (all-zero when budgets are off — ordering
+        unchanged)."""
+        if not cands:
+            return None
+        prompt = (req or {}).get("prompt")
+        min_q = min(ls["queue_depth"] for _, ls in cands)
+        best, best_score = None, None
+        for i, ls in cands:
+            ov = 0
+            if (self._prefix_route_on and prompt
+                    and ls["queue_depth"] - min_q
+                    <= self._route_imbalance):
+                for run_len, fp, resident in \
+                        ls.get("prefix_summary") or ():
+                    if (len(prompt) >= run_len and fp
+                            == _kv.prefix_fingerprint(
+                                prompt[:run_len])):
+                        ov = max(ov, min(resident, len(prompt)))
+            score = (-ov, ls["queue_depth"],
+                     ls.get("admitting_slots", 0),
                      ls["slot_occupancy"], ls["kv_utilization"], i)
             if best_score is None or score < best_score:
                 best, best_score = i, score
+        if best is not None and best_score[0] < 0 and self._tel:
+            _telemetry.count("fleet.prefix_routed")
         return best
 
-    def _route(self) -> None:
+    def _route(self, stats=None) -> None:
         """Dispatch queued work: priority first (ties: submit order),
-        each request to the least-loaded healthy replica; requests no
-        replica can take stay fleet-queued (re-routable)."""
+        each request to the best replica by prefix affinity + load;
+        requests no replica can take stay fleet-queued (re-routable)."""
         if not self._queue:
             return
+        if stats is None:
+            stats = self._snapshot_load()
         self._queue.sort(key=lambda rid: (
             -self._requests[rid]["req"]["priority"],
             self._requests[rid]["req"]["t_submit"]))
@@ -752,7 +808,8 @@ class Router:
             rec = self._requests[rid]
             rejected = {}
             while True:
-                i = self._pick_replica(exclude=rejected)
+                i = self._pick_replica(exclude=rejected, stats=stats,
+                                       req=rec["req"])
                 if i is None:
                     healthy = {j for j in range(len(self.replicas))
                                if self._ok[j]}
@@ -777,6 +834,15 @@ class Router:
                 rec["replica"] = i
                 rec["local_rid"] = local
                 self._local[(i, local)] = rid
+                if i in stats:
+                    # keep the snapshot honest for the REST of this
+                    # round: the adopted request consumes a free slot
+                    # if one was open, else sits on i's queue — the
+                    # mirror of the ``cap`` admission arithmetic above
+                    if stats[i]["free_slots"] > 0:
+                        stats[i]["free_slots"] -= 1
+                    else:
+                        stats[i]["queue_depth"] += 1
                 if self._tel:
                     _telemetry.count("fleet.routed")
                 break
@@ -848,8 +914,13 @@ class Router:
         self._poll_prefill()
         self._check_health()
         self._shed_expired()
-        self._absorb_backpressure()
-        self._route()
+        # ONE load_stats snapshot feeds this round's backpressure fold
+        # AND every routing decision (the per-queued-request re-read is
+        # gone); skipped when nothing needs it
+        stats = (self._snapshot_load()
+                 if self._queue or self._adm is not None else None)
+        self._absorb_backpressure(stats)
+        self._route(stats)
         pend = [r for r in self.replicas if r.pending()]
         if len(pend) <= 1 or self._tick_workers <= 1:
             for r in pend:
@@ -872,17 +943,19 @@ class Router:
         self._check_health()
         self._gauges()
 
-    def _absorb_backpressure(self) -> None:
+    def _absorb_backpressure(self, stats=None) -> None:
         """Fold the replicas' SLO verdicts into the front door: the
         router's controller adopts the WORST healthy replica's
         degradation rung (``load_stats()["admission_rung"]``), so when
         any replica degrades to the shed rung, new lowest-class
         submissions reject HERE — before queueing, before routing —
-        and recovery tracks the replicas' own ladders exactly."""
+        and recovery tracks the replicas' own ladders exactly.
+        ``stats`` is the tick's shared ``_snapshot_load``."""
         if self._adm is None:
             return
-        rungs = [r.load_stats().get("admission_rung", 0)
-                 for i, r in enumerate(self.replicas) if self._ok[i]]
+        if stats is None:
+            stats = self._snapshot_load()
+        rungs = [ls.get("admission_rung", 0) for ls in stats.values()]
         self._adm.absorb_fleet_rung(max(rungs) if rungs else 0)
 
     def pending(self) -> bool:

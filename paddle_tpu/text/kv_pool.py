@@ -19,10 +19,18 @@ RadixAttention:
   side) — blocks are allocated as a slot's ``pos`` crosses block
   boundaries instead of reserving ``max_len`` rows up front, and freed or
   dereferenced on retire;
-* **prefix-hash index** — requests sharing a prompt prefix map their
+* **radix prefix index** — requests sharing a prompt prefix map their
   leading table entries to the SAME physical blocks (exact token-chain
   keys, refcounted), so shared prefixes are prefilled once; the first
-  divergent write to a shared block copies it (copy-on-write).
+  divergent write to a shared block copies it (copy-on-write).  Matching
+  is token-granular: a prompt sharing only part of an indexed block's
+  tokens SPLITS that node (``PADDLE_TPU_KV_RADIX``) instead of missing,
+  so admission adopts the longest *token* prefix;
+* **host-RAM spill tier** — the evict-cold rung can demote cold prefix
+  chains to host buffers (one batched ``device_get`` per round,
+  ``PADDLE_TPU_KV_SPILL_MB``) and admission restores them with one
+  batched ``device_put`` through the existing :func:`inject_rows`
+  buckets instead of a recompute walk.
 
 Device math lives here too: :func:`paged_decode_step_batched` is the
 pooled twin of ``serving.decode_step_batched`` (einsum fallback =
@@ -34,6 +42,8 @@ T-block through the table inside the grid), and
 The contiguous layout stays the default (``PADDLE_TPU_KV_LAYOUT``).
 """
 from __future__ import annotations
+
+import zlib
 
 import jax
 import jax.numpy as jnp
@@ -486,23 +496,37 @@ def copy_blocks(cache: dict, src, dst) -> dict:
 
 
 # ---------------------------------------------------------------------------
-# host allocator: free list + refcounts + prefix index
+# host allocator: free list + refcounts + radix prefix index + spill tier
 # ---------------------------------------------------------------------------
 
 
+def prefix_fingerprint(tokens) -> int:
+    """Deterministic fingerprint of a token run for the router-side
+    prefix summaries (crc32 over the int64 bytes — Python's ``hash()``
+    is salted per process, so it can never be compared across a fleet's
+    replicas)."""
+    return zlib.crc32(np.asarray(tuple(tokens), np.int64).tobytes())
+
+
 class _PrefixEntry:
-    """One indexed prompt block: the physical pool block, its LRU clock,
-    and its position in the interned chain (``key`` = the intern-table
-    key, ``parent`` = the previous block's chain id, 0 at the root) —
-    enough to drop the entry and its intern record together."""
+    """One indexed radix node: the physical pool block, its LRU clock,
+    its position in the interned tree (``key`` = the intern-table key
+    ``(parent chain id, token run)``, ``parent`` = the previous node's
+    chain id, 0 at the root) and ``end`` — the cumulative token count of
+    the chain through this node.  A node's run never crosses a block
+    boundary, and its block holds bit-valid rows for in-block offsets
+    ``[0, end - 1 mod bs]`` — split siblings share a block precisely
+    because their common rows are identical."""
 
-    __slots__ = ("block", "last_hit", "key", "parent")
+    __slots__ = ("block", "last_hit", "key", "parent", "end")
 
-    def __init__(self, block: int, tick: int, key, parent: int):
+    def __init__(self, block: int, tick: int, key, parent: int,
+                 end: int):
         self.block = block
         self.last_hit = tick
         self.key = key
         self.parent = parent
+        self.end = end
 
 
 class PagedAllocator:
@@ -510,19 +534,31 @@ class PagedAllocator:
     per-block refcounts, the per-slot table mirror (pushed to the device
     leaf when dirty), pending COW copies, and the prefix index.
 
-    Prefix identity is an INTERNED parent-id chain (round 9, the ROADMAP
-    open item): block ``li``'s chain id is interned under
-    ``(parent_chain_id, tuple(block li's tokens))``, so looking up or
-    registering a whole prompt touches each token exactly once — O(n)
-    host memory and hashing per distinct prompt, where the old exact
-    full-prefix keys (``tuple(prompt[:(li+1)*bs])``) materialized
-    O(n²/bs).  The no-collision guarantee is unchanged: interning is an
-    exact dict on (parent id, block tokens), and by induction a chain id
-    corresponds to exactly one token chain — two different prefixes can
-    never alias onto one block's rows.  The index holds its own
-    reference on every registered block, so a retired request's prefix
-    blocks survive for the next request until :meth:`evict_cold` (the
-    OOM chain's first rung) or :meth:`close` releases them."""
+    Prefix identity is an INTERNED parent-id RADIX tree (round 9 built
+    the linear chain; this round generalizes it): a node's chain id is
+    interned under ``(parent_chain_id, token_run)`` where the run never
+    crosses a block boundary, and siblings under one parent always
+    diverge on their FIRST token (``_children`` maps parent ->
+    {first token -> child id}), so lookup walks O(n) tokens with O(1)
+    child steps.  A prompt sharing only part of a node's run SPLITS the
+    node (:meth:`_split_entry`): a new parent takes the shared tokens
+    and an extra refcount on the SAME physical block — the shared rows
+    are bit-identical by the chain invariant, so no device copy happens
+    at split time; the adopter's first divergent write copies the block
+    through the normal COW drain.  The no-collision guarantee is
+    unchanged: interning is an exact dict on (parent id, token run), and
+    by induction a chain id corresponds to exactly one token chain.
+
+    The index holds its own reference on every registered block (one
+    per node — split siblings stack refs on a shared block, mirrored in
+    ``_blk_ents``), so a retired request's prefix blocks survive for
+    the next request until :meth:`evict_cold` / :meth:`spill_cold` (the
+    OOM chain's first rung) or :meth:`close` releases them.  With
+    ``PADDLE_TPU_KV_SPILL_MB`` set, :meth:`spill_cold` demotes cold
+    block-aligned chains to host RAM instead of dropping them and
+    :meth:`adopt_prefix` restores them on the next match — the restore
+    rows ride :meth:`take_restores` to the caller's batched
+    ``inject_rows`` scatter."""
 
     def __init__(self, num_blocks: int, block_size: int, nmax: int,
                  max_batch: int):
@@ -537,19 +573,30 @@ class PagedAllocator:
         # deterministic layouts in tests
         self._free = list(range(self.N - 1, -1, -1))
         self._ref = np.zeros(self.N, np.int64)
+        self._blk_ents = np.zeros(self.N, np.int64)  # index entries per block
         self._prefix: dict = {}              # chain id -> _PrefixEntry
-        self._interned: dict = {}            # (parent id, tokens) -> chain id
-        self._children: dict = {}            # chain id -> interned child count
+        self._interned: dict = {}            # (parent id, run) -> chain id
+        self._children: dict = {}            # chain id -> {tok0 -> child id}
         self._next_chain = 1                 # 0 is the root sentinel
         self._pending_copies: list = []      # [(src, dst)] for copy_blocks
         self._tick = 0                       # LRU clock for the index
         self.dirty = True                    # tables need a device push
+        self.radix_on = _flags.kv_radix()
+        self.restore_on = _flags.kv_restore()
+        self.spill_limit_bytes = _flags.kv_spill_mb() << 20
+        self.spill_batch = _flags.kv_spill_batch()
+        self._spilled: dict = {}   # full chain tokens -> (host rows, nbytes)
+        self._pending_restores: list = []    # [(slot, start, rows, block)]
         # host mirrors of the telemetry counters (tests/bench read these
         # without the registry)
         self.prefix_hits = 0
         self.prefix_misses = 0
         self.cow_copies = 0
         self.peak_blocks_in_use = 0
+        self.radix_splits = 0
+        self.spilled_blocks = 0
+        self.restored_blocks = 0
+        self.host_spill_bytes = 0
 
     # -- pool accounting ----------------------------------------------------
 
@@ -585,6 +632,12 @@ class PagedAllocator:
             if self._pending_copies:
                 self._pending_copies = [p for p in self._pending_copies
                                         if p[1] != b]
+            if self._pending_restores:
+                # same rule for undrained restores: injecting into a
+                # REALLOCATED block would corrupt another request's rows
+                self._pending_restores = [r for r in
+                                          self._pending_restores
+                                          if r[3] != b]
             _telemetry.count("kv_pool.blocks_freed")
 
     def _cow_block(self, slot: int, li: int) -> int:
@@ -637,74 +690,154 @@ class PagedAllocator:
         out, self._pending_copies = self._pending_copies, []
         return out
 
-    # -- prefix index -------------------------------------------------------
-
-    def _chain_key(self, parent: int, prompt, li: int):
-        """Intern key of prompt block ``li`` under its parent chain:
-        O(block_size) tokens, never the whole prefix."""
-        return (parent, tuple(prompt[li * self.bs:(li + 1) * self.bs]))
+    # -- radix prefix index -------------------------------------------------
 
     def adopt_prefix(self, slot: int, prompt) -> int:
-        """Map the longest indexed block-chain prefix of ``prompt`` into
-        ``slot``'s table (incref per adopted block) and return the
+        """Map the longest indexed TOKEN prefix of ``prompt`` into
+        ``slot``'s table (one incref per mapped block) and return the
         shared row count, capped at ``len(prompt) - 1`` so admission
         always computes at least the last token's logits (a fully
         shared prompt COWs its final block on that one-row write).
 
-        The walk follows the interned chain (parent id + this block's
-        tokens per step) and stops at the first block the index does not
-        hold — O(n) total work over the prompt."""
+        The walk descends the radix tree one node per step (children
+        are keyed by first token, runs compared tokenwise — O(n) total
+        over the prompt).  A node matching only partially is SPLIT at
+        the divergence point (``PADDLE_TPU_KV_RADIX``) so the shared
+        head still adopts; a missing child may instead be RESTORED from
+        the host spill tier.  Hits/misses count in TOKEN rows: the
+        hit-rate gauge is the fraction of adoptable rows admission did
+        not have to recompute."""
         n = len(prompt)
         self._tick += 1
         matched = 0
         parent = 0
-        for li in range(n // self.bs):
-            cid = self._interned.get(self._chain_key(parent, prompt, li))
+        deepest = {}                 # block index -> deepest node's block
+        while matched < n:
+            cid = self._children.get(parent, {}).get(prompt[matched])
+            if cid is None and self.restore_on:
+                cid = self._restore_spilled(slot, parent, prompt, matched)
             if cid is None:
                 break
             ent = self._prefix[cid]
-            b = ent.block
+            run = ent.key[1]
+            lim = min(len(run), n - matched)
+            m = 0
+            while m < lim and run[m] == prompt[matched + m]:
+                m += 1
+            if m == len(run):
+                ent.last_hit = self._tick
+                matched += m
+                deepest[(ent.end - 1) // self.bs] = ent.block
+                parent = cid
+                continue
+            # partial match: split iff it buys adoptable rows
+            if self.radix_on and m and min(matched + m, n - 1) > matched:
+                scid = self._split_entry(cid, m)
+                sent = self._prefix[scid]
+                sent.last_hit = self._tick
+                matched += m
+                deepest[(sent.end - 1) // self.bs] = sent.block
+            break
+        for bi, b in deepest.items():
             self._ref[b] += 1
-            self.tables[slot, li] = b
-            ent.last_hit = self._tick
-            matched += 1
-            parent = cid
-        if matched:
+            self.tables[slot, bi] = b
+        if deepest:
             self.dirty = True
-            self.prefix_hits += matched
-            _telemetry.count("kv_pool.prefix_hits", matched)
-        missed = n // self.bs - matched
-        if missed:
+        shared = min(matched, n - 1)
+        if shared > 0:
+            self.prefix_hits += shared
+            _telemetry.count("kv_pool.prefix_hits", shared)
+        missed = (n - 1) - shared
+        if missed > 0:
             self.prefix_misses += missed
             _telemetry.count("kv_pool.prefix_misses", missed)
-        return min(matched * self.bs, n - 1)
+        return shared
 
     def register_prefix(self, slot: int, prompt) -> None:
         """Index ``slot``'s full prompt blocks for future sharing (the
-        index takes its own reference per newly registered block).  The
-        owner never rewrites a full prompt block — decode writes start
-        at ``len(prompt)`` — so registered blocks are immutable until
-        released.  Each block interns one (parent id, block tokens)
-        record — registration is O(n) over the prompt."""
+        index takes its own reference per node).  The owner never
+        rewrites a full prompt block — decode writes start at
+        ``len(prompt)`` — so registered blocks are immutable until
+        released; partial tail blocks are never registered.  The walk
+        descends existing nodes, splits at mid-run divergence (the new
+        sibling is backed by the slot's own block) and interns the
+        remainder one block-run per node — O(n) over the prompt."""
         self._tick += 1
+        n_full = (len(prompt) // self.bs) * self.bs
+        off = 0
         parent = 0
-        for li in range(len(prompt) // self.bs):
-            b = int(self.tables[slot, li])
+        while off < n_full:
+            b = int(self.tables[slot, off // self.bs])
             if b < 0:
                 break
-            key = self._chain_key(parent, prompt, li)
-            cid = self._interned.get(key)
+            stop = (off // self.bs + 1) * self.bs
+            run = tuple(prompt[off:stop])
+            cid = self._children.get(parent, {}).get(run[0])
             if cid is None:
+                key = (parent, run)
                 cid = self._next_chain
                 self._next_chain += 1
                 self._interned[key] = cid
                 self._prefix[cid] = _PrefixEntry(b, self._tick, key,
-                                                 parent)
-                if parent:
-                    self._children[parent] = \
-                        self._children.get(parent, 0) + 1
+                                                 parent, stop)
+                self._children.setdefault(parent, {})[run[0]] = cid
+                self._blk_ents[b] += 1
                 self._ref[b] += 1
-            parent = cid
+                parent = cid
+                off = stop
+                continue
+            ent = self._prefix[cid]
+            erun = ent.key[1]
+            # a node's run never crosses a block boundary, so erun fits
+            # inside run's remainder
+            m = 0
+            while m < len(erun) and erun[m] == run[m]:
+                m += 1
+            if m == len(erun):
+                ent.last_hit = self._tick
+                parent = cid
+                off += m
+                continue
+            if not (self.radix_on and m):
+                # block-granular baseline: a mid-run divergence is a
+                # stop (same-first-token siblings need the split)
+                break
+            parent = self._split_entry(cid, m)
+            self._prefix[parent].last_hit = self._tick
+            off += m
+
+    def _split_entry(self, cid: int, m: int) -> int:
+        """COW-split an indexed node at run offset ``m``: a new parent
+        node takes tokens ``[:m]`` and an extra refcount on the SAME
+        physical block (rows up to the split point are bit-identical by
+        the chain invariant), while the deep node keeps its chain id
+        with tokens ``[m:]`` — its descendants' parent pointers stay
+        valid, so a split never orphans children.  No device copy
+        happens here: the first writer adopting the split node sees the
+        stacked refcount and copies through the normal COW drain.
+        Returns the new parent's chain id."""
+        ent = self._prefix[cid]
+        run = ent.key[1]
+        parent = ent.parent
+        skey = (parent, run[:m])
+        scid = self._next_chain
+        self._next_chain += 1
+        self._interned[skey] = scid
+        self._prefix[scid] = _PrefixEntry(ent.block, self._tick, skey,
+                                          parent,
+                                          ent.end - (len(run) - m))
+        self._blk_ents[ent.block] += 1
+        self._ref[ent.block] += 1
+        # re-key the deep node under the split node (same cid)
+        del self._interned[ent.key]
+        ent.key = (scid, run[m:])
+        ent.parent = scid
+        self._interned[ent.key] = cid
+        self._children.setdefault(parent, {})[run[0]] = scid
+        self._children[scid] = {run[m]: cid}
+        self.radix_splits += 1
+        _telemetry.count("kv_pool.radix_splits")
+        return scid
 
     @property
     def prefix_entries(self) -> int:
@@ -712,49 +845,195 @@ class PagedAllocator:
 
     def _drop_entry(self, cid: int) -> None:
         """Remove one index entry plus its intern record (and its
-        parent's child count) — the single removal path eviction and
-        close share, keeping entry/intern/children consistent."""
+        parent's child-map slot) — the single removal path eviction,
+        spill and close share, keeping entry/intern/children
+        consistent."""
         ent = self._prefix.pop(cid)
         self._interned.pop(ent.key, None)
-        if ent.parent and ent.parent in self._children:
-            self._children[ent.parent] -= 1
-            if not self._children[ent.parent]:
+        pm = self._children.get(ent.parent)
+        if pm is not None:
+            tok0 = ent.key[1][0]
+            if pm.get(tok0) == cid:
+                del pm[tok0]
+            if not pm:
                 del self._children[ent.parent]
+        self._blk_ents[ent.block] -= 1
         self._decref_free(ent.block)
 
-    def evict_cold(self, max_entries: int | None = None) -> int:
-        """Drop prefix-cache entries no live slot references (block ref
-        == 1: the index alone), coldest (LRU) first — the OOM retry
-        chain's FIRST rung, and admission's last resort before parking a
-        request back in the queue.  Returns the number of blocks
-        actually freed.
-
-        Only chain LEAVES (entries with no interned children) are
-        candidates: dropping an inner block would orphan its
-        descendants' chain ids.  A cold inner block's whole subtree is
-        cold too (a slot adopting a child block always adopted its
-        parents), so repeated engagements drain chains tail-first."""
+    def _cold_leaves(self, max_entries: int | None) -> list:
+        """Eviction/spill candidates: tree LEAVES no live slot
+        references, coldest (LRU) first.  "No slot references" means
+        every ref on the block is index-held (``_blk_ents`` — split
+        siblings stack refs on one shared block); only leaves are
+        candidates because dropping an inner node would orphan its
+        descendants' chain ids."""
         cold = sorted(
             (ent.last_hit, cid) for cid, ent in self._prefix.items()
-            if self._ref[ent.block] == 1 and not self._children.get(cid))
-        if max_entries is not None:
-            cold = cold[:max_entries]
+            if self._ref[ent.block] == self._blk_ents[ent.block]
+            and not self._children.get(cid))
+        return cold if max_entries is None else cold[:max_entries]
+
+    def evict_cold(self, max_entries: int | None = None) -> int:
+        """Drop cold prefix-cache leaves — the OOM retry chain's FIRST
+        rung, and admission's last resort before parking a request back
+        in the queue.  Returns the number of entries actually dropped.
+
+        A cold inner block's whole subtree is cold too (a slot adopting
+        a child block always adopted its parents), so repeated
+        engagements drain chains tail-first."""
         freed = 0
-        for _, cid in cold:
+        for _, cid in self._cold_leaves(max_entries):
             self._drop_entry(cid)
             freed += 1
         if freed:
             _telemetry.count("kv_pool.prefix_evictions", freed)
         return freed
 
+    # -- host-RAM spill tier ------------------------------------------------
+
+    def _chain_tokens(self, cid: int) -> tuple:
+        """Full token chain of a node, root to ``cid`` — the spill-store
+        key.  Parents are always live: only childless nodes are ever
+        dropped."""
+        parts = []
+        while cid:
+            ent = self._prefix[cid]
+            parts.append(ent.key[1])
+            cid = ent.parent
+        return tuple(t for run in reversed(parts) for t in run)
+
+    def spill_cold(self, max_entries: int | None = None,
+                   fetch=None) -> int:
+        """The evict-cold rung, spill-aware: demote cold block-aligned
+        leaf chains to host RAM before freeing their blocks — ``fetch``
+        (the caller's ONE batched ``device_get`` over the pool leaves)
+        is called once per round with the block list and must return
+        ``{leaf: [L, P, bs, ...]}``.  Entries falling outside the spill
+        contract (mid-block split remnants, blocks with undrained
+        copies/restores, past the ``PADDLE_TPU_KV_SPILL_BATCH`` cap or
+        the ``PADDLE_TPU_KV_SPILL_MB`` budget) drop exactly as
+        :meth:`evict_cold` would.  Returns entries freed (the OOM
+        chain's contract)."""
+        if fetch is None or not self.spill_limit_bytes:
+            return self.evict_cold(max_entries)
+        cold = self._cold_leaves(max_entries)
+        if not cold:
+            return 0
+        # blocks whose device rows are not authoritative yet: pending
+        # COW destinations and pending restore targets — spilling one
+        # would capture garbage
+        pend = {d for _, d in self._pending_copies}
+        pend.update(r[3] for r in self._pending_restores)
+        spill, drop = [], []
+        for _, cid in cold:
+            ent = self._prefix[cid]
+            if (len(spill) < self.spill_batch and ent.end % self.bs == 0
+                    and ent.block not in pend):
+                spill.append(cid)
+            else:
+                drop.append(cid)
+        if spill:
+            rows = fetch([self._prefix[cid].block for cid in spill])
+            kept = 0
+            for j, cid in enumerate(spill):
+                rec = {name: np.asarray(arr[:, j])
+                       for name, arr in rows.items()}
+                nb = sum(a.nbytes for a in rec.values())
+                key = self._chain_tokens(cid)
+                old = self._spilled.pop(key, None)
+                if old is not None:
+                    self.host_spill_bytes -= old[1]
+                if self.host_spill_bytes + nb > self.spill_limit_bytes:
+                    self._drop_entry(cid)    # over budget: plain drop
+                    continue
+                self._spilled[key] = (rec, nb)
+                self.host_spill_bytes += nb
+                self._drop_entry(cid)
+                kept += 1
+            if kept:
+                self.spilled_blocks += kept
+                _telemetry.count("kv_pool.spilled_blocks", kept)
+        for cid in drop:
+            self._drop_entry(cid)
+        freed = len(spill) + len(drop)
+        if freed:
+            _telemetry.count("kv_pool.prefix_evictions", freed)
+        return freed
+
+    def _restore_spilled(self, slot: int, parent: int, prompt,
+                         matched: int):
+        """Adoption-side promotion of one spilled chain block: re-intern
+        the node on a fresh block and queue its host rows for the
+        caller's batched ``device_put`` + ``inject_rows`` table scatter
+        (:meth:`take_restores` — zero new executable families).  Chains
+        restore block-by-block as the adopt walk descends.  Returns the
+        new chain id, or None when nothing matches."""
+        if not self._spilled or not self._free or matched % self.bs:
+            return None
+        end = matched + self.bs
+        if end > len(prompt):
+            return None
+        item = self._spilled.pop(tuple(prompt[:end]), None)
+        if item is None:
+            return None
+        rec, nb = item
+        self.host_spill_bytes -= nb
+        b = self._alloc_block()              # the index's own ref
+        run = tuple(prompt[matched:end])
+        key = (parent, run)
+        cid = self._next_chain
+        self._next_chain += 1
+        self._interned[key] = cid
+        self._prefix[cid] = _PrefixEntry(b, self._tick, key, parent, end)
+        self._children.setdefault(parent, {})[run[0]] = cid
+        self._blk_ents[b] += 1
+        self._pending_restores.append((slot, matched, rec, b))
+        self.restored_blocks += 1
+        _telemetry.count("kv_pool.restored_blocks")
+        return cid
+
+    def take_restores(self) -> list:
+        """Drain the pending restore records ``(slot, start_row, rows,
+        block)`` for the caller's batched device_put + inject scatter
+        (``serving._drain_restores``)."""
+        out, self._pending_restores = self._pending_restores, []
+        if out:
+            _telemetry.count("kv_pool.restore_drains")
+        return out
+
+    # -- routing summary ----------------------------------------------------
+
+    def prefix_summary(self, max_roots: int = 16) -> list:
+        """Compact shape of the index for prefix-aware routing: per
+        root-fanout subtree, ``(run_len, fingerprint, resident_tokens)``
+        — the router matches a prompt's head against the fingerprint and
+        uses resident tokens as the expected-overlap bound.  Top
+        ``max_roots`` subtrees by resident tokens."""
+        out = []
+        for cid in self._children.get(0, {}).values():
+            run = self._prefix[cid].key[1]
+            resident = 0
+            stack = [cid]
+            while stack:
+                c = stack.pop()
+                resident += len(self._prefix[c].key[1])
+                stack.extend(self._children.get(c, {}).values())
+            out.append((len(run), prefix_fingerprint(run), resident))
+        out.sort(key=lambda t: (-t[2], t[1]))
+        return out[:max_roots]
+
     def close(self) -> None:
-        """Release the whole index and every table (server shutdown)."""
+        """Release the whole index, every table, and the spill store
+        (server shutdown)."""
         for cid in list(self._prefix):
             if cid in self._prefix:
                 self._drop_entry(cid)
         for slot in range(self.max_batch):
             if (self.tables[slot] >= 0).any():
                 self.free_slot(slot)
+        self._spilled.clear()
+        self._pending_restores.clear()
+        self.host_spill_bytes = 0
 
     def stats(self) -> dict:
         return {
@@ -765,4 +1044,9 @@ class PagedAllocator:
             "prefix_hits": self.prefix_hits,
             "prefix_misses": self.prefix_misses,
             "cow_copies": self.cow_copies,
+            "radix_splits": self.radix_splits,
+            "spilled_blocks": self.spilled_blocks,
+            "restored_blocks": self.restored_blocks,
+            "spilled_entries": len(self._spilled),
+            "host_spill_bytes": self.host_spill_bytes,
         }

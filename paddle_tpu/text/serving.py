@@ -1392,6 +1392,7 @@ class DecodeServer:
                 # before.
                 shared = alloc.adopt_prefix(slot, prompt) \
                     if self._prefill_on and not req.get("adapter") else 0
+                self._drain_restores()
                 if n - shared <= W:
                     starts = [shared if shared + W <= window
                               else max(0, n - W)]
@@ -1404,8 +1405,7 @@ class DecodeServer:
                     except _kv.PoolExhausted:
                         # the OOM chain's first rung at admission (see
                         # _paged_prefill_slot)
-                        if alloc.evict_cold(
-                                max_entries=_EVICT_BATCH) == 0:
+                        if self._evict_or_spill(_EVICT_BATCH) == 0:
                             raise
             except _kv.PoolExhausted:
                 self._pool.free_slot(slot)
@@ -1641,6 +1641,78 @@ class DecodeServer:
                                          tables=dtables)
             self._pool.dirty = False
 
+    def _evict_or_spill(self, max_entries: int) -> int:
+        """The OOM chain's evict-cold rung, spill-aware: with
+        ``PADDLE_TPU_KV_SPILL_MB`` set, cold prefix chains demote to
+        host RAM (one batched ``device_get`` per round) instead of
+        dropping, so the next admission restores them with one batched
+        ``device_put`` instead of a recompute walk.  Delegates to
+        ``evict_cold`` when spill is off or a draft cache shares the
+        allocator (spilled target rows alone would leave the draft
+        pool's rows for those blocks stale on restore)."""
+        pool = self._pool
+        if pool.spill_limit_bytes and self._draft_cache is None:
+            return pool.spill_cold(max_entries, fetch=self._spill_fetch)
+        return pool.evict_cold(max_entries=max_entries)
+
+    def _spill_fetch(self, blocks):
+        """The ONE batched device->host read a spill round pays: gather
+        the demoted blocks' rows across every pool leaf."""
+        from . import kv_pool as _kv
+
+        idx = jnp.asarray(blocks, jnp.int32)
+        return {name: np.asarray(jax.device_get(self.cache[name][:, idx]))
+                for name in _kv.POOL_LEAVES if name in self.cache}
+
+    def _drain_restores(self):
+        """Promote spilled chains the last ``adopt_prefix`` matched back
+        to the device: ONE batched host->device transfer + ONE
+        ``inject_rows`` table scatter per slot, through the same
+        executable buckets the fleet handoff already warms (zero new
+        executable families).  Runs right after adoption — before
+        ``ensure_rows`` can park the request — so a restored index entry
+        never outlives this call with stale device rows."""
+        if not self._paged:
+            return
+        recs = self._pool.take_restores()
+        if not recs:
+            return
+        # the restored blocks' table entries must be live on device
+        # before the scatter resolves through them
+        self._apply_pool_ops()
+        bs = self._pool.bs
+        by_slot: dict = {}
+        for slot, start, rows, _b in recs:
+            by_slot.setdefault(slot, []).append((start, rows))
+        for slot, items in by_slot.items():
+            items.sort(key=lambda it: it[0])
+            # one adopt walk restores a CONTIGUOUS run of blocks, but
+            # inject writes every row in [start, length) — split on gaps
+            # so a hole never zero-fills rows it doesn't own
+            runs, run = [], [items[0]]
+            for it in items[1:]:
+                if it[0] == run[-1][0] + bs:
+                    run.append(it)
+                else:
+                    runs.append(run)
+                    run = [it]
+            runs.append(run)
+            for run in runs:
+                lo, hi = run[0][0], run[-1][0] + bs
+                bucket = _pow2_bucket(hi, self.max_len,
+                                      self.cfg.max_seq_len)
+                padded = {}
+                for name, v0 in run[0][1].items():
+                    buf = np.zeros(
+                        (v0.shape[0], 1, bucket) + v0.shape[2:],
+                        v0.dtype)
+                    for s, rows in run:
+                        buf[:, 0, s:s + bs] = rows[name]
+                    padded[name] = jnp.asarray(buf)
+                fn = _get_inject_fn(self.cfg, bucket, True, self._shard)
+                self.cache = fn(self.cache, padded, jnp.asarray(lo),
+                                jnp.asarray(hi), jnp.asarray(slot))
+
     def _ensure_decode_blocks(self, steps: int):
         """Incremental allocation: before a dispatch of ``steps`` decode
         steps, map (or copy-on-write) every active slot's blocks
@@ -1676,6 +1748,7 @@ class DecodeServer:
         # adapter rows would poison future base/other-adapter admissions
         shared = alloc.adopt_prefix(slot, prompt) \
             if self._prefill_on and not req.get("adapter") else 0
+        self._drain_restores()
         window = min(self.max_len, self.cfg.max_seq_len)
         if self._chunk:
             C = min(self._chunk, window)
@@ -1711,7 +1784,7 @@ class DecodeServer:
                 # fleet's prefix hit rate.  Cold entries are ref==1, so
                 # this request's freshly adopted blocks (ref>=2) are
                 # never its own victims
-                if alloc.evict_cold(max_entries=_EVICT_BATCH) == 0:
+                if self._evict_or_spill(_EVICT_BATCH) == 0:
                     raise
         self._apply_pool_ops()
         if self._adapters is not None:
@@ -1786,6 +1859,7 @@ class DecodeServer:
                 # capped at n-1 like local admission: the final row is
                 # always written (COW on a fully-shared prompt)
                 shared = self._pool.adopt_prefix(slot, req["prompt"])
+                self._drain_restores()
             while True:
                 try:
                     self._pool.ensure_rows(slot, shared, n)
@@ -1793,8 +1867,7 @@ class DecodeServer:
                 except _kv.PoolExhausted:
                     # the OOM chain's first rung at admission (see
                     # _paged_prefill_slot)
-                    if self._pool.evict_cold(
-                            max_entries=_EVICT_BATCH) == 0:
+                    if self._evict_or_spill(_EVICT_BATCH) == 0:
                         raise
             self._apply_pool_ops()
         fn = _get_inject_fn(self.cfg, bucket, self._paged, self._shard)
@@ -2383,6 +2456,17 @@ class DecodeServer:
                 if st.get("constraint") is not None),
             **({"adapters_active": ad_active}
                if self._adapters is not None else {}),
+            # prefix-cache surface (paged only): the hit-rate gauge
+            # (fraction of adoptable rows admission did NOT recompute),
+            # the compact radix summary prefix-aware routing scores
+            # overlap against, and the host spill tier's footprint
+            **({"prefix_hit_rate": (
+                    self._pool.prefix_hits
+                    / max(1, self._pool.prefix_hits
+                          + self._pool.prefix_misses)),
+                "prefix_summary": self._pool.prefix_summary(),
+                "host_spill_bytes": self._pool.host_spill_bytes}
+               if self._paged else {}),
         }
 
     def drain_queue(self, rids=None) -> list:
@@ -2567,6 +2651,12 @@ class DecodeServer:
             _telemetry.set_gauge("kv_pool.blocks_in_use", used)
             _telemetry.set_gauge("serving.kv_utilization",
                                  used / max(1, self._pool.N))
+            _telemetry.set_gauge("kv_pool.host_spill_bytes",
+                                 self._pool.host_spill_bytes)
+            seen = self._pool.prefix_hits + self._pool.prefix_misses
+            if seen:
+                _telemetry.set_gauge("kv_pool.prefix_hit_rate",
+                                     self._pool.prefix_hits / seen)
         else:
             rows = (int(self.cache["k"].shape[2])
                     if self.cache is not None else self.max_len)
@@ -2726,8 +2816,8 @@ class DecodeServer:
         # routed through the rung so the chaos suite can drive it
         pool_relievable = self._paged and isinstance(
             exc, (_kv.PoolExhausted, _faults.InjectedOOM))
-        if pool_relievable and self._pool.evict_cold(
-                max_entries=max(_EVICT_BATCH, len(self._slots))) > 0:
+        if pool_relievable and self._evict_or_spill(
+                max(_EVICT_BATCH, len(self._slots))) > 0:
             # NEW first rung (round 8): free pool blocks the prefix
             # cache alone holds — pure memory back for zero lost work —
             # before any dispatch degradation.  Batched (LRU-first), not

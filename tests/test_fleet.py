@@ -339,6 +339,102 @@ def test_router_submit_validation(cfg_params):
 
 
 # ---------------------------------------------------------------------------
+# prefix-aware routing (round 16): affinity, imbalance cap, snapshot
+# ---------------------------------------------------------------------------
+
+
+def test_router_prefix_affinity_sticks_to_warm_replica(cfg_params):
+    """A tenant's repeat requests land on the replica holding its radix
+    chain: after the first request registers the shared preamble, every
+    follow-up (submitted one at a time so load never disambiguates)
+    routes to the same replica via the fingerprint match, and
+    ``fleet.prefix_routed`` records each affinity-decided dispatch."""
+    cfg, params = cfg_params
+    rng = np.random.default_rng(12)
+    pre = [int(x) for x in rng.integers(1, 60, 12)]
+    replicas = [serving.DecodeServer(params, cfg, max_batch=2, max_len=48,
+                                     **_layout_kw("paged"))
+                for _ in range(2)]
+    router = fleet.Router(replicas)
+    rid0 = router.submit(pre + [61], max_new_tokens=2)
+    while router.pending():
+        router.tick()
+    home = router._requests[rid0]["replica"]
+    routed0 = _count("fleet.prefix_routed")
+    rids = []
+    for t in range(3):
+        rid = router.submit(pre + [50 + t], max_new_tokens=2)
+        while router.pending():
+            router.tick()
+        rids.append(rid)
+    assert [router._requests[r]["replica"] for r in rids] == [home] * 3
+    assert _count("fleet.prefix_routed") - routed0 >= 3
+    router.close()
+
+
+def test_router_prefix_affinity_imbalance_cap_fills_cold_replica(
+        cfg_params):
+    """Affinity credit is capped: a hot tenant's flood pins to its warm
+    replica only while that replica stays within
+    ``PADDLE_TPU_PREFIX_ROUTE_IMBALANCE`` queued requests of the
+    least-loaded candidate — overflow routes to the cold replica by
+    load instead of queueing forever behind the warm one."""
+    cfg, params = cfg_params
+    rng = np.random.default_rng(13)
+    pre = [int(x) for x in rng.integers(1, 60, 12)]
+    replicas = [serving.DecodeServer(params, cfg, max_batch=1, max_len=48,
+                                     **_layout_kw("paged"))
+                for _ in range(2)]
+    router = fleet.Router(replicas, max_queue=4)
+    rid0 = router.submit(pre + [61], max_new_tokens=2)
+    while router.pending():
+        router.tick()
+    home = router._requests[rid0]["replica"]
+    # six hot requests at once: affinity takes the first few onto the
+    # warm replica (slot, then queue depth 1..2), the imbalance cap
+    # (default 2) zeroes the overlap once the warm queue runs 3 ahead
+    # of the idle replica, and load routing fills the cold one
+    rids = [router.submit(pre + [40 + i], max_new_tokens=2)
+            for i in range(6)]
+    where = [router._requests[r]["replica"] for r in rids]
+    assert set(where) == {0, 1}
+    assert where.count(home) >= 3          # affinity did lead
+    assert where.count(1 - home) >= 2      # the cap did spill
+    while router.pending():
+        router.tick()
+    router.close()
+
+
+def test_router_snapshots_load_once_per_tick(cfg_params):
+    """One ``load_stats()`` read per healthy replica per scheduling
+    round, however deep the fleet queue — the per-queued-request
+    re-read (which multiplied host overhead by queue depth) is gone."""
+    cfg, params = cfg_params
+    replicas = [serving.DecodeServer(params, cfg, max_batch=1, max_len=48)
+                for _ in range(2)]
+    router = fleet.Router(replicas, max_queue=0)
+    reads = [0, 0]
+    for i, r in enumerate(replicas):
+        def wrap(i=i, orig=r.load_stats):
+            reads[i] += 1
+            return orig()
+        r.load_stats = wrap
+    rids = [router.submit([1 + i, 2], max_new_tokens=4)
+            for i in range(2)]
+    extra = [router.submit([7 + i, 8], max_new_tokens=2)
+             for i in range(4)]
+    assert sum(reads) > 0                  # wrappers are wired in
+    reads[0] = reads[1] = 0
+    router.tick()                          # 4 requests still queued
+    assert max(reads) <= 1
+    while router.pending():
+        router.tick()
+    for r in rids + extra:
+        router.result(r)
+    router.close()
+
+
+# ---------------------------------------------------------------------------
 # wedge: drain, re-route, aggregated health
 # ---------------------------------------------------------------------------
 

@@ -108,7 +108,7 @@ def test_prefix_adopt_register_cap_and_cow():
     shared = a.adopt_prefix(1, prompt)
     assert shared == 16
     assert [int(a.tables[1, i]) for i in range(2)] == owned
-    assert a.prefix_hits == 2
+    assert a.prefix_hits == 16         # token rows, not blocks
     # the adopted blocks are shared (ref 2): a write COWs
     a.ensure_rows(1, 8, 20)
     assert a.cow_copies == 1
@@ -551,3 +551,198 @@ def test_check_instrumented_repo_clean():
     import check_instrumented as ci
 
     assert ci.scan_repo() == []
+
+
+# ---------------------------------------------------------------------------
+# radix tree: token-granular splits + host-RAM spill tier (round 16)
+# ---------------------------------------------------------------------------
+
+
+def test_radix_split_adopts_mid_block_and_evicts_cleanly():
+    """A prompt diverging MID-BLOCK splits the node WITHOUT a device
+    copy: both halves share the physical block (the shared rows are
+    bit-identical by the chain invariant), the adopter maps the split
+    node's block, and evict-all drains the shared-block chain with no
+    orphaned children or leaked refs."""
+    bs = 8
+    a = kv_pool.PagedAllocator(num_blocks=8, block_size=bs, nmax=4,
+                               max_batch=2)
+    prompt = list(range(20))           # blocks 0,1 full; 4-row tail
+    a.ensure_rows(0, 0, 20)
+    a.register_prefix(0, prompt)
+    a.free_slot(0)
+    other = prompt[:12] + [99] * 8     # diverges INSIDE block 1
+    shared = a.adopt_prefix(1, other)
+    assert shared == 12                # token-granular, not block-granular
+    assert a.radix_splits == 1
+    assert a.prefix_entries == 3       # block0, split node S, re-keyed X
+    # S and X share ONE physical block: no copy was queued by the split
+    assert a.take_copies() == []
+    blocks = [e.block for e in a._prefix.values()]
+    assert len(blocks) == 3 and len(set(blocks)) == 2
+    # the adopter's first write into the shared block COWs as usual
+    # (admission prefills from the adopted offset, not row 0)
+    a.ensure_rows(1, 12, 20)
+    assert a.cow_copies == 1
+    a.register_prefix(1, other)
+    # evict-all: the ref==entries-per-block rule must drain split-shared
+    # blocks too (a plain ref==1 candidate rule would pin them forever)
+    a.free_slot(1)
+    for _ in range(16):
+        if not a.prefix_entries:
+            break
+        a.evict_cold()
+    assert a.prefix_entries == 0
+    assert a.blocks_in_use == 0
+    assert not a._children
+    assert not a._blk_ents.any()
+
+
+def test_spill_restore_allocator_roundtrip(kv_env):
+    """Allocator-level spill->restore: cold block-aligned chains demote
+    leaf-first to host records, adoption restores them block-by-block,
+    and the queued restore rows are bit-identical to what was fetched
+    at spill time."""
+    kv_env(PADDLE_TPU_KV_SPILL_MB="4")
+    bs = 8
+    a = kv_pool.PagedAllocator(num_blocks=8, block_size=bs, nmax=4,
+                               max_batch=2)
+    prompt = list(range(24))           # 3 full blocks, aligned
+    a.ensure_rows(0, 0, 24)
+    a.register_prefix(0, prompt)
+    chain = [int(a.tables[0, i]) for i in range(3)]
+    a.free_slot(0)
+
+    def fetch(blocks):
+        # per-block marker rows: leaf [L=2, P, bs, 1] stamped with the
+        # physical block id, so restore content is attributable
+        return {"k": np.stack(
+            [np.full((2, bs, 1), float(b), np.float32)
+             for b in blocks], axis=1)}
+
+    for _ in range(8):
+        if not a.prefix_entries:
+            break
+        a.spill_cold(8, fetch=fetch)
+    assert a.spilled_blocks == 3
+    assert len(a._spilled) == 3
+    assert a.blocks_in_use == 0
+    assert a.host_spill_bytes > 0
+    shared = a.adopt_prefix(1, prompt)
+    assert shared == 23                # full chain restored, capped n-1
+    assert a.restored_blocks == 3
+    recs = a.take_restores()
+    assert [r[1] for r in recs] == [0, 8, 16]   # contiguous starts
+    for pos, (slot, start, rows, blk) in enumerate(recs):
+        assert slot == 1
+        # the restored rows carry the marker of the ORIGINAL physical
+        # block that held this chain position at spill time
+        assert float(rows["k"][0, 0, 0]) == float(chain[pos])
+    assert a.host_spill_bytes == 0
+    assert not a._spilled
+    a.take_restores()                  # drained: second take is empty
+    assert a.take_restores() == []
+
+
+@pytest.mark.parametrize("kv", ["fp32", "int8"])
+@pytest.mark.parametrize("mode", ["tick", "async"])
+def test_spill_restore_bit_parity(kv_env, kv, mode, markov_gpt):
+    """Serving-level spill->restore cycle: demote a retired prompt's
+    whole chain to host RAM, re-serve the prompt — greedy tokens stay
+    bit-identical to the cold pass and the contiguous slab, and >= 90%
+    of the re-prefill rows come back from host RAM instead of
+    recompute.  {fp32, int8 KV} x {tick, async}."""
+    kv_env(PADDLE_TPU_KV_DTYPE=None if kv == "fp32" else kv,
+           PADDLE_TPU_KV_SPILL_MB="4")
+    cfg, params = markov_gpt
+    prompt = [int(x) for x in
+              np.random.default_rng(9).integers(0, 13, 16)]
+    async_ = mode == "async"
+    ref, _ = _serve(params, cfg, [prompt], "contiguous", async_=async_)
+    srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=32,
+                               layout="paged", block_size=8,
+                               async_dispatch=async_)
+    r0 = srv.submit(prompt, max_new_tokens=6)
+    while srv.pending():
+        srv.tick()
+    cold = srv.result(r0)
+    for _ in range(8):                 # demote the whole cold chain
+        if not srv._pool.prefix_entries:
+            break
+        srv._evict_or_spill(8)
+    assert srv._pool.spilled_blocks >= 2
+    hits0 = srv._pool.prefix_hits
+    r1 = srv.submit(prompt, max_new_tokens=6)
+    while srv.pending():
+        srv.tick()
+    warm = srv.result(r1)
+    saved = srv._pool.prefix_hits - hits0
+    stats = srv._pool.stats()
+    srv.close()
+    assert warm == cold == ref[0]
+    assert stats["restored_blocks"] >= 2
+    assert saved >= 0.9 * (len(prompt) - 1)
+
+
+def test_oom_fault_spills_cold_prefix_with_parity(kv_env, markov_gpt):
+    """With the spill tier enabled, the OOM chain's first rung DEMOTES
+    cold chains instead of dropping them (kv_pool.spilled_blocks
+    counted), and the faulted pass still yields bit-identical
+    tokens."""
+    kv_env(PADDLE_TPU_KV_SPILL_MB="4")
+    cfg, params = markov_gpt
+    prompt = [int(x) for x in
+              np.random.default_rng(5).integers(0, 13, 12)]
+
+    def run(spec):
+        faults.reset()
+        try:
+            srv = serving.DecodeServer(params, cfg, max_batch=2,
+                                       max_len=32, layout="paged",
+                                       block_size=8)
+            r0 = srv.submit(prompt, max_new_tokens=4)
+            while srv.pending():
+                srv.tick_block(4)
+            if spec:
+                faults.install(spec)
+            r1 = srv.submit([int(x) for x in prompt[::-1][:10]],
+                            max_new_tokens=4)
+            while srv.pending():
+                srv.tick_block(4)
+            out = (srv.result(r0), srv.result(r1))
+            srv.close()
+            return out
+        finally:
+            faults.reset()
+
+    clean = run("")
+    s0 = int(monitor.get_stat("kv_pool.spilled_blocks").get())
+    faulted = run("oom:serving.block:1")
+    spilled = int(monitor.get_stat("kv_pool.spilled_blocks").get()) - s0
+    assert faulted == clean
+    assert spilled >= 1
+
+
+def test_check_instrumented_prefix_rule():
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                    "tools"))
+    import check_instrumented as ci
+
+    bad = ("class P:\n"
+           "    def _split_entry(self, cid, m):\n"
+           "        return cid\n")
+    assert ci.scan_prefix_cache_source(bad)
+    bad2 = ("class R:\n"
+            "    def _prefix_route(self, req, cands):\n"
+            "        return cands[0]\n")
+    assert ci.scan_prefix_cache_source(bad2)
+    good = ("class P:\n"
+            "    def _split_entry(self, cid, m):\n"
+            "        count('kv_pool.radix_splits')\n"
+            "        return cid\n"
+            "    def spill_cold(self):\n"
+            "        self._split_entry(0, 0)\n"
+            "    def _restore_spilled(self):\n"
+            "        count('kv_pool.restored_blocks')\n")
+    assert not ci.scan_prefix_cache_source(good)

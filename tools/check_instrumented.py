@@ -157,6 +157,21 @@ ADAPTER_MARKERS = ("gather_adapter", "apply_constraint", "mask_logits")
 ENGINE_DIR = os.path.join("paddle_tpu", "text")
 ENGINE_FILE = os.path.join("paddle_tpu", "text", "engine.py")
 
+# Prefix-cache lint (round 16, same rule family): every radix-tree /
+# spill-tier / affinity path across the prefix cache — the no-copy node
+# split, host-RAM demotion, restore-on-adopt, prefix-aware replica
+# scoring — must count a telemetry counter (kv_pool.radix_splits /
+# kv_pool.spilled_blocks / kv_pool.restored_blocks /
+# fleet.prefix_routed) or delegate to another marker-named callable.
+# The prefix hit rate is the whole point of the tier; a split or spill
+# path that moves KV rows without counting them makes the hit-rate
+# gauge a lie.
+PREFIX_FILES = (
+    os.path.join("paddle_tpu", "text", "kv_pool.py"),
+    os.path.join("paddle_tpu", "text", "fleet.py"),
+)
+PREFIX_MARKERS = ("split", "spill", "restore", "prefix_route")
+
 
 def _call_name(node: ast.Call):
     f = node.func
@@ -283,6 +298,33 @@ def scan_fleet_source(src: str, filename: str = "<src>") -> list:
                  f"fleet scheduling site {node.name}() records no "
                  f"telemetry counter (count) — silent re-routes/sheds "
                  f"read as healthy while requests vanish"))
+    return violations
+
+
+def scan_prefix_cache_source(src: str, filename: str = "<src>") -> list:
+    """Prefix-cache lint violations in one source string: a function
+    whose name carries a :data:`PREFIX_MARKERS` marker (a radix split,
+    spill/restore, or prefix-routing path) must contain a call to one
+    of :data:`COUNT_NAMES` or delegate to another marker-named
+    callable."""
+    tree = ast.parse(src, filename=filename)
+    violations = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and any(m in node.name for m in PREFIX_MARKERS)):
+            continue
+        counted = any(
+            isinstance(n, ast.Call)
+            and (_call_name(n) in COUNT_NAMES
+                 or any(m in (_call_name(n) or "")
+                        for m in PREFIX_MARKERS))
+            for n in ast.walk(node))
+        if not counted:
+            violations.append(
+                (filename, node.lineno,
+                 f"prefix-cache site {node.name}() records no telemetry "
+                 f"counter (count) — uncounted splits/spills make the "
+                 f"prefix hit-rate gauge a lie"))
     return violations
 
 
@@ -544,6 +586,14 @@ def scan_repo(root: str | None = None) -> list:
         with open(fleet_path, encoding="utf-8") as f:
             violations.extend(scan_fleet_source(
                 f.read(), os.path.relpath(fleet_path, root)))
+    # prefix-cache lint: radix split / spill / restore / affinity
+    # observability
+    for rel in PREFIX_FILES:
+        px_path = os.path.join(root, rel)
+        if os.path.exists(px_path):
+            with open(px_path, encoding="utf-8") as f:
+                violations.extend(scan_prefix_cache_source(
+                    f.read(), os.path.relpath(px_path, root)))
     # speculative-decoding lint: accept/propose/fallback observability
     spec_path = os.path.join(root, SPEC_FILE)
     if os.path.exists(spec_path):
