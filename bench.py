@@ -1454,9 +1454,35 @@ def _spec_smoke():
         raise AssertionError(
             f"spec smoke: self-draft token divergence "
             f"({got_rep} vs {ref_rep})")
+    # tree round (round 17): a draft whose argmax chain is WRONG at a
+    # known position but whose top-2 sibling is right — linear
+    # speculation dies at the first divergence, the tree's branch
+    # recovers it, so at the same per-round row budget the tree must be
+    # bit-identical to plain AND spend strictly fewer target passes
+    # than linear-K
+    bad = dict(params)
+    bad["ln_f_b"] = params["ln_f_b"] + 30.0 * params["wte"][42]
+    tree, tree_passes = serve(draft_cfg=cfg, draft_params=bad,
+                              spec_tree=4)
+    if tree != ref:
+        raise AssertionError(
+            f"spec smoke: tree/plain token divergence "
+            f"({tree} vs {ref})")
+    lin, lin_passes = serve(draft_cfg=cfg, draft_params=bad, spec_k=4)
+    if lin != ref:
+        raise AssertionError(
+            f"spec smoke: biased-draft linear/plain divergence "
+            f"({lin} vs {ref})")
+    if tree_passes >= lin_passes:
+        raise AssertionError(
+            f"spec smoke: tree verify spent {tree_passes} target passes "
+            f"vs linear-K's {lin_passes} at the same 4-row budget — "
+            f"branching bought nothing")
     return {"ok": True, "plain_target_passes": plain_passes,
             "spec_target_passes": spec_passes,
-            "passes_per_token_speedup": round(ratio, 3)}
+            "passes_per_token_speedup": round(ratio, 3),
+            "tree_target_passes": tree_passes,
+            "linear_target_passes_biased": lin_passes}
 
 
 def _mixed_smoke():
@@ -3765,12 +3791,27 @@ def bench_spec(small: bool):
     checks the serving machinery's ceiling, not draft quality.  The
     self-draft arm's pass count is reported unasserted: its n-gram hit
     rate is workload-dependent (repetitive streams win, random streams
-    fall back to plain steps)."""
+    fall back to plain steps).
+
+    Round 17 adds the TREE arm: the same stream through linear-K and
+    tree-N speculation at the SAME per-round row budget (N == K),
+    driven by a draft engineered to argmax WRONG with the truth at its
+    top-2 — the regime where linear dies at the first divergence and
+    the tree's sibling branch recovers the tail.  Asserted: tree
+    verify stays bit-identical to plain AND spends strictly fewer
+    target passes per token than linear at the equal budget; the
+    accepted root-to-leaf length histogram is reported alongside.
+    ``--constrained`` appends a constrained-workload arm: every
+    request decodes under a token-set automaton through a tree server,
+    and the run asserts ``constraint.spec_fallbacks`` stays EXACTLY
+    zero — constrained slots speculate through DFA-pruned trees
+    instead of falling back to plain stepping."""
     import numpy as np
     import jax
     import jax.numpy as jnp
 
     from paddle_tpu import flags
+    from paddle_tpu.framework import monitor
     from paddle_tpu.text import gpt, serving
 
     dev = jax.devices()[0]
@@ -3803,11 +3844,23 @@ def bench_spec(small: bool):
     if small:
         dcfg = cfg
 
-    def serve_pass(**kw):
+    def serve_pass(hist=None, constraint=None, **kw):
         srv = serving.DecodeServer(params, cfg, max_batch=B,
                                    max_len=max_len, **kw)
+        if hist is not None:
+            # accepted-path-length histogram, sampled at the accept
+            # choke point (host-side, zero device traffic)
+            orig = srv._spec_tree_accept
+
+            def counted(st, rows, tp):
+                toks, sel = orig(st, rows, tp)
+                hist[len(sel)] = hist.get(len(sel), 0) + 1
+                return toks, sel
+
+            srv._spec_tree_accept = counted
         for p in prompts:
-            srv.submit(p, max_new_tokens=new_toks)
+            srv.submit(p, max_new_tokens=new_toks,
+                       constraint=constraint)
         while srv.pending():
             srv.tick()
         toks = srv._results
@@ -3819,12 +3872,12 @@ def bench_spec(small: bool):
         srv.close()
         return toks, passes, accept
 
-    def measure(**kw):
+    def measure(hist=None, **kw):
         serve_pass(**kw)                      # warm pass (compiles)
         t0 = time.perf_counter()
         out = None
         for _ in range(iters):
-            out = serve_pass(**kw)
+            out = serve_pass(hist=hist, **kw)
         dt = (time.perf_counter() - t0) / iters
         toks, passes, accept = out
         total = sum(len(t) for t in toks.values())
@@ -3848,6 +3901,63 @@ def bench_spec(small: bool):
             f"spec bench: draft-model arm spent {draft_ppt:.3f} target "
             f"passes/token vs plain {plain_ppt:.3f} — {speedup:.2f}x "
             f"< 1.5x fewer passes per token")
+    # tree arm: linear-K vs tree-N at the SAME per-round row budget,
+    # driven by a target-derived biased draft (argmax wrong, truth at
+    # top-2) so the comparison exercises divergence recovery, not a
+    # perfect-agreement ceiling
+    bparams = dict(params)
+    bparams["ln_f_b"] = jnp.asarray(
+        np.asarray(params["ln_f_b"])
+        + 30.0 * np.asarray(params["wte"])[42])
+    bias_kw = dict(draft_cfg=cfg, draft_params=bparams)
+    got_bl, _, blin_ppt, _ = measure(spec_k=K, **bias_kw)
+    tree_hist: dict = {}
+    got_t, tree_tok_s, tree_ppt, tree_acc = measure(
+        hist=tree_hist, spec_tree=K, **bias_kw)
+    if got_t != ref:
+        raise AssertionError(
+            "spec bench: tree verify diverged from the plain server's "
+            "greedy tokens")
+    if got_bl != ref:
+        raise AssertionError(
+            "spec bench: biased-draft linear arm diverged from the "
+            "plain server's greedy tokens")
+    if tree_ppt >= blin_ppt:
+        raise AssertionError(
+            f"spec bench: tree arm spent {tree_ppt:.3f} target passes/"
+            f"token vs linear-K's {blin_ppt:.3f} at the same {K}-row "
+            f"budget — branching bought nothing")
+    constrained = "--constrained" in sys.argv
+    cons_rec = {}
+    if constrained:
+        # constrained-workload arm: every request under a token-set
+        # automaton; tree speculation must PRUNE instead of FALL BACK
+        fb_stat = monitor.get_stat("constraint.spec_fallbacks")
+        allowed = [int(x) for x in
+                   rng.choice(np.arange(1, cfg.vocab_size), 12,
+                              replace=False)]
+        cref, _, _ = serve_pass(constraint=allowed)
+        fb0 = int(fb_stat.get())
+        cons_hist: dict = {}
+        cgot, ctok_s, cppt, _ = measure(hist=cons_hist, spec_tree=K,
+                                        constraint=allowed)
+        fb1 = int(fb_stat.get())
+        if cgot != cref:
+            raise AssertionError(
+                "spec bench: constrained tree verify diverged from the "
+                "plain constrained server's greedy tokens")
+        if fb1 - fb0 != 0:
+            raise AssertionError(
+                f"spec bench: constrained tree arm tripped "
+                f"{fb1 - fb0} constraint.spec_fallbacks — constrained "
+                f"slots must speculate via pruned trees, not fall back")
+        cons_rec = {
+            "constrained_tok_s": round(ctok_s, 2),
+            "constrained_passes_per_token": round(cppt, 3),
+            "constrained_spec_fallbacks": fb1 - fb0,
+            "constrained_accept_len_hist": {
+                str(k): v for k, v in sorted(cons_hist.items())},
+        }
     rec = {"metric": "tokens_per_sec_serving_speculative",
            "unit": "tokens/s/chip",
            "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
@@ -3868,6 +3978,20 @@ def bench_spec(small: bool):
                                  if draft_acc is not None else None),
            "self_draft_accept_rate": (round(self_acc, 3)
                                       if self_acc is not None else None),
+           # tree arm (equal row budget, biased-target draft): the
+           # passes-per-token pair IS the headline claim — one
+           # tree-masked pass covers what linear loses at its first
+           # divergence — and the histogram shows WHERE the tree's
+           # extra tokens come from (accepted path lengths > 1)
+           "spec_tree_nodes": K,
+           "tree_tok_s": round(tree_tok_s, 2),
+           "tree_passes_per_token": round(tree_ppt, 3),
+           "linear_biased_passes_per_token": round(blin_ppt, 3),
+           "tree_accept_rate": (round(tree_acc, 3)
+                                if tree_acc is not None else None),
+           "tree_accept_len_hist": {
+               str(k): v for k, v in sorted(tree_hist.items())},
+           **cons_rec,
            "kv_dtype": flags.kv_cache_dtype() or "compute",
            "vs_baseline": 0.0}
     return _stamp_provenance(rec, dev)

@@ -428,6 +428,44 @@ def spec_k() -> int:
     return k
 
 
+def spec_tree() -> int:
+    """Node budget of the tree-speculation round
+    (``PADDLE_TPU_SPEC_TREE``, default 0 = tree mode off).  When > 0 a
+    ``DecodeServer`` built without an explicit ``spec_tree=`` proposes a
+    token TREE of this many node slots per round (node 0 is the feed
+    token) and verifies it in one tree-masked pass; mutually exclusive
+    with linear ``spec_k``.  The node count is baked into the tree
+    verify executable's shapes — the raw env string is part of
+    ``decode_jit_key`` — but the tree's TOPOLOGY (ancestor mask +
+    depths) is a runtime argument, so per-round shape changes never
+    retrace."""
+    v = os.environ.get("PADDLE_TPU_SPEC_TREE", "0")
+    try:
+        n = int(v)
+    except ValueError:
+        raise ValueError(f"PADDLE_TPU_SPEC_TREE={v!r}: expected an "
+                         f"integer >= 0 (0 disables tree speculation)")
+    if n < 0 or n == 1:
+        raise ValueError(f"PADDLE_TPU_SPEC_TREE={n}: must be 0 (off) or "
+                         f">= 2 (node 0 carries the feed token, so a "
+                         f"1-node tree proposes nothing)")
+    return n
+
+
+def spec_branch() -> int:
+    """Branching factor of tree-speculation proposals
+    (``PADDLE_TPU_SPEC_BRANCH``, default 2): how many sibling
+    candidates a propose step may fan out per node — top-b from the
+    draft model, or distinct n-gram match continuations when
+    self-drafting.  Host proposal shaping only — the verify executable
+    sees topology as a runtime mask, so this is never a jit-cache
+    key."""
+    try:
+        return max(1, int(os.environ.get("PADDLE_TPU_SPEC_BRANCH", "2")))
+    except ValueError:
+        return 2
+
+
 def prefill_budget() -> int:
     """Per-scheduler-round admission prefill token budget
     (``PADDLE_TPU_PREFILL_BUDGET``, default 0 = monolithic admission).
@@ -724,6 +762,10 @@ def decode_jit_key() -> tuple:
             # speculative serving: K is baked into the batched verify
             # executable's shapes (tokens [B, K], logits [B, K, V])
             os.environ.get("PADDLE_TPU_SPEC_K", ""),
+            # tree speculation: the node budget is the tree verify
+            # executable's chunk shape (topology itself is a runtime
+            # arg — only the count traces)
+            os.environ.get("PADDLE_TPU_SPEC_TREE", ""),
             # budgeted admission: the per-round prefill budget is the
             # chunk width of the admission executables
             os.environ.get("PADDLE_TPU_PREFILL_BUDGET", ""))

@@ -19,7 +19,21 @@ built on the seeds in :mod:`paddle_tpu.profiler` (host spans) and
    per-server ``serving.spec_accept_rate`` gauge; all auto-export to
    :func:`snapshot`/:func:`render_prometheus` like every registry stat,
    and ``tools/check_instrumented.py`` lints that every spec
-   accept/reject/fallback path counts or delegates.  The fleet-scale
+   accept/reject/fallback path counts or delegates.  Draft-TREE
+   speculation (round 17) extends the family: ``spec.tree_rounds``
+   (tree-masked verify passes), ``spec.tree_nodes_proposed`` /
+   ``spec.tree_nodes_accepted`` (token-bearing nodes dispatched vs
+   root-to-leaf edges committed — their ratio is the tree's acceptance
+   efficiency), ``spec.tree_pruned_constrained`` (grammar-forbidden
+   branches a constrained slot's DFA lookahead removed BEFORE the
+   verify pass — the mechanism that keeps ``constraint.spec_fallbacks``
+   at zero for constrained workloads), and ``spec.reearns`` (fallen-
+   back slots that re-entered speculation after the doubling cooldown);
+   the per-server ``serving.spec_tree_accept_len`` gauge (mean accepted
+   path length per round) rides ``load_stats()`` and the Prometheus
+   export, and the same lint covers every
+   ``*tree_propose*``/``*tree_accept*``/``*prune_branch*`` path.
+   The fleet-scale
    prefix cache adds its own family: ``kv_pool.radix_splits`` (no-copy
    radix node splits on partial-block prompt overlap),
    ``kv_pool.spilled_blocks`` / ``kv_pool.restored_blocks`` /

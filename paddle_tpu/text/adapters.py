@@ -49,11 +49,17 @@ Route notes (deliberate scope):
   documented per-slot cost of QLoRA-style serving.
 * Speculative serving composes: the verify pass gathers the SAME
   per-slot adapter (greedy output = the adapter-aware target's argmax
-  regardless of what the base-model draft proposed).  Constrained slots
-  instead force plain stepping for the tick — draft tokens can't be
-  masked cheaply (each would need the automaton advanced on host
-  mid-proposal), so ``DecodeServer._spec_ready`` falls back and counts
-  ``constraint.spec_fallbacks``.
+  regardless of what the base-model draft proposed).  In LINEAR spec
+  mode constrained slots still force plain stepping for the tick
+  (``DecodeServer._spec_ready`` falls back and counts
+  ``constraint.spec_fallbacks``); TREE mode instead speculates them:
+  :func:`constraint_lookahead` walks the token DFA over the proposed
+  tree WITHOUT mutating the request's live state (the lazy
+  ``_TokenMachine.table`` is exactly a lookahead table), grammar-banned
+  branches are pruned before the verify pass, and acceptance applies
+  the state's allowed-mask to each node's logits — so low-entropy
+  JSON/regex traffic, the best speculation target there is, stops
+  paying the fallback.
 """
 from __future__ import annotations
 
@@ -70,7 +76,8 @@ from .. import telemetry as _telemetry
 __all__ = [
     "AdapterPool", "stacked_pool_specs", "TokenSetConstraint",
     "RegexConstraint", "JsonSchemaConstraint", "compile_constraint",
-    "mask_logits", "apply_constraint_host", "NEG_INF",
+    "constraint_lookahead", "mask_logits", "apply_constraint_host",
+    "NEG_INF",
 ]
 
 # additive mask value for banned tokens: large-negative instead of true
@@ -732,6 +739,70 @@ class ConstraintState:
         mask, _ = self._m.table(self._state)
         if not mask.any():
             self.exhausted = True                    # finished language
+
+
+class ConstraintLookahead:
+    """A NON-MUTATING cursor over a :class:`ConstraintState`'s automaton
+    — the tree-speculation primitive.  Pruning a proposed token tree
+    needs the DFA advanced down *several* branches from the request's
+    current position without committing any of them; ``child(t)`` mints
+    an independently-advanced cursor (die-closed exactly like
+    ``ConstraintState.advance``), so one cursor per live tree node walks
+    the whole trie while the request's real state stays untouched until
+    acceptance.  The per-state token table is the machine's lazy cache,
+    shared with the live state — lookahead costs no extra table builds
+    beyond states the walk actually visits.
+
+    Duck-types ``allowed_mask()``/``exhausted`` with ConstraintState, so
+    :func:`apply_constraint_host` masks accept-time logit rows through a
+    cursor unchanged."""
+
+    __slots__ = ("_fixed", "_m", "_state", "_eos", "exhausted")
+
+    def __init__(self, fixed, machine, state, eos_id, exhausted=False):
+        self._fixed = fixed
+        self._m = machine
+        self._state = state
+        self._eos = eos_id
+        self.exhausted = exhausted
+
+    def allowed_mask(self) -> np.ndarray:
+        if self._m is None:
+            return self._fixed
+        mask, _ = self._m.table(self._state)
+        return mask
+
+    def allows(self, t: int) -> bool:
+        """Would the automaton accept ``t`` here?  (eos rides the mask:
+        allowed exactly when the current state admits ending.)"""
+        if self.exhausted:
+            return False
+        return bool(self.allowed_mask()[int(t)])
+
+    def child(self, t: int) -> "ConstraintLookahead":
+        """A NEW cursor advanced past ``t`` — ``self`` is untouched, so
+        sibling branches each get their own continuation."""
+        if self.exhausted:
+            return self
+        if self._eos is not None and int(t) == self._eos:
+            return ConstraintLookahead(self._fixed, self._m, self._state,
+                                       self._eos, exhausted=True)
+        if self._m is None:
+            return self                              # token-set: static
+        _, nxt = self._m.table(self._state)
+        land = nxt.get(int(t))
+        if land is None:                             # banned: die closed
+            return ConstraintLookahead(self._fixed, self._m, self._state,
+                                       self._eos, exhausted=True)
+        mask, _ = self._m.table(land)
+        return ConstraintLookahead(self._fixed, self._m, land, self._eos,
+                                   exhausted=not mask.any())
+
+
+def constraint_lookahead(cst: ConstraintState) -> ConstraintLookahead:
+    """Mint a lookahead cursor positioned at a live request state."""
+    return ConstraintLookahead(cst._fixed, cst._m, cst._state, cst._eos,
+                               exhausted=cst.exhausted)
 
 
 class TokenSetConstraint(Constraint):

@@ -621,6 +621,50 @@ def _build_spec_verify(spec: StepSpec):
         **_shard_kw(spec.shard, 2, "rc"))
 
 
+@register("spec_tree_verify",
+          key=lambda s: ("spec_tree_verify", cfg_key(s.cfg), int(s.k),
+                         s.paged, _shard_key(s.shard)),
+          name=lambda s: f"serving.spec_tree_verify@{s.k}")
+def _build_spec_tree_verify(spec: StepSpec):
+    """Tree-speculation verify: ONE pass over an N-node token tree per
+    slot (tokens [B, N], node 0 = feed token) under a tree-attention
+    mask.  The tree's TOPOLOGY — ancestor-or-self mask [B, N, N] +
+    per-node depths [B, N] — rides as RUNTIME arguments built host-side
+    from the propose step's parent lists, so per-round topology changes
+    never retrace; only the node count N is a compiled shape (it rides
+    ``spec.k``, and ``decode_jit_key`` carries PADDLE_TPU_SPEC_TREE so
+    the recompile watch sees every tree compile).  Einsum-only on both
+    layouts — the flash kernels assume causal masks (on-device tree
+    kernel: ROADMAP follow-up)."""
+    from . import serving
+
+    return jax.jit(
+        lambda p, c, t, m, d, s, _cfg=spec.cfg:
+        serving.spec_tree_verify_batched(p, c, t, m, d, s, _cfg),
+        donate_argnums=donate_cache(),
+        **_shard_kw(spec.shard, 4, "rc"))
+
+
+@register("spec_tree_commit",
+          key=lambda s: ("spec_tree_commit", cfg_key(s.cfg), int(s.k),
+                         s.paged, _shard_key(s.shard)),
+          name=lambda s: f"serving.spec_tree_commit@{s.k}")
+def _build_spec_tree_commit(spec: StepSpec):
+    """Post-acceptance KV permute for tree rounds: per slot, gather the
+    accepted path's rows (``src`` [B, N-1] node indices, identity for
+    slots that accepted a trunk prefix) and write them back contiguously
+    at [pos+1, pos+N).  Cache-only like ``kv_copy`` — same donation
+    idiom (gather-then-scatter inside, so aliasing under donation is
+    safe), no params, no logits; the host skips this dispatch entirely
+    on all-trunk rounds."""
+    from . import serving
+
+    return jax.jit(
+        lambda c, src, s: serving.spec_tree_commit_batched(c, src, s),
+        donate_argnums=donate_cache() and (0,),
+        **_shard_kw(spec.shard, 2, "c", with_params=False))
+
+
 @register("masked_step",
           key=lambda s: ("masked_step", cfg_key(s.cfg), s.paged,
                          _shard_key(s.shard)),
@@ -1125,18 +1169,43 @@ class Engine:
             # (K garbage rows per slot at pos 0 — the same stale-row
             # cover as the plain warm steps) and, in draft mode, the
             # draft's own decode step
-            K = srv._spec_k
-            tokK = jnp.zeros((B, K), jnp.int32)
-            if pool is not None:
-                sfn = self.get("adapter_spec_verify",
-                               tspec(paged=srv._paged, pkey=pk, k=K))
-                warm(f"adapter_spec_verify@{K}", lambda: sfn(
-                    srv.params, srv.cache, ad, ids0, tokK, pos))
+            if getattr(srv, "_spec_tree_n", 0):
+                # tree mode: the tree-masked verify (topology runtime
+                # args: a self-only mask + zero depths compile the same
+                # executable any real tree reuses) plus the acceptance
+                # permute (identity src — rewrites the garbage rows)
+                N = srv._spec_tree_n
+                tokN = jnp.zeros((B, N), jnp.int32)
+                am = jnp.zeros((B, N, N), bool)
+                am = am.at[:, jnp.arange(N), jnp.arange(N)].set(True)
+                dep = jnp.zeros((B, N), jnp.int32)
+                sfn = self.get("spec_tree_verify",
+                               tspec(paged=srv._paged, k=N))
+                warm(f"spec_tree_verify@{N}", lambda: sfn(
+                    srv.params, srv.cache, tokN, am, dep, pos))
+                cfn = self.get("spec_tree_commit",
+                               tspec(paged=srv._paged, k=N))
+                src = jnp.tile(jnp.arange(1, N, dtype=jnp.int32)[None],
+                               (B, 1))
+                t0c = _time.perf_counter()
+                out = cfn(srv.cache, src, pos)
+                jax.block_until_ready(out["k"])
+                srv.cache = out
+                timings[f"spec_tree_commit@{N}"] = round(
+                    _time.perf_counter() - t0c, 3)
             else:
-                sfn = self.get("spec_verify",
-                               tspec(paged=srv._paged, k=K))
-                warm(f"spec_verify@{K}", lambda: sfn(
-                    srv.params, srv.cache, tokK, pos))
+                K = srv._spec_k
+                tokK = jnp.zeros((B, K), jnp.int32)
+                if pool is not None:
+                    sfn = self.get("adapter_spec_verify",
+                                   tspec(paged=srv._paged, pkey=pk, k=K))
+                    warm(f"adapter_spec_verify@{K}", lambda: sfn(
+                        srv.params, srv.cache, ad, ids0, tokK, pos))
+                else:
+                    sfn = self.get("spec_verify",
+                                   tspec(paged=srv._paged, k=K))
+                    warm(f"spec_verify@{K}", lambda: sfn(
+                        srv.params, srv.cache, tokK, pos))
             if srv._draft_cache is not None:
                 dfn = self.get("step", dspec(paged=srv._paged))
                 warm_draft("draft_step", lambda: dfn(

@@ -937,6 +937,194 @@ def verify_chunk_batched(params, cache, tokens, pos, cfg: gpt.GPTConfig):
     return logits.astype(jnp.float32), new_cache
 
 
+# ---------------------------------------------------------------------------
+# tree speculation: ONE verify pass over a branching token tree
+# ---------------------------------------------------------------------------
+
+
+def tree_depths(parent):
+    """Per-node depths [N] int32 of a parent-index tree (parent[0] == -1
+    is the root/feed node; parents precede children — every propose
+    layout in this repo is topologically ordered).  Pure host work."""
+    import numpy as np
+
+    n = len(parent)
+    d = np.zeros(n, np.int32)
+    for j in range(1, n):
+        d[j] = d[parent[j]] + 1
+    return d
+
+
+def tree_ancestor_mask(parent):
+    """Ancestor-or-self mask [N, N] bool of a parent-index tree:
+    ``m[j, t]`` is True iff node t lies on node j's root path (j
+    included) — the within-chunk half of the tree-attention mask.  Built
+    host-side (numpy, one |= per node off the parent's finished row);
+    the device only ever sees the finished mask as a RUNTIME argument,
+    so per-round topology changes never retrace."""
+    import numpy as np
+
+    n = len(parent)
+    m = np.zeros((n, n), bool)
+    for j in range(n):
+        m[j, j] = True
+        if parent[j] >= 0:
+            m[j] |= m[parent[j]]
+    return m
+
+
+def _attend_cache_tree(q, full, tmask, cfg: gpt.GPTConfig):
+    """:func:`_attend_cache` with the causal ``t <= pos + i`` rule
+    replaced by an explicit per-row visibility mask ``tmask`` [B, N, T]
+    (True = attend): each tree node sees the committed prefix plus its
+    OWN ancestor path, nothing from sibling branches.  Einsum-only on
+    purpose — the flash-decode kernels assume causal masks, so tree
+    verify keeps one route that exists on every backend (an on-device
+    tree kernel is a ROADMAP follow-up)."""
+    B, Tq, H, hd = q.shape
+    dt = cfg.dtype
+    k_all, v_all = full["k"], full["v"]
+    ks, vs = full.get("k_s"), full.get("v_s")
+    if ks is not None:
+        from ..ops import decode_attention as da
+
+        k_all = da.dequantize_kv(k_all, ks, dt)
+        v_all = da.dequantize_kv(v_all, vs, dt)
+    k_all = k_all.astype(dt)
+    v_all = v_all.astype(dt)
+    Hkv = k_all.shape[2]
+    g = H // Hkv
+    qg = q.reshape(B, Tq, Hkv, g, hd)
+    scores = jnp.einsum("bikgd,btkd->bkgit", qg, k_all) / jnp.sqrt(
+        jnp.asarray(hd, jnp.float32)).astype(dt)
+    scores = jnp.where(tmask[:, None, None], scores.astype(jnp.float32),
+                       -1e30)
+    w = jax.nn.softmax(scores, axis=-1).astype(dt)
+    return jnp.einsum("bkgit,btkd->bikgd", w, v_all).reshape(B, Tq, -1)
+
+
+def _tree_pre_attn(x, p, pos0, depth, cfg: gpt.GPTConfig):
+    """:func:`_chunk_pre_attn` for a tree chunk: node j ropes at its
+    LOGICAL position ``pos0 + depth[j]`` (depth [N] int32), not its
+    storage index ``pos0 + j`` — siblings at one depth share a
+    position.  Rope's relative-offset property keeps the stored key
+    rows valid after the post-acceptance permute moves a node to the
+    storage index matching its logical position."""
+    q, k_new, v_new = gpt._project_qkv(
+        gpt._norm(x, p, "ln1", cfg), p, cfg, repeat_kv=False)
+    if cfg.pos_embed == "rope":
+        node_pos = pos0 + depth
+        q = gpt.apply_rope(q, node_pos)
+        k_new = gpt.apply_rope(k_new, node_pos)
+    return q, _store_rows(k_new, v_new, cfg)
+
+
+def _tree_attend_block(x, p, csl, pos0, depth, tmask, cfg: gpt.GPTConfig):
+    """One transformer block over an N-node tree chunk stored at rows
+    [pos0, pos0+N) against a per-layer cache slice ``csl`` (leaves k/v
+    [B, T, Hkv, hd] + scales): node j ropes at ``pos0 + depth[j]`` and
+    attends exactly ``tmask[:, j]``.  THE shared body of the contiguous
+    and paged tree verify routes — one copy of the tree math, the
+    :func:`_chunk_attend_block` rule, same PRECONDITION pos0 + N <= T
+    (dynamic_update_slice clamps; callers guarantee the bound)."""
+    dt = cfg.dtype
+    q, rows = _tree_pre_attn(x, p, pos0, depth, cfg)
+    full = {name: jax.lax.dynamic_update_slice(
+                csl[name], val, (0, pos0) + (0,) * (csl[name].ndim - 2))
+            for name, val in rows.items()}
+    attn = _attend_cache_tree(q, full, tmask, cfg)     # [B, N, D]
+    a = woq.mm(attn, p, "proj_w", dt) + p["proj_b"].astype(dt)
+    return gpt._ffn_tail(x + a, p, cfg), rows
+
+
+def tree_verify_chunk(params, cache, tokens, amask, depth, pos0,
+                      cfg: gpt.GPTConfig):
+    """Score one slot's N-node token tree in ONE pass: tokens [1, N]
+    int32 stored at cache rows [pos0, pos0+N) (node 0 = the feed token
+    = the tree root); ``amask`` [1, N, N] bool (ancestor-or-self) and
+    ``depth`` [1, N] int32 describe the topology as RUNTIME arguments —
+    only N is a compiled shape, so per-round topology changes never
+    retrace.  Node j attends the committed rows [0, pos0) plus its own
+    ancestor path inside the chunk; rejected nodes just stay at/past
+    the caller's position pointer as stale rows (the PR 11 invariant),
+    so no rollback executable exists — acceptance off the trunk is a
+    row PERMUTE (:func:`tree_commit_rows`), not an unwrite.  Returns
+    (logits [1, N, V] fp32, cache).  Unused node slots (short trees pad
+    with self-only mask rows) write garbage rows past every live node's
+    visibility — stale by the same invariant.
+
+    MoE: the N nodes would route jointly (the verify_chunk caveat,
+    worse under branching); serving rejects MoE targets before this."""
+    dt = cfg.dtype
+    B, N = tokens.shape
+    T = cache["k"].shape[2]
+    x = woq.embed(params, tokens, dt)
+    if cfg.pos_embed == "learned":
+        x = x + jnp.take(params["wpe"], pos0 + depth[0],
+                         axis=0).astype(dt)[None]
+    tmask = jnp.broadcast_to(jnp.arange(T)[None, None, :] < pos0,
+                             (B, N, T))
+    tmask = jax.lax.dynamic_update_slice(tmask, amask, (0, 0, pos0))
+
+    def body(x, layer):
+        p, csl = layer
+        x, rows = _tree_attend_block(x, p, csl, pos0, depth[0], tmask,
+                                     cfg)
+        return x, rows
+
+    x, rows = jax.lax.scan(body, x, (params["blocks"], cache))
+    new_cache = _write_rows(cache, rows, pos0)
+    x = gpt._norm(x, params, "ln_f", cfg)
+    logits = woq.logits(x, params, dt)
+    return logits.astype(jnp.float32), new_cache
+
+
+def tree_verify_chunk_batched(params, cache, tokens, amask, depth, pos,
+                              cfg: gpt.GPTConfig):
+    """Batched :func:`tree_verify_chunk` over per-slot frontiers:
+    tokens [B, N], amask [B, N, N], depth [B, N], pos [B] int32 ->
+    (logits [B, N, V] fp32, cache).  vmapped at the per-slot [1, N]
+    shapes (rope and the committed-prefix boundary need each slot's own
+    offset); einsum-only — see :func:`_attend_cache_tree`."""
+
+    def one(tok, am, dp, csl, p0):
+        sl = {name: v[:, None] for name, v in csl.items()}
+        lg, nc = tree_verify_chunk(params, sl, tok[None], am[None],
+                                   dp[None], p0, cfg)
+        return lg[0], {n: v[:, 0] for n, v in nc.items()}
+
+    logits, new_cache = jax.vmap(
+        one, in_axes=(0, 0, 0, 1, 0), out_axes=(0, 1))(
+        tokens, amask, depth, cache, pos)
+    return logits.astype(jnp.float32), new_cache
+
+
+def tree_commit_rows(cache, src, pos):
+    """Post-acceptance KV permute for tree speculation on the contiguous
+    layout: per slot b, gather rows ``pos_b + src_b[i]`` and write them
+    back at ``pos_b + 1 + i`` for i in [0, M) (src [B, M] int32, pos [B]
+    the slot's pre-round pointer).  An accepted root-to-leaf path is
+    strictly increasing in node index and every source row sits at or
+    past ``pos_b + 1``, so gather-then-scatter over ALL M rows is
+    alias-safe and needs no keep-mask: identity entries rewrite
+    themselves, and rows past the accepted pointer are stale either way
+    (the PR 11 invariant).  Cache-only — the Engine donates the cache
+    like ``kv_copy``; host code skips the dispatch entirely when every
+    slot accepted a trunk prefix (src == identity everywhere)."""
+    out = {}
+    for name, arr in cache.items():
+
+        def one(arr_b, s, p0, _a=arr):
+            rows = jnp.take(arr_b, p0 + s, axis=1)
+            return jax.lax.dynamic_update_slice(
+                arr_b, rows.astype(_a.dtype),
+                (0, p0 + 1) + (0,) * (arr_b.ndim - 2))
+
+        out[name] = jax.vmap(one, in_axes=(1, 0, 0), out_axes=1)(
+            arr, src, pos)
+    return out
+
+
 def _jit_by_cfg(tag: str, fn, cfg):
     """Engine shim: value-keyed jit cache (the _GEN_CACHE rationale:
     per-call jax.jit wrappers would recompile per invocation and leak
@@ -1030,6 +1218,74 @@ def ngram_propose(sequence, k, max_order=3, window=256):
                     out.append(out[-1])
                 return out
     return None
+
+
+def ngram_propose_tree(sequence, nodes, branch=2, max_order=3,
+                       window=256):
+    """Tree-shaped self-drafting: like :func:`ngram_propose`, but
+    instead of stopping at the first (most recent, longest-order) n-gram
+    match, collect up to ``branch`` DISTINCT continuations and merge
+    them into a prefix trie of at most ``nodes`` node slots — branching
+    exactly where the history itself disagrees about what comes next.
+    Node slot 0 is reserved for the feed token (the caller owns it); the
+    first continuation becomes the TRUNK, laid out as nodes 1..D before
+    any alternate, so a trunk-prefix acceptance needs no KV permute.
+
+    Returns ``(tokens, parent)`` lists — ``tokens[0]`` is None,
+    ``parent[0] == -1``, parents precede children (topological order,
+    what :func:`tree_ancestor_mask` assumes) — or None when no order
+    matches.  May return fewer than ``nodes`` entries; callers pad the
+    device arrays with self-only mask rows (stale, never selected)."""
+    seq = list(sequence)
+    n = len(seq)
+    if n < 2:
+        return None
+    lo = max(0, n - int(window))
+    cap = int(nodes) - 1                     # token-bearing node slots
+    branch = max(1, int(branch))
+    if cap < 1:
+        return None
+    conts, seen = [], set()
+    for order in range(min(int(max_order), n - 1), 0, -1):
+        tail = tuple(seq[n - order:])
+        for s in range(n - order - 1, lo - 1, -1):
+            if tuple(seq[s:s + order]) == tail:
+                c = tuple(seq[s + order:s + order + cap])
+                if c and c not in seen:
+                    seen.add(c)
+                    conts.append(list(c))
+                    if len(conts) >= branch:
+                        break
+        if len(conts) >= branch:
+            break
+    if not conts:
+        return None
+    # the trunk is NOT padded (unused node slots stay idle, masked
+    # self-only by the caller) and leaves one slot per alternate so a
+    # long first match can't starve the branches out of the budget
+    trunk = conts[0][:max(1, cap - (len(conts) - 1))]
+    tokens, parent = [None], [-1]
+    children = {0: {}}
+    for i, t in enumerate(trunk):
+        tokens.append(int(t))
+        parent.append(i)                     # trunk node i+1's parent
+        children[i][int(t)] = i + 1
+        children[i + 1] = {}
+    for c in conts[1:]:                      # graft where they diverge
+        cur = 0
+        for t in c:
+            t = int(t)
+            nxt = children[cur].get(t)
+            if nxt is None:
+                if len(tokens) >= int(nodes):
+                    break
+                tokens.append(t)
+                parent.append(cur)
+                nxt = len(tokens) - 1
+                children[cur][t] = nxt
+                children[nxt] = {}
+            cur = nxt
+    return tokens, parent
 
 
 def speculative_generate(tparams, tcfg, dparams, dcfg, prompt,

@@ -56,7 +56,8 @@ from .. import telemetry as _telemetry
 __all__ = [
     "PoolExhausted", "PagedAllocator", "round_len", "init_paged_cache",
     "paged_decode_step_batched", "paged_prefill_chunk",
-    "paged_verify_chunk_batched", "copy_blocks", "inject_rows",
+    "paged_verify_chunk_batched", "paged_tree_verify_chunk_batched",
+    "paged_tree_commit", "copy_blocks", "inject_rows",
 ]
 
 # the value/scale leaves of a pooled cache (everything except "tables")
@@ -383,6 +384,102 @@ def paged_verify_chunk_batched(params, cache, tokens, pos, cfg):
         v = jnp.moveaxis(v[:, :, 0], 0, 1)                # [L, B, K, ...]
         stacked[n] = v.reshape((v.shape[0], B * K) + v.shape[3:])
     return logits, _scatter_rows(cache, stacked, phys)
+
+
+def paged_tree_verify_chunk_batched(params, cache, tokens, amask, depth,
+                                    pos, cfg: gpt.GPTConfig):
+    """``generate.tree_verify_chunk`` on the pooled layout, batched over
+    slots: tokens [B, N] int32 (node 0 = feed token), amask [B, N, N]
+    ancestor-or-self bool, depth [B, N] int32, pos [B] — ONE pass over
+    each slot's token tree stored at table-translated rows
+    [pos_b, pos_b + N) -> (logits [B, N, V] fp32, cache).
+
+    Per slot this runs ``generate._tree_attend_block`` over the slot's
+    table-gathered view — the EXACT shared tree math the contiguous
+    route runs, so the two layouts cannot drift (and a chain tree
+    reduces to ``paged_verify_chunk_batched``'s fallback bit-for-bit).
+    Topology is a runtime argument; only N is a compiled shape.  Always
+    the einsum route: the flash kernels assume causal masks (see
+    ``generate._attend_cache_tree``).  Rejected nodes land at/past the
+    slot's pointer through the table where the next round overwrites
+    them — the stale-row invariant, unchanged; unmapped or
+    past-the-table entries drop (the standard out-of-bounds sink)."""
+    N, bs, nmax = _geometry(cache)
+    B, K = tokens.shape
+    tables = cache["tables"]
+    pool = {n: cache[n] for n in POOL_LEAVES if n in cache}
+    dt = cfg.dtype
+    T = nmax * bs
+
+    def one(tok_k, am, dp, p0, trow):
+        x = woq.embed(params, tok_k[None], dt)            # [1, K, D]
+        if cfg.pos_embed == "learned":
+            x = x + jnp.take(params["wpe"], p0 + dp,
+                             axis=0).astype(dt)[None]
+        tmask = jnp.broadcast_to(jnp.arange(T)[None, None, :] < p0,
+                                 (1, K, T))
+        tmask = jax.lax.dynamic_update_slice(tmask, am[None], (0, 0, p0))
+
+        def body(x, layer):
+            p, pl = layer
+            csl = {n: _gather_slot(v, trow) for n, v in pl.items()}
+            x, rows = generate._tree_attend_block(x, p, csl, p0, dp,
+                                                  tmask, cfg)
+            return x, rows
+
+        x, rows = jax.lax.scan(body, x, (params["blocks"], pool))
+        x = gpt._norm(x, params, "ln_f", cfg)
+        logits = woq.logits(x, params, dt)[0]             # [K, V]
+        return logits.astype(jnp.float32), rows
+
+    logits, rows = jax.vmap(one, in_axes=(0, 0, 0, 0, 0),
+                            out_axes=(0, 0))(tokens, amask, depth, pos,
+                                             tables)
+    logi = pos[:, None] + jnp.arange(K)[None, :]          # [B, K]
+    tb = jnp.take_along_axis(tables, jnp.clip(logi // bs, 0, nmax - 1),
+                             axis=1)
+    phys = jnp.where((tb >= 0) & (logi // bs < nmax),
+                     tb * bs + logi % bs, N * bs).reshape(B * K)
+    stacked = {}
+    for n, v in rows.items():
+        v = jnp.moveaxis(v[:, :, 0], 0, 1)                # [L, B, K, ...]
+        stacked[n] = v.reshape((v.shape[0], B * K) + v.shape[3:])
+    return logits, _scatter_rows(cache, stacked, phys)
+
+
+def paged_tree_commit(cache, src, pos):
+    """``generate.tree_commit_rows`` on the pooled layout: per slot b,
+    copy the pool rows at logical positions ``pos_b + src_b[i]`` to
+    logical ``pos_b + 1 + i`` (both sides translated through the block
+    table).  Gather-then-scatter per leaf, so in-place aliasing under
+    donation is safe even when source and destination rows share a
+    block; identity entries rewrite themselves and out-of-bounds /
+    unmapped destinations drop (source rows are inside the window the
+    serving tick just ensured blocks for)."""
+    N, bs, nmax = _geometry(cache)
+    B, M = src.shape
+    tables = cache["tables"]
+
+    def phys_of(logi):
+        tb = jnp.take_along_axis(
+            tables, jnp.clip(logi // bs, 0, nmax - 1), axis=1)
+        return jnp.where((tb >= 0) & (logi // bs < nmax),
+                         tb * bs + logi % bs, N * bs)
+
+    src_p = phys_of(pos[:, None] + src).reshape(B * M)
+    dst_p = phys_of(pos[:, None] + 1
+                    + jnp.arange(M)[None, :]).reshape(B * M)
+    out = dict(cache)
+    for name in POOL_LEAVES:
+        if name not in cache:
+            continue
+        arr = cache[name]
+        L, NR = arr.shape[0], arr.shape[1] * arr.shape[2]
+        flat = arr.reshape((L, NR) + arr.shape[3:])
+        rows = flat[:, jnp.clip(src_p, 0, NR - 1)]
+        flat = flat.at[:, dst_p].set(rows, mode="drop")
+        out[name] = flat.reshape(arr.shape)
+    return out
 
 
 def _paged_verify_kernel(params, cache, tokens, pos, cfg: gpt.GPTConfig):

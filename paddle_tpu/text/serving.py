@@ -300,6 +300,62 @@ def _get_spec_verify_fn(cfg: gpt.GPTConfig, k: int, paged: bool = False,
         cfg=cfg, k=int(k), paged=paged, shard=shard))
 
 
+def spec_tree_verify_batched(params, cache, tokens, amask, depth, pos,
+                             cfg: gpt.GPTConfig):
+    """Batched TREE verify: tokens [B, N] int32 (column 0 = each slot's
+    feed token = the tree root, columns 1.. its proposed tree nodes in
+    topological order), ancestor-or-self mask ``amask`` [B, N, N] bool
+    and ``depth`` [B, N] int32 describing each slot's topology as
+    RUNTIME arguments, per-slot positions ``pos`` [B] ->
+    (logits [B, N, V] fp32, cache).  Node j's row scores the
+    continuation of j's root path — row 0 still equals the plain decode
+    step's logits (a chain tree reduces to ``spec_verify_batched``'s
+    fallback bit-for-bit), which is what greedy tree parity rests on.
+
+    Contiguous routes to ``generate.tree_verify_chunk_batched``, paged
+    (a ``tables`` leaf) to ``kv_pool.paged_tree_verify_chunk_batched``
+    — both share ``generate._tree_attend_block`` so the layouts cannot
+    drift.  No kernel route: the flash kernels assume causal masks, so
+    tree verify is einsum-only everywhere (ROADMAP follow-up).  All N
+    rows are written unconditionally; rejected/unused nodes sit at or
+    past the slot's pointer as stale rows (the PR 11 invariant)."""
+    if "tables" in cache:
+        from . import kv_pool
+
+        return kv_pool.paged_tree_verify_chunk_batched(
+            params, cache, tokens, amask, depth, pos, cfg)
+    return generate.tree_verify_chunk_batched(params, cache, tokens,
+                                              amask, depth, pos, cfg)
+
+
+def spec_tree_commit_batched(cache, src, pos):
+    """Post-acceptance KV permute: move each slot's accepted-path rows
+    (``src`` [B, N-1] node indices, identity where nothing moved) to
+    the contiguous rows [pos+1, pos+N) their committed positions
+    require.  Layout-routed like the verify; cache-only (the Engine
+    donates it like ``kv_copy``)."""
+    if "tables" in cache:
+        from . import kv_pool
+
+        return kv_pool.paged_tree_commit(cache, src, pos)
+    return generate.tree_commit_rows(cache, src, pos)
+
+
+def _get_spec_tree_verify_fn(cfg: gpt.GPTConfig, nodes: int,
+                             paged: bool = False, shard=None):
+    """Engine shim: the tree-speculation verify — one executable per
+    (cfg, node count, layout, placement); topology never keys."""
+    return _engine.ENGINE.get("spec_tree_verify", _Spec(
+        cfg=cfg, k=int(nodes), paged=paged, shard=shard))
+
+
+def _get_spec_tree_commit_fn(cfg: gpt.GPTConfig, nodes: int,
+                             paged: bool = False, shard=None):
+    """Engine shim: the tree acceptance KV permute (cache-only)."""
+    return _engine.ENGINE.get("spec_tree_commit", _Spec(
+        cfg=cfg, k=int(nodes), paged=paged, shard=shard))
+
+
 # -- adapter-aware shims (multi-tenant serving: text/adapters.py) ----------
 #
 # Every kind keys on ``pkey`` (AdapterPool.pool_key() — the pool GEOMETRY:
@@ -442,6 +498,7 @@ class DecodeServer:
                  device=None,
                  draft_cfg: gpt.GPTConfig | None = None,
                  draft_params=None, spec_k: int | None = None,
+                 spec_tree: int | None = None,
                  prefill_budget: int | None = None,
                  adapter_pool=None):
         self.params = params
@@ -498,19 +555,43 @@ class DecodeServer:
         # output stays bit-identical to the non-speculative server;
         # per-request rolling acceptance below PADDLE_TPU_SPEC_MIN_ACCEPT
         # falls the slot back to plain decode.
+        # tree speculation (Medusa/SpecInfer shape, round 17): a token
+        # TREE of `spec_tree` node slots per round — n-gram trie or the
+        # draft's top-b fanout — verified in ONE tree-masked pass with
+        # host-side best-path acceptance.  Mutually exclusive with the
+        # linear spec_k round shape; constrained slots SPECULATE in
+        # tree mode (branches the grammar forbids are pruned before the
+        # verify) instead of falling back to plain stepping.
+        if spec_tree is not None:
+            n_tree = int(spec_tree)
+            if n_tree < 0 or n_tree == 1:
+                raise ValueError(
+                    f"spec_tree must be 0 (off) or >= 2 node slots "
+                    f"(node 0 carries the feed token), got {n_tree}")
+        else:
+            n_tree = _flags.spec_tree()
+        self._spec_tree_n = n_tree
+        self._spec_branch = _flags.spec_branch()
         if spec_k is not None:
             k_spec = int(spec_k)
+            if n_tree and k_spec:
+                raise ValueError(
+                    f"spec_k={k_spec} and spec_tree={n_tree} are "
+                    f"mutually exclusive — a round is either a linear "
+                    f"verify or a tree verify")
         else:
-            k_spec = _flags.spec_k()
-            if k_spec == 0 and draft_cfg is not None:
+            # an explicit/env tree budget overrides the env spec_k (one
+            # env flip turns tree mode on without unsetting the other)
+            k_spec = 0 if n_tree else _flags.spec_k()
+            if k_spec == 0 and draft_cfg is not None and not n_tree:
                 k_spec = 4          # passing a draft model IS opting in
         if k_spec < 0:
             raise ValueError(f"spec_k must be >= 0, got {k_spec}")
-        if k_spec == 0 and draft_cfg is not None:
+        if k_spec == 0 and draft_cfg is not None and not n_tree:
             raise ValueError("draft_cfg given but spec_k=0 disables "
                              "speculation — drop one or the other")
         self._spec_k = k_spec
-        self._spec_on = k_spec > 0
+        self._spec_on = k_spec > 0 or n_tree > 0
         self.draft_cfg = draft_cfg
         self._draft_params = draft_params
         self._draft_cache = None
@@ -522,6 +603,8 @@ class DecodeServer:
         self._spec_acc = 0          # ... of those, accepted
         self._spec_rounds = 0       # batched verify dispatches
         self._spec_plain_steps = 0  # plain target steps while spec on
+        self._tree_path_sum = 0     # accepted path tokens (tree rounds)
+        self._tree_path_cnt = 0     # ... over this many slot-rounds
         if self._spec_on:
             window = min(max_len, cfg.max_seq_len)
             if cfg.moe is not None or (draft_cfg is not None
@@ -535,7 +618,18 @@ class DecodeServer:
                     "capacity routing differs between chunked verify "
                     "and stepwise decode — speculative_generate's "
                     "rule)")
-            if not 1 <= k_spec < window:
+            if n_tree:
+                if not 2 <= n_tree < window:
+                    raise ValueError(
+                        f"spec_tree {n_tree} must be in [2, {window}) — "
+                        f"the tree chunk must fit the serving window")
+                if adapter_pool is not None:
+                    raise NotImplementedError(
+                        "spec_tree with an adapter_pool is not "
+                        "supported yet (the tree verify kind has no "
+                        "adapter-gathered twin); linear spec_k composes "
+                        "with pools")
+            elif not 1 <= k_spec < window:
                 raise ValueError(
                     f"spec_k {k_spec} must be in [1, {window}) — the "
                     f"verify chunk must fit the serving window")
@@ -1905,6 +1999,12 @@ class DecodeServer:
             lim = min(lim, drows, self.draft_cfg.max_seq_len)
         return lim
 
+    def _spec_chunk(self) -> int:
+        """Cache rows one speculative round writes per slot — the tree
+        node budget in tree mode, the linear chunk K otherwise (both
+        counts include the fed root/feed row)."""
+        return self._spec_tree_n or self._spec_k
+
     def _spec_ready(self) -> bool:
         """Whether THIS tick can run as a speculative round: every slot
         past its prompt (the verify chunk consumes feedback positions
@@ -1913,16 +2013,20 @@ class DecodeServer:
         rounds are pure overhead)."""
         if not self._spec_on or not self._slots:
             return False
-        if self._constrained_active():
-            # constrained slots fall back to plain stepping for the
-            # whole batch: draft tokens can't be masked cheaply (each
-            # proposal would need the automaton advanced host-side
-            # mid-chunk), and an unmasked draft's acceptances could
-            # emit banned tokens.  Documented fallback — tested.
+        if self._constrained_active() and not self._spec_tree_n:
+            # LINEAR mode: constrained slots fall back to plain
+            # stepping for the whole batch — draft tokens can't be
+            # masked cheaply (each proposal would need the automaton
+            # advanced host-side mid-chunk), and an unmasked draft's
+            # acceptances could emit banned tokens.  Tree mode lifts
+            # this: proposals are walked through a lookahead cursor
+            # and grammar-banned branches pruned BEFORE the verify
+            # pass (_prune_branches_constrained), so constrained
+            # slots speculate and this counter stays at zero.
             if self._tel:
                 _telemetry.count("constraint.spec_fallbacks")
             return False
-        K = self._spec_k
+        K = self._spec_chunk()
         lim = self._spec_limit()
         alive = False
         for st in self._slots.values():
@@ -1933,7 +2037,23 @@ class DecodeServer:
                 return False
             if st["pos"] + K > lim:
                 return False
-            if not st.get("spec_off"):
+            if st.get("spec_off"):
+                # re-earn: a fallen-back slot sits out a cooldown of
+                # spec-eligible rounds, then rejoins with a FRESH
+                # acceptance window (the old window's verdict was
+                # about a different region of the sequence).  The
+                # cooldown doubles per trip (16 → 256 cap), so a
+                # persistently unpredictable request converges to
+                # plain decode while a request that merely passed
+                # through a hard patch re-earns its speculation.
+                st["spec_cool"] = st.get("spec_cool", 1) - 1
+                if st["spec_cool"] <= 0:
+                    st["spec_off"] = False
+                    st["spec_prop"] = st["spec_acc"] = 0
+                    alive = True
+                    if self._tel:
+                        _telemetry.count("spec.reearns")
+            else:
                 alive = True
         return alive
 
@@ -2167,16 +2287,26 @@ class DecodeServer:
         speculating (row-0-only rounds — still bit-correct, no longer
         paying proposal work).  The window decays by halving so the
         rate tracks the request's RECENT regime, not its whole
-        history."""
+        history.
+
+        The window's unit is the ACCEPTED-PATH LENGTH a round could
+        have delivered — K-1 drafted tokens in linear mode, the
+        deepest live root-to-leaf path in tree mode — not the raw
+        linear K, so tree-mode slots fall back (and later re-earn, see
+        _spec_ready) on exactly the same accept-rate contract."""
         if st.get("spec_off") or not st.get("spec_prop"):
             return
-        k = max(1, self._spec_k - 1)
+        k = max(1, (self._spec_tree_n or self._spec_k) - 1)
         if st["spec_prop"] >= 16 * k:
             st["spec_prop"] //= 2
             st["spec_acc"] //= 2
         if st["spec_prop"] >= 4 * k \
                 and st["spec_acc"] / st["spec_prop"] < self._min_accept:
             st["spec_off"] = True
+            # next re-earn waits twice as long as the last one did
+            st["spec_cool"] = cool = min(256,
+                                         2 * st.get("spec_cool0", 8))
+            st["spec_cool0"] = cool
             if self._tel:
                 _telemetry.count("spec.fallbacks")
 
@@ -2191,6 +2321,8 @@ class DecodeServer:
         warmup garbage and slot reuse), so acceptance needs no masked
         write and no rollback: after a rejection the next round's
         writes start exactly at the first stale row."""
+        if self._spec_tree_n:
+            return self._tick_spec_tree()
         if self._inflight is not None:
             # async servers run spec rounds synchronously: the pending
             # dispatch's tokens are real work — fetch them first
@@ -2273,6 +2405,455 @@ class DecodeServer:
         for slot in failed:
             st = self._slots.pop(slot)
             self._fail_request(st, slot, "non-finite spec-verify logits")
+        steps = max([kept for _, kept in appended], default=1)
+        self._tel_tokens(appended, t0, steps=max(steps, 1), kind=kind)
+        self._retire(done)
+
+    # -- draft-tree speculation: one verify pass over a token tree ----------
+
+    def _spec_tree_propose(self):
+        """Build each eligible slot's proposal tree.
+
+        Returns {slot: tree}, where a tree is a dict with ``tokens``
+        (index 0 is the ROOT — the feed token, already fed, so its
+        entry is None), ``parent`` (parent[0] == -1, topological
+        order), ``depth``, ``live`` (False == pruned, the node stays
+        in the dispatched arrays but no acceptance path may use it),
+        ``children`` ({node: [live kids, proposal order]}), and in
+        draft mode ``trunk``/``dsteps``/``qs`` (the draft's base law
+        per depth, for the sampled acceptance test).
+
+        Self-draft: :func:`generate.ngram_propose_tree` merges up to
+        ``branch`` DISTINCT n-gram continuations into one prefix trie.
+        Draft mode: :meth:`_spec_tree_propose_draft` lays a trunk and
+        fans siblings out at the draft's least-confident positions.
+        Constrained slots then get grammar-forbidden subtrees pruned
+        BEFORE the verify pass — the tree dispatched for them carries
+        only tokens their automaton allows."""
+        N = self._spec_tree_n
+        b = max(1, min(self._spec_branch, N - 1))
+        if self._self_draft:
+            props = {}
+            hits = miss = 0
+            for slot, st in self._slots.items():
+                if st.get("spec_off"):
+                    continue
+                base = st.get("base", len(st["prompt"]))
+                seq = st["prompt"][:base] + st["generated"]
+                t = generate.ngram_propose_tree(seq, N, branch=b)
+                if t is not None:
+                    props[slot] = {"tokens": list(t[0]),
+                                   "parent": list(t[1])}
+                    hits += 1
+                else:
+                    miss += 1
+            if self._tel and hits:
+                _telemetry.count("spec.ngram_hits", hits)
+            if self._tel and miss:
+                _telemetry.count("spec.ngram_misses", miss)
+        else:
+            props = self._spec_tree_propose_draft(N, b)
+        total = 0
+        for slot, tp in props.items():
+            n = len(tp["tokens"])
+            tp["depth"] = generate.tree_depths(tp["parent"])
+            tp["live"] = [True] * n
+            total += n - 1
+            st = self._slots[slot]
+            if st.get("constraint") is not None:
+                self._prune_branches_constrained(st, tp)
+            kids: dict = {}
+            for j in range(1, n):
+                if tp["live"][j]:
+                    kids.setdefault(tp["parent"][j], []).append(j)
+            tp["children"] = kids
+        if self._tel and total:
+            _telemetry.count("spec.tree_nodes_proposed", total)
+        return props
+
+    def _spec_tree_propose_draft(self, N, b):
+        """Draft-model tree proposals: D = ceil((N-1)/b) batched draft
+        steps lay a TRUNK (greedy: the draft's argmax chain; sampled:
+        draws from its filtered law q, recorded for the acceptance
+        test), then the remaining N-1-D node slots fan out as sibling
+        leaves at the trunk positions where the draft was LEAST sure
+        (smallest top-1/top-2 margin greedy, smallest chosen-token
+        probability sampled) — branching exactly where linear
+        speculation actually dies.  Greedy siblings take the draft's
+        top-2..b tokens; sampled siblings are drawn from q WITHOUT
+        replacement, so child i+1 at a node is distributed as the
+        i-times-rejection-renormalized law the SpecInfer acceptance
+        chain (_spec_tree_sampled) replays.  Counts spec.draft_steps
+        once per batched draft dispatch, like the linear path."""
+        self._spec_draft_catchup()
+        step = _get_step_fn(self.draft_cfg, self._paged,
+                            self._draft_shard)
+        tok, pos = self._feed_arrays()
+        temp, tk, tp_ = self._sampling_arrays()
+        eligible = {slot: st for slot, st in self._slots.items()
+                    if not st.get("spec_off")}
+        D = max(1, -(-(N - 1) // b))
+        rec = {slot: {"trunk": [], "alts": [], "margins": [],
+                      "qs": [] if temp[slot] > 0 else None}
+               for slot in eligible}
+        for _ in range(D):
+            logits, self._draft_cache = step(
+                self._draft_params, self._draft_cache,
+                jnp.asarray(tok), jnp.asarray(pos))
+            if self._tel:
+                _telemetry.count("spec.draft_steps")
+            lnp = np.asarray(logits)
+            for slot, st in eligible.items():
+                r = rec[slot]
+                row = lnp[slot]
+                if r["qs"] is None:
+                    order = np.argsort(row)[::-1][:max(b, 2)]
+                    d = int(order[0])
+                    alts = [int(x) for x in order[1:b]]
+                    r["margins"].append(
+                        float(row[order[0]] - row[order[1]]))
+                else:
+                    q = generate._filtered_probs(
+                        row, float(temp[slot]), int(tk[slot]),
+                        float(tp_[slot]))
+                    rng = self._spec_rng(st)
+                    d = int(rng.choice(len(q), p=q))
+                    r["qs"].append(q)
+                    alts = []
+                    qq = q.copy()
+                    last = d
+                    for _a in range(b - 1):
+                        qq[last] = 0.0
+                        m = float(qq.sum())
+                        if m <= 0.0:
+                            break
+                        last = int(rng.choice(len(qq), p=qq / m))
+                        alts.append(last)
+                    # low chosen-prob == much residual mass elsewhere
+                    r["margins"].append(float(q[d]))
+                r["trunk"].append(d)
+                r["alts"].append(alts)
+                tok[slot] = d
+            pos = pos + 1
+        props = {}
+        for slot, r in rec.items():
+            toks: list = [None]
+            parent = [-1]
+            for i, t in enumerate(r["trunk"]):
+                toks.append(int(t))
+                parent.append(i)      # trunk node i+1 sits at depth i+1
+            budget = N - 1 - len(r["trunk"])
+            order = np.argsort(np.asarray(r["margins"], np.float64),
+                               kind="stable")
+            for i in order:
+                if budget <= 0:
+                    break
+                for a in r["alts"][int(i)]:
+                    if budget <= 0:
+                        break
+                    if a == r["trunk"][int(i)]:
+                        continue
+                    toks.append(int(a))
+                    parent.append(int(i))   # sibling of trunk node i+1
+                    budget -= 1
+            props[slot] = {"tokens": toks, "parent": parent,
+                           "trunk": [int(t) for t in r["trunk"]],
+                           "dsteps": len(r["trunk"]),
+                           "qs": r["qs"]}
+        return props
+
+    def _prune_branches_constrained(self, st, tp):
+        """Host DFA lookahead over one slot's proposed tree BEFORE the
+        verify pass: walk :func:`adapters.constraint_lookahead` cursors
+        down the trie from the request's CURRENT automaton state (never
+        mutated — acceptance advances the real state through
+        _constraint_push like every other path) and mark every node
+        whose token the grammar forbids — plus its whole subtree —
+        dead.  Pruned nodes still occupy rows in the compiled dispatch
+        (shapes are trace keys), but they leave the host-side candidate
+        set, so no acceptance path can emit a banned token and
+        ``constraint.spec_fallbacks`` stays untouched in tree mode."""
+        from . import adapters as _ad
+
+        cst = st.get("constraint")
+        tokens, parent, live = tp["tokens"], tp["parent"], tp["live"]
+        cursors = {0: _ad.constraint_lookahead(cst)}
+        pruned = 0
+        for j in range(1, len(tokens)):
+            pl = cursors.get(parent[j])
+            if pl is None or not pl.allows(tokens[j]):
+                live[j] = False       # parent dead, or token banned
+                pruned += 1
+                continue
+            cursors[j] = pl.child(tokens[j])
+        if pruned and self._tel:
+            _telemetry.count("spec.tree_pruned_constrained", pruned)
+
+    def _spec_tree_accept(self, st, rows, tp):
+        """Resolve one slot's tree-verify logits [N, V] into the token
+        list this round appends plus the accepted node-index path.
+
+        Greedy: walk from the root; each visited node's target row
+        (constraint-masked for constrained slots — np.where over the
+        same fp32 values the masked plain step argmaxes, so every
+        appended token equals stepwise masked greedy decode on the
+        same prefix) yields an argmax; descend into the live child
+        carrying that token.  The first miss appends the target's own
+        choice — the "correction" IS the plain-decode token — and a
+        leaf's choice is the bonus, so the walk always emits at least
+        one token (the plain-decode floor).  Sampled: SpecInfer
+        sequential multi-child rejection per node
+        (:meth:`_spec_tree_sampled`) preserves the target law exactly.
+
+        Constrained automata are NOT advanced here: the lookahead
+        cursor only shapes masks; the tick loop pushes every appended
+        token through _constraint_push exactly like the plain path.
+        The rolling fallback window advances in PATH-LENGTH units —
+        proposed = the deepest live root-to-leaf depth this round
+        offered, accepted = edges actually taken."""
+        from . import adapters as _ad
+
+        if tp is None:
+            tokens: list = [None]
+            depth = [0]
+            children: dict = {}
+            live = [True]
+            qs = None
+        else:
+            tokens, depth = tp["tokens"], tp["depth"]
+            children, live = tp["children"], tp["live"]
+            qs = tp.get("qs")
+        cst = st.get("constraint")
+        look = (_ad.constraint_lookahead(cst)
+                if cst is not None else None)
+        sampled = st.get("temperature", 0.0) > 0.0
+        cur = 0
+        toks: list = []
+        sel: list = []
+        while True:
+            if look is not None and look.exhausted:
+                break                 # automaton completed mid-path
+            row = rows[cur]
+            if look is not None:
+                row = _ad.apply_constraint_host(row, look)
+            kids = children.get(cur, [])
+            if sampled:
+                t, child = self._spec_tree_sampled(st, row, kids,
+                                                   tokens, depth, qs,
+                                                   look)
+            else:
+                t = int(row.argmax())
+                child = next((j for j in kids if tokens[j] == t), None)
+            toks.append(t)
+            if look is not None:
+                look = look.child(t)
+            if child is None:
+                break
+            sel.append(child)
+            cur = child
+        maxd = max((int(depth[j]) for j in range(len(tokens))
+                    if live[j]), default=0)
+        if maxd:
+            self._spec_prop += maxd
+            self._spec_acc += len(sel)
+            st["spec_prop"] = st.get("spec_prop", 0) + maxd
+            st["spec_acc"] = st.get("spec_acc", 0) + len(sel)
+            if self._tel:
+                _telemetry.count("spec.proposed", maxd)
+                if sel:
+                    _telemetry.count("spec.accepted", len(sel))
+        if self._tel and sel:
+            _telemetry.count("spec.tree_nodes_accepted", len(sel))
+        self._tree_path_sum += len(sel)
+        self._tree_path_cnt += 1
+        return toks, sel
+
+    def _spec_tree_sampled(self, st, row, kids, tokens, depth, qs,
+                           look):
+        """SpecInfer-style sequential multi-candidate rejection at ONE
+        tree node: children x_1..x_m (proposal order) are tested in
+        turn against the target law p — accept x_i with probability
+        min(1, p(x_i)/q_i(x_i)), where q_1 is the draft's base law at
+        this depth and every rejection updates BOTH sides: p becomes
+        norm((p - q_i)+) and q_{i+1} becomes norm(q_i with x_i zeroed),
+        the very law the proposer drew x_{i+1} from (without-
+        replacement draws).  All children rejected -> sample the final
+        residual (the correction); no children -> sample p (the
+        bonus).  Telescoping the per-child terms shows every emitted
+        token is distributed exactly as p — the single-child case
+        reduces to the linear path's Leviathan test bit-for-bit.
+
+        Self-draft trees carry no qs: each child is a POINT MASS
+        (q_i = 1 at x_i), so accept with probability p(x_i) and zero
+        x_i out of the residual — exact for ANY proposal choice, which
+        is what the constraint-pruned trie rides on.  Constrained
+        draft-model slots condition q on the automaton mask (the
+        proposal survived pruning, so its law GIVEN survival is q
+        restricted to the allowed set, renormalized) while p is
+        already the masked filtered law — the masked target law is
+        preserved exactly.  Returns (token, accepted child or None)."""
+        rng = self._spec_rng(st)
+        p = generate._filtered_probs(row, float(st["temperature"]),
+                                     int(st["top_k"]),
+                                     float(st["top_p"]))
+        p0 = p
+        q = None
+        if qs is not None and kids:
+            q = np.asarray(qs[int(depth[kids[0]]) - 1], np.float64)
+            if look is not None:
+                q = q * look.allowed_mask()
+                m = float(q.sum())
+                q = q / m if m > 0.0 else None
+        for x_node in kids:
+            x = tokens[x_node]
+            if qs is not None:
+                if q is None:
+                    break             # proposer's law exhausted
+                qx = float(q[x])
+                if qx <= 0.0:
+                    continue          # proposer can't have drawn this
+                if float(rng.uniform()) < min(1.0, float(p[x]) / qx):
+                    return int(x), x_node
+                p = np.maximum(p - q, 0.0)
+                pm = float(p.sum())
+                q = q.copy()
+                q[x] = 0.0
+                qm = float(q.sum())
+                q = q / qm if qm > 0.0 else None
+                if pm <= 0.0:
+                    p = None
+                    break
+                p = p / pm
+            else:
+                if float(rng.uniform()) < float(p[x]):
+                    return int(x), x_node
+                p = p.copy()
+                p[x] = 0.0
+                pm = float(p.sum())
+                if pm <= 0.0:
+                    p = None
+                    break
+                p = p / pm
+        if p is None:
+            # numerically empty residual: fall back to the target law
+            # itself, as the linear sampled path does
+            p = p0
+        return int(rng.choice(len(p), p=p)), None
+
+    def _tick_spec_tree(self):
+        """One TREE speculative round: propose a token tree per slot,
+        prune grammar-forbidden branches, ONE tree-masked target pass
+        over all slots, host best-path acceptance, a KV row permute
+        for paths that left the trunk, retire.  Same skeleton as
+        _tick_spec — one target pass per round is the headline metric
+        — but acceptance can follow BRANCHES, so a single pass keeps
+        tokens a linear draft of the same row budget loses at its
+        first divergence.  The ancestor mask and depths are runtime
+        arguments: topology changes round to round, the compiled
+        executable keys only on the node COUNT."""
+        if self._inflight is not None:
+            self._drain_inflight()
+            if not self._slots:
+                return
+        t0 = time.perf_counter()
+        N = self._spec_tree_n
+        # rows [pos, pos+N) per slot BEFORE any state mutates (the OOM
+        # retry rule); the commit permute writes inside [pos+1, pos+N)
+        # — covered by the same reservation
+        self._ensure_decode_blocks(N)
+        props = self._spec_tree_propose()
+        tok, pos = self._feed_arrays()
+        tokN = np.repeat(tok[:, None], N, axis=1)
+        amask = np.zeros((self.max_batch, N, N), bool)
+        amask[:, np.arange(N), np.arange(N)] = True  # idle rows: self
+        depth = np.zeros((self.max_batch, N), np.int32)
+        for slot, tp in props.items():
+            n = len(tp["tokens"])
+            for j in range(1, n):
+                tokN[slot, j] = tp["tokens"][j]
+            amask[slot, :n, :n] = generate.tree_ancestor_mask(
+                tp["parent"])
+            depth[slot, :n] = tp["depth"]
+        kind = f"spec_tree_verify@{N}"
+        self._fault_check(kind)
+        fn = _get_spec_tree_verify_fn(self.cfg, N, self._paged,
+                                      self._shard)
+        logits, self.cache = fn(self.params, self.cache,
+                                jnp.asarray(tokN), jnp.asarray(amask),
+                                jnp.asarray(depth), jnp.asarray(pos))
+        self._step_no += 1
+        self._spec_rounds += 1
+        if self._tel:
+            _telemetry.count("spec.tree_rounds")
+        lnp = np.asarray(logits)  # the round's one device->host fetch
+        failed = []
+        if self._resil and (_faults.active()
+                            or _os.environ.get(
+                                "PADDLE_TPU_NAN_GUARD_SERVING",
+                                "") == "1"):  # noqa: E129
+            if _faults.active():
+                lnp = _faults.corrupt_nan("logits", lnp)
+            finite = np.isfinite(lnp).all(axis=(-2, -1))
+            failed = [s for s in self._slots if not finite[s]]
+        done = []
+        appended = []
+        commit_src = None
+        for slot, st in self._slots.items():
+            if slot in failed:
+                continue
+            toks, sel = self._spec_tree_accept(st, lnp[slot],
+                                               props.get(slot))
+            if sel and any(s != i + 1 for i, s in enumerate(sel)):
+                # the accepted path left the trunk: permute its rows
+                # into the contiguous committed positions.  Trunk(-
+                # prefix) acceptances skip this — the proposer lays the
+                # trunk at node indices 1..D, already the committed
+                # layout — so pure-chain trees never dispatch a commit
+                if commit_src is None:
+                    commit_src = np.tile(
+                        np.arange(1, N, dtype=np.int32),
+                        (self.max_batch, 1))
+                commit_src[slot, :len(sel)] = sel
+            old = st["pos"]
+            kept = 0
+            for t in toks:
+                st["generated"].append(t)
+                st["pos"] += 1
+                kept += 1
+                fin = self._constraint_push(st, t)
+                if self._finished(st, t) or fin:
+                    done.append(slot)
+                    break
+            appended.append((st, kept))
+            if self._draft_cache is not None \
+                    and not st.get("spec_off"):
+                # draft rows [old, old+D) were fed feed+trunk tokens
+                # this round; they stay valid through the committed
+                # prefix that AGREES with the trunk (a branch
+                # acceptance diverges earlier than a linear round's
+                # cap) — catch-up re-feeds the rest next round
+                tp = props.get(slot, {})
+                trunk = tp.get("trunk", [])
+                agree = 0
+                for a, bt in zip(toks, trunk):
+                    if a != bt:
+                        break
+                    agree += 1
+                dsteps = tp.get("dsteps", 1)
+                st["spec_dpos"] = min(
+                    st["pos"], old + 1 + min(agree, dsteps - 1))
+            self._spec_fallback_check(st)
+        if commit_src is not None:
+            # dispatched with the PRE-ROUND pos array: failed slots
+            # keep identity rows, accepted slots permute [pos+1, ...)
+            cfn = _get_spec_tree_commit_fn(self.cfg, N, self._paged,
+                                           self._shard)
+            self.cache = cfn(self.cache, jnp.asarray(commit_src),
+                             jnp.asarray(pos))
+        for slot in failed:
+            st = self._slots.pop(slot)
+            self._fail_request(st, slot,
+                               "non-finite spec-tree-verify logits")
         steps = max([kept for _, kept in appended], default=1)
         self._tel_tokens(appended, t0, steps=max(steps, 1), kind=kind)
         self._retire(done)
@@ -2439,6 +3020,13 @@ class DecodeServer:
             # this replica's speculation is paying for itself
             "spec_accept_rate": ((self._spec_acc / self._spec_prop)
                                  if self._spec_prop else None),
+            # tree mode: mean accepted root-to-leaf path length per
+            # verify round (tokens committed beyond the plain-decode
+            # floor ≈ this value) — None off tree mode / before the
+            # first round
+            "spec_tree_accept_len": (
+                (self._tree_path_sum / self._tree_path_cnt)
+                if self._tree_path_cnt else None),
             # admission-control verdict: the degradation ladder rung
             # (0 = healthy) — the fleet router folds the worst replica
             # rung into its OWN controller (absorb_fleet_rung) and
@@ -2641,6 +3229,10 @@ class DecodeServer:
         if self._spec_on and self._spec_prop:
             _telemetry.set_gauge("serving.spec_accept_rate",
                                  self._spec_acc / self._spec_prop)
+        if self._spec_tree_n and self._tree_path_cnt:
+            _telemetry.set_gauge(
+                "serving.spec_tree_accept_len",
+                self._tree_path_sum / self._tree_path_cnt)
         # kv_utilization = TRUE occupancy (round 8): under the paged
         # layout, blocks actually mapped / pool size; under contiguous,
         # filled rows / the slab's real (rounded) allocation — the old
@@ -3510,7 +4102,7 @@ class DecodeServer:
                 # the block's work with the same one-fetch-per-dispatch
                 # cadence (early exit when slots retire or the window
                 # edge forces plain ticks)
-                for _ in range(max(1, -(-block // self._spec_k))):
+                for _ in range(max(1, -(-block // self._spec_chunk()))):
                     if not self._slots or not self._spec_ready():
                         break
                     self._tick_spec()

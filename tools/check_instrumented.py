@@ -99,9 +99,15 @@ FLEET_MARKERS = ("route", "shed", "drain", "handoff")
 # callable) — the acceptance rate drives the fallback knob, the bench
 # arm's passes-per-token, and the router's per-replica gauge, so a
 # silent accept/reject path skews the very signal that decides whether
-# speculation pays for itself.
+# speculation pays for itself.  Round 17 extends the marker family to
+# the tree round: every tree propose/accept and constrained branch-
+# prune path must count (spec.tree_nodes_proposed / tree_nodes_accepted
+# / tree_pruned_constrained) — the accepted-path-length gauge and the
+# fallbacks==0 contract for constrained workloads hang off exactly
+# these sites.
 SPEC_FILE = os.path.join("paddle_tpu", "text", "serving.py")
-SPEC_MARKERS = ("spec_accept", "spec_propose", "spec_fallback")
+SPEC_MARKERS = ("spec_accept", "spec_propose", "spec_fallback",
+                "tree_propose", "tree_accept", "prune_branch")
 
 # budgeted-admission lint (round 12, same rule family): every
 # chunked-prefill co-scheduling path in serving.py — the claim, the
