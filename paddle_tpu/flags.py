@@ -676,6 +676,76 @@ def fleet_max_queue() -> int:
         return 2
 
 
+def stream_chunk_rows() -> int:
+    """Prefill rows per streamed handoff chunk
+    (``PADDLE_TPU_STREAM_CHUNK_ROWS``, default 256; 0 restores the
+    monolithic whole-walk reply).  A prefill worker walks prompts longer
+    than this through the offset-aware chunk executables and ships each
+    finished chunk's cache rows over the raw transport WHILE computing
+    the next one; the decode side injects each chunk through the
+    existing pow2 injector buckets between its own ticks — transfer
+    overlaps both ends, cutting handoff TTFT.  Host scheduling only,
+    never a jit-cache key: the chunk width is rounded to a power of two
+    so the executables come from the same bucketed families warmup
+    already covers."""
+    try:
+        return max(0, int(os.environ.get("PADDLE_TPU_STREAM_CHUNK_ROWS",
+                                         "256")))
+    except ValueError:
+        return 256
+
+
+def fleet_autoscale() -> bool:
+    """Telemetry-driven elastic fleet scaling
+    (``PADDLE_TPU_FLEET_AUTOSCALE``, default off).  When on, the router
+    watches the fleet's worst ``admission_rung`` each tick: sustained
+    degradation (>= ``PADDLE_TPU_FLEET_SCALE_RUNG`` for
+    ``PADDLE_TPU_FLEET_SCALE_OUT_TICKS`` consecutive ticks) attaches a
+    registered spare replica; a sustained fully-idle fleet
+    (``PADDLE_TPU_FLEET_SCALE_IN_TICKS`` ticks) drains the youngest
+    replica back to the spare pool.  Host scheduling only."""
+    v = os.environ.get("PADDLE_TPU_FLEET_AUTOSCALE", "0").strip().lower()
+    return v not in ("0", "false", "off", "no", "")
+
+
+def fleet_scale_rung() -> int:
+    """Degradation rung that arms scale-out
+    (``PADDLE_TPU_FLEET_SCALE_RUNG``, default 2): the fleet's worst
+    replica ``admission_rung`` must sit at or above it.  Host scheduling
+    only."""
+    try:
+        return max(1, int(os.environ.get("PADDLE_TPU_FLEET_SCALE_RUNG",
+                                         "2")))
+    except ValueError:
+        return 2
+
+
+def fleet_scale_out_ticks() -> int:
+    """Consecutive over-rung router ticks before a spare attaches
+    (``PADDLE_TPU_FLEET_SCALE_OUT_TICKS``, default 3) — the sustain
+    window that keeps one histogram blip from flapping the fleet.  Host
+    scheduling only."""
+    try:
+        return max(1, int(os.environ.get(
+            "PADDLE_TPU_FLEET_SCALE_OUT_TICKS", "3")))
+    except ValueError:
+        return 3
+
+
+def fleet_scale_in_ticks() -> int:
+    """Consecutive fully-idle router ticks before the youngest replica
+    drains back to the spare pool
+    (``PADDLE_TPU_FLEET_SCALE_IN_TICKS``, default 50).  Scale-in is
+    deliberately much slower than scale-out: attaching a spare is
+    cheap, re-warming a drained replica's executables is not.  Host
+    scheduling only."""
+    try:
+        return max(1, int(os.environ.get(
+            "PADDLE_TPU_FLEET_SCALE_IN_TICKS", "50")))
+    except ValueError:
+        return 50
+
+
 def telemetry_enabled() -> bool:
     """Runtime telemetry master switch (ON by default).
 

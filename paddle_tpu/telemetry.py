@@ -46,7 +46,21 @@ built on the seeds in :mod:`paddle_tpu.profiler` (host spans) and
    bytes held by the spill tier) ride ``load_stats()`` and the
    Prometheus export, and the same lint requires every
    ``*split*``/``*spill*``/``*restore*``/``*prefix_route*`` path in
-   kv_pool/fleet to count or delegate.
+   kv_pool/fleet to count or delegate.  The elastic-fleet streaming
+   transport (round 18) adds the STREAM family:
+   ``fleet.stream_chunks`` / ``fleet.stream_bytes`` (raw KV chunk
+   frames a prefill worker shipped, and their payload bytes),
+   ``fleet.stream_aborts`` (half-streamed handoffs torn down on worker
+   death / TTL / replica removal), ``fleet.scale_outs`` /
+   ``fleet.scale_ins`` (autoscale topology moves; ``fleet.replicas``
+   gauges LIVE replicas), ``fleet.replica_adds`` /
+   ``fleet.replica_removes`` (every live attach/detach, autoscaled or
+   operator-driven), and ``kv_pool.chain_migrations`` /
+   ``kv_pool.chain_migrations_out`` (spilled prefix chains adopted
+   from / shipped to another replica over the raw transport); the lint
+   covers every ``*stream*``/``*scale_out*``/``*scale_in*``/
+   ``*migrate*`` path in fleet/kv_pool and bans ``pickle.`` call sites
+   in fleet.py outright.
 2. **Training step telemetry** — ``Model.fit`` / ``TrainStep`` emit
    step-time and throughput histograms, and the fit loop's host-sync
    count lands in the shared counter registry via the

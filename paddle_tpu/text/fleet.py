@@ -34,15 +34,21 @@ pieces earlier rounds built:
   (``fleet.*`` counters/gauges land in the shared registry, so
   ``Router(metrics_port=...)`` serves them next to the serving feeds).
 
-Transport frames are pickled python objects: the links carry model
-activations between co-owned processes — the SAME trust domain as the
-weights.  Never expose a transport port beyond that domain.
+Transport frames are a dtype-tagged raw-row streaming protocol — a
+compact JSON/struct header (leaf names, shapes, dtypes, rid, chunk
+index) followed by contiguous raw buffer frames (``memoryview`` from
+the sender's numpy rows straight to the socket, reassembled into
+writable buffers for ``device_put``).  NOTHING on the wire is pickled:
+the control plane is JSON, the data plane raw bytes, so a compromised
+peer can corrupt rows but never execute code in the receiver.  The
+links still carry model activations between co-owned processes (the
+weights' trust domain) — never expose a transport port beyond it.
 """
 from __future__ import annotations
 
 import concurrent.futures
 import contextlib
-import pickle
+import json
 import queue
 import socket
 import struct
@@ -106,46 +112,194 @@ class LoopbackTransport:
         self.worker = _QueueEndpoint(b, a)
 
 
-# a frame the peer started but never finished within this budget is a
-# dead link, not a slow one
+# a frame (or a headered message awaiting its buffer frames) the peer
+# started but never finished within this budget is a dead link, not a
+# slow one
 _FRAME_BUDGET_S = 30.0
+
+# typed wire frames: 1-byte frame type + 8-byte big-endian body length.
+# A message is ONE header frame (JSON: the object tree with every
+# ndarray leaf replaced by a {"__nd__", "shape", "dtype"} descriptor)
+# followed by exactly header["nbufs"] raw buffer frames, one per
+# descriptor, in index order.  The data plane never touches a
+# serializer: buffer bodies go out as memoryviews of the sender's
+# contiguous numpy rows and come back as writable bytearrays the
+# receiver wraps with np.frombuffer — ready for device_put with zero
+# further copies.
+_F_HDR = 1
+_F_BUF = 2
+_FRAME_PREFIX = struct.Struct(">BQ")
+
+
+def _np_dtype(name: str):
+    """Resolve a wire dtype name, including the ml_dtypes extension
+    types (bfloat16 & friends) plain numpy does not know."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _encode_msg(obj):
+    """Split one message into (json_header_bytes, [ndarray, ...]).
+
+    The header is the object tree with ndarray leaves swapped for
+    buffer descriptors; the arrays ride separately as raw frames.
+    Only JSON-safe scalars, lists/tuples, string-keyed dicts and
+    ndarrays are legal — anything else is a protocol bug and raises
+    (never a silent pickle fallback)."""
+    bufs: list = []
+
+    def enc(v):
+        if isinstance(v, np.ndarray):
+            a = np.ascontiguousarray(v)
+            bufs.append(a)
+            return {"__nd__": len(bufs) - 1,
+                    "shape": list(a.shape), "dtype": a.dtype.name}
+        if isinstance(v, np.generic):
+            return v.item()
+        if isinstance(v, dict):
+            if any(not isinstance(k, str) for k in v):
+                raise TypeError("transport dict keys must be str")
+            return {k: enc(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):
+            return [enc(x) for x in v]
+        if v is None or isinstance(v, (bool, int, float, str)):
+            return v
+        raise TypeError(
+            f"type {type(v).__name__} is not transportable (the wire "
+            f"carries JSON scalars + raw ndarray frames, never pickle)")
+
+    tree = enc(obj)
+    hdr = json.dumps({"o": tree, "nbufs": len(bufs)},
+                     separators=(",", ":")).encode("utf-8")
+    return hdr, bufs
+
+
+def _decode_msg(hdr: bytes, bufs: list):
+    """Inverse of :func:`_encode_msg`: rebuild the object tree, wrapping
+    each received (writable) buffer as an ndarray view."""
+    top = json.loads(hdr.decode("utf-8"))
+
+    def dec(v):
+        if isinstance(v, dict):
+            if "__nd__" in v:
+                a = np.frombuffer(bufs[v["__nd__"]],
+                                  dtype=_np_dtype(v["dtype"]))
+                return a.reshape(v["shape"])
+            return {k: dec(x) for k, x in v.items()}
+        if isinstance(v, list):
+            return [dec(x) for x in v]
+        return v
+
+    if len(bufs) != top.get("nbufs", 0):
+        raise ConnectionError(
+            f"transport message carried {len(bufs)} buffer frames, "
+            f"header promised {top.get('nbufs', 0)}")
+    return dec(top["o"])
 
 
 class _SocketEndpoint:
-    """Length-prefixed pickle frames over one TCP socket (same send/recv
-    surface as the loopback endpoint).  Writes are locked (whole frames,
-    atomic w.r.t. other senders on this endpoint); reads buffer partial
-    frames across ``recv`` calls so a timeout never tears one."""
+    """Typed frames over one TCP socket (same send/recv surface as the
+    loopback endpoint).  Writes are locked (whole messages, atomic
+    w.r.t. other senders on this endpoint); reads buffer partial frames
+    AND partially-received multi-frame messages across ``recv`` calls,
+    so a poll timeout never tears either."""
 
     def __init__(self, sock: socket.socket):
         self._sock = sock
         self._wlock = threading.Lock()
-        self._buf = b""
+        self._buf = bytearray()
+        self._hdr: bytes | None = None   # parsed header awaiting buffers
+        self._need = 0                   # buffer frames still expected
+        self._bufs: list = []            # buffer frames received so far
 
     def send(self, obj) -> None:
-        payload = pickle.dumps(obj, protocol=4)
+        hdr, arrs = _encode_msg(obj)
         with self._wlock:
-            self._sock.sendall(struct.pack(">Q", len(payload)) + payload)
+            self._sock.sendall(
+                _FRAME_PREFIX.pack(_F_HDR, len(hdr)) + hdr)
+            for a in arrs:
+                # zero-copy data plane: the rows' own buffer feeds the
+                # socket — no serializer, no intermediate bytes object.
+                # Extension dtypes (ml_dtypes bfloat16 & friends) refuse
+                # the buffer protocol directly; a uint8 VIEW of the same
+                # memory is still zero-copy and byte-identical
+                try:
+                    mv = memoryview(a).cast("B")
+                except (ValueError, TypeError):
+                    mv = memoryview(a.reshape(-1).view(np.uint8))
+                self._sock.sendall(_FRAME_PREFIX.pack(_F_BUF, mv.nbytes))
+                self._sock.sendall(mv)
+
+    def _pop_frame(self):
+        """(ftype, body) of the next complete frame in the read buffer,
+        or None.  The body of a buffer frame is a fresh writable
+        bytearray — exactly what device_put-bound np.frombuffer wants."""
+        if len(self._buf) < 9:
+            return None
+        ftype, ln = _FRAME_PREFIX.unpack_from(self._buf)
+        if len(self._buf) < 9 + ln:
+            return None
+        body = bytearray(self._buf[9:9 + ln])
+        del self._buf[:9 + ln]
+        return ftype, body
+
+    def _pump(self):
+        """Fold complete frames into the message assembler; returns a
+        finished message's decoded object, else None."""
+        while True:
+            fr = self._pop_frame()
+            if fr is None:
+                return None
+            ftype, body = fr
+            if ftype == _F_HDR:
+                if self._hdr is not None:
+                    raise ConnectionError(
+                        "transport header frame arrived mid-message")
+                try:
+                    need = json.loads(bytes(body).decode("utf-8")).get(
+                        "nbufs", 0)
+                except (ValueError, UnicodeDecodeError) as e:
+                    raise ConnectionError(
+                        f"malformed transport header: {e}") from e
+                if need == 0:
+                    return _decode_msg(bytes(body), [])
+                self._hdr, self._need, self._bufs = bytes(body), need, []
+            elif ftype == _F_BUF:
+                if self._hdr is None:
+                    raise ConnectionError(
+                        "transport buffer frame without a header")
+                self._bufs.append(body)
+                if len(self._bufs) == self._need:
+                    hdr, bufs = self._hdr, self._bufs
+                    self._hdr, self._need, self._bufs = None, 0, []
+                    return _decode_msg(hdr, bufs)
+            else:
+                raise ConnectionError(
+                    f"unknown transport frame type {ftype}")
 
     def recv(self, timeout: float = 0.0):
         deadline = time.perf_counter() + max(float(timeout), 0.0)
         frame_deadline = None
         tried = False
         while True:
-            if len(self._buf) >= 8:
-                (ln,) = struct.unpack(">Q", self._buf[:8])
-                if len(self._buf) >= 8 + ln:
-                    body = self._buf[8:8 + ln]
-                    self._buf = self._buf[8 + ln:]
-                    return pickle.loads(body)
-            if self._buf and frame_deadline is None:
-                # ANY partial frame arms the budget — a peer stalling
-                # mid-header (< 8 bytes) is as dead as one stalling
-                # mid-body
+            msg = self._pump()
+            if msg is not None:
+                return msg
+            mid = bool(self._buf) or self._hdr is not None
+            if mid and frame_deadline is None:
+                # ANY partial frame or headered-but-unfinished message
+                # arms the budget — a peer stalling mid-header is as
+                # dead as one stalling between a header and its buffer
+                # frames, and a partial CHUNK must never wedge the
+                # reader past this bound
                 frame_deadline = time.perf_counter() + _FRAME_BUDGET_S
             rem = deadline - time.perf_counter()
-            if self._buf:
-                # mid-frame: wait for the rest (bounded by the frame
+            if mid:
+                # mid-message: wait for the rest (bounded by the frame
                 # budget), even past the caller's poll timeout
                 rem = max(rem, 0.05)
                 if time.perf_counter() > frame_deadline:
@@ -174,7 +328,7 @@ class _SocketEndpoint:
                 # so the router can fail outstanding work instead of
                 # polling a dead link forever
                 raise ConnectionError(
-                    "transport closed mid-frame" if self._buf
+                    "transport closed mid-frame" if mid
                     else "transport closed by peer")
             self._buf += chunk
 
@@ -200,9 +354,10 @@ class _SocketListener:
 
 class SocketTransport:
     """TCP transport for cross-process fleets: ``listen`` on the worker
-    host, ``connect`` from the router.  Frames are pickled — the link
-    carries cache rows between co-owned processes (the weights' trust
-    domain); never expose the port beyond it."""
+    host, ``connect`` from the router.  Frames are JSON headers + raw
+    buffer frames (never pickle) — the link carries cache rows between
+    co-owned processes (the weights' trust domain); never expose the
+    port beyond it."""
 
     @staticmethod
     def listen(host: str = "127.0.0.1", port: int = 0) -> _SocketListener:
@@ -332,9 +487,134 @@ class PrefillWorker:
                                (time.perf_counter() - t0) * 1e3)
         return rows, logits
 
+    def prefill_stream(self, prompt, emit, chunk_rows=None) -> None:
+        """Chunked streaming prefill (the pipelined handoff hot path):
+        walk the prompt through the offset-aware chunk executables
+        (``prefill_chunk@W`` / ``paged_prefill@W``) and hand each
+        finished chunk's cache rows to ``emit`` WHILE the next chunk
+        computes — the chunk's rows are sliced on device right after
+        its dispatch, so the host fetch of chunk ``i`` overlaps the
+        device compute of chunk ``i+1`` (jax async dispatch), and the
+        transfer overlaps the decode replica's ticks on the far side.
+        The final chunk's message carries the fp32 admission logits, so
+        the receiver can graduate the slot the moment the last rows
+        land (no separate done frame to lose).
+
+        ``emit(msg)`` receives ``{"op": "chunk", "seq", "start",
+        "stop", "n", "rows", ["logits"]}`` — rows are host arrays
+        ``[L, 1, stop-start, Hkv(, hd)]`` per leaf, positions
+        ``[start, stop)`` absolute, spans disjoint and covering
+        ``[0, n)`` in order.  The chunk walk overlaps its LAST window
+        (the budgeted-admission rule) instead of overrunning the
+        cache/wpe bounds; overlapped rows recompute bit-identically and
+        the emitted spans stay disjoint."""
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        n = len(prompt)
+        window = min(self.max_len, self.cfg.max_seq_len)
+        if not prompt or n > window:
+            raise ValueError(f"prompt length {n} outside (0, {window}]")
+        C = (int(chunk_rows) if chunk_rows is not None
+             else _flags.stream_chunk_rows())
+        W = serving._pow2_bucket(max(1, min(C, window)), window)
+        if self._paged:
+            # the chunk width floors at the block size, exactly like
+            # the decode replica's own suffix walk
+            W = min(max(W, self._pool.bs), window)
+        t0 = time.perf_counter()
+        if n <= W:
+            # single-window prompt: the monolithic walk IS the chunk
+            rows, logits = self.prefill(prompt)
+            emit({"op": "chunk", "seq": 0, "start": 0, "stop": n,
+                  "n": n, "rows": rows, "logits": logits})
+            self._count_stream(rows)
+            return
+        starts = list(range(0, n - W, W)) + [n - W]
+        if self._paged:
+            bs = self._pool.bs
+            self._pool.ensure_rows(0, 0, n)
+            tables = jnp.asarray(self._pool.tables)
+            if self._device is not None:
+                tables = jax.device_put(tables, self._device)
+            self.cache = dict(self.cache, tables=tables)
+            self._pool.dirty = False
+            fn = _engine.ENGINE.get("paged_prefill", _engine.StepSpec(
+                cfg=self.cfg, bucket=W, shard=self._skey))
+            tb = self._pool.tables[0]
+        else:
+            fn = _engine.ENGINE.get("prefill_chunk", _engine.StepSpec(
+                cfg=self.cfg, width=W, shard=self._skey))
+
+        def device_rows(lo, hi):
+            # lazy device-side slice of the chunk's rows, taken BEFORE
+            # the next (donating) dispatch: the slice op is ordered
+            # ahead of the donation on the device stream, so its output
+            # buffers are independent of the donated cache
+            out = {}
+            for name, arr in self.cache.items():
+                if name == "tables":
+                    continue
+                if self._paged:
+                    flat = arr.reshape(
+                        (arr.shape[0], arr.shape[1] * arr.shape[2])
+                        + arr.shape[3:])
+                    phys = jnp.asarray(
+                        [int(tb[i // bs]) * bs + i % bs
+                         for i in range(lo, hi)], jnp.int32)
+                    out[name] = jnp.take(flat, phys, axis=1)[:, None]
+                else:
+                    out[name] = arr[:, 0:1, lo:hi]
+            return out
+
+        pending = None            # (seq, lo, hi, device rows)
+        logits = None
+        prev_stop = 0
+        for j, s in enumerate(starts):
+            chunk = prompt[s:s + W]
+            padded = np.zeros((1, W), np.int32)
+            padded[0, :len(chunk)] = chunk
+            logits, self.cache = fn(
+                self.params, self.cache, jnp.asarray(padded),
+                jnp.asarray(s), jnp.asarray(len(chunk)),
+                jnp.asarray(0))
+            lo, hi = prev_stop, min(s + W, n)
+            prev_stop = hi
+            if pending is not None:
+                self._emit_chunk(emit, pending, n)
+            pending = (j, lo, hi, device_rows(lo, hi))
+        self._emit_chunk(emit, pending, n,
+                         logits=np.asarray(logits, np.float32))
+        if self._paged:
+            self._pool.free_slot(0)
+        if self._tel:
+            _telemetry.count("fleet.prefill_jobs")
+            _telemetry.observe("fleet.prefill_ms",
+                               (time.perf_counter() - t0) * 1e3)
+
+    def _emit_chunk(self, emit, pending, n, logits=None) -> None:
+        """Fetch one finished chunk's device rows (overlapping the
+        in-flight next chunk) and stream it out."""
+        seq, lo, hi, dev = pending
+        rows = {name: np.asarray(v) for name, v in dev.items()}
+        msg = {"op": "chunk", "seq": seq, "start": lo, "stop": hi,
+               "n": n, "rows": rows}
+        if logits is not None:
+            msg["logits"] = logits
+        emit(msg)
+        self._count_stream(rows)
+
+    def _count_stream(self, rows) -> None:
+        if self._tel:
+            _telemetry.count("fleet.stream_chunks")
+            _telemetry.count("fleet.stream_bytes",
+                             sum(a.nbytes for a in rows.values()))
+
     def run_once(self, timeout: float = 0.0) -> bool:
         """Consume at most one job from the endpoint (cooperative
-        drive); returns whether a message was handled."""
+        drive); returns whether a message was handled.  With
+        ``PADDLE_TPU_STREAM_CHUNK_ROWS`` > 0 replies stream chunk by
+        chunk (``{"op": "chunk", ...}``, the last one carrying the
+        admission logits); 0 restores the monolithic
+        ``{"rid", "rows", "logits"}`` reply."""
         msg = self.endpoint.recv(timeout)
         if msg is None:
             return False
@@ -342,9 +622,19 @@ class PrefillWorker:
             self._stop.set()
             return True
         try:
-            rows, logits = self.prefill(msg["prompt"])
-            self.endpoint.send({"rid": msg["rid"], "rows": rows,
-                                "logits": logits})
+            C = _flags.stream_chunk_rows()
+            if C > 0:
+                rid = msg["rid"]
+                self.prefill_stream(
+                    msg["prompt"],
+                    lambda m: self.endpoint.send(dict(m, rid=rid)),
+                    chunk_rows=C)
+            else:
+                rows, logits = self.prefill(msg["prompt"])
+                self.endpoint.send({"rid": msg["rid"], "rows": rows,
+                                    "logits": logits})
+        except ConnectionError:
+            raise                  # dead link: the caller retires it
         except Exception as e:  # noqa: BLE001 - reported to the router
             self.endpoint.send({"rid": msg.get("rid"),
                                 "error": f"{type(e).__name__}: {e}"})
@@ -453,7 +743,8 @@ class Router:
                  prefill_threshold: int | None = None,
                  tick_block: int | None = None,
                  max_queue: int | None = None,
-                 metrics_port: int | None = None):
+                 metrics_port: int | None = None,
+                 spares=()):
         self.replicas = list(replicas)
         if not self.replicas:
             raise ValueError("Router needs at least one decode replica")
@@ -519,6 +810,17 @@ class Router:
         # never starves a cold replica
         self._prefix_route_on = _flags.prefix_route()
         self._route_imbalance = _flags.prefix_route_imbalance()
+        # elastic fleet: registered spares + the telemetry-driven
+        # scaling loop's sustain counters (PADDLE_TPU_FLEET_AUTOSCALE).
+        # Removed replicas tombstone to None so every rec["replica"]
+        # index stays valid for the life of the router.
+        self._spares = list(spares)
+        self._autoscale_on = _flags.fleet_autoscale()
+        self._scale_rung = _flags.fleet_scale_rung()
+        self._scale_out_ticks = _flags.fleet_scale_out_ticks()
+        self._scale_in_ticks = _flags.fleet_scale_in_ticks()
+        self._hot_ticks = 0
+        self._idle_ticks = 0
 
     # -- submission ---------------------------------------------------------
 
@@ -538,11 +840,12 @@ class Router:
         (``result`` raises ``resilience.Overloaded``).  Requests routed
         to a replica are NOT re-charged there: the fleet door is the
         one bucket."""
+        vocab = next(r.cfg.vocab_size for r in self.replicas
+                     if r is not None)
         prompt, stop, ttl, top_k = serving.validate_request(
             prompt, max_new_tokens, stop, temperature, top_k, top_p,
             ttl_s, window=self._window,
-            vocab_size=self.replicas[0].cfg.vocab_size,
-            default_ttl=self._default_ttl)
+            vocab_size=vocab, default_ttl=self._default_ttl)
         now = time.perf_counter()
         rid = self._next_rid
         self._next_rid += 1
@@ -635,18 +938,104 @@ class Router:
 
     def _fail_prefill_ep(self, i: int) -> None:
         """One endpoint's transport died: every prefill out at it fails
-        (the requester sees the ``error`` status, never a hang) and the
-        endpoint leaves the rotation."""
+        (the requester sees the ``error`` status, never a hang — a
+        request MID-STREAM is aborted on its target replica, whose slot
+        frees), and the endpoint leaves the rotation."""
         self._dead_eps.add(i)
         for rid in sorted(self._prefilling):
             rec = self._requests[rid]
             if rec.get("ep") != i:
                 continue
             self._prefilling.discard(rid)
+            self._abort_stream(rec, "prefill worker transport died "
+                                    "mid-job")
             rec["state"] = "error"
             rec["error"] = "prefill worker transport died mid-job"
             if self._tel:
                 _telemetry.count("fleet.prefill_errors")
+
+    def _abort_stream(self, rec: dict, reason: str) -> None:
+        """Tear down a half-streamed handoff on its target replica (the
+        mid-stream-death rule: the request fails honestly, the claimed
+        slot frees, nothing hangs)."""
+        if rec.get("state") != "streaming":
+            return
+        i, local = rec["replica"], rec["local_rid"]
+        self._local.pop((i, local), None)
+        srv = self.replicas[i]
+        if srv is not None:
+            with contextlib.suppress(KeyError):
+                srv.stream_prefilled_abort(local, reason)
+        if self._tel:
+            _telemetry.count("fleet.stream_aborts")
+
+    def _stream_chunk(self, ep_i: int, msg: dict) -> None:
+        """Fold one streamed prefill chunk into its decode replica —
+        rows land through ``DecodeServer.stream_prefilled_rows`` (the
+        per-chunk pow2 injector path) the moment they arrive, so the
+        transfer overlaps the replica's decode ticks.  The FIRST chunk
+        picks the replica (prefix affinity + load, same scorer as
+        queued dispatch); the LAST chunk carries the admission logits
+        and graduates the request to plain decoding."""
+        rid = msg.get("rid")
+        rec = self._requests.get(rid)
+        if rec is None or rec["state"] not in ("prefilling", "streaming"):
+            return                  # shed/aborted mid-stream: late rows
+        if rec["state"] == "prefilling":
+            i = self._pick_replica(req=rec["req"])
+            if i is None:
+                # every candidate is at capacity: land on the best
+                # healthy replica anyway — its queue buffers the
+                # streamed rows until a slot frees (the transfer has
+                # to park SOMEWHERE, and the replica's host RAM is
+                # where submit_prefilled would put it too)
+                live = [j for j, r in enumerate(self.replicas)
+                        if r is not None and self._ok[j]]
+                if not live:
+                    self._prefilling.discard(rid)
+                    rec["state"] = "error"
+                    rec["error"] = ("no healthy replica to receive "
+                                    "streamed prefill rows")
+                    return
+                i = live[0]
+            req = rec["req"]
+            try:
+                local = self.replicas[i].stream_prefilled_begin(
+                    req["prompt"], max_new_tokens=req["max_new"],
+                    stop=req.get("stop"),
+                    temperature=req.get("temperature", 0.0),
+                    top_k=req.get("top_k", 0),
+                    top_p=req.get("top_p", 1.0),
+                    ttl_s=req.get("ttl"),
+                    priority=req.get("priority", 0))
+            except ValueError as e:
+                self._prefilling.discard(rid)
+                rec["state"] = "error"
+                rec["error"] = str(e)
+                return
+            rec["state"] = "streaming"
+            rec["replica"] = i
+            rec["local_rid"] = local
+            self._local[(i, local)] = rid
+            if self._tel:
+                # the first chunk's replica pick IS this request's
+                # routing decision (same scorer as queued dispatch)
+                _telemetry.count("fleet.routed")
+        srv = self.replicas[rec["replica"]]
+        try:
+            srv.stream_prefilled_rows(
+                rec["local_rid"], int(msg["start"]), int(msg["stop"]),
+                msg["rows"], logits=msg.get("logits"))
+        except Exception as e:  # noqa: BLE001 - surfaced on the request
+            self._prefilling.discard(rid)
+            self._abort_stream(rec, f"stream injection failed: {e}")
+            rec["state"] = "error"
+            rec["error"] = f"stream injection failed: {e}"
+            return
+        if msg.get("logits") is not None:
+            # final chunk: the replica owns the request end to end now
+            self._prefilling.discard(rid)
+            rec["state"] = "dispatched"
 
     def _poll_prefill(self) -> None:
         for i in self._live_eps():
@@ -659,12 +1048,20 @@ class Router:
                     break
                 if msg is None:
                     break
+                if msg.get("op") == "chunk":
+                    self._stream_chunk(i, msg)
+                    continue
                 rid = msg.get("rid")
                 self._prefilling.discard(rid)
                 rec = self._requests.get(rid)
-                if rec is None or rec["state"] != "prefilling":
+                if rec is None or rec["state"] not in ("prefilling",
+                                                       "streaming"):
                     continue
                 if "error" in msg:
+                    # a worker that died mid-walk reports here — a
+                    # half-streamed request aborts on its replica
+                    # instead of wedging its slot
+                    self._abort_stream(rec, msg["error"])
                     rec["state"] = "error"
                     rec["error"] = msg["error"]
                     if self._tel:
@@ -705,6 +1102,8 @@ class Router:
             rec = self._requests[rid]
             if self._expired(rec, now):
                 self._prefilling.discard(rid)
+                # a half-streamed request frees its claimed slot too
+                self._abort_stream(rec, "ttl expired mid-stream")
                 rec["state"] = "timeout"
                 if self._tel:
                     _telemetry.count("fleet.ttl_sheds")
@@ -717,7 +1116,7 @@ class Router:
         summaries on top).  ``_route`` keeps the snapshot honest between
         dispatches by bumping the chosen replica's queue depth."""
         return {i: r.load_stats() for i, r in enumerate(self.replicas)
-                if self._ok[i]}
+                if r is not None and self._ok[i]}
 
     def _pick_replica(self, exclude=(), stats=None, req=None):
         """Best healthy replica with admission capacity (free slots, or
@@ -737,7 +1136,7 @@ class Router:
         operators can see which replica serves which tenant mix."""
         cands = []
         for i, r in enumerate(self.replicas):
-            if not self._ok[i] or i in exclude:
+            if r is None or not self._ok[i] or i in exclude:
                 continue
             ls = (stats.get(i) if stats is not None
                   else r.load_stats())
@@ -811,8 +1210,8 @@ class Router:
                 i = self._pick_replica(exclude=rejected, stats=stats,
                                        req=rec["req"])
                 if i is None:
-                    healthy = {j for j in range(len(self.replicas))
-                               if self._ok[j]}
+                    healthy = {j for j, r in enumerate(self.replicas)
+                               if r is not None and self._ok[j]}
                     if healthy and healthy <= set(rejected):
                         # every healthy replica rejected it OUTRIGHT
                         # (window/pool too small — permanent, not a
@@ -825,6 +1224,7 @@ class Router:
                     else:
                         held.append(rid)
                     break
+                self._migrate_chains(rec["req"], i)
                 try:
                     local = self.replicas[i].adopt_request(rec["req"])
                 except ValueError as e:
@@ -850,6 +1250,8 @@ class Router:
 
     def _check_health(self) -> None:
         for i, r in enumerate(self.replicas):
+            if r is None:
+                continue
             ok = not r.wedged
             if self._ok[i] and not ok:
                 self._ok[i] = False
@@ -877,6 +1279,19 @@ class Router:
             if rid is None:
                 continue        # unreachable given the rid filter
             rec = self._requests[rid]
+            if req.get("stream"):
+                # a still-queued streamed handoff cannot re-route: its
+                # chunks flow to THIS replica's stream plumbing.  Fail
+                # it honestly (the worker's late chunks drop on the
+                # state check) instead of stranding it elsewhere
+                self._prefilling.discard(rid)
+                rec["state"] = "error"
+                rec["error"] = "stream target replica drained mid-handoff"
+                rec.pop("replica", None)
+                rec.pop("local_rid", None)
+                if self._tel:
+                    _telemetry.count("fleet.stream_aborts")
+                continue
             r = dict(req)
             r.pop("rid", None)  # the local rid died with the drain
             rec["req"] = r
@@ -888,6 +1303,166 @@ class Router:
             self._queue[:0] = front
             if self._tel:
                 _telemetry.count("fleet.reroutes", len(front))
+
+    # -- elastic fleet ------------------------------------------------------
+
+    def _migrate_chains(self, req, dest_i: int) -> None:
+        """Cross-replica spilled-chain migration: before ``dest_i``
+        adopts a request, any OTHER replica holding a host-RAM spilled
+        prefix chain of this prompt ships it over — the entries
+        roundtrip through the raw wire codec (the same dtype-tagged
+        header + buffer frames a socket fleet moves KV with; loopback
+        fleets exercise the exact encode path), land in the
+        destination pool's spill store, and restore bit-identically
+        through ITS ``inject_rows`` buckets at admission.  The source
+        forgets the chain (a move, not a copy): prefix-aware routing
+        already steers the tenant here, so the chain follows the
+        traffic.  Cold path — runs only when a source actually holds a
+        matching chain (``kv_pool.chain_migrations``)."""
+        prompt = req.get("prompt")
+        dest = self.replicas[dest_i]
+        pool = getattr(dest, "_pool", None)
+        if not prompt or pool is None \
+                or not hasattr(pool, "migrate_in"):
+            return
+        for j, r in enumerate(self.replicas):
+            if j == dest_i or r is None:
+                continue
+            src = getattr(r, "_pool", None)
+            if src is None or not hasattr(src, "migrate_out"):
+                continue
+            entries = src.migrate_out(prompt)
+            if not entries:
+                continue
+            hdr, arrays = _encode_msg(entries)
+            entries = _decode_msg(
+                hdr, [bytearray(a.reshape(-1).view(np.uint8))
+                      for a in arrays])
+            pool.migrate_in(entries)
+
+    def add_replica(self, srv) -> int:
+        """Attach a decode replica LIVE: it joins the routing candidate
+        set on the next scheduling round (in-flight requests are
+        untouched).  The fleet window tightens if the newcomer's is
+        smaller — already-queued longer prompts are rejected by it at
+        adoption and re-route, never wedge.  Returns the replica
+        index."""
+        self.replicas.append(srv)
+        self._ok.append(True)
+        self._window = min(self._window,
+                           min(srv.max_len, srv.cfg.max_seq_len))
+        if self._tel:
+            _telemetry.count("fleet.replica_adds")
+        self._gauges()
+        return len(self.replicas) - 1
+
+    def remove_replica(self, i: int):
+        """Detach replica ``i`` LIVE: its queued router-owned work
+        re-routes to the survivors (the wedge/drain machinery — the
+        survivors' outputs are bit-identical to an undisturbed run,
+        their slots never observe the topology change), a half-streamed
+        handoff targeting it fails honestly, and its ACTIVE slots tick
+        to completion here with results materialized into the fleet
+        records before the handle goes away.  The slot tombstones to
+        ``None`` so every ``rec["replica"]`` index stays valid for the
+        router's lifetime.  Returns the detached server (the caller
+        owns it again — park it as a spare or ``close()`` it)."""
+        srv = self.replicas[i]
+        if srv is None:
+            raise KeyError(f"replica {i} was already removed")
+        if sum(1 for r in self.replicas if r is not None) <= 1:
+            raise ValueError("cannot remove the last replica")
+        self._drain_replica(i)
+        # a stream mid-flight to this replica would hold its claimed
+        # slot open forever (the worker keeps computing, but its chunks
+        # drop on the state check): abort it so pending() can fall
+        for rid in sorted(self._prefilling):
+            rec = self._requests[rid]
+            if (rec.get("state") == "streaming"
+                    and rec.get("replica") == i):
+                self._prefilling.discard(rid)
+                self._abort_stream(rec, "replica removed mid-stream")
+                rec["state"] = "error"
+                rec["error"] = "replica removed mid-stream"
+        while srv.pending():
+            self._tick_replica(srv)
+        for (ri, local), rid in list(self._local.items()):
+            if ri != i:
+                continue
+            rec = self._requests[rid]
+            try:
+                rec["result"] = srv.result(local)
+                rec["state"] = "done"
+            except Exception as e:  # noqa: BLE001 - surfaced on result
+                rec["state"] = "error"
+                rec["error"] = str(e)
+            del self._local[(ri, local)]
+        self.replicas[i] = None
+        self._ok[i] = False
+        self._window = min(min(r.max_len, r.cfg.max_seq_len)
+                           for r in self.replicas if r is not None)
+        if self._tel:
+            _telemetry.count("fleet.replica_removes")
+        self._route()
+        self._gauges()
+        return srv
+
+    def register_spare(self, srv) -> None:
+        """Park a warm replica for the autoscale loop: ``_scale_out``
+        attaches spares in registration order; ``_scale_in`` returns
+        drained replicas to the pool.  Spares cost device memory but no
+        ticks — the price of scale-out latency measured in one
+        scheduling round instead of a model load."""
+        self._spares.append(srv)
+
+    def _autoscale(self, stats) -> bool:
+        """Telemetry-driven scaling loop (``PADDLE_TPU_FLEET_AUTOSCALE``):
+        the fleet scales OUT to a registered spare after the worst
+        healthy replica's SLO degradation rung has held at or above
+        ``PADDLE_TPU_FLEET_SCALE_RUNG`` for ``_SCALE_OUT_TICKS``
+        consecutive rounds, and scales IN (drain + re-route, survivors
+        bit-identical) after ``_SCALE_IN_TICKS`` rounds with zero
+        queued, streaming, or occupied-slot work anywhere.  Sustain
+        windows debounce both directions — one hot histogram window
+        never flaps the topology.  Returns True when the topology
+        changed (the caller refreshes its load snapshot)."""
+        if stats is None:
+            stats = self._snapshot_load()
+        rungs = [ls.get("admission_rung", 0) for ls in stats.values()]
+        hot = bool(rungs) and max(rungs) >= self._scale_rung
+        busy = (bool(self._queue) or bool(self._prefilling)
+                or any(ls["queue_depth"] > 0 or ls["slot_occupancy"] > 0
+                       for ls in stats.values()))
+        self._hot_ticks = self._hot_ticks + 1 if hot else 0
+        self._idle_ticks = 0 if busy else self._idle_ticks + 1
+        if self._hot_ticks >= self._scale_out_ticks and self._spares:
+            self._scale_out()
+            return True
+        if (self._idle_ticks >= self._scale_in_ticks
+                and sum(1 for r in self.replicas
+                        if r is not None) > 1):
+            self._scale_in()
+            return True
+        return False
+
+    def _scale_out(self) -> None:
+        """Sustained overload verdict: the oldest registered spare
+        joins the fleet (``fleet.scale_outs``)."""
+        self.add_replica(self._spares.pop(0))
+        self._hot_ticks = 0
+        if self._tel:
+            _telemetry.count("fleet.scale_outs")
+
+    def _scale_in(self) -> None:
+        """Sustained idle verdict: the highest-index live replica
+        drains out of the fleet and returns to the spare pool
+        (``fleet.scale_ins``)."""
+        live = [j for j, r in enumerate(self.replicas)
+                if r is not None]
+        self._spares.append(self.remove_replica(live[-1]))
+        self._idle_ticks = 0
+        if self._tel:
+            _telemetry.count("fleet.scale_ins")
 
     def _tick_replica(self, r) -> None:
         if self._block > 1:
@@ -918,10 +1493,14 @@ class Router:
         # AND every routing decision (the per-queued-request re-read is
         # gone); skipped when nothing needs it
         stats = (self._snapshot_load()
-                 if self._queue or self._adm is not None else None)
+                 if self._queue or self._adm is not None
+                 or self._autoscale_on else None)
         self._absorb_backpressure(stats)
+        if self._autoscale_on and self._autoscale(stats):
+            stats = self._snapshot_load()   # topology changed
         self._route(stats)
-        pend = [r for r in self.replicas if r.pending()]
+        pend = [r for r in self.replicas
+                if r is not None and r.pending()]
         if len(pend) <= 1 or self._tick_workers <= 1:
             for r in pend:
                 self._tick_replica(r)
@@ -960,22 +1539,29 @@ class Router:
 
     def pending(self) -> bool:
         return (bool(self._queue) or bool(self._prefilling)
-                or any(r.pending() for r in self.replicas))
+                or any(r.pending() for r in self.replicas
+                       if r is not None))
 
     # -- results ------------------------------------------------------------
 
     def status(self, rid: int) -> str:
         """``queued`` | ``prefilling`` | ``timeout`` | ``rejected`` |
         ``error`` at the fleet level; once dispatched, the owning
-        replica's status."""
+        replica's status; ``ok`` for a result materialized by
+        :meth:`remove_replica` after its replica left the fleet."""
         rec = self._requests[rid]
         if rec["state"] == "dispatched":
             return self.replicas[rec["replica"]].status(rec["local_rid"])
+        if rec["state"] == "done":
+            return "ok"
         return rec["state"]
 
     def result(self, rid: int):
         rec = self._requests[rid]
         state = rec["state"]
+        if state == "done":
+            # materialized by remove_replica before its replica left
+            return rec["result"]
         if state == "timeout":
             raise _resilience.DeadlineExceeded(
                 f"request {rid} was shed at the router: still queued "
@@ -1001,6 +1587,8 @@ class Router:
         same wedge verdict via the shared telemetry state)."""
         reps = []
         for i, r in enumerate(self.replicas):
+            if r is None:
+                continue
             ls = r.load_stats()
             reps.append(dict(ls, ok=not ls["wedged"]))
         return {
@@ -1019,7 +1607,9 @@ class Router:
     def _gauges(self) -> None:
         if not self._tel:
             return
-        _telemetry.set_gauge("fleet.replicas", len(self.replicas))
+        _telemetry.set_gauge(
+            "fleet.replicas",
+            sum(1 for r in self.replicas if r is not None))
         _telemetry.set_gauge("fleet.healthy_replicas", sum(self._ok))
         _telemetry.set_gauge("fleet.queue_depth", len(self._queue))
         _telemetry.set_gauge("fleet.prefill_outstanding",
@@ -1042,7 +1632,9 @@ class Router:
         if self._tick_pool is not None:
             self._tick_pool.shutdown(wait=True)
             self._tick_pool = None
-        for r in self.replicas:
+        for r in list(self.replicas) + list(self._spares):
+            if r is None:
+                continue
             with contextlib.suppress(Exception):
                 r.close()
         if self.metrics_server is not None:

@@ -694,6 +694,7 @@ class PagedAllocator:
         self.spilled_blocks = 0
         self.restored_blocks = 0
         self.host_spill_bytes = 0
+        self.chain_migrations = 0
 
     # -- pool accounting ----------------------------------------------------
 
@@ -1098,6 +1099,63 @@ class PagedAllocator:
             _telemetry.count("kv_pool.restore_drains")
         return out
 
+    # -- cross-replica chain migration --------------------------------------
+
+    def migrate_out(self, prompt) -> list:
+        """Detach every spilled chain that prefixes ``prompt`` for
+        shipment to another replica's pool (the router calls this on
+        every OTHER replica right before a dispatch, so a tenant's
+        spilled KV follows its traffic to wherever prefix-aware
+        routing now sends it).  A move, not a copy: the chains leave
+        this pool's spill store and budget.  Returns wire-ready
+        entries ``{"tokens": [...], "rows": {leaf: [L, bs, ...]}}`` —
+        ndarray leaves, so the fleet codec ships them as raw buffer
+        frames (``kv_pool.chain_migrations_out``)."""
+        if not self._spilled:
+            return []
+        pl = tuple(int(t) for t in prompt)
+        out = []
+        for key in list(self._spilled):
+            if len(key) <= len(pl) and pl[:len(key)] == key:
+                rec, nb = self._spilled.pop(key)
+                self.host_spill_bytes -= nb
+                out.append({"tokens": list(key), "rows": rec})
+        if out:
+            _telemetry.count("kv_pool.chain_migrations_out", len(out))
+        return out
+
+    def migrate_in(self, entries) -> int:
+        """Adopt migrated chains into THIS pool's spill store: the next
+        admission's ``adopt_prefix`` walk promotes them through
+        :meth:`_restore_spilled` → the caller's batched ``device_put``
+        + ``inject_rows`` scatter — the exact restore path local spill
+        uses, so migrated rows land bit-identically to rows this
+        replica spilled itself.  Entries over the host budget drop
+        (the prompt recomputes, never corrupts).  Returns chains kept
+        (``kv_pool.chain_migrations``)."""
+        added = 0
+        for ent in entries:
+            key = tuple(int(t) for t in ent["tokens"])
+            if not key or len(key) % self.bs:
+                continue          # not a block-aligned chain: refuse
+            rec = {name: np.asarray(v)
+                   for name, v in ent["rows"].items()}
+            nb = sum(a.nbytes for a in rec.values())
+            old = self._spilled.pop(key, None)
+            if old is not None:
+                self.host_spill_bytes -= old[1]
+            if self.spill_limit_bytes \
+                    and self.host_spill_bytes + nb \
+                    > self.spill_limit_bytes:
+                continue
+            self._spilled[key] = (rec, nb)
+            self.host_spill_bytes += nb
+            added += 1
+        if added:
+            self.chain_migrations += added
+            _telemetry.count("kv_pool.chain_migrations", added)
+        return added
+
     # -- routing summary ----------------------------------------------------
 
     def prefix_summary(self, max_roots: int = 16) -> list:
@@ -1146,4 +1204,5 @@ class PagedAllocator:
             "restored_blocks": self.restored_blocks,
             "spilled_entries": len(self._spilled),
             "host_spill_bytes": self.host_spill_bytes,
+            "chain_migrations": self.chain_migrations,
         }

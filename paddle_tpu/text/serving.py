@@ -810,6 +810,7 @@ class DecodeServer:
         self._queue: list[dict] = []             # waiting requests
         self._results: dict[int, list] = {}
         self._dropped: set[int] = set()          # rids abandoned by close()
+        self._streams: dict[int, dict] = {}      # rid -> open handoff stream
         self._next_rid = 0
         # decode-gap probe (the stall the budget exists to kill): host
         # timestamp of the last tick that appended decode tokens; the
@@ -1226,6 +1227,14 @@ class DecodeServer:
                 _telemetry.observe(
                     "serving.queue_wait_ms",
                     (t_admit - st["t_submit"]) * 1e3)
+            if req.get("stream"):
+                # streamed fleet handoff: claim the slot now with zero
+                # rows present — chunks inject as they arrive
+                # (stream_prefilled_rows), decode ticks riding the
+                # frontier exactly like budgeted admission
+                if not self._claim_stream(req, slot, st):
+                    break
+                continue
             if self._budget and "prefilled" not in req \
                     and len(req["prompt"]) > self._budget:
                 # budgeted admission: claim the slot NOW (plan the chunk
@@ -1544,7 +1553,9 @@ class DecodeServer:
         chunk bit-exactly.  Returns True when a chunk ran."""
         slot = st = None
         for s_, st_ in self._slots.items():
-            if st_.get("admitting"):
+            # stream slots are "admitting" for the ride/skip machinery
+            # but have no chunk plan — their rows arrive off-tick
+            if st_.get("admitting") and not st_.get("stream"):
                 slot, st = s_, st_
                 break
         if st is None:
@@ -1677,6 +1688,283 @@ class DecodeServer:
                 self._pool.free_slot(slot)
             self._free.append(slot)
             self._tel_retire(st, slot)
+
+    # -- streamed fleet handoff: per-chunk row injection --------------------
+
+    def stream_prefilled_begin(self, prompt, max_new_tokens: int = 32,
+                               stop: list | None = None,
+                               temperature: float = 0.0, top_k: int = 0,
+                               top_p: float = 1.0,
+                               ttl_s: float | None = None,
+                               priority: int = 0) -> int:
+        """Open a STREAMED prefill handoff — the chunked twin of
+        :meth:`submit_prefilled`.  The caller (the fleet router, as a
+        worker's chunks land) follows with one
+        :meth:`stream_prefilled_rows` call per finished prefill chunk;
+        the final chunk carries the admission logits and graduates the
+        request to plain decoding in the same call.  The slot is
+        claimed at admission with ZERO rows present and decode ticks
+        ride it at the injected frontier exactly like budgeted
+        admission (the frontier row a ride writes is rewritten
+        bit-identically by the next chunk's injection), so the
+        transfer overlaps this server's decode steps instead of
+        stalling them.  Chunks that arrive while the request is still
+        QUEUED buffer host-side and replay at claim — admission order
+        is unchanged.  Decoded output is bit-identical to
+        :meth:`submit_prefilled` with the same rows and logits."""
+        req = self._build_request(prompt, max_new_tokens, stop,
+                                  temperature, top_k, top_p, ttl_s,
+                                  priority)
+        req["stream"] = True
+        self._streams[req["rid"]] = {
+            "req": req, "pending": [], "expect": 0,
+            "slot": None, "st": None}
+        self._queue.append(req)
+        if self._tel:
+            _telemetry.count("serving.requests_submitted")
+            _telemetry.count("serving.stream_begins")
+        self._admit()
+        self._tel_gauges()
+        return req["rid"]
+
+    def _claim_stream(self, req, slot, st) -> bool:
+        """Streamed-handoff admission (claim): reserve the slot and —
+        paged — adopt the longest indexed prefix + allocate the FULL
+        row range before any chunk lands, mirroring
+        :meth:`_inject_prefilled`'s allocation exactly (worker rows
+        for adopted blocks are bit-identical to what the index already
+        holds, so those blocks are attended, never rewritten).  No
+        prefill runs here; rows arrive via
+        :meth:`stream_prefilled_rows`.  Returns False when admission
+        must stop (request parked on pool pressure, the monolithic
+        parking rule)."""
+        prompt = req["prompt"]
+        n = len(prompt)
+        shared = 0
+        if self._paged:
+            from . import kv_pool as _kv
+
+            try:
+                if self._prefill_on:
+                    shared = self._pool.adopt_prefix(slot, prompt)
+                    self._drain_restores()
+                while True:
+                    try:
+                        self._pool.ensure_rows(slot, shared, n)
+                        break
+                    except _kv.PoolExhausted:
+                        # the OOM chain's first rung at admission (see
+                        # _paged_prefill_slot)
+                        if self._evict_or_spill(_EVICT_BATCH) == 0:
+                            raise
+            except _kv.PoolExhausted:
+                self._pool.free_slot(slot)
+                self._free.append(slot)
+                self._queue.insert(0, req)
+                if self._tel:
+                    _telemetry.count("kv_pool.admit_blocked")
+                return False
+            self._apply_pool_ops()
+        st["admitting"] = True      # decode ticks ride the frontier
+        st["stream"] = True
+        st["stream_shared"] = shared
+        # pos doubles as the injected frontier: rows [0, pos) are
+        # valid (adopted prefix now, injected chunks as they land)
+        st["pos"] = shared
+        self._slots[slot] = st
+        sr = self._streams[st["rid"]]
+        sr["slot"], sr["st"] = slot, st
+        if self._tel:
+            _telemetry.count("serving.stream_claims")
+        # chunks that arrived while the request was queued replay now
+        self._stream_drain(st["rid"])
+        return True
+
+    def stream_prefilled_rows(self, rid: int, start: int, stop: int,
+                              rows, logits=None) -> None:
+        """Fold one streamed chunk — worker cache rows for prompt
+        positions ``[start, stop)``, leaves ``[L, 1, stop-start,
+        Hkv(, hd)]`` in this server's storage dtype — into the
+        request's slot through the pow2 injector bucket.  ``logits``
+        ([V], float32) rides the FINAL chunk (``stop == n``):
+        graduation happens in the same call, so the slot never sits
+        complete awaiting a separate done frame (a window a decode
+        ride could corrupt).  Chunks landing before the claim buffer
+        host-side.  Raises on leaf/dtype/range mismatch — the
+        transport is ordered, so a gap is a protocol bug, not a
+        retry."""
+        sr = self._streams.get(rid)
+        if sr is None:
+            raise KeyError(f"no open handoff stream for rid {rid}")
+        if self._status.get(rid) is not None:
+            # shed or failed while the chunks were in flight: late
+            # rows drop, the record closes
+            self._streams.pop(rid, None)
+            return
+        start, stop = int(start), int(stop)
+        n = len(sr["req"]["prompt"])
+        if start != sr["expect"] or stop <= start or stop > n:
+            raise ValueError(
+                f"stream chunk [{start}, {stop}) for rid {rid}: "
+                f"expected start {sr['expect']} in a {n}-token prompt")
+        if logits is None and stop == n:
+            raise ValueError(
+                f"final stream chunk for rid {rid} carries no "
+                f"admission logits")
+        if logits is not None and stop != n:
+            raise ValueError(
+                f"stream chunk [{start}, {stop}) for rid {rid} "
+                f"carries logits before the final row {n}")
+        rows = {name: np.asarray(v) for name, v in rows.items()}
+        want = {name for name in self.cache if name != "tables"}
+        if set(rows) != want:
+            raise ValueError(
+                f"stream chunk leaves {sorted(rows)} do not match the "
+                f"cache leaves {sorted(want)}")
+        for name, v in rows.items():
+            have = self.cache[name].dtype
+            if v.dtype != have:
+                raise ValueError(
+                    f"stream chunk leaf {name!r} is {v.dtype}, this "
+                    f"server stores {have} (PADDLE_TPU_KV_DTYPE drift "
+                    f"between prefill worker and decode server?)")
+            if v.shape[2] != stop - start:
+                raise ValueError(
+                    f"stream chunk leaf {name!r} covers {v.shape[2]} "
+                    f"positions for range [{start}, {stop})")
+        sr["expect"] = stop
+        sr["pending"].append(
+            (start, stop, rows,
+             None if logits is None else np.asarray(logits,
+                                                    np.float32)))
+        if sr["st"] is not None:
+            self._stream_drain(rid)
+
+    def _stream_drain(self, rid: int) -> None:
+        """Inject every buffered chunk for a CLAIMED stream, in order;
+        the chunk carrying logits graduates the slot (and may retire
+        the request — single-token budgets finish on the admission
+        token, like every admission path)."""
+        sr = self._streams.get(rid)
+        if sr is None or sr["st"] is None:
+            return
+        while sr["pending"]:
+            start, stop, rows, logits = sr["pending"].pop(0)
+            self._stream_inject(sr["slot"], sr["st"], start, stop,
+                                rows)
+            if logits is not None:
+                self._graduate_stream(sr["slot"], sr["st"], logits)
+                break
+
+    def _stream_inject(self, slot, st, start, stop, rows) -> None:
+        """One chunk through the handoff injector: the rows pad into
+        the request's pow2(n) bucket at their ABSOLUTE offsets and the
+        range-gated executable writes ``[max(shared, start), stop)`` —
+        the SAME ``inject@bucket`` program monolithic handoff
+        admission runs, with per-chunk range arguments (zero new
+        executable families, so bit-parity with
+        :meth:`submit_prefilled` is by construction).  Rows under the
+        adopted prefix are attended, never rewritten."""
+        n = len(st["prompt"])
+        lo = max(st.get("stream_shared", 0), start)
+        if stop > lo:
+            bucket = _pow2_bucket(n, self.max_len,
+                                  self.cfg.max_seq_len)
+            padded = {}
+            for name, v in rows.items():
+                buf = np.zeros(v.shape[:2] + (bucket,) + v.shape[3:],
+                               v.dtype)
+                buf[:, :, lo:stop] = v[:, :, lo - start:stop - start]
+                padded[name] = jnp.asarray(buf)
+            fn = _get_inject_fn(self.cfg, bucket, self._paged,
+                                self._shard)
+            self.cache = fn(self.cache, padded, jnp.asarray(lo),
+                            jnp.asarray(stop), jnp.asarray(slot))
+            if self._tel:
+                _telemetry.count("serving.prefilled_rows", stop - lo)
+        # frontier advance: the row a decode ride wrote at the old pos
+        # was just rewritten bit-identically by this inject
+        st["pos"] = max(st["pos"], stop)
+
+    def _graduate_stream(self, slot, st, logits) -> None:
+        """The final chunk landed (logits in the same frame): draw the
+        first token with the exact per-rid host sampling of monolithic
+        handoff admission and flip the slot to plain decoding."""
+        prompt = st["prompt"]
+        n = len(prompt)
+        rid = st["rid"]
+        self._streams.pop(rid, None)
+        logits_np = np.asarray(logits, np.float32)
+        if _faults.active():
+            logits_np = _faults.corrupt_nan("logits", logits_np)
+        if self._resil and not np.isfinite(logits_np).all():
+            # the admission NaN guard, streamed edition
+            del self._slots[slot]
+            self._fail_request(st, slot, "non-finite prefill logits")
+            return
+        if st["temperature"] > 0.0:
+            p = generate._filtered_probs(
+                logits_np, st["temperature"], st["top_k"], st["top_p"])
+            rng = np.random.default_rng(generate._key_seed(
+                jax.random.fold_in(self._base_key, (1 << 20) + rid)))
+            t = int(rng.choice(len(p), p=p))
+        else:
+            t = int(logits_np.argmax())
+        st["generated"].append(t)
+        st["pos"] = n
+        st.pop("admitting", None)
+        st.pop("stream", None)
+        st.pop("stream_shared", None)
+        if self._paged and self._prefill_on:
+            # streamed rows equal local prefill's bit-for-bit: the
+            # prompt's full blocks index for future sharing
+            self._pool.register_prefix(slot, prompt)
+        if self._spec_on and self.draft_cfg is not None:
+            # the draft cache saw none of these rows: the first spec
+            # round's catch-up feeds it the sequence from 0
+            st["spec_dpos"] = 0
+        if self._tel:
+            now = time.perf_counter()
+            st["t_first"] = st["t_last"] = now
+            _telemetry.observe("serving.ttft_ms",
+                               (now - st["t_submit"]) * 1e3)
+            _telemetry.event("serving.prefill",
+                             st.get("t_admit", now), now, tid=slot,
+                             rid=rid, prompt_len=n)
+            _telemetry.count("serving.tokens_generated")
+        fin = self._constraint_push(st, t)
+        if self._finished(st, t) or fin:
+            # single-token budgets finish on the admission token
+            del self._slots[slot]
+            self._results[rid] = st["generated"]
+            if self._paged:
+                self._pool.free_slot(slot)
+            self._free.append(slot)
+            self._tel_retire(st, slot)
+
+    def stream_prefilled_abort(self, rid: int, reason: str) -> None:
+        """Tear down a half-streamed handoff (worker death, transport
+        loss, TTL, replica removal): the request retires with the
+        ``error`` status and — if the stream had claimed a slot — the
+        slot and its pool blocks free for the next tenant.  Raises
+        ``KeyError`` when no stream is open for ``rid`` (already
+        graduated, aborted, or never begun)."""
+        sr = self._streams.pop(rid)
+        st = sr["st"]
+        if st is None:
+            self._queue[:] = [r for r in self._queue
+                              if r["rid"] != rid]
+        else:
+            self._slots.pop(sr["slot"], None)
+            if self._paged:
+                self._pool.free_slot(sr["slot"])
+            self._free.append(sr["slot"])
+        if self._status.get(rid) is None:
+            self._status[rid] = "error"
+            self._err_reason[rid] = reason
+        if self._tel:
+            _telemetry.count("serving.requests_failed")
+            _telemetry.count("serving.stream_aborts")
 
     # -- paged layout: allocator plumbing (text/kv_pool) --------------------
 
@@ -3074,6 +3362,12 @@ class DecodeServer:
             out = [r for r in self._queue if r["rid"] in rids]
             self._queue[:] = [r for r in self._queue
                               if r["rid"] not in rids]
+        for r in out:
+            # a drained stream request leaves with its rid: the open
+            # stream record dies here (the drainer fails the request
+            # at the fleet level; late chunks would KeyError honestly)
+            if r.get("stream"):
+                self._streams.pop(r["rid"], None)
         if out and self._tel:
             _telemetry.count("serving.queue_drained", len(out))
         self._tel_gauges()

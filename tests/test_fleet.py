@@ -816,3 +816,349 @@ def test_fleet_lint_catches_silent_reroute():
             "    def _drain_replica(self, i):\n"
             "        self._shed_expired()\n")
     assert not ci.scan_fleet_source(good)
+
+
+# ---------------------------------------------------------------------------
+# zero-copy KV streaming: wire codec, chunked handoff, elastic fleet
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def fleet_env(monkeypatch):
+    """Env setter that also busts the value-keyed jit caches (the
+    kv_env idiom from test_kv_pool.py: KV dtype / chunk flags key the
+    traced step fns, but modules cache them across tests)."""
+    def set_(**kw):
+        for k, v in kw.items():
+            if v is None:
+                monkeypatch.delenv(k, raising=False)
+            else:
+                monkeypatch.setenv(k, v)
+        generate._GEN_CACHE.clear()
+        serving._STEP_CACHE.clear()
+    yield set_
+    generate._GEN_CACHE.clear()
+    serving._STEP_CACHE.clear()
+
+
+def test_wire_codec_roundtrip_dtypes():
+    """The raw-row codec: dtype-tagged header + contiguous buffer
+    frames roundtrip bit-exactly for every KV storage dtype (fp32,
+    int8, bf16), nested trees included — and the reassembled arrays
+    are WRITABLE (the decode side owns fresh buffers, so inject paths
+    may pad in place)."""
+    ml_dtypes = pytest.importorskip("ml_dtypes")
+    rng = np.random.default_rng(3)
+    msg = {
+        "rid": 7, "op": "chunk", "start": 0, "stop": 4,
+        "rows": {
+            "k": rng.standard_normal((2, 1, 4, 8)).astype(np.float32),
+            "q8": rng.integers(-128, 127, (2, 1, 4), dtype=np.int8),
+            "b16": rng.standard_normal((3, 4)).astype(ml_dtypes.bfloat16),
+        },
+        "meta": [1, "x", None, 2.5],
+    }
+    hdr, arrays = fleet._encode_msg(msg)
+    assert isinstance(hdr, bytes)
+    out = fleet._decode_msg(
+        hdr, [bytearray(a.reshape(-1).view(np.uint8)) for a in arrays])
+    assert out["rid"] == 7 and out["meta"] == [1, "x", None, 2.5]
+    for name, ref in msg["rows"].items():
+        got = out["rows"][name]
+        assert got.dtype == ref.dtype and got.shape == ref.shape
+        np.testing.assert_array_equal(
+            got.view(np.uint8), ref.view(np.uint8))
+        assert got.flags.writeable
+
+
+def test_wire_codec_never_pickles_unknown_types():
+    """A non-transportable leaf is a loud TypeError, never a silent
+    pickle fallback — the codec's security contract."""
+    with pytest.raises(TypeError):
+        fleet._encode_msg({"bad": {1, 2, 3}})
+    with pytest.raises(TypeError):
+        fleet._encode_msg({"fn": lambda: None})
+
+
+@requires_sockets
+def test_socket_torn_frame_budget_and_reset(monkeypatch):
+    """Transport failure semantics re-pinned on the raw protocol: a
+    peer that stalls MID-FRAME trips the torn-frame budget as a
+    ConnectionError (never an infinite buffer wait), and an orderly
+    close mid-stream surfaces the same way."""
+    monkeypatch.setattr(fleet, "_FRAME_BUDGET_S", 0.05)
+    listener = fleet.SocketTransport.listen()
+    raw = socket.create_connection(("127.0.0.1", listener.port))
+    ep = listener.accept(timeout=5.0)
+    try:
+        raw.sendall(fleet._FRAME_PREFIX.pack(1, 1000) + b"torn")
+        with pytest.raises(ConnectionError):
+            ep.recv(1.0)
+    finally:
+        raw.close()
+        ep.close()
+        listener.close()
+    # orderly close with zero bytes mid-message: ConnectionError too
+    listener = fleet.SocketTransport.listen()
+    raw = socket.create_connection(("127.0.0.1", listener.port))
+    ep = listener.accept(timeout=5.0)
+    try:
+        raw.close()
+        with pytest.raises(ConnectionError):
+            ep.recv(1.0)
+    finally:
+        ep.close()
+        listener.close()
+
+
+@pytest.mark.parametrize("kv", ["fp32", "int8"])
+@pytest.mark.parametrize("layout", ["contiguous", "paged"])
+def test_chunked_stream_bit_parity(fleet_env, kv, layout):
+    """The tentpole claim: a prefill handed off CHUNK BY CHUNK (rows
+    injected through the pow2 buckets while the worker computes the
+    next chunk) yields tokens bit-identical to one DecodeServer's
+    monolithic local admission — {contiguous, paged} x {fp32, int8 KV
+    storage}."""
+    fleet_env(PADDLE_TPU_STREAM_CHUNK_ROWS="4",
+              PADDLE_TPU_KV_DTYPE=None if kv == "fp32" else kv)
+    cfg = _cfg()
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    kw = _layout_kw(layout)
+    prompts = _prompts(seed=23)
+    ref = _single(params, cfg, prompts, **kw)
+    worker = fleet.PrefillWorker(params, cfg, max_len=48,
+                                 layout=layout, block_size=8)
+    router = fleet.Router(
+        [serving.DecodeServer(params, cfg, max_batch=2, max_len=48, **kw)
+         for _ in range(2)],
+        prefill=[worker], prefill_threshold=16)
+    got = _drive(router, prompts)
+    router.close()
+    assert got == ref
+    # the 20-token prompt crossed the wire in >= 2 chunks of raw rows
+    assert _count("fleet.stream_chunks") >= 2
+    assert _count("fleet.stream_bytes") > 0
+    assert _count("serving.stream_claims") >= 1
+
+
+def test_monolithic_flag_restores_whole_walk(fleet_env, cfg_params):
+    """PADDLE_TPU_STREAM_CHUNK_ROWS=0 restores the whole-walk reply
+    shape — still bit-identical, zero chunk frames on the wire."""
+    fleet_env(PADDLE_TPU_STREAM_CHUNK_ROWS="0")
+    cfg, params = cfg_params
+    prompts = _prompts(seed=29)
+    ref = _single(params, cfg, prompts)
+    worker = fleet.PrefillWorker(params, cfg, max_len=48)
+    router = fleet.Router(
+        [serving.DecodeServer(params, cfg, max_batch=2, max_len=48)
+         for _ in range(2)],
+        prefill=[worker], prefill_threshold=16)
+    got = _drive(router, prompts)
+    router.close()
+    assert got == ref
+    assert _count("fleet.stream_chunks") == 0
+    assert _count("fleet.prefill_handoffs") >= 1
+
+
+@requires_sockets
+def test_mid_stream_worker_death_fails_honestly(fleet_env, cfg_params):
+    """A worker that dies after ONE chunk (orderly close, no final
+    logits frame): the half-streamed request retires with ``error``,
+    its claimed replica slot frees, the drive loop never hangs, and the
+    replica keeps serving new work."""
+    fleet_env(PADDLE_TPU_STREAM_CHUNK_ROWS="4")
+    cfg, params = cfg_params
+    listener = fleet.SocketTransport.listen()
+    client = fleet.SocketTransport.connect("127.0.0.1", listener.port)
+    worker_side = listener.accept(timeout=5.0)
+    srv = serving.DecodeServer(params, cfg, max_batch=1, max_len=48)
+    router = fleet.Router([srv], prefill=[client], prefill_threshold=1)
+    prompt = [int(x) for x in
+              np.random.default_rng(31).integers(1, 60, 12)]
+    rid = router.submit(prompt, max_new_tokens=4)
+    job = worker_side.recv(5.0)
+    assert job["rid"] == rid
+    # compute real chunks locally, replay only the first, then die
+    helper = fleet.PrefillWorker(params, cfg, max_len=48)
+    msgs = []
+    helper.prefill_stream(job["prompt"], msgs.append, chunk_rows=4)
+    helper.close()
+    assert len(msgs) >= 2 and msgs[0].get("logits") is None
+    worker_side.send(dict(msgs[0], rid=rid))
+    deadline = time.time() + 10.0
+    while (router._requests[rid]["state"] != "streaming"
+           and time.time() < deadline):
+        router.tick()                    # absorb the first chunk
+        time.sleep(0.01)
+    assert router._requests[rid]["state"] == "streaming"
+    worker_side.close()                  # worker dies mid-stream
+    deadline = time.time() + 10.0
+    while (router.status(rid) in ("prefilling", "streaming")
+           and time.time() < deadline):
+        router.tick()
+        time.sleep(0.01)
+    assert router.status(rid) == "error"
+    with pytest.raises(RuntimeError):
+        router.result(rid)
+    assert not router.pending()
+    assert _count("fleet.stream_aborts") >= 1
+    assert not srv._slots and not srv._streams   # the claimed slot freed
+    rid2 = router.submit([4, 5], max_new_tokens=2)
+    deadline = time.time() + 20.0
+    while router.pending() and time.time() < deadline:
+        router.tick()
+        time.sleep(0.005)
+    assert router.status(rid2) == "ok"
+    router.close()
+    listener.close()
+
+
+def test_live_add_remove_replica_bit_identical(cfg_params):
+    """Elastic topology changes mid-flight: a replica attached LIVE
+    joins routing, a replica removed LIVE materializes its in-flight
+    results first — every token stream bit-identical to an undisturbed
+    single server."""
+    cfg, params = cfg_params
+    prompts = _prompts(n_short=5, seed=37)
+    ref = _single(params, cfg, prompts)
+    mk = lambda: serving.DecodeServer(params, cfg, max_batch=2,  # noqa: E731
+                                      max_len=48)
+    router = fleet.Router([mk(), mk()])
+    rids = [router.submit(p, max_new_tokens=6) for p in prompts]
+    for _ in range(2):
+        router.tick()
+    third = router.add_replica(mk())
+    assert _count("fleet.replica_adds") == 1
+    for _ in range(2):
+        router.tick()
+    removed = router.remove_replica(0)   # in-flight work materializes
+    removed.close()
+    assert _count("fleet.replica_removes") == 1
+    assert router.replicas[0] is None    # tombstone keeps indices valid
+    deadline = time.time() + 120.0
+    while router.pending() and time.time() < deadline:
+        router.tick()
+    got = [router.result(r) for r in rids]
+    assert got == ref
+    assert int(tl.gauge("fleet.replicas").get()) == 2
+    assert router.healthz()["ok"]
+    with pytest.raises(KeyError):
+        router.remove_replica(0)         # already tombstoned
+    router.close()
+    assert third == 2
+
+
+def test_autoscale_drill_out_then_in(fleet_env, cfg_params):
+    """The telemetry-driven scaling loop end to end: sustained
+    admission rung >= threshold attaches the registered spare
+    (fleet.scale_outs), sustained idle drains it back to the pool
+    (fleet.scale_ins) — debounced, never flapping on one hot tick."""
+    fleet_env(PADDLE_TPU_FLEET_AUTOSCALE="1",
+              PADDLE_TPU_FLEET_SCALE_RUNG="2",
+              PADDLE_TPU_FLEET_SCALE_OUT_TICKS="2",
+              PADDLE_TPU_FLEET_SCALE_IN_TICKS="3")
+    cfg, params = cfg_params
+    srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=48)
+    spare = serving.DecodeServer(params, cfg, max_batch=2, max_len=48)
+    router = fleet.Router([srv])
+    router.register_spare(spare)
+    live = lambda: sum(  # noqa: E731
+        1 for r in router.replicas if r is not None)
+    orig = srv.load_stats
+    srv.load_stats = lambda: dict(orig(), admission_rung=2,
+                                  queue_depth=1)
+    router.tick()                        # hot tick 1: debounced
+    assert live() == 1 and _count("fleet.scale_outs") == 0
+    router.tick()                        # hot tick 2: spare attaches
+    assert live() == 2
+    assert _count("fleet.scale_outs") == 1
+    assert int(tl.gauge("fleet.replicas").get()) == 2
+    srv.load_stats = orig                # load clears: fleet goes idle
+    for _ in range(3):
+        assert live() == 2               # scale-in debounce holds
+        router.tick()
+    assert live() == 1
+    assert _count("fleet.scale_ins") == 1
+    assert router._spares == [spare]     # drained back to the pool
+    assert int(tl.gauge("fleet.replicas").get()) == 1
+    router.close()
+
+
+def test_chain_migration_follows_the_prompt(fleet_env):
+    """Cross-replica spilled-chain migration: a host-RAM chain on
+    replica A ships to replica B through the raw wire codec (a MOVE —
+    the source forgets it), lands in B's spill store, and B's
+    admission restores it bit-identically through its own inject
+    buckets (kv_pool.chain_migrations counted)."""
+    fleet_env(PADDLE_TPU_KV_SPILL_MB="4")
+    cfg = _cfg()
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    prompt = [int(x) for x in
+              np.random.default_rng(41).integers(1, 60, 16)]
+    ref = _single(params, cfg, [prompt], layout="paged", block_size=8)
+    mk = lambda: serving.DecodeServer(params, cfg, max_batch=2,  # noqa: E731
+                                      max_len=48, layout="paged",
+                                      block_size=8)
+    a, b = mk(), mk()
+    router = fleet.Router([a, b])
+    # warm the chain on A (direct submit — the drain-spares contract),
+    # then demote it to A's host-RAM spill tier
+    r0 = a.submit(prompt, max_new_tokens=6)
+    while a.pending():
+        a.tick()
+    assert a.result(r0) == ref[0]
+    for _ in range(8):
+        if not a._pool.prefix_entries:
+            break
+        a._evict_or_spill(8)
+    assert a._pool._spilled
+    # the routing hook: before B adopts this prompt, A's chain moves
+    router._migrate_chains({"prompt": prompt}, 1)
+    assert not a._pool._spilled           # a move, not a copy
+    assert b._pool._spilled
+    assert _count("kv_pool.chain_migrations") >= 1
+    assert _count("kv_pool.chain_migrations_out") >= 1
+    r1 = b.submit(prompt, max_new_tokens=6)
+    while b.pending():
+        b.tick()
+    warm = b.result(r1)
+    stats = b._pool.stats()
+    router.close()
+    assert warm == ref[0]
+    assert stats["restored_blocks"] >= 1
+    assert stats["chain_migrations"] >= 1
+
+
+def test_stream_lint_family_and_pickle_ban():
+    """The STREAM lint rules hold on fixtures AND on the shipped tree:
+    every stream/scale/migrate-named path counts or delegates, and
+    text/fleet.py carries zero pickle sites."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                    "tools"))
+    import check_instrumented as ci
+
+    bad = ("class R:\n"
+           "    def _stream_chunk(self, m):\n"
+           "        return m\n"
+           "    def _scale_out(self):\n"
+           "        self.n += 1\n")
+    assert len(ci.scan_stream_source(bad)) == 2
+    good = ("class R:\n"
+            "    def _scale_in(self):\n"
+            "        count('fleet.scale_ins')\n"
+            "    def _migrate_chains(self, req, i):\n"
+            "        self._scale_in()\n")
+    assert not ci.scan_stream_source(good)
+    assert ci.scan_pickle_ban_source("import pickle\n")
+    assert ci.scan_pickle_ban_source(
+        "def recv(self):\n    return pickle.loads(b'')\n")
+    assert not ci.scan_pickle_ban_source(
+        "import json\nx = json.loads('{}')\n")
+    root = os.path.join(os.path.dirname(__file__), os.pardir)
+    for rel in ("paddle_tpu/text/fleet.py", "paddle_tpu/text/kv_pool.py"):
+        with open(os.path.join(root, rel), encoding="utf-8") as f:
+            assert not ci.scan_stream_source(f.read(), rel)
+    with open(os.path.join(root, "paddle_tpu/text/fleet.py"),
+              encoding="utf-8") as f:
+        assert not ci.scan_pickle_ban_source(f.read(), "fleet.py")

@@ -178,6 +178,27 @@ PREFIX_FILES = (
 )
 PREFIX_MARKERS = ("split", "spill", "restore", "prefix_route")
 
+# STREAM lint (round 18, same rule family): every zero-copy streaming /
+# elastic-scaling / chain-migration path across the fleet transport and
+# the KV pool — the per-chunk handoff emit, the chunked inject, the
+# scale-out/scale-in transitions, cross-replica chain migration — must
+# count a telemetry counter (fleet.stream_chunks / fleet.stream_bytes /
+# fleet.scale_outs / fleet.scale_ins / kv_pool.chain_migrations) or
+# delegate to another marker-named callable.  The chunked handoff's
+# whole value claim is measured overlap; an uncounted chunk or silent
+# topology change makes the TTFT win and the replica gauge unfalsifiable.
+STREAM_FILES = (
+    os.path.join("paddle_tpu", "text", "fleet.py"),
+    os.path.join("paddle_tpu", "text", "kv_pool.py"),
+)
+STREAM_MARKERS = ("stream", "scale_out", "scale_in", "migrate")
+
+# STREAM lint rule (b): the raw-row transport exists to get pickle OFF
+# the KV handoff path — a deserialization gadget surface AND a full
+# host-side copy per hop.  Any ``pickle.`` attribute use (loads, dumps,
+# Pickler, ...) or ``import pickle`` in text/fleet.py fails outright.
+PICKLE_BAN_FILE = os.path.join("paddle_tpu", "text", "fleet.py")
+
 
 def _call_name(node: ast.Call):
     f = node.func
@@ -331,6 +352,70 @@ def scan_prefix_cache_source(src: str, filename: str = "<src>") -> list:
                  f"prefix-cache site {node.name}() records no telemetry "
                  f"counter (count) — uncounted splits/spills make the "
                  f"prefix hit-rate gauge a lie"))
+    return violations
+
+
+def scan_stream_source(src: str, filename: str = "<src>") -> list:
+    """STREAM lint violations in one source string: a function whose
+    name carries a :data:`STREAM_MARKERS` marker (a chunked-handoff,
+    elastic-scaling, or chain-migration path) must contain a call to
+    one of :data:`COUNT_NAMES` or delegate to another marker-named
+    callable."""
+    tree = ast.parse(src, filename=filename)
+    violations = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and any(m in node.name for m in STREAM_MARKERS)):
+            continue
+        counted = any(
+            isinstance(n, ast.Call)
+            and (_call_name(n) in COUNT_NAMES
+                 or any(m in (_call_name(n) or "")
+                        for m in STREAM_MARKERS))
+            for n in ast.walk(node))
+        if not counted:
+            violations.append(
+                (filename, node.lineno,
+                 f"streaming/elastic path {node.name}() records no "
+                 f"telemetry counter (count) — an uncounted chunk or "
+                 f"silent scale event makes the overlap win and the "
+                 f"replica gauge unfalsifiable"))
+    return violations
+
+
+def scan_pickle_ban_source(src: str, filename: str = "<src>") -> list:
+    """STREAM lint rule (b) violations: any ``pickle`` import or
+    ``pickle.<attr>`` reference in the fleet transport.  The raw-row
+    protocol's security/perf claim is that NO object deserialization
+    sits on the KV handoff path — one stray ``pickle.loads`` reopens
+    both the gadget surface and the full host-side copy."""
+    tree = ast.parse(src, filename=filename)
+    violations = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.split(".")[0] == "pickle":
+                    violations.append(
+                        (filename, node.lineno,
+                         "import pickle in the fleet transport — the "
+                         "raw-row protocol bans object deserialization "
+                         "on the KV handoff path"))
+        elif isinstance(node, ast.ImportFrom):
+            if (node.module or "").split(".")[0] == "pickle":
+                violations.append(
+                    (filename, node.lineno,
+                     "from pickle import ... in the fleet transport — "
+                     "the raw-row protocol bans object deserialization "
+                     "on the KV handoff path"))
+        elif (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "pickle"):
+            violations.append(
+                (filename, node.lineno,
+                 f"pickle.{node.attr} site in the fleet transport — "
+                 f"frames are struct-prefixed JSON headers + raw "
+                 f"buffers; pickle reopens the gadget surface and the "
+                 f"host-side copy"))
     return violations
 
 
@@ -600,6 +685,19 @@ def scan_repo(root: str | None = None) -> list:
             with open(px_path, encoding="utf-8") as f:
                 violations.extend(scan_prefix_cache_source(
                     f.read(), os.path.relpath(px_path, root)))
+    # STREAM lint: chunked handoff / elastic scaling / chain migration
+    # observability, plus the pickle ban on the fleet transport
+    for rel in STREAM_FILES:
+        st_path = os.path.join(root, rel)
+        if os.path.exists(st_path):
+            with open(st_path, encoding="utf-8") as f:
+                violations.extend(scan_stream_source(
+                    f.read(), os.path.relpath(st_path, root)))
+    pb_path = os.path.join(root, PICKLE_BAN_FILE)
+    if os.path.exists(pb_path):
+        with open(pb_path, encoding="utf-8") as f:
+            violations.extend(scan_pickle_ban_source(
+                f.read(), os.path.relpath(pb_path, root)))
     # speculative-decoding lint: accept/propose/fallback observability
     spec_path = os.path.join(root, SPEC_FILE)
     if os.path.exists(spec_path):
