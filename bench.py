@@ -2049,6 +2049,105 @@ def _prefix_smoke():
             "spill_cycle_hit_rate": round(hit_rate, 3)}
 
 
+def _moe_smoke():
+    """MoE serving round, run by ``--config gpt --small`` (CI): joint-
+    routing decode through the Engine's moe_* kinds must be greedy
+    bit-identical to the capacity-free dense-eval reference on BOTH
+    layouts at a dropless capacity factor with ZERO device-counted
+    drops; the capacity-overflow drop counter must equal host-replayed
+    routing exactly at cf=0.5; a re-serve after warmup must add zero
+    executables."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.text import gpt, moe_serving, serving
+    from paddle_tpu.text.moe import MoEConfig
+
+    base = dict(vocab_size=128, hidden_size=64, num_layers=2,
+                num_heads=4, max_seq_len=64)
+    cfg = gpt.GPTConfig(moe=MoEConfig(num_experts=4, top_k=2,
+                                      capacity_factor=1.25,
+                                      router_noise=0.0), **base)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(23)
+    prompts = [[int(x) for x in rng.integers(1, 120, n)] for n in (6, 5)]
+    ref = [moe_serving.dense_reference_greedy(params, cfg, p, 8, 40)
+           for p in prompts]
+
+    def serve(**kw):
+        srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=40,
+                                   **kw)
+        rids = [srv.submit(p, max_new_tokens=8) for p in prompts]
+        while srv.pending():
+            srv.tick()
+        return srv, [srv.result(r) for r in rids], srv.load_stats()
+
+    srv_c, toks_c, ls_c = serve()
+    srv_p, toks_p, ls_p = serve(layout="paged", block_size=8)
+    for name, toks, ls in (("contiguous", toks_c, ls_c),
+                           ("paged", toks_p, ls_p)):
+        if toks != ref:
+            raise AssertionError(
+                f"moe smoke: {name} joint-routing tokens diverged from "
+                f"the dense-eval reference ({toks} vs {ref})")
+        if ls["moe_dropped_tokens"] != 0:
+            raise AssertionError(
+                f"moe smoke: {name} dropless round counted "
+                f"{ls['moe_dropped_tokens']} dropped assignments")
+
+    # post-warmup: the same shapes must hit the Engine LRU, never the
+    # compiler (srv_p stays open — close() evicts by config VALUE and
+    # both servers share the cfg)
+    keys0 = set(serving._STEP_CACHE.keys())
+    rid = srv_c.submit(prompts[0], max_new_tokens=8)
+    while srv_c.pending():
+        srv_c.tick()
+    again = srv_c.result(rid)
+    added = set(serving._STEP_CACHE.keys()) - keys0
+    srv_c.close()
+    srv_p.close()
+    if again != ref[0]:
+        raise AssertionError(
+            f"moe smoke: warm re-serve diverged ({again} vs {ref[0]})")
+    if added:
+        raise AssertionError(
+            f"moe smoke: post-warmup re-serve retraced — new "
+            f"executables {sorted(added)}")
+
+    # capacity overflow: zeroed router -> uniform softmax -> top_k
+    # tie-break sends every token to experts {0, 1}; at cf=0.5 with
+    # max_batch=2 the capacity is C=1, a schedule the host replays
+    # exactly — the device counter must equal it
+    ocfg = gpt.GPTConfig(moe=MoEConfig(num_experts=4, top_k=2,
+                                       capacity_factor=0.5,
+                                       router_noise=0.0), **base)
+    oparams = gpt.init_params(ocfg, jax.random.PRNGKey(3))
+    oparams["blocks"]["moe"]["router_w"] = jnp.zeros_like(
+        oparams["blocks"]["moe"]["router_w"])
+    L = ocfg.num_layers
+    srv = serving.DecodeServer(oparams, ocfg, max_batch=2, max_len=32)
+    rids = [srv.submit([1, 2], max_new_tokens=4),
+            srv.submit([3, 4, 5], max_new_tokens=4)]
+    exp_dropped = 0
+    while srv.pending():
+        active = sum(1 for st in srv._slots.values()
+                     if not st.get("admitting"))
+        srv.tick()
+        if active:
+            exp_dropped += 2 * L * max(0, active - 1)
+    dropped = srv.load_stats()["moe_dropped_tokens"]
+    srv.close()
+    if exp_dropped <= 0:
+        raise AssertionError("moe smoke: overflow schedule never bit")
+    if dropped != exp_dropped:
+        raise AssertionError(
+            f"moe smoke: device drop counter {dropped} != host-replayed "
+            f"routing {exp_dropped} — 'bounded drop rate' is a guess")
+    return {"ok": True, "expert_load": ls_c["moe_expert_load"],
+            "overflow_drops": dropped, "drop_counter_exact": True}
+
+
 def bench_gpt(small: bool):
     if small:
         rec = _run_gpt_rung(-1)
@@ -2093,6 +2192,11 @@ def bench_gpt(small: bool):
         # constrained request completing valid JSON + zero post-warmup
         # retraces asserted (see _multilora_smoke)
         rec["multilora_smoke"] = _multilora_smoke()
+        # MoE serving rides the CI smoke: joint-routing decode parity
+        # vs the capacity-free dense-eval reference on both layouts,
+        # exact host-replayed drop accounting at overflow, zero
+        # post-warmup retraces asserted (see _moe_smoke)
+        rec["moe_smoke"] = _moe_smoke()
         # provenance-schema gate (CI): a bench line whose provenance
         # block is missing or incomplete must fail the smoke — a silent
         # CPU fallback can never again ship as an unlabeled number
@@ -4494,6 +4598,168 @@ def bench_multilora(small: bool):
     return _stamp_provenance(rec, dev)
 
 
+def bench_moe(small: bool):
+    """MoE serving (round 19): joint expert routing through the
+    Engine's moe_* kinds vs the capacity-free dense evaluation, plus a
+    drop-rate-vs-capacity-factor sweep.
+
+    Arms (same prompts, warm pass first):
+
+    1. **dispatch** — DecodeServer steady decode tok/s with the routed
+       tail (top-k experts per token, capacity-bounded joint routing),
+       at the structurally dropless cf = E/k (capacity >= batch, so
+       routing cannot drop and tokens are reference-exact).
+    2. **dense_eval** — the same batch stepped through
+       ``dense_eval_decode_step`` (EVERY expert computed for every
+       token, gate-weighted): the compute ceiling expert dispatch
+       exists to undercut, and simultaneously the parity reference —
+       arm 1's greedy tokens must equal arm 2's token for token.
+
+    Sweep: capacity_factor in {0.5, 1.0, 2.0, E/k} at full occupancy;
+    drop rate = dropped / (dropped + kept) from the device counters.
+    Asserted: bit parity dispatch == dense_eval; drop rate > 0 at
+    cf=0.5 and exactly 0 at cf=E/k; zero post-warmup retraces in the
+    timed arm.  Prompts are short (the admission prefill is one
+    executable vs the dense arm's python loop — keeping it tiny makes
+    both arms ~pure decode)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.text import generate, gpt, moe_serving, serving
+    from paddle_tpu.text.moe import MoEConfig
+
+    dev = jax.devices()[0]
+    E, K = 8, 2
+    # fp32 compute: the routed tail and the dense evaluation sum the
+    # same expert terms in different einsum orders, so bf16 rounding
+    # can flip a greedy argmax on a random-init model at this width —
+    # fp32 keeps the order-divergence ~1e-7, far under any logit gap,
+    # and the bit-parity gate below stays meaningful
+    if small:
+        base = dict(vocab_size=512, hidden_size=256, num_layers=4,
+                    num_heads=8, max_seq_len=256, dtype=jnp.float32)
+        B, p_len, new_toks, sweep_toks = 4, 4, 64, 16
+    else:
+        base = dict(vocab_size=2048, hidden_size=512, num_layers=8,
+                    num_heads=8, max_seq_len=512, dtype=jnp.float32)
+        B, p_len, new_toks, sweep_toks = 8, 4, 128, 32
+    max_len = p_len + new_toks + 8
+
+    def mcfg(cf):
+        return gpt.GPTConfig(moe=MoEConfig(num_experts=E, top_k=K,
+                                           capacity_factor=cf,
+                                           router_noise=0.0), **base)
+
+    cf_free = float(E) / K                   # C >= B for any B: dropless
+    cfg = mcfg(cf_free)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(19)
+    prompts = [[int(x) for x in rng.integers(1, base["vocab_size"], p_len)]
+               for _ in range(B)]
+
+    def drive(srv, n_new):
+        rids = [srv.submit(p, max_new_tokens=n_new) for p in prompts]
+        while srv.pending():
+            srv.tick()
+        return [srv.result(r) for r in rids]
+
+    # dispatch arm: warm pass compiles, timed pass must not — the
+    # server stays open between them (close() evicts by config value)
+    srv = serving.DecodeServer(params, cfg, max_batch=B,
+                               max_len=max_len)
+    drive(srv, new_toks)
+    keys0 = set(serving._STEP_CACHE.keys())
+    t0 = time.perf_counter()
+    toks_route = drive(srv, new_toks)
+    wall_route = time.perf_counter() - t0
+    added = set(serving._STEP_CACHE.keys()) - keys0
+    srv.close()
+    if added:
+        raise AssertionError(
+            f"moe bench: timed dispatch arm retraced — new executables "
+            f"{sorted(added)}")
+
+    # dense-eval arm: batch cache, shared scalar pos (prompts are
+    # equal-length), greedy feed — timed over the decode phase
+    dstep = jax.jit(lambda p_, c_, t_, pos_: moe_serving
+                    .dense_eval_decode_step(p_, c_, t_, pos_, cfg))
+
+    def dense_run():
+        cache = generate.init_cache(cfg, B, max_len)
+        tok = jnp.asarray([p[0] for p in prompts], jnp.int32)
+        for i in range(p_len - 1):
+            _, cache = dstep(params, cache, tok, jnp.int32(i))
+            tok = jnp.asarray([p[i + 1] for p in prompts], jnp.int32)
+        out = [[] for _ in range(B)]
+        t1 = time.perf_counter()
+        pos = p_len - 1
+        for _ in range(new_toks):
+            logits, cache = dstep(params, cache, tok, jnp.int32(pos))
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            for b, t in enumerate(np.asarray(tok)):
+                out[b].append(int(t))
+            pos += 1
+        jax.block_until_ready(logits)
+        return out, time.perf_counter() - t1
+
+    dense_run()                              # warm the dense-eval jit
+    toks_dense, wall_dense = dense_run()
+    if toks_route != toks_dense:
+        raise AssertionError(
+            f"moe bench: dispatch tokens diverged from the dense-eval "
+            f"ceiling ({toks_route} vs {toks_dense})")
+    tok_s_route = B * new_toks / max(wall_route, 1e-9)
+    tok_s_dense = B * new_toks / max(wall_dense, 1e-9)
+
+    # capacity sweep: fresh cfg per cf (cf is a jit key by design —
+    # capacity is a shape)
+    sweep = []
+    for cf in (0.5, 1.0, 2.0, cf_free):
+        scfg = mcfg(cf)
+        sp = params if cf == cf_free else gpt.init_params(
+            scfg, jax.random.PRNGKey(0))
+        ssrv = serving.DecodeServer(sp, scfg, max_batch=B,
+                                    max_len=max_len)
+        drive(ssrv, sweep_toks)              # warm
+        t0 = time.perf_counter()
+        drive(ssrv, sweep_toks)
+        wall = time.perf_counter() - t0
+        ls = ssrv.load_stats()               # totals over both passes
+        ssrv.close()
+        kept = sum(ls["moe_expert_load"])
+        dropped = ls["moe_dropped_tokens"]
+        sweep.append({"capacity_factor": cf,
+                      "drop_rate": round(
+                          dropped / max(1, dropped + kept), 4),
+                      "dropped": dropped,
+                      "tok_s": round(B * sweep_toks / max(wall, 1e-9),
+                                     2)})
+    if sweep[0]["dropped"] <= 0:
+        raise AssertionError(
+            f"moe bench: cf=0.5 at full occupancy never dropped — the "
+            f"sweep is not exercising capacity ({sweep})")
+    if sweep[-1]["dropped"] != 0:
+        raise AssertionError(
+            f"moe bench: structurally dropless cf={cf_free} counted "
+            f"{sweep[-1]['dropped']} drops ({sweep})")
+
+    rec = {"metric": "moe_dispatch_tok_s", "unit": "tokens/s",
+           "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
+               timespec="seconds"),
+           "device": dev.platform,
+           "device_kind": str(getattr(dev, "device_kind", "")),
+           "num_experts": E, "top_k": K, "batch": B,
+           "new_tokens": new_toks,
+           "value": round(tok_s_route, 2),
+           "dense_eval_tok_s": round(tok_s_dense, 2),
+           "vs_dense_eval": round(tok_s_route / max(tok_s_dense, 1e-9),
+                                  3),
+           "capacity_sweep": sweep,
+           "vs_baseline": 0.0}
+    return _stamp_provenance(rec, dev)
+
+
 _CONFIGS = {"gpt": bench_gpt, "train": bench_train, "mnist": bench_mnist,
             "resnet": bench_resnet, "bert": bench_bert, "int8": bench_int8,
             "decode": bench_decode, "decode_long": bench_decode_long,
@@ -4501,7 +4767,8 @@ _CONFIGS = {"gpt": bench_gpt, "train": bench_train, "mnist": bench_mnist,
             "fleet": bench_fleet, "stream": bench_stream,
             "spec": bench_spec,
             "mixed": bench_mixed, "overload": bench_overload,
-            "multilora": bench_multilora, "prefix": bench_prefix}
+            "multilora": bench_multilora, "prefix": bench_prefix,
+            "moe": bench_moe}
 
 
 def main():

@@ -371,6 +371,25 @@ def kv_spill_batch() -> int:
         return 8
 
 
+def kv_spill_rss_mb() -> int:
+    """Host-RSS watchdog threshold in MiB
+    (``PADDLE_TPU_KV_SPILL_RSS_MB``, default 0 = watchdog off).  When
+    the process resident set crosses the threshold, the paged
+    allocator's per-tick watchdog (:meth:`PagedAllocator.rss_watchdog`)
+    engages one BOUNDED relief round: the oldest host-spilled prefix
+    chains are released first (the spill store is the host tier the
+    watchdog guards), then cold device-index leaves demote through the
+    normal evict-cold LRU rung — at most ``PADDLE_TPU_KV_SPILL_BATCH``
+    entries per round, so a hot server sheds pressure over ticks
+    instead of stalling one.  Host scheduling only — NEVER a jit-cache
+    key."""
+    try:
+        return max(0, int(os.environ.get("PADDLE_TPU_KV_SPILL_RSS_MB",
+                                         "0")))
+    except ValueError:
+        return 0
+
+
 def kv_restore() -> bool:
     """Restore policy for spilled prefix chains (ON by default).
     ``PADDLE_TPU_KV_RESTORE=0`` keeps the spill store write-only —

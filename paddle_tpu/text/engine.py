@@ -194,7 +194,7 @@ class _ShardCtx:
     single-chip executables."""
 
     def __init__(self, mesh, cfg, params, cache, mp: str = "mp",
-                 pool=None):
+                 pool=None, ep: str | None = None):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from . import generate
@@ -202,10 +202,32 @@ class _ShardCtx:
         if mp not in mesh.shape:
             raise ValueError(f"mesh has no {mp!r} axis (axes: "
                              f"{tuple(mesh.shape)})")
+        if ep is not None:
+            if cfg.moe is None:
+                raise ValueError("ep axis given but cfg.moe is None — "
+                                 "expert parallelism needs experts")
+            if ep not in mesh.shape:
+                raise ValueError(f"mesh has no {ep!r} axis (axes: "
+                                 f"{tuple(mesh.shape)})")
+            if cfg.moe.num_experts % mesh.shape[ep] != 0:
+                raise ValueError(
+                    f"num_experts={cfg.moe.num_experts} not divisible by "
+                    f"ep axis size {mesh.shape[ep]}")
         self.mesh = mesh
         self.mp = mp
+        self.ep = ep
         ns = lambda s: NamedSharding(mesh, s)  # noqa: E731
-        pspecs = generate._decode_param_specs(params, cfg, mp)
+        if cfg.moe is not None:
+            # MoE params carry blocks/moe/* leaves the legacy resolver
+            # has no placements for — the round-19 regex table covers
+            # them (dense leaves pinned equal by test); ep=None serves
+            # the experts replicated (pure TP over an MoE model)
+            from . import moe_serving as _moe_serving
+
+            pspecs = _moe_serving.moe_decode_param_specs(
+                params, cfg, mp=mp, ep=ep)
+        else:
+            pspecs = generate._decode_param_specs(params, cfg, mp)
         self.params = jax.tree_util.tree_map(
             ns, pspecs, is_leaf=lambda s: isinstance(s, P))
         self.cache = {
@@ -222,6 +244,11 @@ class _ShardCtx:
             self.adapters = None
         self.key = (mp, tuple(mesh.shape.items()),
                     tuple(int(d.id) for d in mesh.devices.flat))
+        if ep is not None:
+            # the ep placement changes the compiled program (all_to_all
+            # vs replicated experts) — two contexts differing only in ep
+            # must never share an executable
+            self.key = self.key + (("ep", ep),)
 
 
 def _shard_kw(shard, n_extra: int, outs: str,
@@ -683,6 +710,161 @@ def _build_masked_step(spec: StepSpec):
         **_shard_kw(spec.shard, 7, "rc"))
 
 
+# -- MoE serving kinds (round 19: text/moe_serving.py) ---------------------
+#
+# The expert-parallel StepSpec family: joint-routing step bodies that
+# thread the device-side drop accumulator (moe_serving.moe_stats_init)
+# through the jit like the cache and take the occupied-slot mask ``act``
+# as a runtime input.  Keys stay on the standard fragments — cfg_key
+# already embeds (E, top_k, capacity_factor, ...) via moe_key and the
+# shard key carries ("ep", axis) when expert parallelism is on, so the
+# "(E, C, ep)" keying the subsystem promises falls out of the existing
+# authorities.  The prefill kinds are THIN wrappers of the dense prefill
+# bodies: chunked admission routes with valid= + the dropless capacity
+# override (moe_ffn capacity=N), which is already MoE-exact — they exist
+# as distinct kinds so an MoE server's admission compiles are named and
+# keyed apart from a dense server's.
+
+
+@register("moe_step",
+          key=lambda s: ("moe_step", cfg_key(s.cfg), s.paged,
+                         _shard_key(s.shard)),
+          name="serving.moe_step")
+def _build_moe_step(spec: StepSpec):
+    """Greedy joint-routing batched step: (p, cache, tok [B], pos [B],
+    act [B], stats) -> (logits [B, V], cache, stats')."""
+    from . import moe_serving
+
+    return jax.jit(
+        lambda p, c, t, s, a, st, _cfg=spec.cfg:
+        moe_serving.moe_decode_step_batched(p, c, t, s, a, st, _cfg),
+        donate_argnums=donate_cache(),
+        **_shard_kw(spec.shard, 4, "rcr"))
+
+
+@register("moe_sample",
+          key=lambda s: ("moe_sample", cfg_key(s.cfg), s.paged,
+                         _shard_key(s.shard)),
+          name="serving.moe_sample_step")
+def _build_moe_sample(spec: StepSpec):
+    """Sampled joint-routing step: the moe_step body + the shared
+    per-slot sampler (same key schedule as the dense ``sample`` kind)."""
+    from . import moe_serving
+
+    return jax.jit(
+        lambda p, c, t, s, ky, te, tk, tp, a, st, _cfg=spec.cfg:
+        moe_serving.moe_sample_step_batched(p, c, t, s, ky, te, tk, tp,
+                                            a, st, _cfg),
+        donate_argnums=donate_cache(),
+        **_shard_kw(spec.shard, 8, "rcr"))
+
+
+@register("moe_block",
+          key=lambda s: ("moe_block", cfg_key(s.cfg), s.k, s.paged,
+                         _shard_key(s.shard)),
+          name=lambda s: f"serving.moe_block@{s.k}")
+def _build_moe_block(spec: StepSpec):
+    """Greedy joint-routing block: k steps on device, one host fetch —
+    (p, cache, tok, pos, act, stats) -> (toks [B, k], cache, tok, pos,
+    stats').  ``act`` is dispatch-time occupancy for the whole block."""
+    from . import moe_serving
+
+    return jax.jit(
+        lambda p, c, t, s, a, st, _cfg=spec.cfg, _k=spec.k:
+        moe_serving.moe_decode_block_batched(p, c, t, s, a, st, _k, _cfg),
+        donate_argnums=donate_cache(),
+        **_shard_kw(spec.shard, 4, "rcrrr"))
+
+
+@register("moe_async",
+          key=lambda s: ("moe_async", cfg_key(s.cfg), s.paged,
+                         _shard_key(s.shard)),
+          name="serving.moe_async_step")
+def _build_moe_async(spec: StepSpec):
+    """Async-dispatch joint-routing tick: the device-side feed select
+    (``pm`` picks the in-flight step's tokens over the host feed — see
+    the dense ``async`` kind) in front of the sampled moe step."""
+    from . import moe_serving
+
+    return jax.jit(
+        lambda p, c, ht, pm, pv, s, ky, te, tk, tp, a, st, _cfg=spec.cfg:
+        moe_serving.moe_sample_step_batched(p, c, jnp.where(pm, pv, ht),
+                                            s, ky, te, tk, tp, a, st,
+                                            _cfg),
+        donate_argnums=donate_cache(),
+        **_shard_kw(spec.shard, 10, "rcr"))
+
+
+@register("moe_prefill",
+          key=lambda s: ("moe_prefill", cfg_key(s.cfg), int(s.bucket),
+                         _shard_key(s.shard)),
+          name=lambda s: f"serving.moe_prefill@{s.bucket}")
+def _build_moe_prefill(spec: StepSpec):
+    """Bucketed MoE admission: generate.prefill_slot already routes the
+    padded bucket with valid= masking + the dropless capacity override,
+    which is exact for MoE — this kind only names/keys those compiles
+    apart from dense servers'."""
+    from . import generate
+
+    return jax.jit(
+        lambda p, c, t, ln, sl, _cfg=spec.cfg:
+        generate.prefill_slot(p, c, t, ln, sl, _cfg),
+        donate_argnums=donate_cache(),
+        **_shard_kw(spec.shard, 3, "rc"))
+
+
+@register("moe_prefill_chunk",
+          key=lambda s: ("moe_prefill_chunk", cfg_key(s.cfg),
+                         _shard_key(s.shard),
+                         None if s.width is None else int(s.width)),
+          name=lambda s: ("serving.moe_prefill_chunk" if s.width is None
+                          else f"serving.moe_prefill_chunk@{int(s.width)}"))
+def _build_moe_prefill_chunk(spec: StepSpec):
+    """Chunked/budgeted MoE admission (dropless — see moe_prefill)."""
+    from . import generate
+
+    return jax.jit(
+        lambda p, c, t, p0, ln, sl, _cfg=spec.cfg:
+        generate.prefill_slot_chunk(p, c, t, p0, ln, sl, _cfg),
+        donate_argnums=donate_cache(),
+        **_shard_kw(spec.shard, 4, "rc"))
+
+
+@register("moe_paged_prefill",
+          key=lambda s: ("moe_paged_prefill", cfg_key(s.cfg),
+                         int(s.bucket), _shard_key(s.shard)),
+          name=lambda s: f"serving.moe_paged_prefill@{s.bucket}")
+def _build_moe_paged_prefill(spec: StepSpec):
+    """Paged MoE admission (dropless — see moe_prefill)."""
+    from . import kv_pool
+
+    return jax.jit(
+        lambda p, c, t, p0, ln, sl, _cfg=spec.cfg:
+        kv_pool.paged_prefill_chunk(p, c, t, p0, ln, sl, _cfg),
+        donate_argnums=donate_cache(),
+        **_shard_kw(spec.shard, 4, "rc"))
+
+
+@register("moe_verify",
+          key=lambda s: ("moe_verify", cfg_key(s.cfg), int(s.k), s.paged,
+                         _shard_key(s.shard)),
+          name=lambda s: f"serving.moe_verify@{s.k}")
+def _build_moe_verify(spec: StepSpec):
+    """Speculative verify over an MoE target: the chunked verify body
+    routes the [B, K+1] window per slot with the dropless capacity
+    override, so acceptance is exact vs the solo target.  Registered and
+    unit-tested; DecodeServer still REJECTS spec x MoE at construction —
+    batched verify's joint-routing twin (capacity semantics across
+    slots' windows) is the ROADMAP follow-up this kind is staged for."""
+    from . import serving
+
+    return jax.jit(
+        lambda p, c, t, s, _cfg=spec.cfg:
+        serving.spec_verify_batched(p, c, t, s, _cfg),
+        donate_argnums=donate_cache(),
+        **_shard_kw(spec.shard, 2, "rc"))
+
+
 # -- adapter kinds (multi-tenant serving: text/adapters.py) ----------------
 #
 # Every kind below keys on ``pkey`` (AdapterPool.pool_key() — the pool
@@ -1065,6 +1247,15 @@ class Engine:
             timings[name] = round(_time.perf_counter() - t0, 3)
 
         tok, pos = jnp.asarray(zi), jnp.asarray(zi)
+        moe = srv.cfg.moe is not None
+        if moe:
+            # the joint-routing kinds' extra runtime inputs: an all-False
+            # occupancy mask (an idle server's act — zero valid tokens,
+            # so the warm routes claim nothing and the stats delta is
+            # exactly zero: a warmed MoE server's counters match a cold
+            # one's) and the live accumulator
+            mact = jnp.asarray(zb)
+            mst = srv._moe_stats
         pool = srv._adapters
         if pool is not None:
             pk = pool.pool_key()
@@ -1096,6 +1287,13 @@ class Engine:
                     srv.params, srv.cache, ad, ids0, tok, pos, key,
                     jnp.asarray(zf), jnp.asarray(zi), jnp.asarray(of),
                     zm))
+        elif srv._async and moe:
+            fn = self.get("moe_async", tspec(paged=srv._paged))
+            warm("moe_async_step", lambda: fn(
+                srv.params, srv.cache, tok, jnp.asarray(zb), tok, pos,
+                key, jnp.asarray(zf), jnp.asarray(zi), jnp.asarray(of),
+                mact, mst))
+            # constrained x MoE is rejected at submit — nothing to warm
         elif srv._async:
             fn = self.get("async", tspec(paged=srv._paged))
             warm("async_step", lambda: fn(
@@ -1111,14 +1309,23 @@ class Engine:
                     jnp.asarray(zf), jnp.asarray(zi), jnp.asarray(of),
                     zm))
         else:
+            # srv._step is the moe-wrapped joint step under MoE (the
+            # wrapper appends act+stats and peels the stats output), so
+            # this one call warms moe_step and step alike
             warm("step", lambda: srv._step(srv.params, srv.cache, tok,
                                            pos))
-            if sample:
+            if sample and moe:
+                fn = self.get("moe_sample", tspec(paged=srv._paged))
+                warm("moe_sample_step", lambda: fn(
+                    srv.params, srv.cache, tok, pos, key,
+                    jnp.asarray(zf), jnp.asarray(zi), jnp.asarray(of),
+                    mact, mst))
+            elif sample:
                 fn = self.get("sample", tspec(paged=srv._paged))
                 warm("sample_step", lambda: fn(
                     srv.params, srv.cache, tok, pos, key,
                     jnp.asarray(zf), jnp.asarray(zi), jnp.asarray(of)))
-            if constrained:
+            if constrained and not moe:
                 fn = self.get("masked_step", tspec(paged=srv._paged))
                 zm = jnp.zeros((B, srv.cfg.vocab_size), jnp.float32)
                 warm("masked_step", lambda: fn(
@@ -1138,6 +1345,16 @@ class Engine:
                 warm(f"adapter_block{k}", lambda fn=fn: fn(
                     srv.params, srv.cache, ad, ids0, tok, pos)[:2])
                 # sampled pool traffic steps through adapter_sample_step
+                # (tick_block's stepwise fallback) — no sampled block
+            elif srv._async and moe:
+                # async MoE tick_block drains to stepwise async ticks
+                # (moe_async_step, warmed above) — no block executable
+                continue
+            elif moe:
+                fn = self.get("moe_block", tspec(paged=srv._paged, k=k))
+                warm(f"moe_block{k}", lambda fn=fn: fn(
+                    srv.params, srv.cache, tok, pos, mact, mst)[:2])
+                # sampled MoE traffic steps through moe_sample_step
                 # (tick_block's stepwise fallback) — no sampled block
             elif srv._async:
                 fn = self.get("async_block",
@@ -1264,7 +1481,9 @@ class Engine:
                              jnp.asarray(0), jnp.asarray(1),
                              jnp.asarray(0)))
                 else:
-                    fn = self.get("paged_prefill", tspec(bucket=C))
+                    fn = self.get(
+                        "moe_paged_prefill" if moe else "paged_prefill",
+                        tspec(bucket=C))
                     warm(f"paged_prefill{C}",
                          lambda fn=fn, padded=padded: fn(
                              srv.params, srv.cache, padded,
@@ -1349,7 +1568,9 @@ class Engine:
                              jnp.asarray(0), jnp.asarray(1),
                              jnp.asarray(0)))
                 else:
-                    bfn = self.get("prefill_chunk", tspec(width=Wb))
+                    bfn = self.get(
+                        "moe_prefill_chunk" if moe else "prefill_chunk",
+                        tspec(width=Wb))
                     warm(f"prefill_chunk@{Wb}",
                          lambda bfn=bfn, pad_b=pad_b: bfn(
                              srv.params, srv.cache, pad_b,

@@ -194,12 +194,18 @@ def _block_pre_attn(x, p, pos, cfg: gpt.GPTConfig):
     return q3, _store_rows(k_new, v_new, cfg)
 
 
-def _block_post_attn(x, attn, p, cfg: gpt.GPTConfig):
+def _block_post_attn(x, attn, p, cfg: gpt.GPTConfig, valid=None,
+                     capacity=gpt._LEGACY, stats=None):
     """Post-attention half: output projection + residual + FFN tail
-    (the other shared side of :func:`_block_pre_attn`)."""
+    (the other shared side of :func:`_block_pre_attn`).  The MoE serving
+    step calls this ONCE for the whole batch (``valid``/``capacity``/
+    ``stats`` forwarded to :func:`gpt._ffn_tail`) so the slot tokens
+    route jointly under the configured capacity factor — the same layer
+    math as the dense route, a different token grouping."""
     dt = cfg.dtype
     a = woq.mm(attn, p, "proj_w", dt) + p["proj_b"].astype(dt)
-    return gpt._ffn_tail(x + a, p, cfg)
+    return gpt._ffn_tail(x + a, p, cfg, valid=valid, capacity=capacity,
+                         stats=stats)
 
 
 def _cached_block(x, p, csl, pos, cfg: gpt.GPTConfig):

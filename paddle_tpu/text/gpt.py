@@ -389,7 +389,14 @@ def _ffn_dense(x, p, cfg: GPTConfig):
     return x + _ffn_body(_norm(x, p, "ln2", cfg), p, cfg)
 
 
-def _ffn_tail(x, p, cfg: GPTConfig, valid=None):
+# sentinel for _ffn_tail's legacy capacity rule (``None`` is a MEANINGFUL
+# override there: moe_ffn's capacity-factor bound) — module-level so the
+# MoE serving step can request cf-based capacity explicitly
+_LEGACY = object()
+
+
+def _ffn_tail(x, p, cfg: GPTConfig, valid=None, capacity=_LEGACY,
+              stats=None):
     """Inference FFN half: dense MLP or MoE (aux loss discarded — it only
     matters for the training objective).  MoE capacity is computed from
     the CALL's token count (GShard semantics): at one token nothing can
@@ -397,18 +404,38 @@ def _ffn_tail(x, p, cfg: GPTConfig, valid=None):
     tokens.  ``valid`` (prefill path): pad mask over x's token dims —
     pads route nowhere, and capacity becomes the dropless bound so a
     padded prompt chunk routes exactly like its unpadded prefix
-    (text/moe._route)."""
+    (text/moe._route).
+
+    ``capacity`` (round-19, MoE serving): left at the default sentinel it
+    keeps the legacy rule — dropless token-count bound when ``valid`` is
+    given, moe_ffn's capacity-factor bound otherwise.  An explicit value
+    (``None`` included — the cf-based bound) overrides that rule: the
+    expert-parallel decode step passes ``valid=act, capacity=None`` so
+    occupied slots contend under the CONFIGURED capacity factor while
+    free slots claim nothing.
+    ``stats``: a ``{"dropped", "load"}`` int32 accumulator tree — when
+    given, the call returns ``(x', stats')`` with the routing delta
+    added (dense models pass it through unchanged)."""
     if cfg.moe is None:
-        return _ffn_dense(x, p, cfg)
+        out = _ffn_dense(x, p, cfg)
+        return (out, stats) if stats is not None else out
     from .moe import moe_ffn
 
     h = _norm(x, p, "ln2", cfg)
-    n_tokens = 1
-    for d in x.shape[:-1]:
-        n_tokens *= d
-    y, _aux = moe_ffn(p["moe"], h, cfg.moe, key=None, valid=valid,
-                      capacity=(n_tokens if valid is not None else None))
-    return x + y
+    if capacity is _LEGACY:
+        n_tokens = 1
+        for d in x.shape[:-1]:
+            n_tokens *= d
+        capacity = n_tokens if valid is not None else None
+    if stats is None:
+        y, _aux = moe_ffn(p["moe"], h, cfg.moe, key=None, valid=valid,
+                          capacity=capacity)
+        return x + y
+    y, _aux, delta = moe_ffn(p["moe"], h, cfg.moe, key=None, valid=valid,
+                             capacity=capacity, with_stats=True)
+    stats = {"dropped": stats["dropped"] + delta["dropped"],
+             "load": stats["load"] + delta["load"]}
+    return x + y, stats
 
 
 def _block(x, p, cfg: GPTConfig, dropout_key=None):

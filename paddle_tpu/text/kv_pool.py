@@ -597,6 +597,29 @@ def copy_blocks(cache: dict, src, dst) -> dict:
 # ---------------------------------------------------------------------------
 
 
+def _read_rss_bytes() -> int:
+    """Current process resident set in bytes — ``/proc/self/statm``
+    (field 2, pages) on Linux, ``getrusage`` peak-RSS as the portable
+    fallback, 0 when neither is readable (watchdog disarms rather than
+    guessing)."""
+    try:
+        with open("/proc/self/statm", "rb") as f:
+            pages = int(f.read().split()[1])
+        import resource
+
+        return pages * resource.getpagesize()
+    except (OSError, ValueError, IndexError, ImportError):
+        try:
+            import resource
+
+            # ru_maxrss is KiB on Linux (bytes on macOS — either way a
+            # conservative upper bound, which is the safe direction for
+            # a pressure watchdog)
+            return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss << 10
+        except Exception:
+            return 0
+
+
 def prefix_fingerprint(tokens) -> int:
     """Deterministic fingerprint of a token run for the router-side
     prefix summaries (crc32 over the int64 bytes — Python's ``hash()``
@@ -682,6 +705,7 @@ class PagedAllocator:
         self.restore_on = _flags.kv_restore()
         self.spill_limit_bytes = _flags.kv_spill_mb() << 20
         self.spill_batch = _flags.kv_spill_batch()
+        self.rss_limit_bytes = _flags.kv_spill_rss_mb() << 20
         self._spilled: dict = {}   # full chain tokens -> (host rows, nbytes)
         self._pending_restores: list = []    # [(slot, start, rows, block)]
         # host mirrors of the telemetry counters (tests/bench read these
@@ -695,6 +719,7 @@ class PagedAllocator:
         self.restored_blocks = 0
         self.host_spill_bytes = 0
         self.chain_migrations = 0
+        self.rss_spills = 0
 
     # -- pool accounting ----------------------------------------------------
 
@@ -1056,6 +1081,36 @@ class PagedAllocator:
         freed = len(spill) + len(drop)
         if freed:
             _telemetry.count("kv_pool.prefix_evictions", freed)
+        return freed
+
+    def rss_watchdog(self, rss_bytes: int | None = None) -> int:
+        """Host-memory relief rung (``PADDLE_TPU_KV_SPILL_RSS_MB``):
+        when the process resident set exceeds the threshold, release up
+        to ``spill_batch`` entries — OLDEST host-spilled chains first
+        (the spill store is the host tier this watchdog guards;
+        insertion order is spill order, so the front of the dict is the
+        LRU end), then cold device-index leaves through the plain
+        :meth:`evict_cold` rung.  Bounded work per engagement: a server
+        over the threshold sheds pressure across ticks instead of
+        stalling one.  ``rss_bytes`` overrides the ``/proc`` read
+        (tests; schedulers with their own sampler).  Returns entries
+        released; counts ``kv_pool.rss_spills``."""
+        if not self.rss_limit_bytes:
+            return 0
+        rss = _read_rss_bytes() if rss_bytes is None else int(rss_bytes)
+        if rss <= self.rss_limit_bytes:
+            return 0
+        freed = 0
+        while self._spilled and freed < self.spill_batch:
+            key = next(iter(self._spilled))
+            _, nb = self._spilled.pop(key)
+            self.host_spill_bytes -= nb
+            freed += 1
+        if freed < self.spill_batch:
+            freed += self.evict_cold(self.spill_batch - freed)
+        if freed:
+            self.rss_spills += freed
+            _telemetry.count("kv_pool.rss_spills", freed)
         return freed
 
     def _restore_spilled(self, slot: int, parent: int, prompt,

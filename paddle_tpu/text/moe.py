@@ -171,7 +171,8 @@ def moe_ffn_manual(params: dict, x, cfg: MoEConfig, ep_axis: str | None,
 
 
 def moe_ffn(params: dict, x, cfg: MoEConfig, key=None, activation=jax.nn.gelu,
-            valid=None, capacity: int | None = None):
+            valid=None, capacity: int | None = None,
+            with_stats: bool = False):
     """x [..., D] → (y [..., D], aux_loss scalar).
 
     Capacity per expert C = ceil(N * top_k / E * capacity_factor); tokens
@@ -183,7 +184,13 @@ def moe_ffn(params: dict, x, cfg: MoEConfig, key=None, activation=jax.nn.gelu,
     ``capacity`` overrides C; serving prefill passes the DROPLESS bound
     C = N (an expert can receive at most one slot per token), trading
     transient [N, E, N] dispatch memory for the guarantee that a chunked
-    prompt routes identically to feeding it token-by-token."""
+    prompt routes identically to feeding it token-by-token.
+    ``with_stats`` (round-19, MoE serving): additionally return a
+    routing-stats delta ``{"dropped": int32 scalar, "load": int32 [E]}``
+    computed from the dispatch mask alone — kept assignments per expert,
+    and (valid tokens × top_k − kept) dropped assignments — so serving
+    can thread an honest device-side drop counter through the jitted
+    step without a second routing pass."""
     orig_shape = x.shape
     D = orig_shape[-1]
     xf = x.reshape(-1, D)
@@ -194,6 +201,16 @@ def moe_ffn(params: dict, x, cfg: MoEConfig, key=None, activation=jax.nn.gelu,
 
     disp, comb, aux = _route(params, xf, cfg, key, E, C, x.dtype,
                              valid=valid)
+    delta = None
+    if with_stats:
+        # int32 throughout (x64 is disabled): kept assignments per expert
+        # from the 0/1 dispatch mask; every valid token claims exactly
+        # top_k assignments, so dropped = valid * top_k - kept
+        kept_e = jnp.sum(disp.astype(jnp.int32), axis=(0, 2))       # [E]
+        n_valid = (jnp.int32(N) if valid is None
+                   else jnp.sum(valid.reshape(-1).astype(jnp.int32)))
+        delta = {"dropped": n_valid * cfg.top_k - jnp.sum(kept_e),
+                 "load": kept_e}
 
     # route → expert ffn → route back (XLA lowers these to all_to_all when
     # the E dim is sharded over 'ep'); weights resolve through woq.w —
@@ -206,4 +223,6 @@ def moe_ffn(params: dict, x, cfg: MoEConfig, key=None, activation=jax.nn.gelu,
     out = jnp.einsum("ecf,efd->ecd", h, woq.w(params, "w_out", x.dtype)) \
         + params["b_out"][:, None].astype(x.dtype)
     y = jnp.einsum("nec,ecd->nd", comb.astype(x.dtype), out)
+    if with_stats:
+        return y.reshape(orig_shape), aux, delta
     return y.reshape(orig_shape), aux

@@ -166,21 +166,28 @@ _EVICT_BATCH = 4
 
 
 def _get_prefill_fn(cfg: gpt.GPTConfig, bucket: int, shard=None):
-    """Engine shim: whole-prompt admission at one power-of-two bucket."""
-    return _engine.ENGINE.get("prefill", _Spec(
+    """Engine shim: whole-prompt admission at one power-of-two bucket.
+    MoE configs route to the ``moe_prefill`` kind (same dropless body,
+    named/keyed apart) — call sites never branch."""
+    kind = "moe_prefill" if cfg.moe is not None else "prefill"
+    return _engine.ENGINE.get(kind, _Spec(
         cfg=cfg, bucket=int(bucket), shard=shard))
 
 
 def _get_prefill_chunk_fn(cfg: gpt.GPTConfig, shard=None,
                           width: int | None = None):
-    """Engine shim: contiguous fixed-chunk / budgeted admission step."""
-    return _engine.ENGINE.get("prefill_chunk", _Spec(
+    """Engine shim: contiguous fixed-chunk / budgeted admission step
+    (``moe_prefill_chunk`` for MoE configs)."""
+    kind = "moe_prefill_chunk" if cfg.moe is not None else "prefill_chunk"
+    return _engine.ENGINE.get(kind, _Spec(
         cfg=cfg, shard=shard, width=width))
 
 
 def _get_paged_prefill_fn(cfg: gpt.GPTConfig, bucket: int, shard=None):
-    """Engine shim: paged offset-aware admission chunk."""
-    return _engine.ENGINE.get("paged_prefill", _Spec(
+    """Engine shim: paged offset-aware admission chunk
+    (``moe_paged_prefill`` for MoE configs)."""
+    kind = "moe_paged_prefill" if cfg.moe is not None else "paged_prefill"
+    return _engine.ENGINE.get(kind, _Spec(
         cfg=cfg, bucket=int(bucket), shard=shard))
 
 
@@ -246,6 +253,34 @@ def _get_async_sample_block_fn(cfg: gpt.GPTConfig, k: int,
     """Engine shim: async sampled block."""
     return _engine.ENGINE.get("async_sample_block", _Spec(
         cfg=cfg, k=k, paged=paged, shard=shard))
+
+
+def _get_moe_step_fn(cfg: gpt.GPTConfig, paged: bool = False, shard=None):
+    """Engine shim: the joint-routing greedy MoE tick step (round 19) —
+    (p, cache, tok, pos, act, stats) -> (logits, cache, stats')."""
+    return _engine.ENGINE.get("moe_step", _Spec(
+        cfg=cfg, paged=paged, shard=shard))
+
+
+def _get_moe_sample_step_fn(cfg: gpt.GPTConfig, paged: bool = False,
+                            shard=None):
+    """Engine shim: the sampled joint-routing MoE tick step."""
+    return _engine.ENGINE.get("moe_sample", _Spec(
+        cfg=cfg, paged=paged, shard=shard))
+
+
+def _get_moe_block_fn(cfg: gpt.GPTConfig, k: int, paged: bool = False,
+                      shard=None):
+    """Engine shim: k greedy joint-routing MoE steps per host fetch."""
+    return _engine.ENGINE.get("moe_block", _Spec(
+        cfg=cfg, k=k, paged=paged, shard=shard))
+
+
+def _get_moe_async_step_fn(cfg: gpt.GPTConfig, paged: bool = False,
+                           shard=None):
+    """Engine shim: the async-dispatch joint-routing MoE tick step."""
+    return _engine.ENGINE.get("moe_async", _Spec(
+        cfg=cfg, paged=paged, shard=shard))
 
 
 def spec_verify_batched(params, cache, tokens, pos, cfg: gpt.GPTConfig):
@@ -495,6 +530,7 @@ class DecodeServer:
                  block_size: int | None = None,
                  num_blocks: int | None = None,
                  mesh=None, mp_axis: str = "mp",
+                 ep_axis: str | None = None,
                  device=None,
                  draft_cfg: gpt.GPTConfig | None = None,
                  draft_params=None, spec_k: int | None = None,
@@ -544,6 +580,7 @@ class DecodeServer:
         else:
             self._pool = None
             self.cache = generate.init_cache(cfg, max_batch, max_len)
+        self._rss_tick = 0          # host-RSS watchdog cadence counter
         # speculative decoding (draft-then-verify in the serving tick):
         # spec_k > 0 turns speculation on — with (draft_cfg,
         # draft_params) a small draft model proposes K-1 tokens per
@@ -654,17 +691,21 @@ class DecodeServer:
         # placement knob); the two are mutually exclusive.
         self._device = None
         self._shard = None
+        if ep_axis is not None and mesh is None:
+            raise ValueError("ep_axis requires mesh= (expert parallelism "
+                             "is a mesh placement)")
         if mesh is not None:
             if device is not None:
                 raise ValueError("mesh= and device= are mutually "
                                  "exclusive (TP server vs pinned "
                                  "single-chip replica)")
-            if cfg.moe is not None:
-                raise NotImplementedError(
-                    "tensor-parallel serving supports dense models "
-                    "(build_sharded_decode's rule)")
+            # round 19: MoE configs shard through the regex rule table
+            # (moe_serving.moe_decode_param_specs) — _ShardCtx routes
+            # there itself, placing experts over ``ep_axis`` when given
+            # (replicated experts under pure TP otherwise)
             self._shard = _ShardCtx(mesh, cfg, params, self.cache,
-                                    mp_axis, pool=adapter_pool)
+                                    mp_axis, pool=adapter_pool,
+                                    ep=ep_axis)
             self.params = jax.tree_util.tree_map(
                 jax.device_put, params, self._shard.params)
             self.cache = {n: jax.device_put(a, self._shard.cache[n])
@@ -675,7 +716,24 @@ class DecodeServer:
             self.cache = jax.device_put(self.cache, device)
             # placement joins every step-cache key (see _shard_key)
             self._shard = ("device", int(getattr(device, "id", 0)))
-        self._step = _get_step_fn(cfg, self._paged, self._shard)
+        # MoE serving (round 19): the tick runs the JOINT-routing step —
+        # all occupied slots' tokens route through expert capacity in
+        # one call, with the device-side drop/load accumulator threaded
+        # through like the cache.  ``_moe_wrap`` adapts the moe kinds to
+        # the dense calling convention (appends act+stats, peels the
+        # stats output), so every dispatch site — and warmup — stays
+        # shared with the dense server.
+        if cfg.moe is not None:
+            from . import moe_serving as _moe_serving
+
+            self._moe_stats = _moe_serving.moe_stats_init(
+                cfg.moe.num_experts)
+            self._moe_counted = 0       # drained high-water mark
+            self._step = self._moe_wrap(
+                _get_moe_step_fn(cfg, self._paged, self._shard))
+        else:
+            self._moe_stats = None
+            self._step = _get_step_fn(cfg, self._paged, self._shard)
         # the draft model's placement context: identical to the target's
         # for pinned/un-placed servers; under mesh= it gets its OWN
         # _ShardCtx (the draft cfg's Megatron/cache specs differ from the
@@ -859,6 +917,12 @@ class DecodeServer:
         # code path byte-identical to the pre-adapter server.
         self._adapters = adapter_pool
         if adapter_pool is not None:
+            if cfg.moe is not None:
+                raise NotImplementedError(
+                    "adapter_pool with an MoE config is not supported "
+                    "yet — the adapter step kinds have no joint-routing "
+                    "twin (the gathered LoRA delta composes with dense "
+                    "FFNs only)")
             if (generate._cfg_key(adapter_pool.cfg)
                     != generate._cfg_key(cfg)):
                 raise ValueError(
@@ -975,6 +1039,13 @@ class DecodeServer:
                 adapter = self._adapters.default_for(tenant)
             aid = self._adapters.resolve(adapter)
         if constraint is not None:
+            if self.cfg.moe is not None:
+                # the masked step kinds have no joint-routing twin yet
+                # (ROADMAP follow-up) — reject at the door, not ticks
+                # later with a silent unconstrained fallback
+                raise NotImplementedError(
+                    "constrained decoding on an MoE server is not "
+                    "supported yet (no joint-routing masked step kind)")
             from . import adapters as _ad
 
             # compile at the door (and discard): a malformed spec raises
@@ -3168,6 +3239,14 @@ class DecodeServer:
         if self._wedged:
             self._wedged = False
             _telemetry.clear_runtime_wedge()
+        if self._moe_stats is not None:
+            # publish the final routing totals before the accumulator
+            # (and its device buffer) is dropped with the executables
+            try:
+                self._moe_snapshot()
+            except Exception:
+                pass    # a wedged device must not block shutdown
+            self._moe_stats = None
         if self.metrics_server is not None:
             self.metrics_server.close()   # joins the serve thread
             self.metrics_server = None
@@ -3343,6 +3422,15 @@ class DecodeServer:
                 "prefix_summary": self._pool.prefix_summary(),
                 "host_spill_bytes": self._pool.host_spill_bytes}
                if self._paged else {}),
+            # MoE serving: the device accumulator's honest routing
+            # totals — cumulative dropped token→expert assignments and
+            # per-expert kept load (the drain also advances the
+            # moe.dropped_tokens counter / expert-load gauges).  The
+            # fetch blocks on the in-flight step's stats future; the
+            # scheduler's own ticks never pay it.
+            **(dict(zip(("moe_dropped_tokens", "moe_expert_load"),
+                        self._moe_snapshot()))
+               if self._moe_stats is not None else {}),
         }
 
     def drain_queue(self, rids=None) -> list:
@@ -3421,6 +3509,46 @@ class DecodeServer:
                 tk[slot] = st["top_k"]
                 tp[slot] = st["top_p"]
         return temp, tk, tp
+
+    # -- MoE serving: occupancy mask + stats plumbing (round 19) ------------
+
+    def _moe_act(self):
+        """The joint-routing occupancy mask [max_batch] bool: occupied
+        slots route (prompt-feeding INCLUDED — their routing writes the
+        KV rows deeper layers keep, so they must claim real capacity),
+        free slots claim nothing, and ADMITTING slots are excluded —
+        their frontier output is discarded and their rows rewritten by
+        the next prefill chunk, so letting them contend would charge
+        phantom capacity to batch-mates."""
+        act = np.zeros((self.max_batch,), bool)
+        for slot, st in self._slots.items():
+            act[slot] = not st.get("admitting")
+        return act
+
+    def _moe_wrap(self, fn):
+        """Adapt a joint-routing Engine kind to the dense calling
+        convention: append (act, stats) at dispatch, peel the trailing
+        stats output back into ``self._moe_stats``, return the rest —
+        so every dense dispatch site (and Engine.warmup's ``srv._step``
+        call) serves MoE unchanged."""
+        def wrapped(*args):
+            out = fn(*args, jnp.asarray(self._moe_act()),
+                     self._moe_stats)
+            self._moe_stats = out[-1]
+            return out[:-1]
+
+        return wrapped
+
+    def _moe_snapshot(self):
+        """Drain the device accumulator into telemetry (delta-exact:
+        ``moe.dropped_tokens`` advances by what the device dropped since
+        the last drain) and return (dropped_total, load_list)."""
+        from . import moe_serving as _moe_serving
+
+        dropped, load = _moe_serving.drain_drop_stats(
+            self._moe_stats, counted=self._moe_counted, tel=self._tel)
+        self._moe_counted = dropped
+        return dropped, load
 
     # -- multi-tenant serving: adapter gather + constraint masks ------------
 
@@ -3854,6 +3982,20 @@ class DecodeServer:
             _telemetry.event("resilience.wedge", time.perf_counter(),
                              time.perf_counter(), error=str(exc)[:200])
 
+    def _rss_guard(self):
+        """Host-RSS watchdog hook (``PADDLE_TPU_KV_SPILL_RSS_MB``):
+        every 16th scheduler tick reads ``/proc`` and, over the
+        threshold, runs ONE bounded allocator relief round (oldest
+        spilled chains, then evict-cold LRU) — see
+        ``PagedAllocator.rss_watchdog``.  Off (a single int compare)
+        unless the flag armed the allocator."""
+        pool = self._pool
+        if pool is None or not pool.rss_limit_bytes:
+            return
+        self._rss_tick = (self._rss_tick + 1) & 15
+        if not self._rss_tick:
+            pool.rss_watchdog()
+
     def tick(self):
         if self._adm is not None:
             # the SLO control loop rides the scheduler tick: at most
@@ -3861,6 +4003,7 @@ class DecodeServer:
             # self-gates), so this is a float compare on idle ticks
             self._adm.control_tick(
                 idle=not self._slots and not self._queue)
+        self._rss_guard()
         self._guarded(self._tick_impl)
 
     def _tick_impl(self):
@@ -3961,9 +4104,16 @@ class DecodeServer:
             nxt = np.asarray(nxt)
             logits = None
         elif temp.any():
-            kind = "sample_step"
-            self._fault_check(kind)
-            fn = _get_sample_step_fn(self.cfg, self._paged, self._shard)
+            if self.cfg.moe is not None:
+                kind = "moe_sample_step"
+                self._fault_check(kind)
+                fn = self._moe_wrap(_get_moe_sample_step_fn(
+                    self.cfg, self._paged, self._shard))
+            else:
+                kind = "sample_step"
+                self._fault_check(kind)
+                fn = _get_sample_step_fn(self.cfg, self._paged,
+                                         self._shard)
             nxt, self.cache = fn(
                 self.params, self.cache, jnp.asarray(tok),
                 jnp.asarray(pos), jax.random.fold_in(self._base_key, n),
@@ -4121,6 +4271,18 @@ class DecodeServer:
                     jax.random.fold_in(self._base_key, n),
                     jnp.asarray(temp), jnp.asarray(tk),
                     jnp.asarray(tp))
+            elif self.cfg.moe is not None:
+                fname = "moe_async_step"
+                self._fault_check(fname)
+                fn = self._moe_wrap(_get_moe_async_step_fn(
+                    self.cfg, self._paged, self._shard))
+                nxt, self.cache = fn(
+                    self.params, self.cache, jnp.asarray(ht),
+                    jnp.asarray(pm),
+                    self._prev_feed(prev), jnp.asarray(pos),
+                    jax.random.fold_in(self._base_key, n),
+                    jnp.asarray(temp),
+                    jnp.asarray(tk), jnp.asarray(tp))
             else:
                 fname = "async_step"
                 self._fault_check(fname)
@@ -4299,14 +4461,18 @@ class DecodeServer:
                 self._gap_anchor = None
                 return
         if self._adapters is not None or self._constrained_active() \
+                or self.cfg.moe is not None \
                 or any(st["pos"] < len(st["prompt"]) - 1
                        or st.get("admitting")
                        for st in self._slots.values()):
-            # adapter/constrained batches take stepwise async ticks
+            # adapter/constrained/MoE batches take stepwise async ticks
             # (the adapter async STEP pipelines; an async adapter BLOCK
-            # executable isn't built, and constrained slots need every
-            # token fetched before the next mask) — same tokens, the
-            # documented fallback
+            # executable isn't built; constrained slots need every
+            # token fetched before the next mask; an MoE block would
+            # freeze the occupancy mask across k steps while the async
+            # overrun keeps retired slots contending — the stepwise
+            # moe_async_step re-reads occupancy every tick) — same
+            # tokens, the documented fallback
             if prev is not None:
                 self._process_inflight(prev)
             for _ in range(block):
@@ -4374,16 +4540,20 @@ class DecodeServer:
 
         Requires every active slot to be past its prompt (prefill
         admission guarantees this); when some slot is still consuming
-        its prompt token-by-token (``prefill=False`` / MoE), falls back
-        to ``block`` single ticks — per-token host feedback is the whole
+        its prompt token-by-token (``prefill=False``), falls back to
+        ``block`` single ticks — per-token host feedback is the whole
         point of that path.  Slots finishing mid-block overrun on device;
-        the host discards their surplus tokens here."""
+        the host discards their surplus tokens here.  MoE servers run
+        the joint-routing ``moe_block`` kind for greedy batches (the
+        occupancy mask frozen at dispatch) and fall back to stepwise
+        ticks for sampled ones."""
         block = int(block)
         if block < 1:
             raise ValueError(f"block must be >= 1, got {block}")
         if self._adm is not None:
             self._adm.control_tick(
                 idle=not self._slots and not self._queue)
+        self._rss_guard()
         self._guarded(lambda: self._tick_block_impl(block))
 
     def _tick_block_impl(self, block: int):
@@ -4427,7 +4597,8 @@ class DecodeServer:
         # adapter sample-block executable — the stepwise path draws the
         # same fold_in(n) schedule, so tokens match tick() exactly)
         if self._constrained_active() \
-                or (self._adapters is not None
+                or ((self._adapters is not None
+                     or self.cfg.moe is not None)
                     and any(st.get("temperature", 0.0) > 0.0
                             for st in self._slots.values())) \
                 or any(st["pos"] < len(st["prompt"]) - 1
@@ -4464,6 +4635,17 @@ class DecodeServer:
                 self.params, self.cache, jnp.asarray(tok),
                 jnp.asarray(pos), self._base_key, jnp.asarray(n),
                 jnp.asarray(temp), jnp.asarray(tk), jnp.asarray(tp))
+        elif self.cfg.moe is not None:
+            # greedy MoE block: k joint-routing steps, the occupancy
+            # mask frozen at dispatch (every slot here is past its
+            # prompt — see the fallback above — so occupancy only
+            # shrinks mid-block, the documented block-overrun tradeoff)
+            kind = f"moe_block@{block}"
+            self._fault_check(kind)
+            fn = self._moe_wrap(_get_moe_block_fn(
+                self.cfg, block, self._paged, self._shard))
+            toks, self.cache, _, _ = fn(self.params, self.cache,
+                                        jnp.asarray(tok), jnp.asarray(pos))
         else:
             kind = f"block@{block}"
             self._fault_check(kind)

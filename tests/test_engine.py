@@ -442,3 +442,36 @@ class TestEngineLint:
 
     def test_repo_is_clean(self):
         assert self.tool.scan_repo(REPO) == []
+
+
+# ---------------------------------------------------------------------------
+# MOE lint family (tools/check_instrumented.py, round 19)
+# ---------------------------------------------------------------------------
+
+
+class TestMoELint:
+    def setup_method(self):
+        self.tool = _tool("check_instrumented")
+
+    def test_uncounted_dispatch_path_flagged(self):
+        bad = ("def _dispatch_tokens(router_logits, capacity):\n"
+               "    return router_logits.argsort()[:capacity]\n")
+        vs = self.tool.scan_moe_source(bad, "moe_serving.py")
+        assert len(vs) == 1 and "_dispatch_tokens" in vs[0][2]
+
+    def test_counted_drop_path_passes(self):
+        good = ("def drain_drop_stats(srv):\n"
+                "    _telemetry.count('moe.dropped_tokens', 3)\n")
+        assert self.tool.scan_moe_source(good, "moe_serving.py") == []
+
+    def test_delegation_to_routing_tail_passes(self):
+        good = ("def combine_expert_outputs(x, w):\n"
+                "    return moe_ffn(x, w)\n"
+                "def _dispatch_step(tok):\n"
+                "    return combine_expert_outputs(tok, None)\n")
+        assert self.tool.scan_moe_source(good, "moe_serving.py") == []
+
+    def test_unmarked_helper_ignored(self):
+        neutral = ("def route_free_helper(x):\n"
+                   "    return x + 1\n")
+        assert self.tool.scan_moe_source(neutral, "moe_serving.py") == []

@@ -685,6 +685,53 @@ def test_socket_transport_frames_and_poll():
 
 
 @requires_sockets
+def test_socket_send_is_one_gathered_write():
+    """Round-19 frame batching: a whole message — header + N buffer
+    frames — leaves in ONE scatter-gather write (fleet.frame_batches
+    counts messages, not frames), partial sendmsg returns resume at the
+    exact offset, and the bytes on the wire stay codec-identical (the
+    multi-buffer payload round-trips bit-exactly)."""
+    before = _count("fleet.frame_batches")
+    listener = fleet.SocketTransport.listen()
+    client = fleet.SocketTransport.connect("127.0.0.1", listener.port)
+    server = listener.accept(timeout=5.0)
+    calls = []
+
+    class _SendmsgProxy:
+        def __init__(self, sock):
+            self._s = sock
+
+        def __getattr__(self, name):
+            return getattr(self._s, name)
+
+        def sendmsg(self, views):
+            views = list(views)
+            calls.append(len(views))
+            if len(calls) == 1:
+                # force a partial first write: only half the first
+                # frame goes out, the resume path must pick up
+                # mid-frame
+                half = max(1, views[0].nbytes // 2)
+                return self._s.sendmsg([views[0][:half]])
+            return self._s.sendmsg(views)
+
+    client._sock = _SendmsgProxy(client._sock)
+    payload = {"rid": 9, "rows": {"k": np.arange(12.0).reshape(3, 4),
+                                  "v": np.arange(6, dtype=np.int32)}}
+    # (prefix + header) + 2 x (prefix + buffer) = 6 iovecs, one gather
+    client.send(payload)
+    got = server.recv(5.0)
+    assert calls and calls[0] == 6, calls
+    np.testing.assert_array_equal(got["rows"]["k"], payload["rows"]["k"])
+    np.testing.assert_array_equal(got["rows"]["v"], payload["rows"]["v"])
+    assert got["rows"]["v"].dtype == np.int32
+    assert _count("fleet.frame_batches") >= before + 1
+    server.close()
+    client.close()
+    listener.close()
+
+
+@requires_sockets
 def test_socket_fleet_bit_parity(cfg_params):
     """The cross-process deployment shape, in-process: a PrefillWorker
     served over TCP, the router connected as a remote client — tokens

@@ -644,6 +644,69 @@ def test_spill_restore_allocator_roundtrip(kv_env):
     assert a.take_restores() == []
 
 
+def test_rss_watchdog_releases_oldest_spills_then_evicts(kv_env):
+    """``PADDLE_TPU_KV_SPILL_RSS_MB``: over the threshold one watchdog
+    round releases host-spilled chains OLDEST-first, then cold index
+    leaves through the evict-cold LRU rung — bounded by spill_batch and
+    counted in ``kv_pool.rss_spills``; at or under the threshold it is
+    a no-op."""
+    kv_env(PADDLE_TPU_KV_SPILL_MB="4", PADDLE_TPU_KV_SPILL_RSS_MB="1")
+    bs = 8
+    a = kv_pool.PagedAllocator(num_blocks=8, block_size=bs, nmax=4,
+                               max_batch=2)
+    a.ensure_rows(0, 0, 24)
+    a.register_prefix(0, list(range(24)))
+    a.free_slot(0)
+
+    def fetch(blocks):
+        return {"k": np.stack(
+            [np.full((2, bs, 1), float(b), np.float32)
+             for b in blocks], axis=1)}
+
+    for _ in range(8):
+        if not a.prefix_entries:
+            break
+        a.spill_cold(8, fetch=fetch)
+    assert len(a._spilled) == 3 and a.host_spill_bytes > 0
+    # at/under threshold (1 MiB): strictly a no-op
+    assert a.rss_watchdog(rss_bytes=1 << 20) == 0
+    assert len(a._spilled) == 3 and a.rss_spills == 0
+    # a fresh cold chain gives the second rung an index leaf to demote
+    a.ensure_rows(0, 0, 8)
+    a.register_prefix(0, list(range(100, 108)))
+    a.free_slot(0)
+    freed = a.rss_watchdog(rss_bytes=2 << 20)
+    assert freed == 4                  # 3 spilled records + 1 cold leaf
+    assert not a._spilled and a.host_spill_bytes == 0
+    assert a.prefix_entries == 0
+    assert a.rss_spills == 4
+    # pressure relieved -> armed but quiet
+    assert a.rss_watchdog(rss_bytes=2 << 20) == 0
+    assert a.rss_spills == 4
+
+
+def test_rss_watchdog_rides_the_scheduler_tick(kv_env, markov_gpt):
+    """Serving-level: with the RSS flag set to 1 MiB (any real process
+    is over it) idle scheduler ticks engage the watchdog every 16th
+    tick and drain the retired request's cold prefix chain — no spill
+    tier needed (the evict-cold rung alone relieves pressure)."""
+    kv_env(PADDLE_TPU_KV_SPILL_RSS_MB="1")
+    cfg, params = markov_gpt
+    srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=32,
+                               layout="paged", block_size=8)
+    prompt = [int(x) for x in np.random.default_rng(3).integers(0, 13, 16)]
+    rid = srv.submit(prompt, max_new_tokens=4)
+    while srv.pending():
+        srv.tick()
+    assert len(srv.result(rid)) == 4
+    assert srv._pool.prefix_entries > 0
+    for _ in range(64):                # idle ticks: cadence is 1-in-16
+        srv.tick()
+    assert srv._pool.prefix_entries == 0
+    assert srv._pool.rss_spills > 0
+    srv.close()
+
+
 @pytest.mark.parametrize("kv", ["fp32", "int8"])
 @pytest.mark.parametrize("mode", ["tick", "async"])
 def test_spill_restore_bit_parity(kv_env, kv, mode, markov_gpt):

@@ -1,0 +1,342 @@
+"""MoE serving (text/moe_serving.py + the Engine's moe_* kinds).
+
+The correctness property: an MoE request served through the Engine's
+JOINT-routing executables — batch-mates sharing expert capacity, paged
+or contiguous, tick / block / async — must produce exactly the tokens
+the densely-evaluated reference (every expert computed, gate-weighted)
+produces for that prompt alone, whenever the capacity factor is
+dropless for the batch.  Below the dropless bound the server must
+report EXACTLY what the device dropped (host-computed routing, not an
+estimate).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.text import engine
+from paddle_tpu.text import generate as G
+from paddle_tpu.text import gpt, moe_serving, serving
+from paddle_tpu.text.moe import MoEConfig
+
+
+def _cfg(**over):
+    kw = dict(vocab_size=32, hidden_size=32, num_layers=2, num_heads=4,
+              max_seq_len=64)
+    kw.update(over)
+    return gpt.GPTConfig(**kw)
+
+
+def _mcfg(**moe_over):
+    mk = dict(num_experts=4, top_k=2, capacity_factor=1.25,
+              router_noise=0.0)
+    mk.update(moe_over)
+    return _cfg(moe=MoEConfig(**mk))
+
+
+def _mesh(shape, names):
+    devs = np.array(jax.devices()[: int(np.prod(shape))]).reshape(shape)
+    return Mesh(devs, names)
+
+
+PROMPTS = [[5, 3, 9, 1], [2, 8, 8]]
+MAX_NEW = 6
+
+
+@pytest.fixture(scope="module")
+def moe_model():
+    cfg = _mcfg()
+    return cfg, gpt.init_params(cfg, jax.random.PRNGKey(7))
+
+
+@pytest.fixture(scope="module")
+def moe_reference(moe_model):
+    """The capacity-free ground truth, computed ONCE per prompt and
+    shared by every server-parity test below (layout/schedule do not
+    change it)."""
+    cfg, params = moe_model
+    return [moe_serving.dense_reference_greedy(params, cfg, p, MAX_NEW, 32)
+            for p in PROMPTS]
+
+
+# ---------------------------------------------------------------------------
+# regex partition rules
+# ---------------------------------------------------------------------------
+
+
+def test_dense_leaves_match_legacy_resolver():
+    """The rule table is pinned to generate._decode_param_specs on every
+    dense architecture variant — the regex generalization must never
+    silently move a dense leaf."""
+    for over in ({}, dict(num_kv_heads=2), dict(activation="swiglu"),
+                 dict(pos_embed="rope", norm="rmsnorm")):
+        cfg = _cfg(**over)
+        params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+        want = G._decode_param_specs(params, cfg, "mp")
+        got = moe_serving.moe_decode_param_specs(params, cfg, mp="mp")
+        assert got == want, over
+
+
+def test_moe_leaves_shard_over_ep_and_mp(moe_model):
+    cfg, params = moe_model
+    m = moe_serving.moe_decode_param_specs(
+        params, cfg, mp="mp", ep="ep")["blocks"]["moe"]
+    assert m["router_w"] == P(None, None, None)     # replicated
+    assert m["w_in"] == P(None, "ep", None, "mp")
+    assert m["b_in"] == P(None, "ep", "mp")
+    assert m["w_out"] == P(None, "ep", "mp", None)
+    assert m["b_out"] == P(None, "ep", None)
+    # ep=None replicates the expert dim: pure TP over an MoE model
+    m2 = moe_serving.moe_decode_param_specs(
+        params, cfg, mp="mp")["blocks"]["moe"]
+    assert m2["w_in"] == P(None, None, None, "mp")
+
+
+def test_unmatched_leaf_raises_and_scalars_replicate():
+    rules = [(r"^a$", P("mp"))]
+    with pytest.raises(ValueError, match="no partition rule matches"):
+        moe_serving.match_partition_rules(
+            rules, {"a": jnp.zeros((2,)), "mystery": jnp.zeros((2,))})
+    # scalars short-circuit to replicated before the table is consulted
+    got = moe_serving.match_partition_rules(
+        rules, {"a": jnp.zeros((2,)), "step": jnp.zeros(())})
+    assert got == {"a": P("mp"), "step": P()}
+
+
+# ---------------------------------------------------------------------------
+# Engine-served tokens == densely-evaluated reference
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("layout", ["contiguous", "paged"])
+@pytest.mark.parametrize("mode", ["tick", "block", "async"])
+def test_served_tokens_match_dense_eval_reference(moe_model, moe_reference,
+                                                  mode, layout):
+    """{tick, block, async} x {contiguous, paged}: at a dropless
+    capacity factor (B=2, E=4, k=2, cf=1.25 -> C=2 >= B) the joint-
+    routing step equals per-token solo routing, which equals the
+    capacity-free dense evaluation — token for token, and with ZERO
+    dropped assignments on the device counter."""
+    cfg, params = moe_model
+    kw = dict(max_batch=2, max_len=32)
+    if layout == "paged":
+        kw.update(layout="paged", block_size=8)
+    if mode == "async":
+        kw["async_dispatch"] = True
+    srv = serving.DecodeServer(params, cfg, **kw)
+    rids = [srv.submit(p, max_new_tokens=MAX_NEW) for p in PROMPTS]
+    ticks = 0
+    while srv.pending():
+        srv.tick_block(3) if mode == "block" else srv.tick()
+        ticks += 1
+        assert ticks < 100
+    got = [srv.result(r) for r in rids]
+    ls = srv.load_stats()
+    assert got == moe_reference, (mode, layout)
+    assert ls["moe_dropped_tokens"] == 0, (mode, layout)
+    # every generated token routed top_k ways somewhere
+    assert sum(ls["moe_expert_load"]) > 0
+
+
+def test_budgeted_admission_composes_with_joint_routing(moe_model,
+                                                        moe_reference):
+    """prefill_budget: while one slot feeds prompt chunks (admitting —
+    excluded from the occupancy mask) the other decodes; tokens still
+    match the reference and admission chunks route through the DROPLESS
+    prefill kinds (no drops counted)."""
+    cfg, params = moe_model
+    srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=32,
+                               prefill_budget=2)
+    rids = [srv.submit(p, max_new_tokens=MAX_NEW) for p in PROMPTS]
+    ticks = 0
+    while srv.pending():
+        srv.tick()
+        ticks += 1
+        assert ticks < 100
+    assert [srv.result(r) for r in rids] == moe_reference
+    assert srv.load_stats()["moe_dropped_tokens"] == 0
+
+
+# ---------------------------------------------------------------------------
+# drop accounting: the device counter == host-computed routing
+# ---------------------------------------------------------------------------
+
+
+def test_capacity_overflow_drops_exactly_match_host_routing():
+    """Zeroed router -> uniform softmax -> lax.top_k tie-break sends
+    EVERY token to experts {0, 1}.  At cf=0.5 with max_batch=2 the
+    decode capacity is C=1, so each tick with ``a`` active slots drops
+    (a - 1) assignments per claimed expert per layer — a schedule the
+    host can replay exactly.  The device counter must equal it, and the
+    per-expert load must show only experts 0 and 1 ever kept work."""
+    cfg = _mcfg(capacity_factor=0.5)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(3))
+    params["blocks"]["moe"]["router_w"] = jnp.zeros_like(
+        params["blocks"]["moe"]["router_w"])
+    L = cfg.num_layers
+    srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=32)
+    rids = [srv.submit([1, 2], max_new_tokens=4),
+            srv.submit([3, 4, 5], max_new_tokens=4)]
+    exp_dropped = exp_kept = ticks = 0
+    while srv.pending():
+        active = sum(1 for st in srv._slots.values()
+                     if not st.get("admitting"))
+        srv.tick()
+        ticks += 1
+        assert ticks < 50
+        if active:
+            # experts 0 and 1 each see ``active`` claims, keep C=1
+            exp_dropped += 2 * L * max(0, active - 1)
+            exp_kept += L
+    ls = srv.load_stats()
+    assert exp_dropped > 0                     # the test actually bit
+    assert ls["moe_dropped_tokens"] == exp_dropped
+    assert ls["moe_expert_load"] == [exp_kept, exp_kept, 0, 0]
+    for r in rids:
+        assert len(srv.result(r)) == 4         # dropped != stalled
+
+
+def test_single_slot_never_drops_at_any_capacity_factor(moe_reference):
+    """One occupied slot claims at most one capacity slot per expert and
+    C >= 1 always — so even cf=0.25 is dropless solo, and the tokens
+    still equal the dense-eval reference."""
+    cfg = _mcfg(capacity_factor=0.25)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(7))
+    want = moe_serving.dense_reference_greedy(params, cfg, PROMPTS[0],
+                                              MAX_NEW, 32)
+    srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=32)
+    rid = srv.submit(PROMPTS[0], max_new_tokens=MAX_NEW)
+    while srv.pending():
+        srv.tick()
+    assert srv.result(rid) == want
+    assert srv.load_stats()["moe_dropped_tokens"] == 0
+
+
+# ---------------------------------------------------------------------------
+# expert parallelism: ep x mp mesh placement
+# ---------------------------------------------------------------------------
+
+
+def test_ep_mp_mesh_shards_experts_and_matches_reference(moe_model,
+                                                         moe_reference):
+    """DecodeServer(mesh=(ep=2, mp=2)): expert leaves genuinely split
+    over BOTH axes (E/2 experts per ep group, F/2 ffn columns per mp
+    shard), the KV cache's Hkv axis splits over mp, the router
+    replicates — and the greedy tokens equal the single-chip dense-eval
+    reference (sharding must not change the math)."""
+    cfg, params = moe_model
+    mesh = _mesh((2, 2), ("ep", "mp"))
+    srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=32,
+                               mesh=mesh, mp_axis="mp", ep_axis="ep")
+    m = srv.params["blocks"]["moe"]
+    L, E = cfg.num_layers, cfg.moe.num_experts
+    D, F = cfg.hidden_size, cfg.hidden_size * cfg.ffn_ratio
+    assert m["w_in"].sharding.shard_shape(m["w_in"].shape) == \
+        (L, E // 2, D, F // 2)
+    assert m["w_out"].sharding.shard_shape(m["w_out"].shape) == \
+        (L, E // 2, F // 2, D)
+    rw = m["router_w"]
+    assert rw.sharding.shard_shape(rw.shape) == rw.shape   # replicated
+    k = srv.cache["k"]
+    assert k.sharding.shard_shape(k.shape)[3] == cfg.kv_heads // 2
+    rids = [srv.submit(p, max_new_tokens=MAX_NEW) for p in PROMPTS]
+    while srv.pending():
+        srv.tick()
+    got = [srv.result(r) for r in rids]
+    srv.close()
+    assert got == moe_reference
+
+
+def test_expert_parallel_placement_is_validated(moe_model):
+    cfg, params = moe_model
+    with pytest.raises(ValueError, match="ep_axis requires mesh"):
+        serving.DecodeServer(params, cfg, max_batch=1, max_len=16,
+                             ep_axis="ep")
+    mesh = _mesh((2, 2), ("ep", "mp"))
+    dense = _cfg()
+    dparams = gpt.init_params(dense, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="cfg.moe is None"):
+        serving.DecodeServer(dparams, dense, max_batch=1, max_len=16,
+                             mesh=mesh, ep_axis="ep")
+    with pytest.raises(ValueError, match="no 'ep' axis"):
+        serving.DecodeServer(params, cfg, max_batch=1, max_len=16,
+                             mesh=_mesh((2,), ("mp",)), ep_axis="ep")
+    cfg3 = _mcfg(num_experts=3)
+    params3 = gpt.init_params(cfg3, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="not divisible"):
+        serving.DecodeServer(params3, cfg3, max_batch=1, max_len=16,
+                             mesh=mesh, ep_axis="ep")
+
+
+# ---------------------------------------------------------------------------
+# executable hygiene: warmup covers the whole serve path
+# ---------------------------------------------------------------------------
+
+
+def test_moe_warmup_compiles_everything_served():
+    """After warmup(prompt_lens, blocks, sample=True), serving greedy +
+    sampled + block traffic adds ZERO step-cache keys: every moe_* kind
+    the dispatch sites reach was compiled up front (jit keys are exact —
+    a retrace would mint a new key)."""
+    cfg = _mcfg()
+    params = gpt.init_params(cfg, jax.random.PRNGKey(11))
+    srv = serving.DecodeServer(params, cfg, max_batch=2, max_len=32)
+    srv.warmup(prompt_lens=(4,), blocks=(3,), sample=True)
+    keys = set(engine.ENGINE._steps.keys())
+    rids = [srv.submit([5, 3, 9, 1], max_new_tokens=4),
+            srv.submit([2, 8, 8, 1], max_new_tokens=4,
+                       temperature=0.8, top_k=4)]
+    while srv.pending():
+        srv.tick()
+    rid = srv.submit([1, 2, 3, 4], max_new_tokens=4)
+    while srv.pending():
+        srv.tick_block(3)
+    assert len(srv.result(rid)) == 4
+    for r in rids:
+        assert len(srv.result(r)) == 4
+    assert set(engine.ENGINE._steps.keys()) == keys
+    srv.close()
+
+
+# ---------------------------------------------------------------------------
+# staged/rejected compositions
+# ---------------------------------------------------------------------------
+
+
+def test_unsupported_compositions_reject_at_the_door(moe_model):
+    cfg, params = moe_model
+    with pytest.raises(NotImplementedError,
+                       match="speculative serving requires dense"):
+        serving.DecodeServer(params, cfg, max_batch=1, max_len=16,
+                             spec_k=2)
+    srv = serving.DecodeServer(params, cfg, max_batch=1, max_len=16)
+    with pytest.raises(NotImplementedError,
+                       match="constrained decoding on an MoE"):
+        srv.submit([1, 2], max_new_tokens=2, constraint=object())
+    from paddle_tpu.text import adapters
+    dense = _cfg()
+    dparams = gpt.init_params(dense, jax.random.PRNGKey(0))
+    pool = adapters.AdapterPool(dparams, dense, rank=2, max_adapters=1)
+    with pytest.raises(NotImplementedError,
+                       match="adapter_pool with an MoE"):
+        serving.DecodeServer(params, cfg, max_batch=1, max_len=16,
+                             adapter_pool=pool)
+
+
+def test_moe_verify_kind_is_registered_and_scores(moe_model):
+    """The staged spec-verify kind: keyed/named through the registry and
+    runnable directly (DecodeServer still rejects spec x MoE — pinned
+    above — so this is the kind the ROADMAP follow-up builds on)."""
+    cfg, params = moe_model
+    spec = engine.StepSpec(cfg=cfg, k=3)
+    assert spec.key("moe_verify") == ("moe_verify", engine.cfg_key(cfg),
+                                      3, False, None)
+    assert spec.name("moe_verify") == "serving.moe_verify@3"
+    fn = engine.ENGINE.get("moe_verify", spec)
+    cache = G.init_cache(cfg, 2, 16)
+    toks = jnp.asarray([[1, 2, 3], [4, 5, 6]], jnp.int32)
+    logits, _cache = fn(params, cache, toks, jnp.zeros((2,), jnp.int32))
+    assert logits.shape == (2, 3, cfg.vocab_size)
+    assert np.isfinite(np.asarray(logits)).all()

@@ -199,6 +199,19 @@ STREAM_MARKERS = ("stream", "scale_out", "scale_in", "migrate")
 # Pickler, ...) or ``import pickle`` in text/fleet.py fails outright.
 PICKLE_BAN_FILE = os.path.join("paddle_tpu", "text", "fleet.py")
 
+# MOE lint (round 19, same rule family): every token→expert routing
+# path in the MoE serving subsystem — dispatch, combine, capacity-drop
+# accounting — must count a telemetry counter (moe.dropped_tokens /
+# moe.expert_load) or delegate to another marker-named callable or to
+# one of the stats-bearing routing tails (:data:`MOE_DELEGATES`).  The
+# capacity-factor trade is the subsystem's whole contract: a routing
+# path that drops tokens without counting them turns "bounded drop
+# rate" into an unfalsifiable claim and hides expert-load skew.
+MOE_FILE = os.path.join("paddle_tpu", "text", "moe_serving.py")
+MOE_MARKERS = ("dispatch", "combine", "drop")
+MOE_DELEGATES = ("moe_ffn", "_ffn_tail", "_block_post_attn",
+                 "drain_drop_stats")
+
 
 def _call_name(node: ast.Call):
     f = node.func
@@ -416,6 +429,34 @@ def scan_pickle_ban_source(src: str, filename: str = "<src>") -> list:
                  f"frames are struct-prefixed JSON headers + raw "
                  f"buffers; pickle reopens the gadget surface and the "
                  f"host-side copy"))
+    return violations
+
+
+def scan_moe_source(src: str, filename: str = "<src>") -> list:
+    """MOE lint violations in one source string: a function whose name
+    carries a :data:`MOE_MARKERS` marker (a token→expert dispatch,
+    combine, or capacity-drop path) must contain a call to one of
+    :data:`COUNT_NAMES` or delegate to another marker-named callable or
+    to a stats-bearing routing tail in :data:`MOE_DELEGATES`."""
+    tree = ast.parse(src, filename=filename)
+    violations = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and any(m in node.name for m in MOE_MARKERS)):
+            continue
+        counted = any(
+            isinstance(n, ast.Call)
+            and (_call_name(n) in COUNT_NAMES
+                 or any(m in (_call_name(n) or "")
+                        for m in MOE_MARKERS + MOE_DELEGATES))
+            for n in ast.walk(node))
+        if not counted:
+            violations.append(
+                (filename, node.lineno,
+                 f"MoE routing path {node.name}() records no telemetry "
+                 f"counter (count) — uncounted dispatch/combine/drop "
+                 f"makes the capacity-factor drop rate and expert-load "
+                 f"balance unfalsifiable"))
     return violations
 
 
@@ -698,6 +739,12 @@ def scan_repo(root: str | None = None) -> list:
         with open(pb_path, encoding="utf-8") as f:
             violations.extend(scan_pickle_ban_source(
                 f.read(), os.path.relpath(pb_path, root)))
+    # MOE lint: token→expert dispatch/combine/drop observability
+    moe_path = os.path.join(root, MOE_FILE)
+    if os.path.exists(moe_path):
+        with open(moe_path, encoding="utf-8") as f:
+            violations.extend(scan_moe_source(
+                f.read(), os.path.relpath(moe_path, root)))
     # speculative-decoding lint: accept/propose/fallback observability
     spec_path = os.path.join(root, SPEC_FILE)
     if os.path.exists(spec_path):
