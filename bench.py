@@ -1334,6 +1334,7 @@ def _fleet_smoke():
     while router.pending():
         router.tick()
     got = [router.result(r) for r in rids]
+    tracks = router.fleet_trace()
     router.close()
     if got != ref:
         raise AssertionError(
@@ -1344,6 +1345,25 @@ def _fleet_smoke():
         raise AssertionError(
             "fleet smoke: the long prompt never handed off to the "
             "prefill worker (fleet.prefill_handoffs == 0)")
+    # observability round: the handed-off request must leave a COMPLETE
+    # router -> worker -> replica trace (one trace_id on all three
+    # track kinds) — a lost hop truncates every production waterfall
+    def _tids(prefix):
+        return {s["trace_id"] for nm, spans in tracks.items()
+                if nm.startswith(prefix) for s in spans}
+    complete = _tids("router") & _tids("worker-") & _tids("replica-")
+    if not complete:
+        raise AssertionError(
+            f"fleet smoke: no request traced across all three process "
+            f"tracks (tracks: { {nm: len(s) for nm, s in tracks.items()} })")
+    names = {s["name"] for spans in tracks.values() for s in spans
+             if s["trace_id"] in complete}
+    need = {"queue_wait", "route", "inject", "decode", "retire"}
+    if not (need <= names
+            and any(n.startswith("prefill_chunk[") for n in names)):
+        raise AssertionError(
+            f"fleet smoke: traced request is missing spans "
+            f"({sorted(need - names)} absent from {sorted(names)})")
     if not resilience.enabled():
         return {"ok": True, "prefill_handoffs": handoffs,
                 "reroutes": "skipped: PADDLE_TPU_RESILIENCE=0"}
@@ -3336,6 +3356,42 @@ def bench_fleet(small: bool):
             f"({gap99_fshort:.1f}ms) — long prompts are stalling the "
             f"token loop again")
     total_toks = sum(len(t) for t in toks_fleet)
+    # tracing-overhead arm (round 20): the tracing plane — trace mint
+    # at submit, span-ring records on every hop, piggyback collection
+    # on replies — must be invisible in the numbers.  Same mixed
+    # workload, same topology, same telemetry (metrics) plane, only
+    # PADDLE_TPU_TRACE flipped: tok/s and gap p99 with tracing ON must
+    # land within BENCH_TRACE_TOL (3%) of tracing OFF (best-of-2 both
+    # arms — the spans are host dicts keyed off a request field, so a
+    # miss here is a hot-path regression, not noise).
+    trace_tol = float(os.environ.get("BENCH_TRACE_TOL", "0.03"))
+    prev_tr = os.environ.get("PADDLE_TPU_TRACE")
+    os.environ["PADDLE_TPU_TRACE"] = "0"
+    try:
+        off_passes = [fleet_arm(mixed=True) for _ in range(2)]
+    finally:
+        if prev_tr is None:
+            os.environ.pop("PADDLE_TPU_TRACE", None)
+        else:
+            os.environ["PADDLE_TPU_TRACE"] = prev_tr
+    toks_off, gaps_off, _, _ = min(off_passes, key=lambda r: p(r[1], 99))
+    if toks_off != toks_single:
+        raise AssertionError(
+            "fleet bench: tracing-off fleet tokens diverged from the "
+            "single server — TELEMETRY=0 is not a no-op")
+    gap99_off = p(gaps_off, 99)
+    tok_s_on = total_toks / min(r[2] for r in passes)
+    tok_s_off = total_toks / min(r[2] for r in off_passes)
+    if tok_s_on < tok_s_off * (1 - trace_tol):
+        raise AssertionError(
+            f"fleet bench: tracing costs throughput — "
+            f"{tok_s_on:.1f} tok/s on vs {tok_s_off:.1f} off "
+            f"(> {trace_tol:.0%} regression)")
+    if gap99_fleet > gap99_off * (1 + trace_tol) + 1.0:
+        raise AssertionError(
+            f"fleet bench: tracing costs decode-gap latency — "
+            f"p99 {gap99_fleet:.2f}ms on vs {gap99_off:.2f}ms off "
+            f"(> {trace_tol:.0%} + 1ms regression)")
     rec = {"metric": "tokens_per_sec_serving_fleet",
            "unit": "tokens/s/chip",
            "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
@@ -3355,6 +3411,10 @@ def bench_fleet(small: bool):
            "fleet_short_only_gap_p99_ms": round(gap99_fshort, 2),
            "single_mixed_gap_p99_ms": round(gap99_single, 2),
            "single_short_only_gap_p99_ms": round(gap99_short, 2),
+           "tracing_off_gap_p99_ms": round(gap99_off, 2),
+           "tracing_overhead_tok_s": round(
+               1.0 - tok_s_on / max(tok_s_off, 1e-9), 4),
+           "tracing_tolerance": trace_tol,
            "gap_tolerance": tol,
            "telemetry": fleet_tel,
            "vs_baseline": 0.0}

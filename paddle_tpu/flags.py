@@ -832,6 +832,57 @@ def telemetry_log() -> str | None:
     return os.environ.get("PADDLE_TPU_TELEMETRY_LOG") or None
 
 
+def trace_enabled() -> bool:
+    """Fleet distributed-tracing switch (ON by default, nested under
+    the telemetry master switch — ``PADDLE_TPU_TELEMETRY=0`` already
+    no-ops the whole plane).  ``PADDLE_TPU_TRACE=0`` turns off just the
+    trace-context mint at ``Router.submit``: no ``trace`` key rides the
+    wire, every span record early-outs on the missing context, and the
+    metrics aggregation keeps working.  Host scheduling only — never a
+    jit-cache key."""
+    v = os.environ.get("PADDLE_TPU_TRACE", "1").strip().lower()
+    return v not in ("0", "false", "off", "no")
+
+
+def trace_ring_spans() -> int:
+    """Completed fleet-trace spans each entity's ring holds before new
+    spans are dropped (and drop-counted) instead of growing host memory
+    (``PADDLE_TPU_TRACE_RING``, default 4096).  Host scheduling only —
+    never a jit-cache key."""
+    try:
+        return max(1, int(os.environ.get("PADDLE_TPU_TRACE_RING",
+                                         "4096")))
+    except ValueError:
+        return 4096
+
+
+def trace_piggyback_cap() -> int:
+    """Spans a worker/replica ships per reply or stats collection when
+    the router drains its span ring (``PADDLE_TPU_TRACE_PIGGYBACK``,
+    default 256) — bounds the header-frame growth of any one transport
+    message; the remainder rides the next collection.  Host scheduling
+    only."""
+    try:
+        return max(1, int(os.environ.get("PADDLE_TPU_TRACE_PIGGYBACK",
+                                         "256")))
+    except ValueError:
+        return 256
+
+
+def fleet_metrics_port() -> int | None:
+    """``PADDLE_TPU_FLEET_METRICS_PORT=<port>``: start the Router's
+    fleet-aggregated metrics endpoint on this port when the Router is
+    constructed without an explicit ``metrics_port=`` (0 = ephemeral).
+    None = no endpoint unless asked per-Router.  Host scheduling only."""
+    v = os.environ.get("PADDLE_TPU_FLEET_METRICS_PORT")
+    if v is None or not v.strip():
+        return None
+    try:
+        return max(0, int(v))
+    except ValueError:
+        return None
+
+
 def decode_jit_key() -> tuple:
     """The trace-time decode-routing flag tuple — folded into every
     decode/serving jit-cache key (``generate._cfg_key``), so flipping any

@@ -108,6 +108,7 @@ __all__ = [
     "MetricsServer", "note_step_time", "sample_device_stats",
     "device_feed", "probe_health", "capture_device_profile",
     "set_runtime_wedge", "clear_runtime_wedge", "runtime_wedge",
+    "quantile_from_counts", "SpanRing", "mint_trace", "spans_to_chrome",
 ]
 
 
@@ -217,6 +218,40 @@ class Histogram:
                 out.append((_BOUNDS[i], cum))
         out.append((math.inf, cum + counts[-1]))
         return out
+
+    def state(self) -> dict:
+        """JSON-safe serialized form (raw bucket counts + count/sum +
+        observed extremes) — the wire shape replicas ship so a router can
+        :meth:`merge` distributions without the samples."""
+        with self._lock:
+            return {"counts": list(self._counts), "count": self._count,
+                    "sum": self._sum,
+                    "min": self._min if self._count else None,
+                    "max": self._max if self._count else None}
+
+    def merge(self, other) -> "Histogram":
+        """Fold another histogram — or a :meth:`state` dict shipped over
+        the wire — into this one by exact bucket-count addition.  Every
+        histogram shares the fixed ``_BOUNDS`` ladder, so the merge is
+        LOSSLESS: quantiles of the merged histogram equal quantiles of
+        the concatenated samples to within one bucket width.  Returns
+        ``self`` so folds chain."""
+        st = other.state() if isinstance(other, Histogram) else other
+        counts = st["counts"]
+        with self._lock:
+            if len(counts) != len(self._counts):
+                raise ValueError(
+                    f"bucket ladder mismatch: {len(counts)} vs "
+                    f"{len(self._counts)}")
+            for i, c in enumerate(counts):
+                self._counts[i] += int(c)
+            self._count += int(st["count"])
+            self._sum += float(st["sum"])
+            if st.get("min") is not None and float(st["min"]) < self._min:
+                self._min = float(st["min"])
+            if st.get("max") is not None and float(st["max"]) > self._max:
+                self._max = float(st["max"])
+        return self
 
 
 def quantile_from_counts(counts, q: float) -> float:
@@ -517,8 +552,11 @@ def span(name: str, tid: int = 0, **args):
         event(name, t0, time.perf_counter(), tid=tid, **args)
 
 
-def chrome_events(pid: int = 1) -> list:
-    """The ring buffer as chrome://tracing 'X' events (µs timestamps)."""
+def chrome_events(pid: int = 1, shift: float = 0.0) -> list:
+    """The ring buffer as chrome://tracing 'X' events (µs timestamps).
+    ``shift`` (seconds) is added to every timestamp — pass the
+    perf_counter→wall offset to co-display this perf-clock ring beside
+    the wall-clock fleet span tracks in one timeline."""
     out = [{"name": "process_name", "ph": "M", "pid": pid,
             "args": {"name": "paddle_tpu.telemetry"}}]
     with _lock:
@@ -526,14 +564,149 @@ def chrome_events(pid: int = 1) -> list:
     for e in events:
         if e.get("ph") == "C":  # counter sample (HBM gauges)
             out.append({"name": e["name"], "ph": "C", "pid": pid,
-                        "tid": 0, "ts": e["t"] * 1e6,
+                        "tid": 0, "ts": (e["t"] + shift) * 1e6,
                         "args": e.get("args", {})})
             continue
         ev = {"name": e["name"], "ph": "X", "pid": pid, "tid": e["tid"],
-              "ts": e["t0"] * 1e6, "dur": (e["t1"] - e["t0"]) * 1e6}
+              "ts": (e["t0"] + shift) * 1e6,
+              "dur": (e["t1"] - e["t0"]) * 1e6}
         if "args" in e:
             ev["args"] = e["args"]
         out.append(ev)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# fleet tracing: trace contexts + per-entity span rings
+# ---------------------------------------------------------------------------
+# A trace context is a tiny JSON-safe dict of scalars ({"trace_id": ...},
+# optionally {"parent": ...}) minted once at Router.submit and carried on
+# the request dict — it rides the raw-row transport's JSON header frame,
+# adopt_request's dict() copies, and the spill/migrate codec without any
+# wire-format change.  Each process-side entity (a DecodeServer replica, a
+# PrefillWorker, the Router itself) records completed spans into its own
+# bounded SpanRing; remote rings are drained onto existing reply/stats
+# messages and reassembled by the Router into one wall-clock timeline.
+
+_trace_lock = threading.Lock()
+_trace_seq = [0]
+
+
+def mint_trace(parent=None):
+    """Mint a fleet trace context: a JSON-safe ``{"trace_id": ...}`` dict
+    (plus ``parent`` when nesting spans) unique across the processes of
+    one fleet run (pid + per-process sequence + wall-ms).  Returns
+    ``None`` when telemetry is disabled — no key is ever attached to the
+    request dict, so the ``PADDLE_TPU_TELEMETRY=0`` path is bit-identical
+    by construction.  ``PADDLE_TPU_TRACE=0`` turns off just the tracing
+    plane while the metrics plane keeps running."""
+    if not enabled() or not _flags.trace_enabled():
+        return None
+    with _trace_lock:
+        _trace_seq[0] += 1
+        seq = _trace_seq[0]
+    tid = (f"{os.getpid():x}-{seq:x}-"
+           f"{int(time.time() * 1e3) & 0xFFFFFFFF:x}")
+    ctx = {"trace_id": tid}
+    if parent is not None:
+        ctx["parent"] = parent
+    return ctx
+
+
+class SpanRing:
+    """Bounded buffer of COMPLETED trace spans for one entity (replica /
+    prefill worker / router track).  Spans are stamped in WALL-CLOCK
+    seconds (``time.time``) so rings collected from different processes
+    assemble onto one timeline — the perf_counter inputs every call site
+    already holds are shifted by the clock offset measured at record
+    time (µs-level error, zero new stamps on the hot path).  A full ring
+    drops new spans and counts them instead of growing without bound:
+    span loss is accounted, never silent."""
+
+    __slots__ = ("_cap", "_spans", "_dropped", "_lock")
+
+    def __init__(self, cap=None):
+        self._cap = (_flags.trace_ring_spans() if cap is None
+                     else max(1, int(cap)))
+        self._spans: list = []
+        self._dropped = 0
+        self._lock = threading.Lock()
+
+    def record(self, trace, name, t0, t1, **args) -> None:
+        """Record one completed span ``[t0, t1]`` (``time.perf_counter``
+        seconds) under ``trace``.  No-op without a trace context or with
+        telemetry disabled — untraced requests pay one dict lookup."""
+        if not trace or not enabled():
+            return
+        off = time.time() - time.perf_counter()
+        span = {"trace_id": trace.get("trace_id"), "name": name,
+                "ts": t0 + off, "dur": max(0.0, t1 - t0)}
+        if "parent" in trace:
+            span["parent"] = trace["parent"]
+        if args:
+            span["args"] = dict(args)
+        self.push(span)
+        _jsonl_write(dict(span, ph="S"))
+
+    def push(self, span: dict) -> None:
+        """Append one already-formed span dict (a router absorbing a
+        remote ring's drained spans); counts a drop when full."""
+        with self._lock:
+            if len(self._spans) >= self._cap:
+                self._dropped += 1
+            else:
+                self._spans.append(span)
+
+    def add_drops(self, n: int) -> None:
+        """Fold a remote ring's reported drop count into this one so the
+        fleet-side accounting sums losslessly."""
+        if n > 0:
+            with self._lock:
+                self._dropped += int(n)
+
+    def drain(self, cap=None):
+        """Destructively take up to ``cap`` spans (the piggyback bound)
+        plus the drop count so far; the drop counter resets with the
+        take so repeated collections sum exactly."""
+        with self._lock:
+            if cap is None or cap >= len(self._spans):
+                spans, self._spans = self._spans, []
+            else:
+                spans = self._spans[:cap]
+                del self._spans[:cap]
+            dropped, self._dropped = self._dropped, 0
+        return spans, dropped
+
+    def spans(self) -> list:
+        """Non-destructive snapshot (the dump/export path)."""
+        with self._lock:
+            return list(self._spans)
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+
+def spans_to_chrome(spans, pid: int, name: str) -> list:
+    """Wall-clock trace spans as chrome 'X' events on one process track
+    (one ``tid`` row per request id, trace_id surfaced in args)."""
+    out = [{"name": "process_name", "ph": "M", "pid": pid,
+            "args": {"name": name}}]
+    for s in spans:
+        args = dict(s.get("args", {}))
+        tid = args.get("rid", 0)
+        args["trace_id"] = s.get("trace_id")
+        out.append({"name": s.get("name", "?"), "ph": "X", "pid": pid,
+                    "tid": int(tid) if isinstance(tid, (int, float))
+                    else 0,
+                    "ts": float(s.get("ts", 0.0)) * 1e6,
+                    "dur": float(s.get("dur", 0.0)) * 1e6,
+                    "args": args})
     return out
 
 
@@ -1118,10 +1291,19 @@ class MetricsServer:
     ``port=0`` picks an ephemeral port (``.port`` has the bound one).
     Binds loopback by default — the endpoint is unauthenticated, so
     exposing it beyond the host (``host="0.0.0.0"`` for a scraper
-    sidecar) is an explicit opt-in."""
+    sidecar) is an explicit opt-in.
 
-    def __init__(self, port: int, host: str = "127.0.0.1"):
+    ``render``/``snap`` override what ``/metrics`` and ``/snapshot``
+    serve (callables returning exposition text / a JSON-safe dict) — the
+    Router passes its fleet-merged views so one port covers the whole
+    fleet; ``None`` keeps the process-local registry."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1",
+                 render=None, snap=None):
         import http.server
+
+        render_fn = render if render is not None else render_prometheus
+        snap_fn = snap if snap is not None else snapshot
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def _reply(self_h, code, body, ctype):  # noqa: N805
@@ -1133,7 +1315,7 @@ class MetricsServer:
 
             def do_GET(self_h):  # noqa: N805
                 if self_h.path.startswith("/snapshot"):
-                    body = json.dumps(snapshot()).encode()
+                    body = json.dumps(snap_fn()).encode()
                     ctype = "application/json"
                 elif self_h.path.startswith("/healthz"):
                     probe = probe_health()
@@ -1168,7 +1350,7 @@ class MetricsServer:
                     return
                 elif self_h.path.startswith("/metrics") or \
                         self_h.path == "/":
-                    body = render_prometheus().encode()
+                    body = render_fn().encode()
                     ctype = "text/plain; version=0.0.4"
                 else:
                     self_h.send_error(404)
@@ -1229,7 +1411,9 @@ class MetricsServer:
                 self._thread.join(timeout=5.0)
 
 
-def serve_metrics(port: int, host: str = "127.0.0.1") -> MetricsServer:
+def serve_metrics(port: int, host: str = "127.0.0.1",
+                  render=None, snap=None) -> MetricsServer:
     """Start the /metrics endpoint (``DecodeServer(metrics_port=...)``
-    calls this; standalone use works too)."""
-    return MetricsServer(port, host)
+    calls this; standalone use works too).  ``render``/``snap`` override
+    the served views — the Router's fleet aggregation plane."""
+    return MetricsServer(port, host, render=render, snap=snap)

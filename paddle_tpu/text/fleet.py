@@ -49,6 +49,7 @@ from __future__ import annotations
 import concurrent.futures
 import contextlib
 import json
+import os
 import queue
 import socket
 import struct
@@ -469,8 +470,11 @@ class PrefillWorker:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._tel = _telemetry.enabled()
+        # fleet tracing: this worker's completed spans, drained onto
+        # the reply/chunk messages it already sends (piggyback-capped)
+        self._span_ring = _telemetry.SpanRing()
 
-    def prefill(self, prompt):
+    def prefill(self, prompt, trace=None):
         """Run one prompt's admission prefill; returns ``(rows,
         logits)``: rows are host arrays ``[L, 1, n, Hkv(, hd)]`` per
         cache leaf (int8 scale planes included) in the storage dtype,
@@ -528,9 +532,13 @@ class PrefillWorker:
             _telemetry.count("fleet.prefill_jobs")
             _telemetry.observe("fleet.prefill_ms",
                                (time.perf_counter() - t0) * 1e3)
+            self._span_ring.record(
+                trace, "prefill_chunk[0]", t0, time.perf_counter(),
+                start=0, stop=n)
         return rows, logits
 
-    def prefill_stream(self, prompt, emit, chunk_rows=None) -> None:
+    def prefill_stream(self, prompt, emit, chunk_rows=None,
+                       trace=None) -> None:
         """Chunked streaming prefill (the pipelined handoff hot path):
         walk the prompt through the offset-aware chunk executables
         (``prefill_chunk@W`` / ``paged_prefill@W``) and hand each
@@ -566,10 +574,13 @@ class PrefillWorker:
         t0 = time.perf_counter()
         if n <= W:
             # single-window prompt: the monolithic walk IS the chunk
-            rows, logits = self.prefill(prompt)
+            rows, logits = self.prefill(prompt, trace=trace)
             emit({"op": "chunk", "seq": 0, "start": 0, "stop": n,
                   "n": n, "rows": rows, "logits": logits})
             self._count_stream(rows)
+            if self._tel:
+                self._span_ring.record(
+                    trace, "stream", t0, time.perf_counter(), chunks=1)
             return
         starts = list(range(0, n - W, W)) + [n - W]
         if self._paged:
@@ -608,10 +619,11 @@ class PrefillWorker:
                     out[name] = arr[:, 0:1, lo:hi]
             return out
 
-        pending = None            # (seq, lo, hi, device rows)
+        pending = None            # (seq, lo, hi, device rows, t_disp)
         logits = None
         prev_stop = 0
         for j, s in enumerate(starts):
+            t_disp = time.perf_counter()
             chunk = prompt[s:s + W]
             padded = np.zeros((1, W), np.int32)
             padded[0, :len(chunk)] = chunk
@@ -622,21 +634,26 @@ class PrefillWorker:
             lo, hi = prev_stop, min(s + W, n)
             prev_stop = hi
             if pending is not None:
-                self._emit_chunk(emit, pending, n)
-            pending = (j, lo, hi, device_rows(lo, hi))
+                self._emit_chunk(emit, pending, n, trace=trace)
+            pending = (j, lo, hi, device_rows(lo, hi), t_disp)
         self._emit_chunk(emit, pending, n,
-                         logits=np.asarray(logits, np.float32))
+                         logits=np.asarray(logits, np.float32),
+                         trace=trace)
         if self._paged:
             self._pool.free_slot(0)
         if self._tel:
             _telemetry.count("fleet.prefill_jobs")
             _telemetry.observe("fleet.prefill_ms",
                                (time.perf_counter() - t0) * 1e3)
+            self._span_ring.record(
+                trace, "stream", t0, time.perf_counter(),
+                chunks=len(starts))
 
-    def _emit_chunk(self, emit, pending, n, logits=None) -> None:
+    def _emit_chunk(self, emit, pending, n, logits=None,
+                    trace=None) -> None:
         """Fetch one finished chunk's device rows (overlapping the
         in-flight next chunk) and stream it out."""
-        seq, lo, hi, dev = pending
+        seq, lo, hi, dev, t_disp = pending
         rows = {name: np.asarray(v) for name, v in dev.items()}
         msg = {"op": "chunk", "seq": seq, "start": lo, "stop": hi,
                "n": n, "rows": rows}
@@ -644,6 +661,12 @@ class PrefillWorker:
             msg["logits"] = logits
         emit(msg)
         self._count_stream(rows)
+        if self._tel:
+            # dispatch → emitted: covers the chunk's device compute +
+            # the row fetch that overlapped the next chunk's dispatch
+            self._span_ring.record(
+                trace, f"prefill_chunk[{seq}]", t_disp,
+                time.perf_counter(), start=lo, stop=hi)
 
     def _count_stream(self, rows) -> None:
         if self._tel:
@@ -666,22 +689,40 @@ class PrefillWorker:
             return True
         try:
             C = _flags.stream_chunk_rows()
+            # handoff trace context: minted by the router, carried on
+            # the job's header frame, stamped onto every span this
+            # worker records for the job
+            tr = msg.get("trace") if isinstance(msg, dict) else None
             if C > 0:
                 rid = msg["rid"]
                 self.prefill_stream(
                     msg["prompt"],
-                    lambda m: self.endpoint.send(dict(m, rid=rid)),
-                    chunk_rows=C)
+                    lambda m: self.endpoint.send(
+                        self._with_spans(dict(m, rid=rid))),
+                    chunk_rows=C, trace=tr)
             else:
-                rows, logits = self.prefill(msg["prompt"])
-                self.endpoint.send({"rid": msg["rid"], "rows": rows,
-                                    "logits": logits})
+                rows, logits = self.prefill(msg["prompt"], trace=tr)
+                self.endpoint.send(self._with_spans(
+                    {"rid": msg["rid"], "rows": rows,
+                     "logits": logits}))
         except ConnectionError:
             raise                  # dead link: the caller retires it
         except Exception as e:  # noqa: BLE001 - reported to the router
             self.endpoint.send({"rid": msg.get("rid"),
                                 "error": f"{type(e).__name__}: {e}"})
         return True
+
+    def _with_spans(self, msg: dict) -> dict:
+        """Drain this worker's completed spans onto an outgoing reply
+        (the remote-collection piggyback; capped per message, drops
+        carried so loss is accounted router-side)."""
+        if self._tel:
+            spans, dropped = self._span_ring.drain(
+                _flags.trace_piggyback_cap())
+            if spans or dropped:
+                msg["spans"] = spans
+                msg["span_drops"] = dropped
+        return msg
 
     def start(self) -> None:
         """Serve jobs on a daemon thread until :meth:`close` (or a
@@ -818,8 +859,19 @@ class Router:
         self._default_ttl = _flags.request_ttl_s()
         self._resil = _resilience.enabled()
         self._tel = _telemetry.enabled()
-        self.metrics_server = (_telemetry.serve_metrics(metrics_port)
-                               if metrics_port is not None else None)
+        # fleet observability plane (round 20): per-track span stores —
+        # the router's own spans plus rings drained from replicas and
+        # workers, each bounded + drop-counted — and the aggregated
+        # metrics endpoint: the router's port serves the fleet-MERGED
+        # Prometheus exposition / snapshot (per-replica labels + exact
+        # histogram-merge rollups), not just the process registry.
+        self._trace_tracks: dict = {}
+        self._t_start = time.perf_counter()
+        port = (metrics_port if metrics_port is not None
+                else _flags.fleet_metrics_port())
+        self.metrics_server = (_telemetry.serve_metrics(
+            port, render=self.render_fleet_prometheus,
+            snap=self.fleet_snapshot) if port is not None else None)
         self._queue: list[int] = []            # fleet rids awaiting dispatch
         self._requests: dict[int, dict] = {}   # fleet rid -> record
         self._local: dict = {}                 # (replica, local rid) -> rid
@@ -898,6 +950,13 @@ class Router:
                "ttl": ttl, "priority": int(priority),
                "tenant": tenant,
                "t_submit": now, "t_enqueue": now}
+        # fleet trace context: minted HERE, carried on the request dict
+        # through handoff/stream/adopt/reroute/migrate — None (no key
+        # attached at all) with telemetry off, so the TELEMETRY=0 fleet
+        # path is bit-identical by construction
+        tr = _telemetry.mint_trace()
+        if tr is not None:
+            req["trace"] = tr
         rec = {"state": "queued", "req": req}
         self._requests[rid] = rec
         if self._tel:
@@ -960,13 +1019,18 @@ class Router:
                     if self._ep_windows[i] is None
                     or self._ep_windows[i] >= n]
 
+        # the trace context rides the job's JSON header frame so every
+        # span the worker records lands under this request's trace
+        job = {"rid": rid, "prompt": rec["req"]["prompt"]}
+        tr = rec["req"].get("trace")
+        if tr is not None:
+            job["trace"] = tr
         live = usable()
         while live:
             i = live[self._pf_next % len(live)]
             self._pf_next += 1
             try:
-                self._prefill_eps[i].send(
-                    {"rid": rid, "prompt": rec["req"]["prompt"]})
+                self._prefill_eps[i].send(job)
             except (ConnectionError, OSError):
                 self._fail_prefill_ep(i)
                 live = usable()
@@ -1050,7 +1114,8 @@ class Router:
                     top_k=req.get("top_k", 0),
                     top_p=req.get("top_p", 1.0),
                     ttl_s=req.get("ttl"),
-                    priority=req.get("priority", 0))
+                    priority=req.get("priority", 0),
+                    trace=req.get("trace"))
             except ValueError as e:
                 self._prefilling.discard(rid)
                 rec["state"] = "error"
@@ -1064,6 +1129,7 @@ class Router:
                 # the first chunk's replica pick IS this request's
                 # routing decision (same scorer as queued dispatch)
                 _telemetry.count("fleet.routed")
+                self._dispatch_spans(rid, req, i)
         srv = self.replicas[rec["replica"]]
         try:
             srv.stream_prefilled_rows(
@@ -1091,6 +1157,12 @@ class Router:
                     break
                 if msg is None:
                     break
+                if self._tel and isinstance(msg, dict) \
+                        and "spans" in msg:
+                    # remote span collection: worker spans piggyback on
+                    # the replies this poll already reads
+                    self._absorb_spans(f"worker-{i}", msg["spans"],
+                                       msg.get("span_drops", 0))
                 if msg.get("op") == "chunk":
                     self._stream_chunk(i, msg)
                     continue
@@ -1288,6 +1360,7 @@ class Router:
                         stats[i]["queue_depth"] += 1
                 if self._tel:
                     _telemetry.count("fleet.routed")
+                    self._dispatch_spans(rid, rec["req"], i)
                 break
         self._queue[:] = held
 
@@ -1342,6 +1415,13 @@ class Router:
             rec.pop("replica", None)
             rec.pop("local_rid", None)
             front.append(rid)
+            # the trace context rides the request dict through the
+            # reroute; the marker span keeps the hop visible
+            tr = r.get("trace")
+            if tr:
+                now = time.perf_counter()
+                self._track("router").record(tr, "reroute", now, now,
+                                             rid=rid, src=i)
         if front:
             self._queue[:0] = front
             if self._tel:
@@ -1377,11 +1457,18 @@ class Router:
             entries = src.migrate_out(prompt)
             if not entries:
                 continue
+            t0m = time.perf_counter()
             hdr, arrays = _encode_msg(entries)
             entries = _decode_msg(
                 hdr, [bytearray(a.reshape(-1).view(np.uint8))
                       for a in arrays])
             pool.migrate_in(entries)
+            # traced requests keep their chain moves on the timeline
+            tr = req.get("trace")
+            if tr:
+                self._track("router").record(
+                    tr, "migrate", t0m, time.perf_counter(),
+                    src=j, dest=dest_i)
 
     def add_replica(self, srv) -> int:
         """Attach a decode replica LIVE: it joins the routing candidate
@@ -1440,6 +1527,11 @@ class Router:
                 rec["state"] = "error"
                 rec["error"] = str(e)
             del self._local[(ri, local)]
+        if self._tel and hasattr(srv, "drain_spans"):
+            # last collection before the handle leaves the fleet — a
+            # departing replica's spans must not vanish with it
+            spans, drops = srv.drain_spans()
+            self._absorb_spans(f"replica-{i}", spans, drops)
         self.replicas[i] = None
         self._ok[i] = False
         self._window = min(min(r.max_len, r.cfg.max_seq_len)
@@ -1563,6 +1655,7 @@ class Router:
             if errs:
                 raise errs[0]
         self._check_health()
+        self._harvest_spans()
         self._gauges()
 
     def _absorb_backpressure(self, stats=None) -> None:
@@ -1646,6 +1739,197 @@ class Router:
             "admission": (None if self._adm is None
                           else self._adm.stats()),
         }
+
+    # -- fleet tracing: collection + assembly -------------------------------
+
+    def _track(self, name: str) -> _telemetry.SpanRing:
+        """The named span track (lazily created): ``router`` for spans
+        this process records, ``replica-N``/``worker-N`` for rings
+        collected from the fleet — each bounded + drop-counted."""
+        ring = self._trace_tracks.get(name)
+        if ring is None:
+            ring = self._trace_tracks[name] = _telemetry.SpanRing()
+        return ring
+
+    def _absorb_spans(self, track: str, spans, dropped=0) -> None:
+        """Fold a remote ring's drained spans + drop count into the
+        named track (drops also surface on ``fleet.trace_drops``)."""
+        ring = self._track(track)
+        for s in spans or ():
+            if isinstance(s, dict):
+                ring.push(s)
+        if dropped:
+            ring.add_drops(int(dropped))
+            _telemetry.count("fleet.trace_drops", int(dropped))
+
+    def _dispatch_spans(self, rid: int, req: dict, replica: int) -> None:
+        """The dispatch decision on the trace: the fleet-queue wait and
+        a zero-width route marker naming the chosen replica."""
+        tr = req.get("trace")
+        if not tr:
+            return
+        now = time.perf_counter()
+        ring = self._track("router")
+        ring.record(tr, "queue_wait",
+                    req.get("t_enqueue", req.get("t_submit", now)), now,
+                    rid=rid)
+        ring.record(tr, "route", now, now, rid=rid, replica=replica)
+
+    def _harvest_spans(self) -> None:
+        """One collection round: drain every live replica's span ring
+        (the piggyback the ``load_stats(include_spans=True)`` API rides)
+        into its per-replica track.  Worker spans arrive separately on
+        the replies ``_poll_prefill`` already reads."""
+        if not self._tel:
+            return
+        for i, r in enumerate(self.replicas):
+            if r is None or not hasattr(r, "drain_spans"):
+                continue
+            spans, dropped = r.drain_spans()
+            if spans or dropped:
+                self._absorb_spans(f"replica-{i}", spans, dropped)
+
+    def fleet_trace(self) -> dict:
+        """``{track: [span, ...]}`` — a fresh collection round plus a
+        non-destructive snapshot of every span track (``router``,
+        ``replica-N``, ``worker-N``).  Spans are wall-clock stamped, so
+        tracks from different processes share one timeline."""
+        self._harvest_spans()
+        return {nm: ring.spans()
+                for nm, ring in sorted(self._trace_tracks.items())}
+
+    def dump_fleet_trace(self, path: str) -> str:
+        """Assemble ONE Perfetto-loadable timeline for the whole fleet:
+        a process track per span source (router / replica-N / worker-N,
+        one tid row per request) beside the process-global telemetry
+        ring (request/compile events + HBM counter samples) shifted
+        from the perf clock onto the wall clock.  Every request that
+        crossed the fleet shows its full waterfall — queue_wait/route at
+        the router, prefill_chunk[i]/stream at the worker,
+        inject/decode/spec_round/retire at the replica — under a single
+        ``trace_id``."""
+        tracks = self.fleet_trace()
+        evs = []
+        pid = 1
+        for nm, spans in tracks.items():
+            evs.extend(_telemetry.spans_to_chrome(
+                spans, pid=pid, name=f"fleet.{nm}"))
+            pid += 1
+        evs.extend(_telemetry.chrome_events(
+            pid=0, shift=time.time() - time.perf_counter()))
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"traceEvents": evs, "displayTimeUnit": "ms"}, f)
+        return path
+
+    # -- fleet metrics aggregation ------------------------------------------
+
+    def fleet_snapshot(self) -> dict:
+        """The aggregated metrics view the router's ``/snapshot``
+        serves: each replica's per-server histogram states + counters +
+        live load, fleet rollups computed by EXACT log-bucket histogram
+        merge (every histogram shares the fixed bucket ladder, so the
+        fleet p99 equals the p99 of the concatenated samples to within
+        one bucket width — not an average of quantiles), and the span
+        tracks' collection accounting."""
+        reps: dict = {}
+        merged: dict = {}
+        counters: dict = {}
+        for i, r in enumerate(self.replicas):
+            if r is None:
+                continue
+            snap = (r.local_snapshot()
+                    if hasattr(r, "local_snapshot")
+                    else {"histograms": {}, "counters": {}})
+            summaries = {}
+            for name, stt in snap["histograms"].items():
+                h = merged.get(name)
+                if h is None:
+                    h = merged[name] = _telemetry.Histogram(
+                        f"fleet.{name}")
+                h.merge(stt)
+                one = _telemetry.Histogram(name)
+                one.merge(stt)
+                summaries[name] = one.summary()
+            reps[str(i)] = {
+                "histograms": snap["histograms"],
+                "summaries": summaries,   # pre-digested for fleet_top
+                "counters": snap["counters"],
+                "load": r.load_stats(),
+                "healthy": bool(self._ok[i]),
+            }
+            for name, c in snap["counters"].items():
+                counters[name] = counters.get(name, 0) + c
+        uptime = max(time.perf_counter() - self._t_start, 1e-9)
+        toks = counters.get("serving.tokens_generated", 0)
+        ttft = merged.get("serving.ttft_ms")
+        tpot = merged.get("serving.tpot_ms")
+        return {
+            "replicas": reps,
+            "fleet": {
+                "replicas": sum(1 for r in self.replicas
+                                if r is not None),
+                "healthy_replicas": sum(self._ok),
+                "queue_depth": len(self._queue),
+                "prefill_outstanding": len(self._prefilling),
+                "uptime_s": round(uptime, 3),
+                "tokens_generated": toks,
+                "tok_s": round(toks / uptime, 3),
+                "requests_completed": counters.get(
+                    "serving.requests_completed", 0),
+                "ttft_p99_ms": (round(ttft.quantile(0.99), 6)
+                                if ttft is not None else 0.0),
+                "tpot_p99_ms": (round(tpot.quantile(0.99), 6)
+                                if tpot is not None else 0.0),
+                "histograms": {name: h.summary()
+                               for name, h in sorted(merged.items())},
+            },
+            "trace": {nm: {"spans": len(ring),
+                           "dropped": ring.dropped}
+                      for nm, ring in sorted(
+                          self._trace_tracks.items())},
+        }
+
+    @staticmethod
+    def _render_hist_lines(out: list, name: str, h, label: str) -> None:
+        pn = ("paddle_tpu_fleet_"
+              + name.replace(".", "_").replace("-", "_"))
+        for ub, cum in h.buckets():
+            le = "+Inf" if ub == float("inf") else repr(ub)
+            out.append(f'{pn}_bucket{{{label},le="{le}"}} {cum}')
+        s = h.summary()
+        out.append(f'{pn}_sum{{{label}}} {s["sum"]}')
+        out.append(f'{pn}_count{{{label}}} {s["count"]}')
+
+    def render_fleet_prometheus(self) -> str:
+        """One Prometheus exposition for the whole fleet: the process
+        registry first (unchanged families), then every replica's
+        per-server histograms re-labeled ``{replica="i"}`` under
+        ``paddle_tpu_fleet_*`` family names (a distinct family, so the
+        process-level TYPE lines never duplicate), then the fleet
+        rollups — merged by exact bucket addition, never quantile
+        averaging."""
+        snap = self.fleet_snapshot()
+        out = [_telemetry.render_prometheus().rstrip("\n")]
+        for i in sorted(snap["replicas"], key=int):
+            rep = snap["replicas"][i]
+            for name, stt in rep["histograms"].items():
+                h = _telemetry.Histogram(name)
+                h.merge(stt)
+                self._render_hist_lines(out, name, h,
+                                        f'replica="{i}"')
+            for name, c in rep["counters"].items():
+                pn = ("paddle_tpu_fleet_"
+                      + name.replace(".", "_").replace("-", "_")
+                      + "_total")
+                out.append(f'{pn}{{replica="{i}"}} {c}')
+        fl = snap["fleet"]
+        for k in ("replicas", "healthy_replicas", "queue_depth",
+                  "prefill_outstanding", "tokens_generated", "tok_s",
+                  "ttft_p99_ms", "tpot_p99_ms"):
+            out.append(f"paddle_tpu_fleet_{k} {fl[k]}")
+        return "\n".join(out) + "\n"
 
     def _gauges(self) -> None:
         if not self._tel:

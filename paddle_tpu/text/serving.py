@@ -548,6 +548,15 @@ class DecodeServer:
         self._tel = _telemetry.enabled()
         self.metrics_server = (_telemetry.serve_metrics(metrics_port)
                                if metrics_port is not None else None)
+        # fleet observability plane (round 20): completed trace spans
+        # for requests carrying a router-minted trace context, plus
+        # per-SERVER histogram twins — loopback fleets co-host many
+        # replicas in one process, so the fleet metrics merge needs
+        # per-server distributions the process-global registry can't
+        # give.  Both empty and untouched when no trace/telemetry.
+        self._span_ring = _telemetry.SpanRing()
+        self._hist_local: dict = {}
+        self._counts_local: dict = {}
         self.cfg = cfg
         self.max_batch = max_batch
         self.max_len = max_len
@@ -1080,7 +1089,7 @@ class DecodeServer:
                          max_new_tokens: int = 32, stop: list | None = None,
                          temperature: float = 0.0, top_k: int = 0,
                          top_p: float = 1.0, ttl_s: float | None = None,
-                         priority: int = 0) -> int:
+                         priority: int = 0, trace=None) -> int:
         """Admit a request whose prompt a PREFILL WORKER already ran
         (round 9, the fleet's prefill/decode handoff): ``rows`` are the
         worker's finished cache rows — leaves ``[L, 1, n, Hkv(, hd)]``
@@ -1117,6 +1126,8 @@ class DecodeServer:
                 f"prefilled rows cover {rows['k'].shape[2]} positions "
                 f"for a {n}-token prompt")
         req["prefilled"] = (rows, np.asarray(logits, np.float32))
+        if trace:
+            req["trace"] = trace
         self._queue.append(req)
         if self._tel:
             _telemetry.count("serving.requests_submitted")
@@ -1267,6 +1278,10 @@ class DecodeServer:
                 # span timestamps (host clock only; never a device sync)
                 "t_submit": req.get("t_submit", t_admit),
                 "t_admit": t_admit,
+                # fleet trace context (router-minted; absent on direct
+                # submits and whenever telemetry is off) — every span
+                # this slot records lands under it
+                "trace": req.get("trace"),
                 # multi-tenant serving: which pool row this slot gathers
                 # (0 = base model) and the original spec — the spec (not
                 # the live automaton) survives OOM-evict requeues
@@ -1295,7 +1310,7 @@ class DecodeServer:
                 if self._tel:
                     _telemetry.count("admission.spec_forced")
             if self._tel:
-                _telemetry.observe(
+                self._observe(
                     "serving.queue_wait_ms",
                     (t_admit - st["t_submit"]) * 1e3)
             if req.get("stream"):
@@ -1492,11 +1507,14 @@ class DecodeServer:
                     # the prefill span cost zero extra syncs
                     now = time.perf_counter()
                     st["t_first"] = st["t_last"] = now
-                    _telemetry.observe(
+                    self._observe(
                         "serving.ttft_ms", (now - st["t_submit"]) * 1e3)
                     _telemetry.event("serving.prefill", t_admit, now,
                                      tid=slot, rid=st["rid"],
                                      prompt_len=n)
+                    self._span_ring.record(
+                        st.get("trace"), "prefill", t_admit, now,
+                        rid=st["rid"], prompt_len=n)
                     # per-EXECUTION wall bounded at the logits fetch
                     # (host sampling excluded): chunked admission ran
                     # the one chunk executable len(starts) times — the
@@ -1505,6 +1523,7 @@ class DecodeServer:
                         f"serving.{prefill_name}",
                         (t_prefill_done - t_admit) / prefill_calls)
                     _telemetry.count("serving.tokens_generated")
+                    self._count_local("serving.tokens_generated")
                 # _finished (not the old max_new <= 1 test): a carried
                 # (OOM-evicted, re-admitted) request may hit its budget
                 # on the admission token
@@ -1739,16 +1758,20 @@ class DecodeServer:
         if self._tel:
             now = time.perf_counter()
             st["t_first"] = st["t_last"] = now
-            _telemetry.observe("serving.ttft_ms",
-                               (now - st["t_submit"]) * 1e3)
+            self._observe("serving.ttft_ms",
+                          (now - st["t_submit"]) * 1e3)
             _telemetry.event("serving.prefill",
                              st.get("t_admit", t0), now, tid=slot,
                              rid=st["rid"], prompt_len=n)
+            self._span_ring.record(
+                st.get("trace"), "prefill", st.get("t_admit", t0), now,
+                rid=st["rid"], prompt_len=n)
             # only the FINAL chunk's wall is fetch-bounded (earlier
             # chunks dispatch without a sync), so per-execution timing
             # covers exactly this one execution
             _telemetry.note_step_time(f"serving.{kind}", t_fetch - t0)
             _telemetry.count("serving.tokens_generated")
+            self._count_local("serving.tokens_generated")
         fin = self._constraint_push(st, t)
         if self._finished(st, t) or fin:
             # carried (OOM-evicted) requests may hit their budget on
@@ -1767,7 +1790,7 @@ class DecodeServer:
                                temperature: float = 0.0, top_k: int = 0,
                                top_p: float = 1.0,
                                ttl_s: float | None = None,
-                               priority: int = 0) -> int:
+                               priority: int = 0, trace=None) -> int:
         """Open a STREAMED prefill handoff — the chunked twin of
         :meth:`submit_prefilled`.  The caller (the fleet router, as a
         worker's chunks land) follows with one
@@ -1787,6 +1810,8 @@ class DecodeServer:
                                   temperature, top_k, top_p, ttl_s,
                                   priority)
         req["stream"] = True
+        if trace:
+            req["trace"] = trace
         self._streams[req["rid"]] = {
             "req": req, "pending": [], "expect": 0,
             "slot": None, "st": None}
@@ -1939,6 +1964,7 @@ class DecodeServer:
         n = len(st["prompt"])
         lo = max(st.get("stream_shared", 0), start)
         if stop > lo:
+            t_inj = time.perf_counter()
             bucket = _pow2_bucket(n, self.max_len,
                                   self.cfg.max_seq_len)
             padded = {}
@@ -1953,6 +1979,10 @@ class DecodeServer:
                             jnp.asarray(stop), jnp.asarray(slot))
             if self._tel:
                 _telemetry.count("serving.prefilled_rows", stop - lo)
+                self._span_ring.record(
+                    st.get("trace"), "inject", t_inj,
+                    time.perf_counter(), rid=st["rid"], start=lo,
+                    stop=stop)
         # frontier advance: the row a decode ride wrote at the old pos
         # was just rewritten bit-identically by this inject
         st["pos"] = max(st["pos"], stop)
@@ -1997,12 +2027,13 @@ class DecodeServer:
         if self._tel:
             now = time.perf_counter()
             st["t_first"] = st["t_last"] = now
-            _telemetry.observe("serving.ttft_ms",
-                               (now - st["t_submit"]) * 1e3)
+            self._observe("serving.ttft_ms",
+                          (now - st["t_submit"]) * 1e3)
             _telemetry.event("serving.prefill",
                              st.get("t_admit", now), now, tid=slot,
                              rid=rid, prompt_len=n)
             _telemetry.count("serving.tokens_generated")
+            self._count_local("serving.tokens_generated")
         fin = self._constraint_push(st, t)
         if self._finished(st, t) or fin:
             # single-token budgets finish on the admission token
@@ -2297,6 +2328,7 @@ class DecodeServer:
         return (telemetry name, the worker's admission logits)."""
         rows, logits = req["prefilled"]
         n = len(req["prompt"])
+        t_inj = time.perf_counter()
         bucket = _pow2_bucket(n, self.max_len, self.cfg.max_seq_len)
         padded = {}
         for name, v in rows.items():
@@ -2333,6 +2365,9 @@ class DecodeServer:
             self._pool.register_prefix(slot, req["prompt"])
         if self._tel:
             _telemetry.count("serving.prefilled_rows", n - shared)
+            self._span_ring.record(
+                req.get("trace"), "inject", t_inj, time.perf_counter(),
+                rid=req["rid"], rows=n - shared)
         return f"inject@{bucket}", logits
 
     def pending(self) -> bool:
@@ -3342,12 +3377,17 @@ class DecodeServer:
         telemetry wedge state folds every server's verdict)."""
         return self._wedged
 
-    def load_stats(self) -> dict:
+    def load_stats(self, include_spans: bool = False) -> dict:
         """The router's load-balancing inputs, read from the scheduler's
         host state — the SAME quantities the telemetry gauges sample
         (queue depth, active slots, slot occupancy, kv utilization),
         returned per server because the registry gauges are
-        process-global and a fleet co-hosts many replicas."""
+        process-global and a fleet co-hosts many replicas.
+
+        ``include_spans=True`` additionally drains this server's
+        completed trace spans (DESTRUCTIVE, piggyback-capped) into
+        ``spans``/``span_drops`` — the fleet router's collection ride;
+        anything else polling load should leave it off."""
         act = len(self._slots)
         if self._paged:
             kv = self._pool.blocks_in_use / max(1, self._pool.N)
@@ -3431,7 +3471,47 @@ class DecodeServer:
             **(dict(zip(("moe_dropped_tokens", "moe_expert_load"),
                         self._moe_snapshot()))
                if self._moe_stats is not None else {}),
+            # fleet tracing: spans ride the stats collection when asked
+            **(dict(zip(("spans", "span_drops"), self.drain_spans()))
+               if include_spans else {}),
         }
+
+    def drain_spans(self):
+        """Destructively take this server's completed trace spans (the
+        piggyback cap bounds one take) plus the drop count since the
+        last take — what ``load_stats(include_spans=True)`` rides; the
+        fleet router calls it directly each collection round."""
+        return self._span_ring.drain(_flags.trace_piggyback_cap())
+
+    def local_snapshot(self) -> dict:
+        """This SERVER's latency distributions as JSON-safe
+        :meth:`telemetry.Histogram.state` dicts keyed by histogram name
+        — the fleet metrics plane's merge inputs.  Distinct from the
+        process-global ``telemetry.snapshot()``: loopback fleets co-host
+        replicas, so per-replica distributions need per-server buckets.
+        ``counters`` carries the per-server token/request totals the
+        fleet rollups aggregate."""
+        return {
+            "histograms": {name: h.state()
+                           for name, h in sorted(
+                               self._hist_local.items())},
+            "counters": dict(sorted(self._counts_local.items())),
+        }
+
+    def _observe(self, name: str, v: float, n: int = 1) -> None:
+        """Observe into the process-global histogram AND this server's
+        local twin (see :meth:`local_snapshot`).  Call sites already
+        gate on ``self._tel``."""
+        _telemetry.observe(name, v, n)
+        h = self._hist_local.get(name)
+        if h is None:
+            h = self._hist_local[name] = _telemetry.Histogram(name)
+        h.observe(v, n)
+
+    def _count_local(self, name: str, n: int = 1) -> None:
+        """Per-server counter twin of ``telemetry.count`` (same
+        loopback-fleet rationale as :meth:`_observe`)."""
+        self._counts_local[name] = self._counts_local.get(name, 0) + n
 
     def drain_queue(self, rids=None) -> list:
         """Remove and return QUEUED request dicts (the fleet router's
@@ -3687,11 +3767,22 @@ class DecodeServer:
             return
         now = time.perf_counter()
         t_sub = st.get("t_submit", now)
-        _telemetry.observe("serving.e2e_ms", (now - t_sub) * 1e3)
+        self._observe("serving.e2e_ms", (now - t_sub) * 1e3)
         _telemetry.count("serving.requests_completed")
+        self._count_local("serving.requests_completed")
         _telemetry.event("serving.request", t_sub, now, tid=slot,
                          rid=st["rid"], prompt_len=len(st["prompt"]),
                          tokens=len(st["generated"]))
+        tr = st.get("trace")
+        if tr:
+            # the request's lifecycle on its trace: one decode span
+            # (first token → retire) plus a zero-width retire marker
+            self._span_ring.record(
+                tr, "decode", st.get("t_first", t_sub), now,
+                rid=st["rid"], tokens=len(st["generated"]))
+            self._span_ring.record(
+                tr, "retire", now, now, rid=st["rid"],
+                tokens=len(st["generated"]))
 
     def _tel_tokens(self, appended, t0, steps: int = 1, kind=None):
         """Per-tick records from the host bookkeeping that JUST ran on
@@ -3709,7 +3800,7 @@ class DecodeServer:
             return
         now = time.perf_counter()
         dt_ms = (now - t0) * 1e3
-        _telemetry.observe("serving.tick_ms", dt_ms)
+        self._observe("serving.tick_ms", dt_ms)
         if kind is not None:
             _telemetry.note_step_time(f"serving.{kind}", dt_ms / 1e3)
         if appended:
@@ -3720,27 +3811,35 @@ class DecodeServer:
             # resets to None on idle returns so a quiet queue doesn't
             # masquerade as a stall.
             if self._gap_anchor is not None:
-                _telemetry.observe("serving.decode_gap_ms",
-                                   (now - self._gap_anchor) * 1e3)
+                self._observe("serving.decode_gap_ms",
+                              (now - self._gap_anchor) * 1e3)
             self._gap_anchor = now
         if not appended:
             return
         total = 0
         per_tok = dt_ms / max(steps, 1)
+        spec = kind is not None and "spec" in kind
         for st, n in appended:
             total += n
             if "t_first" not in st:
                 st["t_first"] = now
-                _telemetry.observe(
+                self._observe(
                     "serving.ttft_ms",
                     (now - st.get("t_submit", t0)) * 1e3)
                 if n > 1:
-                    _telemetry.observe("serving.tpot_ms", per_tok,
-                                       n=n - 1)
+                    self._observe("serving.tpot_ms", per_tok,
+                                  n=n - 1)
             else:
-                _telemetry.observe("serving.tpot_ms", per_tok, n=n)
+                self._observe("serving.tpot_ms", per_tok, n=n)
             st["t_last"] = now
+            if spec and st.get("trace"):
+                # one span per traced slot per speculative round:
+                # the tick wall bounds every slot's draft+verify work
+                self._span_ring.record(
+                    st["trace"], "spec_round", t0, now,
+                    rid=st["rid"], accepted=n)
         _telemetry.count("serving.tokens_generated", total)
+        self._count_local("serving.tokens_generated", total)
 
     # -- resilience: guarded ticks, the OOM chain, wedge recovery -----------
 

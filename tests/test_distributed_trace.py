@@ -1,0 +1,231 @@
+"""Distributed-tracing / fleet observability plane (round 20),
+host-pure half: the exact
+log-bucket ``Histogram.merge`` the fleet rollups ride, span-ring loss
+accounting, the TRACE lint family, the multi-log ``merge_timeline``
+span merge, and ``fleet_top.render``.  No model, no jit — these run in
+well under a second.  The fleet-drive half (the loopback acceptance
+waterfall, ``TELEMETRY=0`` bit-parity, tracing-on parity across
+layouts/dispatch, the ``SocketTransport`` piggyback) lives in
+``tests/test_tracing.py``.
+"""
+import importlib.util
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import telemetry as tl
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", name + ".py"))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    return m
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    tl.reset()
+    yield
+
+
+# ---------------------------------------------------------------------------
+# Histogram.merge: exact bucket addition
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_merge_quantile_consistency():
+    """Merged p99 == p99 of the CONCATENATED samples — exactly at the
+    bucket level (shared fixed ladder), and within one bucket width of
+    the true sample quantile.  Never an average of quantiles."""
+    rng = np.random.default_rng(11)
+    a = rng.lognormal(1.0, 1.0, 4000)
+    b = rng.lognormal(3.0, 0.3, 1000)
+    h1, h2 = tl.Histogram("m.a"), tl.Histogram("m.b")
+    for v in a:
+        h1.observe(float(v))
+    for v in b:
+        h2.observe(float(v))
+    merged = tl.Histogram("m.merged").merge(h1).merge(h2)
+    conc = tl.Histogram("m.conc")
+    for v in np.concatenate([a, b]):
+        conc.observe(float(v))
+    for q in (0.5, 0.9, 0.99):
+        assert merged.quantile(q) == conc.quantile(q)
+    # one log-bucket width of the exact sample quantile
+    exact = float(np.quantile(np.concatenate([a, b]), 0.99))
+    width = 10.0 ** (1 / 20.0)
+    assert exact / width <= merged.quantile(0.99) <= exact * width
+    s = merged.summary()
+    assert s["count"] == 5000
+    assert s["sum"] == pytest.approx(h1.summary()["sum"]
+                                     + h2.summary()["sum"])
+
+
+def test_histogram_merge_accepts_state_dicts_and_rejects_drift():
+    h = tl.Histogram("m.h")
+    h.observe(3.0)
+    st = h.state()
+    assert st["count"] == 1 and sum(st["counts"]) == 1
+    h2 = tl.Histogram("m.h2").merge(st)          # wire form (JSON-safe)
+    assert h2.summary()["count"] == 1
+    assert h2.quantile(0.5) == h.quantile(0.5)
+    with pytest.raises(ValueError):
+        h2.merge({"counts": [0, 1], "count": 1, "sum": 3.0,
+                  "min": 3.0, "max": 3.0})       # foreign ladder
+
+
+# ---------------------------------------------------------------------------
+# span ring: bounded, drop-counted collection
+# ---------------------------------------------------------------------------
+
+
+def test_span_ring_loss_accounting():
+    """A full ring drops NEW spans and counts every loss; drain hands
+    back the count exactly once."""
+    ring = tl.SpanRing(cap=2)
+    trace = tl.mint_trace()
+    assert trace is not None and "trace_id" in trace
+    t = time.perf_counter()
+    for i in range(5):
+        ring.record(trace, f"s{i}", t, t + 0.001, rid=i)
+    assert len(ring) == 2 and ring.dropped == 3
+    spans, dropped = ring.drain()
+    assert [s["name"] for s in spans] == ["s0", "s1"]
+    assert dropped == 3
+    assert len(ring) == 0 and ring.dropped == 0   # counter handed off
+    # no trace context, no record — the off-path is free
+    ring.record(None, "ghost", t, t + 1.0)
+    assert len(ring) == 0
+
+
+def test_mint_trace_none_when_disabled(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_TELEMETRY", "0")
+    assert tl.mint_trace() is None
+    ring = tl.SpanRing(cap=4)
+    ring.record({"trace_id": "x"}, "s", 0.0, 1.0)
+    assert len(ring) == 0                          # enabled() gate
+
+
+def test_mint_trace_none_when_trace_plane_off(monkeypatch):
+    """``PADDLE_TPU_TRACE=0``: the tracing plane alone turns off while
+    the metrics plane keeps running (the bench overhead arm's knob)."""
+    monkeypatch.setenv("PADDLE_TPU_TRACE", "0")
+    assert tl.mint_trace() is None
+    assert tl.enabled()                            # metrics still on
+
+
+# ---------------------------------------------------------------------------
+# TRACE lint family (tools/check_instrumented.py)
+# ---------------------------------------------------------------------------
+
+
+def test_trace_lint_fixture_and_repo_clean():
+    ci = _tool("check_instrumented")
+    bad = ("def _handoff_prefill(self, rid, rec):\n"
+           "    self.endpoint.send({'rid': rid})\n")
+    vs = ci.scan_trace_source(bad, "f.py")
+    assert len(vs) == 1 and "trace" in vs[0][2]
+    good = ("def _handoff_prefill(self, rid, rec):\n"
+            "    job = {'rid': rid}\n"
+            "    tr = rec['req'].get('trace')\n"
+            "    if tr is not None:\n"
+            "        job['trace'] = tr\n"
+            "    self.endpoint.send(job)\n")
+    assert ci.scan_trace_source(good, "f.py") == []
+    dropped = ("def _migrate_chains(self, req):\n"
+               "    req.pop('trace', None)  # spans end at migration\n"
+               "    self._move(req)\n")
+    assert ci.scan_trace_source(dropped, "f.py") == []   # explicit drop
+    delegated = ("def adopt_and_reroute(self, rid):\n"
+                 "    self._handoff_prefill(rid, self._requests[rid])\n")
+    assert ci.scan_trace_source(delegated, "f.py") == []
+    # unrelated functions never match
+    assert ci.scan_trace_source("def tick(self):\n    pass\n",
+                                "f.py") == []
+    # the shipped fleet.py passes
+    with open(os.path.join(REPO, "paddle_tpu", "text",
+                           "fleet.py")) as f:
+        assert ci.scan_trace_source(f.read(), "fleet.py") == []
+
+
+# ---------------------------------------------------------------------------
+# merge_timeline: multi-log span merge on the wall clock
+# ---------------------------------------------------------------------------
+
+
+def test_merge_timeline_multi_log_spans(tmp_path):
+    """Two span JSONL logs (think: two replicas' telemetry logs) merge
+    into one multi-track file with BOTH files' spans rebased on the
+    shared wall clock — cross-file deltas preserved exactly."""
+    mt = _tool("merge_timeline")
+    wall = 1.7e9
+    a = tmp_path / "replica0.jsonl"
+    b = tmp_path / "replica1.jsonl"
+    a.write_text(json.dumps(
+        {"ph": "S", "trace_id": "t-1", "name": "decode",
+         "ts": wall + 1.0, "dur": 0.5, "args": {"rid": 4}}) + "\n"
+        + json.dumps(                         # perf-clock event beside
+        {"name": "hbm", "ph": "C", "t": 10.0,
+         "args": {"bytes": 1}}) + "\n")
+    b.write_text(json.dumps(
+        {"ph": "S", "trace_id": "t-1", "name": "prefill_chunk[0]",
+         "ts": wall + 0.25, "dur": 0.1}) + "\n")
+    out = tmp_path / "merged.json"
+    doc = mt.merge([str(a), str(b)])
+    json.dump(doc, open(out, "w"))
+    evs = doc["traceEvents"]
+    assert {e["pid"] for e in evs} == {0, 1}
+    spans = [e for e in evs if e.get("ph") == "X"]
+    assert len(spans) == 2
+    by_name = {e["name"]: e for e in spans}
+    # earliest wall span sits at t=0; the other 0.75 s later — the
+    # cross-file delta survives the rebase
+    assert by_name["prefill_chunk[0]"]["ts"] == pytest.approx(0.0)
+    assert by_name["decode"]["ts"] == pytest.approx(0.75e6)
+    assert by_name["decode"]["args"]["trace_id"] == "t-1"
+    # file A's perf counter was pinned to file A's earliest span
+    cs = [e for e in evs if e.get("ph") == "C"]
+    assert len(cs) == 1
+    assert cs[0]["ts"] == pytest.approx(by_name["decode"]["ts"])
+    # a file with NO span records is passed through untouched
+    c = tmp_path / "plain.jsonl"
+    c.write_text(json.dumps(
+        {"name": "step", "t0": 2.0, "t1": 3.0, "tid": 1}) + "\n")
+    doc2 = mt.merge([str(c)])
+    ev = [e for e in doc2["traceEvents"] if e.get("ph") == "X"][0]
+    assert ev["ts"] == pytest.approx(2.0e6)
+
+
+def test_fleet_top_render_pure():
+    ft = _tool("fleet_top")
+    snap = {
+        "fleet": {"replicas": 2, "healthy_replicas": 1,
+                  "queue_depth": 3, "prefill_outstanding": 1,
+                  "uptime_s": 12.5, "tokens_generated": 640,
+                  "tok_s": 51.2, "requests_completed": 9,
+                  "ttft_p99_ms": 21.0, "tpot_p99_ms": 3.5},
+        "replicas": {"0": {"healthy": True, "histograms": {},
+                           "summaries": {
+                               "serving.ttft_ms": {"count": 5,
+                                                   "p99": 21.0}},
+                           "counters": {
+                               "serving.tokens_generated": 400},
+                           "load": {"queue_depth": 1,
+                                    "active_slots": 2}},
+                     "1": {"healthy": False, "histograms": {},
+                           "counters": {}, "load": {}}},
+        "trace": {"router": {"spans": 12, "dropped": 0}},
+    }
+    frame = ft.render(snap)
+    assert "2 replicas (1 healthy)" in frame
+    assert "51.2 tok/s" in frame
+    assert "21.0" in frame                        # pre-digested p99
+    assert "NO" in frame                          # unhealthy replica
+    assert "router: 12 spans (0 dropped)" in frame

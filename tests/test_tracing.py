@@ -1,0 +1,270 @@
+"""Fleet observability plane (round 20), fleet-drive half.
+
+The acceptance drill: a mixed workload through a 1-router / 2-replica /
+1-worker loopback fleet yields ONE ``dump_fleet_trace()`` Perfetto file
+where every retired request's spans share a single trace_id across >= 3
+process tracks, and the Router's merged Prometheus exposition reports a
+fleet TTFT p99 EQUAL to the histogram-merge of the replicas' local
+snapshots (the fixed-bucket ladder makes merges lossless).  Around it:
+``TELEMETRY=0`` no-op parity, greedy bit-parity with tracing ON across
+{contiguous, paged} x {tick, async}, and the cross-process piggyback
+over ``SocketTransport`` (capability-gated).  The host-pure half
+(``Histogram.merge``, span-ring accounting, the TRACE lint,
+``merge_timeline``, ``fleet_top.render``) lives in
+``tests/test_distributed_trace.py``.
+"""
+import importlib.util
+import json
+import os
+import socket
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu import faults
+from paddle_tpu import telemetry as tl
+from paddle_tpu.text import fleet, generate, gpt, serving
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", name + ".py"))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    return m
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.reset()
+    tl.reset()
+    tl.clear_runtime_wedge()
+    yield
+    faults.reset()
+    tl.clear_runtime_wedge()
+
+
+def _cfg(**over):
+    kw = dict(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+              max_seq_len=64)
+    kw.update(over)
+    return gpt.GPTConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def cfg_params():
+    cfg = _cfg()
+    return cfg, gpt.init_params(cfg, jax.random.PRNGKey(0))
+
+
+def _prompts(n_short=3, long_len=20, seed=7):
+    rng = np.random.default_rng(seed)
+    lens = [int(x) for x in rng.integers(3, 8, n_short)] + [long_len]
+    return [[int(x) for x in rng.integers(1, 60, n)] for n in lens]
+
+
+def _single(params, cfg, prompts, max_new=6, max_len=48, **kw):
+    srv = serving.DecodeServer(params, cfg, max_batch=len(prompts),
+                               max_len=max_len, **kw)
+    rids = [srv.submit(p, max_new_tokens=max_new) for p in prompts]
+    while srv.pending():
+        srv.tick()
+    out = [srv.result(r) for r in rids]
+    srv.close()
+    return out
+
+
+def _drive(router, prompts, max_new=6, timeout_s=120.0):
+    rids = [router.submit(p, max_new_tokens=max_new) for p in prompts]
+    deadline = time.time() + timeout_s
+    while router.pending() and time.time() < deadline:
+        router.tick()
+        if not any(r._slots or r._queue for r in router.replicas):
+            time.sleep(0.002)
+    assert not router.pending(), "fleet never drained"
+    return [router.result(r) for r in rids]
+
+
+def _localhost_sockets_ok() -> bool:
+    try:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        s.close()
+        return True
+    except OSError:
+        return False
+
+
+requires_sockets = pytest.mark.skipif(
+    not _localhost_sockets_ok(),
+    reason="sandbox has no localhost sockets")
+
+
+@pytest.fixture()
+def fleet_env(monkeypatch):
+    def set_(**kw):
+        for k, v in kw.items():
+            if v is None:
+                monkeypatch.delenv(k, raising=False)
+            else:
+                monkeypatch.setenv(k, v)
+        generate._GEN_CACHE.clear()
+        serving._STEP_CACHE.clear()
+    yield set_
+    generate._GEN_CACHE.clear()
+    serving._STEP_CACHE.clear()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance drill: one waterfall across the loopback fleet
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_trace_acceptance(cfg_params, tmp_path):
+    """Mixed workload, 1 router / 2 replicas / 1 worker, every request
+    handed off: ONE Perfetto file where each retired request's spans
+    share a single trace_id across >= 3 process tracks, and the merged
+    Prometheus TTFT p99 equals the histogram-merge of the replicas'
+    local snapshots."""
+    cfg, params = cfg_params
+    prompts = _prompts(seed=23)
+    worker = fleet.PrefillWorker(params, cfg, max_len=48)
+    router = fleet.Router(
+        [serving.DecodeServer(params, cfg, max_batch=2, max_len=48)
+         for _ in range(2)],
+        prefill=[worker], prefill_threshold=2)   # all requests hand off
+    got = _drive(router, prompts)
+    assert all(got)
+    path = router.dump_fleet_trace(str(tmp_path / "fleet.json"))
+    doc = json.loads(open(path).read())
+    evs = doc["traceEvents"]
+    tracks = {e["pid"]: e["args"]["name"] for e in evs
+              if e.get("ph") == "M" and e.get("name") == "process_name"}
+    assert any(n.endswith("router") for n in tracks.values())
+    spans = [e for e in evs
+             if e.get("ph") == "X" and "trace_id" in e.get("args", {})]
+    by_tid = {}
+    for e in spans:
+        g = by_tid.setdefault(e["args"]["trace_id"],
+                              {"pids": set(), "names": set()})
+        g["pids"].add(e["pid"])
+        g["names"].add(e["name"])
+    assert len(by_tid) == len(prompts)           # one trace per request
+    for tid, g in by_tid.items():
+        assert len(g["pids"]) >= 3, (tid, g)     # router+worker+replica
+        assert {"queue_wait", "route", "inject",
+                "decode", "retire"} <= g["names"], (tid, g)
+        assert any(n.startswith("prefill_chunk[") for n in g["names"])
+    # fleet p99 == histogram-merge of the replicas' local snapshots
+    expect = tl.Histogram("expect.ttft")
+    for r in router.replicas:
+        st = r.local_snapshot()["histograms"].get("serving.ttft_ms")
+        if st is not None:
+            expect.merge(st)
+    prom = router.render_fleet_prometheus()
+    line = [ln for ln in prom.splitlines()
+            if ln.startswith("paddle_tpu_fleet_ttft_p99_ms ")]
+    assert len(line) == 1
+    assert float(line[0].split()[1]) == pytest.approx(
+        expect.quantile(0.99), rel=1e-9)
+    assert 'replica="0"' in prom and 'replica="1"' in prom
+    # fleet_top renders the same snapshot (pure function, no server)
+    ft = _tool("fleet_top")
+    frame = ft.render(router.fleet_snapshot())
+    assert "replicas" in frame and "ttft p99" in frame
+    assert "trace" in frame
+    router.close()
+    worker.close()
+
+
+def test_fleet_trace_telemetry_off_noop(fleet_env, cfg_params):
+    """``PADDLE_TPU_TELEMETRY=0``: no trace context is minted or
+    attached anywhere on the fleet path, no spans are collected — and
+    the tokens are bit-identical (the key is ABSENT, not empty)."""
+    cfg, params = cfg_params
+    prompts = _prompts(seed=29)
+    ref = _single(params, cfg, prompts)
+    fleet_env(PADDLE_TPU_TELEMETRY="0")
+    worker = fleet.PrefillWorker(params, cfg, max_len=48)
+    router = fleet.Router(
+        [serving.DecodeServer(params, cfg, max_batch=2, max_len=48)
+         for _ in range(2)],
+        prefill=[worker], prefill_threshold=2)
+    rids = [router.submit(p, max_new_tokens=6) for p in prompts]
+    assert all("trace" not in router._requests[r]["req"] for r in rids)
+    deadline = time.time() + 120
+    while router.pending() and time.time() < deadline:
+        router.tick()
+        if not any(r._slots or r._queue for r in router.replicas):
+            time.sleep(0.002)
+    got = [router.result(r) for r in rids]
+    assert got == ref
+    assert router.fleet_trace() == {}            # nothing collected
+    router.close()
+    worker.close()
+
+
+@pytest.mark.parametrize("layout", ["contiguous", "paged"])
+@pytest.mark.parametrize("dispatch", ["tick", "async"])
+def test_fleet_bit_parity_with_tracing_on(cfg_params, layout, dispatch):
+    """PR-4 discipline re-pinned: tracing records NOTHING on device and
+    never changes a token — greedy bit-parity vs the single server in
+    every layout x dispatch combination, spans flowing the whole time."""
+    cfg, params = cfg_params
+    kw = ({} if layout == "contiguous"
+          else {"layout": "paged", "block_size": 8})
+    if dispatch == "async":
+        kw["async_dispatch"] = True
+    prompts = _prompts(seed=31)
+    ref = _single(params, cfg, prompts, **kw)
+    tl.reset()
+    worker = fleet.PrefillWorker(
+        params, cfg, max_len=48,
+        **({"layout": "paged", "block_size": 8}
+           if layout == "paged" else {}))
+    router = fleet.Router(
+        [serving.DecodeServer(params, cfg, max_batch=2, max_len=48, **kw)
+         for _ in range(2)],
+        prefill=[worker], prefill_threshold=2)
+    got = _drive(router, prompts)
+    tracks = router.fleet_trace()
+    router.close()
+    worker.close()
+    assert got == ref
+    names = {s["name"] for spans in tracks.values() for s in spans}
+    assert {"queue_wait", "route", "decode", "retire"} <= names
+
+
+@requires_sockets
+def test_cross_process_trace_over_sockets(cfg_params):
+    """The deployment shape: worker served over TCP — its spans ride
+    the raw-row codec back piggybacked on replies, and land in the
+    router's ``worker-0`` track stitched to the same trace_ids the
+    replicas retire (wall-clock stamps survive the wire)."""
+    cfg, params = cfg_params
+    prompts = _prompts(seed=37)
+    worker = fleet.PrefillWorker(params, cfg, max_len=48)
+    listener = fleet.serve_prefill_worker(worker)
+    ep = fleet.SocketTransport.connect("127.0.0.1", listener.port)
+    router = fleet.Router(
+        [serving.DecodeServer(params, cfg, max_batch=2, max_len=48)
+         for _ in range(2)],
+        prefill=[ep], prefill_threshold=2)
+    got = _drive(router, prompts)
+    assert all(got)
+    tracks = router.fleet_trace()
+    router.close()
+    worker.close()
+    listener.close()
+    wtids = {s["trace_id"] for s in tracks.get("worker-0", [])}
+    assert wtids, "no worker spans crossed the socket"
+    rtids = {s["trace_id"] for nm, spans in tracks.items()
+             if nm.startswith("replica-") for s in spans}
+    assert wtids <= rtids                         # stitched end to end
+    for s in tracks["worker-0"]:
+        assert s["ts"] > 1e9                      # wall-clock stamped

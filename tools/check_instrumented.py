@@ -93,6 +93,15 @@ KV_MARKERS = ("alloc", "evict", "cow", "free")
 FLEET_FILE = os.path.join("paddle_tpu", "text", "fleet.py")
 FLEET_MARKERS = ("route", "shed", "drain", "handoff")
 
+# TRACE lint (round 20, same rule family): every request-movement path
+# in text/fleet.py — prefill handoffs, chain migration, reroute drains,
+# request adoption — must PROPAGATE the request's trace context (or
+# explicitly drop it: ``req.pop("trace", ...)`` also mentions it).  A
+# hop that silently loses the trace_id truncates the fleet waterfall
+# mid-request, and the gap is invisible until someone needs the trace.
+TRACE_FILE = os.path.join("paddle_tpu", "text", "fleet.py")
+TRACE_MARKERS = ("handoff", "migrate", "adopt", "reroute", "drain")
+
 # Speculative-decoding lint (round 11, same rule family): every spec
 # accept/propose/fallback path in text/serving.py must count a spec.*
 # telemetry counter (directly, or by delegating to another marker-named
@@ -338,6 +347,49 @@ def scan_fleet_source(src: str, filename: str = "<src>") -> list:
                  f"fleet scheduling site {node.name}() records no "
                  f"telemetry counter (count) — silent re-routes/sheds "
                  f"read as healthy while requests vanish"))
+    return violations
+
+
+def _mentions_trace(node) -> bool:
+    """Whether any descendant touches trace context: a name/attribute
+    containing ``trace`` (``req["trace"]`` reads land here via the
+    ``"trace"`` string constant; ``mint_trace``/``_route_spans`` calls
+    via the name), or a ``trace=`` keyword on any call."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str) \
+                and "trace" in n.value:
+            return True
+        if isinstance(n, ast.Name) and "trace" in n.id:
+            return True
+        if isinstance(n, ast.Attribute) and "trace" in n.attr:
+            return True
+        if isinstance(n, ast.keyword) and n.arg and "trace" in n.arg:
+            return True
+    return False
+
+
+def scan_trace_source(src: str, filename: str = "<src>") -> list:
+    """TRACE lint violations in one source string: a function whose name
+    carries a :data:`TRACE_MARKERS` marker (a path that moves a request
+    between processes/replicas) must propagate or explicitly drop trace
+    context — i.e. mention it per :func:`_mentions_trace` — or delegate
+    to another marker-named callable that does."""
+    tree = ast.parse(src, filename=filename)
+    violations = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and any(m in node.name for m in TRACE_MARKERS)):
+            continue
+        passed = _mentions_trace(node) or any(
+            isinstance(n, ast.Call)
+            and any(m in (_call_name(n) or "") for m in TRACE_MARKERS)
+            for n in ast.walk(node))
+        if not passed:
+            violations.append(
+                (filename, node.lineno,
+                 f"request-movement site {node.name}() neither "
+                 f"propagates nor explicitly drops trace context — the "
+                 f"fleet waterfall silently truncates at this hop"))
     return violations
 
 
@@ -718,6 +770,12 @@ def scan_repo(root: str | None = None) -> list:
         with open(fleet_path, encoding="utf-8") as f:
             violations.extend(scan_fleet_source(
                 f.read(), os.path.relpath(fleet_path, root)))
+    # TRACE lint: trace-context propagation through request movement
+    trace_path = os.path.join(root, TRACE_FILE)
+    if os.path.exists(trace_path):
+        with open(trace_path, encoding="utf-8") as f:
+            violations.extend(scan_trace_source(
+                f.read(), os.path.relpath(trace_path, root)))
     # prefix-cache lint: radix split / spill / restore / affinity
     # observability
     for rel in PREFIX_FILES:

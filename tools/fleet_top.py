@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Live terminal view of a Router's aggregated fleet metrics.
+
+Reference capability: the framework's ``monitor`` module — but pointed
+at the FLEET plane: the Router's metrics endpoint (started via
+``PADDLE_TPU_FLEET_METRICS_PORT`` or ``Router(metrics_port=...)``)
+serves ``/snapshot`` with per-replica histogram states, counters and
+live load beside fleet rollups merged by exact log-bucket histogram
+addition.  This tool polls that endpoint and redraws one screen:
+
+    fleet   2 replicas (2 healthy)  queue 3   1843.2 tok/s
+            ttft p99 12.4 ms   tpot p99 3.1 ms   requests 512
+    replica  healthy  queue  slots  tok/s(ttft p99/tpot p99)
+    0        yes      1      4/8    ...
+    trace    router: 120 spans (0 dropped) ...
+
+Usage:
+    python tools/fleet_top.py --port 9100 [--interval 2] [--once]
+    python tools/fleet_top.py --url http://host:9100/snapshot --once
+
+``--once`` prints a single frame and exits (CI-friendly); otherwise the
+screen refreshes every ``--interval`` seconds until Ctrl-C.  ``render``
+is a pure snapshot-dict -> str function, so tests need no server.
+"""
+import argparse
+import json
+import sys
+import time
+import urllib.request
+
+
+def fetch(url: str, timeout: float = 5.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def _hist_p99(rep: dict, name: str) -> str:
+    # the endpoint pre-digests each replica's histogram states into
+    # summaries, so this tool needs no framework import at all
+    s = rep.get("summaries", {}).get(name)
+    if not s or not s.get("count"):
+        return "-"
+    return f'{s["p99"]:.1f}'
+
+
+def render(snap: dict) -> str:
+    """One screen of fleet state from a ``/snapshot`` dict (pure)."""
+    fl = snap.get("fleet", {})
+    lines = [
+        "paddle_tpu fleet_top",
+        (f'fleet    {fl.get("replicas", 0)} replicas '
+         f'({fl.get("healthy_replicas", 0)} healthy)   '
+         f'queue {fl.get("queue_depth", 0)}   '
+         f'prefilling {fl.get("prefill_outstanding", 0)}   '
+         f'{fl.get("tok_s", 0.0)} tok/s'),
+        (f'         ttft p99 {fl.get("ttft_p99_ms", 0.0)} ms   '
+         f'tpot p99 {fl.get("tpot_p99_ms", 0.0)} ms   '
+         f'tokens {fl.get("tokens_generated", 0)}   '
+         f'requests {fl.get("requests_completed", 0)}   '
+         f'up {fl.get("uptime_s", 0.0)}s'),
+        "",
+        "replica  healthy  queue  active  ttft_p99  tpot_p99  tokens",
+    ]
+    for i in sorted(snap.get("replicas", {}), key=int):
+        rep = snap["replicas"][i]
+        load = rep.get("load", {})
+        toks = rep.get("counters", {}).get("serving.tokens_generated", 0)
+        lines.append(
+            f'{i:<8} {"yes" if rep.get("healthy", True) else "NO":<8} '
+            f'{load.get("queue_depth", 0):<6} '
+            f'{load.get("active_slots", 0):<7} '
+            f'{_hist_p99(rep, "serving.ttft_ms"):<9} '
+            f'{_hist_p99(rep, "serving.tpot_ms"):<9} {toks}')
+    tr = snap.get("trace", {})
+    if tr:
+        lines.append("")
+        parts = [f'{nm}: {t.get("spans", 0)} spans '
+                 f'({t.get("dropped", 0)} dropped)'
+                 for nm, t in sorted(tr.items())]
+        lines.append("trace    " + "   ".join(parts))
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", default=None,
+                    help="full /snapshot URL (overrides --port)")
+    ap.add_argument("--port", type=int, default=None,
+                    help="router metrics port on localhost")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit")
+    args = ap.parse_args(argv)
+    if args.url is None:
+        if args.port is None:
+            ap.error("need --url or --port")
+        args.url = f"http://{args.host}:{args.port}/snapshot"
+    while True:
+        try:
+            frame = render(fetch(args.url))
+        except Exception as e:  # endpoint down mid-scale — keep polling
+            frame = f"fleet_top: {args.url} unreachable: {e}\n"
+        if args.once:
+            sys.stdout.write(frame)
+            return 0
+        sys.stdout.write("\x1b[2J\x1b[H" + frame)
+        sys.stdout.flush()
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

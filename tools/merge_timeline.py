@@ -10,6 +10,11 @@ preserving per-input thread rows.  Inputs may be:
   chrome format) — ``.json`` with a ``traceEvents`` list;
 - telemetry JSONL event logs (``PADDLE_TPU_TELEMETRY_LOG``) — one span
   per line, converted to chrome 'X' events (tid = the span's slot/tid).
+  Fleet trace spans (``ph: "S"`` records written by the span ring) are
+  wall-clock stamped; when several replica/worker logs are merged their
+  spans are rebased against the earliest wall timestamp across ALL
+  inputs, so one request's spans line up across process rows, and each
+  file's perf-clock events are best-effort pinned to its earliest span.
 
 The merged file loads in Perfetto (ui.perfetto.dev) / chrome://tracing:
 one timeline with serving request lifecycles next to profiler host spans
@@ -38,6 +43,21 @@ def _jsonl_events(path):
                 rec = json.loads(line)
             except json.JSONDecodeError:
                 continue  # truncated tail of a killed writer — skip
+            if rec.get("ph") == "S" and "ts" in rec:
+                # completed fleet span (span-ring JSONL record) —
+                # wall-clock stamped so logs from different processes
+                # share one timeline; merge() rebases these
+                args = dict(rec.get("args") or {})
+                if "trace_id" in rec:
+                    args["trace_id"] = rec["trace_id"]
+                if "parent" in rec:
+                    args["parent"] = rec["parent"]
+                out.append({"name": rec.get("name", "?"), "ph": "X",
+                            "tid": args.get("rid", 0),
+                            "ts": rec["ts"] * 1e6,
+                            "dur": rec.get("dur", 0.0) * 1e6,
+                            "args": args, "_wall": True})
+                continue
             if rec.get("ph") == "C" and "t" in rec:
                 # telemetry counter sample (HBM gauges) -> a Perfetto
                 # counter track beside the spans
@@ -74,7 +94,8 @@ def _is_jsonl(path):
     except json.JSONDecodeError:
         return False
     return ("t0" in rec and "t1" in rec) or \
-        (rec.get("ph") == "C" and "t" in rec)
+        (rec.get("ph") == "C" and "t" in rec) or \
+        (rec.get("ph") == "S" and "ts" in rec)
 
 
 def load_events(path):
@@ -88,11 +109,32 @@ def load_events(path):
 
 
 def merge(paths):
+    loads = [load_events(p) for p in paths]
+    # Fleet spans are wall-clock stamped: rebase every wall timestamp
+    # against the earliest one across ALL inputs so replica/worker logs
+    # line up on one timeline instead of sitting at epoch offsets.
+    walls = [min((e["ts"] for e in evs if e.get("_wall")), default=None)
+             for evs in loads]
+    wall0 = min((w for w in walls if w is not None), default=0.0)
     events = []
-    for hi, path in enumerate(paths):
+    for hi, (path, evs) in enumerate(zip(paths, loads)):
         events.append({"name": "process_name", "ph": "M", "pid": hi,
                        "args": {"name": f"host{hi}:{path}"}})
-        for e in load_events(path):
+        shift_perf = 0.0
+        if walls[hi] is not None:
+            # best effort: pin this file's earliest perf-clock event to
+            # its earliest wall-clock span (the two clocks started in
+            # the same process, but the log alone carries no offset)
+            perf0 = min((e["ts"] for e in evs
+                         if not e.get("_wall") and "ts" in e),
+                        default=None)
+            if perf0 is not None:
+                shift_perf = (walls[hi] - wall0) - perf0
+        for e in evs:
+            if e.pop("_wall", False):
+                e["ts"] -= wall0
+            elif walls[hi] is not None and "ts" in e:
+                e["ts"] += shift_perf
             e["pid"] = hi
             events.append(e)
     return {"traceEvents": events, "displayTimeUnit": "ms"}
